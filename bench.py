@@ -1,0 +1,114 @@
+"""Benchmark driver: flat (brute-force) TPU search on the BASELINE.md primary config.
+
+Workload: 1M x 768-d corpus, batch=256 queries, top-10, L2 — the slice-0 gate
+(BASELINE.json: "QPS @ recall@10>=0.95, 1M vecs, 768-d"). The hot path is the
+HBM-resident bf16 masked matmul + top_k (weaviate_tpu.ops.flat_search);
+recall@10 is measured against exact fp32 distances on the same corpus, and
+vs_baseline compares against a numpy (BLAS/AVX) brute-force on this host —
+the stand-in for the reference's AVX2 SIMD distancer tier.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument("--d", type=int, default=768)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--baseline-queries", type=int, default=16)
+    ap.add_argument("--chunk", type=int, default=131072)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from weaviate_tpu.ops.distance import flat_search
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev}", file=sys.stderr)
+
+    key = jax.random.PRNGKey(0)
+    kc, kq = jax.random.split(key)
+    corpus32 = jax.random.normal(kc, (args.n, args.d), jnp.float32)
+    # queries = perturbed corpus rows -> non-degenerate neighbors
+    qbase = corpus32[: args.batch]
+    queries = qbase + 0.1 * jax.random.normal(kq, (args.batch, args.d), jnp.float32)
+    queries = jax.device_put(np.asarray(queries))  # host copy for baseline
+    corpus16 = corpus32.astype(jnp.bfloat16)
+    valid = jnp.ones((args.n,), jnp.bool_)
+    sqnorms = jnp.sum(corpus32 * corpus32, axis=-1)
+    jax.block_until_ready((corpus16, corpus32, valid, sqnorms))
+
+    # --- ground truth: exact fp32 on device ------------------------------
+    gt_d, gt_ids = flat_search(
+        queries, corpus32, k=args.k, metric="l2-squared",
+        valid_mask=valid, corpus_sqnorms=sqnorms,
+        chunk_size=args.chunk, precision="fp32",
+    )
+    gt_ids = np.asarray(jax.block_until_ready(gt_ids))
+
+    # --- timed: bf16 fast path -------------------------------------------
+    def run():
+        return flat_search(
+            queries, corpus16, k=args.k, metric="l2-squared",
+            valid_mask=valid, corpus_sqnorms=sqnorms,
+            chunk_size=args.chunk, precision="bf16",
+        )
+
+    for _ in range(args.warmup):
+        d, ids = run()
+    jax.block_until_ready((d, ids))
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        d, ids = run()
+    jax.block_until_ready((d, ids))
+    dt = time.perf_counter() - t0
+    qps = args.batch * args.iters / dt
+    ids = np.asarray(ids)
+
+    recall = float(
+        np.mean(
+            [
+                len(set(ids[i]) & set(gt_ids[i])) / args.k
+                for i in range(args.batch)
+            ]
+        )
+    )
+
+    # --- CPU baseline (numpy BLAS ~ AVX2 tier) ---------------------------
+    qh = np.asarray(queries[: args.baseline_queries], np.float32)
+    ch = np.asarray(corpus32)
+    nh = np.asarray(sqnorms)
+    t0 = time.perf_counter()
+    scores = qh @ ch.T
+    dists = (qh * qh).sum(1)[:, None] - 2 * scores + nh[None, :]
+    np.argpartition(dists, args.k, axis=1)
+    cpu_dt = time.perf_counter() - t0
+    cpu_qps = args.baseline_queries / cpu_dt
+
+    out = {
+        "metric": f"flat_qps_{args.n//1_000_000}M_{args.d}d_b{args.batch}",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / cpu_qps, 2),
+        "recall_at_10": round(recall, 4),
+        "p50_batch_ms": round(dt / args.iters * 1000, 2),
+        "cpu_baseline_qps": round(cpu_qps, 1),
+        "device": str(dev),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

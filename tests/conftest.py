@@ -1,0 +1,37 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax import.
+
+Mirrors the reference's in-process multi-node tests
+(``adapters/repos/db/clusterintegrationtest/``): instead of spinning real TPU
+pods we validate sharding/collectives on a virtual 8-device CPU mesh.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The image's sitecustomize imports jax before conftest runs, so the env var
+# alone is too late; the config update takes effect because backends
+# initialize lazily.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_dbdir(tmp_path):
+    d = tmp_path / "db"
+    d.mkdir()
+    return str(d)

@@ -1,0 +1,286 @@
+"""End-to-end single-node tests.
+
+Mirrors the reference's ``adapters/repos/db/crud_integration_test.go`` /
+``vector_search_integration_test.go`` pattern: real storage on a tmp dir,
+insert -> search -> delete -> restart -> verify.
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu import DB, CollectionConfig, Property, DataType, FlatIndexConfig
+from weaviate_tpu.inverted.filters import Where
+from weaviate_tpu.schema.config import MultiTenancyConfig, ShardingConfig
+from weaviate_tpu.storage.objects import StorageObject
+
+
+def make_db(path, **kw):
+    return DB(path, **kw)
+
+
+def article_config(name="Article", **kw):
+    return CollectionConfig(
+        name=name,
+        properties=[
+            Property(name="title", data_type=DataType.TEXT),
+            Property(name="body", data_type=DataType.TEXT),
+            Property(name="views", data_type=DataType.INT),
+            Property(name="tags", data_type=DataType.TEXT_ARRAY),
+        ],
+        vector_config=FlatIndexConfig(distance="l2-squared", precision="fp32"),
+        **kw,
+    )
+
+
+def seed(col, n=20, d=8, rng=None):
+    rng = rng or np.random.default_rng(0)
+    vecs = rng.standard_normal((n, d)).astype(np.float32)
+    objs = [
+        StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}",
+            collection=col.config.name,
+            properties={
+                "title": f"article number {i}",
+                "body": "quick brown fox" if i % 2 == 0 else "lazy sleeping dog",
+                "views": i,
+                "tags": ["even" if i % 2 == 0 else "odd"],
+            },
+            vector=vecs[i],
+        )
+        for i in range(n)
+    ]
+    col.put_batch(objs)
+    return vecs, objs
+
+
+def test_create_insert_search(tmp_dbdir, rng):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(article_config())
+    vecs, objs = seed(col, rng=rng)
+    assert col.count() == 20
+
+    # exact nearest neighbor: query with vec 7 itself
+    res = col.vector_search(vecs[7], k=3)
+    assert res[0][0].uuid == objs[7].uuid
+    assert res[0][1] == pytest.approx(0.0, abs=1e-3)
+
+    got = col.get(objs[3].uuid)
+    assert got is not None and got.properties["views"] == 3
+    db.close()
+
+
+def test_filtered_vector_search(tmp_dbdir, rng):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(article_config())
+    vecs, objs = seed(col, rng=rng)
+    flt = Where.and_(Where.contains_any("tags", ["odd"]), Where.gt("views", 10))
+    res = col.vector_search(vecs[0], k=20, flt=flt)
+    assert res, "filtered search returned nothing"
+    for obj, _ in res:
+        assert obj.properties["views"] > 10 and obj.properties["views"] % 2 == 1
+    db.close()
+
+
+def test_bm25(tmp_dbdir, rng):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(article_config())
+    seed(col, rng=rng)
+    res = col.bm25_search("brown fox", k=5)
+    assert res
+    for obj, score in res:
+        assert "fox" in obj.properties["body"]
+        assert score > 0
+    # property-scoped with boost
+    res2 = col.bm25_search("number", k=5, properties=["title^2"])
+    assert res2
+    db.close()
+
+
+def test_update_and_delete(tmp_dbdir, rng):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(article_config())
+    vecs, objs = seed(col, rng=rng)
+
+    # update: same uuid, new vector + props
+    newvec = np.full(8, 9.0, np.float32)
+    col.put(
+        StorageObject(
+            uuid=objs[5].uuid,
+            collection="Article",
+            properties={"title": "updated", "views": 999},
+            vector=newvec,
+        )
+    )
+    assert col.count() == 20
+    got = col.get(objs[5].uuid)
+    assert got.properties["views"] == 999
+    res = col.vector_search(newvec, k=1)
+    assert res[0][0].uuid == objs[5].uuid
+
+    # delete
+    assert col.delete([objs[0].uuid]) == 1
+    assert col.get(objs[0].uuid) is None
+    assert col.count() == 19
+    res = col.vector_search(vecs[0], k=20)
+    assert all(o.uuid != objs[0].uuid for o, _ in res)
+
+    # delete by filter
+    n = col.delete_where(Where.gte("views", 900))
+    assert n == 1
+    assert col.count() == 18
+    db.close()
+
+
+def test_persistence_recovery(tmp_dbdir, rng):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(article_config())
+    vecs, objs = seed(col, rng=rng)
+    col.delete([objs[1].uuid])
+    db.close()
+
+    db2 = make_db(tmp_dbdir)
+    col2 = db2.get_collection("Article")
+    assert col2.count() == 19
+    res = col2.vector_search(vecs[7], k=1)
+    assert res[0][0].uuid == objs[7].uuid
+    assert col2.get(objs[1].uuid) is None
+    # bm25 works after rebuild
+    assert col2.bm25_search("fox", k=3)
+    db2.close()
+
+
+def test_multi_shard(tmp_dbdir, rng):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(
+        article_config(name="Sharded", sharding=ShardingConfig(desired_count=4))
+    )
+    vecs, objs = seed(col, rng=rng)
+    assert col.count() == 20
+    assert len(col._shards) == 4
+    res = col.vector_search(vecs[13], k=1)
+    assert res[0][0].uuid == objs[13].uuid
+    db.close()
+
+
+def test_multi_tenancy(tmp_dbdir, rng):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(
+        article_config(
+            name="Tenanted",
+            multi_tenancy=MultiTenancyConfig(enabled=True),
+        )
+    )
+    col.add_tenant("alice")
+    col.add_tenant("bob")
+    rng2 = np.random.default_rng(1)
+    a_vecs = rng2.standard_normal((5, 8)).astype(np.float32)
+    b_vecs = rng2.standard_normal((3, 8)).astype(np.float32)
+    col.put_batch(
+        [StorageObject(uuid="", collection="Tenanted", properties={"title": f"a{i}"}, vector=a_vecs[i]) for i in range(5)],
+        tenant="alice",
+    )
+    col.put_batch(
+        [StorageObject(uuid="", collection="Tenanted", properties={"title": f"b{i}"}, vector=b_vecs[i]) for i in range(3)],
+        tenant="bob",
+    )
+    assert col.count(tenant="alice") == 5
+    assert col.count(tenant="bob") == 3
+    res = col.vector_search(a_vecs[0], k=10, tenant="alice")
+    assert len(res) == 5
+    with pytest.raises(ValueError):
+        col.vector_search(a_vecs[0], k=1)  # tenant required
+    with pytest.raises(KeyError):
+        col.put_batch([StorageObject(uuid="", collection="T", vector=a_vecs[0])], tenant="carol")
+    db.close()
+
+
+def test_schema_validation(tmp_dbdir):
+    db = make_db(tmp_dbdir)
+    with pytest.raises(ValueError):
+        db.create_collection(CollectionConfig(name="lowercase"))
+    with pytest.raises(ValueError):
+        db.create_collection(
+            CollectionConfig(
+                name="Dup",
+                properties=[Property(name="a"), Property(name="a")],
+            )
+        )
+    db.create_collection(CollectionConfig(name="Ok"))
+    with pytest.raises(ValueError):
+        db.create_collection(CollectionConfig(name="Ok"))
+    db.delete_collection("Ok")
+    assert not db.has_collection("Ok")
+    db.close()
+
+
+def test_duplicate_uuid_in_batch(tmp_dbdir, rng):
+    """Later occurrence wins; earlier one never becomes visible."""
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(article_config())
+    u = "00000000-0000-0000-0000-00000000aaaa"
+    v1 = np.ones(8, np.float32)
+    v2 = -np.ones(8, np.float32)
+    col.put_batch([
+        StorageObject(uuid=u, collection="Article", properties={"views": 1}, vector=v1),
+        StorageObject(uuid=u, collection="Article", properties={"views": 2}, vector=v2),
+    ])
+    assert col.count() == 1
+    assert col.get(u).properties["views"] == 2
+    res = col.vector_search(v1, k=2)
+    assert len(res) == 1  # v1's vector must not be live
+    assert col.delete([u]) == 1
+    assert col.count() == 0
+    db.close()
+
+
+def test_mixed_dims_first_batch_is_atomic(tmp_dbdir):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(article_config())
+    with pytest.raises(ValueError, match="dims"):
+        col.put_batch([
+            StorageObject(uuid="", collection="Article", properties={"views": 1},
+                          vector=np.ones(8, np.float32)),
+            StorageObject(uuid="", collection="Article", properties={"views": 2},
+                          vector=np.ones(16, np.float32)),
+        ])
+    assert col.count() == 0
+    assert col.bm25_search("anything", k=5) == []
+    db.close()
+
+
+def test_unknown_tenant_read_raises(tmp_dbdir):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(
+        article_config(name="T2", multi_tenancy=MultiTenancyConfig(enabled=True))
+    )
+    col.add_tenant("real")
+    with pytest.raises(KeyError):
+        col.count(tenant="ghost")
+    with pytest.raises(KeyError):
+        col.vector_search(np.ones(4, np.float32), k=1, tenant="ghost")
+    db.close()
+
+
+def test_like_filter_literal_brackets(tmp_dbdir):
+    db = make_db(tmp_dbdir)
+    col = db.create_collection(article_config(name="L"))
+    col.put_batch([
+        StorageObject(uuid="", collection="L", properties={"title": "file[0].txt"}),
+        StorageObject(uuid="", collection="L", properties={"title": "file0x"}),
+    ])
+    res = col.filter_search(Where.like("title", "file[0]*"))
+    assert [o.properties["title"] for o in res] == ["file[0].txt"]
+    res = col.filter_search(Where.like("title", "file?x"))
+    assert [o.properties["title"] for o in res] == ["file0x"]
+    db.close()
+
+
+def test_hnsw_config_rejected_until_implemented(tmp_dbdir):
+    from weaviate_tpu import HNSWIndexConfig
+
+    db = make_db(tmp_dbdir)
+    with pytest.raises(ValueError, match="not available"):
+        db.create_collection(
+            CollectionConfig(name="H", vector_config=HNSWIndexConfig())
+        )
+    db.close()

@@ -1,0 +1,56 @@
+"""weaviate-tpu: a TPU-native vector database framework.
+
+A ground-up rebuild of the capabilities of the reference vector database
+(voyage-ai/weaviate, see SURVEY.md) designed TPU-first:
+
+- The distance hot path (SIMD C/asm kernels in the reference,
+  ``adapters/repos/db/vector/hnsw/distancer``) runs on TPU as batched
+  matmul / popcount kernels with ``jax.lax.top_k`` over HBM-resident
+  shard data (:mod:`weaviate_tpu.ops`).
+- Vector indexes (flat / HNSW / IVF, with PQ/SQ/BQ/RQ quantization) keep
+  their data-parallel evaluation on device and their control flow on host
+  (:mod:`weaviate_tpu.index`).
+- Storage (LSM-style buckets + WAL), inverted/BM25 search, hybrid fusion,
+  filters, aggregations, multi-tenancy, sharding and replication mirror the
+  reference's behavior with host-side implementations
+  (:mod:`weaviate_tpu.storage`, :mod:`weaviate_tpu.inverted`,
+  :mod:`weaviate_tpu.query`, :mod:`weaviate_tpu.core`).
+- Multi-device scale-out uses ``jax.sharding.Mesh`` + ``shard_map`` over
+  ICI instead of the reference's node-to-node scatter
+  (:mod:`weaviate_tpu.parallel`).
+"""
+
+from weaviate_tpu.version import __version__
+
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    Property,
+    DataType,
+    VectorIndexConfig,
+    FlatIndexConfig,
+    HNSWIndexConfig,
+    DynamicIndexConfig,
+    QuantizerConfig,
+    PQConfig,
+    SQConfig,
+    BQConfig,
+    RQConfig,
+)
+from weaviate_tpu.core.db import DB
+
+__all__ = [
+    "__version__",
+    "DB",
+    "CollectionConfig",
+    "Property",
+    "DataType",
+    "VectorIndexConfig",
+    "FlatIndexConfig",
+    "HNSWIndexConfig",
+    "DynamicIndexConfig",
+    "QuantizerConfig",
+    "PQConfig",
+    "SQConfig",
+    "BQConfig",
+    "RQConfig",
+]

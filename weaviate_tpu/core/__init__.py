@@ -1,0 +1,5 @@
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.core.shard import Shard
+from weaviate_tpu.core.collection import Collection
+
+__all__ = ["DB", "Shard", "Collection"]

@@ -1,0 +1,309 @@
+"""Collection: per-class index owning shards, with scatter-gather search.
+
+Reference: ``adapters/repos/db/index.go:219`` (Index) — owns a shard map,
+routes writes by UUID hash (``usecases/sharding/state.go``) or tenant name,
+fans searches out per shard and merges (``index.go:1928 objectVectorSearch``,
+``search_deduplication.go``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+import numpy as np
+
+from weaviate_tpu.core.shard import DEFAULT_VECTOR, Shard
+from weaviate_tpu.index.base import SearchResult
+from weaviate_tpu.inverted.filters import Filter
+from weaviate_tpu.schema.config import CollectionConfig
+from weaviate_tpu.storage.objects import StorageObject
+
+TENANT_HOT = "HOT"
+TENANT_COLD = "COLD"
+TENANT_FROZEN = "FROZEN"
+
+
+class Collection:
+    def __init__(self, dirpath: str, config: CollectionConfig, sync_writes: bool = False):
+        self.dir = dirpath
+        self.config = config
+        self.sync_writes = sync_writes
+        os.makedirs(dirpath, exist_ok=True)
+        self._lock = threading.RLock()
+        self._shards: dict[str, Shard] = {}
+        self._tenant_status: dict[str, str] = {}
+        self._pool = ThreadPoolExecutor(max_workers=8)
+        if not config.multi_tenancy.enabled:
+            for i in range(max(1, config.sharding.desired_count)):
+                self._get_shard(f"shard{i}")
+        else:
+            # discover existing tenant shards on disk
+            for d in sorted(os.listdir(dirpath)):
+                if os.path.isdir(os.path.join(dirpath, d)) and d.startswith("tenant-"):
+                    name = d[len("tenant-"):]
+                    self._tenant_status[name] = TENANT_HOT
+                    self._get_shard(f"tenant-{name}")
+
+    # -- shard management -------------------------------------------------
+    def _get_shard(self, name: str) -> Shard:
+        with self._lock:
+            s = self._shards.get(name)
+            if s is None:
+                s = Shard(
+                    os.path.join(self.dir, name),
+                    self.config,
+                    name=name,
+                    sync_writes=self.sync_writes,
+                )
+                self._shards[name] = s
+            return s
+
+    def _shard_for_uuid(self, uuid: str) -> Shard:
+        n = max(1, self.config.sharding.desired_count)
+        h = int.from_bytes(hashlib.md5(uuid.encode()).digest()[:8], "big")
+        return self._get_shard(f"shard{h % n}")
+
+    def _route(self, uuid: str, tenant: str = "") -> Shard:
+        if self.config.multi_tenancy.enabled:
+            if not tenant:
+                raise ValueError(
+                    f"collection {self.config.name!r} is multi-tenant: tenant required"
+                )
+            if tenant not in self._tenant_status:
+                if self.config.multi_tenancy.auto_tenant_creation:
+                    self.add_tenant(tenant)
+                else:
+                    raise KeyError(f"tenant {tenant!r} not found")
+            if self._tenant_status[tenant] != TENANT_HOT:
+                if self.config.multi_tenancy.auto_tenant_activation:
+                    self._tenant_status[tenant] = TENANT_HOT
+                else:
+                    raise RuntimeError(f"tenant {tenant!r} is not active")
+            return self._get_shard(f"tenant-{tenant}")
+        return self._shard_for_uuid(uuid)
+
+    def _search_shards(self, tenant: str = "") -> list[Shard]:
+        if self.config.multi_tenancy.enabled:
+            if not tenant:
+                raise ValueError("tenant required for multi-tenant search")
+            if tenant not in self._tenant_status:
+                raise KeyError(f"tenant {tenant!r} not found")
+            if self._tenant_status[tenant] != TENANT_HOT:
+                raise RuntimeError(f"tenant {tenant!r} is not active")
+            return [self._get_shard(f"tenant-{tenant}")]
+        return [self._get_shard(f"shard{i}")
+                for i in range(max(1, self.config.sharding.desired_count))]
+
+    # -- tenants ----------------------------------------------------------
+    def add_tenant(self, name: str, status: str = TENANT_HOT) -> None:
+        with self._lock:
+            self._tenant_status.setdefault(name, status)
+
+    def remove_tenant(self, name: str) -> None:
+        with self._lock:
+            self._tenant_status.pop(name, None)
+            s = self._shards.pop(f"tenant-{name}", None)
+            if s is not None:
+                s.close()
+
+    def tenants(self) -> dict[str, str]:
+        return dict(self._tenant_status)
+
+    def set_tenant_status(self, name: str, status: str) -> None:
+        if status not in (TENANT_HOT, TENANT_COLD, TENANT_FROZEN):
+            raise ValueError(f"invalid tenant status {status!r}")
+        with self._lock:
+            if name not in self._tenant_status:
+                raise KeyError(f"tenant {name!r} not found")
+            self._tenant_status[name] = status
+            if status != TENANT_HOT:
+                s = self._shards.pop(f"tenant-{name}", None)
+                if s is not None:
+                    s.close()
+
+    # -- writes -----------------------------------------------------------
+    def put_batch(self, objs: list[StorageObject], tenant: str = "") -> list[str]:
+        by_shard: dict[str, list[StorageObject]] = {}
+        for o in objs:
+            o.collection = self.config.name
+            o.tenant = tenant
+            shard = self._route(o.uuid, tenant)
+            by_shard.setdefault(shard.name, []).append(o)
+        for name, group in by_shard.items():
+            self._shards[name].put_batch(group)
+        return [o.uuid for o in objs]
+
+    def put(self, obj: StorageObject, tenant: str = "") -> str:
+        return self.put_batch([obj], tenant)[0]
+
+    def delete(self, uuids: list[str], tenant: str = "") -> int:
+        by_shard: dict[str, list[str]] = {}
+        for u in uuids:
+            shard = self._route(u, tenant)
+            by_shard.setdefault(shard.name, []).append(u)
+        return sum(
+            self._shards[name].delete(group) for name, group in by_shard.items()
+        )
+
+    def delete_where(self, flt: Filter, tenant: str = "") -> int:
+        """Batch delete by filter (reference ``batch_delete.go``)."""
+        n = 0
+        for shard in self._search_shards(tenant):
+            space = shard._next_doc_id
+            mask = shard.inverted.allow_list(flt, space)
+            doc_ids = np.nonzero(mask)[0]
+            uuids = []
+            for d in doc_ids:
+                obj = shard.get_by_docid(int(d))
+                if obj is not None:
+                    uuids.append(obj.uuid)
+            n += shard.delete(uuids)
+        return n
+
+    # -- reads ------------------------------------------------------------
+    def get(self, uuid: str, tenant: str = "") -> Optional[StorageObject]:
+        return self._route(uuid, tenant).get_by_uuid(uuid)
+
+    def exists(self, uuid: str, tenant: str = "") -> bool:
+        return self._route(uuid, tenant).exists(uuid)
+
+    def count(self, tenant: str = "") -> int:
+        return sum(s.count() for s in self._search_shards(tenant))
+
+    def objects_page(self, limit: int = 25, offset: int = 0, tenant: str = "") -> list[StorageObject]:
+        out: list[StorageObject] = []
+        for s in self._search_shards(tenant):
+            for key, raw in s.objects.items():
+                out.append(StorageObject.from_bytes(raw))
+                if len(out) >= offset + limit:
+                    break
+            if len(out) >= offset + limit:
+                break
+        return out[offset : offset + limit]
+
+    # -- search -----------------------------------------------------------
+    def vector_search(
+        self,
+        query: np.ndarray,
+        k: int = 10,
+        target: str = DEFAULT_VECTOR,
+        flt: Optional[Filter] = None,
+        tenant: str = "",
+        max_distance: Optional[float] = None,
+    ) -> list[tuple[StorageObject, float]]:
+        """Single-query convenience wrapper over batched scatter-gather."""
+        res = self.vector_search_batch(
+            np.atleast_2d(np.asarray(query, np.float32)),
+            k,
+            target=target,
+            flt=flt,
+            tenant=tenant,
+            max_distance=max_distance,
+        )
+        return res[0]
+
+    def vector_search_batch(
+        self,
+        queries: np.ndarray,
+        k: int = 10,
+        target: str = DEFAULT_VECTOR,
+        flt: Optional[Filter] = None,
+        tenant: str = "",
+        max_distance: Optional[float] = None,
+    ) -> list[list[tuple[StorageObject, float]]]:
+        shards = self._search_shards(tenant)
+        per_shard: list[tuple[Shard, SearchResult]] = []
+
+        def run(shard: Shard):
+            allow = None
+            if flt is not None:
+                allow = shard.inverted.allow_list(flt, max(shard._next_doc_id, 1))
+            return shard, shard.vector_search(
+                queries, k, target=target, allow_list=allow, max_distance=max_distance
+            )
+
+        if len(shards) == 1:
+            per_shard = [run(shards[0])]
+        else:
+            per_shard = list(self._pool.map(run, shards))
+
+        b = np.atleast_2d(queries).shape[0]
+        out: list[list[tuple[StorageObject, float]]] = []
+        for qi in range(b):
+            cands: list[tuple[float, Shard, int]] = []
+            for shard, res in per_shard:
+                for d, i in zip(res.dists[qi], res.ids[qi]):
+                    if i >= 0:
+                        cands.append((float(d), shard, int(i)))
+            cands.sort(key=lambda t: t[0])
+            row = []
+            for d, shard, docid in cands[:k]:
+                obj = shard.get_by_docid(docid)
+                if obj is not None:
+                    row.append((obj, d))
+            out.append(row)
+        return out
+
+    def bm25_search(
+        self,
+        query: str,
+        k: int = 10,
+        properties: Optional[list[str]] = None,
+        flt: Optional[Filter] = None,
+        tenant: str = "",
+    ) -> list[tuple[StorageObject, float]]:
+        results: list[tuple[float, Shard, int]] = []
+        for shard in self._search_shards(tenant):
+            allow = None
+            space = max(shard._next_doc_id, 1)
+            if flt is not None:
+                allow = shard.inverted.allow_list(flt, space)
+            ids, scores = shard.inverted.bm25_search(
+                query, k, properties=properties, allow_list=allow, doc_space=space
+            )
+            for i, s in zip(ids, scores):
+                results.append((float(s), shard, int(i)))
+        results.sort(key=lambda t: -t[0])
+        out = []
+        for s, shard, docid in results[:k]:
+            obj = shard.get_by_docid(docid)
+            if obj is not None:
+                out.append((obj, s))
+        return out
+
+    def filter_search(
+        self, flt: Filter, limit: int = 100, tenant: str = ""
+    ) -> list[StorageObject]:
+        out: list[StorageObject] = []
+        for shard in self._search_shards(tenant):
+            space = max(shard._next_doc_id, 1)
+            mask = shard.inverted.allow_list(flt, space)
+            for d in np.nonzero(mask)[0]:
+                obj = shard.get_by_docid(int(d))
+                if obj is not None:
+                    out.append(obj)
+                    if len(out) >= limit:
+                        return out
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+    def flush(self) -> None:
+        for s in self._shards.values():
+            s.flush()
+
+    def close(self) -> None:
+        for s in self._shards.values():
+            s.close()
+        self._pool.shutdown(wait=False)
+
+    def stats(self) -> dict:
+        return {
+            "name": self.config.name,
+            "objects": self.count() if not self.config.multi_tenancy.enabled else None,
+            "shards": {n: s.stats() for n, s in self._shards.items()},
+            "tenants": dict(self._tenant_status),
+        }

@@ -1,0 +1,113 @@
+"""DB: the top-level object — schema + collection map.
+
+Reference: ``adapters/repos/db/repo.go:52`` (DB) + the schema manager
+(``usecases/schema/handler.go``). Single-node round 1: schema mutations apply
+locally and persist to ``schema.json`` (the Raft FSM equivalent slot —
+``cluster/schema/schema.go`` — arrives with the cluster layer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+from weaviate_tpu.core.collection import Collection
+from weaviate_tpu.schema.config import CollectionConfig
+
+
+class DB:
+    def __init__(self, root: str, sync_writes: bool = False):
+        self.root = root
+        self.sync_writes = sync_writes
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.RLock()
+        self._collections: dict[str, Collection] = {}
+        self._schema_path = os.path.join(root, "schema.json")
+        self._load_schema()
+
+    def _load_schema(self) -> None:
+        if not os.path.exists(self._schema_path):
+            return
+        with open(self._schema_path) as f:
+            data = json.load(f)
+        for cd in data.get("collections", []):
+            cfg = CollectionConfig.from_dict(cd)
+            self._collections[cfg.name] = Collection(
+                os.path.join(self.root, cfg.name), cfg, sync_writes=self.sync_writes
+            )
+
+    def _persist_schema(self) -> None:
+        data = {
+            "collections": [c.config.to_dict() for c in self._collections.values()]
+        }
+        tmp = self._schema_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1)
+        os.replace(tmp, self._schema_path)
+
+    # -- schema API -------------------------------------------------------
+    def create_collection(self, config: CollectionConfig) -> Collection:
+        config.validate()
+        with self._lock:
+            if config.name in self._collections:
+                raise ValueError(f"collection {config.name!r} already exists")
+            c = Collection(
+                os.path.join(self.root, config.name),
+                config,
+                sync_writes=self.sync_writes,
+            )
+            self._collections[config.name] = c
+            self._persist_schema()
+            return c
+
+    def get_collection(self, name: str) -> Collection:
+        c = self._collections.get(name)
+        if c is None:
+            raise KeyError(f"collection {name!r} not found")
+        return c
+
+    def has_collection(self, name: str) -> bool:
+        return name in self._collections
+
+    def delete_collection(self, name: str) -> None:
+        with self._lock:
+            c = self._collections.pop(name, None)
+            if c is None:
+                return
+            c.close()
+            import shutil
+
+            shutil.rmtree(c.dir, ignore_errors=True)
+            self._persist_schema()
+
+    def add_property(self, collection: str, prop) -> None:
+        with self._lock:
+            c = self.get_collection(collection)
+            if c.config.property(prop.name) is not None:
+                raise ValueError(f"property {prop.name!r} already exists")
+            c.config.properties.append(prop)
+            self._persist_schema()
+
+    def collections(self) -> list[str]:
+        return sorted(self._collections.keys())
+
+    def schema_dict(self) -> dict:
+        return {
+            "collections": [c.config.to_dict() for c in self._collections.values()]
+        }
+
+    # -- lifecycle --------------------------------------------------------
+    def flush(self) -> None:
+        for c in self._collections.values():
+            c.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            for c in self._collections.values():
+                c.close()
+            self._collections = {}
+
+    def stats(self) -> dict:
+        return {name: c.stats() for name, c in self._collections.items()}

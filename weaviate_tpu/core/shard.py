@@ -1,0 +1,291 @@
+"""Shard: the unit of data ownership.
+
+Reference: ``adapters/repos/db/shard.go:204`` — each shard owns an LSMKV
+store, inverted indexes, one-or-more vector indexes (named target vectors),
+and a doc-id counter. Write path mirrors ``shard_write_batch_objects.go:33``
+(object store -> inverted -> vector index -> WAL flush); read path mirrors
+``shard_read.go:374`` (ObjectVectorSearch).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Any, Optional
+
+import msgpack
+import numpy as np
+
+from weaviate_tpu.index.base import SearchResult, VectorIndex
+from weaviate_tpu.inverted.index import InvertedIndex
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    DynamicIndexConfig,
+    FlatIndexConfig,
+    HNSWIndexConfig,
+    VectorIndexConfig,
+)
+from weaviate_tpu.storage.objects import StorageObject
+from weaviate_tpu.storage.store import Store
+
+_DOCID = struct.Struct(">q")
+
+DEFAULT_VECTOR = ""  # unnamed/default target vector
+
+
+def build_vector_index(dims: int, cfg: VectorIndexConfig) -> VectorIndex:
+    """Factory mirroring ``shard_init_vector.go`` index selection."""
+    if isinstance(cfg, HNSWIndexConfig) or cfg.index_type == "hnsw":
+        from weaviate_tpu.index.hnsw import HNSWIndex
+
+        if not isinstance(cfg, HNSWIndexConfig):
+            cfg = HNSWIndexConfig(**{**cfg.to_dict(), "index_type": "hnsw"})
+        return HNSWIndex(dims, cfg)
+    if isinstance(cfg, DynamicIndexConfig) or cfg.index_type == "dynamic":
+        from weaviate_tpu.index.dynamic import DynamicIndex
+
+        if not isinstance(cfg, DynamicIndexConfig):
+            cfg = DynamicIndexConfig(**{**cfg.to_dict(), "index_type": "dynamic"})
+        return DynamicIndex(dims, cfg)
+    from weaviate_tpu.index.flat import FlatIndex
+
+    if not isinstance(cfg, FlatIndexConfig):
+        cfg = FlatIndexConfig(**{**cfg.to_dict(), "index_type": "flat"})
+    return FlatIndex(dims, cfg)
+
+
+class Shard:
+    def __init__(self, dirpath: str, config: CollectionConfig, name: str = "shard0",
+                 sync_writes: bool = False):
+        self.dir = dirpath
+        self.name = name
+        self.config = config
+        os.makedirs(dirpath, exist_ok=True)
+        self.store = Store(os.path.join(dirpath, "lsm"), sync=sync_writes)
+        self.objects = self.store.bucket("objects")  # docid(8B BE) -> storobj
+        self.ids = self.store.bucket("ids")  # uuid bytes -> docid(8B)
+        self.inverted = InvertedIndex(config, self.store)
+        self._lock = threading.RLock()
+        self._vector_indexes: dict[str, VectorIndex] = {}
+        self._counter_path = os.path.join(dirpath, "counter.bin")
+        self._meta_path = os.path.join(dirpath, "meta.bin")
+        self._next_doc_id = 0
+        self._dims: dict[str, int] = {}
+        self._recover()
+
+    # -- recovery ---------------------------------------------------------
+    def _recover(self) -> None:
+        if os.path.exists(self._counter_path):
+            with open(self._counter_path, "rb") as f:
+                self._next_doc_id = msgpack.unpackb(f.read())
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path, "rb") as f:
+                meta = msgpack.unpackb(f.read(), raw=False)
+            self._dims = meta.get("dims", {})
+        # Rebuild vector indexes + tombstones from the object store. The
+        # reference replays the HNSW commit log instead (hnsw/startup.go);
+        # our indexes rebuild from durable objects (cheap: batched device
+        # scatter) — commit-log persistence for HNSW graphs comes with the
+        # HNSW index itself.
+        batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
+        live = 0
+        for key, raw in self.objects.items():
+            obj = StorageObject.from_bytes(raw)
+            live += 1
+            self.inverted.add_object(obj)
+            if obj.vector is not None:
+                batches.setdefault(DEFAULT_VECTOR, ([], []))[0].append(obj.doc_id)
+                batches[DEFAULT_VECTOR][1].append(obj.vector)
+            for nm, v in obj.named_vectors.items():
+                batches.setdefault(nm, ([], []))[0].append(obj.doc_id)
+                batches[nm][1].append(v)
+        for nm, (ids, vecs) in batches.items():
+            idx = self._index_for(nm, len(vecs[0]))
+            idx.add_batch(np.asarray(ids, np.int64), np.stack(vecs))
+        self._live_count = live
+
+    def _persist_counter(self) -> None:
+        with open(self._counter_path + ".tmp", "wb") as f:
+            f.write(msgpack.packb(self._next_doc_id))
+        os.replace(self._counter_path + ".tmp", self._counter_path)
+
+    def _persist_meta(self) -> None:
+        with open(self._meta_path + ".tmp", "wb") as f:
+            f.write(msgpack.packb({"dims": self._dims}, use_bin_type=True))
+        os.replace(self._meta_path + ".tmp", self._meta_path)
+
+    # -- vector index plumbing -------------------------------------------
+    def _config_for(self, target: str) -> VectorIndexConfig:
+        if target == DEFAULT_VECTOR:
+            return self.config.vector_config
+        cfg = self.config.named_vectors.get(target)
+        if cfg is None:
+            raise KeyError(f"unknown target vector {target!r}")
+        return cfg
+
+    def _index_for(self, target: str, dims: int) -> VectorIndex:
+        idx = self._vector_indexes.get(target)
+        if idx is None:
+            idx = build_vector_index(dims, self._config_for(target))
+            self._vector_indexes[target] = idx
+            self._dims[target] = dims
+            self._persist_meta()
+        return idx
+
+    def vector_index(self, target: str = DEFAULT_VECTOR) -> Optional[VectorIndex]:
+        return self._vector_indexes.get(target)
+
+    # -- write path -------------------------------------------------------
+    def put_batch(self, objs: list[StorageObject]) -> list[int]:
+        """Batch insert/update. Returns assigned doc ids.
+
+        Mirrors objectsBatcher (``shard_write_batch_objects.go:84-140``):
+        resolve doc ids (new vs update), store objects, update inverted,
+        feed vector indexes in one device batch per target vector.
+        """
+        with self._lock:
+            # validate up-front so a bad object can't leave a partial batch:
+            # every vector for a target must match the index dims (or, for a
+            # brand-new target, the dims of the first vector in this batch)
+            batch_dims = dict(self._dims)
+            for obj in objs:
+                vec_items = []
+                if obj.vector is not None:
+                    vec_items.append((DEFAULT_VECTOR, obj.vector))
+                vec_items.extend(obj.named_vectors.items())
+                for nm, vec in vec_items:
+                    d = int(np.asarray(vec).shape[-1])
+                    want = batch_dims.setdefault(nm, d)
+                    if d != want:
+                        raise ValueError(
+                            f"object {obj.uuid}: vector {nm or 'default'!r} dims "
+                            f"{d} != index dims {want}"
+                        )
+            # same uuid twice in one batch: the later occurrence wins; the
+            # earlier one is never written (it was never visible)
+            final: dict[str, StorageObject] = {o.uuid: o for o in objs}
+            doc_ids: list[int] = []
+            old_docids: list[int] = []
+            for obj in objs:
+                obj.doc_id = self._next_doc_id
+                self._next_doc_id += 1
+                doc_ids.append(obj.doc_id)
+            for uuid, obj in final.items():
+                prev = self.ids.get(uuid.encode())
+                if prev is not None:
+                    # update == new docid, old one tombstoned (reference
+                    # updates reuse uuid but bump docid)
+                    old_docids.append(_DOCID.unpack(prev)[0])
+            self._persist_counter()
+
+            batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
+            for obj in final.values():
+                self.ids.put(obj.uuid.encode(), _DOCID.pack(obj.doc_id))
+                self.objects.put(_DOCID.pack(obj.doc_id), obj.to_bytes())
+                self.inverted.add_object(obj)
+                if obj.vector is not None:
+                    b = batches.setdefault(DEFAULT_VECTOR, ([], []))
+                    b[0].append(obj.doc_id)
+                    b[1].append(np.asarray(obj.vector, np.float32))
+                for nm, v in obj.named_vectors.items():
+                    b = batches.setdefault(nm, ([], []))
+                    b[0].append(obj.doc_id)
+                    b[1].append(np.asarray(v, np.float32))
+
+            if old_docids:
+                self._delete_docids(old_docids)
+
+            for nm, (ids, vecs) in batches.items():
+                idx = self._index_for(nm, vecs[0].shape[-1])
+                idx.add_batch(np.asarray(ids, np.int64), np.stack(vecs))
+            self._live_count += len(final)
+            return doc_ids
+
+    def _delete_docids(self, doc_ids: list[int]) -> None:
+        for d in doc_ids:
+            raw = self.objects.get(_DOCID.pack(d))
+            if raw is not None:
+                old = StorageObject.from_bytes(raw)
+                self.inverted.delete_object(old)
+                self.objects.delete(_DOCID.pack(d))
+                self._live_count -= 1
+        arr = np.asarray(doc_ids, np.int64)
+        for idx in self._vector_indexes.values():
+            idx.delete(arr)
+
+    def delete(self, uuids: list[str]) -> int:
+        """Delete by uuid; returns number actually removed."""
+        with self._lock:
+            doc_ids = []
+            for u in uuids:
+                key = u.encode()
+                prev = self.ids.get(key)
+                if prev is None:
+                    continue
+                doc_ids.append(_DOCID.unpack(prev)[0])
+                self.ids.delete(key)
+            if doc_ids:
+                self._delete_docids(doc_ids)
+            return len(doc_ids)
+
+    # -- read path --------------------------------------------------------
+    def get_by_uuid(self, uuid: str) -> Optional[StorageObject]:
+        prev = self.ids.get(uuid.encode())
+        if prev is None:
+            return None
+        return self.get_by_docid(_DOCID.unpack(prev)[0])
+
+    def get_by_docid(self, doc_id: int) -> Optional[StorageObject]:
+        raw = self.objects.get(_DOCID.pack(doc_id))
+        return None if raw is None else StorageObject.from_bytes(raw)
+
+    def exists(self, uuid: str) -> bool:
+        return self.ids.get(uuid.encode()) is not None
+
+    def count(self) -> int:
+        return self._live_count
+
+    def vector_search(
+        self,
+        queries: np.ndarray,
+        k: int,
+        target: str = DEFAULT_VECTOR,
+        allow_list: Optional[np.ndarray] = None,
+        max_distance: Optional[float] = None,
+    ) -> SearchResult:
+        idx = self._vector_indexes.get(target)
+        if idx is None:
+            b = np.atleast_2d(queries).shape[0]
+            return SearchResult(
+                ids=np.full((b, k), -1, np.int64),
+                dists=np.full((b, k), np.inf, np.float32),
+            )
+        if max_distance is not None:
+            return idx.search_by_distance(queries, max_distance, allow_list, limit=k)
+        return idx.search(queries, k, allow_list)
+
+    def objects_by_docids(self, doc_ids: np.ndarray) -> list[Optional[StorageObject]]:
+        return [self.get_by_docid(int(d)) if d >= 0 else None for d in doc_ids]
+
+    # -- lifecycle --------------------------------------------------------
+    def flush(self) -> None:
+        self.store.flush_all()
+        self._persist_counter()
+        self._persist_meta()
+        for idx in self._vector_indexes.values():
+            idx.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self.store.close()
+
+    def stats(self) -> dict:
+        return {
+            "name": self.name,
+            "objects": self.count(),
+            "next_doc_id": self._next_doc_id,
+            "vector_indexes": {
+                nm: idx.stats() for nm, idx in self._vector_indexes.items()
+            },
+        }

@@ -1,0 +1,5 @@
+from weaviate_tpu.index.base import VectorIndex, SearchResult
+from weaviate_tpu.index.flat import FlatIndex
+from weaviate_tpu.index.store import DeviceVectorStore
+
+__all__ = ["VectorIndex", "SearchResult", "FlatIndex", "DeviceVectorStore"]

@@ -1,0 +1,79 @@
+"""The vector-index interface every backend implements.
+
+Mirrors the reference's ``adapters/repos/db/vector_index.go:25`` (VectorIndex:
+Add/AddBatch/Delete/SearchByVector/SearchByVectorDistance/Flush/Drop/
+PostStartup/...), with one deliberate TPU-first change: **every method is
+batched**. The reference's per-vector ``Add(id, vec)`` / per-candidate
+``Distance`` calls would serialize the device; here the unit of work is a
+batch of ids/vectors/queries.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class SearchResult:
+    """Top-k result for a batch of queries: ids[b, k] (-1 = empty), dists[b, k]."""
+
+    ids: np.ndarray
+    dists: np.ndarray
+
+
+class VectorIndex(abc.ABC):
+    """Batched ANN index over internal doc ids (uint64 monotonic per shard)."""
+
+    multi_vector: bool = False
+
+    @abc.abstractmethod
+    def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        """Insert/overwrite vectors for the given internal doc ids."""
+
+    @abc.abstractmethod
+    def delete(self, doc_ids: np.ndarray) -> None:
+        """Remove ids (tombstone semantics — slots masked, space reclaimed later)."""
+
+    @abc.abstractmethod
+    def search(
+        self,
+        queries: np.ndarray,
+        k: int,
+        allow_list: Optional[np.ndarray] = None,
+    ) -> SearchResult:
+        """Batched top-k by vector. ``allow_list``: bool mask over doc ids."""
+
+    @abc.abstractmethod
+    def search_by_distance(
+        self,
+        queries: np.ndarray,
+        max_distance: float,
+        allow_list: Optional[np.ndarray] = None,
+        limit: int = 1024,
+    ) -> SearchResult:
+        """All results within max_distance (reference SearchByVectorDistance)."""
+
+    @abc.abstractmethod
+    def count(self) -> int:
+        """Live (non-deleted) vector count."""
+
+    @property
+    @abc.abstractmethod
+    def capacity(self) -> int:
+        """Current padded device capacity (doc-id space size)."""
+
+    def contains(self, doc_id: int) -> bool:
+        raise NotImplementedError
+
+    def flush(self) -> None:  # durability hook; storage owns real persistence
+        pass
+
+    def drop(self) -> None:
+        pass
+
+    def stats(self) -> dict:
+        return {"count": self.count(), "capacity": self.capacity}

@@ -1,0 +1,111 @@
+"""Flat (brute-force) TPU index.
+
+Reference: ``adapters/repos/db/vector/flat/index.go:49``. There, flat search is
+the slow fallback (scan LSM bucket, per-vector SIMD distance). On TPU it is the
+*primary* fast path: the whole corpus lives in HBM and a query batch is one
+fused masked-matmul + top_k (see SURVEY.md §7 slice 0 and BASELINE.md SIFT1M
+config).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.index.base import SearchResult, VectorIndex
+from weaviate_tpu.index.store import DeviceVectorStore
+from weaviate_tpu.ops.distance import MASK_DISTANCE, flat_search
+from weaviate_tpu.ops.topk import masked_topk
+from weaviate_tpu.schema.config import FlatIndexConfig
+
+
+class FlatIndex(VectorIndex):
+    def __init__(self, dims: int, config: Optional[FlatIndexConfig] = None):
+        self.config = config or FlatIndexConfig()
+        self.metric = self.config.distance
+        self.store = DeviceVectorStore(
+            dims,
+            capacity=self.config.initial_capacity,
+            normalized=(self.metric == "cosine"),
+        )
+
+    # -- VectorIndex ------------------------------------------------------
+    def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        self.store.put(doc_ids, vectors)
+
+    def delete(self, doc_ids: np.ndarray) -> None:
+        self.store.delete(doc_ids)
+
+    def search(
+        self,
+        queries: np.ndarray,
+        k: int,
+        allow_list: Optional[np.ndarray] = None,
+    ) -> SearchResult:
+        queries = np.atleast_2d(np.asarray(queries, np.float32))
+        if queries.shape[-1] != self.store.dims:
+            raise ValueError(
+                f"query dims {queries.shape[-1]} != index dims {self.store.dims}"
+            )
+        qj = jnp.asarray(queries)
+        if self.metric == "cosine":
+            from weaviate_tpu.ops.distance import normalize
+
+            qj = normalize(qj)
+        allow = None
+        if allow_list is not None:
+            allow = _pad_mask(allow_list, self.store.capacity)
+        chunk = self.config.search_chunk_size
+        d, ids = flat_search(
+            qj,
+            self.store.corpus,
+            k=k,
+            metric=self.metric,
+            valid_mask=self.store.valid_mask,
+            allow_mask=allow,
+            corpus_sqnorms=self.store.sqnorms if self.metric == "l2-squared" else None,
+            chunk_size=chunk if self.store.capacity > chunk else 0,
+            precision=self.config.precision,
+        )
+        return SearchResult(ids=np.asarray(ids), dists=np.asarray(d))
+
+    def search_by_distance(
+        self,
+        queries: np.ndarray,
+        max_distance: float,
+        allow_list: Optional[np.ndarray] = None,
+        limit: int = 1024,
+    ) -> SearchResult:
+        k = min(limit, max(1, self.store.live_count))
+        res = self.search(queries, k, allow_list)
+        keep = res.dists <= max_distance
+        ids = np.where(keep, res.ids, -1)
+        dists = np.where(keep, res.dists, np.float32(MASK_DISTANCE))
+        return SearchResult(ids=ids, dists=dists)
+
+    def count(self) -> int:
+        return self.store.live_count
+
+    @property
+    def capacity(self) -> int:
+        return self.store.capacity
+
+    def contains(self, doc_id: int) -> bool:
+        return self.store.contains(doc_id)
+
+    def stats(self) -> dict:
+        return {
+            "type": "flat",
+            "count": self.count(),
+            "capacity": self.capacity,
+            "metric": self.metric,
+        }
+
+
+def _pad_mask(mask: np.ndarray, capacity: int) -> jnp.ndarray:
+    mask = np.asarray(mask, bool)
+    if mask.shape[0] < capacity:
+        mask = np.pad(mask, (0, capacity - mask.shape[0]))
+    return jnp.asarray(mask[:capacity])

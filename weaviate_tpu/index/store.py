@@ -1,0 +1,145 @@
+"""HBM-resident vector store with append watermark + tombstone mask.
+
+The TPU analogue of the reference's sharded in-RAM vector cache
+(``vector/cache/sharded_lock_cache.go``): a padded ``[capacity, D]`` device
+array indexed directly by internal doc id, plus a validity mask. Growth uses
+the donate-and-copy pattern (grow-by-doubling, like the cache's page growth);
+updates are jitted scatters so steady-state ingest never leaves the device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.ops.distance import normalize
+
+_PAGE = 4096
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _scatter(corpus, valid, sqnorms, ids, vecs, norms):
+    corpus = corpus.at[ids].set(vecs)
+    valid = valid.at[ids].set(True)
+    sqnorms = sqnorms.at[ids].set(norms)
+    return corpus, valid, sqnorms
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _mask_off(valid, ids):
+    return valid.at[ids].set(False)
+
+
+@functools.partial(jax.jit, static_argnames=("new_cap",), donate_argnums=())
+def _grow(corpus, valid, sqnorms, new_cap):
+    d = corpus.shape[1]
+    nc = jnp.zeros((new_cap, d), corpus.dtype).at[: corpus.shape[0]].set(corpus)
+    nv = jnp.zeros((new_cap,), jnp.bool_).at[: valid.shape[0]].set(valid)
+    ns = jnp.zeros((new_cap,), jnp.float32).at[: sqnorms.shape[0]].set(sqnorms)
+    return nc, nv, ns
+
+
+class DeviceVectorStore:
+    """Doc-id-addressed [capacity, D] device array + validity mask + sq-norms."""
+
+    def __init__(
+        self,
+        dims: int,
+        capacity: int = _PAGE,
+        dtype=jnp.float32,
+        normalized: bool = False,
+        device: Optional[jax.Device] = None,
+    ):
+        self.dims = dims
+        self.dtype = dtype
+        self.normalized = normalized
+        self.device = device
+        cap = max(_PAGE, _round_up(capacity))
+        self._corpus = jnp.zeros((cap, dims), dtype)
+        self._valid = jnp.zeros((cap,), jnp.bool_)
+        self._sqnorms = jnp.zeros((cap,), jnp.float32)
+        self._watermark = 0  # max assigned id + 1
+        self._live = 0
+
+    # -- properties -------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self._corpus.shape[0]
+
+    @property
+    def watermark(self) -> int:
+        return self._watermark
+
+    @property
+    def live_count(self) -> int:
+        return self._live
+
+    @property
+    def corpus(self) -> jnp.ndarray:
+        return self._corpus
+
+    @property
+    def valid_mask(self) -> jnp.ndarray:
+        return self._valid
+
+    @property
+    def sqnorms(self) -> jnp.ndarray:
+        return self._sqnorms
+
+    # -- mutation ---------------------------------------------------------
+    def ensure_capacity(self, min_capacity: int) -> None:
+        if min_capacity <= self.capacity:
+            return
+        new_cap = _round_up(max(min_capacity, self.capacity * 2))
+        self._corpus, self._valid, self._sqnorms = _grow(
+            self._corpus, self._valid, self._sqnorms, new_cap
+        )
+
+    def put(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        doc_ids = np.asarray(doc_ids, np.int32)
+        vectors = np.asarray(vectors, np.float32)
+        if vectors.ndim != 2 or vectors.shape[1] != self.dims:
+            raise ValueError(
+                f"expected vectors [n, {self.dims}], got {vectors.shape}"
+            )
+        if len(doc_ids) == 0:
+            return
+        self.ensure_capacity(int(doc_ids.max()) + 1)
+        vj = jnp.asarray(vectors, self.dtype)
+        if self.normalized:
+            vj = normalize(vj)
+        norms = jnp.sum(vj.astype(jnp.float32) ** 2, axis=-1)
+        # count newly-live ids before the scatter
+        prev_valid = np.asarray(self._valid[jnp.asarray(doc_ids)]) if self._live else None
+        self._corpus, self._valid, self._sqnorms = _scatter(
+            self._corpus, self._valid, self._sqnorms, jnp.asarray(doc_ids), vj, norms
+        )
+        newly = len(doc_ids) if prev_valid is None else int((~prev_valid).sum())
+        self._live += newly
+        self._watermark = max(self._watermark, int(doc_ids.max()) + 1)
+
+    def delete(self, doc_ids: np.ndarray) -> None:
+        doc_ids = np.asarray(doc_ids, np.int32)
+        if len(doc_ids) == 0:
+            return
+        doc_ids = doc_ids[doc_ids < self.capacity]
+        was = np.asarray(self._valid[jnp.asarray(doc_ids)])
+        self._valid = _mask_off(self._valid, jnp.asarray(doc_ids))
+        self._live -= int(was.sum())
+
+    def get(self, doc_ids: np.ndarray) -> np.ndarray:
+        """Host gather (debug/rescore path)."""
+        return np.asarray(self._corpus[jnp.asarray(np.asarray(doc_ids, np.int32))])
+
+    def contains(self, doc_id: int) -> bool:
+        if doc_id >= self.capacity:
+            return False
+        return bool(self._valid[doc_id])
+
+
+def _round_up(n: int, page: int = _PAGE) -> int:
+    return ((n + page - 1) // page) * page

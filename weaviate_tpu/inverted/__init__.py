@@ -1,0 +1,5 @@
+from weaviate_tpu.inverted.index import InvertedIndex
+from weaviate_tpu.inverted.analyzer import tokenize
+from weaviate_tpu.inverted.filters import Filter, Where
+
+__all__ = ["InvertedIndex", "tokenize", "Filter", "Where"]

@@ -1,0 +1,53 @@
+"""Text analysis: tokenizers + stopwords.
+
+Reference: ``adapters/repos/db/inverted/analyzer.go`` + ``entities/tokenizer``
+(word / lowercase / whitespace / field / trigram) and
+``inverted/stopwords/`` (preset "en").
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+_WORD_RE = re.compile(r"[^0-9A-Za-z_]+")
+
+# The reference's en preset (inverted/stopwords/presets.go) — the classic
+# snowball-ish list.
+STOPWORDS_EN = frozenset(
+    """a an and are as at be but by for if in into is it no not of on or such
+    that the their then there these they this to was will with""".split()
+)
+
+
+def tokenize(text: str, scheme: str = "word") -> list[str]:
+    if text is None:
+        return []
+    if not isinstance(text, str):
+        text = str(text)
+    if scheme == "word":
+        return [t.lower() for t in _WORD_RE.split(text) if t]
+    if scheme == "lowercase":
+        return [t.lower() for t in text.split()]
+    if scheme == "whitespace":
+        return [t for t in text.split()]
+    if scheme == "field":
+        t = text.strip()
+        return [t] if t else []
+    if scheme == "trigram":
+        s = "".join(c.lower() for c in text if c.isalnum())
+        if len(s) < 3:
+            return [s] if s else []
+        return [s[i : i + 3] for i in range(len(s) - 2)]
+    raise ValueError(f"unknown tokenization {scheme!r}")
+
+
+def term_frequencies(
+    text: str, scheme: str = "word", stopwords: frozenset[str] = frozenset()
+) -> Counter:
+    toks = [t for t in tokenize(text, scheme) if t not in stopwords]
+    return Counter(toks)
+
+
+def stopword_set(preset: str) -> frozenset[str]:
+    return STOPWORDS_EN if preset == "en" else frozenset()

@@ -1,0 +1,149 @@
+"""Filter AST + evaluation to allow-list masks.
+
+Reference: ``entities/filters`` (the Where tree) evaluated by
+``inverted/searcher.go`` into roaring-bitmap AllowLists
+(``helpers/allow_list.go``). Our allow-list is a dense bool numpy array over
+the shard's doc-id space — the same thing the TPU masked-matmul kernel
+consumes directly as ``allow_mask`` (SURVEY.md §7: ACORN analogue = masked
+matmul).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+OPERATORS = (
+    "And",
+    "Or",
+    "Not",
+    "Equal",
+    "NotEqual",
+    "GreaterThan",
+    "GreaterThanEqual",
+    "LessThan",
+    "LessThanEqual",
+    "Like",
+    "ContainsAny",
+    "ContainsAll",
+    "IsNull",
+    "WithinGeoRange",
+)
+
+
+@dataclass
+class Filter:
+    operator: str
+    path: Optional[list[str]] = None  # property path (nested refs later)
+    value: Any = None
+    operands: list["Filter"] = field(default_factory=list)
+
+    def validate(self) -> None:
+        if self.operator not in OPERATORS:
+            raise ValueError(f"unknown operator {self.operator!r}")
+        if self.operator in ("And", "Or"):
+            if not self.operands:
+                raise ValueError(f"{self.operator} requires operands")
+            for o in self.operands:
+                o.validate()
+        elif self.operator == "Not":
+            if len(self.operands) != 1:
+                raise ValueError("Not requires exactly one operand")
+            self.operands[0].validate()
+        else:
+            if not self.path:
+                raise ValueError(f"{self.operator} requires a property path")
+
+    def to_dict(self) -> dict:
+        d: dict = {"operator": self.operator}
+        if self.path:
+            d["path"] = self.path
+        if self.value is not None:
+            d["value"] = self.value
+        if self.operands:
+            d["operands"] = [o.to_dict() for o in self.operands]
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Filter":
+        return Filter(
+            operator=d["operator"],
+            path=d.get("path"),
+            value=d.get("value"),
+            operands=[Filter.from_dict(o) for o in d.get("operands", [])],
+        )
+
+
+class Where:
+    """Convenience builders: ``Where.eq("p", v) & Where.gt("n", 3)``."""
+
+    @staticmethod
+    def eq(prop: str, value) -> Filter:
+        return Filter("Equal", [prop], value)
+
+    @staticmethod
+    def neq(prop: str, value) -> Filter:
+        return Filter("NotEqual", [prop], value)
+
+    @staticmethod
+    def gt(prop: str, value) -> Filter:
+        return Filter("GreaterThan", [prop], value)
+
+    @staticmethod
+    def gte(prop: str, value) -> Filter:
+        return Filter("GreaterThanEqual", [prop], value)
+
+    @staticmethod
+    def lt(prop: str, value) -> Filter:
+        return Filter("LessThan", [prop], value)
+
+    @staticmethod
+    def lte(prop: str, value) -> Filter:
+        return Filter("LessThanEqual", [prop], value)
+
+    @staticmethod
+    def like(prop: str, pattern: str) -> Filter:
+        return Filter("Like", [prop], pattern)
+
+    @staticmethod
+    def contains_any(prop: str, values: list) -> Filter:
+        return Filter("ContainsAny", [prop], values)
+
+    @staticmethod
+    def contains_all(prop: str, values: list) -> Filter:
+        return Filter("ContainsAll", [prop], values)
+
+    @staticmethod
+    def is_null(prop: str, value: bool = True) -> Filter:
+        return Filter("IsNull", [prop], value)
+
+    @staticmethod
+    def and_(*ops: Filter) -> Filter:
+        return Filter("And", operands=list(ops))
+
+    @staticmethod
+    def or_(*ops: Filter) -> Filter:
+        return Filter("Or", operands=list(ops))
+
+    @staticmethod
+    def not_(op: Filter) -> Filter:
+        return Filter("Not", operands=[op])
+
+
+def like_to_regex(pattern: str) -> re.Pattern:
+    """Reference Like semantics: ``*`` = any chars, ``?`` = one char.
+
+    Everything else is literal (no character classes — unlike fnmatch).
+    """
+    out = []
+    for ch in pattern:
+        if ch == "*":
+            out.append(".*")
+        elif ch == "?":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return re.compile("".join(out) + r"\Z")

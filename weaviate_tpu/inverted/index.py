@@ -1,0 +1,289 @@
+"""Inverted index: BM25 keyword search + filterable property index.
+
+Reference: ``adapters/repos/db/inverted`` — doc indexing (``objects.go``),
+BM25/BM25F scoring (``bm25_searcher.go:46``), filter evaluation
+(``searcher.go`` → AllowList bitmaps). The reference stores postings in LSMKV
+map/roaringset buckets and scores with WAND/BlockMax-WAND; we hold postings as
+numpy-friendly dicts, score with dense vectorized accumulation over the
+candidate doc space (exact, not pruned), and rebuild from the object store on
+startup (the store is the WAL).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Any, Optional
+
+import numpy as np
+
+from weaviate_tpu.inverted.analyzer import stopword_set, term_frequencies, tokenize
+from weaviate_tpu.inverted.filters import Filter, like_to_regex
+from weaviate_tpu.schema.config import CollectionConfig, DataType
+from weaviate_tpu.storage.objects import StorageObject
+
+_TEXT_TYPES = (DataType.TEXT, DataType.TEXT_ARRAY)
+
+
+class InvertedIndex:
+    def __init__(self, config: CollectionConfig, store=None):
+        self.config = config
+        self.k1 = config.inverted_config.bm25_k1
+        self.b = config.inverted_config.bm25_b
+        self.stopwords = stopword_set(config.inverted_config.stopwords_preset)
+        # postings[prop][term] -> {doc_id: tf}
+        self.postings: dict[str, dict[str, dict[int, int]]] = defaultdict(
+            lambda: defaultdict(dict)
+        )
+        # doc_lengths[prop] -> {doc_id: n_tokens}
+        self.doc_lengths: dict[str, dict[int, int]] = defaultdict(dict)
+        # filter values: prop -> {doc_id: value} (scalar or list)
+        self.values: dict[str, dict[int, Any]] = defaultdict(dict)
+        self.doc_count = 0
+
+    # -- schema helpers ---------------------------------------------------
+    def _prop_schema(self, name: str):
+        return self.config.property(name)
+
+    def _searchable(self, name: str) -> bool:
+        p = self._prop_schema(name)
+        return p is not None and p.index_searchable and p.data_type in _TEXT_TYPES
+
+    def _filterable(self, name: str) -> bool:
+        p = self._prop_schema(name)
+        # auto-schema-less props are filterable by default, like the reference
+        return p is None or p.index_filterable
+
+    def _tokenization(self, name: str) -> str:
+        p = self._prop_schema(name)
+        return p.tokenization.value if p is not None else "word"
+
+    # -- write ------------------------------------------------------------
+    def add_object(self, obj: StorageObject) -> None:
+        doc_id = obj.doc_id
+        self.doc_count += 1
+        for prop, val in obj.properties.items():
+            if val is None:
+                continue
+            if self._filterable(prop):
+                self.values[prop][doc_id] = val
+            if isinstance(val, str) or (
+                isinstance(val, list) and val and isinstance(val[0], str)
+            ):
+                if self._searchable(prop) or self._prop_schema(prop) is None:
+                    texts = val if isinstance(val, list) else [val]
+                    scheme = self._tokenization(prop)
+                    total = 0
+                    for t in texts:
+                        tf = term_frequencies(t, scheme, self.stopwords)
+                        total += sum(tf.values())
+                        for term, n in tf.items():
+                            self.postings[prop][term][doc_id] = (
+                                self.postings[prop][term].get(doc_id, 0) + n
+                            )
+                    self.doc_lengths[prop][doc_id] = total
+
+    def delete_object(self, obj: StorageObject) -> None:
+        doc_id = obj.doc_id
+        self.doc_count = max(0, self.doc_count - 1)
+        for prop, val in obj.properties.items():
+            self.values.get(prop, {}).pop(doc_id, None)
+            lengths = self.doc_lengths.get(prop)
+            if lengths is not None:
+                lengths.pop(doc_id, None)
+            if isinstance(val, str) or (
+                isinstance(val, list) and val and isinstance(val[0], str)
+            ):
+                texts = val if isinstance(val, list) else [val]
+                scheme = self._tokenization(prop)
+                for t in texts:
+                    for term in set(tokenize(t, scheme)):
+                        plist = self.postings.get(prop, {}).get(term)
+                        if plist is not None:
+                            plist.pop(doc_id, None)
+
+    # -- BM25 -------------------------------------------------------------
+    def bm25_search(
+        self,
+        query: str,
+        k: int,
+        properties: Optional[list[str]] = None,
+        allow_list: Optional[np.ndarray] = None,
+        doc_space: int = 0,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """BM25F over the given (optionally boosted ``prop^2``) properties.
+
+        Returns (doc_ids [<=k], scores [<=k]) sorted by descending score.
+        """
+        if properties is None or not properties:
+            properties = [
+                p.name for p in self.config.properties if self._searchable(p.name)
+            ] or list(self.postings.keys())
+        # parse "prop^boost"
+        props: list[tuple[str, float]] = []
+        for p in properties:
+            if "^" in p:
+                name, boost = p.split("^", 1)
+                props.append((name, float(boost)))
+            else:
+                props.append((p, 1.0))
+
+        n_docs = max(1, self.doc_count)
+        space = max(
+            doc_space,
+            1 + max(
+                (max(pl) for prop, _ in props for pl in self.postings.get(prop, {}).values() if pl),
+                default=0,
+            ),
+        )
+        scores = np.zeros(space, np.float32)
+        touched = np.zeros(space, bool)
+
+        for prop, boost in props:
+            prop_postings = self.postings.get(prop)
+            if not prop_postings:
+                continue
+            lengths = self.doc_lengths.get(prop, {})
+            avg_len = (sum(lengths.values()) / len(lengths)) if lengths else 1.0
+            terms = [
+                t
+                for t in tokenize(query, self._tokenization(prop))
+                if t not in self.stopwords
+            ]
+            for term in set(terms):
+                plist = prop_postings.get(term)
+                if not plist:
+                    continue
+                df = len(plist)
+                idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+                ids = np.fromiter(plist.keys(), np.int64, len(plist))
+                tfs = np.fromiter(plist.values(), np.float32, len(plist))
+                dls = np.asarray([lengths.get(int(i), 0) for i in ids], np.float32)
+                denom = tfs + self.k1 * (1 - self.b + self.b * dls / max(avg_len, 1e-9))
+                term_scores = idf * tfs * (self.k1 + 1) / np.maximum(denom, 1e-9)
+                scores[ids] += boost * term_scores
+                touched[ids] = True
+
+        if allow_list is not None:
+            al = np.asarray(allow_list, bool)
+            if al.shape[0] < space:
+                al = np.pad(al, (0, space - al.shape[0]))
+            touched &= al[:space]
+
+        cand = np.nonzero(touched)[0]
+        if len(cand) == 0:
+            return np.empty(0, np.int64), np.empty(0, np.float32)
+        order = np.argsort(-scores[cand], kind="stable")[:k]
+        sel = cand[order]
+        return sel.astype(np.int64), scores[sel]
+
+    # -- filters ----------------------------------------------------------
+    def allow_list(self, flt: Filter, doc_space: int) -> np.ndarray:
+        """Evaluate a filter tree to a dense bool mask over doc ids."""
+        flt.validate()
+        return self._eval(flt, doc_space)
+
+    def _eval(self, flt: Filter, space: int) -> np.ndarray:
+        op = flt.operator
+        if op == "And":
+            m = self._eval(flt.operands[0], space)
+            for o in flt.operands[1:]:
+                m = m & self._eval(o, space)
+            return m
+        if op == "Or":
+            m = self._eval(flt.operands[0], space)
+            for o in flt.operands[1:]:
+                m = m | self._eval(o, space)
+            return m
+        if op == "Not":
+            return ~self._eval(flt.operands[0], space)
+
+        prop = flt.path[-1]
+        vals = self.values.get(prop, {})
+        mask = np.zeros(space, bool)
+
+        if op == "IsNull":
+            has = np.zeros(space, bool)
+            for d in vals:
+                if d < space:
+                    has[d] = True
+            return ~has if flt.value else has
+
+        def each(pred):
+            for d, v in vals.items():
+                if d >= space:
+                    continue
+                if isinstance(v, list):
+                    if any(pred(x) for x in v):
+                        mask[d] = True
+                elif pred(v):
+                    mask[d] = True
+
+        fv = flt.value
+        if op == "Equal":
+            # text props match on tokens too (reference Equal on text uses
+            # the inverted index); exact value match covers the common case
+            each(lambda x: x == fv)
+        elif op == "NotEqual":
+            each(lambda x: x != fv)
+            # docs without the prop don't match NotEqual in the reference
+        elif op == "GreaterThan":
+            each(lambda x: _cmp_ok(x, fv) and x > fv)
+        elif op == "GreaterThanEqual":
+            each(lambda x: _cmp_ok(x, fv) and x >= fv)
+        elif op == "LessThan":
+            each(lambda x: _cmp_ok(x, fv) and x < fv)
+        elif op == "LessThanEqual":
+            each(lambda x: _cmp_ok(x, fv) and x <= fv)
+        elif op == "Like":
+            rx = like_to_regex(str(fv))
+            each(lambda x: isinstance(x, str) and rx.match(x) is not None)
+        elif op == "ContainsAny":
+            wanted = set(fv if isinstance(fv, list) else [fv])
+            each(lambda x: x in wanted)
+        elif op == "ContainsAll":
+            wanted = list(fv if isinstance(fv, list) else [fv])
+            for d, v in vals.items():
+                if d >= space:
+                    continue
+                hay = set(v) if isinstance(v, list) else {v}
+                if all(w in hay for w in wanted):
+                    mask[d] = True
+        elif op == "WithinGeoRange":
+            # value: {"latitude":..,"longitude":..,"distance": meters}
+            lat0 = float(fv["latitude"])
+            lon0 = float(fv["longitude"])
+            maxd = float(fv["distance"])
+            each(
+                lambda x: isinstance(x, dict)
+                and _geo_meters(lat0, lon0, float(x.get("latitude", 0)), float(x.get("longitude", 0)))
+                <= maxd
+            )
+        else:
+            raise ValueError(f"unhandled operator {op!r}")
+        return mask
+
+    def stats(self) -> dict:
+        return {
+            "doc_count": self.doc_count,
+            "searchable_props": sorted(self.postings.keys()),
+            "filterable_props": sorted(self.values.keys()),
+        }
+
+
+def _cmp_ok(x, ref) -> bool:
+    if isinstance(ref, (int, float)) and not isinstance(ref, bool):
+        return isinstance(x, (int, float)) and not isinstance(x, bool)
+    return type(x) is type(ref)
+
+
+def _geo_meters(lat1, lon1, lat2, lon2) -> float:
+    """Haversine (reference ``distancer/geo_spatial.go``)."""
+    import math as m
+
+    r = 6371088.0
+    p1, p2 = m.radians(lat1), m.radians(lat2)
+    dp = m.radians(lat2 - lat1)
+    dl = m.radians(lon2 - lon1)
+    a = m.sin(dp / 2) ** 2 + m.cos(p1) * m.cos(p2) * m.sin(dl / 2) ** 2
+    return 2 * r * m.asin(m.sqrt(a))

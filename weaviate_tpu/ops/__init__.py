@@ -1,0 +1,29 @@
+"""TPU compute kernels: batched distance + top-k over HBM-resident vectors.
+
+This package is the TPU-native replacement for the reference's native tier —
+the 46 hand-written SIMD kernel files under
+``adapters/repos/db/vector/hnsw/distancer/{c,asm}`` (reference
+``distancer/provider.go:14``). Instead of a per-candidate ``Distance(a, b)``
+scalar call, every caller submits *batches*: ``[B, D]`` queries against
+``[N, D]`` corpus blocks, evaluated as MXU matmuls with fused masking and
+``jax.lax.top_k`` selection.
+"""
+
+from weaviate_tpu.ops.distance import (
+    METRICS,
+    pairwise_distance,
+    flat_search,
+    gather_distance,
+    normalize,
+)
+from weaviate_tpu.ops.topk import merge_topk, masked_topk
+
+__all__ = [
+    "METRICS",
+    "pairwise_distance",
+    "flat_search",
+    "gather_distance",
+    "normalize",
+    "merge_topk",
+    "masked_topk",
+]

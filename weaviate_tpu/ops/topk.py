@@ -1,0 +1,52 @@
+"""Top-k selection and streaming merge helpers.
+
+The reference maintains per-query binary heaps on the host
+(``hnsw/priorityqueue``); on TPU, selection is ``jax.lax.top_k`` over score
+blocks plus a fixed-size merge for streaming/chunked evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def merge_topk(
+    vals_a: jnp.ndarray,
+    ids_a: jnp.ndarray,
+    vals_b: jnp.ndarray,
+    ids_b: jnp.ndarray,
+    k: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge two per-query top-k candidate sets (lower value = better).
+
+    vals_*: [B, ka] / [B, kb] distances; ids_*: matching int32 ids.
+    Returns ([B, k], [B, k]).
+    """
+    vals = jnp.concatenate([vals_a, vals_b], axis=1)
+    ids = jnp.concatenate([ids_a, ids_b], axis=1)
+    neg, sel = jax.lax.top_k(-vals, k)
+    return -neg, jnp.take_along_axis(ids, sel, axis=1)
+
+
+def masked_topk(
+    dists: jnp.ndarray,
+    k: int,
+    mask: Optional[jnp.ndarray] = None,
+    mask_value: float = 1e30,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k smallest distances with an optional boolean keep-mask.
+
+    dists: [B, N]; mask: [N] or [B, N] (True = eligible).
+    Returns (dists [B, k], ids [B, k]) with ineligible slots id=-1.
+    """
+    if mask is not None:
+        if mask.ndim == 1:
+            mask = mask[None, :]
+        dists = jnp.where(mask, dists, mask_value)
+    neg, ids = jax.lax.top_k(-dists, k)
+    vals = -neg
+    ids = jnp.where(vals >= mask_value, -1, ids.astype(jnp.int32))
+    return vals, ids

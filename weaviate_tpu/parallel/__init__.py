@@ -1,0 +1,14 @@
+from weaviate_tpu.parallel.mesh import make_mesh, SHARD_AXIS
+from weaviate_tpu.parallel.sharded_search import (
+    sharded_flat_search,
+    distributed_step,
+    shard_corpus,
+)
+
+__all__ = [
+    "make_mesh",
+    "SHARD_AXIS",
+    "sharded_flat_search",
+    "distributed_step",
+    "shard_corpus",
+]

@@ -1,0 +1,29 @@
+"""Device mesh construction.
+
+The reference scales reads by fanning out per-shard goroutines across nodes
+(``index.go:1928``) over HTTP. The TPU-native equivalent is a
+``jax.sharding.Mesh`` over ICI: shards are corpus partitions laid out along a
+single ``shard`` mesh axis; collectives (all_gather of per-device top-k)
+replace the clusterapi scatter-gather.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = SHARD_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))

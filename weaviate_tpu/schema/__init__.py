@@ -1,0 +1,29 @@
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    Property,
+    DataType,
+    VectorIndexConfig,
+    FlatIndexConfig,
+    HNSWIndexConfig,
+    DynamicIndexConfig,
+    QuantizerConfig,
+    PQConfig,
+    SQConfig,
+    BQConfig,
+    RQConfig,
+)
+
+__all__ = [
+    "CollectionConfig",
+    "Property",
+    "DataType",
+    "VectorIndexConfig",
+    "FlatIndexConfig",
+    "HNSWIndexConfig",
+    "DynamicIndexConfig",
+    "QuantizerConfig",
+    "PQConfig",
+    "SQConfig",
+    "BQConfig",
+    "RQConfig",
+]

@@ -1,0 +1,346 @@
+"""Schema + vector-index configuration entities.
+
+Mirrors the reference's ``entities/schema`` (class/property models) and
+``entities/vectorindex/{hnsw,flat,dynamic}/config.go`` (index config structs
+with validation + defaults). Everything is a plain dataclass serializable to
+JSON so the schema store (and later the Raft-style FSM) can persist it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class DataType(str, enum.Enum):
+    """Property data types (reference ``entities/schema/data_types.go``)."""
+
+    TEXT = "text"
+    TEXT_ARRAY = "text[]"
+    INT = "int"
+    INT_ARRAY = "int[]"
+    NUMBER = "number"
+    NUMBER_ARRAY = "number[]"
+    BOOL = "boolean"
+    BOOL_ARRAY = "boolean[]"
+    DATE = "date"
+    DATE_ARRAY = "date[]"
+    UUID = "uuid"
+    UUID_ARRAY = "uuid[]"
+    GEO = "geoCoordinates"
+    BLOB = "blob"
+    OBJECT = "object"
+    OBJECT_ARRAY = "object[]"
+    REFERENCE = "cref"
+
+
+class Tokenization(str, enum.Enum):
+    """Text tokenization schemes (reference ``entities/models/property.go``)."""
+
+    WORD = "word"
+    LOWERCASE = "lowercase"
+    WHITESPACE = "whitespace"
+    FIELD = "field"
+    TRIGRAM = "trigram"
+
+
+@dataclass
+class Property:
+    name: str
+    data_type: DataType = DataType.TEXT
+    tokenization: Tokenization = Tokenization.WORD
+    index_filterable: bool = True
+    index_searchable: bool = True
+    index_range_filters: bool = False
+    description: str = ""
+    nested: list["Property"] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["data_type"] = self.data_type.value
+        d["tokenization"] = self.tokenization.value
+        d["nested"] = [p.to_dict() if isinstance(p, Property) else p for p in self.nested]
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Property":
+        d = dict(d)
+        d["data_type"] = DataType(d.get("data_type", "text"))
+        d["tokenization"] = Tokenization(d.get("tokenization", "word"))
+        d["nested"] = [Property.from_dict(p) for p in d.get("nested", [])]
+        return Property(**d)
+
+
+# ---------------------------------------------------------------------------
+# Quantizer configs (reference entities/vectorindex/hnsw/config.go PQConfig etc.)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class QuantizerConfig:
+    enabled: bool = False
+    kind: str = "none"  # pq | sq | bq | rq
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class PQConfig(QuantizerConfig):
+    """Product quantization (reference ``compressionhelpers/product_quantization.go:155``)."""
+
+    kind: str = "pq"
+    enabled: bool = True
+    segments: int = 0  # 0 = auto (D/4, like the reference default)
+    centroids: int = 256
+    training_limit: int = 100_000
+    encoder: str = "kmeans"  # kmeans | tile
+
+
+@dataclass
+class SQConfig(QuantizerConfig):
+    """Scalar (byte) quantization (reference ``scalar_quantization.go:28``)."""
+
+    kind: str = "sq"
+    enabled: bool = True
+    training_limit: int = 100_000
+    rescore_limit: int = 20
+
+
+@dataclass
+class BQConfig(QuantizerConfig):
+    """Binary quantization (reference ``binary_quantization.go:18``)."""
+
+    kind: str = "bq"
+    enabled: bool = True
+    rescore_limit: int = 10
+
+
+@dataclass
+class RQConfig(QuantizerConfig):
+    """Rotational 8-bit quantization (reference ``rotational_quantization.go:25``)."""
+
+    kind: str = "rq"
+    enabled: bool = True
+    bits: int = 8
+    rescore_limit: int = 20
+
+
+def quantizer_from_dict(d: Optional[dict]) -> Optional[QuantizerConfig]:
+    if not d or not d.get("enabled"):
+        return None
+    kind = d.get("kind", "none")
+    cls = {"pq": PQConfig, "sq": SQConfig, "bq": BQConfig, "rq": RQConfig}.get(kind)
+    if cls is None:
+        return None
+    fields = {f.name for f in dataclasses.fields(cls)}
+    return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+# ---------------------------------------------------------------------------
+# Vector index configs
+# ---------------------------------------------------------------------------
+
+
+# Index types with a registered implementation (kept in sync with
+# weaviate_tpu.core.shard.build_vector_index).
+AVAILABLE_INDEX_TYPES = ("flat",)
+
+
+@dataclass
+class VectorIndexConfig:
+    """Common knobs for every vector index."""
+
+    index_type: str = "flat"
+    distance: str = "cosine"  # l2-squared | dot | cosine | manhattan | hamming
+    quantizer: Optional[QuantizerConfig] = None
+    # device placement / batching
+    precision: str = "bf16"  # matmul precision on TPU: bf16 | fp32
+    initial_capacity: int = 1024
+    search_chunk_size: int = 131072
+
+    def validate(self) -> None:
+        from weaviate_tpu.ops.distance import METRICS
+
+        if self.index_type not in AVAILABLE_INDEX_TYPES:
+            raise ValueError(
+                f"index type {self.index_type!r} not available; "
+                f"have {AVAILABLE_INDEX_TYPES}"
+            )
+        if self.distance not in METRICS:
+            raise ValueError(f"invalid distance {self.distance!r}")
+        if self.precision not in ("bf16", "fp32"):
+            raise ValueError(f"invalid precision {self.precision!r}")
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if self.quantizer is not None:
+            d["quantizer"] = self.quantizer.to_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> "VectorIndexConfig":
+        if not d:
+            return FlatIndexConfig()
+        d = dict(d)
+        q = quantizer_from_dict(d.pop("quantizer", None))
+        t = d.get("index_type", "flat")
+        cls = {
+            "flat": FlatIndexConfig,
+            "hnsw": HNSWIndexConfig,
+            "dynamic": DynamicIndexConfig,
+        }.get(t, FlatIndexConfig)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        cfg = cls(**{k: v for k, v in d.items() if k in fields})
+        cfg.quantizer = q
+        return cfg
+
+
+@dataclass
+class FlatIndexConfig(VectorIndexConfig):
+    """Brute-force index config (reference ``entities/vectorindex/flat/config.go``).
+
+    On TPU this is the *fast path*, not the fallback: masked matmul + top_k
+    over the HBM-resident corpus.
+    """
+
+    index_type: str = "flat"
+
+
+@dataclass
+class HNSWIndexConfig(VectorIndexConfig):
+    """HNSW config (reference ``entities/vectorindex/hnsw/config.go``)."""
+
+    index_type: str = "hnsw"
+    max_connections: int = 32  # M; layer0 uses 2M like the reference
+    ef_construction: int = 128
+    ef: int = -1  # -1 => dynamic ef from k
+    dynamic_ef_min: int = 100
+    dynamic_ef_max: int = 500
+    dynamic_ef_factor: int = 8
+    flat_search_cutoff: int = 40000
+    cleanup_interval_seconds: int = 300
+    vector_cache_max_objects: int = 1_000_000_000_000
+    # TPU-specific: how many frontier candidates to evaluate per device call
+    frontier_batch: int = 256
+
+
+@dataclass
+class DynamicIndexConfig(VectorIndexConfig):
+    """Flat until threshold, then upgrade to HNSW (reference ``dynamic/index.go``)."""
+
+    index_type: str = "dynamic"
+    threshold: int = 10_000
+    hnsw: Optional[dict] = None  # HNSWIndexConfig dict used after upgrade
+    flat: Optional[dict] = None
+
+
+# ---------------------------------------------------------------------------
+# Collection (class) config
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class InvertedIndexConfig:
+    """BM25 + filter indexing knobs (reference ``entities/models/inverted_index_config.go``)."""
+
+    bm25_k1: float = 1.2
+    bm25_b: float = 0.75
+    stopwords_preset: str = "en"  # en | none
+    index_timestamps: bool = False
+    index_null_state: bool = False
+    index_property_length: bool = False
+
+
+@dataclass
+class MultiTenancyConfig:
+    enabled: bool = False
+    auto_tenant_creation: bool = False
+    auto_tenant_activation: bool = False
+
+
+@dataclass
+class ReplicationConfig:
+    factor: int = 1
+    async_enabled: bool = False
+    deletion_strategy: str = "NoAutomatedResolution"
+
+
+@dataclass
+class ShardingConfig:
+    """Reference ``usecases/sharding/config.go``."""
+
+    desired_count: int = 1
+    virtual_per_physical: int = 128
+    replicas: int = 1
+
+
+@dataclass
+class CollectionConfig:
+    """A collection == reference 'class' (``entities/models/class.go``)."""
+
+    name: str
+    properties: list[Property] = field(default_factory=list)
+    vector_config: VectorIndexConfig = field(default_factory=FlatIndexConfig)
+    # named vectors: name -> VectorIndexConfig (reference target vectors)
+    named_vectors: dict[str, VectorIndexConfig] = field(default_factory=dict)
+    inverted_config: InvertedIndexConfig = field(default_factory=InvertedIndexConfig)
+    multi_tenancy: MultiTenancyConfig = field(default_factory=MultiTenancyConfig)
+    replication: ReplicationConfig = field(default_factory=ReplicationConfig)
+    sharding: ShardingConfig = field(default_factory=ShardingConfig)
+    vectorizer: str = "none"  # module name, e.g. text2vec-hash
+    description: str = ""
+
+    def validate(self) -> None:
+        if not self.name or not self.name[0].isupper():
+            raise ValueError(
+                f"invalid collection name {self.name!r}: must be non-empty and capitalized"
+            )
+        self.vector_config.validate()
+        for cfg in self.named_vectors.values():
+            cfg.validate()
+        seen = set()
+        for p in self.properties:
+            if p.name in seen:
+                raise ValueError(f"duplicate property {p.name!r}")
+            seen.add(p.name)
+
+    def property(self, name: str) -> Optional[Property]:
+        for p in self.properties:
+            if p.name == name:
+                return p
+        return None
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "properties": [p.to_dict() for p in self.properties],
+            "vector_config": self.vector_config.to_dict(),
+            "named_vectors": {k: v.to_dict() for k, v in self.named_vectors.items()},
+            "inverted_config": dataclasses.asdict(self.inverted_config),
+            "multi_tenancy": dataclasses.asdict(self.multi_tenancy),
+            "replication": dataclasses.asdict(self.replication),
+            "sharding": dataclasses.asdict(self.sharding),
+            "vectorizer": self.vectorizer,
+            "description": self.description,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "CollectionConfig":
+        return CollectionConfig(
+            name=d["name"],
+            properties=[Property.from_dict(p) for p in d.get("properties", [])],
+            vector_config=VectorIndexConfig.from_dict(d.get("vector_config")),
+            named_vectors={
+                k: VectorIndexConfig.from_dict(v)
+                for k, v in d.get("named_vectors", {}).items()
+            },
+            inverted_config=InvertedIndexConfig(**d.get("inverted_config", {})),
+            multi_tenancy=MultiTenancyConfig(**d.get("multi_tenancy", {})),
+            replication=ReplicationConfig(**d.get("replication", {})),
+            sharding=ShardingConfig(**d.get("sharding", {})),
+            vectorizer=d.get("vectorizer", "none"),
+            description=d.get("description", ""),
+        )

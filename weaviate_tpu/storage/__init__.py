@@ -1,0 +1,5 @@
+from weaviate_tpu.storage.wal import WAL
+from weaviate_tpu.storage.store import Bucket, Store
+from weaviate_tpu.storage.objects import StorageObject
+
+__all__ = ["WAL", "Bucket", "Store", "StorageObject"]

@@ -1,0 +1,90 @@
+"""Binary object codec.
+
+Reference: ``entities/storobj/storage_object.go:110`` (FromBinary) — a binary
+envelope of header + UUID + vectors (LE float32) + named vectors + msgpack
+properties, with partial-parse fast paths. We keep the same shape: msgpack
+envelope with raw little-endian float32 vector payloads so vectors can be
+extracted without decoding properties (``parse_single_object.go`` analogue).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import msgpack
+import numpy as np
+
+CODEC_VERSION = 1
+
+
+@dataclass
+class StorageObject:
+    uuid: str
+    collection: str
+    properties: dict[str, Any] = field(default_factory=dict)
+    vector: Optional[np.ndarray] = None
+    named_vectors: dict[str, np.ndarray] = field(default_factory=dict)
+    doc_id: int = -1
+    tenant: str = ""
+    creation_time_ms: int = 0
+    update_time_ms: int = 0
+
+    def __post_init__(self):
+        if not self.uuid:
+            self.uuid = str(uuidlib.uuid4())
+        now = int(time.time() * 1000)
+        if not self.creation_time_ms:
+            self.creation_time_ms = now
+        if not self.update_time_ms:
+            self.update_time_ms = now
+
+    def to_bytes(self) -> bytes:
+        env = {
+            "v": CODEC_VERSION,
+            "uuid": self.uuid,
+            "class": self.collection,
+            "doc_id": self.doc_id,
+            "tenant": self.tenant,
+            "created": self.creation_time_ms,
+            "updated": self.update_time_ms,
+            "props": self.properties,
+            "vec": None
+            if self.vector is None
+            else np.asarray(self.vector, np.float32).tobytes(),
+            "nvecs": {
+                k: np.asarray(v, np.float32).tobytes()
+                for k, v in self.named_vectors.items()
+            },
+            "nvec_shapes": {
+                k: list(np.asarray(v).shape) for k, v in self.named_vectors.items()
+            },
+        }
+        return msgpack.packb(env, use_bin_type=True)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "StorageObject":
+        env = msgpack.unpackb(data, raw=False)
+        vec = env.get("vec")
+        nvec_shapes = env.get("nvec_shapes", {})
+        return StorageObject(
+            uuid=env["uuid"],
+            collection=env["class"],
+            properties=env.get("props", {}),
+            vector=None if vec is None else np.frombuffer(vec, np.float32).copy(),
+            named_vectors={
+                k: np.frombuffer(v, np.float32).reshape(nvec_shapes[k]).copy()
+                for k, v in env.get("nvecs", {}).items()
+            },
+            doc_id=env.get("doc_id", -1),
+            tenant=env.get("tenant", ""),
+            creation_time_ms=env.get("created", 0),
+            update_time_ms=env.get("updated", 0),
+        )
+
+    @staticmethod
+    def extract_doc_id(data: bytes) -> int:
+        """Partial parse: doc id only (reference parse_single_object.go)."""
+        return msgpack.unpackb(data, raw=False).get("doc_id", -1)

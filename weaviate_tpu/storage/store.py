@@ -1,0 +1,326 @@
+"""LSM-style buckets: memtable + WAL + immutable sorted segments.
+
+Reference: ``adapters/repos/db/lsmkv`` (``store.go:41``, ``bucket.go:74``,
+``strategies.go:21-27``). A Store is a directory of named Buckets per shard;
+each Bucket has an active memtable guarded by a WAL, and a list of immutable
+segment files compacted in the background.
+
+Strategies implemented:
+- ``replace`` — last write wins (object CRUD), tombstones via None
+- ``set``    — value is a set of byte-strings, merged by union across
+               segments with per-entry add/remove (roaringset analogue)
+- ``map``    — value is a key->bytes mapping merged newest-wins per map-key
+               (postings with payloads)
+
+Segment format: msgpack framed records sorted by key; full key index built on
+open (the reference embeds a disk b-tree — ``segmentindex/``; at our scale an
+in-memory dict of offsets serves the same reads).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Any, Iterator, Optional
+
+import msgpack
+
+from weaviate_tpu.storage.wal import WAL
+
+STRATEGIES = ("replace", "set", "map")
+
+_TOMBSTONE = b"\x00__del__"
+
+
+class Segment:
+    """Immutable sorted segment: records [(key, strategy-payload)]."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._index: dict[bytes, Any] = {}
+        self._load()
+
+    def _load(self) -> None:
+        with open(self.path, "rb") as f:
+            unpacker = msgpack.Unpacker(f, raw=True)
+            for key, val in unpacker:
+                self._index[key] = _decode_val(val)
+
+    def get(self, key: bytes):
+        return self._index.get(key, _MISSING)
+
+    def keys(self):
+        return self._index.keys()
+
+    def items(self):
+        return self._index.items()
+
+    def __len__(self):
+        return len(self._index)
+
+    @staticmethod
+    def write(path: str, items: list[tuple[bytes, Any]]) -> "Segment":
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            for key, val in sorted(items, key=lambda kv: kv[0]):
+                f.write(msgpack.packb((key, _encode_val(val)), use_bin_type=True))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return Segment(path)
+
+
+class _Missing:
+    __slots__ = ()
+
+
+_MISSING = _Missing()
+
+
+def _encode_val(val):
+    # replace: bytes|None ; set: dict[bytes,bool] (True=add False=remove)
+    # map: dict[bytes, bytes|None]
+    return val
+
+
+def _decode_val(val):
+    if isinstance(val, dict):
+        return val
+    return val
+
+
+class Bucket:
+    def __init__(self, dirpath: str, strategy: str = "replace", sync: bool = False,
+                 memtable_max_entries: int = 100_000):
+        if strategy not in STRATEGIES:
+            raise ValueError(f"unknown strategy {strategy!r}")
+        self.dir = dirpath
+        self.strategy = strategy
+        self.memtable_max_entries = memtable_max_entries
+        os.makedirs(dirpath, exist_ok=True)
+        self._lock = threading.RLock()
+        self._mem: dict[bytes, Any] = {}
+        self._segments: list[Segment] = []
+        self._seg_seq = 0
+        self._open(sync)
+
+    def _open(self, sync: bool) -> None:
+        segs = sorted(
+            f for f in os.listdir(self.dir) if f.startswith("segment-") and f.endswith(".db")
+        )
+        for s in segs:
+            self._segments.append(Segment(os.path.join(self.dir, s)))
+            self._seg_seq = max(self._seg_seq, int(s[len("segment-"):-3]) + 1)
+        wal_path = os.path.join(self.dir, "wal.log")
+        for rec in WAL.replay(wal_path):
+            op = msgpack.unpackb(rec, raw=True)
+            self._apply_mem(op[b"k"], op[b"v"])
+        self._wal = WAL(wal_path, sync=sync)
+
+    # -- strategy-aware memtable application ------------------------------
+    def _apply_mem(self, key: bytes, val) -> None:
+        if self.strategy == "replace":
+            self._mem[key] = val  # None == tombstone
+        elif self.strategy == "set":
+            cur = self._mem.setdefault(key, {})
+            cur.update(val)  # val: {member: True/False}
+        else:  # map
+            cur = self._mem.setdefault(key, {})
+            cur.update(val)  # val: {mapkey: bytes|None}
+
+    def _log(self, key: bytes, val) -> None:
+        self._wal.append(msgpack.packb({"k": key, "v": val}, use_bin_type=True))
+
+    # -- public API -------------------------------------------------------
+    def put(self, key: bytes, value: bytes) -> None:
+        if self.strategy != "replace":
+            raise ValueError("put() requires replace strategy")
+        with self._lock:
+            self._log(key, value)
+            self._apply_mem(key, value)
+            self._maybe_flush()
+
+    def delete(self, key: bytes) -> None:
+        if self.strategy != "replace":
+            raise ValueError("delete() requires replace strategy")
+        with self._lock:
+            self._log(key, None)
+            self._apply_mem(key, None)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            if self.strategy == "replace":
+                if key in self._mem:
+                    return self._mem[key]
+                for seg in reversed(self._segments):
+                    v = seg.get(key)
+                    if v is not _MISSING:
+                        return v
+                return None
+            # set/map: merged view
+            merged: dict = {}
+            for seg in self._segments:
+                v = seg.get(key)
+                if v is not _MISSING and v is not None:
+                    merged.update(v)
+            if key in self._mem:
+                merged.update(self._mem[key])
+            return merged
+
+    def set_add(self, key: bytes, members: list[bytes]) -> None:
+        if self.strategy != "set":
+            raise ValueError("set_add() requires set strategy")
+        val = {m: True for m in members}
+        with self._lock:
+            self._log(key, val)
+            self._apply_mem(key, val)
+            self._maybe_flush()
+
+    def set_remove(self, key: bytes, members: list[bytes]) -> None:
+        val = {m: False for m in members}
+        with self._lock:
+            self._log(key, val)
+            self._apply_mem(key, val)
+
+    def set_members(self, key: bytes) -> set[bytes]:
+        merged = self.get(key)
+        return {m for m, present in merged.items() if present}
+
+    def map_put(self, key: bytes, mapkey: bytes, value: bytes) -> None:
+        if self.strategy != "map":
+            raise ValueError("map_put() requires map strategy")
+        with self._lock:
+            self._log(key, {mapkey: value})
+            self._apply_mem(key, {mapkey: value})
+            self._maybe_flush()
+
+    def map_delete(self, key: bytes, mapkey: bytes) -> None:
+        with self._lock:
+            self._log(key, {mapkey: None})
+            self._apply_mem(key, {mapkey: None})
+
+    def map_items(self, key: bytes) -> dict[bytes, bytes]:
+        merged = self.get(key)
+        return {k: v for k, v in merged.items() if v is not None}
+
+    def keys(self) -> Iterator[bytes]:
+        """All live keys, merged across memtable + segments."""
+        with self._lock:
+            seen: set[bytes] = set()
+            dead: set[bytes] = set()
+            if self.strategy == "replace":
+                for k, v in self._mem.items():
+                    (dead if v is None else seen).add(k)
+                for seg in reversed(self._segments):
+                    for k, v in seg.items():
+                        if k in seen or k in dead:
+                            continue
+                        (dead if v is None else seen).add(k)
+            else:
+                for k in self._mem:
+                    seen.add(k)
+                for seg in self._segments:
+                    seen.update(seg.keys())
+            return iter(sorted(seen))
+
+    def items(self) -> Iterator[tuple[bytes, Any]]:
+        for k in self.keys():
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.keys())
+
+    # -- flush / compaction ----------------------------------------------
+    def _maybe_flush(self) -> None:
+        if len(self._mem) >= self.memtable_max_entries:
+            self.flush_memtable()
+
+    def flush_memtable(self) -> None:
+        with self._lock:
+            if not self._mem:
+                return
+            path = os.path.join(self.dir, f"segment-{self._seg_seq:06d}.db")
+            self._seg_seq += 1
+            self._segments.append(Segment.write(path, list(self._mem.items())))
+            self._mem = {}
+            self._wal.close()
+            WAL.delete(self._wal.path)
+            self._wal = WAL(self._wal.path, sync=self._wal.sync)
+
+    def compact(self) -> None:
+        """Full-merge all segments (newest wins / set-union / map-merge),
+        dropping tombstones — reference ``segment_group_compaction.go``."""
+        with self._lock:
+            if len(self._segments) <= 1:
+                return
+            merged: dict[bytes, Any] = {}
+            for seg in self._segments:
+                for k, v in seg.items():
+                    if self.strategy == "replace":
+                        merged[k] = v
+                    else:
+                        cur = merged.setdefault(k, {})
+                        if v:
+                            cur.update(v)
+            if self.strategy == "replace":
+                merged = {k: v for k, v in merged.items() if v is not None}
+            else:
+                merged = {
+                    k: {m: p for m, p in v.items() if p not in (None, False)}
+                    for k, v in merged.items()
+                }
+                merged = {k: v for k, v in merged.items() if v}
+            old = self._segments
+            path = os.path.join(self.dir, f"segment-{self._seg_seq:06d}.db")
+            self._seg_seq += 1
+            new_seg = Segment.write(path, list(merged.items()))
+            self._segments = [new_seg]
+            for seg in old:
+                os.remove(seg.path)
+
+    def flush(self) -> None:
+        self._wal.flush()
+
+    def close(self) -> None:
+        self.flush_memtable()
+        self._wal.close()
+
+    def count(self) -> int:
+        return len(self)
+
+
+class Store:
+    """Named buckets rooted at a shard directory (reference ``store.go:41``)."""
+
+    def __init__(self, dirpath: str, sync: bool = False):
+        self.dir = dirpath
+        self.sync = sync
+        os.makedirs(dirpath, exist_ok=True)
+        self._buckets: dict[str, Bucket] = {}
+        self._lock = threading.Lock()
+
+    def bucket(self, name: str, strategy: str = "replace", **kw) -> Bucket:
+        with self._lock:
+            b = self._buckets.get(name)
+            if b is None:
+                b = Bucket(os.path.join(self.dir, name), strategy, sync=self.sync, **kw)
+                self._buckets[name] = b
+            elif b.strategy != strategy:
+                raise ValueError(
+                    f"bucket {name!r} exists with strategy {b.strategy!r}"
+                )
+            return b
+
+    def close(self) -> None:
+        with self._lock:
+            for b in self._buckets.values():
+                b.close()
+            self._buckets = {}
+
+    def flush_all(self) -> None:
+        with self._lock:
+            for b in self._buckets.values():
+                b.flush_memtable()

@@ -1,0 +1,76 @@
+"""Write-ahead log: length+CRC framed append-only records.
+
+Reference: ``adapters/repos/db/lsmkv/commitlogger.go`` (per-memtable commit
+log) and ``bucket_recover_from_wal.go`` (replay on startup, tolerate a torn
+tail). Records are ``[u32 little-endian length][u32 crc32][payload]``; replay
+stops cleanly at the first truncated or corrupt record, truncating the file
+there — exactly the reference's recovery semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator, Optional
+
+_HDR = struct.Struct("<II")
+
+
+class WAL:
+    def __init__(self, path: str, sync: bool = False):
+        self.path = path
+        self.sync = sync
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "ab")
+
+    def append(self, payload: bytes) -> None:
+        rec = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+        self._f.write(rec)
+        if self.sync:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def size(self) -> int:
+        self._f.flush()
+        return os.path.getsize(self.path)
+
+    @staticmethod
+    def replay(path: str, truncate_corrupt: bool = True) -> Iterator[bytes]:
+        """Yield intact records; on torn/corrupt tail, truncate and stop."""
+        if not os.path.exists(path):
+            return
+        good_end = 0
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        n = len(data)
+        while off + _HDR.size <= n:
+            length, crc = _HDR.unpack_from(data, off)
+            start = off + _HDR.size
+            end = start + length
+            if end > n:
+                break
+            payload = data[start:end]
+            if zlib.crc32(payload) != crc:
+                break
+            yield payload
+            off = end
+            good_end = end
+        if truncate_corrupt and good_end < n:
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+
+    @staticmethod
+    def delete(path: str) -> None:
+        if os.path.exists(path):
+            os.remove(path)
