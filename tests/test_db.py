@@ -273,14 +273,3 @@ def test_like_filter_literal_brackets(tmp_dbdir):
     res = col.filter_search(Where.like("title", "file?x"))
     assert [o.properties["title"] for o in res] == ["file0x"]
     db.close()
-
-
-def test_hnsw_config_rejected_until_implemented(tmp_dbdir):
-    from weaviate_tpu import HNSWIndexConfig
-
-    db = make_db(tmp_dbdir)
-    with pytest.raises(ValueError, match="not available"):
-        db.create_collection(
-            CollectionConfig(name="H", vector_config=HNSWIndexConfig())
-        )
-    db.close()

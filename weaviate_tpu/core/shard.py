@@ -34,20 +34,22 @@ _DOCID = struct.Struct(">q")
 DEFAULT_VECTOR = ""  # unnamed/default target vector
 
 
-def build_vector_index(dims: int, cfg: VectorIndexConfig) -> VectorIndex:
+def build_vector_index(
+    dims: int, cfg: VectorIndexConfig, path: Optional[str] = None
+) -> VectorIndex:
     """Factory mirroring ``shard_init_vector.go`` index selection."""
     if isinstance(cfg, HNSWIndexConfig) or cfg.index_type == "hnsw":
         from weaviate_tpu.index.hnsw import HNSWIndex
 
         if not isinstance(cfg, HNSWIndexConfig):
             cfg = HNSWIndexConfig(**{**cfg.to_dict(), "index_type": "hnsw"})
-        return HNSWIndex(dims, cfg)
+        return HNSWIndex(dims, cfg, path=path)
     if isinstance(cfg, DynamicIndexConfig) or cfg.index_type == "dynamic":
         from weaviate_tpu.index.dynamic import DynamicIndex
 
         if not isinstance(cfg, DynamicIndexConfig):
             cfg = DynamicIndexConfig(**{**cfg.to_dict(), "index_type": "dynamic"})
-        return DynamicIndex(dims, cfg)
+        return DynamicIndex(dims, cfg, path=path)
     from weaviate_tpu.index.flat import FlatIndex
 
     if not isinstance(cfg, FlatIndexConfig):
@@ -127,7 +129,10 @@ class Shard:
     def _index_for(self, target: str, dims: int) -> VectorIndex:
         idx = self._vector_indexes.get(target)
         if idx is None:
-            idx = build_vector_index(dims, self._config_for(target))
+            # 'vector__' + target: the double underscore keeps the unnamed
+            # default ('vector__') from colliding with a vector named 'default'
+            path = os.path.join(self.dir, f"vector__{target}")
+            idx = build_vector_index(dims, self._config_for(target), path=path)
             self._vector_indexes[target] = idx
             self._dims[target] = dims
             self._persist_meta()

@@ -1,5 +1,14 @@
 from weaviate_tpu.index.base import VectorIndex, SearchResult
 from weaviate_tpu.index.flat import FlatIndex
 from weaviate_tpu.index.store import DeviceVectorStore
+from weaviate_tpu.index.hnsw import HNSWIndex
+from weaviate_tpu.index.dynamic import DynamicIndex
 
-__all__ = ["VectorIndex", "SearchResult", "FlatIndex", "DeviceVectorStore"]
+__all__ = [
+    "VectorIndex",
+    "SearchResult",
+    "FlatIndex",
+    "HNSWIndex",
+    "DynamicIndex",
+    "DeviceVectorStore",
+]

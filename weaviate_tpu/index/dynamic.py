@@ -1,0 +1,97 @@
+"""Dynamic index: flat until a size threshold, then upgrade to HNSW.
+
+Reference: ``adapters/repos/db/vector/dynamic/index.go`` (bbolt-tracked
+upgrade). On TPU the flat index stays competitive far longer than on CPU
+(the scan is one matmul), so the default threshold is higher than the
+reference's 10k; the upgrade rebuilds the graph from the flat store's
+device-resident vectors without leaving HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from weaviate_tpu.index.base import SearchResult, VectorIndex
+from weaviate_tpu.index.flat import FlatIndex
+from weaviate_tpu.index.hnsw import HNSWIndex
+from weaviate_tpu.schema.config import (
+    DynamicIndexConfig,
+    FlatIndexConfig,
+    HNSWIndexConfig,
+)
+
+
+class DynamicIndex(VectorIndex):
+    def __init__(
+        self,
+        dims: int,
+        config: Optional[DynamicIndexConfig] = None,
+        path: Optional[str] = None,
+    ):
+        self.config = config or DynamicIndexConfig()
+        self.dims = dims
+        self.path = path
+        base = self.config.to_dict()
+        for key in ("index_type", "threshold", "hnsw", "flat"):
+            base.pop(key, None)
+        base.pop("quantizer", None)
+        flat_overrides = self.config.flat or {}
+        self._flat_cfg = FlatIndexConfig(**{**base, **flat_overrides})
+        hnsw_overrides = self.config.hnsw or {}
+        self._hnsw_cfg = HNSWIndexConfig(**{**base, **hnsw_overrides})
+        self._inner: VectorIndex = FlatIndex(dims, self._flat_cfg)
+        self._upgraded = False
+
+    @property
+    def inner(self) -> VectorIndex:
+        return self._inner
+
+    @property
+    def upgraded(self) -> bool:
+        return self._upgraded
+
+    def _maybe_upgrade(self) -> None:
+        if self._upgraded or self._inner.count() < self.config.threshold:
+            return
+        flat: FlatIndex = self._inner  # type: ignore[assignment]
+        # hand over the device store wholesale; rebuild only the graph —
+        # vectors never leave HBM
+        hnsw = HNSWIndex(self.dims, self._hnsw_cfg, path=self.path, store=flat.store)
+        hnsw.index_existing()
+        self._inner = hnsw
+        self._upgraded = True
+
+    # -- VectorIndex ------------------------------------------------------
+    def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        self._inner.add_batch(doc_ids, vectors)
+        self._maybe_upgrade()
+
+    def delete(self, doc_ids: np.ndarray) -> None:
+        self._inner.delete(doc_ids)
+
+    def search(self, queries, k, allow_list=None) -> SearchResult:
+        return self._inner.search(queries, k, allow_list)
+
+    def search_by_distance(self, queries, max_distance, allow_list=None, limit=1024):
+        return self._inner.search_by_distance(queries, max_distance, allow_list, limit)
+
+    def count(self) -> int:
+        return self._inner.count()
+
+    @property
+    def capacity(self) -> int:
+        return self._inner.capacity
+
+    def contains(self, doc_id: int) -> bool:
+        return self._inner.contains(doc_id)
+
+    def flush(self) -> None:
+        self._inner.flush()
+
+    def stats(self) -> dict:
+        s = self._inner.stats()
+        s["type"] = f"dynamic[{s['type']}]"
+        s["upgraded"] = self._upgraded
+        return s

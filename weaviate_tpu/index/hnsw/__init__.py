@@ -1,0 +1,4 @@
+from weaviate_tpu.index.hnsw.hnsw import HNSWIndex
+from weaviate_tpu.index.hnsw.graph import HostGraph
+
+__all__ = ["HNSWIndex", "HostGraph"]

@@ -1,0 +1,659 @@
+"""HNSW with batched TPU distance evaluation.
+
+Reference: ``adapters/repos/db/vector/hnsw`` (``index.go:43``,
+``insert.go:107`` AddBatch, ``search.go:78`` SearchByVector, ``:726`` hot
+loop, ``heuristic.go:23`` neighbor selection, ``delete.go`` tombstones).
+
+TPU-first redesign (SURVEY.md §7 slice 2): the graph and beam control flow
+stay on host, but **every distance evaluation is a batched device call** —
+a whole batch of queries advances through the graph in lockstep, and each
+beam iteration evaluates all queries' neighbor frontiers as one gathered
+``[B, width]`` distance computation (``ops.gather_distance``). The reference
+instead calls a SIMD ``Distance(a, b)`` per candidate inside a scalar loop.
+
+Construction is batched the same way: a sub-batch of inserts runs its
+ef_construction searches in lockstep; the selection heuristic runs for all
+nodes of a level at once — candidate-to-candidate distances come from one
+padded ``[G, C, C]`` einsum (``ops.candidate_pairwise``) and the greedy
+accept loop is vectorized across the G nodes. Intra-batch visibility is
+restored via the batch's own pairwise block; backlink overflow pruning is
+batched per level the same way.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.index.base import SearchResult, VectorIndex
+from weaviate_tpu.index.hnsw.graph import NO_NODE, HostGraph
+from weaviate_tpu.index.store import DeviceVectorStore
+from weaviate_tpu.ops.distance import (
+    candidate_pairwise,
+    flat_search,
+    gather_distance,
+    normalize,
+)
+from weaviate_tpu.schema.config import HNSWIndexConfig
+
+_INF = np.float32(np.inf)
+
+# cap on the [B, capacity] visited scratch (bool bytes)
+_VISITED_BUDGET = 256 << 20
+
+
+def _pow2_pad(n: int) -> int:
+    return 1 << max(3, (n - 1).bit_length())
+
+
+class HNSWIndex(VectorIndex):
+    def __init__(
+        self,
+        dims: int,
+        config: Optional[HNSWIndexConfig] = None,
+        path: Optional[str] = None,
+        store: Optional[DeviceVectorStore] = None,
+    ):
+        self.config = config or HNSWIndexConfig()
+        self.metric = self.config.distance
+        self.path = path
+        # an existing store may be handed over (dynamic-index upgrade keeps
+        # the corpus in HBM and only rebuilds the graph)
+        self.store = store or DeviceVectorStore(
+            dims,
+            capacity=self.config.initial_capacity,
+            normalized=(self.metric == "cosine"),
+        )
+        self.graph = HostGraph(m=self.config.max_connections)
+        self._ml = 1.0 / math.log(max(2, self.config.max_connections))
+        self._level_rng = np.random.default_rng(0x5EED)
+        self._insert_batch = self.config.insert_batch
+        self._visited: Optional[np.ndarray] = None  # [B, cap] scratch
+        # the visited scratch is shared; serialize beam searches (batching,
+        # not thread fan-out, is this index's throughput mechanism)
+        import threading
+
+        self._search_lock = threading.Lock()
+        if path and os.path.exists(self._snapshot_path()):
+            self._load_snapshot()
+
+    # ------------------------------------------------------------------
+    # persistence: condensed-graph snapshot (reference commit_logger.go
+    # writes op deltas + condensor.go compacts; we persist the condensed
+    # form directly — vectors themselves are durable in the object store)
+    # ------------------------------------------------------------------
+    def _snapshot_path(self) -> str:
+        return os.path.join(self.path, "graph.npz")
+
+    def flush(self) -> None:
+        if not self.path:
+            return
+        os.makedirs(self.path, exist_ok=True)
+        tmp = self._snapshot_path() + ".tmp.npz"
+        np.savez_compressed(tmp, **self.graph.to_arrays())
+        os.replace(tmp, self._snapshot_path())
+
+    def _load_snapshot(self) -> None:
+        with np.load(self._snapshot_path()) as z:
+            self.graph = HostGraph.from_arrays({k: z[k] for k in z.files})
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+    def _qdev(self, queries: np.ndarray) -> jnp.ndarray:
+        q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)))
+        if self.metric == "cosine":
+            q = normalize(q)
+        return q
+
+    def _frontier_dists(self, qdev, cand: np.ndarray) -> np.ndarray:
+        """[B, C] candidate ids (-1 pad) -> [B, C] distances (inf for pads)."""
+        clipped = np.maximum(cand, 0)
+        d = np.array(  # np.array: jax buffers are read-only views
+            gather_distance(
+                qdev,
+                self.store.corpus,
+                jnp.asarray(clipped),
+                self.metric,
+                precision=self.config.precision,
+            )
+        )
+        d[cand < 0] = _INF
+        return d
+
+    def _node_dists(self, node_ids: np.ndarray, cand: np.ndarray) -> np.ndarray:
+        """Distances from each node's own vector to its candidates [G, C]."""
+        qdev = jnp.take(self.store.corpus, jnp.asarray(node_ids), axis=0)
+        if self.metric == "cosine":
+            qdev = normalize(qdev)
+        return self._frontier_dists(qdev, cand)
+
+    def _level_for_new(self, n: int) -> np.ndarray:
+        u = self._level_rng.random(n)
+        return np.minimum(
+            (-np.log(np.maximum(u, 1e-12)) * self._ml).astype(np.int16), 30
+        )
+
+    # ------------------------------------------------------------------
+    # batched greedy descent (upper layers, ef=1) — reference search.go:760
+    # ------------------------------------------------------------------
+    def _greedy_step_until_stable(self, qdev, eps: np.ndarray, level: int,
+                                  active: np.ndarray) -> np.ndarray:
+        cur = eps.copy()
+        cur_d = self._frontier_dists(qdev, cur[:, None])[:, 0]
+        live = active.copy()
+        while live.any():
+            nbrs = self.graph.neighbors_batch(level, cur)
+            nbrs[~live] = NO_NODE
+            d = self._frontier_dists(qdev, nbrs)
+            j = np.argmin(d, axis=1)
+            bd = d[np.arange(len(cur)), j]
+            better = bd < cur_d
+            upd = live & better
+            cur[upd] = nbrs[np.arange(len(cur)), j][upd]
+            cur_d[upd] = bd[upd]
+            live = upd
+        return cur
+
+    # ------------------------------------------------------------------
+    # batched beam search at one level — reference searchLayerByVector
+    # (search.go:215); one device call per beam iteration for all queries
+    # ------------------------------------------------------------------
+    def _get_visited(self, b: int) -> np.ndarray:
+        cap = self.graph.capacity
+        if (
+            self._visited is None
+            or self._visited.shape[0] < b
+            or self._visited.shape[1] < cap
+        ):
+            self._visited = np.zeros((b, cap), bool)
+        return self._visited
+
+    def _search_level(
+        self,
+        qdev,
+        eps: np.ndarray,
+        ef: int,
+        level: int,
+        keep_mask: Optional[np.ndarray] = None,
+        keep_k: int = 0,
+    ):
+        """Returns (res_ids [B, ef], res_d [B, ef]) ascending, and — when
+        ``keep_mask`` is given (sweeping filter strategy, search.go:36-41) —
+        (kept_ids [B, keep_k], kept_d [B, keep_k]) best *allowed* nodes seen.
+        """
+        b = qdev.shape[0]
+        rows = np.arange(b)
+        # reusable visited scratch, cleared lazily via the touched log so a
+        # search costs O(touched), not O(capacity) (review finding)
+        visited = self._get_visited(b)
+        touched: list[tuple[np.ndarray, np.ndarray]] = []
+
+        res_ids = np.full((b, ef), NO_NODE, np.int64)
+        res_d = np.full((b, ef), _INF, np.float32)
+        expanded = np.zeros((b, ef), bool)
+
+        d0 = self._frontier_dists(qdev, eps[:, None])[:, 0]
+        res_ids[:, 0] = eps
+        res_d[:, 0] = d0
+        visited[rows, eps] = True
+        touched.append((rows.copy(), eps.astype(np.int64)))
+
+        track_kept = keep_mask is not None and keep_k > 0
+        if track_kept:
+            kept_ids = np.full((b, keep_k), NO_NODE, np.int64)
+            kept_d = np.full((b, keep_k), _INF, np.float32)
+            seed_ok = keep_mask[eps]
+            kept_ids[seed_ok, 0] = eps[seed_ok]
+            kept_d[seed_ok, 0] = d0[seed_ok]
+
+        max_iters = 4 * ef + 64  # safety bound; beam converges well before
+        for _ in range(max_iters):
+            cand_d = np.where(expanded | (res_ids < 0), _INF, res_d)
+            j = np.argmin(cand_d, axis=1)
+            cd = cand_d[rows, j]
+            # stop per query when closest unexpanded is worse than the
+            # current ef-th best (res_d sorted ascending, inf-padded)
+            active = np.isfinite(cd) & (cd <= res_d[:, -1])
+            if not active.any():
+                break
+            expanded[rows[active], j[active]] = True
+            cur = res_ids[rows, j].astype(np.int64)
+            nbrs = self.graph.neighbors_batch(level, cur).astype(np.int64)
+            nbrs[~active] = NO_NODE
+            rr = np.repeat(rows, nbrs.shape[1]).reshape(nbrs.shape)
+            fresh = nbrs >= 0
+            fresh[fresh] = ~visited[rr[fresh], nbrs[fresh]]
+            nbrs = np.where(fresh, nbrs, NO_NODE)
+            sel = nbrs >= 0
+            if sel.any():
+                visited[rr[sel], nbrs[sel]] = True
+                touched.append((rr[sel], nbrs[sel]))
+            nd = self._frontier_dists(qdev, nbrs)
+
+            all_ids = np.concatenate([res_ids, nbrs], axis=1)
+            all_d = np.concatenate([res_d, nd], axis=1)
+            all_exp = np.concatenate(
+                [expanded, np.zeros_like(nbrs, bool)], axis=1
+            )
+            order = np.argsort(all_d, axis=1, kind="stable")[:, :ef]
+            res_ids = np.take_along_axis(all_ids, order, 1)
+            res_d = np.take_along_axis(all_d, order, 1)
+            expanded = np.take_along_axis(all_exp, order, 1)
+
+            if track_kept:
+                ok = (nbrs >= 0) & keep_mask[np.maximum(nbrs, 0)]
+                nd_k = np.where(ok, nd, _INF)
+                ka = np.concatenate([kept_ids, nbrs], axis=1)
+                kd = np.concatenate([kept_d, nd_k], axis=1)
+                korder = np.argsort(kd, axis=1, kind="stable")[:, :keep_k]
+                kept_ids = np.take_along_axis(ka, korder, 1)
+                kept_d = np.take_along_axis(kd, korder, 1)
+
+        for r, n in touched:
+            visited[r, n] = False
+
+        if track_kept:
+            kept_ids[~np.isfinite(kept_d)] = NO_NODE
+            return res_ids, res_d, kept_ids, kept_d
+        return res_ids, res_d
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        doc_ids = np.asarray(doc_ids, np.int64)
+        vectors = np.asarray(vectors, np.float32)
+        if len(doc_ids) == 0:
+            return
+        self.store.put(doc_ids, vectors)
+        self.graph.ensure_capacity(int(doc_ids.max()) + 1)
+        # a re-added tombstoned id is a fresh vector at an old id: drop the
+        # stale node so it re-inserts with edges for the new vector
+        revived = [int(d) for d in doc_ids if int(d) in self.graph.tombstones]
+        for d in revived:
+            self.graph.remove_node_hard(d)
+        # skip ids already present (idempotent rebuild/recovery path)
+        doc_ids = doc_ids[self.graph.levels[doc_ids] < 0]
+        for start in range(0, len(doc_ids), self._insert_batch):
+            self._insert_subbatch(doc_ids[start : start + self._insert_batch])
+
+    def index_existing(self) -> None:
+        """Build the graph over the store's live vectors without touching the
+        corpus (dynamic upgrade path — vectors never leave HBM)."""
+        live = np.nonzero(self.store.host_valid_mask)[0].astype(np.int64)
+        if len(live) == 0:
+            return
+        self.graph.ensure_capacity(int(live.max()) + 1)
+        live = live[self.graph.levels[live] < 0]
+        for start in range(0, len(live), self._insert_batch):
+            self._insert_subbatch(live[start : start + self._insert_batch])
+
+    def _insert_subbatch(self, ids: np.ndarray) -> None:
+        if len(ids) == 0:
+            return
+        levels = self._level_for_new(len(ids))
+        if self.graph.entrypoint == NO_NODE:
+            self.graph.add_node(int(ids[0]), int(levels[0]))
+            ids, levels = ids[1:], levels[1:]
+            if len(ids) == 0:
+                return
+        b = len(ids)
+        qdev = jnp.take(self.store.corpus, jnp.asarray(ids), axis=0)
+        if self.metric == "cosine":
+            qdev = normalize(qdev)
+        eps = np.full(b, self.graph.entrypoint, np.int64)
+        efc = self.config.ef_construction
+        old_max = self.graph.max_level
+        batch_max = max(old_max, int(levels.max()))
+
+        # lockstep layer walk: greedy descent while level > node level,
+        # ef_construction search at levels <= node level. Levels above the
+        # pre-batch max have no existing nodes — link_plan still gets an
+        # entry so same-batch peers connect there (review finding).
+        link_plan: list[tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
+        for level in range(batch_max, -1, -1):
+            search = levels >= level
+            if level <= old_max:
+                descend = ~search
+                if descend.any():
+                    eps[descend] = self._greedy_step_until_stable(
+                        qdev, eps, level, descend
+                    )[descend]
+                if search.any():
+                    sub = np.nonzero(search)[0]
+                    res_ids, res_d = self._search_level(
+                        qdev[jnp.asarray(sub)], eps[sub], efc, level
+                    )
+                    eps[sub] = res_ids[:, 0]
+                    link_plan.append((level, sub, res_ids, res_d))
+            elif search.any():
+                sub = np.nonzero(search)[0]
+                empty = np.empty((len(sub), 0))
+                link_plan.append(
+                    (level, sub, empty.astype(np.int64), empty.astype(np.float32))
+                )
+
+        # register nodes (marks them visible; edges come next)
+        for i, node in enumerate(ids):
+            self.graph.add_node(int(node), int(levels[i]))
+
+        # intra-batch candidates: batch-to-batch pairwise distances restore
+        # visibility between nodes inserted in the same lockstep sub-batch
+        bb = np.array(
+            candidate_pairwise(
+                self.store.corpus,
+                jnp.asarray(ids[None, :]),
+                self.metric,
+                precision=self.config.precision,
+            )
+        )[0]
+
+        for level, sub, res_ids, res_d in link_plan:
+            self._link_level(level, ids, levels, sub, res_ids, res_d, bb)
+
+    def _link_level(self, level, ids, levels, sub, res_ids, res_d, bb) -> None:
+        width = self.graph.width(level)
+        b = len(ids)
+        g = len(sub)
+        peer_ok = levels >= level
+
+        # candidate matrix: search results + same-batch peers at this level
+        cmax = res_ids.shape[1] + b
+        cand = np.full((g, cmax), NO_NODE, np.int64)
+        cd = np.full((g, cmax), _INF, np.float32)
+        cand[:, : res_ids.shape[1]] = res_ids
+        cd[:, : res_d.shape[1]] = res_d
+        for row, i in enumerate(sub):
+            peers = np.nonzero(peer_ok & (np.arange(b) != i))[0]
+            if len(peers):
+                cand[row, res_ids.shape[1] : res_ids.shape[1] + len(peers)] = ids[peers]
+                cd[row, res_ids.shape[1] : res_ids.shape[1] + len(peers)] = bb[i, peers]
+
+        sels = self._select_heuristic_batch(cand, cd, width)
+        backlinks: dict[int, list[int]] = {}
+        for row, i in enumerate(sub):
+            node = int(ids[i])
+            self.graph.set_neighbors(level, node, sels[row])
+            for nbr in sels[row]:
+                backlinks.setdefault(int(nbr), []).append(node)
+
+        # apply backlinks; batch-prune overflowing nodes with the heuristic
+        over_nodes: list[int] = []
+        over_cands: list[np.ndarray] = []
+        for nbr, new in backlinks.items():
+            cur = self.graph.get_neighbors(level, nbr)
+            cur_set = set(int(c) for c in cur)
+            new = [x for x in dict.fromkeys(new) if x not in cur_set]
+            if not new:
+                continue
+            if len(cur) + len(new) <= width:
+                for x in new:
+                    self.graph.append_neighbor(level, nbr, x)
+            else:
+                over_nodes.append(nbr)
+                over_cands.append(
+                    np.unique(np.concatenate([cur, np.asarray(new, np.int32)]))
+                )
+        if over_nodes:
+            go = len(over_nodes)
+            cmax2 = max(len(c) for c in over_cands)
+            cand2 = np.full((go, cmax2), NO_NODE, np.int64)
+            for r, c in enumerate(over_cands):
+                cand2[r, : len(c)] = c
+            cd2 = self._node_dists(np.asarray(over_nodes, np.int64), cand2)
+            sels2 = self._select_heuristic_batch(cand2, cd2, width)
+            for r, node in enumerate(over_nodes):
+                self.graph.set_neighbors(level, node, sels2[r])
+
+    def _select_heuristic_batch(
+        self, cand_ids: np.ndarray, cand_d: np.ndarray, m: int
+    ) -> list[np.ndarray]:
+        """Vectorized greedy diversity heuristic (reference heuristic.go:23):
+        iterate candidates by ascending distance; keep c iff
+        dist(c, q) < dist(c, s) for every already-selected s. One padded
+        [G, C, C] einsum provides all candidate-to-candidate distances.
+        """
+        g, c_in = cand_ids.shape
+        if g == 0 or c_in == 0:
+            return [np.empty(0, np.int32) for _ in range(g)]
+        # sort by distance, cap candidate width (nearest candidates dominate
+        # heuristic selections), pad rows to pow2 to bound jit shape count
+        c_cap = min(c_in, max(3 * m, 96))
+        order = np.argsort(cand_d, axis=1, kind="stable")[:, :c_cap]
+        ids_s = np.take_along_axis(cand_ids, order, 1)
+        d_s = np.take_along_axis(cand_d, order, 1)
+        c_pad = _pow2_pad(c_cap)
+        g_pad = _pow2_pad(g)
+        ids_p = np.full((g_pad, c_pad), 0, np.int64)  # clipped pads
+        d_p = np.full((g_pad, c_pad), _INF, np.float32)
+        ids_p[:g, :c_cap] = np.maximum(ids_s, 0)
+        d_p[:g, :c_cap] = np.where(ids_s >= 0, d_s, _INF)
+
+        pair = np.array(
+            candidate_pairwise(
+                self.store.corpus,
+                jnp.asarray(ids_p),
+                self.metric,
+                precision=self.config.precision,
+            )
+        )
+        rows = np.arange(g_pad)
+        chosen = np.zeros((g_pad, c_pad), bool)
+        min_to_sel = np.full((g_pad, c_pad), _INF, np.float32)
+        for _ in range(m):
+            elig = (d_p < min_to_sel) & ~chosen & np.isfinite(d_p)
+            pick = np.argmin(np.where(elig, d_p, _INF), axis=1)
+            ok = elig[rows, pick]
+            if not ok.any():
+                break
+            okr = rows[ok]
+            chosen[okr, pick[ok]] = True
+            upd = pair[okr, :, pick[ok]]  # dist of every cand to the new pick
+            min_to_sel[okr] = np.minimum(min_to_sel[okr], upd)
+        out = []
+        for r in range(g):
+            sel_cols = np.nonzero(chosen[r])[0]
+            out.append(ids_s[r][sel_cols[sel_cols < c_cap]].astype(np.int32))
+        return out
+
+    # ------------------------------------------------------------------
+    # deletes — tombstone semantics (reference delete.go): deleted nodes
+    # stay traversable (their edges keep the graph connected) but are
+    # excluded from results; cleanup_tombstones() rewires + drops them
+    # (reference tombstone cleanup cycle, maintenance.go)
+    # ------------------------------------------------------------------
+    def delete(self, doc_ids: np.ndarray) -> None:
+        doc_ids = np.asarray(doc_ids, np.int64)
+        self.store.delete(doc_ids)
+        for d in doc_ids:
+            self.graph.add_tombstone(int(d))
+
+    def cleanup_tombstones(self) -> int:
+        """Rewire edges around tombstoned nodes, then drop them.
+
+        For every live node with a dead neighbor, the dead neighbor is
+        replaced by bridging to the dead node's own live neighbors, with the
+        diversity heuristic re-selecting when over width.
+        Returns the number of nodes removed.
+        """
+        dead = self.graph.tombstones
+        if not dead:
+            return 0
+        for level in range(self.graph.max_level, -1, -1):
+            if level == 0:
+                nodes = np.nonzero(self.graph.levels >= 0)[0]
+            else:
+                nodes = np.asarray(list(self.graph.upper.get(level, {})), np.int64)
+            width = self.graph.width(level)
+            rewire_nodes: list[int] = []
+            rewire_cands: list[np.ndarray] = []
+            for node in nodes:
+                node = int(node)
+                if node in dead:
+                    continue
+                nbrs = self.graph.get_neighbors(level, node)
+                dead_mask = np.asarray([int(n) in dead for n in nbrs])
+                if not dead_mask.any():
+                    continue
+                keep = [int(n) for n in nbrs[~dead_mask]]
+                bridge: set[int] = set()
+                for dn in nbrs[dead_mask]:
+                    for x in self.graph.get_neighbors(level, int(dn)):
+                        x = int(x)
+                        if x not in dead and x != node:
+                            bridge.add(x)
+                cand = np.asarray(sorted(set(keep) | bridge), np.int64)
+                if len(cand) <= width:
+                    self.graph.set_neighbors(level, node, cand)
+                else:
+                    rewire_nodes.append(node)
+                    rewire_cands.append(cand)
+            if rewire_nodes:
+                cmax = max(len(c) for c in rewire_cands)
+                cm = np.full((len(rewire_nodes), cmax), -1, np.int64)
+                for r, c in enumerate(rewire_cands):
+                    cm[r, : len(c)] = c
+                cd = self._node_dists(np.asarray(rewire_nodes, np.int64), cm)
+                sels = self._select_heuristic_batch(cm, cd, width)
+                for r, node in enumerate(rewire_nodes):
+                    self.graph.set_neighbors(level, node, sels[r])
+        removed = len(dead)
+        for dn in sorted(dead):
+            self.graph.remove_node_hard(dn)
+        return removed
+
+    # ------------------------------------------------------------------
+    # search
+    # ------------------------------------------------------------------
+    def _dynamic_ef(self, k: int) -> int:
+        ef = self.config.ef
+        if ef > 0:
+            return max(ef, k)
+        ef = k * self.config.dynamic_ef_factor
+        ef = min(max(ef, self.config.dynamic_ef_min), self.config.dynamic_ef_max)
+        return max(ef, k)
+
+    def search(
+        self,
+        queries: np.ndarray,
+        k: int,
+        allow_list: Optional[np.ndarray] = None,
+    ) -> SearchResult:
+        queries = np.atleast_2d(np.asarray(queries, np.float32))
+        if queries.shape[-1] != self.store.dims:
+            raise ValueError(
+                f"query dims {queries.shape[-1]} != index dims {self.store.dims}"
+            )
+        b = queries.shape[0]
+        if self.graph.entrypoint == NO_NODE:
+            return SearchResult(
+                ids=np.full((b, k), -1, np.int64),
+                dists=np.full((b, k), _INF, np.float32),
+            )
+
+        # small filter -> brute force over allowed ids only (reference
+        # flat-search cutoff, search.go:85-89 + flat_search.go:28)
+        if allow_list is not None:
+            n_allowed = int(np.asarray(allow_list, bool).sum())
+            if n_allowed <= self.config.flat_search_cutoff or n_allowed <= k:
+                return self._flat_filtered(queries, k, allow_list)
+
+        # visited scratch is [B, capacity]; bound its footprint
+        sub_b = max(8, min(64, _VISITED_BUDGET // max(1, self.graph.capacity)))
+        out_ids = np.full((b, k), -1, np.int64)
+        out_d = np.full((b, k), _INF, np.float32)
+        with self._search_lock:  # shared visited scratch
+            for s in range(0, b, sub_b):
+                e = min(b, s + sub_b)
+                ids, d = self._search_one_batch(queries[s:e], k, allow_list)
+                out_ids[s:e], out_d[s:e] = ids, d
+        return SearchResult(ids=out_ids, dists=out_d)
+
+    def _keep_mask(self, allow_list: Optional[np.ndarray]) -> np.ndarray:
+        cap = self.graph.capacity
+        valid = self.store.host_valid_mask
+        if len(valid) < cap:
+            valid = np.pad(valid, (0, cap - len(valid)))
+        keep = valid[:cap] & (self.graph.levels >= 0)
+        if allow_list is not None:
+            al = np.asarray(allow_list, bool)
+            if len(al) < cap:
+                al = np.pad(al, (0, cap - len(al)))
+            keep &= al[:cap]
+        return keep
+
+    def _search_one_batch(self, queries, k, allow_list):
+        b = queries.shape[0]
+        qdev = self._qdev(queries)
+        ef = self._dynamic_ef(k)
+        eps = np.full(b, self.graph.entrypoint, np.int64)
+        all_active = np.ones(b, bool)
+        for level in range(self.graph.max_level, 0, -1):
+            eps = self._greedy_step_until_stable(qdev, eps, level, all_active)
+        keep = self._keep_mask(allow_list)
+        _, _, kept_ids, kept_d = self._search_level(
+            qdev, eps, ef, 0, keep_mask=keep, keep_k=max(k, min(ef, 2 * k))
+        )
+        return kept_ids[:, :k], kept_d[:, :k]
+
+    def _flat_filtered(self, queries, k, allow_list):
+        qdev = self._qdev(queries)
+        cap = self.store.capacity
+        al = np.asarray(allow_list, bool)
+        if len(al) < cap:
+            al = np.pad(al, (0, cap - len(al)))
+        d, ids = flat_search(
+            qdev,
+            self.store.corpus,
+            k=k,
+            metric=self.metric,
+            valid_mask=self.store.valid_mask,
+            allow_mask=jnp.asarray(al[:cap]),
+            corpus_sqnorms=self.store.sqnorms if self.metric == "l2-squared" else None,
+            precision=self.config.precision,
+        )
+        d = np.array(d)
+        ids = np.asarray(ids, np.int64)
+        d[ids < 0] = _INF
+        return SearchResult(ids=ids, dists=d)
+
+    def search_by_distance(
+        self,
+        queries: np.ndarray,
+        max_distance: float,
+        allow_list: Optional[np.ndarray] = None,
+        limit: int = 1024,
+    ) -> SearchResult:
+        k = min(limit, max(1, self.count()))
+        res = self.search(queries, k, allow_list)
+        keep = res.dists <= max_distance
+        return SearchResult(
+            ids=np.where(keep, res.ids, -1),
+            dists=np.where(keep, res.dists, _INF),
+        )
+
+    # ------------------------------------------------------------------
+    def count(self) -> int:
+        return self.graph.node_count
+
+    @property
+    def capacity(self) -> int:
+        return self.store.capacity
+
+    def contains(self, doc_id: int) -> bool:
+        return self.graph.contains(doc_id) and self.store.contains(doc_id)
+
+    def stats(self) -> dict:
+        return {
+            "type": "hnsw",
+            "count": self.count(),
+            "capacity": self.capacity,
+            "metric": self.metric,
+            "max_level": self.graph.max_level,
+            "entrypoint": self.graph.entrypoint,
+        }

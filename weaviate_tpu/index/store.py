@@ -62,6 +62,7 @@ class DeviceVectorStore:
         self._corpus = jnp.zeros((cap, dims), dtype)
         self._valid = jnp.zeros((cap,), jnp.bool_)
         self._sqnorms = jnp.zeros((cap,), jnp.float32)
+        self._host_valid = np.zeros((cap,), bool)  # host mirror of _valid
         self._watermark = 0  # max assigned id + 1
         self._live = 0
 
@@ -87,6 +88,11 @@ class DeviceVectorStore:
         return self._valid
 
     @property
+    def host_valid_mask(self) -> np.ndarray:
+        """Incrementally-maintained host copy (no device transfer)."""
+        return self._host_valid
+
+    @property
     def sqnorms(self) -> jnp.ndarray:
         return self._sqnorms
 
@@ -98,6 +104,9 @@ class DeviceVectorStore:
         self._corpus, self._valid, self._sqnorms = _grow(
             self._corpus, self._valid, self._sqnorms, new_cap
         )
+        hv = np.zeros((new_cap,), bool)
+        hv[: len(self._host_valid)] = self._host_valid
+        self._host_valid = hv
 
     def put(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
         doc_ids = np.asarray(doc_ids, np.int32)
@@ -113,13 +122,12 @@ class DeviceVectorStore:
         if self.normalized:
             vj = normalize(vj)
         norms = jnp.sum(vj.astype(jnp.float32) ** 2, axis=-1)
-        # count newly-live ids before the scatter
-        prev_valid = np.asarray(self._valid[jnp.asarray(doc_ids)]) if self._live else None
+        prev_valid = self._host_valid[doc_ids]
         self._corpus, self._valid, self._sqnorms = _scatter(
             self._corpus, self._valid, self._sqnorms, jnp.asarray(doc_ids), vj, norms
         )
-        newly = len(doc_ids) if prev_valid is None else int((~prev_valid).sum())
-        self._live += newly
+        self._host_valid[doc_ids] = True
+        self._live += int((~prev_valid).sum())
         self._watermark = max(self._watermark, int(doc_ids.max()) + 1)
 
     def delete(self, doc_ids: np.ndarray) -> None:
@@ -127,8 +135,9 @@ class DeviceVectorStore:
         if len(doc_ids) == 0:
             return
         doc_ids = doc_ids[doc_ids < self.capacity]
-        was = np.asarray(self._valid[jnp.asarray(doc_ids)])
+        was = self._host_valid[doc_ids]
         self._valid = _mask_off(self._valid, jnp.asarray(doc_ids))
+        self._host_valid[doc_ids] = False
         self._live -= int(was.sum())
 
     def get(self, doc_ids: np.ndarray) -> np.ndarray:
@@ -138,7 +147,7 @@ class DeviceVectorStore:
     def contains(self, doc_id: int) -> bool:
         if doc_id >= self.capacity:
             return False
-        return bool(self._valid[doc_id])
+        return bool(self._host_valid[doc_id])
 
 
 def _round_up(n: int, page: int = _PAGE) -> int:

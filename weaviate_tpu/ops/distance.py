@@ -135,6 +135,37 @@ def gather_distance(
     raise ValueError(f"unknown metric {metric!r}")
 
 
+@functools.partial(jax.jit, static_argnames=("metric", "precision"))
+def candidate_pairwise(
+    corpus: jnp.ndarray,
+    candidate_ids: jnp.ndarray,
+    metric: str,
+    precision: str = "fp32",
+) -> jnp.ndarray:
+    """Pairwise distances within each candidate set: [B, C] ids -> [B, C, C].
+
+    Drives the batched HNSW neighbor-selection heuristic
+    (reference ``hnsw/heuristic.go:23``): the greedy accept test needs
+    candidate-to-candidate distances, which here are one batched einsum.
+    """
+    v = jnp.take(corpus, candidate_ids, axis=0)  # [B, C, D]
+    vf = v.astype(jnp.bfloat16 if precision == "bf16" else jnp.float32)
+    ip = jnp.einsum("bcd,bed->bce", vf, vf, preferred_element_type=jnp.float32)
+    if metric == "l2-squared":
+        sq = jnp.sum(v.astype(jnp.float32) ** 2, axis=-1)
+        d = sq[:, :, None] - 2.0 * ip + sq[:, None, :]
+        return jnp.maximum(d, 0.0)
+    if metric == "dot":
+        return -ip
+    if metric == "cosine":
+        return 1.0 - ip
+    # manhattan / hamming: no matmul form; direct broadcast
+    diff = v[:, :, None, :].astype(jnp.float32) - v[:, None, :, :].astype(jnp.float32)
+    if metric == "manhattan":
+        return jnp.sum(jnp.abs(diff), axis=-1)
+    return jnp.sum((diff != 0).astype(jnp.float32), axis=-1)
+
+
 @functools.partial(
     jax.jit, static_argnames=("metric", "k", "chunk_size", "precision")
 )

@@ -146,7 +146,7 @@ def quantizer_from_dict(d: Optional[dict]) -> Optional[QuantizerConfig]:
 
 # Index types with a registered implementation (kept in sync with
 # weaviate_tpu.core.shard.build_vector_index).
-AVAILABLE_INDEX_TYPES = ("flat",)
+AVAILABLE_INDEX_TYPES = ("flat", "hnsw", "dynamic")
 
 
 @dataclass
@@ -225,6 +225,10 @@ class HNSWIndexConfig(VectorIndexConfig):
     vector_cache_max_objects: int = 1_000_000_000_000
     # TPU-specific: how many frontier candidates to evaluate per device call
     frontier_batch: int = 256
+    # lockstep construction batch: larger = fewer device round-trips but
+    # more intra-batch blindness (~0.98 recall @64, ~0.93 @256 on random
+    # data); bulk loads that rebuild can afford 256+
+    insert_batch: int = 64
 
 
 @dataclass
