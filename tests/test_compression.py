@@ -1,0 +1,281 @@
+"""Quantizer tests: kernel exactness + recall gates + index integration.
+
+Mirrors the reference's compressed recall tests
+(``hnsw/compress_recall_test.go``, ``compressionhelpers/*_test.go``): assert
+distance-kernel semantics exactly, then gate recall@k floors on clustered
+data (the realistic embedding regime) with the rescore tier enabled.
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.compression import (
+    BinaryQuantizer,
+    ProductQuantizer,
+    RotationalQuantizer,
+    ScalarQuantizer,
+    segmented_kmeans,
+)
+from weaviate_tpu.index.flat import FlatIndex, make_flat
+from weaviate_tpu.schema.config import (
+    BQConfig,
+    FlatIndexConfig,
+    PQConfig,
+    RQConfig,
+    SQConfig,
+)
+
+
+def clustered(rng, n, d, n_clusters=32, spread=0.15):
+    centers = rng.standard_normal((n_clusters, d)).astype(np.float32)
+    assign = rng.integers(0, n_clusters, size=n)
+    return (centers[assign] + spread * rng.standard_normal((n, d))).astype(
+        np.float32
+    )
+
+
+def exact_topk(queries, corpus, k, metric="l2-squared"):
+    if metric == "l2-squared":
+        d = (
+            (queries**2).sum(1)[:, None]
+            - 2 * queries @ corpus.T
+            + (corpus**2).sum(1)[None, :]
+        )
+    elif metric == "cosine":
+        qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+        cn = corpus / np.linalg.norm(corpus, axis=1, keepdims=True)
+        d = 1 - qn @ cn.T
+    else:
+        raise ValueError(metric)
+    return np.argsort(d, axis=1, kind="stable")[:, :k]
+
+
+def recall_at_k(got_ids, want_ids):
+    hits = 0
+    for g, w in zip(got_ids, want_ids):
+        hits += len(set(g.tolist()) & set(w.tolist()))
+    return hits / want_ids.size
+
+
+# ---------------------------------------------------------------------------
+# kmeans
+# ---------------------------------------------------------------------------
+
+
+def test_segmented_kmeans_reduces_distortion(rng):
+    data = clustered(rng, 512, 16, n_clusters=8)[None, :, :]  # 1 segment
+    cents = segmented_kmeans(data, 8, iters=10)
+    d2 = ((data[0][:, None, :] - cents[0][None, :, :]) ** 2).sum(-1).min(1)
+    # Lloyd's on 8 well-separated clusters should land near the true centers.
+    assert d2.mean() < 0.5
+
+
+# ---------------------------------------------------------------------------
+# quantizer semantics
+# ---------------------------------------------------------------------------
+
+
+def test_bq_hamming_matches_numpy(rng):
+    d = 70  # non-multiple of 32 exercises the pad path
+    v = rng.standard_normal((40, d)).astype(np.float32)
+    q = rng.standard_normal((5, d)).astype(np.float32)
+    bq = BinaryQuantizer(d, "hamming")
+    enc = bq.encode(v)
+
+    from weaviate_tpu.compression import DeviceArraySet
+
+    store = DeviceArraySet(bq.fields())
+    store.put(np.arange(40), enc)
+    dists, ids = bq.search(bq.prep(q), store, 40, store.valid_mask, 0)
+    dists, ids = np.asarray(dists), np.asarray(ids)
+
+    qb = (q > 0).astype(np.uint8)
+    vb = (v > 0).astype(np.uint8)
+    want = (qb[:, None, :] != vb[None, :, :]).sum(-1)
+    for i in range(5):
+        got = {int(a): float(x) for a, x in zip(ids[i], dists[i]) if a >= 0}
+        for j in range(40):
+            assert got[j] == pytest.approx(want[i, j], abs=0.5)
+
+
+def test_sq_roundtrip_error_bounded(rng):
+    d = 32
+    v = rng.standard_normal((300, d)).astype(np.float32)
+    sq = ScalarQuantizer(d, "l2-squared")
+    sq.fit(v)
+    enc = sq.encode(v)
+    dec = sq.a + sq.s * enc["codes"].astype(np.float32)
+    assert np.abs(dec - np.clip(v, sq.a, sq.a + 255 * sq.s)).max() <= sq.s
+
+
+def test_pq_decode_matches_codebooks(rng):
+    d, m = 32, 8
+    v = clustered(rng, 600, d)
+    pq = ProductQuantizer(d, "l2-squared", PQConfig(segments=m))
+    pq.fit(v)
+    enc = pq.encode(v[:10])
+    dec = pq.decode(enc["codes"])
+    assert dec.shape == (10, d)
+    # reconstruction must beat the zero-vector baseline by a wide margin
+    assert ((dec - v[:10]) ** 2).sum() < 0.5 * (v[:10] ** 2).sum()
+
+
+def test_rq_rotation_is_orthogonal():
+    rq = RotationalQuantizer(48, "l2-squared", RQConfig())
+    rq.fit(np.zeros((4, 48), np.float32))
+    r = rq.rotation
+    assert np.allclose(r @ r.T, np.eye(r.shape[0]), atol=1e-4)
+
+
+def test_quantizer_state_roundtrip(rng):
+    d = 32
+    v = clustered(rng, 600, d)
+    for q in (
+        ScalarQuantizer(d, "l2-squared"),
+        ProductQuantizer(d, "l2-squared", PQConfig(segments=8)),
+        RotationalQuantizer(d, "l2-squared", RQConfig()),
+    ):
+        q.fit(v)
+        state = q.state_dict()
+        fresh = type(q)(d, "l2-squared")
+        fresh.load_state_dict(state)
+        e1 = q.encode(v[:5])
+        e2 = fresh.encode(v[:5])
+        for key in e1:
+            np.testing.assert_array_equal(e1[key], e2[key])
+
+
+# ---------------------------------------------------------------------------
+# recall gates (clustered data + rescore, reference compress_recall_test.go)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "qcfg,floor",
+    [
+        (SQConfig(rescore_limit=80), 0.95),
+        (RQConfig(rescore_limit=80), 0.92),
+        (PQConfig(segments=16, rescore_limit=100), 0.80),
+        (BQConfig(rescore_limit=150), 0.60),
+    ],
+    ids=["sq", "rq", "pq", "bq"],
+)
+def test_compressed_recall_floor(rng, qcfg, floor):
+    n, d, k, nq = 3000, 64, 10, 32
+    corpus = clustered(rng, n, d)
+    queries = corpus[rng.choice(n, nq, replace=False)] + 0.02 * rng.standard_normal(
+        (nq, d)
+    ).astype(np.float32)
+    queries = queries.astype(np.float32)
+
+    idx = make_flat(d, FlatIndexConfig(distance="l2-squared", quantizer=qcfg))
+    idx.add_batch(np.arange(n), corpus)
+    assert idx.quantizer.fitted
+    res = idx.search(queries, k)
+    want = exact_topk(queries, corpus, k)
+    r = recall_at_k(res.ids, want)
+    assert r >= floor, f"recall {r:.3f} < floor {floor} for {qcfg.kind}"
+
+
+def test_quantized_flat_prefit_exact(rng):
+    """Below min_training the index answers exactly from host originals."""
+    n, d = 50, 16
+    corpus = rng.standard_normal((n, d)).astype(np.float32)
+    idx = make_flat(d, FlatIndexConfig(distance="l2-squared", quantizer=SQConfig()))
+    idx.add_batch(np.arange(n), corpus)
+    assert not idx.quantizer.fitted
+    res = idx.search(corpus[:5], 1)
+    np.testing.assert_array_equal(res.ids[:, 0], np.arange(5))
+
+
+def test_quantized_flat_delete_and_filter(rng):
+    n, d = 600, 32
+    corpus = clustered(rng, n, d)
+    idx = make_flat(d, FlatIndexConfig(distance="l2-squared", quantizer=SQConfig()))
+    idx.add_batch(np.arange(n), corpus)
+    assert idx.quantizer.fitted
+
+    q = corpus[:4]
+    res = idx.search(q, 1)
+    np.testing.assert_array_equal(res.ids[:, 0], np.arange(4))
+
+    idx.delete(np.arange(4))
+    res = idx.search(q, 1)
+    assert all(res.ids[:, 0] != np.arange(4))
+
+    allow = np.zeros(n, bool)
+    allow[100:110] = True
+    res = idx.search(q, 5, allow_list=allow)
+    valid = res.ids[res.ids >= 0]
+    assert len(valid) and np.all((valid >= 100) & (valid < 110))
+
+
+def test_quantized_flat_cosine(rng):
+    n, d = 600, 32
+    corpus = clustered(rng, n, d)
+    idx = make_flat(d, FlatIndexConfig(distance="cosine", quantizer=RQConfig()))
+    idx.add_batch(np.arange(n), corpus)
+    queries = corpus[:8] * 3.0  # scale-invariance check
+    res = idx.search(queries, 1)
+    np.testing.assert_array_equal(res.ids[:, 0], np.arange(8))
+
+
+def test_make_flat_dispatch():
+    assert isinstance(make_flat(8, FlatIndexConfig()), FlatIndex)
+    qi = make_flat(8, FlatIndexConfig(quantizer=BQConfig()))
+    assert qi.stats()["quantizer"] == "bq"
+
+
+def test_quantized_flat_prefit_pads_to_k(rng):
+    """Pre-fit exact fallback must honor the [B, k] shape contract."""
+    corpus = rng.standard_normal((5, 16)).astype(np.float32)
+    idx = make_flat(16, FlatIndexConfig(distance="l2-squared", quantizer=SQConfig()))
+    idx.add_batch(np.arange(5), corpus)
+    res = idx.search(corpus[:2], 10)
+    assert res.ids.shape == (2, 10)
+    assert (res.ids[:, 5:] == -1).all()
+
+
+def test_quantizer_metric_validation():
+    from weaviate_tpu.compression import build_quantizer
+
+    with pytest.raises(ValueError):
+        build_quantizer(SQConfig(), 16, "manhattan")
+    with pytest.raises(ValueError):
+        build_quantizer(SQConfig(), 16, "hamming")
+    assert build_quantizer(BQConfig(), 16, "hamming") is not None
+
+
+def test_generic_config_with_quantizer_builds_every_index_type():
+    """as_type must preserve the quantizer object (not a flattened dict)."""
+    from weaviate_tpu.core.shard import build_vector_index
+    from weaviate_tpu.schema.config import VectorIndexConfig
+
+    for t in ("flat", "hnsw", "dynamic"):
+        cfg = VectorIndexConfig(
+            index_type=t, distance="l2-squared", quantizer=SQConfig()
+        )
+        idx = build_vector_index(16, cfg)
+        assert idx is not None
+
+
+def test_hnsw_quantized_cosine_rescore_distances(rng):
+    """Rescore must normalize queries: dists are true cosine distances even
+    for scaled queries (regression: un-normalized rescore)."""
+    from weaviate_tpu.index.hnsw import HNSWIndex
+    from weaviate_tpu.schema.config import HNSWIndexConfig
+
+    n, d = 600, 32
+    corpus = clustered(rng, n, d)
+    idx = HNSWIndex(
+        d,
+        HNSWIndexConfig(
+            distance="cosine", quantizer=SQConfig(rescore_limit=60),
+            flat_search_cutoff=0,
+        ),
+    )
+    idx.add_batch(np.arange(n), corpus)
+    res = idx.search(corpus[:4] * 7.5, 1)  # scaled queries
+    np.testing.assert_array_equal(res.ids[:, 0], np.arange(4))
+    # self-distance in cosine is ~0 regardless of query scale
+    assert np.all(res.dists[:, 0] < 1e-2)

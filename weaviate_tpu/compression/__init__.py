@@ -1,0 +1,29 @@
+"""Vector compression: quantizers, code stores, k-means.
+
+TPU-native rebuild of the reference's ``compressionhelpers`` package — see
+``quantizers.py`` for the family and ``ops/quantized.py`` for the kernels.
+"""
+
+from weaviate_tpu.compression.kmeans import assign_codes, segmented_kmeans
+from weaviate_tpu.compression.quantizers import (
+    BinaryQuantizer,
+    ProductQuantizer,
+    Quantizer,
+    RotationalQuantizer,
+    ScalarQuantizer,
+    build_quantizer,
+)
+from weaviate_tpu.compression.store import DeviceArraySet, HostVectorStore
+
+__all__ = [
+    "BinaryQuantizer",
+    "DeviceArraySet",
+    "HostVectorStore",
+    "ProductQuantizer",
+    "Quantizer",
+    "RotationalQuantizer",
+    "ScalarQuantizer",
+    "assign_codes",
+    "build_quantizer",
+    "segmented_kmeans",
+]

@@ -1,0 +1,120 @@
+"""Segmented k-means in JAX — PQ codebook training on the MXU.
+
+Reference: ``adapters/repos/db/vector/kmeans/`` (plain Lloyd's iterations used
+by ``compressionhelpers/kmeans_encoder.go``). The reference trains one k-means
+per PQ segment sequentially on the CPU; here all M segments train in a single
+jitted program: the assignment step is one batched einsum ``[S,n,d]x[S,c,d]``
+(MXU) and the update step is a scatter-add, iterated with ``lax.fori_loop``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def _assign_chunked(data, centroids, chunk: int):
+    """Nearest-centroid assignment. data [S,n,d], centroids [S,c,d] -> [S,n] int32.
+
+    Chunked over n so the [S, chunk, c] distance block stays small enough for
+    HBM at PQ scale (S=96, c=256).
+    """
+    s, n, d = data.shape
+    c = centroids.shape[1]
+    cn = jnp.sum(centroids * centroids, axis=-1)  # [S, c]
+
+    def body(i, out):
+        start = i * chunk
+        block = jax.lax.dynamic_slice_in_dim(data, start, chunk, axis=1)
+        ip = jnp.einsum(
+            "snd,scd->snc", block, centroids, preferred_element_type=jnp.float32
+        )
+        # argmin of ||x-c||^2 == argmin of -2 x.c + ||c||^2
+        d2 = cn[:, None, :] - 2.0 * ip
+        a = jnp.argmin(d2, axis=-1).astype(jnp.int32)
+        return jax.lax.dynamic_update_slice_in_dim(out, a, start, axis=1)
+
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    if n_pad != n:
+        data = jnp.pad(data, ((0, 0), (0, n_pad - n), (0, 0)))
+    out = jnp.zeros((s, n_pad), jnp.int32)
+    out = jax.lax.fori_loop(0, n_pad // chunk, body, out)
+    return out[:, :n]
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "iters"))
+def _lloyd(data, centroids, iters: int, chunk: int):
+    """Lloyd's iterations over all segments at once."""
+    s, n, d = data.shape
+    c = centroids.shape[1]
+    seg_idx = jnp.arange(s, dtype=jnp.int32)[:, None]  # [S, 1] broadcast with [S, n]
+
+    def step(_, cents):
+        assign = _assign_chunked(data, cents, chunk)
+        sums = jnp.zeros((s, c, d), jnp.float32).at[seg_idx, assign].add(data)
+        counts = jnp.zeros((s, c), jnp.float32).at[seg_idx, assign].add(1.0)
+        new = sums / jnp.maximum(counts[..., None], 1.0)
+        # Empty clusters reseed to the points farthest from their assigned
+        # centroid (split-the-worst-fit): i-th empty slot takes the i-th
+        # farthest point. Keeps k effective clusters where plain Lloyd's
+        # random init loses some.
+        own = jnp.take_along_axis(new, assign[..., None], axis=1)  # [S, n, d]
+        resid = jnp.sum((data - own) ** 2, axis=-1)  # [S, n]
+        _, far = jax.lax.top_k(resid, c)  # [S, c] farthest point ids
+        far_pts = jnp.take_along_axis(data, far[..., None], axis=1)  # [S, c, d]
+        empty = counts <= 0
+        rank = jnp.cumsum(empty.astype(jnp.int32), axis=1) - 1  # [S, c]
+        reseed = jnp.take_along_axis(
+            far_pts, jnp.clip(rank, 0, c - 1)[..., None], axis=1
+        )
+        return jnp.where(empty[..., None], reseed, new)
+
+    return jax.lax.fori_loop(0, iters, step, centroids)
+
+
+def segmented_kmeans(
+    data: np.ndarray,
+    n_centroids: int,
+    iters: int = 10,
+    seed: int = 0,
+    assign_chunk: int = 16384,
+) -> np.ndarray:
+    """Train one k-means per segment. data [S, n, d] -> centroids [S, c, d].
+
+    Init = random sample of the data (k-means++ is sequential/branchy and the
+    reference also just samples: ``kmeans.go`` uses random init with restarts).
+    """
+    data = np.asarray(data, np.float32)
+    s, n, d = data.shape
+    rng = np.random.default_rng(seed)
+    if n >= n_centroids:
+        picks = rng.choice(n, size=n_centroids, replace=False)
+    else:
+        picks = rng.integers(0, n, size=n_centroids)
+    init = data[:, picks, :]  # [S, c, d]
+    chunk = min(assign_chunk, max(256, n))
+    cents = _lloyd(jnp.asarray(data), jnp.asarray(init), iters, chunk)
+    return np.asarray(cents)
+
+
+def assign_codes(
+    data: np.ndarray, centroids: np.ndarray, chunk: int = 16384
+) -> np.ndarray:
+    """Encode: nearest-centroid codes. data [S,n,d], centroids [S,c,d] -> [S,n] uint.
+
+    Dtype is uint8 when c <= 256 (the PQ case), else int32.
+    """
+    a = np.asarray(
+        _assign_chunked(
+            jnp.asarray(data, jnp.float32),
+            jnp.asarray(centroids, jnp.float32),
+            min(chunk, max(256, data.shape[1])),
+        )
+    )
+    if centroids.shape[1] <= 256:
+        return a.astype(np.uint8)
+    return a

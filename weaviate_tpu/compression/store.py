@@ -1,0 +1,174 @@
+"""Growable device-resident code arrays + host-RAM original-vector store.
+
+The compressed analogue of ``index/store.py``'s DeviceVectorStore: HBM holds
+only the quantized code planes (the reference keeps compressed vectors in its
+vector cache, ``compressionhelpers/compression.go:59`` quantizedVectorsCache);
+full-precision originals live in host RAM and are touched only by the rescore
+tier (reference ``hnsw/search.go:184`` shouldRescore path reads originals from
+the LSM store).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_PAGE = 4096
+
+
+def _round_up(n: int, page: int = _PAGE) -> int:
+    return ((n + page - 1) // page) * page
+
+
+class DeviceArraySet:
+    """Named device arrays sharing a doc-id-addressed leading dim + validity.
+
+    fields: name -> (trailing_shape tuple, dtype). All arrays grow together
+    by doubling (donate-free copy, same pattern as DeviceVectorStore._grow).
+    """
+
+    def __init__(self, fields: dict[str, tuple[tuple[int, ...], np.dtype]],
+                 capacity: int = _PAGE):
+        cap = max(_PAGE, _round_up(capacity))
+        self.fields = fields
+        self._arrays: dict[str, jnp.ndarray] = {
+            name: jnp.zeros((cap, *shape), dtype)
+            for name, (shape, dtype) in fields.items()
+        }
+        self._valid = jnp.zeros((cap,), jnp.bool_)
+        self._host_valid = np.zeros((cap,), bool)
+        self._watermark = 0
+        self._live = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._valid.shape[0]
+
+    @property
+    def watermark(self) -> int:
+        return self._watermark
+
+    @property
+    def live_count(self) -> int:
+        return self._live
+
+    @property
+    def valid_mask(self) -> jnp.ndarray:
+        return self._valid
+
+    @property
+    def host_valid_mask(self) -> np.ndarray:
+        return self._host_valid
+
+    def __getitem__(self, name: str) -> jnp.ndarray:
+        return self._arrays[name]
+
+    def ensure_capacity(self, min_capacity: int) -> None:
+        if min_capacity <= self.capacity:
+            return
+        new_cap = _round_up(max(min_capacity, self.capacity * 2))
+        for name, arr in self._arrays.items():
+            na = jnp.zeros((new_cap, *arr.shape[1:]), arr.dtype)
+            self._arrays[name] = na.at[: arr.shape[0]].set(arr)
+        self._valid = (
+            jnp.zeros((new_cap,), jnp.bool_).at[: self._valid.shape[0]].set(self._valid)
+        )
+        hv = np.zeros((new_cap,), bool)
+        hv[: len(self._host_valid)] = self._host_valid
+        self._host_valid = hv
+
+    def put(self, doc_ids: np.ndarray, values: dict[str, np.ndarray]) -> None:
+        doc_ids = np.asarray(doc_ids, np.int32)
+        if len(doc_ids) == 0:
+            return
+        self.ensure_capacity(int(doc_ids.max()) + 1)
+        idx = jnp.asarray(doc_ids)
+        for name, val in values.items():
+            arr = self._arrays[name]
+            self._arrays[name] = arr.at[idx].set(
+                jnp.asarray(val, arr.dtype)
+            )
+        self._valid = self._valid.at[idx].set(True)
+        prev = self._host_valid[doc_ids]
+        self._host_valid[doc_ids] = True
+        self._live += int((~prev).sum())
+        self._watermark = max(self._watermark, int(doc_ids.max()) + 1)
+
+    def delete(self, doc_ids: np.ndarray) -> None:
+        doc_ids = np.asarray(doc_ids, np.int32)
+        if len(doc_ids) == 0:
+            return
+        doc_ids = doc_ids[doc_ids < self.capacity]
+        was = self._host_valid[doc_ids]
+        self._valid = self._valid.at[jnp.asarray(doc_ids)].set(False)
+        self._host_valid[doc_ids] = False
+        self._live -= int(was.sum())
+
+
+class HostVectorStore:
+    """Doc-id-addressed originals in host RAM (the rescore/refit tier)."""
+
+    def __init__(self, dims: int, capacity: int = _PAGE):
+        self.dims = dims
+        self._vecs = np.zeros((max(_PAGE, _round_up(capacity)), dims), np.float32)
+        self._valid = np.zeros((self._vecs.shape[0],), bool)
+        self._watermark = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._vecs.shape[0]
+
+    @property
+    def watermark(self) -> int:
+        return self._watermark
+
+    @property
+    def live_count(self) -> int:
+        return int(self._valid.sum())
+
+    @property
+    def valid(self) -> np.ndarray:
+        return self._valid
+
+    def ensure_capacity(self, min_capacity: int) -> None:
+        if min_capacity <= self.capacity:
+            return
+        new_cap = _round_up(max(min_capacity, self.capacity * 2))
+        nv = np.zeros((new_cap, self.dims), np.float32)
+        nv[: self._vecs.shape[0]] = self._vecs
+        self._vecs = nv
+        va = np.zeros((new_cap,), bool)
+        va[: len(self._valid)] = self._valid
+        self._valid = va
+
+    def put(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        doc_ids = np.asarray(doc_ids, np.int64)
+        if len(doc_ids) == 0:
+            return
+        self.ensure_capacity(int(doc_ids.max()) + 1)
+        self._vecs[doc_ids] = vectors
+        self._valid[doc_ids] = True
+        self._watermark = max(self._watermark, int(doc_ids.max()) + 1)
+
+    def delete(self, doc_ids: np.ndarray) -> None:
+        doc_ids = np.asarray(doc_ids, np.int64)
+        doc_ids = doc_ids[doc_ids < self.capacity]
+        self._valid[doc_ids] = False
+
+    def get(self, doc_ids: np.ndarray) -> np.ndarray:
+        return self._vecs[np.asarray(doc_ids, np.int64)]
+
+    def sample(self, limit: int, seed: int = 0) -> np.ndarray:
+        """Up to ``limit`` live vectors (quantizer training sample)."""
+        live = np.flatnonzero(self._valid)
+        if len(live) > limit:
+            rng = np.random.default_rng(seed)
+            live = rng.choice(live, size=limit, replace=False)
+        return self._vecs[live]
+
+    def all_live(self) -> tuple[np.ndarray, np.ndarray]:
+        live = np.flatnonzero(self._valid)
+        return live, self._vecs[live]

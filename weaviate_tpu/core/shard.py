@@ -42,19 +42,19 @@ def build_vector_index(
         from weaviate_tpu.index.hnsw import HNSWIndex
 
         if not isinstance(cfg, HNSWIndexConfig):
-            cfg = HNSWIndexConfig(**{**cfg.to_dict(), "index_type": "hnsw"})
+            cfg = cfg.as_type(HNSWIndexConfig, "hnsw")
         return HNSWIndex(dims, cfg, path=path)
     if isinstance(cfg, DynamicIndexConfig) or cfg.index_type == "dynamic":
         from weaviate_tpu.index.dynamic import DynamicIndex
 
         if not isinstance(cfg, DynamicIndexConfig):
-            cfg = DynamicIndexConfig(**{**cfg.to_dict(), "index_type": "dynamic"})
+            cfg = cfg.as_type(DynamicIndexConfig, "dynamic")
         return DynamicIndex(dims, cfg, path=path)
-    from weaviate_tpu.index.flat import FlatIndex
+    from weaviate_tpu.index.flat import make_flat
 
     if not isinstance(cfg, FlatIndexConfig):
-        cfg = FlatIndexConfig(**{**cfg.to_dict(), "index_type": "flat"})
-    return FlatIndex(dims, cfg)
+        cfg = cfg.as_type(FlatIndexConfig, "flat")
+    return make_flat(dims, cfg)
 
 
 class Shard:

@@ -21,6 +21,15 @@ from weaviate_tpu.ops.topk import masked_topk
 from weaviate_tpu.schema.config import FlatIndexConfig
 
 
+def make_flat(dims: int, config: Optional[FlatIndexConfig] = None) -> VectorIndex:
+    """Flat-index factory: raw HBM corpus, or code planes + rescore tier when
+    a quantizer is configured (reference ``flat/index.go:49`` + ``quantizer.go``)."""
+    config = config or FlatIndexConfig()
+    if config.quantizer is not None and config.quantizer.enabled:
+        return QuantizedFlatIndex(dims, config)
+    return FlatIndex(dims, config)
+
+
 class FlatIndex(VectorIndex):
     def __init__(self, dims: int, config: Optional[FlatIndexConfig] = None):
         self.config = config or FlatIndexConfig()
@@ -109,3 +118,125 @@ def _pad_mask(mask: np.ndarray, capacity: int) -> jnp.ndarray:
     if mask.shape[0] < capacity:
         mask = np.pad(mask, (0, capacity - mask.shape[0]))
     return jnp.asarray(mask[:capacity])
+
+
+def exact_rescore(
+    queries: np.ndarray,
+    cand_ids: np.ndarray,
+    vectors: "HostVectorStore",
+    metric: str,
+    k: int,
+) -> SearchResult:
+    """Re-rank approximate candidates with exact fp32 distances on the host.
+
+    Reference ``hnsw/search.go:184`` (shouldRescore): compressed search
+    over-fetches, then the top candidates are re-scored against original
+    vectors. cand_ids: [B, k'] device results (-1 = empty). The candidate
+    sets are tiny (k' ~ 10-200) so host BLAS is the right tier — no HBM
+    round-trip for the originals.
+    """
+    cand_ids = np.asarray(cand_ids)
+    b, kp = cand_ids.shape
+    safe = np.clip(cand_ids, 0, None)
+    cand = vectors.get(safe.reshape(-1)).reshape(b, kp, -1)  # [B, k', D]
+    q = np.asarray(queries, np.float32)
+    if metric == "l2-squared":
+        diff = q[:, None, :] - cand
+        d = np.einsum("bkd,bkd->bk", diff, diff)
+    elif metric in ("dot", "cosine"):
+        ip = np.einsum("bd,bkd->bk", q, cand)
+        d = -ip if metric == "dot" else 1.0 - ip
+    elif metric == "manhattan":
+        d = np.abs(q[:, None, :] - cand).sum(axis=-1)
+    else:  # hamming over raw floats (reference hamming.go float variant)
+        d = (q[:, None, :] != cand).sum(axis=-1).astype(np.float32)
+    d = np.where(cand_ids < 0, np.float32(MASK_DISTANCE), d.astype(np.float32))
+    k = min(k, kp)
+    part = np.argpartition(d, k - 1, axis=1)[:, :k]
+    pd = np.take_along_axis(d, part, axis=1)
+    order = np.argsort(pd, axis=1, kind="stable")
+    sel = np.take_along_axis(part, order, axis=1)
+    out_d = np.take_along_axis(d, sel, axis=1)
+    out_i = np.take_along_axis(cand_ids, sel, axis=1)
+    out_i = np.where(out_d >= MASK_DISTANCE, -1, out_i)
+    return SearchResult(ids=out_i, dists=out_d)
+
+
+class QuantizedFlatIndex(VectorIndex):
+    """Flat index over HBM-resident code planes with host-side rescore.
+
+    Reference ``flat/index.go`` with BQ/SQ/RQ (``flat/quantizer.go``): codes
+    live in the LSM 'vectors_compressed' bucket and distances are SIMD over
+    codes; here codes are device arrays and distances are one MXU kernel per
+    chunk (``ops/quantized.py``). Storage, fit policy, code search and the
+    rescore tier all live in ``hnsw.backend.QuantizedBackend`` — this class
+    is the VectorIndex adapter over it (same backend HNSW traversal uses).
+    """
+
+    def __init__(self, dims: int, config: FlatIndexConfig):
+        from weaviate_tpu.index.hnsw.backend import QuantizedBackend
+
+        self.config = config
+        self.metric = config.distance
+        self.dims = dims
+        self.backend = QuantizedBackend(dims, config)
+
+    @property
+    def quantizer(self):
+        return self.backend.quantizer
+
+    # -- VectorIndex ------------------------------------------------------
+    def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        self.backend.put(np.asarray(doc_ids, np.int64), vectors)
+
+    def delete(self, doc_ids: np.ndarray) -> None:
+        self.backend.delete(doc_ids)
+
+    def search(
+        self,
+        queries: np.ndarray,
+        k: int,
+        allow_list: Optional[np.ndarray] = None,
+    ) -> SearchResult:
+        queries = np.atleast_2d(np.asarray(queries, np.float32))
+        if queries.shape[-1] != self.dims:
+            raise ValueError(
+                f"query dims {queries.shape[-1]} != index dims {self.dims}"
+            )
+        d, ids = self.backend.flat_topk(queries, k, allow_list)
+        return SearchResult(ids=ids, dists=d)
+
+    def search_by_distance(
+        self,
+        queries: np.ndarray,
+        max_distance: float,
+        allow_list: Optional[np.ndarray] = None,
+        limit: int = 1024,
+    ) -> SearchResult:
+        k = min(limit, max(1, self.count()))
+        res = self.search(queries, k, allow_list)
+        keep = res.dists <= max_distance
+        return SearchResult(
+            ids=np.where(keep, res.ids, -1),
+            dists=np.where(keep, res.dists, np.float32(MASK_DISTANCE)),
+        )
+
+    def count(self) -> int:
+        return self.backend.originals.live_count
+
+    @property
+    def capacity(self) -> int:
+        return self.backend.capacity
+
+    def contains(self, doc_id: int) -> bool:
+        return self.backend.contains(doc_id)
+
+    def stats(self) -> dict:
+        return {
+            "type": "flat",
+            "quantizer": self.quantizer.kind,
+            "fitted": self.quantizer.fitted,
+            "count": self.count(),
+            "capacity": self.capacity,
+            "metric": self.metric,
+        }

@@ -1,0 +1,342 @@
+"""Distance backends for HNSW traversal: raw HBM vectors or quantized codes.
+
+The reference threads a ``CompressorDistancer`` through the HNSW hot loop when
+compression is on (``compressionhelpers/compression.go:40``,
+``hnsw/search.go:726``) and rescores the final candidates against original
+vectors (``search.go:184``). Here the same seam is a backend object: the graph
+walk is identical, only the batched distance kernels differ.
+
+- ``RawBackend``: full-precision corpus in HBM (DeviceVectorStore).
+- ``QuantizedBackend``: code planes in HBM (DeviceArraySet) + originals in
+  host RAM for rescore; construction and traversal run in code space, the
+  final top-k is exactly re-ranked.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.index.store import DeviceVectorStore
+from weaviate_tpu.ops.distance import (
+    MASK_DISTANCE,
+    candidate_pairwise,
+    flat_search,
+    gather_distance,
+    normalize,
+)
+
+_INF = np.float32(np.inf)
+
+
+class RawBackend:
+    """Full-precision distances over the HBM-resident corpus."""
+
+    quantized = False
+
+    def __init__(self, dims: int, config, store: Optional[DeviceVectorStore] = None):
+        self.config = config
+        self.metric = config.distance
+        self.dims = dims
+        self.store = store or DeviceVectorStore(
+            dims,
+            capacity=config.initial_capacity,
+            normalized=(self.metric == "cosine"),
+        )
+
+    # -- storage ----------------------------------------------------------
+    def put(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        self.store.put(doc_ids, vectors)
+
+    def delete(self, doc_ids: np.ndarray) -> None:
+        self.store.delete(doc_ids)
+
+    def contains(self, doc_id: int) -> bool:
+        return self.store.contains(doc_id)
+
+    @property
+    def capacity(self) -> int:
+        return self.store.capacity
+
+    @property
+    def host_valid_mask(self) -> np.ndarray:
+        return self.store.host_valid_mask
+
+    # -- query prep -------------------------------------------------------
+    def prep_queries(self, queries: np.ndarray):
+        q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)))
+        if self.metric == "cosine":
+            q = normalize(q)
+        return q
+
+    def prep_query_ids(self, ids: np.ndarray):
+        q = jnp.take(self.store.corpus, jnp.asarray(ids), axis=0)
+        if self.metric == "cosine":
+            q = normalize(q)
+        return q
+
+    @staticmethod
+    def take_queries(qrep, rows: np.ndarray):
+        """Row-subset of a query rep (lockstep construction sub-batching)."""
+        return qrep[rows]
+
+    # -- distance kernels -------------------------------------------------
+    def frontier_dists(self, qrep, cand: np.ndarray) -> np.ndarray:
+        clipped = np.maximum(cand, 0)
+        d = np.array(
+            gather_distance(
+                qrep,
+                self.store.corpus,
+                jnp.asarray(clipped),
+                self.metric,
+                precision=self.config.precision,
+            )
+        )
+        d[cand < 0] = _INF
+        return d
+
+    def pairwise(self, ids: np.ndarray) -> np.ndarray:
+        """[G, C] ids (pads clipped to 0 by caller) -> [G, C, C] distances."""
+        return np.array(
+            candidate_pairwise(
+                self.store.corpus,
+                jnp.asarray(ids),
+                self.metric,
+                precision=self.config.precision,
+            )
+        )
+
+    def flat_topk(
+        self, queries: np.ndarray, k: int, allow: Optional[np.ndarray]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Brute-force top-k (small-filter cutoff path). Returns (dists, ids)."""
+        qrep = self.prep_queries(queries)
+        cap = self.store.capacity
+        allow_j = None
+        if allow is not None:
+            al = np.asarray(allow, bool)
+            if len(al) < cap:
+                al = np.pad(al, (0, cap - len(al)))
+            allow_j = jnp.asarray(al[:cap])
+        d, ids = flat_search(
+            qrep,
+            self.store.corpus,
+            k=k,
+            metric=self.metric,
+            valid_mask=self.store.valid_mask,
+            allow_mask=allow_j,
+            corpus_sqnorms=self.store.sqnorms if self.metric == "l2-squared" else None,
+            precision=self.config.precision,
+        )
+        d = np.array(d)
+        ids = np.asarray(ids, np.int64)
+        d[ids < 0] = _INF
+        return d, ids
+
+    def rescore_topk(
+        self, queries: np.ndarray, cand_ids: np.ndarray, cand_d: np.ndarray, k: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Raw distances are already exact — just truncate."""
+        return cand_ids[:, :k], cand_d[:, :k]
+
+
+class QueryRep(NamedTuple):
+    """Per-search query representation: host fp32 (metric-prepped) for exact
+    rescore/fallback + the quantizer's device rep (packed/rotated/cast),
+    computed once and reused across every frontier hop."""
+
+    host: np.ndarray
+    code: Any  # None when the quantizer isn't fitted yet
+
+    @property
+    def shape(self) -> tuple:
+        return self.host.shape
+
+
+class QuantizedBackend:
+    """Code-space distances + exact host rescore (HNSW+PQ/BQ/SQ/RQ)."""
+
+    quantized = True
+
+    def __init__(self, dims: int, config):
+        from weaviate_tpu.compression import (
+            DeviceArraySet,
+            HostVectorStore,
+            build_quantizer,
+        )
+
+        self.config = config
+        self.metric = config.distance
+        self.dims = dims
+        self.quantizer = build_quantizer(config.quantizer, dims, self.metric)
+        self.originals = HostVectorStore(dims, capacity=config.initial_capacity)
+        self.codes = DeviceArraySet(
+            self.quantizer.fields(), capacity=config.initial_capacity
+        )
+
+    def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
+        v = np.asarray(vectors, np.float32)
+        if self.metric == "cosine":
+            v = v / np.maximum(np.linalg.norm(v, axis=-1, keepdims=True), 1e-12)
+        return v
+
+    # -- storage ----------------------------------------------------------
+    def put(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
+        v = self._prep_vectors(vectors)
+        self.originals.put(doc_ids, v)
+        if self.quantizer.fitted:
+            self.codes.put(doc_ids, self.quantizer.encode(v))
+            return
+        if self.originals.live_count >= self.quantizer.min_training:
+            limit = getattr(self.quantizer.config, "training_limit", 100_000)
+            self.quantizer.fit(self.originals.sample(limit))
+            ids, vecs = self.originals.all_live()
+            self.codes.put(ids, self.quantizer.encode(vecs))
+
+    def delete(self, doc_ids: np.ndarray) -> None:
+        self.originals.delete(doc_ids)
+        self.codes.delete(doc_ids)
+
+    def contains(self, doc_id: int) -> bool:
+        return doc_id < self.originals.capacity and bool(
+            self.originals.valid[doc_id]
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.originals.capacity
+
+    @property
+    def host_valid_mask(self) -> np.ndarray:
+        return self.originals.valid
+
+    # -- query prep -------------------------------------------------------
+    def prep_queries(self, queries: np.ndarray) -> QueryRep:
+        host = self._prep_vectors(np.atleast_2d(queries))
+        code = self.quantizer.prep(host) if self.quantizer.fitted else None
+        return QueryRep(host=host, code=code)
+
+    def prep_query_ids(self, ids: np.ndarray) -> QueryRep:
+        return self.prep_queries(self.originals.get(ids))
+
+    @staticmethod
+    def take_queries(qrep: QueryRep, rows: np.ndarray) -> QueryRep:
+        return QueryRep(
+            host=qrep.host[rows],
+            code=None if qrep.code is None else qrep.code[rows],
+        )
+
+    # -- distance kernels -------------------------------------------------
+    def frontier_dists(self, qrep: QueryRep, cand: np.ndarray) -> np.ndarray:
+        if qrep.code is None:
+            return self._exact_host_dists(qrep.host, cand)
+        clipped = np.maximum(cand, 0)
+        d = np.array(
+            self.quantizer.gather_distance(
+                qrep.code, self.codes, jnp.asarray(clipped)
+            )
+        )
+        d[cand < 0] = _INF
+        return d
+
+    def _exact_host_dists(self, q: np.ndarray, cand: np.ndarray) -> np.ndarray:
+        clipped = np.maximum(cand, 0)
+        vecs = self.originals.get(clipped.reshape(-1)).reshape(
+            *cand.shape, self.dims
+        )
+        d = _host_metric(q[:, None, :], vecs, self.metric)
+        d[cand < 0] = _INF
+        return d
+
+    def pairwise(self, ids: np.ndarray) -> np.ndarray:
+        """Construction heuristic pairwise — exact over host originals.
+
+        The candidate sets are small ([G, C] with C ~ 100), so exact host
+        distances cost little and keep graph quality at the uncompressed
+        level (better than the reference, which builds with compressed
+        distances once compression is on).
+        """
+        vecs = self.originals.get(ids.reshape(-1)).reshape(*ids.shape, self.dims)
+        if self.metric == "cosine":
+            vecs = vecs / np.maximum(
+                np.linalg.norm(vecs, axis=-1, keepdims=True), 1e-12
+            )
+        return _host_metric(vecs[:, :, None, :], vecs[:, None, :, :], self.metric)
+
+    def flat_topk(
+        self, queries: np.ndarray, k: int, allow: Optional[np.ndarray]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        from weaviate_tpu.index.flat import exact_rescore
+
+        qrep = self.prep_queries(queries)
+        if qrep.code is None:
+            # pre-fit: exact over the (tiny) host corpus
+            live = np.flatnonzero(self.originals.valid)
+            if allow is not None:
+                al = np.asarray(allow, bool)
+                live = live[(live < len(al))]
+                live = live[al[live]]
+            if len(live) == 0:
+                b = qrep.host.shape[0]
+                return (
+                    np.full((b, k), _INF, np.float32),
+                    np.full((b, k), -1, np.int64),
+                )
+            ids = np.broadcast_to(live[None, :], (qrep.host.shape[0], len(live)))
+            res = exact_rescore(
+                qrep.host, ids, self.originals, self.metric, min(k, len(live))
+            )
+        else:
+            mask = self.codes.valid_mask
+            if allow is not None:
+                al = np.asarray(allow, bool)
+                if len(al) < self.codes.capacity:
+                    al = np.pad(al, (0, self.codes.capacity - len(al)))
+                mask = mask & jnp.asarray(al[: self.codes.capacity])
+            rescore_limit = getattr(self.quantizer.config, "rescore_limit", 0)
+            fetch = max(4 * k, rescore_limit, k)
+            chunk = self.config.search_chunk_size
+            _, ids = self.quantizer.search(
+                qrep.code, self.codes, fetch, mask,
+                chunk if self.codes.capacity > chunk else 0,
+            )
+            res = exact_rescore(
+                qrep.host, np.asarray(ids), self.originals, self.metric, k
+            )
+        d = res.dists.astype(np.float32).copy()
+        ids = res.ids.astype(np.int64)
+        d[ids < 0] = _INF
+        if ids.shape[1] < k:
+            pad = k - ids.shape[1]
+            ids = np.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
+            d = np.pad(d, ((0, 0), (0, pad)), constant_values=_INF)
+        return d, ids
+
+    def rescore_topk(
+        self, queries: np.ndarray, cand_ids: np.ndarray, cand_d: np.ndarray, k: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        from weaviate_tpu.index.flat import exact_rescore
+
+        # metric prep (cosine normalization) must match the stored originals,
+        # otherwise returned distances are scaled by ||q||
+        q = self._prep_vectors(np.atleast_2d(queries))
+        res = exact_rescore(q, cand_ids, self.originals, self.metric, k)
+        d = res.dists.astype(np.float32).copy()
+        ids = res.ids.astype(np.int64)
+        d[ids < 0] = _INF
+        return ids, d
+
+
+def _host_metric(a: np.ndarray, b: np.ndarray, metric: str) -> np.ndarray:
+    """Broadcasted exact distances on host (small candidate blocks only)."""
+    if metric == "l2-squared":
+        diff = a - b
+        return np.einsum("...d,...d->...", diff, diff).astype(np.float32)
+    if metric in ("dot", "cosine"):
+        ip = np.einsum("...d,...d->...", a, b).astype(np.float32)
+        return -ip if metric == "dot" else 1.0 - ip
+    if metric == "manhattan":
+        return np.abs(a - b).sum(axis=-1).astype(np.float32)
+    return (a != b).sum(axis=-1).astype(np.float32)

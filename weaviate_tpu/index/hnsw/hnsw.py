@@ -26,18 +26,12 @@ import math
 import os
 from typing import Optional
 
-import jax.numpy as jnp
 import numpy as np
 
 from weaviate_tpu.index.base import SearchResult, VectorIndex
+from weaviate_tpu.index.hnsw.backend import QuantizedBackend, RawBackend
 from weaviate_tpu.index.hnsw.graph import NO_NODE, HostGraph
 from weaviate_tpu.index.store import DeviceVectorStore
-from weaviate_tpu.ops.distance import (
-    candidate_pairwise,
-    flat_search,
-    gather_distance,
-    normalize,
-)
 from weaviate_tpu.schema.config import HNSWIndexConfig
 
 _INF = np.float32(np.inf)
@@ -62,12 +56,15 @@ class HNSWIndex(VectorIndex):
         self.metric = self.config.distance
         self.path = path
         # an existing store may be handed over (dynamic-index upgrade keeps
-        # the corpus in HBM and only rebuilds the graph)
-        self.store = store or DeviceVectorStore(
-            dims,
-            capacity=self.config.initial_capacity,
-            normalized=(self.metric == "cosine"),
-        )
+        # the corpus in HBM and only rebuilds the graph); a configured
+        # quantizer swaps the whole distance tier to code space
+        quant = self.config.quantizer
+        if store is None and quant is not None and quant.enabled:
+            self.backend = QuantizedBackend(dims, self.config)
+            self.store = None
+        else:
+            self.backend = RawBackend(dims, self.config, store=store)
+            self.store = self.backend.store
         self.graph = HostGraph(m=self.config.max_connections)
         self._ml = 1.0 / math.log(max(2, self.config.max_connections))
         self._level_rng = np.random.default_rng(0x5EED)
@@ -89,6 +86,9 @@ class HNSWIndex(VectorIndex):
     def _snapshot_path(self) -> str:
         return os.path.join(self.path, "graph.npz")
 
+    def _quantizer_path(self) -> str:
+        return os.path.join(self.path, "quantizer.msgpack")
+
     def flush(self) -> None:
         if not self.path:
             return
@@ -96,41 +96,47 @@ class HNSWIndex(VectorIndex):
         tmp = self._snapshot_path() + ".tmp.npz"
         np.savez_compressed(tmp, **self.graph.to_arrays())
         os.replace(tmp, self._snapshot_path())
+        if self.backend.quantized and self.backend.quantizer.fitted:
+            # persist trained quantizer state (codebooks/rotation/scales) so
+            # recovery re-encodes with identical codes (reference persists
+            # PQData/SQData/... in the commit log)
+            import msgpack
+
+            tmp = self._quantizer_path() + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(
+                    msgpack.packb(
+                        self.backend.quantizer.state_dict(), use_bin_type=True
+                    )
+                )
+            os.replace(tmp, self._quantizer_path())
 
     def _load_snapshot(self) -> None:
         with np.load(self._snapshot_path()) as z:
             self.graph = HostGraph.from_arrays({k: z[k] for k in z.files})
+        if self.backend.quantized and os.path.exists(self._quantizer_path()):
+            import msgpack
+
+            with open(self._quantizer_path(), "rb") as f:
+                self.backend.quantizer.load_state_dict(
+                    msgpack.unpackb(f.read(), raw=False)
+                )
 
     # ------------------------------------------------------------------
     # helpers
     # ------------------------------------------------------------------
-    def _qdev(self, queries: np.ndarray) -> jnp.ndarray:
-        q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)))
-        if self.metric == "cosine":
-            q = normalize(q)
-        return q
+    def _qdev(self, queries: np.ndarray):
+        return self.backend.prep_queries(queries)
 
     def _frontier_dists(self, qdev, cand: np.ndarray) -> np.ndarray:
         """[B, C] candidate ids (-1 pad) -> [B, C] distances (inf for pads)."""
-        clipped = np.maximum(cand, 0)
-        d = np.array(  # np.array: jax buffers are read-only views
-            gather_distance(
-                qdev,
-                self.store.corpus,
-                jnp.asarray(clipped),
-                self.metric,
-                precision=self.config.precision,
-            )
-        )
-        d[cand < 0] = _INF
-        return d
+        return self.backend.frontier_dists(qdev, cand)
 
     def _node_dists(self, node_ids: np.ndarray, cand: np.ndarray) -> np.ndarray:
         """Distances from each node's own vector to its candidates [G, C]."""
-        qdev = jnp.take(self.store.corpus, jnp.asarray(node_ids), axis=0)
-        if self.metric == "cosine":
-            qdev = normalize(qdev)
-        return self._frontier_dists(qdev, cand)
+        return self.backend.frontier_dists(
+            self.backend.prep_query_ids(node_ids), cand
+        )
 
     def _level_for_new(self, n: int) -> np.ndarray:
         u = self._level_rng.random(n)
@@ -270,7 +276,7 @@ class HNSWIndex(VectorIndex):
         vectors = np.asarray(vectors, np.float32)
         if len(doc_ids) == 0:
             return
-        self.store.put(doc_ids, vectors)
+        self.backend.put(doc_ids, vectors)
         self.graph.ensure_capacity(int(doc_ids.max()) + 1)
         # a re-added tombstoned id is a fresh vector at an old id: drop the
         # stale node so it re-inserts with edges for the new vector
@@ -285,7 +291,7 @@ class HNSWIndex(VectorIndex):
     def index_existing(self) -> None:
         """Build the graph over the store's live vectors without touching the
         corpus (dynamic upgrade path — vectors never leave HBM)."""
-        live = np.nonzero(self.store.host_valid_mask)[0].astype(np.int64)
+        live = np.nonzero(self.backend.host_valid_mask)[0].astype(np.int64)
         if len(live) == 0:
             return
         self.graph.ensure_capacity(int(live.max()) + 1)
@@ -303,9 +309,7 @@ class HNSWIndex(VectorIndex):
             if len(ids) == 0:
                 return
         b = len(ids)
-        qdev = jnp.take(self.store.corpus, jnp.asarray(ids), axis=0)
-        if self.metric == "cosine":
-            qdev = normalize(qdev)
+        qdev = self.backend.prep_query_ids(ids)
         eps = np.full(b, self.graph.entrypoint, np.int64)
         efc = self.config.ef_construction
         old_max = self.graph.max_level
@@ -327,7 +331,7 @@ class HNSWIndex(VectorIndex):
                 if search.any():
                     sub = np.nonzero(search)[0]
                     res_ids, res_d = self._search_level(
-                        qdev[jnp.asarray(sub)], eps[sub], efc, level
+                        self.backend.take_queries(qdev, sub), eps[sub], efc, level
                     )
                     eps[sub] = res_ids[:, 0]
                     link_plan.append((level, sub, res_ids, res_d))
@@ -344,14 +348,7 @@ class HNSWIndex(VectorIndex):
 
         # intra-batch candidates: batch-to-batch pairwise distances restore
         # visibility between nodes inserted in the same lockstep sub-batch
-        bb = np.array(
-            candidate_pairwise(
-                self.store.corpus,
-                jnp.asarray(ids[None, :]),
-                self.metric,
-                precision=self.config.precision,
-            )
-        )[0]
+        bb = self.backend.pairwise(ids[None, :])[0]
 
         for level, sub, res_ids, res_d in link_plan:
             self._link_level(level, ids, levels, sub, res_ids, res_d, bb)
@@ -434,14 +431,7 @@ class HNSWIndex(VectorIndex):
         ids_p[:g, :c_cap] = np.maximum(ids_s, 0)
         d_p[:g, :c_cap] = np.where(ids_s >= 0, d_s, _INF)
 
-        pair = np.array(
-            candidate_pairwise(
-                self.store.corpus,
-                jnp.asarray(ids_p),
-                self.metric,
-                precision=self.config.precision,
-            )
-        )
+        pair = self.backend.pairwise(ids_p)
         rows = np.arange(g_pad)
         chosen = np.zeros((g_pad, c_pad), bool)
         min_to_sel = np.full((g_pad, c_pad), _INF, np.float32)
@@ -469,7 +459,7 @@ class HNSWIndex(VectorIndex):
     # ------------------------------------------------------------------
     def delete(self, doc_ids: np.ndarray) -> None:
         doc_ids = np.asarray(doc_ids, np.int64)
-        self.store.delete(doc_ids)
+        self.backend.delete(doc_ids)
         for d in doc_ids:
             self.graph.add_tombstone(int(d))
 
@@ -545,9 +535,9 @@ class HNSWIndex(VectorIndex):
         allow_list: Optional[np.ndarray] = None,
     ) -> SearchResult:
         queries = np.atleast_2d(np.asarray(queries, np.float32))
-        if queries.shape[-1] != self.store.dims:
+        if queries.shape[-1] != self.backend.dims:
             raise ValueError(
-                f"query dims {queries.shape[-1]} != index dims {self.store.dims}"
+                f"query dims {queries.shape[-1]} != index dims {self.backend.dims}"
             )
         b = queries.shape[0]
         if self.graph.entrypoint == NO_NODE:
@@ -576,7 +566,7 @@ class HNSWIndex(VectorIndex):
 
     def _keep_mask(self, allow_list: Optional[np.ndarray]) -> np.ndarray:
         cap = self.graph.capacity
-        valid = self.store.host_valid_mask
+        valid = self.backend.host_valid_mask
         if len(valid) < cap:
             valid = np.pad(valid, (0, cap - len(valid)))
         keep = valid[:cap] & (self.graph.levels >= 0)
@@ -596,30 +586,19 @@ class HNSWIndex(VectorIndex):
         for level in range(self.graph.max_level, 0, -1):
             eps = self._greedy_step_until_stable(qdev, eps, level, all_active)
         keep = self._keep_mask(allow_list)
+        keep_k = max(k, min(ef, 2 * k))
+        if self.backend.quantized:
+            # over-fetch so the exact rescore tier has candidates to promote
+            # (reference hnsw/search.go:184 shouldRescore)
+            rl = getattr(self.backend.quantizer.config, "rescore_limit", 0)
+            keep_k = min(ef, max(keep_k, rl, 2 * k))
         _, _, kept_ids, kept_d = self._search_level(
-            qdev, eps, ef, 0, keep_mask=keep, keep_k=max(k, min(ef, 2 * k))
+            qdev, eps, ef, 0, keep_mask=keep, keep_k=keep_k
         )
-        return kept_ids[:, :k], kept_d[:, :k]
+        return self.backend.rescore_topk(queries, kept_ids, kept_d, k)
 
     def _flat_filtered(self, queries, k, allow_list):
-        qdev = self._qdev(queries)
-        cap = self.store.capacity
-        al = np.asarray(allow_list, bool)
-        if len(al) < cap:
-            al = np.pad(al, (0, cap - len(al)))
-        d, ids = flat_search(
-            qdev,
-            self.store.corpus,
-            k=k,
-            metric=self.metric,
-            valid_mask=self.store.valid_mask,
-            allow_mask=jnp.asarray(al[:cap]),
-            corpus_sqnorms=self.store.sqnorms if self.metric == "l2-squared" else None,
-            precision=self.config.precision,
-        )
-        d = np.array(d)
-        ids = np.asarray(ids, np.int64)
-        d[ids < 0] = _INF
+        d, ids = self.backend.flat_topk(queries, k, allow_list)
         return SearchResult(ids=ids, dists=d)
 
     def search_by_distance(
@@ -643,13 +622,13 @@ class HNSWIndex(VectorIndex):
 
     @property
     def capacity(self) -> int:
-        return self.store.capacity
+        return self.backend.capacity
 
     def contains(self, doc_id: int) -> bool:
-        return self.graph.contains(doc_id) and self.store.contains(doc_id)
+        return self.graph.contains(doc_id) and self.backend.contains(doc_id)
 
     def stats(self) -> dict:
-        return {
+        s = {
             "type": "hnsw",
             "count": self.count(),
             "capacity": self.capacity,
@@ -657,3 +636,7 @@ class HNSWIndex(VectorIndex):
             "max_level": self.graph.max_level,
             "entrypoint": self.graph.entrypoint,
         }
+        if self.backend.quantized:
+            s["quantizer"] = self.backend.quantizer.kind
+            s["fitted"] = self.backend.quantizer.fitted
+        return s

@@ -82,6 +82,8 @@ class Property:
 class QuantizerConfig:
     enabled: bool = False
     kind: str = "none"  # pq | sq | bq | rq
+    # candidates fetched from code space before exact rescore (0 = 4*k)
+    rescore_limit: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -97,6 +99,7 @@ class PQConfig(QuantizerConfig):
     centroids: int = 256
     training_limit: int = 100_000
     encoder: str = "kmeans"  # kmeans | tile
+    rescore_limit: int = 40
 
 
 @dataclass
@@ -179,6 +182,18 @@ class VectorIndexConfig:
         if self.quantizer is not None:
             d["quantizer"] = self.quantizer.to_dict()
         return d
+
+    def as_type(self, cls: type, index_type: str) -> "VectorIndexConfig":
+        """Convert to a concrete index-config subclass, preserving the live
+        quantizer object (a plain to_dict round-trip would flatten it)."""
+        quant = self.quantizer
+        d = self.to_dict()
+        d.pop("quantizer", None)
+        d["index_type"] = index_type
+        fields = {f.name for f in dataclasses.fields(cls)}
+        cfg = cls(**{k: v for k, v in d.items() if k in fields})
+        cfg.quantizer = quant
+        return cfg
 
     @staticmethod
     def from_dict(d: Optional[dict]) -> "VectorIndexConfig":
