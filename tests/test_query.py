@@ -1,0 +1,267 @@
+"""Query orchestration tests: hybrid, multi-target, sort, groupBy, autocut,
+aggregations — mirroring the reference's traverser/aggregator unit tests."""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.inverted.filters import Filter, Where
+from weaviate_tpu.query import (
+    Explorer,
+    GroupByParams,
+    HybridParams,
+    QueryParams,
+    autocut,
+    ranked_fusion,
+    relative_score_fusion,
+)
+from weaviate_tpu.query.aggregator import aggregate_property
+from weaviate_tpu.query.multi_target import combine_multi_target
+from weaviate_tpu.query.sorter import sort_objects
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    DataType,
+    FlatIndexConfig,
+    Property,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+
+# ---------------------------------------------------------------- fusion unit
+def test_ranked_fusion_prefers_doc_in_both_sets():
+    a = [("x", 9.0), ("y", 8.0)]
+    b = [("y", 0.5), ("z", 0.4)]
+    out = ranked_fusion([a, b], [0.5, 0.5], 3)
+    assert out[0][0] == "y"
+    assert {k for k, _ in out} == {"x", "y", "z"}
+
+
+def test_relative_score_fusion_normalizes_branches():
+    # raw magnitudes differ wildly; normalization makes branches comparable
+    a = [("x", 1000.0), ("y", 999.5), ("w", 999.0)]
+    b = [("y", 0.01), ("z", 0.0)]
+    out = relative_score_fusion([a, b], [0.5, 0.5], 4)
+    # y: 0.5 normalized in a + 1.0 in b = 0.75 > x's 0.5
+    assert out[0][0] == "y"
+    scores = dict(out)
+    assert scores["y"] > scores["x"]
+
+
+def test_autocut_cuts_at_jump():
+    # clear jump after 3 results
+    scores = [0.99, 0.98, 0.97, 0.5, 0.49]
+    assert autocut(scores, 1) == 3
+    assert autocut(scores, 0) == 5  # disabled
+    assert autocut(scores, 5) == 5  # more jumps than exist
+
+
+def test_combine_multi_target_modes():
+    pt = {
+        "a": {"d1": 0.1, "d2": 0.5},
+        "b": {"d1": 0.4, "d2": 0.2},
+    }
+    assert combine_multi_target(pt, "minimum")[0][0] == "d1"  # min 0.1
+    s = dict(combine_multi_target(pt, "sum"))
+    assert s["d1"] == pytest.approx(0.5)
+    assert s["d2"] == pytest.approx(0.7)
+    m = dict(combine_multi_target(pt, "manualWeights", {"a": 1.0, "b": 10.0}))
+    assert m["d2"] == pytest.approx(0.5 + 2.0)
+
+
+def test_sort_objects_typed_and_missing_last():
+    objs = [
+        StorageObject(uuid=f"u{i}", collection="C", properties=p)
+        for i, p in enumerate([
+            {"n": 3, "t": "b"},
+            {"n": 1, "t": "c"},
+            {"t": "a"},  # missing n
+            {"n": 2, "t": "d"},
+        ])
+    ]
+    asc = sort_objects(objs, [("n", "asc")])
+    assert [o.properties.get("n") for o in asc] == [1, 2, 3, None]
+    desc = sort_objects(objs, [("n", "desc")])
+    assert [o.properties.get("n") for o in desc] == [3, 2, 1, None]
+
+
+def test_aggregate_property_kinds():
+    num = aggregate_property([1, 2, 2, 3])
+    assert num["type"] == "numeric"
+    assert num["mean"] == pytest.approx(2.0)
+    assert num["mode"] == 2
+    txt = aggregate_property(["a", "b", "a"], "text")
+    assert txt["topOccurrences"][0] == {"value": "a", "occurs": 2}
+    boo = aggregate_property([True, False, True])
+    assert boo["type"] == "boolean"
+    assert boo["percentageTrue"] == pytest.approx(2 / 3)
+    dat = aggregate_property(["2024-01-01T00:00:00Z", "2024-06-01T00:00:00Z"])
+    assert dat["type"] == "date"
+    assert dat["min"].startswith("2024-01-01")
+
+
+# ------------------------------------------------------------- e2e via a DB
+D = 32
+
+
+@pytest.fixture
+def db(tmp_dbdir, rng):
+    db = DB(tmp_dbdir)
+    cfg = CollectionConfig(
+        name="Article",
+        properties=[
+            Property(name="title", data_type=DataType.TEXT),
+            Property(name="category", data_type=DataType.TEXT),
+            Property(name="views", data_type=DataType.INT),
+        ],
+        vector_config=FlatIndexConfig(distance="l2-squared", precision="fp32"),
+    )
+    col = db.create_collection(cfg)
+    cats = ["news", "sports", "tech"]
+    words = ["apple", "banana", "cherry", "quantum", "football", "election"]
+    objs = []
+    for i in range(60):
+        vec = np.zeros(D, np.float32)
+        vec[i % D] = 1.0
+        vec[(i + 1) % D] = 0.5
+        objs.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}",
+            collection="Article",
+            properties={
+                "title": f"{words[i % len(words)]} story {i}",
+                "category": cats[i % 3],
+                "views": i * 10,
+            },
+            vector=vec,
+        ))
+    col.put_batch(objs)
+    yield db
+    db.close()
+
+
+def test_hybrid_search_blends_branches(db):
+    col = db.get_collection("Article")
+    # query vector == object 0's vector; keyword 'election' matches i%6==5
+    q = np.zeros(D, np.float32)
+    q[0] = 1.0
+    q[1] = 0.5
+    # alpha=0.6: all 'election' docs tie on BM25 (identical tf/len ->
+    # normalized 1.0 each -> fused 0.4); the exact vector match fuses to 0.6
+    res = col.hybrid_search(query="election", vector=q, alpha=0.6, k=10)
+    assert res
+    uuids = [o.uuid for o, _ in res]
+    # object 0 (exact vector match) must rank, and some 'election' doc too
+    assert "00000000-0000-0000-0000-000000000000" in uuids
+    assert any(int(u[-12:]) % 6 == 5 for u in uuids)
+    # pure-vector alpha=1 == vector order
+    pure = col.hybrid_search(query="election", vector=q, alpha=1.0, k=3)
+    assert pure[0][0].uuid == "00000000-0000-0000-0000-000000000000"
+
+
+def test_explorer_bm25_sort_filter_autocut(db):
+    ex = Explorer(db)
+    # filtered list + sort by views desc
+    res = ex.get(QueryParams(
+        collection="Article",
+        filters=Where.eq("category", "tech"),
+        sort=[("views", "desc")],
+        limit=5,
+    ))
+    views = [h.object.properties["views"] for h in res.hits]
+    assert views == sorted(views, reverse=True)
+    assert all(h.object.properties["category"] == "tech" for h in res.hits)
+
+    # bm25 via explorer
+    res = ex.get(QueryParams(collection="Article", bm25_query="quantum", limit=5))
+    assert res.hits and all(
+        "quantum" in h.object.properties["title"] for h in res.hits
+    )
+    assert res.hits[0].score is not None
+
+
+def test_explorer_groupby(db):
+    ex = Explorer(db)
+    q = np.zeros(D, np.float32)
+    q[0] = 1.0
+    res = ex.get(QueryParams(
+        collection="Article",
+        near_vector=q,
+        limit=30,
+        group_by=GroupByParams(property="category", groups=2,
+                               objects_per_group=3),
+    ))
+    assert res.groups is not None and len(res.groups) == 2
+    for g in res.groups:
+        assert 1 <= len(g.objects) <= 3
+        assert all(o.properties["category"] == g.value for o, _ in g.objects)
+
+
+def test_explorer_hybrid_params(db):
+    ex = Explorer(db)
+    q = np.zeros(D, np.float32)
+    q[2] = 1.0
+    q[3] = 0.5
+    res = ex.get(QueryParams(
+        collection="Article",
+        hybrid=HybridParams(query="banana", vector=q, alpha=0.5),
+        limit=5,
+    ))
+    assert res.hits and res.hits[0].score is not None
+
+
+def test_aggregate_e2e(db):
+    col = db.get_collection("Article")
+    out = col.aggregate({"views": None, "category": "text"})
+    assert out["meta"]["count"] == 60
+    assert out["properties"]["views"]["type"] == "numeric"
+    assert out["properties"]["views"]["min"] == 0
+    assert out["properties"]["views"]["max"] == 590
+    occ = out["properties"]["category"]["topOccurrences"]
+    assert sum(o["occurs"] for o in occ) == 60
+
+    # filtered
+    out = col.aggregate(
+        {"views": None},
+        flt=Where.eq("category", "news"),
+    )
+    assert out["meta"]["count"] == 20
+
+    # grouped
+    out = col.aggregate({"views": None}, group_by="category")
+    assert len(out["groups"]) == 3
+    for g in out["groups"]:
+        assert g["meta"]["count"] == 20
+
+
+def test_multi_target_search_e2e(tmp_dbdir, rng):
+    db = DB(tmp_dbdir)
+    cfg = CollectionConfig(
+        name="Multi",
+        vector_config=FlatIndexConfig(distance="l2-squared", precision="fp32"),
+        named_vectors={
+            "a": FlatIndexConfig(distance="l2-squared", precision="fp32"),
+            "b": FlatIndexConfig(distance="l2-squared", precision="fp32"),
+        },
+    )
+    col = db.create_collection(cfg)
+    objs = []
+    for i in range(20):
+        va = np.zeros(8, np.float32)
+        vb = np.zeros(8, np.float32)
+        va[i % 8] = 1.0
+        vb[(i + 4) % 8] = 1.0
+        objs.append(StorageObject(
+            uuid=f"00000000-0000-0000-0001-{i:012d}",
+            collection="Multi",
+            named_vectors={"a": va, "b": vb},
+        ))
+    col.put_batch(objs)
+
+    qa = np.zeros(8, np.float32)
+    qa[0] = 1.0  # matches i%8==0 in target a
+    qb = np.zeros(8, np.float32)
+    qb[4] = 1.0  # matches i%8==0 in target b ((i+4)%8==4)
+    res = col.multi_target_search({"a": qa, "b": qb}, k=5, combination="sum")
+    assert res
+    top = res[0][0]
+    assert int(top.uuid[-12:]) % 8 == 0
+    db.close()

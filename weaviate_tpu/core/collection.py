@@ -154,7 +154,7 @@ class Collection:
         n = 0
         for shard in self._search_shards(tenant):
             space = shard._next_doc_id
-            mask = shard.inverted.allow_list(flt, space)
+            mask = shard.allow_list(flt, space)
             doc_ids = np.nonzero(mask)[0]
             uuids = []
             for d in doc_ids:
@@ -221,7 +221,7 @@ class Collection:
         def run(shard: Shard):
             allow = None
             if flt is not None:
-                allow = shard.inverted.allow_list(flt, max(shard._next_doc_id, 1))
+                allow = shard.allow_list(flt)
             return shard, shard.vector_search(
                 queries, k, target=target, allow_list=allow, max_distance=max_distance
             )
@@ -261,7 +261,7 @@ class Collection:
             allow = None
             space = max(shard._next_doc_id, 1)
             if flt is not None:
-                allow = shard.inverted.allow_list(flt, space)
+                allow = shard.allow_list(flt, space)
             ids, scores = shard.inverted.bm25_search(
                 query, k, properties=properties, allow_list=allow, doc_space=space
             )
@@ -275,13 +275,215 @@ class Collection:
                 out.append((obj, s))
         return out
 
+    def hybrid_search(
+        self,
+        query: Optional[str] = None,
+        vector: Optional[np.ndarray] = None,
+        alpha: float = 0.75,
+        k: int = 10,
+        fusion: str = "relativeScoreFusion",
+        properties: Optional[list[str]] = None,
+        flt: Optional[Filter] = None,
+        tenant: str = "",
+        target: str = DEFAULT_VECTOR,
+        max_vector_distance: Optional[float] = None,
+    ) -> list[tuple[StorageObject, float]]:
+        """BM25 + vector branches fused (reference ``hybrid/searcher.go:75``).
+
+        ``alpha`` weighs the vector branch (1.0 = pure vector, 0.0 = pure
+        keyword). Vector-branch scores enter fusion as negated distances so
+        "higher is better" holds for both branches.
+        """
+        from weaviate_tpu.query.fusion import FUSION_ALGORITHMS
+
+        fuse = FUSION_ALGORITHMS.get(fusion)
+        if fuse is None:
+            raise ValueError(f"unknown fusion algorithm {fusion!r}")
+        fetch = max(k, 20)  # give fusion room beyond the final page
+        sets: list[list[tuple[str, float]]] = []
+        weights: list[float] = []
+        by_uuid: dict[str, tuple[StorageObject, float]] = {}
+
+        if query and alpha < 1.0:
+            sparse = self.bm25_search(
+                query, fetch, properties=properties, flt=flt, tenant=tenant
+            )
+            sets.append([(o.uuid, s) for o, s in sparse])
+            weights.append(1.0 - alpha)
+            for o, _ in sparse:
+                by_uuid.setdefault(o.uuid, (o, 0.0))
+        if vector is not None and alpha > 0.0:
+            dense = self.vector_search(
+                vector, fetch, target=target, flt=flt, tenant=tenant,
+                max_distance=max_vector_distance,
+            )
+            sets.append([(o.uuid, -d) for o, d in dense])
+            weights.append(alpha)
+            for o, _ in dense:
+                by_uuid.setdefault(o.uuid, (o, 0.0))
+
+        fused = fuse(sets, weights, k)
+        return [(by_uuid[u][0], s) for u, s in fused if u in by_uuid]
+
+    def multi_target_search(
+        self,
+        vectors: dict[str, np.ndarray],
+        k: int = 10,
+        combination: str = "minimum",
+        weights: Optional[dict[str, float]] = None,
+        flt: Optional[Filter] = None,
+        tenant: str = "",
+    ) -> list[tuple[StorageObject, float]]:
+        """Search several named target vectors and join scores.
+
+        Reference ``explorer.go:241`` (searchForTargets) +
+        ``shard_combine_multi_target.go``: per-target searches, missing
+        distances recomputed exactly from stored vectors, then combined.
+        """
+        from weaviate_tpu.query.multi_target import combine_multi_target, np_distance
+
+        per_target: dict[str, dict] = {}
+        objs: dict[tuple[str, int], StorageObject] = {}
+        shards = self._search_shards(tenant)
+
+        for tgt, q in vectors.items():
+            dists: dict[tuple[str, int], float] = {}
+            for shard in shards:
+                allow = None
+                if flt is not None:
+                    allow = shard.allow_list(flt)
+                res = shard.vector_search(
+                    np.atleast_2d(np.asarray(q, np.float32)), k, target=tgt,
+                    allow_list=allow,
+                )
+                for d, i in zip(res.dists[0], res.ids[0]):
+                    if i >= 0:
+                        dists[(shard.name, int(i))] = float(d)
+            per_target[tgt] = dists
+
+        # union of candidates; fill distance gaps by exact recompute
+        union: set[tuple[str, int]] = set()
+        for dists in per_target.values():
+            union.update(dists.keys())
+        shard_by_name = {s.name: s for s in shards}
+        for key in union:
+            shard_name, docid = key
+            obj = shard_by_name[shard_name].get_by_docid(docid)
+            if obj is None:
+                continue
+            objs[key] = obj
+            for tgt in vectors:
+                if key not in per_target[tgt]:
+                    v = obj.named_vectors.get(tgt)
+                    if v is None and tgt == DEFAULT_VECTOR:
+                        v = obj.vector
+                    if v is None:
+                        continue
+                    cfg = (self.config.named_vectors.get(tgt)
+                           or self.config.vector_config)
+                    per_target[tgt][key] = np_distance(
+                        vectors[tgt], v, cfg.distance
+                    )
+        # drop candidates that lack a vector for some target
+        full = [key for key in union
+                if all(key in per_target[t] for t in vectors)]
+        per_target = {t: {k2: d[k2] for k2 in full} for t, d in per_target.items()}
+
+        combined = combine_multi_target(per_target, combination, weights)
+        out = []
+        for key, score in combined[:k]:
+            if key in objs:
+                out.append((objs[key], score))
+        return out
+
+    def aggregate(
+        self,
+        properties: Optional[dict[str, Optional[str]]] = None,
+        flt: Optional[Filter] = None,
+        group_by: Optional[str] = None,
+        tenant: str = "",
+        top_occurrences_limit: int = 5,
+    ) -> dict:
+        """Aggregate API (reference ``aggregator/``): meta count + per-property
+        aggregations, optionally filtered and grouped by a property.
+
+        ``properties``: {prop: kind} where kind in numeric|text|boolean|date|
+        reference|auto (None = auto-infer).
+        """
+        from weaviate_tpu.query.aggregator import aggregate_property
+
+        properties = properties or {}
+        shards = self._search_shards(tenant)
+
+        # collect (docid-scoped) values per shard under the filter mask
+        total = 0
+        prop_values: dict[str, list] = {p: [] for p in properties}
+        group_rows: dict[object, dict[str, list]] = {}
+        group_counts: dict[object, int] = {}
+
+        for shard in shards:
+            space = max(shard._next_doc_id, 1)
+            if flt is not None:
+                mask = shard.allow_list(flt, space)
+                # the inverted value maps only hold live docs, so the mask is
+                # already liveness-correct
+                doc_ids = set(int(i) for i in np.nonzero(mask)[0])
+                total += len(doc_ids)
+            else:
+                doc_ids = None  # all live docs
+                total += shard.count()
+
+            def docs_with(prop: str):
+                vals = shard.inverted.values.get(prop, {})
+                for d, v in vals.items():
+                    if doc_ids is None or d in doc_ids:
+                        yield d, v
+
+            if group_by is None:
+                for p in properties:
+                    prop_values[p].extend(v for _, v in docs_with(p))
+            else:
+                gvals = shard.inverted.values.get(group_by, {})
+                for d, gv in gvals.items():
+                    if doc_ids is not None and d not in doc_ids:
+                        continue
+                    for g in (gv if isinstance(gv, list) else [gv]):
+                        group_counts[g] = group_counts.get(g, 0) + 1
+                        row = group_rows.setdefault(
+                            g, {p: [] for p in properties}
+                        )
+                        for p in properties:
+                            v = shard.inverted.values.get(p, {}).get(d)
+                            if v is not None:
+                                row[p].append(v)
+
+        if group_by is None:
+            return {
+                "meta": {"count": total},
+                "properties": {
+                    p: aggregate_property(vals, properties[p], top_occurrences_limit)
+                    for p, vals in prop_values.items()
+                },
+            }
+        groups = []
+        for g, count in sorted(group_counts.items(), key=lambda t: -t[1]):
+            groups.append({
+                "groupedBy": {"path": [group_by], "value": g},
+                "meta": {"count": count},
+                "properties": {
+                    p: aggregate_property(vals, properties[p], top_occurrences_limit)
+                    for p, vals in group_rows[g].items()
+                },
+            })
+        return {"meta": {"count": total}, "groups": groups}
+
     def filter_search(
         self, flt: Filter, limit: int = 100, tenant: str = ""
     ) -> list[StorageObject]:
         out: list[StorageObject] = []
         for shard in self._search_shards(tenant):
             space = max(shard._next_doc_id, 1)
-            mask = shard.inverted.allow_list(flt, space)
+            mask = shard.allow_list(flt, space)
             for d in np.nonzero(mask)[0]:
                 obj = shard.get_by_docid(int(d))
                 if obj is not None:

@@ -92,9 +92,11 @@ class Shard:
         # HNSW index itself.
         batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
         live = 0
+        self._live = np.zeros(max(self._next_doc_id, 64), bool)
         for key, raw in self.objects.items():
             obj = StorageObject.from_bytes(raw)
             live += 1
+            self._mark_live(obj.doc_id)
             self.inverted.add_object(obj)
             if obj.vector is not None:
                 batches.setdefault(DEFAULT_VECTOR, ([], []))[0].append(obj.doc_id)
@@ -186,6 +188,7 @@ class Shard:
 
             batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
             for obj in final.values():
+                self._mark_live(obj.doc_id)
                 self.ids.put(obj.uuid.encode(), _DOCID.pack(obj.doc_id))
                 self.objects.put(_DOCID.pack(obj.doc_id), obj.to_bytes())
                 self.inverted.add_object(obj)
@@ -214,6 +217,7 @@ class Shard:
                 old = StorageObject.from_bytes(raw)
                 self.inverted.delete_object(old)
                 self.objects.delete(_DOCID.pack(d))
+                self._mark_live(d, False)
                 self._live_count -= 1
         arr = np.asarray(doc_ids, np.int64)
         for idx in self._vector_indexes.values():
@@ -250,6 +254,31 @@ class Shard:
 
     def count(self) -> int:
         return self._live_count
+
+    def _mark_live(self, doc_id: int, value: bool = True) -> None:
+        if doc_id >= self._live.shape[0]:
+            grown = np.zeros(max(doc_id + 1, 2 * self._live.shape[0]), bool)
+            grown[: self._live.shape[0]] = self._live
+            self._live = grown
+        self._live[doc_id] = value
+
+    def live_mask(self, space: int) -> np.ndarray:
+        """Bool mask over the docid space marking live (non-deleted) docs.
+
+        A persistent array maintained on insert/delete — a snapshot read is
+        safe against concurrent writers (same torn-read semantics the
+        reference accepts for searches racing inserts).
+        """
+        live = self._live  # snapshot: resize swaps the reference atomically
+        m = np.zeros(space, bool)
+        n = min(space, live.shape[0])
+        m[:n] = live[:n]
+        return m
+
+    def allow_list(self, flt, space: Optional[int] = None) -> np.ndarray:
+        """Filter → liveness-correct allow mask (handles Not/IsNull right)."""
+        space = space if space is not None else max(self._next_doc_id, 1)
+        return self.inverted.allow_list(flt, space) & self.live_mask(space)
 
     def vector_search(
         self,

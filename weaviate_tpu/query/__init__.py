@@ -1,0 +1,26 @@
+"""Query orchestration: hybrid fusion, sorting, grouping, aggregation.
+
+Reference: ``usecases/traverser`` (Traverser/Explorer) + ``adapters/repos/db``
+post-processing (sorter, aggregator, group-by, autocut).
+"""
+
+from weaviate_tpu.query.aggregator import aggregate_property
+from weaviate_tpu.query.autocut import autocut
+from weaviate_tpu.query.explorer import (
+    Explorer,
+    Hit,
+    HybridParams,
+    QueryParams,
+    QueryResult,
+)
+from weaviate_tpu.query.fusion import ranked_fusion, relative_score_fusion
+from weaviate_tpu.query.groupby import Group, GroupByParams, group_results
+from weaviate_tpu.query.multi_target import combine_multi_target
+from weaviate_tpu.query.sorter import sort_objects
+
+__all__ = [
+    "Explorer", "Hit", "HybridParams", "QueryParams", "QueryResult",
+    "GroupByParams", "Group", "group_results", "sort_objects", "autocut",
+    "ranked_fusion", "relative_score_fusion", "combine_multi_target",
+    "aggregate_property",
+]

@@ -1,0 +1,157 @@
+"""Explorer: the query orchestration façade.
+
+Reference: ``usecases/traverser/explorer.go:132`` (GetClass) — decides
+keyword vs vector vs hybrid vs plain-filtered, then applies groupBy, autocut,
+sort and pagination. The REST/gRPC/GraphQL layers build a ``QueryParams`` and
+call ``Explorer.get`` — the analogue of ``dto.GetParams`` flowing into the
+traverser.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.inverted.filters import Filter
+from weaviate_tpu.query.autocut import autocut as autocut_fn
+from weaviate_tpu.query.groupby import Group, GroupByParams, group_results
+from weaviate_tpu.query.sorter import sort_objects
+from weaviate_tpu.storage.objects import StorageObject
+
+
+@dataclass
+class HybridParams:
+    query: Optional[str] = None
+    vector: Optional[np.ndarray] = None
+    alpha: float = 0.75
+    fusion: str = "relativeScoreFusion"
+    properties: Optional[list[str]] = None
+
+
+@dataclass
+class QueryParams:
+    collection: str
+    tenant: str = ""
+    limit: int = 10
+    offset: int = 0
+    filters: Optional[Filter] = None
+    # vector search (single or multi target)
+    near_vector: Optional[np.ndarray] = None
+    target_vector: str = ""
+    targets: Optional[dict[str, np.ndarray]] = None  # multi-target
+    target_combination: str = "minimum"
+    target_weights: Optional[dict[str, float]] = None
+    max_distance: Optional[float] = None
+    # keyword search
+    bm25_query: Optional[str] = None
+    bm25_properties: Optional[list[str]] = None
+    # hybrid
+    hybrid: Optional[HybridParams] = None
+    # post-processing
+    sort: list[tuple[str, str]] = field(default_factory=list)
+    group_by: Optional[GroupByParams] = None
+    autocut: int = 0
+
+
+@dataclass
+class Hit:
+    object: StorageObject
+    score: Optional[float] = None  # higher is better (bm25/hybrid)
+    distance: Optional[float] = None  # lower is better (vector)
+
+
+@dataclass
+class QueryResult:
+    hits: list[Hit] = field(default_factory=list)
+    groups: Optional[list[Group]] = None
+
+
+class Explorer:
+    def __init__(self, db: DB):
+        self.db = db
+
+    def get(self, params: QueryParams) -> QueryResult:
+        col = self.db.get_collection(params.collection)
+        fetch = params.offset + params.limit
+        scored: list[tuple[StorageObject, float]] = []
+        kind = "none"
+
+        if params.hybrid is not None:
+            h = params.hybrid
+            scored = col.hybrid_search(
+                query=h.query, vector=h.vector, alpha=h.alpha, k=fetch,
+                fusion=h.fusion, properties=h.properties,
+                flt=params.filters, tenant=params.tenant,
+                target=params.target_vector,
+                max_vector_distance=params.max_distance,
+            )
+            kind = "score"
+        elif params.targets:
+            scored = col.multi_target_search(
+                params.targets, k=fetch,
+                combination=params.target_combination,
+                weights=params.target_weights,
+                flt=params.filters, tenant=params.tenant,
+            )
+            kind = "distance"
+        elif params.near_vector is not None:
+            scored = col.vector_search(
+                params.near_vector, k=fetch, target=params.target_vector,
+                flt=params.filters, tenant=params.tenant,
+                max_distance=params.max_distance,
+            )
+            kind = "distance"
+        elif params.bm25_query is not None:
+            scored = col.bm25_search(
+                params.bm25_query, k=fetch,
+                properties=params.bm25_properties,
+                flt=params.filters, tenant=params.tenant,
+            )
+            kind = "score"
+        elif params.filters is not None:
+            objs = col.filter_search(params.filters, limit=fetch,
+                                     tenant=params.tenant)
+            scored = [(o, 0.0) for o in objs]
+        else:
+            objs = col.objects_page(limit=params.limit, offset=params.offset,
+                                    tenant=params.tenant)
+            scored = [(o, 0.0) for o in objs]
+
+        # autocut applies to ranked results only (reference entities/autocut)
+        if params.autocut > 0 and kind != "none":
+            cut = autocut_fn([s for _, s in scored], params.autocut)
+            scored = scored[:cut]
+
+        # groupBy bypasses sort/pagination (reference shard_group_by.go)
+        if params.group_by is not None:
+            groups = group_results(scored, params.group_by)
+            return QueryResult(hits=[], groups=groups)
+
+        if params.sort:
+            ordered = sort_objects([o for o, _ in scored], params.sort)
+            by_id = {id(o): s for o, s in scored}
+            scored = [(o, by_id.get(id(o), 0.0)) for o in ordered]
+
+        page = scored[params.offset: params.offset + params.limit]
+        hits = [
+            Hit(object=o,
+                score=s if kind == "score" else None,
+                distance=s if kind == "distance" else None)
+            for o, s in page
+        ]
+        return QueryResult(hits=hits)
+
+    def aggregate(
+        self,
+        collection: str,
+        properties: Optional[dict[str, Optional[str]]] = None,
+        filters: Optional[Filter] = None,
+        group_by: Optional[str] = None,
+        tenant: str = "",
+    ) -> dict:
+        col = self.db.get_collection(collection)
+        return col.aggregate(properties=properties, flt=filters,
+                             group_by=group_by, tenant=tenant)

@@ -1,0 +1,63 @@
+"""Hybrid fusion algorithms.
+
+Reference: ``usecases/traverser/hybrid/hybrid_fusion.go`` — rankedFusion
+(``:22``, reciprocal-rank with a 60 offset) and relativeScoreFusion (``:93``,
+min-max normalize each branch then weighted sum). Keys are object UUIDs so
+fusion works across shards.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Hashable
+
+# the classic RRF constant used by the reference
+RANKED_FUSION_OFFSET = 60.0
+
+
+def ranked_fusion(
+    result_sets: list[list[tuple[Hashable, float]]],
+    weights: list[float],
+    k: int,
+) -> list[tuple[Hashable, float]]:
+    """Reciprocal-rank fusion: score = Σ_set weight / (60 + rank).
+
+    Each result set is [(key, score)] sorted best-first; scores themselves
+    are ignored, only ranks matter.
+    """
+    fused: dict[Hashable, float] = {}
+    for rs, w in zip(result_sets, weights):
+        for rank, (key, _score) in enumerate(rs):
+            fused[key] = fused.get(key, 0.0) + w / (RANKED_FUSION_OFFSET + rank)
+    out = sorted(fused.items(), key=lambda t: -t[1])
+    return out[:k]
+
+
+def relative_score_fusion(
+    result_sets: list[list[tuple[Hashable, float]]],
+    weights: list[float],
+    k: int,
+) -> list[tuple[Hashable, float]]:
+    """Min-max normalize each branch's scores to [0,1], then weighted sum.
+
+    Scores must be "higher is better" in every set (invert distances before
+    calling). Matches the reference's relativeScoreFusion (:93): a set with
+    a single distinct score normalizes to 1.0.
+    """
+    fused: dict[Hashable, float] = {}
+    for rs, w in zip(result_sets, weights):
+        if not rs:
+            continue
+        scores = [s for _, s in rs]
+        lo, hi = min(scores), max(scores)
+        span = hi - lo
+        for key, s in rs:
+            norm = 1.0 if span <= 0 else (s - lo) / span
+            fused[key] = fused.get(key, 0.0) + w * norm
+    out = sorted(fused.items(), key=lambda t: -t[1])
+    return out[:k]
+
+
+FUSION_ALGORITHMS = {
+    "rankedFusion": ranked_fusion,
+    "relativeScoreFusion": relative_score_fusion,
+}
