@@ -1,0 +1,240 @@
+"""REST + GraphQL API tests, driven through a live werkzeug server —
+the analogue of the reference's acceptance suites (test/acceptance)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.rest import AuthConfig, RestAPI
+from weaviate_tpu.core.db import DB
+
+
+@pytest.fixture
+def server(tmp_dbdir):
+    db = DB(tmp_dbdir)
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0, background=True)
+    base = f"http://127.0.0.1:{srv.server_port}"
+    yield base
+    api.shutdown()
+    db.close()
+
+
+def call(base, method, path, body=None, headers=None, raw=False):
+    req = urllib.request.Request(
+        base + path,
+        data=None if body is None else json.dumps(body).encode(),
+        method=method,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            data = r.read()
+            return r.status, (data if raw else
+                              (json.loads(data) if data else None))
+    except urllib.error.HTTPError as e:
+        data = e.read()
+        return e.code, (json.loads(data) if data else None)
+
+
+ARTICLE = {
+    "class": "Article",
+    "vectorizer": "none",
+    "vectorIndexType": "flat",
+    "vectorIndexConfig": {"distance": "l2-squared"},
+    "properties": [
+        {"name": "title", "dataType": ["text"]},
+        {"name": "wordCount", "dataType": ["int"]},
+    ],
+}
+
+
+def seed(base, n=20, dims=8):
+    objs = []
+    for i in range(n):
+        vec = [0.0] * dims
+        vec[i % dims] = 1.0
+        objs.append({
+            "class": "Article",
+            "id": f"00000000-0000-0000-0000-{i:012d}",
+            "properties": {"title": f"article number {i}",
+                           "wordCount": i * 100},
+            "vector": vec,
+        })
+    status, res = call(base, "POST", "/v1/batch/objects", {"objects": objs})
+    assert status == 200
+    assert all(r["result"]["status"] == "SUCCESS" for r in res)
+
+
+def test_meta_and_health(server):
+    status, meta = call(server, "GET", "/v1/meta")
+    assert status == 200 and "version" in meta and "text2vec-hash" in meta["modules"]
+    assert call(server, "GET", "/v1/.well-known/ready")[0] == 200
+    assert call(server, "GET", "/v1/.well-known/live")[0] == 200
+
+
+def test_schema_crud(server):
+    status, created = call(server, "POST", "/v1/schema", ARTICLE)
+    assert status == 200 and created["class"] == "Article"
+    status, schema = call(server, "GET", "/v1/schema")
+    assert [c["class"] for c in schema["classes"]] == ["Article"]
+    status, cls = call(server, "GET", "/v1/schema/Article")
+    assert status == 200
+    assert cls["vectorIndexType"] == "flat"
+    assert cls["properties"][0]["dataType"] == ["text"]
+    # duplicate -> 422
+    assert call(server, "POST", "/v1/schema", ARTICLE)[0] == 422
+    # add property
+    status, _ = call(server, "POST", "/v1/schema/Article/properties",
+                     {"name": "summary", "dataType": ["text"]})
+    assert status == 200
+    _, cls = call(server, "GET", "/v1/schema/Article")
+    assert any(p["name"] == "summary" for p in cls["properties"])
+    # delete
+    assert call(server, "DELETE", "/v1/schema/Article")[0] == 200
+    assert call(server, "GET", "/v1/schema/Article")[0] == 404
+
+
+def test_objects_crud_and_batch(server):
+    call(server, "POST", "/v1/schema", ARTICLE)
+    seed(server)
+    uid = "00000000-0000-0000-0000-000000000003"
+    status, obj = call(server, "GET", f"/v1/objects/Article/{uid}")
+    assert status == 200 and obj["properties"]["wordCount"] == 300
+    # HEAD exists
+    assert call(server, "HEAD", f"/v1/objects/Article/{uid}", raw=True)[0] == 204
+    # PATCH merge keeps vector + other props
+    status, obj = call(server, "PATCH", f"/v1/objects/Article/{uid}",
+                       {"properties": {"title": "patched"}})
+    assert status == 200
+    status, obj = call(server, "GET", f"/v1/objects/Article/{uid}")
+    assert obj["properties"]["title"] == "patched"
+    assert obj["properties"]["wordCount"] == 300
+    assert obj["vector"][3] == 1.0
+    # list
+    status, page = call(server, "GET", "/v1/objects?class=Article&limit=5")
+    assert status == 200 and len(page["objects"]) == 5
+    assert page["totalResults"] == 20
+    # delete single
+    assert call(server, "DELETE", f"/v1/objects/Article/{uid}", raw=True)[0] == 204
+    assert call(server, "GET", f"/v1/objects/Article/{uid}")[0] == 404
+    # batch delete by filter
+    status, res = call(server, "DELETE", "/v1/batch/objects", {
+        "match": {"class": "Article",
+                  "where": {"operator": "GreaterThanEqual",
+                            "path": ["wordCount"], "valueInt": 1500}},
+    })
+    assert status == 200 and res["results"]["successful"] == 5
+    status, page = call(server, "GET", "/v1/objects?class=Article")
+    assert page["totalResults"] == 14
+
+
+def test_graphql_get_and_aggregate(server):
+    call(server, "POST", "/v1/schema", ARTICLE)
+    seed(server)
+    q = """
+    { Get { Article(nearVector: {vector: [1,0,0,0,0,0,0,0]}, limit: 3)
+            { title _additional { id distance } } } }
+    """
+    status, res = call(server, "POST", "/v1/graphql", {"query": q})
+    assert status == 200, res
+    assert "errors" not in res, res
+    rows = res["data"]["Get"]["Article"]
+    assert len(rows) == 3
+    assert rows[0]["_additional"]["distance"] == pytest.approx(0.0)
+    assert int(rows[0]["_additional"]["id"][-2:]) % 8 == 0
+
+    q = """
+    { Get { Article(
+        bm25: {query: "article"},
+        where: {operator: LessThan, path: ["wordCount"], valueInt: 500},
+        limit: 20) { wordCount } } }
+    """
+    status, res = call(server, "POST", "/v1/graphql", {"query": q})
+    rows = res["data"]["Get"]["Article"]
+    assert rows and all(r["wordCount"] < 500 for r in rows)
+
+    q = """
+    { Aggregate { Article { meta { count } wordCount { mean min max } } } }
+    """
+    status, res = call(server, "POST", "/v1/graphql", {"query": q})
+    agg = res["data"]["Aggregate"]["Article"][0]
+    assert agg["meta"]["count"] == 20
+    assert agg["wordCount"]["min"] == 0 and agg["wordCount"]["max"] == 1900
+
+    # graphql error shape
+    status, res = call(server, "POST", "/v1/graphql", {"query": "{ Bogus }"})
+    assert status == 200 and "errors" in res
+
+
+def test_graphql_hybrid_and_sort(server):
+    call(server, "POST", "/v1/schema", ARTICLE)
+    seed(server)
+    q = """
+    { Get { Article(hybrid: {query: "article number",
+                             vector: [0,1,0,0,0,0,0,0], alpha: 0.5},
+                    limit: 5)
+            { title _additional { score } } } }
+    """
+    status, res = call(server, "POST", "/v1/graphql", {"query": q})
+    assert "errors" not in res, res
+    assert len(res["data"]["Get"]["Article"]) == 5
+
+    q = """
+    { Get { Article(sort: [{path: ["wordCount"], order: desc}], limit: 4)
+            { wordCount } } }
+    """
+    status, res = call(server, "POST", "/v1/graphql", {"query": q})
+    rows = res["data"]["Get"]["Article"]
+    counts = [r["wordCount"] for r in rows]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_tenants_api(server):
+    mt = {
+        "class": "MT",
+        "vectorizer": "none",
+        "vectorIndexType": "flat",
+        "multiTenancyConfig": {"enabled": True},
+        "properties": [{"name": "t", "dataType": ["text"]}],
+    }
+    assert call(server, "POST", "/v1/schema", mt)[0] == 200
+    status, res = call(server, "POST", "/v1/schema/MT/tenants",
+                       [{"name": "alice"}, {"name": "bob"}])
+    assert status == 200
+    status, tenants = call(server, "GET", "/v1/schema/MT/tenants")
+    assert {t["name"] for t in tenants} == {"alice", "bob"}
+    # write scoped to tenant
+    status, _ = call(server, "POST", "/v1/objects", {
+        "class": "MT", "tenant": "alice",
+        "properties": {"t": "hello"}, "vector": [1, 0],
+    })
+    assert status == 200
+    status, page = call(server, "GET", "/v1/objects?class=MT&tenant=alice")
+    assert page["totalResults"] == 1
+    # deactivate
+    status, _ = call(server, "PUT", "/v1/schema/MT/tenants",
+                     [{"name": "bob", "activityStatus": "COLD"}])
+    assert status == 200
+    _, tenants = call(server, "GET", "/v1/schema/MT/tenants")
+    assert dict((t["name"], t["activityStatus"]) for t in tenants)["bob"] == "COLD"
+
+
+def test_auth_api_keys(tmp_dbdir):
+    db = DB(tmp_dbdir)
+    api = RestAPI(db, auth=AuthConfig(api_keys={"sekrit": "admin"},
+                                      anonymous_access=False))
+    srv = api.serve(host="127.0.0.1", port=0, background=True)
+    base = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        assert call(base, "GET", "/v1/schema")[0] == 401
+        assert call(base, "GET", "/v1/schema",
+                    headers={"Authorization": "Bearer wrong"})[0] == 401
+        status, _ = call(base, "GET", "/v1/schema",
+                         headers={"Authorization": "Bearer sekrit"})
+        assert status == 200
+    finally:
+        api.shutdown()
+        db.close()

@@ -1,0 +1,9 @@
+"""API layer: REST (werkzeug) + GraphQL executor + gRPC data plane.
+
+Reference L1: ``adapters/handlers/{rest,graphql,grpc}``.
+"""
+
+from weaviate_tpu.api.graphql import GraphQLExecutor
+from weaviate_tpu.api.rest import AuthConfig, RestAPI
+
+__all__ = ["RestAPI", "AuthConfig", "GraphQLExecutor"]

@@ -1,0 +1,456 @@
+"""Minimal GraphQL parser + executor for the Weaviate query dialect.
+
+Reference: ``adapters/handlers/graphql/local/{get,aggregate}`` — the reference
+rebuilds a full graphql-go schema from the live class schema; here a compact
+recursive-descent parser handles the query-document subset Weaviate clients
+actually send:
+
+    { Get { Class(nearVector: {vector: [..]}, limit: 5)
+            { prop _additional { id distance } } } }
+    { Aggregate { Class(where: {...}) { meta { count } prop { mean } } } }
+
+and the executor maps it onto the Explorer/Collection APIs.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from weaviate_tpu.inverted.filters import Filter
+from weaviate_tpu.query import (
+    Explorer,
+    GenerateParams,
+    GroupByParams,
+    HybridParams,
+    QueryParams,
+    RerankParams,
+)
+
+# ---------------------------------------------------------------------------
+# Lexer / parser
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""\s*(?:(?P<comment>\#[^\n]*)
+          |(?P<punct>[{}()\[\]:,!])
+          |(?P<string>"(?:\\.|[^"\\])*")
+          |(?P<number>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
+          |(?P<name>[_A-Za-z][_0-9A-Za-z]*))""",
+    re.VERBOSE,
+)
+
+
+class GraphQLError(ValueError):
+    pass
+
+
+def _tokenize(src: str) -> list[tuple[str, str]]:
+    # comments are a token kind (skipped below) so '#' inside string
+    # literals survives
+    out = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            if src[pos:].strip() == "":
+                break
+            raise GraphQLError(f"lex error at {src[pos:pos+20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind != "comment":
+            out.append((kind, m.group(kind)))
+    return out
+
+
+@dataclass
+class Field:
+    name: str
+    args: dict[str, Any] = field(default_factory=dict)
+    selections: list["Field"] = field(default_factory=list)
+
+
+class _Parser:
+    def __init__(self, tokens: list[tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else ("eof", "")
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expect(self, value: str):
+        kind, v = self.next()
+        if v != value:
+            raise GraphQLError(f"expected {value!r}, got {v!r}")
+
+    def parse_document(self) -> list[Field]:
+        # optional 'query [Name]' prelude
+        if self.peek() == ("name", "query"):
+            self.next()
+            if self.peek()[0] == "name":
+                self.next()
+        self.expect("{")
+        fields = []
+        while self.peek()[1] != "}":
+            fields.append(self.parse_field())
+        self.expect("}")
+        return fields
+
+    def parse_field(self) -> Field:
+        kind, name = self.next()
+        if kind != "name":
+            raise GraphQLError(f"expected field name, got {name!r}")
+        f = Field(name)
+        if self.peek()[1] == "(":
+            self.next()
+            while self.peek()[1] != ")":
+                kind, argname = self.next()
+                self.expect(":")
+                f.args[argname] = self.parse_value()
+                if self.peek()[1] == ",":
+                    self.next()
+            self.expect(")")
+        if self.peek()[1] == "{":
+            self.next()
+            while self.peek()[1] != "}":
+                f.selections.append(self.parse_field())
+            self.expect("}")
+        return f
+
+    def parse_value(self) -> Any:
+        kind, v = self.next()
+        if kind == "string":
+            # GraphQL string escapes are JSON-compatible; json.loads keeps
+            # non-ASCII text intact (unicode_escape would mojibake it)
+            import json as _json
+
+            try:
+                return _json.loads(v)
+            except _json.JSONDecodeError:
+                return v[1:-1]
+        if kind == "number":
+            return float(v) if ("." in v or "e" in v or "E" in v) else int(v)
+        if kind == "name":
+            if v == "true":
+                return True
+            if v == "false":
+                return False
+            if v == "null":
+                return None
+            return v  # enum (e.g. operator Equal, order asc)
+        if v == "[":
+            out = []
+            while self.peek()[1] != "]":
+                out.append(self.parse_value())
+                if self.peek()[1] == ",":
+                    self.next()
+            self.next()
+            return out
+        if v == "{":
+            out = {}
+            while self.peek()[1] != "}":
+                k, key = self.next()
+                self.expect(":")
+                out[key] = self.parse_value()
+                if self.peek()[1] == ",":
+                    self.next()
+            self.next()
+            return out
+        raise GraphQLError(f"unexpected value token {v!r}")
+
+
+def parse(src: str) -> list[Field]:
+    return _Parser(_tokenize(src)).parse_document()
+
+
+# ---------------------------------------------------------------------------
+# Filter (where) translation
+# ---------------------------------------------------------------------------
+
+_VALUE_KEYS = (
+    "valueText", "valueString", "valueInt", "valueNumber", "valueBoolean",
+    "valueDate", "valueTextArray", "valueStringArray", "valueIntArray",
+    "valueNumberArray", "valueBooleanArray", "valueGeoRange",
+)
+
+
+def where_to_filter(w: dict) -> Filter:
+    """Translate a GraphQL/REST where tree into the internal Filter AST
+    (reference ``entities/filters`` ← ``adapters/handlers/graphql`` where)."""
+    op = w.get("operator")
+    if op is None:
+        raise GraphQLError("where: operator required")
+    if op in ("And", "Or", "Not"):
+        return Filter(op, operands=[where_to_filter(o)
+                                    for o in w.get("operands", [])])
+    path = w.get("path")
+    if isinstance(path, str):
+        path = [path]
+    value: Any = None
+    for k in _VALUE_KEYS:
+        if k in w:
+            value = w[k]
+            break
+    if op == "IsNull":
+        value = bool(value)
+    if op == "WithinGeoRange" and isinstance(value, dict):
+        geo = value.get("geoCoordinates", value)
+        value = {
+            "latitude": geo.get("latitude"),
+            "longitude": geo.get("longitude"),
+            "distance": value.get("distance", {}).get("max")
+            if isinstance(value.get("distance"), dict) else value.get("distance"),
+        }
+    return Filter(op, path=path, value=value)
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+
+class GraphQLExecutor:
+    def __init__(self, db):
+        self.db = db
+        self.explorer = Explorer(db)
+
+    def execute(self, query: str) -> dict:
+        try:
+            roots = parse(query)
+            data: dict = {}
+            for root in roots:
+                if root.name == "Get":
+                    data.setdefault("Get", {}).update(self._get(root))
+                elif root.name == "Aggregate":
+                    data.setdefault("Aggregate", {}).update(self._aggregate(root))
+                elif root.name == "Explore":
+                    raise GraphQLError("Explore: not supported yet")
+                else:
+                    raise GraphQLError(f"unknown root field {root.name!r}")
+            return {"data": data}
+        except (GraphQLError, KeyError, ValueError, TypeError) as e:
+            return {"errors": [{"message": str(e)}]}
+
+    # -- Get ---------------------------------------------------------------
+    def _get(self, root: Field) -> dict:
+        out = {}
+        for cls in root.selections:
+            out[cls.name] = self._get_class(cls)
+        return out
+
+    def _params_from_args(self, class_name: str, args: dict) -> QueryParams:
+        p = QueryParams(collection=class_name)
+        p.limit = int(args.get("limit", 10) or 10)
+        p.offset = int(args.get("offset", 0) or 0)
+        p.tenant = args.get("tenant", "") or ""
+        p.autocut = int(args.get("autocut", 0) or 0)
+        if "where" in args:
+            p.filters = where_to_filter(args["where"])
+        if "nearVector" in args:
+            nv = args["nearVector"]
+            p.near_vector = np.asarray(nv["vector"], np.float32)
+            if "distance" in nv:
+                p.max_distance = float(nv["distance"])
+            elif "certainty" in nv:
+                p.max_distance = 2.0 * (1.0 - float(nv["certainty"]))
+            if "targetVectors" in nv and nv["targetVectors"]:
+                p.target_vector = nv["targetVectors"][0]
+        if "nearText" in args:
+            nt = args["nearText"]
+            concepts = nt.get("concepts", [])
+            p.near_text = " ".join(concepts) if isinstance(concepts, list) else str(concepts)
+            if "distance" in nt:
+                p.max_distance = float(nt["distance"])
+            elif "certainty" in nt:
+                p.max_distance = 2.0 * (1.0 - float(nt["certainty"]))
+            if "targetVectors" in nt and nt["targetVectors"]:
+                p.target_vector = nt["targetVectors"][0]
+        if "nearObject" in args:
+            no = args["nearObject"]
+            obj = self.db.get_collection(class_name).get(no["id"], tenant=p.tenant)
+            if obj is None or obj.vector is None:
+                raise GraphQLError(f"nearObject: {no.get('id')!r} not found or has no vector")
+            p.near_vector = obj.vector
+        if "bm25" in args:
+            p.bm25_query = args["bm25"].get("query", "")
+            p.bm25_properties = args["bm25"].get("properties")
+        if "hybrid" in args:
+            h = args["hybrid"]
+            p.hybrid = HybridParams(
+                query=h.get("query"),
+                vector=np.asarray(h["vector"], np.float32) if "vector" in h else None,
+                alpha=float(h.get("alpha", 0.75)),
+                fusion="rankedFusion"
+                if h.get("fusionType") == "rankedFusion" else "relativeScoreFusion",
+                properties=h.get("properties"),
+            )
+        if "sort" in args:
+            s = args["sort"]
+            entries = s if isinstance(s, list) else [s]
+            p.sort = [
+                ( (e.get("path")[0] if isinstance(e.get("path"), list) else e.get("path")),
+                  e.get("order", "asc"))
+                for e in entries
+            ]
+        if "groupBy" in args:
+            g = args["groupBy"]
+            path = g.get("path")
+            p.group_by = GroupByParams(
+                property=path[0] if isinstance(path, list) else path,
+                groups=int(g.get("groups", 5)),
+                objects_per_group=int(g.get("objectsPerGroup", 10)),
+            )
+        return p
+
+    def _get_class(self, cls: Field) -> list[dict]:
+        params = self._params_from_args(cls.name, cls.args)
+
+        # _additional { generate(...) rerank(...) } argument plumbing
+        for sel in cls.selections:
+            if sel.name == "_additional":
+                for sub in sel.selections:
+                    if sub.name == "generate":
+                        params.generate = GenerateParams(
+                            single_prompt=sub.args.get("singleResult", {}).get("prompt")
+                            if isinstance(sub.args.get("singleResult"), dict) else None,
+                            grouped_task=sub.args.get("groupedResult", {}).get("task")
+                            if isinstance(sub.args.get("groupedResult"), dict) else None,
+                        )
+                    elif sub.name == "rerank":
+                        params.rerank = RerankParams(
+                            query=sub.args.get("query", ""),
+                            property=sub.args.get("property", ""),
+                        )
+
+        result = self.explorer.get(params)
+
+        if result.groups is not None:
+            # grouped hits are flattened with group info in _additional,
+            # like the reference's groupBy response shape
+            rows = []
+            for g in result.groups:
+                for obj, score in g.objects:
+                    rows.append(self._render_object(
+                        cls.selections, obj, None, None,
+                        extra={"group": {"groupValue": g.value}},
+                    ))
+            return rows
+
+        rows = []
+        for i, hit in enumerate(result.hits):
+            extra = dict(hit.additional)
+            if result.generated is not None and i == 0:
+                extra["generate_grouped"] = result.generated
+            rows.append(self._render_object(
+                cls.selections, hit.object, hit.score, hit.distance,
+                extra=extra,
+            ))
+        return rows
+
+    def _render_object(self, selections, obj, score, distance, extra=None) -> dict:
+        row: dict = {}
+        for sel in selections:
+            if sel.name == "_additional":
+                add: dict = {}
+                for sub in sel.selections:
+                    if sub.name == "id":
+                        add["id"] = obj.uuid
+                    elif sub.name == "vector":
+                        add["vector"] = (
+                            obj.vector.tolist() if obj.vector is not None else None
+                        )
+                    elif sub.name == "distance":
+                        add["distance"] = distance
+                    elif sub.name == "certainty":
+                        add["certainty"] = (
+                            None if distance is None else 1.0 - distance / 2.0
+                        )
+                    elif sub.name == "score":
+                        add["score"] = score
+                    elif sub.name == "creationTimeUnix":
+                        add["creationTimeUnix"] = obj.creation_time_ms
+                    elif sub.name == "lastUpdateTimeUnix":
+                        add["lastUpdateTimeUnix"] = obj.update_time_ms
+                    elif sub.name == "generate" and extra and (
+                            "generate" in extra or "generate_grouped" in extra):
+                        add["generate"] = {}
+                        if "generate" in extra:
+                            add["generate"]["singleResult"] = extra["generate"]
+                        if "generate_grouped" in extra:
+                            add["generate"]["groupedResult"] = extra["generate_grouped"]
+                    elif sub.name == "rerank" and extra and "rerank_score" in extra:
+                        add["rerank"] = [{"score": extra["rerank_score"]}]
+                    elif sub.name == "group" and extra and "group" in extra:
+                        add["group"] = extra["group"]
+                row["_additional"] = add
+            else:
+                row[sel.name] = obj.properties.get(sel.name)
+        return row
+
+    # -- Aggregate ----------------------------------------------------------
+    def _aggregate(self, root: Field) -> dict:
+        out = {}
+        for cls in root.selections:
+            flt = (where_to_filter(cls.args["where"])
+                   if "where" in cls.args else None)
+            group_by = None
+            if "groupBy" in cls.args:
+                g = cls.args["groupBy"]
+                path = g if isinstance(g, list) else g.get("path", g)
+                group_by = path[0] if isinstance(path, list) else path
+            tenant = cls.args.get("tenant", "") or ""
+
+            want_meta = False
+            props: dict[str, Optional[str]] = {}
+            prop_fields: dict[str, list[Field]] = {}
+            for sel in cls.selections:
+                if sel.name == "meta":
+                    want_meta = True
+                elif sel.name == "groupedBy":
+                    continue
+                else:
+                    props[sel.name] = None
+                    prop_fields[sel.name] = sel.selections
+
+            col = self.db.get_collection(cls.name)
+            agg = col.aggregate(props, flt=flt, group_by=group_by,
+                                tenant=tenant)
+
+            def render_entry(meta_count, properties) -> dict:
+                entry: dict = {}
+                if want_meta:
+                    entry["meta"] = {"count": meta_count}
+                for pname, pfields in prop_fields.items():
+                    pagg = properties.get(pname, {})
+                    rendered: dict = {}
+                    for pf in pfields:
+                        if pf.name == "topOccurrences":
+                            rendered["topOccurrences"] = pagg.get(
+                                "topOccurrences", [])
+                        elif pf.name in pagg:
+                            rendered[pf.name] = pagg[pf.name]
+                    entry[pname] = rendered
+                return entry
+
+            if group_by is None:
+                out[cls.name] = [render_entry(
+                    agg["meta"]["count"], agg.get("properties", {}))]
+            else:
+                rows = []
+                for g in agg["groups"]:
+                    row = render_entry(g["meta"]["count"], g["properties"])
+                    row["groupedBy"] = {
+                        "path": g["groupedBy"]["path"],
+                        "value": g["groupedBy"]["value"],
+                    }
+                    rows.append(row)
+                out[cls.name] = rows
+        return out

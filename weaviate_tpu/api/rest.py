@@ -1,0 +1,397 @@
+"""REST API server (werkzeug WSGI), mirroring the reference's endpoint map.
+
+Reference: ``adapters/handlers/rest/`` (go-swagger) — ``/v1/schema``,
+``/v1/objects``, ``/v1/batch/*``, ``/v1/graphql``, ``/v1/nodes``,
+``/v1/meta``, ``/v1/.well-known/*`` (``configure_api.go``, ``handlers_*.go``).
+Wire shapes follow the reference's swagger models so its clients work
+unchanged; go-swagger codegen is replaced by explicit werkzeug routing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Optional
+
+import numpy as np
+from werkzeug.exceptions import HTTPException
+from werkzeug.routing import Map, Rule
+from werkzeug.serving import make_server
+from werkzeug.wrappers import Request, Response
+
+from weaviate_tpu.api.graphql import GraphQLExecutor, where_to_filter
+from weaviate_tpu.api.schema_translate import class_from_rest, class_to_rest
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.storage.objects import StorageObject
+from weaviate_tpu.version import __version__
+
+
+class AuthConfig:
+    """API-key authentication (reference ``usecases/auth/authentication/apikey``).
+
+    ``api_keys``: {key: user}; ``anonymous_access``: allow unauthenticated
+    requests (reference AUTHENTICATION_ANONYMOUS_ACCESS_ENABLED).
+    """
+
+    def __init__(self, api_keys: Optional[dict[str, str]] = None,
+                 anonymous_access: bool = True):
+        self.api_keys = api_keys or {}
+        self.anonymous_access = anonymous_access
+
+    def authenticate(self, request: Request) -> Optional[str]:
+        """Returns principal name, or None when anonymous. Raises 401."""
+        header = request.headers.get("Authorization", "")
+        if header.startswith("Bearer "):
+            key = header[len("Bearer "):].strip()
+            user = self.api_keys.get(key)
+            if user is None:
+                _abort(401, "invalid api key")
+            return user
+        if self.anonymous_access:
+            return None
+        _abort(401, "anonymous access disabled: provide Authorization: Bearer <key>")
+
+
+class _ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        self.status = status
+        self.message = message
+
+
+def _abort(status: int, message: str):
+    raise _ApiError(status, message)
+
+
+def _json_response(data: Any, status: int = 200) -> Response:
+    return Response(json.dumps(data), status=status,
+                    content_type="application/json")
+
+
+def _obj_to_rest(obj: StorageObject, include_vector: bool = True) -> dict:
+    out = {
+        "class": obj.collection,
+        "id": obj.uuid,
+        "properties": obj.properties,
+        "creationTimeUnix": obj.creation_time_ms,
+        "lastUpdateTimeUnix": obj.update_time_ms,
+    }
+    if obj.tenant:
+        out["tenant"] = obj.tenant
+    if include_vector and obj.vector is not None:
+        out["vector"] = np.asarray(obj.vector).tolist()
+    if obj.named_vectors:
+        out["vectors"] = {k: np.asarray(v).tolist()
+                          for k, v in obj.named_vectors.items()}
+    return out
+
+
+def _obj_from_rest(d: dict) -> StorageObject:
+    vec = d.get("vector")
+    return StorageObject(
+        uuid=d.get("id", ""),
+        collection=d.get("class", ""),
+        properties=d.get("properties", {}) or {},
+        vector=None if vec is None else np.asarray(vec, np.float32),
+        named_vectors={
+            k: np.asarray(v, np.float32)
+            for k, v in (d.get("vectors") or {}).items()
+        },
+        tenant=d.get("tenant", ""),
+    )
+
+
+class RestAPI:
+    def __init__(self, db: DB, auth: Optional[AuthConfig] = None):
+        self.db = db
+        self.auth = auth or AuthConfig()
+        self.graphql = GraphQLExecutor(db)
+        self.url_map = Map([
+            Rule("/v1/meta", endpoint="meta", methods=["GET"]),
+            Rule("/v1/.well-known/ready", endpoint="ready", methods=["GET"]),
+            Rule("/v1/.well-known/live", endpoint="live", methods=["GET"]),
+            Rule("/v1/schema", endpoint="schema", methods=["GET", "POST"]),
+            Rule("/v1/schema/<cls>", endpoint="schema_class",
+                 methods=["GET", "DELETE"]),
+            Rule("/v1/schema/<cls>/properties", endpoint="schema_properties",
+                 methods=["POST"]),
+            Rule("/v1/schema/<cls>/tenants", endpoint="tenants",
+                 methods=["GET", "POST", "PUT", "DELETE"]),
+            Rule("/v1/objects", endpoint="objects", methods=["GET", "POST"]),
+            Rule("/v1/objects/<cls>/<uuid>", endpoint="object",
+                 methods=["GET", "PUT", "PATCH", "DELETE", "HEAD"]),
+            Rule("/v1/batch/objects", endpoint="batch_objects",
+                 methods=["POST", "DELETE"]),
+            Rule("/v1/graphql", endpoint="graphql", methods=["POST"]),
+            Rule("/v1/nodes", endpoint="nodes", methods=["GET"]),
+        ])
+        self._server = None
+        self._thread = None
+
+    # -- WSGI --------------------------------------------------------------
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        try:
+            adapter = self.url_map.bind_to_environ(environ)
+            endpoint, args = adapter.match()
+            self.auth.authenticate(request)
+            handler = getattr(self, f"on_{endpoint}")
+            response = handler(request, **args)
+        except _ApiError as e:
+            response = _json_response(
+                {"error": [{"message": e.message}]}, e.status)
+        except HTTPException as e:
+            response = _json_response(
+                {"error": [{"message": e.description}]},
+                e.code or 500)
+        except (KeyError, ValueError, TypeError) as e:
+            response = _json_response(
+                {"error": [{"message": str(e)}]}, 422)
+        return response(environ, start_response)
+
+    def _body(self, request: Request) -> dict:
+        try:
+            return json.loads(request.get_data(as_text=True) or "{}")
+        except json.JSONDecodeError as e:
+            _abort(400, f"invalid json: {e}")
+
+    # -- meta / health -----------------------------------------------------
+    def on_meta(self, request):
+        return _json_response({
+            "hostname": request.host,
+            "version": __version__,
+            "modules": self.db.modules.list() if self.db.modules else {},
+        })
+
+    def on_ready(self, request):
+        return Response(status=200)
+
+    def on_live(self, request):
+        return Response(status=200)
+
+    # -- schema ------------------------------------------------------------
+    def on_schema(self, request):
+        if request.method == "GET":
+            return _json_response({"classes": [
+                class_to_rest(self.db.get_collection(n).config)
+                for n in self.db.collections()
+            ]})
+        body = self._body(request)
+        cfg = class_from_rest(body)
+        try:
+            self.db.create_collection(cfg)
+        except ValueError as e:
+            _abort(422, str(e))
+        return _json_response(class_to_rest(cfg))
+
+    def on_schema_class(self, request, cls):
+        if request.method == "GET":
+            if not self.db.has_collection(cls):
+                _abort(404, f"class {cls!r} not found")
+            return _json_response(
+                class_to_rest(self.db.get_collection(cls).config))
+        self.db.delete_collection(cls)
+        return Response(status=200)
+
+    def on_schema_properties(self, request, cls):
+        from weaviate_tpu.schema.config import DataType, Property
+
+        body = self._body(request)
+        dt = body.get("dataType", ["text"])
+        dt0 = dt[0] if isinstance(dt, list) else dt
+        try:
+            data_type = DataType(dt0)
+        except ValueError:
+            data_type = DataType.REFERENCE if dt0 and dt0[0].isupper() else DataType.TEXT
+        prop = Property(name=body["name"], data_type=data_type)
+        try:
+            self.db.add_property(cls, prop)
+        except (KeyError, ValueError) as e:
+            _abort(422, str(e))
+        return _json_response(body)
+
+    def on_tenants(self, request, cls):
+        col = self.db.get_collection(cls)
+        if request.method == "GET":
+            return _json_response([
+                {"name": n, "activityStatus": s}
+                for n, s in sorted(col.tenants().items())
+            ])
+        body = self._body(request)
+        tenants = body if isinstance(body, list) else [body]
+        if request.method == "POST":
+            for t in tenants:
+                col.add_tenant(t["name"], t.get("activityStatus", "HOT"))
+        elif request.method == "PUT":
+            for t in tenants:
+                col.set_tenant_status(t["name"], t["activityStatus"])
+        else:  # DELETE
+            for t in tenants:
+                name = t if isinstance(t, str) else t["name"]
+                col.remove_tenant(name)
+        return _json_response(tenants)
+
+    # -- objects -----------------------------------------------------------
+    def on_objects(self, request):
+        if request.method == "POST":
+            body = self._body(request)
+            obj = _obj_from_rest(body)
+            if not obj.collection:
+                _abort(422, "class required")
+            col = self.db.get_collection(obj.collection)
+            col.put(obj, tenant=obj.tenant)
+            return _json_response(_obj_to_rest(obj))
+        cls = request.args.get("class")
+        if not cls:
+            _abort(422, "class query param required")
+        col = self.db.get_collection(cls)
+        limit = int(request.args.get("limit", 25))
+        offset = int(request.args.get("offset", 0))
+        tenant = request.args.get("tenant", "")
+        objs = col.objects_page(limit=limit, offset=offset, tenant=tenant)
+        return _json_response({
+            "objects": [_obj_to_rest(o) for o in objs],
+            "totalResults": col.count(tenant=tenant),
+        })
+
+    def on_object(self, request, cls, uuid):
+        col = self.db.get_collection(cls)
+        tenant = request.args.get("tenant", "")
+        if request.method == "HEAD":
+            return Response(status=204 if col.exists(uuid, tenant) else 404)
+        if request.method == "GET":
+            obj = col.get(uuid, tenant)
+            if obj is None:
+                _abort(404, f"object {uuid} not found")
+            return _json_response(_obj_to_rest(obj))
+        if request.method == "DELETE":
+            n = col.delete([uuid], tenant)
+            return Response(status=204 if n else 404)
+        body = self._body(request)
+        existing = col.get(uuid, tenant)
+        if request.method == "PATCH":  # merge
+            if existing is None:
+                _abort(404, f"object {uuid} not found")
+            merged = dict(existing.properties)
+            merged.update(body.get("properties", {}) or {})
+            body = {**body, "properties": merged}
+            if "vector" not in body and existing.vector is not None:
+                body["vector"] = existing.vector.tolist()
+            if "vectors" not in body and existing.named_vectors:
+                body["vectors"] = {k: np.asarray(v).tolist()
+                                   for k, v in existing.named_vectors.items()}
+        body["id"] = uuid
+        body.setdefault("class", cls)
+        obj = _obj_from_rest(body)
+        obj.tenant = tenant or obj.tenant
+        col.put(obj, tenant=obj.tenant)
+        return _json_response(_obj_to_rest(obj))
+
+    # -- batch -------------------------------------------------------------
+    def on_batch_objects(self, request):
+        body = self._body(request)
+        if request.method == "DELETE":
+            # reference batch_delete.go: {match: {class, where}, output, dryRun}
+            match = body.get("match", {})
+            cls = match.get("class")
+            if not cls:
+                _abort(422, "match.class required")
+            col = self.db.get_collection(cls)
+            flt = where_to_filter(match.get("where", {}))
+            tenant = body.get("tenant", "") or request.args.get("tenant", "")
+            if body.get("dryRun"):
+                shards = col._search_shards(tenant)
+                matches = sum(
+                    int(s.allow_list(flt).sum()) for s in shards)
+                deleted = 0
+            else:
+                matches = deleted = col.delete_where(flt, tenant=tenant)
+            return _json_response({
+                "match": match,
+                "results": {"matches": matches, "successful": deleted,
+                            "failed": 0},
+            })
+        objs_json = body.get("objects", body if isinstance(body, list) else [])
+        results = []
+        by_class: dict[str, list[StorageObject]] = {}
+        parsed: list[tuple[int, StorageObject]] = []
+        for i, oj in enumerate(objs_json):
+            obj = _obj_from_rest(oj)
+            parsed.append((i, obj))
+            by_class.setdefault(obj.collection, []).append(obj)
+        errors: dict[int, str] = {}
+        for cls, group in by_class.items():
+            try:
+                col = self.db.get_collection(cls)
+            except KeyError as e:
+                for i, o in parsed:
+                    if o.collection == cls:
+                        errors[i] = str(e)
+                continue
+            # objects in one class may span tenants; a failing tenant group
+            # only marks its own objects FAILED (earlier groups persisted)
+            by_tenant: dict[str, list[StorageObject]] = {}
+            for o in group:
+                by_tenant.setdefault(o.tenant, []).append(o)
+            for tenant, tgroup in by_tenant.items():
+                try:
+                    col.put_batch(tgroup, tenant=tenant)
+                except (KeyError, ValueError, RuntimeError) as e:
+                    failed_ids = {id(o) for o in tgroup}
+                    for i, o in parsed:
+                        if id(o) in failed_ids:
+                            errors[i] = str(e)
+        for i, obj in parsed:
+            if i in errors:
+                results.append({
+                    "result": {"status": "FAILED",
+                               "errors": {"error": [{"message": errors[i]}]}},
+                    "id": obj.uuid,
+                })
+            else:
+                results.append({**_obj_to_rest(obj, include_vector=False),
+                                "result": {"status": "SUCCESS"}})
+        return _json_response(results)
+
+    # -- graphql -----------------------------------------------------------
+    def on_graphql(self, request):
+        body = self._body(request)
+        query = body.get("query", "")
+        return _json_response(self.graphql.execute(query))
+
+    # -- nodes -------------------------------------------------------------
+    def on_nodes(self, request):
+        shards = []
+        total = 0
+        for name in self.db.collections():
+            col = self.db.get_collection(name)
+            for sname, s in col._shards.items():
+                shards.append({
+                    "name": sname, "class": name,
+                    "objectCount": s.count(),
+                })
+                total += s.count()
+        return _json_response({"nodes": [{
+            "name": "node-0",
+            "status": "HEALTHY",
+            "version": __version__,
+            "stats": {"objectCount": total, "shardCount": len(shards)},
+            "shards": shards,
+        }]})
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve(self, host: str = "127.0.0.1", port: int = 8080,
+              background: bool = True):
+        self._server = make_server(host, port, self, threaded=True)
+        if background:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self._server.serve_forever()
+        return self._server
+
+    def shutdown(self):
+        if self._server is not None:
+            self._server.shutdown()
+            if self._thread is not None:
+                self._thread.join(timeout=5)

@@ -1,0 +1,221 @@
+"""REST schema wire format ↔ internal CollectionConfig.
+
+The wire shape follows the reference's swagger models
+(``entities/models/class.go``: ``class``, ``properties[].dataType: [..]``,
+``vectorIndexType``, ``vectorIndexConfig``, ``multiTenancyConfig`` …) so
+clients of the reference can talk to this server unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    DataType,
+    InvertedIndexConfig,
+    MultiTenancyConfig,
+    Property,
+    QuantizerConfig,
+    ReplicationConfig,
+    ShardingConfig,
+    Tokenization,
+    VectorIndexConfig,
+    quantizer_from_dict,
+)
+
+_DISTANCE_MAP = {
+    "cosine": "cosine",
+    "dot": "dot",
+    "l2-squared": "l2-squared",
+    "manhattan": "manhattan",
+    "hamming": "hamming",
+}
+
+
+def _quantizer_from_rest(cfg: dict) -> Optional[dict]:
+    """Reference vectorIndexConfig carries pq/sq/bq/rq sub-objects."""
+    for kind in ("pq", "sq", "bq", "rq"):
+        sub = cfg.get(kind)
+        if isinstance(sub, dict) and sub.get("enabled"):
+            d = {"enabled": True, "kind": kind}
+            if "segments" in sub:
+                d["segments"] = sub["segments"]
+            if "centroids" in sub:
+                d["centroids"] = sub["centroids"]
+            if "trainingLimit" in sub:
+                d["training_limit"] = sub["trainingLimit"]
+            if "rescoreLimit" in sub:
+                d["rescore_limit"] = sub["rescoreLimit"]
+            return d
+    return None
+
+
+def _vector_index_from_rest(index_type: str, cfg: dict) -> VectorIndexConfig:
+    d: dict[str, Any] = {"index_type": index_type or "hnsw"}
+    d["distance"] = _DISTANCE_MAP.get(cfg.get("distance", "cosine"), "cosine")
+    if "maxConnections" in cfg:
+        d["max_connections"] = cfg["maxConnections"]
+    if "efConstruction" in cfg:
+        d["ef_construction"] = cfg["efConstruction"]
+    if "ef" in cfg:
+        d["ef"] = cfg["ef"]
+    if "dynamicEfMin" in cfg:
+        d["dynamic_ef_min"] = cfg["dynamicEfMin"]
+    if "dynamicEfMax" in cfg:
+        d["dynamic_ef_max"] = cfg["dynamicEfMax"]
+    if "dynamicEfFactor" in cfg:
+        d["dynamic_ef_factor"] = cfg["dynamicEfFactor"]
+    if "flatSearchCutoff" in cfg:
+        d["flat_search_cutoff"] = cfg["flatSearchCutoff"]
+    if "threshold" in cfg:  # dynamic index upgrade threshold
+        d["threshold"] = cfg["threshold"]
+    q = _quantizer_from_rest(cfg)
+    if q:
+        d["quantizer"] = q
+    return VectorIndexConfig.from_dict(d)
+
+
+def class_from_rest(d: dict) -> CollectionConfig:
+    """Weaviate-style class JSON → CollectionConfig. Also accepts the
+    internal ``to_dict`` shape (round-trip)."""
+    if "name" in d and "class" not in d:
+        return CollectionConfig.from_dict(d)
+
+    props = []
+    for p in d.get("properties", []) or []:
+        dt = p.get("dataType", ["text"])
+        dt0 = dt[0] if isinstance(dt, list) else dt
+        try:
+            data_type = DataType(dt0)
+        except ValueError:
+            # cross-references are typed by class name in the reference
+            data_type = DataType.REFERENCE if dt0 and dt0[0].isupper() else DataType.TEXT
+        tok = p.get("tokenization", "word")
+        try:
+            tokenization = Tokenization(tok)
+        except ValueError:
+            tokenization = Tokenization.WORD
+        props.append(Property(
+            name=p["name"],
+            data_type=data_type,
+            tokenization=tokenization,
+            index_filterable=p.get("indexFilterable", True),
+            index_searchable=p.get(
+                "indexSearchable",
+                data_type in (DataType.TEXT, DataType.TEXT_ARRAY),
+            ),
+            description=p.get("description", ""),
+        ))
+
+    vic = d.get("vectorIndexConfig", {}) or {}
+    vec_cfg = _vector_index_from_rest(d.get("vectorIndexType", "hnsw"), vic)
+
+    named = {}
+    for name, vc in (d.get("vectorConfig") or {}).items():
+        named[name] = _vector_index_from_rest(
+            vc.get("vectorIndexType", "hnsw"),
+            vc.get("vectorIndexConfig", {}) or {},
+        )
+
+    inv = d.get("invertedIndexConfig", {}) or {}
+    bm25 = inv.get("bm25", {}) or {}
+    mt = d.get("multiTenancyConfig", {}) or {}
+    repl = d.get("replicationConfig", {}) or {}
+    shard = d.get("shardingConfig", {}) or {}
+
+    return CollectionConfig(
+        name=d["class"],
+        properties=props,
+        vector_config=vec_cfg,
+        named_vectors=named,
+        inverted_config=InvertedIndexConfig(
+            bm25_k1=bm25.get("k1", 1.2),
+            bm25_b=bm25.get("b", 0.75),
+            stopwords_preset=(inv.get("stopwords", {}) or {}).get("preset", "en"),
+            index_timestamps=inv.get("indexTimestamps", False),
+            index_null_state=inv.get("indexNullState", False),
+            index_property_length=inv.get("indexPropertyLength", False),
+        ),
+        multi_tenancy=MultiTenancyConfig(
+            enabled=mt.get("enabled", False),
+            auto_tenant_creation=mt.get("autoTenantCreation", False),
+            auto_tenant_activation=mt.get("autoTenantActivation", False),
+        ),
+        replication=ReplicationConfig(
+            factor=repl.get("factor", 1),
+            async_enabled=repl.get("asyncEnabled", False),
+        ),
+        sharding=ShardingConfig(
+            desired_count=shard.get("desiredCount", 1),
+            virtual_per_physical=shard.get("virtualPerPhysical", 128),
+        ),
+        vectorizer=d.get("vectorizer", "none"),
+        description=d.get("description", ""),
+    )
+
+
+def class_to_rest(cfg: CollectionConfig) -> dict:
+    """CollectionConfig → Weaviate-style class JSON."""
+    vic: dict[str, Any] = {"distance": cfg.vector_config.distance}
+    vd = cfg.vector_config.to_dict()
+    for src, dst in (
+        ("max_connections", "maxConnections"),
+        ("ef_construction", "efConstruction"),
+        ("ef", "ef"),
+        ("dynamic_ef_min", "dynamicEfMin"),
+        ("dynamic_ef_max", "dynamicEfMax"),
+        ("dynamic_ef_factor", "dynamicEfFactor"),
+        ("flat_search_cutoff", "flatSearchCutoff"),
+        ("threshold", "threshold"),
+    ):
+        if src in vd:
+            vic[dst] = vd[src]
+    if cfg.vector_config.quantizer is not None:
+        qd = cfg.vector_config.quantizer.to_dict()
+        vic[qd.pop("kind")] = {"enabled": True, **{
+            {"training_limit": "trainingLimit",
+             "rescore_limit": "rescoreLimit"}.get(k, k): v
+            for k, v in qd.items() if k != "enabled"
+        }}
+
+    props = []
+    for p in cfg.properties:
+        props.append({
+            "name": p.name,
+            "dataType": [p.data_type.value],
+            "tokenization": p.tokenization.value,
+            "indexFilterable": p.index_filterable,
+            "indexSearchable": p.index_searchable,
+            "description": p.description,
+        })
+
+    out = {
+        "class": cfg.name,
+        "description": cfg.description,
+        "properties": props,
+        "vectorizer": cfg.vectorizer,
+        "vectorIndexType": cfg.vector_config.index_type,
+        "vectorIndexConfig": vic,
+        "invertedIndexConfig": {
+            "bm25": {"k1": cfg.inverted_config.bm25_k1,
+                     "b": cfg.inverted_config.bm25_b},
+            "stopwords": {"preset": cfg.inverted_config.stopwords_preset},
+        },
+        "multiTenancyConfig": {
+            "enabled": cfg.multi_tenancy.enabled,
+            "autoTenantCreation": cfg.multi_tenancy.auto_tenant_creation,
+            "autoTenantActivation": cfg.multi_tenancy.auto_tenant_activation,
+        },
+        "replicationConfig": {"factor": cfg.replication.factor,
+                              "asyncEnabled": cfg.replication.async_enabled},
+        "shardingConfig": {"desiredCount": cfg.sharding.desired_count,
+                           "virtualPerPhysical": cfg.sharding.virtual_per_physical},
+    }
+    if cfg.named_vectors:
+        out["vectorConfig"] = {
+            name: {"vectorIndexType": vc.index_type,
+                   "vectorIndexConfig": {"distance": vc.distance}}
+            for name, vc in cfg.named_vectors.items()
+        }
+    return out

@@ -28,10 +28,12 @@ TENANT_FROZEN = "FROZEN"
 
 
 class Collection:
-    def __init__(self, dirpath: str, config: CollectionConfig, sync_writes: bool = False):
+    def __init__(self, dirpath: str, config: CollectionConfig, sync_writes: bool = False,
+                 modules=None):
         self.dir = dirpath
         self.config = config
         self.sync_writes = sync_writes
+        self.modules = modules
         os.makedirs(dirpath, exist_ok=True)
         self._lock = threading.RLock()
         self._shards: dict[str, Shard] = {}
@@ -125,12 +127,53 @@ class Collection:
                 if s is not None:
                     s.close()
 
+    # -- vectorization (module write-path hook) ---------------------------
+    def _vectorize_missing(self, objs: list[StorageObject]) -> None:
+        """Fill missing default vectors via the configured vectorizer module.
+
+        Reference: ``usecases/modules/vectorizer.go`` (vectorize-on-import) —
+        batched, like the reference's batch vectorizer plumbing
+        (``usecases/modulecomponents/batch``). ``ref2vec-centroid`` instead
+        averages the vectors of referenced objects (same-collection beacons).
+        """
+        name = self.config.vectorizer
+        if name == "none" or self.modules is None:
+            return
+        todo = [o for o in objs if o.vector is None]
+        if not todo:
+            return
+        if name == "ref2vec-centroid":
+            module = self.modules.get(name)
+            ref_props = [p.name for p in self.config.properties
+                         if p.data_type.value == "cref"]
+            for o in todo:
+                refs: list = []
+                for rp in ref_props:
+                    v = o.properties.get(rp)
+                    beacons = v if isinstance(v, list) else [v]
+                    for b in beacons:
+                        uuid = b.get("beacon", "").rsplit("/", 1)[-1] if isinstance(b, dict) else b
+                        if not uuid:
+                            continue
+                        ref = self.get(uuid, tenant=o.tenant)
+                        if ref is not None and ref.vector is not None:
+                            refs.append(ref.vector)
+                o.vector = module.centroid(refs)
+            return
+        vec = self.modules.vectorizer(name)
+        texts = [vec.texts_from_object(o.properties) for o in todo]
+        embedded = vec.vectorize(texts)
+        for o, v in zip(todo, embedded):
+            o.vector = np.asarray(v, np.float32)
+
     # -- writes -----------------------------------------------------------
     def put_batch(self, objs: list[StorageObject], tenant: str = "") -> list[str]:
-        by_shard: dict[str, list[StorageObject]] = {}
         for o in objs:
             o.collection = self.config.name
             o.tenant = tenant
+        self._vectorize_missing(objs)
+        by_shard: dict[str, list[StorageObject]] = {}
+        for o in objs:
             shard = self._route(o.uuid, tenant)
             by_shard.setdefault(shard.name, []).append(o)
         for name, group in by_shard.items():

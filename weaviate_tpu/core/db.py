@@ -18,9 +18,14 @@ from weaviate_tpu.schema.config import CollectionConfig
 
 
 class DB:
-    def __init__(self, root: str, sync_writes: bool = False):
+    def __init__(self, root: str, sync_writes: bool = False, modules=None):
         self.root = root
         self.sync_writes = sync_writes
+        if modules is None:
+            from weaviate_tpu.modules.registry import default_registry
+
+            modules = default_registry()
+        self.modules = modules
         os.makedirs(root, exist_ok=True)
         self._lock = threading.RLock()
         self._collections: dict[str, Collection] = {}
@@ -35,7 +40,8 @@ class DB:
         for cd in data.get("collections", []):
             cfg = CollectionConfig.from_dict(cd)
             self._collections[cfg.name] = Collection(
-                os.path.join(self.root, cfg.name), cfg, sync_writes=self.sync_writes
+                os.path.join(self.root, cfg.name), cfg,
+                sync_writes=self.sync_writes, modules=self.modules,
             )
 
     def _persist_schema(self) -> None:
@@ -57,6 +63,7 @@ class DB:
                 os.path.join(self.root, config.name),
                 config,
                 sync_writes=self.sync_writes,
+                modules=self.modules,
             )
             self._collections[config.name] = c
             self._persist_schema()

@@ -1,0 +1,16 @@
+"""Pluggable model-provider modules (reference L7: ``modules/`` + the SPI in
+``entities/modulecapabilities`` and registry in ``usecases/modules``)."""
+
+from weaviate_tpu.modules.base import (
+    Generative,
+    Module,
+    ModuleNotAvailable,
+    Reranker,
+    Vectorizer,
+)
+from weaviate_tpu.modules.registry import ModuleRegistry, default_registry
+
+__all__ = [
+    "Module", "Vectorizer", "Reranker", "Generative", "ModuleNotAvailable",
+    "ModuleRegistry", "default_registry",
+]

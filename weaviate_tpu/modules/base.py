@@ -1,0 +1,102 @@
+"""Module SPI: the pluggable model-provider interface.
+
+Reference: ``entities/modulecapabilities/module.go:45`` + the runtime registry
+``usecases/modules/modules.go:45``. A module declares capabilities; the
+registry wires them into the write path (vectorize-on-import), the query path
+(nearText → query vector), and additional properties (rerank, generate).
+
+The reference's 67 modules mostly call external inference HTTP APIs; in this
+zero-egress build the in-tree providers are local (hash-based vectorizer,
+transformers when weights are cached, lexical reranker, template generative) —
+the SPI is the parity surface, providers are swappable.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+class Module(abc.ABC):
+    """Base module: name + capability discovery via isinstance checks."""
+
+    name: str = "module"
+
+    def init(self, config: Optional[dict] = None) -> None:
+        """Late init hook (reference InitExtension/InitVectorizer)."""
+
+    def meta(self) -> dict:
+        return {"name": self.name, "type": self.module_type()}
+
+    def module_type(self) -> str:
+        kinds = []
+        if isinstance(self, Vectorizer):
+            kinds.append("text2vec")
+        if isinstance(self, Reranker):
+            kinds.append("reranker")
+        if isinstance(self, Generative):
+            kinds.append("generative")
+        return "+".join(kinds) or "extension"
+
+
+class Vectorizer(Module):
+    """text2vec capability (reference ``modulecapabilities/vectorizer.go``)."""
+
+    dims: int = 0
+
+    @abc.abstractmethod
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        """Batch-embed texts → [n, dims] float32."""
+
+    def vectorize_query(self, text: str) -> np.ndarray:
+        """Query-time embedding (some providers use asymmetric encodings)."""
+        return self.vectorize([text])[0]
+
+    def texts_from_object(self, properties: dict, schema_props: Optional[list] = None) -> str:
+        """Concatenate vectorizable text props (reference vectorizer behavior:
+        lowercased prop name + value, sorted by prop name)."""
+        parts = []
+        for name in sorted(properties):
+            v = properties[name]
+            if isinstance(v, str):
+                parts.append(v)
+            elif isinstance(v, list) and v and isinstance(v[0], str):
+                parts.extend(v)
+        return " ".join(parts)
+
+
+class Reranker(Module):
+    """reranker capability (reference ``modulecapabilities/reranker.go``)."""
+
+    @abc.abstractmethod
+    def rerank(self, query: str, documents: Sequence[str]) -> list[float]:
+        """Relevance score per document (higher is better)."""
+
+
+class Generative(Module):
+    """generative capability (reference ``modulecapabilities/generative.go``)."""
+
+    @abc.abstractmethod
+    def generate(
+        self,
+        prompt: str,
+        context_documents: Sequence[str],
+        grouped: bool = False,
+    ) -> str:
+        """Produce an answer from the prompt + retrieved context."""
+
+    def generate_single(self, prompt_template: str, properties: dict) -> str:
+        """singlePrompt: fill ``{prop}`` placeholders from the object's
+        properties, then generate. Part of the SPI so providers can override
+        (the reference's singlePrompt templating happens module-side)."""
+        out = prompt_template
+        for k, v in properties.items():
+            out = out.replace("{" + k + "}", str(v))
+        return self.generate(out, [])
+
+
+class ModuleNotAvailable(RuntimeError):
+    """Raised when a provider's backing model/service is unavailable
+    (e.g. transformers weights not cached in a zero-egress environment)."""

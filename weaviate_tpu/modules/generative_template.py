@@ -1,0 +1,40 @@
+"""generative-template: offline RAG answer synthesis.
+
+Mirrors the reference's ``test/generative-dummy`` module shape: fills the
+user's prompt with retrieved context so the generate() additional-property
+pipeline (``usecases/modules`` → explorer "generate") is exercised end-to-end
+without an external LLM. ``{property}`` placeholders interpolate document
+text, like the reference's singlePrompt templating.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from weaviate_tpu.modules.base import Generative
+
+
+class TemplateGenerative(Generative):
+    name = "generative-template"
+
+    def generate(
+        self,
+        prompt: str,
+        context_documents: Sequence[str],
+        grouped: bool = False,
+    ) -> str:
+        ctx = "\n".join(f"- {d}" for d in context_documents)
+        if grouped:
+            return f"{prompt}\n[context]\n{ctx}"
+        # single-prompt mode: one doc expected
+        doc = context_documents[0] if context_documents else ""
+        return prompt.replace("{text}", doc) if "{text}" in prompt else (
+            f"{prompt}\n[context]\n{doc}"
+        )
+
+    def generate_single(self, prompt_template: str, properties: dict) -> str:
+        """singlePrompt: ``{prop}`` placeholders filled from object props."""
+        out = prompt_template
+        for k, v in properties.items():
+            out = out.replace("{" + k + "}", str(v))
+        return out

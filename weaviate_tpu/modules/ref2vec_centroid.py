@@ -1,0 +1,25 @@
+"""ref2vec-centroid: object vector = centroid of referenced objects' vectors.
+
+Reference: ``modules/ref2vec-centroid`` — recomputes an object's vector as
+the mean (the only calculation method the reference ships) of the vectors of
+the objects it references. The write path calls ``centroid`` with the
+resolved referenced vectors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.modules.base import Module
+
+
+class Ref2VecCentroid(Module):
+    name = "ref2vec-centroid"
+
+    def centroid(self, vectors: Sequence[np.ndarray]) -> Optional[np.ndarray]:
+        vecs = [np.asarray(v, np.float32) for v in vectors if v is not None]
+        if not vecs:
+            return None
+        return np.mean(np.stack(vecs), axis=0)

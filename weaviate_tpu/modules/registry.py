@@ -1,0 +1,79 @@
+"""Module registry: name → provider instance, capability-checked accessors.
+
+Reference: ``usecases/modules/modules.go:45`` (Provider) — registered at
+startup (``configure_api.go registerModules``), consulted by the write path
+(vectorize on import), query path (nearText), and additional-property
+providers (rerank/generate).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from weaviate_tpu.modules.base import (
+    Generative,
+    Module,
+    Reranker,
+    Vectorizer,
+)
+
+
+class ModuleRegistry:
+    def __init__(self):
+        self._modules: dict[str, Module] = {}
+
+    def register(self, module: Module) -> None:
+        self._modules[module.name] = module
+
+    def get(self, name: str) -> Module:
+        m = self._modules.get(name)
+        if m is None:
+            raise KeyError(f"module {name!r} not registered")
+        return m
+
+    def has(self, name: str) -> bool:
+        return name in self._modules
+
+    def vectorizer(self, name: str) -> Vectorizer:
+        m = self.get(name)
+        if not isinstance(m, Vectorizer):
+            raise TypeError(f"module {name!r} is not a vectorizer")
+        return m
+
+    def reranker(self, name: str) -> Reranker:
+        m = self.get(name)
+        if not isinstance(m, Reranker):
+            raise TypeError(f"module {name!r} is not a reranker")
+        return m
+
+    def generative(self, name: str) -> Generative:
+        m = self.get(name)
+        if not isinstance(m, Generative):
+            raise TypeError(f"module {name!r} is not generative")
+        return m
+
+    def list(self) -> dict[str, dict]:
+        return {name: m.meta() for name, m in self._modules.items()}
+
+
+def default_registry() -> ModuleRegistry:
+    """The baked-in providers (reference: registerModules defaults)."""
+    from weaviate_tpu.modules.generative_template import TemplateGenerative
+    from weaviate_tpu.modules.ref2vec_centroid import Ref2VecCentroid
+    from weaviate_tpu.modules.reranker_lexical import LexicalReranker
+    from weaviate_tpu.modules.text2vec_hash import HashVectorizer
+
+    reg = ModuleRegistry()
+    reg.register(HashVectorizer())
+    reg.register(LexicalReranker())
+    reg.register(TemplateGenerative())
+    reg.register(Ref2VecCentroid())
+    # transformers registers lazily: the model loads on first vectorize()
+    # call and raises ModuleNotAvailable there when weights aren't cached
+    # (eager probing would load ~90MB into every DB instance at startup)
+    from weaviate_tpu.modules.text2vec_transformers import (
+        TransformersVectorizer,
+    )
+
+    reg.register(TransformersVectorizer())
+    return reg

@@ -1,0 +1,42 @@
+"""reranker-lexical: offline token-overlap reranker.
+
+Plays the role of the reference's ``modules/reranker-transformers`` /
+``reranker-dummy`` in a zero-egress environment: scores each document by
+smoothed query-token overlap (per-token idf-free BM25-ish saturation).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Sequence
+
+from weaviate_tpu.inverted.analyzer import tokenize
+from weaviate_tpu.modules.base import Reranker
+
+
+class LexicalReranker(Reranker):
+    name = "reranker-lexical"
+
+    def __init__(self, k1: float = 1.2, b: float = 0.75):
+        self.k1 = k1
+        self.b = b
+
+    def rerank(self, query: str, documents: Sequence[str]) -> list[float]:
+        q_tokens = set(tokenize(query, "word"))
+        if not q_tokens:
+            return [0.0] * len(documents)
+        doc_tokens = [Counter(tokenize(d, "word")) for d in documents]
+        avg_len = max(
+            1.0, sum(sum(c.values()) for c in doc_tokens) / max(1, len(documents))
+        )
+        scores = []
+        for c in doc_tokens:
+            dl = sum(c.values())
+            s = 0.0
+            for t in q_tokens:
+                tf = c.get(t, 0)
+                if tf:
+                    denom = tf + self.k1 * (1 - self.b + self.b * dl / avg_len)
+                    s += tf * (self.k1 + 1) / denom
+            scores.append(s)
+        return scores

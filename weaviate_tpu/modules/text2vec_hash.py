@@ -1,0 +1,54 @@
+"""text2vec-hash: deterministic feature-hashing embedder (offline-safe).
+
+The stand-in for the reference's sidecar vectorizers
+(``modules/text2vec-contextionary``): token feature hashing with positional
+n-grams into a fixed-dim space, L2-normalized. Deterministic, dependency-free,
+and batched — the TPU path treats embeddings as data, so any real provider
+can replace this without touching the write/query integration.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import Sequence
+
+import numpy as np
+
+from weaviate_tpu.inverted.analyzer import tokenize
+from weaviate_tpu.modules.base import Vectorizer
+
+
+def _bucket(token: str, seed: int, dims: int) -> tuple[int, float]:
+    h = hashlib.blake2b(f"{seed}:{token}".encode(), digest_size=8).digest()
+    v = int.from_bytes(h, "big")
+    idx = v % dims
+    sign = 1.0 if (v >> 63) & 1 else -1.0
+    return idx, sign
+
+
+class HashVectorizer(Vectorizer):
+    name = "text2vec-hash"
+
+    def __init__(self, dims: int = 256, ngrams: int = 2):
+        self.dims = dims
+        self.ngrams = ngrams
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        out = np.zeros((len(texts), self.dims), np.float32)
+        for i, text in enumerate(texts):
+            toks = tokenize(text, "word")
+            feats = list(toks)
+            for n in range(2, self.ngrams + 1):
+                feats.extend(
+                    "_".join(toks[j:j + n]) for j in range(len(toks) - n + 1)
+                )
+            for tok in feats:
+                # idf-ish damping: shorter tokens are commoner, weigh less
+                w = 1.0 + math.log1p(len(tok))
+                idx, sign = _bucket(tok, 0, self.dims)
+                out[i, idx] += sign * w
+            norm = float(np.linalg.norm(out[i]))
+            if norm > 0:
+                out[i] /= norm
+        return out

@@ -1,0 +1,65 @@
+"""text2vec-transformers: local HuggingFace encoder (gated on cached weights).
+
+Reference: ``modules/text2vec-transformers`` talks to a sidecar inference
+container; here the model runs in-process (torch CPU / transformers are baked
+into the image). Zero-egress: ``local_files_only=True`` — if the weights are
+not already cached the module raises ``ModuleNotAvailable`` at init and the
+registry simply does not offer it (the reference behaves the same when the
+sidecar is down).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.modules.base import ModuleNotAvailable, Vectorizer
+
+DEFAULT_MODEL = "sentence-transformers/all-MiniLM-L6-v2"
+
+
+class TransformersVectorizer(Vectorizer):
+    name = "text2vec-transformers"
+
+    def __init__(self, model_name: str = DEFAULT_MODEL, max_length: int = 256):
+        self.model_name = model_name
+        self.max_length = max_length
+        self._model = None
+        self._tokenizer = None
+
+    def _load(self):
+        if self._model is not None:
+            return
+        try:
+            import torch  # noqa: F401
+            from transformers import AutoModel, AutoTokenizer
+
+            self._tokenizer = AutoTokenizer.from_pretrained(
+                self.model_name, local_files_only=True
+            )
+            self._model = AutoModel.from_pretrained(
+                self.model_name, local_files_only=True
+            )
+            self._model.eval()
+            self.dims = int(self._model.config.hidden_size)
+        except Exception as e:  # missing weights, no torch, etc.
+            raise ModuleNotAvailable(
+                f"text2vec-transformers: model {self.model_name!r} not "
+                f"available locally ({e})"
+            ) from e
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        self._load()
+        import torch
+
+        enc = self._tokenizer(
+            list(texts), padding=True, truncation=True,
+            max_length=self.max_length, return_tensors="pt",
+        )
+        with torch.no_grad():
+            out = self._model(**enc).last_hidden_state  # [n, t, h]
+        mask = enc["attention_mask"].unsqueeze(-1).float()
+        pooled = (out * mask).sum(1) / mask.sum(1).clamp(min=1e-9)
+        vecs = torch.nn.functional.normalize(pooled, dim=-1).numpy()
+        return np.asarray(vecs, np.float32)

@@ -8,10 +8,12 @@ from weaviate_tpu.query.aggregator import aggregate_property
 from weaviate_tpu.query.autocut import autocut
 from weaviate_tpu.query.explorer import (
     Explorer,
+    GenerateParams,
     Hit,
     HybridParams,
     QueryParams,
     QueryResult,
+    RerankParams,
 )
 from weaviate_tpu.query.fusion import ranked_fusion, relative_score_fusion
 from weaviate_tpu.query.groupby import Group, GroupByParams, group_results
@@ -20,6 +22,7 @@ from weaviate_tpu.query.sorter import sort_objects
 
 __all__ = [
     "Explorer", "Hit", "HybridParams", "QueryParams", "QueryResult",
+    "RerankParams", "GenerateParams",
     "GroupByParams", "Group", "group_results", "sort_objects", "autocut",
     "ranked_fusion", "relative_score_fusion", "combine_multi_target",
     "aggregate_property",

@@ -32,12 +32,33 @@ class HybridParams:
 
 
 @dataclass
+class RerankParams:
+    """Reference ``modulecapabilities`` rerank additional property."""
+
+    query: str
+    property: str = ""  # document text property; "" = all text props
+    module: str = "reranker-lexical"
+
+
+@dataclass
+class GenerateParams:
+    """Reference generative additional property (singlePrompt/groupedTask)."""
+
+    single_prompt: Optional[str] = None  # "{prop}" placeholders
+    grouped_task: Optional[str] = None
+    properties: Optional[list[str]] = None  # context props for grouped
+    module: str = "generative-template"
+
+
+@dataclass
 class QueryParams:
     collection: str
     tenant: str = ""
     limit: int = 10
     offset: int = 0
     filters: Optional[Filter] = None
+    # nearText: vectorized via the collection's vectorizer module
+    near_text: Optional[str] = None
     # vector search (single or multi target)
     near_vector: Optional[np.ndarray] = None
     target_vector: str = ""
@@ -54,6 +75,9 @@ class QueryParams:
     sort: list[tuple[str, str]] = field(default_factory=list)
     group_by: Optional[GroupByParams] = None
     autocut: int = 0
+    # module-powered additional properties
+    rerank: Optional[RerankParams] = None
+    generate: Optional[GenerateParams] = None
 
 
 @dataclass
@@ -61,23 +85,45 @@ class Hit:
     object: StorageObject
     score: Optional[float] = None  # higher is better (bm25/hybrid)
     distance: Optional[float] = None  # lower is better (vector)
+    additional: dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
 class QueryResult:
     hits: list[Hit] = field(default_factory=list)
     groups: Optional[list[Group]] = None
+    generated: Optional[str] = None  # groupedTask output
 
 
 class Explorer:
     def __init__(self, db: DB):
         self.db = db
 
+    def _query_vector(self, col, text: str) -> np.ndarray:
+        """nearText → query vector via the collection's vectorizer module
+        (reference ``near_params_vector.go``)."""
+        name = col.config.vectorizer
+        if name == "none" or col.modules is None:
+            raise ValueError(
+                f"collection {col.config.name!r} has no vectorizer: "
+                "nearText requires one (use nearVector instead)"
+            )
+        return col.modules.vectorizer(name).vectorize_query(text)
+
     def get(self, params: QueryParams) -> QueryResult:
         col = self.db.get_collection(params.collection)
         fetch = params.offset + params.limit
         scored: list[tuple[StorageObject, float]] = []
         kind = "none"
+
+        if params.near_text is not None and params.near_vector is None \
+                and params.hybrid is None:
+            params.near_vector = self._query_vector(col, params.near_text)
+        if params.hybrid is not None and params.hybrid.vector is None \
+                and params.hybrid.query and col.config.vectorizer != "none" \
+                and col.modules is not None:
+            # hybrid with text only: vectorize the query for the dense branch
+            params.hybrid.vector = self._query_vector(col, params.hybrid.query)
 
         if params.hybrid is not None:
             h = params.hybrid
@@ -142,7 +188,59 @@ class Explorer:
                 distance=s if kind == "distance" else None)
             for o, s in page
         ]
-        return QueryResult(hits=hits)
+        result = QueryResult(hits=hits)
+        if params.rerank is not None:
+            self._apply_rerank(col, result, params.rerank)
+        if params.generate is not None:
+            self._apply_generate(col, result, params.generate)
+        return result
+
+    def _doc_text(self, obj: StorageObject, prop: str) -> str:
+        if prop:
+            v = obj.properties.get(prop, "")
+            return " ".join(v) if isinstance(v, list) else str(v)
+        return " ".join(
+            str(v) for v in obj.properties.values()
+            if isinstance(v, str)
+        )
+
+    def _apply_rerank(self, col, result: QueryResult,
+                      params: RerankParams) -> None:
+        """Rerank hits by module score; reorders and annotates
+        (reference reranker additional property)."""
+        if col.modules is None or not result.hits:
+            return
+        reranker = col.modules.reranker(params.module)
+        docs = [self._doc_text(h.object, params.property) for h in result.hits]
+        scores = reranker.rerank(params.query, docs)
+        for h, s in zip(result.hits, scores):
+            h.additional["rerank_score"] = float(s)
+        result.hits.sort(key=lambda h: -h.additional["rerank_score"])
+
+    def _apply_generate(self, col, result: QueryResult,
+                        params: GenerateParams) -> None:
+        """Generative additional property (reference generate provider)."""
+        if col.modules is None or not result.hits:
+            return
+        gen = col.modules.generative(params.module)
+        if params.single_prompt:
+            for h in result.hits:
+                h.additional["generate"] = gen.generate_single(
+                    params.single_prompt, h.object.properties
+                )
+        if params.grouped_task:
+            props = params.properties
+            docs = []
+            for h in result.hits:
+                if props:
+                    docs.append(" ".join(
+                        str(h.object.properties.get(p, "")) for p in props
+                    ))
+                else:
+                    docs.append(self._doc_text(h.object, ""))
+            result.generated = gen.generate(
+                params.grouped_task, docs, grouped=True
+            )
 
     def aggregate(
         self,
