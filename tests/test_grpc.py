@@ -1,0 +1,148 @@
+"""gRPC data plane tests over a live in-process server —
+the analogue of the reference's grpc acceptance tests."""
+
+import json
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.grpc_server import GrpcAPI, GrpcClient
+from weaviate_tpu.api.proto import pb
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    DataType,
+    FlatIndexConfig,
+    Property,
+)
+
+D = 8
+
+
+@pytest.fixture
+def rpc(tmp_dbdir):
+    db = DB(tmp_dbdir)
+    db.create_collection(CollectionConfig(
+        name="Article",
+        properties=[Property(name="title"),
+                    Property(name="n", data_type=DataType.INT)],
+        vector_config=FlatIndexConfig(distance="l2-squared", precision="fp32"),
+    ))
+    api = GrpcAPI(db)
+    port = api.serve(port=0)
+    client = GrpcClient(f"127.0.0.1:{port}")
+    yield client
+    client.close()
+    api.shutdown()
+    db.close()
+
+
+def seed(client, n=20):
+    req = pb.BatchObjectsRequest()
+    for i in range(n):
+        o = req.objects.add()
+        o.uuid = f"00000000-0000-0000-0000-{i:012d}"
+        o.collection = "Article"
+        o.properties_json = json.dumps({"title": f"article {i}", "n": i})
+        vec = [0.0] * D
+        vec[i % D] = 1.0
+        o.vector.values.extend(vec)
+    reply = client.batch_objects(req)
+    assert not reply.errors, reply.errors
+    assert len(reply.uuids) == n
+    return reply
+
+
+def test_batch_and_single_search(rpc):
+    seed(rpc)
+    q = pb.SearchRequest(collection="Article", limit=3)
+    v = q.near_vectors.add()
+    v.values.extend([1, 0, 0, 0, 0, 0, 0, 0])
+    reply = rpc.search(q)
+    assert len(reply.results) == 1
+    hits = reply.results[0].hits
+    assert len(hits) == 3
+    assert hits[0].distance == pytest.approx(0.0)
+    assert json.loads(hits[0].properties_json)["n"] % D == 0
+
+
+def test_batched_queries_one_rpc(rpc):
+    seed(rpc)
+    q = pb.SearchRequest(collection="Article", limit=2)
+    for j in range(4):
+        v = q.near_vectors.add()
+        vec = [0.0] * D
+        vec[j] = 1.0
+        v.values.extend(vec)
+    reply = rpc.search(q)
+    assert len(reply.results) == 4
+    for j, qr in enumerate(reply.results):
+        assert json.loads(qr.hits[0].properties_json)["n"] % D == j
+
+
+def test_bm25_filter_hybrid(rpc):
+    seed(rpc)
+    q = pb.SearchRequest(
+        collection="Article", limit=5, bm25_query="article",
+        where_json=json.dumps({"operator": "LessThan", "path": ["n"],
+                               "valueInt": 5}),
+    )
+    reply = rpc.search(q)
+    hits = reply.results[0].hits
+    assert hits and all(json.loads(h.properties_json)["n"] < 5 for h in hits)
+
+    q = pb.SearchRequest(collection="Article", limit=5,
+                         use_hybrid=True, bm25_query="article", alpha=0.5)
+    v = q.near_vectors.add()
+    v.values.extend([0, 1, 0, 0, 0, 0, 0, 0])
+    reply = rpc.search(q)
+    assert reply.results[0].hits
+
+
+def test_batch_delete_and_aggregate(rpc):
+    seed(rpc)
+    req = pb.BatchDeleteRequest(
+        collection="Article",
+        where_json=json.dumps({"operator": "GreaterThanEqual",
+                               "path": ["n"], "valueInt": 15}),
+        dry_run=True,
+    )
+    reply = rpc.batch_delete(req)
+    assert reply.matches == 5 and reply.successful == 0
+    req.dry_run = False
+    reply = rpc.batch_delete(req)
+    assert reply.successful == 5
+
+    agg = rpc.aggregate(pb.AggregateRequest(
+        collection="Article", properties=["n"]))
+    out = json.loads(agg.result_json)
+    assert out["meta"]["count"] == 15
+    assert out["properties"]["n"]["max"] == 14
+
+
+def test_grpc_errors(rpc):
+    import grpc as grpclib
+
+    with pytest.raises(grpclib.RpcError) as e:
+        rpc.search(pb.SearchRequest(collection="Nope", limit=1))
+    assert e.value.code() == grpclib.StatusCode.NOT_FOUND
+
+    bad = pb.SearchRequest(collection="Article", limit=1,
+                           where_json="{\"operator\": \"Bogus\"}")
+    with pytest.raises(grpclib.RpcError) as e:
+        rpc.search(bad)
+    assert e.value.code() == grpclib.StatusCode.INVALID_ARGUMENT
+
+
+def test_batch_partial_failure(rpc):
+    req = pb.BatchObjectsRequest()
+    o = req.objects.add()
+    o.collection = "Article"
+    o.properties_json = json.dumps({"title": "ok"})
+    o.vector.values.extend([0.0] * D)
+    o2 = req.objects.add()
+    o2.collection = "NoSuchClass"
+    o2.properties_json = json.dumps({"title": "bad"})
+    reply = rpc.batch_objects(req)
+    assert len(reply.errors) == 1 and reply.errors[0].index == 1
+    assert reply.uuids[0] != "" and reply.uuids[1] == ""

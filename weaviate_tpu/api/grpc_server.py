@@ -1,0 +1,285 @@
+"""gRPC data plane server.
+
+Reference: ``adapters/handlers/grpc/v1/service.go`` (Search :271,
+BatchObjects :221, BatchDelete, TenantsGet, Aggregate). The service is
+registered through ``grpc.method_handlers_generic_handler`` with
+protoc-generated messages — the image has no grpc codegen plugin, so the
+stub layer is explicit (and tiny).
+
+TPU-first deviation from the reference: ``SearchRequest.near_vectors`` is a
+batch — all query vectors in one RPC are answered by ONE batched device
+call, the design SURVEY.md §7 calls out as the amortization lever for the
+host↔device round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+import numpy as np
+
+from weaviate_tpu.api.graphql import where_to_filter
+from weaviate_tpu.api.proto import pb
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.query import Explorer, HybridParams, QueryParams
+
+SERVICE = "weaviate_tpu.v1.WeaviateTpu"
+
+
+def _np_from_vec(v: pb.Vector) -> np.ndarray:
+    return np.asarray(v.values, np.float32)
+
+
+class GrpcAPI:
+    def __init__(self, db: DB, max_workers: int = 16):
+        self.db = db
+        self.explorer = Explorer(db)
+        self.max_workers = max_workers
+        self._server: Optional[grpc.Server] = None
+
+    # -- rpc implementations ----------------------------------------------
+    def _wrap(self, fn):
+        def handler(request, context):
+            try:
+                return fn(request)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except (ValueError, TypeError) as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except RuntimeError as e:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return handler
+
+    def search(self, req: pb.SearchRequest) -> pb.SearchReply:
+        t0 = time.perf_counter()
+        col = self.db.get_collection(req.collection)
+        flt = where_to_filter(json.loads(req.where_json)) if req.where_json else None
+        limit = int(req.limit) or 10
+        max_dist = float(req.max_distance) if req.max_distance > 0 else None
+
+        reply = pb.SearchReply()
+
+        if (len(req.near_vectors) > 1 and not req.use_hybrid
+                and not req.bm25_query):
+            # the TPU fast path: all query vectors in one device batch
+            from weaviate_tpu.query.autocut import autocut as autocut_fn
+
+            queries = np.stack([_np_from_vec(v) for v in req.near_vectors])
+            rows = col.vector_search_batch(
+                queries, k=limit + int(req.offset),
+                target=req.target_vector, flt=flt, tenant=req.tenant,
+                max_distance=max_dist,
+            )
+            for row in rows:
+                qr = reply.results.add()
+                page = row[req.offset:]
+                if req.autocut > 0:
+                    cut = autocut_fn([d for _, d in page], int(req.autocut))
+                    page = page[:cut]
+                for obj, dist in page:
+                    self._add_hit(qr, obj, distance=dist,
+                                  include_vector=req.include_vector,
+                                  target=req.target_vector)
+            reply.took_seconds = time.perf_counter() - t0
+            return reply
+
+        params = QueryParams(
+            collection=req.collection, tenant=req.tenant,
+            limit=limit, offset=int(req.offset),
+            filters=flt, autocut=int(req.autocut),
+            max_distance=max_dist,
+            target_vector=req.target_vector,
+        )
+        if req.use_hybrid:
+            params.hybrid = HybridParams(
+                query=req.bm25_query or None,
+                vector=_np_from_vec(req.near_vectors[0])
+                if req.near_vectors else None,
+                # explicit presence: alpha=0.0 (pure keyword) is honored
+                alpha=float(req.alpha) if req.HasField("alpha") else 0.75,
+                fusion=req.fusion or "relativeScoreFusion",
+                properties=list(req.bm25_properties) or None,
+            )
+        elif req.near_vectors:
+            params.near_vector = _np_from_vec(req.near_vectors[0])
+        elif req.near_text:
+            params.near_text = req.near_text
+        elif req.bm25_query:
+            params.bm25_query = req.bm25_query
+            params.bm25_properties = list(req.bm25_properties) or None
+
+        result = self.explorer.get(params)
+        qr = reply.results.add()
+        for hit in result.hits:
+            self._add_hit(qr, hit.object, score=hit.score,
+                          distance=hit.distance,
+                          include_vector=req.include_vector,
+                          target=req.target_vector)
+        reply.took_seconds = time.perf_counter() - t0
+        return reply
+
+    def _add_hit(self, qr, obj, score=None, distance=None,
+                 include_vector=False, target=""):
+        hit = qr.hits.add()
+        hit.uuid = obj.uuid
+        if score is not None:
+            hit.score = float(score)
+        if distance is not None:
+            hit.distance = float(distance)
+        hit.properties_json = json.dumps(obj.properties)
+        if include_vector:
+            vec = obj.named_vectors.get(target) if target else obj.vector
+            if vec is not None:
+                hit.vector.values.extend(np.asarray(vec).tolist())
+
+    def batch_objects(self, req: pb.BatchObjectsRequest) -> pb.BatchObjectsReply:
+        from weaviate_tpu.storage.objects import StorageObject
+
+        t0 = time.perf_counter()
+        reply = pb.BatchObjectsReply()
+        groups: dict[tuple[str, str], list[tuple[int, StorageObject]]] = {}
+        objs: list[Optional[StorageObject]] = []
+        for i, bo in enumerate(req.objects):
+            try:
+                obj = StorageObject(
+                    uuid=bo.uuid,
+                    collection=bo.collection,
+                    properties=json.loads(bo.properties_json)
+                    if bo.properties_json else {},
+                    vector=_np_from_vec(bo.vector)
+                    if bo.vector.values else None,
+                    named_vectors={
+                        k: _np_from_vec(v)
+                        for k, v in bo.named_vectors.items()
+                    },
+                    tenant=bo.tenant,
+                )
+                objs.append(obj)
+                groups.setdefault((bo.collection, bo.tenant), []).append((i, obj))
+            except (json.JSONDecodeError, ValueError) as e:
+                objs.append(None)
+                err = reply.errors.add()
+                err.index = i
+                err.message = str(e)
+        for (cls, tenant), items in groups.items():
+            try:
+                col = self.db.get_collection(cls)
+                col.put_batch([o for _, o in items], tenant=tenant)
+            except (KeyError, ValueError, RuntimeError) as e:
+                for i, _ in items:
+                    err = reply.errors.add()
+                    err.index = i
+                    err.message = str(e)
+                    objs[i] = None
+        reply.uuids.extend(o.uuid if o is not None else "" for o in objs)
+        reply.took_seconds = time.perf_counter() - t0
+        return reply
+
+    def batch_delete(self, req: pb.BatchDeleteRequest) -> pb.BatchDeleteReply:
+        col = self.db.get_collection(req.collection)
+        flt = where_to_filter(json.loads(req.where_json))
+        reply = pb.BatchDeleteReply()
+        if req.dry_run:
+            reply.matches = col.count_where(flt, tenant=req.tenant)
+            reply.successful = 0
+        else:
+            n = col.delete_where(flt, tenant=req.tenant)
+            reply.matches = n
+            reply.successful = n
+        return reply
+
+    def tenants_get(self, req: pb.TenantsGetRequest) -> pb.TenantsGetReply:
+        col = self.db.get_collection(req.collection)
+        reply = pb.TenantsGetReply()
+        for name, status in sorted(col.tenants().items()):
+            t = reply.tenants.add()
+            t.name = name
+            t.activity_status = status
+        return reply
+
+    def aggregate(self, req: pb.AggregateRequest) -> pb.AggregateReply:
+        col = self.db.get_collection(req.collection)
+        flt = where_to_filter(json.loads(req.where_json)) if req.where_json else None
+        agg = col.aggregate(
+            {p: None for p in req.properties},
+            flt=flt,
+            group_by=req.group_by or None,
+            tenant=req.tenant,
+        )
+        return pb.AggregateReply(result_json=json.dumps(agg))
+
+    # -- service wiring ----------------------------------------------------
+    def _generic_handler(self):
+        rpcs = {
+            "Search": (self.search, pb.SearchRequest),
+            "BatchObjects": (self.batch_objects, pb.BatchObjectsRequest),
+            "BatchDelete": (self.batch_delete, pb.BatchDeleteRequest),
+            "TenantsGet": (self.tenants_get, pb.TenantsGetRequest),
+            "Aggregate": (self.aggregate, pb.AggregateRequest),
+        }
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                self._wrap(fn),
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda msg: msg.SerializeToString(),
+            )
+            for name, (fn, req_cls) in rpcs.items()
+        }
+        return grpc.method_handlers_generic_handler(SERVICE, handlers)
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Start the server; returns the bound port. Raises on bind failure
+        (grpc signals it by returning port 0)."""
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self.max_workers))
+        self._server.add_generic_rpc_handlers((self._generic_handler(),))
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise RuntimeError(f"gRPC failed to bind {host}:{port}")
+        self._server.start()
+        return bound
+
+    def shutdown(self, grace: float = 1.0):
+        if self._server is not None:
+            self._server.stop(grace).wait()
+
+
+class GrpcClient:
+    """Minimal client over explicit method paths (no generated stubs)."""
+
+    def __init__(self, address: str):
+        self.channel = grpc.insecure_channel(address)
+        self._methods = {}
+
+    def _call(self, name: str, request, reply_cls):
+        m = self._methods.get(name)
+        if m is None:
+            m = self.channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=lambda msg: msg.SerializeToString(),
+                response_deserializer=reply_cls.FromString,
+            )
+            self._methods[name] = m
+        return m(request)
+
+    def search(self, request: pb.SearchRequest) -> pb.SearchReply:
+        return self._call("Search", request, pb.SearchReply)
+
+    def batch_objects(self, request: pb.BatchObjectsRequest) -> pb.BatchObjectsReply:
+        return self._call("BatchObjects", request, pb.BatchObjectsReply)
+
+    def batch_delete(self, request: pb.BatchDeleteRequest) -> pb.BatchDeleteReply:
+        return self._call("BatchDelete", request, pb.BatchDeleteReply)
+
+    def tenants_get(self, request: pb.TenantsGetRequest) -> pb.TenantsGetReply:
+        return self._call("TenantsGet", request, pb.TenantsGetReply)
+
+    def aggregate(self, request: pb.AggregateRequest) -> pb.AggregateReply:
+        return self._call("Aggregate", request, pb.AggregateReply)
+
+    def close(self):
+        self.channel.close()

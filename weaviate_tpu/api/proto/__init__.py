@@ -1,0 +1,5 @@
+"""Generated protobuf messages (protoc --python_out of weaviate_tpu.proto)."""
+
+from weaviate_tpu.api.proto import weaviate_tpu_pb2 as pb  # noqa: F401
+
+__all__ = ["pb"]

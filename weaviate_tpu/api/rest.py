@@ -299,9 +299,7 @@ class RestAPI:
             flt = where_to_filter(match.get("where", {}))
             tenant = body.get("tenant", "") or request.args.get("tenant", "")
             if body.get("dryRun"):
-                shards = col._search_shards(tenant)
-                matches = sum(
-                    int(s.allow_list(flt).sum()) for s in shards)
+                matches = col.count_where(flt, tenant=tenant)
                 deleted = 0
             else:
                 matches = deleted = col.delete_where(flt, tenant=tenant)

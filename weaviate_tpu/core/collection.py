@@ -217,6 +217,13 @@ class Collection:
     def count(self, tenant: str = "") -> int:
         return sum(s.count() for s in self._search_shards(tenant))
 
+    def count_where(self, flt: Filter, tenant: str = "") -> int:
+        """Number of live objects matching a filter (dry-run counting uses
+        the same masking as ``delete_where`` so the two can't drift)."""
+        return sum(
+            int(s.allow_list(flt).sum()) for s in self._search_shards(tenant)
+        )
+
     def objects_page(self, limit: int = 25, offset: int = 0, tenant: str = "") -> list[StorageObject]:
         out: list[StorageObject] = []
         for s in self._search_shards(tenant):

@@ -1,0 +1,83 @@
+"""Server entry point: REST + gRPC on one DB, env-var configured.
+
+Reference: ``cmd/weaviate-server/main.go`` + the composition root
+``adapters/handlers/rest/configure_api.go`` (env-driven config from
+``usecases/config/environment.go``). Run as:
+
+    python -m weaviate_tpu.server
+
+Env vars (reference names where they exist):
+  PERSISTENCE_DATA_PATH   data directory (default ./weaviate-tpu-data)
+  DEFAULT_HTTP_PORT       REST port (default 8080)
+  GRPC_PORT               gRPC port (default 50051; empty string disables)
+  AUTHENTICATION_APIKEY_ENABLED        "true" to require API keys
+  AUTHENTICATION_APIKEY_ALLOWED_KEYS   comma-separated keys
+  AUTHENTICATION_APIKEY_USERS          comma-separated user names (parallel)
+  AUTHENTICATION_ANONYMOUS_ACCESS_ENABLED  default "true"
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+
+
+def config_from_env() -> dict:
+    keys = [k for k in os.environ.get(
+        "AUTHENTICATION_APIKEY_ALLOWED_KEYS", "").split(",") if k]
+    users = [u for u in os.environ.get(
+        "AUTHENTICATION_APIKEY_USERS", "").split(",") if u]
+    api_keys = dict(zip(keys, users + ["user"] * (len(keys) - len(users))))
+    return {
+        "data_path": os.environ.get(
+            "PERSISTENCE_DATA_PATH", "./weaviate-tpu-data"),
+        "http_port": int(os.environ.get("DEFAULT_HTTP_PORT", "8080")),
+        "grpc_port": os.environ.get("GRPC_PORT", "50051"),
+        "api_keys": api_keys
+        if os.environ.get("AUTHENTICATION_APIKEY_ENABLED") == "true" else {},
+        "anonymous": os.environ.get(
+            "AUTHENTICATION_ANONYMOUS_ACCESS_ENABLED", "true") != "false",
+    }
+
+
+def main() -> int:
+    from weaviate_tpu.api.grpc_server import GrpcAPI
+    from weaviate_tpu.api.rest import AuthConfig, RestAPI
+    from weaviate_tpu.core.db import DB
+
+    cfg = config_from_env()
+    db = DB(cfg["data_path"])
+    auth = AuthConfig(api_keys=cfg["api_keys"],
+                      anonymous_access=cfg["anonymous"])
+    rest = RestAPI(db, auth=auth)
+    rest_srv = rest.serve(host="0.0.0.0", port=cfg["http_port"],
+                          background=True)
+    print(f"REST listening on :{rest_srv.server_port}", file=sys.stderr)
+
+    grpc_api = None
+    if cfg["grpc_port"]:
+        grpc_api = GrpcAPI(db)
+        port = grpc_api.serve(host="0.0.0.0", port=int(cfg["grpc_port"]))
+        print(f"gRPC listening on :{port}", file=sys.stderr)
+
+    stop = threading.Event()
+
+    def _sig(*_):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    stop.wait()
+
+    print("shutting down", file=sys.stderr)
+    rest.shutdown()
+    if grpc_api is not None:
+        grpc_api.shutdown()
+    db.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
