@@ -1,0 +1,257 @@
+"""Cluster layer tests: raft consensus, schema replication, 2PC writes with
+consistency levels, read-repair, anti-entropy, distributed search — the
+in-process analogue of the reference's cluster + clusterintegrationtest
+suites."""
+
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.cluster import (
+    ClusterNode,
+    HashTree,
+    InProcTransport,
+    ReplicationError,
+    ShardingState,
+    TcpTransport,
+    required_acks,
+)
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    FlatIndexConfig,
+    Property,
+    ReplicationConfig,
+    ShardingConfig,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+
+def wait_for(pred, timeout=8.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    registry = {}
+    nodes = []
+    ids = ["n0", "n1", "n2"]
+    for nid in ids:
+        t = InProcTransport(registry, nid)
+        nodes.append(ClusterNode(nid, ids, t, str(tmp_path / nid)))
+    wait_for(lambda: any(n.raft.is_leader() for n in nodes),
+             msg="leader election")
+    yield nodes, registry
+    for n in nodes:
+        n.close()
+
+
+def _leader(nodes):
+    for n in nodes:
+        if n.raft.is_leader():
+            return n
+    return None
+
+
+def _cfg(factor=3, shards=3, name="Doc"):
+    return CollectionConfig(
+        name=name,
+        properties=[Property(name="body")],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        sharding=ShardingConfig(desired_count=shards),
+        replication=ReplicationConfig(factor=factor),
+    )
+
+
+def _objs(n, dims=8, start=0):
+    out = []
+    for i in range(start, start + n):
+        v = np.zeros(dims, np.float32)
+        v[i % dims] = 1.0
+        out.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}",
+            collection="Doc",
+            properties={"body": f"doc {i}"},
+            vector=v,
+        ))
+    return out
+
+
+# -- unit: sharding math -----------------------------------------------------
+def test_sharding_state_and_acks():
+    st = ShardingState(nodes=["a", "b", "c"], n_shards=6, factor=2)
+    for s in range(6):
+        reps = st.replicas(s)
+        assert len(reps) == 2 and len(set(reps)) == 2
+    assert required_acks("ONE", 3) == 1
+    assert required_acks("QUORUM", 3) == 2
+    assert required_acks("ALL", 3) == 3
+    with pytest.raises(ValueError):
+        required_acks("SOME", 3)
+
+
+def test_hashtree_diff():
+    items = [(f"u{i}", 100 + i) for i in range(50)]
+    a = HashTree.build(items)
+    b = HashTree.build(items)
+    assert a.root() == b.root()
+    assert a.diff_leaves(b.leaves) == []
+    b.update("u7", 107, 999)  # version change
+    diff = a.diff_leaves(b.leaves)
+    assert len(diff) == 1
+    # incremental == rebuild
+    c = HashTree.build([(u, 999 if u == "u7" else v) for u, v in items])
+    assert c.root() == b.root()
+
+
+# -- raft --------------------------------------------------------------------
+def test_raft_single_leader_and_replication(cluster3):
+    nodes, _ = cluster3
+    leaders = [n for n in nodes if n.raft.is_leader()]
+    assert len(leaders) == 1
+    leader = leaders[0]
+    follower = next(n for n in nodes if n is not leader)
+    # submit via follower -> forwarded to leader -> applied everywhere
+    follower.create_collection(_cfg())
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+
+
+def test_raft_leader_failover(cluster3, tmp_path):
+    nodes, registry = cluster3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(name="Before"))
+    wait_for(lambda: all(n.db.has_collection("Before") for n in nodes))
+    # partition the leader away
+    lt = registry[leader.id]
+    lt.partitioned = {n.id for n in nodes if n is not leader}
+    others = [n for n in nodes if n is not leader]
+    wait_for(lambda: any(n.raft.is_leader() for n in others),
+             msg="new leader after partition")
+    new_leader = next(n for n in others if n.raft.is_leader())
+    assert new_leader.db.has_collection("Before")  # log retained
+    new_leader.create_collection(_cfg(name="After"))
+    wait_for(lambda: all(n.db.has_collection("After") for n in others))
+    # heal: old leader steps down and catches up
+    lt.partitioned = set()
+    wait_for(lambda: leader.db.has_collection("After"),
+             msg="old leader catch-up")
+    assert sum(1 for n in nodes if n.raft.is_leader()) == 1
+
+
+# -- replication data plane --------------------------------------------------
+def test_replicated_write_and_remote_read(cluster3):
+    nodes, _ = cluster3
+    _leader(nodes).create_collection(_cfg(factor=3))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes))
+    writer = nodes[0]
+    writer.put_batch("Doc", _objs(30), consistency="QUORUM")
+    # read the same object from every node (each holds a replica at f=3)
+    for n in nodes:
+        o = n.get("Doc", "00000000-0000-0000-0000-000000000007",
+                  consistency="ONE")
+        assert o is not None and o.properties["body"] == "doc 7"
+
+
+def test_write_fails_below_consistency(cluster3):
+    nodes, registry = cluster3
+    _leader(nodes).create_collection(_cfg(factor=3))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes))
+    # partition both peers away from n0: only 1 replica reachable
+    registry["n0"].partitioned = {"n1", "n2"}
+    with pytest.raises(ReplicationError):
+        nodes[0].put_batch("Doc", _objs(5), consistency="QUORUM")
+    # ONE still succeeds (local replica)
+    nodes[0].put_batch("Doc", _objs(5), consistency="ONE")
+    registry["n0"].partitioned = set()
+
+
+def test_read_repair(cluster3):
+    nodes, registry = cluster3
+    _leader(nodes).create_collection(_cfg(factor=3))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes))
+    uid = "00000000-0000-0000-0000-000000000001"
+    nodes[0].put_batch("Doc", _objs(3), consistency="ALL")
+    # n2 goes dark; update the object at consistency QUORUM (n0+n1)
+    registry["n2"].partitioned = {"n0", "n1"}
+    newer = _objs(3)
+    newer[1].properties["body"] = "updated"
+    nodes[0].put_batch("Doc", [newer[1]], consistency="QUORUM")
+    registry["n2"].partitioned = set()
+    # read at ALL sees divergence, returns newest, repairs n2
+    o = nodes[1].get("Doc", uid, consistency="ALL")
+    assert o is not None and o.properties["body"] == "updated"
+    sh = nodes[2]._state_for("Doc").shard_replicas_for_uuid(uid)[0]
+    local = nodes[2]._local_shard("Doc", sh).get_by_uuid(uid)
+    assert local is not None and local.properties["body"] == "updated"
+
+
+def test_anti_entropy_heals_partitioned_replica(cluster3):
+    nodes, registry = cluster3
+    _leader(nodes).create_collection(_cfg(factor=3))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes))
+    nodes[0].put_batch("Doc", _objs(10), consistency="ALL")
+    # n2 dark during a second wave of writes
+    registry["n2"].partitioned = {"n0", "n1"}
+    nodes[0].put_batch("Doc", _objs(10, start=10), consistency="QUORUM")
+    registry["n2"].partitioned = set()
+    moved = nodes[2].anti_entropy_once("Doc")
+    assert moved >= 10
+    for i in range(10, 20):
+        uid = f"00000000-0000-0000-0000-{i:012d}"
+        sh = nodes[2]._state_for("Doc").shard_replicas_for_uuid(uid)[0]
+        assert nodes[2]._local_shard("Doc", sh).get_by_uuid(uid) is not None
+
+
+def test_anti_entropy_respects_tombstones(cluster3):
+    nodes, registry = cluster3
+    _leader(nodes).create_collection(_cfg(factor=3))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes))
+    nodes[0].put_batch("Doc", _objs(5), consistency="ALL")
+    uid = "00000000-0000-0000-0000-000000000002"
+    # delete reaches only n0+n1 (n2 dark)
+    registry["n2"].partitioned = {"n0", "n1"}
+    time.sleep(0.01)  # ensure delete_time > write_time
+    nodes[0].delete("Doc", [uid], consistency="QUORUM")
+    registry["n2"].partitioned = set()
+    # n0 pulls from n2 during anti-entropy but must NOT resurrect the object
+    nodes[0].anti_entropy_once("Doc")
+    sh = nodes[0]._state_for("Doc").shard_replicas_for_uuid(uid)[0]
+    assert nodes[0]._local_shard("Doc", sh).get_by_uuid(uid) is None
+
+
+def test_distributed_vector_and_bm25_search(cluster3):
+    nodes, _ = cluster3
+    # factor 1: each shard lives on exactly one node -> true scatter-gather
+    _leader(nodes).create_collection(_cfg(factor=1, shards=3))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes))
+    nodes[0].put_batch("Doc", _objs(24), consistency="ONE")
+    q = np.zeros(8, np.float32)
+    q[3] = 1.0
+    for n in nodes:
+        res = n.vector_search("Doc", q, k=3)
+        assert len(res) == 3
+        assert all(int(o.uuid[-12:]) % 8 == 3 for o, _ in res)
+        assert res[0][1] == pytest.approx(0.0)
+    res = nodes[1].bm25_search("Doc", "doc 5", k=5)
+    assert res and res[0][0].properties["body"] == "doc 5"
+
+
+# -- tcp transport -----------------------------------------------------------
+def test_tcp_transport_roundtrip():
+    t1 = TcpTransport("127.0.0.1:0")
+    t2 = TcpTransport("127.0.0.1:0")
+    t1.start(lambda m: {"echo": m["x"] * 2})
+    t2.start(lambda m: {})
+    try:
+        r = t2.send(t1.node_id, {"x": 21})
+        assert r == {"echo": 42}
+    finally:
+        t1.stop()
+        t2.stop()

@@ -3,7 +3,6 @@ the analogue of the reference's grpc acceptance tests."""
 
 import json
 
-import numpy as np
 import pytest
 
 from weaviate_tpu.api.grpc_server import GrpcAPI, GrpcClient

@@ -63,6 +63,12 @@ class GrpcAPI:
 
         reply = pb.SearchReply()
 
+        if (len(req.near_vectors) > 0 and req.bm25_query
+                and not req.use_hybrid):
+            raise ValueError(
+                "near_vectors and bm25_query both set without use_hybrid: "
+                "ambiguous request (set use_hybrid for fusion)")
+
         if (len(req.near_vectors) > 1 and not req.use_hybrid
                 and not req.bm25_query):
             # the TPU fast path: all query vectors in one device batch

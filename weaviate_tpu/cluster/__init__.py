@@ -1,0 +1,26 @@
+"""Cluster layer: raft metadata consensus, sharded+replicated data plane.
+
+Reference: ``cluster/`` (raft store, router, replication engine) +
+``usecases/replica`` (coordinator/finder/repairer) + ``usecases/sharding``.
+"""
+
+from weaviate_tpu.cluster.fsm import SchemaFSM
+from weaviate_tpu.cluster.hashtree import HashTree
+from weaviate_tpu.cluster.node import ClusterNode, ReplicationError
+from weaviate_tpu.cluster.raft import NotLeader, RaftNode
+from weaviate_tpu.cluster.sharding import (
+    ShardingState,
+    required_acks,
+    shard_for_uuid,
+)
+from weaviate_tpu.cluster.transport import (
+    InProcTransport,
+    TcpTransport,
+    TransportError,
+)
+
+__all__ = [
+    "ClusterNode", "ReplicationError", "RaftNode", "NotLeader", "SchemaFSM",
+    "HashTree", "ShardingState", "shard_for_uuid", "required_acks",
+    "InProcTransport", "TcpTransport", "TransportError",
+]

@@ -1,0 +1,88 @@
+"""Schema FSM: the replicated metadata state machine.
+
+Reference: ``cluster/schema/schema.go`` (the raft FSM holding classes +
+tenants) and ``usecases/schema/executor.go`` → ``adapters/repos/db/
+migrator.go`` (applying committed schema deltas to the local DB). Every
+node applies the same command stream, so every node's DB converges to the
+same schema.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import msgpack
+
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.schema.config import CollectionConfig, DataType, Property
+
+
+class SchemaFSM:
+    def __init__(self, db: DB):
+        self.db = db
+
+    # -- command application (called from the raft apply path) ------------
+    def apply(self, cmd: dict) -> Any:
+        op = cmd.get("op")
+        try:
+            if op == "add_class":
+                cfg = CollectionConfig.from_dict(cmd["class"])
+                if not self.db.has_collection(cfg.name):
+                    self.db.create_collection(cfg)
+                return {"ok": True}
+            if op == "delete_class":
+                self.db.delete_collection(cmd["name"])
+                return {"ok": True}
+            if op == "add_property":
+                prop = Property.from_dict(cmd["property"])
+                try:
+                    self.db.add_property(cmd["class"], prop)
+                except ValueError:
+                    pass  # already exists: idempotent replay
+                return {"ok": True}
+            if op == "add_tenants":
+                col = self.db.get_collection(cmd["class"])
+                for t in cmd["tenants"]:
+                    col.add_tenant(t["name"], t.get("status", "HOT"))
+                return {"ok": True}
+            if op == "update_tenant":
+                col = self.db.get_collection(cmd["class"])
+                col.set_tenant_status(cmd["name"], cmd["status"])
+                return {"ok": True}
+            if op == "delete_tenants":
+                col = self.db.get_collection(cmd["class"])
+                for name in cmd["names"]:
+                    col.remove_tenant(name)
+                return {"ok": True}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        except (KeyError, ValueError, RuntimeError) as e:
+            return {"ok": False, "error": str(e)}
+
+    # -- snapshot / restore ------------------------------------------------
+    def snapshot(self) -> bytes:
+        state = {
+            "collections": [
+                self.db.get_collection(n).config.to_dict()
+                for n in self.db.collections()
+            ],
+            "tenants": {
+                n: self.db.get_collection(n).tenants()
+                for n in self.db.collections()
+                if self.db.get_collection(n).config.multi_tenancy.enabled
+            },
+        }
+        return msgpack.packb(state, use_bin_type=True)
+
+    def restore(self, blob: bytes) -> None:
+        state = msgpack.unpackb(blob, raw=False)
+        want = {c["name"]: c for c in state.get("collections", [])}
+        for name in list(self.db.collections()):
+            if name not in want:
+                self.db.delete_collection(name)
+        for name, cd in want.items():
+            if not self.db.has_collection(name):
+                self.db.create_collection(CollectionConfig.from_dict(cd))
+        for name, tenants in state.get("tenants", {}).items():
+            col = self.db.get_collection(name)
+            for tname, status in tenants.items():
+                col.add_tenant(tname, status)

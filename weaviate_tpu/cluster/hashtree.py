@@ -1,0 +1,61 @@
+"""Merkle hashtree for async replication (anti-entropy).
+
+Reference: ``usecases/replica/hashtree/`` — per-shard merkle trees compared
+between replicas ("hashBeat", ``shard_async_replication.go``); differing
+leaf ranges re-propagate objects. Leaves bucket objects by uuid hash; node
+digests XOR-combine child digests so single-object updates are cheap.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable
+
+
+def _digest(uuid: str, version: int) -> int:
+    h = hashlib.blake2b(f"{uuid}:{version}".encode(), digest_size=8).digest()
+    return int.from_bytes(h, "big")
+
+
+def _bucket(uuid: str, n_leaves: int) -> int:
+    h = hashlib.blake2b(uuid.encode(), digest_size=8).digest()
+    return int.from_bytes(h, "big") % n_leaves
+
+
+class HashTree:
+    """XOR-merkle over uuid→version pairs, ``n_leaves`` leaf buckets."""
+
+    def __init__(self, n_leaves: int = 256):
+        self.n_leaves = n_leaves
+        self.leaves = [0] * n_leaves
+
+    @classmethod
+    def build(cls, items: Iterable[tuple[str, int]], n_leaves: int = 256):
+        t = cls(n_leaves)
+        for uuid, version in items:
+            t.update(uuid, 0, version)
+        return t
+
+    def update(self, uuid: str, old_version: int, new_version: int) -> None:
+        b = _bucket(uuid, self.n_leaves)
+        if old_version:
+            self.leaves[b] ^= _digest(uuid, old_version)
+        if new_version:
+            self.leaves[b] ^= _digest(uuid, new_version)
+
+    def root(self) -> int:
+        r = 0
+        for leaf in self.leaves:
+            r ^= leaf
+        return r
+
+    def diff_leaves(self, other_leaves: list[int]) -> list[int]:
+        """Leaf buckets whose digests differ (other from a peer RPC)."""
+        if len(other_leaves) != self.n_leaves:
+            return list(range(self.n_leaves))
+        return [i for i in range(self.n_leaves)
+                if self.leaves[i] != other_leaves[i]]
+
+
+def bucket_of(uuid: str, n_leaves: int) -> int:
+    return _bucket(uuid, n_leaves)

@@ -1,0 +1,546 @@
+"""ClusterNode: raft-replicated schema + leaderless data replication.
+
+Reference composition (§2.9 of SURVEY.md):
+- schema mutations → RaftNode + SchemaFSM (``cluster/store.go``)
+- writes → 2-phase coordinator over the shard's replica set with tunable
+  consistency (``usecases/replica/coordinator.go:156``)
+- reads → digest-compare finder with read-repair
+  (``usecases/replica/finder.go``, ``repairer.go``)
+- searches → scatter-gather over shards, one live replica each
+  (``index.go:1928``, ``sharding/remote_index.go:303``)
+- anti-entropy → merkle hashtree sync ("hashBeat",
+  ``shard_async_replication.go``)
+
+One transport carries both raft control and data-plane messages (the
+reference splits them across ClusterService gRPC and the clusterapi HTTP
+port; the mux here keeps the same separation by message type).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as uuidlib
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from weaviate_tpu.cluster.fsm import SchemaFSM
+from weaviate_tpu.cluster.hashtree import HashTree, bucket_of
+from weaviate_tpu.cluster.raft import RaftNode
+from weaviate_tpu.cluster.sharding import (
+    ShardingState,
+    required_acks,
+    shard_for_uuid,
+)
+from weaviate_tpu.cluster.transport import TransportError
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.schema.config import CollectionConfig
+from weaviate_tpu.storage.objects import StorageObject
+
+RAFT_TYPES = {"request_vote", "append_entries", "install_snapshot",
+              "forward_apply"}
+
+
+class _RaftTransportView:
+    """The slice of the shared transport raft sees (mux by message type)."""
+
+    def __init__(self, node: "ClusterNode"):
+        self.node = node
+
+    def start(self, handler):
+        self.node._raft_handler = handler
+
+    def send(self, peer, msg, timeout=1.0):
+        return self.node.transport.send(peer, msg, timeout=timeout)
+
+    def stop(self):
+        pass
+
+
+class ReplicationError(RuntimeError):
+    pass
+
+
+class ClusterNode:
+    def __init__(self, node_id: str, peers: list[str], transport,
+                 data_dir: str, heartbeat: bool = True):
+        self.id = node_id
+        self.all_nodes = sorted(set(peers) | {node_id})
+        self.transport = transport
+        self.db = DB(f"{data_dir}/db")
+        self.fsm = SchemaFSM(self.db)
+        self._raft_handler: Optional[Callable] = None
+        self._staging: dict[str, dict] = {}
+        self._staging_lock = threading.Lock()
+        # deletion tombstones for anti-entropy resolution:
+        # (class, shard) -> {uuid: delete_time_ms}
+        self._tombstones: dict[tuple[str, int], dict[str, int]] = {}
+        self.raft = RaftNode(
+            node_id, self.all_nodes, _RaftTransportView(self),
+            apply_fn=self.fsm.apply,
+            data_dir=f"{data_dir}/raft",
+            snapshot_fn=self.fsm.snapshot,
+            restore_fn=self.fsm.restore,
+        )
+        transport.start(self._dispatch)
+        if heartbeat:
+            self.raft.start()
+
+    # -- message mux -------------------------------------------------------
+    def _dispatch(self, msg: dict) -> dict:
+        t = msg.get("type")
+        if t in RAFT_TYPES:
+            if self._raft_handler is None:
+                return {"error": "raft not ready"}
+            return self._raft_handler(msg)
+        handler = getattr(self, f"_on_{t}", None)
+        if handler is None:
+            return {"error": f"unknown message {t!r}"}
+        try:
+            return handler(msg)
+        except (KeyError, ValueError, RuntimeError) as e:
+            return {"error": str(e)}
+
+    # -- schema API (raft path) --------------------------------------------
+    def create_collection(self, cfg: CollectionConfig) -> None:
+        cfg.validate()
+        r = self.raft.submit({"op": "add_class", "class": cfg.to_dict()})
+        if not r.get("ok"):
+            raise ValueError(r.get("error", "add_class failed"))
+
+    def delete_collection(self, name: str) -> None:
+        self.raft.submit({"op": "delete_class", "name": name})
+
+    def add_tenants(self, cls: str, tenants: list[dict]) -> None:
+        r = self.raft.submit({"op": "add_tenants", "class": cls,
+                              "tenants": tenants})
+        if not r.get("ok"):
+            raise ValueError(r.get("error", "add_tenants failed"))
+
+    # -- placement ---------------------------------------------------------
+    def _state_for(self, cls: str) -> ShardingState:
+        cfg = self.db.get_collection(cls).config
+        return ShardingState(
+            nodes=self.all_nodes,
+            n_shards=max(1, cfg.sharding.desired_count),
+            factor=max(1, cfg.replication.factor),
+        )
+
+    def _local_shard(self, cls: str, shard: int, tenant: str = ""):
+        col = self.db.get_collection(cls)
+        if tenant:
+            return col._get_shard(f"tenant-{tenant}")
+        return col._get_shard(f"shard{shard}")
+
+    def _send(self, peer: str, msg: dict, timeout: float = 3.0) -> dict:
+        if peer == self.id:
+            return self._dispatch(msg)
+        return self.transport.send(peer, msg, timeout=timeout)
+
+    # -- write path: 2PC ---------------------------------------------------
+    def put_batch(self, cls: str, objs: list[StorageObject],
+                  tenant: str = "", consistency: str = "QUORUM") -> list[str]:
+        col = self.db.get_collection(cls)
+        for o in objs:
+            o.collection = cls
+            o.tenant = tenant
+        col._vectorize_missing(objs)
+        now = int(time.time() * 1000)
+        for o in objs:
+            o.update_time_ms = now
+
+        state = self._state_for(cls)
+        need = required_acks(consistency, min(state.factor,
+                                              len(state.nodes)))
+        by_shard: dict[int, list[StorageObject]] = {}
+        for o in objs:
+            by_shard.setdefault(
+                shard_for_uuid(o.uuid, state.n_shards), []).append(o)
+
+        for shard, group in by_shard.items():
+            replicas = state.replicas(shard)
+            txid = str(uuidlib.uuid4())
+            payload = {
+                "type": "replica_prepare", "txid": txid, "class": cls,
+                "tenant": tenant, "shard": shard,
+                "objects": [o.to_bytes() for o in group],
+            }
+            acked: list[str] = []
+            errors: list[str] = []
+            for rep in replicas:
+                try:
+                    r = self._send(rep, payload)
+                    if r.get("ok"):
+                        acked.append(rep)
+                    else:
+                        errors.append(f"{rep}: {r.get('error')}")
+                except TransportError as e:
+                    errors.append(f"{rep}: {e}")
+            if len(acked) < need:
+                for rep in acked:
+                    try:
+                        self._send(rep, {"type": "replica_abort",
+                                         "txid": txid})
+                    except TransportError:
+                        pass
+                raise ReplicationError(
+                    f"shard {shard}: {len(acked)}/{need} acks "
+                    f"(consistency {consistency}); errors: {errors}")
+            for rep in acked:
+                try:
+                    self._send(rep, {"type": "replica_commit", "txid": txid})
+                except TransportError:
+                    pass  # healed later by anti-entropy
+        return [o.uuid for o in objs]
+
+    def _on_replica_prepare(self, msg: dict) -> dict:
+        objs = [StorageObject.from_bytes(b) for b in msg["objects"]]
+        with self._staging_lock:
+            self._staging[msg["txid"]] = {
+                "class": msg["class"], "tenant": msg["tenant"],
+                "shard": msg["shard"], "objects": objs,
+                "staged_at": time.monotonic(),
+            }
+        return {"ok": True}
+
+    def _on_replica_commit(self, msg: dict) -> dict:
+        with self._staging_lock:
+            st = self._staging.pop(msg["txid"], None)
+        if st is None:
+            return {"ok": False, "error": "unknown txid"}
+        shard = self._local_shard(st["class"], st["shard"], st["tenant"])
+        shard.put_batch(st["objects"])
+        key = (st["class"], st["shard"])
+        tomb = self._tombstones.get(key)
+        if tomb:
+            for o in st["objects"]:
+                tomb.pop(o.uuid, None)
+        return {"ok": True}
+
+    def _on_replica_abort(self, msg: dict) -> dict:
+        with self._staging_lock:
+            self._staging.pop(msg["txid"], None)
+        return {"ok": True}
+
+    # -- delete ------------------------------------------------------------
+    def delete(self, cls: str, uuids: list[str], tenant: str = "",
+               consistency: str = "QUORUM") -> int:
+        state = self._state_for(cls)
+        need = required_acks(consistency, min(state.factor,
+                                              len(state.nodes)))
+        now = int(time.time() * 1000)
+        by_shard: dict[int, list[str]] = {}
+        for u in uuids:
+            by_shard.setdefault(shard_for_uuid(u, state.n_shards), []).append(u)
+        deleted = 0
+        for shard, group in by_shard.items():
+            acks = 0
+            counts = []
+            for rep in state.replicas(shard):
+                try:
+                    r = self._send(rep, {
+                        "type": "replica_delete", "class": cls,
+                        "tenant": tenant, "shard": shard, "uuids": group,
+                        "time_ms": now,
+                    })
+                    if "deleted" in r:
+                        acks += 1
+                        counts.append(r["deleted"])
+                except TransportError:
+                    pass
+            if acks < need:
+                raise ReplicationError(
+                    f"delete shard {shard}: {acks}/{need} acks")
+            deleted += max(counts) if counts else 0
+        return deleted
+
+    def _on_replica_delete(self, msg: dict) -> dict:
+        shard = self._local_shard(msg["class"], msg["shard"], msg["tenant"])
+        n = shard.delete(msg["uuids"])
+        tomb = self._tombstones.setdefault(
+            (msg["class"], msg["shard"]), {})
+        for u in msg["uuids"]:
+            tomb[u] = msg["time_ms"]
+        return {"deleted": n}
+
+    # -- read path: finder + read-repair -----------------------------------
+    def get(self, cls: str, uuid: str, tenant: str = "",
+            consistency: str = "QUORUM") -> Optional[StorageObject]:
+        state = self._state_for(cls)
+        shard, replicas = state.shard_replicas_for_uuid(uuid)
+        need = required_acks(consistency, min(state.factor, len(replicas)))
+        digests: dict[str, Optional[int]] = {}
+        for rep in replicas:
+            if len(digests) >= need:
+                break
+            try:
+                r = self._send(rep, {
+                    "type": "object_digest", "class": cls, "tenant": tenant,
+                    "shard": shard, "uuids": [uuid],
+                })
+                digests[rep] = r["digests"][0]
+            except (TransportError, KeyError):
+                continue
+        if len(digests) < need:
+            raise ReplicationError(
+                f"get: {len(digests)}/{need} replicas answered")
+        versions = set(digests.values())
+        if len(versions) == 1:
+            v = versions.pop()
+            if v is None:
+                return None
+            return self._fetch_one(cls, tenant, shard, uuid,
+                                   list(digests.keys()))
+        # divergence: fetch all copies, newest wins, repair stale replicas
+        best: Optional[StorageObject] = None
+        for rep in digests:
+            try:
+                r = self._send(rep, {
+                    "type": "object_fetch", "class": cls, "tenant": tenant,
+                    "shard": shard, "uuids": [uuid],
+                })
+                blob = r["objects"][0]
+                if blob is not None:
+                    o = StorageObject.from_bytes(blob)
+                    if best is None or o.update_time_ms > best.update_time_ms:
+                        best = o
+            except (TransportError, KeyError):
+                continue
+        if best is not None:
+            payload = {
+                "type": "object_push", "class": cls, "tenant": tenant,
+                "shard": shard, "objects": [best.to_bytes()],
+            }
+            for rep, v in digests.items():
+                if v != best.update_time_ms:
+                    try:
+                        self._send(rep, payload)
+                    except TransportError:
+                        pass
+        return best
+
+    def _fetch_one(self, cls, tenant, shard, uuid, replicas):
+        for rep in replicas:
+            try:
+                r = self._send(rep, {
+                    "type": "object_fetch", "class": cls, "tenant": tenant,
+                    "shard": shard, "uuids": [uuid],
+                })
+                blob = r["objects"][0]
+                return None if blob is None else StorageObject.from_bytes(blob)
+            except (TransportError, KeyError):
+                continue
+        return None
+
+    def _on_object_digest(self, msg: dict) -> dict:
+        shard = self._local_shard(msg["class"], msg["shard"],
+                                  msg.get("tenant", ""))
+        out = []
+        for u in msg["uuids"]:
+            o = shard.get_by_uuid(u)
+            out.append(None if o is None else o.update_time_ms)
+        return {"digests": out}
+
+    def _on_object_fetch(self, msg: dict) -> dict:
+        shard = self._local_shard(msg["class"], msg["shard"],
+                                  msg.get("tenant", ""))
+        out = []
+        for u in msg["uuids"]:
+            o = shard.get_by_uuid(u)
+            out.append(None if o is None else o.to_bytes())
+        return {"objects": out}
+
+    def _on_object_push(self, msg: dict) -> dict:
+        """Newest-wins upsert used by read-repair + anti-entropy."""
+        shard = self._local_shard(msg["class"], msg["shard"],
+                                  msg.get("tenant", ""))
+        tomb = self._tombstones.get((msg["class"], msg["shard"]), {})
+        applied = 0
+        for blob in msg["objects"]:
+            o = StorageObject.from_bytes(blob)
+            if tomb.get(o.uuid, 0) >= o.update_time_ms:
+                continue  # deleted after this version was written
+            existing = shard.get_by_uuid(o.uuid)
+            if existing is None or existing.update_time_ms < o.update_time_ms:
+                shard.put_batch([o])
+                applied += 1
+        return {"applied": applied}
+
+    # -- search: scatter-gather --------------------------------------------
+    def vector_search(self, cls: str, query: np.ndarray, k: int = 10,
+                      tenant: str = "", target: str = "") \
+            -> list[tuple[StorageObject, float]]:
+        state = self._state_for(cls)
+        results: list[tuple[float, bytes]] = []
+        q = np.asarray(query, np.float32)
+        for shard in range(state.n_shards):
+            got = False
+            for rep in state.replicas(shard):
+                try:
+                    r = self._send(rep, {
+                        "type": "shard_search", "class": cls,
+                        "tenant": tenant, "shard": shard,
+                        "query": q.tobytes(), "dims": q.shape[-1],
+                        "k": k, "target": target,
+                    })
+                    for dist, blob in r["hits"]:
+                        results.append((dist, blob))
+                    got = True
+                    break
+                except TransportError:
+                    continue
+            if not got:
+                raise ReplicationError(
+                    f"shard {shard}: no replica reachable")
+        results.sort(key=lambda t: t[0])
+        return [(StorageObject.from_bytes(blob), d)
+                for d, blob in results[:k]]
+
+    def _on_shard_search(self, msg: dict) -> dict:
+        shard = self._local_shard(msg["class"], msg["shard"],
+                                  msg.get("tenant", ""))
+        q = np.frombuffer(msg["query"], np.float32).reshape(1, msg["dims"])
+        res = shard.vector_search(q, msg["k"], target=msg.get("target", ""))
+        hits = []
+        for d, i in zip(res.dists[0], res.ids[0]):
+            if i < 0:
+                continue
+            o = shard.get_by_docid(int(i))
+            if o is not None:
+                hits.append((float(d), o.to_bytes()))
+        return {"hits": hits}
+
+    def bm25_search(self, cls: str, query: str, k: int = 10,
+                    tenant: str = "") -> list[tuple[StorageObject, float]]:
+        state = self._state_for(cls)
+        results: list[tuple[float, bytes]] = []
+        for shard in range(state.n_shards):
+            for rep in state.replicas(shard):
+                try:
+                    r = self._send(rep, {
+                        "type": "shard_bm25", "class": cls, "tenant": tenant,
+                        "shard": shard, "query": query, "k": k,
+                    })
+                    results.extend((s, b) for s, b in r["hits"])
+                    break
+                except TransportError:
+                    continue
+        results.sort(key=lambda t: -t[0])
+        return [(StorageObject.from_bytes(blob), s)
+                for s, blob in results[:k]]
+
+    def _on_shard_bm25(self, msg: dict) -> dict:
+        shard = self._local_shard(msg["class"], msg["shard"],
+                                  msg.get("tenant", ""))
+        space = max(shard._next_doc_id, 1)
+        ids, scores = shard.inverted.bm25_search(
+            msg["query"], msg["k"], doc_space=space)
+        hits = []
+        for i, s in zip(ids, scores):
+            o = shard.get_by_docid(int(i))
+            if o is not None:
+                hits.append((float(s), o.to_bytes()))
+        return {"hits": hits}
+
+    # -- anti-entropy (hashBeat) -------------------------------------------
+    def _shard_items(self, cls: str, shard: int, tenant: str = ""):
+        s = self._local_shard(cls, shard, tenant)
+        for key, raw in s.objects.items():
+            o = StorageObject.from_bytes(raw)
+            yield o.uuid, o.update_time_ms
+
+    def _on_hashtree_leaves(self, msg: dict) -> dict:
+        tree = HashTree.build(
+            self._shard_items(msg["class"], msg["shard"],
+                              msg.get("tenant", "")))
+        return {"leaves": tree.leaves}
+
+    def _on_hashtree_items(self, msg: dict) -> dict:
+        buckets = set(msg["buckets"])
+        out = []
+        for uuid, ver in self._shard_items(msg["class"], msg["shard"],
+                                           msg.get("tenant", "")):
+            if bucket_of(uuid, msg["n_leaves"]) in buckets:
+                out.append((uuid, ver))
+        return {"items": out}
+
+    def anti_entropy_once(self, cls: str, tenant: str = "") -> int:
+        """One hashBeat round: for every shard this node replicates, compare
+        hashtrees with peer replicas and push/pull newest versions. Returns
+        number of objects transferred."""
+        state = self._state_for(cls)
+        moved = 0
+        for shard in state.node_shards(self.id):
+            local_tree = HashTree.build(self._shard_items(cls, shard, tenant))
+            for rep in state.replicas(shard):
+                if rep == self.id:
+                    continue
+                try:
+                    r = self._send(rep, {
+                        "type": "hashtree_leaves", "class": cls,
+                        "tenant": tenant, "shard": shard,
+                    })
+                except TransportError:
+                    continue
+                diff = local_tree.diff_leaves(r["leaves"])
+                if not diff:
+                    continue
+                try:
+                    r = self._send(rep, {
+                        "type": "hashtree_items", "class": cls,
+                        "tenant": tenant, "shard": shard,
+                        "buckets": diff, "n_leaves": local_tree.n_leaves,
+                    })
+                except TransportError:
+                    continue
+                theirs = dict(r["items"])
+                mine = {
+                    u: v for u, v in self._shard_items(cls, shard, tenant)
+                    if bucket_of(u, local_tree.n_leaves) in set(diff)
+                }
+                tomb = self._tombstones.get((cls, shard), {})
+                # push objects I have newer (or they lack)
+                push = [u for u, v in mine.items()
+                        if theirs.get(u, 0) < v]
+                if push:
+                    s = self._local_shard(cls, shard, tenant)
+                    blobs = []
+                    for u in push:
+                        o = s.get_by_uuid(u)
+                        if o is not None:
+                            blobs.append(o.to_bytes())
+                    if blobs:
+                        try:
+                            rr = self._send(rep, {
+                                "type": "object_push", "class": cls,
+                                "tenant": tenant, "shard": shard,
+                                "objects": blobs,
+                            })
+                            moved += rr.get("applied", 0)
+                        except TransportError:
+                            pass
+                # pull objects they have newer (respecting my tombstones)
+                pull = [u for u, v in theirs.items()
+                        if mine.get(u, 0) < v and tomb.get(u, 0) < v]
+                if pull:
+                    try:
+                        rr = self._send(rep, {
+                            "type": "object_fetch", "class": cls,
+                            "tenant": tenant, "shard": shard, "uuids": pull,
+                        })
+                        blobs = [b for b in rr["objects"] if b is not None]
+                        if blobs:
+                            r2 = self._on_object_push({
+                                "class": cls, "tenant": tenant,
+                                "shard": shard, "objects": blobs,
+                            })
+                            moved += r2.get("applied", 0)
+                    except TransportError:
+                        pass
+        return moved
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self):
+        self.raft.stop()
+        self.db.close()

@@ -1,0 +1,517 @@
+"""Compact Raft consensus for cluster metadata.
+
+Reference: Weaviate embeds hashicorp/raft (``cluster/store.go:194``,
+``cluster/raft.go``) to replicate the schema FSM (classes, tenants, RBAC).
+This is a from-scratch implementation of the same algorithm surface the
+reference relies on: leader election (§5.2 of the Raft paper), log
+replication with the log-matching property (§5.3), commit via majority
+match, follower catch-up, term/vote/log persistence, and snapshot+truncate.
+Writes are leader-forwarded like the reference's ``cluster/rpc`` Apply path.
+
+Scope notes vs hashicorp/raft: no membership-change log entries (the peer
+set is fixed at construction, like the reference's typical static node list)
+and no pipelined AppendEntries — metadata mutation rates don't need it.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import msgpack
+
+from weaviate_tpu.cluster.transport import TransportError
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class NotLeader(RuntimeError):
+    def __init__(self, leader_hint: Optional[str]):
+        super().__init__(f"not leader; leader is {leader_hint!r}")
+        self.leader_hint = leader_hint
+
+
+@dataclass
+class LogEntry:
+    term: int
+    index: int
+    command: Any  # msgpack-serializable FSM command; None = no-op barrier
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: str,
+        peers: list[str],
+        transport,
+        apply_fn: Callable[[Any], Any],
+        data_dir: Optional[str] = None,
+        election_timeout: tuple[float, float] = (0.15, 0.3),
+        heartbeat_interval: float = 0.05,
+        snapshot_fn: Optional[Callable[[], bytes]] = None,
+        restore_fn: Optional[Callable[[bytes], None]] = None,
+        snapshot_threshold: int = 1024,
+    ):
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.transport = transport
+        self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.snapshot_threshold = snapshot_threshold
+        self.data_dir = data_dir
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+
+        # persistent state
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log: list[LogEntry] = []  # log[i].index == snapshot_index+i+1
+        self.snapshot_index = 0
+        self.snapshot_term = 0
+
+        # volatile
+        self.state = FOLLOWER
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: Optional[str] = None
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+
+        self._lock = threading.RLock()
+        self._apply_cv = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._last_heartbeat = time.monotonic()
+        self._election_timeout_range = election_timeout
+        self._heartbeat_interval = heartbeat_interval
+        self._waiting: set[int] = set()  # indexes a local apply() awaits
+        self._wait_results: dict[int, Any] = {}
+
+        self._load_persistent()
+        transport.start(self._handle)
+        self._ticker = threading.Thread(target=self._tick_loop, daemon=True)
+
+    # -- persistence -------------------------------------------------------
+    def _state_path(self):
+        return os.path.join(self.data_dir, "raft_state.bin")
+
+    def _snap_path(self):
+        return os.path.join(self.data_dir, "raft_snapshot.bin")
+
+    def _persist(self):
+        if not self.data_dir:
+            return
+        blob = msgpack.packb({
+            "term": self.current_term,
+            "voted_for": self.voted_for,
+            "snapshot_index": self.snapshot_index,
+            "snapshot_term": self.snapshot_term,
+            "log": [(e.term, e.index, e.command) for e in self.log],
+        }, use_bin_type=True)
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self._state_path())
+
+    def _load_persistent(self):
+        if not self.data_dir or not os.path.exists(self._state_path()):
+            return
+        with open(self._state_path(), "rb") as f:
+            d = msgpack.unpackb(f.read(), raw=False)
+        self.current_term = d["term"]
+        self.voted_for = d["voted_for"]
+        self.snapshot_index = d.get("snapshot_index", 0)
+        self.snapshot_term = d.get("snapshot_term", 0)
+        self.log = [LogEntry(t, i, c) for t, i, c in d["log"]]
+        if os.path.exists(self._snap_path()) and self.restore_fn:
+            with open(self._snap_path(), "rb") as f:
+                self.restore_fn(f.read())
+            self.commit_index = self.snapshot_index
+            self.last_applied = self.snapshot_index
+
+    # -- log helpers -------------------------------------------------------
+    def _last_index(self) -> int:
+        return self.log[-1].index if self.log else self.snapshot_index
+
+    def _last_term(self) -> int:
+        return self.log[-1].term if self.log else self.snapshot_term
+
+    def _entry_at(self, index: int) -> Optional[LogEntry]:
+        i = index - self.snapshot_index - 1
+        if 0 <= i < len(self.log):
+            return self.log[i]
+        return None
+
+    def _term_at(self, index: int) -> Optional[int]:
+        if index == self.snapshot_index:
+            return self.snapshot_term
+        e = self._entry_at(index)
+        return e.term if e else None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._ticker.start()
+
+    def stop(self):
+        self._stop.set()
+        self._ticker.join(timeout=2)
+        self.transport.stop()
+
+    # -- main loop ---------------------------------------------------------
+    def _tick_loop(self):
+        timeout = random.uniform(*self._election_timeout_range)
+        while not self._stop.is_set():
+            time.sleep(0.01)
+            with self._lock:
+                state = self.state
+                since = time.monotonic() - self._last_heartbeat
+            if state == LEADER:
+                self._broadcast_append()
+                time.sleep(self._heartbeat_interval)
+            elif since >= timeout:
+                self._start_election()
+                timeout = random.uniform(*self._election_timeout_range)
+
+    def _start_election(self):
+        with self._lock:
+            self.state = CANDIDATE
+            self.current_term += 1
+            self.voted_for = self.id
+            self.leader_id = None
+            term = self.current_term
+            last_idx, last_term = self._last_index(), self._last_term()
+            self._persist()
+            self._last_heartbeat = time.monotonic()
+        votes = 1
+        for peer in self.peers:
+            try:
+                r = self.transport.send(peer, {
+                    "type": "request_vote", "term": term,
+                    "candidate": self.id,
+                    "last_log_index": last_idx, "last_log_term": last_term,
+                }, timeout=0.2)
+            except TransportError:
+                continue
+            with self._lock:
+                if r.get("term", 0) > self.current_term:
+                    self._become_follower(r["term"])
+                    return
+            if r.get("granted"):
+                votes += 1
+        with self._lock:
+            if (self.state == CANDIDATE and self.current_term == term
+                    and votes * 2 > len(self.peers) + 1):
+                self._become_leader()
+
+    def _become_leader(self):
+        self.state = LEADER
+        self.leader_id = self.id
+        nxt = self._last_index() + 1
+        self.next_index = {p: nxt for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        # no-op barrier commits entries from previous terms (Raft §5.4.2)
+        self.log.append(LogEntry(self.current_term, nxt, None))
+        self._persist()
+
+    def _become_follower(self, term: int):
+        self.state = FOLLOWER
+        self.current_term = term
+        self.voted_for = None
+        self._persist()
+
+    # -- leader: replication ----------------------------------------------
+    def _broadcast_append(self):
+        for peer in self.peers:
+            threading.Thread(
+                target=self._append_to_peer, args=(peer,), daemon=True,
+            ).start()
+
+    def _append_to_peer(self, peer: str):
+        with self._lock:
+            if self.state != LEADER:
+                return
+            term = self.current_term
+            nxt = self.next_index.get(peer, self._last_index() + 1)
+            if nxt <= self.snapshot_index:
+                self._send_snapshot(peer)
+                return
+            prev_index = nxt - 1
+            prev_term = self._term_at(prev_index)
+            if prev_term is None:
+                self._send_snapshot(peer)
+                return
+            entries = [
+                (e.term, e.index, e.command)
+                for e in self.log[prev_index - self.snapshot_index:]
+            ]
+            commit = self.commit_index
+        try:
+            r = self.transport.send(peer, {
+                "type": "append_entries", "term": term, "leader": self.id,
+                "prev_log_index": prev_index, "prev_log_term": prev_term,
+                "entries": entries, "leader_commit": commit,
+            }, timeout=0.3)
+        except TransportError:
+            return
+        with self._lock:
+            if r.get("term", 0) > self.current_term:
+                self._become_follower(r["term"])
+                return
+            if self.state != LEADER or self.current_term != term:
+                return
+            if r.get("success"):
+                if entries:
+                    self.match_index[peer] = entries[-1][1]
+                    self.next_index[peer] = entries[-1][1] + 1
+                self._advance_commit()
+            else:
+                # log mismatch: back off (with the follower's conflict hint)
+                hint = r.get("conflict_index")
+                self.next_index[peer] = max(
+                    1, hint if hint else self.next_index[peer] - 1)
+
+    def _advance_commit(self):
+        # majority match, current-term entries only (Raft §5.4.2)
+        for idx in range(self._last_index(), self.commit_index, -1):
+            e = self._entry_at(idx)
+            if e is None or e.term != self.current_term:
+                continue
+            votes = 1 + sum(
+                1 for p in self.peers if self.match_index.get(p, 0) >= idx)
+            if votes * 2 > len(self.peers) + 1:
+                self.commit_index = idx
+                self._apply_committed()
+                break
+
+    def _send_snapshot(self, peer: str):
+        if not self.snapshot_fn:
+            return
+        with self._lock:
+            blob = self.snapshot_fn()
+            msg = {
+                "type": "install_snapshot", "term": self.current_term,
+                "leader": self.id,
+                "last_included_index": self.snapshot_index,
+                "last_included_term": self.snapshot_term,
+                "data": blob,
+            }
+        try:
+            r = self.transport.send(peer, msg, timeout=1.0)
+        except TransportError:
+            return
+        with self._lock:
+            if r.get("term", 0) > self.current_term:
+                self._become_follower(r["term"])
+                return
+            self.next_index[peer] = self.snapshot_index + 1
+            self.match_index[peer] = self.snapshot_index
+
+    # -- apply -------------------------------------------------------------
+    def _apply_committed(self):
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            e = self._entry_at(self.last_applied)
+            result = None
+            if e is not None and e.command is not None:
+                result = self.apply_fn(e.command)
+            # only a local apply() call consumes the result (followers
+            # would otherwise accumulate results forever)
+            if self.last_applied in self._waiting:
+                self._wait_results[self.last_applied] = result
+                self._apply_cv.notify_all()
+        self._maybe_snapshot()
+
+    def _maybe_snapshot(self):
+        if (self.snapshot_fn is None
+                or self.last_applied - self.snapshot_index
+                < self.snapshot_threshold):
+            return
+        blob = self.snapshot_fn()
+        if self.data_dir:
+            tmp = self._snap_path() + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self._snap_path())
+        cut = self.last_applied - self.snapshot_index
+        self.snapshot_term = self._term_at(self.last_applied) or self.snapshot_term
+        self.log = self.log[cut:]
+        self.snapshot_index = self.last_applied
+        self._persist()
+
+    # -- public API --------------------------------------------------------
+    def apply(self, command: Any, timeout: float = 5.0) -> Any:
+        """Replicate a command; returns the FSM's result once committed.
+        Raises NotLeader with a hint for forwarding (reference
+        ``cluster/raft_apply_endpoints.go`` leader-forward)."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeader(self.leader_id)
+            idx = self._last_index() + 1
+            self.log.append(LogEntry(self.current_term, idx, command))
+            self._waiting.add(idx)
+            self._persist()
+        self._broadcast_append()
+        deadline = time.monotonic() + timeout
+        try:
+            with self._apply_cv:
+                while idx not in self._wait_results:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(f"apply index {idx} not committed")
+                    self._apply_cv.wait(remaining)
+                return self._wait_results.pop(idx)
+        finally:
+            with self._lock:
+                self._waiting.discard(idx)
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def leader(self) -> Optional[str]:
+        with self._lock:
+            return self.leader_id
+
+    def barrier(self, timeout: float = 5.0) -> None:
+        """Linearizable read barrier: commit a no-op entry (reference
+        ``cluster/store.go`` Query with linearizable reads). ``None``
+        commands skip the FSM in ``_apply_committed``."""
+        self.apply(None, timeout=timeout)
+
+    # -- rpc handlers ------------------------------------------------------
+    def _handle(self, msg: dict) -> dict:
+        t = msg.get("type")
+        if t == "request_vote":
+            return self._on_request_vote(msg)
+        if t == "append_entries":
+            return self._on_append_entries(msg)
+        if t == "install_snapshot":
+            return self._on_install_snapshot(msg)
+        if t == "forward_apply":
+            try:
+                return {"ok": True,
+                        "result": self.apply(msg["command"])}
+            except (NotLeader, TimeoutError) as e:
+                return {"ok": False, "error": str(e),
+                        "leader": self.leader()}
+        return {"error": f"unknown message {t!r}"}
+
+    def _on_request_vote(self, msg: dict) -> dict:
+        with self._lock:
+            term = msg["term"]
+            if term > self.current_term:
+                self._become_follower(term)
+            granted = False
+            if term == self.current_term and self.voted_for in (None, msg["candidate"]):
+                up_to_date = (
+                    msg["last_log_term"] > self._last_term()
+                    or (msg["last_log_term"] == self._last_term()
+                        and msg["last_log_index"] >= self._last_index())
+                )
+                if up_to_date:
+                    granted = True
+                    self.voted_for = msg["candidate"]
+                    self._last_heartbeat = time.monotonic()
+                    self._persist()
+            return {"term": self.current_term, "granted": granted}
+
+    def _on_append_entries(self, msg: dict) -> dict:
+        with self._lock:
+            term = msg["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower(term)
+            self.leader_id = msg["leader"]
+            self._last_heartbeat = time.monotonic()
+
+            prev_index = msg["prev_log_index"]
+            prev_term = msg["prev_log_term"]
+            my_term = self._term_at(prev_index)
+            if prev_index > self.snapshot_index and my_term is None:
+                return {"term": self.current_term, "success": False,
+                        "conflict_index": self._last_index() + 1}
+            if my_term is not None and my_term != prev_term:
+                # find first index of the conflicting term
+                ci = prev_index
+                while ci > self.snapshot_index + 1 and \
+                        self._term_at(ci - 1) == my_term:
+                    ci -= 1
+                return {"term": self.current_term, "success": False,
+                        "conflict_index": ci}
+
+            for et, ei, ec in msg["entries"]:
+                existing = self._entry_at(ei)
+                if existing is not None and existing.term != et:
+                    # truncate conflicting suffix
+                    self.log = self.log[: ei - self.snapshot_index - 1]
+                    existing = None
+                if existing is None and ei > self._last_index():
+                    self.log.append(LogEntry(et, ei, ec))
+            if msg["entries"]:
+                self._persist()
+
+            if msg["leader_commit"] > self.commit_index:
+                self.commit_index = min(
+                    msg["leader_commit"], self._last_index())
+                self._apply_committed()
+            return {"term": self.current_term, "success": True}
+
+    def _on_install_snapshot(self, msg: dict) -> dict:
+        with self._lock:
+            term = msg["term"]
+            if term < self.current_term:
+                return {"term": self.current_term}
+            self._become_follower(term)
+            self.leader_id = msg["leader"]
+            self._last_heartbeat = time.monotonic()
+            idx = msg["last_included_index"]
+            if idx <= self.snapshot_index:
+                return {"term": self.current_term}
+            if self.restore_fn:
+                self.restore_fn(msg["data"])
+            if self.data_dir:
+                tmp = self._snap_path() + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(msg["data"])
+                os.replace(tmp, self._snap_path())
+            self.snapshot_index = idx
+            self.snapshot_term = msg["last_included_term"]
+            self.log = []
+            self.commit_index = max(self.commit_index, idx)
+            self.last_applied = max(self.last_applied, idx)
+            self._persist()
+            return {"term": self.current_term}
+
+    # -- leader forwarding (client-facing) ---------------------------------
+    def submit(self, command: Any, timeout: float = 5.0) -> Any:
+        """Apply locally if leader, else forward to the leader (reference
+        ``cluster/rpc/client.go`` Apply forwarding)."""
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                return self.apply(command, timeout=timeout)
+            except NotLeader as e:
+                last_err = e
+                target = e.leader_hint
+                if target and target != self.id:
+                    try:
+                        r = self.transport.send(
+                            target,
+                            {"type": "forward_apply", "command": command},
+                            timeout=timeout,
+                        )
+                        if r.get("ok"):
+                            return r.get("result")
+                        last_err = RuntimeError(r.get("error", "forward failed"))
+                    except TransportError as te:
+                        last_err = te
+                time.sleep(0.05)
+        raise TimeoutError(f"submit failed: {last_err}")

@@ -1,0 +1,52 @@
+"""Sharding state: uuid → shard → replica-set resolution.
+
+Reference: ``usecases/sharding/state.go`` (murmur-hashed virtual-shard ring)
++ ``cluster/router/router.go`` (read/write routing plans honoring the
+replication factor). The hash here is md5-derived like the Collection's
+local routing so single-node and clustered placement agree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from weaviate_tpu.utils.hashing import shard_for_uuid  # noqa: F401  (re-export)
+
+
+@dataclass
+class ShardingState:
+    """Static placement: shard i lives on factor consecutive nodes of the
+    sorted node ring (the reference assigns physical shards to nodes in the
+    schema FSM; consecutive placement is its default layout)."""
+
+    nodes: list[str]  # sorted, stable order
+    n_shards: int
+    factor: int = 1
+
+    def replicas(self, shard: int) -> list[str]:
+        n = len(self.nodes)
+        if n == 0:
+            return []
+        factor = min(self.factor, n)
+        start = shard % n
+        return [self.nodes[(start + r) % n] for r in range(factor)]
+
+    def shard_replicas_for_uuid(self, uuid: str) -> tuple[int, list[str]]:
+        s = shard_for_uuid(uuid, self.n_shards)
+        return s, self.replicas(s)
+
+    def node_shards(self, node: str) -> list[int]:
+        return [s for s in range(self.n_shards)
+                if node in self.replicas(s)]
+
+
+def required_acks(consistency: str, factor: int) -> int:
+    """ONE/QUORUM/ALL → ack count (reference ``usecases/replica``)."""
+    c = consistency.upper()
+    if c == "ONE":
+        return 1
+    if c == "ALL":
+        return factor
+    if c == "QUORUM":
+        return factor // 2 + 1
+    raise ValueError(f"unknown consistency level {consistency!r}")

@@ -1,0 +1,142 @@
+"""Cluster RPC transports.
+
+Reference: ``cluster/rpc/{server,client}.go`` (gRPC ClusterService carrying
+raft control messages + leader-forwarded applies). Two implementations:
+
+- ``InProcTransport``: wires N nodes in one process through a shared
+  registry — the testing topology the reference builds with in-memory raft
+  transports (``cluster/store_test.go``) and the in-process multi-node DB
+  suite (``adapters/repos/db/clusterintegrationtest``).
+- ``TcpTransport``: length-prefixed msgpack frames over TCP sockets for real
+  multi-process deployment.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Optional
+
+import msgpack
+
+Handler = Callable[[dict], dict]
+
+
+class TransportError(ConnectionError):
+    pass
+
+
+class InProcTransport:
+    """Shared-registry transport: node_id -> handler."""
+
+    def __init__(self, registry: dict[str, "InProcTransport"], node_id: str):
+        self.registry = registry
+        self.node_id = node_id
+        self.handler: Optional[Handler] = None
+        self.partitioned: set[str] = set()  # peers unreachable (fault inject)
+        registry[node_id] = self
+
+    def start(self, handler: Handler) -> None:
+        self.handler = handler
+
+    def send(self, peer: str, msg: dict, timeout: float = 1.0) -> dict:
+        if peer in self.partitioned:
+            raise TransportError(f"{self.node_id} -> {peer}: partitioned")
+        target = self.registry.get(peer)
+        if target is None or target.handler is None:
+            raise TransportError(f"{self.node_id} -> {peer}: unreachable")
+        if self.node_id in target.partitioned:
+            raise TransportError(f"{self.node_id} -> {peer}: partitioned")
+        return target.handler(msg)
+
+    def stop(self) -> None:
+        self.registry.pop(self.node_id, None)
+        self.handler = None
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransportError("connection closed")
+        buf += chunk
+    return buf
+
+
+class TcpTransport:
+    """Length-prefixed msgpack over TCP. Peers addressed as host:port."""
+
+    def __init__(self, bind: str = "127.0.0.1:0"):
+        host, port = bind.rsplit(":", 1)
+        self._handler: Optional[Handler] = None
+        outer = self
+
+        class _ReqHandler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        hdr = _recv_exact(self.request, 4)
+                        (n,) = struct.unpack(">I", hdr)
+                        msg = msgpack.unpackb(
+                            _recv_exact(self.request, n), raw=False)
+                        reply = outer._handler(msg) if outer._handler else {}
+                        payload = msgpack.packb(reply, use_bin_type=True)
+                        self.request.sendall(
+                            struct.pack(">I", len(payload)) + payload)
+                except (TransportError, OSError):
+                    return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, int(port)), _ReqHandler)
+        self.node_id = f"{host}:{self._server.server_address[1]}"
+        self._thread: Optional[threading.Thread] = None
+        self._conns: dict[str, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+
+    def start(self, handler: Handler) -> None:
+        self._handler = handler
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def send(self, peer: str, msg: dict, timeout: float = 1.0) -> dict:
+        payload = msgpack.packb(msg, use_bin_type=True)
+        with self._conn_lock:
+            sock = self._conns.get(peer)
+        try:
+            if sock is None:
+                host, port = peer.rsplit(":", 1)
+                sock = socket.create_connection(
+                    (host, int(port)), timeout=timeout)
+                with self._conn_lock:
+                    self._conns[peer] = sock
+            sock.settimeout(timeout)
+            sock.sendall(struct.pack(">I", len(payload)) + payload)
+            (n,) = struct.unpack(">I", _recv_exact(sock, 4))
+            return msgpack.unpackb(_recv_exact(sock, n), raw=False)
+        except (OSError, struct.error) as e:
+            with self._conn_lock:
+                self._conns.pop(peer, None)
+            try:
+                if sock is not None:
+                    sock.close()
+            except OSError:
+                pass
+            raise TransportError(f"-> {peer}: {e}") from e
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        with self._conn_lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()

@@ -8,7 +8,6 @@ fans searches out per shard and merges (``index.go:1928 objectVectorSearch``,
 
 from __future__ import annotations
 
-import hashlib
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -21,6 +20,7 @@ from weaviate_tpu.index.base import SearchResult
 from weaviate_tpu.inverted.filters import Filter
 from weaviate_tpu.schema.config import CollectionConfig
 from weaviate_tpu.storage.objects import StorageObject
+from weaviate_tpu.utils.hashing import shard_for_uuid
 
 TENANT_HOT = "HOT"
 TENANT_COLD = "COLD"
@@ -66,8 +66,7 @@ class Collection:
 
     def _shard_for_uuid(self, uuid: str) -> Shard:
         n = max(1, self.config.sharding.desired_count)
-        h = int.from_bytes(hashlib.md5(uuid.encode()).digest()[:8], "big")
-        return self._get_shard(f"shard{h % n}")
+        return self._get_shard(f"shard{shard_for_uuid(uuid, n)}")
 
     def _route(self, uuid: str, tenant: str = "") -> Shard:
         if self.config.multi_tenancy.enabled:

@@ -1,0 +1,11 @@
+"""Dependency-free placement hashing shared by local routing and the
+cluster router (reference: uuid→shard hashing in ``usecases/sharding``)."""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def shard_for_uuid(uuid: str, n_shards: int) -> int:
+    h = int.from_bytes(hashlib.md5(uuid.encode()).digest()[:8], "big")
+    return h % max(1, n_shards)
