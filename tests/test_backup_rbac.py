@@ -1,0 +1,225 @@
+"""Backup create/status/restore + RBAC authorization tests —
+mirroring the reference's backup journey tests and authz suites."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.rest import AuthConfig, RestAPI
+from weaviate_tpu.auth.rbac import Forbidden, Permission, RBACController
+from weaviate_tpu.backup import BackupError, BackupHandler, FilesystemBackend
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    FlatIndexConfig,
+    Property,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+
+def _seed_db(root, n=25):
+    db = DB(root)
+    col = db.create_collection(CollectionConfig(
+        name="Doc",
+        properties=[Property(name="body")],
+        vector_config=FlatIndexConfig(distance="l2-squared", precision="fp32"),
+    ))
+    objs = []
+    for i in range(n):
+        v = np.zeros(8, np.float32)
+        v[i % 8] = 1.0
+        objs.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Doc",
+            properties={"body": f"doc {i}"}, vector=v))
+    col.put_batch(objs)
+    return db
+
+
+# ---------------------------------------------------------------- backups
+def test_backup_roundtrip(tmp_path):
+    db = _seed_db(str(tmp_path / "db1"))
+    backend = FilesystemBackend(str(tmp_path / "backups"))
+    handler = BackupHandler(db)
+
+    status = handler.create(backend, "bk1")
+    assert status["status"] == "SUCCESS"
+    assert handler.status(backend, "bk1")["status"] == "SUCCESS"
+    # duplicate id refused
+    with pytest.raises(BackupError):
+        handler.create(backend, "bk1")
+
+    # restore into a FRESH db dir (disaster recovery)
+    db2 = DB(str(tmp_path / "db2"))
+    h2 = BackupHandler(db2)
+    out = h2.restore(backend, "bk1")
+    assert out["classes"] == ["Doc"]
+    col = db2.get_collection("Doc")
+    assert col.count() == 25
+    q = np.zeros(8, np.float32)
+    q[2] = 1.0
+    res = col.vector_search(q, k=2)
+    assert int(res[0][0].uuid[-12:]) % 8 == 2
+    # restoring over an existing class refuses
+    with pytest.raises(BackupError):
+        h2.restore(backend, "bk1")
+    db.close()
+    db2.close()
+
+
+def test_backup_include_exclude(tmp_path):
+    db = _seed_db(str(tmp_path / "db"))
+    db.create_collection(CollectionConfig(
+        name="Other", vector_config=FlatIndexConfig(precision="fp32")))
+    backend = FilesystemBackend(str(tmp_path / "bk"))
+    handler = BackupHandler(db)
+    status = handler.create(backend, "partial", include=["Other"])
+    assert status["classes"] == ["Other"]
+    meta = json.loads(backend.get_meta("partial"))
+    assert list(meta["classes"].keys()) == ["Other"]
+    db.close()
+
+
+# ---------------------------------------------------------------- rbac unit
+def test_rbac_roles_and_wildcards(tmp_path):
+    rbac = RBACController(path=str(tmp_path / "rbac.json"))
+    rbac.upsert_role("editor", [
+        {"action": "read_data", "resource": "collections/*"},
+        {"action": "create_data", "resource": "collections/Article"},
+    ])
+    rbac.assign("amy", "editor")
+    rbac.authorize("amy", "read_data", "collections/Anything")
+    rbac.authorize("amy", "create_data", "collections/Article")
+    with pytest.raises(Forbidden):
+        rbac.authorize("amy", "create_data", "collections/Other")
+    with pytest.raises(Forbidden):
+        rbac.authorize("amy", "delete_schema", "collections/Article")
+    # anonymous denied
+    with pytest.raises(Forbidden):
+        rbac.authorize(None, "read_data", "collections/Article")
+    # builtin admin
+    rbac.assign("root", "admin")
+    rbac.authorize("root", "delete_schema", "collections/X")
+    # persistence roundtrip
+    rbac2 = RBACController(path=str(tmp_path / "rbac.json"))
+    assert rbac2.user_roles("amy") == ["editor"]
+    rbac2.authorize("amy", "read_data", "collections/Z")
+    # root users always admin
+    rbac3 = RBACController(root_users=["boss"])
+    rbac3.authorize("boss", "manage_roles")
+    # builtin roles immutable
+    with pytest.raises(ValueError):
+        rbac.upsert_role("admin", [])
+    with pytest.raises(ValueError):
+        rbac.delete_role("viewer")
+    # unknown action rejected
+    with pytest.raises(ValueError):
+        rbac.upsert_role("x", [{"action": "fly"}])
+
+
+# ---------------------------------------------------------------- rest e2e
+def call(base, method, path, body=None, key=None):
+    headers = {"Content-Type": "application/json"}
+    if key:
+        headers["Authorization"] = f"Bearer {key}"
+    req = urllib.request.Request(
+        base + path,
+        data=None if body is None else json.dumps(body).encode(),
+        method=method, headers=headers)
+    try:
+        with urllib.request.urlopen(req) as r:
+            data = r.read()
+            return r.status, json.loads(data) if data else None
+    except urllib.error.HTTPError as e:
+        data = e.read()
+        return e.code, (json.loads(data) if data else None)
+
+
+@pytest.fixture
+def secured(tmp_path):
+    db = _seed_db(str(tmp_path / "db"))
+    rbac = RBACController(path=str(tmp_path / "rbac.json"),
+                          root_users=["root"])
+    rbac.upsert_role("reader", [
+        {"action": "read_data", "resource": "collections/*"},
+        {"action": "read_schema", "resource": "*"},
+    ])
+    rbac.assign("bob", "reader")
+    api = RestAPI(
+        db,
+        auth=AuthConfig(api_keys={"rootkey": "root", "bobkey": "bob"},
+                        anonymous_access=False),
+        rbac=rbac,
+    )
+    srv = api.serve(host="127.0.0.1", port=0, background=True)
+    yield f"http://127.0.0.1:{srv.server_port}"
+    api.shutdown()
+    db.close()
+
+
+def test_rest_rbac_enforcement(secured):
+    base = secured
+    # reader can read schema + data
+    assert call(base, "GET", "/v1/schema", key="bobkey")[0] == 200
+    q = {"query": "{ Get { Doc(limit: 1) { body } } }"}
+    assert call(base, "POST", "/v1/graphql", q, key="bobkey")[0] == 200
+    # ...but not write or manage
+    status, _ = call(base, "POST", "/v1/objects",
+                     {"class": "Doc", "properties": {"body": "x"},
+                      "vector": [0] * 8}, key="bobkey")
+    assert status == 403
+    assert call(base, "DELETE", "/v1/schema/Doc", key="bobkey")[0] == 403
+    assert call(base, "POST", "/v1/backups/filesystem",
+                {"id": "nope"}, key="bobkey")[0] == 403
+    # root can do everything
+    status, _ = call(base, "POST", "/v1/objects",
+                     {"class": "Doc", "properties": {"body": "x"},
+                      "vector": [0] * 8}, key="rootkey")
+    assert status == 200
+
+
+def test_rest_backup_endpoints(secured):
+    base = secured
+    status, out = call(base, "POST", "/v1/backups/filesystem",
+                       {"id": "api-bk"}, key="rootkey")
+    assert status == 200 and out["status"] == "SUCCESS"
+    status, out = call(base, "GET", "/v1/backups/filesystem/api-bk",
+                       key="rootkey")
+    assert status == 200 and out["status"] == "SUCCESS"
+    # unknown backend
+    assert call(base, "POST", "/v1/backups/s3", {"id": "x"},
+                key="rootkey")[0] == 422
+    # restore refuses while class exists
+    status, out = call(base, "POST",
+                       "/v1/backups/filesystem/api-bk/restore", {},
+                       key="rootkey")
+    assert status == 422
+    # delete class then restore brings it back
+    assert call(base, "DELETE", "/v1/schema/Doc", key="rootkey")[0] == 200
+    status, out = call(base, "POST",
+                       "/v1/backups/filesystem/api-bk/restore", {},
+                       key="rootkey")
+    assert status == 200 and out["classes"] == ["Doc"]
+    status, page = call(base, "GET", "/v1/objects?class=Doc", key="rootkey")
+    assert page["totalResults"] >= 25
+
+
+def test_rest_authz_management(secured):
+    base = secured
+    status, _ = call(base, "POST", "/v1/authz/roles",
+                     {"name": "writer",
+                      "permissions": [{"action": "create_data",
+                                       "resource": "collections/Doc"}]},
+                     key="rootkey")
+    assert status == 200
+    assert call(base, "POST", "/v1/authz/users/carol/assign",
+                {"roles": ["writer"]}, key="rootkey")[0] == 200
+    status, roles = call(base, "GET", "/v1/authz/users/carol/roles",
+                         key="rootkey")
+    assert roles == ["writer"]
+    # bob (reader) cannot manage roles
+    assert call(base, "POST", "/v1/authz/roles",
+                {"name": "evil", "permissions": []}, key="bobkey")[0] == 403
+    status, roles = call(base, "GET", "/v1/authz/roles", key="rootkey")
+    assert any(r["name"] == "writer" for r in roles)

@@ -34,16 +34,71 @@ def _np_from_vec(v: pb.Vector) -> np.ndarray:
     return np.asarray(v.values, np.float32)
 
 
+# authz action + resource for each RPC (mirrors the REST layer's mapping)
+_RPC_AUTHZ = {
+    "Search": ("read_data", lambda r: f"collections/{r.collection}"),
+    "BatchObjects": ("create_data",
+                     lambda r: None),  # per-object check in handler
+    "BatchDelete": ("delete_data", lambda r: f"collections/{r.collection}"),
+    "TenantsGet": ("read_tenants", lambda r: f"collections/{r.collection}"),
+    "Aggregate": ("read_data", lambda r: f"collections/{r.collection}"),
+}
+
+
 class GrpcAPI:
-    def __init__(self, db: DB, max_workers: int = 16):
+    def __init__(self, db: DB, max_workers: int = 16, auth=None, rbac=None):
+        """``auth``: rest.AuthConfig (API keys); ``rbac``: RBACController.
+        Both None = open access, matching the REST defaults — the reference
+        gates its gRPC plane with the same composer chain as REST."""
         self.db = db
         self.explorer = Explorer(db)
         self.max_workers = max_workers
+        self.auth = auth
+        self.rbac = rbac
         self._server: Optional[grpc.Server] = None
 
+    # -- auth --------------------------------------------------------------
+    def _principal(self, context) -> Optional[str]:
+        if self.auth is None:
+            return None
+        md = dict(context.invocation_metadata() or [])
+        header = md.get("authorization", "")
+        if header.startswith("Bearer "):
+            key = header[len("Bearer "):].strip()
+            user = self.auth.api_keys.get(key)
+            if user is None:
+                context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                              "invalid api key")
+            return user
+        if self.auth.anonymous_access:
+            return None
+        context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                      "anonymous access disabled")
+
+    def _authz(self, context, principal, action, resource):
+        if self.rbac is None:
+            return
+        from weaviate_tpu.auth.rbac import Forbidden
+
+        try:
+            self.rbac.authorize(principal, action, resource or "*")
+        except Forbidden as e:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+
     # -- rpc implementations ----------------------------------------------
-    def _wrap(self, fn):
+    def _wrap(self, name, fn):
+        action, resource_fn = _RPC_AUTHZ[name]
+
         def handler(request, context):
+            principal = self._principal(context)
+            if name == "BatchObjects":
+                if self.rbac is not None:
+                    for bo in request.objects:
+                        self._authz(context, principal, "create_data",
+                                    f"collections/{bo.collection}")
+            else:
+                self._authz(context, principal, action,
+                            resource_fn(request))
             try:
                 return fn(request)
             except KeyError as e:
@@ -229,7 +284,7 @@ class GrpcAPI:
         }
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(
-                self._wrap(fn),
+                self._wrap(name, fn),
                 request_deserializer=req_cls.FromString,
                 response_serializer=lambda msg: msg.SerializeToString(),
             )
@@ -257,9 +312,11 @@ class GrpcAPI:
 class GrpcClient:
     """Minimal client over explicit method paths (no generated stubs)."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, api_key: Optional[str] = None):
         self.channel = grpc.insecure_channel(address)
         self._methods = {}
+        self._metadata = (
+            [("authorization", f"Bearer {api_key}")] if api_key else None)
 
     def _call(self, name: str, request, reply_cls):
         m = self._methods.get(name)
@@ -270,7 +327,7 @@ class GrpcClient:
                 response_deserializer=reply_cls.FromString,
             )
             self._methods[name] = m
-        return m(request)
+        return m(request, metadata=self._metadata)
 
     def search(self, request: pb.SearchRequest) -> pb.SearchReply:
         return self._call("Search", request, pb.SearchReply)

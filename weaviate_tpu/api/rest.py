@@ -21,6 +21,7 @@ from werkzeug.wrappers import Request, Response
 
 from weaviate_tpu.api.graphql import GraphQLExecutor, where_to_filter
 from weaviate_tpu.api.schema_translate import class_from_rest, class_to_rest
+from weaviate_tpu.auth.rbac import Forbidden as _Forbidden
 from weaviate_tpu.core.db import DB
 from weaviate_tpu.storage.objects import StorageObject
 from weaviate_tpu.version import __version__
@@ -101,10 +102,16 @@ def _obj_from_rest(d: dict) -> StorageObject:
 
 
 class RestAPI:
-    def __init__(self, db: DB, auth: Optional[AuthConfig] = None):
+    def __init__(self, db: DB, auth: Optional[AuthConfig] = None,
+                 rbac=None, backup_root: Optional[str] = None):
         self.db = db
         self.auth = auth or AuthConfig()
+        self.rbac = rbac  # RBACController or None (authz disabled)
         self.graphql = GraphQLExecutor(db)
+        from weaviate_tpu.backup.handler import BackupHandler
+
+        self.backups = BackupHandler(db)
+        self.backup_root = backup_root or f"{db.root}/backups"
         self.url_map = Map([
             Rule("/v1/meta", endpoint="meta", methods=["GET"]),
             Rule("/v1/.well-known/ready", endpoint="ready", methods=["GET"]),
@@ -123,6 +130,22 @@ class RestAPI:
                  methods=["POST", "DELETE"]),
             Rule("/v1/graphql", endpoint="graphql", methods=["POST"]),
             Rule("/v1/nodes", endpoint="nodes", methods=["GET"]),
+            Rule("/v1/backups/<backend>", endpoint="backup_create",
+                 methods=["POST"]),
+            Rule("/v1/backups/<backend>/<backup_id>",
+                 endpoint="backup_status", methods=["GET"]),
+            Rule("/v1/backups/<backend>/<backup_id>/restore",
+                 endpoint="backup_restore", methods=["POST"]),
+            Rule("/v1/authz/roles", endpoint="authz_roles",
+                 methods=["GET", "POST"]),
+            Rule("/v1/authz/roles/<name>", endpoint="authz_role",
+                 methods=["GET", "DELETE"]),
+            Rule("/v1/authz/users/<user>/assign", endpoint="authz_assign",
+                 methods=["POST"]),
+            Rule("/v1/authz/users/<user>/revoke", endpoint="authz_revoke",
+                 methods=["POST"]),
+            Rule("/v1/authz/users/<user>/roles", endpoint="authz_user_roles",
+                 methods=["GET"]),
         ])
         self._server = None
         self._thread = None
@@ -133,9 +156,12 @@ class RestAPI:
         try:
             adapter = self.url_map.bind_to_environ(environ)
             endpoint, args = adapter.match()
-            self.auth.authenticate(request)
+            request.principal = self.auth.authenticate(request)
             handler = getattr(self, f"on_{endpoint}")
             response = handler(request, **args)
+        except _Forbidden as e:
+            response = _json_response(
+                {"error": [{"message": str(e)}]}, 403)
         except _ApiError as e:
             response = _json_response(
                 {"error": [{"message": e.message}]}, e.status)
@@ -147,6 +173,14 @@ class RestAPI:
             response = _json_response(
                 {"error": [{"message": str(e)}]}, 422)
         return response(environ, start_response)
+
+    def _authz(self, request: Request, action: str,
+               resource: str = "*") -> None:
+        """RBAC check (no-op when RBAC disabled, like the reference with
+        AUTHORIZATION_ADMINLIST/RBAC off)."""
+        if self.rbac is not None:
+            self.rbac.authorize(getattr(request, "principal", None),
+                                action, resource)
 
     def _body(self, request: Request) -> dict:
         try:
@@ -171,10 +205,12 @@ class RestAPI:
     # -- schema ------------------------------------------------------------
     def on_schema(self, request):
         if request.method == "GET":
+            self._authz(request, "read_schema")
             return _json_response({"classes": [
                 class_to_rest(self.db.get_collection(n).config)
                 for n in self.db.collections()
             ]})
+        self._authz(request, "create_schema")
         body = self._body(request)
         cfg = class_from_rest(body)
         try:
@@ -185,14 +221,17 @@ class RestAPI:
 
     def on_schema_class(self, request, cls):
         if request.method == "GET":
+            self._authz(request, "read_schema", f"collections/{cls}")
             if not self.db.has_collection(cls):
                 _abort(404, f"class {cls!r} not found")
             return _json_response(
                 class_to_rest(self.db.get_collection(cls).config))
+        self._authz(request, "delete_schema", f"collections/{cls}")
         self.db.delete_collection(cls)
         return Response(status=200)
 
     def on_schema_properties(self, request, cls):
+        self._authz(request, "update_schema", f"collections/{cls}")
         from weaviate_tpu.schema.config import DataType, Property
 
         body = self._body(request)
@@ -210,6 +249,9 @@ class RestAPI:
         return _json_response(body)
 
     def on_tenants(self, request, cls):
+        self._authz(request,
+                    "read_tenants" if request.method == "GET"
+                    else "update_tenants", f"collections/{cls}")
         col = self.db.get_collection(cls)
         if request.method == "GET":
             return _json_response([
@@ -237,12 +279,15 @@ class RestAPI:
             obj = _obj_from_rest(body)
             if not obj.collection:
                 _abort(422, "class required")
+            self._authz(request, "create_data",
+                        f"collections/{obj.collection}")
             col = self.db.get_collection(obj.collection)
             col.put(obj, tenant=obj.tenant)
             return _json_response(_obj_to_rest(obj))
         cls = request.args.get("class")
         if not cls:
             _abort(422, "class query param required")
+        self._authz(request, "read_data", f"collections/{cls}")
         col = self.db.get_collection(cls)
         limit = int(request.args.get("limit", 25))
         offset = int(request.args.get("offset", 0))
@@ -254,6 +299,9 @@ class RestAPI:
         })
 
     def on_object(self, request, cls, uuid):
+        action = {"GET": "read_data", "HEAD": "read_data",
+                  "DELETE": "delete_data"}.get(request.method, "update_data")
+        self._authz(request, action, f"collections/{cls}")
         col = self.db.get_collection(cls)
         tenant = request.args.get("tenant", "")
         if request.method == "HEAD":
@@ -290,6 +338,8 @@ class RestAPI:
     def on_batch_objects(self, request):
         body = self._body(request)
         if request.method == "DELETE":
+            self._authz(request, "delete_data",
+                        f"collections/{body.get('match', {}).get('class', '*')}")
             # reference batch_delete.go: {match: {class, where}, output, dryRun}
             match = body.get("match", {})
             cls = match.get("class")
@@ -308,7 +358,10 @@ class RestAPI:
                 "results": {"matches": matches, "successful": deleted,
                             "failed": 0},
             })
-        objs_json = body.get("objects", body if isinstance(body, list) else [])
+        objs_json = body if isinstance(body, list) else body.get("objects", [])
+        for oj in objs_json:
+            self._authz(request, "create_data",
+                        f"collections/{oj.get('class', '*')}")
         results = []
         by_class: dict[str, list[StorageObject]] = {}
         parsed: list[tuple[int, StorageObject]] = []
@@ -354,10 +407,24 @@ class RestAPI:
     def on_graphql(self, request):
         body = self._body(request)
         query = body.get("query", "")
+        if self.rbac is not None:
+            # authz per class the query touches (scoped read_data grants
+            # must work); parse errors fall through to the executor's
+            # error shape
+            from weaviate_tpu.api.graphql import GraphQLError, parse
+
+            try:
+                for root in parse(query):
+                    for cls in root.selections:
+                        self._authz(request, "read_data",
+                                    f"collections/{cls.name}")
+            except GraphQLError:
+                pass
         return _json_response(self.graphql.execute(query))
 
     # -- nodes -------------------------------------------------------------
     def on_nodes(self, request):
+        self._authz(request, "read_nodes")
         shards = []
         total = 0
         for name in self.db.collections():
@@ -375,6 +442,121 @@ class RestAPI:
             "stats": {"objectCount": total, "shardCount": len(shards)},
             "shards": shards,
         }]})
+
+    # -- backups -----------------------------------------------------------
+    def _backend(self, name: str):
+        from weaviate_tpu.backup.backends import make_backend
+
+        try:
+            return make_backend(name, f"{self.backup_root}/{name}")
+        except KeyError as e:
+            _abort(422, str(e))
+
+    def on_backup_create(self, request, backend):
+        self._authz(request, "manage_backups")
+        from weaviate_tpu.backup.handler import BackupError
+
+        body = self._body(request)
+        if not body.get("id"):
+            _abort(422, "backup id required")
+        try:
+            status = self.backups.create(
+                self._backend(backend), body["id"],
+                include=body.get("include"), exclude=body.get("exclude"),
+            )
+        except BackupError as e:
+            _abort(422, str(e))
+        return _json_response(status)
+
+    def on_backup_status(self, request, backend, backup_id):
+        self._authz(request, "manage_backups")
+        try:
+            return _json_response(
+                self.backups.status(self._backend(backend), backup_id))
+        except KeyError as e:
+            _abort(404, str(e))
+
+    def on_backup_restore(self, request, backend, backup_id):
+        self._authz(request, "manage_backups")
+        from weaviate_tpu.backup.handler import BackupError
+
+        body = self._body(request)
+        try:
+            out = self.backups.restore(
+                self._backend(backend), backup_id,
+                include=body.get("include"), exclude=body.get("exclude"),
+            )
+        except BackupError as e:
+            _abort(422, str(e))
+        return _json_response(out)
+
+    # -- authz (RBAC management) -------------------------------------------
+    def _rbac_or_404(self):
+        if self.rbac is None:
+            _abort(404, "RBAC is not enabled")
+        return self.rbac
+
+    def on_authz_roles(self, request):
+        rbac = self._rbac_or_404()
+        if request.method == "GET":
+            self._authz(request, "read_roles")
+            return _json_response([
+                {"name": r.name,
+                 "permissions": [{"action": p.action, "resource": p.resource}
+                                 for p in r.permissions]}
+                for r in rbac.roles.values()
+            ])
+        self._authz(request, "manage_roles")
+        body = self._body(request)
+        try:
+            role = rbac.upsert_role(body["name"],
+                                    body.get("permissions", []))
+        except ValueError as e:
+            _abort(422, str(e))
+        return _json_response({"name": role.name})
+
+    def on_authz_role(self, request, name):
+        rbac = self._rbac_or_404()
+        if request.method == "GET":
+            self._authz(request, "read_roles")
+            r = rbac.roles.get(name)
+            if r is None:
+                _abort(404, f"role {name!r} not found")
+            return _json_response({
+                "name": r.name,
+                "permissions": [{"action": p.action, "resource": p.resource}
+                                for p in r.permissions]})
+        self._authz(request, "manage_roles")
+        try:
+            rbac.delete_role(name)
+        except ValueError as e:
+            _abort(422, str(e))
+        return Response(status=204)
+
+    def on_authz_assign(self, request, user):
+        rbac = self._rbac_or_404()
+        self._authz(request, "manage_roles")
+        body = self._body(request)
+        roles = body.get("roles", [])
+        missing = [r for r in roles if r not in rbac.roles]
+        if missing:  # validate all before assigning any (no partial state)
+            _abort(404, f"roles not found: {missing}")
+        for role in roles:
+            rbac.assign(user, role)
+        return Response(status=200)
+
+    def on_authz_revoke(self, request, user):
+        rbac = self._rbac_or_404()
+        self._authz(request, "manage_roles")
+        body = self._body(request)
+        for role in body.get("roles", []):
+            rbac.revoke(user, role)
+        return Response(status=200)
+
+    def on_authz_user_roles(self, request, user):
+        rbac = self._rbac_or_404()
+        self._authz(request, "read_roles")
+        return _json_response(rbac.user_roles(user))
 
     # -- lifecycle ---------------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 8080,

@@ -1,0 +1,11 @@
+"""Authentication + authorization (reference ``usecases/auth``)."""
+
+from weaviate_tpu.auth.rbac import (
+    ACTIONS,
+    Forbidden,
+    Permission,
+    RBACController,
+    Role,
+)
+
+__all__ = ["RBACController", "Role", "Permission", "Forbidden", "ACTIONS"]

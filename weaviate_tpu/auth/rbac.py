@@ -1,0 +1,174 @@
+"""RBAC authorization: roles, permissions, user assignments.
+
+Reference: ``usecases/auth/authorization/`` (casbin-backed controller with
+roles/permissions over collections/tenants/backups/roles resources,
+raft-replicated in ``cluster/rbac``). Policies here are explicit
+action+resource-pattern pairs evaluated with fnmatch — the same
+verb/resource model without the casbin dependency — persisted to a JSON
+file (the raft FSM slot when clustered).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+# the reference's authorization verbs (authorization/authorization.go)
+ACTIONS = (
+    "read_schema", "create_schema", "update_schema", "delete_schema",
+    "read_data", "create_data", "update_data", "delete_data",
+    "read_tenants", "update_tenants",
+    "manage_backups", "read_cluster", "read_nodes",
+    "manage_roles", "read_roles",
+)
+
+
+class Forbidden(PermissionError):
+    def __init__(self, user, action, resource):
+        super().__init__(
+            f"user {user!r} is not allowed to {action} on {resource!r}")
+
+
+@dataclass
+class Permission:
+    action: str
+    resource: str = "*"  # e.g. "collections/*", "collections/Article"
+
+    def matches(self, action: str, resource: str) -> bool:
+        return (self.action == action
+                and fnmatch.fnmatchcase(resource, self.resource))
+
+
+@dataclass
+class Role:
+    name: str
+    permissions: list[Permission] = field(default_factory=list)
+
+    def allows(self, action: str, resource: str) -> bool:
+        return any(p.matches(action, resource) for p in self.permissions)
+
+
+def builtin_roles() -> dict[str, Role]:
+    """Reference built-ins: admin (everything), viewer (read-only)."""
+    return {
+        "admin": Role("admin", [Permission(a, "*") for a in ACTIONS]),
+        "viewer": Role("viewer", [
+            Permission(a, "*") for a in ACTIONS if a.startswith("read_")
+        ]),
+    }
+
+
+class RBACController:
+    def __init__(self, path: Optional[str] = None,
+                 root_users: Optional[list[str]] = None):
+        self._lock = threading.RLock()
+        self.path = path
+        self.roles: dict[str, Role] = builtin_roles()
+        self.assignments: dict[str, set[str]] = {}
+        # AUTHORIZATION_RBAC_ROOT_USERS: always admin, can't be locked out
+        self.root_users = set(root_users or [])
+        self._load()
+
+    # -- persistence -------------------------------------------------------
+    def _load(self):
+        if not self.path or not os.path.exists(self.path):
+            return
+        with open(self.path) as f:
+            d = json.load(f)
+        for rd in d.get("roles", []):
+            self.roles[rd["name"]] = Role(
+                rd["name"],
+                [Permission(**p) for p in rd.get("permissions", [])],
+            )
+        self.assignments = {
+            u: set(rs) for u, rs in d.get("assignments", {}).items()
+        }
+
+    def _persist(self):
+        if not self.path:
+            return
+        d = {
+            "roles": [
+                {"name": r.name,
+                 "permissions": [
+                     {"action": p.action, "resource": p.resource}
+                     for p in r.permissions
+                 ]}
+                for r in self.roles.values()
+                if r.name not in ("admin", "viewer")
+            ],
+            "assignments": {u: sorted(rs)
+                            for u, rs in self.assignments.items()},
+        }
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(d, f, indent=1)
+        os.replace(tmp, self.path)
+
+    # -- role management ---------------------------------------------------
+    def upsert_role(self, name: str,
+                    permissions: list[dict | Permission]) -> Role:
+        perms = []
+        for p in permissions:
+            if isinstance(p, Permission):
+                perms.append(p)
+            else:
+                perms.append(Permission(p["action"], p.get("resource", "*")))
+        for p in perms:
+            if p.action not in ACTIONS:
+                raise ValueError(f"unknown action {p.action!r}")
+        with self._lock:
+            if name in ("admin", "viewer"):
+                raise ValueError(f"built-in role {name!r} is immutable")
+            role = Role(name, perms)
+            self.roles[name] = role
+            self._persist()
+            return role
+
+    def delete_role(self, name: str) -> None:
+        with self._lock:
+            if name in ("admin", "viewer"):
+                raise ValueError(f"built-in role {name!r} is immutable")
+            self.roles.pop(name, None)
+            for rs in self.assignments.values():
+                rs.discard(name)
+            self._persist()
+
+    def assign(self, user: str, role: str) -> None:
+        with self._lock:
+            if role not in self.roles:
+                raise KeyError(f"role {role!r} not found")
+            self.assignments.setdefault(user, set()).add(role)
+            self._persist()
+
+    def revoke(self, user: str, role: str) -> None:
+        with self._lock:
+            self.assignments.get(user, set()).discard(role)
+            self._persist()
+
+    def user_roles(self, user: str) -> list[str]:
+        with self._lock:
+            roles = set(self.assignments.get(user, set()))
+            if user in self.root_users:
+                roles.add("admin")
+            return sorted(roles)
+
+    # -- the check ---------------------------------------------------------
+    def authorize(self, user: Optional[str], action: str,
+                  resource: str = "*") -> None:
+        """Raises Forbidden unless some role of the user allows it.
+        ``user=None`` (anonymous) has no roles — deny everything when RBAC
+        is on, like the reference's authz with anonymous access."""
+        with self._lock:
+            if user is not None and user in self.root_users:
+                return
+            names = self.assignments.get(user, set()) if user else set()
+            for rn in names:
+                role = self.roles.get(rn)
+                if role is not None and role.allows(action, resource):
+                    return
+        raise Forbidden(user, action, resource)

@@ -1,0 +1,11 @@
+"""Backups (reference ``usecases/backup`` + ``modules/backup-*``)."""
+
+from weaviate_tpu.backup.backends import (
+    BackupBackend,
+    FilesystemBackend,
+    make_backend,
+)
+from weaviate_tpu.backup.handler import BackupError, BackupHandler
+
+__all__ = ["BackupBackend", "FilesystemBackend", "make_backend",
+           "BackupHandler", "BackupError"]

@@ -1,0 +1,120 @@
+"""Backup storage backends.
+
+Reference: ``entities/modulecapabilities/backup.go`` SPI with
+``modules/backup-{filesystem,s3,gcs,azure}`` implementations. The filesystem
+backend is fully functional; object-store backends register only when their
+SDKs exist in the environment (they don't in this zero-egress image, so they
+surface as unavailable the way a reference deployment without the module
+enabled would).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Optional
+
+# backup ids are path components: no separators, no leading dot
+_BACKUP_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]*$")
+
+
+def validate_backup_id(backup_id: str) -> str:
+    if not _BACKUP_ID_RE.match(backup_id):
+        raise ValueError(f"invalid backup id {backup_id!r}")
+    return backup_id
+
+
+def confine(base: str, path: str) -> str:
+    """Resolve ``path`` and require it inside ``base`` (sep-aware)."""
+    rbase = os.path.realpath(base)
+    rpath = os.path.realpath(path)
+    if rpath != rbase and not rpath.startswith(rbase + os.sep):
+        raise ValueError(f"path escapes {base!r}: {path!r}")
+    return path
+
+
+class BackupBackend:
+    """SPI: write/read a backup's files under a backup-id prefix."""
+
+    name = "backend"
+
+    def put_file(self, backup_id: str, rel_path: str, src_path: str) -> None:
+        raise NotImplementedError
+
+    def get_file(self, backup_id: str, rel_path: str, dst_path: str) -> None:
+        raise NotImplementedError
+
+    def put_meta(self, backup_id: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get_meta(self, backup_id: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def list_files(self, backup_id: str) -> list[str]:
+        raise NotImplementedError
+
+    def exists(self, backup_id: str) -> bool:
+        return self.get_meta(backup_id) is not None
+
+
+class FilesystemBackend(BackupBackend):
+    """Reference ``modules/backup-filesystem``."""
+
+    name = "filesystem"
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, backup_id: str, rel: str = "") -> str:
+        validate_backup_id(backup_id)
+        base = os.path.join(self.root, backup_id)
+        return confine(base, os.path.join(base, rel))
+
+    def put_file(self, backup_id: str, rel_path: str, src_path: str) -> None:
+        dst = self._path(backup_id, rel_path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copy2(src_path, dst)
+
+    def get_file(self, backup_id: str, rel_path: str, dst_path: str) -> None:
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        shutil.copy2(self._path(backup_id, rel_path), dst_path)
+
+    def put_meta(self, backup_id: str, data: bytes) -> None:
+        p = self._path(backup_id, "backup.json")
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+
+    def get_meta(self, backup_id: str) -> Optional[bytes]:
+        p = self._path(backup_id, "backup.json")
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()
+
+    def list_files(self, backup_id: str) -> list[str]:
+        base = self._path(backup_id)
+        out = []
+        for dirpath, _dirs, files in os.walk(base):
+            for fn in files:
+                if fn == "backup.json":
+                    continue
+                full = os.path.join(dirpath, fn)
+                out.append(os.path.relpath(full, base))
+        return sorted(out)
+
+
+_REGISTRY: dict[str, type] = {"filesystem": FilesystemBackend}
+
+
+def make_backend(name: str, root: str) -> BackupBackend:
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise KeyError(
+            f"backup backend {name!r} not available (have: "
+            f"{sorted(_REGISTRY)})")
+    return cls(root)

@@ -1,0 +1,156 @@
+"""Backup coordinator: create/status/restore.
+
+Reference: ``usecases/backup/{handler,coordinator,backupper,restorer}.go`` —
+create flushes each included collection, snapshots its files to the backend
+with a meta manifest (status PENDING→TRANSFERRING→SUCCESS like the
+reference's state machine), restore copies files back and reloads the
+collections. Single-node scope here; the reference's multi-participant
+coordination rides the cluster layer later.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Optional
+
+from weaviate_tpu.backup.backends import BackupBackend
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.version import __version__
+
+STATUS_STARTED = "STARTED"
+STATUS_TRANSFERRING = "TRANSFERRING"
+STATUS_SUCCESS = "SUCCESS"
+STATUS_FAILED = "FAILED"
+
+
+class BackupError(RuntimeError):
+    pass
+
+
+class BackupHandler:
+    def __init__(self, db: DB):
+        self.db = db
+        self._lock = threading.Lock()
+        self._active: dict[str, dict] = {}  # backup_id -> live status
+
+    # -- create ------------------------------------------------------------
+    def create(self, backend: BackupBackend, backup_id: str,
+               include: Optional[list[str]] = None,
+               exclude: Optional[list[str]] = None,
+               wait: bool = True) -> dict:
+        classes = include or self.db.collections()
+        classes = [c for c in classes if c not in (exclude or [])]
+        for c in classes:
+            if not self.db.has_collection(c):
+                raise BackupError(f"class {c!r} not found")
+        status = {
+            "id": backup_id, "backend": backend.name,
+            "status": STATUS_STARTED, "classes": classes,
+            "version": __version__, "started_at": time.time(),
+            "error": None,
+        }
+        with self._lock:
+            # duplicate check under the lock covers both finished backups
+            # (backend meta) and in-flight ones (_active)
+            if backup_id in self._active and \
+                    self._active[backup_id]["status"] in (
+                        STATUS_STARTED, STATUS_TRANSFERRING):
+                raise BackupError(f"backup {backup_id!r} is in progress")
+            if backend.exists(backup_id):
+                raise BackupError(f"backup {backup_id!r} already exists")
+            self._active[backup_id] = status
+
+        def run():
+            try:
+                status["status"] = STATUS_TRANSFERRING
+                manifest: dict = {"classes": {}, "version": __version__}
+                for cls in classes:
+                    col = self.db.get_collection(cls)
+                    col.flush()
+                    files = []
+                    base = col.dir
+                    for dirpath, _dirs, fnames in os.walk(base):
+                        for fn in fnames:
+                            full = os.path.join(dirpath, fn)
+                            rel = os.path.join(
+                                cls, os.path.relpath(full, base))
+                            backend.put_file(backup_id, rel, full)
+                            files.append(rel)
+                    manifest["classes"][cls] = {
+                        "config": col.config.to_dict(),
+                        "files": files,
+                        "tenants": col.tenants(),
+                    }
+                status["status"] = STATUS_SUCCESS
+                status["completed_at"] = time.time()
+                manifest["status"] = status
+                backend.put_meta(
+                    backup_id, json.dumps(manifest).encode())
+            except Exception as e:  # backup must never crash the server
+                status["status"] = STATUS_FAILED
+                status["error"] = str(e)
+
+        if wait:
+            run()
+        else:
+            threading.Thread(target=run, daemon=True).start()
+        return dict(status)
+
+    def status(self, backend: BackupBackend, backup_id: str) -> dict:
+        with self._lock:
+            live = self._active.get(backup_id)
+        if live is not None:
+            return dict(live)
+        meta = backend.get_meta(backup_id)
+        if meta is None:
+            raise KeyError(f"backup {backup_id!r} not found")
+        return json.loads(meta).get("status", {})
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, backend: BackupBackend, backup_id: str,
+                include: Optional[list[str]] = None,
+                exclude: Optional[list[str]] = None) -> dict:
+        meta = backend.get_meta(backup_id)
+        if meta is None:
+            raise BackupError(f"backup {backup_id!r} not found")
+        manifest = json.loads(meta)
+        classes = include or list(manifest["classes"].keys())
+        classes = [c for c in classes if c not in (exclude or [])]
+        from weaviate_tpu.schema.config import CollectionConfig
+
+        restored = []
+        for cls in classes:
+            entry = manifest["classes"].get(cls)
+            if entry is None:
+                raise BackupError(f"class {cls!r} not in backup")
+            if self.db.has_collection(cls):
+                raise BackupError(
+                    f"class {cls!r} already exists; delete it before restore")
+            target_dir = os.path.join(self.db.root, cls)
+            tmp_dir = target_dir + ".restore"
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            from weaviate_tpu.backup.backends import confine
+
+            try:
+                os.makedirs(tmp_dir, exist_ok=True)
+                for rel in entry["files"]:
+                    inner = os.path.relpath(rel, cls)
+                    # a tampered manifest must not write outside tmp_dir
+                    dst = os.path.normpath(os.path.join(tmp_dir, inner))
+                    confine(tmp_dir, dst)
+                    backend.get_file(backup_id, rel, dst)
+                os.replace(tmp_dir, target_dir)
+                cfg = CollectionConfig.from_dict(entry["config"])
+                col = self.db.create_collection(cfg)
+                for tname, tstatus in entry.get("tenants", {}).items():
+                    col.add_tenant(tname, tstatus)
+                restored.append(cls)
+            except OSError as e:
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+                raise BackupError(f"restore {cls!r} failed: {e}") from e
+        return {"id": backup_id, "status": STATUS_SUCCESS,
+                "classes": restored}

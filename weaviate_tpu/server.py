@@ -14,6 +14,8 @@ Env vars (reference names where they exist):
   AUTHENTICATION_APIKEY_ALLOWED_KEYS   comma-separated keys
   AUTHENTICATION_APIKEY_USERS          comma-separated user names (parallel)
   AUTHENTICATION_ANONYMOUS_ACCESS_ENABLED  default "true"
+  AUTHORIZATION_RBAC_ENABLED           "true" to enforce RBAC
+  AUTHORIZATION_RBAC_ROOT_USERS        comma-separated always-admin users
 """
 
 from __future__ import annotations
@@ -39,6 +41,11 @@ def config_from_env() -> dict:
         if os.environ.get("AUTHENTICATION_APIKEY_ENABLED") == "true" else {},
         "anonymous": os.environ.get(
             "AUTHENTICATION_ANONYMOUS_ACCESS_ENABLED", "true") != "false",
+        "rbac_enabled": os.environ.get(
+            "AUTHORIZATION_RBAC_ENABLED") == "true",
+        "rbac_root_users": [
+            u for u in os.environ.get(
+                "AUTHORIZATION_RBAC_ROOT_USERS", "").split(",") if u],
     }
 
 
@@ -51,14 +58,20 @@ def main() -> int:
     db = DB(cfg["data_path"])
     auth = AuthConfig(api_keys=cfg["api_keys"],
                       anonymous_access=cfg["anonymous"])
-    rest = RestAPI(db, auth=auth)
+    rbac = None
+    if cfg["rbac_enabled"]:
+        from weaviate_tpu.auth.rbac import RBACController
+
+        rbac = RBACController(path=f"{cfg['data_path']}/rbac.json",
+                              root_users=cfg["rbac_root_users"])
+    rest = RestAPI(db, auth=auth, rbac=rbac)
     rest_srv = rest.serve(host="0.0.0.0", port=cfg["http_port"],
                           background=True)
     print(f"REST listening on :{rest_srv.server_port}", file=sys.stderr)
 
     grpc_api = None
     if cfg["grpc_port"]:
-        grpc_api = GrpcAPI(db)
+        grpc_api = GrpcAPI(db, auth=auth, rbac=rbac)
         port = grpc_api.serve(host="0.0.0.0", port=int(cfg["grpc_port"]))
         print(f"gRPC listening on :{port}", file=sys.stderr)
 
