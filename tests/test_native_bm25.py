@@ -1,0 +1,143 @@
+"""Native BlockMax-WAND engine tests: exactness vs the dense numpy path,
+deletes, multi-property boosts, and a perf sanity check — the analogue of
+the reference's bm25 searcher unit + benchmark suites."""
+
+import random
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.inverted.index import InvertedIndex
+from weaviate_tpu.inverted.native_bm25 import try_native_bm25
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    DataType,
+    Property,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+pytestmark = pytest.mark.skipif(
+    try_native_bm25(1.2, 0.75) is None,
+    reason="native toolchain unavailable",
+)
+
+WORDS = [
+    "alpha", "bravo", "charlie", "delta", "echo", "foxtrot", "golf",
+    "hotel", "india", "juliet", "kilo", "lima", "mike", "november",
+    "oscar", "papa", "quebec", "romeo", "sierra", "tango",
+]
+
+
+def _config():
+    return CollectionConfig(
+        name="Doc",
+        properties=[
+            Property(name="body", data_type=DataType.TEXT),
+            Property(name="title", data_type=DataType.TEXT),
+        ],
+    )
+
+
+def _make_pair(n_docs=400, seed=7):
+    """Two indexes over identical docs: one native-enabled, one dense."""
+    rng = random.Random(seed)
+    import os
+
+    native_ix = InvertedIndex(_config())
+    os.environ["WEAVIATE_TPU_NATIVE_BM25"] = "off"
+    try:
+        dense_ix = InvertedIndex(_config())
+    finally:
+        os.environ.pop("WEAVIATE_TPU_NATIVE_BM25")
+    assert native_ix.native is not None
+    assert dense_ix.native is None
+    for i in range(n_docs):
+        body = " ".join(rng.choices(WORDS, k=rng.randint(5, 60)))
+        title = " ".join(rng.choices(WORDS, k=rng.randint(1, 5)))
+        obj = StorageObject(uuid=f"u{i}", collection="Doc",
+                            properties={"body": body, "title": title})
+        obj.doc_id = i
+        native_ix.add_object(obj)
+        dense_ix.add_object(obj)
+    return native_ix, dense_ix
+
+
+def test_native_matches_dense_exactly():
+    native_ix, dense_ix = _make_pair()
+    for q in ["alpha", "alpha bravo", "tango echo kilo",
+              "november alpha alpha delta", "zulu"]:
+        for k in (1, 5, 20):
+            n_ids, n_scores = native_ix.bm25_search(q, k)
+            d_ids, d_scores = dense_ix.bm25_search(q, k)
+            assert len(n_ids) == len(d_ids), (q, k)
+            np.testing.assert_allclose(n_scores, d_scores, rtol=2e-5,
+                                       err_msg=f"query {q!r} k={k}")
+            # ids must match wherever scores are distinct; on ties accept
+            # either order but the score multiset must agree
+            assert set(n_ids) == set(d_ids) or np.allclose(
+                sorted(n_scores), sorted(d_scores), rtol=2e-5), (q, k)
+
+
+def test_native_property_boosts_match():
+    native_ix, dense_ix = _make_pair()
+    for props in (["body^2", "title"], ["title^3"], ["body", "title^0.5"]):
+        n_ids, n_scores = native_ix.bm25_search("alpha kilo", 10,
+                                                properties=props)
+        d_ids, d_scores = dense_ix.bm25_search("alpha kilo", 10,
+                                               properties=props)
+        np.testing.assert_allclose(n_scores, d_scores, rtol=2e-5)
+
+
+def test_native_deletes_respected():
+    native_ix, dense_ix = _make_pair(n_docs=50)
+    # delete every doc containing 'alpha' from both
+    victims = []
+    for i in range(50):
+        plist = native_ix.postings["body"].get("alpha", {})
+        tl = native_ix.postings["title"].get("alpha", {})
+        victims = sorted(set(plist) | set(tl))
+    for ix in (native_ix, dense_ix):
+        for d in victims:
+            obj = StorageObject(uuid=f"u{d}", collection="Doc",
+                                properties={})
+            obj.doc_id = d
+            # rebuild props from stored values for symmetric delete
+    # simpler: remove via native tombstone + python postings directly
+    for d in victims:
+        native_ix.native.remove_doc(d)
+        for prop in ("body", "title"):
+            for plist in native_ix.postings[prop].values():
+                plist.pop(d, None)
+            for plist in dense_ix.postings[prop].values():
+                plist.pop(d, None)
+    n_ids, _ = native_ix.bm25_search("alpha", 50)
+    d_ids, _ = dense_ix.bm25_search("alpha", 50)
+    assert len(n_ids) == 0 and len(d_ids) == 0
+
+
+def test_filtered_query_falls_back_to_dense():
+    native_ix, _ = _make_pair(n_docs=60)
+    allow = np.zeros(60, bool)
+    allow[:10] = True
+    ids, scores = native_ix.bm25_search("alpha bravo", 20, allow_list=allow)
+    assert all(i < 10 for i in ids)
+
+
+def test_native_wand_perf_sanity():
+    """WAND must beat the dense path comfortably on a larger corpus."""
+    import time
+
+    rng = random.Random(1)
+    native_ix, dense_ix = _make_pair(n_docs=5000, seed=1)
+    q = "alpha tango kilo"
+    native_ix.bm25_search(q, 10)  # warm (finalize postings)
+    t0 = time.perf_counter()
+    for _ in range(30):
+        native_ix.bm25_search(q, 10)
+    native_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(30):
+        dense_ix.bm25_search(q, 10)
+    dense_dt = time.perf_counter() - t0
+    # not a strict benchmark; just catch pathological slowness
+    assert native_dt < dense_dt * 3, (native_dt, dense_dt)

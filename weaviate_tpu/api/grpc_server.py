@@ -61,19 +61,13 @@ class GrpcAPI:
     def _principal(self, context) -> Optional[str]:
         if self.auth is None:
             return None
+        from weaviate_tpu.api.rest import AuthError
+
         md = dict(context.invocation_metadata() or [])
-        header = md.get("authorization", "")
-        if header.startswith("Bearer "):
-            key = header[len("Bearer "):].strip()
-            user = self.auth.api_keys.get(key)
-            if user is None:
-                context.abort(grpc.StatusCode.UNAUTHENTICATED,
-                              "invalid api key")
-            return user
-        if self.auth.anonymous_access:
-            return None
-        context.abort(grpc.StatusCode.UNAUTHENTICATED,
-                      "anonymous access disabled")
+        try:
+            return self.auth.principal_for(md.get("authorization", ""))
+        except AuthError as e:
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, str(e))
 
     def _authz(self, context, principal, action, resource):
         if self.rbac is None:
@@ -94,7 +88,18 @@ class GrpcAPI:
             if name == "BatchObjects":
                 if self.rbac is not None:
                     for bo in request.objects:
-                        self._authz(context, principal, "create_data",
+                        # upsert semantics: existing uuids need update_data
+                        act = "create_data"
+                        try:
+                            if bo.uuid and self.db.has_collection(
+                                    bo.collection) and \
+                                    self.db.get_collection(
+                                        bo.collection).exists(
+                                        bo.uuid, bo.tenant):
+                                act = "update_data"
+                        except (KeyError, ValueError, RuntimeError):
+                            pass
+                        self._authz(context, principal, act,
                                     f"collections/{bo.collection}")
             else:
                 self._authz(context, principal, action,

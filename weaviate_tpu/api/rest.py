@@ -39,18 +39,32 @@ class AuthConfig:
         self.api_keys = api_keys or {}
         self.anonymous_access = anonymous_access
 
-    def authenticate(self, request: Request) -> Optional[str]:
-        """Returns principal name, or None when anonymous. Raises 401."""
-        header = request.headers.get("Authorization", "")
+    def principal_for(self, header: str) -> Optional[str]:
+        """Transport-agnostic check of an Authorization header value.
+        Returns the principal (None = anonymous allowed); raises
+        AuthError otherwise. Shared by the REST and gRPC planes so the
+        two can't diverge."""
         if header.startswith("Bearer "):
             key = header[len("Bearer "):].strip()
             user = self.api_keys.get(key)
             if user is None:
-                _abort(401, "invalid api key")
+                raise AuthError("invalid api key")
             return user
         if self.anonymous_access:
             return None
-        _abort(401, "anonymous access disabled: provide Authorization: Bearer <key>")
+        raise AuthError(
+            "anonymous access disabled: provide Authorization: Bearer <key>")
+
+    def authenticate(self, request: Request) -> Optional[str]:
+        """Returns principal name, or None when anonymous. Raises 401."""
+        try:
+            return self.principal_for(request.headers.get("Authorization", ""))
+        except AuthError as e:
+            _abort(401, str(e))
+
+
+class AuthError(Exception):
+    pass
 
 
 class _ApiError(Exception):
@@ -174,6 +188,19 @@ class RestAPI:
                 {"error": [{"message": str(e)}]}, 422)
         return response(environ, start_response)
 
+    def _write_action(self, obj: StorageObject) -> str:
+        """Puts are upserts: writing an EXISTING uuid needs update_data,
+        not just create_data (else create-only principals could overwrite)."""
+        try:
+            if obj.uuid and obj.collection \
+                    and self.db.has_collection(obj.collection) \
+                    and self.db.get_collection(obj.collection).exists(
+                        obj.uuid, obj.tenant):
+                return "update_data"
+        except (KeyError, ValueError, RuntimeError):
+            pass
+        return "create_data"
+
     def _authz(self, request: Request, action: str,
                resource: str = "*") -> None:
         """RBAC check (no-op when RBAC disabled, like the reference with
@@ -279,7 +306,7 @@ class RestAPI:
             obj = _obj_from_rest(body)
             if not obj.collection:
                 _abort(422, "class required")
-            self._authz(request, "create_data",
+            self._authz(request, self._write_action(obj),
                         f"collections/{obj.collection}")
             col = self.db.get_collection(obj.collection)
             col.put(obj, tenant=obj.tenant)
@@ -359,9 +386,10 @@ class RestAPI:
                             "failed": 0},
             })
         objs_json = body if isinstance(body, list) else body.get("objects", [])
-        for oj in objs_json:
-            self._authz(request, "create_data",
-                        f"collections/{oj.get('class', '*')}")
+        if self.rbac is not None:
+            for oj in objs_json:
+                self._authz(request, self._write_action(_obj_from_rest(oj)),
+                            f"collections/{oj.get('class', '*')}")
         results = []
         by_class: dict[str, list[StorageObject]] = {}
         parsed: list[tuple[int, StorageObject]] = []

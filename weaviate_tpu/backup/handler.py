@@ -122,14 +122,17 @@ class BackupHandler:
         classes = [c for c in classes if c not in (exclude or [])]
         from weaviate_tpu.schema.config import CollectionConfig
 
-        restored = []
+        # validate ALL classes before touching the DB (no partial restores)
         for cls in classes:
-            entry = manifest["classes"].get(cls)
-            if entry is None:
+            if manifest["classes"].get(cls) is None:
                 raise BackupError(f"class {cls!r} not in backup")
             if self.db.has_collection(cls):
                 raise BackupError(
                     f"class {cls!r} already exists; delete it before restore")
+
+        restored = []
+        for cls in classes:
+            entry = manifest["classes"][cls]
             target_dir = os.path.join(self.db.root, cls)
             tmp_dir = target_dir + ".restore"
             shutil.rmtree(tmp_dir, ignore_errors=True)

@@ -31,12 +31,23 @@ class InvertedIndex:
         self.k1 = config.inverted_config.bm25_k1
         self.b = config.inverted_config.bm25_b
         self.stopwords = stopword_set(config.inverted_config.stopwords_preset)
+        # native BlockMax-WAND engine (C++, reference
+        # bm25_searcher_block.go); None -> dense numpy path only
+        import os as _os
+
+        self.native = None
+        if _os.environ.get("WEAVIATE_TPU_NATIVE_BM25", "on") != "off":
+            from weaviate_tpu.inverted.native_bm25 import try_native_bm25
+
+            self.native = try_native_bm25(self.k1, self.b)
         # postings[prop][term] -> {doc_id: tf}
         self.postings: dict[str, dict[str, dict[int, int]]] = defaultdict(
             lambda: defaultdict(dict)
         )
         # doc_lengths[prop] -> {doc_id: n_tokens}
         self.doc_lengths: dict[str, dict[int, int]] = defaultdict(dict)
+        # running totals so avgdl is O(1) at query time (not O(doc_count))
+        self.len_totals: dict[str, int] = defaultdict(int)
         # filter values: prop -> {doc_id: value} (scalar or list)
         self.values: dict[str, dict[int, Any]] = defaultdict(dict)
         self.doc_count = 0
@@ -74,23 +85,35 @@ class InvertedIndex:
                     texts = val if isinstance(val, list) else [val]
                     scheme = self._tokenization(prop)
                     total = 0
+                    combined: dict[str, int] = {}
                     for t in texts:
                         tf = term_frequencies(t, scheme, self.stopwords)
                         total += sum(tf.values())
                         for term, n in tf.items():
+                            combined[term] = combined.get(term, 0) + n
                             self.postings[prop][term][doc_id] = (
                                 self.postings[prop][term].get(doc_id, 0) + n
                             )
+                    prev = self.doc_lengths[prop].get(doc_id)
+                    if prev is not None:
+                        self.len_totals[prop] -= prev
                     self.doc_lengths[prop][doc_id] = total
+                    self.len_totals[prop] += total
+                    if self.native is not None and combined:
+                        self.native.add_doc(doc_id, prop, combined, total)
 
     def delete_object(self, obj: StorageObject) -> None:
         doc_id = obj.doc_id
         self.doc_count = max(0, self.doc_count - 1)
+        if self.native is not None:
+            self.native.remove_doc(doc_id)
         for prop, val in obj.properties.items():
             self.values.get(prop, {}).pop(doc_id, None)
             lengths = self.doc_lengths.get(prop)
             if lengths is not None:
-                lengths.pop(doc_id, None)
+                prev = lengths.pop(doc_id, None)
+                if prev is not None:
+                    self.len_totals[prop] -= prev
             if isinstance(val, str) or (
                 isinstance(val, list) and val and isinstance(val[0], str)
             ):
@@ -129,6 +152,31 @@ class InvertedIndex:
                 props.append((p, 1.0))
 
         n_docs = max(1, self.doc_count)
+
+        # native BlockMax-WAND hot path (unfiltered queries; the dense
+        # path below handles allow-list masking)
+        if self.native is not None and allow_list is None:
+            query_terms = []
+            for prop, boost in props:
+                prop_postings = self.postings.get(prop)
+                if not prop_postings:
+                    continue
+                lengths = self.doc_lengths.get(prop, {})
+                avg_len = (self.len_totals[prop] / len(lengths)) if lengths else 1.0
+                terms = [
+                    t for t in tokenize(query, self._tokenization(prop))
+                    if t not in self.stopwords
+                ]
+                for term in set(terms):
+                    plist = prop_postings.get(term)
+                    if not plist:
+                        continue
+                    df = len(plist)
+                    idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+                    query_terms.append(
+                        (prop, term, boost * idf, max(avg_len, 1e-9)))
+            return self.native.search(query_terms, k)
+
         space = max(
             doc_space,
             1 + max(
@@ -144,7 +192,7 @@ class InvertedIndex:
             if not prop_postings:
                 continue
             lengths = self.doc_lengths.get(prop, {})
-            avg_len = (sum(lengths.values()) / len(lengths)) if lengths else 1.0
+            avg_len = (self.len_totals[prop] / len(lengths)) if lengths else 1.0
             terms = [
                 t
                 for t in tokenize(query, self._tokenization(prop))

@@ -1,0 +1,136 @@
+"""ctypes wrapper over the C++ BlockMax-WAND BM25 engine.
+
+Reference: ``inverted/bm25_searcher_block.go`` (BlockMax-WAND). The Python
+``InvertedIndex`` keeps its dict postings as source of truth (filters,
+deletes, aggregations read them); this engine mirrors writes into native
+posting lists and serves the scoring hot path. Scores match the Python
+dense path bit-for-bit up to float32 rounding: idf and avgdl are computed
+Python-side and passed per query term.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import threading
+from typing import Optional
+
+import numpy as np
+
+from weaviate_tpu.native import NativeUnavailable, load
+
+_U64 = ctypes.POINTER(ctypes.c_uint64)
+_U32 = ctypes.POINTER(ctypes.c_uint32)
+_I64 = ctypes.POINTER(ctypes.c_int64)
+_F32 = ctypes.POINTER(ctypes.c_float)
+
+
+def _bind():
+    lib = load("bm25_wand")
+    lib.bm25_new.restype = ctypes.c_void_p
+    lib.bm25_new.argtypes = [ctypes.c_float, ctypes.c_float]
+    lib.bm25_free.argtypes = [ctypes.c_void_p]
+    lib.bm25_add_doc.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, _U64, _U32, ctypes.c_uint32,
+        ctypes.c_uint32]
+    lib.bm25_remove_doc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.bm25_compact.argtypes = [ctypes.c_void_p]
+    lib.bm25_posting_len.restype = ctypes.c_uint64
+    lib.bm25_posting_len.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.bm25_search.restype = ctypes.c_uint32
+    lib.bm25_search.argtypes = [
+        ctypes.c_void_p, _U64, _F32, _F32, ctypes.c_uint32, ctypes.c_uint32,
+        _I64, _F32]
+    lib.bm25_score_docs.argtypes = [
+        ctypes.c_void_p, _U64, _F32, _F32, ctypes.c_uint32,
+        _I64, ctypes.c_uint32, _F32]
+    return lib
+
+
+def term_id(prop: str, term: str) -> int:
+    """64-bit id for a (property, term) pair — the native engine's key."""
+    h = hashlib.blake2b(f"{prop}\x00{term}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class NativeBM25:
+    """One engine per shard; posting lists keyed by (property, term)."""
+
+    COMPACT_EVERY = 4096  # removals between full tombstone purges
+
+    def __init__(self, k1: float, b: float):
+        self._lib = _bind()  # raises NativeUnavailable when no toolchain
+        self._h = ctypes.c_void_p(self._lib.bm25_new(k1, b))
+        self._lock = threading.Lock()
+        self._removals = 0
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.bm25_free(h)
+            self._h = None
+
+    def add_doc(self, doc_id: int, prop: str,
+                term_freqs: dict[str, int], doc_len: int) -> None:
+        n = len(term_freqs)
+        if n == 0:
+            return
+        ids = (ctypes.c_uint64 * n)(
+            *(term_id(prop, t) for t in term_freqs))
+        tfs = (ctypes.c_uint32 * n)(*term_freqs.values())
+        with self._lock:
+            self._lib.bm25_add_doc(self._h, doc_id, ids, tfs, n, doc_len)
+
+    def remove_doc(self, doc_id: int) -> None:
+        with self._lock:
+            self._lib.bm25_remove_doc(self._h, doc_id)
+            self._removals += 1
+            if self._removals >= self.COMPACT_EVERY:
+                self._lib.bm25_compact(self._h)
+                self._removals = 0
+
+    def posting_len(self, prop: str, term: str) -> int:
+        with self._lock:
+            return self._lib.bm25_posting_len(self._h, term_id(prop, term))
+
+    def search(self, query_terms: list[tuple[str, str, float, float]],
+               k: int) -> tuple[np.ndarray, np.ndarray]:
+        """query_terms: [(prop, term, weight=boost*idf, avgdl)].
+        Returns (doc_ids, scores) descending."""
+        n = len(query_terms)
+        if n == 0 or k == 0:
+            return np.empty(0, np.int64), np.empty(0, np.float32)
+        ids = (ctypes.c_uint64 * n)(
+            *(term_id(p, t) for p, t, _, _ in query_terms))
+        ws = (ctypes.c_float * n)(*(w for _, _, w, _ in query_terms))
+        ads = (ctypes.c_float * n)(*(a for _, _, _, a in query_terms))
+        out_docs = (ctypes.c_int64 * k)()
+        out_scores = (ctypes.c_float * k)()
+        with self._lock:
+            m = self._lib.bm25_search(self._h, ids, ws, ads, n, k,
+                                      out_docs, out_scores)
+        return (np.ctypeslib.as_array(out_docs)[:m].astype(np.int64),
+                np.ctypeslib.as_array(out_scores)[:m].astype(np.float32))
+
+    def score_docs(self, query_terms: list[tuple[str, str, float, float]],
+                   doc_ids: np.ndarray) -> np.ndarray:
+        n = len(query_terms)
+        nd = len(doc_ids)
+        out = (ctypes.c_float * nd)()
+        if n == 0 or nd == 0:
+            return np.zeros(nd, np.float32)
+        ids = (ctypes.c_uint64 * n)(
+            *(term_id(p, t) for p, t, _, _ in query_terms))
+        ws = (ctypes.c_float * n)(*(w for _, _, w, _ in query_terms))
+        ads = (ctypes.c_float * n)(*(a for _, _, _, a in query_terms))
+        docs = (ctypes.c_int64 * nd)(*[int(d) for d in doc_ids])
+        with self._lock:
+            self._lib.bm25_score_docs(self._h, ids, ws, ads, n, docs, nd, out)
+        return np.ctypeslib.as_array(out).astype(np.float32).copy()
+
+
+def try_native_bm25(k1: float, b: float) -> Optional[NativeBM25]:
+    try:
+        return NativeBM25(k1, b)
+    except NativeUnavailable:
+        return None

@@ -1,0 +1,70 @@
+"""Native (C++) components, compiled on demand with the system toolchain.
+
+The reference ships pre-generated assembly kernels linked by the Go
+toolchain (SURVEY.md §2.8); here the native tier is C++ compiled once at
+first use (g++ -O3 -march=native) and cached next to the sources. Every
+native component has a pure-Python fallback — import failures degrade, not
+crash.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS: dict[str, Optional[ctypes.CDLL]] = {}
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build(name: str) -> str:
+    src = os.path.join(_DIR, f"{name}.cpp")
+    out = os.path.join(_DIR, f"lib{name}.so")
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    tmp = out + ".tmp.so"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-march=native", "-o", tmp, src]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True,
+                       timeout=120)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        raise NativeUnavailable(
+            f"building {name}: {detail[:2000]}") from e
+    os.replace(tmp, out)
+    return out
+
+
+def load(name: str) -> ctypes.CDLL:
+    """Load (building if needed) a native library by basename."""
+    with _LOCK:
+        if name in _LIBS:
+            lib = _LIBS[name]
+            if lib is None:
+                raise NativeUnavailable(f"{name} previously failed to build")
+            return lib
+        try:
+            lib = ctypes.CDLL(_build(name))
+            _LIBS[name] = lib
+            return lib
+        except (NativeUnavailable, OSError) as e:
+            _LIBS[name] = None
+            raise NativeUnavailable(str(e)) from e
+
+
+def available(name: str) -> bool:
+    try:
+        load(name)
+        return True
+    except NativeUnavailable:
+        return False
