@@ -1,0 +1,213 @@
+"""Ops subsystems: cycle manager, metrics, slow-query log, object TTL,
+async index queue — mirroring the reference's cyclemanager/monitoring/
+queue test coverage."""
+
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.monitoring.metrics import Registry
+from weaviate_tpu.monitoring.slow_query import SlowQueryReporter
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    FlatIndexConfig,
+    Property,
+)
+from weaviate_tpu.storage.objects import StorageObject
+from weaviate_tpu.utils.cycles import CycleManager
+
+
+def _objs(n, dims=8, start=0):
+    out = []
+    for i in range(start, start + n):
+        v = np.zeros(dims, np.float32)
+        v[i % dims] = 1.0
+        out.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Doc",
+            properties={"body": f"doc {i}"}, vector=v))
+    return out
+
+
+# ---------------------------------------------------------------- cycles
+def test_cycle_manager_runs_and_backs_off():
+    cm = CycleManager(tick=0.01)
+    ran = []
+    fails = []
+
+    def ok():
+        ran.append(1)
+
+    def bad():
+        fails.append(1)
+        raise RuntimeError("boom")
+
+    cm.register("ok", ok, interval=0.02)
+    cm.register("bad", bad, interval=0.02)
+    cm.start()
+    time.sleep(0.3)
+    cm.stop()
+    assert len(ran) >= 3
+    # backoff: far fewer failure runs than the interval would allow
+    assert 1 <= len(fails) < len(ran)
+    st = cm.stats()
+    assert st["ok"]["errors"] == 0 and st["bad"]["errors"] == len(fails)
+
+
+def test_cycle_run_now():
+    cm = CycleManager()
+    hits = []
+    cm.register("x", lambda: hits.append(1), interval=3600)
+    cm.run_now("x")
+    assert hits == [1]
+
+
+# ---------------------------------------------------------------- metrics
+def test_metrics_registry_render():
+    reg = Registry()
+    c = reg.counter("test_total", "help text")
+    c.inc(type="a")
+    c.inc(2, type="a")
+    c.inc(type="b")
+    g = reg.gauge("test_gauge")
+    g.set(42, shard="s0")
+    h = reg.histogram("test_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.render_text()
+    assert 'test_total{type="a"} 3.0' in text
+    assert 'test_gauge{shard="s0"} 42.0' in text
+    assert 'test_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_seconds_bucket{le="+Inf"} 3' in text
+    assert "test_seconds_count 3" in text
+    with pytest.raises(TypeError):
+        reg.gauge("test_total")  # kind clash
+
+
+def test_query_metrics_increment(tmp_dbdir):
+    from weaviate_tpu.monitoring.metrics import QUERIES_TOTAL
+
+    db = DB(tmp_dbdir)
+    col = db.create_collection(CollectionConfig(
+        name="Doc", properties=[Property(name="body")],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32")))
+    col.put_batch(_objs(10))
+    before_v = QUERIES_TOTAL.value(type="vector", collection="Doc")
+    before_b = QUERIES_TOTAL.value(type="bm25", collection="Doc")
+    col.vector_search(np.zeros(8, np.float32), k=3)
+    col.bm25_search("doc", 3)
+    assert QUERIES_TOTAL.value(type="vector", collection="Doc") == before_v + 1
+    assert QUERIES_TOTAL.value(type="bm25", collection="Doc") == before_b + 1
+    db.close()
+
+
+# ---------------------------------------------------------------- slow query
+def test_slow_query_reporter_logs(caplog):
+    rep = SlowQueryReporter(threshold_s=0.0)
+    with caplog.at_level(logging.WARNING, "weaviate_tpu.slow_query"):
+        with rep.track("vector", collection="C") as tr:
+            tr.stage("filter")
+            tr.stage("search")
+    assert any("slow vector query" in r.message for r in caplog.records)
+
+    rep2 = SlowQueryReporter(threshold_s=10.0)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, "weaviate_tpu.slow_query"):
+        with rep2.track("vector") as tr:
+            pass
+    assert not caplog.records  # under threshold: silent
+
+
+# ---------------------------------------------------------------- TTL
+def test_object_ttl_expiry(tmp_dbdir):
+    db = DB(tmp_dbdir)
+    col = db.create_collection(CollectionConfig(
+        name="Doc", properties=[Property(name="body")],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        object_ttl_seconds=1000))
+    objs = _objs(10)
+    # 5 old objects (created 2000s ago), 5 fresh
+    old_ms = int((time.time() - 2000) * 1000)
+    for o in objs[:5]:
+        o.creation_time_ms = old_ms
+    col.put_batch(objs)
+    assert col.count() == 10
+    removed = col.expire_ttl_once()
+    assert removed == 5
+    assert col.count() == 5
+    # survivors are the fresh ones
+    for i in range(5, 10):
+        assert col.get(f"00000000-0000-0000-0000-{i:012d}") is not None
+    db.close()
+
+
+# ---------------------------------------------------------------- async queue
+def test_async_indexing_queue(tmp_dbdir):
+    db = DB(tmp_dbdir)
+    col = db.create_collection(CollectionConfig(
+        name="Doc", properties=[Property(name="body")],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        async_indexing=True))
+    shard = col._shards["shard0"]
+    assert shard.async_queue is not None
+    col.put_batch(_objs(40))
+    # drain synchronously and search
+    shard.async_queue.flush()
+    q = np.zeros(8, np.float32)
+    q[3] = 1.0
+    res = col.vector_search(q, k=3)
+    assert res and int(res[0][0].uuid[-12:]) % 8 == 3
+
+    # deleted-while-queued docs must not be indexed on drain
+    col.put_batch(_objs(8, start=100))
+    col.delete([f"00000000-0000-0000-0000-{100:012d}"])
+    shard.async_queue.flush()
+    idx = shard.vector_index()
+    assert not idx.contains(
+        shard._next_doc_id - 8), "deleted doc resurrected"
+    db.close()
+
+
+def test_async_queue_background_drain(tmp_dbdir):
+    db = DB(tmp_dbdir)
+    col = db.create_collection(CollectionConfig(
+        name="Doc", properties=[Property(name="body")],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        async_indexing=True))
+    col.put_batch(_objs(16))
+    shard = col._shards["shard0"]
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        idx = shard.vector_index()
+        if idx is not None and idx.count() >= 16:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("background drain never indexed the batch")
+    db.close()
+
+
+def test_metrics_endpoint(tmp_dbdir):
+    import json as _json
+    import urllib.request
+
+    from weaviate_tpu.api.rest import RestAPI
+
+    db = DB(tmp_dbdir)
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0, background=True)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.server_port}/metrics") as r:
+            text = r.read().decode()
+        assert "# TYPE weaviate_tpu_queries_total counter" in text
+    finally:
+        api.shutdown()
+        db.close()

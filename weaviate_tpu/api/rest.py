@@ -144,6 +144,7 @@ class RestAPI:
                  methods=["POST", "DELETE"]),
             Rule("/v1/graphql", endpoint="graphql", methods=["POST"]),
             Rule("/v1/nodes", endpoint="nodes", methods=["GET"]),
+            Rule("/metrics", endpoint="metrics", methods=["GET"]),
             Rule("/v1/backups/<backend>", endpoint="backup_create",
                  methods=["POST"]),
             Rule("/v1/backups/<backend>/<backup_id>",
@@ -449,6 +450,15 @@ class RestAPI:
             except GraphQLError:
                 pass
         return _json_response(self.graphql.execute(query))
+
+    # -- metrics -----------------------------------------------------------
+    def on_metrics(self, request):
+        """Prometheus text exposition (reference serves these on :2112
+        without authz; same here)."""
+        from weaviate_tpu.monitoring.metrics import REGISTRY
+
+        return Response(REGISTRY.render_text(),
+                        content_type="text/plain; version=0.0.4")
 
     # -- nodes -------------------------------------------------------------
     def on_nodes(self, request):

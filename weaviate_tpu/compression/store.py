@@ -66,18 +66,28 @@ class DeviceArraySet:
     def __getitem__(self, name: str) -> jnp.ndarray:
         return self._arrays[name]
 
+    def snapshot(self) -> tuple[dict[str, jnp.ndarray], jnp.ndarray]:
+        """Consistent (arrays, valid) pair for search threads — mutations
+        swap whole containers, never edit them in place."""
+        return self._arrays, self._valid
+
     def ensure_capacity(self, min_capacity: int) -> None:
         if min_capacity <= self.capacity:
             return
         new_cap = _round_up(max(min_capacity, self.capacity * 2))
+        grown: dict[str, jnp.ndarray] = {}
         for name, arr in self._arrays.items():
             na = jnp.zeros((new_cap, *arr.shape[1:]), arr.dtype)
-            self._arrays[name] = na.at[: arr.shape[0]].set(arr)
-        self._valid = (
+            grown[name] = na.at[: arr.shape[0]].set(arr)
+        new_valid = (
             jnp.zeros((new_cap,), jnp.bool_).at[: self._valid.shape[0]].set(self._valid)
         )
         hv = np.zeros((new_cap,), bool)
         hv[: len(self._host_valid)] = self._host_valid
+        # swap containers atomically AFTER all arrays are built so a
+        # concurrent reader never mixes capacities
+        self._arrays = grown
+        self._valid = new_valid
         self._host_valid = hv
 
     def put(self, doc_ids: np.ndarray, values: dict[str, np.ndarray]) -> None:
@@ -86,11 +96,11 @@ class DeviceArraySet:
             return
         self.ensure_capacity(int(doc_ids.max()) + 1)
         idx = jnp.asarray(doc_ids)
+        updated = dict(self._arrays)
         for name, val in values.items():
-            arr = self._arrays[name]
-            self._arrays[name] = arr.at[idx].set(
-                jnp.asarray(val, arr.dtype)
-            )
+            arr = updated[name]
+            updated[name] = arr.at[idx].set(jnp.asarray(val, arr.dtype))
+        self._arrays = updated
         self._valid = self._valid.at[idx].set(True)
         prev = self._host_valid[doc_ids]
         self._host_valid[doc_ids] = True

@@ -1,0 +1,148 @@
+"""Disk-backed async vector-index queue with checkpointed drain.
+
+Reference: ``adapters/repos/db/queue/`` (scheduler + disk chunks) and
+``indexcheckpoint/`` — with ASYNC_INDEXING on, vectors enqueue to disk
+chunks and background workers batch-feed the vector index, keeping imports
+non-blocking and device batches large (the TPU-side win: drains coalesce
+many small puts into one big add_batch device call).
+
+Durability: a chunk file is fully written before push returns; on restart
+the shard's recovery rebuild re-feeds vectors from the object store
+(add_batch is idempotent), so leftover chunks are simply discarded.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+import msgpack
+import numpy as np
+
+from weaviate_tpu.monitoring.metrics import ASYNC_QUEUE_SIZE
+
+
+class AsyncVectorQueue:
+    def __init__(
+        self,
+        dirpath: str,
+        index_for: Callable[[str, int], object],
+        is_live: Callable[[int], bool],
+        shard_label: str = "",
+        interval: float = 0.25,
+        max_files_per_drain: int = 64,
+    ):
+        self.dir = dirpath
+        self.index_for = index_for
+        self.is_live = is_live
+        self.label = shard_label
+        self.interval = interval
+        self.max_files_per_drain = max_files_per_drain
+        os.makedirs(dirpath, exist_ok=True)
+        self._lock = threading.Lock()
+        self._drain_lock = threading.Lock()  # one drainer at a time
+        self._seq = 0
+        self._pending_vectors = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # discard leftover chunks: recovery re-fed the index from the store
+        for fn in os.listdir(dirpath):
+            if fn.startswith("q-"):
+                os.unlink(os.path.join(dirpath, fn))
+
+    # -- enqueue -----------------------------------------------------------
+    def push(self, target: str, doc_ids: np.ndarray,
+             vectors: np.ndarray) -> None:
+        frame = msgpack.packb({
+            "target": target,
+            "ids": np.asarray(doc_ids, np.int64).tobytes(),
+            "vecs": np.asarray(vectors, np.float32).tobytes(),
+            "n": int(len(doc_ids)),
+            "d": int(vectors.shape[-1]),
+        }, use_bin_type=True)
+        with self._lock:
+            path = os.path.join(self.dir, f"q-{self._seq:012d}.bin")
+            self._seq += 1
+            with open(path + ".tmp", "wb") as f:
+                f.write(frame)
+            os.replace(path + ".tmp", path)
+            self._pending_vectors += len(doc_ids)
+        ASYNC_QUEUE_SIZE.set(self._pending_vectors, shard=self.label)
+
+    def size(self) -> int:
+        return self._pending_vectors
+
+    # -- drain -------------------------------------------------------------
+    def _chunk_files(self) -> list[str]:
+        return sorted(
+            fn for fn in os.listdir(self.dir)
+            if fn.startswith("q-") and fn.endswith(".bin"))
+
+    def drain_once(self) -> int:
+        """Apply up to max_files_per_drain chunks; returns vectors indexed."""
+        with self._drain_lock:
+            return self._drain_locked()
+
+    def _drain_locked(self) -> int:
+        files = self._chunk_files()[: self.max_files_per_drain]
+        if not files:
+            return 0
+        by_target: dict[str, tuple[list, list]] = {}
+        for fn in files:
+            with open(os.path.join(self.dir, fn), "rb") as f:
+                d = msgpack.unpackb(f.read(), raw=False)
+            ids = np.frombuffer(d["ids"], np.int64)
+            vecs = np.frombuffer(d["vecs"], np.float32).reshape(
+                d["n"], d["d"])
+            b = by_target.setdefault(d["target"], ([], []))
+            b[0].append(ids)
+            b[1].append(vecs)
+        applied = 0
+        for target, (id_arrs, vec_arrs) in by_target.items():
+            ids = np.concatenate(id_arrs)
+            vecs = np.concatenate(vec_arrs)
+            # docs deleted while queued must not resurrect in the index
+            live = np.asarray([self.is_live(int(i)) for i in ids], bool)
+            if live.any():
+                idx = self.index_for(target, vecs.shape[-1])
+                idx.add_batch(ids[live], vecs[live])
+                applied += int(live.sum())
+        for fn in files:
+            os.unlink(os.path.join(self.dir, fn))
+        drained = sum(len(a) for arrs, _ in by_target.values() for a in arrs)
+        with self._lock:
+            self._pending_vectors = max(0, self._pending_vectors - drained)
+        ASYNC_QUEUE_SIZE.set(self._pending_vectors, shard=self.label)
+        return applied
+
+    def flush(self) -> None:
+        """Drain everything synchronously (shard flush/close path)."""
+        while self._chunk_files():
+            self.drain_once()
+
+    # -- scheduler ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"vindex-queue-{self.label}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.drain_once()
+            except Exception:  # noqa: BLE001 — background drain must survive
+                import logging
+
+                logging.getLogger("weaviate_tpu.queue").exception(
+                    "async drain failed")

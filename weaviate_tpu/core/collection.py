@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
@@ -167,6 +168,9 @@ class Collection:
 
     # -- writes -----------------------------------------------------------
     def put_batch(self, objs: list[StorageObject], tenant: str = "") -> list[str]:
+        from weaviate_tpu.monitoring.metrics import BATCH_DURATION
+
+        t0 = time.perf_counter()
         for o in objs:
             o.collection = self.config.name
             o.tenant = tenant
@@ -177,6 +181,8 @@ class Collection:
             by_shard.setdefault(shard.name, []).append(o)
         for name, group in by_shard.items():
             self._shards[name].put_batch(group)
+        BATCH_DURATION.observe(time.perf_counter() - t0,
+                               collection=self.config.name)
         return [o.uuid for o in objs]
 
     def put(self, obj: StorageObject, tenant: str = "") -> str:
@@ -264,21 +270,35 @@ class Collection:
         tenant: str = "",
         max_distance: Optional[float] = None,
     ) -> list[list[tuple[StorageObject, float]]]:
+        from weaviate_tpu.monitoring.metrics import (
+            QUERIES_TOTAL,
+            QUERY_DURATION,
+        )
+        from weaviate_tpu.monitoring.slow_query import REPORTER
+
+        t0 = time.perf_counter()
         shards = self._search_shards(tenant)
         per_shard: list[tuple[Shard, SearchResult]] = []
 
         def run(shard: Shard):
-            allow = None
-            if flt is not None:
-                allow = shard.allow_list(flt)
-            return shard, shard.vector_search(
-                queries, k, target=target, allow_list=allow, max_distance=max_distance
-            )
+            with REPORTER.track("vector", collection=self.config.name,
+                                shard=shard.name) as tr:
+                allow = None
+                if flt is not None:
+                    allow = shard.allow_list(flt)
+                tr.stage("filter")
+                res = shard.vector_search(
+                    queries, k, target=target, allow_list=allow,
+                    max_distance=max_distance)
+                tr.stage("search")
+            return shard, res
 
         if len(shards) == 1:
             per_shard = [run(shards[0])]
         else:
             per_shard = list(self._pool.map(run, shards))
+        QUERIES_TOTAL.inc(type="vector", collection=self.config.name)
+        QUERY_DURATION.observe(time.perf_counter() - t0, type="vector")
 
         b = np.atleast_2d(queries).shape[0]
         out: list[list[tuple[StorageObject, float]]] = []
@@ -305,6 +325,12 @@ class Collection:
         flt: Optional[Filter] = None,
         tenant: str = "",
     ) -> list[tuple[StorageObject, float]]:
+        from weaviate_tpu.monitoring.metrics import (
+            QUERIES_TOTAL,
+            QUERY_DURATION,
+        )
+
+        t0 = time.perf_counter()
         results: list[tuple[float, Shard, int]] = []
         for shard in self._search_shards(tenant):
             allow = None
@@ -322,6 +348,8 @@ class Collection:
             obj = shard.get_by_docid(docid)
             if obj is not None:
                 out.append((obj, s))
+        QUERIES_TOTAL.inc(type="bm25", collection=self.config.name)
+        QUERY_DURATION.observe(time.perf_counter() - t0, type="bm25")
         return out
 
     def hybrid_search(
@@ -540,6 +568,17 @@ class Collection:
                     if len(out) >= limit:
                         return out
         return out
+
+    def expire_ttl_once(self) -> int:
+        """Delete expired objects (reference ``usecases/object_ttl``
+        background expiry). Returns number removed."""
+        ttl = self.config.object_ttl_seconds
+        if ttl <= 0:
+            return 0
+        cutoff = int((time.time() - ttl) * 1000)
+        with self._lock:
+            shards = list(self._shards.values())
+        return sum(s.expire_ttl(cutoff) for s in shards)
 
     # -- lifecycle --------------------------------------------------------
     def flush(self) -> None:

@@ -31,6 +31,32 @@ class DB:
         self._collections: dict[str, Collection] = {}
         self._schema_path = os.path.join(root, "schema.json")
         self._load_schema()
+        # background maintenance cycles (reference entities/cyclemanager):
+        # TTL expiry + metrics refresh; compaction hooks register here too
+        from weaviate_tpu.utils.cycles import CycleManager
+
+        self.cycles = CycleManager()
+        self.cycles.register("object_ttl", self._ttl_cycle, 60.0)
+        self.cycles.register("metrics_refresh", self._metrics_cycle, 30.0)
+        self.cycles.start()
+
+    def _ttl_cycle(self) -> None:
+        for c in list(self._collections.values()):
+            c.expire_ttl_once()
+
+    def _metrics_cycle(self) -> None:
+        from weaviate_tpu.monitoring.metrics import (
+            OBJECT_COUNT,
+            VECTOR_INDEX_SIZE,
+        )
+
+        for name, c in list(self._collections.items()):
+            for sname, s in list(c._shards.items()):
+                OBJECT_COUNT.set(s.count(), collection=name, shard=sname)
+                for tgt, idx in s._vector_indexes.items():
+                    VECTOR_INDEX_SIZE.set(
+                        idx.count(), collection=name, shard=sname,
+                        target=tgt or "default")
 
     def _load_schema(self) -> None:
         if not os.path.exists(self._schema_path):
@@ -111,6 +137,7 @@ class DB:
             c.flush()
 
     def close(self) -> None:
+        self.cycles.stop()
         with self._lock:
             for c in self._collections.values():
                 c.close()

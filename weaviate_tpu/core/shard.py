@@ -75,6 +75,19 @@ class Shard:
         self._next_doc_id = 0
         self._dims: dict[str, int] = {}
         self._recover()
+        # async indexing (ASYNC_INDEXING env or per-class config)
+        self.async_queue = None
+        if config.async_indexing or os.environ.get("ASYNC_INDEXING") == "true":
+            from weaviate_tpu.core.async_queue import AsyncVectorQueue
+
+            self.async_queue = AsyncVectorQueue(
+                os.path.join(dirpath, "index_queue"),
+                index_for=self._index_for,
+                is_live=lambda d: bool(
+                    d < self._live.shape[0] and self._live[d]),
+                shard_label=name,
+            )
+            self.async_queue.start()
 
     # -- recovery ---------------------------------------------------------
     def _recover(self) -> None:
@@ -205,8 +218,15 @@ class Shard:
                 self._delete_docids(old_docids)
 
             for nm, (ids, vecs) in batches.items():
-                idx = self._index_for(nm, vecs[0].shape[-1])
-                idx.add_batch(np.asarray(ids, np.int64), np.stack(vecs))
+                id_arr = np.asarray(ids, np.int64)
+                vec_arr = np.stack(vecs)
+                if self.async_queue is not None:
+                    # ensure the index exists (dims fixed) then enqueue
+                    self._index_for(nm, vec_arr.shape[-1])
+                    self.async_queue.push(nm, id_arr, vec_arr)
+                else:
+                    idx = self._index_for(nm, vec_arr.shape[-1])
+                    idx.add_batch(id_arr, vec_arr)
             self._live_count += len(final)
             return doc_ids
 
@@ -304,6 +324,8 @@ class Shard:
 
     # -- lifecycle --------------------------------------------------------
     def flush(self) -> None:
+        if self.async_queue is not None:
+            self.async_queue.flush()
         self.store.flush_all()
         self._persist_counter()
         self._persist_meta()
@@ -311,8 +333,19 @@ class Shard:
             idx.flush()
 
     def close(self) -> None:
+        if self.async_queue is not None:
+            self.async_queue.stop()
         self.flush()
         self.store.close()
+
+    def expire_ttl(self, cutoff_ms: int) -> int:
+        """Delete objects created before the cutoff (reference object TTL)."""
+        victims = []
+        for _key, raw in self.objects.items():
+            obj = StorageObject.from_bytes(raw)
+            if obj.creation_time_ms < cutoff_ms:
+                victims.append(obj.uuid)
+        return self.delete(victims) if victims else 0
 
     def stats(self) -> dict:
         return {

@@ -63,19 +63,22 @@ class FlatIndex(VectorIndex):
             from weaviate_tpu.ops.distance import normalize
 
             qj = normalize(qj)
+        # one consistent device-state snapshot (concurrent writers swap it)
+        corpus, valid, sqnorms = self.store.snapshot()
+        cap = corpus.shape[0]
         allow = None
         if allow_list is not None:
-            allow = _pad_mask(allow_list, self.store.capacity)
+            allow = _pad_mask(allow_list, cap)
         chunk = self.config.search_chunk_size
         d, ids = flat_search(
             qj,
-            self.store.corpus,
+            corpus,
             k=k,
             metric=self.metric,
-            valid_mask=self.store.valid_mask,
+            valid_mask=valid,
             allow_mask=allow,
-            corpus_sqnorms=self.store.sqnorms if self.metric == "l2-squared" else None,
-            chunk_size=chunk if self.store.capacity > chunk else 0,
+            corpus_sqnorms=sqnorms if self.metric == "l2-squared" else None,
+            chunk_size=chunk if cap > chunk else 0,
             precision=self.config.precision,
         )
         return SearchResult(ids=np.asarray(ids), dists=np.asarray(d))

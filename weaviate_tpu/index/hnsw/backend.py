@@ -113,7 +113,8 @@ class RawBackend:
     ) -> tuple[np.ndarray, np.ndarray]:
         """Brute-force top-k (small-filter cutoff path). Returns (dists, ids)."""
         qrep = self.prep_queries(queries)
-        cap = self.store.capacity
+        corpus, valid, sqnorms = self.store.snapshot()
+        cap = corpus.shape[0]
         allow_j = None
         if allow is not None:
             al = np.asarray(allow, bool)
@@ -122,12 +123,12 @@ class RawBackend:
             allow_j = jnp.asarray(al[:cap])
         d, ids = flat_search(
             qrep,
-            self.store.corpus,
+            corpus,
             k=k,
             metric=self.metric,
-            valid_mask=self.store.valid_mask,
+            valid_mask=valid,
             allow_mask=allow_j,
-            corpus_sqnorms=self.store.sqnorms if self.metric == "l2-squared" else None,
+            corpus_sqnorms=sqnorms if self.metric == "l2-squared" else None,
             precision=self.config.precision,
         )
         d = np.array(d)

@@ -21,7 +21,11 @@ from weaviate_tpu.ops.distance import normalize
 _PAGE = 4096
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+# NOT donated: a concurrent search may hold (or be executing on) the old
+# buffers — donation would invalidate them mid-flight ("Buffer has been
+# deleted or donated"). Copy-on-write keeps readers safe: they retain the
+# old arrays, writers swap in the new ones atomically via Python refs.
+@jax.jit
 def _scatter(corpus, valid, sqnorms, ids, vecs, norms):
     corpus = corpus.at[ids].set(vecs)
     valid = valid.at[ids].set(True)
@@ -29,7 +33,7 @@ def _scatter(corpus, valid, sqnorms, ids, vecs, norms):
     return corpus, valid, sqnorms
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@jax.jit
 def _mask_off(valid, ids):
     return valid.at[ids].set(False)
 
@@ -59,17 +63,22 @@ class DeviceVectorStore:
         self.normalized = normalized
         self.device = device
         cap = max(_PAGE, _round_up(capacity))
-        self._corpus = jnp.zeros((cap, dims), dtype)
-        self._valid = jnp.zeros((cap,), jnp.bool_)
-        self._sqnorms = jnp.zeros((cap,), jnp.float32)
-        self._host_valid = np.zeros((cap,), bool)  # host mirror of _valid
+        # device state lives in ONE tuple swapped atomically so a
+        # concurrent reader never sees corpus/valid/sqnorms from different
+        # generations (e.g. mid-grow)
+        self._state = (
+            jnp.zeros((cap, dims), dtype),
+            jnp.zeros((cap,), jnp.bool_),
+            jnp.zeros((cap,), jnp.float32),
+        )
+        self._host_valid = np.zeros((cap,), bool)  # host mirror of valid
         self._watermark = 0  # max assigned id + 1
         self._live = 0
 
     # -- properties -------------------------------------------------------
     @property
     def capacity(self) -> int:
-        return self._corpus.shape[0]
+        return self._state[0].shape[0]
 
     @property
     def watermark(self) -> int:
@@ -79,13 +88,18 @@ class DeviceVectorStore:
     def live_count(self) -> int:
         return self._live
 
+    def snapshot(self) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Consistent (corpus, valid, sqnorms) triple — the ONLY safe way
+        to read device state from search threads."""
+        return self._state
+
     @property
     def corpus(self) -> jnp.ndarray:
-        return self._corpus
+        return self._state[0]
 
     @property
     def valid_mask(self) -> jnp.ndarray:
-        return self._valid
+        return self._state[1]
 
     @property
     def host_valid_mask(self) -> np.ndarray:
@@ -94,16 +108,14 @@ class DeviceVectorStore:
 
     @property
     def sqnorms(self) -> jnp.ndarray:
-        return self._sqnorms
+        return self._state[2]
 
     # -- mutation ---------------------------------------------------------
     def ensure_capacity(self, min_capacity: int) -> None:
         if min_capacity <= self.capacity:
             return
         new_cap = _round_up(max(min_capacity, self.capacity * 2))
-        self._corpus, self._valid, self._sqnorms = _grow(
-            self._corpus, self._valid, self._sqnorms, new_cap
-        )
+        self._state = _grow(*self._state, new_cap)
         hv = np.zeros((new_cap,), bool)
         hv[: len(self._host_valid)] = self._host_valid
         self._host_valid = hv
@@ -123,9 +135,7 @@ class DeviceVectorStore:
             vj = normalize(vj)
         norms = jnp.sum(vj.astype(jnp.float32) ** 2, axis=-1)
         prev_valid = self._host_valid[doc_ids]
-        self._corpus, self._valid, self._sqnorms = _scatter(
-            self._corpus, self._valid, self._sqnorms, jnp.asarray(doc_ids), vj, norms
-        )
+        self._state = _scatter(*self._state, jnp.asarray(doc_ids), vj, norms)
         self._host_valid[doc_ids] = True
         self._live += int((~prev_valid).sum())
         self._watermark = max(self._watermark, int(doc_ids.max()) + 1)
@@ -136,13 +146,16 @@ class DeviceVectorStore:
             return
         doc_ids = doc_ids[doc_ids < self.capacity]
         was = self._host_valid[doc_ids]
-        self._valid = _mask_off(self._valid, jnp.asarray(doc_ids))
+        corpus, valid, sqnorms = self._state
+        self._state = (corpus, _mask_off(valid, jnp.asarray(doc_ids)),
+                       sqnorms)
         self._host_valid[doc_ids] = False
         self._live -= int(was.sum())
 
     def get(self, doc_ids: np.ndarray) -> np.ndarray:
         """Host gather (debug/rescore path)."""
-        return np.asarray(self._corpus[jnp.asarray(np.asarray(doc_ids, np.int32))])
+        return np.asarray(
+            self._state[0][jnp.asarray(np.asarray(doc_ids, np.int32))])
 
     def contains(self, doc_id: int) -> bool:
         if doc_id >= self.capacity:

@@ -1,0 +1,14 @@
+"""Monitoring: metrics registry + slow-query reporter (reference
+``usecases/monitoring`` + ``helpers/slow_queries.go``)."""
+
+from weaviate_tpu.monitoring.metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from weaviate_tpu.monitoring.slow_query import REPORTER, SlowQueryReporter
+
+__all__ = ["REGISTRY", "Registry", "Counter", "Gauge", "Histogram",
+           "REPORTER", "SlowQueryReporter"]

@@ -1,0 +1,176 @@
+"""Prometheus-compatible metrics registry (text exposition format).
+
+Reference: ``usecases/monitoring/prometheus.go:40`` (~100 instruments over
+batch/query/LSM/vector-index/queue paths, served on :2112). This is a
+dependency-free implementation of the counter/gauge/histogram subset the
+framework instruments, rendered in the Prometheus text format at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, kind: str):
+        self.name = name
+        self.help = help_
+        self.kind = kind
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_, "counter")
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help_=""):
+        super().__init__(name, help_, "gauge")
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels):
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        for key, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(dict(key))} {v}")
+        return out
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_="", buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, "histogram")
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels):
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, **labels) -> int:
+        return self._totals.get(tuple(sorted(labels.items())), 0)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for key in sorted(self._counts):
+            labels = dict(key)
+            for i, ub in enumerate(self.buckets):
+                lb = dict(labels)
+                lb["le"] = repr(ub)
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(lb)} "
+                    f"{self._counts[key][i]}")
+            lb = dict(labels)
+            lb["le"] = "+Inf"
+            out.append(f"{self.name}_bucket{_fmt_labels(lb)} "
+                       f"{self._totals[key]}")
+            out.append(f"{self.name}_sum{_fmt_labels(labels)} "
+                       f"{self._sums[key]}")
+            out.append(f"{self.name}_count{_fmt_labels(labels)} "
+                       f"{self._totals[key]}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._get(
+            name, lambda: Histogram(name, help_, buckets), Histogram)
+
+    def _get(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def render_text(self) -> str:
+        lines: list[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].render())
+        return "\n".join(lines) + "\n"
+
+
+# the process-wide registry (reference: prometheus default registerer)
+REGISTRY = Registry()
+
+# core instruments (reference monitoring/prometheus.go names, snake-cased)
+BATCH_DURATION = REGISTRY.histogram(
+    "weaviate_tpu_batch_durations_seconds", "batch import latency")
+QUERY_DURATION = REGISTRY.histogram(
+    "weaviate_tpu_query_durations_seconds", "query latency by type")
+OBJECT_COUNT = REGISTRY.gauge(
+    "weaviate_tpu_object_count", "live objects per collection/shard")
+QUERIES_TOTAL = REGISTRY.counter(
+    "weaviate_tpu_queries_total", "queries served by type")
+VECTOR_INDEX_SIZE = REGISTRY.gauge(
+    "weaviate_tpu_vector_index_size", "vectors per collection/shard")
+ASYNC_QUEUE_SIZE = REGISTRY.gauge(
+    "weaviate_tpu_vector_index_queue_size", "pending async-index vectors")

@@ -1,0 +1,58 @@
+"""Slow-query reporter with per-stage annotations.
+
+Reference: ``adapters/repos/db/helpers/slow_queries.go`` — queries over a
+threshold log their stage timings (used at ``shard_read.go:383`` and
+``hnsw/search.go:88``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger("weaviate_tpu.slow_query")
+
+DEFAULT_THRESHOLD_S = 0.5
+
+
+class SlowQueryReporter:
+    def __init__(self, threshold_s: float = DEFAULT_THRESHOLD_S,
+                 enabled: bool = True):
+        self.threshold_s = threshold_s
+        self.enabled = enabled
+
+    def track(self, kind: str, **fields) -> "_Tracker":
+        return _Tracker(self, kind, fields)
+
+
+class _Tracker:
+    def __init__(self, reporter: SlowQueryReporter, kind: str, fields: dict):
+        self.reporter = reporter
+        self.kind = kind
+        self.fields = fields
+        self.stages: list[tuple[str, float]] = []
+        self._t0 = 0.0
+        self._last = 0.0
+
+    def __enter__(self):
+        self._t0 = self._last = time.perf_counter()
+        return self
+
+    def stage(self, name: str) -> None:
+        now = time.perf_counter()
+        self.stages.append((name, now - self._last))
+        self._last = now
+
+    def __exit__(self, *exc):
+        total = time.perf_counter() - self._t0
+        if self.reporter.enabled and total >= self.reporter.threshold_s:
+            detail = " ".join(
+                f"{n}={dt * 1000:.1f}ms" for n, dt in self.stages)
+            extra = " ".join(f"{k}={v}" for k, v in self.fields.items())
+            logger.warning("slow %s query: total=%.1fms %s %s",
+                           self.kind, total * 1000, detail, extra)
+        return False
+
+
+REPORTER = SlowQueryReporter()

@@ -311,6 +311,12 @@ class CollectionConfig:
     sharding: ShardingConfig = field(default_factory=ShardingConfig)
     vectorizer: str = "none"  # module name, e.g. text2vec-hash
     description: str = ""
+    # ASYNC_INDEXING analogue: vectors enqueue to disk, background workers
+    # batch-feed the index (reference queue/scheduler.go)
+    async_indexing: bool = False
+    # object TTL: objects expire this many seconds after creation
+    # (reference usecases/object_ttl; 0 = disabled)
+    object_ttl_seconds: int = 0
 
     def validate(self) -> None:
         if not self.name or not self.name[0].isupper():
@@ -344,6 +350,8 @@ class CollectionConfig:
             "sharding": dataclasses.asdict(self.sharding),
             "vectorizer": self.vectorizer,
             "description": self.description,
+            "async_indexing": self.async_indexing,
+            "object_ttl_seconds": self.object_ttl_seconds,
         }
 
     @staticmethod
@@ -362,4 +370,6 @@ class CollectionConfig:
             sharding=ShardingConfig(**d.get("sharding", {})),
             vectorizer=d.get("vectorizer", "none"),
             description=d.get("description", ""),
+            async_indexing=d.get("async_indexing", False),
+            object_ttl_seconds=d.get("object_ttl_seconds", 0),
         )

@@ -1,0 +1,98 @@
+"""CycleManager: interval-driven background maintenance runner.
+
+Reference: ``entities/cyclemanager`` (4.9k LoC of interval cycles with
+backoff driving compaction, tombstone cleanup, commit-log maintenance).
+Registered callbacks run on a shared daemon thread; a failing callback
+backs off exponentially instead of killing the loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+logger = logging.getLogger("weaviate_tpu.cycles")
+
+
+@dataclass
+class _Cycle:
+    name: str
+    fn: Callable[[], None]
+    interval: float
+    next_run: float = 0.0
+    failures: int = 0
+    runs: int = 0
+    errors: int = 0
+
+
+class CycleManager:
+    def __init__(self, tick: float = 0.5):
+        self.tick = tick
+        self._cycles: dict[str, _Cycle] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def register(self, name: str, fn: Callable[[], None],
+                 interval: float) -> None:
+        with self._lock:
+            self._cycles[name] = _Cycle(
+                name, fn, interval, next_run=time.monotonic() + interval)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._cycles.pop(name, None)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cyclemanager")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def run_now(self, name: str) -> None:
+        """Run one cycle synchronously (tests + forced maintenance)."""
+        with self._lock:
+            c = self._cycles.get(name)
+        if c is not None:
+            self._run(c)
+
+    def _run(self, c: _Cycle) -> None:
+        try:
+            c.fn()
+            c.runs += 1
+            c.failures = 0
+            c.next_run = time.monotonic() + c.interval
+        except Exception:  # noqa: BLE001 — cycles must never kill the loop
+            c.errors += 1
+            c.failures += 1
+            backoff = min(c.interval * (2 ** c.failures), 300.0)
+            c.next_run = time.monotonic() + backoff
+            logger.exception("cycle %s failed (backoff %.1fs)",
+                             c.name, backoff)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick):
+            now = time.monotonic()
+            with self._lock:
+                due = [c for c in self._cycles.values() if c.next_run <= now]
+            for c in due:
+                if self._stop.is_set():
+                    return
+                self._run(c)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {c.name: {"runs": c.runs, "errors": c.errors,
+                             "interval": c.interval}
+                    for c in self._cycles.values()}
