@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -32,12 +33,18 @@ def make_flat(dims: int, config: Optional[FlatIndexConfig] = None) -> VectorInde
 
 class FlatIndex(VectorIndex):
     def __init__(self, dims: int, config: Optional[FlatIndexConfig] = None):
+        from weaviate_tpu.parallel.runtime import default_mesh
+
         self.config = config or FlatIndexConfig()
         self.metric = self.config.distance
+        # Multi-chip: the corpus rows shard across the process mesh and
+        # search runs as one SPMD program (reference scatter-gathers across
+        # nodes instead, index.go:1928).
         self.store = DeviceVectorStore(
             dims,
             capacity=self.config.initial_capacity,
             normalized=(self.metric == "cosine"),
+            mesh=default_mesh(),
         )
 
     # -- VectorIndex ------------------------------------------------------
@@ -69,6 +76,19 @@ class FlatIndex(VectorIndex):
         allow = None
         if allow_list is not None:
             allow = _pad_mask(allow_list, cap)
+        if self.store.mesh is not None:
+            from weaviate_tpu.parallel.sharded_search import (
+                sharded_flat_search,
+            )
+
+            mask = valid if allow is None else valid & jax.device_put(
+                allow, valid.sharding)
+            d, ids = sharded_flat_search(
+                corpus, mask, qj, k=k, metric=self.metric,
+                mesh=self.store.mesh, precision=self.config.precision,
+                sqnorms=sqnorms if self.metric == "l2-squared" else None,
+            )
+            return SearchResult(ids=np.asarray(ids), dists=np.asarray(d))
         chunk = self.config.search_chunk_size
         d, ids = flat_search(
             qj,

@@ -37,13 +37,19 @@ class RawBackend:
     quantized = False
 
     def __init__(self, dims: int, config, store: Optional[DeviceVectorStore] = None):
+        from weaviate_tpu.parallel.runtime import default_mesh
+
         self.config = config
         self.metric = config.distance
         self.dims = dims
+        # Multi-chip: corpus rows shard across the process mesh; frontier
+        # evaluation / heuristic gathers run as SPMD programs with pmin/psum
+        # merges over ICI (see parallel/sharded_search.py).
         self.store = store or DeviceVectorStore(
             dims,
             capacity=config.initial_capacity,
             normalized=(self.metric == "cosine"),
+            mesh=default_mesh(),
         )
 
     # -- storage ----------------------------------------------------------
@@ -72,7 +78,14 @@ class RawBackend:
         return q
 
     def prep_query_ids(self, ids: np.ndarray):
-        q = jnp.take(self.store.corpus, jnp.asarray(ids), axis=0)
+        if self.store.mesh is not None:
+            from weaviate_tpu.parallel.sharded_search import sharded_take
+
+            q = sharded_take(
+                self.store.corpus, jnp.asarray(np.asarray(ids, np.int32)),
+                mesh=self.store.mesh)
+        else:
+            q = jnp.take(self.store.corpus, jnp.asarray(ids), axis=0)
         if self.metric == "cosine":
             q = normalize(q)
         return q
@@ -85,20 +98,46 @@ class RawBackend:
     # -- distance kernels -------------------------------------------------
     def frontier_dists(self, qrep, cand: np.ndarray) -> np.ndarray:
         clipped = np.maximum(cand, 0)
-        d = np.array(
-            gather_distance(
-                qrep,
-                self.store.corpus,
-                jnp.asarray(clipped),
-                self.metric,
-                precision=self.config.precision,
+        if self.store.mesh is not None:
+            from weaviate_tpu.parallel.sharded_search import (
+                sharded_gather_distance,
             )
-        )
+
+            d = np.array(
+                sharded_gather_distance(
+                    self.store.corpus,
+                    qrep,
+                    jnp.asarray(clipped.astype(np.int32)),
+                    self.metric,
+                    mesh=self.store.mesh,
+                    precision=self.config.precision,
+                )
+            )
+        else:
+            d = np.array(
+                gather_distance(
+                    qrep,
+                    self.store.corpus,
+                    jnp.asarray(clipped),
+                    self.metric,
+                    precision=self.config.precision,
+                )
+            )
         d[cand < 0] = _INF
         return d
 
     def pairwise(self, ids: np.ndarray) -> np.ndarray:
         """[G, C] ids (pads clipped to 0 by caller) -> [G, C, C] distances."""
+        if self.store.mesh is not None:
+            from weaviate_tpu.ops.distance import vectors_pairwise
+            from weaviate_tpu.parallel.sharded_search import sharded_take
+
+            v = sharded_take(
+                self.store.corpus, jnp.asarray(ids.astype(np.int32)),
+                mesh=self.store.mesh)
+            return np.array(
+                vectors_pairwise(v, self.metric,
+                                 precision=self.config.precision))
         return np.array(
             candidate_pairwise(
                 self.store.corpus,
@@ -121,6 +160,24 @@ class RawBackend:
             if len(al) < cap:
                 al = np.pad(al, (0, cap - len(al)))
             allow_j = jnp.asarray(al[:cap])
+        if self.store.mesh is not None:
+            import jax
+
+            from weaviate_tpu.parallel.sharded_search import (
+                sharded_flat_search,
+            )
+
+            mask = valid if allow_j is None else valid & jax.device_put(
+                allow_j, valid.sharding)
+            d, ids = sharded_flat_search(
+                corpus, mask, qrep, k=k, metric=self.metric,
+                mesh=self.store.mesh, precision=self.config.precision,
+                sqnorms=sqnorms if self.metric == "l2-squared" else None,
+            )
+            d = np.array(d)
+            ids = np.asarray(ids, np.int64)
+            d[ids < 0] = _INF
+            return d, ids
         d, ids = flat_search(
             qrep,
             corpus,

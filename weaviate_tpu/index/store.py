@@ -10,6 +10,7 @@ updates are jitted scatters so steady-state ingest never leaves the device.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -25,26 +26,54 @@ _PAGE = 4096
 # buffers — donation would invalidate them mid-flight ("Buffer has been
 # deleted or donated"). Copy-on-write keeps readers safe: they retain the
 # old arrays, writers swap in the new ones atomically via Python refs.
-@jax.jit
-def _scatter(corpus, valid, sqnorms, ids, vecs, norms):
+def _scatter_impl(corpus, valid, sqnorms, ids, vecs, norms):
     corpus = corpus.at[ids].set(vecs)
     valid = valid.at[ids].set(True)
     sqnorms = sqnorms.at[ids].set(norms)
     return corpus, valid, sqnorms
 
 
-@jax.jit
-def _mask_off(valid, ids):
+def _mask_off_impl(valid, ids):
     return valid.at[ids].set(False)
 
 
-@functools.partial(jax.jit, static_argnames=("new_cap",), donate_argnums=())
-def _grow(corpus, valid, sqnorms, new_cap):
+def _grow_impl(corpus, valid, sqnorms, new_cap):
     d = corpus.shape[1]
     nc = jnp.zeros((new_cap, d), corpus.dtype).at[: corpus.shape[0]].set(corpus)
     nv = jnp.zeros((new_cap,), jnp.bool_).at[: valid.shape[0]].set(valid)
     ns = jnp.zeros((new_cap,), jnp.float32).at[: sqnorms.shape[0]].set(sqnorms)
     return nc, nv, ns
+
+
+_scatter = jax.jit(_scatter_impl)
+_mask_off = jax.jit(_mask_off_impl)
+_grow = jax.jit(_grow_impl, static_argnames=("new_cap",), donate_argnums=())
+
+# Per-mesh jitted wrappers are shared across all stores on that mesh so the
+# same (shape, sharding) scatter/grow program compiles once per process, not
+# once per collection.
+_mesh_fns_cache: dict = {}
+
+
+def _mesh_fns(mesh):
+    fns = _mesh_fns_cache.get(mesh)
+    if fns is None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+        row = NamedSharding(mesh, P(SHARD_AXIS, None))
+        flat = NamedSharding(mesh, P(SHARD_AXIS))
+        shardings = (row, flat, flat)
+        fns = (
+            shardings,
+            jax.jit(_scatter_impl, out_shardings=shardings),
+            jax.jit(_mask_off_impl, out_shardings=flat),
+            jax.jit(_grow_impl, static_argnames=("new_cap",),
+                    out_shardings=shardings),
+        )
+        _mesh_fns_cache[mesh] = fns
+    return fns
 
 
 class DeviceVectorStore:
@@ -57,20 +86,40 @@ class DeviceVectorStore:
         dtype=jnp.float32,
         normalized: bool = False,
         device: Optional[jax.Device] = None,
+        mesh=None,
     ):
         self.dims = dims
         self.dtype = dtype
         self.normalized = normalized
         self.device = device
-        cap = max(_PAGE, _round_up(capacity))
+        self.mesh = mesh
+        self._page = _PAGE
+        if mesh is None:
+            self._scatter_fn, self._mask_off_fn, self._grow_fn = (
+                _scatter, _mask_off, _grow)
+        else:
+            # Row-sharded mode: corpus rows split across the mesh's 'shard'
+            # axis; scatter/grow outputs pinned to the same layout so every
+            # update stays distributed (no implicit gather to one device).
+            n_dev = int(np.prod(mesh.devices.shape))
+            self._page = _PAGE * n_dev // math.gcd(_PAGE, n_dev)
+            (self._shardings, self._scatter_fn, self._mask_off_fn,
+             self._grow_fn) = _mesh_fns(mesh)
+        cap = max(self._page, _round_up(capacity, self._page))
         # device state lives in ONE tuple swapped atomically so a
         # concurrent reader never sees corpus/valid/sqnorms from different
         # generations (e.g. mid-grow)
-        self._state = (
+        state = (
             jnp.zeros((cap, dims), dtype),
             jnp.zeros((cap,), jnp.bool_),
             jnp.zeros((cap,), jnp.float32),
         )
+        if mesh is not None:
+            state = tuple(
+                jax.device_put(s, sh)
+                for s, sh in zip(state, self._shardings)
+            )
+        self._state = state
         self._host_valid = np.zeros((cap,), bool)  # host mirror of valid
         self._watermark = 0  # max assigned id + 1
         self._live = 0
@@ -114,8 +163,8 @@ class DeviceVectorStore:
     def ensure_capacity(self, min_capacity: int) -> None:
         if min_capacity <= self.capacity:
             return
-        new_cap = _round_up(max(min_capacity, self.capacity * 2))
-        self._state = _grow(*self._state, new_cap)
+        new_cap = _round_up(max(min_capacity, self.capacity * 2), self._page)
+        self._state = self._grow_fn(*self._state, new_cap=new_cap)
         hv = np.zeros((new_cap,), bool)
         hv[: len(self._host_valid)] = self._host_valid
         self._host_valid = hv
@@ -135,7 +184,8 @@ class DeviceVectorStore:
             vj = normalize(vj)
         norms = jnp.sum(vj.astype(jnp.float32) ** 2, axis=-1)
         prev_valid = self._host_valid[doc_ids]
-        self._state = _scatter(*self._state, jnp.asarray(doc_ids), vj, norms)
+        self._state = self._scatter_fn(
+            *self._state, jnp.asarray(doc_ids), vj, norms)
         self._host_valid[doc_ids] = True
         self._live += int((~prev_valid).sum())
         self._watermark = max(self._watermark, int(doc_ids.max()) + 1)
@@ -147,7 +197,7 @@ class DeviceVectorStore:
         doc_ids = doc_ids[doc_ids < self.capacity]
         was = self._host_valid[doc_ids]
         corpus, valid, sqnorms = self._state
-        self._state = (corpus, _mask_off(valid, jnp.asarray(doc_ids)),
+        self._state = (corpus, self._mask_off_fn(valid, jnp.asarray(doc_ids)),
                        sqnorms)
         self._host_valid[doc_ids] = False
         self._live -= int(was.sum())
@@ -164,4 +214,6 @@ class DeviceVectorStore:
 
 
 def _round_up(n: int, page: int = _PAGE) -> int:
+    """Round capacity up to a page multiple (page itself is a multiple of
+    the mesh size in sharded mode, so rows always divide evenly)."""
     return ((n + page - 1) // page) * page

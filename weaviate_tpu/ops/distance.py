@@ -149,6 +149,17 @@ def candidate_pairwise(
     candidate-to-candidate distances, which here are one batched einsum.
     """
     v = jnp.take(corpus, candidate_ids, axis=0)  # [B, C, D]
+    return vectors_pairwise(v, metric, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("metric", "precision"))
+def vectors_pairwise(
+    v: jnp.ndarray,
+    metric: str,
+    precision: str = "fp32",
+) -> jnp.ndarray:
+    """Pairwise distances over already-gathered candidate vectors [B, C, D]
+    -> [B, C, C] (mesh-sharded corpora gather first via ``sharded_take``)."""
     vf = v.astype(jnp.bfloat16 if precision == "bf16" else jnp.float32)
     ip = jnp.einsum("bcd,bed->bce", vf, vf, preferred_element_type=jnp.float32)
     if metric == "l2-squared":

@@ -1,14 +1,23 @@
 from weaviate_tpu.parallel.mesh import make_mesh, SHARD_AXIS
+from weaviate_tpu.parallel.runtime import default_mesh, set_mesh
 from weaviate_tpu.parallel.sharded_search import (
     sharded_flat_search,
+    sharded_gather_distance,
+    sharded_take,
     distributed_step,
     shard_corpus,
+    replicate,
 )
 
 __all__ = [
     "make_mesh",
     "SHARD_AXIS",
+    "default_mesh",
+    "set_mesh",
     "sharded_flat_search",
+    "sharded_gather_distance",
+    "sharded_take",
     "distributed_step",
     "shard_corpus",
+    "replicate",
 ]

@@ -9,6 +9,7 @@ replace the clusterapi scatter-gather.
 
 from __future__ import annotations
 
+import sys
 from typing import Optional
 
 import jax
@@ -19,11 +20,33 @@ SHARD_AXIS = "shard"
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = SHARD_AXIS) -> Mesh:
-    devices = jax.devices()
-    if n_devices is not None:
-        if n_devices > len(devices):
+    """Build a 1-D mesh over ``n_devices`` devices.
+
+    When the default platform cannot supply ``n_devices`` (the usual case in
+    this environment: one real TPU chip, or a broken TPU runtime), fall back
+    to the virtual CPU platform (``--xla_force_host_platform_device_count``)
+    so multi-chip sharding can be validated without N real chips.
+    """
+    try:
+        devices = jax.devices()
+    except Exception:
+        devices = []
+    if n_devices is not None and n_devices > len(devices):
+        cpu = jax.devices("cpu")
+        if n_devices > len(cpu):
             raise ValueError(
-                f"requested {n_devices} devices, only {len(devices)} available"
+                f"requested {n_devices} devices; default platform has "
+                f"{len(devices)}, cpu has {len(cpu)} (set "
+                f"--xla_force_host_platform_device_count={n_devices})"
             )
+        # Loud, not silent: a CPU mesh standing in for real chips must never
+        # be mistaken for a multichip TPU run.
+        print(
+            f"[weaviate_tpu] make_mesh: default platform has only "
+            f"{len(devices)} device(s); using {n_devices} virtual CPU devices",
+            file=sys.stderr,
+        )
+        devices = cpu
+    if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))

@@ -1,0 +1,69 @@
+"""Process-global device mesh for the serving path.
+
+The reference fans searches out across nodes with per-shard goroutines
+(``index.go:1928``); within one multi-chip TPU host the equivalent is a
+single SPMD program over a ``jax.sharding.Mesh``. This module owns the
+process-wide default mesh: when more than one device is visible (a v5e-8,
+or the 8-device virtual CPU platform used in tests), HBM-resident stores
+shard their corpus rows across it and searches run via ``shard_map`` with
+ICI collectives; with one device everything stays single-device.
+
+Kill switch: ``WEAVIATE_TPU_MESH=off`` forces single-device mode.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_lock = threading.Lock()
+_mesh: Optional[Mesh] = None
+_resolved = False
+
+
+def default_mesh() -> Optional[Mesh]:
+    """The process-wide mesh, or None when only one device is available.
+
+    Resolved lazily on first use (so tests can force the CPU platform
+    first) and cached; ``set_mesh`` overrides.
+    """
+    global _mesh, _resolved
+    with _lock:
+        if _resolved:
+            return _mesh
+        if os.environ.get("WEAVIATE_TPU_MESH", "").lower() in ("off", "0", "false"):
+            _mesh, _resolved = None, True
+            return None
+        import jax
+
+        from weaviate_tpu.parallel.mesh import make_mesh
+
+        try:
+            devices = jax.devices()
+        except Exception:
+            devices = []
+        if len(devices) > 1:
+            _mesh = make_mesh(len(devices))
+        else:
+            _mesh = None
+        _resolved = True
+        return _mesh
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    """Override the default mesh (tests / explicit deployment config)."""
+    global _mesh, _resolved
+    with _lock:
+        _mesh = mesh
+        _resolved = True
+
+
+def reset() -> None:
+    """Forget the cached resolution (test helper)."""
+    global _mesh, _resolved
+    with _lock:
+        _mesh = None
+        _resolved = False

@@ -14,6 +14,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from weaviate_tpu.ops.distance import MASK_DISTANCE, pairwise_distance
@@ -30,10 +31,31 @@ def shard_corpus(corpus, valid, mesh: Mesh, axis: str = SHARD_AXIS):
     return jax.device_put(corpus, cs), jax.device_put(valid, vs)
 
 
-def _local_search(c_local, v_local, queries, k, metric, axis, precision):
-    d = pairwise_distance(queries, c_local, metric, precision=precision)
+def replicate(x, mesh: Mesh):
+    """Place an array replicated on every mesh device.
+
+    Numpy inputs go straight to device_put — no jnp.asarray, which would
+    allocate on the (possibly broken / single-chip) default backend first.
+    """
+    spec = P(*([None] * np.ndim(x)))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def _local_search(c_local, v_local, queries, k, metric, axis, precision,
+                  sq_local=None):
+    d = pairwise_distance(queries, c_local, metric,
+                          corpus_sqnorms=sq_local, precision=precision)
     d = jnp.where(v_local[None, :], d, MASK_DISTANCE)
-    neg, idx = jax.lax.top_k(-d, k)
+    kk = min(k, c_local.shape[0])
+    neg, idx = jax.lax.top_k(-d, kk)
+    if kk < k:
+        b = queries.shape[0]
+        neg = jnp.concatenate(
+            [neg, jnp.full((b, k - kk), -MASK_DISTANCE, neg.dtype)], axis=1
+        )
+        idx = jnp.concatenate(
+            [idx, jnp.zeros((b, k - kk), idx.dtype)], axis=1
+        )
     shard_id = jax.lax.axis_index(axis)
     ids = idx.astype(jnp.int32) + shard_id * c_local.shape[0]
     # gather every shard's candidates: [B, n_shards * k]
@@ -58,21 +80,110 @@ def sharded_flat_search(
     mesh: Optional[Mesh] = None,
     axis: str = SHARD_AXIS,
     precision: str = "bf16",
+    sqnorms: Optional[jnp.ndarray] = None,
 ):
-    """Distributed exact top-k. corpus [N, D] sharded on N; queries replicated.
+    """Distributed exact top-k. corpus [N, D] sharded on N; queries replicated;
+    optional precomputed [N] squared norms (sharded like valid) avoid an
+    O(N*D) recompute per l2 query.
 
     Returns replicated (dists [B, k], global ids [B, k]).
     """
+    if sqnorms is None:
+        fn = jax.shard_map(
+            functools.partial(
+                _local_search, k=k, metric=metric, axis=axis,
+                precision=precision,
+            ),
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis), P(None, None)),
+            out_specs=(P(None, None), P(None, None)),
+            check_vma=False,
+        )
+        return fn(corpus, valid, queries)
     fn = jax.shard_map(
-        functools.partial(
-            _local_search, k=k, metric=metric, axis=axis, precision=precision
+        lambda c, v, q, s: _local_search(
+            c, v, q, k=k, metric=metric, axis=axis, precision=precision,
+            sq_local=s,
         ),
         mesh=mesh,
-        in_specs=(P(axis, None), P(axis), P(None, None)),
+        in_specs=(P(axis, None), P(axis), P(None, None), P(axis)),
         out_specs=(P(None, None), P(None, None)),
         check_vma=False,
     )
-    return fn(corpus, valid, queries)
+    return fn(corpus, valid, queries, sqnorms)
+
+
+def _local_gather_dists(c_local, queries, cand_ids, metric, axis, precision):
+    """Per-device frontier eval: distances for the candidate ids this device
+    owns, MASK elsewhere; a ``pmin`` across the axis yields the true value
+    everywhere (each id is owned by exactly one device)."""
+    from weaviate_tpu.ops.distance import gather_distance
+
+    n_local = c_local.shape[0]
+    base = jax.lax.axis_index(axis) * n_local
+    local = (cand_ids >= base) & (cand_ids < base + n_local)
+    rows = jnp.clip(cand_ids - base, 0, n_local - 1)
+    d = gather_distance(queries, c_local, rows, metric, precision=precision)
+    d = jnp.where(local, d, MASK_DISTANCE)
+    return jax.lax.pmin(d, axis)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("metric", "mesh", "axis", "precision")
+)
+def sharded_gather_distance(
+    corpus: jnp.ndarray,
+    queries: jnp.ndarray,
+    candidate_ids: jnp.ndarray,
+    metric: str,
+    mesh: Optional[Mesh] = None,
+    axis: str = SHARD_AXIS,
+    precision: str = "fp32",
+):
+    """Distributed HNSW frontier evaluation (reference hot loop
+    ``hnsw/search.go:726``): corpus [N, D] row-sharded, queries [B, D] and
+    candidate_ids [B, C] replicated -> replicated distances [B, C]."""
+    fn = jax.shard_map(
+        functools.partial(
+            _local_gather_dists, metric=metric, axis=axis, precision=precision
+        ),
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, None), P(None, None)),
+        out_specs=P(None, None),
+        check_vma=False,
+    )
+    return fn(corpus, queries, candidate_ids)
+
+
+def _local_take(c_local, ids, axis):
+    n_local = c_local.shape[0]
+    base = jax.lax.axis_index(axis) * n_local
+    flat = ids.reshape(-1)
+    local = (flat >= base) & (flat < base + n_local)
+    rows = jnp.clip(flat - base, 0, n_local - 1)
+    v = jnp.take(c_local, rows, axis=0)
+    v = jnp.where(local[:, None], v, 0)
+    v = jax.lax.psum(v, axis)
+    return v.reshape(*ids.shape, c_local.shape[1])
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def sharded_take(
+    corpus: jnp.ndarray,
+    ids: jnp.ndarray,
+    mesh: Optional[Mesh] = None,
+    axis: str = SHARD_AXIS,
+):
+    """Gather rows by global id from a row-sharded corpus -> replicated
+    [..., D] vectors (each id owned by exactly one device; psum-combine)."""
+    fn = jax.shard_map(
+        functools.partial(_local_take, axis=axis),
+        mesh=mesh,
+        in_specs=(P(axis, None), P(*([None] * ids.ndim))),
+        out_specs=P(*([None] * (ids.ndim + 1))),
+        check_vma=False,
+    )
+    return fn(corpus, ids)
 
 
 def _local_step(c_local, v_local, ids, vecs, queries, k, metric, axis, precision):
