@@ -255,3 +255,71 @@ def test_tcp_transport_roundtrip():
     finally:
         t1.stop()
         t2.stop()
+
+
+def test_tcp_transport_concurrent_sends_never_cross_replies():
+    """Concurrent senders to one peer must each get THEIR reply (the raft
+    heartbeat-vs-slow-append interleave from ADVICE r1): replies crossing
+    over would ack appends that never happened."""
+    import threading as th
+
+    server = TcpTransport("127.0.0.1:0")
+
+    def slow_echo(m):
+        # jitter so request/response pairs interleave across threads
+        time.sleep(0.001 * (m["x"] % 7))
+        return {"echo": m["x"]}
+
+    server.start(slow_echo)
+    client = TcpTransport("127.0.0.1:0")
+    client.start(lambda m: {})
+    errs: list = []
+
+    def worker(base):
+        try:
+            for i in range(base, base + 20):
+                r = client.send(server.node_id, {"x": i}, timeout=5.0)
+                assert r == {"echo": i}, f"crossed: sent {i} got {r}"
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [th.Thread(target=worker, args=(b * 100,)) for b in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.stop()
+    client.stop()
+    assert not errs, errs
+
+
+def test_raft_equal_term_leader_contact_preserves_vote():
+    """_become_follower on an equal-term AppendEntries must NOT clear
+    voted_for (ADVICE r1: clearing it allows a second vote in the same
+    term -> two leaders)."""
+    from weaviate_tpu.cluster.raft import RaftNode
+
+    reg: dict = {}
+    t = InProcTransport(reg, "n1")
+    # never call .start(): no ticker thread -> fully deterministic handlers
+    node = RaftNode("n1", ["n1", "n2", "n3"], t, apply_fn=lambda c: None)
+    try:
+        node.current_term = 5
+        node.voted_for = "n1"  # voted for itself as candidate in term 5
+        node.state = "candidate"
+        # equal-term leader appends (another candidate won term 5)
+        node._on_append_entries({
+            "type": "append_entries", "term": 5, "leader": "n2",
+            "prev_log_index": 0, "prev_log_term": 0, "entries": [],
+            "leader_commit": 0,
+        })
+        assert node.state == "follower"
+        assert node.voted_for == "n1", "vote must persist within the term"
+        # a second candidate asking for a vote in term 5 must be refused
+        r = node._on_request_vote({
+            "type": "request_vote", "term": 5, "candidate": "n3",
+            "last_log_index": 99, "last_log_term": 5,
+        })
+        assert not r["granted"]
+    finally:
+        t.stop()

@@ -1,0 +1,123 @@
+"""Columnar filter engine (reference inverted/searcher.go -> AllowList):
+semantics parity with the dict-based evaluator it replaced, plus the
+filtered-BM25-through-native-WAND path."""
+
+import numpy as np
+
+from weaviate_tpu.inverted.columnar import ColumnarProps
+
+
+def _mk():
+    cp = ColumnarProps()
+    docs = [
+        {"views": 10, "cat": "a", "tags": ["x", "y"], "ok": True},
+        {"views": 20, "cat": "b", "tags": ["y"], "ok": False},
+        {"views": 30, "cat": "a", "tags": ["x"],
+         "loc": {"latitude": 52.5, "longitude": 13.4}},
+        {"cat": "c"},
+        {"views": 20.5},
+    ]
+    for i, d in enumerate(docs):
+        cp.add(i, d)
+    return cp, len(docs)
+
+
+def test_equal_and_notequal():
+    cp, n = _mk()
+    assert list(np.nonzero(cp.eval_leaf("Equal", "cat", "a", n))[0]) == [0, 2]
+    # NotEqual matches docs HAVING the prop with a different value only
+    assert list(np.nonzero(cp.eval_leaf("NotEqual", "cat", "a", n))[0]) == [1, 3]
+    # numeric equality incl. float
+    assert list(np.nonzero(cp.eval_leaf("Equal", "views", 20.5, n))[0]) == [4]
+    # bool terms
+    assert list(np.nonzero(cp.eval_leaf("Equal", "ok", True, n))[0]) == [0]
+
+
+def test_ranges_and_null():
+    cp, n = _mk()
+    assert list(np.nonzero(cp.eval_leaf("GreaterThan", "views", 15, n))[0]) == [1, 2, 4]
+    assert list(np.nonzero(cp.eval_leaf("LessThanEqual", "views", 20, n))[0]) == [0, 1]
+    assert list(np.nonzero(cp.eval_leaf("IsNull", "views", True, n))[0]) == [3]
+    assert list(np.nonzero(cp.eval_leaf("IsNull", "views", False, n))[0]) == [0, 1, 2, 4]
+
+
+def test_arrays_contains_and_like():
+    cp, n = _mk()
+    # list props: any element matches
+    assert list(np.nonzero(cp.eval_leaf("Equal", "tags", "x", n))[0]) == [0, 2]
+    assert list(np.nonzero(cp.eval_leaf("ContainsAny", "tags", ["x", "y"], n))[0]) == [0, 1, 2]
+    assert list(np.nonzero(cp.eval_leaf("ContainsAll", "tags", ["x", "y"], n))[0]) == [0]
+    # multi-valued doc matches NotEqual even when one element equals fv
+    assert 0 in np.nonzero(cp.eval_leaf("NotEqual", "tags", "x", n))[0]
+    cp2 = ColumnarProps()
+    cp2.add(0, {"t": "apple pie"})
+    cp2.add(1, {"t": "apricot"})
+    cp2.add(2, {"t": "banana"})
+    assert list(np.nonzero(cp2.eval_leaf("Like", "t", "ap*", 3))[0]) == [0, 1]
+
+
+def test_geo_range():
+    cp, n = _mk()
+    near = {"latitude": 52.52, "longitude": 13.405, "distance": 10_000}
+    assert list(np.nonzero(cp.eval_leaf("WithinGeoRange", "loc", near, n))[0]) == [2]
+    far = {"latitude": 48.8, "longitude": 2.35, "distance": 10_000}
+    assert list(np.nonzero(cp.eval_leaf("WithinGeoRange", "loc", far, n))[0]) == []
+
+
+def test_delete_masks_out():
+    cp, n = _mk()
+    cp.delete(0)
+    assert list(np.nonzero(cp.eval_leaf("Equal", "cat", "a", n))[0]) == [2]
+    assert list(np.nonzero(cp.eval_leaf("IsNull", "cat", True, n))[0]) == [4]
+
+
+def test_string_ordering_over_vocab():
+    cp = ColumnarProps()
+    for i, d in enumerate(["2023-01-01", "2024-06-01", "2025-01-01"]):
+        cp.add(i, {"date": d})
+    got = np.nonzero(cp.eval_leaf("GreaterThan", "date", "2024-01-01", 3))[0]
+    assert list(got) == [1, 2]
+
+
+def test_filtered_bm25_uses_native_wand():
+    """Filtered keyword search must stay on the native engine and agree
+    with the dense path (reference: WAND consumes AllowLists)."""
+    import pytest
+
+    from weaviate_tpu.inverted.index import InvertedIndex
+    from weaviate_tpu.schema.config import (
+        CollectionConfig, DataType, Property,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    cfg = CollectionConfig(
+        name="F",
+        properties=[Property(name="body", data_type=DataType.TEXT),
+                    Property(name="grp", data_type=DataType.INT)],
+    )
+    ix = InvertedIndex(cfg)
+    if ix.native is None:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(3)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    n = 400
+    for i in range(n):
+        body = " ".join(rng.choice(words, size=8))
+        o = StorageObject(uuid="", collection="F",
+                          properties={"body": body, "grp": int(i % 4)})
+        o.doc_id = i
+        ix.add_object(o)
+
+    allow = np.zeros(n, bool)
+    allow[ix.columnar.eval_leaf("Equal", "grp", 2, n)] = True
+    ids, scores = ix.bm25_search("alpha beta", k=10, allow_list=allow,
+                                 doc_space=n)
+    assert len(ids) > 0
+    assert all(allow[i] for i in ids)
+
+    # parity with the dense numpy path
+    ix.native = None
+    ids2, scores2 = ix.bm25_search("alpha beta", k=10, allow_list=allow,
+                                   doc_space=n)
+    assert list(ids) == list(ids2)
+    np.testing.assert_allclose(scores, scores2, rtol=1e-4)

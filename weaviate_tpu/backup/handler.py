@@ -122,8 +122,16 @@ class BackupHandler:
         classes = [c for c in classes if c not in (exclude or [])]
         from weaviate_tpu.schema.config import CollectionConfig
 
-        # validate ALL classes before touching the DB (no partial restores)
+        # validate ALL classes before touching the DB (no partial restores);
+        # class names come from the (untrusted) manifest — a name like
+        # '../../x' must never reach os.path.join(self.db.root, cls)
+        from weaviate_tpu.backup.backends import validate_backup_id
+
         for cls in classes:
+            try:
+                validate_backup_id(cls)
+            except ValueError:
+                raise BackupError(f"invalid class name in manifest: {cls!r}")
             if manifest["classes"].get(cls) is None:
                 raise BackupError(f"class {cls!r} not in backup")
             if self.db.has_collection(cls):

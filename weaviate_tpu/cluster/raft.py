@@ -220,9 +220,13 @@ class RaftNode:
         self._persist()
 
     def _become_follower(self, term: int):
+        # voted_for only resets when the term ADVANCES: clearing it within
+        # the same term would let a node grant a second vote in that term
+        # (two leaders per term = election safety violation).
         self.state = FOLLOWER
-        self.current_term = term
-        self.voted_for = None
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
         self._persist()
 
     # -- leader: replication ----------------------------------------------
@@ -233,18 +237,34 @@ class RaftNode:
             ).start()
 
     def _append_to_peer(self, peer: str):
+        needs_snapshot = False
         with self._lock:
             if self.state != LEADER:
                 return
             term = self.current_term
             nxt = self.next_index.get(peer, self._last_index() + 1)
             if nxt <= self.snapshot_index:
-                self._send_snapshot(peer)
+                needs_snapshot = True
+            else:
+                prev_index = nxt - 1
+                prev_term = self._term_at(prev_index)
+                if prev_term is None:
+                    needs_snapshot = True
+        if needs_snapshot:
+            # outside the lock: the blocking transport send (up to 1s) must
+            # not stall heartbeats / RPC handling on the raft lock;
+            # _send_snapshot re-validates leadership+term under its own lock
+            self._send_snapshot(peer, term)
+            return
+        with self._lock:
+            if self.state != LEADER or self.current_term != term:
                 return
+            nxt = self.next_index.get(peer, self._last_index() + 1)
+            if nxt <= self.snapshot_index:
+                return  # raced with a concurrent compaction; next tick
             prev_index = nxt - 1
             prev_term = self._term_at(prev_index)
             if prev_term is None:
-                self._send_snapshot(peer)
                 return
             entries = [
                 (e.term, e.index, e.command)
@@ -289,10 +309,16 @@ class RaftNode:
                 self._apply_committed()
                 break
 
-    def _send_snapshot(self, peer: str):
+    def _send_snapshot(self, peer: str, term: Optional[int] = None):
         if not self.snapshot_fn:
             return
         with self._lock:
+            # re-validate: the caller may have released the lock between
+            # deciding to snapshot and getting here — a stepped-down or
+            # new-term node must not impersonate the leader
+            if self.state != LEADER or (
+                    term is not None and self.current_term != term):
+                return
             blob = self.snapshot_fn()
             msg = {
                 "type": "install_snapshot", "term": self.current_term,
@@ -301,6 +327,7 @@ class RaftNode:
                 "last_included_term": self.snapshot_term,
                 "data": blob,
             }
+            sent_term = self.current_term
         try:
             r = self.transport.send(peer, msg, timeout=1.0)
         except TransportError:
@@ -308,6 +335,8 @@ class RaftNode:
         with self._lock:
             if r.get("term", 0) > self.current_term:
                 self._become_follower(r["term"])
+                return
+            if self.state != LEADER or self.current_term != sent_term:
                 return
             self.next_index[peer] = self.snapshot_index + 1
             self.match_index[peer] = self.snapshot_index

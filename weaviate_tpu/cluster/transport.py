@@ -96,7 +96,11 @@ class TcpTransport:
         self._server = _Server((host, int(port)), _ReqHandler)
         self.node_id = f"{host}:{self._server.server_address[1]}"
         self._thread: Optional[threading.Thread] = None
-        self._conns: dict[str, socket.socket] = {}
+        # Pool of idle connections per peer. A connection is checked OUT for
+        # the full request/response exchange, so concurrent senders (raft
+        # heartbeats racing slow appends) can never interleave frames on one
+        # socket or steal each other's replies.
+        self._idle: dict[str, list[socket.socket]] = {}
         self._conn_lock = threading.Lock()
 
     def start(self, handler: Handler) -> None:
@@ -108,21 +112,21 @@ class TcpTransport:
     def send(self, peer: str, msg: dict, timeout: float = 1.0) -> dict:
         payload = msgpack.packb(msg, use_bin_type=True)
         with self._conn_lock:
-            sock = self._conns.get(peer)
+            pool = self._idle.get(peer)
+            sock = pool.pop() if pool else None
         try:
             if sock is None:
                 host, port = peer.rsplit(":", 1)
                 sock = socket.create_connection(
                     (host, int(port)), timeout=timeout)
-                with self._conn_lock:
-                    self._conns[peer] = sock
             sock.settimeout(timeout)
             sock.sendall(struct.pack(">I", len(payload)) + payload)
             (n,) = struct.unpack(">I", _recv_exact(sock, 4))
-            return msgpack.unpackb(_recv_exact(sock, n), raw=False)
-        except (OSError, struct.error) as e:
+            reply = msgpack.unpackb(_recv_exact(sock, n), raw=False)
             with self._conn_lock:
-                self._conns.pop(peer, None)
+                self._idle.setdefault(peer, []).append(sock)
+            return reply
+        except (OSError, struct.error) as e:
             try:
                 if sock is not None:
                     sock.close()
@@ -134,9 +138,10 @@ class TcpTransport:
         self._server.shutdown()
         self._server.server_close()
         with self._conn_lock:
-            for s in self._conns.values():
-                try:
-                    s.close()
-                except OSError:
-                    pass
-            self._conns.clear()
+            for pool in self._idle.values():
+                for s in pool:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._idle.clear()

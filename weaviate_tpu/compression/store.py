@@ -34,18 +34,23 @@ class DeviceArraySet:
                  capacity: int = _PAGE):
         cap = max(_PAGE, _round_up(capacity))
         self.fields = fields
-        self._arrays: dict[str, jnp.ndarray] = {
-            name: jnp.zeros((cap, *shape), dtype)
-            for name, (shape, dtype) in fields.items()
-        }
-        self._valid = jnp.zeros((cap,), jnp.bool_)
+        # (arrays, valid) live in ONE tuple swapped atomically (mirrors
+        # DeviceVectorStore._state): a concurrent search can never pair
+        # new-capacity arrays with an old-capacity valid mask
+        self._state: tuple[dict[str, jnp.ndarray], jnp.ndarray] = (
+            {
+                name: jnp.zeros((cap, *shape), dtype)
+                for name, (shape, dtype) in fields.items()
+            },
+            jnp.zeros((cap,), jnp.bool_),
+        )
         self._host_valid = np.zeros((cap,), bool)
         self._watermark = 0
         self._live = 0
 
     @property
     def capacity(self) -> int:
-        return self._valid.shape[0]
+        return self._state[1].shape[0]
 
     @property
     def watermark(self) -> int:
@@ -57,37 +62,37 @@ class DeviceArraySet:
 
     @property
     def valid_mask(self) -> jnp.ndarray:
-        return self._valid
+        return self._state[1]
 
     @property
     def host_valid_mask(self) -> np.ndarray:
         return self._host_valid
 
     def __getitem__(self, name: str) -> jnp.ndarray:
-        return self._arrays[name]
+        return self._state[0][name]
 
     def snapshot(self) -> tuple[dict[str, jnp.ndarray], jnp.ndarray]:
         """Consistent (arrays, valid) pair for search threads — mutations
-        swap whole containers, never edit them in place."""
-        return self._arrays, self._valid
+        swap the whole state tuple, never edit it in place."""
+        return self._state
 
     def ensure_capacity(self, min_capacity: int) -> None:
         if min_capacity <= self.capacity:
             return
         new_cap = _round_up(max(min_capacity, self.capacity * 2))
+        arrays, valid = self._state
         grown: dict[str, jnp.ndarray] = {}
-        for name, arr in self._arrays.items():
+        for name, arr in arrays.items():
             na = jnp.zeros((new_cap, *arr.shape[1:]), arr.dtype)
             grown[name] = na.at[: arr.shape[0]].set(arr)
         new_valid = (
-            jnp.zeros((new_cap,), jnp.bool_).at[: self._valid.shape[0]].set(self._valid)
+            jnp.zeros((new_cap,), jnp.bool_).at[: valid.shape[0]].set(valid)
         )
         hv = np.zeros((new_cap,), bool)
         hv[: len(self._host_valid)] = self._host_valid
-        # swap containers atomically AFTER all arrays are built so a
+        # swap the state tuple atomically AFTER all arrays are built so a
         # concurrent reader never mixes capacities
-        self._arrays = grown
-        self._valid = new_valid
+        self._state = (grown, new_valid)
         self._host_valid = hv
 
     def put(self, doc_ids: np.ndarray, values: dict[str, np.ndarray]) -> None:
@@ -96,12 +101,12 @@ class DeviceArraySet:
             return
         self.ensure_capacity(int(doc_ids.max()) + 1)
         idx = jnp.asarray(doc_ids)
-        updated = dict(self._arrays)
+        arrays, valid = self._state
+        updated = dict(arrays)
         for name, val in values.items():
             arr = updated[name]
             updated[name] = arr.at[idx].set(jnp.asarray(val, arr.dtype))
-        self._arrays = updated
-        self._valid = self._valid.at[idx].set(True)
+        self._state = (updated, valid.at[idx].set(True))
         prev = self._host_valid[doc_ids]
         self._host_valid[doc_ids] = True
         self._live += int((~prev).sum())
@@ -113,7 +118,8 @@ class DeviceArraySet:
             return
         doc_ids = doc_ids[doc_ids < self.capacity]
         was = self._host_valid[doc_ids]
-        self._valid = self._valid.at[jnp.asarray(doc_ids)].set(False)
+        arrays, valid = self._state
+        self._state = (arrays, valid.at[jnp.asarray(doc_ids)].set(False))
         self._host_valid[doc_ids] = False
         self._live -= int(was.sum())
 

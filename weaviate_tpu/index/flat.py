@@ -70,25 +70,21 @@ class FlatIndex(VectorIndex):
             from weaviate_tpu.ops.distance import normalize
 
             qj = normalize(qj)
+        if self.store.mesh is not None:
+            from weaviate_tpu.parallel.sharded_search import mesh_flat_topk
+
+            d, ids = mesh_flat_topk(
+                self.store, qj, k, self.metric, allow=allow_list,
+                precision=self.config.precision,
+                chunk_size=self.config.search_chunk_size,
+            )
+            return SearchResult(ids=np.asarray(ids), dists=np.asarray(d))
         # one consistent device-state snapshot (concurrent writers swap it)
         corpus, valid, sqnorms = self.store.snapshot()
         cap = corpus.shape[0]
         allow = None
         if allow_list is not None:
             allow = _pad_mask(allow_list, cap)
-        if self.store.mesh is not None:
-            from weaviate_tpu.parallel.sharded_search import (
-                sharded_flat_search,
-            )
-
-            mask = valid if allow is None else valid & jax.device_put(
-                allow, valid.sharding)
-            d, ids = sharded_flat_search(
-                corpus, mask, qj, k=k, metric=self.metric,
-                mesh=self.store.mesh, precision=self.config.precision,
-                sqnorms=sqnorms if self.metric == "l2-squared" else None,
-            )
-            return SearchResult(ids=np.asarray(ids), dists=np.asarray(d))
         chunk = self.config.search_chunk_size
         d, ids = flat_search(
             qj,

@@ -152,6 +152,18 @@ class RawBackend:
     ) -> tuple[np.ndarray, np.ndarray]:
         """Brute-force top-k (small-filter cutoff path). Returns (dists, ids)."""
         qrep = self.prep_queries(queries)
+        if self.store.mesh is not None:
+            from weaviate_tpu.parallel.sharded_search import mesh_flat_topk
+
+            d, ids = mesh_flat_topk(
+                self.store, qrep, k, self.metric, allow=allow,
+                precision=self.config.precision,
+                chunk_size=self.config.search_chunk_size,
+            )
+            d = np.array(d)
+            ids = np.asarray(ids, np.int64)
+            d[ids < 0] = _INF
+            return d, ids
         corpus, valid, sqnorms = self.store.snapshot()
         cap = corpus.shape[0]
         allow_j = None
@@ -160,24 +172,6 @@ class RawBackend:
             if len(al) < cap:
                 al = np.pad(al, (0, cap - len(al)))
             allow_j = jnp.asarray(al[:cap])
-        if self.store.mesh is not None:
-            import jax
-
-            from weaviate_tpu.parallel.sharded_search import (
-                sharded_flat_search,
-            )
-
-            mask = valid if allow_j is None else valid & jax.device_put(
-                allow_j, valid.sharding)
-            d, ids = sharded_flat_search(
-                corpus, mask, qrep, k=k, metric=self.metric,
-                mesh=self.store.mesh, precision=self.config.precision,
-                sqnorms=sqnorms if self.metric == "l2-squared" else None,
-            )
-            d = np.array(d)
-            ids = np.asarray(ids, np.int64)
-            d[ids < 0] = _INF
-            return d, ids
         d, ids = flat_search(
             qrep,
             corpus,

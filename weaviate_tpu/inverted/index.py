@@ -48,8 +48,14 @@ class InvertedIndex:
         self.doc_lengths: dict[str, dict[int, int]] = defaultdict(dict)
         # running totals so avgdl is O(1) at query time (not O(doc_count))
         self.len_totals: dict[str, int] = defaultdict(int)
-        # filter values: prop -> {doc_id: value} (scalar or list)
+        # filter values: prop -> {doc_id: value} (scalar or list); the value
+        # store for aggregations + doc-value lookups
         self.values: dict[str, dict[int, Any]] = defaultdict(dict)
+        # columnar filter engine: vectorized predicates -> allow masks
+        # (reference inverted/searcher.go -> roaring AllowList)
+        from weaviate_tpu.inverted.columnar import ColumnarProps
+
+        self.columnar = ColumnarProps()
         self.doc_count = 0
 
     # -- schema helpers ---------------------------------------------------
@@ -73,6 +79,11 @@ class InvertedIndex:
     def add_object(self, obj: StorageObject) -> None:
         doc_id = obj.doc_id
         self.doc_count += 1
+        self.columnar.add(
+            doc_id,
+            {p: v for p, v in obj.properties.items()
+             if v is not None and self._filterable(p)},
+        )
         for prop, val in obj.properties.items():
             if val is None:
                 continue
@@ -105,6 +116,7 @@ class InvertedIndex:
     def delete_object(self, obj: StorageObject) -> None:
         doc_id = obj.doc_id
         self.doc_count = max(0, self.doc_count - 1)
+        self.columnar.delete(doc_id)
         if self.native is not None:
             self.native.remove_doc(doc_id)
         for prop, val in obj.properties.items():
@@ -153,9 +165,10 @@ class InvertedIndex:
 
         n_docs = max(1, self.doc_count)
 
-        # native BlockMax-WAND hot path (unfiltered queries; the dense
-        # path below handles allow-list masking)
-        if self.native is not None and allow_list is None:
+        # native BlockMax-WAND hot path — filtered queries pass the allow
+        # mask into the engine (WAND skipping stays active; reference WAND
+        # consumes AllowLists the same way)
+        if self.native is not None:
             query_terms = []
             for prop, boost in props:
                 prop_postings = self.postings.get(prop)
@@ -175,7 +188,7 @@ class InvertedIndex:
                     idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
                     query_terms.append(
                         (prop, term, boost * idf, max(avg_len, 1e-9)))
-            return self.native.search(query_terms, k)
+            return self.native.search(query_terms, k, allow=allow_list)
 
         space = max(
             doc_space,
@@ -246,68 +259,10 @@ class InvertedIndex:
         if op == "Not":
             return ~self._eval(flt.operands[0], space)
 
-        prop = flt.path[-1]
-        vals = self.values.get(prop, {})
-        mask = np.zeros(space, bool)
-
-        if op == "IsNull":
-            has = np.zeros(space, bool)
-            for d in vals:
-                if d < space:
-                    has[d] = True
-            return ~has if flt.value else has
-
-        def each(pred):
-            for d, v in vals.items():
-                if d >= space:
-                    continue
-                if isinstance(v, list):
-                    if any(pred(x) for x in v):
-                        mask[d] = True
-                elif pred(v):
-                    mask[d] = True
-
-        fv = flt.value
-        if op == "Equal":
-            # text props match on tokens too (reference Equal on text uses
-            # the inverted index); exact value match covers the common case
-            each(lambda x: x == fv)
-        elif op == "NotEqual":
-            each(lambda x: x != fv)
-            # docs without the prop don't match NotEqual in the reference
-        elif op == "GreaterThan":
-            each(lambda x: _cmp_ok(x, fv) and x > fv)
-        elif op == "GreaterThanEqual":
-            each(lambda x: _cmp_ok(x, fv) and x >= fv)
-        elif op == "LessThan":
-            each(lambda x: _cmp_ok(x, fv) and x < fv)
-        elif op == "LessThanEqual":
-            each(lambda x: _cmp_ok(x, fv) and x <= fv)
-        elif op == "Like":
-            rx = like_to_regex(str(fv))
-            each(lambda x: isinstance(x, str) and rx.match(x) is not None)
-        elif op == "ContainsAny":
-            wanted = set(fv if isinstance(fv, list) else [fv])
-            each(lambda x: x in wanted)
-        elif op == "ContainsAll":
-            wanted = list(fv if isinstance(fv, list) else [fv])
-            for d, v in vals.items():
-                if d >= space:
-                    continue
-                hay = set(v) if isinstance(v, list) else {v}
-                if all(w in hay for w in wanted):
-                    mask[d] = True
-        elif op == "WithinGeoRange":
-            # value: {"latitude":..,"longitude":..,"distance": meters}
-            lat0 = float(fv["latitude"])
-            lon0 = float(fv["longitude"])
-            maxd = float(fv["distance"])
-            each(
-                lambda x: isinstance(x, dict)
-                and _geo_meters(lat0, lon0, float(x.get("latitude", 0)), float(x.get("longitude", 0)))
-                <= maxd
-            )
-        else:
+        # leaf: vectorized columnar evaluation (reference searcher.go ->
+        # AllowList; here numpy columns instead of roaring segments)
+        mask = self.columnar.eval_leaf(op, flt.path[-1], flt.value, space)
+        if mask is None:
             raise ValueError(f"unhandled operator {op!r}")
         return mask
 
@@ -319,19 +274,3 @@ class InvertedIndex:
         }
 
 
-def _cmp_ok(x, ref) -> bool:
-    if isinstance(ref, (int, float)) and not isinstance(ref, bool):
-        return isinstance(x, (int, float)) and not isinstance(x, bool)
-    return type(x) is type(ref)
-
-
-def _geo_meters(lat1, lon1, lat2, lon2) -> float:
-    """Haversine (reference ``distancer/geo_spatial.go``)."""
-    import math as m
-
-    r = 6371088.0
-    p1, p2 = m.radians(lat1), m.radians(lat2)
-    dp = m.radians(lat2 - lat1)
-    dl = m.radians(lon2 - lon1)
-    a = m.sin(dp / 2) ** 2 + m.cos(p1) * m.cos(p2) * m.sin(dl / 2) ** 2
-    return 2 * r * m.asin(m.sqrt(a))

@@ -41,6 +41,10 @@ def _bind():
     lib.bm25_search.argtypes = [
         ctypes.c_void_p, _U64, _F32, _F32, ctypes.c_uint32, ctypes.c_uint32,
         _I64, _F32]
+    lib.bm25_search_filtered.restype = ctypes.c_uint32
+    lib.bm25_search_filtered.argtypes = [
+        ctypes.c_void_p, _U64, _F32, _F32, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, _I64, _F32]
     lib.bm25_score_docs.argtypes = [
         ctypes.c_void_p, _U64, _F32, _F32, ctypes.c_uint32,
         _I64, ctypes.c_uint32, _F32]
@@ -94,8 +98,11 @@ class NativeBM25:
             return self._lib.bm25_posting_len(self._h, term_id(prop, term))
 
     def search(self, query_terms: list[tuple[str, str, float, float]],
-               k: int) -> tuple[np.ndarray, np.ndarray]:
-        """query_terms: [(prop, term, weight=boost*idf, avgdl)].
+               k: int, allow: Optional[np.ndarray] = None,
+               ) -> tuple[np.ndarray, np.ndarray]:
+        """query_terms: [(prop, term, weight=boost*idf, avgdl)]; allow:
+        optional byte-per-doc mask (the filter engine's output) — WAND
+        skipping stays active, disallowed docs are just never scored.
         Returns (doc_ids, scores) descending."""
         n = len(query_terms)
         if n == 0 or k == 0:
@@ -106,9 +113,17 @@ class NativeBM25:
         ads = (ctypes.c_float * n)(*(a for _, _, _, a in query_terms))
         out_docs = (ctypes.c_int64 * k)()
         out_scores = (ctypes.c_float * k)()
-        with self._lock:
-            m = self._lib.bm25_search(self._h, ids, ws, ads, n, k,
-                                      out_docs, out_scores)
+        if allow is None:
+            with self._lock:
+                m = self._lib.bm25_search(self._h, ids, ws, ads, n, k,
+                                          out_docs, out_scores)
+        else:
+            ab = np.ascontiguousarray(np.asarray(allow, bool), np.uint8)
+            ptr = ab.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            with self._lock:
+                m = self._lib.bm25_search_filtered(
+                    self._h, ids, ws, ads, n, k, ptr, len(ab),
+                    out_docs, out_scores)
         return (np.ctypeslib.as_array(out_docs)[:m].astype(np.int64),
                 np.ctypeslib.as_array(out_scores)[:m].astype(np.float32))
 

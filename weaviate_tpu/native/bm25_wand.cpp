@@ -167,12 +167,18 @@ uint64_t bm25_posting_len(void* h, uint64_t term_id) {
     return pl ? pl->entries.size() : 0;
 }
 
-// WAND top-k. Query: n terms with weights (= boost*idf) and the property
-// avgdl per term. Returns number of results written (<= k), descending
-// score; ties by ascending doc id.
-uint32_t bm25_search(void* h, const uint64_t* term_ids, const float* weights,
-                     const float* avgdls, uint32_t n_terms, uint32_t k,
-                     int64_t* out_docs, float* out_scores) {
+// WAND top-k with optional allow-list. Query: n terms with weights
+// (= boost*idf) and the property avgdl per term. allow: byte-per-doc
+// bitmap (nullptr = no filter; docs >= allow_len are excluded when a
+// filter is present — the filter defines the candidate universe). The
+// filter only removes candidates, so WAND/BMW upper bounds stay sound.
+// Returns number of results written (<= k), descending score; ties by
+// ascending doc id.
+uint32_t bm25_search_filtered(void* h, const uint64_t* term_ids,
+                              const float* weights, const float* avgdls,
+                              uint32_t n_terms, uint32_t k,
+                              const uint8_t* allow, uint64_t allow_len,
+                              int64_t* out_docs, float* out_scores) {
     auto* ix = static_cast<Index*>(h);
     std::vector<Cursor> cursors;
     cursors.reserve(n_terms);
@@ -263,7 +269,11 @@ uint32_t bm25_search(void* h, const uint64_t* term_ids, const float* weights,
 
         {
             // all cursors up to pivot aligned: score the doc fully
-            if (!ix->tombstones.count(pivot_doc)) {
+            bool allowed =
+                allow == nullptr ||
+                (pivot_doc >= 0 && (uint64_t)pivot_doc < allow_len &&
+                 allow[pivot_doc]);
+            if (allowed && !ix->tombstones.count(pivot_doc)) {
                 float s = 0.0f;
                 for (Cursor* c : order) {
                     if (c->done() || c->doc() != pivot_doc) continue;
@@ -294,6 +304,13 @@ uint32_t bm25_search(void* h, const uint64_t* term_ids, const float* weights,
         heap.pop();
     }
     return n;
+}
+
+uint32_t bm25_search(void* h, const uint64_t* term_ids, const float* weights,
+                     const float* avgdls, uint32_t n_terms, uint32_t k,
+                     int64_t* out_docs, float* out_scores) {
+    return bm25_search_filtered(h, term_ids, weights, avgdls, n_terms, k,
+                                nullptr, 0, out_docs, out_scores);
 }
 
 // exact (non-WAND) scoring of specific docs — used by hybrid rescoring

@@ -28,8 +28,11 @@ METRICS = ("l2-squared", "dot", "cosine", "manhattan", "hamming")
 
 # Large-but-finite sentinel used for masked-out candidates. float32 max is
 # ~3.4e38; we stay well below so arithmetic on sentinels can't overflow to inf
-# (inf - inf = nan would poison top-k merges).
-MASK_DISTANCE = jnp.float32(1e30)
+# (inf - inf = nan would poison top-k merges). A plain Python float, NOT a
+# jnp scalar: a device constant here would initialize the default backend at
+# import time (and hang the whole process when the remote TPU runtime is
+# wedged — the CPU-mesh fallback must be reachable without touching it).
+MASK_DISTANCE = 1e30
 
 
 def normalize(v: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:

@@ -10,6 +10,7 @@ replace the clusterapi scatter-gather.
 from __future__ import annotations
 
 import sys
+import threading
 from typing import Optional
 
 import jax
@@ -27,10 +28,7 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = SHARD_AXIS) -> Mesh:
     to the virtual CPU platform (``--xla_force_host_platform_device_count``)
     so multi-chip sharding can be validated without N real chips.
     """
-    try:
-        devices = jax.devices()
-    except Exception:
-        devices = []
+    devices = _probe_default_devices()
     if n_devices is not None and n_devices > len(devices):
         cpu = jax.devices("cpu")
         if n_devices > len(cpu):
@@ -50,3 +48,27 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = SHARD_AXIS) -> Mesh:
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+def _probe_default_devices(timeout: float = 60.0) -> list:
+    """jax.devices() guarded by a timeout: a wedged remote TPU runtime must
+    degrade to the CPU fallback, not hang the whole dry run."""
+    out: list = []
+
+    def probe():
+        try:
+            out.append(jax.devices())
+        except Exception:
+            out.append([])
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout)
+    if not out:
+        print(
+            "[weaviate_tpu] make_mesh: default platform probe timed out "
+            f"after {timeout:.0f}s; treating as unavailable",
+            file=sys.stderr,
+        )
+        return []
+    return out[0]

@@ -41,23 +41,62 @@ def replicate(x, mesh: Mesh):
     return jax.device_put(x, NamedSharding(mesh, spec))
 
 
+def _local_topk(c_local, v_local, queries, k, metric, precision, sq_local,
+                chunk_size):
+    """Masked top-k over this device's corpus block, chunked to bound the
+    [B, chunk] score materialization (mirrors ops.flat_search's loop)."""
+    from weaviate_tpu.ops.topk import merge_topk
+
+    n_local = c_local.shape[0]
+    b = queries.shape[0]
+
+    def score_block(c_blk, v_blk, sq_blk, base):
+        d = pairwise_distance(queries, c_blk, metric,
+                              corpus_sqnorms=sq_blk, precision=precision)
+        d = jnp.where(v_blk[None, :], d, MASK_DISTANCE)
+        kk = min(k, c_blk.shape[0])
+        neg, idx = jax.lax.top_k(-d, kk)
+        if kk < k:
+            neg = jnp.concatenate(
+                [neg, jnp.full((b, k - kk), -MASK_DISTANCE, neg.dtype)],
+                axis=1)
+            idx = jnp.concatenate(
+                [idx, jnp.zeros((b, k - kk), idx.dtype)], axis=1)
+        return -neg, idx.astype(jnp.int32) + base
+
+    if chunk_size <= 0 or chunk_size >= n_local:
+        return score_block(c_local, v_local, sq_local, 0)
+
+    n_full = (n_local // chunk_size) * chunk_size
+
+    def body(i, carry):
+        bv, bi = carry
+        start = i * chunk_size
+        c_blk = jax.lax.dynamic_slice_in_dim(c_local, start, chunk_size, 0)
+        v_blk = jax.lax.dynamic_slice_in_dim(v_local, start, chunk_size, 0)
+        sq_blk = (jax.lax.dynamic_slice_in_dim(sq_local, start, chunk_size, 0)
+                  if sq_local is not None else None)
+        v, idx = score_block(c_blk, v_blk, sq_blk, start)
+        return merge_topk(bv, bi, v, idx, k)
+
+    init = (jnp.full((b, k), MASK_DISTANCE, jnp.float32),
+            jnp.full((b, k), -1, jnp.int32))
+    vals, ids = jax.lax.fori_loop(0, n_full // chunk_size, body, init)
+    if n_full < n_local:
+        v, idx = score_block(
+            c_local[n_full:], v_local[n_full:],
+            sq_local[n_full:] if sq_local is not None else None, n_full)
+        vals, ids = merge_topk(vals, ids, v, idx, k)
+    return vals, ids
+
+
 def _local_search(c_local, v_local, queries, k, metric, axis, precision,
-                  sq_local=None):
-    d = pairwise_distance(queries, c_local, metric,
-                          corpus_sqnorms=sq_local, precision=precision)
-    d = jnp.where(v_local[None, :], d, MASK_DISTANCE)
-    kk = min(k, c_local.shape[0])
-    neg, idx = jax.lax.top_k(-d, kk)
-    if kk < k:
-        b = queries.shape[0]
-        neg = jnp.concatenate(
-            [neg, jnp.full((b, k - kk), -MASK_DISTANCE, neg.dtype)], axis=1
-        )
-        idx = jnp.concatenate(
-            [idx, jnp.zeros((b, k - kk), idx.dtype)], axis=1
-        )
+                  sq_local=None, chunk_size=0):
+    vals, idx = _local_topk(c_local, v_local, queries, k, metric, precision,
+                            sq_local, chunk_size)
+    neg = -vals
     shard_id = jax.lax.axis_index(axis)
-    ids = idx.astype(jnp.int32) + shard_id * c_local.shape[0]
+    ids = idx + shard_id * c_local.shape[0]
     # gather every shard's candidates: [B, n_shards * k]
     d_all = jax.lax.all_gather(-neg, axis, axis=1, tiled=True)
     i_all = jax.lax.all_gather(ids, axis, axis=1, tiled=True)
@@ -69,7 +108,9 @@ def _local_search(c_local, v_local, queries, k, metric, axis, precision,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k", "metric", "mesh", "axis", "precision")
+    jax.jit,
+    static_argnames=("k", "metric", "mesh", "axis", "precision",
+                     "chunk_size"),
 )
 def sharded_flat_search(
     corpus: jnp.ndarray,
@@ -81,10 +122,12 @@ def sharded_flat_search(
     axis: str = SHARD_AXIS,
     precision: str = "bf16",
     sqnorms: Optional[jnp.ndarray] = None,
+    chunk_size: int = 0,
 ):
     """Distributed exact top-k. corpus [N, D] sharded on N; queries replicated;
     optional precomputed [N] squared norms (sharded like valid) avoid an
-    O(N*D) recompute per l2 query.
+    O(N*D) recompute per l2 query. chunk_size bounds each device's [B, chunk]
+    score materialization (0 = single shot over the local block).
 
     Returns replicated (dists [B, k], global ids [B, k]).
     """
@@ -92,7 +135,7 @@ def sharded_flat_search(
         fn = jax.shard_map(
             functools.partial(
                 _local_search, k=k, metric=metric, axis=axis,
-                precision=precision,
+                precision=precision, chunk_size=chunk_size,
             ),
             mesh=mesh,
             in_specs=(P(axis, None), P(axis), P(None, None)),
@@ -103,7 +146,7 @@ def sharded_flat_search(
     fn = jax.shard_map(
         lambda c, v, q, s: _local_search(
             c, v, q, k=k, metric=metric, axis=axis, precision=precision,
-            sq_local=s,
+            sq_local=s, chunk_size=chunk_size,
         ),
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(None, None), P(axis)),
@@ -111,6 +154,34 @@ def sharded_flat_search(
         check_vma=False,
     )
     return fn(corpus, valid, queries, sqnorms)
+
+
+def mesh_flat_topk(store, queries: jnp.ndarray, k: int, metric: str,
+                   allow=None, precision: str = "bf16",
+                   chunk_size: int = 0):
+    """THE mesh flat-search entry for serving code (FlatIndex + HNSW flat
+    cutoff): one place owns the subtle details — allow mask resharded onto
+    the valid mask's layout, sqnorms only for l2, per-device chunking.
+
+    store: DeviceVectorStore in mesh mode; queries: metric-prepped [B, D]
+    jnp array. Returns (dists, ids) jnp arrays with id -1 in masked/empty
+    slots.
+    """
+    corpus, valid, sqnorms = store.snapshot()
+    mask = valid
+    if allow is not None:
+        al = np.asarray(allow, bool)
+        cap = corpus.shape[0]
+        if al.shape[0] < cap:
+            al = np.pad(al, (0, cap - al.shape[0]))
+        mask = valid & jax.device_put(al[:cap], valid.sharding)
+    n_local = corpus.shape[0] // int(np.prod(store.mesh.devices.shape))
+    return sharded_flat_search(
+        corpus, mask, queries, k=k, metric=metric,
+        mesh=store.mesh, precision=precision,
+        sqnorms=sqnorms if metric == "l2-squared" else None,
+        chunk_size=chunk_size if 0 < chunk_size < n_local else 0,
+    )
 
 
 def _local_gather_dists(c_local, queries, cand_ids, metric, axis, precision):
