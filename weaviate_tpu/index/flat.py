@@ -59,12 +59,18 @@ class FlatIndex(VectorIndex):
         queries: np.ndarray,
         k: int,
         allow_list: Optional[np.ndarray] = None,
+        approx_recall: Optional[float] = None,
     ) -> SearchResult:
+        """Top-k scan. ``approx_recall`` overrides the config knob (range
+        queries force 0.0: approx selection may drop in-range rows, which
+        breaks the search_by_distance contract rather than trading recall)."""
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         if queries.shape[-1] != self.store.dims:
             raise ValueError(
                 f"query dims {queries.shape[-1]} != index dims {self.store.dims}"
             )
+        if approx_recall is None:
+            approx_recall = self.config.flat_approx_recall
         qj = jnp.asarray(queries)
         if self.metric == "cosine":
             from weaviate_tpu.ops.distance import normalize
@@ -77,6 +83,7 @@ class FlatIndex(VectorIndex):
                 self.store, qj, k, self.metric, allow=allow_list,
                 precision=self.config.precision,
                 chunk_size=self.config.search_chunk_size,
+                approx_recall=approx_recall,
             )
             return SearchResult(ids=np.asarray(ids), dists=np.asarray(d))
         # one consistent device-state snapshot (concurrent writers swap it)
@@ -96,6 +103,7 @@ class FlatIndex(VectorIndex):
             corpus_sqnorms=sqnorms if self.metric == "l2-squared" else None,
             chunk_size=chunk if cap > chunk else 0,
             precision=self.config.precision,
+            approx_recall=approx_recall,
         )
         return SearchResult(ids=np.asarray(ids), dists=np.asarray(d))
 
@@ -107,7 +115,7 @@ class FlatIndex(VectorIndex):
         limit: int = 1024,
     ) -> SearchResult:
         k = min(limit, max(1, self.store.live_count))
-        res = self.search(queries, k, allow_list)
+        res = self.search(queries, k, allow_list, approx_recall=0.0)
         keep = res.dists <= max_distance
         ids = np.where(keep, res.ids, -1)
         dists = np.where(keep, res.dists, np.float32(MASK_DISTANCE))

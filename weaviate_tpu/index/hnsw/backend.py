@@ -159,6 +159,7 @@ class RawBackend:
                 self.store, qrep, k, self.metric, allow=allow,
                 precision=self.config.precision,
                 chunk_size=self.config.search_chunk_size,
+                approx_recall=self.config.flat_approx_recall,
             )
             d = np.array(d)
             ids = np.asarray(ids, np.int64)
@@ -181,6 +182,7 @@ class RawBackend:
             allow_mask=allow_j,
             corpus_sqnorms=sqnorms if self.metric == "l2-squared" else None,
             precision=self.config.precision,
+            approx_recall=self.config.flat_approx_recall,
         )
         d = np.array(d)
         ids = np.asarray(ids, np.int64)

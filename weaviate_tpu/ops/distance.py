@@ -180,8 +180,24 @@ def vectors_pairwise(
     return jnp.sum((diff != 0).astype(jnp.float32), axis=-1)
 
 
+def select_topk(
+    d: jnp.ndarray, k: int, approx_recall: float = 0.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Smallest-k selection over the last axis: exact ``top_k`` or, when
+    ``0 < approx_recall < 1``, TPU-native two-stage selection via
+    ``lax.approx_min_k`` (PartialReduce bins + aggregate) — ~4-5x faster at
+    1M rows for a bounded, reported recall loss. On CPU approx lowers to an
+    exact sort, so virtual-mesh tests see exact results either way.
+    """
+    if 0.0 < approx_recall < 1.0 and k < d.shape[-1]:
+        return jax.lax.approx_min_k(d, k, recall_target=approx_recall)
+    neg, idx = jax.lax.top_k(-d, k)
+    return -neg, idx
+
+
 @functools.partial(
-    jax.jit, static_argnames=("metric", "k", "chunk_size", "precision")
+    jax.jit,
+    static_argnames=("metric", "k", "chunk_size", "precision", "approx_recall"),
 )
 def flat_search(
     queries: jnp.ndarray,
@@ -193,6 +209,7 @@ def flat_search(
     corpus_sqnorms: Optional[jnp.ndarray] = None,
     chunk_size: int = 0,
     precision: str = "fp32",
+    approx_recall: float = 0.0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Brute-force top-k: the TPU-native flat index (reference ``flat/index.go:49``).
 
@@ -203,6 +220,10 @@ def flat_search(
     chunk_size   evaluate corpus in chunks of this many rows to bound the
                  [B, chunk] score materialization (0 = single shot). Must
                  divide into N by padding; non-multiple tail is handled.
+    approx_recall  0 = exact selection; in (0, 1) = per-chunk
+                 ``lax.approx_min_k`` with this recall target (see
+                 ``select_topk``); candidates are collected via ``scan``
+                 and merged ONCE — two-stage selection, no per-chunk sort.
 
     Returns (distances [B, k], ids [B, k]); masked/empty slots have distance
     MASK_DISTANCE and id -1.
@@ -222,9 +243,8 @@ def flat_search(
         if mask_block is not None:
             d = jnp.where(mask_block[None, :], d, MASK_DISTANCE)
         kk = min(k, c_block.shape[0])
-        neg, idx = jax.lax.top_k(-d, kk)
+        vals, idx = select_topk(d, kk, approx_recall)
         ids = idx.astype(jnp.int32) + base
-        vals = -neg
         if kk < k:
             pad = k - kk
             vals = jnp.concatenate(
@@ -236,10 +256,11 @@ def flat_search(
     if chunk_size <= 0 or chunk_size >= n:
         vals, ids = score_block(corpus, corpus_sqnorms, mask, 0)
     else:
+        from weaviate_tpu.ops.topk import merge_candidate_stack, merge_topk
+
         n_full = (n // chunk_size) * chunk_size
 
-        def body(i, carry):
-            best_v, best_i = carry
+        def body(carry, i):
             start = i * chunk_size
             c_block = jax.lax.dynamic_slice_in_dim(corpus, start, chunk_size, 0)
             norms_block = (
@@ -252,23 +273,20 @@ def flat_search(
                 if mask is not None
                 else None
             )
-            v, idx = score_block(c_block, norms_block, mask_block, start)
-            from weaviate_tpu.ops.topk import merge_topk
+            return carry, score_block(c_block, norms_block, mask_block, start)
 
-            return merge_topk(best_v, best_i, v, idx, k)
-
-        init_v = jnp.full((b, k), MASK_DISTANCE, jnp.float32)
-        init_i = jnp.full((b, k), -1, jnp.int32)
-        vals, ids = jax.lax.fori_loop(
-            0, n_full // chunk_size, body, (init_v, init_i)
+        # Collect every chunk's [B, k] candidates (scan stacks them) and pay
+        # for exactly ONE [B, chunks*k] merge at the end — not a sort per
+        # chunk (the round-1 fori_loop merged after every chunk).
+        _, (vs, is_) = jax.lax.scan(
+            body, 0, jnp.arange(n_full // chunk_size, dtype=jnp.int32)
         )
+        vals, ids = merge_candidate_stack(vs, is_, k)
         if n_full < n:
             tail_c = corpus[n_full:]
             tail_norms = corpus_sqnorms[n_full:] if corpus_sqnorms is not None else None
             tail_mask = mask[n_full:] if mask is not None else None
             v, idx = score_block(tail_c, tail_norms, tail_mask, n_full)
-            from weaviate_tpu.ops.topk import merge_topk
-
             vals, ids = merge_topk(vals, ids, v, idx, k)
 
     # Mark slots that only contain sentinel as id -1.

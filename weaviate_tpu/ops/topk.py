@@ -31,6 +31,22 @@ def merge_topk(
     return -neg, jnp.take_along_axis(ids, sel, axis=1)
 
 
+def merge_candidate_stack(
+    vals: jnp.ndarray, ids: jnp.ndarray, k: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Final merge for scan-collected per-chunk candidates.
+
+    vals/ids: [C, B, k'] stacked by ``lax.scan`` (one [B, k'] block per
+    chunk). Flattens to [B, C*k'] and pays for exactly one top_k — the
+    second stage of two-stage selection.
+    """
+    b = vals.shape[1]
+    cand_v = jnp.moveaxis(vals, 0, 1).reshape(b, -1)
+    cand_i = jnp.moveaxis(ids, 0, 1).reshape(b, -1)
+    neg, sel = jax.lax.top_k(-cand_v, k)
+    return -neg, jnp.take_along_axis(cand_i, sel, axis=1)
+
+
 def masked_topk(
     dists: jnp.ndarray,
     k: int,

@@ -42,10 +42,11 @@ def replicate(x, mesh: Mesh):
 
 
 def _local_topk(c_local, v_local, queries, k, metric, precision, sq_local,
-                chunk_size):
+                chunk_size, approx_recall=0.0):
     """Masked top-k over this device's corpus block, chunked to bound the
     [B, chunk] score materialization (mirrors ops.flat_search's loop)."""
-    from weaviate_tpu.ops.topk import merge_topk
+    from weaviate_tpu.ops.distance import select_topk
+    from weaviate_tpu.ops.topk import merge_candidate_stack, merge_topk
 
     n_local = c_local.shape[0]
     b = queries.shape[0]
@@ -55,33 +56,33 @@ def _local_topk(c_local, v_local, queries, k, metric, precision, sq_local,
                               corpus_sqnorms=sq_blk, precision=precision)
         d = jnp.where(v_blk[None, :], d, MASK_DISTANCE)
         kk = min(k, c_blk.shape[0])
-        neg, idx = jax.lax.top_k(-d, kk)
+        vals, idx = select_topk(d, kk, approx_recall)
         if kk < k:
-            neg = jnp.concatenate(
-                [neg, jnp.full((b, k - kk), -MASK_DISTANCE, neg.dtype)],
+            vals = jnp.concatenate(
+                [vals, jnp.full((b, k - kk), MASK_DISTANCE, vals.dtype)],
                 axis=1)
             idx = jnp.concatenate(
                 [idx, jnp.zeros((b, k - kk), idx.dtype)], axis=1)
-        return -neg, idx.astype(jnp.int32) + base
+        return vals, idx.astype(jnp.int32) + base
 
     if chunk_size <= 0 or chunk_size >= n_local:
         return score_block(c_local, v_local, sq_local, 0)
 
     n_full = (n_local // chunk_size) * chunk_size
 
-    def body(i, carry):
-        bv, bi = carry
+    def body(carry, i):
         start = i * chunk_size
         c_blk = jax.lax.dynamic_slice_in_dim(c_local, start, chunk_size, 0)
         v_blk = jax.lax.dynamic_slice_in_dim(v_local, start, chunk_size, 0)
         sq_blk = (jax.lax.dynamic_slice_in_dim(sq_local, start, chunk_size, 0)
                   if sq_local is not None else None)
-        v, idx = score_block(c_blk, v_blk, sq_blk, start)
-        return merge_topk(bv, bi, v, idx, k)
+        return carry, score_block(c_blk, v_blk, sq_blk, start)
 
-    init = (jnp.full((b, k), MASK_DISTANCE, jnp.float32),
-            jnp.full((b, k), -1, jnp.int32))
-    vals, ids = jax.lax.fori_loop(0, n_full // chunk_size, body, init)
+    # scan-collect all per-chunk candidates, merge ONCE (two-stage selection;
+    # the round-1 version paid a [B, 2k] sort per chunk).
+    _, (vs, is_) = jax.lax.scan(
+        body, 0, jnp.arange(n_full // chunk_size, dtype=jnp.int32))
+    vals, ids = merge_candidate_stack(vs, is_, k)
     if n_full < n_local:
         v, idx = score_block(
             c_local[n_full:], v_local[n_full:],
@@ -91,9 +92,9 @@ def _local_topk(c_local, v_local, queries, k, metric, precision, sq_local,
 
 
 def _local_search(c_local, v_local, queries, k, metric, axis, precision,
-                  sq_local=None, chunk_size=0):
+                  sq_local=None, chunk_size=0, approx_recall=0.0):
     vals, idx = _local_topk(c_local, v_local, queries, k, metric, precision,
-                            sq_local, chunk_size)
+                            sq_local, chunk_size, approx_recall)
     neg = -vals
     shard_id = jax.lax.axis_index(axis)
     ids = idx + shard_id * c_local.shape[0]
@@ -110,7 +111,7 @@ def _local_search(c_local, v_local, queries, k, metric, axis, precision,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "metric", "mesh", "axis", "precision",
-                     "chunk_size"),
+                     "chunk_size", "approx_recall"),
 )
 def sharded_flat_search(
     corpus: jnp.ndarray,
@@ -123,6 +124,7 @@ def sharded_flat_search(
     precision: str = "bf16",
     sqnorms: Optional[jnp.ndarray] = None,
     chunk_size: int = 0,
+    approx_recall: float = 0.0,
 ):
     """Distributed exact top-k. corpus [N, D] sharded on N; queries replicated;
     optional precomputed [N] squared norms (sharded like valid) avoid an
@@ -136,6 +138,7 @@ def sharded_flat_search(
             functools.partial(
                 _local_search, k=k, metric=metric, axis=axis,
                 precision=precision, chunk_size=chunk_size,
+                approx_recall=approx_recall,
             ),
             mesh=mesh,
             in_specs=(P(axis, None), P(axis), P(None, None)),
@@ -146,7 +149,7 @@ def sharded_flat_search(
     fn = jax.shard_map(
         lambda c, v, q, s: _local_search(
             c, v, q, k=k, metric=metric, axis=axis, precision=precision,
-            sq_local=s, chunk_size=chunk_size,
+            sq_local=s, chunk_size=chunk_size, approx_recall=approx_recall,
         ),
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(None, None), P(axis)),
@@ -158,7 +161,7 @@ def sharded_flat_search(
 
 def mesh_flat_topk(store, queries: jnp.ndarray, k: int, metric: str,
                    allow=None, precision: str = "bf16",
-                   chunk_size: int = 0):
+                   chunk_size: int = 0, approx_recall: float = 0.0):
     """THE mesh flat-search entry for serving code (FlatIndex + HNSW flat
     cutoff): one place owns the subtle details — allow mask resharded onto
     the valid mask's layout, sqnorms only for l2, per-device chunking.
@@ -181,6 +184,7 @@ def mesh_flat_topk(store, queries: jnp.ndarray, k: int, metric: str,
         mesh=store.mesh, precision=precision,
         sqnorms=sqnorms if metric == "l2-squared" else None,
         chunk_size=chunk_size if 0 < chunk_size < n_local else 0,
+        approx_recall=approx_recall,
     )
 
 

@@ -163,6 +163,12 @@ class VectorIndexConfig:
     precision: str = "bf16"  # matmul precision on TPU: bf16 | fp32
     initial_capacity: int = 1024
     search_chunk_size: int = 131072
+    # Flat-scan selection: 0 = exact top_k; in (0, 1) = TPU two-stage
+    # approx_min_k with this recall target (~4-5x faster at 1M rows; on CPU
+    # it lowers to an exact sort, so results there are identical). The
+    # reference's flat scan is always exact — this knob is the TPU-native
+    # trade the hardware rewards; measured recall is reported by bench.py.
+    flat_approx_recall: float = 0.0
 
     def validate(self) -> None:
         from weaviate_tpu.ops.distance import METRICS
@@ -176,6 +182,10 @@ class VectorIndexConfig:
             raise ValueError(f"invalid distance {self.distance!r}")
         if self.precision not in ("bf16", "fp32"):
             raise ValueError(f"invalid precision {self.precision!r}")
+        if not 0.0 <= self.flat_approx_recall < 1.0:
+            raise ValueError(
+                f"flat_approx_recall must be in [0, 1), got {self.flat_approx_recall}"
+            )
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
