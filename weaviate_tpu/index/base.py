@@ -72,6 +72,17 @@ class VectorIndex(abc.ABC):
     def flush(self) -> None:  # durability hook; storage owns real persistence
         pass
 
+    # -- device-state checkpoint (shard boot = load + delta replay, not a
+    # full object-store rebuild; reference hnsw/startup.go commit-log role)
+    def save_vectors(self, path: str, meta: Optional[dict] = None) -> bool:
+        """Persist the raw vector tier; False = unsupported by this index."""
+        return False
+
+    def load_vectors(self, path: str) -> Optional[dict]:
+        """Restore the raw vector tier; returns saved meta, None = no/bad
+        checkpoint (or unsupported) — caller falls back to full rebuild."""
+        return None
+
     def drop(self) -> None:
         pass
 

@@ -90,6 +90,16 @@ class DynamicIndex(VectorIndex):
     def flush(self) -> None:
         self._inner.flush()
 
+    def save_vectors(self, path: str, meta=None) -> bool:
+        return self._inner.save_vectors(path, meta)
+
+    def load_vectors(self, path: str):
+        meta = self._inner.load_vectors(path)
+        if meta is not None:
+            # a restored corpus may already be over the upgrade threshold
+            self._maybe_upgrade()
+        return meta
+
     def stats(self) -> dict:
         s = self._inner.stats()
         s["type"] = f"dynamic[{s['type']}]"

@@ -131,6 +131,13 @@ class FlatIndex(VectorIndex):
     def contains(self, doc_id: int) -> bool:
         return self.store.contains(doc_id)
 
+    def save_vectors(self, path: str, meta: Optional[dict] = None) -> bool:
+        self.store.save(path, meta)
+        return True
+
+    def load_vectors(self, path: str) -> Optional[dict]:
+        return self.store.load(path)
+
     def stats(self) -> dict:
         return {
             "type": "flat",

@@ -617,6 +617,17 @@ class HNSWIndex(VectorIndex):
         )
 
     # ------------------------------------------------------------------
+    def save_vectors(self, path: str, meta: Optional[dict] = None) -> bool:
+        if self.store is None:  # quantized backend: codes rebuild from store
+            return False
+        self.store.save(path, meta)
+        return True
+
+    def load_vectors(self, path: str) -> Optional[dict]:
+        if self.store is None:
+            return None
+        return self.store.load(path)
+
     def count(self) -> int:
         return self.graph.node_count
 

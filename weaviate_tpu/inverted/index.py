@@ -40,12 +40,14 @@ class InvertedIndex:
             from weaviate_tpu.inverted.native_bm25 import try_native_bm25
 
             self.native = try_native_bm25(self.k1, self.b)
-        # postings[prop][term] -> {doc_id: tf}
-        self.postings: dict[str, dict[str, dict[int, int]]] = defaultdict(
-            lambda: defaultdict(dict)
+        from weaviate_tpu.inverted.postings import DocLengths, PostingList
+
+        # postings[prop][term] -> PostingList (array base + overlay)
+        self.postings: dict[str, dict[str, PostingList]] = defaultdict(
+            lambda: defaultdict(PostingList)
         )
-        # doc_lengths[prop] -> {doc_id: n_tokens}
-        self.doc_lengths: dict[str, dict[int, int]] = defaultdict(dict)
+        # doc_lengths[prop] -> doc-aligned length column
+        self.doc_lengths: dict[str, DocLengths] = defaultdict(DocLengths)
         # running totals so avgdl is O(1) at query time (not O(doc_count))
         self.len_totals: dict[str, int] = defaultdict(int)
         # filter values: prop -> {doc_id: value} (scalar or list); the value
@@ -102,13 +104,11 @@ class InvertedIndex:
                         total += sum(tf.values())
                         for term, n in tf.items():
                             combined[term] = combined.get(term, 0) + n
-                            self.postings[prop][term][doc_id] = (
-                                self.postings[prop][term].get(doc_id, 0) + n
-                            )
-                    prev = self.doc_lengths[prop].get(doc_id)
+                            plist = self.postings[prop][term]
+                            plist.set(doc_id, plist.get(doc_id, 0) + n)
+                    prev = self.doc_lengths[prop].set(doc_id, total)
                     if prev is not None:
                         self.len_totals[prop] -= prev
-                    self.doc_lengths[prop][doc_id] = total
                     self.len_totals[prop] += total
                     if self.native is not None and combined:
                         self.native.add_doc(doc_id, prop, combined, total)
@@ -136,6 +136,23 @@ class InvertedIndex:
                         plist = self.postings.get(prop, {}).get(term)
                         if plist is not None:
                             plist.pop(doc_id, None)
+
+    def delete_docid(self, doc_id: int) -> None:
+        """Delete by doc id alone — the crash-replay path, where the object
+        bytes are already gone from the store. Postings entries of the doc
+        cannot be located without its terms; they stay as stale rows that the
+        liveness mask screens out of every query (native engine tombstones,
+        dense path intersects the columnar live bitmap)."""
+        self.doc_count = max(0, self.doc_count - 1)
+        self.columnar.delete(doc_id)
+        if self.native is not None:
+            self.native.remove_doc(doc_id)
+        for prop, vals in self.values.items():
+            vals.pop(doc_id, None)
+        for prop, lengths in self.doc_lengths.items():
+            prev = lengths.pop(doc_id, None)
+            if prev is not None:
+                self.len_totals[prop] -= prev
 
     # -- BM25 -------------------------------------------------------------
     def bm25_search(
@@ -193,7 +210,12 @@ class InvertedIndex:
         space = max(
             doc_space,
             1 + max(
-                (max(pl) for prop, _ in props for pl in self.postings.get(prop, {}).values() if pl),
+                (
+                    int(pl.keys()[-1])
+                    for prop, _ in props
+                    for pl in self.postings.get(prop, {}).values()
+                    if len(pl)
+                ),
                 default=0,
             ),
         )
@@ -204,8 +226,12 @@ class InvertedIndex:
             prop_postings = self.postings.get(prop)
             if not prop_postings:
                 continue
-            lengths = self.doc_lengths.get(prop, {})
-            avg_len = (self.len_totals[prop] / len(lengths)) if lengths else 1.0
+            lengths = self.doc_lengths.get(prop)
+            avg_len = (
+                self.len_totals[prop] / len(lengths)
+                if lengths is not None and len(lengths)
+                else 1.0
+            )
             terms = [
                 t
                 for t in tokenize(query, self._tokenization(prop))
@@ -213,18 +239,25 @@ class InvertedIndex:
             ]
             for term in set(terms):
                 plist = prop_postings.get(term)
-                if not plist:
+                if plist is None or not len(plist):
                     continue
                 df = len(plist)
                 idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
-                ids = np.fromiter(plist.keys(), np.int64, len(plist))
-                tfs = np.fromiter(plist.values(), np.float32, len(plist))
-                dls = np.asarray([lengths.get(int(i), 0) for i in ids], np.float32)
+                ids, tfs_u = plist.arrays()
+                tfs = tfs_u.astype(np.float32)
+                dls = (
+                    lengths.gather(ids)
+                    if lengths is not None
+                    else np.zeros(len(ids), np.float32)
+                )
                 denom = tfs + self.k1 * (1 - self.b + self.b * dls / max(avg_len, 1e-9))
                 term_scores = idf * tfs * (self.k1 + 1) / np.maximum(denom, 1e-9)
                 scores[ids] += boost * term_scores
                 touched[ids] = True
 
+        # stale postings of crash-replay deletions are screened here (see
+        # delete_docid); live docs are unaffected
+        touched &= self.columnar.live_mask(space)
         if allow_list is not None:
             al = np.asarray(allow_list, bool)
             if al.shape[0] < space:

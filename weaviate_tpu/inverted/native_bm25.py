@@ -33,6 +33,8 @@ def _bind():
     lib.bm25_add_doc.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, _U64, _U32, ctypes.c_uint32,
         ctypes.c_uint32]
+    lib.bm25_add_term.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, _I64, _U32, _U32, ctypes.c_uint64]
     lib.bm25_remove_doc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.bm25_compact.argtypes = [ctypes.c_void_p]
     lib.bm25_posting_len.restype = ctypes.c_uint64
@@ -84,6 +86,22 @@ class NativeBM25:
         tfs = (ctypes.c_uint32 * n)(*term_freqs.values())
         with self._lock:
             self._lib.bm25_add_doc(self._h, doc_id, ids, tfs, n, doc_len)
+
+    def add_term(self, prop: str, term: str, doc_ids: np.ndarray,
+                 tfs: np.ndarray, doc_lens: np.ndarray) -> None:
+        """Bulk-append one (prop, term) posting list — the snapshot-load
+        path: one C call per term instead of one per doc."""
+        n = len(doc_ids)
+        if n == 0:
+            return
+        docs = np.ascontiguousarray(doc_ids, np.int64)
+        tf = np.ascontiguousarray(tfs, np.uint32)
+        dl = np.ascontiguousarray(doc_lens, np.uint32)
+        with self._lock:
+            self._lib.bm25_add_term(
+                self._h, term_id(prop, term),
+                docs.ctypes.data_as(_I64), tf.ctypes.data_as(_U32),
+                dl.ctypes.data_as(_U32), n)
 
     def remove_doc(self, doc_id: int) -> None:
         with self._lock:

@@ -149,6 +149,19 @@ void bm25_add_doc(void* h, int64_t doc, const uint64_t* term_ids,
     }
 }
 
+// bulk-append one term's posting list (snapshot load path): docs may be
+// pre-sorted; lists are finalized lazily at first search either way.
+void bm25_add_term(void* h, uint64_t term_id, const int64_t* docs,
+                   const uint32_t* tfs, const uint32_t* dls, uint64_t n) {
+    auto* ix = static_cast<Index*>(h);
+    auto& pl = ix->postings[term_id];
+    pl.entries.reserve(pl.entries.size() + n);
+    for (uint64_t i = 0; i < n; ++i) {
+        pl.entries.push_back({docs[i], tfs[i], dls[i]});
+    }
+    pl.dirty = true;
+}
+
 void bm25_remove_doc(void* h, int64_t doc) {
     auto* ix = static_cast<Index*>(h);
     if (ix->tombstones.insert(doc).second) ix->tomb_gen++;

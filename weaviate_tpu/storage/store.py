@@ -12,82 +12,28 @@ Strategies implemented:
 - ``map``    — value is a key->bytes mapping merged newest-wins per map-key
                (postings with payloads)
 
-Segment format: msgpack framed records sorted by key; full key index built on
-open (the reference embeds a disk b-tree — ``segmentindex/``; at our scale an
-in-memory dict of offsets serves the same reads).
+Segments are disk-resident (``storage/segment.py``): sparse index + bloom
+filter in RAM, record reads via mmap, iteration/compaction as streaming
+k-way merges — a bucket's open cost is O(segments * count/SPARSE), not
+O(corpus) (reference ``segment_bloom_filters.go``, ``segmentindex/``).
 """
 
 from __future__ import annotations
 
 import os
-import struct
 import threading
 from typing import Any, Iterator, Optional
 
 import msgpack
 
+from weaviate_tpu.storage.segment import (
+    MISSING as _MISSING,
+    DiskSegment as Segment,
+    merge_streams,
+)
 from weaviate_tpu.storage.wal import WAL
 
 STRATEGIES = ("replace", "set", "map")
-
-_TOMBSTONE = b"\x00__del__"
-
-
-class Segment:
-    """Immutable sorted segment: records [(key, strategy-payload)]."""
-
-    def __init__(self, path: str):
-        self.path = path
-        self._index: dict[bytes, Any] = {}
-        self._load()
-
-    def _load(self) -> None:
-        with open(self.path, "rb") as f:
-            unpacker = msgpack.Unpacker(f, raw=True)
-            for key, val in unpacker:
-                self._index[key] = _decode_val(val)
-
-    def get(self, key: bytes):
-        return self._index.get(key, _MISSING)
-
-    def keys(self):
-        return self._index.keys()
-
-    def items(self):
-        return self._index.items()
-
-    def __len__(self):
-        return len(self._index)
-
-    @staticmethod
-    def write(path: str, items: list[tuple[bytes, Any]]) -> "Segment":
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            for key, val in sorted(items, key=lambda kv: kv[0]):
-                f.write(msgpack.packb((key, _encode_val(val)), use_bin_type=True))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        return Segment(path)
-
-
-class _Missing:
-    __slots__ = ()
-
-
-_MISSING = _Missing()
-
-
-def _encode_val(val):
-    # replace: bytes|None ; set: dict[bytes,bool] (True=add False=remove)
-    # map: dict[bytes, bytes|None]
-    return val
-
-
-def _decode_val(val):
-    if isinstance(val, dict):
-        return val
-    return val
 
 
 class Bucket:
@@ -110,8 +56,17 @@ class Bucket:
             f for f in os.listdir(self.dir) if f.startswith("segment-") and f.endswith(".db")
         )
         for s in segs:
-            self._segments.append(Segment(os.path.join(self.dir, s)))
+            path = os.path.join(self.dir, s)
+            # seq advances even over quarantined files so a fresh segment
+            # never reuses a number that would re-order the LSM stack
             self._seg_seq = max(self._seg_seq, int(s[len("segment-"):-3]) + 1)
+            try:
+                self._segments.append(Segment(path))
+            except (ValueError, OSError):
+                # unreadable/foreign-format segment: quarantine instead of
+                # failing the whole shard open (reference has dedicated
+                # corruption fixers; data re-enters via rebuild paths)
+                os.replace(path, path + ".corrupt")
         wal_path = os.path.join(self.dir, "wal.log")
         for rec in WAL.replay(wal_path):
             op = msgpack.unpackb(rec, raw=True)
@@ -204,31 +159,18 @@ class Bucket:
         merged = self.get(key)
         return {k: v for k, v in merged.items() if v is not None}
 
-    def keys(self) -> Iterator[bytes]:
-        """All live keys, merged across memtable + segments."""
-        with self._lock:
-            seen: set[bytes] = set()
-            dead: set[bytes] = set()
-            if self.strategy == "replace":
-                for k, v in self._mem.items():
-                    (dead if v is None else seen).add(k)
-                for seg in reversed(self._segments):
-                    for k, v in seg.items():
-                        if k in seen or k in dead:
-                            continue
-                        (dead if v is None else seen).add(k)
-            else:
-                for k in self._mem:
-                    seen.add(k)
-                for seg in self._segments:
-                    seen.update(seg.keys())
-            return iter(sorted(seen))
-
     def items(self) -> Iterator[tuple[bytes, Any]]:
-        for k in self.keys():
-            v = self.get(k)
-            if v is not None:
-                yield k, v
+        """Live (key, merged-value) pairs in key order — one streaming k-way
+        merge over segments + a memtable snapshot; nothing is materialized."""
+        with self._lock:
+            streams = [seg.items() for seg in self._segments]
+            streams.append(iter(sorted(self._mem.items())))
+        yield from merge_streams(streams, self.strategy, drop_tombstones=True)
+
+    def keys(self) -> Iterator[bytes]:
+        """All live keys, merged across memtable + segments, in key order."""
+        for k, _ in self.items():
+            yield k
 
     def __len__(self) -> int:
         return sum(1 for _ in self.keys())
@@ -244,41 +186,38 @@ class Bucket:
                 return
             path = os.path.join(self.dir, f"segment-{self._seg_seq:06d}.db")
             self._seg_seq += 1
-            self._segments.append(Segment.write(path, list(self._mem.items())))
+            self._segments.append(
+                Segment.write(path, sorted(self._mem.items()))
+            )
             self._mem = {}
             self._wal.close()
             WAL.delete(self._wal.path)
             self._wal = WAL(self._wal.path, sync=self._wal.sync)
 
     def compact(self) -> None:
-        """Full-merge all segments (newest wins / set-union / map-merge),
-        dropping tombstones — reference ``segment_group_compaction.go``."""
+        """Streaming full-merge of all segments (newest wins / set-union /
+        map-merge), dropping tombstones — reference
+        ``segment_group_compaction.go``. Memory stays O(1) per record: the
+        k-way merge reads each segment sequentially and the new segment is
+        written as the merge drains."""
         with self._lock:
             if len(self._segments) <= 1:
                 return
-            merged: dict[bytes, Any] = {}
-            for seg in self._segments:
-                for k, v in seg.items():
-                    if self.strategy == "replace":
-                        merged[k] = v
-                    else:
-                        cur = merged.setdefault(k, {})
-                        if v:
-                            cur.update(v)
-            if self.strategy == "replace":
-                merged = {k: v for k, v in merged.items() if v is not None}
-            else:
-                merged = {
-                    k: {m: p for m, p in v.items() if p not in (None, False)}
-                    for k, v in merged.items()
-                }
-                merged = {k: v for k, v in merged.items() if v}
             old = self._segments
             path = os.path.join(self.dir, f"segment-{self._seg_seq:06d}.db")
             self._seg_seq += 1
-            new_seg = Segment.write(path, list(merged.items()))
+            new_seg = Segment.write(
+                path,
+                merge_streams(
+                    [seg.items() for seg in old],
+                    self.strategy,
+                    drop_tombstones=True,
+                ),
+            )
             self._segments = [new_seg]
             for seg in old:
+                # unlink only: a concurrent items() iterator may still hold
+                # the mmap (Linux keeps the inode until the map drops)
                 os.remove(seg.path)
 
     def flush(self) -> None:
@@ -287,6 +226,8 @@ class Bucket:
     def close(self) -> None:
         self.flush_memtable()
         self._wal.close()
+        for seg in self._segments:
+            seg.close()
 
     def count(self) -> int:
         return len(self)

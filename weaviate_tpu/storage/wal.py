@@ -35,6 +35,11 @@ class WAL:
         self._f.flush()
         os.fsync(self._f.fileno())
 
+    def flush_soft(self) -> None:
+        """Drain the userspace buffer to the OS (no fsync): survives process
+        kill, keeps write-ordering against other files' fsyncs."""
+        self._f.flush()
+
     def close(self) -> None:
         if not self._f.closed:
             self._f.flush()
