@@ -70,11 +70,16 @@ class HNSWIndex(VectorIndex):
         self._level_rng = np.random.default_rng(0x5EED)
         self._insert_batch = self.config.insert_batch
         self._visited: Optional[np.ndarray] = None  # [B, cap] scratch
-        # the visited scratch is shared; serialize beam searches (batching,
-        # not thread fan-out, is this index's throughput mechanism)
+        # Batching, not thread fan-out, is this index's throughput
+        # mechanism: concurrent searches COALESCE into one lockstep walk
+        # (dispatch.py); the scratch lock is the search/construction
+        # exclusion point (_search_level).
         import threading
 
-        self._search_lock = threading.Lock()
+        from weaviate_tpu.index.dispatch import CoalescingDispatcher
+
+        self._scratch_lock = threading.Lock()
+        self._dispatch = CoalescingDispatcher(self._run_search_batch)
         if path and os.path.exists(self._snapshot_path()):
             self._load_snapshot()
 
@@ -191,7 +196,20 @@ class HNSWIndex(VectorIndex):
         """Returns (res_ids [B, ef], res_d [B, ef]) ascending, and — when
         ``keep_mask`` is given (sweeping filter strategy, search.go:36-41) —
         (kept_ids [B, keep_k], kept_d [B, keep_k]) best *allowed* nodes seen.
+
+        The visited scratch is shared between searches (single-flight via
+        the coalescing dispatcher) and construction beams — this lock
+        serializes SCRATCH use only. Graph structure itself is read without
+        a lock (torn-read semantics, as in the reference's lock-free reads):
+        nodes linked mid-search are skipped via the scratch-width clamp in
+        the expansion loop.
         """
+        with self._scratch_lock:
+            return self._search_level_impl(qdev, eps, ef, level, keep_mask,
+                                           keep_k)
+
+    def _search_level_impl(self, qdev, eps, ef, level, keep_mask=None,
+                           keep_k=0):
         b = qdev.shape[0]
         rows = np.arange(b)
         # reusable visited scratch, cleared lazily via the touched log so a
@@ -231,6 +249,10 @@ class HNSWIndex(VectorIndex):
             cur = res_ids[rows, j].astype(np.int64)
             nbrs = self.graph.neighbors_batch(level, cur).astype(np.int64)
             nbrs[~active] = NO_NODE
+            # a concurrent insert may have linked nodes past this scratch's
+            # width (graph reads are torn-read-tolerant); skip them — they
+            # were not visible when this search started
+            nbrs[nbrs >= visited.shape[1]] = NO_NODE
             rr = np.repeat(rows, nbrs.shape[1]).reshape(nbrs.shape)
             fresh = nbrs >= 0
             fresh[fresh] = ~visited[rr[fresh], nbrs[fresh]]
@@ -553,16 +575,21 @@ class HNSWIndex(VectorIndex):
             if n_allowed <= self.config.flat_search_cutoff or n_allowed <= k:
                 return self._flat_filtered(queries, k, allow_list)
 
+        ids, d = self._dispatch.search(queries, k, allow_list)
+        return SearchResult(ids=ids, dists=d)
+
+    def _run_search_batch(self, queries: np.ndarray, k: int, allow_list):
+        """Single-flight batch runner behind the coalescing dispatcher."""
+        b = queries.shape[0]
         # visited scratch is [B, capacity]; bound its footprint
         sub_b = max(8, min(64, _VISITED_BUDGET // max(1, self.graph.capacity)))
         out_ids = np.full((b, k), -1, np.int64)
         out_d = np.full((b, k), _INF, np.float32)
-        with self._search_lock:  # shared visited scratch
-            for s in range(0, b, sub_b):
-                e = min(b, s + sub_b)
-                ids, d = self._search_one_batch(queries[s:e], k, allow_list)
-                out_ids[s:e], out_d[s:e] = ids, d
-        return SearchResult(ids=out_ids, dists=out_d)
+        for s in range(0, b, sub_b):
+            e = min(b, s + sub_b)
+            ids, d = self._search_one_batch(queries[s:e], k, allow_list)
+            out_ids[s:e], out_d[s:e] = ids, d
+        return out_ids, out_d
 
     def _keep_mask(self, allow_list: Optional[np.ndarray]) -> np.ndarray:
         cap = self.graph.capacity
