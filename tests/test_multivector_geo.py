@@ -1,0 +1,193 @@
+"""MUVERA multivector index + geo index.
+
+Reference test model: ``multivector/muvera_test.go`` (encoding properties +
+recall vs exact MaxSim) and ``vector/geo/geo_test.go`` (range queries).
+"""
+
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.index.geo import GeoIndex, haversine_m
+from weaviate_tpu.index.multivector import (
+    MultiVectorIndex, MuveraEncoder, maxsim_scores,
+)
+from weaviate_tpu.schema.config import MultiVectorIndexConfig
+
+
+def _token_sets(rng, n_docs, dims, tmin=4, tmax=24):
+    """ColBERT-style fixture: per-doc token sets around doc topics."""
+    topics = rng.standard_normal((n_docs, dims)).astype(np.float32)
+    sets = []
+    for i in range(n_docs):
+        t = rng.integers(tmin, tmax + 1)
+        toks = topics[i] + 0.6 * rng.standard_normal((t, dims)).astype(np.float32)
+        toks /= np.linalg.norm(toks, axis=1, keepdims=True) + 1e-12
+        sets.append(toks.astype(np.float32))
+    return sets
+
+
+def _exact_maxsim_topk(query, sets, k):
+    scores = []
+    for s in sets:
+        sims = query @ s.T  # [Tq, Td]
+        scores.append(float(sims.max(axis=1).sum()))
+    order = np.argsort(-np.asarray(scores), kind="stable")[:k]
+    return order.tolist()
+
+
+def test_encoder_shapes_and_determinism():
+    enc = MuveraEncoder(32, ksim=3, dproj=8, repetitions=4)
+    assert enc.fde_dim == 4 * 8 * 8
+    rng = np.random.default_rng(0)
+    toks = rng.standard_normal((10, 32)).astype(np.float32)
+    a = enc.encode_doc(toks)
+    b = MuveraEncoder(32, ksim=3, dproj=8, repetitions=4).encode_doc(toks)
+    np.testing.assert_array_equal(a, b)  # fixed seed -> stable encodings
+    q = enc.encode_query(toks)
+    assert q.shape == (enc.fde_dim,)
+
+
+def test_fde_similarity_tracks_maxsim():
+    """FDE dot products must correlate with exact MaxSim (the paper's whole
+    point); check rank correlation over a small corpus."""
+    rng = np.random.default_rng(1)
+    dims = 24
+    sets = _token_sets(rng, 60, dims)
+    enc = MuveraEncoder(dims, ksim=4, dproj=12, repetitions=8)
+    fdes = np.stack([enc.encode_doc(s) for s in sets])
+    q = sets[7][:6]
+    qf = enc.encode_query(q)
+    approx = fdes @ qf
+    exact = np.asarray([float((q @ s.T).max(axis=1).sum()) for s in sets])
+    # top-1 by exact MaxSim must rank in FDE top-5
+    top_exact = int(np.argmax(exact))
+    assert top_exact in np.argsort(-approx)[:5].tolist()
+
+
+def test_multivector_recall_vs_exact_late_interaction():
+    rng = np.random.default_rng(2)
+    dims, n, k = 24, 300, 10
+    sets = _token_sets(rng, n, dims)
+    idx = MultiVectorIndex(dims, MultiVectorIndexConfig(rescore_limit=60))
+    idx.add_batch_multi(np.arange(n, dtype=np.int64), sets)
+
+    hits = total = 0
+    for qi in (3, 77, 150, 222):
+        q = sets[qi][:8]
+        res = idx.search_multi(q, k)
+        got = [int(d) for d in res.ids[0] if d >= 0]
+        want = _exact_maxsim_topk(q, sets, k)
+        assert got[0] == want[0] == qi  # own doc is the top hit
+        hits += len(set(got) & set(want))
+        total += k
+    assert hits / total >= 0.9, f"recall {hits/total:.2f}"
+
+
+def test_maxsim_scores_respects_padding():
+    q = np.eye(2, 4, dtype=np.float32)
+    toks = np.zeros((1, 3, 4), np.float32)
+    toks[0, 0] = [1, 0, 0, 0]
+    toks[0, 1] = [9, 9, 9, 9]  # padded slot — must be ignored
+    mask = np.array([[True, False, False]])
+    s = maxsim_scores(q, toks, mask)
+    np.testing.assert_allclose(s, [1.0])
+
+
+def test_multivector_delete_and_single_vector_degenerate():
+    rng = np.random.default_rng(3)
+    idx = MultiVectorIndex(8, MultiVectorIndexConfig())
+    vecs = rng.standard_normal((5, 8)).astype(np.float32)
+    idx.add_batch(np.arange(5, dtype=np.int64), vecs)
+    res = idx.search(vecs[2][None, :], 2)
+    assert res.ids[0][0] == 2
+    idx.delete(np.asarray([2]))
+    res = idx.search(vecs[2][None, :], 2)
+    assert 2 not in res.ids[0].tolist()
+    assert idx.count() == 4
+
+
+def test_multivector_through_shard_with_recovery():
+    from weaviate_tpu.core.shard import Shard
+    from weaviate_tpu.schema.config import CollectionConfig
+    from weaviate_tpu.storage.objects import StorageObject
+
+    tmp = tempfile.mkdtemp()
+    try:
+        rng = np.random.default_rng(4)
+        cfg = CollectionConfig(
+            name="Colbert",
+            named_vectors={"tokens": MultiVectorIndexConfig(rescore_limit=20)},
+        )
+        sets = _token_sets(rng, 40, 16)
+        s1 = Shard(tmp, cfg)
+        objs = [
+            StorageObject(uuid=f"00000000-0000-0000-0000-{i:012d}",
+                          collection="Colbert",
+                          named_vectors={"tokens": sets[i]})
+            for i in range(40)
+        ]
+        s1.put_batch(objs)
+        q = sets[9][:5]
+        r1 = s1.vector_search(q, k=3, target="tokens")
+        assert r1.ids[0][0] == objs[9].doc_id
+        s1.close()
+
+        s2 = Shard(tmp, cfg)  # multivector doesn't checkpoint -> rebuild path
+        r2 = s2.vector_search(q, k=3, target="tokens")
+        assert r2.ids[0].tolist() == r1.ids[0].tolist()
+        s2.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# geo
+# ---------------------------------------------------------------------------
+
+def test_geo_range_and_knn():
+    g = GeoIndex()
+    # Berlin, Potsdam (~26km), Hamburg (~255km), Munich (~504km)
+    g.add(1, 52.5200, 13.4050)
+    g.add(2, 52.3906, 13.0645)
+    g.add(3, 53.5511, 9.9937)
+    g.add(4, 48.1351, 11.5820)
+    near = g.within_range(52.5200, 13.4050, 50_000)
+    assert near.tolist() == [1, 2]
+    ids, d = g.knn(52.5200, 13.4050, 3)
+    assert ids.tolist() == [1, 2, 3]
+    assert d[0] < 1.0 and 20_000 < d[1] < 35_000 and 200_000 < d[2] < 300_000
+
+
+def test_geo_delete_and_dedup():
+    g = GeoIndex()
+    g.add(1, 10.0, 10.0)
+    g.add(2, 10.001, 10.001)
+    g.delete(2)
+    assert g.within_range(10.0, 10.0, 10_000).tolist() == [1]
+    g.add(2, 10.0005, 10.0005)  # re-add revives
+    assert g.within_range(10.0, 10.0, 10_000).tolist() == [1, 2]
+    assert len(g) == 2
+
+
+def test_geo_haversine_against_known_distance():
+    # Paris <-> London ~343.5 km
+    d = haversine_m(48.8566, 2.3522, np.asarray([51.5074]),
+                    np.asarray([-0.1278]))[0]
+    assert 340_000 < d < 347_000
+
+
+def test_geo_filter_through_columnar_engine():
+    """WithinGeoRange e2e via the filter engine (reference geo property
+    filter path)."""
+    from weaviate_tpu.inverted.columnar import ColumnarProps
+
+    cp = ColumnarProps()
+    cp.add(0, {"loc": {"latitude": 52.52, "longitude": 13.405}})
+    cp.add(1, {"loc": {"latitude": 48.1351, "longitude": 11.582}})
+    m = cp.eval_leaf("WithinGeoRange", "loc",
+                     {"latitude": 52.52, "longitude": 13.405,
+                      "distance": 100_000}, 2)
+    assert m.tolist() == [True, False]

@@ -300,7 +300,14 @@ class Collection:
         QUERIES_TOTAL.inc(type="vector", collection=self.config.name)
         QUERY_DURATION.observe(time.perf_counter() - t0, type="vector")
 
-        b = np.atleast_2d(queries).shape[0]
+        # a multivector target consumes the whole [Tq, D] matrix as ONE
+        # late-interaction query — the merged result has a single row
+        target_cfg = (self.config.vector_config if target == DEFAULT_VECTOR
+                      else self.config.named_vectors.get(target))
+        if target_cfg is not None and target_cfg.index_type == "multivector":
+            b = 1
+        else:
+            b = np.atleast_2d(queries).shape[0]
         out: list[list[tuple[StorageObject, float]]] = []
         for qi in range(b):
             cands: list[tuple[float, Shard, int]] = []

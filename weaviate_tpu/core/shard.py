@@ -50,11 +50,27 @@ def build_vector_index(
         if not isinstance(cfg, DynamicIndexConfig):
             cfg = cfg.as_type(DynamicIndexConfig, "dynamic")
         return DynamicIndex(dims, cfg, path=path)
+    if cfg.index_type == "multivector":
+        from weaviate_tpu.index.multivector import MultiVectorIndex
+        from weaviate_tpu.schema.config import MultiVectorIndexConfig
+
+        if not isinstance(cfg, MultiVectorIndexConfig):
+            cfg = cfg.as_type(MultiVectorIndexConfig, "multivector")
+        return MultiVectorIndex(dims, cfg)
     from weaviate_tpu.index.flat import make_flat
 
     if not isinstance(cfg, FlatIndexConfig):
         cfg = cfg.as_type(FlatIndexConfig, "flat")
     return make_flat(dims, cfg)
+
+
+def _feed_index(idx: VectorIndex, id_arr: np.ndarray, vecs: list) -> None:
+    """Route a collected batch to the index: ragged token sets go to the
+    multivector path, fixed-dim rows stack into one device batch."""
+    if idx.multi_vector:
+        idx.add_batch_multi(id_arr, [np.asarray(v, np.float32) for v in vecs])
+    else:
+        idx.add_batch(id_arr, np.stack(vecs))
 
 
 class Shard:
@@ -212,8 +228,8 @@ class Shard:
         for nm, (ids, vecs) in batches.items():
             if not ids:
                 continue
-            idx = self._index_for(nm, len(vecs[0]))
-            idx.add_batch(np.asarray(ids, np.int64), np.stack(vecs))
+            idx = self._index_for(nm, int(np.asarray(vecs[0]).shape[-1]))
+            _feed_index(idx, np.asarray(ids, np.int64), vecs)
         batches.clear()
 
     def _recover_full(self) -> None:
@@ -233,8 +249,8 @@ class Shard:
                 batches.setdefault(nm, ([], []))[0].append(obj.doc_id)
                 batches[nm][1].append(v)
         for nm, (ids, vecs) in batches.items():
-            idx = self._index_for(nm, len(vecs[0]))
-            idx.add_batch(np.asarray(ids, np.int64), np.stack(vecs))
+            idx = self._index_for(nm, int(np.asarray(vecs[0]).shape[-1]))
+            _feed_index(idx, np.asarray(ids, np.int64), vecs)
         self._live_count = live
 
     def _rebuild_vector_targets(self, targets: list[str]) -> None:
@@ -256,8 +272,8 @@ class Shard:
         for nm, (ids, vecs) in batches.items():
             if not ids:
                 continue
-            idx = self._index_for(nm, len(vecs[0]))
-            idx.add_batch(np.asarray(ids, np.int64), np.stack(vecs))
+            idx = self._index_for(nm, int(np.asarray(vecs[0]).shape[-1]))
+            _feed_index(idx, np.asarray(ids, np.int64), vecs)
 
     def _vec_ckpt_path(self, target: str) -> str:
         return os.path.join(self.dir, f"vector__{target}.ckpt")
@@ -392,14 +408,15 @@ class Shard:
 
             for nm, (ids, vecs) in batches.items():
                 id_arr = np.asarray(ids, np.int64)
-                vec_arr = np.stack(vecs)
-                if self.async_queue is not None:
-                    # ensure the index exists (dims fixed) then enqueue
-                    self._index_for(nm, vec_arr.shape[-1])
-                    self.async_queue.push(nm, id_arr, vec_arr)
+                dims = int(np.asarray(vecs[0]).shape[-1])
+                idx = self._index_for(nm, dims)
+                if (self.async_queue is not None
+                        and not idx.multi_vector):
+                    # fixed-shape targets enqueue; ragged multivector sets
+                    # index synchronously (the disk queue stores [n, D])
+                    self.async_queue.push(nm, id_arr, np.stack(vecs))
                 else:
-                    idx = self._index_for(nm, vec_arr.shape[-1])
-                    idx.add_batch(id_arr, vec_arr)
+                    _feed_index(idx, id_arr, vecs)
             self._live_count += len(final)
             return doc_ids
 
@@ -493,6 +510,15 @@ class Shard:
                 ids=np.full((b, k), -1, np.int64),
                 dists=np.full((b, k), np.inf, np.float32),
             )
+        if idx.multi_vector:
+            # a [Tq, D] matrix is ONE late-interaction query (token set),
+            # not a Tq-query batch; max_distance bounds the negated MaxSim
+            res = idx.search_multi(queries, k, allow_list)
+            if max_distance is not None:
+                keep = res.dists <= max_distance
+                res = SearchResult(ids=np.where(keep, res.ids, -1),
+                                   dists=np.where(keep, res.dists, np.inf))
+            return res
         if max_distance is not None:
             return idx.search_by_distance(queries, max_distance, allow_list, limit=k)
         return idx.search(queries, k, allow_list)

@@ -149,7 +149,7 @@ def quantizer_from_dict(d: Optional[dict]) -> Optional[QuantizerConfig]:
 
 # Index types with a registered implementation (kept in sync with
 # weaviate_tpu.core.shard.build_vector_index).
-AVAILABLE_INDEX_TYPES = ("flat", "hnsw", "dynamic")
+AVAILABLE_INDEX_TYPES = ("flat", "hnsw", "dynamic", "multivector")
 
 
 @dataclass
@@ -216,6 +216,7 @@ class VectorIndexConfig:
             "flat": FlatIndexConfig,
             "hnsw": HNSWIndexConfig,
             "dynamic": DynamicIndexConfig,
+            "multivector": MultiVectorIndexConfig,
         }.get(t, FlatIndexConfig)
         fields = {f.name for f in dataclasses.fields(cls)}
         cfg = cls(**{k: v for k, v in d.items() if k in fields})
@@ -254,6 +255,20 @@ class HNSWIndexConfig(VectorIndexConfig):
     # more intra-batch blindness (~0.98 recall @64, ~0.93 @256 on random
     # data); bulk loads that rebuild can afford 256+
     insert_batch: int = 64
+
+
+@dataclass
+class MultiVectorIndexConfig(VectorIndexConfig):
+    """ColBERT-style multi-vector index via MUVERA fixed-dim encoding
+    (reference ``multivector/muvera.go:26``, ``entities/vectorindex/hnsw``
+    MuveraConfig) + exact MaxSim rescore (``hnsw/search.go:927``)."""
+
+    index_type: str = "multivector"
+    distance: str = "dot"  # FDE space similarity; MaxSim rescore is exact
+    ksim: int = 4           # simhash bits -> 2^ksim buckets
+    dproj: int = 16         # per-bucket projection dims
+    repetitions: int = 10
+    rescore_limit: int = 0  # candidates for exact MaxSim (0 = 4k)
 
 
 @dataclass

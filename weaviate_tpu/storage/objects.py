@@ -54,6 +54,11 @@ class StorageObject:
             "vec": None
             if self.vector is None
             else np.asarray(self.vector, np.float32).tobytes(),
+            # shape for multi-vector ([T, D]) default vectors; absent/None
+            # means 1-D (the overwhelmingly common case stays compact)
+            "vec_shape": None
+            if self.vector is None or np.asarray(self.vector).ndim == 1
+            else list(np.asarray(self.vector).shape),
             "nvecs": {
                 k: np.asarray(v, np.float32).tobytes()
                 for k, v in self.named_vectors.items()
@@ -68,12 +73,17 @@ class StorageObject:
     def from_bytes(data: bytes) -> "StorageObject":
         env = msgpack.unpackb(data, raw=False)
         vec = env.get("vec")
+        if vec is not None:
+            vec = np.frombuffer(vec, np.float32).copy()
+            shape = env.get("vec_shape")
+            if shape:
+                vec = vec.reshape(shape)
         nvec_shapes = env.get("nvec_shapes", {})
         return StorageObject(
             uuid=env["uuid"],
             collection=env["class"],
             properties=env.get("props", {}),
-            vector=None if vec is None else np.frombuffer(vec, np.float32).copy(),
+            vector=vec,
             named_vectors={
                 k: np.frombuffer(v, np.float32).reshape(nvec_shapes[k]).copy()
                 for k, v in env.get("nvecs", {}).items()
