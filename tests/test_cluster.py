@@ -323,3 +323,189 @@ def test_raft_equal_term_leader_contact_preserves_vote():
         assert not r["granted"]
     finally:
         t.stop()
+
+
+# ---------------------------------------------------------------------------
+# cluster dynamics: gossip liveness, raft membership change, replica movement
+# ---------------------------------------------------------------------------
+
+def test_gossip_detects_dead_node(cluster3):
+    nodes, registry = cluster3
+    wait_for(lambda: all(
+        nodes[0].gossip.status(n) == "ALIVE" for n in ("n1", "n2")),
+        msg="gossip converges alive")
+    # kill n2 both ways: unregister inbound AND stop its own gossip (an
+    # in-process "dead" node would otherwise keep pinging peers)
+    registry.pop("n2", None)
+    nodes[2].gossip.stop()
+    wait_for(lambda: nodes[0].gossip.status("n2") == "DEAD",
+             msg="n2 declared dead")
+    assert nodes[0].members()["n2"] == "DEAD"
+    # liveness ordering puts the dead node last
+    assert nodes[0]._ordered(["n2", "n0", "n1"])[-1] == "n2"
+    registry["n2"] = nodes[2].transport  # restore for teardown
+
+
+def test_kill_node_quorum_reads_writes_keep_working(cluster3):
+    nodes, registry = cluster3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=3, shards=2))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    objs = _objs(12)
+    leader.put_batch("Doc", objs, consistency="QUORUM")
+
+    # kill a NON-leader node (the raft fixture keeps its own leader alive)
+    victim = next(n for n in nodes if not n.raft.is_leader())
+    registry.pop(victim.id, None)
+    wait_for(lambda: _leader(nodes) is not None, msg="leader survives")
+    live = _leader(nodes)
+
+    # QUORUM write + read still succeed with 2/3 replicas
+    more = _objs(6, start=100)
+    live.put_batch("Doc", more, consistency="QUORUM")
+    got = live.get("Doc", objs[0].uuid, consistency="QUORUM")
+    assert got is not None and got.uuid == objs[0].uuid
+    got2 = live.get("Doc", more[0].uuid, consistency="QUORUM")
+    assert got2 is not None
+    # ALL must fail with a dead replica
+    with pytest.raises(ReplicationError):
+        live.put_batch("Doc", _objs(1, start=200), consistency="ALL")
+    registry[victim.id] = victim.transport
+
+
+def test_raft_membership_add_remove(cluster3, tmp_path):
+    nodes, registry = cluster3
+    leader = _leader(nodes)
+    # add a 4th server: joins the raft config and catches up
+    t3 = InProcTransport(registry, "n3")
+    n3 = ClusterNode("n3", ["n0", "n1", "n2", "n3"], t3,
+                     str(tmp_path / "n3"))
+    try:
+        leader.add_node("n3")
+        wait_for(lambda: "n3" in leader.raft.config_nodes,
+                 msg="config applied on leader")
+        wait_for(lambda: sorted(n3.raft.config_nodes) ==
+                 ["n0", "n1", "n2", "n3"], msg="new node learns config")
+        # placement view follows membership
+        wait_for(lambda: "n3" in leader.all_nodes, msg="placement updated")
+        # committed entries reach the new node (schema catches up)
+        leader.create_collection(_cfg(name="Joined"))
+        wait_for(lambda: n3.db.has_collection("Joined"),
+                 msg="new node applies schema")
+        # remove it again
+        leader.remove_node("n3")
+        wait_for(lambda: "n3" not in leader.raft.config_nodes,
+                 msg="removal applied")
+        wait_for(lambda: "n3" not in leader.all_nodes,
+                 msg="placement shrinks")
+    finally:
+        n3.close()
+
+
+def test_move_shard_copies_flips_routing_and_drops_source(cluster3):
+    nodes, registry = cluster3
+    leader = _leader(nodes)
+    # factor=1: each shard lives on exactly one node -> movement is visible
+    leader.create_collection(_cfg(factor=1, shards=2))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    objs = _objs(20)
+    leader.put_batch("Doc", objs, consistency="ONE")
+
+    state = leader._state_for("Doc")
+    shard = 0
+    src = state.replicas(shard)[0]
+    dst = next(n for n in ("n0", "n1", "n2") if n not in state.replicas(shard))
+    moved = leader.move_shard("Doc", shard, src, dst)
+    assert moved > 0
+
+    # routing flipped everywhere (raft-committed override)
+    wait_for(lambda: all(
+        n._state_for("Doc").replicas(shard) ==
+        [dst if x == src else x for x in state.replicas(shard)]
+        for n in nodes), msg="override replicated")
+
+    # every object still readable; shard-0 objects now served by dst
+    for o in objs:
+        got = leader.get("Doc", o.uuid, consistency="ONE")
+        assert got is not None and got.uuid == o.uuid
+    # source dropped its copy
+    src_node = next(n for n in nodes if n.id == src)
+    src_shard = src_node._local_shard("Doc", shard)
+    assert src_shard.count() == 0
+
+    # distributed search still sees the full corpus
+    res = leader.vector_search("Doc", np.eye(1, 8, dtype=np.float32)[0], k=5)
+    assert len(res) == 5
+
+
+def test_leader_self_removal_commits_then_steps_down(cluster3):
+    nodes, registry = cluster3
+    leader = _leader(nodes)
+    leader.remove_node(leader.id)
+    # removal commits (other nodes' configs shrink) and the old leader
+    # steps down AFTER commit (Raft §4.2.2)
+    others = [n for n in nodes if n is not leader]
+    wait_for(lambda: all(
+        leader.id not in n.raft.config_nodes for n in others),
+        msg="removal replicated")
+    wait_for(lambda: not leader.raft.is_leader(), msg="old leader steps down")
+    wait_for(lambda: any(n.raft.is_leader() for n in others),
+             msg="remaining pair elects a leader")
+    # the 2-node cluster still commits entries
+    new_leader = next(n for n in others if n.raft.is_leader())
+    new_leader.create_collection(_cfg(name="AfterRemoval", factor=2,
+                                      shards=1))
+    wait_for(lambda: all(n.db.has_collection("AfterRemoval") for n in others),
+             msg="post-removal commit")
+
+
+def test_raft_log_survives_restart_with_wal_persistence(tmp_path):
+    registry = {}
+    ids = ["a0", "a1", "a2"]
+    nodes = [ClusterNode(i, ids, InProcTransport(registry, i),
+                         str(tmp_path / i)) for i in ids]
+    try:
+        wait_for(lambda: any(n.raft.is_leader() for n in nodes),
+                 msg="election")
+        leader = _leader(nodes)
+        for i in range(5):
+            leader.create_collection(_cfg(name=f"C{i}", factor=1, shards=1))
+        term = leader.raft.current_term
+        last = leader.raft._last_index()
+    finally:
+        for n in nodes:
+            n.close()
+    # cold restart of the whole cluster: term + log come back from meta +
+    # WAL, a leader re-emerges, and every committed entry is re-visible
+    registry2 = {}
+    nodes2 = [ClusterNode(i, ids, InProcTransport(registry2, i),
+                          str(tmp_path / i)) for i in ids]
+    try:
+        assert nodes2[0].raft.current_term >= term
+        assert max(n.raft._last_index() for n in nodes2) >= last
+        wait_for(lambda: any(n.raft.is_leader() for n in nodes2),
+                 msg="re-election after restart")
+        for i in range(5):
+            wait_for(
+                lambda i=i: all(n.db.has_collection(f"C{i}") for n in nodes2),
+                msg=f"C{i} after restart")
+    finally:
+        for n in nodes2:
+            n.close()
+
+
+def test_frozen_shard_rejects_writes(cluster3):
+    nodes, registry = cluster3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=3, shards=1))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema")
+    for n in nodes:
+        n._on_shard_freeze({"class": "Doc", "shard": 0})
+    with pytest.raises(ReplicationError):
+        leader.put_batch("Doc", _objs(1), consistency="QUORUM")
+    for n in nodes:
+        n._on_shard_unfreeze({"class": "Doc", "shard": 0})
+    leader.put_batch("Doc", _objs(1), consistency="QUORUM")

@@ -20,6 +20,9 @@ from weaviate_tpu.schema.config import CollectionConfig, DataType, Property
 class SchemaFSM:
     def __init__(self, db: DB):
         self.db = db
+        # replica-movement overrides: "cls/shard" -> explicit replica list
+        # (reference cluster/replication/ shard-replica FSM state)
+        self.shard_overrides: dict[str, list[str]] = {}
 
     # -- command application (called from the raft apply path) ------------
     def apply(self, cmd: dict) -> Any:
@@ -54,6 +57,15 @@ class SchemaFSM:
                 for name in cmd["names"]:
                     col.remove_tenant(name)
                 return {"ok": True}
+            if op == "set_shard_replicas":
+                key = f"{cmd['class']}/{cmd['shard']}"
+                nodes = list(cmd["nodes"])
+                if nodes:
+                    self.shard_overrides[key] = nodes
+                else:
+                    # empty override = fall back to ring placement
+                    self.shard_overrides.pop(key, None)
+                return {"ok": True}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except (KeyError, ValueError, RuntimeError) as e:
             return {"ok": False, "error": str(e)}
@@ -70,6 +82,7 @@ class SchemaFSM:
                 for n in self.db.collections()
                 if self.db.get_collection(n).config.multi_tenancy.enabled
             },
+            "shard_overrides": self.shard_overrides,
         }
         return msgpack.packb(state, use_bin_type=True)
 
@@ -86,3 +99,4 @@ class SchemaFSM:
             col = self.db.get_collection(name)
             for tname, status in tenants.items():
                 col.add_tenant(tname, status)
+        self.shard_overrides = dict(state.get("shard_overrides", {}))

@@ -1,0 +1,122 @@
+"""Gossip membership: heartbeat liveness over the cluster transport.
+
+Reference: ``usecases/cluster/delegate.go`` wraps hashicorp/memberlist
+(SWIM-style UDP gossip) for node discovery + failure detection. Here the
+same epidemic mechanism rides the existing TCP transport: every interval a
+node picks one random peer and exchanges its freshness view (node ->
+seconds-since-heard); views merge by taking the fresher claim. A node
+unheard (directly or transitively) past ``dead_after`` is DEAD; past
+``suspect_after`` it is SUSPECT. The data plane orders replicas
+live-first so requests don't stall on timeouts to dead peers, and
+kill-a-node QUORUM flows keep working (reference failure-detection role,
+SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Iterable
+
+ALIVE = "ALIVE"
+SUSPECT = "SUSPECT"
+DEAD = "DEAD"
+
+
+class Gossip:
+    def __init__(self, node_id: str, peers_fn: Callable[[], Iterable[str]],
+                 send_fn: Callable[[str, dict], dict],
+                 interval: float = 0.15, suspect_after: float = 0.8,
+                 dead_after: float = 2.5):
+        self.id = node_id
+        self.peers_fn = peers_fn
+        self.send_fn = send_fn
+        self.interval = interval
+        self.suspect_after = suspect_after
+        self.dead_after = dead_after
+        self._heard: dict[str, float] = {}  # node -> monotonic last-heard
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:  # started
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            peers = [p for p in self.peers_fn() if p != self.id]
+            if not peers:
+                continue
+            peer = random.choice(peers)
+            try:
+                r = self.send_fn(peer, {"type": "gossip_ping",
+                                        "from": self.id,
+                                        "view": self.view()})
+                if isinstance(r, dict) and "view" in r:
+                    self.merge(r["view"])
+                self._mark_heard(peer)
+            except Exception:
+                pass  # unreachable peer ages out naturally
+
+    # -- view exchange -----------------------------------------------------
+    def view(self) -> dict[str, float]:
+        """node -> age in seconds (0 for self)."""
+        now = time.monotonic()
+        with self._lock:
+            out = {n: max(0.0, now - t) for n, t in self._heard.items()}
+        out[self.id] = 0.0
+        return out
+
+    def merge(self, view: dict[str, float]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for node, age in view.items():
+                if node == self.id:
+                    continue
+                t = now - float(age)
+                if t > self._heard.get(node, -1.0):
+                    self._heard[node] = t
+
+    def _mark_heard(self, node: str) -> None:
+        with self._lock:
+            self._heard[node] = time.monotonic()
+
+    def on_ping(self, msg: dict) -> dict:
+        self.merge(msg.get("view", {}))
+        self._mark_heard(msg["from"])
+        return {"view": self.view()}
+
+    # -- queries -----------------------------------------------------------
+    def status(self, node: str) -> str:
+        if node == self.id:
+            return ALIVE
+        with self._lock:
+            t = self._heard.get(node)
+        if t is None:
+            return SUSPECT  # never heard: don't declare dead prematurely
+        age = time.monotonic() - t
+        if age >= self.dead_after:
+            return DEAD
+        if age >= self.suspect_after:
+            return SUSPECT
+        return ALIVE
+
+    def alive(self, node: str) -> bool:
+        return self.status(node) != DEAD
+
+    def order_by_liveness(self, nodes: list[str]) -> list[str]:
+        """Stable sort: ALIVE first, then SUSPECT, then DEAD — readers try
+        healthy replicas before burning timeouts on dead ones."""
+        rank = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
+        return sorted(nodes, key=lambda n: rank[self.status(n)])
+
+    def members(self) -> dict[str, str]:
+        nodes = set(self.peers_fn()) | {self.id}
+        return {n: self.status(n) for n in sorted(nodes)}

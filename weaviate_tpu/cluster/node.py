@@ -76,6 +76,9 @@ class ClusterNode:
         # deletion tombstones for anti-entropy resolution:
         # (class, shard) -> {uuid: delete_time_ms}
         self._tombstones: dict[tuple[str, int], dict[str, int]] = {}
+        # shards frozen for the final replica-movement cutover: writes error
+        # (clients retry against post-flip routing)
+        self._frozen: set[tuple[str, int, str]] = set()
         self.raft = RaftNode(
             node_id, self.all_nodes, _RaftTransportView(self),
             apply_fn=self.fsm.apply,
@@ -83,9 +86,22 @@ class ClusterNode:
             snapshot_fn=self.fsm.snapshot,
             restore_fn=self.fsm.restore,
         )
+        # placement follows the raft-committed membership
+        self.all_nodes = list(self.raft.config_nodes)
+        self.raft.on_config_change = self._on_membership_change
+        # gossip liveness (reference memberlist delegate role)
+        from weaviate_tpu.cluster.gossip import Gossip
+
+        self.gossip = Gossip(
+            node_id,
+            peers_fn=lambda: self.all_nodes,
+            send_fn=lambda peer, msg: self.transport.send(
+                peer, msg, timeout=0.3),
+        )
         transport.start(self._dispatch)
         if heartbeat:
             self.raft.start()
+            self.gossip.start()
 
     # -- message mux -------------------------------------------------------
     def _dispatch(self, msg: dict) -> dict:
@@ -101,6 +117,36 @@ class ClusterNode:
             return handler(msg)
         except (KeyError, ValueError, RuntimeError) as e:
             return {"error": str(e)}
+
+    def _on_membership_change(self, nodes: list[str]) -> None:
+        self.all_nodes = sorted(nodes)
+
+    def _on_gossip_ping(self, msg: dict) -> dict:
+        return self.gossip.on_ping(msg)
+
+    # -- membership API ----------------------------------------------------
+    def add_node(self, node_id: str) -> None:
+        """Single-server raft membership add (a DELTA command — resolved
+        against the leader's config at append, so a submitter's stale view
+        can't clobber a concurrent change)."""
+        self.raft.submit({"_raft_member_add": node_id})
+
+    def remove_node(self, node_id: str) -> None:
+        self.raft.submit({"_raft_member_remove": node_id})
+        # un-orphan any moved-shard override pinned to the removed node:
+        # without this, a shard moved there earlier would route to a ghost
+        for key, nodes in list(self.fsm.shard_overrides.items()):
+            if node_id in nodes:
+                cls, shard = key.rsplit("/", 1)
+                remaining = [n for n in nodes if n != node_id]
+                self.raft.submit({
+                    "op": "set_shard_replicas", "class": cls,
+                    "shard": int(shard), "nodes": remaining,
+                })
+
+    def members(self) -> dict[str, str]:
+        """node -> ALIVE/SUSPECT/DEAD (gossip view)."""
+        return self.gossip.members()
 
     # -- schema API (raft path) --------------------------------------------
     def create_collection(self, cfg: CollectionConfig) -> None:
@@ -121,11 +167,22 @@ class ClusterNode:
     # -- placement ---------------------------------------------------------
     def _state_for(self, cls: str) -> ShardingState:
         cfg = self.db.get_collection(cls).config
+        prefix = f"{cls}/"
+        overrides = {
+            int(k[len(prefix):]): v
+            for k, v in self.fsm.shard_overrides.items()
+            if k.startswith(prefix)
+        }
         return ShardingState(
             nodes=self.all_nodes,
             n_shards=max(1, cfg.sharding.desired_count),
             factor=max(1, cfg.replication.factor),
+            overrides=overrides,
         )
+
+    def _ordered(self, replicas: list[str]) -> list[str]:
+        """Live replicas first so reads don't burn timeouts on dead peers."""
+        return self.gossip.order_by_liveness(replicas)
 
     def _local_shard(self, cls: str, shard: int, tenant: str = ""):
         col = self.db.get_collection(cls)
@@ -195,6 +252,8 @@ class ClusterNode:
         return [o.uuid for o in objs]
 
     def _on_replica_prepare(self, msg: dict) -> dict:
+        if (msg["class"], msg["shard"], msg.get("tenant", "")) in self._frozen:
+            return {"ok": False, "error": "shard frozen (moving)"}
         objs = [StorageObject.from_bytes(b) for b in msg["objects"]]
         with self._staging_lock:
             self._staging[msg["txid"]] = {
@@ -256,6 +315,8 @@ class ClusterNode:
         return deleted
 
     def _on_replica_delete(self, msg: dict) -> dict:
+        if (msg["class"], msg["shard"], msg.get("tenant", "")) in self._frozen:
+            return {"error": "shard frozen (moving)"}
         shard = self._local_shard(msg["class"], msg["shard"], msg["tenant"])
         n = shard.delete(msg["uuids"])
         tomb = self._tombstones.setdefault(
@@ -269,6 +330,7 @@ class ClusterNode:
             consistency: str = "QUORUM") -> Optional[StorageObject]:
         state = self._state_for(cls)
         shard, replicas = state.shard_replicas_for_uuid(uuid)
+        replicas = self._ordered(replicas)
         need = required_acks(consistency, min(state.factor, len(replicas)))
         digests: dict[str, Optional[int]] = {}
         for rep in replicas:
@@ -376,7 +438,7 @@ class ClusterNode:
         q = np.asarray(query, np.float32)
         for shard in range(state.n_shards):
             got = False
-            for rep in state.replicas(shard):
+            for rep in self._ordered(state.replicas(shard)):
                 try:
                     r = self._send(rep, {
                         "type": "shard_search", "class": cls,
@@ -416,7 +478,7 @@ class ClusterNode:
         state = self._state_for(cls)
         results: list[tuple[float, bytes]] = []
         for shard in range(state.n_shards):
-            for rep in state.replicas(shard):
+            for rep in self._ordered(state.replicas(shard)):
                 try:
                     r = self._send(rep, {
                         "type": "shard_bm25", "class": cls, "tenant": tenant,
@@ -540,7 +602,107 @@ class ClusterNode:
                         pass
         return moved
 
+    # -- replica movement (reference cluster/replication/ + copier/) -------
+    def _copy_shard_pages(self, cls: str, shard: int, src: str, dst: str,
+                          tenant: str, page: int) -> int:
+        moved = 0
+        after = -1
+        while True:
+            r = self._send(src, {
+                "type": "shard_export", "class": cls, "tenant": tenant,
+                "shard": shard, "after": after, "limit": page,
+            }, timeout=10.0)
+            blobs = r.get("objects", [])
+            if blobs:
+                rr = self._send(dst, {
+                    "type": "object_push", "class": cls, "tenant": tenant,
+                    "shard": shard, "objects": blobs,
+                }, timeout=10.0)
+                moved += rr.get("applied", 0)
+            after = r.get("next", None)
+            if after is None:
+                return moved
+
+    def move_shard(self, cls: str, shard: int, src: str, dst: str,
+                   tenant: str = "", page: int = 512) -> int:
+        """COPY a shard replica src -> dst, flip routing via raft, drop the
+        source. Three phases (reference ``copier/`` + replication engine):
+        bulk copy while writes flow; FREEZE src (writes to it error and the
+        client retries against post-flip routing); delta copy + flip; drop.
+        The freeze closes the factor=1 window where a write landing between
+        the last copied page and the flip would die with the source copy."""
+        state = self._state_for(cls)
+        reps = state.replicas(shard)
+        if src not in reps:
+            raise ValueError(f"{src!r} does not hold shard {shard}")
+        if dst in reps:
+            raise ValueError(f"{dst!r} already holds shard {shard}")
+        moved = self._copy_shard_pages(cls, shard, src, dst, tenant, page)
+        self._send(src, {"type": "shard_freeze", "class": cls,
+                         "tenant": tenant, "shard": shard})
+        try:
+            moved += self._copy_shard_pages(cls, shard, src, dst, tenant,
+                                            page)
+            new_reps = [dst if n == src else n for n in reps]
+            res = self.raft.submit({
+                "op": "set_shard_replicas", "class": cls, "shard": shard,
+                "nodes": new_reps,
+            })
+            if not res.get("ok"):
+                raise ReplicationError(
+                    f"routing flip failed: {res.get('error')}")
+        except Exception:
+            try:
+                self._send(src, {"type": "shard_unfreeze", "class": cls,
+                                 "tenant": tenant, "shard": shard})
+            except TransportError:
+                pass
+            raise
+        try:
+            self._send(src, {"type": "shard_drop", "class": cls,
+                             "tenant": tenant, "shard": shard})
+        except TransportError:
+            pass  # orphan copy is unreachable via routing; gc later
+        return moved
+
+    def _on_shard_export(self, msg: dict) -> dict:
+        """Page of object blobs ordered by doc id (cursor = last doc id)."""
+        shard = self._local_shard(msg["class"], msg["shard"],
+                                  msg.get("tenant", ""))
+        after = msg.get("after", -1)
+        limit = msg.get("limit", 512)
+        out = []
+        last = None
+        for key, raw in shard.objects.items():
+            docid = int.from_bytes(key, "big", signed=True)
+            if docid <= after:
+                continue
+            out.append(raw)
+            last = docid
+            if len(out) >= limit:
+                break
+        return {"objects": out, "next": last if len(out) >= limit else None}
+
+    def _on_shard_freeze(self, msg: dict) -> dict:
+        self._frozen.add((msg["class"], msg["shard"], msg.get("tenant", "")))
+        return {"ok": True}
+
+    def _on_shard_unfreeze(self, msg: dict) -> dict:
+        self._frozen.discard(
+            (msg["class"], msg["shard"], msg.get("tenant", "")))
+        return {"ok": True}
+
+    def _on_shard_drop(self, msg: dict) -> dict:
+        col = self.db.get_collection(msg["class"])
+        name = (f"tenant-{msg['tenant']}" if msg.get("tenant")
+                else f"shard{msg['shard']}")
+        col.drop_shard(name)
+        self._frozen.discard(
+            (msg["class"], msg["shard"], msg.get("tenant", "")))
+        return {"ok": True}
+
     # -- lifecycle ---------------------------------------------------------
     def close(self):
+        self.gossip.stop()
         self.raft.stop()
         self.db.close()

@@ -8,7 +8,7 @@ local routing so single-node and clustered placement agree.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from weaviate_tpu.utils.hashing import shard_for_uuid  # noqa: F401  (re-export)
 
@@ -17,13 +17,19 @@ from weaviate_tpu.utils.hashing import shard_for_uuid  # noqa: F401  (re-export)
 class ShardingState:
     """Static placement: shard i lives on factor consecutive nodes of the
     sorted node ring (the reference assigns physical shards to nodes in the
-    schema FSM; consecutive placement is its default layout)."""
+    schema FSM; consecutive placement is its default layout). Replica
+    movement installs an explicit per-shard override via the raft FSM
+    (reference ``cluster/replication/`` replica-set updates)."""
 
     nodes: list[str]  # sorted, stable order
     n_shards: int
     factor: int = 1
+    overrides: dict[int, list[str]] = field(default_factory=dict)
 
     def replicas(self, shard: int) -> list[str]:
+        ov = self.overrides.get(shard)
+        if ov:
+            return list(ov)
         n = len(self.nodes)
         if n == 0:
             return []

@@ -112,6 +112,17 @@ class Collection:
             if s is not None:
                 s.close()
 
+    def drop_shard(self, name: str) -> None:
+        """Close and delete one shard's data (replica movement: the source
+        copy after a routing flip, reference ``copier/`` drop phase)."""
+        import shutil
+
+        with self._lock:
+            s = self._shards.pop(name, None)
+        if s is not None:
+            s.close()
+            shutil.rmtree(s.dir, ignore_errors=True)
+
     def tenants(self) -> dict[str, str]:
         return dict(self._tenant_status)
 
