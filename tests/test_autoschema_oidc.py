@@ -1,0 +1,263 @@
+"""Auto-schema inference + OIDC token validation.
+
+Reference test models: ``usecases/objects/auto_schema_test.go`` (type
+inference matrix, class creation on write) and
+``usecases/auth/authentication/oidc`` middleware tests.
+"""
+
+import json
+import shutil
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.auth.oidc import OIDCConfig, OIDCError, make_hs256_token
+from weaviate_tpu.schema.auto_schema import (
+    ensure_schema, infer_data_type, infer_properties,
+)
+from weaviate_tpu.schema.config import DataType
+
+
+# -- inference ---------------------------------------------------------------
+
+@pytest.mark.parametrize("value,want", [
+    ("hello", DataType.TEXT),
+    ("2024-05-01T10:00:00Z", DataType.DATE),
+    ("2024-05-01 10:00:00+02:00", DataType.DATE),
+    ("8d3a0c05-1bb7-4a5a-b3d5-3a0c051bb74a", DataType.UUID),
+    (True, DataType.BOOL),
+    (3, DataType.INT),
+    (3.5, DataType.NUMBER),
+    ({"latitude": 1.0, "longitude": 2.0}, DataType.GEO),
+    ({"a": 1}, DataType.OBJECT),
+    (["a", "b"], DataType.TEXT_ARRAY),
+    ([1, 2], DataType.INT_ARRAY),
+    ([1.5], DataType.NUMBER_ARRAY),
+    ([], None),
+    (None, None),
+])
+def test_infer_data_type(value, want):
+    assert infer_data_type(value) == want
+
+
+def test_infer_properties_skips_existing():
+    props = infer_properties({"a": 1, "b": "x"}, existing={"a"})
+    assert [p.name for p in props] == ["b"]
+
+
+def test_ensure_schema_creates_class_and_extends(tmp_path):
+    from weaviate_tpu.core.db import DB
+
+    db = DB(str(tmp_path))
+    ensure_schema(db, "Auto", [{"title": "hi", "rank": 3}])
+    col = db.get_collection("Auto")
+    types = {p.name: p.data_type for p in col.config.properties}
+    assert types == {"title": DataType.TEXT, "rank": DataType.INT}
+    # later write with a new property extends the class
+    ensure_schema(db, "Auto", [{"score": 0.5}])
+    types = {p.name: p.data_type
+             for p in db.get_collection("Auto").config.properties}
+    assert types["score"] == DataType.NUMBER
+    db.close()
+
+
+def test_autoschema_disabled_via_env(tmp_path, monkeypatch):
+    from weaviate_tpu.core.db import DB
+
+    monkeypatch.setenv("AUTOSCHEMA_ENABLED", "false")
+    db = DB(str(tmp_path))
+    ensure_schema(db, "Nope", [{"a": 1}])
+    assert not db.has_collection("Nope")
+    db.close()
+
+
+def test_rest_write_to_unknown_class_creates_it():
+    from weaviate_tpu.api.rest import RestAPI
+    from weaviate_tpu.core.db import DB
+
+    tmp = tempfile.mkdtemp()
+    try:
+        db = DB(tmp)
+        api = RestAPI(db)
+        srv = api.serve(host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{srv.server_port}/v1"
+
+        def req(method, path, body=None, headers=None):
+            r = urllib.request.Request(
+                base + path, method=method,
+                data=json.dumps(body).encode() if body is not None else None,
+                headers={"Content-Type": "application/json",
+                         **(headers or {})})
+            with urllib.request.urlopen(r) as resp:
+                return json.loads(resp.read() or b"{}")
+
+        req("POST", "/objects", {
+            "class": "Fresh",
+            "properties": {"title": "auto", "views": 7},
+            "vector": [0.1] * 8,
+        })
+        sch = req("GET", "/schema")
+        cls = next(c for c in sch["classes"] if c["class"] == "Fresh")
+        got = {p["name"]: p["dataType"] for p in cls["properties"]}
+        assert got["title"] == ["text"] and got["views"] == ["int"]
+        # the object is queryable
+        out = req("POST", "/graphql", {"query": "{ Get { Fresh { title } } }"})
+        assert out["data"]["Get"]["Fresh"] == [{"title": "auto"}]
+        api.shutdown()
+        db.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+# -- OIDC --------------------------------------------------------------------
+
+SECRET = b"test-secret"
+
+
+def _claims(**over):
+    c = {"sub": "alice", "iss": "https://issuer", "aud": "wv",
+         "exp": time.time() + 300, "groups": ["admins"]}
+    c.update(over)
+    return c
+
+
+def test_hs256_roundtrip_and_claims():
+    cfg = OIDCConfig(issuer="https://issuer", client_id="wv",
+                     hs256_secret=SECRET)
+    tok = make_hs256_token(_claims(), SECRET)
+    principal, groups = cfg.validate(tok)
+    assert principal == "alice" and groups == ["admins"]
+
+
+@pytest.mark.parametrize("claims,err", [
+    (dict(exp=time.time() - 600), "expired"),
+    (dict(iss="https://evil"), "issuer"),
+    (dict(aud="other"), "audience"),
+    (dict(sub=None), "claim"),
+])
+def test_hs256_rejects_bad_claims(claims, err):
+    cfg = OIDCConfig(issuer="https://issuer", client_id="wv",
+                     hs256_secret=SECRET)
+    tok = make_hs256_token(_claims(**claims), SECRET)
+    with pytest.raises(OIDCError, match=err):
+        cfg.validate(tok)
+
+
+def test_missing_exp_rejected():
+    cfg = OIDCConfig(hs256_secret=SECRET)
+    claims = _claims()
+    del claims["exp"]
+    with pytest.raises(OIDCError, match="exp"):
+        cfg.validate(make_hs256_token(claims, SECRET))
+
+
+def test_merge_prefers_inferable_values():
+    from weaviate_tpu.core.db import DB
+
+    tmp = tempfile.mkdtemp()
+    try:
+        db = DB(tmp)
+        # empty list first must not shadow the value-bearing one
+        ensure_schema(db, "Tags", [{"tags": []}, {"tags": ["a"]}])
+        types = {p.name: p.data_type
+                 for p in db.get_collection("Tags").config.properties}
+        assert types["tags"] == DataType.TEXT_ARRAY
+        db.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def test_oidc_groups_grant_rbac_roles():
+    from weaviate_tpu.auth.rbac import Forbidden, RBACController
+
+    rbac = RBACController()
+    rbac.upsert_role("reader", [{"action": "read_data", "resource": "*"}])
+    rbac.assign("group:admins", "reader")
+    # user with the group passes; without it, denied
+    rbac.authorize("alice", "read_data", "collections/X", groups=["admins"])
+    with pytest.raises(Forbidden):
+        rbac.authorize("alice", "read_data", "collections/X", groups=[])
+
+
+def test_hs256_rejects_tampered_signature():
+    cfg = OIDCConfig(hs256_secret=SECRET)
+    tok = make_hs256_token(_claims(), SECRET)
+    head, body, sig = tok.split(".")
+    with pytest.raises(OIDCError, match="signature"):
+        cfg.validate(f"{head}.{body}.{'A' * len(sig)}")
+    with pytest.raises(OIDCError, match="signature"):
+        cfg.validate(make_hs256_token(_claims(), b"wrong-secret"))
+
+
+def test_rs256_with_inline_jwks():
+    import base64
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+
+    def b64i(n, length):
+        return base64.urlsafe_b64encode(
+            n.to_bytes(length, "big")).decode().rstrip("=")
+
+    jwks = {"keys": [{"kty": "RSA", "kid": "k1",
+                      "n": b64i(pub.n, 256), "e": b64i(pub.e, 3)}]}
+
+    def enc(obj):
+        raw = json.dumps(obj, separators=(",", ":")).encode()
+        return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+    head = enc({"alg": "RS256", "typ": "JWT", "kid": "k1"})
+    body = enc(_claims())
+    sig = key.sign(f"{head}.{body}".encode(), padding.PKCS1v15(),
+                   hashes.SHA256())
+    tok = f"{head}.{body}." + base64.urlsafe_b64encode(sig).decode().rstrip("=")
+
+    cfg = OIDCConfig(issuer="https://issuer", client_id="wv", jwks=jwks)
+    principal, groups = cfg.validate(tok)
+    assert principal == "alice"
+    # tampered payload fails
+    bad = enc(_claims(sub="mallory"))
+    with pytest.raises(OIDCError, match="signature"):
+        cfg.validate(f"{head}.{bad}." +
+                     tok.rsplit(".", 1)[1])
+
+
+def test_rest_accepts_oidc_bearer_and_rejects_invalid():
+    from weaviate_tpu.api.rest import AuthConfig, RestAPI
+    from weaviate_tpu.core.db import DB
+
+    tmp = tempfile.mkdtemp()
+    try:
+        db = DB(tmp)
+        oidc = OIDCConfig(issuer="https://issuer", client_id="wv",
+                          hs256_secret=SECRET)
+        api = RestAPI(db, auth=AuthConfig(
+            api_keys={"static-key": "bob"}, anonymous_access=False,
+            oidc=oidc))
+        srv = api.serve(host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{srv.server_port}/v1"
+
+        def get_schema(token):
+            r = urllib.request.Request(
+                base + "/schema",
+                headers={"Authorization": f"Bearer {token}"})
+            with urllib.request.urlopen(r) as resp:
+                return resp.status
+
+        tok = make_hs256_token(_claims(), SECRET)
+        assert get_schema(tok) == 200          # OIDC JWT
+        assert get_schema("static-key") == 200  # API key still works
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get_schema(make_hs256_token(_claims(), b"forged"))
+        assert ei.value.code == 401
+        api.shutdown()
+        db.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -133,7 +133,10 @@ def test_grpc_errors(rpc):
     assert e.value.code() == grpclib.StatusCode.INVALID_ARGUMENT
 
 
-def test_batch_partial_failure(rpc):
+def test_batch_partial_failure(rpc, monkeypatch):
+    # auto-schema would CREATE the unknown class (reference default-on
+    # behavior); disable it so the unknown class is an error again
+    monkeypatch.setenv("AUTOSCHEMA_ENABLED", "false")
     req = pb.BatchObjectsRequest()
     o = req.objects.add()
     o.collection = "Article"

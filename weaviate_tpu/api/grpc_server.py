@@ -58,24 +58,26 @@ class GrpcAPI:
         self._server: Optional[grpc.Server] = None
 
     # -- auth --------------------------------------------------------------
-    def _principal(self, context) -> Optional[str]:
+    def _principal(self, context) -> tuple[Optional[str], list[str]]:
+        """(principal, groups) — groups flow to RBAC like the REST plane."""
         if self.auth is None:
-            return None
+            return None, []
         from weaviate_tpu.api.rest import AuthError
 
         md = dict(context.invocation_metadata() or [])
         try:
-            return self.auth.principal_for(md.get("authorization", ""))
+            return self.auth.identity_for(md.get("authorization", ""))
         except AuthError as e:
             context.abort(grpc.StatusCode.UNAUTHENTICATED, str(e))
 
-    def _authz(self, context, principal, action, resource):
+    def _authz(self, context, principal, action, resource, groups=()):
         if self.rbac is None:
             return
         from weaviate_tpu.auth.rbac import Forbidden
 
         try:
-            self.rbac.authorize(principal, action, resource or "*")
+            self.rbac.authorize(principal, action, resource or "*",
+                                groups=groups)
         except Forbidden as e:
             context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
 
@@ -84,7 +86,7 @@ class GrpcAPI:
         action, resource_fn = _RPC_AUTHZ[name]
 
         def handler(request, context):
-            principal = self._principal(context)
+            principal, groups = self._principal(context)
             if name == "BatchObjects":
                 if self.rbac is not None:
                     for bo in request.objects:
@@ -100,10 +102,11 @@ class GrpcAPI:
                         except (KeyError, ValueError, RuntimeError):
                             pass
                         self._authz(context, principal, act,
-                                    f"collections/{bo.collection}")
+                                    f"collections/{bo.collection}",
+                                    groups=groups)
             else:
                 self._authz(context, principal, action,
-                            resource_fn(request))
+                            resource_fn(request), groups=groups)
             try:
                 return fn(request)
             except KeyError as e:
@@ -233,6 +236,10 @@ class GrpcAPI:
                 err.message = str(e)
         for (cls, tenant), items in groups.items():
             try:
+                from weaviate_tpu.schema.auto_schema import ensure_schema
+
+                ensure_schema(self.db, cls,
+                              [o.properties for _, o in items])
                 col = self.db.get_collection(cls)
                 col.put_batch([o for _, o in items], tenant=tenant)
             except (KeyError, ValueError, RuntimeError) as e:

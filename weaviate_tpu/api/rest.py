@@ -35,30 +35,47 @@ class AuthConfig:
     """
 
     def __init__(self, api_keys: Optional[dict[str, str]] = None,
-                 anonymous_access: bool = True):
+                 anonymous_access: bool = True, oidc=None):
         self.api_keys = api_keys or {}
         self.anonymous_access = anonymous_access
+        self.oidc = oidc  # Optional[auth.oidc.OIDCConfig]
 
-    def principal_for(self, header: str) -> Optional[str]:
+    def identity_for(self, header: str) -> tuple[Optional[str], list[str]]:
         """Transport-agnostic check of an Authorization header value.
-        Returns the principal (None = anonymous allowed); raises
-        AuthError otherwise. Shared by the REST and gRPC planes so the
-        two can't diverge."""
+        Returns (principal, groups) — principal None = anonymous allowed;
+        raises AuthError otherwise. Shared by the REST and gRPC planes so
+        the two can't diverge."""
         if header.startswith("Bearer "):
             key = header[len("Bearer "):].strip()
             user = self.api_keys.get(key)
-            if user is None:
-                raise AuthError("invalid api key")
-            return user
+            if user is not None:
+                return user, []
+            # JWT-shaped tokens fall through to OIDC (reference runs the
+            # apikey and oidc middlewares side by side the same way)
+            if self.oidc is not None and key.count(".") == 2:
+                from weaviate_tpu.auth.oidc import OIDCError
+
+                try:
+                    return self.oidc.validate(key)
+                except OIDCError as e:
+                    raise AuthError(f"oidc: {e}") from e
+            raise AuthError("invalid api key")
         if self.anonymous_access:
-            return None
+            return None, []
         raise AuthError(
             "anonymous access disabled: provide Authorization: Bearer <key>")
 
+    def principal_for(self, header: str) -> Optional[str]:
+        return self.identity_for(header)[0]
+
     def authenticate(self, request: Request) -> Optional[str]:
-        """Returns principal name, or None when anonymous. Raises 401."""
+        """Sets request.principal_groups; returns principal name, or None
+        when anonymous. Raises 401."""
         try:
-            return self.principal_for(request.headers.get("Authorization", ""))
+            principal, groups = self.identity_for(
+                request.headers.get("Authorization", ""))
+            request.principal_groups = groups
+            return principal
         except AuthError as e:
             _abort(401, str(e))
 
@@ -208,7 +225,9 @@ class RestAPI:
         AUTHORIZATION_ADMINLIST/RBAC off)."""
         if self.rbac is not None:
             self.rbac.authorize(getattr(request, "principal", None),
-                                action, resource)
+                                action, resource,
+                                groups=getattr(request, "principal_groups",
+                                               ()))
 
     def _body(self, request: Request) -> dict:
         try:
@@ -309,6 +328,9 @@ class RestAPI:
                 _abort(422, "class required")
             self._authz(request, self._write_action(obj),
                         f"collections/{obj.collection}")
+            from weaviate_tpu.schema.auto_schema import ensure_schema
+
+            ensure_schema(self.db, obj.collection, [obj.properties])
             col = self.db.get_collection(obj.collection)
             col.put(obj, tenant=obj.tenant)
             return _json_response(_obj_to_rest(obj))
@@ -359,6 +381,11 @@ class RestAPI:
         body.setdefault("class", cls)
         obj = _obj_from_rest(body)
         obj.tenant = tenant or obj.tenant
+        # updates can introduce new properties too (reference auto-schema
+        # runs on update/merge, not only create)
+        from weaviate_tpu.schema.auto_schema import ensure_schema
+
+        ensure_schema(self.db, cls, [obj.properties])
         col.put(obj, tenant=obj.tenant)
         return _json_response(_obj_to_rest(obj))
 
@@ -401,8 +428,11 @@ class RestAPI:
         errors: dict[int, str] = {}
         for cls, group in by_class.items():
             try:
+                from weaviate_tpu.schema.auto_schema import ensure_schema
+
+                ensure_schema(self.db, cls, [o.properties for o in group])
                 col = self.db.get_collection(cls)
-            except KeyError as e:
+            except (KeyError, ValueError) as e:
                 for i, o in parsed:
                     if o.collection == cls:
                         errors[i] = str(e)

@@ -1,0 +1,141 @@
+"""OIDC bearer-token authentication (JWT validation).
+
+Reference: ``usecases/auth/authentication/oidc/middleware.go`` — validates
+RS256 JWTs against the issuer's JWKS (fetched via OIDC discovery) and maps
+``username_claim``/``groups_claim`` into the principal. This deployment is
+zero-egress, so keys are CONFIGURED rather than discovered: an inline JWKS
+(RS256, via the ``cryptography`` package) and/or a shared HS256 secret.
+Checks: signature, ``exp``/``nbf``, ``iss``, ``aud`` — the same claim set
+the reference's go-oidc verifier enforces.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from typing import Any, Optional
+
+
+class OIDCError(RuntimeError):
+    pass
+
+
+def _b64url(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def _int_from_b64(data: str) -> int:
+    return int.from_bytes(_b64url(data), "big")
+
+
+class OIDCConfig:
+    """Static-key OIDC validator.
+
+    jwks: {"keys": [{kty, kid, n, e}, ...]} (RFC 7517 RSA keys)
+    hs256_secret: shared secret for HS256 tokens (tests / internal services)
+    """
+
+    def __init__(self, issuer: str = "", client_id: str = "",
+                 jwks: Optional[dict] = None,
+                 hs256_secret: Optional[bytes] = None,
+                 username_claim: str = "sub",
+                 groups_claim: str = "groups",
+                 clock_skew_s: int = 30):
+        self.issuer = issuer
+        self.client_id = client_id
+        self.keys: dict[str, Any] = {}
+        self.hs256_secret = hs256_secret
+        self.username_claim = username_claim
+        self.groups_claim = groups_claim
+        self.clock_skew_s = clock_skew_s
+        for jwk in (jwks or {}).get("keys", []):
+            if jwk.get("kty") != "RSA":
+                continue
+            self.keys[jwk.get("kid", "")] = jwk
+
+    # -- verification ------------------------------------------------------
+    def _verify_rs256(self, signing: bytes, sig: bytes, kid: str) -> None:
+        jwk = self.keys.get(kid) or (
+            next(iter(self.keys.values())) if len(self.keys) == 1 else None)
+        if jwk is None:
+            raise OIDCError(f"no JWKS key for kid {kid!r}")
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+        pub = rsa.RSAPublicNumbers(
+            _int_from_b64(jwk["e"]), _int_from_b64(jwk["n"])
+        ).public_key()
+        try:
+            pub.verify(sig, signing, padding.PKCS1v15(), hashes.SHA256())
+        except Exception as e:
+            raise OIDCError("invalid RS256 signature") from e
+
+    def _verify_hs256(self, signing: bytes, sig: bytes) -> None:
+        if not self.hs256_secret:
+            raise OIDCError("HS256 token but no shared secret configured")
+        want = hmac.new(self.hs256_secret, signing, hashlib.sha256).digest()
+        if not hmac.compare_digest(want, sig):
+            raise OIDCError("invalid HS256 signature")
+
+    def validate(self, token: str) -> tuple[str, list[str]]:
+        """Returns (principal, groups); raises OIDCError."""
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise OIDCError("not a JWT")
+        try:
+            header = json.loads(_b64url(parts[0]))
+            claims = json.loads(_b64url(parts[1]))
+            sig = _b64url(parts[2])
+        except (ValueError, json.JSONDecodeError) as e:
+            raise OIDCError("malformed JWT") from e
+        signing = f"{parts[0]}.{parts[1]}".encode()
+        alg = header.get("alg")
+        if alg == "RS256":
+            self._verify_rs256(signing, sig, header.get("kid", ""))
+        elif alg == "HS256":
+            self._verify_hs256(signing, sig)
+        else:
+            raise OIDCError(f"unsupported alg {alg!r}")
+
+        now = time.time()
+        exp = claims.get("exp")
+        if exp is None:
+            # a token that can never age out is a permanent credential —
+            # reject like go-oidc does
+            raise OIDCError("missing exp claim")
+        if now > exp + self.clock_skew_s:
+            raise OIDCError("token expired")
+        nbf = claims.get("nbf")
+        if nbf is not None and now < nbf - self.clock_skew_s:
+            raise OIDCError("token not yet valid")
+        if self.issuer and claims.get("iss") != self.issuer:
+            raise OIDCError(f"wrong issuer {claims.get('iss')!r}")
+        if self.client_id:
+            aud = claims.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.client_id not in auds:
+                raise OIDCError("audience mismatch")
+
+        principal = claims.get(self.username_claim)
+        if not principal:
+            raise OIDCError(f"missing {self.username_claim!r} claim")
+        groups = claims.get(self.groups_claim) or []
+        if not isinstance(groups, list):
+            groups = [groups]
+        return str(principal), [str(g) for g in groups]
+
+
+def make_hs256_token(claims: dict, secret: bytes) -> str:
+    """Mint an HS256 JWT (tests + internal service-to-service auth)."""
+    def enc(obj) -> str:
+        raw = json.dumps(obj, separators=(",", ":")).encode()
+        return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+    head = enc({"alg": "HS256", "typ": "JWT"})
+    body = enc(claims)
+    sig = hmac.new(secret, f"{head}.{body}".encode(), hashlib.sha256).digest()
+    return f"{head}.{body}." + base64.urlsafe_b64encode(sig).decode().rstrip("=")

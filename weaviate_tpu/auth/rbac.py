@@ -159,14 +159,18 @@ class RBACController:
 
     # -- the check ---------------------------------------------------------
     def authorize(self, user: Optional[str], action: str,
-                  resource: str = "*") -> None:
-        """Raises Forbidden unless some role of the user allows it.
-        ``user=None`` (anonymous) has no roles — deny everything when RBAC
-        is on, like the reference's authz with anonymous access."""
+                  resource: str = "*", groups=()) -> None:
+        """Raises Forbidden unless some role of the user (or one of their
+        OIDC groups, assigned as ``group:<name>`` principals — reference
+        RBAC group subjects) allows it. ``user=None`` (anonymous) has no
+        roles — deny everything when RBAC is on, like the reference's
+        authz with anonymous access."""
         with self._lock:
             if user is not None and user in self.root_users:
                 return
-            names = self.assignments.get(user, set()) if user else set()
+            names = set(self.assignments.get(user, set())) if user else set()
+            for g in groups:
+                names |= self.assignments.get(f"group:{g}", set())
             for rn in names:
                 role = self.roles.get(rn)
                 if role is not None and role.allows(action, resource):

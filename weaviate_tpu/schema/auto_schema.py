@@ -1,0 +1,114 @@
+"""Auto-schema: infer classes and properties from object payloads.
+
+Reference: ``usecases/objects/auto_schema.go`` — on write, an unknown class
+is created and missing properties are added with types inferred from the
+JSON values (strings that parse as RFC3339 become dates, numbers follow the
+configured default, geo shapes are detected structurally). Enabled by
+default, disabled via ``AUTOSCHEMA_ENABLED=false`` — same env contract.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+from weaviate_tpu.schema.config import CollectionConfig, DataType, Property
+
+_RFC3339 = re.compile(
+    r"^\d{4}-\d{2}-\d{2}[Tt ]\d{2}:\d{2}:\d{2}(\.\d+)?([Zz]|[+-]\d{2}:\d{2})$")
+_UUID = re.compile(
+    r"^[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-"
+    r"[0-9a-fA-F]{4}-[0-9a-fA-F]{12}$")
+
+_ARRAY_OF = {
+    DataType.TEXT: DataType.TEXT_ARRAY,
+    DataType.INT: DataType.INT_ARRAY,
+    DataType.NUMBER: DataType.NUMBER_ARRAY,
+    DataType.BOOL: DataType.BOOL_ARRAY,
+    DataType.DATE: DataType.DATE_ARRAY,
+    DataType.UUID: DataType.UUID_ARRAY,
+    DataType.OBJECT: DataType.OBJECT_ARRAY,
+}
+
+
+def enabled() -> bool:
+    return os.environ.get("AUTOSCHEMA_ENABLED", "true") != "false"
+
+
+def infer_data_type(value: Any) -> Optional[DataType]:
+    """Value -> DataType; None = not schematizable (skip the property)."""
+    if isinstance(value, bool):
+        return DataType.BOOL
+    if isinstance(value, int):
+        return DataType.INT
+    if isinstance(value, float):
+        return DataType.NUMBER
+    if isinstance(value, str):
+        if _RFC3339.match(value):
+            return DataType.DATE
+        if _UUID.match(value):
+            return DataType.UUID
+        return DataType.TEXT
+    if isinstance(value, dict):
+        if "latitude" in value and "longitude" in value:
+            return DataType.GEO
+        return DataType.OBJECT
+    if isinstance(value, list):
+        for v in value:
+            base = infer_data_type(v)
+            if base is not None:
+                return _ARRAY_OF.get(base)
+        return None  # empty/unknown list: wait for a value-bearing write
+    return None
+
+
+def infer_properties(props: dict[str, Any],
+                     existing: Optional[set[str]] = None) -> list[Property]:
+    """New Property entries for keys absent from ``existing``."""
+    existing = existing or set()
+    out = []
+    for name, value in props.items():
+        if name in existing or value is None:
+            continue
+        dt = infer_data_type(value)
+        if dt is None:
+            continue
+        out.append(Property(name=name, data_type=dt))
+    return out
+
+
+def ensure_schema(db, cls: str, objects_props: list[dict[str, Any]]) -> None:
+    """Create a missing class / add missing properties before a write.
+
+    ``db`` needs ``has_collection``/``create_collection``/``get_collection``/
+    ``add_property`` — both the single-node DB and the cluster FSM-backed
+    path satisfy it (reference autoSchemaManager sits above the repo the
+    same way)."""
+    if not enabled():
+        return
+    # keep the first INFERABLE value per key: an empty list from one object
+    # must not shadow a value-bearing list from a later one in this batch
+    merged: dict[str, Any] = {}
+    for p in objects_props:
+        for k, v in (p or {}).items():
+            if v is None:
+                continue
+            if k not in merged or (infer_data_type(merged[k]) is None
+                                   and infer_data_type(v) is not None):
+                merged[k] = v
+    if not db.has_collection(cls):
+        cfg = CollectionConfig(name=cls, properties=infer_properties(merged))
+        cfg.validate()
+        try:
+            db.create_collection(cfg)
+            return
+        except ValueError:
+            pass  # lost a concurrent-create race: extend instead
+    col = db.get_collection(cls)
+    have = {p.name for p in col.config.properties}
+    for prop in infer_properties(merged, existing=have):
+        try:
+            db.add_property(cls, prop)
+        except ValueError:
+            pass  # raced with a concurrent writer: idempotent

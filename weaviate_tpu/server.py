@@ -46,6 +46,19 @@ def config_from_env() -> dict:
         "rbac_root_users": [
             u for u in os.environ.get(
                 "AUTHORIZATION_RBAC_ROOT_USERS", "").split(",") if u],
+        # OIDC (reference AUTHENTICATION_OIDC_*): zero-egress deployments
+        # configure keys inline instead of discovery
+        "oidc_enabled": os.environ.get(
+            "AUTHENTICATION_OIDC_ENABLED") == "true",
+        "oidc_issuer": os.environ.get("AUTHENTICATION_OIDC_ISSUER", ""),
+        "oidc_client_id": os.environ.get("AUTHENTICATION_OIDC_CLIENT_ID", ""),
+        "oidc_username_claim": os.environ.get(
+            "AUTHENTICATION_OIDC_USERNAME_CLAIM", "sub"),
+        "oidc_groups_claim": os.environ.get(
+            "AUTHENTICATION_OIDC_GROUPS_CLAIM", "groups"),
+        "oidc_jwks_file": os.environ.get("AUTHENTICATION_OIDC_JWKS_FILE", ""),
+        "oidc_hs256_secret": os.environ.get(
+            "AUTHENTICATION_OIDC_HS256_SECRET", ""),
     }
 
 
@@ -56,8 +69,26 @@ def main() -> int:
 
     cfg = config_from_env()
     db = DB(cfg["data_path"])
+    oidc = None
+    if cfg["oidc_enabled"]:
+        import json as _json
+
+        from weaviate_tpu.auth.oidc import OIDCConfig
+
+        jwks = None
+        if cfg["oidc_jwks_file"]:
+            with open(cfg["oidc_jwks_file"]) as f:
+                jwks = _json.load(f)
+        oidc = OIDCConfig(
+            issuer=cfg["oidc_issuer"], client_id=cfg["oidc_client_id"],
+            jwks=jwks,
+            hs256_secret=(cfg["oidc_hs256_secret"].encode()
+                          if cfg["oidc_hs256_secret"] else None),
+            username_claim=cfg["oidc_username_claim"],
+            groups_claim=cfg["oidc_groups_claim"],
+        )
     auth = AuthConfig(api_keys=cfg["api_keys"],
-                      anonymous_access=cfg["anonymous"])
+                      anonymous_access=cfg["anonymous"], oidc=oidc)
     rbac = None
     if cfg["rbac_enabled"]:
         from weaviate_tpu.auth.rbac import RBACController
