@@ -1,0 +1,287 @@
+"""weaviate.v1 wire-contract tests.
+
+The stock weaviate client package is not in this image, so these tests
+speak the contract at the wire level: real grpc channel, the
+``/weaviate.v1.Weaviate/*`` method paths, and messages built from the
+compat pb module whose field numbers replicate the reference protos
+(``grpc/proto/v1``). A stock client serializes to exactly these bytes.
+"""
+
+import json
+import shutil
+import tempfile
+
+import grpc
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.grpc_server import GrpcAPI
+from weaviate_tpu.api.proto import weaviate_v1_compat_pb2 as wv
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.schema.config import (
+    CollectionConfig, DataType, FlatIndexConfig, Property,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+D = 8
+
+
+@pytest.fixture(scope="module")
+def server():
+    tmp = tempfile.mkdtemp()
+    db = DB(tmp)
+    cfg = CollectionConfig(
+        name="Article",
+        properties=[Property(name="title", data_type=DataType.TEXT),
+                    Property(name="wordCount", data_type=DataType.INT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+    )
+    col = db.create_collection(cfg)
+    rng = np.random.default_rng(0)
+    objs = []
+    for i in range(30):
+        v = np.zeros(D, np.float32)
+        v[i % D] = 1.0 + 0.01 * i
+        objs.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}",
+            collection="Article",
+            properties={"title": f"news item {i}", "wordCount": 100 + i},
+            vector=v))
+    col.put_batch(objs)
+    api = GrpcAPI(db)
+    port = api.serve(port=0)
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield chan, objs
+    api.shutdown()
+    db.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _unary(chan, name, req, reply_cls):
+    m = chan.unary_unary(
+        f"/weaviate.v1.Weaviate/{name}",
+        request_serializer=lambda x: x.SerializeToString(),
+        response_deserializer=reply_cls.FromString)
+    return m(req)
+
+
+def test_search_near_vector_with_metadata(server):
+    chan, objs = server
+    req = wv.SearchRequest(collection="Article", limit=3)
+    req.near_vector.vector_bytes = np.asarray(
+        objs[5].vector, "<f4").tobytes()
+    req.metadata.uuid = True
+    req.metadata.distance = True
+    reply = _unary(chan, "Search", req, wv.SearchReply)
+    assert len(reply.results) == 3
+    top = reply.results[0]
+    assert top.metadata.id == objs[5].uuid
+    assert top.metadata.distance_present
+    assert top.metadata.distance < 1e-3
+    # properties come back as weaviate.v1 typed values
+    fields = top.properties.non_ref_props.fields
+    assert fields["title"].text_value == "news item 5"
+    assert fields["wordCount"].int_value == 105
+
+
+def test_search_bm25_and_filters(server):
+    chan, objs = server
+    req = wv.SearchRequest(collection="Article", limit=5)
+    req.bm25_search.query = "news item 7"
+    f = req.filters
+    f.operator = wv.Filters.OPERATOR_LESS_THAN
+    f.target.property = "wordCount"
+    f.value_int = 110
+    req.metadata.uuid = True
+    req.metadata.score = True
+    reply = _unary(chan, "Search", req, wv.SearchReply)
+    assert reply.results
+    for r in reply.results:
+        assert r.properties.non_ref_props.fields["wordCount"].int_value < 110
+    assert reply.results[0].metadata.id == objs[7].uuid
+
+
+def test_search_hybrid(server):
+    chan, objs = server
+    req = wv.SearchRequest(collection="Article", limit=4)
+    req.hybrid_search.query = "news item 3"
+    req.hybrid_search.alpha = 0.5
+    req.hybrid_search.vector_bytes = np.asarray(
+        objs[3].vector, "<f4").tobytes()
+    req.metadata.uuid = True
+    reply = _unary(chan, "Search", req, wv.SearchReply)
+    assert reply.results[0].metadata.id == objs[3].uuid
+
+
+def test_batch_objects_struct_properties(server):
+    chan, _ = server
+    req = wv.BatchObjectsRequest()
+    bo = req.objects.add()
+    bo.uuid = "10000000-0000-0000-0000-000000000001"
+    bo.collection = "Article"
+    bo.properties.non_ref_properties.fields["title"].string_value = "fresh"
+    bo.properties.non_ref_properties.fields["wordCount"].number_value = 321
+    ap = bo.properties.text_array_properties.add()
+    ap.prop_name = "tags"
+    ap.values.extend(["a", "b"])
+    bo.vector_bytes = np.zeros(D, "<f4").tobytes()
+    reply = _unary(chan, "BatchObjects", req, wv.BatchObjectsReply)
+    assert not reply.errors
+
+    sreq = wv.SearchRequest(collection="Article", limit=1)
+    sreq.bm25_search.query = "fresh"
+    sreq.metadata.uuid = True
+    out = _unary(chan, "Search", sreq, wv.SearchReply)
+    assert out.results[0].metadata.id == bo.uuid
+    fields = out.results[0].properties.non_ref_props.fields
+    assert fields["wordCount"].int_value == 321
+    assert list(fields["tags"].list_value.text_values.values) == ["a", "b"]
+
+
+def test_aggregate_count_and_int_stats(server):
+    chan, _ = server
+    req = wv.AggregateRequest(collection="Article", objects_count=True)
+    agg = req.aggregations.add()
+    agg.property = "wordCount"
+    agg.int.count = True
+    agg.int.mean = True
+    agg.int.maximum = True
+    reply = _unary(chan, "Aggregate", req, wv.AggregateReply)
+    assert reply.single_result.objects_count >= 30
+    stats = reply.single_result.aggregations.aggregations[0]
+    assert stats.property == "wordCount"
+    assert stats.int.count >= 30
+    assert stats.int.maximum >= 129
+
+
+def test_batch_delete_with_filter(server):
+    chan, _ = server
+    req = wv.BatchObjectsRequest()
+    bo = req.objects.add()
+    bo.uuid = "20000000-0000-0000-0000-000000000002"
+    bo.collection = "Article"
+    bo.properties.non_ref_properties.fields["title"].string_value = "doomed"
+    bo.vector_bytes = np.zeros(D, "<f4").tobytes()
+    _unary(chan, "BatchObjects", req, wv.BatchObjectsReply)
+
+    dreq = wv.BatchDeleteRequest(collection="Article", dry_run=True)
+    dreq.filters.operator = wv.Filters.OPERATOR_EQUAL
+    dreq.filters.target.property = "title"
+    dreq.filters.value_text = "doomed"
+    reply = _unary(chan, "BatchDelete", dreq, wv.BatchDeleteReply)
+    assert reply.matches == 1 and reply.successful == 0  # dry run
+    dreq.dry_run = False
+    reply = _unary(chan, "BatchDelete", dreq, wv.BatchDeleteReply)
+    assert reply.successful == 1
+
+
+def test_tenants_get(server):
+    chan, _ = server
+    req = wv.TenantsGetRequest(collection="Article")
+    reply = _unary(chan, "TenantsGet", req, wv.TenantsGetReply)
+    assert len(reply.tenants) == 0  # not multi-tenant
+
+
+def test_batch_stream_bidi(server):
+    chan, _ = server
+    stream = chan.stream_stream(
+        "/weaviate.v1.Weaviate/BatchStream",
+        request_serializer=lambda x: x.SerializeToString(),
+        response_deserializer=wv.BatchStreamReply.FromString)
+
+    def requests():
+        start = wv.BatchStreamRequest()
+        start.start.SetInParent()
+        yield start
+        data = wv.BatchStreamRequest()
+        for i in range(3):
+            bo = data.data.objects.values.add()
+            bo.uuid = f"30000000-0000-0000-0000-{i:012d}"
+            bo.collection = "Article"
+            bo.properties.non_ref_properties.fields[
+                "title"].string_value = f"streamed {i}"
+            bo.vector_bytes = np.zeros(D, "<f4").tobytes()
+        yield data
+        stop = wv.BatchStreamRequest()
+        stop.stop.SetInParent()
+        yield stop
+
+    replies = list(stream(requests()))
+    kinds = [r.WhichOneof("message") for r in replies]
+    assert kinds[0] == "started"
+    assert "acks" in kinds and "results" in kinds
+    assert kinds[-1] == "shutdown"
+    res = next(r for r in replies if r.WhichOneof("message") == "results")
+    assert len(res.results.successes) == 3 and not res.results.errors
+
+    # the streamed objects are searchable
+    sreq = wv.SearchRequest(collection="Article", limit=3)
+    sreq.bm25_search.query = "streamed"
+    out = _unary(chan, "Search", sreq, wv.SearchReply)
+    assert len(out.results) == 3
+
+
+def test_sort_and_group_by(server):
+    chan, _ = server
+    req = wv.SearchRequest(collection="Article", limit=5)
+    req.bm25_search.query = "news"
+    sb = req.sort_by.add()
+    sb.ascending = False
+    sb.path.append("wordCount")
+    reply = _unary(chan, "Search", req, wv.SearchReply)
+    counts = [r.properties.non_ref_props.fields["wordCount"].int_value
+              for r in reply.results]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_multi_vector_wire_decode():
+    from weaviate_tpu.api.grpc_v1_compat import _decode_vectors_entry
+
+    tokens = np.arange(12, dtype="<f4").reshape(3, 4)
+    v = wv.Vectors()
+    v.type = wv.Vectors.VECTOR_TYPE_MULTI_FP32
+    v.vector_bytes = np.asarray([4], "<u2").tobytes() + tokens.tobytes()
+    out = _decode_vectors_entry(v)
+    np.testing.assert_array_equal(out, tokens)
+    with pytest.raises(ValueError, match="dimension"):
+        bad = wv.Vectors()
+        bad.type = wv.Vectors.VECTOR_TYPE_MULTI_FP32
+        bad.vector_bytes = np.asarray([0], "<u2").tobytes() + b"\x00" * 8
+        _decode_vectors_entry(bad)
+
+
+def test_batch_delete_without_filters_is_invalid(server):
+    chan, _ = server
+    dreq = wv.BatchDeleteRequest(collection="Article", dry_run=True)
+    with pytest.raises(grpc.RpcError) as ei:
+        _unary(chan, "BatchDelete", dreq, wv.BatchDeleteReply)
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_batch_stream_requires_auth_when_configured():
+    from weaviate_tpu.api.rest import AuthConfig
+
+    tmp = tempfile.mkdtemp()
+    try:
+        db = DB(tmp)
+        api = GrpcAPI(db, auth=AuthConfig(anonymous_access=False))
+        port = api.serve(port=0)
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        stream = chan.stream_stream(
+            "/weaviate.v1.Weaviate/BatchStream",
+            request_serializer=lambda x: x.SerializeToString(),
+            response_deserializer=wv.BatchStreamReply.FromString)
+
+        def requests():
+            start = wv.BatchStreamRequest()
+            start.start.SetInParent()
+            yield start
+
+        with pytest.raises(grpc.RpcError) as ei:
+            list(stream(requests()))
+        assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        api.shutdown()
+        db.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -30,6 +30,26 @@ from weaviate_tpu.query import Explorer, HybridParams, QueryParams
 SERVICE = "weaviate_tpu.v1.WeaviateTpu"
 
 
+def insert_grouped(db: DB, items) -> list[tuple[int, str]]:
+    """Shared batch-insert tail for both gRPC planes: group decoded objects
+    by (collection, tenant), run auto-schema, put_batch; returns
+    (index, error) pairs. ``items``: [(index, StorageObject)]."""
+    errors: list[tuple[int, str]] = []
+    groups: dict[tuple[str, str], list] = {}
+    for i, obj in items:
+        groups.setdefault((obj.collection, obj.tenant), []).append((i, obj))
+    for (cls, tenant), group in groups.items():
+        try:
+            from weaviate_tpu.schema.auto_schema import ensure_schema
+
+            ensure_schema(db, cls, [o.properties for _, o in group])
+            col = db.get_collection(cls)
+            col.put_batch([o for _, o in group], tenant=tenant)
+        except (KeyError, ValueError, RuntimeError) as e:
+            errors.extend((i, str(e)) for i, _ in group)
+    return errors
+
+
 def _np_from_vec(v: pb.Vector) -> np.ndarray:
     return np.asarray(v.values, np.float32)
 
@@ -234,20 +254,12 @@ class GrpcAPI:
                 err = reply.errors.add()
                 err.index = i
                 err.message = str(e)
-        for (cls, tenant), items in groups.items():
-            try:
-                from weaviate_tpu.schema.auto_schema import ensure_schema
-
-                ensure_schema(self.db, cls,
-                              [o.properties for _, o in items])
-                col = self.db.get_collection(cls)
-                col.put_batch([o for _, o in items], tenant=tenant)
-            except (KeyError, ValueError, RuntimeError) as e:
-                for i, _ in items:
-                    err = reply.errors.add()
-                    err.index = i
-                    err.message = str(e)
-                    objs[i] = None
+        decoded = [it for g in groups.values() for it in g]
+        for i, msg in insert_grouped(self.db, decoded):
+            err = reply.errors.add()
+            err.index = i
+            err.message = msg
+            objs[i] = None
         reply.uuids.extend(o.uuid if o is not None else "" for o in objs)
         reply.took_seconds = time.perf_counter() - t0
         return reply
@@ -307,9 +319,15 @@ class GrpcAPI:
     def serve(self, host: str = "127.0.0.1", port: int = 0) -> int:
         """Start the server; returns the bound port. Raises on bind failure
         (grpc signals it by returning port 0)."""
+        from weaviate_tpu.api.grpc_v1_compat import WeaviateV1Service
+
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self.max_workers))
-        self._server.add_generic_rpc_handlers((self._generic_handler(),))
+        # native TPU-first plane + the reference's public weaviate.v1
+        # contract, one port (stock clients connect unchanged)
+        compat = WeaviateV1Service(self.db, auth=self.auth, rbac=self.rbac)
+        self._server.add_generic_rpc_handlers(
+            (self._generic_handler(), compat.generic_handler()))
         bound = self._server.add_insecure_port(f"{host}:{port}")
         if bound == 0:
             raise RuntimeError(f"gRPC failed to bind {host}:{port}")

@@ -1,0 +1,620 @@
+"""weaviate.v1 gRPC service: the reference's public wire contract.
+
+Reference: ``adapters/handlers/grpc/v1/service.go`` — stock weaviate
+clients speak ``/weaviate.v1.Weaviate/...`` with the messages in
+``grpc/proto/v1/*.proto``. This adapter translates that contract onto the
+same Explorer/Collection machinery the native ``weaviate_tpu.v1`` plane
+uses (which remains the TPU-first surface: its Search carries a BATCH of
+query vectors per RPC). Served alongside it on the same port.
+
+Covered: Search (near_vector/bm25/hybrid/near_text, filters, metadata,
+properties, sort, group_by, autocut), BatchObjects, BatchDelete,
+TenantsGet, Aggregate (count/int/number/text/boolean, group_by), and the
+bidirectional BatchStream (start -> started, data -> acks/results,
+stop -> shutdown; reference ``grpc/v1/batch/start.go:35``).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import time
+import uuid as uuidlib
+from typing import Any, Optional
+
+import grpc
+import numpy as np
+
+from weaviate_tpu.api.proto import weaviate_v1_compat_pb2 as wv
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.inverted.filters import Filter
+from weaviate_tpu.query import Explorer, HybridParams, QueryParams
+from weaviate_tpu.storage.objects import StorageObject
+
+SERVICE_V1 = "weaviate.v1.Weaviate"
+
+_OP_NAMES = {
+    wv.Filters.OPERATOR_EQUAL: "Equal",
+    wv.Filters.OPERATOR_NOT_EQUAL: "NotEqual",
+    wv.Filters.OPERATOR_GREATER_THAN: "GreaterThan",
+    wv.Filters.OPERATOR_GREATER_THAN_EQUAL: "GreaterThanEqual",
+    wv.Filters.OPERATOR_LESS_THAN: "LessThan",
+    wv.Filters.OPERATOR_LESS_THAN_EQUAL: "LessThanEqual",
+    wv.Filters.OPERATOR_AND: "And",
+    wv.Filters.OPERATOR_OR: "Or",
+    wv.Filters.OPERATOR_WITHIN_GEO_RANGE: "WithinGeoRange",
+    wv.Filters.OPERATOR_LIKE: "Like",
+    wv.Filters.OPERATOR_IS_NULL: "IsNull",
+    wv.Filters.OPERATOR_CONTAINS_ANY: "ContainsAny",
+    wv.Filters.OPERATOR_CONTAINS_ALL: "ContainsAll",
+    wv.Filters.OPERATOR_NOT: "Not",
+}
+
+
+# -- request decoding --------------------------------------------------------
+
+def filter_from_pb(f: wv.Filters) -> Filter:
+    op = _OP_NAMES.get(f.operator)
+    if op is None:
+        raise ValueError(f"unsupported filter operator {f.operator}")
+    if op in ("And", "Or", "Not"):
+        return Filter(operator=op,
+                      operands=[filter_from_pb(x) for x in f.filters])
+    which = f.WhichOneof("test_value")
+    value: Any = None
+    if which == "value_text":
+        value = f.value_text
+    elif which == "value_int":
+        value = int(f.value_int)
+    elif which == "value_boolean":
+        value = f.value_boolean
+    elif which == "value_number":
+        value = f.value_number
+    elif which == "value_text_array":
+        value = list(f.value_text_array.values)
+    elif which == "value_int_array":
+        value = [int(v) for v in f.value_int_array.values]
+    elif which == "value_boolean_array":
+        value = list(f.value_boolean_array.values)
+    elif which == "value_number_array":
+        value = list(f.value_number_array.values)
+    elif which == "value_geo":
+        value = {"latitude": f.value_geo.latitude,
+                 "longitude": f.value_geo.longitude,
+                 "distance": f.value_geo.distance}
+    path: list[str] = []
+    if f.target.WhichOneof("target") == "property":
+        path = [f.target.property]
+    elif f.on:
+        path = list(f.on)
+    return Filter(operator=op, path=path or None, value=value)
+
+
+def _vec_from_bytes(raw: bytes) -> np.ndarray:
+    return np.frombuffer(raw, "<f4").astype(np.float32)
+
+
+def _decode_vectors_entry(v: wv.Vectors) -> np.ndarray:
+    if v.type == wv.Vectors.VECTOR_TYPE_MULTI_FP32:
+        # wire layout (reference byteops.Fp32SliceOfSlicesFromBytes): a
+        # little-endian uint16 row dimension, then row-major f32 tokens
+        raw = v.vector_bytes
+        if len(raw) < 2:
+            raise ValueError("multi-vector payload too short")
+        dim = int(np.frombuffer(raw[:2], "<u2")[0])
+        if dim == 0:
+            raise ValueError("multi-vector dimension cannot be 0")
+        return np.frombuffer(raw[2:], "<f4").astype(
+            np.float32).reshape(-1, dim)
+    return _vec_from_bytes(v.vector_bytes)
+
+
+def vector_from_near(nv: wv.NearVector) -> np.ndarray:
+    if nv.vectors:
+        return _decode_vectors_entry(nv.vectors[0])
+    if nv.vector_bytes:
+        return _vec_from_bytes(nv.vector_bytes)
+    return np.asarray(list(nv.vector), np.float32)
+
+
+def _struct_value(v) -> Any:
+    kind = v.WhichOneof("kind")
+    if kind == "number_value":
+        n = v.number_value
+        return int(n) if float(n).is_integer() else n
+    if kind == "string_value":
+        return v.string_value
+    if kind == "bool_value":
+        return v.bool_value
+    if kind == "struct_value":
+        return {k: _struct_value(x) for k, x in v.struct_value.fields.items()}
+    if kind == "list_value":
+        return [_struct_value(x) for x in v.list_value.values]
+    return None
+
+
+def object_from_pb(bo: wv.BatchObject) -> StorageObject:
+    props: dict[str, Any] = {
+        k: _struct_value(v)
+        for k, v in bo.properties.non_ref_properties.fields.items()
+    }
+    for ap in bo.properties.number_array_properties:
+        props[ap.prop_name] = (
+            np.frombuffer(ap.values_bytes, "<f8").tolist()
+            if ap.values_bytes else list(ap.values))
+    for ap in bo.properties.int_array_properties:
+        props[ap.prop_name] = [int(x) for x in ap.values]
+    for ap in bo.properties.text_array_properties:
+        props[ap.prop_name] = list(ap.values)
+    for ap in bo.properties.boolean_array_properties:
+        props[ap.prop_name] = list(ap.values)
+    for name in bo.properties.empty_list_props:
+        props[name] = []
+    vector = None
+    named: dict[str, np.ndarray] = {}
+    if bo.vector_bytes:
+        vector = _vec_from_bytes(bo.vector_bytes)
+    elif bo.vector:
+        vector = np.asarray(list(bo.vector), np.float32)
+    for v in bo.vectors:
+        arr = _decode_vectors_entry(v)
+        if v.name:
+            named[v.name] = arr
+        else:
+            vector = arr
+    return StorageObject(
+        uuid=bo.uuid or str(uuidlib.uuid4()),
+        collection=bo.collection,
+        tenant=bo.tenant,
+        properties=props,
+        vector=vector,
+        named_vectors=named,
+    )
+
+
+# -- reply encoding ----------------------------------------------------------
+
+def _value_to_pb(out: wv.Value, value: Any) -> None:
+    if value is None:
+        out.null_value = 0
+    elif isinstance(value, bool):
+        out.bool_value = value
+    elif isinstance(value, int):
+        out.int_value = value
+    elif isinstance(value, float):
+        out.number_value = value
+    elif isinstance(value, str):
+        out.text_value = value
+    elif isinstance(value, dict):
+        if "latitude" in value and "longitude" in value:
+            out.geo_value.latitude = float(value["latitude"])
+            out.geo_value.longitude = float(value["longitude"])
+        else:
+            for k, v in value.items():
+                _value_to_pb(out.object_value.fields[k], v)
+    elif isinstance(value, (list, tuple, np.ndarray)):
+        vals = list(value)
+        if not vals:
+            out.list_value.text_values.SetInParent()
+        elif all(isinstance(x, bool) for x in vals):
+            out.list_value.bool_values.values.extend(vals)
+        elif all(isinstance(x, int) for x in vals):
+            out.list_value.int_values.values = struct.pack(
+                f"<{len(vals)}q", *vals)
+        elif all(isinstance(x, (int, float)) for x in vals):
+            out.list_value.number_values.values = struct.pack(
+                f"<{len(vals)}d", *[float(x) for x in vals])
+        elif all(isinstance(x, str) for x in vals):
+            out.list_value.text_values.values.extend(vals)
+        elif all(isinstance(x, dict) for x in vals):
+            for x in vals:
+                p = out.list_value.object_values.values.add()
+                for k, v in x.items():
+                    _value_to_pb(p.fields[k], v)
+
+
+def _fill_result(sr: wv.SearchResult, obj: StorageObject,
+                 distance: Optional[float], score: Optional[float],
+                 md_req: Optional[wv.MetadataRequest],
+                 props_req: Optional[wv.PropertiesRequest]) -> None:
+    md = sr.metadata
+    if md_req is None or md_req.uuid:
+        md.id = obj.uuid
+    if md_req is not None:
+        if md_req.creation_time_unix:
+            md.creation_time_unix = obj.creation_time_ms
+            md.creation_time_unix_present = True
+        if md_req.last_update_time_unix:
+            md.last_update_time_unix = obj.update_time_ms
+            md.last_update_time_unix_present = True
+        if md_req.vector and obj.vector is not None:
+            md.vector_bytes = np.asarray(
+                obj.vector, "<f4").tobytes()
+        for nm in md_req.vectors:
+            v = obj.named_vectors.get(nm)
+            if v is not None:
+                ent = md.vectors.add()
+                ent.name = nm
+                ent.vector_bytes = np.asarray(v, "<f4").tobytes()
+                ent.type = wv.Vectors.VECTOR_TYPE_SINGLE_FP32
+    if distance is not None and (md_req is None or md_req.distance):
+        md.distance = distance
+        md.distance_present = True
+    if score is not None and (md_req is None or md_req.score):
+        md.score = score
+        md.score_present = True
+
+    wanted = None
+    if props_req is not None and not props_req.return_all_nonref_properties:
+        wanted = set(props_req.non_ref_properties)
+    for k, v in obj.properties.items():
+        if wanted is not None and k not in wanted:
+            continue
+        _value_to_pb(sr.properties.non_ref_props.fields[k], v)
+    sr.properties.target_collection = obj.collection
+
+
+class WeaviateV1Service:
+    """The weaviate.v1 service handlers (registered as generic handlers)."""
+
+    def __init__(self, db: DB, auth=None, rbac=None):
+        self.db = db
+        self.explorer = Explorer(db)
+        self.auth = auth
+        self.rbac = rbac
+
+    # -- auth (same identity machinery as the native plane) ----------------
+    def _identity(self, context):
+        if self.auth is None:
+            return None, ()
+        from weaviate_tpu.api.rest import AuthError
+
+        md = dict(context.invocation_metadata() or [])
+        try:
+            return self.auth.identity_for(md.get("authorization", ""))
+        except AuthError as e:
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, str(e))
+
+    def _check(self, context, principal, groups, action: str, resource: str):
+        if self.rbac is None:
+            return
+        from weaviate_tpu.auth.rbac import Forbidden
+
+        try:
+            self.rbac.authorize(principal, action, resource, groups=groups)
+        except Forbidden as e:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+
+    def _gate(self, context, action: str, resource: str):
+        principal, groups = self._identity(context)
+        self._check(context, principal, groups, action, resource)
+
+    def _authz_objects(self, context, principal, groups, objects) -> None:
+        """Per-object create/update authz, mirroring the native plane
+        (upsert of an existing uuid needs update_data, and resources are
+        collection-scoped)."""
+        if self.rbac is None:
+            return
+        for bo in objects:
+            act = "create_data"
+            try:
+                if bo.uuid and self.db.has_collection(bo.collection) and \
+                        self.db.get_collection(bo.collection).exists(
+                            bo.uuid, bo.tenant):
+                    act = "update_data"
+            except (KeyError, ValueError, RuntimeError):
+                pass
+            self._check(context, principal, groups, act,
+                        f"collections/{bo.collection}")
+
+    # -- Search ------------------------------------------------------------
+    def search(self, req: wv.SearchRequest, context) -> wv.SearchReply:
+        t0 = time.perf_counter()
+        self._gate(context, "read_data", f"collections/{req.collection}")
+        flt = (filter_from_pb(req.filters)
+               if req.HasField("filters") else None)
+        md_req = req.metadata if req.HasField("metadata") else None
+        props_req = req.properties if req.HasField("properties") else None
+
+        params = QueryParams(
+            collection=req.collection, tenant=req.tenant,
+            limit=int(req.limit) or 10, offset=int(req.offset),
+            filters=flt, autocut=int(req.autocut),
+        )
+        if req.sort_by:
+            params.sort = [
+                (".".join(s.path), "asc" if s.ascending else "desc")
+                for s in req.sort_by if s.path
+            ]
+        if req.HasField("group_by") and req.group_by.path:
+            from weaviate_tpu.query.groupby import GroupByParams
+
+            params.group_by = GroupByParams(
+                property=req.group_by.path[0],
+                groups=int(req.group_by.number_of_groups) or 5,
+                objects_per_group=int(req.group_by.objects_per_group) or 10,
+            )
+        if req.HasField("hybrid_search"):
+            h = req.hybrid_search
+            vec = None
+            if h.vectors:
+                vec = _vec_from_bytes(h.vectors[0].vector_bytes)
+            elif h.vector_bytes:
+                vec = _vec_from_bytes(h.vector_bytes)
+            elif h.vector:
+                vec = np.asarray(list(h.vector), np.float32)
+            target = ""
+            if h.targets.target_vectors:
+                target = h.targets.target_vectors[0]
+            elif h.target_vectors:
+                target = h.target_vectors[0]
+            params.target_vector = target
+            params.hybrid = HybridParams(
+                query=h.query or None,
+                vector=vec,
+                # plain proto3 float: the reference uses it as sent, so an
+                # absent field means 0.0 = pure keyword (no 0.75 coercion —
+                # stock clients always set alpha explicitly)
+                alpha=float(h.alpha),
+                fusion=("rankedFusion"
+                        if h.fusion_type == wv.Hybrid.FUSION_TYPE_RANKED
+                        else "relativeScoreFusion"),
+                properties=list(h.properties) or None,
+            )
+        elif req.HasField("near_vector"):
+            nv = req.near_vector
+            params.near_vector = vector_from_near(nv)
+            if nv.targets.target_vectors:
+                params.target_vector = nv.targets.target_vectors[0]
+            elif nv.target_vectors:
+                params.target_vector = nv.target_vectors[0]
+            if nv.HasField("distance"):
+                params.max_distance = float(nv.distance)
+        elif req.HasField("near_text"):
+            params.near_text = " ".join(req.near_text.query)
+            if req.near_text.HasField("distance"):
+                params.max_distance = float(req.near_text.distance)
+        elif req.HasField("bm25_search"):
+            params.bm25_query = req.bm25_search.query
+            params.bm25_properties = list(req.bm25_search.properties) or None
+
+        out = self.explorer.get(params)
+        reply = wv.SearchReply()
+        keyword = params.hybrid is not None or params.bm25_query
+        if out.groups:
+            for g in out.groups:
+                gr = reply.group_by_results.add()
+                gr.name = str(g.value)
+                gr.number_of_objects = len(g.objects)
+                gr.min_distance = g.min_score
+                gr.max_distance = g.max_score
+                for obj, s in g.objects:
+                    dist = None if keyword else s
+                    score = s if keyword else None
+                    _fill_result(gr.objects.add(), obj, dist, score,
+                                 md_req, props_req)
+        else:
+            for hit in out.hits:
+                _fill_result(reply.results.add(), hit.object, hit.distance,
+                             hit.score, md_req, props_req)
+        reply.took = time.perf_counter() - t0
+        return reply
+
+    # -- BatchObjects ------------------------------------------------------
+    def _insert(self, objects) -> list[tuple[int, str]]:
+        """Insert BatchObjects; returns (index, error) pairs."""
+        from weaviate_tpu.api.grpc_server import insert_grouped
+
+        errors: list[tuple[int, str]] = []
+        decoded: list[tuple[int, StorageObject]] = []
+        for i, bo in enumerate(objects):
+            try:
+                decoded.append((i, object_from_pb(bo)))
+            except (ValueError, KeyError) as e:
+                errors.append((i, str(e)))
+        errors.extend(insert_grouped(self.db, decoded))
+        return errors
+
+    def batch_objects(self, req: wv.BatchObjectsRequest,
+                      context) -> wv.BatchObjectsReply:
+        t0 = time.perf_counter()
+        principal, groups = self._identity(context)
+        self._authz_objects(context, principal, groups, req.objects)
+        reply = wv.BatchObjectsReply()
+        for i, msg in self._insert(req.objects):
+            err = reply.errors.add()
+            err.index = i
+            err.error = msg
+        reply.took = time.perf_counter() - t0
+        return reply
+
+    # -- BatchStream (bidi) ------------------------------------------------
+    def batch_stream(self, request_iterator, context):
+        """start -> Started; each Data -> Acks then Results; stop ->
+        Shutdown (reference grpc/v1/batch/start.go:35 state machine)."""
+        principal, groups = self._identity(context)
+        for msg in request_iterator:
+            which = msg.WhichOneof("message")
+            if which == "start":
+                reply = wv.BatchStreamReply()
+                reply.started.SetInParent()
+                yield reply
+            elif which == "data":
+                objs = list(msg.data.objects.values)
+                self._authz_objects(context, principal, groups, objs)
+                ack = wv.BatchStreamReply()
+                ack.acks.uuids.extend(o.uuid for o in objs)
+                yield ack
+                errors = dict(self._insert(objs))
+                res = wv.BatchStreamReply()
+                for i, o in enumerate(objs):
+                    if i in errors:
+                        e = res.results.errors.add()
+                        e.error = errors[i]
+                        e.uuid = o.uuid
+                    else:
+                        s = res.results.successes.add()
+                        s.uuid = o.uuid
+                yield res
+            elif which == "stop":
+                reply = wv.BatchStreamReply()
+                reply.shutdown.SetInParent()
+                yield reply
+                return
+
+    # -- BatchDelete -------------------------------------------------------
+    def batch_delete(self, req: wv.BatchDeleteRequest,
+                     context) -> wv.BatchDeleteReply:
+        t0 = time.perf_counter()
+        self._gate(context, "delete_data", f"collections/{req.collection}")
+        col = self.db.get_collection(req.collection)
+        if not req.HasField("filters"):
+            raise ValueError("BatchDelete requires filters (the reference "
+                             "refuses unfiltered deletes the same way)")
+        flt = filter_from_pb(req.filters)
+        tenant = req.tenant if req.HasField("tenant") else ""
+        reply = wv.BatchDeleteReply()
+        if req.dry_run:
+            reply.matches = col.count_where(flt, tenant=tenant)
+            reply.successful = 0
+        else:
+            n = col.delete_where(flt, tenant=tenant)
+            reply.matches = n
+            reply.successful = n
+        reply.took = time.perf_counter() - t0
+        return reply
+
+    # -- TenantsGet --------------------------------------------------------
+    def tenants_get(self, req: wv.TenantsGetRequest,
+                    context) -> wv.TenantsGetReply:
+        t0 = time.perf_counter()
+        self._gate(context, "read_tenants", f"collections/{req.collection}")
+        col = self.db.get_collection(req.collection)
+        want = (set(req.names.values)
+                if req.WhichOneof("params") == "names" else None)
+        reply = wv.TenantsGetReply()
+        status_map = {
+            "HOT": wv.TENANT_ACTIVITY_STATUS_HOT,
+            "COLD": wv.TENANT_ACTIVITY_STATUS_COLD,
+            "FROZEN": wv.TENANT_ACTIVITY_STATUS_FROZEN,
+        }
+        for name, status in sorted(col.tenants().items()):
+            if want is not None and name not in want:
+                continue
+            t = reply.tenants.add()
+            t.name = name
+            t.activity_status = status_map.get(
+                status, wv.TENANT_ACTIVITY_STATUS_HOT)
+        reply.took = time.perf_counter() - t0
+        return reply
+
+    # -- Aggregate ---------------------------------------------------------
+    def aggregate(self, req: wv.AggregateRequest,
+                  context) -> wv.AggregateReply:
+        t0 = time.perf_counter()
+        self._gate(context, "read_data", f"collections/{req.collection}")
+        col = self.db.get_collection(req.collection)
+        flt = filter_from_pb(req.filters) if req.HasField("filters") else None
+        kind_of = {"int": "numeric", "number": "numeric", "text": "text",
+                   "boolean": "boolean"}
+        props = {
+            a.property: kind_of.get(a.WhichOneof("aggregation"), "auto")
+            for a in req.aggregations
+        }
+        group_by = (req.group_by.property
+                    if req.HasField("group_by") else None)
+        result = col.aggregate(properties=props or None, flt=flt,
+                               tenant=req.tenant, group_by=group_by)
+        reply = wv.AggregateReply()
+
+        def fill_aggs(aggs_pb, stats: dict):
+            for a in req.aggregations:
+                st = stats.get(a.property)
+                if st is None:
+                    continue
+                out = aggs_pb.aggregations.add()
+                out.property = a.property
+                kind = a.WhichOneof("aggregation")
+                if kind == "int":
+                    out.int.count = st.get("count", 0)
+                    for f in ("mean", "median"):
+                        if st.get(f) is not None:
+                            setattr(out.int, f, float(st[f]))
+                    for f, src in (("maximum", "max"), ("minimum", "min"),
+                                   ("sum", "sum")):
+                        if st.get(src) is not None:
+                            setattr(out.int, f, int(st[src]))
+                elif kind == "number":
+                    out.number.count = st.get("count", 0)
+                    for f, src in (("mean", "mean"), ("median", "median"),
+                                   ("maximum", "max"), ("minimum", "min"),
+                                   ("sum", "sum")):
+                        if st.get(src) is not None:
+                            setattr(out.number, f, float(st[src]))
+                elif kind == "text":
+                    out.text.count = st.get("count", 0)
+                    for item in st.get("topOccurrences", []):
+                        to = out.text.top_occurences.items.add()
+                        to.value = str(item["value"])
+                        to.occurs = int(item["occurs"])
+                elif kind == "boolean":
+                    out.boolean.count = st.get("count", 0)
+                    if st.get("totalTrue") is not None:
+                        out.boolean.total_true = int(st["totalTrue"])
+                    if st.get("totalFalse") is not None:
+                        out.boolean.total_false = int(st["totalFalse"])
+
+        if group_by:
+            for g in result.get("groups", []):
+                grp = reply.grouped_results.groups.add()
+                grp.objects_count = g.get("meta", {}).get("count", 0)
+                grp.grouped_by.path.append(group_by)
+                val = g.get("groupedBy", {}).get("value")
+                if isinstance(val, bool):
+                    grp.grouped_by.boolean = val
+                elif isinstance(val, int):
+                    grp.grouped_by.int = val
+                elif isinstance(val, float):
+                    grp.grouped_by.number = val
+                else:
+                    grp.grouped_by.text = str(val)
+                fill_aggs(grp.aggregations, g.get("properties", {}))
+        else:
+            reply.single_result.objects_count = result.get(
+                "meta", {}).get("count", 0)
+            fill_aggs(reply.single_result.aggregations,
+                      result.get("properties", {}))
+        reply.took = time.perf_counter() - t0
+        return reply
+
+    # -- registration ------------------------------------------------------
+    def generic_handler(self):
+        def unary(fn, req_cls):
+            def h(request, context):
+                try:
+                    return fn(request, context)
+                except KeyError as e:
+                    context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+                except (ValueError, TypeError) as e:
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                except RuntimeError as e:
+                    context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                  str(e))
+            return grpc.unary_unary_rpc_method_handler(
+                h, request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString())
+
+        stream = grpc.stream_stream_rpc_method_handler(
+            self.batch_stream,
+            request_deserializer=wv.BatchStreamRequest.FromString,
+            response_serializer=lambda m: m.SerializeToString())
+
+        return grpc.method_handlers_generic_handler(SERVICE_V1, {
+            "Search": unary(self.search, wv.SearchRequest),
+            "BatchObjects": unary(self.batch_objects,
+                                  wv.BatchObjectsRequest),
+            "BatchDelete": unary(self.batch_delete, wv.BatchDeleteRequest),
+            "TenantsGet": unary(self.tenants_get, wv.TenantsGetRequest),
+            "Aggregate": unary(self.aggregate, wv.AggregateRequest),
+            "BatchStream": stream,
+        })
