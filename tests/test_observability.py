@@ -1,0 +1,203 @@
+"""Tracing, telemetry, runtime config hot-reload, reindexer, CJK tokens.
+
+Reference test models: ``usecases/config/runtime`` tests, telemetry
+payload tests, ``inverted_reindexer`` tests, entities/tokenizer tests.
+"""
+
+import json
+import shutil
+import tempfile
+import urllib.request
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.inverted.analyzer import tokenize
+from weaviate_tpu.monitoring.tracing import TRACER, Tracer
+from weaviate_tpu.utils.runtime_config import RuntimeConfig
+
+
+# -- tracing -----------------------------------------------------------------
+
+def test_span_nesting_and_retention():
+    tr = Tracer(max_spans=8)
+    with tr.span("root", kind="test") as root:
+        with tr.span("child") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+    spans = tr.recent()
+    assert [s["name"] for s in spans] == ["child", "root"]  # finish order
+    assert spans[1]["parentSpanId"] is None
+    trees = tr.traces()
+    assert trees[0]["root"] == "root" and len(trees[0]["spans"]) == 2
+
+
+def test_span_error_status():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("nope")
+    assert tr.recent()[-1]["status"] == "ERROR"
+
+
+def test_tracer_bounds_memory():
+    tr = Tracer(max_spans=10)
+    for i in range(50):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.recent(limit=100)) == 10
+
+
+# -- runtime config ----------------------------------------------------------
+
+def test_runtime_overrides_file_roundtrip(tmp_path):
+    path = tmp_path / "overrides.json"
+    rc = RuntimeConfig(path=str(path))
+    knob = rc.register("ef_default", 64)
+    assert knob.get() == 64
+    path.write_text(json.dumps({"ef_default": 128, "unknown_key": 1}))
+    assert rc.load_file() is True
+    assert knob.get() == 128 and knob.overridden
+    # removing the key falls back to the default
+    path.write_text(json.dumps({}))
+    rc._mtime = None  # force re-read despite fast mtime granularity
+    rc.load_file()
+    assert knob.get() == 64 and not knob.overridden
+
+
+def test_runtime_overrides_malformed_file_keeps_values(tmp_path):
+    path = tmp_path / "overrides.json"
+    rc = RuntimeConfig(path=str(path))
+    knob = rc.register("x", 1)
+    path.write_text(json.dumps({"x": 5}))
+    rc.load_file()
+    assert knob.get() == 5
+    path.write_text("{not json")
+    rc._mtime = None
+    assert rc.load_file() is False
+    assert knob.get() == 5  # previous override retained
+
+
+# -- CJK tokenization --------------------------------------------------------
+
+def test_cjk_bigram_tokenization():
+    assert tokenize("今日は良い天気", "gse") == [
+        "今日", "日は", "は良", "良い", "い天", "天気"]
+    # mixed CJK + latin: latin runs tokenize as words, order of appearance
+    assert tokenize("GPU架构设计 rocks", "kagome_ja") == [
+        "gpu", "架构", "构设", "设计", "rocks"]
+    assert tokenize("中", "gse") == ["中"]
+    assert tokenize("hello world", "gse") == ["hello", "world"]
+    # halfwidth katakana indexes as CJK; fullwidth ASCII normalizes
+    assert tokenize("ﾃｽﾄです", "kagome_ja") == ["ﾃｽ", "ｽﾄ", "ﾄで", "です"]
+    assert tokenize("ＧＰＵ２ rocks", "gse") == ["gpu2", "rocks"]
+
+
+def test_cjk_bm25_end_to_end(tmp_path):
+    from weaviate_tpu.core.shard import Shard
+    from weaviate_tpu.schema.config import (
+        CollectionConfig, DataType, Property, Tokenization,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    cfg = CollectionConfig(
+        name="Docs",
+        properties=[Property(name="body", data_type=DataType.TEXT,
+                             tokenization=Tokenization.GSE)],
+    )
+    s = Shard(str(tmp_path), cfg)
+    s.put_batch([
+        StorageObject(uuid=f"00000000-0000-0000-0000-{i:012d}",
+                      collection="Docs", properties={"body": b})
+        for i, b in enumerate(["今日は良い天気です", "機械学習の話", "良い本"])
+    ])
+    ids, scores = s.inverted.bm25_search("良い天気", k=3)
+    assert len(ids) >= 1 and ids[0] == 0  # best match: the weather doc
+    s.close()
+
+
+# -- reindexer ---------------------------------------------------------------
+
+def test_reindex_inverted_rebuilds_postings(tmp_path):
+    from weaviate_tpu.core.shard import Shard
+    from weaviate_tpu.schema.config import (
+        CollectionConfig, DataType, Property,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    cfg = CollectionConfig(
+        name="Docs",
+        properties=[Property(name="body", data_type=DataType.TEXT)],
+    )
+    s = Shard(str(tmp_path), cfg)
+    objs = [StorageObject(uuid=f"00000000-0000-0000-0000-{i:012d}",
+                          collection="Docs",
+                          properties={"body": f"alpha beta doc{i}"})
+            for i in range(10)]
+    s.put_batch(objs)
+    s.delete([objs[3].uuid])
+    n = s.reindex_inverted()
+    assert n == 9  # deleted doc not reindexed
+    ids, _ = s.inverted.bm25_search("alpha", k=20)
+    assert len(ids) == 9 and objs[3].doc_id not in set(ids.tolist())
+    ids, _ = s.inverted.bm25_search("doc5", k=5)
+    assert ids[0] == objs[5].doc_id
+    s.close()
+
+
+# -- REST debug plane --------------------------------------------------------
+
+def test_rest_debug_endpoints():
+    from weaviate_tpu.api.rest import RestAPI
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.monitoring.telemetry import Telemeter
+
+    tmp = tempfile.mkdtemp()
+    try:
+        db = DB(tmp)
+        api = RestAPI(db)
+        api.telemeter = Telemeter(db, enabled=False)
+        srv = api.serve(host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{srv.server_port}/v1"
+
+        def get(path):
+            with urllib.request.urlopen(base + path) as r:
+                return json.loads(r.read())
+
+        get("/schema")  # generates at least one span
+        traces = get("/debug/traces")
+        assert any(t["root"].startswith("rest.") for t in traces["traces"])
+        cfgv = get("/debug/config")
+        assert "slow_query_threshold_s" in cfgv["values"]
+        tel = get("/debug/telemetry")
+        assert tel["payload"]["num_collections"] == 0
+        assert tel["payload"]["machine_id"]
+        api.shutdown()
+        db.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def test_telemetry_payload_counts():
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.monitoring.telemetry import Telemeter
+    from weaviate_tpu.schema.config import CollectionConfig
+    from weaviate_tpu.storage.objects import StorageObject
+
+    tmp = tempfile.mkdtemp()
+    try:
+        db = DB(tmp)
+        col = db.create_collection(CollectionConfig(name="T"))
+        col.put_batch([
+            StorageObject(uuid=f"00000000-0000-0000-0000-{i:012d}",
+                          collection="T", properties={},
+                          vector=np.zeros(4, np.float32))
+            for i in range(7)
+        ])
+        t = Telemeter(db, enabled=False)
+        p = t.build_payload("INIT")
+        assert p["num_collections"] == 1 and p["num_objects"] == 7
+        assert p["type"] == "INIT" and p["version"]
+        db.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

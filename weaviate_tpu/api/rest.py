@@ -178,7 +178,18 @@ class RestAPI:
                  methods=["POST"]),
             Rule("/v1/authz/users/<user>/roles", endpoint="authz_user_roles",
                  methods=["GET"]),
+            # debug/ops plane (reference adapters/handlers/debug + runtime
+            # config + telemetry inspection)
+            Rule("/v1/debug/traces", endpoint="debug_traces",
+                 methods=["GET", "DELETE"]),
+            Rule("/v1/debug/config", endpoint="debug_config",
+                 methods=["GET"]),
+            Rule("/v1/debug/telemetry", endpoint="debug_telemetry",
+                 methods=["GET"]),
+            Rule("/v1/debug/reindex/<cls>", endpoint="debug_reindex",
+                 methods=["POST"]),
         ])
+        self.telemeter = None  # attached by server.py when enabled
         self._server = None
         self._thread = None
 
@@ -190,7 +201,12 @@ class RestAPI:
             endpoint, args = adapter.match()
             request.principal = self.auth.authenticate(request)
             handler = getattr(self, f"on_{endpoint}")
-            response = handler(request, **args)
+            from weaviate_tpu.monitoring.tracing import TRACER
+
+            with TRACER.span(f"rest.{endpoint}",
+                             method=request.method,
+                             path=request.path):
+                response = handler(request, **args)
         except _Forbidden as e:
             response = _json_response(
                 {"error": [{"message": str(e)}]}, 403)
@@ -482,6 +498,53 @@ class RestAPI:
         return _json_response(self.graphql.execute(query))
 
     # -- metrics -----------------------------------------------------------
+    # -- debug/ops plane ---------------------------------------------------
+    def on_debug_traces(self, request):
+        from weaviate_tpu.monitoring.tracing import TRACER
+
+        if request.method == "DELETE":
+            # destroys debugging evidence: write-tier verb, not read_cluster
+            self._authz(request, "manage_cluster", "debug/traces")
+            TRACER.clear()
+            return Response(status=204)
+        self._authz(request, "read_cluster", "debug/traces")
+        trace_id = request.args.get("trace")
+        if trace_id:
+            return _json_response({"spans": TRACER.recent(
+                limit=int(request.args.get("limit", 200)),
+                trace_id=trace_id)})
+        return _json_response({
+            "traces": TRACER.traces(limit=int(request.args.get("limit", 20)))
+        })
+
+    def on_debug_config(self, request):
+        self._authz(request, "read_cluster", "debug/config")
+        from weaviate_tpu.utils.runtime_config import RUNTIME
+
+        return _json_response({
+            "overrides_path": RUNTIME.path or None,
+            "values": RUNTIME.snapshot(),
+        })
+
+    def on_debug_telemetry(self, request):
+        self._authz(request, "read_cluster", "debug/telemetry")
+        if self.telemeter is None:
+            return _json_response({"enabled": False})
+        return _json_response({
+            "enabled": self.telemeter.enabled,
+            "payload": self.telemeter.build_payload("UPDATE"),
+            "push_url": self.telemeter.url or None,
+            "last_push_error": self.telemeter.last_push_error,
+        })
+
+    def on_debug_reindex(self, request, cls):
+        self._authz(request, "update_schema", f"collections/{cls}")
+        col = self.db.get_collection(cls)
+        total = 0
+        for shard in col._shards.values():
+            total += shard.reindex_inverted()
+        return _json_response({"class": cls, "reindexed": total})
+
     def on_metrics(self, request):
         """Prometheus text exposition (reference serves these on :2112
         without authz; same here)."""

@@ -22,7 +22,7 @@ ACTIONS = (
     "read_schema", "create_schema", "update_schema", "delete_schema",
     "read_data", "create_data", "update_data", "delete_data",
     "read_tenants", "update_tenants",
-    "manage_backups", "read_cluster", "read_nodes",
+    "manage_backups", "read_cluster", "manage_cluster", "read_nodes",
     "manage_roles", "read_roles",
 )
 

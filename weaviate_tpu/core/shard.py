@@ -548,6 +548,25 @@ class Shard:
         self._delta.close()
         self.store.close()
 
+    def reindex_inverted(self) -> int:
+        """Rebuild the inverted index (+filter columns) from stored objects.
+
+        Reference ``adapters/repos/db/inverted_reindexer.go``: run after a
+        tokenization/schema change that invalidates existing postings. The
+        rebuilt index replaces the live one atomically (searches during the
+        rebuild keep using the old postings), and the next checkpoint
+        persists the new state. Returns objects reindexed."""
+        with self._lock:
+            fresh = InvertedIndex(self.config, self.store)
+            n = 0
+            for _key, raw in self.objects.items():
+                obj = StorageObject.from_bytes(raw)
+                if obj.doc_id < len(self._live) and self._live[obj.doc_id]:
+                    fresh.add_object(obj)
+                    n += 1
+            self.inverted = fresh
+            return n
+
     def expire_ttl(self, cutoff_ms: int) -> int:
         """Delete objects created before the cutoff (reference object TTL)."""
         victims = []

@@ -71,6 +71,14 @@ class FlatIndex(VectorIndex):
             )
         if approx_recall is None:
             approx_recall = self.config.flat_approx_recall
+            if approx_recall == 0.0:
+                # fleet-wide hot-reloadable default for collections that
+                # didn't pin the knob in their schema (runtime overrides)
+                from weaviate_tpu.utils.runtime_config import (
+                    FLAT_APPROX_RECALL_DEFAULT,
+                )
+
+                approx_recall = FLAT_APPROX_RECALL_DEFAULT.get()
         qj = jnp.asarray(queries)
         if self.metric == "cosine":
             from weaviate_tpu.ops.distance import normalize

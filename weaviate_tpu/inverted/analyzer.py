@@ -39,7 +39,66 @@ def tokenize(text: str, scheme: str = "word") -> list[str]:
         if len(s) < 3:
             return [s] if s else []
         return [s[i : i + 3] for i in range(len(s) - 2)]
+    if scheme in ("gse", "kagome_ja", "kagome_kr"):
+        # CJK tokenization (reference gse/kagome integrations, gated behind
+        # USE_GSE etc.): the image carries no segmentation dictionaries, so
+        # CJK runs tokenize as overlapping BIGRAMS — the standard
+        # dictionary-free CJK indexing scheme (every two-char word is an
+        # exact posting; longer words match via consecutive bigrams) —
+        # while embedded latin/digit runs tokenize as words.
+        return _cjk_bigrams(text)
     raise ValueError(f"unknown tokenization {scheme!r}")
+
+
+_CJK_RANGES = (
+    (0x3040, 0x30FF),    # hiragana + katakana
+    (0x3400, 0x4DBF),    # CJK ext A
+    (0x4E00, 0x9FFF),    # CJK unified
+    (0xAC00, 0xD7AF),    # hangul syllables
+    (0xF900, 0xFAFF),    # CJK compat
+    (0xFF66, 0xFF9F),    # halfwidth katakana (ubiquitous in real ja data)
+)
+
+# fullwidth ASCII (FF01-FF5E) normalizes to its halfwidth form so ＧＰＵ２
+# tokenizes as latin "gpu2" rather than disappearing into the separator re
+_FULLWIDTH_TO_ASCII = {cp: cp - 0xFEE0 for cp in range(0xFF01, 0xFF5F)}
+
+
+def _is_cjk(ch: str) -> bool:
+    cp = ord(ch)
+    return any(lo <= cp <= hi for lo, hi in _CJK_RANGES)
+
+
+def _cjk_bigrams(text: str) -> list[str]:
+    text = text.translate(_FULLWIDTH_TO_ASCII)
+    out: list[str] = []
+    run: list[str] = []
+    latin: list[str] = []
+
+    def flush_run():
+        if len(run) == 1:
+            out.append(run[0])
+        else:
+            out.extend(run[i] + run[i + 1] for i in range(len(run) - 1))
+        run.clear()
+
+    def flush_latin():
+        if latin:
+            out.extend(t.lower() for t in _WORD_RE.split("".join(latin)) if t)
+            latin.clear()
+
+    for ch in text:
+        if _is_cjk(ch):
+            flush_latin()
+            run.append(ch)
+        else:
+            if run:
+                flush_run()
+            latin.append(ch)
+    if run:
+        flush_run()
+    flush_latin()
+    return out
 
 
 def term_frequencies(

@@ -46,7 +46,14 @@ class _Tracker:
 
     def __exit__(self, *exc):
         total = time.perf_counter() - self._t0
-        if self.reporter.enabled and total >= self.reporter.threshold_s:
+        # hot-reloadable threshold (utils/runtime_config; reference
+        # DynamicValue consumers read per use, never cache)
+        from weaviate_tpu.utils.runtime_config import SLOW_QUERY_THRESHOLD_S
+
+        threshold = (SLOW_QUERY_THRESHOLD_S.get()
+                     if SLOW_QUERY_THRESHOLD_S.overridden
+                     else self.reporter.threshold_s)
+        if self.reporter.enabled and total >= threshold:
             detail = " ".join(
                 f"{n}={dt * 1000:.1f}ms" for n, dt in self.stages)
             extra = " ".join(f"{k}={v}" for k, v in self.fields.items())

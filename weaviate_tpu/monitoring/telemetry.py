@@ -1,0 +1,90 @@
+"""Usage telemetry: periodic anonymous usage payloads.
+
+Reference: ``usecases/telemetry/telemeter.go`` — pushes {machine_id, type
+(INIT/UPDATE/TERMINATE), version, object_count, collections_count, ...} to
+a collector URL on boot, every interval, and at shutdown; DISABLE_TELEMETRY
+opts out. This deployment is zero-egress, so the pusher degrades loudly-
+but-harmlessly: payloads are always built and retained for inspection
+(``/v1/debug/telemetry``), and the HTTP push only fires when a collector
+URL is configured and reachable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+import uuid as uuidlib
+from typing import Optional
+
+VERSION = "0.2.0"  # framework version reported in payloads
+
+
+class Telemeter:
+    def __init__(self, db, url: str = "", interval_s: float = 3600.0,
+                 enabled: Optional[bool] = None):
+        self.db = db
+        self.url = url or os.environ.get("TELEMETRY_PUSH_URL", "")
+        self.interval_s = interval_s
+        if enabled is None:
+            enabled = os.environ.get("DISABLE_TELEMETRY", "") != "true"
+        self.enabled = enabled
+        self.machine_id = uuidlib.uuid4().hex
+        self.last_payload: Optional[dict] = None
+        self.last_push_error: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    # -- payload -----------------------------------------------------------
+    def build_payload(self, kind: str) -> dict:
+        cols = list(self.db.collections())
+        objects = 0
+        for name in cols:
+            try:
+                objects += self.db.get_collection(name).count()
+            except Exception:
+                pass
+        payload = {
+            "machine_id": self.machine_id,
+            "type": kind,  # INIT | UPDATE | TERMINATE
+            "version": VERSION,
+            "num_objects": objects,
+            "num_collections": len(cols),
+            "os": os.uname().sysname.lower(),
+            "arch": os.uname().machine,
+            "timestamp": int(time.time()),
+        }
+        self.last_payload = payload
+        return payload
+
+    def _push(self, payload: dict) -> None:
+        if not self.url:
+            return
+        try:
+            req = urllib.request.Request(
+                self.url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            urllib.request.urlopen(req, timeout=5).read()
+            self.last_push_error = None
+        except Exception as e:  # zero-egress: expected to fail, never fatal
+            self.last_push_error = str(e)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if not self.enabled:
+            return
+        self._push(self.build_payload("INIT"))
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._push(self.build_payload("UPDATE"))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=2)
+        if self.enabled:
+            self._push(self.build_payload("TERMINATE"))

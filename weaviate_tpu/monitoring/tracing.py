@@ -1,0 +1,150 @@
+"""Request tracing: span trees with timings and attributes.
+
+Reference: the reference wires OpenTelemetry-style tracing through its
+handler chain (``adapters/handlers/rest/middlewares``) and exposes pprof
+profiles (``adapters/handlers/debug``). Zero-egress equivalent: an
+in-process tracer with bounded retention, OTLP-shaped JSON export, and a
+``/v1/debug/traces`` endpoint. Spans nest via a context-local stack, so
+instrumented layers (REST -> Collection -> Shard -> kernel) compose
+without passing handles around.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import threading
+import time
+import uuid as uuidlib
+from collections import deque
+from typing import Any, Optional
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = \
+    contextvars.ContextVar("wv_current_span", default=None)
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "start_ns",
+                 "end_ns", "attributes", "status", "_token", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: Optional[str]):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuidlib.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.start_ns = time.time_ns()
+        self.end_ns: Optional[int] = None
+        self.attributes: dict[str, Any] = {}
+        self.status = "OK"
+        self._token = None
+
+    def set(self, **attrs) -> "Span":
+        self.attributes.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.status = "ERROR"
+            self.attributes["error"] = repr(exc)
+        self.end_ns = time.time_ns()
+        _current_span.reset(self._token)
+        self._tracer._finish(self)
+
+    @property
+    def duration_ms(self) -> float:
+        end = self.end_ns or time.time_ns()
+        return (end - self.start_ns) / 1e6
+
+    def to_dict(self) -> dict:
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentSpanId": self.parent_id,
+            "name": self.name,
+            "startTimeUnixNano": self.start_ns,
+            "endTimeUnixNano": self.end_ns,
+            "durationMs": round(self.duration_ms, 3),
+            "attributes": self.attributes,
+            "status": self.status,
+        }
+
+
+class Tracer:
+    """Bounded-retention tracer; disabled = near-zero overhead."""
+
+    def __init__(self, max_spans: int = 4096, enabled: bool = True):
+        self.enabled = enabled
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        # deque(maxlen): O(1) append-with-eviction — a full buffer must not
+        # copy 4k entries under the lock on every request
+        self._spans: deque[dict] = deque(maxlen=max_spans)
+
+    def span(self, name: str, **attrs) -> Span:
+        parent = _current_span.get()
+        if parent is not None:
+            s = Span(self, name, parent.trace_id, parent.span_id)
+        else:
+            s = Span(self, name, uuidlib.uuid4().hex, None)
+        if attrs:
+            s.attributes.update(attrs)
+        return s
+
+    def _finish(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._spans.append(span.to_dict())
+
+    # -- export ------------------------------------------------------------
+    def recent(self, limit: int = 100,
+               trace_id: Optional[str] = None) -> list[dict]:
+        with self._lock:
+            spans = list(self._spans)
+        if trace_id:
+            spans = [s for s in spans if s["traceId"] == trace_id]
+        return spans[-limit:]
+
+    def traces(self, limit: int = 20) -> list[dict]:
+        """Assembled span trees, newest first (root span + children)."""
+        with self._lock:
+            spans = list(self._spans)
+        by_trace: dict[str, list[dict]] = {}
+        order: list[str] = []
+        for s in spans:
+            if s["traceId"] not in by_trace:
+                order.append(s["traceId"])
+            by_trace.setdefault(s["traceId"], []).append(s)
+        out = []
+        for tid in reversed(order[-limit:]):
+            group = by_trace[tid]
+            roots = [s for s in group if s["parentSpanId"] is None]
+            out.append({
+                "traceId": tid,
+                "root": roots[0]["name"] if roots else group[0]["name"],
+                "durationMs": max(s["durationMs"] for s in group),
+                "spans": group,
+            })
+        return out
+
+    def export_jsonl(self, path: str) -> int:
+        with self._lock:
+            spans = list(self._spans)
+        with open(path, "w") as f:
+            for s in spans:
+                f.write(json.dumps(s) + "\n")
+        return len(spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+# process-wide default tracer (REST wires its endpoints to this)
+TRACER = Tracer()

@@ -44,6 +44,11 @@ class Tokenization(str, enum.Enum):
     WHITESPACE = "whitespace"
     FIELD = "field"
     TRIGRAM = "trigram"
+    # CJK schemes (reference gse/kagome integrations; dictionary-free
+    # bigram segmentation here — see inverted/analyzer.py)
+    GSE = "gse"
+    KAGOME_JA = "kagome_ja"
+    KAGOME_KR = "kagome_kr"
 
 
 @dataclass

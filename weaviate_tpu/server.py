@@ -95,7 +95,17 @@ def main() -> int:
 
         rbac = RBACController(path=f"{cfg['data_path']}/rbac.json",
                               root_users=cfg["rbac_root_users"])
+    # runtime-overrides hot reload + usage telemetry (reference
+    # config/runtime + usecases/telemetry)
+    from weaviate_tpu.monitoring.telemetry import Telemeter
+    from weaviate_tpu.utils.runtime_config import RUNTIME
+
+    RUNTIME.start()
+    telemeter = Telemeter(db)
+    telemeter.start()
+
     rest = RestAPI(db, auth=auth, rbac=rbac)
+    rest.telemeter = telemeter
     rest_srv = rest.serve(host="0.0.0.0", port=cfg["http_port"],
                           background=True)
     print(f"REST listening on :{rest_srv.server_port}", file=sys.stderr)
@@ -119,6 +129,8 @@ def main() -> int:
     rest.shutdown()
     if grpc_api is not None:
         grpc_api.shutdown()
+    telemeter.stop()
+    RUNTIME.stop()
     db.close()
     return 0
 

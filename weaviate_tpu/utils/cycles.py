@@ -83,6 +83,12 @@ class CycleManager:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.tick):
+            # operator kill-switch, hot-reloadable (reference runtime
+            # config pauses cycle managers the same way)
+            from weaviate_tpu.utils.runtime_config import MAINTENANCE_PAUSED
+
+            if MAINTENANCE_PAUSED.get():
+                continue
             now = time.monotonic()
             with self._lock:
                 due = [c for c in self._cycles.values() if c.next_run <= now]

@@ -1,0 +1,156 @@
+"""Runtime-mutable configuration with file-based hot reload.
+
+Reference: ``usecases/config/runtime`` — ``DynamicValue[T]`` wraps a knob
+that an operator can override at runtime via a YAML file named by
+``RUNTIME_OVERRIDES_PATH``, polled every ``RUNTIME_OVERRIDES_LOAD_INTERVAL``;
+consumers call ``.Get()`` on every use so changes land without restart.
+Same contract here with a JSON overrides file (the image has no yaml lib):
+
+    registry = RuntimeConfig(path="overrides.json", interval_s=5)
+    ef = registry.register("query_defaults_ef", 64)   # DynamicValue
+    ...
+    ef.get()   # current value, overridden or default
+
+Unknown keys in the file are reported, not fatal; a malformed file keeps
+the previous values (reference behavior: refuse to crash the server over
+an operator typo).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Any, Callable, Generic, Optional, TypeVar
+
+logger = logging.getLogger("weaviate_tpu.runtime_config")
+
+T = TypeVar("T")
+
+
+class DynamicValue(Generic[T]):
+    """A named knob: default + optional runtime override."""
+
+    __slots__ = ("name", "_default", "_override", "_cast")
+
+    def __init__(self, name: str, default: T,
+                 cast: Optional[Callable[[Any], T]] = None):
+        self.name = name
+        self._default = default
+        self._override: Optional[T] = None
+        self._cast = cast
+
+    def get(self) -> T:
+        ov = self._override
+        return self._default if ov is None else ov
+
+    def set_override(self, value: Any) -> None:
+        if self._cast is not None:
+            value = self._cast(value)
+        elif self._default is not None:
+            value = type(self._default)(value)
+        self._override = value
+
+    def clear_override(self) -> None:
+        self._override = None
+
+    @property
+    def overridden(self) -> bool:
+        return self._override is not None
+
+
+class RuntimeConfig:
+    def __init__(self, path: Optional[str] = None,
+                 interval_s: float = 5.0):
+        self.path = path or os.environ.get("RUNTIME_OVERRIDES_PATH", "")
+        self.interval_s = float(os.environ.get(
+            "RUNTIME_OVERRIDES_LOAD_INTERVAL", interval_s))
+        self._values: dict[str, DynamicValue] = {}
+        self._lock = threading.Lock()
+        self._mtime: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def register(self, name: str, default: T,
+                 cast: Optional[Callable[[Any], T]] = None) -> DynamicValue[T]:
+        with self._lock:
+            dv = self._values.get(name)
+            if dv is None:
+                dv = DynamicValue(name, default, cast)
+                self._values[name] = dv
+            return dv
+
+    def get(self, name: str, default: Any = None) -> Any:
+        dv = self._values.get(name)
+        return dv.get() if dv is not None else default
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                n: {"value": dv.get(), "overridden": dv.overridden}
+                for n, dv in sorted(self._values.items())
+            }
+
+    # -- file reload -------------------------------------------------------
+    def load_file(self) -> bool:
+        """Apply the overrides file; returns True when values changed."""
+        if not self.path or not os.path.exists(self.path):
+            return False
+        try:
+            mtime = os.path.getmtime(self.path)
+            if mtime == self._mtime:
+                return False
+            with open(self.path) as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                raise ValueError("overrides file must be a JSON object")
+        except (OSError, ValueError) as e:
+            # operator typo must not take the server down — keep old values
+            logger.warning("runtime overrides not applied: %s", e)
+            return False
+        self._mtime = mtime
+        with self._lock:
+            seen = set()
+            for name, value in data.items():
+                dv = self._values.get(name)
+                if dv is None:
+                    logger.warning("unknown runtime override %r", name)
+                    continue
+                try:
+                    dv.set_override(value)
+                    seen.add(name)
+                except (TypeError, ValueError) as e:
+                    logger.warning("override %r rejected: %s", name, e)
+            # keys removed from the file fall back to defaults
+            for name, dv in self._values.items():
+                if name not in seen and dv.overridden:
+                    dv.clear_override()
+        return True
+
+    def start(self) -> None:
+        if self.path:
+            self.load_file()
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.load_file()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.ident is not None:
+            self._thread.join(timeout=2)
+
+
+# process-wide registry; servers start() it when RUNTIME_OVERRIDES_PATH is set
+RUNTIME = RuntimeConfig()
+
+# knobs consumed across the codebase (registered here so the overrides file
+# has a stable catalogue; callers may register more)
+SLOW_QUERY_THRESHOLD_S = RUNTIME.register("slow_query_threshold_s", 0.5,
+                                          cast=float)
+FLAT_APPROX_RECALL_DEFAULT = RUNTIME.register("flat_approx_recall_default",
+                                              0.0, cast=float)
+MAINTENANCE_PAUSED = RUNTIME.register("maintenance_paused", False,
+                                      cast=bool)
