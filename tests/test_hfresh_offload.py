@@ -1,0 +1,174 @@
+"""HFresh index + FROZEN tenant offload tier.
+
+Reference test models: ``vector/hfresh/hfresh_test.go`` (insert/search/
+split behavior) and tenant offload activation tests.
+"""
+
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.index.hfresh import HFreshIndex
+from weaviate_tpu.schema.config import HFreshIndexConfig
+
+
+def _corpus(rng, n, d):
+    v = rng.standard_normal((n, d)).astype(np.float32)
+    return v
+
+
+def _recall(idx, corpus, rng, k=10, nq=32):
+    queries = corpus[:nq] + 0.05 * rng.standard_normal(
+        (nq, corpus.shape[1])).astype(np.float32)
+    res = idx.search(queries, k)
+    d2 = ((queries[:, None, :] - corpus[None]) ** 2).sum(-1)
+    gt = np.argsort(d2, axis=1)[:, :k]
+    hits = sum(len(set(res.ids[i].tolist()) & set(gt[i].tolist()))
+               for i in range(nq))
+    return hits / (nq * k)
+
+
+def test_hfresh_recall_on_clustered_data():
+    """IVF-style indexes target real embedding corpora (clustered); default
+    probe/replica settings must be near-exact there."""
+    rng = np.random.default_rng(0)
+    n, d = 5000, 32
+    centers = rng.standard_normal((50, d)).astype(np.float32) * 3
+    corpus = (centers[rng.integers(0, 50, n)]
+              + rng.standard_normal((n, d)).astype(np.float32))
+    idx = HFreshIndex(d, HFreshIndexConfig(
+        distance="l2-squared", max_posting_size=128, search_probe=8))
+    for s in range(0, n, 500):
+        idx.add_batch(np.arange(s, s + 500, dtype=np.int64),
+                      corpus[s: s + 500])
+    assert idx.count() == n
+    st = idx.stats()
+    assert st["centroids"] > 10  # splits happened
+    assert _recall(idx, corpus, rng) >= 0.95
+
+
+def test_hfresh_recall_on_random_data_with_wider_probe():
+    """Structureless gaussian data is the worst case: wider probing +
+    boundary replication must still recover decent recall."""
+    rng = np.random.default_rng(0)
+    n, d = 5000, 32
+    corpus = _corpus(rng, n, d)
+    idx = HFreshIndex(d, HFreshIndexConfig(
+        distance="l2-squared", max_posting_size=128, search_probe=16,
+        replicas=3))
+    for s in range(0, n, 500):
+        idx.add_batch(np.arange(s, s + 500, dtype=np.int64),
+                      corpus[s: s + 500])
+    assert _recall(idx, corpus, rng) >= 0.75
+
+
+def test_hfresh_delete_and_filter():
+    rng = np.random.default_rng(1)
+    n, d = 600, 16
+    corpus = _corpus(rng, n, d)
+    idx = HFreshIndex(d, HFreshIndexConfig(distance="l2-squared",
+                                           max_posting_size=64))
+    idx.add_batch(np.arange(n, dtype=np.int64), corpus)
+    res = idx.search(corpus[5][None], 3)
+    assert res.ids[0][0] == 5
+    idx.delete(np.asarray([5]))
+    res = idx.search(corpus[5][None], 3)
+    assert 5 not in res.ids[0].tolist()
+    # allow-list filtering
+    allow = np.zeros(n, bool)
+    allow[100:200] = True
+    res = idx.search(corpus[150][None], 5, allow_list=allow)
+    got = [i for i in res.ids[0].tolist() if i >= 0]
+    assert got and all(100 <= i < 200 for i in got)
+
+
+def test_hfresh_checkpoint_roundtrip(tmp_path):
+    rng = np.random.default_rng(2)
+    n, d = 400, 16
+    corpus = _corpus(rng, n, d)
+    idx = HFreshIndex(d, HFreshIndexConfig(distance="cosine",
+                                           max_posting_size=64))
+    idx.add_batch(np.arange(n, dtype=np.int64), corpus)
+    before = idx.search(corpus[7][None], 5)
+    path = str(tmp_path / "hf.ckpt")
+    assert idx.save_vectors(path, {"seq": 42}) is True
+
+    idx2 = HFreshIndex(d, HFreshIndexConfig(distance="cosine",
+                                            max_posting_size=64))
+    meta = idx2.load_vectors(path)
+    assert meta is not None and meta["seq"] == 42
+    after = idx2.search(corpus[7][None], 5)
+    assert before.ids.tolist() == after.ids.tolist()
+    assert idx2.stats()["centroids"] == idx.stats()["centroids"]
+
+
+def test_hfresh_through_shard(tmp_path):
+    from weaviate_tpu.core.shard import Shard
+    from weaviate_tpu.schema.config import CollectionConfig
+
+    cfg = CollectionConfig(
+        name="HF", vector_config=HFreshIndexConfig(distance="l2-squared"))
+    rng = np.random.default_rng(3)
+    from weaviate_tpu.storage.objects import StorageObject
+
+    s = Shard(str(tmp_path), cfg)
+    vecs = rng.standard_normal((50, 8)).astype(np.float32)
+    s.put_batch([
+        StorageObject(uuid=f"00000000-0000-0000-0000-{i:012d}",
+                      collection="HF", properties={}, vector=vecs[i])
+        for i in range(50)
+    ])
+    res = s.vector_search(vecs[9][None], k=3)
+    assert res.ids[0][0] == 9
+    s.close()
+    # checkpointed reopen
+    s2 = Shard(str(tmp_path), cfg)
+    assert s2.recovered_from == "checkpoint"
+    res2 = s2.vector_search(vecs[9][None], k=3)
+    assert res2.ids[0].tolist() == res.ids[0].tolist()
+    s2.close()
+
+
+# -- offload tier ------------------------------------------------------------
+
+def test_frozen_tenant_offloads_files_and_onloads_back(tmp_path, monkeypatch):
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.schema.config import (
+        CollectionConfig, DataType, MultiTenancyConfig, Property,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    offload_root = tmp_path / "cold-bucket"
+    monkeypatch.setenv("OFFLOAD_FS_PATH", str(offload_root))
+    db = DB(str(tmp_path / "db"))
+    col = db.create_collection(CollectionConfig(
+        name="MT",
+        properties=[Property(name="t", data_type=DataType.TEXT)],
+        multi_tenancy=MultiTenancyConfig(enabled=True),
+    ))
+    col.add_tenant("acme")
+    col.put_batch([
+        StorageObject(uuid=f"00000000-0000-0000-0000-{i:012d}",
+                      collection="MT", properties={"t": f"doc {i}"},
+                      vector=np.eye(1, 8, i % 8, dtype=np.float32)[0])
+        for i in range(10)
+    ], tenant="acme")
+    shard_dir = os.path.join(col.dir, "tenant-acme")
+    assert os.path.exists(shard_dir)
+
+    col.set_tenant_status("acme", "FROZEN")
+    assert not os.path.exists(shard_dir)  # files LEFT the hot tier
+    frozen_dir = offload_root / "MT" / "acme"
+    assert frozen_dir.exists() and any(frozen_dir.iterdir())
+    with pytest.raises(Exception):
+        col.bm25_search("doc", tenant="acme")  # frozen tenant not queryable
+
+    col.set_tenant_status("acme", "HOT")
+    assert os.path.exists(shard_dir) and not frozen_dir.exists()
+    hits = col.bm25_search("doc 3", k=2, tenant="acme")
+    assert hits and hits[0][0].properties["t"] == "doc 3"
+    assert col.count(tenant="acme") == 10
+    db.close()

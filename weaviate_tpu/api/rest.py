@@ -540,10 +540,8 @@ class RestAPI:
     def on_debug_reindex(self, request, cls):
         self._authz(request, "update_schema", f"collections/{cls}")
         col = self.db.get_collection(cls)
-        total = 0
-        for shard in col._shards.values():
-            total += shard.reindex_inverted()
-        return _json_response({"class": cls, "reindexed": total})
+        return _json_response({"class": cls,
+                               "reindexed": col.reindex_inverted()})
 
     def on_metrics(self, request):
         """Prometheus text exposition (reference serves these on :2112

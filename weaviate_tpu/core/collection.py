@@ -44,12 +44,38 @@ class Collection:
             for i in range(max(1, config.sharding.desired_count)):
                 self._get_shard(f"shard{i}")
         else:
-            # discover existing tenant shards on disk
+            # persisted statuses first (a FROZEN tenant's files live in the
+            # offload tier, not here — a dir scan alone would orphan them)
+            self._load_tenant_status()
             for d in sorted(os.listdir(dirpath)):
                 if os.path.isdir(os.path.join(dirpath, d)) and d.startswith("tenant-"):
                     name = d[len("tenant-"):]
-                    self._tenant_status[name] = TENANT_HOT
+                    self._tenant_status.setdefault(name, TENANT_HOT)
+            for name, status in self._tenant_status.items():
+                if status == TENANT_HOT:
                     self._get_shard(f"tenant-{name}")
+
+    def _tenant_status_path(self) -> str:
+        return os.path.join(self.dir, "tenants.json")
+
+    def _load_tenant_status(self) -> None:
+        import json
+
+        path = self._tenant_status_path()
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._tenant_status = dict(json.load(f))
+            except (OSError, ValueError):
+                self._tenant_status = {}
+
+    def _persist_tenant_status(self) -> None:
+        import json
+
+        tmp = self._tenant_status_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._tenant_status, f)
+        os.replace(tmp, self._tenant_status_path())
 
     # -- shard management -------------------------------------------------
     def _get_shard(self, name: str) -> Shard:
@@ -82,7 +108,9 @@ class Collection:
                     raise KeyError(f"tenant {tenant!r} not found")
             if self._tenant_status[tenant] != TENANT_HOT:
                 if self.config.multi_tenancy.auto_tenant_activation:
-                    self._tenant_status[tenant] = TENANT_HOT
+                    # full activation path: a FROZEN tenant's files must
+                    # onload from the offload tier before the shard opens
+                    self.set_tenant_status(tenant, TENANT_HOT)
                 else:
                     raise RuntimeError(f"tenant {tenant!r} is not active")
             return self._get_shard(f"tenant-{tenant}")
@@ -104,13 +132,23 @@ class Collection:
     def add_tenant(self, name: str, status: str = TENANT_HOT) -> None:
         with self._lock:
             self._tenant_status.setdefault(name, status)
+            self._persist_tenant_status()
 
     def remove_tenant(self, name: str) -> None:
         with self._lock:
             self._tenant_status.pop(name, None)
+            self._persist_tenant_status()
             s = self._shards.pop(f"tenant-{name}", None)
             if s is not None:
                 s.close()
+
+    def reindex_inverted(self) -> int:
+        """Rebuild every open shard's inverted index (reference
+        ``inverted_reindexer.go`` per-index run). Snapshot under the lock —
+        concurrent tenant activation must not mutate the dict mid-walk."""
+        with self._lock:
+            shards = list(self._shards.values())
+        return sum(s.reindex_inverted() for s in shards)
 
     def drop_shard(self, name: str) -> None:
         """Close and delete one shard's data (replica movement: the source
@@ -126,17 +164,49 @@ class Collection:
     def tenants(self) -> dict[str, str]:
         return dict(self._tenant_status)
 
+    def _offload_root(self) -> str:
+        """Frozen-tier storage root (reference offload-s3 module; a cold
+        filesystem tier here — the bucket abstraction is a directory)."""
+        root = os.environ.get(
+            "OFFLOAD_FS_PATH", os.path.join(os.path.dirname(self.dir),
+                                            "_offload"))
+        return os.path.join(root, self.config.name)
+
     def set_tenant_status(self, name: str, status: str) -> None:
         if status not in (TENANT_HOT, TENANT_COLD, TENANT_FROZEN):
             raise ValueError(f"invalid tenant status {status!r}")
+        import shutil
+
         with self._lock:
             if name not in self._tenant_status:
                 raise KeyError(f"tenant {name!r} not found")
-            self._tenant_status[name] = status
+            prev = self._tenant_status[name]
+            shard_dir = os.path.join(self.dir, f"tenant-{name}")
+            frozen_dir = os.path.join(self._offload_root(), name)
             if status != TENANT_HOT:
                 s = self._shards.pop(f"tenant-{name}", None)
                 if s is not None:
                     s.close()
+            if status == TENANT_FROZEN and prev != TENANT_FROZEN:
+                # offload: shard files leave the hot data root entirely
+                # (reference FREEZING -> upload -> FROZEN; synchronous
+                # here). An existing frozen copy is only replaced when
+                # there are hot files to replace it with — never deleted
+                # on a freeze of an empty/recreated tenant.
+                if os.path.exists(shard_dir):
+                    os.makedirs(os.path.dirname(frozen_dir), exist_ok=True)
+                    if os.path.exists(frozen_dir):
+                        shutil.rmtree(frozen_dir)
+                    shutil.move(shard_dir, frozen_dir)
+            elif prev == TENANT_FROZEN and status != TENANT_FROZEN:
+                # onload (UNFREEZING -> HOT/COLD): files come back before
+                # the shard may open
+                if os.path.exists(frozen_dir):
+                    if os.path.exists(shard_dir):
+                        shutil.rmtree(shard_dir)
+                    shutil.move(frozen_dir, shard_dir)
+            self._tenant_status[name] = status
+            self._persist_tenant_status()
 
     # -- vectorization (module write-path hook) ---------------------------
     def _vectorize_missing(self, objs: list[StorageObject]) -> None:

@@ -57,6 +57,13 @@ def build_vector_index(
         if not isinstance(cfg, MultiVectorIndexConfig):
             cfg = cfg.as_type(MultiVectorIndexConfig, "multivector")
         return MultiVectorIndex(dims, cfg)
+    if cfg.index_type == "hfresh":
+        from weaviate_tpu.index.hfresh import HFreshIndex
+        from weaviate_tpu.schema.config import HFreshIndexConfig
+
+        if not isinstance(cfg, HFreshIndexConfig):
+            cfg = cfg.as_type(HFreshIndexConfig, "hfresh")
+        return HFreshIndex(dims, cfg)
     from weaviate_tpu.index.flat import make_flat
 
     if not isinstance(cfg, FlatIndexConfig):

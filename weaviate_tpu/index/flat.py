@@ -71,9 +71,9 @@ class FlatIndex(VectorIndex):
             )
         if approx_recall is None:
             approx_recall = self.config.flat_approx_recall
-            if approx_recall == 0.0:
-                # fleet-wide hot-reloadable default for collections that
-                # didn't pin the knob in their schema (runtime overrides)
+            if approx_recall < 0.0:
+                # UNSET: follow the fleet-wide hot-reloadable default.
+                # 0.0 means PINNED exact and never follows the override.
                 from weaviate_tpu.utils.runtime_config import (
                     FLAT_APPROX_RECALL_DEFAULT,
                 )

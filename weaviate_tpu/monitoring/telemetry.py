@@ -29,7 +29,9 @@ class Telemeter:
         self.url = url or os.environ.get("TELEMETRY_PUSH_URL", "")
         self.interval_s = interval_s
         if enabled is None:
-            enabled = os.environ.get("DISABLE_TELEMETRY", "") != "true"
+            enabled = os.environ.get(
+                "DISABLE_TELEMETRY", "").strip().lower() not in (
+                "true", "1", "yes", "on")
         self.enabled = enabled
         self.machine_id = uuidlib.uuid4().hex
         self.last_payload: Optional[dict] = None

@@ -154,7 +154,7 @@ def quantizer_from_dict(d: Optional[dict]) -> Optional[QuantizerConfig]:
 
 # Index types with a registered implementation (kept in sync with
 # weaviate_tpu.core.shard.build_vector_index).
-AVAILABLE_INDEX_TYPES = ("flat", "hnsw", "dynamic", "multivector")
+AVAILABLE_INDEX_TYPES = ("flat", "hnsw", "dynamic", "multivector", "hfresh")
 
 
 @dataclass
@@ -168,12 +168,14 @@ class VectorIndexConfig:
     precision: str = "bf16"  # matmul precision on TPU: bf16 | fp32
     initial_capacity: int = 1024
     search_chunk_size: int = 131072
-    # Flat-scan selection: 0 = exact top_k; in (0, 1) = TPU two-stage
-    # approx_min_k with this recall target (~4-5x faster at 1M rows; on CPU
-    # it lowers to an exact sort, so results there are identical). The
-    # reference's flat scan is always exact — this knob is the TPU-native
-    # trade the hardware rewards; measured recall is reported by bench.py.
-    flat_approx_recall: float = 0.0
+    # Flat-scan selection: -1 = unset (follows the runtime-config fleet
+    # default); 0 = PINNED exact top_k (immune to the fleet override); in
+    # (0, 1) = TPU two-stage approx_min_k with this recall target (~4-5x
+    # faster at 1M rows; on CPU it lowers to an exact sort, so results
+    # there are identical). The reference's flat scan is always exact —
+    # this knob is the TPU-native trade the hardware rewards; measured
+    # recall is reported by bench.py.
+    flat_approx_recall: float = -1.0
 
     def validate(self) -> None:
         from weaviate_tpu.ops.distance import METRICS
@@ -187,9 +189,11 @@ class VectorIndexConfig:
             raise ValueError(f"invalid distance {self.distance!r}")
         if self.precision not in ("bf16", "fp32"):
             raise ValueError(f"invalid precision {self.precision!r}")
-        if not 0.0 <= self.flat_approx_recall < 1.0:
+        if self.flat_approx_recall != -1.0 and \
+                not 0.0 <= self.flat_approx_recall < 1.0:
             raise ValueError(
-                f"flat_approx_recall must be in [0, 1), got {self.flat_approx_recall}"
+                "flat_approx_recall must be -1 (unset) or in [0, 1), "
+                f"got {self.flat_approx_recall}"
             )
 
     def to_dict(self) -> dict:
@@ -222,6 +226,7 @@ class VectorIndexConfig:
             "hnsw": HNSWIndexConfig,
             "dynamic": DynamicIndexConfig,
             "multivector": MultiVectorIndexConfig,
+            "hfresh": HFreshIndexConfig,
         }.get(t, FlatIndexConfig)
         fields = {f.name for f in dataclasses.fields(cls)}
         cfg = cls(**{k: v for k, v in d.items() if k in fields})
@@ -274,6 +279,24 @@ class MultiVectorIndexConfig(VectorIndexConfig):
     dproj: int = 16         # per-bucket projection dims
     repetitions: int = 10
     rescore_limit: int = 0  # candidates for exact MaxSim (0 = 4k)
+
+
+@dataclass
+class HFreshIndexConfig(VectorIndexConfig):
+    """SPFresh-style centroid/posting index (reference
+    ``vector/hfresh/config.go``): postings split above max_posting_size,
+    merge below min_posting_size, searches probe search_probe postings."""
+
+    index_type: str = "hfresh"
+    max_posting_size: int = 128
+    min_posting_size: int = 8
+    search_probe: int = 8
+    # SPFresh boundary replication: a vector joins up to `replicas`
+    # postings whose centroid distance is within rng_factor x the nearest
+    # (reference hfresh.go `replicas`/`rngFactor`) — recall insurance for
+    # vectors near posting boundaries
+    replicas: int = 2
+    rng_factor: float = 2.0
 
 
 @dataclass
