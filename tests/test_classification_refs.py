@@ -1,0 +1,191 @@
+"""Classification, reference filters, GraphQL Explore.
+
+Reference test models: ``usecases/classification/classifier_test.go``
+(knn + zeroshot), ``filters`` ref-path tests, ``get_explore`` traverser
+tests.
+"""
+
+import json
+import shutil
+import tempfile
+import urllib.request
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.schema.config import (
+    CollectionConfig, DataType, FlatIndexConfig, Property,
+)
+from weaviate_tpu.storage.objects import StorageObject
+from weaviate_tpu.usecases.classification import ClassificationManager
+
+
+@pytest.fixture
+def db():
+    tmp = tempfile.mkdtemp()
+    d = DB(tmp)
+    yield d
+    d.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _mk(db, name, props, objs):
+    col = db.create_collection(CollectionConfig(
+        name=name, properties=props,
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32")))
+    col.put_batch(objs)
+    return col
+
+
+def test_knn_classification_fills_labels(db):
+    # two clean clusters: label follows the neighborhood
+    objs = []
+    rng = np.random.default_rng(0)
+    for i in range(20):
+        center = np.zeros(8, np.float32)
+        label = "sports" if i % 2 == 0 else "politics"
+        center[0 if label == "sports" else 4] = 5.0
+        v = center + 0.1 * rng.standard_normal(8).astype(np.float32)
+        props = {"category": label} if i < 16 else {}
+        objs.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Art",
+            properties=props, vector=v))
+    _mk(db, "Art", [Property(name="category", data_type=DataType.TEXT)],
+        objs)
+    mgr = ClassificationManager(db)
+    c = mgr.start("Art", ["category"], kind="knn", k=3)
+    assert c.status == "completed", c.error
+    assert c.counts == {"count": 4, "successful": 4, "failed": 0}
+    col = db.get_collection("Art")
+    for i in range(16, 20):
+        o = col.get(f"00000000-0000-0000-0000-{i:012d}")
+        want = "sports" if i % 2 == 0 else "politics"
+        assert o.properties["category"] == want
+
+
+def test_knn_classification_requires_labeled_data(db):
+    objs = [StorageObject(uuid=f"00000000-0000-0000-0000-{i:012d}",
+                          collection="Empty", properties={},
+                          vector=np.zeros(4, np.float32))
+            for i in range(3)]
+    _mk(db, "Empty", [Property(name="cat", data_type=DataType.TEXT)], objs)
+    mgr = ClassificationManager(db)
+    c = mgr.start("Empty", ["cat"], kind="knn")
+    assert c.status == "failed" and "labeled" in c.error
+
+
+def test_zeroshot_classification_points_at_target(db):
+    cats = [StorageObject(uuid=f"c0000000-0000-0000-0000-{i:012d}",
+                          collection="Category", properties={"name": n},
+                          vector=v.astype(np.float32))
+            for i, (n, v) in enumerate([
+                ("tech", np.eye(1, 8, 0)[0] * 3),
+                ("food", np.eye(1, 8, 4)[0] * 3)])]
+    _mk(db, "Category", [Property(name="name", data_type=DataType.TEXT)],
+        cats)
+    arts = [StorageObject(uuid=f"a0000000-0000-0000-0000-{i:012d}",
+                          collection="Art2", properties={},
+                          vector=(np.eye(1, 8, 0 if i == 0 else 4)[0] * 3
+                                  ).astype(np.float32))
+            for i in range(2)]
+    _mk(db, "Art2", [Property(name="ofCategory",
+                              data_type=DataType.REFERENCE,
+                              target_collection="Category")], arts)
+    mgr = ClassificationManager(db)
+    c = mgr.start("Art2", ["ofCategory"], kind="zeroshot")
+    assert c.status == "completed", c.error
+    col = db.get_collection("Art2")
+    o0 = col.get(arts[0].uuid)
+    assert o0.properties["ofCategory"][0]["beacon"].endswith(cats[0].uuid)
+    o1 = col.get(arts[1].uuid)
+    assert o1.properties["ofCategory"][0]["beacon"].endswith(cats[1].uuid)
+
+
+def test_ref_filter_joins_target_collection(db):
+    pubs = [StorageObject(uuid=f"b0000000-0000-0000-0000-{i:012d}",
+                          collection="Publisher",
+                          properties={"city": c})
+            for i, c in enumerate(["berlin", "tokyo"])]
+    _mk(db, "Publisher", [Property(name="city", data_type=DataType.TEXT)],
+        pubs)
+    arts = []
+    for i in range(6):
+        pub = pubs[i % 2]
+        arts.append(StorageObject(
+            uuid=f"d0000000-0000-0000-0000-{i:012d}", collection="Art3",
+            properties={
+                "title": f"article {i}",
+                "inPublication": [{
+                    "beacon":
+                        f"weaviate://localhost/Publisher/{pub.uuid}"}],
+            },
+            vector=np.eye(1, 8, i % 8, dtype=np.float32)[0]))
+    _mk(db, "Art3", [
+        Property(name="title", data_type=DataType.TEXT),
+        Property(name="inPublication", data_type=DataType.REFERENCE,
+                 target_collection="Publisher"),
+    ], arts)
+
+    from weaviate_tpu.inverted.filters import Filter
+
+    col = db.get_collection("Art3")
+    flt = Filter(operator="Equal",
+                 path=["inPublication", "Publisher", "city"],
+                 value="berlin")
+    rows = col.vector_search(arts[0].vector, k=10, flt=flt)
+    got = {o.uuid for o, _ in rows}
+    want = {a.uuid for i, a in enumerate(arts) if i % 2 == 0}
+    assert got == want
+
+
+def test_graphql_explore_cross_class(db):
+    for name, offset in (("ClsA", 0), ("ClsB", 4)):
+        objs = [StorageObject(
+            uuid=f"{'e' if name == 'ClsA' else 'f'}0000000-0000-0000-0000-{i:012d}",
+            collection=name, properties={},
+            vector=(np.eye(1, 8, offset)[0] * (1.0 + 0.1 * i)
+                    ).astype(np.float32))
+            for i in range(3)]
+        _mk(db, name, [], objs)
+    from weaviate_tpu.api.graphql import GraphQLExecutor
+
+    ex = GraphQLExecutor(db)
+    q = ("{ Explore(nearVector: {vector: [1,0,0,0,0,0,0,0]}, limit: 4) "
+         "{ beacon className distance } }")
+    out = ex.execute(q)
+    assert "errors" not in out, out
+    hits = out["data"]["Explore"]
+    assert len(hits) == 4
+    assert hits[0]["className"] == "ClsA"  # nearest cluster wins
+    assert hits[0]["beacon"].startswith("weaviate://localhost/ClsA/")
+    assert {h["className"] for h in hits} >= {"ClsA"}
+
+
+def test_classification_rest_endpoint(db):
+    from weaviate_tpu.api.rest import RestAPI
+
+    objs = []
+    for i in range(8):
+        label = {"cat": "x"} if i < 6 else {}
+        v = np.zeros(4, np.float32)
+        v[0] = 1.0
+        objs.append(StorageObject(
+            uuid=f"90000000-0000-0000-0000-{i:012d}", collection="R",
+            properties=label, vector=v))
+    _mk(db, "R", [Property(name="cat", data_type=DataType.TEXT)], objs)
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{srv.server_port}/v1"
+    req = urllib.request.Request(
+        base + "/classifications", method="POST",
+        data=json.dumps({"class": "R",
+                         "classifyProperties": ["cat"]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        body = json.loads(r.read())
+    assert body["status"] == "completed"
+    with urllib.request.urlopen(base + f"/classifications/{body['id']}") as r:
+        assert json.loads(r.read())["meta"]["successful"] == 2
+    api.shutdown()

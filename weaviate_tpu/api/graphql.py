@@ -232,12 +232,71 @@ class GraphQLExecutor:
                 elif root.name == "Aggregate":
                     data.setdefault("Aggregate", {}).update(self._aggregate(root))
                 elif root.name == "Explore":
-                    raise GraphQLError("Explore: not supported yet")
+                    data["Explore"] = self._explore(root)
                 else:
                     raise GraphQLError(f"unknown root field {root.name!r}")
             return {"data": data}
         except (GraphQLError, KeyError, ValueError, TypeError) as e:
             return {"errors": [{"message": str(e)}]}
+
+    # -- Explore ------------------------------------------------------------
+    def _explore(self, root: Field) -> list[dict]:
+        """Cross-class exploration (reference ``traverser.Explore``,
+        ``get_explore.go``): one nearVector/nearObject query fans out over
+        EVERY collection; hits come back as beacons with class names,
+        merged by distance. Only collections whose default vector dims
+        match the query participate (the reference requires a shared
+        vectorizer space; dims are the structural equivalent here)."""
+        args = root.args
+        limit = int(args.get("limit", 20) or 20)
+        vec = None
+        if "nearVector" in args:
+            vec = np.asarray(args["nearVector"]["vector"], np.float32)
+        elif "nearObject" in args:
+            no = args["nearObject"]
+            for name in self.db.collections():
+                col = self.db.get_collection(name)
+                if col.config.multi_tenancy.enabled:
+                    continue  # tenant-scoped lookups need a tenant
+                try:
+                    obj = col.get(no["id"])
+                except (KeyError, ValueError):
+                    continue
+                if obj is not None and obj.vector is not None:
+                    vec = obj.vector
+                    break
+            if vec is None:
+                raise GraphQLError(
+                    f"nearObject: {no.get('id')!r} not found")
+        if vec is None:
+            raise GraphQLError("Explore requires nearVector or nearObject")
+        wanted = {f.name for f in root.selections} or {
+            "beacon", "className", "distance", "certainty"}
+        merged: list[tuple[float, str, str]] = []
+        for name in self.db.collections():
+            col = self.db.get_collection(name)
+            if col.config.multi_tenancy.enabled:
+                continue  # tenant-scoped classes need a tenant: skip
+            try:
+                rows = col.vector_search(vec, k=limit)
+            except (ValueError, KeyError):
+                continue  # dims mismatch / no vector index: not explorable
+            for obj, d in rows:
+                merged.append((float(d), name, obj.uuid))
+        merged.sort(key=lambda t: t[0])
+        out = []
+        for d, cls, uuid in merged[:limit]:
+            row = {}
+            if "beacon" in wanted:
+                row["beacon"] = f"weaviate://localhost/{cls}/{uuid}"
+            if "className" in wanted:
+                row["className"] = cls
+            if "distance" in wanted:
+                row["distance"] = d
+            if "certainty" in wanted:
+                row["certainty"] = max(0.0, 1.0 - d / 2.0)
+            out.append(row)
+        return out
 
     # -- Get ---------------------------------------------------------------
     def _get(self, root: Field) -> dict:

@@ -178,6 +178,10 @@ class RestAPI:
                  methods=["POST"]),
             Rule("/v1/authz/users/<user>/roles", endpoint="authz_user_roles",
                  methods=["GET"]),
+            Rule("/v1/classifications", endpoint="classifications",
+                 methods=["POST"]),
+            Rule("/v1/classifications/<cid>", endpoint="classification",
+                 methods=["GET"]),
             # debug/ops plane (reference adapters/handlers/debug + runtime
             # config + telemetry inspection)
             Rule("/v1/debug/traces", endpoint="debug_traces",
@@ -190,6 +194,11 @@ class RestAPI:
                  methods=["POST"]),
         ])
         self.telemeter = None  # attached by server.py when enabled
+        # eager: a lazy per-request init would race two first requests into
+        # two managers, orphaning one run's id
+        from weaviate_tpu.usecases.classification import ClassificationManager
+
+        self._classifications = ClassificationManager(db)
         self._server = None
         self._thread = None
 
@@ -498,6 +507,34 @@ class RestAPI:
         return _json_response(self.graphql.execute(query))
 
     # -- metrics -----------------------------------------------------------
+    # -- classifications (reference adapters/handlers/rest classifications,
+    # usecases/classification) --------------------------------------------
+    def on_classifications(self, request):
+        body = self._body(request)
+        cls = body.get("class")
+        if not cls:
+            _abort(422, "class required")
+        self._authz(request, "update_data", f"collections/{cls}")
+        try:
+            c = self._classifications.start(
+                collection=cls,
+                classify_properties=body.get("classifyProperties", []),
+                based_on_properties=body.get("basedOnProperties", []),
+                kind=body.get("type", "knn"),
+                k=int(body.get("settings", {}).get("k", 3)),
+                background=request.args.get("async") == "true",
+            )
+        except (KeyError, ValueError) as e:
+            _abort(422, str(e))
+        return _json_response(c.to_dict(), 201)
+
+    def on_classification(self, request, cid):
+        self._authz(request, "read_data", "classifications")
+        c = self._classifications.get(cid)
+        if c is None:
+            _abort(404, f"classification {cid} not found")
+        return _json_response(c.to_dict())
+
     # -- debug/ops plane ---------------------------------------------------
     def on_debug_traces(self, request):
         from weaviate_tpu.monitoring.tracing import TRACER

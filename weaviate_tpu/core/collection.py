@@ -30,11 +30,12 @@ TENANT_FROZEN = "FROZEN"
 
 class Collection:
     def __init__(self, dirpath: str, config: CollectionConfig, sync_writes: bool = False,
-                 modules=None):
+                 modules=None, db=None):
         self.dir = dirpath
         self.config = config
         self.sync_writes = sync_writes
         self.modules = modules
+        self.db = db  # back-ref for cross-collection ops (ref-filters)
         os.makedirs(dirpath, exist_ok=True)
         self._lock = threading.RLock()
         self._shards: dict[str, Shard] = {}
@@ -88,8 +89,46 @@ class Collection:
                     name=name,
                     sync_writes=self.sync_writes,
                 )
+                # cross-collection ref-filter hook (reference
+                # inverted/searcher.go ref-filter recursion)
+                s.inverted.ref_resolver = self._resolve_ref_filter
                 self._shards[name] = s
             return s
+
+    def _resolve_ref_filter(self, inv, flt, space: int):
+        """Leaf with path [refProp, TargetClass, ...rest]: evaluate the
+        tail on the target collection, then mask source docs whose beacons
+        point at an allowed target (reference ref-filter join)."""
+        import numpy as np
+
+        from weaviate_tpu.inverted.filters import Filter
+
+        ref_prop, target_cls = flt.path[0], flt.path[1]
+        if self.db is None:
+            raise ValueError("ref filters need a DB-attached collection")
+        target = self.db.get_collection(target_cls)
+        inner = Filter(operator=flt.operator, path=list(flt.path[2:]),
+                       value=flt.value, operands=flt.operands)
+        allowed_uuids: set[str] = set()
+        for shard in target._search_shards():
+            mask = shard.allow_list(inner)
+            for docid in np.nonzero(mask)[0]:
+                o = shard.get_by_docid(int(docid))
+                if o is not None:
+                    allowed_uuids.add(o.uuid)
+        out = np.zeros(space, bool)
+        vals = inv.values.get(ref_prop, {})
+        for docid, v in vals.items():
+            if docid >= space:
+                continue
+            beacons = v if isinstance(v, list) else [v]
+            for b in beacons:
+                u = (b.get("beacon", "").rsplit("/", 1)[-1]
+                     if isinstance(b, dict) else str(b))
+                if u in allowed_uuids:
+                    out[docid] = True
+                    break
+        return out
 
     def _shard_for_uuid(self, uuid: str) -> Shard:
         n = max(1, self.config.sharding.desired_count)
@@ -135,12 +174,20 @@ class Collection:
             self._persist_tenant_status()
 
     def remove_tenant(self, name: str) -> None:
+        import shutil
+
         with self._lock:
             self._tenant_status.pop(name, None)
             self._persist_tenant_status()
             s = self._shards.pop(f"tenant-{name}", None)
             if s is not None:
                 s.close()
+            # data retention: BOTH tiers go — a lingering frozen copy could
+            # resurrect deleted data under a recreated tenant name
+            shutil.rmtree(os.path.join(self.dir, f"tenant-{name}"),
+                          ignore_errors=True)
+            shutil.rmtree(os.path.join(self._offload_root(), name),
+                          ignore_errors=True)
 
     def reindex_inverted(self) -> int:
         """Rebuild every open shard's inverted index (reference

@@ -68,6 +68,7 @@ class DB:
             self._collections[cfg.name] = Collection(
                 os.path.join(self.root, cfg.name), cfg,
                 sync_writes=self.sync_writes, modules=self.modules,
+                db=self,
             )
 
     def _persist_schema(self) -> None:
@@ -90,6 +91,7 @@ class DB:
                 config,
                 sync_writes=self.sync_writes,
                 modules=self.modules,
+                db=self,
             )
             self._collections[config.name] = c
             self._persist_schema()

@@ -113,7 +113,7 @@ class HFreshIndex(VectorIndex):
         for row, sel in appends.items():
             self._postings[row] = np.concatenate(
                 [self._postings[row], np.asarray(sel, np.int64)])
-        self._maintain()
+        self._maintain(set(appends))
 
     def delete(self, doc_ids: np.ndarray) -> None:
         doc_ids = np.asarray(doc_ids).reshape(-1)
@@ -134,16 +134,31 @@ class HFreshIndex(VectorIndex):
         self._postings[row] = ids
         return ids
 
-    def _maintain(self) -> None:
-        row = 0
-        while row < len(self._postings):
+    def _maintain(self, touched: Optional[set] = None) -> None:
+        """Split/merge pass over the postings the current batch touched
+        (plus rows created by its own splits) — insert cost stays O(batch),
+        not O(total postings)."""
+        if touched is None:
+            touched = set(range(len(self._postings)))
+        work = sorted(touched)
+        i = 0
+        while i < len(work):
+            row = work[i]
+            i += 1
+            if row >= len(self._postings):
+                continue
+            before = len(self._postings)
             ids = self._live_posting(row)
             if len(ids) > self.config.max_posting_size:
                 self._split(row)
-            row += 1
-        # merge pass: tiny postings fold into their nearest neighbor
+                # a split's children may still be oversized
+                work.extend(range(before, len(self._postings)))
+                if len(self._live_posting(row)) > self.config.max_posting_size:
+                    work.append(row)
         if len(self._postings) > 1:
-            for row in range(len(self._postings) - 1, -1, -1):
+            for row in sorted(touched, reverse=True):
+                if row >= len(self._postings):
+                    continue
                 ids = self._live_posting(row)
                 if 0 < len(ids) < self.config.min_posting_size \
                         and len(self._postings) > 1:
@@ -168,8 +183,11 @@ class HFreshIndex(VectorIndex):
         if (a == 0).all() or (a == 1).all():
             return  # degenerate (duplicate vectors): keep as one posting
         new_row = len(self._postings)
-        self._centroids[row] = c[0]
-        self._centroids = np.vstack([self._centroids, c[1][None]])
+        # copy-on-write: a concurrent search reads the OLD centroid array
+        # outside the lock; in-place row writes would tear under it
+        grown = np.vstack([self._centroids, c[1][None]])
+        grown[row] = c[0]
+        self._centroids = grown
         self._postings[row] = ids[a == 0]
         self._postings.append(ids[a == 1])
         for d_id in ids[a == 1]:
@@ -185,16 +203,18 @@ class HFreshIndex(VectorIndex):
             [self._postings[target], ids])
         for d_id in ids:
             self._doc_posting[int(d_id)] = target
-        # drop row by swapping the last one in (postings + centroids)
+        # drop row by swapping the last one in (postings + centroids);
+        # copy-on-write for the same reason as _split
         last = len(self._postings) - 1
+        shrunk = self._centroids[:last].copy()
         if row != last:
             self._postings[row] = self._postings[last]
-            self._centroids[row] = self._centroids[last]
+            shrunk[row] = self._centroids[last]
             for d_id in self._postings[row]:
                 if self._doc_posting.get(int(d_id)) == last:
                     self._doc_posting[int(d_id)] = row
         self._postings.pop()
-        self._centroids = self._centroids[:last]
+        self._centroids = shrunk
 
     # -- search -------------------------------------------------------------
     def search(self, queries: np.ndarray, k: int,

@@ -59,6 +59,9 @@ class InvertedIndex:
 
         self.columnar = ColumnarProps()
         self.doc_count = 0
+        # cross-collection ref-filter hook, set by the owning Collection
+        # (fn(inv, flt, space) -> mask); None = ref filters unsupported
+        self.ref_resolver = None
 
     # -- schema helpers ---------------------------------------------------
     def _prop_schema(self, name: str):
@@ -291,6 +294,20 @@ class InvertedIndex:
             return m
         if op == "Not":
             return ~self._eval(flt.operands[0], space)
+
+        # ref filter: path [refProp, TargetClass, ...tail] joins through
+        # the target collection (reference searcher.go ref recursion).
+        # Disambiguated by SCHEMA, not naming convention: the head segment
+        # must be a REFERENCE property (a nested prop path never is).
+        if flt.path is not None and len(flt.path) >= 3:
+            head = self._prop_schema(flt.path[0])
+            if head is not None and (
+                    head.data_type == DataType.REFERENCE
+                    or head.target_collection):
+                if self.ref_resolver is None:
+                    raise ValueError(
+                        "reference filters need a collection-attached index")
+                return self.ref_resolver(self, flt, space)
 
         # leaf: vectorized columnar evaluation (reference searcher.go ->
         # AllowList; here numpy columns instead of roaring segments)

@@ -61,6 +61,9 @@ class Property:
     index_range_filters: bool = False
     description: str = ""
     nested: list["Property"] = field(default_factory=list)
+    # for data_type REFERENCE (cref): the class the beacons point at
+    # (reference dataType=["TargetClass"] form)
+    target_collection: str = ""
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)

@@ -1,0 +1,1 @@
+"""Use-case layer: operations composed over the DB (reference usecases/)."""

@@ -1,0 +1,209 @@
+"""Classification: fill property values from vector neighborhoods.
+
+Reference: ``usecases/classification/`` — POST /v1/classifications starts a
+background run that finds unlabeled objects (classifyProperties unset) and
+writes predicted values:
+
+- ``knn``: majority vote over the k nearest LABELED objects
+  (``classifier_run_knn.go``)
+- ``zeroshot``: nearest object in the TARGET class of a reference
+  property; the winning target's uuid becomes the ref value
+  (``classifier_run_zeroshot.go``)
+
+TPU-first: the reference classifies object-by-object in worker goroutines;
+here ALL unlabeled objects' vectors go to the device as one query batch —
+classification is literally one batched vector search plus a host vote.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as uuidlib
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+
+@dataclass
+class Classification:
+    id: str
+    collection: str
+    classify_properties: list[str]
+    based_on_properties: list[str]  # informational (vectors drive knn)
+    type: str = "knn"  # knn | zeroshot
+    k: int = 3
+    status: str = "running"  # running | completed | failed
+    error: str = ""
+    counts: dict = field(default_factory=lambda: {
+        "count": 0, "successful": 0, "failed": 0})
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "class": self.collection,
+            "classifyProperties": self.classify_properties,
+            "basedOnProperties": self.based_on_properties,
+            "type": self.type,
+            "status": self.status,
+            "error": self.error or None,
+            "meta": dict(self.counts),
+        }
+
+
+class ClassificationManager:
+    def __init__(self, db):
+        self.db = db
+        self._runs: dict[str, Classification] = {}
+        self._lock = threading.Lock()
+
+    def get(self, cid: str) -> Optional[Classification]:
+        with self._lock:
+            return self._runs.get(cid)
+
+    def start(self, collection: str, classify_properties: list[str],
+              based_on_properties: Optional[list[str]] = None,
+              kind: str = "knn", k: int = 3,
+              background: bool = False) -> Classification:
+        if kind not in ("knn", "zeroshot"):
+            raise ValueError(f"unknown classification type {kind!r}")
+        col = self.db.get_collection(collection)  # raises on unknown class
+        for p in classify_properties:
+            if col.config.property(p) is None:
+                raise ValueError(f"unknown classify property {p!r}")
+        c = Classification(
+            id=str(uuidlib.uuid4()), collection=collection,
+            classify_properties=list(classify_properties),
+            based_on_properties=list(based_on_properties or []),
+            type=kind, k=k)
+        with self._lock:
+            self._runs[c.id] = c
+        if background:
+            threading.Thread(target=self._run, args=(c,), daemon=True).start()
+        else:
+            self._run(c)
+        return c
+
+    # -- the run -----------------------------------------------------------
+    def _run(self, c: Classification) -> None:
+        try:
+            if c.type == "knn":
+                self._run_knn(c)
+            else:
+                self._run_zeroshot(c)
+            c.status = "completed"
+        except Exception as e:  # surfaced in status, like the reference
+            c.status = "failed"
+            c.error = str(e)
+
+    def _split_labeled(self, col, props: list[str]):
+        labeled, unlabeled = [], []
+        for shard in col._search_shards():
+            for _k, raw in shard.objects.items():
+                from weaviate_tpu.storage.objects import StorageObject
+
+                o = StorageObject.from_bytes(raw)
+                if o.vector is None:
+                    continue
+                if all(o.properties.get(p) is not None for p in props):
+                    labeled.append(o)
+                else:
+                    unlabeled.append(o)
+        return labeled, unlabeled
+
+    def _run_knn(self, c: Classification) -> None:
+        col = self.db.get_collection(c.collection)
+        labeled, unlabeled = self._split_labeled(col, c.classify_properties)
+        c.counts["count"] = len(unlabeled)
+        if not unlabeled:
+            return
+        if not labeled:
+            raise ValueError("no labeled objects to learn from")
+
+        # the reference takes the k nearest LABELED objects — restrict the
+        # search to labeled docs via per-shard allow masks (an over-fetch
+        # heuristic would fail inside unlabeled clusters), still ONE device
+        # batch per shard for every unlabeled object
+        queries = np.stack([o.vector for o in unlabeled]).astype(np.float32)
+        labeled_by_shard: dict[int, set[int]] = {}
+        per_query: list[list[tuple[float, Any]]] = [[] for _ in unlabeled]
+        for shard in col._search_shards():
+            labeled_ids = set()
+            for o in labeled:
+                s = shard.get_by_uuid(o.uuid)
+                if s is not None:
+                    labeled_ids.add(s.doc_id)
+            if not labeled_ids:
+                continue
+            space = max(shard._next_doc_id, 1)
+            allow = np.zeros(space, bool)
+            allow[list(labeled_ids)] = True
+            res = shard.vector_search(queries, c.k, allow_list=allow)
+            for qi in range(len(unlabeled)):
+                for d, i in zip(res.dists[qi], res.ids[qi]):
+                    if i >= 0:
+                        obj = shard.get_by_docid(int(i))
+                        if obj is not None:
+                            per_query[qi].append((float(d), obj))
+        updated = []
+        for o, cands in zip(unlabeled, per_query):
+            cands.sort(key=lambda t: t[0])
+            votes: dict[str, Counter] = {p: Counter()
+                                         for p in c.classify_properties}
+            for _d, hit in cands[: c.k]:
+                for p in c.classify_properties:
+                    v = hit.properties.get(p)
+                    if v is not None:
+                        votes[p][_vote_key(v)] += 1
+            ok = False
+            for p in c.classify_properties:
+                if votes[p]:
+                    o.properties[p] = votes[p].most_common(1)[0][0]
+                    ok = True
+            if ok:
+                updated.append(o)
+                c.counts["successful"] += 1
+            else:
+                c.counts["failed"] += 1
+        if updated:
+            col.put_batch(updated)
+
+    def _run_zeroshot(self, c: Classification) -> None:
+        """Ref properties: point each unlabeled object at the nearest object
+        of the property's target collection (no training data needed)."""
+        col = self.db.get_collection(c.collection)
+        labeled, unlabeled = self._split_labeled(col, c.classify_properties)
+        c.counts["count"] = len(unlabeled)
+        if not unlabeled:
+            return
+        queries = np.stack([o.vector for o in unlabeled]).astype(np.float32)
+        assigned = [False] * len(unlabeled)
+        for p in c.classify_properties:
+            prop = col.config.property(p)
+            target_cls = (prop.target_collection
+                          if prop is not None else None)
+            if not target_cls:
+                raise ValueError(
+                    f"zeroshot requires a reference property with a target "
+                    f"collection; {p!r} has none")
+            target = self.db.get_collection(target_cls)
+            rows = target.vector_search_batch(queries, k=1)
+            for qi, (o, row) in enumerate(zip(unlabeled, rows)):
+                if row:
+                    o.properties[p] = [{
+                        "beacon":
+                            f"weaviate://localhost/{target_cls}/{row[0][0].uuid}"
+                    }]
+                    assigned[qi] = True
+        # counts are per OBJECT (meta.count is), not per (property, object)
+        c.counts["successful"] = sum(assigned)
+        c.counts["failed"] = len(unlabeled) - sum(assigned)
+        col.put_batch(unlabeled)
+
+
+def _vote_key(v: Any):
+    if isinstance(v, list):
+        return tuple(v)
+    return v
