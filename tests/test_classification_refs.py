@@ -189,3 +189,57 @@ def test_classification_rest_endpoint(db):
     with urllib.request.urlopen(base + f"/classifications/{body['id']}") as r:
         assert json.loads(r.read())["meta"]["successful"] == 2
     api.shutdown()
+
+
+def test_classification_null_settings_and_partial_labels(db):
+    """settings:null must not 500 (serializers emit null for {}), and a
+    partially labeled object only gets its UNSET properties filled."""
+    from weaviate_tpu.api.rest import RestAPI
+
+    objs = []
+    for i in range(6):
+        v = np.zeros(4, np.float32)
+        v[0] = 1.0
+        objs.append(StorageObject(
+            uuid=f"91000000-0000-0000-0000-{i:012d}", collection="P",
+            properties={"cat": "sports", "tag": "ball"}, vector=v))
+    # partially labeled: human-set cat must survive, tag gets filled
+    v = np.zeros(4, np.float32)
+    v[0] = 1.0
+    objs.append(StorageObject(
+        uuid="91000000-0000-0000-0000-999999999999", collection="P",
+        properties={"cat": "politics"}, vector=v))
+    _mk(db, "P", [Property(name="cat", data_type=DataType.TEXT),
+                  Property(name="tag", data_type=DataType.TEXT)], objs)
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{srv.server_port}/v1"
+    req = urllib.request.Request(
+        base + "/classifications", method="POST",
+        data=json.dumps({"class": "P",
+                         "classifyProperties": ["cat", "tag"],
+                         "settings": None}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read())["status"] == "completed"
+    col = db.get_collection("P")
+    obj = col.get("91000000-0000-0000-0000-999999999999")
+    assert obj.properties["cat"] == "politics"  # human label untouched
+    assert obj.properties["tag"] == "ball"      # unset prop filled by vote
+    api.shutdown()
+
+
+def test_rest_schema_reference_carries_target_collection():
+    """dataType=["Target"] through class_from_rest keeps the target class so
+    zeroshot/ref-filters can resolve it (reference crossref dataType)."""
+    from weaviate_tpu.api.schema_translate import class_from_rest
+    from weaviate_tpu.schema.config import DataType as DT
+
+    cfg = class_from_rest({
+        "class": "Src",
+        "properties": [{"name": "toCat", "dataType": ["Category"]},
+                       {"name": "title", "dataType": ["text"]}],
+    })
+    ref = next(p for p in cfg.properties if p.name == "toCat")
+    assert ref.data_type == DT.REFERENCE
+    assert ref.target_collection == "Category"

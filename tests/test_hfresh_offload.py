@@ -172,3 +172,16 @@ def test_frozen_tenant_offloads_files_and_onloads_back(tmp_path, monkeypatch):
     assert hits and hits[0][0].properties["t"] == "doc 3"
     assert col.count(tenant="acme") == 10
     db.close()
+
+
+def test_hfresh_degenerate_duplicate_vectors_terminate():
+    """An oversized posting of identical vectors cannot be split (2-means is
+    degenerate); _maintain must not re-queue it forever."""
+    d = 8
+    idx = HFreshIndex(d, HFreshIndexConfig(
+        distance="l2-squared", max_posting_size=16, search_probe=2))
+    dup = np.ones((100, d), np.float32)
+    idx.add_batch(np.arange(100, dtype=np.int64), dup)  # must return
+    assert idx.count() == 100
+    res = idx.search(np.ones((1, d), np.float32), 5)
+    assert (res.ids[0] >= 0).all()

@@ -281,11 +281,12 @@ class GraphQLExecutor:
                 rows = col.vector_search(vec, k=limit)
             except (ValueError, KeyError):
                 continue  # dims mismatch / no vector index: not explorable
+            cosine = col.config.vector_config.distance == "cosine"
             for obj, d in rows:
-                merged.append((float(d), name, obj.uuid))
+                merged.append((float(d), name, obj.uuid, cosine))
         merged.sort(key=lambda t: t[0])
         out = []
-        for d, cls, uuid in merged[:limit]:
+        for d, cls, uuid, cosine in merged[:limit]:
             row = {}
             if "beacon" in wanted:
                 row["beacon"] = f"weaviate://localhost/{cls}/{uuid}"
@@ -293,7 +294,10 @@ class GraphQLExecutor:
                 row["className"] = cls
             if "distance" in wanted:
                 row["distance"] = d
-            if "certainty" in wanted:
+            if "certainty" in wanted and cosine:
+                # certainty is only defined for cosine (reference
+                # additional/certainty); other metrics omit the field
+                # rather than emit a meaningless 1 - d/2
                 row["certainty"] = max(0.0, 1.0 - d / 2.0)
             out.append(row)
         return out

@@ -313,7 +313,10 @@ class RestAPI:
             data_type = DataType(dt0)
         except ValueError:
             data_type = DataType.REFERENCE if dt0 and dt0[0].isupper() else DataType.TEXT
-        prop = Property(name=body["name"], data_type=data_type)
+        prop = Property(
+            name=body["name"], data_type=data_type,
+            target_collection=(
+                dt0 if data_type == DataType.REFERENCE else ""))
         try:
             self.db.add_property(cls, prop)
         except (KeyError, ValueError) as e:
@@ -521,7 +524,7 @@ class RestAPI:
                 classify_properties=body.get("classifyProperties", []),
                 based_on_properties=body.get("basedOnProperties", []),
                 kind=body.get("type", "knn"),
-                k=int(body.get("settings", {}).get("k", 3)),
+                k=int((body.get("settings") or {}).get("k", 3)),
                 background=request.args.get("async") == "true",
             )
         except (KeyError, ValueError) as e:

@@ -106,6 +106,11 @@ def class_from_rest(d: dict) -> CollectionConfig:
                 data_type in (DataType.TEXT, DataType.TEXT_ARRAY),
             ),
             description=p.get("description", ""),
+            # cross-refs carry the target class in dataType[0]
+            # (reference entities/schema crossref); classification and
+            # ref-filters need it back out of the schema
+            target_collection=(
+                dt0 if data_type == DataType.REFERENCE else ""),
         ))
 
     vic = d.get("vectorIndexConfig", {}) or {}

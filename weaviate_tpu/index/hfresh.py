@@ -153,7 +153,11 @@ class HFreshIndex(VectorIndex):
                 self._split(row)
                 # a split's children may still be oversized
                 work.extend(range(before, len(self._postings)))
-                if len(self._live_posting(row)) > self.config.max_posting_size:
+                # re-queue only if the split made progress: a degenerate
+                # posting (duplicate vectors) stays oversized forever and
+                # re-appending it would spin _maintain without terminating
+                after = len(self._live_posting(row))
+                if self.config.max_posting_size < after < len(ids):
                     work.append(row)
         if len(self._postings) > 1:
             for row in sorted(touched, reverse=True):

@@ -127,7 +127,6 @@ class ClassificationManager:
         # heuristic would fail inside unlabeled clusters), still ONE device
         # batch per shard for every unlabeled object
         queries = np.stack([o.vector for o in unlabeled]).astype(np.float32)
-        labeled_by_shard: dict[int, set[int]] = {}
         per_query: list[list[tuple[float, Any]]] = [[] for _ in unlabeled]
         for shard in col._search_shards():
             labeled_ids = set()
@@ -159,7 +158,11 @@ class ClassificationManager:
                         votes[p][_vote_key(v)] += 1
             ok = False
             for p in c.classify_properties:
-                if votes[p]:
+                # fill only UNSET properties: a partially labeled object
+                # lands in `unlabeled`, but its human-set values must not
+                # be overwritten by the vote (the reference classifier
+                # only writes nil properties)
+                if votes[p] and o.properties.get(p) is None:
                     o.properties[p] = votes[p].most_common(1)[0][0]
                     ok = True
             if ok:
