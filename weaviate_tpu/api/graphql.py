@@ -22,12 +22,15 @@ import numpy as np
 
 from weaviate_tpu.inverted.filters import Filter
 from weaviate_tpu.query import (
+    AskParams,
     Explorer,
     GenerateParams,
     GroupByParams,
     HybridParams,
     QueryParams,
     RerankParams,
+    SummaryParams,
+    TokenParams,
 )
 
 # ---------------------------------------------------------------------------
@@ -345,6 +348,22 @@ class GraphQLExecutor:
         if "bm25" in args:
             p.bm25_query = args["bm25"].get("query", "")
             p.bm25_properties = args["bm25"].get("properties")
+        if "ask" in args:
+            a = args["ask"]
+            p.ask = AskParams(
+                question=a.get("question", ""),
+                properties=a.get("properties"),
+                certainty=float(a.get("certainty", 0.0)),
+            )
+            if p.near_vector is None and p.near_text is None \
+                    and p.bm25_query is None and p.hybrid is None:
+                # reference qna providers search by the question text
+                p.near_text = p.ask.question
+            if a.get("autocorrect"):
+                p.autocorrect = True
+        for key in ("nearText", "bm25"):
+            if key in args and args[key].get("autocorrect"):
+                p.autocorrect = True
         if "hybrid" in args:
             h = args["hybrid"]
             p.hybrid = HybridParams(
@@ -391,6 +410,18 @@ class GraphQLExecutor:
                         params.rerank = RerankParams(
                             query=sub.args.get("query", ""),
                             property=sub.args.get("property", ""),
+                        )
+                    elif sub.name == "summary":
+                        props = sub.args.get("properties", [])
+                        params.summary = SummaryParams(
+                            properties=props if isinstance(props, list)
+                            else [props])
+                    elif sub.name == "tokens":
+                        props = sub.args.get("properties", [])
+                        params.tokens = TokenParams(
+                            properties=props if isinstance(props, list)
+                            else [props],
+                            certainty=float(sub.args.get("certainty", 0.0)),
                         )
 
         result = self.explorer.get(params)
@@ -453,6 +484,26 @@ class GraphQLExecutor:
                         add["rerank"] = [{"score": extra["rerank_score"]}]
                     elif sub.name == "group" and extra and "group" in extra:
                         add["group"] = extra["group"]
+                    elif sub.name == "answer" and extra and "answer" in extra:
+                        a = extra["answer"]
+                        add["answer"] = {
+                            "result": a.get("answer"),
+                            "certainty": a.get("certainty"),
+                            "startPosition": a.get("start"),
+                            "endPosition": a.get("end"),
+                            "hasAnswer": a.get("answer") is not None,
+                        }
+                    elif sub.name == "summary" and extra and "summary" in extra:
+                        add["summary"] = extra["summary"]
+                    elif sub.name == "tokens" and extra and "tokens" in extra:
+                        add["tokens"] = [
+                            {"entity": t.get("entity"),
+                             "word": t.get("word"),
+                             "property": t.get("property"),
+                             "startPosition": t.get("start"),
+                             "endPosition": t.get("end"),
+                             "certainty": t.get("certainty")}
+                            for t in extra["tokens"]]
                 row["_additional"] = add
             else:
                 row[sel.name] = obj.properties.get(sel.name)

@@ -304,19 +304,10 @@ class RestAPI:
 
     def on_schema_properties(self, request, cls):
         self._authz(request, "update_schema", f"collections/{cls}")
-        from weaviate_tpu.schema.config import DataType, Property
+        from weaviate_tpu.api.schema_translate import property_from_rest
 
         body = self._body(request)
-        dt = body.get("dataType", ["text"])
-        dt0 = dt[0] if isinstance(dt, list) else dt
-        try:
-            data_type = DataType(dt0)
-        except ValueError:
-            data_type = DataType.REFERENCE if dt0 and dt0[0].isupper() else DataType.TEXT
-        prop = Property(
-            name=body["name"], data_type=data_type,
-            target_collection=(
-                dt0 if data_type == DataType.REFERENCE else ""))
+        prop = property_from_rest(body)
         try:
             self.db.add_property(cls, prop)
         except (KeyError, ValueError) as e:

@@ -76,42 +76,46 @@ def _vector_index_from_rest(index_type: str, cfg: dict) -> VectorIndexConfig:
     return VectorIndexConfig.from_dict(d)
 
 
+def property_from_rest(p: dict) -> Property:
+    """Weaviate-style property JSON → Property. Cross-refs carry the target
+    class in dataType[0] (reference entities/schema crossref); classification
+    and ref-filters need it back out of the schema. Shared by schema create
+    and add-property so reference handling cannot drift."""
+    dt = p.get("dataType", ["text"])
+    dt0 = dt[0] if isinstance(dt, list) else dt
+    try:
+        data_type = DataType(dt0)
+    except ValueError:
+        # cross-references are typed by class name in the reference
+        data_type = (DataType.REFERENCE if dt0 and dt0[0].isupper()
+                     else DataType.TEXT)
+    tok = p.get("tokenization", "word")
+    try:
+        tokenization = Tokenization(tok)
+    except ValueError:
+        tokenization = Tokenization.WORD
+    return Property(
+        name=p["name"],
+        data_type=data_type,
+        tokenization=tokenization,
+        index_filterable=p.get("indexFilterable", True),
+        index_searchable=p.get(
+            "indexSearchable",
+            data_type in (DataType.TEXT, DataType.TEXT_ARRAY),
+        ),
+        description=p.get("description", ""),
+        target_collection=(
+            dt0 if data_type == DataType.REFERENCE else ""),
+    )
+
+
 def class_from_rest(d: dict) -> CollectionConfig:
     """Weaviate-style class JSON → CollectionConfig. Also accepts the
     internal ``to_dict`` shape (round-trip)."""
     if "name" in d and "class" not in d:
         return CollectionConfig.from_dict(d)
 
-    props = []
-    for p in d.get("properties", []) or []:
-        dt = p.get("dataType", ["text"])
-        dt0 = dt[0] if isinstance(dt, list) else dt
-        try:
-            data_type = DataType(dt0)
-        except ValueError:
-            # cross-references are typed by class name in the reference
-            data_type = DataType.REFERENCE if dt0 and dt0[0].isupper() else DataType.TEXT
-        tok = p.get("tokenization", "word")
-        try:
-            tokenization = Tokenization(tok)
-        except ValueError:
-            tokenization = Tokenization.WORD
-        props.append(Property(
-            name=p["name"],
-            data_type=data_type,
-            tokenization=tokenization,
-            index_filterable=p.get("indexFilterable", True),
-            index_searchable=p.get(
-                "indexSearchable",
-                data_type in (DataType.TEXT, DataType.TEXT_ARRAY),
-            ),
-            description=p.get("description", ""),
-            # cross-refs carry the target class in dataType[0]
-            # (reference entities/schema crossref); classification and
-            # ref-filters need it back out of the schema
-            target_collection=(
-                dt0 if data_type == DataType.REFERENCE else ""),
-        ))
+    props = [property_from_rest(p) for p in d.get("properties", []) or []]
 
     vic = d.get("vectorIndexConfig", {}) or {}
     vec_cfg = _vector_index_from_rest(d.get("vectorIndexType", "hnsw"), vic)

@@ -289,7 +289,43 @@ class Collection:
                 o.vector = module.centroid(refs)
             return
         vec = self.modules.vectorizer(name)
-        texts = [vec.texts_from_object(o.properties) for o in todo]
+        from weaviate_tpu.modules.base import MultiModalVectorizer
+
+        blob_props = [p.name for p in self.config.properties
+                      if p.data_type.value == "blob"]
+        if isinstance(vec, MultiModalVectorizer):
+            # multi2vec: fuse text and image (blob prop) vectors per object
+            # (reference multi2vec CalculateVector weighted average). Blob
+            # values are base64 strings and must NOT reach the text pass;
+            # media batches across the whole todo list like the text path.
+            texts, images = [], []
+            text_of, imgs_of = {}, {}
+            for i, o in enumerate(todo):
+                props = {k: v for k, v in o.properties.items()
+                         if k not in blob_props}
+                t = vec.texts_from_object(props)
+                if t.strip():
+                    text_of[i] = len(texts)
+                    texts.append(t)
+                imgs_of[i] = []
+                for bp in blob_props:
+                    b = o.properties.get(bp)
+                    if isinstance(b, str) and b:
+                        imgs_of[i].append(len(images))
+                        images.append(b)
+            tvecs = vec.vectorize(texts) if texts else None
+            ivecs = vec.vectorize_image(images) if images else None
+            for i, o in enumerate(todo):
+                parts = []
+                if i in text_of:
+                    parts.append(tvecs[text_of[i]])
+                parts.extend(ivecs[j] for j in imgs_of[i])
+                if parts:
+                    o.vector = vec.fuse(parts)
+            return
+        texts = [vec.texts_from_object(
+            {k: v for k, v in o.properties.items() if k not in blob_props})
+            for o in todo]
         embedded = vec.vectorize(texts)
         for o, v in zip(todo, embedded):
             o.vector = np.asarray(v, np.float32)

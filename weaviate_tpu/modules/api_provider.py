@@ -1,0 +1,325 @@
+"""Shared HTTP inference-provider plumbing for the module ecosystem.
+
+Reference: the bulk of ``modules/`` (text2vec-openai, generative-cohere, …)
+are thin HTTP clients around hosted or self-hosted inference APIs, built on
+shared client plumbing in ``usecases/modulecomponents`` (batch vectorizer,
+rate limits, key propagation). This module is the equivalent surface,
+table-driven instead of one package per provider:
+
+- a ``Transport`` callable (url, headers, payload) -> parsed JSON, so tests
+  inject a fake and zero-egress deployments fail with ``ModuleNotAvailable``
+  instead of a socket error buried in a request thread;
+- request/response *styles* (openai, cohere, ollama, google, …) shared by
+  the many providers that clone each other's wire format;
+- ``APIVectorizer`` / ``APIReranker`` / ``APIGenerative`` /
+  ``APIMultiModal`` / ``APIMultiVector`` capability classes parameterized
+  by a ``ProviderSpec`` row (see ``providers.py`` for the catalog).
+
+API keys come from the spec's env var (reference reads the same names, e.g.
+``OPENAI_APIKEY``) or an ``api_key`` entry in ``init()`` config; endpoints
+can be overridden per deployment (reference baseURL class setting).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.modules.base import (
+    Generative,
+    ModuleNotAvailable,
+    MultiModalVectorizer,
+    MultiVectorVectorizer,
+    Reranker,
+    Vectorizer,
+)
+
+Transport = Callable[[str, dict, dict], dict]
+
+
+def urllib_transport(url: str, headers: dict, payload: dict,
+                     timeout: float = 30.0) -> dict:
+    """Default transport. In a zero-egress deployment every call lands in
+    ``ModuleNotAvailable`` with the provider URL, which API handlers map to
+    a clean 422 instead of a 500."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **headers})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise ModuleNotAvailable(f"inference API unreachable: {url}: {e}")
+
+
+@dataclass
+class ProviderSpec:
+    """One provider row: module name + wire format + defaults."""
+
+    name: str                 # module name, e.g. "text2vec-openai"
+    style: str                # wire format key in STYLES
+    endpoint: str             # default URL ({model} substituted)
+    key_env: str = ""         # env var with the API key
+    auth: str = "bearer"      # bearer | x-api-key | header:<Name> | none
+    model: str = ""           # default model
+    dims: int = 0             # embedding dims of the default model
+    extra: dict = field(default_factory=dict)  # style-specific payload knobs
+
+
+class _APIBase:
+    """Config resolution shared by every API-backed capability class."""
+
+    def __init__(self, spec: ProviderSpec,
+                 transport: Optional[Transport] = None):
+        self.spec = spec
+        self.name = spec.name
+        self.transport: Transport = transport or urllib_transport
+        self._cfg: dict = {}
+
+    def init(self, config: Optional[dict] = None) -> None:
+        self._cfg = dict(config or {})
+
+    @property
+    def model(self) -> str:
+        return self._cfg.get("model", self.spec.model)
+
+    def _endpoint(self) -> str:
+        base = (self._cfg.get("baseURL")
+                or os.environ.get(self.spec.name.upper().replace("-", "_")
+                                  + "_ENDPOINT")
+                or self.spec.endpoint)
+        return base.replace("{model}", self.model)
+
+    def _headers(self) -> dict:
+        key = (self._cfg.get("api_key")
+               or (os.environ.get(self.spec.key_env, "")
+                   if self.spec.key_env else ""))
+        if not key:
+            if self.spec.auth == "none":
+                return {}
+            raise ModuleNotAvailable(
+                f"{self.name}: no API key (set {self.spec.key_env or 'api_key'})")
+        if self.spec.auth == "bearer":
+            return {"Authorization": f"Bearer {key}"}
+        if self.spec.auth == "x-api-key":
+            return {"x-api-key": key, "anthropic-version": "2023-06-01"} \
+                if "anthropic" in self.name else {"x-api-key": key}
+        if self.spec.auth.startswith("header:"):
+            return {self.spec.auth.split(":", 1)[1]: key}
+        return {}
+
+    def _call(self, payload: dict) -> dict:
+        return self.transport(self._endpoint(), self._headers(), payload)
+
+
+# ---------------------------------------------------------------------------
+# wire styles: build embed / generate / rerank payloads and parse replies
+# ---------------------------------------------------------------------------
+
+def _f32(rows) -> np.ndarray:
+    return np.asarray(rows, np.float32)
+
+
+def _openai_embed(p: _APIBase, texts: Sequence[str]) -> np.ndarray:
+    out = p._call({"input": list(texts), "model": p.model, **p.spec.extra})
+    data = sorted(out["data"], key=lambda d: d.get("index", 0))
+    return _f32([d["embedding"] for d in data])
+
+
+def _cohere_embed(p: _APIBase, texts: Sequence[str]) -> np.ndarray:
+    out = p._call({"texts": list(texts), "model": p.model,
+                   "input_type": p.spec.extra.get(
+                       "input_type", "search_document")})
+    emb = out["embeddings"]
+    return _f32(emb["float"] if isinstance(emb, dict) else emb)
+
+
+def _hf_embed(p: _APIBase, texts: Sequence[str]) -> np.ndarray:
+    vecs = p._call({"inputs": list(texts),
+                    "options": {"wait_for_model": True}})
+    a = np.asarray(vecs, np.float32)
+    # token-level outputs mean-pool to sentence vectors
+    return a.mean(axis=1) if a.ndim == 3 else a
+
+
+def _ollama_embed(p: _APIBase, texts: Sequence[str]) -> np.ndarray:
+    out = p._call({"model": p.model, "input": list(texts)})
+    return _f32(out["embeddings"])
+
+
+def _google_embed(p: _APIBase, texts: Sequence[str]) -> np.ndarray:
+    out = p._call({"instances": [{"content": t} for t in texts]})
+    return _f32([pr["embeddings"]["values"] for pr in out["predictions"]])
+
+
+def _bedrock_embed(p: _APIBase, texts: Sequence[str]) -> np.ndarray:
+    # reference signs SigV4 via the AWS SDK; here the endpoint must be a
+    # pre-authed proxy/gateway (key still forwarded as bearer)
+    rows = [p._call({"inputText": t})["embedding"] for t in texts]
+    return _f32(rows)
+
+
+def _local_vectorize(p: _APIBase, texts: Sequence[str]) -> np.ndarray:
+    # self-hosted inference container contract (reference
+    # text2vec-transformers/multi2vec-clip sidecars): POST /vectorize
+    rows = [p._call({"text": t})["vector"] for t in texts]
+    return _f32(rows)
+
+
+EMBED_STYLES: dict[str, Callable[[_APIBase, Sequence[str]], np.ndarray]] = {
+    "openai": _openai_embed,
+    "cohere": _cohere_embed,
+    "huggingface": _hf_embed,
+    "ollama": _ollama_embed,
+    "google": _google_embed,
+    "bedrock": _bedrock_embed,
+    "local": _local_vectorize,
+}
+
+
+def _openai_chat(p: _APIBase, prompt: str) -> str:
+    out = p._call({"model": p.model, "messages": [
+        {"role": "user", "content": prompt}], **p.spec.extra})
+    return out["choices"][0]["message"]["content"]
+
+
+def _anthropic_chat(p: _APIBase, prompt: str) -> str:
+    out = p._call({"model": p.model, "max_tokens": 1024,
+                   "messages": [{"role": "user", "content": prompt}]})
+    return "".join(b.get("text", "") for b in out["content"])
+
+
+def _cohere_chat(p: _APIBase, prompt: str) -> str:
+    return p._call({"model": p.model, "message": prompt})["text"]
+
+
+def _ollama_generate(p: _APIBase, prompt: str) -> str:
+    return p._call({"model": p.model, "prompt": prompt,
+                    "stream": False})["response"]
+
+
+def _google_generate(p: _APIBase, prompt: str) -> str:
+    out = p._call({"contents": [{"parts": [{"text": prompt}]}]})
+    return out["candidates"][0]["content"]["parts"][0]["text"]
+
+
+def _bedrock_generate(p: _APIBase, prompt: str) -> str:
+    return p._call({"prompt": prompt})["completion"]
+
+
+GENERATE_STYLES: dict[str, Callable[[_APIBase, str], str]] = {
+    "openai": _openai_chat,
+    "anthropic": _anthropic_chat,
+    "cohere": _cohere_chat,
+    "ollama": _ollama_generate,
+    "google": _google_generate,
+    "bedrock": _bedrock_generate,
+}
+
+
+def _cohere_rerank(p: _APIBase, query: str,
+                   docs: Sequence[str]) -> list[float]:
+    # cohere/voyage/jina share this shape; nvidia's variant returns
+    # "rankings" rows scored by "logit"
+    out = p._call({"model": p.model, "query": query,
+                   "documents": list(docs)})
+    rows = out.get("results") or out.get("data") or out.get("rankings") or []
+    scores = [0.0] * len(docs)
+    for r in rows:
+        scores[int(r["index"])] = float(
+            r.get("relevance_score", r.get("logit", 0.0)))
+    return scores
+
+
+RERANK_STYLES = {"cohere": _cohere_rerank}
+
+
+# ---------------------------------------------------------------------------
+# capability classes
+# ---------------------------------------------------------------------------
+
+class APIVectorizer(_APIBase, Vectorizer):
+    def __init__(self, spec: ProviderSpec,
+                 transport: Optional[Transport] = None):
+        super().__init__(spec, transport)
+        self.dims = spec.dims
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        return EMBED_STYLES[self.spec.style](self, texts)
+
+    def vectorize_query(self, text: str) -> np.ndarray:
+        if self.spec.style == "cohere":
+            out = self._call({"texts": [text], "model": self.model,
+                              "input_type": "search_query"})
+            emb = out["embeddings"]
+            return _f32(emb["float"] if isinstance(emb, dict) else emb)[0]
+        return self.vectorize([text])[0]
+
+
+class APIGenerative(_APIBase, Generative):
+    def generate(self, prompt: str, context_documents: Sequence[str],
+                 grouped: bool = False) -> str:
+        if context_documents:
+            ctx = "\n".join(context_documents)
+            prompt = f"{prompt}\n\nContext:\n{ctx}"
+        return GENERATE_STYLES[self.spec.style](self, prompt)
+
+
+class APIReranker(_APIBase, Reranker):
+    def rerank(self, query: str, documents: Sequence[str]) -> list[float]:
+        return RERANK_STYLES[self.spec.style](self, query, documents)
+
+
+class APIMultiModal(_APIBase, MultiModalVectorizer):
+    """Image+text providers. Text goes through the spec's embed style;
+    images through the provider's image field convention."""
+
+    def __init__(self, spec: ProviderSpec,
+                 transport: Optional[Transport] = None):
+        super().__init__(spec, transport)
+        self.dims = spec.dims
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        if self.spec.style == "local":
+            return _local_vectorize(self, texts)
+        return EMBED_STYLES[self.spec.style](self, texts)
+
+    def vectorize_image(self, images_b64: Sequence[str]) -> np.ndarray:
+        if self.spec.style == "local":
+            rows = [self._call({"image": b})["vector"] for b in images_b64]
+            return _f32(rows)
+        if self.spec.style == "cohere":
+            out = self._call({"model": self.model, "input_type": "image",
+                              "images": list(images_b64)})
+            emb = out["embeddings"]
+            return _f32(emb["float"] if isinstance(emb, dict) else emb)
+        if self.spec.style == "google":
+            out = self._call({"instances": [
+                {"image": {"bytesBase64Encoded": b}} for b in images_b64]})
+            return _f32([pr["imageEmbedding"] for pr in out["predictions"]])
+        # openai-shaped multimodal (jina/nvidia/voyage): typed input rows
+        out = self._call({"model": self.model, "input": [
+            {"image": b} for b in images_b64]})
+        data = sorted(out["data"], key=lambda d: d.get("index", 0))
+        return _f32([d["embedding"] for d in data])
+
+
+class APIMultiVector(_APIBase, MultiVectorVectorizer):
+    """ColBERT-style providers (jina v2 multivector API shape)."""
+
+    def __init__(self, spec: ProviderSpec,
+                 transport: Optional[Transport] = None):
+        super().__init__(spec, transport)
+        self.dims = spec.dims
+
+    def vectorize_multi(self, texts: Sequence[str]) -> list[np.ndarray]:
+        out = self._call({"model": self.model, "input": list(texts),
+                          **self.spec.extra})
+        data = sorted(out["data"], key=lambda d: d.get("index", 0))
+        return [_f32(d["embeddings"]) for d in data]

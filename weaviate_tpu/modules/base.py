@@ -32,12 +32,24 @@ class Module(abc.ABC):
 
     def module_type(self) -> str:
         kinds = []
-        if isinstance(self, Vectorizer):
+        if isinstance(self, MultiVectorVectorizer):
+            kinds.append("text2multivec")
+        elif isinstance(self, MultiModalVectorizer):
+            kinds.append("multi2vec")
+        elif isinstance(self, Vectorizer):
             kinds.append("text2vec")
         if isinstance(self, Reranker):
             kinds.append("reranker")
         if isinstance(self, Generative):
             kinds.append("generative")
+        if isinstance(self, QnA):
+            kinds.append("qna")
+        if isinstance(self, Summarizer):
+            kinds.append("sum")
+        if isinstance(self, NERTagger):
+            kinds.append("ner")
+        if isinstance(self, SpellChecker):
+            kinds.append("spellcheck")
         return "+".join(kinds) or "extension"
 
 
@@ -95,6 +107,78 @@ class Generative(Module):
         for k, v in properties.items():
             out = out.replace("{" + k + "}", str(v))
         return self.generate(out, [])
+
+
+class MultiModalVectorizer(Vectorizer):
+    """multi2vec capability: text + image (+ other media) into one space
+    (reference ``modules/multi2vec-*``; fusion weights per class config)."""
+
+    def vectorize_image(self, images_b64: Sequence[str]) -> np.ndarray:
+        """Batch-embed base64 images → [n, dims] float32."""
+        raise ModuleNotAvailable(f"{self.name}: image vectorization backend"
+                                 " not configured")
+
+    def fuse(self, vectors: Sequence[np.ndarray],
+             weights: Optional[Sequence[float]] = None) -> np.ndarray:
+        """Weighted-mean fusion of per-media vectors (reference
+        multi2vec CalculateVector weighted average)."""
+        vs = np.stack([np.asarray(v, np.float32) for v in vectors])
+        w = (np.asarray(weights, np.float32)
+             if weights is not None else np.ones(len(vs), np.float32))
+        w = w / max(float(w.sum()), 1e-9)
+        out = (vs * w[:, None]).sum(axis=0)
+        n = float(np.linalg.norm(out))
+        return out / n if n > 0 else out
+
+
+class MultiVectorVectorizer(Module):
+    """text2multivec capability: ColBERT-style token-vector sets, consumed
+    by the MUVERA multivector index (reference ``text2multivec-jinaai``,
+    ``multi2multivec-*``)."""
+
+    dims: int = 0
+
+    def vectorize_multi(self, texts: Sequence[str]) -> list[np.ndarray]:
+        """Batch-embed texts → list of [tokens_i, dims] float32 arrays."""
+        raise ModuleNotAvailable(f"{self.name}: multivector backend"
+                                 " not configured")
+
+
+class QnA(Module):
+    """Extractive/abstractive question answering over retrieved objects
+    (reference ``modules/qna-*``; GraphQL ``ask`` argument)."""
+
+    @abc.abstractmethod
+    def answer(self, question: str, context: str) -> dict:
+        """→ {"answer": str|None, "certainty": float, "start": int,
+        "end": int} (absent positions = -1 for abstractive providers)."""
+
+
+class Summarizer(Module):
+    """Property summarization (reference ``modules/sum-transformers``;
+    ``_additional { summary }``)."""
+
+    @abc.abstractmethod
+    def summarize(self, text: str) -> str: ...
+
+
+class NERTagger(Module):
+    """Named-entity recognition over properties (reference
+    ``modules/ner-transformers``; ``_additional { tokens }``)."""
+
+    @abc.abstractmethod
+    def tag(self, text: str) -> list[dict]:
+        """→ [{"entity": label, "word": str, "start": int, "end": int,
+        "certainty": float}]."""
+
+
+class SpellChecker(Module):
+    """Query spellcheck (reference ``modules/text-spellcheck``; corrects
+    nearText concepts before vectorization)."""
+
+    @abc.abstractmethod
+    def check(self, text: str) -> dict:
+        """→ {"original": str, "corrected": str, "changes": [...]}"""
 
 
 class ModuleNotAvailable(RuntimeError):

@@ -1,0 +1,322 @@
+"""Auxiliary NLP modules: QnA, summarization, NER, spellcheck, dummies.
+
+Reference counterparts: ``modules/qna-transformers`` + ``qna-openai``
+(extractive/abstractive answers for the GraphQL ``ask`` argument),
+``sum-transformers`` (``_additional { summary }``), ``ner-transformers``
+(``_additional { tokens }``), ``text-spellcheck`` (nearText autocorrect),
+and the ``*-dummy`` providers the reference ships for CI.
+
+The transformers-backed modules load a cached HF pipeline when available and
+otherwise fall back to an honest classical algorithm (extractive answer
+matching, frequency-based extractive summary, capitalized-span NER) — the
+``meta()`` payload reports which backend answered so operators can tell.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+from weaviate_tpu.inverted.analyzer import STOPWORDS_EN, tokenize
+from weaviate_tpu.modules.base import (
+    Generative,
+    MultiModalVectorizer,
+    NERTagger,
+    QnA,
+    Reranker,
+    SpellChecker,
+    Summarizer,
+)
+
+
+def _try_pipeline(task: str, model: str):
+    """HF pipeline if its weights are in the local cache; None otherwise
+    (zero-egress: never attempt a download — offline env vars make the miss
+    immediate instead of N retried HEAD requests)."""
+    import os
+
+    os.environ.setdefault("HF_HUB_OFFLINE", "1")
+    os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+    try:
+        from transformers import pipeline
+
+        return pipeline(task, model=model, local_files_only=True)
+    except Exception:
+        return None
+
+
+def _sentences(text: str) -> list[str]:
+    return [s.strip() for s in re.split(r"(?<=[.!?])\s+", text) if s.strip()]
+
+
+class TransformersQnA(QnA):
+    """Extractive QA (reference ``qna-transformers``). Fallback: the
+    sentence sharing the most question terms, span = the sentence."""
+
+    name = "qna-transformers"
+
+    def __init__(self, model: str = "distilbert-base-cased-distilled-squad"):
+        self._model_name = model
+        self._pipe = None
+        self._probed = False
+
+    def _backend(self):
+        if not self._probed:
+            self._pipe = _try_pipeline("question-answering", self._model_name)
+            self._probed = True
+        return self._pipe
+
+    def meta(self) -> dict:
+        # no _backend() here: meta() is called by /v1/meta for every module
+        # and must not trigger the transformers import/probe
+        m = super().meta()
+        m["backend"] = ("transformers" if self._pipe is not None
+                        else ("lexical" if self._probed else "lazy"))
+        return m
+
+    def answer(self, question: str, context: str) -> dict:
+        pipe = self._backend()
+        if pipe is not None:
+            r = pipe(question=question, context=context)
+            return {"answer": r["answer"], "certainty": float(r["score"]),
+                    "start": int(r["start"]), "end": int(r["end"])}
+        q_toks = set(tokenize(question, "word")) - STOPWORDS_EN
+        best, best_score = None, 0.0
+        for sent in _sentences(context):
+            toks = set(tokenize(sent, "word"))
+            overlap = len(q_toks & toks) / max(len(q_toks), 1)
+            if overlap > best_score:
+                best, best_score = sent, overlap
+        if best is None or best_score == 0.0:
+            return {"answer": None, "certainty": 0.0, "start": -1, "end": -1}
+        start = context.find(best)
+        return {"answer": best, "certainty": round(best_score, 4),
+                "start": start, "end": start + len(best)}
+
+
+class OpenAIQnA(QnA):
+    """Abstractive QA via a generative provider (reference ``qna-openai``
+    prompts the completions API with question + context)."""
+
+    name = "qna-openai"
+
+    def __init__(self, generative: Optional[Generative] = None):
+        self._gen = generative
+
+    def init(self, config: Optional[dict] = None) -> None:
+        if self._gen is not None:
+            self._gen.init(config)
+
+    def answer(self, question: str, context: str) -> dict:
+        if self._gen is None:
+            from weaviate_tpu.modules.base import ModuleNotAvailable
+
+            raise ModuleNotAvailable("qna-openai: no generative backend")
+        text = self._gen.generate(
+            f"Answer strictly from the context.\n\nContext:\n{context}\n\n"
+            f"Question: {question}\nAnswer:", [])
+        return {"answer": text.strip(), "certainty": 0.0,
+                "start": -1, "end": -1}
+
+
+class TransformersSummarizer(Summarizer):
+    """Reference ``sum-transformers``. Fallback: frequency-scored extractive
+    summary (top sentences by non-stopword term frequency, original order)."""
+
+    name = "sum-transformers"
+
+    def __init__(self, model: str = "sshleifer/distilbart-cnn-12-6",
+                 max_sentences: int = 3):
+        self._model_name = model
+        self.max_sentences = max_sentences
+        self._pipe = None
+        self._probed = False
+
+    def _backend(self):
+        if not self._probed:
+            self._pipe = _try_pipeline("summarization", self._model_name)
+            self._probed = True
+        return self._pipe
+
+    def meta(self) -> dict:
+        m = super().meta()
+        m["backend"] = ("transformers" if self._pipe is not None
+                        else ("extractive" if self._probed else "lazy"))
+        return m
+
+    def summarize(self, text: str) -> str:
+        pipe = self._backend()
+        if pipe is not None:
+            return pipe(text, truncation=True)[0]["summary_text"]
+        sents = _sentences(text)
+        if len(sents) <= self.max_sentences:
+            return text
+        freq: dict[str, int] = {}
+        for s in sents:
+            for t in tokenize(s, "word"):
+                if t not in STOPWORDS_EN:
+                    freq[t] = freq.get(t, 0) + 1
+        def score(s: str) -> float:
+            toks = [t for t in tokenize(s, "word") if t not in STOPWORDS_EN]
+            return sum(freq[t] for t in toks) / math.sqrt(len(toks)) \
+                if toks else 0.0
+        ranked = sorted(range(len(sents)), key=lambda i: -score(sents[i]))
+        keep = sorted(ranked[: self.max_sentences])
+        return " ".join(sents[i] for i in keep)
+
+
+class TransformersNER(NERTagger):
+    """Reference ``ner-transformers``. Fallback: capitalized multi-word
+    spans tagged MISC (mid-sentence capitalization heuristic)."""
+
+    name = "ner-transformers"
+
+    def __init__(self, model: str = "dslim/bert-base-NER"):
+        self._model_name = model
+        self._pipe = None
+        self._probed = False
+
+    def _backend(self):
+        if not self._probed:
+            self._pipe = _try_pipeline("token-classification", self._model_name)
+            self._probed = True
+        return self._pipe
+
+    def meta(self) -> dict:
+        m = super().meta()
+        m["backend"] = ("transformers" if self._pipe is not None
+                        else ("heuristic" if self._probed else "lazy"))
+        return m
+
+    def tag(self, text: str) -> list[dict]:
+        pipe = self._backend()
+        if pipe is not None:
+            out = pipe(text, aggregation_strategy="simple")
+            return [{"entity": r["entity_group"], "word": r["word"],
+                     "start": int(r["start"]), "end": int(r["end"]),
+                     "certainty": float(r["score"])} for r in out]
+        ents = []
+        for m in re.finditer(
+                r"(?<![.!?]\s)(?<!^)\b([A-Z][a-z]+(?:\s+[A-Z][a-z]+)*)\b",
+                text):
+            ents.append({"entity": "MISC", "word": m.group(1),
+                         "start": m.start(1), "end": m.end(1),
+                         "certainty": 0.5})
+        return ents
+
+
+# a compact common-word core; check() also learns from configured vocab
+_BASE_WORDS = (
+    "the of and a to in is was he for it with as his on be at by had not "
+    "are but from or have an they which one you were all her she there "
+    "would their we him been has when who will no more if out so said what "
+    "up its about than into them can only other time new some could these "
+    "two may first then do any like my now over such our man me even most "
+    "made after also did many off before must well back through years much "
+    "where your way down should because each just those people how too "
+    "good very world search query vector database index engine data text "
+    "document result filter schema object class property tenant backup"
+).split()
+
+
+class SpellCheck(SpellChecker):
+    """Reference ``text-spellcheck``: corrects query text before
+    vectorization. Local symspell-style edit-distance-1 lookup against a
+    frequency dictionary (base vocabulary + words learned via init config
+    ``vocabulary`` or ``learn()``)."""
+
+    name = "text-spellcheck"
+
+    def __init__(self):
+        self._freq: dict[str, int] = {w: 100 for w in _BASE_WORDS}
+
+    def init(self, config: Optional[dict] = None) -> None:
+        for w in (config or {}).get("vocabulary", []):
+            self.learn(w)
+
+    def learn(self, word: str, count: int = 1) -> None:
+        w = word.lower()
+        self._freq[w] = self._freq.get(w, 0) + count
+
+    def _edits1(self, w: str):
+        letters = "abcdefghijklmnopqrstuvwxyz"
+        splits = [(w[:i], w[i:]) for i in range(len(w) + 1)]
+        for a, b in splits:
+            if b:
+                yield a + b[1:]                      # delete
+                yield a + b[0] + b[0] + b[1:]        # double
+            if len(b) > 1:
+                yield a + b[1] + b[0] + b[2:]        # transpose
+            for c in letters:
+                if b:
+                    yield a + c + b[1:]              # replace
+                yield a + c + b                      # insert
+
+    def _correct(self, w: str) -> str:
+        if w in self._freq or len(w) < 3 or not w.isalpha():
+            return w
+        cands = {c for c in self._edits1(w) if c in self._freq}
+        if not cands:
+            return w
+        return max(cands, key=lambda c: self._freq[c])
+
+    def check(self, text: str) -> dict:
+        parts = re.split(r"(\W+)", text)
+        changes = []
+        out = []
+        for p in parts:
+            c = self._correct(p.lower()) if p.isalpha() else p
+            if p.isalpha() and c != p.lower():
+                changes.append({"original": p, "corrected": c})
+                out.append(c)
+            else:
+                out.append(p)
+        return {"original": text, "corrected": "".join(out),
+                "changes": changes}
+
+
+# ---------------------------------------------------------------------------
+# dummy providers (reference generative-dummy / multi2vec-dummy /
+# reranker-dummy: deterministic no-network CI modules)
+# ---------------------------------------------------------------------------
+
+class DummyGenerative(Generative):
+    name = "generative-dummy"
+
+    def generate(self, prompt: str, context_documents: Sequence[str],
+                 grouped: bool = False) -> str:
+        n = len(context_documents)
+        return f"[dummy] prompt={prompt!r} docs={n}"
+
+
+class DummyReranker(Reranker):
+    name = "reranker-dummy"
+
+    def rerank(self, query: str, documents: Sequence[str]) -> list[float]:
+        # reverse input order, deterministically
+        n = len(documents)
+        return [float(n - i) for i in range(n)]
+
+
+class DummyMultiModal(MultiModalVectorizer):
+    name = "multi2vec-dummy"
+    dims = 64
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        from weaviate_tpu.modules.text2vec_hash import HashVectorizer
+
+        return HashVectorizer(dims=self.dims).vectorize(texts)
+
+    def vectorize_image(self, images_b64: Sequence[str]) -> np.ndarray:
+        import hashlib
+
+        out = np.zeros((len(images_b64), self.dims), np.float32)
+        for i, b in enumerate(images_b64):
+            h = hashlib.blake2b(b.encode(), digest_size=32).digest()
+            rng = np.random.default_rng(int.from_bytes(h[:8], "big"))
+            v = rng.standard_normal(self.dims).astype(np.float32)
+            out[i] = v / (np.linalg.norm(v) + 1e-12)
+        return out

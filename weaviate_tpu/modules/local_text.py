@@ -1,0 +1,171 @@
+"""Offline text vectorizers: the reference's local/self-contained embedders.
+
+Reference counterparts:
+- ``modules/text2vec-contextionary`` — the classic c11y: per-word vectors
+  composed (idf-weighted centroid) into a document vector, with stopword
+  removal and compound-word splitting.
+- ``modules/text2vec-bigram`` — experimental character-bigram embedder.
+- ``modules/text2vec-morph`` — morphology-aware variant (stems share mass).
+- ``modules/text2vec-model2vec`` — static token-embedding table, mean-pooled.
+
+All four here are deterministic and dependency-free: per-token vectors come
+from a seeded hash (a stand-in for trained tables — swap the token-vector
+function for real weights without touching composition), so the composition
+semantics (weighting, stopwords, pooling) match the reference while staying
+runnable in a zero-egress image.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import Sequence
+
+import numpy as np
+
+from weaviate_tpu.inverted.analyzer import STOPWORDS_EN, tokenize
+from weaviate_tpu.modules.base import Vectorizer
+
+
+def _token_vec(token: str, dims: int, seed: str) -> np.ndarray:
+    """Deterministic dense unit vector per token (trained-table stand-in)."""
+    h = hashlib.blake2b(f"{seed}:{token}".encode(), digest_size=32).digest()
+    rng = np.random.default_rng(int.from_bytes(h[:8], "big"))
+    v = rng.standard_normal(dims).astype(np.float32)
+    return v / (np.linalg.norm(v) + 1e-12)
+
+
+def _split_compound(tok: str, vocab_check) -> list[str]:
+    """Greedy 2-way compound split ("bathtub" -> bath+tub) when both halves
+    look like words — the c11y does this against its vocabulary."""
+    if len(tok) < 6:
+        return [tok]
+    for cut in range(3, len(tok) - 2):
+        a, b = tok[:cut], tok[cut:]
+        if vocab_check(a) and vocab_check(b):
+            return [a, b]
+    return [tok]
+
+
+class ContextionaryVectorizer(Vectorizer):
+    """Compositional word-centroid embedder (reference
+    ``text2vec-contextionary`` Vectorizer.Corpi → centroid)."""
+
+    name = "text2vec-contextionary"
+
+    def __init__(self, dims: int = 300):
+        self.dims = dims
+        self._df: dict[str, int] = {}  # corpus-side doc freq for idf weights
+        self._docs = 0
+
+    def _idf(self, tok: str) -> float:
+        df = self._df.get(tok, 0)
+        return 1.0 + math.log((self._docs + 1) / (df + 1))
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        out = np.zeros((len(texts), self.dims), np.float32)
+        common = STOPWORDS_EN
+        for i, text in enumerate(texts):
+            toks = [t for t in tokenize(text, "word") if t not in common]
+            expanded: list[str] = []
+            for t in toks:
+                expanded.extend(_split_compound(t, lambda w: len(w) >= 3))
+            self._docs += 1
+            for t in set(expanded):
+                self._df[t] = self._df.get(t, 0) + 1
+            if not expanded:
+                continue
+            acc = np.zeros(self.dims, np.float32)
+            for t in expanded:
+                acc += self._idf(t) * _token_vec(t, self.dims, "c11y")
+            n = float(np.linalg.norm(acc))
+            out[i] = acc / n if n > 0 else acc
+        return out
+
+
+class BigramVectorizer(Vectorizer):
+    """Character-bigram embedder (reference ``text2vec-bigram``)."""
+
+    name = "text2vec-bigram"
+
+    def __init__(self, dims: int = 256):
+        self.dims = dims
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        out = np.zeros((len(texts), self.dims), np.float32)
+        for i, text in enumerate(texts):
+            s = " " + " ".join(tokenize(text, "lowercase")) + " "
+            for j in range(len(s) - 1):
+                bg = s[j:j + 2]
+                h = int.from_bytes(
+                    hashlib.blake2b(bg.encode(), digest_size=8).digest(),
+                    "big")
+                out[i, h % self.dims] += (1.0 if (h >> 63) & 1 else -1.0)
+            n = float(np.linalg.norm(out[i]))
+            if n > 0:
+                out[i] /= n
+        return out
+
+
+def _stem(tok: str) -> str:
+    """Tiny suffix-stripping stemmer (Porter-lite) so inflected forms share
+    a base vector, which is the point of the morph module."""
+    for suf in ("ingly", "edly", "ing", "edly", "ed", "ies", "es", "s",
+                "ly", "er", "est"):
+        if tok.endswith(suf) and len(tok) - len(suf) >= 3:
+            return tok[: len(tok) - len(suf)]
+    return tok
+
+
+class MorphVectorizer(Vectorizer):
+    """Morphology-aware embedder (reference ``text2vec-morph``): each token
+    contributes its stem vector plus a damped surface-form vector."""
+
+    name = "text2vec-morph"
+
+    def __init__(self, dims: int = 256):
+        self.dims = dims
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        out = np.zeros((len(texts), self.dims), np.float32)
+        for i, text in enumerate(texts):
+            toks = tokenize(text, "word")
+            if not toks:
+                continue
+            acc = np.zeros(self.dims, np.float32)
+            for t in toks:
+                acc += _token_vec(_stem(t), self.dims, "morph")
+                acc += 0.25 * _token_vec(t, self.dims, "morph-surface")
+            n = float(np.linalg.norm(acc))
+            out[i] = acc / n if n > 0 else acc
+        return out
+
+
+class Model2VecVectorizer(Vectorizer):
+    """Static-table mean-pooled embedder (reference ``text2vec-model2vec``:
+    distilled static token embeddings, no attention at inference)."""
+
+    name = "text2vec-model2vec"
+
+    def __init__(self, dims: int = 256):
+        self.dims = dims
+        self._cache: dict[str, np.ndarray] = {}
+
+    def _lookup(self, tok: str) -> np.ndarray:
+        v = self._cache.get(tok)
+        if v is None:
+            v = _token_vec(tok, self.dims, "m2v")
+            if len(self._cache) < 200_000:
+                self._cache[tok] = v
+        return v
+
+    def vectorize(self, texts: Sequence[str]) -> np.ndarray:
+        out = np.zeros((len(texts), self.dims), np.float32)
+        for i, text in enumerate(texts):
+            toks = tokenize(text, "word")
+            if not toks:
+                continue
+            acc = np.add.reduce([self._lookup(t) for t in toks])
+            n = float(np.linalg.norm(acc))
+            out[i] = acc / n if n > 0 else acc
+        return out

@@ -1,0 +1,178 @@
+"""The provider catalog: every API-backed module the reference ships.
+
+Reference: one Go package per provider under ``modules/`` (67 total); the
+clients differ mainly in endpoint, auth header, default model, and which of
+four or five wire formats they clone. Here that variation is data
+(``ProviderSpec`` rows) over the shared capability classes in
+``api_provider.py``. Local/offline modules (contextionary, bigram, dummies,
+transformers pipelines, spellcheck) live in ``local_text.py`` /
+``extras.py``; storage-backed modules (backup-*, offload-s3, usage-*) are
+part of the backup/offload subsystem.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from weaviate_tpu.modules.api_provider import (
+    APIGenerative,
+    APIMultiModal,
+    APIMultiVector,
+    APIReranker,
+    APIVectorizer,
+    ProviderSpec,
+    Transport,
+)
+
+S = ProviderSpec
+
+TEXT2VEC_SPECS = [
+    S("text2vec-openai", "openai", "https://api.openai.com/v1/embeddings",
+      "OPENAI_APIKEY", model="text-embedding-3-small", dims=1536),
+    S("text2vec-cohere", "cohere", "https://api.cohere.ai/v1/embed",
+      "COHERE_APIKEY", model="embed-multilingual-v3.0", dims=1024),
+    S("text2vec-voyageai", "openai", "https://api.voyageai.com/v1/embeddings",
+      "VOYAGEAI_APIKEY", model="voyage-3", dims=1024),
+    S("text2vec-jinaai", "openai", "https://api.jina.ai/v1/embeddings",
+      "JINAAI_APIKEY", model="jina-embeddings-v3", dims=1024),
+    S("text2vec-mistral", "openai", "https://api.mistral.ai/v1/embeddings",
+      "MISTRAL_APIKEY", model="mistral-embed", dims=1024),
+    S("text2vec-huggingface", "huggingface",
+      "https://api-inference.huggingface.co/pipeline/feature-extraction/{model}",
+      "HUGGINGFACE_APIKEY",
+      model="sentence-transformers/all-MiniLM-L6-v2", dims=384),
+    S("text2vec-ollama", "ollama", "http://localhost:11434/api/embed",
+      auth="none", model="nomic-embed-text", dims=768),
+    S("text2vec-google", "google",
+      "https://us-central1-aiplatform.googleapis.com/v1/publishers/google/"
+      "models/{model}:predict",
+      "GOOGLE_APIKEY", model="textembedding-gecko@003", dims=768),
+    S("text2vec-aws", "bedrock", "http://localhost:9018/bedrock/embed",
+      "AWS_ACCESS_KEY", model="amazon.titan-embed-text-v2:0", dims=1024),
+    S("text2vec-databricks", "openai", "http://localhost:9020/serving/embed",
+      "DATABRICKS_TOKEN", dims=0),
+    S("text2vec-nvidia", "openai",
+      "https://integrate.api.nvidia.com/v1/embeddings",
+      "NVIDIA_APIKEY", model="nvidia/nv-embed-v1", dims=4096),
+    S("text2vec-octoai", "openai", "https://text.octoai.run/v1/embeddings",
+      "OCTOAI_APIKEY", model="thenlper/gte-large", dims=1024),
+    S("text2vec-weaviate", "openai",
+      "https://api.embedding.weaviate.io/v1/embeddings",
+      "WEAVIATE_APIKEY", model="Snowflake/snowflake-arctic-embed-m-v1.5",
+      dims=768),
+    S("text2vec-gpt4all", "local", "http://localhost:4891/vectorize",
+      auth="none", dims=384),
+]
+
+GENERATIVE_SPECS = [
+    S("generative-openai", "openai",
+      "https://api.openai.com/v1/chat/completions",
+      "OPENAI_APIKEY", model="gpt-4o-mini"),
+    S("generative-anthropic", "anthropic",
+      "https://api.anthropic.com/v1/messages",
+      "ANTHROPIC_APIKEY", auth="x-api-key",
+      model="claude-3-5-sonnet-latest"),
+    S("generative-cohere", "cohere", "https://api.cohere.ai/v1/chat",
+      "COHERE_APIKEY", model="command-r-plus"),
+    S("generative-mistral", "openai",
+      "https://api.mistral.ai/v1/chat/completions",
+      "MISTRAL_APIKEY", model="mistral-large-latest"),
+    S("generative-google", "google",
+      "https://generativelanguage.googleapis.com/v1beta/models/"
+      "{model}:generateContent",
+      "GOOGLE_APIKEY", auth="header:x-goog-api-key",
+      model="gemini-1.5-flash"),
+    S("generative-ollama", "ollama", "http://localhost:11434/api/generate",
+      auth="none", model="llama3.1"),
+    S("generative-aws", "bedrock", "http://localhost:9018/bedrock/generate",
+      "AWS_ACCESS_KEY", model="anthropic.claude-3-sonnet"),
+    S("generative-anyscale", "openai",
+      "https://api.endpoints.anyscale.com/v1/chat/completions",
+      "ANYSCALE_APIKEY", model="meta-llama/Meta-Llama-3-70B-Instruct"),
+    S("generative-databricks", "openai",
+      "http://localhost:9020/serving/chat", "DATABRICKS_TOKEN"),
+    S("generative-friendliai", "openai",
+      "https://api.friendli.ai/serverless/v1/chat/completions",
+      "FRIENDLI_TOKEN", model="meta-llama-3.1-70b-instruct"),
+    S("generative-nvidia", "openai",
+      "https://integrate.api.nvidia.com/v1/chat/completions",
+      "NVIDIA_APIKEY", model="nvidia/llama-3.1-nemotron-70b-instruct"),
+    S("generative-octoai", "openai",
+      "https://text.octoai.run/v1/chat/completions",
+      "OCTOAI_APIKEY", model="meta-llama-3.1-70b-instruct"),
+    S("generative-xai", "openai", "https://api.x.ai/v1/chat/completions",
+      "XAI_APIKEY", model="grok-2-latest"),
+    S("generative-contextualai", "openai",
+      "https://api.contextual.ai/v1/generate",
+      "CONTEXTUALAI_APIKEY", model="v1"),
+]
+
+RERANKER_SPECS = [
+    S("reranker-cohere", "cohere", "https://api.cohere.ai/v1/rerank",
+      "COHERE_APIKEY", model="rerank-v3.5"),
+    S("reranker-voyageai", "cohere", "https://api.voyageai.com/v1/rerank",
+      "VOYAGEAI_APIKEY", model="rerank-2"),
+    S("reranker-jinaai", "cohere", "https://api.jina.ai/v1/rerank",
+      "JINAAI_APIKEY", model="jina-reranker-v2-base-multilingual"),
+    S("reranker-nvidia", "cohere",
+      "https://ai.api.nvidia.com/v1/retrieval/nvidia/reranking",
+      "NVIDIA_APIKEY", model="nvidia/rerank-qa-mistral-4b"),
+    S("reranker-contextualai", "cohere",
+      "https://api.contextual.ai/v1/rerank",
+      "CONTEXTUALAI_APIKEY", model="ctxl-rerank-en-v1"),
+]
+
+MULTI2VEC_SPECS = [
+    # self-hosted sidecar contract (reference CLIP_INFERENCE_API etc.)
+    S("multi2vec-clip", "local", "http://localhost:9090/vectorize",
+      auth="none", dims=512),
+    S("multi2vec-bind", "local", "http://localhost:9091/vectorize",
+      auth="none", dims=1024),
+    S("img2vec-neural", "local", "http://localhost:9092/vectorize",
+      auth="none", dims=512),
+    S("multi2vec-cohere", "cohere", "https://api.cohere.ai/v1/embed",
+      "COHERE_APIKEY", model="embed-multilingual-v3.0", dims=1024),
+    S("multi2vec-google", "google",
+      "https://us-central1-aiplatform.googleapis.com/v1/publishers/google/"
+      "models/{model}:predict",
+      "GOOGLE_APIKEY", model="multimodalembedding@001", dims=1408),
+    S("multi2vec-jinaai", "openai", "https://api.jina.ai/v1/embeddings",
+      "JINAAI_APIKEY", model="jina-clip-v2", dims=1024),
+    S("multi2vec-voyageai", "openai",
+      "https://api.voyageai.com/v1/multimodalembeddings",
+      "VOYAGEAI_APIKEY", model="voyage-multimodal-3", dims=1024),
+    S("multi2vec-nvidia", "openai",
+      "https://integrate.api.nvidia.com/v1/embeddings",
+      "NVIDIA_APIKEY", model="nvidia/nvclip", dims=1024),
+    S("multi2vec-aws", "bedrock", "http://localhost:9018/bedrock/embed",
+      "AWS_ACCESS_KEY", model="amazon.titan-embed-image-v1", dims=1024),
+]
+
+MULTIVEC_SPECS = [
+    S("text2multivec-jinaai", "openai", "https://api.jina.ai/v1/embeddings",
+      "JINAAI_APIKEY", model="jina-colbert-v2", dims=128,
+      extra={"return_multivector": True}),
+    S("multi2multivec-jinaai", "openai",
+      "https://api.jina.ai/v1/embeddings",
+      "JINAAI_APIKEY", model="jina-colbert-v2", dims=128,
+      extra={"return_multivector": True}),
+    S("multi2multivec-weaviate", "openai",
+      "https://api.embedding.weaviate.io/v1/multivector",
+      "WEAVIATE_APIKEY", dims=128,
+      extra={"return_multivector": True}),
+]
+
+
+def register_api_providers(reg, transport: Optional[Transport] = None) -> None:
+    """Instantiate the full API-provider catalog into ``reg``. A custom
+    ``transport`` (tests, proxies) applies to every provider."""
+    for spec in TEXT2VEC_SPECS:
+        reg.register(APIVectorizer(spec, transport))
+    for spec in GENERATIVE_SPECS:
+        reg.register(APIGenerative(spec, transport))
+    for spec in RERANKER_SPECS:
+        reg.register(APIReranker(spec, transport))
+    for spec in MULTI2VEC_SPECS:
+        reg.register(APIMultiModal(spec, transport))
+    for spec in MULTIVEC_SPECS:
+        reg.register(APIMultiVector(spec, transport))

@@ -13,7 +13,13 @@ from typing import Optional
 from weaviate_tpu.modules.base import (
     Generative,
     Module,
+    MultiModalVectorizer,
+    MultiVectorVectorizer,
+    NERTagger,
+    QnA,
     Reranker,
+    SpellChecker,
+    Summarizer,
     Vectorizer,
 )
 
@@ -34,31 +40,66 @@ class ModuleRegistry:
     def has(self, name: str) -> bool:
         return name in self._modules
 
-    def vectorizer(self, name: str) -> Vectorizer:
+    def _typed(self, name: str, cls: type, what: str):
         m = self.get(name)
-        if not isinstance(m, Vectorizer):
-            raise TypeError(f"module {name!r} is not a vectorizer")
+        if not isinstance(m, cls):
+            raise TypeError(f"module {name!r} is not {what}")
         return m
+
+    def vectorizer(self, name: str) -> Vectorizer:
+        return self._typed(name, Vectorizer, "a vectorizer")
 
     def reranker(self, name: str) -> Reranker:
-        m = self.get(name)
-        if not isinstance(m, Reranker):
-            raise TypeError(f"module {name!r} is not a reranker")
-        return m
+        return self._typed(name, Reranker, "a reranker")
 
     def generative(self, name: str) -> Generative:
-        m = self.get(name)
-        if not isinstance(m, Generative):
-            raise TypeError(f"module {name!r} is not generative")
-        return m
+        return self._typed(name, Generative, "generative")
+
+    def multimodal(self, name: str) -> MultiModalVectorizer:
+        return self._typed(name, MultiModalVectorizer, "multi-modal")
+
+    def multivector(self, name: str) -> MultiVectorVectorizer:
+        return self._typed(name, MultiVectorVectorizer,
+                           "a multivector provider")
+
+    def qna(self, name: str) -> QnA:
+        return self._typed(name, QnA, "a QnA provider")
+
+    def summarizer(self, name: str) -> Summarizer:
+        return self._typed(name, Summarizer, "a summarizer")
+
+    def ner(self, name: str) -> NERTagger:
+        return self._typed(name, NERTagger, "a NER tagger")
+
+    def spellchecker(self, name: str) -> SpellChecker:
+        return self._typed(name, SpellChecker, "a spellchecker")
 
     def list(self) -> dict[str, dict]:
         return {name: m.meta() for name, m in self._modules.items()}
 
 
 def default_registry() -> ModuleRegistry:
-    """The baked-in providers (reference: registerModules defaults)."""
+    """The full provider catalog (reference: registerModules wires all 67
+    enabled modules; here every provider registers and the unreachable ones
+    fail per-call with ``ModuleNotAvailable``)."""
+    from weaviate_tpu.modules.extras import (
+        DummyGenerative,
+        DummyMultiModal,
+        DummyReranker,
+        OpenAIQnA,
+        SpellCheck,
+        TransformersNER,
+        TransformersQnA,
+        TransformersSummarizer,
+    )
     from weaviate_tpu.modules.generative_template import TemplateGenerative
+    from weaviate_tpu.modules.local_text import (
+        BigramVectorizer,
+        ContextionaryVectorizer,
+        Model2VecVectorizer,
+        MorphVectorizer,
+    )
+    from weaviate_tpu.modules.providers import register_api_providers
     from weaviate_tpu.modules.ref2vec_centroid import Ref2VecCentroid
     from weaviate_tpu.modules.reranker_lexical import LexicalReranker
     from weaviate_tpu.modules.text2vec_hash import HashVectorizer
@@ -76,4 +117,21 @@ def default_registry() -> ModuleRegistry:
     )
 
     reg.register(TransformersVectorizer())
+    # offline local embedders
+    reg.register(ContextionaryVectorizer())
+    reg.register(BigramVectorizer())
+    reg.register(MorphVectorizer())
+    reg.register(Model2VecVectorizer())
+    # auxiliary NLP + CI dummies
+    reg.register(TransformersQnA())
+    reg.register(TransformersSummarizer())
+    reg.register(TransformersNER())
+    reg.register(SpellCheck())
+    reg.register(DummyGenerative())
+    reg.register(DummyReranker())
+    reg.register(DummyMultiModal())
+    # the hosted/self-hosted API catalog (gated per call in zero-egress)
+    register_api_providers(reg)
+    # qna-openai rides the generative-openai client
+    reg.register(OpenAIQnA(reg.generative("generative-openai")))
     return reg

@@ -7,6 +7,7 @@ post-processing (sorter, aggregator, group-by, autocut).
 from weaviate_tpu.query.aggregator import aggregate_property
 from weaviate_tpu.query.autocut import autocut
 from weaviate_tpu.query.explorer import (
+    AskParams,
     Explorer,
     GenerateParams,
     Hit,
@@ -14,6 +15,8 @@ from weaviate_tpu.query.explorer import (
     QueryParams,
     QueryResult,
     RerankParams,
+    SummaryParams,
+    TokenParams,
 )
 from weaviate_tpu.query.fusion import ranked_fusion, relative_score_fusion
 from weaviate_tpu.query.groupby import Group, GroupByParams, group_results
@@ -22,7 +25,8 @@ from weaviate_tpu.query.sorter import sort_objects
 
 __all__ = [
     "Explorer", "Hit", "HybridParams", "QueryParams", "QueryResult",
-    "RerankParams", "GenerateParams",
+    "RerankParams", "GenerateParams", "AskParams", "SummaryParams",
+    "TokenParams",
     "GroupByParams", "Group", "group_results", "sort_objects", "autocut",
     "ranked_fusion", "relative_score_fusion", "combine_multi_target",
     "aggregate_property",

@@ -51,6 +51,34 @@ class GenerateParams:
 
 
 @dataclass
+class AskParams:
+    """Reference ``qna-*`` GraphQL ``ask`` argument: answer a question from
+    the best-matching object's text."""
+
+    question: str
+    properties: Optional[list[str]] = None  # context props; None = all text
+    certainty: float = 0.0  # drop answers below this confidence
+    module: str = "qna-transformers"
+
+
+@dataclass
+class SummaryParams:
+    """Reference ``sum-transformers`` ``_additional { summary }``."""
+
+    properties: list[str] = field(default_factory=list)
+    module: str = "sum-transformers"
+
+
+@dataclass
+class TokenParams:
+    """Reference ``ner-transformers`` ``_additional { tokens }``."""
+
+    properties: list[str] = field(default_factory=list)
+    certainty: float = 0.0
+    module: str = "ner-transformers"
+
+
+@dataclass
 class QueryParams:
     collection: str
     tenant: str = ""
@@ -78,6 +106,12 @@ class QueryParams:
     # module-powered additional properties
     rerank: Optional[RerankParams] = None
     generate: Optional[GenerateParams] = None
+    ask: Optional[AskParams] = None
+    summary: Optional[SummaryParams] = None
+    tokens: Optional[TokenParams] = None
+    # query spellcheck (reference text-spellcheck): autocorrect nearText /
+    # bm25 input before vectorization when enabled
+    autocorrect: bool = False
 
 
 @dataclass
@@ -116,6 +150,14 @@ class Explorer:
         scored: list[tuple[StorageObject, float]] = []
         kind = "none"
 
+        if params.autocorrect and col.modules is not None \
+                and col.modules.has("text-spellcheck"):
+            checker = col.modules.spellchecker("text-spellcheck")
+            if params.near_text is not None:
+                params.near_text = checker.check(params.near_text)["corrected"]
+            if params.bm25_query is not None:
+                params.bm25_query = checker.check(
+                    params.bm25_query)["corrected"]
         if params.near_text is not None and params.near_vector is None \
                 and params.hybrid is None:
             params.near_vector = self._query_vector(col, params.near_text)
@@ -193,6 +235,12 @@ class Explorer:
             self._apply_rerank(col, result, params.rerank)
         if params.generate is not None:
             self._apply_generate(col, result, params.generate)
+        if params.ask is not None:
+            self._apply_ask(col, result, params.ask)
+        if params.summary is not None:
+            self._apply_summary(col, result, params.summary)
+        if params.tokens is not None:
+            self._apply_tokens(col, result, params.tokens)
         return result
 
     def _doc_text(self, obj: StorageObject, prop: str) -> str:
@@ -241,6 +289,65 @@ class Explorer:
             result.generated = gen.generate(
                 params.grouped_task, docs, grouped=True
             )
+
+    def _apply_ask(self, col, result: QueryResult,
+                   params: AskParams) -> None:
+        """QnA additional property: answer from the TOP hit's text, like the
+        reference (``qna-transformers`` answers over result objects and the
+        first confident answer wins)."""
+        if col.modules is None or not result.hits:
+            return
+        qna = col.modules.qna(params.module)
+        for h in result.hits:
+            props = params.properties
+            if props:
+                ctx = " ".join(
+                    str(h.object.properties.get(p, "")) for p in props)
+            else:
+                ctx = self._doc_text(h.object, "")
+            if not ctx.strip():
+                continue
+            a = qna.answer(params.question, ctx)
+            if a.get("answer") and a.get("certainty", 0.0) >= params.certainty:
+                h.additional["answer"] = a
+                # reference returns the first (best-ranked) answer and
+                # stops — remaining hits carry no answer payload
+                break
+
+    def _apply_summary(self, col, result: QueryResult,
+                       params: SummaryParams) -> None:
+        if col.modules is None or not result.hits:
+            return
+        summ = col.modules.summarizer(params.module)
+        for h in result.hits:
+            out = []
+            for p in (params.properties
+                      or [k for k, v in h.object.properties.items()
+                          if isinstance(v, str)]):
+                v = h.object.properties.get(p)
+                if isinstance(v, str) and v.strip():
+                    out.append({"property": p, "result": summ.summarize(v)})
+            if out:
+                h.additional["summary"] = out
+
+    def _apply_tokens(self, col, result: QueryResult,
+                      params: TokenParams) -> None:
+        if col.modules is None or not result.hits:
+            return
+        ner = col.modules.ner(params.module)
+        for h in result.hits:
+            toks = []
+            for p in (params.properties
+                      or [k for k, v in h.object.properties.items()
+                          if isinstance(v, str)]):
+                v = h.object.properties.get(p)
+                if not isinstance(v, str) or not v.strip():
+                    continue
+                for e in ner.tag(v):
+                    if e.get("certainty", 0.0) >= params.certainty:
+                        toks.append({"property": p, **e})
+            if toks:
+                h.additional["tokens"] = toks
 
     def aggregate(
         self,
