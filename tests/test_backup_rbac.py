@@ -187,7 +187,7 @@ def test_rest_backup_endpoints(secured):
     status, out = call(base, "GET", "/v1/backups/filesystem/api-bk",
                        key="rootkey")
     assert status == 200 and out["status"] == "SUCCESS"
-    # unknown backend
+    # s3 backend exists but is unconfigured (no BACKUP_S3_BUCKET): 422
     assert call(base, "POST", "/v1/backups/s3", {"id": "x"},
                 key="rootkey")[0] == 422
     # restore refuses while class exists

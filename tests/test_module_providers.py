@@ -57,8 +57,8 @@ def test_catalog_covers_reference_module_names():
         "generative-friendliai", "generative-nvidia", "generative-octoai",
         "generative-xai", "generative-contextualai", "generative-dummy",
         "reranker-cohere", "reranker-voyageai", "reranker-jinaai",
-        "reranker-nvidia", "reranker-contextualai", "reranker-transformers"
-        if False else "reranker-dummy", "reranker-lexical",
+        "reranker-nvidia", "reranker-contextualai", "reranker-transformers",
+        "reranker-dummy", "reranker-lexical",
         "multi2vec-clip", "multi2vec-bind", "multi2vec-cohere",
         "multi2vec-google", "multi2vec-jinaai", "multi2vec-voyageai",
         "multi2vec-nvidia", "multi2vec-aws", "multi2vec-dummy",
@@ -174,13 +174,21 @@ def test_every_multimodal_image_style_parses(spec):
 
     p = APIMultiModal(spec, fake)
     p.init({"api_key": "k"})
-    if spec.style == "bedrock":
-        # bedrock image embedding posts one image per call
-        def fake_bedrock(url, headers, payload):
-            return {"embedding": [1.0] * 4}
-        p.transport = fake_bedrock if False else fake
     out = p.vectorize_image(["aW1n"])
     assert out.shape == (1, 4)
+    if spec.style == "bedrock":
+        # bedrock posts one {"inputImage"} per call — never the openai
+        # batch shape (the fake asserts by raising on unknown payloads)
+        seen = []
+
+        def strict(url, headers, payload):
+            assert set(payload) == {"inputImage"}, payload
+            seen.append(payload)
+            return {"embedding": [1.0] * 4}
+
+        p.transport = strict
+        assert p.vectorize_image(["aQ==", "bQ=="]).shape == (2, 4)
+        assert len(seen) == 2
 
 
 @pytest.mark.parametrize("spec", MULTIVEC_SPECS, ids=lambda s: s.name)

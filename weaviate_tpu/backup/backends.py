@@ -108,13 +108,69 @@ class FilesystemBackend(BackupBackend):
         return sorted(out)
 
 
+class ObjectStoreBackend(BackupBackend):
+    """Backup over an object store (reference ``modules/backup-s3`` /
+    ``backup-gcs`` / ``backup-azure`` — same SPI, keys are
+    ``<backup_id>/<rel_path>``)."""
+
+    def __init__(self, name: str, client):
+        self.name = name
+        self.client = client
+
+    def _key(self, backup_id: str, rel: str = "") -> str:
+        validate_backup_id(backup_id)
+        # rel paths come from os.walk (trusted) on write but from the
+        # manifest on read — normalize and refuse traversal either way
+        rel = rel.replace(os.sep, "/")
+        if rel.startswith("/") or ".." in rel.split("/"):
+            raise ValueError(f"invalid backup path {rel!r}")
+        return f"{backup_id}/{rel}" if rel else backup_id
+
+    def put_file(self, backup_id: str, rel_path: str, src_path: str) -> None:
+        with open(src_path, "rb") as f:
+            self.client.put(self._key(backup_id, rel_path), f.read())
+
+    def get_file(self, backup_id: str, rel_path: str, dst_path: str) -> None:
+        data = self.client.get(self._key(backup_id, rel_path))
+        if data is None:
+            raise FileNotFoundError(f"{backup_id}/{rel_path}")
+        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
+        with open(dst_path, "wb") as f:
+            f.write(data)
+
+    def put_meta(self, backup_id: str, data: bytes) -> None:
+        self.client.put(self._key(backup_id, "backup.json"), data)
+
+    def get_meta(self, backup_id: str) -> Optional[bytes]:
+        from weaviate_tpu.backup.object_store import ObjectStoreError
+
+        try:
+            return self.client.get(self._key(backup_id, "backup.json"))
+        except ObjectStoreError:
+            raise
+        except Exception:
+            return None
+
+    def list_files(self, backup_id: str) -> list[str]:
+        keys = self.client.list(validate_backup_id(backup_id) + "/")
+        pre = backup_id + "/"
+        meta = pre + "backup.json"  # exact meta key only — a data file
+        # named *backup.json must survive the listing
+        return sorted(k[len(pre):] for k in keys
+                      if k.startswith(pre) and k != meta)
+
+
 _REGISTRY: dict[str, type] = {"filesystem": FilesystemBackend}
 
 
 def make_backend(name: str, root: str) -> BackupBackend:
+    if name in ("s3", "gcs", "azure"):
+        from weaviate_tpu.backup.object_store import make_client
+
+        return ObjectStoreBackend(name, make_client(name))
     cls = _REGISTRY.get(name)
     if cls is None:
         raise KeyError(
             f"backup backend {name!r} not available (have: "
-            f"{sorted(_REGISTRY)})")
+            f"{sorted(_REGISTRY) + ['s3', 'gcs', 'azure']})")
     return cls(root)

@@ -71,15 +71,19 @@ class BackupHandler:
                 for cls in classes:
                     col = self.db.get_collection(cls)
                     col.flush()
-                    files = []
-                    base = col.dir
-                    for dirpath, _dirs, fnames in os.walk(base):
-                        for fn in fnames:
-                            full = os.path.join(dirpath, fn)
-                            rel = os.path.join(
-                                cls, os.path.relpath(full, base))
-                            backend.put_file(backup_id, rel, full)
-                            files.append(rel)
+                    # freeze the segment set while walking+copying: a
+                    # concurrent compaction would delete listed files
+                    # mid-copy (reference bucket_pauses.go)
+                    with col.maintenance_paused():
+                        files = []
+                        base = col.dir
+                        for dirpath, _dirs, fnames in os.walk(base):
+                            for fn in fnames:
+                                full = os.path.join(dirpath, fn)
+                                rel = os.path.join(
+                                    cls, os.path.relpath(full, base))
+                                backend.put_file(backup_id, rel, full)
+                                files.append(rel)
                     manifest["classes"][cls] = {
                         "config": col.config.to_dict(),
                         "files": files,

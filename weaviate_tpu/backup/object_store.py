@@ -1,0 +1,343 @@
+"""Object-store clients: S3 (SigV4), GCS (JSON API), Azure Blob (SharedKey).
+
+Reference: ``modules/backup-{s3,gcs,azure}`` + ``modules/offload-s3`` +
+``modules/usage-{s3,gcs}`` wrap the vendor SDKs. This environment has no
+SDKs, so the three wire protocols are implemented directly over urllib —
+S3's AWS SigV4 request signing and Azure's SharedKey authorization are
+pure hashlib/hmac; GCS authenticates with a bearer token (service-account
+JWT exchange needs RSA signing, which stdlib lacks — deployments supply
+``GCP_ACCESS_TOKEN`` the way workload identity would).
+
+The HTTP layer is injectable (``http(method, url, headers, body) ->
+(status, body)``) so tests run against an in-process emulator and the
+signing/URL construction is still exercised end to end.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import hashlib
+import hmac
+import json
+import os
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Optional
+
+HttpFn = Callable[[str, str, dict, bytes], tuple[int, bytes]]
+
+
+class ObjectStoreError(RuntimeError):
+    pass
+
+
+def urllib_http(method: str, url: str, headers: dict,
+                body: bytes) -> tuple[int, bytes]:
+    req = urllib.request.Request(url, data=body if body else None,
+                                 headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+    except (urllib.error.URLError, OSError) as e:
+        raise ObjectStoreError(f"object store unreachable: {url}: {e}")
+
+
+class ObjectStoreClient:
+    """put/get/delete/list over a bucket-like container."""
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+
+def _hmac256(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+class S3Client(ObjectStoreClient):
+    """AWS SigV4-signed S3 REST (virtual-host or path style)."""
+
+    def __init__(self, bucket: str, region: str = "us-east-1",
+                 access_key: str = "", secret_key: str = "",
+                 endpoint: str = "", http: Optional[HttpFn] = None):
+        self.bucket = bucket
+        self.region = region
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get(
+            "AWS_SECRET_ACCESS_KEY", "")
+        # custom endpoint (minio/emulator) uses path-style addressing
+        self.endpoint = endpoint.rstrip("/") if endpoint else \
+            f"https://{bucket}.s3.{region}.amazonaws.com"
+        self.path_style = bool(endpoint)
+        self.http = http or urllib_http
+
+    def _sign(self, method: str, path: str, query: str,
+              payload: bytes) -> dict:
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amzdate = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        host = urllib.parse.urlparse(self.endpoint).netloc
+        payload_hash = hashlib.sha256(payload).hexdigest()
+        canonical_headers = (f"host:{host}\n"
+                             f"x-amz-content-sha256:{payload_hash}\n"
+                             f"x-amz-date:{amzdate}\n")
+        signed = "host;x-amz-content-sha256;x-amz-date"
+        creq = "\n".join([method, path, query, canonical_headers, signed,
+                          payload_hash])
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        sts = "\n".join(["AWS4-HMAC-SHA256", amzdate, scope,
+                         hashlib.sha256(creq.encode()).hexdigest()])
+        k = _hmac256(("AWS4" + self.secret_key).encode(), datestamp)
+        k = _hmac256(k, self.region)
+        k = _hmac256(k, "s3")
+        k = _hmac256(k, "aws4_request")
+        sig = hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
+        return {
+            "x-amz-date": amzdate,
+            "x-amz-content-sha256": payload_hash,
+            "Authorization": (
+                f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+                f"SignedHeaders={signed}, Signature={sig}"),
+        }
+
+    def _request(self, method: str, key: str, query: str = "",
+                 body: bytes = b"") -> tuple[int, bytes]:
+        kpath = urllib.parse.quote(key, safe="/~-._")
+        path = (f"/{self.bucket}/{kpath}" if self.path_style
+                else f"/{kpath}").rstrip("/") or "/"
+        headers = self._sign(method, path, query, body)
+        url = self.endpoint + path + (f"?{query}" if query else "")
+        return self.http(method, url, headers, body)
+
+    def put(self, key: str, data: bytes) -> None:
+        status, body = self._request("PUT", key, body=data)
+        if status not in (200, 201):
+            raise ObjectStoreError(f"s3 put {key}: HTTP {status} {body[:200]}")
+
+    def get(self, key: str) -> Optional[bytes]:
+        status, body = self._request("GET", key)
+        if status == 404:
+            return None
+        if status != 200:
+            raise ObjectStoreError(f"s3 get {key}: HTTP {status}")
+        return body
+
+    def delete(self, key: str) -> None:
+        status, _ = self._request("DELETE", key)
+        if status not in (200, 204, 404):
+            raise ObjectStoreError(f"s3 delete {key}: HTTP {status}")
+
+    def list(self, prefix: str) -> list[str]:
+        # ListObjectsV2 with continuation-token pagination (a truncated
+        # listing silently dropping keys would make restores partial);
+        # query params must be canonical-sorted for SigV4
+        import re
+
+        keys: list[str] = []
+        token = ""
+        while True:
+            parts = ["list-type=2",
+                     "prefix=" + urllib.parse.quote(prefix, safe="")]
+            if token:
+                parts.append("continuation-token="
+                             + urllib.parse.quote(token, safe=""))
+            q = "&".join(sorted(parts))
+            status, body = self._request("GET", "", query=q)
+            if status != 200:
+                raise ObjectStoreError(f"s3 list {prefix}: HTTP {status}")
+            text = body.decode()
+            keys.extend(re.findall(r"<Key>([^<]+)</Key>", text))
+            m = re.search(r"<NextContinuationToken>([^<]+)"
+                          r"</NextContinuationToken>", text)
+            if not m or "<IsTruncated>true</IsTruncated>" not in text:
+                break
+            token = m.group(1)
+        return keys
+
+
+class GCSClient(ObjectStoreClient):
+    """GCS JSON API with bearer-token auth."""
+
+    def __init__(self, bucket: str, token: str = "", endpoint: str = "",
+                 http: Optional[HttpFn] = None):
+        self.bucket = bucket
+        self.token = token or os.environ.get("GCP_ACCESS_TOKEN", "")
+        self.endpoint = (endpoint.rstrip("/")
+                         or "https://storage.googleapis.com")
+        self.http = http or urllib_http
+
+    def _headers(self) -> dict:
+        return {"Authorization": f"Bearer {self.token}"} if self.token else {}
+
+    def put(self, key: str, data: bytes) -> None:
+        url = (f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o"
+               f"?uploadType=media&name={urllib.parse.quote(key, safe='')}")
+        status, body = self.http("POST", url, self._headers(), data)
+        if status not in (200, 201):
+            raise ObjectStoreError(f"gcs put {key}: HTTP {status}")
+
+    def get(self, key: str) -> Optional[bytes]:
+        url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o/"
+               f"{urllib.parse.quote(key, safe='')}?alt=media")
+        status, body = self.http("GET", url, self._headers(), b"")
+        if status == 404:
+            return None
+        if status != 200:
+            raise ObjectStoreError(f"gcs get {key}: HTTP {status}")
+        return body
+
+    def delete(self, key: str) -> None:
+        url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o/"
+               f"{urllib.parse.quote(key, safe='')}")
+        status, _ = self.http("DELETE", url, self._headers(), b"")
+        if status not in (200, 204, 404):
+            raise ObjectStoreError(f"gcs delete {key}: HTTP {status}")
+
+    def list(self, prefix: str) -> list[str]:
+        keys: list[str] = []
+        token = ""
+        while True:
+            url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o"
+                   f"?prefix={urllib.parse.quote(prefix, safe='')}")
+            if token:
+                url += f"&pageToken={urllib.parse.quote(token, safe='')}"
+            status, body = self.http("GET", url, self._headers(), b"")
+            if status != 200:
+                raise ObjectStoreError(f"gcs list {prefix}: HTTP {status}")
+            out = json.loads(body)
+            keys.extend(it["name"] for it in out.get("items", []))
+            token = out.get("nextPageToken", "")
+            if not token:
+                break
+        return keys
+
+
+class AzureClient(ObjectStoreClient):
+    """Azure Blob REST with SharedKey authorization."""
+
+    VERSION = "2021-08-06"
+
+    def __init__(self, account: str, container: str, key: str = "",
+                 endpoint: str = "", http: Optional[HttpFn] = None):
+        self.account = account
+        self.container = container
+        self.key = key or os.environ.get("AZURE_STORAGE_KEY", "")
+        self.endpoint = (endpoint.rstrip("/")
+                         or f"https://{account}.blob.core.windows.net")
+        self.http = http or urllib_http
+
+    def _auth(self, method: str, path: str, query: dict,
+              length: int, extra_ms: dict) -> dict:
+        now = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%a, %d %b %Y %H:%M:%S GMT")
+        ms = {"x-ms-date": now, "x-ms-version": self.VERSION, **extra_ms}
+        canon_headers = "".join(
+            f"{k}:{v}\n" for k, v in sorted(ms.items()))
+        canon_resource = f"/{self.account}{path}" + "".join(
+            f"\n{k}:{v}" for k, v in sorted(query.items()))
+        sts = "\n".join([
+            method, "", "", str(length) if length else "", "", "", "", "",
+            "", "", "", "", canon_headers + canon_resource])
+        sig = base64.b64encode(hmac.new(
+            base64.b64decode(self.key) if self.key else b"",
+            sts.encode(), hashlib.sha256).digest()).decode()
+        return {**ms, "Authorization": f"SharedKey {self.account}:{sig}"}
+
+    def _request(self, method: str, blob: str, query: dict,
+                 body: bytes = b"", extra_ms: Optional[dict] = None
+                 ) -> tuple[int, bytes]:
+        bpath = urllib.parse.quote(blob, safe="/~-._")
+        path = f"/{self.container}/{bpath}" if blob else f"/{self.container}"
+        headers = self._auth(method, path, query, len(body), extra_ms or {})
+        qs = urllib.parse.urlencode(query)
+        url = self.endpoint + path + (f"?{qs}" if qs else "")
+        return self.http(method, url, headers, body)
+
+    def put(self, key: str, data: bytes) -> None:
+        status, body = self._request(
+            "PUT", key, {}, data, {"x-ms-blob-type": "BlockBlob"})
+        if status not in (200, 201):
+            raise ObjectStoreError(f"azure put {key}: HTTP {status}")
+
+    def get(self, key: str) -> Optional[bytes]:
+        status, body = self._request("GET", key, {})
+        if status == 404:
+            return None
+        if status != 200:
+            raise ObjectStoreError(f"azure get {key}: HTTP {status}")
+        return body
+
+    def delete(self, key: str) -> None:
+        status, _ = self._request("DELETE", key, {})
+        if status not in (200, 202, 204, 404):
+            raise ObjectStoreError(f"azure delete {key}: HTTP {status}")
+
+    def list(self, prefix: str) -> list[str]:
+        import re
+
+        keys: list[str] = []
+        marker = ""
+        while True:
+            q = {"comp": "list", "prefix": prefix, "restype": "container"}
+            if marker:
+                q["marker"] = marker
+            status, body = self._request("GET", "", q)
+            if status != 200:
+                raise ObjectStoreError(f"azure list {prefix}: HTTP {status}")
+            text = body.decode()
+            keys.extend(re.findall(r"<Name>([^<]+)</Name>", text))
+            m = re.search(r"<NextMarker>([^<]+)</NextMarker>", text)
+            if not m:
+                break
+            marker = m.group(1)
+        return keys
+
+
+def make_client(provider: str, http: Optional[HttpFn] = None
+                ) -> ObjectStoreClient:
+    """Env-configured client (reference module env vars:
+    BACKUP_S3_BUCKET/BACKUP_GCS_BUCKET/BACKUP_AZURE_CONTAINER...). An
+    unconfigured provider raises KeyError so API handlers answer 422, the
+    same as a reference deployment without the module enabled."""
+    if provider == "s3":
+        bucket = os.environ.get("BACKUP_S3_BUCKET", "")
+        if not bucket:
+            raise KeyError("backup backend 's3' not configured "
+                           "(set BACKUP_S3_BUCKET)")
+        return S3Client(
+            bucket=bucket,
+            region=os.environ.get("AWS_REGION", "us-east-1"),
+            endpoint=os.environ.get("BACKUP_S3_ENDPOINT", ""),
+            http=http)
+    if provider == "gcs":
+        bucket = os.environ.get("BACKUP_GCS_BUCKET", "")
+        if not bucket:
+            raise KeyError("backup backend 'gcs' not configured "
+                           "(set BACKUP_GCS_BUCKET)")
+        return GCSClient(
+            bucket=bucket,
+            endpoint=os.environ.get("BACKUP_GCS_ENDPOINT", ""),
+            http=http)
+    if provider == "azure":
+        container = os.environ.get("BACKUP_AZURE_CONTAINER", "")
+        if not container:
+            raise KeyError("backup backend 'azure' not configured "
+                           "(set BACKUP_AZURE_CONTAINER)")
+        return AzureClient(
+            account=os.environ.get("AZURE_STORAGE_ACCOUNT", ""),
+            container=container,
+            endpoint=os.environ.get("BACKUP_AZURE_ENDPOINT", ""),
+            http=http)
+    raise KeyError(f"unknown object-store provider {provider!r}")

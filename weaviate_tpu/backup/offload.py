@@ -1,0 +1,140 @@
+"""FROZEN-tenant offload tier + usage reporting over object stores.
+
+Reference: ``modules/offload-s3`` (FREEZING uploads tenant shard files to a
+bucket, UNFREEZING downloads them back) and ``modules/usage-{s3,gcs}`` +
+``cluster/usage`` (periodic usage reports written to a bucket). The local
+filesystem tier stays the default (zero-egress); setting
+``OFFLOAD_S3_BUCKET`` (reference's env) routes frozen tenants through the
+S3 client instead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from weaviate_tpu.backup.object_store import (
+    GCSClient,
+    HttpFn,
+    ObjectStoreClient,
+    S3Client,
+)
+
+
+class ObjectStoreOffloader:
+    """Move tenant shard directories to/from an object store under
+    ``offload/<collection>/<tenant>/``."""
+
+    def __init__(self, client: ObjectStoreClient):
+        self.client = client
+
+    def _prefix(self, collection: str, tenant: str) -> str:
+        return f"offload/{collection}/{tenant}/"
+
+    def upload(self, collection: str, tenant: str, shard_dir: str) -> int:
+        pre = self._prefix(collection, tenant)
+        # clear any previous frozen copy first: after unfreeze+compaction
+        # the re-frozen file set shrinks, and stale segment keys left in
+        # the bucket would resurrect deleted data on the next download
+        # (the filesystem tier's rmtree-before-move invariant)
+        for stale in self.client.list(pre):
+            self.client.delete(stale)
+        n = 0
+        for dirpath, _dirs, files in os.walk(shard_dir):
+            for fn in files:
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, shard_dir).replace(os.sep, "/")
+                with open(full, "rb") as f:
+                    self.client.put(pre + rel, f.read())
+                n += 1
+        return n
+
+    def download(self, collection: str, tenant: str, shard_dir: str) -> int:
+        pre = self._prefix(collection, tenant)
+        n = 0
+        for key in self.client.list(pre):
+            rel = key[len(pre):]
+            if not rel or rel.startswith("/") or ".." in rel.split("/"):
+                continue  # hostile key names must not escape shard_dir
+            dst = os.path.join(shard_dir, *rel.split("/"))
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            data = self.client.get(key)
+            if data is not None:
+                with open(dst, "wb") as f:
+                    f.write(data)
+                n += 1
+        return n
+
+    def exists(self, collection: str, tenant: str) -> bool:
+        return bool(self.client.list(self._prefix(collection, tenant)))
+
+
+def get_offloader(http: Optional[HttpFn] = None
+                  ) -> Optional[ObjectStoreOffloader]:
+    """Env-gated (reference offload-s3 registers only when configured)."""
+    bucket = os.environ.get("OFFLOAD_S3_BUCKET", "")
+    if not bucket:
+        return None
+    return ObjectStoreOffloader(S3Client(
+        bucket=bucket,
+        region=os.environ.get("AWS_REGION", "us-east-1"),
+        endpoint=os.environ.get("OFFLOAD_S3_ENDPOINT", ""),
+        http=http))
+
+
+class UsageReporter:
+    """Periodic usage snapshots to a bucket (reference ``cluster/usage`` +
+    ``modules/usage-{s3,gcs}``: per-node collection/shard/object counts
+    written as JSON for billing/ops pipelines)."""
+
+    def __init__(self, db, client: ObjectStoreClient, node: str = "node-0",
+                 prefix: str = "usage"):
+        self.db = db
+        self.client = client
+        self.node = node
+        self.prefix = prefix
+        self.reports = 0
+
+    def build_report(self) -> dict:
+        cols = {}
+        for name in self.db.collections():
+            try:
+                c = self.db.get_collection(name)
+                st = c.stats()
+                cols[name] = {
+                    "objects": st.get("objects"),
+                    "shards": len(st.get("shards", {})),
+                    "tenants": len(st.get("tenants", {})),
+                }
+            except Exception:
+                continue
+        return {"node": self.node, "ts": time.time(),
+                "collections": cols}
+
+    def report_once(self) -> str:
+        rep = self.build_report()
+        key = (f"{self.prefix}/{self.node}/"
+               f"{time.strftime('%Y%m%dT%H%M%SZ', time.gmtime())}.json")
+        self.client.put(key, json.dumps(rep).encode())
+        self.reports += 1
+        return key
+
+
+def get_usage_reporter(db, http: Optional[HttpFn] = None
+                       ) -> Optional[UsageReporter]:
+    node = os.environ.get("CLUSTER_HOSTNAME", "node-0")
+    s3b = os.environ.get("USAGE_S3_BUCKET", "")
+    if s3b:
+        return UsageReporter(db, S3Client(
+            bucket=s3b, region=os.environ.get("AWS_REGION", "us-east-1"),
+            endpoint=os.environ.get("USAGE_S3_ENDPOINT", ""), http=http),
+            node=node)
+    gcsb = os.environ.get("USAGE_GCS_BUCKET", "")
+    if gcsb:
+        return UsageReporter(db, GCSClient(
+            bucket=gcsb,
+            endpoint=os.environ.get("USAGE_GCS_ENDPOINT", ""), http=http),
+            node=node)
+    return None

@@ -12,6 +12,7 @@ import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
 from typing import Any, Optional
 
 import numpy as np
@@ -40,6 +41,7 @@ class Collection:
         self._lock = threading.RLock()
         self._shards: dict[str, Shard] = {}
         self._tenant_status: dict[str, str] = {}
+        self._maintenance_pause = 0  # backup copy windows (counter)
         self._pool = ThreadPoolExecutor(max_workers=8)
         if not config.multi_tenancy.enabled:
             for i in range(max(1, config.sharding.desired_count)):
@@ -92,6 +94,11 @@ class Collection:
                 # cross-collection ref-filter hook (reference
                 # inverted/searcher.go ref-filter recursion)
                 s.inverted.ref_resolver = self._resolve_ref_filter
+                # a shard born inside a backup copy window inherits the
+                # pause, otherwise its compaction could delete files the
+                # backup walk already listed
+                for _ in range(self._maintenance_pause):
+                    s.store.pause_maintenance()
                 self._shards[name] = s
             return s
 
@@ -234,6 +241,9 @@ class Collection:
                 s = self._shards.pop(f"tenant-{name}", None)
                 if s is not None:
                     s.close()
+            from weaviate_tpu.backup.offload import get_offloader
+
+            off = get_offloader()
             if status == TENANT_FROZEN and prev != TENANT_FROZEN:
                 # offload: shard files leave the hot data root entirely
                 # (reference FREEZING -> upload -> FROZEN; synchronous
@@ -241,14 +251,24 @@ class Collection:
                 # there are hot files to replace it with — never deleted
                 # on a freeze of an empty/recreated tenant.
                 if os.path.exists(shard_dir):
-                    os.makedirs(os.path.dirname(frozen_dir), exist_ok=True)
-                    if os.path.exists(frozen_dir):
-                        shutil.rmtree(frozen_dir)
-                    shutil.move(shard_dir, frozen_dir)
+                    if off is not None:
+                        # offload-s3 tier: files go to the bucket
+                        off.upload(self.config.name, name, shard_dir)
+                        shutil.rmtree(shard_dir)
+                    else:
+                        os.makedirs(os.path.dirname(frozen_dir),
+                                    exist_ok=True)
+                        if os.path.exists(frozen_dir):
+                            shutil.rmtree(frozen_dir)
+                        shutil.move(shard_dir, frozen_dir)
             elif prev == TENANT_FROZEN and status != TENANT_FROZEN:
                 # onload (UNFREEZING -> HOT/COLD): files come back before
                 # the shard may open
-                if os.path.exists(frozen_dir):
+                if off is not None and off.exists(self.config.name, name):
+                    if os.path.exists(shard_dir):
+                        shutil.rmtree(shard_dir)
+                    off.download(self.config.name, name, shard_dir)
+                elif os.path.exists(frozen_dir):
                     if os.path.exists(shard_dir):
                         shutil.rmtree(shard_dir)
                     shutil.move(frozen_dir, shard_dir)
@@ -755,6 +775,38 @@ class Collection:
     def flush(self) -> None:
         for s in self._shards.values():
             s.flush()
+
+    @contextmanager
+    def maintenance_paused(self):
+        """Freeze segment-set mutations across every shard for the duration
+        (backup copy window; reference ``shard_backup.go`` BeginBackup →
+        pause compaction+flush → copy → ResumeMaintenance). Writes continue
+        into WAL+memtable. Shards created while paused inherit the pause
+        (see ``_get_shard``)."""
+        with self._lock:
+            self._maintenance_pause += 1
+            shards = list(self._shards.values())
+        for s in shards:
+            s.store.pause_maintenance()
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._maintenance_pause -= 1
+                now = list(self._shards.values())
+            # resume every shard that is currently paused — including ones
+            # born (and pre-paused) during the window
+            for s in now:
+                s.store.resume_maintenance()
+
+    def compact_once(self, min_segments: int = 4) -> None:
+        """One background-compaction pass over all shards."""
+        with self._lock:
+            if self._maintenance_pause:
+                return
+            shards = list(self._shards.values())
+        for s in shards:
+            s.store.compact_all(min_segments)
 
     def close(self) -> None:
         for s in self._shards.values():

@@ -38,11 +38,24 @@ class DB:
         self.cycles = CycleManager()
         self.cycles.register("object_ttl", self._ttl_cycle, 60.0)
         self.cycles.register("metrics_refresh", self._metrics_cycle, 30.0)
+        self.cycles.register("compaction", self._compaction_cycle, 60.0)
+        # usage reports to a bucket when USAGE_{S3,GCS}_BUCKET configured
+        # (reference modules/usage-* default interval 1h)
+        from weaviate_tpu.backup.offload import get_usage_reporter
+
+        self.usage_reporter = get_usage_reporter(self)
+        if self.usage_reporter is not None:
+            self.cycles.register(
+                "usage_report", self.usage_reporter.report_once, 3600.0)
         self.cycles.start()
 
     def _ttl_cycle(self) -> None:
         for c in list(self._collections.values()):
             c.expire_ttl_once()
+
+    def _compaction_cycle(self) -> None:
+        for c in list(self._collections.values()):
+            c.compact_once()
 
     def _metrics_cycle(self) -> None:
         from weaviate_tpu.monitoring.metrics import (

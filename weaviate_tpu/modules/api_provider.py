@@ -294,6 +294,11 @@ class APIMultiModal(_APIBase, MultiModalVectorizer):
         if self.spec.style == "local":
             rows = [self._call({"image": b})["vector"] for b in images_b64]
             return _f32(rows)
+        if self.spec.style == "bedrock":
+            # titan image embedding takes one image per request
+            rows = [self._call({"inputImage": b})["embedding"]
+                    for b in images_b64]
+            return _f32(rows)
         if self.spec.style == "cohere":
             out = self._call({"model": self.model, "input_type": "image",
                               "images": list(images_b64)})

@@ -278,6 +278,42 @@ class SpellCheck(SpellChecker):
                 "changes": changes}
 
 
+class TransformersReranker(Reranker):
+    """Cross-encoder reranker (reference ``modules/reranker-transformers``:
+    a self-hosted cross-encoder service). Uses a cached HF text-
+    classification pipeline when present; otherwise falls back to the
+    lexical BM25-ish scorer so reranking stays functional offline."""
+
+    name = "reranker-transformers"
+
+    def __init__(self, model: str = "cross-encoder/ms-marco-MiniLM-L-6-v2"):
+        self._model_name = model
+        self._pipe = None
+        self._probed = False
+
+    def _backend(self):
+        if not self._probed:
+            self._pipe = _try_pipeline("text-classification", self._model_name)
+            self._probed = True
+        return self._pipe
+
+    def meta(self) -> dict:
+        m = super().meta()
+        m["backend"] = ("transformers" if self._pipe is not None
+                        else ("lexical" if self._probed else "lazy"))
+        return m
+
+    def rerank(self, query: str, documents: Sequence[str]) -> list[float]:
+        pipe = self._backend()
+        if pipe is not None:
+            out = pipe([{"text": query, "text_pair": d} for d in documents],
+                       truncation=True)
+            return [float(r["score"]) for r in out]
+        from weaviate_tpu.modules.reranker_lexical import LexicalReranker
+
+        return LexicalReranker().rerank(query, documents)
+
+
 # ---------------------------------------------------------------------------
 # dummy providers (reference generative-dummy / multi2vec-dummy /
 # reranker-dummy: deterministic no-network CI modules)

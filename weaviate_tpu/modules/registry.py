@@ -90,6 +90,7 @@ def default_registry() -> ModuleRegistry:
         SpellCheck,
         TransformersNER,
         TransformersQnA,
+        TransformersReranker,
         TransformersSummarizer,
     )
     from weaviate_tpu.modules.generative_template import TemplateGenerative
@@ -126,6 +127,7 @@ def default_registry() -> ModuleRegistry:
     reg.register(TransformersQnA())
     reg.register(TransformersSummarizer())
     reg.register(TransformersNER())
+    reg.register(TransformersReranker())
     reg.register(SpellCheck())
     reg.register(DummyGenerative())
     reg.register(DummyReranker())

@@ -49,6 +49,7 @@ class Bucket:
         self._mem: dict[bytes, Any] = {}
         self._segments: list[Segment] = []
         self._seg_seq = 0
+        self._paused = 0  # maintenance (flush/compact) pause counter
         self._open(sync)
 
     def _open(self, sync: bool) -> None:
@@ -176,13 +177,27 @@ class Bucket:
         return sum(1 for _ in self.keys())
 
     # -- flush / compaction ----------------------------------------------
+    def pause_maintenance(self) -> None:
+        """Stop segment-set mutations (flush + compaction) so a backup can
+        copy a stable file set while WRITES keep landing in WAL+memtable —
+        reference ``bucket_pauses.go`` PauseCompaction/FlushMemtable
+        ordering. Re-entrant via a counter."""
+        with self._lock:
+            self._paused += 1
+
+    def resume_maintenance(self) -> None:
+        with self._lock:
+            self._paused = max(0, self._paused - 1)
+
     def _maybe_flush(self) -> None:
+        if self._paused:
+            return  # deferred until resume; WAL holds the overflow
         if len(self._mem) >= self.memtable_max_entries:
             self.flush_memtable()
 
     def flush_memtable(self) -> None:
         with self._lock:
-            if not self._mem:
+            if self._paused or not self._mem:
                 return
             path = os.path.join(self.dir, f"segment-{self._seg_seq:06d}.db")
             self._seg_seq += 1
@@ -201,7 +216,7 @@ class Bucket:
         k-way merge reads each segment sequentially and the new segment is
         written as the merge drains."""
         with self._lock:
-            if len(self._segments) <= 1:
+            if self._paused or len(self._segments) <= 1:
                 return
             old = self._segments
             path = os.path.join(self.dir, f"segment-{self._seg_seq:06d}.db")
@@ -265,3 +280,25 @@ class Store:
         with self._lock:
             for b in self._buckets.values():
                 b.flush_memtable()
+
+    def pause_maintenance(self) -> None:
+        """Backup snapshot isolation (reference ``store_snapshot.go`` +
+        ``bucket_pauses.go``): freeze every bucket's segment set."""
+        with self._lock:
+            for b in self._buckets.values():
+                b.pause_maintenance()
+
+    def resume_maintenance(self) -> None:
+        with self._lock:
+            for b in self._buckets.values():
+                b.resume_maintenance()
+
+    def compact_all(self, min_segments: int = 4) -> None:
+        """Background compaction entry (reference cyclemanager-driven
+        ``segment_group_compaction.go``): merge any bucket whose segment
+        stack is at least ``min_segments`` deep."""
+        with self._lock:
+            buckets = list(self._buckets.values())
+        for b in buckets:
+            if len(b._segments) >= min_segments:
+                b.compact()
