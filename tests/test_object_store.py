@@ -367,3 +367,48 @@ def test_backup_pauses_compaction_during_copy(tmp_path):
     bucket.compact()
     assert bucket.get(b"k-new") == b"v"
     db.close()
+
+
+def test_backup_includes_frozen_tenants(tmp_path, monkeypatch):
+    """FROZEN tenant files live in the offload tier outside col.dir; a
+    backup must carry them and restore must put them back where an
+    unfreeze expects them."""
+    monkeypatch.setenv("OFFLOAD_FS_PATH", str(tmp_path / "offload"))
+    db = DB(str(tmp_path / "db"))
+    db.create_collection(CollectionConfig(
+        name="FT",
+        properties=[Property(name="t", data_type=DataType.TEXT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        multi_tenancy=MultiTenancyConfig(enabled=True)))
+    col = db.get_collection("FT")
+    col.add_tenant("cold-co")
+    vecs = np.eye(8, dtype=np.float32)
+    col.put_batch([StorageObject(
+        uuid=f"99000000-0000-0000-0000-{i:012d}", collection="FT",
+        properties={"t": f"doc {i}"}, vector=vecs[i], tenant="cold-co")
+        for i in range(8)], tenant="cold-co")
+    col.set_tenant_status("cold-co", "FROZEN")
+
+    from weaviate_tpu.backup.backends import FilesystemBackend
+
+    backend = FilesystemBackend(str(tmp_path / "bk"))
+    h = BackupHandler(db)
+    st = h.create(backend, "fbk")
+    assert st["status"] == "SUCCESS", st
+    assert any("__frozen__" in f for f in backend.list_files("fbk"))
+    db.close()
+
+    # fresh node: different data root, same backup
+    monkeypatch.setenv("OFFLOAD_FS_PATH", str(tmp_path / "offload2"))
+    db2 = DB(str(tmp_path / "db2"))
+    h2 = BackupHandler(db2)
+    out = h2.restore(backend, "fbk")
+    assert out["classes"] == ["FT"]
+    col2 = db2.get_collection("FT")
+    assert col2.tenants()["cold-co"] == "FROZEN"
+    col2.set_tenant_status("cold-co", "HOT")
+    hits = col2.vector_search(vecs[3], k=1, tenant="cold-co")
+    assert hits[0][0].properties["t"] == "doc 3"
+    assert col2.count(tenant="cold-co") == 8
+    db2.close()

@@ -103,6 +103,7 @@ def property_from_rest(p: dict) -> Property:
             "indexSearchable",
             data_type in (DataType.TEXT, DataType.TEXT_ARRAY),
         ),
+        index_range_filters=p.get("indexRangeFilters", False),
         description=p.get("description", ""),
         target_collection=(
             dt0 if data_type == DataType.REFERENCE else ""),
@@ -192,10 +193,16 @@ def class_to_rest(cfg: CollectionConfig) -> dict:
     for p in cfg.properties:
         props.append({
             "name": p.name,
-            "dataType": [p.data_type.value],
+            # cross-refs serialize as ["TargetClass"] on the wire
+            # (reference schema JSON), not the internal "cref" tag
+            "dataType": [p.target_collection
+                         if (p.data_type == DataType.REFERENCE
+                             and p.target_collection)
+                         else p.data_type.value],
             "tokenization": p.tokenization.value,
             "indexFilterable": p.index_filterable,
             "indexSearchable": p.index_searchable,
+            "indexRangeFilters": p.index_range_filters,
             "description": p.description,
         })
 

@@ -127,16 +127,13 @@ class ObjectStoreBackend(BackupBackend):
         return f"{backup_id}/{rel}" if rel else backup_id
 
     def put_file(self, backup_id: str, rel_path: str, src_path: str) -> None:
-        with open(src_path, "rb") as f:
-            self.client.put(self._key(backup_id, rel_path), f.read())
+        # streams from disk (multi-GB segments never materialize in RAM)
+        self.client.put_file(self._key(backup_id, rel_path), src_path)
 
     def get_file(self, backup_id: str, rel_path: str, dst_path: str) -> None:
-        data = self.client.get(self._key(backup_id, rel_path))
-        if data is None:
+        if not self.client.get_to_file(
+                self._key(backup_id, rel_path), dst_path):
             raise FileNotFoundError(f"{backup_id}/{rel_path}")
-        os.makedirs(os.path.dirname(dst_path), exist_ok=True)
-        with open(dst_path, "wb") as f:
-            f.write(data)
 
     def put_meta(self, backup_id: str, data: bytes) -> None:
         self.client.put(self._key(backup_id, "backup.json"), data)

@@ -84,10 +84,42 @@ class BackupHandler:
                                     cls, os.path.relpath(full, base))
                                 backend.put_file(backup_id, rel, full)
                                 files.append(rel)
+                        # FROZEN tenants live in the local offload tier,
+                        # outside col.dir — without these files a restore
+                        # would recreate the tenant FROZEN but empty.
+                        # (Bucket-offloaded tenants already sit in durable
+                        # object storage; the manifest records that.)
+                        frozen_root = col._offload_root()
+                        offloaded = []
+                        from weaviate_tpu.backup.offload import (
+                            get_offloader,
+                        )
+
+                        bucket_off = get_offloader()
+                        for tname, tstatus in col.tenants().items():
+                            if tstatus != "FROZEN":
+                                continue
+                            fdir = os.path.join(frozen_root, tname)
+                            if os.path.isdir(fdir):
+                                for dirpath, _dirs, fnames in os.walk(fdir):
+                                    for fn in fnames:
+                                        full = os.path.join(dirpath, fn)
+                                        rel = os.path.join(
+                                            cls, "__frozen__", tname,
+                                            os.path.relpath(full, fdir))
+                                        backend.put_file(
+                                            backup_id, rel, full)
+                                        files.append(rel)
+                            elif bucket_off is not None and \
+                                    bucket_off.exists(cls, tname):
+                                offloaded.append(tname)
                     manifest["classes"][cls] = {
                         "config": col.config.to_dict(),
                         "files": files,
                         "tenants": col.tenants(),
+                        # frozen tenants whose data stays in the offload
+                        # bucket (not copied into the backup)
+                        "bucket_offloaded_tenants": offloaded,
                     }
                 status["status"] = STATUS_SUCCESS
                 status["completed_at"] = time.time()
@@ -150,13 +182,24 @@ class BackupHandler:
             shutil.rmtree(tmp_dir, ignore_errors=True)
             from weaviate_tpu.backup.backends import confine
 
+            frozen_prefix = os.path.join(cls, "__frozen__")
+            offload_base = os.environ.get(
+                "OFFLOAD_FS_PATH", os.path.join(self.db.root, "_offload"))
             try:
                 os.makedirs(tmp_dir, exist_ok=True)
                 for rel in entry["files"]:
                     inner = os.path.relpath(rel, cls)
-                    # a tampered manifest must not write outside tmp_dir
-                    dst = os.path.normpath(os.path.join(tmp_dir, inner))
-                    confine(tmp_dir, dst)
+                    if rel.startswith(frozen_prefix + os.sep):
+                        # frozen-tenant files restore into the offload
+                        # tier, where unfreezing expects them
+                        sub = os.path.relpath(rel, frozen_prefix)
+                        dst = os.path.normpath(
+                            os.path.join(offload_base, cls, sub))
+                        confine(os.path.join(offload_base, cls), dst)
+                    else:
+                        # a tampered manifest must not escape tmp_dir
+                        dst = os.path.normpath(os.path.join(tmp_dir, inner))
+                        confine(tmp_dir, dst)
                     backend.get_file(backup_id, rel, dst)
                 os.replace(tmp_dir, target_dir)
                 cfg = CollectionConfig.from_dict(entry["config"])

@@ -34,7 +34,10 @@ class ObjectStoreError(RuntimeError):
 
 
 def urllib_http(method: str, url: str, headers: dict,
-                body: bytes) -> tuple[int, bytes]:
+                body) -> tuple[int, bytes]:
+    """``body`` may be bytes or a file-like object (uploads stream from
+    disk instead of materializing multi-GB segments in RAM; callers set
+    Content-Length for file bodies)."""
     req = urllib.request.Request(url, data=body if body else None,
                                  headers=headers, method=method)
     try:
@@ -42,6 +45,40 @@ def urllib_http(method: str, url: str, headers: dict,
             return r.status, r.read()
     except urllib.error.HTTPError as e:
         return e.code, e.read()
+    except (urllib.error.URLError, OSError) as e:
+        raise ObjectStoreError(f"object store unreachable: {url}: {e}")
+
+
+def _sha256_file(path: str) -> tuple[str, int]:
+    h = hashlib.sha256()
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+            n += len(chunk)
+    return h.hexdigest(), n
+
+
+def _urllib_get_to_file(url: str, headers: dict, dst: str) -> bool:
+    """Chunked GET → file (downloads never materialize whole objects)."""
+    import shutil as _shutil
+
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            tmp = dst + ".dl"
+            with open(tmp, "wb") as f:
+                _shutil.copyfileobj(r, f, 1 << 20)
+            os.replace(tmp, dst)
+            return True
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return False
+        raise ObjectStoreError(f"get {url}: HTTP {e.code}")
     except (urllib.error.URLError, OSError) as e:
         raise ObjectStoreError(f"object store unreachable: {url}: {e}")
 
@@ -60,6 +97,22 @@ class ObjectStoreClient:
 
     def list(self, prefix: str) -> list[str]:
         raise NotImplementedError
+
+    # file-path variants so multi-GB segment files stream instead of
+    # materializing in RAM; subclasses override when the wire protocol
+    # allows a file-like body (custom test transports use these defaults)
+    def put_file(self, key: str, path: str) -> None:
+        with open(path, "rb") as f:
+            self.put(key, f.read())
+
+    def get_to_file(self, key: str, dst: str) -> bool:
+        data = self.get(key)
+        if data is None:
+            return False
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        with open(dst, "wb") as f:
+            f.write(data)
+        return True
 
 
 def _hmac256(key: bytes, msg: str) -> bytes:
@@ -84,12 +137,12 @@ class S3Client(ObjectStoreClient):
         self.http = http or urllib_http
 
     def _sign(self, method: str, path: str, query: str,
-              payload: bytes) -> dict:
+              payload: bytes, payload_hash: str = "") -> dict:
         now = datetime.datetime.now(datetime.timezone.utc)
         amzdate = now.strftime("%Y%m%dT%H%M%SZ")
         datestamp = now.strftime("%Y%m%d")
         host = urllib.parse.urlparse(self.endpoint).netloc
-        payload_hash = hashlib.sha256(payload).hexdigest()
+        payload_hash = payload_hash or hashlib.sha256(payload).hexdigest()
         canonical_headers = (f"host:{host}\n"
                              f"x-amz-content-sha256:{payload_hash}\n"
                              f"x-amz-date:{amzdate}\n")
@@ -125,6 +178,29 @@ class S3Client(ObjectStoreClient):
         status, body = self._request("PUT", key, body=data)
         if status not in (200, 201):
             raise ObjectStoreError(f"s3 put {key}: HTTP {status} {body[:200]}")
+
+    def put_file(self, key: str, path: str) -> None:
+        if self.http is not urllib_http:
+            return super().put_file(key, path)
+        phash, length = _sha256_file(path)
+        kpath = urllib.parse.quote(key, safe="/~-._")
+        upath = (f"/{self.bucket}/{kpath}" if self.path_style
+                 else f"/{kpath}")
+        headers = self._sign("PUT", upath, "", b"", payload_hash=phash)
+        headers["Content-Length"] = str(length)
+        with open(path, "rb") as f:
+            status, body = urllib_http(
+                "PUT", self.endpoint + upath, headers, f)
+        if status not in (200, 201):
+            raise ObjectStoreError(f"s3 put {key}: HTTP {status}")
+
+    def get_to_file(self, key: str, dst: str) -> bool:
+        if self.http is not urllib_http:
+            return super().get_to_file(key, dst)
+        kpath = urllib.parse.quote(key, safe="/~-._")
+        upath = f"/{self.bucket}/{kpath}" if self.path_style else f"/{kpath}"
+        headers = self._sign("GET", upath, "", b"")
+        return _urllib_get_to_file(self.endpoint + upath, headers, dst)
 
     def get(self, key: str) -> Optional[bytes]:
         status, body = self._request("GET", key)
@@ -205,6 +281,25 @@ class GCSClient(ObjectStoreClient):
         if status not in (200, 204, 404):
             raise ObjectStoreError(f"gcs delete {key}: HTTP {status}")
 
+    def put_file(self, key: str, path: str) -> None:
+        if self.http is not urllib_http:
+            return super().put_file(key, path)
+        url = (f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o"
+               f"?uploadType=media&name={urllib.parse.quote(key, safe='')}")
+        headers = dict(self._headers())
+        headers["Content-Length"] = str(os.path.getsize(path))
+        with open(path, "rb") as f:
+            status, _ = urllib_http("POST", url, headers, f)
+        if status not in (200, 201):
+            raise ObjectStoreError(f"gcs put {key}: HTTP {status}")
+
+    def get_to_file(self, key: str, dst: str) -> bool:
+        if self.http is not urllib_http:
+            return super().get_to_file(key, dst)
+        url = (f"{self.endpoint}/storage/v1/b/{self.bucket}/o/"
+               f"{urllib.parse.quote(key, safe='')}?alt=media")
+        return _urllib_get_to_file(url, self._headers(), dst)
+
     def list(self, prefix: str) -> list[str]:
         keys: list[str] = []
         token = ""
@@ -283,6 +378,29 @@ class AzureClient(ObjectStoreClient):
         status, _ = self._request("DELETE", key, {})
         if status not in (200, 202, 204, 404):
             raise ObjectStoreError(f"azure delete {key}: HTTP {status}")
+
+    def put_file(self, key: str, path: str) -> None:
+        if self.http is not urllib_http:
+            return super().put_file(key, path)
+        length = os.path.getsize(path)
+        bpath = urllib.parse.quote(key, safe="/~-._")
+        upath = f"/{self.container}/{bpath}"
+        headers = self._auth("PUT", upath, {}, length,
+                             {"x-ms-blob-type": "BlockBlob"})
+        headers["Content-Length"] = str(length)
+        with open(path, "rb") as f:
+            status, _ = urllib_http(
+                "PUT", self.endpoint + upath, headers, f)
+        if status not in (200, 201):
+            raise ObjectStoreError(f"azure put {key}: HTTP {status}")
+
+    def get_to_file(self, key: str, dst: str) -> bool:
+        if self.http is not urllib_http:
+            return super().get_to_file(key, dst)
+        bpath = urllib.parse.quote(key, safe="/~-._")
+        upath = f"/{self.container}/{bpath}"
+        headers = self._auth("GET", upath, {}, 0, {})
+        return _urllib_get_to_file(self.endpoint + upath, headers, dst)
 
     def list(self, prefix: str) -> list[str]:
         import re

@@ -46,8 +46,7 @@ class ObjectStoreOffloader:
             for fn in files:
                 full = os.path.join(dirpath, fn)
                 rel = os.path.relpath(full, shard_dir).replace(os.sep, "/")
-                with open(full, "rb") as f:
-                    self.client.put(pre + rel, f.read())
+                self.client.put_file(pre + rel, full)  # streamed
                 n += 1
         return n
 
@@ -59,11 +58,7 @@ class ObjectStoreOffloader:
             if not rel or rel.startswith("/") or ".." in rel.split("/"):
                 continue  # hostile key names must not escape shard_dir
             dst = os.path.join(shard_dir, *rel.split("/"))
-            os.makedirs(os.path.dirname(dst), exist_ok=True)
-            data = self.client.get(key)
-            if data is not None:
-                with open(dst, "wb") as f:
-                    f.write(data)
+            if self.client.get_to_file(key, dst):
                 n += 1
         return n
 

@@ -231,47 +231,62 @@ class Collection:
             raise ValueError(f"invalid tenant status {status!r}")
         import shutil
 
+        from weaviate_tpu.backup.offload import get_offloader
+
         with self._lock:
             if name not in self._tenant_status:
                 raise KeyError(f"tenant {name!r} not found")
             prev = self._tenant_status[name]
+            if prev in ("FREEZING", "UNFREEZING"):
+                raise ValueError(
+                    f"tenant {name!r} has a transfer in flight")
             shard_dir = os.path.join(self.dir, f"tenant-{name}")
             frozen_dir = os.path.join(self._offload_root(), name)
             if status != TENANT_HOT:
                 s = self._shards.pop(f"tenant-{name}", None)
                 if s is not None:
                     s.close()
-            from weaviate_tpu.backup.offload import get_offloader
-
             off = get_offloader()
-            if status == TENANT_FROZEN and prev != TENANT_FROZEN:
-                # offload: shard files leave the hot data root entirely
-                # (reference FREEZING -> upload -> FROZEN; synchronous
-                # here). An existing frozen copy is only replaced when
-                # there are hot files to replace it with — never deleted
-                # on a freeze of an empty/recreated tenant.
-                if os.path.exists(shard_dir):
-                    if off is not None:
-                        # offload-s3 tier: files go to the bucket
-                        off.upload(self.config.name, name, shard_dir)
-                        shutil.rmtree(shard_dir)
-                    else:
-                        os.makedirs(os.path.dirname(frozen_dir),
-                                    exist_ok=True)
-                        if os.path.exists(frozen_dir):
-                            shutil.rmtree(frozen_dir)
-                        shutil.move(shard_dir, frozen_dir)
-            elif prev == TENANT_FROZEN and status != TENANT_FROZEN:
-                # onload (UNFREEZING -> HOT/COLD): files come back before
-                # the shard may open
-                if off is not None and off.exists(self.config.name, name):
-                    if os.path.exists(shard_dir):
-                        shutil.rmtree(shard_dir)
-                    off.download(self.config.name, name, shard_dir)
-                elif os.path.exists(frozen_dir):
+            freezing = (status == TENANT_FROZEN and prev != TENANT_FROZEN
+                        and os.path.exists(shard_dir))
+            unfreezing = (prev == TENANT_FROZEN and status != TENANT_FROZEN)
+            if freezing and off is not None:
+                # bucket transfers are slow (one PUT per file): mark
+                # FREEZING and release the lock so other tenants keep
+                # serving (reference FREEZING -> upload -> FROZEN)
+                self._tenant_status[name] = "FREEZING"
+            elif unfreezing and off is not None \
+                    and off.exists(self.config.name, name):
+                self._tenant_status[name] = "UNFREEZING"
+            else:
+                # filesystem tier: a rename, done under the lock
+                if freezing:
+                    os.makedirs(os.path.dirname(frozen_dir), exist_ok=True)
+                    if os.path.exists(frozen_dir):
+                        shutil.rmtree(frozen_dir)
+                    shutil.move(shard_dir, frozen_dir)
+                elif unfreezing and os.path.exists(frozen_dir):
                     if os.path.exists(shard_dir):
                         shutil.rmtree(shard_dir)
                     shutil.move(frozen_dir, shard_dir)
+                self._tenant_status[name] = status
+                self._persist_tenant_status()
+                return
+        # bucket transfer outside the lock
+        try:
+            if freezing:
+                off.upload(self.config.name, name, shard_dir)
+                shutil.rmtree(shard_dir)
+            else:
+                if os.path.exists(shard_dir):
+                    shutil.rmtree(shard_dir)
+                off.download(self.config.name, name, shard_dir)
+        except Exception:
+            with self._lock:
+                self._tenant_status[name] = prev
+                self._persist_tenant_status()
+            raise
+        with self._lock:
             self._tenant_status[name] = status
             self._persist_tenant_status()
 

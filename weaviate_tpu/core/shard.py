@@ -396,19 +396,24 @@ class Shard:
             self._delta.flush_soft()  # never let objects get durable first
 
             batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
-            for obj in final.values():
-                self._mark_live(obj.doc_id)
-                self.ids.put(obj.uuid.encode(), _DOCID.pack(obj.doc_id))
-                self.objects.put(_DOCID.pack(obj.doc_id), obj.to_bytes())
-                self.inverted.add_object(obj)
-                if obj.vector is not None:
-                    b = batches.setdefault(DEFAULT_VECTOR, ([], []))
-                    b[0].append(obj.doc_id)
-                    b[1].append(np.asarray(obj.vector, np.float32))
-                for nm, v in obj.named_vectors.items():
-                    b = batches.setdefault(nm, ([], []))
-                    b[0].append(obj.doc_id)
-                    b[1].append(np.asarray(v, np.float32))
+            # range-index puts accumulate across the batch: one put_many
+            # per property instead of 65 bucket ops per object
+            with self.inverted.batched_range_writes():
+                for obj in final.values():
+                    self._mark_live(obj.doc_id)
+                    self.ids.put(obj.uuid.encode(),
+                                 _DOCID.pack(obj.doc_id))
+                    self.objects.put(_DOCID.pack(obj.doc_id),
+                                     obj.to_bytes())
+                    self.inverted.add_object(obj)
+                    if obj.vector is not None:
+                        b = batches.setdefault(DEFAULT_VECTOR, ([], []))
+                        b[0].append(obj.doc_id)
+                        b[1].append(np.asarray(obj.vector, np.float32))
+                    for nm, v in obj.named_vectors.items():
+                        b = batches.setdefault(nm, ([], []))
+                        b[0].append(obj.doc_id)
+                        b[1].append(np.asarray(v, np.float32))
 
             if old_docids:
                 self._delete_docids(old_docids)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import math
 from collections import defaultdict
+from contextlib import contextmanager
 from typing import Any, Optional
 
 import numpy as np
@@ -62,6 +63,65 @@ class InvertedIndex:
         # cross-collection ref-filter hook, set by the owning Collection
         # (fn(inv, flt, space) -> mask); None = ref filters unsupported
         self.ref_resolver = None
+        # persistent bit-sliced range indexes for props that opt in via
+        # index_range_filters (reference roaringsetrange buckets); backed
+        # by the shard's LSM store when one is attached
+        self.store = store
+        self._range_buckets: dict[str, Any] = {}
+        self._range_pending = None  # set inside batched_range_writes()
+        if store is not None:
+            for p in config.properties:
+                if p.index_range_filters:
+                    self._range_bucket(p.name)
+
+    def _range_bucket(self, prop: str):
+        if self.store is None:
+            return None
+        rb = self._range_buckets.get(prop)
+        if rb is None:
+            from weaviate_tpu.storage.bitmaps import RangeBucket
+
+            rb = RangeBucket(self.store.bucket(
+                f"range_{prop}", "roaringsetrange"))
+            self._range_buckets[prop] = rb
+        return rb
+
+    @contextmanager
+    def batched_range_writes(self):
+        """Accumulate range-index puts across a write batch and flush them
+        as ONE put_many per property (65 bucket ops per batch instead of
+        per object)."""
+        self._range_pending = defaultdict(lambda: ([], []))
+        try:
+            yield
+        finally:
+            pending, self._range_pending = self._range_pending, None
+            for prop, (ids, vals) in pending.items():
+                self._range_bucket(prop).put_many(ids, vals)
+
+    _RANGE_TYPES = (DataType.INT, DataType.NUMBER)
+
+    def _range_indexed(self, prop: str) -> bool:
+        # scalar numeric props only: array/text props fall through to the
+        # columnar engine, which handles their value shapes
+        p = self._prop_schema(prop)
+        return (p is not None and p.index_range_filters
+                and p.data_type in self._RANGE_TYPES
+                and self.store is not None)
+
+    def _range_backfill(self, prop: str, rb) -> None:
+        """Docs written before the flag was enabled (or loaded from a
+        snapshot that predates the bucket) backfill on first use, keyed
+        off a count mismatch — O(1) when in sync."""
+        vals = self.values.get(prop, {})
+        present = rb.bucket.roaring_get(rb._key(0))
+        if len(present) >= len(vals):
+            return
+        missing = [(d, v) for d, v in vals.items()
+                   if isinstance(v, (int, float))
+                   and not isinstance(v, bool) and d not in present]
+        if missing:
+            rb.put_many([d for d, _ in missing], [v for _, v in missing])
 
     # -- schema helpers ---------------------------------------------------
     def _prop_schema(self, name: str):
@@ -94,6 +154,14 @@ class InvertedIndex:
                 continue
             if self._filterable(prop):
                 self.values[prop][doc_id] = val
+            if self._range_indexed(prop) and isinstance(
+                    val, (int, float)) and not isinstance(val, bool):
+                if self._range_pending is not None:
+                    ids, vals = self._range_pending[prop]
+                    ids.append(doc_id)
+                    vals.append(val)
+                else:
+                    self._range_bucket(prop).put_many([doc_id], [val])
             if isinstance(val, str) or (
                 isinstance(val, list) and val and isinstance(val[0], str)
             ):
@@ -120,6 +188,8 @@ class InvertedIndex:
         doc_id = obj.doc_id
         self.doc_count = max(0, self.doc_count - 1)
         self.columnar.delete(doc_id)
+        for rb in self._range_buckets.values():
+            rb.delete_many([doc_id])
         if self.native is not None:
             self.native.remove_doc(doc_id)
         for prop, val in obj.properties.items():
@@ -148,6 +218,8 @@ class InvertedIndex:
         dense path intersects the columnar live bitmap)."""
         self.doc_count = max(0, self.doc_count - 1)
         self.columnar.delete(doc_id)
+        for rb in self._range_buckets.values():
+            rb.delete_many([doc_id])
         if self.native is not None:
             self.native.remove_doc(doc_id)
         for prop, vals in self.values.items():
@@ -308,6 +380,20 @@ class InvertedIndex:
                     raise ValueError(
                         "reference filters need a collection-attached index")
                 return self.ref_resolver(self, flt, space)
+
+        # range-indexed props answer comparisons from the persistent
+        # bit-sliced index (reference roaringsetrange reader)
+        _RANGE_OPS = {"GreaterThan": ">", "GreaterThanEqual": ">=",
+                      "LessThan": "<", "LessThanEqual": "<=",
+                      "Equal": "==", "NotEqual": "!="}
+        if (flt.path and op in _RANGE_OPS
+                and isinstance(flt.value, (int, float))
+                and not isinstance(flt.value, bool)
+                and self._range_indexed(flt.path[-1])):
+            rb = self._range_bucket(flt.path[-1])
+            self._range_backfill(flt.path[-1], rb)
+            bm = rb.query(_RANGE_OPS[op], flt.value)
+            return bm.mask(space) & self.columnar.live_mask(space)
 
         # leaf: vectorized columnar evaluation (reference searcher.go ->
         # AllowList; here numpy columns instead of roaring segments)

@@ -250,6 +250,21 @@ def merge_streams(streams: list[Iterator[tuple[bytes, Any]]], strategy: str,
             if merged is None and drop_tombstones:
                 continue
             yield key, merged
+        elif strategy in ("roaringset", "roaringsetrange"):
+            # fold bitmap layers oldest->newest (reference roaringset
+            # compactor); a full compaction flattens deletions away
+            from weaviate_tpu.storage.bitmaps import BitmapLayer
+            from weaviate_tpu.storage.store import _as_layer, _encode_value
+
+            layer = BitmapLayer()
+            for _, v in vals:
+                if v is not None:
+                    layer = BitmapLayer.merged(layer, _as_layer(v))
+            if drop_tombstones:
+                layer.dels = type(layer.dels)()
+                if not len(layer.adds):
+                    continue
+            yield key, _encode_value(layer)
         else:
             acc: dict = {}
             for _, v in vals:

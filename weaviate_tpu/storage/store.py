@@ -33,7 +33,9 @@ from weaviate_tpu.storage.segment import (
 )
 from weaviate_tpu.storage.wal import WAL
 
-STRATEGIES = ("replace", "set", "map")
+STRATEGIES = ("replace", "set", "map",
+              # bitmap + postings strategies (reference strategies.go:21-27)
+              "roaringset", "roaringsetrange", "inverted")
 
 
 class Bucket:
@@ -81,7 +83,25 @@ class Bucket:
         elif self.strategy == "set":
             cur = self._mem.setdefault(key, {})
             cur.update(val)  # val: {member: True/False}
-        else:  # map
+        elif self.strategy in ("roaringset", "roaringsetrange"):
+            # val: WAL delta {b"a": uint64-array bytes, b"d": ...}
+            import numpy as _np
+
+            from weaviate_tpu.storage.bitmaps import BitmapLayer
+
+            layer = self._mem.get(key)
+            if not isinstance(layer, BitmapLayer):
+                layer = BitmapLayer()
+                self._mem[key] = layer
+            adds = _np.frombuffer(val.get(b"a", b""), _np.uint64)
+            dels = _np.frombuffer(val.get(b"d", b""), _np.uint64)
+            if len(adds):
+                layer.adds.add_many(adds)
+                layer.dels.remove_many(adds)
+            if len(dels):
+                layer.dels.add_many(dels)
+                layer.adds.remove_many(dels)
+        else:  # map / inverted (postings: docid-key -> packed payload)
             cur = self._mem.setdefault(key, {})
             cur.update(val)  # val: {mapkey: bytes|None}
 
@@ -105,6 +125,8 @@ class Bucket:
             self._apply_mem(key, None)
 
     def get(self, key: bytes) -> Optional[bytes]:
+        if self.strategy in ("roaringset", "roaringsetrange"):
+            return self.roaring_get(key)
         with self._lock:
             if self.strategy == "replace":
                 if key in self._mem:
@@ -114,7 +136,7 @@ class Bucket:
                     if v is not _MISSING:
                         return v
                 return None
-            # set/map: merged view
+            # set/map/inverted: merged dict view
             merged: dict = {}
             for seg in self._segments:
                 v = seg.get(key)
@@ -160,6 +182,90 @@ class Bucket:
         merged = self.get(key)
         return {k: v for k, v in merged.items() if v is not None}
 
+    # -- roaringset(+range) API (reference roaringset/ bitmap layers) ------
+    def roaring_add(self, key: bytes, ids) -> None:
+        if self.strategy not in ("roaringset", "roaringsetrange"):
+            raise ValueError("roaring_add() requires a roaring strategy")
+        import numpy as _np
+
+        arr = _np.asarray(ids, _np.uint64)
+        if not len(arr):
+            return
+        val = {b"a": arr.tobytes()}
+        with self._lock:
+            self._log(key, val)
+            self._apply_mem(key, val)
+            self._maybe_flush()
+
+    def roaring_remove(self, key: bytes, ids) -> None:
+        if self.strategy not in ("roaringset", "roaringsetrange"):
+            raise ValueError("roaring_remove() requires a roaring strategy")
+        import numpy as _np
+
+        arr = _np.asarray(ids, _np.uint64)
+        if not len(arr):
+            return
+        val = {b"d": arr.tobytes()}
+        with self._lock:
+            self._log(key, val)
+            self._apply_mem(key, val)
+
+    def roaring_get(self, key: bytes):
+        """Merged bitmap: fold segment layers oldest→newest, then the
+        memtable layer (reference roaringset BitmapLayers.Flatten)."""
+        from weaviate_tpu.storage.bitmaps import Bitmap, BitmapLayer
+
+        if self.strategy not in ("roaringset", "roaringsetrange"):
+            raise ValueError("roaring_get() requires a roaring strategy")
+        with self._lock:
+            acc = Bitmap()
+            for seg in self._segments:
+                v = seg.get(key)
+                if v is not _MISSING and v is not None:
+                    acc = _as_layer(v).apply_over(acc)
+            mem = self._mem.get(key)
+            if isinstance(mem, BitmapLayer):
+                acc = mem.apply_over(acc)
+            return acc
+
+    # -- inverted (postings) API (reference StrategyInverted blocks) -------
+    def postings_put(self, term: bytes, doc_ids, tfs, doc_lens) -> None:
+        if self.strategy != "inverted":
+            raise ValueError("postings_put() requires inverted strategy")
+        import struct as _struct
+
+        val = {int(d).to_bytes(8, "big"): _struct.pack("<II", int(t), int(l))
+               for d, t, l in zip(doc_ids, tfs, doc_lens)}
+        with self._lock:
+            self._log(term, val)
+            self._apply_mem(term, val)
+            self._maybe_flush()
+
+    def postings_remove(self, term: bytes, doc_ids) -> None:
+        if self.strategy != "inverted":
+            raise ValueError("postings_remove() requires inverted strategy")
+        val = {int(d).to_bytes(8, "big"): None for d in doc_ids}
+        with self._lock:
+            self._log(term, val)
+            self._apply_mem(term, val)
+
+    def postings_get(self, term: bytes):
+        """→ (doc_ids int64[], tfs uint32[], doc_lens uint32[]) sorted by
+        doc id; the shape BlockMax-WAND block loads consume."""
+        import struct as _struct
+
+        import numpy as _np
+
+        merged = self.get(term)
+        live = sorted((k, v) for k, v in merged.items() if v is not None)
+        ids = _np.fromiter((int.from_bytes(k, "big") for k, _ in live),
+                           _np.int64, count=len(live))
+        tfs = _np.empty(len(live), _np.uint32)
+        dls = _np.empty(len(live), _np.uint32)
+        for i, (_, v) in enumerate(live):
+            tfs[i], dls[i] = _struct.unpack("<II", v)
+        return ids, tfs, dls
+
     def items(self) -> Iterator[tuple[bytes, Any]]:
         """Live (key, merged-value) pairs in key order — one streaming k-way
         merge over segments + a memtable snapshot; nothing is materialized."""
@@ -202,7 +308,11 @@ class Bucket:
             path = os.path.join(self.dir, f"segment-{self._seg_seq:06d}.db")
             self._seg_seq += 1
             self._segments.append(
-                Segment.write(path, sorted(self._mem.items()))
+                Segment.write(
+                    path,
+                    ((k, _encode_value(v)) for k, v in
+                     sorted(self._mem.items()))
+                )
             )
             self._mem = {}
             self._wal.close()
@@ -246,6 +356,28 @@ class Bucket:
 
     def count(self) -> int:
         return len(self)
+
+
+def _encode_value(v):
+    """Memtable value → msgpack-able segment value (roaring layers carry
+    their serialized form; everything else passes through)."""
+    from weaviate_tpu.storage.bitmaps import BitmapLayer
+
+    if isinstance(v, BitmapLayer):
+        return {b"a": v.adds.to_bytes(), b"d": v.dels.to_bytes()}
+    return v
+
+
+def _as_layer(v):
+    """Segment/memtable roaring value → BitmapLayer."""
+    from weaviate_tpu.storage.bitmaps import Bitmap, BitmapLayer
+
+    if isinstance(v, BitmapLayer):
+        return v
+    return BitmapLayer(
+        Bitmap.from_bytes(v[b"a"]) if v.get(b"a") else None,
+        Bitmap.from_bytes(v[b"d"]) if v.get(b"d") else None,
+    )
 
 
 class Store:
