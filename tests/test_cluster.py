@@ -509,3 +509,71 @@ def test_frozen_shard_rejects_writes(cluster3):
     for n in nodes:
         n._on_shard_unfreeze({"class": "Doc", "shard": 0})
     leader.put_batch("Doc", _objs(1), consistency="QUORUM")
+
+
+def test_distributed_tasks_fan_out_and_complete(cluster3):
+    """Reference cluster/distributedtask: submit once, every node claims
+    its slice exactly once, task reaches FINISHED with per-node results."""
+    nodes, _ = cluster3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(name="DT"))
+    wait_for(lambda: all(n.db.has_collection("DT") for n in nodes),
+             msg="schema replication")
+    calls = []
+    for n in nodes:
+        n.tasks.register(
+            "probe", lambda p, nid=n.id: calls.append(nid) or {"node": nid})
+    tid = leader.tasks.submit("probe", {"x": 1})
+    wait_for(lambda: all(
+        n.task_fsm.tasks.get(tid, {}).get("status") == "FINISHED"
+        for n in nodes), msg="task completion")
+    t = leader.tasks.get(tid)
+    assert sorted(calls) == ["n0", "n1", "n2"]  # exactly-once per node
+    assert set(t["node_result"]) == {"n0", "n1", "n2"}
+    assert t["node_result"]["n1"]["node"] == "n1"
+
+
+def test_distributed_task_failure_and_cancel(cluster3):
+    nodes, _ = cluster3
+    leader = _leader(nodes)
+
+    def boom(payload):
+        raise RuntimeError("handler exploded")
+
+    for n in nodes:
+        n.tasks.register("boom", boom)
+    tid = leader.tasks.submit("boom", {})
+    wait_for(lambda: leader.tasks.get(tid)["status"] == "FAILED",
+             msg="task failure")
+    assert "handler exploded" in \
+        leader.tasks.get(tid)["node_result"]["n0"]["error"]
+    # cancel a fresh task before workers run (stop executors first)
+    for n in nodes:
+        n.tasks.stop()
+    tid2 = leader.tasks.submit("boom", {})
+    leader.tasks.cancel(tid2)
+    for n in nodes:
+        assert n.tasks.run_pending_once() == 0  # cancelled: nobody claims
+    assert leader.tasks.get(tid2)["status"] == "CANCELLED"
+
+
+def test_distributed_reindex_task_runs_against_local_data(cluster3):
+    nodes, _ = cluster3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(name="RD", factor=3))
+    wait_for(lambda: all(n.db.has_collection("RD") for n in nodes),
+             msg="schema replication")
+    objs = []
+    for i in range(12):
+        v = np.zeros(8, np.float32)
+        v[i % 8] = 1.0
+        objs.append(StorageObject(
+            uuid=f"0d000000-0000-0000-0000-{i:012d}", collection="RD",
+            properties={"body": f"doc {i}"}, vector=v))
+    leader.put_batch("RD", objs, consistency="ALL")
+    tid = leader.tasks.submit("reindex_inverted", {"class": "RD"})
+    wait_for(lambda: leader.tasks.get(tid)["status"] == "FINISHED",
+             msg="reindex task")
+    total = sum(r.get("reindexed", 0)
+                for r in leader.tasks.get(tid)["node_result"].values())
+    assert total >= 12  # replicated: every node reindexes its copies

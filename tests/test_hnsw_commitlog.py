@@ -1,0 +1,160 @@
+"""HNSW incremental commit log: crash replay, condensing, corruption.
+
+Reference test models: ``hnsw/commit_logger_test.go`` (op round-trips),
+``startup_test.go`` (snapshot + tail replay equivalence),
+``corrupt_commit_logs_fixer_test.go`` (quarantine).
+"""
+
+import os
+
+import numpy as np
+
+from weaviate_tpu.index.hnsw.commitlog import HNSWCommitLog
+from weaviate_tpu.index.hnsw.graph import HostGraph
+from weaviate_tpu.index.hnsw.hnsw import HNSWIndex
+from weaviate_tpu.schema.config import HNSWIndexConfig
+
+
+def _cfg(n=0):
+    return HNSWIndexConfig(distance="l2-squared", ef_construction=32,
+                           max_connections=8)
+
+
+def _corpus(n, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, d)).astype(np.float32)
+
+
+def test_ops_replay_reproduces_graph(tmp_path):
+    log = HNSWCommitLog(str(tmp_path / "cl"))
+    g = HostGraph(m=4)
+    g.log = log
+    g.add_node(0, 2)
+    g.add_node(1, 0)
+    g.set_neighbors(0, 0, np.asarray([1], np.int32))
+    g.append_neighbor(0, 1, 0)
+    g.set_neighbors(1, 0, np.asarray([], np.int32))
+    g.add_tombstone(1)
+    log.close()
+
+    g2 = HostGraph(m=4)
+    log2 = HNSWCommitLog(str(tmp_path / "cl"))
+    n = log2.replay_into(g2)
+    assert n == 6
+    assert g2.entrypoint == 0 and g2.max_level == 2
+    assert g2.get_neighbors(0, 0).tolist() == [1]
+    assert g2.get_neighbors(0, 1).tolist() == [0]
+    assert 1 in g2.tombstones
+    log2.close()
+
+
+def test_crash_between_snapshots_replays_graph_edits(tmp_path):
+    """Insert, flush (snapshot), insert more WITHOUT flush, reopen: the
+    post-snapshot inserts must be searchable purely from log replay."""
+    path = str(tmp_path / "idx")
+    vecs = _corpus(300)
+    idx = HNSWIndex(16, _cfg(), path=path)
+    idx.add_batch(np.arange(200, dtype=np.int64), vecs[:200])
+    idx.flush()  # condense: snapshot + truncate
+    idx.add_batch(np.arange(200, 300, dtype=np.int64), vecs[200:])
+    idx._commitlog.flush()  # durable ops, NO snapshot
+    # simulate crash: no close / flush
+    del idx
+
+    idx2 = HNSWIndex(16, _cfg(), path=path)
+    # vectors come back through the backend store in a real shard; here we
+    # re-feed them (idempotent) so distances work, then search
+    idx2.add_batch(np.arange(300, dtype=np.int64), vecs)
+    assert idx2.graph.node_count == 300
+    res = idx2.search(vecs[250:251], k=1)
+    assert res.ids[0, 0] == 250
+    idx2.close()
+
+
+def test_replay_is_idempotent_with_delta_reinserts(tmp_path):
+    """Shard recovery may re-add docs the log already replayed; counts and
+    results must not double."""
+    path = str(tmp_path / "idx")
+    vecs = _corpus(100)
+    idx = HNSWIndex(16, _cfg(), path=path)
+    idx.add_batch(np.arange(100, dtype=np.int64), vecs)
+    idx._commitlog.flush()
+    del idx
+    idx2 = HNSWIndex(16, _cfg(), path=path)
+    assert idx2.graph.node_count == 100
+    idx2.add_batch(np.arange(100, dtype=np.int64), vecs)  # idempotent
+    assert idx2.graph.node_count == 100
+    idx2.close()
+
+
+def test_torn_tail_truncates_and_replays_prefix(tmp_path):
+    log = HNSWCommitLog(str(tmp_path / "cl"))
+    g = HostGraph(m=4)
+    g.log = log
+    for i in range(10):
+        g.add_node(i, 0)
+    log.flush()
+    log.close()
+    # append garbage (torn frame)
+    files = [f for f in os.listdir(str(tmp_path / "cl"))
+             if f.endswith(".log") and os.path.getsize(
+                 os.path.join(str(tmp_path / "cl"), f))]
+    with open(os.path.join(str(tmp_path / "cl"), files[0]), "ab") as f:
+        f.write(b"\x55\x00\x00\x00garbage-without-valid-crc")
+    g2 = HostGraph(m=4)
+    log2 = HNSWCommitLog(str(tmp_path / "cl"))
+    assert log2.replay_into(g2) == 10
+    assert g2.node_count == 10
+    log2.close()
+    # the torn tail is gone: a second replay sees clean files
+    g3 = HostGraph(m=4)
+    log3 = HNSWCommitLog(str(tmp_path / "cl"))
+    assert log3.replay_into(g3) == 10
+    log3.close()
+
+
+def test_unreadable_log_quarantines(tmp_path):
+    d = str(tmp_path / "cl")
+    os.makedirs(d)
+    with open(os.path.join(d, "commit-00000000.log"), "wb") as f:
+        f.write(os.urandom(64))  # valid frame header never matches crc
+    g = HostGraph(m=4)
+    log = HNSWCommitLog(d)
+    log.replay_into(g)  # must not raise
+    assert g.node_count == 0
+    log.close()
+
+
+def test_condense_truncates_log(tmp_path):
+    path = str(tmp_path / "idx")
+    vecs = _corpus(150)
+    idx = HNSWIndex(16, _cfg(), path=path)
+    idx.add_batch(np.arange(150, dtype=np.int64), vecs)
+    idx._commitlog.flush()
+    assert idx._commitlog.pending_bytes > 0
+    idx.flush()
+    assert idx._commitlog.pending_bytes == 0
+    idx.close()
+
+
+def test_replay_over_condensed_snapshot_adds_no_duplicate_edges(tmp_path):
+    """Crash between snapshot write and log truncation: replay re-applies
+    ops the snapshot contains; layer0 rows must not grow duplicates."""
+    path = str(tmp_path / "idx")
+    vecs = _corpus(120)
+    idx = HNSWIndex(16, _cfg(), path=path)
+    idx.add_batch(np.arange(120, dtype=np.int64), vecs)
+    idx._commitlog.flush()
+    # snapshot WITHOUT truncating the log (the crash window)
+    import numpy as _np
+    _np.savez_compressed(idx._snapshot_path() + ".tmp.npz",
+                         **idx.graph.to_arrays())
+    os.replace(idx._snapshot_path() + ".tmp.npz", idx._snapshot_path())
+    del idx
+
+    idx2 = HNSWIndex(16, _cfg(), path=path)
+    for node in range(120):
+        for lvl in range(int(idx2.graph.levels[node]) + 1):
+            nbrs = idx2.graph.get_neighbors(lvl, node)
+            assert len(nbrs) == len(set(nbrs.tolist())), (node, lvl)
+    idx2.close()

@@ -185,22 +185,36 @@ class BackupHandler:
             frozen_prefix = os.path.join(cls, "__frozen__")
             offload_base = os.environ.get(
                 "OFFLOAD_FS_PATH", os.path.join(self.db.root, "_offload"))
+            tmp_frozen = target_dir + ".restore-frozen"
+            shutil.rmtree(tmp_frozen, ignore_errors=True)
             try:
                 os.makedirs(tmp_dir, exist_ok=True)
                 for rel in entry["files"]:
                     inner = os.path.relpath(rel, cls)
                     if rel.startswith(frozen_prefix + os.sep):
-                        # frozen-tenant files restore into the offload
-                        # tier, where unfreezing expects them
+                        # frozen-tenant files STAGE first — writing into
+                        # the live offload tier mid-restore would corrupt
+                        # an existing frozen copy if a later download fails
                         sub = os.path.relpath(rel, frozen_prefix)
                         dst = os.path.normpath(
-                            os.path.join(offload_base, cls, sub))
-                        confine(os.path.join(offload_base, cls), dst)
+                            os.path.join(tmp_frozen, sub))
+                        confine(tmp_frozen, dst)
                     else:
                         # a tampered manifest must not escape tmp_dir
                         dst = os.path.normpath(os.path.join(tmp_dir, inner))
                         confine(tmp_dir, dst)
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
                     backend.get_file(backup_id, rel, dst)
+                # all downloads succeeded: commit frozen tenants, then the
+                # hot dir (per-tenant dir moves are atomic)
+                if os.path.isdir(tmp_frozen):
+                    dst_root = os.path.join(offload_base, cls)
+                    os.makedirs(dst_root, exist_ok=True)
+                    for tname in os.listdir(tmp_frozen):
+                        tdst = os.path.join(dst_root, tname)
+                        shutil.rmtree(tdst, ignore_errors=True)
+                        os.replace(os.path.join(tmp_frozen, tname), tdst)
+                    shutil.rmtree(tmp_frozen, ignore_errors=True)
                 os.replace(tmp_dir, target_dir)
                 cfg = CollectionConfig.from_dict(entry["config"])
                 col = self.db.create_collection(cfg)
@@ -209,6 +223,7 @@ class BackupHandler:
                 restored.append(cls)
             except OSError as e:
                 shutil.rmtree(tmp_dir, ignore_errors=True)
+                shutil.rmtree(tmp_frozen, ignore_errors=True)
                 raise BackupError(f"restore {cls!r} failed: {e}") from e
         return {"id": backup_id, "status": STATUS_SUCCESS,
                 "classes": restored}

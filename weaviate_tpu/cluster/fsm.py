@@ -19,14 +19,20 @@ from weaviate_tpu.schema.config import CollectionConfig, DataType, Property
 
 class SchemaFSM:
     def __init__(self, db: DB):
+        from weaviate_tpu.cluster.tasks import TaskFSM
+
         self.db = db
         # replica-movement overrides: "cls/shard" -> explicit replica list
         # (reference cluster/replication/ shard-replica FSM state)
         self.shard_overrides: dict[str, list[str]] = {}
+        # distributed-task table (reference cluster/distributedtask FSM)
+        self.tasks = TaskFSM()
 
     # -- command application (called from the raft apply path) ------------
     def apply(self, cmd: dict) -> Any:
         op = cmd.get("op")
+        if isinstance(op, str) and op.startswith("task_"):
+            return self.tasks.apply(cmd)
         try:
             if op == "add_class":
                 cfg = CollectionConfig.from_dict(cmd["class"])
@@ -83,6 +89,7 @@ class SchemaFSM:
                 if self.db.get_collection(n).config.multi_tenancy.enabled
             },
             "shard_overrides": self.shard_overrides,
+            "tasks": self.tasks.state(),
         }
         return msgpack.packb(state, use_bin_type=True)
 
@@ -100,3 +107,4 @@ class SchemaFSM:
             for tname, status in tenants.items():
                 col.add_tenant(tname, status)
         self.shard_overrides = dict(state.get("shard_overrides", {}))
+        self.tasks.load(state.get("tasks", {}))

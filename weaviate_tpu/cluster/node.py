@@ -98,10 +98,29 @@ class ClusterNode:
             send_fn=lambda peer, msg: self.transport.send(
                 peer, msg, timeout=0.3),
         )
+        # distributed tasks: replicated table in the FSM + a per-node
+        # executor claiming this node's slice (cluster/distributedtask)
+        from weaviate_tpu.cluster.tasks import DistributedTaskExecutor
+
+        self.tasks = DistributedTaskExecutor(self)
         transport.start(self._dispatch)
         if heartbeat:
             self.raft.start()
             self.gossip.start()
+            self.tasks.start()
+
+    # -- distributed-task plumbing (executor-facing surface) ---------------
+    @property
+    def node_id(self) -> str:
+        return self.id
+
+    @property
+    def task_fsm(self):
+        return self.fsm.tasks
+
+    def apply(self, cmd: dict):
+        """Linearizable FSM command (leader-forwarded raft submit)."""
+        return self.raft.submit(cmd)
 
     # -- message mux -------------------------------------------------------
     def _dispatch(self, msg: dict) -> dict:
@@ -703,6 +722,7 @@ class ClusterNode:
 
     # -- lifecycle ---------------------------------------------------------
     def close(self):
+        self.tasks.stop()
         self.gossip.stop()
         self.raft.stop()
         self.db.close()

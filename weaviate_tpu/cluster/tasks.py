@@ -1,0 +1,198 @@
+"""Distributed tasks: a raft-replicated cluster-wide task FSM + workers.
+
+Reference: ``cluster/distributedtask/{manager,scheduler}.go`` +
+``usecases/distributedtask`` — generic cluster task lifecycle (submit →
+per-node claim/execute → finished/failed/cancelled), used by background
+reindexing v3. The task table rides the same raft FSM as the schema, so
+every node sees an identical task list and claims are linearizable (a
+claim is a raft command that only succeeds on the first applier).
+
+Tasks are fan-out by default: every live node runs the task against its
+local data and reports; the task finishes when all listed nodes have.
+Handlers register per task kind on the executor (``reindex_inverted`` and
+``compact`` ship built-in).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as uuidlib
+from typing import Any, Callable, Optional
+
+TASK_PENDING = "PENDING"
+TASK_RUNNING = "RUNNING"
+TASK_FINISHED = "FINISHED"
+TASK_FAILED = "FAILED"
+TASK_CANCELLED = "CANCELLED"
+
+
+class TaskFSM:
+    """The replicated task table (a sub-FSM the SchemaFSM delegates to)."""
+
+    def __init__(self):
+        self.tasks: dict[str, dict] = {}
+
+    def apply(self, cmd: dict) -> Any:
+        op = cmd.get("op")
+        if op == "task_submit":
+            tid = cmd["id"]
+            if tid in self.tasks:
+                return {"ok": False, "error": "task exists"}
+            self.tasks[tid] = {
+                "id": tid, "kind": cmd["kind"],
+                "payload": cmd.get("payload", {}),
+                "nodes": list(cmd.get("nodes", [])),
+                "status": TASK_PENDING,
+                "submitted_at": cmd.get("ts", 0.0),
+                "node_status": {}, "node_result": {},
+            }
+            return {"ok": True, "id": tid}
+        t = self.tasks.get(cmd.get("id", ""))
+        if t is None:
+            return {"ok": False, "error": "task not found"}
+        if op == "task_claim":
+            node = cmd["node"]
+            if t["status"] == TASK_CANCELLED:
+                return {"ok": False, "error": "cancelled"}
+            if t["node_status"].get(node) is not None:
+                return {"ok": False, "error": "already claimed"}
+            t["node_status"][node] = TASK_RUNNING
+            t["status"] = TASK_RUNNING
+            return {"ok": True}
+        if op == "task_report":
+            node = cmd["node"]
+            ok = cmd.get("success", False)
+            t["node_status"][node] = TASK_FINISHED if ok else TASK_FAILED
+            t["node_result"][node] = cmd.get("result")
+            done = [n for n in t["nodes"]
+                    if t["node_status"].get(n) in (TASK_FINISHED,
+                                                   TASK_FAILED)]
+            if len(done) == len(t["nodes"]) and \
+                    t["status"] != TASK_CANCELLED:
+                t["status"] = (
+                    TASK_FAILED if any(
+                        t["node_status"][n] == TASK_FAILED
+                        for n in t["nodes"]) else TASK_FINISHED)
+            return {"ok": True}
+        if op == "task_cancel":
+            if t["status"] in (TASK_FINISHED, TASK_FAILED):
+                return {"ok": False, "error": "already terminal"}
+            t["status"] = TASK_CANCELLED
+            return {"ok": True}
+        if op == "task_cleanup":
+            cutoff = cmd.get("before", 0.0)
+            drop = [tid for tid, tt in self.tasks.items()
+                    if tt["status"] in (TASK_FINISHED, TASK_FAILED,
+                                        TASK_CANCELLED)
+                    and tt.get("submitted_at", 0.0) < cutoff]
+            for tid in drop:
+                del self.tasks[tid]
+            return {"ok": True, "removed": len(drop)}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def state(self) -> dict:
+        return {"tasks": self.tasks}
+
+    def load(self, state: dict) -> None:
+        self.tasks = dict(state.get("tasks", {}))
+
+
+class DistributedTaskExecutor:
+    """Per-node worker: claims this node's slice of pending tasks and runs
+    the registered handler (reference scheduler.go worker loop)."""
+
+    def __init__(self, cluster, poll_interval: float = 0.2):
+        self.cluster = cluster  # ClusterNode: .node_id, .apply(), .task_fsm
+        self.poll_interval = poll_interval
+        self.handlers: dict[str, Callable[[dict], Any]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.register("reindex_inverted", self._reindex_inverted)
+        self.register("compact", self._compact)
+
+    def register(self, kind: str, fn: Callable[[dict], Any]) -> None:
+        self.handlers[kind] = fn
+
+    # -- built-in handlers -------------------------------------------------
+    def _reindex_inverted(self, payload: dict) -> Any:
+        col = self.cluster.db.get_collection(payload["class"])
+        return {"reindexed": sum(
+            s.reindex_inverted() for s in col._shards.values())}
+
+    def _compact(self, payload: dict) -> Any:
+        col = self.cluster.db.get_collection(payload["class"])
+        col.compact_once(min_segments=int(payload.get("min_segments", 2)))
+        return {"ok": True}
+
+    # -- lifecycle ---------------------------------------------------------
+    def submit(self, kind: str, payload: dict,
+               nodes: Optional[list[str]] = None) -> str:
+        tid = uuidlib.uuid4().hex[:16]
+        out = self.cluster.apply({
+            "op": "task_submit", "id": tid, "kind": kind,
+            "payload": payload, "ts": time.time(),
+            "nodes": nodes or list(self.cluster.all_nodes),
+        })
+        if not out.get("ok"):
+            raise RuntimeError(out.get("error", "submit failed"))
+        return tid
+
+    def get(self, tid: str) -> Optional[dict]:
+        return self.cluster.task_fsm.tasks.get(tid)
+
+    def list(self) -> list[dict]:
+        return list(self.cluster.task_fsm.tasks.values())
+
+    def cancel(self, tid: str) -> None:
+        self.cluster.apply({"op": "task_cancel", "id": tid})
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="dtask-executor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def run_pending_once(self) -> int:
+        """One synchronous pass (tests + forced drains). Returns tasks
+        executed on this node."""
+        me = self.cluster.node_id
+        ran = 0
+        for t in list(self.cluster.task_fsm.tasks.values()):
+            if t["status"] == TASK_CANCELLED:
+                continue
+            if me not in t["nodes"] or t["node_status"].get(me) is not None:
+                continue
+            claim = self.cluster.apply(
+                {"op": "task_claim", "id": t["id"], "node": me})
+            if not claim.get("ok"):
+                continue
+            handler = self.handlers.get(t["kind"])
+            try:
+                if handler is None:
+                    raise KeyError(f"no handler for kind {t['kind']!r}")
+                result = handler(t["payload"])
+                self.cluster.apply({
+                    "op": "task_report", "id": t["id"], "node": me,
+                    "success": True, "result": result})
+            except Exception as e:  # report, never kill the worker
+                self.cluster.apply({
+                    "op": "task_report", "id": t["id"], "node": me,
+                    "success": False, "result": {"error": str(e)}})
+            ran += 1
+        return ran
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.run_pending_once()
+            except Exception:
+                pass  # raft leadership churn etc: retry next tick

@@ -61,6 +61,11 @@ class Collection:
     def _tenant_status_path(self) -> str:
         return os.path.join(self.dir, "tenants.json")
 
+    # transfers are transient: crash/persist mid-flight must resolve to the
+    # state whose DATA is intact (FREEZING keeps local files until the
+    # FROZEN persist; UNFREEZING keeps the bucket copy until HOT persists)
+    _TRANSIENT_STATUS = {"FREEZING": TENANT_HOT, "UNFREEZING": TENANT_FROZEN}
+
     def _load_tenant_status(self) -> None:
         import json
 
@@ -68,7 +73,9 @@ class Collection:
         if os.path.exists(path):
             try:
                 with open(path) as f:
-                    self._tenant_status = dict(json.load(f))
+                    self._tenant_status = {
+                        n: self._TRANSIENT_STATUS.get(s, s)
+                        for n, s in dict(json.load(f)).items()}
             except (OSError, ValueError):
                 self._tenant_status = {}
 
@@ -77,7 +84,10 @@ class Collection:
 
         tmp = self._tenant_status_path() + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(self._tenant_status, f)
+            # never write a transient transfer state: a crash would wedge
+            # the tenant (set_tenant_status rejects transitions out of it)
+            json.dump({n: self._TRANSIENT_STATUS.get(s, s)
+                       for n, s in self._tenant_status.items()}, f)
         os.replace(tmp, self._tenant_status_path())
 
     # -- shard management -------------------------------------------------
@@ -216,7 +226,10 @@ class Collection:
             shutil.rmtree(s.dir, ignore_errors=True)
 
     def tenants(self) -> dict[str, str]:
-        return dict(self._tenant_status)
+        # external views (API, backup manifests, FSM snapshots) see the
+        # durable equivalent of in-flight transfers, never the transient
+        return {n: self._TRANSIENT_STATUS.get(s, s)
+                for n, s in self._tenant_status.items()}
 
     def _offload_root(self) -> str:
         """Frozen-tier storage root (reference offload-s3 module; a cold
@@ -276,11 +289,20 @@ class Collection:
         try:
             if freezing:
                 off.upload(self.config.name, name, shard_dir)
+                # commit FROZEN while the local copy still exists: a crash
+                # before this line leaves status HOT + intact local data; a
+                # crash after it leaves an orphan dir the unfreeze path
+                # clears — never a deleted-local + HOT-status state whose
+                # re-freeze would overwrite the good bucket copy with an
+                # empty shard
+                with self._lock:
+                    self._tenant_status[name] = status
+                    self._persist_tenant_status()
+                shutil.rmtree(shard_dir, ignore_errors=True)
+                return
+            if os.path.exists(shard_dir):
                 shutil.rmtree(shard_dir)
-            else:
-                if os.path.exists(shard_dir):
-                    shutil.rmtree(shard_dir)
-                off.download(self.config.name, name, shard_dir)
+            off.download(self.config.name, name, shard_dir)
         except Exception:
             with self._lock:
                 self._tenant_status[name] = prev

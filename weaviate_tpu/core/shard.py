@@ -558,6 +558,9 @@ class Shard:
         self.flush()
         self.checkpoint()
         self._delta.close()
+        for idx in self._vector_indexes.values():
+            if hasattr(idx, "close"):
+                idx.close()
         self.store.close()
 
     def reindex_inverted(self) -> int:

@@ -90,6 +90,10 @@ class DynamicIndex(VectorIndex):
     def flush(self) -> None:
         self._inner.flush()
 
+    def close(self) -> None:
+        if hasattr(self._inner, "close"):
+            self._inner.close()
+
     def save_vectors(self, path: str, meta=None) -> bool:
         return self._inner.save_vectors(path, meta)
 

@@ -29,6 +29,10 @@ class HostGraph:
         # from results + entrypoint election until cleanup rewires them
         # (reference delete.go tombstone semantics)
         self.tombstones: set[int] = set()
+        # optional incremental op log (commitlog.HNSWCommitLog); mutations
+        # mirror into it so a crash since the last condensed snapshot
+        # replays link ops instead of redoing construction
+        self.log = None
 
     @property
     def capacity(self) -> int:
@@ -67,6 +71,8 @@ class HostGraph:
         if level > self.max_level:
             self.max_level = level
             self.entrypoint = node
+        if self.log is not None:
+            self.log.op_an(node, level)
 
     def add_tombstone(self, node: int) -> None:
         """Mark deleted: edges stay so traversal can route through; the node
@@ -77,6 +83,8 @@ class HostGraph:
         self.node_count -= 1
         if node == self.entrypoint:
             self._elect_entrypoint()
+        if self.log is not None:
+            self.log.op_ts(node)
 
     def remove_node_hard(self, node: int) -> None:
         """Physically drop a node (cleanup only — callers must have rewired
@@ -94,6 +102,8 @@ class HostGraph:
             self.node_count -= 1
         if node == self.entrypoint:
             self._elect_entrypoint()
+        if self.log is not None:
+            self.log.op_rm(node)
 
     def _elect_entrypoint(self) -> None:
         """New entrypoint = any live (non-tombstoned) node at the highest
@@ -146,6 +156,8 @@ class HostGraph:
             self.layer0[node, : len(nbrs)] = nbrs
         else:
             self.upper.setdefault(level, {})[node] = nbrs.copy()
+        if self.log is not None:
+            self.log.op_sn(level, node, nbrs)
 
     def append_neighbor(self, level: int, node: int, nbr: int) -> bool:
         """Add an edge if there's room; returns False when full (caller prunes)."""
@@ -155,6 +167,8 @@ class HostGraph:
             if len(free) == 0:
                 return False
             row[free[0]] = nbr
+            if self.log is not None:
+                self.log.op_ap(level, node, nbr)
             return True
         layer = self.upper.setdefault(level, {})
         arr = layer.get(node)
@@ -163,6 +177,8 @@ class HostGraph:
         if len(arr) >= self.m:
             return False
         layer[node] = np.append(arr, np.int32(nbr))
+        if self.log is not None:
+            self.log.op_ap(level, node, nbr)
         return True
 
     # -- persistence ------------------------------------------------------

@@ -82,6 +82,18 @@ class HNSWIndex(VectorIndex):
         self._dispatch = CoalescingDispatcher(self._run_search_batch)
         if path and os.path.exists(self._snapshot_path()):
             self._load_snapshot()
+        if path:
+            # incremental op log: graph edits since the last condensed
+            # snapshot replay on open (reference commit_logger.go +
+            # startup.go); condensing == flush() + truncate
+            from weaviate_tpu.index.hnsw.commitlog import HNSWCommitLog
+
+            self._commitlog = HNSWCommitLog(
+                os.path.join(path, "commitlog"))
+            self._commitlog.replay_into(self.graph)
+            self.graph.log = self._commitlog
+        else:
+            self._commitlog = None
 
     # ------------------------------------------------------------------
     # persistence: condensed-graph snapshot (reference commit_logger.go
@@ -101,6 +113,9 @@ class HNSWIndex(VectorIndex):
         tmp = self._snapshot_path() + ".tmp.npz"
         np.savez_compressed(tmp, **self.graph.to_arrays())
         os.replace(tmp, self._snapshot_path())
+        if self._commitlog is not None:
+            # the snapshot condenses everything logged so far
+            self._commitlog.truncate_after_snapshot()
         if self.backend.quantized and self.backend.quantizer.fitted:
             # persist trained quantizer state (codebooks/rotation/scales) so
             # recovery re-encodes with identical codes (reference persists
@@ -115,6 +130,15 @@ class HNSWIndex(VectorIndex):
                     )
                 )
             os.replace(tmp, self._quantizer_path())
+
+    def close(self) -> None:
+        """Condense + release the commit log (crash after this point
+        replays nothing)."""
+        self.flush()
+        if self._commitlog is not None:
+            self._commitlog.close()
+            self._commitlog = None
+            self.graph.log = None
 
     def _load_snapshot(self) -> None:
         with np.load(self._snapshot_path()) as z:
@@ -309,6 +333,11 @@ class HNSWIndex(VectorIndex):
         doc_ids = doc_ids[self.graph.levels[doc_ids] < 0]
         for start in range(0, len(doc_ids), self._insert_batch):
             self._insert_subbatch(doc_ids[start : start + self._insert_batch])
+        if self._commitlog is not None:
+            self._commitlog.flush_soft()
+            # condense once the op window outgrows the snapshot cost
+            if self._commitlog.pending_bytes > (64 << 20):
+                self.flush()
 
     def index_existing(self) -> None:
         """Build the graph over the store's live vectors without touching the
@@ -484,6 +513,8 @@ class HNSWIndex(VectorIndex):
         self.backend.delete(doc_ids)
         for d in doc_ids:
             self.graph.add_tombstone(int(d))
+        if self._commitlog is not None:
+            self._commitlog.flush_soft()
 
     def cleanup_tombstones(self) -> int:
         """Rewire edges around tombstoned nodes, then drop them.

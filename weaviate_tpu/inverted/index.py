@@ -69,6 +69,8 @@ class InvertedIndex:
         self.store = store
         self._range_buckets: dict[str, Any] = {}
         self._range_pending = None  # set inside batched_range_writes()
+        # prop -> count of range-eligible values (None = not yet computed)
+        self._range_counts: dict[str, Optional[int]] = {}
         if store is not None:
             for p in config.properties:
                 if p.index_range_filters:
@@ -109,17 +111,31 @@ class InvertedIndex:
                 and p.data_type in self._RANGE_TYPES
                 and self.store is not None)
 
+    @staticmethod
+    def _range_eligible(v) -> bool:
+        return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+    def _range_count(self, prop: str) -> int:
+        """Count of range-ELIGIBLE values for the prop — not len(values):
+        one ineligible value (bool in an INT prop) would otherwise make
+        the backfill mismatch check O(n) on every query, forever."""
+        c = self._range_counts.get(prop)
+        if c is None:  # first use after snapshot load: one O(n) pass
+            c = sum(1 for v in self.values.get(prop, {}).values()
+                    if self._range_eligible(v))
+            self._range_counts[prop] = c
+        return c
+
     def _range_backfill(self, prop: str, rb) -> None:
         """Docs written before the flag was enabled (or loaded from a
         snapshot that predates the bucket) backfill on first use, keyed
         off a count mismatch — O(1) when in sync."""
-        vals = self.values.get(prop, {})
         present = rb.bucket.roaring_get(rb._key(0))
-        if len(present) >= len(vals):
+        if len(present) >= self._range_count(prop):
             return
+        vals = self.values.get(prop, {})
         missing = [(d, v) for d, v in vals.items()
-                   if isinstance(v, (int, float))
-                   and not isinstance(v, bool) and d not in present]
+                   if self._range_eligible(v) and d not in present]
         if missing:
             rb.put_many([d for d, _ in missing], [v for _, v in missing])
 
@@ -154,8 +170,10 @@ class InvertedIndex:
                 continue
             if self._filterable(prop):
                 self.values[prop][doc_id] = val
-            if self._range_indexed(prop) and isinstance(
-                    val, (int, float)) and not isinstance(val, bool):
+            if self._range_indexed(prop) and self._range_eligible(val):
+                if prop in self._range_counts and \
+                        self._range_counts[prop] is not None:
+                    self._range_counts[prop] += 1
                 if self._range_pending is not None:
                     ids, vals = self._range_pending[prop]
                     ids.append(doc_id)
@@ -193,7 +211,10 @@ class InvertedIndex:
         if self.native is not None:
             self.native.remove_doc(doc_id)
         for prop, val in obj.properties.items():
-            self.values.get(prop, {}).pop(doc_id, None)
+            popped = self.values.get(prop, {}).pop(doc_id, None)
+            if self._range_eligible(popped) and \
+                    self._range_counts.get(prop) is not None:
+                self._range_counts[prop] -= 1
             lengths = self.doc_lengths.get(prop)
             if lengths is not None:
                 prev = lengths.pop(doc_id, None)
@@ -223,7 +244,10 @@ class InvertedIndex:
         if self.native is not None:
             self.native.remove_doc(doc_id)
         for prop, vals in self.values.items():
-            vals.pop(doc_id, None)
+            popped = vals.pop(doc_id, None)
+            if self._range_eligible(popped) and \
+                    self._range_counts.get(prop) is not None:
+                self._range_counts[prop] -= 1
         for prop, lengths in self.doc_lengths.items():
             prev = lengths.pop(doc_id, None)
             if prev is not None:
