@@ -167,6 +167,7 @@ def bench_flat1m(n=1_000_000, d=768, batch=256, k=10, iters=30, warmup=3):
         "unit": "qps",
         "vs_baseline": round(qps / cpu_qps, 2),
         "recall_at_10": round(recall, 4),
+        "recall_ok": bool(recall >= 0.95),
         "serial_qps": round(serial_qps, 1),
         "p50_batch_ms": round(float(np.median(ts)) * 1000, 2),
         "p99_batch_ms": round(float(np.percentile(ts, 99)) * 1000, 2),
@@ -225,6 +226,7 @@ def bench_glove(n=1_200_000, d=25, batch=256, k=10, ef=64, iters=20, warmup=2):
         "unit": "qps",
         "vs_baseline": round(qps / cpu_qps, 2),
         "recall_at_10": round(recall, 4),
+        "recall_ok": bool(recall >= 0.95),
         "p50_batch_ms": round(float(np.median(ts)) * 1000, 2),
         "p99_batch_ms": round(float(np.percentile(ts, 99)) * 1000, 2),
         "build_s": round(build_s, 1),
@@ -289,6 +291,7 @@ def bench_pq(n=1_000_000, d=1536, batch=256, k=10, segments=96, iters=20, warmup
         "unit": "qps",
         "vs_baseline": round(qps / cpu_qps, 2),
         "recall_at_10": round(recall, 4),
+        "recall_ok": bool(recall >= 0.95),
         "p50_batch_ms": round(float(np.median(ts)) * 1000, 2),
         "p99_batch_ms": round(float(np.percentile(ts, 99)) * 1000, 2),
         "build_s": round(build_s, 1),
@@ -374,6 +377,7 @@ def bench_bq(n=10_000_000, d=768, batch=256, k=10, iters=20, warmup=2):
         "unit": "qps",
         "vs_baseline": round(qps / cpu_qps, 2),
         "recall_at_10": round(recall, 4),
+        "recall_ok": bool(recall >= 0.95),
         "p50_batch_ms": round(float(np.median(ts)) * 1000, 2),
         "p99_batch_ms": round(float(np.percentile(ts, 99)) * 1000, 2),
         "build_s": round(build_s, 1),

@@ -577,3 +577,27 @@ def test_distributed_reindex_task_runs_against_local_data(cluster3):
     total = sum(r.get("reindexed", 0)
                 for r in leader.tasks.get(tid)["node_result"].values())
     assert total >= 12  # replicated: every node reindexes its copies
+
+
+def test_distributed_task_lease_reaps_dead_node(cluster3):
+    """A task listing a node that never reports must still reach a
+    terminal state once the lease expires (reference distributedtask
+    liveness handling)."""
+    nodes, _ = cluster3
+    leader = _leader(nodes)
+    for n in nodes:
+        n.tasks.stop()  # manual control
+        n.tasks.register("noop", lambda p: {"ok": True})
+    tid = leader.tasks.submit("noop", {}, lease_s=1.0)
+    # only two of three nodes run the task; "n2" plays dead
+    for n in nodes:
+        if n.id != "n2":
+            n.tasks.run_pending_once()
+    t = leader.tasks.get(tid)
+    assert t["status"] == "RUNNING"  # n2 outstanding
+    time.sleep(1.1)
+    leader.tasks.reap_expired_once()
+    wait_for(lambda: leader.tasks.get(tid)["status"] == "FAILED",
+             msg="lease reap")
+    assert leader.tasks.get(tid)["node_result"]["n2"]["error"] == \
+        "lease expired"

@@ -213,7 +213,9 @@ class BackupHandler:
                     for tname in os.listdir(tmp_frozen):
                         tdst = os.path.join(dst_root, tname)
                         shutil.rmtree(tdst, ignore_errors=True)
-                        os.replace(os.path.join(tmp_frozen, tname), tdst)
+                        # shutil.move, not os.replace: the offload tier is
+                        # commonly a different mount (EXDEV)
+                        shutil.move(os.path.join(tmp_frozen, tname), tdst)
                     shutil.rmtree(tmp_frozen, ignore_errors=True)
                 os.replace(tmp_dir, target_dir)
                 cfg = CollectionConfig.from_dict(entry["config"])

@@ -45,6 +45,10 @@ class TaskFSM:
                 "nodes": list(cmd.get("nodes", [])),
                 "status": TASK_PENDING,
                 "submitted_at": cmd.get("ts", 0.0),
+                # a node that never reports within the lease gets FAILED
+                # by a task_reap (dead/stuck nodes must not wedge the task
+                # in RUNNING forever)
+                "lease_s": float(cmd.get("lease_s", 300.0)),
                 "node_status": {}, "node_result": {},
             }
             return {"ok": True, "id": tid}
@@ -80,6 +84,26 @@ class TaskFSM:
                 return {"ok": False, "error": "already terminal"}
             t["status"] = TASK_CANCELLED
             return {"ok": True}
+        if op == "task_reap":
+            # deterministic: `now` is stamped by the submitter before
+            # replication, so every applier makes the same decision
+            now = float(cmd.get("now", 0.0))
+            if t["status"] in (TASK_FINISHED, TASK_FAILED, TASK_CANCELLED):
+                return {"ok": True, "reaped": 0}
+            if now - t.get("submitted_at", 0.0) < t.get("lease_s", 300.0):
+                return {"ok": True, "reaped": 0}
+            reaped = 0
+            for n in t["nodes"]:
+                if t["node_status"].get(n) in (TASK_FINISHED, TASK_FAILED):
+                    continue
+                t["node_status"][n] = TASK_FAILED
+                t["node_result"][n] = {"error": "lease expired"}
+                reaped += 1
+            t["status"] = (
+                TASK_FAILED if any(
+                    t["node_status"].get(n) == TASK_FAILED
+                    for n in t["nodes"]) else TASK_FINISHED)
+            return {"ok": True, "reaped": reaped}
         if op == "task_cleanup":
             cutoff = cmd.get("before", 0.0)
             drop = [tid for tid, tt in self.tasks.items()
@@ -117,21 +141,24 @@ class DistributedTaskExecutor:
     # -- built-in handlers -------------------------------------------------
     def _reindex_inverted(self, payload: dict) -> Any:
         col = self.cluster.db.get_collection(payload["class"])
-        return {"reindexed": sum(
-            s.reindex_inverted() for s in col._shards.values())}
+        # collection-level API: covers lazily-unopened tenants and takes
+        # the collection lock correctly
+        return {"reindexed": col.reindex_inverted()}
 
     def _compact(self, payload: dict) -> Any:
         col = self.cluster.db.get_collection(payload["class"])
-        col.compact_once(min_segments=int(payload.get("min_segments", 2)))
+        col.compact_once(min_segments=int(payload.get("min_segments", 2)),
+                         include_unopened=True)
         return {"ok": True}
 
     # -- lifecycle ---------------------------------------------------------
     def submit(self, kind: str, payload: dict,
-               nodes: Optional[list[str]] = None) -> str:
+               nodes: Optional[list[str]] = None,
+               lease_s: float = 300.0) -> str:
         tid = uuidlib.uuid4().hex[:16]
         out = self.cluster.apply({
             "op": "task_submit", "id": tid, "kind": kind,
-            "payload": payload, "ts": time.time(),
+            "payload": payload, "ts": time.time(), "lease_s": lease_s,
             "nodes": nodes or list(self.cluster.all_nodes),
         })
         if not out.get("ok"):
@@ -190,9 +217,21 @@ class DistributedTaskExecutor:
             ran += 1
         return ran
 
+    def reap_expired_once(self) -> None:
+        """Drive overdue tasks terminal: nodes that died before reporting
+        (or never claimed) fail with 'lease expired'."""
+        now = time.time()
+        for t in list(self.cluster.task_fsm.tasks.values()):
+            if t["status"] in (TASK_FINISHED, TASK_FAILED, TASK_CANCELLED):
+                continue
+            if now - t.get("submitted_at", 0.0) >= t.get("lease_s", 300.0):
+                self.cluster.apply(
+                    {"op": "task_reap", "id": t["id"], "now": now})
+
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
             try:
                 self.run_pending_once()
+                self.reap_expired_once()
             except Exception:
                 pass  # raft leadership churn etc: retry next tick

@@ -40,6 +40,7 @@ class Collection:
         os.makedirs(dirpath, exist_ok=True)
         self._lock = threading.RLock()
         self._shards: dict[str, Shard] = {}
+        self._building: dict[str, threading.Event] = {}  # in-flight opens
         self._tenant_status: dict[str, str] = {}
         self._maintenance_pause = 0  # backup copy windows (counter)
         self._pool = ThreadPoolExecutor(max_workers=8)
@@ -54,9 +55,10 @@ class Collection:
                 if os.path.isdir(os.path.join(dirpath, d)) and d.startswith("tenant-"):
                     name = d[len("tenant-"):]
                     self._tenant_status.setdefault(name, TENANT_HOT)
-            for name, status in self._tenant_status.items():
-                if status == TENANT_HOT:
-                    self._get_shard(f"tenant-{name}")
+            # tenant shards load LAZILY on first use (reference
+            # shard_lazyloader.go): a collection with 10k tenants must not
+            # open 10k shards at boot; _get_shard's load limiter bounds
+            # concurrent opens when traffic fans in
 
     def _tenant_status_path(self) -> str:
         return os.path.join(self.dir, "tenants.json")
@@ -91,26 +93,66 @@ class Collection:
         os.replace(tmp, self._tenant_status_path())
 
     # -- shard management -------------------------------------------------
+    # bound concurrent shard OPENS process-wide (reference
+    # shard_load_limiter.go — deliberately a CLASS attribute: recovery
+    # re-tokenizes/replays and a fan-in of cold tenants across all
+    # collections must not open unbounded shards at once)
+    _LOAD_LIMITER = threading.Semaphore(8)
+
     def _get_shard(self, name: str) -> Shard:
-        with self._lock:
-            s = self._shards.get(name)
-            if s is None:
-                s = Shard(
-                    os.path.join(self.dir, name),
-                    self.config,
-                    name=name,
-                    sync_writes=self.sync_writes,
-                )
+        # the collection lock guards only dict state — the (slow) Shard
+        # construction runs OUTSIDE it, behind the load limiter, so one
+        # collection's recovery storm cannot stall others' reads/writes
+        while True:
+            with self._lock:
+                s = self._shards.get(name)
+                if s is not None:
+                    return s
+                ev = self._building.get(name)
+                if ev is None:
+                    ev = threading.Event()
+                    self._building[name] = ev
+                    builder = True
+                else:
+                    builder = False
+            if not builder:
+                ev.wait()
+                continue  # re-check: the builder published (or failed)
+            try:
+                with self._LOAD_LIMITER:
+                    s = Shard(
+                        os.path.join(self.dir, name),
+                        self.config,
+                        name=name,
+                        sync_writes=self.sync_writes,
+                    )
                 # cross-collection ref-filter hook (reference
                 # inverted/searcher.go ref-filter recursion)
                 s.inverted.ref_resolver = self._resolve_ref_filter
-                # a shard born inside a backup copy window inherits the
-                # pause, otherwise its compaction could delete files the
-                # backup walk already listed
-                for _ in range(self._maintenance_pause):
-                    s.store.pause_maintenance()
-                self._shards[name] = s
-            return s
+                with self._lock:
+                    # a shard born inside a backup copy window inherits
+                    # the pause, otherwise its compaction could delete
+                    # files the backup walk already listed
+                    for _ in range(self._maintenance_pause):
+                        s.store.pause_maintenance()
+                    self._shards[name] = s
+                return s
+            finally:
+                with self._lock:
+                    self._building.pop(name, None)
+                ev.set()
+
+    def _all_shard_names(self) -> list[str]:
+        """Every shard this collection OWNS (not just the lazily opened
+        ones) — maintenance (reindex/compact/backup walks) must cover
+        unopened tenants too."""
+        if self.config.multi_tenancy.enabled:
+            with self._lock:
+                return [f"tenant-{n}"
+                        for n, s in self._tenant_status.items()
+                        if s == TENANT_HOT]
+        return [f"shard{i}"
+                for i in range(max(1, self.config.sharding.desired_count))]
 
     def _resolve_ref_filter(self, inv, flt, space: int):
         """Leaf with path [refProp, TargetClass, ...rest]: evaluate the
@@ -207,12 +249,12 @@ class Collection:
                           ignore_errors=True)
 
     def reindex_inverted(self) -> int:
-        """Rebuild every open shard's inverted index (reference
-        ``inverted_reindexer.go`` per-index run). Snapshot under the lock —
-        concurrent tenant activation must not mutate the dict mid-walk."""
-        with self._lock:
-            shards = list(self._shards.values())
-        return sum(s.reindex_inverted() for s in shards)
+        """Rebuild every owned shard's inverted index (reference
+        ``inverted_reindexer.go`` per-index run). Enumerates from tenant
+        status, not the open-shard dict — with lazy loading an unopened
+        tenant would otherwise be silently skipped."""
+        return sum(self._get_shard(n).reindex_inverted()
+                   for n in self._all_shard_names())
 
     def drop_shard(self, name: str) -> None:
         """Close and delete one shard's data (replica movement: the source
@@ -836,12 +878,18 @@ class Collection:
             for s in now:
                 s.store.resume_maintenance()
 
-    def compact_once(self, min_segments: int = 4) -> None:
-        """One background-compaction pass over all shards."""
+    def compact_once(self, min_segments: int = 4,
+                     include_unopened: bool = False) -> None:
+        """One background-compaction pass. The periodic cycle touches only
+        OPEN shards (waking every lazy tenant each minute would defeat
+        lazy loading); the explicit distributed-task path passes
+        ``include_unopened`` to cover everything."""
         with self._lock:
             if self._maintenance_pause:
                 return
             shards = list(self._shards.values())
+        if include_unopened:
+            shards = [self._get_shard(n) for n in self._all_shard_names()]
         for s in shards:
             s.store.compact_all(min_segments)
 

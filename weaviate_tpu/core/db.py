@@ -59,17 +59,25 @@ class DB:
 
     def _metrics_cycle(self) -> None:
         from weaviate_tpu.monitoring.metrics import (
+            DIMENSIONS_SUM,
             OBJECT_COUNT,
             VECTOR_INDEX_SIZE,
         )
 
         for name, c in list(self._collections.items()):
+            dims_sum = 0
             for sname, s in list(c._shards.items()):
                 OBJECT_COUNT.set(s.count(), collection=name, shard=sname)
                 for tgt, idx in s._vector_indexes.items():
                     VECTOR_INDEX_SIZE.set(
                         idx.count(), collection=name, shard=sname,
                         target=tgt or "default")
+                    # dimension tracking (reference
+                    # shard_dimension_tracking.go: billed dims = n x d);
+                    # every index type carries .dims directly
+                    dims_sum += idx.count() * (
+                        getattr(idx, "dims", 0) or 0)
+            DIMENSIONS_SUM.set(dims_sum, collection=name)
 
     def _load_schema(self) -> None:
         if not os.path.exists(self._schema_path):

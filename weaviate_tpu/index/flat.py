@@ -35,6 +35,7 @@ class FlatIndex(VectorIndex):
     def __init__(self, dims: int, config: Optional[FlatIndexConfig] = None):
         from weaviate_tpu.parallel.runtime import default_mesh
 
+        self.dims = dims
         self.config = config or FlatIndexConfig()
         self.metric = self.config.distance
         # Multi-chip: the corpus rows shard across the process mesh and

@@ -65,6 +65,7 @@ class HNSWIndex(VectorIndex):
         else:
             self.backend = RawBackend(dims, self.config, store=store)
             self.store = self.backend.store
+        self.dims = dims
         self.graph = HostGraph(m=self.config.max_connections)
         self._ml = 1.0 / math.log(max(2, self.config.max_connections))
         self._level_rng = np.random.default_rng(0x5EED)
