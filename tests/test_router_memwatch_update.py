@@ -1,0 +1,167 @@
+"""Router plans, memwatch gate, live class-config updates.
+
+Reference test models: ``cluster/router`` plan tests,
+``entities/memwatch`` allocation-checker tests, and
+``usecases/schema`` update-validation tests (+ hnsw/config_update.go).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.cluster.router import Router, RoutingError
+from weaviate_tpu.cluster.sharding import ShardingState
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.monitoring.memwatch import MemoryPressure, MemWatch
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    HNSWIndexConfig,
+    Property,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+
+# -- router ----------------------------------------------------------------
+
+def _router(live=None, factor=2, n_shards=4):
+    state = ShardingState(nodes=["n0", "n1", "n2"], n_shards=n_shards,
+                          factor=factor)
+    return Router(node_id="n1", state_fn=lambda c: state,
+                  live_fn=(lambda: set(live)) if live is not None else None)
+
+
+def test_read_plan_orders_local_then_live():
+    r = _router(live={"n0", "n1"})  # n2 suspected dead
+    for s in range(4):
+        plan = r.read_plan("C", s, "ONE")
+        if "n1" in plan.replicas:
+            assert plan.ordered[0] == "n1"  # local first
+        if "n2" in plan.replicas and len(plan.ordered) > 1:
+            assert plan.ordered[-1] == "n2"  # dead last
+
+
+def test_write_plan_validates_consistency_against_liveness():
+    r = _router(live={"n0"}, factor=3)
+    with pytest.raises(RoutingError, match="unsatisfiable"):
+        r.write_plan("C", 0, "QUORUM")
+    # ONE is satisfiable with a single live replica
+    plan = r.write_plan("C", 0, "ONE")
+    assert plan.required == 1
+
+
+def test_invalid_consistency_level_rejected():
+    r = _router()
+    with pytest.raises(RoutingError, match="invalid consistency"):
+        r.read_plan("C", 0, "TWO")
+
+
+def test_plan_for_uuid_and_scatter():
+    r = _router(factor=2)
+    p = r.plan_for_uuid("C", "00000000-0000-0000-0000-000000000001")
+    assert 0 <= p.shard < 4 and len(p.replicas) == 2
+    plans = r.all_plans("C")
+    assert [p.shard for p in plans] == [0, 1, 2, 3]
+
+
+# -- memwatch --------------------------------------------------------------
+
+def test_memwatch_rejects_over_watermark():
+    mw = MemWatch(max_ratio=0.9)
+    mw.limit = mw._refresh() + (1 << 30)  # headroom: 1GB
+    mw._read_at = 1e18  # freeze cached rss
+    mw.check_alloc(1 << 20)  # 1MB fine
+    with pytest.raises(MemoryPressure):
+        mw.check_alloc(10 << 30)  # 10GB over the watermark
+    assert mw.rejections == 1
+    assert 0 < mw.usage_ratio() < 1
+
+
+def test_memwatch_gates_batch_import(tmp_path, monkeypatch):
+    from weaviate_tpu.monitoring import memwatch as mwmod
+
+    db = DB(str(tmp_path))
+    db.create_collection(CollectionConfig(
+        name="M", properties=[Property(name="t")]))
+    col = db.get_collection("M")
+    monkeypatch.setattr(mwmod.MONITOR, "limit", 1)  # everything rejects
+    monkeypatch.setattr(mwmod.MONITOR, "_read_at", 1e18)
+    monkeypatch.setattr(mwmod.MONITOR, "_rss", 2)
+    with pytest.raises(MemoryPressure):
+        col.put_batch([StorageObject(
+            uuid="de000000-0000-0000-0000-000000000001", collection="M",
+            properties={"t": "x"}, vector=np.ones(8, np.float32))])
+    db.close()
+
+
+# -- live class update -----------------------------------------------------
+
+def test_put_schema_updates_mutable_fields_live(tmp_path):
+    from weaviate_tpu.api.rest import RestAPI
+
+    db = DB(str(tmp_path))
+    db.create_collection(CollectionConfig(
+        name="U", properties=[Property(name="t")],
+        vector_config=HNSWIndexConfig(distance="l2-squared", ef=64,
+                                      ef_construction=32,
+                                      max_connections=8)))
+    col = db.get_collection("U")
+    col.put_batch([StorageObject(
+        uuid="df000000-0000-0000-0000-000000000001", collection="U",
+        properties={"t": "x"}, vector=np.ones(8, np.float32))])
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{srv.server_port}/v1"
+
+    def put(p, body):
+        req = urllib.request.Request(
+            base + p, data=json.dumps(body).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=10)
+
+    with put("/schema/U", {
+        "vectorIndexConfig": {"ef": 256, "flatSearchCutoff": 1234},
+        "invertedIndexConfig": {"bm25": {"k1": 1.5, "b": 0.6}},
+        "description": "updated",
+    }) as r:
+        out = json.loads(r.read())
+    assert out["vectorIndexConfig"]["ef"] == 256
+    # live: open shard's index sees the new knobs without reopen
+    shard = next(iter(col._shards.values()))
+    idx = shard._vector_indexes[""]
+    inner = getattr(idx, "_inner", idx)
+    assert inner.config.ef == 256
+    assert inner.config.flat_search_cutoff == 1234
+    assert shard.inverted.k1 == 1.5 and shard.inverted.b == 0.6
+    assert col.config.description == "updated"
+
+    # immutable fields reject with 422
+    for body in ({"vectorIndexConfig": {"distance": "cosine"}},
+                 {"vectorIndexType": "flat"}):
+        try:
+            put("/schema/U", body)
+            raise AssertionError("immutable change accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 422
+    api.shutdown()
+    db.close()
+
+
+def test_update_survives_restart(tmp_path):
+    db = DB(str(tmp_path))
+    db.create_collection(CollectionConfig(
+        name="U2", properties=[Property(name="t")],
+        vector_config=HNSWIndexConfig(distance="l2-squared", ef=64,
+                                      ef_construction=32,
+                                      max_connections=8)))
+    from weaviate_tpu.api.schema_translate import update_class_from_rest
+
+    cfg = update_class_from_rest(
+        db.get_collection("U2").config, {"vectorIndexConfig": {"ef": 512}})
+    db.update_collection("U2", cfg)
+    db.close()
+    db2 = DB(str(tmp_path))
+    assert db2.get_collection("U2").config.vector_config.ef == 512
+    db2.close()

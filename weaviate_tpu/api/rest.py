@@ -149,7 +149,7 @@ class RestAPI:
             Rule("/v1/.well-known/live", endpoint="live", methods=["GET"]),
             Rule("/v1/schema", endpoint="schema", methods=["GET", "POST"]),
             Rule("/v1/schema/<cls>", endpoint="schema_class",
-                 methods=["GET", "DELETE"]),
+                 methods=["GET", "PUT", "DELETE"]),
             Rule("/v1/schema/<cls>/properties", endpoint="schema_properties",
                  methods=["POST"]),
             Rule("/v1/schema/<cls>/tenants", endpoint="tenants",
@@ -229,6 +229,15 @@ class RestAPI:
         except (KeyError, ValueError, TypeError) as e:
             response = _json_response(
                 {"error": [{"message": str(e)}]}, 422)
+        except Exception as e:
+            from weaviate_tpu.monitoring.memwatch import MemoryPressure
+
+            if isinstance(e, MemoryPressure):
+                # back-pressure, not failure: clients should retry later
+                response = _json_response(
+                    {"error": [{"message": str(e)}]}, 503)
+            else:
+                raise
         return response(environ, start_response)
 
     def _write_action(self, obj: StorageObject) -> str:
@@ -296,6 +305,25 @@ class RestAPI:
             self._authz(request, "read_schema", f"collections/{cls}")
             if not self.db.has_collection(cls):
                 _abort(404, f"class {cls!r} not found")
+            return _json_response(
+                class_to_rest(self.db.get_collection(cls).config))
+        if request.method == "PUT":
+            # live class update: only mutable fields (reference
+            # schema update validation + hnsw/config_update.go)
+            self._authz(request, "update_schema", f"collections/{cls}")
+            if not self.db.has_collection(cls):
+                _abort(404, f"class {cls!r} not found")
+            from weaviate_tpu.api.schema_translate import (
+                update_class_from_rest,
+            )
+
+            try:
+                new_cfg = update_class_from_rest(
+                    self.db.get_collection(cls).config,
+                    self._body(request))
+                self.db.update_collection(cls, new_cfg)
+            except ValueError as e:
+                _abort(422, str(e))
             return _json_response(
                 class_to_rest(self.db.get_collection(cls).config))
         self._authz(request, "delete_schema", f"collections/{cls}")

@@ -110,6 +110,77 @@ def property_from_rest(p: dict) -> Property:
     )
 
 
+MUTABLE_VECTOR_FIELDS = {
+    # reference hnsw/config_update.go ValidateUserConfigUpdate: the
+    # traversal-time knobs are live-mutable; structural ones are not
+    "ef": "ef", "dynamicEfMin": "dynamic_ef_min",
+    "dynamicEfMax": "dynamic_ef_max", "dynamicEfFactor": "dynamic_ef_factor",
+    "flatSearchCutoff": "flat_search_cutoff",
+    "vectorCacheMaxObjects": "vector_cache_max_objects",
+}
+
+_IMMUTABLE_VECTOR_FIELDS = {
+    "distance", "maxConnections", "efConstruction", "multivector",
+}
+
+
+def update_class_from_rest(cfg: CollectionConfig, d: dict
+                           ) -> CollectionConfig:
+    """Apply a class update (PUT /v1/schema/{class}) to an existing
+    config, accepting only live-mutable fields (reference
+    ``usecases/schema`` update validation + ``hnsw/config_update.go``).
+    Raises ValueError on attempts to change immutable structure."""
+    import copy
+
+    out = copy.deepcopy(cfg)
+    if d.get("class") not in (None, cfg.name):
+        raise ValueError("class name is immutable")
+    if "description" in d:
+        out.description = d["description"] or ""
+    inv = d.get("invertedIndexConfig") or {}
+    bm25 = inv.get("bm25") or {}
+    if "k1" in bm25:
+        out.inverted_config.bm25_k1 = float(bm25["k1"])
+    if "b" in bm25:
+        out.inverted_config.bm25_b = float(bm25["b"])
+    if "stopwords" in inv:
+        preset = (inv["stopwords"] or {}).get("preset")
+        if preset:
+            out.inverted_config.stopwords_preset = preset
+    repl = d.get("replicationConfig") or {}
+    if "factor" in repl:
+        out.replication.factor = int(repl["factor"])
+    vic = d.get("vectorIndexConfig") or {}
+    for rest_name in vic:
+        if rest_name in _IMMUTABLE_VECTOR_FIELDS:
+            attr = _camel_to_snake(rest_name)
+            if not hasattr(out.vector_config, attr):
+                # a field this config doesn't model (clients echo back
+                # whole GET payloads, e.g. multivector:{enabled:false})
+                # cannot conflict — ignore rather than reject the no-op
+                continue
+            if vic[rest_name] != getattr(out.vector_config, attr):
+                raise ValueError(
+                    f"vectorIndexConfig.{rest_name} is immutable")
+    if "vectorIndexType" in d and \
+            d["vectorIndexType"] != out.vector_config.index_type:
+        raise ValueError("vectorIndexType is immutable")
+    for rest_name, attr in MUTABLE_VECTOR_FIELDS.items():
+        if rest_name in vic and hasattr(out.vector_config, attr):
+            setattr(out.vector_config, attr, int(vic[rest_name]))
+    q = vic.get("pq") or vic.get("bq") or vic.get("sq") or vic.get("rq")
+    if q and out.vector_config.quantizer is not None and \
+            "rescoreLimit" in q:
+        out.vector_config.quantizer.rescore_limit = int(q["rescoreLimit"])
+    return out
+
+
+def _camel_to_snake(name: str) -> str:
+    import re as _re
+
+    return _re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
 def class_from_rest(d: dict) -> CollectionConfig:
     """Weaviate-style class JSON → CollectionConfig. Also accepts the
     internal ``to_dict`` shape (round-trip)."""

@@ -213,6 +213,11 @@ class BackupHandler:
                     for tname in os.listdir(tmp_frozen):
                         tdst = os.path.join(dst_root, tname)
                         shutil.rmtree(tdst, ignore_errors=True)
+                        if os.path.exists(tdst):
+                            # a surviving stale dir would make move() NEST
+                            # the restore inside it — fail loudly instead
+                            raise BackupError(
+                                f"cannot clear stale frozen copy {tdst}")
                         # shutil.move, not os.replace: the offload tier is
                         # commonly a different mount (EXDEV)
                         shutil.move(os.path.join(tmp_frozen, tname), tdst)

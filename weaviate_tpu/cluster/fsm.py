@@ -42,6 +42,10 @@ class SchemaFSM:
             if op == "delete_class":
                 self.db.delete_collection(cmd["name"])
                 return {"ok": True}
+            if op == "update_class":
+                cfg = CollectionConfig.from_dict(cmd["class"])
+                self.db.update_collection(cfg.name, cfg)
+                return {"ok": True}
             if op == "add_property":
                 prop = Property.from_dict(cmd["property"])
                 try:

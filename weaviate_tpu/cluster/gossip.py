@@ -111,6 +111,11 @@ class Gossip:
     def alive(self, node: str) -> bool:
         return self.status(node) != DEAD
 
+    def live_nodes(self) -> list[str]:
+        """Peers not declared DEAD (router liveness view)."""
+        return [n for n in (set(self.peers_fn()) | {self.id})
+                if self.alive(n)]
+
     def order_by_liveness(self, nodes: list[str]) -> list[str]:
         """Stable sort: ALIVE first, then SUSPECT, then DEAD — readers try
         healthy replicas before burning timeouts on dead ones."""

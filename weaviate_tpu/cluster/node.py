@@ -177,6 +177,13 @@ class ClusterNode:
     def delete_collection(self, name: str) -> None:
         self.raft.submit({"op": "delete_class", "name": name})
 
+    def update_collection(self, cfg: CollectionConfig) -> None:
+        """Replicated live class update — every node applies the same
+        mutable-config delta (reference schema update via raft FSM)."""
+        r = self.raft.submit({"op": "update_class", "class": cfg.to_dict()})
+        if not r.get("ok"):
+            raise ValueError(r.get("error", "update_class failed"))
+
     def add_tenants(self, cls: str, tenants: list[dict]) -> None:
         r = self.raft.submit({"op": "add_tenants", "class": cls,
                               "tenants": tenants})
@@ -198,6 +205,24 @@ class ClusterNode:
             factor=max(1, cfg.replication.factor),
             overrides=overrides,
         )
+
+    @property
+    def router(self):
+        """Routing-plan builder (reference cluster/router/router.go):
+        explicit ReplicaPlan values with consistency-level validation over
+        the same sharding state the data plane uses. Cached — the
+        callables are stable, plans are built per call."""
+        r = getattr(self, "_router", None)
+        if r is None:
+            from weaviate_tpu.cluster.router import Router
+
+            r = Router(
+                node_id=self.id,
+                state_fn=self._state_for,
+                live_fn=lambda: set(self.gossip.live_nodes()),
+            )
+            self._router = r
+        return r
 
     def _ordered(self, replicas: list[str]) -> list[str]:
         """Live replicas first so reads don't burn timeouts on dead peers."""

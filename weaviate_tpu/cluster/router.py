@@ -1,0 +1,116 @@
+"""Router: explicit read/write routing plans with consistency validation.
+
+Reference: ``cluster/router/router.go:65,334`` + ``types/`` — builds
+ordered replica plans per shard (local replica first, then live peers),
+validates the requested consistency level against the replica count, and
+resolves tenant partitions. ``ClusterNode`` previously inlined replica
+ordering + failover; the Router makes the plan an inspectable value (the
+reference exposes it to the resolver/finder layers the same way).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from weaviate_tpu.cluster.sharding import ShardingState, required_acks
+
+CONSISTENCY_LEVELS = ("ONE", "QUORUM", "ALL")
+
+
+class RoutingError(ValueError):
+    pass
+
+
+@dataclass
+class ReplicaPlan:
+    """One shard's routing decision."""
+
+    collection: str
+    shard: int
+    replicas: list[str]          # full membership, placement order
+    ordered: list[str]           # contact order (local + live first)
+    consistency: str
+    required: int                # acks needed for the level
+
+    def quorum_possible(self, live: set[str]) -> bool:
+        return sum(1 for r in self.replicas if r in live) >= self.required
+
+
+@dataclass
+class Router:
+    """Plan builder over the sharding state + liveness view."""
+
+    node_id: str
+    state_fn: Callable[[str], ShardingState]   # collection -> state
+    live_fn: Optional[Callable[[], set[str]]] = None  # gossip view
+    tenant_fn: Optional[Callable[[str, str], str]] = None
+
+    def _live(self) -> Optional[set[str]]:
+        return self.live_fn() if self.live_fn is not None else None
+
+    def _order(self, replicas: list[str]) -> list[str]:
+        """Local replica first (avoids a network hop), then live peers,
+        then suspected-dead ones as a last resort (they may have
+        recovered; the data plane's failover will skip them on error)."""
+        live = self._live()
+
+        def rank(r: str) -> tuple:
+            return (r != self.node_id,
+                    live is not None and r not in live,
+                    r)
+        return sorted(replicas, key=rank)
+
+    def _plan(self, collection: str, shard: int, consistency: str,
+              tenant: str = "") -> ReplicaPlan:
+        if consistency not in CONSISTENCY_LEVELS:
+            raise RoutingError(
+                f"invalid consistency level {consistency!r} "
+                f"(one of {CONSISTENCY_LEVELS})")
+        state = self.state_fn(collection)
+        replicas = state.replicas(shard)
+        if not replicas:
+            raise RoutingError(
+                f"no replicas for {collection}/shard{shard}")
+        need = required_acks(consistency,
+                             min(state.factor, len(replicas)))
+        return ReplicaPlan(
+            collection=collection, shard=shard, replicas=replicas,
+            ordered=self._order(replicas), consistency=consistency,
+            required=need)
+
+    # -- public surface (reference router.go BuildReadRoutingPlan /
+    # BuildWriteRoutingPlan) ------------------------------------------------
+    def read_plan(self, collection: str, shard: int,
+                  consistency: str = "ONE",
+                  tenant: str = "") -> ReplicaPlan:
+        return self._plan(collection, shard, consistency, tenant)
+
+    def write_plan(self, collection: str, shard: int,
+                   consistency: str = "QUORUM",
+                   tenant: str = "") -> ReplicaPlan:
+        plan = self._plan(collection, shard, consistency, tenant)
+        live = self._live()
+        if live is not None and not plan.quorum_possible(live):
+            raise RoutingError(
+                f"consistency {consistency} unsatisfiable for "
+                f"{collection}/shard{shard}: "
+                f"{sum(1 for r in plan.replicas if r in live)} of "
+                f"{len(plan.replicas)} replicas live, need "
+                f"{plan.required}")
+        return plan
+
+    def plan_for_uuid(self, collection: str, uuid: str,
+                      consistency: str = "QUORUM",
+                      write: bool = False) -> ReplicaPlan:
+        state = self.state_fn(collection)
+        shard, _ = state.shard_replicas_for_uuid(uuid)
+        return (self.write_plan if write else self.read_plan)(
+            collection, shard, consistency)
+
+    def all_plans(self, collection: str, consistency: str = "ONE"
+                  ) -> list[ReplicaPlan]:
+        """Scatter plans for a full-collection read (search fan-out)."""
+        state = self.state_fn(collection)
+        return [self.read_plan(collection, s, consistency)
+                for s in range(state.n_shards)]

@@ -62,9 +62,14 @@ class TaskFSM:
             if t["node_status"].get(node) is not None:
                 return {"ok": False, "error": "already claimed"}
             t["node_status"][node] = TASK_RUNNING
+            t.setdefault("claimed_at", {})[node] = cmd.get("ts", 0.0)
             t["status"] = TASK_RUNNING
             return {"ok": True}
         if op == "task_report":
+            if t["status"] in (TASK_FINISHED, TASK_FAILED, TASK_CANCELLED):
+                # a reaped/cancelled task is terminal: late reports must
+                # not mutate it back
+                return {"ok": False, "error": "task already terminal"}
             node = cmd["node"]
             ok = cmd.get("success", False)
             t["node_status"][node] = TASK_FINISHED if ok else TASK_FAILED
@@ -86,23 +91,38 @@ class TaskFSM:
             return {"ok": True}
         if op == "task_reap":
             # deterministic: `now` is stamped by the submitter before
-            # replication, so every applier makes the same decision
+            # replication, so every applier makes the same decision.
+            # UNCLAIMED nodes fail after one lease (never showed up);
+            # CLAIMED-but-silent nodes get 3 leases — an actively running
+            # task is slow, not dead, and must not be force-failed at the
+            # first deadline.
             now = float(cmd.get("now", 0.0))
             if t["status"] in (TASK_FINISHED, TASK_FAILED, TASK_CANCELLED):
                 return {"ok": True, "reaped": 0}
-            if now - t.get("submitted_at", 0.0) < t.get("lease_s", 300.0):
-                return {"ok": True, "reaped": 0}
+            lease = t.get("lease_s", 300.0)
+            claimed_at = t.get("claimed_at", {})
             reaped = 0
             for n in t["nodes"]:
-                if t["node_status"].get(n) in (TASK_FINISHED, TASK_FAILED):
+                st = t["node_status"].get(n)
+                if st in (TASK_FINISHED, TASK_FAILED):
                     continue
-                t["node_status"][n] = TASK_FAILED
-                t["node_result"][n] = {"error": "lease expired"}
-                reaped += 1
-            t["status"] = (
-                TASK_FAILED if any(
-                    t["node_status"].get(n) == TASK_FAILED
-                    for n in t["nodes"]) else TASK_FINISHED)
+                if st is None:
+                    overdue = now - t.get("submitted_at", 0.0) >= lease
+                else:  # claimed, still RUNNING
+                    overdue = now - claimed_at.get(
+                        n, t.get("submitted_at", 0.0)) >= 3 * lease
+                if overdue:
+                    t["node_status"][n] = TASK_FAILED
+                    t["node_result"][n] = {"error": "lease expired"}
+                    reaped += 1
+            done = [n for n in t["nodes"]
+                    if t["node_status"].get(n) in (TASK_FINISHED,
+                                                   TASK_FAILED)]
+            if len(done) == len(t["nodes"]):
+                t["status"] = (
+                    TASK_FAILED if any(
+                        t["node_status"].get(n) == TASK_FAILED
+                        for n in t["nodes"]) else TASK_FINISHED)
             return {"ok": True, "reaped": reaped}
         if op == "task_cleanup":
             cutoff = cmd.get("before", 0.0)
@@ -199,7 +219,8 @@ class DistributedTaskExecutor:
             if me not in t["nodes"] or t["node_status"].get(me) is not None:
                 continue
             claim = self.cluster.apply(
-                {"op": "task_claim", "id": t["id"], "node": me})
+                {"op": "task_claim", "id": t["id"], "node": me,
+                 "ts": time.time()})
             if not claim.get("ok"):
                 continue
             handler = self.handlers.get(t["kind"])

@@ -232,9 +232,21 @@ class Collection:
             self._tenant_status.setdefault(name, status)
             self._persist_tenant_status()
 
+    def _wait_building(self, shard_name: str) -> None:
+        """Block until no _get_shard build is in flight for the name —
+        deleting concurrently would let the builder republish a zombie
+        shard over the removed directory."""
+        while True:
+            with self._lock:
+                ev = self._building.get(shard_name)
+            if ev is None:
+                return
+            ev.wait()
+
     def remove_tenant(self, name: str) -> None:
         import shutil
 
+        self._wait_building(f"tenant-{name}")
         with self._lock:
             self._tenant_status.pop(name, None)
             self._persist_tenant_status()
@@ -248,19 +260,73 @@ class Collection:
             shutil.rmtree(os.path.join(self._offload_root(), name),
                           ignore_errors=True)
 
+    def apply_config_update(self, new_cfg: CollectionConfig) -> None:
+        """Swap in a live-mutable config (reference
+        ``hnsw/config_update.go`` + migrator UpdateInvertedIndexConfig).
+        Traversal knobs (ef, dynamic ef, cutoff) take effect on the next
+        query; BM25 k1/b on the next scoring call."""
+        with self._lock:
+            self.config = new_cfg
+            shards = list(self._shards.values())
+        for s in shards:
+            s.config = new_cfg
+            s.inverted.config = new_cfg
+            s.inverted.k1 = new_cfg.inverted_config.bm25_k1
+            s.inverted.b = new_cfg.inverted_config.bm25_b
+            # the native WAND engine carries its own k1/b, and the
+            # stopword set was frozen at init — both must follow
+            if s.inverted.native is not None:
+                s.inverted.native.set_params(
+                    new_cfg.inverted_config.bm25_k1,
+                    new_cfg.inverted_config.bm25_b)
+            from weaviate_tpu.inverted.analyzer import stopword_set
+
+            s.inverted.stopwords = stopword_set(
+                new_cfg.inverted_config.stopwords_preset)
+            for tgt, idx in s._vector_indexes.items():
+                vic = (new_cfg.named_vectors.get(tgt)
+                       if tgt else new_cfg.vector_config)
+                if vic is None:
+                    continue
+                if hasattr(idx, "config"):
+                    idx.config = vic
+                inner = getattr(idx, "_inner", None)
+                if inner is not None and hasattr(inner, "config"):
+                    inner.config = vic
+
+    @contextmanager
+    def _maintenance_shards(self):
+        """Yield every OWNED shard, then evict the ones this pass had to
+        open — a maintenance sweep over 10k lazy tenants must not leave
+        them all resident (that would undo lazy loading and trip the
+        memwatch gate)."""
+        with self._lock:
+            before = set(self._shards)
+        names = self._all_shard_names()
+        try:
+            yield [self._get_shard(n) for n in names]
+        finally:
+            for n in names:
+                if n not in before:
+                    with self._lock:
+                        s = self._shards.pop(n, None)
+                    if s is not None:
+                        s.close()
+
     def reindex_inverted(self) -> int:
         """Rebuild every owned shard's inverted index (reference
         ``inverted_reindexer.go`` per-index run). Enumerates from tenant
         status, not the open-shard dict — with lazy loading an unopened
         tenant would otherwise be silently skipped."""
-        return sum(self._get_shard(n).reindex_inverted()
-                   for n in self._all_shard_names())
+        with self._maintenance_shards() as shards:
+            return sum(s.reindex_inverted() for s in shards)
 
     def drop_shard(self, name: str) -> None:
         """Close and delete one shard's data (replica movement: the source
         copy after a routing flip, reference ``copier/`` drop phase)."""
         import shutil
 
+        self._wait_building(name)
         with self._lock:
             s = self._shards.pop(name, None)
         if s is not None:
@@ -889,7 +955,10 @@ class Collection:
                 return
             shards = list(self._shards.values())
         if include_unopened:
-            shards = [self._get_shard(n) for n in self._all_shard_names()]
+            with self._maintenance_shards() as all_shards:
+                for s in all_shards:
+                    s.store.compact_all(min_segments)
+            return
         for s in shards:
             s.store.compact_all(min_segments)
 

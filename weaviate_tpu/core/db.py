@@ -146,6 +146,16 @@ class DB:
             c.config.properties.append(prop)
             self._persist_schema()
 
+    def update_collection(self, collection: str, new_cfg) -> None:
+        """Apply a validated live config update (reference migrator
+        UpdateVectorIndexConfig + inverted config updates): the new config
+        propagates to every OPEN shard's indexes immediately; lazily
+        opened shards read it at construction."""
+        with self._lock:
+            c = self.get_collection(collection)
+            c.apply_config_update(new_cfg)
+            self._persist_schema()
+
     def collections(self) -> list[str]:
         return sorted(self._collections.keys())
 

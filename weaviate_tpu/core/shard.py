@@ -351,6 +351,18 @@ class Shard:
         resolve doc ids (new vs update), store objects, update inverted,
         feed vector indexes in one device batch per target vector.
         """
+        # memwatch gate (reference memwatch.CheckAlloc on the write path):
+        # refuse the batch under memory pressure instead of OOMing mid-write
+        from weaviate_tpu.monitoring.memwatch import MONITOR
+
+        est = sum(
+            (len(o.properties) * 64)
+            + (0 if o.vector is None
+               else np.asarray(o.vector).nbytes * 2)
+            + sum(np.asarray(v).nbytes * 2
+                  for v in o.named_vectors.values())
+            for o in objs)
+        MONITOR.check_alloc(est, "batch import")
         with self._lock:
             # validate up-front so a bad object can't leave a partial batch:
             # every vector for a target must match the index dims (or, for a

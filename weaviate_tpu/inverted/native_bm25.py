@@ -35,6 +35,8 @@ def _bind():
         ctypes.c_uint32]
     lib.bm25_add_term.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, _I64, _U32, _U32, ctypes.c_uint64]
+    lib.bm25_set_params.argtypes = [
+        ctypes.c_void_p, ctypes.c_float, ctypes.c_float]
     lib.bm25_remove_doc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.bm25_compact.argtypes = [ctypes.c_void_p]
     lib.bm25_posting_len.restype = ctypes.c_uint64
@@ -69,6 +71,11 @@ class NativeBM25:
         self._h = ctypes.c_void_p(self._lib.bm25_new(k1, b))
         self._lock = threading.Lock()
         self._removals = 0
+
+    def set_params(self, k1: float, b: float) -> None:
+        """Live scoring-param update (schema PUT applies without rebuild)."""
+        with self._lock:
+            self._lib.bm25_set_params(self._h, k1, b)
 
     def __del__(self):
         h = getattr(self, "_h", None)

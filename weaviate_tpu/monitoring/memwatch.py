@@ -1,0 +1,99 @@
+"""Memory watermark monitor: reject heavy work before the OOM killer does.
+
+Reference: ``entities/memwatch`` — an allocation checker consulted by the
+write path and background loaders (``CheckAlloc``/``CheckMappingAndReserve``)
+against a max-ratio of system memory. Process RSS comes from /proc (Linux)
+with a resource.getrusage fallback; limits honor cgroup v2/v1 caps when the
+process runs containerized.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+        return None if raw == "max" else int(raw)
+    except (OSError, ValueError):
+        return None
+
+
+def system_memory_limit() -> int:
+    """Effective memory cap in bytes: cgroup limit when present (and
+    sane), else total system RAM."""
+    for p in ("/sys/fs/cgroup/memory.max",
+              "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        v = _read_int(p)
+        if v is not None and v < (1 << 60):
+            return v
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError):
+        return 16 << 30
+
+
+def process_rss() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        return rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+class MemoryPressure(RuntimeError):
+    pass
+
+
+class MemWatch:
+    """CheckAlloc-style gate. ``check_alloc(nbytes)`` raises
+    ``MemoryPressure`` when RSS + request would cross ``max_ratio`` of the
+    limit; RSS reads are cached for ``refresh_s`` so hot paths stay cheap."""
+
+    def __init__(self, max_ratio: float = 0.9, refresh_s: float = 1.0):
+        self.max_ratio = max_ratio
+        self.refresh_s = refresh_s
+        self.limit = system_memory_limit()
+        self._rss = 0
+        self._read_at = 0.0
+        self._lock = threading.Lock()
+        self.rejections = 0
+
+    def _refresh(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._read_at >= self.refresh_s:
+                self._rss = process_rss()
+                self._read_at = now
+            return self._rss
+
+    def usage_ratio(self) -> float:
+        return self._refresh() / max(1, self.limit)
+
+    def check_alloc(self, nbytes: int, what: str = "allocation") -> None:
+        rss = self._refresh()
+        if rss + nbytes > self.max_ratio * self.limit:
+            with self._lock:
+                self.rejections += 1
+            raise MemoryPressure(
+                f"{what} of {nbytes} bytes refused: rss {rss} + request "
+                f"would exceed {self.max_ratio:.0%} of limit {self.limit}")
+
+    def stats(self) -> dict:
+        return {"rss": self._refresh(), "limit": self.limit,
+                "ratio": round(self.usage_ratio(), 4),
+                "rejections": self.rejections}
+
+
+# process-wide instance (reference wires one memwatch through app state)
+MONITOR = MemWatch(
+    max_ratio=float(os.environ.get("MEMORY_MAX_RATIO", "0.9") or 0.9))

@@ -134,6 +134,14 @@ void* bm25_new(float k1, float b) {
     return ix;
 }
 
+// live config update (schema PUT): scoring params apply to the next
+// search — postings and block maxima are tf-based, so no rebuild needed
+void bm25_set_params(void* h, float k1, float b) {
+    auto* ix = static_cast<Index*>(h);
+    ix->k1 = k1;
+    ix->b = b;
+}
+
 void bm25_free(void* h) { delete static_cast<Index*>(h); }
 
 // add one document's term frequencies for one property-term-id space.
