@@ -243,3 +243,58 @@ def test_rest_schema_reference_carries_target_collection():
     ref = next(p for p in cfg.properties if p.name == "toCat")
     assert ref.data_type == DT.REFERENCE
     assert ref.target_collection == "Category"
+
+
+def test_batch_and_object_references_endpoints(db):
+    from weaviate_tpu.api.rest import RestAPI
+
+    _mk(db, "Tgt", [Property(name="name", data_type=DataType.TEXT)], [
+        StorageObject(uuid=f"a1000000-0000-0000-0000-{i:012d}",
+                      collection="Tgt", properties={"name": f"t{i}"},
+                      vector=np.eye(4, dtype=np.float32)[i])
+        for i in range(3)])
+    _mk(db, "Src", [
+        Property(name="title", data_type=DataType.TEXT),
+        Property(name="toTgt", data_type=DataType.REFERENCE,
+                 target_collection="Tgt"),
+    ], [StorageObject(uuid="a2000000-0000-0000-0000-000000000001",
+                      collection="Src", properties={"title": "s"},
+                      vector=np.ones(4, np.float32))])
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{srv.server_port}/v1"
+
+    def call(method, p, body):
+        req = urllib.request.Request(
+            base + p, data=json.dumps(body).encode(), method=method,
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=10)
+
+    src = "a2000000-0000-0000-0000-000000000001"
+    # batch references: two adds (idempotent on repeat)
+    with call("POST", "/batch/references", [
+        {"from": f"weaviate://localhost/Src/{src}/toTgt",
+         "to": "weaviate://localhost/Tgt/a1000000-0000-0000-0000-000000000000"},
+        {"from": f"weaviate://localhost/Src/{src}/toTgt",
+         "to": "weaviate://localhost/Tgt/a1000000-0000-0000-0000-000000000001"},
+    ]) as r:
+        out = json.loads(r.read())
+    assert all(x["result"]["status"] == "SUCCESS" for x in out), out
+    col = db.get_collection("Src")
+    assert len(col.get(src).properties["toTgt"]) == 2
+    # object-level add + delete
+    b3 = "weaviate://localhost/Tgt/a1000000-0000-0000-0000-000000000002"
+    call("POST", f"/objects/Src/{src}/references/toTgt", {"beacon": b3})
+    assert len(col.get(src).properties["toTgt"]) == 3
+    call("DELETE", f"/objects/Src/{src}/references/toTgt", {"beacon": b3})
+    assert len(col.get(src).properties["toTgt"]) == 2
+    # replace
+    call("PUT", f"/objects/Src/{src}/references/toTgt", [{"beacon": b3}])
+    refs = col.get(src).properties["toTgt"]
+    assert len(refs) == 1 and refs[0]["beacon"] == b3
+    # malformed beacon reports FAILED, not 500
+    with call("POST", "/batch/references", [
+            {"from": "weaviate://localhost/nope", "to": "x"}]) as r:
+        out = json.loads(r.read())
+    assert out[0]["result"]["status"] == "FAILED"
+    api.shutdown()

@@ -201,3 +201,39 @@ def test_telemetry_payload_counts():
         db.close()
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def test_pprof_endpoints(tmp_path):
+    import threading
+    import urllib.request
+
+    from weaviate_tpu.api.rest import RestAPI
+    from weaviate_tpu.core.db import DB
+
+    db = DB(str(tmp_path))
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0)
+    base = f"http://127.0.0.1:{srv.server_port}"
+    stop = threading.Event()
+
+    def busy():  # give the sampler something to see
+        while not stop.is_set():
+            sum(i * i for i in range(1000))
+
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                base + "/debug/pprof/profile?seconds=0.3", timeout=30) as r:
+            body = r.read().decode()
+        assert "stack samples" in body and "busy" in body
+        with urllib.request.urlopen(
+                base + "/debug/pprof/heap", timeout=10) as r:
+            assert b"tracemalloc started" in r.read()
+        with urllib.request.urlopen(
+                base + "/debug/pprof/heap", timeout=10) as r:
+            assert b"blocks" in r.read()
+    finally:
+        stop.set()
+    api.shutdown()
+    db.close()

@@ -10,6 +10,7 @@ unchanged; go-swagger codegen is replaced by explicit werkzeug routing.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from typing import Any, Optional
 
@@ -159,9 +160,20 @@ class RestAPI:
                  methods=["GET", "PUT", "PATCH", "DELETE", "HEAD"]),
             Rule("/v1/batch/objects", endpoint="batch_objects",
                  methods=["POST", "DELETE"]),
+            Rule("/v1/batch/references", endpoint="batch_references",
+                 methods=["POST"]),
+            Rule("/v1/objects/<cls>/<uuid>/references/<prop>",
+                 endpoint="object_references",
+                 methods=["POST", "PUT", "DELETE"]),
             Rule("/v1/graphql", endpoint="graphql", methods=["POST"]),
             Rule("/v1/nodes", endpoint="nodes", methods=["GET"]),
             Rule("/metrics", endpoint="metrics", methods=["GET"]),
+            # pprof-shaped profiling surface (reference serves Go pprof
+            # on the metrics port; here cProfile/tracemalloc equivalents)
+            Rule("/debug/pprof/profile", endpoint="pprof_profile",
+                 methods=["GET"]),
+            Rule("/debug/pprof/heap", endpoint="pprof_heap",
+                 methods=["GET"]),
             Rule("/v1/backups/<backend>", endpoint="backup_create",
                  methods=["POST"]),
             Rule("/v1/backups/<backend>/<backup_id>",
@@ -437,6 +449,92 @@ class RestAPI:
         return _json_response(_obj_to_rest(obj))
 
     # -- batch -------------------------------------------------------------
+    _UUID_RE = re.compile(
+        r"^[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}"
+        r"-[0-9a-fA-F]{4}-[0-9a-fA-F]{12}$")
+
+    @classmethod
+    def _parse_beacon(cls_, beacon: str) -> tuple[str, str, str]:
+        """weaviate://localhost[/Class]/uuid[/prop] → (class, uuid, prop).
+        The uuid is detected by SHAPE, not capitalization — uppercase hex
+        uuids are valid RFC 4122 and several clients emit them."""
+        if not beacon.startswith("weaviate://"):
+            raise ValueError(f"invalid beacon {beacon!r}")
+        parts = [p for p in
+                 beacon[len("weaviate://"):].split("/")[1:] if p]
+        cls = uuid = prop = ""
+        for p in parts:
+            if cls_._UUID_RE.match(p):
+                if uuid:
+                    raise ValueError(f"invalid beacon {beacon!r}")
+                uuid = p
+            elif not uuid:
+                cls = p
+            else:
+                prop = p
+        if not uuid:
+            raise ValueError(f"invalid beacon {beacon!r}")
+        return cls, uuid, prop
+
+    def on_batch_references(self, request):
+        """Reference ``batch_references_add.go``: [{from, to}] where from
+        is weaviate://localhost/SourceClass/uuid/refProp and to addresses
+        the target object."""
+        body = self._body(request)
+        if not isinstance(body, list):
+            _abort(422, "expected a JSON array of {from, to} references")
+        refs = body
+        tenant = request.args.get("tenant", "")
+        results = []
+        for i, r in enumerate(refs):
+            try:
+                src_cls, src_id, prop = self._parse_beacon(r["from"])
+                if not src_cls or not prop:
+                    raise ValueError(
+                        "from beacon needs class and property")
+                self._authz(request, "update_data",
+                            f"collections/{src_cls}")
+                col = self.db.get_collection(src_cls)
+                col.add_reference(src_id, prop, r["to"], tenant=tenant)
+                results.append({"result": {"status": "SUCCESS"}})
+            except (KeyError, ValueError) as e:
+                results.append({"result": {
+                    "status": "FAILED",
+                    "errors": {"error": [{"message": str(e)}]}}})
+        return _json_response(results)
+
+    def on_object_references(self, request, cls, uuid, prop):
+        """Single-object reference mutations (reference objects API
+        /references/{propertyName}: POST add, PUT replace, DELETE)."""
+        self._authz(request, "update_data", f"collections/{cls}")
+        body = self._body(request)
+        tenant = request.args.get("tenant", "")
+        try:
+            col = self.db.get_collection(cls)
+        except KeyError:
+            _abort(404, f"class {cls!r} not found")
+        # body-shape errors are 422; only a missing object/class is 404
+        if request.method == "PUT":
+            if not isinstance(body, list) or any(
+                    "beacon" not in b for b in body):
+                _abort(422, "expected a JSON array of {beacon} entries")
+            beacons = [b["beacon"] for b in body]
+        else:
+            if not isinstance(body, dict) or "beacon" not in body:
+                _abort(422, "expected a JSON object with a beacon")
+        try:
+            if request.method == "POST":
+                col.add_reference(uuid, prop, body["beacon"],
+                                  tenant=tenant)
+            elif request.method == "PUT":
+                col.replace_references(uuid, prop, beacons, tenant=tenant)
+            else:
+                col.delete_reference(uuid, prop, body["beacon"],
+                                     tenant=tenant)
+        except KeyError as e:
+            _abort(404, str(e))
+        return Response(status=204)
+
     def on_batch_objects(self, request):
         body = self._body(request)
         if request.method == "DELETE":
@@ -609,6 +707,65 @@ class RestAPI:
 
         return Response(REGISTRY.render_text(),
                         content_type="text/plain; version=0.0.4")
+
+    def on_pprof_profile(self, request):
+        """CPU profile: sample every live thread's stack for ?seconds=N
+        (default 2, capped at 30) and return aggregated stack counts —
+        the /debug/pprof/profile role, py-spy-shaped output (Go's
+        signal-based profiler has no Python equivalent that can see other
+        threads; a wall-clock stack sampler does)."""
+        self._authz(request, "read_nodes")  # ops surface, not public
+        import sys
+        import time as _time
+        import traceback
+
+        seconds = min(float(request.args.get("seconds", 2) or 2), 30.0)
+        me = __import__("threading").get_ident()
+        samples: dict[str, int] = {}
+        total = 0
+        deadline = _time.monotonic() + seconds
+        while _time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue  # the sampler's own loop is noise
+                stack = "".join(traceback.format_stack(frame, limit=8))
+                samples[stack] = samples.get(stack, 0) + 1
+                total += 1
+            _time.sleep(0.01)
+        top = sorted(samples.items(), key=lambda t: -t[1])[:20]
+        out = [f"# {total} stack samples over {seconds}s "
+               f"(innermost frame last):\n"]
+        for stack, n in top:
+            out.append(f"\n=== {n} samples ===\n{stack}")
+        return Response("".join(out), content_type="text/plain")
+
+    def on_pprof_heap(self, request):
+        """Heap profile via tracemalloc: top allocation sites. First call
+        starts tracing; ?stop=true turns the (allocation-overhead-heavy)
+        tracer back off."""
+        self._authz(request, "read_nodes")  # ops surface, not public
+        import tracemalloc
+
+        if request.args.get("stop") == "true":
+            if tracemalloc.is_tracing():
+                tracemalloc.stop()
+            return Response("tracemalloc stopped\n",
+                            content_type="text/plain")
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(10)
+            return Response(
+                "tracemalloc started; call again for a snapshot "
+                "(?stop=true to disable)\n",
+                content_type="text/plain")
+        snap = tracemalloc.take_snapshot()
+        stats = snap.statistics("lineno")[:50]
+        from weaviate_tpu.monitoring.memwatch import MONITOR
+
+        lines = [f"# rss={MONITOR.stats()['rss']} "
+                 f"limit={MONITOR.stats()['limit']}\n"]
+        lines += [f"{s.size:>12} B {s.count:>8} blocks  "
+                  f"{s.traceback}\n" for s in stats]
+        return Response("".join(lines), content_type="text/plain")
 
     # -- nodes -------------------------------------------------------------
     def on_nodes(self, request):

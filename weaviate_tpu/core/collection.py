@@ -39,6 +39,8 @@ class Collection:
         self.db = db  # back-ref for cross-collection ops (ref-filters)
         os.makedirs(dirpath, exist_ok=True)
         self._lock = threading.RLock()
+        self._ref_lock = threading.Lock()  # reference read-modify-writes
+        self._get_seq = 0  # strictly-increasing shard access stamp
         self._shards: dict[str, Shard] = {}
         self._building: dict[str, threading.Event] = {}  # in-flight opens
         self._tenant_status: dict[str, str] = {}
@@ -107,6 +109,11 @@ class Collection:
             with self._lock:
                 s = self._shards.get(name)
                 if s is not None:
+                    # access stamp (under the lock) — the maintenance
+                    # eviction uses it to prove nobody else acquired the
+                    # shard since the sweep opened it
+                    self._get_seq += 1
+                    s._last_get = self._get_seq
                     return s
                 ev = self._building.get(name)
                 if ev is None:
@@ -135,6 +142,8 @@ class Collection:
                     # files the backup walk already listed
                     for _ in range(self._maintenance_pause):
                         s.store.pause_maintenance()
+                    self._get_seq += 1
+                    s._last_get = self._get_seq
                     self._shards[name] = s
                 return s
             finally:
@@ -299,19 +308,30 @@ class Collection:
         """Yield every OWNED shard, then evict the ones this pass had to
         open — a maintenance sweep over 10k lazy tenants must not leave
         them all resident (that would undo lazy loading and trip the
-        memwatch gate)."""
+        memwatch gate). Eviction is proven safe via the _last_get stamp:
+        a shard is closed only if NO other caller acquired it after the
+        sweep's own open (the stamp is written under the collection lock,
+        so the check-and-pop under the same lock cannot race a getter)."""
         with self._lock:
             before = set(self._shards)
         names = self._all_shard_names()
+        opened_at: dict[str, int] = {}
+        shards = []
+        for n in names:
+            s = self._get_shard(n)
+            if n not in before:
+                opened_at[n] = s._last_get
+            shards.append(s)
         try:
-            yield [self._get_shard(n) for n in names]
+            yield shards
         finally:
-            for n in names:
-                if n not in before:
-                    with self._lock:
-                        s = self._shards.pop(n, None)
-                    if s is not None:
-                        s.close()
+            for n, stamp in opened_at.items():
+                with self._lock:
+                    s = self._shards.get(n)
+                    if s is None or s._last_get != stamp:
+                        continue  # someone else is using it: stays open
+                    self._shards.pop(n)
+                s.close()
 
     def reindex_inverted(self) -> int:
         """Rebuild every owned shard's inverted index (reference
@@ -525,6 +545,60 @@ class Collection:
         return sum(
             self._shards[name].delete(group) for name, group in by_shard.items()
         )
+
+    def _check_ref_prop(self, prop: str) -> None:
+        p = self.config.property(prop)
+        if p is None or p.data_type.value != "cref":
+            # a typo'd prop name must not clobber scalar data with beacons
+            raise ValueError(f"property {prop!r} is not a reference")
+
+    def add_reference(self, uuid: str, prop: str, beacon: str,
+                      tenant: str = "") -> None:
+        """Append one cross-ref beacon to an object's reference property
+        (reference ``batch_references_add.go`` / objects references API).
+        Idempotent: an already-present beacon is not duplicated. The
+        read-modify-write serializes per collection so concurrent adds
+        cannot lose each other's beacons."""
+        self._check_ref_prop(prop)
+        with self._ref_lock:
+            obj = self.get(uuid, tenant=tenant)
+            if obj is None:
+                raise KeyError(f"object {uuid!r} not found")
+            cur = obj.properties.get(prop)
+            beacons = cur if isinstance(cur, list) else (
+                [cur] if cur else [])
+            if any((b.get("beacon") if isinstance(b, dict) else b) == beacon
+                   for b in beacons):
+                return
+            beacons.append({"beacon": beacon})
+            obj.properties[prop] = beacons
+            self.put(obj, tenant=tenant)
+
+    def replace_references(self, uuid: str, prop: str, beacons: list[str],
+                           tenant: str = "") -> None:
+        self._check_ref_prop(prop)
+        with self._ref_lock:
+            obj = self.get(uuid, tenant=tenant)
+            if obj is None:
+                raise KeyError(f"object {uuid!r} not found")
+            obj.properties[prop] = [{"beacon": b} for b in beacons]
+            self.put(obj, tenant=tenant)
+
+    def delete_reference(self, uuid: str, prop: str, beacon: str,
+                         tenant: str = "") -> None:
+        self._check_ref_prop(prop)
+        with self._ref_lock:
+            obj = self.get(uuid, tenant=tenant)
+            if obj is None:
+                raise KeyError(f"object {uuid!r} not found")
+            cur = obj.properties.get(prop)
+            beacons = cur if isinstance(cur, list) else (
+                [cur] if cur else [])
+            obj.properties[prop] = [
+                b for b in beacons
+                if (b.get("beacon") if isinstance(b, dict) else b)
+                != beacon]
+            self.put(obj, tenant=tenant)
 
     def delete_where(self, flt: Filter, tenant: str = "") -> int:
         """Batch delete by filter (reference ``batch_delete.go``)."""

@@ -46,8 +46,12 @@ def process_rss() -> int:
         return rss_pages * os.sysconf("SC_PAGE_SIZE")
     except (OSError, ValueError, IndexError):
         import resource
+        import sys
 
-        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # ru_maxrss is KiB on Linux but BYTES on macOS (and it's the
+        # peak, not current — the best a /proc-less platform offers)
+        return peak if sys.platform == "darwin" else peak * 1024
 
 
 class MemoryPressure(RuntimeError):
