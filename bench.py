@@ -394,6 +394,29 @@ CONFIGS = {
 }
 
 
+def _device_precheck(timeout_s: float = 180.0) -> bool:
+    """Probe device init in a SUBPROCESS with a deadline. A wedged remote
+    TPU runtime (e.g. a tunneled device whose claim lease is stuck) hangs
+    jax backend init forever; failing fast with a diagnostic line beats a
+    silent multi-hour hang of the whole bench run."""
+    import subprocess
+    import sys as _sys
+
+    try:
+        out = subprocess.run(
+            [_sys.executable, "-c",
+             "import jax; print(jax.devices()[0])"],
+            capture_output=True, text=True, timeout=timeout_s)
+        if out.returncode == 0:
+            return True
+        print(f"# device init failed: {out.stderr.strip()[-500:]}",
+              file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"# device init timed out after {timeout_s:.0f}s "
+              "(wedged TPU runtime?)", file=sys.stderr)
+    return False
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="flat1m,glove,pq,bq")
@@ -409,6 +432,10 @@ def main():
         overrides["batch"] = args.batch
     if args.iters:
         overrides["iters"] = args.iters
+    if not _device_precheck():
+        _emit({"metric": "device_unavailable", "value": 0, "unit": "error",
+               "vs_baseline": 0})
+        sys.exit(1)
     names = [c.strip() for c in args.configs.split(",") if c.strip()]
     failed = []
     for name in names:
