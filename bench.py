@@ -398,28 +398,50 @@ def _device_precheck(timeout_s: float = 180.0) -> bool:
     """Probe device init in a SUBPROCESS with a deadline. A wedged remote
     TPU runtime (e.g. a tunneled device whose claim lease is stuck) hangs
     jax backend init forever; failing fast with a diagnostic line beats a
-    silent multi-hour hang of the whole bench run."""
-    import subprocess
-    import sys as _sys
+    silent multi-hour hang of the whole bench run.
 
-    try:
-        out = subprocess.run(
-            [_sys.executable, "-c",
-             "import jax; print(jax.devices()[0])"],
-            capture_output=True, text=True, timeout=timeout_s)
-        if out.returncode == 0:
-            return True
-        print(f"# device init failed: {out.stderr.strip()[-500:]}",
-              file=sys.stderr)
-    except subprocess.TimeoutExpired:
+    The child runs in its own session with output to a temp file — a
+    probe stuck in an uninterruptible device ioctl (or jax helper
+    processes holding inherited pipes) must not turn the *timeout path*
+    into a second unbounded wait, so on deadline the whole process group
+    is killed and we stop waiting."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    with tempfile.TemporaryFile() as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; print(jax.devices()[0])"],
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            rc = proc.poll()
+            if rc is not None:
+                if rc == 0:
+                    return True
+                log.seek(0)
+                tail = log.read()[-500:].decode(errors="replace")
+                print(f"# device init failed: {tail}", file=sys.stderr)
+                return False
+            _time.sleep(0.5)
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
         print(f"# device init timed out after {timeout_s:.0f}s "
               "(wedged TPU runtime?)", file=sys.stderr)
-    return False
+        return False
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="flat1m,glove,pq,bq")
+    ap.add_argument("--skip-precheck", action="store_true",
+                    help="skip the device-init probe (saves one backend "
+                         "init on quick smoke runs)")
     # sizing overrides for quick smoke runs (apply to every selected config)
     ap.add_argument("--n", type=int, default=0, help="override corpus size")
     ap.add_argument("--batch", type=int, default=0, help="override query batch")
@@ -432,7 +454,7 @@ def main():
         overrides["batch"] = args.batch
     if args.iters:
         overrides["iters"] = args.iters
-    if not _device_precheck():
+    if not args.skip_precheck and not _device_precheck():
         _emit({"metric": "device_unavailable", "value": 0, "unit": "error",
                "vs_baseline": 0})
         sys.exit(1)
