@@ -417,16 +417,20 @@ def _device_precheck(timeout_s: float = 180.0) -> bool:
             stdout=log, stderr=subprocess.STDOUT,
             start_new_session=True)
         deadline = _time.monotonic() + timeout_s
-        while _time.monotonic() < deadline:
-            rc = proc.poll()
-            if rc is not None:
-                if rc == 0:
-                    return True
-                log.seek(0)
-                tail = log.read()[-500:].decode(errors="replace")
-                print(f"# device init failed: {tail}", file=sys.stderr)
-                return False
+        rc = None
+        while True:
+            rc = proc.poll()  # final poll AFTER the last sleep too — a
+            # probe finishing in the closing 0.5s must not read as timeout
+            if rc is not None or _time.monotonic() >= deadline:
+                break
             _time.sleep(0.5)
+        if rc is not None:
+            if rc == 0:
+                return True
+            log.seek(0)
+            tail = log.read()[-500:].decode(errors="replace")
+            print(f"# device init failed: {tail}", file=sys.stderr)
+            return False
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):

@@ -102,6 +102,26 @@ class FlatIndex(VectorIndex):
         if allow_list is not None:
             allow = _pad_mask(allow_list, cap)
         chunk = self.config.search_chunk_size
+        # optional fused Pallas kernel (env-gated; see pallas_flat.py).
+        # Taken only where its semantics match the request: bf16 is the
+        # configured precision, approximate selection is permitted
+        # (approx_recall=0.0 pins EXACT — range queries ride that), and k
+        # is small enough for the kernel's unrolled extract-min loop.
+        from weaviate_tpu.ops import pallas_flat
+
+        if (self.metric == "l2-squared" and sqnorms is not None
+                and pallas_flat.usable()
+                and self.config.precision == "bf16"
+                and approx_recall > 0.0 and k <= 64):
+            m = valid if allow is None else (valid & allow)
+            csz = min(chunk or cap, cap)
+            if cap % csz == 0:
+                out = pallas_flat.try_flat_topk(
+                    qj, corpus, sqnorms, m, k, chunk_size=csz)
+                if out is not None:
+                    d, ids = out
+                    return SearchResult(
+                        ids=np.asarray(ids), dists=np.asarray(d))
         d, ids = flat_search(
             qj,
             corpus,

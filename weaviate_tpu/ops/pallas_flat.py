@@ -1,0 +1,152 @@
+"""Pallas fused flat-search kernel: masked distance + per-chunk top-k.
+
+Reference counterpart: the SIMD distancer tier (``hnsw/distancer/asm``) —
+here ONE TPU kernel per corpus chunk computes the [B, CHUNK] distance
+block on the MXU and reduces it to [B, K] candidates on the VPU without
+ever writing the full score matrix back to HBM. The XLA two-stage path
+(``ops.distance.flat_search``) materializes [B, chunk] scores between the
+matmul and ``approx_min_k``; fusing the select into the same VMEM
+residency removes that HBM round-trip, which is the flat scan's
+bandwidth ceiling at large B.
+
+Gated OFF by default (``WEAVIATE_TPU_PALLAS_FLAT=on`` to enable in the
+serving path): semantics are validated in interpret mode on CPU, but the
+compiled kernel must prove itself against ``approx_min_k`` on real
+hardware before it takes over the hot path. ``flat.py`` falls back to
+the XLA path on any failure.
+
+Selection inside the kernel is k rounds of min+mask on the VPU — k is
+small (<=64) and static, so the unrolled extraction beats a full sort
+and needs no cross-lane shuffles beyond the row-min reduction.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from weaviate_tpu.ops.distance import MASK_DISTANCE
+
+
+def enabled() -> bool:
+    return os.environ.get("WEAVIATE_TPU_PALLAS_FLAT", "off") == "on"
+
+
+# latched after the first trace/compile failure: a backend that cannot
+# lower the kernel must not pay a full trace + exception unwind per query
+_disabled = False
+
+
+def usable() -> bool:
+    return enabled() and not _disabled
+
+
+def try_flat_topk(queries, corpus, corpus_sqnorms, mask, k,
+                  chunk_size):
+    """pallas_flat_topk with one-shot failure latching: on the first
+    error the kernel logs and disables itself for the process; callers
+    fall back to the XLA path with no per-query retry tax."""
+    global _disabled
+    if _disabled:
+        return None
+    try:
+        return pallas_flat_topk(queries, corpus, corpus_sqnorms, mask,
+                                k, chunk_size=chunk_size)
+    except Exception as e:
+        _disabled = True
+        import logging
+
+        logging.getLogger("weaviate_tpu.pallas").warning(
+            "pallas flat kernel disabled after failure "
+            "(falling back to the XLA path): %s", e)
+        return None
+
+
+def _kernel(q_ref, c_ref, norms_ref, mask_ref, vals_ref, ids_ref, *, k):
+    """One grid step: queries [B, D] x corpus chunk [C, D] -> top-k per
+    query within the chunk. mask is float32 (1 = allowed)."""
+    q = q_ref[:].astype(jnp.bfloat16)
+    c = c_ref[:].astype(jnp.bfloat16)
+    # [B, C] inner products on the MXU, fp32 accumulation
+    ip = jax.lax.dot_general(
+        q, c, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    qf = q_ref[:].astype(jnp.float32)
+    q_sq = jnp.sum(qf * qf, axis=1, keepdims=True)          # [B, 1]
+    d = q_sq - 2.0 * ip + norms_ref[:][None, :]             # [B, C]
+    d = jnp.maximum(d, 0.0)
+    d = jnp.where(mask_ref[:][None, :] > 0.5, d, MASK_DISTANCE)
+
+    b, cwidth = d.shape
+    col = jax.lax.broadcasted_iota(jnp.int32, (b, cwidth), 1)
+    # k rounds of extract-min: each round takes the row minimum, records
+    # (val, idx), then masks that column out of its row
+    for i in range(k):
+        row_min = jnp.min(d, axis=1)                        # [B]
+        # first column equal to the row min wins (ties resolve low-index,
+        # matching argmin semantics)
+        is_min = d == row_min[:, None]
+        idx = jnp.min(jnp.where(is_min, col, cwidth), axis=1)  # [B]
+        vals_ref[0, :, i] = row_min
+        ids_ref[0, :, i] = idx
+        d = jnp.where(col == idx[:, None], MASK_DISTANCE, d)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "chunk_size", "interpret"))
+def pallas_flat_topk(
+    queries: jnp.ndarray,
+    corpus: jnp.ndarray,
+    corpus_sqnorms: jnp.ndarray,
+    mask: jnp.ndarray,
+    k: int,
+    chunk_size: int = 131072,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """L2 top-k over the corpus. queries [B, D] fp32; corpus [N, D] (any
+    float dtype; cast to bf16 in-kernel); corpus_sqnorms [N] fp32 (exact,
+    fp32-computed); mask [N] float32 1/0. N must be a multiple of
+    chunk_size (pad with mask=0 rows). Returns ([B, k], [B, k])."""
+    from jax.experimental import pallas as pl
+
+    n, d_dim = corpus.shape
+    b = queries.shape[0]
+    if n % chunk_size != 0:
+        raise ValueError(f"corpus rows {n} % chunk {chunk_size} != 0")
+    grid = n // chunk_size
+
+    vals, ids = pl.pallas_call(
+        functools.partial(_kernel, k=k),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((b, d_dim), lambda i: (0, 0)),
+            pl.BlockSpec((chunk_size, d_dim), lambda i: (i, 0)),
+            pl.BlockSpec((chunk_size,), lambda i: (i,)),
+            pl.BlockSpec((chunk_size,), lambda i: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, b, k), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b, k), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid, b, k), jnp.float32),
+            jax.ShapeDtypeStruct((grid, b, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(queries.astype(jnp.float32), corpus,
+      corpus_sqnorms.astype(jnp.float32), mask.astype(jnp.float32))
+
+    # global merge of the per-chunk candidates (tiny: [B, grid*k])
+    base = (jnp.arange(grid, dtype=jnp.int32) * chunk_size)[:, None, None]
+    gids = jnp.where(ids >= chunk_size, -1, ids + base)  # masked sentinel
+    flat_v = jnp.transpose(vals, (1, 0, 2)).reshape(b, grid * k)
+    flat_i = jnp.transpose(gids, (1, 0, 2)).reshape(b, grid * k)
+    sel_v, sel_pos = jax.lax.top_k(-flat_v, k)
+    out_v = -sel_v
+    out_i = jnp.take_along_axis(flat_i, sel_pos, axis=1)
+    out_i = jnp.where(out_v >= MASK_DISTANCE, -1, out_i)
+    return out_v, out_i
