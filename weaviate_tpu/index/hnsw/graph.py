@@ -33,6 +33,9 @@ class HostGraph:
         # mirror into it so a crash since the last condensed snapshot
         # replays link ops instead of redoing construction
         self.log = None
+        # optional dirty-row callback (device adjacency mirror): called
+        # with node ids whose layer-0 row / presence changed
+        self.dirty_hook = None
 
     @property
     def capacity(self) -> int:
@@ -73,6 +76,8 @@ class HostGraph:
             self.entrypoint = node
         if self.log is not None:
             self.log.op_an(node, level)
+        if self.dirty_hook is not None:
+            self.dirty_hook(node)
 
     def add_tombstone(self, node: int) -> None:
         """Mark deleted: edges stay so traversal can route through; the node
@@ -85,6 +90,8 @@ class HostGraph:
             self._elect_entrypoint()
         if self.log is not None:
             self.log.op_ts(node)
+        if self.dirty_hook is not None:
+            self.dirty_hook(node)
 
     def remove_node_hard(self, node: int) -> None:
         """Physically drop a node (cleanup only — callers must have rewired
@@ -104,6 +111,8 @@ class HostGraph:
             self._elect_entrypoint()
         if self.log is not None:
             self.log.op_rm(node)
+        if self.dirty_hook is not None:
+            self.dirty_hook(node)
 
     def _elect_entrypoint(self) -> None:
         """New entrypoint = any live (non-tombstoned) node at the highest
@@ -158,6 +167,8 @@ class HostGraph:
             self.upper.setdefault(level, {})[node] = nbrs.copy()
         if self.log is not None:
             self.log.op_sn(level, node, nbrs)
+        if level == 0 and self.dirty_hook is not None:
+            self.dirty_hook(node)
 
     def append_neighbor(self, level: int, node: int, nbr: int) -> bool:
         """Add an edge if there's room; returns False when full (caller prunes)."""
@@ -169,6 +180,8 @@ class HostGraph:
             row[free[0]] = nbr
             if self.log is not None:
                 self.log.op_ap(level, node, nbr)
+            if self.dirty_hook is not None:
+                self.dirty_hook(node)
             return True
         layer = self.upper.setdefault(level, {})
         arr = layer.get(node)

@@ -95,6 +95,20 @@ class HNSWIndex(VectorIndex):
             self.graph.log = self._commitlog
         else:
             self._commitlog = None
+        # device-resident layer-0 beam (ops/device_beam.py): one dispatch
+        # per batch instead of one per hop. Opt-in (config flag or
+        # WEAVIATE_TPU_DEVICE_BEAM=on); unfiltered raw-backend searches
+        # only — the host loop keeps filtered + quantized paths. Created
+        # AFTER snapshot load/replay: those swap self.graph, and the
+        # mirror must bind the final graph object.
+        self._device_beam = None
+        if not self.backend.quantized and (
+                getattr(self.config, "device_beam", False)
+                or os.environ.get("WEAVIATE_TPU_DEVICE_BEAM") == "on"):
+            from weaviate_tpu.ops.device_beam import DeviceAdjacency
+
+            self._device_beam = DeviceAdjacency(self.graph)
+            self.graph.dirty_hook = self._device_beam.mark_dirty
 
     # ------------------------------------------------------------------
     # persistence: condensed-graph snapshot (reference commit_logger.go
@@ -644,6 +658,10 @@ class HNSWIndex(VectorIndex):
         all_active = np.ones(b, bool)
         for level in range(self.graph.max_level, 0, -1):
             eps = self._greedy_step_until_stable(qdev, eps, level, all_active)
+        if self._device_beam is not None and allow_list is None:
+            out = self._device_beam_search(queries, eps, ef, k)
+            if out is not None:
+                return out
         keep = self._keep_mask(allow_list)
         keep_k = max(k, min(ef, 2 * k))
         if self.backend.quantized:
@@ -655,6 +673,61 @@ class HNSWIndex(VectorIndex):
             qdev, eps, ef, 0, keep_mask=keep, keep_k=keep_k
         )
         return self.backend.rescore_topk(queries, kept_ids, kept_d, k)
+
+    def _device_beam_search(self, queries, eps, ef, k):
+        """Layer-0 walk fully on device; host filters tombstoned/deleted
+        ids out of the returned beam (sweeping semantics)."""
+        from weaviate_tpu.ops.device_beam import beam_search_layer0
+
+        try:
+            adj, present = self._device_beam.sync()
+            corpus, valid, sqnorms = self.backend.store.snapshot()
+            import jax.numpy as jnp
+
+            if self.metric == "cosine":
+                # same normalization the host path applies in
+                # prep_queries: stored vectors are normalized, queries
+                # must be too or 1 - q.c is the wrong scale
+                norms = np.linalg.norm(queries, axis=1, keepdims=True)
+                queries = queries / np.maximum(norms, 1e-12)
+            # bucket ef to a power of two so a workload mixing k values
+            # shares a handful of while_loop compiles instead of one per
+            # distinct ef (the beam tolerates extra -1/MASK width)
+            ef_pad = 1 << max(4, (int(ef) - 1).bit_length())
+            ids, d = beam_search_layer0(
+                jnp.asarray(queries),
+                corpus,
+                adj,
+                present,
+                jnp.asarray(eps.astype(np.int32)),
+                ef=ef_pad,
+                max_steps=int(4 * ef_pad + 64),
+                metric=self.metric,
+                sqnorms=sqnorms,
+                precision=self.config.precision,
+            )
+            ids = np.asarray(ids).astype(np.int64)
+            d = np.asarray(d)
+        except Exception as e:
+            import logging
+
+            logging.getLogger("weaviate_tpu.hnsw").warning(
+                "device beam disabled after failure: %s", e)
+            self.graph.dirty_hook = None
+            self._device_beam = None
+            return None
+        keep = self._keep_mask(None)
+        ok = (ids >= 0) & keep[np.clip(ids, 0, len(keep) - 1)]
+        d = np.where(ok, d, _INF)
+        ids = np.where(ok, ids, -1)
+        order = np.argsort(d, axis=1, kind="stable")[:, :k]
+        d = np.take_along_axis(d, order, axis=1)
+        ids = np.take_along_axis(ids, order, axis=1)
+        if d.shape[1] < k:
+            pad = k - d.shape[1]
+            d = np.pad(d, ((0, 0), (0, pad)), constant_values=_INF)
+            ids = np.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
+        return ids, d
 
     def _flat_filtered(self, queries, k, allow_list):
         d, ids = self.backend.flat_topk(queries, k, allow_list)

@@ -1,0 +1,167 @@
+"""Device-resident HNSW layer-0 beam search: ONE dispatch per batch.
+
+Reference hot loop: ``hnsw/search.go:726`` expands one candidate at a
+time with per-candidate SIMD distance calls. The host-side TPU redesign
+(``index/hnsw/hnsw.py _search_level``) batches each beam ITERATION into
+one device call — but still pays a host↔device round-trip per hop, which
+dominates wall time on high-latency links (a tunneled device costs
+~70ms/hop) and adds dispatch overhead everywhere else.
+
+This kernel moves the whole layer-0 walk into one ``lax.while_loop``
+under jit: the adjacency lives in HBM as a device array (see
+``DeviceAdjacency`` — an incrementally synced mirror of the host
+graph), the beam/visited state stays on device, and the host gets
+exactly one dispatch + one fetch per search batch.
+
+Semantics mirror the host implementation (lockstep best-first expansion,
+ef-bounded beam, stop when the beam holds no unexpanded candidates —
+every entry that survives the ef cut gets expanded once). Tombstoned
+nodes remain traversable; result
+filtering happens after the walk (sweeping strategy), so this path
+serves UNFILTERED searches and the host loop keeps the filtered ones
+(which track best-allowed-seen candidates mid-walk).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from weaviate_tpu.ops.distance import MASK_DISTANCE
+
+_INF = jnp.float32(MASK_DISTANCE)
+
+
+def _cand_dists(q, corpus, ids, metric, sqnorms, precision):
+    """[B, C] distances for candidate ids (-1 → MASK). Delegates to the
+    shared ``gather_distance`` kernel (single source of per-metric
+    semantics — the host frontier evaluation uses the same one)."""
+    from weaviate_tpu.ops.distance import gather_distance
+
+    d = gather_distance(q, corpus, jnp.maximum(ids, 0), metric,
+                        precision=precision)
+    return jnp.where(ids >= 0, d, _INF)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("ef", "max_steps", "metric", "precision"))
+def beam_search_layer0(
+    queries: jnp.ndarray,        # [B, D] fp32
+    corpus: jnp.ndarray,         # [N, D]
+    adjacency: jnp.ndarray,      # [N, M0] int32, -1 padded
+    present: jnp.ndarray,        # [N] bool — node exists (incl. tombstoned)
+    eps: jnp.ndarray,            # [B] int32 entrypoints
+    ef: int,
+    max_steps: int,
+    metric: str = "l2-squared",
+    sqnorms: Optional[jnp.ndarray] = None,
+    precision: str = "bf16",
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (ids [B, ef], dists [B, ef]) ascending; -1/MASK padded."""
+    b = queries.shape[0]
+    n, m0 = adjacency.shape
+    rows = jnp.arange(b)
+
+    d0 = _cand_dists(queries, corpus, eps[:, None].astype(jnp.int32),
+                     metric, sqnorms, precision)[:, 0]
+    beam_ids = jnp.full((b, ef), -1, jnp.int32).at[:, 0].set(
+        eps.astype(jnp.int32))
+    beam_d = jnp.full((b, ef), _INF, jnp.float32).at[:, 0].set(d0)
+    expanded = jnp.zeros((b, ef), bool)
+    visited = jnp.zeros((b, n), jnp.uint8).at[rows, eps].set(1)
+
+    def cond(st):
+        step, _, _, _, _, alive = st
+        return (step < max_steps) & alive
+
+    def body(st):
+        step, beam_ids, beam_d, expanded, visited, _ = st
+        cand_d = jnp.where(expanded | (beam_ids < 0), _INF, beam_d)
+        j = jnp.argmin(cand_d, axis=1)
+        cd = cand_d[rows, j]
+        # termination is beam exhaustion: every beam entry (all within the
+        # ef best seen) gets expanded exactly once — cd is drawn FROM the
+        # beam, so a "worse than ef-th best" test would be vacuous here
+        active = cd < _INF
+        expanded = expanded.at[rows, j].set(expanded[rows, j] | active)
+        cur = jnp.where(active, beam_ids[rows, j], 0)
+        nbrs = jnp.take(adjacency, jnp.maximum(cur, 0), axis=0)  # [B, M0]
+        nbrs = jnp.where(active[:, None], nbrs, -1)
+        safe = jnp.maximum(nbrs, 0)
+        seen = jnp.take_along_axis(visited, safe, axis=1) > 0
+        ok = (nbrs >= 0) & ~seen & jnp.take(present, safe)
+        nbrs = jnp.where(ok, nbrs, -1)
+        visited = visited.at[rows[:, None], safe].max(
+            ok.astype(jnp.uint8))
+        nd = _cand_dists(queries, corpus, nbrs, metric, sqnorms,
+                         precision)
+        all_ids = jnp.concatenate([beam_ids, nbrs], axis=1)
+        all_d = jnp.concatenate([beam_d, nd], axis=1)
+        all_exp = jnp.concatenate(
+            [expanded, jnp.zeros_like(nbrs, bool)], axis=1)
+        order = jnp.argsort(all_d, axis=1, stable=True)[:, :ef]
+        beam_ids = jnp.take_along_axis(all_ids, order, axis=1)
+        beam_d = jnp.take_along_axis(all_d, order, axis=1)
+        expanded = jnp.take_along_axis(all_exp, order, axis=1)
+        return (step + 1, beam_ids, beam_d, expanded, visited,
+                active.any())
+
+    _, beam_ids, beam_d, _, _, _ = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), beam_ids, beam_d, expanded, visited,
+         jnp.bool_(True)))
+    return beam_ids, beam_d
+
+
+class DeviceAdjacency:
+    """Incrementally synced device mirror of the layer-0 adjacency.
+
+    The host graph mutates rows during inserts/deletes (set_neighbors /
+    append_neighbor / rewires); uploading the full [N, 2M] array per
+    search would swamp the link, so the mirror tracks dirty rows and
+    scatters ONLY those before a search (one device call). Capacity
+    growth re-uploads wholesale (rare: doubling)."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self._adj = None        # device [cap, M0] int32
+        self._present = None    # device [cap] bool
+        self._synced_cap = 0
+        self._dirty: set[int] = set()
+        # monkeypatch-free hook: HostGraph calls log ops; we piggyback on
+        # set_neighbors/append/remove via mark_dirty from the index layer
+
+    def mark_dirty(self, *node_ids) -> None:
+        self._dirty.update(int(x) for x in node_ids)
+
+    def mark_all_dirty(self) -> None:
+        self._synced_cap = 0
+
+    def sync(self):
+        """→ (adjacency, present) device arrays, up to date."""
+        g = self.graph
+        cap = g.capacity
+        if self._adj is None or self._synced_cap != cap:
+            self._adj = jnp.asarray(g.layer0, jnp.int32)
+            pres = g.levels >= 0
+            self._present = jnp.asarray(pres)
+            self._synced_cap = cap
+            self._dirty.clear()
+            return self._adj, self._present
+        if self._dirty:
+            # atomic swap: construction threads keep calling mark_dirty
+            # concurrently — iterating the live set would race (and a
+            # dropped id would leave a device row stale forever)
+            dirty, self._dirty = self._dirty, set()
+            idx = np.fromiter((i for i in dirty if i < cap), np.int32)
+            if len(idx):
+                rows = jnp.asarray(g.layer0[idx], jnp.int32)
+                self._adj = self._adj.at[jnp.asarray(idx)].set(rows)
+                self._present = self._present.at[jnp.asarray(idx)].set(
+                    jnp.asarray(g.levels[idx] >= 0))
+        return self._adj, self._present

@@ -264,6 +264,9 @@ class HNSWIndexConfig(VectorIndexConfig):
     vector_cache_max_objects: int = 1_000_000_000_000
     # TPU-specific: how many frontier candidates to evaluate per device call
     frontier_batch: int = 256
+    # device-resident layer-0 beam walk (ops/device_beam.py): one dispatch
+    # per search batch instead of one per hop; also WEAVIATE_TPU_DEVICE_BEAM
+    device_beam: bool = False
     # lockstep construction batch: larger = fewer device round-trips but
     # more intra-batch blindness (~0.98 recall @64, ~0.93 @256 on random
     # data); bulk loads that rebuild can afford 256+
