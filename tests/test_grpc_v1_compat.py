@@ -285,3 +285,45 @@ def test_batch_stream_requires_auth_when_configured():
         db.close()
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def test_batch_references_rpc(tmp_path):
+    import weaviate_tpu.api.proto.weaviate_v1_compat_pb2 as wv
+
+    db = DB(str(tmp_path))
+    db.create_collection(CollectionConfig(
+        name="Books",
+        properties=[
+            Property(name="title", data_type=DataType.TEXT),
+            Property(name="authoredBy", data_type=DataType.REFERENCE,
+                     target_collection="Books"),
+        ],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32")))
+    col = db.get_collection("Books")
+    uuids = [f"0b000000-0000-0000-0000-{i:012d}" for i in range(2)]
+    col.put_batch([StorageObject(
+        uuid=u, collection="Books", properties={"title": f"b{i}"},
+        vector=np.eye(4, dtype=np.float32)[i])
+        for i, u in enumerate(uuids)])
+    api = GrpcAPI(db)
+    port = api.serve(port=0)
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    stub = chan.unary_unary(
+        "/weaviate.v1.Weaviate/BatchReferences",
+        request_serializer=wv.BatchReferencesRequest.SerializeToString,
+        response_deserializer=wv.BatchReferencesReply.FromString)
+    req = wv.BatchReferencesRequest(references=[
+        wv.BatchReference(name="authoredBy", from_collection="Books",
+                          from_uuid=uuids[0], to_collection="Books",
+                          to_uuid=uuids[1]),
+        wv.BatchReference(name="title", from_collection="Books",
+                          from_uuid=uuids[0], to_uuid=uuids[1]),
+    ])
+    reply = stub(req)
+    # second entry targets a TEXT property: rejected per-index, first lands
+    assert len(reply.errors) == 1 and reply.errors[0].index == 1
+    refs = col.get(uuids[0]).properties["authoredBy"]
+    assert refs and refs[0]["beacon"].endswith(uuids[1])
+    api.shutdown()
+    db.close()

@@ -428,6 +428,36 @@ class WeaviateV1Service:
         reply.took = time.perf_counter() - t0
         return reply
 
+    def batch_references(self, req: wv.BatchReferencesRequest,
+                         context) -> wv.BatchReferencesReply:
+        """Reference ``grpc/v1/batch references`` handler: each entry names
+        (from_collection, from_uuid, property) and the target uuid; errors
+        report per index like BatchObjects."""
+        t0 = time.perf_counter()
+        principal, groups = self._identity(context)
+        # authorize EVERY entry before applying ANY (batch_objects order):
+        # a mid-loop PERMISSION_DENIED abort after partial writes would
+        # leave the client unable to tell what landed
+        for ref in req.references:
+            self._check(context, principal, groups, "update_data",
+                        f"collections/{ref.from_collection}")
+        reply = wv.BatchReferencesReply()
+        for i, ref in enumerate(req.references):
+            try:
+                col = self.db.get_collection(ref.from_collection)
+                target_cls = ref.to_collection or ""
+                beacon = ("weaviate://localhost/"
+                          + (f"{target_cls}/" if target_cls else "")
+                          + ref.to_uuid)
+                col.add_reference(ref.from_uuid, ref.name, beacon,
+                                  tenant=ref.tenant)
+            except (KeyError, ValueError) as e:
+                err = reply.errors.add()
+                err.index = i
+                err.error = str(e)
+        reply.took = time.perf_counter() - t0
+        return reply
+
     # -- BatchStream (bidi) ------------------------------------------------
     def batch_stream(self, request_iterator, context):
         """start -> Started; each Data -> Acks then Results; stop ->
@@ -613,6 +643,8 @@ class WeaviateV1Service:
             "Search": unary(self.search, wv.SearchRequest),
             "BatchObjects": unary(self.batch_objects,
                                   wv.BatchObjectsRequest),
+            "BatchReferences": unary(self.batch_references,
+                                     wv.BatchReferencesRequest),
             "BatchDelete": unary(self.batch_delete, wv.BatchDeleteRequest),
             "TenantsGet": unary(self.tenants_get, wv.TenantsGetRequest),
             "Aggregate": unary(self.aggregate, wv.AggregateRequest),

@@ -703,18 +703,25 @@ class HNSWIndex(VectorIndex):
                 ef=ef_pad,
                 max_steps=int(4 * ef_pad + 64),
                 metric=self.metric,
-                sqnorms=sqnorms,
                 precision=self.config.precision,
             )
             ids = np.asarray(ids).astype(np.int64)
             d = np.asarray(d)
+            self._beam_proven = True
         except Exception as e:
             import logging
 
-            logging.getLogger("weaviate_tpu.hnsw").warning(
-                "device beam disabled after failure: %s", e)
-            self.graph.dirty_hook = None
-            self._device_beam = None
+            if getattr(self, "_beam_proven", False):
+                # worked before: treat as transient (device busy, batch
+                # OOM) — fall back for THIS query only
+                logging.getLogger("weaviate_tpu.hnsw").warning(
+                    "device beam failed (transient, falling back): %s", e)
+            else:
+                # never lowered successfully on this backend: latch off
+                logging.getLogger("weaviate_tpu.hnsw").warning(
+                    "device beam disabled after failure: %s", e)
+                self.graph.dirty_hook = None
+                self._device_beam = None
             return None
         keep = self._keep_mask(None)
         ok = (ids >= 0) & keep[np.clip(ids, 0, len(keep) - 1)]

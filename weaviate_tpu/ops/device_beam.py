@@ -36,7 +36,7 @@ from weaviate_tpu.ops.distance import MASK_DISTANCE
 _INF = jnp.float32(MASK_DISTANCE)
 
 
-def _cand_dists(q, corpus, ids, metric, sqnorms, precision):
+def _cand_dists(q, corpus, ids, metric, precision):
     """[B, C] distances for candidate ids (-1 → MASK). Delegates to the
     shared ``gather_distance`` kernel (single source of per-metric
     semantics — the host frontier evaluation uses the same one)."""
@@ -59,7 +59,6 @@ def beam_search_layer0(
     ef: int,
     max_steps: int,
     metric: str = "l2-squared",
-    sqnorms: Optional[jnp.ndarray] = None,
     precision: str = "bf16",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """→ (ids [B, ef], dists [B, ef]) ascending; -1/MASK padded."""
@@ -68,7 +67,7 @@ def beam_search_layer0(
     rows = jnp.arange(b)
 
     d0 = _cand_dists(queries, corpus, eps[:, None].astype(jnp.int32),
-                     metric, sqnorms, precision)[:, 0]
+                     metric, precision)[:, 0]
     beam_ids = jnp.full((b, ef), -1, jnp.int32).at[:, 0].set(
         eps.astype(jnp.int32))
     beam_d = jnp.full((b, ef), _INF, jnp.float32).at[:, 0].set(d0)
@@ -98,8 +97,7 @@ def beam_search_layer0(
         nbrs = jnp.where(ok, nbrs, -1)
         visited = visited.at[rows[:, None], safe].max(
             ok.astype(jnp.uint8))
-        nd = _cand_dists(queries, corpus, nbrs, metric, sqnorms,
-                         precision)
+        nd = _cand_dists(queries, corpus, nbrs, metric, precision)
         all_ids = jnp.concatenate([beam_ids, nbrs], axis=1)
         all_d = jnp.concatenate([beam_d, nd], axis=1)
         all_exp = jnp.concatenate(
@@ -138,9 +136,6 @@ class DeviceAdjacency:
 
     def mark_dirty(self, *node_ids) -> None:
         self._dirty.update(int(x) for x in node_ids)
-
-    def mark_all_dirty(self) -> None:
-        self._synced_cap = 0
 
     def sync(self):
         """→ (adjacency, present) device arrays, up to date."""
