@@ -190,8 +190,13 @@ def bench_glove(n=1_200_000, d=25, batch=256, k=10, ef=64, iters=20, warmup=2):
     queries = corpus[:batch] + 0.08 * rng.standard_normal((batch, d)).astype(np.float32)
     queries /= np.linalg.norm(queries, axis=1, keepdims=True) + 1e-12
 
+    # device_beam: layer-0 walk fully on device (one dispatch per batch
+    # instead of one per hop — essential on a tunneled device where each
+    # host round-trip costs ~70ms); latched fallback keeps the bench
+    # alive if the kernel fails to lower on this backend
     cfg = HNSWIndexConfig(distance="cosine", ef=ef, ef_construction=96,
-                          max_connections=16, initial_capacity=n)
+                          max_connections=16, initial_capacity=n,
+                          device_beam=True)
     idx = HNSWIndex(d, cfg)
     ids = np.arange(n, dtype=np.int64)
     t0 = time.perf_counter()
