@@ -16,6 +16,10 @@ from typing import Any, Optional
 
 import numpy as np
 from werkzeug.exceptions import HTTPException
+
+from weaviate_tpu.core.collection import TenantNotActive
+from weaviate_tpu.monitoring.memwatch import MemoryPressure
+from weaviate_tpu.storage.store import ShardClosed
 from werkzeug.routing import Map, Rule
 from werkzeug.serving import make_server
 from werkzeug.wrappers import Request, Response
@@ -238,18 +242,16 @@ class RestAPI:
             response = _json_response(
                 {"error": [{"message": e.description}]},
                 e.code or 500)
-        except (KeyError, ValueError, TypeError) as e:
+        except (KeyError, ValueError, TypeError,
+                TenantNotActive, ShardClosed) as e:
+            # TenantNotActive / ShardClosed: inactive tenant or a read
+            # racing a freeze — client errors, retriable once activated
             response = _json_response(
                 {"error": [{"message": str(e)}]}, 422)
-        except Exception as e:
-            from weaviate_tpu.monitoring.memwatch import MemoryPressure
-
-            if isinstance(e, MemoryPressure):
-                # back-pressure, not failure: clients should retry later
-                response = _json_response(
-                    {"error": [{"message": str(e)}]}, 503)
-            else:
-                raise
+        except MemoryPressure as e:
+            # back-pressure, not failure: clients should retry later
+            response = _json_response(
+                {"error": [{"message": str(e)}]}, 503)
         return response(environ, start_response)
 
     def _write_action(self, obj: StorageObject) -> str:

@@ -29,6 +29,12 @@ TENANT_COLD = "COLD"
 TENANT_FROZEN = "FROZEN"
 
 
+class TenantNotActive(RuntimeError):
+    """Request addressed a COLD/FROZEN (or mid-transition) tenant — a
+    client error (HTTP 422 / gRPC FAILED_PRECONDITION), not a server
+    fault (reference tenant-activity validation)."""
+
+
 class Collection:
     def __init__(self, dirpath: str, config: CollectionConfig, sync_writes: bool = False,
                  modules=None, db=None):
@@ -126,6 +132,17 @@ class Collection:
                 ev.wait()
                 continue  # re-check: the builder published (or failed)
             try:
+                # re-validate AFTER claiming the build slot: the caller's
+                # status check was unlocked, and a freeze/remove that
+                # completed in between moved or deleted the directory —
+                # building now would resurrect an empty zombie shard
+                if name.startswith("tenant-"):
+                    tname = name[len("tenant-"):]
+                    with self._lock:
+                        status = self._tenant_status.get(tname)
+                    if status != TENANT_HOT:
+                        raise TenantNotActive(
+                            f"tenant {tname!r} is not active")
                 with self._LOAD_LIMITER:
                     s = Shard(
                         os.path.join(self.dir, name),
@@ -219,7 +236,8 @@ class Collection:
                     # onload from the offload tier before the shard opens
                     self.set_tenant_status(tenant, TENANT_HOT)
                 else:
-                    raise RuntimeError(f"tenant {tenant!r} is not active")
+                    raise TenantNotActive(
+                        f"tenant {tenant!r} is not active")
             return self._get_shard(f"tenant-{tenant}")
         return self._shard_for_uuid(uuid)
 
@@ -230,7 +248,7 @@ class Collection:
             if tenant not in self._tenant_status:
                 raise KeyError(f"tenant {tenant!r} not found")
             if self._tenant_status[tenant] != TENANT_HOT:
-                raise RuntimeError(f"tenant {tenant!r} is not active")
+                raise TenantNotActive(f"tenant {tenant!r} is not active")
             return [self._get_shard(f"tenant-{tenant}")]
         return [self._get_shard(f"shard{i}")
                 for i in range(max(1, self.config.sharding.desired_count))]
@@ -257,6 +275,11 @@ class Collection:
 
         self._wait_building(f"tenant-{name}")
         with self._lock:
+            if self._tenant_status.get(name) in ("FREEZING", "UNFREEZING"):
+                # a racing transfer would resurrect the tenant on its
+                # commit/rollback; the caller retries after it settles
+                raise ValueError(
+                    f"tenant {name!r} has a transfer in flight")
             self._tenant_status.pop(name, None)
             self._persist_tenant_status()
             s = self._shards.pop(f"tenant-{name}", None)
@@ -368,12 +391,19 @@ class Collection:
         return os.path.join(root, self.config.name)
 
     def set_tenant_status(self, name: str, status: str) -> None:
+        """Transition order matters against concurrent lazy opens: flip to
+        a TRANSIENT status first (under the lock) so new ``_get_shard``
+        builders fail their re-check, THEN drain any in-flight build, THEN
+        move files. Without the flip-first, a builder registered between
+        the drain and the move would reopen a directory mid-move and
+        publish a zombie shard."""
         if status not in (TENANT_HOT, TENANT_COLD, TENANT_FROZEN):
             raise ValueError(f"invalid tenant status {status!r}")
         import shutil
 
         from weaviate_tpu.backup.offload import get_offloader
 
+        shard_name = f"tenant-{name}"
         with self._lock:
             if name not in self._tenant_status:
                 raise KeyError(f"tenant {name!r} not found")
@@ -381,64 +411,78 @@ class Collection:
             if prev in ("FREEZING", "UNFREEZING"):
                 raise ValueError(
                     f"tenant {name!r} has a transfer in flight")
-            shard_dir = os.path.join(self.dir, f"tenant-{name}")
+            shard_dir = os.path.join(self.dir, shard_name)
             frozen_dir = os.path.join(self._offload_root(), name)
-            if status != TENANT_HOT:
-                s = self._shards.pop(f"tenant-{name}", None)
+            off = get_offloader()
+            freezing = (status == TENANT_FROZEN and prev != TENANT_FROZEN)
+            unfreezing = (prev == TENANT_FROZEN and status != TENANT_FROZEN)
+            if not freezing and not unfreezing:
+                # HOT<->COLD: no file movement, just open/close semantics.
+                # Flip FIRST so in-flight lazy builders fail their
+                # re-check, then drain + close outside the lock
+                self._tenant_status[name] = status
+                self._persist_tenant_status()
+                cold = status != TENANT_HOT
+            else:
+                cold = None
+                # block new lazy opens for the whole transition window
+                # (same lock hold as the validation: no interleave gap)
+                self._tenant_status[name] = (
+                    "FREEZING" if freezing else "UNFREEZING")
+        if cold is not None:
+            if cold:
+                self._wait_building(shard_name)
+                with self._lock:
+                    s = self._shards.pop(shard_name, None)
                 if s is not None:
                     s.close()
-            off = get_offloader()
-            freezing = (status == TENANT_FROZEN and prev != TENANT_FROZEN
-                        and os.path.exists(shard_dir))
-            unfreezing = (prev == TENANT_FROZEN and status != TENANT_FROZEN)
-            if freezing and off is not None:
-                # bucket transfers are slow (one PUT per file): mark
-                # FREEZING and release the lock so other tenants keep
-                # serving (reference FREEZING -> upload -> FROZEN)
-                self._tenant_status[name] = "FREEZING"
-            elif unfreezing and off is not None \
-                    and off.exists(self.config.name, name):
-                self._tenant_status[name] = "UNFREEZING"
-            else:
-                # filesystem tier: a rename, done under the lock
-                if freezing:
+            return
+        try:
+            # drain a build that won its slot before the flip, then close
+            # whatever is published
+            self._wait_building(shard_name)
+            with self._lock:
+                s = self._shards.pop(shard_name, None)
+            if s is not None:
+                s.close()
+            if freezing:
+                if os.path.exists(shard_dir):
+                    if off is not None:
+                        off.upload(self.config.name, name, shard_dir)
+                        # commit FROZEN while the local copy still exists:
+                        # crash before → HOT + intact local data; crash
+                        # after → orphan dir the unfreeze path clears.
+                        # Never deleted-local + HOT (a later re-freeze of
+                        # an empty shard would clobber the bucket copy).
+                        with self._lock:
+                            if name in self._tenant_status:
+                                self._tenant_status[name] = status
+                                self._persist_tenant_status()
+                        shutil.rmtree(shard_dir, ignore_errors=True)
+                        return
                     os.makedirs(os.path.dirname(frozen_dir), exist_ok=True)
                     if os.path.exists(frozen_dir):
                         shutil.rmtree(frozen_dir)
                     shutil.move(shard_dir, frozen_dir)
-                elif unfreezing and os.path.exists(frozen_dir):
+            else:  # unfreezing
+                if off is not None and off.exists(self.config.name, name):
+                    if os.path.exists(shard_dir):
+                        shutil.rmtree(shard_dir)
+                    off.download(self.config.name, name, shard_dir)
+                elif os.path.exists(frozen_dir):
                     if os.path.exists(shard_dir):
                         shutil.rmtree(shard_dir)
                     shutil.move(frozen_dir, shard_dir)
-                self._tenant_status[name] = status
-                self._persist_tenant_status()
-                return
-        # bucket transfer outside the lock
-        try:
-            if freezing:
-                off.upload(self.config.name, name, shard_dir)
-                # commit FROZEN while the local copy still exists: a crash
-                # before this line leaves status HOT + intact local data; a
-                # crash after it leaves an orphan dir the unfreeze path
-                # clears — never a deleted-local + HOT-status state whose
-                # re-freeze would overwrite the good bucket copy with an
-                # empty shard
-                with self._lock:
+            with self._lock:
+                if name in self._tenant_status:  # removed mid-transfer?
                     self._tenant_status[name] = status
                     self._persist_tenant_status()
-                shutil.rmtree(shard_dir, ignore_errors=True)
-                return
-            if os.path.exists(shard_dir):
-                shutil.rmtree(shard_dir)
-            off.download(self.config.name, name, shard_dir)
         except Exception:
             with self._lock:
-                self._tenant_status[name] = prev
-                self._persist_tenant_status()
+                if name in self._tenant_status:
+                    self._tenant_status[name] = prev
+                    self._persist_tenant_status()
             raise
-        with self._lock:
-            self._tenant_status[name] = status
-            self._persist_tenant_status()
 
     # -- vectorization (module write-path hook) ---------------------------
     def _vectorize_missing(self, objs: list[StorageObject]) -> None:

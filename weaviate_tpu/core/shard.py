@@ -97,6 +97,7 @@ class Shard:
         self._meta_path = os.path.join(dirpath, "meta.bin")
         self._inv_snap_path = os.path.join(dirpath, "inverted.snap")
         self._delta_path = os.path.join(dirpath, "delta.log")
+        self._sweep_tmp(dirpath)
         self._next_doc_id = 0
         self._seq = 0  # per-shard op sequence, checkpoints record it
         self._dims: dict[str, int] = {}
@@ -309,15 +310,39 @@ class Shard:
             WAL.delete(self._delta_path)
             self._delta = WAL(self._delta_path, sync=sync)
 
+    @staticmethod
+    def _atomic_write(path: str, blob: bytes) -> None:
+        """Unique tmp name per call: concurrent checkpoint/flush callers
+        with a SHARED tmp name race each other's os.replace (the loser
+        hits FileNotFoundError after the winner renamed the tmp away).
+        Crash-orphaned tmps are swept at shard open (_sweep_tmp)."""
+        import threading as _threading
+
+        tmp = f"{path}.tmp.{os.getpid()}.{_threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _sweep_tmp(dirpath: str) -> None:
+        """Remove crash-orphaned ``*.tmp.<pid>.<tid>`` litter so backups
+        and offload walks never carry it."""
+        import glob
+
+        for p in glob.glob(os.path.join(dirpath, "*.tmp.*")):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
     def _persist_counter(self) -> None:
-        with open(self._counter_path + ".tmp", "wb") as f:
-            f.write(msgpack.packb(self._next_doc_id))
-        os.replace(self._counter_path + ".tmp", self._counter_path)
+        self._atomic_write(self._counter_path,
+                           msgpack.packb(self._next_doc_id))
 
     def _persist_meta(self) -> None:
-        with open(self._meta_path + ".tmp", "wb") as f:
-            f.write(msgpack.packb({"dims": self._dims}, use_bin_type=True))
-        os.replace(self._meta_path + ".tmp", self._meta_path)
+        self._atomic_write(
+            self._meta_path,
+            msgpack.packb({"dims": self._dims}, use_bin_type=True))
 
     # -- vector index plumbing -------------------------------------------
     def _config_for(self, target: str) -> VectorIndexConfig:

@@ -121,23 +121,45 @@ class InvertedIndex:
         the backfill mismatch check O(n) on every query, forever."""
         c = self._range_counts.get(prop)
         if c is None:  # first use after snapshot load: one O(n) pass
-            c = sum(1 for v in self.values.get(prop, {}).values()
-                    if self._range_eligible(v))
+            vals = self.values.get(prop, {})
+            for _ in range(5):  # concurrent writers: retry torn iteration
+                try:
+                    c = sum(1 for v in list(vals.values())
+                            if self._range_eligible(v))
+                    break
+                except RuntimeError:
+                    continue
+            else:
+                return len(vals)  # give up this round; next query retries
             self._range_counts[prop] = c
         return c
 
-    def _range_backfill(self, prop: str, rb) -> None:
+    def _range_backfill(self, prop: str, rb) -> bool:
         """Docs written before the flag was enabled (or loaded from a
         snapshot that predates the bucket) backfill on first use, keyed
-        off a count mismatch — O(1) when in sync."""
+        off a count mismatch — O(1) when in sync. Returns False when the
+        bucket could NOT be brought in sync (torn iteration under heavy
+        writes): the caller must answer from the columnar path rather
+        than silently drop rows."""
         present = rb.bucket.roaring_get(rb._key(0))
         if len(present) >= self._range_count(prop):
-            return
+            return True
         vals = self.values.get(prop, {})
-        missing = [(d, v) for d, v in vals.items()
+        # concurrent writers mutate the values dict; retry the snapshot on
+        # a torn iteration (same torn-read stance as the graph reads)
+        for _ in range(5):
+            try:
+                items = list(vals.items())
+                break
+            except RuntimeError:
+                continue
+        else:
+            return False
+        missing = [(d, v) for d, v in items
                    if self._range_eligible(v) and d not in present]
         if missing:
             rb.put_many([d for d, _ in missing], [v for _, v in missing])
+        return True
 
     # -- schema helpers ---------------------------------------------------
     def _prop_schema(self, name: str):
@@ -415,9 +437,11 @@ class InvertedIndex:
                 and not isinstance(flt.value, bool)
                 and self._range_indexed(flt.path[-1])):
             rb = self._range_bucket(flt.path[-1])
-            self._range_backfill(flt.path[-1], rb)
-            bm = rb.query(_RANGE_OPS[op], flt.value)
-            return bm.mask(space) & self.columnar.live_mask(space)
+            if self._range_backfill(flt.path[-1], rb):
+                bm = rb.query(_RANGE_OPS[op], flt.value)
+                return bm.mask(space) & self.columnar.live_mask(space)
+            # bucket not provably complete this round: the columnar path
+            # below answers correctly (never silently drop rows)
 
         # leaf: vectorized columnar evaluation (reference searcher.go ->
         # AllowList; here numpy columns instead of roaring segments)

@@ -38,6 +38,12 @@ STRATEGIES = ("replace", "set", "map",
               "roaringset", "roaringsetrange", "inverted")
 
 
+class ShardClosed(RuntimeError):
+    """A read/write raced a shard shutdown (tenant freeze, drop): the
+    mmap'd segments are gone. Clean and retriable — the reference cancels
+    in-flight readers' contexts on shard shutdown the same way."""
+
+
 class Bucket:
     def __init__(self, dirpath: str, strategy: str = "replace", sync: bool = False,
                  memtable_max_entries: int = 100_000):
@@ -52,6 +58,7 @@ class Bucket:
         self._segments: list[Segment] = []
         self._seg_seq = 0
         self._paused = 0  # maintenance (flush/compact) pause counter
+        self._closed = False
         self._open(sync)
 
     def _open(self, sync: bool) -> None:
@@ -127,6 +134,12 @@ class Bucket:
     def get(self, key: bytes) -> Optional[bytes]:
         if self.strategy in ("roaringset", "roaringsetrange"):
             return self.roaring_get(key)
+        try:
+            return self._get_locked(key)
+        except ValueError as e:
+            self._guard_closed(e)
+
+    def _get_locked(self, key: bytes) -> Optional[bytes]:
         with self._lock:
             if self.strategy == "replace":
                 if key in self._mem:
@@ -218,11 +231,14 @@ class Bucket:
         if self.strategy not in ("roaringset", "roaringsetrange"):
             raise ValueError("roaring_get() requires a roaring strategy")
         with self._lock:
-            acc = Bitmap()
-            for seg in self._segments:
-                v = seg.get(key)
-                if v is not _MISSING and v is not None:
-                    acc = _as_layer(v).apply_over(acc)
+            try:
+                acc = Bitmap()
+                for seg in self._segments:
+                    v = seg.get(key)
+                    if v is not _MISSING and v is not None:
+                        acc = _as_layer(v).apply_over(acc)
+            except ValueError as e:
+                self._guard_closed(e)
             mem = self._mem.get(key)
             if isinstance(mem, BitmapLayer):
                 acc = mem.apply_over(acc)
@@ -272,7 +288,11 @@ class Bucket:
         with self._lock:
             streams = [seg.items() for seg in self._segments]
             streams.append(iter(sorted(self._mem.items())))
-        yield from merge_streams(streams, self.strategy, drop_tombstones=True)
+        try:
+            yield from merge_streams(streams, self.strategy,
+                                     drop_tombstones=True)
+        except ValueError as e:
+            self._guard_closed(e)
 
     def keys(self) -> Iterator[bytes]:
         """All live keys, merged across memtable + segments, in key order."""
@@ -349,10 +369,19 @@ class Bucket:
         self._wal.flush()
 
     def close(self) -> None:
+        self._closed = True
         self.flush_memtable()
         self._wal.close()
         for seg in self._segments:
             seg.close()
+
+    def _guard_closed(self, e: Exception):
+        """mmap access after close raises ValueError; surface the race as
+        ShardClosed instead of a confusing mmap error."""
+        if self._closed:
+            raise ShardClosed(
+                f"bucket {self.dir!r} closed mid-operation") from e
+        raise e
 
     def count(self) -> int:
         return len(self)
