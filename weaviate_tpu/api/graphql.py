@@ -275,7 +275,13 @@ class GraphQLExecutor:
             raise GraphQLError("Explore requires nearVector or nearObject")
         wanted = {f.name for f in root.selections} or {
             "beacon", "className", "distance", "certainty"}
-        merged: list[tuple[float, str, str]] = []
+        # raw distances only compare within ONE metric: an l2-squared
+        # value (unbounded) against a cosine value ([0,2]) is meaningless.
+        # Merge per-metric and rank the cosine group when present (the
+        # Explore convention — certainty is cosine-defined), else the
+        # single metric every explorable collection shares; mixed
+        # non-cosine metrics keep the majority group.
+        by_metric: dict[str, list[tuple[float, str, str, bool]]] = {}
         for name in self.db.collections():
             col = self.db.get_collection(name)
             if col.config.multi_tenancy.enabled:
@@ -284,9 +290,17 @@ class GraphQLExecutor:
                 rows = col.vector_search(vec, k=limit)
             except (ValueError, KeyError):
                 continue  # dims mismatch / no vector index: not explorable
-            cosine = col.config.vector_config.distance == "cosine"
+            metric = col.config.vector_config.distance
+            cosine = metric == "cosine"
             for obj, d in rows:
-                merged.append((float(d), name, obj.uuid, cosine))
+                by_metric.setdefault(metric, []).append(
+                    (float(d), name, obj.uuid, cosine))
+        if not by_metric:
+            merged = []
+        elif "cosine" in by_metric:
+            merged = by_metric["cosine"]
+        else:
+            merged = max(by_metric.values(), key=len)
         merged.sort(key=lambda t: t[0])
         out = []
         for d, cls, uuid, cosine in merged[:limit]:

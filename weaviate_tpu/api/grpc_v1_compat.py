@@ -120,8 +120,10 @@ def vector_from_near(nv: wv.NearVector) -> np.ndarray:
 def _struct_value(v) -> Any:
     kind = v.WhichOneof("kind")
     if kind == "number_value":
-        n = v.number_value
-        return int(n) if float(n).is_integer() else n
+        # stays float: 10.0 collapsing to int would make auto-schema
+        # infer INT for a number property (the reference infers number
+        # from Struct numbers) and corrupt later 10.5 writes
+        return v.number_value
     if kind == "string_value":
         return v.string_value
     if kind == "bool_value":
@@ -401,6 +403,26 @@ class WeaviateV1Service:
         return reply
 
     # -- BatchObjects ------------------------------------------------------
+    def _coerce_schema_ints(self, obj: StorageObject) -> None:
+        """protobuf Struct has no integer kind — clients send ints as
+        number_value. The reference resolves the type from the SCHEMA:
+        a number targeting an INT property coerces to int; unknown/new
+        props stay float (auto-schema infers number, like the reference)."""
+        if not self.db.has_collection(obj.collection):
+            return
+        cfg = self.db.get_collection(obj.collection).config
+        for name, val in list(obj.properties.items()):
+            p = cfg.property(name)
+            if p is None:
+                continue
+            dt = p.data_type.value
+            if dt == "int" and isinstance(val, float) and val.is_integer():
+                obj.properties[name] = int(val)
+            elif dt == "int[]" and isinstance(val, list):
+                obj.properties[name] = [
+                    int(x) if isinstance(x, float) and x.is_integer()
+                    else x for x in val]
+
     def _insert(self, objects) -> list[tuple[int, str]]:
         """Insert BatchObjects; returns (index, error) pairs."""
         from weaviate_tpu.api.grpc_server import insert_grouped
@@ -409,7 +431,9 @@ class WeaviateV1Service:
         decoded: list[tuple[int, StorageObject]] = []
         for i, bo in enumerate(objects):
             try:
-                decoded.append((i, object_from_pb(bo)))
+                obj = object_from_pb(bo)
+                self._coerce_schema_ints(obj)
+                decoded.append((i, obj))
             except (ValueError, KeyError) as e:
                 errors.append((i, str(e)))
         errors.extend(insert_grouped(self.db, decoded))

@@ -205,8 +205,11 @@ class BackupHandler:
                         confine(tmp_dir, dst)
                     os.makedirs(os.path.dirname(dst), exist_ok=True)
                     backend.get_file(backup_id, rel, dst)
-                # all downloads succeeded: commit frozen tenants, then the
-                # hot dir (per-tenant dir moves are atomic)
+                # all downloads succeeded. Pre-validate that every frozen
+                # destination can be cleared BEFORE installing anything —
+                # a mid-loop failure after some tenants moved would leave
+                # a half-restored offload tier (no-partial-restores)
+                frozen_moves = []
                 if os.path.isdir(tmp_frozen):
                     dst_root = os.path.join(offload_base, cls)
                     os.makedirs(dst_root, exist_ok=True)
@@ -215,22 +218,28 @@ class BackupHandler:
                         shutil.rmtree(tdst, ignore_errors=True)
                         if os.path.exists(tdst):
                             # a surviving stale dir would make move() NEST
-                            # the restore inside it — fail loudly instead
+                            # the restore inside it — fail loudly, before
+                            # any tenant has been installed
                             raise BackupError(
                                 f"cannot clear stale frozen copy {tdst}")
-                        # shutil.move, not os.replace: the offload tier is
-                        # commonly a different mount (EXDEV)
-                        shutil.move(os.path.join(tmp_frozen, tname), tdst)
-                    shutil.rmtree(tmp_frozen, ignore_errors=True)
+                        frozen_moves.append((tname, tdst))
+                # commit the hot dir first (atomic), then the frozen
+                # tenants (destinations proven clear above; shutil.move
+                # because the offload tier is commonly another mount)
                 os.replace(tmp_dir, target_dir)
+                for tname, tdst in frozen_moves:
+                    shutil.move(os.path.join(tmp_frozen, tname), tdst)
+                shutil.rmtree(tmp_frozen, ignore_errors=True)
                 cfg = CollectionConfig.from_dict(entry["config"])
                 col = self.db.create_collection(cfg)
                 for tname, tstatus in entry.get("tenants", {}).items():
                     col.add_tenant(tname, tstatus)
                 restored.append(cls)
-            except OSError as e:
+            except (OSError, BackupError) as e:
                 shutil.rmtree(tmp_dir, ignore_errors=True)
                 shutil.rmtree(tmp_frozen, ignore_errors=True)
+                if isinstance(e, BackupError):
+                    raise
                 raise BackupError(f"restore {cls!r} failed: {e}") from e
         return {"id": backup_id, "status": STATUS_SUCCESS,
                 "classes": restored}

@@ -283,14 +283,17 @@ class Collection:
             self._tenant_status.pop(name, None)
             self._persist_tenant_status()
             s = self._shards.pop(f"tenant-{name}", None)
-            if s is not None:
-                s.close()
-            # data retention: BOTH tiers go — a lingering frozen copy could
-            # resurrect deleted data under a recreated tenant name
-            shutil.rmtree(os.path.join(self.dir, f"tenant-{name}"),
-                          ignore_errors=True)
-            shutil.rmtree(os.path.join(self._offload_root(), name),
-                          ignore_errors=True)
+        if s is not None:
+            # close OUTSIDE the lock: flush+checkpoint can take seconds
+            # and must not stall every other tenant's _get_shard
+            s.close()
+        # data retention: BOTH tiers go — a lingering frozen copy could
+        # resurrect deleted data under a recreated tenant name (and an
+        # unopened tenant's directories must be removed too)
+        shutil.rmtree(os.path.join(self.dir, f"tenant-{name}"),
+                      ignore_errors=True)
+        shutil.rmtree(os.path.join(self._offload_root(), name),
+                      ignore_errors=True)
 
     def apply_config_update(self, new_cfg: CollectionConfig) -> None:
         """Swap in a live-mutable config (reference

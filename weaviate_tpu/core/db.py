@@ -39,6 +39,7 @@ class DB:
         self.cycles.register("object_ttl", self._ttl_cycle, 60.0)
         self.cycles.register("metrics_refresh", self._metrics_cycle, 30.0)
         self.cycles.register("compaction", self._compaction_cycle, 60.0)
+        self.cycles.register("checkpoint", self._checkpoint_cycle, 120.0)
         # usage reports to a bucket when USAGE_{S3,GCS}_BUCKET configured
         # (reference modules/usage-* default interval 1h)
         from weaviate_tpu.backup.offload import get_usage_reporter
@@ -56,6 +57,19 @@ class DB:
     def _compaction_cycle(self) -> None:
         for c in list(self._collections.values()):
             c.compact_once()
+
+    def _checkpoint_cycle(self) -> None:
+        """Bound crash-recovery replay: shards with a fat delta log
+        checkpoint in the background (open shards only — lazy tenants
+        checkpoint at close)."""
+        for c in list(self._collections.values()):
+            with c._lock:
+                shards = list(c._shards.values())
+            for s in shards:
+                try:
+                    s.maybe_checkpoint()
+                except Exception:
+                    pass  # cycle must never die; next tick retries
 
     def _metrics_cycle(self) -> None:
         from weaviate_tpu.monitoring.metrics import (

@@ -335,6 +335,20 @@ class Shard:
             except OSError:
                 pass
 
+    def maybe_checkpoint(self, delta_threshold: int = 16 << 20) -> bool:
+        """Checkpoint when the delta log outgrows the threshold — keeps
+        crash recovery O(recent delta) on long-running servers (the
+        docstring contract of checkpoint(); without a periodic caller the
+        log would grow until close)."""
+        try:
+            size = os.path.getsize(self._delta_path)
+        except OSError:
+            size = 0
+        if size < delta_threshold:
+            return False
+        self.checkpoint()
+        return True
+
     def _persist_counter(self) -> None:
         self._atomic_write(self._counter_path,
                            msgpack.packb(self._next_doc_id))
@@ -610,6 +624,10 @@ class Shard:
         persists the new state. Returns objects reindexed."""
         with self._lock:
             fresh = InvertedIndex(self.config, self.store)
+            # collection-attached hooks must carry over: a fresh index
+            # without the ref_resolver would fail every reference filter
+            # until the shard reopens
+            fresh.ref_resolver = self.inverted.ref_resolver
             n = 0
             for _key, raw in self.objects.items():
                 obj = StorageObject.from_bytes(raw)

@@ -159,7 +159,7 @@ class RawBackend:
                 self.store, qrep, k, self.metric, allow=allow,
                 precision=self.config.precision,
                 chunk_size=self.config.search_chunk_size,
-                approx_recall=self.config.flat_approx_recall,
+                approx_recall=_resolved_approx_recall(self.config),
             )
             d = np.array(d)
             ids = np.asarray(ids, np.int64)
@@ -182,7 +182,7 @@ class RawBackend:
             allow_mask=allow_j,
             corpus_sqnorms=sqnorms if self.metric == "l2-squared" else None,
             precision=self.config.precision,
-            approx_recall=self.config.flat_approx_recall,
+            approx_recall=_resolved_approx_recall(self.config),
         )
         d = np.array(d)
         ids = np.asarray(ids, np.int64)
@@ -194,6 +194,19 @@ class RawBackend:
     ) -> tuple[np.ndarray, np.ndarray]:
         """Raw distances are already exact — just truncate."""
         return cand_ids[:, :k], cand_d[:, :k]
+
+
+def _resolved_approx_recall(config) -> float:
+    """Same UNSET(-1) resolution FlatIndex.search applies: follow the
+    hot-reloadable fleet default; 0.0 stays PINNED exact."""
+    r = config.flat_approx_recall
+    if r < 0.0:
+        from weaviate_tpu.utils.runtime_config import (
+            FLAT_APPROX_RECALL_DEFAULT,
+        )
+
+        return FLAT_APPROX_RECALL_DEFAULT.get()
+    return r
 
 
 class QueryRep(NamedTuple):
