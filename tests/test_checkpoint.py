@@ -248,3 +248,37 @@ def test_update_across_checkpoint_boundary(tmpdir):
     got = s3.get_by_uuid(objs[4].uuid)
     assert got.properties["rank"] == 999
     s3.close()
+
+
+def test_maybe_checkpoint_triggers_on_fat_delta(tmp_path):
+    """The background checkpoint cycle bounds crash-recovery replay: a
+    delta log over the threshold checkpoints and truncates."""
+    import os
+
+    import numpy as np
+
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        FlatIndexConfig,
+        Property,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    db = DB(str(tmp_path))
+    db.create_collection(CollectionConfig(
+        name="CkC", properties=[Property(name="t")],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32")))
+    col = db.get_collection("CkC")
+    col.put_batch([StorageObject(
+        uuid=f"a7000000-0000-0000-0000-{i:012d}", collection="CkC",
+        properties={"t": f"d{i}"},
+        vector=np.ones(8, np.float32)) for i in range(50)])
+    shard = next(iter(col._shards.values()))
+    assert not shard.maybe_checkpoint(delta_threshold=1 << 30)  # tiny log
+    assert shard.maybe_checkpoint(delta_threshold=1)  # forced
+    assert os.path.getsize(shard._delta_path) == 0  # truncated
+    # db-level cycle path runs without error
+    db._checkpoint_cycle()
+    db.close()

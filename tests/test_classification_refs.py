@@ -298,3 +298,31 @@ def test_batch_and_object_references_endpoints(db):
         out = json.loads(r.read())
     assert out[0]["result"]["status"] == "FAILED"
     api.shutdown()
+
+
+def test_ref_filters_survive_reindex(db):
+    """Reindexing swaps in a fresh inverted index; the collection-attached
+    ref-resolver must carry over or every ref-filtered query 422s."""
+    from weaviate_tpu.inverted.filters import Filter
+
+    _mk(db, "RCat", [Property(name="name", data_type=DataType.TEXT)], [
+        StorageObject(uuid="a5000000-0000-0000-0000-000000000001",
+                      collection="RCat", properties={"name": "tools"},
+                      vector=np.ones(4, np.float32))])
+    _mk(db, "RItem", [
+        Property(name="title", data_type=DataType.TEXT),
+        Property(name="inCat", data_type=DataType.REFERENCE,
+                 target_collection="RCat"),
+    ], [StorageObject(
+        uuid="a6000000-0000-0000-0000-000000000001", collection="RItem",
+        properties={"title": "hammer", "inCat": [{
+            "beacon": "weaviate://localhost/RCat/"
+                      "a5000000-0000-0000-0000-000000000001"}]},
+        vector=np.ones(4, np.float32))])
+    col = db.get_collection("RItem")
+    flt = Filter(operator="Equal", path=["inCat", "RCat", "name"],
+                 value="tools")
+    assert col.filter_search(flt, limit=5)
+    assert col.reindex_inverted() == 1
+    rows = col.filter_search(flt, limit=5)  # must not raise, must match
+    assert rows and rows[0].properties["title"] == "hammer"
