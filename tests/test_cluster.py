@@ -589,6 +589,10 @@ def test_distributed_task_lease_reaps_dead_node(cluster3):
         n.tasks.stop()  # manual control
         n.tasks.register("noop", lambda p: {"ok": True})
     tid = leader.tasks.submit("noop", {}, lease_s=1.0)
+    # replication lag: followers' FSMs see the task slightly after the
+    # leader's apply — wait before the manual claim pass
+    wait_for(lambda: all(tid in n.task_fsm.tasks for n in nodes),
+             msg="task replication")
     # only two of three nodes run the task; "n2" plays dead
     for n in nodes:
         if n.id != "n2":

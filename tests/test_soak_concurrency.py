@@ -199,3 +199,116 @@ def test_soak_tenant_lifecycle(tmp_path):
                                  tenant=name)
         assert hits and hits[0][0].properties["t"] == f"d{i}"
     db.close()
+
+
+@pytest.mark.timeout(240)
+def test_soak_cluster_churn(tmp_path):
+    """Replicated writes + reads + distributed tasks while the raft leader
+    is repeatedly killed and revived: no errors besides clean consistency
+    rejections, and the cluster converges afterwards."""
+    from weaviate_tpu.cluster.node import ClusterNode, ReplicationError
+    from weaviate_tpu.cluster.transport import InProcTransport
+    from weaviate_tpu.schema.config import (
+        CollectionConfig as CC,
+        FlatIndexConfig as FIC,
+        Property as P,
+        ReplicationConfig,
+        ShardingConfig,
+    )
+
+    registry: dict = {}
+    ids = ["n0", "n1", "n2"]
+    nodes = [ClusterNode(n, ids, InProcTransport(registry, n),
+                         str(tmp_path / n)) for n in ids]
+
+    def wait(pred, timeout=10.0, msg=""):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"timeout: {msg}")
+
+    wait(lambda: any(n.raft.is_leader() for n in nodes), msg="election")
+    leader = next(n for n in nodes if n.raft.is_leader())
+    leader.create_collection(CC(
+        name="CS", properties=[P(name="t")],
+        vector_config=FIC(distance="l2-squared", precision="fp32"),
+        sharding=ShardingConfig(desired_count=2),
+        replication=ReplicationConfig(factor=3)))
+    wait(lambda: all(n.db.has_collection("CS") for n in nodes),
+         msg="schema replication")
+
+    stop = threading.Event()
+    errors: list[str] = []
+    written: list[str] = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            u = f"70000000-0000-0000-0000-{i:012d}"
+            v = np.zeros(8, np.float32)
+            v[i % 8] = 1.0
+            node = nodes[i % 3]
+            try:
+                node.put_batch("CS", [StorageObject(
+                    uuid=u, collection="CS",
+                    properties={"t": f"doc {i}"}, vector=v)],
+                    consistency="QUORUM")
+                written.append(u)
+            except (ReplicationError, RuntimeError, ConnectionError):
+                pass  # partition/kill window: clean rejection
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"writer: {type(e).__name__}: {e}")
+                return
+            i += 1
+
+    def reader():
+        i = 0
+        while not stop.is_set():
+            if written:
+                u = written[i % len(written)]
+                node = nodes[(i + 1) % 3]
+                try:
+                    node.get("CS", u, consistency="ONE")
+                except (ReplicationError, RuntimeError, KeyError,
+                        ConnectionError):
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"reader: {type(e).__name__}: {e}")
+                    return
+            i += 1
+            time.sleep(0.005)
+
+    def chaos():
+        while not stop.is_set():
+            time.sleep(1.5)
+            leader = next((n for n in nodes if n.raft.is_leader()), None)
+            if leader is None:
+                continue
+            # "kill": stop raft + drop from transport registry
+            leader.raft.stop()
+            registry.pop(leader.id, None)
+            time.sleep(1.0)
+            # revive
+            registry[leader.id] = leader.transport
+            leader.raft.start()
+
+    threads = [threading.Thread(target=t, daemon=True)
+               for t in (writer, writer, reader, chaos)]
+    for t in threads:
+        t.start()
+    time.sleep(10.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "cluster soak thread wedged"
+    assert not errors, errors[:5]
+    assert written, "no write ever succeeded"
+    # convergence: a QUORUM read of the last written object succeeds
+    wait(lambda: any(n.raft.is_leader() for n in nodes), msg="re-election")
+    u = written[-1]
+    obj = nodes[0].get("CS", u, consistency="QUORUM")
+    assert obj is not None and obj.uuid == u
+    for n in nodes:
+        n.close()

@@ -306,6 +306,28 @@ class RaftNode:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
+        """Start (or RESTART after stop()): python threads are one-shot,
+        so a revived node needs a fresh ticker, a fresh WAL handle, its
+        transport handler RE-REGISTERED (stop() tore it down — without it
+        the node sends votes but can never receive one), and volatile
+        state reset to FOLLOWER like a process restart would."""
+        if self._ticker.ident is not None:  # previously started
+            if self._ticker.is_alive():
+                # old loop outlived stop()'s bounded join: starting a
+                # second ticker would double heartbeats/elections
+                raise RuntimeError("raft ticker still draining; retry")
+            self._stop.clear()
+            if self.data_dir and self._log_wal.closed:
+                from weaviate_tpu.storage.wal import WAL
+
+                self._log_wal = WAL(self._log_path())
+            with self._lock:
+                self.state = FOLLOWER
+                self.leader_id = None
+                self._last_heartbeat = time.monotonic()
+            self.transport.start(self._handle)
+            self._ticker = threading.Thread(
+                target=self._tick_loop, daemon=True)
         self._ticker.start()
 
     def stop(self):

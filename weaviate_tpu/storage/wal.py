@@ -40,6 +40,10 @@ class WAL:
         kill, keeps write-ordering against other files' fsyncs."""
         self._f.flush()
 
+    @property
+    def closed(self) -> bool:
+        return self._f.closed
+
     def close(self) -> None:
         if not self._f.closed:
             self._f.flush()
