@@ -37,6 +37,15 @@ from weaviate_tpu.schema.config import (
     RQConfig,
 )
 from weaviate_tpu.core.db import DB
+from weaviate_tpu.inverted.filters import Filter
+from weaviate_tpu.schema.config import (
+    HFreshIndexConfig,
+    InvertedIndexConfig,
+    MultiTenancyConfig,
+    ReplicationConfig,
+    ShardingConfig,
+)
+from weaviate_tpu.storage.objects import StorageObject
 
 __all__ = [
     "__version__",
@@ -53,4 +62,11 @@ __all__ = [
     "SQConfig",
     "BQConfig",
     "RQConfig",
+    "HFreshIndexConfig",
+    "InvertedIndexConfig",
+    "MultiTenancyConfig",
+    "ReplicationConfig",
+    "ShardingConfig",
+    "StorageObject",
+    "Filter",
 ]
