@@ -66,6 +66,24 @@ def test_dispatcher_coalesces_and_splits_correctly():
     assert sum(calls) == 48
 
 
+def test_uncontended_search_pays_no_poll_tick():
+    """A lone query must drain itself immediately — not wait out the 20ms
+    poll tick before attempting leadership (VERDICT r2 weak #5)."""
+    def run_batch(q, k, allow):
+        return (np.zeros((q.shape[0], k), np.int64),
+                np.zeros((q.shape[0], k), np.float32))
+
+    disp = CoalescingDispatcher(run_batch)
+    disp.search(np.zeros((1, 4), np.float32), 3)  # warm any lazy state
+    lats = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        disp.search(np.zeros((1, 4), np.float32), 3)
+        lats.append(time.perf_counter() - t0)
+    p50 = float(np.percentile(lats, 50))
+    assert p50 < 0.005, f"uncontended p50 {p50*1e3:.2f}ms — poll tick leaked in"
+
+
 def test_dispatcher_propagates_errors():
     def run_batch(q, k, allow):
         raise RuntimeError("boom")

@@ -61,7 +61,9 @@ class CoalescingDispatcher:
         # (plus the group in flight), then yields. Remaining waiters
         # self-promote within one poll tick, so no request waits on an
         # exited leader and a crashed leader can't wedge the queue.
-        while not req.event.wait(timeout=0.02):
+        # Leadership is attempted BEFORE the first wait so an uncontended
+        # query pays zero poll-tick latency — it drains itself immediately.
+        while True:
             with self._lock:
                 lead = not self._draining and bool(self._pending)
                 if lead:
@@ -72,6 +74,8 @@ class CoalescingDispatcher:
                 finally:
                     with self._lock:
                         self._draining = False
+            if req.event.wait(timeout=0.02):
+                break
         if req.error is not None:
             raise req.error
         return req.ids, req.dists
