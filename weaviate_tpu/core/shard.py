@@ -19,6 +19,7 @@ import numpy as np
 
 from weaviate_tpu.index.base import SearchResult, VectorIndex
 from weaviate_tpu.inverted.index import InvertedIndex
+from weaviate_tpu.inverted.segmented import make_inverted_index
 from weaviate_tpu.schema.config import (
     CollectionConfig,
     DynamicIndexConfig,
@@ -90,7 +91,7 @@ class Shard:
         self.store = Store(os.path.join(dirpath, "lsm"), sync=sync_writes)
         self.objects = self.store.bucket("objects")  # docid(8B BE) -> storobj
         self.ids = self.store.bucket("ids")  # uuid bytes -> docid(8B)
-        self.inverted = InvertedIndex(config, self.store)
+        self.inverted = make_inverted_index(config, self.store)
         self._lock = threading.RLock()
         self._vector_indexes: dict[str, VectorIndex] = {}
         self._counter_path = os.path.join(dirpath, "counter.bin")
@@ -447,9 +448,10 @@ class Shard:
             self._delta.flush_soft()  # never let objects get durable first
 
             batches: dict[str, tuple[list[int], list[np.ndarray]]] = {}
-            # range-index puts accumulate across the batch: one put_many
-            # per property instead of 65 bucket ops per object
-            with self.inverted.batched_range_writes():
+            # bucket writes accumulate across the batch: one put_many /
+            # roaring_add / postings_put per (prop, key) instead of per
+            # object (segmented mode batches everything; RAM mode ranges)
+            with self.inverted.batched_writes():
                 for obj in final.values():
                     self._mark_live(obj.doc_id)
                     self.ids.put(obj.uuid.encode(),
@@ -618,12 +620,26 @@ class Shard:
         """Rebuild the inverted index (+filter columns) from stored objects.
 
         Reference ``adapters/repos/db/inverted_reindexer.go``: run after a
-        tokenization/schema change that invalidates existing postings. The
-        rebuilt index replaces the live one atomically (searches during the
-        rebuild keep using the old postings), and the next checkpoint
+        tokenization/schema change that invalidates existing postings. RAM
+        mode swaps the rebuilt index in atomically (searches during the
+        rebuild keep using the old postings); segmented mode must truncate
+        the shared buckets first, so racing queries get a retriable
+        ShardClosed for the rebuild window instead. The next checkpoint
         persists the new state. Returns objects reindexed."""
         with self._lock:
-            fresh = InvertedIndex(self.config, self.store)
+            if getattr(self.inverted, "segmented", False):
+                # segmented state lives in shared buckets: mark the live
+                # index superseded (queries racing the rebuild raise a
+                # retriable ShardClosed rather than reading recreated-empty
+                # buckets), then truncate so stale-tokenization rows can't
+                # survive (map merges would resurrect them). The RAM path's
+                # atomic swap does not apply to segmented mode.
+                self.inverted._closed = True
+                for name in os.listdir(self.store.dir):
+                    if name.startswith(("inv_", "post_", "range_")) \
+                            or name == "propvals":
+                        self.store.drop_bucket(name)
+            fresh = make_inverted_index(self.config, self.store)
             # collection-attached hooks must carry over: a fresh index
             # without the ref_resolver would fail every reference filter
             # until the shard reopens

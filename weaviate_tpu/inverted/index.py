@@ -101,6 +101,10 @@ class InvertedIndex:
             for prop, (ids, vals) in pending.items():
                 self._range_bucket(prop).put_many(ids, vals)
 
+    # general batched-write entry (segmented mode batches every bucket
+    # family; the RAM index only has range buckets to batch)
+    batched_writes = batched_range_writes
+
     _RANGE_TYPES = (DataType.INT, DataType.NUMBER)
 
     def _range_indexed(self, prop: str) -> bool:

@@ -1,0 +1,627 @@
+"""Segment-resident inverted index: filters + postings served from LSM buckets.
+
+Reference: ``adapters/repos/db/inverted/searcher.go`` answers filters by
+reading roaring bitmaps straight out of LSM segments (``lsmkv/roaringset/``,
+``roaringsetrange/``) and BM25 by streaming postings blocks from the
+``inverted`` strategy (``lsmkv/strategies.go:21-27``) — a shard's filterable
+state never has to fit in RAM. The RAM-columnar engine (``columnar.py``)
+remains the default for small shards; this class is the scale tier, selected
+with ``InvertedIndexConfig(storage="segment")``.
+
+What stays in RAM (all bounded or doc-bit-sized):
+- the live bitmap + watermark (1 bit/doc — 1.25 MB per 10M docs)
+- geo columns (geo props are rare and small; haversine wants raw coords)
+- per-prop aggregate length totals for avgdl (two ints per text prop)
+- bucket memtables (capped at ``memtable_max_entries`` each) and segment
+  sparse indexes/bloom filters (O(keys/SPARSE))
+
+Everything else lives in buckets under the shard's LSM store:
+- ``inv_<prop>``   (roaringset)      value-token -> doc bitmap, plus
+                                     presence/multi rows for IsNull/NotEqual
+- ``range_<prop>`` (roaringsetrange) bit-sliced index for scalar numerics
+- ``post_<prop>``  (inverted)        term -> (docid -> tf, doclen) postings
+- ``propvals``     (replace)         docid -> filterable values (the value
+                                     store for aggregations/ref-filters and
+                                     for docid-only crash-replay deletes)
+
+Query results are bit-for-bit identical to the RAM path (shared test matrix
+in ``tests/test_segmented_inverted.py`` asserts it).
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+import msgpack
+import numpy as np
+
+from weaviate_tpu.inverted.analyzer import term_frequencies, tokenize
+from weaviate_tpu.inverted.filters import Filter
+from weaviate_tpu.inverted.index import InvertedIndex
+from weaviate_tpu.schema.config import CollectionConfig, DataType
+from weaviate_tpu.storage.bitmaps import RangeBucket, RangeBitmap
+
+_DOCID = struct.Struct(">q")
+
+# key layout inside an inv_<prop> roaringset bucket: meta rows sort first
+# (\x00 prefix), then numeric tokens (order-preserving big-endian), then
+# text/bool tokens
+_K_PRESENT = b"\x00p"
+_K_MULTI = b"\x00m"
+_NUM_PREFIX = b"n"
+_TOK_PREFIX = b"t"
+
+_SCALAR_NUM = (DataType.INT, DataType.NUMBER)
+
+
+def _num_key(value: float) -> bytes:
+    """Order-preserving numeric token: big-endian of the float64 sign-fold
+    encoding, so byte order == numeric order for vocabulary range scans."""
+    return _NUM_PREFIX + struct.pack(">Q", RangeBitmap.encode(float(value)))
+
+
+def _num_from_key(key: bytes) -> int:
+    return struct.unpack(">Q", key[1:])[0]
+
+
+def _tok_key(value) -> Optional[bytes]:
+    if isinstance(value, bool):
+        return _TOK_PREFIX + (b"\x01" if value else b"\x00")
+    if isinstance(value, str):
+        return _TOK_PREFIX + value.encode("utf-8")
+    return None
+
+
+class _PropValuesView:
+    """Read-only mapping view of one property's values, backed by the
+    ``propvals`` bucket — dict-compatible surface for the aggregation and
+    ref-filter consumers (``collection.py``)."""
+
+    def __init__(self, inv: "SegmentedInvertedIndex", prop: str):
+        self._inv = inv
+        self._prop = prop
+
+    def get(self, doc_id: int, default=None):
+        rec = self._inv._propvals_get(doc_id)
+        if rec is None:
+            return default
+        return rec.get("v", {}).get(self._prop, default)
+
+    def __getitem__(self, doc_id: int):
+        v = self.get(doc_id)
+        if v is None:
+            raise KeyError(doc_id)
+        return v
+
+    def items(self) -> Iterator[tuple[int, Any]]:
+        prop = self._prop
+        for key, raw in self._inv.propvals.items():
+            if raw is None:
+                continue
+            rec = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+            v = rec.get("v", {}).get(prop)
+            if v is not None:
+                yield _DOCID.unpack(key)[0], v
+
+    def values(self) -> Iterator[Any]:
+        for _, v in self.items():
+            yield v
+
+    def keys(self) -> Iterator[int]:
+        for d, _ in self.items():
+            yield d
+
+    def __iter__(self):
+        return self.keys()
+
+    def __len__(self) -> int:
+        return sum(1 for _ in self.items())
+
+    def __bool__(self) -> bool:
+        for _ in self.items():
+            return True
+        return False
+
+
+class _ValuesFacade:
+    """prop -> _PropValuesView, mimicking the RAM index's ``values`` dict."""
+
+    def __init__(self, inv: "SegmentedInvertedIndex"):
+        self._inv = inv
+
+    def get(self, prop: str, default=None) -> _PropValuesView:
+        return _PropValuesView(self._inv, prop)
+
+    def __getitem__(self, prop: str) -> _PropValuesView:
+        return _PropValuesView(self._inv, prop)
+
+    def keys(self):
+        return [p.name for p in self._inv.config.properties
+                if self._inv._filterable(p.name)]
+
+
+class SegmentedInvertedIndex(InvertedIndex):
+    """LSM-bucket-resident drop-in for ``InvertedIndex`` (see module doc)."""
+
+    segmented = True
+
+    def __init__(self, config: CollectionConfig, store=None):
+        if store is None:
+            raise ValueError("segmented inverted index requires an LSM store")
+        super().__init__(config, store)
+        # the native BlockMax-WAND engine keeps postings in C++ RAM, which
+        # defeats segment residency — the dense streaming path serves here
+        self.native = None
+        self.values = _ValuesFacade(self)
+        self.propvals = store.bucket("propvals", "replace")
+        self._term_bk: dict[str, Any] = {}
+        self._post_bk: dict[str, Any] = {}
+        # avgdl state: totals + doc counts per searchable prop (persisted in
+        # the shard snapshot; reference prop-length tracker keeps the same
+        # aggregates, ``inverted/tracker/``)
+        self.lens_counts: dict[str, int] = defaultdict(int)
+        self._pending = None  # batch accumulators inside batched_writes()
+        # set by reindex before its buckets are dropped: queries racing the
+        # rebuild get a clean retriable ShardClosed instead of silently
+        # recreating empty buckets and returning wrong empty results
+        self._closed = False
+        # small LRU over propvals decodes: grouped aggregations hit the same
+        # doc once per property
+        self._pv_cache: dict[int, dict] = {}
+
+    # -- buckets -----------------------------------------------------------
+    def _terms(self, prop: str):
+        bk = self._term_bk.get(prop)
+        if bk is None:
+            bk = self._term_bk[prop] = self.store.bucket(
+                f"inv_{prop}", "roaringset")
+        return bk
+
+    def _posts(self, prop: str):
+        bk = self._post_bk.get(prop)
+        if bk is None:
+            bk = self._post_bk[prop] = self.store.bucket(
+                f"post_{prop}", "inverted")
+        return bk
+
+    def _range_indexed(self, prop: str) -> bool:
+        # always-on for scalar numerics in segmented mode (the RAM path
+        # gates on the per-prop index_range_filters flag)
+        p = self._prop_schema(prop)
+        return p is not None and p.data_type in _SCALAR_NUM
+
+    def _check_open(self) -> None:
+        if self._closed:
+            from weaviate_tpu.storage.store import ShardClosed
+
+            raise ShardClosed(
+                "segmented inverted index superseded by reindex; retry")
+
+    def _propvals_get(self, doc_id: int) -> Optional[dict]:
+        self._check_open()
+        rec = self._pv_cache.get(doc_id)
+        if rec is not None:
+            return rec
+        raw = self.propvals.get(_DOCID.pack(doc_id))
+        if raw is None:
+            return None
+        rec = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+        if len(self._pv_cache) >= 4096:
+            self._pv_cache.clear()
+        self._pv_cache[doc_id] = rec
+        return rec
+
+    # -- write path --------------------------------------------------------
+    @contextmanager
+    def batched_writes(self):
+        """Accumulate bucket mutations across a put_batch and flush them
+        grouped: one roaring_add per (prop, token), one postings_put per
+        (prop, term), one range put_many per prop — instead of per-object
+        WAL records."""
+        if self._pending is not None:  # re-entrant: outer flush wins
+            yield
+            return
+        self._pending = {
+            "present": defaultdict(list),   # prop -> [doc_id]
+            "multi": defaultdict(list),
+            "tok": defaultdict(lambda: defaultdict(list)),  # prop->key->[id]
+            "range": defaultdict(lambda: ([], [])),         # prop->(ids,vals)
+            "post": defaultdict(lambda: defaultdict(lambda: ([], [], []))),
+        }
+        try:
+            yield
+        finally:
+            pending, self._pending = self._pending, None
+            for prop, ids in pending["present"].items():
+                self._terms(prop).roaring_add(_K_PRESENT, ids)
+            for prop, ids in pending["multi"].items():
+                self._terms(prop).roaring_add(_K_MULTI, ids)
+            for prop, by_key in pending["tok"].items():
+                bk = self._terms(prop)
+                for key, ids in by_key.items():
+                    bk.roaring_add(key, ids)
+            for prop, (ids, vals) in pending["range"].items():
+                RangeBucket(self.store.bucket(
+                    f"range_{prop}", "roaringsetrange")).put_many(ids, vals)
+            for prop, by_term in pending["post"].items():
+                bk = self._posts(prop)
+                for term, (ids, tfs, dls) in by_term.items():
+                    bk.postings_put(term.encode("utf-8"), ids, tfs, dls)
+
+    # keep the base-class name working for callers that only batch ranges
+    batched_range_writes = batched_writes
+
+    def add_object(self, obj) -> None:
+        if self._pending is None:
+            with self.batched_writes():
+                self._add_object_pending(obj)
+        else:
+            self._add_object_pending(obj)
+
+    def _add_object_pending(self, obj) -> None:
+        doc_id = obj.doc_id
+        self.doc_count += 1
+        pend = self._pending
+        pv_vals: dict[str, Any] = {}
+        pv_lens: dict[str, int] = {}
+        geo_props: dict[str, Any] = {}
+        for prop, val in obj.properties.items():
+            if val is None:
+                continue
+            vals = val if isinstance(val, list) else [val]
+            if self._filterable(prop):
+                pv_vals[prop] = val
+                pend["present"][prop].append(doc_id)
+                if len(vals) > 1:
+                    pend["multi"][prop].append(doc_id)
+                ranged = self._range_indexed(prop) and len(vals) == 1
+                geos = []
+                for v in vals:
+                    tok = _tok_key(v)
+                    if tok is not None:
+                        pend["tok"][prop][tok].append(doc_id)
+                    elif isinstance(v, (int, float)):
+                        if ranged:
+                            ids, rvals = pend["range"][prop]
+                            ids.append(doc_id)
+                            rvals.append(float(v))
+                        else:
+                            pend["tok"][prop][_num_key(v)].append(doc_id)
+                    elif (isinstance(v, dict) and "latitude" in v
+                          and "longitude" in v):
+                        geos.append(v)
+                if geos:
+                    geo_props[prop] = geos if len(geos) > 1 else geos[0]
+            if isinstance(val, str) or (
+                isinstance(val, list) and val and isinstance(val[0], str)
+            ):
+                if self._searchable(prop) or self._prop_schema(prop) is None:
+                    texts = val if isinstance(val, list) else [val]
+                    scheme = self._tokenization(prop)
+                    total = 0
+                    combined: dict[str, int] = {}
+                    for t in texts:
+                        tf = term_frequencies(t, scheme, self.stopwords)
+                        total += sum(tf.values())
+                        for term, n in tf.items():
+                            combined[term] = combined.get(term, 0) + n
+                    for term, n in combined.items():
+                        ids, tfs, dls = pend["post"][prop][term]
+                        ids.append(doc_id)
+                        tfs.append(n)
+                        dls.append(total)
+                    pv_lens[prop] = total
+                    self.len_totals[prop] += total
+                    self.lens_counts[prop] += 1
+        # live bit + watermark + geo coords stay columnar (RAM)
+        self.columnar.add(doc_id, geo_props)
+        if pv_vals or pv_lens:
+            self.propvals.put(
+                _DOCID.pack(doc_id),
+                msgpack.packb({"v": pv_vals, "l": pv_lens},
+                              use_bin_type=True))
+        self._pv_cache.pop(doc_id, None)
+
+    def delete_object(self, obj) -> None:
+        self._delete_known(obj.doc_id, obj.properties)
+
+    def delete_docid(self, doc_id: int) -> None:
+        """Docid-only delete (crash replay): the ``propvals`` record stands
+        in for the lost object bytes, so filter/range rows clean up fully;
+        postings of searchable-but-unfilterable props stay as stale rows the
+        live mask screens (same stance as the RAM path)."""
+        rec = self._propvals_get(doc_id)
+        if rec is None:
+            self.doc_count = max(0, self.doc_count - 1)
+            self.columnar.delete(doc_id)
+            return
+        for prop, total in rec.get("l", {}).items():
+            self.len_totals[prop] -= total
+            self.lens_counts[prop] = max(0, self.lens_counts[prop] - 1)
+        self._delete_known(doc_id, rec.get("v", {}), adjust_lens=False)
+
+    def _delete_known(self, doc_id: int, properties: dict,
+                      adjust_lens: bool = True) -> None:
+        self.doc_count = max(0, self.doc_count - 1)
+        self.columnar.delete(doc_id)
+        ids = np.asarray([doc_id], np.uint64)
+        for prop, val in properties.items():
+            if val is None:
+                continue
+            vals = val if isinstance(val, list) else [val]
+            if self._filterable(prop):
+                bk = self._terms(prop)
+                bk.roaring_remove(_K_PRESENT, ids)
+                if len(vals) > 1:
+                    bk.roaring_remove(_K_MULTI, ids)
+                if self._range_indexed(prop) and len(vals) == 1 \
+                        and isinstance(vals[0], (int, float)) \
+                        and not isinstance(vals[0], bool):
+                    RangeBucket(self.store.bucket(
+                        f"range_{prop}", "roaringsetrange")
+                    ).delete_many([doc_id])
+                else:
+                    for v in vals:
+                        tok = _tok_key(v)
+                        if tok is None and isinstance(v, (int, float)):
+                            tok = _num_key(v)
+                        if tok is not None:
+                            bk.roaring_remove(tok, ids)
+            if isinstance(val, str) or (
+                isinstance(val, list) and val and isinstance(val[0], str)
+            ):
+                if self._searchable(prop) or self._prop_schema(prop) is None:
+                    texts = val if isinstance(val, list) else [val]
+                    scheme = self._tokenization(prop)
+                    total = 0
+                    terms = set()
+                    for t in texts:
+                        tf = term_frequencies(t, scheme, self.stopwords)
+                        total += sum(tf.values())
+                        terms.update(tf)
+                    bk = self._posts(prop)
+                    for term in terms:
+                        bk.postings_remove(term.encode("utf-8"), [doc_id])
+                    if adjust_lens:
+                        self.len_totals[prop] -= total
+                        self.lens_counts[prop] = max(
+                            0, self.lens_counts[prop] - 1)
+        self.propvals.delete(_DOCID.pack(doc_id))
+        self._pv_cache.pop(doc_id, None)
+
+    # -- BM25 --------------------------------------------------------------
+    def bm25_search(self, query: str, k: int,
+                    properties: Optional[list[str]] = None,
+                    allow_list: Optional[np.ndarray] = None,
+                    doc_space: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        """Dense BM25F accumulation over postings streamed per-term from the
+        ``inverted`` buckets — doc lengths ride in the posting payloads, so
+        nothing doc-aligned is gathered from RAM."""
+        self._check_open()
+        if properties is None or not properties:
+            properties = [p.name for p in self.config.properties
+                          if self._searchable(p.name)]
+        props: list[tuple[str, float]] = []
+        for p in properties:
+            if "^" in p:
+                name, boost = p.split("^", 1)
+                props.append((name, float(boost)))
+            else:
+                props.append((p, 1.0))
+
+        n_docs = max(1, self.doc_count)
+        space = max(doc_space, self.columnar._watermark, 1)
+        scores = np.zeros(space, np.float32)
+        touched = np.zeros(space, bool)
+
+        for prop, boost in props:
+            cnt = self.lens_counts.get(prop, 0)
+            avg_len = (self.len_totals[prop] / cnt) if cnt else 1.0
+            avg_len = max(avg_len, 1e-9)
+            bk = self._posts(prop)
+            terms = [t for t in tokenize(query, self._tokenization(prop))
+                     if t not in self.stopwords]
+            for term in set(terms):
+                ids, tfs_u, dls = bk.postings_get(term.encode("utf-8"))
+                if not len(ids):
+                    continue
+                sel = ids < space
+                ids, tfs_u, dls = ids[sel], tfs_u[sel], dls[sel]
+                if not len(ids):
+                    continue
+                df = len(ids)
+                idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+                tfs = tfs_u.astype(np.float32)
+                denom = tfs + self.k1 * (
+                    1 - self.b + self.b * dls.astype(np.float32) / avg_len)
+                scores[ids] += boost * (
+                    idf * tfs * (self.k1 + 1) / np.maximum(denom, 1e-9))
+                touched[ids] = True
+
+        touched &= self.columnar.live_mask(space)
+        if allow_list is not None:
+            al = np.asarray(allow_list, bool)
+            if al.shape[0] < space:
+                al = np.pad(al, (0, space - al.shape[0]))
+            touched &= al[:space]
+        cand = np.nonzero(touched)[0]
+        if len(cand) == 0:
+            return np.empty(0, np.int64), np.empty(0, np.float32)
+        order = np.argsort(-scores[cand], kind="stable")[:k]
+        sel = cand[order]
+        return sel.astype(np.int64), scores[sel]
+
+    # -- filters -----------------------------------------------------------
+    def _eval(self, flt: Filter, space: int) -> np.ndarray:
+        self._check_open()
+        op = flt.operator
+        if op == "And":
+            m = self._eval(flt.operands[0], space)
+            for o in flt.operands[1:]:
+                m = m & self._eval(o, space)
+            return m
+        if op == "Or":
+            m = self._eval(flt.operands[0], space)
+            for o in flt.operands[1:]:
+                m = m | self._eval(o, space)
+            return m
+        if op == "Not":
+            return ~self._eval(flt.operands[0], space)
+
+        if flt.path is not None and len(flt.path) >= 3:
+            head = self._prop_schema(flt.path[0])
+            if head is not None and (
+                    head.data_type == DataType.REFERENCE
+                    or head.target_collection):
+                if self.ref_resolver is None:
+                    raise ValueError(
+                        "reference filters need a collection-attached index")
+                return self.ref_resolver(self, flt, space)
+
+        mask = self._eval_leaf(op, flt.path[-1], flt.value, space)
+        if mask is None:
+            raise ValueError(f"unhandled operator {op!r}")
+        return mask
+
+    def _present_mask(self, prop: str, space: int) -> np.ndarray:
+        return (self._terms(prop).roaring_get(_K_PRESENT).mask(space)
+                & self.columnar.live_mask(space))
+
+    def _multi_mask(self, prop: str, space: int) -> np.ndarray:
+        return (self._terms(prop).roaring_get(_K_MULTI).mask(space)
+                & self.columnar.live_mask(space))
+
+    def _equal_mask(self, prop: str, fv: Any, space: int) -> np.ndarray:
+        live = self.columnar.live_mask(space)
+        if isinstance(fv, (int, float)) and not isinstance(fv, bool):
+            m = np.zeros(space, bool)
+            if self._range_indexed(prop):
+                m |= RangeBucket(self.store.bucket(
+                    f"range_{prop}", "roaringsetrange")
+                ).query("==", float(fv)).mask(space)
+            # multi-valued / schemaless numerics live as numeric tokens
+            m |= self._terms(prop).roaring_get(_num_key(fv)).mask(space)
+            return m & live
+        tok = _tok_key(fv)
+        if tok is None:
+            return np.zeros(space, bool)
+        return self._terms(prop).roaring_get(tok).mask(space) & live
+
+    def _num_range_mask(self, prop: str, op: str, fv: float,
+                        space: int) -> np.ndarray:
+        """Numeric ordering: bit-sliced query on the range bucket, plus a
+        vocabulary scan over numeric tokens (multi-valued/schemaless docs)."""
+        live = self.columnar.live_mask(space)
+        m = np.zeros(space, bool)
+        if self._range_indexed(prop):
+            m |= RangeBucket(self.store.bucket(
+                f"range_{prop}", "roaringsetrange")
+            ).query(op, float(fv)).mask(space)
+        bk = self._terms(prop)
+        enc_ref = RangeBitmap.encode(float(fv))
+        import operator as _op
+
+        cmp = {">": _op.gt, ">=": _op.ge, "<": _op.lt, "<=": _op.le}[op]
+        for key in bk.keys():
+            if not key.startswith(_NUM_PREFIX) or len(key) != 9:
+                continue
+            if cmp(_num_from_key(key), enc_ref):
+                m |= bk.roaring_get(key).mask(space)
+        return m & live
+
+    def _eval_leaf(self, op: str, prop: str, fv: Any,
+                   space: int) -> Optional[np.ndarray]:
+        live = self.columnar.live_mask(space)
+        if op == "IsNull":
+            has = self._present_mask(prop, space)
+            return (live & ~has) if fv else has
+        if op == "Equal":
+            return self._equal_mask(prop, fv, space)
+        if op == "NotEqual":
+            # same semantics as the columnar engine: present with a
+            # different value, or any multi-valued doc
+            return ((self._present_mask(prop, space)
+                     & ~self._equal_mask(prop, fv, space))
+                    | self._multi_mask(prop, space))
+        if op in ("GreaterThan", "GreaterThanEqual", "LessThan",
+                  "LessThanEqual"):
+            sym = {"GreaterThan": ">", "GreaterThanEqual": ">=",
+                   "LessThan": "<", "LessThanEqual": "<="}[op]
+            if isinstance(fv, (int, float)) and not isinstance(fv, bool):
+                return self._num_range_mask(prop, sym, float(fv), space)
+            # text/date ordering: scan the (sorted, streamed) vocabulary
+            m = np.zeros(space, bool)
+            bk = self._terms(prop)
+            import operator as _op
+
+            cmp = {">": _op.gt, ">=": _op.ge,
+                   "<": _op.lt, "<=": _op.le}[sym]
+            for key in bk.keys():
+                if not key.startswith(_TOK_PREFIX):
+                    continue
+                try:
+                    val = key[1:].decode("utf-8")
+                except UnicodeDecodeError:
+                    continue
+                if isinstance(fv, str) and cmp(val, fv):
+                    m |= bk.roaring_get(key).mask(space)
+            return m & live
+        if op == "Like":
+            from weaviate_tpu.inverted.filters import like_to_regex
+
+            rx = like_to_regex(str(fv))
+            m = np.zeros(space, bool)
+            bk = self._terms(prop)
+            for key in bk.keys():
+                if not key.startswith(_TOK_PREFIX):
+                    continue
+                try:
+                    val = key[1:].decode("utf-8")
+                except UnicodeDecodeError:
+                    continue
+                if rx.match(val) is not None:
+                    m |= bk.roaring_get(key).mask(space)
+            return m & live
+        if op == "ContainsAny":
+            wanted = fv if isinstance(fv, list) else [fv]
+            m = np.zeros(space, bool)
+            for w in wanted:
+                m |= self._equal_mask(prop, w, space)
+            return m
+        if op == "ContainsAll":
+            wanted = fv if isinstance(fv, list) else [fv]
+            if not wanted:
+                return np.zeros(space, bool)
+            m = self._equal_mask(prop, wanted[0], space)
+            for w in wanted[1:]:
+                m &= self._equal_mask(prop, w, space)
+            return m
+        if op == "WithinGeoRange":
+            # geo coords stay columnar (RAM): haversine needs raw values
+            return self.columnar.eval_leaf(op, prop, fv, space)
+        return None
+
+    # -- misc --------------------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "doc_count": self.doc_count,
+            "storage": "segment",
+            "searchable_props": sorted(
+                p.name for p in self.config.properties
+                if self._searchable(p.name)),
+            "filterable_props": sorted(
+                p.name for p in self.config.properties
+                if self._filterable(p.name)),
+        }
+
+
+def make_inverted_index(config: CollectionConfig, store=None):
+    """Factory: RAM-columnar vs segment-resident, per collection config."""
+    if getattr(config.inverted_config, "storage", "ram") == "segment" \
+            and store is not None:
+        return SegmentedInvertedIndex(config, store)
+    return InvertedIndex(config, store)

@@ -96,11 +96,17 @@ def _load_col(rec) -> "PropColumn":
 
 
 def save_snapshot(inv, path: str, seq: int) -> None:
-    """Write the whole inverted-index state atomically (tmp + rename)."""
+    """Write the whole inverted-index state atomically (tmp + rename).
+
+    Segmented indexes (``segmented.py``) keep postings/filters in LSM
+    buckets that persist themselves via WAL + segments, so their snapshot
+    is only the small RAM residue: counters, live bitmap, geo columns —
+    O(doc bits), not O(index)."""
     tmp = path + ".tmp"
     pack = msgpack.Packer(use_bin_type=True)
+    segmented = bool(getattr(inv, "segmented", False))
     with open(tmp, "wb") as f:
-        f.write(pack.pack({
+        hdr = {
             "k": "hdr",
             "version": 1,
             "seq": seq,
@@ -109,7 +115,22 @@ def save_snapshot(inv, path: str, seq: int) -> None:
             "live": np.packbits(inv.columnar._live._arr).tobytes(),
             "live_n": len(inv.columnar._live._arr),
             "watermark": inv.columnar._watermark,
-        }))
+        }
+        if segmented:
+            hdr["mode"] = "segmented"
+            hdr["lens_counts"] = dict(inv.lens_counts)
+        f.write(pack.pack(hdr))
+        if segmented:
+            for prop, col in inv.columnar.props.items():
+                rec = _col_state(col)
+                rec["k"] = "col"
+                rec["prop"] = prop
+                f.write(pack.pack(rec))
+            f.write(pack.pack({"k": "end"}))
+            f.flush()
+            os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return
         # Posting rows are filtered by the live bitmap at checkpoint time:
         # docid-only deletes (crash replay) leave stale rows that the live
         # mask screens at query time, but a snapshot must not feed them to
@@ -166,6 +187,13 @@ def load_snapshot(inv, path: str) -> Optional[int]:
             hdr = next(unpacker)
             if hdr.get("k") != "hdr" or hdr.get("version") != 1:
                 return None
+            # mode must match the index the config built: a mismatch (config
+            # flipped ram<->segment between boots) falls back to a full
+            # rebuild, which is correct either way (bucket re-adds are
+            # idempotent; stale bucket rows are screened by the live mask)
+            if (hdr.get("mode") == "segmented") != bool(
+                    getattr(inv, "segmented", False)):
+                return None
             seq = hdr["seq"]
             doc_count = hdr["doc_count"]
             len_totals = hdr["len_totals"]
@@ -207,6 +235,9 @@ def load_snapshot(inv, path: str) -> Optional[int]:
     inv.columnar._live = live
     inv.columnar._watermark = hdr["watermark"]
     inv.columnar.props = cols
+    if hdr.get("mode") == "segmented":
+        inv.lens_counts.update(hdr.get("lens_counts", {}))
+        return seq  # postings/values live in the LSM buckets
     for prop, terms in postings.items():
         inv.postings[prop].update(terms)
     inv.doc_lengths.update(doc_lengths)

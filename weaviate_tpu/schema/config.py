@@ -330,6 +330,10 @@ class InvertedIndexConfig:
     index_timestamps: bool = False
     index_null_state: bool = False
     index_property_length: bool = False
+    # "ram": columnar + dict postings, whole-index snapshots (fast, RAM-bound)
+    # "segment": filters/postings live in LSM buckets and stream from disk
+    # segments at query time (reference inverted/searcher.go architecture)
+    storage: str = "ram"
 
 
 @dataclass
