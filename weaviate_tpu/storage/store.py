@@ -59,6 +59,7 @@ class Bucket:
         self._seg_seq = 0
         self._paused = 0  # maintenance (flush/compact) pause counter
         self._closed = False
+        self.compaction_bytes_written = 0  # write-amplification diagnostic
         self._open(sync)
 
     def _open(self, sync: bool) -> None:
@@ -344,7 +345,9 @@ class Bucket:
         map-merge), dropping tombstones — reference
         ``segment_group_compaction.go``. Memory stays O(1) per record: the
         k-way merge reads each segment sequentially and the new segment is
-        written as the merge drains."""
+        written as the merge drains. This is the EXPLICIT full compaction;
+        the background cycle uses ``compact_tiered`` (pairwise, bounded
+        write amplification)."""
         with self._lock:
             if self._paused or len(self._segments) <= 1:
                 return
@@ -359,11 +362,57 @@ class Bucket:
                     drop_tombstones=True,
                 ),
             )
+            self.compaction_bytes_written += os.path.getsize(path)
             self._segments = [new_seg]
             for seg in old:
                 # unlink only: a concurrent items() iterator may still hold
                 # the mmap (Linux keeps the inode until the map drops)
                 os.remove(seg.path)
+
+    def compact_once(self) -> bool:
+        """ONE pairwise merge of the adjacent pair with the smallest
+        combined file size (reference ``segment_group_compaction.go``
+        pairwise/leveled compaction). O(pair bytes), never O(total): a
+        large cold segment is not rewritten to absorb a few fresh small
+        ones — small neighbors merge together until their tier grows
+        comparable. Tombstones drop only when the pair includes the OLDEST
+        segment (an older segment could otherwise still hold the key).
+        Returns True if a merge happened."""
+        with self._lock:
+            if self._paused or len(self._segments) <= 1:
+                return False
+            sizes = [os.path.getsize(s.path) for s in self._segments]
+            i = min(range(len(sizes) - 1),
+                    key=lambda j: sizes[j] + sizes[j + 1])
+            old = self._segments[i:i + 2]
+            # The merged segment adopts the OLDER filename — the only
+            # crash-safe choice: a crash between the replace and the remove
+            # leaves merged@old[0].path + old[1] on disk, and replaying
+            # old[1] OVER the merged file is idempotent for every strategy
+            # (newest-wins re-wins, unions re-union, roaring layers re-fold,
+            # and a tombstone dropped from the i==0 merge still exists in
+            # old[1]). Adopting the NEWER name instead would make a dropped
+            # tombstone resurrect old[0]'s value after a crash.
+            final_path = old[0].path
+            tmp = final_path + ".compacting"
+            new_seg = Segment.write(
+                tmp,
+                merge_streams([s.items() for s in old], self.strategy,
+                              drop_tombstones=(i == 0)),
+            )
+            os.replace(tmp, final_path)
+            new_seg.path = final_path
+            self.compaction_bytes_written += os.path.getsize(final_path)
+            self._segments[i:i + 2] = [new_seg]
+            os.remove(old[1].path)
+            return True
+
+    def compact_tiered(self, max_segments: int = 4) -> None:
+        """Pairwise-merge until at most ``max_segments`` remain (or
+        maintenance pauses). The background-cycle entry point."""
+        while len(self._segments) > max(1, max_segments):
+            if not self.compact_once():
+                return
 
     def flush(self) -> None:
         self._wal.flush()
@@ -437,6 +486,20 @@ class Store:
                 b.close()
             self._buckets = {}
 
+    def drop_bucket(self, name: str) -> None:
+        """Close and delete a bucket's files (reindex truncation path)."""
+        import shutil
+
+        with self._lock:
+            b = self._buckets.pop(name, None)
+            if b is not None:
+                b.close()
+            shutil.rmtree(os.path.join(self.dir, name), ignore_errors=True)
+
+    def bucket_names(self) -> list[str]:
+        with self._lock:
+            return list(self._buckets)
+
     def flush_all(self) -> None:
         with self._lock:
             for b in self._buckets.values():
@@ -456,10 +519,12 @@ class Store:
 
     def compact_all(self, min_segments: int = 4) -> None:
         """Background compaction entry (reference cyclemanager-driven
-        ``segment_group_compaction.go``): merge any bucket whose segment
-        stack is at least ``min_segments`` deep."""
+        ``segment_group_compaction.go``): size-tiered pairwise merges for
+        any bucket whose segment stack is at least ``min_segments`` deep —
+        each merge O(pair bytes), so a deep stack of fresh small segments
+        never forces a rewrite of the large cold ones."""
         with self._lock:
             buckets = list(self._buckets.values())
         for b in buckets:
             if len(b._segments) >= min_segments:
-                b.compact()
+                b.compact_tiered(min_segments - 1)
