@@ -25,6 +25,8 @@ class SchemaFSM:
         # replica-movement overrides: "cls/shard" -> explicit replica list
         # (reference cluster/replication/ shard-replica FSM state)
         self.shard_overrides: dict[str, list[str]] = {}
+        # "cls/shard" -> joiners still converging (write-only replicas)
+        self.shard_warming: dict[str, list[str]] = {}
         # distributed-task table (reference cluster/distributedtask FSM)
         self.tasks = TaskFSM()
 
@@ -76,6 +78,14 @@ class SchemaFSM:
                     # empty override = fall back to ring placement
                     self.shard_overrides.pop(key, None)
                 return {"ok": True}
+            if op == "set_shard_warming":
+                key = f"{cmd['class']}/{cmd['shard']}"
+                nodes = list(cmd["nodes"])
+                if nodes:
+                    self.shard_warming[key] = nodes
+                else:
+                    self.shard_warming.pop(key, None)
+                return {"ok": True}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except (KeyError, ValueError, RuntimeError) as e:
             return {"ok": False, "error": str(e)}
@@ -93,6 +103,7 @@ class SchemaFSM:
                 if self.db.get_collection(n).config.multi_tenancy.enabled
             },
             "shard_overrides": self.shard_overrides,
+            "shard_warming": self.shard_warming,
             "tasks": self.tasks.state(),
         }
         return msgpack.packb(state, use_bin_type=True)
@@ -111,4 +122,5 @@ class SchemaFSM:
             for tname, status in tenants.items():
                 col.add_tenant(tname, status)
         self.shard_overrides = dict(state.get("shard_overrides", {}))
+        self.shard_warming = dict(state.get("shard_warming", {}))
         self.tasks.load(state.get("tasks", {}))

@@ -25,6 +25,10 @@ class ShardingState:
     n_shards: int
     factor: int = 1
     overrides: dict[int, list[str]] = field(default_factory=dict)
+    # replicas that joined mid-move and are still converging: they RECEIVE
+    # writes but must not SERVE reads yet (a digest miss there would read
+    # as a deleted object). Raft-committed alongside the override.
+    warming: dict[int, list[str]] = field(default_factory=dict)
 
     def replicas(self, shard: int) -> list[str]:
         ov = self.overrides.get(shard)
@@ -36,6 +40,16 @@ class ShardingState:
         factor = min(self.factor, n)
         start = shard % n
         return [self.nodes[(start + r) % n] for r in range(factor)]
+
+    def read_replicas(self, shard: int) -> list[str]:
+        """Replicas eligible to serve reads: warming joiners excluded
+        (falling back to the full set if exclusion would empty it)."""
+        reps = self.replicas(shard)
+        warm = set(self.warming.get(shard, ()))
+        if not warm:
+            return reps
+        out = [r for r in reps if r not in warm]
+        return out or reps
 
     def shard_replicas_for_uuid(self, uuid: str) -> tuple[int, list[str]]:
         s = shard_for_uuid(uuid, self.n_shards)
