@@ -10,6 +10,9 @@ QPS @ recall@10 >= 0.95):
   (reference harness ``test/benchmark/benchmark_sift.go:43-60`` analogue).
 - ``pq``       1M x 1536-d HNSW+PQ (96 segments), batch 256 — DBpedia-style.
 - ``bq``       10M x 768-d binary-quantized flat + host rescore — LAION-style.
+- ``msmarco``  8.8M x 768-d hybrid BM25+vector, 16 tenants — MS-MARCO-style
+  (native BlockMax-WAND on CPU + SQ8 codes on TPU, relativeScoreFusion;
+  quality = recall@10 + nDCG@10 proxy vs the exact hybrid ranking).
 
 Select with ``--configs flat1m,glove,...`` (default: all). Every line carries
 QPS, measured recall@10, p50/p99 batch latency, and ``vs_baseline`` — the
@@ -391,11 +394,244 @@ def bench_bq(n=10_000_000, d=768, batch=256, k=10, iters=20, warmup=2):
     })
 
 
+def bench_msmarco(n=8_800_000, d=768, batch=256, k=10, iters=10, warmup=2,
+                  tenants=16, vocab=30_000, alpha=0.5):
+    """MS-MARCO-style hybrid: BM25 (native BlockMax-WAND, CPU) + SQ8 vector
+    (TPU) fused per query, 16 tenants (BASELINE.md row 5; reference harness
+    ``test/benchmark_bm25/main.go``). Text is synthetic-Zipf but the served
+    machinery is the real one: per-tenant WAND engines, HBM-resident SQ8
+    code planes with host rescore, relativeScoreFusion. Quality is scored
+    against the EXACT hybrid ranking (dense BM25 + fp32 vector, same
+    fusion): recall@10 + an nDCG@10 proxy with graded relevance."""
+    import concurrent.futures as cf
+
+    import jax
+    import jax.numpy as jnp
+
+    from weaviate_tpu.index.flat import make_flat
+    from weaviate_tpu.inverted.native_bm25 import try_native_bm25
+    from weaviate_tpu.ops.distance import flat_search
+    from weaviate_tpu.query.fusion import relative_score_fusion
+    from weaviate_tpu.schema.config import FlatIndexConfig, SQConfig
+
+    per = max(1024, n // tenants)
+    n = per * tenants
+    k1, b = 1.2, 0.75
+    rng = np.random.default_rng(21)
+
+    # ---- text tier: Zipf postings built at the array level ----------------
+    # df(rank) ~ 0.5/(1+rank)^0.9 of a tenant's docs -> ~15 indexed terms/doc
+    t0 = time.perf_counter()
+    doc_lens = [rng.integers(40, 90, per).astype(np.uint32)
+                for _ in range(tenants)]
+    avgdl = [float(dl.mean()) for dl in doc_lens]
+    ranks = np.arange(vocab)
+    df_target = np.maximum((0.5 * per / (1.0 + ranks) ** 0.9).astype(np.int64), 1)
+    postings: list[dict[int, tuple[np.ndarray, np.ndarray]]] = []
+    engines = []
+    dfs = np.zeros((tenants, vocab), np.int64)
+    for t in range(tenants):
+        eng = try_native_bm25(k1, b)
+        # one flat (term, doc) edge list per tenant, deduped vectorized
+        terms = np.repeat(ranks, df_target)
+        docs = rng.integers(0, per, len(terms)).astype(np.int64)
+        key = np.unique(terms.astype(np.int64) * per + docs)
+        terms = (key // per).astype(np.int64)
+        docs = (key % per).astype(np.int64)
+        tfs = rng.integers(1, 4, len(key)).astype(np.uint32)
+        bounds = np.searchsorted(terms, ranks)
+        bounds = np.append(bounds, len(terms))
+        tp: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        for r in range(vocab):
+            lo, hi = bounds[r], bounds[r + 1]
+            if lo == hi:
+                continue
+            ids_l, tf_l = docs[lo:hi], tfs[lo:hi]
+            tp[r] = (ids_l, tf_l)
+            dfs[t, r] = hi - lo
+            if eng is not None:
+                eng.add_term("body", f"t{r}", ids_l + t * per, tf_l,
+                             doc_lens[t][ids_l])
+        postings.append(tp)
+        engines.append(eng)
+    engine_kind = "wand" if engines[0] is not None else "dense"
+
+    # ---- vector tier: per-tenant SQ8 flat (codes in HBM, rescore on host) -
+    centers = np.random.default_rng(99).standard_normal((2048, d)).astype(np.float32)
+
+    def gen_block(t):
+        g = np.random.default_rng(1000 + t)
+        assign = g.integers(0, 2048, per)
+        blk = centers[assign] + 0.4 * g.standard_normal((per, d)).astype(np.float32)
+        blk /= np.linalg.norm(blk, axis=1, keepdims=True) + 1e-12
+        return blk
+
+    vidx = []
+    for t in range(tenants):
+        idx = make_flat(d, FlatIndexConfig(
+            distance="cosine", initial_capacity=per,
+            quantizer=SQConfig(rescore_limit=200)))
+        idx.add_batch(np.arange(per, dtype=np.int64), gen_block(t))
+        vidx.append(idx)
+    build_s = time.perf_counter() - t0
+
+    # ---- query pool + EXACT hybrid ground truth ---------------------------
+    npool = batch  # every pooled query is served each round (GT is O(pool))
+    rng_q = np.random.default_rng(5)
+    pool_terms = []
+    p_term = (dfs[0] + 1.0) ** 0.5
+    p_term /= p_term.sum()
+    for _ in range(npool):
+        nt = int(rng_q.integers(3, 7))
+        pool_terms.append(np.unique(rng_q.choice(vocab, nt, p=p_term)))
+    pool_tenant = np.arange(npool) % tenants
+
+    def q_weights(t, qt):
+        df = dfs[t][qt]
+        return np.log(1.0 + (per - df + 0.5) / (df + 0.5)).astype(np.float32)
+
+    def bm25_dense(t, qt):
+        scores = np.zeros(per, np.float32)
+        ws = q_weights(t, qt)
+        dl = doc_lens[t]
+        for r, w in zip(qt, ws):
+            ent = postings[t].get(int(r))
+            if ent is None:
+                continue
+            ids_l, tf = ent
+            tf = tf.astype(np.float32)
+            denom = tf + k1 * (1 - b + b * dl[ids_l] / avgdl[t])
+            scores[ids_l] += w * tf * (k1 + 1) / denom
+        return scores
+
+    pool_qvec = np.empty((npool, d), np.float32)
+    gt_top10: list = [None] * npool
+    kcand = 100
+    for t in range(tenants):
+        sel = np.nonzero(pool_tenant == t)[0]
+        blk = gen_block(t)
+        qv = blk[rng_q.integers(0, per, len(sel))] \
+            + 0.25 * rng_q.standard_normal((len(sel), d)).astype(np.float32)
+        qv /= np.linalg.norm(qv, axis=1, keepdims=True) + 1e-12
+        pool_qvec[sel] = qv
+        dd, ii = flat_search(jnp.asarray(qv), jnp.asarray(blk), k=kcand,
+                             metric="cosine", chunk_size=131072,
+                             precision="fp32")
+        dd = np.asarray(jax.block_until_ready(dd))
+        ii = np.asarray(ii)
+        for j, qi in enumerate(sel):
+            sc = bm25_dense(t, pool_terms[qi])
+            top = np.argpartition(-sc, min(kcand, per - 1))[:kcand]
+            top = top[np.argsort(-sc[top], kind="stable")]
+            bm_set = [(int(doc) + t * per, float(sc[doc]))
+                      for doc in top if sc[doc] > 0]
+            vec_set = [(int(ii[j, c]) + t * per, -float(dd[j, c]))
+                       for c in range(kcand)]
+            fused = relative_score_fusion([bm_set, vec_set],
+                                          [1 - alpha, alpha], k)
+            gt_top10[qi] = [doc for doc, _ in fused]
+        del blk
+
+    # ---- served path ------------------------------------------------------
+    def serve_window(start):
+        """One measured round: `batch` queries spread over the tenants,
+        each fused from WAND top-100 + SQ8 top-100."""
+        out = []
+
+        def tenant_task(t):
+            sel = [i for i in range(start, start + batch)
+                   if pool_tenant[i % npool] == t]
+            if not sel:
+                return []
+            qv = pool_qvec[[i % npool for i in sel]]
+            res = vidx[t].search(qv, kcand)
+            results = []
+            for j, i in enumerate(sel):
+                qi = i % npool
+                qt = pool_terms[qi]
+                ws = q_weights(t, qt)
+                if engines[t] is not None:
+                    terms = [("body", f"t{int(r)}", float(w), avgdl[t])
+                             for r, w in zip(qt, ws)]
+                    bids, bsc = engines[t].search(terms, kcand)
+                    bm_set = list(zip(bids.tolist(), bsc.tolist()))
+                else:
+                    sc = bm25_dense(t, qt)
+                    top = np.argpartition(-sc, min(kcand, per - 1))[:kcand]
+                    top = top[np.argsort(-sc[top], kind="stable")]
+                    bm_set = [(int(doc) + t * per, float(sc[doc]))
+                              for doc in top if sc[doc] > 0]
+                vec_set = [(int(res.ids[j, c]) + t * per,
+                            -float(res.dists[j, c]))
+                           for c in range(kcand) if res.ids[j, c] >= 0]
+                fused = relative_score_fusion([bm_set, vec_set],
+                                             [1 - alpha, alpha], k)
+                results.append((qi, [doc for doc, _ in fused]))
+            return results
+
+        with cf.ThreadPoolExecutor(max_workers=min(8, tenants)) as pool:
+            for part in pool.map(tenant_task, range(tenants)):
+                out.extend(part)
+        return out
+
+    ts, out = _timed(lambda: serve_window(0), lambda r: None, iters, warmup)
+    qps = batch / float(np.median(ts))
+
+    # quality vs exact hybrid
+    recalls, ndcgs = [], []
+    idcg = sum((k - i) / np.log2(i + 2) for i in range(k))
+    for qi, served in out:
+        gt = gt_top10[qi]
+        recalls.append(len(set(served) & set(gt)) / k)
+        dcg = sum((k - gt.index(docn)) / np.log2(p + 2)
+                  for p, docn in enumerate(served) if docn in gt)
+        ndcgs.append(dcg / idcg)
+    recall = float(np.mean(recalls))
+    ndcg = float(np.mean(ndcgs))
+
+    # CPU baseline: dense BM25 + numpy brute-force vector + fusion over
+    # tenant 0's pooled queries
+    blk = gen_block(0)
+    t0_qis = np.nonzero(pool_tenant == 0)[0][:8]
+    nq = len(t0_qis)
+    t0 = time.perf_counter()
+    for qi in t0_qis:
+        sc = bm25_dense(0, pool_terms[qi])
+        top = np.argpartition(-sc, kcand)[:kcand]
+        sims = pool_qvec[qi][None, :] @ blk.T
+        vt = np.argpartition(-sims[0], kcand)[:kcand]
+        relative_score_fusion(
+            [[(int(dn), float(sc[dn])) for dn in top],
+             [(int(dn), float(sims[0][dn])) for dn in vt]],
+            [1 - alpha, alpha], k)
+    cpu_qps = nq / (time.perf_counter() - t0)
+    del blk
+
+    _emit({
+        "metric": f"hybrid_msmarco_qps_{round(n / 1e6, 1)}M_{d}d_{tenants}t",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / cpu_qps, 2),
+        "recall_at_10": round(recall, 4),
+        "recall_ok": bool(recall >= 0.95),
+        "ndcg_at_10": round(ndcg, 4),
+        "p50_batch_ms": round(float(np.median(ts)) * 1000, 2),
+        "p99_batch_ms": round(float(np.percentile(ts, 99)) * 1000, 2),
+        "build_s": round(build_s, 1),
+        "cpu_baseline_qps": round(cpu_qps, 1),
+        "bm25_engine": engine_kind,
+        "alpha": alpha,
+        "quality_note": "recall/nDCG vs exact hybrid (dense BM25 + fp32 "
+                        "vector, same fusion)",
+    })
+
+
 CONFIGS = {
     "flat1m": bench_flat1m,
     "glove": bench_glove,
     "pq": bench_pq,
     "bq": bench_bq,
+    "msmarco": bench_msmarco,
 }
 
 
@@ -447,7 +683,7 @@ def _device_precheck(timeout_s: float = 180.0) -> bool:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="flat1m,glove,pq,bq")
+    ap.add_argument("--configs", default="flat1m,glove,pq,bq,msmarco")
     ap.add_argument("--skip-precheck", action="store_true",
                     help="skip the device-init probe (saves one backend "
                          "init on quick smoke runs)")
