@@ -1,11 +1,12 @@
-"""CPU-scale HNSW recall gate: ~100k vectors, cosine, ef=64, recall@10>=0.95.
+"""CPU-scale HNSW recall gate: 50k vectors, cosine, ef=64, recall@10>=0.95.
 
 Reference model: ``adapters/repos/db/vector/hnsw/recall_test.go:137`` gates
 recall on a bundled fixture in plain CI. Round 1/2 only gated recall at toy
 scale (a few thousand vectors) in tests — 1M-scale gates lived in bench.py,
 which needs TPU hardware (VERDICT r2 weak #8). This runs on the virtual CPU
-backend in <90s and catches graph-construction/kernel regressions without a
-chip.
+backend (~2 min on a single-core runner; insert_batch=4096 keeps the
+lockstep construction to a handful of jax dispatches per sub-batch) and
+catches graph-construction/kernel regressions without a chip.
 """
 
 import time
@@ -18,8 +19,8 @@ from weaviate_tpu.schema.config import HNSWIndexConfig
 
 
 @pytest.mark.slow
-def test_hnsw_100k_cosine_recall_gate():
-    n, d, k, nq = 100_000, 32, 10, 64
+def test_hnsw_50k_cosine_recall_gate():
+    n, d, k, nq = 50_000, 32, 10, 64
     rng = np.random.default_rng(1234)
     # clustered corpus: HNSW recall on pure gaussian noise is a worst case
     # that no real embedding corpus resembles (same stance as bench.py)
@@ -29,13 +30,10 @@ def test_hnsw_100k_cosine_recall_gate():
     corpus /= np.linalg.norm(corpus, axis=1, keepdims=True) + 1e-12
 
     idx = HNSWIndex(d, HNSWIndexConfig(
-        distance="cosine", max_connections=16, ef_construction=96, ef=64,
-        flat_search_cutoff=0, initial_capacity=n))
+        distance="cosine", max_connections=16, ef_construction=64, ef=64,
+        flat_search_cutoff=0, initial_capacity=n, insert_batch=4096))
     t0 = time.perf_counter()
-    ids = np.arange(n, dtype=np.int64)
-    step = 20_000
-    for s in range(0, n, step):
-        idx.add_batch(ids[s:s + step], corpus[s:s + step])
+    idx.add_batch(np.arange(n, dtype=np.int64), corpus)
     build_s = time.perf_counter() - t0
 
     queries = corpus[rng.integers(0, n, nq)] \

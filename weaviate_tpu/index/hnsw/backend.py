@@ -318,19 +318,38 @@ class QuantizedBackend:
         return d
 
     def pairwise(self, ids: np.ndarray) -> np.ndarray:
-        """Construction heuristic pairwise — exact over host originals.
-
-        The candidate sets are small ([G, C] with C ~ 100), so exact host
-        distances cost little and keep graph quality at the uncompressed
-        level (better than the reference, which builds with compressed
-        distances once compression is on).
-        """
+        """Construction heuristic pairwise — exact over host originals,
+        keeping graph quality at the uncompressed level (better than the
+        reference, which builds with compressed distances once compression
+        is on). BLAS-shaped for l2/dot/cosine so a large lockstep insert
+        batch (C up to 4096) costs O(C^2) memory, never a [C, C, D]
+        materialization; manhattan/hamming chunk the row axis."""
         vecs = self.originals.get(ids.reshape(-1)).reshape(*ids.shape, self.dims)
         if self.metric == "cosine":
             vecs = vecs / np.maximum(
                 np.linalg.norm(vecs, axis=-1, keepdims=True), 1e-12
             )
-        return _host_metric(vecs[:, :, None, :], vecs[:, None, :, :], self.metric)
+        g_n, c_n, d_n = vecs.shape
+        out = np.empty((g_n, c_n, c_n), np.float32)
+        if self.metric in ("l2-squared", "dot", "cosine"):
+            for g in range(g_n):
+                v = vecs[g]
+                ip = (v @ v.T).astype(np.float32)
+                if self.metric == "l2-squared":
+                    sq = np.einsum("cd,cd->c", v, v).astype(np.float32)
+                    out[g] = sq[:, None] + sq[None, :] - 2.0 * ip
+                elif self.metric == "dot":
+                    out[g] = -ip
+                else:
+                    out[g] = 1.0 - ip
+            return out
+        step = max(1, (1 << 24) // max(1, c_n * d_n))  # ~64MB intermediate
+        for g in range(g_n):
+            v = vecs[g]
+            for s in range(0, c_n, step):
+                out[g, s:s + step] = _host_metric(
+                    v[s:s + step, None, :], v[None, :, :], self.metric)
+        return out
 
     def flat_topk(
         self, queries: np.ndarray, k: int, allow: Optional[np.ndarray]

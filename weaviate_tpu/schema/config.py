@@ -267,10 +267,13 @@ class HNSWIndexConfig(VectorIndexConfig):
     # device-resident layer-0 beam walk (ops/device_beam.py): one dispatch
     # per search batch instead of one per hop; also WEAVIATE_TPU_DEVICE_BEAM
     device_beam: bool = False
-    # lockstep construction batch: larger = fewer device round-trips but
-    # more intra-batch blindness (~0.98 recall @64, ~0.93 @256 on random
-    # data); bulk loads that rebuild can afford 256+
-    insert_batch: int = 64
+    # lockstep construction batch: larger = fewer device round-trips (the
+    # dominant build cost on a tunneled TPU and on CPU backends). The
+    # intra-batch pairwise candidate matrix keeps same-batch nodes visible
+    # to each other, so recall holds as the batch grows (measured 20k/24d
+    # random: 0.981 @256, 0.982 @1024, 0.982 @4096 — build 5x faster at
+    # 4096 than 64); bulk loads can afford 4096
+    insert_batch: int = 1024
 
 
 @dataclass
