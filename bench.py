@@ -25,6 +25,7 @@ a like-for-like index comparison (VERDICT r1 weak #3).
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -307,7 +308,26 @@ def bench_pq(n=1_000_000, d=1536, batch=256, k=10, segments=96, iters=20, warmup
     })
 
 
-def bench_bq(n=10_000_000, d=768, batch=256, k=10, iters=20, warmup=2):
+def bench_bq(n=10_000_000, d=768, batch=256, k=10, iters=20, warmup=2,
+             raw_tier="ram", raw_path=None):
+    """LAION-style BQ flat. ``raw_tier`` selects the originals tier the
+    rescore stage gathers from: fp32 RAM (default), fp16 RAM, or a fp16
+    disk memmap — the beyond-RAM configuration ``bq50m`` uses (50M x 768
+    raw fp16 = 77 GB on disk; HBM holds only the 96-byte/row code planes,
+    reported as hbm_gb)."""
+    if raw_tier == "disk16" and raw_path is None:
+        # cwd, NOT tempdir: /tmp is commonly RAM-backed tmpfs, which would
+        # quietly turn the beyond-RAM tier back into a RAM tier (or OOM)
+        raw_path = os.path.abspath(f"bench_bq_{n}.raw16")
+    try:
+        _bench_bq_impl(n, d, batch, k, iters, warmup, raw_tier, raw_path)
+    finally:
+        # a mid-bench failure must not leak a multi-GB memmap
+        if raw_tier == "disk16" and raw_path and os.path.exists(raw_path):
+            os.remove(raw_path)
+
+
+def _bench_bq_impl(n, d, batch, k, iters, warmup, raw_tier, raw_path):
     import jax
     import jax.numpy as jnp
 
@@ -319,6 +339,8 @@ def bench_bq(n=10_000_000, d=768, batch=256, k=10, iters=20, warmup=2):
         distance="cosine",
         initial_capacity=n,
         quantizer=BQConfig(rescore_limit=32 * k),
+        raw_tier=raw_tier,
+        raw_path=raw_path,
     )
     idx = make_flat(d, cfg)
     step = 500_000
@@ -391,7 +413,20 @@ def bench_bq(n=10_000_000, d=768, batch=256, k=10, iters=20, warmup=2):
         "build_s": round(build_s, 1),
         "cpu_baseline_qps": round(cpu_qps, 1),
         "cpu_baseline_estimated": True,
+        "raw_tier": raw_tier,
+        "hbm_gb": round(idx.backend.codes.nbytes / 1e9, 2),
+        "host_raw_gb": round(idx.backend.originals.nbytes / 1e9, 2),
     })
+
+
+def bench_bq50m(batch=256, k=10, iters=10, warmup=1, **kw):
+    """Beyond-HBM/RAM tier: 50M x 768-d BQ codes in HBM (~4.9 GB), raw
+    fp16 originals paged from disk for rescore. Not in the default config
+    set — generation + upload dominate wall-clock; run explicitly with
+    ``--configs bq50m``."""
+    kw.setdefault("n", 50_000_000)
+    return bench_bq(batch=batch, k=k, iters=iters, warmup=warmup,
+                    raw_tier="disk16", **kw)
 
 
 def bench_msmarco(n=8_800_000, d=768, batch=256, k=10, iters=10, warmup=2,
@@ -631,6 +666,7 @@ CONFIGS = {
     "glove": bench_glove,
     "pq": bench_pq,
     "bq": bench_bq,
+    "bq50m": bench_bq50m,
     "msmarco": bench_msmarco,
 }
 

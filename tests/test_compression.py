@@ -279,3 +279,64 @@ def test_hnsw_quantized_cosine_rescore_distances(rng):
     np.testing.assert_array_equal(res.ids[:, 0], np.arange(4))
     # self-distance in cosine is ~0 regardless of query scale
     assert np.all(res.dists[:, 0] < 1e-2)
+
+
+# -- raw-vector residency tiers (VERDICT r3 #4: beyond-HBM corpus tier) ------
+
+
+@pytest.mark.parametrize("tier", ["ram16", "disk16"])
+def test_raw_tier_parity_with_ram(rng, tier, tmp_path):
+    """fp16 RAM / fp16 disk-memmap originals must serve the rescore tier
+    with the same results as fp32 RAM (codes in HBM are identical; only
+    the rescore gather touches the tier)."""
+    n, d, k, nq = 4000, 64, 10, 32
+    corpus = clustered(rng, n, d)
+    queries = corpus[rng.choice(n, nq, replace=False)] + 0.02 * \
+        rng.standard_normal((nq, d)).astype(np.float32)
+
+    base = make_flat(d, FlatIndexConfig(
+        distance="cosine", quantizer=BQConfig(rescore_limit=150)))
+    base.add_batch(np.arange(n), corpus)
+
+    cfg = FlatIndexConfig(
+        distance="cosine", quantizer=BQConfig(rescore_limit=150),
+        raw_tier=tier,
+        raw_path=str(tmp_path / "raw16.bin") if tier == "disk16" else None)
+    idx = make_flat(d, cfg)
+    # two put calls: the second forces memmap ensure_capacity growth
+    idx.add_batch(np.arange(n // 2), corpus[: n // 2])
+    idx.add_batch(np.arange(n // 2, n), corpus[n // 2:])
+
+    rb = base.search(queries, k)
+    rt = idx.search(queries, k)
+    agree = np.mean([
+        len(set(rb.ids[i].tolist()) & set(rt.ids[i].tolist())) / k
+        for i in range(nq)])
+    assert agree >= 0.95, f"{tier} diverged from ram tier: {agree}"
+    if tier == "disk16":
+        import os
+
+        assert os.path.exists(cfg.raw_path)
+        assert idx.backend.originals.nbytes >= n * d * 2
+    assert idx.backend.codes.nbytes > 0  # HBM footprint reportable
+
+
+def test_disk16_tier_via_shard_path(tmp_path):
+    """build_vector_index resolves a PER-INDEX raw16.bin under the index
+    dir without mutating the shared config (two shards of one collection
+    must never memmap the same file)."""
+    from weaviate_tpu.core.shard import build_vector_index
+
+    cfg = FlatIndexConfig(distance="l2-squared",
+                          quantizer=SQConfig(rescore_limit=40),
+                          raw_tier="disk16")
+    idx = build_vector_index(8, cfg, path=str(tmp_path / "vec"))
+    idx2 = build_vector_index(8, cfg, path=str(tmp_path / "vec2"))
+    assert cfg.raw_path is None  # shared config untouched
+    assert idx.backend.originals.path.endswith("vec/raw16.bin")
+    assert idx2.backend.originals.path.endswith("vec2/raw16.bin")
+    rng = np.random.default_rng(0)
+    corpus = rng.standard_normal((2000, 8)).astype(np.float32)
+    idx.add_batch(np.arange(2000), corpus)
+    res = idx.search(corpus[:4], 5)
+    assert (res.ids[:, 0] == np.arange(4)).all()

@@ -65,6 +65,12 @@ class DeviceArraySet:
         return self._state[1]
 
     @property
+    def nbytes(self) -> int:
+        """Device (HBM) footprint of all code planes + the valid mask."""
+        arrays, valid = self._state
+        return sum(a.nbytes for a in arrays.values()) + valid.nbytes
+
+    @property
     def host_valid_mask(self) -> np.ndarray:
         return self._host_valid
 
@@ -125,13 +131,39 @@ class DeviceArraySet:
 
 
 class HostVectorStore:
-    """Doc-id-addressed originals in host RAM (the rescore/refit tier)."""
+    """Doc-id-addressed originals on the host (the rescore/refit tier).
 
-    def __init__(self, dims: int, capacity: int = _PAGE):
+    ``dtype``/``path`` select the residency tier (config ``raw_tier``):
+    float32 RAM (default), float16 RAM (half footprint), or a float16
+    disk memmap — the beyond-RAM tier for 50M+ x 768-d corpora where only
+    rescore gathers touch the raw vectors (reference keeps originals
+    LSM-resident the same way, ``flat/index.go:49``)."""
+
+    def __init__(self, dims: int, capacity: int = _PAGE,
+                 dtype=np.float32, path: Optional[str] = None):
         self.dims = dims
-        self._vecs = np.zeros((max(_PAGE, _round_up(capacity)), dims), np.float32)
+        self.dtype = np.dtype(dtype)
+        self.path = path
+        self._vecs = self._alloc(max(_PAGE, _round_up(capacity)))
         self._valid = np.zeros((self._vecs.shape[0],), bool)
         self._watermark = 0
+
+    def _alloc(self, rows: int) -> np.ndarray:
+        if self.path is None:
+            return np.zeros((rows, self.dims), self.dtype)
+        import os
+
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        nbytes = rows * self.dims * self.dtype.itemsize
+        with open(self.path, "ab") as f:
+            if f.tell() < nbytes:
+                f.truncate(nbytes)
+        return np.memmap(self.path, dtype=self.dtype, mode="r+",
+                         shape=(rows, self.dims))
+
+    @property
+    def nbytes(self) -> int:
+        return self._vecs.shape[0] * self.dims * self.dtype.itemsize
 
     @property
     def capacity(self) -> int:
@@ -153,9 +185,16 @@ class HostVectorStore:
         if min_capacity <= self.capacity:
             return
         new_cap = _round_up(max(min_capacity, self.capacity * 2))
-        nv = np.zeros((new_cap, self.dims), np.float32)
-        nv[: self._vecs.shape[0]] = self._vecs
-        self._vecs = nv
+        if self.path is None:
+            nv = np.zeros((new_cap, self.dims), self.dtype)
+            nv[: self._vecs.shape[0]] = self._vecs
+            self._vecs = nv
+        else:
+            # memmap growth: flush, extend the file, map the larger view,
+            # THEN swap — a failed allocation (ENOSPC) leaves the old map
+            # intact instead of a broken store
+            self._vecs.flush()
+            self._vecs = self._alloc(new_cap)
         va = np.zeros((new_cap,), bool)
         va[: len(self._valid)] = self._valid
         self._valid = va
@@ -165,7 +204,8 @@ class HostVectorStore:
         if len(doc_ids) == 0:
             return
         self.ensure_capacity(int(doc_ids.max()) + 1)
-        self._vecs[doc_ids] = vectors
+        self._vecs[doc_ids] = np.asarray(vectors).astype(
+            self.dtype, copy=False)
         self._valid[doc_ids] = True
         self._watermark = max(self._watermark, int(doc_ids.max()) + 1)
 
@@ -175,7 +215,8 @@ class HostVectorStore:
         self._valid[doc_ids] = False
 
     def get(self, doc_ids: np.ndarray) -> np.ndarray:
-        return self._vecs[np.asarray(doc_ids, np.int64)]
+        out = self._vecs[np.asarray(doc_ids, np.int64)]
+        return out.astype(np.float32) if out.dtype != np.float32 else out
 
     def sample(self, limit: int, seed: int = 0) -> np.ndarray:
         """Up to ``limit`` live vectors (quantizer training sample)."""
@@ -183,7 +224,7 @@ class HostVectorStore:
         if len(live) > limit:
             rng = np.random.default_rng(seed)
             live = rng.choice(live, size=limit, replace=False)
-        return self._vecs[live]
+        return self._vecs[live].astype(np.float32, copy=False)
 
     def all_live(self) -> tuple[np.ndarray, np.ndarray]:
         live = np.flatnonzero(self._valid)

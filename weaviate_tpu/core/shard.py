@@ -38,7 +38,11 @@ DEFAULT_VECTOR = ""  # unnamed/default target vector
 def build_vector_index(
     dims: int, cfg: VectorIndexConfig, path: Optional[str] = None
 ) -> VectorIndex:
-    """Factory mirroring ``shard_init_vector.go`` index selection."""
+    """Factory mirroring ``shard_init_vector.go`` index selection.
+
+    disk16 originals memmaps resolve to ``<path>/raw16.bin`` PER index —
+    passed as a constructor arg, never written into ``cfg`` (the config
+    object is shared across every shard of the collection)."""
     if isinstance(cfg, HNSWIndexConfig) or cfg.index_type == "hnsw":
         from weaviate_tpu.index.hnsw import HNSWIndex
 
@@ -69,7 +73,11 @@ def build_vector_index(
 
     if not isinstance(cfg, FlatIndexConfig):
         cfg = cfg.as_type(FlatIndexConfig, "flat")
-    return make_flat(dims, cfg)
+    raw_path = None
+    if getattr(cfg, "raw_tier", "ram") == "disk16" \
+            and getattr(cfg, "raw_path", None) is None and path:
+        raw_path = os.path.join(path, "raw16.bin")
+    return make_flat(dims, cfg, raw_path=raw_path)
 
 
 def _feed_index(idx: VectorIndex, id_arr: np.ndarray, vecs: list) -> None:

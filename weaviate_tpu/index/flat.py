@@ -22,12 +22,15 @@ from weaviate_tpu.ops.topk import masked_topk
 from weaviate_tpu.schema.config import FlatIndexConfig
 
 
-def make_flat(dims: int, config: Optional[FlatIndexConfig] = None) -> VectorIndex:
+def make_flat(dims: int, config: Optional[FlatIndexConfig] = None,
+              raw_path: Optional[str] = None) -> VectorIndex:
     """Flat-index factory: raw HBM corpus, or code planes + rescore tier when
-    a quantizer is configured (reference ``flat/index.go:49`` + ``quantizer.go``)."""
+    a quantizer is configured (reference ``flat/index.go:49`` + ``quantizer.go``).
+    ``raw_path`` places a disk16 originals memmap per index instance without
+    mutating the (possibly shared) config."""
     config = config or FlatIndexConfig()
     if config.quantizer is not None and config.quantizer.enabled:
-        return QuantizedFlatIndex(dims, config)
+        return QuantizedFlatIndex(dims, config, raw_path=raw_path)
     return FlatIndex(dims, config)
 
 
@@ -236,13 +239,14 @@ class QuantizedFlatIndex(VectorIndex):
     is the VectorIndex adapter over it (same backend HNSW traversal uses).
     """
 
-    def __init__(self, dims: int, config: FlatIndexConfig):
+    def __init__(self, dims: int, config: FlatIndexConfig,
+                 raw_path: Optional[str] = None):
         from weaviate_tpu.index.hnsw.backend import QuantizedBackend
 
         self.config = config
         self.metric = config.distance
         self.dims = dims
-        self.backend = QuantizedBackend(dims, config)
+        self.backend = QuantizedBackend(dims, config, raw_path=raw_path)
 
     @property
     def quantizer(self):

@@ -227,7 +227,7 @@ class QuantizedBackend:
 
     quantized = True
 
-    def __init__(self, dims: int, config):
+    def __init__(self, dims: int, config, raw_path: Optional[str] = None):
         from weaviate_tpu.compression import (
             DeviceArraySet,
             HostVectorStore,
@@ -238,7 +238,20 @@ class QuantizedBackend:
         self.metric = config.distance
         self.dims = dims
         self.quantizer = build_quantizer(config.quantizer, dims, self.metric)
-        self.originals = HostVectorStore(dims, capacity=config.initial_capacity)
+        tier = getattr(config, "raw_tier", "ram")
+        if tier not in ("ram", "ram16", "disk16"):
+            raise ValueError(f"invalid raw_tier {tier!r}")
+        dtype = np.float32 if tier == "ram" else np.float16
+        # raw_path param wins over config so per-shard callers can place
+        # each shard's memmap under its own directory without mutating the
+        # shared collection config
+        path = None
+        if tier == "disk16":
+            path = raw_path or getattr(config, "raw_path", None)
+            if path is None:
+                raise ValueError("raw_tier='disk16' requires a raw path")
+        self.originals = HostVectorStore(
+            dims, capacity=config.initial_capacity, dtype=dtype, path=path)
         self.codes = DeviceArraySet(
             self.quantizer.fields(), capacity=config.initial_capacity
         )

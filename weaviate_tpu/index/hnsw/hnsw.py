@@ -60,7 +60,13 @@ class HNSWIndex(VectorIndex):
         # quantizer swaps the whole distance tier to code space
         quant = self.config.quantizer
         if store is None and quant is not None and quant.enabled:
-            self.backend = QuantizedBackend(dims, self.config)
+            raw_path = None
+            if getattr(self.config, "raw_tier", "ram") == "disk16" \
+                    and getattr(self.config, "raw_path", None) is None \
+                    and path:
+                raw_path = os.path.join(path, "raw16.bin")
+            self.backend = QuantizedBackend(dims, self.config,
+                                            raw_path=raw_path)
             self.store = None
         else:
             self.backend = RawBackend(dims, self.config, store=store)

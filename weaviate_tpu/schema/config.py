@@ -179,6 +179,13 @@ class VectorIndexConfig:
     # this knob is the TPU-native trade the hardware rewards; measured
     # recall is reported by bench.py.
     flat_approx_recall: float = -1.0
+    # Quantized indexes keep raw originals host-side for the exact rescore
+    # tier (reference keeps them LSM-resident, flat/index.go:49). Beyond
+    # ~10M x 768-d rows fp32 RAM stops scaling: "ram16" halves it, "disk16"
+    # pages a float16 memmap from disk (raw_path, or <index path>/raw16.bin)
+    # — codes stay in HBM either way, only rescore gathers touch the tier.
+    raw_tier: str = "ram"  # ram | ram16 | disk16
+    raw_path: Optional[str] = None
 
     def validate(self) -> None:
         from weaviate_tpu.ops.distance import METRICS
@@ -198,6 +205,10 @@ class VectorIndexConfig:
                 "flat_approx_recall must be -1 (unset) or in [0, 1), "
                 f"got {self.flat_approx_recall}"
             )
+        if self.raw_tier not in ("ram", "ram16", "disk16"):
+            raise ValueError(
+                f"invalid raw_tier {self.raw_tier!r}; "
+                "expected ram | ram16 | disk16")
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
