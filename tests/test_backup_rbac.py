@@ -223,3 +223,53 @@ def test_rest_authz_management(secured):
                 {"name": "evil", "permissions": []}, key="bobkey")[0] == 403
     status, roles = call(base, "GET", "/v1/authz/roles", key="rootkey")
     assert any(r["name"] == "writer" for r in roles)
+
+
+def test_dynamic_db_users_lifecycle(secured):
+    """Reference /v1/users/db surface: create -> key authenticates ->
+    own-info -> rotate invalidates the old key -> deactivate blocks auth
+    -> delete; RBAC user actions enforced (VERDICT §2.10 authN dynamic
+    keys)."""
+    base = secured
+    # non-root cannot manage users
+    assert call(base, "POST", "/v1/users/db/svc1", {},
+                key="bobkey")[0] == 403
+    # a db user may not shadow a static principal (privilege escalation:
+    # its key would authenticate as that principal)
+    assert call(base, "POST", "/v1/users/db/root", {},
+                key="rootkey")[0] == 409
+    assert call(base, "POST", "/v1/users/db/bob", {},
+                key="rootkey")[0] == 409
+    status, out = call(base, "POST", "/v1/users/db/svc1", {}, key="rootkey")
+    assert status == 201
+    key1 = out["apikey"]
+    assert key1.startswith("wv-tpu-svc1-")
+    # duplicate create conflicts
+    assert call(base, "POST", "/v1/users/db/svc1", {},
+                key="rootkey")[0] == 409
+    # the fresh key authenticates; own-info names the principal
+    status, info = call(base, "GET", "/v1/users/own-info", key=key1)
+    assert status == 200 and info["username"] == "svc1"
+    # listing + get
+    status, users = call(base, "GET", "/v1/users/db", key="rootkey")
+    assert status == 200 and any(u["userId"] == "svc1" for u in users)
+    status, u = call(base, "GET", "/v1/users/db/svc1", key="rootkey")
+    assert status == 200 and u["active"] is True
+    # rotate: old key dies, new key works
+    status, out = call(base, "POST", "/v1/users/db/svc1/rotate-key",
+                       {}, key="rootkey")
+    assert status == 200
+    key2 = out["apikey"]
+    assert call(base, "GET", "/v1/users/own-info", key=key1)[0] == 401
+    assert call(base, "GET", "/v1/users/own-info", key=key2)[0] == 200
+    # deactivate blocks auth without deleting; activate restores
+    assert call(base, "POST", "/v1/users/db/svc1/deactivate", {},
+                key="rootkey")[0] == 200
+    assert call(base, "GET", "/v1/users/own-info", key=key2)[0] == 401
+    assert call(base, "POST", "/v1/users/db/svc1/activate", {},
+                key="rootkey")[0] == 200
+    assert call(base, "GET", "/v1/users/own-info", key=key2)[0] == 200
+    # delete
+    assert call(base, "DELETE", "/v1/users/db/svc1",
+                key="rootkey")[0] == 204
+    assert call(base, "GET", "/v1/users/own-info", key=key2)[0] == 401

@@ -103,6 +103,60 @@ def test_zeroshot_classification_points_at_target(db):
     assert o1.properties["ofCategory"][0]["beacon"].endswith(cats[1].uuid)
 
 
+def test_contextual_classification_tfidf_match(db):
+    """No training data: basedOn TEXT matched against target texts by
+    TF-IDF (reference text2vec-contextionary-contextual)."""
+    cats = [StorageObject(uuid=f"c1000000-0000-0000-0000-{i:012d}",
+                          collection="Topic", properties={"name": n},
+                          vector=np.eye(1, 8, i, dtype=np.float32)[0])
+            for i, n in enumerate([
+                "software compiler kernel programming",
+                "pasta cuisine restaurant cooking"])]
+    _mk(db, "Topic", [Property(name="name", data_type=DataType.TEXT)], cats)
+    arts = [
+        StorageObject(uuid=f"e0000000-0000-0000-0000-{i:012d}",
+                      collection="Art4",
+                      properties={"body": body},
+                      vector=np.eye(1, 8, i, dtype=np.float32)[0])
+        for i, body in enumerate([
+            "a deep dive into the compiler and kernel internals",
+            "the best restaurant serves pasta with slow cooking"])]
+    _mk(db, "Art4", [
+        Property(name="body", data_type=DataType.TEXT),
+        Property(name="ofTopic", data_type=DataType.REFERENCE,
+                 target_collection="Topic")], arts)
+    mgr = ClassificationManager(db)
+    c = mgr.start("Art4", ["ofTopic"], based_on_properties=["body"],
+                  kind="text2vec-contextionary-contextual")
+    assert c.status == "completed", c.error
+    assert c.type == "contextual"
+    assert c.counts["successful"] == 2
+    col = db.get_collection("Art4")
+    assert col.get(arts[0].uuid).properties["ofTopic"][0][
+        "beacon"].endswith(cats[0].uuid)
+    assert col.get(arts[1].uuid).properties["ofTopic"][0][
+        "beacon"].endswith(cats[1].uuid)
+
+
+def test_contextual_requires_based_on_and_target(db):
+    cats = [StorageObject(uuid=f"c2000000-0000-0000-0000-{0:012d}",
+                          collection="T2", properties={"name": "x"},
+                          vector=np.eye(1, 8, 0, dtype=np.float32)[0])]
+    _mk(db, "T2", [Property(name="name", data_type=DataType.TEXT)], cats)
+    arts = [StorageObject(uuid=f"e1000000-0000-0000-0000-{0:012d}",
+                          collection="A5", properties={"body": "hello"},
+                          vector=np.eye(1, 8, 1, dtype=np.float32)[0])]
+    _mk(db, "A5", [
+        Property(name="body", data_type=DataType.TEXT),
+        Property(name="ofT", data_type=DataType.REFERENCE,
+                 target_collection="T2")], arts)
+    mgr = ClassificationManager(db)
+    # validated UPFRONT (reference validation.go), even when nothing is
+    # unlabeled — not deferred into the run
+    with pytest.raises(ValueError, match="basedOnProperties"):
+        mgr.start("A5", ["ofT"], kind="contextual")  # no basedOn
+
+
 def test_ref_filter_joins_target_collection(db):
     pubs = [StorageObject(uuid=f"b0000000-0000-0000-0000-{i:012d}",
                           collection="Publisher",

@@ -44,6 +44,7 @@ class AuthConfig:
         self.api_keys = api_keys or {}
         self.anonymous_access = anonymous_access
         self.oidc = oidc  # Optional[auth.oidc.OIDCConfig]
+        self.dynamic_users = None  # Optional[auth.users.DynamicUserStore]
 
     def identity_for(self, header: str) -> tuple[Optional[str], list[str]]:
         """Transport-agnostic check of an Authorization header value.
@@ -55,6 +56,10 @@ class AuthConfig:
             user = self.api_keys.get(key)
             if user is not None:
                 return user, []
+            if self.dynamic_users is not None:
+                dyn = self.dynamic_users.principal_for_key(key)
+                if dyn is not None:
+                    return dyn, []
             # JWT-shaped tokens fall through to OIDC (reference runs the
             # apikey and oidc middlewares side by side the same way)
             if self.oidc is not None and key.count(".") == 2:
@@ -194,6 +199,18 @@ class RestAPI:
                  methods=["POST"]),
             Rule("/v1/authz/users/<user>/roles", endpoint="authz_user_roles",
                  methods=["GET"]),
+            # dynamic db users (reference /users/db + own-info surface)
+            Rule("/v1/users/own-info", endpoint="users_own_info",
+                 methods=["GET"]),
+            Rule("/v1/users/db", endpoint="users_db", methods=["GET"]),
+            Rule("/v1/users/db/<user_id>", endpoint="users_db_user",
+                 methods=["GET", "POST", "DELETE"]),
+            Rule("/v1/users/db/<user_id>/rotate-key",
+                 endpoint="users_db_rotate", methods=["POST"]),
+            Rule("/v1/users/db/<user_id>/activate",
+                 endpoint="users_db_activate", methods=["POST"]),
+            Rule("/v1/users/db/<user_id>/deactivate",
+                 endpoint="users_db_deactivate", methods=["POST"]),
             Rule("/v1/classifications", endpoint="classifications",
                  methods=["POST"]),
             Rule("/v1/classifications/<cid>", endpoint="classification",
@@ -215,6 +232,16 @@ class RestAPI:
         from weaviate_tpu.usecases.classification import ClassificationManager
 
         self._classifications = ClassificationManager(db)
+        # dynamic db users back the same Bearer-key auth chain static env
+        # keys use (reference apikey dynamic store)
+        from weaviate_tpu.auth.users import DynamicUserStore
+
+        reserved = set(self.auth.api_keys.values())
+        if rbac is not None:
+            reserved |= set(getattr(rbac, "root_users", ()))
+        self.users = DynamicUserStore(f"{db.root}/users.db",
+                                      reserved=reserved)
+        self.auth.dynamic_users = self.users
         self._server = None
         self._thread = None
 
@@ -629,6 +656,68 @@ class RestAPI:
         return _json_response(self.graphql.execute(query))
 
     # -- metrics -----------------------------------------------------------
+    # -- dynamic db users (reference rest/operations/users) ----------------
+    def on_users_own_info(self, request):
+        principal = getattr(request, "principal", None)
+        if principal is None:
+            _abort(401, "own-info requires authentication")
+        roles = []
+        if self.rbac is not None:
+            roles = [{"name": r} for r in self.rbac.user_roles(principal)]
+        return _json_response({
+            "username": principal,
+            "roles": roles,
+            "groups": getattr(request, "principal_groups", []) or [],
+        })
+
+    def on_users_db(self, request):
+        self._authz(request, "read_users")
+        return _json_response(self.users.list())
+
+    def on_users_db_user(self, request, user_id):
+        if request.method == "POST":
+            self._authz(request, "create_users")
+            try:
+                key = self.users.create(user_id)
+            except KeyError as e:
+                _abort(409, str(e.args[0]))
+            except ValueError as e:
+                _abort(422, str(e))
+            return _json_response({"apikey": key}, 201)
+        if request.method == "DELETE":
+            self._authz(request, "delete_users")
+            if not self.users.delete(user_id):
+                _abort(404, f"user {user_id!r} not found")
+            return Response(status=204)
+        self._authz(request, "read_users")
+        u = self.users.get(user_id)
+        if u is None:
+            _abort(404, f"user {user_id!r} not found")
+        return _json_response(u)
+
+    def on_users_db_rotate(self, request, user_id):
+        self._authz(request, "update_users")
+        try:
+            return _json_response({"apikey": self.users.rotate(user_id)})
+        except KeyError as e:
+            _abort(404, str(e.args[0]))
+
+    def on_users_db_activate(self, request, user_id):
+        self._authz(request, "update_users")
+        try:
+            self.users.set_active(user_id, True)
+        except KeyError as e:
+            _abort(404, str(e.args[0]))
+        return Response(status=200)
+
+    def on_users_db_deactivate(self, request, user_id):
+        self._authz(request, "update_users")
+        try:
+            self.users.set_active(user_id, False)
+        except KeyError as e:
+            _abort(404, str(e.args[0]))
+        return Response(status=200)
+
     # -- classifications (reference adapters/handlers/rest classifications,
     # usecases/classification) --------------------------------------------
     def on_classifications(self, request):

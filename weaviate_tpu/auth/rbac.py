@@ -24,6 +24,8 @@ ACTIONS = (
     "read_tenants", "update_tenants",
     "manage_backups", "read_cluster", "manage_cluster", "read_nodes",
     "manage_roles", "read_roles",
+    # dynamic db-user management (reference authorization/users domain)
+    "read_users", "create_users", "update_users", "delete_users",
 )
 
 

@@ -1,0 +1,149 @@
+"""Dynamic (db-backed) API-key users.
+
+Reference: ``usecases/auth/authentication/apikey/`` dynamic keys +
+``adapters/handlers/rest/operations/users`` (`/v1/users/db` create / list /
+get / delete / rotate-key / activate / deactivate, `/v1/users/own-info`).
+Static env keys identify fixed principals; dynamic users are created at
+runtime, their secrets are returned ONCE and stored only as salted SHA-256
+hashes, keys can be rotated, and deactivated users fail authentication
+without being deleted.
+
+Persistence is one atomically-replaced msgpack file under the DB dir (the
+reference stores dynamic users in its raft-backed store; single-file-per-
+node matches this repo's other node-local auth state).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import secrets
+import threading
+import time
+from typing import Optional
+
+import msgpack
+
+_PREFIX = "wv-tpu"
+
+
+def _hash(secret: str, salt: bytes) -> bytes:
+    return hashlib.sha256(salt + secret.encode()).digest()
+
+
+class DynamicUserStore:
+    """user_id -> {hash, salt, active, created_at}; key lookup is by the
+    key's embedded user id (``<prefix>-<user_id>-<secret>``), so auth costs
+    one hash, not a scan."""
+
+    def __init__(self, path: str, reserved: Optional[set] = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._users: dict[str, dict] = {}
+        # principal names owned by static keys / root users: creating a db
+        # user under one of these would mint a key that AUTHENTICATES AS
+        # that principal (privilege escalation) — reject with a conflict,
+        # like the reference's env-user collision check
+        self.reserved = set(reserved or ())
+        self._load()
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path, "rb") as f:
+                self._users = msgpack.unpackb(f.read(), raw=False)
+        except Exception:
+            self._users = {}
+
+    def _persist(self) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(self._users, use_bin_type=True))
+        os.replace(tmp, self.path)
+
+    @staticmethod
+    def _make_key(user_id: str) -> tuple[str, str]:
+        secret = secrets.token_urlsafe(24)
+        return f"{_PREFIX}-{user_id}-{secret}", secret
+
+    # -- management --------------------------------------------------------
+    def create(self, user_id: str) -> str:
+        """Create a user; returns the apikey (shown exactly once)."""
+        if not user_id or "-" in user_id:
+            raise ValueError("user id must be non-empty and free of '-'")
+        if user_id in self.reserved:
+            raise KeyError(
+                f"user id {user_id!r} collides with a static principal")
+        with self._lock:
+            if user_id in self._users:
+                raise KeyError(f"user {user_id!r} already exists")
+            key, secret = self._make_key(user_id)
+            salt = secrets.token_bytes(16)
+            self._users[user_id] = {
+                "hash": _hash(secret, salt), "salt": salt,
+                "active": True, "created_at": int(time.time() * 1000),
+            }
+            self._persist()
+            return key
+
+    def rotate(self, user_id: str) -> str:
+        """Invalidate the current key, return a fresh one."""
+        with self._lock:
+            u = self._users.get(user_id)
+            if u is None:
+                raise KeyError(f"user {user_id!r} not found")
+            key, secret = self._make_key(user_id)
+            u["salt"] = secrets.token_bytes(16)
+            u["hash"] = _hash(secret, u["salt"])
+            self._persist()
+            return key
+
+    def set_active(self, user_id: str, active: bool) -> None:
+        with self._lock:
+            u = self._users.get(user_id)
+            if u is None:
+                raise KeyError(f"user {user_id!r} not found")
+            u["active"] = bool(active)
+            self._persist()
+
+    def delete(self, user_id: str) -> bool:
+        with self._lock:
+            if self._users.pop(user_id, None) is None:
+                return False
+            self._persist()
+            return True
+
+    def get(self, user_id: str) -> Optional[dict]:
+        with self._lock:
+            u = self._users.get(user_id)
+            if u is None:
+                return None
+            return {"userId": user_id, "active": u["active"],
+                    "createdAt": u["created_at"], "dbUserType": "db_user"}
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return [{"userId": i, "active": u["active"],
+                     "createdAt": u["created_at"], "dbUserType": "db_user"}
+                    for i, u in self._users.items()]
+
+    # -- authentication ----------------------------------------------------
+    def principal_for_key(self, key: str) -> Optional[str]:
+        """apikey -> user id; None when the key is not a dynamic key or is
+        invalid/inactive (caller decides whether to fall through)."""
+        if not key.startswith(f"{_PREFIX}-"):
+            return None
+        rest = key[len(_PREFIX) + 1:]
+        user_id, sep, secret = rest.partition("-")
+        if not sep:
+            return None
+        import hmac
+
+        with self._lock:
+            u = self._users.get(user_id)
+            if u is None or not u["active"]:
+                return None
+            if not hmac.compare_digest(_hash(secret, u["salt"]), u["hash"]):
+                return None
+            return user_id

@@ -9,10 +9,17 @@ writes predicted values:
 - ``zeroshot``: nearest object in the TARGET class of a reference
   property; the winning target's uuid becomes the ref value
   (``classifier_run_zeroshot.go``)
+- ``contextual`` (reference ``text2vec-contextionary-contextual``,
+  ``validation.go:24``): no training data; each source's basedOn TEXT is
+  TF-IDF-matched against the target collection's texts — informative
+  words dominate, mirroring the contextionary's IDF-boosted vector
+  composition — and the winning target becomes the ref value.
 
 TPU-first: the reference classifies object-by-object in worker goroutines;
 here ALL unlabeled objects' vectors go to the device as one query batch —
-classification is literally one batched vector search plus a host vote.
+classification is literally one batched vector search plus a host vote
+(contextual scores are one dense [sources, vocab] @ [vocab, targets]
+matmul on host numpy — BLAS, vocab-capped).
 """
 
 from __future__ import annotations
@@ -67,8 +74,16 @@ class ClassificationManager:
               based_on_properties: Optional[list[str]] = None,
               kind: str = "knn", k: int = 3,
               background: bool = False) -> Classification:
-        if kind not in ("knn", "zeroshot"):
+        if kind == "text2vec-contextionary-contextual":  # reference alias
+            kind = "contextual"
+        if kind not in ("knn", "zeroshot", "contextual"):
             raise ValueError(f"unknown classification type {kind!r}")
+        if kind == "contextual" and not based_on_properties:
+            # upfront like the reference validator (validation.go) — NOT in
+            # the run, where a fully-labeled collection would short-circuit
+            # to 'completed' before noticing the invalid request
+            raise ValueError(
+                "contextual classification requires basedOnProperties")
         col = self.db.get_collection(collection)  # raises on unknown class
         for p in classify_properties:
             if col.config.property(p) is None:
@@ -91,6 +106,8 @@ class ClassificationManager:
         try:
             if c.type == "knn":
                 self._run_knn(c)
+            elif c.type == "contextual":
+                self._run_contextual(c)
             else:
                 self._run_zeroshot(c)
             c.status = "completed"
@@ -204,6 +221,103 @@ class ClassificationManager:
         c.counts["successful"] = sum(assigned)
         c.counts["failed"] = len(unlabeled) - sum(assigned)
         col.put_batch(unlabeled)
+
+
+    def _run_contextual(self, c: Classification) -> None:
+        """Training-data-free ref classification by TF-IDF text relevance
+        (reference contextual type): score every (source, target) pair as
+        the cosine of their IDF-weighted term vectors over the TARGET
+        corpus's vocabulary, assign the argmax target's beacon."""
+        from weaviate_tpu.inverted.analyzer import term_frequencies
+
+        from weaviate_tpu.schema.config import DataType as _DT
+
+        col = self.db.get_collection(c.collection)
+        _, unlabeled = self._split_labeled(col, c.classify_properties)
+        c.counts["count"] = len(unlabeled)
+        if not unlabeled:
+            return
+
+        def text_of(o, props):
+            out = []
+            for p in props:
+                v = o.properties.get(p)
+                if isinstance(v, str):
+                    out.append(v)
+                elif isinstance(v, list):
+                    out.extend(x for x in v if isinstance(x, str))
+            return " ".join(out)
+
+        # source term frequencies depend only on basedOn text: compute once
+        src_tfs = [term_frequencies(
+            text_of(o, c.based_on_properties), "word", set())
+            for o in unlabeled]
+        assigned = [False] * len(unlabeled)
+        for p in c.classify_properties:
+            prop = col.config.property(p)
+            target_cls = prop.target_collection if prop is not None else None
+            if not target_cls:
+                raise ValueError(
+                    f"contextual requires a reference property with a "
+                    f"target collection; {p!r} has none")
+            target = self.db.get_collection(target_cls)
+            t_objs, t_tfs = [], []
+            # TEXT props only: str() of refs/numbers would pollute the
+            # vocabulary with beacon fragments and digit tokens
+            text_props = [q.name for q in target.config.properties
+                          if q.data_type in (_DT.TEXT, _DT.TEXT_ARRAY)]
+            for shard in target._search_shards():
+                from weaviate_tpu.storage.objects import StorageObject
+
+                for _k, raw in shard.objects.items():
+                    o = StorageObject.from_bytes(raw)
+                    t_objs.append(o)
+                    t_tfs.append(term_frequencies(
+                        text_of(o, text_props), "word", set()))
+            if not t_objs:
+                raise ValueError(f"target collection {target_cls} is empty")
+            # vocabulary + idf over the TARGET corpus (informative words
+            # dominate, rare-everywhere words contribute little)
+            df: Counter = Counter()
+            for tf in t_tfs:
+                df.update(tf.keys())
+            n_t = len(t_objs)
+            # cap the vocabulary by keeping the most INFORMATIVE terms
+            # (lowest df — ubiquitous words carry no signal and their IDF
+            # is ~0 anyway); ties broken deterministically by term
+            if len(df) > 20_000:
+                vocab = [w for w, _n in sorted(
+                    df.items(), key=lambda t: (t[1], t[0]))[:20_000]]
+            else:
+                vocab = list(df)
+            vix = {w: i for i, w in enumerate(vocab)}
+            idf = np.log(1.0 + n_t / (1.0 + np.asarray(
+                [df[w] for w in vocab], np.float32)))
+
+            def tfidf(tf: dict) -> np.ndarray:
+                v = np.zeros(len(vocab), np.float32)
+                for w, n in tf.items():
+                    i = vix.get(w)
+                    if i is not None:
+                        v[i] = n
+                v *= idf
+                norm = np.linalg.norm(v)
+                return v / norm if norm > 0 else v
+
+            tmat = np.stack([tfidf(tf) for tf in t_tfs])        # [T, V]
+            smat = np.stack([tfidf(tf) for tf in src_tfs])      # [S, V]
+            scores = smat @ tmat.T                              # [S, T]
+            best = np.argmax(scores, axis=1)
+            for qi, o in enumerate(unlabeled):
+                if scores[qi, best[qi]] <= 0:
+                    continue  # no textual overlap: leave unassigned
+                o.properties[p] = [{
+                    "beacon": "weaviate://localhost/"
+                    f"{target_cls}/{t_objs[best[qi]].uuid}"}]
+                assigned[qi] = True
+        c.counts["successful"] = sum(assigned)
+        c.counts["failed"] = len(unlabeled) - sum(assigned)
+        col.put_batch([o for ok, o in zip(assigned, unlabeled) if ok])
 
 
 def _vote_key(v: Any):
