@@ -99,12 +99,16 @@ class Shard:
         self.store = Store(os.path.join(dirpath, "lsm"), sync=sync_writes)
         self.objects = self.store.bucket("objects")  # docid(8B BE) -> storobj
         self.ids = self.store.bucket("ids")  # uuid bytes -> docid(8B)
-        self.inverted = make_inverted_index(config, self.store)
+        self._inv_snap_path = os.path.join(dirpath, "inverted.snap")
+        self.inverted = make_inverted_index(
+            config, self.store, snapshot_path=self._inv_snap_path)
+        self._migrating = False  # auto tier upgrade in flight
+        self._migrate_cancel = False
+        self._migrate_thread = None
         self._lock = threading.RLock()
         self._vector_indexes: dict[str, VectorIndex] = {}
         self._counter_path = os.path.join(dirpath, "counter.bin")
         self._meta_path = os.path.join(dirpath, "meta.bin")
-        self._inv_snap_path = os.path.join(dirpath, "inverted.snap")
         self._delta_path = os.path.join(dirpath, "delta.log")
         self._sweep_tmp(dirpath)
         self._next_doc_id = 0
@@ -304,6 +308,14 @@ class Shard:
         from weaviate_tpu.storage.wal import WAL
 
         with self._lock:
+            if self._migrating:
+                # the tier migration's catch-up replay depends on the delta
+                # log this would truncate; the next cycle checkpoints
+                # normally. Checked under the lock: the migration also
+                # takes it to read start_seq, so either this checkpoint
+                # completed before the migration snapshotted its seq (all
+                # later records survive) or it sees the flag and skips.
+                return
             seq = self._seq
             # objects the snapshot indexes must be durable BEFORE the delta
             # log is truncated — else a crash leaves doc ids the store can't
@@ -491,7 +503,8 @@ class Shard:
                 else:
                     _feed_index(idx, id_arr, vecs)
             self._live_count += len(final)
-            return doc_ids
+        self._maybe_upgrade_inverted()
+        return doc_ids
 
     def _delete_docids(self, doc_ids: list[int]) -> None:
         self._seq += 1
@@ -616,6 +629,10 @@ class Shard:
     def close(self) -> None:
         if self.async_queue is not None:
             self.async_queue.stop()
+        # an in-flight tier migration must not outlive the store it reads:
+        # cancel cooperatively and join (the next boot simply retries; its
+        # bucket re-adds are idempotent)
+        self._stop_migration()
         self.flush()
         self.checkpoint()
         self._delta.close()
@@ -623,6 +640,104 @@ class Shard:
             if hasattr(idx, "close"):
                 idx.close()
         self.store.close()
+
+    # -- auto inverted-tier upgrade ---------------------------------------
+    def _maybe_upgrade_inverted(self) -> None:
+        """storage="auto": past segment_cutoff live docs, migrate the RAM
+        inverted index to the segment tier in the background (the same
+        grow-up move the dynamic vector index makes flat->HNSW). Writes
+        keep flowing during the bulk stream; the delta log replays the
+        stream window under the lock before the atomic swap."""
+        cfg = self.config.inverted_config
+        with self._lock:
+            if getattr(cfg, "storage", "ram") != "auto" or self._migrating \
+                    or getattr(self.inverted, "segmented", False) \
+                    or self._live_count < getattr(cfg, "segment_cutoff",
+                                                  1 << 62):
+                return
+            self._migrating = True
+            self._migrate_cancel = False
+        self._migrate_thread = threading.Thread(
+            target=self._upgrade_inverted, daemon=True)
+        self._migrate_thread.start()
+
+    def _stop_migration(self, timeout: float = 30.0) -> None:
+        """Cooperatively cancel an in-flight tier migration and wait for
+        the worker to exit (close()/reindex need exclusive ownership of
+        the inverted index and the store)."""
+        t = getattr(self, "_migrate_thread", None)
+        if t is None or not t.is_alive():
+            return
+        self._migrate_cancel = True
+        t.join(timeout=timeout)
+        if t.is_alive():
+            import logging
+
+            logging.getLogger("weaviate_tpu.shard").warning(
+                "tier migration did not stop within %.0fs", timeout)
+
+    def _upgrade_inverted(self) -> None:
+        from weaviate_tpu.inverted.segmented import SegmentedInvertedIndex
+        from weaviate_tpu.storage.wal import WAL
+
+        try:
+            with self._lock:  # serialize with any in-flight checkpoint
+                start_seq = self._seq
+            fresh = SegmentedInvertedIndex(self.config, self.store)
+            fresh.ref_resolver = self.inverted.ref_resolver
+            # phase 1: lock-free bulk stream of the object store (docid
+            # bytes are immutable once written; concurrent writes land in
+            # the delta log and are replayed in phase 2). Bucket re-adds
+            # are idempotent, so a crash-interrupted earlier attempt only
+            # costs wasted work, never wrong rows. CHUNKED batched_writes:
+            # one shard-wide pending buffer would rebuild the whole index
+            # in RAM — the exact thing the migration exists to end.
+            chunk, pending = 20_000, 0
+            ctx = fresh.batched_writes()
+            ctx.__enter__()
+            try:
+                for _key, raw in self.objects.items():
+                    if self._migrate_cancel:
+                        return  # abandoned (close/reindex); no swap
+                    obj = StorageObject.from_bytes(raw)
+                    if obj.doc_id < len(self._live) \
+                            and self._live[obj.doc_id]:
+                        fresh.add_object(obj)
+                        pending += 1
+                        if pending >= chunk:
+                            ctx.__exit__(None, None, None)
+                            ctx = fresh.batched_writes()
+                            ctx.__enter__()
+                            pending = 0
+            finally:
+                ctx.__exit__(None, None, None)
+            # phase 2: catch up + swap under the write lock. checkpoint()
+            # is suppressed while migrating (it truncates the delta log
+            # this replay depends on). The propvals row marks docs phase 1
+            # already indexed, so re-applying their add is skipped and the
+            # RAM counters (doc_count/avgdl) can't double-count.
+            with self._lock:
+                if self._migrate_cancel:
+                    return
+                for payload in WAL.replay(self._delta_path):
+                    rec = msgpack.unpackb(payload, raw=False)
+                    if rec["s"] <= start_seq:
+                        continue
+                    if rec["o"] == "a":
+                        for d in rec["d"]:
+                            raw = self.objects.get(_DOCID.pack(d))
+                            if raw is None or not (d < len(self._live)
+                                                   and self._live[d]):
+                                continue
+                            if fresh._propvals_get(d) is not None:
+                                continue  # streamed by phase 1 already
+                            fresh.add_object(StorageObject.from_bytes(raw))
+                    else:
+                        for d in rec["d"]:
+                            fresh.delete_docid(d)
+                self.inverted = fresh
+        finally:
+            self._migrating = False
 
     def reindex_inverted(self) -> int:
         """Rebuild the inverted index (+filter columns) from stored objects.
@@ -634,8 +749,12 @@ class Shard:
         the shared buckets first, so racing queries get a retriable
         ShardClosed for the rebuild window instead. The next checkpoint
         persists the new state. Returns objects reindexed."""
+        # a racing tier migration would swap stale-tokenization postings
+        # over the rebuilt index — stop it first (it reruns on next write)
+        self._stop_migration()
         with self._lock:
-            if getattr(self.inverted, "segmented", False):
+            was_segmented = getattr(self.inverted, "segmented", False)
+            if was_segmented:
                 # segmented state lives in shared buckets: mark the live
                 # index superseded (queries racing the rebuild raise a
                 # retriable ShardClosed rather than reading recreated-empty
@@ -647,7 +766,15 @@ class Shard:
                     if name.startswith(("inv_", "post_", "range_")) \
                             or name == "propvals":
                         self.store.drop_bucket(name)
-            fresh = make_inverted_index(self.config, self.store)
+                # rebuild into the tier the shard had reached — an "auto"
+                # shard that upgraded must not silently downgrade here
+                from weaviate_tpu.inverted.segmented import (
+                    SegmentedInvertedIndex,
+                )
+
+                fresh = SegmentedInvertedIndex(self.config, self.store)
+            else:
+                fresh = make_inverted_index(self.config, self.store)
             # collection-attached hooks must carry over: a fresh index
             # without the ref_resolver would fail every reference filter
             # until the shard reopens

@@ -319,11 +319,14 @@ class SegmentedInvertedIndex(InvertedIndex):
                     self.lens_counts[prop] += 1
         # live bit + watermark + geo coords stay columnar (RAM)
         self.columnar.add(doc_id, geo_props)
-        if pv_vals or pv_lens:
-            self.propvals.put(
-                _DOCID.pack(doc_id),
-                msgpack.packb({"v": pv_vals, "l": pv_lens},
-                              use_bin_type=True))
+        # ALWAYS write the propvals row (even empty): its presence is the
+        # "doc is indexed" marker that makes docid-level replay idempotent
+        # (tier migration / crash recovery re-apply delta records whose
+        # bucket writes are idempotent but whose counters are not)
+        self.propvals.put(
+            _DOCID.pack(doc_id),
+            msgpack.packb({"v": pv_vals, "l": pv_lens},
+                          use_bin_type=True))
         self._pv_cache.pop(doc_id, None)
 
     def delete_object(self, obj) -> None:
@@ -333,10 +336,11 @@ class SegmentedInvertedIndex(InvertedIndex):
         """Docid-only delete (crash replay): the ``propvals`` record stands
         in for the lost object bytes, so filter/range rows clean up fully;
         postings of searchable-but-unfilterable props stay as stale rows the
-        live mask screens (same stance as the RAM path)."""
+        live mask screens (same stance as the RAM path). A doc with NO
+        propvals row was never indexed here (every add writes one), so the
+        delete is a pure no-op — counters must not drift on double replay."""
         rec = self._propvals_get(doc_id)
         if rec is None:
-            self.doc_count = max(0, self.doc_count - 1)
             self.columnar.delete(doc_id)
             return
         for prop, total in rec.get("l", {}).items():
@@ -619,9 +623,23 @@ class SegmentedInvertedIndex(InvertedIndex):
         }
 
 
-def make_inverted_index(config: CollectionConfig, store=None):
-    """Factory: RAM-columnar vs segment-resident, per collection config."""
-    if getattr(config.inverted_config, "storage", "ram") == "segment" \
-            and store is not None:
+def make_inverted_index(config: CollectionConfig, store=None,
+                        snapshot_path=None):
+    """Factory: RAM-columnar vs segment-resident, per collection config.
+
+    ``storage="auto"`` starts RAM and upgrades at runtime (shard-driven);
+    on reopen the persisted snapshot header decides which engine the shard
+    had reached, so an upgraded shard boots straight into the segment tier
+    instead of rebuilding into RAM."""
+    storage = getattr(config.inverted_config, "storage", "ram")
+    if store is None:
+        return InvertedIndex(config, store)
+    if storage == "segment":
         return SegmentedInvertedIndex(config, store)
+    if storage == "auto" and snapshot_path is not None:
+        from weaviate_tpu.inverted.snapshot import read_header
+
+        hdr = read_header(snapshot_path)
+        if hdr is not None and hdr.get("mode") == "segmented":
+            return SegmentedInvertedIndex(config, store)
     return InvertedIndex(config, store)

@@ -95,6 +95,22 @@ def _load_col(rec) -> "PropColumn":
     return col
 
 
+def read_header(path: str) -> Optional[dict]:
+    """First record of a snapshot file, or None when absent/unreadable —
+    the one place that knows the header framing (the auto-tier factory
+    routes on ``mode`` without paying a full load)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "rb") as f:
+            hdr = next(msgpack.Unpacker(
+                f, raw=False, max_buffer_size=1 << 31,
+                strict_map_key=False))
+        return hdr if hdr.get("k") == "hdr" else None
+    except Exception:
+        return None
+
+
 def save_snapshot(inv, path: str, seq: int) -> None:
     """Write the whole inverted-index state atomically (tmp + rename).
 

@@ -347,7 +347,11 @@ class InvertedIndexConfig:
     # "ram": columnar + dict postings, whole-index snapshots (fast, RAM-bound)
     # "segment": filters/postings live in LSM buckets and stream from disk
     # segments at query time (reference inverted/searcher.go architecture)
+    # "auto": ram until segment_cutoff live docs, then a background
+    # migration streams the shard into the segment tier and swaps it in
+    # (delta-replay catch-up, same pattern as the dynamic vector index)
     storage: str = "ram"
+    segment_cutoff: int = 1_000_000
 
 
 @dataclass
