@@ -200,7 +200,7 @@ def bench_glove(n=1_200_000, d=25, batch=256, k=10, ef=64, iters=20, warmup=2):
     # alive if the kernel fails to lower on this backend
     cfg = HNSWIndexConfig(distance="cosine", ef=ef, ef_construction=96,
                           max_connections=16, initial_capacity=n,
-                          device_beam=True)
+                          device_beam=True, insert_batch=4096)
     idx = HNSWIndex(d, cfg)
     ids = np.arange(n, dtype=np.int64)
     t0 = time.perf_counter()

@@ -284,3 +284,55 @@ def test_golden_search_filtered(raw_channel):
     results = fields(reply, 2)
     assert len(results) == 1
     assert decode_metadata(results[0])["id"].endswith("007")
+
+
+def test_golden_batch_objects_roundtrip(raw_channel):
+    """BatchObjectsRequest (batch.proto:12/:86): objects=1 BatchObject{
+    uuid=1, properties=3{non_ref_properties=1 google.protobuf.Struct},
+    collection=4, vector_bytes=6} — then a golden Search proves the
+    object landed. Struct wire: fields=1 map, Value string_value=3 /
+    number_value=2."""
+    vec = np.zeros(D, np.float32)
+    vec[7] = 2.0
+    # google.protobuf.Struct { fields: {"title": Value{string_value}} }
+    val = ld(3, b"golden inserted")
+    struct = ld(1, ld(1, b"title") + ld(2, val))
+    val2 = tag(2, 1) + struct_pack_double(123.0)
+    struct += ld(1, ld(1, b"wordCount") + ld(2, val2))
+    batch_obj = (
+        ld(1, b"99999999-0000-0000-0000-000000000001")
+        + ld(3, ld(1, struct))
+        + ld(4, b"Article")
+        + ld(6, vec.tobytes())
+    )
+    reply = _call(raw_channel, "BatchObjects", ld(1, batch_obj))
+    # BatchObjectsReply: took=1 (float), errors=2
+    assert not fields(reply, 2), f"batch errors: {parse(reply)}"
+
+    req = (
+        ld(1, b"Article")
+        + ld(21, vint(1, 1))
+        + vint(30, 1)
+        + ld(43, ld(4, vec.tobytes()))
+    )
+    results = fields(_call(raw_channel, "Search", req), 2)
+    assert decode_metadata(results[0])["id"] == \
+        "99999999-0000-0000-0000-000000000001"
+    props = decode_props(results[0])
+    assert props.get("title") == "golden inserted"
+
+
+def struct_pack_double(x: float) -> bytes:
+    return struct.pack("<d", x)
+
+
+def test_golden_aggregate_count(raw_channel):
+    """AggregateRequest{collection=1, objects_count=20} ->
+    AggregateReply.single_result(2).objects_count(1)
+    (aggregate.proto:12/:105)."""
+    req = ld(1, b"Article") + vint(20, 1)
+    reply = _call(raw_channel, "Aggregate", req)
+    single = one(reply, 2)
+    assert single is not None, parse(reply)
+    count = one(single, 1)
+    assert isinstance(count, int) and count >= 20
