@@ -38,6 +38,7 @@ def _bind():
     lib.bm25_set_params.argtypes = [
         ctypes.c_void_p, ctypes.c_float, ctypes.c_float]
     lib.bm25_remove_doc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.bm25_drop_term.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.bm25_compact.argtypes = [ctypes.c_void_p]
     lib.bm25_posting_len.restype = ctypes.c_uint64
     lib.bm25_posting_len.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -121,6 +122,12 @@ class NativeBM25:
     def posting_len(self, prop: str, term: str) -> int:
         with self._lock:
             return self._lib.bm25_posting_len(self._h, term_id(prop, term))
+
+    def drop_term(self, prop: str, term: str) -> None:
+        """Evict one (prop, term) posting list — cache-tier eviction and
+        write invalidation for the segment-resident index."""
+        with self._lock:
+            self._lib.bm25_drop_term(self._h, term_id(prop, term))
 
     def search(self, query_terms: list[tuple[str, str, float, float]],
                k: int, allow: Optional[np.ndarray] = None,

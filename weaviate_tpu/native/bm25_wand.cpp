@@ -175,6 +175,13 @@ void bm25_remove_doc(void* h, int64_t doc) {
     if (ix->tombstones.insert(doc).second) ix->tomb_gen++;
 }
 
+// drop one term's posting list entirely — the eviction/invalidation
+// primitive for the bounded term cache the segment-resident inverted
+// index keeps over its LSM postings buckets
+void bm25_drop_term(void* h, uint64_t term_id) {
+    static_cast<Index*>(h)->postings.erase(term_id);
+}
+
 // purge all tombstoned entries from every posting list, then drop the
 // tombstone set (callable periodically from the host on delete-heavy flows)
 void bm25_compact(void* h) {
