@@ -107,8 +107,39 @@ def maxsim_scores(query: np.ndarray, cand_tokens: np.ndarray,
     query [Tq, D]; cand_tokens [C, Tmax, D] zero-padded; cand_mask [C, Tmax].
     Returns [C] scores = sum over query tokens of max over doc tokens of the
     dot product (reference hnsw/search.go:927 rescore loop -> one einsum).
+    With an active device mesh the candidate axis shards across it
+    (``parallel.sharded_maxsim``) — the rescore tier's sequence-parallel
+    analogue for long token sets.
     """
     import jax.numpy as jnp
+
+    from weaviate_tpu.parallel.runtime import default_mesh
+
+    mesh = default_mesh()
+    if mesh is not None and cand_tokens.shape[0] >= 2 * mesh.size:
+        from weaviate_tpu.parallel.sharded_search import (
+            replicate, sharded_maxsim,
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+        c = cand_tokens.shape[0]
+        pad = (-c) % mesh.size
+        if pad:
+            cand_tokens = np.concatenate(
+                [cand_tokens, np.zeros((pad, *cand_tokens.shape[1:]),
+                                       np.float32)])
+            cand_mask = np.concatenate(
+                [cand_mask, np.zeros((pad, cand_mask.shape[1]), bool)])
+        import jax
+
+        toks = jax.device_put(
+            cand_tokens.astype(np.float32),
+            NamedSharding(mesh, P(SHARD_AXIS, None, None)))
+        mask = jax.device_put(cand_mask,
+                              NamedSharding(mesh, P(SHARD_AXIS, None)))
+        q = replicate(np.asarray(query, np.float32), mesh)
+        return np.asarray(sharded_maxsim(q, toks, mask, mesh=mesh))[:c]
 
     q = jnp.asarray(query, jnp.float32)
     c = jnp.asarray(cand_tokens, jnp.float32)

@@ -188,6 +188,45 @@ def mesh_flat_topk(store, queries: jnp.ndarray, k: int, metric: str,
     )
 
 
+def _local_maxsim(q, toks_local, mask_local):
+    sims = jnp.einsum("qd,ctd->cqt", q, toks_local,
+                      preferred_element_type=jnp.float32)
+    sims = jnp.where(mask_local[:, None, :], sims, -jnp.inf)
+    best = jnp.max(sims, axis=2)
+    best = jnp.where(jnp.isfinite(best), best, 0.0)
+    return jnp.sum(best, axis=1)  # [C_local]
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def sharded_maxsim(
+    query: jnp.ndarray,        # [Tq, D] replicated
+    cand_tokens: jnp.ndarray,  # [C, Tmax, D] sharded on C (pad C to mesh)
+    cand_mask: jnp.ndarray,    # [C, Tmax] sharded on C
+    mesh: Optional[Mesh] = None,
+    axis: str = SHARD_AXIS,
+) -> jnp.ndarray:
+    """Mesh-parallel exact late interaction: the token-level analogue of
+    sequence parallelism for the long-context tier. Candidate token sets
+    shard across the mesh on the candidate axis, every device computes
+    MaxSim for its slice as one einsum, and a tiled ``all_gather`` over
+    ICI reassembles the [C] score vector — the reference rescoring loop
+    (``hnsw/search.go:927``) turned into one SPMD program."""
+    from jax.experimental.shard_map import shard_map
+
+    if mesh is None:
+        return _local_maxsim(query, cand_tokens, cand_mask)
+
+    # out_specs=P(axis): each device returns its candidate slice's scores
+    # and shard_map stitches the global [C] vector — the reassembly IS the
+    # collective, no explicit all_gather needed
+    fn = shard_map(
+        _local_maxsim, mesh=mesh,
+        in_specs=(P(None, None), P(axis, None, None), P(axis, None)),
+        out_specs=P(axis),
+    )
+    return fn(query, cand_tokens, cand_mask)
+
+
 def _local_gather_dists(c_local, queries, cand_ids, metric, axis, precision):
     """Per-device frontier eval: distances for the candidate ids this device
     owns, MASK elsewhere; a ``pmin`` across the axis yields the true value
