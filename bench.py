@@ -837,7 +837,12 @@ def _device_precheck(timeout_s: float = 180.0) -> bool:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", default="flat1m,glove,pq,bq,msmarco,bm25")
+    # bm25 first: it is cheap, CPU-only, and always lands even if a later
+    # device config dies mid-run; the LAST line (what the driver parses as
+    # the headline) is then a device metric when the chip is up, and the
+    # bm25 line when it is not (the device-down flow emits
+    # device_unavailable before the CPU-only configs).
+    ap.add_argument("--configs", default="bm25,flat1m,glove,pq,bq,msmarco")
     ap.add_argument("--skip-precheck", action="store_true",
                     help="skip the device-init probe (saves one backend "
                          "init on quick smoke runs)")

@@ -172,3 +172,27 @@ def test_memtable_auto_flush(tmp_path):
     assert len(b._segments) >= 2
     assert len(b) == 25
     b.close()
+
+
+def test_write_heavy_soak_bounded_write_amplification(tmp_path):
+    """Sustained writes with periodic background compaction: total
+    compaction bytes stay a small multiple of ingested bytes (the
+    all-to-one compactor rewrote O(total) per cycle — VERDICT r2 #6)."""
+    b = Bucket(str(tmp_path / "b"), memtable_max_entries=500)
+    ingested = 0
+    for i in range(8000):
+        payload = (f"v{i}".encode() * 8)
+        b.put(f"k{i % 4000:05d}".encode(), payload)
+        ingested += len(payload) + 6
+        if i % 2000 == 1999:
+            b.compact_tiered(max_segments=4)
+    b.flush_memtable()
+    b.compact_tiered(max_segments=4)
+    assert len(b._segments) <= 4
+    amp = b.compaction_bytes_written / max(ingested, 1)
+    # tiered pairwise keeps amplification low; all-to-one on this write
+    # pattern measures >4x
+    assert amp < 3.0, f"write amplification {amp:.2f}"
+    # data correct after all that churn
+    assert b.get(b"k00123") is not None
+    b.close()
