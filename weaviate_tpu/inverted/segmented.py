@@ -165,8 +165,13 @@ class SegmentedInvertedIndex(InvertedIndex):
 
         self._wand = self.native
         self.native = None  # the base-class write path must not feed it
-        self._wand_budget = int(float(_os.environ.get(
-            "WEAVIATE_TPU_WAND_CACHE_MB", "64")) * (1 << 20))
+        # fleet-tunable budget: runtime override wins over env over 64 MB
+        from weaviate_tpu.utils.runtime_config import WAND_CACHE_MB
+
+        mb = WAND_CACHE_MB.get()
+        if mb < 0:
+            mb = float(_os.environ.get("WEAVIATE_TPU_WAND_CACHE_MB", "64"))
+        self._wand_budget = int(mb * (1 << 20))
         if self._wand_budget <= 0:
             self._wand = None
         # (prop, term) -> (approx bytes, df at load), LRU order. _wand_lock
@@ -241,10 +246,15 @@ class SegmentedInvertedIndex(InvertedIndex):
         self._wand.add_term(prop, term, ids, tfs, dls)
         self._wand_terms[key] = (nbytes, len(ids))
         self._wand_bytes += nbytes
+        # live fleet override applies at eviction time (hot-reload)
+        from weaviate_tpu.utils.runtime_config import WAND_CACHE_MB
+
+        ov = WAND_CACHE_MB.get()
+        budget = int(ov * (1 << 20)) if ov >= 0 else self._wand_budget
         victims = [k for k in self._wand_terms
                    if k not in pinned and k != key]
         for vk in victims:
-            if self._wand_bytes <= self._wand_budget:
+            if self._wand_bytes <= budget:
                 break
             eb, _df = self._wand_terms.pop(vk)
             self._wand.drop_term(*vk)

@@ -154,3 +154,6 @@ FLAT_APPROX_RECALL_DEFAULT = RUNTIME.register("flat_approx_recall_default",
                                               0.0, cast=float)
 MAINTENANCE_PAUSED = RUNTIME.register("maintenance_paused", False,
                                       cast=bool)
+# byte budget of the segmented index's native WAND term cache; -1 = unset
+# (follow the WEAVIATE_TPU_WAND_CACHE_MB env / built-in 64 MB default)
+WAND_CACHE_MB = RUNTIME.register("wand_cache_mb", -1.0, cast=float)
