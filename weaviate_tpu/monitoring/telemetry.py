@@ -19,7 +19,7 @@ import urllib.request
 import uuid as uuidlib
 from typing import Optional
 
-VERSION = "0.2.0"  # framework version reported in payloads
+from weaviate_tpu.version import __version__ as VERSION  # noqa: N812
 
 
 class Telemeter:
