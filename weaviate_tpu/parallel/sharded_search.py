@@ -211,7 +211,10 @@ def sharded_maxsim(
     MaxSim for its slice as one einsum, and a tiled ``all_gather`` over
     ICI reassembles the [C] score vector — the reference rescoring loop
     (``hnsw/search.go:927``) turned into one SPMD program."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.6 stable path
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
 
     if mesh is None:
         return _local_maxsim(query, cand_tokens, cand_mask)
