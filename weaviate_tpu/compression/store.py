@@ -134,10 +134,13 @@ class HostVectorStore:
     """Doc-id-addressed originals on the host (the rescore/refit tier).
 
     ``dtype``/``path`` select the residency tier (config ``raw_tier``):
-    float32 RAM (default), float16 RAM (half footprint), or a float16
-    disk memmap — the beyond-RAM tier for 50M+ x 768-d corpora where only
-    rescore gathers touch the raw vectors (reference keeps originals
-    LSM-resident the same way, ``flat/index.go:49``)."""
+    float32 RAM (default), float16 RAM (half footprint), a float16 disk
+    memmap, or an int8 disk memmap (``dtype=np.int8``: per-row affine SQ8
+    with the scale/offset pair in RAM — 1 byte/dim on disk for the 100M-row
+    tier where fp16 outgrows the volume) — the beyond-RAM tiers for 50M+ x
+    768-d corpora where only rescore gathers touch the raw vectors
+    (reference keeps originals LSM-resident the same way,
+    ``flat/index.go:49``)."""
 
     def __init__(self, dims: int, capacity: int = _PAGE,
                  dtype=np.float32, path: Optional[str] = None):
@@ -146,6 +149,12 @@ class HostVectorStore:
         self.path = path
         self._vecs = self._alloc(max(_PAGE, _round_up(capacity)))
         self._valid = np.zeros((self._vecs.shape[0],), bool)
+        # per-row affine decode params for the int8 tier: v ~ code * scale
+        # + offset (fp32 pair in RAM, 8 B/row)
+        self._sq8 = self.dtype == np.int8
+        if self._sq8:
+            self._scale = np.zeros((self._vecs.shape[0],), np.float32)
+            self._offset = np.zeros((self._vecs.shape[0],), np.float32)
         self._watermark = 0
 
     def _alloc(self, rows: int) -> np.ndarray:
@@ -163,7 +172,10 @@ class HostVectorStore:
 
     @property
     def nbytes(self) -> int:
-        return self._vecs.shape[0] * self.dims * self.dtype.itemsize
+        n = self._vecs.shape[0] * self.dims * self.dtype.itemsize
+        if self._sq8:
+            n += self._scale.nbytes + self._offset.nbytes
+        return n
 
     @property
     def capacity(self) -> int:
@@ -198,14 +210,33 @@ class HostVectorStore:
         va = np.zeros((new_cap,), bool)
         va[: len(self._valid)] = self._valid
         self._valid = va
+        if self._sq8:
+            sc = np.zeros((new_cap,), np.float32)
+            sc[: len(self._scale)] = self._scale
+            off = np.zeros((new_cap,), np.float32)
+            off[: len(self._offset)] = self._offset
+            self._scale, self._offset = sc, off
 
     def put(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
         doc_ids = np.asarray(doc_ids, np.int64)
         if len(doc_ids) == 0:
             return
         self.ensure_capacity(int(doc_ids.max()) + 1)
-        self._vecs[doc_ids] = np.asarray(vectors).astype(
-            self.dtype, copy=False)
+        v = np.asarray(vectors)
+        if self._sq8:
+            v = np.atleast_2d(v.astype(np.float32, copy=False))
+            vmin = v.min(axis=1)
+            vmax = v.max(axis=1)
+            scale = np.maximum((vmax - vmin) / 255.0, 1e-12)
+            offset = (vmin + vmax) * 0.5
+            codes = np.clip(
+                np.rint((v - offset[:, None]) / scale[:, None]),
+                -128, 127).astype(np.int8)
+            self._vecs[doc_ids] = codes
+            self._scale[doc_ids] = scale.astype(np.float32)
+            self._offset[doc_ids] = offset.astype(np.float32)
+        else:
+            self._vecs[doc_ids] = v.astype(self.dtype, copy=False)
         self._valid[doc_ids] = True
         self._watermark = max(self._watermark, int(doc_ids.max()) + 1)
 
@@ -214,8 +245,17 @@ class HostVectorStore:
         doc_ids = doc_ids[doc_ids < self.capacity]
         self._valid[doc_ids] = False
 
+    def _decode(self, rows: np.ndarray, ids: np.ndarray) -> np.ndarray:
+        out = rows.astype(np.float32)
+        out *= self._scale[ids][..., None]
+        out += self._offset[ids][..., None]
+        return out
+
     def get(self, doc_ids: np.ndarray) -> np.ndarray:
-        out = self._vecs[np.asarray(doc_ids, np.int64)]
+        ids = np.asarray(doc_ids, np.int64)
+        out = self._vecs[ids]
+        if self._sq8:
+            return self._decode(out, ids)
         return out.astype(np.float32) if out.dtype != np.float32 else out
 
     def sample(self, limit: int, seed: int = 0) -> np.ndarray:
@@ -224,8 +264,12 @@ class HostVectorStore:
         if len(live) > limit:
             rng = np.random.default_rng(seed)
             live = rng.choice(live, size=limit, replace=False)
+        if self._sq8:
+            return self._decode(self._vecs[live], live)
         return self._vecs[live].astype(np.float32, copy=False)
 
     def all_live(self) -> tuple[np.ndarray, np.ndarray]:
         live = np.flatnonzero(self._valid)
+        if self._sq8:
+            return live, self._decode(self._vecs[live], live)
         return live, self._vecs[live]

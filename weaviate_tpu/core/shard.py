@@ -74,9 +74,10 @@ def build_vector_index(
     if not isinstance(cfg, FlatIndexConfig):
         cfg = cfg.as_type(FlatIndexConfig, "flat")
     raw_path = None
-    if getattr(cfg, "raw_tier", "ram") == "disk16" \
+    tier = getattr(cfg, "raw_tier", "ram")
+    if tier.startswith("disk") \
             and getattr(cfg, "raw_path", None) is None and path:
-        raw_path = os.path.join(path, "raw16.bin")
+        raw_path = os.path.join(path, f"raw{tier[4:]}.bin")
     return make_flat(dims, cfg, raw_path=raw_path)
 
 

@@ -239,17 +239,18 @@ class QuantizedBackend:
         self.dims = dims
         self.quantizer = build_quantizer(config.quantizer, dims, self.metric)
         tier = getattr(config, "raw_tier", "ram")
-        if tier not in ("ram", "ram16", "disk16"):
+        if tier not in ("ram", "ram16", "disk16", "disk8"):
             raise ValueError(f"invalid raw_tier {tier!r}")
-        dtype = np.float32 if tier == "ram" else np.float16
+        dtype = {"ram": np.float32, "ram16": np.float16,
+                 "disk16": np.float16, "disk8": np.int8}[tier]
         # raw_path param wins over config so per-shard callers can place
         # each shard's memmap under its own directory without mutating the
         # shared collection config
         path = None
-        if tier == "disk16":
+        if tier.startswith("disk"):
             path = raw_path or getattr(config, "raw_path", None)
             if path is None:
-                raise ValueError("raw_tier='disk16' requires a raw path")
+                raise ValueError(f"raw_tier={tier!r} requires a raw path")
         self.originals = HostVectorStore(
             dims, capacity=config.initial_capacity, dtype=dtype, path=path)
         self.codes = DeviceArraySet(

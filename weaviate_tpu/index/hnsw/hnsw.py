@@ -61,10 +61,11 @@ class HNSWIndex(VectorIndex):
         quant = self.config.quantizer
         if store is None and quant is not None and quant.enabled:
             raw_path = None
-            if getattr(self.config, "raw_tier", "ram") == "disk16" \
+            tier = getattr(self.config, "raw_tier", "ram")
+            if tier.startswith("disk") \
                     and getattr(self.config, "raw_path", None) is None \
                     and path:
-                raw_path = os.path.join(path, "raw16.bin")
+                raw_path = os.path.join(path, f"raw{tier[4:]}.bin")
             self.backend = QuantizedBackend(dims, self.config,
                                             raw_path=raw_path)
             self.store = None
@@ -102,11 +103,12 @@ class HNSWIndex(VectorIndex):
         else:
             self._commitlog = None
         # device-resident layer-0 beam (ops/device_beam.py): one dispatch
-        # per batch instead of one per hop. Opt-in (config flag or
-        # WEAVIATE_TPU_DEVICE_BEAM=on); unfiltered raw-backend searches
-        # only — the host loop keeps filtered + quantized paths. Created
-        # AFTER snapshot load/replay: those swap self.graph, and the
-        # mirror must bind the final graph object.
+        # per batch instead of one per hop, filtered or not (filtered
+        # walks track best-allowed-seen on device). Opt-in (config flag or
+        # WEAVIATE_TPU_DEVICE_BEAM=on); raw-backend searches only — the
+        # host loop keeps the quantized paths. Created AFTER snapshot
+        # load/replay: those swap self.graph, and the mirror must bind
+        # the final graph object.
         self._device_beam = None
         if not self.backend.quantized and (
                 getattr(self.config, "device_beam", False)
@@ -676,11 +678,21 @@ class HNSWIndex(VectorIndex):
                 dists=np.full((b, k), _INF, np.float32),
             )
 
-        # small filter -> brute force over allowed ids only (reference
-        # flat-search cutoff, search.go:85-89 + flat_search.go:28)
+        # Filtered-search triage (reference SWEEPING/ACORN/RRE pick,
+        # search.go:36-41 + the flat cutoff, flat_search.go:28). TPU-first
+        # the tiers are: (1) small OR mid-selectivity filters take the
+        # masked flat scan — one fused masked-matmul dispatch, exact, and
+        # on the MXU faster than a graph walk that would mostly expand
+        # disallowed nodes; (2) permissive filters sweep the graph (host
+        # lockstep beam, or the masked device beam which tracks
+        # best-allowed-seen on device in the same single dispatch).
         if allow_list is not None:
             n_allowed = int(np.asarray(allow_list, bool).sum())
-            if n_allowed <= self.config.flat_search_cutoff or n_allowed <= k:
+            live = max(1, self.count())
+            if (n_allowed <= self.config.flat_search_cutoff
+                    or n_allowed <= k
+                    or n_allowed <= self.config.filter_flat_selectivity
+                    * live):
                 return self._flat_filtered(queries, k, allow_list)
 
         ids, d = self._dispatch.search(queries, k, allow_list)
@@ -720,8 +732,8 @@ class HNSWIndex(VectorIndex):
         all_active = np.ones(b, bool)
         for level in range(self.graph.max_level, 0, -1):
             eps = self._greedy_step_until_stable(qdev, eps, level, all_active)
-        if self._device_beam is not None and allow_list is None:
-            out = self._device_beam_search(queries, eps, ef, k)
+        if self._device_beam is not None:
+            out = self._device_beam_search(queries, eps, ef, k, allow_list)
             if out is not None:
                 return out
         keep = self._keep_mask(allow_list)
@@ -736,9 +748,12 @@ class HNSWIndex(VectorIndex):
         )
         return self.backend.rescore_topk(queries, kept_ids, kept_d, k)
 
-    def _device_beam_search(self, queries, eps, ef, k):
+    def _device_beam_search(self, queries, eps, ef, k, allow_list=None):
         """Layer-0 walk fully on device; host filters tombstoned/deleted
-        ids out of the returned beam (sweeping semantics)."""
+        ids out of the returned beam (sweeping semantics). With a filter,
+        the device additionally tracks the best ALLOWED nodes seen along
+        the unchanged walk (ACORN-style connectivity through disallowed
+        nodes; single dispatch either way)."""
         from weaviate_tpu.ops.device_beam import beam_search_layer0
 
         try:
@@ -756,17 +771,32 @@ class HNSWIndex(VectorIndex):
             # shares a handful of while_loop compiles instead of one per
             # distinct ef (the beam tolerates extra -1/MASK width)
             ef_pad = 1 << max(4, (int(ef) - 1).bit_length())
-            ids, d = beam_search_layer0(
-                jnp.asarray(queries),
-                corpus,
-                adj,
-                present,
-                jnp.asarray(eps.astype(np.int32)),
-                ef=ef_pad,
-                max_steps=int(4 * ef_pad + 64),
-                metric=self.metric,
-                precision=self.config.precision,
-            )
+            if allow_list is not None:
+                cap = int(adj.shape[0])
+                al = np.asarray(allow_list, bool)
+                if len(al) < cap:
+                    al = np.pad(al, (0, cap - len(al)))
+                keep_k = 1 << max(
+                    3, (max(k, min(ef, 2 * k)) - 1).bit_length())
+                _, _, ids, d = beam_search_layer0(
+                    jnp.asarray(queries), corpus, adj, present,
+                    jnp.asarray(eps.astype(np.int32)),
+                    ef=ef_pad, max_steps=int(4 * ef_pad + 64),
+                    metric=self.metric, precision=self.config.precision,
+                    allow=jnp.asarray(al[:cap]), keep_k=keep_k,
+                )
+            else:
+                ids, d = beam_search_layer0(
+                    jnp.asarray(queries),
+                    corpus,
+                    adj,
+                    present,
+                    jnp.asarray(eps.astype(np.int32)),
+                    ef=ef_pad,
+                    max_steps=int(4 * ef_pad + 64),
+                    metric=self.metric,
+                    precision=self.config.precision,
+                )
             ids = np.asarray(ids).astype(np.int64)
             d = np.asarray(d)
             self._beam_proven = True
@@ -785,7 +815,7 @@ class HNSWIndex(VectorIndex):
                 self.graph.dirty_hook = None
                 self._device_beam = None
             return None
-        keep = self._keep_mask(None)
+        keep = self._keep_mask(allow_list)
         ok = (ids >= 0) & keep[np.clip(ids, 0, len(keep) - 1)]
         d = np.where(ok, d, _INF)
         ids = np.where(ok, ids, -1)

@@ -182,9 +182,12 @@ class VectorIndexConfig:
     # Quantized indexes keep raw originals host-side for the exact rescore
     # tier (reference keeps them LSM-resident, flat/index.go:49). Beyond
     # ~10M x 768-d rows fp32 RAM stops scaling: "ram16" halves it, "disk16"
-    # pages a float16 memmap from disk (raw_path, or <index path>/raw16.bin)
-    # — codes stay in HBM either way, only rescore gathers touch the tier.
-    raw_tier: str = "ram"  # ram | ram16 | disk16
+    # pages a float16 memmap from disk (raw_path, or <index path>/raw16.bin),
+    # "disk8" halves disk again with per-row affine int8 (rescore against
+    # SQ8-decoded originals; the 100M x 768-d tier where even fp16-on-disk
+    # outgrows the volume) — codes stay in HBM either way, only rescore
+    # gathers touch the tier.
+    raw_tier: str = "ram"  # ram | ram16 | disk16 | disk8
     raw_path: Optional[str] = None
 
     def validate(self) -> None:
@@ -205,10 +208,16 @@ class VectorIndexConfig:
                 "flat_approx_recall must be -1 (unset) or in [0, 1), "
                 f"got {self.flat_approx_recall}"
             )
-        if self.raw_tier not in ("ram", "ram16", "disk16"):
+        if self.raw_tier not in ("ram", "ram16", "disk16", "disk8"):
             raise ValueError(
                 f"invalid raw_tier {self.raw_tier!r}; "
-                "expected ram | ram16 | disk16")
+                "expected ram | ram16 | disk16 | disk8")
+        sel = getattr(self, "filter_flat_selectivity", 0.0)
+        if not 0.0 <= sel < 1.0:
+            raise ValueError(
+                "filter_flat_selectivity must be in [0, 1), got "
+                f"{sel} — above 1 every filtered query would silently "
+                "take the exact flat scan")
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -271,6 +280,16 @@ class HNSWIndexConfig(VectorIndexConfig):
     dynamic_ef_max: int = 500
     dynamic_ef_factor: int = 8
     flat_search_cutoff: int = 40000
+    # Filtered-search triage (reference picks SWEEPING / ACORN / RRE per
+    # query, hnsw/search.go:36-41 + flat_search.go:28; the TPU triage is
+    # shaped by different hardware): allowlists under flat_search_cutoff
+    # brute-force; mid-selectivity filters — below this fraction of live
+    # docs — take the MASKED FLAT SCAN (exact, one fused masked-matmul
+    # dispatch: on the MXU a full scan outruns any graph walk whose beam
+    # would mostly expand disallowed nodes); only permissive filters above
+    # the threshold walk the graph (sweeping, or the masked device beam
+    # which tracks best-allowed-seen on device). 0 disables the flat tier.
+    filter_flat_selectivity: float = 0.35
     cleanup_interval_seconds: int = 300
     vector_cache_max_objects: int = 1_000_000_000_000
     # TPU-specific: how many frontier candidates to evaluate per device call
