@@ -16,10 +16,13 @@ exactly one dispatch + one fetch per search batch.
 Semantics mirror the host implementation (lockstep best-first expansion,
 ef-bounded beam, stop when the beam holds no unexpanded candidates —
 every entry that survives the ef cut gets expanded once). Tombstoned
-nodes remain traversable; result
-filtering happens after the walk (sweeping strategy), so this path
-serves UNFILTERED searches and the host loop keeps the filtered ones
-(which track best-allowed-seen candidates mid-walk).
+nodes remain traversable; result filtering happens after the walk
+(sweeping strategy). Filtered searches pass ``allow``/``keep_k``: the
+walk itself is UNCHANGED (traversal through disallowed nodes preserves
+graph connectivity — the device analogue of the reference's ACORN
+traversal, ``hnsw/search.go:36-41``) while a second on-device top-k
+tracks the best ALLOWED nodes seen, exactly like the host sweep's
+``keep_mask`` track — so a filtered batch still costs one dispatch.
 """
 
 from __future__ import annotations
@@ -49,7 +52,7 @@ def _cand_dists(q, corpus, ids, metric, precision):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ef", "max_steps", "metric", "precision"))
+    static_argnames=("ef", "max_steps", "metric", "precision", "keep_k"))
 def beam_search_layer0(
     queries: jnp.ndarray,        # [B, D] fp32
     corpus: jnp.ndarray,         # [N, D]
@@ -60,11 +63,17 @@ def beam_search_layer0(
     max_steps: int,
     metric: str = "l2-squared",
     precision: str = "bf16",
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """→ (ids [B, ef], dists [B, ef]) ascending; -1/MASK padded."""
+    allow: Optional[jnp.ndarray] = None,  # [N] bool filter allowlist
+    keep_k: int = 0,
+):
+    """→ (ids [B, ef], dists [B, ef]) ascending; -1/MASK padded. With
+    ``allow`` + ``keep_k`` also returns (kept_ids [B, keep_k], kept_d) —
+    the best ALLOWED nodes seen anywhere along the walk (the device
+    analogue of the host sweep's keep_mask track)."""
     b = queries.shape[0]
     n, m0 = adjacency.shape
     rows = jnp.arange(b)
+    track = allow is not None and keep_k > 0
 
     d0 = _cand_dists(queries, corpus, eps[:, None].astype(jnp.int32),
                      metric, precision)[:, 0]
@@ -73,13 +82,24 @@ def beam_search_layer0(
     beam_d = jnp.full((b, ef), _INF, jnp.float32).at[:, 0].set(d0)
     expanded = jnp.zeros((b, ef), bool)
     visited = jnp.zeros((b, n), jnp.uint8).at[rows, eps].set(1)
+    if track:
+        seed_ok = jnp.take(allow, eps)
+        kept_ids = jnp.full((b, keep_k), -1, jnp.int32).at[:, 0].set(
+            jnp.where(seed_ok, eps.astype(jnp.int32), -1))
+        kept_d = jnp.full((b, keep_k), _INF, jnp.float32).at[:, 0].set(
+            jnp.where(seed_ok, d0, _INF))
+    else:
+        # zero-width placeholders keep the while_loop carry structure
+        # identical across the two variants
+        kept_ids = jnp.zeros((b, 0), jnp.int32)
+        kept_d = jnp.zeros((b, 0), jnp.float32)
 
     def cond(st):
-        step, _, _, _, _, alive = st
+        step, _, _, _, _, _, _, alive = st
         return (step < max_steps) & alive
 
     def body(st):
-        step, beam_ids, beam_d, expanded, visited, _ = st
+        step, beam_ids, beam_d, expanded, visited, kept_ids, kept_d, _ = st
         cand_d = jnp.where(expanded | (beam_ids < 0), _INF, beam_d)
         j = jnp.argmin(cand_d, axis=1)
         cd = cand_d[rows, j]
@@ -106,13 +126,28 @@ def beam_search_layer0(
         beam_ids = jnp.take_along_axis(all_ids, order, axis=1)
         beam_d = jnp.take_along_axis(all_d, order, axis=1)
         expanded = jnp.take_along_axis(all_exp, order, axis=1)
+        if track:
+            # merge this hop's ALLOWED neighbors into the kept track; the
+            # walk itself stays unfiltered (connectivity through
+            # disallowed nodes is the point)
+            nd_k = jnp.where(
+                (nbrs >= 0) & jnp.take(allow, jnp.maximum(nbrs, 0)),
+                nd, _INF)
+            ka = jnp.concatenate([kept_ids, nbrs], axis=1)
+            kd = jnp.concatenate([kept_d, nd_k], axis=1)
+            korder = jnp.argsort(kd, axis=1, stable=True)[:, :keep_k]
+            kept_ids = jnp.take_along_axis(ka, korder, axis=1)
+            kept_d = jnp.take_along_axis(kd, korder, axis=1)
         return (step + 1, beam_ids, beam_d, expanded, visited,
-                active.any())
+                kept_ids, kept_d, active.any())
 
-    _, beam_ids, beam_d, _, _, _ = jax.lax.while_loop(
+    _, beam_ids, beam_d, _, _, kept_ids, kept_d, _ = jax.lax.while_loop(
         cond, body,
         (jnp.int32(0), beam_ids, beam_d, expanded, visited,
-         jnp.bool_(True)))
+         kept_ids, kept_d, jnp.bool_(True)))
+    if track:
+        kept_ids = jnp.where(kept_d >= _INF, -1, kept_ids)
+        return beam_ids, beam_d, kept_ids, kept_d
     return beam_ids, beam_d
 
 
