@@ -273,3 +273,31 @@ def test_dynamic_db_users_lifecycle(secured):
     assert call(base, "DELETE", "/v1/users/db/svc1",
                 key="rootkey")[0] == 204
     assert call(base, "GET", "/v1/users/own-info", key=key2)[0] == 401
+
+
+def test_dynamic_user_store_durability(tmp_path):
+    """Persist must fsync+replace with a rolling .bak, and a corrupt
+    users.db must FAIL CLOSED loudly instead of silently resetting to an
+    empty user set (which would lock out every dynamic key holder)."""
+    import pytest as _pytest
+
+    from weaviate_tpu.auth.users import DynamicUserStore
+
+    path = str(tmp_path / "users.db")
+    st = DynamicUserStore(path)
+    key = st.create("svc")
+    assert st.principal_for_key(key) == "svc"
+    st.create("svc2")  # second persist writes the .bak of the first
+    import os
+
+    assert os.path.exists(path + ".bak")
+
+    # reload from disk: the first user's key still authenticates
+    st2 = DynamicUserStore(path)
+    assert st2.principal_for_key(key) == "svc" 
+
+    # torn/corrupt file -> loud failure, not an empty store
+    with open(path, "wb") as f:
+        f.write(b"\xc1garbage")
+    with _pytest.raises(RuntimeError, match="corrupt"):
+        DynamicUserStore(path)

@@ -16,6 +16,7 @@ node matches this repo's other node-local auth state).
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import secrets
 import threading
@@ -23,6 +24,8 @@ import time
 from typing import Optional
 
 import msgpack
+
+_bak_warned = False
 
 _PREFIX = "wv-tpu"
 
@@ -50,17 +53,62 @@ class DynamicUserStore:
     def _load(self) -> None:
         if not os.path.exists(self.path):
             return
+        with open(self.path, "rb") as f:
+            raw = f.read()
         try:
-            with open(self.path, "rb") as f:
-                self._users = msgpack.unpackb(f.read(), raw=False)
-        except Exception:
-            self._users = {}
+            self._users = msgpack.unpackb(raw, raw=False)
+        except Exception as e:
+            # FAIL CLOSED, loudly: silently starting with an empty user
+            # set would lock out every dynamic key holder and hide the
+            # corruption (advisor r3 finding). The operator restores from
+            # the .bak written on every persist, or removes the file to
+            # intentionally start fresh.
+            raise RuntimeError(
+                f"dynamic user store {self.path!r} is corrupt ({e!r}); "
+                f"restore it (a .bak sits beside it) or delete it to "
+                f"reset all db users") from e
 
     def _persist(self) -> None:
         tmp = f"{self.path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             f.write(msgpack.packb(self._users, use_bin_type=True))
+            # fsync BEFORE the rename: the key was already returned to the
+            # client, so a crash must not be able to lose the only copy of
+            # its hash (advisor r3 finding)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(self.path):
+            # rolling backup for the fail-closed corrupt-load path — a
+            # HARDLINK, not a rename: the primary must exist at every
+            # instant (a crash between a rename-away and the final
+            # replace would silently present as "no user store")
+            bak = f"{self.path}.bak"
+            try:
+                if os.path.exists(bak):
+                    os.unlink(bak)
+                os.link(self.path, bak)
+            except OSError as e:
+                global _bak_warned
+                if not _bak_warned:
+                    # the corrupt-load message points the operator at the
+                    # .bak — if this filesystem can't produce one, say so
+                    # (once), or that pointer is a dead end
+                    _bak_warned = True
+                    logging.getLogger("weaviate_tpu.auth").warning(
+                        "user store backup %s not written (%s); corrupt-"
+                        "store recovery will have no .bak", bak, e)
         os.replace(tmp, self.path)
+        # fsync the DIRECTORY too: the rename itself is not durable until
+        # the directory entry is journaled — without this a power loss
+        # after create() returns can roll back to the pre-key users.db
+        try:
+            dfd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
 
     @staticmethod
     def _make_key(user_id: str) -> tuple[str, str]:

@@ -77,6 +77,12 @@ class SchemaFSM:
                 else:
                     # empty override = fall back to ring placement
                     self.shard_overrides.pop(key, None)
+                if cmd.get("clear_warming"):
+                    # routing flip + warming clear as ONE raft command: a
+                    # coordinator crash between two separate submits would
+                    # leave the new replica permanently read-excluded
+                    # (advisor r3 finding)
+                    self.shard_warming.pop(key, None)
                 return {"ok": True}
             if op == "set_shard_warming":
                 key = f"{cmd['class']}/{cmd['shard']}"

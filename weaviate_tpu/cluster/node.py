@@ -721,7 +721,15 @@ class ClusterNode:
         4. converge to a VERIFIED-ZERO anti-entropy round (bounded rounds;
            a move that cannot converge raises instead of flipping — with
            factor=1 a blind flip would drop the only complete copy);
-        5. raft-flip src out + clear warming; 6. drop the source copy.
+        5. raft-flip src out AND clear warming in ONE command (a crash
+           between two separate submits would leave dst permanently
+           read-excluded);
+        6. one FINAL anti-entropy pass src -> dst: src stopped receiving
+           writes at the flip, so this closes the factor=1 lost-write
+           window — a write that committed on src but transiently failed
+           on the still-warming dst after step 4's verified-zero round is
+           copied over before the source copy is dropped;
+        7. drop the source copy.
 
         A delete racing the copy window can leave dst holding the object
         until the periodic anti-entropy cycle applies tombstones — the same
@@ -760,12 +768,11 @@ class ClusterNode:
             res = self.raft.submit({
                 "op": "set_shard_replicas", "class": cls, "shard": shard,
                 "nodes": [dst if n == src else n for n in reps],
+                "clear_warming": True,  # atomic with the flip
             })
             if not res.get("ok"):
                 raise ReplicationError(
                     f"routing flip failed: {res.get('error')}")
-            self.raft.submit({"op": "set_shard_warming", "class": cls,
-                              "shard": shard, "nodes": []})
         except Exception:
             # leave routing as it was before the move began
             try:
@@ -778,6 +785,15 @@ class ClusterNode:
             except Exception:
                 pass
             raise
+        # final post-flip pass: src is out of routing now (no new writes
+        # land there), so any straggler that committed on src while dst
+        # was still warming gets copied before the only other copy dies
+        try:
+            moved += self._converge_replicas(cls, shard, src, dst, tenant)
+        except (TransportError, ReplicationError):
+            # src unreachable for the sweep: keep its copy for gc-after-
+            # verify rather than dropping data we could not reconcile
+            return moved
         try:
             self._send(src, {"type": "shard_drop", "class": cls,
                              "tenant": tenant, "shard": shard})

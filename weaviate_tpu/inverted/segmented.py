@@ -237,8 +237,18 @@ class SegmentedInvertedIndex(InvertedIndex):
         be atomic against invalidation and other queries' evictions."""
         key = (prop, term)
         if key in self._wand_terms:
-            self._wand_terms.move_to_end(key)
-            return self._wand_terms[key][1]
+            # LIVE df from the engine, not the df stored at load: the
+            # engine purges tombstoned docs from its lists on its compact
+            # cycle, so docid-only deletes stop drifting idf away from
+            # what a fresh bucket reload would compute (advisor r3
+            # finding; drift is bounded by the compact cadence)
+            df = self._wand.posting_len(prop, term)
+            if df > 0:
+                self._wand_terms.move_to_end(key)
+                return df
+            # list vanished underneath the cache entry — reload below
+            eb, _ = self._wand_terms.pop(key)
+            self._wand_bytes -= eb
         ids, tfs, dls = self._posts(prop).postings_get(term.encode("utf-8"))
         if not len(ids):
             return None
@@ -303,7 +313,20 @@ class SegmentedInvertedIndex(InvertedIndex):
         """Accumulate bucket mutations across a put_batch and flush them
         grouped: one roaring_add per (prop, token), one postings_put per
         (prop, term), one range put_many per prop — instead of per-object
-        WAL records."""
+        WAL records.
+
+        Effects are PER-OBJECT ATOMIC: ``_add_object_pending`` stages each
+        object locally and merges into the batch only on that object's
+        clean completion, and the flush (which runs even when the batch
+        body raises — ``Shard.put_batch`` has already durably written the
+        completed objects' id/object rows, so dropping their index rows
+        would leave live, id-retrievable objects invisible to search)
+        applies exactly the complete objects. The object that RAISED
+        contributes nothing — no counters, no bucket rows — so an aborted
+        batch cannot leave index state behind for half-processed objects
+        (advisor r3 finding); its durable rows are healed by the delta-log
+        replay on restart, like any crash between object and index
+        writes."""
         if self._pending is not None:  # re-entrant: outer flush wins
             yield
             return
@@ -313,6 +336,10 @@ class SegmentedInvertedIndex(InvertedIndex):
             "tok": defaultdict(lambda: defaultdict(list)),  # prop->key->[id]
             "range": defaultdict(lambda: ([], [])),         # prop->(ids,vals)
             "post": defaultdict(lambda: defaultdict(lambda: ([], [], []))),
+            "docs": [],                     # (doc_id, pv_vals, pv_lens, geo)
+            "doc_count": 0,
+            "len_totals": defaultdict(int),
+            "lens_counts": defaultdict(int),
         }
         try:
             yield
@@ -334,6 +361,21 @@ class SegmentedInvertedIndex(InvertedIndex):
                 for term, (ids, tfs, dls) in by_term.items():
                     bk.postings_put(term.encode("utf-8"), ids, tfs, dls)
                     self._wand_invalidate(prop, term)
+            # per-doc rows AFTER bucket rows: the propvals row is the
+            # "doc is indexed" replay marker, so a crash between the two
+            # re-applies idempotent bucket writes instead of skipping them
+            for doc_id, pv_vals, pv_lens, geo_props in pending["docs"]:
+                self.columnar.add(doc_id, geo_props)
+                self.propvals.put(
+                    _DOCID.pack(doc_id),
+                    msgpack.packb({"v": pv_vals, "l": pv_lens},
+                                  use_bin_type=True))
+                self._pv_cache.pop(doc_id, None)
+            self.doc_count += pending["doc_count"]
+            for prop, t in pending["len_totals"].items():
+                self.len_totals[prop] += t
+            for prop, c in pending["lens_counts"].items():
+                self.lens_counts[prop] += c
 
     # keep the base-class name working for callers that only batch ranges
     batched_range_writes = batched_writes
@@ -346,9 +388,15 @@ class SegmentedInvertedIndex(InvertedIndex):
             self._add_object_pending(obj)
 
     def _add_object_pending(self, obj) -> None:
+        # stage locally, merge on clean completion: an exception anywhere
+        # in this method (bad geo dict, mixed-type list, tokenizer error)
+        # must contribute NOTHING to the batch — per-object atomicity
         doc_id = obj.doc_id
-        self.doc_count += 1
-        pend = self._pending
+        present: list[str] = []
+        multi: list[str] = []
+        toks: list[tuple[str, bytes]] = []
+        ranges: list[tuple[str, float]] = []
+        posts: list[tuple[str, str, int, int]] = []  # prop, term, tf, dl
         pv_vals: dict[str, Any] = {}
         pv_lens: dict[str, int] = {}
         geo_props: dict[str, Any] = {}
@@ -358,22 +406,20 @@ class SegmentedInvertedIndex(InvertedIndex):
             vals = val if isinstance(val, list) else [val]
             if self._filterable(prop):
                 pv_vals[prop] = val
-                pend["present"][prop].append(doc_id)
+                present.append(prop)
                 if len(vals) > 1:
-                    pend["multi"][prop].append(doc_id)
+                    multi.append(prop)
                 ranged = self._range_indexed(prop) and len(vals) == 1
                 geos = []
                 for v in vals:
                     tok = _tok_key(v)
                     if tok is not None:
-                        pend["tok"][prop][tok].append(doc_id)
+                        toks.append((prop, tok))
                     elif isinstance(v, (int, float)):
                         if ranged:
-                            ids, rvals = pend["range"][prop]
-                            ids.append(doc_id)
-                            rvals.append(float(v))
+                            ranges.append((prop, float(v)))
                         else:
-                            pend["tok"][prop][_num_key(v)].append(doc_id)
+                            toks.append((prop, _num_key(v)))
                     elif (isinstance(v, dict) and "latitude" in v
                           and "longitude" in v):
                         geos.append(v)
@@ -393,24 +439,34 @@ class SegmentedInvertedIndex(InvertedIndex):
                         for term, n in tf.items():
                             combined[term] = combined.get(term, 0) + n
                     for term, n in combined.items():
-                        ids, tfs, dls = pend["post"][prop][term]
-                        ids.append(doc_id)
-                        tfs.append(n)
-                        dls.append(total)
+                        posts.append((prop, term, n, total))
                     pv_lens[prop] = total
-                    self.len_totals[prop] += total
-                    self.lens_counts[prop] += 1
-        # live bit + watermark + geo coords stay columnar (RAM)
-        self.columnar.add(doc_id, geo_props)
-        # ALWAYS write the propvals row (even empty): its presence is the
-        # "doc is indexed" marker that makes docid-level replay idempotent
-        # (tier migration / crash recovery re-apply delta records whose
-        # bucket writes are idempotent but whose counters are not)
-        self.propvals.put(
-            _DOCID.pack(doc_id),
-            msgpack.packb({"v": pv_vals, "l": pv_lens},
-                          use_bin_type=True))
-        self._pv_cache.pop(doc_id, None)
+        # -- the object completed: merge its staging into the batch -------
+        pend = self._pending
+        pend["doc_count"] += 1
+        for prop in present:
+            pend["present"][prop].append(doc_id)
+        for prop in multi:
+            pend["multi"][prop].append(doc_id)
+        for prop, tok in toks:
+            pend["tok"][prop][tok].append(doc_id)
+        for prop, v in ranges:
+            ids, rvals = pend["range"][prop]
+            ids.append(doc_id)
+            rvals.append(v)
+        for prop, term, n, total in posts:
+            ids, tfs, dls = pend["post"][prop][term]
+            ids.append(doc_id)
+            tfs.append(n)
+            dls.append(total)
+        for prop, total in pv_lens.items():
+            pend["len_totals"][prop] += total
+            pend["lens_counts"][prop] += 1
+        # deferred with everything else: the live columnar bit + the
+        # propvals row (ALWAYS written, even empty — its presence is the
+        # "doc is indexed" marker that makes docid-level replay
+        # idempotent) land at flush
+        pend["docs"].append((doc_id, pv_vals, pv_lens, geo_props))
 
     def delete_object(self, obj) -> None:
         self._delete_known(obj.doc_id, obj.properties)
