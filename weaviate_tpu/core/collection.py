@@ -961,35 +961,71 @@ class Collection:
                 mask = shard.allow_list(flt, space)
                 # the inverted value maps only hold live docs, so the mask is
                 # already liveness-correct
-                doc_ids = set(int(i) for i in np.nonzero(mask)[0])
-                total += len(doc_ids)
+                total += int(mask.sum())
             else:
-                doc_ids = None  # all live docs
+                mask = None  # all live docs
                 total += shard.count()
 
+            inv = shard.inverted
+            if getattr(inv, "segmented", False):
+                # segment tier: aggregate straight off the inv_/range_
+                # buckets with bitmap intersections — O(vocab + matching
+                # docs), no per-doc propvals decode (reference
+                # ``aggregator/`` reads the same LSM rows)
+                base = (mask if mask is not None
+                        else inv.columnar.live_mask(space))
+                if group_by is None:
+                    for p in properties:
+                        prop_values[p].extend(
+                            inv.agg_prop_values(p, base, space))
+                else:
+                    counts, rows = inv.agg_group_table(
+                        group_by, list(properties), base, space)
+                    for g, c in counts.items():
+                        group_counts[g] = group_counts.get(g, 0) + c
+                        row = group_rows.setdefault(
+                            g, {p: [] for p in properties})
+                        for p in properties:
+                            row[p].extend(rows[g][p])
+                continue
+
+            doc_ids = (None if mask is None
+                       else set(int(i) for i in np.nonzero(mask)[0]))
+
+            def _dedup(v):
+                # a value repeated WITHIN one doc's array counts once —
+                # inverted-index (per-doc distinct) semantics, identical
+                # to what the segment tier's bitmaps can express
+                if isinstance(v, list):
+                    try:
+                        return list(dict.fromkeys(v))
+                    except TypeError:  # unhashable elements (geo dicts)
+                        return v
+                return v
+
             def docs_with(prop: str):
-                vals = shard.inverted.values.get(prop, {})
+                vals = inv.values.get(prop, {})
                 for d, v in vals.items():
                     if doc_ids is None or d in doc_ids:
-                        yield d, v
+                        yield d, _dedup(v)
 
             if group_by is None:
                 for p in properties:
                     prop_values[p].extend(v for _, v in docs_with(p))
             else:
-                gvals = shard.inverted.values.get(group_by, {})
+                gvals = inv.values.get(group_by, {})
                 for d, gv in gvals.items():
                     if doc_ids is not None and d not in doc_ids:
                         continue
-                    for g in (gv if isinstance(gv, list) else [gv]):
+                    for g in _dedup(gv) if isinstance(gv, list) else [gv]:
                         group_counts[g] = group_counts.get(g, 0) + 1
                         row = group_rows.setdefault(
                             g, {p: [] for p in properties}
                         )
                         for p in properties:
-                            v = shard.inverted.values.get(p, {}).get(d)
+                            v = inv.values.get(p, {}).get(d)
                             if v is not None:
-                                row[p].append(v)
+                                row[p].append(_dedup(v))
 
         if group_by is None:
             return {
@@ -1000,7 +1036,9 @@ class Collection:
                 },
             }
         groups = []
-        for g, count in sorted(group_counts.items(), key=lambda t: -t[1]):
+        # count desc, value asc on ties — engine-order independent
+        for g, count in sorted(group_counts.items(),
+                               key=lambda t: (-t[1], str(t[0]))):
             groups.append({
                 "groupedBy": {"path": [group_by], "value": g},
                 "meta": {"count": count},

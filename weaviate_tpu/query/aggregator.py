@@ -51,7 +51,12 @@ def aggregate_numeric(values: list[Any]) -> dict:
     if not nums:
         return {"count": 0}
     arr = np.asarray(nums, np.float64)
-    mode_val, _ = Counter(nums).most_common(1)[0]
+    # deterministic mode: ties break to the smallest value, not insertion
+    # order — the segment tier reconstructs values in key order, the RAM
+    # tier sees doc order, and both must answer identically
+    counts = Counter(nums)
+    best = max(counts.values())
+    mode_val = min(v for v, c in counts.items() if c == best)
     return {
         "count": len(nums),
         "sum": float(arr.sum()),
@@ -66,11 +71,13 @@ def aggregate_numeric(values: list[Any]) -> dict:
 def aggregate_text(values: list[Any], top_occurrences_limit: int = 5) -> dict:
     texts = [v for v in _flatten(values) if isinstance(v, str)]
     counter = Counter(texts)
+    # ties break lexicographically (engine-order independence, see mode)
+    ranked = sorted(counter.items(), key=lambda t: (-t[1], t[0]))
     return {
         "count": len(texts),
         "topOccurrences": [
             {"value": v, "occurs": n}
-            for v, n in counter.most_common(top_occurrences_limit)
+            for v, n in ranked[:top_occurrences_limit]
         ],
     }
 
@@ -94,7 +101,9 @@ def aggregate_date(values: list[Any]) -> dict:
         return {"count": 0}
     stamps = sorted(dates)
     iso = lambda d: d.isoformat()
-    mode_val, _ = Counter(iso(d) for d in dates).most_common(1)[0]
+    dcounts = Counter(iso(d) for d in dates)
+    dbest = max(dcounts.values())
+    mode_val = min(v for v, c in dcounts.items() if c == dbest)
     return {
         "count": len(dates),
         "min": iso(stamps[0]),

@@ -273,6 +273,17 @@ class RangeBitmap:
             return (~bits) & 0xFFFFFFFFFFFFFFFF
         return bits | (1 << 63)
 
+    @staticmethod
+    def decode_many(enc: np.ndarray) -> np.ndarray:
+        """Vectorized inverse of ``encode``: uint64 sign-fold lexicodes
+        back to float64 (the aggregation read path reconstructs per-doc
+        values from the bit slices instead of scanning a value store)."""
+        enc = np.ascontiguousarray(enc, np.uint64)
+        top = (enc >> np.uint64(63)) & np.uint64(1)
+        pos = enc & np.uint64(0x7FFFFFFFFFFFFFFF)  # original >= 0
+        neg = ~enc                                  # original < 0
+        return np.where(top == 1, pos, neg).view(np.float64)
+
     def put(self, doc_id: int, value: float) -> None:
         self.delete(doc_id)
         ids = np.asarray([doc_id], np.uint64)
@@ -377,3 +388,25 @@ class RangeBucket:
                   for b in range(self.BITS)]
         return range_query_slices(
             present, slices, op, RangeBitmap.encode(float(value)))
+
+    def present_mask(self, space: int) -> np.ndarray:
+        return self.bucket.roaring_get(self._key(0)).mask(space)
+
+    def values_for(self, doc_ids) -> np.ndarray:
+        """Reconstruct float64 values for PRESENT doc ids straight from
+        the bit slices — the aggregation read path (reference
+        ``aggregator/`` reads the same roaringsetrange rows): 64 bitmap
+        probes regardless of how many docs match, then one vectorized
+        decode. Never touches a per-doc value store."""
+        ids = np.asarray(doc_ids, np.int64)
+        if not len(ids):
+            return np.empty(0, np.float64)
+        space = int(ids.max()) + 1
+        acc = np.zeros(len(ids), np.uint64)
+        for b in range(self.BITS):
+            bm = self.bucket.roaring_get(self._key(b + 1))
+            if len(bm) == 0:
+                continue
+            hit = bm.mask(space)[ids]
+            acc |= hit.astype(np.uint64) << np.uint64(b)
+        return RangeBitmap.decode_many(acc)
