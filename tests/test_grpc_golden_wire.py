@@ -336,3 +336,147 @@ def test_golden_aggregate_count(raw_channel):
     assert single is not None, parse(reply)
     count = one(single, 1)
     assert isinstance(count, int) and count >= 20
+
+
+# -- the remaining four RPCs, hand-encoded the same way (VERDICT r3 #8) -----
+
+
+def test_golden_batch_delete(raw_channel):
+    """BatchDeleteRequest{collection=1, filters=2, verbose=3, dry_run=4}
+    (batch_delete.proto:12); Filters.value_text=4 (base.proto:103).
+    Reply: took=1(float), failed=2, matches=3, successful=4, objects=5
+    BatchDeleteObject{uuid=1 BYTES, successful=2}."""
+    vec = np.zeros(D, np.float32)
+    vec[6] = 3.0
+    val = ld(3, b"golden doomed")
+    st = ld(1, ld(1, b"title") + ld(2, val))
+    batch_obj = (
+        ld(1, b"99999999-0000-0000-0000-00000000dead")
+        + ld(3, ld(1, st))
+        + ld(4, b"Article")
+        + ld(6, vec.tobytes())
+    )
+    assert not fields(_call(raw_channel, "BatchObjects", ld(1, batch_obj)), 2)
+
+    flt = vint(1, 1) + ld(20, ld(1, b"title")) + ld(4, b"golden doomed")
+    # dry run: reference semantics are successful == matches (the
+    # per-object walk runs with the delete skipped, Err=nil —
+    # shard_write_batch_delete.go:105)
+    req = ld(1, b"Article") + ld(2, flt) + vint(3, 1) + vint(4, 1)
+    reply = _call(raw_channel, "BatchDelete", req)
+    assert one(reply, 3) == 1, parse(reply)       # matches
+    assert one(reply, 4, 0) == 1                  # successful (dry run)
+
+    req = ld(1, b"Article") + ld(2, flt) + vint(3, 1)
+    reply = _call(raw_channel, "BatchDelete", req)
+    assert one(reply, 3) == 1 and one(reply, 4) == 1
+    objs = fields(reply, 5)                       # verbose=1 -> objects
+    assert objs, "verbose requested but no per-object results"
+    # uuid is the big-endian INTEGER bytes of the hex uuid, leading
+    # zeros stripped (reference batch_delete.go:82 big.Int.Bytes)
+    want = bytes.fromhex(
+        "99999999-0000-0000-0000-00000000dead".replace("-", ""))
+    assert one(objs[0], 1) == want.lstrip(b"\x00")
+    assert one(objs[0], 2) == 1                   # successful
+
+
+def test_golden_batch_references(raw_channel):
+    """BatchReferencesRequest.references=1 BatchReference{name=1,
+    from_collection=2, from_uuid=3, to_collection=4, to_uuid=5}
+    (batch.proto:17/:124). Reply errors=2{index=1, error=2}."""
+    # Article has no REFERENCE property: the entry must come back as a
+    # per-index error, not a transport failure — proving field numbers
+    # decode right on both sides
+    ref = (ld(1, b"title")
+           + ld(2, b"Article")
+           + ld(3, b"00000000-0000-0000-0000-000000000001")
+           + ld(4, b"Article")
+           + ld(5, b"00000000-0000-0000-0000-000000000002"))
+    reply = _call(raw_channel, "BatchReferences", ld(1, ref))
+    errs = fields(reply, 2)
+    assert len(errs) == 1
+    assert one(errs[0], 1, 0) == 0                # index 0
+    assert one(errs[0], 2, b"")                   # has an error string
+
+
+@pytest.fixture(scope="module")
+def tenant_channel():
+    from weaviate_tpu.schema.config import MultiTenancyConfig
+
+    tmp = tempfile.mkdtemp()
+    db = DB(tmp)
+    cfg = CollectionConfig(
+        name="MT",
+        properties=[Property(name="title", data_type=DataType.TEXT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        multi_tenancy=MultiTenancyConfig(enabled=True))
+    col = db.create_collection(cfg)
+    col.add_tenant("alpha", "HOT")
+    col.add_tenant("beta", "COLD")
+    api = GrpcAPI(db)
+    port = api.serve(port=0)
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield chan
+    api.shutdown()
+    db.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def test_golden_tenants_get(tenant_channel):
+    """TenantsGetRequest{collection=1, names=2{values=1}}; Reply
+    tenants=2 Tenant{name=1, activity_status=2} with HOT=1 COLD=2
+    (tenants.proto:27/:44)."""
+    reply = _call(tenant_channel, "TenantsGet", ld(1, b"MT"))
+    tenants = {one(t, 1).decode(): one(t, 2, 0) for t in fields(reply, 2)}
+    assert tenants == {"alpha": 1, "beta": 2}, tenants
+
+    # filtered by TenantNames
+    req = ld(1, b"MT") + ld(2, ld(1, b"beta"))
+    reply = _call(tenant_channel, "TenantsGet", req)
+    tenants = {one(t, 1).decode(): one(t, 2, 0) for t in fields(reply, 2)}
+    assert tenants == {"beta": 2}
+
+
+def test_golden_batch_stream_bidi(raw_channel):
+    """One full bidi exchange hand-framed (batch.proto:22/:45):
+    requests Start=1 / Data=2{objects=1{values=1}} / Stop=3; replies are
+    the oneof results=1{successes=2{uuid=2}}, shutdown=3, started=4,
+    acks=6{uuids=1}."""
+    vec = np.zeros(D, np.float32)
+    vec[2] = 4.0
+    val = ld(3, b"golden streamed")
+    st = ld(1, ld(1, b"title") + ld(2, val))
+    batch_obj = (
+        ld(1, b"99999999-0000-0000-0000-00000000beef")
+        + ld(3, ld(1, st))
+        + ld(4, b"Article")
+        + ld(6, vec.tobytes())
+    )
+    msgs = [
+        ld(1, b""),                                # Start{}
+        ld(2, ld(1, ld(1, batch_obj))),            # Data.objects.values
+        ld(3, b""),                                # Stop{}
+    ]
+    stream = raw_channel.stream_stream(
+        "/weaviate.v1.Weaviate/BatchStream",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b)
+    replies = list(stream(iter(msgs)))
+    kinds = [parse(r)[0][0] if parse(r) else None for r in replies]
+    assert kinds[0] == 4, kinds                    # started
+    assert 6 in kinds and 1 in kinds, kinds        # acks + results
+    assert kinds[-1] == 3, kinds                   # shutdown
+    acks = one(replies[kinds.index(6)], 6)
+    assert b"99999999-0000-0000-0000-00000000beef" in one(acks, 1, b"")
+    results = one(replies[kinds.index(1)], 1)
+    succ = fields(results, 2)
+    assert len(succ) == 1 and not fields(results, 1)
+    assert one(succ[0], 2) == b"99999999-0000-0000-0000-00000000beef"
+
+    # the streamed object is searchable via a golden Search
+    req = (ld(1, b"Article") + ld(21, vint(1, 1)) + vint(30, 1)
+           + ld(43, ld(4, vec.tobytes())))
+    results = fields(_call(raw_channel, "Search", req), 2)
+    assert decode_metadata(results[0])["id"] == \
+        "99999999-0000-0000-0000-00000000beef"

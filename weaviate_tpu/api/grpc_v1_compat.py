@@ -528,13 +528,32 @@ class WeaviateV1Service:
         flt = filter_from_pb(req.filters)
         tenant = req.tenant if req.HasField("tenant") else ""
         reply = wv.BatchDeleteReply()
-        if req.dry_run:
-            reply.matches = col.count_where(flt, tenant=tenant)
-            reply.successful = 0
-        else:
-            n = col.delete_where(flt, tenant=tenant)
-            reply.matches = n
-            reply.successful = n
+        # reference semantics (shard_write_batch_delete.go:105): dry run
+        # walks the same per-object path with the delete skipped and
+        # Err=nil, so matches == successful either way; verbose returns
+        # one BatchDeleteObject per matched uuid with the uuid encoded as
+        # the big-endian INTEGER bytes of the hex form, leading zeros
+        # stripped (batch_delete.go:82 big.Int.Bytes)
+        # the reference caps the WHOLE operation at QueryMaximumResults
+        # (db/batch.go fetches matching ids capped, deletes only those;
+        # clients loop until matches < cap) — so matches, successful and
+        # the verbose list always agree, one filter scan total
+        cap_n = 10_000
+        matched = [o.uuid for o in col.filter_search(
+            flt, limit=cap_n, tenant=tenant)]
+        if not req.dry_run and matched:
+            col.delete(matched, tenant=tenant)
+        reply.matches = len(matched)
+        reply.successful = len(matched)
+        reply.failed = 0
+        if req.verbose:
+            for u in matched:
+                bo = reply.objects.add()
+                bo.uuid = bytes.fromhex(u.replace("-", "")).lstrip(b"\x00")
+                bo.successful = True
+                # the reference always sets Error (pointer to "") on
+                # success — "empty string means no error" per the proto
+                bo.error = ""
         reply.took = time.perf_counter() - t0
         return reply
 
