@@ -113,9 +113,11 @@ class RaftNode:
         self._waiting: set[int] = set()  # indexes a local apply() awaits
         self._wait_results: dict[int, Any] = {}
         # per-peer replication pipelines: peer -> (thread, kick event);
+        # heartbeat loops tracked separately (same lifecycle); both
         # guarded by _lock, spawned on leadership/config change
         self._peer_loops: dict[str, tuple[threading.Thread,
                                           threading.Event]] = {}
+        self._hb_loops: dict[str, threading.Thread] = {}
 
         self._load_persistent()
         transport.start(self._handle)
@@ -352,7 +354,10 @@ class RaftNode:
             self._ticker.join(timeout=2)
         for th, _ in list(self._peer_loops.values()):
             th.join(timeout=1)
+        for th in list(self._hb_loops.values()):
+            th.join(timeout=1)
         self._peer_loops.clear()
+        self._hb_loops.clear()
         self.transport.stop()
         if self.data_dir:
             self._log_wal.close()
@@ -448,16 +453,22 @@ class RaftNode:
         or stop; leadership respawns them."""
         for peer in self.peers:
             ent = self._peer_loops.get(peer)
-            if ent is not None and ent[0].is_alive():
-                continue
-            ev = threading.Event()
-            th = threading.Thread(target=self._peer_loop,
-                                  args=(peer, ev, self._stop), daemon=True)
-            self._peer_loops[peer] = (th, ev)
-            th.start()
-            hb = threading.Thread(target=self._heartbeat_loop,
-                                  args=(peer, self._stop), daemon=True)
-            hb.start()
+            if ent is None or not ent[0].is_alive():
+                ev = threading.Event()
+                th = threading.Thread(
+                    target=self._peer_loop,
+                    args=(peer, ev, self._stop), daemon=True)
+                self._peer_loops[peer] = (th, ev)
+                th.start()
+            hb = self._hb_loops.get(peer)
+            if hb is None or not hb.is_alive():
+                # tracked like the pipeline: an old loop that outlived a
+                # step-down is superseded (it checks this dict), never
+                # duplicated
+                hb = threading.Thread(target=self._heartbeat_loop,
+                                      args=(peer, self._stop), daemon=True)
+                self._hb_loops[peer] = hb
+                hb.start()
 
     def _kick_peers(self):
         for _, ev in list(self._peer_loops.values()):
@@ -514,7 +525,11 @@ class RaftNode:
         while not stop_evt.is_set():
             stop_evt.wait(self._heartbeat_interval)
             with self._lock:
+                if self._hb_loops.get(peer) \
+                        is not threading.current_thread():
+                    return  # superseded by a respawn
                 if peer not in self.config_nodes or self.state != LEADER:
+                    self._hb_loops.pop(peer, None)
                     return  # leadership/membership ended; respawned later
                 msg = {
                     "type": "append_entries", "term": self.current_term,
@@ -536,6 +551,7 @@ class RaftNode:
             with self._lock:
                 if r.get("term", 0) > self.current_term:
                     self._become_follower(r["term"])
+                    self._hb_loops.pop(peer, None)
                     return
 
     def _append_to_peer(self, peer: str) -> bool:

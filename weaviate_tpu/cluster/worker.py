@@ -1,0 +1,166 @@
+"""Cluster worker: one ClusterNode as a standalone OS process.
+
+The reference proves its distributed layer with real multi-process
+deployments (compose acceptance, ``test/docker/compose.go:24``;
+``clusterintegrationtest/doc.go:1``) — this is the equivalent
+composition root for THIS framework: a raft + 2PC + anti-entropy node
+over ``TcpTransport``, addressable by ``host:port``. ``server.py``
+remains the single-node REST/gRPC entry; a worker is what a cluster
+deployment runs per node (the REST tier scatter-gathers through it).
+
+Run:
+
+    python -m weaviate_tpu.cluster.worker \
+        --bind 127.0.0.1:7101 \
+        --peers 127.0.0.1:7101,127.0.0.1:7102,127.0.0.1:7103 \
+        --data /var/lib/weaviate-tpu/node1
+
+Besides the cluster-internal messages, the worker answers a small
+``ctl_*`` control surface on the same transport (status, schema, puts,
+gets, counts, anti-entropy) so operators/tests can drive any node
+without a second RPC stack. Process-isolated kill -9 recovery is
+exercised by ``tests/test_cluster_procs.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+import numpy as np
+
+
+def _default_cfg(name: str, factor: int):
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        DataType,
+        FlatIndexConfig,
+        Property,
+        ReplicationConfig,
+        ShardingConfig,
+    )
+
+    return CollectionConfig(
+        name=name,
+        properties=[Property(name="title", data_type=DataType.TEXT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        sharding=ShardingConfig(desired_count=1),
+        replication=ReplicationConfig(factor=factor),
+    )
+
+
+class WorkerControl:
+    """ctl_* message handlers layered over a ClusterNode's dispatch."""
+
+    def __init__(self, node):
+        self.node = node
+
+    def handle(self, msg: dict):
+        t = msg.get("type", "")
+        if not t.startswith("ctl_"):
+            return None  # not ours — fall through to the cluster mux
+        try:
+            return {"ok": True, **(getattr(self, t)(msg) or {})}
+        except Exception as e:  # control replies carry errors, not stacks
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    # -- handlers ----------------------------------------------------------
+    def ctl_status(self, msg):
+        raft = self.node.raft
+        return {"id": self.node.id, "is_leader": raft.is_leader(),
+                "leader": raft.leader(),
+                "applied": raft.last_applied,
+                "members": sorted(self.node.all_nodes)}
+
+    def ctl_create_collection(self, msg):
+        self.node.create_collection(
+            _default_cfg(msg["name"], int(msg.get("factor", 3))))
+        return {}
+
+    def ctl_put(self, msg):
+        from weaviate_tpu.storage.objects import StorageObject
+
+        obj = StorageObject(
+            uuid=msg["uuid"], collection=msg["class"],
+            properties=msg.get("properties", {}),
+            vector=np.asarray(msg["vector"], np.float32))
+        self.node.put_batch(msg["class"], [obj],
+                            consistency=msg.get("consistency", "QUORUM"))
+        return {}
+
+    def ctl_get(self, msg):
+        obj = self.node.get(msg["class"], msg["uuid"],
+                            consistency=msg.get("consistency", "QUORUM"))
+        if obj is None:
+            return {"found": False}
+        return {"found": True, "properties": obj.properties}
+
+    def ctl_local_count(self, msg):
+        shard = self.node._local_shard(msg["class"], int(msg.get("shard", 0)))
+        return {"count": shard.count()}
+
+    def ctl_anti_entropy(self, msg):
+        moved = self.node.anti_entropy_once(msg["class"])
+        return {"moved": moved}
+
+
+class CtlTransport:
+    """Transport decorator that muxes the ``ctl_*`` surface in front of
+    whatever handler the wrapped transport is started with — works with
+    any transport via the public start/send/stop contract (no private
+    attribute poking), and the control object can attach AFTER the node
+    has bound its dispatcher."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.ctl = None
+
+    @property
+    def node_id(self):
+        return self.inner.node_id
+
+    def start(self, handler):
+        def mux(msg: dict) -> dict:
+            out = self.ctl.handle(msg) if self.ctl is not None else None
+            return out if out is not None else handler(msg)
+
+        self.inner.start(mux)
+
+    def send(self, peer, msg, timeout=1.0):
+        return self.inner.send(peer, msg, timeout=timeout)
+
+    def stop(self):
+        self.inner.stop()
+
+
+def main(argv=None) -> int:
+    from weaviate_tpu.cluster.node import ClusterNode
+    from weaviate_tpu.cluster.transport import TcpTransport
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bind", required=True, help="host:port (= node id)")
+    ap.add_argument("--peers", required=True,
+                    help="comma-separated host:port list incl. self")
+    ap.add_argument("--data", required=True, help="data directory")
+    args = ap.parse_args(argv)
+
+    transport = CtlTransport(TcpTransport(args.bind))
+    peers = [p.strip() for p in args.peers.split(",") if p.strip()]
+    node = ClusterNode(args.bind, peers, transport, args.data)
+    transport.ctl = WorkerControl(node)
+
+    print(f"worker {args.bind} up; peers={peers}", file=sys.stderr,
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    node.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
