@@ -183,3 +183,85 @@ def test_three_process_cluster_kill9_leader_recovers(tmp_path):
                 os.killpg(p.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+
+
+def _http(port, method, path, body=None, timeout=10.0):
+    import http.client
+    import json
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path,
+                     body=None if body is None else json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, (json.loads(raw) if raw else None)
+    finally:
+        conn.close()
+
+
+@pytest.mark.slow
+def test_rest_over_cluster_replicated_writes(tmp_path):
+    """REST served from cluster workers (reference: every weaviate node
+    serves REST): a schema POST on node A raft-replicates, an object PUT
+    on node A 2PC-replicates, and a GET on node B answers it at QUORUM
+    through the finder."""
+    ports = _free_ports(6)
+    addrs = [f"127.0.0.1:{p}" for p in ports[:3]]
+    http_ports = ports[3:]
+    procs = {}
+    try:
+        for i, a in enumerate(addrs):
+            env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+            procs[a] = subprocess.Popen(
+                [sys.executable, "-m", "weaviate_tpu.cluster.worker",
+                 "--bind", a, "--peers", ",".join(addrs),
+                 "--data", str(tmp_path / f"n{i}"),
+                 "--http-port", str(http_ports[i])],
+                cwd=REPO, env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL, start_new_session=True)
+
+        _wait(lambda: _leader(addrs), timeout=60, msg="leader election")
+        _wait(lambda: _http(http_ports[0], "GET",
+                            "/v1/.well-known/ready")[0] == 200,
+              timeout=60, msg="REST up")
+
+        # schema via REST on node 0 -> raft -> visible on node 2's REST
+        status, _ = _http(http_ports[0], "POST", "/v1/schema", {
+            "class": "Doc",
+            "properties": [{"name": "title", "dataType": ["text"]}],
+            "vectorIndexType": "flat",
+            "vectorIndexConfig": {"distance": "l2-squared"},
+            "replicationConfig": {"factor": 3},
+        })
+        assert status == 200, status
+        _wait(lambda: _http(http_ports[2], "GET", "/v1/schema/Doc")[0]
+              == 200, timeout=30, msg="schema replication to node 2")
+
+        # object write via node 0's REST (2PC), read via node 2's REST
+        uuid = "00000000-0000-0000-0000-00000000ab01"
+        status, _ = _http(http_ports[0], "POST", "/v1/objects", {
+            "class": "Doc", "id": uuid,
+            "properties": {"title": "replicated via REST"},
+            "vector": [1.0, 2.0, 3.0, 4.0],
+        })
+        assert status == 200, status
+        status, out = _http(http_ports[2], "GET",
+                            f"/v1/objects/Doc/{uuid}")
+        assert status == 200, (status, out)
+        assert out["properties"]["title"] == "replicated via REST"
+
+        # DELETE via node 1, gone via node 0 at QUORUM
+        status, _ = _http(http_ports[1], "DELETE",
+                          f"/v1/objects/Doc/{uuid}")
+        assert status == 204, status
+        _wait(lambda: _http(http_ports[0], "GET",
+                            f"/v1/objects/Doc/{uuid}")[0] == 404,
+              timeout=20, msg="delete visible at QUORUM")
+    finally:
+        for p in procs.values():
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass

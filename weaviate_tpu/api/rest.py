@@ -142,12 +142,30 @@ def _obj_from_rest(d: dict) -> StorageObject:
     )
 
 
+def _consistency(request) -> str:
+    cl = request.args.get("consistency_level", "QUORUM").upper()
+    if cl not in ("ONE", "QUORUM", "ALL"):
+        # a typo'd level must not silently downgrade a requested ALL
+        _abort(422, f"invalid consistency_level {cl!r}; "
+                    "expected ONE | QUORUM | ALL")
+    return cl
+
+
 class RestAPI:
     def __init__(self, db: DB, auth: Optional[AuthConfig] = None,
-                 rbac=None, backup_root: Optional[str] = None):
+                 rbac=None, backup_root: Optional[str] = None,
+                 cluster=None):
         self.db = db
         self.auth = auth or AuthConfig()
         self.rbac = rbac  # RBACController or None (authz disabled)
+        # Optional ClusterNode: object CRUD then rides the replicated
+        # data plane (2PC writes, consistency-level reads) instead of the
+        # local shard, and schema mutations go through raft — REST served
+        # from any cluster worker behaves like the reference's clustered
+        # REST tier. Search/aggregate endpoints still answer from the
+        # local replica view (every node holds its raft-replicated
+        # schema; scatter-gather search stays on the ctl/cluster plane).
+        self.cluster = cluster
         self.graphql = GraphQLExecutor(db)
         from weaviate_tpu.backup.handler import BackupHandler
 
@@ -279,6 +297,19 @@ class RestAPI:
             # back-pressure, not failure: clients should retry later
             response = _json_response(
                 {"error": [{"message": str(e)}]}, 503)
+        except TimeoutError as e:
+            # raft apply/forward deadline (clustered schema mutation)
+            response = _json_response(
+                {"error": [{"message": str(e)}]}, 503)
+        except RuntimeError as e:
+            # ReplicationError subclasses RuntimeError: consistency level
+            # not met / replicas unreachable — a structured 503 the client
+            # can retry, never a bare werkzeug 500
+            from weaviate_tpu.cluster.node import ReplicationError
+
+            status = 503 if isinstance(e, ReplicationError) else 500
+            response = _json_response(
+                {"error": [{"message": str(e)}]}, status)
         return response(environ, start_response)
 
     def _write_action(self, obj: StorageObject) -> str:
@@ -336,7 +367,10 @@ class RestAPI:
         body = self._body(request)
         cfg = class_from_rest(body)
         try:
-            self.db.create_collection(cfg)
+            if self.cluster is not None:
+                self.cluster.create_collection(cfg)  # raft-replicated
+            else:
+                self.db.create_collection(cfg)
         except ValueError as e:
             _abort(422, str(e))
         return _json_response(class_to_rest(cfg))
@@ -362,13 +396,21 @@ class RestAPI:
                 new_cfg = update_class_from_rest(
                     self.db.get_collection(cls).config,
                     self._body(request))
+                if self.cluster is not None:
+                    self.cluster.update_collection(new_cfg)
+                    # answer from the COMMITTED config: a follower's
+                    # local FSM apply may lag the leader by a heartbeat
+                    return _json_response(class_to_rest(new_cfg))
                 self.db.update_collection(cls, new_cfg)
             except ValueError as e:
                 _abort(422, str(e))
             return _json_response(
                 class_to_rest(self.db.get_collection(cls).config))
         self._authz(request, "delete_schema", f"collections/{cls}")
-        self.db.delete_collection(cls)
+        if self.cluster is not None:
+            self.cluster.delete_collection(cls)
+        else:
+            self.db.delete_collection(cls)
         return Response(status=200)
 
     def on_schema_properties(self, request, cls):
@@ -378,7 +420,13 @@ class RestAPI:
         body = self._body(request)
         prop = property_from_rest(body)
         try:
-            self.db.add_property(cls, prop)
+            if self.cluster is not None:
+                r = self.cluster.apply({"op": "add_property", "class": cls,
+                                        "property": body})
+                if not r.get("ok"):
+                    raise ValueError(r.get("error", "add_property failed"))
+            else:
+                self.db.add_property(cls, prop)
         except (KeyError, ValueError) as e:
             _abort(422, str(e))
         return _json_response(body)
@@ -418,9 +466,15 @@ class RestAPI:
                         f"collections/{obj.collection}")
             from weaviate_tpu.schema.auto_schema import ensure_schema
 
-            ensure_schema(self.db, obj.collection, [obj.properties])
+            ensure_schema(self.cluster or self.db, obj.collection,
+                          [obj.properties])
             col = self.db.get_collection(obj.collection)
-            col.put(obj, tenant=obj.tenant)
+            if self.cluster is not None:
+                self.cluster.put_batch(obj.collection, [obj],
+                                       tenant=obj.tenant,
+                                       consistency=_consistency(request))
+            else:
+                col.put(obj, tenant=obj.tenant)
             return _json_response(_obj_to_rest(obj))
         cls = request.args.get("class")
         if not cls:
@@ -442,18 +496,35 @@ class RestAPI:
         self._authz(request, action, f"collections/{cls}")
         col = self.db.get_collection(cls)
         tenant = request.args.get("tenant", "")
+
+        def _read(u):
+            # clustered reads go through the finder (digest reads at the
+            # requested consistency + read-repair); local otherwise
+            if self.cluster is not None:
+                return self.cluster.get(cls, u, tenant=tenant,
+                                        consistency=_consistency(request))
+            return col.get(u, tenant)
+
         if request.method == "HEAD":
-            return Response(status=204 if col.exists(uuid, tenant) else 404)
+            found = (self.cluster.exists(cls, uuid, tenant=tenant,
+                                         consistency=_consistency(request))
+                     if self.cluster is not None
+                     else col.exists(uuid, tenant))
+            return Response(status=204 if found else 404)
         if request.method == "GET":
-            obj = col.get(uuid, tenant)
+            obj = _read(uuid)
             if obj is None:
                 _abort(404, f"object {uuid} not found")
             return _json_response(_obj_to_rest(obj))
         if request.method == "DELETE":
-            n = col.delete([uuid], tenant)
+            if self.cluster is not None:
+                n = self.cluster.delete(cls, [uuid], tenant=tenant,
+                                        consistency=_consistency(request))
+            else:
+                n = col.delete([uuid], tenant)
             return Response(status=204 if n else 404)
         body = self._body(request)
-        existing = col.get(uuid, tenant)
+        existing = _read(uuid)
         if request.method == "PATCH":  # merge
             if existing is None:
                 _abort(404, f"object {uuid} not found")
@@ -473,8 +544,12 @@ class RestAPI:
         # runs on update/merge, not only create)
         from weaviate_tpu.schema.auto_schema import ensure_schema
 
-        ensure_schema(self.db, cls, [obj.properties])
-        col.put(obj, tenant=obj.tenant)
+        ensure_schema(self.cluster or self.db, cls, [obj.properties])
+        if self.cluster is not None:
+            self.cluster.put_batch(cls, [obj], tenant=obj.tenant,
+                                   consistency=_consistency(request))
+        else:
+            col.put(obj, tenant=obj.tenant)
         return _json_response(_obj_to_rest(obj))
 
     # -- batch -------------------------------------------------------------
@@ -604,7 +679,8 @@ class RestAPI:
             try:
                 from weaviate_tpu.schema.auto_schema import ensure_schema
 
-                ensure_schema(self.db, cls, [o.properties for o in group])
+                ensure_schema(self.cluster or self.db, cls,
+                              [o.properties for o in group])
                 col = self.db.get_collection(cls)
             except (KeyError, ValueError) as e:
                 for i, o in parsed:
@@ -618,7 +694,12 @@ class RestAPI:
                 by_tenant.setdefault(o.tenant, []).append(o)
             for tenant, tgroup in by_tenant.items():
                 try:
-                    col.put_batch(tgroup, tenant=tenant)
+                    if self.cluster is not None:
+                        self.cluster.put_batch(
+                            cls, tgroup, tenant=tenant,
+                            consistency=_consistency(request))
+                    else:
+                        col.put_batch(tgroup, tenant=tenant)
                 except (KeyError, ValueError, RuntimeError) as e:
                     failed_ids = {id(o) for o in tgroup}
                     for i, o in parsed:

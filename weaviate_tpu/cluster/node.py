@@ -190,6 +190,22 @@ class ClusterNode:
         if not r.get("ok"):
             raise ValueError(r.get("error", "add_tenants failed"))
 
+    def add_property(self, cls: str, prop) -> None:
+        r = self.raft.submit({"op": "add_property", "class": cls,
+                              "property": prop.to_dict()})
+        if not r.get("ok"):
+            raise ValueError(r.get("error", "add_property failed"))
+
+    # schema READS answer locally (raft-replicated FSM state) — together
+    # with the mutators above this satisfies ``ensure_schema``'s interface,
+    # so auto-schema on a cluster worker replicates instead of forking the
+    # coordinator's local schema
+    def has_collection(self, name: str) -> bool:
+        return self.db.has_collection(name)
+
+    def get_collection(self, name: str):
+        return self.db.get_collection(name)
+
     # -- placement ---------------------------------------------------------
     def _state_for(self, cls: str) -> ShardingState:
         cfg = self.db.get_collection(cls).config
@@ -431,6 +447,41 @@ class ClusterNode:
                     except TransportError:
                         pass
         return best
+
+    def exists(self, cls: str, uuid: str, tenant: str = "",
+               consistency: str = "QUORUM") -> bool:
+        """Digest-only existence check: the finder's quorum of version
+        digests answers HEAD without ever fetching object bytes. Newest
+        digest wins on divergence (a replica that missed a delete must
+        not resurrect 'found')."""
+        state = self._state_for(cls)
+        shard, _ = state.shard_replicas_for_uuid(uuid)
+        replicas = self._ordered(state.read_replicas(shard))
+        need = required_acks(consistency, min(state.factor, len(replicas)))
+        digests: list[Optional[int]] = []
+        for rep in replicas:
+            if len(digests) >= need:
+                break
+            try:
+                r = self._send(rep, {
+                    "type": "object_digest", "class": cls, "tenant": tenant,
+                    "shard": shard, "uuids": [uuid],
+                })
+                digests.append(r["digests"][0])
+            except (TransportError, KeyError):
+                continue
+        if len(digests) < need:
+            raise ReplicationError(
+                f"exists: {len(digests)}/{need} replicas answered")
+        present = [d for d in digests if d is not None]
+        if not present:
+            return False
+        if len(present) == len(digests):
+            return True
+        # divergence (some replicas have it, some not): resolve through
+        # the full finder — repair happens there and newest wins
+        return self.get(cls, uuid, tenant=tenant,
+                        consistency=consistency) is not None
 
     def _fetch_one(self, cls, tenant, shard, uuid, replicas):
         for rep in replicas:

@@ -145,6 +145,10 @@ def main(argv=None) -> int:
     ap.add_argument("--peers", required=True,
                     help="comma-separated host:port list incl. self")
     ap.add_argument("--data", required=True, help="data directory")
+    ap.add_argument("--http-port", type=int, default=0,
+                    help="serve the REST tier on this port (0 = off): "
+                         "object CRUD rides the replicated data plane, "
+                         "schema mutations go through raft")
     args = ap.parse_args(argv)
 
     transport = CtlTransport(TcpTransport(args.bind))
@@ -152,12 +156,24 @@ def main(argv=None) -> int:
     node = ClusterNode(args.bind, peers, transport, args.data)
     transport.ctl = WorkerControl(node)
 
+    rest = rest_srv = None
+    if args.http_port:
+        from weaviate_tpu.api.rest import RestAPI
+
+        rest = RestAPI(node.db, cluster=node)
+        rest_srv = rest.serve(host="127.0.0.1", port=args.http_port,
+                              background=True)
+        print(f"REST on :{rest_srv.server_port}", file=sys.stderr,
+              flush=True)
+
     print(f"worker {args.bind} up; peers={peers}", file=sys.stderr,
           flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
+    if rest is not None:
+        rest.shutdown()
     node.close()
     return 0
 
