@@ -1106,9 +1106,9 @@ SMOKE = {
     "glove": dict(n=24_000, iters=3, warmup=1),
     "pq": dict(n=20_000, iters=3, warmup=1),
     "bq": dict(n=120_000, iters=2, warmup=1),
-    "bq50m": dict(n=400_000, iters=2, warmup=1),
-    "bq100m": dict(n=400_000, iters=2, warmup=1),
-    "msmarco": dict(n=128_000, tenants=8, iters=2, warmup=1),
+    "bq50m": dict(n=250_000, iters=2, warmup=1),
+    "bq100m": dict(n=250_000, iters=2, warmup=1),
+    "msmarco": dict(n=96_000, tenants=8, iters=2, warmup=1),
     "bm25": dict(n=20_000, vocab=8_000),
     "bm25seg": dict(n=20_000, vocab=8_000),
 }

@@ -150,7 +150,14 @@ class NativeBM25:
                 m = self._lib.bm25_search(self._h, ids, ws, ads, n, k,
                                           out_docs, out_scores)
         else:
-            ab = np.ascontiguousarray(np.asarray(allow, bool), np.uint8)
+            if isinstance(allow, np.ndarray) and allow.flags.c_contiguous \
+                    and allow.dtype in (np.uint8, np.bool_):
+                # bool is 1 byte: view, don't copy — at 1M docs the two
+                # dtype passes the generic path pays per query cost more
+                # than the WAND search itself
+                ab = allow.view(np.uint8)
+            else:
+                ab = np.ascontiguousarray(np.asarray(allow, bool), np.uint8)
             ptr = ab.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
             with self._lock:
                 m = self._lib.bm25_search_filtered(

@@ -203,6 +203,10 @@ class SegmentedInvertedIndex(InvertedIndex):
         # small LRU over propvals decodes: grouped aggregations hit the same
         # doc once per property
         self._pv_cache: dict[int, dict] = {}
+        # cached live mask for the WAND allow path: materializing a
+        # doc-space bool array per query costs more than the WAND search
+        # itself at 1M docs — writes/deletes invalidate
+        self._live_cache: Optional[tuple[int, np.ndarray]] = None
 
     # -- buckets -----------------------------------------------------------
     def _terms(self, prop: str):
@@ -371,6 +375,8 @@ class SegmentedInvertedIndex(InvertedIndex):
                     msgpack.packb({"v": pv_vals, "l": pv_lens},
                                   use_bin_type=True))
                 self._pv_cache.pop(doc_id, None)
+            if pending["docs"]:
+                self._live_cache = None
             self.doc_count += pending["doc_count"]
             for prop, t in pending["len_totals"].items():
                 self.len_totals[prop] += t
@@ -481,6 +487,7 @@ class SegmentedInvertedIndex(InvertedIndex):
         rec = self._propvals_get(doc_id)
         if rec is None:
             self.columnar.delete(doc_id)
+            self._live_cache = None
             if self._wand is not None:
                 self._wand.remove_doc(doc_id)
             return
@@ -493,6 +500,7 @@ class SegmentedInvertedIndex(InvertedIndex):
                       adjust_lens: bool = True) -> None:
         self.doc_count = max(0, self.doc_count - 1)
         self.columnar.delete(doc_id)
+        self._live_cache = None
         if self._wand is not None:
             # tombstone cached lists whose terms this delete can't name
             # (stale bucket rows are screened by the live mask anyway; the
@@ -580,15 +588,19 @@ class SegmentedInvertedIndex(InvertedIndex):
                 query, self._tokenization(prop)) if t not in self.stopwords]
                 for prop, _ in props}
             pinned = {(prop, t) for prop, ts in by_prop.items() for t in ts}
-            allow = self.columnar.live_mask(space)
+            # ensure + search as ONE critical section: another query's
+            # eviction (or a write invalidation) must not drop this
+            # query's terms between its ensure loop and its search
+            cached = self._live_cache
+            if cached is None or cached[0] != space:
+                cached = (space, self.columnar.live_mask(space))
+                self._live_cache = cached
+            allow = cached[1]
             if allow_list is not None:
                 al = np.asarray(allow_list, bool)
                 if al.shape[0] < space:
                     al = np.pad(al, (0, space - al.shape[0]))
-                allow &= al[:space]
-            # ensure + search as ONE critical section: another query's
-            # eviction (or a write invalidation) must not drop this
-            # query's terms between its ensure loop and its search
+                allow = allow & al[:space]
             with self._wand_lock:
                 query_terms = []
                 for prop, boost in props:
