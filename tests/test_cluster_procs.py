@@ -252,6 +252,17 @@ def test_rest_over_cluster_replicated_writes(tmp_path):
         assert status == 200, (status, out)
         assert out["properties"]["title"] == "replicated via REST"
 
+        # /v1/nodes on a worker lists all raft members with liveness;
+        # gossip freshness is eventually consistent on a loaded host, so
+        # poll like every other cross-node check here
+        def nodes_all_healthy():
+            status, out = _http(http_ports[1], "GET", "/v1/nodes")
+            assert status == 200
+            names = {n["name"] for n in out["nodes"]}
+            assert names == set(addrs), names
+            return all(n["status"] == "HEALTHY" for n in out["nodes"])
+        _wait(nodes_all_healthy, timeout=20, msg="all nodes HEALTHY")
+
         # DELETE via node 1, gone via node 0 at QUORUM
         status, _ = _http(http_ports[1], "DELETE",
                           f"/v1/objects/Doc/{uuid}")

@@ -952,13 +952,37 @@ class RestAPI:
                     "objectCount": s.count(),
                 })
                 total += s.count()
-        return _json_response({"nodes": [{
-            "name": "node-0",
+        local = {
+            "name": (self.cluster.id if self.cluster is not None
+                     else "node-0"),
             "status": "HEALTHY",
             "version": __version__,
             "stats": {"objectCount": total, "shardCount": len(shards)},
             "shards": shards,
-        }]})
+        }
+        if self.cluster is None:
+            return _json_response({"nodes": [local]})
+        # clustered: every raft member, liveness from gossip (reference
+        # /v1/nodes aggregates memberlist state the same way)
+        nodes = [local]
+        statuses = self.cluster.members()
+        for nid in sorted(self.cluster.all_nodes):
+            if nid == self.cluster.id:
+                continue
+            st = statuses.get(nid, "UNKNOWN")
+            nodes.append({
+                "name": nid,
+                "status": ("HEALTHY" if st == "ALIVE"
+                           else "UNHEALTHY" if st == "DEAD"
+                           else "UNAVAILABLE"),
+                "version": __version__,
+                # zero-valued, HOMOGENEOUS stats (typed clients index
+                # into every element; reference non-verbose output is
+                # zero-valued the same way)
+                "stats": {"objectCount": 0, "shardCount": 0},
+                "shards": [],
+            })
+        return _json_response({"nodes": nodes})
 
     # -- backups -----------------------------------------------------------
     def _backend(self, name: str):
