@@ -110,9 +110,18 @@ class HNSWIndex(VectorIndex):
         # load/replay: those swap self.graph, and the mirror must bind
         # the final graph object.
         self._device_beam = None
-        if not self.backend.quantized and (
-                getattr(self.config, "device_beam", False)
-                or os.environ.get("WEAVIATE_TPU_DEVICE_BEAM") == "on"):
+        # env > per-index config > platform-matched measured verdict
+        # (the backend store above already initialized jax, so
+        # default_backend() cannot trip a fresh device init here)
+        import jax as _jax
+
+        from weaviate_tpu.utils import perf_flags
+
+        _beam_on = perf_flags.resolve(
+            "device_beam", os.environ.get("WEAVIATE_TPU_DEVICE_BEAM", ""),
+            config_on=getattr(self.config, "device_beam", False),
+            platform=_jax.default_backend())
+        if not self.backend.quantized and _beam_on:
             from weaviate_tpu.ops.device_beam import DeviceAdjacency
 
             self._device_beam = DeviceAdjacency(self.graph)

@@ -1,0 +1,115 @@
+"""Measured performance flags: bench A/B outcomes drive serving defaults.
+
+VERDICT r3 #1 asks for A/B results to "flip winners on by default,
+delete losers" — but the only process guaranteed to touch real silicon
+is the driver's end-of-round ``bench.py`` run. So the loop closes
+through a file: when the flat1m/glove configs A/B the pallas flat
+kernel and the device beam on a TPU platform, they RECORD the outcome
+(winner + the numbers that decided it) here, and the serving defaults
+consult it — a kernel flips on only after it has beaten the incumbent
+at equal-or-better recall on the target hardware, automatically, with
+the evidence attached.
+
+Resolution order for each flag: explicit env var ("on"/"off") wins,
+then this file's measured verdict, then the conservative default
+(off). The file lives beside the package (repo-local) so the bench
+and the server see the same state; ``WEAVIATE_TPU_PERF_FLAGS``
+overrides the path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Optional
+
+_LOCK = threading.Lock()
+_CACHE: Optional[dict] = None
+_CACHE_KEY: tuple[str, float] = ("", -1.0)  # (path, mtime)
+
+
+def path() -> str:
+    override = os.environ.get("WEAVIATE_TPU_PERF_FLAGS")
+    if override:
+        return override
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(os.path.dirname(pkg_root), "perf_flags.json")
+
+
+def load() -> dict:
+    """Cached read; re-reads when the file (or the configured path)
+    changes — a bench run may finish while a server is up."""
+    global _CACHE, _CACHE_KEY
+    p = path()
+    try:
+        mtime = os.stat(p).st_mtime
+    except OSError:
+        return {}
+    with _LOCK:
+        if _CACHE is not None and (p, mtime) == _CACHE_KEY:
+            return _CACHE
+        try:
+            with open(p) as f:
+                _CACHE = json.load(f)
+            _CACHE_KEY = (p, mtime)
+        except (OSError, ValueError):
+            return {}
+        return _CACHE
+
+
+def flag(name: str, default: bool = False,
+         platform: Optional[str] = None) -> bool:
+    """Measured verdict for ``name``. When ``platform`` is given, a
+    verdict recorded on a DIFFERENT backend does not apply — a TPU win
+    must not route a CPU-backend process into device paths that were
+    never measured there."""
+    ent = load().get(name)
+    if not isinstance(ent, dict):
+        return default
+    rec_plat = ent.get("platform")
+    if platform is not None and rec_plat is not None \
+            and rec_plat != platform:
+        return default
+    return bool(ent.get("enabled", default))
+
+
+def resolve(name: str, env_value: str, config_on: bool = False,
+            platform: Optional[str] = None) -> bool:
+    """The ONE resolution order every measured flag follows: explicit
+    env ("on"/"off") wins, then an explicit per-index config opt-in,
+    then the platform-matched measured verdict, else off."""
+    if env_value in ("on", "off"):
+        return env_value == "on"
+    if config_on:
+        return True
+    return flag(name, default=False, platform=platform)
+
+
+def record(name: str, enabled: bool, evidence: dict) -> None:
+    """Merge one measured verdict (bench-side). Atomic replace; the
+    evidence dict should carry the deciding numbers (and the platform
+    it was measured on). BEST-EFFORT: the recording side channel must
+    never take down the measurement that produced it (read-only
+    checkouts just skip the write)."""
+    global _CACHE, _CACHE_KEY
+    p = path()
+    with _LOCK:
+        try:
+            try:
+                with open(p) as f:
+                    state = json.load(f)
+            except (OSError, ValueError):
+                state = {}
+            state[name] = {"enabled": bool(enabled), **evidence}
+            tmp = f"{p}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(state, f, indent=2, sort_keys=True)
+            os.replace(tmp, p)
+            _CACHE = None
+            _CACHE_KEY = ("", -1.0)
+        except OSError as e:
+            import logging
+
+            logging.getLogger("weaviate_tpu.perf_flags").warning(
+                "could not record perf flag %s at %s: %s", name, p, e)
