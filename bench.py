@@ -237,16 +237,16 @@ def bench_flat1m(n=1_000_000, d=768, batch=256, k=10, iters=30, warmup=3):
                 {"pallas_qps": round(p_qps, 1), "xla_qps": round(qps, 1),
                  "pallas_recall": round(p_recall, 4),
                  "xla_recall": round(recall, 4),
-                 "config": f"{n}x{d} b{batch}", "device": str(dev),
-                 "platform": dev.platform})
+                 "config": f"{n}x{d} b{batch}", "device": str(dev)},
+                platform=dev.platform)
         except Exception as e:
             _emit({"metric": "flat_pallas_failed", "value": 0,
                    "unit": "error", "vs_baseline": 0, "error": repr(e)[:300]})
             from weaviate_tpu.utils import perf_flags
 
             perf_flags.record("pallas_flat", False,
-                              {"error": repr(e)[:300], "device": str(dev),
-                               "platform": dev.platform})
+                              {"error": repr(e)[:300], "device": str(dev)},
+                              platform=dev.platform)
 
 
 def bench_sift1m(n=1_000_000, d=128, batch=256, k=10, iters=30, warmup=3):
@@ -324,8 +324,8 @@ def bench_glove(n=1_200_000, d=25, batch=256, k=10, ef=64, iters=20, warmup=2):
             "device_beam", bool(beam_used and qps > host_qps),
             {"beam_qps": round(qps, 1), "host_qps": round(host_qps, 1),
              "beam_lowered": beam_used, "recall_at_10": round(recall, 4),
-             "config": f"glove {n}x{d} ef{ef}",
-             "platform": _jax.devices()[0].platform})
+             "config": f"glove {n}x{d} ef{ef}"},
+            platform=_jax.devices()[0].platform)
 
     cpu_qps = _cpu_bruteforce(queries[:16], corpus, k, "cosine")
 

@@ -252,9 +252,13 @@ def test_perf_flags_measured_defaults(tmp_path, monkeypatch):
 
     assert pallas_flat.enabled() is False  # no file -> conservative
 
+    import jax as _jax
+
+    plat = _jax.default_backend()
     perf_flags.record("pallas_flat", True,
                       {"pallas_qps": 60000.0, "xla_qps": 45000.0,
-                       "pallas_recall": 0.996, "xla_recall": 0.994})
+                       "pallas_recall": 0.996, "xla_recall": 0.994},
+                      platform=plat)
     assert pallas_flat.enabled() is True  # measured win applies
 
     ev = perf_flags.load()["pallas_flat"]
@@ -262,10 +266,21 @@ def test_perf_flags_measured_defaults(tmp_path, monkeypatch):
 
     monkeypatch.setenv("WEAVIATE_TPU_PALLAS_FLAT", "off")
     assert pallas_flat.enabled() is False  # env always wins
+    monkeypatch.setenv("WEAVIATE_TPU_PALLAS_FLAT", "false")
+    assert pallas_flat.enabled() is False  # any non-on value disables
+    monkeypatch.setenv("WEAVIATE_TPU_PALLAS_FLAT", "bogus")
+    assert pallas_flat.enabled() is False  # unknown values stay OFF
 
     monkeypatch.delenv("WEAVIATE_TPU_PALLAS_FLAT", raising=False)
-    perf_flags.record("pallas_flat", False, {"error": "lowering failed"})
+    perf_flags.record("pallas_flat", False, {"error": "lowering failed"},
+                      platform=plat)
     assert pallas_flat.enabled() is False  # measured loss turns it off
+
+    # a verdict from a DIFFERENT platform never applies
+    perf_flags.record("pallas_flat", True, {"pallas_qps": 1.0},
+                      platform="axon")
+    if plat != "axon":
+        assert pallas_flat.enabled() is False
 
     # device_beam follows the same file through HNSWIndex construction
     import numpy as np
@@ -274,7 +289,8 @@ def test_perf_flags_measured_defaults(tmp_path, monkeypatch):
     from weaviate_tpu.schema.config import HNSWIndexConfig
 
     perf_flags.record("device_beam", True, {"beam_qps": 9000.0,
-                                            "host_qps": 700.0})
+                                            "host_qps": 700.0},
+                      platform=plat)
     monkeypatch.delenv("WEAVIATE_TPU_DEVICE_BEAM", raising=False)
     idx = HNSWIndex(8, HNSWIndexConfig(distance="l2-squared",
                                        precision="fp32"))

@@ -312,3 +312,79 @@ def test_soak_cluster_churn(tmp_path):
     assert obj is not None and obj.uuid == u
     for n in nodes:
         n.close()
+
+
+@pytest.mark.timeout(180)
+def test_soak_segment_tier_writers_vs_queries(tmp_path):
+    """Segment tier under concurrent batch writers + BM25/filter/aggregate
+    readers: protects the live-mask cache (invalidation racing queries),
+    the per-object-atomic batch staging, and the WAND term cache's
+    write invalidation. Invariant: no exceptions, results well-formed,
+    and final counts exact."""
+    from weaviate_tpu.schema.config import InvertedIndexConfig
+
+    db = DB(str(tmp_path))
+    db.create_collection(CollectionConfig(
+        name="Seg",
+        properties=[Property(name="t", data_type=DataType.TEXT),
+                    Property(name="n", data_type=DataType.INT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        inverted_config=InvertedIndexConfig(storage="segment")))
+    col = db.get_collection("Seg")
+    errors: list[BaseException] = []
+    stop = threading.Event()
+    written = [0]
+    lock = threading.Lock()
+
+    def writer():
+        i = 0
+        try:
+            while not stop.is_set():
+                with lock:
+                    base = written[0]
+                    written[0] += 40
+                objs = []
+                for j in range(base, base + 40):
+                    v = np.zeros(D, np.float32)
+                    v[j % D] = 1.0
+                    objs.append(StorageObject(
+                        uuid=f"60000000-0000-0000-0000-{j:012d}",
+                        collection="Seg",
+                        properties={"t": f"word{j % 9} seg common",
+                                    "n": j % 50},
+                        vector=v))
+                col.put_batch(objs)
+                i += 1
+        except BaseException as e:
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                hits = col.bm25_search("word3 common", k=10)
+                for o, s in hits:
+                    assert o.properties["t"]
+                from weaviate_tpu.inverted.filters import Where
+
+                col.aggregate(properties={"n": "numeric"},
+                              flt=Where.gt("n", 10))
+                col.vector_search(np.ones(D, np.float32), 5)
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer),
+               threading.Thread(target=reader),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    time.sleep(8.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors[:3]
+    # exact final count: every batch either fully indexed or raised
+    assert col.count() == written[0]
+    ids, _ = col._get_shard("shard0").inverted.bm25_search("common", k=5)
+    assert len(ids) > 0
+    db.close()

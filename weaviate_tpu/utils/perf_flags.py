@@ -5,16 +5,23 @@ delete losers" — but the only process guaranteed to touch real silicon
 is the driver's end-of-round ``bench.py`` run. So the loop closes
 through a file: when the flat1m/glove configs A/B the pallas flat
 kernel and the device beam on a TPU platform, they RECORD the outcome
-(winner + the numbers that decided it) here, and the serving defaults
-consult it — a kernel flips on only after it has beaten the incumbent
-at equal-or-better recall on the target hardware, automatically, with
-the evidence attached.
+(winner + the numbers that decided it + the platform it was measured
+on), and the serving defaults consult it — a kernel flips on only after
+it has beaten the incumbent within 0.005 of its recall (and above the
+0.95 gate) on the target hardware, automatically, with the evidence
+attached.
 
-Resolution order for each flag: explicit env var ("on"/"off") wins,
-then this file's measured verdict, then the conservative default
-(off). The file lives beside the package (repo-local) so the bench
-and the server see the same state; ``WEAVIATE_TPU_PERF_FLAGS``
-overrides the path.
+Resolution order for each flag (``resolve``): explicit env var wins —
+on/1/true enable, ANY other non-empty value disables (an operator who
+set something never gets surprised by a measured flip) — then an
+explicit per-index config opt-in, then the platform-matched measured
+verdict, then off.
+
+The file lives beside the package (repo-local, gitignored — verdicts
+are per-machine measurements, not source) so the bench and the server
+see the same state; ``WEAVIATE_TPU_PERF_FLAGS`` overrides the path.
+Reads are lock-free against an immutable snapshot re-stat'ed at most
+every few seconds — this sits on the query hot path.
 """
 
 from __future__ import annotations
@@ -22,11 +29,16 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Any, Optional
 
-_LOCK = threading.Lock()
-_CACHE: Optional[dict] = None
-_CACHE_KEY: tuple[str, float] = ("", -1.0)  # (path, mtime)
+_ON = ("on", "1", "true")
+
+_WRITE_LOCK = threading.Lock()
+# immutable (path, mtime, state, checked_at) snapshot swapped atomically;
+# readers never take a lock
+_SNAP: tuple[str, float, dict, float] = ("", -1.0, {}, 0.0)
+_RECHECK_S = 5.0
 
 
 def path() -> str:
@@ -38,78 +50,88 @@ def path() -> str:
 
 
 def load() -> dict:
-    """Cached read; re-reads when the file (or the configured path)
-    changes — a bench run may finish while a server is up."""
-    global _CACHE, _CACHE_KEY
+    """Lock-free cached read; re-stats at most every ``_RECHECK_S``
+    seconds (a bench run may finish while a server is up — per-query
+    freshness is not needed)."""
+    global _SNAP
     p = path()
+    snap = _SNAP
+    now = time.monotonic()
+    if snap[0] == p and now - snap[3] < _RECHECK_S:
+        return snap[2]
     try:
         mtime = os.stat(p).st_mtime
     except OSError:
+        _SNAP = (p, -1.0, {}, now)
         return {}
-    with _LOCK:
-        if _CACHE is not None and (p, mtime) == _CACHE_KEY:
-            return _CACHE
-        try:
-            with open(p) as f:
-                _CACHE = json.load(f)
-            _CACHE_KEY = (p, mtime)
-        except (OSError, ValueError):
-            return {}
-        return _CACHE
+    if snap[0] == p and snap[1] == mtime:
+        _SNAP = (p, mtime, snap[2], now)
+        return snap[2]
+    try:
+        with open(p) as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        state = {}
+    _SNAP = (p, mtime, state, now)
+    return state
 
 
 def flag(name: str, default: bool = False,
          platform: Optional[str] = None) -> bool:
     """Measured verdict for ``name``. When ``platform`` is given, a
-    verdict recorded on a DIFFERENT backend does not apply — a TPU win
-    must not route a CPU-backend process into device paths that were
-    never measured there."""
+    verdict recorded on a DIFFERENT (or unrecorded) backend does not
+    apply — a TPU win must not route a CPU-backend process into device
+    paths that were never measured there."""
     ent = load().get(name)
     if not isinstance(ent, dict):
         return default
-    rec_plat = ent.get("platform")
-    if platform is not None and rec_plat is not None \
-            and rec_plat != platform:
+    if platform is not None and ent.get("platform") != platform:
         return default
     return bool(ent.get("enabled", default))
 
 
 def resolve(name: str, env_value: str, config_on: bool = False,
             platform: Optional[str] = None) -> bool:
-    """The ONE resolution order every measured flag follows: explicit
-    env ("on"/"off") wins, then an explicit per-index config opt-in,
-    then the platform-matched measured verdict, else off."""
-    if env_value in ("on", "off"):
-        return env_value == "on"
+    """The ONE resolution order every measured flag follows (see module
+    docstring). A non-empty env value that isn't an on-synonym DISABLES:
+    the operator set something, so the measured verdict must not
+    override their intent."""
+    if env_value:
+        return env_value.lower() in _ON
     if config_on:
         return True
     return flag(name, default=False, platform=platform)
 
 
-def record(name: str, enabled: bool, evidence: dict) -> None:
-    """Merge one measured verdict (bench-side). Atomic replace; the
-    evidence dict should carry the deciding numbers (and the platform
-    it was measured on). BEST-EFFORT: the recording side channel must
-    never take down the measurement that produced it (read-only
-    checkouts just skip the write)."""
-    global _CACHE, _CACHE_KEY
+def record(name: str, enabled: bool, evidence: dict,
+           platform: Optional[str] = None) -> None:
+    """Merge one measured verdict (bench-side). ``platform`` is a
+    first-class parameter because ``flag``'s safety gate depends on it —
+    verdicts recorded without one apply NOWHERE when the reader passes a
+    platform. Atomic replace; BEST-EFFORT: the recording side channel
+    must never take down the measurement that produced it."""
+    global _SNAP
     p = path()
-    with _LOCK:
+    tmp = f"{p}.tmp.{os.getpid()}"
+    with _WRITE_LOCK:
         try:
             try:
                 with open(p) as f:
                     state = json.load(f)
             except (OSError, ValueError):
                 state = {}
-            state[name] = {"enabled": bool(enabled), **evidence}
-            tmp = f"{p}.tmp.{os.getpid()}"
+            state[name] = {"enabled": bool(enabled),
+                           "platform": platform, **evidence}
             with open(tmp, "w") as f:
-                json.dump(state, f, indent=2, sort_keys=True)
+                json.dump(state, f, indent=2, sort_keys=True, default=str)
             os.replace(tmp, p)
-            _CACHE = None
-            _CACHE_KEY = ("", -1.0)
-        except OSError as e:
+            _SNAP = ("", -1.0, {}, 0.0)
+        except Exception as e:
             import logging
 
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             logging.getLogger("weaviate_tpu.perf_flags").warning(
                 "could not record perf flag %s at %s: %s", name, p, e)
