@@ -125,6 +125,21 @@ def test_three_process_cluster_kill9_leader_recovers(tmp_path):
         for i in range(30):
             put_when_ready(i, addrs[i % 3])
 
+        # distributed scatter-gather search across real processes: the
+        # nearest neighbor of obj 5's exact vector is obj 5, from ANY
+        # coordinator; BM25 finds its title too
+        r = _send(addrs[1], {"type": "ctl_vector_search", "class": "Doc",
+                             "vector": [5.0, 1.0, 0.0, 0.5], "k": 3},
+                  timeout=10.0)
+        assert r.get("ok") and r["hits"], r
+        # vectors repeat every 7 ids, so the exact-match class is
+        # {5, 12, 19, ...} — any member at distance ~0 is correct
+        top = r["hits"][0]
+        assert int(top["uuid"][-12:]) % 7 == 5 and top["dist"] < 1e-5, top
+        r = _send(addrs[2], {"type": "ctl_bm25", "class": "Doc",
+                             "query": "obj", "k": 5}, timeout=10.0)
+        assert r.get("ok") and len(r["hits"]) == 5, r
+
         # -- kill -9 the raft LEADER mid-cluster --------------------------
         victim = _wait(lambda: _leader(addrs), msg="leader before kill")
         os.killpg(procs[victim].pid, signal.SIGKILL)

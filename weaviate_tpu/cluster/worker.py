@@ -17,8 +17,8 @@ Run:
 
 Besides the cluster-internal messages, the worker answers a small
 ``ctl_*`` control surface on the same transport (status, schema, puts,
-gets, counts, anti-entropy) so operators/tests can drive any node
-without a second RPC stack. Process-isolated kill -9 recovery is
+gets, scatter-gather vector + BM25 search, counts, anti-entropy) so
+operators/tests can drive any node without a second RPC stack. Process-isolated kill -9 recovery is
 exercised by ``tests/test_cluster_procs.py``.
 """
 
@@ -101,6 +101,19 @@ class WorkerControl:
     def ctl_local_count(self, msg):
         shard = self.node._local_shard(msg["class"], int(msg.get("shard", 0)))
         return {"count": shard.count()}
+
+    def ctl_vector_search(self, msg):
+        hits = self.node.vector_search(
+            msg["class"], np.asarray(msg["vector"], np.float32),
+            k=int(msg.get("k", 10)))
+        return {"hits": [{"uuid": o.uuid, "dist": float(d)}
+                         for o, d in hits]}
+
+    def ctl_bm25(self, msg):
+        hits = self.node.bm25_search(msg["class"], msg["query"],
+                                     k=int(msg.get("k", 10)))
+        return {"hits": [{"uuid": o.uuid, "score": float(s)}
+                         for o, s in hits]}
 
     def ctl_anti_entropy(self, msg):
         moved = self.node.anti_entropy_once(msg["class"])
