@@ -291,3 +291,76 @@ def test_rest_over_cluster_replicated_writes(tmp_path):
                 os.killpg(p.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+
+
+@pytest.mark.slow
+def test_live_replica_movement_across_processes(tmp_path):
+    """LIVE shard movement (bulk copy -> warming join -> verified-zero
+    anti-entropy -> atomic flip+warming-clear -> post-flip sweep -> src
+    drop) between REAL OS processes: the destination serves reads, the
+    source copy is gone, and routing reflects the move everywhere."""
+    ports = _free_ports(3)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    procs = {}
+    try:
+        for i, a in enumerate(addrs):
+            procs[a] = _spawn(a, addrs, str(tmp_path / f"n{i}"))
+        _wait(lambda: _leader(addrs), timeout=60, msg="leader election")
+        r = _send(addrs[0], {"type": "ctl_create_collection",
+                             "name": "Doc", "factor": 2}, timeout=10.0)
+        assert r.get("ok"), r
+
+        def put(i, coordinator):
+            r = _send(coordinator, {
+                "type": "ctl_put", "class": "Doc",
+                "uuid": f"00000000-0000-0000-0000-{i:012d}",
+                "properties": {"title": f"obj {i}"},
+                "vector": [float(i), 1.0, 0.0, 0.5]}, timeout=10.0)
+            assert r.get("ok"), (i, r)
+
+        for i in range(20):
+            _wait(lambda i=i: (put(i, addrs[i % 3]), True)[1], timeout=20,
+                  msg=f"put {i}")
+
+        r = _send(addrs[0], {"type": "ctl_replicas", "class": "Doc"},
+                  timeout=5.0)
+        assert r.get("ok"), r
+        reps = r["replicas"]
+        assert len(reps) == 2
+        src = reps[0]
+        dst = next(a for a in addrs if a not in reps)
+
+        # coordinate the move from the surviving replica (not src): the
+        # coordinator talks to both src and dst over real TCP
+        coord = reps[1]
+        r = _send(coord, {"type": "ctl_move_shard", "class": "Doc",
+                          "src": src, "dst": dst}, timeout=60.0)
+        assert r.get("ok"), r
+
+        # routing flipped everywhere (raft-replicated)
+        def routing_flipped():
+            views = [_send(a, {"type": "ctl_replicas", "class": "Doc"},
+                           timeout=5.0) for a in addrs]
+            return all(v.get("ok")
+                       and sorted(v["replicas"]) == sorted([reps[1], dst])
+                       and src not in v["read_replicas"] for v in views)
+        _wait(routing_flipped, timeout=30, msg="routing flip visible")
+
+        # the destination holds the full copy; the source dropped its
+        counts = {a: _send(a, {"type": "ctl_local_count", "class": "Doc"},
+                           timeout=5.0).get("count") for a in addrs}
+        assert counts[dst] == 20, counts
+        assert counts[src] == 0, counts
+
+        # QUORUM reads answer from the new replica set, via any node
+        r = _send(dst, {"type": "ctl_get", "class": "Doc",
+                        "uuid": "00000000-0000-0000-0000-000000000007"},
+                  timeout=10.0)
+        assert r.get("ok") and r.get("found"), r
+        assert r["properties"]["title"] == "obj 7"
+    finally:
+        for p in procs.values():
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass

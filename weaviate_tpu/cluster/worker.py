@@ -18,8 +18,9 @@ Run:
 Besides the cluster-internal messages, the worker answers a small
 ``ctl_*`` control surface on the same transport (status, schema, puts,
 gets, scatter-gather vector + BM25 search, counts, anti-entropy) so
-operators/tests can drive any node without a second RPC stack. Process-isolated kill -9 recovery is
-exercised by ``tests/test_cluster_procs.py``.
+operators/tests can drive any node without a second RPC stack.
+Process-isolated kill -9 recovery, replica movement, and distributed
+search are exercised by ``tests/test_cluster_procs.py``.
 """
 
 from __future__ import annotations
@@ -117,6 +118,17 @@ class WorkerControl:
 
     def ctl_anti_entropy(self, msg):
         moved = self.node.anti_entropy_once(msg["class"])
+        return {"moved": moved}
+
+    def ctl_replicas(self, msg):
+        state = self.node._state_for(msg["class"])
+        shard = int(msg.get("shard", 0))
+        return {"replicas": state.replicas(shard),
+                "read_replicas": state.read_replicas(shard)}
+
+    def ctl_move_shard(self, msg):
+        moved = self.node.move_shard(
+            msg["class"], int(msg.get("shard", 0)), msg["src"], msg["dst"])
         return {"moved": moved}
 
 

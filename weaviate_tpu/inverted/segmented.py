@@ -816,11 +816,18 @@ class SegmentedInvertedIndex(InvertedIndex):
         return p is not None and p.data_type in (DataType.INT,
                                                  DataType.INT_ARRAY)
 
+    def _num_caster(self, prop: str):
+        """float -> the schema's value type (INT props wrote ints; 2^53
+        exactness makes the round-trip lossless). The schema lookup is
+        hoisted OUT of the per-value loop — a 1M-doc aggregation must not
+        pay a property-schema scan per element."""
+        if self._int_typed(prop):
+            return lambda v: int(v) if float(v).is_integer() else float(v)
+        return float
+
     def _num_back(self, v: float, prop: str):
-        """Reconstructed float -> the schema's value type (INT props wrote
-        ints; 2^53 exactness makes the round-trip lossless)."""
-        return int(v) if self._int_typed(prop) and float(v).is_integer() \
-            else float(v)
+        """Scalar convenience over ``_num_caster`` — ONE coercion policy."""
+        return self._num_caster(prop)(v)
 
     def _tok_value(self, key: bytes, prop: str):
         """inv_ bucket key -> python value (None = not a value row).
@@ -883,7 +890,9 @@ class SegmentedInvertedIndex(InvertedIndex):
                 out.extend([val] * c)
         if self._range_indexed(prop):
             _, vals = self._range_values(prop, base, space)
-            out.extend(self._num_back(v, prop) for v in vals)
+            if len(vals):
+                cast = self._num_caster(prop)
+                out.extend(cast(v) for v in vals)
         return out
 
     def agg_group_table(self, group_by: str, props: list[str],
@@ -921,11 +930,11 @@ class SegmentedInvertedIndex(InvertedIndex):
             if self._range_indexed(p):
                 ids, vals = self._range_values(p, base, space)
                 if len(ids):
+                    cast = self._num_caster(p)
                     for g, gm in groups:
                         sel = gm[ids]
                         if sel.any():
-                            rows[g][p].extend(
-                                self._num_back(v, p) for v in vals[sel])
+                            rows[g][p].extend(cast(v) for v in vals[sel])
         return counts, rows
 
     # -- misc --------------------------------------------------------------
