@@ -37,6 +37,35 @@ def test_matches_xla_path_exact_ids():
                        atol=1e-2)
 
 
+def test_bucketed_fold_path_matches_exact():
+    """fold>1 engages the strided bucket index math that serves at 1M
+    scale (the fold-scaling rule keeps k=10 test corpora exact, so this
+    pins k=2: 16*64*4 = 4096 <= n → fold=16). Ids must reconstruct
+    through loc*folds + j exactly; top-1 is always exact under bucketing
+    and top-2 may only miss on a true bucket collision."""
+    q, corpus, sq = _data(n=4096, d=64, b=16, seed=3)
+    mask = np.ones(len(corpus), np.float32)
+    v, i = pallas_flat_topk(jnp.asarray(q), jnp.asarray(corpus),
+                            jnp.asarray(sq), jnp.asarray(mask), 2,
+                            chunk_size=2048, interpret=True)
+    gv, gi = flat_search(jnp.asarray(q), jnp.asarray(corpus), k=2,
+                         metric="l2-squared",
+                         corpus_sqnorms=jnp.asarray(sq), precision="bf16")
+    v, i, gv, gi = map(np.asarray, (v, i, gv, gi))
+    # the true nearest neighbor is each query's own corpus row; a
+    # bucket can hide at most the SECOND hit, never the first
+    assert (i[:, 0] == gi[:, 0]).all()
+    agree = np.mean([len(set(i[r]) & set(gi[r])) for r in range(16)]) / 2
+    assert agree >= 0.9
+    assert np.allclose(v[:, 0], gv[:, 0], rtol=1e-2, atol=1e-2)
+    # ids are in-range and distances are real recomputable values;
+    # atol scales with the bf16 cancellation error of q²-2qc+c² whose
+    # terms are O(d)=O(64) even when the distance itself is ~0
+    sel = corpus[i.reshape(-1)].reshape(16, 2, -1)
+    d_chk = ((q[:, None, :] - sel) ** 2).sum(-1)
+    assert np.allclose(d_chk, v, rtol=2e-2, atol=0.5)
+
+
 def test_mask_excludes_and_pads():
     q, corpus, sq = _data(n=2048)
     mask = np.zeros(len(corpus), np.float32)

@@ -118,7 +118,7 @@ class FlatIndex(VectorIndex):
                 and approx_recall > 0.0 and k <= 64):
             m = valid if allow is None else (valid & allow)
             csz = min(chunk or cap, cap)
-            if cap % csz == 0:
+            if pallas_flat.fits(cap, csz):
                 out = pallas_flat.try_flat_topk(
                     qj, corpus, sqnorms, m, k, chunk_size=csz)
                 if out is not None:
