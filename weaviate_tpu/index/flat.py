@@ -118,9 +118,25 @@ class FlatIndex(VectorIndex):
                 and approx_recall > 0.0 and k <= 64):
             m = valid if allow is None else (valid & allow)
             csz = min(chunk or cap, cap)
+            # live candidate count (host-tracked; allowlist cardinality
+            # counted on the host-side mask) sizes the kernel's fold so
+            # its collision-loss bound holds against the REAL population,
+            # not the padded capacity; power-of-4 bucketing keeps the
+            # static arg from recompiling per write. With a filter the
+            # true population is |valid & allow|, unknown host-side —
+            # use the inclusion-exclusion LOWER bound max(live+|allow|-
+            # cap, 1): fold sizing from an underestimate only ever
+            # degrades toward exact (fold=1) selection, never past the
+            # advertised loss bound
+            live = self.store.live_count
+            if allow_list is not None:
+                allow_n = int(np.count_nonzero(
+                    np.asarray(allow_list, bool)))
+                live = max(1, live + allow_n - cap)
             if pallas_flat.fits(cap, csz):
                 out = pallas_flat.try_flat_topk(
-                    qj, corpus, sqnorms, m, k, chunk_size=csz)
+                    qj, corpus, sqnorms, m, k, chunk_size=csz,
+                    live_rows=pallas_flat.bucket_live(live))
                 if out is not None:
                     d, ids = out
                     return SearchResult(

@@ -67,8 +67,20 @@ def usable() -> bool:
     return enabled() and not _disabled
 
 
+def bucket_live(live: int) -> int:
+    """Coarse power-of-4 bucket of a live-row count. Fold sizing only
+    needs the order of magnitude of the candidate population, and the
+    bucket is a static (compile-time) argument — bucketing means a
+    recompile happens when the live set crosses a 4x boundary, not on
+    every insert/delete."""
+    b = 1
+    while b * 4 <= max(1, live):
+        b *= 4
+    return b
+
+
 def try_flat_topk(queries, corpus, corpus_sqnorms, mask, k,
-                  chunk_size):
+                  chunk_size, live_rows=None):
     """pallas_flat_topk with one-shot failure latching: on the first
     error the kernel logs and disables itself for the process; callers
     fall back to the XLA path with no per-query retry tax."""
@@ -77,7 +89,8 @@ def try_flat_topk(queries, corpus, corpus_sqnorms, mask, k,
         return None
     try:
         return pallas_flat_topk(queries, corpus, corpus_sqnorms, mask,
-                                k, chunk_size=chunk_size)
+                                k, chunk_size=chunk_size,
+                                live_rows=live_rows)
     except Exception as e:
         _disabled = True
         import logging
@@ -161,7 +174,7 @@ def fits(n: int, chunk_size: int) -> bool:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "chunk_size", "interpret"))
+    static_argnames=("k", "chunk_size", "interpret", "live_rows"))
 def pallas_flat_topk(
     queries: jnp.ndarray,
     corpus: jnp.ndarray,
@@ -170,26 +183,33 @@ def pallas_flat_topk(
     k: int,
     chunk_size: int = 131072,
     interpret: bool = False,
+    live_rows: int | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """L2 top-k over the corpus. queries [B, D] fp32; corpus [N, D] (any
     float dtype; cast to bf16 in-kernel); corpus_sqnorms [N] fp32 (exact,
     fp32-computed); mask [N] float32 1/0. N must be a multiple of a
     ladder block <= chunk_size (pad with mask=0 rows). Selection is
     bucketed (see module docstring) — approximate in exactly the way
-    ``approx_min_k`` is. Returns ([B, k], [B, k])."""
+    ``approx_min_k`` is. ``live_rows`` (static; pass through
+    ``bucket_live``) is the unmasked candidate population — fold sizing
+    must bound collision loss against the LIVE rows, not the padded
+    corpus, or a heavily padded/filtered corpus gets ~fold x the
+    advertised loss. Returns ([B, k], [B, k])."""
     from jax.experimental import pallas as pl
 
     n, d_dim = corpus.shape
     b = queries.shape[0]
     block = _pick_block(n, chunk_size)
     grid = n // block
-    # fold width scales with corpus size so the bucket-collision loss is
-    # bounded: expected missed candidates ~ C(k,2)*(fold-1)/n, so capping
-    # fold at n/(64*k^2) keeps the loss under ~1% at any scale — tiny
-    # (test-sized) corpora degrade to fold=1, i.e. exact full-width
-    # extraction; 1M x k=10 serving gets the full 16x VPU saving
+    # fold width scales with the live candidate count so the
+    # bucket-collision loss is bounded: expected missed candidates
+    # ~ C(k,2)*(fold-1)/live, so capping fold at live/(64*k^2) keeps the
+    # loss under ~1% at any scale — tiny (test-sized) or heavily masked
+    # corpora degrade to fold=1, i.e. exact full-width extraction;
+    # 1M x k=10 serving gets the full 16x VPU saving
+    live = live_rows if live_rows else n
     fold = 16
-    while fold > 1 and (block // fold < k or fold * 64 * k * k > n):
+    while fold > 1 and (block // fold < k or fold * 64 * k * k > live):
         fold //= 2
     if block // fold < k:
         raise ValueError(f"k={k} exceeds block {block} bucket count")
