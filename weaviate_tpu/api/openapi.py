@@ -1,0 +1,363 @@
+"""OpenAPI 3 spec generated from the live routing table.
+
+Reference counterpart: the swagger document the reference embeds and
+serves (``adapters/handlers/rest/embedded_spec.go``, generated from
+``openapi-specs/schema.json``) — the artifact its client ecosystem is
+generated from. SURVEY §2.10 files this under "API surface artifacts —
+regenerate, don't port": here the spec is *derived from the actual
+werkzeug URL map at request time*, so a route added to ``RestAPI`` can
+never silently miss the published contract (a drift test asserts the
+inverse direction too). Served at ``/v1/.well-known/openapi``.
+
+Schemas follow the reference's model names (``Class``, ``Property``,
+``Object``, ``Tenant``, ``BackupCreateRequest``, …) so client
+generators targeting the reference map onto the same shapes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+_VAR = re.compile(r"<(?:[^:<>]+:)?([^<>]+)>")
+
+
+def _ref(name: str) -> dict:
+    return {"$ref": f"#/components/schemas/{name}"}
+
+
+def _arr(item: dict) -> dict:
+    return {"type": "array", "items": item}
+
+
+_STR = {"type": "string"}
+_INT = {"type": "integer"}
+_NUM = {"type": "number"}
+_BOOL = {"type": "boolean"}
+_OBJ = {"type": "object", "additionalProperties": True}
+
+# Component schemas, reference-aligned names (entities/models in the
+# reference swagger). Kept to the fields this server actually honors.
+SCHEMAS: dict[str, dict] = {
+    "Class": {
+        "type": "object",
+        "required": ["class"],
+        "properties": {
+            "class": _STR,
+            "description": _STR,
+            "vectorizer": _STR,
+            "vectorIndexType": {
+                "type": "string",
+                "enum": ["flat", "hnsw", "dynamic", "hfresh"],
+            },
+            "vectorIndexConfig": _OBJ,
+            "vectorConfig": _OBJ,
+            "invertedIndexConfig": _OBJ,
+            "replicationConfig": _OBJ,
+            "multiTenancyConfig": _OBJ,
+            "shardingConfig": _OBJ,
+            "moduleConfig": _OBJ,
+            "properties": _arr(_ref("Property")),
+        },
+    },
+    "Property": {
+        "type": "object",
+        "required": ["name", "dataType"],
+        "properties": {
+            "name": _STR,
+            "dataType": _arr(_STR),
+            "description": _STR,
+            "tokenization": _STR,
+            "indexFilterable": _BOOL,
+            "indexSearchable": _BOOL,
+            "indexRangeFilters": _BOOL,
+            "nestedProperties": _arr(_OBJ),
+            "moduleConfig": _OBJ,
+        },
+    },
+    "Schema": {
+        "type": "object",
+        "properties": {"classes": _arr(_ref("Class"))},
+    },
+    "Object": {
+        "type": "object",
+        "properties": {
+            "class": _STR,
+            "id": {"type": "string", "format": "uuid"},
+            "properties": _OBJ,
+            "vector": _arr(_NUM),
+            "vectors": {"type": "object",
+                        "additionalProperties": _arr(_NUM)},
+            "tenant": _STR,
+            "creationTimeUnix": _INT,
+            "lastUpdateTimeUnix": _INT,
+            "additional": _OBJ,
+        },
+    },
+    "ObjectsListResponse": {
+        "type": "object",
+        "properties": {
+            "objects": _arr(_ref("Object")),
+            "totalResults": _INT,
+        },
+    },
+    "BatchObjectsRequest": {
+        "type": "object",
+        "properties": {
+            "objects": _arr(_ref("Object")),
+            "fields": _arr(_STR),
+        },
+    },
+    "BatchObjectResponse": {
+        "type": "object",
+        "properties": {
+            "id": _STR,
+            "result": {
+                "type": "object",
+                "properties": {"status": _STR, "errors": _OBJ},
+            },
+        },
+    },
+    "BatchReference": {
+        "type": "object",
+        "required": ["from", "to"],
+        "properties": {"from": _STR, "to": _STR, "tenant": _STR},
+    },
+    "Tenant": {
+        "type": "object",
+        "required": ["name"],
+        "properties": {
+            "name": _STR,
+            "activityStatus": {
+                "type": "string",
+                "enum": ["HOT", "COLD", "FROZEN", "ACTIVE", "INACTIVE",
+                         "OFFLOADED"],
+            },
+        },
+    },
+    "GraphQLQuery": {
+        "type": "object",
+        "required": ["query"],
+        "properties": {
+            "query": _STR,
+            "operationName": _STR,
+            "variables": _OBJ,
+        },
+    },
+    "GraphQLResponse": {
+        "type": "object",
+        "properties": {"data": _OBJ, "errors": _arr(_OBJ)},
+    },
+    "Meta": {
+        "type": "object",
+        "properties": {
+            "hostname": _STR,
+            "version": _STR,
+            "modules": _OBJ,
+            "grpcMaxMessageSize": _INT,
+        },
+    },
+    "NodesStatusResponse": {
+        "type": "object",
+        "properties": {"nodes": _arr(_OBJ)},
+    },
+    "BackupCreateRequest": {
+        "type": "object",
+        "required": ["id"],
+        "properties": {
+            "id": _STR,
+            "include": _arr(_STR),
+            "exclude": _arr(_STR),
+            "config": _OBJ,
+        },
+    },
+    "BackupRestoreRequest": {
+        "type": "object",
+        "properties": {
+            "include": _arr(_STR),
+            "exclude": _arr(_STR),
+            "node_mapping": {"type": "object",
+                             "additionalProperties": _STR},
+            "config": _OBJ,
+        },
+    },
+    "BackupStatusResponse": {
+        "type": "object",
+        "properties": {"id": _STR, "status": _STR, "path": _STR,
+                       "error": _STR},
+    },
+    "Role": {
+        "type": "object",
+        "required": ["name"],
+        "properties": {"name": _STR, "permissions": _arr(_OBJ)},
+    },
+    "UserInfo": {
+        "type": "object",
+        "properties": {"username": _STR, "roles": _arr(_STR),
+                       "userType": _STR, "active": _BOOL},
+    },
+    "UserApiKey": {
+        "type": "object",
+        "properties": {"apikey": _STR},
+    },
+    "Classification": {
+        "type": "object",
+        "properties": {
+            "id": _STR,
+            "class": _STR,
+            "type": {"type": "string",
+                     "enum": ["knn", "zeroshot", "contextual"]},
+            "classifyProperties": _arr(_STR),
+            "basedOnProperties": _arr(_STR),
+            "settings": _OBJ,
+            "status": _STR,
+            "meta": _OBJ,
+        },
+    },
+    "ErrorResponse": {
+        "type": "object",
+        "properties": {
+            "error": _arr({
+                "type": "object",
+                "properties": {"message": _STR},
+            }),
+        },
+    },
+}
+
+# endpoint name -> (summary, request schema name | None,
+#                   response schema name | None). Endpoints not listed
+# still appear in the spec (derived from the URL map) with a generic
+# JSON body/response.
+DOCS: dict[str, tuple[str, str | None, str | None]] = {
+    "meta": ("Server metadata and module catalog", None, "Meta"),
+    "ready": ("Readiness probe", None, None),
+    "live": ("Liveness probe", None, None),
+    "openapi": ("This document", None, None),
+    "schema": ("List collections / create a collection", "Class",
+               "Schema"),
+    "schema_class": ("Get / update / delete one collection", "Class",
+                     "Class"),
+    "schema_properties": ("Add a property to a collection", "Property",
+                          "Class"),
+    "tenants": ("List / add / update / delete tenants", "Tenant",
+                "Tenant"),
+    "objects": ("List objects / create an object", "Object", "Object"),
+    "object": ("Get / replace / merge / delete one object", "Object",
+               "Object"),
+    "batch_objects": ("Batch-insert objects", "BatchObjectsRequest",
+                      "BatchObjectResponse"),
+    "batch_references": ("Batch-add cross-references",
+                         "BatchReference", "BatchObjectResponse"),
+    "object_references": ("Mutate one object's reference property",
+                          "BatchReference", None),
+    "graphql": ("GraphQL Get / Aggregate / Explore", "GraphQLQuery",
+                "GraphQLResponse"),
+    "nodes": ("Per-node status (shards, stats, versions)", None,
+              "NodesStatusResponse"),
+    "backup_create": ("Start a backup to a backend",
+                      "BackupCreateRequest", "BackupStatusResponse"),
+    "backup_status": ("Backup status", None, "BackupStatusResponse"),
+    "backup_restore": ("Restore a backup", "BackupRestoreRequest",
+                       "BackupStatusResponse"),
+    "authz_roles": ("List / create RBAC roles", "Role", "Role"),
+    "authz_role": ("Get / delete one role", None, "Role"),
+    "authz_assign": ("Assign roles to a user", None, None),
+    "authz_revoke": ("Revoke roles from a user", None, None),
+    "authz_user_roles": ("Roles assigned to a user", None, "Role"),
+    "users_own_info": ("Identity + roles of the calling principal",
+                       None, "UserInfo"),
+    "users_db": ("List dynamic db users", None, "UserInfo"),
+    "users_db_user": ("Create / get / delete a dynamic db user", None,
+                      "UserApiKey"),
+    "users_db_rotate": ("Rotate a db user's API key", None,
+                        "UserApiKey"),
+    "users_db_activate": ("Activate a db user", None, None),
+    "users_db_deactivate": ("Deactivate a db user", None, None),
+    "classifications": ("Start a classification job", "Classification",
+                        "Classification"),
+    "classification": ("Classification job status", None,
+                       "Classification"),
+}
+
+_TAGS = (
+    ("schema", ("schema", "tenants")),
+    ("objects", ("objects", "object", "batch", "references")),
+    ("graphql", ("graphql",)),
+    ("backups", ("backup",)),
+    ("authz", ("authz", "users")),
+    ("classifications", ("classification",)),
+    ("meta", ("meta", "ready", "live", "nodes", "openapi")),
+)
+
+
+def _tag(endpoint: str) -> str:
+    for tag, prefixes in _TAGS:
+        if any(endpoint.startswith(p) for p in prefixes):
+            return tag
+    return "ops"
+
+
+def build_spec(url_map, version: str) -> dict[str, Any]:
+    """OpenAPI 3.0 document derived from a werkzeug ``Map``. Every rule
+    is included; ``DOCS`` upgrades the documented ones with model
+    schemas."""
+    paths: dict[str, dict] = {}
+    for rule in url_map.iter_rules():
+        path = _VAR.sub(r"{\1}", rule.rule)
+        item = paths.setdefault(path, {})
+        params = [
+            {"name": m.group(1), "in": "path", "required": True,
+             "schema": _STR}
+            for m in _VAR.finditer(rule.rule)
+        ]
+        summary, req_schema, resp_schema = DOCS.get(
+            rule.endpoint, (rule.endpoint.replace("_", " "), None, None))
+        for method in sorted(rule.methods - {"HEAD", "OPTIONS"}):
+            op: dict[str, Any] = {
+                "operationId": f"{rule.endpoint}.{method.lower()}",
+                "tags": [_tag(rule.endpoint)],
+                "summary": summary,
+                "responses": {
+                    "200": {
+                        "description": "OK",
+                        "content": {"application/json": {"schema": (
+                            _ref(resp_schema) if resp_schema else _OBJ)}},
+                    },
+                    "422": {
+                        "description": "Invalid request",
+                        "content": {"application/json": {
+                            "schema": _ref("ErrorResponse")}},
+                    },
+                },
+            }
+            if params:
+                op["parameters"] = params
+            if method in ("POST", "PUT", "PATCH") and req_schema:
+                op["requestBody"] = {
+                    "required": True,
+                    "content": {"application/json": {
+                        "schema": _ref(req_schema)}},
+                }
+            item[method.lower()] = op
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "weaviate-tpu",
+            "version": version,
+            "description": (
+                "TPU-native vector database speaking the reference "
+                "wire contract (REST + GraphQL + gRPC weaviate.v1)."),
+        },
+        "paths": dict(sorted(paths.items())),
+        "components": {
+            "schemas": SCHEMAS,
+            "securitySchemes": {
+                "bearer": {"type": "http", "scheme": "bearer"},
+                "oidc": {"type": "openIdConnect",
+                         "openIdConnectUrl":
+                             "/v1/.well-known/openid-configuration"},
+            },
+        },
+        "security": [{"bearer": []}],
+    }

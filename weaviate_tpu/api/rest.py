@@ -175,6 +175,8 @@ class RestAPI:
             Rule("/v1/meta", endpoint="meta", methods=["GET"]),
             Rule("/v1/.well-known/ready", endpoint="ready", methods=["GET"]),
             Rule("/v1/.well-known/live", endpoint="live", methods=["GET"]),
+            Rule("/v1/.well-known/openapi", endpoint="openapi",
+                 methods=["GET"]),
             Rule("/v1/schema", endpoint="schema", methods=["GET", "POST"]),
             Rule("/v1/schema/<cls>", endpoint="schema_class",
                  methods=["GET", "PUT", "DELETE"]),
@@ -348,6 +350,20 @@ class RestAPI:
             "version": __version__,
             "modules": self.db.modules.list() if self.db.modules else {},
         })
+
+    def on_openapi(self, request):
+        """OpenAPI 3 spec derived from the LIVE url map (api/openapi.py)
+        — the reference serves its generated swagger the same way
+        (``embedded_spec.go``); here the routing table is the source of
+        truth so route/spec drift is impossible. Built once: the url
+        map is fixed after __init__."""
+        spec = getattr(self, "_openapi_spec", None)
+        if spec is None:
+            from weaviate_tpu.api.openapi import build_spec
+
+            spec = self._openapi_spec = build_spec(
+                self.url_map, __version__)
+        return _json_response(spec)
 
     def on_ready(self, request):
         return Response(status=200)
