@@ -1,0 +1,157 @@
+"""End-to-end tests of the pythonic client against a live server —
+the analogue of the reference's client-driven acceptance suites
+(``test/acceptance_with_python``)."""
+
+import numpy as np
+import pytest
+
+import weaviate_tpu.client as wvt
+from weaviate_tpu.api.rest import RestAPI
+from weaviate_tpu.core.db import DB
+
+
+@pytest.fixture
+def client(tmp_dbdir):
+    db = DB(tmp_dbdir)
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0, background=True)
+    c = wvt.connect(f"http://127.0.0.1:{srv.server_port}")
+    yield c
+    api.shutdown()
+    db.close()
+
+
+def _seed(client, n=24, dims=8):
+    col = client.collections.create(
+        "Article",
+        properties=[("title", "text"), ("wordCount", "int")],
+        vector_index_type="flat", distance="l2-squared")
+    objs = []
+    for i in range(n):
+        vec = np.zeros(dims, np.float32)
+        vec[i % dims] = 1.0
+        objs.append({
+            "id": f"00000000-0000-0000-0000-{i:012d}",
+            "properties": {"title": f"article number {i}",
+                           "wordCount": i * 10},
+            "vector": vec,
+        })
+    res = col.data.insert_many(objs)
+    assert all(r["result"]["status"] == "SUCCESS" for r in res)
+    return col
+
+
+def test_health_meta_openapi(client):
+    assert client.is_ready() and client.is_live()
+    assert "version" in client.meta()
+    assert client.openapi()["openapi"].startswith("3.")
+
+
+def test_collection_lifecycle(client):
+    col = _seed(client)
+    assert client.collections.exists("Article")
+    assert client.collections.list_all() == ["Article"]
+    cfg = col.config()
+    assert cfg["class"] == "Article"
+    col.add_property("tag", "text")
+    assert any(p["name"] == "tag"
+               for p in col.config()["properties"])
+    client.collections.delete("Article")
+    assert not client.collections.exists("Article")
+
+
+def test_near_vector_and_filters(client):
+    col = _seed(client)
+    q = np.zeros(8, np.float32)
+    q[2] = 1.0
+    hits = col.query.near_vector(q, limit=4,
+                                 return_properties=["wordCount"])
+    assert len(hits) == 4
+    assert hits[0].distance == pytest.approx(0.0)
+    assert hits[0].properties["wordCount"] % 80 == 20
+    # filtered: wordCount < 100 via the builder
+    f = wvt.Filter("wordCount") < 100
+    hits = col.query.near_vector(q, limit=10, filters=f,
+                                 return_properties=["wordCount"])
+    assert hits and all(h.properties["wordCount"] < 100 for h in hits)
+    # combinator
+    f2 = (wvt.Filter("wordCount") >= 40) & (wvt.Filter("wordCount") < 90)
+    hits = col.query.fetch_objects(filters=f2,
+                                   return_properties=["wordCount"])
+    assert {h.properties["wordCount"] for h in hits} == {40, 50, 60, 70, 80}
+
+
+def test_bm25_hybrid_sort(client):
+    col = _seed(client)
+    hits = col.query.bm25("article", limit=5,
+                          return_properties=["title"])
+    assert len(hits) == 5 and hits[0].score is not None
+    hits = col.query.hybrid("article number",
+                            vector=[1.0] + [0.0] * 7, alpha=0.5,
+                            limit=5, return_properties=["title"])
+    assert len(hits) == 5
+    hits = col.query.fetch_objects(
+        sort=wvt.Sort("wordCount", ascending=False), limit=3,
+        return_properties=["wordCount"])
+    # global top-3, not "first page reordered" (explorer fetches the
+    # full set before an unranked sort)
+    assert [h.properties["wordCount"] for h in hits] == [230, 220, 210]
+    # offset pages once, after sort (regression: it used to apply twice)
+    hits = col.query.fetch_objects(
+        sort=wvt.Sort("wordCount", ascending=False), limit=3, offset=3,
+        return_properties=["wordCount"])
+    assert [h.properties["wordCount"] for h in hits] == [200, 190, 180]
+    hits = col.query.fetch_objects(limit=5, offset=20)
+    assert len(hits) == 4
+
+
+def test_object_crud(client):
+    col = _seed(client, n=4)
+    uid = col.data.insert({"title": "fresh", "wordCount": 7},
+                          vector=np.ones(8, np.float32))
+    assert col.data.exists(uid)
+    got = col.data.get_by_id(uid)
+    assert got["properties"]["title"] == "fresh"
+    col.data.update(uid, {"title": "stale"})
+    assert col.data.get_by_id(uid)["properties"]["title"] == "stale"
+    col.data.delete_by_id(uid)
+    assert not col.data.exists(uid)
+    assert col.data.get_by_id("00000000-0000-0000-0000-00000000dead") is None
+
+
+def test_aggregate(client):
+    col = _seed(client)
+    out = col.aggregate.over_all(
+        total_count=True, fields={"wordCount": ["mean", "maximum"]})
+    row = out[0]
+    assert row["meta"]["count"] == 24
+    assert row["wordCount"]["maximum"] == 230
+    filtered = col.aggregate.over_all(
+        total_count=True, filters=wvt.Filter("wordCount") < 100)
+    assert filtered[0]["meta"]["count"] == 10
+
+
+def test_tenants(client):
+    col = client.collections.create(
+        "Private", properties=[("note", "text")],
+        multi_tenancy=True)
+    col.tenants.create("alice", "bob")
+    names = {t["name"] for t in col.tenants.list()}
+    assert names == {"alice", "bob"}
+    a = col.with_tenant("alice")
+    a.data.insert({"note": "mine"}, vector=np.ones(4, np.float32),
+                  uuid="00000000-0000-0000-0000-0000000000aa")
+    assert a.data.exists("00000000-0000-0000-0000-0000000000aa")
+    b = col.with_tenant("bob")
+    assert not b.data.exists("00000000-0000-0000-0000-0000000000aa")
+    # tenant-scoped update/replace ride the tenant query param
+    a.data.update("00000000-0000-0000-0000-0000000000aa",
+                  {"note": "updated"})
+    got = a.data.get_by_id("00000000-0000-0000-0000-0000000000aa")
+    assert got["properties"]["note"] == "updated"
+
+
+def test_api_error_shape(client):
+    with pytest.raises(wvt.ApiError) as ei:
+        client.collections.get("Nope").query.bm25("x")
+    assert ei.value.status in (404, 422)

@@ -71,6 +71,20 @@ def test_path_params_declared(api):
             assert got == want, f"{path}: params {got} != {want}"
 
 
+def test_method_shapes(api):
+    spec = build_spec(api.url_map, "test")
+    tenants = spec["paths"]["/v1/schema/{cls}/tenants"]
+    body = tenants["post"]["requestBody"]["content"]["application/json"]
+    assert body["schema"]["type"] == "array"
+    objs_get = spec["paths"]["/v1/objects"]["get"]["responses"]["200"]
+    assert objs_get["content"]["application/json"]["schema"]["$ref"] \
+        .endswith("ObjectsListResponse")
+    refs = spec["paths"][
+        "/v1/objects/{cls}/{uuid}/references/{prop}"]["post"]
+    assert refs["requestBody"]["content"]["application/json"][
+        "schema"]["$ref"].endswith("SingleRef")
+
+
 def test_served_over_http(tmp_dbdir):
     db = DB(tmp_dbdir)
     api = RestAPI(db)

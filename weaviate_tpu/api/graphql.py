@@ -33,6 +33,9 @@ from weaviate_tpu.query import (
     TokenParams,
 )
 
+# reference GraphQL aggregation field names -> aggregator native keys
+_AGG_ALIASES = {"maximum": "max", "minimum": "min"}
+
 # ---------------------------------------------------------------------------
 # Lexer / parser
 # ---------------------------------------------------------------------------
@@ -388,6 +391,9 @@ class GraphQLExecutor:
                 if h.get("fusionType") == "rankedFusion" else "relativeScoreFusion",
                 properties=h.get("properties"),
             )
+            if h.get("targetVectors"):
+                # reference hybrid accepts targetVectors like near*
+                p.target_vector = h["targetVectors"][0]
         if "sort" in args:
             s = args["sort"]
             entries = s if isinstance(s, list) else [s]
@@ -565,6 +571,12 @@ class GraphQLExecutor:
                                 "topOccurrences", [])
                         elif pf.name in pagg:
                             rendered[pf.name] = pagg[pf.name]
+                        elif pf.name in _AGG_ALIASES \
+                                and _AGG_ALIASES[pf.name] in pagg:
+                            # reference GraphQL spells these maximum/
+                            # minimum (graphql/local/aggregate); the
+                            # aggregator's native keys stay max/min
+                            rendered[pf.name] = pagg[_AGG_ALIASES[pf.name]]
                     entry[pname] = rendered
                 return entry
 

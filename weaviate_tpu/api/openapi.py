@@ -123,6 +123,11 @@ SCHEMAS: dict[str, dict] = {
         "required": ["from", "to"],
         "properties": {"from": _STR, "to": _STR, "tenant": _STR},
     },
+    "SingleRef": {
+        "type": "object",
+        "required": ["beacon"],
+        "properties": {"beacon": _STR, "href": _STR},
+    },
     "Tenant": {
         "type": "object",
         "required": ["name"],
@@ -226,9 +231,9 @@ SCHEMAS: dict[str, dict] = {
 }
 
 # endpoint name -> (summary, request schema name | None,
-#                   response schema name | None). Endpoints not listed
-# still appear in the spec (derived from the URL map) with a generic
-# JSON body/response.
+#                   response schema name | None). A "[]Name" prefix
+# means "array of Name". Endpoints not listed still appear in the spec
+# (derived from the URL map) with a generic JSON body/response.
 DOCS: dict[str, tuple[str, str | None, str | None]] = {
     "meta": ("Server metadata and module catalog", None, "Meta"),
     "ready": ("Readiness probe", None, None),
@@ -240,8 +245,8 @@ DOCS: dict[str, tuple[str, str | None, str | None]] = {
                      "Class"),
     "schema_properties": ("Add a property to a collection", "Property",
                           "Class"),
-    "tenants": ("List / add / update / delete tenants", "Tenant",
-                "Tenant"),
+    "tenants": ("List / add / update / delete tenants", "[]Tenant",
+                "[]Tenant"),
     "objects": ("List objects / create an object", "Object", "Object"),
     "object": ("Get / replace / merge / delete one object", "Object",
                "Object"),
@@ -250,7 +255,7 @@ DOCS: dict[str, tuple[str, str | None, str | None]] = {
     "batch_references": ("Batch-add cross-references",
                          "BatchReference", "BatchObjectResponse"),
     "object_references": ("Mutate one object's reference property",
-                          "BatchReference", None),
+                          "SingleRef", None),
     "graphql": ("GraphQL Get / Aggregate / Explore", "GraphQLQuery",
                 "GraphQLResponse"),
     "nodes": ("Per-node status (shards, stats, versions)", None,
@@ -278,6 +283,16 @@ DOCS: dict[str, tuple[str, str | None, str | None]] = {
                         "Classification"),
     "classification": ("Classification job status", None,
                        "Classification"),
+}
+
+# (endpoint, METHOD) -> (request schema, response schema) overrides for
+# endpoints whose shapes differ per method
+_METHOD_DOCS: dict[tuple[str, str], tuple[str | None, str | None]] = {
+    ("objects", "GET"): (None, "ObjectsListResponse"),
+    ("batch_objects", "POST"): ("BatchObjectsRequest",
+                                "[]BatchObjectResponse"),
+    ("batch_references", "POST"): ("[]BatchReference",
+                                   "[]BatchObjectResponse"),
 }
 
 _TAGS = (
@@ -311,9 +326,19 @@ def build_spec(url_map, version: str) -> dict[str, Any]:
              "schema": _STR}
             for m in _VAR.finditer(rule.rule)
         ]
-        summary, req_schema, resp_schema = DOCS.get(
+        summary, req_default, resp_default = DOCS.get(
             rule.endpoint, (rule.endpoint.replace("_", " "), None, None))
+
+        def _schema(name: str | None) -> dict:
+            if not name:
+                return _OBJ
+            if name.startswith("[]"):
+                return _arr(_ref(name[2:]))
+            return _ref(name)
+
         for method in sorted(rule.methods - {"HEAD", "OPTIONS"}):
+            req_schema, resp_schema = _METHOD_DOCS.get(
+                (rule.endpoint, method), (req_default, resp_default))
             op: dict[str, Any] = {
                 "operationId": f"{rule.endpoint}.{method.lower()}",
                 "tags": [_tag(rule.endpoint)],
@@ -321,8 +346,8 @@ def build_spec(url_map, version: str) -> dict[str, Any]:
                 "responses": {
                     "200": {
                         "description": "OK",
-                        "content": {"application/json": {"schema": (
-                            _ref(resp_schema) if resp_schema else _OBJ)}},
+                        "content": {"application/json": {
+                            "schema": _schema(resp_schema)}},
                     },
                     "422": {
                         "description": "Invalid request",
@@ -337,7 +362,7 @@ def build_spec(url_map, version: str) -> dict[str, Any]:
                 op["requestBody"] = {
                     "required": True,
                     "content": {"application/json": {
-                        "schema": _ref(req_schema)}},
+                        "schema": _schema(req_schema)}},
                 }
             item[method.lower()] = op
     return {

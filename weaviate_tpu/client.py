@@ -1,0 +1,634 @@
+"""Pythonic client for a running weaviate-tpu server.
+
+Reference counterpart: the generated client ecosystem (``client/`` —
+go-swagger Go client; the public v4 Python client's collections API).
+SURVEY §2.10 files clients under "regenerate, don't port": this module
+is hand-written against the server's REST + GraphQL surface (the one
+``/v1/.well-known/openapi`` publishes) with the v4 client's ergonomics
+
+    import weaviate_tpu.client as wvt
+    client = wvt.connect("http://127.0.0.1:8080", api_key="secret")
+    col = client.collections.create("Article", properties=[
+        ("title", "text"), ("wordCount", "int")])
+    col.data.insert_many([{"properties": {...}, "vector": [...]}, ...])
+    hits = col.query.near_vector([...], limit=5,
+                                 filters=wvt.Filter("wordCount") < 100)
+    client.close()
+
+Everything rides stdlib ``urllib`` — no third-party HTTP dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Iterable, Optional, Sequence
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+# -- GraphQL serialization -------------------------------------------------
+
+class _Enum(str):
+    """A bare (unquoted) GraphQL token, e.g. an operator or sort order."""
+
+
+def _gql(v: Any) -> str:
+    if isinstance(v, _Enum):
+        return str(v)
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, str):
+        return json.dumps(v)
+    if isinstance(v, dict):
+        inner = ", ".join(f"{k}: {_gql(x)}" for k, x in v.items())
+        return "{" + inner + "}"
+    if isinstance(v, (list, tuple)):
+        return "[" + ", ".join(_gql(x) for x in v) + "]"
+    if hasattr(v, "tolist"):  # numpy array / scalar
+        return _gql(v.tolist())
+    raise TypeError(f"cannot serialize {type(v).__name__} to GraphQL")
+
+
+class Filter:
+    """Builder for GraphQL ``where`` arguments.
+
+    ``Filter("wordCount") < 100`` / ``.equal`` / ``.like`` /
+    ``.contains_any`` ..., combined with ``&`` and ``|``.
+    """
+
+    def __init__(self, *path: str):
+        self.path = list(path)
+        self._clause: Optional[dict] = None
+
+    # comparison builders ---------------------------------------------------
+    def _value_key(self, value: Any) -> str:
+        if isinstance(value, bool):
+            return "valueBoolean"
+        if isinstance(value, int):
+            return "valueInt"
+        if isinstance(value, float):
+            return "valueNumber"
+        if isinstance(value, (list, tuple)):
+            return self._value_key(value[0]) if value else "valueText"
+        return "valueText"
+
+    def _cmp(self, op: str, value: Any) -> "Filter":
+        f = Filter(*self.path)
+        f._clause = {"operator": _Enum(op), "path": self.path,
+                     self._value_key(value): value}
+        return f
+
+    def equal(self, v):
+        return self._cmp("Equal", v)
+
+    def not_equal(self, v):
+        return self._cmp("NotEqual", v)
+
+    def less_than(self, v):
+        return self._cmp("LessThan", v)
+
+    def less_or_equal(self, v):
+        return self._cmp("LessThanEqual", v)
+
+    def greater_than(self, v):
+        return self._cmp("GreaterThan", v)
+
+    def greater_or_equal(self, v):
+        return self._cmp("GreaterThanEqual", v)
+
+    def like(self, v):
+        return self._cmp("Like", v)
+
+    def contains_any(self, v):
+        return self._cmp("ContainsAny", list(v))
+
+    def contains_all(self, v):
+        return self._cmp("ContainsAll", list(v))
+
+    def is_none(self, v: bool = True):
+        return self._cmp("IsNull", bool(v))
+
+    def within_geo_range(self, lat: float, lon: float, max_km: float):
+        f = Filter(*self.path)
+        f._clause = {"operator": _Enum("WithinGeoRange"), "path": self.path,
+                     "valueGeoRange": {
+                         "geoCoordinates": {"latitude": lat,
+                                            "longitude": lon},
+                         "distance": {"max": max_km * 1000.0}}}
+        return f
+
+    __lt__ = less_than
+    __le__ = less_or_equal
+    __gt__ = greater_than
+    __ge__ = greater_or_equal
+
+    def __eq__(self, v):  # type: ignore[override]
+        return self.equal(v)
+
+    def __ne__(self, v):  # type: ignore[override]
+        return self.not_equal(v)
+
+    __hash__ = None  # rich comparisons return Filters, not bools
+
+    # combinators -----------------------------------------------------------
+    def _bool(self, op: str, other: "Filter") -> "Filter":
+        if self._clause is None or other._clause is None:
+            raise ValueError("combine completed filters, e.g. "
+                             "(Filter('a') > 1) & (Filter('b').like('x'))")
+        f = Filter()
+        f._clause = {"operator": _Enum(op),
+                     "operands": [self._clause, other._clause]}
+        return f
+
+    def __and__(self, other):
+        return self._bool("And", other)
+
+    def __or__(self, other):
+        return self._bool("Or", other)
+
+    def to_dict(self) -> dict:
+        if self._clause is None:
+            raise ValueError(f"incomplete filter on path {self.path}")
+        return self._clause
+
+
+class Sort:
+    def __init__(self, *path: str, ascending: bool = True):
+        self.path = list(path)
+        self.ascending = ascending
+
+    def to_dict(self) -> dict:
+        return {"path": self.path,
+                "order": _Enum("asc" if self.ascending else "desc")}
+
+
+# -- transport -------------------------------------------------------------
+
+class _Http:
+    def __init__(self, base: str, api_key: Optional[str], timeout: float):
+        self.base = base.rstrip("/")
+        self.timeout = timeout
+        self.headers = {"Content-Type": "application/json"}
+        if api_key:
+            self.headers["Authorization"] = f"Bearer {api_key}"
+
+    def call(self, method: str, path: str, body: Any = None,
+             params: Optional[dict] = None) -> Any:
+        url = self.base + path
+        if params:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in params.items() if v not in (None, "")})
+        req = urllib.request.Request(
+            url, method=method,
+            data=None if body is None else json.dumps(body).encode(),
+            headers=self.headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                raw = r.read()
+                return json.loads(raw) if raw else None
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            try:
+                msg = json.loads(raw)["error"][0]["message"]
+            except Exception:
+                msg = raw.decode(errors="replace")[:300]
+            raise ApiError(e.code, msg) from None
+
+
+# -- query results ---------------------------------------------------------
+
+class QueryResult:
+    """One Get hit: ``properties`` plus the ``_additional`` fields."""
+
+    __slots__ = ("properties", "uuid", "distance", "score", "vector",
+                 "additional")
+
+    def __init__(self, row: dict):
+        add = row.pop("_additional", {}) or {}
+        self.properties = row
+        self.uuid = add.get("id")
+        self.distance = add.get("distance")
+        self.score = add.get("score")
+        self.vector = add.get("vector")
+        self.additional = add
+
+    def __repr__(self):
+        return (f"QueryResult(uuid={self.uuid!r}, "
+                f"distance={self.distance}, score={self.score}, "
+                f"properties={self.properties!r})")
+
+
+class _Query:
+    def __init__(self, http: _Http, name: str, tenant: str = ""):
+        self._http = http
+        self._name = name
+        self._tenant = tenant
+
+    def _run(self, args: dict, return_properties: Optional[Sequence[str]],
+             include: Sequence[str]) -> list[QueryResult]:
+        if self._tenant:
+            args = {**args, "tenant": self._tenant}
+        arg_s = ", ".join(f"{k}: {_gql(v)}" for k, v in args.items())
+        props = " ".join(return_properties or ())
+        add = " ".join(dict.fromkeys(("id", *include)))
+        q = (f"{{ Get {{ {self._name}({arg_s}) "
+             f"{{ {props} _additional {{ {add} }} }} }} }}")
+        out = self._http.call("POST", "/v1/graphql", {"query": q})
+        if out.get("errors"):
+            raise ApiError(422, json.dumps(out["errors"])[:300])
+        rows = (out.get("data") or {}).get("Get", {}).get(self._name, [])
+        return [QueryResult(r) for r in rows]
+
+    @staticmethod
+    def _common(args: dict, filters, limit, offset, autocut,
+                sort) -> dict:
+        if filters is not None:
+            args["where"] = (filters.to_dict()
+                             if isinstance(filters, Filter) else filters)
+        if limit is not None:
+            args["limit"] = limit
+        if offset:
+            args["offset"] = offset
+        if autocut is not None:
+            args["autocut"] = autocut
+        if sort is not None:
+            sorts = sort if isinstance(sort, (list, tuple)) else [sort]
+            args["sort"] = [s.to_dict() if isinstance(s, Sort) else s
+                            for s in sorts]
+        return args
+
+    def near_vector(self, vector, *, limit: int = 10, certainty=None,
+                    distance=None, filters=None, offset: int = 0,
+                    autocut=None, sort=None, target_vector: str = "",
+                    return_properties: Optional[Sequence[str]] = None,
+                    include: Sequence[str] = ("distance",)):
+        nv: dict = {"vector": vector}
+        if certainty is not None:
+            nv["certainty"] = certainty
+        if distance is not None:
+            nv["distance"] = distance
+        if target_vector:
+            # the server reads targetVectors nested in the operator
+            # (graphql.py _params_from_args), matching the reference
+            nv["targetVectors"] = [target_vector]
+        args = self._common({"nearVector": nv}, filters, limit, offset,
+                            autocut, sort)
+        return self._run(args, return_properties, include)
+
+    def near_object(self, uuid: str, *, limit: int = 10, filters=None,
+                    offset: int = 0, autocut=None, sort=None,
+                    return_properties: Optional[Sequence[str]] = None,
+                    include: Sequence[str] = ("distance",)):
+        args = self._common({"nearObject": {"id": uuid}}, filters, limit,
+                            offset, autocut, sort)
+        return self._run(args, return_properties, include)
+
+    def near_text(self, query: str, *, limit: int = 10, certainty=None,
+                  distance=None, filters=None, offset: int = 0,
+                  autocut=None, sort=None, target_vector: str = "",
+                  return_properties: Optional[Sequence[str]] = None,
+                  include: Sequence[str] = ("distance",)):
+        nt: dict = {"concepts": [query]}
+        if certainty is not None:
+            nt["certainty"] = certainty
+        if distance is not None:
+            nt["distance"] = distance
+        if target_vector:
+            nt["targetVectors"] = [target_vector]
+        args = self._common({"nearText": nt}, filters, limit, offset,
+                            autocut, sort)
+        return self._run(args, return_properties, include)
+
+    def bm25(self, query: str, *, query_properties=None, limit: int = 10,
+             filters=None, offset: int = 0, autocut=None, sort=None,
+             return_properties=None, include=("score",)):
+        b: dict = {"query": query}
+        if query_properties:
+            b["properties"] = list(query_properties)
+        args = self._common({"bm25": b}, filters, limit, offset, autocut,
+                            sort)
+        return self._run(args, return_properties, include)
+
+    def hybrid(self, query: str, *, vector=None, alpha: float = 0.5,
+               fusion_type: Optional[str] = None, limit: int = 10,
+               filters=None, offset: int = 0, autocut=None,
+               target_vector: str = "", return_properties=None,
+               include=("score",)):
+        h: dict = {"query": query, "alpha": alpha}
+        if vector is not None:
+            h["vector"] = vector
+        if fusion_type:
+            h["fusionType"] = _Enum(fusion_type)
+        if target_vector:
+            h["targetVectors"] = [target_vector]
+        args = self._common({"hybrid": h}, filters, limit, offset,
+                            autocut, None)
+        return self._run(args, return_properties, include)
+
+    def fetch_objects(self, *, limit: int = 25, filters=None,
+                      offset: int = 0, sort=None, return_properties=None,
+                      include: Sequence[str] = ()):
+        args = self._common({}, filters, limit, offset, None, sort)
+        return self._run(args, return_properties, include)
+
+
+class _Aggregate:
+    def __init__(self, http: _Http, name: str, tenant: str = ""):
+        self._http = http
+        self._name = name
+        self._tenant = tenant
+
+    def over_all(self, *, total_count: bool = True, filters=None,
+                 group_by: Optional[str] = None,
+                 fields: Optional[dict[str, Sequence[str]]] = None):
+        """``fields`` maps property -> aggregations, e.g.
+        ``{"wordCount": ["mean", "maximum"]}``."""
+        args = {}
+        if filters is not None:
+            args["where"] = (filters.to_dict()
+                             if isinstance(filters, Filter) else filters)
+        if group_by:
+            args["groupBy"] = [group_by]
+        if self._tenant:
+            args["tenant"] = self._tenant
+        arg_s = ", ".join(f"{k}: {_gql(v)}" for k, v in args.items())
+        parts = []
+        if total_count:
+            parts.append("meta { count }")
+        if group_by:
+            parts.append("groupedBy { path value }")
+        for prop, aggs in (fields or {}).items():
+            parts.append(f"{prop} {{ {' '.join(aggs)} }}")
+        sel = " ".join(parts) or "meta { count }"
+        head = f"({arg_s})" if arg_s else ""
+        q = f"{{ Aggregate {{ {self._name}{head} {{ {sel} }} }} }}"
+        out = self._http.call("POST", "/v1/graphql", {"query": q})
+        if out.get("errors"):
+            raise ApiError(422, json.dumps(out["errors"])[:300])
+        return (out.get("data") or {}).get("Aggregate", {}).get(
+            self._name, [])
+
+
+class _Data:
+    def __init__(self, http: _Http, name: str, tenant: str = ""):
+        self._http = http
+        self._name = name
+        self._tenant = tenant
+
+    def _obj(self, properties, vector, uuid, vectors) -> dict:
+        o: dict = {"class": self._name, "properties": properties or {}}
+        if uuid:
+            o["id"] = uuid
+        if vector is not None:
+            o["vector"] = (vector.tolist()
+                           if hasattr(vector, "tolist") else list(vector))
+        if vectors:
+            o["vectors"] = {k: (v.tolist() if hasattr(v, "tolist")
+                                else list(v)) for k, v in vectors.items()}
+        if self._tenant:
+            o["tenant"] = self._tenant
+        return o
+
+    def insert(self, properties: dict, *, vector=None, uuid=None,
+               vectors=None) -> str:
+        out = self._http.call(
+            "POST", "/v1/objects",
+            self._obj(properties, vector, uuid, vectors))
+        return out["id"]
+
+    def insert_many(self, objects: Iterable[dict]) -> list[dict]:
+        """Each item: ``{"properties": ..., "vector": ..., "id": ...}``
+        (or a bare properties dict)."""
+        body = []
+        for o in objects:
+            if "properties" not in o:
+                o = {"properties": o}
+            body.append(self._obj(o.get("properties"), o.get("vector"),
+                                  o.get("id") or o.get("uuid"),
+                                  o.get("vectors")))
+        return self._http.call("POST", "/v1/batch/objects",
+                               {"objects": body})
+
+    def get_by_id(self, uuid: str) -> Optional[dict]:
+        try:
+            return self._http.call(
+                "GET", f"/v1/objects/{self._name}/{uuid}",
+                params={"tenant": self._tenant})
+        except ApiError as e:
+            if e.status == 404:
+                return None
+            raise
+
+    def replace(self, uuid: str, properties: dict, *, vector=None,
+                vectors=None) -> None:
+        self._http.call("PUT", f"/v1/objects/{self._name}/{uuid}",
+                        self._obj(properties, vector, uuid, vectors),
+                        params={"tenant": self._tenant})
+
+    def update(self, uuid: str, properties: dict) -> None:
+        self._http.call("PATCH", f"/v1/objects/{self._name}/{uuid}",
+                        self._obj(properties, None, uuid, None),
+                        params={"tenant": self._tenant})
+
+    def delete_by_id(self, uuid: str) -> None:
+        self._http.call("DELETE", f"/v1/objects/{self._name}/{uuid}",
+                        params={"tenant": self._tenant})
+
+    def reference_add(self, from_uuid: str, prop: str,
+                      to_uuid: str, to_collection: str = "") -> None:
+        beacon = (f"weaviate://localhost/"
+                  f"{to_collection or self._name}/{to_uuid}")
+        self._http.call(
+            "POST", f"/v1/objects/{self._name}/{from_uuid}"
+                    f"/references/{prop}",
+            {"beacon": beacon}, params={"tenant": self._tenant})
+
+    def exists(self, uuid: str) -> bool:
+        return self.get_by_id(uuid) is not None
+
+
+class _Tenants:
+    def __init__(self, http: _Http, name: str):
+        self._http = http
+        self._name = name
+
+    def create(self, *names: str) -> None:
+        self._http.call("POST", f"/v1/schema/{self._name}/tenants",
+                        [{"name": n} for n in names])
+
+    def list(self) -> list[dict]:
+        return self._http.call("GET", f"/v1/schema/{self._name}/tenants")
+
+    def update(self, name: str, activity_status: str) -> None:
+        self._http.call("PUT", f"/v1/schema/{self._name}/tenants",
+                        [{"name": name,
+                          "activityStatus": activity_status}])
+
+    def remove(self, *names: str) -> None:
+        self._http.call("DELETE", f"/v1/schema/{self._name}/tenants",
+                        [{"name": n} for n in names])
+
+
+class Collection:
+    def __init__(self, http: _Http, name: str, tenant: str = ""):
+        self._http = http
+        self.name = name
+        self.tenant = tenant
+        self.data = _Data(http, name, tenant)
+        self.query = _Query(http, name, tenant)
+        self.aggregate = _Aggregate(http, name, tenant)
+        self.tenants = _Tenants(http, name)
+
+    def with_tenant(self, tenant: str) -> "Collection":
+        return Collection(self._http, self.name, tenant)
+
+    def config(self) -> dict:
+        return self._http.call("GET", f"/v1/schema/{self.name}")
+
+    def add_property(self, name: str, data_type: str, **kw) -> None:
+        self._http.call("POST", f"/v1/schema/{self.name}/properties",
+                        {"name": name, "dataType": [data_type], **kw})
+
+    def __repr__(self):
+        return f"Collection({self.name!r}, tenant={self.tenant!r})"
+
+
+_PROP_SHORTHAND = str  # ("name", "text") tuples or full dicts
+
+
+class _Collections:
+    def __init__(self, http: _Http):
+        self._http = http
+
+    def create(self, name: str, *,
+               properties: Optional[Sequence] = None,
+               vector_index_type: str = "flat",
+               vector_index_config: Optional[dict] = None,
+               distance: str = "l2-squared",
+               vectorizer: str = "none",
+               multi_tenancy: bool = False,
+               replication_factor: int = 1,
+               **extra) -> Collection:
+        props = []
+        for p in properties or ():
+            if isinstance(p, dict):
+                props.append(p)
+            else:
+                pname, dtype = p
+                props.append({"name": pname, "dataType": [dtype]})
+        cfg = dict(vector_index_config or {})
+        cfg.setdefault("distance", distance)
+        body = {
+            "class": name,
+            "vectorizer": vectorizer,
+            "vectorIndexType": vector_index_type,
+            "vectorIndexConfig": cfg,
+            "properties": props,
+            **extra,
+        }
+        if multi_tenancy:
+            body["multiTenancyConfig"] = {"enabled": True}
+        if replication_factor != 1:
+            body["replicationConfig"] = {"factor": replication_factor}
+        self._http.call("POST", "/v1/schema", body)
+        return Collection(self._http, name)
+
+    def get(self, name: str) -> Collection:
+        return Collection(self._http, name)
+
+    def list_all(self) -> list[str]:
+        out = self._http.call("GET", "/v1/schema")
+        return [c["class"] for c in out.get("classes", [])]
+
+    def exists(self, name: str) -> bool:
+        return name in self.list_all()
+
+    def delete(self, name: str) -> None:
+        self._http.call("DELETE", f"/v1/schema/{name}")
+
+
+class _Backup:
+    def __init__(self, http: _Http):
+        self._http = http
+
+    def create(self, backend: str, backup_id: str, *,
+               include: Optional[Sequence[str]] = None,
+               exclude: Optional[Sequence[str]] = None) -> dict:
+        body: dict = {"id": backup_id}
+        if include:
+            body["include"] = list(include)
+        if exclude:
+            body["exclude"] = list(exclude)
+        return self._http.call("POST", f"/v1/backups/{backend}", body)
+
+    def status(self, backend: str, backup_id: str) -> dict:
+        return self._http.call("GET",
+                               f"/v1/backups/{backend}/{backup_id}")
+
+    def restore(self, backend: str, backup_id: str, **body) -> dict:
+        return self._http.call(
+            "POST", f"/v1/backups/{backend}/{backup_id}/restore",
+            body or {})
+
+
+class Client:
+    def __init__(self, url: str = "http://127.0.0.1:8080", *,
+                 api_key: Optional[str] = None, timeout: float = 30.0):
+        self._http = _Http(url, api_key, timeout)
+        self.collections = _Collections(self._http)
+        self.backup = _Backup(self._http)
+
+    def is_ready(self) -> bool:
+        try:
+            self._http.call("GET", "/v1/.well-known/ready")
+            return True
+        except (ApiError, OSError):
+            return False
+
+    def is_live(self) -> bool:
+        try:
+            self._http.call("GET", "/v1/.well-known/live")
+            return True
+        except (ApiError, OSError):
+            return False
+
+    def meta(self) -> dict:
+        return self._http.call("GET", "/v1/meta")
+
+    def nodes(self) -> dict:
+        return self._http.call("GET", "/v1/nodes")
+
+    def openapi(self) -> dict:
+        return self._http.call("GET", "/v1/.well-known/openapi")
+
+    def graphql_raw(self, query: str,
+                    variables: Optional[dict] = None) -> dict:
+        return self._http.call("POST", "/v1/graphql",
+                               {"query": query,
+                                **({"variables": variables}
+                                   if variables else {})})
+
+    def close(self) -> None:  # symmetry with the reference client
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def connect(url: str = "http://127.0.0.1:8080", *,
+            api_key: Optional[str] = None,
+            timeout: float = 30.0) -> Client:
+    return Client(url, api_key=api_key, timeout=timeout)

@@ -200,11 +200,19 @@ class Explorer:
             )
             kind = "score"
         elif params.filters is not None:
-            objs = col.filter_search(params.filters, limit=fetch,
+            # a sort over unranked results must see the FULL candidate
+            # set — sorting a pre-truncated page returns the first
+            # objects reordered, not the global order (reference sorts
+            # at the shard against the whole allowlist, sorter/)
+            want = (1 << 62) if params.sort else fetch
+            objs = col.filter_search(params.filters, limit=want,
                                      tenant=params.tenant)
             scored = [(o, 0.0) for o in objs]
         else:
-            objs = col.objects_page(limit=params.limit, offset=params.offset,
+            # offset applies once, in the common paging below — passing
+            # it here too double-applied it (offset=10 returned [])
+            want = (1 << 62) if params.sort else fetch
+            objs = col.objects_page(limit=want, offset=0,
                                     tenant=params.tenant)
             scored = [(o, 0.0) for o in objs]
 
