@@ -293,6 +293,8 @@ _METHOD_DOCS: dict[tuple[str, str], tuple[str | None, str | None]] = {
                                 "[]BatchObjectResponse"),
     ("batch_references", "POST"): ("[]BatchReference",
                                    "[]BatchObjectResponse"),
+    # PUT replaces the whole reference list; POST/DELETE take one beacon
+    ("object_references", "PUT"): ("[]SingleRef", None),
 }
 
 _TAGS = (

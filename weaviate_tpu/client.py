@@ -503,9 +503,6 @@ class Collection:
         return f"Collection({self.name!r}, tenant={self.tenant!r})"
 
 
-_PROP_SHORTHAND = str  # ("name", "text") tuples or full dicts
-
-
 class _Collections:
     def __init__(self, http: _Http):
         self._http = http

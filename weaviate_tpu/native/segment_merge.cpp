@@ -1,0 +1,371 @@
+// Native k-way segment merge for the LSM "replace" strategy.
+//
+// Reference counterpart: the compaction workers of the reference's LSM
+// store (its largest native-adjacent subsystem; compactor_replace +
+// segment writers). The Python tier (`storage/segment.py`) streams a
+// heapq merge through msgpack unpack/repack per record; for the replace
+// strategy the payload is opaque (newest wins, tombstone = msgpack nil)
+// so none of that decode work is needed — this engine merges the raw
+// record streams and emits a byte-identical segment file (same sparse
+// index, same blake2b-parameterized bloom, same footer), verified by a
+// bytes-equality parity test against the Python writer.
+//
+// Exports (ctypes):
+//   long long merge_replace_segments(const char **in_paths, int n_in,
+//                                    const char *out_path,
+//                                    int drop_tombstones);
+//     in_paths are oldest -> newest. Returns record count written,
+//     or -1 on any error (errno-style detail is not propagated; the
+//     Python caller falls back to the portable merge).
+//
+// File format (storage/segment.py):
+//   [8B magic "WVTSEG01"]
+//   data:   repeat [u32 klen][u32 vlen][key][msgpack value]
+//   index:  msgpack [[key(bin), offset(uint)], ...]   (every 32nd + last)
+//   bloom:  [u64 nbits][u32 nhashes=7][bit bytes]; double hashing with
+//           h1,h2 = first/second 8 LE bytes of blake2b-128(key); bit
+//           index = (h1 + i*h2) mod nbits in UNBOUNDED arithmetic
+//           (Python ints don't wrap) -> 128-bit intermediate here.
+//   footer: [u64 index_off][u64 bloom_off][u64 count][8B magic]
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#if defined(__unix__) || defined(__APPLE__)
+#include <fcntl.h>
+#include <unistd.h>
+#endif
+
+namespace {
+
+constexpr char MAGIC[9] = "WVTSEG01";
+constexpr int SPARSE = 32;
+constexpr int BLOOM_BITS_PER_KEY = 10;
+constexpr int BLOOM_HASHES = 7;
+
+// ---------------------------------------------------------------- blake2b
+// Compact RFC 7693 BLAKE2b, unkeyed, 16-byte digest.
+struct Blake2b {
+    uint64_t h[8];
+    uint8_t buf[128];
+    size_t buflen = 0;
+    uint64_t t = 0;  // total bytes (< 2^64 here)
+    static constexpr uint64_t IV[8] = {
+        0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+        0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+        0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+        0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+    explicit Blake2b(size_t digest_len) {
+        for (int i = 0; i < 8; i++) h[i] = IV[i];
+        h[0] ^= 0x01010000ULL ^ (uint64_t)digest_len;
+    }
+    static uint64_t rotr(uint64_t x, int n) {
+        return (x >> n) | (x << (64 - n));
+    }
+    void compress(const uint8_t *block, bool last) {
+        static const uint8_t sigma[12][16] = {
+            {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+            {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+            {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+            {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+            {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+            {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+            {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+            {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+            {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+            {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+            {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+            {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+        uint64_t m[16], v[16];
+        for (int i = 0; i < 16; i++) {
+            uint64_t w = 0;
+            memcpy(&w, block + 8 * i, 8);  // little-endian host assumed
+            m[i] = w;
+        }
+        for (int i = 0; i < 8; i++) v[i] = h[i];
+        for (int i = 0; i < 8; i++) v[8 + i] = IV[i];
+        v[12] ^= t;
+        // t high word is 0 (inputs < 2^64)
+        if (last) v[14] = ~v[14];
+        auto G = [&](int a, int b, int c, int d, uint64_t x, uint64_t y) {
+            v[a] = v[a] + v[b] + x;
+            v[d] = rotr(v[d] ^ v[a], 32);
+            v[c] = v[c] + v[d];
+            v[b] = rotr(v[b] ^ v[c], 24);
+            v[a] = v[a] + v[b] + y;
+            v[d] = rotr(v[d] ^ v[a], 16);
+            v[c] = v[c] + v[d];
+            v[b] = rotr(v[b] ^ v[c], 63);
+        };
+        for (int r = 0; r < 12; r++) {
+            const uint8_t *s = sigma[r];
+            G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+            G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+            G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+            G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+            G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+            G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+            G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+            G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+        }
+        for (int i = 0; i < 8; i++) h[i] ^= v[i] ^ v[8 + i];
+    }
+    void update(const uint8_t *p, size_t n) {
+        while (n > 0) {
+            if (buflen == 128) {
+                t += 128;
+                compress(buf, false);
+                buflen = 0;
+            }
+            size_t take = 128 - buflen;
+            if (take > n) take = n;
+            memcpy(buf + buflen, p, take);
+            buflen += take;
+            p += take;
+            n -= take;
+        }
+    }
+    void final16(uint8_t out[16]) {
+        t += buflen;
+        memset(buf + buflen, 0, 128 - buflen);
+        compress(buf, true);
+        memcpy(out, h, 16);  // first 16 bytes of little-endian state
+    }
+};
+constexpr uint64_t Blake2b::IV[8];
+
+// ------------------------------------------------------------- segment IO
+struct Reader {
+    FILE *f = nullptr;
+    uint64_t pos = 0, data_end = 0;
+    std::vector<uint8_t> key, val;
+    bool ok = false, done = false;
+
+    bool open(const char *path) {
+        f = fopen(path, "rb");
+        if (!f) return false;
+        char head[8];
+        if (fread(head, 1, 8, f) != 8 || memcmp(head, MAGIC, 8) != 0)
+            return false;
+        if (fseek(f, 0, SEEK_END) != 0) return false;
+        long size = ftell(f);
+        if (size < (long)(8 + 24 + 8)) return false;
+        char foot[32];
+        if (fseek(f, size - 32, SEEK_SET) != 0) return false;
+        if (fread(foot, 1, 32, f) != 32) return false;
+        if (memcmp(foot + 24, MAGIC, 8) != 0) return false;
+        uint64_t index_off;
+        memcpy(&index_off, foot, 8);
+        data_end = index_off;
+        if (fseek(f, 8, SEEK_SET) != 0) return false;
+        pos = 8;
+        ok = true;
+        return advance();
+    }
+    // load next record into key/val; false at end-of-data
+    bool advance() {
+        if (pos >= data_end) {
+            done = true;
+            return true;
+        }
+        uint32_t kl, vl;
+        if (fread(&kl, 4, 1, f) != 1 || fread(&vl, 4, 1, f) != 1)
+            return false;
+        if (pos + 8 + (uint64_t)kl + vl > data_end) return false;
+        key.resize(kl);
+        val.resize(vl);
+        if (kl && fread(key.data(), 1, kl, f) != kl) return false;
+        if (vl && fread(val.data(), 1, vl, f) != vl) return false;
+        pos += 8 + (uint64_t)kl + vl;
+        return true;
+    }
+    ~Reader() {
+        if (f) fclose(f);
+    }
+};
+
+struct Writer {
+    FILE *f = nullptr;
+    uint64_t off = 0;
+    uint64_t count = 0;
+    std::vector<std::pair<std::vector<uint8_t>, uint64_t>> sparse;
+    std::vector<uint8_t> last_key;
+    uint64_t last_off = 0;
+    std::vector<std::pair<uint64_t, uint64_t>> hashes;  // (h1, h2)
+
+    bool open(const char *path) {
+        f = fopen(path, "wb");
+        if (!f) return false;
+        if (fwrite(MAGIC, 1, 8, f) != 8) return false;
+        off = 8;
+        return true;
+    }
+    bool put(const std::vector<uint8_t> &key,
+             const std::vector<uint8_t> &val) {
+        if (count % SPARSE == 0) sparse.emplace_back(key, off);
+        last_key = key;
+        last_off = off;
+        uint32_t kl = (uint32_t)key.size(), vl = (uint32_t)val.size();
+        if (fwrite(&kl, 4, 1, f) != 1 || fwrite(&vl, 4, 1, f) != 1)
+            return false;
+        if (kl && fwrite(key.data(), 1, kl, f) != kl) return false;
+        if (vl && fwrite(val.data(), 1, vl, f) != vl) return false;
+        off += 8 + (uint64_t)kl + vl;
+        count++;
+        uint8_t d[16];
+        Blake2b b(16);
+        b.update(key.data(), key.size());
+        b.final16(d);
+        uint64_t h1, h2;
+        memcpy(&h1, d, 8);
+        memcpy(&h2, d + 8, 8);
+        hashes.emplace_back(h1, h2);
+        return true;
+    }
+    void mp_uint(std::string &o, uint64_t v) {
+        if (v < 128) {
+            o.push_back((char)v);
+        } else if (v <= 0xff) {
+            o.push_back((char)0xcc);
+            o.push_back((char)v);
+        } else if (v <= 0xffff) {
+            o.push_back((char)0xcd);
+            o.push_back((char)(v >> 8));
+            o.push_back((char)v);
+        } else if (v <= 0xffffffffULL) {
+            o.push_back((char)0xce);
+            for (int s = 24; s >= 0; s -= 8) o.push_back((char)(v >> s));
+        } else {
+            o.push_back((char)0xcf);
+            for (int s = 56; s >= 0; s -= 8) o.push_back((char)(v >> s));
+        }
+    }
+    void mp_bin(std::string &o, const std::vector<uint8_t> &b) {
+        size_t n = b.size();
+        if (n <= 0xff) {
+            o.push_back((char)0xc4);
+            o.push_back((char)n);
+        } else if (n <= 0xffff) {
+            o.push_back((char)0xc5);
+            o.push_back((char)(n >> 8));
+            o.push_back((char)n);
+        } else {
+            o.push_back((char)0xc6);
+            for (int s = 24; s >= 0; s -= 8) o.push_back((char)(n >> s));
+        }
+        o.append((const char *)b.data(), n);
+    }
+    bool finish() {
+        if (count > 0 && (count - 1) % SPARSE != 0)
+            sparse.emplace_back(last_key, last_off);
+        uint64_t index_off = off;
+        std::string idx;
+        size_t n = sparse.size();
+        if (n <= 15) {
+            idx.push_back((char)(0x90 | n));
+        } else if (n <= 0xffff) {
+            idx.push_back((char)0xdc);
+            idx.push_back((char)(n >> 8));
+            idx.push_back((char)n);
+        } else {
+            idx.push_back((char)0xdd);
+            for (int s = 24; s >= 0; s -= 8) idx.push_back((char)(n >> s));
+        }
+        for (auto &e : sparse) {
+            idx.push_back((char)0x92);
+            mp_bin(idx, e.first);
+            mp_uint(idx, e.second);
+        }
+        if (fwrite(idx.data(), 1, idx.size(), f) != idx.size())
+            return false;
+        off += idx.size();
+        uint64_t bloom_off = off;
+        uint64_t nbits = count * BLOOM_BITS_PER_KEY;
+        if (nbits < 64) nbits = 64;
+        std::vector<uint8_t> bits((nbits + 7) / 8, 0);
+        for (auto &hp : hashes) {
+            for (int i = 0; i < BLOOM_HASHES; i++) {
+                // Python computes (h1 + i*h2) % nbits without 64-bit
+                // wrap — mirror with a 128-bit intermediate
+                unsigned __int128 x =
+                    (unsigned __int128)hp.first +
+                    (unsigned __int128)i * hp.second;
+                uint64_t b = (uint64_t)(x % nbits);
+                bits[b >> 3] |= (uint8_t)(1u << (b & 7));
+            }
+        }
+        uint32_t nh = BLOOM_HASHES;
+        if (fwrite(&nbits, 8, 1, f) != 1) return false;
+        if (fwrite(&nh, 4, 1, f) != 1) return false;
+        if (!bits.empty() &&
+            fwrite(bits.data(), 1, bits.size(), f) != bits.size())
+            return false;
+        if (fwrite(&index_off, 8, 1, f) != 1) return false;
+        if (fwrite(&bloom_off, 8, 1, f) != 1) return false;
+        if (fwrite(&count, 8, 1, f) != 1) return false;
+        if (fwrite(MAGIC, 1, 8, f) != 8) return false;
+        if (fflush(f) != 0) return false;
+#if defined(__unix__) || defined(__APPLE__)
+        if (fsync(fileno(f)) != 0) return false;
+#endif
+        return fclose(f) == 0 ? (f = nullptr, true) : (f = nullptr, false);
+    }
+    ~Writer() {
+        if (f) fclose(f);
+    }
+};
+
+bool is_tombstone(const std::vector<uint8_t> &v) {
+    return v.size() == 1 && v[0] == 0xc0;  // msgpack nil
+}
+
+}  // namespace
+
+extern "C" long long merge_replace_segments(const char **in_paths,
+                                            int n_in,
+                                            const char *out_path,
+                                            int drop_tombstones) {
+    if (n_in <= 0) return -1;
+    std::vector<Reader> rd(n_in);
+    for (int i = 0; i < n_in; i++)
+        if (!rd[i].open(in_paths[i])) return -1;
+    Writer w;
+    if (!w.open(out_path)) return -1;
+
+    // n_in is small (2 for pairwise compaction): linear-scan merge.
+    while (true) {
+        int best = -1;
+        for (int i = 0; i < n_in; i++) {
+            if (rd[i].done) continue;
+            if (best < 0) {
+                best = i;
+                continue;
+            }
+            const auto &a = rd[i].key, &b = rd[best].key;
+            int c = memcmp(a.data(), b.data(),
+                           a.size() < b.size() ? a.size() : b.size());
+            if (c < 0 || (c == 0 && a.size() < b.size())) best = i;
+        }
+        if (best < 0) break;
+        std::vector<uint8_t> key = rd[best].key;
+        // newest (highest index) among equal keys wins
+        int winner = -1;
+        for (int i = 0; i < n_in; i++) {
+            if (rd[i].done || rd[i].key != key) continue;
+            winner = i;  // ascending scan -> ends at the newest
+        }
+        std::vector<uint8_t> val = rd[winner].val;
+        for (int i = 0; i < n_in; i++) {
+            if (!rd[i].done && rd[i].key == key)
+                if (!rd[i].advance()) return -1;
+        }
+        if (drop_tombstones && is_tombstone(val)) continue;
+        if (!w.put(key, val)) return -1;
+    }
+    if (!w.finish()) return -1;
+    return (long long)w.count;
+}

@@ -227,6 +227,10 @@ class Explorer:
             return QueryResult(hits=[], groups=groups)
 
         if params.sort:
+            # Ranked queries sort the already-fetched top-k, matching
+            # the reference (index.go:1630 sorts the merged per-shard
+            # top-limit results); only UNRANKED fetches widen to the
+            # full candidate set above.
             ordered = sort_objects([o for o, _ in scored], params.sort)
             by_id = {id(o): s for o, s in scored}
             scored = [(o, by_id.get(id(o), 0.0)) for o in ordered]

@@ -206,6 +206,40 @@ class DiskSegment:
         return DiskSegment(path)
 
 
+def native_merge_replace(in_paths: list[str], out_path: str,
+                         drop_tombstones: bool):
+    """C++ k-way merge for the *replace* strategy (payloads are opaque
+    there — newest wins, tombstone = msgpack nil — so no per-record
+    decode is needed). Writes a byte-identical segment file to
+    ``out_path`` (parity-tested against :meth:`DiskSegment.write`) and
+    returns the record count, or ``None`` when the native tier is
+    unavailable or fails — callers fall back to the streaming Python
+    merge. ``in_paths`` oldest -> newest, like ``merge_streams``."""
+    import ctypes
+
+    from weaviate_tpu import native
+
+    try:
+        lib = native.load("segment_merge")
+    except native.NativeUnavailable:
+        return None
+    fn = lib.merge_replace_segments
+    fn.restype = ctypes.c_longlong
+    fn.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                   ctypes.c_char_p, ctypes.c_int]
+    arr = (ctypes.c_char_p * len(in_paths))(
+        *[p.encode() for p in in_paths])
+    rc = fn(arr, len(in_paths), out_path.encode(),
+            1 if drop_tombstones else 0)
+    if rc < 0:
+        try:  # never leave a half-written output behind
+            os.remove(out_path)
+        except OSError:
+            pass
+        return None
+    return int(rc)
+
+
 def merge_streams(streams: list[Iterator[tuple[bytes, Any]]], strategy: str,
                   drop_tombstones: bool) -> Iterator[tuple[bytes, Any]]:
     """K-way merge of key-sorted streams, oldest stream first in ``streams``.
