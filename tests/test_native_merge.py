@@ -115,6 +115,14 @@ def test_newest_wins_across_three(tmp_path):
 
 
 def test_bucket_compaction_uses_native(tmp_path, monkeypatch):
+    # prove the NATIVE path serves the merge: the Python fallback is
+    # poisoned, so any regression that silently falls back fails here
+    import weaviate_tpu.storage.store as store_mod
+
+    def _no_fallback(*a, **kw):
+        raise AssertionError("native merge fell back to merge_streams")
+
+    monkeypatch.setattr(store_mod, "merge_streams", _no_fallback)
     b = Bucket(str(tmp_path / "bucket"), strategy="replace")
     for i in range(300):
         b.put(f"k{i:04d}".encode(), f"v{i}".encode())

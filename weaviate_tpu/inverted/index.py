@@ -219,8 +219,12 @@ class InvertedIndex:
                         total += sum(tf.values())
                         for term, n in tf.items():
                             combined[term] = combined.get(term, 0) + n
-                            plist = self.postings[prop][term]
-                            plist.set(doc_id, plist.get(doc_id, 0) + n)
+                    # one posting write per (term, doc): the doc id is
+                    # fresh (put_batch bumps doc ids; updates tombstone
+                    # the old id), so no membership probe is needed
+                    pp = self.postings[prop]
+                    for term, n in combined.items():
+                        pp[term].add_new(doc_id, n)
                     prev = self.doc_lengths[prop].set(doc_id, total)
                     if prev is not None:
                         self.len_totals[prop] -= prev

@@ -11,6 +11,7 @@ Python-side and passed per query term.
 from __future__ import annotations
 
 import ctypes
+import functools
 import hashlib
 import threading
 from typing import Optional
@@ -56,8 +57,13 @@ def _bind():
     return lib
 
 
+@functools.lru_cache(maxsize=262_144)
 def term_id(prop: str, term: str) -> int:
-    """64-bit id for a (property, term) pair — the native engine's key."""
+    """64-bit id for a (property, term) pair — the native engine's key.
+    Cached: term distributions are Zipf, so ingest hits the same few
+    thousand hot terms constantly and the blake2b per (term, doc) was
+    a measurable slice of the write path; the LRU bound keeps a
+    pathological vocab from pinning memory."""
     h = hashlib.blake2b(f"{prop}\x00{term}".encode(), digest_size=8)
     return int.from_bytes(h.digest(), "big")
 

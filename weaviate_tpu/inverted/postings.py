@@ -76,6 +76,16 @@ class PostingList:
 
     __setitem__ = set
 
+    def add_new(self, doc: int, tf: int) -> None:
+        """``set`` for a doc id KNOWN to be absent (fresh ingest: doc
+        ids are monotonic and updates tombstone the old id, so the
+        write path never re-adds a live doc). Skips the two
+        membership probes — base-array searchsorted per (term, doc)
+        was the ingest profile's top cost."""
+        self._over[doc] = tf
+        self._len += 1
+        self._cache = None
+
     def pop(self, doc: int, default=None):
         prev = self.get(doc, -1)
         if prev == -1:
