@@ -119,6 +119,38 @@ def test_object_crud(client):
     assert col.data.get_by_id("00000000-0000-0000-0000-00000000dead") is None
 
 
+def test_cursor_pagination(client):
+    col = _seed(client)
+    seen = []
+    after = ""
+    while True:
+        page = col.query.fetch_objects(limit=7, after=after,
+                                       return_properties=["wordCount"],
+                                       include=("id",))
+        if not page:
+            break
+        seen.extend(h.properties["wordCount"] for h in page)
+        after = page[-1].uuid
+    assert seen == [i * 10 for i in range(24)]
+    # cursor + search operator is rejected, like the reference
+    with pytest.raises(wvt.ApiError):
+        col.query.fetch_objects(limit=3, after=after,
+                                filters=wvt.Filter("wordCount") < 100)
+
+
+def test_aggregate_search_scoped(client):
+    col = _seed(client)
+    q = [0.0] * 8
+    q[2] = 1.0
+    out = col.aggregate.over_all(
+        total_count=True, near_vector=q, object_limit=3,
+        fields={"wordCount": ["mean", "count"]})
+    row = out[0]
+    assert row["meta"]["count"] == 3
+    # the 3 nearest to e_2 are wordCounts 20, 100, 180 (docs 2, 10, 18)
+    assert row["wordCount"]["mean"] == pytest.approx((20 + 100 + 180) / 3)
+
+
 def test_aggregate(client):
     col = _seed(client)
     out = col.aggregate.over_all(

@@ -36,6 +36,10 @@ from weaviate_tpu.query import (
 # reference GraphQL aggregation field names -> aggregator native keys
 _AGG_ALIASES = {"maximum": "max", "minimum": "min"}
 
+# distance-bounded (no objectLimit) search-scoped Aggregate refuses to
+# truncate past this many hits — erroring beats a silently-wrong mean
+_DISTANCE_AGG_CAP = 100_000
+
 # ---------------------------------------------------------------------------
 # Lexer / parser
 # ---------------------------------------------------------------------------
@@ -335,6 +339,7 @@ class GraphQLExecutor:
         p.offset = int(args.get("offset", 0) or 0)
         p.tenant = args.get("tenant", "") or ""
         p.autocut = int(args.get("autocut", 0) or 0)
+        p.after = args.get("after", "") or ""
         if "where" in args:
             p.filters = where_to_filter(args["where"])
         if "nearVector" in args:
@@ -530,6 +535,69 @@ class GraphQLExecutor:
         return row
 
     # -- Aggregate ----------------------------------------------------------
+    def _aggregate_search_scope(self, cls: Field, props: dict,
+                                group_by, tenant: str) -> dict:
+        """Aggregate over the top-``objectLimit`` results of a vector/
+        keyword/hybrid search — the reference's search-scoped Aggregate
+        (``traverser_aggregate.go``; GraphQL ``objectLimit``). The
+        result shape matches ``Collection.aggregate``."""
+        from weaviate_tpu.query.aggregator import (
+            aggregate_property,
+            per_doc_distinct,
+        )
+
+        # grouping happens locally over the hits below — groupBy must
+        # not reach the Get parser (its dict/list arg forms differ, and
+        # a grouped explorer result would hide the hit objects)
+        get_args = {k: v for k, v in cls.args.items() if k != "groupBy"}
+        params = self._params_from_args(cls.name, get_args)
+        obj_limit = cls.args.get("objectLimit")
+        if obj_limit is None and params.max_distance is None:
+            raise GraphQLError(
+                "Aggregate with a search operator needs objectLimit "
+                "or a distance/certainty bound")
+        params.limit = (int(obj_limit) if obj_limit is not None
+                        else _DISTANCE_AGG_CAP)
+        params.offset = 0
+        params.tenant = tenant or params.tenant
+        res = self.explorer.get(params)
+        objs = [h.object for h in res.hits]
+        if obj_limit is None and len(objs) >= _DISTANCE_AGG_CAP:
+            # a silently truncated aggregate is a wrong aggregate
+            raise GraphQLError(
+                f"distance-bounded Aggregate matched >= "
+                f"{_DISTANCE_AGG_CAP} objects; add objectLimit")
+
+        def _vals(obj_list, prop):
+            out = []
+            for o in obj_list:
+                v = o.properties.get(prop)
+                if v is None:
+                    continue
+                v = per_doc_distinct(v)
+                out.extend(v) if isinstance(v, list) else out.append(v)
+            return out
+
+        if group_by is None:
+            return {
+                "meta": {"count": len(objs)},
+                "properties": {
+                    p: aggregate_property(_vals(objs, p), kind)
+                    for p, kind in props.items()},
+            }
+        groups: dict = {}
+        for o in objs:
+            gv = o.properties.get(group_by)
+            for g in (gv if isinstance(gv, list) else [gv]):
+                groups.setdefault(g, []).append(o)
+        return {"groups": [
+            {"groupedBy": {"path": [group_by], "value": g},
+             "meta": {"count": len(members)},
+             "properties": {
+                 p: aggregate_property(_vals(members, p), kind)
+                 for p, kind in props.items()}}
+            for g, members in groups.items()]}
+
     def _aggregate(self, root: Field) -> dict:
         out = {}
         for cls in root.selections:
@@ -555,8 +623,17 @@ class GraphQLExecutor:
                     prop_fields[sel.name] = sel.selections
 
             col = self.db.get_collection(cls.name)
-            agg = col.aggregate(props, flt=flt, group_by=group_by,
-                                tenant=tenant)
+            search_ops = ("nearVector", "nearText", "nearObject",
+                          "hybrid", "bm25")
+            if any(op in cls.args for op in search_ops):
+                # search-scoped aggregation (reference Aggregate with
+                # near*/hybrid + objectLimit, aggregate.proto:30,41-42):
+                # aggregate over the top-objectLimit hits
+                agg = self._aggregate_search_scope(
+                    cls, props, group_by, tenant)
+            else:
+                agg = col.aggregate(props, flt=flt, group_by=group_by,
+                                    tenant=tenant)
 
             def render_entry(meta_count, properties) -> dict:
                 entry: dict = {}

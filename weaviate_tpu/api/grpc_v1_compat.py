@@ -322,6 +322,7 @@ class WeaviateV1Service:
             collection=req.collection, tenant=req.tenant,
             limit=int(req.limit) or 10, offset=int(req.offset),
             filters=flt, autocut=int(req.autocut),
+            after=req.after,
         )
         if req.sort_by:
             params.sort = [

@@ -500,7 +500,11 @@ class RestAPI:
         limit = int(request.args.get("limit", 25))
         offset = int(request.args.get("offset", 0))
         tenant = request.args.get("tenant", "")
-        objs = col.objects_page(limit=limit, offset=offset, tenant=tenant)
+        after = request.args.get("after", "")
+        if after and offset:
+            _abort(422, "offset cannot combine with the after cursor")
+        objs = col.objects_page(limit=limit, offset=offset, tenant=tenant,
+                                after=after)
         return _json_response({
             "objects": [_obj_to_rest(o) for o in objs],
             "totalResults": col.count(tenant=tenant),

@@ -336,9 +336,12 @@ class _Query:
         return self._run(args, return_properties, include)
 
     def fetch_objects(self, *, limit: int = 25, filters=None,
-                      offset: int = 0, sort=None, return_properties=None,
+                      offset: int = 0, sort=None, after: str = "",
+                      return_properties=None,
                       include: Sequence[str] = ()):
         args = self._common({}, filters, limit, offset, None, sort)
+        if after:
+            args["after"] = after
         return self._run(args, return_properties, include)
 
 
@@ -350,10 +353,17 @@ class _Aggregate:
 
     def over_all(self, *, total_count: bool = True, filters=None,
                  group_by: Optional[str] = None,
-                 fields: Optional[dict[str, Sequence[str]]] = None):
+                 fields: Optional[dict[str, Sequence[str]]] = None,
+                 near_vector=None, object_limit: Optional[int] = None):
         """``fields`` maps property -> aggregations, e.g.
-        ``{"wordCount": ["mean", "maximum"]}``."""
+        ``{"wordCount": ["mean", "maximum"]}``. ``near_vector`` +
+        ``object_limit`` aggregate over the top search hits instead of
+        the whole collection."""
         args = {}
+        if near_vector is not None:
+            args["nearVector"] = {"vector": near_vector}
+            if object_limit is not None:
+                args["objectLimit"] = object_limit
         if filters is not None:
             args["where"] = (filters.to_dict()
                              if isinstance(filters, Filter) else filters)

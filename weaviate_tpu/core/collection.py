@@ -679,16 +679,43 @@ class Collection:
             int(s.allow_list(flt).sum()) for s in self._search_shards(tenant)
         )
 
-    def objects_page(self, limit: int = 25, offset: int = 0, tenant: str = "") -> list[StorageObject]:
+    def objects_page(self, limit: int = 25, offset: int = 0,
+                     tenant: str = "", after: str = "") -> list[StorageObject]:
+        """Page through objects in uuid order per shard. ``after`` is
+        exhaustive-cursor pagination (reference ``filters.Cursor`` /
+        REST ``?after=``): resume strictly past that uuid via a seek on
+        the uuid->docid bucket — O(limit), not O(position). Iterating
+        by uuid (not doc id) keeps the cursor position-stable under
+        concurrent updates (an update keeps the uuid but bumps the doc
+        id) and resumable past a deleted cursor object, matching the
+        reference's uuid-ordered scan."""
+        from weaviate_tpu.core.shard import _DOCID
+
+        import heapq
+
+        # uuids are strings; the next key after `after` in byte order
+        start_key = after.encode() + b"\x00" if after else None
+        shards = self._search_shards(tenant)
+
+        def stream(s):
+            for k, packed in s.ids.items(start=start_key):
+                yield k, s, packed
+
+        # global uuid order: shards hold hash-random uuid subsets, so a
+        # per-shard cursor would skip the other shards' earlier uuids —
+        # merge the (already uuid-sorted) shard streams instead
+        merged = (stream(shards[0]) if len(shards) == 1 else
+                  heapq.merge(*(stream(s) for s in shards),
+                              key=lambda t: t[0]))
         out: list[StorageObject] = []
-        for s in self._search_shards(tenant):
-            for key, raw in s.objects.items():
-                out.append(StorageObject.from_bytes(raw))
-                if len(out) >= offset + limit:
-                    break
+        for _, s, packed in merged:
+            raw = s.objects.get(packed[: _DOCID.size])
+            if raw is None:
+                continue  # racing delete between the two buckets
+            out.append(StorageObject.from_bytes(raw))
             if len(out) >= offset + limit:
                 break
-        return out[offset : offset + limit]
+        return out[offset: offset + limit]
 
     # -- search -----------------------------------------------------------
     def vector_search(
@@ -992,16 +1019,9 @@ class Collection:
             doc_ids = (None if mask is None
                        else set(int(i) for i in np.nonzero(mask)[0]))
 
-            def _dedup(v):
-                # a value repeated WITHIN one doc's array counts once —
-                # inverted-index (per-doc distinct) semantics, identical
-                # to what the segment tier's bitmaps can express
-                if isinstance(v, list):
-                    try:
-                        return list(dict.fromkeys(v))
-                    except TypeError:  # unhashable elements (geo dicts)
-                        return v
-                return v
+            from weaviate_tpu.query.aggregator import (
+                per_doc_distinct as _dedup,
+            )
 
             def docs_with(prop: str):
                 vals = inv.values.get(prop, {})

@@ -137,6 +137,19 @@ def infer_kind(values: list[Any]) -> str:
     return "text"
 
 
+def per_doc_distinct(v):
+    """A value repeated WITHIN one doc's array counts once —
+    inverted-index (per-doc distinct) semantics, identical to what the
+    segment tier's bitmaps can express. Shared by collection-wide and
+    search-scoped aggregation so the two can never drift."""
+    if isinstance(v, list):
+        try:
+            return list(dict.fromkeys(v))
+        except TypeError:  # unhashable elements (geo dicts)
+            return v
+    return v
+
+
 def aggregate_property(
     values: list[Any],
     kind: Optional[str] = None,

@@ -100,6 +100,9 @@ class QueryParams:
     # hybrid
     hybrid: Optional[HybridParams] = None
     # post-processing
+    # exhaustive-cursor pagination (reference filters.Cursor): only
+    # valid for plain fetches — no search/sort/filters
+    after: str = ""
     sort: list[tuple[str, str]] = field(default_factory=list)
     group_by: Optional[GroupByParams] = None
     autocut: int = 0
@@ -147,6 +150,17 @@ class Explorer:
     def get(self, params: QueryParams) -> QueryResult:
         col = self.db.get_collection(params.collection)
         fetch = params.offset + params.limit
+        if params.after and (
+                params.filters is not None
+                or params.near_vector is not None
+                or params.near_text is not None
+                or params.bm25_query is not None
+                or params.hybrid is not None or params.targets):
+            # reference restriction: the exhaustive cursor is a plain
+            # scan; ranked or filtered orders have no stable cursor
+            raise ValueError(
+                "cursor pagination (after) requires a plain fetch "
+                "without search operators or filters")
         scored: list[tuple[StorageObject, float]] = []
         kind = "none"
 
@@ -209,11 +223,16 @@ class Explorer:
                                      tenant=params.tenant)
             scored = [(o, 0.0) for o in objs]
         else:
+            if params.after and (params.sort or params.offset):
+                raise ValueError(
+                    "cursor pagination (after) cannot combine with "
+                    "sort or offset")
             # offset applies once, in the common paging below — passing
             # it here too double-applied it (offset=10 returned [])
             want = (1 << 62) if params.sort else fetch
             objs = col.objects_page(limit=want, offset=0,
-                                    tenant=params.tenant)
+                                    tenant=params.tenant,
+                                    after=params.after)
             scored = [(o, 0.0) for o in objs]
 
         # autocut applies to ranked results only (reference entities/autocut)

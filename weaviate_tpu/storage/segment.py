@@ -137,16 +137,27 @@ class DiskSegment:
     def __contains__(self, key: bytes) -> bool:
         return self.get(key) is not MISSING
 
-    def items(self) -> Iterator[tuple[bytes, Any]]:
-        """Stream (key, value) in key order; tombstones yield value None."""
+    def items(self, start: bytes | None = None) -> Iterator[tuple[bytes, Any]]:
+        """Stream (key, value) in key order; tombstones yield value None.
+        ``start`` seeks to the first key >= start via the sparse index —
+        the cursor-pagination path (reference ``filters.Cursor``) pays
+        O(SPARSE) records of skip, not O(position)."""
         mm = self._mm
         off = len(MAGIC)
         end = self._data_end
+        if start is not None and self._idx_keys:
+            # rightmost sparse entry <= start bounds the scan-in point
+            i = bisect.bisect_right(self._idx_keys, start) - 1
+            if i >= 0:
+                off = self._idx_offs[i]
         while off < end:
             klen, vlen = _REC.unpack_from(mm, off)
             off += _REC.size
             k = bytes(mm[off:off + klen])
             off += klen
+            if start is not None and k < start:
+                off += vlen  # inside the sparse gap, before the cursor
+                continue
             v = msgpack.unpackb(bytes(mm[off:off + vlen]), raw=True)
             off += vlen
             yield k, v

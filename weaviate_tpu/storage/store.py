@@ -284,12 +284,17 @@ class Bucket:
             tfs[i], dls[i] = _struct.unpack("<II", v)
         return ids, tfs, dls
 
-    def items(self) -> Iterator[tuple[bytes, Any]]:
+    def items(self, start: bytes | None = None) -> Iterator[tuple[bytes, Any]]:
         """Live (key, merged-value) pairs in key order — one streaming k-way
-        merge over segments + a memtable snapshot; nothing is materialized."""
+        merge over segments + a memtable snapshot; nothing is materialized.
+        ``start`` seeks every stream to the first key >= start (cursor
+        pagination)."""
         with self._lock:
-            streams = [seg.items() for seg in self._segments]
-            streams.append(iter(sorted(self._mem.items())))
+            streams = [seg.items(start) for seg in self._segments]
+            mem = (sorted(self._mem.items()) if start is None else
+                   sorted(kv for kv in self._mem.items()
+                          if kv[0] >= start))
+            streams.append(iter(mem))
         try:
             yield from merge_streams(streams, self.strategy,
                                      drop_tombstones=True)
