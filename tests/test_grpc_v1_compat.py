@@ -155,6 +155,26 @@ def test_aggregate_count_and_int_stats(server):
     assert stats.int.maximum >= 129
 
 
+def test_aggregate_search_scoped(server):
+    """Aggregate over the top-object_limit near_vector hits (reference
+    aggregate.proto oneof search, field 42)."""
+    chan, objs = server
+    req = wv.AggregateRequest(collection="Article", objects_count=True)
+    agg = req.aggregations.add()
+    agg.property = "wordCount"
+    agg.int.count = True
+    agg.int.maximum = True
+    req.object_limit = 5
+    v = np.zeros(D, np.float32)
+    v[3] = 1.03  # exactly doc 3's vector
+    req.near_vector.vector_bytes = v.tobytes()
+    reply = _unary(chan, "Aggregate", req, wv.AggregateReply)
+    assert reply.single_result.objects_count == 5
+    stats = reply.single_result.aggregations.aggregations[0]
+    assert stats.int.count == 5
+    assert stats.int.maximum >= 103
+
+
 def test_batch_delete_with_filter(server):
     chan, _ = server
     req = wv.BatchObjectsRequest()

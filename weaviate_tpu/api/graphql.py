@@ -36,9 +36,9 @@ from weaviate_tpu.query import (
 # reference GraphQL aggregation field names -> aggregator native keys
 _AGG_ALIASES = {"maximum": "max", "minimum": "min"}
 
-# distance-bounded (no objectLimit) search-scoped Aggregate refuses to
-# truncate past this many hits — erroring beats a silently-wrong mean
-_DISTANCE_AGG_CAP = 100_000
+from weaviate_tpu.query.aggregator import (  # noqa: E402
+    DISTANCE_AGG_CAP as _DISTANCE_AGG_CAP,
+)
 
 # ---------------------------------------------------------------------------
 # Lexer / parser
@@ -339,7 +339,7 @@ class GraphQLExecutor:
         p.offset = int(args.get("offset", 0) or 0)
         p.tenant = args.get("tenant", "") or ""
         p.autocut = int(args.get("autocut", 0) or 0)
-        p.after = args.get("after", "") or ""
+        p.after = args.get("after")  # None = no cursor; "" = from start
         if "where" in args:
             p.filters = where_to_filter(args["where"])
         if "nearVector" in args:
@@ -541,10 +541,7 @@ class GraphQLExecutor:
         keyword/hybrid search — the reference's search-scoped Aggregate
         (``traverser_aggregate.go``; GraphQL ``objectLimit``). The
         result shape matches ``Collection.aggregate``."""
-        from weaviate_tpu.query.aggregator import (
-            aggregate_property,
-            per_doc_distinct,
-        )
+        from weaviate_tpu.query.aggregator import aggregate_objects
 
         # grouping happens locally over the hits below — groupBy must
         # not reach the Get parser (its dict/list arg forms differ, and
@@ -567,36 +564,7 @@ class GraphQLExecutor:
             raise GraphQLError(
                 f"distance-bounded Aggregate matched >= "
                 f"{_DISTANCE_AGG_CAP} objects; add objectLimit")
-
-        def _vals(obj_list, prop):
-            out = []
-            for o in obj_list:
-                v = o.properties.get(prop)
-                if v is None:
-                    continue
-                v = per_doc_distinct(v)
-                out.extend(v) if isinstance(v, list) else out.append(v)
-            return out
-
-        if group_by is None:
-            return {
-                "meta": {"count": len(objs)},
-                "properties": {
-                    p: aggregate_property(_vals(objs, p), kind)
-                    for p, kind in props.items()},
-            }
-        groups: dict = {}
-        for o in objs:
-            gv = o.properties.get(group_by)
-            for g in (gv if isinstance(gv, list) else [gv]):
-                groups.setdefault(g, []).append(o)
-        return {"groups": [
-            {"groupedBy": {"path": [group_by], "value": g},
-             "meta": {"count": len(members)},
-             "properties": {
-                 p: aggregate_property(_vals(members, p), kind)
-                 for p, kind in props.items()}}
-            for g, members in groups.items()]}
+        return aggregate_objects(objs, props, group_by)
 
     def _aggregate(self, root: Field) -> dict:
         out = {}

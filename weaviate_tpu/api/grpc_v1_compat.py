@@ -117,6 +117,50 @@ def vector_from_near(nv: wv.NearVector) -> np.ndarray:
     return np.asarray(list(nv.vector), np.float32)
 
 
+# search-operator pb -> QueryParams translation, shared by Search and
+# the search-scoped Aggregate so the two planes can never drift
+def apply_hybrid(params: QueryParams, h) -> None:
+    vec = None
+    if h.vectors:
+        vec = _vec_from_bytes(h.vectors[0].vector_bytes)
+    elif h.vector_bytes:
+        vec = _vec_from_bytes(h.vector_bytes)
+    elif h.vector:
+        vec = np.asarray(list(h.vector), np.float32)
+    if h.targets.target_vectors:
+        params.target_vector = h.targets.target_vectors[0]
+    elif h.target_vectors:
+        params.target_vector = h.target_vectors[0]
+    params.hybrid = HybridParams(
+        query=h.query or None,
+        vector=vec,
+        # plain proto3 float: the reference uses it as sent, so an
+        # absent field means 0.0 = pure keyword (no 0.75 coercion —
+        # stock clients always set alpha explicitly)
+        alpha=float(h.alpha),
+        fusion=("rankedFusion"
+                if h.fusion_type == wv.Hybrid.FUSION_TYPE_RANKED
+                else "relativeScoreFusion"),
+        properties=list(h.properties) or None,
+    )
+
+
+def apply_near_vector(params: QueryParams, nv) -> None:
+    params.near_vector = vector_from_near(nv)
+    if nv.targets.target_vectors:
+        params.target_vector = nv.targets.target_vectors[0]
+    elif nv.target_vectors:
+        params.target_vector = nv.target_vectors[0]
+    if nv.HasField("distance"):
+        params.max_distance = float(nv.distance)
+
+
+def apply_near_text(params: QueryParams, nt) -> None:
+    params.near_text = " ".join(nt.query)
+    if nt.HasField("distance"):
+        params.max_distance = float(nt.distance)
+
+
 def _struct_value(v) -> Any:
     kind = v.WhichOneof("kind")
     if kind == "number_value":
@@ -322,7 +366,9 @@ class WeaviateV1Service:
             collection=req.collection, tenant=req.tenant,
             limit=int(req.limit) or 10, offset=int(req.offset),
             filters=flt, autocut=int(req.autocut),
-            after=req.after,
+            # proto3 string can't carry absent-vs-empty: empty = no
+            # cursor, like the reference's gRPC parse
+            after=req.after or None,
         )
         if req.sort_by:
             params.sort = [
@@ -338,45 +384,11 @@ class WeaviateV1Service:
                 objects_per_group=int(req.group_by.objects_per_group) or 10,
             )
         if req.HasField("hybrid_search"):
-            h = req.hybrid_search
-            vec = None
-            if h.vectors:
-                vec = _vec_from_bytes(h.vectors[0].vector_bytes)
-            elif h.vector_bytes:
-                vec = _vec_from_bytes(h.vector_bytes)
-            elif h.vector:
-                vec = np.asarray(list(h.vector), np.float32)
-            target = ""
-            if h.targets.target_vectors:
-                target = h.targets.target_vectors[0]
-            elif h.target_vectors:
-                target = h.target_vectors[0]
-            params.target_vector = target
-            params.hybrid = HybridParams(
-                query=h.query or None,
-                vector=vec,
-                # plain proto3 float: the reference uses it as sent, so an
-                # absent field means 0.0 = pure keyword (no 0.75 coercion —
-                # stock clients always set alpha explicitly)
-                alpha=float(h.alpha),
-                fusion=("rankedFusion"
-                        if h.fusion_type == wv.Hybrid.FUSION_TYPE_RANKED
-                        else "relativeScoreFusion"),
-                properties=list(h.properties) or None,
-            )
+            apply_hybrid(params, req.hybrid_search)
         elif req.HasField("near_vector"):
-            nv = req.near_vector
-            params.near_vector = vector_from_near(nv)
-            if nv.targets.target_vectors:
-                params.target_vector = nv.targets.target_vectors[0]
-            elif nv.target_vectors:
-                params.target_vector = nv.target_vectors[0]
-            if nv.HasField("distance"):
-                params.max_distance = float(nv.distance)
+            apply_near_vector(params, req.near_vector)
         elif req.HasField("near_text"):
-            params.near_text = " ".join(req.near_text.query)
-            if req.near_text.HasField("distance"):
-                params.max_distance = float(req.near_text.distance)
+            apply_near_text(params, req.near_text)
         elif req.HasField("bm25_search"):
             params.bm25_query = req.bm25_search.query
             params.bm25_properties = list(req.bm25_search.properties) or None
@@ -597,8 +609,42 @@ class WeaviateV1Service:
         }
         group_by = (req.group_by.property
                     if req.HasField("group_by") else None)
-        result = col.aggregate(properties=props or None, flt=flt,
-                               tenant=req.tenant, group_by=group_by)
+        search = req.WhichOneof("search")
+        if search is not None:
+            # search-scoped aggregation (reference aggregate.proto
+            # oneof search + object_limit): aggregate the top hits
+            from weaviate_tpu.query.aggregator import (
+                DISTANCE_AGG_CAP as _DISTANCE_AGG_CAP,
+                aggregate_objects,
+            )
+
+            params = QueryParams(collection=req.collection,
+                                 tenant=req.tenant, filters=flt)
+            if search == "near_vector":
+                apply_near_vector(params, req.near_vector)
+            elif search == "hybrid":
+                apply_hybrid(params, req.hybrid)
+            else:  # near_text — vectorized by the collection's module
+                apply_near_text(params, req.near_text)
+            if not req.HasField("object_limit") \
+                    and params.max_distance is None:
+                raise ValueError(
+                    "Aggregate with a search needs object_limit or a "
+                    "distance bound")
+            params.limit = (int(req.object_limit)
+                            if req.HasField("object_limit")
+                            else _DISTANCE_AGG_CAP)
+            hits = self.explorer.get(params).hits
+            if not req.HasField("object_limit") \
+                    and len(hits) >= _DISTANCE_AGG_CAP:
+                raise ValueError(
+                    f"distance-bounded Aggregate matched >= "
+                    f"{_DISTANCE_AGG_CAP} objects; set object_limit")
+            result = aggregate_objects(
+                [h.object for h in hits], props, group_by)
+        else:
+            result = col.aggregate(properties=props or None, flt=flt,
+                                   tenant=req.tenant, group_by=group_by)
         reply = wv.AggregateReply()
 
         def fill_aggs(aggs_pb, stats: dict):

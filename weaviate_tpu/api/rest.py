@@ -500,8 +500,8 @@ class RestAPI:
         limit = int(request.args.get("limit", 25))
         offset = int(request.args.get("offset", 0))
         tenant = request.args.get("tenant", "")
-        after = request.args.get("after", "")
-        if after and offset:
+        after = request.args.get("after")  # None when absent; "" = start
+        if after is not None and offset:
             _abort(422, "offset cannot combine with the after cursor")
         objs = col.objects_page(limit=limit, offset=offset, tenant=tenant,
                                 after=after)

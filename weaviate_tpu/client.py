@@ -336,11 +336,15 @@ class _Query:
         return self._run(args, return_properties, include)
 
     def fetch_objects(self, *, limit: int = 25, filters=None,
-                      offset: int = 0, sort=None, after: str = "",
+                      offset: int = 0, sort=None,
+                      after: Optional[str] = None,
                       return_properties=None,
                       include: Sequence[str] = ()):
+        """``after=None`` is a plain fetch; ``after=""`` starts a
+        uuid-ordered cursor walk (pass the last hit's uuid to
+        continue)."""
         args = self._common({}, filters, limit, offset, None, sort)
-        if after:
+        if after is not None:
             args["after"] = after
         return self._run(args, return_properties, include)
 

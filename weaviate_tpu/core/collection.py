@@ -680,22 +680,38 @@ class Collection:
         )
 
     def objects_page(self, limit: int = 25, offset: int = 0,
-                     tenant: str = "", after: str = "") -> list[StorageObject]:
-        """Page through objects in uuid order per shard. ``after`` is
-        exhaustive-cursor pagination (reference ``filters.Cursor`` /
-        REST ``?after=``): resume strictly past that uuid via a seek on
-        the uuid->docid bucket — O(limit), not O(position). Iterating
-        by uuid (not doc id) keeps the cursor position-stable under
-        concurrent updates (an update keeps the uuid but bumps the doc
-        id) and resumable past a deleted cursor object, matching the
-        reference's uuid-ordered scan."""
+                     tenant: str = "",
+                     after: Optional[str] = None) -> list[StorageObject]:
+        """Page through objects. ``after`` is exhaustive-cursor
+        pagination (reference ``filters.Cursor`` / REST ``?after=``):
+        ``None`` = no cursor (plain doc-id-order stream); a string —
+        including ``""`` for "from the start" — walks GLOBAL uuid order
+        and resumes strictly past that uuid via a seek on the
+        uuid->docid bucket, O(limit) not O(position). Iterating by uuid
+        (not doc id) keeps the cursor position-stable under concurrent
+        updates (an update keeps the uuid but bumps the doc id) and
+        resumable past a deleted cursor object, and makes page 1
+        (``after=""``) consistent with every later page."""
         from weaviate_tpu.core.shard import _DOCID
+
+        shards = self._search_shards(tenant)
+        out: list[StorageObject] = []
+        if after is None:
+            # no cursor: stream the object store directly — the uuid
+            # route below costs a point lookup per object, which a full
+            # fetch (e.g. an unranked sort's limit=inf read) never needs
+            for s in shards:
+                for _, raw in s.objects.items():
+                    out.append(StorageObject.from_bytes(raw))
+                    if len(out) >= offset + limit:
+                        return out[offset: offset + limit]
+            return out[offset: offset + limit]
 
         import heapq
 
         # uuids are strings; the next key after `after` in byte order
+        # ("" seeks to the very first uuid)
         start_key = after.encode() + b"\x00" if after else None
-        shards = self._search_shards(tenant)
 
         def stream(s):
             for k, packed in s.ids.items(start=start_key):
@@ -707,7 +723,6 @@ class Collection:
         merged = (stream(shards[0]) if len(shards) == 1 else
                   heapq.merge(*(stream(s) for s in shards),
                               key=lambda t: t[0]))
-        out: list[StorageObject] = []
         for _, s, packed in merged:
             raw = s.objects.get(packed[: _DOCID.size])
             if raw is None:

@@ -150,6 +150,47 @@ def per_doc_distinct(v):
     return v
 
 
+# distance-bounded (no objectLimit) search-scoped Aggregate refuses to
+# truncate past this many hits — erroring beats a silently-wrong mean
+DISTANCE_AGG_CAP = 100_000
+
+
+def aggregate_objects(objs, props: dict, group_by=None,
+                      top_occurrences_limit: int = 5) -> dict:
+    """Aggregate over an explicit object list — the search-scoped
+    Aggregate (reference ``traverser_aggregate.go``: near*/hybrid +
+    objectLimit aggregates the top hits). Returns the same shape as
+    ``Collection.aggregate`` so reply builders are shared."""
+    def _vals(obj_list, prop):
+        out = []
+        for o in obj_list:
+            v = o.properties.get(prop)
+            if v is None:
+                continue
+            v = per_doc_distinct(v)
+            out.extend(v) if isinstance(v, list) else out.append(v)
+        return out
+
+    def _props(obj_list):
+        return {p: aggregate_property(_vals(obj_list, p), kind,
+                                      top_occurrences_limit)
+                for p, kind in props.items()}
+
+    if group_by is None:
+        return {"meta": {"count": len(objs)},
+                "properties": _props(objs)}
+    groups: dict = {}
+    for o in objs:
+        gv = o.properties.get(group_by)
+        for g in (gv if isinstance(gv, list) else [gv]):
+            groups.setdefault(g, []).append(o)
+    return {"groups": [
+        {"groupedBy": {"path": [group_by], "value": g},
+         "meta": {"count": len(members)},
+         "properties": _props(members)}
+        for g, members in groups.items()]}
+
+
 def aggregate_property(
     values: list[Any],
     kind: Optional[str] = None,

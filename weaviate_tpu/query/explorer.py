@@ -101,8 +101,10 @@ class QueryParams:
     hybrid: Optional[HybridParams] = None
     # post-processing
     # exhaustive-cursor pagination (reference filters.Cursor): only
-    # valid for plain fetches — no search/sort/filters
-    after: str = ""
+    # valid for plain fetches — no search/sort/filters. None = no
+    # cursor; "" = cursor from the start (uuid order, reference REST
+    # ``?after=`` semantics)
+    after: Optional[str] = None
     sort: list[tuple[str, str]] = field(default_factory=list)
     group_by: Optional[GroupByParams] = None
     autocut: int = 0
@@ -150,7 +152,7 @@ class Explorer:
     def get(self, params: QueryParams) -> QueryResult:
         col = self.db.get_collection(params.collection)
         fetch = params.offset + params.limit
-        if params.after and (
+        if params.after is not None and (
                 params.filters is not None
                 or params.near_vector is not None
                 or params.near_text is not None
@@ -223,7 +225,7 @@ class Explorer:
                                      tenant=params.tenant)
             scored = [(o, 0.0) for o in objs]
         else:
-            if params.after and (params.sort or params.offset):
+            if params.after is not None and (params.sort or params.offset):
                 raise ValueError(
                     "cursor pagination (after) cannot combine with "
                     "sort or offset")
