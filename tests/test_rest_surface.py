@@ -1,0 +1,184 @@
+"""Round-4 REST surface sweep: the reference paths the earlier rounds
+lacked — root banner, uuid-only object routes, validate, shard status,
+graphql/batch, per-class nodes, cluster statistics, tasks, single
+tenant, RBAC role depth endpoints."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.api.rest import AuthConfig, RestAPI
+from weaviate_tpu.auth.rbac import RBACController
+from weaviate_tpu.core.db import DB
+
+
+@pytest.fixture
+def server(tmp_dbdir):
+    db = DB(tmp_dbdir)
+    rbac = RBACController(path=f"{tmp_dbdir}/rbac.json",
+                          root_users=["root"])
+    api = RestAPI(db, auth=AuthConfig(
+        api_keys={"rootkey": "root"}, anonymous_access=False), rbac=rbac)
+    srv = api.serve(host="127.0.0.1", port=0, background=True)
+    yield f"http://127.0.0.1:{srv.server_port}"
+    api.shutdown()
+    db.close()
+
+
+def call(base, method, path, body=None, key="rootkey"):
+    req = urllib.request.Request(
+        base + path,
+        data=None if body is None else json.dumps(body).encode(),
+        method=method,
+        headers={"Content-Type": "application/json",
+                 "Authorization": f"Bearer {key}"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            d = r.read()
+            return r.status, (json.loads(d) if d else None)
+    except urllib.error.HTTPError as e:
+        d = e.read()
+        return e.code, (json.loads(d) if d else None)
+
+
+def seed(base, n=8):
+    call(base, "POST", "/v1/schema", {
+        "class": "Doc", "vectorIndexType": "flat",
+        "vectorIndexConfig": {"distance": "l2-squared"},
+        "properties": [{"name": "t", "dataType": ["text"]},
+                       {"name": "n", "dataType": ["int"]}]})
+    objs = [{"class": "Doc", "id": f"00000000-0000-0000-0000-{i:012d}",
+             "properties": {"t": f"doc {i}", "n": i},
+             "vector": [float(i), 1.0]} for i in range(n)]
+    s, r = call(base, "POST", "/v1/batch/objects", {"objects": objs})
+    assert s == 200
+
+
+def test_root_and_oidc_discovery(server):
+    s, body = call(server, "GET", "/")
+    assert s == 200 and any("/v1/meta" in l["href"] for l in body["links"])
+    s, _ = call(server, "GET", "/v1/.well-known/openid-configuration")
+    assert s == 404  # OIDC not configured
+
+
+def test_uuid_only_object_routes(server):
+    seed(server)
+    uid = "00000000-0000-0000-0000-000000000003"
+    s, obj = call(server, "GET", f"/v1/objects/{uid}")
+    assert s == 200 and obj["properties"]["n"] == 3
+    s, _ = call(server, "PATCH", f"/v1/objects/{uid}",
+                {"class": "Doc", "properties": {"t": "patched"}})
+    assert s in (200, 204)
+    s, obj = call(server, "GET", f"/v1/objects/{uid}")
+    assert obj["properties"]["t"] == "patched"
+    s, _ = call(server, "DELETE", f"/v1/objects/{uid}")
+    assert s in (200, 204)
+    s, _ = call(server, "GET", f"/v1/objects/{uid}")
+    assert s == 404
+
+
+def test_objects_validate(server):
+    seed(server)
+    ok = {"class": "Doc", "properties": {"t": "x", "n": 5},
+          "vector": [0.0, 1.0]}
+    assert call(server, "POST", "/v1/objects/validate", ok)[0] == 200
+    bad_dims = {**ok, "vector": [0.0, 1.0, 2.0]}
+    assert call(server, "POST", "/v1/objects/validate", bad_dims)[0] == 422
+    bad_type = {**ok, "properties": {"t": "x", "n": "not-an-int"}}
+    assert call(server, "POST", "/v1/objects/validate", bad_type)[0] == 422
+    # nothing was written
+    s, page = call(server, "GET", "/v1/objects?class=Doc&limit=100")
+    assert len(page["objects"]) == 8
+
+
+def test_shard_status_readonly(server):
+    seed(server)
+    s, shards = call(server, "GET", "/v1/schema/Doc/shards")
+    assert s == 200 and shards[0]["status"] == "READY"
+    name = shards[0]["name"]
+    s, r = call(server, "PUT", f"/v1/schema/Doc/shards/{name}",
+                {"status": "READONLY"})
+    assert s == 200 and r["status"] == "READONLY"
+    s, r = call(server, "POST", "/v1/batch/objects", {"objects": [
+        {"class": "Doc", "properties": {"t": "x", "n": 99},
+         "vector": [9.0, 9.0]}]})
+    assert s == 200 and r[0]["result"]["status"] == "FAILED"
+    assert "READONLY" in json.dumps(r[0]["result"]["errors"])
+    s, _ = call(server, "PUT", f"/v1/schema/Doc/shards/{name}",
+                {"status": "READY"})
+    assert s == 200
+    s, r = call(server, "POST", "/v1/batch/objects", {"objects": [
+        {"class": "Doc", "properties": {"t": "x", "n": 99},
+         "vector": [9.0, 9.0]}]})
+    assert r[0]["result"]["status"] == "SUCCESS"
+
+
+def test_graphql_batch(server):
+    seed(server)
+    s, out = call(server, "POST", "/v1/graphql/batch", [
+        {"query": "{ Get { Doc(limit: 2) { t } } }"},
+        {"query": "{ Aggregate { Doc { meta { count } } } }"},
+        {"query": "{ Get { Missing { t } } }"},
+    ])
+    assert s == 200 and len(out) == 3
+    assert len(out[0]["data"]["Get"]["Doc"]) == 2
+    assert out[1]["data"]["Aggregate"]["Doc"][0]["meta"]["count"] == 8
+    assert out[2].get("errors")
+
+
+def test_nodes_class_and_statistics_and_tasks(server):
+    seed(server)
+    s, n = call(server, "GET", "/v1/nodes/Doc")
+    assert s == 200
+    assert all(sh["class"] == "Doc" for sh in n["nodes"][0]["shards"])
+    assert call(server, "GET", "/v1/nodes/Nope")[0] == 404
+    s, stats = call(server, "GET", "/v1/cluster/statistics")
+    assert s == 200 and stats["synchronized"] is True
+    assert stats["statistics"][0]["raft"]["state"] == "Leader"
+    s, tasks = call(server, "GET", "/v1/tasks")
+    assert s == 200 and tasks == {"tasks": []}
+
+
+def test_tenant_one(server):
+    call(server, "POST", "/v1/schema", {
+        "class": "MT", "multiTenancyConfig": {"enabled": True},
+        "properties": [{"name": "t", "dataType": ["text"]}]})
+    call(server, "POST", "/v1/schema/MT/tenants", [{"name": "alice"}])
+    s, t = call(server, "GET", "/v1/schema/MT/tenants/alice")
+    assert s == 200 and t["name"] == "alice"
+    assert call(server, "GET", "/v1/schema/MT/tenants/bob")[0] == 404
+
+
+def test_authz_role_depth(server):
+    s, _ = call(server, "POST", "/v1/authz/roles",
+                {"name": "reader", "permissions": [
+                    {"action": "read_data", "resource": "collections/Doc"}]})
+    assert s == 200
+    s, _ = call(server, "POST", "/v1/authz/roles/reader/add-permissions",
+                {"permissions": [{"action": "read_schema"}]})
+    assert s == 200
+    s, ok = call(server, "POST", "/v1/authz/roles/reader/has-permission",
+                 {"permission": {"action": "read_schema"}})
+    assert s == 200 and ok is True
+    s, ok = call(server, "POST", "/v1/authz/roles/reader/has-permission",
+                 {"permission": {"action": "delete_data"}})
+    assert ok is False
+    s, _ = call(server, "POST",
+                "/v1/authz/roles/reader/remove-permissions",
+                {"permissions": [{"action": "read_schema"}]})
+    assert s == 200
+    s, ok = call(server, "POST", "/v1/authz/roles/reader/has-permission",
+                 {"permission": {"action": "read_schema"}})
+    assert ok is False
+    call(server, "POST", "/v1/authz/users/alice/assign",
+         {"roles": ["reader"]})
+    s, users = call(server, "GET", "/v1/authz/roles/reader/users")
+    assert s == 200 and users == ["alice"]
+    s, asg = call(server, "GET",
+                  "/v1/authz/roles/reader/user-assignments")
+    assert asg == [{"userId": "alice", "userType": "db"}]
+    s, roles = call(server, "GET", "/v1/authz/users/alice/roles/db")
+    assert roles == ["reader"]
+    assert call(server, "GET", "/v1/authz/roles/nope/users")[0] == 404

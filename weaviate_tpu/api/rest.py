@@ -172,7 +172,11 @@ class RestAPI:
         self.backups = BackupHandler(db)
         self.backup_root = backup_root or f"{db.root}/backups"
         self.url_map = Map([
+            Rule("/", endpoint="root", methods=["GET"]),
+            Rule("/v1", endpoint="root", methods=["GET"]),
             Rule("/v1/meta", endpoint="meta", methods=["GET"]),
+            Rule("/v1/.well-known/openid-configuration",
+                 endpoint="oidc_discovery", methods=["GET"]),
             Rule("/v1/.well-known/ready", endpoint="ready", methods=["GET"]),
             Rule("/v1/.well-known/live", endpoint="live", methods=["GET"]),
             Rule("/v1/.well-known/openapi", endpoint="openapi",
@@ -182,9 +186,24 @@ class RestAPI:
                  methods=["GET", "PUT", "DELETE"]),
             Rule("/v1/schema/<cls>/properties", endpoint="schema_properties",
                  methods=["POST"]),
+            Rule("/v1/schema/<cls>/shards", endpoint="shards",
+                 methods=["GET"]),
+            Rule("/v1/schema/<cls>/shards/<shard>", endpoint="shard_status",
+                 methods=["PUT"]),
+            Rule("/v1/schema/<cls>/tenants/<tname>", endpoint="tenant_one",
+                 methods=["GET", "HEAD"]),
             Rule("/v1/schema/<cls>/tenants", endpoint="tenants",
                  methods=["GET", "POST", "PUT", "DELETE"]),
             Rule("/v1/objects", endpoint="objects", methods=["GET", "POST"]),
+            Rule("/v1/objects/validate", endpoint="objects_validate",
+                 methods=["POST"]),
+            # uuid-only legacy routes (reference /objects/{id}): the
+            # class is resolved by uuid scan across collections
+            Rule("/v1/objects/<uuid>", endpoint="object_by_id",
+                 methods=["GET", "HEAD", "PUT", "PATCH", "DELETE"]),
+            Rule("/v1/objects/<uuid>/references/<prop>",
+                 endpoint="object_by_id_references",
+                 methods=["POST", "PUT", "DELETE"]),
             Rule("/v1/objects/<cls>/<uuid>", endpoint="object",
                  methods=["GET", "PUT", "PATCH", "DELETE", "HEAD"]),
             Rule("/v1/batch/objects", endpoint="batch_objects",
@@ -195,7 +214,14 @@ class RestAPI:
                  endpoint="object_references",
                  methods=["POST", "PUT", "DELETE"]),
             Rule("/v1/graphql", endpoint="graphql", methods=["POST"]),
+            Rule("/v1/graphql/batch", endpoint="graphql_batch",
+                 methods=["POST"]),
             Rule("/v1/nodes", endpoint="nodes", methods=["GET"]),
+            Rule("/v1/nodes/<cls>", endpoint="nodes_class",
+                 methods=["GET"]),
+            Rule("/v1/cluster/statistics", endpoint="cluster_statistics",
+                 methods=["GET"]),
+            Rule("/v1/tasks", endpoint="tasks_list", methods=["GET"]),
             Rule("/metrics", endpoint="metrics", methods=["GET"]),
             # pprof-shaped profiling surface (reference serves Go pprof
             # on the metrics port; here cProfile/tracemalloc equivalents)
@@ -213,6 +239,19 @@ class RestAPI:
                  methods=["GET", "POST"]),
             Rule("/v1/authz/roles/<name>", endpoint="authz_role",
                  methods=["GET", "DELETE"]),
+            Rule("/v1/authz/roles/<name>/add-permissions",
+                 endpoint="authz_role_add_permissions", methods=["POST"]),
+            Rule("/v1/authz/roles/<name>/remove-permissions",
+                 endpoint="authz_role_remove_permissions",
+                 methods=["POST"]),
+            Rule("/v1/authz/roles/<name>/has-permission",
+                 endpoint="authz_role_has_permission", methods=["POST"]),
+            Rule("/v1/authz/roles/<name>/users",
+                 endpoint="authz_role_users", methods=["GET"]),
+            Rule("/v1/authz/roles/<name>/user-assignments",
+                 endpoint="authz_role_user_assignments", methods=["GET"]),
+            Rule("/v1/authz/users/<user>/roles/<user_type>",
+                 endpoint="authz_user_roles_typed", methods=["GET"]),
             Rule("/v1/authz/users/<user>/assign", endpoint="authz_assign",
                  methods=["POST"]),
             Rule("/v1/authz/users/<user>/revoke", endpoint="authz_revoke",
@@ -365,6 +404,28 @@ class RestAPI:
                 self.url_map, __version__)
         return _json_response(spec)
 
+    def on_root(self, request):
+        return _json_response({
+            "links": [
+                {"href": "/v1/meta", "name": "Meta information"},
+                {"href": "/v1/schema", "name": "Schema"},
+                {"href": "/v1/objects", "name": "Objects"},
+                {"href": "/v1/graphql", "name": "GraphQL"},
+                {"href": "/v1/.well-known/openapi", "name": "OpenAPI"},
+            ]})
+
+    def on_oidc_discovery(self, request):
+        """OIDC discovery (reference /.well-known/openid-configuration):
+        points clients at the configured issuer; 404 when OIDC is off."""
+        oidc = getattr(self.auth, "oidc", None)
+        if oidc is None:
+            _abort(404, "OIDC is not configured")
+        issuer = getattr(oidc, "issuer", "") or ""
+        return _json_response({
+            "href": issuer.rstrip("/") + "/.well-known/openid-configuration",
+            "clientID": getattr(oidc, "client_id", "") or "",
+        })
+
     def on_ready(self, request):
         return Response(status=200)
 
@@ -471,7 +532,74 @@ class RestAPI:
                 col.remove_tenant(name)
         return _json_response(tenants)
 
+    def on_tenant_one(self, request, cls, tname):
+        """GET/HEAD one tenant (reference
+        /schema/{className}/tenants/{tenantName})."""
+        self._authz(request, "read_tenants", f"collections/{cls}")
+        col = self.db.get_collection(cls)
+        status = col.tenants().get(tname)
+        if status is None:
+            _abort(404, f"tenant {tname!r} not found")
+        if request.method == "HEAD":
+            return Response(status=200)
+        return _json_response({"name": tname, "activityStatus": status})
+
+    def on_shards(self, request, cls):
+        """Shard list + status (reference /schema/{className}/shards)."""
+        self._authz(request, "read_schema", f"collections/{cls}")
+        col = self.db.get_collection(cls)
+        return _json_response(col.shard_statuses())
+
+    def on_shard_status(self, request, cls, shard):
+        """PUT status READY|READONLY (reference shards/{shardName});
+        READONLY shards reject writes atomically at the batch level."""
+        self._authz(request, "update_schema", f"collections/{cls}")
+        col = self.db.get_collection(cls)
+        body = self._body(request)
+        try:
+            status = col.set_shard_status(shard, body.get("status", ""))
+        except KeyError as e:
+            _abort(404, str(e))
+        return _json_response({"status": status})
+
     # -- objects -----------------------------------------------------------
+    def _resolve_uuid_class(self, uuid: str) -> str:
+        """Class for a uuid-only legacy route (reference /objects/{id}):
+        scan collections; 404 when the uuid exists nowhere."""
+        for name in self.db.collections():
+            col = self.db.get_collection(name)
+            try:
+                if col.exists(uuid):
+                    return name
+            except (KeyError, ValueError, TenantNotActive):
+                continue
+        _abort(404, f"object {uuid!r} not found")
+
+    def on_object_by_id(self, request, uuid):
+        return self.on_object(request, self._resolve_uuid_class(uuid),
+                              uuid)
+
+    def on_object_by_id_references(self, request, uuid, prop):
+        return self.on_object_references(
+            request, self._resolve_uuid_class(uuid), uuid, prop)
+
+    def on_objects_validate(self, request):
+        """Validate an object without writing it (reference
+        /objects/validate): schema + dims checks, 200 on valid."""
+        body = self._body(request)
+        obj = _obj_from_rest(body)
+        if not obj.collection:
+            _abort(422, "class required")
+        try:
+            col = self.db.get_collection(obj.collection)
+        except KeyError as e:
+            _abort(422, str(e))
+        try:
+            col.validate_object(obj)
+        except (KeyError, ValueError) as e:
+            _abort(422, str(e))
+        return Response(status=200)
+
     def on_objects(self, request):
         if request.method == "POST":
             body = self._body(request)
@@ -738,23 +866,82 @@ class RestAPI:
         return _json_response(results)
 
     # -- graphql -----------------------------------------------------------
+    def _graphql_authz(self, request, query: str) -> None:
+        """Per-class authz for every class a query touches (scoped
+        read_data grants must work); parse errors fall through to the
+        executor's error shape. Shared by /graphql and /graphql/batch."""
+        if self.rbac is None:
+            return
+        from weaviate_tpu.api.graphql import GraphQLError, parse
+
+        try:
+            for root in parse(query):
+                for cls in root.selections:
+                    self._authz(request, "read_data",
+                                f"collections/{cls.name}")
+        except GraphQLError:
+            pass
+
     def on_graphql(self, request):
         body = self._body(request)
         query = body.get("query", "")
-        if self.rbac is not None:
-            # authz per class the query touches (scoped read_data grants
-            # must work); parse errors fall through to the executor's
-            # error shape
-            from weaviate_tpu.api.graphql import GraphQLError, parse
-
-            try:
-                for root in parse(query):
-                    for cls in root.selections:
-                        self._authz(request, "read_data",
-                                    f"collections/{cls.name}")
-            except GraphQLError:
-                pass
+        self._graphql_authz(request, query)
         return _json_response(self.graphql.execute(query))
+
+    def on_graphql_batch(self, request):
+        """Batch of GraphQL queries in one request (reference
+        /graphql/batch): a JSON array of {query}; one result per entry,
+        errors isolated per query."""
+        body = self._body(request)
+        if not isinstance(body, list):
+            _abort(422, "expected a JSON array of GraphQL queries")
+        out = []
+        for entry in body:
+            if not isinstance(entry, dict):
+                out.append({"errors": [{"message":
+                                        "entry must be {query: ...}"}]})
+                continue
+            query = entry.get("query", "")
+            try:
+                self._graphql_authz(request, query)
+                out.append(self.graphql.execute(query))
+            except _Forbidden as e:
+                out.append({"errors": [{"message": str(e)}]})
+        return _json_response(out)
+
+    def on_cluster_statistics(self, request):
+        """Raft consensus statistics (reference /cluster/statistics):
+        per-node state/term/commit indexes; single-node servers report
+        a synchronized singleton."""
+        self._authz(request, "read_cluster")
+        if self.cluster is None:
+            return _json_response({"statistics": [{
+                "name": "node-0", "status": "HEALTHY",
+                "raft": {"state": "Leader", "term": 0,
+                         "commitIndex": 0, "appliedIndex": 0},
+                "leaderId": "node-0", "open": True, "bootstrapped": True,
+            }], "synchronized": True})
+        r = self.cluster.raft
+        return _json_response({"statistics": [{
+            "name": self.cluster.id,
+            "status": "HEALTHY",
+            "raft": {"state": r.state.capitalize(),
+                     "term": int(r.current_term),
+                     "commitIndex": int(r.commit_index),
+                     "appliedIndex": int(r.last_applied)},
+            "leaderId": r.leader_id or "",
+            "open": True,
+            "bootstrapped": True,
+        }], "synchronized": r.leader_id is not None})
+
+    def on_tasks_list(self, request):
+        """Distributed task table (reference /tasks; cluster/tasks.py
+        FSM). Single-node servers have no task plane — empty list."""
+        self._authz(request, "read_cluster")
+        if self.cluster is None or getattr(self.cluster, "tasks",
+                                           None) is None:
+            return _json_response({"tasks": []})
+        return _json_response({"tasks": self.cluster.tasks.list()})
 
     # -- metrics -----------------------------------------------------------
     # -- dynamic db users (reference rest/operations/users) ----------------
@@ -962,6 +1149,26 @@ class RestAPI:
     # -- nodes -------------------------------------------------------------
     def on_nodes(self, request):
         self._authz(request, "read_nodes")
+        return _json_response(self._nodes_dict())
+
+    def on_nodes_class(self, request, cls):
+        """Node status scoped to one collection (reference
+        /nodes/{className})."""
+        self._authz(request, "read_nodes")
+        if not self.db.has_collection(cls):
+            _abort(404, f"class {cls!r} not found")
+        full = self._nodes_dict()
+        for node in full["nodes"]:
+            node["shards"] = [s for s in node["shards"]
+                              if s.get("class") == cls]
+            node["stats"] = {
+                "objectCount": sum(s["objectCount"]
+                                   for s in node["shards"]),
+                "shardCount": len(node["shards"]),
+            }
+        return _json_response(full)
+
+    def _nodes_dict(self) -> dict:
         shards = []
         total = 0
         for name in self.db.collections():
@@ -981,7 +1188,7 @@ class RestAPI:
             "shards": shards,
         }
         if self.cluster is None:
-            return _json_response({"nodes": [local]})
+            return {"nodes": [local]}
         # clustered: every raft member, liveness from gossip (reference
         # /v1/nodes aggregates memberlist state the same way)
         nodes = [local]
@@ -1002,7 +1209,7 @@ class RestAPI:
                 "stats": {"objectCount": 0, "shardCount": 0},
                 "shards": [],
             })
-        return _json_response({"nodes": nodes})
+        return {"nodes": nodes}
 
     # -- backups -----------------------------------------------------------
     def _backend(self, name: str):
@@ -1093,6 +1300,67 @@ class RestAPI:
         except ValueError as e:
             _abort(422, str(e))
         return Response(status=204)
+
+    def on_authz_role_add_permissions(self, request, name):
+        rbac = self._rbac_or_404()
+        self._authz(request, "manage_roles")
+        body = self._body(request)
+        try:
+            role = rbac.add_permissions(name, body.get("permissions", []))
+        except KeyError as e:
+            _abort(404, str(e))
+        except ValueError as e:
+            _abort(422, str(e))
+        return _json_response({"name": role.name})
+
+    def on_authz_role_remove_permissions(self, request, name):
+        rbac = self._rbac_or_404()
+        self._authz(request, "manage_roles")
+        body = self._body(request)
+        try:
+            role = rbac.remove_permissions(name,
+                                           body.get("permissions", []))
+        except KeyError as e:
+            _abort(404, str(e))
+        except ValueError as e:
+            _abort(422, str(e))
+        return _json_response({"name": role.name})
+
+    def on_authz_role_has_permission(self, request, name):
+        rbac = self._rbac_or_404()
+        self._authz(request, "read_roles")
+        body = self._body(request)
+        p = body.get("permission", body)
+        try:
+            ok = rbac.role_has_permission(
+                name, p.get("action", ""), p.get("resource", "*"))
+        except KeyError as e:
+            _abort(404, str(e))
+        return _json_response(bool(ok))
+
+    def on_authz_role_users(self, request, name):
+        rbac = self._rbac_or_404()
+        self._authz(request, "read_roles")
+        try:
+            return _json_response(rbac.users_with_role(name))
+        except KeyError as e:
+            _abort(404, str(e))
+
+    def on_authz_role_user_assignments(self, request, name):
+        rbac = self._rbac_or_404()
+        self._authz(request, "read_roles")
+        try:
+            users = rbac.users_with_role(name)
+        except KeyError as e:
+            _abort(404, str(e))
+        return _json_response([
+            {"userId": u, "userType": "db"} for u in users])
+
+    def on_authz_user_roles_typed(self, request, user, user_type):
+        # userType (db | oidc) narrows nothing here: one identity plane
+        rbac = self._rbac_or_404()
+        self._authz(request, "read_roles")
+        return _json_response(rbac.user_roles(user))
 
     def on_authz_assign(self, request, user):
         rbac = self._rbac_or_404()

@@ -152,6 +152,71 @@ class RBACController:
             self.assignments.get(user, set()).discard(role)
             self._persist()
 
+    def add_permissions(self, name: str,
+                        permissions: list[dict]) -> Role:
+        """Append permissions to an existing role (reference
+        /authz/roles/{id}/add-permissions)."""
+        with self._lock:
+            if name in ("admin", "viewer"):
+                raise ValueError(f"built-in role {name!r} is immutable")
+            role = self.roles.get(name)
+            if role is None:
+                raise KeyError(f"role {name!r} not found")
+            # validate EVERY entry before appending ANY: a bad later
+            # entry must not leave earlier grants live-but-unpersisted
+            parsed = []
+            for p in permissions:
+                if "action" not in p:
+                    raise ValueError("permission missing 'action'")
+                perm = Permission(p["action"], p.get("resource", "*"))
+                if perm.action not in ACTIONS:
+                    raise ValueError(f"unknown action {perm.action!r}")
+                parsed.append(perm)
+            have = {(p.action, p.resource) for p in role.permissions}
+            for perm in parsed:
+                if (perm.action, perm.resource) not in have:
+                    role.permissions.append(perm)
+                    have.add((perm.action, perm.resource))
+            self._persist()
+            return role
+
+    def remove_permissions(self, name: str,
+                           permissions: list[dict]) -> Role:
+        with self._lock:
+            if name in ("admin", "viewer"):
+                raise ValueError(f"built-in role {name!r} is immutable")
+            role = self.roles.get(name)
+            if role is None:
+                raise KeyError(f"role {name!r} not found")
+            if any("action" not in p for p in permissions):
+                raise ValueError("permission missing 'action'")
+            drop = {(p["action"], p.get("resource", "*"))
+                    for p in permissions}
+            role.permissions = [
+                p for p in role.permissions
+                if (p.action, p.resource) not in drop]
+            self._persist()
+            return role
+
+    def role_has_permission(self, name: str, action: str,
+                            resource: str = "*") -> bool:
+        with self._lock:
+            role = self.roles.get(name)
+            if role is None:
+                raise KeyError(f"role {name!r} not found")
+            return role.allows(action, resource)
+
+    def users_with_role(self, name: str) -> list[str]:
+        """Users assigned a role (reference /authz/roles/{id}/users)."""
+        with self._lock:
+            if name not in self.roles:
+                raise KeyError(f"role {name!r} not found")
+            out = sorted(u for u, rs in self.assignments.items()
+                         if name in rs)
+            if name == "admin":
+                out = sorted(set(out) | set(self.root_users))
+            return out
+
     def user_roles(self, user: str) -> list[str]:
         with self._lock:
             roles = set(self.assignments.get(user, set()))

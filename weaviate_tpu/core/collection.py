@@ -8,6 +8,7 @@ fans searches out per shard and merges (``index.go:1928 objectVectorSearch``,
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -50,6 +51,15 @@ class Collection:
         self._shards: dict[str, Shard] = {}
         self._building: dict[str, threading.Event] = {}  # in-flight opens
         self._tenant_status: dict[str, str] = {}
+        # per-shard serving status (reference /schema/{class}/shards:
+        # READY | READONLY); only non-READY entries are persisted
+        self._shard_status: dict[str, str] = {}
+        self._shard_status_path = os.path.join(dirpath, "shard_status.json")
+        try:
+            with open(self._shard_status_path) as f:
+                self._shard_status = json.load(f)
+        except (OSError, ValueError):
+            pass
         self._maintenance_pause = 0  # backup copy windows (counter)
         self._pool = ThreadPoolExecutor(max_workers=8)
         if not config.multi_tenancy.enabled:
@@ -575,6 +585,7 @@ class Collection:
         for o in objs:
             shard = self._route(o.uuid, tenant)
             by_shard.setdefault(shard.name, []).append(o)
+        self._reject_readonly(by_shard)
         for name, group in by_shard.items():
             self._shards[name].put_batch(group)
         BATCH_DURATION.observe(time.perf_counter() - t0,
@@ -584,14 +595,51 @@ class Collection:
     def put(self, obj: StorageObject, tenant: str = "") -> str:
         return self.put_batch([obj], tenant)[0]
 
+    # -- shard status (reference /schema/{class}/shards) -------------------
+    def shard_statuses(self) -> list[dict]:
+        with self._lock:
+            return [{"name": n,
+                     "status": self._shard_status.get(n, "READY"),
+                     "vectorQueueSize": (
+                         s.async_queue.size()
+                         if getattr(s, "async_queue", None) else 0)}
+                    for n, s in sorted(self._shards.items())]
+
+    def set_shard_status(self, name: str, status: str) -> str:
+        status = status.upper()
+        if status not in ("READY", "READONLY"):
+            raise ValueError(f"invalid shard status {status!r} "
+                             "(READY | READONLY)")
+        with self._lock:
+            if name not in self._shards:
+                raise KeyError(f"shard {name!r} not found")
+            if status == "READY":
+                self._shard_status.pop(name, None)
+            else:
+                self._shard_status[name] = status
+            tmp = self._shard_status_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._shard_status, f)
+            os.replace(tmp, self._shard_status_path)
+        return status
+
     def delete(self, uuids: list[str], tenant: str = "") -> int:
         by_shard: dict[str, list[str]] = {}
         for u in uuids:
             shard = self._route(u, tenant)
             by_shard.setdefault(shard.name, []).append(u)
+        self._reject_readonly(by_shard)
         return sum(
             self._shards[name].delete(group) for name, group in by_shard.items()
         )
+
+    def _reject_readonly(self, shard_names) -> None:
+        """Deletes are writes too: a READONLY shard rejects every
+        mutation, checked before ANY shard is touched (atomic)."""
+        ro = [n for n in shard_names
+              if self._shard_status.get(n) == "READONLY"]
+        if ro:
+            raise ValueError(f"shards {ro} are READONLY")
 
     def _check_ref_prop(self, prop: str) -> None:
         p = self.config.property(prop)
@@ -649,8 +697,10 @@ class Collection:
 
     def delete_where(self, flt: Filter, tenant: str = "") -> int:
         """Batch delete by filter (reference ``batch_delete.go``)."""
+        shards = self._search_shards(tenant)
+        self._reject_readonly([s.name for s in shards])
         n = 0
-        for shard in self._search_shards(tenant):
+        for shard in shards:
             space = shard._next_doc_id
             mask = shard.allow_list(flt, space)
             doc_ids = np.nonzero(mask)[0]
@@ -668,6 +718,67 @@ class Collection:
 
     def exists(self, uuid: str, tenant: str = "") -> bool:
         return self._route(uuid, tenant).exists(uuid)
+
+    def validate_object(self, obj: StorageObject, tenant: str = "") -> None:
+        """Write-path validation WITHOUT writing (reference
+        /objects/validate): uuid shape, vector dims vs the live index,
+        and property names/types against the schema."""
+        import uuid as _uuid
+
+        if obj.uuid:
+            try:
+                _uuid.UUID(obj.uuid)
+            except ValueError:
+                raise ValueError(f"invalid uuid {obj.uuid!r}")
+        # dims come from any OPEN shard (index configs are uniform
+        # across shards) — never via _route, whose auto-tenant paths
+        # create/activate tenants, a mutation a validate must not do
+        dims: dict[str, int] = {}
+        with self._lock:
+            for s in self._shards.values():
+                if s._dims:
+                    dims = s._dims
+                    break
+        vec_items = []
+        if obj.vector is not None:
+            vec_items.append((DEFAULT_VECTOR, obj.vector))
+        vec_items.extend(obj.named_vectors.items())
+        for nm, vec in vec_items:
+            d = int(np.asarray(vec).shape[-1])
+            want = dims.get(nm)
+            if want is not None and d != want:
+                raise ValueError(
+                    f"vector {nm or 'default'!r} dims {d} != index "
+                    f"dims {want}")
+        from weaviate_tpu.schema.auto_schema import infer_data_type
+        from weaviate_tpu.schema.config import DataType
+
+        # widenings the write path accepts (int into a number column,
+        # date/uuid strings into text)
+        compatible = {
+            (DataType.INT, DataType.NUMBER),
+            (DataType.INT_ARRAY, DataType.NUMBER_ARRAY),
+            (DataType.DATE, DataType.TEXT),
+            (DataType.UUID, DataType.TEXT),
+            (DataType.DATE_ARRAY, DataType.TEXT_ARRAY),
+            (DataType.UUID_ARRAY, DataType.TEXT_ARRAY),
+        }
+        for pname, val in obj.properties.items():
+            prop = self.config.property(pname)
+            if prop is None:
+                continue  # auto-schema would add it on write
+            if val is None:
+                continue
+            inferred = infer_data_type(val)
+            if inferred is None:
+                continue
+            declared = prop.data_type
+            if inferred != declared \
+                    and (inferred, declared) not in compatible:
+                raise ValueError(
+                    f"property {pname!r}: inferred type "
+                    f"{inferred.value} does not match declared "
+                    f"{declared.value}")
 
     def count(self, tenant: str = "") -> int:
         return sum(s.count() for s in self._search_shards(tenant))
