@@ -151,6 +151,41 @@ def test_tenant_one(server):
     assert call(server, "GET", "/v1/schema/MT/tenants/bob")[0] == 404
 
 
+def test_aliases(server):
+    seed(server)
+    s, _ = call(server, "POST", "/v1/aliases",
+                {"alias": "Articles", "class": "Doc"})
+    assert s == 200
+    # resolves everywhere a class name is accepted
+    s, page = call(server, "GET", "/v1/objects?class=Articles&limit=3")
+    assert s == 200 and len(page["objects"]) == 3
+    s, out = call(server, "GET", "/v1/aliases")
+    assert out["aliases"] == [{"alias": "Articles", "class": "Doc"}]
+    s, one = call(server, "GET", "/v1/aliases/Articles")
+    assert one["class"] == "Doc"
+    # collisions rejected both directions
+    s, _ = call(server, "POST", "/v1/aliases",
+                {"alias": "Doc", "class": "Doc"})
+    assert s == 422
+    s, _ = call(server, "POST", "/v1/schema", {"class": "Articles"})
+    assert s == 422
+    # re-point then delete
+    call(server, "POST", "/v1/schema", {
+        "class": "Doc2", "properties": [{"name": "t",
+                                         "dataType": ["text"]}]})
+    s, _ = call(server, "PUT", "/v1/aliases/Articles", {"class": "Doc2"})
+    assert s == 200
+    assert call(server, "GET",
+                "/v1/aliases/Articles")[1]["class"] == "Doc2"
+    s, _ = call(server, "DELETE", "/v1/aliases/Articles")
+    assert s == 204
+    assert call(server, "GET", "/v1/aliases/Articles")[0] == 404
+    # deleting a class drops its aliases
+    call(server, "POST", "/v1/aliases", {"alias": "D2", "class": "Doc2"})
+    call(server, "DELETE", "/v1/schema/Doc2")
+    assert call(server, "GET", "/v1/aliases/D2")[0] == 404
+
+
 def test_authz_role_depth(server):
     s, _ = call(server, "POST", "/v1/authz/roles",
                 {"name": "reader", "permissions": [

@@ -182,6 +182,10 @@ class RestAPI:
             Rule("/v1/.well-known/openapi", endpoint="openapi",
                  methods=["GET"]),
             Rule("/v1/schema", endpoint="schema", methods=["GET", "POST"]),
+            Rule("/v1/aliases", endpoint="aliases",
+                 methods=["GET", "POST"]),
+            Rule("/v1/aliases/<alias>", endpoint="alias_one",
+                 methods=["GET", "PUT", "DELETE"]),
             Rule("/v1/schema/<cls>", endpoint="schema_class",
                  methods=["GET", "PUT", "DELETE"]),
             Rule("/v1/schema/<cls>/properties", endpoint="schema_properties",
@@ -451,6 +455,60 @@ class RestAPI:
         except ValueError as e:
             _abort(422, str(e))
         return _json_response(class_to_rest(cfg))
+
+    # -- aliases (reference /v1/aliases) -----------------------------------
+    def on_aliases(self, request):
+        if request.method == "GET":
+            self._authz(request, "read_schema")
+            target = request.args.get("class", "")
+            return _json_response({"aliases": [
+                {"alias": a, "class": t}
+                for a, t in self.db.aliases(target).items()]})
+        self._authz(request, "create_schema")
+        body = self._body(request)
+        alias, target = body.get("alias", ""), body.get("class", "")
+        if not alias or not target:
+            _abort(422, "alias and class are required")
+        try:
+            if self.cluster is not None:
+                self.cluster.set_alias(alias, target)
+            else:
+                self.db.set_alias(alias, target)
+        except KeyError as e:
+            _abort(404, str(e))
+        except ValueError as e:
+            _abort(422, str(e))
+        return _json_response({"alias": alias, "class": target})
+
+    def on_alias_one(self, request, alias):
+        if request.method == "GET":
+            self._authz(request, "read_schema")
+            target = self.db.aliases().get(alias)
+            if target is None:
+                _abort(404, f"alias {alias!r} not found")
+            return _json_response({"alias": alias, "class": target})
+        if request.method == "PUT":
+            # re-point the alias at a new class (reference alias update)
+            self._authz(request, "update_schema")
+            if alias not in self.db.aliases():
+                _abort(404, f"alias {alias!r} not found")
+            target = self._body(request).get("class", "")
+            try:
+                if self.cluster is not None:
+                    self.cluster.set_alias(alias, target)
+                else:
+                    self.db.set_alias(alias, target)
+            except KeyError as e:
+                _abort(404, str(e))
+            except ValueError as e:
+                _abort(422, str(e))
+            return _json_response({"alias": alias, "class": target})
+        self._authz(request, "delete_schema")
+        if self.cluster is not None:
+            self.cluster.delete_alias(alias)
+        else:
+            self.db.delete_alias(alias)
+        return Response(status=204)
 
     def on_schema_class(self, request, cls):
         if request.method == "GET":

@@ -567,6 +567,22 @@ class _Collections:
     def delete(self, name: str) -> None:
         self._http.call("DELETE", f"/v1/schema/{name}")
 
+    # -- aliases -----------------------------------------------------------
+    def create_alias(self, alias: str, target: str) -> None:
+        self._http.call("POST", "/v1/aliases",
+                        {"alias": alias, "class": target})
+
+    def list_aliases(self, target: str = "") -> dict[str, str]:
+        out = self._http.call("GET", "/v1/aliases",
+                              params={"class": target})
+        return {a["alias"]: a["class"] for a in out.get("aliases", [])}
+
+    def update_alias(self, alias: str, target: str) -> None:
+        self._http.call("PUT", f"/v1/aliases/{alias}", {"class": target})
+
+    def delete_alias(self, alias: str) -> None:
+        self._http.call("DELETE", f"/v1/aliases/{alias}")
+
 
 class _Backup:
     def __init__(self, http: _Http):

@@ -48,6 +48,12 @@ class SchemaFSM:
                 cfg = CollectionConfig.from_dict(cmd["class"])
                 self.db.update_collection(cfg.name, cfg)
                 return {"ok": True}
+            if op == "alias_set":
+                self.db.set_alias(cmd["alias"], cmd["target"])
+                return {"ok": True}
+            if op == "alias_delete":
+                self.db.delete_alias(cmd["alias"])
+                return {"ok": True}
             if op == "add_property":
                 prop = Property.from_dict(cmd["property"])
                 try:

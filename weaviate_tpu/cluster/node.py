@@ -174,6 +174,17 @@ class ClusterNode:
         if not r.get("ok"):
             raise ValueError(r.get("error", "add_class failed"))
 
+    def set_alias(self, alias: str, target: str) -> None:
+        r = self.raft.submit({"op": "alias_set", "alias": alias,
+                              "target": target})
+        if not r.get("ok"):
+            raise ValueError(r.get("error", "alias_set failed"))
+
+    def delete_alias(self, alias: str) -> None:
+        r = self.raft.submit({"op": "alias_delete", "alias": alias})
+        if not r.get("ok"):
+            raise ValueError(r.get("error", "alias_delete failed"))
+
     def delete_collection(self, name: str) -> None:
         self.raft.submit({"op": "delete_class", "name": name})
 

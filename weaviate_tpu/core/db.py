@@ -29,6 +29,9 @@ class DB:
         os.makedirs(root, exist_ok=True)
         self._lock = threading.RLock()
         self._collections: dict[str, Collection] = {}
+        # collection aliases (reference /v1/aliases): alias -> class,
+        # one namespace with class names, resolved in get_collection
+        self._aliases: dict[str, str] = {}
         self._schema_path = os.path.join(root, "schema.json")
         self._load_schema()
         # background maintenance cycles (reference entities/cyclemanager):
@@ -105,11 +108,14 @@ class DB:
                 sync_writes=self.sync_writes, modules=self.modules,
                 db=self,
             )
+        self._aliases = dict(data.get("aliases", {}))
 
     def _persist_schema(self) -> None:
         data = {
             "collections": [c.config.to_dict() for c in self._collections.values()]
         }
+        if self._aliases:
+            data["aliases"] = self._aliases
         tmp = self._schema_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(data, f, indent=1)
@@ -121,6 +127,10 @@ class DB:
         with self._lock:
             if config.name in self._collections:
                 raise ValueError(f"collection {config.name!r} already exists")
+            if config.name in self._aliases:
+                raise ValueError(
+                    f"collection name {config.name!r} collides with an "
+                    "alias")
             c = Collection(
                 os.path.join(self.root, config.name),
                 config,
@@ -134,23 +144,50 @@ class DB:
 
     def get_collection(self, name: str) -> Collection:
         c = self._collections.get(name)
+        if c is None and name in self._aliases:
+            c = self._collections.get(self._aliases[name])
         if c is None:
             raise KeyError(f"collection {name!r} not found")
         return c
 
     def has_collection(self, name: str) -> bool:
-        return name in self._collections
+        return name in self._collections or name in self._aliases
 
     def delete_collection(self, name: str) -> None:
         with self._lock:
             c = self._collections.pop(name, None)
             if c is None:
                 return
+            # aliases of a dropped class go with it (a dangling alias
+            # would 404 confusingly on every later use)
+            for a in [a for a, t in self._aliases.items() if t == name]:
+                del self._aliases[a]
             c.close()
             import shutil
 
             shutil.rmtree(c.dir, ignore_errors=True)
             self._persist_schema()
+
+    # -- aliases (reference /v1/aliases) ----------------------------------
+    def set_alias(self, alias: str, target: str) -> None:
+        with self._lock:
+            if target not in self._collections:
+                raise KeyError(f"collection {target!r} not found")
+            if alias in self._collections:
+                raise ValueError(
+                    f"alias {alias!r} collides with a collection name")
+            self._aliases[alias] = target
+            self._persist_schema()
+
+    def delete_alias(self, alias: str) -> None:
+        with self._lock:
+            if self._aliases.pop(alias, None) is not None:
+                self._persist_schema()
+
+    def aliases(self, target: str = "") -> dict[str, str]:
+        with self._lock:
+            return {a: t for a, t in sorted(self._aliases.items())
+                    if not target or t == target}
 
     def add_property(self, collection: str, prop) -> None:
         with self._lock:
