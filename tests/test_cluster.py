@@ -440,6 +440,76 @@ def test_move_shard_copies_flips_routing_and_drops_source(cluster3):
     assert len(res) == 5
 
 
+def test_copy_shard_adds_replica_keeps_source(cluster3):
+    """COPY (scale-out): dst joins the replica set, src keeps its copy,
+    reads succeed from either."""
+    nodes, registry = cluster3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=1, shards=2))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    objs = _objs(16)
+    leader.put_batch("Doc", objs, consistency="ONE")
+    state = leader._state_for("Doc")
+    shard = 0
+    src = state.replicas(shard)[0]
+    dst = next(n for n in ("n0", "n1", "n2")
+               if n not in state.replicas(shard))
+    moved = leader.copy_shard("Doc", shard, src, dst)
+    assert moved > 0
+    wait_for(lambda: all(
+        set(n._state_for("Doc").replicas(shard)) == {src, dst}
+        for n in nodes), msg="replica set widened")
+    # both copies hold the shard's objects
+    src_node = next(n for n in nodes if n.id == src)
+    dst_node = next(n for n in nodes if n.id == dst)
+    assert src_node._local_shard("Doc", shard).count() > 0
+    assert (dst_node._local_shard("Doc", shard).count()
+            == src_node._local_shard("Doc", shard).count())
+    for o in objs:
+        assert leader.get("Doc", o.uuid, consistency="ONE") is not None
+
+
+def test_replication_ops_api(cluster3):
+    """Async op registry: REGISTERED -> READY lifecycle, list/get/
+    cancel/force-delete (reference /v1/replication/replicate)."""
+    import time as _t
+
+    nodes, registry = cluster3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=1, shards=2))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    leader.put_batch("Doc", _objs(12), consistency="ONE")
+    state = leader._state_for("Doc")
+    shard = 1
+    src = state.replicas(shard)[0]
+    dst = next(n for n in ("n0", "n1", "n2")
+               if n not in state.replicas(shard))
+    op_id = leader.start_replication_op("Doc", shard, src, dst,
+                                        kind="COPY")
+    wait_for(lambda: leader.replication_op(op_id)["status"]
+             in ("READY", "FAILED"), timeout=30, msg="op completion")
+    op = leader.replication_op(op_id)
+    assert op["status"] == "READY", op
+    assert op["transferType"] == "COPY"
+    assert leader.replication_ops(cls="Doc")[0]["id"] == op_id
+    assert leader.replication_ops(cls="Other") == []
+    # sharding state reflects the widened replica set
+    ss = leader.sharding_state("Doc")
+    row = next(s for s in ss["Doc"]["shards"] if s["shard"] == str(shard))
+    assert set(row["replicas"]) == {src, dst}
+    # invalid op requests fail synchronously
+    with pytest.raises(ValueError):
+        leader.start_replication_op("Doc", shard, src, dst, kind="COPY")
+    with pytest.raises(ValueError):
+        leader.start_replication_op("Doc", 0, "nope", "n1")
+    # cancel of a finished op is acknowledged but terminal; force-delete
+    assert leader.cancel_replication_op(op_id) is True
+    assert leader.delete_replication_ops() == 1
+    assert leader.replication_op(op_id) is None
+
+
 def test_move_shard_is_live_writes_never_rejected(cluster3):
     """The source stays writable for the whole move (no freeze): a writer
     hammering the MOVING shard sees zero rejections, and every write —

@@ -151,6 +151,18 @@ def test_tenant_one(server):
     assert call(server, "GET", "/v1/schema/MT/tenants/bob")[0] == 404
 
 
+def test_replication_requires_cluster(server):
+    s, body = call(server, "POST", "/v1/replication/replicate",
+                   {"collection": "Doc", "shard": 0,
+                    "sourceNode": "a", "targetNode": "b"})
+    assert s == 422 and "cluster" in body["error"][0]["message"]
+    assert call(server, "GET",
+                "/v1/replication/sharding-state")[0] == 422
+    s, _ = call(server, "POST", "/v1/replication/replicate",
+                {"collection": "Doc"})
+    assert s == 422  # missing fields are 422 too
+
+
 def test_aliases(server):
     seed(server)
     s, _ = call(server, "POST", "/v1/aliases",

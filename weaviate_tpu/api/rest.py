@@ -225,6 +225,18 @@ class RestAPI:
                  methods=["GET"]),
             Rule("/v1/cluster/statistics", endpoint="cluster_statistics",
                  methods=["GET"]),
+            Rule("/v1/replication/replicate", endpoint="replicate",
+                 methods=["POST"]),
+            Rule("/v1/replication/replicate/list",
+                 endpoint="replicate_list", methods=["GET"]),
+            Rule("/v1/replication/replicate/force-delete",
+                 endpoint="replicate_force_delete", methods=["POST"]),
+            Rule("/v1/replication/replicate/<op_id>",
+                 endpoint="replicate_op", methods=["GET"]),
+            Rule("/v1/replication/replicate/<op_id>/cancel",
+                 endpoint="replicate_cancel", methods=["POST"]),
+            Rule("/v1/replication/sharding-state",
+                 endpoint="sharding_state", methods=["GET"]),
             Rule("/v1/tasks", endpoint="tasks_list", methods=["GET"]),
             Rule("/metrics", endpoint="metrics", methods=["GET"]),
             # pprof-shaped profiling surface (reference serves Go pprof
@@ -469,6 +481,16 @@ class RestAPI:
         alias, target = body.get("alias", ""), body.get("class", "")
         if not alias or not target:
             _abort(422, "alias and class are required")
+        self._set_alias(alias, target)
+        return _json_response({"alias": alias, "class": target})
+
+    def _set_alias(self, alias: str, target: str) -> None:
+        """Shared POST/PUT alias write with MODE-UNIFORM status codes:
+        a missing target class is 404 in both single-node and cluster
+        paths (the FSM flattens KeyError into ok:false, which would
+        otherwise surface as 422 only when clustered)."""
+        if target not in self.db.collections():
+            _abort(404, f"collection {target!r} not found")
         try:
             if self.cluster is not None:
                 self.cluster.set_alias(alias, target)
@@ -478,7 +500,6 @@ class RestAPI:
             _abort(404, str(e))
         except ValueError as e:
             _abort(422, str(e))
-        return _json_response({"alias": alias, "class": target})
 
     def on_alias_one(self, request, alias):
         if request.method == "GET":
@@ -493,15 +514,9 @@ class RestAPI:
             if alias not in self.db.aliases():
                 _abort(404, f"alias {alias!r} not found")
             target = self._body(request).get("class", "")
-            try:
-                if self.cluster is not None:
-                    self.cluster.set_alias(alias, target)
-                else:
-                    self.db.set_alias(alias, target)
-            except KeyError as e:
-                _abort(404, str(e))
-            except ValueError as e:
-                _abort(422, str(e))
+            if not target:
+                _abort(422, "class is required")
+            self._set_alias(alias, target)
             return _json_response({"alias": alias, "class": target})
         self._authz(request, "delete_schema")
         if self.cluster is not None:
@@ -991,6 +1006,64 @@ class RestAPI:
             "open": True,
             "bootstrapped": True,
         }], "synchronized": r.leader_id is not None})
+
+    # -- replication ops (reference /v1/replication) -----------------------
+    def _cluster_or_422(self):
+        if self.cluster is None:
+            _abort(422, "replication operations require a cluster")
+        return self.cluster
+
+    def on_replicate(self, request):
+        """Start an async COPY/MOVE of one shard replica (reference
+        POST /replication/replicate -> replication engine FSM)."""
+        self._authz(request, "manage_cluster")
+        c = self._cluster_or_422()
+        b = self._body(request)
+        for f in ("collection", "shard", "sourceNode", "targetNode"):
+            if not b.get(f) and b.get(f) != 0:
+                _abort(422, f"{f} is required")
+        try:
+            op_id = c.start_replication_op(
+                b["collection"], int(b["shard"]), b["sourceNode"],
+                b["targetNode"], kind=b.get("type", "MOVE"),
+                tenant=b.get("tenant", ""))
+        except KeyError as e:
+            _abort(404, str(e))
+        return _json_response({"id": op_id})
+
+    def on_replicate_op(self, request, op_id):
+        self._authz(request, "read_cluster")
+        op = self._cluster_or_422().replication_op(op_id)
+        if op is None:
+            _abort(404, f"replication op {op_id!r} not found")
+        return _json_response(op)
+
+    def on_replicate_list(self, request):
+        self._authz(request, "read_cluster")
+        c = self._cluster_or_422()
+        shard = request.args.get("shard")
+        return _json_response(c.replication_ops(
+            cls=request.args.get("collection", ""),
+            shard=int(shard) if shard is not None else None))
+
+    def on_replicate_cancel(self, request, op_id):
+        self._authz(request, "manage_cluster")
+        if not self._cluster_or_422().cancel_replication_op(op_id):
+            _abort(404, f"replication op {op_id!r} not found")
+        return Response(status=204)
+
+    def on_replicate_force_delete(self, request):
+        self._authz(request, "manage_cluster")
+        n = self._cluster_or_422().delete_replication_ops()
+        return _json_response({"deleted": n})
+
+    def on_sharding_state(self, request):
+        self._authz(request, "read_cluster")
+        c = self._cluster_or_422()
+        cls = request.args.get("collection", "")
+        if cls and not self.db.has_collection(cls):
+            _abort(404, f"class {cls!r} not found")
+        return _json_response(c.sharding_state(cls))
 
     def on_tasks_list(self, request):
         """Distributed task table (reference /tasks; cluster/tasks.py

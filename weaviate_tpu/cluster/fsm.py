@@ -38,7 +38,10 @@ class SchemaFSM:
         try:
             if op == "add_class":
                 cfg = CollectionConfig.from_dict(cmd["class"])
-                if not self.db.has_collection(cfg.name):
+                # strict name check: has_collection also matches
+                # aliases, and an alias collision must ERROR (as the
+                # single-node create does), not silently no-op
+                if cfg.name not in self.db.collections():
                     self.db.create_collection(cfg)
                 return {"ok": True}
             if op == "delete_class":
@@ -117,6 +120,7 @@ class SchemaFSM:
             "shard_overrides": self.shard_overrides,
             "shard_warming": self.shard_warming,
             "tasks": self.tasks.state(),
+            "aliases": self.db.aliases(),
         }
         return msgpack.packb(state, use_bin_type=True)
 
@@ -127,12 +131,20 @@ class SchemaFSM:
             if name not in want:
                 self.db.delete_collection(name)
         for name, cd in want.items():
-            if not self.db.has_collection(name):
+            if name not in self.db.collections():
                 self.db.create_collection(CollectionConfig.from_dict(cd))
         for name, tenants in state.get("tenants", {}).items():
             col = self.db.get_collection(name)
             for tname, status in tenants.items():
                 col.add_tenant(tname, status)
+        # reconcile aliases to the snapshot's exact set (stale local
+        # aliases must not survive a restore)
+        want_aliases = dict(state.get("aliases", {}))
+        for a in list(self.db.aliases()):
+            if a not in want_aliases:
+                self.db.delete_alias(a)
+        for a, t in want_aliases.items():
+            self.db.set_alias(a, t)
         self.shard_overrides = dict(state.get("shard_overrides", {}))
         self.shard_warming = dict(state.get("shard_warming", {}))
         self.tasks.load(state.get("tasks", {}))

@@ -103,6 +103,9 @@ class ClusterNode:
         from weaviate_tpu.cluster.tasks import DistributedTaskExecutor
 
         self.tasks = DistributedTaskExecutor(self)
+        # async replica-op registry (reference /v1/replication/replicate)
+        self._rep_ops: dict[str, dict] = {}
+        self._rep_ops_lock = threading.Lock()
         transport.start(self._dispatch)
         if heartbeat:
             self.raft.start()
@@ -219,6 +222,10 @@ class ClusterNode:
 
     # -- placement ---------------------------------------------------------
     def _state_for(self, cls: str) -> ShardingState:
+        # canonicalize aliases FIRST: overrides/warming are keyed by
+        # the canonical class name, and an alias prefix would read an
+        # empty override set (routing to dropped replicas)
+        cls = self.db.resolve_class(cls)
         cfg = self.db.get_collection(cls).config
         prefix = f"{cls}/"
         overrides = {
@@ -767,6 +774,192 @@ class ClusterNode:
                               "objects": blobs}, timeout=10.0)
         return rr.get("applied", 0)
 
+    # -- replication ops API (reference /v1/replication/replicate) ---------
+    def start_replication_op(self, cls: str, shard: int, src: str,
+                             dst: str, kind: str = "MOVE",
+                             tenant: str = "") -> str:
+        """Start an async COPY/MOVE replica operation; returns the op id
+        (reference POST /replication/replicate -> replication engine).
+        Status lifecycle: REGISTERED -> HYDRATING -> READY | CANCELLED |
+        FAILED(+error)."""
+        import uuid as _uuid
+
+        # canonical name throughout: the raft override keys this op
+        # will write must be the ones canonical-name traffic reads
+        cls = self.db.resolve_class(cls)
+        kind = kind.upper()
+        if kind not in ("COPY", "MOVE"):
+            raise ValueError(f"invalid replication type {kind!r}")
+        # validate now so the caller gets a 4xx, not an async failure
+        reps = self._state_for(cls).replicas(shard)
+        if src not in reps:
+            raise ValueError(f"{src!r} does not hold shard {shard}")
+        if dst in reps:
+            raise ValueError(f"{dst!r} already holds shard {shard}")
+        op_id = str(_uuid.uuid4())
+        op = {"id": op_id, "collection": cls, "shardId": str(shard),
+              "sourceNodeId": src, "targetNodeId": dst,
+              "transferType": kind, "tenant": tenant,
+              "status": "REGISTERED", "error": ""}
+        with self._rep_ops_lock:
+            # one in-flight op per shard (checked and registered under
+            # ONE lock hold): a second concurrent op would validate
+            # against the same pre-op replica set and its final routing
+            # commit would erase the first op's replica
+            for o in self._rep_ops.values():
+                if (o["collection"] == cls and o["shardId"] == str(shard)
+                        and o["status"] in ("REGISTERED", "HYDRATING")):
+                    raise ValueError(
+                        f"shard {shard} already has replication op "
+                        f"{o['id']} in flight")
+            self._rep_ops[op_id] = op
+
+        def _run():
+            with self._rep_ops_lock:
+                if op["status"] == "CANCELLED":
+                    return
+                op["status"] = "HYDRATING"
+            try:
+                fn = self.move_shard if kind == "MOVE" else self.copy_shard
+                fn(cls, shard, src, dst, tenant=tenant)
+                with self._rep_ops_lock:
+                    if op["status"] != "CANCELLED":
+                        op["status"] = "READY"
+            except Exception as e:
+                with self._rep_ops_lock:
+                    op["status"] = "FAILED"
+                    op["error"] = str(e)[:500]
+
+        t = threading.Thread(target=_run, daemon=True,
+                             name=f"replicate-{op_id[:8]}")
+        t.start()
+        return op_id
+
+    def replication_op(self, op_id: str) -> Optional[dict]:
+        with self._rep_ops_lock:
+            op = self._rep_ops.get(op_id)
+            return dict(op) if op else None
+
+    def replication_ops(self, cls: str = "",
+                        shard: Optional[int] = None) -> list[dict]:
+        with self._rep_ops_lock:
+            return [dict(o) for o in self._rep_ops.values()
+                    if (not cls or o["collection"] == cls)
+                    and (shard is None or o["shardId"] == str(shard))]
+
+    def cancel_replication_op(self, op_id: str) -> bool:
+        """Best-effort: an op still REGISTERED is cancelled outright; a
+        HYDRATING op runs to completion (the move path's own rollback
+        keeps routing consistent) — matching the reference's 'cancel is
+        advisory once data transfer started' stance."""
+        with self._rep_ops_lock:
+            op = self._rep_ops.get(op_id)
+            if op is None:
+                return False
+            if op["status"] == "REGISTERED":
+                op["status"] = "CANCELLED"
+            return True
+
+    def delete_replication_ops(self) -> int:
+        """Drop completed op records (reference force-delete)."""
+        with self._rep_ops_lock:
+            done = [k for k, o in self._rep_ops.items()
+                    if o["status"] in ("READY", "FAILED", "CANCELLED")]
+            for k in done:
+                del self._rep_ops[k]
+            return len(done)
+
+    def sharding_state(self, cls: str = "") -> dict:
+        """shard -> replica set per collection (reference
+        /replication/sharding-state)."""
+        out = {}
+        for name in (self.db.collections() if not cls else [cls]):
+            st = self._state_for(name)
+            out[name] = {
+                "shards": [
+                    {"shard": str(i), "replicas": st.replicas(i)}
+                    for i in range(st.n_shards)
+                ]}
+        return out
+
+    def copy_shard(self, cls: str, shard: int, src: str, dst: str,
+                   tenant: str = "", page: int = 512) -> int:
+        """ADD a replica on dst (reference replication type COPY —
+        scale-out): same hydrate/warming/converge discipline as
+        ``move_shard`` but the source stays a replica; the final raft
+        command clears warming with BOTH nodes in routing."""
+        cls = self.db.resolve_class(cls)
+        reps = self._validate_replica_op(cls, shard, src, dst)
+        return self._hydrate_join(cls, shard, src, dst, tenant, page,
+                                  reps, final_nodes=reps + [dst],
+                                  what="copy")
+
+    def _validate_replica_op(self, cls: str, shard: int, src: str,
+                             dst: str) -> list[str]:
+        reps = self._state_for(cls).replicas(shard)
+        if src not in reps:
+            raise ValueError(f"{src!r} does not hold shard {shard}")
+        if dst in reps:
+            raise ValueError(f"{dst!r} already holds shard {shard}")
+        return reps
+
+    def _hydrate_join(self, cls: str, shard: int, src: str, dst: str,
+                      tenant: str, page: int, reps: list[str],
+                      final_nodes: list[str], what: str) -> int:
+        """The shared hydrate -> warming-join -> converge -> atomic
+        routing-commit core of COPY and MOVE (phases 1-5 of
+        ``move_shard``'s docstring). ``final_nodes`` is the replica set
+        committed (with warming cleared, atomically) after a
+        verified-zero convergence; any failure rolls routing back to
+        ``reps`` exactly as before the op."""
+        moved = self._copy_shard_pages(cls, shard, src, dst, tenant, page)
+        moved += self._converge_replicas(cls, shard, src, dst, tenant)
+        res = self.raft.submit({
+            "op": "set_shard_warming", "class": cls, "shard": shard,
+            "nodes": [dst],
+        })
+        if res.get("ok"):
+            res = self.raft.submit({
+                "op": "set_shard_replicas", "class": cls, "shard": shard,
+                "nodes": reps + [dst],
+            })
+        if not res.get("ok"):
+            self.raft.submit({"op": "set_shard_warming", "class": cls,
+                              "shard": shard, "nodes": []})
+            raise ReplicationError(f"replica join failed: {res.get('error')}")
+        try:
+            converged = False
+            for _ in range(6):
+                if self._converge_replicas(cls, shard, src, dst,
+                                           tenant) == 0:
+                    converged = True
+                    break
+            if not converged:
+                raise ReplicationError(
+                    f"shard {shard} {what} src={src} dst={dst} did not "
+                    "converge; routing left unchanged")
+            res = self.raft.submit({
+                "op": "set_shard_replicas", "class": cls, "shard": shard,
+                "nodes": final_nodes,
+                "clear_warming": True,  # atomic with the commit
+            })
+            if not res.get("ok"):
+                raise ReplicationError(
+                    f"routing commit failed: {res.get('error')}")
+        except Exception:
+            # leave routing as it was before the op began
+            try:
+                self.raft.submit({
+                    "op": "set_shard_replicas", "class": cls,
+                    "shard": shard, "nodes": reps,
+                })
+                self.raft.submit({"op": "set_shard_warming", "class": cls,
+                                  "shard": shard, "nodes": []})
+            except Exception:
+                pass
+            raise
+        return moved
+
     def move_shard(self, cls: str, shard: int, src: str, dst: str,
                    tenant: str = "", page: int = 512) -> int:
         """LIVE-move a shard replica src -> dst; the source stays writable
@@ -796,57 +989,12 @@ class ClusterNode:
         A delete racing the copy window can leave dst holding the object
         until the periodic anti-entropy cycle applies tombstones — the same
         stance the read-repair path takes."""
-        state = self._state_for(cls)
-        reps = state.replicas(shard)
-        if src not in reps:
-            raise ValueError(f"{src!r} does not hold shard {shard}")
-        if dst in reps:
-            raise ValueError(f"{dst!r} already holds shard {shard}")
-        moved = self._copy_shard_pages(cls, shard, src, dst, tenant, page)
-        moved += self._converge_replicas(cls, shard, src, dst, tenant)
-        res = self.raft.submit({
-            "op": "set_shard_warming", "class": cls, "shard": shard,
-            "nodes": [dst],
-        })
-        if res.get("ok"):
-            res = self.raft.submit({
-                "op": "set_shard_replicas", "class": cls, "shard": shard,
-                "nodes": reps + [dst],
-            })
-        if not res.get("ok"):
-            self.raft.submit({"op": "set_shard_warming", "class": cls,
-                              "shard": shard, "nodes": []})
-            raise ReplicationError(f"replica join failed: {res.get('error')}")
-        try:
-            converged = False
-            for _ in range(6):
-                if self._converge_replicas(cls, shard, src, dst, tenant) == 0:
-                    converged = True
-                    break
-            if not converged:
-                raise ReplicationError(
-                    f"shard {shard} move src={src} dst={dst} did not "
-                    "converge; routing left unchanged")
-            res = self.raft.submit({
-                "op": "set_shard_replicas", "class": cls, "shard": shard,
-                "nodes": [dst if n == src else n for n in reps],
-                "clear_warming": True,  # atomic with the flip
-            })
-            if not res.get("ok"):
-                raise ReplicationError(
-                    f"routing flip failed: {res.get('error')}")
-        except Exception:
-            # leave routing as it was before the move began
-            try:
-                self.raft.submit({
-                    "op": "set_shard_replicas", "class": cls,
-                    "shard": shard, "nodes": reps,
-                })
-                self.raft.submit({"op": "set_shard_warming", "class": cls,
-                                  "shard": shard, "nodes": []})
-            except Exception:
-                pass
-            raise
+        cls = self.db.resolve_class(cls)
+        reps = self._validate_replica_op(cls, shard, src, dst)
+        moved = self._hydrate_join(
+            cls, shard, src, dst, tenant, page, reps,
+            final_nodes=[dst if n == src else n for n in reps],
+            what="move")
         # final post-flip pass: src is out of routing now (no new writes
         # land there), so any straggler that committed on src while dst
         # was still warming gets copied before the only other copy dies

@@ -184,6 +184,13 @@ class DB:
             if self._aliases.pop(alias, None) is not None:
                 self._persist_schema()
 
+    def resolve_class(self, name: str) -> str:
+        """Canonical class name for ``name`` (identity for non-aliases).
+        Cluster routing state (shard overrides, warming markers) is
+        keyed by canonical names — routing via an alias without
+        resolving would read empty overrides and write orphan keys."""
+        return self._aliases.get(name, name)
+
     def aliases(self, target: str = "") -> dict[str, str]:
         with self._lock:
             return {a: t for a, t in sorted(self._aliases.items())
