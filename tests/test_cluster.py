@@ -510,6 +510,26 @@ def test_replication_ops_api(cluster3):
     assert leader.replication_op(op_id) is None
 
 
+def test_scale_plan(cluster3):
+    nodes, registry = cluster3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=1, shards=2))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    plan = leader.scale_plan("Doc", 2)
+    assert plan["replicationFactor"] == 2
+    for row in plan["shards"]:
+        assert len(row["replicas"]) == 1
+        assert len(row["add"]) == 1
+        assert row["add"][0] not in row["replicas"]
+        assert row["remove"] == []
+    # shrink plan lists removals
+    plan3 = leader.scale_plan("Doc", 1)
+    assert all(r["add"] == [] for r in plan3["shards"])
+    with pytest.raises(ValueError):
+        leader.scale_plan("Doc", 9)
+
+
 def test_move_shard_is_live_writes_never_rejected(cluster3):
     """The source stays writable for the whole move (no freeze): a writer
     hammering the MOVING shard sees zero rejections, and every write —

@@ -151,6 +151,30 @@ def test_tenant_one(server):
     assert call(server, "GET", "/v1/schema/MT/tenants/bob")[0] == 404
 
 
+def test_authz_groups(server):
+    call(server, "POST", "/v1/authz/roles",
+         {"name": "geditor", "permissions": [{"action": "read_data"}]})
+    s, _ = call(server, "POST", "/v1/authz/groups/engineers/assign",
+                {"roles": ["geditor"]})
+    assert s == 200
+    s, roles = call(server, "GET",
+                    "/v1/authz/groups/engineers/roles/oidc")
+    assert roles == ["geditor"]
+    s, groups = call(server, "GET", "/v1/authz/groups/oidc")
+    assert groups == ["engineers"]
+    s, asg = call(server, "GET",
+                  "/v1/authz/roles/geditor/group-assignments")
+    assert asg == [{"groupId": "engineers", "groupType": "oidc"}]
+    s, _ = call(server, "POST", "/v1/authz/groups/engineers/revoke",
+                {"roles": ["geditor"]})
+    assert s == 200
+    s, roles = call(server, "GET",
+                    "/v1/authz/groups/engineers/roles/oidc")
+    assert roles == []
+    assert call(server, "POST", "/v1/authz/groups/x/assign",
+                {"roles": ["missing"]})[0] == 404
+
+
 def test_replication_requires_cluster(server):
     s, body = call(server, "POST", "/v1/replication/replicate",
                    {"collection": "Doc", "shard": 0,

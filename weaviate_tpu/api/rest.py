@@ -237,6 +237,8 @@ class RestAPI:
                  endpoint="replicate_cancel", methods=["POST"]),
             Rule("/v1/replication/sharding-state",
                  endpoint="sharding_state", methods=["GET"]),
+            Rule("/v1/replication/scale", endpoint="replication_scale",
+                 methods=["GET"]),
             Rule("/v1/tasks", endpoint="tasks_list", methods=["GET"]),
             Rule("/metrics", endpoint="metrics", methods=["GET"]),
             # pprof-shaped profiling surface (reference serves Go pprof
@@ -268,6 +270,17 @@ class RestAPI:
                  endpoint="authz_role_user_assignments", methods=["GET"]),
             Rule("/v1/authz/users/<user>/roles/<user_type>",
                  endpoint="authz_user_roles_typed", methods=["GET"]),
+            Rule("/v1/authz/groups/<group_type>", endpoint="authz_groups",
+                 methods=["GET"]),
+            Rule("/v1/authz/groups/<gid>/assign",
+                 endpoint="authz_group_assign", methods=["POST"]),
+            Rule("/v1/authz/groups/<gid>/revoke",
+                 endpoint="authz_group_revoke", methods=["POST"]),
+            Rule("/v1/authz/groups/<gid>/roles/<group_type>",
+                 endpoint="authz_group_roles", methods=["GET"]),
+            Rule("/v1/authz/roles/<name>/group-assignments",
+                 endpoint="authz_role_group_assignments",
+                 methods=["GET"]),
             Rule("/v1/authz/users/<user>/assign", endpoint="authz_assign",
                  methods=["POST"]),
             Rule("/v1/authz/users/<user>/revoke", endpoint="authz_revoke",
@@ -286,8 +299,11 @@ class RestAPI:
                  endpoint="users_db_activate", methods=["POST"]),
             Rule("/v1/users/db/<user_id>/deactivate",
                  endpoint="users_db_deactivate", methods=["POST"]),
+            # reference swagger publishes this path WITH the trailing
+            # slash; accept both without a 308 redirect (POST bodies
+            # don't survive redirects in some clients)
             Rule("/v1/classifications", endpoint="classifications",
-                 methods=["POST"]),
+                 methods=["POST"], strict_slashes=False),
             Rule("/v1/classifications/<cid>", endpoint="classification",
                  methods=["GET"]),
             # debug/ops plane (reference adapters/handlers/debug + runtime
@@ -1057,6 +1073,25 @@ class RestAPI:
         n = self._cluster_or_422().delete_replication_ops()
         return _json_response({"deleted": n})
 
+    def on_replication_scale(self, request):
+        """Scale plan (reference GET /replication/scale): per-shard
+        add/remove lists toward a desired factor; computes only."""
+        self._authz(request, "read_cluster")
+        c = self._cluster_or_422()
+        cls = request.args.get("collection", "")
+        if not cls:
+            _abort(422, "collection query param required")
+        if not self.db.has_collection(cls):
+            _abort(404, f"class {cls!r} not found")
+        try:
+            factor = int(request.args.get("replicationFactor", "0"))
+        except ValueError:
+            _abort(422, "replicationFactor must be an integer")
+        try:
+            return _json_response(c.scale_plan(cls, factor))
+        except ValueError as e:
+            _abort(422, str(e))
+
     def on_sharding_state(self, request):
         self._authz(request, "read_cluster")
         c = self._cluster_or_422()
@@ -1492,6 +1527,49 @@ class RestAPI:
         rbac = self._rbac_or_404()
         self._authz(request, "read_roles")
         return _json_response(rbac.user_roles(user))
+
+    # -- RBAC group subjects (reference /authz/groups; OIDC groups map
+    # to `group:<name>` principals in the assignment table) -------------
+    def on_authz_groups(self, request, group_type):
+        rbac = self._rbac_or_404()
+        self._authz(request, "read_roles")
+        return _json_response(sorted(
+            p[len("group:"):] for p, rs in rbac.assignments.items()
+            if p.startswith("group:") and rs))
+
+    def on_authz_group_assign(self, request, gid):
+        rbac = self._rbac_or_404()
+        self._authz(request, "manage_roles")
+        roles = self._body(request).get("roles", [])
+        missing = [r for r in roles if r not in rbac.roles]
+        if missing:
+            _abort(404, f"roles not found: {missing}")
+        for role in roles:
+            rbac.assign(f"group:{gid}", role)
+        return Response(status=200)
+
+    def on_authz_group_revoke(self, request, gid):
+        rbac = self._rbac_or_404()
+        self._authz(request, "manage_roles")
+        for role in self._body(request).get("roles", []):
+            rbac.revoke(f"group:{gid}", role)
+        return Response(status=200)
+
+    def on_authz_group_roles(self, request, gid, group_type):
+        rbac = self._rbac_or_404()
+        self._authz(request, "read_roles")
+        return _json_response(rbac.user_roles(f"group:{gid}"))
+
+    def on_authz_role_group_assignments(self, request, name):
+        rbac = self._rbac_or_404()
+        self._authz(request, "read_roles")
+        if name not in rbac.roles:
+            _abort(404, f"role {name!r} not found")
+        groups = sorted(
+            p[len("group:"):] for p, rs in rbac.assignments.items()
+            if p.startswith("group:") and name in rs)
+        return _json_response([
+            {"groupId": g, "groupType": "oidc"} for g in groups])
 
     def on_authz_assign(self, request, user):
         rbac = self._rbac_or_404()

@@ -869,6 +869,31 @@ class ClusterNode:
                 del self._rep_ops[k]
             return len(done)
 
+    def scale_plan(self, cls: str, factor: int) -> dict:
+        """Replication scale PLAN (reference GET /replication/scale):
+        per shard, which nodes would be added/removed to reach
+        ``factor``. Additions follow ring order over live membership;
+        nothing is executed — the operator drives the plan through
+        /replication/replicate ops."""
+        cls = self.db.resolve_class(cls)
+        if factor < 1:
+            raise ValueError("replicationFactor must be >= 1")
+        if factor > len(self.all_nodes):
+            raise ValueError(
+                f"replicationFactor {factor} exceeds cluster size "
+                f"{len(self.all_nodes)}")
+        st = self._state_for(cls)
+        shards = []
+        for i in range(st.n_shards):
+            have = st.replicas(i)
+            add = [n for n in self.all_nodes if n not in have]
+            add = add[: max(0, factor - len(have))]
+            remove = have[factor:] if len(have) > factor else []
+            shards.append({"shard": str(i), "replicas": have,
+                           "add": add, "remove": remove})
+        return {"collection": cls, "replicationFactor": factor,
+                "shards": shards}
+
     def sharding_state(self, cls: str = "") -> dict:
         """shard -> replica set per collection (reference
         /replication/sharding-state)."""
