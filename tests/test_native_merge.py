@@ -12,6 +12,7 @@ from weaviate_tpu import native
 from weaviate_tpu.storage.segment import (
     DiskSegment,
     merge_streams,
+    native_merge,
     native_merge_replace,
 )
 from weaviate_tpu.storage.store import Bucket
@@ -143,10 +144,86 @@ def test_bucket_compaction_uses_native(tmp_path, monkeypatch):
     b.close()
 
 
+def _mk_map_inputs(tmp_path, seed=11, nseg=3, nkeys=120, set_mode=False):
+    """Inverted/map-shaped segments: term -> {8B docid: 8B payload},
+    with member-level tombstones (nil) and whole-record overlap —
+    exactly what post_* postings buckets write."""
+    rng = random.Random(seed)
+    paths = []
+    for s in range(nseg):
+        items = {}
+        for t in rng.sample(range(nkeys), nkeys // 2):
+            key = f"term{t:05d}".encode()
+            members = {}
+            for d in rng.sample(range(200), rng.randint(1, 12)):
+                dk = int(d).to_bytes(8, "big")
+                if rng.random() < 0.2:
+                    # falsy pool: every shape Python's `if p` drops
+                    members[dk] = (rng.choice([False, 0, 0.0, b"", None])
+                                   if set_mode else None)
+                else:
+                    members[dk] = (True if set_mode
+                                   else os.urandom(8))
+            items[key] = members
+        p = str(tmp_path / f"map-{s:02d}.db")
+        DiskSegment.write(p, sorted(items.items()))
+        paths.append(p)
+    return paths
+
+
+@pytest.mark.parametrize("strategy", ["inverted", "map", "set"])
+@pytest.mark.parametrize("drop", [True, False])
+def test_map_merge_byte_identical(tmp_path, strategy, drop):
+    paths = _mk_map_inputs(tmp_path, set_mode=(strategy == "set"))
+    segs = [DiskSegment(p) for p in paths]
+    py_out = str(tmp_path / "py.db")
+    DiskSegment.write(py_out, merge_streams(
+        [s.items() for s in segs], strategy, drop_tombstones=drop))
+    nat_out = str(tmp_path / "nat.db")
+    n = native_merge(paths, nat_out, strategy, drop)
+    assert n is not None
+    with open(py_out, "rb") as a, open(nat_out, "rb") as b:
+        assert a.read() == b.read()
+
+
+def test_map_merge_newest_member_wins(tmp_path):
+    a = str(tmp_path / "a.db")
+    b = str(tmp_path / "b.db")
+    d1, d2 = (1).to_bytes(8, "big"), (2).to_bytes(8, "big")
+    DiskSegment.write(a, [(b"t", {d1: b"old1", d2: b"old2"})])
+    DiskSegment.write(b, [(b"t", {d2: b"new2"})])
+    out = str(tmp_path / "m.db")
+    assert native_merge([a, b], out, "inverted", True) == 1
+    got = DiskSegment(out).get(b"t")
+    assert got == {d1: b"old1", d2: b"new2"}
+
+
+def test_inverted_bucket_compaction_native(tmp_path, monkeypatch):
+    import weaviate_tpu.storage.store as store_mod
+
+    def _no_fallback(*a, **kw):
+        raise AssertionError("native map merge fell back")
+
+    monkeypatch.setattr(store_mod, "merge_streams", _no_fallback)
+    bk = Bucket(str(tmp_path / "post"), strategy="inverted")
+    import numpy as np
+    for wave in range(3):
+        for t in range(40):
+            docs = np.arange(wave * 10, wave * 10 + 10)
+            bk.postings_put(f"term{t}".encode(), docs,
+                            np.ones(10, np.uint32),
+                            np.full(10, 5, np.uint32))
+        bk.flush_memtable()
+    bk.compact()
+    ids, tfs, lens = bk.postings_get(b"term7")
+    assert len(ids) == 30
+    bk.close()
+
+
 def test_fallback_when_native_fails(tmp_path, monkeypatch):
     import weaviate_tpu.storage.store as store_mod
 
-    monkeypatch.setattr(store_mod, "native_merge_replace",
+    monkeypatch.setattr(store_mod, "native_merge",
                         lambda *a, **kw: None)
     b = Bucket(str(tmp_path / "bucket"), strategy="replace")
     for i in range(100):

@@ -32,6 +32,7 @@
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 #if defined(__unix__) || defined(__APPLE__)
@@ -323,7 +324,256 @@ bool is_tombstone(const std::vector<uint8_t> &v) {
     return v.size() == 1 && v[0] == 0xc0;  // msgpack nil
 }
 
+// ---------------------------------------------------------- msgpack walk
+// Byte length of the msgpack object at p (bounded by n); 0 on error.
+size_t mp_skip(const uint8_t *p, size_t n) {
+    if (n == 0) return 0;
+    uint8_t b = p[0];
+    auto need = [&](size_t k) -> size_t { return k <= n ? k : 0; };
+    if (b <= 0x7f || b >= 0xe0) return 1;              // fixint
+    if (b >= 0x80 && b <= 0x8f) {                      // fixmap
+        size_t off = 1;
+        for (int i = 0; i < (b & 0x0f) * 2; i++) {
+            size_t s = mp_skip(p + off, n - off);
+            if (!s) return 0;
+            off += s;
+        }
+        return off;
+    }
+    if (b >= 0x90 && b <= 0x9f) {                      // fixarray
+        size_t off = 1;
+        for (int i = 0; i < (b & 0x0f); i++) {
+            size_t s = mp_skip(p + off, n - off);
+            if (!s) return 0;
+            off += s;
+        }
+        return off;
+    }
+    if (b >= 0xa0 && b <= 0xbf) return need(1 + (b & 0x1f));  // fixstr
+    switch (b) {
+        case 0xc0: case 0xc2: case 0xc3: return 1;     // nil/bool
+        case 0xc4: case 0xd9:                          // bin8/str8
+            return n >= 2 ? need(2 + p[1]) : 0;
+        case 0xc5: case 0xda:                          // bin16/str16
+            return n >= 3 ? need(3 + ((size_t)p[1] << 8 | p[2])) : 0;
+        case 0xc6: case 0xdb:                          // bin32/str32
+            return n >= 5 ? need(5 + ((size_t)p[1] << 24 |
+                                      (size_t)p[2] << 16 |
+                                      (size_t)p[3] << 8 | p[4])) : 0;
+        case 0xcc: case 0xd0: return need(2);          // u8/i8
+        case 0xcd: case 0xd1: return need(3);          // u16/i16
+        case 0xce: case 0xd2: case 0xca: return need(5);   // u32/i32/f32
+        case 0xcf: case 0xd3: case 0xcb: return need(9);   // u64/i64/f64
+        case 0xdc: case 0xde: {                        // array16/map16
+            if (n < 3) return 0;
+            size_t cnt = ((size_t)p[1] << 8 | p[2]);
+            if (b == 0xde) cnt *= 2;
+            size_t off = 3;
+            for (size_t i = 0; i < cnt; i++) {
+                size_t s = mp_skip(p + off, n - off);
+                if (!s) return 0;
+                off += s;
+            }
+            return off;
+        }
+        case 0xdd: case 0xdf: {                        // array32/map32
+            if (n < 5) return 0;
+            size_t cnt = ((size_t)p[1] << 24 | (size_t)p[2] << 16 |
+                          (size_t)p[3] << 8 | p[4]);
+            if (b == 0xdf) cnt *= 2;
+            size_t off = 5;
+            for (size_t i = 0; i < cnt; i++) {
+                size_t s = mp_skip(p + off, n - off);
+                if (!s) return 0;
+                off += s;
+            }
+            return off;
+        }
+        default: return 0;  // ext types unused by the store
+    }
+}
+
+// Decoded payload of a bin/str key at p; false if not bin/str.
+bool mp_key_payload(const uint8_t *p, size_t n, const uint8_t **out,
+                    size_t *len) {
+    if (n == 0) return false;
+    uint8_t b = p[0];
+    if (b >= 0xa0 && b <= 0xbf) {
+        *out = p + 1;
+        *len = b & 0x1f;
+        return 1 + *len <= n;
+    }
+    if ((b == 0xc4 || b == 0xd9) && n >= 2) {
+        *out = p + 2;
+        *len = p[1];
+        return 2 + *len <= n;
+    }
+    if ((b == 0xc5 || b == 0xda) && n >= 3) {
+        *out = p + 3;
+        *len = ((size_t)p[1] << 8 | p[2]);
+        return 3 + *len <= n;
+    }
+    if ((b == 0xc6 || b == 0xdb) && n >= 5) {
+        *out = p + 5;
+        *len = ((size_t)p[1] << 24 | (size_t)p[2] << 16 |
+                (size_t)p[3] << 8 | p[4]);
+        return 5 + *len <= n;
+    }
+    return false;
+}
+
+bool mp_is_nil(const std::string &v) {
+    return v.size() == 1 && (uint8_t)v[0] == 0xc0;
+}
+
+// Python truthiness of a decoded msgpack value — the set strategy's
+// member-drop rule (`if p`): nil, false, 0, -0, empty str/bin/array/map
+bool mp_falsy(const std::string &v) {
+    if (v.empty()) return true;
+    uint8_t b = (uint8_t)v[0];
+    if (b == 0xc0 || b == 0xc2) return true;           // nil/false
+    if (b == 0x00) return true;                        // int 0
+    if (b == 0xa0 || b == 0xc4 || b == 0xd9) {
+        if (b == 0xa0) return true;                    // fixstr ""
+        return v.size() >= 2 && v[1] == 0;             // bin8/str8 len 0
+    }
+    if (b == 0x80 || b == 0x90) return true;           // {} / []
+    if ((b == 0xcb && v.size() == 9) || (b == 0xca && v.size() == 5)) {
+        // float 0.0 / -0.0 (Python `if p` drops both; sign bit only)
+        for (size_t i = 1; i < v.size(); i++)
+            if ((uint8_t)v[i] != 0 && !(i == 1 && (uint8_t)v[i] == 0x80))
+                return false;
+        return true;
+    }
+    return false;
+}
+
+// Ordered member table reproducing Python dict-update semantics: first
+// insertion fixes the position, later updates replace in place.
+struct MemberMap {
+    std::vector<std::pair<std::string, std::string>> entries;  // key->val
+    std::unordered_map<std::string, size_t> index;
+
+    void update_from(const uint8_t *p, size_t n, bool &ok) {
+        // p..n is one msgpack map
+        if (n == 0) { ok = false; return; }
+        uint8_t b = p[0];
+        size_t cnt, off;
+        if (b >= 0x80 && b <= 0x8f) { cnt = b & 0x0f; off = 1; }
+        else if (b == 0xde && n >= 3) {
+            cnt = ((size_t)p[1] << 8 | p[2]); off = 3;
+        } else if (b == 0xdf && n >= 5) {
+            cnt = ((size_t)p[1] << 24 | (size_t)p[2] << 16 |
+                   (size_t)p[3] << 8 | p[4]); off = 5;
+        } else if (b == 0xc0) { return; }  // nil record: contributes none
+        else { ok = false; return; }
+        for (size_t i = 0; i < cnt; i++) {
+            const uint8_t *kp; size_t klen;
+            size_t ksz = mp_skip(p + off, n - off);
+            if (!ksz || !mp_key_payload(p + off, n - off, &kp, &klen)) {
+                ok = false; return;
+            }
+            off += ksz;
+            size_t vsz = mp_skip(p + off, n - off);
+            if (!vsz) { ok = false; return; }
+            std::string key((const char *)kp, klen);
+            std::string val((const char *)(p + off), vsz);
+            off += vsz;
+            auto it = index.find(key);
+            if (it == index.end()) {
+                index.emplace(key, entries.size());
+                entries.emplace_back(std::move(key), std::move(val));
+            } else {
+                entries[it->second].second = std::move(val);
+            }
+        }
+    }
+
+    // serialize surviving members the way msgpack-python re-packs the
+    // merged dict: map header + bin keys + value passthrough
+    std::string serialize(bool drop, bool set_mode, Writer &w) const {
+        std::vector<const std::pair<std::string, std::string> *> keep;
+        keep.reserve(entries.size());
+        for (auto &e : entries) {
+            if (drop) {
+                if (set_mode ? mp_falsy(e.second) : mp_is_nil(e.second))
+                    continue;
+            }
+            keep.push_back(&e);
+        }
+        std::string out;
+        size_t n = keep.size();
+        if (n <= 15) {
+            out.push_back((char)(0x80 | n));
+        } else if (n <= 0xffff) {
+            out.push_back((char)0xde);
+            out.push_back((char)(n >> 8));
+            out.push_back((char)n);
+        } else {
+            out.push_back((char)0xdf);
+            for (int s = 24; s >= 0; s -= 8) out.push_back((char)(n >> s));
+        }
+        for (auto *e : keep) {
+            std::vector<uint8_t> kb(e->first.begin(), e->first.end());
+            w.mp_bin(out, kb);
+            out.append(e->second);
+        }
+        return out;
+    }
+};
+
 }  // namespace
+
+// Merge for the map-shaped strategies — "map"/"inverted" (set_mode=0:
+// drop nil members) and "set" (set_mode=1: drop falsy members). Equal
+// keys union their member maps oldest -> newest with newest-wins per
+// member and Python-dict insertion order, matching merge_streams'
+// acc.update() fold byte for byte on bin-valued maps.
+extern "C" long long merge_map_segments(const char **in_paths,
+                                        int n_in,
+                                        const char *out_path,
+                                        int drop_tombstones,
+                                        int set_mode) {
+    if (n_in <= 0) return -1;
+    std::vector<Reader> rd(n_in);
+    for (int i = 0; i < n_in; i++)
+        if (!rd[i].open(in_paths[i])) return -1;
+    Writer w;
+    if (!w.open(out_path)) return -1;
+
+    while (true) {
+        int best = -1;
+        for (int i = 0; i < n_in; i++) {
+            if (rd[i].done) continue;
+            if (best < 0) { best = i; continue; }
+            const auto &a = rd[i].key, &b = rd[best].key;
+            int c = memcmp(a.data(), b.data(),
+                           a.size() < b.size() ? a.size() : b.size());
+            if (c < 0 || (c == 0 && a.size() < b.size())) best = i;
+        }
+        if (best < 0) break;
+        std::vector<uint8_t> key = rd[best].key;
+        MemberMap mm;
+        bool ok = true;
+        for (int i = 0; i < n_in; i++) {
+            if (rd[i].done || rd[i].key != key) continue;
+            mm.update_from(rd[i].val.data(), rd[i].val.size(), ok);
+            if (!ok) return -1;  // unparseable value: caller falls back
+            if (!rd[i].advance()) return -1;
+        }
+        std::string payload = mm.serialize(drop_tombstones != 0,
+                                           set_mode != 0, w);
+        // Python: `if acc or not drop_tombstones: yield` — an
+        // all-dropped map vanishes entirely under full compaction
+        if (drop_tombstones && payload.size() == 1 &&
+            (uint8_t)payload[0] == 0x80)
+            continue;
+        std::vector<uint8_t> vb(payload.begin(), payload.end());
+        if (!w.put(key, vb)) return -1;
+    }
+    if (!w.finish()) return -1;
+    return (long long)w.count;
+}
 
 extern "C" long long merge_replace_segments(const char **in_paths,
                                             int n_in,

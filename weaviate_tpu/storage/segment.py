@@ -217,31 +217,51 @@ class DiskSegment:
         return DiskSegment(path)
 
 
-def native_merge_replace(in_paths: list[str], out_path: str,
-                         drop_tombstones: bool):
-    """C++ k-way merge for the *replace* strategy (payloads are opaque
-    there — newest wins, tombstone = msgpack nil — so no per-record
-    decode is needed). Writes a byte-identical segment file to
-    ``out_path`` (parity-tested against :meth:`DiskSegment.write`) and
-    returns the record count, or ``None`` when the native tier is
-    unavailable or fails — callers fall back to the streaming Python
-    merge. ``in_paths`` oldest -> newest, like ``merge_streams``."""
+def native_merge(in_paths: list[str], out_path: str, strategy: str,
+                 drop_tombstones: bool):
+    """C++ k-way merge for the non-bitmap strategies. *replace*:
+    payloads are opaque (newest wins, tombstone = msgpack nil).
+    *map*/*inverted*/*set*: member maps union oldest -> newest with
+    newest-wins per member and Python-dict insertion order; map/
+    inverted drop nil members, set drops falsy ones. Output is
+    byte-identical to :meth:`DiskSegment.write` over ``merge_streams``
+    (parity-tested on the store's real payload shapes). Returns the
+    record count, or ``None`` when the native tier is unavailable or
+    the merge fails — callers fall back to the streaming Python merge.
+    ``in_paths`` oldest -> newest, like ``merge_streams``."""
     import ctypes
 
     from weaviate_tpu import native
 
+    if strategy not in ("replace", "map", "inverted", "set"):
+        return None
     try:
         lib = native.load("segment_merge")
     except native.NativeUnavailable:
         return None
-    fn = lib.merge_replace_segments
-    fn.restype = ctypes.c_longlong
-    fn.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
-                   ctypes.c_char_p, ctypes.c_int]
     arr = (ctypes.c_char_p * len(in_paths))(
         *[p.encode() for p in in_paths])
-    rc = fn(arr, len(in_paths), out_path.encode(),
-            1 if drop_tombstones else 0)
+    # getattr: a stale cached .so predating a symbol must degrade to
+    # the Python merge, not AttributeError out of compaction
+    if strategy == "replace":
+        fn = getattr(lib, "merge_replace_segments", None)
+        if fn is None:
+            return None
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                       ctypes.c_char_p, ctypes.c_int]
+        rc = fn(arr, len(in_paths), out_path.encode(),
+                1 if drop_tombstones else 0)
+    else:
+        fn = getattr(lib, "merge_map_segments", None)
+        if fn is None:
+            return None
+        fn.restype = ctypes.c_longlong
+        fn.argtypes = [ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                       ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        rc = fn(arr, len(in_paths), out_path.encode(),
+                1 if drop_tombstones else 0,
+                1 if strategy == "set" else 0)
     if rc < 0:
         try:  # never leave a half-written output behind
             os.remove(out_path)
@@ -249,6 +269,12 @@ def native_merge_replace(in_paths: list[str], out_path: str,
             pass
         return None
     return int(rc)
+
+
+def native_merge_replace(in_paths: list[str], out_path: str,
+                         drop_tombstones: bool):
+    """Back-compat shim over :func:`native_merge` (replace strategy)."""
+    return native_merge(in_paths, out_path, "replace", drop_tombstones)
 
 
 def merge_streams(streams: list[Iterator[tuple[bytes, Any]]], strategy: str,

@@ -30,7 +30,7 @@ from weaviate_tpu.storage.segment import (
     MISSING as _MISSING,
     DiskSegment as Segment,
     merge_streams,
-    native_merge_replace,
+    native_merge,
 )
 from weaviate_tpu.storage.wal import WAL
 
@@ -348,14 +348,15 @@ class Bucket:
 
     def _merge_to(self, path: str, old: list, drop_tombstones: bool):
         """Merge ``old`` (oldest first) into a new segment at ``path``.
-        The replace strategy routes through the native C++ merge
-        (payloads are opaque there — no per-record msgpack decode);
-        byte-identical output is parity-tested, and any native failure
-        falls back to the streaming Python merge."""
-        if self.strategy == "replace":
+        The replace/map/inverted/set strategies route through the
+        native C++ merge; byte-identical output is parity-tested, and
+        any native failure falls back to the streaming Python merge
+        (roaring strategies always take the Python path — their layer
+        fold lives in ``storage/bitmaps.py``)."""
+        if self.strategy in ("replace", "map", "inverted", "set"):
             tmp = path + ".tmp"
-            n = native_merge_replace([s.path for s in old], tmp,
-                                     drop_tombstones)
+            n = native_merge([s.path for s in old], tmp, self.strategy,
+                             drop_tombstones)
             if n is not None:
                 os.replace(tmp, path)
                 return Segment(path)
