@@ -1,0 +1,17 @@
+"""docs/metrics.md is the canonical instrument list (reference
+docs/metrics.md): every registered instrument must be documented, and
+every documented metric must exist — drift fails the build."""
+
+import re
+
+from weaviate_tpu.monitoring.metrics import REGISTRY
+
+
+def test_docs_cover_registry_both_directions():
+    doc = open("docs/metrics.md").read()
+    documented = set(re.findall(r"`(weaviate_tpu_[a-z0-9_]+)`", doc))
+    registered = set(REGISTRY._metrics)
+    missing = registered - documented
+    stale = documented - registered
+    assert not missing, f"instruments not documented: {missing}"
+    assert not stale, f"documented but unregistered: {stale}"
