@@ -65,6 +65,36 @@ def test_hfresh_recall_on_random_data_with_wider_probe():
     assert _recall(idx, corpus, rng) >= 0.75
 
 
+def test_hfresh_reassign_after_splits():
+    """SPFresh reassign (reference ``reassign.go``): after splits move
+    cell boundaries, members end up in the posting of their TRUE
+    nearest centroid — without reassign, early inserts stay pinned to
+    stale cells and probe-1 recall decays as the index grows."""
+    rng = np.random.default_rng(5)
+    cfg = HFreshIndexConfig(distance="l2-squared", max_posting_size=24,
+                            min_posting_size=2, search_probe=1)
+    idx = HFreshIndex(8, cfg)
+    # two slowly separating clusters inserted interleaved: the early
+    # single-centroid cell must split and members must re-home
+    for step in range(8):
+        n = 40
+        a = rng.standard_normal((n, 8)).astype(np.float32) * 0.2
+        b = a + np.float32(step)  # drifts away over time
+        ids_a = np.arange(step * 2 * n, step * 2 * n + n)
+        ids_b = ids_a + n
+        idx.add_batch(ids_a, a)
+        idx.add_batch(ids_b, b)
+    # every doc's primary posting is its true nearest centroid
+    sample = rng.choice(8 * 80, 200, replace=False)
+    good = 0
+    for d in sample:
+        row = idx._doc_posting[int(d)]
+        v = idx._prep(idx.store.get(np.asarray([d])))
+        best = int(np.argmin(idx._centroid_dists(v)[0]))
+        good += (best == row)
+    assert good / len(sample) >= 0.9, f"only {good}/200 well-homed"
+
+
 def test_hfresh_delete_and_filter():
     rng = np.random.default_rng(1)
     n, d = 600, 16

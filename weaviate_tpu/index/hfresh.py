@@ -196,6 +196,44 @@ class HFreshIndex(VectorIndex):
         self._postings.append(ids[a == 1])
         for d_id in ids[a == 1]:
             self._doc_posting[int(d_id)] = new_row
+        self._reassign_neighbors((row, new_row))
+
+    def _reassign_neighbors(self, split_rows: tuple[int, int],
+                            neighbors: int = 8) -> None:
+        """Bounded SPFresh reassign (reference ``reassign.go``): a split
+        moves the cell boundary, so members of NEARBY postings may now be
+        closest to one of the two new centroids (and the split posting's
+        own members may belong elsewhere). Recheck only the ``neighbors``
+        postings closest to the split pair — cost stays O(local), never
+        O(index)."""
+        c = self._centroids
+        if len(c) <= 2:
+            return
+        pair = c[list(split_rows)]
+        d = ((c[None, :, :] - pair[:, None, :]) ** 2).sum(-1).min(0)
+        for sr in split_rows:
+            d[sr] = np.inf
+        nrows = np.argsort(d)[:neighbors]
+        check = list(split_rows) + [int(r) for r in nrows]
+        moved: dict[int, list[int]] = {}
+        for row in check:
+            ids = self._live_posting(row)
+            if len(ids) == 0:
+                continue
+            vecs = self._prep(self.store.get(ids))
+            cd = self._centroid_dists(vecs)
+            best = np.argmin(cd, axis=1)
+            stay = best == row
+            if stay.all():
+                continue
+            self._postings[row] = ids[stay]
+            for d_id, b_row in zip(ids[~stay], best[~stay]):
+                moved.setdefault(int(b_row), []).append(int(d_id))
+        for row, sel in moved.items():
+            self._postings[row] = np.unique(np.concatenate(
+                [self._postings[row], np.asarray(sel, np.int64)]))
+            for d_id in sel:
+                self._doc_posting[int(d_id)] = row
 
     def _merge(self, row: int) -> None:
         ids = self._postings[row]
