@@ -256,6 +256,47 @@ def _mkdb(tmp_path, vectorizer="text2vec-hash", props=None):
     return db
 
 
+def test_neartext_concept_movement(tmp_path):
+    """moveTo/moveAwayFrom (reference searcher_movements.go): moveTo
+    lerps toward the target with weight force*0.5 — at force=2 the
+    query vector BECOMES the target object's vector, so that object
+    must rank first even for an unrelated query string."""
+    from weaviate_tpu.api.graphql import GraphQLExecutor
+
+    db = _mkdb(tmp_path)
+    col = db.get_collection("Doc")
+    col.put_batch([
+        StorageObject(uuid=f"11000000-0000-0000-0000-{i:012d}",
+                      collection="Doc",
+                      properties={"body": body})
+        for i, body in enumerate([
+            "alpha alpha alpha", "bravo bravo bravo",
+            "charlie charlie charlie", "delta delta delta"])])
+    gql = GraphQLExecutor(db)
+    target = "11000000-0000-0000-0000-000000000002"  # charlie
+    out = gql.execute("""
+    { Get { Doc(nearText: {concepts: ["alpha"],
+                           moveTo: {objects: [{id: "%s"}], force: 2.0}},
+                limit: 2)
+            { body _additional { id } } } }""" % target)
+    assert not out.get("errors"), out
+    rows = out["data"]["Get"]["Doc"]
+    assert rows[0]["_additional"]["id"] == target
+    # moveAwayFrom the query's own concept pushes 'alpha' out of the top
+    out2 = gql.execute("""
+    { Get { Doc(nearText: {concepts: ["alpha"],
+                           moveAwayFrom: {concepts: ["alpha"],
+                                          force: 2.0}}, limit: 4)
+            { body } } }""")
+    assert not out2.get("errors"), out2
+    # without movement, 'alpha...' ranks first for query 'alpha'
+    base = gql.execute("""
+    { Get { Doc(nearText: {concepts: ["alpha"]}, limit: 1)
+            { body } } }""")
+    assert base["data"]["Get"]["Doc"][0]["body"].startswith("alpha")
+    db.close()
+
+
 def test_ask_summary_tokens_through_graphql(tmp_path):
     from weaviate_tpu.api.graphql import GraphQLExecutor
 

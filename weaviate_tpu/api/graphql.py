@@ -361,6 +361,19 @@ class GraphQLExecutor:
                 p.max_distance = 2.0 * (1.0 - float(nt["certainty"]))
             if "targetVectors" in nt and nt["targetVectors"]:
                 p.target_vector = nt["targetVectors"][0]
+
+            def _move(m):
+                return {
+                    "concepts": m.get("concepts", []),
+                    "objects": [o.get("id") for o in
+                                m.get("objects", []) if o.get("id")],
+                    "force": float(m.get("force", 0.0)),
+                }
+
+            if "moveTo" in nt:
+                p.near_text_move_to = _move(nt["moveTo"])
+            if "moveAwayFrom" in nt:
+                p.near_text_move_away = _move(nt["moveAwayFrom"])
         if "nearObject" in args:
             no = args["nearObject"]
             obj = self.db.get_collection(class_name).get(no["id"], tenant=p.tenant)

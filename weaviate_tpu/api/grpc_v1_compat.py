@@ -159,6 +159,16 @@ def apply_near_text(params: QueryParams, nt) -> None:
     params.near_text = " ".join(nt.query)
     if nt.HasField("distance"):
         params.max_distance = float(nt.distance)
+    if nt.HasField("move_to"):
+        params.near_text_move_to = {
+            "concepts": list(nt.move_to.concepts),
+            "objects": list(nt.move_to.uuids),
+            "force": float(nt.move_to.force)}
+    if nt.HasField("move_away"):
+        params.near_text_move_away = {
+            "concepts": list(nt.move_away.concepts),
+            "objects": list(nt.move_away.uuids),
+            "force": float(nt.move_away.force)}
 
 
 def _struct_value(v) -> Any:

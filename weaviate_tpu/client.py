@@ -296,8 +296,12 @@ class _Query:
     def near_text(self, query: str, *, limit: int = 10, certainty=None,
                   distance=None, filters=None, offset: int = 0,
                   autocut=None, sort=None, target_vector: str = "",
+                  move_to: Optional[dict] = None,
+                  move_away: Optional[dict] = None,
                   return_properties: Optional[Sequence[str]] = None,
                   include: Sequence[str] = ("distance",)):
+        """``move_to``/``move_away``: ``{"concepts": [...], "objects":
+        [uuid, ...], "force": 0.5}`` concept movement."""
         nt: dict = {"concepts": [query]}
         if certainty is not None:
             nt["certainty"] = certainty
@@ -305,6 +309,14 @@ class _Query:
             nt["distance"] = distance
         if target_vector:
             nt["targetVectors"] = [target_vector]
+        for arg, name in ((move_to, "moveTo"), (move_away, "moveAwayFrom")):
+            if arg:
+                m: dict = {"force": arg.get("force", 0.5)}
+                if arg.get("concepts"):
+                    m["concepts"] = list(arg["concepts"])
+                if arg.get("objects"):
+                    m["objects"] = [{"id": u} for u in arg["objects"]]
+                nt[name] = m
         args = self._common({"nearText": nt}, filters, limit, offset,
                             autocut, sort)
         return self._run(args, return_properties, include)

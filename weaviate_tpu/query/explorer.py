@@ -87,6 +87,10 @@ class QueryParams:
     filters: Optional[Filter] = None
     # nearText: vectorized via the collection's vectorizer module
     near_text: Optional[str] = None
+    # concept movement (reference nearText moveTo/moveAwayFrom):
+    # {"concepts": [...], "objects": [uuid, ...], "force": float}
+    near_text_move_to: Optional[dict] = None
+    near_text_move_away: Optional[dict] = None
     # vector search (single or multi target)
     near_vector: Optional[np.ndarray] = None
     target_vector: str = ""
@@ -149,6 +153,43 @@ class Explorer:
             )
         return col.modules.vectorizer(name).vectorize_query(text)
 
+    def _apply_moves(self, col, vector: np.ndarray,
+                     move_to: Optional[dict], move_away: Optional[dict],
+                     tenant: str = "") -> np.ndarray:
+        """nearText concept movement (reference
+        ``nearText/searcher_movements.go``): moveTo lerps toward the
+        target with weight force*0.5; moveAwayFrom pushes along
+        (source - target) by the same weight. Targets average the
+        vectorized concepts plus the named objects' vectors."""
+        def _target(move: dict) -> Optional[np.ndarray]:
+            parts = []
+            for concept in move.get("concepts") or ():
+                parts.append(np.asarray(
+                    self._query_vector(col, concept), np.float32))
+            for uuid in move.get("objects") or ():
+                obj = col.get(uuid, tenant=tenant)
+                if obj is None or obj.vector is None:
+                    raise ValueError(
+                        f"move object {uuid!r} not found or has no "
+                        "vector")
+                parts.append(np.asarray(obj.vector, np.float32))
+            if not parts:
+                return None
+            return np.mean(np.stack(parts), axis=0)
+
+        vector = np.asarray(vector, np.float32)
+        if move_to and float(move_to.get("force", 0)) > 0:
+            t = _target(move_to)
+            if t is not None:
+                w = float(move_to["force"]) * 0.5
+                vector = vector * (1.0 - w) + t * w
+        if move_away and float(move_away.get("force", 0)) > 0:
+            t = _target(move_away)
+            if t is not None:
+                w = float(move_away["force"]) * 0.5
+                vector = vector + w * (vector - t)
+        return vector
+
     def get(self, params: QueryParams) -> QueryResult:
         col = self.db.get_collection(params.collection)
         fetch = params.offset + params.limit
@@ -176,7 +217,10 @@ class Explorer:
                     params.bm25_query)["corrected"]
         if params.near_text is not None and params.near_vector is None \
                 and params.hybrid is None:
-            params.near_vector = self._query_vector(col, params.near_text)
+            params.near_vector = self._apply_moves(
+                col, self._query_vector(col, params.near_text),
+                params.near_text_move_to, params.near_text_move_away,
+                params.tenant)
         if params.hybrid is not None and params.hybrid.vector is None \
                 and params.hybrid.query and col.config.vectorizer != "none" \
                 and col.modules is not None:
