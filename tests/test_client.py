@@ -81,6 +81,19 @@ def test_near_vector_and_filters(client):
     assert {h.properties["wordCount"] for h in hits} == {40, 50, 60, 70, 80}
 
 
+def test_bm25_search_operator(client):
+    col = _seed(client)
+    # every doc contains "article"; only doc 7 contains "7"
+    hits = col.query.bm25("article 7", operator="And", limit=24,
+                          return_properties=["title"])
+    assert len(hits) == 1 and hits[0].properties["title"].endswith(" 7")
+    # minimum_match=1 == plain OR
+    hits = col.query.bm25("article 7", minimum_match=1, limit=24)
+    assert len(hits) == 24
+    # a token absent from the corpus makes And empty
+    assert col.query.bm25("article zzz", operator="And", limit=5) == []
+
+
 def test_bm25_hybrid_sort(client):
     col = _seed(client)
     hits = col.query.bm25("article", limit=5,

@@ -78,6 +78,40 @@ def test_native_matches_dense_exactly():
                 sorted(n_scores), sorted(d_scores), rtol=2e-5), (q, k)
 
 
+def test_search_operator_and_min_match():
+    """SearchOperatorOptions (reference bm25_searcher.go:251): And = a
+    doc must hold EVERY query token; minimum_match = at least N
+    distinct tokens (a token in both body and title counts once).
+    Native and dense paths must agree on the RESULT SET."""
+    native_ix, dense_ix = _make_pair()
+    for q, kw in [("alpha bravo charlie", dict(operator="And")),
+                  ("alpha bravo charlie", dict(minimum_match=2)),
+                  ("alpha zulu", dict(operator="And")),
+                  ("tango echo kilo delta", dict(minimum_match=3))]:
+        n_ids, n_scores = native_ix.bm25_search(q, 400, **kw)
+        d_ids, d_scores = dense_ix.bm25_search(q, 400, **kw)
+        assert set(n_ids) == set(d_ids), (q, kw)
+        # verify the constraint semantically against raw doc text
+        toks = set(q.split())
+        need = len(toks) if kw.get("operator") == "And" \
+            else kw.get("minimum_match", 1)
+        # And with a token absent from the corpus -> empty
+        for ids in (n_ids, d_ids):
+            for d in ids:
+                # re-read the doc's text from the postings: count how
+                # many query tokens hit this doc in ANY property
+                hit = sum(
+                    1 for t in toks
+                    if any(d in native_ix.postings[prop].get(t, ())
+                           for prop in ("body", "title")))
+                assert hit >= need, (q, kw, int(d), hit)
+        # the constrained result is a subset of the unconstrained one
+        u_ids, _ = native_ix.bm25_search(q, 400)
+        assert set(n_ids) <= set(u_ids)
+        if need > 1:
+            assert len(n_ids) < len(u_ids) or len(u_ids) == 0
+
+
 def test_native_property_boosts_match():
     native_ix, dense_ix = _make_pair()
     for props in (["body^2", "title"], ["title^3"], ["body", "title^0.5"]):

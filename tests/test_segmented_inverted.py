@@ -456,6 +456,30 @@ def test_auto_upgrade_with_concurrent_writes(tmp_path):
     sh.close()
 
 
+def test_search_operator_parity_with_ram_tier(tmp_path):
+    """SearchOperatorOptions on the segment tier: WAND-cached and dense
+    fallbacks agree with the RAM engine's result sets for And /
+    minimum_match (reference bm25_searcher_block.go carries
+    minimumOrTokensMatch into DoWand the same way)."""
+    seg = Shard(str(tmp_path / "seg"), _cfg("segment"))
+    seg.put_batch(_mk_objs(240))
+    ram = Shard(str(tmp_path / "ram"), _cfg("ram"))
+    ram.put_batch(_mk_objs(240))
+    for q, kw in [("apple banana", dict(operator="And")),
+                  ("apple banana cherry", dict(minimum_match=2)),
+                  ("quantum zzzmissing", dict(operator="And"))]:
+        ids_s, _ = seg.inverted.bm25_search(
+            q, 240, doc_space=seg._next_doc_id, **kw)
+        ids_r, _ = ram.inverted.bm25_search(
+            q, 240, doc_space=ram._next_doc_id, **kw)
+        assert set(ids_s) == set(ids_r), (q, kw)
+        unc, _ = ram.inverted.bm25_search(q, 240,
+                                          doc_space=ram._next_doc_id)
+        assert set(ids_r) <= set(unc)
+    seg.close()
+    ram.close()
+
+
 def test_wand_cache_eviction_and_invalidation(tmp_path, monkeypatch):
     """The native WAND term cache must stay correct under a tiny byte
     budget (constant eviction) and after writes invalidate cached terms;

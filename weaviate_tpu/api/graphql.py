@@ -383,6 +383,11 @@ class GraphQLExecutor:
         if "bm25" in args:
             p.bm25_query = args["bm25"].get("query", "")
             p.bm25_properties = args["bm25"].get("properties")
+            so = args["bm25"].get("searchOperator")
+            if so:
+                p.bm25_operator = str(so.get("operator", "Or"))
+                p.bm25_minimum_match = int(
+                    so.get("minimumOrTokensMatch", 0) or 0)
         if "ask" in args:
             a = args["ask"]
             p.ask = AskParams(

@@ -402,6 +402,14 @@ class WeaviateV1Service:
         elif req.HasField("bm25_search"):
             params.bm25_query = req.bm25_search.query
             params.bm25_properties = list(req.bm25_search.properties) or None
+            if req.bm25_search.HasField("search_operator"):
+                so = req.bm25_search.search_operator
+                if so.operator == \
+                        wv.SearchOperatorOptions.OPERATOR_AND:
+                    params.bm25_operator = "And"
+                if so.HasField("minimum_or_tokens_match"):
+                    params.bm25_minimum_match = int(
+                        so.minimum_or_tokens_match)
 
         out = self.explorer.get(params)
         reply = wv.SearchReply()

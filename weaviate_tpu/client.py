@@ -323,10 +323,20 @@ class _Query:
 
     def bm25(self, query: str, *, query_properties=None, limit: int = 10,
              filters=None, offset: int = 0, autocut=None, sort=None,
+             operator: Optional[str] = None,
+             minimum_match: Optional[int] = None,
              return_properties=None, include=("score",)):
+        """``operator="And"`` requires every query token to match;
+        ``operator="Or"`` with ``minimum_match=N`` requires at least N
+        distinct tokens (reference searchOperator)."""
         b: dict = {"query": query}
         if query_properties:
             b["properties"] = list(query_properties)
+        if operator or minimum_match:
+            so: dict = {"operator": _Enum(operator or "Or")}
+            if minimum_match:
+                so["minimumOrTokensMatch"] = int(minimum_match)
+            b["searchOperator"] = so
         args = self._common({"bm25": b}, filters, limit, offset, autocut,
                             sort)
         return self._run(args, return_properties, include)

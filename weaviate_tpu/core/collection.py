@@ -934,6 +934,8 @@ class Collection:
         properties: Optional[list[str]] = None,
         flt: Optional[Filter] = None,
         tenant: str = "",
+        operator: str = "Or",
+        minimum_match: int = 0,
     ) -> list[tuple[StorageObject, float]]:
         from weaviate_tpu.monitoring.metrics import (
             QUERIES_TOTAL,
@@ -948,7 +950,9 @@ class Collection:
             if flt is not None:
                 allow = shard.allow_list(flt, space)
             ids, scores = shard.inverted.bm25_search(
-                query, k, properties=properties, allow_list=allow, doc_space=space
+                query, k, properties=properties, allow_list=allow,
+                doc_space=space, operator=operator,
+                minimum_match=minimum_match,
             )
             for i, s in zip(ids, scores):
                 results.append((float(s), shard, int(i)))

@@ -284,6 +284,52 @@ class InvertedIndex:
                 self.len_totals[prop] -= prev
 
     # -- BM25 -------------------------------------------------------------
+    def _min_match_groups(
+        self, query: str, props: list[tuple[str, float]],
+        operator: str, minimum_match: int,
+    ) -> tuple[dict[str, int], int]:
+        """Distinct-token group table + the min-match bound for the
+        SearchOperatorOptions rule (reference ``bm25_searcher.go:251``):
+        every token the query produces under ANY searched property's
+        tokenization gets one group; And = all of them must match.
+        Shared by the RAM and segment tiers so the rule cannot drift."""
+        all_tokens: dict[str, int] = {}
+        for prop, _ in props:
+            for t in tokenize(query, self._tokenization(prop)):
+                if t not in self.stopwords and t not in all_tokens:
+                    all_tokens[t] = len(all_tokens)
+        min_match = 1
+        if operator.lower() == "and":
+            min_match = max(1, len(all_tokens))
+        elif minimum_match:
+            min_match = max(1, int(minimum_match))
+        return all_tokens, min_match
+
+    def _min_match_mask(self, all_tokens: dict[str, int],
+                        props: list[tuple[str, float]], space: int,
+                        min_match: int) -> np.ndarray:
+        """Per-doc distinct-token count >= min_match, with ONE reusable
+        scratch mask — O(space) memory, not O(tokens x space). A token
+        matching in several properties counts once."""
+        count = np.zeros(space, np.uint16)
+        scratch = np.zeros(space, bool)
+        for token in all_tokens:
+            scratch[:] = False
+            for prop, _ in props:
+                ids = self._token_doc_ids(prop, token)
+                if ids is not None and len(ids):
+                    scratch[ids[ids < space]] = True
+            count += scratch
+        return count >= min_match
+
+    def _token_doc_ids(self, prop: str, token: str):
+        """Doc ids holding ``token`` in ``prop`` (min-match accounting);
+        the segment tier overrides this to read its postings buckets."""
+        plist = self.postings.get(prop, {}).get(token)
+        if plist is None or not len(plist):
+            return None
+        return plist.arrays()[0]
+
     def bm25_search(
         self,
         query: str,
@@ -291,8 +337,16 @@ class InvertedIndex:
         properties: Optional[list[str]] = None,
         allow_list: Optional[np.ndarray] = None,
         doc_space: int = 0,
+        operator: str = "Or",
+        minimum_match: int = 0,
     ) -> tuple[np.ndarray, np.ndarray]:
         """BM25F over the given (optionally boosted ``prop^2``) properties.
+
+        ``operator``/``minimum_match`` are the reference's
+        SearchOperatorOptions (``bm25_searcher.go:251``): And = a doc
+        must match EVERY query token; Or with minimum_match = at least
+        that many distinct tokens (a token matching in several
+        properties counts once).
 
         Returns (doc_ids [<=k], scores [<=k]) sorted by descending score.
         """
@@ -310,12 +364,15 @@ class InvertedIndex:
                 props.append((p, 1.0))
 
         n_docs = max(1, self.doc_count)
+        all_tokens, min_match = self._min_match_groups(
+            query, props, operator, minimum_match)
 
         # native BlockMax-WAND hot path — filtered queries pass the allow
         # mask into the engine (WAND skipping stays active; reference WAND
         # consumes AllowLists the same way)
         if self.native is not None:
             query_terms = []
+            groups = []
             for prop, boost in props:
                 prop_postings = self.postings.get(prop)
                 if not prop_postings:
@@ -334,7 +391,9 @@ class InvertedIndex:
                     idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
                     query_terms.append(
                         (prop, term, boost * idf, max(avg_len, 1e-9)))
-            return self.native.search(query_terms, k, allow=allow_list)
+                    groups.append(all_tokens[term])
+            return self.native.search(query_terms, k, allow=allow_list,
+                                      groups=groups, min_match=min_match)
 
         space = max(
             doc_space,
@@ -383,6 +442,10 @@ class InvertedIndex:
                 term_scores = idf * tfs * (self.k1 + 1) / np.maximum(denom, 1e-9)
                 scores[ids] += boost * term_scores
                 touched[ids] = True
+
+        if min_match > 1:
+            touched &= self._min_match_mask(all_tokens, props, space,
+                                            min_match)
 
         # stale postings of crash-replay deletions are screened here (see
         # delete_docid); live docs are unaffected

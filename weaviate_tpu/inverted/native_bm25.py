@@ -51,6 +51,11 @@ def _bind():
     lib.bm25_search_filtered.argtypes = [
         ctypes.c_void_p, _U64, _F32, _F32, ctypes.c_uint32, ctypes.c_uint32,
         ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, _I64, _F32]
+    lib.bm25_search_min_match.restype = ctypes.c_uint32
+    lib.bm25_search_min_match.argtypes = [
+        ctypes.c_void_p, _U64, _F32, _F32, _U32, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, _I64, _F32]
     lib.bm25_score_docs.argtypes = [
         ctypes.c_void_p, _U64, _F32, _F32, ctypes.c_uint32,
         _I64, ctypes.c_uint32, _F32]
@@ -137,10 +142,15 @@ class NativeBM25:
 
     def search(self, query_terms: list[tuple[str, str, float, float]],
                k: int, allow: Optional[np.ndarray] = None,
+               groups: Optional[list[int]] = None, min_match: int = 1,
                ) -> tuple[np.ndarray, np.ndarray]:
         """query_terms: [(prop, term, weight=boost*idf, avgdl)]; allow:
         optional byte-per-doc mask (the filter engine's output) — WAND
         skipping stays active, disallowed docs are just never scored.
+        ``groups``/``min_match``: distinct-token group per term and the
+        minimum distinct tokens a doc must match (reference
+        minimumOrTokensMatch / operator AND — one token fans out across
+        properties in BM25F and must count once).
         Returns (doc_ids, scores) descending."""
         n = len(query_terms)
         if n == 0 or k == 0:
@@ -151,11 +161,8 @@ class NativeBM25:
         ads = (ctypes.c_float * n)(*(a for _, _, _, a in query_terms))
         out_docs = (ctypes.c_int64 * k)()
         out_scores = (ctypes.c_float * k)()
-        if allow is None:
-            with self._lock:
-                m = self._lib.bm25_search(self._h, ids, ws, ads, n, k,
-                                          out_docs, out_scores)
-        else:
+        ptr, alen = None, 0
+        if allow is not None:
             if isinstance(allow, np.ndarray) and allow.flags.c_contiguous \
                     and allow.dtype in (np.uint8, np.bool_):
                 # bool is 1 byte: view, don't copy — at 1M docs the two
@@ -165,9 +172,22 @@ class NativeBM25:
             else:
                 ab = np.ascontiguousarray(np.asarray(allow, bool), np.uint8)
             ptr = ab.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+            alen = len(ab)
+        if min_match > 1:
+            garr = (ctypes.c_uint32 * n)(
+                *(groups if groups is not None else range(n)))
+            with self._lock:
+                m = self._lib.bm25_search_min_match(
+                    self._h, ids, ws, ads, garr, int(min_match), n, k,
+                    ptr, alen, out_docs, out_scores)
+        elif allow is None:
+            with self._lock:
+                m = self._lib.bm25_search(self._h, ids, ws, ads, n, k,
+                                          out_docs, out_scores)
+        else:
             with self._lock:
                 m = self._lib.bm25_search_filtered(
-                    self._h, ids, ws, ads, n, k, ptr, len(ab),
+                    self._h, ids, ws, ads, n, k, ptr, alen,
                     out_docs, out_scores)
         return (np.ctypeslib.as_array(out_docs)[:m].astype(np.int64),
                 np.ctypeslib.as_array(out_scores)[:m].astype(np.float32))

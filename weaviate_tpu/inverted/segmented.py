@@ -553,10 +553,16 @@ class SegmentedInvertedIndex(InvertedIndex):
         self._pv_cache.pop(doc_id, None)
 
     # -- BM25 --------------------------------------------------------------
+    def _token_doc_ids(self, prop: str, token: str):
+        ids, _, _ = self._posts(prop).postings_get(token.encode("utf-8"))
+        return ids if len(ids) else None
+
     def bm25_search(self, query: str, k: int,
                     properties: Optional[list[str]] = None,
                     allow_list: Optional[np.ndarray] = None,
-                    doc_space: int = 0) -> tuple[np.ndarray, np.ndarray]:
+                    doc_space: int = 0,
+                    operator: str = "Or",
+                    minimum_match: int = 0) -> tuple[np.ndarray, np.ndarray]:
         """BM25F over bucket-resident postings. Hot path: BlockMax-WAND on
         the bounded native term cache (loaded per-term from segments, LRU
         by byte budget, invalidated on write). Fallback (cache disabled or
@@ -577,6 +583,9 @@ class SegmentedInvertedIndex(InvertedIndex):
 
         n_docs = max(1, self.doc_count)
         space = max(doc_space, self.columnar._watermark, 1)
+
+        all_tokens, min_match = self._min_match_groups(
+            query, props, operator, minimum_match)
 
         # BlockMax-WAND over the bounded term cache (reference
         # bm25_searcher_block.go). The live mask always rides as the allow
@@ -603,6 +612,7 @@ class SegmentedInvertedIndex(InvertedIndex):
                 allow = allow & al[:space]
             with self._wand_lock:
                 query_terms = []
+                groups = []
                 for prop, boost in props:
                     cnt = self.lens_counts.get(prop, 0)
                     avg_len = max(
@@ -615,7 +625,10 @@ class SegmentedInvertedIndex(InvertedIndex):
                             1.0 + (n_docs - df + 0.5) / (df + 0.5))
                         query_terms.append(
                             (prop, term, boost * idf, avg_len))
-                return self._wand.search(query_terms, k, allow=allow)
+                        groups.append(all_tokens[term])
+                return self._wand.search(query_terms, k, allow=allow,
+                                         groups=groups,
+                                         min_match=min_match)
 
         scores = np.zeros(space, np.float32)
         touched = np.zeros(space, bool)
@@ -644,6 +657,9 @@ class SegmentedInvertedIndex(InvertedIndex):
                     idf * tfs * (self.k1 + 1) / np.maximum(denom, 1e-9))
                 touched[ids] = True
 
+        if min_match > 1:
+            touched &= self._min_match_mask(all_tokens, props, space,
+                                            min_match)
         touched &= self.columnar.live_mask(space)
         if allow_list is not None:
             al = np.asarray(allow_list, bool)

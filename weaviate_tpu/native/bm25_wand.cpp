@@ -89,6 +89,7 @@ struct Cursor {
     float weight;   // boost * idf
     float avgdl;
     float term_bound;
+    uint32_t group = 0;  // distinct-token group (min-match rule)
 
     bool done() const { return pos >= pl->entries.size(); }
     int64_t doc() const { return pl->entries[pos].doc; }
@@ -201,15 +202,22 @@ uint64_t bm25_posting_len(void* h, uint64_t term_id) {
 // filter is present — the filter defines the candidate universe). The
 // filter only removes candidates, so WAND/BMW upper bounds stay sound.
 // Returns number of results written (<= k), descending score; ties by
-// ascending doc id.
-uint32_t bm25_search_filtered(void* h, const uint64_t* term_ids,
-                              const float* weights, const float* avgdls,
-                              uint32_t n_terms, uint32_t k,
-                              const uint8_t* allow, uint64_t allow_len,
-                              int64_t* out_docs, float* out_scores) {
+// ascending doc id. term_groups (may be null) maps each query term to
+// its distinct-token group; a doc enters the top-k only when it
+// matches >= min_match distinct groups (reference
+// minimumOrTokensMatch / operator AND; groups exist because BM25F
+// fans one token out across properties and it must count once).
+uint32_t bm25_search_min_match(void* h, const uint64_t* term_ids,
+                               const float* weights, const float* avgdls,
+                               const uint32_t* term_groups,
+                               uint32_t min_match,
+                               uint32_t n_terms, uint32_t k,
+                               const uint8_t* allow, uint64_t allow_len,
+                               int64_t* out_docs, float* out_scores) {
     auto* ix = static_cast<Index*>(h);
     std::vector<Cursor> cursors;
     cursors.reserve(n_terms);
+    uint32_t n_group_slots = 1;
     for (uint32_t i = 0; i < n_terms; ++i) {
         PostingList* pl = ix->find(term_ids[i]);
         if (!pl) continue;
@@ -221,9 +229,13 @@ uint32_t bm25_search_filtered(void* h, const uint64_t* term_ids,
         c.avgdl = avgdls[i];
         c.term_bound = weights[i] * pl->max_tf * (ix->k1 + 1.0f) /
                        (pl->max_tf + ix->k1 * (1.0f - ix->b));
+        c.group = term_groups ? term_groups[i] : i;
+        if (c.group + 1 > n_group_slots) n_group_slots = c.group + 1;
         cursors.push_back(c);
     }
     if (cursors.empty() || k == 0) return 0;
+    std::vector<uint8_t> seen_groups;
+    if (min_match > 1) seen_groups.resize(n_group_slots, 0);
 
     // min-heap of (score, -doc) keeping the current top-k
     using Entry = std::pair<float, int64_t>;
@@ -303,10 +315,24 @@ uint32_t bm25_search_filtered(void* h, const uint64_t* term_ids,
                  allow[pivot_doc]);
             if (allowed && !ix->tombstones.count(pivot_doc)) {
                 float s = 0.0f;
+                uint32_t gcount = 0;  // distinct-token groups hit (exact)
+                if (min_match > 1)
+                    std::fill(seen_groups.begin(), seen_groups.end(), 0);
                 for (Cursor* c : order) {
                     if (c->done() || c->doc() != pivot_doc) continue;
                     s += score_posting(ix, c->pl->entries[c->pos], c->weight,
                                        c->avgdl);
+                    if (min_match > 1 && !seen_groups[c->group]) {
+                        seen_groups[c->group] = 1;
+                        ++gcount;
+                    }
+                }
+                if (min_match > 1 && gcount < min_match) {
+                    for (Cursor* c : order) {
+                        if (!c->done() && c->doc() == pivot_doc)
+                            c->seek(pivot_doc + 1);
+                    }
+                    continue;
                 }
                 if ((uint32_t)heap.size() < k) {
                     heap.push({s, pivot_doc});
@@ -332,6 +358,16 @@ uint32_t bm25_search_filtered(void* h, const uint64_t* term_ids,
         heap.pop();
     }
     return n;
+}
+
+uint32_t bm25_search_filtered(void* h, const uint64_t* term_ids,
+                              const float* weights, const float* avgdls,
+                              uint32_t n_terms, uint32_t k,
+                              const uint8_t* allow, uint64_t allow_len,
+                              int64_t* out_docs, float* out_scores) {
+    return bm25_search_min_match(h, term_ids, weights, avgdls, nullptr, 1,
+                                 n_terms, k, allow, allow_len, out_docs,
+                                 out_scores);
 }
 
 uint32_t bm25_search(void* h, const uint64_t* term_ids, const float* weights,

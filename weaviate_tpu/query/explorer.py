@@ -101,6 +101,10 @@ class QueryParams:
     # keyword search
     bm25_query: Optional[str] = None
     bm25_properties: Optional[list[str]] = None
+    # SearchOperatorOptions (reference base_search.proto:38): "And"
+    # requires every query token; minimum_match bounds "Or"
+    bm25_operator: str = "Or"
+    bm25_minimum_match: int = 0
     # hybrid
     hybrid: Optional[HybridParams] = None
     # post-processing
@@ -257,6 +261,8 @@ class Explorer:
                 params.bm25_query, k=fetch,
                 properties=params.bm25_properties,
                 flt=params.filters, tenant=params.tenant,
+                operator=params.bm25_operator,
+                minimum_match=params.bm25_minimum_match,
             )
             kind = "score"
         elif params.filters is not None:
