@@ -92,6 +92,11 @@ def test_bm25_search_operator(client):
     assert len(hits) == 24
     # a token absent from the corpus makes And empty
     assert col.query.bm25("article zzz", operator="And", limit=5) == []
+    # hybrid's keyword branch honors the operator too (reference
+    # hybrid.go:170): pure-keyword alpha=0 And narrows to doc 7
+    hits = col.query.hybrid("article 7", alpha=0.0, operator="And",
+                            limit=24, return_properties=["title"])
+    assert len(hits) == 1 and hits[0].properties["title"].endswith(" 7")
 
 
 def test_bm25_hybrid_sort(client):

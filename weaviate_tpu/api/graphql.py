@@ -406,6 +406,7 @@ class GraphQLExecutor:
                 p.autocorrect = True
         if "hybrid" in args:
             h = args["hybrid"]
+            hso = h.get("searchOperator") or {}
             p.hybrid = HybridParams(
                 query=h.get("query"),
                 vector=np.asarray(h["vector"], np.float32) if "vector" in h else None,
@@ -413,6 +414,9 @@ class GraphQLExecutor:
                 fusion="rankedFusion"
                 if h.get("fusionType") == "rankedFusion" else "relativeScoreFusion",
                 properties=h.get("properties"),
+                operator=str(hso.get("operator", "Or")),
+                minimum_match=int(
+                    hso.get("minimumOrTokensMatch", 0) or 0),
             )
             if h.get("targetVectors"):
                 # reference hybrid accepts targetVectors like near*

@@ -131,6 +131,13 @@ def apply_hybrid(params: QueryParams, h) -> None:
         params.target_vector = h.targets.target_vectors[0]
     elif h.target_vectors:
         params.target_vector = h.target_vectors[0]
+    operator, min_match = "Or", 0
+    if h.HasField("bm25_search_operator"):
+        so = h.bm25_search_operator
+        if so.operator == wv.SearchOperatorOptions.OPERATOR_AND:
+            operator = "And"
+        if so.HasField("minimum_or_tokens_match"):
+            min_match = int(so.minimum_or_tokens_match)
     params.hybrid = HybridParams(
         query=h.query or None,
         vector=vec,
@@ -142,6 +149,8 @@ def apply_hybrid(params: QueryParams, h) -> None:
                 if h.fusion_type == wv.Hybrid.FUSION_TYPE_RANKED
                 else "relativeScoreFusion"),
         properties=list(h.properties) or None,
+        operator=operator,
+        minimum_match=min_match,
     )
 
 

@@ -344,13 +344,21 @@ class _Query:
     def hybrid(self, query: str, *, vector=None, alpha: float = 0.5,
                fusion_type: Optional[str] = None, limit: int = 10,
                filters=None, offset: int = 0, autocut=None,
-               target_vector: str = "", return_properties=None,
+               target_vector: str = "",
+               operator: Optional[str] = None,
+               minimum_match: Optional[int] = None,
+               return_properties=None,
                include=("score",)):
         h: dict = {"query": query, "alpha": alpha}
         if vector is not None:
             h["vector"] = vector
         if fusion_type:
             h["fusionType"] = _Enum(fusion_type)
+        if operator or minimum_match:
+            so: dict = {"operator": _Enum(operator or "Or")}
+            if minimum_match:
+                so["minimumOrTokensMatch"] = int(minimum_match)
+            h["searchOperator"] = so
         if target_vector:
             h["targetVectors"] = [target_vector]
         args = self._common({"hybrid": h}, filters, limit, offset,

@@ -978,6 +978,8 @@ class Collection:
         tenant: str = "",
         target: str = DEFAULT_VECTOR,
         max_vector_distance: Optional[float] = None,
+        operator: str = "Or",
+        minimum_match: int = 0,
     ) -> list[tuple[StorageObject, float]]:
         """BM25 + vector branches fused (reference ``hybrid/searcher.go:75``).
 
@@ -997,7 +999,8 @@ class Collection:
 
         if query and alpha < 1.0:
             sparse = self.bm25_search(
-                query, fetch, properties=properties, flt=flt, tenant=tenant
+                query, fetch, properties=properties, flt=flt, tenant=tenant,
+                operator=operator, minimum_match=minimum_match,
             )
             sets.append([(o.uuid, s) for o, s in sparse])
             weights.append(1.0 - alpha)

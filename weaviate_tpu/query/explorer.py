@@ -29,6 +29,9 @@ class HybridParams:
     alpha: float = 0.75
     fusion: str = "relativeScoreFusion"
     properties: Optional[list[str]] = None
+    # keyword-branch SearchOperatorOptions (reference hybrid.go:170)
+    operator: str = "Or"
+    minimum_match: int = 0
 
 
 @dataclass
@@ -239,6 +242,7 @@ class Explorer:
                 flt=params.filters, tenant=params.tenant,
                 target=params.target_vector,
                 max_vector_distance=params.max_distance,
+                operator=h.operator, minimum_match=h.minimum_match,
             )
             kind = "score"
         elif params.targets:
