@@ -155,6 +155,35 @@ def test_aggregate_count_and_int_stats(server):
     assert stats.int.maximum >= 129
 
 
+def test_bm25_search_operator_grpc(server):
+    """SearchOperatorOptions rides BM25.search_operator (field 3) and
+    Hybrid.bm25_search_operator (field 11), reference field numbers."""
+    chan, objs = server
+    # every doc's title is "news item {i}"; only one contains "7"
+    req = wv.SearchRequest(collection="Article", limit=30)
+    req.bm25_search.query = "news 7"
+    req.bm25_search.search_operator.operator = \
+        wv.SearchOperatorOptions.OPERATOR_AND
+    reply = _unary(chan, "Search", req, wv.SearchReply)
+    assert len(reply.results) == 1
+    # OR with minimum 1 matches everything
+    req2 = wv.SearchRequest(collection="Article", limit=30)
+    req2.bm25_search.query = "news 7"
+    req2.bm25_search.search_operator.operator = \
+        wv.SearchOperatorOptions.OPERATOR_OR
+    req2.bm25_search.search_operator.minimum_or_tokens_match = 1
+    reply2 = _unary(chan, "Search", req2, wv.SearchReply)
+    assert len(reply2.results) == 30
+    # hybrid keyword branch, alpha=0
+    req3 = wv.SearchRequest(collection="Article", limit=30)
+    req3.hybrid_search.query = "news 7"
+    req3.hybrid_search.alpha = 0.0
+    req3.hybrid_search.bm25_search_operator.operator = \
+        wv.SearchOperatorOptions.OPERATOR_AND
+    reply3 = _unary(chan, "Search", req3, wv.SearchReply)
+    assert len(reply3.results) == 1
+
+
 def test_aggregate_search_scoped(server):
     """Aggregate over the top-object_limit near_vector hits (reference
     aggregate.proto oneof search, field 42)."""
