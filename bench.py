@@ -1066,6 +1066,10 @@ def _bench_bm25seg_impl(n, k, vocab):
                 continue
             bk.postings_put(f"t{r}".encode(), docs[lo:hi], tfs[lo:hi],
                             doc_lens[docs[lo:hi]])
+            if r == vocab // 2:
+                # force >= 2 postings segments at every bench scale so
+                # the compaction A/B below always has a real merge
+                store.flush_all()
         # array-level bookkeeping bulk-load (the RAM bench feeds its engine
         # the same way — this bench measures the SERVING tier, not the
         # per-object tokenizer): live bits, counters, length aggregates
@@ -1162,6 +1166,44 @@ def _bench_bm25seg_impl(n, k, vocab):
             "agg_numeric_ms": round(agg_flat_ms, 1),
             "device": "cpu (segment tier + bounded WAND cache)",
         })
+
+        # native-vs-python compaction A/B over THIS config's real
+        # postings segments — the native C++ merge's number lands in
+        # the BENCH record, not just the notes
+        from weaviate_tpu.storage.segment import (
+            DiskSegment,
+            merge_streams,
+            native_merge,
+        )
+
+        bk = inv._posts("body")
+        segs = list(bk._segments)
+        if len(segs) >= 2:
+            paths = [s.path for s in segs]
+            t0 = time.perf_counter()
+            nat_out = os.path.join(tmpdir, "nat-merge.db")
+            cnt = native_merge(paths, nat_out, "inverted", True)
+            nat_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            py_out = os.path.join(tmpdir, "py-merge.db")
+            DiskSegment.write(py_out, merge_streams(
+                [s.items() for s in segs], "inverted",
+                drop_tombstones=True))
+            py_s = time.perf_counter() - t0
+            mb = os.path.getsize(nat_out) / 1e6
+            _emit({
+                "metric": "compaction_native_mbs",
+                "value": round(mb / max(nat_s, 1e-9), 1),
+                "unit": "MB/s",
+                "vs_baseline": round(py_s / max(nat_s, 1e-9), 2),
+                "segments": len(segs),
+                "records": cnt if cnt is not None else 0,
+                "out_mb": round(mb, 1),
+                "python_s": round(py_s, 2),
+                "native_s": round(nat_s, 3),
+                "native_used": cnt is not None,
+                "device": "cpu (native C++ segment merge)",
+            })
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
