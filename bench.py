@@ -881,6 +881,87 @@ def bench_msmarco(n=8_800_000, d=768, batch=256, k=10, iters=10, warmup=2,
     })
 
 
+def bench_ingest(n=120_000, batch=0, k=0, iters=0, warmup=0, d=128):
+    """Write-path throughput (reference objectsBatcher,
+    ``shard_write_batch_objects.go``): put_batch docs/s end-to-end —
+    object store + WAL + inverted postings + native BM25 + vector
+    feed. CPU-only subprocess, tunnel-proof like ``bm25``; batch/k/
+    iters/warmup accepted for override compatibility and ignored."""
+    import subprocess
+
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    code = f"import bench; bench._bench_ingest_impl({n}, {d})"
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=os.path.dirname(
+            os.path.abspath(__file__)) or ".",
+        capture_output=True, text=True, timeout=1800)
+    sys.stderr.write(out.stderr[-2000:])
+    line = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    if out.returncode != 0 or not line:
+        raise RuntimeError(f"ingest subprocess rc={out.returncode}")
+    print(line[-1], flush=True)
+
+
+def _bench_ingest_impl(n, d):
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        DataType,
+        FlatIndexConfig,
+        Property,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(4000)]
+    tmpdir = tempfile.mkdtemp(prefix="bench_ingest_", dir=".")
+    try:
+        db = DB(tmpdir)
+        db.create_collection(CollectionConfig(
+            name="Doc",
+            vector_config=FlatIndexConfig(distance="l2-squared"),
+            properties=[Property(name="title", data_type=DataType.TEXT),
+                        Property(name="n", data_type=DataType.INT)]))
+        col = db.get_collection("Doc")
+        vecs = rng.standard_normal((n, d)).astype(np.float32)
+        zipf = rng.zipf(1.3, size=(n, 8)) % len(words)
+        objs = [StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Doc",
+            properties={"title": " ".join(words[int(w)]
+                                          for w in zipf[i]),
+                        "n": int(i)},
+            vector=vecs[i]) for i in range(n)]
+        B = 2000
+        t0 = time.perf_counter()
+        for s in range(0, n, B):
+            col.put_batch(objs[s:s + B])
+        dt = time.perf_counter() - t0
+        # searchable immediately (sanity, not timed): keyword + vector
+        assert col.bm25_search(words[1], k=5)
+        assert col.vector_search(vecs[7], k=3)
+        _emit({
+            "metric": f"ingest_docs_s_{n // 1000}k",
+            "value": round(n / dt, 1),
+            "unit": "docs/s",
+            # r4 session-2 start (pre fast-path) measured 3,103 docs/s
+            # at this exact shape — the committed reference point
+            "vs_baseline": round((n / dt) / 3103.0, 2),
+            "batch": B,
+            "build_s": round(dt, 1),
+            "dims": d,
+            "device": "cpu (objectsBatcher analogue, single core)",
+        })
+        db.close()
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def bench_bm25(n=1_000_000, batch=0, k=10, iters=0, warmup=0, vocab=80_000):
     """Pure keyword tier: BlockMax-WAND over 1M synthetic-Zipf docs
     (reference ``test/benchmark_bm25``). CPU-only — runs in a SUBPROCESS
@@ -1235,13 +1316,14 @@ CONFIGS = {
     "msmarco": bench_msmarco,
     "bm25": bench_bm25,
     "bm25seg": bench_bm25seg,
+    "ingest": bench_ingest,
     "pallasab": bench_pallas_ab,
     "bq50m": bench_bq50m,
     "bq100m": bench_bq100m,
 }
 
 # configs that touch no device: they run even when the TPU probe fails
-CPU_ONLY = ("bm25", "bm25seg")
+CPU_ONLY = ("bm25", "bm25seg", "ingest")
 
 # ---------------------------------------------------------------------------
 # smoke mode: every config end-to-end at ~1/50 scale on CPU (<10 min total),
@@ -1301,6 +1383,10 @@ def _full_footprint(name: str) -> dict:
         # build-side edge arrays + bounded WAND cache; postings in LSM
         return {"hbm_gb": 0.0, "host_gb": n * 12 * 20 / _GB,
                 "disk_gb": n * 12 * 16 / _GB}
+    if name == "ingest":
+        n = 120_000
+        return {"hbm_gb": 0.0, "host_gb": n * 128 * 4 * 3 / _GB,
+                "disk_gb": n * 800 / _GB}
     return {"hbm_gb": 0.0, "host_gb": 0.0, "disk_gb": 0.0}
 
 
@@ -1321,6 +1407,7 @@ SMOKE = {
     "msmarco": dict(n=96_000, tenants=8, iters=2, warmup=1),
     "bm25": dict(n=20_000, vocab=8_000),
     "bm25seg": dict(n=20_000, vocab=8_000),
+    "ingest": dict(n=8_000),
 }
 
 
@@ -1422,7 +1509,7 @@ def main():
     # not the deliberately disk-bound segment tier; with the chip up a
     # device metric lands last either way.
     ap.add_argument("--configs",
-                    default="bm25seg,bm25,flat1m,sift1m,glove,pq,bq,"
+                    default="ingest,bm25seg,bm25,flat1m,sift1m,glove,pq,bq,"
                             "msmarco,pallasab")
     ap.add_argument("--smoke", action="store_true",
                     help="run EVERY selected config end-to-end at ~1/50 "
