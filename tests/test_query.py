@@ -47,6 +47,51 @@ def test_relative_score_fusion_normalizes_branches():
     assert scores["y"] > scores["x"]
 
 
+def test_legacy_group_closest_and_merge():
+    """Legacy group arg (reference traverser/grouper): greedy
+    clustering by normalized cosine distance < force; closest keeps
+    each cluster's best hit, merge folds properties (text joined as
+    'a (b)', numbers averaged) and averages vectors."""
+    import numpy as np
+
+    from weaviate_tpu.query.explorer import Hit
+    from weaviate_tpu.query.legacy_group import legacy_group
+    from weaviate_tpu.storage.objects import StorageObject
+
+    def hit(uuid, vec, props):
+        return Hit(object=StorageObject(
+            uuid=uuid, collection="C", properties=props,
+            vector=np.asarray(vec, np.float32)), distance=0.0)
+
+    hits = [
+        hit("a", [1, 0], {"t": "alpha", "n": 10}),
+        hit("b", [0.999, 0.01], {"t": "beta", "n": 20}),  # ~= a
+        hit("c", [0, 1], {"t": "gamma", "n": 30}),        # far
+    ]
+    closest = legacy_group(list(hits), "closest", force=0.05)
+    assert [h.object.uuid for h in closest] == ["a", "c"]
+
+    merged = legacy_group([hit("a", [1, 0], {"t": "alpha", "n": 10}),
+                           hit("b", [0.999, 0.01],
+                               {"t": "beta", "n": 20}),
+                           hit("c", [0, 1], {"t": "gamma", "n": 30})],
+                          "merge", force=0.05)
+    assert len(merged) == 2
+    m = merged[0]
+    assert m.object.properties["t"] == "alpha (beta)"
+    assert m.object.properties["n"] == 15.0
+    assert m.additional["group"]["count"] == 2
+    np.testing.assert_allclose(
+        m.object.vector, [(1 + 0.999) / 2, 0.005], atol=1e-6)
+    # force=0 groups nothing
+    none = legacy_group(list(hits), "closest", force=0.0)
+    assert len(none) == 3
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        legacy_group(hits, "bogus", 0.1)
+
+
 def test_autocut_cuts_at_jump():
     # clear jump after 3 results
     scores = [0.99, 0.98, 0.97, 0.5, 0.49]

@@ -421,6 +421,10 @@ class GraphQLExecutor:
             if h.get("targetVectors"):
                 # reference hybrid accepts targetVectors like near*
                 p.target_vector = h["targetVectors"][0]
+        if "group" in args:
+            g = args["group"]
+            p.legacy_group = {"type": str(g.get("type", "closest")),
+                              "force": float(g.get("force", 0.0))}
         if "sort" in args:
             s = args["sort"]
             entries = s if isinstance(s, list) else [s]

@@ -118,6 +118,9 @@ class QueryParams:
     after: Optional[str] = None
     sort: list[tuple[str, str]] = field(default_factory=list)
     group_by: Optional[GroupByParams] = None
+    # legacy GraphQL group: {type: closest|merge, force} (reference
+    # traverser/grouper; distinct from groupBy)
+    legacy_group: Optional[dict] = None
     autocut: int = 0
     # module-powered additional properties
     rerank: Optional[RerankParams] = None
@@ -317,6 +320,13 @@ class Explorer:
                 distance=s if kind == "distance" else None)
             for o, s in page
         ]
+        if params.legacy_group is not None:
+            from weaviate_tpu.query.legacy_group import legacy_group
+
+            hits = legacy_group(
+                hits,
+                str(params.legacy_group.get("type", "closest")),
+                float(params.legacy_group.get("force", 0.0)))
         result = QueryResult(hits=hits)
         if params.rerank is not None:
             self._apply_rerank(col, result, params.rerank)
