@@ -169,6 +169,23 @@ def test_graphql_get_and_aggregate(server):
     assert status == 200 and "errors" in res
 
 
+def test_graphql_legacy_group(server):
+    """group: {type, force} — seed() writes one-hot vectors per i%8, so
+    force high enough clusters each axis's duplicates."""
+    call(server, "POST", "/v1/schema", ARTICLE)
+    seed(server)  # 20 docs over 8 one-hot axes
+    q = """
+    { Get { Article(nearVector: {vector: [1,0,0,0,0,0,0,0]}, limit: 20,
+                    group: {type: closest, force: 0.01})
+            { title _additional { id group } } } }
+    """
+    status, res = call(server, "POST", "/v1/graphql", {"query": q})
+    assert status == 200 and "errors" not in res, res
+    rows = res["data"]["Get"]["Article"]
+    # 20 docs over 8 distinct axes collapse to 8 representatives
+    assert len(rows) == 8
+
+
 def test_graphql_hybrid_and_sort(server):
     call(server, "POST", "/v1/schema", ARTICLE)
     seed(server)
