@@ -155,6 +155,23 @@ def test_aggregate_count_and_int_stats(server):
     assert stats.int.maximum >= 129
 
 
+def test_near_text_move_grpc_rejected_without_vectorizer(server):
+    """NearTextSearch.Move fields parse on the wire; this collection has
+    no vectorizer, so the server must answer with a clean error (not a
+    crash) — the movement math itself is covered at the GraphQL layer
+    with the hash vectorizer."""
+    chan, _ = server
+    req = wv.SearchRequest(collection="Article", limit=3)
+    req.near_text.query.append("anything")
+    req.near_text.move_to.force = 0.5
+    req.near_text.move_to.concepts.append("target")
+    import grpc as _grpc
+
+    with pytest.raises(_grpc.RpcError) as ei:
+        _unary(chan, "Search", req, wv.SearchReply)
+    assert ei.value.code() == _grpc.StatusCode.INVALID_ARGUMENT
+
+
 def test_bm25_search_operator_grpc(server):
     """SearchOperatorOptions rides BM25.search_operator (field 3) and
     Hybrid.bm25_search_operator (field 11), reference field numbers."""

@@ -283,6 +283,50 @@ DOCS: dict[str, tuple[str, str | None, str | None]] = {
                         "Classification"),
     "classification": ("Classification job status", None,
                        "Classification"),
+    "root": ("Service links", None, None),
+    "oidc_discovery": ("OIDC discovery pointer", None, None),
+    "aliases": ("List / create collection aliases", None, None),
+    "alias_one": ("Get / re-point / delete one alias", None, None),
+    "shards": ("Shard statuses for a collection", None, None),
+    "shard_status": ("Set a shard READY | READONLY", None, None),
+    "tenant_one": ("Get one tenant", None, "Tenant"),
+    "graphql_batch": ("Batch of GraphQL queries", None, None),
+    "nodes_class": ("Node status scoped to one collection", None,
+                    "NodesStatusResponse"),
+    "cluster_statistics": ("Raft consensus statistics", None, None),
+    "tasks_list": ("Distributed task table", None, None),
+    "replicate": ("Start an async COPY/MOVE replica operation", None,
+                  None),
+    "replicate_op": ("Replication operation status", None, None),
+    "replicate_list": ("List replication operations", None, None),
+    "replicate_cancel": ("Cancel a replication operation", None, None),
+    "replicate_force_delete": ("Drop completed replication op records",
+                               None, None),
+    "sharding_state": ("Shard -> replica sets", None, None),
+    "replication_scale": ("Replication scale plan (compute only)",
+                          None, None),
+    "objects_validate": ("Validate an object without writing", "Object",
+                         None),
+    "object_by_id": ("Legacy uuid-only object CRUD", "Object", "Object"),
+    "object_by_id_references": ("Legacy uuid-only reference mutation",
+                                "SingleRef", None),
+    "authz_groups": ("Known RBAC group subjects", None, None),
+    "authz_group_assign": ("Assign roles to a group", None, None),
+    "authz_group_revoke": ("Revoke roles from a group", None, None),
+    "authz_group_roles": ("Roles assigned to a group", None, None),
+    "authz_role_group_assignments": ("Groups assigned a role", None,
+                                     None),
+    "authz_role_add_permissions": ("Append permissions to a role", None,
+                                   None),
+    "authz_role_remove_permissions": ("Remove permissions from a role",
+                                      None, None),
+    "authz_role_has_permission": ("Check one permission on a role",
+                                  None, None),
+    "authz_role_users": ("Users assigned a role", None, None),
+    "authz_role_user_assignments": ("User assignments of a role", None,
+                                    None),
+    "authz_user_roles_typed": ("Roles of a user by user type", None,
+                               None),
 }
 
 # (endpoint, METHOD) -> (request schema, response schema) overrides for
