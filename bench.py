@@ -181,7 +181,10 @@ CONFIG_METRICS = {
     "bq100m": (lambda m: m.startswith("bq_qps_100M"),) * 2,
     "msmarco": (lambda m: m.startswith("hybrid_msmarco_"),) * 2,
     "pallasab": (_m_pallas, _m_pallas),
-    "ingest": (lambda m: m.startswith("ingest_docs_s"),) * 2,
+    "ingest": (lambda m: m.startswith("ingest_docs_s")
+        and not m.rstrip("0123456789").endswith("w"),) * 2,
+    "ingestmp": (lambda m: m.startswith("ingest_docs_s")
+        and m.rstrip("0123456789").endswith("w"),) * 2,
     "bm25": (lambda m: m.startswith("bm25_wand_qps"),) * 2,
     "bm25seg": (lambda m: m.startswith(("bm25_segment_qps",
                                         "compaction_native")),
@@ -1027,6 +1030,110 @@ def bench_ingest(n=120_000, batch=0, k=0, iters=0, warmup=0, d=128):
     print(line[-1], flush=True)
 
 
+def bench_ingest_parallel(n=160_000, batch=0, k=0, iters=0, warmup=0,
+                          d=128, workers=0):
+    """Concurrent write path (reference ``objectsBatcher`` worker pool,
+    ``shard_write_batch_objects.go:44-46``): W worker PROCESSES, each
+    ingesting ``n/W`` docs into its own shard — the multi-shard
+    concurrent ingest a 16-shard collection does, measured end-to-end by
+    wall clock across all workers. W defaults to host cores. CPU-only;
+    batch/k/iters/warmup accepted for override compatibility."""
+    import subprocess
+
+    workers = workers or os.cpu_count() or 2
+    per = n // workers
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    cwd = os.path.dirname(os.path.abspath(__file__)) or "."
+    procs = [subprocess.Popen(
+        [sys.executable, "-c",
+         f"import bench; bench._bench_ingest_worker({per}, {d}, {w})"],
+        env=env, cwd=cwd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, bufsize=1)
+        for w in range(workers)]
+    # interpreter/corpus startup is excluded: workers report READY, the
+    # parent releases them together and times only the ingest phase (the
+    # reference's batcher pool lives in a long-running server process)
+    for p in procs:
+        if p.stdout.readline().strip() != "READY":
+            p.kill()
+            raise RuntimeError("ingest worker failed before start; "
+                               "see stderr")
+    t0 = time.perf_counter()
+    for p in procs:
+        p.stdin.write("\n")
+        p.stdin.flush()
+    outs = [p.communicate(timeout=1800) for p in procs]
+    wall = time.perf_counter() - t0
+    per_worker = []
+    for p, (stdout, stderr) in zip(procs, outs):
+        if p.returncode != 0:
+            sys.stderr.write(stderr[-2000:])
+            raise RuntimeError(f"ingest worker rc={p.returncode}")
+        line = [ln for ln in stdout.splitlines() if ln.startswith("{")]
+        per_worker.append(json.loads(line[-1])["docs_s"])
+    total_docs_s = per * workers / wall
+    _emit({
+        "metric": f"ingest_docs_s_{n // 1000}k_{workers}w",
+        "value": round(total_docs_s, 1),
+        "unit": "docs_s",
+        # speedup over one worker's solo rate (W would be perfectly
+        # linear); efficiency = that speedup / W
+        "vs_baseline": round(total_docs_s / max(per_worker), 2),
+        "efficiency": round(total_docs_s / (max(per_worker) * workers), 3),
+        "workers": workers,
+        "per_worker_docs_s": [round(x, 1) for x in per_worker],
+        "wall_s": round(wall, 2),
+    })
+
+
+def _bench_ingest_worker(n, d, seed):
+    """One ingest worker: its own DB dir (= its own shard), plain-JSON
+    result on stdout (no _emit — the parent owns the official line)."""
+    import shutil
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        DataType,
+        FlatIndexConfig,
+        Property,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    rng = np.random.default_rng(seed)
+    words = [f"w{i}" for i in range(4000)]
+    tmpdir = tempfile.mkdtemp(prefix=f"bench_ingest_w{seed}_", dir=".")
+    try:
+        db = DB(tmpdir)
+        db.create_collection(CollectionConfig(
+            name="Doc",
+            vector_config=FlatIndexConfig(distance="l2-squared"),
+            properties=[Property(name="title", data_type=DataType.TEXT),
+                        Property(name="n", data_type=DataType.INT)]))
+        col = db.get_collection("Doc")
+        vecs = rng.standard_normal((n, d)).astype(np.float32)
+        zipf = rng.zipf(1.3, size=(n, 8)) % len(words)
+        objs = [StorageObject(
+            uuid=f"{seed:08d}-0000-0000-0000-{i:012d}", collection="Doc",
+            properties={"title": " ".join(words[int(w)] for w in zipf[i]),
+                        "n": int(i)},
+            vector=vecs[i]) for i in range(n)]
+        print("READY", flush=True)
+        sys.stdin.readline()  # parent releases all workers together
+        B = 2000
+        t0 = time.perf_counter()
+        for s in range(0, n, B):
+            col.put_batch(objs[s:s + B])
+        dt = time.perf_counter() - t0
+        assert col.bm25_search(words[1], k=5)
+        print(json.dumps({"docs_s": n / dt}), flush=True)
+        db.close()
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def _bench_ingest_impl(n, d):
     import shutil
     import tempfile
@@ -1442,13 +1549,14 @@ CONFIGS = {
     "bm25": bench_bm25,
     "bm25seg": bench_bm25seg,
     "ingest": bench_ingest,
+    "ingestmp": bench_ingest_parallel,
     "pallasab": bench_pallas_ab,
     "bq50m": bench_bq50m,
     "bq100m": bench_bq100m,
 }
 
 # configs that touch no device: they run even when the TPU probe fails
-CPU_ONLY = ("bm25", "bm25seg", "ingest")
+CPU_ONLY = ("bm25", "bm25seg", "ingest", "ingestmp")
 
 # ---------------------------------------------------------------------------
 # smoke mode: every config end-to-end at ~1/50 scale on CPU (<10 min total),
@@ -1533,6 +1641,7 @@ SMOKE = {
     "bm25": dict(n=20_000, vocab=8_000),
     "bm25seg": dict(n=20_000, vocab=8_000),
     "ingest": dict(n=8_000),
+    "ingestmp": dict(n=8_000),
 }
 
 
@@ -1634,7 +1743,7 @@ def main():
     # not the deliberately disk-bound segment tier; with the chip up a
     # device metric lands last either way.
     ap.add_argument("--configs",
-                    default="ingest,bm25seg,bm25,flat1m,sift1m,glove,pq,bq,"
+                    default="ingest,ingestmp,bm25seg,bm25,flat1m,sift1m,glove,pq,bq,"
                             "msmarco,pallasab")
     ap.add_argument("--smoke", action="store_true",
                     help="run EVERY selected config end-to-end at ~1/50 "

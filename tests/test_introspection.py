@@ -1,0 +1,328 @@
+"""GraphQL introspection + extended-grammar tests.
+
+Reference behavior: ``adapters/handlers/graphql/schema.go`` rebuilds a
+graphql-go schema from the live class schema, so any introspecting
+client (IDEs, the v3 Python client) can discover per-class types. These
+tests drive the same contract: the standard graphql-js introspection
+document (operation + named fragments + deep TypeRef nesting) must
+resolve against live collections, and the executable dialect must keep
+working with fragments/variables/directives/aliases in the document.
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu import (
+    DB,
+    CollectionConfig,
+    DataType,
+    FlatIndexConfig,
+    Property,
+)
+from weaviate_tpu.api.graphql import GraphQLExecutor
+from weaviate_tpu.storage.objects import StorageObject
+
+STANDARD_INTROSPECTION = """
+query IntrospectionQuery {
+  __schema {
+    queryType { name }
+    mutationType { name }
+    subscriptionType { name }
+    types { ...FullType }
+    directives { name description locations args { ...InputValue } }
+  }
+}
+fragment FullType on __Type {
+  kind name description
+  fields(includeDeprecated: true) {
+    name description
+    args { ...InputValue }
+    type { ...TypeRef }
+    isDeprecated deprecationReason
+  }
+  inputFields { ...InputValue }
+  interfaces { ...TypeRef }
+  enumValues(includeDeprecated: true) {
+    name description isDeprecated deprecationReason
+  }
+  possibleTypes { ...TypeRef }
+}
+fragment InputValue on __InputValue {
+  name description type { ...TypeRef } defaultValue
+}
+fragment TypeRef on __Type {
+  kind name
+  ofType { kind name ofType { kind name ofType { kind name ofType {
+    kind name ofType { kind name ofType { kind name ofType {
+    kind name } } } } } } }
+}
+"""
+
+
+@pytest.fixture
+def executor(tmp_path):
+    db = DB(str(tmp_path / "db"))
+    db.create_collection(CollectionConfig(
+        name="Article",
+        properties=[
+            Property(name="title", data_type=DataType.TEXT),
+            Property(name="views", data_type=DataType.INT),
+            Property(name="score", data_type=DataType.NUMBER),
+            Property(name="published", data_type=DataType.BOOL),
+            Property(name="tags", data_type=DataType.TEXT_ARRAY),
+        ],
+        vector_config=FlatIndexConfig(distance="cosine")))
+    col = db.get_collection("Article")
+    vecs = np.eye(4, 8, dtype=np.float32)
+    col.put_batch([
+        StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Article",
+            properties={"title": f"article {i}", "views": i,
+                        "score": i / 2, "published": i % 2 == 0,
+                        "tags": ["t"]},
+            vector=vecs[i])
+        for i in range(4)
+    ])
+    yield GraphQLExecutor(db)
+    db.close()
+
+
+def test_standard_introspection_document(executor):
+    res = executor.execute(STANDARD_INTROSPECTION)
+    assert "errors" not in res, res.get("errors")
+    schema = res["data"]["__schema"]
+    assert schema["queryType"]["name"] == "WeaviateObj"
+    assert schema["mutationType"] is None
+    names = {t["name"] for t in schema["types"]}
+    assert {"Article", "ArticleAdditionalProps", "AggregateArticleObj",
+            "GetObjectsObj", "AggregateObjectsObj", "WhereInpObj",
+            "NearVectorInpObj", "HybridInpObj", "WhereOperatorEnum",
+            "__Schema", "__Type", "__Field", "String", "Int",
+            "Float", "Boolean"} <= names
+    assert {d["name"] for d in schema["directives"]} == {
+        "include", "skip", "deprecated"}
+
+
+def test_class_type_reflects_properties(executor):
+    res = executor.execute(STANDARD_INTROSPECTION)
+    art = next(t for t in res["data"]["__schema"]["types"]
+               if t["name"] == "Article")
+    fields = {f["name"]: f["type"] for f in art["fields"]}
+    assert fields["title"] == {"kind": "SCALAR", "name": "String",
+                               "ofType": None}
+    assert fields["views"]["name"] == "Int"
+    assert fields["score"]["name"] == "Float"
+    assert fields["published"]["name"] == "Boolean"
+    assert fields["tags"]["kind"] == "LIST"
+    assert fields["tags"]["ofType"]["name"] == "String"
+    assert fields["_additional"]["name"] == "ArticleAdditionalProps"
+
+
+def test_get_field_args_and_aggregate_types(executor):
+    res = executor.execute(STANDARD_INTROSPECTION)
+    types = {t["name"]: t for t in res["data"]["__schema"]["types"]}
+    get_args = {a["name"] for f in types["GetObjectsObj"]["fields"]
+                if f["name"] == "Article" for a in f["args"]}
+    assert {"where", "limit", "offset", "after", "autocut", "nearVector",
+            "nearObject", "nearText", "bm25", "hybrid", "sort",
+            "groupBy", "tenant"} <= get_args
+    agg = types["AggregateArticleObj"]
+    agg_fields = {f["name"]: f["type"] for f in agg["fields"]}
+    assert agg_fields["views"]["name"] == "AggregateNumericProp"
+    assert agg_fields["published"]["name"] == "AggregateBooleanProp"
+    assert agg_fields["title"]["name"] == "AggregateTextProp"
+    assert agg_fields["meta"]["name"] == "AggregateMetaObj"
+    # where input models operands recursion + value keys
+    where = types["WhereInpObj"]
+    in_names = {f["name"] for f in where["inputFields"]}
+    assert {"operator", "path", "operands", "valueText", "valueInt",
+            "valueGeoRange"} <= in_names
+
+
+def test_type_lookup_and_typename(executor):
+    res = executor.execute(
+        '{ __type(name: "Article") { kind name fields { name } } }')
+    t = res["data"]["__type"]
+    assert t["kind"] == "OBJECT"
+    assert {f["name"] for f in t["fields"]} >= {"title", "_additional"}
+    res = executor.execute('{ __type(name: "NoSuchClass") { name } }')
+    assert res["data"]["__type"] is None
+    res = executor.execute("{ __typename }")
+    assert res["data"]["__typename"] == "WeaviateObj"
+
+
+def test_meta_introspection(executor):
+    res = executor.execute(
+        '{ __type(name: "__Type") { kind fields { name } } }')
+    t = res["data"]["__type"]
+    assert {f["name"] for f in t["fields"]} >= {
+        "kind", "name", "fields", "inputFields", "ofType"}
+
+
+def test_variables_defaults_and_directives(executor):
+    # default fills a missing variable; @skip/@include prune fields
+    res = executor.execute(
+        'query Q($name: String = "Article") {'
+        ' __type(name: $name) { name'
+        '   kind @skip(if: true)'
+        '   description @include(if: false) } }')
+    t = res["data"]["__type"]
+    assert t == {"name": "Article"}
+    # explicit variables override defaults
+    res = executor.execute(
+        'query Q($name: String = "Article") { __type(name: $name) { name } }',
+        variables={"name": "GetObjectsObj"})
+    assert res["data"]["__type"]["name"] == "GetObjectsObj"
+
+
+def test_fragments_and_aliases_in_dialect_query(executor):
+    # named fragment + inline fragment + alias inside an executable Get
+    res = executor.execute("""
+      query {
+        Get {
+          Article(limit: 2, sort: [{path: ["views"], order: asc}]) {
+            headline: title
+            ... on Article { views }
+            ...Extra
+          }
+        }
+      }
+      fragment Extra on Article { published }
+    """)
+    assert "errors" not in res, res.get("errors")
+    rows = res["data"]["Get"]["Article"]
+    assert len(rows) == 2
+    assert rows[0]["headline"] == "article 0"
+    assert rows[0]["views"] == 0 and rows[0]["published"] is True
+
+
+def test_operation_name_selection(executor):
+    doc = """
+      query A { __type(name: "Article") { name } }
+      query B { __typename }
+    """
+    res = executor.execute(doc, operation_name="B")
+    assert res["data"] == {"__typename": "WeaviateObj"}
+    res = executor.execute(doc, operation_name="A")
+    assert res["data"]["__type"]["name"] == "Article"
+    # multiple operations without operationName is an error, not a
+    # silent first-op execution
+    res = executor.execute(doc)
+    assert "errors" in res
+
+
+def test_fragment_before_operation_sees_variable_defaults(executor):
+    res = executor.execute("""
+      fragment F on GetObjectsObj {
+        Article(limit: $lim, sort: [{path: ["views"], order: asc}]) { views }
+      }
+      query Q($lim: Int = 2) { Get { ...F } }
+    """)
+    assert "errors" not in res, res.get("errors")
+    assert [r["views"] for r in res["data"]["Get"]["Article"]] == [0, 1]
+
+
+def test_class_level_alias(executor):
+    res = executor.execute("""
+      { Get {
+          first: Article(limit: 1, sort: [{path: ["views"], order: asc}])
+            { views }
+          last: Article(limit: 1, sort: [{path: ["views"], order: desc}])
+            { views }
+      } }
+    """)
+    assert "errors" not in res, res.get("errors")
+    get = res["data"]["Get"]
+    assert get["first"][0]["views"] == 0 and get["last"][0]["views"] == 3
+
+
+def test_inline_fragment_without_type_condition(executor):
+    res = executor.execute(
+        'query Q($x: Boolean = true) { Get { Article(limit: 1) {'
+        ' ... @include(if: $x) { title } ... { views } } } }')
+    assert "errors" not in res, res.get("errors")
+    row = res["data"]["Get"]["Article"][0]
+    assert "title" in row and "views" in row
+
+
+def test_nested_typename_uses_meta_type_names(executor):
+    res = executor.execute(
+        '{ __schema { __typename queryType { __typename '
+        'fields { __typename type { __typename } } } } }')
+    s = res["data"]["__schema"]
+    assert s["__typename"] == "__Schema"
+    assert s["queryType"]["__typename"] == "__Type"
+    assert s["queryType"]["fields"][0]["__typename"] == "__Field"
+    assert s["queryType"]["fields"][0]["type"]["__typename"] == "__Type"
+
+
+def test_rbac_introspection_and_variable_driven_authz(tmp_path):
+    """Introspection must not 403 for scoped users, and a class hidden
+    from the authz walk by a variable-driven @include must still be
+    authz-checked (the executor and authz walk parse identically)."""
+    import json
+    import urllib.request
+
+    from weaviate_tpu.api.rest import AuthConfig, RestAPI
+    from weaviate_tpu.auth.rbac import RBACController
+
+    db = DB(str(tmp_path / "db"))
+    for name in ("Open", "Secret"):
+        db.create_collection(CollectionConfig(
+            name=name,
+            properties=[Property(name="p", data_type=DataType.TEXT)],
+            vector_config=FlatIndexConfig(distance="l2-squared")))
+    rbac = RBACController(path=str(tmp_path / "rbac.json"),
+                          root_users=["root"])
+    rbac.upsert_role("reader", [
+        {"action": "read_data", "resource": "collections/Open"},
+        {"action": "read_schema", "resource": "collections/*"}])
+    rbac.assign("alice", "reader")
+    api = RestAPI(db, auth=AuthConfig(
+        api_keys={"rk": "root", "ak": "alice"}, anonymous_access=False),
+        rbac=rbac)
+    srv = api.serve(host="127.0.0.1", port=0, background=True)
+    base = f"http://127.0.0.1:{srv.server_port}/v1"
+
+    def gql(body, key):
+        req = urllib.request.Request(
+            base + "/graphql", data=json.dumps(body).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Bearer {key}"})
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, None
+
+    try:
+        status, out = gql({"query": "{ __schema { queryType { name } } }"},
+                          "ak")
+        assert status == 200
+        assert out["data"]["__schema"]["queryType"]["name"] == "WeaviateObj"
+        status, out = gql({"query": "{ Get { Open { p } } }"}, "ak")
+        assert status == 200 and "errors" not in out
+        # direct access to Secret: denied
+        status, _ = gql({"query": "{ Get { Secret { p } } }"}, "ak")
+        assert status == 403
+        # variable-driven include must not slip past authz
+        status, _ = gql({
+            "query": "query Q($f: Boolean!) { Get {"
+                     " Secret @include(if: $f) { p } } }",
+            "variables": {"f": True}}, "ak")
+        assert status == 403
+    finally:
+        api.shutdown()
+        db.close()
+
+
+def test_schema_updates_with_new_collection(executor):
+    res = executor.execute('{ __type(name: "Later") { name } }')
+    assert res["data"]["__type"] is None
+    executor.db.create_collection(CollectionConfig(
+        name="Later",
+        properties=[Property(name="x", data_type=DataType.INT)],
+        vector_config=FlatIndexConfig(distance="l2-squared")))
+    res = executor.execute('{ __type(name: "Later") { name fields { name } } }')
+    assert res["data"]["__type"]["name"] == "Later"

@@ -116,6 +116,38 @@ def test_cjk_bm25_end_to_end(tmp_path):
     s.close()
 
 
+def test_cjk_tokenizer_env_gate(tmp_path, monkeypatch, caplog):
+    """gse/kagome_* schemes are rejected at schema validation unless the
+    reference's enable flags are set (``entities/tokenizer/tokenizer.go``
+    USE_GSE / ENABLE_TOKENIZER_*; ``usecases/schema/class.go:832``), and
+    enabling them logs the bigram-approximation warning once."""
+    import logging
+
+    from weaviate_tpu.schema import config as cfgmod
+    from weaviate_tpu.schema.config import (
+        CollectionConfig, DataType, Property, Tokenization,
+    )
+
+    def cjk_cfg(name):
+        return CollectionConfig(
+            name=name,
+            properties=[Property(name="body", data_type=DataType.TEXT,
+                                 tokenization=Tokenization.GSE)])
+
+    monkeypatch.delenv("ENABLE_TOKENIZER_GSE", raising=False)
+    monkeypatch.delenv("USE_GSE", raising=False)
+    with pytest.raises(ValueError, match="ENABLE_TOKENIZER_GSE"):
+        cjk_cfg("Cjk").validate()
+    # enabled: validates, and warns (once) that this is an approximation
+    monkeypatch.setenv("ENABLE_TOKENIZER_GSE", "true")
+    monkeypatch.setattr(cfgmod, "_CJK_WARNED", set())
+    with caplog.at_level(logging.WARNING, logger="weaviate_tpu.schema"):
+        cjk_cfg("Cjk").validate()
+        cjk_cfg("Cjk2").validate()
+    warns = [r for r in caplog.records if "bigrams" in r.getMessage()]
+    assert len(warns) == 1  # once per scheme, not per class
+
+
 # -- reindexer ---------------------------------------------------------------
 
 def test_reindex_inverted_rebuilds_postings(tmp_path):

@@ -46,7 +46,7 @@ from weaviate_tpu.query.aggregator import (  # noqa: E402
 
 _TOKEN_RE = re.compile(
     r"""\s*(?:(?P<comment>\#[^\n]*)
-          |(?P<punct>[{}()\[\]:,!])
+          |(?P<punct>\.\.\.|[{}()\[\]:,!=$@|])
           |(?P<string>"(?:\\.|[^"\\])*")
           |(?P<number>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
           |(?P<name>[_A-Za-z][_0-9A-Za-z]*))""",
@@ -81,12 +81,29 @@ class Field:
     name: str
     args: dict[str, Any] = field(default_factory=dict)
     selections: list["Field"] = field(default_factory=list)
+    alias: Optional[str] = None
+
+    @property
+    def out_name(self) -> str:
+        return self.alias or self.name
 
 
 class _Parser:
-    def __init__(self, tokens: list[tuple[str, str]]):
+    """Recursive-descent parser for the executable subset of the GraphQL
+    grammar Weaviate clients and introspecting IDEs send: operations with
+    variable definitions, named + inline fragments, spreads, and
+    ``@include``/``@skip`` directives (other directives are tolerated and
+    ignored). Mirrors what the reference gets for free from graphql-go
+    (``adapters/handlers/graphql/schema.go`` builds a full schema and
+    hands parsing to the library)."""
+
+    def __init__(self, tokens: list[tuple[str, str]],
+                 variables: Optional[dict] = None):
         self.toks = tokens
         self.i = 0
+        self.variables = dict(variables or {})
+        self.fragments: dict[str, list[Field]] = {}
+        self._frag_idx: dict[str, int] = {}  # name -> token index of '{'
 
     def peek(self):
         return self.toks[self.i] if self.i < len(self.toks) else ("eof", "")
@@ -101,24 +118,176 @@ class _Parser:
         if v != value:
             raise GraphQLError(f"expected {value!r}, got {v!r}")
 
-    def parse_document(self) -> list[Field]:
-        # optional 'query [Name]' prelude
-        if self.peek() == ("name", "query"):
-            self.next()
-            if self.peek()[0] == "name":
+    def parse_document(self,
+                       operation_name: Optional[str] = None) -> list[Field]:
+        """Two-phase: first scan every definition — collecting variable
+        defaults and fragment body positions WITHOUT parsing bodies (a
+        fragment may lexically precede the operation whose variables it
+        uses) — then parse the selected operation's selection set.
+        Fragments are parsed lazily at spread-expansion time."""
+        ops: list[tuple[Optional[str], int]] = []  # (op name, '{' index)
+        while self.peek()[0] != "eof":
+            kind, v = self.peek()
+            if v == "{":
+                ops.append((None, self.i))
+                self._skip_braced()
+            elif v in ("query", "mutation", "subscription"):
+                if v != "query":
+                    raise GraphQLError(f"{v} operations are not supported")
                 self.next()
-        self.expect("{")
-        fields = []
-        while self.peek()[1] != "}":
-            fields.append(self.parse_field())
-        self.expect("}")
-        return fields
+                opname = None
+                if self.peek()[0] == "name":
+                    opname = self.next()[1]
+                if self.peek()[1] == "(":
+                    self._variable_defs()
+                self._directives()
+                ops.append((opname, self.i))
+                self._skip_braced()
+            elif v == "fragment":
+                self.next()
+                _, name = self.next()
+                self.expect("on")
+                self.next()  # type condition
+                self._directives()
+                self._frag_idx[name] = self.i
+                self._skip_braced()
+            else:
+                raise GraphQLError(f"unexpected token {v!r} at top level")
+        if not ops:
+            raise GraphQLError("no operation in document")
+        if operation_name is not None:
+            matches = [idx for nm, idx in ops if nm == operation_name]
+            if not matches:
+                raise GraphQLError(
+                    f"unknown operation {operation_name!r}")
+            start = matches[0]
+        else:
+            if len(ops) > 1:
+                raise GraphQLError(
+                    "document has multiple operations; operationName "
+                    "is required")
+            start = ops[0][1]
+        self.i = start
+        fields = self._selection_set()
+        return self._expand(fields, depth=0)
 
-    def parse_field(self) -> Field:
+    def _skip_braced(self):
+        """Skip a balanced ``{ ... }`` block without parsing it."""
+        self.expect("{")
+        depth = 1
+        while depth:
+            kind, v = self.next()
+            if kind == "eof":
+                raise GraphQLError("unbalanced braces")
+            if v == "{":
+                depth += 1
+            elif v == "}":
+                depth -= 1
+
+    def _fragment(self, name: str, depth: int) -> list[Field]:
+        if name not in self.fragments:
+            idx = self._frag_idx.get(name)
+            if idx is None:
+                raise GraphQLError(f"unknown fragment {name!r}")
+            save = self.i
+            self.i = idx
+            # placeholder breaks self-referential cycles before expansion's
+            # depth guard catches them
+            self.fragments[name] = []
+            self.fragments[name] = self._selection_set()
+            self.i = save
+        return self.fragments[name]
+
+    def _variable_defs(self):
+        """``($name: Type = default, ...)`` — defaults fill ``variables``
+        for names the caller did not supply."""
+        self.expect("(")
+        while self.peek()[1] != ")":
+            self.expect("$")
+            _, name = self.next()
+            self.expect(":")
+            self._type_ref()
+            if self.peek()[1] == "=":
+                self.next()
+                default = self.parse_value()
+                self.variables.setdefault(name, default)
+            if self.peek()[1] == ",":
+                self.next()
+        self.expect(")")
+
+    def _type_ref(self):
+        if self.peek()[1] == "[":
+            self.next()
+            self._type_ref()
+            self.expect("]")
+        else:
+            kind, _ = self.next()
+            if kind != "name":
+                raise GraphQLError("bad type reference")
+        if self.peek()[1] == "!":
+            self.next()
+
+    def _directives(self) -> bool:
+        """Consume ``@name(args)*``; returns True if an ``@skip``/
+        ``@include`` directive says to drop the node."""
+        dropped = False
+        while self.peek()[1] == "@":
+            self.next()
+            _, name = self.next()
+            args = {}
+            if self.peek()[1] == "(":
+                self.next()
+                while self.peek()[1] != ")":
+                    _, argname = self.next()
+                    self.expect(":")
+                    args[argname] = self.parse_value()
+                    if self.peek()[1] == ",":
+                        self.next()
+                self.expect(")")
+            if name == "skip" and bool(args.get("if")):
+                dropped = True
+            if name == "include" and not bool(args.get("if", True)):
+                dropped = True
+        return dropped
+
+    def _selection_set(self) -> list[Field]:
+        self.expect("{")
+        out = []
+        while self.peek()[1] != "}":
+            f = self.parse_field()
+            if f is not None:
+                out.append(f)
+        self.expect("}")
+        return out
+
+    def parse_field(self) -> Optional[Field]:
         kind, name = self.next()
+        if name == "...":
+            # inline fragment: '... on T {..}', '... @dir {..}', '... {..}'
+            if self.peek() == ("name", "on") or self.peek()[1] in ("@", "{"):
+                if self.peek() == ("name", "on"):
+                    self.next()
+                    self.next()  # type condition (single-type model: always
+                    # matches — unions/interfaces are not part of the dialect)
+                dropped = self._directives()
+                sels = self._selection_set()
+                f = Field("...", selections=sels)
+                return None if dropped else f
+            kind2, frag = self.next()
+            if kind2 != "name":
+                raise GraphQLError(f"bad fragment spread {frag!r}")
+            dropped = self._directives()
+            f = Field("...", args={"fragment": frag})
+            return None if dropped else f
         if kind != "name":
             raise GraphQLError(f"expected field name, got {name!r}")
-        f = Field(name)
+        alias = None
+        if self.peek()[1] == ":":
+            # alias: use the alias as the output key, keep the real field
+            alias = name
+            self.next()
+            _, name = self.next()
+        f = Field(name, alias=alias)
         if self.peek()[1] == "(":
             self.next()
             while self.peek()[1] != ")":
@@ -128,15 +297,37 @@ class _Parser:
                 if self.peek()[1] == ",":
                     self.next()
             self.expect(")")
+        if self._directives():
+            # still need to consume a selection set if present
+            if self.peek()[1] == "{":
+                self._selection_set()
+            return None
         if self.peek()[1] == "{":
-            self.next()
-            while self.peek()[1] != "}":
-                f.selections.append(self.parse_field())
-            self.expect("}")
+            f.selections = self._selection_set()
         return f
+
+    def _expand(self, fields: list[Field], depth: int) -> list[Field]:
+        """Inline fragment spreads (cycle-guarded by depth)."""
+        if depth > 32:
+            raise GraphQLError("fragment nesting too deep (cycle?)")
+        out: list[Field] = []
+        for f in fields:
+            if f.name == "...":
+                if "fragment" in f.args:
+                    frag = self._fragment(f.args["fragment"], depth)
+                    out.extend(self._expand(frag, depth + 1))
+                else:
+                    out.extend(self._expand(f.selections, depth + 1))
+            else:
+                f.selections = self._expand(f.selections, depth)
+                out.append(f)
+        return out
 
     def parse_value(self) -> Any:
         kind, v = self.next()
+        if v == "$":
+            _, name = self.next()
+            return self.variables.get(name)
         if kind == "string":
             # GraphQL string escapes are JSON-compatible; json.loads keeps
             # non-ASCII text intact (unicode_escape would mojibake it)
@@ -177,8 +368,9 @@ class _Parser:
         raise GraphQLError(f"unexpected value token {v!r}")
 
 
-def parse(src: str) -> list[Field]:
-    return _Parser(_tokenize(src)).parse_document()
+def parse(src: str, variables: Optional[dict] = None,
+          operation_name: Optional[str] = None) -> list[Field]:
+    return _Parser(_tokenize(src), variables).parse_document(operation_name)
 
 
 # ---------------------------------------------------------------------------
@@ -232,9 +424,10 @@ class GraphQLExecutor:
         self.db = db
         self.explorer = Explorer(db)
 
-    def execute(self, query: str) -> dict:
+    def execute(self, query: str, variables: Optional[dict] = None,
+                operation_name: Optional[str] = None) -> dict:
         try:
-            roots = parse(query)
+            roots = parse(query, variables, operation_name)
             data: dict = {}
             for root in roots:
                 if root.name == "Get":
@@ -243,6 +436,12 @@ class GraphQLExecutor:
                     data.setdefault("Aggregate", {}).update(self._aggregate(root))
                 elif root.name == "Explore":
                     data["Explore"] = self._explore(root)
+                elif root.name in ("__schema", "__type"):
+                    from weaviate_tpu.api.introspection import resolve
+
+                    data[root.out_name] = resolve(self.db, root)
+                elif root.name == "__typename":
+                    data[root.out_name] = "WeaviateObj"
                 else:
                     raise GraphQLError(f"unknown root field {root.name!r}")
             return {"data": data}
@@ -330,7 +529,7 @@ class GraphQLExecutor:
     def _get(self, root: Field) -> dict:
         out = {}
         for cls in root.selections:
-            out[cls.name] = self._get_class(cls)
+            out[cls.out_name] = self._get_class(cls)
         return out
 
     def _params_from_args(self, class_name: str, args: dict) -> QueryParams:
@@ -557,7 +756,7 @@ class GraphQLExecutor:
                             for t in extra["tokens"]]
                 row["_additional"] = add
             else:
-                row[sel.name] = obj.properties.get(sel.name)
+                row[sel.out_name] = obj.properties.get(sel.name)
         return row
 
     # -- Aggregate ----------------------------------------------------------
@@ -652,7 +851,7 @@ class GraphQLExecutor:
                 return entry
 
             if group_by is None:
-                out[cls.name] = [render_entry(
+                out[cls.out_name] = [render_entry(
                     agg["meta"]["count"], agg.get("properties", {}))]
             else:
                 rows = []
@@ -663,5 +862,5 @@ class GraphQLExecutor:
                         "value": g["groupedBy"]["value"],
                     }
                     rows.append(row)
-                out[cls.name] = rows
+                out[cls.out_name] = rows
         return out

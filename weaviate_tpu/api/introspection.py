@@ -1,0 +1,446 @@
+"""GraphQL introspection over the live class schema.
+
+The reference rebuilds a complete graphql-go schema from the class
+schema on every schema change (``adapters/handlers/graphql/schema.go``;
+per-class Get/Aggregate object types assembled in
+``adapters/handlers/graphql/local/get/class_builder.go`` and
+``local/aggregate/``), which makes ``__schema``/``__type`` introspection
+work for free — IDEs and the v3 client depend on it. Here the same type
+graph is materialised as plain dicts on demand: named types live in a
+registry, field ``type`` entries are ``{kind, name}`` stubs swapped for
+the registry entry when a selection descends into them, and a generic
+resolver walks the query's selection set over that graph.
+
+Only the executable dialect's types are modelled (Get / Aggregate /
+Explore, per-class object + aggregate types, shared filter/search input
+objects); mutations are served by REST/gRPC as in the reference's
+actual deployment surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from weaviate_tpu.schema.config import DataType
+
+# ---------------------------------------------------------------------------
+# type-graph constructors
+# ---------------------------------------------------------------------------
+
+
+def _scalar(name: str, desc: str = "") -> dict:
+    return {"kind": "SCALAR", "name": name, "description": desc or None,
+            "fields": None, "inputFields": None, "interfaces": None,
+            "enumValues": None, "possibleTypes": None}
+
+
+def _enum(name: str, values: list[str], desc: str = "") -> dict:
+    return {"kind": "ENUM", "name": name, "description": desc or None,
+            "fields": None, "inputFields": None, "interfaces": None,
+            "possibleTypes": None,
+            "enumValues": [{"name": v, "description": None,
+                            "isDeprecated": False, "deprecationReason": None}
+                           for v in values]}
+
+
+def _obj(name: str, fields: list[dict], desc: str = "") -> dict:
+    return {"kind": "OBJECT", "name": name, "description": desc or None,
+            "fields": fields, "inputFields": None, "interfaces": [],
+            "enumValues": None, "possibleTypes": None}
+
+
+def _input(name: str, fields: list[dict], desc: str = "") -> dict:
+    return {"kind": "INPUT_OBJECT", "name": name, "description": desc or None,
+            "fields": None, "inputFields": fields, "interfaces": None,
+            "enumValues": None, "possibleTypes": None}
+
+
+def _ref(name: str, kind: str = "OBJECT") -> dict:
+    return {"kind": kind, "name": name, "ofType": None}
+
+
+def _list(of: dict) -> dict:
+    return {"kind": "LIST", "name": None, "ofType": of}
+
+
+def _nonnull(of: dict) -> dict:
+    return {"kind": "NON_NULL", "name": None, "ofType": of}
+
+
+def _field(name: str, type_: dict, args: Optional[list[dict]] = None,
+           desc: str = "") -> dict:
+    return {"name": name, "description": desc or None, "args": args or [],
+            "type": type_, "isDeprecated": False, "deprecationReason": None}
+
+
+def _arg(name: str, type_: dict, default: Optional[str] = None,
+         desc: str = "") -> dict:
+    return {"name": name, "description": desc or None, "type": type_,
+            "defaultValue": default}
+
+
+_STRING = _ref("String", "SCALAR")
+_INT = _ref("Int", "SCALAR")
+_FLOAT = _ref("Float", "SCALAR")
+_BOOL = _ref("Boolean", "SCALAR")
+
+# property DataType -> GraphQL output type ref
+_DATATYPE_REFS = {
+    DataType.TEXT: _STRING,
+    DataType.TEXT_ARRAY: _list(_STRING),
+    DataType.INT: _INT,
+    DataType.INT_ARRAY: _list(_INT),
+    DataType.NUMBER: _FLOAT,
+    DataType.NUMBER_ARRAY: _list(_FLOAT),
+    DataType.BOOL: _BOOL,
+    DataType.BOOL_ARRAY: _list(_BOOL),
+    DataType.DATE: _STRING,
+    DataType.DATE_ARRAY: _list(_STRING),
+    DataType.UUID: _STRING,
+    DataType.UUID_ARRAY: _list(_STRING),
+    DataType.GEO: _ref("GeoCoordinates"),
+    DataType.BLOB: _STRING,
+}
+
+_WHERE_OPERATORS = [
+    "And", "Or", "Not", "Equal", "NotEqual", "GreaterThan",
+    "GreaterThanEqual", "LessThan", "LessThanEqual", "Like",
+    "WithinGeoRange", "IsNull", "ContainsAny", "ContainsAll",
+]
+
+
+def _shared_types() -> dict[str, dict]:
+    """Types independent of the class schema."""
+    where_fields = [
+        _arg("operator", _ref("WhereOperatorEnum", "ENUM")),
+        _arg("path", _list(_STRING)),
+        _arg("operands", _list(_ref("WhereInpObj", "INPUT_OBJECT"))),
+        _arg("valueText", _STRING), _arg("valueString", _STRING),
+        _arg("valueInt", _INT), _arg("valueNumber", _FLOAT),
+        _arg("valueBoolean", _BOOL), _arg("valueDate", _STRING),
+        _arg("valueTextArray", _list(_STRING)),
+        _arg("valueIntArray", _list(_INT)),
+        _arg("valueNumberArray", _list(_FLOAT)),
+        _arg("valueBooleanArray", _list(_BOOL)),
+        _arg("valueGeoRange", _ref("GeoRangeInpObj", "INPUT_OBJECT")),
+    ]
+    move_fields = [
+        _arg("concepts", _list(_STRING)),
+        _arg("objects", _list(_ref("MoveObjectInpObj", "INPUT_OBJECT"))),
+        _arg("force", _FLOAT),
+    ]
+    types = {
+        "String": _scalar("String", "built-in UTF-8 string"),
+        "Int": _scalar("Int", "built-in 64-bit integer"),
+        "Float": _scalar("Float", "built-in IEEE-754 double"),
+        "Boolean": _scalar("Boolean", "built-in boolean"),
+        "ID": _scalar("ID", "built-in identifier"),
+        "WhereOperatorEnum": _enum("WhereOperatorEnum", _WHERE_OPERATORS),
+        "SortOrderEnum": _enum("SortOrderEnum", ["asc", "desc"]),
+        "FusionEnum": _enum(
+            "FusionEnum", ["rankedFusion", "relativeScoreFusion"]),
+        "GeoCoordinates": _obj("GeoCoordinates", [
+            _field("latitude", _FLOAT), _field("longitude", _FLOAT)]),
+        "GeoRangeInpObj": _input("GeoRangeInpObj", [
+            _arg("geoCoordinates",
+                 _ref("GeoCoordinatesInpObj", "INPUT_OBJECT")),
+            _arg("distance", _ref("GeoRangeDistanceInpObj", "INPUT_OBJECT"))]),
+        "GeoCoordinatesInpObj": _input("GeoCoordinatesInpObj", [
+            _arg("latitude", _FLOAT), _arg("longitude", _FLOAT)]),
+        "GeoRangeDistanceInpObj": _input("GeoRangeDistanceInpObj", [
+            _arg("max", _FLOAT)]),
+        "WhereInpObj": _input("WhereInpObj", where_fields),
+        "MoveObjectInpObj": _input("MoveObjectInpObj", [
+            _arg("id", _STRING), _arg("beacon", _STRING)]),
+        "MoveInpObj": _input("MoveInpObj", move_fields),
+        "NearVectorInpObj": _input("NearVectorInpObj", [
+            _arg("vector", _list(_FLOAT)), _arg("certainty", _FLOAT),
+            _arg("distance", _FLOAT), _arg("targetVectors", _list(_STRING))]),
+        "NearObjectInpObj": _input("NearObjectInpObj", [
+            _arg("id", _STRING), _arg("beacon", _STRING),
+            _arg("certainty", _FLOAT), _arg("distance", _FLOAT)]),
+        "NearTextInpObj": _input("NearTextInpObj", [
+            _arg("concepts", _list(_STRING)), _arg("certainty", _FLOAT),
+            _arg("distance", _FLOAT), _arg("autocorrect", _BOOL),
+            _arg("moveTo", _ref("MoveInpObj", "INPUT_OBJECT")),
+            _arg("moveAwayFrom", _ref("MoveInpObj", "INPUT_OBJECT"))]),
+        "Bm25InpObj": _input("Bm25InpObj", [
+            _arg("query", _STRING), _arg("properties", _list(_STRING)),
+            _arg("searchOperator",
+                 _ref("SearchOperatorInpObj", "INPUT_OBJECT"))]),
+        "SearchOperatorInpObj": _input("SearchOperatorInpObj", [
+            _arg("operator", _STRING),
+            _arg("minimumOrTokensMatch", _INT)]),
+        "HybridInpObj": _input("HybridInpObj", [
+            _arg("query", _STRING), _arg("alpha", _FLOAT),
+            _arg("vector", _list(_FLOAT)), _arg("properties", _list(_STRING)),
+            _arg("fusionType", _ref("FusionEnum", "ENUM"))]),
+        "SortInpObj": _input("SortInpObj", [
+            _arg("path", _list(_STRING)),
+            _arg("order", _ref("SortOrderEnum", "ENUM"))]),
+        "GroupByInpObj": _input("GroupByInpObj", [
+            _arg("path", _list(_STRING)), _arg("groups", _INT),
+            _arg("objectsPerGroup", _INT)]),
+        "ExploreObj": _obj("ExploreObj", [
+            _field("beacon", _STRING), _field("className", _STRING),
+            _field("certainty", _FLOAT), _field("distance", _FLOAT)]),
+        "AggregateMetaObj": _obj("AggregateMetaObj", [
+            _field("count", _INT)]),
+        "AggregateGroupedByObj": _obj("AggregateGroupedByObj", [
+            _field("path", _list(_STRING)), _field("value", _STRING)]),
+        "AggregateTextTopOccurrence": _obj("AggregateTextTopOccurrence", [
+            _field("value", _STRING), _field("occurs", _INT)]),
+        "AggregateTextProp": _obj("AggregateTextProp", [
+            _field("count", _INT), _field("type", _STRING),
+            _field("topOccurrences", _list(_ref("AggregateTextTopOccurrence")),
+                   [_arg("limit", _INT)])]),
+        "AggregateNumericProp": _obj("AggregateNumericProp", [
+            _field("count", _INT), _field("type", _STRING),
+            _field("minimum", _FLOAT), _field("maximum", _FLOAT),
+            _field("mean", _FLOAT), _field("median", _FLOAT),
+            _field("mode", _FLOAT), _field("sum", _FLOAT)]),
+        "AggregateBooleanProp": _obj("AggregateBooleanProp", [
+            _field("count", _INT), _field("type", _STRING),
+            _field("totalTrue", _INT), _field("totalFalse", _INT),
+            _field("percentageTrue", _FLOAT),
+            _field("percentageFalse", _FLOAT)]),
+        "AggregateDateProp": _obj("AggregateDateProp", [
+            _field("count", _INT), _field("type", _STRING),
+            _field("minimum", _STRING), _field("maximum", _STRING)]),
+    }
+    return types
+
+
+# shared Get-level args every class field accepts
+def _get_args() -> list[dict]:
+    return [
+        _arg("limit", _INT), _arg("offset", _INT), _arg("after", _STRING),
+        _arg("autocut", _INT),
+        _arg("where", _ref("WhereInpObj", "INPUT_OBJECT")),
+        _arg("nearVector", _ref("NearVectorInpObj", "INPUT_OBJECT")),
+        _arg("nearObject", _ref("NearObjectInpObj", "INPUT_OBJECT")),
+        _arg("nearText", _ref("NearTextInpObj", "INPUT_OBJECT")),
+        _arg("bm25", _ref("Bm25InpObj", "INPUT_OBJECT")),
+        _arg("hybrid", _ref("HybridInpObj", "INPUT_OBJECT")),
+        _arg("sort", _list(_ref("SortInpObj", "INPUT_OBJECT"))),
+        _arg("groupBy", _ref("GroupByInpObj", "INPUT_OBJECT")),
+        _arg("tenant", _STRING),
+    ]
+
+
+def _aggregate_args() -> list[dict]:
+    return [
+        _arg("where", _ref("WhereInpObj", "INPUT_OBJECT")),
+        _arg("groupBy", _list(_STRING)),
+        _arg("limit", _INT), _arg("objectLimit", _INT),
+        _arg("nearVector", _ref("NearVectorInpObj", "INPUT_OBJECT")),
+        _arg("nearObject", _ref("NearObjectInpObj", "INPUT_OBJECT")),
+        _arg("nearText", _ref("NearTextInpObj", "INPUT_OBJECT")),
+        _arg("tenant", _STRING),
+    ]
+
+
+def _agg_prop_ref(dt: DataType) -> dict:
+    if dt in (DataType.INT, DataType.INT_ARRAY, DataType.NUMBER,
+              DataType.NUMBER_ARRAY):
+        return _ref("AggregateNumericProp")
+    if dt in (DataType.BOOL, DataType.BOOL_ARRAY):
+        return _ref("AggregateBooleanProp")
+    if dt in (DataType.DATE, DataType.DATE_ARRAY):
+        return _ref("AggregateDateProp")
+    return _ref("AggregateTextProp")
+
+
+def build_registry(db) -> dict[str, dict]:
+    """Assemble the full named-type registry for the live schema."""
+    types = _shared_types()
+    get_fields = []
+    agg_fields = []
+    for name in sorted(db.collections()):
+        try:
+            cfg = db.get_collection(name).config
+        except Exception:
+            continue
+        prop_fields = []
+        agg_prop_fields = []
+        for p in cfg.properties:
+            dt = p.data_type
+            if dt in (DataType.REFERENCE, DataType.OBJECT,
+                      DataType.OBJECT_ARRAY):
+                continue  # refs/objects are beacons in REST; not modelled
+            prop_fields.append(_field(
+                p.name, _DATATYPE_REFS.get(dt, _STRING)))
+            agg_prop_fields.append(_field(p.name, _agg_prop_ref(dt)))
+        add_name = f"{name}AdditionalProps"
+        types[add_name] = _obj(add_name, [
+            _field("id", _STRING), _field("vector", _list(_FLOAT)),
+            _field("certainty", _FLOAT), _field("distance", _FLOAT),
+            _field("score", _STRING), _field("explainScore", _STRING),
+            _field("creationTimeUnix", _STRING),
+            _field("lastUpdateTimeUnix", _STRING)])
+        types[name] = _obj(
+            name, prop_fields + [_field("_additional", _ref(add_name))],
+            desc=cfg.description or f"collection {name}")
+        agg_name = f"Aggregate{name}Obj"
+        types[agg_name] = _obj(agg_name, agg_prop_fields + [
+            _field("meta", _ref("AggregateMetaObj")),
+            _field("groupedBy", _ref("AggregateGroupedByObj"))])
+        get_fields.append(_field(name, _list(_ref(name)), _get_args()))
+        agg_fields.append(_field(name, _list(_ref(agg_name)),
+                                 _aggregate_args()))
+    types["GetObjectsObj"] = _obj(
+        "GetObjectsObj", get_fields or [_field("_empty", _STRING)],
+        "one field per collection")
+    types["AggregateObjectsObj"] = _obj(
+        "AggregateObjectsObj", agg_fields or [_field("_empty", _STRING)],
+        "one field per collection")
+    types["WeaviateObj"] = _obj("WeaviateObj", [
+        _field("Get", _ref("GetObjectsObj")),
+        _field("Aggregate", _ref("AggregateObjectsObj")),
+        _field("Explore", _list(_ref("ExploreObj")), [
+            _arg("limit", _INT), _arg("offset", _INT),
+            _arg("nearVector", _ref("NearVectorInpObj", "INPUT_OBJECT")),
+            _arg("nearObject", _ref("NearObjectInpObj", "INPUT_OBJECT")),
+            _arg("nearText", _ref("NearTextInpObj", "INPUT_OBJECT"))]),
+    ], "query root")
+    types.update(_meta_types())
+    return types
+
+
+def _meta_types() -> dict[str, dict]:
+    """The __Schema/__Type/... meta layer itself, so meta-introspection
+    (`__type(name: "__Type")`) answers like a standard server."""
+    type_ref = _ref("__Type")
+    return {
+        "__Schema": _obj("__Schema", [
+            _field("description", _STRING),
+            _field("types", _nonnull(_list(_nonnull(type_ref)))),
+            _field("queryType", _nonnull(type_ref)),
+            _field("mutationType", type_ref),
+            _field("subscriptionType", type_ref),
+            _field("directives", _nonnull(_list(_nonnull(_ref("__Directive"))))),
+        ]),
+        "__Type": _obj("__Type", [
+            _field("kind", _nonnull(_ref("__TypeKind", "ENUM"))),
+            _field("name", _STRING), _field("description", _STRING),
+            _field("fields", _list(_nonnull(_ref("__Field"))),
+                   [_arg("includeDeprecated", _BOOL, "false")]),
+            _field("interfaces", _list(_nonnull(type_ref))),
+            _field("possibleTypes", _list(_nonnull(type_ref))),
+            _field("enumValues", _list(_nonnull(_ref("__EnumValue"))),
+                   [_arg("includeDeprecated", _BOOL, "false")]),
+            _field("inputFields", _list(_nonnull(_ref("__InputValue")))),
+            _field("ofType", type_ref),
+        ]),
+        "__Field": _obj("__Field", [
+            _field("name", _nonnull(_STRING)), _field("description", _STRING),
+            _field("args", _nonnull(_list(_nonnull(_ref("__InputValue"))))),
+            _field("type", _nonnull(type_ref)),
+            _field("isDeprecated", _nonnull(_BOOL)),
+            _field("deprecationReason", _STRING),
+        ]),
+        "__InputValue": _obj("__InputValue", [
+            _field("name", _nonnull(_STRING)), _field("description", _STRING),
+            _field("type", _nonnull(type_ref)),
+            _field("defaultValue", _STRING),
+        ]),
+        "__EnumValue": _obj("__EnumValue", [
+            _field("name", _nonnull(_STRING)), _field("description", _STRING),
+            _field("isDeprecated", _nonnull(_BOOL)),
+            _field("deprecationReason", _STRING),
+        ]),
+        "__TypeKind": _enum("__TypeKind", [
+            "SCALAR", "OBJECT", "INTERFACE", "UNION", "ENUM",
+            "INPUT_OBJECT", "LIST", "NON_NULL"]),
+        "__Directive": _obj("__Directive", [
+            _field("name", _nonnull(_STRING)), _field("description", _STRING),
+            _field("locations", _nonnull(_list(_nonnull(
+                _ref("__DirectiveLocation", "ENUM"))))),
+            _field("args", _nonnull(_list(_nonnull(_ref("__InputValue"))))),
+            _field("isRepeatable", _nonnull(_BOOL)),
+        ]),
+        "__DirectiveLocation": _enum("__DirectiveLocation", [
+            "QUERY", "MUTATION", "SUBSCRIPTION", "FIELD",
+            "FRAGMENT_DEFINITION", "FRAGMENT_SPREAD", "INLINE_FRAGMENT",
+            "VARIABLE_DEFINITION", "SCHEMA", "SCALAR", "OBJECT",
+            "FIELD_DEFINITION", "ARGUMENT_DEFINITION", "INTERFACE", "UNION",
+            "ENUM", "ENUM_VALUE", "INPUT_OBJECT", "INPUT_FIELD_DEFINITION"]),
+    }
+
+
+_DIRECTIVES = [
+    {"name": "include", "description":
+        "include this field when the if argument is true",
+     "locations": ["FIELD", "FRAGMENT_SPREAD", "INLINE_FRAGMENT"],
+     "args": [_arg("if", _nonnull(_BOOL))], "isRepeatable": False},
+    {"name": "skip", "description":
+        "skip this field when the if argument is true",
+     "locations": ["FIELD", "FRAGMENT_SPREAD", "INLINE_FRAGMENT"],
+     "args": [_arg("if", _nonnull(_BOOL))], "isRepeatable": False},
+    {"name": "deprecated", "description": "marks a field as deprecated",
+     "locations": ["FIELD_DEFINITION", "ENUM_VALUE"],
+     "args": [_arg("reason", _STRING, '"No longer supported"')],
+     "isRepeatable": False},
+]
+
+# ---------------------------------------------------------------------------
+# generic selection resolver
+# ---------------------------------------------------------------------------
+
+
+# meta type of the node a selection descends into, keyed by field name —
+# so nested ``__typename`` answers like a standard server (Apollo keys
+# its normalized cache on it)
+_CHILD_TYPENAME = {
+    "types": "__Type", "queryType": "__Type", "mutationType": "__Type",
+    "subscriptionType": "__Type", "ofType": "__Type", "type": "__Type",
+    "interfaces": "__Type", "possibleTypes": "__Type",
+    "fields": "__Field", "args": "__InputValue",
+    "inputFields": "__InputValue", "enumValues": "__EnumValue",
+    "directives": "__Directive",
+}
+
+
+def _resolve_node(node: Any, selections: list, registry: dict,
+                  typename: Optional[str] = None) -> Any:
+    if node is None:
+        return None
+    if isinstance(node, list):
+        return [_resolve_node(x, selections, registry, typename)
+                for x in node]
+    if not selections:
+        return node
+    # a {kind, name[, ofType]} type stub descends into the registry entry
+    if (isinstance(node, dict) and node.get("name")
+            and node["name"] in registry
+            and set(node) <= {"kind", "name", "ofType"}):
+        node = registry[node["name"]]
+    out = {}
+    for f in selections:
+        if f.name == "__typename":
+            out[f.out_name] = typename or "__Type"
+            continue
+        child = node.get(f.name) if isinstance(node, dict) else None
+        out[f.out_name] = _resolve_node(
+            child, f.selections, registry, _CHILD_TYPENAME.get(f.name))
+    return out
+
+
+def resolve(db, root) -> Any:
+    """Entry point from the GraphQL executor: ``root`` is the parsed
+    ``__schema`` or ``__type`` field."""
+    registry = build_registry(db)
+    if root.name == "__type":
+        name = root.args.get("name")
+        t = registry.get(name)
+        return None if t is None else _resolve_node(
+            t, root.selections, registry, "__Type")
+    schema_node = {
+        "description": "weaviate-tpu GraphQL API",
+        "types": list(registry.values()),
+        "queryType": registry["WeaviateObj"],
+        "mutationType": None,
+        "subscriptionType": None,
+        "directives": _DIRECTIVES,
+    }
+    return _resolve_node(schema_node, root.selections, registry, "__Schema")

@@ -955,16 +955,23 @@ class RestAPI:
         return _json_response(results)
 
     # -- graphql -----------------------------------------------------------
-    def _graphql_authz(self, request, query: str) -> None:
+    def _graphql_authz(self, request, query: str,
+                       variables=None, operation_name=None) -> None:
         """Per-class authz for every class a query touches (scoped
         read_data grants must work); parse errors fall through to the
-        executor's error shape. Shared by /graphql and /graphql/batch."""
+        executor's error shape. Shared by /graphql and /graphql/batch.
+        MUST parse with the same variables/operation as execution —
+        otherwise a variable-driven @include could hide a class from the
+        authz walk that execution then returns. Introspection roots
+        (``__schema``/``__type``) select meta fields, not classes."""
         if self.rbac is None:
             return
         from weaviate_tpu.api.graphql import GraphQLError, parse
 
         try:
-            for root in parse(query):
+            for root in parse(query, variables, operation_name):
+                if root.name.startswith("__"):
+                    continue
                 for cls in root.selections:
                     self._authz(request, "read_data",
                                 f"collections/{cls.name}")
@@ -974,8 +981,11 @@ class RestAPI:
     def on_graphql(self, request):
         body = self._body(request)
         query = body.get("query", "")
-        self._graphql_authz(request, query)
-        return _json_response(self.graphql.execute(query))
+        variables = body.get("variables")
+        op_name = body.get("operationName")
+        self._graphql_authz(request, query, variables, op_name)
+        return _json_response(
+            self.graphql.execute(query, variables, op_name))
 
     def on_graphql_batch(self, request):
         """Batch of GraphQL queries in one request (reference
@@ -991,9 +1001,11 @@ class RestAPI:
                                         "entry must be {query: ...}"}]})
                 continue
             query = entry.get("query", "")
+            variables = entry.get("variables")
+            op_name = entry.get("operationName")
             try:
-                self._graphql_authz(request, query)
-                out.append(self.graphql.execute(query))
+                self._graphql_authz(request, query, variables, op_name)
+                out.append(self.graphql.execute(query, variables, op_name))
             except _Forbidden as e:
                 out.append({"errors": [{"message": str(e)}]})
         return _json_response(out)

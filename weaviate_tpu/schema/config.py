@@ -51,6 +51,45 @@ class Tokenization(str, enum.Enum):
     KAGOME_KR = "kagome_kr"
 
 
+# CJK scheme -> env flags that enable it (reference
+# ``entities/tokenizer/tokenizer.go:54-96`` gates gse/kagome behind
+# ENABLE_TOKENIZER_* / USE_GSE; ``usecases/schema/class.go:832-847``
+# rejects classes using a non-enabled tokenizer). This build carries no
+# segmentation dictionaries, so enabling a CJK scheme opts in to the
+# dictionary-free bigram approximation — the error and the one-time
+# warning both say so.
+_CJK_TOKENIZER_FLAGS = {
+    "gse": ("ENABLE_TOKENIZER_GSE", "USE_GSE"),
+    "kagome_ja": ("ENABLE_TOKENIZER_KAGOME_JA",),
+    "kagome_kr": ("ENABLE_TOKENIZER_KAGOME_KR",),
+}
+_CJK_WARNED: set = set()
+
+
+def _validate_cjk_tokenization(p: "Property") -> None:
+    import logging
+    import os
+
+    scheme = p.tokenization.value
+    flags = _CJK_TOKENIZER_FLAGS.get(scheme)
+    if flags is None:
+        return
+    if not any(os.environ.get(f, "").lower() in ("1", "true", "on", "enabled")
+               for f in flags):
+        raise ValueError(
+            f"the {scheme!r} tokenizer is not enabled; set {flags[0]!r} to "
+            f"'true' to enable it (in this build it is approximated by "
+            f"dictionary-free overlapping CJK bigrams, not a "
+            f"dictionary segmenter)")
+    if scheme not in _CJK_WARNED:
+        _CJK_WARNED.add(scheme)
+        logging.getLogger("weaviate_tpu.schema").warning(
+            "tokenization %r enabled: approximated as overlapping CJK "
+            "bigrams (no segmentation dictionary in this build); recall "
+            "matches bigram indexing, not gse/kagome dictionary output",
+            scheme)
+
+
 @dataclass
 class Property:
     name: str
@@ -431,6 +470,7 @@ class CollectionConfig:
             if p.name in seen:
                 raise ValueError(f"duplicate property {p.name!r}")
             seen.add(p.name)
+            _validate_cjk_tokenization(p)
 
     def property(self, name: str) -> Optional[Property]:
         for p in self.properties:
