@@ -21,6 +21,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Auto-mesh stays OFF for the bulk of the suite: with 8 virtual devices,
+# every Collection search would otherwise compile an 8-way SPMD program per
+# new shape — minutes of XLA time across the suite's hundreds of shapes.
+# Sharding/collectives are still validated by the dedicated mesh tests
+# (test_parallel.py builds meshes directly; test_mesh_serving.py opts back
+# in via runtime.set_mesh).
+os.environ.setdefault("WEAVIATE_TPU_MESH", "off")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -11,6 +11,10 @@ import pytest
 from weaviate_tpu.index.hnsw.hnsw import HNSWIndex
 from weaviate_tpu.schema.config import HNSWIndexConfig
 
+# every test builds a 3k-node graph and compiles the beam program
+# (~10-20s each on the virtual-CPU platform): full-CI tier, not tier-1
+pytestmark = pytest.mark.slow
+
 
 def _build(n=3000, d=32, seed=0, **kw):
     rng = np.random.default_rng(seed)

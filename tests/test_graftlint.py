@@ -1,0 +1,729 @@
+"""graftlint unit tests: per-rule fixtures (positive, negative, and
+suppressed cases) plus baseline loader validation."""
+
+import json
+import textwrap
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+from tools.graftlint import baseline as baseline_mod
+from tools.graftlint.cli import main as cli_main
+from tools.graftlint.engine import lint_source
+from tools.graftlint.rules import RULE_IDS, get_rules
+
+HOT = "weaviate_tpu/ops/fake.py"
+KERNEL = "weaviate_tpu/ops/fake_kernel.py"
+COLD = "weaviate_tpu/storage/fake.py"
+CLUSTER = "weaviate_tpu/cluster/fake.py"
+
+
+def run(src, rel=HOT, rules=None):
+    res = lint_source(textwrap.dedent(src), rel, rules)
+    return res
+
+
+def rule_ids(res):
+    return [v.rule for v in res.violations]
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-hot-path
+
+
+class TestHostSync:
+    def test_np_asarray_on_device_call_flagged(self):
+        res = run("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                return np.asarray(jnp.sum(x))
+        """)
+        assert rule_ids(res) == ["host-sync-in-hot-path"]
+
+    def test_taint_through_assignment(self):
+        res = run("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                d = jnp.dot(x, x)
+                e = d * 2
+                return np.asarray(e)
+        """)
+        assert rule_ids(res) == ["host-sync-in-hot-path"]
+
+    def test_host_input_prep_not_flagged(self):
+        res = run("""
+            import numpy as np
+
+            def f(queries):
+                q = np.atleast_2d(np.asarray(queries, np.float32))
+                return q
+        """)
+        assert rule_ids(res) == []
+
+    def test_tolist_and_item_on_device_value(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def f(x):
+                s = jnp.max(x)
+                return s.item(), jnp.min(x).tolist()
+        """)
+        assert rule_ids(res) == ["host-sync-in-hot-path"] * 2
+
+    def test_tolist_on_host_value_not_flagged(self):
+        res = run("""
+            import numpy as np
+
+            def f(xs):
+                return np.asarray(xs).tolist()
+        """)
+        assert rule_ids(res) == []
+
+    def test_block_until_ready_always_flagged(self):
+        res = run("""
+            def f(x):
+                return x.block_until_ready()
+        """)
+        assert rule_ids(res) == ["host-sync-in-hot-path"]
+
+    def test_float_cast_of_device_value(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def f(x):
+                return float(jnp.sum(x))
+        """)
+        assert rule_ids(res) == ["host-sync-in-hot-path"]
+
+    def test_ops_import_is_taint_source(self):
+        res = run("""
+            import numpy as np
+            from weaviate_tpu.ops.distance import gather_distance
+
+            def f(q, c, i):
+                return np.asarray(gather_distance(q, c, i, "dot"))
+        """)
+        assert rule_ids(res) == ["host-sync-in-hot-path"]
+
+    def test_jax_devices_not_a_taint_source(self):
+        res = run("""
+            import jax
+            import numpy as np
+
+            def f():
+                devs = jax.devices()
+                return np.array(devs)
+        """)
+        assert rule_ids(res) == []
+
+    def test_outside_hot_path_not_flagged(self):
+        res = run("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                return np.asarray(jnp.sum(x))
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_suppressed_with_reason(self):
+        res = run("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                # graftlint: allow[host-sync-in-hot-path] reason=final materialization
+                return np.asarray(jnp.sum(x))
+        """)
+        assert rule_ids(res) == []
+        assert len(res.suppressed) == 1
+
+    def test_unused_suppression_is_its_own_violation(self):
+        res = run("""
+            import numpy as np
+
+            def f(xs):
+                # graftlint: allow[host-sync-in-hot-path] reason=stale comment
+                return np.asarray(xs, np.float32)
+        """)
+        assert rule_ids(res) == ["unused-suppression"]
+
+    def test_suppression_without_reason_is_its_own_violation(self):
+        res = run("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                # graftlint: allow[host-sync-in-hot-path]
+                return np.asarray(jnp.sum(x))
+        """)
+        assert sorted(rule_ids(res)) == [
+            "host-sync-in-hot-path", "suppression-missing-reason"]
+
+
+# ---------------------------------------------------------------------------
+# jit-in-loop
+
+
+class TestJitInLoop:
+    def test_jit_in_for_loop(self):
+        res = run("""
+            import jax
+
+            def f(fns, xs):
+                for fn in fns:
+                    g = jax.jit(fn)
+                    xs = g(xs)
+                return xs
+        """, rel=COLD)
+        assert rule_ids(res) == ["jit-in-loop"]
+
+    def test_immediately_invoked_jit(self):
+        res = run("""
+            import jax
+
+            def handler(x):
+                return jax.jit(lambda y: y * 2)(x)
+        """, rel=COLD)
+        assert rule_ids(res) == ["jit-in-loop"]
+
+    def test_module_scope_jit_ok(self):
+        res = run("""
+            import jax
+
+            def _impl(x):
+                return x
+
+            g = jax.jit(_impl)
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_decorator_ok(self):
+        res = run("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def f(x, k):
+                return x[:k]
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_pallas_call_inside_jitted_fn_ok(self):
+        res = run("""
+            import functools
+            import jax
+            from jax.experimental import pallas as pl
+
+            @jax.jit
+            def f(x):
+                return pl.pallas_call(lambda r: r, out_shape=x)(x)
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_loop_inside_jitted_fn_is_trace_time_ok(self):
+        res = run("""
+            import jax
+            from jax.experimental import pallas as pl
+
+            @jax.jit
+            def f(x):
+                for spec in range(3):
+                    x = pl.pallas_call(lambda r: r, out_shape=x)(x)
+                return x
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_lru_cached_factory_ok(self):
+        res = run("""
+            import functools
+            import jax
+
+            @functools.lru_cache(maxsize=8)
+            def make(k):
+                return jax.jit(lambda x: x[:k])
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_plain_function_body_flagged_as_warning(self):
+        res = run("""
+            import jax
+
+            def per_request(fn, x):
+                return jax.jit(fn)
+        """, rel=COLD)
+        assert rule_ids(res) == ["jit-in-loop"]
+        assert res.violations[0].severity == "warning"
+
+
+# ---------------------------------------------------------------------------
+# nonhashable-static-arg
+
+
+class TestNonhashableStaticArg:
+    def test_list_literal_flagged(self):
+        res = run("""
+            import jax
+
+            g = jax.jit(lambda x, k: x, static_argnums=[1])
+        """, rel=COLD)
+        assert rule_ids(res) == ["nonhashable-static-arg"]
+
+    def test_dict_literal_flagged(self):
+        res = run("""
+            import jax
+
+            g = jax.jit(lambda x: x, static_argnames={"k": 1})
+        """, rel=COLD)
+        assert rule_ids(res) == ["nonhashable-static-arg"]
+
+    def test_tuple_ok(self):
+        res = run("""
+            import jax
+
+            g = jax.jit(lambda x, k: x, static_argnums=(1,))
+            h = jax.jit(lambda x, k: x, static_argnames=("k",))
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+
+# ---------------------------------------------------------------------------
+# swallowed-exception
+
+
+class TestSwallowedException:
+    def test_bare_except_pass(self):
+        res = run("""
+            def f():
+                try:
+                    g()
+                except:
+                    pass
+        """, rel=COLD)
+        assert rule_ids(res) == ["swallowed-exception"]
+
+    def test_blind_except_exception_pass(self):
+        res = run("""
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        """, rel=COLD)
+        assert rule_ids(res) == ["swallowed-exception"]
+
+    def test_critical_severity_in_cluster(self):
+        res = run("""
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        """, rel=CLUSTER)
+        assert rule_ids(res) == ["swallowed-exception"]
+        assert res.violations[0].severity == "critical"
+
+    def test_narrowed_type_ok(self):
+        res = run("""
+            def f():
+                try:
+                    g()
+                except (OSError, ValueError):
+                    pass
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_logging_counts_as_handled(self):
+        res = run("""
+            import logging
+
+            def f():
+                try:
+                    g()
+                except Exception:
+                    logging.getLogger("x").warning("boom", exc_info=True)
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_reraise_counts_as_handled(self):
+        res = run("""
+            def f():
+                try:
+                    g()
+                except Exception:
+                    cleanup()
+                    raise
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_consuming_bound_exception_counts_as_handled(self):
+        res = run("""
+            def f(fut):
+                try:
+                    g()
+                except BaseException as e:
+                    fut.set_exception(e)
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_tuple_containing_exception_is_blind(self):
+        res = run("""
+            def f():
+                try:
+                    g()
+                except (ValueError, Exception):
+                    pass
+        """, rel=COLD)
+        assert rule_ids(res) == ["swallowed-exception"]
+
+
+# ---------------------------------------------------------------------------
+# lock-across-device-call
+
+
+class TestLockAcrossDeviceCall:
+    def test_jnp_under_lock_flagged(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            class S:
+                def f(self, x):
+                    with self._lock:
+                        return jnp.sum(x)
+        """, rel=COLD)
+        assert rule_ids(res) == ["lock-across-device-call"]
+
+    def test_ops_import_under_lock_flagged(self):
+        res = run("""
+            from weaviate_tpu.ops.distance import pairwise_distance
+
+            class S:
+                def f(self, q, c):
+                    with self._search_lock:
+                        return pairwise_distance(q, c, "dot")
+        """, rel=COLD)
+        assert rule_ids(res) == ["lock-across-device-call"]
+
+    def test_host_work_under_lock_ok(self):
+        res = run("""
+            class S:
+                def f(self):
+                    with self._lock:
+                        return dict(self._table)
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_device_call_outside_lock_ok(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            class S:
+                def f(self, x):
+                    with self._lock:
+                        snap = self._state
+                    return jnp.sum(snap)
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_jax_devices_under_lock_ok(self):
+        res = run("""
+            import jax
+
+            def f(lock):
+                with lock:
+                    return jax.devices()
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+
+# ---------------------------------------------------------------------------
+# float64-literal-drift
+
+
+class TestFloat64LiteralDrift:
+    def test_undtyped_float_literal_flagged(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def k():
+                return jnp.array(0.5)
+        """, rel=KERNEL)
+        assert rule_ids(res) == ["float64-literal-drift"]
+
+    def test_dtype_keyword_ok(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def k():
+                return jnp.full((4,), 0.5, dtype=jnp.float32)
+        """, rel=KERNEL)
+        assert rule_ids(res) == []
+
+    def test_positional_dtype_ok(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def k():
+                return jnp.array(0.5, jnp.float32)
+        """, rel=KERNEL)
+        assert rule_ids(res) == []
+
+    def test_int_literal_ok(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def k():
+                return jnp.array(2)
+        """, rel=KERNEL)
+        assert rule_ids(res) == []
+
+    def test_outside_kernel_dirs_ok(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def k():
+                return jnp.array(0.5)
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+
+# ---------------------------------------------------------------------------
+# engine-level behavior
+
+
+class TestEngine:
+    def test_parse_error_reported_not_raised(self):
+        res = lint_source("def broken(:\n", COLD)
+        assert rule_ids(res) == ["parse-error"]
+
+    def test_unreadable_file_reported_not_raised(self, tmp_path):
+        from tools.graftlint.engine import lint_paths
+        bad = tmp_path / "latin.py"
+        bad.write_bytes(b"# caf\xe9\nx = 1\n")  # not valid utf-8
+        res = lint_paths([str(tmp_path)], root=tmp_path)
+        assert [v.rule for v in res.violations] == ["parse-error"]
+        assert "unreadable" in res.violations[0].message
+
+    def test_repo_root_anchor(self):
+        from tools.graftlint.engine import repo_root
+        assert (repo_root() / "tools" / "graftlint" / "engine.py").exists()
+
+    def test_rule_selection(self):
+        res = run("""
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        """, rel=COLD, rules=["jit-in-loop"])
+        assert rule_ids(res) == []
+
+    def test_unknown_rule_raises(self):
+        with pytest.raises(KeyError):
+            get_rules(["no-such-rule"])
+
+    def test_fingerprint_stable_across_line_shifts(self):
+        src = """
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                return np.asarray(jnp.sum(x))
+        """
+        a = run(src).violations[0]
+        b = run("# a new leading comment\n" + textwrap.dedent(src)).violations[0]
+        assert a.fingerprint() == b.fingerprint()
+        assert a.line != b.line
+
+    def test_all_rule_ids_unique(self):
+        assert len(set(RULE_IDS)) == len(RULE_IDS)
+
+
+# ---------------------------------------------------------------------------
+# baseline loader / ratchet
+
+
+class TestBaseline:
+    def _entry(self, **kw):
+        e = {"rule": "host-sync-in-hot-path", "path": HOT,
+             "symbol": "f", "snippet": "np.asarray(x)", "count": 1}
+        e.update(kw)
+        return e
+
+    def _write(self, tmp_path, payload):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(payload))
+        return p
+
+    def test_missing_file_is_empty_baseline(self, tmp_path):
+        assert baseline_mod.load(tmp_path / "nope.json") == Counter()
+
+    def test_not_json_rejected(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text("{nope")
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(p)
+
+    def test_wrong_version_rejected(self, tmp_path):
+        p = self._write(tmp_path, {"version": 99, "entries": []})
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(p)
+
+    def test_missing_keys_rejected(self, tmp_path):
+        e = self._entry()
+        del e["symbol"]
+        p = self._write(tmp_path, {"version": 1, "entries": [e]})
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(p)
+
+    def test_extra_keys_rejected(self, tmp_path):
+        p = self._write(tmp_path, {"version": 1,
+                                   "entries": [self._entry(line=12)]})
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(p)
+
+    def test_bad_count_rejected(self, tmp_path):
+        p = self._write(tmp_path, {"version": 1,
+                                   "entries": [self._entry(count=0)]})
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(p)
+
+    def test_duplicate_entries_rejected(self, tmp_path):
+        p = self._write(tmp_path, {"version": 1,
+                                   "entries": [self._entry(), self._entry()]})
+        with pytest.raises(baseline_mod.BaselineError):
+            baseline_mod.load(p)
+
+    def test_stale_entries_surface_and_fail(self, tmp_path):
+        budget = Counter({("r", "p.py", "f", "snip"): 2})
+        new, baselined, stale = baseline_mod.match([], budget)
+        assert new == [] and baselined == []
+        assert sum(stale.values()) == 2
+
+    def test_match_splits_new_and_baselined(self):
+        res = run("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                a = np.asarray(jnp.sum(x))
+                b = np.asarray(jnp.min(x))
+                return a, b
+        """)
+        vs = res.violations
+        assert len(vs) == 2
+        budget = Counter({vs[0].fingerprint(): 1})
+        new, baselined, stale = baseline_mod.match(vs, budget)
+        assert len(baselined) == 1 and len(new) == 1 and not stale
+
+    def test_write_is_deterministic_and_roundtrips(self, tmp_path):
+        res = run("""
+            import jax.numpy as jnp
+            import numpy as np
+
+            def f(x):
+                return np.asarray(jnp.sum(x))
+        """)
+        p = tmp_path / "baseline.json"
+        baseline_mod.write(p, res.violations)
+        first = p.read_text()
+        baseline_mod.write(p, res.violations)
+        assert p.read_text() == first
+        budget = baseline_mod.load(p)
+        new, baselined, stale = baseline_mod.match(res.violations, budget)
+        assert not new and not stale and len(baselined) == 1
+
+    def test_write_empty_deletes_file(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text("{}")
+        assert baseline_mod.write(p, []) == 0
+        assert not p.exists()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+class TestCli:
+    def test_clean_tree_exits_zero(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "baseline.json")])
+        assert rc == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_violation_exits_one_and_fix_baseline_ratchets(
+            self, tmp_path, capsys):
+        pkg = tmp_path / "weaviate_tpu" / "ops"
+        pkg.mkdir(parents=True)
+        (pkg / "bad.py").write_text(
+            "import jax.numpy as jnp\nimport numpy as np\n\n\n"
+            "def f(x):\n    return np.asarray(jnp.sum(x))\n")
+        bl = tmp_path / "baseline.json"
+        args = [str(tmp_path), "--root", str(tmp_path), "--baseline", str(bl)]
+        assert cli_main(args) == 1
+        capsys.readouterr()
+        assert cli_main(args + ["--fix-baseline"]) == 0
+        assert bl.exists()
+        capsys.readouterr()
+        assert cli_main(args) == 0
+        out = capsys.readouterr().out
+        assert "1 baselined" in out
+
+    def test_stale_baseline_fails_until_regenerated(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"version": 1, "entries": [
+            {"rule": "host-sync-in-hot-path", "path": "gone.py",
+             "symbol": "f", "snippet": "np.asarray(x)", "count": 1}]}))
+        args = [str(tmp_path), "--root", str(tmp_path), "--baseline", str(bl)]
+        assert cli_main(args) == 1
+        out = capsys.readouterr().out
+        assert "stale" in out
+        assert cli_main(args + ["--fix-baseline"]) == 0
+        assert not bl.exists()  # zero violations -> baseline file removed
+        assert cli_main(args) == 0
+
+    def test_fix_baseline_refuses_select_subset(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "b.json"),
+                       "--select", "jit-in-loop", "--fix-baseline"])
+        assert rc == 2
+        assert "--select" in capsys.readouterr().err
+
+    def test_fix_baseline_refuses_partial_tree_with_default_baseline(
+            self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--fix-baseline"])
+        assert rc == 2
+        assert "partial tree" in capsys.readouterr().err
+
+    def test_malformed_baseline_exits_two(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        bl = tmp_path / "baseline.json"
+        bl.write_text("not json at all")
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(bl)])
+        assert rc == 2
+
+    def test_json_format(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "b.json"),
+                       "--format", "json"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["summary"]["status"] == "ok"
+
+    def test_list_rules(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in RULE_IDS:
+            assert rid in out

@@ -17,6 +17,18 @@ from weaviate_tpu.schema.config import (
 )
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _mesh_on():
+    """conftest defaults WEAVIATE_TPU_MESH=off for suite speed; this module
+    exists to exercise the mesh serving path, so force it on."""
+    from weaviate_tpu.parallel import runtime
+    from weaviate_tpu.parallel.mesh import make_mesh
+
+    runtime.set_mesh(make_mesh(8))
+    yield
+    runtime.reset()
+
+
 def _mk_db(tmp_dbdir, name, index_config=None):
     db = DB(tmp_dbdir)
     cfg = CollectionConfig(

@@ -70,9 +70,13 @@ def test_plan_for_uuid_and_scatter():
 
 def test_memwatch_rejects_over_watermark():
     mw = MemWatch(max_ratio=0.9)
-    mw.limit = mw._refresh() + (1 << 30)  # headroom: 1GB
-    mw._read_at = 1e18  # freeze cached rss
-    mw.check_alloc(1 << 20)  # 1MB fine
+    # freeze a FAKE rss: deriving headroom from real process RSS made the
+    # watermark arithmetic depend on how much the test suite had already
+    # allocated (rejects everything once suite RSS crosses ~9GB)
+    mw._rss = 1 << 30  # pretend rss: 1GB
+    mw._read_at = 1e18  # freeze the cache
+    mw.limit = 2 << 30  # watermark at 0.9 * 2GB = 1.8GB
+    mw.check_alloc(1 << 20)  # 1GB + 1MB fine
     with pytest.raises(MemoryPressure):
         mw.check_alloc(10 << 30)  # 10GB over the watermark
     assert mw.rejections == 1

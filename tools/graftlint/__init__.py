@@ -1,0 +1,39 @@
+"""graftlint — AST-based static analysis guarding the TPU hot path.
+
+Weaviate leans on ``go vet`` and the race detector to keep its serving
+path honest; a JAX rebuild has failure modes those tools never see:
+accidental device->host syncs in the distance hot loop, per-call jit
+recompiles, dtype drift in kernels, and (shared with any distributed
+DB) silently swallowed exceptions in replication paths. pytest catches
+none of these — they surface as latency cliffs or quiet data loss.
+
+graftlint walks the stdlib ``ast`` (no third-party deps), applies a
+small registry of rules tuned to this codebase's real hazards, and
+ratchets via a committed baseline: new violations fail tier-1, old
+ones are tracked in ``baseline.json`` and burned down over time.
+
+Usage::
+
+    python -m tools.graftlint weaviate_tpu/
+    python -m tools.graftlint weaviate_tpu/ --format json
+    python -m tools.graftlint weaviate_tpu/ --fix-baseline
+
+Per-site suppression (reason is mandatory)::
+
+    x = np.asarray(dists)  # graftlint: allow[host-sync-in-hot-path] reason=final top-k materialization
+"""
+
+from tools.graftlint.engine import FileContext, lint_paths, lint_source
+from tools.graftlint.rules import ALL_RULES, Rule, Violation, get_rules
+
+__all__ = [
+    "ALL_RULES",
+    "FileContext",
+    "Rule",
+    "Violation",
+    "get_rules",
+    "lint_paths",
+    "lint_source",
+]
+
+__version__ = "0.1.0"

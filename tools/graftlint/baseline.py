@@ -1,0 +1,124 @@
+"""Baseline (ratchet) persistence and matching.
+
+The baseline is a committed JSON multiset of violation fingerprints.
+Matching is by ``(rule, path, symbol, snippet)`` with a count — line
+numbers are deliberately excluded so edits elsewhere in a file do not
+churn the file. The check ratchets in both directions:
+
+* a current violation with no remaining baseline budget is **new** -> fail;
+* a baseline entry with no matching current violation is **stale** -> fail
+  (whoever fixed it must also shrink the baseline via ``--fix-baseline``,
+  keeping the committed count an honest upper bound).
+
+``--fix-baseline`` regenerates the file deterministically (entries sorted,
+paths posix-relative) so diffs stay reviewable.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Dict, List, Sequence, Tuple
+
+from tools.graftlint.rules import Violation
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = Path(__file__).with_name("baseline.json")
+
+_ENTRY_KEYS = {"rule", "path", "symbol", "snippet", "count"}
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file — refuse to guess, fail the run."""
+
+
+Fingerprint = Tuple[str, str, str, str]
+
+
+def _entry_fingerprint(e: dict) -> Fingerprint:
+    return (e["rule"], e["path"], e["symbol"], e["snippet"])
+
+
+def load(path: Path) -> Counter:
+    """Load + validate; returns a Counter of fingerprints. A missing file
+    is an empty baseline (the zero-violation end state deletes it)."""
+    if not path.exists():
+        return Counter()
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except json.JSONDecodeError as e:
+        raise BaselineError(f"{path}: not valid JSON ({e})") from e
+    if not isinstance(data, dict):
+        raise BaselineError(f"{path}: top level must be an object")
+    if data.get("version") != BASELINE_VERSION:
+        raise BaselineError(
+            f"{path}: unsupported version {data.get('version')!r} "
+            f"(expected {BASELINE_VERSION})")
+    entries = data.get("entries")
+    if not isinstance(entries, list):
+        raise BaselineError(f"{path}: 'entries' must be a list")
+    budget: Counter = Counter()
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict) or set(e) != _ENTRY_KEYS:
+            raise BaselineError(
+                f"{path}: entry {i} must have exactly keys "
+                f"{sorted(_ENTRY_KEYS)}")
+        if not all(isinstance(e[k], str) for k in
+                   ("rule", "path", "symbol", "snippet")):
+            raise BaselineError(f"{path}: entry {i} has non-string fields")
+        if not isinstance(e["count"], int) or e["count"] < 1:
+            raise BaselineError(f"{path}: entry {i} count must be int >= 1")
+        fp = _entry_fingerprint(e)
+        if fp in budget:
+            raise BaselineError(
+                f"{path}: duplicate entry {i} for {e['path']} [{e['rule']}] "
+                "— merge counts")
+        budget[fp] = e["count"]
+    return budget
+
+
+def match(violations: Sequence[Violation],
+          budget: Counter) -> Tuple[List[Violation], List[Violation], Counter]:
+    """Split current violations into (new, baselined); the third element
+    is the stale remainder — baseline budget nothing matched."""
+    remaining = Counter(budget)
+    new: List[Violation] = []
+    baselined: List[Violation] = []
+    for v in violations:
+        fp = v.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            baselined.append(v)
+        else:
+            new.append(v)
+    stale = Counter({fp: n for fp, n in remaining.items() if n > 0})
+    return new, baselined, stale
+
+
+def write(path: Path, violations: Sequence[Violation]) -> int:
+    """Regenerate the baseline from the current violation set. Returns
+    the number of (merged) entries written; an empty set deletes the
+    file so the end state of the ratchet is no baseline at all."""
+    counts: Counter = Counter(v.fingerprint() for v in violations)
+    if not counts:
+        if path.exists():
+            path.unlink()
+        return 0
+    entries = [
+        {"rule": fp[0], "path": fp[1], "symbol": fp[2], "snippet": fp[3],
+         "count": n}
+        for fp, n in sorted(counts.items())
+    ]
+    payload: Dict = {
+        "version": BASELINE_VERSION,
+        "comment": (
+            "Grandfathered graftlint violations. Do not add entries by "
+            "hand; fix the code, or run --fix-baseline and justify the "
+            "diff in review."
+        ),
+        "entries": entries,
+    }
+    path.write_text(json.dumps(payload, indent=2, sort_keys=False) + "\n",
+                    encoding="utf-8")
+    return len(entries)

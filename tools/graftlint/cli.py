@@ -1,0 +1,124 @@
+"""graftlint CLI.
+
+Exit codes: 0 clean (baselined/suppressed only), 1 new or stale
+violations, 2 usage error / malformed baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from tools.graftlint import baseline as baseline_mod
+from tools.graftlint.engine import lint_paths
+from tools.graftlint.report import render_json, render_text, summary_line
+from tools.graftlint.rules import ALL_RULES, RULE_IDS
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST lint guarding the TPU hot path "
+                    "(host syncs, recompiles, swallowed errors).")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories to lint (default: the "
+                        "repo's weaviate_tpu/, from any cwd)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", type=Path,
+                   default=baseline_mod.DEFAULT_BASELINE,
+                   help="baseline file (default: tools/graftlint/"
+                        "baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every violation, ignoring the baseline")
+    p.add_argument("--fix-baseline", action="store_true",
+                   help="regenerate the baseline from the current "
+                        "violations (deterministic: sorted, path-relative)")
+    p.add_argument("--select", metavar="RULES",
+                   help="comma-separated rule ids to run "
+                        "(default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--root", type=Path, default=None,
+                   help="root for path relativization "
+                        "(default: current directory)")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also print baselined violations")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.paths:
+        from tools.graftlint.engine import repo_root
+
+        args.paths = [str(repo_root() / "weaviate_tpu")]
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id}\n    {r.description}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        # parse-error / unused-suppression are engine-level, always-on ids
+        unknown = set(select) - set(RULE_IDS) - {
+            "parse-error", "unused-suppression"}
+        if unknown:
+            print(f"graftlint: unknown rule(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    if args.fix_baseline:
+        # the baseline is defined over the full default tree; regenerating
+        # it from a rule subset or a sub-path would silently drop every
+        # grandfathered entry the partial run didn't see
+        if select is not None:
+            print("graftlint: --fix-baseline cannot be combined with "
+                  "--select (would drop entries for unselected rules)",
+                  file=sys.stderr)
+            return 2
+        if args.baseline == baseline_mod.DEFAULT_BASELINE:
+            from tools.graftlint.engine import repo_root
+
+            want = (repo_root() / "weaviate_tpu").resolve()
+            got = {Path(p).resolve() for p in args.paths}
+            if got != {want}:
+                print("graftlint: --fix-baseline with the default baseline "
+                      f"must lint exactly {want} (got {sorted(got)}); a "
+                      "partial tree would drop unseen grandfathered entries",
+                      file=sys.stderr)
+                return 2
+
+    result = lint_paths(args.paths, root=args.root, rules=select)
+
+    if args.fix_baseline:
+        n = baseline_mod.write(args.baseline, result.violations)
+        print(f"graftlint: wrote {n} baseline entries "
+              f"({len(result.violations)} violations) to {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        from collections import Counter
+        new, baselined, stale = result.violations, [], Counter()
+    else:
+        try:
+            budget = baseline_mod.load(args.baseline)
+        except baseline_mod.BaselineError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        new, baselined, stale = baseline_mod.match(result.violations, budget)
+
+    if args.format == "json":
+        print(render_json(new, baselined, stale, len(result.suppressed),
+                          result.files_checked))
+    else:
+        print(render_text(new, baselined, stale, len(result.suppressed),
+                          result.files_checked, verbose=args.verbose))
+
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,306 @@
+"""File walking, AST annotation, suppression parsing, rule dispatch.
+
+The engine parses each file once, annotates every node with a parent
+link + field name (so rules can ask "am I in a loop body?" vs "am I a
+decorator?"), builds the import table rules need (what names this file
+binds to ``weaviate_tpu.ops``), and collects ``# graftlint: allow[...]``
+comments. Rules never re-read the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint.rules import (
+    ALL_RULES,
+    SEV_ERROR,
+    Violation,
+    get_rules,
+)
+
+_SNIPPET_MAX = 96
+
+# graftlint: allow[rule-a,rule-b] reason=free text to end of line
+_ALLOW_RE = re.compile(
+    r"#\s*graftlint:\s*allow\[([A-Za-z0-9_\-, ]+)\]\s*(?:reason=(.*\S))?"
+)
+
+_SKIP_FILE_RE = re.compile(r"(_pb2\.py|_pb2_grpc\.py)$")
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int
+    rules: Set[str]
+    reason: Optional[str]
+    used: bool = False
+
+
+class FileContext:
+    """Everything a rule may ask about one parsed file."""
+
+    def __init__(self, source: str, rel_path: str):
+        self.rel_path = rel_path.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self._parents: Dict[ast.AST, Tuple[Optional[ast.AST], str]] = {}
+        self._annotate_parents()
+        self.ops_imports: Set[str] = set()
+        self.ops_aliases: Set[str] = set()
+        self.device_imports: Set[str] = set()
+        self.device_aliases: Set[str] = set()
+        self._collect_imports()
+        self.suppressions: List[Suppression] = []
+        self.bad_suppressions: Dict[int, Set[str]] = {}
+        self._collect_suppressions()
+
+    # -- construction ---------------------------------------------------
+
+    def _annotate_parents(self) -> None:
+        self._parents[self.tree] = (None, "")
+        stack = [self.tree]
+        while stack:
+            node = stack.pop()
+            for field, value in ast.iter_fields(node):
+                children = value if isinstance(value, list) else [value]
+                for child in children:
+                    if isinstance(child, ast.AST):
+                        self._parents[child] = (node, field)
+                        stack.append(child)
+
+    _DEVICE_PKGS = ("weaviate_tpu.ops", "weaviate_tpu.parallel")
+
+    def _collect_imports(self) -> None:
+        """Names this file binds to device-dispatching code: ops/parallel
+        function imports and module aliases. Rules use these to decide
+        whether a call launches device work."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module == "weaviate_tpu":
+                    for a in node.names:
+                        if a.name in ("ops", "parallel"):
+                            self.device_aliases.add(a.asname or a.name)
+                            if a.name == "ops":
+                                self.ops_aliases.add(a.asname or a.name)
+                elif node.module.startswith(self._DEVICE_PKGS):
+                    for a in node.names:
+                        self.device_imports.add(a.asname or a.name)
+                        if node.module.startswith("weaviate_tpu.ops"):
+                            self.ops_imports.add(a.asname or a.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith(self._DEVICE_PKGS):
+                        alias = a.asname or a.name.split(".", 1)[0]
+                        self.device_aliases.add(alias)
+                        if a.name.startswith("weaviate_tpu.ops"):
+                            self.ops_aliases.add(alias)
+
+    def _collect_suppressions(self) -> None:
+        for i, text in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            reason = m.group(2)
+            if reason is None or not reason.strip():
+                self.bad_suppressions[i] = rules
+                continue  # ignored until it carries a reason
+            self.suppressions.append(
+                Suppression(line=i, rules=rules, reason=reason.strip()))
+
+    # -- queries used by rules ------------------------------------------
+
+    def walk(self, *types) -> Iterable[ast.AST]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, types):
+                yield node
+
+    def parent_of(self, node: ast.AST) -> Tuple[Optional[ast.AST], str]:
+        return self._parents.get(node, (None, ""))
+
+    def ancestry(self, node: ast.AST) -> Iterable[Tuple[ast.AST, str]]:
+        """Yield (ancestor, field-entered-through) from node outward."""
+        cur = node
+        while True:
+            parent, field = self.parent_of(cur)
+            if parent is None:
+                return
+            yield parent, field
+            cur = parent
+
+    def in_decorator(self, node: ast.AST) -> bool:
+        return any(field == "decorator_list"
+                   for _, field in self.ancestry(node))
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """Inside the body/orelse of a for/while (comprehensions excluded —
+        a comprehension is still one trace)."""
+        for parent, field in self.ancestry(node):
+            if isinstance(parent, (ast.For, ast.AsyncFor, ast.While)) \
+                    and field in ("body", "orelse"):
+                return True
+        return False
+
+    def enclosing_scope(self, node: ast.AST) -> ast.AST:
+        """Nearest function whose *body* owns this node (decorators and
+        default-expressions execute in the outer scope), else the module."""
+        for parent, field in self.ancestry(node):
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and field == "body":
+                return parent
+        return self.tree
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-first function chain; decorator position excluded."""
+        chain = []
+        for parent, field in self.ancestry(node):
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if field == "decorator_list":
+                    continue
+                chain.append(parent)
+        return chain
+
+    def qualname(self, node: ast.AST) -> str:
+        parts = []
+        for parent, field in self.ancestry(node):
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                if field != "decorator_list":
+                    parts.append(parent.name)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def line_snippet(self, line_no: int) -> str:
+        if 1 <= line_no <= len(self.lines):
+            return self.lines[line_no - 1].strip()[:_SNIPPET_MAX]
+        return ""
+
+    def snippet(self, node: ast.AST) -> str:
+        return self.line_snippet(getattr(node, "lineno", 1))
+
+    # -- suppression matching -------------------------------------------
+
+    def is_suppressed(self, v: Violation) -> bool:
+        """An allow-comment suppresses matching-rule violations on its own
+        line and on the line directly below (comment-above style)."""
+        for s in self.suppressions:
+            if v.rule in s.rules and v.line in (s.line, s.line + 1):
+                s.used = True
+                return True
+        return False
+
+
+@dataclasses.dataclass
+class LintResult:
+    violations: List[Violation]
+    suppressed: List[Violation]
+    files_checked: int = 1
+    parse_errors: List[Violation] = dataclasses.field(default_factory=list)
+
+
+def repo_root() -> Path:
+    """The repository this linter is vendored in (tools/graftlint/ -> repo).
+
+    Anchors default path relativization so the prefix-scoped rules
+    (hot-path, kernel, critical dirs) work no matter where the CLI is
+    invoked from."""
+    return Path(__file__).resolve().parents[2]
+
+
+def lint_source(source: str, rel_path: str,
+                rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint one source string as if it lived at ``rel_path``. The unit
+    tests and the CLI share this path, so fixtures exercise exactly the
+    production matching logic."""
+    try:
+        ctx = FileContext(source, rel_path)
+    except SyntaxError as e:
+        v = Violation(
+            rule="parse-error", path=rel_path, line=e.lineno or 1,
+            col=e.offset or 0, severity=SEV_ERROR,
+            message=f"file does not parse: {e.msg}",
+            symbol="<module>", snippet="")
+        return LintResult(violations=[v], suppressed=[], parse_errors=[v])
+
+    kept: List[Violation] = []
+    suppressed: List[Violation] = []
+    if rules is not None:
+        # engine-level pseudo-rules (parse-error, unused-suppression) are
+        # not in the registry; drop them before the lookup
+        from tools.graftlint.rules import RULE_IDS
+        rules_for_registry = [r for r in rules if r in RULE_IDS]
+    for rule in (get_rules(rules_for_registry) if rules is not None
+                 else ALL_RULES):
+        for v in rule.check(ctx):
+            (suppressed if ctx.is_suppressed(v) else kept).append(v)
+    # dead allow-comments are debt too: a suppression that matched nothing
+    # would silently mask a future regression on that line (the comment
+    # ratchet, mirroring the stale-baseline check)
+    if rules is None or "unused-suppression" in rules:
+        for s in ctx.suppressions:
+            if not s.used:
+                kept.append(Violation(
+                    rule="unused-suppression", path=ctx.rel_path,
+                    line=s.line, col=0, severity=SEV_ERROR,
+                    message=(
+                        f"allow[{','.join(sorted(s.rules))}] suppresses "
+                        "nothing — the hazard was fixed, so delete the "
+                        "comment"),
+                    symbol="<module>", snippet=ctx.line_snippet(s.line)))
+    kept.sort(key=lambda v: (v.line, v.col, v.rule))
+    return LintResult(violations=kept, suppressed=suppressed)
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_file() and p.suffix == ".py":
+            yield p
+        elif p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" in f.parts or _SKIP_FILE_RE.search(f.name):
+                    continue
+                yield f
+
+
+def lint_paths(paths: Sequence[str], root: Optional[Path] = None,
+               rules: Optional[Sequence[str]] = None) -> LintResult:
+    """Lint every ``*.py`` under ``paths`` (files or directories).
+
+    ``root`` anchors the relative paths used in reports, baselines, and
+    the prefix-scoped rules; it defaults to the repo this linter is
+    vendored in, so the console script works from any cwd.
+    """
+    root = (root or repo_root()).resolve()
+    all_v: List[Violation] = []
+    all_s: List[Violation] = []
+    parse_errors: List[Violation] = []
+    n = 0
+    for f in iter_python_files(paths):
+        n += 1
+        try:
+            rel = f.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        try:
+            source = f.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError) as e:
+            v = Violation(
+                rule="parse-error", path=rel, line=1, col=0,
+                severity="error",
+                message=f"file unreadable: {e}",
+                symbol="<module>", snippet="")
+            all_v.append(v)
+            parse_errors.append(v)
+            continue
+        res = lint_source(source, rel, rules)
+        all_v.extend(res.violations)
+        all_s.extend(res.suppressed)
+        parse_errors.extend(res.parse_errors)
+    all_v.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return LintResult(violations=all_v, suppressed=all_s,
+                      files_checked=n, parse_errors=parse_errors)

@@ -1,0 +1,71 @@
+"""Text / JSON reporters + the one-line summary used for BENCH-style
+tracking (violation counts over time)."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List, Sequence
+
+from tools.graftlint.rules import Violation
+
+Fingerprint = tuple
+
+
+def _fmt(v: Violation) -> str:
+    return (f"{v.path}:{v.line}:{v.col + 1}: [{v.rule}] {v.severity}: "
+            f"{v.message}\n    {v.snippet}")
+
+
+def render_text(new: Sequence[Violation], baselined: Sequence[Violation],
+                stale: Counter, suppressed_count: int, files_checked: int,
+                verbose: bool = False) -> str:
+    out: List[str] = []
+    for v in new:
+        out.append(_fmt(v))
+    if verbose and baselined:
+        out.append("")
+        out.append("baselined (grandfathered — burn these down):")
+        for v in baselined:
+            out.append("  " + _fmt(v).replace("\n", "\n  "))
+    for fp, n in sorted(stale.items()):
+        out.append(
+            f"{fp[1]}: [{fp[0]}] stale-baseline: {n} grandfathered "
+            f"violation(s) in {fp[2]} no longer occur — run --fix-baseline "
+            f"to ratchet down\n    {fp[3]}")
+    out.append(summary_line(new, baselined, stale, suppressed_count,
+                            files_checked))
+    return "\n".join(out)
+
+
+def summary_line(new: Sequence[Violation], baselined: Sequence[Violation],
+                 stale: Counter, suppressed_count: int,
+                 files_checked: int) -> str:
+    status = "FAIL" if (new or stale) else "OK"
+    n_stale = sum(stale.values())
+    return (f"graftlint: {status} — {files_checked} files, "
+            f"{len(new)} new, {len(baselined)} baselined, "
+            f"{suppressed_count} suppressed, {n_stale} stale")
+
+
+def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
+                stale: Counter, suppressed_count: int,
+                files_checked: int) -> str:
+    doc = {
+        "summary": {
+            "status": "fail" if (new or stale) else "ok",
+            "files_checked": files_checked,
+            "new": len(new),
+            "baselined": len(baselined),
+            "suppressed": suppressed_count,
+            "stale": sum(stale.values()),
+        },
+        "violations": [v.to_dict() for v in new],
+        "baselined": [v.to_dict() for v in baselined],
+        "stale": [
+            {"rule": fp[0], "path": fp[1], "symbol": fp[2],
+             "snippet": fp[3], "count": n}
+            for fp, n in sorted(stale.items())
+        ],
+    }
+    return json.dumps(doc, indent=2)

@@ -1,0 +1,592 @@
+"""Rule registry + the built-in rules.
+
+Every rule is a subclass of :class:`Rule` registered in ``ALL_RULES``.
+A rule receives a fully annotated :class:`~tools.graftlint.engine.FileContext`
+(parent links, qualnames, import table) and yields :class:`Violation`s.
+
+Adding a rule: subclass ``Rule``, set ``id``/``description``/``rationale``,
+implement ``check``, and append an instance to ``ALL_RULES``. Document it
+in ``docs/lint.md``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional, Sequence
+
+# Directories (relative, posix) whose files form the latency-critical
+# serving path: a host sync here stalls the whole TPU pipeline.
+HOT_PATH_DIRS = (
+    "weaviate_tpu/ops/",
+    "weaviate_tpu/index/",
+    "weaviate_tpu/parallel/",
+    "weaviate_tpu/query/",
+)
+
+# Kernel files: dtype discipline is load-bearing (bf16 MXU inputs, fp32
+# accumulators); an implicit float32/float64 literal silently widens math.
+KERNEL_DIRS = ("weaviate_tpu/ops/",)
+
+# Packages where a swallowed exception means quiet data loss rather than
+# a degraded response.
+CRITICAL_EXCEPTION_DIRS = ("weaviate_tpu/cluster/", "weaviate_tpu/backup/")
+
+SEV_WARNING = "warning"
+SEV_ERROR = "error"
+SEV_CRITICAL = "critical"
+
+_SEV_ORDER = {SEV_WARNING: 0, SEV_ERROR: 1, SEV_CRITICAL: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # posix path relative to the lint root
+    line: int
+    col: int
+    severity: str
+    message: str
+    symbol: str  # enclosing qualname, or "<module>"
+    snippet: str  # stripped offending source line, truncated
+
+    def fingerprint(self) -> tuple:
+        """Identity used for baseline matching — deliberately excludes
+        line/col so unrelated edits above a grandfathered violation do
+        not churn the baseline."""
+        return (self.rule, self.path, self.symbol, self.snippet)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    id: str = ""
+    description: str = ""
+    rationale: str = ""
+
+    def check(self, ctx) -> Iterator[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+    # -- shared helpers -------------------------------------------------
+
+    def violation(self, ctx, node: ast.AST, message: str,
+                  severity: str = SEV_ERROR) -> Violation:
+        return Violation(
+            rule=self.id,
+            path=ctx.rel_path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            severity=severity,
+            message=message,
+            symbol=ctx.qualname(node),
+            snippet=ctx.snippet(node),
+        )
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.jit' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _path_in(rel_path: str, dirs: Sequence[str]) -> bool:
+    return any(rel_path.startswith(d) for d in dirs)
+
+
+def _contains_root_name(node: ast.AST, names: Sequence[str]) -> bool:
+    """Whether any Name in the subtree matches ``names`` (e.g. jnp/jax)."""
+    return any(
+        isinstance(n, ast.Name) and n.id in names for n in ast.walk(node)
+    )
+
+
+# jax API calls that return host metadata (device handles, counts), not
+# device arrays: neither a taint source nor device dispatch.
+NON_DISPATCH_JAX = frozenset({
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.process_count", "jax.process_index",
+    "jax.default_backend", "jax.named_scope",
+})
+
+
+# ---------------------------------------------------------------------------
+# 1. host-sync-in-hot-path
+
+
+class HostSyncInHotPath(Rule):
+    id = "host-sync-in-hot-path"
+    description = (
+        "device->host transfer (np.asarray/.item()/.tolist()/"
+        "block_until_ready/float(jnp...)) of a device value inside the "
+        "serving hot path"
+    )
+    rationale = (
+        "Each transfer blocks the Python thread on the device stream and "
+        "flushes the async dispatch pipeline; one stray .item() turns a "
+        "fully-overlapped TPU search into lockstep round trips. The rule "
+        "runs a per-scope taint pass so host-side input prep "
+        "(np.asarray(user_queries)) is NOT flagged — only values that "
+        "provably come from a jax/ops/parallel call are."
+    )
+
+    _NP_FUNCS = frozenset({
+        "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+        "np.copy", "numpy.copy", "np.ascontiguousarray",
+    })
+    _SYNC_METHODS = frozenset({"item", "tolist"})
+    _SCALAR_CASTS = frozenset({"float", "int", "bool"})
+    _DEVICE_ROOTS = ("jnp", "jax", "pl")
+
+    def _is_device_call(self, call: ast.Call, ctx) -> bool:
+        dn = dotted_name(call.func)
+        if not dn or dn in NON_DISPATCH_JAX:
+            return False
+        root = dn.split(".", 1)[0]
+        if root in self._DEVICE_ROOTS:
+            # jnp.asarray / jax.device_put etc. *produce* device values
+            return True
+        if root in ctx.device_aliases:
+            return True
+        if "." not in dn and dn in ctx.device_imports:
+            return True
+        return False
+
+    def _contains_device_value(self, node: ast.AST, tainted, ctx) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and self._is_device_call(n, ctx):
+                return True
+            if (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                    and n.id in tainted):
+                return True
+        return False
+
+    def _scope_taint(self, scope, ctx) -> set:
+        """Fixpoint over assignments in one scope: a name is tainted if
+        it is ever assigned a value derived from a device call or from
+        another tainted name. Deliberately flow-insensitive (over-taints
+        names reused for host values) — suppress with a reason if hit."""
+        tainted: set = set()
+        assigns = []
+        for n in ast.walk(scope):
+            if ctx.enclosing_scope(n) is not scope:
+                continue  # owned by a nested function
+            if isinstance(n, ast.Assign):
+                assigns.append((n.targets, n.value))
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)) \
+                    and n.value is not None:
+                assigns.append(([n.target], n.value))
+        for _ in range(4):  # taint chains deeper than 4 hops don't occur
+            changed = False
+            for targets, value in assigns:
+                if not self._contains_device_value(value, tainted, ctx):
+                    continue
+                for t in targets:
+                    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                    for e in elts:
+                        if isinstance(e, ast.Name) and e.id not in tainted:
+                            tainted.add(e.id)
+                            changed = True
+            if not changed:
+                break
+        return tainted
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, HOT_PATH_DIRS):
+            return
+        scopes = [ctx.tree] + list(
+            ctx.walk(ast.FunctionDef, ast.AsyncFunctionDef))
+        for scope in scopes:
+            tainted = self._scope_taint(scope, ctx)
+            for node in ast.walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                if ctx.enclosing_scope(node) is not scope:
+                    continue
+                yield from self._check_call(node, tainted, ctx)
+
+    def _check_call(self, node: ast.Call, tainted, ctx):
+        func = node.func
+        dn = dotted_name(func)
+        if dn in self._NP_FUNCS:
+            if node.args and self._contains_device_value(
+                    node.args[0], tainted, ctx):
+                yield self.violation(
+                    ctx, node,
+                    f"{dn}(...) on a device value forces a blocking "
+                    "device->host copy; keep the hot path on device (jnp) "
+                    "or annotate a true host boundary",
+                )
+        elif isinstance(func, ast.Attribute) \
+                and func.attr in self._SYNC_METHODS:
+            if self._contains_device_value(func.value, tainted, ctx):
+                yield self.violation(
+                    ctx, node,
+                    f".{func.attr}() on a device value synchronizes with "
+                    "the device stream; batch the readback or move it past "
+                    "the top-k merge",
+                )
+        elif isinstance(func, ast.Attribute) \
+                and func.attr == "block_until_ready":
+            yield self.violation(
+                ctx, node,
+                ".block_until_ready() is a full pipeline flush — benchmark "
+                "harnesses only, never the serving path",
+            )
+        elif (isinstance(func, ast.Name)
+                and func.id in self._SCALAR_CASTS
+                and node.args
+                and self._contains_device_value(node.args[0], tainted, ctx)):
+            yield self.violation(
+                ctx, node,
+                f"{func.id}() on a device value is an implicit .item() — "
+                "a blocking scalar readback",
+            )
+
+
+# ---------------------------------------------------------------------------
+# 2. jit-in-loop
+
+
+def _is_jit_like(call: ast.Call) -> Optional[str]:
+    dn = dotted_name(call.func)
+    if dn in ("jax.jit", "jit", "pjit", "jax.pjit"):
+        return "jax.jit"
+    if dn in ("pl.pallas_call", "pallas_call",
+              "jax.experimental.pallas.pallas_call"):
+        return "pallas_call"
+    return None
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    dn = dotted_name(dec)
+    if dn in ("jax.jit", "jit", "pjit", "jax.pjit"):
+        return True
+    if isinstance(dec, ast.Call):
+        inner = dotted_name(dec.func)
+        if inner in ("jax.jit", "jit", "pjit", "jax.pjit"):
+            return True
+        # functools.partial(jax.jit, ...)
+        if inner in ("functools.partial", "partial") and dec.args:
+            return dotted_name(dec.args[0]) in (
+                "jax.jit", "jit", "pjit", "jax.pjit")
+    return False
+
+
+def _decorator_is_cache(dec: ast.AST) -> bool:
+    dn = dotted_name(dec)
+    if isinstance(dec, ast.Call):
+        dn = dotted_name(dec.func)
+    return dn in ("functools.lru_cache", "lru_cache",
+                  "functools.cache", "cache")
+
+
+class JitInLoop(Rule):
+    id = "jit-in-loop"
+    description = (
+        "jax.jit / pallas_call constructed inside a loop or per-call "
+        "function body (cache-miss => recompile on every invocation)"
+    )
+    rationale = (
+        "jax caches compiled programs by wrapper identity; a wrapper built "
+        "inside a request handler or loop is new every time, so XLA "
+        "recompiles (100ms-10s) per call instead of once per process."
+    )
+
+    def check(self, ctx) -> Iterator[Violation]:
+        for node in ctx.walk(ast.Call):
+            kind = _is_jit_like(node)
+            if kind is None:
+                continue
+            if ctx.in_decorator(node):
+                continue  # @jax.jit / @functools.partial(jax.jit, ...)
+            parent, field = ctx.parent_of(node)
+            immediately_invoked = (
+                isinstance(parent, ast.Call) and field == "func")
+            func_chain = ctx.enclosing_functions(node)
+            if any(any(_decorator_is_jit(d) for d in f.decorator_list)
+                   for f in func_chain):
+                continue  # trace-time construction inside an outer jit
+            if any(any(_decorator_is_cache(d) for d in f.decorator_list)
+                   for f in func_chain):
+                continue  # memoized factory
+            if ctx.in_loop(node):
+                yield self.violation(
+                    ctx, node,
+                    f"{kind} constructed inside a loop — hoist it to module "
+                    "scope or a per-shape cache",
+                )
+                continue
+            if not func_chain:
+                continue  # module scope: compiled once per import
+            if immediately_invoked:
+                yield self.violation(
+                    ctx, node,
+                    f"immediately-invoked {kind}(f)(...) recompiles on every "
+                    "call — bind the jitted wrapper once",
+                )
+            else:
+                yield self.violation(
+                    ctx, node,
+                    f"{kind} constructed inside "
+                    f"{ctx.qualname(node)}() — every call builds a fresh "
+                    "wrapper and misses the compile cache; hoist or memoize",
+                    severity=SEV_WARNING,
+                )
+
+
+# ---------------------------------------------------------------------------
+# 3. nonhashable-static-arg
+
+
+class NonhashableStaticArg(Rule):
+    id = "nonhashable-static-arg"
+    description = (
+        "list/dict/set literal passed via static_argnums/static_argnames "
+        "plumbing (static operands must be hashable)"
+    )
+    rationale = (
+        "Static arguments key the jit compile cache by hash; an unhashable "
+        "value raises at call time, and a mutable-but-hashed wrapper "
+        "silently defeats cache hits. Tuples only."
+    )
+
+    _KEYWORDS = ("static_argnums", "static_argnames")
+    _BAD = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+            ast.SetComp, ast.GeneratorExp)
+
+    def check(self, ctx) -> Iterator[Violation]:
+        for node in ctx.walk(ast.Call):
+            for kw in node.keywords:
+                if kw.arg in self._KEYWORDS and isinstance(kw.value, self._BAD):
+                    yield self.violation(
+                        ctx, kw.value,
+                        f"{kw.arg} given a {type(kw.value).__name__} "
+                        "literal — use a tuple so the value is hashable and "
+                        "the compile-cache key is stable",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# 4. swallowed-exception
+
+
+class SwallowedException(Rule):
+    id = "swallowed-exception"
+    description = (
+        "bare/blind `except` that neither re-raises nor logs — the classic "
+        "quiet-data-loss bug in replication/backup paths"
+    )
+    rationale = (
+        "Weaviate's raft and backup code treats every error as a first-class "
+        "result; a blind `except Exception: pass` here converts a failed "
+        "replica write into silent divergence that no test observes."
+    )
+
+    _BLIND = frozenset({"Exception", "BaseException"})
+    _LOG_ATTRS = frozenset({
+        "exception", "warning", "warn", "error", "critical", "info",
+        "debug", "log", "print_exc",
+    })
+
+    def _is_blind(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        if dotted_name(t) in self._BLIND:
+            return True
+        if isinstance(t, ast.Tuple):
+            return any(dotted_name(e) in self._BLIND for e in t.elts)
+        return False
+
+    def _is_handled(self, handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) and f.attr in self._LOG_ATTRS:
+                    return True
+                dn = dotted_name(f)
+                if dn in ("warnings.warn", "traceback.print_exc"):
+                    return True
+            # `except Exception as e:` where e is actually consumed
+            # (stored on a status object, set on a future, stringified
+            # into a reply) is error *handling*, not swallowing.
+            if (handler.name and isinstance(n, ast.Name)
+                    and n.id == handler.name
+                    and isinstance(n.ctx, ast.Load)):
+                return True
+        return False
+
+    def check(self, ctx) -> Iterator[Violation]:
+        for handler in ctx.walk(ast.ExceptHandler):
+            if not self._is_blind(handler) or self._is_handled(handler):
+                continue
+            critical = _path_in(ctx.rel_path, CRITICAL_EXCEPTION_DIRS)
+            what = ("bare except" if handler.type is None
+                    else "blind except Exception")
+            yield self.violation(
+                ctx, handler,
+                f"{what} with no re-raise and no logging — narrow the type "
+                "or log via logging.getLogger('weaviate_tpu.<area>') before "
+                "continuing",
+                severity=SEV_CRITICAL if critical else SEV_ERROR,
+            )
+
+
+# ---------------------------------------------------------------------------
+# 5. lock-across-device-call
+
+
+class LockAcrossDeviceCall(Rule):
+    id = "lock-across-device-call"
+    description = (
+        "jax/ops device call issued while holding a threading lock"
+    )
+    rationale = (
+        "Device dispatch under a Python lock serializes every serving "
+        "thread behind one device round trip; snapshot state under the "
+        "lock, release it, then dispatch."
+    )
+
+    _DEVICE_ROOTS = ("jax", "jnp", "pl")
+
+    def _lock_items(self, node) -> list:
+        names = []
+        for item in node.items:
+            dn = dotted_name(item.context_expr)
+            if dn and "lock" in dn.lower():
+                names.append(dn)
+        return names
+
+    def check(self, ctx) -> Iterator[Violation]:
+        for node in ctx.walk(ast.With, ast.AsyncWith):
+            locks = self._lock_items(node)
+            if not locks:
+                continue
+            for call in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+                if not isinstance(call, ast.Call):
+                    continue
+                dn = dotted_name(call.func)
+                if not dn or dn in NON_DISPATCH_JAX:
+                    continue
+                root = dn.split(".", 1)[0]
+                if root in self._DEVICE_ROOTS or root in ctx.ops_aliases \
+                        or (root in ctx.ops_imports and "." not in dn):
+                    yield self.violation(
+                        ctx, call,
+                        f"{dn}(...) dispatched while holding "
+                        f"{', '.join(locks)} — move device work outside the "
+                        "critical section",
+                        severity=SEV_WARNING,
+                    )
+
+
+# ---------------------------------------------------------------------------
+# 6. float64-literal-drift
+
+
+class Float64LiteralDrift(Rule):
+    id = "float64-literal-drift"
+    description = (
+        "jnp array constructor fed a Python float literal without an "
+        "explicit dtype in kernel files"
+    )
+    rationale = (
+        "Kernel math is bf16-in / fp32-accumulate by contract; an undtyped "
+        "jnp.array(0.5) defaults to float32 (float64 under x64) and "
+        "silently widens whatever it touches, bloating VMEM tiles."
+    )
+
+    # constructors where the dtype may also arrive positionally at index N
+    _CTORS = {
+        "jnp.array": 1, "jnp.asarray": 1, "jnp.full": 2,
+        "jnp.linspace": 5, "jnp.arange": 3, "jnp.ones": 1, "jnp.zeros": 1,
+    }
+
+    def _has_float_literal(self, node: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Constant) and isinstance(n.value, float)
+            for n in ast.walk(node)
+        )
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, KERNEL_DIRS):
+            return
+        for node in ctx.walk(ast.Call):
+            dn = dotted_name(node.func)
+            if dn not in self._CTORS:
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            if len(node.args) > self._CTORS[dn]:
+                continue  # dtype passed positionally
+            value_args = node.args[: self._CTORS[dn]]
+            if any(self._has_float_literal(a) for a in value_args):
+                yield self.violation(
+                    ctx, node,
+                    f"{dn}(<float literal>) without dtype= — pin the kernel "
+                    "dtype explicitly (jnp.float32/bf16)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# 7. suppression-missing-reason (meta-rule, emitted by the engine)
+
+
+class SuppressionMissingReason(Rule):
+    id = "suppression-missing-reason"
+    description = (
+        "graftlint allow-comment without a reason= — suppressions must "
+        "say why the hazard is acceptable"
+    )
+    rationale = (
+        "An unexplained suppression is indistinguishable from a silenced "
+        "bug; the reason is the review artifact."
+    )
+
+    def check(self, ctx) -> Iterator[Violation]:
+        for line_no, rules in sorted(ctx.bad_suppressions.items()):
+            yield Violation(
+                rule=self.id,
+                path=ctx.rel_path,
+                line=line_no,
+                col=0,
+                severity=SEV_ERROR,
+                message=(
+                    f"allow[{','.join(sorted(rules))}] has no reason=; the "
+                    "suppression is ignored until one is given"
+                ),
+                symbol="<module>",
+                snippet=ctx.line_snippet(line_no),
+            )
+
+
+ALL_RULES: tuple = (
+    HostSyncInHotPath(),
+    JitInLoop(),
+    NonhashableStaticArg(),
+    SwallowedException(),
+    LockAcrossDeviceCall(),
+    Float64LiteralDrift(),
+    SuppressionMissingReason(),
+)
+
+RULE_IDS = tuple(r.id for r in ALL_RULES)
+
+
+def get_rules(select: Optional[Sequence[str]] = None) -> tuple:
+    """Registry lookup; ``select=None`` means every rule."""
+    if select is None:
+        return ALL_RULES
+    unknown = set(select) - set(RULE_IDS)
+    if unknown:
+        raise KeyError(f"unknown rule id(s): {sorted(unknown)}")
+    return tuple(r for r in ALL_RULES if r.id in set(select))

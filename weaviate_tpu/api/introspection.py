@@ -259,8 +259,8 @@ def build_registry(db) -> dict[str, dict]:
     for name in sorted(db.collections()):
         try:
             cfg = db.get_collection(name).config
-        except Exception:
-            continue
+        except KeyError:
+            continue  # dropped between listing and lookup
         prop_fields = []
         agg_prop_fields = []
         for p in cfg.properties:

@@ -145,8 +145,8 @@ class ObjectStoreBackend(BackupBackend):
             return self.client.get(self._key(backup_id, "backup.json"))
         except ObjectStoreError:
             raise
-        except Exception:
-            return None
+        except (OSError, KeyError, ValueError):
+            return None  # missing meta == backup does not exist
 
     def list_files(self, backup_id: str) -> list[str]:
         keys = self.client.list(validate_backup_id(backup_id) + "/")

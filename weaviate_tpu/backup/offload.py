@@ -11,6 +11,7 @@ S3 client instead.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Optional
@@ -21,6 +22,8 @@ from weaviate_tpu.backup.object_store import (
     ObjectStoreClient,
     S3Client,
 )
+
+logger = logging.getLogger("weaviate_tpu.backup")
 
 
 class ObjectStoreOffloader:
@@ -104,6 +107,10 @@ class UsageReporter:
                     "tenants": len(st.get("tenants", {})),
                 }
             except Exception:
+                # usage report is best-effort per collection, but a
+                # collection that cannot be read should show up somewhere
+                logger.warning("usage report skipped collection %s", name,
+                               exc_info=True)
                 continue
         return {"node": self.node, "ts": time.time(),
                 "collections": cols}

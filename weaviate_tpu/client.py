@@ -200,7 +200,7 @@ class _Http:
             raw = e.read()
             try:
                 msg = json.loads(raw)["error"][0]["message"]
-            except Exception:
+            except (ValueError, KeyError, IndexError, TypeError):
                 msg = raw.decode(errors="replace")[:300]
             raise ApiError(e.code, msg) from None
 

@@ -14,10 +14,13 @@ SURVEY §5).
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
 from typing import Callable, Iterable
+
+logger = logging.getLogger("weaviate_tpu.gossip")
 
 ALIVE = "ALIVE"
 SUSPECT = "SUSPECT"
@@ -63,7 +66,9 @@ class Gossip:
                     self.merge(r["view"])
                 self._mark_heard(peer)
             except Exception:
-                pass  # unreachable peer ages out naturally
+                # unreachable peer ages out naturally, but leave a trace
+                # so a flapping network is diagnosable from logs
+                logger.debug("gossip ping to %s failed", peer, exc_info=True)
 
     # -- view exchange -----------------------------------------------------
     def view(self) -> dict[str, float]:

@@ -18,6 +18,7 @@ port; the mux here keeps the same separation by message type).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid as uuidlib
@@ -37,6 +38,8 @@ from weaviate_tpu.cluster.transport import TransportError
 from weaviate_tpu.core.db import DB
 from weaviate_tpu.schema.config import CollectionConfig
 from weaviate_tpu.storage.objects import StorageObject
+
+logger = logging.getLogger("weaviate_tpu.cluster")
 
 RAFT_TYPES = {"request_vote", "append_entries", "install_snapshot",
               "forward_apply"}
@@ -981,7 +984,13 @@ class ClusterNode:
                 self.raft.submit({"op": "set_shard_warming", "class": cls,
                                   "shard": shard, "nodes": []})
             except Exception:
-                pass
+                # a failed rollback leaves routing pointing at the aborted
+                # target set — that is exactly the silent-divergence case,
+                # so it must be loud even though the original error wins
+                logger.exception(
+                    "shard %s/%s routing rollback failed after aborted "
+                    "move; routing may reference the target replica", cls,
+                    shard)
             raise
         return moved
 

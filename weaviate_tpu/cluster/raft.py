@@ -29,6 +29,7 @@ thread-per-append fan-out).
 
 from __future__ import annotations
 
+import logging
 import os
 import random
 import threading
@@ -39,6 +40,8 @@ from typing import Any, Callable, Optional
 import msgpack
 
 from weaviate_tpu.cluster.transport import TransportError
+
+logger = logging.getLogger("weaviate_tpu.raft")
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -254,7 +257,10 @@ class RaftNode:
                 try:
                     self.on_config_change(nodes)
                 except Exception:
-                    pass
+                    # membership already committed; a broken observer must
+                    # not stall raft, but the operator has to see it
+                    logger.exception(
+                        "config-change callback failed for %s", nodes)
 
     def _apply_config_command(self, command: dict, index: int) -> None:
         base = set(self.config_nodes)
@@ -287,7 +293,9 @@ class RaftNode:
                 try:
                     self.on_config_change(nodes)
                 except Exception:
-                    pass
+                    logger.exception(
+                        "config-change callback failed for %s after log "
+                        "truncation", nodes)
 
     @staticmethod
     def _is_config(command) -> bool:
@@ -511,9 +519,7 @@ class RaftNode:
                     if not behind:
                         break
             except Exception:
-                import logging
-
-                logging.getLogger("weaviate_tpu.raft").exception(
+                logger.exception(
                     "replication to %s failed; pipeline continues", peer)
                 stop_evt.wait(self._heartbeat_interval)
 
@@ -545,8 +551,10 @@ class RaftNode:
             try:
                 r = self.transport.send(peer, msg, timeout=0.2)
             except TransportError:
-                continue
+                continue  # expected under partition; next beat retries
             except Exception:
+                logger.warning("heartbeat to %s raised a non-transport "
+                               "error", peer, exc_info=True)
                 continue
             with self._lock:
                 if r.get("term", 0) > self.current_term:

@@ -15,10 +15,13 @@ Handlers register per task kind on the executor (``reindex_inverted`` and
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 import uuid as uuidlib
 from typing import Any, Callable, Optional
+
+logger = logging.getLogger("weaviate_tpu.tasks")
 
 TASK_PENDING = "PENDING"
 TASK_RUNNING = "RUNNING"
@@ -255,4 +258,6 @@ class DistributedTaskExecutor:
                 self.run_pending_once()
                 self.reap_expired_once()
             except Exception:
-                pass  # raft leadership churn etc: retry next tick
+                # raft leadership churn etc: retry next tick, audibly
+                logger.warning("task executor tick failed; retrying",
+                               exc_info=True)

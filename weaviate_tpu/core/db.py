@@ -9,6 +9,7 @@ locally and persist to ``schema.json`` (the Raft FSM equivalent slot —
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from typing import Optional
@@ -72,7 +73,12 @@ class DB:
                 try:
                     s.maybe_checkpoint()
                 except Exception:
-                    pass  # cycle must never die; next tick retries
+                    # cycle must never die; next tick retries — but a shard
+                    # that cannot checkpoint is accumulating unbounded
+                    # replay, which the operator needs to know about
+                    logging.getLogger("weaviate_tpu.db").warning(
+                        "background checkpoint failed; will retry",
+                        exc_info=True)
 
     def _metrics_cycle(self) -> None:
         from weaviate_tpu.monitoring.metrics import (

@@ -97,6 +97,7 @@ class FlatIndex(VectorIndex):
                 chunk_size=self.config.search_chunk_size,
                 approx_recall=approx_recall,
             )
+            # graftlint: allow[host-sync-in-hot-path] reason=final top-k materialization
             return SearchResult(ids=np.asarray(ids), dists=np.asarray(d))
         # one consistent device-state snapshot (concurrent writers swap it)
         corpus, valid, sqnorms = self.store.snapshot()
@@ -140,6 +141,7 @@ class FlatIndex(VectorIndex):
                 if out is not None:
                     d, ids = out
                     return SearchResult(
+                        # graftlint: allow[host-sync-in-hot-path] reason=final top-k materialization
                         ids=np.asarray(ids), dists=np.asarray(d))
         d, ids = flat_search(
             qj,
@@ -153,6 +155,7 @@ class FlatIndex(VectorIndex):
             precision=self.config.precision,
             approx_recall=approx_recall,
         )
+        # graftlint: allow[host-sync-in-hot-path] reason=final top-k materialization
         return SearchResult(ids=np.asarray(ids), dists=np.asarray(d))
 
     def search_by_distance(

@@ -93,6 +93,7 @@ class GeoIndex:
                  * jnp.sin(dl / 2.0) ** 2)
             d = 2.0 * EARTH_RADIUS_M * jnp.arcsin(
                 jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+            # graftlint: allow[host-sync-in-hot-path] reason=single [N] readback feeding the host radius filter
             d = np.asarray(d)
         else:
             d = haversine_m(lat, lon, self._lat[: self._n],

@@ -312,24 +312,26 @@ class HFreshIndex(VectorIndex):
             mask = mask & np.where(ok, al[np.clip(cand, 0, len(al) - 1)],
                                    False)
 
+        import jax
         import jax.numpy as jnp
 
         from weaviate_tpu.ops.distance import gather_distance
 
         corpus, valid, _ = self.store.snapshot()
-        d = np.asarray(gather_distance(
-            jnp.asarray(qp), corpus,
-            jnp.asarray(np.clip(cand, 0, corpus.shape[0] - 1).astype(np.int32)),
-            self.metric))
-        live = np.asarray(valid)[np.clip(cand, 0, corpus.shape[0] - 1)]
-        d = np.where(mask & live, d, np.float32(MASK_DISTANCE))
-
+        rows = jnp.asarray(
+            np.clip(cand, 0, corpus.shape[0] - 1).astype(np.int32))
+        dj = gather_distance(jnp.asarray(qp), corpus, rows, self.metric)
+        # mask + select stay on device: only the final [B, k] crosses back,
+        # not the full [B, cmax] candidate matrix
+        live = jnp.take(valid, rows)
+        dj = jnp.where(jnp.asarray(mask) & live, dj,
+                       jnp.float32(MASK_DISTANCE))
         kk = min(k, cmax)
-        part = np.argpartition(d, kk - 1, axis=1)[:, :kk]
-        pd = np.take_along_axis(d, part, axis=1)
-        order = np.argsort(pd, axis=1, kind="stable")
-        sel = np.take_along_axis(part, order, axis=1)
-        out_d = np.take_along_axis(d, sel, axis=1)
+        neg, sel_j = jax.lax.top_k(-dj, kk)
+        # graftlint: allow[host-sync-in-hot-path] reason=final top-k materialization
+        out_d = np.asarray(-neg)
+        # graftlint: allow[host-sync-in-hot-path] reason=final top-k materialization
+        sel = np.asarray(sel_j)
         out_i = np.take_along_axis(cand, sel, axis=1)
         out_i = np.where(out_d >= MASK_DISTANCE, -1, out_i)
         out_d = np.where(out_i < 0, np.inf, out_d)

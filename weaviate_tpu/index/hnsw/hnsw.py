@@ -414,7 +414,9 @@ class HNSWIndex(VectorIndex):
                     jnp.asarray(eps[s:s + chunk].astype(np.int32)),
                     ef=ef_pad, max_steps=int(4 * ef_pad + 64),
                     metric=self.metric, precision=self.config.precision)
+                # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
                 outs_i.append(np.asarray(ids_j).astype(np.int64))
+                # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
                 outs_d.append(np.asarray(d_j))
             res_ids = np.concatenate(outs_i)[:, :efc]
             res_d = np.concatenate(outs_d)[:, :efc]
@@ -806,7 +808,9 @@ class HNSWIndex(VectorIndex):
                     metric=self.metric,
                     precision=self.config.precision,
                 )
+            # graftlint: allow[host-sync-in-hot-path] reason=final beam materialization
             ids = np.asarray(ids).astype(np.int64)
+            # graftlint: allow[host-sync-in-hot-path] reason=final beam materialization
             d = np.asarray(d)
             self._beam_proven = True
         except Exception as e:

@@ -51,8 +51,10 @@ class MuveraEncoder:
         key = jax.random.PRNGKey(MUVERA_SEED)
         kg, kp = jax.random.split(key)
         # host copies: encoding happens in jitted fns that close over these
+        # graftlint: allow[host-sync-in-hot-path] reason=one-shot init; jitted encoders close over host copies
         self.gaussians = np.asarray(
             jax.random.normal(kg, (repetitions, ksim, dims)), np.float32)
+        # graftlint: allow[host-sync-in-hot-path] reason=one-shot init; jitted encoders close over host copies
         self.proj = np.asarray(
             jax.random.rademacher(kp, (repetitions, dims, self.dproj)),
             np.float32) / np.sqrt(self.dproj)
@@ -139,6 +141,7 @@ def maxsim_scores(query: np.ndarray, cand_tokens: np.ndarray,
         mask = jax.device_put(cand_mask,
                               NamedSharding(mesh, P(SHARD_AXIS, None)))
         q = replicate(np.asarray(query, np.float32), mesh)
+        # graftlint: allow[host-sync-in-hot-path] reason=final [C] score materialization for host rerank
         return np.asarray(sharded_maxsim(q, toks, mask, mesh=mesh))[:c]
 
     q = jnp.asarray(query, jnp.float32)
@@ -148,6 +151,7 @@ def maxsim_scores(query: np.ndarray, cand_tokens: np.ndarray,
     sims = jnp.where(m[:, None, :], sims, -jnp.inf)
     best = jnp.max(sims, axis=2)  # [C, Tq]
     best = jnp.where(jnp.isfinite(best), best, 0.0)
+    # graftlint: allow[host-sync-in-hot-path] reason=final [C] score materialization for host rerank
     return np.asarray(jnp.sum(best, axis=1))
 
 
@@ -304,7 +308,8 @@ class MultiVectorIndex(VectorIndex):
                 .reshape(rec["shape"]).copy()
                 for rec in d["docs"]
             }
-        except Exception:
+        except (OSError, ValueError, KeyError, TypeError, AttributeError):
+            # torn/corrupt token sidecar: contract is "rebuild from source"
             return None
         return meta
 

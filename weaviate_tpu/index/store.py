@@ -68,8 +68,11 @@ def _mesh_fns(mesh):
         shardings = (row, flat, flat)
         fns = (
             shardings,
+            # graftlint: allow[jit-in-loop] reason=compiled once per mesh via _mesh_fns_cache
             jax.jit(_scatter_impl, out_shardings=shardings),
+            # graftlint: allow[jit-in-loop] reason=compiled once per mesh via _mesh_fns_cache
             jax.jit(_mask_off_impl, out_shardings=flat),
+            # graftlint: allow[jit-in-loop] reason=compiled once per mesh via _mesh_fns_cache
             jax.jit(_grow_impl, static_argnames=("new_cap",),
                     out_shardings=shardings),
         )
@@ -205,6 +208,7 @@ class DeviceVectorStore:
 
     def get(self, doc_ids: np.ndarray) -> np.ndarray:
         """Host gather (debug/rescore path)."""
+        # graftlint: allow[host-sync-in-hot-path] reason=explicitly host-facing accessor
         return np.asarray(
             self._state[0][jnp.asarray(np.asarray(doc_ids, np.int32))])
 
@@ -267,7 +271,9 @@ class DeviceVectorStore:
             norms = np.frombuffer(d["sqnorms"], np.float32)
             hv = np.unpackbits(
                 np.frombuffer(d["valid"], np.uint8), count=wm).astype(bool)
-        except Exception:
+        except (OSError, ValueError, KeyError, TypeError, AttributeError,
+                ImportError):
+            # absent/torn/foreign-dtype file: caller rebuilds from source
             return None
         self.ensure_capacity(max(wm, 1))
         cap = self.capacity

@@ -232,6 +232,6 @@ def try_native_bm25(k1: float, b: float) -> Optional[NativeBM25]:
                 )
 
                 NATIVE_BM25_UNAVAILABLE.set(1)
-            except Exception:
-                pass
+            except ImportError:
+                pass  # metrics registry optional in minimal builds
         return None

@@ -20,11 +20,14 @@ term. The delta log replays writes with seq > the snapshot's seq.
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Optional
 
 import msgpack
 import numpy as np
+
+logger = logging.getLogger("weaviate_tpu.inverted")
 
 
 def _col_state(col) -> dict:
@@ -107,8 +110,9 @@ def read_header(path: str) -> Optional[dict]:
                 f, raw=False, max_buffer_size=1 << 31,
                 strict_map_key=False))
         return hdr if hdr.get("k") == "hdr" else None
-    except Exception:
-        return None
+    except (OSError, ValueError, KeyError, TypeError, StopIteration,
+            AttributeError):
+        return None  # unreadable/foreign header == no snapshot
 
 
 def save_snapshot(inv, path: str, seq: int) -> None:
@@ -244,6 +248,8 @@ def load_snapshot(inv, path: str) -> Optional[int]:
             if not ended:
                 return None  # torn snapshot: fall back to full rebuild
     except Exception:
+        logger.warning("snapshot %s unreadable; falling back to full "
+                       "rebuild", path, exc_info=True)
         return None
 
     inv.doc_count = doc_count

@@ -14,6 +14,7 @@ matching, frequency-based extractive summary, capitalized-span NER) — the
 
 from __future__ import annotations
 
+import logging
 import math
 import re
 from typing import Optional, Sequence
@@ -45,6 +46,11 @@ def _try_pipeline(task: str, model: str):
 
         return pipeline(task, model=model, local_files_only=True)
     except Exception:
+        # third-party loader can raise anything; absence of the model is
+        # an expected, logged degradation to the heuristic path
+        logging.getLogger("weaviate_tpu.modules").debug(
+            "transformers pipeline %s/%s unavailable", task, model,
+            exc_info=True)
         return None
 
 

@@ -12,6 +12,7 @@ URL is configured and reachable.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -47,7 +48,11 @@ class Telemeter:
             try:
                 objects += self.db.get_collection(name).count()
             except Exception:
-                pass
+                # best-effort payload: a dropped collection or an unreadable
+                # lazy shard must never break startup/shutdown pings
+                logging.getLogger("weaviate_tpu.telemetry").debug(
+                    "telemetry count skipped collection %s", name,
+                    exc_info=True)
         payload = {
             "machine_id": self.machine_id,
             "type": kind,  # INIT | UPDATE | TERMINATE

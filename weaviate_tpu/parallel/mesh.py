@@ -9,6 +9,7 @@ replace the clusterapi scatter-gather.
 
 from __future__ import annotations
 
+import logging
 import sys
 import threading
 from typing import Optional
@@ -59,6 +60,10 @@ def _probe_default_devices(timeout: float = 60.0) -> list:
         try:
             out.append(jax.devices())
         except Exception:
+            # no usable platform (CPU-only image, wedged PJRT plugin):
+            # expected degradation, logged for mesh-sizing post-mortems
+            logging.getLogger("weaviate_tpu.mesh").info(
+                "default platform probe failed; no mesh", exc_info=True)
             out.append([])
 
     t = threading.Thread(target=probe, daemon=True)

@@ -13,6 +13,7 @@ Kill switch: ``WEAVIATE_TPU_MESH=off`` forces single-device mode.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Optional
@@ -44,6 +45,10 @@ def default_mesh() -> Optional[Mesh]:
         try:
             devices = jax.devices()
         except Exception:
+            # a wedged PJRT plugin can raise anything (see mesh.py probe);
+            # any failure here means single-host mode, audibly
+            logging.getLogger("weaviate_tpu.mesh").info(
+                "jax.devices() failed; running single-host", exc_info=True)
             devices = []
         if len(devices) > 1:
             _mesh = make_mesh(len(devices))

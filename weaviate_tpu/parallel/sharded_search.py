@@ -20,6 +20,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from weaviate_tpu.ops.distance import MASK_DISTANCE, pairwise_distance
 from weaviate_tpu.parallel.mesh import SHARD_AXIS
 
+try:  # jax >= 0.6: stable API, replication check renamed to check_vma
+    from jax import shard_map as _shard_map_impl
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except ImportError:  # pragma: no cover - exercised on jax 0.4.x images
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _SHARD_MAP_CHECK_KW = "check_rep"
+
+
+def _shard_map(f, mesh, in_specs, out_specs, check=False):
+    """Version-portable shard_map with the replication check disabled (our
+    out_specs are authoritative; the checker rejects the pmin/psum-combine
+    patterns used below)."""
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs,
+                           **{_SHARD_MAP_CHECK_KW: check})
+
 
 def shard_corpus(corpus, valid, mesh: Mesh, axis: str = SHARD_AXIS):
     """Place [N, D] corpus + [N] mask row-sharded across the mesh.
@@ -134,7 +150,7 @@ def sharded_flat_search(
     Returns replicated (dists [B, k], global ids [B, k]).
     """
     if sqnorms is None:
-        fn = jax.shard_map(
+        fn = _shard_map(
             functools.partial(
                 _local_search, k=k, metric=metric, axis=axis,
                 precision=precision, chunk_size=chunk_size,
@@ -143,10 +159,9 @@ def sharded_flat_search(
             mesh=mesh,
             in_specs=(P(axis, None), P(axis), P(None, None)),
             out_specs=(P(None, None), P(None, None)),
-            check_vma=False,
         )
         return fn(corpus, valid, queries)
-    fn = jax.shard_map(
+    fn = _shard_map(
         lambda c, v, q, s: _local_search(
             c, v, q, k=k, metric=metric, axis=axis, precision=precision,
             sq_local=s, chunk_size=chunk_size, approx_recall=approx_recall,
@@ -154,7 +169,6 @@ def sharded_flat_search(
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(None, None), P(axis)),
         out_specs=(P(None, None), P(None, None)),
-        check_vma=False,
     )
     return fn(corpus, valid, queries, sqnorms)
 
@@ -211,21 +225,16 @@ def sharded_maxsim(
     MaxSim for its slice as one einsum, and a tiled ``all_gather`` over
     ICI reassembles the [C] score vector — the reference rescoring loop
     (``hnsw/search.go:927``) turned into one SPMD program."""
-    try:
-        from jax import shard_map  # jax >= 0.6 stable path
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
-
     if mesh is None:
         return _local_maxsim(query, cand_tokens, cand_mask)
 
     # out_specs=P(axis): each device returns its candidate slice's scores
     # and shard_map stitches the global [C] vector — the reassembly IS the
     # collective, no explicit all_gather needed
-    fn = shard_map(
+    fn = _shard_map(
         _local_maxsim, mesh=mesh,
         in_specs=(P(None, None), P(axis, None, None), P(axis, None)),
-        out_specs=P(axis),
+        out_specs=P(axis), check=True,
     )
     return fn(query, cand_tokens, cand_mask)
 
@@ -260,14 +269,13 @@ def sharded_gather_distance(
     """Distributed HNSW frontier evaluation (reference hot loop
     ``hnsw/search.go:726``): corpus [N, D] row-sharded, queries [B, D] and
     candidate_ids [B, C] replicated -> replicated distances [B, C]."""
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(
             _local_gather_dists, metric=metric, axis=axis, precision=precision
         ),
         mesh=mesh,
         in_specs=(P(axis, None), P(None, None), P(None, None)),
         out_specs=P(None, None),
-        check_vma=False,
     )
     return fn(corpus, queries, candidate_ids)
 
@@ -293,12 +301,11 @@ def sharded_take(
 ):
     """Gather rows by global id from a row-sharded corpus -> replicated
     [..., D] vectors (each id owned by exactly one device; psum-combine)."""
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(_local_take, axis=axis),
         mesh=mesh,
         in_specs=(P(axis, None), P(*([None] * ids.ndim))),
         out_specs=P(*([None] * (ids.ndim + 1))),
-        check_vma=False,
     )
     return fn(corpus, ids)
 
@@ -349,13 +356,12 @@ def distributed_step(
     corpus [N, D] / valid [N] row-sharded; new_ids [M] global, new_vecs [M, D]
     and queries [B, D] replicated. Returns (corpus', valid', dists, ids).
     """
-    fn = jax.shard_map(
+    fn = _shard_map(
         functools.partial(
             _local_step, k=k, metric=metric, axis=axis, precision=precision
         ),
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(None), P(None, None), P(None, None)),
         out_specs=(P(axis, None), P(axis), P(None, None), P(None, None)),
-        check_vma=False,
     )
     return fn(corpus, valid, new_ids, new_vecs, queries)

@@ -173,8 +173,8 @@ class DiskSegment:
         try:
             self._mm.close()
             self._f.close()
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # double-close during compaction teardown is harmless
 
     # -- writes -----------------------------------------------------------
     @staticmethod
