@@ -1,0 +1,619 @@
+"""Chaos-hardened replication suite.
+
+Drives the replica coordinator through ChaosTransport fault programs
+(seeded drops, injected latency, one-way partitions, lost replies) and
+asserts the resilience layer holds: QUORUM reads/writes succeed inside
+their deadline budget with a dead replica and a lossy network, breakers
+isolate the dead peer, diverged replicas converge after healing via
+hashtree anti-entropy, and every reaction is observable in the metrics
+registry. Unit coverage for RetryPolicy/Deadline/CircuitBreaker and the
+TCP stale-pooled-socket retry rides along.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.cluster import (
+    BreakerBoard,
+    ChaosTransport,
+    CircuitBreaker,
+    ClusterNode,
+    Deadline,
+    DeadlineExceeded,
+    HashTree,
+    InProcTransport,
+    RetryPolicy,
+    TcpTransport,
+    TransportError,
+)
+from weaviate_tpu.cluster.resilience import retrying_call
+from weaviate_tpu.monitoring.metrics import (
+    BREAKER_TRANSITIONS,
+    CHAOS_FAULTS,
+    REGISTRY,
+    REPLICA_REPAIRS,
+    RPC_RETRIES,
+    STAGING_ABORTED,
+)
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    FlatIndexConfig,
+    Property,
+    ReplicationConfig,
+    ShardingConfig,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+# the replica data plane: fault these, leave raft/gossip control clean so
+# leadership stays stable while the coordinator is under fire
+DATA_TYPES = (
+    "replica_prepare", "replica_commit", "replica_abort", "replica_delete",
+    "object_digest", "object_fetch", "object_push",
+    "hashtree_leaves", "hashtree_items",
+)
+
+
+def wait_for(pred, timeout=8.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _leader(nodes):
+    for n in nodes:
+        if n.raft.is_leader():
+            return n
+    return None
+
+
+def _cfg(factor=3, shards=2, name="Doc"):
+    return CollectionConfig(
+        name=name,
+        properties=[Property(name="body")],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        sharding=ShardingConfig(desired_count=shards),
+        replication=ReplicationConfig(factor=factor),
+    )
+
+
+def _objs(n, dims=8, start=0, name="Doc"):
+    out = []
+    for i in range(start, start + n):
+        v = np.zeros(dims, np.float32)
+        v[i % dims] = 1.0
+        out.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}",
+            collection=name,
+            properties={"body": f"doc {i}"},
+            vector=v,
+        ))
+    return out
+
+
+@pytest.fixture
+def chaos3(tmp_path):
+    """3-node cluster, every node's OUTBOUND path wrapped in a seeded
+    ChaosTransport over the shared in-proc registry."""
+    registry = {}
+    ids = ["n0", "n1", "n2"]
+    nodes, chaos = [], {}
+    for i, nid in enumerate(ids):
+        ct = ChaosTransport(InProcTransport(registry, nid), seed=1000 + i)
+        chaos[nid] = ct
+        nodes.append(ClusterNode(nid, ids, ct, str(tmp_path / nid)))
+    wait_for(lambda: any(n.raft.is_leader() for n in nodes),
+             msg="leader election")
+    yield nodes, chaos
+    for ct in chaos.values():
+        ct.clear()
+    for n in nodes:
+        n.close()
+
+
+def _isolate(chaos, victim, ids):
+    """Full isolation from one-way programs: nobody reaches the victim,
+    the victim reaches nobody (its gossip/raft chatter dies at its own
+    wrapper)."""
+    for nid in ids:
+        if nid != victim:
+            chaos[nid].partition(victim)
+    chaos[victim].program(None, partition=True)
+
+
+def _heal(chaos, ids, nodes=()):
+    for nid in ids:
+        chaos[nid].clear()
+    for n in nodes:
+        # the operator knows the network healed; don't wait out the
+        # half-open probe cycle (keeps convergence free of wall-clock)
+        n.breakers.reset()
+
+
+def _shard_root(node, cls, shard):
+    return HashTree.build(node._shard_items(cls, shard)).root()
+
+
+def _converge(nodes, cls, rounds=10):
+    for _ in range(rounds):
+        if sum(n.anti_entropy_once(cls) for n in nodes) == 0:
+            return
+    raise AssertionError(f"no zero-move round within {rounds} rounds")
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: dead replica + 10% drop + 50ms jitter
+
+
+def test_quorum_ops_survive_drop_jitter_and_dead_replica(chaos3):
+    nodes, chaos = chaos3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=3, shards=2))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    nodes[0].put_batch("Doc", _objs(10), consistency="ALL")
+
+    retries0 = sum(RPC_RETRIES._values.values())
+    opens0 = BREAKER_TRANSITIONS.value(peer="n2", to="open")
+    drops0 = sum(v for k, v in CHAOS_FAULTS._values.items()
+                 if ("kind", "drop") in k)
+
+    # n2 drops dead; the n0<->n1 links run at 10% drop + up to 50ms jitter
+    _isolate(chaos, "n2", ["n0", "n1", "n2"])
+    for a, b in (("n0", "n1"), ("n1", "n0")):
+        chaos[a].program(b, drop=0.10, jitter=0.05, types=DATA_TYPES)
+
+    budget = nodes[0].op_budget
+    for start in (100, 120, 140):
+        t0 = time.monotonic()
+        nodes[0].put_batch("Doc", _objs(20, start=start),
+                           consistency="QUORUM")
+        write_s = time.monotonic() - t0
+        assert write_s < budget + 0.5, f"QUORUM write took {write_s:.2f}s"
+
+    for i in list(range(10)) + list(range(100, 160, 3)):
+        uid = f"00000000-0000-0000-0000-{i:012d}"
+        t0 = time.monotonic()
+        o = nodes[1].get("Doc", uid, consistency="QUORUM")
+        read_s = time.monotonic() - t0
+        assert o is not None and o.uuid == uid
+        assert read_s < budget + 0.5, f"QUORUM read took {read_s:.2f}s"
+
+    # deletes ride the same fan-out
+    assert nodes[0].delete("Doc", ["00000000-0000-0000-0000-000000000009"],
+                           consistency="QUORUM") == 1
+
+    # the injected faults were really exercised, and the policies reacted:
+    # chaos dropped messages (hundreds of lossy RPCs make zero drops
+    # astronomically unlikely), retries absorbed them, n2's breaker opened
+    drops = sum(v for k, v in CHAOS_FAULTS._values.items()
+                if ("kind", "drop") in k)
+    assert drops > drops0
+    assert sum(RPC_RETRIES._values.values()) > retries0
+    assert BREAKER_TRANSITIONS.value(peer="n2", to="open") > opens0
+    assert nodes[0].breakers.states().get("n2") in ("open", "half_open")
+
+    # heal everything; anti-entropy converges the dead replica
+    _heal(chaos, ["n0", "n1", "n2"], nodes)
+    _converge(nodes, "Doc")
+    n_shards = nodes[0]._state_for("Doc").n_shards
+    for shard in range(n_shards):
+        roots = {_shard_root(n, "Doc", shard) for n in nodes}
+        assert len(roots) == 1, f"shard {shard} diverged after healing"
+    # and the repair path was counted
+    assert REPLICA_REPAIRS.value(path="anti_entropy") > 0
+    # the whole story is observable through the registry text endpoint
+    text = REGISTRY.render_text()
+    for series in ("weaviate_tpu_rpc_retries_total",
+                   "weaviate_tpu_breaker_transitions_total",
+                   "weaviate_tpu_replica_repairs_total",
+                   "weaviate_tpu_chaos_faults_total"):
+        assert series in text
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy convergence (satellite): partition -> write majority -> heal
+
+
+def test_anti_entropy_converges_after_partition(chaos3):
+    nodes, chaos = chaos3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=3, shards=3))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    nodes[0].put_batch("Doc", _objs(12), consistency="ALL")
+
+    # n2 partitioned away from the data plane only (raft/gossip stay up,
+    # so this is a replica partition, not a node death)
+    for nid in ("n0", "n1"):
+        chaos[nid].program("n2", partition=True, types=DATA_TYPES)
+    chaos["n2"].program("n0", partition=True, types=DATA_TYPES)
+    chaos["n2"].program("n1", partition=True, types=DATA_TYPES)
+
+    # writes and a delete flow through the majority; n2 diverges
+    nodes[0].put_batch("Doc", _objs(12, start=50), consistency="QUORUM")
+    dead_uid = "00000000-0000-0000-0000-000000000003"
+    nodes[0].delete("Doc", [dead_uid], consistency="QUORUM")
+
+    n_shards = nodes[0]._state_for("Doc").n_shards
+    assert any(
+        _shard_root(nodes[2], "Doc", s) != _shard_root(nodes[0], "Doc", s)
+        for s in range(n_shards)), "partitioned replica should diverge"
+
+    _heal(chaos, ["n0", "n1", "n2"], nodes)
+    _converge(nodes, "Doc")
+    for shard in range(n_shards):
+        roots = {_shard_root(n, "Doc", shard) for n in nodes}
+        assert len(roots) == 1, f"shard {shard} diverged after hashBeat"
+    # tombstone honored: the partitioned replica must not resurrect
+    for n in nodes:
+        sh = n._state_for("Doc").shard_replicas_for_uuid(dead_uid)[0]
+        assert n._local_shard("Doc", sh).get_by_uuid(dead_uid) is None
+
+
+# ---------------------------------------------------------------------------
+# breaker behavior on a persistently bad link
+
+
+def test_breaker_opens_on_bad_link_and_recovers(chaos3):
+    nodes, chaos = chaos3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=3, shards=1))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+
+    chaos["n0"].program("n1", drop=1.0, types=DATA_TYPES)
+    for i in range(4):
+        nodes[0].put_batch("Doc", _objs(1, start=i), consistency="QUORUM")
+    assert nodes[0].breakers.states().get("n1") == "open"
+    # open breaker demotes n1 in n0's replica ordering despite gossip ALIVE
+    assert nodes[0]._ordered(["n1", "n2"])[0] == "n2"
+
+    chaos["n0"].clear("n1")
+    time.sleep(nodes[0].breakers.reset_after)  # open -> half-open window
+    nodes[0].put_batch("Doc", _objs(1, start=40), consistency="ALL")
+    wait_for(lambda: nodes[0].breakers.states().get("n1") == "closed",
+             timeout=4.0, msg="breaker closes after heal")
+
+
+# ---------------------------------------------------------------------------
+# 2PC staging hygiene: lost coordinators leave no orphans
+
+
+def test_staging_ttl_sweep_aborts_orphans(tmp_path):
+    registry = {}
+    node = ClusterNode("s0", ["s0"], InProcTransport(registry, "s0"),
+                       str(tmp_path / "s0"), heartbeat=False,
+                       staging_ttl=0.05)
+    try:
+        # install the schema straight into the FSM (raft isn't running):
+        # prepares are refused for collections this replica doesn't know
+        node.fsm.apply({"op": "add_class",
+                        "class": _cfg(factor=1, shards=1).to_dict()})
+        objs = _objs(2)
+        node._on_replica_prepare({
+            "type": "replica_prepare", "txid": "tx-orphan", "class": "Doc",
+            "tenant": "", "shard": 0,
+            "objects": [o.to_bytes() for o in objs],
+        })
+        assert "tx-orphan" in node._staging
+        aborted0 = STAGING_ABORTED.value(reason="ttl")
+        time.sleep(0.06)
+        assert node.sweep_staging() == 1
+        assert node._staging == {}
+        assert STAGING_ABORTED.value(reason="ttl") == aborted0 + 1
+        # a commit for the swept tx is refused, not applied — and the
+        # outcome ledger answers truthfully that it was aborted
+        r = node._on_replica_commit({"txid": "tx-orphan"})
+        assert r == {"ok": False, "error": "transaction aborted"}
+        # a commit for a tx nobody ever staged is simply unknown
+        r = node._on_replica_commit({"txid": "tx-never-staged"})
+        assert r == {"ok": False, "error": "unknown txid"}
+        # the next prepare sweeps opportunistically too
+        node._on_replica_prepare({
+            "type": "replica_prepare", "txid": "tx-a", "class": "Doc",
+            "tenant": "", "shard": 0, "objects": [],
+        })
+        time.sleep(0.06)
+        node._on_replica_prepare({
+            "type": "replica_prepare", "txid": "tx-b", "class": "Doc",
+            "tenant": "", "shard": 0, "objects": [],
+        })
+        assert "tx-a" not in node._staging and "tx-b" in node._staging
+    finally:
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# TCP transport: stale pooled socket (peer restart) retries once
+
+
+def test_tcp_stale_pooled_socket_retries_with_fresh_connection():
+    server = TcpTransport("127.0.0.1:0")
+    server.start(lambda m: {"echo": m["x"]})
+    port = int(server.node_id.rsplit(":", 1)[1])
+    client = TcpTransport("127.0.0.1:0")
+    client.start(lambda m: {})
+    try:
+        assert client.send(server.node_id, {"x": 1}) == {"echo": 1}
+        assert len(client._idle[server.node_id]) == 1  # pooled
+        # peer restarts on the SAME address: pooled socket is now stale
+        server.stop()
+        server = TcpTransport(f"127.0.0.1:{port}")
+        server.start(lambda m: {"echo": m["x"] * 10})
+        assert client.send(server.node_id, {"x": 2}) == {"echo": 20}
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_tcp_dead_peer_still_raises():
+    client = TcpTransport("127.0.0.1:0")
+    client.start(lambda m: {})
+    try:
+        with pytest.raises(TransportError):
+            client.send("127.0.0.1:1", {"x": 1}, timeout=0.2)
+    finally:
+        client.stop()
+
+
+# ---------------------------------------------------------------------------
+# unit: policies
+
+
+class TestRetryPolicy:
+    def test_backoff_within_jittered_envelope_and_deterministic(self):
+        import random
+
+        p = RetryPolicy(attempts=5, base=0.1, cap=1.0, multiplier=2.0)
+        seq1 = [p.backoff(n, random.Random(7)) for n in range(1, 5)]
+        seq2 = [p.backoff(n, random.Random(7)) for n in range(1, 5)]
+        assert seq1 == seq2  # seeded => reproducible
+        for n in range(1, 5):
+            envelope = min(1.0, 0.1 * 2 ** (n - 1))
+            draws = [p.backoff(n, random.Random(s)) for s in range(20)]
+            assert all(0.0 <= d <= envelope for d in draws)
+
+    def test_retrying_call_retries_then_succeeds(self):
+        import random
+
+        calls, sleeps = [], []
+
+        def flaky(timeout):
+            calls.append(timeout)
+            if len(calls) < 3:
+                raise TransportError("flake")
+            return {"ok": True}
+
+        r0 = sum(RPC_RETRIES._values.values())
+        out = retrying_call(
+            flaky, peer="p", policy=RetryPolicy(attempts=3),
+            deadline=Deadline(5.0), timeout=1.0, rng=random.Random(1),
+            retry_on=(TransportError,), sleep=sleeps.append)
+        assert out == {"ok": True} and len(calls) == 3
+        assert len(sleeps) == 2
+        assert sum(RPC_RETRIES._values.values()) == r0 + 2
+
+    def test_retrying_call_exhausts_and_raises_last(self):
+        import random
+
+        def always(timeout):
+            raise TransportError("down")
+
+        with pytest.raises(TransportError):
+            retrying_call(
+                always, peer="p", policy=RetryPolicy(attempts=2),
+                deadline=Deadline(5.0), timeout=1.0, rng=random.Random(1),
+                retry_on=(TransportError,), sleep=lambda s: None)
+
+
+class TestDeadline:
+    def test_clamps_attempt_timeout_and_expires(self):
+        now = [0.0]
+        d = Deadline(2.0, op="t", clock=lambda: now[0])
+        assert d.per_attempt(1.0) == 1.0
+        now[0] = 1.5
+        assert d.per_attempt(1.0) == pytest.approx(0.5)
+        assert not d.expired
+        now[0] = 2.1
+        assert d.expired
+        assert d.per_attempt(1.0) == 0.0
+        with pytest.raises(DeadlineExceeded):
+            d.require()
+
+    def test_expiry_metric_counted_once(self):
+        from weaviate_tpu.monitoring.metrics import DEADLINE_EXPIRED
+
+        d = Deadline(0.0, op="only_once_test")
+        for _ in range(3):
+            with pytest.raises(DeadlineExceeded):
+                d.require()
+        assert DEADLINE_EXPIRED.value(op="only_once_test") == 1
+
+
+class TestCircuitBreaker:
+    def test_full_lifecycle(self):
+        now = [0.0]
+        b = CircuitBreaker("p", fail_threshold=3, reset_after=1.0,
+                           clock=lambda: now[0])
+        assert b.state == "closed" and b.allow()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"  # below threshold
+        b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()  # fail-fast
+        now[0] = 1.1
+        assert b.state == "half_open"
+        assert b.allow()        # the single probe
+        assert not b.allow()    # second caller rejected mid-probe
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_half_open_failure_reopens(self):
+        now = [0.0]
+        b = CircuitBreaker("p", fail_threshold=1, reset_after=1.0,
+                           clock=lambda: now[0])
+        b.record_failure()
+        now[0] = 1.5
+        assert b.allow()
+        b.record_failure()  # failed probe
+        assert b.state == "open"
+        now[0] = 2.0        # cooldown restarted at 1.5, not elapsed
+        assert b.state == "open"
+        now[0] = 2.6
+        assert b.state == "half_open"
+
+    def test_board_rank_feeds_ordering(self):
+        board = BreakerBoard(fail_threshold=1)
+        assert board.rank("fresh") == 0  # unknown peer: no breaker created
+        board.fail("sick")
+        assert board.rank("sick") == 2
+        board.ok("sick")
+        assert board.rank("sick") == 0
+
+
+class TestChaosTransport:
+    class _Echo:
+        node_id = "echo"
+
+        def __init__(self):
+            self.sent = []
+
+        def start(self, handler):
+            pass
+
+        def send(self, peer, msg, timeout=1.0):
+            self.sent.append((peer, dict(msg)))
+            return {"ok": True}
+
+        def stop(self):
+            pass
+
+    def test_seeded_drop_schedule_is_reproducible(self):
+        def run(seed):
+            inner = self._Echo()
+            ct = ChaosTransport(inner, seed=seed, sleep=lambda s: None)
+            ct.program("p", drop=0.5)
+            outcome = []
+            for i in range(40):
+                try:
+                    ct.send("p", {"type": "t", "i": i})
+                    outcome.append(1)
+                except TransportError:
+                    outcome.append(0)
+            return outcome
+
+        a, b = run(42), run(42)
+        assert a == b
+        assert 0 < sum(a) < 40  # some dropped, some delivered
+        assert run(43) != a     # schedule is a function of the seed
+
+    def test_type_scoped_faults_spare_other_traffic(self):
+        inner = self._Echo()
+        ct = ChaosTransport(inner, seed=1, sleep=lambda s: None)
+        ct.program("p", drop=1.0, types={"replica_prepare"})
+        with pytest.raises(TransportError):
+            ct.send("p", {"type": "replica_prepare"})
+        assert ct.send("p", {"type": "gossip_ping"}) == {"ok": True}
+
+    def test_partition_and_heal(self):
+        inner = self._Echo()
+        ct = ChaosTransport(inner, seed=1, sleep=lambda s: None)
+        ct.partition("p")
+        with pytest.raises(TransportError):
+            ct.send("p", {"type": "t"})
+        assert ct.send("q", {"type": "t"}) == {"ok": True}  # one-way
+        ct.heal("p")
+        assert ct.send("p", {"type": "t"}) == {"ok": True}
+
+    def test_duplicate_delivers_twice_first_reply_wins(self):
+        inner = self._Echo()
+        ct = ChaosTransport(inner, seed=5, sleep=lambda s: None)
+        ct.program("p", duplicate=1.0)
+        assert ct.send("p", {"type": "t"}) == {"ok": True}
+        assert len(inner.sent) == 2
+
+    def test_fail_reply_delivers_but_raises(self):
+        inner = self._Echo()
+        ct = ChaosTransport(inner, seed=5, sleep=lambda s: None)
+        ct.program("p", fail_reply=1.0)
+        with pytest.raises(TransportError):
+            ct.send("p", {"type": "t"})
+        assert len(inner.sent) == 1  # the peer DID process the message
+
+    def test_latency_sleeps_injected_amount(self):
+        slept = []
+        inner = self._Echo()
+        ct = ChaosTransport(inner, seed=5, sleep=slept.append)
+        ct.program("p", latency=0.02, jitter=0.03)
+        ct.send("p", {"type": "t"})
+        assert len(slept) == 1 and 0.02 <= slept[0] <= 0.05
+
+    def test_chaos_spec_parser(self):
+        from weaviate_tpu.cluster.chaos import parse_chaos_spec
+
+        progs = parse_chaos_spec(
+            "*:drop=0.05,jitter=0.02;"
+            "10.0.0.3:7101:partition=1;"
+            "n1:drop=0.5,types=replica_prepare+object_digest")
+        assert progs[0] == (None, {"drop": 0.05, "jitter": 0.02})
+        assert progs[1] == ("10.0.0.3:7101", {"partition": True})
+        assert progs[2][0] == "n1"
+        assert progs[2][1]["types"] == {"replica_prepare", "object_digest"}
+
+
+# ---------------------------------------------------------------------------
+# soak (slow): sustained faults on EVERY message type + kill/heal cycles
+
+
+@pytest.mark.slow
+def test_chaos_soak_full_stack_faults(chaos3):
+    nodes, chaos = chaos3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=3, shards=2))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+
+    # 5% drop + up to 20ms jitter on EVERYTHING, raft and gossip included
+    for a in ("n0", "n1", "n2"):
+        for b in ("n0", "n1", "n2"):
+            if a != b:
+                chaos[a].program(b, drop=0.05, jitter=0.02)
+
+    written = []
+    for wave in range(4):
+        victim = ("n2", "n1")[wave % 2]
+        _isolate(chaos, victim, [])  # victim's own outbound only
+        for nid in ("n0", "n1", "n2"):
+            if nid != victim:
+                chaos[nid].partition(victim)
+        for n in nodes:  # last wave's breakers are stale news
+            n.breakers.reset()
+        writer = next(n for n in nodes if n.id != victim)
+        objs = _objs(15, start=1000 + wave * 100)
+        writer.put_batch("Doc", objs, consistency="QUORUM")
+        written.extend(o.uuid for o in objs)
+        # heal the partition but keep the lossy links for the next wave
+        for nid in ("n0", "n1", "n2"):
+            chaos[nid].clear(victim)
+        chaos[victim].clear()
+        for a in ("n0", "n1", "n2"):
+            for b in ("n0", "n1", "n2"):
+                if a != b:
+                    chaos[a].program(b, drop=0.05, jitter=0.02)
+
+    _heal(chaos, ["n0", "n1", "n2"], nodes)
+    wait_for(lambda: _leader(nodes) is not None, msg="leadership settles")
+    _converge(nodes, "Doc", rounds=15)
+    n_shards = nodes[0]._state_for("Doc").n_shards
+    for shard in range(n_shards):
+        assert len({_shard_root(n, "Doc", shard) for n in nodes}) == 1
+    for uid in written:
+        o = nodes[0].get("Doc", uid, consistency="QUORUM")
+        assert o is not None and o.uuid == uid

@@ -74,6 +74,10 @@ def _wait(pred, timeout=30.0, interval=0.2, msg="condition"):
 
 def _spawn(addr, peers, data_dir):
     env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    # don't inherit conftest's 8-virtual-device XLA split: each worker
+    # would spin up an 8-device CPU backend, and three such processes
+    # contending for the host starve the data plane into timeouts
+    env.pop("XLA_FLAGS", None)
     return subprocess.Popen(
         [sys.executable, "-m", "weaviate_tpu.cluster.worker",
          "--bind", addr, "--peers", ",".join(peers), "--data", data_dir],
@@ -229,6 +233,7 @@ def test_rest_over_cluster_replicated_writes(tmp_path):
     try:
         for i, a in enumerate(addrs):
             env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+            env.pop("XLA_FLAGS", None)  # see _spawn
             procs[a] = subprocess.Popen(
                 [sys.executable, "-m", "weaviate_tpu.cluster.worker",
                  "--bind", a, "--peers", ",".join(addrs),

@@ -383,6 +383,120 @@ class TestSwallowedException:
 
 
 # ---------------------------------------------------------------------------
+# transport-error-swallowed
+
+
+class TestTransportErrorSwallowed:
+    def test_pass_body_flagged_critical(self):
+        res = run("""
+            def f():
+                try:
+                    send()
+                except TransportError:
+                    pass
+        """, rel=CLUSTER)
+        assert rule_ids(res) == ["transport-error-swallowed"]
+        assert res.violations[0].severity == "critical"
+
+    def test_tuple_and_alias_forms_flagged(self):
+        res = run("""
+            def f():
+                try:
+                    send()
+                except (KeyError, TransportError):
+                    pass
+
+            def g():
+                try:
+                    send()
+                except _REPLICA_ERRORS:
+                    pass
+        """, rel=CLUSTER)
+        assert rule_ids(res) == ["transport-error-swallowed"] * 2
+
+    def test_dotted_name_flagged(self):
+        res = run("""
+            import weaviate_tpu.cluster.transport as transport
+
+            def f():
+                try:
+                    send()
+                except transport.TransportError:
+                    x = 1
+        """, rel=CLUSTER)
+        assert rule_ids(res) == ["transport-error-swallowed"]
+
+    def test_log_or_metric_counts_as_observed(self):
+        res = run("""
+            def f():
+                try:
+                    send()
+                except TransportError:
+                    logger.warning("replica down")
+
+            def g():
+                try:
+                    send()
+                except TransportError:
+                    RPC_FAILURES.inc(peer=peer, kind="transport")
+        """, rel=CLUSTER)
+        assert rule_ids(res) == []
+
+    def test_result_communication_counts_as_observed(self):
+        res = run("""
+            def f():
+                for rep in reps:
+                    try:
+                        send(rep)
+                    except TransportError:
+                        continue
+
+            def g():
+                try:
+                    send()
+                except TransportError:
+                    return False
+
+            def h():
+                try:
+                    send()
+                except TransportError:
+                    raise
+        """, rel=CLUSTER)
+        assert rule_ids(res) == []
+
+    def test_bound_exception_use_counts_as_observed(self):
+        res = run("""
+            def f():
+                try:
+                    send()
+                except TransportError as e:
+                    errors.append(str(e))
+        """, rel=CLUSTER)
+        assert rule_ids(res) == []
+
+    def test_outside_cluster_not_flagged(self):
+        res = run("""
+            def f():
+                try:
+                    send()
+                except TransportError:
+                    pass
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_other_exception_types_not_this_rule(self):
+        res = run("""
+            def f():
+                try:
+                    send()
+                except ValueError:
+                    pass
+        """, rel=CLUSTER)
+        assert rule_ids(res) == []
+
+
+# ---------------------------------------------------------------------------
 # lock-across-device-call
 
 

@@ -442,6 +442,78 @@ class SwallowedException(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 4b. transport-error-swallowed
+
+
+class TransportErrorSwallowed(Rule):
+    id = "transport-error-swallowed"
+    description = (
+        "`except TransportError: pass` in cluster/ — a replica RPC "
+        "failure absorbed with no log, no metric, and no result"
+    )
+    rationale = (
+        "The replication data plane is allowed to tolerate a failed "
+        "replica, but never invisibly: an unobserved TransportError is "
+        "exactly how a chaos-injected fault (or a real partition) turns "
+        "into silent divergence no dashboard shows. Failing the call, "
+        "counting it (RPC_FAILURES and friends), logging it, or turning "
+        "it into a result (return/continue/raise) all count as handling; "
+        "a body that does none of those is flagged."
+    )
+
+    _DIRS = ("weaviate_tpu/cluster/",)
+    # names the cluster package binds transport failure to
+    _TYPES = frozenset({"TransportError", "_REPLICA_ERRORS"})
+    _LOG_ATTRS = SwallowedException._LOG_ATTRS
+    _METRIC_ATTRS = frozenset({"inc", "dec", "observe", "set"})
+
+    def _names_transport_error(self, t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return False
+        if isinstance(t, ast.Tuple):
+            return any(self._names_transport_error(e) for e in t.elts)
+        dn = dotted_name(t)
+        return bool(dn) and dn.rsplit(".", 1)[-1] in self._TYPES
+
+    def _is_observed(self, handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+            # failure becomes a first-class result the caller sees
+            if isinstance(n, (ast.Raise, ast.Return, ast.Continue,
+                              ast.Break)):
+                return True
+            if isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) and f.attr in (
+                        self._LOG_ATTRS | self._METRIC_ATTRS):
+                    return True
+                if dotted_name(f) in ("warnings.warn",
+                                      "traceback.print_exc"):
+                    return True
+            if (handler.name and isinstance(n, ast.Name)
+                    and n.id == handler.name
+                    and isinstance(n.ctx, ast.Load)):
+                return True
+        return False
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, self._DIRS):
+            return
+        for handler in ctx.walk(ast.ExceptHandler):
+            if not self._names_transport_error(handler.type):
+                continue
+            if self._is_observed(handler):
+                continue
+            yield self.violation(
+                ctx, handler,
+                "TransportError swallowed with no log, metric, or "
+                "result — count it (RPC_FAILURES / a repair counter), log "
+                "via logging.getLogger('weaviate_tpu.cluster'), or let it "
+                "propagate",
+                severity=SEV_CRITICAL,
+            )
+
+
+# ---------------------------------------------------------------------------
 # 5. lock-across-device-call
 
 
@@ -574,6 +646,7 @@ ALL_RULES: tuple = (
     JitInLoop(),
     NonhashableStaticArg(),
     SwallowedException(),
+    TransportErrorSwallowed(),
     LockAcrossDeviceCall(),
     Float64LiteralDrift(),
     SuppressionMissingReason(),

@@ -4,10 +4,18 @@ Reference: ``cluster/`` (raft store, router, replication engine) +
 ``usecases/replica`` (coordinator/finder/repairer) + ``usecases/sharding``.
 """
 
+from weaviate_tpu.cluster.chaos import ChaosTransport, LinkFaults
 from weaviate_tpu.cluster.fsm import SchemaFSM
 from weaviate_tpu.cluster.hashtree import HashTree
 from weaviate_tpu.cluster.node import ClusterNode, ReplicationError
 from weaviate_tpu.cluster.raft import NotLeader, RaftNode
+from weaviate_tpu.cluster.resilience import (
+    BreakerBoard,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+)
 from weaviate_tpu.cluster.sharding import (
     ShardingState,
     required_acks,
@@ -23,4 +31,6 @@ __all__ = [
     "ClusterNode", "ReplicationError", "RaftNode", "NotLeader", "SchemaFSM",
     "HashTree", "ShardingState", "shard_for_uuid", "required_acks",
     "InProcTransport", "TcpTransport", "TransportError",
+    "ChaosTransport", "LinkFaults", "RetryPolicy", "Deadline",
+    "DeadlineExceeded", "CircuitBreaker", "BreakerBoard",
 ]

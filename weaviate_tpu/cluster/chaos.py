@@ -1,0 +1,197 @@
+"""ChaosTransport: seeded, per-link fault injection over any transport.
+
+The reference proves its replica coordinator against real network misery
+(compose acceptance suites kill containers and partition networks); our
+in-process equivalent needs the same vocabulary. ``ChaosTransport`` wraps
+any transport honoring the start/send/stop contract (``InProcTransport``,
+``TcpTransport``, or the worker's ``CtlTransport``) and applies a
+per-destination-link fault program on the OUTBOUND path:
+
+- ``drop``      — probability a send raises ``TransportError`` instead of
+                  being delivered (the message never reaches the peer);
+- ``fail_reply``— probability the message IS delivered but the reply is
+                  lost (the dangerous half-failure: state changed, caller
+                  sees an error — exercises commit/abort idempotency);
+- ``latency`` + ``jitter`` — fixed plus uniform-random injected delay;
+- ``partition`` — one-way blackhole (this node -> peer); the reverse
+                  direction is programmed on the peer's own wrapper, so
+                  asymmetric partitions compose naturally;
+- ``duplicate`` — probability the message is delivered twice (first
+                  reply wins — models at-least-once networks);
+- ``types``     — message-type scope: ``None`` faults every message, a
+                  set like ``{"replica_prepare"}`` faults only those,
+                  leaving raft/gossip control traffic clean.
+
+Every fired fault increments ``weaviate_tpu_chaos_faults_total`` so a
+chaos run's pressure is observable next to the resilience counters it is
+supposed to exercise. All randomness comes from one ``random.Random``
+seeded at construction: a chaos test's fault schedule is reproducible
+from its seed alone.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from weaviate_tpu.cluster.transport import TransportError
+from weaviate_tpu.monitoring.metrics import CHAOS_FAULTS
+
+logger = logging.getLogger("weaviate_tpu.cluster.chaos")
+
+
+@dataclass
+class LinkFaults:
+    """Fault program for one outbound link (or the default for all)."""
+
+    drop: float = 0.0
+    fail_reply: float = 0.0
+    latency: float = 0.0
+    jitter: float = 0.0
+    partition: bool = False
+    duplicate: float = 0.0
+    types: Optional[frozenset] = None  # None = every message type
+
+    def applies_to(self, msg_type: str) -> bool:
+        return self.types is None or msg_type in self.types
+
+
+class ChaosTransport:
+    """Composable fault-injecting wrapper; transparent when unprogrammed."""
+
+    def __init__(self, inner, seed: int = 0,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.inner = inner
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self._links: dict[str, list[LinkFaults]] = {}
+        self._default: list[LinkFaults] = []
+        self._lock = threading.Lock()
+
+    # -- transport contract --------------------------------------------------
+    @property
+    def node_id(self) -> str:
+        return self.inner.node_id
+
+    def start(self, handler) -> None:
+        self.inner.start(handler)
+
+    def stop(self) -> None:
+        self.inner.stop()
+
+    def send(self, peer: str, msg: dict, timeout: float = 1.0) -> dict:
+        mtype = str(msg.get("type", ""))
+        with self._lock:
+            programs = [f for f in
+                        self._links.get(peer, []) + self._default
+                        if f.applies_to(mtype)]
+            # one rng draw per decision, under the lock: concurrent senders
+            # (raft pipelines vs data plane) see a deterministic TOTAL
+            # schedule per seed even though interleaving varies
+            decisions = [(f,
+                          self._rng.random(),   # drop roll
+                          self._rng.random(),   # duplicate roll
+                          self._rng.random(),   # fail_reply roll
+                          self._rng.uniform(0.0, f.jitter) if f.jitter else 0.0)
+                         for f in programs]
+        delay = 0.0
+        duplicate = False
+        for f, roll, dup_roll, _reply_roll, jit in decisions:
+            if f.partition:
+                CHAOS_FAULTS.inc(kind="partition", link=f"{self.node_id}->{peer}")
+                raise TransportError(
+                    f"chaos: {self.node_id} -> {peer} partitioned")
+            if f.drop and roll < f.drop:
+                CHAOS_FAULTS.inc(kind="drop", link=f"{self.node_id}->{peer}")
+                raise TransportError(
+                    f"chaos: {self.node_id} -> {peer} dropped {mtype!r}")
+            delay += f.latency + jit
+            if f.duplicate and dup_roll < f.duplicate:
+                duplicate = True
+        if delay > 0.0:
+            CHAOS_FAULTS.inc(kind="delay", link=f"{self.node_id}->{peer}")
+            self._sleep(delay)
+        reply = self.inner.send(peer, msg, timeout=timeout)
+        if duplicate:
+            CHAOS_FAULTS.inc(kind="duplicate", link=f"{self.node_id}->{peer}")
+            try:
+                self.inner.send(peer, msg, timeout=timeout)
+            except TransportError:
+                # the duplicate is best-effort noise by definition
+                logger.debug("chaos duplicate to %s lost", peer)
+        for f, _roll, _dup, reply_roll, _jit in decisions:
+            if f.fail_reply and reply_roll < f.fail_reply:
+                CHAOS_FAULTS.inc(kind="fail_reply",
+                                 link=f"{self.node_id}->{peer}")
+                raise TransportError(
+                    f"chaos: {self.node_id} -> {peer} reply lost for "
+                    f"{mtype!r}")
+        return reply
+
+    # -- fault programming ---------------------------------------------------
+    def program(self, peer: Optional[str] = None, **kwargs) -> LinkFaults:
+        """Add a fault program for ``peer`` (None = every link). ``types``
+        may be any iterable of message-type strings. Returns the installed
+        program so a test can keep a handle for later removal."""
+        types = kwargs.pop("types", None)
+        if types is not None:
+            kwargs["types"] = frozenset(types)
+        f = LinkFaults(**kwargs)
+        with self._lock:
+            (self._default if peer is None
+             else self._links.setdefault(peer, [])).append(f)
+        return f
+
+    def clear(self, peer: Optional[str] = None) -> None:
+        """Heal: remove all programs for ``peer``, or every program."""
+        with self._lock:
+            if peer is None:
+                self._links.clear()
+                self._default.clear()
+            else:
+                self._links.pop(peer, None)
+
+    def partition(self, peer: str) -> LinkFaults:
+        """Convenience: one-way blackhole this node -> peer."""
+        return self.program(peer, partition=True)
+
+    def heal(self, peer: Optional[str] = None) -> None:
+        self.clear(peer)
+
+    def links(self) -> dict[str, list[LinkFaults]]:
+        with self._lock:
+            out = {p: list(fs) for p, fs in self._links.items()}
+            if self._default:
+                out["*"] = list(self._default)
+            return out
+
+
+def parse_chaos_spec(spec: str) -> list[tuple[Optional[str], dict]]:
+    """Parse the worker's ``--chaos`` flag: semicolon-separated programs,
+    each ``[peer|*]:key=val,key=val``. Example::
+
+        *:drop=0.05,jitter=0.02;10.0.0.3:7101:partition=1
+
+    Returns ``(peer_or_None, kwargs)`` tuples for ``ChaosTransport.program``.
+    """
+    out: list[tuple[Optional[str], dict]] = []
+    for part in (p.strip() for p in spec.split(";") if p.strip()):
+        target, _, prog = part.rpartition(":")
+        if not target:
+            raise ValueError(
+                f"chaos spec {part!r} needs '<peer|*>:<k=v,...>'")
+        kwargs: dict = {}
+        for kv in (s.strip() for s in prog.split(",") if s.strip()):
+            k, _, v = kv.partition("=")
+            if k == "partition":
+                kwargs[k] = v not in ("", "0", "false")
+            elif k == "types":
+                kwargs[k] = frozenset(v.split("+"))
+            else:
+                kwargs[k] = float(v)
+        out.append((None if target == "*" else target, kwargs))
+    return out

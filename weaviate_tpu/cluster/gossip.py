@@ -121,11 +121,19 @@ class Gossip:
         return [n for n in (set(self.peers_fn()) | {self.id})
                 if self.alive(n)]
 
-    def order_by_liveness(self, nodes: list[str]) -> list[str]:
+    def order_by_liveness(self, nodes: list[str],
+                          extra_rank=None) -> list[str]:
         """Stable sort: ALIVE first, then SUSPECT, then DEAD — readers try
-        healthy replicas before burning timeouts on dead ones."""
+        healthy replicas before burning timeouts on dead ones.
+        ``extra_rank(node) -> int`` breaks ties within a liveness class
+        (the data plane passes the circuit-breaker rank, so a peer this
+        node keeps failing against sorts behind a clean one even while
+        gossip still calls both ALIVE)."""
         rank = {ALIVE: 0, SUSPECT: 1, DEAD: 2}
-        return sorted(nodes, key=lambda n: rank[self.status(n)])
+        if extra_rank is None:
+            return sorted(nodes, key=lambda n: rank[self.status(n)])
+        return sorted(nodes,
+                      key=lambda n: (rank[self.status(n)], extra_rank(n)))
 
     def members(self) -> dict[str, str]:
         nodes = set(self.peers_fn()) | {self.id}

@@ -19,9 +19,12 @@ port; the mux here keeps the same separation by message type).
 from __future__ import annotations
 
 import logging
+import queue
+import random
 import threading
 import time
 import uuid as uuidlib
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -29,6 +32,13 @@ import numpy as np
 from weaviate_tpu.cluster.fsm import SchemaFSM
 from weaviate_tpu.cluster.hashtree import HashTree, bucket_of
 from weaviate_tpu.cluster.raft import RaftNode
+from weaviate_tpu.cluster.resilience import (
+    BreakerBoard,
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    retrying_call,
+)
 from weaviate_tpu.cluster.sharding import (
     ShardingState,
     required_acks,
@@ -36,10 +46,20 @@ from weaviate_tpu.cluster.sharding import (
 )
 from weaviate_tpu.cluster.transport import TransportError
 from weaviate_tpu.core.db import DB
+from weaviate_tpu.monitoring.metrics import (
+    REPLICA_REPAIRS,
+    RPC_DURATION,
+    RPC_FAILURES,
+    STAGING_ABORTED,
+)
 from weaviate_tpu.schema.config import CollectionConfig
 from weaviate_tpu.storage.objects import StorageObject
 
 logger = logging.getLogger("weaviate_tpu.cluster")
+
+# exceptions a replica attempt may surface without failing the whole
+# coordinator operation (the per-replica isolation boundary)
+_REPLICA_ERRORS = (TransportError, DeadlineExceeded)
 
 RAFT_TYPES = {"request_vote", "append_entries", "install_snapshot",
               "forward_apply"}
@@ -66,16 +86,58 @@ class ReplicationError(RuntimeError):
 
 
 class ClusterNode:
+    # width of the node's shared RPC worker pool: bounds TOTAL in-flight
+    # replica fan-out across all concurrent operations (replica sets are
+    # small — typically ≤ factor — so this comfortably overlaps ~10 ops;
+    # a saturated pool queues work instead of spawning threads)
+    POOL_WORKERS = 32
+    # budget for the 2PC finish leg (commit/abort AFTER a quorum of
+    # prepares): deliberately generous — the quorum is already promised,
+    # and a replica's first-touch apply (shard + index creation, cold XLA
+    # compile) can dwarf a data-plane RPC. Dead peers still fail fast
+    # (connection error / breaker), so this never stalls the fault path.
+    FINISH_BUDGET = 10.0
+
     def __init__(self, node_id: str, peers: list[str], transport,
-                 data_dir: str, heartbeat: bool = True):
+                 data_dir: str, heartbeat: bool = True,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breakers: Optional[BreakerBoard] = None,
+                 op_budget: float = 3.0, rpc_timeout: float = 1.0,
+                 staging_ttl: float = 30.0):
         self.id = node_id
         self.all_nodes = sorted(set(peers) | {node_id})
         self.transport = transport
+        # RPC resilience policy stack (see cluster/resilience.py): the
+        # per-operation budget bounds the WHOLE coordinator op, the
+        # per-attempt timeout bounds one socket exchange, breakers
+        # isolate per-peer failure so one dead replica cannot serialize
+        # the fan-out behind its timeouts
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breakers = breakers or BreakerBoard()
+        self.op_budget = op_budget
+        self.rpc_timeout = rpc_timeout
+        self.staging_ttl = staging_ttl
+        self._rpc_rng = random.Random(f"rpc:{node_id}")
+        # one persistent pool for all replica fan-out / scatter work
+        # (same pattern as core/collection.py): per-request thread spawn
+        # on the hot path would be pure churn
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.POOL_WORKERS,
+            thread_name_prefix=f"cluster-{node_id}")
         self.db = DB(f"{data_dir}/db")
         self.fsm = SchemaFSM(self.db)
         self._raft_handler: Optional[Callable] = None
         self._staging: dict[str, dict] = {}
         self._staging_lock = threading.Lock()
+        # outcome ledger for finished 2PC transactions: a commit RETRY
+        # (reply lost, stale socket, attempt timeout racing a slow apply)
+        # must be answerable truthfully instead of "unknown txid" — an
+        # applied commit re-acked, a swept/aborted one re-refused
+        self._tx_done: dict[str, str] = {}
+        # commits mid-apply: a duplicate delivery waits for the first to
+        # finish instead of reading "unknown txid" out of the gap between
+        # the staging pop and the ledger write
+        self._tx_inflight: dict[str, threading.Event] = {}
         # deletion tombstones for anti-entropy resolution:
         # (class, shard) -> {uuid: delete_time_ms}
         self._tombstones: dict[tuple[str, int], dict[str, int]] = {}
@@ -263,13 +325,18 @@ class ClusterNode:
                 node_id=self.id,
                 state_fn=self._state_for,
                 live_fn=lambda: set(self.gossip.live_nodes()),
+                rank_fn=self.breakers.rank,
             )
             self._router = r
         return r
 
     def _ordered(self, replicas: list[str]) -> list[str]:
-        """Live replicas first so reads don't burn timeouts on dead peers."""
-        return self.gossip.order_by_liveness(replicas)
+        """Live replicas first so reads don't burn timeouts on dead peers;
+        breaker state breaks ties (an ALIVE peer whose circuit is open —
+        e.g. a flaky link this node keeps failing against — sorts after a
+        healthy one)."""
+        return self.gossip.order_by_liveness(replicas,
+                                             extra_rank=self.breakers.rank)
 
     def _local_shard(self, cls: str, shard: int, tenant: str = ""):
         col = self.db.get_collection(cls)
@@ -278,9 +345,170 @@ class ClusterNode:
         return col._get_shard(f"shard{shard}")
 
     def _send(self, peer: str, msg: dict, timeout: float = 3.0) -> dict:
+        """Bare one-shot RPC (no retry/breaker): control-plane and
+        movement paths that carry their own convergence loops."""
         if peer == self.id:
             return self._dispatch(msg)
         return self.transport.send(peer, msg, timeout=timeout)
+
+    def _call(self, peer: str, msg: dict, *, deadline: Deadline,
+              timeout: Optional[float] = None) -> dict:
+        """Policy-wrapped RPC for the replication data plane: breaker
+        fail-fast, jittered-backoff retries on transport faults, every
+        attempt's timeout clamped to the operation deadline."""
+        if peer == self.id:
+            return self._dispatch(msg)
+        timeout = self.rpc_timeout if timeout is None else timeout
+        mtype = str(msg.get("type", ""))
+        breaker = self.breakers.get(peer)
+        start = time.monotonic()
+
+        def attempt(attempt_timeout: float) -> dict:
+            if not breaker.allow():
+                RPC_FAILURES.inc(peer=peer, kind="breaker_open")
+                raise TransportError(f"-> {peer}: circuit open")
+            try:
+                r = self.transport.send(peer, msg, timeout=attempt_timeout)
+            except TransportError:
+                breaker.record_failure()
+                raise
+            except Exception as e:
+                # InProc delivery surfaces peer handler bugs raw; to this
+                # node that IS a failed replica attempt — normalize it so
+                # the breaker can't leak its half-open probe and the
+                # fan-out accounting always sees a result
+                breaker.record_failure()
+                raise TransportError(
+                    f"-> {peer}: {type(e).__name__}: {e}") from e
+            breaker.record_success()
+            return r
+
+        try:
+            return retrying_call(
+                attempt, peer=peer, policy=self.retry_policy,
+                deadline=deadline, timeout=timeout, rng=self._rpc_rng,
+                retry_on=(TransportError,), msg_type=mtype)
+        except TransportError:
+            RPC_FAILURES.inc(peer=peer, kind="transport")
+            raise
+        finally:
+            RPC_DURATION.observe(time.monotonic() - start, msg_type=mtype)
+
+    def _fan_out(self, replicas: list[str], payload: dict, *, need: int,
+                 deadline: Deadline, timeout: Optional[float] = None,
+                 ok: Callable[[dict], bool] = lambda r: bool(r.get("ok")),
+                 on_late: Optional[Callable[[str, dict], None]] = None,
+                 linger: float = 0.0,
+                 ) -> tuple[list[tuple[str, dict]], list[str]]:
+        """Concurrent replica fan-out with quorum short-circuit.
+
+        Sends ``payload`` to every replica through a bounded worker pool,
+        collects replies as they land, and returns ``(acked, errors)`` as
+        soon as ``need`` acks arrive, every replica has answered, or the
+        deadline is spent. In-flight stragglers are not cancelled (a
+        blocking send cannot be): a straggler's SUCCESSFUL reply is handed
+        to ``on_late`` from the worker thread, so 2PC can still commit or
+        abort a replica that prepared after the coordinator stopped
+        waiting.
+
+        ``linger`` bounds a post-quorum grace: with healthy replicas the
+        remaining acks land within microseconds, and draining them keeps
+        the write synchronous on EVERY replica (no anti-entropy debt); a
+        slow or dead straggler costs at most ``linger`` seconds."""
+        results: queue.Queue = queue.Queue()
+        done = threading.Event()
+        # closes the check-then-put race: done is only set while holding
+        # this lock, so every result enqueued before the flag flips is in
+        # the queue when the post-done drain runs — a reply can be early
+        # or late, never lost
+        hand_off = threading.Lock()
+
+        def attempt_one(peer: str) -> None:
+            reply: dict = {}
+            try:
+                reply = self._call(peer, payload, deadline=deadline,
+                                   timeout=timeout)
+                good = ok(reply)
+                err = None if good else str(reply.get("error"))
+            except _REPLICA_ERRORS as e:
+                good, err = False, str(e)
+            except Exception as e:  # a lost slot would stall the whole op
+                logger.exception("fan-out leg to %s raised", peer)
+                good, err = False, f"{type(e).__name__}: {e}"
+            with hand_off:
+                late = done.is_set()
+                if not late:
+                    results.put((peer, reply, good, err))
+            if late and good and on_late is not None:
+                on_late(peer, reply)
+
+        for rep in replicas:
+            self._pool.submit(attempt_one, rep)
+
+        acked: list[tuple[str, dict]] = []
+        errors: list[str] = []
+        pending = len(replicas)
+        linger_until: Optional[float] = None
+        while pending:
+            wait = deadline.remaining()
+            if len(acked) >= need:
+                if linger <= 0:
+                    break
+                if linger_until is None:  # quorum just landed
+                    linger_until = time.monotonic() + linger
+                wait = min(wait, linger_until - time.monotonic())
+            if wait <= 0:
+                break
+            try:
+                peer, reply, good, err = results.get(timeout=wait)
+            except queue.Empty:
+                break
+            pending -= 1
+            if good:
+                acked.append((peer, reply))
+            else:
+                errors.append(f"{peer}: {err}")
+        with hand_off:
+            done.set()
+        # drain results that raced the done flag: they count toward the
+        # quorum if it is still short, otherwise they are late arrivals.
+        # on_late may block (2PC waits for the coordinator's decision), so
+        # it must never run on the caller's thread.
+        while True:
+            try:
+                peer, reply, good, err = results.get_nowait()
+            except queue.Empty:
+                break
+            if good and len(acked) < need:
+                acked.append((peer, reply))
+            elif good and on_late is not None:
+                self._pool.submit(on_late, peer, reply)
+            elif not good:
+                errors.append(f"{peer}: {err}")
+        return acked, errors
+
+    def _parallel_map(self, fn: Callable[[Any], Any], items: list,
+                      ) -> list[Any]:
+        """Run ``fn(item)`` for every item through the bounded pool and
+        return all results (order-matched to ``items``); exceptions
+        re-raise in the caller after every worker finished."""
+        if not items:
+            return []
+        if len(items) == 1:  # skip pool overhead for the common case
+            return [fn(items[0])]
+        futures = [self._pool.submit(fn, item) for item in items]
+        out: list[Any] = []
+        first_err: Optional[BaseException] = None
+        for f in futures:
+            try:
+                out.append(f.result())
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                out.append(None)
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return out
 
     # -- write path: 2PC ---------------------------------------------------
     def put_batch(self, cls: str, objs: list[StorageObject],
@@ -302,45 +530,102 @@ class ClusterNode:
             by_shard.setdefault(
                 shard_for_uuid(o.uuid, state.n_shards), []).append(o)
 
+        deadline = Deadline(self.op_budget, op="put_batch")
         for shard, group in by_shard.items():
-            replicas = state.replicas(shard)
+            replicas = self._ordered(state.replicas(shard))
             txid = str(uuidlib.uuid4())
             payload = {
                 "type": "replica_prepare", "txid": txid, "class": cls,
                 "tenant": tenant, "shard": shard,
                 "objects": [o.to_bytes() for o in group],
             }
-            acked: list[str] = []
-            errors: list[str] = []
-            for rep in replicas:
+            # decision shared with late-preparing stragglers: a replica
+            # whose prepare-ack lands after the quorum short-circuit still
+            # gets its commit (or abort) from the fan-out worker itself
+            decided = threading.Event()
+            decision = {"outcome": "abort"}
+
+            def finish(rep: str, txid=txid, decision=decision,
+                       decided=decided) -> bool:
+                decided.wait(timeout=self.op_budget)
+                msg = {"type": f"replica_{decision['outcome']}",
+                       "txid": txid}
+                budget = max(self.op_budget, self.FINISH_BUDGET)
                 try:
-                    r = self._send(rep, payload)
-                    if r.get("ok"):
-                        acked.append(rep)
-                    else:
-                        errors.append(f"{rep}: {r.get('error')}")
-                except TransportError as e:
-                    errors.append(f"{rep}: {e}")
+                    # full budget per attempt: timing out a commit that is
+                    # mid-apply just to retry it buys nothing
+                    r = self._call(rep, msg,
+                                   deadline=Deadline(budget,
+                                                     op="2pc_finish"),
+                                   timeout=budget)
+                except _REPLICA_ERRORS:
+                    # staging TTL sweep aborts the orphan; anti-entropy
+                    # heals a missed commit
+                    logger.warning("2PC %s to %s failed for tx %s",
+                                   decision["outcome"], rep, txid)
+                    return False
+                if not r.get("ok"):
+                    RPC_FAILURES.inc(peer=rep, kind="commit_rejected")
+                    logger.warning("2PC %s on %s rejected for tx %s: %s",
+                                   decision["outcome"], rep, txid,
+                                   r.get("error"))
+                    return False
+                return True
+
+            acked, errors = self._fan_out(
+                replicas, payload, need=need, deadline=deadline,
+                on_late=lambda rep, _r, finish=finish: finish(rep),
+                linger=0.05)
             if len(acked) < need:
-                for rep in acked:
-                    try:
-                        self._send(rep, {"type": "replica_abort",
-                                         "txid": txid})
-                    except TransportError:
-                        pass
+                decided.set()  # decision stays "abort"
+                self._parallel_map(lambda rep: finish(rep),
+                                   [rep for rep, _ in acked])
                 raise ReplicationError(
                     f"shard {shard}: {len(acked)}/{need} acks "
                     f"(consistency {consistency}); errors: {errors}")
-            for rep in acked:
-                try:
-                    self._send(rep, {"type": "replica_commit", "txid": txid})
-                except TransportError:
-                    pass  # healed later by anti-entropy
+            decision["outcome"] = "commit"
+            decided.set()
+            committed = sum(self._parallel_map(
+                lambda rep: finish(rep), [rep for rep, _ in acked]))
+            if committed < need:
+                # the quorum PROMISED by the prepares did not materialize
+                # (e.g. a replica whose commit was rejected): reporting
+                # success here would be a silent lost write — surface it,
+                # the TTL sweep aborts the leftover staging entries
+                raise ReplicationError(
+                    f"shard {shard}: only {committed}/{need} replicas "
+                    f"committed (consistency {consistency})")
         return [o.uuid for o in objs]
+
+    def sweep_staging(self, ttl: Optional[float] = None) -> int:
+        """Abort 2PC staging entries older than the TTL — the orphan left
+        when a coordinator dies (or stops waiting) between prepare and
+        commit. Without the sweep every such entry pins its object blobs
+        forever. Returns the number of entries aborted."""
+        ttl = self.staging_ttl if ttl is None else ttl
+        now = time.monotonic()
+        with self._staging_lock:
+            expired = [txid for txid, st in self._staging.items()
+                       if now - st["staged_at"] >= ttl]
+            for txid in expired:
+                del self._staging[txid]
+        for txid in expired:
+            self._record_tx(txid, "abort")
+            STAGING_ABORTED.inc(reason="ttl")
+            logger.warning(
+                "aborted orphaned 2PC staging entry %s after %.1fs "
+                "(coordinator lost between prepare and commit)", txid, ttl)
+        return len(expired)
 
     def _on_replica_prepare(self, msg: dict) -> dict:
         if (msg["class"], msg["shard"], msg.get("tenant", "")) in self._frozen:
             return {"ok": False, "error": "shard frozen (moving)"}
+        if not self.db.has_collection(msg["class"]):
+            # raft schema replication hasn't landed here yet: refuse now
+            # (cheap, retried by the coordinator) rather than ack a
+            # prepare whose commit would fail after quorum was promised
+            return {"ok": False, "error": "unknown collection (schema lag)"}
+        self.sweep_staging()  # opportunistic: every prepare pays the rent
         objs = [StorageObject.from_bytes(b) for b in msg["objects"]]
         with self._staging_lock:
             self._staging[msg["txid"]] = {
@@ -350,23 +635,67 @@ class ClusterNode:
             }
         return {"ok": True}
 
-    def _on_replica_commit(self, msg: dict) -> dict:
+    _TX_LEDGER_MAX = 4096
+
+    def _record_tx(self, txid: str, outcome: str) -> None:
         with self._staging_lock:
-            st = self._staging.pop(msg["txid"], None)
+            self._tx_done[txid] = outcome
+            while len(self._tx_done) > self._TX_LEDGER_MAX:
+                self._tx_done.pop(next(iter(self._tx_done)))
+
+    def _on_replica_commit(self, msg: dict) -> dict:
+        txid = msg["txid"]
+        with self._staging_lock:
+            st = self._staging.pop(txid, None)
+            prior = self._tx_done.get(txid)
+            inflight = self._tx_inflight.get(txid)
+            if st is not None:
+                inflight = self._tx_inflight[txid] = threading.Event()
         if st is None:
+            if inflight is not None and prior is None:
+                # duplicate racing the first delivery's (possibly slow)
+                # apply: wait for the outcome instead of guessing
+                inflight.wait(self.FINISH_BUDGET)
+                with self._staging_lock:
+                    prior = self._tx_done.get(txid)
+            if prior == "commit":  # duplicate delivery / retried commit
+                return {"ok": True, "duplicate": True}
+            if prior == "abort":
+                return {"ok": False, "error": "transaction aborted"}
             return {"ok": False, "error": "unknown txid"}
-        shard = self._local_shard(st["class"], st["shard"], st["tenant"])
-        shard.put_batch(st["objects"])
-        key = (st["class"], st["shard"])
-        tomb = self._tombstones.get(key)
-        if tomb:
-            for o in st["objects"]:
-                tomb.pop(o.uuid, None)
-        return {"ok": True}
+        try:
+            # a LATE commit (quorum short-circuited, this ack a straggler)
+            # may arrive after a replica move routed this shard away;
+            # applying would resurrect the dropped copy outside routing
+            if self.id not in self._state_for(
+                    st["class"]).replicas(st["shard"]):
+                STAGING_ABORTED.inc(reason="not_replica")
+                self._record_tx(txid, "abort")
+                logger.warning("discarding commit for tx %s: no longer a "
+                               "replica of %s/shard%s", txid,
+                               st["class"], st["shard"])
+                return {"ok": False, "error": "no longer a replica"}
+            shard = self._local_shard(st["class"], st["shard"], st["tenant"])
+            shard.put_batch(st["objects"])
+            key = (st["class"], st["shard"])
+            tomb = self._tombstones.get(key)
+            if tomb:
+                for o in st["objects"]:
+                    tomb.pop(o.uuid, None)
+            self._record_tx(txid, "commit")
+            return {"ok": True}
+        finally:
+            with self._staging_lock:
+                ev = self._tx_inflight.pop(txid, None)
+            if ev is not None:
+                ev.set()
 
     def _on_replica_abort(self, msg: dict) -> dict:
         with self._staging_lock:
-            self._staging.pop(msg["txid"], None)
+            dropped = self._staging.pop(msg["txid"], None)
+        if dropped is not None:
+            STAGING_ABORTED.inc(reason="abort")
+            self._record_tx(msg["txid"], "abort")
         return {"ok": True}
 
     # -- delete ------------------------------------------------------------
@@ -380,25 +709,21 @@ class ClusterNode:
         for u in uuids:
             by_shard.setdefault(shard_for_uuid(u, state.n_shards), []).append(u)
         deleted = 0
+        deadline = Deadline(self.op_budget, op="delete")
         for shard, group in by_shard.items():
-            acks = 0
-            counts = []
-            for rep in state.replicas(shard):
-                try:
-                    r = self._send(rep, {
-                        "type": "replica_delete", "class": cls,
-                        "tenant": tenant, "shard": shard, "uuids": group,
-                        "time_ms": now,
-                    })
-                    if "deleted" in r:
-                        acks += 1
-                        counts.append(r["deleted"])
-                except TransportError:
-                    pass
-            if acks < need:
+            acked, errors = self._fan_out(
+                self._ordered(state.replicas(shard)), {
+                    "type": "replica_delete", "class": cls,
+                    "tenant": tenant, "shard": shard, "uuids": group,
+                    "time_ms": now,
+                },
+                need=need, deadline=deadline,
+                ok=lambda r: "deleted" in r, linger=0.05)
+            if len(acked) < need:
                 raise ReplicationError(
-                    f"delete shard {shard}: {acks}/{need} acks")
-            deleted += max(counts) if counts else 0
+                    f"delete shard {shard}: {len(acked)}/{need} acks; "
+                    f"errors: {errors}")
+            deleted += max(r["deleted"] for _, r in acked)
         return deleted
 
     def _on_replica_delete(self, msg: dict) -> dict:
@@ -419,18 +744,9 @@ class ClusterNode:
         shard, _ = state.shard_replicas_for_uuid(uuid)
         replicas = self._ordered(state.read_replicas(shard))
         need = required_acks(consistency, min(state.factor, len(replicas)))
-        digests: dict[str, Optional[int]] = {}
-        for rep in replicas:
-            if len(digests) >= need:
-                break
-            try:
-                r = self._send(rep, {
-                    "type": "object_digest", "class": cls, "tenant": tenant,
-                    "shard": shard, "uuids": [uuid],
-                })
-                digests[rep] = r["digests"][0]
-            except (TransportError, KeyError):
-                continue
+        deadline = Deadline(self.op_budget, op="get")
+        digests = self._digest_quorum(cls, tenant, shard, uuid, replicas,
+                                      need, deadline)
         if len(digests) < need:
             raise ReplicationError(
                 f"get: {len(digests)}/{need} replicas answered")
@@ -440,34 +756,58 @@ class ClusterNode:
             if v is None:
                 return None
             return self._fetch_one(cls, tenant, shard, uuid,
-                                   list(digests.keys()))
+                                   list(digests.keys()), deadline=deadline)
         # divergence: fetch all copies, newest wins, repair stale replicas
+        fetched, fetch_errs = self._fan_out(
+            list(digests), {
+                "type": "object_fetch", "class": cls, "tenant": tenant,
+                "shard": shard, "uuids": [uuid],
+            },
+            need=len(digests), deadline=deadline,
+            ok=lambda r: "objects" in r)
+        if not fetched:
+            # a quorum of digests confirmed a version exists; answering
+            # None here would read a spent deadline as a deleted object
+            raise ReplicationError(
+                f"get: no replica answered the divergent fetch for "
+                f"{uuid}; errors: {fetch_errs}")
         best: Optional[StorageObject] = None
-        for rep in digests:
-            try:
-                r = self._send(rep, {
-                    "type": "object_fetch", "class": cls, "tenant": tenant,
-                    "shard": shard, "uuids": [uuid],
-                })
-                blob = r["objects"][0]
-                if blob is not None:
-                    o = StorageObject.from_bytes(blob)
-                    if best is None or o.update_time_ms > best.update_time_ms:
-                        best = o
-            except (TransportError, KeyError):
-                continue
+        for _rep, r in fetched:
+            blob = r["objects"][0]
+            if blob is not None:
+                o = StorageObject.from_bytes(blob)
+                if best is None or o.update_time_ms > best.update_time_ms:
+                    best = o
         if best is not None:
             payload = {
                 "type": "object_push", "class": cls, "tenant": tenant,
                 "shard": shard, "objects": [best.to_bytes()],
             }
-            for rep, v in digests.items():
-                if v != best.update_time_ms:
-                    try:
-                        self._send(rep, payload)
-                    except TransportError:
-                        pass
+            stale = [rep for rep, v in digests.items()
+                     if v != best.update_time_ms]
+            for rep in stale:
+                try:
+                    self._call(rep, payload, deadline=deadline)
+                    REPLICA_REPAIRS.inc(path="read_repair")
+                except _REPLICA_ERRORS:
+                    logger.warning("read-repair push to %s failed for %s",
+                                   rep, uuid)
         return best
+
+    def _digest_quorum(self, cls: str, tenant: str, shard: int, uuid: str,
+                       replicas: list[str], need: int,
+                       deadline: Deadline) -> dict[str, Optional[int]]:
+        """Version digests from the first ``need`` replicas to answer —
+        the whole read set is asked concurrently, so a dead or slow
+        replica costs nothing as long as a quorum is healthy."""
+        acked, _ = self._fan_out(
+            replicas, {
+                "type": "object_digest", "class": cls, "tenant": tenant,
+                "shard": shard, "uuids": [uuid],
+            },
+            need=need, deadline=deadline,
+            ok=lambda r: "digests" in r)
+        return {rep: r["digests"][0] for rep, r in acked}
 
     def exists(self, cls: str, uuid: str, tenant: str = "",
                consistency: str = "QUORUM") -> bool:
@@ -479,18 +819,10 @@ class ClusterNode:
         shard, _ = state.shard_replicas_for_uuid(uuid)
         replicas = self._ordered(state.read_replicas(shard))
         need = required_acks(consistency, min(state.factor, len(replicas)))
-        digests: list[Optional[int]] = []
-        for rep in replicas:
-            if len(digests) >= need:
-                break
-            try:
-                r = self._send(rep, {
-                    "type": "object_digest", "class": cls, "tenant": tenant,
-                    "shard": shard, "uuids": [uuid],
-                })
-                digests.append(r["digests"][0])
-            except (TransportError, KeyError):
-                continue
+        deadline = Deadline(self.op_budget, op="exists")
+        by_rep = self._digest_quorum(cls, tenant, shard, uuid, replicas,
+                                     need, deadline)
+        digests = list(by_rep.values())
         if len(digests) < need:
             raise ReplicationError(
                 f"exists: {len(digests)}/{need} replicas answered")
@@ -504,18 +836,28 @@ class ClusterNode:
         return self.get(cls, uuid, tenant=tenant,
                         consistency=consistency) is not None
 
-    def _fetch_one(self, cls, tenant, shard, uuid, replicas):
-        for rep in replicas:
-            try:
-                r = self._send(rep, {
-                    "type": "object_fetch", "class": cls, "tenant": tenant,
-                    "shard": shard, "uuids": [uuid],
-                })
-                blob = r["objects"][0]
-                return None if blob is None else StorageObject.from_bytes(blob)
-            except (TransportError, KeyError):
-                continue
-        return None
+    def _fetch_one(self, cls, tenant, shard, uuid, replicas,
+                   deadline: Optional[Deadline] = None):
+        """Hedged single-object fetch: ask every candidate replica
+        concurrently, first well-formed reply wins (they agreed on the
+        digest, so any copy is the right copy). Raises when NO replica
+        answers — the callers hold a digest quorum saying the object
+        exists, so a fetch shortfall must not read as deletion."""
+        if deadline is None:
+            deadline = Deadline(self.op_budget, op="fetch_one")
+        acked, errors = self._fan_out(
+            replicas, {
+                "type": "object_fetch", "class": cls, "tenant": tenant,
+                "shard": shard, "uuids": [uuid],
+            },
+            need=1, deadline=deadline,
+            ok=lambda r: "objects" in r)
+        for _rep, r in acked:
+            blob = r["objects"][0]
+            return None if blob is None else StorageObject.from_bytes(blob)
+        raise ReplicationError(
+            f"get: no replica answered the fetch for {uuid}; "
+            f"errors: {errors}")
 
     def _on_object_digest(self, msg: dict) -> dict:
         shard = self._local_shard(msg["class"], msg["shard"],
@@ -534,6 +876,24 @@ class ClusterNode:
             o = shard.get_by_uuid(u)
             out.append(None if o is None else o.to_bytes())
         return {"objects": out}
+
+    def _on_tombstone_push(self, msg: dict) -> dict:
+        """Apply delete tombstones from a peer (anti-entropy): a replica
+        that missed a delete drops its stale copy instead of keeping it
+        forever (and re-offering it every hashBeat round)."""
+        shard = self._local_shard(msg["class"], msg["shard"],
+                                  msg.get("tenant", ""))
+        tomb = self._tombstones.setdefault(
+            (msg["class"], msg["shard"]), {})
+        removed = 0
+        for u, t in msg["tombs"]:
+            if tomb.get(u, 0) < t:
+                tomb[u] = t
+            o = shard.get_by_uuid(u)
+            if o is not None and o.update_time_ms <= t:
+                shard.delete([u])
+                removed += 1
+        return {"removed": removed}
 
     def _on_object_push(self, msg: dict) -> dict:
         """Newest-wins upsert used by read-repair + anti-entropy."""
@@ -556,30 +916,39 @@ class ClusterNode:
                       tenant: str = "", target: str = "") \
             -> list[tuple[StorageObject, float]]:
         state = self._state_for(cls)
-        results: list[tuple[float, bytes]] = []
         q = np.asarray(query, np.float32)
-        for shard in range(state.n_shards):
-            got = False
-            for rep in self._ordered(state.read_replicas(shard)):
-                try:
-                    r = self._send(rep, {
-                        "type": "shard_search", "class": cls,
-                        "tenant": tenant, "shard": shard,
-                        "query": q.tobytes(), "dims": q.shape[-1],
-                        "k": k, "target": target,
-                    })
-                    for dist, blob in r["hits"]:
-                        results.append((dist, blob))
-                    got = True
-                    break
-                except TransportError:
-                    continue
-            if not got:
-                raise ReplicationError(
-                    f"shard {shard}: no replica reachable")
+        deadline = Deadline(self.op_budget, op="vector_search")
+
+        def one_shard(shard: int) -> list[tuple[float, bytes]]:
+            r = self._first_replica(state, shard, {
+                "type": "shard_search", "class": cls,
+                "tenant": tenant, "shard": shard,
+                "query": q.tobytes(), "dims": q.shape[-1],
+                "k": k, "target": target,
+            }, deadline)
+            return [(dist, blob) for dist, blob in r["hits"]]
+
+        results: list[tuple[float, bytes]] = []
+        for hits in self._parallel_map(one_shard,
+                                       list(range(state.n_shards))):
+            results.extend(hits)
         results.sort(key=lambda t: t[0])
         return [(StorageObject.from_bytes(blob), d)
                 for d, blob in results[:k]]
+
+    def _first_replica(self, state: ShardingState, shard: int, msg: dict,
+                       deadline: Deadline) -> dict:
+        """One shard's scatter leg: try its read replicas live-first,
+        failing over per replica; raises if none answers."""
+        last = "no replicas"
+        for rep in self._ordered(state.read_replicas(shard)):
+            try:
+                return self._call(rep, msg, deadline=deadline)
+            except _REPLICA_ERRORS as e:
+                last = str(e)
+                continue
+        raise ReplicationError(
+            f"shard {shard}: no replica reachable ({last})")
 
     def _on_shard_search(self, msg: dict) -> dict:
         shard = self._local_shard(msg["class"], msg["shard"],
@@ -598,18 +967,25 @@ class ClusterNode:
     def bm25_search(self, cls: str, query: str, k: int = 10,
                     tenant: str = "") -> list[tuple[StorageObject, float]]:
         state = self._state_for(cls)
+        deadline = Deadline(self.op_budget, op="bm25_search")
+
+        def one_shard(shard: int) -> list[tuple[float, bytes]]:
+            try:
+                r = self._first_replica(state, shard, {
+                    "type": "shard_bm25", "class": cls, "tenant": tenant,
+                    "shard": shard, "query": query, "k": k,
+                }, deadline)
+            except ReplicationError:
+                # keyword search keeps the reference's best-effort stance:
+                # an unreachable shard degrades recall, not availability
+                logger.warning("bm25 scatter: shard %s unreachable", shard)
+                return []
+            return [(s, b) for s, b in r["hits"]]
+
         results: list[tuple[float, bytes]] = []
-        for shard in range(state.n_shards):
-            for rep in self._ordered(state.read_replicas(shard)):
-                try:
-                    r = self._send(rep, {
-                        "type": "shard_bm25", "class": cls, "tenant": tenant,
-                        "shard": shard, "query": query, "k": k,
-                    })
-                    results.extend((s, b) for s, b in r["hits"])
-                    break
-                except TransportError:
-                    continue
+        for hits in self._parallel_map(one_shard,
+                                       list(range(state.n_shards))):
+            results.extend(hits)
         results.sort(key=lambda t: -t[0])
         return [(StorageObject.from_bytes(blob), s)
                 for s, blob in results[:k]]
@@ -651,77 +1027,119 @@ class ClusterNode:
 
     def anti_entropy_once(self, cls: str, tenant: str = "") -> int:
         """One hashBeat round: for every shard this node replicates, compare
-        hashtrees with peer replicas and push/pull newest versions. Returns
-        number of objects transferred."""
+        hashtrees with peer replicas and push/pull newest versions. Peer
+        syncs run concurrently through the bounded pool (one slow replica
+        no longer serializes the whole beat), each under the retry/breaker
+        policy. Returns number of objects transferred."""
         state = self._state_for(cls)
-        moved = 0
+        self.sweep_staging()  # the beat doubles as the 2PC orphan reaper
+        jobs: list[tuple[int, str, HashTree]] = []
         for shard in state.node_shards(self.id):
-            local_tree = HashTree.build(self._shard_items(cls, shard, tenant))
-            for rep in state.replicas(shard):
-                if rep == self.id:
-                    continue
+            tree = HashTree.build(self._shard_items(cls, shard, tenant))
+            jobs.extend((shard, rep, tree) for rep in state.replicas(shard)
+                        if rep != self.id)
+        return sum(self._parallel_map(
+            lambda job: self._sync_with_peer(cls, tenant, *job), jobs))
+
+    def _sync_with_peer(self, cls: str, tenant: str, shard: int, rep: str,
+                        local_tree: HashTree) -> int:
+        """Hashtree diff + push/pull against ONE peer replica."""
+        deadline = Deadline(self.op_budget, op="anti_entropy")
+        moved = 0
+        try:
+            r = self._call(rep, {
+                "type": "hashtree_leaves", "class": cls,
+                "tenant": tenant, "shard": shard,
+            }, deadline=deadline)
+        except _REPLICA_ERRORS:
+            logger.info("hashBeat: %s unreachable for %s/shard%s leaves",
+                        rep, cls, shard)
+            return 0
+        diff = local_tree.diff_leaves(r["leaves"])
+        if not diff:
+            return 0
+        try:
+            r = self._call(rep, {
+                "type": "hashtree_items", "class": cls,
+                "tenant": tenant, "shard": shard,
+                "buckets": diff, "n_leaves": local_tree.n_leaves,
+            }, deadline=deadline)
+        except _REPLICA_ERRORS:
+            logger.info("hashBeat: %s unreachable for %s/shard%s items",
+                        rep, cls, shard)
+            return 0
+        theirs = dict(r["items"])
+        mine = {
+            u: v for u, v in self._shard_items(cls, shard, tenant)
+            if bucket_of(u, local_tree.n_leaves) in set(diff)
+        }
+        tomb = self._tombstones.get((cls, shard), {})
+        # propagate deletes: objects the peer still holds that my
+        # tombstones declare dead (a replica that missed the delete would
+        # otherwise keep — and keep re-offering — the stale copy)
+        tombs = [(u, tomb[u]) for u, v in theirs.items()
+                 if tomb.get(u, 0) >= v]
+        if tombs:
+            try:
+                rr = self._call(rep, {
+                    "type": "tombstone_push", "class": cls,
+                    "tenant": tenant, "shard": shard, "tombs": tombs,
+                }, deadline=deadline)
+                removed = rr.get("removed", 0)
+                moved += removed
+                if removed:
+                    REPLICA_REPAIRS.inc(removed, path="anti_entropy")
+            except _REPLICA_ERRORS:
+                logger.warning("hashBeat tombstone push to %s failed "
+                               "(%s/shard%s, %d tombstones)", rep, cls,
+                               shard, len(tombs))
+        # push objects I have newer (or they lack)
+        push = [u for u, v in mine.items() if theirs.get(u, 0) < v]
+        if push:
+            s = self._local_shard(cls, shard, tenant)
+            blobs = []
+            for u in push:
+                o = s.get_by_uuid(u)
+                if o is not None:
+                    blobs.append(o.to_bytes())
+            if blobs:
                 try:
-                    r = self._send(rep, {
-                        "type": "hashtree_leaves", "class": cls,
+                    rr = self._call(rep, {
+                        "type": "object_push", "class": cls,
                         "tenant": tenant, "shard": shard,
+                        "objects": blobs,
+                    }, deadline=deadline)
+                    applied = rr.get("applied", 0)
+                    moved += applied
+                    if applied:
+                        REPLICA_REPAIRS.inc(applied, path="anti_entropy")
+                except _REPLICA_ERRORS:
+                    logger.warning("hashBeat push to %s failed "
+                                   "(%s/shard%s, %d objects)", rep, cls,
+                                   shard, len(blobs))
+        # pull objects they have newer (respecting my tombstones)
+        pull = [u for u, v in theirs.items()
+                if mine.get(u, 0) < v and tomb.get(u, 0) < v]
+        if pull:
+            try:
+                rr = self._call(rep, {
+                    "type": "object_fetch", "class": cls,
+                    "tenant": tenant, "shard": shard, "uuids": pull,
+                }, deadline=deadline)
+                blobs = [b for b in rr["objects"] if b is not None]
+                if blobs:
+                    r2 = self._on_object_push({
+                        "class": cls, "tenant": tenant,
+                        "shard": shard, "objects": blobs,
                     })
-                except TransportError:
-                    continue
-                diff = local_tree.diff_leaves(r["leaves"])
-                if not diff:
-                    continue
-                try:
-                    r = self._send(rep, {
-                        "type": "hashtree_items", "class": cls,
-                        "tenant": tenant, "shard": shard,
-                        "buckets": diff, "n_leaves": local_tree.n_leaves,
-                    })
-                except TransportError:
-                    continue
-                theirs = dict(r["items"])
-                mine = {
-                    u: v for u, v in self._shard_items(cls, shard, tenant)
-                    if bucket_of(u, local_tree.n_leaves) in set(diff)
-                }
-                tomb = self._tombstones.get((cls, shard), {})
-                # push objects I have newer (or they lack)
-                push = [u for u, v in mine.items()
-                        if theirs.get(u, 0) < v]
-                if push:
-                    s = self._local_shard(cls, shard, tenant)
-                    blobs = []
-                    for u in push:
-                        o = s.get_by_uuid(u)
-                        if o is not None:
-                            blobs.append(o.to_bytes())
-                    if blobs:
-                        try:
-                            rr = self._send(rep, {
-                                "type": "object_push", "class": cls,
-                                "tenant": tenant, "shard": shard,
-                                "objects": blobs,
-                            })
-                            moved += rr.get("applied", 0)
-                        except TransportError:
-                            pass
-                # pull objects they have newer (respecting my tombstones)
-                pull = [u for u, v in theirs.items()
-                        if mine.get(u, 0) < v and tomb.get(u, 0) < v]
-                if pull:
-                    try:
-                        rr = self._send(rep, {
-                            "type": "object_fetch", "class": cls,
-                            "tenant": tenant, "shard": shard, "uuids": pull,
-                        })
-                        blobs = [b for b in rr["objects"] if b is not None]
-                        if blobs:
-                            r2 = self._on_object_push({
-                                "class": cls, "tenant": tenant,
-                                "shard": shard, "objects": blobs,
-                            })
-                            moved += r2.get("applied", 0)
-                    except TransportError:
-                        pass
+                    applied = r2.get("applied", 0)
+                    moved += applied
+                    if applied:
+                        REPLICA_REPAIRS.inc(applied, path="anti_entropy")
+            except _REPLICA_ERRORS:
+                logger.warning("hashBeat pull from %s failed "
+                               "(%s/shard%s, %d uuids)", rep, cls, shard,
+                               len(pull))
         return moved
 
     # -- replica movement (reference cluster/replication/ + copier/) -------
@@ -1042,7 +1460,10 @@ class ClusterNode:
             self._send(src, {"type": "shard_drop", "class": cls,
                              "tenant": tenant, "shard": shard})
         except TransportError:
-            pass  # orphan copy is unreachable via routing; gc later
+            # orphan copy is unreachable via routing; gc later
+            logger.warning("post-move shard_drop on %s failed "
+                           "(%s/shard%s); orphan copy remains", src, cls,
+                           shard)
         return moved
 
     def _on_shard_export(self, msg: dict) -> dict:
@@ -1086,4 +1507,7 @@ class ClusterNode:
         self.tasks.stop()
         self.gossip.stop()
         self.raft.stop()
+        # in-flight fan-out legs are bounded by their deadlines; don't
+        # block shutdown on them, just stop accepting new work
+        self._pool.shutdown(wait=False, cancel_futures=True)
         self.db.close()

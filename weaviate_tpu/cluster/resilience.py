@@ -1,0 +1,279 @@
+"""RPC resilience policies: retry, deadline, per-peer circuit breaker.
+
+Reference: the replica coordinator (``usecases/replica/coordinator.go``)
+assumes the RPC layer under it absorbs slow, flaky, and dead peers — the
+Go stack gets that from gRPC's retry/deadline machinery plus memberlist
+failure detection. This module is the explicit equivalent for our
+transports:
+
+- :class:`RetryPolicy` — jittered exponential backoff (full jitter, the
+  AWS-architecture variant: ``sleep = uniform(0, min(cap, base * 2^n))``)
+  so synchronized retry storms from concurrent coordinators decorrelate.
+- :class:`Deadline` — a per-OPERATION budget threaded through per-ATTEMPT
+  timeouts, so a QUORUM write over f replicas can never stall for
+  ``replicas x timeout``; every attempt's socket timeout is clamped to
+  what remains of the budget.
+- :class:`CircuitBreaker` — per-peer closed/open/half-open state driven
+  by consecutive transport failures. An OPEN breaker fails fast (no
+  socket, no timeout burned) until ``reset_after`` elapses, then admits
+  one half-open probe; the probe's outcome closes or re-opens it.
+- :class:`BreakerBoard` — the per-node registry of breakers, exposing the
+  rank the data plane folds into gossip's liveness ordering (a peer whose
+  breaker is open sorts after a healthy SUSPECT peer).
+
+All waiting is injectable (``sleep=``/``clock=``) and all jitter draws
+from a caller-provided ``random.Random``, so the chaos suite runs the
+real policies deterministically.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from weaviate_tpu.monitoring.metrics import (
+    BREAKER_TRANSITIONS,
+    DEADLINE_EXPIRED,
+    RPC_RETRIES,
+)
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# breaker rank folded into replica ordering: closed peers first, probing
+# (half-open) next, open last — mirrors gossip ALIVE/SUSPECT/DEAD
+BREAKER_RANK = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class DeadlineExceeded(TimeoutError):
+    """The operation budget is spent; no further attempts are admissible."""
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Jittered exponential backoff schedule for transport-level retries.
+
+    ``attempts`` counts TOTAL tries (first call + retries). ``backoff(n)``
+    is the sleep before try ``n`` (n=1 is the first retry). Full jitter:
+    a uniform draw over the exponential envelope, never a fixed ladder.
+    """
+
+    attempts: int = 3
+    base: float = 0.02
+    cap: float = 0.5
+    multiplier: float = 2.0
+
+    def backoff(self, retry_no: int, rng: random.Random) -> float:
+        envelope = min(self.cap,
+                       self.base * (self.multiplier ** max(0, retry_no - 1)))
+        return rng.uniform(0.0, envelope)
+
+
+class Deadline:
+    """Monotonic per-operation budget.
+
+    ``per_attempt(default)`` clamps an attempt's transport timeout to the
+    remaining budget so the LAST attempt cannot overshoot the operation's
+    envelope. A spent deadline raises :class:`DeadlineExceeded` from
+    ``require()`` and records the expiry metric exactly once.
+    """
+
+    def __init__(self, budget: float, op: str = "rpc",
+                 clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self.op = op
+        self.budget = budget
+        self._expires = clock() + budget
+        self._recorded = False
+        self._lock = threading.Lock()
+
+    @classmethod
+    def after(cls, budget: float, op: str = "rpc") -> "Deadline":
+        return cls(budget, op=op)
+
+    def remaining(self) -> float:
+        return self._expires - self._clock()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def per_attempt(self, default_timeout: float) -> float:
+        return max(0.0, min(default_timeout, self.remaining()))
+
+    def require(self) -> None:
+        if not self.expired:
+            return
+        with self._lock:
+            if not self._recorded:
+                self._recorded = True
+                DEADLINE_EXPIRED.inc(op=self.op)
+        raise DeadlineExceeded(
+            f"{self.op}: deadline of {self.budget:.3f}s spent")
+
+
+class CircuitBreaker:
+    """Per-peer failure isolation: closed -> open -> half-open -> closed.
+
+    CLOSED admits everything; ``fail_threshold`` consecutive failures trip
+    it OPEN. OPEN rejects (fail-fast, no timeout burned) until
+    ``reset_after`` seconds pass, then ONE caller is admitted HALF_OPEN as
+    a probe; its success closes the breaker, its failure re-opens it (and
+    restarts the cooldown). Thread-safe; transitions are counted in
+    ``weaviate_tpu_breaker_transitions_total``.
+    """
+
+    def __init__(self, peer: str, fail_threshold: int = 3,
+                 reset_after: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.peer = peer
+        self.fail_threshold = fail_threshold
+        self.reset_after = reset_after
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    def _transition(self, to: str) -> None:
+        if self._state == to:
+            return
+        self._state = to
+        BREAKER_TRANSITIONS.inc(peer=self.peer, to=to)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if (self._state == OPEN
+                and self._clock() - self._opened_at >= self.reset_after):
+            self._transition(HALF_OPEN)
+            self._probing = False
+
+    def allow(self) -> bool:
+        """May a request be sent to this peer right now?"""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and not self._probing:
+                self._probing = True  # exactly one probe per half-open window
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            self._transition(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == HALF_OPEN:
+                # failed probe: back to open, restart the cooldown
+                self._probing = False
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+                return
+            self._failures += 1
+            if self._state == CLOSED and self._failures >= self.fail_threshold:
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+
+    def reset(self) -> None:
+        """Operator override: force-close (e.g. after a known network
+        heal, instead of waiting out the half-open probe cycle)."""
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            self._transition(CLOSED)
+
+    def rank(self) -> int:
+        return BREAKER_RANK[self.state]
+
+
+class BreakerBoard:
+    """peer -> CircuitBreaker registry with the ordering hook the data
+    plane feeds into gossip's liveness sort."""
+
+    def __init__(self, fail_threshold: int = 3, reset_after: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.fail_threshold = fail_threshold
+        self.reset_after = reset_after
+        self._clock = clock
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def get(self, peer: str) -> CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(peer)
+            if b is None:
+                b = CircuitBreaker(peer, self.fail_threshold,
+                                   self.reset_after, clock=self._clock)
+                self._breakers[peer] = b
+            return b
+
+    def allow(self, peer: str) -> bool:
+        return self.get(peer).allow()
+
+    def ok(self, peer: str) -> None:
+        self.get(peer).record_success()
+
+    def fail(self, peer: str) -> None:
+        self.get(peer).record_failure()
+
+    def rank(self, peer: str) -> int:
+        """0 closed / 1 half-open / 2 open — never creates a breaker."""
+        with self._lock:
+            b = self._breakers.get(peer)
+        return 0 if b is None else b.rank()
+
+    def reset(self, peer: Optional[str] = None) -> None:
+        with self._lock:
+            targets = ([self._breakers[peer]] if peer in self._breakers
+                       else [] if peer is not None
+                       else list(self._breakers.values()))
+        for b in targets:
+            b.reset()
+
+    def states(self) -> dict[str, str]:
+        with self._lock:
+            items = list(self._breakers.items())
+        return {p: b.state for p, b in items}
+
+
+def retrying_call(fn: Callable[[float], dict], *, peer: str,
+                  policy: RetryPolicy, deadline: Deadline,
+                  timeout: float, rng: random.Random,
+                  retry_on: tuple = (),
+                  sleep: Callable[[float], None] = time.sleep,
+                  msg_type: str = "") -> dict:
+    """Run ``fn(attempt_timeout)`` under the full policy stack: per-attempt
+    timeouts clamped to the deadline, jittered backoff between attempts,
+    retries only on ``retry_on`` exception types. The caller wraps breaker
+    bookkeeping (it decides which peers a retry may target)."""
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.attempts + 1):
+        deadline.require()
+        try:
+            return fn(deadline.per_attempt(timeout))
+        except retry_on as e:  # type: ignore[misc]
+            last = e
+            if attempt == policy.attempts:
+                break
+            RPC_RETRIES.inc(peer=peer, msg_type=msg_type)
+            pause = min(policy.backoff(attempt, rng),
+                        max(0.0, deadline.remaining()))
+            if pause > 0:
+                sleep(pause)
+    assert last is not None
+    raise last

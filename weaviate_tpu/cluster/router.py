@@ -45,19 +45,25 @@ class Router:
     state_fn: Callable[[str], ShardingState]   # collection -> state
     live_fn: Optional[Callable[[], set[str]]] = None  # gossip view
     tenant_fn: Optional[Callable[[str, str], str]] = None
+    # per-peer health rank (0 best) folded between liveness and name —
+    # the node wires the circuit-breaker board here so plans demote
+    # peers this node's RPCs keep failing against
+    rank_fn: Optional[Callable[[str], int]] = None
 
     def _live(self) -> Optional[set[str]]:
         return self.live_fn() if self.live_fn is not None else None
 
     def _order(self, replicas: list[str]) -> list[str]:
-        """Local replica first (avoids a network hop), then live peers,
-        then suspected-dead ones as a last resort (they may have
-        recovered; the data plane's failover will skip them on error)."""
+        """Local replica first (avoids a network hop), then live peers
+        (breaker-closed before breaker-open within a class), then
+        suspected-dead ones as a last resort (they may have recovered;
+        the data plane's failover will skip them on error)."""
         live = self._live()
 
         def rank(r: str) -> tuple:
             return (r != self.node_id,
                     live is not None and r not in live,
+                    self.rank_fn(r) if self.rank_fn is not None else 0,
                     r)
         return sorted(replicas, key=rank)
 

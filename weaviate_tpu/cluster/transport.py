@@ -75,6 +75,14 @@ class TcpTransport:
         outer = self
 
         class _ReqHandler(socketserver.BaseRequestHandler):
+            def setup(self):
+                with outer._open_lock:
+                    outer._open.add(self.request)
+
+            def finish(self):
+                with outer._open_lock:
+                    outer._open.discard(self.request)
+
             def handle(self):
                 try:
                     while True:
@@ -93,6 +101,11 @@ class TcpTransport:
             allow_reuse_address = True
             daemon_threads = True
 
+        # accepted connections, so stop() can sever them (socketserver's
+        # shutdown only closes the LISTENING socket; a peer that "stops"
+        # must look stopped to peers holding pooled connections)
+        self._open: set[socket.socket] = set()
+        self._open_lock = threading.Lock()
         self._server = _Server((host, int(port)), _ReqHandler)
         self.node_id = f"{host}:{self._server.server_address[1]}"
         self._thread: Optional[threading.Thread] = None
@@ -114,29 +127,65 @@ class TcpTransport:
         with self._conn_lock:
             pool = self._idle.get(peer)
             sock = pool.pop() if pool else None
-        try:
-            if sock is None:
-                host, port = peer.rsplit(":", 1)
-                sock = socket.create_connection(
-                    (host, int(port)), timeout=timeout)
-            sock.settimeout(timeout)
-            sock.sendall(struct.pack(">I", len(payload)) + payload)
-            (n,) = struct.unpack(">I", _recv_exact(sock, 4))
-            reply = msgpack.unpackb(_recv_exact(sock, n), raw=False)
-            with self._conn_lock:
-                self._idle.setdefault(peer, []).append(sock)
-            return reply
-        except (OSError, struct.error) as e:
+        # A pooled socket can be stale — the peer restarted (or closed the
+        # idle connection) since it was checked in, and the death is only
+        # observable on use. If it DIES (reset/closed, never a timeout:
+        # a slow peer may still be processing, and re-sending would be
+        # duplicate delivery of a possibly non-idempotent message) before
+        # any reply byte arrives, the request provably did not complete
+        # on a live peer, so one retry over a fresh connection is safe;
+        # after the first reply byte we must surface the error (the peer
+        # may have applied the request).
+        pooled = sock is not None
+        for attempt in (0, 1):
+            got_reply_bytes = False
             try:
-                if sock is not None:
-                    sock.close()
-            except OSError:
-                pass
-            raise TransportError(f"-> {peer}: {e}") from e
+                if sock is None:
+                    host, port = peer.rsplit(":", 1)
+                    sock = socket.create_connection(
+                        (host, int(port)), timeout=timeout)
+                sock.settimeout(timeout)
+                sock.sendall(struct.pack(">I", len(payload)) + payload)
+                hdr = b""
+                while len(hdr) < 4:
+                    chunk = sock.recv(4 - len(hdr))
+                    if not chunk:
+                        raise TransportError("connection closed")
+                    got_reply_bytes = True
+                    hdr += chunk
+                (n,) = struct.unpack(">I", hdr)
+                reply = msgpack.unpackb(_recv_exact(sock, n), raw=False)
+                with self._conn_lock:
+                    self._idle.setdefault(peer, []).append(sock)
+                return reply
+            except (OSError, struct.error, TransportError) as e:
+                try:
+                    if sock is not None:
+                        sock.close()
+                except OSError:
+                    pass
+                sock = None
+                if pooled and attempt == 0 and not got_reply_bytes \
+                        and not isinstance(e, TimeoutError):
+                    pooled = False  # the fresh connection gets no retry
+                    continue
+                raise TransportError(f"-> {peer}: {e}") from e
+        raise TransportError(f"-> {peer}: unreachable")  # pragma: no cover
 
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self._open_lock:
+            open_now = list(self._open)
+        for s in open_now:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
         with self._conn_lock:
             for pool in self._idle.values():
                 for s in pool:

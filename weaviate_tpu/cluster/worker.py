@@ -131,6 +131,17 @@ class WorkerControl:
             msg["class"], int(msg.get("shard", 0)), msg["src"], msg["dst"])
         return {"moved": moved}
 
+    def ctl_breakers(self, msg):
+        """Per-peer circuit-breaker states + gossip view — the operator's
+        one-call health snapshot during a chaos soak."""
+        return {"breakers": self.node.breakers.states(),
+                "members": self.node.members()}
+
+    def ctl_sweep_staging(self, msg):
+        ttl = msg.get("ttl")
+        return {"aborted": self.node.sweep_staging(
+            ttl=float(ttl) if ttl is not None else None)}
+
 
 class CtlTransport:
     """Transport decorator that muxes the ``ctl_*`` surface in front of
@@ -174,11 +185,29 @@ def main(argv=None) -> int:
                     help="serve the REST tier on this port (0 = off): "
                          "object CRUD rides the replicated data plane, "
                          "schema mutations go through raft")
+    ap.add_argument("--chaos", default="",
+                    help="fault-inject outbound RPCs for soak testing: "
+                         "'<peer|*>:k=v,...;...' e.g. "
+                         "'*:drop=0.05,jitter=0.02' (see cluster/chaos.py)")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="seed for the chaos fault schedule")
+    ap.add_argument("--staging-ttl", type=float, default=30.0,
+                    help="seconds before an orphaned 2PC staging entry "
+                         "is aborted")
     args = ap.parse_args(argv)
 
-    transport = CtlTransport(TcpTransport(args.bind))
+    inner = TcpTransport(args.bind)
+    if args.chaos:
+        from weaviate_tpu.cluster.chaos import ChaosTransport, parse_chaos_spec
+
+        chaos = ChaosTransport(inner, seed=args.chaos_seed)
+        for peer, kwargs in parse_chaos_spec(args.chaos):
+            chaos.program(peer, **kwargs)
+        inner = chaos
+    transport = CtlTransport(inner)
     peers = [p.strip() for p in args.peers.split(",") if p.strip()]
-    node = ClusterNode(args.bind, peers, transport, args.data)
+    node = ClusterNode(args.bind, peers, transport, args.data,
+                       staging_ttl=args.staging_ttl)
     transport.ctl = WorkerControl(node)
 
     rest = rest_srv = None

@@ -180,3 +180,31 @@ NATIVE_BM25_UNAVAILABLE = REGISTRY.gauge(
 DIMENSIONS_SUM = REGISTRY.gauge(
     "weaviate_tpu_vector_dimensions_sum",
     "stored vector dimensions per collection (count x dims)")
+
+# cluster RPC resilience instruments (retry/deadline/breaker + repair paths;
+# every chaos-injected fault and every policy reaction is observable here)
+RPC_RETRIES = REGISTRY.counter(
+    "weaviate_tpu_rpc_retries_total",
+    "transport-level retries by peer and message type")
+RPC_FAILURES = REGISTRY.counter(
+    "weaviate_tpu_rpc_failures_total",
+    "RPC attempts that exhausted retries, by peer and failure kind")
+RPC_DURATION = REGISTRY.histogram(
+    "weaviate_tpu_rpc_durations_seconds",
+    "cluster RPC latency by message type (includes retries/backoff)")
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "weaviate_tpu_breaker_transitions_total",
+    "circuit-breaker state transitions by peer and target state")
+DEADLINE_EXPIRED = REGISTRY.counter(
+    "weaviate_tpu_deadline_expired_total",
+    "operations that spent their deadline budget, by operation")
+REPLICA_REPAIRS = REGISTRY.counter(
+    "weaviate_tpu_replica_repairs_total",
+    "objects repaired onto stale replicas, by path "
+    "(read_repair/anti_entropy)")
+STAGING_ABORTED = REGISTRY.counter(
+    "weaviate_tpu_staging_aborted_total",
+    "orphaned 2PC staging entries swept, by reason (ttl/abort)")
+CHAOS_FAULTS = REGISTRY.counter(
+    "weaviate_tpu_chaos_faults_total",
+    "faults fired by ChaosTransport, by kind and link")
