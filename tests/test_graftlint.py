@@ -497,6 +497,93 @@ class TestTransportErrorSwallowed:
 
 
 # ---------------------------------------------------------------------------
+# unbounded-queue
+
+API = "weaviate_tpu/api/fake.py"
+SERVING = "weaviate_tpu/serving/fake.py"
+
+
+class TestUnboundedQueue:
+    def test_queue_without_maxsize_flagged(self):
+        res = run("""
+            import queue
+
+            def f():
+                return queue.Queue()
+        """, rel=CLUSTER)
+        assert rule_ids(res) == ["unbounded-queue"]
+
+    def test_from_import_and_alias_flagged(self):
+        res = run("""
+            from queue import Queue as Q
+            from collections import deque
+
+            def f():
+                return Q(), deque()
+        """, rel=SERVING)
+        assert rule_ids(res) == ["unbounded-queue"] * 2
+
+    def test_bounded_forms_ok(self):
+        res = run("""
+            import queue
+            from collections import deque
+
+            def f(n):
+                return (queue.Queue(maxsize=n), queue.Queue(n),
+                        deque(maxlen=16), deque([], 16))
+        """, rel=API)
+        assert rule_ids(res) == []
+
+    def test_zero_none_and_negative_bounds_are_unbounded(self):
+        res = run("""
+            import queue
+            from collections import deque
+
+            def f():
+                return (queue.Queue(maxsize=0), deque(maxlen=None),
+                        queue.Queue(maxsize=-1), queue.Queue(-1))
+        """, rel=CLUSTER)
+        assert rule_ids(res) == ["unbounded-queue"] * 4
+
+    def test_simplequeue_always_flagged(self):
+        res = run("""
+            import queue
+
+            def f():
+                return queue.SimpleQueue()
+        """, rel=API)
+        assert rule_ids(res) == ["unbounded-queue"]
+
+    def test_out_of_scope_paths_not_flagged(self):
+        res = run("""
+            import queue
+
+            def f():
+                return queue.Queue()
+        """, rel=COLD)  # storage/: not a serving-path package
+        assert rule_ids(res) == []
+
+    def test_unrelated_names_not_flagged(self):
+        res = run("""
+            from weaviate_tpu.core.async_queue import AsyncVectorQueue
+
+            def f(d):
+                return AsyncVectorQueue(d), d.Queue()
+        """, rel=API)
+        assert rule_ids(res) == []
+
+    def test_suppressible_with_reason(self):
+        res = run("""
+            from collections import deque
+
+            def f():
+                return deque()  # graftlint: allow[unbounded-queue] reason=depth checked under lock before append
+        """, rel=SERVING)
+        assert rule_ids(res) == []
+        assert [v.rule for v in res.suppressed] == ["unbounded-queue"]
+
+
+# ---------------------------------------------------------------------------
 # lock-across-device-call
 
 

@@ -1,0 +1,543 @@
+"""Serving QoS: admission control, deadlines, shedding, tenant fairness.
+
+Reference test model: there is no Go analogue — the reference leans on
+gRPC deadlines and goroutine cheapness; here the QoS layer IS the
+overload story (ISSUE 4), so the tests drive it three ways: unit tests
+on the limiter/bucket/controller, a dispatcher-level proof that expired
+requests never reach device execution, and a live-server overload soak
+(64 clients vs a pinned-low ceiling: bounded p99 for admitted work,
+429 + Retry-After for the rest).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.cluster.resilience import Deadline, DeadlineExceeded
+from weaviate_tpu.monitoring.metrics import (
+    DISPATCH_DEVICE_ROWS,
+    DISPATCH_EXPIRED,
+)
+from weaviate_tpu.serving.limiter import AIMDLimiter
+from weaviate_tpu.serving.qos import (
+    AdmissionController,
+    LaneConfig,
+    QosRejected,
+)
+from weaviate_tpu.serving.tenancy import TenantThrottle, TokenBucket
+
+
+# ---------------------------------------------------------------------------
+# AIMD limiter
+
+
+class TestAIMDLimiter:
+    def test_multiplicative_decrease_on_slow_p99(self):
+        lim = AIMDLimiter(initial=16, window=8, target_p99_s=0.1)
+        for _ in range(8):
+            lim.record(0.5)
+        assert lim.ceiling == 8
+        for _ in range(8):
+            lim.record(0.5)
+        assert lim.ceiling == 4
+
+    def test_additive_increase_on_fast_p99(self):
+        lim = AIMDLimiter(initial=4, window=4, target_p99_s=0.5)
+        for _ in range(4):
+            lim.record(0.01)
+        assert lim.ceiling == 5
+
+    def test_respects_floor_and_cap(self):
+        lim = AIMDLimiter(initial=2, min_limit=2, max_limit=3, window=2,
+                          target_p99_s=0.1)
+        for _ in range(10):
+            lim.record(9.0)
+        assert lim.ceiling == 2  # never below the floor
+        for _ in range(10):
+            lim.record(0.001)
+        assert lim.ceiling == 3  # never above the cap
+
+    def test_partial_window_does_not_adjust(self):
+        lim = AIMDLimiter(initial=8, window=32)
+        for _ in range(31):
+            lim.record(99.0)
+        assert lim.ceiling == 8
+
+    def test_rejects_inverted_bounds(self):
+        with pytest.raises(ValueError):
+            AIMDLimiter(initial=1, min_limit=4)
+        with pytest.raises(ValueError):
+            AIMDLimiter(decrease=1.5)
+
+
+# ---------------------------------------------------------------------------
+# token bucket / tenant throttle
+
+
+class TestTokenBucket:
+    def test_burst_then_throttle_then_refill(self):
+        now = [0.0]
+        b = TokenBucket(rate=10.0, burst=2.0, clock=lambda: now[0])
+        assert b.try_take() == 0.0
+        assert b.try_take() == 0.0
+        wait = b.try_take()
+        assert wait == pytest.approx(0.1, abs=0.01)
+        now[0] += wait
+        assert b.try_take() == 0.0
+
+    def test_tenant_overrides_and_unlimited_default(self):
+        now = [0.0]
+        th = TenantThrottle(default_rate=0.0, clock=lambda: now[0])
+        for _ in range(100):
+            assert th.check("anyone") is None  # rate<=0 = unthrottled
+        th.set_limit("hot", rate=1.0, burst=1.0)
+        assert th.check("hot") is None
+        assert th.check("hot") is not None  # bucket spent
+        assert th.check("cold") is None  # other tenants unaffected
+
+
+# ---------------------------------------------------------------------------
+# admission controller
+
+
+def controller(ceiling=1, depth=2, rate=0.0, clock=time.monotonic):
+    return AdmissionController(
+        limiter=AIMDLimiter(initial=ceiling, min_limit=ceiling,
+                            max_limit=ceiling),
+        throttle=TenantThrottle(default_rate=rate, default_burst=rate,
+                                clock=clock),
+        lanes=(LaneConfig("interactive", 8, depth),
+               LaneConfig("batch", 2, depth),
+               LaneConfig("background", 1, depth)),
+        clock=clock)
+
+
+class TestAdmissionController:
+    def test_admits_up_to_ceiling_then_queues_then_sheds(self):
+        ctl = controller(ceiling=1, depth=1)
+        first = ctl.acquire("interactive")  # takes the only slot
+
+        queued_ticket = []
+
+        def queued():
+            with ctl.acquire("interactive") as tk:
+                queued_ticket.append(tk)
+
+        t = threading.Thread(target=queued)
+        t.start()
+        for _ in range(1000):  # wait for the waiter to enqueue
+            if ctl.snapshot()["queued"]["interactive"] == 1:
+                break
+            time.sleep(0.001)
+        with pytest.raises(QosRejected) as exc:  # depth 1 already used
+            ctl.acquire("interactive")
+        assert exc.value.reason == "queue_full"
+        assert exc.value.retry_after >= 1.0
+        first.__exit__(None, None, None)  # release -> waiter admitted
+        t.join(timeout=5)
+        assert queued_ticket and queued_ticket[0].queue_wait >= 0.0
+        assert ctl.snapshot()["inflight"] == 0
+
+    def test_deadline_expiry_while_queued(self):
+        ctl = controller(ceiling=1, depth=4)
+        held = ctl.acquire("interactive")
+        try:
+            with pytest.raises(DeadlineExceeded):
+                ctl.acquire("interactive",
+                            deadline=Deadline(0.05, op="test"))
+            # the expired waiter must not linger in the queue
+            assert ctl.snapshot()["queued"]["interactive"] == 0
+        finally:
+            held.__exit__(None, None, None)
+
+    def test_expired_on_arrival_is_shed_before_queueing(self):
+        ctl = controller(ceiling=1, depth=4)
+        d = Deadline(0.0, op="test")
+        with pytest.raises(DeadlineExceeded):
+            ctl.acquire("interactive", deadline=d)
+
+    def test_tenant_rate_shed_does_not_touch_cold_tenant(self):
+        now = [0.0]
+        ctl = controller(ceiling=4, depth=4, rate=1.0, clock=lambda: now[0])
+        with ctl.acquire("interactive", tenant="hot"):
+            pass
+        with pytest.raises(QosRejected) as exc:
+            ctl.acquire("interactive", tenant="hot")
+        assert exc.value.reason == "tenant_rate"
+        with ctl.acquire("interactive", tenant="cold"):
+            pass  # cold tenant sails through
+
+    def test_weighted_fair_dequeue_prefers_interactive(self):
+        ctl = controller(ceiling=1, depth=8)
+        held = ctl.acquire("interactive")
+        order = []
+        threads = []
+
+        def worker(lane, tag):
+            with ctl.acquire(lane):
+                order.append(tag)
+
+        # enqueue batch FIRST so FIFO would favor it; the weighted
+        # dequeue must still run interactive work ahead of it
+        for i in range(2):
+            t = threading.Thread(target=worker, args=("batch", f"b{i}"))
+            t.start()
+            threads.append(t)
+            while ctl.snapshot()["queued"]["batch"] < i + 1:
+                time.sleep(0.001)
+        for i in range(2):
+            t = threading.Thread(target=worker,
+                                 args=("interactive", f"i{i}"))
+            t.start()
+            threads.append(t)
+            while ctl.snapshot()["queued"]["interactive"] < i + 1:
+                time.sleep(0.001)
+        held.__exit__(None, None, None)
+        for t in threads:
+            t.join(timeout=5)
+        assert order[0].startswith("i"), order  # interactive won the slot
+
+    def test_round_robin_across_tenants_within_lane(self):
+        ctl = controller(ceiling=1, depth=8)
+        held = ctl.acquire("interactive")
+        order = []
+        threads = []
+
+        def worker(tenant, tag):
+            with ctl.acquire("interactive", tenant=tenant):
+                order.append(tag)
+
+        # hot tenant queues 3 requests before cold queues 1
+        for spec in [("hot", "h0"), ("hot", "h1"), ("hot", "h2"),
+                     ("cold", "c0")]:
+            t = threading.Thread(target=worker, args=spec)
+            t.start()
+            threads.append(t)
+            want = len(threads)
+            while ctl.snapshot()["queued"]["interactive"] < want:
+                time.sleep(0.001)
+        held.__exit__(None, None, None)
+        for t in threads:
+            t.join(timeout=5)
+        # cold's single request must run before hot's backlog drains
+        assert order.index("c0") <= 1, order
+
+    def test_disabled_qos_is_a_noop(self):
+        from weaviate_tpu.utils.runtime_config import SERVING_QOS
+
+        ctl = controller(ceiling=1, depth=0)
+        held = ctl.acquire("interactive")
+        SERVING_QOS.set_override("off")
+        try:
+            # ceiling is full and the queue holds nobody, yet off = admit
+            with ctl.acquire("interactive"):
+                pass
+        finally:
+            SERVING_QOS.clear_override()
+            held.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# dispatcher: expired requests never reach device execution
+
+
+class TestDispatcherDeadline:
+    def test_expired_request_never_reaches_device(self):
+        from weaviate_tpu.index.dispatch import CoalescingDispatcher
+
+        calls = []
+
+        def run_batch(q, k, allow):
+            calls.append(q.shape[0])
+            return (np.zeros((q.shape[0], k), np.int64),
+                    np.zeros((q.shape[0], k), np.float32))
+
+        disp = CoalescingDispatcher(run_batch)
+        expired_before = DISPATCH_EXPIRED.value()
+        with pytest.raises(DeadlineExceeded):
+            disp.search(np.zeros((1, 4), np.float32), 3,
+                        deadline=Deadline(0.0, op="test"))
+        assert calls == []  # the device batch never ran
+        assert DISPATCH_EXPIRED.value() == expired_before + 1
+
+    def test_expired_waiter_shed_while_live_request_runs(self):
+        from weaviate_tpu.index.dispatch import CoalescingDispatcher, _Req
+
+        rows_before = DISPATCH_DEVICE_ROWS.value()
+        executed = []
+
+        def run_batch(q, k, allow):
+            executed.append(q.shape[0])
+            return (np.zeros((q.shape[0], k), np.int64),
+                    np.zeros((q.shape[0], k), np.float32))
+
+        disp = CoalescingDispatcher(run_batch)
+        stale = _Req(np.zeros((1, 4), np.float32), 3, None,
+                     Deadline(0.0, op="test"))
+        disp._pending.append(stale)  # a queued request whose budget died
+        ids, dists = disp.search(np.zeros((2, 4), np.float32), 3)
+        assert ids.shape == (2, 3)
+        assert isinstance(stale.error, DeadlineExceeded)
+        assert executed == [2]  # only the live rows hit the device
+        assert DISPATCH_DEVICE_ROWS.value() == rows_before + 2
+
+    def test_collection_sheds_expired_before_shards(self, tmp_path):
+        from weaviate_tpu.core.db import DB
+        from weaviate_tpu.schema.config import (
+            CollectionConfig,
+            DataType,
+            FlatIndexConfig,
+            Property,
+        )
+        from weaviate_tpu.storage.objects import StorageObject
+
+        db = DB(str(tmp_path))
+        db.create_collection(CollectionConfig(
+            name="Q", properties=[Property(name="t",
+                                           data_type=DataType.TEXT)],
+            vector_config=FlatIndexConfig(distance="l2-squared")))
+        col = db.get_collection("Q")
+        col.put(StorageObject(
+            uuid="00000000-0000-0000-0000-000000000001", collection="Q",
+            properties={"t": "x"},
+            vector=np.ones(4, np.float32)))
+        with pytest.raises(DeadlineExceeded):
+            col.vector_search(np.ones(4, np.float32), k=1,
+                              deadline=Deadline(0.0, op="test"))
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# live-server overload soak
+
+
+ARTICLE = {
+    "class": "Article",
+    "vectorizer": "none",
+    "vectorIndexType": "flat",
+    "vectorIndexConfig": {"distance": "l2-squared"},
+    "properties": [{"name": "title", "dataType": ["text"]}],
+}
+
+SEARCH_QUERY = {
+    "query": '{ Get { Article(nearVector: {vector: [1,0,0,0]}, limit: 3) '
+             '{ title } } }'
+}
+
+
+def _call(base, method, path, body=None, headers=None, timeout=30):
+    req = urllib.request.Request(
+        base + path,
+        data=None if body is None else json.dumps(body).encode(),
+        method=method,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+@pytest.fixture
+def overload_server(tmp_dbdir):
+    """REST server with the limiter ceiling pinned LOW (2) and small
+    queues, so 64 clients deterministically overrun it."""
+    from weaviate_tpu.api.rest import RestAPI
+    from weaviate_tpu.core.db import DB
+
+    db = DB(tmp_dbdir)
+    qos = AdmissionController(
+        limiter=AIMDLimiter(initial=2, min_limit=2, max_limit=2),
+        lanes=(LaneConfig("interactive", 8, 4),
+               LaneConfig("batch", 2, 4),
+               LaneConfig("background", 1, 8)))
+    api = RestAPI(db, qos=qos)
+    srv = api.serve(host="127.0.0.1", port=0, background=True,
+                    max_handlers=80)
+    base = f"http://127.0.0.1:{srv.server_port}"
+    status, _, _ = _call(base, "POST", "/v1/schema", ARTICLE)
+    assert status == 200
+    for i in range(8):
+        vec = [0.0] * 4
+        vec[i % 4] = 1.0
+        _call(base, "POST", "/v1/objects", {
+            "class": "Article", "id": f"00000000-0000-0000-0000-"
+                                      f"{i:012d}",
+            "properties": {"title": f"doc {i}"}, "vector": vec})
+    yield base, api
+    api.shutdown()
+    db.close()
+
+
+@pytest.mark.timeout(120)
+def test_overload_soak_64_clients(overload_server):
+    base, api = overload_server
+    # make each admitted search occupy its slot long enough that 64
+    # near-simultaneous arrivals must overrun ceiling(2) + queue(4)
+    orig = api.on_graphql
+
+    def slow_graphql(request):
+        time.sleep(0.15)
+        return orig(request)
+
+    api.on_graphql = slow_graphql
+    expired_before = DISPATCH_EXPIRED.value()
+
+    results = [None] * 64
+    start = threading.Barrier(64)
+
+    def client(i):
+        start.wait(timeout=30)
+        t0 = time.perf_counter()
+        status, headers, body = _call(
+            base, "POST", "/v1/graphql", SEARCH_QUERY,
+            headers={"X-Request-Timeout": "20"})
+        results[i] = (status, headers, time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(64)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    api.on_graphql = orig
+
+    statuses = [r[0] for r in results]
+    assert all(r is not None for r in results)
+    # every request either completed or was shed loudly — never a 5xx,
+    # never a hang, never a silent queue
+    assert set(statuses) <= {200, 429}, statuses
+    ok = [r for r in results if r[0] == 200]
+    shed = [r for r in results if r[0] == 429]
+    assert ok, "nothing admitted"
+    assert shed, "64 clients vs ceiling 2 + queue 4 must shed"
+    # every shed response tells the client when to come back
+    for _, headers, _ in shed:
+        assert int(headers["Retry-After"]) >= 1
+    # admitted requests finished within their deadline (no 504s above)
+    # with bounded latency: ceiling 2, queue 4, 0.15s/op -> worst
+    # admitted wait ~ (4/2 + 1) * 0.15s; 5s is an order of magnitude
+    # of slack for CI schedulers
+    assert max(lat for _, _, lat in ok) < 5.0
+    # and zero expired-deadline requests reached device execution
+    assert DISPATCH_EXPIRED.value() == expired_before
+
+
+def test_expired_deadline_returns_504(overload_server):
+    base, _ = overload_server
+    status, _, body = _call(
+        base, "POST", "/v1/graphql", SEARCH_QUERY,
+        headers={"X-Request-Timeout": "0.000001"})
+    assert status == 504
+    assert b"deadline" in body.lower()
+
+
+def test_bad_timeout_header_is_400(overload_server):
+    base, _ = overload_server
+    status, _, _ = _call(base, "POST", "/v1/graphql", SEARCH_QUERY,
+                         headers={"X-Request-Timeout": "soon"})
+    assert status == 400
+
+
+def test_health_and_metrics_exempt_under_full_overload(overload_server):
+    base, api = overload_server
+    # saturate the controller completely: ceiling + every queue slot
+    held = [api.qos.acquire("interactive") for _ in range(2)]
+    try:
+        assert _call(base, "GET", "/v1/.well-known/ready")[0] == 200
+        assert _call(base, "GET", "/metrics")[0] == 200
+    finally:
+        for t in held:
+            t.__exit__(None, None, None)
+
+
+def test_qos_off_restores_unlimited_admission(overload_server):
+    from weaviate_tpu.utils.runtime_config import SERVING_QOS
+
+    base, api = overload_server
+    held = [api.qos.acquire("interactive") for _ in range(2)]
+    SERVING_QOS.set_override("off")
+    try:
+        status, _, _ = _call(base, "POST", "/v1/graphql", SEARCH_QUERY)
+        assert status == 200  # full ceiling, yet served: QoS bypassed
+    finally:
+        SERVING_QOS.clear_override()
+        for t in held:
+            t.__exit__(None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# gRPC plane: RESOURCE_EXHAUSTED + DEADLINE_EXCEEDED mapping
+
+
+@pytest.fixture
+def grpc_overloaded(tmp_dbdir):
+    import grpc
+
+    from weaviate_tpu.api.grpc_server import GrpcAPI, GrpcClient
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        DataType,
+        FlatIndexConfig,
+        Property,
+    )
+
+    db = DB(tmp_dbdir)
+    db.create_collection(CollectionConfig(
+        name="Article",
+        properties=[Property(name="title", data_type=DataType.TEXT)],
+        vector_config=FlatIndexConfig(distance="l2-squared")))
+    qos = AdmissionController(
+        limiter=AIMDLimiter(initial=1, min_limit=1, max_limit=1),
+        lanes=(LaneConfig("interactive", 8, 0),
+               LaneConfig("batch", 2, 0),
+               LaneConfig("background", 1, 0)))
+    api = GrpcAPI(db, qos=qos)
+    port = api.serve(host="127.0.0.1", port=0)
+    client = GrpcClient(f"127.0.0.1:{port}")
+    yield api, client, grpc
+    client.close()
+    api.shutdown()
+    db.close()
+
+
+def test_grpc_shed_maps_to_resource_exhausted(grpc_overloaded):
+    from weaviate_tpu.api.proto import pb
+
+    api, client, grpc = grpc_overloaded
+    held = api.qos.acquire("interactive")  # the only slot; queues hold 0
+    try:
+        req = pb.SearchRequest(collection="Article", limit=1)
+        v = req.near_vectors.add()
+        v.values.extend([1.0, 0.0, 0.0, 0.0])
+        with pytest.raises(grpc.RpcError) as exc:
+            client.search(req)
+        assert exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        trailers = dict(exc.value.trailing_metadata() or ())
+        assert int(trailers["retry-after"]) >= 1
+    finally:
+        held.__exit__(None, None, None)
+
+
+def test_grpc_expired_deadline_maps_to_deadline_exceeded(grpc_overloaded):
+    from weaviate_tpu.api.proto import pb
+    from weaviate_tpu.utils.runtime_config import SERVING_DEFAULT_TIMEOUT_S
+
+    api, client, grpc = grpc_overloaded
+    SERVING_DEFAULT_TIMEOUT_S.set_override(0.0000001)
+    try:
+        req = pb.SearchRequest(collection="Article", limit=1)
+        v = req.near_vectors.add()
+        v.values.extend([1.0, 0.0, 0.0, 0.0])
+        with pytest.raises(grpc.RpcError) as exc:
+            client.search(req)
+        assert exc.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    finally:
+        SERVING_DEFAULT_TIMEOUT_S.clear_override()

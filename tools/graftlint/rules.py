@@ -514,6 +514,109 @@ class TransportErrorSwallowed(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 4c. unbounded-queue
+
+
+class UnboundedQueue(Rule):
+    id = "unbounded-queue"
+    description = (
+        "queue.Queue()/collections.deque() constructed without a "
+        "maxsize/maxlen in the cross-thread serving path"
+    )
+    rationale = (
+        "The serving QoS contract is that overload is SHED, never "
+        "silently queued: an unbounded queue handed between threads in "
+        "serving/, api/, or cluster/ is exactly the invisible backlog "
+        "that turns a traffic spike into unbounded p99 and an OOM. "
+        "Bound it (maxsize/maxlen), or suppress with a reason stating "
+        "the invariant that bounds it externally."
+    )
+
+    _DIRS = ("weaviate_tpu/serving/", "weaviate_tpu/api/",
+             "weaviate_tpu/cluster/")
+    # constructor -> index of the positional bound argument
+    _QUEUES = {"queue.Queue": 0, "queue.LifoQueue": 0,
+               "queue.PriorityQueue": 0, "multiprocessing.Queue": 0}
+    _DEQUES = {"collections.deque": 1}
+    _NEVER_BOUNDED = frozenset({"queue.SimpleQueue"})
+    _FROM_MODULES = {
+        "queue": {"Queue": "queue.Queue", "LifoQueue": "queue.LifoQueue",
+                  "PriorityQueue": "queue.PriorityQueue",
+                  "SimpleQueue": "queue.SimpleQueue"},
+        "collections": {"deque": "collections.deque"},
+        "multiprocessing": {"Queue": "multiprocessing.Queue"},
+    }
+
+    def _bound_names(self, ctx) -> dict:
+        """name-as-bound-in-file -> canonical ctor (from-imports only;
+        dotted calls resolve through dotted_name directly)."""
+        bound: dict[str, str] = {}
+        for node in ctx.walk(ast.ImportFrom):
+            table = self._FROM_MODULES.get(node.module or "")
+            if not table:
+                continue
+            for a in node.names:
+                if a.name in table:
+                    bound[a.asname or a.name] = table[a.name]
+        return bound
+
+    @staticmethod
+    def _is_bounded(call: ast.Call, pos: int) -> bool:
+        """A bound exists unless the arg is absent, or a constant 0/None/
+        negative (queue.Queue(0), Queue(maxsize=-1), and
+        deque(maxlen=None) all mean unbounded, spelled loudly)."""
+        kw_name = "maxlen" if pos == 1 else "maxsize"
+        arg: Optional[ast.AST] = None
+        if len(call.args) > pos:
+            arg = call.args[pos]
+        for kw in call.keywords:
+            if kw.arg == kw_name:
+                arg = kw.value
+        if arg is None:
+            return False
+        if isinstance(arg, ast.Constant) and arg.value in (0, None):
+            return False
+        # -N parses as UnaryOp(USub, Constant(N)): Queue(maxsize=-1)
+        if isinstance(arg, ast.UnaryOp) and isinstance(arg.op, ast.USub) \
+                and isinstance(arg.operand, ast.Constant):
+            return False
+        return True
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, self._DIRS):
+            return
+        bound = self._bound_names(ctx)
+        for node in ctx.walk(ast.Call):
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            canonical = bound.get(dn, dn)
+            if canonical in self._NEVER_BOUNDED:
+                yield self.violation(
+                    ctx, node,
+                    f"{dn}() has no capacity bound at all — use "
+                    "queue.Queue(maxsize=...) so overload backpressures "
+                    "instead of accumulating",
+                )
+            elif canonical in self._QUEUES:
+                if not self._is_bounded(node, self._QUEUES[canonical]):
+                    yield self.violation(
+                        ctx, node,
+                        f"{dn}() without maxsize= — an unbounded "
+                        "cross-thread queue; bound it or state the "
+                        "external invariant in a suppression reason",
+                    )
+            elif canonical in self._DEQUES:
+                if not self._is_bounded(node, self._DEQUES[canonical]):
+                    yield self.violation(
+                        ctx, node,
+                        f"{dn}() without maxlen= — an unbounded deque in "
+                        "the serving path; bound it or state the external "
+                        "invariant in a suppression reason",
+                    )
+
+
+# ---------------------------------------------------------------------------
 # 5. lock-across-device-call
 
 
@@ -647,6 +750,7 @@ ALL_RULES: tuple = (
     NonhashableStaticArg(),
     SwallowedException(),
     TransportErrorSwallowed(),
+    UnboundedQueue(),
     LockAcrossDeviceCall(),
     Float64LiteralDrift(),
     SuppressionMissingReason(),

@@ -24,10 +24,57 @@ import numpy as np
 
 from weaviate_tpu.api.graphql import where_to_filter
 from weaviate_tpu.api.proto import pb
+from weaviate_tpu.cluster.resilience import Deadline, DeadlineExceeded
 from weaviate_tpu.core.db import DB
 from weaviate_tpu.query import Explorer, HybridParams, QueryParams
+from weaviate_tpu.serving.context import RequestContext, request_scope
+from weaviate_tpu.serving.qos import QosRejected
 
 SERVICE = "weaviate_tpu.v1.WeaviateTpu"
+
+# admission lane per RPC (mirrors the REST endpoint->lane map): search
+# and aggregation are interactive, bulk mutation rides the batch lane
+RPC_LANES = {
+    "Search": "interactive", "Aggregate": "interactive",
+    "BatchObjects": "batch", "BatchReferences": "batch",
+    "BatchDelete": "batch", "TenantsGet": "background",
+}
+
+
+def qos_admit(qos, name: str, context, tenant: str = ""):
+    """Shared gRPC-plane admission: mint the end-to-end Deadline from the
+    client's gRPC deadline (clamped to the server default), acquire a QoS
+    ticket, and map shed/expiry onto RESOURCE_EXHAUSTED (with a
+    ``retry-after`` trailer) / DEADLINE_EXCEEDED. Returns
+    ``(ticket, request_scope_ctx)``; both planes use it so they can't
+    drift."""
+    from weaviate_tpu.utils.runtime_config import SERVING_DEFAULT_TIMEOUT_S
+
+    if not qos.enabled():  # serving_qos=off: no deadline, no admission
+        return qos.acquire(), None
+    # the client's gRPC deadline IS the budget when given (capped like
+    # REST's X-Request-Timeout at 600s — a longer client deadline must
+    # not be silently truncated to the server default); the default
+    # applies only to clients that sent none. grpc-python reports "no
+    # deadline" as ~2^63 ns remaining, not None, hence the sanity bound.
+    remaining = context.time_remaining()
+    if remaining is not None and remaining < 1e9:
+        budget = min(max(0.0, remaining), 600.0)
+    else:
+        budget = SERVING_DEFAULT_TIMEOUT_S.get()
+    deadline = Deadline(budget, op=f"grpc.{name}")
+    lane = RPC_LANES.get(name, "background")
+    try:
+        ticket = qos.acquire(lane, tenant=tenant, deadline=deadline)
+    except QosRejected as e:
+        context.set_trailing_metadata(
+            (("retry-after", str(int(e.retry_after))),))
+        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+    except DeadlineExceeded as e:
+        context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+    ctx = RequestContext(deadline=deadline, lane=lane, tenant=tenant,
+                         queue_wait_s=ticket.queue_wait)
+    return ticket, ctx
 
 
 def insert_grouped(db: DB, items) -> list[tuple[int, str]]:
@@ -66,15 +113,19 @@ _RPC_AUTHZ = {
 
 
 class GrpcAPI:
-    def __init__(self, db: DB, max_workers: int = 16, auth=None, rbac=None):
+    def __init__(self, db: DB, max_workers: Optional[int] = None,
+                 auth=None, rbac=None, qos=None):
         """``auth``: rest.AuthConfig (API keys); ``rbac``: RBACController.
         Both None = open access, matching the REST defaults — the reference
-        gates its gRPC plane with the same composer chain as REST."""
+        gates its gRPC plane with the same composer chain as REST.
+        ``qos``: AdmissionController; defaults to the DB-shared one so the
+        worker pool below and the REST plane answer to one ceiling."""
         self.db = db
         self.explorer = Explorer(db)
         self.max_workers = max_workers
         self.auth = auth
         self.rbac = rbac
+        self.qos = qos if qos is not None else db.qos
         self._server: Optional[grpc.Server] = None
 
     # -- auth --------------------------------------------------------------
@@ -127,8 +178,13 @@ class GrpcAPI:
             else:
                 self._authz(context, principal, action,
                             resource_fn(request), groups=groups)
+            ticket, ctx = qos_admit(self.qos, name, context,
+                                    tenant=getattr(request, "tenant", ""))
             try:
-                return fn(request)
+                with ticket, request_scope(ctx):
+                    return fn(request)
+            except DeadlineExceeded as e:
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
             except KeyError as e:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             except (ValueError, TypeError) as e:
@@ -321,11 +377,17 @@ class GrpcAPI:
         (grpc signals it by returning port 0)."""
         from weaviate_tpu.api.grpc_v1_compat import WeaviateV1Service
 
+        # pool sized from the admission limiter (like the bounded REST
+        # server): a fixed 16 would queue silently AHEAD of admission,
+        # hiding exactly the backlog the QoS layer exists to shed
+        workers = self.max_workers if self.max_workers is not None \
+            else max(8, min(64, self.qos.limiter.max_limit))
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=self.max_workers))
+            futures.ThreadPoolExecutor(max_workers=workers))
         # native TPU-first plane + the reference's public weaviate.v1
         # contract, one port (stock clients connect unchanged)
-        compat = WeaviateV1Service(self.db, auth=self.auth, rbac=self.rbac)
+        compat = WeaviateV1Service(self.db, auth=self.auth, rbac=self.rbac,
+                                   qos=self.qos)
         self._server.add_generic_rpc_handlers(
             (self._generic_handler(), compat.generic_handler()))
         bound = self._server.add_insecure_port(f"{host}:{port}")

@@ -322,11 +322,14 @@ def _fill_result(sr: wv.SearchResult, obj: StorageObject,
 class WeaviateV1Service:
     """The weaviate.v1 service handlers (registered as generic handlers)."""
 
-    def __init__(self, db: DB, auth=None, rbac=None):
+    def __init__(self, db: DB, auth=None, rbac=None, qos=None):
         self.db = db
         self.explorer = Explorer(db)
         self.auth = auth
         self.rbac = rbac
+        # same admission controller as the native plane (GrpcAPI passes
+        # its own down); stand-alone use shares the DB's controller
+        self.qos = qos if qos is not None else db.qos
 
     # -- auth (same identity machinery as the native plane) ----------------
     def _identity(self, context):
@@ -736,10 +739,23 @@ class WeaviateV1Service:
 
     # -- registration ------------------------------------------------------
     def generic_handler(self):
-        def unary(fn, req_cls):
+        from weaviate_tpu.api.grpc_server import qos_admit
+        from weaviate_tpu.cluster.resilience import DeadlineExceeded
+        from weaviate_tpu.serving.context import request_scope
+
+        def unary(name, fn, req_cls):
             def h(request, context):
+                # same admission + end-to-end deadline as the native
+                # plane (shared qos_admit); tenant rides most requests
+                ticket, ctx = qos_admit(
+                    self.qos, name, context,
+                    tenant=getattr(request, "tenant", ""))
                 try:
-                    return fn(request, context)
+                    with ticket, request_scope(ctx):
+                        return fn(request, context)
+                except DeadlineExceeded as e:
+                    context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                  str(e))
                 except KeyError as e:
                     context.abort(grpc.StatusCode.NOT_FOUND, str(e))
                 except (ValueError, TypeError) as e:
@@ -751,19 +767,26 @@ class WeaviateV1Service:
                 h, request_deserializer=req_cls.FromString,
                 response_serializer=lambda m: m.SerializeToString())
 
+        # BatchStream stays un-admitted: it is flow-controlled per Data
+        # message by the gRPC stream itself, and a mid-stream shed would
+        # strand the client's protocol state machine
         stream = grpc.stream_stream_rpc_method_handler(
             self.batch_stream,
             request_deserializer=wv.BatchStreamRequest.FromString,
             response_serializer=lambda m: m.SerializeToString())
 
         return grpc.method_handlers_generic_handler(SERVICE_V1, {
-            "Search": unary(self.search, wv.SearchRequest),
-            "BatchObjects": unary(self.batch_objects,
+            "Search": unary("Search", self.search, wv.SearchRequest),
+            "BatchObjects": unary("BatchObjects", self.batch_objects,
                                   wv.BatchObjectsRequest),
-            "BatchReferences": unary(self.batch_references,
+            "BatchReferences": unary("BatchReferences",
+                                     self.batch_references,
                                      wv.BatchReferencesRequest),
-            "BatchDelete": unary(self.batch_delete, wv.BatchDeleteRequest),
-            "TenantsGet": unary(self.tenants_get, wv.TenantsGetRequest),
-            "Aggregate": unary(self.aggregate, wv.AggregateRequest),
+            "BatchDelete": unary("BatchDelete", self.batch_delete,
+                                 wv.BatchDeleteRequest),
+            "TenantsGet": unary("TenantsGet", self.tenants_get,
+                                wv.TenantsGetRequest),
+            "Aggregate": unary("Aggregate", self.aggregate,
+                               wv.AggregateRequest),
             "BatchStream": stream,
         })

@@ -10,6 +10,7 @@ unchanged; go-swagger codegen is replaced by explicit werkzeug routing.
 from __future__ import annotations
 
 import json
+import math
 import re
 import threading
 from typing import Any, Optional
@@ -21,13 +22,15 @@ from weaviate_tpu.core.collection import TenantNotActive
 from weaviate_tpu.monitoring.memwatch import MemoryPressure
 from weaviate_tpu.storage.store import ShardClosed
 from werkzeug.routing import Map, Rule
-from werkzeug.serving import make_server
 from werkzeug.wrappers import Request, Response
 
 from weaviate_tpu.api.graphql import GraphQLExecutor, where_to_filter
 from weaviate_tpu.api.schema_translate import class_from_rest, class_to_rest
 from weaviate_tpu.auth.rbac import Forbidden as _Forbidden
+from weaviate_tpu.cluster.resilience import Deadline, DeadlineExceeded
 from weaviate_tpu.core.db import DB
+from weaviate_tpu.serving.context import RequestContext, request_scope
+from weaviate_tpu.serving.qos import QosRejected
 from weaviate_tpu.storage.objects import StorageObject
 from weaviate_tpu.version import __version__
 
@@ -152,10 +155,34 @@ def _consistency(request) -> str:
 
 
 class RestAPI:
+    # endpoints that must answer even under full overload: health probes,
+    # metrics scrapes, and the debug/ops plane an operator needs to SEE
+    # the overload (shedding your own observability is how outages hide)
+    _QOS_EXEMPT = frozenset({
+        "root", "meta", "ready", "live", "metrics", "openapi",
+        "oidc_discovery", "pprof_profile", "pprof_heap", "debug_traces",
+        "debug_config", "debug_telemetry",
+    })
+    # endpoint -> admission lane; anything unlisted is background
+    # (schema/authz/backup/replication mutations: important, not latency-
+    # sensitive, and never allowed to crowd out interactive search)
+    _QOS_LANES = {
+        "graphql": "interactive", "graphql_batch": "interactive",
+        "objects": "interactive", "object": "interactive",
+        "object_by_id": "interactive", "objects_validate": "interactive",
+        "object_references": "interactive",
+        "object_by_id_references": "interactive",
+        "batch_objects": "batch", "batch_references": "batch",
+        "debug_reindex": "batch",
+    }
+
     def __init__(self, db: DB, auth: Optional[AuthConfig] = None,
                  rbac=None, backup_root: Optional[str] = None,
-                 cluster=None):
+                 cluster=None, qos=None):
         self.db = db
+        # admission controller shared with the gRPC planes via the DB by
+        # default (one ceiling for the process); pass qos= to isolate
+        self.qos = qos if qos is not None else db.qos
         self.auth = auth or AuthConfig()
         self.rbac = rbac  # RBACController or None (authz disabled)
         # Optional ClusterNode: object CRUD then rides the replicated
@@ -349,10 +376,22 @@ class RestAPI:
             with TRACER.span(f"rest.{endpoint}",
                              method=request.method,
                              path=request.path):
-                response = handler(request, **args)
+                response = self._dispatch_qos(request, endpoint,
+                                              handler, args)
         except _Forbidden as e:
             response = _json_response(
                 {"error": [{"message": str(e)}]}, 403)
+        except QosRejected as e:
+            # explicit load shed: the client knows WHEN to come back
+            response = _json_response(
+                {"error": [{"message": str(e)}]}, 429)
+            response.headers["Retry-After"] = str(
+                int(math.ceil(e.retry_after)))
+        except DeadlineExceeded as e:
+            # end-to-end budget spent (at admission, in the queue, or
+            # mid-execution) — distinct from the 503 raft TimeoutError
+            response = _json_response(
+                {"error": [{"message": str(e)}]}, 504)
         except _ApiError as e:
             response = _json_response(
                 {"error": [{"message": e.message}]}, e.status)
@@ -384,6 +423,45 @@ class RestAPI:
             response = _json_response(
                 {"error": [{"message": str(e)}]}, status)
         return response(environ, start_response)
+
+    def _dispatch_qos(self, request: Request, endpoint: str, handler,
+                      args: dict) -> Response:
+        """Admission control + end-to-end deadline for one request.
+
+        The deadline is minted HERE (``X-Request-Timeout`` seconds, else
+        the ``serving_default_timeout_s`` knob) and installed in the
+        serving request scope, so collection search, the coalescing
+        dispatcher, and the cluster replica fan-out all clamp to the same
+        budget — no per-layer timeout arithmetic."""
+        if endpoint in self._QOS_EXEMPT or not self.qos.enabled():
+            return handler(request, **args)
+        lane = self._QOS_LANES.get(endpoint, "background")
+        from weaviate_tpu.utils.runtime_config import (
+            SERVING_DEFAULT_TIMEOUT_S,
+        )
+
+        budget = SERVING_DEFAULT_TIMEOUT_S.get()
+        hdr = request.headers.get("X-Request-Timeout", "")
+        if hdr:
+            try:
+                budget = min(float(hdr), 600.0)
+            except ValueError:
+                budget = None
+            # nan would make the deadline never expire AND never satisfy
+            # the wait math; <=0 can only mean a client bug
+            if budget is None or not math.isfinite(budget) or budget <= 0:
+                _abort(400, f"invalid X-Request-Timeout {hdr!r}: "
+                            "expected positive seconds")
+        deadline = Deadline(budget, op=f"rest.{endpoint}")
+        tenant = (request.args.get("tenant", "")
+                  or request.headers.get("X-Tenant", ""))
+        with self.qos.acquire(lane, tenant=tenant,
+                              deadline=deadline) as ticket:
+            ctx = RequestContext(deadline=deadline, lane=lane,
+                                 tenant=tenant,
+                                 queue_wait_s=ticket.queue_wait)
+            with request_scope(ctx):
+                return handler(request, **args)
 
     def _write_action(self, obj: StorageObject) -> str:
         """Puts are upserts: writing an EXISTING uuid needs update_data,
@@ -1238,6 +1316,7 @@ class RestAPI:
         return _json_response({
             "overrides_path": RUNTIME.path or None,
             "values": RUNTIME.snapshot(),
+            "qos": self.qos.snapshot(),
         })
 
     def on_debug_telemetry(self, request):
@@ -1610,8 +1689,26 @@ class RestAPI:
 
     # -- lifecycle ---------------------------------------------------------
     def serve(self, host: str = "127.0.0.1", port: int = 8080,
-              background: bool = True):
-        self._server = make_server(host, port, self, threaded=True)
+              background: bool = True, max_handlers: Optional[int] = None,
+              read_timeout: Optional[float] = None):
+        """Start the bounded REST server (serving/bounded.py): handler
+        concurrency is capped by a fixed pool sized from the admission
+        limiter's ceiling range (not thread-per-connection), and a
+        per-connection read timeout unpins handlers from slow clients."""
+        from weaviate_tpu.serving.bounded import BoundedThreadedWSGIServer
+        from weaviate_tpu.utils.runtime_config import (
+            SERVING_REST_READ_TIMEOUT_S,
+        )
+
+        if max_handlers is None:
+            # enough workers to run a full limiter ceiling plus headroom
+            # to keep ANSWERING sheds (a 429 needs a thread too)
+            max_handlers = max(8, min(64, self.qos.limiter.max_limit))
+        if read_timeout is None:
+            read_timeout = SERVING_REST_READ_TIMEOUT_S.get()
+        self._server = BoundedThreadedWSGIServer(
+            host, port, self, max_handlers=max_handlers,
+            read_timeout=read_timeout)
         if background:
             self._thread = threading.Thread(
                 target=self._server.serve_forever, daemon=True)
@@ -1625,3 +1722,6 @@ class RestAPI:
             self._server.shutdown()
             if self._thread is not None:
                 self._thread.join(timeout=5)
+            # releases the listen fd AND the bounded handler pool —
+            # without this every serve/shutdown cycle leaks both
+            self._server.server_close()

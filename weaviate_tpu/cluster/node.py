@@ -351,6 +351,20 @@ class ClusterNode:
             return self._dispatch(msg)
         return self.transport.send(peer, msg, timeout=timeout)
 
+    def _op_deadline(self, op: str,
+                     deadline: Optional[Deadline] = None) -> Deadline:
+        """The ONE deadline type end-to-end: an explicit caller deadline
+        wins; else the serving layer's ingress deadline (REST header /
+        gRPC context, riding the request scope) governs the whole replica
+        fan-out; only internally-originated ops mint their own budget."""
+        if deadline is not None:
+            return deadline
+        from weaviate_tpu.serving.context import current_deadline
+
+        ingress = current_deadline()
+        return ingress if ingress is not None \
+            else Deadline(self.op_budget, op=op)
+
     def _call(self, peer: str, msg: dict, *, deadline: Deadline,
               timeout: Optional[float] = None) -> dict:
         """Policy-wrapped RPC for the replication data plane: breaker
@@ -415,7 +429,8 @@ class ClusterNode:
         remaining acks land within microseconds, and draining them keeps
         the write synchronous on EVERY replica (no anti-entropy debt); a
         slow or dead straggler costs at most ``linger`` seconds."""
-        results: queue.Queue = queue.Queue()
+        # bounded: each replica leg enqueues at most one result
+        results: queue.Queue = queue.Queue(maxsize=max(1, len(replicas)))
         done = threading.Event()
         # closes the check-then-put race: done is only set while holding
         # this lock, so every result enqueued before the flag flips is in
@@ -512,7 +527,8 @@ class ClusterNode:
 
     # -- write path: 2PC ---------------------------------------------------
     def put_batch(self, cls: str, objs: list[StorageObject],
-                  tenant: str = "", consistency: str = "QUORUM") -> list[str]:
+                  tenant: str = "", consistency: str = "QUORUM",
+                  deadline: Optional[Deadline] = None) -> list[str]:
         col = self.db.get_collection(cls)
         for o in objs:
             o.collection = cls
@@ -530,7 +546,7 @@ class ClusterNode:
             by_shard.setdefault(
                 shard_for_uuid(o.uuid, state.n_shards), []).append(o)
 
-        deadline = Deadline(self.op_budget, op="put_batch")
+        deadline = self._op_deadline("put_batch", deadline)
         for shard, group in by_shard.items():
             replicas = self._ordered(state.replicas(shard))
             txid = str(uuidlib.uuid4())
@@ -700,7 +716,8 @@ class ClusterNode:
 
     # -- delete ------------------------------------------------------------
     def delete(self, cls: str, uuids: list[str], tenant: str = "",
-               consistency: str = "QUORUM") -> int:
+               consistency: str = "QUORUM",
+               deadline: Optional[Deadline] = None) -> int:
         state = self._state_for(cls)
         need = required_acks(consistency, min(state.factor,
                                               len(state.nodes)))
@@ -709,7 +726,7 @@ class ClusterNode:
         for u in uuids:
             by_shard.setdefault(shard_for_uuid(u, state.n_shards), []).append(u)
         deleted = 0
-        deadline = Deadline(self.op_budget, op="delete")
+        deadline = self._op_deadline("delete", deadline)
         for shard, group in by_shard.items():
             acked, errors = self._fan_out(
                 self._ordered(state.replicas(shard)), {
@@ -739,12 +756,13 @@ class ClusterNode:
 
     # -- read path: finder + read-repair -----------------------------------
     def get(self, cls: str, uuid: str, tenant: str = "",
-            consistency: str = "QUORUM") -> Optional[StorageObject]:
+            consistency: str = "QUORUM",
+            deadline: Optional[Deadline] = None) -> Optional[StorageObject]:
         state = self._state_for(cls)
         shard, _ = state.shard_replicas_for_uuid(uuid)
         replicas = self._ordered(state.read_replicas(shard))
         need = required_acks(consistency, min(state.factor, len(replicas)))
-        deadline = Deadline(self.op_budget, op="get")
+        deadline = self._op_deadline("get", deadline)
         digests = self._digest_quorum(cls, tenant, shard, uuid, replicas,
                                       need, deadline)
         if len(digests) < need:
@@ -810,7 +828,8 @@ class ClusterNode:
         return {rep: r["digests"][0] for rep, r in acked}
 
     def exists(self, cls: str, uuid: str, tenant: str = "",
-               consistency: str = "QUORUM") -> bool:
+               consistency: str = "QUORUM",
+               deadline: Optional[Deadline] = None) -> bool:
         """Digest-only existence check: the finder's quorum of version
         digests answers HEAD without ever fetching object bytes. Newest
         digest wins on divergence (a replica that missed a delete must
@@ -819,7 +838,7 @@ class ClusterNode:
         shard, _ = state.shard_replicas_for_uuid(uuid)
         replicas = self._ordered(state.read_replicas(shard))
         need = required_acks(consistency, min(state.factor, len(replicas)))
-        deadline = Deadline(self.op_budget, op="exists")
+        deadline = self._op_deadline("exists", deadline)
         by_rep = self._digest_quorum(cls, tenant, shard, uuid, replicas,
                                      need, deadline)
         digests = list(by_rep.values())
@@ -843,8 +862,7 @@ class ClusterNode:
         digest, so any copy is the right copy). Raises when NO replica
         answers — the callers hold a digest quorum saying the object
         exists, so a fetch shortfall must not read as deletion."""
-        if deadline is None:
-            deadline = Deadline(self.op_budget, op="fetch_one")
+        deadline = self._op_deadline("fetch_one", deadline)
         acked, errors = self._fan_out(
             replicas, {
                 "type": "object_fetch", "class": cls, "tenant": tenant,
@@ -913,11 +931,12 @@ class ClusterNode:
 
     # -- search: scatter-gather --------------------------------------------
     def vector_search(self, cls: str, query: np.ndarray, k: int = 10,
-                      tenant: str = "", target: str = "") \
+                      tenant: str = "", target: str = "",
+                      deadline: Optional[Deadline] = None) \
             -> list[tuple[StorageObject, float]]:
         state = self._state_for(cls)
         q = np.asarray(query, np.float32)
-        deadline = Deadline(self.op_budget, op="vector_search")
+        deadline = self._op_deadline("vector_search", deadline)
 
         def one_shard(shard: int) -> list[tuple[float, bytes]]:
             r = self._first_replica(state, shard, {
@@ -965,9 +984,11 @@ class ClusterNode:
         return {"hits": hits}
 
     def bm25_search(self, cls: str, query: str, k: int = 10,
-                    tenant: str = "") -> list[tuple[StorageObject, float]]:
+                    tenant: str = "",
+                    deadline: Optional[Deadline] = None) \
+            -> list[tuple[StorageObject, float]]:
         state = self._state_for(cls)
-        deadline = Deadline(self.op_budget, op="bm25_search")
+        deadline = self._op_deadline("bm25_search", deadline)
 
         def one_shard(shard: int) -> list[tuple[float, bytes]]:
             try:

@@ -852,6 +852,7 @@ class Collection:
         flt: Optional[Filter] = None,
         tenant: str = "",
         max_distance: Optional[float] = None,
+        deadline=None,
     ) -> list[tuple[StorageObject, float]]:
         """Single-query convenience wrapper over batched scatter-gather."""
         res = self.vector_search_batch(
@@ -861,6 +862,7 @@ class Collection:
             flt=flt,
             tenant=tenant,
             max_distance=max_distance,
+            deadline=deadline,
         )
         return res[0]
 
@@ -872,34 +874,61 @@ class Collection:
         flt: Optional[Filter] = None,
         tenant: str = "",
         max_distance: Optional[float] = None,
+        deadline=None,
     ) -> list[list[tuple[StorageObject, float]]]:
         from weaviate_tpu.monitoring.metrics import (
             QUERIES_TOTAL,
             QUERY_DURATION,
         )
         from weaviate_tpu.monitoring.slow_query import REPORTER
+        from weaviate_tpu.serving import context as serving_ctx
 
+        # end-to-end deadline (serving/context.py): an expired request is
+        # shed HERE, before any shard filter/search work and before the
+        # dispatcher could hand it a device batch slot
+        req_ctx = serving_ctx.current()
+        if deadline is None:
+            deadline = req_ctx.deadline if req_ctx is not None else None
+        elif req_ctx is None:
+            # explicit deadline without an ingress scope (direct API use):
+            # still propagate it into the shard pool / dispatcher
+            req_ctx = serving_ctx.RequestContext(deadline=deadline)
+        if deadline is not None:
+            deadline.require()
         t0 = time.perf_counter()
         shards = self._search_shards(tenant)
         per_shard: list[tuple[Shard, SearchResult]] = []
 
         def run(shard: Shard):
-            with REPORTER.track("vector", collection=self.config.name,
-                                shard=shard.name) as tr:
+            # pool threads don't inherit the caller's thread-local request
+            # scope; re-enter it so the dispatcher sees the deadline
+            with serving_ctx.request_scope(req_ctx), \
+                    REPORTER.track("vector", collection=self.config.name,
+                                   shard=shard.name) as tr:
                 allow = None
                 if flt is not None:
                     allow = shard.allow_list(flt)
                 tr.stage("filter")
+                if deadline is not None:
+                    deadline.require()  # filter work may have spent it
                 res = shard.vector_search(
                     queries, k, target=target, allow_list=allow,
                     max_distance=max_distance)
                 tr.stage("search")
             return shard, res
 
-        if len(shards) == 1:
-            per_shard = [run(shards[0])]
-        else:
-            per_shard = list(self._pool.map(run, shards))
+        # request-level tracker: folds the admission queue wait in ONCE
+        # (the per-shard trackers above deliberately don't, so a queued
+        # request can't log N-shards duplicate slow-query lines)
+        with REPORTER.track("vector_request",
+                            collection=self.config.name,
+                            include_queue_wait=True,
+                            shards=len(shards)) as req_tr:
+            if len(shards) == 1:
+                per_shard = [run(shards[0])]
+            else:
+                per_shard = list(self._pool.map(run, shards))
+            req_tr.stage("scatter")
         QUERIES_TOTAL.inc(type="vector", collection=self.config.name)
         QUERY_DURATION.observe(time.perf_counter() - t0, type="vector")
 
@@ -936,32 +965,42 @@ class Collection:
         tenant: str = "",
         operator: str = "Or",
         minimum_match: int = 0,
+        deadline=None,
     ) -> list[tuple[StorageObject, float]]:
         from weaviate_tpu.monitoring.metrics import (
             QUERIES_TOTAL,
             QUERY_DURATION,
         )
+        from weaviate_tpu.monitoring.slow_query import REPORTER
+        from weaviate_tpu.serving.context import current_deadline
 
+        if deadline is None:
+            deadline = current_deadline()
         t0 = time.perf_counter()
         results: list[tuple[float, Shard, int]] = []
-        for shard in self._search_shards(tenant):
-            allow = None
-            space = max(shard._next_doc_id, 1)
-            if flt is not None:
-                allow = shard.allow_list(flt, space)
-            ids, scores = shard.inverted.bm25_search(
-                query, k, properties=properties, allow_list=allow,
-                doc_space=space, operator=operator,
-                minimum_match=minimum_match,
-            )
-            for i, s in zip(ids, scores):
-                results.append((float(s), shard, int(i)))
-        results.sort(key=lambda t: -t[0])
-        out = []
-        for s, shard, docid in results[:k]:
-            obj = shard.get_by_docid(docid)
-            if obj is not None:
-                out.append((obj, s))
+        # request-level slow-query tracker (folds admission queue wait in)
+        with REPORTER.track("bm25", collection=self.config.name,
+                            include_queue_wait=True):
+            for shard in self._search_shards(tenant):
+                if deadline is not None:
+                    deadline.require()  # shed between shards
+                allow = None
+                space = max(shard._next_doc_id, 1)
+                if flt is not None:
+                    allow = shard.allow_list(flt, space)
+                ids, scores = shard.inverted.bm25_search(
+                    query, k, properties=properties, allow_list=allow,
+                    doc_space=space, operator=operator,
+                    minimum_match=minimum_match,
+                )
+                for i, s in zip(ids, scores):
+                    results.append((float(s), shard, int(i)))
+            results.sort(key=lambda t: -t[0])
+            out = []
+            for s, shard, docid in results[:k]:
+                obj = shard.get_by_docid(docid)
+                if obj is not None:
+                    out.append((obj, s))
         QUERIES_TOTAL.inc(type="bm25", collection=self.config.name)
         QUERY_DURATION.observe(time.perf_counter() - t0, type="bm25")
         return out

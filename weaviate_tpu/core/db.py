@@ -30,6 +30,10 @@ class DB:
         os.makedirs(root, exist_ok=True)
         self._lock = threading.RLock()
         self._collections: dict[str, Collection] = {}
+        # serving QoS controller, shared by every API plane mounted on
+        # this DB (REST + both gRPC services) so one AIMD ceiling governs
+        # total in-flight work; built lazily — most tests never serve
+        self._qos = None
         # collection aliases (reference /v1/aliases): alias -> class,
         # one namespace with class names, resolved in get_collection
         self._aliases: dict[str, str] = {}
@@ -147,6 +151,16 @@ class DB:
             self._collections[config.name] = c
             self._persist_schema()
             return c
+
+    @property
+    def qos(self):
+        """The admission controller for API planes serving this DB."""
+        with self._lock:
+            if self._qos is None:
+                from weaviate_tpu.serving.qos import AdmissionController
+
+                self._qos = AdmissionController()
+            return self._qos
 
     def get_collection(self, name: str) -> Collection:
         c = self._collections.get(name)

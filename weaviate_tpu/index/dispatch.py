@@ -24,18 +24,29 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from weaviate_tpu.monitoring.metrics import (
+    DISPATCH_DEVICE_ROWS,
+    DISPATCH_EXPIRED,
+)
+
 
 class _Req:
-    __slots__ = ("queries", "k", "allow", "event", "ids", "dists", "error")
+    __slots__ = ("queries", "k", "allow", "deadline", "event", "ids",
+                 "dists", "error")
 
-    def __init__(self, queries: np.ndarray, k: int, allow):
+    def __init__(self, queries: np.ndarray, k: int, allow, deadline=None):
         self.queries = queries
         self.k = k
         self.allow = allow
+        self.deadline = deadline  # cluster.resilience.Deadline or None
         self.event = threading.Event()
         self.ids: Optional[np.ndarray] = None
         self.dists: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and self.deadline.expired
 
 
 class CoalescingDispatcher:
@@ -52,8 +63,14 @@ class CoalescingDispatcher:
         self._pending: list[_Req] = []
         self._draining = False
 
-    def search(self, queries: np.ndarray, k: int, allow=None):
-        req = _Req(queries, k, allow)
+    def search(self, queries: np.ndarray, k: int, allow=None, deadline=None):
+        if deadline is None:
+            # the serving layer's end-to-end budget rides a thread-scoped
+            # context so index signatures in between stay deadline-free
+            from weaviate_tpu.serving.context import current_deadline
+
+            deadline = current_deadline()
+        req = _Req(queries, k, allow, deadline)
         with self._lock:
             self._pending.append(req)
         # Every waiter is a potential leader: whoever finds no active
@@ -76,14 +93,44 @@ class CoalescingDispatcher:
                         self._draining = False
             if req.event.wait(timeout=0.02):
                 break
+            if req.expired:
+                # shed from the queue BEFORE a leader batches it; a
+                # request already taken in flight just waits its result
+                with self._lock:
+                    try:
+                        self._pending.remove(req)
+                        shed = True
+                    except ValueError:
+                        shed = False
+                if shed:
+                    DISPATCH_EXPIRED.inc()
+                    req.deadline.require()  # raises DeadlineExceeded
         if req.error is not None:
             raise req.error
         return req.ids, req.dists
 
     # -- leader ------------------------------------------------------------
     def _take_group(self) -> list[_Req]:
-        """Pop the next compatible group under the lock (empty = done)."""
+        """Pop the next compatible group under the lock (empty = done).
+        Requests whose deadline expired while queued are shed here —
+        an expired request must never occupy a device batch slot."""
+        expired: list[_Req] = []
+        group = self._take_group_locked(expired)
+        for r in expired:
+            DISPATCH_EXPIRED.inc()
+            try:
+                r.deadline.require()
+            except TimeoutError as e:  # DeadlineExceeded
+                r.error = e
+            r.event.set()
+        return group
+
+    def _take_group_locked(self, expired: list[_Req]) -> list[_Req]:
         with self._lock:
+            alive = []
+            for r in self._pending:
+                (expired if r.expired else alive).append(r)
+            self._pending[:] = alive
             if not self._pending:
                 return []
             head = self._pending[0]
@@ -111,6 +158,7 @@ class CoalescingDispatcher:
             try:
                 q = (group[0].queries if len(group) == 1
                      else np.concatenate([r.queries for r in group], axis=0))
+                DISPATCH_DEVICE_ROWS.inc(q.shape[0])
                 ids, dists = self.run_batch(q, group[0].k, group[0].allow)
                 at = 0
                 for r in group:

@@ -208,3 +208,41 @@ STAGING_ABORTED = REGISTRY.counter(
 CHAOS_FAULTS = REGISTRY.counter(
     "weaviate_tpu_chaos_faults_total",
     "faults fired by ChaosTransport, by kind and link")
+
+# serving QoS instruments (serving/qos.py admission controller + the
+# deadline-aware coalescing dispatcher): the overload story is observable
+# end to end — what was admitted, what was shed and why, how long admitted
+# work queued, and what the adaptive limiter currently allows
+QOS_ADMITTED = REGISTRY.counter(
+    "weaviate_tpu_qos_admitted_total",
+    "requests admitted past the QoS controller, by lane")
+QOS_SHED = REGISTRY.counter(
+    "weaviate_tpu_qos_shed_total",
+    "requests rejected by the QoS controller, by lane and reason "
+    "(queue_full/tenant_rate)")
+QOS_EXPIRED = REGISTRY.counter(
+    "weaviate_tpu_qos_expired_total",
+    "requests whose deadline expired at admission or while queued, by lane")
+QOS_QUEUE_DEPTH = REGISTRY.gauge(
+    "weaviate_tpu_qos_queue_depth",
+    "requests currently waiting in the admission queue, by lane")
+QOS_QUEUE_WAIT = REGISTRY.histogram(
+    "weaviate_tpu_qos_queue_wait_seconds",
+    "time admitted requests spent queued before execution, by lane")
+QOS_LIMIT = REGISTRY.gauge(
+    "weaviate_tpu_qos_limit",
+    "current AIMD concurrency ceiling of the admission controller")
+QOS_INFLIGHT = REGISTRY.gauge(
+    "weaviate_tpu_qos_inflight",
+    "requests currently executing under the admission controller")
+QOS_TENANT_THROTTLED = REGISTRY.counter(
+    "weaviate_tpu_qos_tenant_throttled_total",
+    "requests rejected by the per-tenant token bucket, by tenant")
+DISPATCH_EXPIRED = REGISTRY.counter(
+    "weaviate_tpu_dispatch_expired_total",
+    "queued searches shed by the coalescing dispatcher because their "
+    "deadline expired before device execution")
+DISPATCH_DEVICE_ROWS = REGISTRY.counter(
+    "weaviate_tpu_dispatch_device_rows_total",
+    "query rows the coalescing dispatcher actually sent to device "
+    "batches (expired rows never count here)")

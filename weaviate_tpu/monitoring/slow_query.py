@@ -22,15 +22,23 @@ class SlowQueryReporter:
         self.threshold_s = threshold_s
         self.enabled = enabled
 
-    def track(self, kind: str, **fields) -> "_Tracker":
-        return _Tracker(self, kind, fields)
+    def track(self, kind: str, include_queue_wait: bool = False,
+              **fields) -> "_Tracker":
+        """``include_queue_wait=True`` marks a REQUEST-scoped tracker:
+        the serving admission queue wait is folded into its total and
+        logged separately from execute time. Inner (per-shard/per-stage)
+        trackers leave it False so one queued request does not log once
+        per shard with the same wait misattributed to each."""
+        return _Tracker(self, kind, fields, include_queue_wait)
 
 
 class _Tracker:
-    def __init__(self, reporter: SlowQueryReporter, kind: str, fields: dict):
+    def __init__(self, reporter: SlowQueryReporter, kind: str, fields: dict,
+                 include_queue_wait: bool = False):
         self.reporter = reporter
         self.kind = kind
         self.fields = fields
+        self.include_queue_wait = include_queue_wait
         self.stages: list[tuple[str, float]] = []
         self._t0 = 0.0
         self._last = 0.0
@@ -45,7 +53,7 @@ class _Tracker:
         self._last = now
 
     def __exit__(self, *exc):
-        total = time.perf_counter() - self._t0
+        execute = time.perf_counter() - self._t0
         # hot-reloadable threshold (utils/runtime_config; reference
         # DynamicValue consumers read per use, never cache)
         from weaviate_tpu.utils.runtime_config import SLOW_QUERY_THRESHOLD_S
@@ -53,12 +61,25 @@ class _Tracker:
         threshold = (SLOW_QUERY_THRESHOLD_S.get()
                      if SLOW_QUERY_THRESHOLD_S.overridden
                      else self.reporter.threshold_s)
+        # queue wait from the serving admission layer: a query that sat
+        # 2s in the QoS queue and ran 10ms IS slow end-to-end, and the
+        # split tells the operator whether to fix the query or the load
+        queue_wait = 0.0
+        if self.include_queue_wait:
+            from weaviate_tpu.serving.context import current
+
+            ctx = current()
+            queue_wait = ctx.queue_wait_s if ctx is not None else 0.0
+        total = queue_wait + execute
         if self.reporter.enabled and total >= threshold:
             detail = " ".join(
                 f"{n}={dt * 1000:.1f}ms" for n, dt in self.stages)
             extra = " ".join(f"{k}={v}" for k, v in self.fields.items())
-            logger.warning("slow %s query: total=%.1fms %s %s",
-                           self.kind, total * 1000, detail, extra)
+            logger.warning(
+                "slow %s query: total=%.1fms queue_wait=%.1fms "
+                "execute=%.1fms %s %s",
+                self.kind, total * 1000, queue_wait * 1000,
+                execute * 1000, detail, extra)
         return False
 
 

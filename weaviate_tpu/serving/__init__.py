@@ -1,0 +1,39 @@
+"""Serving QoS layer: admission control, deadlines, adaptive shedding.
+
+The layer between the API servers (REST/gRPC) and the query engine.
+Reference analogue: the Go stack leans on gRPC's deadline machinery plus
+goroutine-per-request cheapness; a TPU inference server cannot — device
+batches are the throughput mechanism (SURVEY §7), so overload must be
+absorbed BEFORE a request burns a batch slot. Three parts:
+
+- :mod:`~weaviate_tpu.serving.qos` — admission controller: per-lane
+  bounded queues (interactive / batch / background), an AIMD concurrency
+  limiter driven by observed latency, explicit load shedding with a
+  computed Retry-After, and weighted-fair dequeue across lanes+tenants.
+- :mod:`~weaviate_tpu.serving.context` — per-request scope carrying the
+  single end-to-end :class:`~weaviate_tpu.cluster.resilience.Deadline`
+  from ingress through collection search, the coalescing dispatcher, and
+  the cluster replica fan-out.
+- :mod:`~weaviate_tpu.serving.bounded` — the bounded-concurrency WSGI
+  server the REST plane runs on (thread-per-connection is how p99 dies).
+"""
+
+from weaviate_tpu.serving.context import (
+    RequestContext,
+    current,
+    current_deadline,
+    request_scope,
+)
+from weaviate_tpu.serving.limiter import AIMDLimiter
+from weaviate_tpu.serving.qos import (
+    AdmissionController,
+    LaneConfig,
+    QosRejected,
+)
+from weaviate_tpu.serving.tenancy import TenantThrottle, TokenBucket
+
+__all__ = [
+    "AdmissionController", "LaneConfig", "QosRejected", "AIMDLimiter",
+    "TenantThrottle", "TokenBucket", "RequestContext", "request_scope",
+    "current", "current_deadline",
+]

@@ -1,0 +1,72 @@
+"""Bounded-concurrency WSGI server for the REST plane.
+
+werkzeug's ``make_server(threaded=True)`` is thread-per-connection with
+no cap: 10k slow clients are 10k handler threads, and a client that
+stops reading pins its thread forever (no socket timeout). This server
+keeps werkzeug's request handling but:
+
+- runs handlers on a FIXED pool (``max_handlers`` workers, sized from
+  the admission controller's limiter by the caller);
+- bounds accepted-but-unprocessed connections with a semaphore — when
+  every worker is busy and the runway is full, the ACCEPT LOOP blocks,
+  so overflow lands in the kernel listen backlog where the OS applies
+  backpressure (instead of an unbounded in-process queue);
+- sets a per-connection socket timeout so a slow-loris client gets
+  disconnected instead of holding a worker hostage.
+
+Load-based rejection (429) is the admission controller's job; this layer
+only guarantees the PROCESS can't be resource-exhausted by connection
+count alone.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+from werkzeug.serving import ThreadedWSGIServer
+
+
+class BoundedThreadedWSGIServer(ThreadedWSGIServer):
+    # runway beyond the worker count: connections parked here are cheap
+    # (one fd + one semaphore token), and the admission controller sheds
+    # their requests quickly once a worker picks them up
+    RUNWAY_FACTOR = 2
+
+    def __init__(self, host: str, port: int, app,
+                 max_handlers: int = 32, read_timeout: float = 30.0):
+        super().__init__(host, port, app)
+        self.max_handlers = max(1, int(max_handlers))
+        self.read_timeout = float(read_timeout)
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.max_handlers, thread_name_prefix="rest-handler")
+        self._slots = threading.BoundedSemaphore(
+            self.max_handlers * self.RUNWAY_FACTOR)
+
+    def process_request(self, request, client_address):
+        if self.read_timeout > 0:
+            request.settimeout(self.read_timeout)
+        # full runway blocks the accept loop (kernel-backlog
+        # backpressure) — but never past a shutdown() request, which
+        # the serve_forever loop can only honor once we return
+        while not self._slots.acquire(timeout=0.5):
+            if getattr(self, "_BaseServer__shutdown_request", False):
+                self.shutdown_request(request)
+                return
+        try:
+            self._pool.submit(self._run_one, request, client_address)
+        except RuntimeError:  # pool already shut down mid-stop
+            self._slots.release()
+            self.shutdown_request(request)
+
+    def _run_one(self, request, client_address):
+        try:
+            # ThreadingMixIn's worker body: finish_request + handle_error
+            # + shutdown_request, exactly what the unbounded server ran
+            self.process_request_thread(request, client_address)
+        finally:
+            self._slots.release()
+
+    def server_close(self):
+        super().server_close()
+        self._pool.shutdown(wait=False)

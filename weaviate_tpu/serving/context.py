@@ -1,0 +1,62 @@
+"""Per-request serving context: the end-to-end deadline, thread-scoped.
+
+One :class:`~weaviate_tpu.cluster.resilience.Deadline` is minted at
+ingress (REST ``X-Request-Timeout`` header / gRPC context deadline /
+server default) and travels with the request. Deep layers — collection
+scatter-gather, the coalescing dispatcher, the cluster replica fan-out —
+read it from here instead of growing a ``deadline=`` parameter on every
+signature in between.
+
+Scope is THREAD-local, not a contextvar: the query engine fans work out
+through plain ``ThreadPoolExecutor`` pools, which never propagate
+contextvars. Any closure that hops threads re-enters the scope explicitly
+(``with request_scope(ctx):`` — see ``Collection.vector_search_batch``),
+which keeps the propagation points grep-able.
+
+This module depends on nothing but the stdlib so every layer may import
+it without cycles; the Deadline object itself is duck-typed (anything
+with ``remaining()/expired/require()``).
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Iterator, Optional
+
+
+@dataclass
+class RequestContext:
+    """What the QoS layer learned about one in-flight request."""
+
+    deadline: Optional[Any] = None  # cluster.resilience.Deadline
+    lane: str = ""
+    tenant: str = ""
+    queue_wait_s: float = 0.0  # admission-queue wait, for slow-query logs
+
+
+_local = threading.local()
+
+
+def current() -> Optional[RequestContext]:
+    return getattr(_local, "ctx", None)
+
+
+def current_deadline() -> Optional[Any]:
+    ctx = current()
+    return None if ctx is None else ctx.deadline
+
+
+@contextmanager
+def request_scope(ctx: Optional[RequestContext]) -> Iterator[
+        Optional[RequestContext]]:
+    """Install ``ctx`` as the thread's request context; restores the
+    previous one on exit so nested scopes (a subrequest minting a shorter
+    deadline) unwind correctly."""
+    prev = getattr(_local, "ctx", None)
+    _local.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _local.ctx = prev

@@ -1,0 +1,70 @@
+"""AIMD concurrency limiter: the admission controller's adaptive ceiling.
+
+Reference model: TCP congestion control applied to server concurrency
+(the Netflix concurrency-limits shape). The controller feeds every
+admitted request's END-TO-END latency (queue wait + execute) in; once a
+window of samples has accumulated, the observed p99 is compared against
+the target:
+
+- p99 over target  -> multiplicative decrease (the server is past its
+  latency knee; shrinking concurrency is the only move that helps)
+- p99 under target -> additive increase (probe for headroom, one slot
+  per window, so recovery is gradual and cannot oscillate wildly)
+
+The ceiling is what the admission controller compares in-flight work
+against; everything above it queues or sheds. Deterministic and fully
+injectable — tests drive it by recording synthetic latencies.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from weaviate_tpu.monitoring.metrics import QOS_LIMIT
+
+
+class AIMDLimiter:
+    def __init__(self, initial: int = 16, min_limit: int = 1,
+                 max_limit: int = 256, target_p99_s: float = 0.75,
+                 window: int = 32, increase: float = 1.0,
+                 decrease: float = 0.5):
+        if not (0 < min_limit <= initial <= max_limit):
+            raise ValueError(
+                f"need min <= initial <= max, got {min_limit}/{initial}"
+                f"/{max_limit}")
+        if not (0.0 < decrease < 1.0):
+            raise ValueError("decrease must be a factor in (0, 1)")
+        self.min_limit = int(min_limit)
+        self.max_limit = int(max_limit)
+        self.target_p99_s = float(target_p99_s)
+        self.window = max(1, int(window))
+        self.increase = float(increase)
+        self.decrease = float(decrease)
+        self._limit = float(initial)
+        self._samples: list[float] = []  # bounded: reset every `window`
+        self._lock = threading.Lock()
+        QOS_LIMIT.set(self.ceiling)
+
+    @property
+    def ceiling(self) -> int:
+        """Current concurrency ceiling (>= min_limit always)."""
+        return max(self.min_limit, int(self._limit))
+
+    def record(self, latency_s: float) -> None:
+        """Feed one admitted request's queue+execute latency; adjusts the
+        ceiling once per full window."""
+        with self._lock:
+            self._samples.append(float(latency_s))
+            if len(self._samples) < self.window:
+                return
+            samples = sorted(self._samples)
+            self._samples = []
+            p99 = samples[min(len(samples) - 1,
+                              int(0.99 * (len(samples) - 1)))]
+            if p99 > self.target_p99_s:
+                self._limit = max(float(self.min_limit),
+                                  self._limit * self.decrease)
+            else:
+                self._limit = min(float(self.max_limit),
+                                  self._limit + self.increase)
+            QOS_LIMIT.set(self.ceiling)

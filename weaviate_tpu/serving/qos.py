@@ -1,0 +1,301 @@
+"""Admission controller: bounded lanes, AIMD ceiling, explicit shedding.
+
+The front door's overload contract (ISSUE 4; the shape every TPU
+inference server needs between its RPC plane and its batch scheduler):
+
+- Work is classified into LANES — ``interactive`` (search), ``batch``
+  (bulk ingest), ``background`` (schema/ops) — each with its own bounded
+  queue and a weight for the fair dequeue. A full lane sheds instead of
+  queueing: HTTP 429 / gRPC RESOURCE_EXHAUSTED with a computed
+  ``Retry-After``, never an invisible unbounded queue.
+- Total in-flight work is capped by an :class:`AIMDLimiter` ceiling fed
+  with observed queue+execute latency, so the cap tracks what the
+  hardware can actually sustain instead of a hand-tuned constant.
+- A request whose :class:`~weaviate_tpu.cluster.resilience.Deadline` is
+  already spent (or expires while queued) is shed with 504 /
+  DEADLINE_EXCEEDED *here*, before it can burn a device batch slot.
+- Dequeue is weighted-fair: smooth weighted round-robin across lanes
+  (nginx's algorithm), plain round-robin across tenants inside a lane —
+  one hot tenant cannot starve the rest even after the token bucket
+  (:mod:`~weaviate_tpu.serving.tenancy`) let its requests in.
+
+The whole layer is bypassable at runtime: ``serving_qos=off`` in the
+runtime-overrides file restores the pre-QoS behavior (every acquire
+returns a no-op ticket).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from weaviate_tpu.monitoring.metrics import (
+    QOS_ADMITTED,
+    QOS_EXPIRED,
+    QOS_INFLIGHT,
+    QOS_QUEUE_DEPTH,
+    QOS_QUEUE_WAIT,
+    QOS_SHED,
+    QOS_TENANT_THROTTLED,
+)
+from weaviate_tpu.serving.limiter import AIMDLimiter
+from weaviate_tpu.serving.tenancy import TenantThrottle
+
+INTERACTIVE = "interactive"
+BATCH = "batch"
+BACKGROUND = "background"
+
+
+class QosRejected(RuntimeError):
+    """Load shed: the caller should retry after ``retry_after`` seconds
+    (HTTP 429 + Retry-After / gRPC RESOURCE_EXHAUSTED)."""
+
+    def __init__(self, message: str, retry_after: float, reason: str):
+        super().__init__(message)
+        self.retry_after = retry_after
+        self.reason = reason
+
+
+@dataclass(frozen=True)
+class LaneConfig:
+    name: str
+    weight: int  # fair-dequeue share relative to the other lanes
+    max_queue_depth: int  # waiters beyond this are shed, not queued
+
+
+DEFAULT_LANES = (
+    LaneConfig(INTERACTIVE, weight=8, max_queue_depth=64),
+    LaneConfig(BATCH, weight=2, max_queue_depth=32),
+    LaneConfig(BACKGROUND, weight=1, max_queue_depth=32),
+)
+
+
+class _Waiter:
+    __slots__ = ("lane", "tenant", "event", "admitted")
+
+    def __init__(self, lane: str, tenant: str):
+        self.lane = lane
+        self.tenant = tenant
+        self.event = threading.Event()
+        self.admitted = False
+
+
+class _Ticket:
+    """Held for the request's execution; releasing it feeds the limiter
+    and hands the freed slot to the next fair-dequeue winner."""
+
+    __slots__ = ("_ctl", "lane", "t0", "queue_wait")
+
+    def __init__(self, ctl: Optional["AdmissionController"], lane: str,
+                 t0: float, queue_wait: float = 0.0):
+        self._ctl = ctl  # None = QoS bypassed, ticket is a no-op
+        self.lane = lane
+        self.t0 = t0
+        self.queue_wait = queue_wait
+
+    def __enter__(self) -> "_Ticket":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._ctl is not None:
+            self._ctl._release(self)
+        return False
+
+
+class AdmissionController:
+    def __init__(self, limiter: Optional[AIMDLimiter] = None,
+                 throttle: Optional[TenantThrottle] = None,
+                 lanes: tuple[LaneConfig, ...] = DEFAULT_LANES,
+                 clock: Callable[[], float] = time.monotonic):
+        self.limiter = limiter or AIMDLimiter()
+        self.throttle = throttle or TenantThrottle()
+        self.lanes = {cfg.name: cfg for cfg in lanes}
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._inflight = 0
+        # lane -> tenant -> waiter FIFO; depth is enforced under _lock
+        # before every append, so these can never grow past the lane cap
+        self._queues: dict[str, dict[str, deque]] = {
+            name: {} for name in self.lanes}
+        self._depths: dict[str, int] = {name: 0 for name in self.lanes}
+        self._tenant_ring: dict[str, list[str]] = {
+            name: [] for name in self.lanes}
+        self._credits: dict[str, float] = {name: 0.0 for name in self.lanes}
+        self._svc_ewma = 0.05  # smoothed queue+execute seconds, Retry-After
+
+    # -- admission ---------------------------------------------------------
+    @staticmethod
+    def enabled() -> bool:
+        from weaviate_tpu.utils.runtime_config import SERVING_QOS
+
+        return str(SERVING_QOS.get()).lower() not in ("off", "false", "0")
+
+    def acquire(self, lane: str = INTERACTIVE, tenant: str = "",
+                deadline=None) -> _Ticket:
+        """Admit, queue, or shed. Returns a ticket (context manager) on
+        admission; raises :class:`QosRejected` on shed and
+        ``DeadlineExceeded`` when the request's budget is spent before a
+        slot opened."""
+        if not self.enabled():
+            return _Ticket(None, lane, self._clock())
+        cfg = self.lanes.get(lane) or self.lanes[BACKGROUND]
+        lane = cfg.name
+        if deadline is not None and deadline.expired:
+            QOS_EXPIRED.inc(lane=lane)
+            deadline.require()  # raises DeadlineExceeded
+        throttle_wait = self.throttle.check(tenant)
+        if throttle_wait is not None:
+            # label cardinality must stay bounded: only operator-pinned
+            # tenant names become series; the client-controlled rest
+            # aggregate under "default"
+            QOS_TENANT_THROTTLED.inc(
+                tenant=tenant if self.throttle.has_override(tenant)
+                else "default")
+            QOS_SHED.inc(lane=lane, reason="tenant_rate")
+            raise QosRejected(
+                f"tenant {tenant or 'default'!r} over its rate limit",
+                retry_after=max(1.0, math.ceil(throttle_wait)),
+                reason="tenant_rate")
+        t0 = self._clock()
+        with self._lock:
+            if self._inflight < self.limiter.ceiling \
+                    and not self._queued_total():
+                self._inflight += 1
+                QOS_INFLIGHT.set(self._inflight)
+                QOS_ADMITTED.inc(lane=lane)
+                return _Ticket(self, lane, t0)
+            if self._lane_depth(lane) >= cfg.max_queue_depth:
+                QOS_SHED.inc(lane=lane, reason="queue_full")
+                raise QosRejected(
+                    f"overloaded: {lane} admission queue full "
+                    f"(depth {cfg.max_queue_depth})",
+                    retry_after=self._retry_after_locked(),
+                    reason="queue_full")
+            waiter = _Waiter(lane, tenant)
+            self._enqueue_locked(waiter)
+        try:
+            self._wait(waiter, deadline)
+        except BaseException:
+            # not admitted (deadline/interrupt): leave no orphan waiter
+            with self._lock:
+                if not waiter.admitted:
+                    self._remove_locked(waiter)
+                admitted_anyway = waiter.admitted
+            if admitted_anyway:
+                # the slot was granted in the race window; hand it back
+                self._release(_Ticket(self, lane, t0))
+            raise
+        queue_wait = self._clock() - t0
+        QOS_QUEUE_WAIT.observe(queue_wait, lane=lane)
+        QOS_ADMITTED.inc(lane=lane)
+        return _Ticket(self, lane, t0, queue_wait=queue_wait)
+
+    def _wait(self, waiter: _Waiter, deadline) -> None:
+        while True:
+            timeout = 5.0
+            if deadline is not None:
+                timeout = min(timeout, max(0.0, deadline.remaining()))
+            if waiter.event.wait(timeout=timeout):
+                return
+            if deadline is not None and deadline.expired:
+                QOS_EXPIRED.inc(lane=waiter.lane)
+                deadline.require()  # raises DeadlineExceeded
+
+    # -- release + fair dequeue --------------------------------------------
+    def _release(self, ticket: _Ticket) -> None:
+        total = max(0.0, self._clock() - ticket.t0)
+        with self._lock:
+            self._inflight -= 1
+            self._svc_ewma = 0.8 * self._svc_ewma + 0.2 * max(total, 1e-4)
+            self.limiter.record(total)
+            while self._inflight < self.limiter.ceiling:
+                waiter = self._pick_next_locked()
+                if waiter is None:
+                    break
+                self._inflight += 1
+                waiter.admitted = True
+                waiter.event.set()
+            QOS_INFLIGHT.set(self._inflight)
+
+    def _pick_next_locked(self) -> Optional[_Waiter]:
+        """Smooth weighted round-robin across non-empty lanes, then
+        round-robin across that lane's tenants."""
+        candidates = [name for name in self.lanes
+                      if self._lane_depth(name) > 0]
+        if not candidates:
+            return None
+        total_weight = sum(self.lanes[n].weight for n in candidates)
+        for name in candidates:
+            self._credits[name] += self.lanes[name].weight
+        winner = max(candidates, key=lambda n: self._credits[n])
+        self._credits[winner] -= total_weight
+        ring = self._tenant_ring[winner]
+        tenant = ring.pop(0)
+        q = self._queues[winner][tenant]
+        waiter = q.popleft()
+        self._depths[winner] -= 1
+        if q:
+            ring.append(tenant)  # back of the ring: round-robin
+        else:
+            del self._queues[winner][tenant]
+        QOS_QUEUE_DEPTH.set(self._depths[winner], lane=winner)
+        return waiter
+
+    # -- queue bookkeeping (all under _lock) -------------------------------
+    def _enqueue_locked(self, waiter: _Waiter) -> None:
+        by_tenant = self._queues[waiter.lane]
+        if waiter.tenant not in by_tenant:
+            by_tenant[waiter.tenant] = deque()  # graftlint: allow[unbounded-queue] reason=depth checked against max_queue_depth under _lock before every append
+            self._tenant_ring[waiter.lane].append(waiter.tenant)
+        by_tenant[waiter.tenant].append(waiter)
+        self._depths[waiter.lane] += 1
+        QOS_QUEUE_DEPTH.set(self._depths[waiter.lane], lane=waiter.lane)
+
+    def _remove_locked(self, waiter: _Waiter) -> None:
+        by_tenant = self._queues[waiter.lane]
+        q = by_tenant.get(waiter.tenant)
+        if q is None:
+            return
+        try:
+            q.remove(waiter)
+        except ValueError:
+            return  # already dequeued by a releaser
+        self._depths[waiter.lane] -= 1
+        if not q:
+            del by_tenant[waiter.tenant]
+            try:
+                self._tenant_ring[waiter.lane].remove(waiter.tenant)
+            except ValueError:
+                pass
+        QOS_QUEUE_DEPTH.set(self._depths[waiter.lane], lane=waiter.lane)
+
+    def _lane_depth(self, lane: str) -> int:
+        # O(1) counter (kept in lock-step by enqueue/remove/pick): the
+        # admission fast path reads this under the one global lock, so a
+        # scan over tenants would make that lock hottest under overload
+        return self._depths[lane]
+
+    def _queued_total(self) -> int:
+        return sum(self._depths.values())
+
+    def _retry_after_locked(self) -> float:
+        """Seconds until the backlog in front of a new arrival should have
+        drained at the current service rate: depth x EWMA / ceiling."""
+        backlog = self._queued_total() + self._inflight
+        est = backlog * self._svc_ewma / max(1, self.limiter.ceiling)
+        return float(min(60.0, max(1.0, math.ceil(est))))
+
+    # -- introspection -----------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled(),
+                "ceiling": self.limiter.ceiling,
+                "inflight": self._inflight,
+                "queued": {name: self._lane_depth(name)
+                           for name in self._queues},
+            }

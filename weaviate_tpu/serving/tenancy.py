@@ -1,0 +1,111 @@
+"""Per-tenant rate limiting: token buckets with per-tenant overrides.
+
+Multi-tenancy is a first-class axis in the reference (§2.2) and the
+fairness failure mode is always the same: one hot tenant saturates the
+shared admission queue and every other tenant's p99 rides along. The
+throttle answers *before* a request may even enter the queue; the
+weighted-fair dequeue in :mod:`~weaviate_tpu.serving.qos` handles the
+tenants that got in.
+
+``rate <= 0`` disables the default bucket (unlimited), so single-tenant
+deployments pay nothing; per-tenant overrides still apply.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/second, ``burst`` capacity.
+
+    ``try_take`` returns 0.0 on admission, else the seconds until the
+    requested tokens will exist — the client's Retry-After.
+    """
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._clock = clock
+        self._tokens = self.burst
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> float:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return 0.0
+            if self.rate <= 0:
+                return 60.0  # bucket can never refill; long back-off
+            return (n - self._tokens) / self.rate
+
+
+class TenantThrottle:
+    """tenant -> TokenBucket registry with lazy creation.
+
+    ``default_rate``/``default_burst`` govern tenants without an explicit
+    override; a default rate <= 0 means unthrottled (no bucket is even
+    created). ``set_limit`` pins a specific tenant's budget — rate <= 0
+    there means that tenant is explicitly unlimited.
+    """
+
+    # hard cap on tracked buckets: the tenant string is CLIENT-controlled
+    # (X-Tenant header / ?tenant=), so the registry itself must be bounded
+    # or the throttle becomes the memory-overload vector it guards against
+    MAX_TRACKED = 8192
+
+    def __init__(self, default_rate: float = 0.0,
+                 default_burst: float = 0.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.default_rate = float(default_rate)
+        self.default_burst = float(default_burst)
+        self._clock = clock
+        self._buckets: dict[str, TokenBucket] = {}
+        self._overrides: dict[str, tuple[float, float]] = {}
+        self._lock = threading.Lock()
+
+    def set_limit(self, tenant: str, rate: float, burst: float) -> None:
+        with self._lock:
+            self._overrides[tenant] = (float(rate), float(burst))
+            self._buckets.pop(tenant, None)  # rebuild with new params
+
+    def has_override(self, tenant: str) -> bool:
+        """Operator explicitly pinned this tenant's budget — a BOUNDED
+        set, safe to use as a metric label (arbitrary client-sent tenant
+        strings are not)."""
+        with self._lock:
+            return tenant in self._overrides
+
+    def _bucket(self, tenant: str) -> Optional[TokenBucket]:
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is not None:
+                return bucket
+            rate, burst = self._overrides.get(
+                tenant, (self.default_rate, self.default_burst))
+            if rate <= 0:
+                return None  # unthrottled: never cache (unbounded names)
+            if len(self._buckets) >= self.MAX_TRACKED:
+                # evict the oldest-inserted tracked bucket (dict order);
+                # it re-materializes full on next use — briefly generous
+                # to one tenant beats unbounded growth
+                self._buckets.pop(next(iter(self._buckets)))
+            bucket = TokenBucket(rate, burst, clock=self._clock)
+            self._buckets[tenant] = bucket
+            return bucket
+
+    def check(self, tenant: str) -> Optional[float]:
+        """None = admitted; else seconds the tenant should wait."""
+        bucket = self._bucket(tenant)
+        if bucket is None:
+            return None
+        wait = bucket.try_take()
+        return None if wait <= 0 else wait

@@ -157,3 +157,14 @@ MAINTENANCE_PAUSED = RUNTIME.register("maintenance_paused", False,
 # byte budget of the segmented index's native WAND term cache; -1 = unset
 # (follow the WEAVIATE_TPU_WAND_CACHE_MB env / built-in 64 MB default)
 WAND_CACHE_MB = RUNTIME.register("wand_cache_mb", -1.0, cast=float)
+# serving QoS layer (serving/qos.py): "off" bypasses admission control,
+# deadlines, and shedding entirely — the pre-QoS front door
+SERVING_QOS = RUNTIME.register("serving_qos", "on", cast=str)
+# default end-to-end request budget when the client sends none (REST
+# X-Request-Timeout header / gRPC context deadline override it per call)
+SERVING_DEFAULT_TIMEOUT_S = RUNTIME.register(
+    "serving_default_timeout_s", 30.0, cast=float)
+# per-connection socket read timeout of the bounded REST server (a slow
+# client is disconnected instead of pinning a handler thread)
+SERVING_REST_READ_TIMEOUT_S = RUNTIME.register(
+    "serving_rest_read_timeout_s", 30.0, cast=float)
