@@ -176,6 +176,11 @@ CONFIG_METRICS = {
     "glove": (lambda m: m.startswith("hnsw_glove_"),
               lambda m: m.startswith("hnsw_glove_qps")),
     "pq": (lambda m: m.startswith("pq_qps_1M"),) * 2,
+    # headline: the devbeam lines only — a cached hostbeam number must
+    # not stand in for the device-walk measurement this config exists for
+    "hnswquant": (lambda m: m.startswith(("hnsw_pq_qps_", "hnsw_bq_qps_")),
+                  lambda m: m.startswith(("hnsw_pq_qps_devbeam",
+                                          "hnsw_bq_qps_devbeam"))),
     "bq": (lambda m: m.startswith("bq_qps_10M"),) * 2,
     "bq50m": (lambda m: m.startswith("bq_qps_50M"),) * 2,
     "bq100m": (lambda m: m.startswith("bq_qps_100M"),) * 2,
@@ -632,6 +637,145 @@ def bench_pq(n=1_000_000, d=1536, batch=256, k=10, segments=96, iters=20, warmup
         "build_s": round(build_s, 1),
         "cpu_baseline_qps": round(cpu_qps, 1),
     })
+
+
+def bench_hnsw_quant(n=1_000_000, batch=256, k=10, ef=96, iters=15,
+                     warmup=2):
+    """Quantized-HNSW device-beam A/B: the two BASELINE compressed
+    north-star shapes as GRAPH walks (DBpedia-OpenAI-tier PQ 1536d,
+    LAION-tier BQ 768d), codes resident in HBM, full entrypoint→layer-0
+    walk fused into one dispatch per sub-batch vs the per-hop host beam
+    on the SAME index. recall@10 vs the exact fp32 ranking for BOTH
+    sides — a devbeam speedup at lower recall is not a win. The measured
+    verdict feeds the ``device_beam_quantized`` serving default
+    (utils/perf_flags.py): quantized walks flip on only when they beat
+    the host walk on the target hardware."""
+    import jax
+    import jax.numpy as jnp
+
+    from weaviate_tpu.index.hnsw.hnsw import HNSWIndex
+    from weaviate_tpu.ops import device_beam as device_beam_mod
+    from weaviate_tpu.ops.distance import flat_search
+    from weaviate_tpu.schema.config import (BQConfig, HNSWIndexConfig,
+                                            PQConfig)
+
+    evidence = {}
+    for kind, d, qcfg in (
+        ("pq", 1536, PQConfig(segments=96, rescore_limit=4 * k)),
+        ("bq", 768, BQConfig(rescore_limit=8 * k)),
+    ):
+        rng = np.random.default_rng(29)
+        # clustered data so the codebooks / sign planes have structure
+        centers = rng.standard_normal((1024, d)).astype(np.float32)
+        corpus = centers[rng.integers(0, 1024, n)] + 0.35 * rng.standard_normal(
+            (n, d)
+        ).astype(np.float32)
+        queries = corpus[:batch] + 0.1 * rng.standard_normal(
+            (batch, d)).astype(np.float32)
+
+        cfg = HNSWIndexConfig(
+            distance="l2-squared", ef=ef, ef_construction=96,
+            max_connections=16, initial_capacity=n, insert_batch=4096,
+            quantizer=qcfg, flat_search_cutoff=0, device_beam=True)
+        idx = HNSWIndex(d, cfg)
+        ids = np.arange(n, dtype=np.int64)
+        t0 = time.perf_counter()
+        step = 100_000
+        for s in range(0, n, step):
+            idx.add_batch(ids[s : s + step], corpus[s : s + step])
+        build_s = time.perf_counter() - t0
+
+        cj = jnp.asarray(corpus)
+        gt_ids = np.asarray(
+            jax.block_until_ready(
+                flat_search(jnp.asarray(queries), cj, k=k,
+                            metric="l2-squared", chunk_size=131072,
+                            precision="fp32")[1]))
+        del cj  # gt-only fp32 HBM tenancy: release before the timed runs
+
+        def run():
+            return idx.search(queries, k)
+
+        c0 = device_beam_mod.dispatch_count()
+        ts, res = _timed(run, lambda r: None, iters, warmup)
+        # sub-batches are sized by the visited-scratch budget; each one
+        # is exactly ONE fused dispatch (the contract this PR pins)
+        per_batch = ((device_beam_mod.dispatch_count() - c0)
+                     / (iters + warmup))
+        serial_qps = batch / float(np.median(ts))
+        dev_recall = _recall(res.ids, gt_ids, k)
+        dev_qps = max(serial_qps, _pipelined_thread_qps(run, batch))
+        # used-signal must come from the SEARCH path, not _beam_proven
+        # (construction also sets that — a search-side latch-off after a
+        # successful build would otherwise A/B the host walk against
+        # itself and journal it as a beam verdict)
+        beam_used = bool(idx._device_beam is not None and per_batch >= 1)
+
+        # host per-hop walk on the SAME index (graph, codes, rescore
+        # tier identical — only the walk executor differs)
+        beam_obj, hook = idx._device_beam, idx.graph.dirty_hook
+        idx._device_beam, idx.graph.dirty_hook = None, None
+        ts_h, res_h = _timed(run, lambda r: None, max(2, iters // 2), 1)
+        host_qps = max(batch / float(np.median(ts_h)),
+                       _pipelined_thread_qps(run, batch))
+        host_recall = _recall(res_h.ids, gt_ids, k)
+        idx._device_beam, idx.graph.dirty_hook = beam_obj, hook
+
+        # hostbeam first, devbeam LAST: the driver parses the final
+        # stdout line as the headline
+        _emit({
+            "metric": f"hnsw_{kind}_qps_hostbeam",
+            "value": round(host_qps, 1),
+            "unit": "qps",
+            "vs_baseline": round(host_qps / dev_qps, 2) if dev_qps else 0,
+            "recall_at_10": round(host_recall, 4),
+            "recall_ok": bool(host_recall >= 0.95),
+            "p50_batch_ms": round(float(np.median(ts_h)) * 1000, 2),
+            "n": n, "d": d,
+        })
+        _emit({
+            "metric": f"hnsw_{kind}_qps_devbeam",
+            "value": round(dev_qps, 1),
+            "serial_qps": round(serial_qps, 1),
+            "unit": "qps",
+            "vs_baseline": round(dev_qps / host_qps, 2) if host_qps else 0,
+            "recall_at_10": round(dev_recall, 4),
+            "recall_ok": bool(dev_recall >= 0.95),
+            "p50_batch_ms": round(float(np.median(ts)) * 1000, 2),
+            "p99_batch_ms": round(float(np.percentile(ts, 99)) * 1000, 2),
+            "build_s": round(build_s, 1),
+            "device_beam_used": beam_used,
+            "dispatches_per_batch": round(per_batch, 2),
+            "beam_vs_host": round(dev_qps / host_qps, 2) if host_qps else 0,
+            "codes_hbm_gb": round(idx.backend.codes.nbytes / _GB, 3),
+            "beam_hbm_gb": round(
+                (idx._device_beam.nbytes if idx._device_beam else 0) / _GB,
+                3),
+            "n": n, "d": d,
+        })
+        evidence[kind] = {
+            "devbeam_qps": round(dev_qps, 1),
+            "hostbeam_qps": round(host_qps, 1),
+            "beam_lowered": beam_used,
+            "recall_at_10": round(dev_recall, 4),
+        }
+        win = beam_used and dev_qps > host_qps \
+            and dev_recall >= host_recall - 0.005
+        evidence[kind]["win"] = bool(win)
+        del idx, corpus, queries, gt_ids  # cap host RAM across phases
+
+    # data-driven serving default: quantized walks follow their OWN
+    # measured flag — a raw-corpus glove win says nothing about the
+    # code-space walk (CPU backends measure nothing about either)
+    if jax.devices()[0].platform != "cpu":
+        from weaviate_tpu.utils import perf_flags
+
+        perf_flags.record(
+            "device_beam_quantized",
+            all(e["win"] for e in evidence.values()),
+            {"config": f"hnswquant {n}x(1536d pq, 768d bq) ef{ef}",
+             **evidence},
+            platform=jax.devices()[0].platform)
 
 
 def bench_bq(n=10_000_000, d=768, batch=256, k=10, iters=20, warmup=2,
@@ -1544,6 +1688,7 @@ CONFIGS = {
     "sift1m": bench_sift1m,
     "glove": bench_glove,
     "pq": bench_pq,
+    "hnswquant": bench_hnsw_quant,
     "bq": bench_bq,
     "msmarco": bench_msmarco,
     "bm25": bench_bm25,
@@ -1590,6 +1735,14 @@ def _full_footprint(name: str) -> dict:
         return {"hbm_gb": n * seg / _GB,
                 "host_gb": n * dp * 4 * 2 / _GB,  # originals + gen block
                 "disk_gb": 0.0}
+    if name == "hnswquant":
+        # peak is the PQ phase: fp32 1536-d corpus (+ its clustered-gen
+        # twin) on host, gt flat-scan fp32 corpus transiently in HBM
+        # alongside codes + the layer-0 adjacency mirror
+        n, dp = 1_000_000, 1536
+        return {"hbm_gb": (n * dp * 4 + n * 96 + n * 33 * 4) / _GB,
+                "host_gb": (n * dp * 4 * 2 + n * 200) / _GB,
+                "disk_gb": 0.0}
     if name == "bq":
         n = 10_000_000
         return {"hbm_gb": n * d / 8 / _GB, "host_gb": n * d * 4 / _GB,
@@ -1634,6 +1787,9 @@ SMOKE = {
     "sift1m": dict(n=20_000, iters=3, warmup=1),
     "glove": dict(n=24_000, iters=3, warmup=1),
     "pq": dict(n=20_000, iters=3, warmup=1),
+    # 1536-d HNSW builds dominate: keep the smoke shape small (semantics
+    # check — one-dispatch walk + A/B plumbing — not a measurement)
+    "hnswquant": dict(n=5_000, batch=64, iters=2, warmup=1),
     "bq": dict(n=120_000, iters=2, warmup=1),
     "bq50m": dict(n=250_000, iters=2, warmup=1),
     "bq100m": dict(n=250_000, iters=2, warmup=1),
@@ -1743,8 +1899,8 @@ def main():
     # not the deliberately disk-bound segment tier; with the chip up a
     # device metric lands last either way.
     ap.add_argument("--configs",
-                    default="ingest,ingestmp,bm25seg,bm25,flat1m,sift1m,glove,pq,bq,"
-                            "msmarco,pallasab")
+                    default="ingest,ingestmp,bm25seg,bm25,flat1m,sift1m,glove,pq,"
+                            "hnswquant,bq,msmarco,pallasab")
     ap.add_argument("--smoke", action="store_true",
                     help="run EVERY selected config end-to-end at ~1/50 "
                          "scale on the CPU backend and emit the projected "

@@ -1,0 +1,215 @@
+"""Quantized device-beam parity: fused one-dispatch walk over code planes.
+
+The device graph walk (``ops/device_beam.py``) gather-scores SQ/PQ/BQ/RQ
+code arrays resident in HBM through the pluggable scorer — these tests
+pin the acceptance contract from ISSUE 5 on a small seeded corpus:
+
+* a batch search runs the FULL entrypoint→layer-0 walk in exactly ONE
+  device dispatch (asserted via ``ops.device_beam.dispatch_count``);
+* recall@10 matches the host per-hop walk within 0.005 on the same
+  index (both ends share the exact-rescore tier, so the walks must find
+  the same candidates);
+* tombstones stay traversable-but-never-returned and filtered searches
+  keep ``keep_k`` allowed-only semantics — the same guarantees the
+  raw-backend suite (tests/test_device_beam.py) pins.
+
+Large-corpus variants live at the bottom, marked ``slow``.
+"""
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.index.hnsw import HNSWIndex
+from weaviate_tpu.ops import device_beam as device_beam_mod
+from weaviate_tpu.schema.config import (
+    BQConfig,
+    HNSWIndexConfig,
+    PQConfig,
+    RQConfig,
+    SQConfig,
+)
+
+from tests.test_compression import clustered
+
+QCFGS = {
+    "sq": SQConfig(rescore_limit=60),
+    "pq": PQConfig(segments=8, rescore_limit=80),
+    "bq": BQConfig(rescore_limit=100),
+    "rq": RQConfig(rescore_limit=60),
+}
+# small-corpus floors: clustered data, exact rescore on top of the walk
+FLOORS = {"sq": 0.90, "pq": 0.85, "bq": 0.80, "rq": 0.88}
+
+
+def _build(rng, qcfg, n=1200, d=32, device_beam=True):
+    corpus = clustered(rng, n, d)
+    cfg = HNSWIndexConfig(
+        distance="l2-squared",
+        quantizer=qcfg,
+        ef_construction=96,
+        max_connections=16,
+        flat_search_cutoff=0,
+        device_beam=device_beam,
+    )
+    idx = HNSWIndex(d, cfg)
+    idx.add_batch(np.arange(n), corpus)
+    return idx, corpus
+
+
+def _queries(rng, corpus, nq=24):
+    n, d = corpus.shape
+    q = corpus[rng.choice(n, nq, replace=False)] + 0.02 * rng.standard_normal(
+        (nq, d))
+    return q.astype(np.float32)
+
+
+def _recall(ids, gt, k=10):
+    nq = gt.shape[0]
+    return sum(len(set(ids[i].tolist()) & set(gt[i].tolist()))
+               for i in range(nq)) / (nq * k)
+
+
+def _host_twin_search(idx, q, k, **kw):
+    """Same index, device walk off (fallback tier), restored after."""
+    beam, hook = idx._device_beam, idx.graph.dirty_hook
+    idx._device_beam, idx.graph.dirty_hook = None, None
+    try:
+        return idx.search(q, k, **kw)
+    finally:
+        idx._device_beam, idx.graph.dirty_hook = beam, hook
+
+
+@pytest.mark.parametrize("kind", list(QCFGS), ids=list(QCFGS))
+def test_quantized_parity_one_dispatch(rng, kind):
+    """Acceptance: ONE dispatch for the whole walk + host-walk recall
+    parity within 0.005, per quantizer."""
+    idx, corpus = _build(rng, QCFGS[kind])
+    assert idx._device_beam is not None, "device beam not enabled"
+    # construction itself ran on the fused walk (quantized ingest no
+    # longer round-trips per hop)
+    assert getattr(idx, "_beam_proven", False), \
+        "construction never used the device beam"
+
+    q = _queries(rng, corpus)
+    k = 10
+    before = device_beam_mod.dispatch_count()
+    dev = idx.search(q, k)
+    assert device_beam_mod.dispatch_count() - before == 1, \
+        "full entrypoint→layer-0 walk must be exactly one device dispatch"
+
+    d2 = ((q[:, None, :] - corpus[None]) ** 2).sum(-1)
+    gt = np.argsort(d2, axis=1)[:, :k]
+    dev_recall = _recall(dev.ids, gt, k)
+    host = _host_twin_search(idx, q, k)
+    host_recall = _recall(host.ids, gt, k)
+
+    assert dev_recall >= FLOORS[kind], (kind, dev_recall)
+    assert dev_recall >= host_recall - 0.005, (dev_recall, host_recall)
+
+
+def test_quantized_tombstones_traversable_not_returned(rng):
+    idx, corpus = _build(rng, QCFGS["sq"])
+    dead = np.arange(0, 1200, 3, dtype=np.int64)
+    idx.delete(dead)
+    q = corpus[1:2] + 0.01 * rng.standard_normal((1, 32)).astype(np.float32)
+    res = idx.search(q.astype(np.float32), 20)
+    assert getattr(idx, "_beam_proven", False)
+    live = res.ids[res.ids >= 0]
+    assert len(live) and not set(live.tolist()) & set(dead.tolist())
+
+
+def test_quantized_filtered_keep_k_matches_host(rng):
+    """Permissive filters ride the masked device beam over code planes:
+    results allowed-only, recall parity with the host sweep's kept
+    track."""
+    idx, corpus = _build(rng, QCFGS["sq"], n=1500)
+    n = len(corpus)
+    allow = np.zeros(idx.graph.capacity, bool)
+    allow[rng.choice(n, int(0.6 * n), replace=False)] = True
+    # keep the flat tier from absorbing the 60% filter
+    idx.config.flat_search_cutoff = 10
+
+    q = _queries(rng, corpus)
+    k = 10
+    before = device_beam_mod.dispatch_count()
+    dev = idx.search(q, k, allow_list=allow)
+    assert device_beam_mod.dispatch_count() - before == 1
+    live = dev.ids[dev.ids >= 0]
+    assert len(live) and allow[live].all()
+
+    d2 = ((q[:, None, :] - corpus[None]) ** 2).sum(-1)
+    d2[:, ~allow[:n]] = np.inf
+    gt = np.argsort(d2, axis=1)[:, :k]
+    host = _host_twin_search(idx, q, k, allow_list=allow)
+    assert _recall(dev.ids, gt, k) >= _recall(host.ids, gt, k) - 0.005
+
+
+def test_quantized_filtered_respects_deletes(rng):
+    """Tombstoned ids must not surface through the kept track even when
+    the allowlist still has them set."""
+    idx, corpus = _build(rng, QCFGS["sq"])
+    idx.config.flat_search_cutoff = 10
+    allow = np.ones(idx.graph.capacity, bool)
+    dead = np.arange(0, 1200, 3, dtype=np.int64)
+    idx.delete(dead)
+    q = corpus[1:9] + 0.01 * rng.standard_normal((8, 32)).astype(np.float32)
+    res = idx.search(q.astype(np.float32), 20, allow_list=allow)
+    live = res.ids[res.ids >= 0]
+    assert len(live) and not set(live.tolist()) & set(dead.tolist())
+
+
+def test_unfitted_quantizer_stays_on_host_without_latching(rng):
+    """Pre-fit searches are a lifecycle stage, not a failure: the walk
+    falls back to host scoring but the beam must NOT latch off — once
+    the quantizer trains, the device path engages."""
+    corpus = clustered(rng, 1200, 32)
+    cfg = HNSWIndexConfig(
+        distance="l2-squared", quantizer=SQConfig(rescore_limit=60),
+        ef_construction=96, max_connections=16, flat_search_cutoff=0,
+        device_beam=True,
+    )
+    idx = HNSWIndex(32, cfg)
+    # below the training threshold: quantizer unfitted, scorer is None
+    idx.add_batch(np.arange(64), corpus[:64])
+    if not idx.backend.quantizer.fitted:
+        before = device_beam_mod.dispatch_count()
+        idx.search(corpus[:4], 5)
+        assert device_beam_mod.dispatch_count() == before
+        assert idx._device_beam is not None, "lifecycle gap must not latch"
+    # enough data to train: the device walk engages
+    idx.add_batch(np.arange(64, 1200), corpus[64:])
+    assert idx.backend.quantizer.fitted
+    before = device_beam_mod.dispatch_count()
+    res = idx.search(corpus[:4], 5)
+    assert device_beam_mod.dispatch_count() - before == 1
+    assert (res.ids[:, 0] == np.arange(4)).all()
+
+
+def test_mirror_tracks_incremental_quantized_inserts(rng):
+    idx, corpus = _build(rng, QCFGS["sq"], n=1000)
+    idx.search(corpus[:4], 5)  # syncs the mirror once
+    extra = clustered(rng, 400, 32)
+    idx.add_batch(np.arange(1000, 1400), extra)
+    res = idx.search(extra[:8], 5)
+    # fresh points are their own nearest neighbors: the mirror must have
+    # scattered the new adjacency rows before this search
+    hits = sum(1000 + i in set(res.ids[i].tolist()) for i in range(8))
+    assert hits >= 7, res.ids[:, 0]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["sq", "bq"], ids=["sq", "bq"])
+def test_quantized_parity_large(rng, kind):
+    """Large-corpus twin of the parity gate (multi-level graphs: the
+    on-device upper-layer descent actually has levels to walk)."""
+    idx, corpus = _build(rng, QCFGS[kind], n=8000)
+    assert idx.graph.max_level >= 1, "graph too flat to exercise descent"
+    q = _queries(rng, corpus, nq=32)
+    k = 10
+    before = device_beam_mod.dispatch_count()
+    dev = idx.search(q, k)
+    assert device_beam_mod.dispatch_count() - before == 1
+    d2 = ((q[:, None, :] - corpus[None]) ** 2).sum(-1)
+    gt = np.argsort(d2, axis=1)[:, :k]
+    host = _host_twin_search(idx, q, k)
+    assert _recall(dev.ids, gt, k) >= _recall(host.ids, gt, k) - 0.005

@@ -112,6 +112,74 @@ def test_dispatcher_groups_by_k_and_filter():
     assert seen[-1] == (2, 7, False)
 
 
+def test_filtered_requests_with_identical_masks_coalesce():
+    """Multi-tenant case: requests sharing ONE allow mask (same content,
+    even different array objects) must batch together instead of running
+    as singletons; requests with a different mask never share a batch."""
+    calls = []
+    all_enqueued = threading.Event()
+    entered_lock = threading.Lock()
+    entered = [0]  # rows already popped out of _pending into a batch
+
+    def run_batch(q, k, allow):
+        # the leader holds its first (possibly tiny) batch here until
+        # every worker has enqueued, so the follow-up leaders see the
+        # full pending set and the coalescing under test can happen
+        with entered_lock:
+            entered[0] += q.shape[0]
+        all_enqueued.wait(timeout=10)
+        calls.append((q.shape[0], None if allow is None
+                      else int(allow.sum())))
+        vals = q.sum(axis=1)
+        ids = np.tile(np.arange(k, dtype=np.int64), (q.shape[0], 1))
+        return ids, np.repeat(vals[:, None], k, axis=1).astype(np.float32)
+
+    disp = CoalescingDispatcher(run_batch, max_batch=64)
+    mask_a = np.zeros(64, bool)
+    mask_a[:10] = True
+    mask_b = np.zeros(64, bool)
+    mask_b[:20] = True
+    results = {}
+    errs = []
+
+    def worker(i):
+        try:
+            # tenant A rebuilds its mask per request (same content,
+            # different object); tenant B uses another mask entirely
+            allow = mask_a.copy() if i % 4 else mask_b
+            q = np.full((1, 4), float(i), np.float32)
+            ids, d = disp.search(q, 5, allow)
+            results[i] = d.copy()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(32)]
+    for t in threads:
+        t.start()
+    # every request is accounted for once it is either still pending or
+    # already popped into an in-flight batch (the first leader's group
+    # blocks inside run_batch and is in neither _pending nor results)
+    for _ in range(10_000):
+        with disp._lock:
+            n = len(disp._pending)
+        with entered_lock:
+            e = entered[0]
+        if n + e >= 32:
+            break
+        time.sleep(0.001)
+    all_enqueued.set()
+    for t in threads:
+        t.join()
+    assert not errs
+    for i, d in results.items():
+        np.testing.assert_allclose(d[0], 4.0 * i)  # own rows back
+    # masks never mixed within a batch...
+    assert all(m in (10, 20) for _, m in calls)
+    # ...and same-mask requests coalesced: far fewer batches than requests
+    assert sum(n for n, _ in calls) == 32
+    assert len(calls) < 32
+
+
 def test_hnsw_concurrent_search_matches_serial_with_bounded_tail():
     rng = np.random.default_rng(0)
     n, d, k = 4000, 32, 10

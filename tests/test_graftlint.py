@@ -584,6 +584,100 @@ class TestUnboundedQueue:
 
 
 # ---------------------------------------------------------------------------
+# host-beam-fallback-unproven
+
+
+class TestHostBeamFallbackUnproven:
+    RULES = ["host-beam-fallback-unproven"]
+    IDX = "weaviate_tpu/index/hnsw/fake.py"
+
+    def test_latch_without_counter_flagged(self):
+        res = run("""
+            import logging
+
+            class Idx:
+                def f(self):
+                    try:
+                        g()
+                    except Exception as e:
+                        logging.getLogger("x").warning("disabled: %s", e)
+                        self._device_beam = None
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == ["host-beam-fallback-unproven"]
+
+    def test_latch_with_counter_ok(self):
+        res = run("""
+            import logging
+            from weaviate_tpu.monitoring.metrics import DEVICE_BEAM_FALLBACK
+
+            class Idx:
+                def f(self):
+                    try:
+                        g()
+                    except Exception as e:
+                        DEVICE_BEAM_FALLBACK.inc(kind="search", mode="latched")
+                        logging.getLogger("x").warning("disabled: %s", e)
+                        self._device_beam = None
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_non_beam_disable_ignored(self):
+        res = run("""
+            class Idx:
+                def f(self):
+                    try:
+                        g()
+                    except Exception:
+                        self._cache = None
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_outside_hot_dirs_ignored(self):
+        res = run("""
+            class Idx:
+                def f(self):
+                    try:
+                        g()
+                    except Exception:
+                        self._device_beam = None
+        """, rel=COLD, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_disable_outside_handler_ignored(self):
+        # the __init__-time default (beam not configured) is not a latch
+        res = run("""
+            class Idx:
+                def __init__(self):
+                    self._device_beam = None
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_bare_name_latch_flagged(self):
+        res = run("""
+            def f():
+                global device_beam
+                try:
+                    g()
+                except Exception:
+                    device_beam = None
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == ["host-beam-fallback-unproven"]
+
+    def test_suppressible_with_reason(self):
+        res = run("""
+            class Idx:
+                def f(self):
+                    try:
+                        g()
+                    except Exception:
+                        self._device_beam = None  # graftlint: allow[host-beam-fallback-unproven] reason=counted by the caller
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == []
+        assert [v.rule for v in res.suppressed] == [
+            "host-beam-fallback-unproven"]
+
+
+# ---------------------------------------------------------------------------
 # lock-across-device-call
 
 

@@ -617,6 +617,76 @@ class UnboundedQueue(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 4d. host-beam-fallback-unproven
+
+
+class HostBeamFallbackUnproven(Rule):
+    id = "host-beam-fallback-unproven"
+    description = (
+        "except-handler that permanently disables a device beam (sets a "
+        "*beam* attribute to None) without incrementing a fallback counter"
+    )
+    rationale = (
+        "The device-beam latch is deliberate: a kernel that never lowered "
+        "on this backend disables itself and every future search silently "
+        "downgrades to per-hop host round trips. The disable LOG LINE "
+        "scrolls away in minutes while dashboards keep reporting healthy "
+        "QPS at 10-100x worse latency. Any `_beam_proven`-style latch "
+        "path must therefore also record the event on a counter "
+        "(weaviate_tpu_device_beam_fallback_total) so the degradation is "
+        "observable and alertable — logging alone does not count."
+    )
+
+    _DIRS = ("weaviate_tpu/index/", "weaviate_tpu/ops/")
+    _METRIC_ATTRS = frozenset({"inc", "observe"})
+
+    @staticmethod
+    def _beam_disable(handler: ast.ExceptHandler) -> Optional[ast.Assign]:
+        """The assignment that latches a beam off (sets a *beam* name or
+        attribute to None), or None. The violation anchors HERE so the
+        allow-comment sits next to the latch, not the except line."""
+        for n in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+            if not isinstance(n, ast.Assign):
+                continue
+            if not (isinstance(n.value, ast.Constant)
+                    and n.value.value is None):
+                continue
+            for t in n.targets:
+                name = (t.attr if isinstance(t, ast.Attribute)
+                        else t.id if isinstance(t, ast.Name) else "")
+                if "beam" in name:
+                    return n
+        return None
+
+    def _counts_fallback(self, handler: ast.ExceptHandler) -> bool:
+        for n in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in self._METRIC_ATTRS:
+                return True
+        return False
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, self._DIRS):
+            return
+        for handler in ctx.walk(ast.ExceptHandler):
+            disable = self._beam_disable(handler)
+            if disable is None:
+                continue
+            if self._counts_fallback(handler):
+                continue
+            yield self.violation(
+                ctx, disable,
+                "device-beam fallback latch without a counter — a "
+                "permanent host-walk downgrade must increment "
+                "DEVICE_BEAM_FALLBACK (or another .inc()/.observe() "
+                "instrument) so the degradation is observable, not just "
+                "logged",
+                severity=SEV_WARNING,
+            )
+
+
+# ---------------------------------------------------------------------------
 # 5. lock-across-device-call
 
 
@@ -751,6 +821,7 @@ ALL_RULES: tuple = (
     SwallowedException(),
     TransportErrorSwallowed(),
     UnboundedQueue(),
+    HostBeamFallbackUnproven(),
     LockAcrossDeviceCall(),
     Float64LiteralDrift(),
     SuppressionMissingReason(),

@@ -84,6 +84,13 @@ class Quantizer(abc.ABC):
         """Per-query candidate distances (HNSW frontier eval in code space).
         ``qrep`` from prep()."""
 
+    def beam_scorer(self, store: DeviceArraySet):
+        """(scorer, operands) for the fused device graph walk
+        (``ops.device_beam.device_search``): a hashable Scorer plus the
+        HBM code planes it reads. ``None`` means this quantizer has no
+        device scorer and the walk stays on the host path."""
+        return None
+
     # -- persistence ------------------------------------------------------
     def state_dict(self) -> dict:
         return {"kind": self.kind, "dims": self.dims, "metric": self.metric,
@@ -141,6 +148,11 @@ class BinaryQuantizer(Quantizer):
             qrep, store["packed"], candidate_ids, store["popcount"], self.dims,
         )
 
+    def beam_scorer(self, store):
+        from weaviate_tpu.ops.device_beam import BQScorer
+
+        return BQScorer(self.dims), (store["packed"], store["popcount"])
+
 
 class ScalarQuantizer(Quantizer):
     """Global-affine byte codes (``scalar_quantization.go:28``): 4x smaller.
@@ -191,6 +203,13 @@ class ScalarQuantizer(Quantizer):
             jnp.float32(self.a), jnp.float32(self.s), self.metric,
         )
 
+    def beam_scorer(self, store):
+        from weaviate_tpu.ops.device_beam import SQScorer
+
+        return SQScorer(self.metric), (
+            store["codes"], store["dec_sqnorm"],
+            jnp.float32(self.a), jnp.float32(self.s))
+
     def state_dict(self) -> dict:
         return {**super().state_dict(), "a": self.a, "s": self.s}
 
@@ -224,6 +243,8 @@ class ProductQuantizer(Quantizer):
         self.dsub = dims // m
         self.centroids = min(self.config.centroids, 256)
         self.codebooks: Optional[np.ndarray] = None  # [M, C, dsub]
+        self._cb_dev = None      # device copy, identity-keyed on codebooks
+        self._cb_dev_src = None
 
     def fit(self, sample: np.ndarray) -> None:
         s = np.asarray(sample, np.float32)
@@ -249,17 +270,31 @@ class ProductQuantizer(Quantizer):
         out = self.codebooks[np.arange(self.m)[None, :], codes.astype(np.int64)]
         return out.reshape(codes.shape[0], self.dims)
 
+    def _device_codebooks(self) -> jnp.ndarray:
+        """Upload the codebooks once per fit, not once per call — the
+        frontier/beam paths hit this every search batch."""
+        if self._cb_dev is None or self._cb_dev_src is not self.codebooks:
+            self._cb_dev = jnp.asarray(self.codebooks)
+            self._cb_dev_src = self.codebooks
+        return self._cb_dev
+
     def search(self, qrep, store, k, mask, chunk):
         return qops.pq_search(
-            qrep, store["codes"], jnp.asarray(self.codebooks),
+            qrep, store["codes"], self._device_codebooks(),
             store["dec_sqnorm"], mask, self.metric, k, min(chunk, 32768),
         )
 
     def gather_distance(self, qrep, store, candidate_ids):
         return qops.pq_gather_distance(
-            qrep, store["codes"], jnp.asarray(self.codebooks), candidate_ids,
+            qrep, store["codes"], self._device_codebooks(), candidate_ids,
             store["dec_sqnorm"], self.metric,
         )
+
+    def beam_scorer(self, store):
+        from weaviate_tpu.ops.device_beam import PQScorer
+
+        return PQScorer(self.metric), (
+            store["codes"], self._device_codebooks(), store["dec_sqnorm"])
 
     def state_dict(self) -> dict:
         return {
@@ -364,6 +399,15 @@ class RotationalQuantizer(Quantizer):
             qrep, store["codes"], candidate_ids, store["lower"],
             store["step"], store["dec_sqnorm"], self.metric,
         )
+
+    def beam_scorer(self, store):
+        if self.bits == 1:
+            return self._bq.beam_scorer(store)
+        from weaviate_tpu.ops.device_beam import RQScorer
+
+        return RQScorer(self.metric), (
+            store["codes"], store["lower"], store["step"],
+            store["dec_sqnorm"])
 
     def state_dict(self) -> dict:
         return {

@@ -9,12 +9,19 @@ goroutines over per-core SIMD, ``shard_read.go:374``).
 
 Leader-follower, no dedicated thread: any waiter that finds no active
 drainer promotes itself, repeatedly collects every compatible pending
-request (same k, unfiltered), runs them as ONE batch, and publishes
+request (same k, same filter), runs them as ONE batch, and publishes
 results. A leader yields once its own request completes; remaining waiters
 self-promote within one poll tick — no request's latency is bound to
-another's queue, and a crashed leader can't wedge the dispatcher. Filtered
-requests (per-request allow mask) run as singleton batches in arrival order
-— the underlying kernel applies one mask per batch.
+another's queue, and a crashed leader can't wedge the dispatcher.
+
+Filtered requests coalesce too, when their allow masks are IDENTICAL —
+the common multi-tenant case where every request in a tenant shares one
+precomputed mask (the underlying kernel applies one mask per batch, so
+only mask-equal requests may share it). Identity is a content digest
+computed once per request at enqueue, verified with an exact compare
+before grouping so a hash collision can never leak one tenant's mask
+onto another's query. Requests with distinct masks still run as
+singleton batches in arrival order.
 """
 
 from __future__ import annotations
@@ -31,13 +38,22 @@ from weaviate_tpu.monitoring.metrics import (
 
 
 class _Req:
-    __slots__ = ("queries", "k", "allow", "deadline", "event", "ids",
-                 "dists", "error")
+    __slots__ = ("queries", "k", "allow", "mask_key", "deadline", "event",
+                 "ids", "dists", "error")
 
     def __init__(self, queries: np.ndarray, k: int, allow, deadline=None):
         self.queries = queries
         self.k = k
         self.allow = allow
+        # content digest of the allow mask, computed ONCE at enqueue so
+        # the leader's grouping scan never re-reads mask bytes under the
+        # lock; collisions are disambiguated by array_equal in
+        # _masks_equal before two requests may share a batch
+        if allow is None:
+            self.mask_key = None
+        else:
+            a = np.asarray(allow)
+            self.mask_key = (a.shape, a.dtype.str, hash(a.tobytes()))
         self.deadline = deadline  # cluster.resilience.Deadline or None
         self.event = threading.Event()
         self.ids: Optional[np.ndarray] = None
@@ -47,6 +63,15 @@ class _Req:
     @property
     def expired(self) -> bool:
         return self.deadline is not None and self.deadline.expired
+
+
+def _masks_equal(a: _Req, b: _Req) -> bool:
+    """Whether two requests may share one device batch's allow mask."""
+    if a.allow is None or b.allow is None:
+        return a.allow is None and b.allow is None
+    if a.allow is b.allow:
+        return True
+    return a.mask_key == b.mask_key and np.array_equal(a.allow, b.allow)
 
 
 class CoalescingDispatcher:
@@ -134,14 +159,12 @@ class CoalescingDispatcher:
             if not self._pending:
                 return []
             head = self._pending[0]
-            if head.allow is not None:
-                return [self._pending.pop(0)]
             group = []
             rows = 0
             i = 0
             while i < len(self._pending) and rows < self.max_batch:
                 r = self._pending[i]
-                if r.allow is None and r.k == head.k:
+                if r.k == head.k and _masks_equal(head, r):
                     group.append(self._pending.pop(i))
                     rows += r.queries.shape[0]
                 else:

@@ -95,14 +95,43 @@ class RawBackend:
         """Row-subset of a query rep (lockstep construction sub-batching)."""
         return qrep[rows]
 
+    # -- device beam ------------------------------------------------------
+    def device_scorer(self):
+        """(scorer, operands) for the fused device walk — the raw corpus
+        snapshot gather-scored at full precision."""
+        from weaviate_tpu.ops.device_beam import RawScorer
+
+        corpus, _valid, _sqnorms = self.store.snapshot()
+        return RawScorer(self.metric, self.config.precision), (corpus,)
+
+    def beam_queries(self, qrep):
+        """Device query rep for the fused walk (prep_queries output is
+        already a normalized device array)."""
+        return qrep
+
+    def beam_queries_for_ids(self, ids: np.ndarray):
+        """Construction-side query rep GATHERED from the HBM corpus by id
+        — nothing crosses the link. Rows are already metric-prepped
+        (cosine rows are normalized at put)."""
+        corpus, _valid, _sqnorms = self.store.snapshot()
+        return jnp.take(
+            corpus, jnp.asarray(np.asarray(ids, np.int32)), axis=0
+        ).astype(jnp.float32)
+
     # -- distance kernels -------------------------------------------------
     def frontier_dists(self, qrep, cand: np.ndarray) -> np.ndarray:
+        """Host-walk frontier evaluation: one device call per beam hop.
+        The per-hop syncs below are the FALLBACK tier — the serving path
+        is the fused one-dispatch walk (``device_scorer`` + ``ops/
+        device_beam.py``); this host walk remains for mesh-sharded
+        stores, latch-disabled beams, and construction's upper levels."""
         clipped = np.maximum(cand, 0)
         if self.store.mesh is not None:
             from weaviate_tpu.parallel.sharded_search import (
                 sharded_gather_distance,
             )
 
+            # graftlint: allow[host-sync-in-hot-path] reason=host-walk fallback tier; the serving path is the one-dispatch device beam
             d = np.array(
                 sharded_gather_distance(
                     self.store.corpus,
@@ -114,6 +143,7 @@ class RawBackend:
                 )
             )
         else:
+            # graftlint: allow[host-sync-in-hot-path] reason=host-walk fallback tier; the serving path is the one-dispatch device beam
             d = np.array(
                 gather_distance(
                     qrep,
@@ -315,11 +345,33 @@ class QuantizedBackend:
             code=None if qrep.code is None else qrep.code[rows],
         )
 
+    # -- device beam ------------------------------------------------------
+    def device_scorer(self):
+        """(scorer, operands) over the HBM code planes, or None while the
+        quantizer is unfitted (pre-training corpus walks stay on host —
+        that is a lifecycle stage, not a failure)."""
+        if not self.quantizer.fitted:
+            return None
+        return self.quantizer.beam_scorer(self.codes)
+
+    def beam_queries(self, qrep: QueryRep):
+        """Device query rep for the fused walk: the quantizer's code-space
+        rep (packed bits / rotated bytes / fp32), None pre-fit."""
+        return qrep.code
+
+    def beam_queries_for_ids(self, ids: np.ndarray):
+        """Construction-side query rep: originals gathered on host and
+        prepped ONCE per chunk (one upload), not once per hop."""
+        return self.prep_query_ids(ids).code
+
     # -- distance kernels -------------------------------------------------
     def frontier_dists(self, qrep: QueryRep, cand: np.ndarray) -> np.ndarray:
+        """Host-walk frontier evaluation in code space — the FALLBACK
+        tier; the serving path is the fused one-dispatch device beam."""
         if qrep.code is None:
             return self._exact_host_dists(qrep.host, cand)
         clipped = np.maximum(cand, 0)
+        # graftlint: allow[host-sync-in-hot-path] reason=host-walk fallback tier; the serving path is the one-dispatch device beam
         d = np.array(
             self.quantizer.gather_distance(
                 qrep.code, self.codes, jnp.asarray(clipped)

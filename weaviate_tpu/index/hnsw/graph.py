@@ -36,6 +36,10 @@ class HostGraph:
         # optional dirty-row callback (device adjacency mirror): called
         # with node ids whose layer-0 row / presence changed
         self.dirty_hook = None
+        # bumped on any level>=1 topology change; the device mirror
+        # rebuilds its compact upper-layer tables when this moves (the
+        # upper layers hold ~N/(M-1) nodes, so wholesale rebuild is cheap)
+        self.upper_version = 0
 
     @property
     def capacity(self) -> int:
@@ -69,6 +73,8 @@ class HostGraph:
         if self.levels[node] < 0:
             self.node_count += 1
         self.levels[node] = level
+        if level >= 1:
+            self.upper_version += 1
         for l in range(1, level + 1):
             self.upper.setdefault(l, {})[node] = np.empty(0, np.int32)
         if level > self.max_level:
@@ -101,6 +107,8 @@ class HostGraph:
         level = int(self.levels[node])
         self.levels[node] = NO_NODE
         self.layer0[node] = NO_NODE
+        if level >= 1:
+            self.upper_version += 1
         for l in range(1, level + 1):
             self.upper.get(l, {}).pop(node, None)
         if node in self.tombstones:
@@ -165,6 +173,7 @@ class HostGraph:
             self.layer0[node, : len(nbrs)] = nbrs
         else:
             self.upper.setdefault(level, {})[node] = nbrs.copy()
+            self.upper_version += 1
         if self.log is not None:
             self.log.op_sn(level, node, nbrs)
         if level == 0 and self.dirty_hook is not None:
@@ -190,6 +199,7 @@ class HostGraph:
         if len(arr) >= self.m:
             return False
         layer[node] = np.append(arr, np.int32(nbr))
+        self.upper_version += 1
         if self.log is not None:
             self.log.op_ap(level, node, nbr)
         return True

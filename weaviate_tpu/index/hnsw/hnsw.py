@@ -102,26 +102,32 @@ class HNSWIndex(VectorIndex):
             self.graph.log = self._commitlog
         else:
             self._commitlog = None
-        # device-resident layer-0 beam (ops/device_beam.py): one dispatch
-        # per batch instead of one per hop, filtered or not (filtered
-        # walks track best-allowed-seen on device). Opt-in (config flag or
-        # WEAVIATE_TPU_DEVICE_BEAM=on); raw-backend searches only — the
-        # host loop keeps the quantized paths. Created AFTER snapshot
-        # load/replay: those swap self.graph, and the mirror must bind
-        # the final graph object.
+        # device-resident graph walk (ops/device_beam.py): upper-layer
+        # greedy descent + layer-0 beam fused into ONE dispatch per batch
+        # instead of one per hop, filtered or not (filtered walks track
+        # best-allowed-seen on device). Works for EVERY backend: the raw
+        # corpus gather-scores at full precision; SQ/PQ/BQ/RQ walks
+        # gather-score their HBM code planes through the same pluggable
+        # scorer. Opt-in (config flag or WEAVIATE_TPU_DEVICE_BEAM=on).
+        # Created AFTER snapshot load/replay: those swap self.graph, and
+        # the mirror must bind the final graph object.
         self._device_beam = None
         # env > per-index config > platform-matched measured verdict
         # (the backend store above already initialized jax, so
-        # default_backend() cannot trip a fresh device init here)
+        # default_backend() cannot trip a fresh device init here).
+        # Quantized backends follow their own measured flag: a raw-corpus
+        # A/B win says nothing about the code-space walk.
         import jax as _jax
 
         from weaviate_tpu.utils import perf_flags
 
         _beam_on = perf_flags.resolve(
-            "device_beam", os.environ.get("WEAVIATE_TPU_DEVICE_BEAM", ""),
+            "device_beam_quantized" if self.backend.quantized
+            else "device_beam",
+            os.environ.get("WEAVIATE_TPU_DEVICE_BEAM", ""),
             config_on=getattr(self.config, "device_beam", False),
             platform=_jax.default_backend())
-        if not self.backend.quantized and _beam_on:
+        if _beam_on:
             from weaviate_tpu.ops.device_beam import DeviceAdjacency
 
             self._device_beam = DeviceAdjacency(self.graph)
@@ -386,38 +392,50 @@ class HNSWIndex(VectorIndex):
                                   eps: np.ndarray, efc: int):
         """Layer-0 ef_construction walks fully on device (VERDICT r3 #5):
         one dispatch per chunk instead of one per hop — the construction
-        analogue of ``_device_beam_search``. Query vectors are GATHERED
-        from the HBM corpus by id, so nothing crosses the link per hop.
-        Returns (res_ids, res_d) ascending, or None to use the host walk
-        (no device beam configured / quantized backend / lowering failed —
-        same latch semantics as the search path)."""
-        if self._device_beam is None or self.backend.quantized:
+        analogue of ``_device_beam_search``, for EVERY backend. Raw
+        query vectors are GATHERED from the HBM corpus by id (nothing
+        crosses the link per hop); quantized backends upload the chunk's
+        code-space query rep once and walk the HBM code planes with the
+        same pluggable scorer the search path uses. Returns (res_ids,
+        res_d) ascending, or None to use the host walk (no device beam
+        configured / quantizer unfitted / lowering failed — same latch
+        semantics as the search path)."""
+        if self._device_beam is None:
             return None
+        scorer_pack = self.backend.device_scorer()
+        if scorer_pack is None:
+            return None  # quantizer unfitted: lifecycle, not a failure
+        scorer, operands = scorer_pack
         import jax.numpy as jnp
 
-        from weaviate_tpu.ops.device_beam import beam_search_layer0
+        from weaviate_tpu.monitoring.metrics import DEVICE_BEAM_FALLBACK
+        from weaviate_tpu.ops.device_beam import device_search
 
         try:
             adj, present = self._device_beam.sync()
-            corpus, _valid, _sqnorms = self.backend.store.snapshot()
             ef_pad = 1 << max(4, (int(efc) - 1).bit_length())
             outs_i, outs_d = [], []
             chunk = 256  # bounds the [chunk, capacity] visited scratch
             for s in range(0, len(node_ids), chunk):
-                sub = node_ids[s:s + chunk].astype(np.int32)
-                # corpus rows are already metric-prepped (cosine rows are
-                # normalized at put), so gathered queries need no prep
-                q = jnp.take(corpus, jnp.asarray(sub), axis=0).astype(
-                    jnp.float32)
-                ids_j, d_j = beam_search_layer0(
-                    q, corpus, adj, present,
-                    jnp.asarray(eps[s:s + chunk].astype(np.int32)),
-                    ef=ef_pad, max_steps=int(4 * ef_pad + 64),
-                    metric=self.metric, precision=self.config.precision)
+                sub = node_ids[s:s + chunk].astype(np.int64)
+                q = self.backend.beam_queries_for_ids(sub)
+                sub_eps = eps[s:s + chunk].astype(np.int32)
+                if len(sub) < chunk:
+                    # pad the tail to the fixed chunk shape so every
+                    # sub-batch reuses ONE compiled program (row 0
+                    # repeats; its results are sliced off below)
+                    pad = chunk - len(sub)
+                    q = jnp.concatenate(
+                        [q, jnp.repeat(q[:1], pad, axis=0)], axis=0)
+                    sub_eps = np.concatenate(
+                        [sub_eps, np.repeat(sub_eps[:1], pad)])
+                ids_j, d_j = device_search(
+                    scorer, q, operands, adj, present, sub_eps,
+                    ef=ef_pad, max_steps=int(4 * ef_pad + 64))
                 # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
-                outs_i.append(np.asarray(ids_j).astype(np.int64))
+                outs_i.append(np.asarray(ids_j)[:len(sub)].astype(np.int64))
                 # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
-                outs_d.append(np.asarray(d_j))
+                outs_d.append(np.asarray(d_j)[:len(sub)])
             res_ids = np.concatenate(outs_i)[:, :efc]
             res_d = np.concatenate(outs_d)[:, :efc]
             self._beam_proven = True
@@ -426,10 +444,13 @@ class HNSWIndex(VectorIndex):
             import logging
 
             if getattr(self, "_beam_proven", False):
+                DEVICE_BEAM_FALLBACK.inc(kind="construction",
+                                         mode="transient")
                 logging.getLogger("weaviate_tpu.hnsw").warning(
                     "construction device beam failed (transient, host "
                     "walk for this sub-batch): %s", e)
             else:
+                DEVICE_BEAM_FALLBACK.inc(kind="construction", mode="latched")
                 logging.getLogger("weaviate_tpu.hnsw").warning(
                     "device beam disabled after construction failure: %s", e)
                 self.graph.dirty_hook = None
@@ -739,14 +760,16 @@ class HNSWIndex(VectorIndex):
         b = queries.shape[0]
         qdev = self._qdev(queries)
         ef = self._dynamic_ef(k)
+        if self._device_beam is not None:
+            # fused walk: greedy descent + layer-0 beam in ONE dispatch
+            # (the host per-level loop below is the fallback tier)
+            out = self._device_beam_search(queries, qdev, ef, k, allow_list)
+            if out is not None:
+                return out
         eps = np.full(b, self.graph.entrypoint, np.int64)
         all_active = np.ones(b, bool)
         for level in range(self.graph.max_level, 0, -1):
             eps = self._greedy_step_until_stable(qdev, eps, level, all_active)
-        if self._device_beam is not None:
-            out = self._device_beam_search(queries, eps, ef, k, allow_list)
-            if out is not None:
-                return out
         keep = self._keep_mask(allow_list)
         keep_k = max(k, min(ef, 2 * k))
         if self.backend.quantized:
@@ -759,59 +782,73 @@ class HNSWIndex(VectorIndex):
         )
         return self.backend.rescore_topk(queries, kept_ids, kept_d, k)
 
-    def _device_beam_search(self, queries, eps, ef, k, allow_list=None):
-        """Layer-0 walk fully on device; host filters tombstoned/deleted
-        ids out of the returned beam (sweeping semantics). With a filter,
-        the device additionally tracks the best ALLOWED nodes seen along
-        the unchanged walk (ACORN-style connectivity through disallowed
-        nodes; single dispatch either way)."""
-        from weaviate_tpu.ops.device_beam import beam_search_layer0
+    def _device_beam_search(self, queries, qdev, ef, k, allow_list=None):
+        """Full entrypoint→layer-0 walk in ONE device dispatch: the fused
+        kernel runs the upper-layer greedy descent AND the layer-0 beam
+        (``ops/device_beam.py``), gather-scoring the backend's HBM arrays
+        — raw corpus or SQ/PQ/BQ/RQ code planes — through its pluggable
+        scorer. The host then filters tombstoned/deleted ids out of the
+        returned beam (sweeping semantics) and runs the backend's rescore
+        tier (identity for raw; exact over originals for quantized). With
+        a filter, the device additionally tracks the best ALLOWED nodes
+        seen along the unchanged walk (ACORN-style connectivity through
+        disallowed nodes; still a single dispatch)."""
+        from weaviate_tpu.monitoring.metrics import DEVICE_BEAM_FALLBACK
+        from weaviate_tpu.ops.device_beam import device_search
 
+        scorer_pack = self.backend.device_scorer()
+        if scorer_pack is None:
+            return None  # quantizer unfitted: lifecycle, not a failure
+        scorer, operands = scorer_pack
+        q = self.backend.beam_queries(qdev)
+        if q is None:
+            return None
+        # over-fetch width for the rescore tier (reference
+        # hnsw/search.go:184 shouldRescore): raw distances are exact so
+        # k suffices; code-space walks promote from a wider candidate set
+        fetch = max(k, min(ef, 2 * k))
+        if self.backend.quantized:
+            rl = getattr(self.backend.quantizer.config, "rescore_limit", 0)
+            fetch = min(ef, max(fetch, rl, 2 * k))
         try:
-            adj, present = self._device_beam.sync()
-            corpus, valid, sqnorms = self.backend.store.snapshot()
             import jax.numpy as jnp
 
-            if self.metric == "cosine":
-                # same normalization the host path applies in
-                # prep_queries: stored vectors are normalized, queries
-                # must be too or 1 - q.c is the wrong scale
-                norms = np.linalg.norm(queries, axis=1, keepdims=True)
-                queries = queries / np.maximum(norms, 1e-12)
-            # bucket ef to a power of two so a workload mixing k values
-            # shares a handful of while_loop compiles instead of one per
-            # distinct ef (the beam tolerates extra -1/MASK width)
+            adj, present = self._device_beam.sync()
+            upper_adj, upper_slots = self._device_beam.sync_upper()
+            b = q.shape[0]
+            # bucket ef AND the batch to powers of two so a workload
+            # mixing k values / batch sizes shares a handful of
+            # while_loop compiles instead of one per distinct shape
+            # (the beam tolerates extra -1/MASK width; padded rows
+            # repeat row 0 and are sliced off after the fetch)
             ef_pad = 1 << max(4, (int(ef) - 1).bit_length())
+            b_pad = 1 << max(3, (b - 1).bit_length())  # b: python int shape
+            if b_pad != b:
+                q = jnp.concatenate(
+                    [q, jnp.repeat(q[:1], b_pad - b, axis=0)], axis=0)
+            eps = np.full(b_pad, self.graph.entrypoint, np.int32)
             if allow_list is not None:
                 cap = int(adj.shape[0])
                 al = np.asarray(allow_list, bool)
                 if len(al) < cap:
                     al = np.pad(al, (0, cap - len(al)))
-                keep_k = 1 << max(
-                    3, (max(k, min(ef, 2 * k)) - 1).bit_length())
-                _, _, ids, d = beam_search_layer0(
-                    jnp.asarray(queries), corpus, adj, present,
-                    jnp.asarray(eps.astype(np.int32)),
+                keep_k = 1 << max(3, (int(fetch) - 1).bit_length())
+                _, _, ids, d = device_search(
+                    scorer, q, operands, adj, present, eps,
                     ef=ef_pad, max_steps=int(4 * ef_pad + 64),
-                    metric=self.metric, precision=self.config.precision,
+                    upper_adj=upper_adj, upper_slots=upper_slots,
                     allow=jnp.asarray(al[:cap]), keep_k=keep_k,
                 )
             else:
-                ids, d = beam_search_layer0(
-                    jnp.asarray(queries),
-                    corpus,
-                    adj,
-                    present,
-                    jnp.asarray(eps.astype(np.int32)),
-                    ef=ef_pad,
-                    max_steps=int(4 * ef_pad + 64),
-                    metric=self.metric,
-                    precision=self.config.precision,
+                ids, d = device_search(
+                    scorer, q, operands, adj, present, eps,
+                    ef=ef_pad, max_steps=int(4 * ef_pad + 64),
+                    upper_adj=upper_adj, upper_slots=upper_slots,
                 )
             # graftlint: allow[host-sync-in-hot-path] reason=final beam materialization
-            ids = np.asarray(ids).astype(np.int64)
+            ids = np.asarray(ids)[:b].astype(np.int64)
             # graftlint: allow[host-sync-in-hot-path] reason=final beam materialization
-            d = np.asarray(d)
+            d = np.asarray(d)[:b]
             self._beam_proven = True
         except Exception as e:
             import logging
@@ -819,10 +856,12 @@ class HNSWIndex(VectorIndex):
             if getattr(self, "_beam_proven", False):
                 # worked before: treat as transient (device busy, batch
                 # OOM) — fall back for THIS query only
+                DEVICE_BEAM_FALLBACK.inc(kind="search", mode="transient")
                 logging.getLogger("weaviate_tpu.hnsw").warning(
                     "device beam failed (transient, falling back): %s", e)
             else:
                 # never lowered successfully on this backend: latch off
+                DEVICE_BEAM_FALLBACK.inc(kind="search", mode="latched")
                 logging.getLogger("weaviate_tpu.hnsw").warning(
                     "device beam disabled after failure: %s", e)
                 self.graph.dirty_hook = None
@@ -832,9 +871,13 @@ class HNSWIndex(VectorIndex):
         ok = (ids >= 0) & keep[np.clip(ids, 0, len(keep) - 1)]
         d = np.where(ok, d, _INF)
         ids = np.where(ok, ids, -1)
-        order = np.argsort(d, axis=1, kind="stable")[:, :k]
+        order = np.argsort(d, axis=1, kind="stable")[:, :fetch]
         d = np.take_along_axis(d, order, axis=1)
         ids = np.take_along_axis(ids, order, axis=1)
+        # rescore tier: exact promotion for quantized walks, truncation
+        # for raw ones (distances already exact)
+        ids, d = self.backend.rescore_topk(queries, ids, d, k)
+        ids = ids.astype(np.int64)
         if d.shape[1] < k:
             pad = k - d.shape[1]
             d = np.pad(d, ((0, 0), (0, pad)), constant_values=_INF)
@@ -894,4 +937,12 @@ class HNSWIndex(VectorIndex):
         if self.backend.quantized:
             s["quantizer"] = self.backend.quantizer.kind
             s["fitted"] = self.backend.quantizer.fitted
+            s["codes_hbm_bytes"] = self.backend.codes.nbytes
+        else:
+            s["corpus_hbm_bytes"] = self.backend.store.nbytes
+        if self._device_beam is not None:
+            # the fused walk's extra HBM rent: mirrored layer-0 rows,
+            # presence mask, and compact upper-layer tables
+            s["device_beam"] = True
+            s["device_beam_hbm_bytes"] = self._device_beam.nbytes
         return s

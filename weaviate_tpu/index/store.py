@@ -141,6 +141,14 @@ class DeviceVectorStore:
     def live_count(self) -> int:
         return self._live
 
+    @property
+    def nbytes(self) -> int:
+        """Device (HBM) footprint: corpus + validity mask + sq-norms —
+        the raw-tier term of the device-beam residency budget (see
+        docs/device_beam.md); quantized tiers report DeviceArraySet.nbytes
+        instead."""
+        return sum(a.nbytes for a in self._state)
+
     def snapshot(self) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Consistent (corpus, valid, sqnorms) triple — the ONLY safe way
         to read device state from search threads."""

@@ -246,3 +246,8 @@ DISPATCH_DEVICE_ROWS = REGISTRY.counter(
     "weaviate_tpu_dispatch_device_rows_total",
     "query rows the coalescing dispatcher actually sent to device "
     "batches (expired rows never count here)")
+DEVICE_BEAM_FALLBACK = REGISTRY.counter(
+    "weaviate_tpu_device_beam_fallback_total",
+    "fused device-beam walks that fell back to the host per-hop path, "
+    "by kind (search/construction) and mode (transient/latched); a "
+    "latched fallback permanently downgrades the index to host walks")

@@ -1,4 +1,4 @@
-"""Device-resident HNSW layer-0 beam search: ONE dispatch per batch.
+"""Device-resident HNSW search: ONE dispatch per batch, any backend.
 
 Reference hot loop: ``hnsw/search.go:726`` expands one candidate at a
 time with per-candidate SIMD distance calls. The host-side TPU redesign
@@ -7,11 +7,23 @@ one device call — but still pays a host↔device round-trip per hop, which
 dominates wall time on high-latency links (a tunneled device costs
 ~70ms/hop) and adds dispatch overhead everywhere else.
 
-This kernel moves the whole layer-0 walk into one ``lax.while_loop``
-under jit: the adjacency lives in HBM as a device array (see
-``DeviceAdjacency`` — an incrementally synced mirror of the host
-graph), the beam/visited state stays on device, and the host gets
-exactly one dispatch + one fetch per search batch.
+This kernel moves the WHOLE walk — upper-layer greedy descent from the
+entrypoint plus the layer-0 beam — into one jitted program: the
+adjacency lives in HBM as a device array (``DeviceAdjacency`` — an
+incrementally synced mirror of the host graph, including compact
+slot-addressed upper-layer tables), the beam/visited state stays on
+device, and the host gets exactly one dispatch + one fetch per search
+batch.
+
+Distance evaluation is PLUGGABLE: a :class:`Scorer` is a frozen (and
+therefore hashable — it keys the jit cache) dataclass whose ``__call__``
+maps ``(queries, candidate_ids, operands) -> [B, C]`` distances, where
+``operands`` is the backend's tuple of HBM-resident arrays. ``RawScorer``
+gather-scores the fp32 corpus; ``SQScorer``/``PQScorer``/``BQScorer``/
+``RQScorer`` gather-score quantized code planes via the kernels in
+``ops/quantized.py`` — so PQ/SQ/BQ/RQ graph walks are exactly as
+device-resident as the raw ones, with only the codes (4–32x smaller)
+living in HBM.
 
 Semantics mirror the host implementation (lockstep best-first expansion,
 ef-bounded beam, stop when the beam holds no unexpanded candidates —
@@ -27,6 +39,7 @@ tracks the best ALLOWED nodes seen, exactly like the host sweep's
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional
 
@@ -38,31 +51,115 @@ from weaviate_tpu.ops.distance import MASK_DISTANCE
 
 _INF = jnp.float32(MASK_DISTANCE)
 
+# Test/ops hook: fused-walk programs dispatched by this process. The
+# acceptance contract "one dispatch per batch for the whole
+# entrypoint→layer-0 walk" is asserted against this counter.
+_dispatch_count = 0
 
-def _cand_dists(q, corpus, ids, metric, precision):
-    """[B, C] distances for candidate ids (-1 → MASK). Delegates to the
-    shared ``gather_distance`` kernel (single source of per-metric
-    semantics — the host frontier evaluation uses the same one)."""
-    from weaviate_tpu.ops.distance import gather_distance
 
-    d = gather_distance(q, corpus, jnp.maximum(ids, 0), metric,
-                        precision=precision)
+def dispatch_count() -> int:
+    return _dispatch_count
+
+
+# ---------------------------------------------------------------------------
+# scorers: static (hashable) per-backend distance evaluators
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RawScorer:
+    """Full-precision gather-score. operands = (corpus [N, D],)."""
+
+    metric: str
+    precision: str
+
+    def __call__(self, q, ids, operands):
+        from weaviate_tpu.ops.distance import gather_distance
+
+        (corpus,) = operands
+        return gather_distance(q, corpus, ids, self.metric,
+                               precision=self.precision)
+
+
+@dataclasses.dataclass(frozen=True)
+class SQScorer:
+    """operands = (codes [N, D] u8, dec_sqnorms [N], a, s)."""
+
+    metric: str
+
+    def __call__(self, q, ids, operands):
+        from weaviate_tpu.ops import quantized as qops
+
+        codes, dsq, a, s = operands
+        return qops.sq_gather_distance(q, codes, ids, dsq, a, s, self.metric)
+
+
+@dataclasses.dataclass(frozen=True)
+class PQScorer:
+    """operands = (codes [N, M] u8, codebooks [M, C, dsub], dec_sqnorms)."""
+
+    metric: str
+
+    def __call__(self, q, ids, operands):
+        from weaviate_tpu.ops import quantized as qops
+
+        codes, codebooks, dsq = operands
+        return qops.pq_gather_distance(q, codes, codebooks, ids, dsq,
+                                       self.metric)
+
+
+@dataclasses.dataclass(frozen=True)
+class BQScorer:
+    """operands = (packed [N, W] u32, popcounts [N]); q is packed bits."""
+
+    dims: int
+
+    def __call__(self, q, ids, operands):
+        from weaviate_tpu.ops import quantized as qops
+
+        packed, popcounts = operands
+        return qops.bq_gather_distance(q, packed, ids, popcounts, self.dims)
+
+
+@dataclasses.dataclass(frozen=True)
+class RQScorer:
+    """operands = (codes [N, D'] u8, lower [N], step [N], dec_sqnorms)."""
+
+    metric: str
+
+    def __call__(self, q, ids, operands):
+        from weaviate_tpu.ops import quantized as qops
+
+        codes, lower, step, dsq = operands
+        return qops.rq_gather_distance(q, codes, ids, lower, step, dsq,
+                                       self.metric)
+
+
+def _masked_scores(scorer, q, ids, operands):
+    """[B, C] distances for candidate ids (-1 → MASK) via the scorer."""
+    d = scorer(q, jnp.maximum(ids, 0), operands)
     return jnp.where(ids >= 0, d, _INF)
+
+
+# ---------------------------------------------------------------------------
+# fused kernel: greedy descent over upper layers + layer-0 beam, one jit
+# ---------------------------------------------------------------------------
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("ef", "max_steps", "metric", "precision", "keep_k"))
-def beam_search_layer0(
-    queries: jnp.ndarray,        # [B, D] fp32
-    corpus: jnp.ndarray,         # [N, D]
-    adjacency: jnp.ndarray,      # [N, M0] int32, -1 padded
+    static_argnames=("scorer", "ef", "max_steps", "keep_k"))
+def _fused_search(
+    scorer,                      # static Scorer (hashable dataclass)
+    queries: jnp.ndarray,        # [B, ...] backend query rep
+    operands: tuple,             # backend HBM arrays (corpus or code planes)
+    adjacency: jnp.ndarray,      # [N, M0] int32, -1 padded (layer 0)
     present: jnp.ndarray,        # [N] bool — node exists (incl. tombstoned)
     eps: jnp.ndarray,            # [B] int32 entrypoints
+    upper_adj: jnp.ndarray,      # [L, S, M] int32 slot-compacted, top first
+    upper_slots: jnp.ndarray,    # [L, N] int32 node -> slot (-1 absent)
     ef: int,
     max_steps: int,
-    metric: str = "l2-squared",
-    precision: str = "bf16",
     allow: Optional[jnp.ndarray] = None,  # [N] bool filter allowlist
     keep_k: int = 0,
 ):
@@ -75,17 +172,56 @@ def beam_search_layer0(
     rows = jnp.arange(b)
     track = allow is not None and keep_k > 0
 
-    d0 = _cand_dists(queries, corpus, eps[:, None].astype(jnp.int32),
-                     metric, precision)[:, 0]
-    beam_ids = jnp.full((b, ef), -1, jnp.int32).at[:, 0].set(
-        eps.astype(jnp.int32))
+    eps = eps.astype(jnp.int32)
+    d0 = _masked_scores(scorer, queries, eps[:, None], operands)[:, 0]
+
+    # -- upper-layer greedy descent (reference search.go:760) ------------
+    # One fori_loop over levels (index 0 = TOP level), nested while_loop
+    # per level; a node absent at a level (slot -1) simply never moves.
+    n_upper = upper_adj.shape[0]
+    if n_upper:  # static — L=0 graphs skip the descent entirely
+        def level_body(li, carry):
+            cur, cur_d = carry
+            adj_l = jax.lax.dynamic_index_in_dim(
+                upper_adj, li, 0, keepdims=False)      # [S, M]
+            slot_l = jax.lax.dynamic_index_in_dim(
+                upper_slots, li, 0, keepdims=False)    # [N]
+
+            def cond(st):
+                step, _, _, live = st
+                return (step < max_steps) & live.any()
+
+            def body(st):
+                step, cur, cur_d, live = st
+                slot = jnp.take(slot_l, cur)                      # [B]
+                nbrs = jnp.take(adj_l, jnp.maximum(slot, 0), axis=0)
+                ok = ((slot >= 0) & live)[:, None] & (nbrs >= 0)
+                ok &= jnp.take(present, jnp.maximum(nbrs, 0))
+                nbrs = jnp.where(ok, nbrs, -1)
+                d = _masked_scores(scorer, queries, nbrs, operands)
+                j = jnp.argmin(d, axis=1)
+                bd = d[rows, j]
+                upd = live & (bd < cur_d)
+                cur = jnp.where(upd, nbrs[rows, j], cur)
+                cur_d = jnp.where(upd, bd, cur_d)
+                return step + 1, cur, cur_d, upd
+
+            _, cur, cur_d, _ = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), cur, cur_d, jnp.ones((b,), bool)))
+            return cur, cur_d
+
+        eps, d0 = jax.lax.fori_loop(0, n_upper, level_body, (eps, d0))
+
+    # -- layer-0 best-first beam -----------------------------------------
+    beam_ids = jnp.full((b, ef), -1, jnp.int32).at[:, 0].set(eps)
     beam_d = jnp.full((b, ef), _INF, jnp.float32).at[:, 0].set(d0)
     expanded = jnp.zeros((b, ef), bool)
     visited = jnp.zeros((b, n), jnp.uint8).at[rows, eps].set(1)
     if track:
         seed_ok = jnp.take(allow, eps)
         kept_ids = jnp.full((b, keep_k), -1, jnp.int32).at[:, 0].set(
-            jnp.where(seed_ok, eps.astype(jnp.int32), -1))
+            jnp.where(seed_ok, eps, -1))
         kept_d = jnp.full((b, keep_k), _INF, jnp.float32).at[:, 0].set(
             jnp.where(seed_ok, d0, _INF))
     else:
@@ -117,7 +253,7 @@ def beam_search_layer0(
         nbrs = jnp.where(ok, nbrs, -1)
         visited = visited.at[rows[:, None], safe].max(
             ok.astype(jnp.uint8))
-        nd = _cand_dists(queries, corpus, nbrs, metric, precision)
+        nd = _masked_scores(scorer, queries, nbrs, operands)
         all_ids = jnp.concatenate([beam_ids, nbrs], axis=1)
         all_d = jnp.concatenate([beam_d, nd], axis=1)
         all_exp = jnp.concatenate(
@@ -151,14 +287,84 @@ def beam_search_layer0(
     return beam_ids, beam_d
 
 
-class DeviceAdjacency:
-    """Incrementally synced device mirror of the layer-0 adjacency.
+# jit-cache-stable empty upper tables for layer-0-only walks (the shapes
+# participate in the compile key, so they must never vary)
+_NO_UPPER_ADJ = None
+_NO_UPPER_SLOTS = None
 
-    The host graph mutates rows during inserts/deletes (set_neighbors /
-    append_neighbor / rewires); uploading the full [N, 2M] array per
-    search would swamp the link, so the mirror tracks dirty rows and
-    scatters ONLY those before a search (one device call). Capacity
-    growth re-uploads wholesale (rare: doubling)."""
+
+def _empty_upper():
+    global _NO_UPPER_ADJ, _NO_UPPER_SLOTS
+    if _NO_UPPER_ADJ is None:
+        _NO_UPPER_ADJ = jnp.zeros((0, 1, 1), jnp.int32)
+        _NO_UPPER_SLOTS = jnp.zeros((0, 1), jnp.int32)
+    return _NO_UPPER_ADJ, _NO_UPPER_SLOTS
+
+
+def device_search(
+    scorer,
+    queries,
+    operands,
+    adjacency,
+    present,
+    eps,
+    ef: int,
+    max_steps: int,
+    upper_adj=None,
+    upper_slots=None,
+    allow=None,
+    keep_k: int = 0,
+):
+    """Dispatch ONE fused walk program (descent + layer-0 beam). Without
+    upper tables the walk starts at layer 0 (construction / flat graphs).
+    Increments the module dispatch counter — the test hook behind the
+    one-dispatch-per-batch contract."""
+    global _dispatch_count
+    if upper_adj is None or upper_adj.shape[0] == 0:
+        upper_adj, upper_slots = _empty_upper()
+    _dispatch_count += 1
+    return _fused_search(
+        scorer, queries, operands, adjacency, present,
+        jnp.asarray(eps, jnp.int32), upper_adj, upper_slots,
+        ef=ef, max_steps=max_steps, allow=allow, keep_k=keep_k)
+
+
+def beam_search_layer0(
+    queries: jnp.ndarray,
+    corpus: jnp.ndarray,
+    adjacency: jnp.ndarray,
+    present: jnp.ndarray,
+    eps: jnp.ndarray,
+    ef: int,
+    max_steps: int,
+    metric: str = "l2-squared",
+    precision: str = "bf16",
+    allow: Optional[jnp.ndarray] = None,
+    keep_k: int = 0,
+):
+    """Layer-0-only raw-corpus walk (compat wrapper over the pluggable
+    kernel; the scorer-generic ``device_search`` is the primary entry)."""
+    return device_search(
+        RawScorer(metric, precision), queries, (corpus,), adjacency,
+        present, eps, ef=ef, max_steps=max_steps, allow=allow,
+        keep_k=keep_k)
+
+
+class DeviceAdjacency:
+    """Incrementally synced device mirror of the host graph topology.
+
+    Layer 0: the host graph mutates rows during inserts/deletes
+    (set_neighbors / append_neighbor / rewires); uploading the full
+    [N, 2M] array per search would swamp the link, so the mirror tracks
+    dirty rows and scatters ONLY those before a search (one device
+    call). Capacity growth re-uploads wholesale (rare: doubling).
+
+    Upper layers: compact slot-addressed tables ([L, S, M] adjacency +
+    [L, N] node→slot maps, top level first) consumed by the fused
+    kernel's greedy descent. They hold ~N/(M-1) rows total, so a version
+    bump on the host graph (``HostGraph.upper_version``) rebuilds them
+    wholesale — cheap, and only when construction actually touched a
+    level ≥ 1."""
 
     def __init__(self, graph):
         self.graph = graph
@@ -166,11 +372,25 @@ class DeviceAdjacency:
         self._present = None    # device [cap] bool
         self._synced_cap = 0
         self._dirty: set[int] = set()
+        self._upper = None      # (upper_adj [L, S, M], upper_slots [L, cap])
+        self._upper_version = -1
+        self._upper_cap = 0
         # monkeypatch-free hook: HostGraph calls log ops; we piggyback on
         # set_neighbors/append/remove via mark_dirty from the index layer
 
     def mark_dirty(self, *node_ids) -> None:
         self._dirty.update(int(x) for x in node_ids)
+
+    @property
+    def nbytes(self) -> int:
+        """HBM footprint of the mirrored topology (layer 0 + upper)."""
+        total = 0
+        for a in (self._adj, self._present):
+            if a is not None:
+                total += a.nbytes
+        if self._upper is not None:
+            total += sum(a.nbytes for a in self._upper)
+        return total
 
     def sync(self):
         """→ (adjacency, present) device arrays, up to date."""
@@ -195,3 +415,56 @@ class DeviceAdjacency:
                 self._present = self._present.at[jnp.asarray(idx)].set(
                     jnp.asarray(g.levels[idx] >= 0))
         return self._adj, self._present
+
+    def sync_upper(self):
+        """→ (upper_adj, upper_slots) device tables for the fused
+        descent; rebuilt only when the host graph's upper_version (or
+        capacity) moved."""
+        g = self.graph
+        ver = getattr(g, "upper_version", 0)
+        cap = g.capacity
+        if (self._upper is not None and self._upper_version == ver
+                and self._upper_cap == cap):
+            return self._upper
+        levels = max(0, int(g.max_level))
+        if levels == 0:
+            self._upper = _empty_upper()
+        else:
+            # searches read the level dicts lock-free while inserts grow
+            # them (same torn-read contract as the host walk): a dict
+            # resizing mid-iteration raises RuntimeError, so snapshot the
+            # items with a short retry — MUST NOT propagate, or the
+            # caller's blanket fallback would latch the beam off over a
+            # transient race. Index 0 = TOP level (the descent order).
+            snap = None
+            for _ in range(8):
+                try:
+                    snap = [list(g.upper.get(lv, {}).items())
+                            for lv in range(levels, 0, -1)]
+                    break
+                except RuntimeError:  # resized under us; re-read
+                    continue
+            if snap is None:
+                # pathological churn: serve the previous tables (stale
+                # topology is valid — the walk just sees older edges) or
+                # start at layer 0; leave version unmoved so the next
+                # search retries the rebuild
+                return self._upper if self._upper is not None \
+                    else _empty_upper()
+            sizes = [len(items) for items in snap]
+            # pow2-pad the slot axis so steady growth reuses compiles
+            s_pad = 1 << max(3, (max(1, max(sizes)) - 1).bit_length())
+            adj = np.full((levels, s_pad, g.m), -1, np.int32)
+            slots = np.full((levels, cap), -1, np.int32)
+            for li, items in enumerate(snap):
+                for slot, (node, nbrs) in enumerate(items):
+                    if node >= cap:
+                        continue  # torn read mid-grow; next sync catches up
+                    slots[li, node] = slot
+                    nb = nbrs[:g.m]
+                    if len(nb):
+                        adj[li, slot, :len(nb)] = nb
+            self._upper = (jnp.asarray(adj), jnp.asarray(slots))
+        self._upper_version = ver
+        self._upper_cap = cap
+        return self._upper
