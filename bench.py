@@ -138,7 +138,7 @@ def _emit(out):
     if (m.endswith("_cached")
             or m.startswith(("footprint_", "flat_pallas_interpret"))
             or m in ("device_unavailable", "smoke", "flat_pallas_failed",
-                     "bm25_native_unavailable")
+                     "bm25_native_unavailable", "config_timeout")
             or out.get("recall_ok") is False):  # never cache a bad-recall run
         return
     try:
@@ -185,6 +185,9 @@ CONFIG_METRICS = {
     "bq50m": (lambda m: m.startswith("bq_qps_50M"),) * 2,
     "bq100m": (lambda m: m.startswith("bq_qps_100M"),) * 2,
     "msmarco": (lambda m: m.startswith("hybrid_msmarco_"),) * 2,
+    # headline: the hot-set QPS line; the cold-latency line is secondary
+    "tiering": (lambda m: m.startswith("tiering_"),
+                lambda m: m.startswith("tiering_qps_hot")),
     "pallasab": (_m_pallas, _m_pallas),
     "ingest": (lambda m: m.startswith("ingest_docs_s")
         and not m.rstrip("0123456789").endswith("w"),) * 2,
@@ -1670,6 +1673,113 @@ def _bench_bm25seg_impl(n, k, vocab):
 # CPU-only text lines, and the multi-GB disk tiers (bq50m ~7.7 GB,
 # bq100m ~77 GB of memmap writes) last so a mid-run kill costs the
 # cheapest lines, not the flagship ones.
+def bench_tiering(n=128_000, d=256, tenants=16, batch=64, k=10, iters=10,
+                  warmup=2, oversub=4.0):
+    """Tiered tenant store (docs/tiering.md): steady-state QPS for the HOT
+    tenant set while the aggregate corpus oversubscribes a pinned HBM
+    budget ~``oversub``x, plus first-query-after-cold promotion latency
+    recorded as its own metric. The whole serving path is the real one —
+    DB-level tiering controller, per-tenant shards, residency demotion —
+    not an index-level microbench. Flat indexes are exact, so there is no
+    recall axis; hot/warm parity is pinned by tests/test_tiering.py."""
+    import shutil
+    import tempfile
+
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        MultiTenancyConfig,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    per = max(256, n // tenants)
+    n = per * tenants
+    rng = np.random.default_rng(7)
+    root = tempfile.mkdtemp(prefix="bench_tiering_")
+    db = DB(root, tiering_budget_bytes=1 << 62)  # unbounded during build
+    try:
+        col = db.create_collection(CollectionConfig(
+            name="Tiered",
+            multi_tenancy=MultiTenancyConfig(enabled=True)))
+        t0 = time.perf_counter()
+        for t in range(tenants):
+            name = f"t{t:03d}"
+            col.add_tenant(name)
+            vecs = rng.standard_normal((per, d)).astype(np.float32)
+            for lo in range(0, per, 2048):
+                objs = [StorageObject(uuid=f"{name}-{i:08d}",
+                                      collection="Tiered",
+                                      properties={}, vector=vecs[i],
+                                      tenant=name)
+                        for i in range(lo, min(lo + 2048, per))]
+                col.put_batch(objs, tenant=name)
+        build_s = time.perf_counter() - t0
+
+        # pin the budget to 1/oversub of the real aggregate footprint and
+        # let one controller pass demote the least-active tenants
+        total = db.tiering.accountant.total()
+        budget = max(1, int(total / oversub))
+        db.tiering.accountant.set_budget(budget)
+        hot_n = max(1, tenants // 5)
+        hot = [f"t{t:03d}" for t in range(hot_n)]  # skewed mix: 20% hot
+        qpool = rng.standard_normal((batch, d)).astype(np.float32)
+        for name in hot:  # activity so eviction spares the hot set
+            col.vector_search_batch(qpool, k, tenant=name)
+        db.tiering.tick()
+        within = db.tiering.accountant.total() <= budget
+
+        # steady-state QPS over the hot set at oversubscription
+        def hot_round():
+            for name in hot:
+                col.vector_search_batch(qpool, k, tenant=name)
+
+        for _ in range(warmup):
+            hot_round()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hot_round()
+        dt = time.perf_counter() - t0
+        qps = hot_n * batch * iters / dt
+        states = [e["state"] for e in
+                  db.tiering.stats()["tenants"].values()]
+        _emit({
+            "metric": f"tiering_qps_hot_{tenants}t",
+            "value": round(qps, 1), "unit": "qps", "vs_baseline": 0,
+            "n": n, "d": d, "tenants": tenants, "hot_tenants": hot_n,
+            "oversub": round(total / budget, 2),
+            "budget_bytes": budget, "corpus_bytes": total,
+            "within_budget": bool(within),
+            "hot": states.count("hot"), "warm": states.count("warm"),
+            "cold": states.count("cold"),
+            "build_s": round(build_s, 1),
+        })
+
+        # first-query-after-cold: force the coldest tenants to disk, then
+        # time the first search (promotion open + attach) per tenant
+        db.tiering.cold_after_s = 0.0
+        for _ in range(3):
+            db.tiering.tick()  # hot->warm->cold drains the idle tail
+        cold = [name for name, e in db.tiering.stats()["tenants"].items()
+                if e["state"] == "cold"][:5]
+        lat_ms = []
+        for key in cold:
+            name = key.split("/", 1)[1]
+            t0 = time.perf_counter()
+            col.vector_search_batch(qpool[:8], k, tenant=name)
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+        if lat_ms:
+            lat_ms.sort()
+            _emit({
+                "metric": "tiering_cold_first_query_ms",
+                "value": round(lat_ms[len(lat_ms) // 2], 2), "unit": "ms",
+                "vs_baseline": 0, "p_max": round(lat_ms[-1], 2),
+                "sampled": len(lat_ms), "per_tenant_rows": per,
+            })
+    finally:
+        db.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_pallas_ab(**kw):
     """The one Pallas compile in the matrix, as its own config ordered
     after every XLA-only serving config: a wedged compile helper
@@ -1691,6 +1801,7 @@ CONFIGS = {
     "hnswquant": bench_hnsw_quant,
     "bq": bench_bq,
     "msmarco": bench_msmarco,
+    "tiering": bench_tiering,
     "bm25": bench_bm25,
     "bm25seg": bench_bm25seg,
     "ingest": bench_ingest,
@@ -1761,6 +1872,13 @@ def _full_footprint(name: str) -> dict:
         # SQ8 code planes in HBM; fp32 originals + postings on host
         return {"hbm_gb": n * d / _GB,
                 "host_gb": (n * d * 4 + n * 15 * 16) / _GB, "disk_gb": 0.0}
+    if name == "tiering":
+        n, dt_ = 128_000, 256
+        # budget pins HBM to 1/4 of the fp32 corpus; everything also has
+        # a host twin (warm tier / object storage) + checkpoint on disk
+        return {"hbm_gb": n * dt_ * 4 / 4 / _GB,
+                "host_gb": n * dt_ * 4 * 2 / _GB,
+                "disk_gb": n * dt_ * 4 / _GB}
     if name == "bm25":
         n = 1_000_000
         return {"hbm_gb": 0.0, "host_gb": n * 12 * 24 / _GB, "disk_gb": 0.0}
@@ -1794,6 +1912,7 @@ SMOKE = {
     "bq50m": dict(n=250_000, iters=2, warmup=1),
     "bq100m": dict(n=250_000, iters=2, warmup=1),
     "msmarco": dict(n=96_000, tenants=8, iters=2, warmup=1),
+    "tiering": dict(n=8_000, tenants=8, batch=16, iters=2, warmup=1),
     "bm25": dict(n=20_000, vocab=8_000),
     "bm25seg": dict(n=20_000, vocab=8_000),
     "ingest": dict(n=8_000),
@@ -1884,6 +2003,134 @@ def _device_precheck(timeout_s: float = 180.0) -> bool:
         return False
 
 
+def _run_isolated(names, args, overrides) -> int:
+    """One SUBPROCESS per config (ROADMAP item 5, first half): each child
+    gets its OWN device-init probe + timeout, so a TPU runtime that wedges
+    before (or during) one config costs only that config — every other
+    line still lands and journals. This is what un-blanks a
+    ``device_unavailable`` round: BENCH_r02–r04 lost the whole trajectory
+    because one up-front probe timeout skipped every device config in a
+    single process.
+
+    Children run ``--no-isolate`` and journal their own full-scale lines
+    as they land (partial-result journaling comes for free: a child killed
+    at its timeout keeps everything it already emitted). The parent
+    relays child stdout verbatim, tracks emitted metric names for the
+    cached-coverage tail, and kills a silent child's whole process group
+    at ``--config-timeout``."""
+    import queue as _q
+    import signal
+    import subprocess
+    import threading
+
+    failed = []
+    emitted = set()
+    for name in names:
+        if name not in CONFIGS:
+            print(f"# unknown config {name!r}", file=sys.stderr)
+            failed.append(name)
+            continue
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--configs", name, "--no-isolate"]
+        if args.skip_precheck or name in CPU_ONLY:
+            cmd.append("--skip-precheck")
+        for key_ in ("n", "batch", "iters"):
+            if overrides.get(key_):
+                cmd += [f"--{key_}", str(overrides[key_])]
+        t_cfg = time.monotonic()
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                                start_new_session=True)
+        lines: _q.Queue = _q.Queue()
+
+        def _pump(pipe, sink=lines):
+            for ln in pipe:
+                sink.put(ln)
+            sink.put(None)
+
+        threading.Thread(target=_pump, args=(proc.stdout,),
+                         daemon=True).start()
+        deadline = t_cfg + args.config_timeout
+        timed_out = False
+        try:
+            while True:
+                try:
+                    ln = lines.get(timeout=0.5)
+                except _q.Empty:
+                    ln = False  # no line this tick; still check the clock
+                if time.monotonic() >= deadline and ln is not None:
+                    # wall-clock budget holds even for a CHATTY child —
+                    # a wedged config emitting progress lines faster than
+                    # the 0.5s poll must not dodge the timeout forever
+                    timed_out = True
+                    break
+                if ln is False:
+                    continue
+                if ln is None:
+                    break
+                sys.stdout.write(ln)
+                sys.stdout.flush()
+                try:
+                    emitted.add(json.loads(ln).get("metric", ""))
+                except (json.JSONDecodeError, AttributeError):
+                    pass
+            if timed_out:
+                _emit({"metric": "config_timeout", "value": 0,
+                       "unit": "error", "vs_baseline": 0, "config": name,
+                       "timeout_s": args.config_timeout})
+        finally:
+            # the child is its own session (start_new_session), so the
+            # parent's SIGTERM unwind (driver deadline -> SystemExit)
+            # would otherwise orphan a full-scale run that keeps the
+            # device claimed and its multi-GB disk tiers growing — a
+            # SIGTERM first so the child's own finally blocks delete
+            # those memmaps, then the group hard-kill backstop
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                    proc.wait(timeout=10)
+                except (subprocess.TimeoutExpired, ProcessLookupError,
+                        PermissionError):
+                    pass
+            # ALWAYS sweep the group: the direct child may have exited
+            # (cleanly or on SIGTERM) while a grandchild worker it
+            # spawned (ingest/ingestmp) survives in the session — a
+            # no-op ProcessLookupError when the group is already empty
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        try:
+            rc = proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            rc = -9
+        dt = time.monotonic() - t_cfg
+        print(f"# config {name}: rc={rc} in {dt:.1f}s", file=sys.stderr)
+        if rc != 0 or timed_out:
+            failed.append(name)
+    if not failed:
+        return 0
+    # cached-coverage tail, same contract as the in-process path: a
+    # failed/timed-out config may stand on a journaled measurement from
+    # an earlier healthy window, re-emitted as ``*_cached``. Only the
+    # FAILED configs — and the children's relayed live lines are folded
+    # into _EMITTED first, so a config that emitted its headline before
+    # wedging is NOT shadowed by a stale ``*_cached`` twin landing after
+    # the fresh output (the driver headlines the LAST stdout line).
+    _EMITTED.update(m for m in emitted if m)
+    cached = _reemit_cached(failed)
+    known = cached | emitted
+    uncovered = []
+    for name in failed:
+        match = CONFIG_METRICS.get(name)
+        if match is None or not any(match[1](m) for m in known):
+            uncovered.append(name)
+    if uncovered:
+        print(f"# configs with neither live nor cached coverage: "
+              f"{uncovered}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main():
     # SIGTERM (driver deadline, `timeout`) must unwind via SystemExit so
     # the disk-tier configs' finally blocks delete their multi-GB memmaps
@@ -1900,7 +2147,7 @@ def main():
     # device metric lands last either way.
     ap.add_argument("--configs",
                     default="ingest,ingestmp,bm25seg,bm25,flat1m,sift1m,glove,pq,"
-                            "hnswquant,bq,msmarco,pallasab")
+                            "hnswquant,bq,msmarco,tiering,pallasab")
     ap.add_argument("--smoke", action="store_true",
                     help="run EVERY selected config end-to-end at ~1/50 "
                          "scale on the CPU backend and emit the projected "
@@ -1909,6 +2156,18 @@ def main():
     ap.add_argument("--skip-precheck", action="store_true",
                     help="skip the device-init probe (saves one backend "
                          "init on quick smoke runs)")
+    # subprocess-per-config isolation (default for full-scale runs): one
+    # wedged TPU init costs one config, not the round
+    ap.add_argument("--isolate", dest="isolate", action="store_true",
+                    default=None,
+                    help="run each config in its own subprocess with its "
+                         "own device-init timeout (default for full runs)")
+    ap.add_argument("--no-isolate", dest="isolate", action="store_false",
+                    help="run all configs in-process (smoke default; also "
+                         "what isolated children run)")
+    ap.add_argument("--config-timeout", type=float, default=2400.0,
+                    help="per-config wall clock budget in isolate mode; a "
+                         "silent child is killed (group) at this deadline")
     # sizing overrides for quick smoke runs (apply to every selected config)
     ap.add_argument("--n", type=int, default=0, help="override corpus size")
     ap.add_argument("--batch", type=int, default=0, help="override query batch")
@@ -1938,6 +2197,12 @@ def main():
         args.skip_precheck = True
     names = [c.strip() for c in args.configs.split(",") if c.strip()]
     all_names = list(names)  # before any device-down narrowing
+    if args.isolate is None:
+        # full-scale multi-config runs isolate by default; smoke and
+        # sized-down runs stay in-process (cheap, CPU, nothing to wedge)
+        args.isolate = not args.smoke and not overrides and len(names) > 1
+    if args.isolate and not args.smoke:
+        sys.exit(_run_isolated(names, args, overrides))
     if args.smoke:
         fit_fail = [c for c in names if c in CONFIGS and not preflight(c)]
         smoke_fail = []

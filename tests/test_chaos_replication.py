@@ -112,6 +112,10 @@ def chaos3(tmp_path):
     yield nodes, chaos
     for ct in chaos.values():
         ct.clear()
+    # two-phase, order-independent teardown (see test_cluster.cluster3):
+    # all senders quiesce before any node closes
+    for n in nodes:
+        n.quiesce()
     for n in nodes:
         n.close()
 

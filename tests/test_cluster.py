@@ -47,6 +47,12 @@ def cluster3(tmp_path):
     wait_for(lambda: any(n.raft.is_leader() for n in nodes),
              msg="leader election")
     yield nodes, registry
+    # two-phase, order-independent teardown: silence every node's
+    # background senders BEFORE any node leaves the registry, so a
+    # still-running anti-entropy/gossip loop can't fire at a peer that
+    # is mid-close (the order-dependent teardown flake)
+    for n in nodes:
+        n.quiesce()
     for n in nodes:
         n.close()
 

@@ -678,6 +678,72 @@ class TestHostBeamFallbackUnproven:
 
 
 # ---------------------------------------------------------------------------
+# device-array-leak
+
+
+class TestDeviceArrayLeak:
+    RULES = ["device-array-leak"]
+    IDX = "weaviate_tpu/index/fake.py"
+    CORE = "weaviate_tpu/core/fake.py"
+
+    def test_discarded_demote_flagged(self):
+        res = run("""
+            def f(shard):
+                shard.demote_device()
+        """, rel=self.CORE, rules=self.RULES)
+        assert rule_ids(res) == ["device-array-leak"]
+
+    def test_discarded_promote_flagged(self):
+        res = run("""
+            def f(shard):
+                shard.promote_device()
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == ["device-array-leak"]
+
+    def test_assigned_delta_ok(self):
+        res = run("""
+            def f(shard, acct, key):
+                freed = shard.demote_device()
+                acct.charge(key, shard.hbm_bytes())
+                return freed
+        """, rel=self.CORE, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_returned_delta_ok(self):
+        res = run("""
+            def f(store):
+                return store.detach()
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_detach_flagged_in_store_layers_only(self):
+        src = """
+            def f(store):
+                store.detach()
+        """
+        assert rule_ids(run(src, rel=self.IDX, rules=self.RULES)) == [
+            "device-array-leak"]
+        # detach/attach are generic names outside the store layers
+        # (file handles, observers) — core/ only sees the *_device verbs
+        assert rule_ids(run(src, rel=self.CORE, rules=self.RULES)) == []
+
+    def test_outside_package_ignored(self):
+        res = run("""
+            def f(shard):
+                shard.demote_device()
+        """, rel="tools/fake.py", rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_suppressible_with_reason(self):
+        res = run("""
+            def f(shard):
+                shard.promote_device()  # graftlint: allow[device-array-leak] reason=absolute footprint re-charged below
+        """, rel=self.CORE, rules=self.RULES)
+        assert rule_ids(res) == []
+        assert [v.rule for v in res.suppressed] == ["device-array-leak"]
+
+
+# ---------------------------------------------------------------------------
 # lock-across-device-call
 
 

@@ -1,0 +1,602 @@
+"""Tiered tenant store: HBM / host / disk residency (docs/tiering.md).
+
+Pins the ISSUE 6 acceptance contract:
+
+* K tenants whose combined (quantized) footprint exceeds a pinned HBM
+  budget all stay SERVABLE — every query succeeds from whatever tier the
+  tenant lives in, and the accountant ledger never settles above the
+  budget after a controller pass;
+* a hot tenant's results and device-dispatch count are IDENTICAL to the
+  untiered path (tiering must be invisible to resident tenants);
+* a demoted tenant's first query after cold promotes under the request
+  Deadline — or sheds with an explicit retryable signal
+  (:class:`ColdStartPending` -> HTTP 503 + Retry-After), never a hang;
+* every residency move flows through the ledger (per-tier byte gauges
+  stay truthful across demote / promote / release).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.cluster.resilience import Deadline
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.index.flat import make_flat
+from weaviate_tpu.monitoring.metrics import (
+    TIER_BYTES,
+    TIER_COLD_SHED,
+    TIER_PROMOTIONS,
+    TIER_SEARCHES,
+)
+from weaviate_tpu.ops import device_beam as device_beam_mod
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    FlatIndexConfig,
+    HNSWIndexConfig,
+    MultiTenancyConfig,
+    SQConfig,
+)
+from weaviate_tpu.storage.objects import StorageObject
+from weaviate_tpu.tiering import ColdStartPending, HbmAccountant
+from weaviate_tpu.tiering.controller import COLD, HOT, WARM
+
+D = 32
+
+
+def _vecs(n, seed, d=D):
+    return np.random.default_rng(seed).standard_normal((n, d)).astype(
+        np.float32)
+
+
+def _fill(col, tenant, n, seed, d=D):
+    col.add_tenant(tenant)
+    vecs = _vecs(n, seed, d)
+    objs = [StorageObject(uuid=f"{tenant}-{i:06d}", collection=col.config.name,
+                          properties={"i": i}, vector=vecs[i], tenant=tenant)
+            for i in range(n)]
+    col.put_batch(objs, tenant=tenant)
+    return vecs
+
+
+def _ids(results):
+    return [o.properties["i"] for o, _ in results]
+
+
+def _same_topk(a_ids, a_d, b_ids, b_d):
+    """Row-wise top-k equality modulo tie order (equal-distance rows may
+    permute between the device and host selectors)."""
+    # rtol covers the bf16 device scan vs the fp32 host tier
+    np.testing.assert_allclose(np.sort(a_d, axis=1), np.sort(b_d, axis=1),
+                               rtol=5e-3, atol=1e-4)
+    for ra, rb in zip(np.asarray(a_ids), np.asarray(b_ids)):
+        assert set(ra.tolist()) == set(rb.tolist())
+
+
+# ---------------------------------------------------------------------------
+# accountant
+
+
+class TestAccountant:
+    def test_charge_is_absolute_and_idempotent(self):
+        a = HbmAccountant(1000)
+        a.charge(("C", "t"), 400)
+        a.charge(("C", "t"), 400)
+        assert a.total() == 400
+        a.charge(("C", "t"), 700)  # footprint refresh, not a delta
+        assert a.total() == 700
+
+    def test_release_returns_rent(self):
+        a = HbmAccountant(1000)
+        a.charge(("C", "t"), 400)
+        assert a.release(("C", "t")) == 400
+        assert a.release(("C", "t")) == 0
+        assert a.total() == 0
+
+    def test_overshoot_and_would_exceed(self):
+        a = HbmAccountant(1000)
+        a.charge(("C", "x"), 900)
+        assert a.overshoot() == 0
+        assert a.would_exceed(200)
+        assert not a.would_exceed(100)
+        a.charge(("C", "y"), 400)
+        assert a.overshoot() == 300
+
+    def test_unbudgeted_tracks_but_never_blocks(self):
+        a = HbmAccountant(0)
+        a.charge(("C", "t"), 10**12)
+        assert a.overshoot() == 0
+        assert not a.would_exceed(10**12)
+        assert a.total() == 10**12
+
+    def test_zero_charge_drops_entry(self):
+        a = HbmAccountant(100)
+        a.charge(("C", "t"), 50)
+        a.charge(("C", "t"), 0)
+        assert a.snapshot()["tenants"] == {}
+
+
+# ---------------------------------------------------------------------------
+# store / index residency
+
+
+class TestIndexResidency:
+    def test_flat_demote_parity_and_write_protection(self):
+        idx = make_flat(D, FlatIndexConfig(distance="l2-squared"))
+        vecs = _vecs(200, 1)
+        idx.add_batch(np.arange(200, dtype=np.int64), vecs)
+        q = _vecs(4, 2)
+        hot = idx.search(q, k=10)
+        freed = idx.demote_device()
+        assert freed > 0 and idx.hbm_bytes() == 0
+        assert not idx.device_resident
+        assert idx.host_tier_bytes() > 0
+        warm = idx.search(q, k=10)
+        _same_topk(hot.ids, hot.dists, warm.ids, warm.dists)
+        # a demoted store must never silently re-rent HBM on a write
+        with pytest.raises(RuntimeError, match="warm tier"):
+            idx.add_batch(np.asarray([500]), _vecs(1, 3))
+        gained = idx.promote_device()
+        assert gained == freed
+        back = idx.search(q, k=10)
+        _same_topk(hot.ids, hot.dists, back.ids, back.dists)
+
+    def test_flat_demote_idempotent(self):
+        idx = make_flat(D, FlatIndexConfig())
+        idx.add_batch(np.arange(10, dtype=np.int64), _vecs(10, 1))
+        assert idx.demote_device() > 0
+        assert idx.demote_device() == 0
+        assert idx.promote_device() > 0
+        assert idx.promote_device() == 0
+
+    def test_quantized_flat_demote_serves_from_originals(self):
+        idx = make_flat(D, FlatIndexConfig(
+            distance="l2-squared", quantizer=SQConfig(rescore_limit=50)))
+        vecs = _vecs(300, 4)
+        idx.add_batch(np.arange(300, dtype=np.int64), vecs)
+        q = _vecs(4, 5)
+        freed = idx.demote_device()
+        assert freed > 0 and idx.hbm_bytes() == 0
+        warm = idx.search(q, k=10)
+        # the warm tier is EXACT over the host originals: compare to
+        # brute force, not to the quantized hot path
+        gt = np.argsort(((q[:, None, :] - vecs[None]) ** 2).sum(-1),
+                        axis=1)[:, :10]
+        overlap = np.mean([len(set(warm.ids[i]) & set(gt[i])) / 10
+                           for i in range(4)])
+        assert overlap == 1.0
+
+    def test_residency_flip_never_fails_inflight_search(self):
+        """A demote/promote landing between a search's tier check and its
+        array access re-routes the query (ResidencyMoved retry), never
+        fails it — both tiers can serve any query."""
+        import threading
+
+        idx = make_flat(D, FlatIndexConfig(distance="l2-squared"))
+        idx.add_batch(np.arange(200, dtype=np.int64), _vecs(200, 1))
+        q = _vecs(2, 2)
+        stop = threading.Event()
+
+        def flipper():
+            while not stop.is_set():
+                idx.demote_device()
+                idx.promote_device()
+
+        th = threading.Thread(target=flipper, daemon=True)
+        th.start()
+        try:
+            for _ in range(200):
+                res = idx.search(q, k=5)
+                assert res.ids.shape == (2, 5)
+        finally:
+            stop.set()
+            th.join()
+
+    def test_hnsw_demote_parity_and_no_dispatch(self):
+        idx = HNSWIndexFactory()
+        vecs = _vecs(400, 6)
+        idx.add_batch(np.arange(400, dtype=np.int64), vecs)
+        q = _vecs(8, 7)
+        idx.search(q, k=10)  # compile/dispatch the hot path once
+        before = device_beam_mod.dispatch_count()
+        hot = idx.search(q, k=10)
+        hot_dispatches = device_beam_mod.dispatch_count() - before
+        freed = idx.demote_device()
+        assert freed > 0 and idx.hbm_bytes() == 0
+        before = device_beam_mod.dispatch_count()
+        warm = idx.search(q, k=10)
+        # a warm tenant must NEVER occupy a device batch slot
+        assert device_beam_mod.dispatch_count() == before
+        gt = np.argsort(((q[:, None, :] - vecs[None]) ** 2).sum(-1),
+                        axis=1)[:, :10]
+        overlap = np.mean([len(set(warm.ids[i]) & set(gt[i])) / 10
+                           for i in range(8)])
+        assert overlap == 1.0  # host tier is exact
+        gained = idx.promote_device()
+        assert gained > 0
+        before = device_beam_mod.dispatch_count()
+        back = idx.search(q, k=10)
+        # hot again: device-dispatch parity with the pre-demotion path
+        assert device_beam_mod.dispatch_count() - before == hot_dispatches
+        _same_topk(hot.ids, hot.dists, back.ids, back.dists)
+
+
+def HNSWIndexFactory():
+    from weaviate_tpu.index.hnsw import HNSWIndex
+
+    return HNSWIndex(D, HNSWIndexConfig(
+        distance="l2-squared", ef_construction=48, max_connections=8,
+        flat_search_cutoff=0, filter_flat_selectivity=0.0))
+
+
+# ---------------------------------------------------------------------------
+# controller lifecycle (DB level)
+
+
+@pytest.fixture
+def tiered_db(tmp_path):
+    db = DB(str(tmp_path / "db"), tiering_budget_bytes=1 << 62)
+    yield db
+    db.close()
+
+
+def _mt_col(db, name="Docs", **mt_kw):
+    return db.create_collection(CollectionConfig(
+        name=name,
+        multi_tenancy=MultiTenancyConfig(enabled=True, **mt_kw)))
+
+
+class TestController:
+    def test_eviction_prefers_least_active(self, tiered_db):
+        db = tiered_db
+        col = _mt_col(db)
+        for t, seed in (("a", 1), ("b", 2), ("c", 3)):
+            _fill(col, t, 120, seed)
+        q = _vecs(2, 9)
+        for _ in range(5):  # c is the hot one
+            col.vector_search_batch(q, 5, tenant="c")
+        per = db.tiering.accountant.charged(("Docs", "c"))
+        db.tiering.accountant.set_budget(per + 1)
+        db.tiering.tick()
+        states = {k.split("/")[1]: v["state"]
+                  for k, v in db.tiering.stats()["tenants"].items()}
+        assert states["c"] == HOT
+        assert states["a"] == WARM and states["b"] == WARM
+        assert db.tiering.accountant.overshoot() == 0
+
+    def test_warm_tenant_serves_and_promotes_when_room(self, tiered_db):
+        db = tiered_db
+        col = _mt_col(db)
+        vecs = _fill(col, "a", 120, 1)
+        shard = col._get_shard("tenant-a")
+        shard.demote_device()
+        db.tiering.note_shard_open(col, "a", shard)
+        q = _vecs(2, 2)
+        res = col.vector_search_batch(q, 5, tenant="a")
+        assert len(res[0]) == 5  # served from the host tier
+        # enough activity -> the next pass promotes it back to HBM
+        for _ in range(3):
+            col.vector_search_batch(q, 5, tenant="a")
+        db.tiering.tick()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not shard.device_resident():
+            time.sleep(0.02)  # promotion is async (single-flight pool)
+        assert shard.device_resident()
+        ent = db.tiering.stats()["tenants"]["Docs/a"]
+        assert ent["state"] == HOT
+
+    def test_activity_swap_rebalances_residency(self, tiered_db):
+        """A full budget must not freeze residency: when traffic shifts
+        decisively to a warm tenant, the next pass swaps it with the
+        coldest hot incumbent instead of skipping promotion forever."""
+        db = tiered_db
+        col = _mt_col(db)
+        _fill(col, "a", 120, 1)
+        _fill(col, "b", 120, 2)
+        per = db.tiering.accountant.charged(("Docs", "a"))
+        db.tiering.accountant.set_budget(per + 1)
+        q = _vecs(2, 9)
+        for _ in range(3):
+            col.vector_search_batch(q, 5, tenant="a")
+        db.tiering.tick()  # b (least active) is evicted
+        states = {k.split("/")[1]: v["state"]
+                  for k, v in db.tiering.stats()["tenants"].items()}
+        assert states == {"a": HOT, "b": WARM}
+        # traffic shifts: b's score must clear a's by the swap margin
+        for _ in range(12):
+            col.vector_search_batch(q, 5, tenant="b")
+        db.tiering.tick()  # submits the swap (async promotion)
+        shard_b = col._get_shard("tenant-b")
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not shard_b.device_resident():
+            time.sleep(0.02)
+        assert shard_b.device_resident()
+        states = {k.split("/")[1]: v["state"]
+                  for k, v in db.tiering.stats()["tenants"].items()}
+        assert states == {"a": WARM, "b": HOT}
+        assert db.tiering.accountant.overshoot() == 0
+
+    def test_idle_tenant_drains_to_cold_and_reopens(self, tiered_db):
+        db = tiered_db
+        col = _mt_col(db)
+        vecs = _fill(col, "a", 100, 1)
+        assert "tenant-a" in col._shards
+        db.tiering.cold_after_s = 0.0
+        time.sleep(0.01)
+        db.tiering.tick()  # hot -> warm
+        db.tiering.tick()  # warm -> cold (shard closed, on disk)
+        assert "tenant-a" not in col._shards
+        ent = db.tiering.stats()["tenants"]["Docs/a"]
+        assert ent["state"] == COLD
+        assert ent["disk_bytes"] > 0
+        assert db.tiering.accountant.charged(("Docs", "a")) == 0
+        # first touch: promotion re-opens the shard, data intact
+        before = TIER_PROMOTIONS.value(from_tier=COLD)
+        res = col.vector_search(_vecs(1, 2)[0], 5, tenant="a")
+        assert len(res) == 5
+        assert TIER_PROMOTIONS.value(from_tier=COLD) == before + 1
+        assert "tenant-a" in col._shards
+
+    def test_cold_release_skipped_while_in_use(self, tiered_db):
+        db = tiered_db
+        col = _mt_col(db)
+        _fill(col, "a", 60, 1)
+        db.tiering.cold_after_s = 0.0
+        time.sleep(0.01)
+        db.tiering.tick()  # -> warm
+        # a getter lands between the controller's decision and the close
+        col.vector_search(_vecs(1, 2)[0], 5, tenant="a")
+        assert "tenant-a" in col._shards  # still open: it was re-acquired
+
+    def test_per_tenant_budget_pins_warm(self, tiered_db):
+        db = tiered_db
+        col = _mt_col(db, tenant_hbm_budget_bytes=64)
+        _fill(col, "a", 100, 1)
+        db.tiering.cold_after_s = 0.0
+        time.sleep(0.01)
+        db.tiering.tick()
+        db.tiering.tick()  # released cold
+        res = col.vector_search(_vecs(1, 2)[0], 5, tenant="a")
+        assert len(res) == 5
+        # promotion re-opened it, but its own cap pins it off-device
+        ent = db.tiering.stats()["tenants"]["Docs/a"]
+        assert ent["state"] == WARM
+        shard = col._shards["tenant-a"]
+        assert not shard.device_resident()
+
+    def test_write_to_cap_pinned_tenant_lands_then_redemotes(self, tiered_db):
+        """Demoted stores reject mutations, so a write to a cap-pinned
+        tenant promotes it just long enough to land; the next pass's cap
+        backstop re-demotes. Never a write outage, never a permanent cap
+        violation."""
+        db = tiered_db
+        col = _mt_col(db, tenant_hbm_budget_bytes=64)
+        _fill(col, "a", 100, 1)  # footprint far beyond the 64-byte cap
+        db.tiering.tick()  # cap backstop: re-demote the hot writer
+        shard = col._shards["tenant-a"]
+        assert not shard.device_resident()
+        obj = StorageObject(uuid="a-late", collection="Docs",
+                            properties={"i": -1}, vector=_vecs(1, 2)[0],
+                            tenant="a")
+        col.put_batch([obj], tenant="a")  # promotes transiently to land
+        assert shard.device_resident()
+        db.tiering.tick()
+        assert not shard.device_resident()
+        # reads keep serving from the host tier, new write included
+        res = col.vector_search(_vecs(1, 3)[0], 101, tenant="a")
+        assert len(res) == 101
+
+    def test_budget_knob_hot_reload(self, tiered_db):
+        from weaviate_tpu.utils.runtime_config import TIERING_HBM_BUDGET
+
+        db = tiered_db
+        try:
+            TIERING_HBM_BUDGET.set_override(12345)
+            db.tiering.tick()
+            assert db.tiering.accountant.budget_bytes == 12345
+        finally:
+            TIERING_HBM_BUDGET.clear_override()
+
+    def test_remove_tenant_releases_ledger(self, tiered_db):
+        db = tiered_db
+        col = _mt_col(db)
+        _fill(col, "a", 60, 1)
+        assert db.tiering.accountant.charged(("Docs", "a")) > 0
+        col.remove_tenant("a")
+        assert db.tiering.accountant.charged(("Docs", "a")) == 0
+        assert "Docs/a" not in db.tiering.stats()["tenants"]
+
+    def test_cold_start_sheds_on_expired_deadline(self, tiered_db):
+        db = tiered_db
+        col = _mt_col(db)
+        _fill(col, "a", 60, 1)
+        db.tiering.cold_after_s = 0.0
+        time.sleep(0.01)
+        db.tiering.tick()
+        db.tiering.tick()
+        assert "tenant-a" not in col._shards
+        shed_before = TIER_COLD_SHED.value()
+        dl = Deadline(0.0, op="test")  # already expired at the gate
+        with pytest.raises(ColdStartPending) as ei:
+            db.tiering.ensure_hot(col, "a", deadline=dl)
+        assert ei.value.retry_after >= 1.0
+        assert TIER_COLD_SHED.value() == shed_before + 1
+        # the promotion kept running: the tenant becomes servable again
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "tenant-a" in col._shards:
+                break
+            time.sleep(0.02)
+        res = col.vector_search(_vecs(1, 2)[0], 5, tenant="a",
+                                deadline=Deadline(30.0, op="test"))
+        assert len(res) == 5
+
+    def test_cold_start_completes_within_deadline(self, tiered_db):
+        db = tiered_db
+        col = _mt_col(db)
+        _fill(col, "a", 60, 1)
+        db.tiering.cold_after_s = 0.0
+        time.sleep(0.01)
+        db.tiering.tick()
+        db.tiering.tick()
+        dl = Deadline(30.0, op="test")
+        res = col.vector_search(_vecs(1, 2)[0], 5, tenant="a", deadline=dl)
+        assert len(res) == 5
+        assert dl.remaining() > 0  # promoted + served inside the budget
+
+    def test_untiered_db_has_no_controller(self, tmp_path):
+        env = os.environ.pop("WEAVIATE_TPU_HBM_BUDGET_BYTES", None)
+        try:
+            db = DB(str(tmp_path / "plain"))
+            assert db.tiering is None
+            db.close()
+        finally:
+            if env is not None:
+                os.environ["WEAVIATE_TPU_HBM_BUDGET_BYTES"] = env
+
+
+# ---------------------------------------------------------------------------
+# the acceptance soak: oversubscribed quantized tenants, skewed mix
+
+
+@pytest.mark.timeout(300)
+def test_soak_oversubscribed_tenants(tmp_path):
+    """K quantized tenants at ~3x HBM oversubscription with a skewed
+    query mix: every query succeeds, the hot tenant matches the untiered
+    twin bit-for-bit (results AND device-dispatch count), the ledger
+    settles under the budget after every pass, and a cold tenant's first
+    query either completes in-deadline or sheds explicitly."""
+    K, PER = 6, 150
+    cfg_vec = FlatIndexConfig(distance="l2-squared",
+                              quantizer=SQConfig(rescore_limit=40))
+    db = DB(str(tmp_path / "tiered"), tiering_budget_bytes=1 << 62)
+    plain = DB(str(tmp_path / "plain"))
+    assert plain.tiering is None
+    try:
+        col = db.create_collection(CollectionConfig(
+            name="Soak", vector_config=cfg_vec,
+            multi_tenancy=MultiTenancyConfig(enabled=True)))
+        twin = plain.create_collection(CollectionConfig(
+            name="Soak", vector_config=cfg_vec,
+            multi_tenancy=MultiTenancyConfig(enabled=True)))
+        for t in range(K):
+            _fill(col, f"t{t}", PER, 100 + t)
+            _fill(twin, f"t{t}", PER, 100 + t)
+
+        # pin the budget to a third of the real quantized footprint
+        total = db.tiering.accountant.total()
+        assert total > 0
+        budget = total // 3
+        db.tiering.accountant.set_budget(budget)
+        q = _vecs(4, 999)
+        hot_tenants = ["t0", "t1"]
+        for name in hot_tenants:  # skew: activity concentrates here
+            for _ in range(3):
+                col.vector_search_batch(q, 10, tenant=name)
+        db.tiering.tick()
+
+        # steady state: 80% of traffic on the hot set, the rest sweeps
+        # the demoted tail; every query must succeed from SOME tier
+        rng = np.random.default_rng(0)
+        for step in range(30):
+            name = (hot_tenants[step % 2] if rng.random() < 0.8
+                    else f"t{rng.integers(2, K)}")
+            res = col.vector_search_batch(
+                q, 10, tenant=name, deadline=Deadline(30.0, op="soak"))
+            assert all(len(r) == 10 for r in res)
+            if step % 10 == 9:
+                db.tiering.tick()
+                assert db.tiering.accountant.overshoot() == 0
+                assert TIER_BYTES.value(tier="hbm") <= budget
+
+        # hot-tenant parity with the untiered twin: same results, same
+        # number of device dispatches (tiering invisible when resident)
+        states = {k.split("/")[1]: v["state"]
+                  for k, v in db.tiering.stats()["tenants"].items()}
+        hot_now = [t for t in hot_tenants if states[t] == HOT]
+        assert hot_now, f"skewed mix kept no hot tenant resident: {states}"
+        name = hot_now[0]
+        twin.vector_search_batch(q, 10, tenant=name)  # warm the twin
+        b0 = device_beam_mod.dispatch_count()
+        tiered_res = col.vector_search_batch(q, 10, tenant=name)
+        tiered_disp = device_beam_mod.dispatch_count() - b0
+        b0 = device_beam_mod.dispatch_count()
+        twin_res = twin.vector_search_batch(q, 10, tenant=name)
+        twin_disp = device_beam_mod.dispatch_count() - b0
+        assert tiered_disp == twin_disp
+        for row_t, row_p in zip(tiered_res, twin_res):
+            assert _ids(row_t) == _ids(row_p)
+
+        # cold-start SLO leg: drain an idle tenant to disk, then prove
+        # first-touch either completes in-deadline or sheds explicitly
+        db.tiering.cold_after_s = 0.0
+        time.sleep(0.01)
+        db.tiering.tick()
+        db.tiering.tick()
+        cold = [t for t, e in db.tiering.stats()["tenants"].items()
+                if e["state"] == COLD]
+        assert cold, "idle drain produced no cold tenant"
+        victim = cold[0].split("/")[1]
+        dl = Deadline(30.0, op="cold-slo")
+        try:
+            res = col.vector_search_batch(q, 10, tenant=victim, deadline=dl)
+            assert all(len(r) == 10 for r in res)
+            assert dl.remaining() > 0
+        except ColdStartPending as e:
+            assert e.retry_after >= 1.0  # explicit shed, never a hang
+        # tier attribution flowed: searches were counted per tier
+        assert TIER_SEARCHES.value(tier="device") > 0
+        assert TIER_SEARCHES.value(tier="host") > 0
+    finally:
+        db.close()
+        plain.close()
+
+
+# ---------------------------------------------------------------------------
+# REST: cold-start shed surfaces as 503 + Retry-After
+
+
+def test_rest_cold_start_maps_to_503(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from weaviate_tpu.api.rest import RestAPI
+
+    db = DB(str(tmp_path / "db"), tiering_budget_bytes=1 << 62)
+    api = None
+    try:
+        col = _mt_col(db, name="Docs")
+        _fill(col, "a", 60, 1)
+        api = RestAPI(db)
+        srv = api.serve(host="127.0.0.1", port=0, background=True)
+        base = f"http://127.0.0.1:{srv.server_port}"
+        db.tiering.cold_after_s = 0.0
+        time.sleep(0.01)
+        db.tiering.tick()
+        db.tiering.tick()
+        assert "tenant-a" not in col._shards
+        # slow the promotion down so a 1ms-deadline request must shed
+        orig = col._get_shard
+
+        def slow_get(name):
+            if name == "tenant-a":
+                time.sleep(0.5)
+            return orig(name)
+
+        col._get_shard = slow_get
+        body = (b'{"query": "{ Get { Docs(tenant: \\"a\\", limit: 1) '
+                b'{ i } } }"}')
+        req = urllib.request.Request(
+            f"{base}/v1/graphql", data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Request-Timeout": "0.05"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code in (503, 504)
+        if ei.value.code == 503:
+            assert int(ei.value.headers["Retry-After"]) >= 1
+    finally:
+        if api is not None:
+            api.shutdown()
+        db.close()

@@ -687,6 +687,56 @@ class HostBeamFallbackUnproven(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 4e. device-array-leak
+
+
+class DeviceArrayLeak(Rule):
+    id = "device-array-leak"
+    description = (
+        "discarded byte delta from a tiered-residency move "
+        "(demote_device/promote_device/detach/attach/drop_device)"
+    )
+    rationale = (
+        "The tiering primitives return the HBM bytes they released or "
+        "charged, and the HbmAccountant ledger is only honest if every "
+        "caller propagates that delta (or refreshes the absolute "
+        "footprint). A bare-statement call throws the delta away: the "
+        "arrays moved but the budget ledger did not, so the controller "
+        "either keeps evicting tenants that already left HBM or lets "
+        "real residency grow past the budget unseen."
+    )
+
+    # demote/promote/drop are tiering-specific names: flag anywhere in
+    # the package. detach/attach are generic — only the store/code-plane
+    # layers use them with the accountant contract.
+    _ALWAYS = frozenset({"demote_device", "promote_device", "drop_device"})
+    _STORE_ONLY = frozenset({"detach", "attach"})
+    _STORE_DIRS = ("weaviate_tpu/index/", "weaviate_tpu/compression/",
+                   "weaviate_tpu/tiering/", "weaviate_tpu/ops/")
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not ctx.rel_path.startswith("weaviate_tpu/"):
+            return
+        in_store_layer = _path_in(ctx.rel_path, self._STORE_DIRS)
+        for node in ctx.walk(ast.Expr):
+            call = node.value
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)):
+                continue
+            meth = call.func.attr
+            if meth in self._ALWAYS or (in_store_layer
+                                        and meth in self._STORE_ONLY):
+                yield self.violation(
+                    ctx, node,
+                    f"result of {meth}() discarded — the returned HBM "
+                    "byte delta must reach the tiering accountant "
+                    "(assign it, return it, or re-charge the absolute "
+                    "footprint via note_shard_open/charge)",
+                    severity=SEV_ERROR,
+                )
+
+
+# ---------------------------------------------------------------------------
 # 5. lock-across-device-call
 
 
@@ -822,6 +872,7 @@ ALL_RULES: tuple = (
     TransportErrorSwallowed(),
     UnboundedQueue(),
     HostBeamFallbackUnproven(),
+    DeviceArrayLeak(),
     LockAcrossDeviceCall(),
     Float64LiteralDrift(),
     SuppressionMissingReason(),

@@ -29,6 +29,7 @@ from weaviate_tpu.core.db import DB
 from weaviate_tpu.query import Explorer, HybridParams, QueryParams
 from weaviate_tpu.serving.context import RequestContext, request_scope
 from weaviate_tpu.serving.qos import QosRejected
+from weaviate_tpu.tiering import ColdStartPending
 
 SERVICE = "weaviate_tpu.v1.WeaviateTpu"
 
@@ -189,6 +190,13 @@ class GrpcAPI:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
             except (ValueError, TypeError) as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            except ColdStartPending as e:
+                # tiering cold-start shed (must precede the RuntimeError
+                # catch it subclasses): UNAVAILABLE + retry-after trailer,
+                # the gRPC analogue of REST's 503
+                context.set_trailing_metadata(
+                    (("retry-after", str(int(e.retry_after))),))
+                context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
             except RuntimeError as e:
                 context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         return handler

@@ -742,6 +742,7 @@ class WeaviateV1Service:
         from weaviate_tpu.api.grpc_server import qos_admit
         from weaviate_tpu.cluster.resilience import DeadlineExceeded
         from weaviate_tpu.serving.context import request_scope
+        from weaviate_tpu.tiering import ColdStartPending
 
         def unary(name, fn, req_cls):
             def h(request, context):
@@ -760,6 +761,12 @@ class WeaviateV1Service:
                     context.abort(grpc.StatusCode.NOT_FOUND, str(e))
                 except (ValueError, TypeError) as e:
                     context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                except ColdStartPending as e:
+                    # tiering cold-start shed (subclasses RuntimeError):
+                    # UNAVAILABLE + retry-after, same as the native plane
+                    context.set_trailing_metadata(
+                        (("retry-after", str(int(e.retry_after))),))
+                    context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
                 except RuntimeError as e:
                     context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                                   str(e))

@@ -31,6 +31,7 @@ from weaviate_tpu.cluster.resilience import Deadline, DeadlineExceeded
 from weaviate_tpu.core.db import DB
 from weaviate_tpu.serving.context import RequestContext, request_scope
 from weaviate_tpu.serving.qos import QosRejected
+from weaviate_tpu.tiering import ColdStartPending
 from weaviate_tpu.storage.objects import StorageObject
 from weaviate_tpu.version import __version__
 
@@ -413,6 +414,14 @@ class RestAPI:
             # raft apply/forward deadline (clustered schema mutation)
             response = _json_response(
                 {"error": [{"message": str(e)}]}, 503)
+        except ColdStartPending as e:
+            # tiering cold-start shed: the tenant's promotion is still in
+            # flight past the request deadline — 503 with a Retry-After
+            # sized from the promotion-latency EWMA (docs/tiering.md)
+            response = _json_response(
+                {"error": [{"message": str(e)}]}, 503)
+            response.headers["Retry-After"] = str(
+                int(math.ceil(e.retry_after)))
         except RuntimeError as e:
             # ReplicationError subclasses RuntimeError: consistency level
             # not met / replicas unreachable — a structured 503 the client

@@ -1524,9 +1524,20 @@ class ClusterNode:
         return {"ok": True}
 
     # -- lifecycle ---------------------------------------------------------
-    def close(self):
+    def quiesce(self):
+        """Stop the background SENDERS (anti-entropy tasks, gossip) while
+        leaving the node reachable. Multi-node teardown calls this on
+        every node FIRST, so no node's periodic loop fires an RPC at a
+        peer that already left the transport registry — the source of
+        order-dependent teardown flakes."""
         self.tasks.stop()
         self.gossip.stop()
+
+    def close(self):
+        if getattr(self, "_node_closed", False):
+            return  # idempotent: fixtures and finallys may both call it
+        self._node_closed = True
+        self.quiesce()
         self.raft.stop()
         # in-flight fan-out legs are bounded by their deadlines; don't
         # block shutdown on them, just stop accepting new work

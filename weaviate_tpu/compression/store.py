@@ -19,11 +19,50 @@ import numpy as np
 _PAGE = 4096
 
 
+class ResidencyMoved(RuntimeError):
+    """A reader raced a tier move (tiering/): the arrays it was promised
+    moved between its residency check and the access. Search entry
+    points catch this and retry against the settled tier — both tiers
+    can serve any query, so a flip must never fail one."""
+
+
+class TieredResidency:
+    """Shared warm-tier residency protocol (tiering/). The device state
+    lives in ``_state`` and its detached host-numpy mirror in
+    ``_host_state`` — exactly one is non-None at any time. Subclasses
+    own ``detach``/``attach`` (the state shapes differ), but the
+    check-then-raise accessors live HERE so the single-read
+    ResidencyMoved rule — read ``_state`` once, never check one
+    attribute and then dereference the other — can never diverge
+    between the stores."""
+
+    _state = None
+    _host_state: Optional[tuple] = None
+    _DETACHED_MSG = ("arrays are detached (warm tier): device access "
+                     "would silently re-rent HBM — attach() first")
+
+    @property
+    def device_resident(self) -> bool:
+        return self._host_state is None
+
+    def _require_device(self) -> None:
+        if self._host_state is not None:
+            raise ResidencyMoved(self._DETACHED_MSG)
+
+    def _device_state(self):
+        """The device state, or ResidencyMoved if a detach raced the
+        caller's residency check."""
+        s = self._state
+        if s is None:
+            raise ResidencyMoved(self._DETACHED_MSG)
+        return s
+
+
 def _round_up(n: int, page: int = _PAGE) -> int:
     return ((n + page - 1) // page) * page
 
 
-class DeviceArraySet:
+class DeviceArraySet(TieredResidency):
     """Named device arrays sharing a doc-id-addressed leading dim + validity.
 
     fields: name -> (trailing_shape tuple, dtype). All arrays grow together
@@ -45,12 +84,55 @@ class DeviceArraySet:
             jnp.zeros((cap,), jnp.bool_),
         )
         self._host_valid = np.zeros((cap,), bool)
+        # warm-tier residency (tiering/): detached code planes live here
+        # as host numpy; device accessors raise until attach
+        self._host_state: Optional[tuple] = None
         self._watermark = 0
         self._live = 0
 
+    # -- residency (tiering warm tier; protocol on TieredResidency) -------
+    def detach(self) -> int:
+        """Demote the code planes to host RAM; returns HBM bytes
+        released. Readers holding an old snapshot keep their arrays."""
+        if self._host_state is not None:
+            return 0
+        arrays, valid = self._state
+        freed = self.nbytes
+        self._host_state = (
+            {name: np.asarray(a) for name, a in arrays.items()},
+            np.asarray(valid),
+        )
+        self._state = None
+        return freed
+
+    def attach(self) -> int:
+        """Re-upload the code planes at identical shapes/dtypes (compiled
+        scan/beam programs keep hitting their cache). Returns HBM bytes
+        charged."""
+        if self._host_state is None:
+            return 0
+        arrays, valid = self._host_state
+        self._state = (
+            {name: jnp.asarray(a) for name, a in arrays.items()},
+            jnp.asarray(valid),
+        )
+        self._host_state = None
+        return self.nbytes
+
+    @property
+    def host_bytes(self) -> int:
+        hs = self._host_state
+        if hs is None:
+            return 0
+        arrays, valid = hs
+        return sum(a.nbytes for a in arrays.values()) + valid.nbytes
+
     @property
     def capacity(self) -> int:
-        return self._state[1].shape[0]
+        hs = self._host_state
+        if hs is not None:
+            return hs[1].shape[0]
+        return self._device_state()[1].shape[0]
 
     @property
     def watermark(self) -> int:
@@ -62,12 +144,16 @@ class DeviceArraySet:
 
     @property
     def valid_mask(self) -> jnp.ndarray:
-        return self._state[1]
+        return self._device_state()[1]
 
     @property
     def nbytes(self) -> int:
-        """Device (HBM) footprint of all code planes + the valid mask."""
-        arrays, valid = self._state
+        """Device (HBM) footprint of all code planes + the valid mask
+        (zero while detached to the warm tier)."""
+        s = self._state
+        if s is None:
+            return 0
+        arrays, valid = s
         return sum(a.nbytes for a in arrays.values()) + valid.nbytes
 
     @property
@@ -75,16 +161,17 @@ class DeviceArraySet:
         return self._host_valid
 
     def __getitem__(self, name: str) -> jnp.ndarray:
-        return self._state[0][name]
+        return self._device_state()[0][name]
 
     def snapshot(self) -> tuple[dict[str, jnp.ndarray], jnp.ndarray]:
         """Consistent (arrays, valid) pair for search threads — mutations
         swap the whole state tuple, never edit it in place."""
-        return self._state
+        return self._device_state()
 
     def ensure_capacity(self, min_capacity: int) -> None:
         if min_capacity <= self.capacity:
             return
+        self._require_device()  # writers promote before growing
         new_cap = _round_up(max(min_capacity, self.capacity * 2))
         arrays, valid = self._state
         grown: dict[str, jnp.ndarray] = {}
@@ -105,6 +192,7 @@ class DeviceArraySet:
         doc_ids = np.asarray(doc_ids, np.int32)
         if len(doc_ids) == 0:
             return
+        self._require_device()  # ingest promotes the tenant first
         self.ensure_capacity(int(doc_ids.max()) + 1)
         idx = jnp.asarray(doc_ids)
         arrays, valid = self._state
@@ -122,6 +210,7 @@ class DeviceArraySet:
         doc_ids = np.asarray(doc_ids, np.int32)
         if len(doc_ids) == 0:
             return
+        self._require_device()  # writers promote before mutating
         doc_ids = doc_ids[doc_ids < self.capacity]
         was = self._host_valid[doc_ids]
         arrays, valid = self._state

@@ -172,6 +172,13 @@ class Collection:
                     self._get_seq += 1
                     s._last_get = self._get_seq
                     self._shards[name] = s
+                if name.startswith("tenant-"):
+                    # tiering ledger: a freshly opened tenant shard starts
+                    # renting HBM — charge it (outside the collection
+                    # lock; the hook takes the controller + shard locks)
+                    t = self._tiering()
+                    if t is not None:
+                        t.note_shard_open(self, name[len("tenant-"):], s)
                 return s
             finally:
                 with self._lock:
@@ -225,11 +232,21 @@ class Collection:
                     break
         return out
 
+    def _tiering(self):
+        """The DB's tiering controller, when one governs this collection
+        (multi-tenant only — single-tenant corpora are the node's working
+        set, not candidates for eviction)."""
+        t = getattr(self.db, "tiering", None) if self.db is not None else None
+        if t is None or not self.config.multi_tenancy.enabled:
+            return None
+        return t
+
     def _shard_for_uuid(self, uuid: str) -> Shard:
         n = max(1, self.config.sharding.desired_count)
         return self._get_shard(f"shard{shard_for_uuid(uuid, n)}")
 
-    def _route(self, uuid: str, tenant: str = "") -> Shard:
+    def _route(self, uuid: str, tenant: str = "",
+               write: bool = False) -> Shard:
         if self.config.multi_tenancy.enabled:
             if not tenant:
                 raise ValueError(
@@ -248,6 +265,24 @@ class Collection:
                 else:
                     raise TenantNotActive(
                         f"tenant {tenant!r} is not active")
+            t = self._tiering()
+            if t is not None:
+                # ONE activity event per operation (batched callers
+                # resolve the shard once; the ensure_hot gate carries
+                # the event weight itself) — per-object or double bumps
+                # would let a single ingest batch outweigh thousands of
+                # queries in the EWMA
+                t.ensure_hot(self, tenant,
+                             weight=2.0 if write else 1.0)
+                tenant_shard = self._get_shard(f"tenant-{tenant}")
+                if write and not tenant_shard.device_resident():
+                    # demoted stores reject mutations: writers promote
+                    # first (reads stay on the warm host tier), through
+                    # the controller so the attach respects the budget
+                    # ledger and make-room, never a bare re-rent
+                    t.promote_for_write(
+                        (self.config.name, tenant), tenant_shard)
+                return tenant_shard
             return self._get_shard(f"tenant-{tenant}")
         return self._shard_for_uuid(uuid)
 
@@ -259,6 +294,13 @@ class Collection:
                 raise KeyError(f"tenant {tenant!r} not found")
             if self._tenant_status[tenant] != TENANT_HOT:
                 raise TenantNotActive(f"tenant {tenant!r} is not active")
+            t = self._tiering()
+            if t is not None:
+                # activity signal + cold-start gate: a COLD tenant's first
+                # query blocks on the async promotion under the request's
+                # serving Deadline (503 + Retry-After past it); warm
+                # tenants serve immediately from the host tier
+                t.ensure_hot(self, tenant)
             return [self._get_shard(f"tenant-{tenant}")]
         return [self._get_shard(f"shard{i}")
                 for i in range(max(1, self.config.sharding.desired_count))]
@@ -280,10 +322,48 @@ class Collection:
                 return
             ev.wait()
 
+    def release_tenant(self, name: str) -> bool:
+        """COLD demotion (tiering/): close the tenant's shard — state
+        flushes + checkpoints to disk through the normal storage paths —
+        WITHOUT changing its logical HOT status, so the next access
+        lazily reopens it (the promotion path). Returns False when the
+        tenant is not open or was re-acquired since the controller's
+        decision (the ``_last_get`` stamp proves no racing getter)."""
+        shard_name = f"tenant-{name}"
+        with self._lock:
+            s = self._shards.get(shard_name)
+            if s is None:
+                return False
+            stamp = s._last_get
+        # durability FIRST, outside the lock: flush + checkpoint while the
+        # shard is still published, so a getter that lands mid-release and
+        # rebuilds from disk sees every write. Only then re-verify the
+        # stamp under the lock (same proof _maintenance_shards uses) — a
+        # tenant that got traffic during the flush stays open — and pop;
+        # the trailing close() re-runs flush/checkpoint as cheap no-ops.
+        s.flush()
+        s.checkpoint()
+        with self._lock:
+            s2 = self._shards.get(shard_name)
+            if s2 is None or s2._last_get != stamp:
+                return False
+            self._shards.pop(shard_name)
+        # under the shard lock: waits out any writer already inside a
+        # mutation, then flags the instance so a writer that routed to
+        # it BEFORE the pop re-routes (ResidencyMoved -> re-resolve)
+        # instead of mutating a closed store
+        with s._lock:
+            s._tier_released = True
+        s.close()
+        return True
+
     def remove_tenant(self, name: str) -> None:
         import shutil
 
         self._wait_building(f"tenant-{name}")
+        t = self._tiering()
+        if t is not None:
+            t.forget(self.config.name, name)
         with self._lock:
             if self._tenant_status.get(name) in ("FREEZING", "UNFREEZING"):
                 # a racing transfer would resurrect the tenant on its
@@ -582,12 +662,37 @@ class Collection:
             o.tenant = tenant
         self._vectorize_missing(objs)
         by_shard: dict[str, list[StorageObject]] = {}
-        for o in objs:
-            shard = self._route(o.uuid, tenant)
-            by_shard.setdefault(shard.name, []).append(o)
+        owners: dict[str, Shard] = {}
+        if self.config.multi_tenancy.enabled:
+            # every object of a tenant batch lands on the ONE tenant
+            # shard: resolve it (and run the tiering write gate) once,
+            # not per object
+            shard = self._route("", tenant, write=True)
+            owners[shard.name] = shard
+            by_shard[shard.name] = list(objs)
+        else:
+            for o in objs:
+                shard = self._route(o.uuid, tenant, write=True)
+                owners[shard.name] = shard
+                by_shard.setdefault(shard.name, []).append(o)
         self._reject_readonly(by_shard)
+        # write through the resolved shard OBJECTS: a concurrent tiering
+        # cold-release pops _shards entries, and a dict re-lookup here
+        # would KeyError on a shard we already routed to
         for name, group in by_shard.items():
-            self._shards[name].put_batch(group)
+            self._write_tier_stable(
+                name, owners[name],
+                lambda s, g=group: s.put_batch(g))
+        if tenant:
+            # tiering ledger: the writes above may have grown the device
+            # arrays — refresh the charge NOW so budget enforcement sees
+            # the real footprint, not the pre-batch one (the 5s tick is
+            # only a backstop)
+            t = self._tiering()
+            if t is not None:
+                shard = self._shards.get(f"tenant-{tenant}")
+                if shard is not None:
+                    t.note_shard_open(self, tenant, shard)
         BATCH_DURATION.observe(time.perf_counter() - t0,
                                collection=self.config.name)
         return [o.uuid for o in objs]
@@ -625,13 +730,45 @@ class Collection:
 
     def delete(self, uuids: list[str], tenant: str = "") -> int:
         by_shard: dict[str, list[str]] = {}
-        for u in uuids:
-            shard = self._route(u, tenant)
-            by_shard.setdefault(shard.name, []).append(u)
+        owners: dict[str, Shard] = {}
+        if self.config.multi_tenancy.enabled:
+            shard = self._route("", tenant, write=True)
+            owners[shard.name] = shard
+            by_shard[shard.name] = list(uuids)
+        else:
+            for u in uuids:
+                shard = self._route(u, tenant, write=True)
+                owners[shard.name] = shard
+                by_shard.setdefault(shard.name, []).append(u)
         self._reject_readonly(by_shard)
         return sum(
-            self._shards[name].delete(group) for name, group in by_shard.items()
+            self._write_tier_stable(
+                name, owners[name],
+                lambda s, g=group: s.delete(g))
+            for name, group in by_shard.items()
         )
+
+    def _write_tier_stable(self, shard_name: str, shard, fn):
+        """Run a shard mutation ``fn(shard)``, retrying once when a
+        tiering move lands between the route gate's residency check and
+        the write (``ResidencyMoved``): re-resolve the shard (a cold
+        release closes the routed instance — ``_get_shard`` re-opens it
+        from the checkpoint the release flushed), promote back
+        (budget-aware) and re-apply — a residency flip must re-route a
+        write, never fail it."""
+        from weaviate_tpu.compression.store import ResidencyMoved
+
+        try:
+            return fn(shard)
+        except ResidencyMoved:
+            t = self._tiering()
+            if t is None or not shard_name.startswith("tenant-"):
+                raise
+            shard = self._get_shard(shard_name)
+            if not shard.device_resident():
+                t.promote_for_write(
+                    (self.config.name, shard_name[len("tenant-"):]), shard)
+            return fn(shard)
 
     def _reject_readonly(self, shard_names) -> None:
         """Deletes are writes too: a READONLY shard rejects every
@@ -697,19 +834,40 @@ class Collection:
 
     def delete_where(self, flt: Filter, tenant: str = "") -> int:
         """Batch delete by filter (reference ``batch_delete.go``)."""
-        shards = self._search_shards(tenant)
+        if self.config.multi_tenancy.enabled:
+            # a delete is a write: run the tiering write gate like
+            # delete/put_batch, so a warm (demoted) tenant promotes
+            # before the mutation instead of failing with ResidencyMoved.
+            # But with SEARCH-path tenant semantics first — a delete must
+            # never auto-create or auto-activate a tenant as a side
+            # effect (deleting from a typo'd name should 404, not mint
+            # an empty shard or onload a frozen one)
+            if not tenant:
+                raise ValueError(
+                    f"collection {self.config.name!r} is multi-tenant: "
+                    "tenant required")
+            if tenant not in self._tenant_status:
+                raise KeyError(f"tenant {tenant!r} not found")
+            if self._tenant_status[tenant] != TENANT_HOT:
+                raise TenantNotActive(f"tenant {tenant!r} is not active")
+            shards = [self._route("", tenant, write=True)]
+        else:
+            shards = self._search_shards(tenant)
         self._reject_readonly([s.name for s in shards])
         n = 0
         for shard in shards:
-            space = shard._next_doc_id
-            mask = shard.allow_list(flt, space)
-            doc_ids = np.nonzero(mask)[0]
-            uuids = []
-            for d in doc_ids:
-                obj = shard.get_by_docid(int(d))
-                if obj is not None:
-                    uuids.append(obj.uuid)
-            n += shard.delete(uuids)
+            def _one(shard):
+                space = shard._next_doc_id
+                mask = shard.allow_list(flt, space)
+                doc_ids = np.nonzero(mask)[0]
+                uuids = []
+                for d in doc_ids:
+                    obj = shard.get_by_docid(int(d))
+                    if obj is not None:
+                        uuids.append(obj.uuid)
+                return shard.delete(uuids)
+
+            n += self._write_tier_stable(shard.name, shard, _one)
         return n
 
     # -- reads ------------------------------------------------------------

@@ -19,7 +19,8 @@ from weaviate_tpu.schema.config import CollectionConfig
 
 
 class DB:
-    def __init__(self, root: str, sync_writes: bool = False, modules=None):
+    def __init__(self, root: str, sync_writes: bool = False, modules=None,
+                 tiering_budget_bytes: Optional[int] = None):
         self.root = root
         self.sync_writes = sync_writes
         if modules is None:
@@ -30,6 +31,23 @@ class DB:
         os.makedirs(root, exist_ok=True)
         self._lock = threading.RLock()
         self._collections: dict[str, Collection] = {}
+        # tiered tenant store (docs/tiering.md): created only when an HBM
+        # budget is configured (ctor arg > env > runtime knob) — absent,
+        # the serving path is byte-identical to the untiered one
+        self.tiering = None
+        if tiering_budget_bytes is None:
+            tiering_budget_bytes = int(
+                os.environ.get("WEAVIATE_TPU_HBM_BUDGET_BYTES", "0") or 0)
+            if tiering_budget_bytes <= 0:
+                from weaviate_tpu.utils.runtime_config import (
+                    TIERING_HBM_BUDGET,
+                )
+
+                tiering_budget_bytes = int(TIERING_HBM_BUDGET.get())
+        if tiering_budget_bytes > 0:
+            from weaviate_tpu.tiering import TieringController
+
+            self.tiering = TieringController(self, tiering_budget_bytes)
         # serving QoS controller, shared by every API plane mounted on
         # this DB (REST + both gRPC services) so one AIMD ceiling governs
         # total in-flight work; built lazily — most tests never serve
@@ -48,6 +66,8 @@ class DB:
         self.cycles.register("metrics_refresh", self._metrics_cycle, 30.0)
         self.cycles.register("compaction", self._compaction_cycle, 60.0)
         self.cycles.register("checkpoint", self._checkpoint_cycle, 120.0)
+        if self.tiering is not None:
+            self.cycles.register("tiering", self.tiering.tick, 5.0)
         # usage reports to a bucket when USAGE_{S3,GCS}_BUCKET configured
         # (reference modules/usage-* default interval 1h)
         from weaviate_tpu.backup.offload import get_usage_reporter
@@ -160,6 +180,12 @@ class DB:
                 from weaviate_tpu.serving.qos import AdmissionController
 
                 self._qos = AdmissionController()
+                if self.tiering is not None:
+                    # front-door activity signal: every admitted tenant
+                    # request bumps the tiering EWMA before the query
+                    # engine is even reached
+                    self._qos.throttle.on_activity = \
+                        self.tiering.on_tenant_signal
             return self._qos
 
     def get_collection(self, name: str) -> Collection:
@@ -178,6 +204,8 @@ class DB:
             c = self._collections.pop(name, None)
             if c is None:
                 return
+            if self.tiering is not None:
+                self.tiering.forget_collection(name)
             # aliases of a dropped class go with it (a dangling alias
             # would 404 confusingly on every later use)
             for a in [a for a, t in self._aliases.items() if t == name]:
@@ -249,10 +277,15 @@ class DB:
 
     def close(self) -> None:
         self.cycles.stop()
+        if self.tiering is not None:
+            self.tiering.close()
         with self._lock:
             for c in self._collections.values():
                 c.close()
             self._collections = {}
 
     def stats(self) -> dict:
-        return {name: c.stats() for name, c in self._collections.items()}
+        out = {name: c.stats() for name, c in self._collections.items()}
+        if self.tiering is not None:
+            out["_tiering"] = self.tiering.stats()
+        return out

@@ -106,6 +106,11 @@ class Shard:
         self._migrating = False  # auto tier upgrade in flight
         self._migrate_cancel = False
         self._migrate_thread = None
+        # set by Collection.release_tenant just before it closes this
+        # instance (tiering cold demotion): a writer that routed to the
+        # old object must re-route to the re-opened shard, not mutate a
+        # closed store
+        self._tier_released = False
         self._lock = threading.RLock()
         self._vector_indexes: dict[str, VectorIndex] = {}
         self._counter_path = os.path.join(dirpath, "counter.bin")
@@ -425,6 +430,7 @@ class Shard:
             for o in objs)
         MONITOR.check_alloc(est, "batch import")
         with self._lock:
+            self._require_open()
             # validate up-front so a bad object can't leave a partial batch:
             # every vector for a target must match the index dims (or, for a
             # brand-new target, the dims of the first vector in this batch)
@@ -525,9 +531,22 @@ class Shard:
         for idx in self._vector_indexes.values():
             idx.delete(arr)
 
+    def _require_open(self) -> None:
+        """Caller holds ``self._lock``. A shard the tiering controller
+        released (closed to the cold tier) must bounce late writers to
+        the retry path — they re-resolve the re-opened shard instead of
+        mutating a closed store."""
+        if self._tier_released:
+            from weaviate_tpu.compression.store import ResidencyMoved
+
+            raise ResidencyMoved(
+                f"shard {self.name!r} was released to the cold tier; "
+                "re-route to the re-opened shard")
+
     def delete(self, uuids: list[str]) -> int:
         """Delete by uuid; returns number actually removed."""
         with self._lock:
+            self._require_open()
             doc_ids = []
             for u in uuids:
                 key = u.encode()
@@ -597,6 +616,12 @@ class Shard:
                 ids=np.full((b, k), -1, np.int64),
                 dists=np.full((b, k), np.inf, np.float32),
             )
+        from weaviate_tpu.monitoring.metrics import TIER_SEARCHES
+
+        # residency-tier attribution (tiering/): device = HBM-resident
+        # arrays, host = the warm tier's exact fallback executor
+        TIER_SEARCHES.inc(
+            tier="device" if idx.device_resident else "host")
         if idx.multi_vector:
             # a [Tq, D] matrix is ONE late-interaction query (token set),
             # not a Tq-query batch; max_distance bounds the negated MaxSim
@@ -612,6 +637,40 @@ class Shard:
 
     def objects_by_docids(self, doc_ids: np.ndarray) -> list[Optional[StorageObject]]:
         return [self.get_by_docid(int(d)) if d >= 0 else None for d in doc_ids]
+
+    # -- tiered residency (docs/tiering.md) --------------------------------
+    def hbm_bytes(self) -> int:
+        """Current HBM rent of every vector index this shard owns."""
+        with self._lock:
+            return sum(idx.hbm_bytes()
+                       for idx in self._vector_indexes.values())
+
+    def host_tier_bytes(self) -> int:
+        with self._lock:
+            return sum(idx.host_tier_bytes()
+                       for idx in self._vector_indexes.values())
+
+    def device_resident(self) -> bool:
+        """Whether every demotable index is on device (an all-host-tier
+        shard — e.g. no vector indexes yet — counts as resident: there is
+        nothing to promote)."""
+        with self._lock:
+            return all(idx.device_resident
+                       for idx in self._vector_indexes.values())
+
+    def demote_device(self) -> int:
+        """Warm demotion of every vector index; returns total HBM bytes
+        released (the caller feeds this to the tiering accountant). Held
+        under the shard lock so a concurrent put cannot interleave with
+        the array move."""
+        with self._lock:
+            return sum(idx.demote_device()
+                       for idx in self._vector_indexes.values())
+
+    def promote_device(self) -> int:
+        with self._lock:
+            return sum(idx.promote_device()
+                       for idx in self._vector_indexes.values())
 
     # -- lifecycle --------------------------------------------------------
     def flush(self) -> None:
@@ -803,6 +862,8 @@ class Shard:
             "name": self.name,
             "objects": self.count(),
             "next_doc_id": self._next_doc_id,
+            "hbm_bytes": self.hbm_bytes(),
+            "host_tier_bytes": self.host_tier_bytes(),
             "vector_indexes": {
                 nm: idx.stats() for nm, idx in self._vector_indexes.items()
             },

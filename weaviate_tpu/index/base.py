@@ -86,5 +86,49 @@ class VectorIndex(abc.ABC):
     def drop(self) -> None:
         pass
 
+    # -- tiered residency (tiering/ warm tier; docs/tiering.md) -----------
+    # Default: an index type with no device arrays (or one that cannot
+    # demote them) reports zero HBM rent and stays "resident" — the
+    # controller then only ever cold-releases its whole shard.
+    @property
+    def device_resident(self) -> bool:
+        """False while this index's device arrays are demoted to host."""
+        return True
+
+    def hbm_bytes(self) -> int:
+        """Current HBM rent (0 while demoted / for host-only indexes)."""
+        return 0
+
+    def host_tier_bytes(self) -> int:
+        """Host-RAM rent of demoted device arrays (warm tier)."""
+        return 0
+
+    def demote_device(self) -> int:
+        """Move device arrays to host RAM (warm tier); returns HBM bytes
+        released. Callers MUST feed the returned delta to the tiering
+        accountant (graftlint rule ``device-array-leak``)."""
+        return 0
+
+    def promote_device(self) -> int:
+        """Re-upload demoted arrays; returns HBM bytes charged. Same
+        accountant contract as :meth:`demote_device`."""
+        return 0
+
     def stats(self) -> dict:
         return {"count": self.count(), "capacity": self.capacity}
+
+
+def run_tier_stable(fn):
+    """Run a search closure, retrying when a residency flip lands between
+    its tier check and the array access (``ResidencyMoved``). Either tier
+    can serve any query, so a concurrent demote/promote must re-route the
+    request, never fail it. Two retries bound the pathological case of a
+    flip landing on every attempt."""
+    from weaviate_tpu.compression.store import ResidencyMoved
+
+    for _ in range(2):
+        try:
+            return fn()
+        except ResidencyMoved:
+            continue
+    return fn()

@@ -38,13 +38,20 @@ from weaviate_tpu.monitoring.metrics import (
 
 
 class _Req:
-    __slots__ = ("queries", "k", "allow", "mask_key", "deadline", "event",
-                 "ids", "dists", "error")
+    __slots__ = ("queries", "k", "allow", "mask_key", "tier_key",
+                 "deadline", "event", "ids", "dists", "error")
 
-    def __init__(self, queries: np.ndarray, k: int, allow, deadline=None):
+    def __init__(self, queries: np.ndarray, k: int, allow, deadline=None,
+                 tier_key=None):
         self.queries = queries
         self.k = k
         self.allow = allow
+        # residency-tier generation (tiering/): requests enqueued against
+        # different residency epochs must never share one device batch —
+        # a tenant demoted (or promoted) between enqueue and drain would
+        # otherwise coalesce into a batch whose arrays belong to the
+        # other generation
+        self.tier_key = tier_key
         # content digest of the allow mask, computed ONCE at enqueue so
         # the leader's grouping scan never re-reads mask bytes under the
         # lock; collisions are disambiguated by array_equal in
@@ -88,14 +95,15 @@ class CoalescingDispatcher:
         self._pending: list[_Req] = []
         self._draining = False
 
-    def search(self, queries: np.ndarray, k: int, allow=None, deadline=None):
+    def search(self, queries: np.ndarray, k: int, allow=None, deadline=None,
+               tier_key=None):
         if deadline is None:
             # the serving layer's end-to-end budget rides a thread-scoped
             # context so index signatures in between stay deadline-free
             from weaviate_tpu.serving.context import current_deadline
 
             deadline = current_deadline()
-        req = _Req(queries, k, allow, deadline)
+        req = _Req(queries, k, allow, deadline, tier_key=tier_key)
         with self._lock:
             self._pending.append(req)
         # Every waiter is a potential leader: whoever finds no active
@@ -164,7 +172,8 @@ class CoalescingDispatcher:
             i = 0
             while i < len(self._pending) and rows < self.max_batch:
                 r = self._pending[i]
-                if r.k == head.k and _masks_equal(head, r):
+                if r.k == head.k and r.tier_key == head.tier_key \
+                        and _masks_equal(head, r):
                     group.append(self._pending.pop(i))
                     rows += r.queries.shape[0]
                 else:

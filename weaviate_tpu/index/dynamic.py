@@ -104,6 +104,25 @@ class DynamicIndex(VectorIndex):
             self._maybe_upgrade()
         return meta
 
+    # -- tiered residency (docs/tiering.md): pure delegation — without it
+    # the base-class no-ops would hide the inner index's real HBM rent
+    # from the budget ledger and turn demotion into a silent no-op
+    @property
+    def device_resident(self) -> bool:
+        return self._inner.device_resident
+
+    def hbm_bytes(self) -> int:
+        return self._inner.hbm_bytes()
+
+    def host_tier_bytes(self) -> int:
+        return self._inner.host_tier_bytes()
+
+    def demote_device(self) -> int:
+        return self._inner.demote_device()
+
+    def promote_device(self) -> int:
+        return self._inner.promote_device()
+
     def stats(self) -> dict:
         s = self._inner.stats()
         s["type"] = f"dynamic[{s['type']}]"

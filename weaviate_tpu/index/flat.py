@@ -15,7 +15,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from weaviate_tpu.index.base import SearchResult, VectorIndex
+from weaviate_tpu.index.base import (
+    SearchResult,
+    VectorIndex,
+    run_tier_stable,
+)
 from weaviate_tpu.index.store import DeviceVectorStore
 from weaviate_tpu.ops.distance import MASK_DISTANCE, flat_search
 from weaviate_tpu.ops.topk import masked_topk
@@ -68,6 +72,18 @@ class FlatIndex(VectorIndex):
         """Top-k scan. ``approx_recall`` overrides the config knob (range
         queries force 0.0: approx selection may drop in-range rows, which
         breaks the search_by_distance contract rather than trading recall)."""
+        # a tiering demote/promote between the residency check below and
+        # the array access re-routes the query, never fails it
+        return run_tier_stable(
+            lambda: self._search_impl(queries, k, allow_list, approx_recall))
+
+    def _search_impl(
+        self,
+        queries: np.ndarray,
+        k: int,
+        allow_list: Optional[np.ndarray] = None,
+        approx_recall: Optional[float] = None,
+    ) -> SearchResult:
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         if queries.shape[-1] != self.store.dims:
             raise ValueError(
@@ -83,6 +99,14 @@ class FlatIndex(VectorIndex):
                 )
 
                 approx_recall = FLAT_APPROX_RECALL_DEFAULT.get()
+        if not self.store.device_resident:
+            # WARM tier (tiering/): the corpus is demoted to host RAM —
+            # serve exactly from there, never re-renting HBM per query
+            from weaviate_tpu.index.hnsw.backend import host_store_topk
+
+            d, ids = host_store_topk(
+                self.store, self.metric, queries, k, allow_list)
+            return SearchResult(ids=ids, dists=d)
         qj = jnp.asarray(queries)
         if self.metric == "cosine":
             from weaviate_tpu.ops.distance import normalize
@@ -189,12 +213,30 @@ class FlatIndex(VectorIndex):
     def load_vectors(self, path: str) -> Optional[dict]:
         return self.store.load(path)
 
+    # -- tiered residency (docs/tiering.md) -------------------------------
+    @property
+    def device_resident(self) -> bool:
+        return self.store.device_resident
+
+    def hbm_bytes(self) -> int:
+        return self.store.nbytes
+
+    def host_tier_bytes(self) -> int:
+        return self.store.host_bytes
+
+    def demote_device(self) -> int:
+        return self.store.detach()
+
+    def promote_device(self) -> int:
+        return self.store.attach()
+
     def stats(self) -> dict:
         return {
             "type": "flat",
             "count": self.count(),
             "capacity": self.capacity,
             "metric": self.metric,
+            "device_resident": self.store.device_resident,
         }
 
 
@@ -289,7 +331,8 @@ class QuantizedFlatIndex(VectorIndex):
             raise ValueError(
                 f"query dims {queries.shape[-1]} != index dims {self.dims}"
             )
-        d, ids = self.backend.flat_topk(queries, k, allow_list)
+        d, ids = run_tier_stable(
+            lambda: self.backend.flat_topk(queries, k, allow_list))
         return SearchResult(ids=ids, dists=d)
 
     def search_by_distance(
@@ -317,6 +360,23 @@ class QuantizedFlatIndex(VectorIndex):
     def contains(self, doc_id: int) -> bool:
         return self.backend.contains(doc_id)
 
+    # -- tiered residency (docs/tiering.md) -------------------------------
+    @property
+    def device_resident(self) -> bool:
+        return self.backend.device_resident
+
+    def hbm_bytes(self) -> int:
+        return self.backend.hbm_bytes()
+
+    def host_tier_bytes(self) -> int:
+        return self.backend.host_tier_bytes()
+
+    def demote_device(self) -> int:
+        return self.backend.demote_device()
+
+    def promote_device(self) -> int:
+        return self.backend.promote_device()
+
     def stats(self) -> dict:
         return {
             "type": "flat",
@@ -325,4 +385,5 @@ class QuantizedFlatIndex(VectorIndex):
             "count": self.count(),
             "capacity": self.capacity,
             "metric": self.metric,
+            "device_resident": self.backend.device_resident,
         }

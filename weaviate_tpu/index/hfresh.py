@@ -393,6 +393,15 @@ class HFreshIndex(VectorIndex):
     def contains(self, doc_id: int) -> bool:
         return self.store.contains(doc_id)
 
+    # -- tiered residency (docs/tiering.md): hfresh has no warm search
+    # tier (its posting walk reads the device store directly), so it
+    # stays non-demotable — demote_device keeps the base-class 0 and the
+    # controller can only cold-release the whole shard. But its HBM rent
+    # is REAL and must reach the budget ledger; hiding it would let
+    # actual residency grow past the budget unseen.
+    def hbm_bytes(self) -> int:
+        return self.store.nbytes
+
     def stats(self) -> dict:
         sizes = [len(p) for p in self._postings]
         return {

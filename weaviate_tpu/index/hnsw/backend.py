@@ -31,6 +31,88 @@ from weaviate_tpu.ops.distance import (
 _INF = np.float32(np.inf)
 
 
+def host_exact_topk(q: np.ndarray, vecs: np.ndarray, live_ids: np.ndarray,
+                    metric: str, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Exact top-k over host rows — the WARM-tier search executor
+    (tiering/): a demoted tenant's arrays live in host RAM and its
+    (by definition low-rate) queries are served by one BLAS pass instead
+    of re-renting HBM. ``vecs`` [L, D] are the live rows, ``live_ids``
+    their doc ids. Returns (dists [B, k], ids [B, k]) ascending,
+    -1/inf padded."""
+    b = q.shape[0]
+    if len(live_ids) == 0:
+        return (np.full((b, k), _INF, np.float32),
+                np.full((b, k), -1, np.int64))
+    v = vecs.astype(np.float32, copy=False)
+    if metric in ("l2-squared", "dot", "cosine"):
+        ip = q @ v.T  # [B, L] — BLAS, never a [B, L, D] intermediate
+        if metric == "l2-squared":
+            sq = np.einsum("ld,ld->l", v, v)
+            qsq = np.einsum("bd,bd->b", q, q)
+            d = qsq[:, None] - 2.0 * ip + sq[None, :]
+        elif metric == "dot":
+            d = -ip
+        else:
+            d = 1.0 - ip
+        d = d.astype(np.float32, copy=False)
+    else:
+        # manhattan/hamming: chunk the row axis (~64MB intermediates)
+        d = np.empty((b, len(live_ids)), np.float32)
+        step = max(1, (1 << 24) // max(1, b * v.shape[1]))
+        for s in range(0, len(live_ids), step):
+            d[:, s:s + step] = _host_metric(
+                q[:, None, :], v[None, s:s + step, :], metric)
+    kk = min(k, d.shape[1])
+    part = np.argpartition(d, kk - 1, axis=1)[:, :kk]
+    pd = np.take_along_axis(d, part, axis=1)
+    order = np.argsort(pd, axis=1, kind="stable")
+    sel = np.take_along_axis(part, order, axis=1)
+    out_d = np.take_along_axis(d, sel, axis=1)
+    out_i = live_ids[sel].astype(np.int64)
+    if kk < k:
+        out_d = np.pad(out_d, ((0, 0), (0, k - kk)), constant_values=_INF)
+        out_i = np.pad(out_i, ((0, 0), (0, k - kk)), constant_values=-1)
+    return out_d, out_i
+
+
+def _live_under_allow(valid: np.ndarray,
+                      allow: Optional[np.ndarray]) -> np.ndarray:
+    live = np.flatnonzero(valid)
+    if allow is not None:
+        al = np.asarray(allow, bool)
+        live = live[live < len(al)]
+        live = live[al[live]]
+    return live
+
+
+def host_store_topk(store: DeviceVectorStore, metric: str,
+                    queries: np.ndarray, k: int,
+                    allow: Optional[np.ndarray]
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """Warm-tier exact search over a detached store's host corpus — the
+    ONE recipe (cosine normalize, live-under-allow mask, exact top-k)
+    shared by RawBackend.host_topk and FlatIndex's warm branch."""
+    q = np.atleast_2d(np.asarray(queries, np.float32))
+    if metric == "cosine":
+        q = q / np.maximum(
+            np.linalg.norm(q, axis=-1, keepdims=True), 1e-12)
+    corpus, _valid, _sq = store.host_arrays
+    if allow is None:
+        # the unfiltered live view is immutable while detached (a
+        # demoted store rejects mutations), so gather it ONCE per
+        # demotion instead of copying the whole live corpus on every
+        # query batch; attach()/detach() invalidate the cache
+        cached = store._warm_live_cache
+        if cached is None:
+            live = np.flatnonzero(store.host_valid_mask)
+            cached = (live, corpus[live])
+            store._warm_live_cache = cached
+        live, vecs = cached
+        return host_exact_topk(q, vecs, live, metric, k)
+    live = _live_under_allow(store.host_valid_mask, allow)
+    return host_exact_topk(q, corpus[live], live, metric, k)
+
+
 class RawBackend:
     """Full-precision distances over the HBM-resident corpus."""
 
@@ -70,6 +152,29 @@ class RawBackend:
     def host_valid_mask(self) -> np.ndarray:
         return self.store.host_valid_mask
 
+    # -- tiered residency (docs/tiering.md) -------------------------------
+    @property
+    def device_resident(self) -> bool:
+        return self.store.device_resident
+
+    def hbm_bytes(self) -> int:
+        return self.store.nbytes
+
+    def host_tier_bytes(self) -> int:
+        return self.store.host_bytes
+
+    def demote_device(self) -> int:
+        return self.store.detach()
+
+    def promote_device(self) -> int:
+        return self.store.attach()
+
+    def host_topk(self, queries: np.ndarray, k: int,
+                  allow: Optional[np.ndarray]
+                  ) -> tuple[np.ndarray, np.ndarray]:
+        """Warm-tier exact search over the detached host corpus."""
+        return host_store_topk(self.store, self.metric, queries, k, allow)
+
     # -- query prep -------------------------------------------------------
     def prep_queries(self, queries: np.ndarray):
         q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)))
@@ -98,7 +203,10 @@ class RawBackend:
     # -- device beam ------------------------------------------------------
     def device_scorer(self):
         """(scorer, operands) for the fused device walk — the raw corpus
-        snapshot gather-scored at full precision."""
+        snapshot gather-scored at full precision. None while demoted to
+        the warm tier (searches belong on the host path)."""
+        if not self.store.device_resident:
+            return None
         from weaviate_tpu.ops.device_beam import RawScorer
 
         corpus, _valid, _sqnorms = self.store.snapshot()
@@ -183,6 +291,8 @@ class RawBackend:
         self, queries: np.ndarray, k: int, allow: Optional[np.ndarray]
     ) -> tuple[np.ndarray, np.ndarray]:
         """Brute-force top-k (small-filter cutoff path). Returns (dists, ids)."""
+        if not self.store.device_resident:
+            return self.host_topk(queries, k, allow)
         qrep = self.prep_queries(queries)
         if self.store.mesh is not None:
             from weaviate_tpu.parallel.sharded_search import mesh_flat_topk
@@ -329,6 +439,33 @@ class QuantizedBackend:
     def host_valid_mask(self) -> np.ndarray:
         return self.originals.valid
 
+    # -- tiered residency (docs/tiering.md) -------------------------------
+    @property
+    def device_resident(self) -> bool:
+        return self.codes.device_resident
+
+    def hbm_bytes(self) -> int:
+        return self.codes.nbytes
+
+    def host_tier_bytes(self) -> int:
+        return self.codes.host_bytes
+
+    def demote_device(self) -> int:
+        return self.codes.detach()
+
+    def promote_device(self) -> int:
+        return self.codes.attach()
+
+    def host_topk(self, queries: np.ndarray, k: int,
+                  allow: Optional[np.ndarray]
+                  ) -> tuple[np.ndarray, np.ndarray]:
+        """Warm-tier exact search over the host originals (the rescore
+        tier already lives there — demotion only evicts the codes)."""
+        q = self._prep_vectors(np.atleast_2d(queries))
+        live = _live_under_allow(self.originals.valid, allow)
+        return host_exact_topk(
+            q, self.originals.get(live), live, self.metric, k)
+
     # -- query prep -------------------------------------------------------
     def prep_queries(self, queries: np.ndarray) -> QueryRep:
         host = self._prep_vectors(np.atleast_2d(queries))
@@ -349,8 +486,9 @@ class QuantizedBackend:
     def device_scorer(self):
         """(scorer, operands) over the HBM code planes, or None while the
         quantizer is unfitted (pre-training corpus walks stay on host —
-        that is a lifecycle stage, not a failure)."""
-        if not self.quantizer.fitted:
+        that is a lifecycle stage, not a failure) or the codes are
+        demoted to the warm tier."""
+        if not self.quantizer.fitted or not self.codes.device_resident:
             return None
         return self.quantizer.beam_scorer(self.codes)
 
@@ -428,6 +566,8 @@ class QuantizedBackend:
     ) -> tuple[np.ndarray, np.ndarray]:
         from weaviate_tpu.index.flat import exact_rescore
 
+        if not self.codes.device_resident:
+            return self.host_topk(queries, k, allow)
         qrep = self.prep_queries(queries)
         if qrep.code is None:
             # pre-fit: exact over the (tiny) host corpus

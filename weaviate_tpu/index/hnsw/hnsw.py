@@ -87,6 +87,11 @@ class HNSWIndex(VectorIndex):
         from weaviate_tpu.index.dispatch import CoalescingDispatcher
 
         self._scratch_lock = threading.Lock()
+        # residency epoch: bumped on every demote/promote; the dispatcher
+        # keys batch grouping on it so a request enqueued against one
+        # residency generation never coalesces into a batch of another
+        # (a cold/warm tenant must not ride a hot tenant's device batch)
+        self._residency_epoch = 0
         self._dispatch = CoalescingDispatcher(self._run_search_batch)
         if path and os.path.exists(self._snapshot_path()):
             self._load_snapshot()
@@ -698,6 +703,21 @@ class HNSWIndex(VectorIndex):
         k: int,
         allow_list: Optional[np.ndarray] = None,
     ) -> SearchResult:
+        # a tiering demote/promote between the residency check and the
+        # array access (here, in the dispatcher's leader, or in the host
+        # tier) surfaces as ResidencyMoved: re-route, never fail — the
+        # retry re-enqueues under the NEW residency epoch's tier_key
+        from weaviate_tpu.index.base import run_tier_stable
+
+        return run_tier_stable(
+            lambda: self._search_tiered(queries, k, allow_list))
+
+    def _search_tiered(
+        self,
+        queries: np.ndarray,
+        k: int,
+        allow_list: Optional[np.ndarray] = None,
+    ) -> SearchResult:
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         if queries.shape[-1] != self.backend.dims:
             raise ValueError(
@@ -709,6 +729,14 @@ class HNSWIndex(VectorIndex):
                 ids=np.full((b, k), -1, np.int64),
                 dists=np.full((b, k), _INF, np.float32),
             )
+
+        if not self.backend.device_resident:
+            # WARM tier (tiering/): arrays are demoted to host RAM — the
+            # exact host pass serves the query without entering the
+            # device dispatcher, so a demoted tenant can never occupy a
+            # hot tenant's batch slot (or re-rent HBM per query)
+            d, ids = self.backend.host_topk(queries, k, allow_list)
+            return SearchResult(ids=ids, dists=d)
 
         # Filtered-search triage (reference SWEEPING/ACORN/RRE pick,
         # search.go:36-41 + the flat cutoff, flat_search.go:28). TPU-first
@@ -727,11 +755,18 @@ class HNSWIndex(VectorIndex):
                     * live):
                 return self._flat_filtered(queries, k, allow_list)
 
-        ids, d = self._dispatch.search(queries, k, allow_list)
+        ids, d = self._dispatch.search(queries, k, allow_list,
+                                       tier_key=self._residency_epoch)
         return SearchResult(ids=ids, dists=d)
 
     def _run_search_batch(self, queries: np.ndarray, k: int, allow_list):
         """Single-flight batch runner behind the coalescing dispatcher."""
+        if not self.backend.device_resident:
+            # a demotion landed while this group was queued: the leader
+            # re-routes the whole batch to the warm host tier instead of
+            # touching (now-detached) device arrays
+            d, ids = self.backend.host_topk(queries, k, allow_list)
+            return ids, d
         b = queries.shape[0]
         # visited scratch is [B, capacity]; bound its footprint
         sub_b = max(8, min(64, _VISITED_BUDGET // max(1, self.graph.capacity)))
@@ -925,6 +960,40 @@ class HNSWIndex(VectorIndex):
     def contains(self, doc_id: int) -> bool:
         return self.graph.contains(doc_id) and self.backend.contains(doc_id)
 
+    # -- tiered residency (docs/tiering.md) -------------------------------
+    @property
+    def device_resident(self) -> bool:
+        return self.backend.device_resident
+
+    def hbm_bytes(self) -> int:
+        n = self.backend.hbm_bytes()
+        if self._device_beam is not None:
+            n += self._device_beam.nbytes
+        return n
+
+    def host_tier_bytes(self) -> int:
+        return self.backend.host_tier_bytes()
+
+    def demote_device(self) -> int:
+        """Warm demotion: corpus/codes to host RAM + the beam's mirrored
+        tables released. The DeviceAdjacency OBJECT survives (it re-syncs
+        wholesale on the next hot search at identical shapes), so the
+        fused walk is never latched off by tiering."""
+        freed = self.backend.demote_device()
+        if self._device_beam is not None:
+            freed += self._device_beam.drop_device()
+        if freed:
+            self._residency_epoch += 1
+        return freed
+
+    def promote_device(self) -> int:
+        """Re-attach the demoted arrays; the beam tables re-upload lazily
+        on the next search's sync (counted by the footprint refresh)."""
+        gained = self.backend.promote_device()
+        if gained:
+            self._residency_epoch += 1
+        return gained
+
     def stats(self) -> dict:
         s = {
             "type": "hnsw",
@@ -934,6 +1003,9 @@ class HNSWIndex(VectorIndex):
             "max_level": self.graph.max_level,
             "entrypoint": self.graph.entrypoint,
         }
+        s["device_resident"] = self.backend.device_resident
+        if not self.backend.device_resident:
+            s["host_tier_bytes"] = self.backend.host_tier_bytes()
         if self.backend.quantized:
             s["quantizer"] = self.backend.quantizer.kind
             s["fitted"] = self.backend.quantizer.fitted

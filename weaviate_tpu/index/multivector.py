@@ -324,6 +324,26 @@ class MultiVectorIndex(VectorIndex):
     def contains(self, doc_id: int) -> bool:
         return self.inner.contains(doc_id)
 
+    # -- tiered residency (docs/tiering.md): the FDE corpus is the inner
+    # FlatIndex, whose warm tier serves demoted searches exactly; the
+    # token store for the rescore tier is host-side already. Pure
+    # delegation keeps the budget ledger seeing the real HBM rent.
+    @property
+    def device_resident(self) -> bool:
+        return self.inner.device_resident
+
+    def hbm_bytes(self) -> int:
+        return self.inner.hbm_bytes()
+
+    def host_tier_bytes(self) -> int:
+        return self.inner.host_tier_bytes()
+
+    def demote_device(self) -> int:
+        return self.inner.demote_device()
+
+    def promote_device(self) -> int:
+        return self.inner.promote_device()
+
     def stats(self) -> dict:
         return {
             "type": "multivector",

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from weaviate_tpu.compression.store import ResidencyMoved, TieredResidency
 from weaviate_tpu.ops.distance import normalize
 
 _PAGE = 4096
@@ -80,7 +81,7 @@ def _mesh_fns(mesh):
     return fns
 
 
-class DeviceVectorStore:
+class DeviceVectorStore(TieredResidency):
     """Doc-id-addressed [capacity, D] device array + validity mask + sq-norms."""
 
     def __init__(
@@ -124,14 +125,77 @@ class DeviceVectorStore:
                 for s, sh in zip(state, self._shardings)
             )
         self._state = state
+        # warm-tier residency (tiering/): when detached, the device tuple
+        # is replaced by a host numpy mirror and every device accessor
+        # raises — a detached store must never silently re-rent HBM
+        self._host_state: Optional[tuple] = None
+        # warm-tier unfiltered (live_ids, gathered rows) view, built
+        # lazily by host_store_topk; valid only while detached (demoted
+        # stores reject mutations, so it can't go stale mid-demotion)
+        self._warm_live_cache: Optional[tuple] = None
         self._host_valid = np.zeros((cap,), bool)  # host mirror of valid
         self._watermark = 0  # max assigned id + 1
         self._live = 0
 
+    # -- residency (tiering warm tier; protocol on TieredResidency) -------
+    def detach(self) -> int:
+        """Demote to the warm tier: fetch the device triple to host RAM
+        and drop the device references. Returns HBM bytes released.
+        In-flight readers holding an older ``snapshot()`` keep their
+        arrays alive (jax refcounts); NEW readers must take the host
+        tier — the device accessors raise until ``attach``."""
+        if self._host_state is not None:
+            return 0
+        corpus, valid, sqnorms = self._state
+        freed = sum(a.nbytes for a in self._state)
+        self._host_state = (np.asarray(corpus), np.asarray(valid),
+                            np.asarray(sqnorms))
+        self._state = None
+        self._warm_live_cache = None  # rebuilt lazily for THIS demotion
+        return freed
+
+    def attach(self) -> int:
+        """Promote back to HBM. Shapes and dtypes are identical to the
+        detached arrays, so every compiled program keyed on them (scatter,
+        flat scan, fused beam) hits its cache — promotion costs one
+        upload, zero recompiles. Returns HBM bytes charged."""
+        if self._host_state is None:
+            return 0
+        corpus, valid, sqnorms = self._host_state
+        if self.mesh is not None:
+            state = tuple(
+                jax.device_put(np.asarray(s), sh)
+                for s, sh in zip((corpus, valid, sqnorms), self._shardings)
+            )
+        else:
+            # only built when actually used: promotion runs exactly when
+            # the budget is tight, so a discarded extra upload here would
+            # transiently double the tenant's HBM rent
+            state = (jnp.asarray(corpus, self.dtype), jnp.asarray(valid),
+                     jnp.asarray(sqnorms))
+        self._state = state
+        self._host_state = None
+        self._warm_live_cache = None
+        return sum(a.nbytes for a in self._state)
+
+    @property
+    def host_arrays(self) -> tuple:
+        """(corpus, valid, sqnorms) as host numpy — the warm search tier.
+        Only valid while detached (an attached store's searches belong on
+        device; gathering the whole corpus back would defeat tiering)."""
+        hs = self._host_state
+        if hs is None:
+            raise ResidencyMoved(
+                "store is device-resident; use snapshot()")
+        return hs
+
     # -- properties -------------------------------------------------------
     @property
     def capacity(self) -> int:
-        return self._state[0].shape[0]
+        hs = self._host_state
+        if hs is not None:
+            return hs[0].shape[0]
+        return self._device_state()[0].shape[0]
 
     @property
     def watermark(self) -> int:
@@ -146,21 +210,32 @@ class DeviceVectorStore:
         """Device (HBM) footprint: corpus + validity mask + sq-norms —
         the raw-tier term of the device-beam residency budget (see
         docs/device_beam.md); quantized tiers report DeviceArraySet.nbytes
-        instead."""
-        return sum(a.nbytes for a in self._state)
+        instead. Zero while detached to the warm tier."""
+        s = self._state
+        if s is None:
+            return 0
+        return sum(a.nbytes for a in s)
+
+    @property
+    def host_bytes(self) -> int:
+        """Host-RAM footprint of the warm tier (0 while device-resident)."""
+        hs = self._host_state
+        if hs is None:
+            return 0
+        return sum(a.nbytes for a in hs)
 
     def snapshot(self) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Consistent (corpus, valid, sqnorms) triple — the ONLY safe way
         to read device state from search threads."""
-        return self._state
+        return self._device_state()
 
     @property
     def corpus(self) -> jnp.ndarray:
-        return self._state[0]
+        return self._device_state()[0]
 
     @property
     def valid_mask(self) -> jnp.ndarray:
-        return self._state[1]
+        return self._device_state()[1]
 
     @property
     def host_valid_mask(self) -> np.ndarray:
@@ -169,12 +244,13 @@ class DeviceVectorStore:
 
     @property
     def sqnorms(self) -> jnp.ndarray:
-        return self._state[2]
+        return self._device_state()[2]
 
     # -- mutation ---------------------------------------------------------
     def ensure_capacity(self, min_capacity: int) -> None:
         if min_capacity <= self.capacity:
             return
+        self._require_device()  # writers promote before growing
         new_cap = _round_up(max(min_capacity, self.capacity * 2), self._page)
         self._state = self._grow_fn(*self._state, new_cap=new_cap)
         hv = np.zeros((new_cap,), bool)
@@ -190,6 +266,7 @@ class DeviceVectorStore:
             )
         if len(doc_ids) == 0:
             return
+        self._require_device()  # ingest promotes the tenant first
         self.ensure_capacity(int(doc_ids.max()) + 1)
         vj = jnp.asarray(vectors, self.dtype)
         if self.normalized:
@@ -206,6 +283,7 @@ class DeviceVectorStore:
         doc_ids = np.asarray(doc_ids, np.int32)
         if len(doc_ids) == 0:
             return
+        self._require_device()  # writers promote before mutating
         doc_ids = doc_ids[doc_ids < self.capacity]
         was = self._host_valid[doc_ids]
         corpus, valid, sqnorms = self._state
@@ -215,10 +293,13 @@ class DeviceVectorStore:
         self._live -= int(was.sum())
 
     def get(self, doc_ids: np.ndarray) -> np.ndarray:
-        """Host gather (debug/rescore path)."""
+        """Host gather (debug/rescore path; serves from either tier)."""
+        ids = np.asarray(doc_ids, np.int32)
+        hs = self._host_state
+        if hs is not None:
+            return np.asarray(hs[0][ids], np.float32)
         # graftlint: allow[host-sync-in-hot-path] reason=explicitly host-facing accessor
-        return np.asarray(
-            self._state[0][jnp.asarray(np.asarray(doc_ids, np.int32))])
+        return np.asarray(self._device_state()[0][jnp.asarray(ids)])
 
     def contains(self, doc_id: int) -> bool:
         if doc_id >= self.capacity:
@@ -233,7 +314,8 @@ class DeviceVectorStore:
     def save(self, path: str, meta: Optional[dict] = None) -> None:
         import msgpack
 
-        corpus, valid, sqnorms = self._state
+        corpus, valid, sqnorms = (self._host_state if self._host_state
+                                  is not None else self._state)
         wm = self._watermark
         host = np.asarray(corpus[:wm])
         norms = np.asarray(sqnorms[:wm])
@@ -303,6 +385,7 @@ class DeviceVectorStore:
             state = (jnp.asarray(full, self.dtype), jnp.asarray(fv),
                      jnp.asarray(fn))
         self._state = state
+        self._host_state = None  # a restored store is device-resident
         self._host_valid = fv.copy()
         self._watermark = wm
         self._live = d["live"]

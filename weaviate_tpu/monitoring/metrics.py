@@ -251,3 +251,40 @@ DEVICE_BEAM_FALLBACK = REGISTRY.counter(
     "fused device-beam walks that fell back to the host per-hop path, "
     "by kind (search/construction) and mode (transient/latched); a "
     "latched fallback permanently downgrades the index to host walks")
+
+# tiered tenant store instruments (tiering/): residency bytes per tier,
+# every promotion/demotion the controller performs, cold-start behavior
+# observable end to end (first-touch hits, promotion latency, and the
+# 503-with-Retry-After sheds when a promotion outlives the deadline)
+TIER_BYTES = REGISTRY.gauge(
+    "weaviate_tpu_tier_bytes",
+    "tenant-store residency bytes by tier (hbm/host/disk); hbm is the "
+    "accountant ledger the budget is enforced against")
+TIER_BUDGET_BYTES = REGISTRY.gauge(
+    "weaviate_tpu_tier_budget_bytes",
+    "configured HBM byte budget the tiering controller demotes against "
+    "(0 = unlimited)")
+TIER_PROMOTIONS = REGISTRY.counter(
+    "weaviate_tpu_tier_promotions_total",
+    "tenant promotions by source tier (warm: device re-attach; cold: "
+    "shard open + replay + attach)")
+TIER_DEMOTIONS = REGISTRY.counter(
+    "weaviate_tpu_tier_demotions_total",
+    "tenant demotions by destination tier (warm: arrays to host RAM; "
+    "cold: shard closed to disk)")
+TIER_COLD_HITS = REGISTRY.counter(
+    "weaviate_tpu_tier_cold_hits_total",
+    "requests that touched a non-hot tenant and had to wait on (or "
+    "trigger) a promotion, by tier the tenant was found in")
+TIER_PROMOTION_LATENCY = REGISTRY.histogram(
+    "weaviate_tpu_tier_promotion_seconds",
+    "wall time of one tenant promotion, by source tier (cold includes "
+    "shard open + checkpoint replay)")
+TIER_COLD_SHED = REGISTRY.counter(
+    "weaviate_tpu_tier_cold_shed_total",
+    "requests shed with 503 + Retry-After because a promotion was still "
+    "in flight when the request deadline expired")
+TIER_SEARCHES = REGISTRY.counter(
+    "weaviate_tpu_tier_searches_total",
+    "vector searches served by residency tier (device = HBM-resident "
+    "arrays, host = the instrumented warm-tier exact fallback)")

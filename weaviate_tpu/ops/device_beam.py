@@ -381,6 +381,20 @@ class DeviceAdjacency:
     def mark_dirty(self, *node_ids) -> None:
         self._dirty.update(int(x) for x in node_ids)
 
+    def drop_device(self) -> int:
+        """Release the mirrored tables from HBM (tiering warm tier).
+        Returns bytes released. The next ``sync`` re-uploads wholesale at
+        the same shapes, so compiled beam programs keep hitting their
+        cache — dropping never latches the beam off."""
+        freed = self.nbytes
+        self._adj = None
+        self._present = None
+        self._synced_cap = 0
+        self._dirty.clear()
+        self._upper = None
+        self._upper_version = -1
+        return freed
+
     @property
     def nbytes(self) -> int:
         """HBM footprint of the mirrored topology (layer 0 + upper)."""

@@ -417,6 +417,10 @@ class MultiTenancyConfig:
     enabled: bool = False
     auto_tenant_creation: bool = False
     auto_tenant_activation: bool = False
+    # tiering (docs/tiering.md): per-tenant HBM cap — a tenant whose
+    # device footprint exceeds it is pinned to the warm (host RAM) tier
+    # and served by the exact host fallback; 0 = no per-tenant cap
+    tenant_hbm_budget_bytes: int = 0
 
 
 @dataclass

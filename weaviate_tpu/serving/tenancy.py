@@ -71,6 +71,11 @@ class TenantThrottle:
         self._buckets: dict[str, TokenBucket] = {}
         self._overrides: dict[str, tuple[float, float]] = {}
         self._lock = threading.Lock()
+        # tiering activity tap (tiering/controller.py on_tenant_signal):
+        # the throttle sees every tenant-tagged request at the front door,
+        # so it doubles as the serving-side activity feed — wired by the
+        # DB when a tiering controller exists, else a no-op
+        self.on_activity: Optional[Callable[[str], None]] = None
 
     def set_limit(self, tenant: str, rate: float, burst: float) -> None:
         with self._lock:
@@ -104,6 +109,8 @@ class TenantThrottle:
 
     def check(self, tenant: str) -> Optional[float]:
         """None = admitted; else seconds the tenant should wait."""
+        if tenant and self.on_activity is not None:
+            self.on_activity(tenant)
         bucket = self._bucket(tenant)
         if bucket is None:
             return None

@@ -1,0 +1,20 @@
+"""Tiered tenant store: HBM / host / disk residency with activity-driven
+promotion (docs/tiering.md)."""
+
+from weaviate_tpu.tiering.accountant import HbmAccountant
+from weaviate_tpu.tiering.controller import (
+    COLD,
+    HOT,
+    WARM,
+    ColdStartPending,
+    TieringController,
+)
+
+__all__ = [
+    "COLD",
+    "HOT",
+    "WARM",
+    "ColdStartPending",
+    "HbmAccountant",
+    "TieringController",
+]

@@ -1,0 +1,93 @@
+"""HBM residency ledger: who is renting device memory, against what budget.
+
+The reference counts tenant residency through its LSM bucket cache and
+memwatch; here HBM is the scarce tier and the unit of rent is a tenant's
+device arrays (corpus or code planes + beam tables). Every attach/detach
+of tenant device state MUST flow through this ledger — the graftlint rule
+``device-array-leak`` enforces that the byte deltas the demote/promote
+primitives return are never silently discarded — so the controller's
+eviction decisions and the ``weaviate_tpu_tier_bytes`` gauge always
+describe the device's real occupancy.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from weaviate_tpu.monitoring.metrics import TIER_BUDGET_BYTES, TIER_BYTES
+
+TenantKey = tuple  # (collection, tenant)
+
+
+class HbmAccountant:
+    """(collection, tenant) -> charged HBM bytes, with one global budget.
+
+    ``charge`` records the ABSOLUTE current footprint for a key (stores
+    grow by doubling, so deltas would drift); ``release`` zeroes it.
+    ``budget_bytes <= 0`` disables enforcement (the ledger still tracks,
+    so stats and gauges stay truthful on un-budgeted deployments).
+    """
+
+    def __init__(self, budget_bytes: int = 0):
+        self._lock = threading.Lock()
+        self._charges: dict[TenantKey, int] = {}
+        self._budget = int(budget_bytes)
+        TIER_BUDGET_BYTES.set(max(0, self._budget))
+
+    @property
+    def budget_bytes(self) -> int:
+        return self._budget
+
+    def set_budget(self, budget_bytes: int) -> None:
+        with self._lock:
+            self._budget = int(budget_bytes)
+            TIER_BUDGET_BYTES.set(max(0, self._budget))
+
+    def charge(self, key: TenantKey, nbytes: int) -> None:
+        """Record ``key``'s current device footprint (absolute, not a
+        delta — idempotent under footprint refresh)."""
+        with self._lock:
+            if nbytes <= 0:
+                self._charges.pop(key, None)
+            else:
+                self._charges[key] = int(nbytes)
+            TIER_BYTES.set(sum(self._charges.values()), tier="hbm")
+
+    def release(self, key: TenantKey) -> int:
+        """Drop ``key``'s charge; returns the bytes it was renting."""
+        with self._lock:
+            freed = self._charges.pop(key, 0)
+            TIER_BYTES.set(sum(self._charges.values()), tier="hbm")
+            return freed
+
+    def charged(self, key: TenantKey) -> int:
+        with self._lock:
+            return self._charges.get(key, 0)
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._charges.values())
+
+    def overshoot(self) -> int:
+        """Bytes past the budget (0 when unbudgeted or within it)."""
+        with self._lock:
+            if self._budget <= 0:
+                return 0
+            return max(0, sum(self._charges.values()) - self._budget)
+
+    def would_exceed(self, extra_bytes: int) -> bool:
+        """Whether charging ``extra_bytes`` more would cross the budget."""
+        with self._lock:
+            if self._budget <= 0:
+                return False
+            return sum(self._charges.values()) + extra_bytes > self._budget
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "budget_bytes": self._budget,
+                "total_bytes": sum(self._charges.values()),
+                "tenants": {
+                    f"{c}/{t}": b for (c, t), b in sorted(self._charges.items())
+                },
+            }

@@ -168,3 +168,9 @@ SERVING_DEFAULT_TIMEOUT_S = RUNTIME.register(
 # client is disconnected instead of pinning a handler thread)
 SERVING_REST_READ_TIMEOUT_S = RUNTIME.register(
     "serving_rest_read_timeout_s", 30.0, cast=float)
+# tiered tenant store (tiering/): HBM byte budget the controller demotes
+# against; 0 = unset (follow the WEAVIATE_TPU_HBM_BUDGET_BYTES env / the
+# DB constructor argument). Hot-reloadable so an operator can shrink the
+# budget on a live node and watch the eviction pass drain HBM.
+TIERING_HBM_BUDGET = RUNTIME.register(
+    "tiering_hbm_budget_bytes", 0, cast=int)
