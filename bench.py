@@ -188,6 +188,10 @@ CONFIG_METRICS = {
     # headline: the hot-set QPS line; the cold-latency line is secondary
     "tiering": (lambda m: m.startswith("tiering_"),
                 lambda m: m.startswith("tiering_qps_hot")),
+    # headline: the scaling ratio only — a cached 1-chip leg must not
+    # stand in for the mesh A/B this config exists for
+    "meshbeam": (lambda m: m.startswith("mesh_"),
+                 lambda m: m.startswith("mesh_qps_scaling")),
     "pallasab": (_m_pallas, _m_pallas),
     "ingest": (lambda m: m.startswith("ingest_docs_s")
         and not m.rstrip("0123456789").endswith("w"),) * 2,
@@ -1780,6 +1784,167 @@ def bench_tiering(n=128_000, d=256, tenants=16, batch=64, k=10, iters=10,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_meshbeam(n=1_000_000, d=768, batch=256, k=10, ef=96, iters=10,
+                   warmup=2):
+    """Mesh-sharded device beam A/B (docs/mesh.md): the SAME workload on
+    ONE chip vs the full device mesh, for the two serving shapes the
+    mesh path owns — raw flat scan (``mesh_flat_topk``) and PQ-HNSW
+    devbeam (the fused SPMD walk + on-device cross-shard merge). Emits
+    per-leg QPS with recall@10 on both sides and ``mesh_qps_scaling``
+    (mesh/1-chip ratio; near-linear = the ICI merge is free, ~1.0 =
+    the mesh is not pulling its weight). Records the
+    ``mesh_device_beam`` perf-flag verdict on real hardware so the
+    serving default follows measurements, not hope."""
+    import sys as _sys
+
+    # smoke tier: when the CPU platform is forced and jax has not
+    # initialized yet, stand up 8 virtual devices so the mesh leg runs
+    # end-to-end instead of silently skipping
+    if "jax" not in _sys.modules \
+            and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    from weaviate_tpu.index.flat import FlatIndex
+    from weaviate_tpu.index.hnsw.hnsw import HNSWIndex
+    from weaviate_tpu.ops import device_beam as device_beam_mod
+    from weaviate_tpu.parallel import runtime
+    from weaviate_tpu.parallel.mesh import make_mesh
+    from weaviate_tpu.schema.config import (FlatIndexConfig,
+                                            HNSWIndexConfig, PQConfig)
+
+    n_dev = len(jax.devices())
+    rng = np.random.default_rng(31)
+    centers = rng.standard_normal((1024, d)).astype(np.float32)
+    corpus = centers[rng.integers(0, 1024, n)] + 0.35 * rng.standard_normal(
+        (n, d)).astype(np.float32)
+    queries = corpus[:batch] + 0.1 * rng.standard_normal(
+        (batch, d)).astype(np.float32)
+    # exact ground truth once, on host BLAS (the corpus also feeds both
+    # legs, so no extra device tenancy for gt); argpartition+partial sort
+    # like every other gt computation here — a full [B, N] argsort at 1M
+    # rows is seconds of pure host time for 10 ids
+    ip = queries @ corpus.T
+    csq = np.einsum("nd,nd->n", corpus, corpus)
+    qsq = np.einsum("bd,bd->b", queries, queries)
+    gt_d = qsq[:, None] - 2 * ip + csq[None, :]
+    part = np.argpartition(gt_d, k - 1, axis=1)[:, :k]
+    order = np.argsort(np.take_along_axis(gt_d, part, axis=1), axis=1)
+    gt_ids = np.take_along_axis(part, order, axis=1).astype(np.int64)
+    del ip, gt_d
+
+    def measure(build):
+        idx = build()
+        ids = np.arange(n, dtype=np.int64)
+        t0 = time.perf_counter()
+        step = 100_000
+        for s in range(0, n, step):
+            idx.add_batch(ids[s:s + step], corpus[s:s + step])
+        build_s = time.perf_counter() - t0
+
+        def run():
+            return idx.search(queries, k)
+
+        c0 = device_beam_mod.dispatch_count()
+        ts, res = _timed(run, lambda r: None, iters, warmup)
+        per_batch = ((device_beam_mod.dispatch_count() - c0)
+                     / (iters + warmup))
+        qps = max(batch / float(np.median(ts)),
+                  _pipelined_thread_qps(run, batch))
+        recall = _recall(res.ids, gt_ids, k)
+        stats = idx.stats()
+        out = {
+            "qps": qps, "recall": recall, "build_s": build_s,
+            "p50_ms": float(np.median(ts)) * 1000,
+            "dispatches_per_batch": per_batch,
+            "shards": stats.get("mesh_shards", 1),
+        }
+        del idx
+        return out
+
+    legs = {
+        "flat": lambda: FlatIndex(d, FlatIndexConfig(
+            distance="l2-squared", initial_capacity=n)),
+        "hnswpq": lambda: HNSWIndex(d, HNSWIndexConfig(
+            distance="l2-squared", ef=ef, ef_construction=96,
+            max_connections=16, initial_capacity=n, insert_batch=4096,
+            quantizer=PQConfig(segments=96, rescore_limit=4 * k),
+            flat_search_cutoff=0, device_beam=True)),
+    }
+    evidence = {}
+    scaling = {}
+    for leg, build in legs.items():
+        runtime.set_mesh(None)
+        one = measure(build)
+        if n_dev > 1:
+            runtime.set_mesh(make_mesh(n_dev))
+            mesh = measure(build)
+            runtime.reset()
+        else:
+            mesh = None
+        _emit({
+            "metric": f"mesh_{leg}_qps_1chip", "value": round(one["qps"], 1),
+            "unit": "qps", "vs_baseline": 0,
+            "recall_at_10": round(one["recall"], 4),
+            "recall_ok": bool(one["recall"] >= 0.95),
+            "p50_batch_ms": round(one["p50_ms"], 2), "n": n, "d": d,
+        })
+        if mesh is not None:
+            ratio = mesh["qps"] / one["qps"] if one["qps"] else 0.0
+            scaling[leg] = ratio
+            _emit({
+                "metric": f"mesh_{leg}_qps_mesh",
+                "value": round(mesh["qps"], 1), "unit": "qps",
+                "vs_baseline": round(ratio, 2),
+                "recall_at_10": round(mesh["recall"], 4),
+                "recall_ok": bool(mesh["recall"] >= 0.95),
+                "p50_batch_ms": round(mesh["p50_ms"], 2),
+                "mesh_shards": mesh["shards"],
+                "dispatches_per_batch": round(
+                    mesh["dispatches_per_batch"], 2),
+                "build_s": round(mesh["build_s"], 1), "n": n, "d": d,
+            })
+            evidence[leg] = {
+                "qps_1chip": round(one["qps"], 1),
+                "qps_mesh": round(mesh["qps"], 1),
+                "scaling": round(ratio, 2),
+                "recall_mesh": round(mesh["recall"], 4),
+                "recall_1chip": round(one["recall"], 4),
+                "win": bool(mesh["qps"] > one["qps"]
+                            and mesh["recall"] >= one["recall"] - 0.005),
+            }
+    if not scaling:
+        # single-device platform: the A/B cannot run — say so without
+        # journaling a fake ratio (recall_ok False keeps it out)
+        _emit({"metric": "mesh_qps_scaling", "value": 0, "unit": "ratio",
+               "vs_baseline": 0, "recall_ok": False,
+               "note": "single-device platform; mesh leg skipped"})
+        return
+    # headline LAST: geometric mean of the per-leg scalings
+    geo = float(np.exp(np.mean([np.log(max(v, 1e-9))
+                                for v in scaling.values()])))
+    _emit({
+        "metric": "mesh_qps_scaling", "value": round(geo, 2),
+        "unit": "ratio", "vs_baseline": round(geo / max(n_dev, 1), 3),
+        "mesh_shards": n_dev,
+        "per_leg": {leg: round(v, 2) for leg, v in scaling.items()},
+        "recall_ok": bool(all(e["recall_mesh"] >= 0.95
+                              for e in evidence.values())),
+    })
+    if jax.devices()[0].platform != "cpu":
+        from weaviate_tpu.utils import perf_flags
+
+        perf_flags.record(
+            "mesh_device_beam",
+            all(e["win"] for e in evidence.values()),
+            {"config": f"meshbeam {n}x{d}d ef{ef} x{n_dev}dev",
+             **evidence},
+            platform=jax.devices()[0].platform)
+
+
 def bench_pallas_ab(**kw):
     """The one Pallas compile in the matrix, as its own config ordered
     after every XLA-only serving config: a wedged compile helper
@@ -1802,6 +1967,7 @@ CONFIGS = {
     "bq": bench_bq,
     "msmarco": bench_msmarco,
     "tiering": bench_tiering,
+    "meshbeam": bench_meshbeam,
     "bm25": bench_bm25,
     "bm25seg": bench_bm25seg,
     "ingest": bench_ingest,
@@ -1854,6 +2020,13 @@ def _full_footprint(name: str) -> dict:
         return {"hbm_gb": (n * dp * 4 + n * 96 + n * 33 * 4) / _GB,
                 "host_gb": (n * dp * 4 * 2 + n * 200) / _GB,
                 "disk_gb": 0.0}
+    if name == "meshbeam":
+        # peak is the PQ-HNSW mesh leg: fp32 corpus transiently in HBM
+        # for the flat leg, then codes + layer-0 adjacency mirror; host
+        # holds the fp32 corpus + its clustered-gen twin
+        n = 1_000_000
+        return {"hbm_gb": (n * d * 4 + n * 96 + n * 33 * 4) / _GB,
+                "host_gb": n * d * 4 * 2 / _GB, "disk_gb": 0.0}
     if name == "bq":
         n = 10_000_000
         return {"hbm_gb": n * d / 8 / _GB, "host_gb": n * d * 4 / _GB,
@@ -1913,6 +2086,8 @@ SMOKE = {
     "bq100m": dict(n=250_000, iters=2, warmup=1),
     "msmarco": dict(n=96_000, tenants=8, iters=2, warmup=1),
     "tiering": dict(n=8_000, tenants=8, batch=16, iters=2, warmup=1),
+    # mesh A/B needs real builds on both legs: keep the smoke shape tiny
+    "meshbeam": dict(n=3_000, batch=32, ef=48, iters=2, warmup=1),
     "bm25": dict(n=20_000, vocab=8_000),
     "bm25seg": dict(n=20_000, vocab=8_000),
     "ingest": dict(n=8_000),
@@ -2147,7 +2322,7 @@ def main():
     # device metric lands last either way.
     ap.add_argument("--configs",
                     default="ingest,ingestmp,bm25seg,bm25,flat1m,sift1m,glove,pq,"
-                            "hnswquant,bq,msmarco,tiering,pallasab")
+                            "hnswquant,bq,msmarco,tiering,meshbeam,pallasab")
     ap.add_argument("--smoke", action="store_true",
                     help="run EVERY selected config end-to-end at ~1/50 "
                          "scale on the CPU backend and emit the projected "
@@ -2189,6 +2364,16 @@ def main():
         # alone does not deregister an already-installed platform plugin, so
         # set the config knob too, before any bench fn first touches jax)
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # stand up 8 virtual CPU devices BEFORE jax first-init so the
+        # meshbeam config's mesh leg runs end-to-end in smoke; auto-mesh
+        # stays OFF (same discipline as tests/conftest.py) so every other
+        # config keeps its single-device smoke shape — meshbeam builds
+        # its meshes explicitly via runtime.set_mesh
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        os.environ.setdefault("WEAVIATE_TPU_MESH", "off")
         import jax
 
         jax.config.update("jax_platforms", "cpu")

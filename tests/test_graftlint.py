@@ -744,6 +744,90 @@ class TestDeviceArrayLeak:
 
 
 # ---------------------------------------------------------------------------
+# host-loop-over-mesh
+
+
+class TestHostLoopOverMesh:
+    RULES = ["host-loop-over-mesh"]
+    PAR = "weaviate_tpu/parallel/fake.py"
+    IDX = "weaviate_tpu/index/fake.py"
+
+    def test_loop_over_mesh_devices_dispatching_flagged(self):
+        res = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def scatter(mesh, corpus, q):
+                outs = []
+                for d in mesh.devices.flat:
+                    outs.append(jnp.dot(q, corpus))
+                return outs
+        """, rel=self.PAR, rules=self.RULES)
+        assert rule_ids(res) == ["host-loop-over-mesh"]
+        assert res.violations[0].severity == "error"
+
+    def test_loop_over_jax_devices_with_device_put_flagged(self):
+        res = run("""
+            import jax
+
+            def place(blocks):
+                placed = []
+                for i, dev in enumerate(jax.devices()):
+                    placed.append(jax.device_put(blocks[i], dev))
+                return placed
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == ["host-loop-over-mesh"]
+
+    def test_metadata_loop_not_flagged(self):
+        # enumerating devices for placement tables / logging is fine —
+        # only loops that DISPATCH per device serialize the mesh
+        res = run("""
+            import jax
+
+            def names(mesh):
+                out = []
+                for d in mesh.devices.flat:
+                    out.append(str(d))
+                return out
+        """, rel=self.PAR, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_non_device_loop_not_flagged(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def f(chunks, q):
+                outs = []
+                for c in chunks:
+                    outs.append(jnp.dot(q, c))
+                return outs
+        """, rel=self.PAR, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_outside_scoped_dirs_ignored(self):
+        res = run("""
+            import jax
+            import jax.numpy as jnp
+
+            def f(mesh, q, c):
+                for d in mesh.devices.flat:
+                    jnp.dot(q, c)
+        """, rel="weaviate_tpu/storage/fake.py", rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_suppressible_with_reason(self):
+        res = run("""
+            import jax
+
+            def f(mesh, blocks):
+                for i, d in enumerate(mesh.devices.flat):  # graftlint: allow[host-loop-over-mesh] reason=one-time checkpoint restore, not the serving path
+                    jax.device_put(blocks[i], d)
+        """, rel=self.PAR, rules=self.RULES)
+        assert rule_ids(res) == []
+        assert [v.rule for v in res.suppressed] == ["host-loop-over-mesh"]
+
+
+# ---------------------------------------------------------------------------
 # lock-across-device-call
 
 

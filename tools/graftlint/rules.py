@@ -115,6 +115,22 @@ NON_DISPATCH_JAX = frozenset({
     "jax.default_backend", "jax.named_scope",
 })
 
+_DISPATCH_ROOTS = ("jax", "jnp", "pl")
+
+
+def is_dispatch_call(call: ast.Call, ctx) -> bool:
+    """Whether a call dispatches device work: jax/jnp/pl-rooted (minus
+    the NON_DISPATCH_JAX metadata calls) or resolved through the file's
+    weaviate_tpu.ops imports/aliases. ONE matcher shared by the
+    lock-across-device-call and host-loop-over-mesh rules, so the two
+    can never drift apart on what counts as a dispatch."""
+    dn = dotted_name(call.func)
+    if not dn or dn in NON_DISPATCH_JAX:
+        return False
+    root = dn.split(".", 1)[0]
+    return (root in _DISPATCH_ROOTS or root in ctx.ops_aliases
+            or (root in ctx.ops_imports and "." not in dn))
+
 
 # ---------------------------------------------------------------------------
 # 1. host-sync-in-hot-path
@@ -737,6 +753,67 @@ class DeviceArrayLeak(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 4f. host-loop-over-mesh
+
+
+class HostLoopOverMesh(Rule):
+    id = "host-loop-over-mesh"
+    description = (
+        "Python for-loop over mesh devices (mesh.devices / jax.devices()) "
+        "whose body issues per-device dispatches"
+    )
+    rationale = (
+        "The mesh serving contract is ONE SPMD program per batch "
+        "(shard_map + on-device cross-shard merge, ops/device_beam.py + "
+        "parallel/sharded_search.py): a host loop that dispatches work "
+        "per device serializes N round trips behind the Python thread, "
+        "re-introducing exactly the scatter-gather the fused mesh walk "
+        "exists to delete. Enumerating devices for metadata (counts, "
+        "placement tables) is fine — only loops that DISPATCH per "
+        "device are flagged. Rewrite as a shard_map/psum program, or "
+        "suppress with the invariant that makes the loop cold."
+    )
+
+    _DIRS = ("weaviate_tpu/parallel/", "weaviate_tpu/index/")
+    _DEVICE_ATTRS = frozenset({"devices", "local_devices"})
+
+    def _iterates_devices(self, it: ast.AST) -> bool:
+        """Whether the loop's iterable mentions a device enumeration:
+        ``mesh.devices`` (and .flat/.ravel() views), ``jax.devices()``,
+        ``jax.local_devices()``, or enumerate(...) of any of those."""
+        for n in ast.walk(it):
+            if isinstance(n, ast.Attribute) and n.attr in self._DEVICE_ATTRS:
+                return True
+        return False
+
+    def _dispatch_in_body(self, node, ctx) -> Optional[ast.Call]:
+        for call in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if isinstance(call, ast.Call) and is_dispatch_call(call, ctx):
+                return call
+        return None
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, self._DIRS):
+            return
+        for node in ctx.walk(ast.For, ast.AsyncFor):
+            if not self._iterates_devices(node.iter):
+                continue
+            call = self._dispatch_in_body(node, ctx)
+            if call is None:
+                continue
+            dn = dotted_name(call.func)
+            yield self.violation(
+                ctx, node,
+                f"for-loop over mesh devices dispatches {dn}(...) per "
+                "device — N serialized round trips instead of one SPMD "
+                "program; use shard_map with an on-device merge "
+                "(parallel/sharded_search.py, ops/topk."
+                "merge_across_shards)",
+                severity=SEV_ERROR,
+            )
+
+
+# ---------------------------------------------------------------------------
 # 5. lock-across-device-call
 
 
@@ -750,8 +827,6 @@ class LockAcrossDeviceCall(Rule):
         "thread behind one device round trip; snapshot state under the "
         "lock, release it, then dispatch."
     )
-
-    _DEVICE_ROOTS = ("jax", "jnp", "pl")
 
     def _lock_items(self, node) -> list:
         names = []
@@ -767,21 +842,16 @@ class LockAcrossDeviceCall(Rule):
             if not locks:
                 continue
             for call in ast.walk(ast.Module(body=node.body, type_ignores=[])):
-                if not isinstance(call, ast.Call):
+                if not isinstance(call, ast.Call) \
+                        or not is_dispatch_call(call, ctx):
                     continue
-                dn = dotted_name(call.func)
-                if not dn or dn in NON_DISPATCH_JAX:
-                    continue
-                root = dn.split(".", 1)[0]
-                if root in self._DEVICE_ROOTS or root in ctx.ops_aliases \
-                        or (root in ctx.ops_imports and "." not in dn):
-                    yield self.violation(
-                        ctx, call,
-                        f"{dn}(...) dispatched while holding "
-                        f"{', '.join(locks)} — move device work outside the "
-                        "critical section",
-                        severity=SEV_WARNING,
-                    )
+                yield self.violation(
+                    ctx, call,
+                    f"{dotted_name(call.func)}(...) dispatched while "
+                    f"holding {', '.join(locks)} — move device work "
+                    "outside the critical section",
+                    severity=SEV_WARNING,
+                )
 
 
 # ---------------------------------------------------------------------------
@@ -873,6 +943,7 @@ ALL_RULES: tuple = (
     UnboundedQueue(),
     HostBeamFallbackUnproven(),
     DeviceArrayLeak(),
+    HostLoopOverMesh(),
     LockAcrossDeviceCall(),
     Float64LiteralDrift(),
     SuppressionMissingReason(),

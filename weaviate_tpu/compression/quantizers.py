@@ -245,6 +245,7 @@ class ProductQuantizer(Quantizer):
         self.codebooks: Optional[np.ndarray] = None  # [M, C, dsub]
         self._cb_dev = None      # device copy, identity-keyed on codebooks
         self._cb_dev_src = None
+        self._cb_dev_mesh = None
 
     def fit(self, sample: np.ndarray) -> None:
         s = np.asarray(sample, np.float32)
@@ -270,31 +271,46 @@ class ProductQuantizer(Quantizer):
         out = self.codebooks[np.arange(self.m)[None, :], codes.astype(np.int64)]
         return out.reshape(codes.shape[0], self.dims)
 
-    def _device_codebooks(self) -> jnp.ndarray:
+    def _device_codebooks(self, mesh=None) -> jnp.ndarray:
         """Upload the codebooks once per fit, not once per call — the
-        frontier/beam paths hit this every search batch."""
-        if self._cb_dev is None or self._cb_dev_src is not self.codebooks:
-            self._cb_dev = jnp.asarray(self.codebooks)
+        frontier/beam paths hit this every search batch. With a mesh the
+        copy is placed REPLICATED on every shard device up front, so the
+        fused mesh walk never re-broadcasts 1.5 MB of codebooks per
+        dispatch (same discipline as the replicated-query cache)."""
+        if (self._cb_dev is None or self._cb_dev_src is not self.codebooks
+                or self._cb_dev_mesh is not mesh):
+            if mesh is None:
+                self._cb_dev = jnp.asarray(self.codebooks)
+            else:
+                from weaviate_tpu.parallel.sharded_search import replicate
+
+                self._cb_dev = replicate(
+                    np.asarray(self.codebooks, np.float32), mesh)
             self._cb_dev_src = self.codebooks
+            self._cb_dev_mesh = mesh
         return self._cb_dev
 
     def search(self, qrep, store, k, mask, chunk):
         return qops.pq_search(
-            qrep, store["codes"], self._device_codebooks(),
+            qrep, store["codes"],
+            self._device_codebooks(getattr(store, "mesh", None)),
             store["dec_sqnorm"], mask, self.metric, k, min(chunk, 32768),
         )
 
     def gather_distance(self, qrep, store, candidate_ids):
         return qops.pq_gather_distance(
-            qrep, store["codes"], self._device_codebooks(), candidate_ids,
-            store["dec_sqnorm"], self.metric,
+            qrep, store["codes"],
+            self._device_codebooks(getattr(store, "mesh", None)),
+            candidate_ids, store["dec_sqnorm"], self.metric,
         )
 
     def beam_scorer(self, store):
         from weaviate_tpu.ops.device_beam import PQScorer
 
         return PQScorer(self.metric), (
-            store["codes"], self._device_codebooks(), store["dec_sqnorm"])
+            store["codes"],
+            self._device_codebooks(getattr(store, "mesh", None)),
+            store["dec_sqnorm"])
 
     def state_dict(self) -> dict:
         return {

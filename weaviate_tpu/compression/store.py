@@ -62,27 +62,116 @@ def _round_up(n: int, page: int = _PAGE) -> int:
     return ((n + page - 1) // page) * page
 
 
+# Mesh-mode jitted mutators with PINNED out-shardings (mirrors
+# index/store.py _mesh_fns): every update keeps the code planes
+# row-sharded across the shard axis — no implicit gather to one device.
+# Cached per (mesh, field layout) so each collection shape compiles once.
+def _das_scatter_impl(arrays, valid, ids, values):
+    out = dict(arrays)
+    for name, val in values.items():
+        out[name] = out[name].at[ids].set(val)
+    return out, valid.at[ids].set(True)
+
+
+def _das_mask_off_impl(valid, ids):
+    return valid.at[ids].set(False)
+
+
+def _das_grow_impl(arrays, valid, new_cap):
+    grown = {}
+    for name, arr in arrays.items():
+        na = jnp.zeros((new_cap, *arr.shape[1:]), arr.dtype)
+        grown[name] = na.at[: arr.shape[0]].set(arr)
+    nv = jnp.zeros((new_cap,), jnp.bool_).at[: valid.shape[0]].set(valid)
+    return grown, nv
+
+
+_das_mesh_fns_cache: dict = {}
+
+
+def _das_mesh_fns(mesh, field_sig: tuple):
+    key = (mesh, field_sig)
+    fns = _das_mesh_fns_cache.get(key)
+    if fns is None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+        arr_sh = {
+            name: NamedSharding(mesh, P(SHARD_AXIS, *([None] * (ndim - 1))))
+            for name, ndim in field_sig
+        }
+        valid_sh = NamedSharding(mesh, P(SHARD_AXIS))
+        fns = (
+            (arr_sh, valid_sh),
+            # graftlint: allow[jit-in-loop] reason=compiled once per (mesh, field layout) via _das_mesh_fns_cache
+            jax.jit(_das_scatter_impl, out_shardings=(arr_sh, valid_sh)),
+            # graftlint: allow[jit-in-loop] reason=compiled once per (mesh, field layout) via _das_mesh_fns_cache
+            jax.jit(_das_mask_off_impl, out_shardings=valid_sh),
+            # graftlint: allow[jit-in-loop] reason=compiled once per (mesh, field layout) via _das_mesh_fns_cache
+            jax.jit(_das_grow_impl, static_argnames=("new_cap",),
+                    out_shardings=(arr_sh, valid_sh)),
+        )
+        _das_mesh_fns_cache[key] = fns
+    return fns
+
+
 class DeviceArraySet(TieredResidency):
     """Named device arrays sharing a doc-id-addressed leading dim + validity.
 
     fields: name -> (trailing_shape tuple, dtype). All arrays grow together
     by doubling (donate-free copy, same pattern as DeviceVectorStore._grow).
+
+    With ``mesh`` the code planes row-shard across the mesh's shard axis
+    (the quantized analogue of DeviceVectorStore's mesh mode): one
+    logical code plane spans every chip's HBM, and the fused mesh beam
+    (ops/device_beam.py) walks each shard's local block. Growth then
+    multiplies capacity by an INTEGER factor so block-shard membership
+    only ever coarsens (see parallel/mesh.shard_of).
     """
 
     def __init__(self, fields: dict[str, tuple[tuple[int, ...], np.dtype]],
-                 capacity: int = _PAGE):
-        cap = max(_PAGE, _round_up(capacity))
+                 capacity: int = _PAGE, mesh=None):
+        import math
+
         self.fields = fields
+        self.mesh = mesh
+        self._page = _PAGE
+        if mesh is None:
+            self._scatter_fn = _das_scatter_impl
+            self._mask_off_fn = _das_mask_off_impl
+            self._grow_fn = _das_grow_impl
+            self._shardings = None
+        else:
+            from weaviate_tpu.parallel.mesh import mesh_size
+
+            n_dev = mesh_size(mesh)
+            self._page = _PAGE * n_dev // math.gcd(_PAGE, n_dev)
+            sig = tuple(sorted(
+                (name, 1 + len(shape))
+                for name, (shape, _dtype) in fields.items()))
+            (self._shardings, self._scatter_fn, self._mask_off_fn,
+             self._grow_fn) = _das_mesh_fns(mesh, sig)
+        cap = max(self._page, _round_up(capacity, self._page))
         # (arrays, valid) live in ONE tuple swapped atomically (mirrors
         # DeviceVectorStore._state): a concurrent search can never pair
         # new-capacity arrays with an old-capacity valid mask
-        self._state: tuple[dict[str, jnp.ndarray], jnp.ndarray] = (
+        state = (
             {
                 name: jnp.zeros((cap, *shape), dtype)
                 for name, (shape, dtype) in fields.items()
             },
             jnp.zeros((cap,), jnp.bool_),
         )
+        if mesh is not None:
+            arr_sh, valid_sh = self._shardings
+            state = (
+                {name: jax.device_put(a, arr_sh[name])
+                 for name, a in state[0].items()},
+                jax.device_put(state[1], valid_sh),
+            )
+        self._state: tuple[dict[str, jnp.ndarray], jnp.ndarray] = state
         self._host_valid = np.zeros((cap,), bool)
         # warm-tier residency (tiering/): detached code planes live here
         # as host numpy; device accessors raise until attach
@@ -108,14 +197,23 @@ class DeviceArraySet(TieredResidency):
     def attach(self) -> int:
         """Re-upload the code planes at identical shapes/dtypes (compiled
         scan/beam programs keep hitting their cache). Returns HBM bytes
-        charged."""
+        charged. In mesh mode every shard's slice re-uploads straight to
+        its owning device (one sharded device_put per plane)."""
         if self._host_state is None:
             return 0
         arrays, valid = self._host_state
-        self._state = (
-            {name: jnp.asarray(a) for name, a in arrays.items()},
-            jnp.asarray(valid),
-        )
+        if self.mesh is not None:
+            arr_sh, valid_sh = self._shardings
+            self._state = (
+                {name: jax.device_put(np.asarray(a), arr_sh[name])
+                 for name, a in arrays.items()},
+                jax.device_put(np.asarray(valid), valid_sh),
+            )
+        else:
+            self._state = (
+                {name: jnp.asarray(a) for name, a in arrays.items()},
+                jnp.asarray(valid),
+            )
         self._host_state = None
         return self.nbytes
 
@@ -172,20 +270,19 @@ class DeviceArraySet(TieredResidency):
         if min_capacity <= self.capacity:
             return
         self._require_device()  # writers promote before growing
-        new_cap = _round_up(max(min_capacity, self.capacity * 2))
+        cap = self.capacity
+        new_cap = _round_up(max(min_capacity, cap * 2), self._page)
+        if self.mesh is not None:
+            # integer-multiple growth: block-shard membership (id // L)
+            # then only COARSENS, so intra-shard graph edges stay
+            # intra-shard across every grow (parallel/mesh.shard_of)
+            new_cap = cap * -(-new_cap // cap)
         arrays, valid = self._state
-        grown: dict[str, jnp.ndarray] = {}
-        for name, arr in arrays.items():
-            na = jnp.zeros((new_cap, *arr.shape[1:]), arr.dtype)
-            grown[name] = na.at[: arr.shape[0]].set(arr)
-        new_valid = (
-            jnp.zeros((new_cap,), jnp.bool_).at[: valid.shape[0]].set(valid)
-        )
         hv = np.zeros((new_cap,), bool)
         hv[: len(self._host_valid)] = self._host_valid
         # swap the state tuple atomically AFTER all arrays are built so a
         # concurrent reader never mixes capacities
-        self._state = (grown, new_valid)
+        self._state = self._grow_fn(arrays, valid, new_cap=new_cap)
         self._host_valid = hv
 
     def put(self, doc_ids: np.ndarray, values: dict[str, np.ndarray]) -> None:
@@ -196,11 +293,11 @@ class DeviceArraySet(TieredResidency):
         self.ensure_capacity(int(doc_ids.max()) + 1)
         idx = jnp.asarray(doc_ids)
         arrays, valid = self._state
-        updated = dict(arrays)
-        for name, val in values.items():
-            arr = updated[name]
-            updated[name] = arr.at[idx].set(jnp.asarray(val, arr.dtype))
-        self._state = (updated, valid.at[idx].set(True))
+        vals = {
+            name: jnp.asarray(val, arrays[name].dtype)
+            for name, val in values.items()
+        }
+        self._state = self._scatter_fn(arrays, valid, idx, vals)
         prev = self._host_valid[doc_ids]
         self._host_valid[doc_ids] = True
         self._live += int((~prev).sum())
@@ -214,7 +311,7 @@ class DeviceArraySet(TieredResidency):
         doc_ids = doc_ids[doc_ids < self.capacity]
         was = self._host_valid[doc_ids]
         arrays, valid = self._state
-        self._state = (arrays, valid.at[jnp.asarray(doc_ids)].set(False))
+        self._state = (arrays, self._mask_off_fn(valid, jnp.asarray(doc_ids)))
         self._host_valid[doc_ids] = False
         self._live -= int(was.sum())
 

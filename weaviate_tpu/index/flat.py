@@ -231,13 +231,22 @@ class FlatIndex(VectorIndex):
         return self.store.attach()
 
     def stats(self) -> dict:
-        return {
+        s = {
             "type": "flat",
             "count": self.count(),
             "capacity": self.capacity,
             "metric": self.metric,
             "device_resident": self.store.device_resident,
         }
+        per_shard = self.store.per_shard_live()
+        if per_shard is not None:
+            # mesh mode: surface the shard layout + feed the skew gauges
+            from weaviate_tpu.monitoring.metrics import set_mesh_shard_gauges
+
+            s["mesh_shards"] = len(per_shard)
+            s["mesh_shard_rows"] = [int(x) for x in per_shard]
+            set_mesh_shard_gauges(per_shard)
+        return s
 
 
 def _pad_mask(mask: np.ndarray, capacity: int) -> jnp.ndarray:

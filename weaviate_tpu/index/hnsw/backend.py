@@ -149,6 +149,19 @@ class RawBackend:
         return self.store.capacity
 
     @property
+    def mesh(self):
+        """The shard mesh the corpus rows span (None single-chip) — the
+        mesh device beam shards its graph mirror by this store's layout."""
+        return self.store.mesh
+
+    def device_plane_capacity(self) -> int:
+        """Capacity of the device-resident plane the beam scorer gathers
+        (== row count of the sharded corpus); the mesh mirror derives its
+        shard membership from this, never from the host graph's own
+        capacity."""
+        return self.store.capacity
+
+    @property
     def host_valid_mask(self) -> np.ndarray:
         return self.store.host_valid_mask
 
@@ -397,10 +410,16 @@ class QuantizedBackend:
             path = raw_path or getattr(config, "raw_path", None)
             if path is None:
                 raise ValueError(f"raw_tier={tier!r} requires a raw path")
+        from weaviate_tpu.parallel.runtime import default_mesh
+
         self.originals = HostVectorStore(
             dims, capacity=config.initial_capacity, dtype=dtype, path=path)
+        # Multi-chip: the quantized code planes row-shard across the
+        # process mesh exactly like the raw corpus does — the fused mesh
+        # beam walks each shard's local block (docs/mesh.md).
         self.codes = DeviceArraySet(
-            self.quantizer.fields(), capacity=config.initial_capacity
+            self.quantizer.fields(), capacity=config.initial_capacity,
+            mesh=default_mesh(),
         )
 
     def _prep_vectors(self, vectors: np.ndarray) -> np.ndarray:
@@ -434,6 +453,16 @@ class QuantizedBackend:
     @property
     def capacity(self) -> int:
         return self.originals.capacity
+
+    @property
+    def mesh(self):
+        """The shard mesh the code planes span (None single-chip)."""
+        return self.codes.mesh
+
+    def device_plane_capacity(self) -> int:
+        """Row count of the sharded code planes — the mesh mirror's
+        shard-membership base (the originals' host capacity can differ)."""
+        return self.codes.capacity
 
     @property
     def host_valid_mask(self) -> np.ndarray:

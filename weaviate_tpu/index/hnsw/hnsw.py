@@ -132,11 +132,46 @@ class HNSWIndex(VectorIndex):
             os.environ.get("WEAVIATE_TPU_DEVICE_BEAM", ""),
             config_on=getattr(self.config, "device_beam", False),
             platform=_jax.default_backend())
+        # Mesh mode: with the backend's planes row-sharded across a
+        # device mesh, the fused walk runs as ONE SPMD dispatch spanning
+        # every chip — per-shard subgraph walks + on-device cross-shard
+        # top-k merge (docs/mesh.md). The graph is then PARTITIONED
+        # (edges intra-shard only), so the mirror is the mesh variant
+        # and construction routes through _insert_subbatch_mesh.
+        self._mesh_partitioned = False
         if _beam_on:
-            from weaviate_tpu.ops.device_beam import DeviceAdjacency
+            from weaviate_tpu.ops.device_beam import (
+                DeviceAdjacency,
+                MeshDeviceAdjacency,
+            )
 
-            self._device_beam = DeviceAdjacency(self.graph)
-            self.graph.dirty_hook = self._device_beam.mark_dirty
+            mesh = getattr(self.backend, "mesh", None)
+            if mesh is not None:
+                if self._graph_intra_shard(mesh):
+                    self._device_beam = MeshDeviceAdjacency(
+                        self.graph, mesh,
+                        self.backend.device_plane_capacity)
+                    if self.graph.node_count:
+                        # restored shard-consistent graph: elect per-shard
+                        # seeds and serve it through the mesh walk
+                        self._device_beam.refresh_seeds()
+                        self._mesh_partitioned = True
+                else:
+                    # legacy GLOBAL graph under a mesh (e.g. a snapshot
+                    # from a single-chip build): its edges cross shards,
+                    # so the mesh walk cannot own it — keep the pre-mesh
+                    # host-walk path (sharded gather kernels) instead
+                    import logging
+
+                    logging.getLogger("weaviate_tpu.hnsw").warning(
+                        "graph edges cross mesh shards (single-chip "
+                        "build?); mesh device beam disabled, host walk "
+                        "serves this index")
+                    self._device_beam = None
+            else:
+                self._device_beam = DeviceAdjacency(self.graph)
+            if self._device_beam is not None:
+                self.graph.dirty_hook = self._device_beam.mark_dirty
 
     # ------------------------------------------------------------------
     # persistence: condensed-graph snapshot (reference commit_logger.go
@@ -215,6 +250,39 @@ class HNSWIndex(VectorIndex):
         return np.minimum(
             (-np.log(np.maximum(u, 1e-12)) * self._ml).astype(np.int16), 30
         )
+
+    def _mesh_mirror(self):
+        """The MeshDeviceAdjacency mirror when mesh beam mode is active,
+        else None."""
+        from weaviate_tpu.ops.device_beam import MeshDeviceAdjacency
+
+        beam = self._device_beam
+        return beam if isinstance(beam, MeshDeviceAdjacency) else None
+
+    def _graph_intra_shard(self, mesh) -> bool:
+        """Whether every existing edge stays within one block shard of
+        the backend's plane layout — the invariant the mesh walk owns.
+        A restored single-chip graph fails this and keeps the host-walk
+        path instead (a wrong local-index walk must be impossible)."""
+        from weaviate_tpu.parallel.mesh import mesh_size, shard_of
+
+        g = self.graph
+        if g.node_count == 0:
+            return True
+        cap = self.backend.device_plane_capacity()
+        n = mesh_size(mesh)
+        gc = min(g.capacity, cap)
+        src = g.layer0[:gc]
+        row_shard = shard_of(np.arange(gc), cap, n)[:, None]
+        if not np.all((src < 0) | (shard_of(src, cap, n) == row_shard)):
+            return False
+        for layer in g.upper.values():
+            for node, nbrs in layer.items():
+                if len(nbrs) and not np.all(
+                        shard_of(np.asarray(nbrs), cap, n)
+                        == shard_of(node, cap, n)):
+                    return False
+        return True
 
     # ------------------------------------------------------------------
     # batched greedy descent (upper layers, ef=1) — reference search.go:760
@@ -416,6 +484,7 @@ class HNSWIndex(VectorIndex):
         from weaviate_tpu.monitoring.metrics import DEVICE_BEAM_FALLBACK
         from weaviate_tpu.ops.device_beam import device_search
 
+        mesh_mirror = self._mesh_mirror()
         try:
             adj, present = self._device_beam.sync()
             ef_pad = 1 << max(4, (int(efc) - 1).bit_length())
@@ -434,13 +503,39 @@ class HNSWIndex(VectorIndex):
                         [q, jnp.repeat(q[:1], pad, axis=0)], axis=0)
                     sub_eps = np.concatenate(
                         [sub_eps, np.repeat(sub_eps[:1], pad)])
-                ids_j, d_j = device_search(
-                    scorer, q, operands, adj, present, sub_eps,
-                    ef=ef_pad, max_steps=int(4 * ef_pad + 64))
-                # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
-                outs_i.append(np.asarray(ids_j)[:len(sub)].astype(np.int64))
-                # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
-                outs_d.append(np.asarray(d_j)[:len(sub)])
+                if mesh_mirror is not None:
+                    # ONE SPMD dispatch for the whole chunk: every shard
+                    # walks all rows, but a row's entrypoint is local to
+                    # exactly one shard — the others see seed -1 and
+                    # exit immediately. merge=False returns the stacked
+                    # per-shard results; each node takes its OWN shard's
+                    # candidates (links are intra-shard by definition).
+                    from weaviate_tpu.ops.device_beam import (
+                        device_search_mesh,
+                    )
+
+                    ids_j, d_j = device_search_mesh(
+                        scorer, q, operands, adj, present,
+                        mesh_mirror.mesh, ef=ef_pad,
+                        max_steps=int(4 * ef_pad + 64), fetch=ef_pad,
+                        qeps=jnp.asarray(sub_eps), merge=False)
+                    own = mesh_mirror.shard_of(sub)
+                    # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
+                    oi = np.asarray(ids_j)
+                    # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
+                    od = np.asarray(d_j)
+                    sel = np.arange(len(sub))
+                    outs_i.append(oi[own, sel].astype(np.int64))
+                    outs_d.append(od[own, sel])
+                else:
+                    ids_j, d_j = device_search(
+                        scorer, q, operands, adj, present, sub_eps,
+                        ef=ef_pad, max_steps=int(4 * ef_pad + 64))
+                    # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
+                    oi = np.asarray(ids_j)[:len(sub)].astype(np.int64)
+                    outs_i.append(oi)
+                    # graftlint: allow[host-sync-in-hot-path] reason=per-batch beam results feed host graph linking
+                    outs_d.append(np.asarray(d_j)[:len(sub)])
             res_ids = np.concatenate(outs_i)[:, :efc]
             res_d = np.concatenate(outs_d)[:, :efc]
             self._beam_proven = True
@@ -465,6 +560,8 @@ class HNSWIndex(VectorIndex):
     def _insert_subbatch(self, ids: np.ndarray) -> None:
         if len(ids) == 0:
             return
+        if self._mesh_mirror() is not None:
+            return self._insert_subbatch_mesh(ids)
         levels = self._level_for_new(len(ids))
         if self.graph.entrypoint == NO_NODE:
             self.graph.add_node(int(ids[0]), int(levels[0]))
@@ -520,7 +617,88 @@ class HNSWIndex(VectorIndex):
         for level, sub, res_ids, res_d in link_plan:
             self._link_level(level, ids, levels, sub, res_ids, res_d, bb)
 
-    def _link_level(self, level, ids, levels, sub, res_ids, res_d, bb) -> None:
+    def _insert_subbatch_mesh(self, ids: np.ndarray) -> None:
+        """Lockstep insert for the PARTITIONED (mesh) graph: every node
+        links only within its block shard, seeded at its shard's
+        entrypoints, so each shard grows an independent subgraph the
+        SPMD walk can traverse in pure local index space. The layer-0
+        ef_construction walks still run as ONE mesh dispatch per chunk
+        (``_construction_beam_level0``) — per-shard host loops are
+        exactly the anti-pattern graftlint's host-loop-over-mesh bans."""
+        mirror = self._device_beam
+        levels = self._level_for_new(len(ids))
+        shard = mirror.shard_of(np.asarray(ids, np.int64))
+        # bootstrap: the first node of a seedless shard becomes its seed
+        boot = []
+        for i, node in enumerate(ids):
+            if not mirror.has_seed(int(shard[i])):
+                self.graph.add_node(int(node), int(levels[i]))
+                mirror.add_seed(int(node))
+                boot.append(i)
+        if boot:
+            keep = np.setdiff1d(np.arange(len(ids)), np.asarray(boot))
+            ids, levels, shard = ids[keep], levels[keep], shard[keep]
+        self._mesh_partitioned = True
+        if len(ids) == 0:
+            return
+        b = len(ids)
+        qdev = self.backend.prep_query_ids(ids)
+        eps = np.empty(b, np.int64)
+        shard_max = np.empty(b, np.int64)
+        for i in range(b):
+            sd = mirror.primary_seed(int(shard[i]))
+            eps[i] = sd
+            shard_max[i] = int(self.graph.levels[sd]) if sd >= 0 else -1
+        efc = self.config.ef_construction
+        batch_max = int(max(int(levels.max()), int(shard_max.max())))
+
+        link_plan: list[tuple[int, np.ndarray, np.ndarray, np.ndarray]] = []
+        for level in range(batch_max, -1, -1):
+            # a shard's seed is its highest-level node, so it exists at
+            # every level the shard has — descent/search never step onto
+            # a level the shard's subgraph lacks
+            exists = shard_max >= level
+            search = levels >= level
+            descend = exists & ~search
+            if descend.any():
+                eps[descend] = self._greedy_step_until_stable(
+                    qdev, eps, level, descend)[descend]
+            active = search & exists
+            if active.any():
+                sub = np.nonzero(active)[0]
+                res = (self._construction_beam_level0(
+                    ids[sub], eps[sub], efc) if level == 0 else None)
+                if res is None:
+                    res = self._search_level(
+                        self.backend.take_queries(qdev, sub), eps[sub],
+                        efc, level)
+                res_ids, res_d = res
+                eps[sub] = np.where(res_ids[:, 0] >= 0, res_ids[:, 0],
+                                    eps[sub])
+                link_plan.append((level, sub, res_ids, res_d))
+            lonely = search & ~exists
+            if lonely.any():
+                # levels above the shard's current max: same-shard batch
+                # peers are the only candidates
+                sub = np.nonzero(lonely)[0]
+                empty = np.empty((len(sub), 0))
+                link_plan.append(
+                    (level, sub, empty.astype(np.int64),
+                     empty.astype(np.float32)))
+
+        for i, node in enumerate(ids):
+            self.graph.add_node(int(node), int(levels[i]))
+            if int(levels[i]) > int(shard_max[i]):
+                # new shard-top node: future descents start here
+                mirror.add_seed(int(node))
+
+        bb = self.backend.pairwise(ids[None, :])[0]
+        for level, sub, res_ids, res_d in link_plan:
+            self._link_level(level, ids, levels, sub, res_ids, res_d, bb,
+                             peer_shard=shard)
+
+    def _link_level(self, level, ids, levels, sub, res_ids, res_d, bb,
+                    peer_shard=None) -> None:
         width = self.graph.width(level)
         b = len(ids)
         g = len(sub)
@@ -533,7 +711,11 @@ class HNSWIndex(VectorIndex):
         cand[:, : res_ids.shape[1]] = res_ids
         cd[:, : res_d.shape[1]] = res_d
         for row, i in enumerate(sub):
-            peers = np.nonzero(peer_ok & (np.arange(b) != i))[0]
+            ok = peer_ok & (np.arange(b) != i)
+            if peer_shard is not None:
+                # partitioned graph: only same-shard peers may link
+                ok &= peer_shard == peer_shard[i]
+            peers = np.nonzero(ok)[0]
             if len(peers):
                 cand[row, res_ids.shape[1] : res_ids.shape[1] + len(peers)] = ids[peers]
                 cd[row, res_ids.shape[1] : res_ids.shape[1] + len(peers)] = bb[i, peers]
@@ -684,6 +866,11 @@ class HNSWIndex(VectorIndex):
         removed = len(dead)
         for dn in sorted(dead):
             self.graph.remove_node_hard(dn)
+        mirror = self._mesh_mirror()
+        if mirror is not None:
+            # a hard-removed node may have been a shard seed: drop it and
+            # re-elect so every populated shard stays walkable
+            mirror.refresh_seeds()
         return removed
 
     # ------------------------------------------------------------------
@@ -755,8 +942,14 @@ class HNSWIndex(VectorIndex):
                     * live):
                 return self._flat_filtered(queries, k, allow_list)
 
-        ids, d = self._dispatch.search(queries, k, allow_list,
-                                       tier_key=self._residency_epoch)
+        # batch-group key: residency epoch PLUS the mesh mirror's
+        # membership epoch — a request enqueued before an integer-factor
+        # growth re-sharded the planes must never coalesce into a batch
+        # whose local-index layout belongs to the new generation
+        ids, d = self._dispatch.search(
+            queries, k, allow_list,
+            tier_key=(self._residency_epoch,
+                      getattr(self._device_beam, "epoch", 0)))
         return SearchResult(ids=ids, dists=d)
 
     def _run_search_batch(self, queries: np.ndarray, k: int, allow_list):
@@ -801,6 +994,14 @@ class HNSWIndex(VectorIndex):
             out = self._device_beam_search(queries, qdev, ef, k, allow_list)
             if out is not None:
                 return out
+        if self._mesh_partitioned:
+            # a PARTITIONED graph has no global walk: the host beam from
+            # one entrypoint would explore a single shard's subgraph and
+            # silently drop 7/8ths of the corpus. The correct fallback
+            # (mesh kernel unavailable / unfitted quantizer / latched)
+            # is the exact sharded flat scan — still one dispatch.
+            d, ids = self.backend.flat_topk(queries, k, allow_list)
+            return ids, d
         eps = np.full(b, self.graph.entrypoint, np.int64)
         all_active = np.ones(b, bool)
         for level in range(self.graph.max_level, 0, -1):
@@ -845,6 +1046,7 @@ class HNSWIndex(VectorIndex):
         if self.backend.quantized:
             rl = getattr(self.backend.quantizer.config, "rescore_limit", 0)
             fetch = min(ef, max(fetch, rl, 2 * k))
+        mesh_mirror = self._mesh_mirror()
         try:
             import jax.numpy as jnp
 
@@ -861,20 +1063,56 @@ class HNSWIndex(VectorIndex):
             if b_pad != b:
                 q = jnp.concatenate(
                     [q, jnp.repeat(q[:1], b_pad - b, axis=0)], axis=0)
-            eps = np.full(b_pad, self.graph.entrypoint, np.int32)
+            cap = int(adj.shape[0])
+            al_pad = None
             if allow_list is not None:
-                cap = int(adj.shape[0])
                 al = np.asarray(allow_list, bool)
                 if len(al) < cap:
                     al = np.pad(al, (0, cap - len(al)))
+                al_pad = al[:cap]
+            if mesh_mirror is not None:
+                # ONE SPMD dispatch spanning the whole mesh: per-shard
+                # walk from the shard's seed table + on-device
+                # cross-shard top-k merge (docs/mesh.md)
+                import jax
+
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from weaviate_tpu.ops.device_beam import device_search_mesh
+                from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+                seeds = mesh_mirror.sync_seeds()
+                fetch_pad = min(
+                    ef_pad, 1 << max(3, (int(fetch) - 1).bit_length()))
+                if al_pad is not None:
+                    allow_j = jax.device_put(
+                        al_pad, NamedSharding(
+                            mesh_mirror.mesh, P(SHARD_AXIS)))
+                    _, _, ids, d = device_search_mesh(
+                        scorer, q, operands, adj, present,
+                        mesh_mirror.mesh, ef=ef_pad,
+                        max_steps=int(4 * ef_pad + 64), fetch=fetch_pad,
+                        seeds=seeds, upper_adj=upper_adj,
+                        upper_slots=upper_slots, allow=allow_j,
+                        keep_k=fetch_pad)
+                else:
+                    ids, d = device_search_mesh(
+                        scorer, q, operands, adj, present,
+                        mesh_mirror.mesh, ef=ef_pad,
+                        max_steps=int(4 * ef_pad + 64), fetch=fetch_pad,
+                        seeds=seeds, upper_adj=upper_adj,
+                        upper_slots=upper_slots)
+            elif al_pad is not None:
+                eps = np.full(b_pad, self.graph.entrypoint, np.int32)
                 keep_k = 1 << max(3, (int(fetch) - 1).bit_length())
                 _, _, ids, d = device_search(
                     scorer, q, operands, adj, present, eps,
                     ef=ef_pad, max_steps=int(4 * ef_pad + 64),
                     upper_adj=upper_adj, upper_slots=upper_slots,
-                    allow=jnp.asarray(al[:cap]), keep_k=keep_k,
+                    allow=jnp.asarray(al_pad), keep_k=keep_k,
                 )
             else:
+                eps = np.full(b_pad, self.graph.entrypoint, np.int32)
                 ids, d = device_search(
                     scorer, q, operands, adj, present, eps,
                     ef=ef_pad, max_steps=int(4 * ef_pad + 64),
@@ -1017,4 +1255,8 @@ class HNSWIndex(VectorIndex):
             # presence mask, and compact upper-layer tables
             s["device_beam"] = True
             s["device_beam_hbm_bytes"] = self._device_beam.nbytes
+        mirror = self._mesh_mirror()
+        if mirror is not None:
+            s["mesh_shards"] = mirror.n
+            s["mesh_rows_per_shard"] = mirror.rows_per_shard()
         return s

@@ -119,9 +119,7 @@ def maxsim_scores(query: np.ndarray, cand_tokens: np.ndarray,
 
     mesh = default_mesh()
     if mesh is not None and cand_tokens.shape[0] >= 2 * mesh.size:
-        from weaviate_tpu.parallel.sharded_search import (
-            replicate, sharded_maxsim,
-        )
+        from weaviate_tpu.parallel.sharded_search import sharded_maxsim
         from jax.sharding import NamedSharding, PartitionSpec as P
         from weaviate_tpu.parallel.mesh import SHARD_AXIS
 
@@ -140,7 +138,9 @@ def maxsim_scores(query: np.ndarray, cand_tokens: np.ndarray,
             NamedSharding(mesh, P(SHARD_AXIS, None, None)))
         mask = jax.device_put(cand_mask,
                               NamedSharding(mesh, P(SHARD_AXIS, None)))
-        q = replicate(np.asarray(query, np.float32), mesh)
+        # replication of the query rides sharded_maxsim's identity-keyed
+        # cache (one upload per query batch, not per invocation)
+        q = np.asarray(query, np.float32)
         # graftlint: allow[host-sync-in-hot-path] reason=final [C] score materialization for host rerank
         return np.asarray(sharded_maxsim(q, toks, mask, mesh=mesh))[:c]
 

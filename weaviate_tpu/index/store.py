@@ -106,7 +106,9 @@ class DeviceVectorStore(TieredResidency):
             # Row-sharded mode: corpus rows split across the mesh's 'shard'
             # axis; scatter/grow outputs pinned to the same layout so every
             # update stays distributed (no implicit gather to one device).
-            n_dev = int(np.prod(mesh.devices.shape))
+            from weaviate_tpu.parallel.mesh import mesh_size
+
+            n_dev = mesh_size(mesh)
             self._page = _PAGE * n_dev // math.gcd(_PAGE, n_dev)
             (self._shardings, self._scatter_fn, self._mask_off_fn,
              self._grow_fn) = _mesh_fns(mesh)
@@ -251,11 +253,30 @@ class DeviceVectorStore(TieredResidency):
         if min_capacity <= self.capacity:
             return
         self._require_device()  # writers promote before growing
-        new_cap = _round_up(max(min_capacity, self.capacity * 2), self._page)
+        cap = self.capacity
+        new_cap = _round_up(max(min_capacity, cap * 2), self._page)
+        if self.mesh is not None:
+            # integer-multiple growth: block-shard membership (id // L)
+            # then only COARSENS across grows, so the mesh beam's
+            # intra-shard graph edges can never straddle a new shard
+            # boundary (parallel/mesh.shard_of)
+            new_cap = cap * -(-new_cap // cap)
         self._state = self._grow_fn(*self._state, new_cap=new_cap)
         hv = np.zeros((new_cap,), bool)
         hv[: len(self._host_valid)] = self._host_valid
         self._host_valid = hv
+
+    def per_shard_live(self) -> Optional[np.ndarray]:
+        """Live-row count per mesh shard under the row-block layout
+        (None off-mesh) — the feed for the shard-imbalance gauges."""
+        if self.mesh is None:
+            return None
+        from weaviate_tpu.parallel.mesh import mesh_size
+
+        n = mesh_size(self.mesh)
+        hv = self._host_valid
+        rows = len(hv) // n
+        return hv.reshape(n, rows).sum(axis=1)
 
     def put(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
         doc_ids = np.asarray(doc_ids, np.int32)

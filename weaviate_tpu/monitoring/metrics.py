@@ -252,6 +252,42 @@ DEVICE_BEAM_FALLBACK = REGISTRY.counter(
     "by kind (search/construction) and mode (transient/latched); a "
     "latched fallback permanently downgrades the index to host walks")
 
+# mesh-sharded device beam instruments (ops/device_beam.py mesh kernel +
+# parallel/): shard skew and accidental per-shard dispatch regressions are
+# alertable — one logical index across all chips must stay ONE dispatch
+MESH_SHARDS = REGISTRY.gauge(
+    "weaviate_tpu_mesh_shards",
+    "devices in the active shard mesh the fused beam spans (0 = mesh off)")
+MESH_SHARD_ROWS = REGISTRY.gauge(
+    "weaviate_tpu_mesh_shard_rows",
+    "live graph rows resident on each mesh shard, by shard index — the "
+    "per-shard row-count feed for skew alerts")
+MESH_SHARD_IMBALANCE = REGISTRY.gauge(
+    "weaviate_tpu_mesh_shard_imbalance",
+    "max/mean ratio of live rows across populated mesh shards (1.0 = "
+    "perfectly balanced; alert when skew concentrates the walk on one chip)")
+MESH_BEAM_DISPATCH = REGISTRY.counter(
+    "weaviate_tpu_mesh_beam_dispatch_total",
+    "fused mesh-beam SPMD programs dispatched, by mode "
+    "(search/construction); a full-mesh batch is exactly ONE dispatch — a "
+    "rate jump relative to query batches means a per-shard dispatch "
+    "regression")
+
+
+def set_mesh_shard_gauges(counts) -> None:
+    """Feed the mesh skew gauges from per-shard live-row counts — the ONE
+    owner of the imbalance definition (max/mean over populated shards),
+    shared by the beam mirror sync and flat-index stats."""
+    import numpy as np
+
+    counts = np.asarray(counts)
+    MESH_SHARDS.set(len(counts))
+    for s, c in enumerate(counts):
+        MESH_SHARD_ROWS.set(float(c), shard=str(s))
+    populated = counts[counts > 0]
+    if len(populated):
+        MESH_SHARD_IMBALANCE.set(float(populated.max() / populated.mean()))
+
 # tiered tenant store instruments (tiering/): residency bytes per tier,
 # every promotion/demotion the controller performs, cold-start behavior
 # observable end to end (first-touch hits, promotion latency, and the

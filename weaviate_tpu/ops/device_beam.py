@@ -287,6 +287,336 @@ def _fused_search(
     return beam_ids, beam_d
 
 
+# ---------------------------------------------------------------------------
+# mesh-sharded fused walk: ONE SPMD dispatch across every chip
+# ---------------------------------------------------------------------------
+#
+# The reference scales reads by per-shard goroutine fan-out with a
+# coordinator merge (index.go:1928); the jax-native analogue is the same
+# fused walk run under shard_map: queries replicate, every device walks
+# its OWN shard-local subgraph over its LOCAL block of the scored planes
+# (raw corpus or SQ/PQ/BQ/RQ codes, row-block-sharded), each shard
+# over-fetches its rescore-tier candidates, and a tiled all_gather +
+# top_k merges across shards ON DEVICE (ops/topk.merge_across_shards) —
+# no per-shard candidate list ever round-trips to the host, and the
+# whole thing is still exactly one dispatch per batch.
+#
+# Shard-local subgraphs: mesh construction (index/hnsw/hnsw.py) links
+# every node only within its block shard (shard(id) = id // L, L =
+# plane capacity / mesh size), so the mirrored adjacency can store
+# LOCAL neighbor indices and each device's block is self-contained —
+# the device walk never needs a cross-shard gather per hop.
+
+
+def _op_partition_spec(arr, cap: int, axis: str):
+    """Row-sharded for plane arrays (leading dim == capacity), replicated
+    for everything else (PQ codebooks, SQ affine scalars)."""
+    from jax.sharding import PartitionSpec as P
+
+    nd = np.ndim(arr)
+    if nd >= 1 and arr.shape[0] == cap:
+        return P(axis, *([None] * (nd - 1)))
+    return P(*([None] * nd))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scorer", "ef", "max_steps", "fetch", "keep_k",
+                     "mesh", "axis", "merge"))
+def _fused_mesh_search(
+    scorer,
+    queries,
+    operands: tuple,
+    adjacency,           # [cap, M0] int32 row-sharded, content LOCAL ids
+    present,             # [cap] bool row-sharded
+    upper_adj,           # [n, Lv, S, M] int32 sharded on 0, content LOCAL
+    upper_slots,         # [Lv, cap] int32 sharded on dim 1
+    ef: int,
+    max_steps: int,
+    fetch: int,
+    mesh=None,
+    axis: str = "shard",
+    merge: bool = True,
+    seeds=None,          # [n, E] int32 sharded on 0, LOCAL ids (serving)
+    qeps=None,           # [B] int32 replicated GLOBAL ids (construction)
+    allow=None,          # [cap] bool row-sharded
+    keep_k: int = 0,
+):
+    """The whole mesh as one program: per-shard descent + layer-0 beam
+    in local index space, then the cross-shard top-k merge. Returns
+    replicated (ids [B, fetch] GLOBAL, dists) — plus (kept_ids [B,
+    keep_k], kept_d) when filtered — or, with ``merge=False``
+    (construction), the UNMERGED per-shard results stacked [n, B,
+    fetch] so the host can take each node's own-shard candidates."""
+    from jax.sharding import PartitionSpec as P
+
+    from weaviate_tpu.parallel.sharded_search import _shard_map
+
+    cap = adjacency.shape[0]
+    track = allow is not None and keep_k > 0
+
+    def local(q, ops_l, adj_l, pres_l, uadj_l, uslots_l, *rest):
+        rest = list(rest)
+        seeds_l = rest.pop(0) if seeds is not None else None
+        qeps_r = rest.pop(0) if qeps is not None else None
+        allow_l = rest.pop(0) if allow is not None else None
+        n_local = adj_l.shape[0]
+        b = q.shape[0]
+        rows = jnp.arange(b)
+        base = jax.lax.axis_index(axis) * n_local
+
+        if seeds_l is not None:
+            sds = seeds_l[0]                                   # [E] local
+            cur = jnp.broadcast_to(sds[None, :], (b, sds.shape[0]))
+        else:
+            # construction: per-query global entrypoints — only the
+            # owning shard walks each query, the rest see seed -1 and
+            # exit their beam immediately (per-shard parallelism)
+            ok = (qeps_r >= base) & (qeps_r < base + n_local)
+            cur = jnp.where(ok, qeps_r - base, -1)[:, None]
+        e_w = cur.shape[1]
+        d0 = _masked_scores(scorer, q, cur, ops_l)             # [B, E]
+
+        # -- per-shard upper-layer greedy descent (one seed lane each) --
+        n_upper = uadj_l.shape[1]
+        if n_upper:
+            def level_body(li, carry):
+                cur, cur_d = carry
+                adj_lv = jax.lax.dynamic_index_in_dim(
+                    uadj_l[0], li, 0, keepdims=False)          # [S, M]
+                slot_lv = jax.lax.dynamic_index_in_dim(
+                    uslots_l, li, 0, keepdims=False)           # [L]
+
+                def cond(st):
+                    step, _, _, live = st
+                    return (step < max_steps) & live.any()
+
+                def body(st):
+                    step, cur, cur_d, live = st
+                    slot = jnp.where(
+                        cur >= 0, jnp.take(slot_lv, jnp.maximum(cur, 0)), -1)
+                    nbrs = jnp.take(adj_lv, jnp.maximum(slot, 0), axis=0)
+                    okm = ((slot >= 0) & live)[..., None] & (nbrs >= 0)
+                    okm &= jnp.take(pres_l, jnp.maximum(nbrs, 0))
+                    nbrs = jnp.where(okm, nbrs, -1)
+                    d = _masked_scores(
+                        scorer, q, nbrs.reshape(b, -1), ops_l
+                    ).reshape(nbrs.shape)
+                    j = jnp.argmin(d, axis=2)
+                    bd = jnp.take_along_axis(d, j[..., None], 2)[..., 0]
+                    upd = live & (bd < cur_d)
+                    cur = jnp.where(
+                        upd,
+                        jnp.take_along_axis(nbrs, j[..., None], 2)[..., 0],
+                        cur)
+                    cur_d = jnp.where(upd, bd, cur_d)
+                    return step + 1, cur, cur_d, upd
+
+                _, cur, cur_d, _ = jax.lax.while_loop(
+                    cond, body,
+                    (jnp.int32(0), cur, cur_d, jnp.ones(cur.shape, bool)))
+                return cur, cur_d
+
+            cur, d0 = jax.lax.fori_loop(0, n_upper, level_body, (cur, d0))
+
+        if e_w > 1:
+            # seed lanes that converged to the same node would occupy two
+            # beam slots and surface DUPLICATE result ids — keep the first
+            same = (cur[:, :, None] == cur[:, None, :]) & (cur[:, None, :] >= 0)
+            earlier = jnp.tril(jnp.ones((e_w, e_w), bool), -1)
+            dup = (same & earlier[None]).any(axis=2) & (cur >= 0)
+            cur = jnp.where(dup, -1, cur)
+            d0 = jnp.where(dup, _INF, d0)
+
+        # -- layer-0 best-first beam over the local block ---------------
+        beam_ids = jnp.full((b, ef), -1, jnp.int32).at[:, :e_w].set(cur)
+        beam_d = jnp.full((b, ef), _INF, jnp.float32).at[:, :e_w].set(
+            jnp.where(cur >= 0, d0, _INF))
+        expanded = jnp.zeros((b, ef), bool)
+        visited = jnp.zeros((b, n_local), jnp.uint8).at[
+            rows[:, None], jnp.maximum(cur, 0)].max(
+                (cur >= 0).astype(jnp.uint8))
+        if track:
+            pad_w = max(e_w, keep_k)
+            ka0 = jnp.full((b, pad_w), -1, jnp.int32).at[:, :e_w].set(cur)
+            al_ok = (cur >= 0) & jnp.take(allow_l, jnp.maximum(cur, 0))
+            kd0 = jnp.full((b, pad_w), _INF, jnp.float32).at[:, :e_w].set(
+                jnp.where(al_ok, d0, _INF))
+            korder0 = jnp.argsort(kd0, axis=1, stable=True)[:, :keep_k]
+            kept_ids = jnp.take_along_axis(ka0, korder0, axis=1)
+            kept_d = jnp.take_along_axis(kd0, korder0, axis=1)
+        else:
+            kept_ids = jnp.zeros((b, 0), jnp.int32)
+            kept_d = jnp.zeros((b, 0), jnp.float32)
+
+        def cond(st):
+            step, _, _, _, _, _, _, alive = st
+            return (step < max_steps) & alive
+
+        def body(st):
+            step, beam_ids, beam_d, expanded, visited, kept_ids, kept_d, _ = st
+            cand_d = jnp.where(expanded | (beam_ids < 0), _INF, beam_d)
+            j = jnp.argmin(cand_d, axis=1)
+            cd = cand_d[rows, j]
+            active = cd < _INF
+            expanded = expanded.at[rows, j].set(expanded[rows, j] | active)
+            cur = jnp.where(active, beam_ids[rows, j], 0)
+            nbrs = jnp.take(adj_l, jnp.maximum(cur, 0), axis=0)
+            nbrs = jnp.where(active[:, None], nbrs, -1)
+            safe = jnp.maximum(nbrs, 0)
+            seen = jnp.take_along_axis(visited, safe, axis=1) > 0
+            ok = (nbrs >= 0) & ~seen & jnp.take(pres_l, safe)
+            nbrs = jnp.where(ok, nbrs, -1)
+            visited = visited.at[rows[:, None], safe].max(
+                ok.astype(jnp.uint8))
+            nd = _masked_scores(scorer, q, nbrs, ops_l)
+            all_ids = jnp.concatenate([beam_ids, nbrs], axis=1)
+            all_d = jnp.concatenate([beam_d, nd], axis=1)
+            all_exp = jnp.concatenate(
+                [expanded, jnp.zeros_like(nbrs, bool)], axis=1)
+            order = jnp.argsort(all_d, axis=1, stable=True)[:, :ef]
+            beam_ids = jnp.take_along_axis(all_ids, order, axis=1)
+            beam_d = jnp.take_along_axis(all_d, order, axis=1)
+            expanded = jnp.take_along_axis(all_exp, order, axis=1)
+            if track:
+                nd_k = jnp.where(
+                    (nbrs >= 0) & jnp.take(allow_l, jnp.maximum(nbrs, 0)),
+                    nd, _INF)
+                ka = jnp.concatenate([kept_ids, nbrs], axis=1)
+                kd = jnp.concatenate([kept_d, nd_k], axis=1)
+                korder = jnp.argsort(kd, axis=1, stable=True)[:, :keep_k]
+                kept_ids = jnp.take_along_axis(ka, korder, axis=1)
+                kept_d = jnp.take_along_axis(kd, korder, axis=1)
+            return (step + 1, beam_ids, beam_d, expanded, visited,
+                    kept_ids, kept_d, active.any())
+
+        _, beam_ids, beam_d, _, _, kept_ids, kept_d, _ = jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(0), beam_ids, beam_d, expanded, visited,
+             kept_ids, kept_d, jnp.bool_(True)))
+
+        out_ids = beam_ids[:, :fetch]
+        out_d = beam_d[:, :fetch]
+        gids = jnp.where(out_ids >= 0, out_ids + base, -1)
+        if not merge:
+            return gids[None], out_d[None]       # [1, B, fetch] per shard
+        from weaviate_tpu.ops.topk import merge_across_shards
+
+        md, mi = merge_across_shards(out_d, gids, fetch, axis)
+        if track:
+            kg = jnp.where(kept_ids >= 0, kept_ids + base, -1)
+            kept_ids = jnp.where(kg >= 0, kg, -1)
+            kmd, kmi = merge_across_shards(kept_d, kept_ids, keep_k, axis)
+            return mi, md, kmi, kmd
+        return mi, md
+
+    q_spec = P(*([None] * np.ndim(queries)))
+    op_specs = tuple(_op_partition_spec(a, cap, axis) for a in operands)
+    in_specs = [q_spec, op_specs, P(axis, None), P(axis),
+                P(axis, None, None, None), P(None, axis)]
+    args = [queries, operands, adjacency, present, upper_adj, upper_slots]
+    if seeds is not None:
+        in_specs.append(P(axis, None))
+        args.append(seeds)
+    if qeps is not None:
+        in_specs.append(P(None))
+        args.append(qeps)
+    if allow is not None:
+        in_specs.append(P(axis))
+        args.append(allow)
+    if not merge:
+        out_specs = (P(axis, None, None), P(axis, None, None))
+    elif track:
+        out_specs = (P(None, None),) * 4
+    else:
+        out_specs = (P(None, None), P(None, None))
+    fn = _shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                    out_specs=out_specs)
+    return fn(*args)
+
+
+# jit-cache-stable empty per-shard upper tables ([n, 0, 1, 1] + [0, cap])
+# for layer-0-only mesh walks; cached per (mesh, cap) so construction
+# never re-places them per dispatch
+_mesh_empty_upper_cache: dict = {}
+
+
+def _mesh_empty_upper(mesh, cap: int, axis: str = "shard"):
+    key = (mesh, cap)
+    out = _mesh_empty_upper_cache.get(key)
+    if out is None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = int(mesh.devices.size)
+        out = (
+            jax.device_put(
+                np.zeros((n, 0, 1, 1), np.int32),
+                NamedSharding(mesh, P(axis, None, None, None))),
+            jax.device_put(
+                np.zeros((0, cap), np.int32),
+                NamedSharding(mesh, P(None, axis))),
+        )
+        _mesh_empty_upper_cache[key] = out
+    return out
+
+
+def device_search_mesh(
+    scorer,
+    queries,
+    operands,
+    adjacency,
+    present,
+    mesh,
+    ef: int,
+    max_steps: int,
+    fetch: int,
+    seeds=None,
+    qeps=None,
+    upper_adj=None,
+    upper_slots=None,
+    allow=None,
+    keep_k: int = 0,
+    merge: bool = True,
+    axis: str = "shard",
+):
+    """Dispatch ONE fused SPMD walk spanning every mesh shard (per-shard
+    descent + beam + on-device cross-shard merge). Exactly one of
+    ``seeds`` (serving: per-shard entrypoint table) / ``qeps``
+    (construction: per-query global entrypoints, unmerged output) must
+    be given. Increments the module dispatch counter — the same hook
+    behind the single-chip one-dispatch-per-batch contract."""
+    global _dispatch_count
+    if (seeds is None) == (qeps is None):
+        raise ValueError("exactly one of seeds/qeps must be provided")
+    if upper_adj is None or upper_adj.shape[1] == 0:
+        upper_adj, upper_slots = _mesh_empty_upper(
+            mesh, adjacency.shape[0], axis)
+    _dispatch_count += 1
+    from weaviate_tpu.monitoring.metrics import MESH_BEAM_DISPATCH
+
+    MESH_BEAM_DISPATCH.inc(mode="search" if merge else "construction")
+    if merge:
+        # the cross-shard merge is a collective: dispatches must enqueue
+        # on every device in one total order or two concurrent programs
+        # deadlock at the all_gather rendezvous (see
+        # parallel.sharded_search.mesh_dispatch_lock)
+        from weaviate_tpu.parallel.sharded_search import mesh_dispatch_lock
+
+        with mesh_dispatch_lock():
+            return _fused_mesh_search(
+                scorer, queries, operands, adjacency, present, upper_adj,
+                upper_slots, ef=ef, max_steps=max_steps, fetch=fetch,
+                mesh=mesh, axis=axis, merge=merge, seeds=seeds, qeps=qeps,
+                allow=allow, keep_k=keep_k)
+    # merge=False (construction) has no cross-device rendezvous — the
+    # per-shard walks are independent programs and cannot invert
+    return _fused_mesh_search(
+        scorer, queries, operands, adjacency, present, upper_adj,
+        upper_slots, ef=ef, max_steps=max_steps, fetch=fetch, mesh=mesh,
+        axis=axis, merge=merge, seeds=seeds, qeps=qeps, allow=allow,
+        keep_k=keep_k)
+
+
 # jit-cache-stable empty upper tables for layer-0-only walks (the shapes
 # participate in the compile key, so they must never vary)
 _NO_UPPER_ADJ = None
@@ -445,19 +775,11 @@ class DeviceAdjacency:
             self._upper = _empty_upper()
         else:
             # searches read the level dicts lock-free while inserts grow
-            # them (same torn-read contract as the host walk): a dict
-            # resizing mid-iteration raises RuntimeError, so snapshot the
-            # items with a short retry — MUST NOT propagate, or the
-            # caller's blanket fallback would latch the beam off over a
-            # transient race. Index 0 = TOP level (the descent order).
-            snap = None
-            for _ in range(8):
-                try:
-                    snap = [list(g.upper.get(lv, {}).items())
-                            for lv in range(levels, 0, -1)]
-                    break
-                except RuntimeError:  # resized under us; re-read
-                    continue
+            # them (same torn-read contract as the host walk); _snap_upper
+            # owns the retry — a transient resize MUST NOT propagate, or
+            # the caller's blanket fallback would latch the beam off.
+            # Index 0 = TOP level (the descent order).
+            snap = _snap_upper(g, levels)
             if snap is None:
                 # pathological churn: serve the previous tables (stale
                 # topology is valid — the walk just sees older edges) or
@@ -482,3 +804,305 @@ class DeviceAdjacency:
         self._upper_version = ver
         self._upper_cap = cap
         return self._upper
+
+
+def _snap_upper(g, levels: int):
+    """Lock-free snapshot of the upper-level dicts, top level first, with
+    the same short RuntimeError retry the single-chip mirror uses (a
+    dict resizing under a concurrent insert MUST NOT latch the beam
+    off). None = pathological churn; caller serves stale tables."""
+    for _ in range(8):
+        try:
+            return [list(g.upper.get(lv, {}).items())
+                    for lv in range(levels, 0, -1)]
+        except RuntimeError:  # resized under us; re-read
+            continue
+    return None
+
+
+# per-mesh jitted mirror scatters with pinned out-shardings (dirty-row
+# sync must stay distributed, never gather the adjacency to one device)
+_mesh_adj_fns_cache: dict = {}
+
+
+def _mesh_adj_fns(mesh):
+    fns = _mesh_adj_fns_cache.get(mesh)
+    if fns is None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+        row = NamedSharding(mesh, P(SHARD_AXIS, None))
+        flat = NamedSharding(mesh, P(SHARD_AXIS))
+        fns = (
+            row, flat,
+            # graftlint: allow[jit-in-loop] reason=compiled once per mesh via _mesh_adj_fns_cache
+            jax.jit(lambda a, i, r: a.at[i].set(r), out_shardings=row),
+            # graftlint: allow[jit-in-loop] reason=compiled once per mesh via _mesh_adj_fns_cache
+            jax.jit(lambda a, i, v: a.at[i].set(v), out_shardings=flat),
+        )
+        _mesh_adj_fns_cache[mesh] = fns
+    return fns
+
+
+class MeshDeviceAdjacency:
+    """Mesh twin of :class:`DeviceAdjacency`: the shard-local subgraph
+    topology mirrored across the mesh, plus the per-shard entrypoint
+    seed table the fused SPMD walk starts from.
+
+    Membership is the store's row-block layout: ``shard(id) = id // L``
+    with ``L = plane_capacity / n_shards`` (``cap_fn`` reports the
+    backend's device-plane capacity — the raw corpus or the quantized
+    code planes — so adjacency rows shard EXACTLY like the arrays the
+    scorer gathers). Mesh construction links nodes only within their
+    shard, so adjacency content is stored as LOCAL indices and each
+    device's block is self-contained. Growth multiplies capacity by an
+    integer factor (store contract), which only COARSENS membership —
+    on a capacity move the mirror rebuilds wholesale, regroups the seed
+    lists (previously separate shards merge, leaving multiple seed
+    components per shard — all of them stay seeds), and bumps ``epoch``
+    so the dispatcher never coalesces requests across the move."""
+
+    MAX_SEEDS = 8
+
+    def __init__(self, graph, mesh, cap_fn):
+        from weaviate_tpu.parallel.mesh import mesh_size
+
+        self.graph = graph
+        self.mesh = mesh
+        self.n = mesh_size(mesh)
+        self.cap_fn = cap_fn
+        self.epoch = 0
+        self._adj = None
+        self._present = None
+        self._synced_cap = 0
+        self._dirty: set[int] = set()
+        self._upper = None
+        self._upper_version = -1
+        self._upper_cap = 0
+        self._seed_lists: list[list[int]] = [[] for _ in range(self.n)]
+        self._seeds_dev = None
+        self._seeds_key = None
+        self._seeds_version = 0
+
+    # -- membership -------------------------------------------------------
+    def capacity(self) -> int:
+        return int(self.cap_fn())
+
+    def rows_per_shard(self) -> int:
+        return self.capacity() // self.n
+
+    def shard_of(self, ids):
+        from weaviate_tpu.parallel.mesh import shard_of
+
+        return shard_of(ids, self.capacity(), self.n)
+
+    # -- seeds ------------------------------------------------------------
+    def add_seed(self, node: int) -> None:
+        lst = self._seed_lists[int(node) // self.rows_per_shard()]
+        if node not in lst:
+            lst.append(int(node))
+            del lst[self.MAX_SEEDS:]
+            self._seeds_version += 1
+
+    def has_seed(self, shard: int) -> bool:
+        return bool(self._seed_lists[shard])
+
+    def primary_seed(self, shard: int) -> int:
+        """The shard's highest-level present seed (construction descends
+        from it; its level IS the shard's max walkable level), -1 when
+        the shard is empty."""
+        g = self.graph
+        best, best_lv = -1, -1
+        for x in self._seed_lists[shard]:
+            if x < g.capacity and g.levels[x] >= 0:
+                lv = int(g.levels[x])
+                if lv > best_lv:
+                    best, best_lv = x, lv
+        return best
+
+    def _regroup_seeds(self, rows_per_shard: int) -> None:
+        flat = [x for lst in self._seed_lists for x in lst]
+        self._seed_lists = [[] for _ in range(self.n)]
+        for x in flat:
+            lst = self._seed_lists[x // rows_per_shard]
+            if x not in lst:
+                lst.append(x)
+        for lst in self._seed_lists:
+            del lst[self.MAX_SEEDS:]
+        self._seeds_version += 1
+
+    def refresh_seeds(self) -> None:
+        """Drop hard-removed seeds and re-elect for shards left seedless
+        (tombstone cleanup can physically remove a seed node)."""
+        g = self.graph
+        cap = self.capacity()
+        rows = self.rows_per_shard()
+        gc = min(g.capacity, cap)
+        changed = False
+        for s, lst in enumerate(self._seed_lists):
+            keep = [x for x in lst if x < g.capacity and g.levels[x] >= 0]
+            if len(keep) != len(lst):
+                self._seed_lists[s] = keep
+                changed = True
+        present = np.nonzero(g.levels[:gc] >= 0)[0]
+        if len(present):
+            by_shard = present // rows
+            for s in np.unique(by_shard):
+                if not self._seed_lists[int(s)]:
+                    members = present[by_shard == s]
+                    top = members[np.argmax(g.levels[members])]
+                    self._seed_lists[int(s)].append(int(top))
+                    changed = True
+        if changed:
+            self._seeds_version += 1
+
+    def sync_seeds(self):
+        """→ [n, E] int32 device table (sharded on the shard axis) of
+        LOCAL seed indices, -1 padded; E pow2-padded so seed-list growth
+        reuses compiles."""
+        cap = self._synced_cap or self.capacity()
+        rows = cap // self.n
+        key = (self._seeds_version, cap)
+        if self._seeds_dev is not None and self._seeds_key == key:
+            return self._seeds_dev
+        longest = max(1, max(len(lst) for lst in self._seed_lists))
+        e_pad = 1 << (longest - 1).bit_length()
+        arr = np.full((self.n, e_pad), -1, np.int32)
+        for s, lst in enumerate(self._seed_lists):
+            vals = [x % rows for x in lst if x < cap]
+            arr[s, :len(vals)] = vals
+        row_sh, _flat, _sr, _sf = _mesh_adj_fns(self.mesh)
+        self._seeds_dev = jax.device_put(arr, row_sh)
+        self._seeds_key = key
+        return self._seeds_dev
+
+    # -- residency (tiering warm tier) ------------------------------------
+    def mark_dirty(self, *node_ids) -> None:
+        self._dirty.update(int(x) for x in node_ids)
+
+    def drop_device(self) -> int:
+        """Release every shard's mirrored slice from HBM; the next sync
+        re-uploads wholesale at identical shapes (promotion costs one
+        sharded upload, zero recompiles)."""
+        freed = self.nbytes
+        self._adj = None
+        self._present = None
+        self._synced_cap = 0
+        self._dirty.clear()
+        self._upper = None
+        self._upper_version = -1
+        self._seeds_dev = None
+        self._seeds_key = None
+        return freed
+
+    @property
+    def nbytes(self) -> int:
+        total = 0
+        for a in (self._adj, self._present, self._seeds_dev):
+            if a is not None:
+                total += a.nbytes
+        if self._upper is not None:
+            total += sum(a.nbytes for a in self._upper)
+        return total
+
+    # -- sync -------------------------------------------------------------
+    def sync(self):
+        """→ (adjacency, present) sharded device arrays, up to date.
+        Content is LOCAL neighbor indices (edges are intra-shard by
+        construction, so ``global % L`` is exact)."""
+        g = self.graph
+        cap = self.capacity()
+        rows = cap // self.n
+        row_sh, flat_sh, scatter_rows, scatter_flat = _mesh_adj_fns(self.mesh)
+        if self._adj is None or self._synced_cap != cap:
+            if self._synced_cap and self._synced_cap != cap:
+                # membership coarsened (integer-factor growth): regroup
+                # the seed lists and fence the dispatcher epoch
+                self._regroup_seeds(rows)
+                self.epoch += 1
+            gc = min(g.capacity, cap)
+            adj = np.full((cap, g.m0), -1, np.int32)
+            src = g.layer0[:gc]
+            adj[:gc] = np.where(src >= 0, src % rows, -1)
+            pres = np.zeros(cap, bool)
+            pres[:gc] = g.levels[:gc] >= 0
+            self._adj = jax.device_put(adj, row_sh)
+            self._present = jax.device_put(pres, flat_sh)
+            self._synced_cap = cap
+            self._dirty.clear()
+            self._update_shard_gauges(pres, rows)
+            return self._adj, self._present
+        if self._dirty:
+            dirty, self._dirty = self._dirty, set()
+            idx = np.fromiter(
+                (i for i in dirty if i < min(cap, g.capacity)), np.int32)
+            if len(idx):
+                src = g.layer0[idx]
+                local = np.where(src >= 0, src % rows, -1).astype(np.int32)
+                jidx = jnp.asarray(idx)
+                self._adj = scatter_rows(self._adj, jidx, jnp.asarray(local))
+                self._present = scatter_flat(
+                    self._present, jidx, jnp.asarray(g.levels[idx] >= 0))
+        return self._adj, self._present
+
+    def sync_upper(self):
+        """→ per-shard compact upper tables: ([n, Lv, S, M] adjacency
+        sharded on the shard axis, content LOCAL; [Lv, cap] node→slot
+        sharded on the node axis). Rebuilt wholesale when the host
+        graph's upper_version (or capacity) moves."""
+        g = self.graph
+        ver = getattr(g, "upper_version", 0)
+        cap = self._synced_cap or self.capacity()
+        if (self._upper is not None and self._upper_version == ver
+                and self._upper_cap == cap):
+            return self._upper
+        rows = cap // self.n
+        levels = max(0, int(g.max_level))
+        if levels == 0:
+            self._upper = _mesh_empty_upper(self.mesh, cap)
+        else:
+            snap = _snap_upper(g, levels)
+            if snap is None:
+                # pathological churn: serve the previous tables (stale
+                # topology is valid) or start at layer 0; version stays
+                # unmoved so the next search retries the rebuild
+                return self._upper if self._upper is not None \
+                    else _mesh_empty_upper(self.mesh, cap)
+            per: list[list[list]] = [
+                [[] for _ in range(self.n)] for _ in range(levels)]
+            for li, items in enumerate(snap):
+                for node, nbrs in items:
+                    if node >= cap:
+                        continue  # torn read mid-grow; next sync catches up
+                    per[li][node // rows].append((node, nbrs))
+            smax = max(
+                (len(pl) for lvl in per for pl in lvl), default=1)
+            s_pad = 1 << max(3, (max(1, smax) - 1).bit_length())
+            adj = np.full((self.n, levels, s_pad, g.m), -1, np.int32)
+            slots = np.full((levels, cap), -1, np.int32)
+            for li in range(levels):
+                for s in range(self.n):
+                    for slot, (node, nbrs) in enumerate(per[li][s]):
+                        slots[li, node] = slot
+                        nb = np.asarray(nbrs[:g.m], np.int64)
+                        if len(nb):
+                            adj[s, li, slot, :len(nb)] = nb % rows
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+            self._upper = (
+                jax.device_put(adj, NamedSharding(
+                    self.mesh, P(SHARD_AXIS, None, None, None))),
+                jax.device_put(slots, NamedSharding(
+                    self.mesh, P(None, SHARD_AXIS))),
+            )
+        self._upper_version = ver
+        self._upper_cap = cap
+        return self._upper
+
+    def _update_shard_gauges(self, present: np.ndarray, rows: int) -> None:
+        from weaviate_tpu.monitoring.metrics import set_mesh_shard_gauges
+
+        set_mesh_shard_gauges(present.reshape(self.n, rows).sum(axis=1))

@@ -47,6 +47,28 @@ def merge_candidate_stack(
     return -neg, jnp.take_along_axis(cand_i, sel, axis=1)
 
 
+def merge_across_shards(
+    vals: jnp.ndarray,
+    ids: jnp.ndarray,
+    k: int,
+    axis: str,
+    mask_value: float = 1e30,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Cross-shard top-k merge INSIDE a shard_map body: every shard
+    contributes its local [B, k'] candidates (global ids, ascending
+    values), a tiled ``all_gather`` over ICI assembles [B, n_shards*k'],
+    and one ``top_k`` yields the replicated global winners — no
+    per-shard candidate list ever round-trips to the host. Slots at or
+    past ``mask_value`` come back as id -1.
+    """
+    d_all = jax.lax.all_gather(vals, axis, axis=1, tiled=True)
+    i_all = jax.lax.all_gather(ids, axis, axis=1, tiled=True)
+    neg, sel = jax.lax.top_k(-d_all, k)
+    out_vals = -neg
+    out_ids = jnp.take_along_axis(i_all, sel, axis=1)
+    return out_vals, jnp.where(out_vals >= mask_value, -1, out_ids)
+
+
 def masked_topk(
     dists: jnp.ndarray,
     k: int,

@@ -1,4 +1,4 @@
-from weaviate_tpu.parallel.mesh import make_mesh, SHARD_AXIS
+from weaviate_tpu.parallel.mesh import make_mesh, mesh_size, shard_of, SHARD_AXIS
 from weaviate_tpu.parallel.runtime import default_mesh, set_mesh
 from weaviate_tpu.parallel.sharded_search import (
     sharded_flat_search,
@@ -7,10 +7,14 @@ from weaviate_tpu.parallel.sharded_search import (
     distributed_step,
     shard_corpus,
     replicate,
+    replicate_cached,
+    replicated_upload_count,
 )
 
 __all__ = [
     "make_mesh",
+    "mesh_size",
+    "shard_of",
     "SHARD_AXIS",
     "default_mesh",
     "set_mesh",
@@ -20,4 +24,6 @@ __all__ = [
     "distributed_step",
     "shard_corpus",
     "replicate",
+    "replicate_cached",
+    "replicated_upload_count",
 ]

@@ -21,6 +21,21 @@ from jax.sharding import Mesh
 SHARD_AXIS = "shard"
 
 
+def mesh_size(mesh: Mesh) -> int:
+    """Device count along all mesh axes (the shard count)."""
+    return int(np.prod(mesh.devices.shape))
+
+
+def shard_of(ids, capacity: int, n_shards: int):
+    """Block-shard membership for row ids under the store's row-block
+    layout: shard s owns rows [s*L, (s+1)*L) with L = capacity //
+    n_shards. Growth in mesh mode multiplies capacity by an integer
+    factor (see DeviceVectorStore.ensure_capacity), so membership only
+    ever COARSENS — an intra-shard graph edge stays intra-shard across
+    every grow."""
+    return np.asarray(ids) // (capacity // n_shards)
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = SHARD_AXIS) -> Mesh:
     """Build a 1-D mesh over ``n_devices`` devices.
 

@@ -10,6 +10,7 @@ merges — the whole round trip rides ICI inside a single jit.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional
 
 import jax
@@ -19,6 +20,25 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from weaviate_tpu.ops.distance import MASK_DISTANCE, pairwise_distance
 from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+# Collective-bearing SPMD programs (all_gather/psum/pmin rendezvous) must
+# enqueue on every device in ONE total order: two programs dispatched
+# concurrently from different Python threads can interleave their
+# per-device enqueues in opposite orders and deadlock at the rendezvous
+# (each device executes its queue in order, so device 0 waits inside
+# program A for device 1, which is stuck inside program B waiting for
+# device 0 — observed on the CPU backend's collective_ops rendezvous,
+# and the same inversion exists on any backend). Every dispatch wrapper
+# below takes this lock for exactly the enqueue; programs WITHOUT
+# cross-device rendezvous (per-shard construction walks, sharded
+# scatters, transfers) cannot invert and stay lock-free.
+_DISPATCH_LOCK = threading.Lock()
+
+
+def mesh_dispatch_lock() -> threading.Lock:
+    """The process-wide collective-dispatch order lock (see module note);
+    ops/device_beam.device_search_mesh serializes its merged walks on it."""
+    return _DISPATCH_LOCK
 
 try:  # jax >= 0.6: stable API, replication check renamed to check_vma
     from jax import shard_map as _shard_map_impl
@@ -55,6 +75,59 @@ def replicate(x, mesh: Mesh):
     """
     spec = P(*([None] * np.ndim(x)))
     return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+class _ReplicatedCache:
+    """Replicated-query placements keyed on SOURCE IDENTITY, so per-hop
+    callers (``sharded_gather_distance`` runs once per beam hop with the
+    same query batch; ``sharded_maxsim`` once per rescore pass) upload
+    the replicated form once per query batch instead of once per
+    invocation — the same upload-once-per-fit discipline PQ codebooks
+    follow. Entries hold a strong reference to the source array, which
+    pins its ``id()`` for the lifetime of the entry (no stale-id reuse);
+    a small LRU bound keeps the pin from becoming a leak."""
+
+    def __init__(self, maxlen: int = 16):
+        import collections
+        import threading
+
+        self._entries = collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._maxlen = maxlen
+        self.uploads = 0  # test hook: device placements actually paid
+
+    def get(self, x, mesh: Mesh):
+        key = (id(x), np.shape(x), str(getattr(x, "dtype", "")), mesh)
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None and hit[0] is x:
+                self._entries.move_to_end(key)
+                return hit[1]
+        rep = replicate(x, mesh)
+        with self._lock:
+            self.uploads += 1
+            self._entries[key] = (x, rep)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._maxlen:
+                self._entries.popitem(last=False)
+        return rep
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+
+_REPLICATED = _ReplicatedCache()
+
+
+def replicate_cached(x, mesh: Mesh):
+    """``replicate`` with an identity-keyed cache (see _ReplicatedCache)."""
+    return _REPLICATED.get(x, mesh)
+
+
+def replicated_upload_count() -> int:
+    """Test hook: replicated placements actually uploaded (cache misses)."""
+    return _REPLICATED.uploads
 
 
 def _local_topk(c_local, v_local, queries, k, metric, precision, sq_local,
@@ -129,7 +202,7 @@ def _local_search(c_local, v_local, queries, k, metric, axis, precision,
     static_argnames=("k", "metric", "mesh", "axis", "precision",
                      "chunk_size", "approx_recall"),
 )
-def sharded_flat_search(
+def _sharded_flat_search_jit(
     corpus: jnp.ndarray,
     valid: jnp.ndarray,
     queries: jnp.ndarray,
@@ -173,6 +246,29 @@ def sharded_flat_search(
     return fn(corpus, valid, queries, sqnorms)
 
 
+def sharded_flat_search(
+    corpus: jnp.ndarray,
+    valid: jnp.ndarray,
+    queries: jnp.ndarray,
+    k: int,
+    metric: str = "l2-squared",
+    mesh: Optional[Mesh] = None,
+    axis: str = SHARD_AXIS,
+    precision: str = "bf16",
+    sqnorms: Optional[jnp.ndarray] = None,
+    chunk_size: int = 0,
+    approx_recall: float = 0.0,
+):
+    """Public entry for the distributed exact top-k: the all_gather
+    merge makes this a collective program, so the dispatch takes the
+    process-wide order lock (see module note)."""
+    with _DISPATCH_LOCK:
+        return _sharded_flat_search_jit(
+            corpus, valid, queries, k, metric=metric, mesh=mesh, axis=axis,
+            precision=precision, sqnorms=sqnorms, chunk_size=chunk_size,
+            approx_recall=approx_recall)
+
+
 def mesh_flat_topk(store, queries: jnp.ndarray, k: int, metric: str,
                    allow=None, precision: str = "bf16",
                    chunk_size: int = 0, approx_recall: float = 0.0):
@@ -212,19 +308,13 @@ def _local_maxsim(q, toks_local, mask_local):
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))
-def sharded_maxsim(
+def _sharded_maxsim_jit(
     query: jnp.ndarray,        # [Tq, D] replicated
     cand_tokens: jnp.ndarray,  # [C, Tmax, D] sharded on C (pad C to mesh)
     cand_mask: jnp.ndarray,    # [C, Tmax] sharded on C
     mesh: Optional[Mesh] = None,
     axis: str = SHARD_AXIS,
 ) -> jnp.ndarray:
-    """Mesh-parallel exact late interaction: the token-level analogue of
-    sequence parallelism for the long-context tier. Candidate token sets
-    shard across the mesh on the candidate axis, every device computes
-    MaxSim for its slice as one einsum, and a tiled ``all_gather`` over
-    ICI reassembles the [C] score vector — the reference rescoring loop
-    (``hnsw/search.go:927``) turned into one SPMD program."""
     if mesh is None:
         return _local_maxsim(query, cand_tokens, cand_mask)
 
@@ -237,6 +327,32 @@ def sharded_maxsim(
         out_specs=P(axis), check=True,
     )
     return fn(query, cand_tokens, cand_mask)
+
+
+def sharded_maxsim(
+    query: jnp.ndarray,
+    cand_tokens: jnp.ndarray,
+    cand_mask: jnp.ndarray,
+    mesh: Optional[Mesh] = None,
+    axis: str = SHARD_AXIS,
+) -> jnp.ndarray:
+    """Mesh-parallel exact late interaction: the token-level analogue of
+    sequence parallelism for the long-context tier. Candidate token sets
+    shard across the mesh on the candidate axis, every device computes
+    MaxSim for its slice as one einsum, and a tiled ``all_gather`` over
+    ICI reassembles the [C] score vector — the reference rescoring loop
+    (``hnsw/search.go:927``) turned into one SPMD program.
+
+    The replicated query placement is cached on source identity
+    (``replicate_cached``): a rescore tier calling back with the same
+    query token batch pays the upload once, not per invocation."""
+    if mesh is None:
+        return _sharded_maxsim_jit(query, cand_tokens, cand_mask,
+                                   mesh=mesh, axis=axis)
+    query = replicate_cached(query, mesh)
+    with _DISPATCH_LOCK:
+        return _sharded_maxsim_jit(query, cand_tokens, cand_mask,
+                                   mesh=mesh, axis=axis)
 
 
 def _local_gather_dists(c_local, queries, cand_ids, metric, axis, precision):
@@ -257,6 +373,26 @@ def _local_gather_dists(c_local, queries, cand_ids, metric, axis, precision):
 @functools.partial(
     jax.jit, static_argnames=("metric", "mesh", "axis", "precision")
 )
+def _sharded_gather_distance_jit(
+    corpus: jnp.ndarray,
+    queries: jnp.ndarray,
+    candidate_ids: jnp.ndarray,
+    metric: str,
+    mesh: Optional[Mesh] = None,
+    axis: str = SHARD_AXIS,
+    precision: str = "fp32",
+):
+    fn = _shard_map(
+        functools.partial(
+            _local_gather_dists, metric=metric, axis=axis, precision=precision
+        ),
+        mesh=mesh,
+        in_specs=(P(axis, None), P(None, None), P(None, None)),
+        out_specs=P(None, None),
+    )
+    return fn(corpus, queries, candidate_ids)
+
+
 def sharded_gather_distance(
     corpus: jnp.ndarray,
     queries: jnp.ndarray,
@@ -268,16 +404,20 @@ def sharded_gather_distance(
 ):
     """Distributed HNSW frontier evaluation (reference hot loop
     ``hnsw/search.go:726``): corpus [N, D] row-sharded, queries [B, D] and
-    candidate_ids [B, C] replicated -> replicated distances [B, C]."""
-    fn = _shard_map(
-        functools.partial(
-            _local_gather_dists, metric=metric, axis=axis, precision=precision
-        ),
-        mesh=mesh,
-        in_specs=(P(axis, None), P(None, None), P(None, None)),
-        out_specs=P(None, None),
-    )
-    return fn(corpus, queries, candidate_ids)
+    candidate_ids [B, C] replicated -> replicated distances [B, C].
+
+    The host beam calls this once PER HOP with the same query batch, so
+    the replicated query placement is cached on source identity
+    (``replicate_cached``) — one upload per query batch, not per hop."""
+    if mesh is None:
+        return _sharded_gather_distance_jit(
+            corpus, queries, candidate_ids, metric,
+            mesh=mesh, axis=axis, precision=precision)
+    queries = replicate_cached(queries, mesh)
+    with _DISPATCH_LOCK:
+        return _sharded_gather_distance_jit(
+            corpus, queries, candidate_ids, metric,
+            mesh=mesh, axis=axis, precision=precision)
 
 
 def _local_take(c_local, ids, axis):
@@ -293,14 +433,12 @@ def _local_take(c_local, ids, axis):
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))
-def sharded_take(
+def _sharded_take_jit(
     corpus: jnp.ndarray,
     ids: jnp.ndarray,
     mesh: Optional[Mesh] = None,
     axis: str = SHARD_AXIS,
 ):
-    """Gather rows by global id from a row-sharded corpus -> replicated
-    [..., D] vectors (each id owned by exactly one device; psum-combine)."""
     fn = _shard_map(
         functools.partial(_local_take, axis=axis),
         mesh=mesh,
@@ -308,6 +446,19 @@ def sharded_take(
         out_specs=P(*([None] * (ids.ndim + 1))),
     )
     return fn(corpus, ids)
+
+
+def sharded_take(
+    corpus: jnp.ndarray,
+    ids: jnp.ndarray,
+    mesh: Optional[Mesh] = None,
+    axis: str = SHARD_AXIS,
+):
+    """Gather rows by global id from a row-sharded corpus -> replicated
+    [..., D] vectors (each id owned by exactly one device; psum-combine
+    — a collective, so the dispatch takes the order lock)."""
+    with _DISPATCH_LOCK:
+        return _sharded_take_jit(corpus, ids, mesh=mesh, axis=axis)
 
 
 def _local_step(c_local, v_local, ids, vecs, queries, k, metric, axis, precision):
@@ -339,7 +490,7 @@ def _local_step(c_local, v_local, ids, vecs, queries, k, metric, axis, precision
     static_argnames=("k", "metric", "mesh", "axis", "precision"),
     donate_argnums=(0, 1),
 )
-def distributed_step(
+def _distributed_step_jit(
     corpus: jnp.ndarray,
     valid: jnp.ndarray,
     new_ids: jnp.ndarray,
@@ -365,3 +516,24 @@ def distributed_step(
         out_specs=(P(axis, None), P(axis), P(None, None), P(None, None)),
     )
     return fn(corpus, valid, new_ids, new_vecs, queries)
+
+
+def distributed_step(
+    corpus: jnp.ndarray,
+    valid: jnp.ndarray,
+    new_ids: jnp.ndarray,
+    new_vecs: jnp.ndarray,
+    queries: jnp.ndarray,
+    k: int = 10,
+    metric: str = "l2-squared",
+    mesh: Optional[Mesh] = None,
+    axis: str = SHARD_AXIS,
+    precision: str = "bf16",
+):
+    """One full ingest+query step over the mesh (the driver's dry-run
+    target) — the embedded search's all_gather merge makes this a
+    collective program, so the dispatch takes the order lock."""
+    with _DISPATCH_LOCK:
+        return _distributed_step_jit(
+            corpus, valid, new_ids, new_vecs, queries, k=k, metric=metric,
+            mesh=mesh, axis=axis, precision=precision)
