@@ -1,0 +1,30 @@
+# Developer/CI entry points. Tier-1 itself is driven by ROADMAP.md's
+# pytest line; these targets cover the static-analysis side.
+
+.PHONY: lint lint-sarif lint-dot lint-fix-baseline test
+
+# Full graftlint: every per-file rule plus the interprocedural
+# concurrency pass (lock-order cycles, blocking-under-lock, unlocked
+# collective dispatch). The concurrency model is cached on source
+# mtimes (tools/graftlint/.concurrency_cache.json); per-phase wall time
+# is recorded in summary.timings of the JSON so tier-1 budget creep is
+# visible in CI artifacts.
+lint:
+	@python -m tools.graftlint weaviate_tpu/ --format json
+
+# SARIF 2.1.0 of the NEW violations — renders as code annotations in CI.
+lint-sarif:
+	@python -m tools.graftlint weaviate_tpu/ --format sarif
+
+# The whole-program lock-order graph (graphviz); cycle edges are red.
+# Recipes are @-silenced so the output pipes cleanly:
+#   make lint-dot | dot -Tsvg > lock-order.svg
+lint-dot:
+	@python -m tools.graftlint weaviate_tpu/ --format dot
+
+lint-fix-baseline:
+	python -m tools.graftlint weaviate_tpu/ --fix-baseline
+
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		-p no:cacheprovider

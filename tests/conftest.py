@@ -29,8 +29,45 @@ jax.config.update("jax_platforms", "cpu")
 # in via runtime.set_mesh).
 os.environ.setdefault("WEAVIATE_TPU_MESH", "off")
 
+# Lock-order witness (docs/lint.md "Concurrency contracts"): instrument
+# every lock weaviate_tpu creates so the whole tier-1 run doubles as a
+# dynamic validation of graftlint's static lock-order graph. The module
+# is boot-loaded by file path BEFORE any weaviate_tpu import so the
+# threading.Lock/RLock factories are already patched when module-level
+# locks (mesh _DISPATCH_LOCK, native._LOCK, ...) are born; registering
+# it in sys.modules keeps it the one shared instance for later package
+# imports. Knob: WEAVIATE_TPU_LOCK_WITNESS=off|record|strict (default
+# record — inversions fail the session at exit, see pytest_sessionfinish).
+import sys  # noqa: E402
+
+_WITNESS_MODE = os.environ.get("WEAVIATE_TPU_LOCK_WITNESS", "record")
+if _WITNESS_MODE not in ("off", "0", ""):
+    import importlib.util
+
+    _lw_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "weaviate_tpu", "utils", "lockwitness.py")
+    _spec = importlib.util.spec_from_file_location(
+        "weaviate_tpu.utils.lockwitness", os.path.abspath(_lw_path))
+    lockwitness = importlib.util.module_from_spec(_spec)
+    _spec.loader.exec_module(lockwitness)
+    sys.modules["weaviate_tpu.utils.lockwitness"] = lockwitness
+    lockwitness.install(strict=(_WITNESS_MODE == "strict"))
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Zero observed lock-order inversions is a tier-1 invariant: the
+    chaos, tiering, and mesh suites all ran with the witness on."""
+    lw = sys.modules.get("weaviate_tpu.utils.lockwitness")
+    if lw is None or not lw.installed():
+        return
+    w = lw.current()
+    print("\n" + w.report())
+    if w.inversions and exitstatus == 0:
+        session.exitstatus = 1
 
 
 @pytest.fixture

@@ -1172,3 +1172,662 @@ class TestCli:
         out = capsys.readouterr().out
         for rid in RULE_IDS:
             assert rid in out
+
+
+# ---------------------------------------------------------------------------
+# interprocedural concurrency pass (tools/graftlint/concurrency.py)
+
+
+from tools.graftlint import concurrency as conc  # noqa: E402
+
+
+def analyze(sources: dict):
+    return conc.analyze_sources({
+        rel: textwrap.dedent(src) for rel, src in sources.items()})
+
+
+class TestLockOrderCycle:
+    def test_two_lock_inversion_one_file(self):
+        res = run("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A:
+                    with B:
+                        pass
+
+            def g():
+                with B:
+                    with A:
+                        pass
+        """, rel=COLD)
+        assert "lock-order-cycle" in rule_ids(res)
+
+    def test_consistent_order_clean(self):
+        res = run("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A:
+                    with B:
+                        pass
+
+            def g():
+                with A:
+                    with B:
+                        pass
+        """, rel=COLD)
+        assert "lock-order-cycle" not in rule_ids(res)
+
+    def test_cycle_through_call_chain_cross_module(self):
+        m = analyze({
+            "weaviate_tpu/a.py": """
+                import threading
+                from weaviate_tpu.b import takes_b
+                A_LOCK = threading.Lock()
+
+                def f():
+                    with A_LOCK:
+                        takes_b()
+
+                def takes_a():
+                    with A_LOCK:
+                        pass
+            """,
+            "weaviate_tpu/b.py": """
+                import threading
+                from weaviate_tpu.a import takes_a
+                B_LOCK = threading.Lock()
+
+                def takes_b():
+                    with B_LOCK:
+                        pass
+
+                def g():
+                    with B_LOCK:
+                        takes_a()
+            """,
+        })
+        assert [v.rule for v in m.violations] == ["lock-order-cycle"]
+        assert set(m.edges) == {
+            ("weaviate_tpu.a.A_LOCK", "weaviate_tpu.b.B_LOCK"),
+            ("weaviate_tpu.b.B_LOCK", "weaviate_tpu.a.A_LOCK")}
+
+    def test_rlock_reentry_not_flagged(self):
+        res = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+        """, rel=COLD)
+        assert "lock-order-cycle" not in rule_ids(res)
+
+    def test_plain_lock_direct_nesting_is_self_deadlock(self):
+        res = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def f(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """, rel=COLD)
+        assert "lock-order-cycle" in rule_ids(res)
+        v = next(v for v in res.violations if v.rule == "lock-order-cycle")
+        assert "self-deadlock" in v.message
+
+    def test_plain_lock_call_reentry_of_module_global_flagged(self):
+        res = run("""
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def outer():
+                with _LOCK:
+                    inner()
+
+            def inner():
+                with _LOCK:
+                    pass
+        """, rel=COLD)
+        assert "lock-order-cycle" in rule_ids(res)
+
+    def test_instance_lock_call_reentry_not_flagged(self):
+        # two different instances may be involved: ambiguous, not flagged
+        res = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.peer = None
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+        """, rel=COLD)
+        assert "lock-order-cycle" not in rule_ids(res)
+
+    def test_condition_aliases_to_underlying_lock(self):
+        # Condition(self._lock) IS self._lock: cv -> _lock nesting is
+        # reentrancy on one RLock, not a two-lock cycle
+        res = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._cv = threading.Condition(self._lock)
+
+                def f(self):
+                    with self._cv:
+                        with self._lock:
+                            pass
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_cycle_suppressible_with_reason(self):
+        res = run("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A:
+                    # graftlint: allow[lock-order-cycle] reason=startup only, single thread
+                    with B:
+                        pass
+
+            def g():
+                with B:
+                    with A:
+                        pass
+        """, rel=COLD)
+        assert "lock-order-cycle" not in rule_ids(res)
+        assert any(v.rule == "lock-order-cycle" for v in res.suppressed)
+
+    def test_lock_getter_resolution(self):
+        # with lock_fn(): resolves through a module-level getter
+        res = run("""
+            import threading
+
+            _LOCK = threading.Lock()
+            OTHER = threading.Lock()
+
+            def the_lock():
+                return _LOCK
+
+            def f():
+                with the_lock():
+                    with OTHER:
+                        pass
+
+            def g():
+                with OTHER:
+                    with the_lock():
+                        pass
+        """, rel=COLD)
+        assert "lock-order-cycle" in rule_ids(res)
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock(self):
+        res = run("""
+            import threading
+            import time
+
+            _LOCK = threading.Lock()
+
+            def f():
+                with _LOCK:
+                    time.sleep(1.0)
+        """, rel=COLD)
+        assert "blocking-under-lock" in rule_ids(res)
+
+    def test_sleep_outside_lock_clean(self):
+        res = run("""
+            import threading
+            import time
+
+            _LOCK = threading.Lock()
+
+            def f():
+                with _LOCK:
+                    x = 1
+                time.sleep(1.0)
+        """, rel=COLD)
+        assert "blocking-under-lock" not in rule_ids(res)
+
+    def test_queue_get_under_lock(self):
+        res = run("""
+            import queue
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def f():
+                q = queue.Queue(maxsize=8)
+                with _LOCK:
+                    return q.get(timeout=1)
+        """, rel=COLD)
+        assert "blocking-under-lock" in rule_ids(res)
+
+    def test_dict_get_under_lock_clean(self):
+        res = run("""
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def f(d):
+                with _LOCK:
+                    return d.get("k", 0)
+        """, rel=COLD)
+        assert "blocking-under-lock" not in rule_ids(res)
+
+    def test_future_result_via_callee(self):
+        # interprocedural: the .result() is one call deep
+        res = run("""
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def waits(fut):
+                return fut.result()
+
+            def f(fut):
+                with _LOCK:
+                    return waits(fut)
+        """, rel=COLD)
+        assert "blocking-under-lock" in rule_ids(res)
+
+    def test_cv_wait_under_own_lock_clean(self):
+        # Condition.wait releases its own lock: the canonical pattern
+        res = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._cv = threading.Condition(self._lock)
+
+                def f(self):
+                    with self._cv:
+                        self._cv.wait(timeout=1)
+        """, rel=COLD)
+        assert "blocking-under-lock" not in rule_ids(res)
+
+    def test_wait_under_foreign_lock_flagged(self):
+        res = run("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._other_lock = threading.Lock()
+                    self._cv = threading.Condition(self._other_lock)
+
+                def f(self):
+                    with self._lock:
+                        with self._cv:
+                            self._cv.wait(timeout=1)
+        """, rel=COLD)
+        assert "blocking-under-lock" in rule_ids(res)
+
+    def test_device_dispatch_in_callee_under_lock(self):
+        res = run("""
+            import threading
+            import jax.numpy as jnp
+
+            _LOCK = threading.Lock()
+
+            def compute(x):
+                return jnp.sum(x)
+
+            def f(x):
+                with _LOCK:
+                    return compute(x)
+        """, rel=COLD)
+        assert "blocking-under-lock" in rule_ids(res)
+
+    def test_direct_dispatch_left_to_per_file_rule(self):
+        # depth-0 dispatch under a lock belongs to lock-across-device-call
+        res = run("""
+            import threading
+            import jax.numpy as jnp
+
+            _LOCK = threading.Lock()
+
+            def f(x):
+                with _LOCK:
+                    return jnp.sum(x)
+        """, rel=COLD)
+        ids = rule_ids(res)
+        assert "lock-across-device-call" in ids
+        assert "blocking-under-lock" not in ids
+
+    def test_stored_callback_attr_not_resolved_by_name(self):
+        # self.cb() where cb is a stored callable must not bind to some
+        # unrelated project function that happens to share the name
+        m = analyze({
+            "weaviate_tpu/a.py": """
+                import threading
+
+                class C:
+                    def __init__(self, cb):
+                        self._lock = threading.Lock()
+                        self.cb = cb
+
+                    def f(self):
+                        with self._lock:
+                            self.cb()
+            """,
+            "weaviate_tpu/b.py": """
+                def cb(fut):
+                    return fut.result()
+            """,
+        })
+        assert [v.rule for v in m.violations] == []
+
+
+class TestUnlockedCollectiveDispatch:
+    MESH_SRC = """
+        import threading
+
+        _DISPATCH_LOCK = threading.Lock()
+
+        def mesh_dispatch_lock():
+            return _DISPATCH_LOCK
+    """
+
+    def test_jitted_collective_called_unlocked(self):
+        m = analyze({
+            "weaviate_tpu/parallel/sharded_search.py": self.MESH_SRC,
+            "weaviate_tpu/parallel/fanout.py": """
+                import functools
+                import jax
+                from jax import lax
+
+                @functools.partial(jax.jit, static_argnames=("k",))
+                def _merged(x, k):
+                    return lax.all_gather(x, "shard")
+
+                def search(x):
+                    return _merged(x, 4)
+            """,
+        })
+        assert [v.rule for v in m.violations] == \
+            ["unlocked-collective-dispatch"]
+
+    def test_locked_dispatch_clean(self):
+        m = analyze({
+            "weaviate_tpu/parallel/sharded_search.py": self.MESH_SRC,
+            "weaviate_tpu/parallel/fanout.py": """
+                import functools
+                import jax
+                from jax import lax
+                from weaviate_tpu.parallel.sharded_search import (
+                    mesh_dispatch_lock,
+                )
+
+                @functools.partial(jax.jit, static_argnames=("k",))
+                def _merged(x, k):
+                    return lax.all_gather(x, "shard")
+
+                def search(x):
+                    with mesh_dispatch_lock():
+                        return _merged(x, 4)
+            """,
+        })
+        assert [v.rule for v in m.violations] == []
+
+    def test_all_callers_locked_clean(self):
+        # the dispatch site itself is bare, but every caller holds the
+        # lock: reverse reachability proves it safe
+        m = analyze({
+            "weaviate_tpu/parallel/sharded_search.py": self.MESH_SRC,
+            "weaviate_tpu/parallel/fanout.py": """
+                import functools
+                import jax
+                from jax import lax
+                from weaviate_tpu.parallel.sharded_search import (
+                    mesh_dispatch_lock,
+                )
+
+                @functools.partial(jax.jit, static_argnames=("k",))
+                def _merged(x, k):
+                    return lax.all_gather(x, "shard")
+
+                def _inner(x):
+                    return _merged(x, 4)
+
+                def search(x):
+                    with mesh_dispatch_lock():
+                        return _inner(x)
+            """,
+        })
+        assert [v.rule for v in m.violations] == []
+
+    def test_non_collective_jit_clean(self):
+        m = analyze({
+            "weaviate_tpu/parallel/sharded_search.py": self.MESH_SRC,
+            "weaviate_tpu/parallel/fanout.py": """
+                import jax
+
+                @jax.jit
+                def _plain(x):
+                    return x * 2
+
+                def search(x):
+                    return _plain(x)
+            """,
+        })
+        assert [v.rule for v in m.violations] == []
+
+    def test_seeded_mesh_lock_inversion_caught_static(self):
+        """The acceptance seed: a caller that takes its own lock before
+        the collective wrapper (which internally takes the mesh lock),
+        while another path takes them in the opposite order — the cycle
+        includes the real _DISPATCH_LOCK id. Analyzed against the REAL
+        sharded_search.py source."""
+        real = Path("weaviate_tpu/parallel/sharded_search.py")
+        root = Path(__file__).resolve().parent.parent
+        sources = {
+            "weaviate_tpu/parallel/sharded_search.py":
+                (root / real).read_text(encoding="utf-8"),
+            "weaviate_tpu/evil.py": textwrap.dedent("""
+                import threading
+                from weaviate_tpu.parallel.sharded_search import (
+                    mesh_dispatch_lock,
+                    sharded_flat_search,
+                )
+
+                MY_LOCK = threading.Lock()
+
+                def bad_search(c, v, q, mesh):
+                    with MY_LOCK:
+                        return sharded_flat_search(c, v, q, 10, "l2", mesh)
+
+                def bad_admin():
+                    with mesh_dispatch_lock():
+                        with MY_LOCK:
+                            pass
+            """),
+        }
+        m = conc.analyze_sources(sources)
+        cycles = [v for v in m.violations if v.rule == "lock-order-cycle"]
+        assert cycles, "seeded mesh-lock inversion must be caught"
+        assert any(conc.MESH_LOCK_ID in v.message for v in cycles)
+
+
+class TestLockwitnessInKernel:
+    def test_import_in_ops_flagged(self):
+        res = run("""
+            from weaviate_tpu.utils import lockwitness
+
+            def f():
+                return lockwitness.current()
+        """, rel=KERNEL)
+        assert "lockwitness-in-kernel" in rule_ids(res)
+
+    def test_reference_in_jitted_function_flagged(self):
+        res = run("""
+            import jax
+            from weaviate_tpu.utils import lockwitness
+
+            @jax.jit
+            def f(x):
+                lockwitness.current()
+                return x
+        """, rel=COLD)
+        assert "lockwitness-in-kernel" in rule_ids(res)
+
+    def test_host_side_use_clean(self):
+        res = run("""
+            from weaviate_tpu.utils import lockwitness
+
+            def f():
+                return lockwitness.current()
+        """, rel=COLD)
+        assert "lockwitness-in-kernel" not in rule_ids(res)
+
+
+class TestConcurrencyEngineIntegration:
+    def test_concurrency_suppression_counts_as_used(self):
+        # an allow-comment consumed by a whole-program finding must not
+        # be reported as unused-suppression
+        res = run("""
+            import threading
+            import time
+
+            _LOCK = threading.Lock()
+
+            def f():
+                with _LOCK:
+                    # graftlint: allow[blocking-under-lock] reason=boot path, single-threaded
+                    time.sleep(0.1)
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_select_excludes_concurrency(self):
+        res = run("""
+            import threading
+            import time
+
+            _LOCK = threading.Lock()
+
+            def f():
+                with _LOCK:
+                    time.sleep(0.1)
+        """, rel=COLD, rules=["swallowed-exception"])
+        assert rule_ids(res) == []
+
+    def test_mtime_cache_cold_then_warm(self, tmp_path):
+        src = textwrap.dedent("""
+            import threading
+            import time
+
+            _LOCK = threading.Lock()
+
+            def f():
+                with _LOCK:
+                    time.sleep(0.1)
+        """)
+        f = tmp_path / "mod.py"
+        f.write_text(src)
+        from tools.graftlint.engine import FileContext
+        cache = tmp_path / "cache.json"
+
+        def once():
+            st = f.stat()
+            return conc.check_contexts(
+                {"weaviate_tpu/mod.py": FileContext(
+                    src, "weaviate_tpu/mod.py")},
+                {"weaviate_tpu/mod.py": (st.st_mtime_ns, st.st_size)},
+                cache_path=cache)
+
+        m1 = once()
+        assert m1.cache_state == "cold"
+        assert [v.rule for v in m1.violations] == ["blocking-under-lock"]
+        m2 = once()
+        assert m2.cache_state == "warm"
+        assert [v.to_dict() for v in m2.violations] == \
+            [v.to_dict() for v in m1.violations]
+        assert set(m2.edges) == set(m1.edges)
+        import os as _os
+        _os.utime(f, ns=(f.stat().st_atime_ns, f.stat().st_mtime_ns + 7))
+        m3 = once()
+        assert m3.cache_state == "cold"
+
+    def test_sarif_output(self, tmp_path, capsys):
+        (tmp_path / "bad.py").write_text(textwrap.dedent("""
+            try:
+                x = 1
+            except Exception:
+                pass
+        """))
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "b.json"),
+                       "--format", "sarif"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        results = doc["runs"][0]["results"]
+        assert any(r["ruleId"] == "swallowed-exception" for r in results)
+        loc = results[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "bad.py"
+        assert loc["region"]["startLine"] >= 1
+
+    def test_dot_output(self, tmp_path, capsys):
+        (tmp_path / "locks.py").write_text(textwrap.dedent("""
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A:
+                    with B:
+                        pass
+        """))
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "b.json"),
+                       "--format", "dot"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "digraph lock_order" in out
+        assert '"locks.A" -> "locks.B"' in out
+
+    def test_json_records_concurrency_walltime_and_cache(
+            self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "b.json"),
+                       "--format", "json", "--no-concurrency-cache"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "concurrency_s" in doc["summary"]["timings"]
+        assert "total_s" in doc["summary"]["timings"]
+        assert doc["summary"]["concurrency_cache"] == "off"

@@ -13,7 +13,12 @@ from typing import List, Optional
 
 from tools.graftlint import baseline as baseline_mod
 from tools.graftlint.engine import lint_paths
-from tools.graftlint.report import render_json, render_text, summary_line
+from tools.graftlint.report import (
+    render_json,
+    render_sarif,
+    render_text,
+    summary_line,
+)
 from tools.graftlint.rules import ALL_RULES, RULE_IDS
 
 
@@ -25,7 +30,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories to lint (default: the "
                         "repo's weaviate_tpu/, from any cwd)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif", "dot"),
+                   default="text",
+                   help="text/json: ratcheted report; sarif: SARIF 2.1.0 "
+                        "of the NEW violations (CI code annotations); "
+                        "dot: the interprocedural lock-order graph "
+                        "(graphviz)")
+    p.add_argument("--no-concurrency-cache", action="store_true",
+                   help="recompute the interprocedural concurrency model "
+                        "even when source mtimes match the cache")
     p.add_argument("--baseline", type=Path,
                    default=baseline_mod.DEFAULT_BASELINE,
                    help="baseline file (default: tools/graftlint/"
@@ -91,7 +104,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                       file=sys.stderr)
                 return 2
 
-    result = lint_paths(args.paths, root=args.root, rules=select)
+    result = lint_paths(args.paths, root=args.root, rules=select,
+                        concurrency_cache=not args.no_concurrency_cache)
+
+    if args.format == "dot":
+        if result.concurrency is None:
+            print("graftlint: --format dot needs the concurrency pass "
+                  "(do not --select it away)", file=sys.stderr)
+            return 2
+        print(result.concurrency.to_dot())
+        return 0
 
     if args.fix_baseline:
         n = baseline_mod.write(args.baseline, result.violations)
@@ -111,8 +133,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         new, baselined, stale = baseline_mod.match(result.violations, budget)
 
     if args.format == "json":
+        cache_state = (result.concurrency.cache_state
+                       if result.concurrency is not None else None)
         print(render_json(new, baselined, stale, len(result.suppressed),
-                          result.files_checked))
+                          result.files_checked, timings=result.timings,
+                          concurrency_cache=cache_state))
+    elif args.format == "sarif":
+        print(render_sarif(new, result.files_checked,
+                           rules_meta=ALL_RULES))
     else:
         print(render_text(new, baselined, stale, len(result.suppressed),
                           result.files_checked, verbose=args.verbose))

@@ -1,0 +1,1264 @@
+"""Interprocedural concurrency contract checker.
+
+PR 7 found a real process deadlock (two threads interleaving per-device
+enqueues of collective SPMD programs) only by reproducing it live, and
+fixed it with a convention — the process-wide ``mesh_dispatch_lock`` —
+that nothing enforced. Meanwhile the tree has grown 35+ locks across
+``cluster/``, ``serving/``, ``tiering/`` and ``storage/`` with no tool
+that can see an ordering cycle. This module is that tool: a
+whole-program pass (the rest of graftlint is per-file) that builds
+
+1. a **lock model** — every ``threading.Lock/RLock/Condition`` attribute,
+   module global, and function local, identified by owner (module, class,
+   name). ``Condition(self._lock)`` aliases to the underlying lock;
+   RLock/Condition are reentrant, Lock is not.
+2. a **call graph** — module-level functions, methods and nested defs,
+   with calls resolved through each file's import table, ``self.``
+   dispatch, class instantiation, and (capped, last-resort) by-name
+   matching.
+3. the **lock-order graph** — which locks can be held when each other
+   lock is acquired, propagated through calls: ``f`` holding ``L`` that
+   calls ``g`` contributes an edge ``L -> M`` for every lock ``M`` that
+   ``g`` transitively acquires.
+
+Three whole-program rules are derived from the model (registered in
+``rules.py``; reported, suppressed and baselined exactly like per-file
+rules):
+
+- ``lock-order-cycle`` (error): a cycle in the lock-order graph is a
+  potential deadlock — two threads entering the cycle from different
+  edges wedge forever. Includes self-cycles on non-reentrant locks
+  (direct re-acquisition, or a call chain that re-enters a module-global
+  ``Lock``).
+- ``blocking-under-lock`` (warning): a blocking operation — RPC send,
+  ``time.sleep``/retry backoff, ``Future.result()``, ``queue.get()``,
+  ``Event``/``Condition.wait`` on a foreign lock, or a *callee's* device
+  dispatch — reachable while a lock is held. This generalizes the
+  per-file ``lock-across-device-call`` rule interprocedurally (direct
+  dispatch under a lock stays with the old rule; this one follows
+  calls).
+- ``unlocked-collective-dispatch`` (error): a collective-bearing mesh
+  program (a jitted callable whose traced body contains
+  ``all_gather``/``psum``/``pmin``/... or the cross-shard merge)
+  dispatched on a path that can be reached without
+  ``mesh_dispatch_lock`` held — the exact PR 7 deadlock, now
+  un-regressable.
+
+The pass reuses the per-file ``FileContext`` objects the engine already
+built (no second parse) and caches its findings keyed on source mtimes
+(``.concurrency_cache.json`` next to this file) so a warm tier-1 run
+pays only the stat calls.
+
+The static model is validated against reality by the runtime witness
+(``weaviate_tpu/utils/lockwitness.py``): the instrumented locks record
+the dynamic held-set at every acquire, and the witness's observed-order
+graph must embed into this module's static graph.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint.rules import (
+    SEV_ERROR,
+    SEV_WARNING,
+    Violation,
+    dotted_name,
+    is_dispatch_call,
+)
+
+# bump to invalidate caches when the analysis itself changes
+CONCURRENCY_VERSION = 1
+
+LOCK_ORDER_CYCLE = "lock-order-cycle"
+BLOCKING_UNDER_LOCK = "blocking-under-lock"
+UNLOCKED_COLLECTIVE = "unlocked-collective-dispatch"
+CONCURRENCY_RULE_IDS = (
+    LOCK_ORDER_CYCLE, BLOCKING_UNDER_LOCK, UNLOCKED_COLLECTIVE)
+
+DEFAULT_CACHE = Path(__file__).with_name(".concurrency_cache.json")
+
+# the one process-wide collective-dispatch order lock (PR 7)
+MESH_LOCK_ID = "weaviate_tpu.parallel.sharded_search._DISPATCH_LOCK"
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+}
+
+# cross-device rendezvous primitives: a jitted program containing one of
+# these deadlocks if two programs' per-device enqueues interleave
+_COLLECTIVE_NAMES = frozenset({
+    "all_gather", "psum", "pmin", "pmax", "all_to_all", "ppermute",
+    "pmean", "merge_across_shards",
+})
+
+# attribute-call names treated as blocking RPC/socket sends
+_RPC_NAMES = frozenset({
+    "_call", "urlopen", "sendall", "recv", "connect", "accept",
+    "create_connection", "getresponse",
+})
+
+_QUEUE_CTORS = frozenset({
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "multiprocessing.Queue",
+})
+
+# attribute names never resolved by-name (enormous fan-out and/or
+# always stdlib/container methods); blocking-relevant ones (.get,
+# .result, .wait, .acquire) are classified directly instead
+_NO_BYNAME = frozenset({
+    "get", "put", "items", "keys", "values", "append", "add", "pop",
+    "close", "update", "copy", "join", "split", "strip", "read",
+    "write", "open", "encode", "decode", "format", "setdefault",
+    "extend", "insert", "remove", "discard", "clear", "sort", "index",
+    "count", "group", "match", "search", "sub", "info", "debug",
+    "warning", "error", "exception", "log", "inc", "dec", "observe",
+    "set", "submit", "done", "cancel", "start", "is_set", "locked",
+    "acquire", "release", "wait", "notify", "notify_all", "result",
+    "item", "tolist", "astype", "reshape", "exists", "mkdir", "stat",
+    "resolve", "unlink", "touch", "flush", "seek", "tell", "fileno",
+    "sleep", "send",
+})
+
+_BYNAME_CAP = 3  # by-name attr resolution only when <= this many defs
+_CHAIN_MAX = 4   # call-chain depth kept for messages
+
+
+# ---------------------------------------------------------------------------
+# model dataclasses
+
+
+@dataclasses.dataclass
+class LockDef:
+    id: str
+    kind: str            # lock | rlock | condition
+    path: str
+    line: int
+    alias_of: Optional[str] = None  # Condition(self._lock) -> that lock
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind in ("rlock", "condition")
+
+
+@dataclasses.dataclass
+class _Event:
+    kind: str            # acquire | call | blocking | collective
+    line: int
+    held: Tuple[str, ...]          # lock ids held at this point
+    lock: Optional[str] = None     # acquire: lock id
+    callee: Optional[tuple] = None  # call: descriptor
+    detail: str = ""
+    category: str = ""             # blocking: sleep|future-result|...
+    direct_receiver: str = ""      # acquire: source receiver expr
+
+
+@dataclasses.dataclass
+class _Func:
+    key: str             # "module::qualname"
+    module: str
+    qual: str            # in-file qualname
+    path: str
+    line: int
+    cls: Optional[str]
+    events: List[_Event] = dataclasses.field(default_factory=list)
+    local_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    local_queues: Set[str] = dataclasses.field(default_factory=set)
+    jit_locals: Set[str] = dataclasses.field(default_factory=set)
+    direct_dispatch: Optional[int] = None  # line of a direct device dispatch
+    jitted: bool = False  # body executes at trace time, not dispatch time
+
+
+@dataclasses.dataclass
+class Edge:
+    src: str
+    dst: str
+    path: str
+    line: int
+    func: str            # in-file qualname where the edge was observed
+    via: str = ""        # callee chain note for propagated edges
+
+
+class ConcurrencyModel:
+    """The computed whole-program model: lock defs, call graph summary,
+    lock-order edges, and the derived findings."""
+
+    def __init__(self):
+        self.locks: Dict[str, LockDef] = {}
+        self.edges: Dict[Tuple[str, str], Edge] = {}
+        self.violations: List[Violation] = []
+        self.cache_state: str = "off"   # off | cold | warm
+        self.wall_s: float = 0.0
+
+    def to_dot(self) -> str:
+        """The lock-order graph in graphviz dot form; cycle edges red."""
+        cyc_edges = set()
+        for scc in _sccs({(e.src, e.dst) for e in self.edges.values()}):
+            if len(scc) > 1:
+                for (s, d) in self.edges:
+                    if s in scc and d in scc:
+                        cyc_edges.add((s, d))
+        for (s, d) in self.edges:
+            if s == d:
+                cyc_edges.add((s, d))
+        out = ["digraph lock_order {", "  rankdir=LR;",
+               '  node [shape=box, fontsize=10];']
+        for lid in sorted(self.locks):
+            ld = self.locks[lid]
+            shape = "ellipse" if ld.reentrant else "box"
+            out.append(f'  "{lid}" [shape={shape}];')
+        for (s, d) in sorted(self.edges):
+            e = self.edges[(s, d)]
+            color = ' color=red penwidth=2' if (s, d) in cyc_edges else ""
+            out.append(
+                f'  "{s}" -> "{d}" '
+                f'[label="{e.path}:{e.line}", fontsize=8{color}];')
+        out.append("}")
+        return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+
+def _module_of(rel_path: str) -> str:
+    p = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    parts = p.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _sccs(edges: Set[Tuple[str, str]]) -> List[Set[str]]:
+    """Tarjan SCCs over the edge set (iterative)."""
+    graph: Dict[str, List[str]] = {}
+    nodes: Set[str] = set()
+    for s, d in edges:
+        graph.setdefault(s, []).append(d)
+        nodes.add(s)
+        nodes.add(d)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+
+    for root in sorted(nodes):
+        if root in index:
+            continue
+        work = [(root, iter(graph.get(root, ())))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(graph.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                scc = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == v:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+# ---------------------------------------------------------------------------
+# per-file extraction
+
+
+class _FileModel:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.rel_path = ctx.rel_path
+        self.module = _module_of(ctx.rel_path)
+        self.imports: Dict[str, str] = {}
+        self.classes: Set[str] = set()
+        self.lock_defs: Dict[str, LockDef] = {}
+        self.lock_getters: Dict[str, str] = {}   # in-file qual -> lock id
+        self.queue_attrs: Dict[str, Set[str]] = {}  # class -> attrs
+        self.attr_assigns: Dict[str, Set[str]] = {}  # class -> all attrs
+        self.collective_jit_funcs: Set[str] = set()  # in-file quals
+        self.module_has_collectives = any(
+            name in ctx.source for name in _COLLECTIVE_NAMES)
+        self.funcs: Dict[str, _Func] = {}
+        self._collect_imports()
+        self._collect_locks_and_classes()
+        self._collect_jit_collectives()
+        self._collect_queue_attrs()
+        self._collect_getters()
+        self._scan_functions()
+
+    # -- import table ----------------------------------------------------
+
+    def _collect_imports(self) -> None:
+        pkg_parts = self.module.split(".")
+        for node in self.ctx.walk(ast.ImportFrom):
+            mod = node.module or ""
+            if node.level:  # relative import -> absolute
+                base = pkg_parts[: len(pkg_parts) - node.level]
+                mod = ".".join(base + ([mod] if mod else []))
+            for a in node.names:
+                self.imports[a.asname or a.name] = (
+                    f"{mod}.{a.name}" if mod else a.name)
+        for node in self.ctx.walk(ast.Import):
+            for a in node.names:
+                # `import x.y as z` binds z -> x.y; bare `import x.y`
+                # binds only the root name x
+                self.imports[a.asname or a.name.split(".", 1)[0]] = \
+                    a.name if a.asname else a.name.split(".", 1)[0]
+
+    def _canonical(self, dn: Optional[str]) -> Optional[str]:
+        """Rewrite a dotted name's root through the import table
+        (``_threading.RLock`` -> ``threading.RLock``)."""
+        if not dn:
+            return dn
+        root, _, rest = dn.partition(".")
+        target = self.imports.get(root)
+        if target is None:
+            return dn
+        return f"{target}.{rest}" if rest else target
+
+    def _lock_ctor(self, call: ast.Call) -> Optional[Tuple[str, Optional[ast.AST]]]:
+        dn = self._canonical(dotted_name(call.func))
+        kind = _LOCK_CTORS.get(dn or "")
+        if kind is None:
+            return None
+        arg = call.args[0] if (kind == "condition" and call.args) else None
+        for kw in call.keywords:
+            if kw.arg == "lock":
+                arg = kw.value
+        return kind, arg
+
+    # -- lock + class collection ----------------------------------------
+
+    def _collect_locks_and_classes(self) -> None:
+        ctx = self.ctx
+        for node in ctx.walk(ast.ClassDef):
+            self.classes.add(node.name)
+        for node in ctx.walk(ast.Assign):
+            if not isinstance(node.value, ast.Call):
+                continue
+            ctor = self._lock_ctor(node.value)
+            if ctor is None:
+                continue
+            kind, cond_arg = ctor
+            for t in node.targets:
+                self._define_lock(t, node, kind, cond_arg)
+
+    def _define_lock(self, target: ast.AST, node: ast.Assign, kind: str,
+                     cond_arg: Optional[ast.AST]) -> None:
+        ctx = self.ctx
+        qual = ctx.qualname(node)
+        alias = None
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            # self.X = threading.Lock() inside some method of class C
+            cls = qual.split(".")[0] if qual != "<module>" else None
+            if cls is None or cls not in self.classes:
+                return
+            lock_id = f"{self.module}.{cls}.{target.attr}"
+            if cond_arg is not None:
+                adn = dotted_name(cond_arg)
+                if adn and adn.startswith("self."):
+                    alias = f"{self.module}.{cls}.{adn[5:]}"
+        elif isinstance(target, ast.Name):
+            if qual == "<module>":
+                lock_id = f"{self.module}.{target.id}"
+            else:
+                lock_id = f"{self.module}.{qual}.{target.id}"
+        else:
+            return
+        self.lock_defs[lock_id] = LockDef(
+            id=lock_id, kind=kind, path=self.rel_path,
+            line=node.lineno, alias_of=alias)
+
+    def _collect_getters(self) -> None:
+        """Module-level functions whose body is (docstring +) ``return
+        <module lock>`` — e.g. ``mesh_dispatch_lock()``."""
+        for node in self.ctx.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            body = [s for s in node.body
+                    if not (isinstance(s, ast.Expr)
+                            and isinstance(s.value, ast.Constant))]
+            if len(body) != 1 or not isinstance(body[0], ast.Return):
+                continue
+            ret = body[0].value
+            if isinstance(ret, ast.Name):
+                lid = f"{self.module}.{ret.id}"
+                if lid in self.lock_defs:
+                    self.lock_getters[node.name] = lid
+
+    def _collect_jit_collectives(self) -> None:
+        from tools.graftlint.rules import _decorator_is_jit
+        for node in self.ctx.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if not any(_decorator_is_jit(d) for d in node.decorator_list):
+                continue
+            names = {n.attr for n in ast.walk(node)
+                     if isinstance(n, ast.Attribute)}
+            names |= {n.id for n in ast.walk(node)
+                      if isinstance(n, ast.Name)}
+            # a jitted entry is collective-bearing if its traced body
+            # names a collective primitive, or builds a shard_map program
+            # in a module that uses collectives (out_specs reassembly is
+            # itself a collective even without an explicit all_gather)
+            if names & _COLLECTIVE_NAMES or (
+                    self.module_has_collectives
+                    and names & {"_shard_map", "shard_map"}):
+                self.collective_jit_funcs.add(node.name)
+
+    def _collect_queue_attrs(self) -> None:
+        for node in self.ctx.walk(ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    cls = self.ctx.qualname(node).split(".")[0]
+                    # every instance-attr assignment: self.X() where X is
+                    # a stored value (callback, handle) must not resolve
+                    # to some unrelated project function by name
+                    self.attr_assigns.setdefault(cls, set()).add(t.attr)
+                    if isinstance(node.value, ast.Call) and \
+                            self._canonical(dotted_name(
+                                node.value.func)) in _QUEUE_CTORS:
+                        self.queue_attrs.setdefault(
+                            cls, set()).add(t.attr)
+
+    # -- function scanning ----------------------------------------------
+
+    def _scan_functions(self) -> None:
+        from tools.graftlint.rules import _decorator_is_jit
+        ctx = self.ctx
+        for node in ctx.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+            qual = self._func_qual(node)
+            cls = self._owner_class(node)
+            f = _Func(key=f"{self.module}::{qual}", module=self.module,
+                      qual=qual, path=self.rel_path, line=node.lineno,
+                      cls=cls,
+                      jitted=any(_decorator_is_jit(d)
+                                 for d in node.decorator_list))
+            self._collect_locals(node, f)
+            _Scanner(self, f).scan(node.body, ())
+            self.funcs[qual] = f
+
+    def _func_qual(self, node: ast.AST) -> str:
+        parts = [node.name]
+        for parent, field in self.ctx.ancestry(node):
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)) \
+                    and field != "decorator_list":
+                parts.append(parent.name)
+        return ".".join(reversed(parts))
+
+    def _owner_class(self, node: ast.AST) -> Optional[str]:
+        parent, field = self.ctx.parent_of(node)
+        if isinstance(parent, ast.ClassDef) and field == "body":
+            return parent.name
+        return None
+
+    def _collect_locals(self, node, f: _Func) -> None:
+        """Locks and queues bound to local names inside this function
+        (owned by this scope, not a nested def)."""
+        ctx = self.ctx
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Assign) or \
+                    not isinstance(n.value, ast.Call):
+                continue
+            if ctx.enclosing_scope(n) is not node:
+                continue
+            dn = self._canonical(dotted_name(n.value.func))
+            ctor = self._lock_ctor(n.value)
+            for t in n.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if ctor is not None:
+                    lid = f"{self.module}.{f.qual}.{t.id}"
+                    self.lock_defs[lid] = LockDef(
+                        id=lid, kind=ctor[0], path=self.rel_path,
+                        line=n.lineno)
+                    f.local_locks[t.id] = lid
+                elif dn in _QUEUE_CTORS:
+                    f.local_queues.add(t.id)
+                elif dn and (dn.endswith("_jit") or dn in
+                             ("_shard_map", "shard_map")):
+                    f.jit_locals.add(t.id)
+
+
+class _Scanner:
+    """Walks one function body tracking the held-lock set through
+    ``with`` nesting, emitting events."""
+
+    def __init__(self, fm: _FileModel, f: _Func):
+        self.fm = fm
+        self.f = f
+
+    # -- lock expression resolution (symbolic; resolved globally) -------
+
+    def resolve_lock(self, expr: ast.AST) -> Optional[tuple]:
+        """A symbolic lock reference for a with-item / acquire receiver,
+        or None if it doesn't look like a lock."""
+        if isinstance(expr, ast.Call):
+            # with mesh_dispatch_lock():  /  with self._lock_for(x): ...
+            dn = dotted_name(expr.func)
+            if dn is None:
+                return None
+            return ("getter", dn)
+        dn = dotted_name(expr)
+        if dn is None:
+            return None
+        if dn.startswith("self."):
+            attr = dn[5:]
+            if "." in attr:
+                return None
+            return ("selfattr", self.f.cls, attr)
+        if "." not in dn:
+            if dn in self.f.local_locks:
+                return ("exact", self.f.local_locks[dn])
+            return ("global", dn)
+        return ("dotted", dn)
+
+    # -- statement recursion --------------------------------------------
+
+    def scan(self, stmts: Sequence[ast.stmt], held: Tuple[str, ...]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # separate scope, scanned on its own
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                self._scan_with(st, held)
+                continue
+            # header expressions run under the current held set
+            for expr in self._header_exprs(st):
+                self._scan_expr(expr, held)
+            for body in self._bodies(st):
+                self.scan(body, held)
+            if not self._bodies(st) and not self._header_exprs(st):
+                self._scan_expr(st, held)
+
+    @staticmethod
+    def _bodies(st: ast.stmt) -> List[Sequence[ast.stmt]]:
+        out = []
+        for field in ("body", "orelse", "finalbody"):
+            b = getattr(st, field, None)
+            if b:
+                out.append(b)
+        for h in getattr(st, "handlers", ()) or ():
+            out.append(h.body)
+        return out
+
+    @staticmethod
+    def _header_exprs(st: ast.stmt) -> List[ast.AST]:
+        if isinstance(st, (ast.If, ast.While)):
+            return [st.test]
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            return [st.iter]
+        if isinstance(st, ast.Try):
+            return []
+        if _Scanner._bodies(st):
+            return []
+        return []
+
+    def _scan_with(self, st, held: Tuple[str, ...]) -> None:
+        """Every Name/Attribute/zero-arg-call with-item is a *candidate*
+        acquisition; global resolution against the lock model decides
+        whether it is one (``with open(...)`` resolves to nothing and
+        the event is dropped). Events whose lock does not resolve
+        contribute nothing to held-sets or edges."""
+        acquired: List[str] = []
+        for item in st.items:
+            ref = self.resolve_lock(item.context_expr)
+            if ref is not None:
+                recv = ast.dump(item.context_expr)
+                self.f.events.append(_Event(
+                    kind="acquire", line=item.context_expr.lineno,
+                    held=held + tuple(acquired),
+                    lock=None, callee=ref, direct_receiver=recv))
+                acquired.append(f"@{len(self.f.events) - 1}")
+            if isinstance(item.context_expr, ast.Call):
+                # also record call edges for context-manager factories
+                # (a non-getter `with self.x.scope():` still calls code)
+                self._scan_expr(item.context_expr, held)
+        self.scan(st.body, held + tuple(acquired))
+
+    # -- expression handling --------------------------------------------
+
+    def _scan_expr(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        # calls inside nested defs/lambdas run later, not here
+        skip: Set[int] = set()
+        for n in ast.walk(node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                for sub in ast.walk(n):
+                    skip.add(id(sub))
+        for call in ast.walk(node):
+            if isinstance(call, ast.Call) and id(call) not in skip:
+                self._classify_call(call, held)
+
+    def _classify_call(self, call: ast.Call, held: Tuple[str, ...]) -> None:
+        fm, f = self.fm, self.f
+        func = call.func
+        dn = dotted_name(func)
+
+        # explicit lock.acquire() — a point event (the extent of the
+        # critical section is unknowable without pairing releases)
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            ref = self.resolve_lock(func.value)
+            if ref is not None:
+                f.events.append(_Event(
+                    kind="acquire", line=call.lineno, held=held,
+                    callee=ref,
+                    direct_receiver=ast.dump(func.value)))
+                return
+
+        # blocking primitives -------------------------------------------
+        if dn is not None and fm._canonical(dn) in ("time.sleep",):
+            f.events.append(_Event(kind="blocking", line=call.lineno,
+                                   held=held, detail="time.sleep",
+                                   category="sleep"))
+            return
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            recv = func.value
+            if attr == "result":
+                f.events.append(_Event(
+                    kind="blocking", line=call.lineno, held=held,
+                    category="future-result",
+                    detail=f"{dotted_name(recv) or '<expr>'}.result()"))
+                return
+            if attr == "get" and self._is_queue(recv):
+                f.events.append(_Event(
+                    kind="blocking", line=call.lineno, held=held,
+                    category="queue-get",
+                    detail=f"{dotted_name(recv) or '<expr>'}.get()"))
+                return
+            if attr == "wait":
+                # callee carries the receiver's lock ref: a cv.wait()
+                # releases its own lock, which resolution subtracts
+                # from the effective held-set
+                f.events.append(_Event(
+                    kind="blocking", line=call.lineno, held=held,
+                    callee=self.resolve_lock(recv), category="wait",
+                    detail=f"{dotted_name(recv) or '<expr>'}.wait()"))
+                return
+            if attr in _RPC_NAMES:
+                f.events.append(_Event(
+                    kind="blocking", line=call.lineno, held=held,
+                    category="rpc",
+                    detail=f"{dotted_name(recv) or '<expr>'}.{attr}()"))
+                # fall through: also record the call edge (e.g. self._call
+                # resolves to a project method whose summary matters)
+
+        # direct device dispatch (old rule covers depth 0; we only record
+        # the fact for interprocedural propagation)
+        if is_dispatch_call(call, fm.ctx):
+            if f.direct_dispatch is None:
+                f.direct_dispatch = call.lineno
+            return
+
+        # collective dispatch, pattern: invoking a local name bound from
+        # a *_jit(...) / _shard_map(...) factory in a collective module
+        if isinstance(func, ast.Name) and func.id in f.jit_locals \
+                and fm.module_has_collectives:
+            f.events.append(_Event(kind="collective", line=call.lineno,
+                                   held=held, detail=f"{func.id}(...)"))
+            return
+
+        # plain call edge ------------------------------------------------
+        desc = self._call_descriptor(call)
+        if desc is not None:
+            f.events.append(_Event(kind="call", line=call.lineno,
+                                   held=held, callee=desc,
+                                   detail=dn or desc[-1]))
+
+    def _call_descriptor(self, call: ast.Call) -> Optional[tuple]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return ("name", func.id)
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id == "self":
+                return ("self", func.attr)
+            dn = dotted_name(func)
+            if dn is not None:
+                return ("dotted", dn)
+            return ("attr", func.attr)
+        return None
+
+    def _is_queue(self, recv: ast.AST) -> bool:
+        if isinstance(recv, ast.Name):
+            return recv.id in self.f.local_queues
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id == "self" and self.f.cls:
+            return recv.attr in self.fm.queue_attrs.get(self.f.cls, set())
+        return False
+
+
+# ---------------------------------------------------------------------------
+# global analysis
+
+
+class Analyzer:
+    def __init__(self, contexts: Dict[str, "FileContext"]):
+        self.files = {rel: _FileModel(ctx)
+                      for rel, ctx in sorted(contexts.items())}
+        self.locks: Dict[str, LockDef] = {}
+        self.getters: Dict[str, str] = {}     # "module::qual" -> lock id
+        self.funcs: Dict[str, _Func] = {}
+        self.by_name: Dict[str, List[str]] = {}
+        self.by_method: Dict[Tuple[str, str], List[str]] = {}
+        self.collective_funcs: Set[str] = set()  # keys of jit+collective
+        for fm in self.files.values():
+            self.locks.update(fm.lock_defs)
+            for qual, lid in fm.lock_getters.items():
+                self.getters[f"{fm.module}::{qual}"] = lid
+            for qual, f in fm.funcs.items():
+                self.funcs[f.key] = f
+                simple = qual.rsplit(".", 1)[-1]
+                self.by_name.setdefault(simple, []).append(f.key)
+                if f.cls:
+                    self.by_method.setdefault(
+                        (f.cls, simple), []).append(f.key)
+            for qual in fm.collective_jit_funcs:
+                self.collective_funcs.add(f"{fm.module}::{qual}")
+        # lock attr name -> ids (for cross-class fallback)
+        self.lock_attr_index: Dict[str, List[str]] = {}
+        for lid in self.locks:
+            self.lock_attr_index.setdefault(
+                lid.rsplit(".", 1)[-1], []).append(lid)
+        self.project_modules: Set[str] = {
+            fm.module for fm in self.files.values()}
+
+    def _is_project_module(self, mod: str) -> bool:
+        """Whether a dotted import target points into the analyzed tree
+        (``os``/``subprocess``/... must NOT fall back to by-name
+        matching — ``os.replace`` is not the Collection.replace API)."""
+        return any(m == mod or m.startswith(mod + ".")
+                   or mod.startswith(m + ".")
+                   for m in self.project_modules)
+
+    # -- resolution ------------------------------------------------------
+
+    def _follow_alias(self, lid: Optional[str]) -> Optional[str]:
+        seen = set()
+        while lid is not None and lid in self.locks \
+                and self.locks[lid].alias_of and lid not in seen:
+            seen.add(lid)
+            lid = self.locks[lid].alias_of
+        return lid
+
+    def resolve_lock_ref(self, fm: _FileModel, f: _Func,
+                         ref: tuple) -> Optional[str]:
+        kind = ref[0]
+        if kind == "exact":
+            return self._follow_alias(ref[1])
+        if kind == "selfattr":
+            cls, attr = ref[1], ref[2]
+            if cls:
+                lid = f"{fm.module}.{cls}.{attr}"
+                if lid in self.locks:
+                    return self._follow_alias(lid)
+            cands = self.lock_attr_index.get(attr, [])
+            if len(cands) == 1:
+                return self._follow_alias(cands[0])
+            return None
+        if kind == "global":
+            lid = f"{fm.module}.{ref[1]}"
+            if lid in self.locks:
+                return self._follow_alias(lid)
+            tgt = fm.imports.get(ref[1])
+            if tgt and tgt in self.locks:
+                return self._follow_alias(tgt)
+            return None
+        if kind == "dotted":
+            dn = fm._canonical(ref[1])
+            if dn and dn in self.locks:
+                return self._follow_alias(dn)
+            # obj._lock style: attr-name fallback when globally unique
+            attr = ref[1].rsplit(".", 1)[-1]
+            cands = self.lock_attr_index.get(attr, [])
+            if len(cands) == 1:
+                return self._follow_alias(cands[0])
+            return None
+        if kind == "getter":
+            keys = self.resolve_call(fm, None, ("name", ref[1])) \
+                if "." not in ref[1] else \
+                self.resolve_call(fm, None, ("dotted", ref[1]))
+            for k in keys:
+                if k in self.getters:
+                    return self._follow_alias(self.getters[k])
+            return None
+        return None
+
+    def resolve_call(self, fm: _FileModel, f: Optional[_Func],
+                     desc: tuple) -> List[str]:
+        kind = desc[0]
+        if kind == "name":
+            name = desc[1]
+            if f is not None:
+                # nested def in the same function
+                nested = f"{f.qual}.{name}"
+                if nested in fm.funcs:
+                    return [fm.funcs[nested].key]
+            if name in fm.funcs:
+                return [fm.funcs[name].key]
+            if name in fm.classes:
+                init = f"{name}.__init__"
+                if init in fm.funcs:
+                    return [fm.funcs[init].key]
+                return []
+            tgt = fm.imports.get(name)
+            if tgt and "." in tgt:
+                mod, _, fname = tgt.rpartition(".")
+                key = f"{mod}::{fname}"
+                if key in self.funcs:
+                    return [key]
+                if key in self.getters:
+                    return [key]
+                # imported class
+                ikey = f"{mod}::{fname}.__init__"
+                if ikey in self.funcs:
+                    return [ikey]
+            return []
+        if kind == "self":
+            name = desc[1]
+            if f is not None and f.cls:
+                mkey = f"{fm.module}::{f.cls}.{name}"
+                if mkey in self.funcs:
+                    return [mkey]
+                cands = self.by_method.get((f.cls, name))
+                if cands:
+                    return list(cands)
+                if name in fm.attr_assigns.get(f.cls, set()):
+                    return []  # stored callback/handle, target unknowable
+            return self._by_name(name)
+        if kind == "dotted":
+            dn = desc[1]
+            root, _, rest = dn.partition(".")
+            tgt = fm.imports.get(root)
+            if tgt and rest:
+                # module alias: sharded_search.sharded_flat_search(...)
+                mod_attr = f"{tgt}.{rest}"
+                mod, _, fname = mod_attr.rpartition(".")
+                key = f"{mod}::{fname}"
+                if key in self.funcs:
+                    return [key]
+                if key in self.getters:
+                    return [key]
+                if not self._is_project_module(tgt):
+                    return []  # stdlib/3rd-party call, never by-name
+            return self._by_name(dn.rsplit(".", 1)[-1])
+        if kind == "attr":
+            return self._by_name(desc[1])
+        return []
+
+    def _by_name(self, name: str) -> List[str]:
+        if name in _NO_BYNAME or name.startswith("__"):
+            return []
+        cands = self.by_name.get(name, [])
+        if 0 < len(cands) <= _BYNAME_CAP:
+            return list(cands)
+        return []
+
+    # -- propagation -----------------------------------------------------
+
+    def run(self) -> ConcurrencyModel:
+        model = ConcurrencyModel()
+        model.locks = dict(self.locks)
+
+        # resolve every event's symbolic pieces once
+        resolved: Dict[str, List[dict]] = {}
+        for fm in self.files.values():
+            for f in fm.funcs.values():
+                evs = []
+                for ev in f.events:
+                    e = {"ev": ev, "lock": None, "callees": []}
+                    if ev.kind == "acquire":
+                        e["lock"] = self.resolve_lock_ref(fm, f, ev.callee)
+                    elif ev.kind == "call":
+                        e["callees"] = self.resolve_call(fm, f, ev.callee)
+                    elif ev.kind == "blocking" and ev.callee is not None:
+                        e["lock"] = self.resolve_lock_ref(fm, f, ev.callee)
+                    evs.append(e)
+                resolved[f.key] = evs
+
+        held_ids = self._materialize_held(resolved)
+
+        # transitive acquire sets --------------------------------------
+        acq: Dict[str, Set[str]] = {k: set() for k in self.funcs}
+        calls: Dict[str, Set[str]] = {k: set() for k in self.funcs}
+        for key, evs in resolved.items():
+            for e in evs:
+                if e["ev"].kind == "acquire" and e["lock"]:
+                    acq[key].add(e["lock"])
+                for c in e["callees"]:
+                    if c in self.funcs:
+                        calls[key].add(c)
+        acq_star = {k: set(v) for k, v in acq.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k in self.funcs:
+                for c in calls[k]:
+                    before = len(acq_star[k])
+                    acq_star[k] |= acq_star[c]
+                    if len(acq_star[k]) != before:
+                        changed = True
+
+        # transitive blocking summaries --------------------------------
+        # kind -> representative chain [(path, line, what)]
+        blk: Dict[str, Dict[str, list]] = {k: {} for k in self.funcs}
+        for key, evs in resolved.items():
+            f = self.funcs[key]
+            for e in evs:
+                ev = e["ev"]
+                if ev.kind == "blocking":
+                    blk[key].setdefault(
+                        ev.category or "blocking",
+                        [(f.path, ev.line, ev.detail)])
+            if f.direct_dispatch is not None:
+                blk[key].setdefault(
+                    "device-dispatch",
+                    [(f.path, f.direct_dispatch, "device dispatch")])
+        changed = True
+        while changed:
+            changed = False
+            for k in self.funcs:
+                f = self.funcs[k]
+                for e in resolved[k]:
+                    ev = e["ev"]
+                    if ev.kind != "call":
+                        continue
+                    for c in e["callees"]:
+                        if c not in blk:
+                            continue
+                        for bkind, chain in blk[c].items():
+                            if bkind in blk[k] or len(chain) >= _CHAIN_MAX:
+                                continue
+                            blk[k][bkind] = \
+                                [(f.path, ev.line, ev.detail)] + chain
+                            changed = True
+
+        self._edges(model, resolved, held_ids, acq_star)
+        self._cycle_findings(model)
+        self._blocking_findings(model, resolved, held_ids, blk)
+        self._collective_findings(model, resolved, held_ids)
+        model.violations.sort(
+            key=lambda v: (v.path, v.line, v.col, v.rule, v.message))
+        return model
+
+    def _materialize_held(self, resolved) -> Dict[str, List[Tuple[str, ...]]]:
+        """Per function, per event: the held set as resolved lock ids.
+        With-acquired locks are referenced as '@<event index>' in
+        ``held`` — map those through each event's resolved lock."""
+        out: Dict[str, List[Tuple[str, ...]]] = {}
+        for key, evs in resolved.items():
+            per_ev: List[Tuple[str, ...]] = []
+            for e in evs:
+                ids = []
+                for h in e["ev"].held:
+                    if h.startswith("@"):
+                        lid = evs[int(h[1:])]["lock"]
+                    else:
+                        lid = h
+                    if lid:
+                        ids.append(lid)
+                per_ev.append(tuple(dict.fromkeys(ids)))
+            out[key] = per_ev
+        return out
+
+    def _add_edge(self, model, src, dst, f: _Func, line: int,
+                  via: str = "") -> None:
+        if (src, dst) in model.edges:
+            return
+        model.edges[(src, dst)] = Edge(
+            src=src, dst=dst, path=f.path, line=line, func=f.qual, via=via)
+
+    def _edges(self, model, resolved, held_ids, acq_star) -> None:
+        for key, evs in resolved.items():
+            f = self.funcs[key]
+            for i, e in enumerate(evs):
+                ev = e["ev"]
+                held = held_ids[key][i]
+                if ev.kind == "acquire" and e["lock"]:
+                    dst = e["lock"]
+                    for src in held:
+                        if src == dst:
+                            self._self_edge(model, src, f, ev, direct=True)
+                        else:
+                            self._add_edge(model, src, dst, f, ev.line)
+                elif ev.kind == "call" and held:
+                    for c in e["callees"]:
+                        for dst in acq_star.get(c, ()):
+                            for src in held:
+                                if src == dst:
+                                    self._self_edge(model, src, f, ev,
+                                                    direct=False)
+                                else:
+                                    self._add_edge(
+                                        model, src, dst, f, ev.line,
+                                        via=f"via {ev.detail}()")
+
+    def _self_edge(self, model, lid, f: _Func, ev: _Event,
+                   direct: bool) -> None:
+        ld = self.locks.get(lid)
+        if ld is None or ld.reentrant:
+            return
+        # class-attr locks exist once per instance: a call-propagated
+        # re-entry may hit a *different* instance, which is ordering-
+        # ambiguous, not a certain deadlock — only direct syntactic
+        # re-acquisition, or any re-entry of a true module-global
+        # singleton, is reported.
+        is_global = "." not in lid[len(_module_of(ld.path)) + 1:]
+        if direct or is_global:
+            self._add_edge(model, lid, lid, f, ev.line,
+                           via="" if direct else f"via {ev.detail}()")
+
+    # -- findings --------------------------------------------------------
+
+    def _mk(self, rule, sev, f_path, line, symbol, message) -> Violation:
+        fm = self.files.get(f_path)
+        snippet = fm.ctx.line_snippet(line) if fm else ""
+        return Violation(rule=rule, path=f_path, line=line, col=0,
+                         severity=sev, message=message, symbol=symbol,
+                         snippet=snippet)
+
+    def _cycle_findings(self, model) -> None:
+        edge_pairs = set(model.edges)
+        for scc in _sccs(edge_pairs):
+            members = sorted(scc)
+            cyc = [(s, d) for (s, d) in sorted(edge_pairs)
+                   if s in scc and d in scc]
+            if len(scc) == 1:
+                lid = members[0]
+                if (lid, lid) not in edge_pairs:
+                    continue
+                cyc = [(lid, lid)]
+            if not cyc:
+                continue
+            sites = []
+            for (s, d) in cyc:
+                e = model.edges[(s, d)]
+                note = f" {e.via}" if e.via else ""
+                sites.append(f"{s} -> {d} at {e.path}:{e.line} "
+                             f"({e.func}){note}")
+            anchor = model.edges[cyc[0]]
+            if len(scc) == 1:
+                msg = (f"non-reentrant lock {members[0]} can be "
+                       "re-acquired while already held (self-deadlock): "
+                       + "; ".join(sites))
+            else:
+                msg = ("lock-order cycle (potential deadlock) between "
+                       + ", ".join(members) + ": " + "; ".join(sites)
+                       + " — pick one order and enforce it, or alias "
+                         "the locks")
+            v = self._mk(LOCK_ORDER_CYCLE, SEV_ERROR, anchor.path,
+                         anchor.line, anchor.func, msg)
+            model.violations.append(v)
+
+    def _blocking_findings(self, model, resolved, held_ids, blk) -> None:
+        seen: Set[Tuple[str, int, str]] = set()
+        for key, evs in resolved.items():
+            f = self.funcs[key]
+            for i, e in enumerate(evs):
+                ev = e["ev"]
+                held = held_ids[key][i]
+                if not held:
+                    continue
+                if ev.kind == "blocking":
+                    eff = tuple(h for h in held if h != e["lock"])
+                    if not eff:
+                        continue  # cv.wait() under only its own lock
+                    k = (f.path, ev.line)
+                    if k in seen:
+                        continue
+                    seen.add(k)
+                    model.violations.append(self._mk(
+                        BLOCKING_UNDER_LOCK, SEV_WARNING, f.path, ev.line,
+                        f.qual,
+                        f"{ev.detail} blocks while holding "
+                        f"{', '.join(eff)} — every thread contending for "
+                        "the lock stalls behind this wait; move it "
+                        "outside the critical section or bound it"))
+                elif ev.kind == "call":
+                    for c in e["callees"]:
+                        chains = blk.get(c, {})
+                        for bkind, chain in sorted(chains.items()):
+                            eff = held
+                            if bkind == "device-dispatch":
+                                # serializing device enqueues IS the mesh
+                                # dispatch lock's job
+                                eff = tuple(h for h in held
+                                            if h != MESH_LOCK_ID)
+                            if not eff:
+                                continue
+                            k = (f.path, ev.line)
+                            if k in seen:
+                                continue
+                            seen.add(k)
+                            hops = " -> ".join(
+                                f"{p}:{ln} {what}"
+                                for (p, ln, what) in chain)
+                            model.violations.append(self._mk(
+                                BLOCKING_UNDER_LOCK, SEV_WARNING,
+                                f.path, ev.line, f.qual,
+                                f"call to {ev.detail}() while holding "
+                                f"{', '.join(eff)} reaches a blocking "
+                                f"{bkind} ({hops}) — snapshot under the "
+                                "lock, release, then block"))
+
+    def _collective_findings(self, model, resolved, held_ids) -> None:
+        # which functions can be entered without the mesh lock held:
+        # roots (no known callers) start unlocked; an edge whose call
+        # site holds the lock does not propagate unlocked-ness
+        incoming: Dict[str, List[Tuple[str, bool]]] = \
+            {k: [] for k in self.funcs}
+        for key, evs in resolved.items():
+            for i, e in enumerate(evs):
+                if e["ev"].kind != "call":
+                    continue
+                locked = MESH_LOCK_ID in held_ids[key][i]
+                for c in e["callees"]:
+                    if c in incoming:
+                        incoming[c].append((key, locked))
+        unlocked: Dict[str, bool] = {
+            k: not incoming[k] for k in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for k, edges_in in incoming.items():
+                if unlocked[k]:
+                    continue
+                for (caller, locked) in edges_in:
+                    if not locked and unlocked.get(caller, True):
+                        unlocked[k] = True
+                        changed = True
+                        break
+
+        for key, evs in resolved.items():
+            f = self.funcs[key]
+            if f.jitted:
+                # a jitted body executes at trace time; the runtime
+                # enqueue order is governed by whoever dispatches it
+                continue
+            for i, e in enumerate(evs):
+                ev = e["ev"]
+                coll = ev.kind == "collective" or (
+                    ev.kind == "call"
+                    and any(c in self.collective_funcs
+                            for c in e["callees"]))
+                if not coll:
+                    continue
+                held = held_ids[key][i]
+                if MESH_LOCK_ID in held:
+                    continue
+                if not unlocked.get(key, True):
+                    continue  # every caller path already holds the lock
+                model.violations.append(self._mk(
+                    UNLOCKED_COLLECTIVE, SEV_ERROR, f.path, ev.line,
+                    f.qual,
+                    f"collective-bearing mesh program {ev.detail} "
+                    "dispatched without mesh_dispatch_lock held — two "
+                    "concurrent collective programs can interleave "
+                    "per-device enqueues and deadlock at the rendezvous "
+                    "(the PR 7 bug); wrap the dispatch in `with "
+                    "mesh_dispatch_lock():` (see docs/mesh.md)"))
+
+
+# ---------------------------------------------------------------------------
+# entry points + cache
+
+
+def analyze_contexts(contexts: Dict[str, "FileContext"]) -> ConcurrencyModel:
+    """Run the whole-program analysis over pre-built FileContexts."""
+    return Analyzer(contexts).run()
+
+
+def analyze_sources(sources: Dict[str, str]) -> ConcurrencyModel:
+    """Test/utility entry: analyze raw sources keyed by rel path."""
+    from tools.graftlint.engine import FileContext
+    return analyze_contexts(
+        {rel: FileContext(src, rel) for rel, src in sources.items()})
+
+
+def _cache_key(meta: Dict[str, Tuple[int, int]]) -> dict:
+    return {rel: list(mt) for rel, mt in sorted(meta.items())}
+
+
+def check_contexts(contexts: Dict[str, "FileContext"],
+                   meta: Optional[Dict[str, Tuple[int, int]]] = None,
+                   cache_path: Optional[Path] = None) -> ConcurrencyModel:
+    """Analysis with the mtime cache: ``meta`` maps rel path ->
+    (mtime_ns, size). A warm cache (identical version + file set +
+    stamps) replays the stored findings and edges without re-running
+    the pass; anything else recomputes and rewrites the cache."""
+    import time as _time
+    t0 = _time.perf_counter()
+    if cache_path is not None and meta is not None and cache_path.exists():
+        try:
+            data = json.loads(cache_path.read_text(encoding="utf-8"))
+            if (data.get("version") == CONCURRENCY_VERSION
+                    and data.get("files") == _cache_key(meta)):
+                model = ConcurrencyModel()
+                model.cache_state = "warm"
+                for d in data["violations"]:
+                    model.violations.append(Violation(**d))
+                for d in data["edges"]:
+                    e = Edge(**d)
+                    model.edges[(e.src, e.dst)] = e
+                for d in data["locks"]:
+                    ld = LockDef(**d)
+                    model.locks[ld.id] = ld
+                model.wall_s = _time.perf_counter() - t0
+                return model
+        except (ValueError, KeyError, TypeError):
+            pass  # malformed cache: recompute and overwrite
+    model = analyze_contexts(contexts)
+    model.cache_state = "cold" if cache_path is not None else "off"
+    model.wall_s = _time.perf_counter() - t0
+    if cache_path is not None and meta is not None:
+        payload = {
+            "version": CONCURRENCY_VERSION,
+            "files": _cache_key(meta),
+            "violations": [v.to_dict() for v in model.violations],
+            "edges": [dataclasses.asdict(e)
+                      for _, e in sorted(model.edges.items())],
+            "locks": [dataclasses.asdict(ld)
+                      for _, ld in sorted(model.locks.items())],
+        }
+        try:
+            cache_path.write_text(json.dumps(payload), encoding="utf-8")
+        except OSError:
+            pass  # read-only checkout: run uncached
+    return model

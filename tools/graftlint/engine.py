@@ -200,6 +200,11 @@ class LintResult:
     suppressed: List[Violation]
     files_checked: int = 1
     parse_errors: List[Violation] = dataclasses.field(default_factory=list)
+    # whole-program concurrency pass artifacts (None when not run):
+    # the ConcurrencyModel carries the lock-order graph (for --format
+    # dot) plus its wall time and cache state (for the JSON report)
+    concurrency: Optional[object] = None
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 def repo_root() -> Path:
@@ -211,11 +216,73 @@ def repo_root() -> Path:
     return Path(__file__).resolve().parents[2]
 
 
+def _per_file_rules(ctx: FileContext, rules: Optional[Sequence[str]],
+                    kept: List[Violation],
+                    suppressed: List[Violation]) -> None:
+    """Run the per-file registry rules on one context. Unused-suppression
+    is NOT emitted here — the whole-program concurrency pass may still
+    mark suppressions used, so the caller flushes it last."""
+    if rules is not None:
+        # engine-level pseudo-rules (parse-error, unused-suppression) are
+        # not in the registry; drop them before the lookup
+        from tools.graftlint.rules import RULE_IDS
+        selected = get_rules([r for r in rules if r in RULE_IDS])
+    else:
+        selected = ALL_RULES
+    for rule in selected:
+        for v in rule.check(ctx):
+            (suppressed if ctx.is_suppressed(v) else kept).append(v)
+
+
+def _flush_unused_suppressions(ctx: FileContext,
+                               rules: Optional[Sequence[str]],
+                               kept: List[Violation]) -> None:
+    # dead allow-comments are debt too: a suppression that matched nothing
+    # would silently mask a future regression on that line (the comment
+    # ratchet, mirroring the stale-baseline check)
+    if rules is not None and "unused-suppression" not in rules:
+        return
+    for s in ctx.suppressions:
+        if not s.used:
+            kept.append(Violation(
+                rule="unused-suppression", path=ctx.rel_path,
+                line=s.line, col=0, severity=SEV_ERROR,
+                message=(
+                    f"allow[{','.join(sorted(s.rules))}] suppresses "
+                    "nothing — the hazard was fixed, so delete the "
+                    "comment"),
+                symbol="<module>", snippet=ctx.line_snippet(s.line)))
+
+
+def _concurrency_selected(rules: Optional[Sequence[str]]) -> bool:
+    from tools.graftlint.concurrency import CONCURRENCY_RULE_IDS
+    return rules is None or bool(set(rules) & set(CONCURRENCY_RULE_IDS))
+
+
+def _run_concurrency(contexts, meta, cache_path, rules,
+                     kept: List[Violation],
+                     suppressed: List[Violation]):
+    from tools.graftlint import concurrency as conc
+    model = conc.check_contexts(contexts, meta, cache_path)
+    selected = set(rules) if rules is not None else None
+    for v in model.violations:
+        if selected is not None and v.rule not in selected:
+            continue
+        ctx = contexts.get(v.path)
+        if ctx is not None and ctx.is_suppressed(v):
+            suppressed.append(v)
+        else:
+            kept.append(v)
+    return model
+
+
 def lint_source(source: str, rel_path: str,
                 rules: Optional[Sequence[str]] = None) -> LintResult:
     """Lint one source string as if it lived at ``rel_path``. The unit
     tests and the CLI share this path, so fixtures exercise exactly the
-    production matching logic."""
+    production matching logic. The concurrency pass runs degenerately
+    over the single file (cross-module propagation needs
+    ``concurrency.analyze_sources``)."""
     try:
         ctx = FileContext(source, rel_path)
     except SyntaxError as e:
@@ -228,31 +295,15 @@ def lint_source(source: str, rel_path: str,
 
     kept: List[Violation] = []
     suppressed: List[Violation] = []
-    if rules is not None:
-        # engine-level pseudo-rules (parse-error, unused-suppression) are
-        # not in the registry; drop them before the lookup
-        from tools.graftlint.rules import RULE_IDS
-        rules_for_registry = [r for r in rules if r in RULE_IDS]
-    for rule in (get_rules(rules_for_registry) if rules is not None
-                 else ALL_RULES):
-        for v in rule.check(ctx):
-            (suppressed if ctx.is_suppressed(v) else kept).append(v)
-    # dead allow-comments are debt too: a suppression that matched nothing
-    # would silently mask a future regression on that line (the comment
-    # ratchet, mirroring the stale-baseline check)
-    if rules is None or "unused-suppression" in rules:
-        for s in ctx.suppressions:
-            if not s.used:
-                kept.append(Violation(
-                    rule="unused-suppression", path=ctx.rel_path,
-                    line=s.line, col=0, severity=SEV_ERROR,
-                    message=(
-                        f"allow[{','.join(sorted(s.rules))}] suppresses "
-                        "nothing — the hazard was fixed, so delete the "
-                        "comment"),
-                    symbol="<module>", snippet=ctx.line_snippet(s.line)))
+    _per_file_rules(ctx, rules, kept, suppressed)
+    concurrency = None
+    if _concurrency_selected(rules):
+        concurrency = _run_concurrency(
+            {ctx.rel_path: ctx}, None, None, rules, kept, suppressed)
+    _flush_unused_suppressions(ctx, rules, kept)
     kept.sort(key=lambda v: (v.line, v.col, v.rule))
-    return LintResult(violations=kept, suppressed=suppressed)
+    return LintResult(violations=kept, suppressed=suppressed,
+                      concurrency=concurrency)
 
 
 def iter_python_files(paths: Sequence[str]) -> Iterable[Path]:
@@ -268,17 +319,28 @@ def iter_python_files(paths: Sequence[str]) -> Iterable[Path]:
 
 
 def lint_paths(paths: Sequence[str], root: Optional[Path] = None,
-               rules: Optional[Sequence[str]] = None) -> LintResult:
+               rules: Optional[Sequence[str]] = None,
+               concurrency_cache: bool = True) -> LintResult:
     """Lint every ``*.py`` under ``paths`` (files or directories).
 
     ``root`` anchors the relative paths used in reports, baselines, and
     the prefix-scoped rules; it defaults to the repo this linter is
     vendored in, so the console script works from any cwd.
+
+    Per-file rules run first; the interprocedural concurrency pass then
+    runs once over every parsed file (cached on source mtimes — see
+    ``tools/graftlint/concurrency.py``) and its findings flow through
+    the same suppression and baseline pipeline.
     """
+    import time as _time
+
+    t_start = _time.perf_counter()
     root = (root or repo_root()).resolve()
     all_v: List[Violation] = []
     all_s: List[Violation] = []
     parse_errors: List[Violation] = []
+    contexts: Dict[str, FileContext] = {}
+    meta: Dict[str, Tuple[int, int]] = {}
     n = 0
     for f in iter_python_files(paths):
         n += 1
@@ -288,6 +350,7 @@ def lint_paths(paths: Sequence[str], root: Optional[Path] = None,
             rel = f.as_posix()
         try:
             source = f.read_text(encoding="utf-8")
+            st = f.stat()
         except (OSError, UnicodeDecodeError) as e:
             v = Violation(
                 rule="parse-error", path=rel, line=1, col=0,
@@ -297,10 +360,39 @@ def lint_paths(paths: Sequence[str], root: Optional[Path] = None,
             all_v.append(v)
             parse_errors.append(v)
             continue
-        res = lint_source(source, rel, rules)
-        all_v.extend(res.violations)
-        all_s.extend(res.suppressed)
-        parse_errors.extend(res.parse_errors)
+        try:
+            ctx = FileContext(source, rel)
+        except SyntaxError as e:
+            v = Violation(
+                rule="parse-error", path=rel, line=e.lineno or 1,
+                col=e.offset or 0, severity=SEV_ERROR,
+                message=f"file does not parse: {e.msg}",
+                symbol="<module>", snippet="")
+            all_v.append(v)
+            parse_errors.append(v)
+            continue
+        contexts[rel] = ctx
+        meta[rel] = (st.st_mtime_ns, st.st_size)
+        _per_file_rules(ctx, rules, all_v, all_s)
+
+    concurrency = None
+    timings: Dict[str, float] = {}
+    if _concurrency_selected(rules) and contexts:
+        from tools.graftlint.concurrency import DEFAULT_CACHE
+
+        # the committed cache is only meaningful for the canonical full
+        # tree; fixture/tmp-path runs must not overwrite it
+        want = (repo_root() / "weaviate_tpu").resolve()
+        canonical = {Path(p).resolve() for p in paths} == {want}
+        cache_path = (DEFAULT_CACHE
+                      if concurrency_cache and canonical else None)
+        concurrency = _run_concurrency(
+            contexts, meta, cache_path, rules, all_v, all_s)
+        timings["concurrency_s"] = round(concurrency.wall_s, 3)
+    for ctx in contexts.values():
+        _flush_unused_suppressions(ctx, rules, all_v)
     all_v.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    timings["total_s"] = round(_time.perf_counter() - t_start, 3)
     return LintResult(violations=all_v, suppressed=all_s,
-                      files_checked=n, parse_errors=parse_errors)
+                      files_checked=n, parse_errors=parse_errors,
+                      concurrency=concurrency, timings=timings)

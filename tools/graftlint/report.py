@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import json
 from collections import Counter
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 from tools.graftlint.rules import Violation
 
@@ -50,7 +50,9 @@ def summary_line(new: Sequence[Violation], baselined: Sequence[Violation],
 
 def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
                 stale: Counter, suppressed_count: int,
-                files_checked: int) -> str:
+                files_checked: int,
+                timings: Optional[dict] = None,
+                concurrency_cache: Optional[str] = None) -> str:
     doc = {
         "summary": {
             "status": "fail" if (new or stale) else "ok",
@@ -67,5 +69,69 @@ def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
              "snippet": fp[3], "count": n}
             for fp, n in sorted(stale.items())
         ],
+    }
+    if timings is not None:
+        # wall-time per phase so tier-1 budget creep is visible in the
+        # same artifact CI already collects
+        doc["summary"]["timings"] = dict(timings)
+    if concurrency_cache is not None:
+        doc["summary"]["concurrency_cache"] = concurrency_cache
+    return json.dumps(doc, indent=2)
+
+
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 — findings render as code annotations in CI
+
+
+_SARIF_LEVEL = {"warning": "warning", "error": "error", "critical": "error"}
+
+
+def render_sarif(new: Sequence[Violation], files_checked: int,
+                 rules_meta: Sequence = ()) -> str:
+    """Minimal-but-valid SARIF 2.1.0 log of the NEW violations (the
+    baseline/suppression pipeline has already run; grandfathered and
+    annotated findings do not become annotations)."""
+    rule_ids = sorted({v.rule for v in new})
+    meta_by_id = {r.id: r for r in rules_meta}
+    rules = []
+    for rid in rule_ids:
+        r = meta_by_id.get(rid)
+        rules.append({
+            "id": rid,
+            "shortDescription": {
+                "text": getattr(r, "description", rid) or rid},
+            "helpUri": "docs/lint.md",
+        })
+    results = []
+    for v in new:
+        results.append({
+            "ruleId": v.rule,
+            "level": _SARIF_LEVEL.get(v.severity, "warning"),
+            "message": {"text": v.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": v.path},
+                    "region": {
+                        "startLine": v.line,
+                        "startColumn": v.col + 1,
+                        "snippet": {"text": v.snippet},
+                    },
+                },
+                "logicalLocations": [{"fullyQualifiedName": v.symbol}],
+            }],
+        })
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri": "docs/lint.md",
+                "rules": rules,
+            }},
+            "results": results,
+            "properties": {"files_checked": files_checked},
+        }],
     }
     return json.dumps(doc, indent=2)

@@ -903,6 +903,70 @@ class Float64LiteralDrift(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 6b. lockwitness-in-kernel
+
+
+class LockwitnessInKernel(Rule):
+    id = "lockwitness-in-kernel"
+    description = (
+        "lockwitness (the runtime lock-order witness) referenced in "
+        "kernel files or inside a jit-decorated function"
+    )
+    rationale = (
+        "The witness wraps Python locks to record acquisition order; it "
+        "must stay strictly host-side. A reference inside "
+        "weaviate_tpu/ops/ or in a jitted function body would put "
+        "witness bookkeeping on the trace — at best a retrace per "
+        "install, at worst host callbacks inside the compiled program. "
+        "Instrument the callers, never the kernels."
+    )
+
+    _NAMES = ("lockwitness",)
+
+    def _mentions_witness(self, node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in self._NAMES:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in self._NAMES:
+                return True
+            if isinstance(n, (ast.Import, ast.ImportFrom)):
+                mod = getattr(n, "module", "") or ""
+                if "lockwitness" in mod or any(
+                        "lockwitness" in a.name for a in n.names):
+                    return True
+        return False
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if _path_in(ctx.rel_path, KERNEL_DIRS):
+            for node in ctx.walk(ast.Import, ast.ImportFrom, ast.Name,
+                                 ast.Attribute):
+                if self._mentions_witness(node):
+                    yield self.violation(
+                        ctx, node,
+                        "lockwitness referenced in a kernel file — the "
+                        "witness is host-side instrumentation and must "
+                        "never reach ops/ (wrap the caller's lock, not "
+                        "the kernel)",
+                    )
+                    return  # one finding per file is enough
+            return
+        if not ctx.rel_path.startswith("weaviate_tpu/"):
+            return
+        for fn in ctx.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+            if not any(_decorator_is_jit(d) for d in fn.decorator_list):
+                continue
+            if self._mentions_witness(
+                    ast.Module(body=fn.body, type_ignores=[])):
+                yield self.violation(
+                    ctx, fn,
+                    f"jit-decorated {fn.name}() references lockwitness — "
+                    "witness bookkeeping inside a traced function ends "
+                    "up in the compiled program; instrument outside the "
+                    "jit boundary",
+                )
+
+
+# ---------------------------------------------------------------------------
 # 7. suppression-missing-reason (meta-rule, emitted by the engine)
 
 
@@ -934,6 +998,65 @@ class SuppressionMissingReason(Rule):
             )
 
 
+# ---------------------------------------------------------------------------
+# 8. whole-program concurrency rules (driven by tools/graftlint/
+#    concurrency.py — the per-file check() is a no-op; the engine runs
+#    the interprocedural pass once per tree and routes its findings
+#    through the same suppression/baseline pipeline)
+
+
+class WholeProgramRule(Rule):
+    def check(self, ctx) -> Iterator[Violation]:
+        return iter(())
+
+
+class LockOrderCycle(WholeProgramRule):
+    id = "lock-order-cycle"
+    description = (
+        "cycle in the interprocedural lock-order graph (potential "
+        "deadlock), incl. self-deadlock on non-reentrant locks"
+    )
+    rationale = (
+        "Two threads entering a lock-order cycle from different edges "
+        "wedge forever — the PR 7 mesh-dispatch deadlock class. The "
+        "order graph is built whole-program: holding L while calling a "
+        "function that (transitively) acquires M is an L->M edge, so a "
+        "cycle spanning three modules is as visible as a nested with."
+    )
+
+
+class BlockingUnderLock(WholeProgramRule):
+    id = "blocking-under-lock"
+    description = (
+        "blocking operation (RPC send, sleep, Future.result, queue.get, "
+        "foreign cv/event wait, callee's device dispatch) reachable "
+        "while a lock is held"
+    )
+    rationale = (
+        "A lock held across a wait turns every contending thread into a "
+        "convoy behind one straggler, and held across an RPC it couples "
+        "local liveness to a remote peer. Snapshot under the lock, "
+        "release, then block. Interprocedural: the wait may be three "
+        "calls deep."
+    )
+
+
+class UnlockedCollectiveDispatch(WholeProgramRule):
+    id = "unlocked-collective-dispatch"
+    description = (
+        "collective-bearing mesh program dispatched on a path reachable "
+        "without mesh_dispatch_lock held"
+    )
+    rationale = (
+        "Collective SPMD programs (all_gather/psum rendezvous) must "
+        "enqueue on every device in one total order; two concurrent "
+        "dispatches can interleave per-device enqueues in opposite "
+        "orders and deadlock at the rendezvous — found live in PR 7, "
+        "enforced statically here. Wrap the dispatch in `with "
+        "mesh_dispatch_lock():`."
+    )
+
+
 ALL_RULES: tuple = (
     HostSyncInHotPath(),
     JitInLoop(),
@@ -946,6 +1069,10 @@ ALL_RULES: tuple = (
     HostLoopOverMesh(),
     LockAcrossDeviceCall(),
     Float64LiteralDrift(),
+    LockwitnessInKernel(),
+    LockOrderCycle(),
+    BlockingUnderLock(),
+    UnlockedCollectiveDispatch(),
     SuppressionMissingReason(),
 )
 

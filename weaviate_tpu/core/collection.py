@@ -793,6 +793,7 @@ class Collection:
         cannot lose each other's beacons."""
         self._check_ref_prop(prop)
         with self._ref_lock:
+            # graftlint: allow[blocking-under-lock] reason=ref RMW atomicity requires holding _ref_lock across get->put; a cold-tenant wait inside is bounded by the serving deadline
             obj = self.get(uuid, tenant=tenant)
             if obj is None:
                 raise KeyError(f"object {uuid!r} not found")
@@ -804,22 +805,26 @@ class Collection:
                 return
             beacons.append({"beacon": beacon})
             obj.properties[prop] = beacons
+            # graftlint: allow[blocking-under-lock] reason=ref RMW atomicity requires holding _ref_lock across get->put; a cold-tenant wait inside is bounded by the serving deadline
             self.put(obj, tenant=tenant)
 
     def replace_references(self, uuid: str, prop: str, beacons: list[str],
                            tenant: str = "") -> None:
         self._check_ref_prop(prop)
         with self._ref_lock:
+            # graftlint: allow[blocking-under-lock] reason=ref RMW atomicity requires holding _ref_lock across get->put; a cold-tenant wait inside is bounded by the serving deadline
             obj = self.get(uuid, tenant=tenant)
             if obj is None:
                 raise KeyError(f"object {uuid!r} not found")
             obj.properties[prop] = [{"beacon": b} for b in beacons]
+            # graftlint: allow[blocking-under-lock] reason=ref RMW atomicity requires holding _ref_lock across get->put; a cold-tenant wait inside is bounded by the serving deadline
             self.put(obj, tenant=tenant)
 
     def delete_reference(self, uuid: str, prop: str, beacon: str,
                          tenant: str = "") -> None:
         self._check_ref_prop(prop)
         with self._ref_lock:
+            # graftlint: allow[blocking-under-lock] reason=ref RMW atomicity requires holding _ref_lock across get->put; a cold-tenant wait inside is bounded by the serving deadline
             obj = self.get(uuid, tenant=tenant)
             if obj is None:
                 raise KeyError(f"object {uuid!r} not found")
@@ -830,6 +835,7 @@ class Collection:
                 b for b in beacons
                 if (b.get("beacon") if isinstance(b, dict) else b)
                 != beacon]
+            # graftlint: allow[blocking-under-lock] reason=ref RMW atomicity requires holding _ref_lock across get->put; a cold-tenant wait inside is bounded by the serving deadline
             self.put(obj, tenant=tenant)
 
     def delete_where(self, flt: Filter, tenant: str = "") -> int:

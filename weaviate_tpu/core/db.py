@@ -161,6 +161,7 @@ class DB:
                 raise ValueError(
                     f"collection name {config.name!r} collides with an "
                     "alias")
+            # graftlint: allow[blocking-under-lock] reason=schema ops serialize on the DB lock by design; create is not the serving path and the shard-open wait is deadline-bounded
             c = Collection(
                 os.path.join(self.root, config.name),
                 config,

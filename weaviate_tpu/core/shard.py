@@ -501,6 +501,7 @@ class Shard:
             for nm, (ids, vecs) in batches.items():
                 id_arr = np.asarray(ids, np.int64)
                 dims = int(np.asarray(vecs[0]).shape[-1])
+                # graftlint: allow[blocking-under-lock] reason=lazy index build on first write is the shard-open contract; the write already owns the shard
                 idx = self._index_for(nm, dims)
                 if (self.async_queue is not None
                         and not idx.multi_vector):

@@ -340,6 +340,7 @@ class HNSWIndex(VectorIndex):
         the expansion loop.
         """
         with self._scratch_lock:
+            # graftlint: allow[blocking-under-lock] reason=scratch buffers are the shared state the walk mutates per hop; serving uses the device beam, this host walk is the annotated fallback tier
             return self._search_level_impl(qdev, eps, ef, level, keep_mask,
                                            keep_k)
 

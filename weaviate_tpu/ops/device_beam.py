@@ -610,6 +610,7 @@ def device_search_mesh(
                 allow=allow, keep_k=keep_k)
     # merge=False (construction) has no cross-device rendezvous — the
     # per-shard walks are independent programs and cannot invert
+    # graftlint: allow[unlocked-collective-dispatch] reason=merge=False traces no all_gather; independent per-shard programs cannot invert
     return _fused_mesh_search(
         scorer, queries, operands, adjacency, present, upper_adj,
         upper_slots, ef=ef, max_steps=max_steps, fetch=fetch, mesh=mesh,

@@ -347,6 +347,7 @@ def sharded_maxsim(
     (``replicate_cached``): a rescore tier calling back with the same
     query token batch pays the upload once, not per invocation."""
     if mesh is None:
+        # graftlint: allow[unlocked-collective-dispatch] reason=mesh=None traces _local_maxsim without shard_map, no rendezvous
         return _sharded_maxsim_jit(query, cand_tokens, cand_mask,
                                    mesh=mesh, axis=axis)
     query = replicate_cached(query, mesh)
@@ -410,6 +411,7 @@ def sharded_gather_distance(
     the replicated query placement is cached on source identity
     (``replicate_cached``) — one upload per query batch, not per hop."""
     if mesh is None:
+        # graftlint: allow[unlocked-collective-dispatch] reason=mesh=None traces _local_gather_dists without shard_map, no rendezvous
         return _sharded_gather_distance_jit(
             corpus, queries, candidate_ids, metric,
             mesh=mesh, axis=axis, precision=precision)

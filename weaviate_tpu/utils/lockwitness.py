@@ -1,0 +1,396 @@
+"""Runtime lock-order witness: dynamic validation of the static model.
+
+``tools/graftlint/concurrency.py`` computes the *static* lock-order
+graph. This module is its runtime counterpart: an opt-in instrumented
+lock wrapper that records the dynamic held-set at every acquire, fails
+fast on an observed order inversion (the A->B vs B->A interleaving that
+deadlocks two threads — the PR 7 mesh-dispatch bug class), and can dump
+its observed graph so the static model is validated against reality.
+
+Design:
+
+- **Identity is the creation site** (module:qualname:line of the
+  ``threading.Lock()`` call), not the instance: lock *ordering* is a
+  class-level discipline. Two locks born at the same site (two
+  ``Collection._lock`` instances) are order-ambiguous hand-over-hand
+  territory, so same-site pairs are never recorded — the witness only
+  judges cross-site order.
+- **Edges come from blocking acquires only.** A successful trylock
+  (``acquire(blocking=False)``) cannot deadlock — it would have
+  returned ``False`` — so it extends the held-set but records no edge.
+- **Reentrancy is understood.** Re-acquiring an RLock already held by
+  this thread is bookkeeping, not an ordering event. ``Condition.wait``
+  releases the underlying lock via ``_release_save`` — the wrapper
+  forwards those internals and pops/restores the held-set so a thread
+  parked in ``wait()`` is not falsely "holding" the lock.
+- **Host-side only.** Locks live in Python control flow; nothing here
+  may reach a jitted/traced code path (enforced statically by the
+  ``lockwitness-in-kernel`` graftlint rule). ``install()`` wraps only
+  locks *created by weaviate_tpu modules* — jax, logging and the rest
+  of the interpreter keep raw primitives and pay zero overhead.
+
+Activation (tests): ``tests/conftest.py`` installs the witness before
+any weaviate_tpu import when ``WEAVIATE_TPU_LOCK_WITNESS`` is not
+``off`` (default ``record``: inversions are collected and the session
+fails at exit; ``strict`` raises :class:`LockOrderInversion` at the
+offending acquire).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "LockOrderInversion", "LockWitness", "WitnessLock", "install",
+    "uninstall", "installed", "current", "isolated", "wrap",
+]
+
+_RAW_LOCK = threading.Lock
+_RAW_RLOCK = threading.RLock
+
+# modules whose frames are skipped when attributing a creation site
+_SKIP_MODULES = ("threading", __name__)
+
+
+class LockOrderInversion(RuntimeError):
+    """Acquiring B while holding A after having observed A acquired
+    while holding B — two threads running both paths concurrently can
+    deadlock."""
+
+
+def _creation_site(name: Optional[str]) -> str:
+    if name:
+        return name
+    f = sys._getframe(2)
+    while f is not None:
+        mod = f.f_globals.get("__name__", "")
+        if not any(mod == m or mod.startswith(m + ".")
+                   for m in _SKIP_MODULES):
+            return f"{mod}:{f.f_code.co_name}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _stack_note(limit: int = 5) -> str:
+    frames = traceback.extract_stack()
+    keep = [fr for fr in frames
+            if "lockwitness" not in fr.filename
+            and "/threading.py" not in fr.filename][-limit:]
+    return " <- ".join(f"{os.path.basename(fr.filename)}:{fr.lineno}"
+                       f"({fr.name})" for fr in reversed(keep))
+
+
+# The held-set is a property of the THREAD, not of any particular
+# witness: it must survive `isolated()` swapping the current recorder
+# mid-flight (a lock acquired before the window and released inside it
+# would otherwise leave a permanent stale "held" entry in the session
+# witness, producing phantom edges and false inversions later).
+_tls = threading.local()
+
+
+def _held() -> List["WitnessLock"]:
+    try:
+        return _tls.held
+    except AttributeError:
+        _tls.held = []
+        return _tls.held
+
+
+class LockWitness:
+    """The acquisition-order recorder: observed edges + inversions."""
+
+    def __init__(self, strict: bool = False):
+        self.strict = strict
+        self._mu = _RAW_LOCK()
+        # (held_site, acquired_site) -> first-observation note
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self.inversions: List[dict] = []
+        self.acquires = 0  # total blocking acquisitions witnessed
+
+    # -- held-set (shared across witnesses; see module note) ------------
+
+    def _held(self) -> List["WitnessLock"]:
+        return _held()
+
+    # -- the check ------------------------------------------------------
+
+    def before_blocking_acquire(self, lock: "WitnessLock") -> None:
+        held = self._held()
+        self.acquires += 1
+        if any(h is lock for h in held):
+            return  # reentrant re-acquire: bookkeeping, not ordering
+        note = None
+        for h in held:
+            if h.site == lock.site:
+                continue  # same-site pair: order-ambiguous by design
+            key = (h.site, lock.site)
+            rev = (lock.site, h.site)
+            # check + insert must be ONE critical section: two threads
+            # establishing A->B and B->A concurrently for the first time
+            # would otherwise each pass the reverse check before either
+            # records, and a once-per-session inversion slips through
+            with self._mu:
+                prior = self._edges.get(rev)
+                if prior is not None:
+                    inv = {
+                        "acquiring": lock.site,
+                        "holding": h.site,
+                        "here": _stack_note(),
+                        "prior_order": f"{lock.site} -> {h.site}",
+                        "prior_note": prior,
+                        "thread": threading.current_thread().name,
+                    }
+                    self.inversions.append(inv)
+                    if self.strict:
+                        raise LockOrderInversion(
+                            f"lock-order inversion: acquiring {lock.site} "
+                            f"while holding {h.site}, but the opposite "
+                            f"order was observed earlier ({prior}); "
+                            f"here: {inv['here']}")
+                elif key not in self._edges:
+                    if note is None:  # first new edge pays the stack walk
+                        note = _stack_note()
+                    self._edges[key] = note
+
+    def push(self, lock: "WitnessLock") -> None:
+        self._held().append(lock)
+
+    def pop(self, lock: "WitnessLock") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def pop_all(self, lock: "WitnessLock") -> int:
+        held = self._held()
+        n = sum(1 for h in held if h is lock)
+        if n:
+            held[:] = [h for h in held if h is not lock]
+        return n
+
+    def push_n(self, lock: "WitnessLock", n: int) -> None:
+        self._held().extend([lock] * n)
+
+    # -- introspection --------------------------------------------------
+
+    def observed_edges(self) -> Dict[Tuple[str, str], str]:
+        with self._mu:
+            return dict(self._edges)
+
+    def dump_dot(self) -> str:
+        """Observed order graph, same shape as the static model's
+        ``--format dot`` so the two can be diffed."""
+        out = ["digraph observed_lock_order {", "  rankdir=LR;"]
+        with self._mu:
+            edges = sorted(self._edges)
+            bad = {(i["holding"], i["acquiring"]) for i in self.inversions}
+        for (s, d) in edges:
+            color = ' color=red' if ((s, d) in bad or (d, s) in bad) else ""
+            out.append(f'  "{s}" -> "{d}" [fontsize=8{color}];')
+        out.append("}")
+        return "\n".join(out)
+
+    def report(self) -> str:
+        lines = [f"lockwitness: {self.acquires} ordered acquisitions, "
+                 f"{len(self._edges)} edges, "
+                 f"{len(self.inversions)} inversion(s)"]
+        for inv in self.inversions:
+            lines.append(
+                f"  INVERSION [{inv['thread']}]: acquiring "
+                f"{inv['acquiring']} while holding {inv['holding']} — "
+                f"opposite order seen at {inv['prior_note']}; "
+                f"here: {inv['here']}")
+        return "\n".join(lines)
+
+
+class WitnessLock:
+    """Wrapper around a Lock/RLock primitive that reports every
+    acquisition to the witness. API-compatible where it matters
+    (acquire/release/locked/context manager/Condition internals)."""
+
+    __slots__ = ("_inner", "site", "_witness")
+
+    def __init__(self, inner=None, name: Optional[str] = None,
+                 witness: Optional[LockWitness] = None):
+        self._inner = inner if inner is not None else _RAW_LOCK()
+        self.site = _creation_site(name)
+        self._witness = witness
+
+    def _w(self) -> LockWitness:
+        return self._witness or current()
+
+    # -- core API -------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        w = self._w()
+        if blocking:
+            w.before_blocking_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            w.push(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._w().pop(self)
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        if inner_locked is not None:
+            return inner_locked()
+        return False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<WitnessLock {self.site} wrapping {self._inner!r}>"
+
+    # -- Condition internals (RLock wrappers) ---------------------------
+    # Condition.wait() fully releases the lock via _release_save and
+    # re-takes it via _acquire_restore; forward both and keep the
+    # held-set honest so a parked waiter isn't "holding" the lock.
+
+    def _release_save(self):
+        inner = self._inner
+        n = self._w().pop_all(self)
+        if hasattr(inner, "_release_save"):
+            return (inner._release_save(), n)
+        inner.release()
+        return (None, n)
+
+    def _acquire_restore(self, state):
+        inner_state, n = state
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(inner_state)
+        else:
+            inner.acquire()
+        self._w().push_n(self, max(1, n))
+
+    def _is_owned(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        # plain Lock fallback, mirroring threading.Condition._is_owned
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self) -> None:  # pragma: no cover - fork safety
+        reinit = getattr(self._inner, "_at_fork_reinit", None)
+        if reinit is not None:
+            reinit()
+
+
+# ---------------------------------------------------------------------------
+# module state + installation
+
+
+_default_witness = LockWitness()
+_current: LockWitness = _default_witness
+_installed = False
+_WRAP_PREFIXES = ("weaviate_tpu",)
+
+
+def current() -> LockWitness:
+    return _current
+
+
+def installed() -> bool:
+    return _installed
+
+
+def _creator_is_wrapped() -> bool:
+    f = sys._getframe(2)
+    while f is not None:
+        mod = f.f_globals.get("__name__", "")
+        if any(mod == m or mod.startswith(m + ".")
+               for m in _SKIP_MODULES):
+            f = f.f_back
+            continue
+        return any(mod == p or mod.startswith(p + ".")
+                   for p in _WRAP_PREFIXES)
+    return False
+
+
+class _Factory:
+    """Callable object, deliberately NOT a function: third-party code
+    stores ``lock_class = Lock`` as a class attribute and calls
+    ``self.lock_class()`` — a plain function there would be bound as a
+    method and receive ``self``; an instance with ``__call__`` is not a
+    descriptor and behaves like the C factory it replaces."""
+
+    __slots__ = ("_raw",)
+
+    def __init__(self, raw):
+        self._raw = raw
+
+    def __call__(self):
+        if _installed and _creator_is_wrapped():
+            return WitnessLock(self._raw())
+        return self._raw()
+
+
+_lock_factory = _Factory(_RAW_LOCK)
+_rlock_factory = _Factory(_RAW_RLOCK)
+
+
+def install(strict: bool = False) -> LockWitness:
+    """Patch ``threading.Lock``/``RLock`` so locks created by
+    weaviate_tpu modules from now on are witness-wrapped. Locks created
+    before installation (or by other packages) stay raw. Idempotent."""
+    global _installed, _current
+    _current.strict = strict
+    if _installed:
+        return _current
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _installed = True
+    return _current
+
+
+def uninstall() -> None:
+    """Restore the raw factories. Already-wrapped locks keep working
+    (they delegate to real primitives); they just stop being recorded
+    against a fresh witness if one is installed later."""
+    global _installed
+    threading.Lock = _RAW_LOCK
+    threading.RLock = _RAW_RLOCK
+    _installed = False
+
+
+def wrap(lock, name: str) -> WitnessLock:
+    """Explicitly wrap an existing lock (e.g. one created before
+    ``install()``) under the current witness."""
+    return WitnessLock(lock, name=name)
+
+
+class isolated:
+    """Context manager swapping in a fresh witness — tests that
+    deliberately provoke inversions must not pollute the session-wide
+    zero-inversion assertion."""
+
+    def __init__(self, strict: bool = True):
+        self._fresh = LockWitness(strict=strict)
+        self._prev: Optional[LockWitness] = None
+
+    def __enter__(self) -> LockWitness:
+        global _current
+        self._prev = _current
+        _current = self._fresh
+        return self._fresh
+
+    def __exit__(self, *exc) -> None:
+        global _current
+        _current = self._prev
