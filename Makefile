@@ -1,7 +1,7 @@
 # Developer/CI entry points. Tier-1 itself is driven by ROADMAP.md's
 # pytest line; these targets cover the static-analysis side.
 
-.PHONY: lint lint-sarif lint-dot lint-fix-baseline test
+.PHONY: lint lint-sarif lint-dot lint-fix-baseline test trace-demo
 
 # Full graftlint: every per-file rule plus the interprocedural
 # concurrency pass (lock-order cycles, blocking-under-lock, unlocked
@@ -28,3 +28,10 @@ lint-fix-baseline:
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		-p no:cacheprovider
+
+# Boot a node on a loopback port, run a mixed search/ingest burst, and
+# pretty-print the assembled trace tree from /v1/debug/traces — the
+# quickest way to SEE what docs/tracing.md describes. Smoke-tested in
+# tier-1 (tests/test_observability.py::test_trace_demo_smoke).
+trace-demo:
+	JAX_PLATFORMS=cpu python -m tools.trace_demo

@@ -107,6 +107,48 @@ def _pipelined_thread_qps(run, batch, threads=8, reps=4, rounds=2):
     return best
 
 
+def _dispatch_split(prefix, run, reps=32, threads=4):
+    """Queue-wait vs device-time split from the dispatcher's batch spans
+    (docs/tracing.md): run a short traced burst (each query under its
+    own sampled root so the coalescing dispatcher emits dispatch.batch
+    spans) and journal `{prefix}_queue_ms_p99` / `{prefix}_device_ms_p99`
+    next to the QPS headline — the split that EXPLAINS a p99, not just
+    reports it. Threads force real coalescing, so queue_ms is the
+    contention the pipelined QPS number actually experienced."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from weaviate_tpu.monitoring.tracing import TRACER
+
+    t0 = time.time_ns()
+
+    def traced():
+        with TRACER.span("bench.query", parent=None):
+            run()
+
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        for f in [pool.submit(traced) for _ in range(reps)]:
+            f.result()
+    spans = [s for s in TRACER.recent(limit=TRACER.max_spans)
+             if s["name"] == "dispatch.batch"
+             and s["startTimeUnixNano"] >= t0]
+    if not spans:
+        return  # path never reached the coalescing dispatcher
+    q = [float(s["attributes"].get("queue_ms", 0.0)) for s in spans]
+    dv = [float(s["attributes"].get("device_ms", 0.0)) for s in spans]
+    _emit({
+        "metric": f"{prefix}_queue_ms_p99",
+        "value": round(float(np.percentile(q, 99)), 3),
+        "unit": "ms", "batches": len(spans), "threads": threads,
+        "note": "dispatcher enqueue->drain wait, from dispatch.batch spans",
+    })
+    _emit({
+        "metric": f"{prefix}_device_ms_p99",
+        "value": round(float(np.percentile(dv, 99)), 3),
+        "unit": "ms", "batches": len(spans), "threads": threads,
+        "note": "device batch service time, from dispatch.batch spans",
+    })
+
+
 def _recall(ids, gt_ids, k):
     ids = np.asarray(ids)
     return float(
@@ -178,7 +220,7 @@ CONFIG_METRICS = {
     "pq": (lambda m: m.startswith("pq_qps_1M"),) * 2,
     # headline: the devbeam lines only — a cached hostbeam number must
     # not stand in for the device-walk measurement this config exists for
-    "hnswquant": (lambda m: m.startswith(("hnsw_pq_qps_", "hnsw_bq_qps_")),
+    "hnswquant": (lambda m: m.startswith(("hnsw_pq_", "hnsw_bq_")),
                   lambda m: m.startswith(("hnsw_pq_qps_devbeam",
                                           "hnsw_bq_qps_devbeam"))),
     "bq": (lambda m: m.startswith("bq_qps_10M"),) * 2,
@@ -528,6 +570,10 @@ def bench_glove(n=1_200_000, d=25, batch=256, k=10, ef=64, iters=20, warmup=2):
 
     cpu_qps = _cpu_bruteforce(queries[:16], corpus, k, "cosine")
 
+    # queue-wait vs device-time split for this config, emitted before
+    # the QPS headline
+    _dispatch_split("hnsw_glove", run)
+
     _emit({
         "metric": f"hnsw_glove_qps_{n // 100_000 / 10}M_{d}d_ef{ef}",
         "value": round(qps, 1),
@@ -727,6 +773,10 @@ def bench_hnsw_quant(n=1_000_000, batch=256, k=10, ef=96, iters=15,
                        _pipelined_thread_qps(run, batch))
         host_recall = _recall(res_h.ids, gt_ids, k)
         idx._device_beam, idx.graph.dirty_hook = beam_obj, hook
+
+        # queue-wait vs device-time split on the devbeam path, emitted
+        # BEFORE the QPS lines so the headline stays last
+        _dispatch_split(f"hnsw_{kind}", run)
 
         # hostbeam first, devbeam LAST: the driver parses the final
         # stdout line as the headline

@@ -222,3 +222,34 @@ def test_hnsw_concurrent_search_matches_serial_with_bounded_tail():
         p50 = float(np.percentile(lat, 50))
         p99 = float(np.percentile(lat, 99))
     assert p99 < 3.0 * p50, f"p99 {p99*1e3:.1f}ms vs p50 {p50*1e3:.1f}ms"
+
+
+def test_unsampled_batch_never_annotates_leader_trace():
+    """A leader whose OWN request is sampled may first drain a group
+    containing only unsampled requests: the walk's device-time
+    annotations for that group must not stamp the leader's unrelated
+    request span (they go nowhere — the batch had no sampled member)."""
+    from weaviate_tpu.index.dispatch import _Req
+    from weaviate_tpu.monitoring import tracing
+
+    def run_batch(q, k, allow):
+        tracing.annotate(devleak=True)  # what the fused walk does
+        return (np.full((q.shape[0], k), -1, np.int64),
+                np.zeros((q.shape[0], k), np.float32))
+
+    d = CoalescingDispatcher(run_batch)
+    # a pending request from an UNSAMPLED context, queued ahead of ours
+    ghost = _Req(np.zeros((1, 4), np.float32), 3, None, tier_key="ghost")
+    assert ghost.span is None
+    d._pending.append(ghost)
+    with tracing.TRACER.span("request", parent=None) as req_span:
+        d.search(np.zeros((2, 4), np.float32), 3, tier_key="mine")
+    assert ghost.event.is_set()  # the ghost group did run
+    # the ghost batch's annotation never leaked onto our request span...
+    assert "devleak" not in req_span.attributes
+    # ...while our own (sampled) group's batch span absorbed its copy
+    batches = [s for s in tracing.TRACER.recent(limit=200)
+               if s["name"] == "dispatch.batch"
+               and s["traceId"] == req_span.trace_id]
+    assert batches and all(s["attributes"].get("devleak")
+                           for s in batches)

@@ -1714,6 +1714,70 @@ class TestLockwitnessInKernel:
         assert "lockwitness-in-kernel" not in rule_ids(res)
 
 
+class TestTracerInKernel:
+    def test_import_in_ops_flagged(self):
+        res = run("""
+            from weaviate_tpu.monitoring import tracing
+
+            def f():
+                with tracing.TRACER.span("kernel"):
+                    pass
+        """, rel=KERNEL)
+        assert "tracer-in-kernel" in rule_ids(res)
+
+    def test_tracer_name_in_ops_flagged(self):
+        res = run("""
+            from weaviate_tpu.monitoring.tracing import TRACER
+
+            def f(x):
+                TRACER.span("walk").set(rows=x)
+        """, rel=KERNEL)
+        assert "tracer-in-kernel" in rule_ids(res)
+
+    def test_reference_in_jitted_function_flagged(self):
+        res = run("""
+            import jax
+            from weaviate_tpu.monitoring import tracing
+
+            @jax.jit
+            def f(x):
+                # a span in a traced-out body runs once at trace time:
+                # silent wrongness, not overhead
+                with tracing.TRACER.span("inner"):
+                    return x
+        """, rel=COLD)
+        assert "tracer-in-kernel" in rule_ids(res)
+
+    def test_host_side_use_clean(self):
+        res = run("""
+            from weaviate_tpu.monitoring import tracing
+
+            def f():
+                with tracing.TRACER.span("dispatch.batch"):
+                    pass
+        """, rel=COLD)
+        assert "tracer-in-kernel" not in rule_ids(res)
+
+    def test_jitted_without_tracer_clean(self):
+        res = run("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x + 1
+        """, rel=COLD)
+        assert "tracer-in-kernel" not in rule_ids(res)
+
+    def test_suppression_honored(self):
+        res = run("""
+            from weaviate_tpu.monitoring import tracing  # graftlint: allow[tracer-in-kernel] reason=test fixture
+
+            def f():
+                return tracing.current_trace_id()
+        """, rel=KERNEL)
+        assert "tracer-in-kernel" not in rule_ids(res)
+
+
 class TestConcurrencyEngineIntegration:
     def test_concurrency_suppression_counts_as_used(self):
         # an allow-comment consumed by a whole-program finding must not

@@ -1,0 +1,50 @@
+"""docs/metrics.md is the canonical instrument list (reference
+docs/metrics.md): every registered instrument must be documented, and
+every documented metric must exist — drift fails the build. The registry
+grew ~30 instruments across PRs 3–8 by hand-maintained parallel edits;
+these assertions are what keeps the two files one file."""
+
+import re
+
+from weaviate_tpu.monitoring.metrics import REGISTRY
+
+
+def _doc():
+    return open("docs/metrics.md").read()
+
+
+def test_docs_cover_registry_both_directions():
+    doc = _doc()
+    documented = set(re.findall(r"`(weaviate_tpu_[a-z0-9_]+)`", doc))
+    registered = set(REGISTRY._metrics)
+    missing = registered - documented
+    stale = documented - registered
+    assert not missing, f"instruments not documented: {missing}"
+    assert not stale, f"documented but unregistered: {stale}"
+
+
+def test_docs_kind_column_matches_registry():
+    """The table's kind column must agree with the registered metric
+    type — a counter documented as a gauge misleads every dashboard
+    built off the docs."""
+    doc = _doc()
+    row = re.compile(r"^\|\s*`(weaviate_tpu_[a-z0-9_]+)`\s*\|"
+                     r"\s*(counter|gauge|histogram)\s*\|", re.M)
+    seen = {}
+    for name, kind in row.findall(doc):
+        seen[name] = kind
+    assert seen, "docs/metrics.md table not parseable"
+    for name, kind in seen.items():
+        m = REGISTRY._metrics.get(name)
+        assert m is not None, name
+        assert m.kind == kind, (
+            f"{name} documented as {kind} but registered as {m.kind}")
+    # every registered instrument appears as a table ROW (not merely
+    # mentioned in prose somewhere)
+    missing_rows = set(REGISTRY._metrics) - set(seen)
+    assert not missing_rows, f"no table row for: {missing_rows}"
+
+
+def test_every_instrument_has_help_text():
+    empty = [n for n, m in REGISTRY._metrics.items() if not m.help.strip()]
+    assert not empty, f"instruments registered without help text: {empty}"

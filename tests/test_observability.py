@@ -48,6 +48,192 @@ def test_tracer_bounds_memory():
     assert len(tr.recent(limit=100)) == 10
 
 
+def test_traceparent_roundtrip():
+    from weaviate_tpu.monitoring.tracing import parse_traceparent
+
+    tr = Tracer()
+    with tr.span("root") as s:
+        tp = s.traceparent
+    ctx = parse_traceparent(tp)
+    assert ctx.trace_id == s.trace_id and ctx.span_id == s.span_id
+    assert ctx.sampled
+    # malformed headers never fail the request: they parse to None
+    for bad in ("", "junk", "00-short-short-01", "00-" + "zz" * 16
+                + "-" + "cd" * 8 + "-01"):
+        assert parse_traceparent(bad) is None
+    # unsampled flag is honored
+    assert parse_traceparent(
+        "00-" + "ab" * 16 + "-" + "cd" * 8 + "-00").sampled is False
+
+
+def test_remote_parent_and_links_and_events():
+    from weaviate_tpu.monitoring.tracing import SpanContext
+
+    tr = Tracer()
+    remote = SpanContext("ab" * 16, "cd" * 8, True)
+    other = SpanContext("ef" * 16, "12" * 8, True)
+    with tr.span("server", parent=remote, links=[other]) as s:
+        s.add_event("retry", attempt=1)
+    d = tr.recent()[-1]
+    assert d["traceId"] == "ab" * 16 and d["parentSpanId"] == "cd" * 8
+    assert d["links"][0]["traceId"] == "ef" * 16
+    assert d["events"][0]["name"] == "retry"
+    assert d["events"][0]["attributes"]["attempt"] == 1
+
+
+def test_sampling_rate_zero_and_inheritance():
+    tr = Tracer(sample_rate=0.0)
+    with tr.span("root") as root:
+        assert not root.sampled
+        with tr.span("child") as child:
+            # the verdict is decided ONCE at the root and inherited
+            assert not child.sampled and child.span_id == ""
+    assert tr.recent() == []
+    # an explicitly sampled remote parent overrides the local rate:
+    # the caller already decided to trace this request
+    from weaviate_tpu.monitoring.tracing import SpanContext
+
+    with tr.span("server", parent=SpanContext("ab" * 16, "cd" * 8, True)):
+        pass
+    assert [s["name"] for s in tr.recent()] == ["server"]
+
+
+def test_truncated_trace_synthesizes_placeholder_root():
+    """Satellite: when the bounded buffer evicted a trace's root, the
+    orphaned children must not be misattributed to group[0] as the root,
+    and the duration must be the span extent — the trace is rendered
+    under a synthesized placeholder and marked truncated."""
+    tr = Tracer(max_spans=3)
+    with tr.span("root2") as root:
+        ctx = root.context
+    # LOCAL children (parent passed as the Span, not a remote
+    # SpanContext) finishing after the root pushed it out of maxlen=3
+    with tr.span("c1", parent=root):
+        pass
+    with tr.span("c2", parent=root):
+        pass
+    with tr.span("c3", parent=root):
+        pass
+    # buffer holds c1..c3; root2 was evicted
+    (trace,) = [t for t in tr.traces() if t["traceId"] == ctx.trace_id]
+    assert trace["truncated"] is True
+    assert trace["root"] == "(root evicted)"
+    tree = tr.trace_tree(ctx.trace_id)
+    assert tree["truncated"] and tree["tree"]["synthesized"]
+    assert {c["name"] for c in tree["tree"]["children"]} == \
+        {"c1", "c2", "c3"}
+    # durationMs is the extent over the surviving spans, not a max over
+    # disconnected subtree durations
+    spans = tr.recent(limit=10, trace_id=ctx.trace_id)
+    extent = (max(s["endTimeUnixNano"] for s in spans)
+              - min(s["startTimeUnixNano"] for s in spans)) / 1e6
+    assert abs(trace["durationMs"] - extent) < 0.01
+
+
+def test_in_flight_trace_is_not_reported_truncated():
+    """A trace whose root is still OPEN (finished children only in the
+    buffer) is IN FLIGHT — exactly the slow request an operator queries
+    mid-execution — and must not be misreported as '(root evicted)'."""
+    tr = Tracer()
+    root = tr.span("slow_request")
+    root.__enter__()
+    try:
+        with tr.span("child"):
+            pass
+        (trace,) = [t for t in tr.traces()
+                    if t["traceId"] == root.trace_id]
+        assert trace["truncated"] is False and trace["inFlight"] is True
+        assert trace["root"] == "(in flight)"
+        tree = tr.trace_tree(root.trace_id)
+        assert tree["tree"]["name"] == "(in flight)"
+    finally:
+        root.__exit__(None, None, None)
+    # once the root finishes, the trace assembles normally
+    tree = tr.trace_tree(root.trace_id)
+    assert tree["root"] == "slow_request" and not tree["inFlight"]
+
+
+def test_remote_parented_span_is_a_local_root_not_truncation():
+    """A span continued from an incoming traceparent (or transport
+    envelope) has a parent that lives in ANOTHER process — it must
+    render as this process's legitimate root, never as '(root evicted)'
+    with a truncated flag."""
+    from weaviate_tpu.monitoring.tracing import SpanContext
+
+    tr = Tracer()
+    remote = SpanContext("ab" * 16, "cd" * 8, True)
+    with tr.span("server", parent=remote):
+        with tr.span("inner"):
+            pass
+    (trace,) = [t for t in tr.traces() if t["traceId"] == "ab" * 16]
+    assert trace["truncated"] is False
+    assert trace["root"] == "server"
+    tree = tr.trace_tree("ab" * 16)
+    assert tree["tree"]["name"] == "server"
+    assert [c["name"] for c in tree["tree"]["children"]] == ["inner"]
+
+
+def test_trace_tree_nests_children():
+    tr = Tracer()
+    with tr.span("root") as r:
+        with tr.span("a"):
+            with tr.span("a1"):
+                pass
+        with tr.span("b"):
+            pass
+    tree = tr.trace_tree(r.trace_id)
+    assert not tree["truncated"]
+    node = tree["tree"]
+    assert node["name"] == "root"
+    assert [c["name"] for c in node["children"]] == ["a", "b"]
+    assert [c["name"] for c in node["children"][0]["children"]] == ["a1"]
+
+
+def test_otlp_jsonl_export_shape():
+    tr = Tracer()
+    with tr.span("root", kind="test") as r:
+        pass
+    lines = tr.export_otlp_jsonl(r.trace_id).splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    span = rec["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert span["name"] == "root" and span["traceId"] == r.trace_id
+    assert {"key": "kind", "value": {"stringValue": "test"}} \
+        in span["attributes"]
+    res_attrs = rec["resourceSpans"][0]["resource"]["attributes"]
+    assert {"key": "service.name",
+            "value": {"stringValue": "weaviate_tpu"}} in res_attrs
+
+
+def test_histogram_exemplar_tracks_worst():
+    from weaviate_tpu.monitoring.metrics import Histogram
+
+    h = Histogram("test_exemplar_seconds")
+    h.observe(0.1, exemplar="t1", lane="x")
+    h.observe(0.5, exemplar="t2", lane="x")
+    h.observe(0.2, exemplar="t3", lane="x")
+    h.observe(0.9, lane="x")  # no trace id: never displaces an exemplar
+    assert h.exemplar(lane="x") == (0.5, "t2")
+    ex = h.exemplars()
+    assert ex['{lane="x"}'] == {"value": 0.5, "trace_id": "t2"}
+
+
+def test_devtime_compile_vs_execute():
+    from weaviate_tpu.monitoring import devtime
+    from weaviate_tpu.monitoring.metrics import DEVICE_TIME_SECONDS
+
+    devtime.reset()
+    base = DEVICE_TIME_SECONDS.count(phase="compile", backend="B",
+                                     scorer="S", mesh="single")
+    assert devtime.record("B", "S", "single", (8, 16), 1.5) == "compile"
+    assert devtime.record("B", "S", "single", (8, 16), 0.01) == "execute"
+    # a new shape bucket recompiles
+    assert devtime.record("B", "S", "single", (16, 16), 1.0) == "compile"
+    assert DEVICE_TIME_SECONDS.count(
+        phase="compile", backend="B", scorer="S", mesh="single") \
+        == base + 2
+
+
 # -- runtime config ----------------------------------------------------------
 
 def test_runtime_overrides_file_roundtrip(tmp_path):
@@ -208,6 +394,22 @@ def test_rest_debug_endpoints():
         db.close()
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def test_trace_demo_smoke():
+    """`make trace-demo` end to end against the in-proc server: the
+    demo must boot, burst, and render a rest.graphql trace tree that
+    reaches the dispatcher's batch span."""
+    from tools.trace_demo import run
+
+    lines: list[str] = []
+    tree = run(out=lines.append)
+    assert tree["root"] == "rest.graphql"
+    joined = "\n".join(lines)
+    assert "rest.graphql" in joined
+    assert "qos.queue" in joined
+    assert "dispatch.batch" in joined
+    assert "└─" in joined  # the tree actually rendered as a tree
 
 
 def test_telemetry_payload_counts():

@@ -967,6 +967,72 @@ class LockwitnessInKernel(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 6c. tracer-in-kernel
+
+
+class TracerInKernel(Rule):
+    id = "tracer-in-kernel"
+    description = (
+        "tracer/span references in kernel files or inside a "
+        "jit-decorated function"
+    )
+    rationale = (
+        "Spans are host-side bookkeeping; a ``span.__enter__`` inside a "
+        "traced-out function runs ONCE at trace time and never again — "
+        "the span silently reports nothing (or worse, one stale "
+        "compile-time measurement) while looking instrumented. A tracer "
+        "reference in weaviate_tpu/ops/ or in a jitted body is therefore "
+        "silent wrongness, not overhead. Instrument the dispatch SITE "
+        "(index/, serving/, cluster/), never the kernel."
+    )
+
+    _NAMES = ("TRACER", "tracing")
+
+    def _mentions_tracer(self, node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in self._NAMES:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in self._NAMES:
+                return True
+            if isinstance(n, (ast.Import, ast.ImportFrom)):
+                mod = getattr(n, "module", "") or ""
+                if "monitoring.tracing" in mod or mod == "tracing" or any(
+                        a.name == "tracing" or a.name == "TRACER"
+                        or a.name.endswith(".tracing") for a in n.names):
+                    return True
+        return False
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if _path_in(ctx.rel_path, KERNEL_DIRS):
+            for node in ctx.walk(ast.Import, ast.ImportFrom, ast.Name,
+                                 ast.Attribute):
+                if self._mentions_tracer(node):
+                    yield self.violation(
+                        ctx, node,
+                        "tracer referenced in a kernel file — spans are "
+                        "host-side and a span in traced code reports "
+                        "nothing; instrument the dispatch site, never "
+                        "ops/",
+                    )
+                    return  # one finding per file is enough
+            return
+        if not ctx.rel_path.startswith("weaviate_tpu/"):
+            return
+        for fn in ctx.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+            if not any(_decorator_is_jit(d) for d in fn.decorator_list):
+                continue
+            if self._mentions_tracer(
+                    ast.Module(body=fn.body, type_ignores=[])):
+                yield self.violation(
+                    ctx, fn,
+                    f"jit-decorated {fn.name}() references the tracer — "
+                    "a span __enter__ in a traced-out function runs at "
+                    "trace time only and measures nothing; span the "
+                    "caller outside the jit boundary",
+                )
+
+
+# ---------------------------------------------------------------------------
 # 7. suppression-missing-reason (meta-rule, emitted by the engine)
 
 
@@ -1070,6 +1136,7 @@ ALL_RULES: tuple = (
     LockAcrossDeviceCall(),
     Float64LiteralDrift(),
     LockwitnessInKernel(),
+    TracerInKernel(),
     LockOrderCycle(),
     BlockingUnderLock(),
     UnlockedCollectiveDispatch(),

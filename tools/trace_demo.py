@@ -1,0 +1,125 @@
+"""`make trace-demo`: boot a node, fire a mixed burst, print a trace tree.
+
+Boots a single-node DB + REST server on a loopback port, runs a small
+mixed search/ingest burst through the real HTTP surface (so the spans
+come from the actual ingress → QoS → collection → dispatcher path, not
+a synthetic fixture), then fetches `/v1/debug/traces`, picks the newest
+search trace, and pretty-prints its assembled tree — the five-minute
+"what does a trace look like here" tour of docs/tracing.md.
+
+Tier-1 smoke-tests `run()` against the in-proc server; no external
+network is touched (everything binds 127.0.0.1).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import tempfile
+import urllib.request
+
+
+def _fetch(base: str, path: str, body=None):
+    req = urllib.request.Request(
+        base + path,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="GET" if body is None else "POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def render_tree(node: dict, prefix: str = "", last: bool = True,
+                root: bool = True) -> list[str]:
+    """One span per line, box-drawing glyphs, duration + the attributes
+    that explain where the time went."""
+    attrs = node.get("attributes", {})
+    interesting = {k: v for k, v in attrs.items()
+                   if k in ("lane", "queue_wait_ms", "queue_ms",
+                            "device_ms", "device_phase", "batch_size",
+                            "rows", "peer", "node", "tier", "method",
+                            "path", "error")}
+    extra = (" " + " ".join(f"{k}={v}" for k, v in interesting.items())
+             if interesting else "")
+    glyph = "" if root else ("└─ " if last else "├─ ")
+    status = "" if node.get("status", "OK") == "OK" else " [ERROR]"
+    lines = [f"{prefix}{glyph}{node['name']}  "
+             f"{node.get('durationMs', 0):.2f}ms{status}{extra}"]
+    kids = node.get("children", [])
+    child_prefix = prefix + ("" if root else ("   " if last else "│  "))
+    for i, kid in enumerate(kids):
+        lines.extend(render_tree(kid, child_prefix, i == len(kids) - 1,
+                                 root=False))
+    return lines
+
+
+def run(out=print) -> dict:
+    """Boot, burst, fetch, print. Returns the rendered trace (for the
+    tier-1 smoke test). Everything is torn down before returning."""
+    from weaviate_tpu.api.rest import RestAPI
+    from weaviate_tpu.core.db import DB
+
+    tmp = tempfile.mkdtemp(prefix="trace-demo-")
+    db = api = None
+    try:
+        db = DB(tmp)
+        api = RestAPI(db)
+        srv = api.serve(host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{srv.server_port}"
+
+        out("• creating collection Demo (hnsw, 8d) ...")
+        _fetch(base, "/v1/schema", {
+            "class": "Demo",
+            "vectorIndexType": "hnsw",
+            "properties": [{"name": "body", "dataType": ["text"]}],
+        })
+        out("• ingest burst: 3 batches × 16 objects ...")
+        for b in range(3):
+            _fetch(base, "/v1/batch/objects", [
+                {"class": "Demo",
+                 "id": f"00000000-0000-0000-0000-{b * 16 + i:012d}",
+                 "properties": {"body": f"doc {b * 16 + i}"},
+                 "vector": [((b * 16 + i + j) % 7) / 7.0
+                            for j in range(8)]}
+                for i in range(16)
+            ])
+        out("• search burst: 8 nearVector queries ...")
+        for i in range(8):
+            q = [((i + j) % 5) / 5.0 for j in range(8)]
+            _fetch(base, "/v1/graphql", {
+                "query": "{ Get { Demo(nearVector: {vector: %s}, "
+                         "limit: 3) { _additional { id distance } } } }"
+                         % json.dumps(q)})
+
+        traces = _fetch(base, "/v1/debug/traces?limit=50")["traces"]
+        search = [t for t in traces if t["root"] == "rest.graphql"]
+        assert search, "no search trace recorded"
+        tid = search[0]["traceId"]
+        tree = _fetch(base, f"/v1/debug/traces?trace={tid}")["tree"]
+        out("")
+        out(f"trace {tid} ({tree['spanCount']} spans, "
+            f"{tree['durationMs']:.2f}ms"
+            + (", TRUNCATED" if tree["truncated"] else "") + ")")
+        for line in render_tree(tree["tree"]):
+            out("  " + line)
+        exemplars = _fetch(base,
+                           "/v1/debug/traces?exemplars=true")["exemplars"]
+        if exemplars:
+            out("")
+            out("worst-observation exemplars (histogram → trace id):")
+            for metric, by_labels in exemplars.items():
+                for labels, ex in by_labels.items():
+                    out(f"  {metric}{labels}: {ex['value'] * 1000:.2f}ms"
+                        f" → trace {ex['trace_id']}")
+        return tree
+    finally:
+        if api is not None:
+            api.shutdown()
+        if db is not None:
+            db.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    run()

@@ -420,8 +420,16 @@ def where_to_filter(w: dict) -> Filter:
 
 
 class GraphQLExecutor:
-    def __init__(self, db):
+    def __init__(self, db, cluster=None):
         self.db = db
+        # Optional ClusterNode: a plain nearVector Get whose collection
+        # has shards this node does NOT replicate scatter-gathers through
+        # the cluster data plane (reference traverser ->
+        # sharding/remote_index fan-out) instead of silently answering
+        # from the local subset. Feature-bearing queries (filters,
+        # hybrid, groupBy, ...) keep the local path — the cluster search
+        # API doesn't carry those parameters.
+        self.cluster = cluster
         self.explorer = Explorer(db)
 
     def execute(self, query: str, variables: Optional[dict] = None,
@@ -642,6 +650,38 @@ class GraphQLExecutor:
             )
         return p
 
+    def _needs_cluster_scatter(self, p) -> bool:
+        """A PLAIN nearVector Get against a collection whose shard set
+        extends beyond this node must scatter through the cluster — the
+        local replica view would silently drop the remote shards' hits.
+        Any feature the cluster search API doesn't carry (filters,
+        hybrid, offsets, ...) keeps the local path with its documented
+        local-replica semantics."""
+        if self.cluster is None or p.near_vector is None:
+            return False
+        featured = (p.filters is not None or p.hybrid is not None
+                    or p.bm25_query is not None or p.near_text is not None
+                    or getattr(p, "ask", None) is not None
+                    or p.group_by is not None
+                    or getattr(p, "legacy_group", None) is not None
+                    or getattr(p, "sort", None)
+                    or getattr(p, "generate", None) is not None
+                    or getattr(p, "rerank", None) is not None
+                    or getattr(p, "summary", None) is not None
+                    or getattr(p, "tokens", None) is not None
+                    or p.offset or p.autocut
+                    or getattr(p, "autocorrect", False)
+                    or p.max_distance is not None
+                    or p.after is not None)
+        if featured:
+            return False
+        try:
+            st = self.cluster._state_for(p.collection)
+        except (KeyError, ValueError):
+            return False
+        return any(self.cluster.id not in st.replicas(s)
+                   for s in range(st.n_shards))
+
     def _get_class(self, cls: Field) -> list[dict]:
         params = self._params_from_args(cls.name, cls.args)
 
@@ -673,6 +713,13 @@ class GraphQLExecutor:
                             else [props],
                             certainty=float(sub.args.get("certainty", 0.0)),
                         )
+
+        if self._needs_cluster_scatter(params):
+            rows = self.cluster.vector_search(
+                params.collection, params.near_vector, k=params.limit,
+                tenant=params.tenant, target=params.target_vector)
+            return [self._render_object(cls.selections, obj, None, d)
+                    for obj, d in rows]
 
         result = self.explorer.get(params)
 

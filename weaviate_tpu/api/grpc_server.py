@@ -65,8 +65,15 @@ def qos_admit(qos, name: str, context, tenant: str = ""):
         budget = SERVING_DEFAULT_TIMEOUT_S.get()
     deadline = Deadline(budget, op=f"grpc.{name}")
     lane = RPC_LANES.get(name, "background")
+    from weaviate_tpu.monitoring import tracing
+
     try:
-        ticket = qos.acquire(lane, tenant=tenant, deadline=deadline)
+        # same qos.queue span as the REST plane: a shed or queued-past-
+        # deadline request exits it with ERROR before the abort below
+        with tracing.TRACER.span("qos.queue", lane=lane,
+                                 tenant=tenant) as qspan:
+            ticket = qos.acquire(lane, tenant=tenant, deadline=deadline)
+            qspan.set(queue_wait_ms=round(ticket.queue_wait * 1000, 3))
     except QosRejected as e:
         context.set_trailing_metadata(
             (("retry-after", str(int(e.retry_after))),))
@@ -74,7 +81,8 @@ def qos_admit(qos, name: str, context, tenant: str = ""):
     except DeadlineExceeded as e:
         context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
     ctx = RequestContext(deadline=deadline, lane=lane, tenant=tenant,
-                         queue_wait_s=ticket.queue_wait)
+                         queue_wait_s=ticket.queue_wait,
+                         trace=tracing.current_span())
     return ticket, ctx
 
 
@@ -158,6 +166,17 @@ class GrpcAPI:
         action, resource_fn = _RPC_AUTHZ[name]
 
         def handler(request, context):
+            from weaviate_tpu.monitoring.tracing import TRACER
+
+            md = dict(context.invocation_metadata() or [])
+            # gRPC ingress span: the traceparent rides invocation
+            # metadata (same W3C format as the REST header)
+            with TRACER.ingress(f"grpc.{name}",
+                                traceparent=md.get("traceparent", ""),
+                                rpc=name):
+                return run(request, context)
+
+        def run(request, context):
             principal, groups = self._principal(context)
             if name == "BatchObjects":
                 if self.rbac is not None:

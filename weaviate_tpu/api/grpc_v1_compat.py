@@ -746,6 +746,18 @@ class WeaviateV1Service:
 
         def unary(name, fn, req_cls):
             def h(request, context):
+                from weaviate_tpu.monitoring.tracing import TRACER
+
+                md = dict(context.invocation_metadata() or [])
+                # ingress span, same W3C traceparent metadata key as the
+                # native plane (the two planes must not drift)
+                with TRACER.ingress(
+                        f"grpc.{name}",
+                        traceparent=md.get("traceparent", ""),
+                        rpc=name, plane="v1_compat"):
+                    return run(request, context)
+
+            def run(request, context):
                 # same admission + end-to-end deadline as the native
                 # plane (shared qos_admit); tenant rides most requests
                 ticket, ctx = qos_admit(

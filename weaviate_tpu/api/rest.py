@@ -194,7 +194,7 @@ class RestAPI:
         # local replica view (every node holds its raft-replicated
         # schema; scatter-gather search stays on the ctl/cluster plane).
         self.cluster = cluster
-        self.graphql = GraphQLExecutor(db)
+        self.graphql = GraphQLExecutor(db, cluster=cluster)
         from weaviate_tpu.backup.handler import BackupHandler
 
         self.backups = BackupHandler(db)
@@ -367,6 +367,7 @@ class RestAPI:
     # -- WSGI --------------------------------------------------------------
     def __call__(self, environ, start_response):
         request = Request(environ)
+        span = None
         try:
             adapter = self.url_map.bind_to_environ(environ)
             endpoint, args = adapter.match()
@@ -374,9 +375,15 @@ class RestAPI:
             handler = getattr(self, f"on_{endpoint}")
             from weaviate_tpu.monitoring.tracing import TRACER
 
-            with TRACER.span(f"rest.{endpoint}",
-                             method=request.method,
-                             path=request.path):
+            # ingress span: continues an incoming W3C traceparent (and
+            # its sampled flag) or mints a fresh trace under the
+            # tracing_sample_rate knob; the id is echoed back in the
+            # response header so clients can fetch their own trace
+            span = TRACER.ingress(
+                f"rest.{endpoint}",
+                traceparent=request.headers.get("traceparent", ""),
+                method=request.method, path=request.path)
+            with span:
                 response = self._dispatch_qos(request, endpoint,
                                               handler, args)
         except _Forbidden as e:
@@ -431,6 +438,10 @@ class RestAPI:
             status = 503 if isinstance(e, ReplicationError) else 500
             response = _json_response(
                 {"error": [{"message": str(e)}]}, status)
+        if span is not None and span.sampled:
+            # traceparent OUT: error responses carry it too — the 429/504
+            # shed is exactly the request whose trace an operator wants
+            response.headers["traceparent"] = span.traceparent
         return response(environ, start_response)
 
     def _dispatch_qos(self, request: Request, endpoint: str, handler,
@@ -464,11 +475,21 @@ class RestAPI:
         deadline = Deadline(budget, op=f"rest.{endpoint}")
         tenant = (request.args.get("tenant", "")
                   or request.headers.get("X-Tenant", ""))
-        with self.qos.acquire(lane, tenant=tenant,
-                              deadline=deadline) as ticket:
+        from weaviate_tpu.monitoring import tracing
+
+        # qos.queue: the admission wait as its own span — a shed (429) or
+        # queued-past-deadline (504) exits it with ERROR status, so "where
+        # did my request die" is answerable from the trace alone
+        with tracing.TRACER.span("qos.queue", lane=lane,
+                                 tenant=tenant) as qspan:
+            ticket = self.qos.acquire(lane, tenant=tenant,
+                                      deadline=deadline)
+            qspan.set(queue_wait_ms=round(ticket.queue_wait * 1000, 3))
+        with ticket:
             ctx = RequestContext(deadline=deadline, lane=lane,
                                  tenant=tenant,
-                                 queue_wait_s=ticket.queue_wait)
+                                 queue_wait_s=ticket.queue_wait,
+                                 trace=tracing.current_span())
             with request_scope(ctx):
                 return handler(request, **args)
 
@@ -1309,11 +1330,33 @@ class RestAPI:
             TRACER.clear()
             return Response(status=204)
         self._authz(request, "read_cluster", "debug/traces")
+        if request.args.get("exemplars") == "true":
+            # worst-observation trace ids per histogram: the jump table
+            # from a bad percentile to the trace that produced it
+            from weaviate_tpu.monitoring.metrics import REGISTRY
+
+            return _json_response({"exemplars": REGISTRY.exemplars()})
         trace_id = request.args.get("trace")
         if trace_id:
-            return _json_response({"spans": TRACER.recent(
-                limit=int(request.args.get("limit", 200)),
-                trace_id=trace_id)})
+            if request.args.get("format") == "otlp":
+                # OTLP-shaped JSONL of ONE trace (docs/tracing.md):
+                # importable by any OTLP-tolerant tool, one span per line
+                body = TRACER.export_otlp_jsonl(trace_id)
+                if not body:
+                    _abort(404, f"trace {trace_id!r} not found "
+                                "(evicted or never sampled)")
+                return Response(body,
+                                content_type="application/x-ndjson")
+            tree = TRACER.trace_tree(trace_id)
+            if tree is None:
+                _abort(404, f"trace {trace_id!r} not found "
+                            "(evicted or never sampled)")
+            return _json_response({
+                "spans": TRACER.recent(
+                    limit=int(request.args.get("limit", 200)),
+                    trace_id=trace_id),
+                "tree": tree,
+            })
         return _json_response({
             "traces": TRACER.traces(limit=int(request.args.get("limit", 20)))
         })

@@ -200,7 +200,26 @@ class ClusterNode:
         handler = getattr(self, f"_on_{t}", None)
         if handler is None:
             return {"error": f"unknown message {t!r}"}
+        # cross-process trace continuation: the sender's span context
+        # rides the envelope (``_trace``, W3C traceparent format), so a
+        # replica RPC handled here is a child span of the INGRESS trace —
+        # over TCP as much as in-proc (docs/tracing.md)
+        tp = msg.get("_trace")
+        span = None
+        if tp:
+            from weaviate_tpu.monitoring import tracing
+
+            ctx = tracing.parse_traceparent(tp)
+            # a malformed envelope (version-skewed peer) must not mint a
+            # fresh root trace per RPC — it would pollute and evict real
+            # traces from the bounded buffer; run unspanned instead
+            if ctx is not None:
+                span = tracing.TRACER.span(f"cluster.{t}", parent=ctx,
+                                           node=self.id)
         try:
+            if span is not None:
+                with span:
+                    return handler(msg)
             return handler(msg)
         except (KeyError, ValueError, RuntimeError) as e:
             return {"error": str(e)}
@@ -347,6 +366,11 @@ class ClusterNode:
     def _send(self, peer: str, msg: dict, timeout: float = 3.0) -> dict:
         """Bare one-shot RPC (no retry/breaker): control-plane and
         movement paths that carry their own convergence loops."""
+        from weaviate_tpu.monitoring import tracing
+
+        cur = tracing.current_span()
+        if cur is not None and cur.sampled:
+            msg = {**msg, "_trace": cur.traceparent}
         if peer == self.id:
             return self._dispatch(msg)
         return self.transport.send(peer, msg, timeout=timeout)
@@ -370,16 +394,34 @@ class ClusterNode:
         """Policy-wrapped RPC for the replication data plane: breaker
         fail-fast, jittered-backoff retries on transport faults, every
         attempt's timeout clamped to the operation deadline."""
+        from weaviate_tpu.monitoring import tracing
+
         if peer == self.id:
+            # self-delivery still continues the trace (the local replica
+            # leg of a fan-out must be as visible as the remote ones)
+            cur = tracing.current_span()
+            if cur is not None and cur.sampled:
+                msg = {**msg, "_trace": cur.traceparent}
             return self._dispatch(msg)
         timeout = self.rpc_timeout if timeout is None else timeout
         mtype = str(msg.get("type", ""))
         breaker = self.breakers.get(peer)
         start = time.monotonic()
+        # client-side RPC span (created only inside a sampled trace):
+        # the remote handler's span parents to THIS one via the envelope,
+        # and resilience.py records retry attempts as events on it
+        parent = tracing.current_span()
+        span = None
+        if parent is not None and parent.sampled:
+            span = tracing.TRACER.span(f"rpc.{mtype}", peer=peer)
+            msg = {**msg, "_trace": span.traceparent}
 
         def attempt(attempt_timeout: float) -> dict:
             if not breaker.allow():
                 RPC_FAILURES.inc(peer=peer, kind="breaker_open")
+                # the skip costs no socket — the event is the only trace
+                # a fail-fast leaves
+                tracing.add_event("breaker.open", peer=peer)
                 raise TransportError(f"-> {peer}: circuit open")
             try:
                 r = self.transport.send(peer, msg, timeout=attempt_timeout)
@@ -397,16 +439,23 @@ class ClusterNode:
             breaker.record_success()
             return r
 
-        try:
-            return retrying_call(
-                attempt, peer=peer, policy=self.retry_policy,
-                deadline=deadline, timeout=timeout, rng=self._rpc_rng,
-                retry_on=(TransportError,), msg_type=mtype)
-        except TransportError:
-            RPC_FAILURES.inc(peer=peer, kind="transport")
-            raise
-        finally:
-            RPC_DURATION.observe(time.monotonic() - start, msg_type=mtype)
+        def call() -> dict:
+            try:
+                return retrying_call(
+                    attempt, peer=peer, policy=self.retry_policy,
+                    deadline=deadline, timeout=timeout, rng=self._rpc_rng,
+                    retry_on=(TransportError,), msg_type=mtype)
+            except TransportError:
+                RPC_FAILURES.inc(peer=peer, kind="transport")
+                raise
+            finally:
+                RPC_DURATION.observe(time.monotonic() - start,
+                                     msg_type=mtype)
+
+        if span is None:
+            return call()
+        with span:
+            return call()
 
     def _fan_out(self, replicas: list[str], payload: dict, *, need: int,
                  deadline: Deadline, timeout: Optional[float] = None,
@@ -438,11 +487,19 @@ class ClusterNode:
         # or late, never lost
         hand_off = threading.Lock()
 
+        # pool threads don't inherit the caller's contextvars: capture
+        # the active span here so every replica leg's rpc span parents
+        # into the ingress trace instead of starting a disconnected root
+        from weaviate_tpu.monitoring import tracing
+
+        fan_span = tracing.current_span()
+
         def attempt_one(peer: str) -> None:
             reply: dict = {}
             try:
-                reply = self._call(peer, payload, deadline=deadline,
-                                   timeout=timeout)
+                with tracing.use_span(fan_span):
+                    reply = self._call(peer, payload, deadline=deadline,
+                                       timeout=timeout)
                 good = ok(reply)
                 err = None if good else str(reply.get("error"))
             except _REPLICA_ERRORS as e:
@@ -511,7 +568,16 @@ class ClusterNode:
             return []
         if len(items) == 1:  # skip pool overhead for the common case
             return [fn(items[0])]
-        futures = [self._pool.submit(fn, item) for item in items]
+        # same contextvar hop as _fan_out: scatter legs keep the trace
+        from weaviate_tpu.monitoring import tracing
+
+        par_span = tracing.current_span()
+
+        def run_one(item):
+            with tracing.use_span(par_span):
+                return fn(item)
+
+        futures = [self._pool.submit(run_one, item) for item in items]
         out: list[Any] = []
         first_err: Optional[BaseException] = None
         for f in futures:

@@ -271,6 +271,12 @@ def retrying_call(fn: Callable[[float], dict], *, peer: str,
             if attempt == policy.attempts:
                 break
             RPC_RETRIES.inc(peer=peer, msg_type=msg_type)
+            # span event on the caller's rpc span (no-op unsampled): the
+            # trace shows each retry with its cause, not just a slow leg
+            from weaviate_tpu.monitoring.tracing import add_event
+
+            add_event("rpc.retry", attempt=attempt, peer=peer,
+                      error=str(e))
             pause = min(policy.backoff(attempt, rng),
                         max(0.0, deadline.remaining()))
             if pause > 0:

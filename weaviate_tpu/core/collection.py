@@ -50,6 +50,11 @@ class Collection:
         self._get_seq = 0  # strictly-increasing shard access stamp
         self._shards: dict[str, Shard] = {}
         self._building: dict[str, threading.Event] = {}  # in-flight opens
+        # shard names mid-drop (replica movement): a concurrent
+        # _get_shard must not rebuild the shard while rmtree runs — the
+        # rebuilt object would register with a deleted directory and
+        # explode on its first flush
+        self._dropping: set[str] = set()
         self._tenant_status: dict[str, str] = {}
         # per-shard serving status (reference /schema/{class}/shards:
         # READY | READONLY); only non-READY entries are persisted
@@ -153,6 +158,16 @@ class Collection:
                     if status != TENANT_HOT:
                         raise TenantNotActive(
                             f"tenant {tname!r} is not active")
+                with self._lock:
+                    dropping = name in self._dropping
+                if dropping:
+                    # a drop (replica moved away) is deleting this
+                    # shard's directory right now: rebuilding would
+                    # resurrect a zombie whose files vanish under it
+                    from weaviate_tpu.storage.store import ShardClosed
+
+                    raise ShardClosed(
+                        f"shard {name!r} is being dropped")
                 with self._LOAD_LIMITER:
                     s = Shard(
                         os.path.join(self.dir, name),
@@ -164,14 +179,37 @@ class Collection:
                 # inverted/searcher.go ref-filter recursion)
                 s.inverted.ref_resolver = self._resolve_ref_filter
                 with self._lock:
-                    # a shard born inside a backup copy window inherits
-                    # the pause, otherwise its compaction could delete
-                    # files the backup walk already listed
-                    for _ in range(self._maintenance_pause):
-                        s.store.pause_maintenance()
-                    self._get_seq += 1
-                    s._last_get = self._get_seq
-                    self._shards[name] = s
+                    # re-check: a drop may have started while this
+                    # builder was constructing (it waits only for
+                    # builders it could SEE when it began)
+                    publish = name not in self._dropping
+                    if publish:
+                        # a shard born inside a backup copy window
+                        # inherits the pause, otherwise its compaction
+                        # could delete files the backup walk already
+                        # listed
+                        for _ in range(self._maintenance_pause):
+                            s.store.pause_maintenance()
+                        self._get_seq += 1
+                        s._last_get = self._get_seq
+                        self._shards[name] = s
+                if not publish:
+                    import logging
+                    import shutil
+
+                    try:
+                        s.close()
+                    except OSError as e:
+                        # the racing rmtree may already have taken the
+                        # directory out from under the close's flush
+                        logging.getLogger("weaviate_tpu.core").info(
+                            "discarding shard %s built during drop: %s",
+                            name, e)
+                    shutil.rmtree(s.dir, ignore_errors=True)
+                    from weaviate_tpu.storage.store import ShardClosed
+
+                    raise ShardClosed(
+                        f"shard {name!r} is being dropped")
                 if name.startswith("tenant-"):
                     # tiering ledger: a freshly opened tenant shard starts
                     # renting HBM — charge it (outside the collection
@@ -459,15 +497,34 @@ class Collection:
 
     def drop_shard(self, name: str) -> None:
         """Close and delete one shard's data (replica movement: the source
-        copy after a routing flip, reference ``copier/`` drop phase)."""
+        copy after a routing flip, reference ``copier/`` drop phase).
+        ``_dropping`` gates the whole close+rmtree window: a late write
+        (e.g. a 2PC commit racing the routing flip) must get ShardClosed
+        from ``_get_shard``, not silently rebuild the shard it is
+        deleting."""
         import shutil
 
-        self._wait_building(name)
+        # gate FIRST, then wait: a builder that registered before the
+        # gate either publishes before the pop below (we drop it) or
+        # fails its publish re-check (it sees _dropping). Waiting first
+        # would leave a window where a fresh builder passes both checks
+        # while this drop runs, republishing the shard being deleted.
         with self._lock:
-            s = self._shards.pop(name, None)
-        if s is not None:
-            s.close()
-            shutil.rmtree(s.dir, ignore_errors=True)
+            self._dropping.add(name)
+        try:
+            self._wait_building(name)
+            with self._lock:
+                s = self._shards.pop(name, None)
+            if s is not None:
+                s.close()
+            # the directory goes regardless of whether the shard was
+            # open: a lazily-closed (tiering-cold) shard's files must
+            # not survive the drop and resurrect on the next open
+            shutil.rmtree(os.path.join(self.dir, name),
+                          ignore_errors=True)
+        finally:
+            with self._lock:
+                self._dropping.discard(name)
 
     def tenants(self) -> dict[str, str]:
         # external views (API, backup manifests, FSM snapshots) see the

@@ -22,24 +22,38 @@ computed once per request at enqueue, verified with an exact compare
 before grouping so a hash collision can never leak one tenant's mask
 onto another's query. Requests with distinct masks still run as
 singleton batches in arrival order.
+
+Tracing (docs/tracing.md): the batch/request relation is N:1 — several
+requests from DIFFERENT traces share one device batch. Each drained
+group emits ONE ``dispatch.batch`` span, parented into the leader's (or
+first sampled requester's) trace and LINKED to every coalesced request's
+span, with the batch size, tier key, pow2 row bucket, the group's worst
+queue wait, and the device service time. When no requester is sampled
+(``tracing_sample_rate=0``) no span object is created at all — the hot
+path's only additions are two ``perf_counter`` reads and the always-on
+queue/service histograms.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
 
 from weaviate_tpu.monitoring.metrics import (
+    DISPATCH_BATCH_SECONDS,
     DISPATCH_DEVICE_ROWS,
     DISPATCH_EXPIRED,
+    DISPATCH_QUEUE_WAIT,
 )
 
 
 class _Req:
     __slots__ = ("queries", "k", "allow", "mask_key", "tier_key",
-                 "deadline", "event", "ids", "dists", "error")
+                 "deadline", "event", "ids", "dists", "error", "span",
+                 "enq_t")
 
     def __init__(self, queries: np.ndarray, k: int, allow, deadline=None,
                  tier_key=None):
@@ -66,6 +80,10 @@ class _Req:
         self.ids: Optional[np.ndarray] = None
         self.dists: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # originating span (still open for the search's lifetime): the
+        # leader links the batch span to it and records shed events on it
+        self.span = None
+        self.enq_t = time.perf_counter()
 
     @property
     def expired(self) -> bool:
@@ -104,6 +122,11 @@ class CoalescingDispatcher:
 
             deadline = current_deadline()
         req = _Req(queries, k, allow, deadline, tier_key=tier_key)
+        from weaviate_tpu.monitoring import tracing
+
+        origin = tracing.current_span()
+        if origin is not None and origin.sampled:
+            req.span = origin
         with self._lock:
             self._pending.append(req)
         # Every waiter is a potential leader: whoever finds no active
@@ -137,6 +160,8 @@ class CoalescingDispatcher:
                         shed = False
                 if shed:
                     DISPATCH_EXPIRED.inc()
+                    if req.span is not None:
+                        req.span.add_event("dispatch.expired")
                     req.deadline.require()  # raises DeadlineExceeded
         if req.error is not None:
             raise req.error
@@ -151,6 +176,8 @@ class CoalescingDispatcher:
         group = self._take_group_locked(expired)
         for r in expired:
             DISPATCH_EXPIRED.inc()
+            if r.span is not None:
+                r.span.add_event("dispatch.expired")
             try:
                 r.deadline.require()
             except TimeoutError as e:  # DeadlineExceeded
@@ -180,6 +207,30 @@ class CoalescingDispatcher:
                     i += 1
             return group
 
+    def _batch_span(self, group: list[_Req], rows: int, queue_s: float):
+        """One span per drained batch, created ONLY when some member of
+        the group is sampled: parented into the leader's active trace
+        when it has one, else the first sampled requester's, and linked
+        to EVERY sampled request span (the N:1 relation)."""
+        sampled = [r for r in group if r.span is not None]
+        if not sampled:
+            return None
+        from weaviate_tpu.monitoring import tracing
+
+        parent = tracing.current_span()
+        if parent is None or not parent.sampled:
+            parent = sampled[0].span
+        span = tracing.TRACER.span(
+            "dispatch.batch", parent=parent,
+            links=[r.span.context for r in sampled],
+            batch_size=len(group), rows=rows,
+            rows_pow2=1 << max(0, int(rows - 1).bit_length()),
+            k=group[0].k, tier_key=str(group[0].tier_key),
+            filtered=group[0].allow is not None,
+            queue_ms=round(queue_s * 1000, 3),
+        )
+        return span
+
     def _drain(self, until_done: Optional[_Req] = None) -> None:
         while True:
             if until_done is not None and until_done.event.is_set():
@@ -187,6 +238,26 @@ class CoalescingDispatcher:
             group = self._take_group()
             if not group:
                 return
+            t0 = time.perf_counter()
+            # the group's WORST wait: the batch drained now, so every
+            # member's wait ends here
+            queue_s = max(t0 - r.enq_t for r in group)
+            rows = sum(r.queries.shape[0] for r in group)
+            span = self._batch_span(group, rows, queue_s)
+            detach_token = None
+            if span is not None:
+                span.__enter__()
+            else:
+                # no member of THIS group is sampled, but the leader may
+                # be mid-trace for its OWN (different) request: detach
+                # its span so the walk's device-time annotations cannot
+                # stamp this group's timings onto an unrelated trace
+                from weaviate_tpu.monitoring import tracing
+
+                cur = tracing.current_span()
+                if cur is not None and cur.sampled:
+                    detach_token = tracing.detach()
+            batch_exc: Optional[BaseException] = None
             try:
                 q = (group[0].queries if len(group) == 1
                      else np.concatenate([r.queries for r in group], axis=0))
@@ -199,8 +270,21 @@ class CoalescingDispatcher:
                     r.dists = dists[at:at + n]
                     at += n
             except BaseException as e:  # propagate to every waiter
+                batch_exc = e
                 for r in group:
                     r.error = e
             finally:
+                dt = time.perf_counter() - t0
+                trace_id = span.trace_id if span is not None else ""
+                DISPATCH_QUEUE_WAIT.observe(queue_s, exemplar=trace_id)
+                DISPATCH_BATCH_SECONDS.observe(dt, exemplar=trace_id)
+                if span is not None:
+                    span.set(device_ms=round(dt * 1000, 3))
+                    span.__exit__(type(batch_exc) if batch_exc else None,
+                                  batch_exc, None)
+                elif detach_token is not None:
+                    from weaviate_tpu.monitoring import tracing
+
+                    tracing.deactivate(detach_token)
                 for r in group:
                     r.event.set()

@@ -922,8 +922,13 @@ class HNSWIndex(VectorIndex):
             # WARM tier (tiering/): arrays are demoted to host RAM — the
             # exact host pass serves the query without entering the
             # device dispatcher, so a demoted tenant can never occupy a
-            # hot tenant's batch slot (or re-rent HBM per query)
-            d, ids = self.backend.host_topk(queries, k, allow_list)
+            # hot tenant's batch slot (or re-rent HBM per query). The
+            # span makes the tier visible per-request: a latency cliff
+            # that is "tenant went warm" reads directly off the trace
+            from weaviate_tpu.monitoring.tracing import TRACER
+
+            with TRACER.span("tiering.host_search", rows=b, k=k):
+                d, ids = self.backend.host_topk(queries, k, allow_list)
             return SearchResult(ids=ids, dists=d)
 
         # Filtered-search triage (reference SWEEPING/ACORN/RRE pick,
@@ -1071,6 +1076,9 @@ class HNSWIndex(VectorIndex):
                 if len(al) < cap:
                     al = np.pad(al, (0, cap - len(al)))
                 al_pad = al[:cap]
+            import time as _time
+
+            t_dev = _time.perf_counter()
             if mesh_mirror is not None:
                 # ONE SPMD dispatch spanning the whole mesh: per-shard
                 # walk from the shard's seed table + on-device
@@ -1123,6 +1131,24 @@ class HNSWIndex(VectorIndex):
             ids = np.asarray(ids)[:b].astype(np.int64)
             # graftlint: allow[host-sync-in-hot-path] reason=final beam materialization
             d = np.asarray(d)[:b]
+            # device-time attribution (monitoring/devtime.py): the
+            # np.asarray above IS the completion sync, so bracketing it
+            # costs two perf_counter reads and ZERO extra host syncs.
+            # First sighting of a (backend, scorer, mesh, shape-bucket)
+            # identity = the dispatch that paid XLA compile.
+            from weaviate_tpu.monitoring import devtime, tracing
+
+            dt_dev = _time.perf_counter() - t_dev
+            mesh_mode = "mesh" if mesh_mirror is not None else "single"
+            phase = devtime.record(
+                backend=type(self.backend).__name__,
+                scorer=type(scorer).__name__, mesh=mesh_mode,
+                shape_key=(b_pad, ef_pad, al_pad is not None),
+                seconds=dt_dev)
+            tracing.annotate(
+                device_execute_ms=round(dt_dev * 1000, 3),
+                device_phase=phase, scorer=type(scorer).__name__,
+                mesh_mode=mesh_mode)
             self._beam_proven = True
         except Exception as e:
             import logging

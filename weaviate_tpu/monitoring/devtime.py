@@ -1,0 +1,53 @@
+"""Device-time attribution: first-compile vs steady-state execute.
+
+The fused device beam is one jitted program per (scorer, mesh-mode,
+shape-bucket); its FIRST dispatch for a new bucket pays XLA compilation
+(seconds) while every later one is a steady-state execute
+(milliseconds). A latency investigation must be able to tell the two
+apart — "the p99 spike was three cold compiles after a deploy" is a
+different incident than "steady-state execute regressed".
+
+Timing rides the walk's EXISTING result materialization (the
+``np.asarray`` host sync the search path already performs to hand
+results back): the caller brackets dispatch→materialization with
+``time.perf_counter`` and reports here. No ``block_until_ready``, no
+extra transfers — the graftlint ``host-sync-in-hot-path`` baseline
+stays at zero.
+
+Classification is a per-process registry: the first observation of a
+``(backend, scorer, mesh, shape_key)`` tuple is ``compile``, the rest
+are ``execute``. The shape key participates in detection (a new pow2
+bucket recompiles) but not in metric labels (cardinality stays at the
+taxonomy, not the workload).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from weaviate_tpu.monitoring.metrics import DEVICE_TIME_SECONDS
+
+_lock = threading.Lock()
+_seen: set[tuple] = set()
+
+
+def record(backend: str, scorer: str, mesh: str, shape_key: tuple,
+           seconds: float) -> str:
+    """Attribute one timed dispatch; returns the phase it was classified
+    as (``compile`` for the first sighting of this program identity,
+    ``execute`` after)."""
+    ident = (backend, scorer, mesh, shape_key)
+    with _lock:
+        first = ident not in _seen
+        if first:
+            _seen.add(ident)
+    phase = "compile" if first else "execute"
+    DEVICE_TIME_SECONDS.observe(seconds, phase=phase, backend=backend,
+                                scorer=scorer, mesh=mesh)
+    return phase
+
+
+def reset() -> None:
+    """Forget compile history (tests; a fresh process compiles afresh)."""
+    with _lock:
+        _seen.clear()

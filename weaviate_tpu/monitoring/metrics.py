@@ -88,8 +88,15 @@ class Histogram(_Metric):
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        # exemplar per label set: (value, trace_id) of the WORST
+        # observation — the handle that turns "p99 regressed" into a
+        # concrete trace tree at /v1/debug/traces?trace=<id>
+        self._exemplars: dict[tuple, tuple[float, str]] = {}
 
-    def observe(self, value: float, **labels):
+    def observe(self, value: float, exemplar: str = "", **labels):
+        """``exemplar``: trace id of this observation (usually
+        ``tracing.current_trace_id()``); kept only while it is the
+        worst seen for its label set."""
         key = tuple(sorted(labels.items()))
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
@@ -98,9 +105,25 @@ class Histogram(_Metric):
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+            if exemplar:
+                worst = self._exemplars.get(key)
+                if worst is None or value > worst[0]:
+                    self._exemplars[key] = (value, exemplar)
 
     def count(self, **labels) -> int:
         return self._totals.get(tuple(sorted(labels.items())), 0)
+
+    def exemplar(self, **labels):
+        """(worst_value, trace_id) for one label set, or None."""
+        return self._exemplars.get(tuple(sorted(labels.items())))
+
+    def exemplars(self) -> dict:
+        with self._lock:
+            return {
+                _fmt_labels(dict(key)) or "{}":
+                    {"value": v, "trace_id": t}
+                for key, (v, t) in sorted(self._exemplars.items())
+            }
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -156,6 +179,20 @@ class Registry:
         for name in sorted(self._metrics):
             lines.extend(self._metrics[name].render())
         return "\n".join(lines) + "\n"
+
+    def exemplars(self) -> dict:
+        """Worst-observation exemplars of every histogram that recorded
+        any: {metric: {label_set: {value, trace_id}}} — served on the
+        debug plane so an operator can jump from a bad percentile to
+        the exact trace that produced it."""
+        out = {}
+        for name in sorted(self._metrics):
+            m = self._metrics[name]
+            if isinstance(m, Histogram):
+                ex = m.exemplars()
+                if ex:
+                    out[name] = ex
+        return out
 
 
 # the process-wide registry (reference: prometheus default registerer)
@@ -324,3 +361,25 @@ TIER_SEARCHES = REGISTRY.counter(
     "weaviate_tpu_tier_searches_total",
     "vector searches served by residency tier (device = HBM-resident "
     "arrays, host = the instrumented warm-tier exact fallback)")
+
+# end-to-end tracing instruments (monitoring/tracing.py + the coalescing
+# dispatcher's batch spans): the dispatcher's queue-wait/service split is
+# measurable even when sampling is off, and both histograms carry the
+# trace-id exemplar of their worst observation
+DISPATCH_QUEUE_WAIT = REGISTRY.histogram(
+    "weaviate_tpu_dispatch_queue_wait_seconds",
+    "time a coalesced search waited between enqueue and its device "
+    "batch draining (per batch: the longest wait in the group)")
+DISPATCH_BATCH_SECONDS = REGISTRY.histogram(
+    "weaviate_tpu_dispatch_batch_seconds",
+    "service time of one coalesced device batch (dispatch through "
+    "result materialization), as timed by the dispatcher leader")
+DEVICE_TIME_SECONDS = REGISTRY.histogram(
+    "weaviate_tpu_device_time_seconds",
+    "device-time attribution of fused beam dispatches by phase "
+    "(first-compile vs steady-state execute), backend, scorer and "
+    "mesh mode — timed against the walk's existing result "
+    "materialization, zero extra host syncs")
+TRACE_SPANS = REGISTRY.counter(
+    "weaviate_tpu_trace_spans_total",
+    "sampled spans recorded into the bounded trace buffer, by span name")

@@ -75,11 +75,16 @@ class _Tracker:
             detail = " ".join(
                 f"{n}={dt * 1000:.1f}ms" for n, dt in self.stages)
             extra = " ".join(f"{k}={v}" for k, v in self.fields.items())
+            # exemplar: the slow query's trace id is the handle into
+            # /v1/debug/traces?trace=<id> — the log line names the
+            # victim, the trace tree explains it
+            from weaviate_tpu.monitoring.tracing import current_trace_id
+
             logger.warning(
                 "slow %s query: total=%.1fms queue_wait=%.1fms "
-                "execute=%.1fms %s %s",
+                "execute=%.1fms trace_id=%s %s %s",
                 self.kind, total * 1000, queue_wait * 1000,
-                execute * 1000, detail, extra)
+                execute * 1000, current_trace_id() or "-", detail, extra)
         return False
 
 

@@ -1,59 +1,167 @@
-"""Request tracing: span trees with timings and attributes.
+"""Request tracing: span trees with timings, links, events and propagation.
 
-Reference: the reference wires OpenTelemetry-style tracing through its
+Reference: the reference wires OpenTelemetry tracing through its whole
 handler chain (``adapters/handlers/rest/middlewares``) and exposes pprof
 profiles (``adapters/handlers/debug``). Zero-egress equivalent: an
 in-process tracer with bounded retention, OTLP-shaped JSON export, and a
 ``/v1/debug/traces`` endpoint. Spans nest via a context-local stack, so
-instrumented layers (REST -> Collection -> Shard -> kernel) compose
-without passing handles around.
+instrumented layers (REST -> QoS -> Collection -> dispatcher -> kernel)
+compose without passing handles around; layers that hop threads
+(collection scatter pools, the cluster replica fan-out) re-activate the
+request's span explicitly (``use_span`` / ``serving.context``).
+
+Cross-process propagation follows the W3C trace-context shape: a
+``traceparent`` header (``00-<trace_id>-<span_id>-<flags>``) travels in
+and out of REST/gRPC ingress and rides the cluster transport's msgpack
+envelope (``_trace`` key), so a replica RPC handled on another node
+continues the ingress trace.
+
+Sampling: the ``tracing_sample_rate`` runtime knob (default 1.0) decides
+per-TRACE at the root; children inherit the verdict. An unsampled span
+is a real object (so nesting and inheritance stay uniform) but skips id
+generation, attribute work, and retention — near-zero overhead. Hot
+paths that must add literally nothing (the coalescing dispatcher) check
+``span.sampled``/``current_span()`` before creating anything.
 """
 
 from __future__ import annotations
 
 import contextvars
 import json
+import random
 import threading
 import time
 import uuid as uuidlib
 from collections import deque
-from typing import Any, Optional
+from contextlib import contextmanager
+from typing import Any, Iterator, NamedTuple, Optional
 
 _current_span: contextvars.ContextVar[Optional["Span"]] = \
     contextvars.ContextVar("wv_current_span", default=None)
 
+_UNSET = object()
+
+
+class SpanContext(NamedTuple):
+    """The portable identity of a span: enough to parent or link a child
+    across threads and processes."""
+
+    trace_id: str
+    span_id: str
+    sampled: bool = True
+
+    @property
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id, self.sampled)
+
+
+def format_traceparent(trace_id: str, span_id: str, sampled: bool) -> str:
+    """W3C trace-context header: version 00, 32-hex trace id, 16-hex
+    parent span id, flags (01 = sampled)."""
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def parse_traceparent(header: str) -> Optional[SpanContext]:
+    """Parse a ``traceparent`` header; None when absent or malformed (a
+    bad header starts a fresh trace, it never fails the request)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    _ver, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+        sampled = bool(int(flags, 16) & 0x01)
+    except ValueError:
+        return None
+    return SpanContext(trace_id, span_id, sampled)
+
 
 class Span:
     __slots__ = ("trace_id", "span_id", "parent_id", "name", "start_ns",
-                 "end_ns", "attributes", "status", "_token", "_tracer")
+                 "end_ns", "attributes", "status", "sampled", "links",
+                 "events", "remote_parent", "_token", "_tracer")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
-                 parent_id: Optional[str]):
+                 parent_id: Optional[str], sampled: bool = True,
+                 remote_parent: bool = False):
+        # remote_parent: the parent span lives in ANOTHER process (an
+        # incoming traceparent / transport envelope) — this span is a
+        # legitimate local root, not an eviction orphan
+        self.remote_parent = remote_parent
         self._tracer = tracer
         self.name = name
+        self.sampled = sampled
         self.trace_id = trace_id
-        self.span_id = uuidlib.uuid4().hex[:16]
+        # unsampled spans exist only to propagate the verdict down the
+        # context stack: no ids, no retention, (almost) no work
+        self.span_id = uuidlib.uuid4().hex[:16] if sampled else ""
         self.parent_id = parent_id
-        self.start_ns = time.time_ns()
+        self.start_ns = time.time_ns() if sampled else 0
         self.end_ns: Optional[int] = None
         self.attributes: dict[str, Any] = {}
+        self.links: list[dict] = []
+        self.events: list[dict] = []
         self.status = "OK"
         self._token = None
 
     def set(self, **attrs) -> "Span":
-        self.attributes.update(attrs)
+        if self.sampled:
+            self.attributes.update(attrs)
         return self
+
+    def add_event(self, name: str, **attrs) -> "Span":
+        """Timestamped point-in-time annotation (retry attempts, breaker
+        skips, dispatcher sheds)."""
+        if self.sampled:
+            self.events.append({
+                "name": name,
+                "timeUnixNano": time.time_ns(),
+                "attributes": attrs,
+            })
+        return self
+
+    def add_link(self, ctx: Optional[SpanContext], **attrs) -> "Span":
+        """Link another trace's span (the N:1 batch<-requests relation)."""
+        if self.sampled and ctx is not None:
+            self.links.append({
+                "traceId": ctx.trace_id,
+                "spanId": ctx.span_id,
+                "attributes": attrs,
+            })
+        return self
+
+    @property
+    def context(self) -> Optional[SpanContext]:
+        if not self.sampled:
+            return None
+        return SpanContext(self.trace_id, self.span_id, True)
+
+    @property
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id or "0" * 32,
+                                  self.span_id or "0" * 16, self.sampled)
 
     def __enter__(self) -> "Span":
         self._token = _current_span.set(self)
+        if self.sampled:
+            # open-span registry: lets the assembler tell "parent still
+            # executing" apart from "parent evicted from the buffer"
+            self._tracer._open_add(self.span_id)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
-        if exc_type is not None:
+        if exc_type is not None and self.sampled:
             self.status = "ERROR"
             self.attributes["error"] = repr(exc)
-        self.end_ns = time.time_ns()
-        _current_span.reset(self._token)
+        if self.sampled:
+            self.end_ns = time.time_ns()
+        if self._token is not None:
+            _current_span.reset(self._token)
+            self._token = None
         self._tracer._finish(self)
 
     @property
@@ -62,7 +170,7 @@ class Span:
         return (end - self.start_ns) / 1e6
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "traceId": self.trace_id,
             "spanId": self.span_id,
             "parentSpanId": self.parent_id,
@@ -73,34 +181,110 @@ class Span:
             "attributes": self.attributes,
             "status": self.status,
         }
+        if self.remote_parent:
+            out["remoteParent"] = True
+        if self.links:
+            out["links"] = self.links
+        if self.events:
+            out["events"] = self.events
+        return out
 
 
 class Tracer:
-    """Bounded-retention tracer; disabled = near-zero overhead."""
+    """Bounded-retention tracer; disabled/unsampled = near-zero overhead."""
 
-    def __init__(self, max_spans: int = 4096, enabled: bool = True):
+    def __init__(self, max_spans: int = 4096, enabled: bool = True,
+                 sample_rate: Optional[float] = None):
         self.enabled = enabled
         self.max_spans = max_spans
+        # None = follow the tracing_sample_rate runtime knob; a float
+        # pins it (unit tests, the bench harness)
+        self.sample_rate = sample_rate
         self._lock = threading.Lock()
+        self._rng = random.Random()
         # deque(maxlen): O(1) append-with-eviction — a full buffer must not
         # copy 4k entries under the lock on every request
         self._spans: deque[dict] = deque(maxlen=max_spans)
+        # span ids currently OPEN (entered, not finished): finished
+        # children whose parent is here belong to an in-flight trace,
+        # not a truncated one
+        self._open: set[str] = set()
 
-    def span(self, name: str, **attrs) -> Span:
-        parent = _current_span.get()
-        if parent is not None:
-            s = Span(self, name, parent.trace_id, parent.span_id)
+    def _open_add(self, span_id: str) -> None:
+        with self._lock:
+            self._open.add(span_id)
+
+    def open_span_ids(self) -> set:
+        with self._lock:
+            return set(self._open)
+
+    # -- sampling ----------------------------------------------------------
+    def _rate(self) -> float:
+        if self.sample_rate is not None:
+            return self.sample_rate
+        from weaviate_tpu.utils.runtime_config import TRACING_SAMPLE_RATE
+
+        return float(TRACING_SAMPLE_RATE.get())
+
+    def _sample(self) -> bool:
+        rate = self._rate()
+        if rate >= 1.0:
+            return True
+        if rate <= 0.0:
+            return False
+        return self._rng.random() < rate
+
+    # -- span creation -----------------------------------------------------
+    def span(self, name: str, parent=_UNSET,
+             links: Optional[list] = None, **attrs) -> Span:
+        """Child of ``parent`` (default: the context-active span), or a
+        new root — which draws the sampling verdict for its whole trace.
+        ``parent`` may be a Span, a SpanContext (remote parent), or
+        None (force a new root)."""
+        if parent is _UNSET:
+            parent = _current_span.get()
+        if isinstance(parent, Span):
+            s = Span(self, name, parent.trace_id, parent.span_id or None,
+                     sampled=parent.sampled)
+        elif isinstance(parent, SpanContext):
+            s = Span(self, name, parent.trace_id, parent.span_id,
+                     sampled=parent.sampled, remote_parent=True)
         else:
-            s = Span(self, name, uuidlib.uuid4().hex, None)
-        if attrs:
-            s.attributes.update(attrs)
+            sampled = self._sample()
+            s = Span(self, name,
+                     uuidlib.uuid4().hex if sampled else "", None,
+                     sampled=sampled)
+        if s.sampled:
+            if attrs:
+                s.attributes.update(attrs)
+            if links:
+                for ctx in links:
+                    s.add_link(ctx)
         return s
 
+    def ingress(self, name: str, traceparent: str = "", **attrs) -> Span:
+        """Root-of-request span minted at REST/gRPC ingress: continues an
+        incoming ``traceparent`` (honoring its sampled flag) or starts a
+        fresh trace under the sampling knob."""
+        remote = parse_traceparent(traceparent)
+        if remote is not None:
+            return self.span(name, parent=remote, **attrs)
+        return self.span(name, parent=None, **attrs)
+
     def _finish(self, span: Span) -> None:
-        if not self.enabled:
+        if not span.sampled:
             return
+        if not self.enabled:
+            with self._lock:
+                self._open.discard(span.span_id)
+            return
+        from weaviate_tpu.monitoring.metrics import TRACE_SPANS
+
+        TRACE_SPANS.inc(name=span.name)
+        d = span.to_dict()
         with self._lock:
-            self._spans.append(span.to_dict())
+            self._open.discard(span.span_id)
+            self._spans.append(d)
 
     # -- export ------------------------------------------------------------
     def recent(self, limit: int = 100,
@@ -110,6 +294,54 @@ class Tracer:
         if trace_id:
             spans = [s for s in spans if s["traceId"] == trace_id]
         return spans[-limit:]
+
+    @staticmethod
+    def _assemble(group: list[dict], open_ids: set) -> dict:
+        """Root + duration + truncation verdict for one trace's spans.
+        A root is a span with no parent OR whose parent was evicted from
+        the bounded buffer; with no true root left the trace is rendered
+        under a synthesized placeholder and marked ``truncated`` —
+        orphans must never masquerade as the request root, and the
+        duration is the span EXTENT (min start .. max end), not a max
+        over disconnected subtree durations. A missing parent that is
+        still OPEN (``open_ids``) means the trace is IN FLIGHT — a slow
+        request queried mid-execution — not evicted."""
+        ids = {s["spanId"] for s in group}
+        # a span whose parent lives in ANOTHER process (remoteParent:
+        # incoming traceparent, transport envelope) is a legitimate
+        # LOCAL root when that parent was never recorded here — only a
+        # local parent missing from the buffer means eviction
+        true_roots = [s for s in group
+                      if s["parentSpanId"] is None
+                      or (s.get("remoteParent")
+                          and s["parentSpanId"] not in ids)]
+        orphans = [s for s in group
+                   if s["parentSpanId"] is not None
+                   and s["parentSpanId"] not in ids
+                   and not s.get("remoteParent")]
+        pending = [s for s in orphans if s["parentSpanId"] in open_ids]
+        evicted = [s for s in orphans
+                   if s["parentSpanId"] not in open_ids]
+        start = min(s["startTimeUnixNano"] for s in group)
+        end = max(s["endTimeUnixNano"] or s["startTimeUnixNano"]
+                  for s in group)
+        if true_roots:
+            root_name = true_roots[0]["name"]
+        elif pending and not evicted:
+            root_name = "(in flight)"
+        else:
+            root_name = "(root evicted)"
+        return {
+            "root": root_name,
+            # an EVICTED subtree means the buffer dropped part of this
+            # trace — the duration/shape below is a lower bound, say so;
+            # an in-flight parent is normal operation, not truncation
+            "truncated": bool(evicted),
+            "in_flight": bool(pending),
+            "durationMs": round((end - start) / 1e6, 3),
+            "true_roots": true_roots,
+            "orphans": orphans,
+        }
 
     def traces(self, limit: int = 20) -> list[dict]:
         """Assembled span trees, newest first (root span + children)."""
@@ -121,21 +353,148 @@ class Tracer:
             if s["traceId"] not in by_trace:
                 order.append(s["traceId"])
             by_trace.setdefault(s["traceId"], []).append(s)
+        open_ids = self.open_span_ids()
         out = []
         for tid in reversed(order[-limit:]):
             group = by_trace[tid]
-            roots = [s for s in group if s["parentSpanId"] is None]
+            meta = self._assemble(group, open_ids)
             out.append({
                 "traceId": tid,
-                "root": roots[0]["name"] if roots else group[0]["name"],
-                "durationMs": max(s["durationMs"] for s in group),
+                "root": meta["root"],
+                "truncated": meta["truncated"],
+                "inFlight": meta["in_flight"],
+                "durationMs": meta["durationMs"],
                 "spans": group,
             })
         return out
 
-    def export_jsonl(self, path: str) -> int:
+    def trace_tree(self, trace_id: str) -> Optional[dict]:
+        """One trace rendered as a nested tree (children under parents,
+        ordered by start time). Evicted ancestors are represented by a
+        synthesized ``(root evicted)`` placeholder so orphaned subtrees
+        stay visible and correctly grouped."""
+        group = self.recent(limit=self.max_spans, trace_id=trace_id)
+        if not group:
+            return None
+        meta = self._assemble(group, self.open_span_ids())
+        children: dict[Optional[str], list[dict]] = {}
+        ids = {s["spanId"] for s in group}
+        root_ids = {s["spanId"] for s in meta["true_roots"]}
+        for s in group:
+            if s["spanId"] in root_ids:
+                continue  # roots (incl. remote-parented) render top-level
+            pid = s["parentSpanId"]
+            if pid is not None and pid not in ids:
+                pid = "(evicted)"
+            children.setdefault(pid, []).append(s)
+
+        def build(span: dict) -> dict:
+            node = dict(span)
+            kids = children.get(span["spanId"], [])
+            node["children"] = [build(k)
+                                for k in sorted(
+                                    kids,
+                                    key=lambda s: s["startTimeUnixNano"])]
+            return node
+
+        def placeholder(kids: list[dict], label: str) -> dict:
+            return {
+                "name": label,
+                "traceId": trace_id,
+                "spanId": "(evicted)",
+                "synthesized": True,
+                "durationMs": meta["durationMs"],
+                "children": [build(k) for k in sorted(
+                    kids, key=lambda s: s["startTimeUnixNano"])],
+            }
+
+        true_roots = sorted(meta["true_roots"],
+                            key=lambda s: s["startTimeUnixNano"])
+        if not true_roots:
+            # the real root is missing: still OPEN (in-flight trace,
+            # finished children only) or evicted from the bounded
+            # buffer — orphaned subtrees render under a synthesized
+            # placeholder either way, labeled accordingly
+            tree = placeholder(meta["orphans"], meta["root"])
+        else:
+            tree = build(true_roots[0])
+            for extra in true_roots[1:]:  # multi-root trace: siblings
+                tree.setdefault("siblings", []).append(build(extra))
+            if meta["orphans"]:
+                # a MIDDLE ancestor is missing: keep its subtrees
+                # visible instead of silently dropping them
+                tree.setdefault("siblings", []).append(placeholder(
+                    meta["orphans"],
+                    "(root evicted)" if meta["truncated"]
+                    else "(in flight)"))
+        return {
+            "traceId": trace_id,
+            "root": meta["root"],
+            "truncated": meta["truncated"],
+            "inFlight": meta["in_flight"],
+            "durationMs": meta["durationMs"],
+            "spanCount": len(group),
+            "tree": tree,
+        }
+
+    # OTLP-shaped export: the ResourceSpans JSON shape OTLP/HTTP uses,
+    # one line per span batch, importable by any OTLP-tolerant tool.
+    def _otlp_record(self, spans: list[dict]) -> dict:
+        def enc_attrs(attrs: dict) -> list[dict]:
+            return [{"key": k, "value": {"stringValue": str(v)}}
+                    for k, v in attrs.items()]
+
+        otlp_spans = []
+        for s in spans:
+            rec = {
+                "traceId": s["traceId"],
+                "spanId": s["spanId"],
+                "name": s["name"],
+                "startTimeUnixNano": str(s["startTimeUnixNano"]),
+                "endTimeUnixNano": str(s["endTimeUnixNano"] or 0),
+                "kind": "SPAN_KIND_INTERNAL",
+                "attributes": enc_attrs(s.get("attributes", {})),
+                "status": {"code": "STATUS_CODE_ERROR"
+                           if s["status"] == "ERROR" else "STATUS_CODE_OK"},
+            }
+            if s["parentSpanId"]:
+                rec["parentSpanId"] = s["parentSpanId"]
+            if s.get("links"):
+                rec["links"] = [{
+                    "traceId": ln["traceId"], "spanId": ln["spanId"],
+                    "attributes": enc_attrs(ln.get("attributes", {})),
+                } for ln in s["links"]]
+            if s.get("events"):
+                rec["events"] = [{
+                    "name": ev["name"],
+                    "timeUnixNano": str(ev["timeUnixNano"]),
+                    "attributes": enc_attrs(ev.get("attributes", {})),
+                } for ev in s["events"]]
+            otlp_spans.append(rec)
+        return {
+            "resourceSpans": [{
+                "resource": {"attributes": enc_attrs(
+                    {"service.name": "weaviate_tpu"})},
+                "scopeSpans": [{
+                    "scope": {"name": "weaviate_tpu.monitoring.tracing"},
+                    "spans": otlp_spans,
+                }],
+            }],
+        }
+
+    def export_otlp_jsonl(self, trace_id: str) -> str:
+        """One trace as OTLP-shaped JSONL: one ResourceSpans line per
+        span (streaming-friendly; ``cat | jq`` works line by line)."""
+        spans = self.recent(limit=self.max_spans, trace_id=trace_id)
+        return "".join(json.dumps(self._otlp_record([s])) + "\n"
+                       for s in spans)
+
+    def export_jsonl(self, path: str,
+                     trace_id: Optional[str] = None) -> int:
         with self._lock:
             spans = list(self._spans)
+        if trace_id:
+            spans = [s for s in spans if s["traceId"] == trace_id]
         with open(path, "w") as f:
             for s in spans:
                 f.write(json.dumps(s) + "\n")
@@ -144,6 +503,74 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+
+
+# -- context helpers (the thread-hop API layers use) ------------------------
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+def current_context() -> Optional[SpanContext]:
+    s = _current_span.get()
+    return s.context if s is not None else None
+
+
+def current_trace_id() -> str:
+    """Trace id of the active sampled span, "" otherwise — the exemplar
+    feed for histograms and slow-query logs."""
+    s = _current_span.get()
+    return s.trace_id if s is not None and s.sampled else ""
+
+
+def current_traceparent() -> str:
+    s = _current_span.get()
+    return s.traceparent if s is not None and s.sampled else ""
+
+
+def annotate(**attrs) -> None:
+    """Set attributes on the active span; no-op when unsampled/absent."""
+    s = _current_span.get()
+    if s is not None and s.sampled:
+        s.attributes.update(attrs)
+
+
+def add_event(name: str, **attrs) -> None:
+    s = _current_span.get()
+    if s is not None and s.sampled:
+        s.add_event(name, **attrs)
+
+
+def activate(span: Optional[Span]):
+    """Install an ALREADY-OPEN span as this thread's current span (the
+    pool-thread re-entry path); returns a token for ``deactivate``."""
+    if span is None:
+        return None
+    return _current_span.set(span)
+
+
+def detach():
+    """Clear this thread's current span (returns a token for
+    ``deactivate``): for code that runs on the caller's thread but does
+    work the caller's span must NOT absorb — e.g. a dispatcher leader
+    draining a batch that belongs to OTHER requests."""
+    return _current_span.set(None)
+
+
+def deactivate(token) -> None:
+    if token is not None:
+        _current_span.reset(token)
+
+
+@contextmanager
+def use_span(span: Optional[Span]) -> Iterator[Optional[Span]]:
+    """Re-activate a span captured in another thread without finishing
+    it — the worker-pool analogue of ``with span:``."""
+    token = activate(span)
+    try:
+        yield span
+    finally:
+        deactivate(token)
 
 
 # process-wide default tracer (REST wires its endpoints to this)

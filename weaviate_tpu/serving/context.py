@@ -34,6 +34,11 @@ class RequestContext:
     lane: str = ""
     tenant: str = ""
     queue_wait_s: float = 0.0  # admission-queue wait, for slow-query logs
+    # the ingress span (monitoring.tracing.Span) minted with the deadline:
+    # re-entering the scope in a pool thread re-activates it there, so
+    # spans created deep in scatter/dispatch work parent to the request's
+    # trace instead of starting disconnected roots
+    trace: Optional[Any] = None
 
 
 _local = threading.local()
@@ -56,7 +61,19 @@ def request_scope(ctx: Optional[RequestContext]) -> Iterator[
     deadline) unwind correctly."""
     prev = getattr(_local, "ctx", None)
     _local.ctx = ctx
+    token = None
+    span = getattr(ctx, "trace", None)
+    if span is not None:
+        # lazy: tracing is stdlib-only but keeping this module's import
+        # graph empty until a trace actually rides a context
+        from weaviate_tpu.monitoring import tracing
+
+        token = tracing.activate(span)
     try:
         yield ctx
     finally:
+        if token is not None:
+            from weaviate_tpu.monitoring import tracing
+
+            tracing.deactivate(token)
         _local.ctx = prev

@@ -190,7 +190,12 @@ class AdmissionController:
                 self._release(_Ticket(self, lane, t0))
             raise
         queue_wait = self._clock() - t0
-        QOS_QUEUE_WAIT.observe(queue_wait, lane=lane)
+        # exemplar: the trace id of the worst queue wait per lane — the
+        # jump-off point from the histogram to a concrete trace tree
+        from weaviate_tpu.monitoring.tracing import current_trace_id
+
+        QOS_QUEUE_WAIT.observe(queue_wait, lane=lane,
+                               exemplar=current_trace_id())
         QOS_ADMITTED.inc(lane=lane)
         return _Ticket(self, lane, t0, queue_wait=queue_wait)
 

@@ -251,14 +251,21 @@ class TieringController:
         timeout = self.max_cold_wait_s
         if deadline is not None:
             timeout = max(0.0, deadline.remaining())
-        try:
-            fut.result(timeout=timeout)
-        except FuturesTimeout:
-            TIER_COLD_SHED.inc()
-            raise ColdStartPending(
-                f"tenant {tenant!r} is being promoted from the "
-                f"{from_tier} tier; retry shortly",
-                retry_after=math.ceil(self._promote_ewma_s)) from None
+        # the cold-start wait as a child span of the request: first-query-
+        # after-cold latency decomposes into THIS wait vs the search
+        # itself (the promotion's own work traces under tiering.promote)
+        from weaviate_tpu.monitoring.tracing import TRACER
+
+        with TRACER.span("tiering.cold_wait", tier=from_tier,
+                         collection=key[0], tenant=tenant):
+            try:
+                fut.result(timeout=timeout)
+            except FuturesTimeout:
+                TIER_COLD_SHED.inc()
+                raise ColdStartPending(
+                    f"tenant {tenant!r} is being promoted from the "
+                    f"{from_tier} tier; retry shortly",
+                    retry_after=math.ceil(self._promote_ewma_s)) from None
 
     def _promotion_future(self, key: TenantKey, col, tenant: str,
                           from_tier: str) -> Future:
@@ -280,6 +287,17 @@ class TieringController:
 
     def _promote(self, key: TenantKey, col, tenant: str,
                  from_tier: str) -> None:
+        # runs on the promotion pool: its own trace root (requests that
+        # blocked on it hold tiering.cold_wait spans in THEIR traces)
+        from weaviate_tpu.monitoring.tracing import TRACER
+
+        with TRACER.span("tiering.promote", parent=None,
+                         collection=key[0], tenant=tenant,
+                         from_tier=from_tier) as _sp:
+            self._promote_traced(key, col, tenant, from_tier, _sp)
+
+    def _promote_traced(self, key: TenantKey, col, tenant: str,
+                        from_tier: str, _sp) -> None:
         t0 = self._clock()
         with self._lock:
             ent0 = self._entries.get(key)
@@ -332,8 +350,12 @@ class TieringController:
                 ent.hbm_bytes = hbm
                 ent.host_bytes = shard.host_tier_bytes()
             self._promote_ewma_s = 0.8 * self._promote_ewma_s + 0.2 * dt
+        _sp.set(promote_ms=round(dt * 1000, 3), hbm_bytes=hbm,
+                device_resident=shard.device_resident())
         TIER_PROMOTIONS.inc(from_tier=from_tier)
-        TIER_PROMOTION_LATENCY.observe(dt, from_tier=from_tier)
+        TIER_PROMOTION_LATENCY.observe(
+            dt, from_tier=from_tier,
+            exemplar=_sp.trace_id if _sp.sampled else "")
         self._refresh_tier_gauges()
 
     def promote_for_write(self, key: TenantKey, shard) -> None:

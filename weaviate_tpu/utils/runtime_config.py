@@ -168,6 +168,14 @@ SERVING_DEFAULT_TIMEOUT_S = RUNTIME.register(
 # client is disconnected instead of pinning a handler thread)
 SERVING_REST_READ_TIMEOUT_S = RUNTIME.register(
     "serving_rest_read_timeout_s", 30.0, cast=float)
+# end-to-end tracing (monitoring/tracing.py): per-TRACE sampling rate
+# decided at the ingress root (children inherit the verdict). 1.0 traces
+# everything (the default: the span buffer is bounded and spans are
+# cheap), 0.0 disables span creation on the request path entirely —
+# hot-reloadable so an operator can flip tracing on during an incident
+# without a restart.
+TRACING_SAMPLE_RATE = RUNTIME.register(
+    "tracing_sample_rate", 1.0, cast=float)
 # tiered tenant store (tiering/): HBM byte budget the controller demotes
 # against; 0 = unset (follow the WEAVIATE_TPU_HBM_BUDGET_BYTES env / the
 # DB constructor argument). Hot-reloadable so an operator can shrink the
