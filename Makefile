@@ -1,7 +1,7 @@
 # Developer/CI entry points. Tier-1 itself is driven by ROADMAP.md's
 # pytest line; these targets cover the static-analysis side.
 
-.PHONY: lint lint-sarif lint-dot lint-fix-baseline test trace-demo
+.PHONY: lint lint-sarif lint-dot lint-fix-baseline test trace-demo chaos
 
 # Full graftlint: every per-file rule plus the interprocedural
 # concurrency pass (lock-order cycles, blocking-under-lock, unlocked
@@ -28,6 +28,14 @@ lint-fix-baseline:
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 		-p no:cacheprovider
+
+# The chaos suite, slow soaks included: replica coordination under
+# seeded drop/latency/partition faults, and the elastic scale-out
+# scenario (3->5 nodes under live ingest+search, donor killed
+# mid-migration, crash-resume via the rebalance ledger).
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_replication.py \
+		tests/test_rebalance.py -q -p no:cacheprovider
 
 # Boot a node on a loopback port, run a mixed search/ingest burst, and
 # pretty-print the assembled trace tree from /v1/debug/traces — the

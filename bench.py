@@ -243,6 +243,10 @@ CONFIG_METRICS = {
     "bm25seg": (lambda m: m.startswith(("bm25_segment_qps",
                                         "compaction_native")),
                 lambda m: m.startswith("bm25_segment_qps")),
+    # headline: serving p99 while shards migrate; the lost-write count
+    # rides along (and must stay zero)
+    "rebalance": (lambda m: m.startswith("rebalance_"),
+                  lambda m: m.startswith("rebalance_p99_during_move_ms")),
 }
 
 
@@ -1995,6 +1999,151 @@ def bench_meshbeam(n=1_000_000, d=768, batch=256, k=10, ef=96, iters=10,
             platform=jax.devices()[0].platform)
 
 
+def bench_rebalance(n=20_000, d=64, shards=8, batch=8, k=10, iters=0,
+                    warmup=0, load_seconds=3.0):
+    """Elastic scale-out under live traffic (docs/rebalance.md): an
+    in-proc 3-node cluster serving sustained ingest+search scales to 5
+    nodes through the raft rebalance ledger. Journals the p99 search
+    latency DURING the migration window next to the control p99 before
+    it, and the lost-write count (acked writes unreadable after
+    convergence — the number this subsystem exists to keep at zero)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from weaviate_tpu.cluster import ClusterNode, InProcTransport
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        FlatIndexConfig,
+        Property,
+        ReplicationConfig,
+        ShardingConfig,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    rng = np.random.default_rng(11)
+    root = tempfile.mkdtemp(prefix="bench_rebalance_")
+    registry = {}
+    ids = [f"n{i}" for i in range(3)]
+    nodes = [ClusterNode(nid, ids, InProcTransport(registry, nid),
+                         f"{root}/{nid}") for nid in ids]
+    extra = []
+    try:
+        t_deadline = time.monotonic() + 30
+        while not any(nd.raft.is_leader() for nd in nodes):
+            if time.monotonic() > t_deadline:
+                raise RuntimeError("no raft leader")
+            time.sleep(0.05)
+        leader = next(nd for nd in nodes if nd.raft.is_leader())
+        leader.create_collection(CollectionConfig(
+            name="Bench", properties=[Property(name="body")],
+            vector_config=FlatIndexConfig(distance="l2-squared",
+                                          precision="fp32"),
+            sharding=ShardingConfig(desired_count=shards),
+            replication=ReplicationConfig(factor=1)))
+        while not all(nd.db.has_collection("Bench") for nd in nodes):
+            time.sleep(0.05)
+
+        vecs = rng.standard_normal((n, d)).astype(np.float32)
+
+        def obj(i):
+            return StorageObject(uuid=f"{i:032x}", collection="Bench",
+                                 properties={"body": f"doc {i}"},
+                                 vector=vecs[i % n])
+
+        for lo in range(0, n, 1024):
+            nodes[0].put_batch(
+                "Bench", [obj(i) for i in range(lo, min(lo + 1024, n))],
+                consistency="ONE")
+
+        acked, write_errs, lat_ms = [], [], []
+        stop = threading.Event()
+
+        def writer():
+            i = n
+            while not stop.is_set():
+                try:
+                    nodes[0].put_batch("Bench", [obj(i)],
+                                       consistency="ONE")
+                    acked.append(f"{i:032x}")
+                except Exception as e:  # noqa: BLE001 — counted, reported
+                    write_errs.append(str(e))
+                i += 1
+                time.sleep(0.002)
+
+        def searcher():
+            q = vecs[:1]
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    nodes[0].vector_search("Bench", q, k=k)
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+                except Exception:  # noqa: BLE001 — availability noise
+                    pass
+                time.sleep(0.001)
+
+        threads = [threading.Thread(target=writer, daemon=True),
+                   threading.Thread(target=searcher, daemon=True)]
+        for t in threads:
+            t.start()
+        time.sleep(load_seconds / 3)  # control window before the moves
+        control = list(lat_ms)
+
+        # ---- scale 3 -> 5 under the load ---------------------------------
+        reb = nodes[0].rebalancer
+        t_move0 = time.perf_counter()
+        for nid in ("n3", "n4"):
+            extra.append(ClusterNode(
+                nid, ids + ["n3", "n4"],
+                InProcTransport(registry, nid), f"{root}/{nid}"))
+            reb.join(nid, rebalance=False)
+        move_ids = reb.rebalance(max_moves=shards, wait=True)
+        move_s = time.perf_counter() - t_move0
+        during = lat_ms[len(control):]
+        time.sleep(load_seconds / 3)  # settle window
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+        ledger = nodes[0].fsm.rebalance_ledger
+        completed = sum(1 for e in ledger.values()
+                        if e["state"] == "dropped")
+        # convergence, then the zero-lost-writes audit
+        for _ in range(20):
+            if sum(nd.anti_entropy_once("Bench")
+                   for nd in nodes + extra) == 0:
+                break
+        lost = 0
+        for uid in acked:
+            if nodes[1].get("Bench", uid, consistency="ONE") is None:
+                lost += 1
+
+        def p(q_, xs):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(q_ * len(xs)))] if xs else 0.0
+
+        _emit({
+            "metric": "rebalance_p99_during_move_ms",
+            "value": round(p(0.99, during), 2), "unit": "ms",
+            "vs_baseline": 0, "n": n, "d": d, "shards": shards,
+            "p50_during_ms": round(p(0.5, during), 2),
+            "p99_control_ms": round(p(0.99, control), 2),
+            "searches_during": len(during), "move_seconds": round(move_s, 2),
+            "moves_planned": len(move_ids), "moves_completed": completed,
+        })
+        _emit({
+            "metric": "rebalance_lost_writes", "value": lost,
+            "unit": "count", "vs_baseline": 0,
+            "acked_writes": len(acked), "write_errors": len(write_errs),
+        })
+    finally:
+        for nd in nodes + extra:
+            nd.quiesce()
+        for nd in nodes + extra:
+            nd.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_pallas_ab(**kw):
     """The one Pallas compile in the matrix, as its own config ordered
     after every XLA-only serving config: a wedged compile helper
@@ -2022,13 +2171,14 @@ CONFIGS = {
     "bm25seg": bench_bm25seg,
     "ingest": bench_ingest,
     "ingestmp": bench_ingest_parallel,
+    "rebalance": bench_rebalance,
     "pallasab": bench_pallas_ab,
     "bq50m": bench_bq50m,
     "bq100m": bench_bq100m,
 }
 
 # configs that touch no device: they run even when the TPU probe fails
-CPU_ONLY = ("bm25", "bm25seg", "ingest", "ingestmp")
+CPU_ONLY = ("bm25", "bm25seg", "ingest", "ingestmp", "rebalance")
 
 # ---------------------------------------------------------------------------
 # smoke mode: every config end-to-end at ~1/50 scale on CPU (<10 min total),
@@ -2142,6 +2292,8 @@ SMOKE = {
     "bm25seg": dict(n=20_000, vocab=8_000),
     "ingest": dict(n=8_000),
     "ingestmp": dict(n=8_000),
+    # semantics check (moves happen, nothing lost), not a latency claim
+    "rebalance": dict(n=2_000, shards=4, load_seconds=1.5),
 }
 
 

@@ -573,6 +573,81 @@ class TestChaosTransport:
 
 
 # ---------------------------------------------------------------------------
+# move_shard rollback: an aborted move leaves routing exactly as it was
+
+
+def test_move_shard_rollback_restores_routing(chaos3):
+    nodes, chaos = chaos3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=1, shards=1))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    objs = _objs(12)
+    nodes[0].put_batch("Doc", objs, consistency="ONE")
+
+    coord = nodes[0]
+    before = coord._state_for("Doc").replicas(0)
+    src = before[0]
+    dst = next(n.id for n in nodes if n.id not in before)
+    # the convergence loop never reaches verified-zero: the move MUST
+    # abort instead of flipping (with factor=1 a blind flip would drop
+    # the only complete copy)
+    coord._converge_replicas = lambda *a, **k: 1
+    with pytest.raises(Exception, match="did not converge"):
+        coord.move_shard("Doc", 0, src, dst)
+
+    # routing rolled back: same replicas, no warming leftovers, on
+    # every node once raft replication lands
+    def rolled_back():
+        return all(
+            n._state_for("Doc").replicas(0) == before
+            and not n.fsm.shard_warming for n in nodes)
+    wait_for(rolled_back, msg="routing rollback replicated")
+    # reads still answer from the original replica
+    o = nodes[1].get("Doc", objs[0].uuid, consistency="ONE")
+    assert o is not None and o.uuid == objs[0].uuid
+
+
+def test_move_shard_failed_rollback_is_loud(chaos3, caplog):
+    import logging
+
+    nodes, chaos = chaos3
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=1, shards=1))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    nodes[0].put_batch("Doc", _objs(4), consistency="ONE")
+
+    coord = nodes[0]
+    before = coord._state_for("Doc").replicas(0)
+    src = before[0]
+    dst = next(n.id for n in nodes if n.id not in before)
+    coord._converge_replicas = lambda *a, **k: 1  # force the abort
+    real_submit = coord.raft.submit
+
+    def failing_submit(cmd, **kw):
+        # the rollback's routing restore hits a dead raft: the
+        # silent-divergence case the loud-log branch exists for
+        if (cmd.get("op") == "set_shard_replicas"
+                and cmd.get("nodes") == before):
+            raise RuntimeError("raft unavailable during rollback")
+        return real_submit(cmd, **kw)
+
+    coord.raft.submit = failing_submit
+    with caplog.at_level(logging.ERROR, logger="weaviate_tpu.cluster"):
+        with pytest.raises(Exception, match="did not converge"):
+            coord.move_shard("Doc", 0, src, dst)
+    assert any("rollback failed" in r.message for r in caplog.records), \
+        [r.message for r in caplog.records]
+    coord.raft.submit = real_submit
+    # teardown hygiene: restore routing so close() finds a sane cluster
+    real_submit({"op": "set_shard_replicas", "class": "Doc", "shard": 0,
+                 "nodes": before})
+    real_submit({"op": "set_shard_warming", "class": "Doc", "shard": 0,
+                 "nodes": []})
+
+
+# ---------------------------------------------------------------------------
 # soak (slow): sustained faults on EVERY message type + kill/heal cycles
 
 

@@ -299,6 +299,77 @@ def test_rest_over_cluster_replicated_writes(tmp_path):
 
 
 @pytest.mark.slow
+def test_drain_node_across_processes(tmp_path):
+    """Elastic scale-in between REAL OS processes: ctl_drain migrates
+    every replica off a node through the raft rebalance ledger (writes
+    never rejected), then removes it from membership — the surviving
+    two-node cluster keeps answering every pre-drain write."""
+    ports = _free_ports(3)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    procs = {}
+    try:
+        for i, a in enumerate(addrs):
+            procs[a] = _spawn(a, addrs, str(tmp_path / f"n{i}"))
+        _wait(lambda: _leader(addrs), timeout=60, msg="leader election")
+        r = _send(addrs[0], {"type": "ctl_create_collection",
+                             "name": "Doc", "factor": 2}, timeout=10.0)
+        assert r.get("ok"), r
+
+        def put(i, coordinator):
+            r = _send(coordinator, {
+                "type": "ctl_put", "class": "Doc",
+                "uuid": f"00000000-0000-0000-0000-{i:012d}",
+                "properties": {"title": f"obj {i}"},
+                "vector": [float(i), 1.0, 0.0, 0.5]}, timeout=10.0)
+            assert r.get("ok"), (i, r)
+
+        for i in range(12):
+            _wait(lambda i=i: (put(i, addrs[i % 3]), True)[1], timeout=20,
+                  msg=f"put {i}")
+
+        # drain the node that holds a replica of shard 0, coordinated
+        # from a DIFFERENT node over real TCP
+        r = _send(addrs[0], {"type": "ctl_replicas", "class": "Doc"},
+                  timeout=5.0)
+        assert r.get("ok"), r
+        victim = r["replicas"][0]
+        coord = next(a for a in addrs if a != victim)
+        r = _send(coord, {"type": "ctl_drain", "node": victim},
+                  timeout=120.0)
+        assert r.get("ok"), r
+        assert r["move_ids"], "the drained node held a replica"
+
+        # membership shrank everywhere; nothing routes to the victim
+        def drained():
+            for a in addrs:
+                if a == victim:
+                    continue
+                st = _send(a, {"type": "ctl_status"}, timeout=5.0)
+                if victim in st.get("members", [victim]):
+                    return False
+                reps = _send(a, {"type": "ctl_replicas", "class": "Doc"},
+                             timeout=5.0)
+                if victim in reps.get("replicas", [victim]):
+                    return False
+            return True
+        _wait(drained, timeout=30, msg="drain visible everywhere")
+
+        # zero lost writes: the survivors answer every pre-drain object
+        for i in range(12):
+            r = _send(coord, {
+                "type": "ctl_get", "class": "Doc",
+                "uuid": f"00000000-0000-0000-0000-{i:012d}",
+                "consistency": "ONE"}, timeout=10.0)
+            assert r.get("ok") and r.get("found"), (i, r)
+    finally:
+        for p in procs.values():
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+@pytest.mark.slow
 def test_live_replica_movement_across_processes(tmp_path):
     """LIVE shard movement (bulk copy -> warming join -> verified-zero
     anti-entropy -> atomic flip+warming-clear -> post-flip sweep -> src

@@ -1,0 +1,998 @@
+"""Elastic scale-out suite (docs/rebalance.md).
+
+Covers the rebalance ledger FSM, the pure placement planner, the gossip
+capacity advertisement, node join/drain under live traffic, the
+coordinator crash-resume matrix (killed mid-copy / mid-warming /
+mid-drop), the orphan-copy GC, the writable-source shard export, and the
+acceptance chaos scenario: scale 3->5 nodes under sustained ingest+search
+with seeded drop/latency faults, a donor killed mid-migration, zero lost
+acked writes, zero writes rejected due to migration, and every migration
+leg visible as one trace.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.cluster import (
+    ChaosTransport,
+    ClusterNode,
+    InProcTransport,
+    Move,
+    ReplicationError,
+    plan_moves,
+)
+from weaviate_tpu.cluster.fsm import SchemaFSM
+from weaviate_tpu.monitoring.metrics import (
+    NODE_HBM_BUDGET,
+    NODE_HBM_USED,
+    ORPHAN_SHARDS_DROPPED,
+    REBALANCE_MOVES,
+)
+from weaviate_tpu.monitoring.tracing import TRACER
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    FlatIndexConfig,
+    Property,
+    ReplicationConfig,
+    ShardingConfig,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+# fault the replica data plane only: raft/gossip control stays clean so
+# leadership and the ledger survive while the data path is under fire
+DATA_TYPES = (
+    "replica_prepare", "replica_commit", "replica_abort", "replica_delete",
+    "object_digest", "object_fetch", "object_push",
+    "hashtree_leaves", "hashtree_items", "shard_export", "shard_drop",
+)
+
+
+def wait_for(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _leader(nodes):
+    for n in nodes:
+        if n.raft.is_leader():
+            return n
+    return None
+
+
+def _cfg(factor=1, shards=6, name="Doc"):
+    return CollectionConfig(
+        name=name,
+        properties=[Property(name="body")],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        sharding=ShardingConfig(desired_count=shards),
+        replication=ReplicationConfig(factor=factor),
+    )
+
+
+def _objs(n, dims=8, start=0, name="Doc"):
+    out = []
+    for i in range(start, start + n):
+        v = np.zeros(dims, np.float32)
+        v[i % dims] = 1.0
+        out.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}",
+            collection=name,
+            properties={"body": f"doc {i}"},
+            vector=v,
+        ))
+    return out
+
+
+def _make_cluster(tmp_path, ids, chaos_seed=None):
+    """In-proc cluster; chaos_seed wraps every node's outbound path."""
+    registry = {}
+    nodes, chaos = [], {}
+    for i, nid in enumerate(ids):
+        t = InProcTransport(registry, nid)
+        if chaos_seed is not None:
+            t = ChaosTransport(t, seed=chaos_seed + i)
+            chaos[nid] = t
+        nodes.append(ClusterNode(nid, ids, t, str(tmp_path / nid)))
+    wait_for(lambda: any(n.raft.is_leader() for n in nodes),
+             msg="leader election")
+    return nodes, registry, chaos
+
+
+def _teardown(nodes):
+    for n in nodes:
+        n.quiesce()
+    for n in nodes:
+        n.close()
+
+
+def _add_node(registry, ids_now, nid, tmp_path, chaos=None,
+              chaos_seed=None):
+    t = InProcTransport(registry, nid)
+    if chaos is not None:
+        t = ChaosTransport(t, seed=chaos_seed)
+        chaos[nid] = t
+    return ClusterNode(nid, sorted(set(ids_now) | {nid}), t,
+                       str(tmp_path / nid))
+
+
+def _converge(nodes, cls, rounds=15):
+    for _ in range(rounds):
+        if sum(n.anti_entropy_once(cls) for n in nodes) == 0:
+            return
+    raise AssertionError(f"no zero-move anti-entropy round in {rounds}")
+
+
+def _ledger(node):
+    return dict(node.fsm.rebalance_ledger)
+
+
+# ---------------------------------------------------------------------------
+# ledger FSM unit coverage
+
+
+class TestLedgerFSM:
+    def _fsm(self):
+        return SchemaFSM(db=None)
+
+    def _entry(self, mid="m1", shard=0):
+        return {"id": mid, "class": "Doc", "shard": shard, "src": "n0",
+                "dst": "n3", "tenant": "", "prev_nodes": ["n0"],
+                "final_nodes": ["n3"], "coordinator": "n0",
+                "created_ts": 1.0}
+
+    def test_plan_advance_full_lifecycle(self):
+        fsm = self._fsm()
+        assert fsm.apply({"op": "rebalance_plan",
+                          "entry": self._entry()})["ok"]
+        assert fsm.rebalance_ledger["m1"]["state"] == "planned"
+        for state in ("copying", "warming", "flipped", "dropped"):
+            r = fsm.apply({"op": "rebalance_advance", "id": "m1",
+                           "state": state, "ts": 2.0})
+            assert r["ok"], (state, r)
+        assert fsm.rebalance_ledger["m1"]["state"] == "dropped"
+
+    def test_illegal_transitions_rejected(self):
+        fsm = self._fsm()
+        fsm.apply({"op": "rebalance_plan", "entry": self._entry()})
+        # planned cannot skip to warming/flipped/dropped
+        for state in ("warming", "flipped", "dropped"):
+            assert not fsm.apply({"op": "rebalance_advance", "id": "m1",
+                                  "state": state})["ok"]
+        # a flipped move cannot abort — it can only roll forward
+        for state in ("copying", "warming", "flipped"):
+            fsm.apply({"op": "rebalance_advance", "id": "m1",
+                       "state": state})
+        assert not fsm.apply({"op": "rebalance_advance", "id": "m1",
+                              "state": "aborted"})["ok"]
+        # terminal is terminal
+        fsm.apply({"op": "rebalance_advance", "id": "m1",
+                   "state": "dropped"})
+        assert not fsm.apply({"op": "rebalance_advance", "id": "m1",
+                              "state": "copying"})["ok"]
+
+    def test_same_state_recommit_is_coordinator_takeover(self):
+        fsm = self._fsm()
+        fsm.apply({"op": "rebalance_plan", "entry": self._entry()})
+        fsm.apply({"op": "rebalance_advance", "id": "m1",
+                   "state": "copying"})
+        r = fsm.apply({"op": "rebalance_advance", "id": "m1",
+                       "state": "copying", "coordinator": "n7"})
+        assert r["ok"]
+        assert fsm.rebalance_ledger["m1"]["coordinator"] == "n7"
+
+    def test_one_active_move_per_shard(self):
+        fsm = self._fsm()
+        assert fsm.apply({"op": "rebalance_plan",
+                          "entry": self._entry("m1")})["ok"]
+        assert not fsm.apply({"op": "rebalance_plan",
+                              "entry": self._entry("m2")})["ok"]
+        # a terminal move frees the shard
+        fsm.apply({"op": "rebalance_advance", "id": "m1",
+                   "state": "aborted"})
+        assert fsm.apply({"op": "rebalance_plan",
+                          "entry": self._entry("m2")})["ok"]
+        # duplicate id always rejected
+        assert not fsm.apply({"op": "rebalance_plan",
+                              "entry": self._entry("m2", shard=1)})["ok"]
+
+    def test_forget_removes_terminal_only(self):
+        fsm = self._fsm()
+        fsm.apply({"op": "rebalance_plan", "entry": self._entry("m1", 0)})
+        fsm.apply({"op": "rebalance_plan", "entry": self._entry("m2", 1)})
+        fsm.apply({"op": "rebalance_advance", "id": "m1",
+                   "state": "aborted"})
+        r = fsm.apply({"op": "rebalance_forget"})
+        assert r == {"ok": True, "removed": 1}
+        assert set(fsm.rebalance_ledger) == {"m2"}
+
+    def test_forget_before_compacts_only_old_terminal(self):
+        fsm = self._fsm()
+        fsm.apply({"op": "rebalance_plan", "entry": self._entry("m1", 0)})
+        fsm.apply({"op": "rebalance_plan", "entry": self._entry("m2", 1)})
+        fsm.apply({"op": "rebalance_advance", "id": "m1",
+                   "state": "aborted", "ts": 100.0})
+        fsm.apply({"op": "rebalance_advance", "id": "m2",
+                   "state": "aborted", "ts": 500.0})
+        r = fsm.apply({"op": "rebalance_forget", "before": 200.0})
+        assert r == {"ok": True, "removed": 1}
+        assert set(fsm.rebalance_ledger) == {"m2"}
+
+    def test_draining_ops(self):
+        fsm = self._fsm()
+        assert fsm.apply({"op": "set_node_draining", "node": "n2"})["ok"]
+        fsm.apply({"op": "set_node_draining", "node": "n2"})  # idempotent
+        assert fsm.draining_nodes == ["n2"]
+        assert fsm.apply({"op": "clear_node_draining", "node": "n2"})["ok"]
+        assert fsm.draining_nodes == []
+
+
+def test_ledger_and_draining_survive_snapshot_restore(tmp_path):
+    from weaviate_tpu.core.db import DB
+
+    db_a = DB(str(tmp_path / "a"))
+    db_b = DB(str(tmp_path / "b"))
+    try:
+        a, b = SchemaFSM(db_a), SchemaFSM(db_b)
+        a.apply({"op": "rebalance_plan", "entry": {
+            "id": "m1", "class": "Doc", "shard": 0, "src": "n0",
+            "dst": "n3", "tenant": "", "prev_nodes": ["n0"],
+            "final_nodes": ["n3"], "coordinator": "n0",
+            "created_ts": 1.0}})
+        a.apply({"op": "rebalance_advance", "id": "m1",
+                 "state": "copying"})
+        a.apply({"op": "set_node_draining", "node": "n1"})
+        b.restore(a.snapshot())
+        assert b.rebalance_ledger["m1"]["state"] == "copying"
+        assert b.draining_nodes == ["n1"]
+    finally:
+        db_a.close()
+        db_b.close()
+
+
+# ---------------------------------------------------------------------------
+# the pure planner
+
+
+class TestPlanMoves:
+    def _snap(self, shards, nodes=("n0", "n1", "n2"), draining=(),
+              meta=None):
+        return {"nodes": list(nodes), "draining": set(draining),
+                "meta": meta or {}, "shards": shards}
+
+    def test_join_pulls_hottest_shards_onto_empty_node(self):
+        shards = [
+            {"class": "Doc", "shard": 0, "replicas": ["n0"], "weight": 3.0},
+            {"class": "Doc", "shard": 1, "replicas": ["n0"], "weight": 1.0},
+            {"class": "Doc", "shard": 2, "replicas": ["n1"], "weight": 1.0},
+        ]
+        moves = plan_moves(self._snap(shards, nodes=["n0", "n1", "n2"]))
+        assert moves, "empty node must receive load"
+        # the HOT shard moves first, and onto the empty node
+        assert moves[0] == Move("Doc", 0, "n0", "n2")
+
+    def test_drain_evacuates_everything_and_never_targets_draining(self):
+        shards = [
+            {"class": "Doc", "shard": s,
+             "replicas": ["n2" if s % 2 else "n0"], "weight": 1.0}
+            for s in range(4)
+        ]
+        moves = plan_moves(self._snap(shards, draining={"n2"}),
+                           max_moves=100)
+        drained = [m for m in moves if m.src == "n2"]
+        assert {m.shard for m in drained} == {1, 3}
+        assert all(m.dst != "n2" for m in moves)
+
+    def test_full_hbm_budget_excludes_target(self):
+        shards = [{"class": "Doc", "shard": s, "replicas": ["n0"],
+                   "weight": 1.0} for s in range(4)]
+        meta = {"n1": {"hbm_budget": 100, "hbm_used": 100, "ts": 1.0},
+                "n2": {"hbm_budget": 100, "hbm_used": 10, "ts": 1.0}}
+        moves = plan_moves(self._snap(shards, meta=meta), max_moves=100)
+        assert moves and all(m.dst == "n2" for m in moves)
+
+    def test_balanced_cluster_plans_nothing(self):
+        shards = [{"class": "Doc", "shard": s,
+                   "replicas": [f"n{s % 3}"], "weight": 1.0}
+                  for s in range(6)]
+        assert plan_moves(self._snap(shards)) == []
+
+    def test_max_moves_cap(self):
+        shards = [{"class": "Doc", "shard": s, "replicas": ["n0"],
+                   "weight": 1.0} for s in range(20)]
+        assert len(plan_moves(self._snap(shards), max_moves=3)) == 3
+
+    def test_never_targets_existing_replica(self):
+        shards = [{"class": "Doc", "shard": 0,
+                   "replicas": ["n0", "n1", "n2"], "weight": 1.0}]
+        assert plan_moves(self._snap(shards)) == []
+
+
+# ---------------------------------------------------------------------------
+# gossip capacity advertisement (satellite: HBM budget/usage via gossip)
+
+
+def test_gossip_advertises_hbm_capacity(tmp_path):
+    nodes, _registry, _ = _make_cluster(tmp_path, ["n0", "n1", "n2"])
+    try:
+        for i, n in enumerate(nodes):
+            n.capacity_fn = (
+                lambda i=i: {"hbm_budget": 1000 * (i + 1),
+                             "hbm_used": 100 * (i + 1)})
+        def fresh():
+            meta = nodes[0].gossip.node_meta()
+            return (meta.get("n1", {}).get("hbm_budget") == 2000
+                    and meta.get("n2", {}).get("hbm_used") == 300)
+        wait_for(fresh, timeout=8.0, msg="capacity meta propagation")
+        meta = nodes[0].gossip.node_meta()
+        assert meta["n1"]["hbm_budget"] == 2000
+        assert meta["n2"]["hbm_used"] == 300
+        # surfaced as gauges, labeled per node
+        assert NODE_HBM_BUDGET.value(node="n1") == 2000
+        assert NODE_HBM_USED.value(node="n2") == 300
+        # and in the operator cluster view
+        view = nodes[0].cluster_view()
+        assert view["nodes"]["n1"]["meta"]["hbm_budget"] == 2000
+        assert view["draining"] == []
+    finally:
+        _teardown(nodes)
+
+
+# ---------------------------------------------------------------------------
+# join: scale out onto a new node
+
+
+def test_join_moves_shards_onto_new_node_and_journals(tmp_path):
+    ids = ["n0", "n1", "n2"]
+    nodes, registry, _ = _make_cluster(tmp_path, ids)
+    n3 = None
+    try:
+        leader = _leader(nodes)
+        leader.create_collection(_cfg(factor=1, shards=6))
+        wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+                 msg="schema replication")
+        objs = _objs(30)
+        nodes[0].put_batch("Doc", objs, consistency="ONE")
+
+        n3 = _add_node(registry, ids, "n3", tmp_path)
+        ids_ids = nodes[0].rebalancer.join("n3")
+        assert ids_ids, "join should have planned moves"
+        wait_for(lambda: "n3" in nodes[1].all_nodes,
+                 msg="membership replication")
+
+        # every journaled move ran to terminal DROPPED (worker joined;
+        # the last advance's local FSM apply may lag a beat)
+        wait_for(lambda: all(
+            _ledger(nodes[0]).get(mid, {}).get("state") == "dropped"
+            for mid in ids_ids), msg="all moves dropped")
+        led = _ledger(nodes[0])
+        # the ledger is raft state: identical on a peer
+        wait_for(lambda: all(
+            _ledger(nodes[1]).get(mid, {}).get("state") == "dropped"
+            for mid in ids_ids), msg="ledger replication")
+
+        # n3 now holds routed shards; moved sources dropped their copies
+        st = nodes[0]._state_for("Doc")
+        n3_shards = [s for s in range(st.n_shards)
+                     if "n3" in st.replicas(s)]
+        assert n3_shards, "no shard routed to the joined node"
+        assert not nodes[0].fsm.shard_warming, "warming must be cleared"
+        for mid in ids_ids:
+            e = led[mid]
+            src_col = next(n for n in nodes if n.id == e["src"]) \
+                .db.get_collection("Doc")
+            assert f"shard{e['shard']}" not in src_col._shards
+
+        # zero lost writes: every object readable through new routing
+        for o in objs:
+            got = nodes[1].get("Doc", o.uuid, consistency="ONE")
+            assert got is not None and got.uuid == o.uuid
+
+        # each migration is ONE trace: rebalance.move root + leg spans
+        spans = TRACER.recent(limit=4096)
+        roots = {s["attributes"].get("move_id"): s for s in spans
+                 if s["name"] == "rebalance.move"}
+        for mid in ids_ids:
+            root = roots.get(mid)
+            assert root is not None, f"no rebalance.move trace for {mid}"
+            kids = {s["name"] for s in spans
+                    if s["parentSpanId"] == root["spanId"]}
+            assert {"rebalance.copy", "rebalance.anti_entropy",
+                    "rebalance.flip", "rebalance.drop"} <= kids, kids
+            assert all(s["traceId"] == root["traceId"] for s in spans
+                       if s["parentSpanId"] == root["spanId"])
+    finally:
+        _teardown(nodes + ([n3] if n3 is not None else []))
+
+
+# ---------------------------------------------------------------------------
+# drain: scale in without ever rejecting a write
+
+
+def test_drain_never_rejects_writes_and_removes_node(tmp_path):
+    ids = ["n0", "n1", "n2"]
+    nodes, _registry, _ = _make_cluster(tmp_path, ids)
+    try:
+        leader = _leader(nodes)
+        leader.create_collection(_cfg(factor=1, shards=6))
+        wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+                 msg="schema replication")
+        nodes[0].put_batch("Doc", _objs(24), consistency="ONE")
+
+        acked, errors = [], []
+        stop = threading.Event()
+
+        def writer():
+            i = 1000
+            while not stop.is_set():
+                batch = _objs(1, start=i)
+                try:
+                    nodes[0].put_batch("Doc", batch, consistency="ONE")
+                    acked.extend(o.uuid for o in batch)
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    errors.append(str(e))
+                i += 1
+                time.sleep(0.002)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            move_ids = nodes[0].rebalancer.drain("n2")
+        finally:
+            time.sleep(0.1)  # a few post-drain writes too
+            stop.set()
+            t.join(timeout=5)
+
+        assert move_ids, "n2 held shards; drain must move them"
+        # drain NEVER rejects a write: no error at all on the healthy
+        # in-proc cluster, and specifically never a migration freeze
+        assert not errors, errors
+        # membership shrank, draining mark cleared, nothing routes to n2
+        wait_for(lambda: "n2" not in nodes[0].all_nodes,
+                 msg="membership shrink")
+        assert nodes[0].fsm.draining_nodes == []
+        st = nodes[0]._state_for("Doc")
+        for s in range(st.n_shards):
+            assert "n2" not in st.replicas(s)
+        # zero lost writes across the drain
+        for uid in [o.uuid for o in _objs(24)] + acked:
+            got = nodes[1].get("Doc", uid, consistency="ONE")
+            assert got is not None, f"lost {uid}"
+    finally:
+        _teardown(nodes)
+
+
+def test_new_collection_mid_drain_skips_draining_node(tmp_path):
+    ids = ["n0", "n1", "n2"]
+    nodes, _registry, _ = _make_cluster(tmp_path, ids)
+    try:
+        leader = _leader(nodes)
+        r = nodes[0].raft.submit({"op": "set_node_draining", "node": "n2"})
+        assert r.get("ok"), r
+        leader.create_collection(_cfg(factor=2, shards=4, name="Fresh"))
+        wait_for(lambda: all(n.db.has_collection("Fresh") for n in nodes),
+                 msg="schema replication")
+        st = nodes[0]._state_for("Fresh")
+        for s in range(st.n_shards):
+            assert "n2" not in st.replicas(s), \
+                "new placement landed on a draining node"
+        # and the router demotes the draining node in read ordering
+        plan = nodes[0].router.read_plan("Fresh", 0)
+        assert "n2" not in plan.ordered
+    finally:
+        _teardown(nodes)
+
+
+# ---------------------------------------------------------------------------
+# coordinator crash-resume matrix (the ledger's reason to exist)
+
+
+@pytest.mark.parametrize("crash_at,stuck_state,expected", [
+    ("copy", "copying", "aborted"),    # nothing routed yet -> clean abort
+    ("flip", "warming", "resumed"),    # dst already takes writes -> finish
+    ("drop", "flipped", "resumed"),    # past the flip -> roll forward
+])
+def test_coordinator_crash_then_resume(tmp_path, crash_at, stuck_state,
+                                       expected):
+    ids = ["n0", "n1", "n2"]
+    nodes, _registry, _ = _make_cluster(tmp_path, ids)
+    try:
+        leader = _leader(nodes)
+        leader.create_collection(_cfg(factor=1, shards=2))
+        wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+                 msg="schema replication")
+        objs = _objs(16)
+        nodes[0].put_batch("Doc", objs, consistency="ONE")
+
+        st = nodes[0]._state_for("Doc")
+        src = st.replicas(0)[0]
+        dst = next(n for n in ids if n not in st.replicas(0))
+        reb = nodes[0].rebalancer
+        reb.crash_points = {crash_at}
+        mids = reb.execute([Move("Doc", 0, src, dst)], wait=True)
+        assert len(mids) == 1
+        mid = mids[0]
+        # the coordinator died mid-move: entry journaled at the phase
+        # it reached, replicated to every node
+        wait_for(lambda: _ledger(nodes[1]).get(mid, {}).get("state")
+                 == stuck_state, msg=f"ledger stuck at {stuck_state}")
+        reb.crash_points = set()
+
+        # ANOTHER node picks the move up from the ledger
+        out = nodes[1].rebalancer.resume_pending(force=True)
+        assert out.get(mid) == expected, out
+        want = "aborted" if expected == "aborted" else "dropped"
+        wait_for(lambda: _ledger(nodes[1]).get(mid, {}).get("state")
+                 == want, msg=f"ledger terminal {want}")
+        assert _ledger(nodes[1])[mid]["coordinator"] == "n1"
+
+        # invariants after recovery: no warming replica left excluded
+        # from reads, and no shard routed below its factor
+        st = nodes[1]._state_for("Doc")
+        assert not nodes[1].fsm.shard_warming
+        for s in range(st.n_shards):
+            assert len(st.replicas(s)) >= st.factor
+            assert st.read_replicas(s) == st.replicas(s)
+        # the shard ended on exactly one side, data intact either way
+        routed = st.replicas(0)
+        assert routed == ([src] if expected == "aborted" else [dst])
+        for o in objs:
+            got = nodes[2].get("Doc", o.uuid, consistency="ONE")
+            assert got is not None, f"lost {o.uuid} after {expected}"
+    finally:
+        _teardown(nodes)
+
+
+def test_resume_skips_moves_of_live_coordinators(tmp_path):
+    ids = ["n0", "n1", "n2"]
+    nodes, _registry, _ = _make_cluster(tmp_path, ids)
+    try:
+        leader = _leader(nodes)
+        leader.create_collection(_cfg(factor=1, shards=1))
+        wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+                 msg="schema replication")
+        st = nodes[0]._state_for("Doc")
+        src = st.replicas(0)[0]
+        dst = next(n for n in ids if n not in st.replicas(0))
+        reb = nodes[0].rebalancer
+        reb.crash_points = {"flip"}
+        [mid] = reb.execute([Move("Doc", 0, src, dst)], wait=True)
+        wait_for(lambda: _ledger(nodes[1]).get(mid, {}).get("state")
+                 == "warming", msg="ledger replication to peer")
+        # n0 (the coordinator) is ALIVE per gossip: without force, a
+        # peer must not steal its move
+        assert nodes[1].rebalancer.resume_pending() == {}
+        assert _ledger(nodes[1])[mid]["state"] == "warming"
+        # cleanup: finish it so teardown sees no warming replicas
+        reb.crash_points = set()
+        assert nodes[1].rebalancer.resume_pending(force=True)[mid] \
+            == "resumed"
+    finally:
+        _teardown(nodes)
+
+
+# ---------------------------------------------------------------------------
+# orphan-copy GC (satellite): unrouted copies verified, rescued, reaped
+
+
+def test_orphan_gc_verifies_then_drops_unrouted_copy(tmp_path):
+    ids = ["n0", "n1", "n2"]
+    nodes, _registry, _ = _make_cluster(tmp_path, ids)
+    try:
+        leader = _leader(nodes)
+        leader.create_collection(_cfg(factor=1, shards=2))
+        wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+                 msg="schema replication")
+        nodes[0].put_batch("Doc", _objs(8), consistency="ONE")
+
+        st = nodes[0]._state_for("Doc")
+        orphan_holder = next(n for n in nodes
+                             if n.id not in st.replicas(0))
+        # a stranded copy: objects landed outside routing (exactly what a
+        # failed post-move shard_drop leaves), including one UNIQUE
+        # object routing has never seen
+        unique = _objs(1, start=7777)[0]
+        unique.update_time_ms = int(time.time() * 1000)
+        blobs = [o.to_bytes() for o in _objs(3)] + [unique.to_bytes()]
+        orphan_holder._on_object_push({"class": "Doc", "tenant": "",
+                                       "shard": 0, "objects": blobs})
+        assert orphan_holder._local_shard("Doc", 0).count() > 0
+
+        before = ORPHAN_SHARDS_DROPPED.value(collection="Doc")
+        orphan_holder.orphan_grace_s = 10.0
+        assert orphan_holder.gc_orphan_shards_once() == 0  # grace window
+        orphan_holder.orphan_grace_s = 0.0
+        assert orphan_holder.gc_orphan_shards_once() == 1
+        assert ORPHAN_SHARDS_DROPPED.value(collection="Doc") == before + 1
+        assert f"shard0" not in \
+            orphan_holder.db.get_collection("Doc")._shards
+        # the verify pass RESCUED the unique object into routing before
+        # dropping the copy — GC never deletes what routing can't serve
+        shard_no = st.shard_replicas_for_uuid(unique.uuid)[0]
+        if shard_no == 0:  # only meaningful if it hashed to the orphan
+            got = nodes[0].get("Doc", unique.uuid, consistency="ONE")
+            assert got is not None
+    finally:
+        _teardown(nodes)
+
+
+def test_orphan_gc_keeps_copy_when_routing_unreachable(tmp_path):
+    ids = ["n0", "n1", "n2"]
+    nodes, _registry, chaos = _make_cluster(tmp_path, ids, chaos_seed=77)
+    try:
+        leader = _leader(nodes)
+        leader.create_collection(_cfg(factor=1, shards=2))
+        wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+                 msg="schema replication")
+        st = nodes[0]._state_for("Doc")
+        holder = next(n for n in nodes if n.id not in st.replicas(0))
+        holder._on_object_push({
+            "class": "Doc", "tenant": "", "shard": 0,
+            "objects": [o.to_bytes() for o in _objs(2)]})
+        holder.orphan_grace_s = 0.0
+        # routing unreachable: the copy MUST survive the sweep (first
+        # pass records the sighting, second attempts the verify)
+        for peer in st.replicas(0):
+            chaos[holder.id].partition(peer)
+        assert holder.gc_orphan_shards_once() == 0
+        assert holder.gc_orphan_shards_once() == 0
+        assert holder._local_shard("Doc", 0).count() > 0
+        chaos[holder.id].clear()
+    finally:
+        _teardown(nodes)
+
+
+# ---------------------------------------------------------------------------
+# shard export stays correct while the source keeps taking writes
+
+
+def test_shard_export_pages_stable_under_concurrent_writes(tmp_path):
+    node = ClusterNode("s0", ["s0"], InProcTransport({}, "s0"),
+                       str(tmp_path / "s0"), heartbeat=False)
+    try:
+        node.fsm.apply({"op": "add_class",
+                        "class": _cfg(factor=1, shards=1).to_dict()})
+        shard = node._local_shard("Doc", 0)
+        initial = _objs(400)
+        shard.put_batch(initial)
+
+        stop = threading.Event()
+        write_err = []
+
+        def writer():
+            i = 10_000
+            while not stop.is_set():
+                try:
+                    shard.put_batch(_objs(8, start=i))
+                except Exception as e:  # noqa: BLE001 — asserted below
+                    write_err.append(e)
+                i += 8
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            seen = set()
+            after = -1
+            while True:
+                r = node._on_shard_export({"class": "Doc", "shard": 0,
+                                           "after": after, "limit": 32})
+                for raw in r["objects"]:
+                    seen.add(StorageObject.from_bytes(raw).uuid)
+                if r["next"] is None:
+                    break
+                after = r["next"]
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert not write_err, write_err
+        # every object present BEFORE the export started is in the pages
+        # — a concurrent put never fails or truncates a hydration page
+        missing = {o.uuid for o in initial} - seen
+        assert not missing, f"{len(missing)} pre-export objects missing"
+    finally:
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# drain racing a concurrent drop_shard on the source (satellite)
+
+
+def test_move_races_concurrent_source_drop(tmp_path):
+    ids = ["n0", "n1", "n2"]
+    nodes, _registry, _ = _make_cluster(tmp_path, ids)
+    try:
+        leader = _leader(nodes)
+        leader.create_collection(_cfg(factor=2, shards=1))
+        wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+                 msg="schema replication")
+        objs = _objs(30)
+        nodes[0].put_batch("Doc", objs, consistency="ALL")
+
+        st = nodes[0]._state_for("Doc")
+        src = st.replicas(0)[0]
+        dst = next(n for n in ids if n not in st.replicas(0))
+        src_node = next(n for n in nodes if n.id == src)
+        reb = nodes[0].rebalancer
+        reb.page = 4  # many pages: widen the race window
+
+        fired = threading.Event()
+
+        def dropper():
+            # a concurrent shard_drop on the SOURCE mid-copy (a stale
+            # cleanup, an operator mistake) must not corrupt the move
+            time.sleep(0.01)
+            try:
+                src_node._on_shard_drop({"class": "Doc", "tenant": "",
+                                         "shard": 0})
+            finally:
+                fired.set()
+
+        t = threading.Thread(target=dropper, daemon=True)
+        t.start()
+        mids = reb.execute([Move("Doc", 0, src, dst)], wait=True,
+                           timeout=60.0)
+        t.join(timeout=5)
+        assert fired.is_set()
+        # whatever side won: the entry is terminal, routing is
+        # consistent, and no acked write is lost (the second replica of
+        # factor=2 still holds everything; anti-entropy heals the rest)
+        wait_for(lambda: _ledger(nodes[1]).get(mids[0], {}).get("state")
+                 in ("dropped", "aborted"), msg="entry terminal on peer")
+        assert not nodes[0].fsm.shard_warming
+        _converge(nodes, "Doc")
+        for o in objs:
+            got = nodes[1].get("Doc", o.uuid, consistency="ONE")
+            assert got is not None, f"lost {o.uuid}"
+    finally:
+        _teardown(nodes)
+
+
+# ---------------------------------------------------------------------------
+# REST surface: the operator cluster view + rebalance endpoints
+
+
+def test_rest_debug_cluster_and_rebalance_endpoints(tmp_path):
+    import json
+    import urllib.error
+    import urllib.request
+
+    from weaviate_tpu.api.rest import RestAPI
+
+    def call(base, method, path, body=None):
+        req = urllib.request.Request(
+            base + path,
+            data=None if body is None else json.dumps(body).encode(),
+            method=method, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as r:
+                d = r.read()
+                return r.status, (json.loads(d) if d else None)
+        except urllib.error.HTTPError as e:
+            return e.code, None
+
+    node = ClusterNode("s0", ["s0"], InProcTransport({}, "s0"),
+                       str(tmp_path / "s0"))
+    try:
+        wait_for(lambda: node.raft.is_leader(), msg="singleton leader")
+        node.create_collection(_cfg(factor=1, shards=2))
+        api = RestAPI(node.db, cluster=node)
+        srv = api.serve(host="127.0.0.1", port=0, background=True)
+        base = f"http://127.0.0.1:{srv.server_port}"
+        try:
+            status, view = call(base, "GET", "/v1/debug/cluster")
+            assert status == 200
+            assert view["node"] == "s0"
+            assert "s0" in view["nodes"]
+            assert "hbm_budget" in view["nodes"]["s0"]["meta"]
+            assert view["rebalance_ledger"] == []
+            # planner dry-run: a balanced singleton plans nothing
+            status, plan = call(base, "GET", "/v1/cluster/rebalance")
+            assert status == 200 and plan == {"moves": []}
+            status, out = call(base, "POST", "/v1/cluster/rebalance", {})
+            assert status == 200 and out == {"moveIds": []}
+            # drain validates membership up front...
+            status, _ = call(base, "POST",
+                             "/v1/cluster/drain/sX?remove=false")
+            assert status == 404
+            # ...and kicks off async for a real member
+            status, out = call(
+                base, "POST", "/v1/cluster/drain/s0?remove=false")
+            assert status == 202 and out["draining"] == "s0"
+        finally:
+            api.shutdown()
+    finally:
+        node.close()
+
+    # no cluster wired: the debug view degrades, rebalance is 422
+    from weaviate_tpu.core.db import DB
+
+    db = DB(str(tmp_path / "solo"))
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0, background=True)
+    base = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        status, view = call(base, "GET", "/v1/debug/cluster")
+        assert status == 200 and view["nodes"] == {}
+        status, _ = call(base, "GET", "/v1/cluster/rebalance")
+        assert status == 422
+    finally:
+        api.shutdown()
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: 3 -> 5 under chaos, donor killed mid-migration
+
+
+def test_chaos_scale_out_3_to_5_donor_killed_mid_migration(tmp_path):
+    ids = ["n0", "n1", "n2"]
+    nodes, registry, chaos = _make_cluster(tmp_path, ids, chaos_seed=500)
+    extra = []
+    try:
+        leader = _leader(nodes)
+        leader.create_collection(_cfg(factor=1, shards=8))
+        wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+                 msg="schema replication")
+        nodes[0].put_batch("Doc", _objs(40), consistency="ONE")
+
+        # seeded drop + latency faults on the data plane for the whole
+        # scale-out; raft/gossip stay clean so the ledger survives
+        for a in ids:
+            for b in ids:
+                if a != b:
+                    chaos[a].program(b, drop=0.03, jitter=0.01,
+                                     types=DATA_TYPES)
+
+        # sustained ingest + search under the faults
+        acked, frozen_rejections, search_errs = [], [], []
+        stop = threading.Event()
+
+        def writer():
+            i = 1000
+            while not stop.is_set():
+                batch = _objs(1, start=i)
+                try:
+                    nodes[0].put_batch("Doc", batch, consistency="ONE")
+                    acked.extend(o.uuid for o in batch)
+                except Exception as e:  # noqa: BLE001 — triaged below
+                    if "frozen" in str(e):
+                        frozen_rejections.append(str(e))
+                i += 1
+                time.sleep(0.004)
+
+        def searcher():
+            q = np.zeros((8,), np.float32)
+            while not stop.is_set():
+                try:
+                    nodes[0].vector_search("Doc", q, k=3)
+                except Exception as e:  # noqa: BLE001 — triaged below
+                    if "frozen" in str(e):
+                        frozen_rejections.append(str(e))
+                    else:
+                        search_errs.append(str(e))
+                time.sleep(0.004)
+
+        threads = [threading.Thread(target=writer, daemon=True),
+                   threading.Thread(target=searcher, daemon=True)]
+        for t in threads:
+            t.start()
+
+        # ---- scale 3 -> 5 ------------------------------------------------
+        reb = nodes[0].rebalancer
+        for nid in ("n3", "n4"):
+            extra.append(_add_node(registry, ids + ["n3", "n4"], nid,
+                                   tmp_path, chaos=chaos,
+                                   chaos_seed=900 + len(extra)))
+            reb.join(nid, rebalance=False)
+        moves = reb.plan(max_moves=8)
+        assert moves, "scale-out must plan moves onto the new nodes"
+        assert {m.dst for m in moves} <= {"n3", "n4"}
+        # the donor we will kill: a source that is NOT the coordinator
+        donor = next(m.src for m in moves if m.src != "n0")
+        # slow the donor's hydration pages so the kill lands mid-copy
+        reb.page = 4
+        chaos[donor].program(None, latency=0.02, types=("shard_export",))
+
+        mids = reb.execute(moves, wait=False)
+        # the plan entries are raft-committed; n0's local apply may lag
+        wait_for(lambda: all(mid in _ledger(nodes[0]) for mid in mids),
+                 msg="planned entries in local ledger")
+        donor_mid = next(
+            mid for mid in mids
+            if _ledger(nodes[0])[mid]["src"] == donor)
+
+        # ---- kill the donor mid-migration --------------------------------
+        wait_for(lambda: _ledger(nodes[0])[donor_mid]["state"]
+                 in ("copying", "warming"), timeout=20.0,
+                 msg="donor move in flight")
+        interrupted_at = _ledger(nodes[0])[donor_mid]["state"]
+        for nid in ids + ["n3", "n4"]:
+            if nid != donor:
+                chaos[nid].partition(donor)
+        chaos[donor].program(None, partition=True)
+
+        # the interrupted move reaches a terminal state VIA THE LEDGER:
+        # aborted (routing rolled back) or dropped (resumed to the end)
+        wait_for(lambda: _ledger(nodes[0])[donor_mid]["state"]
+                 in ("aborted", "dropped"), timeout=30.0,
+                 msg="interrupted move terminal via ledger")
+        outcome = _ledger(nodes[0])[donor_mid]["state"]
+        assert interrupted_at in ("copying", "warming")
+
+        # heal the donor ("restart"), finish the scale-out
+        for nid in ids + ["n3", "n4"]:
+            chaos[nid].clear()
+        for n in nodes + extra:
+            n.breakers.reset()
+        wait_for(lambda: _leader(nodes + extra) is not None,
+                 msg="leadership after heal")
+        wait_for(lambda: all(
+            e["state"] in ("dropped", "aborted")
+            for e in _ledger(nodes[0]).values()), timeout=60.0,
+            msg="all first-round moves terminal")
+        reb.rebalance(max_moves=8)  # finish spreading after the abort
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+        # ---- convergence + the acceptance assertions ---------------------
+        assert not frozen_rejections, \
+            f"writes rejected due to migration: {frozen_rejections[:3]}"
+        all_nodes = nodes + extra
+        # reap any copy the aborted move stranded outside routing (two
+        # sweeps: the first records the sighting, the second verifies —
+        # rescuing anything the copy uniquely holds — and drops)
+        for n in all_nodes:
+            n.orphan_grace_s = 0.0
+            n.gc_orphan_shards_once()
+            n.gc_orphan_shards_once()
+        _converge(all_nodes, "Doc", rounds=20)
+
+        # zero lost writes: every acked object answers through routing
+        for uid in [o.uuid for o in _objs(40)] + acked:
+            got = nodes[1].get("Doc", uid, consistency="ONE")
+            assert got is not None, f"lost acked write {uid}"
+
+        # the cluster really scaled: both joiners hold routed shards,
+        # every shard fully routed, nothing left warming
+        st = nodes[0]._state_for("Doc")
+        holders = {rep for s in range(st.n_shards)
+                   for rep in st.replicas(s)}
+        assert "n3" in holders and "n4" in holders, holders
+        assert not nodes[0].fsm.shard_warming
+        for s in range(st.n_shards):
+            assert len(st.replicas(s)) >= st.factor
+
+        # every COMPLETED migration is one trace with all four legs
+        spans = TRACER.recent(limit=4096)
+        roots = {s["attributes"].get("move_id"): s for s in spans
+                 if s["name"] == "rebalance.move"}
+        completed = [mid for mid, e in _ledger(nodes[0]).items()
+                     if e["state"] == "dropped"
+                     and e["coordinator"] == "n0"]
+        assert completed, "at least one move must have completed"
+        traced = 0
+        for mid in completed:
+            root = roots.get(mid)
+            if root is None:
+                continue  # evicted from the bounded buffer under load
+            kids = {s["name"] for s in spans
+                    if s["parentSpanId"] == root["spanId"]}
+            if {"rebalance.copy", "rebalance.anti_entropy",
+                    "rebalance.flip", "rebalance.drop"} <= kids:
+                traced += 1
+        assert traced > 0, "no completed move produced a full-leg trace"
+        # the interrupted move's verdict is journaled, not guessed
+        assert outcome in ("aborted", "dropped")
+    finally:
+        for ct in chaos.values():
+            ct.clear()
+        _teardown(nodes + extra)

@@ -294,6 +294,12 @@ DOCS: dict[str, tuple[str, str | None, str | None]] = {
     "nodes_class": ("Node status scoped to one collection", None,
                     "NodesStatusResponse"),
     "cluster_statistics": ("Raft consensus statistics", None, None),
+    "cluster_rebalance": ("Plan (GET) or execute (POST) a shard "
+                          "rebalance round", None, None),
+    "cluster_drain": ("Drain a node: migrate its replicas away, then "
+                      "remove it from membership", None, None),
+    "debug_cluster": ("Cluster view: liveness, capacity adverts, "
+                      "draining set, rebalance ledger", None, None),
     "tasks_list": ("Distributed task table", None, None),
     "replicate": ("Start an async COPY/MOVE replica operation", None,
                   None),

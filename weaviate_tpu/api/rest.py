@@ -162,7 +162,7 @@ class RestAPI:
     _QOS_EXEMPT = frozenset({
         "root", "meta", "ready", "live", "metrics", "openapi",
         "oidc_discovery", "pprof_profile", "pprof_heap", "debug_traces",
-        "debug_config", "debug_telemetry",
+        "debug_config", "debug_telemetry", "debug_cluster",
     })
     # endpoint -> admission lane; anything unlisted is background
     # (schema/authz/backup/replication mutations: important, not latency-
@@ -253,6 +253,10 @@ class RestAPI:
                  methods=["GET"]),
             Rule("/v1/cluster/statistics", endpoint="cluster_statistics",
                  methods=["GET"]),
+            Rule("/v1/cluster/rebalance", endpoint="cluster_rebalance",
+                 methods=["GET", "POST"]),
+            Rule("/v1/cluster/drain/<node>", endpoint="cluster_drain",
+                 methods=["POST"]),
             Rule("/v1/replication/replicate", endpoint="replicate",
                  methods=["POST"]),
             Rule("/v1/replication/replicate/list",
@@ -336,6 +340,8 @@ class RestAPI:
                  methods=["GET"]),
             # debug/ops plane (reference adapters/handlers/debug + runtime
             # config + telemetry inspection)
+            Rule("/v1/debug/cluster", endpoint="debug_cluster",
+                 methods=["GET"]),
             Rule("/v1/debug/traces", endpoint="debug_traces",
                  methods=["GET", "DELETE"]),
             Rule("/v1/debug/config", endpoint="debug_config",
@@ -1219,6 +1225,59 @@ class RestAPI:
         if cls and not self.db.has_collection(cls):
             _abort(404, f"class {cls!r} not found")
         return _json_response(c.sharding_state(cls))
+
+    def on_cluster_rebalance(self, request):
+        """GET: the planner's current move list (dry run). POST: plan and
+        execute a rebalance round from this node as coordinator — every
+        move journaled in the raft ledger (docs/rebalance.md)."""
+        c = self._cluster_or_422()
+        if request.method == "GET":
+            self._authz(request, "read_cluster")
+            moves = c.rebalancer.plan(
+                max_moves=int(request.args.get("maxMoves", 16)))
+            return _json_response({"moves": [m.__dict__ for m in moves]})
+        self._authz(request, "manage_cluster")
+        b = self._body(request) or {}
+        ids = c.rebalancer.rebalance(
+            max_moves=int(b.get("maxMoves", 16)),
+            wait=bool(b.get("wait", False)))
+        return _json_response({"moveIds": ids})
+
+    def on_cluster_drain(self, request, node):
+        """Drain one node: migrate every replica off it (writes never
+        rejected), then remove it from membership unless ?remove=false."""
+        self._authz(request, "manage_cluster")
+        c = self._cluster_or_422()
+        if node not in c.all_nodes:
+            _abort(404, f"{node!r} is not a cluster member")
+        remove = request.args.get("remove", "true") != "false"
+
+        import logging as _logging
+        import threading as _threading
+
+        def _run():
+            try:
+                c.rebalancer.drain(node, remove=remove)
+            except Exception:
+                # async surface: the failure story lives in the ledger /
+                # draining mark (drain is re-runnable), but say so
+                _logging.getLogger("weaviate_tpu.cluster.rebalance") \
+                    .exception("async drain of %s failed", node)
+
+        _threading.Thread(target=_run, daemon=True,
+                          name=f"drain-{node}").start()
+        return _json_response({"draining": node, "remove": remove},
+                              status=202)
+
+    def on_debug_cluster(self, request):
+        """Operator cluster view: membership + gossip liveness, per-node
+        advertised HBM capacity, draining set, and the rebalance ledger."""
+        self._authz(request, "read_cluster", "debug/cluster")
+        if self.cluster is None:
+            return _json_response({"node": "node-0", "nodes": {},
+                                   "draining": [], "rebalance_ledger": [],
+                                   "replication_ops": []})
+        return _json_response(self.cluster.cluster_view())
 
     def on_tasks_list(self, request):
         """Distributed task table (reference /tasks; cluster/tasks.py

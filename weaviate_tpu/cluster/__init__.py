@@ -9,6 +9,12 @@ from weaviate_tpu.cluster.fsm import SchemaFSM
 from weaviate_tpu.cluster.hashtree import HashTree
 from weaviate_tpu.cluster.node import ClusterNode, ReplicationError
 from weaviate_tpu.cluster.raft import NotLeader, RaftNode
+from weaviate_tpu.cluster.rebalance import (
+    CrashInjected,
+    Move,
+    Rebalancer,
+    plan_moves,
+)
 from weaviate_tpu.cluster.resilience import (
     BreakerBoard,
     CircuitBreaker,
@@ -33,4 +39,5 @@ __all__ = [
     "InProcTransport", "TcpTransport", "TransportError",
     "ChaosTransport", "LinkFaults", "RetryPolicy", "Deadline",
     "DeadlineExceeded", "CircuitBreaker", "BreakerBoard",
+    "Rebalancer", "Move", "plan_moves", "CrashInjected",
 ]

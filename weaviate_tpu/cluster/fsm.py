@@ -17,6 +17,22 @@ from weaviate_tpu.core.db import DB
 from weaviate_tpu.schema.config import CollectionConfig, DataType, Property
 
 
+# rebalance-ledger lifecycle (cluster/rebalance.py): the allowed NEXT
+# states per state. A same-state "transition" is always legal — it is how
+# a resuming coordinator takes an entry over without losing its phase.
+LEDGER_STATES = ("planned", "copying", "warming", "flipped", "dropped",
+                 "aborted")
+LEDGER_TERMINAL = ("dropped", "aborted")
+_LEDGER_NEXT = {
+    "planned": {"copying", "aborted"},
+    "copying": {"warming", "aborted"},
+    "warming": {"flipped", "aborted"},
+    "flipped": {"dropped"},  # past the flip, a move can only roll forward
+    "dropped": set(),
+    "aborted": set(),
+}
+
+
 class SchemaFSM:
     def __init__(self, db: DB):
         from weaviate_tpu.cluster.tasks import TaskFSM
@@ -27,6 +43,14 @@ class SchemaFSM:
         self.shard_overrides: dict[str, list[str]] = {}
         # "cls/shard" -> joiners still converging (write-only replicas)
         self.shard_warming: dict[str, list[str]] = {}
+        # raft-replicated migration journal (cluster/rebalance.py): every
+        # shard move advances through here, so a coordinator crash leaves
+        # a durable record any surviving node can resume or abort from
+        self.rebalance_ledger: dict[str, dict] = {}
+        # nodes draining out of membership: excluded from ring placement
+        # of un-overridden shards and from rebalance targets; writes to
+        # shards they still hold keep flowing until the moves flip
+        self.draining_nodes: list[str] = []
         # distributed-task table (reference cluster/distributedtask FSM)
         self.tasks = TaskFSM()
 
@@ -101,9 +125,81 @@ class SchemaFSM:
                 else:
                     self.shard_warming.pop(key, None)
                 return {"ok": True}
+            if op == "rebalance_plan":
+                return self._apply_rebalance_plan(cmd)
+            if op == "rebalance_advance":
+                return self._apply_rebalance_advance(cmd)
+            if op == "rebalance_forget":
+                # `before` (submitter-stamped unix ts, so every applier
+                # decides identically) bounds ledger growth: terminal
+                # entries older than it are compacted away
+                before = float(cmd.get("before", 0.0))
+                drop = [
+                    mid for mid, e in self.rebalance_ledger.items()
+                    if e["state"] in LEDGER_TERMINAL
+                    and (not cmd.get("ids") or mid in cmd["ids"])
+                    and (not before
+                         or e.get("updated_ts",
+                                  e.get("created_ts", 0.0)) < before)
+                ]
+                for mid in drop:
+                    del self.rebalance_ledger[mid]
+                return {"ok": True, "removed": len(drop)}
+            if op == "set_node_draining":
+                if cmd["node"] not in self.draining_nodes:
+                    self.draining_nodes.append(cmd["node"])
+                    self.draining_nodes.sort()
+                return {"ok": True}
+            if op == "clear_node_draining":
+                if cmd["node"] in self.draining_nodes:
+                    self.draining_nodes.remove(cmd["node"])
+                return {"ok": True}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except (KeyError, ValueError, RuntimeError) as e:
             return {"ok": False, "error": str(e)}
+
+    # -- rebalance ledger --------------------------------------------------
+    def _apply_rebalance_plan(self, cmd: dict) -> dict:
+        e = dict(cmd["entry"])
+        for f in ("id", "class", "shard", "src", "dst", "prev_nodes"):
+            if f not in e:
+                return {"ok": False, "error": f"ledger entry missing {f!r}"}
+        if e["id"] in self.rebalance_ledger:
+            return {"ok": False, "error": f"move {e['id']!r} exists"}
+        # ONE in-flight move per shard: a second concurrent move would
+        # validate against the first's pre-move replica set and its final
+        # routing commit would erase the first's replica
+        for o in self.rebalance_ledger.values():
+            if (o["class"] == e["class"] and o["shard"] == e["shard"]
+                    and o["state"] not in LEDGER_TERMINAL):
+                return {"ok": False,
+                        "error": f"shard {e['shard']} already has move "
+                                 f"{o['id']} in state {o['state']}"}
+        e["state"] = "planned"
+        e.setdefault("error", "")
+        self.rebalance_ledger[e["id"]] = e
+        return {"ok": True, "id": e["id"]}
+
+    def _apply_rebalance_advance(self, cmd: dict) -> dict:
+        e = self.rebalance_ledger.get(cmd.get("id", ""))
+        if e is None:
+            return {"ok": False, "error": "unknown move id"}
+        state = cmd["state"]
+        if state not in LEDGER_STATES:
+            return {"ok": False, "error": f"unknown state {state!r}"}
+        # same-state re-commit is the coordinator-takeover path (a
+        # resuming node stamps itself without changing the phase)
+        if state != e["state"] and state not in _LEDGER_NEXT[e["state"]]:
+            return {"ok": False,
+                    "error": f"illegal transition {e['state']} -> {state}"}
+        e["state"] = state
+        if "coordinator" in cmd:
+            e["coordinator"] = cmd["coordinator"]
+        if "error" in cmd:
+            e["error"] = str(cmd["error"])[:500]
+        if "ts" in cmd:
+            e["updated_ts"] = cmd["ts"]
+        return {"ok": True}
 
     # -- snapshot / restore ------------------------------------------------
     def snapshot(self) -> bytes:
@@ -119,6 +215,8 @@ class SchemaFSM:
             },
             "shard_overrides": self.shard_overrides,
             "shard_warming": self.shard_warming,
+            "rebalance_ledger": self.rebalance_ledger,
+            "draining_nodes": self.draining_nodes,
             "tasks": self.tasks.state(),
             "aliases": self.db.aliases(),
         }
@@ -147,4 +245,6 @@ class SchemaFSM:
             self.db.set_alias(a, t)
         self.shard_overrides = dict(state.get("shard_overrides", {}))
         self.shard_warming = dict(state.get("shard_warming", {}))
+        self.rebalance_ledger = dict(state.get("rebalance_ledger", {}))
+        self.draining_nodes = list(state.get("draining_nodes", []))
         self.tasks.load(state.get("tasks", {}))

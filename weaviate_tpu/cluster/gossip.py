@@ -18,7 +18,7 @@ import logging
 import random
 import threading
 import time
-from typing import Callable, Iterable
+from typing import Callable, Iterable, Optional
 
 logger = logging.getLogger("weaviate_tpu.gossip")
 
@@ -31,13 +31,24 @@ class Gossip:
     def __init__(self, node_id: str, peers_fn: Callable[[], Iterable[str]],
                  send_fn: Callable[[str, dict], dict],
                  interval: float = 0.15, suspect_after: float = 0.8,
-                 dead_after: float = 2.5):
+                 dead_after: float = 2.5,
+                 meta_fn: Optional[Callable[[], dict]] = None,
+                 on_meta: Optional[Callable[[str, dict], None]] = None):
         self.id = node_id
         self.peers_fn = peers_fn
         self.send_fn = send_fn
         self.interval = interval
         self.suspect_after = suspect_after
         self.dead_after = dead_after
+        # per-node metadata advertisement (reference memberlist node meta):
+        # meta_fn() supplies THIS node's payload — capacity today (HBM
+        # budget/usage for the rebalance planner), anything small tomorrow
+        # — and it rides every ping/ack, merging by freshest wall-clock
+        # stamp. on_meta(node, meta) fires whenever a node's view advances
+        # (the ClusterNode wires the HBM gauges there).
+        self.meta_fn = meta_fn
+        self.on_meta = on_meta
+        self._meta: dict[str, dict] = {}  # node -> {..., "ts": unix}
         self._heard: dict[str, float] = {}  # node -> monotonic last-heard
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -61,9 +72,11 @@ class Gossip:
             try:
                 r = self.send_fn(peer, {"type": "gossip_ping",
                                         "from": self.id,
-                                        "view": self.view()})
+                                        "view": self.view(),
+                                        "meta": self.meta_out()})
                 if isinstance(r, dict) and "view" in r:
                     self.merge(r["view"])
+                    self.merge_meta(r.get("meta", {}))
                 self._mark_heard(peer)
             except Exception:
                 # unreachable peer ages out naturally, but leave a trace
@@ -95,8 +108,52 @@ class Gossip:
 
     def on_ping(self, msg: dict) -> dict:
         self.merge(msg.get("view", {}))
+        self.merge_meta(msg.get("meta", {}))
         self._mark_heard(msg["from"])
-        return {"view": self.view()}
+        return {"view": self.view(), "meta": self.meta_out()}
+
+    # -- metadata exchange -------------------------------------------------
+    def meta_out(self) -> dict[str, dict]:
+        """The merged cluster meta view, self refreshed from ``meta_fn``
+        and freshly stamped — the epidemic payload of every exchange."""
+        out = self.node_meta()
+        if self.meta_fn is not None:
+            try:
+                mine = dict(self.meta_fn() or {})
+            except Exception:
+                logger.warning("gossip meta_fn failed", exc_info=True)
+                mine = {}
+            mine["ts"] = time.time()
+            with self._lock:
+                self._meta[self.id] = mine
+            out[self.id] = mine
+            if self.on_meta is not None:
+                self.on_meta(self.id, mine)
+        return out
+
+    def merge_meta(self, meta: dict[str, dict]) -> None:
+        """Freshest wall-clock stamp wins per node (self is never
+        overwritten by hearsay — meta_fn is the authority for it)."""
+        if not isinstance(meta, dict):
+            return
+        advanced = []
+        with self._lock:
+            for node, m in meta.items():
+                if node == self.id or not isinstance(m, dict):
+                    continue
+                if float(m.get("ts", 0.0)) > float(
+                        self._meta.get(node, {}).get("ts", -1.0)):
+                    self._meta[node] = dict(m)
+                    advanced.append((node, dict(m)))
+        if self.on_meta is not None:
+            for node, m in advanced:
+                self.on_meta(node, m)
+
+    def node_meta(self) -> dict[str, dict]:
+        """node -> last advertised metadata (capacity view the rebalance
+        planner reads)."""
+        with self._lock:
+            return {n: dict(m) for n, m in self._meta.items()}
 
     # -- queries -----------------------------------------------------------
     def status(self, node: str) -> str:

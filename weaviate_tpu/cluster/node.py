@@ -47,6 +47,9 @@ from weaviate_tpu.cluster.sharding import (
 from weaviate_tpu.cluster.transport import TransportError
 from weaviate_tpu.core.db import DB
 from weaviate_tpu.monitoring.metrics import (
+    NODE_HBM_BUDGET,
+    NODE_HBM_USED,
+    ORPHAN_SHARDS_DROPPED,
     REPLICA_REPAIRS,
     RPC_DURATION,
     RPC_FAILURES,
@@ -144,6 +147,15 @@ class ClusterNode:
         # shards frozen for the final replica-movement cutover: writes error
         # (clients retry against post-flip routing)
         self._frozen: set[tuple[str, int, str]] = set()
+        # orphan-GC two-pass confirmation: (cls, shard) -> (monotonic
+        # first-seen outside routing, object count at that sighting).
+        # Only copies unrouted for a full grace window AND unchanged
+        # since are dropped — a copy mid-hydration by a coordinator this
+        # node cannot see keeps growing, which re-stamps the window, so
+        # arbitrarily long hydrations survive the sweep
+        self._orphan_suspects: dict[tuple[str, int],
+                                    tuple[float, int]] = {}
+        self.orphan_grace_s = 5.0
         self.raft = RaftNode(
             node_id, self.all_nodes, _RaftTransportView(self),
             apply_fn=self.fsm.apply,
@@ -154,14 +166,21 @@ class ClusterNode:
         # placement follows the raft-committed membership
         self.all_nodes = list(self.raft.config_nodes)
         self.raft.on_config_change = self._on_membership_change
-        # gossip liveness (reference memberlist delegate role)
+        # gossip liveness (reference memberlist delegate role) + per-node
+        # capacity advertisement: every exchange carries this node's HBM
+        # budget/usage so the rebalance planner sees real headroom.
+        # capacity_fn is the override hook (workers, tests); the default
+        # reads the tiering accountant when one exists.
         from weaviate_tpu.cluster.gossip import Gossip
 
+        self.capacity_fn: Optional[Callable[[], dict]] = None
         self.gossip = Gossip(
             node_id,
             peers_fn=lambda: self.all_nodes,
             send_fn=lambda peer, msg: self.transport.send(
                 peer, msg, timeout=0.3),
+            meta_fn=self._capacity_meta,
+            on_meta=self._on_capacity_meta,
         )
         # distributed tasks: replicated table in the FSM + a per-node
         # executor claiming this node's slice (cluster/distributedtask)
@@ -229,6 +248,54 @@ class ClusterNode:
 
     def _on_gossip_ping(self, msg: dict) -> dict:
         return self.gossip.on_ping(msg)
+
+    # -- capacity advertisement (gossip node meta) -------------------------
+    def _capacity_meta(self) -> dict:
+        """This node's capacity payload for gossip: HBM budget/usage from
+        the tiering accountant (or the injected ``capacity_fn``)."""
+        if self.capacity_fn is not None:
+            return dict(self.capacity_fn() or {})
+        tiering = getattr(self.db, "tiering", None)
+        if tiering is not None:
+            acc = tiering.accountant
+            return {"hbm_budget": acc.budget_bytes, "hbm_used": acc.total()}
+        return {"hbm_budget": 0, "hbm_used": 0}
+
+    def _on_capacity_meta(self, node: str, meta: dict) -> None:
+        NODE_HBM_BUDGET.set(float(meta.get("hbm_budget", 0) or 0),
+                            node=node)
+        NODE_HBM_USED.set(float(meta.get("hbm_used", 0) or 0), node=node)
+
+    def cluster_view(self) -> dict:
+        """The operator's one-call cluster snapshot (served at
+        /v1/debug/cluster): membership + liveness, per-node advertised
+        capacity, who is draining, and the full rebalance ledger."""
+        meta = self.gossip.node_meta()
+        # this node's advert, fresh — a singleton (or a node that has
+        # not completed a gossip round yet) must still report itself
+        meta[self.id] = self._capacity_meta()
+        statuses = self.members()
+        draining = list(self.fsm.draining_nodes)
+        return {
+            "node": self.id,
+            "leader": self.raft.leader(),
+            "nodes": {
+                nid: {
+                    "status": statuses.get(nid, "UNKNOWN"),
+                    "draining": nid in draining,
+                    "meta": meta.get(nid, {}),
+                }
+                for nid in sorted(set(self.all_nodes) | set(statuses))
+            },
+            "draining": draining,
+            # copy the entries: the raft apply thread mutates the live
+            # dicts (advance stamps, new plans) while this serializes
+            "rebalance_ledger": sorted(
+                (dict(e) for e in
+                 list(self.fsm.rebalance_ledger.values())),
+                key=lambda e: e.get("created_ts", 0.0)),
+            "replication_ops": self.replication_ops(),
+        }
 
     # -- membership API ----------------------------------------------------
     def add_node(self, node_id: str) -> None:
@@ -328,6 +395,7 @@ class ClusterNode:
             factor=max(1, cfg.replication.factor),
             overrides=overrides,
             warming=warming,
+            draining=frozenset(self.fsm.draining_nodes),
         )
 
     @property
@@ -345,9 +413,23 @@ class ClusterNode:
                 state_fn=self._state_for,
                 live_fn=lambda: set(self.gossip.live_nodes()),
                 rank_fn=self.breakers.rank,
+                draining_fn=lambda: set(self.fsm.draining_nodes),
             )
             self._router = r
         return r
+
+    @property
+    def rebalancer(self):
+        """Shard-rebalance coordinator (cluster/rebalance.py): planner +
+        ledger-journaled executor + join/drain lifecycle. Lazy like the
+        router — most nodes never coordinate a move."""
+        rb = getattr(self, "_rebalancer", None)
+        if rb is None:
+            from weaviate_tpu.cluster.rebalance import Rebalancer
+
+            rb = Rebalancer(self)
+            self._rebalancer = rb
+        return rb
 
     def _ordered(self, replicas: list[str]) -> list[str]:
         """Live replicas first so reads don't burn timeouts on dead peers;
@@ -746,17 +828,26 @@ class ClusterNode:
                 return {"ok": False, "error": "transaction aborted"}
             return {"ok": False, "error": "unknown txid"}
         try:
-            # a LATE commit (quorum short-circuited, this ack a straggler)
-            # may arrive after a replica move routed this shard away;
-            # applying would resurrect the dropped copy outside routing
+            # a commit may land AFTER a replica move routed this shard
+            # away (the prepare raced the routing flip). If the local
+            # copy is already gone, applying would resurrect a zombie
+            # outside routing — refuse. But while the copy still exists
+            # (mid-move, pre-drop), refusing would REJECT a write because
+            # of a migration: apply it and reconcile it straight into
+            # current routing instead (the copy is on borrowed time —
+            # the post-flip sweep may already have run past it).
             if self.id not in self._state_for(
                     st["class"]).replicas(st["shard"]):
-                STAGING_ABORTED.inc(reason="not_replica")
-                self._record_tx(txid, "abort")
-                logger.warning("discarding commit for tx %s: no longer a "
-                               "replica of %s/shard%s", txid,
-                               st["class"], st["shard"])
-                return {"ok": False, "error": "no longer a replica"}
+                if not self._apply_stale_routing_commit(st):
+                    STAGING_ABORTED.inc(reason="not_replica")
+                    self._record_tx(txid, "abort")
+                    logger.warning(
+                        "discarding commit for tx %s: no longer a "
+                        "replica of %s/shard%s and the local copy is "
+                        "gone", txid, st["class"], st["shard"])
+                    return {"ok": False, "error": "no longer a replica"}
+                self._record_tx(txid, "commit")
+                return {"ok": True, "stale_routing": True}
             shard = self._local_shard(st["class"], st["shard"], st["tenant"])
             shard.put_batch(st["objects"])
             key = (st["class"], st["shard"])
@@ -771,6 +862,50 @@ class ClusterNode:
                 ev = self._tx_inflight.pop(txid, None)
             if ev is not None:
                 ev.set()
+
+    def _apply_stale_routing_commit(self, st: dict) -> bool:
+        """Commit a 2PC transaction whose prepare raced a routing flip:
+        the shard no longer routes here, but the local copy still exists.
+        Applies locally AND pushes the objects to a routed replica, so
+        the write survives even if the post-flip sweep already ran and
+        the local copy is about to be dropped. Returns False when the
+        copy is gone (the caller refuses — the original zombie guard)."""
+        import os as _os
+
+        cls, tenant = st["class"], st["tenant"]
+        name = f"tenant-{tenant}" if tenant else f"shard{st['shard']}"
+        col = self.db.get_collection(cls)
+        with col._lock:
+            present = name in col._shards and name not in col._dropping
+        if not present and not _os.path.isdir(
+                _os.path.join(col.dir, name)):
+            return False
+        try:
+            shard = self._local_shard(cls, st["shard"], tenant)
+            shard.put_batch(st["objects"])
+        except RuntimeError:  # ShardClosed: the drop won the race
+            return False
+        payload = {"type": "object_push", "class": cls, "tenant": tenant,
+                   "shard": st["shard"],
+                   "objects": [o.to_bytes() for o in st["objects"]]}
+        for rep in self._ordered(self._state_for(cls)
+                                 .replicas(st["shard"])):
+            if rep == self.id:
+                continue
+            try:
+                r = self._send(rep, payload, timeout=5.0)
+            except TransportError:
+                continue
+            # an error reply (replica's schema lagging, shard mid-drop)
+            # is NOT delivery — acking on it could strand the write on
+            # a copy the sweep is about to drop
+            if "applied" in r:
+                return True
+        logger.warning(
+            "stale-routing commit for %s/shard%s applied locally but no "
+            "routed replica reachable; the sweep/orphan GC must carry it",
+            cls, st["shard"])
+        return True
 
     def _on_replica_abort(self, msg: dict) -> dict:
         with self._staging_lock:
@@ -1091,11 +1226,30 @@ class ClusterNode:
         return {"hits": hits}
 
     # -- anti-entropy (hashBeat) -------------------------------------------
+    _STABLE_SCAN_TRIES = 3
+
     def _shard_items(self, cls: str, shard: int, tenant: str = ""):
-        s = self._local_shard(cls, shard, tenant)
-        for key, raw in s.objects.items():
-            o = StorageObject.from_bytes(raw)
-            yield o.uuid, o.update_time_ms
+        """(uuid, version) for every live object — materialized as a
+        STABLE view: the store's merged iterator is read while writes
+        keep flowing, and a concurrent put that flips the memtable can
+        abort the lazy scan mid-stream; retrying on a fresh iterator
+        yields a consistent snapshot instead of failing the beat."""
+        last: Optional[RuntimeError] = None
+        for _ in range(self._STABLE_SCAN_TRIES):
+            # re-resolve the shard each attempt: a retry against the
+            # SAME handle cannot recover from the reachable failure
+            # (the store closed under the scan by a drop / tiering
+            # demotion) — only a reopened shard can
+            s = self._local_shard(cls, shard, tenant)
+            try:
+                return [
+                    (o.uuid, o.update_time_ms)
+                    for o in (StorageObject.from_bytes(raw)
+                              for _key, raw in s.objects.items())
+                ]
+            except RuntimeError as e:  # store closed/mutated mid-scan
+                last = e
+        raise last
 
     def _on_hashtree_leaves(self, msg: dict) -> dict:
         tree = HashTree.build(
@@ -1239,7 +1393,9 @@ class ClusterNode:
                 "type": "shard_export", "class": cls, "tenant": tenant,
                 "shard": shard, "after": after, "limit": page,
             }, timeout=10.0)
-            blobs = r.get("objects", [])
+            # an error reply must not read as end-of-pages: the copy leg
+            # would report success having hydrated nothing
+            blobs = self._expect(r, "objects", src)
             if blobs:
                 rr = self._send(dst, {
                     "type": "object_push", "class": cls, "tenant": tenant,
@@ -1250,37 +1406,54 @@ class ClusterNode:
             if after is None:
                 return moved
 
+    @staticmethod
+    def _expect(r: dict, key: str, peer: str):
+        """Unwrap one field of a peer reply; an error reply (e.g. the
+        peer's raft catch-up hasn't applied this schema yet) surfaces as
+        a retryable ReplicationError, never a raw KeyError."""
+        if key not in r:
+            raise ReplicationError(
+                f"{peer}: {r.get('error', f'reply missing {key!r}')}")
+        return r[key]
+
     def _converge_replicas(self, cls: str, shard: int, src: str, dst: str,
                            tenant: str = "") -> int:
         """Coordinator-mediated hashtree anti-entropy src -> dst for ONE
         shard: diff leaf hashes, fetch newer objects from src, push to dst.
         Returns objects transferred (0 == converged)."""
         base = {"class": cls, "tenant": tenant, "shard": shard}
-        a = self._send(src, {"type": "hashtree_leaves", **base},
-                       timeout=10.0)["leaves"]
-        b = self._send(dst, {"type": "hashtree_leaves", **base},
-                       timeout=10.0)["leaves"]
+        a = self._expect(self._send(src, {"type": "hashtree_leaves",
+                                          **base}, timeout=10.0),
+                         "leaves", src)
+        b = self._expect(self._send(dst, {"type": "hashtree_leaves",
+                                          **base}, timeout=10.0),
+                         "leaves", dst)
         diff = [i for i, (x, y) in enumerate(zip(a, b)) if x != y]
         if not diff:
             return 0
-        sa = self._send(src, {"type": "hashtree_items", **base,
-                              "buckets": diff, "n_leaves": len(a)},
-                        timeout=10.0)["items"]
-        sb = self._send(dst, {"type": "hashtree_items", **base,
-                              "buckets": diff, "n_leaves": len(a)},
-                        timeout=10.0)["items"]
+        sa = self._expect(self._send(src, {"type": "hashtree_items",
+                                           **base, "buckets": diff,
+                                           "n_leaves": len(a)},
+                                     timeout=10.0), "items", src)
+        sb = self._expect(self._send(dst, {"type": "hashtree_items",
+                                           **base, "buckets": diff,
+                                           "n_leaves": len(a)},
+                                     timeout=10.0), "items", dst)
         theirs = dict(sb)
         pull = [u for u, v in sa if theirs.get(u, 0) < v]
         if not pull:
             return 0
-        blobs = [bb for bb in self._send(
-            src, {"type": "object_fetch", **base, "uuids": pull},
-            timeout=10.0)["objects"] if bb is not None]
+        blobs = [bb for bb in self._expect(
+            self._send(src, {"type": "object_fetch", **base,
+                             "uuids": pull}, timeout=10.0),
+            "objects", src) if bb is not None]
         if not blobs:
             return 0
         rr = self._send(dst, {"type": "object_push", **base,
                               "objects": blobs}, timeout=10.0)
-        return rr.get("applied", 0)
+        # an ERROR reply must never read as a zero-transfer round: the
+        # callers treat 0 as VERIFIED convergence and flip/drop on it
+        return self._expect(rr, "applied", dst)
 
     # -- replication ops API (reference /v1/replication/replicate) ---------
     def start_replication_op(self, cls: str, shard: int, src: str,
@@ -1299,11 +1472,8 @@ class ClusterNode:
         if kind not in ("COPY", "MOVE"):
             raise ValueError(f"invalid replication type {kind!r}")
         # validate now so the caller gets a 4xx, not an async failure
-        reps = self._state_for(cls).replicas(shard)
-        if src not in reps:
-            raise ValueError(f"{src!r} does not hold shard {shard}")
-        if dst in reps:
-            raise ValueError(f"{dst!r} already holds shard {shard}")
+        # (also rejects shards mid-rebalance via the raft ledger)
+        self._validate_replica_op(cls, shard, src, dst)
         op_id = str(_uuid.uuid4())
         op = {"id": op_id, "collection": cls, "shardId": str(shard),
               "sourceNodeId": src, "targetNodeId": dst,
@@ -1434,6 +1604,15 @@ class ClusterNode:
             raise ValueError(f"{src!r} does not hold shard {shard}")
         if dst in reps:
             raise ValueError(f"{dst!r} already holds shard {shard}")
+        # the raft rebalance ledger owns in-flight shards cluster-wide:
+        # a manual move racing a ledger move would erase whichever
+        # routing flip lands first
+        for e in list(self.fsm.rebalance_ledger.values()):
+            if (e["class"] == cls and int(e["shard"]) == shard
+                    and e["state"] not in ("dropped", "aborted")):
+                raise ValueError(
+                    f"shard {shard} has rebalance move {e['id']} in "
+                    f"state {e['state']}")
         return reps
 
     def _hydrate_join(self, cls: str, shard: int, src: str, dst: str,
@@ -1554,22 +1733,36 @@ class ClusterNode:
         return moved
 
     def _on_shard_export(self, msg: dict) -> dict:
-        """Page of object blobs ordered by doc id (cursor = last doc id)."""
-        shard = self._local_shard(msg["class"], msg["shard"],
-                                  msg.get("tenant", ""))
+        """Page of object blobs ordered by doc id (cursor = last doc id).
+
+        The source stays WRITABLE during a move, so the page must be
+        materialized from a cursor-seeked iterator and retried on a
+        fresh one if a concurrent put flips the memtable mid-scan — a
+        hydration page must never fail because the shard kept serving.
+        The cursor seek also makes paging O(page), not O(scanned)."""
         after = msg.get("after", -1)
         limit = msg.get("limit", 512)
-        out = []
-        last = None
-        for key, raw in shard.objects.items():
-            docid = int.from_bytes(key, "big", signed=True)
-            if docid <= after:
-                continue
-            out.append(raw)
-            last = docid
-            if len(out) >= limit:
-                break
-        return {"objects": out, "next": last if len(out) >= limit else None}
+        start = (None if after is None or after < 0
+                 else (after + 1).to_bytes(8, "big", signed=True))
+        last_err: Optional[RuntimeError] = None
+        for _ in range(self._STABLE_SCAN_TRIES):
+            # re-resolve per attempt (see _shard_items): only a fresh
+            # handle can recover from a close-under-scan
+            shard = self._local_shard(msg["class"], msg["shard"],
+                                      msg.get("tenant", ""))
+            out: list[bytes] = []
+            last = None
+            try:
+                for key, raw in shard.objects.items(start=start):
+                    out.append(raw)
+                    last = int.from_bytes(key, "big", signed=True)
+                    if len(out) >= limit:
+                        break
+                return {"objects": out,
+                        "next": last if len(out) >= limit else None}
+            except RuntimeError as e:  # store mutated under the scan
+                last_err = e
+        raise last_err
 
     def _on_shard_freeze(self, msg: dict) -> dict:
         self._frozen.add((msg["class"], msg["shard"], msg.get("tenant", "")))
@@ -1588,6 +1781,119 @@ class ClusterNode:
         self._frozen.discard(
             (msg["class"], msg["shard"], msg.get("tenant", "")))
         return {"ok": True}
+
+    # -- orphan-copy GC ----------------------------------------------------
+    def _shard_move_active(self, cls: str, shard: int) -> bool:
+        """Is some migration machinery currently entitled to a local copy
+        of this shard outside routing? (A move's dst holds data before the
+        warming join; an aborted move's dst holds it until the abort's
+        cleanup. Both must be invisible to the GC.)"""
+        for e in list(self.fsm.rebalance_ledger.values()):
+            if (e["class"] == cls and int(e["shard"]) == shard
+                    and e["state"] not in ("dropped", "aborted")):
+                return True
+        with self._rep_ops_lock:
+            return any(
+                o["collection"] == cls and o["shardId"] == str(shard)
+                and o["status"] in ("REGISTERED", "HYDRATING")
+                for o in self._rep_ops.values())
+
+    def gc_orphan_shards_once(self) -> int:
+        """Drop local shard copies absent from routing (the leftovers of a
+        post-move ``shard_drop`` that failed, or of an aborted move whose
+        donor was unreachable). Every candidate is VERIFIED first: an
+        anti-entropy push of anything this copy uniquely holds into a
+        routed replica must reach a zero-transfer round — data is never
+        deleted that routing could not serve. Returns copies dropped."""
+        import os as _os
+        import re as _re
+
+        dropped = 0
+        for cls in self.db.collections():
+            try:
+                col = self.db.get_collection(cls)
+            except KeyError:
+                continue  # deleted under the sweep
+            if col.config.multi_tenancy.enabled:
+                continue  # tenant shards are tiered, not ring-placed
+            st = self._state_for(cls)
+            names = set(col._shards)
+            try:
+                names |= {d for d in _os.listdir(col.dir)
+                          if _os.path.isdir(_os.path.join(col.dir, d))}
+            except OSError:
+                pass
+            for name in sorted(names):
+                m = _re.fullmatch(r"shard(\d+)", name)
+                if m is None:
+                    continue
+                shard = int(m.group(1))
+                if shard >= st.n_shards:
+                    continue  # not this ring's shard space: leave alone
+                routed = st.replicas(shard)
+                if self.id in routed or not routed:
+                    self._orphan_suspects.pop((cls, shard), None)
+                    continue
+                if self._shard_move_active(cls, shard):
+                    self._orphan_suspects.pop((cls, shard), None)
+                    continue
+                try:
+                    count = self._local_shard(cls, shard).count()
+                except (KeyError, RuntimeError):
+                    continue  # mid-drop / unopenable: not ours to judge
+                key = (cls, shard)
+                prior = self._orphan_suspects.get(key)
+                if prior is None or prior[1] != count:
+                    # first sighting, or the copy CHANGED since — a
+                    # hydration in progress restarts the window
+                    self._orphan_suspects[key] = (time.monotonic(),
+                                                  count)
+                    continue
+                if time.monotonic() - prior[0] < self.orphan_grace_s:
+                    continue  # two-pass confirmation window
+                if not self._orphan_verified(cls, shard, routed):
+                    continue  # routing unreachable: keep the copy
+                # re-check between verify and drop: a stale-routing 2PC
+                # commit can land on this copy AFTER the verify's zero
+                # round — dropping then would delete an acked write that
+                # never reached routing (the drop gate itself refuses
+                # commits mid-drop, so this closes the window)
+                try:
+                    if self._local_shard(cls, shard).count() != count:
+                        self._orphan_suspects.pop((cls, shard), None)
+                        continue
+                except (KeyError, RuntimeError):
+                    continue
+                try:
+                    self._on_shard_drop({"class": cls, "shard": shard,
+                                         "tenant": ""})
+                except (KeyError, RuntimeError):
+                    logger.warning("orphan GC: drop of %s/shard%s failed",
+                                   cls, shard, exc_info=True)
+                    continue
+                self._orphan_suspects.pop((cls, shard), None)
+                ORPHAN_SHARDS_DROPPED.inc(collection=cls)
+                logger.info("orphan GC: dropped %s/shard%s (not in "
+                            "routing, verified against %s)", cls, shard,
+                            routed)
+                dropped += 1
+        return dropped
+
+    def _orphan_verified(self, cls: str, shard: int,
+                         routed: list[str]) -> bool:
+        """Push everything this local copy uniquely holds into one routed
+        replica and require a verified-zero round — only then is the copy
+        redundant."""
+        for rep in self._ordered(routed):
+            try:
+                for _ in range(4):
+                    if self._converge_replicas(cls, shard, self.id,
+                                               rep) == 0:
+                        return True
+            except (TransportError, ReplicationError, KeyError,
+                    DeadlineExceeded):
+                continue  # try the next routed replica
+        return False
 
     # -- lifecycle ---------------------------------------------------------
     def quiesce(self):

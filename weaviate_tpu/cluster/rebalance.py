@@ -1,0 +1,789 @@
+"""Elastic scale-out: planned, ledger-journaled shard rebalancing.
+
+Reference composition: the reference scales horizontally by moving shard
+replicas between nodes while both keep serving (``cluster/replication/``
+engine + ``copier/``), with every operation recorded in a raft FSM so a
+dead coordinator never strands an op. This module is that orchestration
+layer for THIS framework, built on the primitives that already exist:
+
+- ``ClusterNode.move_shard``'s phase machinery (bulk page copy, warming
+  join, verified-zero anti-entropy, atomic flip, post-flip sweep, drop);
+- the tiering activity signal + per-node HBM budgets advertised via
+  gossip node meta (the planner's heat and capacity axes);
+- ``resilience.RetryPolicy``/``Deadline`` per migration leg;
+- the W3C tracer: every migration is ONE trace — a ``rebalance.move``
+  root with ``rebalance.{copy,anti_entropy,flip,drop}`` child spans.
+
+The load-bearing design point is the **ledger**: every move is a
+raft-replicated journal entry advancing ``planned -> copying -> warming
+-> flipped -> dropped`` (terminal: ``dropped``/``aborted``). Each raft
+command a phase issues is derived from ``prev_nodes`` journaled at plan
+time, never from current state — so re-running a phase after a crash is
+idempotent, and ANY surviving node can finish the job:
+
+- ``planned``/``copying``: nothing routed yet -> cheap, safe ABORT
+  (routing restored to ``prev_nodes``, the half-hydrated target copy
+  reconciled back and dropped, or left for the orphan GC to verify+reap);
+- ``warming``: the destination already receives every write -> RESUME
+  (converge to verified zero, atomic flip+warming-clear);
+- ``flipped``: past the point of no return -> ROLL FORWARD (final
+  sweep, drop the source copy).
+
+Node lifecycle rides on top. ``join``: pin current routing as explicit
+overrides (membership growth must not re-ring data away), add the node
+to raft, plan+execute moves onto its advertised capacity. ``drain``: pin
+routing, raft-mark the node draining (new ring placements and planner
+targets skip it; the Router demotes it for reads; writes NEVER shed),
+migrate everything off, then remove it from membership.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+import uuid as uuidlib
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from weaviate_tpu.cluster.node import ReplicationError
+from weaviate_tpu.cluster.resilience import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    retrying_call,
+)
+from weaviate_tpu.cluster.transport import TransportError
+from weaviate_tpu.monitoring.metrics import (
+    REBALANCE_ACTIVE,
+    REBALANCE_MOVE_SECONDS,
+    REBALANCE_MOVES,
+)
+from weaviate_tpu.monitoring.tracing import TRACER
+
+logger = logging.getLogger("weaviate_tpu.cluster.rebalance")
+
+TERMINAL = ("dropped", "aborted")
+
+
+class CrashInjected(RuntimeError):
+    """Raised by the chaos crash hook (``Rebalancer.crash_points``): the
+    worker dies WITHOUT running its abort path — exactly what a
+    SIGKILLed coordinator looks like to the rest of the cluster. Tests
+    use it to prove the ledger resume/abort paths, not just read them."""
+
+
+@dataclass(frozen=True)
+class Move:
+    collection: str
+    shard: int
+    src: str
+    dst: str
+    tenant: str = ""
+
+
+def _free_bytes(meta: dict, node: str) -> float:
+    m = meta.get(node, {})
+    budget = float(m.get("hbm_budget", 0) or 0)
+    if budget <= 0:
+        return float("inf")  # unbudgeted = unconstrained
+    return budget - float(m.get("hbm_used", 0) or 0)
+
+
+def plan_moves(snapshot: dict, max_moves: int = 16) -> list[Move]:
+    """Pure placement planner over a cluster snapshot.
+
+    ``snapshot``: ``nodes`` (live membership), ``draining`` (set),
+    ``meta`` (node -> gossip capacity advert), ``shards`` (list of
+    ``{class, shard, replicas, weight}`` where weight folds the tiering
+    activity signal — hot shards move first, so a joining node picks up
+    load immediately).
+
+    Two passes: (1) evacuate draining nodes, hottest shards first;
+    (2) balance weighted load, moving a shard from the most- to the
+    least-loaded node only while it improves the spread. Targets are
+    always live, non-draining nodes with advertised HBM headroom.
+    """
+    draining = set(snapshot.get("draining", ()))
+    meta = snapshot.get("meta", {})
+    nodes = list(snapshot.get("nodes", ()))
+    shards = sorted(snapshot.get("shards", ()),
+                    key=lambda s: (-float(s.get("weight", 1.0)),
+                                   s["class"], int(s["shard"])))
+    candidates = [n for n in nodes
+                  if n not in draining and _free_bytes(meta, n) > 0]
+    if not candidates:
+        return []
+
+    loads: dict[str, float] = {n: 0.0 for n in set(nodes) | draining}
+    placement: dict[tuple, list[str]] = {}
+    weight: dict[tuple, float] = {}
+    for sh in shards:
+        key = (sh["class"], int(sh["shard"]))
+        placement[key] = list(sh["replicas"])
+        weight[key] = float(sh.get("weight", 1.0))
+        for rep in sh["replicas"]:
+            loads[rep] = loads.get(rep, 0.0) + weight[key]
+
+    moves: list[Move] = []
+    moved: set[tuple] = set()  # one move per shard per round
+
+    def pick_dst(key: tuple) -> Optional[str]:
+        cands = [n for n in candidates if n not in placement[key]]
+        if not cands:
+            return None
+        return min(cands, key=lambda n: (
+            loads.get(n, 0.0), -min(_free_bytes(meta, n), 1e30), n))
+
+    def apply(key: tuple, src: str, dst: str) -> None:
+        moves.append(Move(key[0], key[1], src, dst,
+                          tenant=""))
+        moved.add(key)
+        placement[key] = [dst if x == src else x for x in placement[key]]
+        loads[src] -= weight[key]
+        loads[dst] = loads.get(dst, 0.0) + weight[key]
+
+    # pass 1: drain evacuations, hottest first
+    for sh in shards:
+        key = (sh["class"], int(sh["shard"]))
+        if key in moved:
+            continue
+        for rep in list(placement[key]):
+            if rep not in draining:
+                continue
+            dst = pick_dst(key)
+            if dst is None:
+                logger.warning("plan: no target for draining replica of "
+                               "%s/shard%s on %s", key[0], key[1], rep)
+                continue
+            apply(key, rep, dst)
+            if len(moves) >= max_moves:
+                return moves
+            break  # one replica of a shard per round
+
+    # pass 2: weighted balance toward the flattest spread
+    while len(moves) < max_moves:
+        best = None
+        donors = sorted((n for n in loads if n not in draining),
+                        key=lambda n: (-loads.get(n, 0.0), n))
+        for donor in donors:
+            for sh in shards:
+                key = (sh["class"], int(sh["shard"]))
+                if key in moved or donor not in placement[key]:
+                    continue
+                dst = pick_dst(key)
+                if dst is None or dst == donor:
+                    continue
+                # a move improves the spread only while the gap exceeds
+                # the shard's own weight (it shifts the gap by 2w)
+                if loads[donor] - loads.get(dst, 0.0) > weight[key] + 1e-9:
+                    best = (key, donor, dst)
+                    break
+            if best is not None:
+                break
+        if best is None:
+            break
+        apply(*best)
+    return moves
+
+
+class Rebalancer:
+    """Planner + ledger-journaled migration executor + node lifecycle.
+
+    One instance per ClusterNode (``node.rebalancer``), but every
+    decision it makes is raft-replicated — another node's instance can
+    pick up any move this one started (``resume_pending``).
+    """
+
+    # per-leg wall budgets (seconds): each leg runs under a Deadline with
+    # jittered-backoff retries on transport faults inside it
+    LEG_BUDGETS = {"copy": 60.0, "anti_entropy": 30.0, "flip": 10.0,
+                   "drop": 30.0}
+    CONVERGE_ROUNDS = 8
+
+    def __init__(self, node, max_concurrent: int = 2,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 page: int = 512,
+                 weight_fn: Optional[Callable[[str], float]] = None):
+        self.node = node
+        self.page = page
+        self.retry_policy = retry_policy or RetryPolicy(
+            attempts=3, base=0.05, cap=1.0)
+        self.weight_fn = weight_fn
+        self.leg_budgets = dict(self.LEG_BUDGETS)
+        self._rng = random.Random(f"rebalance:{node.id}")
+        self._sem = threading.BoundedSemaphore(max_concurrent)
+        self._active: set[str] = set()
+        self._active_lock = threading.Lock()
+        # chaos hook: leg names at which the worker dies WITHOUT cleanup
+        # (see CrashInjected) — the crash-resume story must be provable
+        self.crash_points: set[str] = set()
+
+    # -- planning ----------------------------------------------------------
+    def _collection_weight(self, cls: str) -> float:
+        """1 + the collection's mean tiering activity score: the heat
+        axis that makes a join pull HOT shards first."""
+        if self.weight_fn is not None:
+            return float(self.weight_fn(cls))
+        tiering = getattr(self.node.db, "tiering", None)
+        if tiering is None:
+            return 1.0
+        try:
+            tenants = tiering.stats().get("tenants", {})
+        except (KeyError, RuntimeError):
+            return 1.0
+        scores = [e.get("score", 0.0) for k, e in tenants.items()
+                  if k.startswith(f"{cls}/")]
+        return 1.0 + (sum(scores) / len(scores) if scores else 0.0)
+
+    def snapshot(self) -> dict:
+        """The planner's input, assembled from raft state + gossip."""
+        n = self.node
+        meta = n.gossip.node_meta()
+        meta.setdefault(n.id, dict(n._capacity_meta()))
+        shards = []
+        for cls in n.db.collections():
+            col = n.db.get_collection(cls)
+            if col.config.multi_tenancy.enabled:
+                continue  # tenant shards are tiered, not ring-placed
+            st = n._state_for(cls)
+            w = self._collection_weight(cls)
+            for s in range(st.n_shards):
+                shards.append({"class": cls, "shard": s,
+                               "replicas": st.replicas(s), "weight": w})
+        live = set(n.gossip.live_nodes())
+        return {
+            "nodes": sorted(nd for nd in n.all_nodes if nd in live),
+            "draining": set(n.fsm.draining_nodes),
+            "meta": meta,
+            "shards": shards,
+        }
+
+    def plan(self, max_moves: int = 16) -> list[Move]:
+        return plan_moves(self.snapshot(), max_moves=max_moves)
+
+    # -- execution ---------------------------------------------------------
+    def execute(self, moves: list[Move], wait: bool = True,
+                timeout: float = 120.0) -> list[str]:
+        """Journal every move into the raft ledger and run them with
+        bounded concurrency. Returns the ledger ids actually planned
+        (a shard already mid-move is skipped, not queued)."""
+        n = self.node
+        ids, threads = [], []
+        for mv in moves:
+            try:
+                st = n._state_for(mv.collection)
+            except KeyError:
+                continue
+            prev = st.replicas(mv.shard)
+            if mv.src not in prev or mv.dst in prev:
+                logger.warning("skipping stale move %s/shard%s %s->%s "
+                               "(replicas now %s)", mv.collection,
+                               mv.shard, mv.src, mv.dst, prev)
+                continue
+            if n.replication_ops(mv.collection, mv.shard) and any(
+                    o["status"] in ("REGISTERED", "HYDRATING")
+                    for o in n.replication_ops(mv.collection, mv.shard)):
+                # a manual /v1/replication op owns this shard: two
+                # movers computing final routing from different
+                # snapshots would erase each other's replica
+                logger.warning("skipping move %s/shard%s: manual "
+                               "replication op in flight", mv.collection,
+                               mv.shard)
+                continue
+            entry = {
+                "id": uuidlib.uuid4().hex,
+                "class": mv.collection, "shard": mv.shard,
+                "src": mv.src, "dst": mv.dst, "tenant": mv.tenant,
+                "prev_nodes": list(prev),
+                "final_nodes": [mv.dst if x == mv.src else x
+                                for x in prev],
+                "coordinator": n.id,
+                "created_ts": time.time(), "error": "",
+            }
+            r = n.raft.submit({"op": "rebalance_plan", "entry": entry})
+            if not r.get("ok"):
+                logger.warning("move %s/shard%s %s->%s not planned: %s",
+                               mv.collection, mv.shard, mv.src, mv.dst,
+                               r.get("error"))
+                continue
+            entry["state"] = "planned"
+            ids.append(entry["id"])
+            t = threading.Thread(target=self._worker, args=(entry,),
+                                 daemon=True,
+                                 name=f"rebalance-{entry['id'][:8]}")
+            threads.append(t)
+            t.start()
+        if wait:
+            deadline = time.monotonic() + timeout
+            for t in threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+        return ids
+
+    def rebalance(self, max_moves: int = 16, wait: bool = True) -> list[str]:
+        return self.execute(self.plan(max_moves=max_moves), wait=wait)
+
+    def _worker(self, entry: dict, outcome: str = "completed") -> None:
+        with self._active_lock:
+            self._active.add(entry["id"])
+        try:
+            with self._sem:
+                # gauge counts EXECUTING moves (inside the concurrency
+                # cap), not queued workers — that is what it documents
+                REBALANCE_ACTIVE.inc()
+                try:
+                    self._run_entry(entry, outcome=outcome)
+                finally:
+                    REBALANCE_ACTIVE.dec()
+        except CrashInjected:
+            # simulated coordinator death: no abort, no cleanup — the
+            # ledger entry stays where it was for resume_pending
+            logger.warning("rebalance worker crash injected at move %s",
+                           entry["id"])
+        except Exception as e:
+            logger.warning("move %s (%s/shard%s %s->%s) failed in state "
+                           "%s: %s — aborting via ledger", entry["id"],
+                           entry["class"], entry["shard"], entry["src"],
+                           entry["dst"], entry["state"], e)
+            try:
+                self._abort_entry(entry, error=str(e))
+            except Exception:
+                logger.exception("abort of move %s failed; entry left "
+                                 "for resume", entry["id"])
+        finally:
+            with self._active_lock:
+                self._active.discard(entry["id"])
+
+    # -- the phase machine -------------------------------------------------
+    def _maybe_crash(self, point: str) -> None:
+        if point in self.crash_points:
+            raise CrashInjected(point)
+
+    def _advance(self, e: dict, state: str, error: str = "") -> None:
+        cmd = {"op": "rebalance_advance", "id": e["id"], "state": state,
+               "coordinator": self.node.id, "ts": time.time()}
+        if error:
+            cmd["error"] = error
+        r = self.node.raft.submit(cmd)
+        if not r.get("ok"):
+            raise ReplicationError(
+                f"ledger advance to {state!r} failed: {r.get('error')}")
+        e["state"] = state
+
+    def _leg(self, name: str, e: dict, fn: Callable[[], object]):
+        """One migration leg: its own span, deadline, and jittered-backoff
+        retries on transport/replication faults (the leg functions are
+        idempotent by construction)."""
+        deadline = Deadline(self.leg_budgets.get(name, 30.0),
+                            op=f"rebalance.{name}")
+        with TRACER.span(f"rebalance.{name}", shard=e["shard"],
+                         collection=e["class"]):
+            return retrying_call(
+                lambda _t: fn(), peer=e["dst"], policy=self.retry_policy,
+                deadline=deadline,
+                timeout=self.leg_budgets.get(name, 30.0), rng=self._rng,
+                retry_on=(TransportError, ReplicationError),
+                msg_type=f"rebalance_{name}")
+
+    def _run_entry(self, e: dict, outcome: str = "completed") -> None:
+        """Drive one ledger entry from its journaled state to terminal.
+        Entered fresh after plan OR mid-state on resume — every phase
+        derives its raft commands from the journaled ``prev_nodes`` /
+        ``final_nodes``, so re-execution is idempotent."""
+        t0 = time.monotonic()
+        root = TRACER.span(
+            "rebalance.move", parent=None, move_id=e["id"],
+            collection=e["class"], shard=e["shard"], src=e["src"],
+            dst=e["dst"], start_state=e["state"], node=self.node.id)
+        with root:
+            if e["state"] == "planned":
+                self._advance(e, "copying")
+            if e["state"] == "copying":
+                self._maybe_crash("copy")
+                self._leg("copy", e, lambda: self._copy_and_join(e))
+                self._advance(e, "warming")
+            if e["state"] == "warming":
+                self._maybe_crash("anti_entropy")
+                self._leg("anti_entropy", e,
+                          lambda: self._converge_zero(e))
+                self._maybe_crash("flip")
+                self._leg("flip", e, lambda: self._flip(e))
+                self._advance(e, "flipped")
+            if e["state"] == "flipped":
+                self._maybe_crash("drop")
+                self._leg("drop", e, lambda: self._final_drop(e))
+                self._advance(e, "dropped")
+        REBALANCE_MOVES.inc(outcome=outcome)
+        REBALANCE_MOVE_SECONDS.observe(time.monotonic() - t0,
+                                       outcome=outcome)
+        logger.info("move %s (%s/shard%s %s->%s) %s in %.2fs", e["id"],
+                    e["class"], e["shard"], e["src"], e["dst"], outcome,
+                    time.monotonic() - t0)
+
+    def _dst_ready(self, e: dict, timeout: float = 15.0) -> None:
+        """Block until the target can actually serve this collection — a
+        freshly joined node may still be replaying the raft log that
+        creates the schema, and hydrating into the gap only burns the
+        leg budget on error replies."""
+        n = self.node
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                r = n._send(e["dst"], {
+                    "type": "object_digest", "class": e["class"],
+                    "tenant": e["tenant"], "shard": e["shard"],
+                    "uuids": []}, timeout=2.0)
+            except TransportError as ex:
+                r = {"error": str(ex)}
+            if "digests" in r:
+                return
+            if time.monotonic() >= deadline:
+                raise ReplicationError(
+                    f"target {e['dst']} not ready for {e['class']}: "
+                    f"{r.get('error')}")
+            time.sleep(0.05)
+
+    def _copy_and_join(self, e: dict) -> None:
+        """Bulk page hydration + one pre-join anti-entropy pass, then the
+        raft warming JOIN: dst becomes a write replica that reads skip."""
+        n = self.node
+        self._dst_ready(e)
+        n._copy_shard_pages(e["class"], e["shard"], e["src"], e["dst"],
+                            e["tenant"], self.page)
+        n._converge_replicas(e["class"], e["shard"], e["src"], e["dst"],
+                             e["tenant"])
+        res = n.raft.submit({"op": "set_shard_warming",
+                             "class": e["class"], "shard": e["shard"],
+                             "nodes": [e["dst"]]})
+        if res.get("ok"):
+            res = n.raft.submit({
+                "op": "set_shard_replicas", "class": e["class"],
+                "shard": e["shard"],
+                "nodes": list(e["prev_nodes"]) + [e["dst"]]})
+        if not res.get("ok"):
+            raise ReplicationError(
+                f"warming join failed: {res.get('error')}")
+
+    def _converge_zero(self, e: dict) -> None:
+        n = self.node
+        for _ in range(self.CONVERGE_ROUNDS):
+            if n._converge_replicas(e["class"], e["shard"], e["src"],
+                                    e["dst"], e["tenant"]) == 0:
+                return
+        raise ReplicationError(
+            f"shard {e['shard']} move {e['src']}->{e['dst']} did not "
+            f"reach a verified-zero round in {self.CONVERGE_ROUNDS} "
+            "passes")
+
+    def _flip(self, e: dict) -> None:
+        """Atomic routing flip: src out, warming cleared, ONE command."""
+        res = self.node.raft.submit({
+            "op": "set_shard_replicas", "class": e["class"],
+            "shard": e["shard"], "nodes": list(e["final_nodes"]),
+            "clear_warming": True})
+        if not res.get("ok"):
+            raise ReplicationError(
+                f"routing flip failed: {res.get('error')}")
+
+    def _final_drop(self, e: dict) -> None:
+        """Post-flip straggler sweep, then drop the source copy. A sweep
+        that cannot reach the source NEVER drops — the copy stays for the
+        orphan GC to verify and reap once the node is back."""
+        from weaviate_tpu.monitoring import tracing
+
+        n = self.node
+        swept = False
+        for _ in range(2):
+            try:
+                n._converge_replicas(e["class"], e["shard"], e["src"],
+                                     e["dst"], e["tenant"])
+                swept = True
+                break
+            except (TransportError, ReplicationError, DeadlineExceeded):
+                continue
+        if not swept:
+            tracing.add_event("drop.skipped", reason="sweep_unreachable")
+            logger.warning("move %s: post-flip sweep of %s unreachable; "
+                           "source copy kept for orphan GC", e["id"],
+                           e["src"])
+            return
+        try:
+            n._send(e["src"], {"type": "shard_drop", "class": e["class"],
+                               "tenant": e["tenant"],
+                               "shard": e["shard"]})
+        except TransportError:
+            tracing.add_event("drop.failed", peer=e["src"])
+            logger.warning("move %s: post-move shard_drop on %s failed "
+                           "(%s/shard%s); orphan copy remains for GC",
+                           e["id"], e["src"], e["class"], e["shard"])
+
+    # -- abort / resume ----------------------------------------------------
+    def _abort_entry(self, e: dict, error: str = "") -> None:
+        """Cleanly abort an in-flight move: routing restored to exactly
+        the journaled pre-move set, warming cleared, and anything only
+        the half-hydrated target holds reconciled back to the source
+        BEFORE its copy is dropped (a warming dst may have solo-acked a
+        write). A move past the flip cannot abort — it rolls forward."""
+        n = self.node
+        # re-read the replicated entry: a resumer that declared THIS
+        # coordinator dead may have advanced (or finished) the move —
+        # rolling routing back from a stale local copy would revert a
+        # completed flip onto a dropped source copy
+        cur = n.fsm.rebalance_ledger.get(e["id"])
+        if cur is not None:
+            e = {**e, "state": cur["state"]}
+        if e["state"] in TERMINAL:
+            return
+        if e["state"] == "flipped":
+            self._run_entry(e, outcome="resumed")
+            return
+        # claim the abort in the LEDGER first (CAS): if another node won
+        # the race past this state, the advance is refused (illegal
+        # transition) and no routing command of ours can contradict its
+        # progress
+        from_state = e["state"]
+        self._advance(e, "aborted", error=error or "aborted")
+        # routing rollback next: while the warming dst is still a write
+        # replica, a write can be solo-acked by it between a reconcile
+        # pass and the drop — taking dst out of routing before anything
+        # else closes that window (the reconcile below then sweeps a
+        # frozen set of dst-only writes back to the source)
+        try:
+            r1 = n.raft.submit({
+                "op": "set_shard_replicas", "class": e["class"],
+                "shard": e["shard"], "nodes": list(e["prev_nodes"])})
+            r2 = n.raft.submit({
+                "op": "set_shard_warming", "class": e["class"],
+                "shard": e["shard"], "nodes": []})
+            if not (r1.get("ok") and r2.get("ok")):
+                raise ReplicationError(
+                    f"{r1.get('error')}/{r2.get('error')}")
+        except Exception:
+            # a failed rollback leaves routing possibly referencing the
+            # aborted target — the silent-divergence case, so be loud
+            logger.exception(
+                "move %s abort: routing rollback failed for %s/shard%s; "
+                "routing may reference the aborted target", e["id"],
+                e["class"], e["shard"])
+        recovered = from_state == "planned"  # nothing hydrated yet
+        if not recovered:
+            try:
+                for _ in range(3):
+                    if n._converge_replicas(e["class"], e["shard"],
+                                            e["dst"], e["src"],
+                                            e["tenant"]) == 0:
+                        recovered = True
+                        break
+            except (TransportError, ReplicationError, DeadlineExceeded,
+                    KeyError):
+                logger.info("move %s abort: dst->src reconcile pass "
+                            "failed; keeping the target copy", e["id"],
+                            exc_info=True)
+        if recovered and from_state != "planned":
+            try:
+                n._send(e["dst"], {"type": "shard_drop",
+                                   "class": e["class"],
+                                   "tenant": e["tenant"],
+                                   "shard": e["shard"]})
+            except TransportError:
+                logger.warning("move %s abort: target copy drop on %s "
+                               "failed; orphan GC will reap it", e["id"],
+                               e["dst"])
+        elif not recovered:
+            logger.warning("move %s abort: target copy on %s NOT "
+                           "reconciled back; kept for the orphan GC's "
+                           "verify+reap", e["id"], e["dst"])
+        REBALANCE_MOVES.inc(outcome="aborted")
+
+    def resume_pending(self, force: bool = False) -> dict[str, str]:
+        """Crash recovery: adopt every non-terminal ledger entry whose
+        coordinator is this node (a previous incarnation) or is dead per
+        gossip (``force`` adopts regardless). Entries still mid-copy are
+        aborted — routing never referenced the target; entries past the
+        warming join are resumed to completion. Returns id -> action."""
+        n = self.node
+        out: dict[str, str] = {}
+        entries = sorted(n.fsm.rebalance_ledger.values(),
+                         key=lambda e: e.get("created_ts", 0.0))
+        for e in entries:
+            if e["state"] in TERMINAL:
+                continue
+            with self._active_lock:
+                if e["id"] in self._active:
+                    continue  # our own live worker owns it
+            coord = e.get("coordinator", "")
+            if (not force and coord != n.id
+                    and n.gossip.alive(coord)):
+                continue  # its coordinator is alive and responsible
+            e = dict(e)
+            try:
+                if e["state"] in ("planned", "copying"):
+                    self._abort_entry(
+                        e, error="aborted on resume: coordinator lost "
+                                 "before the warming join")
+                    out[e["id"]] = "aborted"
+                else:
+                    self._run_entry(e, outcome="resumed")
+                    out[e["id"]] = "resumed"
+            except CrashInjected:
+                raise
+            except Exception as ex:
+                if e["state"] == "warming":
+                    try:
+                        self._abort_entry(e, error=f"resume failed: {ex}")
+                        out[e["id"]] = "aborted"
+                        continue
+                    except Exception:
+                        logger.exception("abort-after-failed-resume of "
+                                         "move %s failed", e["id"])
+                logger.warning("resume of move %s left pending: %s",
+                               e["id"], ex)
+                out[e["id"]] = "pending"
+        return out
+
+    # -- node lifecycle ----------------------------------------------------
+    def pin_routing(self) -> int:
+        """Install the CURRENT effective replica set of every shard as an
+        explicit raft override. Ring placement is a pure function of
+        membership, so growing or shrinking the cluster would otherwise
+        silently re-route shards away from their data — pinning first
+        makes membership changes routing-neutral until real moves flip
+        real copies. Returns overrides installed."""
+        n = self.node
+        pinned = 0
+        # EVERY collection pins — multi-tenant ones included: their
+        # tenant objects replicate over the same uuid-shard ring, so an
+        # unpinned membership change would re-ring them away from their
+        # data just the same
+        for cls in n.db.collections():
+            st = n._state_for(cls)
+            for s in range(st.n_shards):
+                if s in st.overrides:
+                    continue
+                reps = st.replicas(s)
+                if not reps:
+                    continue
+                r = n.raft.submit({"op": "set_shard_replicas",
+                                   "class": cls, "shard": s,
+                                   "nodes": reps})
+                if not r.get("ok"):
+                    raise ReplicationError(
+                        f"pin of {cls}/shard{s} failed: {r.get('error')}")
+                pinned += 1
+        return pinned
+
+    def _stranded_data(self, node_id: str) -> list:
+        """Shards for which ``node_id`` holds objects WITHOUT being a
+        routed replica — data a membership removal would silently lose."""
+        n = self.node
+        out = []
+        for cls in n.db.collections():
+            st = n._state_for(cls)
+            for s in range(st.n_shards):
+                if node_id in st.replicas(s):
+                    continue  # the leftover check owns routed shards
+                try:
+                    r = n._send(node_id, {
+                        "type": "shard_export", "class": cls,
+                        "tenant": "", "shard": s, "after": -1,
+                        "limit": 1}, timeout=5.0)
+                except TransportError:
+                    out.append((cls, s, "unreachable"))
+                    continue
+                if r.get("objects"):
+                    out.append((cls, s, "unrouted data"))
+        return out
+
+    def _wait(self, pred: Callable[[], bool], timeout: float,
+              what: str) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"timed out waiting for {what}")
+
+    def join(self, node_id: str, rebalance: bool = True,
+             timeout: float = 30.0, max_moves: int = 16) -> list[str]:
+        """Scale OUT: admit ``node_id`` to raft membership and move load
+        onto it. Routing is pinned first, so the membership change alone
+        moves nothing — data follows only through journaled moves."""
+        n = self.node
+        self.pin_routing()
+        if node_id not in n.all_nodes:
+            n.add_node(node_id)
+        self._wait(lambda: node_id in n.all_nodes, timeout,
+                   f"{node_id} joining raft membership")
+        # require a REAL heartbeat: alive() also passes for never-heard
+        # (SUSPECT) nodes, and planning moves onto a node that is not
+        # actually up just burns every move's readiness budget
+        from weaviate_tpu.cluster.gossip import ALIVE
+
+        self._wait(lambda: n.gossip.status(node_id) == ALIVE, timeout,
+                   f"{node_id} gossip liveness")
+        if not rebalance:
+            return []
+        return self.execute(self.plan(max_moves=max_moves))
+
+    def drain(self, node_id: str, remove: bool = True,
+              timeout: float = 120.0) -> list[str]:
+        """Scale IN: migrate every replica off ``node_id`` — writes are
+        never rejected during the moves — then remove it from membership.
+        Raises if any shard still routes to the node afterwards (the
+        draining mark stays set so a re-run finishes the job)."""
+        n = self.node
+        if node_id not in n.all_nodes:
+            raise ValueError(f"{node_id!r} is not a cluster member")
+        self.pin_routing()
+        r = n.raft.submit({"op": "set_node_draining", "node": node_id})
+        if not r.get("ok"):
+            raise ReplicationError(
+                f"draining mark failed: {r.get('error')}")
+        # submit() returns once the LEADER applied; this coordinator may
+        # be a follower whose own FSM apply lags — plan only against a
+        # local view that already sees the mark
+        self._wait(lambda: node_id in n.fsm.draining_nodes, 10.0,
+                   "draining mark to apply locally")
+        moves = [m for m in self.plan(max_moves=1_000_000)
+                 if m.src == node_id]
+        ids = self.execute(moves, wait=True, timeout=timeout)
+
+        def leftovers() -> list:
+            # MT collections count too: the planner cannot move tenant
+            # shards (yet), so a drain that would strand tenant data
+            # must FAIL here rather than remove the node
+            out = []
+            for cls in n.db.collections():
+                st = n._state_for(cls)
+                out.extend((cls, s) for s in range(st.n_shards)
+                           if node_id in st.replicas(s))
+            return out
+
+        try:  # flips are committed; wait out the local FSM apply lag
+            self._wait(lambda: not leftovers(), 10.0, "routing flips")
+        except TimeoutError:
+            raise ReplicationError(
+                f"drain incomplete: {leftovers()} still route to "
+                f"{node_id}; draining mark left set — re-run drain")
+        # final safety: the node must hold NO data routing does not
+        # know about (a collection created inside the pin->mark gap can
+        # have ring-placed writes there that the mark then re-rung away)
+        # — never remove a member that still uniquely holds objects
+        stranded = self._stranded_data(node_id)
+        if stranded:
+            raise ReplicationError(
+                f"drain refused: {node_id} still holds unrouted data "
+                f"{stranded}; run the orphan GC / re-run drain")
+        if remove:
+            n.remove_node(node_id)
+            self._wait(lambda: node_id not in n.all_nodes, 30.0,
+                       f"{node_id} leaving raft membership")
+        # re-pin before clearing the mark: a collection created MID-drain
+        # ring-placed over the filtered membership, and clearing would
+        # silently re-ring its shards away from that data
+        self.pin_routing()
+        n.raft.submit({"op": "clear_node_draining", "node": node_id})
+        self._wait(lambda: node_id not in n.fsm.draining_nodes, 10.0,
+                   "draining mark to clear locally")
+        return ids

@@ -49,20 +49,29 @@ class Router:
     # the node wires the circuit-breaker board here so plans demote
     # peers this node's RPCs keep failing against
     rank_fn: Optional[Callable[[str], int]] = None
+    # nodes draining out of the cluster: still full write replicas (a
+    # drain must never reject a write), but reads prefer the replicas
+    # that will still be here tomorrow, and new placements skip them
+    # entirely (ShardingState ring + rebalance planner)
+    draining_fn: Optional[Callable[[], set[str]]] = None
 
     def _live(self) -> Optional[set[str]]:
         return self.live_fn() if self.live_fn is not None else None
 
     def _order(self, replicas: list[str]) -> list[str]:
         """Local replica first (avoids a network hop), then live peers
-        (breaker-closed before breaker-open within a class), then
-        suspected-dead ones as a last resort (they may have recovered;
-        the data plane's failover will skip them on error)."""
+        (breaker-closed before breaker-open within a class), draining
+        peers demoted within their liveness class, then suspected-dead
+        ones as a last resort (they may have recovered; the data plane's
+        failover will skip them on error)."""
         live = self._live()
+        draining = (self.draining_fn()
+                    if self.draining_fn is not None else set())
 
         def rank(r: str) -> tuple:
             return (r != self.node_id,
                     live is not None and r not in live,
+                    r in draining,
                     self.rank_fn(r) if self.rank_fn is not None else 0,
                     r)
         return sorted(replicas, key=rank)

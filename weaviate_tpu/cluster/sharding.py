@@ -29,17 +29,26 @@ class ShardingState:
     # writes but must not SERVE reads yet (a digest miss there would read
     # as a deleted object). Raft-committed alongside the override.
     warming: dict[int, list[str]] = field(default_factory=dict)
+    # nodes draining out of membership (raft-committed): NEW ring
+    # placements skip them, so a collection created mid-drain never lands
+    # a shard on the node that is leaving. Explicit overrides are placement
+    # decisions and pass through untouched — the rebalancer pins every
+    # existing shard as an override before a drain is marked, so no shard
+    # that holds data can be silently re-rung off its replicas.
+    draining: frozenset = frozenset()
 
     def replicas(self, shard: int) -> list[str]:
         ov = self.overrides.get(shard)
         if ov:
             return list(ov)
-        n = len(self.nodes)
+        nodes = [n for n in self.nodes if n not in self.draining] \
+            or self.nodes
+        n = len(nodes)
         if n == 0:
             return []
         factor = min(self.factor, n)
         start = shard % n
-        return [self.nodes[(start + r) % n] for r in range(factor)]
+        return [nodes[(start + r) % n] for r in range(factor)]
 
     def read_replicas(self, shard: int) -> list[str]:
         """Replicas eligible to serve reads: warming joiners excluded

@@ -149,14 +149,27 @@ class DistributedTaskExecutor:
     """Per-node worker: claims this node's slice of pending tasks and runs
     the registered handler (reference scheduler.go worker loop)."""
 
-    def __init__(self, cluster, poll_interval: float = 0.2):
+    def __init__(self, cluster, poll_interval: float = 0.2,
+                 orphan_gc_interval: float = 5.0):
         self.cluster = cluster  # ClusterNode: .node_id, .apply(), .task_fsm
         self.poll_interval = poll_interval
+        # periodic orphan-copy GC (cluster/node.py gc_orphan_shards_once):
+        # local shard copies absent from routing — a failed post-move
+        # shard_drop, an aborted move's unreachable target — are verified
+        # against routing via anti-entropy and reaped on this cadence
+        self.orphan_gc_interval = orphan_gc_interval
+        self._orphan_gc_last = 0.0
+        self._orphan_gc_thread: Optional[threading.Thread] = None
+        # rebalance-ledger retention: terminal entries older than this
+        # are compacted (leader-submitted rebalance_forget) so a cluster
+        # that rebalances periodically never grows unbounded FSM state
+        self.ledger_retention_s = 3600.0
         self.handlers: dict[str, Callable[[dict], Any]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.register("reindex_inverted", self._reindex_inverted)
         self.register("compact", self._compact)
+        self.register("orphan_gc", self._orphan_gc)
 
     def register(self, kind: str, fn: Callable[[dict], Any]) -> None:
         self.handlers[kind] = fn
@@ -173,6 +186,32 @@ class DistributedTaskExecutor:
         col.compact_once(min_segments=int(payload.get("min_segments", 2)),
                          include_unopened=True)
         return {"ok": True}
+
+    def _orphan_gc(self, payload: dict) -> Any:
+        """Fan-out task form of the periodic sweep: every node reaps its
+        own unrouted copies (operator-forced full-cluster GC)."""
+        return {"dropped": self.cluster.gc_orphan_shards_once()}
+
+    def _orphan_gc_sweep(self) -> None:
+        try:
+            self.cluster.gc_orphan_shards_once()
+        except Exception:
+            logger.warning("orphan GC sweep failed; next interval "
+                           "retries", exc_info=True)
+
+    def _compact_ledger_once(self) -> None:
+        """Leader-only: forget terminal rebalance-ledger entries older
+        than the retention window (one raft command, every applier
+        drops the same set)."""
+        if self.ledger_retention_s <= 0 or not self.cluster.raft.is_leader():
+            return
+        cutoff = time.time() - self.ledger_retention_s
+        fsm = self.cluster.fsm
+        if any(e["state"] in ("dropped", "aborted")
+               and e.get("updated_ts", e.get("created_ts", 0.0)) < cutoff
+               for e in list(fsm.rebalance_ledger.values())):
+            self.cluster.apply({"op": "rebalance_forget",
+                                "before": cutoff})
 
     # -- lifecycle ---------------------------------------------------------
     def submit(self, kind: str, payload: dict,
@@ -257,6 +296,21 @@ class DistributedTaskExecutor:
             try:
                 self.run_pending_once()
                 self.reap_expired_once()
+                now = time.monotonic()
+                if (self.orphan_gc_interval > 0
+                        and now - self._orphan_gc_last
+                        >= self.orphan_gc_interval
+                        and (self._orphan_gc_thread is None
+                             or not self._orphan_gc_thread.is_alive())):
+                    self._orphan_gc_last = now
+                    # own thread: the verify pass can spend many RPC
+                    # timeouts against an unreachable replica set, and
+                    # that must never starve task claiming/reaping
+                    self._orphan_gc_thread = threading.Thread(
+                        target=self._orphan_gc_sweep, daemon=True,
+                        name="orphan-gc")
+                    self._orphan_gc_thread.start()
+                    self._compact_ledger_once()
             except Exception:
                 # raft leadership churn etc: retry next tick, audibly
                 logger.warning("task executor tick failed; retrying",

@@ -33,7 +33,7 @@ import threading
 import numpy as np
 
 
-def _default_cfg(name: str, factor: int):
+def _default_cfg(name: str, factor: int, shards: int = 1):
     from weaviate_tpu.schema.config import (
         CollectionConfig,
         DataType,
@@ -48,7 +48,7 @@ def _default_cfg(name: str, factor: int):
         properties=[Property(name="title", data_type=DataType.TEXT)],
         vector_config=FlatIndexConfig(distance="l2-squared",
                                       precision="fp32"),
-        sharding=ShardingConfig(desired_count=1),
+        sharding=ShardingConfig(desired_count=max(1, shards)),
         replication=ReplicationConfig(factor=factor),
     )
 
@@ -78,7 +78,8 @@ class WorkerControl:
 
     def ctl_create_collection(self, msg):
         self.node.create_collection(
-            _default_cfg(msg["name"], int(msg.get("factor", 3))))
+            _default_cfg(msg["name"], int(msg.get("factor", 3)),
+                         int(msg.get("shards", 1))))
         return {}
 
     def ctl_put(self, msg):
@@ -142,6 +143,36 @@ class WorkerControl:
         return {"aborted": self.node.sweep_staging(
             ttl=float(ttl) if ttl is not None else None)}
 
+    # -- elastic scale-out (cluster/rebalance.py) --------------------------
+    def ctl_rebalance(self, msg):
+        """Plan (and optionally execute) a rebalance round from THIS node
+        as coordinator."""
+        rb = self.node.rebalancer
+        max_moves = int(msg.get("max_moves", 16))
+        if msg.get("dry_run"):
+            return {"moves": [m.__dict__ for m in rb.plan(max_moves)]}
+        return {"move_ids": rb.rebalance(max_moves=max_moves,
+                                         wait=bool(msg.get("wait", True)))}
+
+    def ctl_join(self, msg):
+        return {"move_ids": self.node.rebalancer.join(
+            msg["node"], rebalance=bool(msg.get("rebalance", True)))}
+
+    def ctl_drain(self, msg):
+        return {"move_ids": self.node.rebalancer.drain(
+            msg["node"], remove=bool(msg.get("remove", True)),
+            timeout=float(msg.get("timeout", 120.0)))}
+
+    def ctl_resume_rebalance(self, msg):
+        return {"resumed": self.node.rebalancer.resume_pending(
+            force=bool(msg.get("force", False)))}
+
+    def ctl_cluster_view(self, msg):
+        return {"view": self.node.cluster_view()}
+
+    def ctl_gc_orphans(self, msg):
+        return {"dropped": self.node.gc_orphan_shards_once()}
+
 
 class CtlTransport:
     """Transport decorator that muxes the ``ctl_*`` surface in front of
@@ -194,6 +225,10 @@ def main(argv=None) -> int:
     ap.add_argument("--staging-ttl", type=float, default=30.0,
                     help="seconds before an orphaned 2PC staging entry "
                          "is aborted")
+    ap.add_argument("--hbm-budget", type=int, default=0,
+                    help="HBM byte budget this node advertises via gossip "
+                         "(0 = use the tiering accountant / unbudgeted); "
+                         "the rebalance planner places against it")
     args = ap.parse_args(argv)
 
     inner = TcpTransport(args.bind)
@@ -208,6 +243,13 @@ def main(argv=None) -> int:
     peers = [p.strip() for p in args.peers.split(",") if p.strip()]
     node = ClusterNode(args.bind, peers, transport, args.data,
                        staging_ttl=args.staging_ttl)
+    if args.hbm_budget:
+        def _capacity(node=node, budget=args.hbm_budget):
+            tiering = getattr(node.db, "tiering", None)
+            used = tiering.accountant.total() if tiering else 0
+            return {"hbm_budget": budget, "hbm_used": used}
+
+        node.capacity_fn = _capacity
     transport.ctl = WorkerControl(node)
 
     rest = rest_srv = None

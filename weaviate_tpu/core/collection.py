@@ -1535,7 +1535,12 @@ class Collection:
             s.store.compact_all(min_segments)
 
     def close(self) -> None:
-        for s in self._shards.values():
+        # snapshot under the lock: a straggler replication push (late
+        # anti-entropy object_push, a racing shard build) can still be
+        # inserting into _shards while the node tears down
+        with self._lock:
+            shards = list(self._shards.values())
+        for s in shards:
             s.close()
         self._pool.shutdown(wait=False)
 

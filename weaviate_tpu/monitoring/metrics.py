@@ -383,3 +383,32 @@ DEVICE_TIME_SECONDS = REGISTRY.histogram(
 TRACE_SPANS = REGISTRY.counter(
     "weaviate_tpu_trace_spans_total",
     "sampled spans recorded into the bounded trace buffer, by span name")
+
+# elastic scale-out instruments (cluster/rebalance.py + gossip capacity
+# advertisement): every shard migration's outcome and duration, the
+# in-flight count, the per-node HBM capacity view the planner places
+# against, and the orphan-copy GC that reaps what failed drops leave
+REBALANCE_MOVES = REGISTRY.counter(
+    "weaviate_tpu_rebalance_moves_total",
+    "shard migrations driven through the rebalance ledger, by outcome "
+    "(completed/resumed/aborted)")
+REBALANCE_MOVE_SECONDS = REGISTRY.histogram(
+    "weaviate_tpu_rebalance_move_seconds",
+    "wall time of one ledger-journaled shard migration (copy through "
+    "drop), by outcome",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0))
+REBALANCE_ACTIVE = REGISTRY.gauge(
+    "weaviate_tpu_rebalance_active_moves",
+    "shard migrations currently executing on this coordinator")
+ORPHAN_SHARDS_DROPPED = REGISTRY.counter(
+    "weaviate_tpu_orphan_shards_dropped_total",
+    "local shard copies absent from routing that the periodic GC dropped "
+    "after an anti-entropy verify, by collection")
+NODE_HBM_BUDGET = REGISTRY.gauge(
+    "weaviate_tpu_node_hbm_budget_bytes",
+    "per-node HBM byte budget advertised via gossip (0 = unbudgeted), "
+    "by node — the capacity axis the rebalance planner places against")
+NODE_HBM_USED = REGISTRY.gauge(
+    "weaviate_tpu_node_hbm_used_bytes",
+    "per-node HBM bytes in use as advertised via gossip (the tiering "
+    "accountant ledger total), by node")
