@@ -1778,6 +1778,126 @@ class TestTracerInKernel:
         assert "tracer-in-kernel" not in rule_ids(res)
 
 
+# ---------------------------------------------------------------------------
+# unwarmed-jit-program
+
+
+class TestUnwarmedJitProgram:
+    @pytest.fixture(autouse=True)
+    def _manifest(self):
+        from tools.graftlint.rules import UnwarmedJitProgram
+
+        UnwarmedJitProgram.manifest_override = frozenset(
+            {"ops.fake.registered", "ops.fake.assigned"})
+        yield
+        UnwarmedJitProgram.manifest_override = None
+
+    def test_unregistered_module_level_jit_flagged_warning(self):
+        res = run("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def unregistered(q, k):
+                return q
+        """)
+        vs = [v for v in res.violations
+              if v.rule == "unwarmed-jit-program"]
+        assert len(vs) == 1
+        assert vs[0].severity == "warning"
+        assert "ops.fake.unregistered" in vs[0].message
+
+    def test_registered_decorated_and_assigned_pass(self):
+        res = run("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def registered(q, k):
+                return q
+
+            def _impl(x):
+                return x
+
+            assigned = jax.jit(_impl)
+        """)
+        assert "unwarmed-jit-program" not in rule_ids(res)
+
+    def test_unregistered_module_level_assignment_flagged(self):
+        res = run("""
+            import jax
+
+            def _impl(x):
+                return x
+
+            stray = jax.jit(_impl)
+        """)
+        vs = [v for v in res.violations
+              if v.rule == "unwarmed-jit-program"]
+        assert len(vs) == 1 and "ops.fake.stray" in vs[0].message
+
+    def test_annotated_assignment_flagged_too(self):
+        res = run("""
+            import jax
+            from typing import Callable
+
+            def _impl(x):
+                return x
+
+            annotated: Callable = jax.jit(_impl)
+        """)
+        vs = [v for v in res.violations
+              if v.rule == "unwarmed-jit-program"]
+        assert len(vs) == 1 and "ops.fake.annotated" in vs[0].message
+
+    def test_scope_limited_to_ops_and_parallel(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def unregistered(q):
+                return q
+        """
+        assert "unwarmed-jit-program" in rule_ids(
+            run(src, rel="weaviate_tpu/parallel/fake.py"))
+        # index/ and non-module-level jits are out of scope
+        assert "unwarmed-jit-program" not in rule_ids(
+            run(src, rel="weaviate_tpu/index/fake.py"))
+        res = run("""
+            import jax
+
+            def factory():
+                @jax.jit
+                def inner(q):
+                    return q
+                return inner
+        """)
+        assert "unwarmed-jit-program" not in rule_ids(res)
+
+    def test_suppressible_with_reason(self):
+        res = run("""
+            import jax
+
+            @jax.jit
+            # graftlint: allow[unwarmed-jit-program] reason=construction-only
+            def build_only(q):
+                return q
+        """)
+        assert "unwarmed-jit-program" not in rule_ids(res)
+        assert any(v.rule == "unwarmed-jit-program"
+                   for v in res.suppressed)
+
+    def test_real_tree_manifest_loads_from_prewarm_module(self):
+        from tools.graftlint.rules import UnwarmedJitProgram
+
+        UnwarmedJitProgram.manifest_override = None
+        manifest = UnwarmedJitProgram._load_manifest()
+        from weaviate_tpu.utils.prewarm import MANIFEST
+
+        assert manifest == frozenset(MANIFEST)
+        assert "ops.device_beam._fused_search" in manifest
+
+
 class TestConcurrencyEngineIntegration:
     def test_concurrency_suppression_counts_as_used(self):
         # an allow-comment consumed by a whole-program finding must not

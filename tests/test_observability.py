@@ -234,6 +234,56 @@ def test_devtime_compile_vs_execute():
         == base + 2
 
 
+def test_devtime_three_way_classification():
+    """compile vs cache_hit vs execute: a first sighting whose bracket
+    saw only persistent-cache HITS deserialized off disk (cache_hit);
+    any miss — or no cache traffic at all — is a true compile."""
+    from weaviate_tpu.monitoring import devtime
+    from weaviate_tpu.monitoring.metrics import DEVICE_TIME_SECONDS
+    from weaviate_tpu.utils import compile_cache
+
+    devtime.reset()
+    hit = "/jax/compilation_cache/cache_hits"
+    miss = "/jax/compilation_cache/cache_misses"
+    base_hit = DEVICE_TIME_SECONDS.count(phase="cache_hit", backend="B",
+                                         scorer="S", mesh="single")
+    # no cache events: conservative compile (cache disabled looks
+    # exactly like this)
+    assert devtime.record("B", "S", "single", (8, 8), 1.0) == "compile"
+    # hits only across the bracket: disk deserialize, not a compile
+    compile_cache._note_event(hit)
+    compile_cache._note_event(hit)
+    assert devtime.record("B", "S", "single", (16, 8), 0.05) \
+        == "cache_hit"
+    # the SAME identity after: steady state, whatever the cache did
+    compile_cache._note_event(hit)
+    assert devtime.record("B", "S", "single", (16, 8), 0.01) == "execute"
+    # a miss anywhere in the bracket means XLA really compiled
+    compile_cache._note_event(hit)
+    compile_cache._note_event(miss)
+    assert devtime.record("B", "S", "single", (32, 8), 0.8) == "compile"
+    assert DEVICE_TIME_SECONDS.count(
+        phase="cache_hit", backend="B", scorer="S", mesh="single") \
+        == base_hit + 1
+    # the debug surface sees first-sighting phases and running counts
+    snap = devtime.snapshot()
+    assert snap["B/S/single/(16, 8)"] == "cache_hit"
+    assert snap["B/S/single/(32, 8)"] == "compile"
+    counts = devtime.phase_counts()
+    assert counts == {"compile": 2, "cache_hit": 1, "execute": 1}
+
+
+def test_devtime_reset_reanchors_cache_mark():
+    """Events fired before a reset must not classify the next fresh
+    identity: reset re-anchors the delta mark at the current counters."""
+    from weaviate_tpu.monitoring import devtime
+    from weaviate_tpu.utils import compile_cache
+
+    compile_cache._note_event("/jax/compilation_cache/cache_hits")
+    devtime.reset()
+    assert devtime.record("B2", "S", "single", (8, 8), 0.5) == "compile"
+
+
 # -- runtime config ----------------------------------------------------------
 
 def test_runtime_overrides_file_roundtrip(tmp_path):

@@ -100,11 +100,18 @@ def traced_cluster(cluster3):
              msg="schema replication")
     # explicit generous budget: the default 3s op deadline spans ALL
     # shard groups, and the FIRST commit's shard open + HNSW construction
-    # compile can eat it before the last shard's prepare fans out
+    # compile can eat it before the last shard's prepare fans out.
+    # Configurable (default 120s) now that the persistent compile cache
+    # exists: a warmed environment can tighten it toward the op budget —
+    # the compile-free regression proof lives in test_compile_cache.py
+    import os as _os
+
     from weaviate_tpu.cluster.resilience import Deadline
 
+    seed_budget = float(_os.environ.get(
+        "WEAVIATE_TPU_SEED_WRITE_BUDGET_S", "120"))
     nodes[0].put_batch("Traced", _objs(48), consistency="ONE",
-                       deadline=Deadline(120.0, op="seed"))
+                       deadline=Deadline(seed_budget, op="seed"))
     return nodes
 
 

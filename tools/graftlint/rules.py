@@ -1065,6 +1065,121 @@ class SuppressionMissingReason(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 7b. unwarmed-jit-program
+
+
+class UnwarmedJitProgram(Rule):
+    id = "unwarmed-jit-program"
+    description = (
+        "module-level jax.jit entry point in ops/ or parallel/ not "
+        "registered in the prewarm manifest "
+        "(weaviate_tpu/utils/prewarm.py MANIFEST)"
+    )
+    rationale = (
+        "The prewarm driver compiles the shape-bucket lattice of every "
+        "registered serving program at boot / tenant promotion / "
+        "rebalance warming, so restarted nodes answer their first query "
+        "compile-free. A serving jit missing from the manifest silently "
+        "re-opens the compile tax on the cold path. Register it in "
+        "MANIFEST, or suppress with a reason for genuinely cold paths "
+        "(construction-only programs compile during builds, not "
+        "serving)."
+    )
+
+    SCOPES = ("weaviate_tpu/ops/", "weaviate_tpu/parallel/")
+    # tests inject a manifest here; None = read the real tree's
+    manifest_override: Optional[frozenset] = None
+    _manifest_cache: Optional[frozenset] = None
+
+    @classmethod
+    def _manifest(cls) -> frozenset:
+        if cls.manifest_override is not None:
+            return cls.manifest_override
+        if cls._manifest_cache is None:
+            cls._manifest_cache = cls._load_manifest()
+        return cls._manifest_cache
+
+    @staticmethod
+    def _load_manifest() -> frozenset:
+        """String-literal keys of ``MANIFEST = {...}`` in prewarm.py,
+        read from the AST — the registry must stay statically
+        analyzable (no computed keys)."""
+        import pathlib
+
+        path = (pathlib.Path(__file__).resolve().parents[2]
+                / "weaviate_tpu" / "utils" / "prewarm.py")
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except (OSError, SyntaxError):
+            return frozenset()
+        names = set()
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "MANIFEST"
+                       for t in targets):
+                continue
+            if isinstance(value, ast.Dict):
+                for key in value.keys:
+                    if isinstance(key, ast.Constant) \
+                            and isinstance(key.value, str):
+                        names.add(key.value)
+        return frozenset(names)
+
+    def _module_dotted(self, rel_path: str) -> str:
+        # weaviate_tpu/ops/distance.py -> ops.distance (matches the
+        # manifest's dotted-under-weaviate_tpu key format)
+        mod = rel_path[len("weaviate_tpu/"):]
+        if mod.endswith(".py"):
+            mod = mod[:-3]
+        return mod.replace("/", ".")
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, self.SCOPES):
+            return
+        manifest = self._manifest()
+        mod = self._module_dotted(ctx.rel_path)
+        for node in ctx.tree.body:
+            name: Optional[str] = None
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_decorator_is_jit(d) for d in node.decorator_list):
+                    name = node.name
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and _is_jit_like(node.value) is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        name = t.id
+                        break
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None \
+                    and isinstance(node.value, ast.Call) \
+                    and _is_jit_like(node.value) is not None \
+                    and isinstance(node.target, ast.Name):
+                name = node.target.id
+            if name is None:
+                continue
+            program = f"{mod}.{name}"
+            if program in manifest:
+                continue
+            yield self.violation(
+                ctx, node,
+                f"jit entry point {program!r} is not registered in the "
+                "prewarm manifest (weaviate_tpu/utils/prewarm.py) — "
+                "register it so the driver warms its shape buckets, or "
+                "suppress with a reason if it never serves queries",
+                severity=SEV_WARNING,
+            )
+
+
+# ---------------------------------------------------------------------------
 # 8. whole-program concurrency rules (driven by tools/graftlint/
 #    concurrency.py — the per-file check() is a no-op; the engine runs
 #    the interprocedural pass once per tree and routes its findings
@@ -1140,6 +1255,7 @@ ALL_RULES: tuple = (
     LockOrderCycle(),
     BlockingUnderLock(),
     UnlockedCollectiveDispatch(),
+    UnwarmedJitProgram(),
     SuppressionMissingReason(),
 )
 

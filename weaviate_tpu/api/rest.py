@@ -163,6 +163,7 @@ class RestAPI:
         "root", "meta", "ready", "live", "metrics", "openapi",
         "oidc_discovery", "pprof_profile", "pprof_heap", "debug_traces",
         "debug_config", "debug_telemetry", "debug_cluster",
+        "debug_compile",
     })
     # endpoint -> admission lane; anything unlisted is background
     # (schema/authz/backup/replication mutations: important, not latency-
@@ -347,6 +348,8 @@ class RestAPI:
             Rule("/v1/debug/config", endpoint="debug_config",
                  methods=["GET"]),
             Rule("/v1/debug/telemetry", endpoint="debug_telemetry",
+                 methods=["GET"]),
+            Rule("/v1/debug/compile", endpoint="debug_compile",
                  methods=["GET"]),
             Rule("/v1/debug/reindex/<cls>", endpoint="debug_reindex",
                  methods=["POST"]),
@@ -573,7 +576,14 @@ class RestAPI:
         })
 
     def on_ready(self, request):
-        return Response(status=200)
+        # ``warming``: true while the shape-bucket prewarm driver is
+        # compiling the serving lattice (docs/compile_cache.md) — the
+        # node answers queries (they just pay the compile), so readiness
+        # stays 200 and orchestrators that want compile-free first
+        # queries gate on the field instead
+        from weaviate_tpu.utils import prewarm
+
+        return _json_response({"warming": prewarm.warming()})
 
     def on_live(self, request):
         return Response(status=200)
@@ -1439,6 +1449,26 @@ class RestAPI:
             "payload": self.telemeter.build_payload("UPDATE"),
             "push_url": self.telemeter.url or None,
             "last_push_error": self.telemeter.last_push_error,
+        })
+
+    def on_debug_compile(self, request):
+        """Compile-tax readiness surface (docs/compile_cache.md):
+        persistent-cache hit/miss/bytes, the prewarm driver's warmed
+        bucket lattice + manifest, and every program identity devtime
+        has sighted with the phase its first dispatch was classified as
+        — "did this node's restart pay compile seconds" is answerable
+        from one GET."""
+        self._authz(request, "read_cluster", "debug/compile")
+        from weaviate_tpu.monitoring import devtime
+        from weaviate_tpu.utils import compile_cache, prewarm
+
+        return _json_response({
+            "cache": compile_cache.stats(),
+            "prewarm": prewarm.stats(),
+            "devtime": {
+                "identities": devtime.snapshot(),
+                "phases": devtime.phase_counts(),
+            },
         })
 
     def on_debug_reindex(self, request, cls):

@@ -94,12 +94,26 @@ class ClusterNode:
     # small — typically ≤ factor — so this comfortably overlaps ~10 ops;
     # a saturated pool queues work instead of spawning threads)
     POOL_WORKERS = 32
-    # budget for the 2PC finish leg (commit/abort AFTER a quorum of
-    # prepares): deliberately generous — the quorum is already promised,
-    # and a replica's first-touch apply (shard + index creation, cold XLA
-    # compile) can dwarf a data-plane RPC. Dead peers still fail fast
-    # (connection error / breaker), so this never stalls the fault path.
+    # default budget for the 2PC finish leg (commit/abort AFTER a quorum
+    # of prepares): deliberately generous — the quorum is already
+    # promised, and a replica's first-touch apply (shard + index
+    # creation, cold XLA compile) can dwarf a data-plane RPC. Dead peers
+    # still fail fast (connection error / breaker), so this never stalls
+    # the fault path. With the persistent compilation cache + prewarm
+    # (docs/compile_cache.md) in place the compile term disappears, so
+    # the live value rides the hot-reloadable ``cluster_finish_budget_s``
+    # knob (see ``finish_budget``) — operators with warmed fleets can
+    # tighten it toward the plain op budget.
     FINISH_BUDGET = 10.0
+
+    @property
+    def finish_budget(self) -> float:
+        from weaviate_tpu.utils.runtime_config import (
+            CLUSTER_FINISH_BUDGET_S,
+        )
+
+        v = float(CLUSTER_FINISH_BUDGET_S.get())
+        return v if v > 0 else self.FINISH_BUDGET
 
     def __init__(self, node_id: str, peers: list[str], transport,
                  data_dir: str, heartbeat: bool = True,
@@ -248,6 +262,47 @@ class ClusterNode:
 
     def _on_gossip_ping(self, msg: dict) -> dict:
         return self.gossip.on_ping(msg)
+
+    def _on_shard_prewarm(self, msg: dict) -> dict:
+        """Rebalance warming leg (cluster/rebalance.py): compile the
+        shape-bucket lattice for a shard THIS node just hydrated, before
+        the routing flip points traffic at it. THIS node's own prewarm
+        config decides (the coordinator always asks — its local config
+        says nothing about the destination's compile tax), and the reply
+        is bounded by the message's budget: a lattice that outlives it
+        keeps warming in the background (``pending``) while the
+        coordinator proceeds — best-effort, never a stalled move
+        executor (``_send`` to self ignores RPC timeouts entirely)."""
+        from weaviate_tpu.utils import prewarm
+
+        if not prewarm.enabled():
+            return {"ok": True, "skipped": "prewarm disabled on node"}
+        cls = msg["class"]
+        tenant = msg.get("tenant", "")
+        shard_name = (f"tenant-{tenant}" if tenant
+                      else f"shard{int(msg['shard'])}")
+        col = self.db.get_collection(cls)
+        done = threading.Event()
+        out: dict = {}
+
+        def _warm() -> None:
+            try:
+                r = prewarm.prewarm_collection(
+                    col, reason="rebalance", shards=[shard_name],
+                    block=True)
+                out["report"] = r.to_dict() if r else None
+            except Exception as e:
+                logger.warning("rebalance prewarm of %s/%s failed: %s",
+                               cls, shard_name, e)
+                out["prewarm_error"] = str(e)
+            finally:
+                done.set()
+
+        threading.Thread(target=_warm, daemon=True,
+                         name=f"prewarm-rebalance-{shard_name}").start()
+        if done.wait(timeout=float(msg.get("budget", 25.0))):
+            return {"ok": True, **out}
+        return {"ok": True, "pending": True}
 
     # -- capacity advertisement (gossip node meta) -------------------------
     def _capacity_meta(self) -> dict:
@@ -714,7 +769,7 @@ class ClusterNode:
                 decided.wait(timeout=self.op_budget)
                 msg = {"type": f"replica_{decision['outcome']}",
                        "txid": txid}
-                budget = max(self.op_budget, self.FINISH_BUDGET)
+                budget = max(self.op_budget, self.finish_budget)
                 try:
                     # full budget per attempt: timing out a commit that is
                     # mid-apply just to retry it buys nothing
@@ -819,7 +874,7 @@ class ClusterNode:
             if inflight is not None and prior is None:
                 # duplicate racing the first delivery's (possibly slow)
                 # apply: wait for the outcome instead of guessing
-                inflight.wait(self.FINISH_BUDGET)
+                inflight.wait(self.finish_budget)
                 with self._staging_lock:
                     prior = self._tx_done.get(txid)
             if prior == "commit":  # duplicate delivery / retried commit

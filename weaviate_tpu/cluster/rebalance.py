@@ -198,8 +198,8 @@ class Rebalancer:
 
     # per-leg wall budgets (seconds): each leg runs under a Deadline with
     # jittered-backoff retries on transport faults inside it
-    LEG_BUDGETS = {"copy": 60.0, "anti_entropy": 30.0, "flip": 10.0,
-                   "drop": 30.0}
+    LEG_BUDGETS = {"copy": 60.0, "anti_entropy": 30.0, "prewarm": 30.0,
+                   "flip": 10.0, "drop": 30.0}
     CONVERGE_ROUNDS = 8
 
     def __init__(self, node, max_concurrent: int = 2,
@@ -407,6 +407,7 @@ class Rebalancer:
                 self._maybe_crash("anti_entropy")
                 self._leg("anti_entropy", e,
                           lambda: self._converge_zero(e))
+                self._prewarm_dst(e)
                 self._maybe_crash("flip")
                 self._leg("flip", e, lambda: self._flip(e))
                 self._advance(e, "flipped")
@@ -420,6 +421,43 @@ class Rebalancer:
         logger.info("move %s (%s/shard%s %s->%s) %s in %.2fs", e["id"],
                     e["class"], e["shard"], e["src"], e["dst"], outcome,
                     time.monotonic() - t0)
+
+    def _prewarm_dst(self, e: dict) -> None:
+        """Warming leg, compile half: ask the destination to compile (or
+        cache-deserialize) the migrating shard's shape-bucket lattice
+        BEFORE the routing flip, so the first post-flip query pays zero
+        compile seconds (docs/compile_cache.md). The DESTINATION's own
+        prewarm config decides whether it warms (this coordinator's
+        local config says nothing about that node's compile tax); the
+        reply is bounded by the budget carried in the message, so even
+        a self-send — where ``_send`` bypasses RPC timeouts — cannot
+        stall the move executor past one leg budget. Strictly
+        best-effort: a prewarm failure never aborts a migration."""
+        budget = self.leg_budgets.get("prewarm", 30.0)
+        with TRACER.span("compile.prewarm", collection=e["class"],
+                         shard=e["shard"], dst=e["dst"],
+                         reason="rebalance") as sp:
+            try:
+                r = self.node._send(
+                    e["dst"], {"type": "shard_prewarm",
+                               "class": e["class"],
+                               "tenant": e["tenant"],
+                               "shard": e["shard"],
+                               # headroom for the RPC round itself
+                               "budget": max(1.0, budget - 2.0)},
+                    timeout=budget)
+                if r.get("error"):
+                    raise ReplicationError(r["error"])
+                sp.set(skipped=r.get("skipped", ""),
+                       pending=bool(r.get("pending")))
+            except (TransportError, ReplicationError) as ex:
+                from weaviate_tpu.monitoring import tracing
+
+                tracing.add_event("prewarm.failed", peer=e["dst"])
+                logger.warning(
+                    "move %s: destination prewarm on %s failed "
+                    "(non-fatal, first post-flip query may compile): %s",
+                    e["id"], e["dst"], ex)
 
     def _dst_ready(self, e: dict, timeout: float = 15.0) -> None:
         """Block until the target can actually serve this collection — a

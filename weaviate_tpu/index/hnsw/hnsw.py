@@ -951,11 +951,18 @@ class HNSWIndex(VectorIndex):
         # batch-group key: residency epoch PLUS the mesh mirror's
         # membership epoch — a request enqueued before an integer-factor
         # growth re-sharded the planes must never coalesce into a batch
-        # whose local-index layout belongs to the new generation
+        # whose local-index layout belongs to the new generation — PLUS
+        # the prewarm isolation token (None for live traffic): a
+        # synthetic lattice batch coalescing with a user query would
+        # compile a bigger bucket nobody planned and drag that query's
+        # latency through it (utils/prewarm.py)
+        from weaviate_tpu.utils.prewarm import isolation_key
+
         ids, d = self._dispatch.search(
             queries, k, allow_list,
             tier_key=(self._residency_epoch,
-                      getattr(self._device_beam, "epoch", 0)))
+                      getattr(self._device_beam, "epoch", 0),
+                      isolation_key()))
         return SearchResult(ids=ids, dists=d)
 
     def _run_search_batch(self, queries: np.ndarray, k: int, allow_list):
@@ -1135,7 +1142,10 @@ class HNSWIndex(VectorIndex):
             # np.asarray above IS the completion sync, so bracketing it
             # costs two perf_counter reads and ZERO extra host syncs.
             # First sighting of a (backend, scorer, mesh, shape-bucket)
-            # identity = the dispatch that paid XLA compile.
+            # identity = the dispatch that paid program acquisition —
+            # classified compile (true XLA) vs cache_hit (persistent-
+            # cache deserialize, utils/compile_cache.py) from the
+            # cache's hit/miss counters across this bracket.
             from weaviate_tpu.monitoring import devtime, tracing
 
             dt_dev = _time.perf_counter() - t_dev

@@ -1,11 +1,16 @@
-"""Device-time attribution: first-compile vs steady-state execute.
+"""Device-time attribution: compile vs cache_hit vs steady-state execute.
 
 The fused device beam is one jitted program per (scorer, mesh-mode,
-shape-bucket); its FIRST dispatch for a new bucket pays XLA compilation
-(seconds) while every later one is a steady-state execute
-(milliseconds). A latency investigation must be able to tell the two
-apart — "the p99 spike was three cold compiles after a deploy" is a
-different incident than "steady-state execute regressed".
+shape-bucket); its FIRST dispatch for a new bucket pays program
+acquisition while every later one is a steady-state execute
+(milliseconds). Acquisition itself splits two ways once the persistent
+compilation cache (``utils/compile_cache.py``) is wired: a true XLA
+``compile`` (seconds) or a ``cache_hit`` — a disk deserialize of an
+executable a previous process compiled (tens of milliseconds). A latency
+investigation must tell all three apart: "the p99 spike was three cold
+compiles after a deploy" is a different incident than "the cache warmed
+us in 40ms" is a different incident than "steady-state execute
+regressed".
 
 Timing rides the walk's EXISTING result materialization (the
 ``np.asarray`` host sync the search path already performs to hand
@@ -15,10 +20,14 @@ extra transfers — the graftlint ``host-sync-in-hot-path`` baseline
 stays at zero.
 
 Classification is a per-process registry: the first observation of a
-``(backend, scorer, mesh, shape_key)`` tuple is ``compile``, the rest
-are ``execute``. The shape key participates in detection (a new pow2
-bucket recompiles) but not in metric labels (cardinality stays at the
-taxonomy, not the workload).
+``(backend, scorer, mesh, shape_key)`` tuple is an acquisition, the rest
+are ``execute``. An acquisition is a ``cache_hit`` when the persistent
+cache reported hits and ZERO misses since the previous observation
+(every program the bracket compiled deserialized off disk), else
+``compile`` — the conservative default, and the only possible answer
+when the cache layer is disabled (no events ever fire). The shape key
+participates in detection (a new pow2 bucket recompiles) but not in
+metric labels (cardinality stays at the taxonomy, not the workload).
 """
 
 from __future__ import annotations
@@ -28,26 +37,69 @@ import threading
 from weaviate_tpu.monitoring.metrics import DEVICE_TIME_SECONDS
 
 _lock = threading.Lock()
-_seen: set[tuple] = set()
+_seen: dict[tuple, str] = {}  # identity -> phase of its first sighting
+_phase_counts = {"compile": 0, "cache_hit": 0, "execute": 0}
+# persistent-cache (hits, misses) at the previous observation: the delta
+# across one bracket decides compile vs cache_hit for a first sighting
+_cache_mark: tuple[int, int] = (0, 0)
 
 
 def record(backend: str, scorer: str, mesh: str, shape_key: tuple,
            seconds: float) -> str:
     """Attribute one timed dispatch; returns the phase it was classified
-    as (``compile`` for the first sighting of this program identity,
-    ``execute`` after)."""
+    as (``compile``/``cache_hit`` for the first sighting of this program
+    identity, ``execute`` after)."""
+    from weaviate_tpu.utils import compile_cache
+
+    global _cache_mark
     ident = (backend, scorer, mesh, shape_key)
     with _lock:
-        first = ident not in _seen
-        if first:
-            _seen.add(ident)
-    phase = "compile" if first else "execute"
+        # counters read UNDER the lock: two interleaved brackets would
+        # otherwise race the mark backwards and credit one bracket's
+        # cache traffic to the other's classification. Events from a
+        # truly concurrent bracket still cross-attribute (documented
+        # heuristic), but the mark itself stays monotonic.
+        hits, misses = compile_cache.counters()
+        d_hits = hits - _cache_mark[0]
+        d_misses = misses - _cache_mark[1]
+        _cache_mark = (hits, misses)
+        if ident in _seen:
+            phase = "execute"
+        else:
+            phase = "cache_hit" if d_hits > 0 and d_misses == 0 \
+                else "compile"
+            _seen[ident] = phase
+        _phase_counts[phase] += 1
     DEVICE_TIME_SECONDS.observe(seconds, phase=phase, backend=backend,
                                 scorer=scorer, mesh=mesh)
     return phase
 
 
+def snapshot() -> dict[str, str]:
+    """Every program identity seen by this process and the phase its
+    first dispatch was classified as (the /v1/debug/compile feed)."""
+    with _lock:
+        return {
+            f"{b}/{s}/{m}/{shape}": phase
+            for (b, s, m, shape), phase in sorted(
+                _seen.items(), key=lambda kv: str(kv[0]))
+        }
+
+
+def phase_counts() -> dict[str, int]:
+    with _lock:
+        return dict(_phase_counts)
+
+
 def reset() -> None:
-    """Forget compile history (tests; a fresh process compiles afresh)."""
+    """Forget compile history (tests; a fresh process compiles afresh).
+    The cache mark re-anchors to the CURRENT counters so events from a
+    previous test never bleed into the next classification."""
+    from weaviate_tpu.utils import compile_cache
+
+    global _cache_mark
     with _lock:
         _seen.clear()
+        for k in _phase_counts:
+            _phase_counts[k] = 0
+        _cache_mark = compile_cache.counters()

@@ -377,7 +377,8 @@ DISPATCH_BATCH_SECONDS = REGISTRY.histogram(
 DEVICE_TIME_SECONDS = REGISTRY.histogram(
     "weaviate_tpu_device_time_seconds",
     "device-time attribution of fused beam dispatches by phase "
-    "(first-compile vs steady-state execute), backend, scorer and "
+    "(compile = true XLA compile, cache_hit = persistent-cache disk "
+    "deserialize, execute = steady state), backend, scorer and "
     "mesh mode — timed against the walk's existing result "
     "materialization, zero extra host syncs")
 TRACE_SPANS = REGISTRY.counter(
@@ -412,3 +413,27 @@ NODE_HBM_USED = REGISTRY.gauge(
     "weaviate_tpu_node_hbm_used_bytes",
     "per-node HBM bytes in use as advertised via gossip (the tiering "
     "accountant ledger total), by node")
+
+# persistent compilation cache + shape-bucket prewarming instruments
+# (utils/compile_cache.py + utils/prewarm.py): whether a restarted node
+# deserialized its programs off disk instead of recompiling, and how much
+# of the bucket lattice the prewarm driver covered before traffic arrived
+COMPILE_CACHE_EVENTS = REGISTRY.counter(
+    "weaviate_tpu_compile_cache_events_total",
+    "persistent-compilation-cache traffic by event (hit = executable "
+    "deserialized from disk, miss = true XLA compile that was then "
+    "written back)")
+COMPILE_CACHE_BYTES = REGISTRY.gauge(
+    "weaviate_tpu_compile_cache_bytes",
+    "on-disk size of this node's keyed persistent compilation cache "
+    "directory (refreshed on /v1/debug/compile reads)")
+PREWARM_PROGRAMS = REGISTRY.counter(
+    "weaviate_tpu_prewarm_programs_total",
+    "shape-bucket prewarm dispatches by outcome (warmed/failed/skipped) "
+    "— one per (shard, target, pow2 row bucket) lattice point the "
+    "driver compiled off the request path")
+PREWARM_SECONDS = REGISTRY.histogram(
+    "weaviate_tpu_prewarm_seconds",
+    "wall time of one prewarm run (every lattice point of one trigger: "
+    "boot, tenant promotion, or rebalance warming leg), by reason",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0))

@@ -139,6 +139,7 @@ def gather_distance(
 
 
 @functools.partial(jax.jit, static_argnames=("metric", "precision"))
+# graftlint: allow[unwarmed-jit-program] reason=construction-only neighbor-selection program; compiles during index builds, never on the serving path
 def candidate_pairwise(
     corpus: jnp.ndarray,
     candidate_ids: jnp.ndarray,
@@ -156,6 +157,7 @@ def candidate_pairwise(
 
 
 @functools.partial(jax.jit, static_argnames=("metric", "precision"))
+# graftlint: allow[unwarmed-jit-program] reason=construction-only neighbor-selection program; compiles during index builds, never on the serving path
 def vectors_pairwise(
     v: jnp.ndarray,
     metric: str,

@@ -68,6 +68,16 @@ def main() -> int:
     from weaviate_tpu.core.db import DB
 
     cfg = config_from_env()
+    # persistent compilation cache BEFORE anything can jit (DB open may
+    # compile during checkpoint replay): restarted nodes deserialize
+    # yesterday's executables instead of re-paying XLA (ROADMAP item 3,
+    # docs/compile_cache.md). Default base under the data path; env /
+    # runtime knob / kill switch override inside configure().
+    from weaviate_tpu.utils import compile_cache
+
+    compile_cache.configure(
+        compile_cache.resolve_base_dir()
+        or os.path.join(cfg["data_path"], "compile_cache"))
     db = DB(cfg["data_path"])
     oidc = None
     if cfg["oidc_enabled"]:
@@ -103,6 +113,14 @@ def main() -> int:
     RUNTIME.start()
     telemeter = Telemeter(db)
     telemeter.start()
+
+    # boot prewarm: compile the shape-bucket lattice of every open
+    # collection in the background; /v1/.well-known/ready reports
+    # ``warming: true`` until it drains so orchestrators can gate
+    # traffic on compile-free first queries
+    from weaviate_tpu.utils import prewarm
+
+    prewarm.prewarm_db(db, reason="boot", block=False)
 
     rest = RestAPI(db, auth=auth, rbac=rbac)
     rest.telemeter = telemeter

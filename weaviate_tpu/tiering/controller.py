@@ -357,6 +357,18 @@ class TieringController:
             dt, from_tier=from_tier,
             exemplar=_sp.trace_id if _sp.sampled else "")
         self._refresh_tier_gauges()
+        # compile-tax burn-down (utils/prewarm.py, gated on the compile
+        # cache opt-in): the promoted tenant's shape-bucket lattice
+        # compiles in the background so follow-up queries in ANY bucket
+        # execute — tiering's cold-first-query SLO stays compile-free.
+        # Async: the requester blocked on this promotion must not also
+        # wait out the lattice.
+        if shard.device_resident():
+            from weaviate_tpu.utils import prewarm
+
+            prewarm.prewarm_collection(
+                col, reason="promotion", shards=[f"tenant-{tenant}"],
+                block=False)
 
     def promote_for_write(self, key: TenantKey, shard) -> None:
         """Writers must be device-resident (demoted stores reject

@@ -182,3 +182,21 @@ TRACING_SAMPLE_RATE = RUNTIME.register(
 # budget on a live node and watch the eviction pass drain HBM.
 TIERING_HBM_BUDGET = RUNTIME.register(
     "tiering_hbm_budget_bytes", 0, cast=int)
+# persistent compilation cache (utils/compile_cache.py): base directory
+# for the node-local keyed cache; "" = disabled unless the
+# WEAVIATE_TPU_COMPILE_CACHE_DIR env or an explicit configure() call
+# names one. The server's composition root defaults it under the data
+# path.
+COMPILE_CACHE_DIR = RUNTIME.register("compile_cache_dir", "", cast=str)
+# shape-bucket prewarm driver (utils/prewarm.py): the pow2 row buckets
+# compiled per (shard, target vector) at boot / tenant promotion /
+# rebalance warming, and how many lattice points compile concurrently
+PREWARM_BUCKETS = RUNTIME.register("prewarm_buckets", "8,16,32,64",
+                                   cast=str)
+PREWARM_CONCURRENCY = RUNTIME.register("prewarm_concurrency", 2, cast=int)
+# 2PC finish-leg budget (cluster/node.py FINISH_BUDGET): deliberately
+# generous while first-touch apply could cold-compile; with the
+# persistent cache + prewarm in place an operator can tighten it — the
+# workaround is a knob now, not a constant
+CLUSTER_FINISH_BUDGET_S = RUNTIME.register(
+    "cluster_finish_budget_s", 10.0, cast=float)
