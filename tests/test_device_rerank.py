@@ -1,0 +1,549 @@
+"""Device-native rerank: the pluggable module tier fused into the
+one-dispatch search pipeline (ISSUE 13 acceptance).
+
+Pins the contract:
+
+* a reranked search (MaxSim module, raw AND quantized HNSW backends,
+  mesh on and off) executes as EXACTLY ONE device dispatch per batch
+  (``ops.device_beam.dispatch_count``) with zero candidate host
+  round-trips, and its top-k matches the host ``maxsim_scores``
+  reference ordering over the same candidates;
+* an unfused/host-tier rerank latches LOUDLY — counter + span event —
+  never silently;
+* ``MultiVectorIndex.search_multi`` routes through the fused stage:
+  one dispatch per batch, parity with the legacy host rescore;
+* differently-reranked requests never share a coalesced device batch
+  (the module is a jit-static arg of the batch's program);
+* the candidate token planes pay HBM rent through the tiering ledger
+  and drop/reload across demote/promote like code planes.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.index.hnsw import HNSWIndex
+from weaviate_tpu.index.multivector import MultiVectorIndex, maxsim_scores
+from weaviate_tpu.modules.device import (
+    LinearRerank,
+    MaxSimRerank,
+    RerankRequest,
+    build_device_reranker,
+)
+from weaviate_tpu.ops import device_beam as device_beam_mod
+from weaviate_tpu.schema.config import (
+    HNSWIndexConfig,
+    MultiVectorIndexConfig,
+    RerankModuleConfig,
+    SQConfig,
+    VectorIndexConfig,
+)
+
+from tests.test_compression import clustered
+
+
+def _build(rng, n=600, d=24, tmax=4, quantizer=None, module="rerank-maxsim"):
+    corpus = clustered(rng, n, d)
+    cfg = HNSWIndexConfig(
+        distance="l2-squared", ef_construction=48, max_connections=12,
+        device_beam=True, flat_search_cutoff=0, quantizer=quantizer,
+        rerank=RerankModuleConfig(module=module, max_tokens=tmax))
+    idx = HNSWIndex(d, cfg)
+    idx.add_batch(np.arange(n, dtype=np.int64), corpus)
+    # real late-interaction token sets: jittered copies of the doc vector
+    sets = [corpus[i][None, :]
+            + 0.1 * rng.standard_normal((tmax, d)).astype(np.float32)
+            for i in range(n)]
+    idx.set_tokens(np.arange(n, dtype=np.int64), sets)
+    return idx, corpus
+
+
+def _assert_matches_host_maxsim(idx, res, queries, atol=1e-3):
+    """The fused top-k must carry EXACTLY the host maxsim_scores values
+    (negated) for its ids, in descending score order."""
+    toks, mask = idx._token_store.host_planes()
+    for b in range(res.ids.shape[0]):
+        ids = res.ids[b][res.ids[b] >= 0]
+        if not len(ids):
+            continue
+        ref = maxsim_scores(queries[b][None, :], toks[ids], mask[ids])
+        assert np.allclose(-res.dists[b][: len(ids)], ref, atol=atol)
+        assert (np.diff(ref) <= 1e-4).all(), "not ordered by module score"
+
+
+# ---------------------------------------------------------------------------
+# modules + registry + config
+# ---------------------------------------------------------------------------
+
+
+def test_catalog_registry_and_config_roundtrip():
+    from weaviate_tpu.modules.registry import default_registry
+
+    reg = default_registry()
+    assert reg.has_device_reranker("rerank-maxsim")
+    assert reg.has_device_reranker("rerank-linear")
+    assert not reg.has_device_reranker("reranker-lexical")
+    assert reg.device_reranker("rerank-linear").build(w_mean=0.5).w_mean == 0.5
+    with pytest.raises(TypeError):
+        reg.device_reranker("reranker-lexical")
+
+    cfg = HNSWIndexConfig(rerank=RerankModuleConfig(
+        module="rerank-linear", max_tokens=16, params={"w_max": 2.0}))
+    cfg.validate()
+    rt = VectorIndexConfig.from_dict(cfg.to_dict())
+    assert rt.rerank.module == "rerank-linear"
+    assert rt.rerank.params == {"w_max": 2.0}
+    bad = HNSWIndexConfig(rerank=RerankModuleConfig(module="no-such"))
+    with pytest.raises(ValueError):
+        bad.validate()
+    bad2 = HNSWIndexConfig(rerank=RerankModuleConfig(
+        module="rerank-linear", params={"typo_weight": 1.0}))
+    with pytest.raises(ValueError):
+        bad2.validate()
+
+
+def test_module_hooks_match_their_host_twins(rng):
+    import jax.numpy as jnp
+
+    B, C, T, Tq, D = 2, 6, 3, 2, 8
+    qt = rng.standard_normal((B, Tq, D)).astype(np.float32)
+    qm = np.ones((B, Tq), bool)
+    qm[1, 1] = False
+    ct = rng.standard_normal((B, C, T, D)).astype(np.float32)
+    cm = rng.random((B, C, T)) > 0.3
+    cm[:, :, 0] = True
+    for mod in (MaxSimRerank(), LinearRerank(w_max=0.7, w_mean=1.1)):
+        dev = np.asarray(mod.score(jnp.asarray(qt), jnp.asarray(qm),
+                                   jnp.asarray(ct), jnp.asarray(cm)))
+        host = mod.host_score(qt, qm, ct, cm)
+        assert np.allclose(dev, host, atol=1e-4)
+    # single-query MaxSim == the multivector index's reference scorer
+    m = MaxSimRerank()
+    dev = np.asarray(m.score(jnp.asarray(qt[:1]), jnp.asarray(qm[:1]),
+                             jnp.asarray(ct[:1]), jnp.asarray(cm[:1])))
+    ref = maxsim_scores(qt[0][qm[0]], ct[0], cm[0])
+    assert np.allclose(dev[0], ref, atol=1e-4)
+
+
+def test_rerank_request_group_key():
+    a = RerankRequest(MaxSimRerank())
+    b = RerankRequest(MaxSimRerank())
+    assert a.group_key == b.group_key  # frozen modules compare equal
+    c = RerankRequest(LinearRerank())
+    assert a.group_key != c.group_key
+    d = RerankRequest(MaxSimRerank(), np.zeros((3, 8), np.float32))
+    assert d.tq_pad == 4 and a.group_key != d.group_key
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one dispatch, maxsim-reference ordering, mesh off
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("quant", [None, SQConfig(rescore_limit=40)],
+                         ids=["raw", "sq"])
+def test_fused_rerank_one_dispatch_matches_reference(rng, quant):
+    idx, corpus = _build(rng, quantizer=quant)
+    assert idx._device_beam is not None
+    q = corpus[:8] + 0.02 * rng.standard_normal((8, 24)).astype(np.float32)
+    rr = RerankRequest(MaxSimRerank())
+    before = device_beam_mod.dispatch_count()
+    res = idx.search(q, 10, rerank=rr)
+    assert device_beam_mod.dispatch_count() - before == 1, \
+        "walk + rerank must be exactly ONE device dispatch per batch"
+    _assert_matches_host_maxsim(idx, res, q)
+    from weaviate_tpu.monitoring.metrics import RERANK_REQUESTS
+
+    assert RERANK_REQUESTS.value(module="rerank-maxsim", tier="fused") >= 1
+
+
+def test_fused_rerank_filtered_allowed_only(rng):
+    idx, corpus = _build(rng)
+    q = corpus[:4]
+    allow = np.zeros(len(corpus), bool)
+    allow[::2] = True
+    before = device_beam_mod.dispatch_count()
+    res = idx.search(q, 10, rerank=RerankRequest(MaxSimRerank()),
+                     allow_list=allow)
+    assert device_beam_mod.dispatch_count() - before == 1
+    got = res.ids[res.ids >= 0]
+    assert len(got) and (got % 2 == 0).all()
+    _assert_matches_host_maxsim(idx, res, q)
+
+
+def test_second_module_is_a_distinct_ranking(rng):
+    idx, corpus = _build(rng)
+    q = corpus[:2]
+    heavy_mean = RerankRequest(build_device_reranker(
+        "rerank-linear", {"w_max": 0.0, "w_mean": 1.0}))
+    res_lin = idx.search(q, 10, rerank=heavy_mean)
+    res_max = idx.search(q, 10, rerank=RerankRequest(MaxSimRerank()))
+    assert res_lin.ids.shape == res_max.ids.shape
+    # both are valid rankings of real ids
+    assert (res_lin.ids >= 0).any() and (res_max.ids >= 0).any()
+
+
+# ---------------------------------------------------------------------------
+# fallback tier: loud, never silent
+# ---------------------------------------------------------------------------
+
+
+def test_warm_tier_fallback_latches_loudly(rng):
+    from weaviate_tpu.monitoring.metrics import (
+        RERANK_FALLBACK,
+        RERANK_REQUESTS,
+    )
+    from weaviate_tpu.monitoring.tracing import TRACER
+
+    idx, corpus = _build(rng, n=300)
+    q = corpus[:4]
+    rr = RerankRequest(MaxSimRerank())
+    fused = idx.search(q, 10, rerank=rr)
+    idx.demote_device()
+    f0 = RERANK_FALLBACK.value(module="rerank-maxsim", reason="warm_tier")
+    h0 = RERANK_REQUESTS.value(module="rerank-maxsim", tier="host")
+    prev_rate = TRACER.sample_rate
+    TRACER.sample_rate = 1.0
+    try:
+        with TRACER.span("test.rerank_fallback") as sp:
+            warm = idx.search(q, 10, rerank=rr)
+    finally:
+        TRACER.sample_rate = prev_rate
+    assert RERANK_FALLBACK.value(module="rerank-maxsim",
+                                 reason="warm_tier") > f0
+    assert RERANK_REQUESTS.value(module="rerank-maxsim", tier="host") > h0
+    trace = TRACER.recent(limit=200, trace_id=sp.trace_id)
+    assert any(e["name"] == "rerank.fallback"
+               for s in trace for e in s.get("events", ())), \
+        "fallback must land a span event — silent downgrades are banned"
+    # the host twin computes the same ordering the fused stage would
+    assert warm.ids[0][0] == fused.ids[0][0]
+    idx.promote_device()
+    again = idx.search(q, 10, rerank=rr)
+    assert again.ids[0].tolist() == fused.ids[0].tolist()
+
+
+def test_rerank_without_module_config_is_an_error(rng):
+    corpus = clustered(rng, 200, 16)
+    idx = HNSWIndex(16, HNSWIndexConfig(distance="l2-squared",
+                                        device_beam=True))
+    idx.add_batch(np.arange(200, dtype=np.int64), corpus)
+    with pytest.raises(ValueError, match="rerank"):
+        idx.search(corpus[:2], 5, rerank=RerankRequest(MaxSimRerank()))
+
+
+# ---------------------------------------------------------------------------
+# dispatcher: rerank identity joins the batch-group key
+# ---------------------------------------------------------------------------
+
+
+def test_differently_reranked_requests_never_coalesce():
+    from weaviate_tpu.index.dispatch import CoalescingDispatcher
+
+    groups: list = []
+    gate = threading.Event()
+
+    def run_batch(q, k, allow, rerank=None):
+        gate.wait(1.0)  # let both requests enqueue before draining
+        groups.append((q.shape[0],
+                       None if rerank is None else rerank[0].name))
+        b = q.shape[0]
+        return (np.zeros((b, k), np.int64), np.zeros((b, k), np.float32))
+
+    disp = CoalescingDispatcher(run_batch)
+    qs = np.zeros((1, 8), np.float32)
+    reqs = [RerankRequest(MaxSimRerank()), RerankRequest(LinearRerank()),
+            None]
+    threads = [threading.Thread(
+        target=lambda r=r: disp.search(qs, 5, rerank=r)) for r in reqs]
+    for t in threads:
+        t.start()
+    gate.set()
+    for t in threads:
+        t.join(10)
+    assert len(groups) == 3, f"expected 3 separate batches, got {groups}"
+    assert sorted(g[1] or "" for g in groups) == \
+        ["", "rerank-linear", "rerank-maxsim"]
+
+
+def test_same_module_requests_do_coalesce():
+    from weaviate_tpu.index.dispatch import CoalescingDispatcher
+
+    lock = threading.Lock()
+    batches: list = []
+    started = threading.Barrier(3)
+
+    def run_batch(q, k, allow, rerank=None):
+        with lock:
+            batches.append((q.shape[0], rerank[1].shape))
+        b = q.shape[0]
+        return (np.zeros((b, k), np.int64), np.zeros((b, k), np.float32))
+
+    disp = CoalescingDispatcher(run_batch)
+    qs = np.zeros((1, 8), np.float32)
+
+    results = []
+
+    def go():
+        # identical module + self-mode tokens -> one shared batch is
+        # ALLOWED (not guaranteed under timing, so only assert shape
+        # consistency: every batch's token rows == its query rows)
+        started.wait(5)
+        results.append(disp.search(qs, 5, rerank=RerankRequest(
+            MaxSimRerank())))
+
+    threads = [threading.Thread(target=go) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert len(results) == 3
+    for rows, qt_shape in batches:
+        assert qt_shape[0] == rows and qt_shape[1] == 1  # self mode Tq=1
+
+
+# ---------------------------------------------------------------------------
+# satellite: MultiVectorIndex routes through the fused stage
+# ---------------------------------------------------------------------------
+
+
+def test_multivector_fused_one_dispatch_and_parity(rng):
+    n, d = 300, 16
+    # explicit config: the fallback counter is gated on it (an
+    # UNconfigured multivector collection's normal host rescore must
+    # not fire the alertable counter — covered further down)
+    idx = MultiVectorIndex(d, MultiVectorIndexConfig(
+        precision="fp32",
+        rerank=RerankModuleConfig(module="rerank-maxsim")))
+    sets = [rng.standard_normal((int(rng.integers(1, 5)), d))
+            .astype(np.float32) for _ in range(n)]
+    idx.add_batch_multi(np.arange(n, dtype=np.int64), sets)
+    q = sets[7] + 0.02 * rng.standard_normal(sets[7].shape).astype(np.float32)
+
+    before = device_beam_mod.dispatch_count()
+    fused = idx.search_multi(q, 10)
+    assert device_beam_mod.dispatch_count() - before == 1, \
+        "FDE scan + MaxSim rerank must be ONE dispatch, candidates " \
+        "never round-trip to the host"
+    assert fused.ids[0, 0] == 7
+
+    # parity with the legacy host rescore on the same index
+    idx.inner.store.detach()
+    from weaviate_tpu.monitoring.metrics import RERANK_FALLBACK
+
+    f0 = RERANK_FALLBACK.value(module="rerank-maxsim", reason="warm_tier")
+    host = idx.search_multi(q, 10)
+    assert RERANK_FALLBACK.value(module="rerank-maxsim",
+                                 reason="warm_tier") > f0
+    idx.inner.store.attach()
+    assert fused.ids[0].tolist()[:5] == host.ids[0].tolist()[:5]
+    assert np.allclose(fused.dists[0][:5], host.dists[0][:5], atol=1e-3)
+
+    # an UNconfigured index's host rescore never fires the counter
+    plain = MultiVectorIndex(d, MultiVectorIndexConfig(precision="fp32"))
+    plain.add_batch_multi(np.arange(20, dtype=np.int64), sets[:20])
+    plain.inner.store.detach()
+    f1 = RERANK_FALLBACK.value(module="rerank-maxsim", reason="warm_tier")
+    plain.search_multi(q, 5)
+    assert RERANK_FALLBACK.value(module="rerank-maxsim",
+                                 reason="warm_tier") == f1
+
+
+def test_multivector_fused_respects_allow_and_delete(rng):
+    n, d = 200, 16
+    idx = MultiVectorIndex(d, MultiVectorIndexConfig(precision="fp32"))
+    sets = [rng.standard_normal((3, d)).astype(np.float32)
+            for _ in range(n)]
+    idx.add_batch_multi(np.arange(n, dtype=np.int64), sets)
+    q = sets[11]
+    allow = np.zeros(n, bool)
+    allow[1::2] = True
+    res = idx.search_multi(q, 5, allow_list=allow)
+    got = res.ids[res.ids >= 0]
+    assert len(got) and (got % 2 == 1).all()
+    idx.delete(np.asarray([11]))
+    res2 = idx.search_multi(q, 10)
+    assert 11 not in res2.ids[0].tolist()
+
+
+# ---------------------------------------------------------------------------
+# tiering: token planes pay HBM rent like code planes
+# ---------------------------------------------------------------------------
+
+
+def test_token_planes_charge_the_tiering_ledger(rng):
+    idx, corpus = _build(rng, n=300)
+    idx.search(corpus[:2], 5, rerank=RerankRequest(MaxSimRerank()))
+    stats = idx.stats()
+    assert stats["rerank_module"] == "rerank-maxsim"
+    assert stats["rerank_hbm_bytes"] > 0
+    assert idx.hbm_bytes() >= stats["rerank_hbm_bytes"]
+    freed = idx.demote_device()
+    assert freed >= stats["rerank_hbm_bytes"]
+    assert idx._token_store.nbytes == 0
+    assert idx.host_tier_bytes() >= idx._token_store.host_bytes > 0
+    idx.promote_device()
+    # first hot search re-uploads the planes lazily
+    idx.search(corpus[:2], 5, rerank=RerankRequest(MaxSimRerank()))
+    assert idx._token_store.nbytes > 0
+
+
+def test_multivector_rerank_block_annotates_not_resorts(rng):
+    """rerank{} on a multivector collection with the default/configured
+    device module annotates the fused ordering instead of silently
+    lexical-resorting it (or 500ing on the configured module name)."""
+    from weaviate_tpu.modules.registry import default_registry
+    from weaviate_tpu.query.explorer import Explorer, QueryParams
+    from weaviate_tpu.schema.config import CollectionConfig
+
+    class _Col:
+        config = CollectionConfig(
+            name="C", vector_config=MultiVectorIndexConfig())
+        modules = default_registry()
+
+    ex = Explorer(db=None)
+    p = QueryParams(collection="C", near_vector=np.zeros(4, np.float32))
+    from weaviate_tpu.query.explorer import RerankParams
+
+    p.rerank = RerankParams(query="q")  # "" = collection default
+    assert ex._rerank_inherent(_Col(), p)
+    p.rerank = RerankParams(query="q", module="rerank-maxsim")
+    assert ex._rerank_inherent(_Col(), p)
+    p.rerank = RerankParams(query="q", module="reranker-lexical")
+    assert not ex._rerank_inherent(_Col(), p)
+
+
+def test_multivector_nondefault_module_ranks_fallback_too(rng):
+    """A configured non-default module must rank the host fallback tier
+    as well — demotion must not silently change the ordering family."""
+    cfg = MultiVectorIndexConfig(
+        precision="fp32",
+        rerank=RerankModuleConfig(module="rerank-linear",
+                                  params={"w_max": 0.0, "w_mean": 1.0}))
+    idx = MultiVectorIndex(8, cfg)
+    sets = [rng.standard_normal((3, 8)).astype(np.float32)
+            for _ in range(80)]
+    idx.add_batch_multi(np.arange(80, dtype=np.int64), sets)
+    q = sets[5]
+    fused = idx.search_multi(q, 8)
+    idx.inner.store.detach()
+    host = idx.search_multi(q, 8)
+    idx.inner.store.attach()
+    assert fused.ids[0].tolist()[:4] == host.ids[0].tolist()[:4]
+
+
+def test_rerank_config_restricted_to_fusable_index_types():
+    from weaviate_tpu.schema.config import FlatIndexConfig
+
+    cfg = FlatIndexConfig(rerank=RerankModuleConfig())
+    with pytest.raises(ValueError, match="index_type"):
+        cfg.validate()
+    HNSWIndexConfig(rerank=RerankModuleConfig()).validate()
+    MultiVectorIndexConfig(rerank=RerankModuleConfig()).validate()
+
+
+def test_rerank_with_max_distance_is_a_loud_error(rng):
+    from weaviate_tpu.core.shard import Shard
+    import tempfile
+
+    # the shard-level guard: a direct caller combining the two must get
+    # an explicit error, never an unbounded result set
+    idx, corpus = _build(rng, n=200, d=16)
+    import weaviate_tpu.core.shard as shard_mod
+
+    class _FakeShard:
+        _vector_indexes = {"default": idx}
+        vector_search = Shard.vector_search
+
+    with pytest.raises(ValueError, match="max_distance"):
+        _FakeShard().vector_search(corpus[:1], 5, target="default",
+                                   max_distance=0.5,
+                                   rerank=RerankRequest(MaxSimRerank()))
+
+
+def test_device_module_on_host_path_is_a_clean_error():
+    from weaviate_tpu.modules.registry import default_registry
+    from weaviate_tpu.query.explorer import (
+        Explorer,
+        QueryResult,
+        Hit,
+        RerankParams,
+    )
+
+    class _Col:
+        modules = default_registry()
+
+    class _Obj:
+        properties = {"body": "x"}
+
+    ex = Explorer(db=None)
+    result = QueryResult(hits=[Hit(object=_Obj())])
+    with pytest.raises(ValueError, match="device rerank module"):
+        ex._apply_rerank(_Col(), result,
+                         RerankParams(query="q", module="rerank-maxsim"))
+
+
+def test_prewarm_manifest_covers_rerank_programs():
+    from weaviate_tpu.utils.prewarm import MANIFEST
+
+    assert "ops.device_beam._fused_flat_rerank" in MANIFEST
+
+
+# ---------------------------------------------------------------------------
+# acceptance: mesh ON — per-shard rerank + cross-shard merge by module
+# score, still exactly one SPMD dispatch per batch
+# ---------------------------------------------------------------------------
+
+
+class TestMeshRerank:
+    @pytest.fixture(autouse=True)
+    def _mesh(self):
+        from weaviate_tpu.parallel import runtime
+        from weaviate_tpu.parallel.mesh import make_mesh
+
+        runtime.set_mesh(make_mesh(8))
+        yield
+        runtime.reset()
+
+    def test_mesh_fused_rerank_one_dispatch_matches_reference(self, rng):
+        idx, corpus = _build(rng, n=640, d=16,
+                             quantizer=SQConfig(rescore_limit=40))
+        assert idx._mesh_partitioned, "mesh build expected"
+        q = corpus[:4] + 0.02 * rng.standard_normal(
+            (4, 16)).astype(np.float32)
+        rr = RerankRequest(MaxSimRerank())
+        before = device_beam_mod.dispatch_count()
+        res = idx.search(q, 10, rerank=rr)
+        assert device_beam_mod.dispatch_count() - before == 1, \
+            "full-mesh walk + per-shard rerank + merge must be ONE " \
+            "SPMD dispatch"
+        _assert_matches_host_maxsim(idx, res, q)
+        # quality floor vs exact MaxSim over the whole corpus: clustered
+        # data, jittered token sets — the fused pool must find most of
+        # the true top-10
+        toks, mask = idx._token_store.host_planes()
+        n = len(corpus)
+        overlap = 0.0
+        for b in range(4):
+            ref = maxsim_scores(q[b][None, :], toks[:n], mask[:n])
+            gt = set(np.argsort(-ref, kind="stable")[:10].tolist())
+            got = set(res.ids[b][res.ids[b] >= 0].tolist())
+            overlap += len(gt & got) / 10
+        assert overlap / 4 >= 0.6, overlap / 4
+
+    def test_mesh_fused_rerank_filtered(self, rng):
+        idx, corpus = _build(rng, n=640, d=16)
+        q = corpus[:2]
+        allow = np.zeros(len(corpus), bool)
+        allow[::2] = True
+        before = device_beam_mod.dispatch_count()
+        res = idx.search(q, 8, rerank=RerankRequest(MaxSimRerank()),
+                         allow_list=allow)
+        assert device_beam_mod.dispatch_count() - before == 1
+        got = res.ids[res.ids >= 0]
+        assert len(got) and (got % 2 == 0).all()
+        # no holes: plenty of allowed docs exist, so disallowed filler
+        # slots in a shard's kept track must never displace allowed
+        # candidates in the cross-shard rerank merge
+        assert (res.ids >= 0).sum(axis=1).min() == 8, res.ids

@@ -1779,6 +1779,90 @@ class TestTracerInKernel:
 
 
 # ---------------------------------------------------------------------------
+# module-hook-host-sync
+
+DEVICE_MODULE = "weaviate_tpu/modules/device/fake.py"
+
+
+class TestModuleHookHostSync:
+    def test_np_in_score_hook_flagged(self):
+        res = run("""
+            import numpy as np
+
+            class M:
+                def score(self, q, qm, c, cm):
+                    return np.asarray(q).sum()
+        """, rel=DEVICE_MODULE)
+        assert "module-hook-host-sync" in rule_ids(res)
+
+    def test_item_in_call_hook_flagged(self):
+        res = run("""
+            class M:
+                def __call__(self, q, qm, c, cm):
+                    return (q * c).sum().item()
+        """, rel=DEVICE_MODULE)
+        assert "module-hook-host-sync" in rule_ids(res)
+
+    def test_callback_in_score_hook_flagged(self):
+        res = run("""
+            import jax
+
+            class M:
+                def score(self, q, qm, c, cm):
+                    return jax.pure_callback(lambda x: x, q, q)
+        """, rel=DEVICE_MODULE)
+        assert "module-hook-host-sync" in rule_ids(res)
+
+    def test_host_score_twin_clean(self):
+        res = run("""
+            import numpy as np
+
+            class M:
+                def host_score(self, q, qm, c, cm):
+                    return np.einsum("bqd,bctd->bc", q, c)
+        """, rel=DEVICE_MODULE)
+        assert "module-hook-host-sync" not in rule_ids(res)
+
+    def test_rerank_stage_in_ops_flagged(self):
+        res = run("""
+            import numpy as np
+
+            def _rerank_stage(module, cand, tokens):
+                return np.asarray(cand)
+        """, rel=KERNEL)
+        assert "module-hook-host-sync" in rule_ids(res)
+
+    def test_non_rerank_ops_function_out_of_scope(self):
+        res = run("""
+            import numpy as np
+
+            def prep_inputs(x):
+                return np.asarray(x, np.float32)
+        """, rel=KERNEL, rules=["module-hook-host-sync"])
+        assert rule_ids(res) == []
+
+    def test_score_outside_device_dir_out_of_scope(self):
+        res = run("""
+            import numpy as np
+
+            class M:
+                def score(self, q):
+                    return np.asarray(q)
+        """, rel=COLD, rules=["module-hook-host-sync"])
+        assert rule_ids(res) == []
+
+    def test_suppression_honored(self):
+        res = run("""
+            import numpy as np
+
+            class M:
+                def score(self, q, qm, c, cm):
+                    return np.asarray(q)  # graftlint: allow[module-hook-host-sync] reason=test fixture
+        """, rel=DEVICE_MODULE)
+        assert "module-hook-host-sync" not in rule_ids(res)
+
+
+# ---------------------------------------------------------------------------
 # unwarmed-jit-program
 
 

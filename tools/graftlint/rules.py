@@ -1033,6 +1033,79 @@ class TracerInKernel(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 6d. module-hook-host-sync
+
+
+class ModuleHookHostSync(Rule):
+    id = "module-hook-host-sync"
+    description = (
+        "host sync (np.asarray/.item()/host callbacks) inside a device "
+        "module hook (modules/device/ score/__call__) or a rerank-stage "
+        "function in ops/"
+    )
+    rationale = (
+        "Device module hooks (``DeviceRerankModule.score``) and the "
+        "rerank-stage functions in ops/ are traced INSIDE the fused "
+        "search program — the whole point of the module tier is that "
+        "rerank costs one dispatch, not a host round-trip. A "
+        "``np.asarray``/``.item()`` there either breaks tracing "
+        "outright or (via a callback) reintroduces the per-query host "
+        "sync the tier exists to remove. Host-side scoring belongs in "
+        "``host_score`` (the fallback tier), never in the traced hook."
+    )
+
+    MODULE_DIR = "weaviate_tpu/modules/device/"
+    OPS_DIR = "weaviate_tpu/ops/"
+    HOOK_NAMES = ("score", "__call__")
+    # host-callback entry points: these smuggle host Python back into
+    # the compiled program even when they trace successfully
+    _CALLBACK_ATTRS = frozenset({
+        "device_get", "pure_callback", "io_callback",
+        "block_until_ready", "item",
+    })
+    _HOST_ROOTS = ("np", "numpy")
+
+    def _sync_calls(self, fn: ast.AST):
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            dn = dotted_name(n.func)
+            if dn is not None and dn.split(".")[0] in self._HOST_ROOTS:
+                yield n, f"{dn}(...) is a host-side numpy call"
+                continue
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in self._CALLBACK_ATTRS:
+                yield n, (f".{n.func.attr}() syncs (or calls back to) "
+                          "the host")
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if ctx.rel_path.startswith(self.MODULE_DIR):
+            targets = [
+                fn for fn in ctx.walk(ast.FunctionDef,
+                                      ast.AsyncFunctionDef)
+                if fn.name in self.HOOK_NAMES
+            ]
+            where = "device module hook"
+        elif ctx.rel_path.startswith(self.OPS_DIR):
+            targets = [
+                fn for fn in ctx.walk(ast.FunctionDef,
+                                      ast.AsyncFunctionDef)
+                if "rerank" in fn.name
+            ]
+            where = "rerank-stage function"
+        else:
+            return
+        for fn in targets:
+            for node, what in self._sync_calls(fn):
+                yield self.violation(
+                    ctx, node,
+                    f"{what} inside {where} {fn.name}() — the hook is "
+                    "traced into the fused search program; host-side "
+                    "math belongs in host_score (the fallback tier)",
+                )
+
+
+# ---------------------------------------------------------------------------
 # 7. suppression-missing-reason (meta-rule, emitted by the engine)
 
 
@@ -1252,6 +1325,7 @@ ALL_RULES: tuple = (
     Float64LiteralDrift(),
     LockwitnessInKernel(),
     TracerInKernel(),
+    ModuleHookHostSync(),
     LockOrderCycle(),
     BlockingUnderLock(),
     UnlockedCollectiveDispatch(),

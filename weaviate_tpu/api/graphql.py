@@ -700,6 +700,10 @@ class GraphQLExecutor:
                         params.rerank = RerankParams(
                             query=sub.args.get("query", ""),
                             property=sub.args.get("property", ""),
+                            # "" = collection default (the configured
+                            # device module when one exists); a device
+                            # module name routes the FUSED tier
+                            module=sub.args.get("module", ""),
                         )
                     elif sub.name == "summary":
                         props = sub.args.get("properties", [])

@@ -234,6 +234,13 @@ class GrpcAPI:
             raise ValueError(
                 "near_vectors and bm25_query both set without use_hybrid: "
                 "ambiguous request (set use_hybrid for fusion)")
+        if len(req.near_vectors) > 1 and req.rerank_query:
+            # the rerank path serves ONE query per request (the explorer
+            # pipeline); silently answering only near_vectors[0] would
+            # drop the rest without a trace
+            raise ValueError(
+                "rerank_query supports a single near_vector per request; "
+                "send one request per query vector")
 
         if (len(req.near_vectors) > 1 and not req.use_hybrid
                 and not req.bm25_query):
@@ -266,6 +273,16 @@ class GrpcAPI:
             max_distance=max_dist,
             target_vector=req.target_vector,
         )
+        if req.rerank_query:
+            from weaviate_tpu.query.explorer import RerankParams
+
+            # "" module = collection default — a configured device
+            # module rides the fused dispatch (docs/modules.md)
+            params.rerank = RerankParams(
+                query=req.rerank_query,
+                property=req.rerank_property,
+                module=req.rerank_module,
+            )
         if req.use_hybrid:
             params.hybrid = HybridParams(
                 query=req.bm25_query or None,
@@ -287,7 +304,10 @@ class GrpcAPI:
         result = self.explorer.get(params)
         qr = reply.results.add()
         for hit in result.hits:
-            self._add_hit(qr, hit.object, score=hit.score,
+            score = hit.score
+            if "rerank_score" in hit.additional:
+                score = hit.additional["rerank_score"]
+            self._add_hit(qr, hit.object, score=score,
                           distance=hit.distance,
                           include_vector=req.include_vector,
                           target=req.target_vector)

@@ -1074,6 +1074,7 @@ class Collection:
         tenant: str = "",
         max_distance: Optional[float] = None,
         deadline=None,
+        rerank=None,
     ) -> list[tuple[StorageObject, float]]:
         """Single-query convenience wrapper over batched scatter-gather."""
         res = self.vector_search_batch(
@@ -1084,6 +1085,7 @@ class Collection:
             tenant=tenant,
             max_distance=max_distance,
             deadline=deadline,
+            rerank=rerank,
         )
         return res[0]
 
@@ -1096,6 +1098,7 @@ class Collection:
         tenant: str = "",
         max_distance: Optional[float] = None,
         deadline=None,
+        rerank=None,
     ) -> list[list[tuple[StorageObject, float]]]:
         from weaviate_tpu.monitoring.metrics import (
             QUERIES_TOTAL,
@@ -1134,7 +1137,7 @@ class Collection:
                     deadline.require()  # filter work may have spent it
                 res = shard.vector_search(
                     queries, k, target=target, allow_list=allow,
-                    max_distance=max_distance)
+                    max_distance=max_distance, rerank=rerank)
                 tr.stage("search")
             return shard, res
 

@@ -609,6 +609,7 @@ class Shard:
         target: str = DEFAULT_VECTOR,
         allow_list: Optional[np.ndarray] = None,
         max_distance: Optional[float] = None,
+        rerank=None,
     ) -> SearchResult:
         idx = self._vector_indexes.get(target)
         if idx is None:
@@ -625,13 +626,25 @@ class Shard:
             tier="device" if idx.device_resident else "host")
         if idx.multi_vector:
             # a [Tq, D] matrix is ONE late-interaction query (token set),
-            # not a Tq-query batch; max_distance bounds the negated MaxSim
+            # not a Tq-query batch; max_distance bounds the negated
+            # MaxSim. The fused rerank stage is built in (search_multi
+            # runs FDE scan + module score as one dispatch).
             res = idx.search_multi(queries, k, allow_list)
             if max_distance is not None:
                 keep = res.dists <= max_distance
                 res = SearchResult(ids=np.where(keep, res.ids, -1),
                                    dists=np.where(keep, res.dists, np.inf))
             return res
+        if rerank is not None:
+            # fused device rerank (modules/device/): only indexes with a
+            # configured module accept the kwarg — the explorer routes
+            # here only after checking the target's config
+            if max_distance is not None:
+                raise ValueError(
+                    "rerank and max_distance cannot combine: reranked "
+                    "distances are negated module scores, not metric "
+                    "distances a bound could apply to")
+            return idx.search(queries, k, allow_list, rerank=rerank)
         if max_distance is not None:
             return idx.search_by_distance(queries, max_distance, allow_list, limit=k)
         return idx.search(queries, k, allow_list)

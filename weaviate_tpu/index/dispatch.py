@@ -53,13 +53,19 @@ from weaviate_tpu.monitoring.metrics import (
 class _Req:
     __slots__ = ("queries", "k", "allow", "mask_key", "tier_key",
                  "deadline", "event", "ids", "dists", "error", "span",
-                 "enq_t")
+                 "enq_t", "rerank")
 
     def __init__(self, queries: np.ndarray, k: int, allow, deadline=None,
-                 tier_key=None):
+                 tier_key=None, rerank=None):
         self.queries = queries
         self.k = k
         self.allow = allow
+        # fused rerank spec (modules.device.RerankRequest) or None; its
+        # group_key joins the batch grouping below — requests reranked
+        # by different modules (or differently-shaped query token sets)
+        # must never share one device batch, because the module instance
+        # is a static argument of the batch's compiled program
+        self.rerank = rerank
         # residency-tier generation (tiering/): requests enqueued against
         # different residency epochs must never share one device batch —
         # a tenant demoted (or promoted) between enqueue and drain would
@@ -90,6 +96,10 @@ class _Req:
         return self.deadline is not None and self.deadline.expired
 
 
+def _rerank_key(r: _Req):
+    return None if r.rerank is None else r.rerank.group_key
+
+
 def _masks_equal(a: _Req, b: _Req) -> bool:
     """Whether two requests may share one device batch's allow mask."""
     if a.allow is None or b.allow is None:
@@ -114,14 +124,15 @@ class CoalescingDispatcher:
         self._draining = False
 
     def search(self, queries: np.ndarray, k: int, allow=None, deadline=None,
-               tier_key=None):
+               tier_key=None, rerank=None):
         if deadline is None:
             # the serving layer's end-to-end budget rides a thread-scoped
             # context so index signatures in between stay deadline-free
             from weaviate_tpu.serving.context import current_deadline
 
             deadline = current_deadline()
-        req = _Req(queries, k, allow, deadline, tier_key=tier_key)
+        req = _Req(queries, k, allow, deadline, tier_key=tier_key,
+                   rerank=rerank)
         from weaviate_tpu.monitoring import tracing
 
         origin = tracing.current_span()
@@ -197,9 +208,11 @@ class CoalescingDispatcher:
             group = []
             rows = 0
             i = 0
+            head_rr = _rerank_key(head)
             while i < len(self._pending) and rows < self.max_batch:
                 r = self._pending[i]
                 if r.k == head.k and r.tier_key == head.tier_key \
+                        and _rerank_key(r) == head_rr \
                         and _masks_equal(head, r):
                     group.append(self._pending.pop(i))
                     rows += r.queries.shape[0]
@@ -220,6 +233,13 @@ class CoalescingDispatcher:
         parent = tracing.current_span()
         if parent is None or not parent.sampled:
             parent = sampled[0].span
+        attrs = {}
+        if group[0].rerank is not None:
+            # the fused rerank stage rides this batch's program; the
+            # module name makes its device time attributable per batch
+            # (the stage itself adds a rerank.score child event)
+            attrs["rerank"] = getattr(group[0].rerank.module, "name",
+                                      type(group[0].rerank.module).__name__)
         span = tracing.TRACER.span(
             "dispatch.batch", parent=parent,
             links=[r.span.context for r in sampled],
@@ -228,6 +248,7 @@ class CoalescingDispatcher:
             k=group[0].k, tier_key=str(group[0].tier_key),
             filtered=group[0].allow is not None,
             queue_ms=round(queue_s * 1000, 3),
+            **attrs,
         )
         return span
 
@@ -262,7 +283,21 @@ class CoalescingDispatcher:
                 q = (group[0].queries if len(group) == 1
                      else np.concatenate([r.queries for r in group], axis=0))
                 DISPATCH_DEVICE_ROWS.inc(q.shape[0])
-                ids, dists = self.run_batch(q, group[0].k, group[0].allow)
+                if group[0].rerank is not None:
+                    # per-request query token sets concatenate along the
+                    # batch rows exactly like the queries themselves
+                    # (group members share the module + Tq bucket)
+                    parts = [r.rerank.batch_for(r.queries) for r in group]
+                    rq = (parts[0][1] if len(parts) == 1 else
+                          np.concatenate([p[1] for p in parts], axis=0))
+                    rqm = (parts[0][2] if len(parts) == 1 else
+                           np.concatenate([p[2] for p in parts], axis=0))
+                    ids, dists = self.run_batch(
+                        q, group[0].k, group[0].allow,
+                        rerank=(parts[0][0], rq, rqm))
+                else:
+                    ids, dists = self.run_batch(q, group[0].k,
+                                                group[0].allow)
                 at = 0
                 for r in group:
                     n = r.queries.shape[0]

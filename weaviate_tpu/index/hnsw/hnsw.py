@@ -172,6 +172,27 @@ class HNSWIndex(VectorIndex):
                 self._device_beam = DeviceAdjacency(self.graph)
             if self._device_beam is not None:
                 self.graph.dirty_hook = self._device_beam.mark_dirty
+        # fused device rerank tier (modules/device/, docs/modules.md):
+        # a frozen module scores the walk's candidates INSIDE the fused
+        # dispatch against HBM-resident candidate token planes. Token
+        # sets default to each vector as a 1-token set (set_tokens
+        # registers real late-interaction sets); the planes pay HBM rent
+        # through this index's tiering ledger like code planes do.
+        self._rerank_module = None
+        self._token_store = None
+        rr_cfg = getattr(self.config, "rerank", None)
+        if rr_cfg is not None and rr_cfg.enabled:
+            from weaviate_tpu.modules.device import (
+                CandidateTokenStore,
+                build_device_reranker,
+            )
+
+            self._rerank_module = build_device_reranker(
+                rr_cfg.module, rr_cfg.params)
+            self._token_store = CandidateTokenStore(
+                dims, max_tokens=rr_cfg.max_tokens,
+                cap_fn=self.backend.device_plane_capacity,
+                mesh=getattr(self.backend, "mesh", None))
 
     # ------------------------------------------------------------------
     # persistence: condensed-graph snapshot (reference commit_logger.go
@@ -435,6 +456,12 @@ class HNSWIndex(VectorIndex):
         if len(doc_ids) == 0:
             return
         self.backend.put(doc_ids, vectors)
+        if self._token_store is not None:
+            # default token sets: the vector itself (1-token), written
+            # as one [m, 1, D] block so the store takes its vectorized
+            # path; callers with real late-interaction sets override
+            # via set_tokens
+            self._token_store.put(doc_ids, vectors[:, None, :])
         self.graph.ensure_capacity(int(doc_ids.max()) + 1)
         # a re-added tombstoned id is a fresh vector at an old id: drop the
         # stale node so it re-inserts with edges for the new vector
@@ -810,10 +837,22 @@ class HNSWIndex(VectorIndex):
     def delete(self, doc_ids: np.ndarray) -> None:
         doc_ids = np.asarray(doc_ids, np.int64)
         self.backend.delete(doc_ids)
+        if self._token_store is not None:
+            self._token_store.delete(doc_ids)
         for d in doc_ids:
             self.graph.add_tombstone(int(d))
         if self._commitlog is not None:
             self._commitlog.flush_soft()
+
+    def set_tokens(self, doc_ids: np.ndarray, token_sets: list) -> None:
+        """Register late-interaction token sets for the rerank tier
+        (overrides the 1-token default add_batch stores). Requires a
+        configured rerank module."""
+        if self._token_store is None:
+            raise ValueError(
+                "set_tokens requires a rerank module configured on this "
+                "index (HNSWIndexConfig.rerank)")
+        self._token_store.put(np.asarray(doc_ids, np.int64), token_sets)
 
     def cleanup_tombstones(self) -> int:
         """Rewire edges around tombstoned nodes, then drop them.
@@ -890,6 +929,7 @@ class HNSWIndex(VectorIndex):
         queries: np.ndarray,
         k: int,
         allow_list: Optional[np.ndarray] = None,
+        rerank=None,
     ) -> SearchResult:
         # a tiering demote/promote between the residency check and the
         # array access (here, in the dispatcher's leader, or in the host
@@ -897,14 +937,68 @@ class HNSWIndex(VectorIndex):
         # retry re-enqueues under the NEW residency epoch's tier_key
         from weaviate_tpu.index.base import run_tier_stable
 
+        if rerank is not None and self._token_store is None:
+            raise ValueError(
+                "rerank requested but no rerank module is configured on "
+                "this index (HNSWIndexConfig.rerank)")
         return run_tier_stable(
-            lambda: self._search_tiered(queries, k, allow_list))
+            lambda: self._search_tiered(queries, k, allow_list, rerank))
+
+    def _fetch_width(self, k: int, ef: int) -> int:
+        """THE over-fetch policy (reference hnsw/search.go:184
+        shouldRescore): the candidate pool width the rescore tier AND
+        the rerank stage promote from — one owner, so the device walk,
+        host-walk fallback, and rerank pools can never silently
+        diverge."""
+        fetch = max(k, min(ef, 2 * k))
+        if self.backend.quantized:
+            rl = getattr(self.backend.quantizer.config, "rescore_limit", 0)
+            fetch = min(ef, max(fetch, rl, 2 * k))
+        return fetch
+
+    def _host_rerank_topk(self, rerank_batch, cand_ids: np.ndarray,
+                          k: int, reason: str
+                          ) -> tuple[np.ndarray, np.ndarray]:
+        """Host fallback tier for the rerank stage: score the candidate
+        pool against the token store's HOST planes with the module's
+        numpy twin. Latches LOUDLY — counter + span event — never
+        silently (acceptance contract, docs/modules.md)."""
+        from weaviate_tpu.monitoring import tracing
+        from weaviate_tpu.monitoring.metrics import (
+            RERANK_FALLBACK,
+            RERANK_REQUESTS,
+        )
+
+        module, rq, rqm = rerank_batch
+        name = getattr(module, "name", type(module).__name__)
+        RERANK_REQUESTS.inc(module=name, tier="host")
+        RERANK_FALLBACK.inc(module=name, reason=reason)
+        tracing.add_event("rerank.fallback", module=name, reason=reason)
+        toks, mask = self._token_store.host_planes()
+        cand_ids = np.asarray(cand_ids, np.int64)
+        inside = (cand_ids >= 0) & (cand_ids < toks.shape[0])
+        safe = np.clip(cand_ids, 0, toks.shape[0] - 1)
+        ct = toks[safe]
+        cm = mask[safe] & inside[:, :, None]
+        scores = module.host_score(rq, rqm, ct, cm)
+        scores = np.where(inside, scores, -np.inf)
+        order = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+        ids = np.take_along_axis(cand_ids, order, axis=1)
+        s = np.take_along_axis(scores, order, axis=1)
+        ids = np.where(np.isfinite(s), ids, -1)
+        d = np.where(np.isfinite(s), -s, _INF).astype(np.float32)
+        if ids.shape[1] < k:
+            pad = k - ids.shape[1]
+            ids = np.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
+            d = np.pad(d, ((0, 0), (0, pad)), constant_values=_INF)
+        return ids.astype(np.int64), d
 
     def _search_tiered(
         self,
         queries: np.ndarray,
         k: int,
         allow_list: Optional[np.ndarray] = None,
+        rerank=None,
     ) -> SearchResult:
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         if queries.shape[-1] != self.backend.dims:
@@ -928,7 +1022,14 @@ class HNSWIndex(VectorIndex):
             from weaviate_tpu.monitoring.tracing import TRACER
 
             with TRACER.span("tiering.host_search", rows=b, k=k):
-                d, ids = self.backend.host_topk(queries, k, allow_list)
+                if rerank is not None:
+                    fetch = self._fetch_width(k, self._dynamic_ef(k))
+                    _, ids = self.backend.host_topk(
+                        queries, fetch, allow_list)
+                    ids, d = self._host_rerank_topk(
+                        rerank.batch_for(queries), ids, k, "warm_tier")
+                else:
+                    d, ids = self.backend.host_topk(queries, k, allow_list)
             return SearchResult(ids=ids, dists=d)
 
         # Filtered-search triage (reference SWEEPING/ACORN/RRE pick,
@@ -946,6 +1047,13 @@ class HNSWIndex(VectorIndex):
                     or n_allowed <= k
                     or n_allowed <= self.config.filter_flat_selectivity
                     * live):
+                if rerank is not None:
+                    fetch = self._fetch_width(k, self._dynamic_ef(k))
+                    _, ids = self.backend.flat_topk(
+                        queries, fetch, allow_list)
+                    ids, d = self._host_rerank_topk(
+                        rerank.batch_for(queries), ids, k, "flat_triage")
+                    return SearchResult(ids=ids, dists=d)
                 return self._flat_filtered(queries, k, allow_list)
 
         # batch-group key: residency epoch PLUS the mesh mirror's
@@ -962,15 +1070,23 @@ class HNSWIndex(VectorIndex):
             queries, k, allow_list,
             tier_key=(self._residency_epoch,
                       getattr(self._device_beam, "epoch", 0),
-                      isolation_key()))
+                      isolation_key()),
+            rerank=rerank)
         return SearchResult(ids=ids, dists=d)
 
-    def _run_search_batch(self, queries: np.ndarray, k: int, allow_list):
-        """Single-flight batch runner behind the coalescing dispatcher."""
+    def _run_search_batch(self, queries: np.ndarray, k: int, allow_list,
+                          rerank=None):
+        """Single-flight batch runner behind the coalescing dispatcher.
+        ``rerank``: (module, q_tokens [B, Tq, D], q_mask) concatenated by
+        the leader across the coalesced group, or None."""
         if not self.backend.device_resident:
             # a demotion landed while this group was queued: the leader
             # re-routes the whole batch to the warm host tier instead of
             # touching (now-detached) device arrays
+            if rerank is not None:
+                fetch = self._fetch_width(k, self._dynamic_ef(k))
+                _, ids = self.backend.host_topk(queries, fetch, allow_list)
+                return self._host_rerank_topk(rerank, ids, k, "warm_tier")
             d, ids = self.backend.host_topk(queries, k, allow_list)
             return ids, d
         b = queries.shape[0]
@@ -980,7 +1096,11 @@ class HNSWIndex(VectorIndex):
         out_d = np.full((b, k), _INF, np.float32)
         for s in range(0, b, sub_b):
             e = min(b, s + sub_b)
-            ids, d = self._search_one_batch(queries[s:e], k, allow_list)
+            sub_rr = rerank
+            if rerank is not None and (s or e < b):
+                sub_rr = (rerank[0], rerank[1][s:e], rerank[2][s:e])
+            ids, d = self._search_one_batch(queries[s:e], k, allow_list,
+                                            rerank=sub_rr)
             out_ids[s:e], out_d[s:e] = ids, d
         return out_ids, out_d
 
@@ -997,14 +1117,15 @@ class HNSWIndex(VectorIndex):
             keep &= al[:cap]
         return keep
 
-    def _search_one_batch(self, queries, k, allow_list):
+    def _search_one_batch(self, queries, k, allow_list, rerank=None):
         b = queries.shape[0]
         qdev = self._qdev(queries)
         ef = self._dynamic_ef(k)
         if self._device_beam is not None:
             # fused walk: greedy descent + layer-0 beam in ONE dispatch
             # (the host per-level loop below is the fallback tier)
-            out = self._device_beam_search(queries, qdev, ef, k, allow_list)
+            out = self._device_beam_search(queries, qdev, ef, k, allow_list,
+                                           rerank=rerank)
             if out is not None:
                 return out
         if self._mesh_partitioned:
@@ -1013,6 +1134,10 @@ class HNSWIndex(VectorIndex):
             # silently drop 7/8ths of the corpus. The correct fallback
             # (mesh kernel unavailable / unfitted quantizer / latched)
             # is the exact sharded flat scan — still one dispatch.
+            if rerank is not None:
+                fetch = self._fetch_width(k, ef)
+                _, ids = self.backend.flat_topk(queries, fetch, allow_list)
+                return self._host_rerank_topk(rerank, ids, k, "host_walk")
             d, ids = self.backend.flat_topk(queries, k, allow_list)
             return ids, d
         eps = np.full(b, self.graph.entrypoint, np.int64)
@@ -1020,18 +1145,21 @@ class HNSWIndex(VectorIndex):
         for level in range(self.graph.max_level, 0, -1):
             eps = self._greedy_step_until_stable(qdev, eps, level, all_active)
         keep = self._keep_mask(allow_list)
-        keep_k = max(k, min(ef, 2 * k))
-        if self.backend.quantized:
-            # over-fetch so the exact rescore tier has candidates to promote
-            # (reference hnsw/search.go:184 shouldRescore)
-            rl = getattr(self.backend.quantizer.config, "rescore_limit", 0)
-            keep_k = min(ef, max(keep_k, rl, 2 * k))
+        # over-fetch so the exact rescore tier has candidates to promote
+        # (reference hnsw/search.go:184 shouldRescore); ONE owner of the
+        # policy — the device walk and rerank pool use the same width
+        keep_k = self._fetch_width(k, ef)
         _, _, kept_ids, kept_d = self._search_level(
             qdev, eps, ef, 0, keep_mask=keep, keep_k=keep_k
         )
+        if rerank is not None:
+            # host-walk fallback: the kept candidates feed the module's
+            # numpy twin instead of the fused stage
+            return self._host_rerank_topk(rerank, kept_ids, k, "host_walk")
         return self.backend.rescore_topk(queries, kept_ids, kept_d, k)
 
-    def _device_beam_search(self, queries, qdev, ef, k, allow_list=None):
+    def _device_beam_search(self, queries, qdev, ef, k, allow_list=None,
+                            rerank=None):
         """Full entrypoint→layer-0 walk in ONE device dispatch: the fused
         kernel runs the upper-layer greedy descent AND the layer-0 beam
         (``ops/device_beam.py``), gather-scoring the backend's HBM arrays
@@ -1054,12 +1182,11 @@ class HNSWIndex(VectorIndex):
             return None
         # over-fetch width for the rescore tier (reference
         # hnsw/search.go:184 shouldRescore): raw distances are exact so
-        # k suffices; code-space walks promote from a wider candidate set
-        fetch = max(k, min(ef, 2 * k))
-        if self.backend.quantized:
-            rl = getattr(self.backend.quantizer.config, "rescore_limit", 0)
-            fetch = min(ef, max(fetch, rl, 2 * k))
+        # k suffices; code-space walks promote from a wider candidate
+        # set — same policy owner as the host walk and rerank pool
+        fetch = self._fetch_width(k, ef)
         mesh_mirror = self._mesh_mirror()
+        rr_name = ""  # set for real below; the except path may read it
         try:
             import jax.numpy as jnp
 
@@ -1083,6 +1210,24 @@ class HNSWIndex(VectorIndex):
                 if len(al) < cap:
                     al = np.pad(al, (0, cap - len(al)))
                 al_pad = al[:cap]
+            fetch_pad = min(ef_pad, 1 << max(3, (int(fetch) - 1).bit_length()))
+            rr_args: dict = {}
+            rr_name = ""
+            if rerank is not None:
+                # fused rerank stage: candidate token planes ride the
+                # same dispatch; query token sets pad like the queries
+                module, rq, rqm = rerank
+                rr_name = getattr(module, "name", type(module).__name__)
+                toks, tmask = self._token_store.sync(min_rows=cap)
+                if b_pad != b:
+                    rq = np.concatenate(
+                        [rq, np.repeat(rq[:1], b_pad - b, axis=0)])
+                    rqm = np.concatenate(
+                        [rqm, np.repeat(rqm[:1], b_pad - b, axis=0)])
+                rr_args = dict(rerank=module, rerank_k=fetch_pad,
+                               rerank_q=jnp.asarray(rq),
+                               rerank_qmask=jnp.asarray(rqm),
+                               rerank_tokens=toks, rerank_tmask=tmask)
             import time as _time
 
             t_dev = _time.perf_counter()
@@ -1098,42 +1243,47 @@ class HNSWIndex(VectorIndex):
                 from weaviate_tpu.parallel.mesh import SHARD_AXIS
 
                 seeds = mesh_mirror.sync_seeds()
-                fetch_pad = min(
-                    ef_pad, 1 << max(3, (int(fetch) - 1).bit_length()))
                 if al_pad is not None:
                     allow_j = jax.device_put(
                         al_pad, NamedSharding(
                             mesh_mirror.mesh, P(SHARD_AXIS)))
-                    _, _, ids, d = device_search_mesh(
+                    out = device_search_mesh(
                         scorer, q, operands, adj, present,
                         mesh_mirror.mesh, ef=ef_pad,
                         max_steps=int(4 * ef_pad + 64), fetch=fetch_pad,
                         seeds=seeds, upper_adj=upper_adj,
                         upper_slots=upper_slots, allow=allow_j,
-                        keep_k=fetch_pad)
+                        keep_k=fetch_pad, **rr_args)
+                    # with rerank the mesh merge ranks by module score
+                    # and returns just (ids, neg_scores); unfused
+                    # filtered walks return the 4-tuple kept track
+                    ids, d = out if len(out) == 2 else out[2:]
                 else:
                     ids, d = device_search_mesh(
                         scorer, q, operands, adj, present,
                         mesh_mirror.mesh, ef=ef_pad,
                         max_steps=int(4 * ef_pad + 64), fetch=fetch_pad,
                         seeds=seeds, upper_adj=upper_adj,
-                        upper_slots=upper_slots)
+                        upper_slots=upper_slots, **rr_args)
             elif al_pad is not None:
                 eps = np.full(b_pad, self.graph.entrypoint, np.int32)
-                keep_k = 1 << max(3, (int(fetch) - 1).bit_length())
-                _, _, ids, d = device_search(
+                out = device_search(
                     scorer, q, operands, adj, present, eps,
                     ef=ef_pad, max_steps=int(4 * ef_pad + 64),
                     upper_adj=upper_adj, upper_slots=upper_slots,
-                    allow=jnp.asarray(al_pad), keep_k=keep_k,
+                    allow=jnp.asarray(al_pad), keep_k=fetch_pad,
+                    **rr_args,
                 )
+                ids, d = out[2:]
             else:
                 eps = np.full(b_pad, self.graph.entrypoint, np.int32)
-                ids, d = device_search(
+                out = device_search(
                     scorer, q, operands, adj, present, eps,
                     ef=ef_pad, max_steps=int(4 * ef_pad + 64),
                     upper_adj=upper_adj, upper_slots=upper_slots,
+                    **rr_args,
                 )
+                ids, d = out if len(out) == 2 else out[2:]
             # graftlint: allow[host-sync-in-hot-path] reason=final beam materialization
             ids = np.asarray(ids)[:b].astype(np.int64)
             # graftlint: allow[host-sync-in-hot-path] reason=final beam materialization
@@ -1153,7 +1303,11 @@ class HNSWIndex(VectorIndex):
             phase = devtime.record(
                 backend=type(self.backend).__name__,
                 scorer=type(scorer).__name__, mesh=mesh_mode,
-                shape_key=(b_pad, ef_pad, al_pad is not None),
+                # the rerank module is a jit-static arg: its variant is
+                # a DISTINCT program identity whose first dispatch pays
+                # its own compile — it must not masquerade as a warm
+                # execute of the plain walk
+                shape_key=(b_pad, ef_pad, al_pad is not None, rr_name),
                 seconds=dt_dev)
             tracing.annotate(
                 device_execute_ms=round(dt_dev * 1000, 3),
@@ -1169,6 +1323,19 @@ class HNSWIndex(VectorIndex):
                 DEVICE_BEAM_FALLBACK.inc(kind="search", mode="transient")
                 logging.getLogger("weaviate_tpu.hnsw").warning(
                     "device beam failed (transient, falling back): %s", e)
+            elif rerank is not None:
+                # a rerank-STAGE failure (token-plane sync, query-token
+                # dims mismatch in the fused einsum) says nothing about
+                # the plain walk — never latch the whole beam off for
+                # it; this query serves from the host rerank tier
+                from weaviate_tpu.monitoring.metrics import RERANK_FALLBACK
+
+                DEVICE_BEAM_FALLBACK.inc(kind="search", mode="transient")
+                RERANK_FALLBACK.inc(module=rr_name or "unknown",
+                                    reason="fused_error")
+                logging.getLogger("weaviate_tpu.hnsw").warning(
+                    "fused rerank stage failed (host tier serves this "
+                    "query): %s", e)
             else:
                 # never lowered successfully on this backend: latch off
                 DEVICE_BEAM_FALLBACK.inc(kind="search", mode="latched")
@@ -1184,10 +1351,32 @@ class HNSWIndex(VectorIndex):
         order = np.argsort(d, axis=1, kind="stable")[:, :fetch]
         d = np.take_along_axis(d, order, axis=1)
         ids = np.take_along_axis(ids, order, axis=1)
-        # rescore tier: exact promotion for quantized walks, truncation
-        # for raw ones (distances already exact)
-        ids, d = self.backend.rescore_topk(queries, ids, d, k)
-        ids = ids.astype(np.int64)
+        if rerank is not None:
+            # the module score IS the final ordering (d = negated score;
+            # the stable sort above only re-packed keep-filtered slots) —
+            # no second rescore tier. Observability: the batch span (the
+            # active span here — the dispatcher leader runs this inside
+            # it) gains the rerank.score child event, and the instruments
+            # make fused-vs-fallback traffic alertable per module.
+            from weaviate_tpu.monitoring import tracing
+            from weaviate_tpu.monitoring.metrics import (
+                RERANK_CANDIDATES,
+                RERANK_REQUESTS,
+            )
+
+            RERANK_REQUESTS.inc(module=rr_name, tier="fused")
+            # b and fetch_pad are python ints (shape metadata, no sync)
+            n_scored = b * fetch_pad
+            RERANK_CANDIDATES.observe(n_scored, module=rr_name)
+            tracing.add_event("rerank.score", module=rr_name,
+                              candidates=fetch_pad, rows=b)
+            ids = ids[:, :k].astype(np.int64)
+            d = d[:, :k].astype(np.float32)
+        else:
+            # rescore tier: exact promotion for quantized walks,
+            # truncation for raw ones (distances already exact)
+            ids, d = self.backend.rescore_topk(queries, ids, d, k)
+            ids = ids.astype(np.int64)
         if d.shape[1] < k:
             pad = k - d.shape[1]
             d = np.pad(d, ((0, 0), (0, pad)), constant_values=_INF)
@@ -1215,15 +1404,29 @@ class HNSWIndex(VectorIndex):
 
     # ------------------------------------------------------------------
     def save_vectors(self, path: str, meta: Optional[dict] = None) -> bool:
-        if self.store is None:  # quantized backend: codes rebuild from store
+        if self.store is None:  # quantized backend: codes rebuild from source
             return False
         self.store.save(path, meta)
+        if self._token_store is not None:
+            # the rerank tier's token planes checkpoint alongside the
+            # corpus — a restored index reranking against empty masks
+            # would be silently wrong ordering
+            self._token_store.save(path)
         return True
 
     def load_vectors(self, path: str) -> Optional[dict]:
         if self.store is None:
             return None
-        return self.store.load(path)
+        meta = self.store.load(path)
+        if meta is None:
+            return None
+        if self._token_store is not None \
+                and not self._token_store.load(path):
+            # corpus without its token sidecar (older checkpoint / torn
+            # write): half a checkpoint is no checkpoint — the caller's
+            # rebuild path re-adds vectors and repopulates the planes
+            return None
+        return meta
 
     def count(self) -> int:
         return self.graph.node_count
@@ -1244,10 +1447,17 @@ class HNSWIndex(VectorIndex):
         n = self.backend.hbm_bytes()
         if self._device_beam is not None:
             n += self._device_beam.nbytes
+        if self._token_store is not None:
+            # the rerank tier's candidate token planes pay HBM rent
+            # through the same ledger as code planes (docs/modules.md)
+            n += self._token_store.nbytes
         return n
 
     def host_tier_bytes(self) -> int:
-        return self.backend.host_tier_bytes()
+        n = self.backend.host_tier_bytes()
+        if self._token_store is not None:
+            n += self._token_store.host_bytes
+        return n
 
     def demote_device(self) -> int:
         """Warm demotion: corpus/codes to host RAM + the beam's mirrored
@@ -1257,6 +1467,8 @@ class HNSWIndex(VectorIndex):
         freed = self.backend.demote_device()
         if self._device_beam is not None:
             freed += self._device_beam.drop_device()
+        if self._token_store is not None:
+            freed += self._token_store.drop_device()
         if freed:
             self._residency_epoch += 1
         return freed
@@ -1292,6 +1504,10 @@ class HNSWIndex(VectorIndex):
             # presence mask, and compact upper-layer tables
             s["device_beam"] = True
             s["device_beam_hbm_bytes"] = self._device_beam.nbytes
+        if self._rerank_module is not None:
+            s["rerank_module"] = self._rerank_module.name
+            s["rerank_hbm_bytes"] = self._token_store.nbytes
+            s["rerank_host_bytes"] = self._token_store.host_bytes
         mirror = self._mesh_mirror()
         if mirror is not None:
             s["mesh_shards"] = mirror.n

@@ -172,8 +172,34 @@ class MultiVectorIndex(VectorIndex):
             flat_approx_recall=self.config.flat_approx_recall,
         )
         self.inner = FlatIndex(self.encoder.fde_dim, inner_cfg)
-        # host token store for the exact rescore tier (doc_id -> [T, D])
-        self._tokens: dict[int, np.ndarray] = {}
+        # device rerank tier (modules/device/): the exact MaxSim rescore
+        # IS a rerank module here, fused with the FDE candidate scan into
+        # ONE dispatch (ops/device_beam.fused_flat_rerank) — candidates
+        # never round-trip to the host. config.rerank swaps the module.
+        # The token store's host planes are the ONE host copy of the
+        # token sets (rescore fallback + checkpoint both read them).
+        from weaviate_tpu.modules.device import (
+            CandidateTokenStore,
+            build_device_reranker,
+        )
+
+        rr_cfg = getattr(self.config, "rerank", None)
+        # explicit config vs the built-in default matters for the
+        # fallback COUNTER only: an operator alerting on rerank
+        # fallbacks must not see every unconfigured multivector
+        # collection's normal host rescore firing the alert
+        self._rerank_explicit = rr_cfg is not None and rr_cfg.enabled
+        if self._rerank_explicit:
+            self._rerank_module = build_device_reranker(
+                rr_cfg.module, rr_cfg.params)
+            tmax = rr_cfg.max_tokens
+        else:
+            self._rerank_module = build_device_reranker("rerank-maxsim")
+            tmax = 8
+        self._token_store = CandidateTokenStore(
+            dims, max_tokens=tmax,
+            cap_fn=lambda: self.inner.store.capacity,
+            mesh=self.inner.store.mesh)
 
     multi_vector = True
 
@@ -186,10 +212,21 @@ class MultiVectorIndex(VectorIndex):
                       for t in token_sets]
         # tokens BEFORE the candidate index: a racing search that sees the
         # new id in the FDE corpus must find its rescore tokens
-        for d, t in zip(doc_ids, token_sets):
-            self._tokens[int(d)] = t
+        self._token_store.put(np.asarray(doc_ids, np.int64), token_sets)
         fdes = np.stack([self.encoder.encode_doc(t) for t in token_sets])
         self.inner.add_batch(np.asarray(doc_ids, np.int64), fdes)
+
+    def _host_token_set(self, doc_id: int) -> Optional[np.ndarray]:
+        """The exact (unpadded) token set for one doc from the host
+        planes, or None when absent/deleted (mask rows are prefix-True,
+        so the mask slice reconstructs the original shape)."""
+        toks, mask = self._token_store.host_planes()
+        if doc_id >= toks.shape[0]:
+            return None
+        m = mask[doc_id]
+        if not m.any():
+            return None
+        return toks[doc_id][m]
 
     def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
         """Single-vector adds are degenerate token sets of size 1."""
@@ -198,14 +235,16 @@ class MultiVectorIndex(VectorIndex):
 
     def delete(self, doc_ids: np.ndarray) -> None:
         self.inner.delete(doc_ids)
-        for d in np.asarray(doc_ids).reshape(-1):
-            self._tokens.pop(int(d), None)
+        self._token_store.delete(np.asarray(doc_ids).reshape(-1))
 
     # -- search ---------------------------------------------------------------
     def search_multi(self, query_tokens: np.ndarray, k: int,
                      allow_list: Optional[np.ndarray] = None) -> SearchResult:
-        """query_tokens [Tq, D] -> top-k by exact MaxSim over the FDE
-        candidates (rescore_limit-wide)."""
+        """query_tokens [Tq, D] -> top-k by the rerank module (exact
+        MaxSim by default) over the FDE candidates (rescore_limit-wide).
+        Device-resident single-chip stores run FDE scan + module score +
+        top-k as ONE fused dispatch — candidates never visit the host;
+        the legacy host rescore remains the (loud) fallback tier."""
         query_tokens = np.atleast_2d(np.asarray(query_tokens, np.float32))
         if query_tokens.shape[-1] != self.dims:
             raise ValueError(
@@ -213,6 +252,18 @@ class MultiVectorIndex(VectorIndex):
         fde = self.encoder.encode_query(query_tokens)[None, :]
         cand_k = max(k, self.config.rescore_limit or 4 * k)
         cand_k = min(cand_k, max(1, self.inner.count()))
+        if self.inner.store.device_resident and self.inner.store.mesh is None:
+            res = self._search_multi_fused(query_tokens, fde, cand_k, k,
+                                           allow_list)
+            if res is not None:
+                return res
+        elif self._rerank_explicit:
+            from weaviate_tpu.monitoring.metrics import RERANK_FALLBACK
+
+            RERANK_FALLBACK.inc(
+                module=self._rerank_module.name,
+                reason="mesh_legacy" if self.inner.store.mesh is not None
+                else "warm_tier")
         res = self.inner.search(fde, cand_k, allow_list)
         cand = res.ids[0]
         cand = cand[cand >= 0]
@@ -223,7 +274,7 @@ class MultiVectorIndex(VectorIndex):
         sets = []
         kept = []
         for d in cand:
-            t = self._tokens.get(int(d))
+            t = self._host_token_set(int(d))
             if t is not None:
                 sets.append(t)
                 kept.append(int(d))
@@ -237,7 +288,17 @@ class MultiVectorIndex(VectorIndex):
         for i, s in enumerate(sets):
             toks[i, : s.shape[0]] = s
             mask[i, : s.shape[0]] = True
-        scores = maxsim_scores(query_tokens, toks, mask)
+        if self._rerank_module.name == "rerank-maxsim":
+            # the default module IS this scorer — keep the (possibly
+            # mesh-sharded, device-accelerated) implementation
+            scores = maxsim_scores(query_tokens, toks, mask)
+        else:
+            # a configured non-default module must rank the fallback
+            # tier too, or demotion would silently change the ordering
+            # (docs/modules.md: the fallback runs the host_score twin)
+            qm = np.ones((1, query_tokens.shape[0]), bool)
+            scores = self._rerank_module.host_score(
+                query_tokens[None], qm, toks[None], mask[None])[0]
         order = np.argsort(-scores, kind="stable")[:k]
         ids = np.full((1, k), -1, np.int64)
         d = np.full((1, k), np.inf, np.float32)
@@ -245,6 +306,73 @@ class MultiVectorIndex(VectorIndex):
         # present as a distance: negated MaxSim (lower = better)
         d[0, : len(order)] = -scores[order]
         return SearchResult(ids=ids, dists=d)
+
+    def _search_multi_fused(self, query_tokens: np.ndarray,
+                            fde: np.ndarray, cand_k: int, k: int,
+                            allow_list: Optional[np.ndarray]
+                            ) -> Optional[SearchResult]:
+        """ONE dispatch: FDE scan → gather candidate token planes →
+        module score → on-device top-k (``ops/device_beam.
+        fused_flat_rerank``). Returns None to use the host path (the
+        caller latches the fallback counter)."""
+        import jax.numpy as jnp
+
+        from weaviate_tpu.monitoring import tracing
+        from weaviate_tpu.monitoring.metrics import (
+            RERANK_CANDIDATES,
+            RERANK_FALLBACK,
+            RERANK_REQUESTS,
+        )
+        from weaviate_tpu.ops.device_beam import fused_flat_rerank
+
+        name = self._rerank_module.name
+        corpus, valid, _sqnorms = self.inner.store.snapshot()
+        cap = int(corpus.shape[0])
+        toks, tmask = self._token_store.sync(min_rows=cap)
+        tq = query_tokens.shape[0]
+        tq_pad = 1 << max(0, (tq - 1).bit_length())
+        qt = np.zeros((1, tq_pad, self.dims), np.float32)
+        qt[0, :tq] = query_tokens
+        qm = np.zeros((1, tq_pad), bool)
+        qm[0, :tq] = True
+        allow_j = None
+        if allow_list is not None:
+            al = np.asarray(allow_list, bool)
+            if len(al) < cap:
+                al = np.pad(al, (0, cap - len(al)))
+            allow_j = jnp.asarray(al[:cap])
+        # pow2 buckets so steady traffic shares a handful of compiles
+        fetch = 1 << max(3, (int(cand_k) - 1).bit_length())
+        out_k = min(1 << max(3, (int(k) - 1).bit_length()), fetch)
+        try:
+            ids_j, d_j = fused_flat_rerank(
+                self._rerank_module, jnp.asarray(fde), corpus, valid,
+                jnp.asarray(qt), jnp.asarray(qm), toks, tmask,
+                fetch=fetch, k=out_k, allow=allow_j, metric="dot",
+                precision=self.config.precision)
+            # graftlint: allow[host-sync-in-hot-path] reason=final reranked top-k materialization
+            ids = np.asarray(ids_j)[0].astype(np.int64)
+            # graftlint: allow[host-sync-in-hot-path] reason=final reranked top-k materialization
+            d = np.asarray(d_j)[0].astype(np.float32)
+        except Exception as e:
+            import logging
+
+            RERANK_FALLBACK.inc(module=name, reason="fused_error")
+            logging.getLogger("weaviate_tpu.multivector").warning(
+                "fused multivector rerank failed (host path serves this "
+                "query): %s", e)
+            return None
+        RERANK_REQUESTS.inc(module=name, tier="fused")
+        RERANK_CANDIDATES.observe(float(fetch), module=name)
+        tracing.add_event("rerank.score", module=name,
+                          candidates=int(fetch), rows=1)
+        out_ids = np.full((1, k), -1, np.int64)
+        out_d = np.full((1, k), np.inf, np.float32)
+        n_out = min(k, len(ids))
+        out_ids[0, :n_out] = ids[:n_out]
+        out_d[0, :n_out] = d[:n_out]
+        out_ids[0][~np.isfinite(out_d[0])] = -1
+        return SearchResult(ids=out_ids, dists=out_d)
 
     def search(self, queries: np.ndarray, k: int,
                allow_list: Optional[np.ndarray] = None) -> SearchResult:
@@ -266,20 +394,25 @@ class MultiVectorIndex(VectorIndex):
 
     # -- checkpoint ----------------------------------------------------------
     def save_vectors(self, path: str, meta: Optional[dict] = None) -> bool:
-        """FDE corpus via the inner store + one token file — boot becomes
+        """FDE corpus via the inner store + one token file (written from
+        the token-store host planes — the one host copy) — boot becomes
         O(bytes) instead of an O(corpus) re-encode through the FDE loop."""
         import os
 
         import msgpack
 
         self.inner.store.save(path, meta)
+        toks, mask = self._token_store.host_planes()
+        live = np.flatnonzero(mask.any(axis=1))
         tmp = path + ".tokens.tmp"
         with open(tmp, "wb") as f:
             f.write(msgpack.packb({
                 "version": 1,
                 "docs": [
-                    {"d": d, "shape": list(t.shape), "data": t.tobytes()}
-                    for d, t in self._tokens.items()
+                    {"d": int(d),
+                     "shape": [int(mask[d].sum()), self.dims],
+                     "data": toks[d][mask[d]].tobytes()}
+                    for d in live
                 ],
             }, use_bin_type=True))
             f.flush()
@@ -303,14 +436,19 @@ class MultiVectorIndex(VectorIndex):
                 d = msgpack.unpackb(f.read(), raw=False)
             if d.get("version") != 1:
                 return None
-            self._tokens = {
-                rec["d"]: np.frombuffer(rec["data"], np.float32)
+            ids = [rec["d"] for rec in d["docs"]]
+            sets = [
+                np.frombuffer(rec["data"], np.float32)
                 .reshape(rec["shape"]).copy()
                 for rec in d["docs"]
-            }
+            ]
         except (OSError, ValueError, KeyError, TypeError, AttributeError):
             # torn/corrupt token sidecar: contract is "rebuild from source"
             return None
+        if ids:
+            # a recovered index must rerank against the SAME token sets
+            # it checkpointed, not empty masks
+            self._token_store.put(np.asarray(ids, np.int64), sets)
         return meta
 
     # -- bookkeeping ---------------------------------------------------------
@@ -333,16 +471,26 @@ class MultiVectorIndex(VectorIndex):
         return self.inner.device_resident
 
     def hbm_bytes(self) -> int:
-        return self.inner.hbm_bytes()
+        return self.inner.hbm_bytes() + self._token_store.nbytes
 
     def host_tier_bytes(self) -> int:
-        return self.inner.host_tier_bytes()
+        return self.inner.host_tier_bytes() + self._token_store.host_bytes
 
     def demote_device(self) -> int:
-        return self.inner.demote_device()
+        # the fused rerank's token planes are HBM rent exactly like the
+        # FDE corpus — demotion drops both (host copies stay exact)
+        return self.inner.demote_device() + self._token_store.drop_device()
 
     def promote_device(self) -> int:
-        return self.inner.promote_device()
+        gained = self.inner.promote_device()
+        if gained and self.inner.store.mesh is None:
+            # the fused scan+rerank path is single-chip only; mesh mode
+            # serves the rescore tier from host planes — uploading the
+            # token planes there would be pure HBM rent for arrays no
+            # program reads
+            toks, tmask = self._token_store.sync()
+            gained += sum(a.nbytes for a in (toks, tmask))
+        return gained
 
     def stats(self) -> dict:
         return {
@@ -350,4 +498,6 @@ class MultiVectorIndex(VectorIndex):
             "count": self.count(),
             "fde_dim": self.encoder.fde_dim,
             "token_dims": self.dims,
+            "rerank_module": self._rerank_module.name,
+            "rerank_hbm_bytes": self._token_store.nbytes,
         }

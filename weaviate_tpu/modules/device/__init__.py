@@ -1,0 +1,25 @@
+"""Device module tier: rerank hooks fused into the one-dispatch search.
+
+See ``docs/modules.md`` for the taxonomy (host vs device tiers), the
+DeviceRerankModule contract, fallback semantics, and HBM rent.
+"""
+
+from weaviate_tpu.modules.device.base import (
+    DeviceRerankModule,
+    RerankRequest,
+    build_device_reranker,
+    device_reranker_catalog,
+)
+from weaviate_tpu.modules.device.linear import LinearRerank
+from weaviate_tpu.modules.device.maxsim import MaxSimRerank
+from weaviate_tpu.modules.device.store import CandidateTokenStore
+
+__all__ = [
+    "DeviceRerankModule",
+    "RerankRequest",
+    "build_device_reranker",
+    "device_reranker_catalog",
+    "MaxSimRerank",
+    "LinearRerank",
+    "CandidateTokenStore",
+]

@@ -1,0 +1,158 @@
+"""Device module SPI: rerank hooks that ride the fused dispatch.
+
+The host module tier (``modules/base.py``) scores documents with Python
+after search returns — fine for an external cross-encoder API, but a
+host round-trip per query for math the accelerator does in microseconds.
+A *device* rerank module is the TPU-native tier: a frozen (and therefore
+hashable — it keys the jit cache, exactly like ``ops/device_beam.py``'s
+``Scorer`` dataclasses) dataclass whose ``score`` hook is jit-traceable
+and runs INSIDE the fused search program: beam → rescore → gather
+candidate token planes → module score → on-device top-k, one dispatch
+per batch (``docs/modules.md``).
+
+Contract for a ``DeviceRerankModule`` implementation:
+
+- ``@dataclasses.dataclass(frozen=True)`` with hashable fields only
+  (floats/ints/strs/tuples) — the instance is a jit static argument.
+- ``name``: catalog id (``rerank-*``), a plain class attribute.
+- ``score(q_tokens, q_mask, cand_tokens, cand_mask) -> [B, C]`` —
+  jit-traceable, HIGHER is better. Shapes: ``q_tokens [B, Tq, D]``,
+  ``q_mask [B, Tq]`` bool, ``cand_tokens [B, C, T, D]``,
+  ``cand_mask [B, C, T]`` bool. The hook must never sync to host
+  (``np.asarray``/``.item()``/callbacks) — graftlint's
+  ``module-hook-host-sync`` rule enforces this.
+- ``host_score(...)`` — the same math in numpy, used by the host
+  fallback tier (warm-tier tenants, latched beams, flat-triage paths)
+  and as the reference ordering in tests. NOT part of the traced
+  region; numpy is expected here.
+"""
+
+from __future__ import annotations
+
+from typing import ClassVar, Optional
+
+import numpy as np
+
+from weaviate_tpu.modules.base import Module
+
+
+class DeviceRerankModule:
+    """Protocol base (isinstance marker) for device rerank scorers."""
+
+    name: ClassVar[str] = "rerank-device"
+
+    def score(self, q_tokens, q_mask, cand_tokens, cand_mask):
+        raise NotImplementedError
+
+    def host_score(self, q_tokens, q_mask, cand_tokens, cand_mask
+                   ) -> np.ndarray:
+        raise NotImplementedError
+
+    # modules scored inside jit call the instance like a function — keep
+    # the two spellings one implementation
+    def __call__(self, q_tokens, q_mask, cand_tokens, cand_mask):
+        return self.score(q_tokens, q_mask, cand_tokens, cand_mask)
+
+
+class DeviceRerankerProvider(Module):
+    """Registry-visible wrapper: the reference registers every module in
+    one Provider catalog (``usecases/modules/modules.go``), so device
+    rerankers appear there too — discoverable via ``registry.list()``
+    and type-checked via ``registry.device_reranker(name)``. ``build``
+    mints the frozen scorer instance the fused stage jits against."""
+
+    device_rerank = True  # capability marker (modules.base.module_type)
+
+    def __init__(self, cls: type):
+        self.name = cls.name
+        self._cls = cls
+
+    def module_type(self) -> str:
+        return "device-rerank"
+
+    def build(self, **params) -> DeviceRerankModule:
+        return self._cls(**params)
+
+
+def device_reranker_catalog() -> dict[str, type]:
+    """name -> frozen module class for every in-tree device reranker."""
+    from weaviate_tpu.modules.device.linear import LinearRerank
+    from weaviate_tpu.modules.device.maxsim import MaxSimRerank
+
+    return {
+        MaxSimRerank.name: MaxSimRerank,
+        LinearRerank.name: LinearRerank,
+    }
+
+
+def build_device_reranker(name: str, params: Optional[dict] = None
+                          ) -> DeviceRerankModule:
+    """Instantiate a frozen device reranker from the catalog. Unknown
+    params raise (a typo'd weight silently defaulting would change
+    ranking quality without a trace)."""
+    catalog = device_reranker_catalog()
+    cls = catalog.get(name)
+    if cls is None:
+        raise KeyError(
+            f"device rerank module {name!r} not in catalog "
+            f"{sorted(catalog)}")
+    return cls(**(params or {}))
+
+
+class RerankRequest:
+    """Per-request fused-rerank spec carried into the coalescing
+    dispatcher. Its identity joins the batch-group key: two requests may
+    share one device batch only when their module instance AND padded
+    query-token shape agree — a differently-reranked request must never
+    ride a batch whose program scores with someone else's module.
+
+    ``query_tokens=None`` is *self* mode: each query row's own vector is
+    its (single-element) token set — the natural form for reranking a
+    plain nearVector search. A ``[Tq, D]`` matrix is an explicit
+    late-interaction token set shared by every row of this request
+    (typically B=1). Tq pads to a pow2 bucket so steady traffic shares a
+    handful of compiles instead of one per distinct token count.
+    """
+
+    __slots__ = ("module", "query_tokens", "query_mask", "tq_pad")
+
+    def __init__(self, module: DeviceRerankModule,
+                 query_tokens: Optional[np.ndarray] = None):
+        self.module = module
+        if query_tokens is None:
+            self.query_tokens = None
+            self.query_mask = None
+            self.tq_pad = 1
+            return
+        qt = np.atleast_2d(np.asarray(query_tokens, np.float32))
+        tq = qt.shape[0]
+        self.tq_pad = 1 << max(0, (tq - 1).bit_length())
+        padded = np.zeros((self.tq_pad, qt.shape[1]), np.float32)
+        padded[:tq] = qt
+        mask = np.zeros((self.tq_pad,), bool)
+        mask[:tq] = True
+        self.query_tokens = padded
+        self.query_mask = mask
+
+    @property
+    def group_key(self) -> tuple:
+        """Dispatcher batch-group identity (hashable)."""
+        dims = (None if self.query_tokens is None
+                else self.query_tokens.shape[1])
+        return (self.module, self.tq_pad, dims)
+
+    def batch_for(self, queries: np.ndarray
+                  ) -> tuple[DeviceRerankModule, np.ndarray, np.ndarray]:
+        """→ (module, q_tokens [B, Tq, D], q_mask [B, Tq]) for one
+        request's query rows (the dispatcher concatenates these across a
+        coalesced group)."""
+        q = np.atleast_2d(np.asarray(queries, np.float32))
+        b = q.shape[0]
+        if self.query_tokens is None:
+            return (self.module, q[:, None, :].astype(np.float32),
+                    np.ones((b, 1), bool))
+        qt = np.broadcast_to(
+            self.query_tokens[None], (b, *self.query_tokens.shape))
+        qm = np.broadcast_to(self.query_mask[None], (b, self.tq_pad))
+        return self.module, np.ascontiguousarray(qt), \
+            np.ascontiguousarray(qm)

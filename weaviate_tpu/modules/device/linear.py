@@ -1,0 +1,69 @@
+"""Cross-encoder-shaped linear rerank module.
+
+A real cross-encoder jointly attends over (query, document); its
+device-fusable approximation here is a weighted blend of the two
+interaction features the token planes support — late interaction
+(MaxSim) and mean-pooled dot product — with frozen scalar weights. The
+point of shipping it is the SHAPE: it proves the module tier accepts a
+second, differently-parameterized scorer behind the same hook (the
+weights are dataclass fields, so two differently-weighted instances are
+distinct jit identities and never share a coalesced batch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import ClassVar
+
+import numpy as np
+
+from weaviate_tpu.modules.device.base import DeviceRerankModule
+
+
+@dataclasses.dataclass(frozen=True)
+class LinearRerank(DeviceRerankModule):
+    """score = w_max·MaxSim + w_mean·(mean_q · mean_d) + bias."""
+
+    name: ClassVar[str] = "rerank-linear"
+
+    w_max: float = 1.0
+    w_mean: float = 0.25
+    bias: float = 0.0
+
+    def score(self, q_tokens, q_mask, cand_tokens, cand_mask):
+        import jax.numpy as jnp
+
+        from weaviate_tpu.modules.device.maxsim import batched_maxsim
+
+        maxsim = batched_maxsim(q_tokens, q_mask, cand_tokens, cand_mask)
+
+        qn = jnp.maximum(jnp.sum(q_mask, axis=1), 1)[:, None]
+        qm = (jnp.sum(
+            jnp.where(q_mask[..., None], q_tokens, 0.0), axis=1)
+            / qn.astype(jnp.float32))                               # [B, D]
+        cn = jnp.maximum(jnp.sum(cand_mask, axis=2), 1)[..., None]
+        cm = (jnp.sum(
+            jnp.where(cand_mask[..., None], cand_tokens, 0.0), axis=2)
+            / cn.astype(jnp.float32))                               # [B, C, D]
+        mean_dot = jnp.einsum("bd,bcd->bc", qm, cm,
+                              preferred_element_type=jnp.float32)
+        return (jnp.float32(self.w_max) * maxsim
+                + jnp.float32(self.w_mean) * mean_dot
+                + jnp.float32(self.bias))
+
+    def host_score(self, q_tokens, q_mask, cand_tokens, cand_mask
+                   ) -> np.ndarray:
+        from weaviate_tpu.modules.device.maxsim import batched_maxsim_host
+
+        q_tokens = np.asarray(q_tokens, np.float32)
+        cand_tokens = np.asarray(cand_tokens, np.float32)
+        maxsim = batched_maxsim_host(q_tokens, q_mask, cand_tokens,
+                                     cand_mask)
+
+        qn = np.maximum(q_mask.sum(axis=1), 1)[:, None]
+        qm = np.where(q_mask[..., None], q_tokens, 0.0).sum(axis=1) / qn
+        cn = np.maximum(cand_mask.sum(axis=2), 1)[..., None]
+        cm = np.where(cand_mask[..., None], cand_tokens, 0.0).sum(axis=2) / cn
+        mean_dot = np.einsum("bd,bcd->bc", qm, cm)
+        return (self.w_max * maxsim + self.w_mean * mean_dot
+                + self.bias).astype(np.float32)

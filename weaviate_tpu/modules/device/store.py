@@ -1,0 +1,232 @@
+"""Candidate token planes: the HBM residency of the device rerank tier.
+
+Fused rerank gathers each candidate's token set INSIDE the search
+program, so the token sets must live in HBM as doc-id-addressed planes:
+``tokens [cap, T, D]`` + ``mask [cap, T]``. This store keeps the host
+copy authoritative (writes land there first; the device mirror scatters
+dirty rows before a search, exactly like ``ops/device_beam.py``'s
+``DeviceAdjacency``), which also makes the host fallback tier and
+tiering demotion free: dropping the device planes loses nothing.
+
+Mesh mode row-shards the planes along the same shard axis as every
+other HBM plane (``capacity`` tracks the backend's
+``device_plane_capacity`` via ``cap_fn`` so the beam's local candidate
+ids index the local token block directly).
+
+Tiering: the planes pay HBM rent like code planes do — ``nbytes`` feeds
+the index's ledger total, ``drop_device``/``sync`` are the
+demote/promote legs (``TieredResidency`` semantics: demotion releases
+HBM, the next hot search re-uploads wholesale at identical shapes so
+compiled rerank programs keep hitting their cache).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (max(1, n) - 1).bit_length())
+
+
+class CandidateTokenStore:
+    def __init__(self, dims: int, max_tokens: int = 8,
+                 cap_fn: Optional[Callable[[], int]] = None,
+                 mesh=None, initial_capacity: int = 1024):
+        self.dims = dims
+        self.tmax = _pow2(max_tokens)
+        self.cap_fn = cap_fn
+        self.mesh = mesh
+        cap = self._target_capacity(initial_capacity)
+        self._tokens = np.zeros((cap, self.tmax, dims), np.float32)
+        self._mask = np.zeros((cap, self.tmax), bool)
+        self._dev: Optional[tuple] = None
+        self._dev_shape: Optional[tuple] = None
+        self._dirty: set[int] = set()
+
+    # -- host-authoritative writes ---------------------------------------
+    def _target_capacity(self, need: int) -> int:
+        cap = max(1024, need)
+        if self.cap_fn is not None:
+            # align to the backend's device plane so ids (and, on a
+            # mesh, LOCAL block offsets) index both the same way
+            cap = max(cap, int(self.cap_fn()))
+        if self.mesh is not None:
+            from weaviate_tpu.parallel.mesh import mesh_size
+
+            n = mesh_size(self.mesh)
+            cap = ((cap + n - 1) // n) * n
+        return cap
+
+    def _ensure(self, need_rows: int, need_tokens: int) -> None:
+        cap = self._target_capacity(need_rows)
+        tmax = self.tmax if need_tokens <= self.tmax else _pow2(need_tokens)
+        if cap <= self._tokens.shape[0] and tmax == self.tmax:
+            return
+        cap = max(cap, self._tokens.shape[0])
+        grown_t = np.zeros((cap, tmax, self.dims), np.float32)
+        grown_m = np.zeros((cap, tmax), bool)
+        old = self._tokens.shape[0]
+        grown_t[:old, : self.tmax] = self._tokens
+        grown_m[:old, : self.tmax] = self._mask
+        self._tokens, self._mask, self.tmax = grown_t, grown_m, tmax
+        # shape moved: the mirror re-uploads wholesale on the next sync
+        self._dev = None
+        self._dirty.clear()
+
+    def put(self, doc_ids: np.ndarray, token_sets) -> None:
+        doc_ids = np.asarray(doc_ids, np.int64).reshape(-1)
+        if len(doc_ids) == 0:
+            return
+        if isinstance(token_sets, np.ndarray) and token_sets.ndim == 3:
+            # uniform [m, T, D] block (bulk loads): one vectorized write
+            t = token_sets.astype(np.float32, copy=False)
+            self._ensure(int(doc_ids.max()) + 1, t.shape[1])
+            self._tokens[doc_ids, : t.shape[1]] = t
+            self._tokens[doc_ids, t.shape[1]:] = 0.0
+            self._mask[doc_ids, : t.shape[1]] = True
+            self._mask[doc_ids, t.shape[1]:] = False
+            self._dirty.update(int(d) for d in doc_ids)
+        else:
+            sets = [np.atleast_2d(np.asarray(t, np.float32))
+                    for t in token_sets]
+            self._ensure(int(doc_ids.max()) + 1,
+                         max(s.shape[0] for s in sets))
+            for d, t in zip(doc_ids, sets):
+                d = int(d)
+                n = t.shape[0]
+                self._tokens[d, :n] = t
+                self._tokens[d, n:] = 0.0
+                self._mask[d, :n] = True
+                self._mask[d, n:] = False
+                self._dirty.add(d)
+        if len(self._dirty) > self._tokens.shape[0] // 2:
+            # more dirty rows than a scatter is worth: next sync
+            # re-uploads wholesale instead of building a huge index list
+            self._dev = None
+            self._dirty.clear()
+
+    def delete(self, doc_ids: np.ndarray) -> None:
+        cap = self._tokens.shape[0]
+        for d in np.asarray(doc_ids, np.int64).reshape(-1):
+            d = int(d)
+            if d < cap:
+                self._mask[d] = False
+                self._dirty.add(d)
+
+    # -- reads ------------------------------------------------------------
+    def host_planes(self) -> tuple[np.ndarray, np.ndarray]:
+        """(tokens, mask) host arrays — the fallback tier's scoring
+        source and the mirror's upload source."""
+        return self._tokens, self._mask
+
+    def sync(self, min_rows: int = 0):
+        """→ (tokens, mask) device arrays, up to date. Wholesale upload
+        on shape change / first hot touch after a demotion; dirty-row
+        scatter otherwise (mesh scatters stay sharded via the pinned
+        out-sharding the plane was placed with). ``min_rows``: the
+        caller's candidate-id space (e.g. the adjacency mirror's row
+        count) — the plane must cover it or a clipped gather would read
+        the wrong row's tokens."""
+        import jax
+        import jax.numpy as jnp
+
+        # the backend plane may have grown since the last write — track
+        # it so beam candidate ids never index past the token plane
+        self._ensure(max(1, min_rows), self.tmax)
+        shape = self._tokens.shape
+        if self._dev is None or self._dev_shape != shape:
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+                self._dev = (
+                    jax.device_put(self._tokens, NamedSharding(
+                        self.mesh, P(SHARD_AXIS, None, None))),
+                    jax.device_put(self._mask, NamedSharding(
+                        self.mesh, P(SHARD_AXIS, None))),
+                )
+            else:
+                self._dev = (jnp.asarray(self._tokens),
+                             jnp.asarray(self._mask))
+            self._dev_shape = shape
+            self._dirty.clear()
+            return self._dev
+        if self._dirty:
+            # atomic swap: writers keep adding ids concurrently (same
+            # contract as DeviceAdjacency.sync)
+            dirty, self._dirty = self._dirty, set()
+            idx = np.fromiter(
+                (i for i in dirty if i < shape[0]), np.int32)
+            if len(idx):
+                toks, mask = self._dev
+                jidx = jnp.asarray(idx)
+                toks = toks.at[jidx].set(jnp.asarray(self._tokens[idx]))
+                mask = mask.at[jidx].set(jnp.asarray(self._mask[idx]))
+                self._dev = (toks, mask)
+        return self._dev
+
+    # -- tiered residency -------------------------------------------------
+    @property
+    def device_resident(self) -> bool:
+        return self._dev is not None
+
+    @property
+    def nbytes(self) -> int:
+        """HBM rent of the mirrored planes (0 while demoted)."""
+        if self._dev is None:
+            return 0
+        return sum(a.nbytes for a in self._dev)
+
+    @property
+    def host_bytes(self) -> int:
+        return self._tokens.nbytes + self._mask.nbytes
+
+    def drop_device(self) -> int:
+        """Release the planes from HBM (warm demotion); the host copy is
+        authoritative, so nothing is lost. Returns bytes released."""
+        freed = self.nbytes
+        self._dev = None
+        self._dev_shape = None
+        self._dirty.clear()
+        return freed
+
+    # -- checkpoint -------------------------------------------------------
+    def save(self, path: str) -> None:
+        """Persist the host planes as an atomic sidecar next to the
+        owning index's checkpoint — a restored index must rerank against
+        the SAME token sets it checkpointed, never empty masks."""
+        import os
+
+        tmp = path + ".rrtok.tmp.npz"
+        np.savez_compressed(tmp, tokens=self._tokens, mask=self._mask)
+        os.replace(tmp, path + ".rrtok.npz")
+
+    def load(self, path: str) -> bool:
+        """Restore the host planes from the sidecar; False when absent
+        or corrupt (the caller treats the whole checkpoint as missing —
+        half a checkpoint is no checkpoint)."""
+        import os
+
+        p = path + ".rrtok.npz"
+        if not os.path.exists(p):
+            return False
+        try:
+            with np.load(p) as z:
+                tokens = z["tokens"]
+                mask = z["mask"]
+        except (OSError, ValueError, KeyError):
+            return False
+        if tokens.ndim != 3 or tokens.shape[2] != self.dims \
+                or mask.shape != tokens.shape[:2]:
+            return False
+        self._tokens = tokens.astype(np.float32, copy=False)
+        self._mask = mask.astype(bool, copy=False)
+        self.tmax = tokens.shape[1]
+        self._dev = None
+        self._dev_shape = None
+        self._dirty.clear()
+        return True

@@ -74,6 +74,19 @@ class ModuleRegistry:
     def spellchecker(self, name: str) -> SpellChecker:
         return self._typed(name, SpellChecker, "a spellchecker")
 
+    def device_reranker(self, name: str):
+        """A device rerank provider (``modules/device/``) — checked via
+        the capability marker, not isinstance, so this module keeps its
+        zero-import view of the device tier."""
+        m = self.get(name)
+        if not getattr(m, "device_rerank", False):
+            raise TypeError(f"module {name!r} is not a device reranker")
+        return m
+
+    def has_device_reranker(self, name: str) -> bool:
+        return self.has(name) and getattr(
+            self.get(name), "device_rerank", False)
+
     def list(self) -> dict[str, dict]:
         return {name: m.meta() for name, m in self._modules.items()}
 
@@ -132,6 +145,15 @@ def default_registry() -> ModuleRegistry:
     reg.register(DummyGenerative())
     reg.register(DummyReranker())
     reg.register(DummyMultiModal())
+    # device rerank tier (modules/device/): fused into the one-dispatch
+    # search pipeline; the registry entry is the discovery/config surface
+    from weaviate_tpu.modules.device.base import (
+        DeviceRerankerProvider,
+        device_reranker_catalog,
+    )
+
+    for cls in device_reranker_catalog().values():
+        reg.register(DeviceRerankerProvider(cls))
     # the hosted/self-hosted API catalog (gated per call in zero-egress)
     register_api_providers(reg)
     # qna-openai rides the generative-openai client

@@ -289,6 +289,27 @@ DEVICE_BEAM_FALLBACK = REGISTRY.counter(
     "by kind (search/construction) and mode (transient/latched); a "
     "latched fallback permanently downgrades the index to host walks")
 
+# device rerank module tier (modules/device/ + the fused rerank stage in
+# ops/device_beam.py): every rerank stage is attributed to its module and
+# tier, fallbacks latch LOUDLY, and the candidate pool sizes the fused
+# stage actually scored are observable per module
+RERANK_REQUESTS = REGISTRY.counter(
+    "weaviate_tpu_rerank_requests_total",
+    "rerank stages executed, by module and tier (fused = scored inside "
+    "the one-dispatch search program, host = the explicit fallback / "
+    "host-module tier)")
+RERANK_FALLBACK = REGISTRY.counter(
+    "weaviate_tpu_rerank_fallback_total",
+    "rerank requests that could not ride the fused device stage, by "
+    "module and reason (warm_tier/flat_triage/host_walk/mesh_legacy/"
+    "fused_error); each also lands a rerank.fallback span event — the "
+    "fallback tier is never silent")
+RERANK_CANDIDATES = REGISTRY.histogram(
+    "weaviate_tpu_rerank_candidates",
+    "candidate rows scored per reranked device batch (batch rows x "
+    "fused pool width), by module",
+    buckets=(8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 16384))
+
 # mesh-sharded device beam instruments (ops/device_beam.py mesh kernel +
 # parallel/): shard skew and accidental per-shard dispatch regressions are
 # alertable — one logical index across all chips must stay ONE dispatch

@@ -141,6 +141,37 @@ def _masked_scores(scorer, q, ids, operands):
     return jnp.where(ids >= 0, d, _INF)
 
 
+_NEG_INF = jnp.float32(-np.inf)
+
+
+def _rerank_module_scores(rerank, cand, tokens, tmask, rq, rqmask):
+    """The fused rerank core (traced INSIDE the search program): gather
+    the candidate token planes for a candidate pool and score it
+    through the device module hook (``modules/device/``). ``cand``
+    [B, C] pool ids (-1 pad). Returns (valid [B, C], scores [B, C],
+    higher = better; invalid slots carry garbage — every caller masks
+    with its own sentinel)."""
+    valid = cand >= 0
+    safe = jnp.maximum(cand, 0)
+    toks = jnp.take(tokens, safe, axis=0)               # [B, C, T, D]
+    tm = jnp.take(tmask, safe, axis=0) & valid[:, :, None]
+    return valid, rerank(rq, rqmask, toks, tm)
+
+
+def _rerank_stage(rerank, out_k, cand, tokens, tmask, rq, rqmask):
+    """Single-program rerank tail: module scores + on-device top-k.
+    Returns (ids [B, out_k], neg_scores [B, out_k]) — negated scores,
+    so lower is better and the host plumbing treats them exactly like
+    distances."""
+    valid, scores = _rerank_module_scores(rerank, cand, tokens, tmask,
+                                          rq, rqmask)
+    scores = jnp.where(valid, scores, _NEG_INF)
+    s, sel = jax.lax.top_k(scores, out_k)
+    r_ids = jnp.take_along_axis(cand, sel, axis=1)
+    ok = jnp.isfinite(s)
+    return jnp.where(ok, r_ids, -1), jnp.where(ok, -s, _INF)
+
+
 # ---------------------------------------------------------------------------
 # fused kernel: greedy descent over upper layers + layer-0 beam, one jit
 # ---------------------------------------------------------------------------
@@ -148,7 +179,8 @@ def _masked_scores(scorer, q, ids, operands):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scorer", "ef", "max_steps", "keep_k"))
+    static_argnames=("scorer", "ef", "max_steps", "keep_k", "rerank",
+                     "rerank_k"))
 def _fused_search(
     scorer,                      # static Scorer (hashable dataclass)
     queries: jnp.ndarray,        # [B, ...] backend query rep
@@ -162,11 +194,22 @@ def _fused_search(
     max_steps: int,
     allow: Optional[jnp.ndarray] = None,  # [N] bool filter allowlist
     keep_k: int = 0,
+    rerank=None,                 # static DeviceRerankModule (hashable)
+    rerank_k: int = 0,
+    rerank_q: Optional[jnp.ndarray] = None,       # [B, Tq, D]
+    rerank_qmask: Optional[jnp.ndarray] = None,   # [B, Tq] bool
+    rerank_tokens: Optional[jnp.ndarray] = None,  # [N, T, D] HBM plane
+    rerank_tmask: Optional[jnp.ndarray] = None,   # [N, T] bool
 ):
     """→ (ids [B, ef], dists [B, ef]) ascending; -1/MASK padded. With
     ``allow`` + ``keep_k`` also returns (kept_ids [B, keep_k], kept_d) —
     the best ALLOWED nodes seen anywhere along the walk (the device
-    analogue of the host sweep's keep_mask track)."""
+    analogue of the host sweep's keep_mask track). With a ``rerank``
+    module the walk's top candidates (the kept track when filtered, the
+    beam otherwise) feed the fused rerank stage — gather candidate token
+    planes, module score, on-device top-k — and the returns become
+    (beam_ids, beam_d, rerank_ids [B, rerank_k], neg_scores); still ONE
+    dispatch for walk + rerank."""
     b = queries.shape[0]
     n, m0 = adjacency.shape
     rows = jnp.arange(b)
@@ -283,6 +326,13 @@ def _fused_search(
          kept_ids, kept_d, jnp.bool_(True)))
     if track:
         kept_ids = jnp.where(kept_d >= _INF, -1, kept_ids)
+    if rerank is not None and rerank_k > 0:
+        r_ids, r_d = _rerank_stage(
+            rerank, rerank_k,
+            (kept_ids if track else beam_ids)[:, :rerank_k],
+            rerank_tokens, rerank_tmask, rerank_q, rerank_qmask)
+        return beam_ids, beam_d, r_ids, r_d
+    if track:
         return beam_ids, beam_d, kept_ids, kept_d
     return beam_ids, beam_d
 
@@ -322,7 +372,7 @@ def _op_partition_spec(arr, cap: int, axis: str):
 @functools.partial(
     jax.jit,
     static_argnames=("scorer", "ef", "max_steps", "fetch", "keep_k",
-                     "mesh", "axis", "merge"))
+                     "mesh", "axis", "merge", "rerank", "rerank_k"))
 def _fused_mesh_search(
     scorer,
     queries,
@@ -341,25 +391,41 @@ def _fused_mesh_search(
     qeps=None,           # [B] int32 replicated GLOBAL ids (construction)
     allow=None,          # [cap] bool row-sharded
     keep_k: int = 0,
+    rerank=None,         # static DeviceRerankModule (hashable)
+    rerank_k: int = 0,
+    rerank_q=None,       # [B, Tq, D] replicated
+    rerank_qmask=None,   # [B, Tq] replicated
+    rerank_tokens=None,  # [cap, T, D] row-sharded token plane
+    rerank_tmask=None,   # [cap, T] row-sharded
 ):
     """The whole mesh as one program: per-shard descent + layer-0 beam
     in local index space, then the cross-shard top-k merge. Returns
     replicated (ids [B, fetch] GLOBAL, dists) — plus (kept_ids [B,
     keep_k], kept_d) when filtered — or, with ``merge=False``
     (construction), the UNMERGED per-shard results stacked [n, B,
-    fetch] so the host can take each node's own-shard candidates."""
+    fetch] so the host can take each node's own-shard candidates. With
+    a ``rerank`` module every shard runs the fused rerank stage over
+    its LOCAL candidates (token planes row-shard like every other HBM
+    plane) and the cross-shard merge ranks by module score — returns
+    replicated (ids [B, rerank_k], neg_scores); still ONE dispatch."""
     from jax.sharding import PartitionSpec as P
 
     from weaviate_tpu.parallel.sharded_search import _shard_map
 
     cap = adjacency.shape[0]
     track = allow is not None and keep_k > 0
+    rerank_on = rerank is not None and rerank_k > 0 and merge
 
     def local(q, ops_l, adj_l, pres_l, uadj_l, uslots_l, *rest):
         rest = list(rest)
         seeds_l = rest.pop(0) if seeds is not None else None
         qeps_r = rest.pop(0) if qeps is not None else None
         allow_l = rest.pop(0) if allow is not None else None
+        if rerank_on:
+            tok_l = rest.pop(0)
+            tmask_l = rest.pop(0)
+            rq_r = rest.pop(0)
+            rqm_r = rest.pop(0)
         n_local = adj_l.shape[0]
         b = q.shape[0]
         rows = jnp.arange(b)
@@ -495,6 +561,31 @@ def _fused_mesh_search(
             (jnp.int32(0), beam_ids, beam_d, expanded, visited,
              kept_ids, kept_d, jnp.bool_(True)))
 
+        if rerank_on:
+            # fused rerank over this shard's LOCAL candidates: gather
+            # the local token block, score, and let the cross-shard
+            # merge rank by (negated) module score — the rerank is part
+            # of the same SPMD program, no extra dispatch
+            from weaviate_tpu.ops.topk import merge_across_shards
+
+            if track:
+                # the kept track's filler slots hold real-but-DISALLOWED
+                # ids at kd=_INF (the unfiltered merge keeps them for
+                # shape); mask them out BEFORE scoring or they would
+                # earn genuine module scores and displace allowed
+                # candidates in the cross-shard merge (the single-chip
+                # path applies the same mask in _fused_search)
+                cand = jnp.where(kept_d[:, :rerank_k] >= _INF, -1,
+                                 kept_ids[:, :rerank_k])
+            else:
+                cand = beam_ids[:, :rerank_k]
+            rvalid, scores = _rerank_module_scores(
+                rerank, cand, tok_l, tmask_l, rq_r, rqm_r)
+            neg = jnp.where(rvalid, -scores, _INF)
+            rgids = jnp.where(rvalid, cand + base, -1)
+            rmd, rmi = merge_across_shards(neg, rgids, rerank_k, axis)
+            return rmi, rmd
+
         out_ids = beam_ids[:, :fetch]
         out_d = beam_d[:, :fetch]
         gids = jnp.where(out_ids >= 0, out_ids + base, -1)
@@ -524,8 +615,14 @@ def _fused_mesh_search(
     if allow is not None:
         in_specs.append(P(axis))
         args.append(allow)
+    if rerank_on:
+        in_specs += [P(axis, None, None), P(axis, None),
+                     P(None, None, None), P(None, None)]
+        args += [rerank_tokens, rerank_tmask, rerank_q, rerank_qmask]
     if not merge:
         out_specs = (P(axis, None, None), P(axis, None, None))
+    elif rerank_on:
+        out_specs = (P(None, None), P(None, None))
     elif track:
         out_specs = (P(None, None),) * 4
     else:
@@ -578,6 +675,12 @@ def device_search_mesh(
     keep_k: int = 0,
     merge: bool = True,
     axis: str = "shard",
+    rerank=None,
+    rerank_k: int = 0,
+    rerank_q=None,
+    rerank_qmask=None,
+    rerank_tokens=None,
+    rerank_tmask=None,
 ):
     """Dispatch ONE fused SPMD walk spanning every mesh shard (per-shard
     descent + beam + on-device cross-shard merge). Exactly one of
@@ -591,6 +694,9 @@ def device_search_mesh(
     if upper_adj is None or upper_adj.shape[1] == 0:
         upper_adj, upper_slots = _mesh_empty_upper(
             mesh, adjacency.shape[0], axis)
+    if rerank is not None:
+        rerank_k = min(rerank_k, keep_k if (allow is not None
+                                            and keep_k > 0) else ef)
     _dispatch_count += 1
     from weaviate_tpu.monitoring.metrics import MESH_BEAM_DISPATCH
 
@@ -607,7 +713,10 @@ def device_search_mesh(
                 scorer, queries, operands, adjacency, present, upper_adj,
                 upper_slots, ef=ef, max_steps=max_steps, fetch=fetch,
                 mesh=mesh, axis=axis, merge=merge, seeds=seeds, qeps=qeps,
-                allow=allow, keep_k=keep_k)
+                allow=allow, keep_k=keep_k, rerank=rerank,
+                rerank_k=rerank_k, rerank_q=rerank_q,
+                rerank_qmask=rerank_qmask, rerank_tokens=rerank_tokens,
+                rerank_tmask=rerank_tmask)
     # merge=False (construction) has no cross-device rendezvous — the
     # per-shard walks are independent programs and cannot invert
     # graftlint: allow[unlocked-collective-dispatch] reason=merge=False traces no all_gather; independent per-shard programs cannot invert
@@ -645,19 +754,35 @@ def device_search(
     upper_slots=None,
     allow=None,
     keep_k: int = 0,
+    rerank=None,
+    rerank_k: int = 0,
+    rerank_q=None,
+    rerank_qmask=None,
+    rerank_tokens=None,
+    rerank_tmask=None,
 ):
     """Dispatch ONE fused walk program (descent + layer-0 beam). Without
     upper tables the walk starts at layer 0 (construction / flat graphs).
+    With a ``rerank`` module the same single program also runs the fused
+    rerank stage over its top candidates (see ``_fused_search``).
     Increments the module dispatch counter — the test hook behind the
     one-dispatch-per-batch contract."""
     global _dispatch_count
     if upper_adj is None or upper_adj.shape[0] == 0:
         upper_adj, upper_slots = _empty_upper()
+    if rerank is not None:
+        # the rerank pool is drawn from the kept track when filtered,
+        # the beam otherwise — never wider than its source
+        rerank_k = min(rerank_k, keep_k if (allow is not None
+                                            and keep_k > 0) else ef)
     _dispatch_count += 1
     return _fused_search(
         scorer, queries, operands, adjacency, present,
         jnp.asarray(eps, jnp.int32), upper_adj, upper_slots,
-        ef=ef, max_steps=max_steps, allow=allow, keep_k=keep_k)
+        ef=ef, max_steps=max_steps, allow=allow, keep_k=keep_k,
+        rerank=rerank, rerank_k=rerank_k, rerank_q=rerank_q,
+        rerank_qmask=rerank_qmask, rerank_tokens=rerank_tokens,
+        rerank_tmask=rerank_tmask)
 
 
 def beam_search_layer0(
@@ -679,6 +804,58 @@ def beam_search_layer0(
         RawScorer(metric, precision), queries, (corpus,), adjacency,
         present, eps, ef=ef, max_steps=max_steps, allow=allow,
         keep_k=keep_k)
+
+
+# ---------------------------------------------------------------------------
+# fused flat scan + rerank: the multivector (MUVERA) serving program
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("module", "fetch", "k", "metric", "precision"))
+def _fused_flat_rerank(
+    module,                   # static DeviceRerankModule (hashable)
+    queries: jnp.ndarray,     # [B, F] coarse-space queries (e.g. FDE)
+    corpus: jnp.ndarray,      # [N, F] coarse corpus (HBM)
+    valid: jnp.ndarray,       # [N] bool
+    q_tokens: jnp.ndarray,    # [B, Tq, D] rerank query token sets
+    q_mask: jnp.ndarray,      # [B, Tq] bool
+    tokens: jnp.ndarray,      # [N, T, D] candidate token plane (HBM)
+    tmask: jnp.ndarray,       # [N, T] bool
+    fetch: int,
+    k: int,
+    allow: Optional[jnp.ndarray] = None,
+    metric: str = "dot",
+    precision: str = "bf16",
+):
+    """Coarse flat scan → gather candidate token planes → module score →
+    on-device top-k, ONE program. This is ``MultiVectorIndex``'s serving
+    path: the MUVERA FDE scan produces ``fetch`` candidates and the
+    exact MaxSim (or any device module) reranks them WITHOUT the
+    candidate ids ever round-tripping to the host — the fix for the
+    coarse-search→host→rescore pattern the pre-rerank code paid."""
+    from weaviate_tpu.ops.distance import flat_search
+
+    d, ids = flat_search(queries, corpus, k=fetch, metric=metric,
+                         valid_mask=valid, allow_mask=allow,
+                         precision=precision)
+    return _rerank_stage(module, k, ids.astype(jnp.int32)[:, :fetch],
+                         tokens, tmask, q_tokens, q_mask)
+
+
+def fused_flat_rerank(module, queries, corpus, valid, q_tokens, q_mask,
+                      tokens, tmask, fetch: int, k: int, allow=None,
+                      metric: str = "dot", precision: str = "bf16"):
+    """Dispatch ONE fused coarse-scan + rerank program. Increments the
+    module dispatch counter (same hook as the beam's one-dispatch
+    contract). ``k`` is clamped to ``fetch`` — the rerank pool."""
+    global _dispatch_count
+    _dispatch_count += 1
+    return _fused_flat_rerank(
+        module, queries, corpus, valid, q_tokens, q_mask, tokens, tmask,
+        fetch=fetch, k=min(k, fetch), allow=allow, metric=metric,
+        precision=precision)
 
 
 class DeviceAdjacency:

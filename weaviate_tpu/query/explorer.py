@@ -9,6 +9,7 @@ traverser.
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -36,11 +37,17 @@ class HybridParams:
 
 @dataclass
 class RerankParams:
-    """Reference ``modulecapabilities`` rerank additional property."""
+    """Reference ``modulecapabilities`` rerank additional property.
+
+    ``module`` "" = collection default: the target index's configured
+    DEVICE module when one exists (fused into the search dispatch, see
+    docs/modules.md), else the host ``reranker-lexical``. Naming a
+    registered device module routes the fused tier; any other name runs
+    the host module tier after search."""
 
     query: str
     property: str = ""  # document text property; "" = all text props
-    module: str = "reranker-lexical"
+    module: str = ""
 
 
 @dataclass
@@ -216,6 +223,7 @@ class Explorer:
                 "without search operators or filters")
         scored: list[tuple[StorageObject, float]] = []
         kind = "none"
+        fused_rerank = None  # set when the device tier scores in-dispatch
 
         if params.autocorrect and col.modules is not None \
                 and col.modules.has("text-spellcheck"):
@@ -257,10 +265,12 @@ class Explorer:
             )
             kind = "distance"
         elif params.near_vector is not None:
+            fused_rerank = self._fused_rerank_request(col, params)
             scored = col.vector_search(
                 params.near_vector, k=fetch, target=params.target_vector,
                 flt=params.filters, tenant=params.tenant,
                 max_distance=params.max_distance,
+                rerank=fused_rerank,
             )
             kind = "distance"
         elif params.bm25_query is not None:
@@ -329,7 +339,36 @@ class Explorer:
                 float(params.legacy_group.get("force", 0.0)))
         result = QueryResult(hits=hits)
         if params.rerank is not None:
-            self._apply_rerank(col, result, params.rerank)
+            if fused_rerank is not None or self._rerank_inherent(
+                    col, params):
+                # the device module scored INSIDE the search dispatch
+                # (the fused hnsw stage, or the multivector index whose
+                # serving path IS the fused scan+rerank): each hit's
+                # distance is its negated module score, no host rerank
+                # pass runs — and must not overwrite the ordering
+                for h in result.hits:
+                    if h.distance is not None:
+                        h.additional["rerank_score"] = -float(h.distance)
+            else:
+                if not params.rerank.module:
+                    # "" = collection default. If that default is a
+                    # DEVICE module, silently substituting the lexical
+                    # reranker on a non-fusable query shape would swap
+                    # the ranking criterion without a trace — reject
+                    # like the explicit spelling does
+                    cfg = (col.config.named_vectors.get(
+                        params.target_vector) if params.target_vector
+                        else col.config.vector_config)
+                    rcfg = getattr(cfg, "rerank", None)
+                    if rcfg is not None and rcfg.enabled:
+                        raise ValueError(
+                            f"this collection's default rerank module "
+                            f"{rcfg.module!r} is a device module and "
+                            "cannot serve this query shape (bm25/hybrid "
+                            "result set or max_distance bound) — name a "
+                            "host reranker explicitly, e.g. module: "
+                            "\"reranker-lexical\"")
+                self._apply_rerank(col, result, params.rerank)
         if params.generate is not None:
             self._apply_generate(col, result, params.generate)
         if params.ask is not None:
@@ -349,43 +388,161 @@ class Explorer:
             if isinstance(v, str)
         )
 
+    def _fused_rerank_request(self, col, params: QueryParams):
+        """A ``RerankRequest`` when this query's rerank should ride the
+        fused device stage (the target index is an hnsw index with a
+        device module configured and the requested module is
+        device-capable), else None — the host module tier applies after
+        search instead. The rerank ``query`` TEXT becomes the query
+        token set via the collection's vectorizer (the stated criterion
+        is honored, not silently swapped for the search vector); with
+        no vectorizer the search vector itself is the token set (self
+        mode). ``property`` selects document TEXT and has no meaning on
+        the device tier — token planes are vectors."""
+        rr = params.rerank
+        if rr is None or params.max_distance is not None:
+            return None
+        cfg = (col.config.named_vectors.get(params.target_vector)
+               if params.target_vector else col.config.vector_config)
+        rcfg = getattr(cfg, "rerank", None)
+        if rcfg is None or not rcfg.enabled \
+                or getattr(cfg, "index_type", "") != "hnsw":
+            return None
+        from weaviate_tpu.modules.device.base import (
+            RerankRequest,
+            build_device_reranker,
+            device_reranker_catalog,
+        )
+
+        name = rr.module or rcfg.module
+        if name not in device_reranker_catalog():
+            return None  # a host module was asked for by name
+        mod_params = rcfg.params if name == rcfg.module else None
+        q_tokens = None
+        if rr.query and col.modules is not None \
+                and col.config.vectorizer != "none":
+            from weaviate_tpu.modules.base import ModuleNotAvailable
+
+            try:
+                q_tokens = col.modules.vectorizer(
+                    col.config.vectorizer).vectorize_query(rr.query)
+            except ModuleNotAvailable:
+                q_tokens = None  # self mode; the provider is offline
+        return RerankRequest(build_device_reranker(name, mod_params),
+                             q_tokens)
+
+    def _rerank_inherent(self, col, params: QueryParams) -> bool:
+        """Whether the target index's OWN serving path already applied
+        the requested device module — a multivector index reranks every
+        search with its configured module (default MaxSim), so the
+        rerank{} block annotates rather than re-sorts. A DIFFERENT
+        module name (host or device) falls through to _apply_rerank,
+        which either runs the host module or rejects a device name with
+        a clean error. NOTE: on a multivector target the late
+        interaction is scored against the SEARCH token set — the
+        rerank ``query`` text is informational here (re-stating the
+        criterion in multivector token space would need a text2multivec
+        provider); docs/modules.md spells this out."""
+        if params.near_vector is None:
+            return False
+        cfg = (col.config.named_vectors.get(params.target_vector)
+               if params.target_vector else col.config.vector_config)
+        if getattr(cfg, "index_type", "") != "multivector":
+            return False
+        rcfg = getattr(cfg, "rerank", None)
+        configured = (rcfg.module if rcfg is not None and rcfg.enabled
+                      else "rerank-maxsim")
+        return (params.rerank.module or configured) == configured
+
+    @contextmanager
+    def _module_scope(self, span_name: str, **attrs):
+        """Host module stage harness: re-enter the request scope (the
+        module may run on a pool thread that never inherited it — this
+        re-activates the INGRESS span so the stage's child span lands in
+        the request's trace) and hold the stage to the request's serving
+        deadline. Yields a callable the stage invokes between documents:
+        a slow reranker/generator sheds at the next document boundary
+        instead of blowing past QoS budgets unobserved."""
+        from weaviate_tpu.monitoring.tracing import TRACER
+        from weaviate_tpu.serving import context as serving_ctx
+
+        ctx = serving_ctx.current()
+        deadline = ctx.deadline if ctx is not None else None
+
+        def checkpoint() -> None:
+            if deadline is not None:
+                deadline.require()
+
+        with serving_ctx.request_scope(ctx), \
+                TRACER.span(span_name, **attrs):
+            checkpoint()
+            yield checkpoint
+
     def _apply_rerank(self, col, result: QueryResult,
                       params: RerankParams) -> None:
-        """Rerank hits by module score; reorders and annotates
-        (reference reranker additional property)."""
+        """HOST-tier rerank: module scores after search returns
+        (reference reranker additional property). Runs under the
+        request's serving deadline inside the ingress trace — and counts
+        itself, so host-tier rerank traffic is attributable next to the
+        fused tier's."""
         if col.modules is None or not result.hits:
             return
-        reranker = col.modules.reranker(params.module)
-        docs = [self._doc_text(h.object, params.property) for h in result.hits]
-        scores = reranker.rerank(params.query, docs)
+        from weaviate_tpu.monitoring.metrics import RERANK_REQUESTS
+
+        name = params.module or "reranker-lexical"
+        if col.modules.has_device_reranker(name):
+            # a device module reached the host tier: this query shape
+            # cannot fuse (bm25/hybrid result set, max_distance bound,
+            # or no device rerank config on the target index) and a
+            # device module has no document-text scorer to fall back to
+            raise ValueError(
+                f"module {name!r} is a device rerank module; it fuses "
+                "into nearVector searches on an index configured with "
+                "a rerank module (docs/modules.md) — use a host "
+                "reranker (e.g. 'reranker-lexical') for this query")
+        reranker = col.modules.reranker(name)
+        RERANK_REQUESTS.inc(module=name, tier="host")
+        with self._module_scope("modules.rerank", module=name,
+                                hits=len(result.hits)) as checkpoint:
+            docs = [self._doc_text(h.object, params.property)
+                    for h in result.hits]
+            checkpoint()
+            scores = reranker.rerank(params.query, docs)
         for h, s in zip(result.hits, scores):
             h.additional["rerank_score"] = float(s)
         result.hits.sort(key=lambda h: -h.additional["rerank_score"])
 
     def _apply_generate(self, col, result: QueryResult,
                         params: GenerateParams) -> None:
-        """Generative additional property (reference generate provider)."""
+        """Generative additional property (reference generate provider).
+        Deadline-checked between documents — generation is the slowest
+        module stage and must shed mid-result, not after."""
         if col.modules is None or not result.hits:
             return
         gen = col.modules.generative(params.module)
-        if params.single_prompt:
-            for h in result.hits:
-                h.additional["generate"] = gen.generate_single(
-                    params.single_prompt, h.object.properties
+        with self._module_scope("modules.generate", module=params.module,
+                                hits=len(result.hits)) as checkpoint:
+            if params.single_prompt:
+                for h in result.hits:
+                    checkpoint()
+                    h.additional["generate"] = gen.generate_single(
+                        params.single_prompt, h.object.properties
+                    )
+            if params.grouped_task:
+                props = params.properties
+                docs = []
+                for h in result.hits:
+                    if props:
+                        docs.append(" ".join(
+                            str(h.object.properties.get(p, ""))
+                            for p in props
+                        ))
+                    else:
+                        docs.append(self._doc_text(h.object, ""))
+                checkpoint()
+                result.generated = gen.generate(
+                    params.grouped_task, docs, grouped=True
                 )
-        if params.grouped_task:
-            props = params.properties
-            docs = []
-            for h in result.hits:
-                if props:
-                    docs.append(" ".join(
-                        str(h.object.properties.get(p, "")) for p in props
-                    ))
-                else:
-                    docs.append(self._doc_text(h.object, ""))
-            result.generated = gen.generate(
-                params.grouped_task, docs, grouped=True
-            )
 
     def _apply_ask(self, col, result: QueryResult,
                    params: AskParams) -> None:

@@ -190,6 +190,56 @@ def quantizer_from_dict(d: Optional[dict]) -> Optional[QuantizerConfig]:
 
 
 # ---------------------------------------------------------------------------
+# Device rerank module config (the reference configures modules per class
+# in the schema, usecases/modules; here the device rerank tier hangs off
+# the vector-index config it fuses into — docs/modules.md)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RerankModuleConfig:
+    """Fused device rerank for one vector index: which registered device
+    module (``modules/device/``) scores the walk's candidates inside the
+    one-dispatch search, how wide its candidate token planes are, and
+    its frozen parameters."""
+
+    enabled: bool = True
+    module: str = "rerank-maxsim"
+    # candidate token plane width (pow2-rounded); token sets longer than
+    # this grow the plane, shorter ones zero-pad
+    max_tokens: int = 8
+    # module constructor params (frozen into the jit-static scorer —
+    # e.g. {"w_mean": 0.5} for rerank-linear)
+    params: dict = field(default_factory=dict)
+
+    def validate(self) -> None:
+        from weaviate_tpu.modules.device.base import (
+            build_device_reranker,
+        )
+
+        if self.max_tokens < 1:
+            raise ValueError(
+                f"rerank max_tokens must be >= 1, got {self.max_tokens}")
+        # instantiating validates both the name and the params (a typo'd
+        # weight silently defaulting would change ranking quality)
+        try:
+            build_device_reranker(self.module, self.params)
+        except (KeyError, TypeError) as e:
+            raise ValueError(f"invalid rerank module config: {e}") from e
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def rerank_from_dict(d: Optional[dict]) -> Optional[RerankModuleConfig]:
+    if not d or not d.get("enabled", True):
+        return None
+    fields = {f.name for f in dataclasses.fields(RerankModuleConfig)}
+    return RerankModuleConfig(
+        **{k: v for k, v in d.items() if k in fields})
+
+
+# ---------------------------------------------------------------------------
 # Vector index configs
 # ---------------------------------------------------------------------------
 
@@ -206,6 +256,8 @@ class VectorIndexConfig:
     index_type: str = "flat"
     distance: str = "cosine"  # l2-squared | dot | cosine | manhattan | hamming
     quantizer: Optional[QuantizerConfig] = None
+    # fused device rerank module (docs/modules.md); None = no rerank tier
+    rerank: Optional[RerankModuleConfig] = None
     # device placement / batching
     precision: str = "bf16"  # matmul precision on TPU: bf16 | fp32
     initial_capacity: int = 1024
@@ -257,23 +309,36 @@ class VectorIndexConfig:
                 "filter_flat_selectivity must be in [0, 1), got "
                 f"{sel} — above 1 every filtered query would silently "
                 "take the exact flat scan")
+        if self.rerank is not None:
+            if self.index_type not in ("hnsw", "multivector"):
+                raise ValueError(
+                    f"rerank modules fuse into the hnsw and multivector "
+                    f"search programs only; index_type "
+                    f"{self.index_type!r} does not support them")
+            self.rerank.validate()
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         if self.quantizer is not None:
             d["quantizer"] = self.quantizer.to_dict()
+        if self.rerank is not None:
+            d["rerank"] = self.rerank.to_dict()
         return d
 
     def as_type(self, cls: type, index_type: str) -> "VectorIndexConfig":
         """Convert to a concrete index-config subclass, preserving the live
-        quantizer object (a plain to_dict round-trip would flatten it)."""
+        quantizer/rerank objects (a plain to_dict round-trip would
+        flatten them)."""
         quant = self.quantizer
+        rer = self.rerank
         d = self.to_dict()
         d.pop("quantizer", None)
+        d.pop("rerank", None)
         d["index_type"] = index_type
         fields = {f.name for f in dataclasses.fields(cls)}
         cfg = cls(**{k: v for k, v in d.items() if k in fields})
         cfg.quantizer = quant
+        cfg.rerank = rer
         return cfg
 
     @staticmethod
@@ -282,6 +347,7 @@ class VectorIndexConfig:
             return FlatIndexConfig()
         d = dict(d)
         q = quantizer_from_dict(d.pop("quantizer", None))
+        r = rerank_from_dict(d.pop("rerank", None))
         t = d.get("index_type", "flat")
         cls = {
             "flat": FlatIndexConfig,
@@ -293,6 +359,7 @@ class VectorIndexConfig:
         fields = {f.name for f in dataclasses.fields(cls)}
         cfg = cls(**{k: v for k, v in d.items() if k in fields})
         cfg.quantizer = q
+        cfg.rerank = r
         return cfg
 
 

@@ -57,6 +57,9 @@ MANIFEST: dict[str, str] = {
         "fused greedy-descent + layer-0 beam walk, single device",
     "ops.device_beam._fused_mesh_search":
         "fused beam walk as ONE SPMD program across the shard mesh",
+    "ops.device_beam._fused_flat_rerank":
+        "fused coarse flat scan + device-module rerank (multivector "
+        "MUVERA serving path, docs/modules.md)",
     "ops.distance.flat_search":
         "exact flat top-k scan (flat index + filtered-triage tier)",
     "ops.pallas_flat.pallas_flat_topk":
@@ -263,6 +266,17 @@ def _warm_one(spec: _Spec, reason: str) -> None:
         _tls.token = ("prewarm", spec.bucket)
         try:
             spec.index.search(q, spec.k)
+            mod = getattr(spec.index, "_rerank_module", None)
+            if mod is not None and not getattr(spec.index, "multi_vector",
+                                               False):
+                # the rerank variant is a DISTINCT program identity (the
+                # module is a jit-static arg): warm it too, so a warmed
+                # node's first reranked query is compile-free. The
+                # multivector index needs no extra pass — its plain
+                # search IS the fused scan+rerank program.
+                from weaviate_tpu.modules.device import RerankRequest
+
+                spec.index.search(q, spec.k, rerank=RerankRequest(mod))
         finally:
             _tls.token = None
         sp.set(warm_ms=round((time.perf_counter() - t0) * 1000, 3))
