@@ -227,6 +227,12 @@ CONFIG_METRICS = {
     "bq50m": (lambda m: m.startswith("bq_qps_50M"),) * 2,
     "bq100m": (lambda m: m.startswith("bq_qps_100M"),) * 2,
     "msmarco": (lambda m: m.startswith("hybrid_msmarco_"),) * 2,
+    # headline: the device-path QPS line (with its recall field); the
+    # host-fusion A/B and the queue/device split ride along
+    "hybrid": (lambda m: m.startswith(("hybrid_qps_", "hybrid_queue_ms",
+                                       "hybrid_device_ms")),
+               lambda m: m.startswith("hybrid_qps_")
+               and not m.startswith("hybrid_qps_hostfusion")),
     # headline: the hot-set QPS line; the cold-latency line is secondary
     "tiering": (lambda m: m.startswith("tiering_"),
                 lambda m: m.startswith("tiering_qps_hot")),
@@ -2500,6 +2506,154 @@ def bench_rerank(n=1_000_000, d=128, batch=64, k=10, iters=0, warmup=0,
         platform=jax.default_backend())
 
 
+def bench_hybrid(n=200_000, d=256, batch=0, k=10, iters=0, warmup=0,
+                 vocab=20_000, nq=64, threads=8, reps=6):
+    """One-dispatch hybrid search (docs/hybrid.md): `hybrid_qps` through
+    the REAL Collection path — overlapped BM25 ⊕ dense legs, device
+    fusion — with recall@10 against the sequential-host-fusion ground
+    truth (device fusion + device sparse OFF: the pre-overlap serving
+    shape), the queue-vs-device split journaled from the dense leg's
+    `dispatch.batch` spans, and a `device_hybrid` perf-flag verdict on
+    real hardware (A/B vs the host-fusion tier)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.ops import fusion as fops
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        DataType,
+        HNSWIndexConfig,
+        Property,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+    from weaviate_tpu.utils.runtime_config import (
+        HYBRID_DEVICE_FUSION,
+        HYBRID_SPARSE_DEVICE,
+    )
+
+    rng = np.random.default_rng(11)
+    print(f"# hybrid: n={n} d={d} vocab={vocab} nq={nq}", file=sys.stderr)
+    # zipf text: the same distribution the bm25 configs use, as words
+    ranks = np.arange(1, vocab + 1)
+    probs = (1.0 / ranks) / np.sum(1.0 / ranks)
+    root = tempfile.mkdtemp(prefix="bench_hybrid_")
+    db = DB(root)
+    try:
+        # HNSW so the dense leg rides the coalescing dispatcher (the
+        # queue-vs-device split below reads its dispatch.batch spans)
+        col = db.create_collection(CollectionConfig(
+            name="Hybrid",
+            properties=[Property(name="body", data_type=DataType.TEXT)],
+            vector_config=HNSWIndexConfig(distance="l2-squared",
+                                          ef=64, ef_construction=64),
+        ))
+        t0 = time.perf_counter()
+        vecs = rng.standard_normal((n, d)).astype(np.float32)
+        terms = rng.choice(vocab, size=(n, 8), p=probs)
+        for lo in range(0, n, 4096):
+            hi = min(lo + 4096, n)
+            objs = [StorageObject(
+                uuid=f"{i:08x}-0000-0000-0000-000000000000",
+                collection="Hybrid",
+                properties={"body": " ".join(
+                    f"w{t:05d}" for t in terms[i])},
+                vector=vecs[i]) for i in range(lo, hi)]
+            col.put_batch(objs)
+        build_s = time.perf_counter() - t0
+        print(f"# built in {build_s:.1f}s", file=sys.stderr)
+
+        q_terms = rng.choice(vocab, size=(nq, 2), p=probs)
+        q_text = [" ".join(f"w{t:05d}" for t in row) for row in q_terms]
+        q_vecs = vecs[rng.choice(n, nq, replace=False)] \
+            + 0.05 * rng.standard_normal((nq, d)).astype(np.float32)
+
+        def run_one(i):
+            return col.hybrid_search(query=q_text[i % nq],
+                                     vector=q_vecs[i % nq],
+                                     alpha=0.5, k=k)
+
+        def sweep():
+            return [run_one(i) for i in range(nq)]
+
+        # ground truth: the sequential host-fusion tier (device knobs
+        # off) — quality must carry over 1:1 into the fused device path
+        HYBRID_DEVICE_FUSION.set_override("off")
+        HYBRID_SPARSE_DEVICE.set_override("off")
+        try:
+            gt = sweep()
+        finally:
+            HYBRID_DEVICE_FUSION.clear_override()
+            HYBRID_SPARSE_DEVICE.clear_override()
+        disp0 = fops.dispatch_count()
+        live = sweep()  # also the device-path warmup
+        assert fops.dispatch_count() - disp0 == nq, \
+            "hybrid fusion must be ONE device dispatch per request"
+        recall = float(np.mean([
+            len({o.uuid for o, _ in live[i][:k]}
+                & {o.uuid for o, _ in gt[i][:k]}) / max(1, min(
+                    k, len(gt[i])))
+            for i in range(nq)]))
+
+        def timed_qps():
+            from concurrent.futures import ThreadPoolExecutor
+
+            best = 0.0
+            for _ in range(3):
+                with ThreadPoolExecutor(max_workers=threads) as pool:
+                    t0 = time.perf_counter()
+                    futs = [pool.submit(
+                        lambda s=s: [run_one(s * reps + r)
+                                     for r in range(reps)])
+                        for s in range(threads)]
+                    for f in futs:
+                        f.result()
+                    dt = time.perf_counter() - t0
+                best = max(best, threads * reps / dt)
+            return best
+
+        qps = timed_qps()
+        _emit({
+            "metric": f"hybrid_qps_{n // 1000}k_{d}d",
+            "value": round(qps, 1), "unit": "qps",
+            "recall10_vs_host_fusion": round(recall, 4),
+            "recall_ok": bool(recall >= 0.99),
+            "k": k, "alpha": 0.5, "threads": threads,
+            "note": "overlapped legs + one-dispatch device fusion, "
+                    "recall vs sequential-host-fusion ground truth",
+        })
+        # queue-vs-device split of the dense leg's coalesced batches
+        _dispatch_split("hybrid", lambda: run_one(
+            int(rng.integers(nq))))
+
+        # A/B: host-fusion tier under the same load -> perf-flag verdict
+        HYBRID_DEVICE_FUSION.set_override("off")
+        try:
+            host_qps = timed_qps()
+        finally:
+            HYBRID_DEVICE_FUSION.clear_override()
+        _emit({
+            "metric": f"hybrid_qps_hostfusion_{n // 1000}k_{d}d",
+            "value": round(host_qps, 1), "unit": "qps",
+            "note": "same load, fusion pinned to the host python twin",
+        })
+        from weaviate_tpu.utils import perf_flags
+
+        perf_flags.record(
+            "device_hybrid",
+            enabled=bool(qps >= 0.95 * host_qps and recall >= 0.99),
+            evidence={"hybrid_qps": round(qps, 1),
+                      "host_fusion_qps": round(host_qps, 1),
+                      "recall10_vs_host": round(recall, 4),
+                      "config": f"{n}x{d} k{k} a0.5"},
+            platform=jax.default_backend())
+    finally:
+        db.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 CONFIGS = {
     "flat1m": bench_flat1m,
     "sift1m": bench_sift1m,
@@ -2508,6 +2662,7 @@ CONFIGS = {
     "hnswquant": bench_hnsw_quant,
     "bq": bench_bq,
     "msmarco": bench_msmarco,
+    "hybrid": bench_hybrid,
     "tiering": bench_tiering,
     "meshbeam": bench_meshbeam,
     "bm25": bench_bm25,
@@ -2615,6 +2770,13 @@ def _full_footprint(name: str) -> dict:
         return {"hbm_gb": n * dc * (4 + 2) / _GB,
                 "host_gb": n * (dc * 4 + 200) / _GB,
                 "disk_gb": 0.1}  # the populated compile cache itself
+    if name == "hybrid":
+        # fp32 corpus + adjacency mirror in HBM; fp32 originals + graph
+        # + python postings (8 terms/doc) on host
+        n, dh = 200_000, 256
+        return {"hbm_gb": n * (dh * 4 + 33 * 4) / _GB,
+                "host_gb": (n * (dh * 4 * 2 + 200) + n * 8 * 24) / _GB,
+                "disk_gb": 0.0}
     if name == "rerank":
         # fp32 corpus + adjacency mirror + [n, T, D] token planes in
         # HBM; host holds the corpus + token twins
@@ -2644,6 +2806,9 @@ SMOKE = {
     "bq50m": dict(n=250_000, iters=2, warmup=1),
     "bq100m": dict(n=250_000, iters=2, warmup=1),
     "msmarco": dict(n=96_000, tenants=8, iters=2, warmup=1),
+    # semantics check (overlap + one-dispatch fusion + recall parity),
+    # not a throughput claim
+    "hybrid": dict(n=3_000, vocab=1_500, nq=12, threads=4, reps=2),
     "tiering": dict(n=8_000, tenants=8, batch=16, iters=2, warmup=1),
     # mesh A/B needs real builds on both legs: keep the smoke shape tiny
     "meshbeam": dict(n=3_000, batch=32, ef=48, iters=2, warmup=1),

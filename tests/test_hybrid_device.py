@@ -1,0 +1,659 @@
+"""One-dispatch hybrid search: overlapped legs + on-device fusion.
+
+Pins the acceptance contracts of the hybrid pipeline (docs/hybrid.md):
+device-vs-host fusion parity (bit-exact page order for both algorithms,
+including ties and single-distinct-score legs), leg OVERLAP proven from
+trace spans, fusion as ONE device dispatch (`ops.fusion.dispatch_count`),
+the segmented sparse path for filtered legs (single device and mesh with
+a fully-banned shard), deadline shed of a slow sparse leg while the
+dense results still fuse, the overfetch knob, and the cross-node
+global-normalization regression (per-shard min-max skew is gone).
+"""
+
+import time
+from concurrent.futures import TimeoutError as FuturesTimeout
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.cluster.resilience import Deadline, DeadlineExceeded
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.inverted.filters import Filter
+from weaviate_tpu.ops import fusion as fops
+from weaviate_tpu.ops import sparse as sops
+from weaviate_tpu.query.fusion import (
+    FUSION_ALGORITHMS,
+    fuse_result_sets,
+    ranked_fusion,
+    relative_score_fusion,
+)
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    DataType,
+    FlatIndexConfig,
+    Property,
+)
+from weaviate_tpu.serving import context as serving_ctx
+from weaviate_tpu.storage.objects import StorageObject
+from weaviate_tpu.utils.runtime_config import (
+    HYBRID_DEVICE_FUSION,
+    HYBRID_OVERFETCH_FACTOR,
+    HYBRID_SPARSE_DEVICE,
+)
+
+D = 8
+WORDS = ["alpha", "beta", "gamma", "delta", "election", "vote", "senate",
+         "quantum", "football"]
+
+
+@pytest.fixture
+def col(tmp_dbdir, rng):
+    db = DB(tmp_dbdir)
+    cfg = CollectionConfig(
+        name="Doc",
+        properties=[Property(name="body", data_type=DataType.TEXT),
+                    Property(name="blk", data_type=DataType.TEXT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+    )
+    c = db.create_collection(cfg)
+    objs = []
+    for i in range(64):
+        body = " ".join(rng.choice(WORDS, 5)) + (
+            " election vote" if i % 3 == 0 else "")
+        v = rng.normal(size=D).astype(np.float32)
+        objs.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Doc",
+            properties={"body": body, "blk": f"b{i // 8}"}, vector=v))
+    c.put_batch(objs)
+    yield c
+    db.close()
+
+
+# ------------------------------------------------------------- fusion parity
+def _random_sets(rng, n_keys=40, sizes=(17, 23)):
+    keys = [f"k{i:03d}" for i in range(n_keys)]
+    sets = []
+    for sz in sizes:
+        pick = rng.choice(n_keys, size=sz, replace=False)
+        scores = np.sort(rng.normal(size=sz).astype(np.float32))[::-1]
+        sets.append([(keys[int(p)], float(s))
+                     for p, s in zip(pick, scores)])
+    return sets
+
+
+@pytest.mark.parametrize("algo", sorted(FUSION_ALGORITHMS))
+def test_fusion_device_host_parity_random(rng, algo):
+    """Random legs: the device page ORDER matches the host twin exactly;
+    scores agree to float32 rounding."""
+    for trial in range(5):
+        sets = _random_sets(rng)
+        weights = [0.3, 0.7]
+        host = FUSION_ALGORITHMS[algo](sets, weights, 10)
+        dev = fuse_result_sets(sets, weights, 10, algo)
+        assert [k for k, _ in dev] == [k for k, _ in host], (algo, trial)
+        np.testing.assert_allclose([s for _, s in dev],
+                                   [s for _, s in host],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_ranked_fusion_tie_order_matches_host():
+    """Exact ties: x leads leg A at rank 0, y leads leg B at rank 0 with
+    equal weights — identical RRF sums. The host's stable sort keeps
+    dict-insertion order (x first); the device page must match it
+    bit-exactly (slot order + lax.top_k's lower-index-wins)."""
+    a = [("x", 9.0), ("z", 1.0)]
+    b = [("y", 5.0), ("z", 0.5)]
+    host = ranked_fusion([a, b], [0.5, 0.5], 3)
+    dev = fuse_result_sets([a, b], [0.5, 0.5], 3, "rankedFusion")
+    # z fuses from both legs; x and y tie exactly at 0.5/60 each
+    assert host[1][1] == host[2][1]  # the engineered tie is real
+    assert [k for k, _ in dev] == [k for k, _ in host] == ["z", "x", "y"]
+
+
+def test_relative_fusion_single_distinct_score():
+    """A leg with one distinct score min-max normalizes to 1.0 (host
+    twin's span<=0 branch) on both tiers, including a one-entry leg."""
+    a = [("x", 7.0), ("y", 7.0), ("z", 7.0)]
+    b = [("y", 0.25)]
+    host = relative_score_fusion([a, b], [0.5, 0.5], 4)
+    dev = fuse_result_sets([a, b], [0.5, 0.5], 4, "relativeScoreFusion")
+    assert [k for k, _ in dev] == [k for k, _ in host]
+    np.testing.assert_allclose([s for _, s in dev], [s for _, s in host],
+                               rtol=1e-6)
+    assert dict(dev)["y"] == pytest.approx(1.0)  # 0.5*1.0 + 0.5*1.0
+
+
+def test_fusion_empty_and_unknown():
+    assert fuse_result_sets([], [], 5, "rankedFusion") == []
+    with pytest.raises(ValueError):
+        fuse_result_sets([[("a", 1.0)]], [1.0], 5, "bogusFusion")
+
+
+def test_fusion_host_fallback_latches_loudly():
+    from weaviate_tpu.monitoring.metrics import HYBRID_FALLBACK
+
+    before = HYBRID_FALLBACK.value(stage="fuse", reason="disabled")
+    HYBRID_DEVICE_FUSION.set_override("off")
+    try:
+        sets = [[("a", 2.0), ("b", 1.0)]]
+        out = fuse_result_sets(sets, [1.0], 2, "relativeScoreFusion")
+        assert [k for k, _ in out] == ["a", "b"]
+    finally:
+        HYBRID_DEVICE_FUSION.clear_override()
+    assert HYBRID_FALLBACK.value(
+        stage="fuse", reason="disabled") == before + 1
+
+
+# ------------------------------------------------ one dispatch + leg overlap
+def test_hybrid_fusion_is_one_dispatch(col, rng):
+    q = rng.normal(size=D).astype(np.float32)
+    col.hybrid_search(query="election vote", vector=q, alpha=0.5, k=10)
+    before = fops.dispatch_count()
+    res = col.hybrid_search(query="election vote", vector=q, alpha=0.5,
+                            k=10)
+    assert res
+    assert fops.dispatch_count() == before + 1
+
+
+def test_hybrid_leg_spans_overlap(col, rng, monkeypatch):
+    """The ACCEPTANCE overlap proof: a traced hybrid request's
+    hybrid.sparse and hybrid.dense spans overlap in time — with the
+    sparse leg slowed, the dense window must fall INSIDE it, which is
+    impossible under serialized legs."""
+    from weaviate_tpu.core.collection import Collection
+    from weaviate_tpu.monitoring.tracing import TRACER
+
+    real = Collection.bm25_search
+
+    def slow_bm25(self, *a, **kw):
+        time.sleep(0.25)
+        return real(self, *a, **kw)
+
+    monkeypatch.setattr(Collection, "bm25_search", slow_bm25)
+    q = rng.normal(size=D).astype(np.float32)
+    with TRACER.span("test.ingress", parent=None) as root:
+        col.hybrid_search(query="election", vector=q, alpha=0.5, k=5)
+        trace_id = root.trace_id
+    spans = {s["name"]: s for s in TRACER.recent(500, trace_id=trace_id)}
+    sparse, dense = spans["hybrid.sparse"], spans["hybrid.dense"]
+    fuse = spans["hybrid.fuse"]
+    assert sparse["parentSpanId"] == root.span_id
+    assert dense["parentSpanId"] == root.span_id
+    # windows overlap: each starts before the other ends
+    assert sparse["startTimeUnixNano"] < dense["endTimeUnixNano"]
+    assert dense["startTimeUnixNano"] < sparse["endTimeUnixNano"]
+    # fusion runs after both legs
+    assert fuse["startTimeUnixNano"] >= dense["startTimeUnixNano"]
+
+
+def test_slow_sparse_leg_sheds_dense_still_fuses(col, rng, monkeypatch):
+    """Concurrent-leg deadline expiry: the WAND leg outlives the budget
+    and sheds; the dense leg's results still fuse into a valid page."""
+    from weaviate_tpu.core.collection import Collection
+    from weaviate_tpu.monitoring.metrics import HYBRID_LEG_SHED
+
+    def stuck_bm25(self, *a, **kw):
+        time.sleep(1.5)
+        return []
+
+    monkeypatch.setattr(Collection, "bm25_search", stuck_bm25)
+    q = rng.normal(size=D).astype(np.float32)
+    before = HYBRID_LEG_SHED.value(leg="sparse")
+    ctx = serving_ctx.RequestContext(deadline=Deadline(0.4, op="test"))
+    with serving_ctx.request_scope(ctx):
+        res = col.hybrid_search(query="election", vector=q, alpha=0.5,
+                                k=5)
+    assert len(res) == 5  # the dense leg alone fills the page
+    assert HYBRID_LEG_SHED.value(leg="sparse") == before + 1
+    # pure-keyword + dead sparse leg = nothing survives -> the request
+    # itself sheds
+    monkeypatch.setattr(Collection, "bm25_search", stuck_bm25)
+    ctx = serving_ctx.RequestContext(deadline=Deadline(0.4, op="test"))
+    with serving_ctx.request_scope(ctx):
+        with pytest.raises((DeadlineExceeded, TimeoutError,
+                            FuturesTimeout)):
+            col.hybrid_search(query="election", vector=None, alpha=0.0,
+                              k=5)
+
+
+def test_slow_dense_leg_sheds_sparse_still_fuses(col, rng, monkeypatch):
+    """Symmetric shed: a dense leg that outlives the budget must not
+    discard a sparse leg that FINISHED in time."""
+    from weaviate_tpu.core.collection import Collection
+    from weaviate_tpu.monitoring.metrics import HYBRID_LEG_SHED
+
+    def over_budget_dense(self, *a, **kw):
+        time.sleep(0.3)  # let the sparse leg complete first
+        raise DeadlineExceeded("dense leg over budget")
+
+    monkeypatch.setattr(Collection, "vector_search", over_budget_dense)
+    before = HYBRID_LEG_SHED.value(leg="dense")
+    ctx = serving_ctx.RequestContext(deadline=Deadline(5.0, op="test"))
+    with serving_ctx.request_scope(ctx):
+        res = col.hybrid_search(
+            query="election", vector=rng.normal(size=D).astype(
+                np.float32), alpha=0.5, k=5)
+    assert res  # the sparse leg alone fills the page
+    assert HYBRID_LEG_SHED.value(leg="dense") == before + 1
+
+
+def test_dispatch_group_token_survives_shard_pool(tmp_dbdir, rng,
+                                                  monkeypatch):
+    """The hybrid dense leg's group token must reach the dispatcher from
+    SHARD POOL WORKERS too — a multi-shard scatter re-enters it beside
+    the request scope."""
+    from weaviate_tpu.core.shard import Shard
+    from weaviate_tpu.index.dispatch import (
+        current_dispatch_group,
+        dispatch_group,
+    )
+    from weaviate_tpu.schema.config import ShardingConfig
+
+    db = DB(tmp_dbdir)
+    col = db.create_collection(CollectionConfig(
+        name="Sharded",
+        properties=[Property(name="body", data_type=DataType.TEXT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        sharding=ShardingConfig(desired_count=2),
+    ))
+    col.put_batch([StorageObject(
+        uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Sharded",
+        properties={"body": "x"},
+        vector=rng.normal(size=D).astype(np.float32))
+        for i in range(16)])
+    seen = []
+    real = Shard.vector_search
+
+    def spy(self, *a, **kw):
+        seen.append(current_dispatch_group())
+        return real(self, *a, **kw)
+
+    monkeypatch.setattr(Shard, "vector_search", spy)
+    q = rng.normal(size=D).astype(np.float32)
+    with dispatch_group(("hybrid", "rankedFusion")):
+        col.vector_search(q, 5)
+    assert len(seen) == 2  # both shards, through the pool
+    assert all(t == ("hybrid", "rankedFusion") for t in seen)
+    db.close()
+
+
+def test_hybrid_overfetch_knob(col, rng, monkeypatch):
+    """The hardcoded max(k, 20) is gone: legs fetch ceil(factor*k),
+    hot-reloadable via hybrid_overfetch_factor."""
+    from weaviate_tpu.core.collection import Collection
+
+    seen = {}
+    real_bm = Collection.bm25_search
+    real_vs = Collection.vector_search
+
+    def spy_bm(self, query, k=10, **kw):
+        seen["sparse"] = k
+        return real_bm(self, query, k, **kw)
+
+    def spy_vs(self, query, k=10, **kw):
+        seen["dense"] = k
+        return real_vs(self, query, k, **kw)
+
+    monkeypatch.setattr(Collection, "bm25_search", spy_bm)
+    monkeypatch.setattr(Collection, "vector_search", spy_vs)
+    q = rng.normal(size=D).astype(np.float32)
+    col.hybrid_search(query="election", vector=q, alpha=0.5, k=30)
+    assert seen == {"sparse": 60, "dense": 60}  # default factor 2.0
+    HYBRID_OVERFETCH_FACTOR.set_override(1.0)
+    try:
+        col.hybrid_search(query="election", vector=q, alpha=0.5, k=30)
+        assert seen == {"sparse": 30, "dense": 30}
+    finally:
+        HYBRID_OVERFETCH_FACTOR.clear_override()
+
+
+def test_dispatch_group_token_splits_batches():
+    """Hybrid identity in the dispatcher's batch-group key: requests
+    enqueued under different group tokens never share a device batch."""
+    from weaviate_tpu.index.dispatch import (
+        CoalescingDispatcher,
+        _Req,
+        dispatch_group,
+    )
+
+    d = CoalescingDispatcher(lambda q, k, allow: (None, None))
+    qs = np.zeros((1, 4), np.float32)
+    with dispatch_group(("hybrid", "rankedFusion")):
+        r1 = _Req(qs, 5, None)
+        r1b = _Req(qs, 5, None)
+    r2 = _Req(qs, 5, None)
+    assert r1.group_key == ("hybrid", "rankedFusion")
+    assert r2.group_key is None
+    d._pending = [r1, r2, r1b]
+    group = d._take_group()
+    assert group == [r1, r1b]  # token-equal requests coalesce
+    assert d._take_group() == [r2]
+
+
+# ------------------------------------------------------ segmented sparse path
+def test_filtered_hybrid_device_sparse_parity(col, rng):
+    """Filtered hybrid: sparse leg scores on device (one dispatch) and
+    matches the WAND/host tier's page exactly."""
+    q = rng.normal(size=D).astype(np.float32)
+    flt = Filter("Equal", path=["blk"], value="b1")
+    before = sops.dispatch_count()
+    dev = col.hybrid_search(query="election vote", vector=q, alpha=0.5,
+                            k=8, flt=flt)
+    assert sops.dispatch_count() > before
+    HYBRID_SPARSE_DEVICE.set_override("off")
+    try:
+        host = col.hybrid_search(query="election vote", vector=q,
+                                 alpha=0.5, k=8, flt=flt)
+    finally:
+        HYBRID_SPARSE_DEVICE.clear_override()
+    assert [o.uuid for o, _ in dev] == [o.uuid for o, _ in host]
+    assert all(o.properties["blk"] == "b1" for o, _ in dev)
+
+
+def test_filtered_hybrid_min_match_device_parity(col, rng):
+    """operator=And / minimum_match run on device too
+    (sparse_score_topk_min_match) and match the host rule."""
+    q = rng.normal(size=D).astype(np.float32)
+    flt = Filter("Like", path=["blk"], value="b*")  # allow-all filter
+    kw = dict(query="election vote", vector=q, alpha=0.4, k=10, flt=flt,
+              operator="And")
+    dev = col.hybrid_search(**kw)
+    HYBRID_SPARSE_DEVICE.set_override("off")
+    try:
+        host = col.hybrid_search(**kw)
+    finally:
+        HYBRID_SPARSE_DEVICE.clear_override()
+    assert [o.uuid for o, _ in dev] == [o.uuid for o, _ in host]
+    # And-semantics on the KEYWORD leg (alpha=0 = pure keyword): every
+    # hit holds both tokens — the device min-match plane matches the rule
+    pure = col.hybrid_search(query="election vote", vector=None,
+                             alpha=0.0, k=10, flt=flt, operator="And")
+    assert pure
+    for o, _ in pure:
+        assert "election" in o.properties["body"]
+        assert "vote" in o.properties["body"]
+
+
+def test_filtered_hybrid_on_mesh_with_fully_banned_shard(tmp_dbdir, rng):
+    """Mesh sparse scoring with a filter that bans an entire mesh
+    row-block: the banned shard contributes only masked slots and the
+    merged page matches the host tier bit for bit."""
+    from weaviate_tpu.parallel import runtime
+    from weaviate_tpu.parallel.mesh import make_mesh
+
+    runtime.set_mesh(make_mesh(8))
+    try:
+        db = DB(tmp_dbdir)
+        cfg = CollectionConfig(
+            name="MeshDoc",
+            properties=[Property(name="body", data_type=DataType.TEXT),
+                        Property(name="blk", data_type=DataType.TEXT)],
+            vector_config=FlatIndexConfig(distance="l2-squared",
+                                          precision="fp32"),
+        )
+        c = db.create_collection(cfg)
+        objs = []
+        for i in range(64):
+            body = " ".join(rng.choice(WORDS, 4)) + " election"
+            v = rng.normal(size=D).astype(np.float32)
+            objs.append(StorageObject(
+                uuid=f"00000000-0000-0000-0000-{i:012d}",
+                collection="MeshDoc",
+                properties={"body": body, "blk": f"b{i // 8}"},
+                vector=v))
+        c.put_batch(objs)
+        # doc rows 0..63, mesh row-blocks of 8: banning blk b0 (docs
+        # 0-7) bans mesh shard 0 ENTIRELY
+        flt = Filter("NotEqual", path=["blk"], value="b0")
+        q = rng.normal(size=D).astype(np.float32)
+        before = sops.dispatch_count()
+        dev = c.hybrid_search(query="election", vector=q, alpha=0.5,
+                              k=10, flt=flt)
+        assert sops.dispatch_count() > before
+        HYBRID_SPARSE_DEVICE.set_override("off")
+        try:
+            host = c.hybrid_search(query="election", vector=q, alpha=0.5,
+                                   k=10, flt=flt)
+        finally:
+            HYBRID_SPARSE_DEVICE.clear_override()
+        assert [o.uuid for o, _ in dev] == [o.uuid for o, _ in host]
+        assert all(o.properties["blk"] != "b0" for o, _ in dev)
+        db.close()
+    finally:
+        runtime.reset()
+
+
+def test_sparse_fallback_latches_for_segment_tier():
+    """A tier that cannot serve device scoring (segment-resident
+    postings) declines and the fallback latches in the metric."""
+    from weaviate_tpu.inverted.segmented import SegmentedInvertedIndex
+
+    assert SegmentedInvertedIndex.bm25_device_search(
+        object.__new__(SegmentedInvertedIndex), "q", 5) is None
+
+
+# -------------------------------------------- cross-node global normalization
+def _mk_cluster(tmp_path, n_docs=40, skew_shard=0):
+    from weaviate_tpu.cluster import ClusterNode, InProcTransport
+    from weaviate_tpu.cluster.sharding import shard_for_uuid
+    from weaviate_tpu.schema.config import ReplicationConfig, ShardingConfig
+
+    registry = {}
+    ids = ["n0", "n1"]
+    nodes = [ClusterNode(nid, ids, InProcTransport(registry, nid),
+                         str(tmp_path / nid)) for nid in ids]
+    deadline = time.monotonic() + 8
+    while not any(n.raft.is_leader() for n in nodes):
+        assert time.monotonic() < deadline, "no leader"
+        time.sleep(0.05)
+    leader = next(n for n in nodes if n.raft.is_leader())
+    cfg = CollectionConfig(
+        name="Doc",
+        properties=[Property(name="body", data_type=DataType.TEXT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        sharding=ShardingConfig(desired_count=2),
+        replication=ReplicationConfig(factor=1),
+    )
+    leader.create_collection(cfg)
+    deadline = time.monotonic() + 8
+    while not all(n.db.has_collection("Doc") for n in nodes):
+        assert time.monotonic() < deadline, "schema propagation"
+        time.sleep(0.05)
+    # engineered IMBALANCE: ~4/5 of the docs land on one shard (the
+    # per-shard normalization bug needs skew to show)
+    rng = np.random.default_rng(7)
+    objs, i = [], 0
+    quota = {skew_shard: int(n_docs * 0.8),
+             1 - skew_shard: n_docs - int(n_docs * 0.8)}
+    placed = {0: 0, 1: 0}
+    while sum(placed.values()) < n_docs:
+        u = f"00000000-0000-0000-0000-{i:012d}"
+        i += 1
+        s = shard_for_uuid(u, 2)
+        if placed[s] >= quota[s]:
+            continue
+        placed[s] += 1
+        v = rng.normal(size=D).astype(np.float32)
+        body = " ".join(np.random.default_rng(i).choice(WORDS, 4)) \
+            + " election"
+        objs.append(StorageObject(uuid=u, collection="Doc",
+                                  properties={"body": body}, vector=v))
+    leader.put_batch("Doc", objs, consistency="ONE")
+    return nodes, objs
+
+
+def test_cluster_hybrid_fuses_globally_not_per_shard(tmp_path, rng):
+    """THE cross-node regression: relativeScoreFusion must min-max
+    normalize over the GLOBALLY merged candidate sets. Fusing per shard
+    and merging afterwards skews scores when shards are unbalanced —
+    the coordinator's page must equal a single-corpus ground truth, and
+    the per-shard-normalized page must demonstrably differ."""
+    nodes, objs = _mk_cluster(tmp_path)
+    try:
+        coord = nodes[0]
+        q = rng.normal(size=D).astype(np.float32)
+        k, fetch = 10, 20
+        got = coord.hybrid_search("Doc", query="election", vector=q,
+                                  alpha=0.5, k=k,
+                                  fusion="relativeScoreFusion")
+        assert len(got) == k
+
+        # ground truth: same legs, fused over the GLOBAL merged sets
+        # with the host twin (the coordinator's exact contract)
+        sparse = coord.bm25_search("Doc", "election", fetch)
+        dense = coord.vector_search("Doc", q, fetch)
+        sets = [[(o.uuid, s) for o, s in sparse],
+                [(o.uuid, -d) for o, d in dense]]
+        truth = relative_score_fusion(sets, [0.5, 0.5], k)
+        assert [o.uuid for o, _ in got] == [u for u, _ in truth]
+        np.testing.assert_allclose([s for _, s in got],
+                                   [s for _, s in truth],
+                                   rtol=1e-5, atol=1e-6)
+
+        # the BUGGY shape: normalize per shard, then merge — must differ
+        # under the engineered imbalance, or this test proves nothing
+        st = coord._state_for("Doc")
+        per_shard_pages = []
+        for shard in range(st.n_shards):
+            rep = st.replicas(shard)[0]
+            node = next(n for n in nodes if n.id == rep)
+            sh_sparse = node._on_shard_bm25(
+                {"class": "Doc", "shard": shard, "query": "election",
+                 "k": fetch})["hits"]
+            sh_dense = node._on_shard_search(
+                {"class": "Doc", "shard": shard, "query": q.tobytes(),
+                 "dims": D, "k": fetch})["hits"]
+            s_sets = [
+                [(StorageObject.from_bytes(b).uuid, s)
+                 for s, b in sh_sparse],
+                [(StorageObject.from_bytes(b).uuid, -d)
+                 for d, b in sh_dense],
+            ]
+            per_shard_pages.extend(
+                relative_score_fusion(s_sets, [0.5, 0.5], k))
+        per_shard_pages.sort(key=lambda t: -t[1])
+        buggy = [u for u, _ in per_shard_pages[:k]]
+        assert buggy != [u for u, _ in truth]
+    finally:
+        for n in nodes:
+            n.quiesce()
+        for n in nodes:
+            n.close()
+
+
+def test_cluster_hybrid_leg_spans_one_trace(tmp_path, rng):
+    """Cross-node hybrid is one trace: the coordinator's leg + fuse
+    spans hang off the caller's span."""
+    from weaviate_tpu.monitoring.tracing import TRACER
+
+    nodes, _ = _mk_cluster(tmp_path, n_docs=20)
+    try:
+        q = rng.normal(size=D).astype(np.float32)
+        with TRACER.span("test.ingress", parent=None) as root:
+            nodes[0].hybrid_search("Doc", query="election", vector=q,
+                                   alpha=0.5, k=5)
+            trace_id = root.trace_id
+        names = {s["name"] for s in TRACER.recent(800, trace_id=trace_id)}
+        assert {"hybrid.sparse", "hybrid.dense", "hybrid.fuse"} <= names
+    finally:
+        for n in nodes:
+            n.quiesce()
+        for n in nodes:
+            n.close()
+
+
+# ----------------------------------------------------------- API error paths
+def test_unknown_fusion_is_invalid_argument_not_500(col):
+    from weaviate_tpu.query.explorer import Explorer, HybridParams, QueryParams
+
+    ex = Explorer(col_db(col))
+    with pytest.raises(ValueError, match="unknown fusion"):
+        ex.get(QueryParams(collection="Doc",
+                           hybrid=HybridParams(query="x",
+                                               fusion="bogusFusion")))
+
+
+def col_db(col):
+    """The DB owning a fixture collection (Explorer wants the DB)."""
+    class _Shim:
+        def get_collection(self, name):
+            return col
+    return _Shim()
+
+
+def test_grpc_hybrid_operator_and_fusion_mapping(tmp_dbdir):
+    """gRPC surface: bm25_operator/bm25_minimum_match reach the keyword
+    branch end-to-end, and an unknown fusion name maps to
+    INVALID_ARGUMENT — never an internal error."""
+    import grpc
+
+    from weaviate_tpu.api.grpc_server import GrpcAPI, GrpcClient
+    from weaviate_tpu.api.proto import pb
+
+    db = DB(tmp_dbdir)
+    db.create_collection(CollectionConfig(
+        name="Doc",
+        properties=[Property(name="body", data_type=DataType.TEXT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+    ))
+    api = GrpcAPI(db)
+    port = api.serve(port=0)
+    client = GrpcClient(f"127.0.0.1:{port}")
+    try:
+        import json as _json
+
+        req = pb.BatchObjectsRequest()
+        bodies = ["election vote", "election only", "vote only"]
+        for i, body in enumerate(bodies):
+            o = req.objects.add()
+            o.uuid = f"00000000-0000-0000-0000-{i:012d}"
+            o.collection = "Doc"
+            o.properties_json = _json.dumps({"body": body})
+            vec = [0.0] * D
+            vec[i % D] = 1.0
+            o.vector.values.extend(vec)
+        assert not client.batch_objects(req).errors
+
+        # operator=And on the hybrid keyword branch: only the doc with
+        # BOTH tokens may score on the sparse leg (alpha=0 = pure keyword)
+        q = pb.SearchRequest(collection="Doc", limit=5, use_hybrid=True,
+                             bm25_query="election vote",
+                             bm25_operator="And", alpha=0.0)
+        hits = client.search(q).results[0].hits
+        assert [h.uuid[-1:] for h in hits] == ["0"]
+
+        # minimum_match=1 admits all three
+        q = pb.SearchRequest(collection="Doc", limit=5, use_hybrid=True,
+                             bm25_query="election vote",
+                             bm25_minimum_match=1, alpha=0.0)
+        assert len(client.search(q).results[0].hits) == 3
+
+        # unknown fusion name -> INVALID_ARGUMENT
+        q = pb.SearchRequest(collection="Doc", limit=5, use_hybrid=True,
+                             bm25_query="election", fusion="bogusFusion",
+                             alpha=0.0)
+        with pytest.raises(grpc.RpcError) as exc:
+            client.search(q)
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        client.close()
+        api.shutdown()
+        db.close()
+
+
+def test_graphql_unknown_fusion_is_clean_error(col):
+    """GraphQL passes fusionType through verbatim; an unknown name comes
+    back as a clean error entry (no 500, no silent coercion)."""
+    from weaviate_tpu.api.graphql import GraphQLExecutor
+
+    ex = GraphQLExecutor(col_db(col))
+    out = ex.execute("""
+    { Get { Doc(hybrid: {query: "election", fusionType: "bogusFusion"},
+               limit: 3) { body } } }
+    """)
+    assert "errors" in out
+    assert "unknown fusion" in out["errors"][0]["message"]

@@ -618,8 +618,11 @@ class GraphQLExecutor:
                 query=h.get("query"),
                 vector=np.asarray(h["vector"], np.float32) if "vector" in h else None,
                 alpha=float(h.get("alpha", 0.75)),
-                fusion="rankedFusion"
-                if h.get("fusionType") == "rankedFusion" else "relativeScoreFusion",
+                # pass the name through VERBATIM: an unknown fusionType
+                # must surface as a clean invalid-argument error from
+                # query/fusion.validate_fusion, not be silently coerced
+                # to relativeScoreFusion (nor 500)
+                fusion=h.get("fusionType") or "relativeScoreFusion",
                 properties=h.get("properties"),
                 operator=str(hso.get("operator", "Or")),
                 minimum_match=int(
@@ -682,6 +685,41 @@ class GraphQLExecutor:
         return any(self.cluster.id not in st.replicas(s)
                    for s in range(st.n_shards))
 
+    def _needs_cluster_hybrid(self, p) -> bool:
+        """A plain hybrid Get against a collection with non-local shards
+        scatters BOTH legs through the coordinator
+        (``cluster/node.py:hybrid_search``) — fusion then normalizes
+        over the GLOBALLY merged candidate sets, never one node's
+        slice. Features the cluster hybrid API doesn't carry (filters,
+        search operators, groupBy, ...) keep the documented local path."""
+        if self.cluster is None or p.hybrid is None:
+            return False
+        h = p.hybrid
+        featured = (p.filters is not None or p.near_vector is not None
+                    or p.bm25_query is not None or p.near_text is not None
+                    or getattr(p, "ask", None) is not None
+                    or p.group_by is not None
+                    or getattr(p, "legacy_group", None) is not None
+                    or getattr(p, "sort", None)
+                    or getattr(p, "generate", None) is not None
+                    or getattr(p, "rerank", None) is not None
+                    or getattr(p, "summary", None) is not None
+                    or getattr(p, "tokens", None) is not None
+                    or p.offset or p.autocut
+                    or getattr(p, "autocorrect", False)
+                    or p.max_distance is not None
+                    or p.after is not None or p.targets
+                    or h.operator != "Or" or h.minimum_match
+                    or h.properties)
+        if featured:
+            return False
+        try:
+            st = self.cluster._state_for(p.collection)
+        except (KeyError, ValueError):
+            return False
+        return any(self.cluster.id not in st.replicas(s)
+                   for s in range(st.n_shards))
+
     def _get_class(self, cls: Field) -> list[dict]:
         params = self._params_from_args(cls.name, cls.args)
 
@@ -724,6 +762,28 @@ class GraphQLExecutor:
                 tenant=params.tenant, target=params.target_vector)
             return [self._render_object(cls.selections, obj, None, d)
                     for obj, d in rows]
+
+        if self._needs_cluster_hybrid(params):
+            from weaviate_tpu.query.fusion import validate_fusion
+
+            h = params.hybrid
+            # same invariant as the explorer path: reject unknown fusion
+            # names BEFORE any leg work or query vectorization
+            validate_fusion(h.fusion)
+            vec = h.vector
+            if vec is None and h.query:
+                col = self.db.get_collection(params.collection)
+                if col.config.vectorizer != "none" \
+                        and col.modules is not None:
+                    # text-only hybrid: vectorize for the dense leg,
+                    # exactly like the local explorer path does
+                    vec = self.explorer._query_vector(col, h.query)
+            rows = self.cluster.hybrid_search(
+                params.collection, query=h.query, vector=vec,
+                alpha=h.alpha, k=params.limit, fusion=h.fusion,
+                tenant=params.tenant, target=params.target_vector)
+            return [self._render_object(cls.selections, obj, s, None)
+                    for obj, s in rows]
 
         result = self.explorer.get(params)
 

@@ -290,8 +290,12 @@ class GrpcAPI:
                 if req.near_vectors else None,
                 # explicit presence: alpha=0.0 (pure keyword) is honored
                 alpha=float(req.alpha) if req.HasField("alpha") else 0.75,
+                # verbatim: an unknown name maps to INVALID_ARGUMENT via
+                # query/fusion.validate_fusion's ValueError, never a 500
                 fusion=req.fusion or "relativeScoreFusion",
                 properties=list(req.bm25_properties) or None,
+                operator=req.bm25_operator or "Or",
+                minimum_match=int(req.bm25_minimum_match),
             )
         elif req.near_vectors:
             params.near_vector = _np_from_vec(req.near_vectors[0])
@@ -300,6 +304,8 @@ class GrpcAPI:
         elif req.bm25_query:
             params.bm25_query = req.bm25_query
             params.bm25_properties = list(req.bm25_properties) or None
+            params.bm25_operator = req.bm25_operator or "Or"
+            params.bm25_minimum_match = int(req.bm25_minimum_match)
 
         result = self.explorer.get(params)
         qr = reply.results.add()

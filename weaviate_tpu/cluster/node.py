@@ -1267,6 +1267,132 @@ class ClusterNode:
         return [(StorageObject.from_bytes(blob), s)
                 for s, blob in results[:k]]
 
+    def hybrid_search(self, cls: str, query: Optional[str] = None,
+                      vector: Optional[np.ndarray] = None,
+                      alpha: float = 0.75, k: int = 10,
+                      fusion: str = "relativeScoreFusion",
+                      tenant: str = "", target: str = "",
+                      deadline: Optional[Deadline] = None) \
+            -> list[tuple[StorageObject, float]]:
+        """Coordinator-side hybrid: both leg scatters run CONCURRENTLY
+        under one deadline, then fusion runs over the GLOBALLY merged
+        per-leg candidate sets — relativeScoreFusion's min-max
+        normalization must span the whole corpus's candidates, because
+        normalizing per shard (or per node) skews scores exactly when
+        shards are unbalanced: a half-empty shard's weak best hit would
+        normalize to 1.0 and outrank a full shard's runner-up. Fusing
+        only the merged global top-fetch of each leg (what this does) is
+        the reference's semantics and what the single-node path computes.
+
+        Spans mirror the collection path (``hybrid.sparse`` /
+        ``hybrid.dense`` / ``hybrid.fuse``), so a cross-node hybrid's
+        leg overlap reads off one trace. The keyword leg keeps BM25's
+        best-effort stance on unreachable shards; a leg that outlives
+        the deadline sheds while the surviving leg's results still fuse.
+        """
+        from weaviate_tpu.monitoring import tracing
+        from weaviate_tpu.monitoring.metrics import (
+            HYBRID_LEG_SECONDS,
+            HYBRID_LEG_SHED,
+            HYBRID_REQUESTS,
+        )
+        from weaviate_tpu.query.fusion import (
+            fuse_result_sets,
+            hybrid_fetch,
+            validate_fusion,
+        )
+
+        validate_fusion(fusion)
+        deadline = self._op_deadline("hybrid_search", deadline)
+        deadline.require()
+        fetch = hybrid_fetch(k)
+        parent = tracing.current_span()
+        want_sparse = bool(query) and alpha < 1.0
+        want_dense = vector is not None and alpha > 0.0
+
+        sparse_box: list = [None, None]  # (result, error)
+
+        def sparse_leg():
+            try:
+                with tracing.use_span(parent), \
+                        tracing.TRACER.span("hybrid.sparse", k=fetch):
+                    t0 = time.perf_counter()
+                    sparse_box[0] = self.bm25_search(
+                        cls, query, fetch, tenant=tenant,
+                        deadline=deadline)
+                    HYBRID_LEG_SECONDS.observe(
+                        time.perf_counter() - t0, leg="sparse")
+            except BaseException as e:  # noqa: BLE001 — joined below
+                sparse_box[1] = e
+
+        th = None
+        if want_sparse:
+            # a dedicated thread, NOT the bounded pool: both legs nest
+            # _parallel_map shard scatters on that pool, and two pooled
+            # legs waiting on pooled shard futures can starve it closed
+            # under concurrent hybrid load
+            th = threading.Thread(target=sparse_leg, daemon=True,
+                                  name=f"hybrid-sparse-{self.id}")
+            th.start()
+
+        sets: list[list[tuple[str, float]]] = []
+        weights: list[float] = []
+        by_uuid: dict[str, StorageObject] = {}
+        dense = None
+        if want_dense:
+            try:
+                with tracing.TRACER.span("hybrid.dense", parent=parent,
+                                         k=fetch):
+                    t0 = time.perf_counter()
+                    dense = self.vector_search(cls, vector, fetch,
+                                               tenant=tenant,
+                                               target=target,
+                                               deadline=deadline)
+                    HYBRID_LEG_SECONDS.observe(
+                        time.perf_counter() - t0, leg="dense")
+            except TimeoutError:  # DeadlineExceeded
+                # symmetric shed: a dense leg over budget must not
+                # discard a sparse leg that finished in time
+                th_done = th is not None and not th.is_alive()
+                if not (th_done and sparse_box[0] is not None):
+                    raise
+                HYBRID_LEG_SHED.inc(leg="dense")
+                if parent is not None:
+                    parent.add_event("hybrid.leg_shed", leg="dense")
+        if th is not None:
+            th.join(timeout=max(0.0, deadline.remaining()) + 0.05)
+            if th.is_alive() or isinstance(sparse_box[1], TimeoutError):
+                HYBRID_LEG_SHED.inc(leg="sparse")
+                if parent is not None:
+                    parent.add_event("hybrid.leg_shed", leg="sparse")
+                if dense is None:
+                    deadline.require()
+                    raise DeadlineExceeded(
+                        f"hybrid_search: sparse leg outlived the "
+                        f"deadline ({deadline})")
+            elif sparse_box[1] is not None:
+                raise sparse_box[1]
+        # a live thread's partial result must not fuse: only a leg that
+        # FINISHED contributes
+        sparse = sparse_box[0] if th is None or not th.is_alive() \
+            else None
+        if sparse is not None:
+            sets.append([(o.uuid, s) for o, s in sparse])
+            weights.append(1.0 - alpha)
+            for o, _ in sparse:
+                by_uuid.setdefault(o.uuid, o)
+        if dense is not None:
+            sets.append([(o.uuid, -d) for o, d in dense])
+            weights.append(alpha)
+            for o, _ in dense:
+                by_uuid.setdefault(o.uuid, o)
+
+        with tracing.TRACER.span("hybrid.fuse", parent=parent,
+                                 fusion=fusion, legs=len(sets)):
+            fused = fuse_result_sets(sets, weights, k, fusion)
+        HYBRID_REQUESTS.inc(fusion=fusion)
+        return [(by_uuid[u], s) for u, s in fused if u in by_uuid]
+
     def _on_shard_bm25(self, msg: dict) -> dict:
         shard = self._local_shard(msg["class"], msg["shard"],
                                   msg.get("tenant", ""))

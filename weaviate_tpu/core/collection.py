@@ -13,6 +13,7 @@ import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout
 from contextlib import contextmanager
 from typing import Any, Optional
 
@@ -1123,10 +1124,21 @@ class Collection:
         shards = self._search_shards(tenant)
         per_shard: list[tuple[Shard, SearchResult]] = []
 
+        # pool workers inherit neither the request scope nor the
+        # dispatcher's thread-local batch-group token (the hybrid dense
+        # leg's identity) — capture both here, re-enter in run()
+        from weaviate_tpu.index.dispatch import (
+            current_dispatch_group,
+            dispatch_group,
+        )
+
+        group_token = current_dispatch_group()
+
         def run(shard: Shard):
             # pool threads don't inherit the caller's thread-local request
             # scope; re-enter it so the dispatcher sees the deadline
             with serving_ctx.request_scope(req_ctx), \
+                    dispatch_group(group_token), \
                     REPORTER.track("vector", collection=self.config.name,
                                    shard=shard.name) as tr:
                 allow = None
@@ -1190,8 +1202,15 @@ class Collection:
         operator: str = "Or",
         minimum_match: int = 0,
         deadline=None,
+        device_scoring: bool = False,
     ) -> list[tuple[StorageObject, float]]:
+        """``device_scoring``: score via the segmented device kernels
+        (``ops/sparse.py``) instead of BlockMax-WAND — the hybrid path
+        sets it for filtered legs, where WAND's skipping advantage
+        collapses. A shard whose tier can't serve it (segment-resident
+        postings, mesh min-match) falls back to WAND and latches."""
         from weaviate_tpu.monitoring.metrics import (
+            HYBRID_FALLBACK,
             QUERIES_TOTAL,
             QUERY_DURATION,
         )
@@ -1212,11 +1231,49 @@ class Collection:
                 space = max(shard._next_doc_id, 1)
                 if flt is not None:
                     allow = shard.allow_list(flt, space)
-                ids, scores = shard.inverted.bm25_search(
-                    query, k, properties=properties, allow_list=allow,
-                    doc_space=space, operator=operator,
-                    minimum_match=minimum_match,
-                )
+                hit = None
+                if device_scoring:
+                    reason = None
+                    try:
+                        hit = shard.inverted.bm25_device_search(
+                            query, k, properties=properties,
+                            allow_list=allow, doc_space=space,
+                            operator=operator,
+                            minimum_match=minimum_match,
+                        )
+                        if hit is None:
+                            reason = "unsupported"
+                    except TimeoutError:
+                        raise  # a spent deadline is a shed, not a tier
+                    except Exception as e:
+                        # device tier down (OOM, lowering failure): the
+                        # leg still serves from WAND — latched, never a
+                        # request failure
+                        import logging
+
+                        hit, reason = None, "device_error"
+                        logging.getLogger(
+                            "weaviate_tpu.core.collection").warning(
+                            "device sparse scoring fell back to WAND "
+                            "(%s/%s): %s", self.config.name, shard.name,
+                            e)
+                    if reason is not None:
+                        from weaviate_tpu.monitoring import tracing
+
+                        HYBRID_FALLBACK.inc(stage="sparse",
+                                            reason=reason)
+                        span = tracing.current_span()
+                        if span is not None:
+                            span.add_event("hybrid.sparse.fallback",
+                                           reason=reason,
+                                           shard=shard.name)
+                if hit is None:
+                    hit = shard.inverted.bm25_search(
+                        query, k, properties=properties, allow_list=allow,
+                        doc_space=space, operator=operator,
+                        minimum_match=minimum_match,
+                    )
+                ids, scores = hit
                 for i, s in zip(ids, scores):
                     results.append((float(s), shard, int(i)))
             results.sort(key=lambda t: -t[0])
@@ -1249,38 +1306,139 @@ class Collection:
         ``alpha`` weighs the vector branch (1.0 = pure vector, 0.0 = pure
         keyword). Vector-branch scores enter fusion as negated distances so
         "higher is better" holds for both branches.
-        """
-        from weaviate_tpu.query.fusion import FUSION_ALGORITHMS
 
-        fuse = FUSION_ALGORITHMS.get(fusion)
-        if fuse is None:
-            raise ValueError(f"unknown fusion algorithm {fusion!r}")
-        fetch = max(k, 20)  # give fusion room beyond the final page
+        One overlapped, device-fused pipeline (docs/hybrid.md): the
+        sparse leg runs on the bounded pool CONCURRENTLY with the dense
+        leg on this thread — wall time tracks max(leg), not the sum —
+        both under the request's serving deadline and inside the ingress
+        trace (``hybrid.sparse`` / ``hybrid.dense`` / ``hybrid.fuse``
+        child spans). Fusion itself is ONE jitted device dispatch
+        (``ops/fusion.py``) with the host twin as the latching fallback;
+        each leg over-fetches ``hybrid_overfetch_factor``·k so fusion has
+        room beyond the final page (autocut then trims the FUSED
+        ranking, never a pre-cut leg). A slow sparse leg sheds at the
+        deadline while the dense results still fuse.
+        """
+        from weaviate_tpu.index.dispatch import dispatch_group
+        from weaviate_tpu.monitoring import tracing
+        from weaviate_tpu.monitoring.metrics import (
+            HYBRID_LEG_SECONDS,
+            HYBRID_LEG_SHED,
+            HYBRID_REQUESTS,
+        )
+        from weaviate_tpu.monitoring.tracing import TRACER
+        from weaviate_tpu.query.fusion import (
+            fuse_result_sets,
+            hybrid_fetch,
+            validate_fusion,
+        )
+        from weaviate_tpu.serving import context as serving_ctx
+        from weaviate_tpu.utils.runtime_config import HYBRID_SPARSE_DEVICE
+
+        validate_fusion(fusion)
+        req_ctx = serving_ctx.current()
+        deadline = req_ctx.deadline if req_ctx is not None else None
+        if deadline is not None:
+            deadline.require()
+        # ceil(factor * k) per leg (shared helper — prewarm warms the
+        # same shapes); the old hardcoded max(k, 20) silently starved
+        # fusion for k beyond ~20
+        fetch = hybrid_fetch(k)
+        parent = tracing.current_span()
+        want_sparse = bool(query) and alpha < 1.0
+        want_dense = vector is not None and alpha > 0.0
+        sparse_mode = str(HYBRID_SPARSE_DEVICE.get()).lower()
+        if sparse_mode in ("off", "0", "false"):
+            device_sparse = False
+        elif sparse_mode in ("on", "1", "true"):
+            device_sparse = True
+        else:  # auto: filtered legs, where WAND's advantage collapses
+            device_sparse = flt is not None
+
+        def sparse_leg():
+            # pool thread: re-enter the request scope (deadline) and the
+            # ingress trace so the leg's span overlaps the dense leg's
+            with serving_ctx.request_scope(req_ctx), \
+                    TRACER.span("hybrid.sparse", parent=parent, k=fetch,
+                                device_scoring=device_sparse):
+                t0 = time.perf_counter()
+                out = self.bm25_search(
+                    query, fetch, properties=properties, flt=flt,
+                    tenant=tenant, operator=operator,
+                    minimum_match=minimum_match,
+                    device_scoring=device_sparse,
+                )
+                HYBRID_LEG_SECONDS.observe(time.perf_counter() - t0,
+                                           leg="sparse")
+                return out
+
+        sparse_future = self._pool.submit(sparse_leg) if want_sparse \
+            else None
+
         sets: list[list[tuple[str, float]]] = []
         weights: list[float] = []
-        by_uuid: dict[str, tuple[StorageObject, float]] = {}
+        by_uuid: dict[str, StorageObject] = {}
+        dense = None
+        if want_dense:
+            try:
+                with TRACER.span("hybrid.dense", parent=parent,
+                                 k=fetch), \
+                        dispatch_group(("hybrid", fusion)):
+                    t0 = time.perf_counter()
+                    dense = self.vector_search(
+                        vector, fetch, target=target, flt=flt,
+                        tenant=tenant,
+                        max_distance=max_vector_distance,
+                    )
+                    HYBRID_LEG_SECONDS.observe(time.perf_counter() - t0,
+                                               leg="dense")
+            except TimeoutError:  # DeadlineExceeded
+                # shed symmetrically: a dense leg that outlives the
+                # budget must not discard a sparse leg that FINISHED in
+                # time — only with no completed sparse page does the
+                # request itself shed
+                if sparse_future is None or not sparse_future.done():
+                    raise
+                HYBRID_LEG_SHED.inc(leg="dense")
+                if parent is not None:
+                    parent.add_event("hybrid.leg_shed", leg="dense")
 
-        if query and alpha < 1.0:
-            sparse = self.bm25_search(
-                query, fetch, properties=properties, flt=flt, tenant=tenant,
-                operator=operator, minimum_match=minimum_match,
-            )
+        sparse = None
+        if sparse_future is not None:
+            try:
+                timeout = None
+                if deadline is not None:
+                    timeout = max(0.0, deadline.remaining())
+                sparse = sparse_future.result(timeout=timeout)
+            except (TimeoutError, FuturesTimeout):
+                # DeadlineExceeded subclasses TimeoutError; the wait
+                # timeout raises futures.TimeoutError (distinct on 3.10)
+                # the slow leg sheds; the other leg's results still fuse
+                # (with no surviving leg the request itself is over
+                # deadline and sheds below)
+                HYBRID_LEG_SHED.inc(leg="sparse")
+                if parent is not None:
+                    parent.add_event("hybrid.leg_shed", leg="sparse")
+                if dense is None:
+                    if deadline is not None:
+                        deadline.require()  # -> DeadlineExceeded
+                    raise
+        if sparse is not None:
             sets.append([(o.uuid, s) for o, s in sparse])
             weights.append(1.0 - alpha)
             for o, _ in sparse:
-                by_uuid.setdefault(o.uuid, (o, 0.0))
-        if vector is not None and alpha > 0.0:
-            dense = self.vector_search(
-                vector, fetch, target=target, flt=flt, tenant=tenant,
-                max_distance=max_vector_distance,
-            )
+                by_uuid.setdefault(o.uuid, o)
+        if dense is not None:
             sets.append([(o.uuid, -d) for o, d in dense])
             weights.append(alpha)
             for o, _ in dense:
-                by_uuid.setdefault(o.uuid, (o, 0.0))
+                by_uuid.setdefault(o.uuid, o)
 
-        fused = fuse(sets, weights, k)
-        return [(by_uuid[u][0], s) for u, s in fused if u in by_uuid]
+        with TRACER.span("hybrid.fuse", parent=parent, fusion=fusion,
+                         legs=len(sets)):
+            fused = fuse_result_sets(sets, weights, k, fusion)
+        HYBRID_REQUESTS.inc(fusion=fusion)
+        return [(by_uuid[u], s) for u, s in fused if u in by_uuid]
 
     def multi_target_search(
         self,

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, Optional
 
 import numpy as np
@@ -49,11 +50,37 @@ from weaviate_tpu.monitoring.metrics import (
     DISPATCH_QUEUE_WAIT,
 )
 
+# Thread-scoped batch-group identity: requests enqueued under different
+# tokens never share one device batch, and the token lands on the
+# ``dispatch.batch`` span. The hybrid path scopes its DENSE leg with
+# ("hybrid", fusion) so hybrid batches stay attributable (the bench's
+# queue-vs-device split reads them off dispatch.batch spans) and a leg
+# feeding a device fusion consumer never coalesces with plain searches
+# whose latency profile it would distort. Same mechanism family as the
+# prewarm isolation token — but owned HERE, folded into grouping for
+# every index path without touching their signatures.
+_group_tls = threading.local()
+
+
+@contextmanager
+def dispatch_group(token):
+    """Scope a batch-group identity token onto the current thread."""
+    prev = getattr(_group_tls, "token", None)
+    _group_tls.token = token
+    try:
+        yield
+    finally:
+        _group_tls.token = prev
+
+
+def current_dispatch_group():
+    return getattr(_group_tls, "token", None)
+
 
 class _Req:
     __slots__ = ("queries", "k", "allow", "mask_key", "tier_key",
                  "deadline", "event", "ids", "dists", "error", "span",
-                 "enq_t", "rerank")
+                 "enq_t", "rerank", "group_key")
 
     def __init__(self, queries: np.ndarray, k: int, allow, deadline=None,
                  tier_key=None, rerank=None):
@@ -66,6 +93,10 @@ class _Req:
         # must never share one device batch, because the module instance
         # is a static argument of the batch's compiled program
         self.rerank = rerank
+        # batch-group identity token of the enqueuing thread (see
+        # dispatch_group above): read ONCE here so the leader's grouping
+        # scan compares plain attributes
+        self.group_key = current_dispatch_group()
         # residency-tier generation (tiering/): requests enqueued against
         # different residency epochs must never share one device batch —
         # a tenant demoted (or promoted) between enqueue and drain would
@@ -212,6 +243,7 @@ class CoalescingDispatcher:
             while i < len(self._pending) and rows < self.max_batch:
                 r = self._pending[i]
                 if r.k == head.k and r.tier_key == head.tier_key \
+                        and r.group_key == head.group_key \
                         and _rerank_key(r) == head_rr \
                         and _masks_equal(head, r):
                     group.append(self._pending.pop(i))
@@ -234,6 +266,10 @@ class CoalescingDispatcher:
         if parent is None or not parent.sampled:
             parent = sampled[0].span
         attrs = {}
+        if group[0].group_key is not None:
+            # e.g. ("hybrid", "relativeScoreFusion"): lets trace readers
+            # and the bench's queue-vs-device split select hybrid batches
+            attrs["group"] = str(group[0].group_key)
         if group[0].rerank is not None:
             # the fused rerank stage rides this batch's program; the
             # module name makes its device time attributable per batch

@@ -11,7 +11,6 @@ startup (the store is the WAL).
 
 from __future__ import annotations
 
-import math
 from collections import defaultdict
 from contextlib import contextmanager
 from typing import Any, Optional
@@ -330,6 +329,207 @@ class InvertedIndex:
             return None
         return plist.arrays()[0]
 
+    def _parse_props(self, properties: Optional[list[str]]) \
+            -> list[tuple[str, float]]:
+        """Searched (prop, boost) pairs from the request's "prop^boost"
+        strings; None/empty = every searchable property."""
+        if properties is None or not properties:
+            properties = [
+                p.name for p in self.config.properties
+                if self._searchable(p.name)
+            ] or list(self.postings.keys())
+        props: list[tuple[str, float]] = []
+        for p in properties:
+            if "^" in p:
+                name, boost = p.split("^", 1)
+                props.append((name, float(boost)))
+            else:
+                props.append((p, 1.0))
+        return props
+
+    def _weighted_query_terms(
+        self, query: str, props: list[tuple[str, float]], n_docs: int,
+        all_tokens: dict[str, int],
+    ) -> list[tuple[str, str, float, float, int]]:
+        """[(prop, term, weight=boost*idf, avgdl, distinct-token group)]
+        for every (searched prop, present query term) pair — the shared
+        query-plan assembly of the native WAND engine and the segmented
+        device kernels (``ops/sparse.py``), so their weights can never
+        drift from the dense python scorer's."""
+        from weaviate_tpu.inverted.native_bm25 import bm25_idf
+
+        out: list[tuple[str, str, float, float, int]] = []
+        for prop, boost in props:
+            prop_postings = self.postings.get(prop)
+            if not prop_postings:
+                continue
+            lengths = self.doc_lengths.get(prop, {})
+            avg_len = (self.len_totals[prop] / len(lengths)) \
+                if lengths else 1.0
+            terms = [
+                t for t in tokenize(query, self._tokenization(prop))
+                if t not in self.stopwords
+            ]
+            for term in set(terms):
+                plist = prop_postings.get(term)
+                if not plist:
+                    continue
+                out.append((prop, term, boost * bm25_idf(n_docs, len(plist)),
+                            max(avg_len, 1e-9), all_tokens[term]))
+        return out
+
+    def bm25_device_search(
+        self,
+        query: str,
+        k: int,
+        properties: Optional[list[str]] = None,
+        allow_list: Optional[np.ndarray] = None,
+        doc_space: int = 0,
+        operator: str = "Or",
+        minimum_match: int = 0,
+    ) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Filtered BM25F scored ON DEVICE (``ops/sparse.py``): the query
+        terms' postings flatten into one segmented entry list, one jitted
+        scatter-score + top-k answers — and with a mesh active the
+        entries partition by doc row-block along the same ``shard`` axis
+        as the dense planes (``parallel.sharded_search.sharded_sparse_topk``).
+
+        Same contract as ``bm25_search`` ((doc_ids, scores) descending),
+        or ``None`` when this query cannot ride the device path (no
+        python postings for the query's terms, or a min-match query on
+        the mesh) — callers fall back to the WAND/host tier and latch.
+        """
+        from weaviate_tpu.ops import sparse as sops
+
+        props = self._parse_props(properties)
+        n_docs = max(1, self.doc_count)
+        all_tokens, min_match = self._min_match_groups(
+            query, props, operator, minimum_match)
+        weighted = self._weighted_query_terms(query, props, n_docs,
+                                              all_tokens)
+        if not weighted:
+            return np.empty(0, np.int64), np.empty(0, np.float32)
+
+        rows_p, tf_p, dl_p, w_p, ad_p, g_p = [], [], [], [], [], []
+        for prop, term, w, avgdl, grp in weighted:
+            plist = self.postings[prop][term]
+            ids, tfs = plist.arrays()
+            if not len(ids):
+                continue
+            lengths = self.doc_lengths.get(prop)
+            dls = (lengths.gather(ids) if lengths is not None
+                   else np.zeros(len(ids), np.float32))
+            rows_p.append(np.asarray(ids, np.int64))
+            tf_p.append(np.asarray(tfs, np.float32))
+            dl_p.append(np.asarray(dls, np.float32))
+            w_p.append(np.full(len(ids), w, np.float32))
+            ad_p.append(np.full(len(ids), avgdl, np.float32))
+            g_p.append(np.full(len(ids), grp, np.int32))
+        if not rows_p:
+            return np.empty(0, np.int64), np.empty(0, np.float32)
+        rows = np.concatenate(rows_p)
+        space = max(doc_space, int(rows.max()) + 1)
+
+        # eligibility = live docs ∧ the filter's allow mask
+        keep = self.columnar.live_mask(space).copy()
+        if allow_list is not None:
+            al = np.asarray(allow_list, bool)
+            if al.shape[0] < space:
+                al = np.pad(al, (0, space - al.shape[0]))
+            keep &= al[:space]
+
+        from weaviate_tpu.parallel import runtime as mesh_runtime
+
+        mesh = mesh_runtime.default_mesh()
+        if mesh is not None and min_match <= 1:
+            vals, ids_out = self._device_sparse_mesh(
+                mesh, rows, tf_p, dl_p, w_p, ad_p, keep, space, k)
+        elif mesh is not None:
+            return None  # min-match on the mesh: WAND fallback, latched
+        else:
+            vals, ids_out = self._device_sparse_single(
+                rows, tf_p, dl_p, w_p, ad_p, g_p, keep, space, k,
+                min_match, len(all_tokens))
+            sops.count_dispatch()
+        ids_np = np.asarray(ids_out).reshape(-1)
+        vals_np = np.asarray(vals).reshape(-1)
+        live = ids_np >= 0
+        return ids_np[live].astype(np.int64), vals_np[live]
+
+    def _device_sparse_single(self, rows, tf_p, dl_p, w_p, ad_p, g_p,
+                              keep, space, k, min_match, n_tokens):
+        """Single-device dispatch: pad entries + doc space to their pow2
+        buckets (the programs are shared across queries of a shape)."""
+        from weaviate_tpu.ops import sparse as sops
+        from weaviate_tpu.ops.fusion import bucket
+
+        p_len = bucket(len(rows))
+        s_len = bucket(space, floor=bucket(k))
+        r = np.full(p_len, -1, np.int32)
+        r[:len(rows)] = rows
+        tf = np.zeros(p_len, np.float32)
+        tf[:len(rows)] = np.concatenate(tf_p)
+        dl = np.zeros(p_len, np.float32)
+        dl[:len(rows)] = np.concatenate(dl_p)
+        w = np.zeros(p_len, np.float32)
+        w[:len(rows)] = np.concatenate(w_p)
+        ad = np.ones(p_len, np.float32)
+        ad[:len(rows)] = np.concatenate(ad_p)
+        allow = np.zeros(s_len, bool)
+        allow[:space] = keep
+        kk = min(k, s_len)
+        if min_match > 1:
+            g = np.zeros(p_len, np.int32)
+            g[:len(rows)] = np.concatenate(g_p)
+            return sops.sparse_score_topk_min_match(
+                r, tf, dl, w, ad, g, allow, kk, float(self.k1),
+                float(self.b), bucket(max(1, n_tokens), floor=2),
+                int(min_match))
+        return sops.sparse_score_topk(r, tf, dl, w, ad, allow, kk,
+                                      float(self.k1), float(self.b))
+
+    def _device_sparse_mesh(self, mesh, rows, tf_p, dl_p, w_p, ad_p,
+                            keep, space, k):
+        """Mesh dispatch: entries partition by doc row-block along the
+        shard axis (the same membership rule as the dense planes), the
+        allow mask row-shards beside them, and the kernel's all_gather
+        merge returns the replicated global page."""
+        from weaviate_tpu.ops.fusion import bucket
+        from weaviate_tpu.parallel.mesh import mesh_size
+        from weaviate_tpu.parallel.sharded_search import sharded_sparse_topk
+
+        n_shards = mesh_size(mesh)
+        kk = min(k, max(1, space))
+        s_local = bucket(-(-space // n_shards), floor=bucket(kk))
+        s_len = s_local * n_shards
+        tf = np.concatenate(tf_p)
+        dl = np.concatenate(dl_p)
+        w = np.concatenate(w_p)
+        ad = np.concatenate(ad_p)
+        shard_of = rows // s_local
+        p_max = bucket(max(1, int(np.bincount(
+            shard_of, minlength=n_shards).max())))
+        m_rows = np.full((n_shards, p_max), -1, np.int32)
+        m_tf = np.zeros((n_shards, p_max), np.float32)
+        m_dl = np.zeros((n_shards, p_max), np.float32)
+        m_w = np.zeros((n_shards, p_max), np.float32)
+        m_ad = np.ones((n_shards, p_max), np.float32)
+        for s in range(n_shards):
+            sel = shard_of == s
+            n = int(sel.sum())
+            if not n:
+                continue
+            m_rows[s, :n] = rows[sel] - s * s_local
+            m_tf[s, :n] = tf[sel]
+            m_dl[s, :n] = dl[sel]
+            m_w[s, :n] = w[sel]
+            m_ad[s, :n] = ad[sel]
+        allow = np.zeros(s_len, bool)
+        allow[:space] = keep
+        return sharded_sparse_topk(m_rows, m_tf, m_dl, m_w, m_ad, allow,
+                                   min(kk, s_local), float(self.k1),
+                                   float(self.b), mesh)
+
     def bm25_search(
         self,
         query: str,
@@ -350,19 +550,7 @@ class InvertedIndex:
 
         Returns (doc_ids [<=k], scores [<=k]) sorted by descending score.
         """
-        if properties is None or not properties:
-            properties = [
-                p.name for p in self.config.properties if self._searchable(p.name)
-            ] or list(self.postings.keys())
-        # parse "prop^boost"
-        props: list[tuple[str, float]] = []
-        for p in properties:
-            if "^" in p:
-                name, boost = p.split("^", 1)
-                props.append((name, float(boost)))
-            else:
-                props.append((p, 1.0))
-
+        props = self._parse_props(properties)
         n_docs = max(1, self.doc_count)
         all_tokens, min_match = self._min_match_groups(
             query, props, operator, minimum_match)
@@ -371,27 +559,10 @@ class InvertedIndex:
         # mask into the engine (WAND skipping stays active; reference WAND
         # consumes AllowLists the same way)
         if self.native is not None:
-            query_terms = []
-            groups = []
-            for prop, boost in props:
-                prop_postings = self.postings.get(prop)
-                if not prop_postings:
-                    continue
-                lengths = self.doc_lengths.get(prop, {})
-                avg_len = (self.len_totals[prop] / len(lengths)) if lengths else 1.0
-                terms = [
-                    t for t in tokenize(query, self._tokenization(prop))
-                    if t not in self.stopwords
-                ]
-                for term in set(terms):
-                    plist = prop_postings.get(term)
-                    if not plist:
-                        continue
-                    df = len(plist)
-                    idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
-                    query_terms.append(
-                        (prop, term, boost * idf, max(avg_len, 1e-9)))
-                    groups.append(all_tokens[term])
+            weighted = self._weighted_query_terms(query, props, n_docs,
+                                                  all_tokens)
+            query_terms = [(p, t, w, a) for p, t, w, a, _ in weighted]
+            groups = [g for _, _, _, _, g in weighted]
             return self.native.search(query_terms, k, allow=allow_list,
                                       groups=groups, min_match=min_match)
 
@@ -429,8 +600,9 @@ class InvertedIndex:
                 plist = prop_postings.get(term)
                 if plist is None or not len(plist):
                     continue
-                df = len(plist)
-                idf = math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+                from weaviate_tpu.inverted.native_bm25 import bm25_idf
+
+                idf = bm25_idf(n_docs, len(plist))
                 ids, tfs_u = plist.arrays()
                 tfs = tfs_u.astype(np.float32)
                 dls = (

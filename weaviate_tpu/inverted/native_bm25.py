@@ -62,6 +62,16 @@ def _bind():
     return lib
 
 
+def bm25_idf(n_docs: int, df: int) -> float:
+    """The one BM25 idf definition every scoring tier shares — the
+    native WAND engine, the dense python path, and the segmented device
+    kernels (``ops/sparse.py``) all weight terms with exactly this, so
+    their scores agree up to float32 rounding."""
+    import math
+
+    return math.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+
+
 @functools.lru_cache(maxsize=262_144)
 def term_id(prop: str, term: str) -> int:
     """64-bit id for a (property, term) pair — the native engine's key.

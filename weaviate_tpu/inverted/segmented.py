@@ -557,6 +557,13 @@ class SegmentedInvertedIndex(InvertedIndex):
         ids, _, _ = self._posts(prop).postings_get(token.encode("utf-8"))
         return ids if len(ids) else None
 
+    def bm25_device_search(self, query: str, k: int, **kw):
+        """The segment tier keeps postings in LSM buckets, not the RAM
+        dicts the device assembly reads — declining here routes filtered
+        hybrid legs to the WAND/stream path (callers latch the fallback
+        in ``weaviate_tpu_hybrid_fallback_total``)."""
+        return None
+
     def bm25_search(self, query: str, k: int,
                     properties: Optional[list[str]] = None,
                     allow_list: Optional[np.ndarray] = None,

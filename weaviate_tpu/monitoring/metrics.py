@@ -310,6 +310,31 @@ RERANK_CANDIDATES = REGISTRY.histogram(
     "fused pool width), by module",
     buckets=(8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 16384))
 
+# hybrid search instruments (core/collection.py hybrid_search +
+# query/fusion.py + ops/{fusion,sparse}.py): request mix by fusion
+# algorithm, per-leg latency (the overlap story: hybrid wall time should
+# track max(leg), not sum), legs shed at the deadline, and every drop out
+# of the device fusion/sparse tiers — the fallback is never silent
+HYBRID_REQUESTS = REGISTRY.counter(
+    "weaviate_tpu_hybrid_requests_total",
+    "hybrid searches served, by fusion algorithm (rankedFusion/"
+    "relativeScoreFusion)")
+HYBRID_LEG_SECONDS = REGISTRY.histogram(
+    "weaviate_tpu_hybrid_leg_seconds",
+    "wall time of one hybrid leg, by leg (sparse = BM25, dense = vector) "
+    "— the legs run CONCURRENTLY, so request wall time should track the "
+    "max, not the sum")
+HYBRID_LEG_SHED = REGISTRY.counter(
+    "weaviate_tpu_hybrid_leg_shed_total",
+    "hybrid legs abandoned at the request deadline while the other leg's "
+    "results still fused, by leg")
+HYBRID_FALLBACK = REGISTRY.counter(
+    "weaviate_tpu_hybrid_fallback_total",
+    "hybrid stages that fell off the device tier onto the host twin, by "
+    "stage (fuse = query/fusion.py dict merge, sparse = WAND/host "
+    "keyword scoring) and reason (disabled/device_error/unsupported); "
+    "each also lands a span event — the fallback tier is never silent")
+
 # mesh-sharded device beam instruments (ops/device_beam.py mesh kernel +
 # parallel/): shard skew and accidental per-shard dispatch regressions are
 # alertable — one logical index across all chips must stay ONE dispatch

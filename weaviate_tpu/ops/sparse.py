@@ -1,0 +1,116 @@
+"""Segmented sparse (BM25) scoring on device.
+
+Reference: ``inverted/bm25_searcher_block.go`` scores postings with
+BlockMax-WAND on the CPU — the right engine for an UNFILTERED top-k,
+where WAND's upper-bound skipping prunes most of the posting mass. Under
+a selective filter that advantage collapses (every skipped block must
+still be probed against the allow list, and the survivors are few), so
+the filtered hybrid path moves the arithmetic to the device instead: the
+query's term postings flatten into one segmented entry list (doc row,
+tf, doc length, per-term weight = boost·idf, per-property avgdl), a
+single scatter-add materializes every doc's BM25F score, the allow mask
+gates eligibility, and one ``top_k`` selects the page — one jitted
+dispatch per (entry-bucket, doc-space-bucket) shape, batched exactly
+like the dense planes.
+
+The formula matches ``inverted/index.py``'s dense python path (and the
+native engine) term for term:
+
+    denom = tf + k1 * (1 - b + b * dl / avgdl)
+    score += w * tf * (k1 + 1) / max(denom, 1e-9)
+
+so host-vs-device scores agree up to float32 rounding, and ``top_k``'s
+lower-index-wins tie-break reproduces the host's stable ascending-doc-id
+order. The mesh variant lives in ``parallel/sharded_search.py``
+(``sharded_sparse_topk``): entries partition by doc row-block along the
+same ``shard`` axis as the dense planes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Test/ops hook (mirrors ops.device_beam.dispatch_count): segmented
+# sparse-scoring programs dispatched by this process.
+_dispatch_count = 0
+
+
+def dispatch_count() -> int:
+    return _dispatch_count
+
+
+def count_dispatch() -> None:
+    """Callers that run the kernels directly (the mesh wrapper in
+    parallel/) record their dispatch here so the hook stays truthful."""
+    global _dispatch_count
+    _dispatch_count += 1
+
+
+def entry_scores(tf, dl, w, avgdl, k1: float, b: float):
+    """Per-posting-entry BM25 contribution (shared by the single-device
+    and mesh kernels; k1/b are jit-static per-index constants)."""
+    denom = tf + k1 * (1.0 - b + b * dl / jnp.maximum(avgdl, 1e-9))
+    return w * tf * (k1 + 1.0) / jnp.maximum(denom, 1e-9)
+
+
+def scatter_doc_scores(rows, contrib, ok, space: int):
+    """Scatter per-entry contributions into the doc-space accumulator.
+    Returns (scores [space], touched [space])."""
+    r = jnp.where(ok, rows, 0)
+    zero = jnp.float32(0.0)
+    scores = jnp.zeros(space, jnp.float32).at[r].add(
+        jnp.where(ok, contrib, zero), mode="drop")
+    touched = jnp.zeros(space, jnp.float32).at[r].add(
+        ok.astype(jnp.float32), mode="drop") > 0
+    return scores, touched
+
+
+def masked_score_topk(scores, keep, k: int):
+    """Descending top-k over eligible docs; ineligible ids come back -1."""
+    neg_inf = jnp.float32(-jnp.inf)
+    ranked = jnp.where(keep, scores, neg_inf)
+    vals, ids = jax.lax.top_k(ranked, k)
+    live = jnp.isfinite(vals)
+    return (jnp.where(live, vals, jnp.float32(0.0)),
+            jnp.where(live, ids.astype(jnp.int32), -1))
+
+
+@functools.partial(jax.jit, static_argnames=("k", "k1", "b"))
+def sparse_score_topk(rows, tf, dl, w, avgdl, allow, k: int,
+                      k1: float, b: float):
+    """Filtered BM25F top-k in one dispatch.
+
+    rows [P] int32 doc ids (-1 = pad); tf/dl/w/avgdl [P] f32 per-entry
+    operands; allow [S] bool (filter AND live mask, padded doc space).
+    Returns (scores [k] f32 desc, ids [k] int32, -1 where exhausted).
+    """
+    ok = rows >= 0
+    contrib = entry_scores(tf, dl, w, avgdl, k1, b)
+    scores, touched = scatter_doc_scores(rows, contrib, ok, allow.shape[0])
+    return masked_score_topk(scores, touched & allow, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "k1", "b", "n_groups",
+                                             "min_match"))
+def sparse_score_topk_min_match(rows, tf, dl, w, avgdl, grp, allow, k: int,
+                                k1: float, b: float, n_groups: int,
+                                min_match: int):
+    """``sparse_score_topk`` with the reference's SearchOperatorOptions:
+    a doc is eligible only when it matches at least ``min_match``
+    DISTINCT query-token groups (``grp`` [P] int32: the distinct-token
+    group of each entry — one token fanning out across properties in
+    BM25F must count once). ``n_groups`` is the pow2-padded group count.
+    """
+    ok = rows >= 0
+    contrib = entry_scores(tf, dl, w, avgdl, k1, b)
+    space = allow.shape[0]
+    scores, touched = scatter_doc_scores(rows, contrib, ok, space)
+    flat = jnp.where(ok, grp, 0) * space + jnp.where(ok, rows, 0)
+    pres = jnp.zeros(n_groups * space, jnp.float32).at[flat].add(
+        ok.astype(jnp.float32), mode="drop")
+    matched = (pres.reshape(n_groups, space) > 0).sum(axis=0)
+    keep = touched & allow & (matched >= min_match)
+    return masked_score_topk(scores, keep, k)

@@ -463,6 +463,69 @@ def sharded_take(
         return _sharded_take_jit(corpus, ids, mesh=mesh, axis=axis)
 
 
+def _local_sparse_topk(rows, tf, dl, w, avgdl, allow_local, k, k1, b, axis):
+    """Per-shard segmented BM25 scoring + the cross-shard merge.
+
+    Entry arrays arrive [1, P] (one row of the host-partitioned
+    [n_shards, P] layout — every entry already belongs to THIS shard's
+    doc row-block, in LOCAL row indices); allow_local is this shard's
+    slice of the doc-space mask. Local scatter-score + top-k, then the
+    same tiled all_gather merge the dense planes use — BM25 scores
+    negate into "ascending = better" so ``merge_across_shards`` applies
+    unchanged, and a fully-banned shard contributes only masked slots.
+    """
+    from weaviate_tpu.ops import sparse as sops
+    from weaviate_tpu.ops.topk import merge_across_shards
+
+    rows = rows.reshape(-1)
+    ok = rows >= 0
+    contrib = sops.entry_scores(tf.reshape(-1), dl.reshape(-1),
+                                w.reshape(-1), avgdl.reshape(-1), k1, b)
+    space_local = allow_local.shape[0]
+    scores, touched = sops.scatter_doc_scores(rows, contrib, ok,
+                                              space_local)
+    vals, ids = sops.masked_score_topk(scores, touched & allow_local, k)
+    base = jax.lax.axis_index(axis) * space_local
+    gids = jnp.where(ids >= 0, ids + base, 0)
+    negv = jnp.where(ids >= 0, -vals, MASK_DISTANCE)
+    d, gi = merge_across_shards(negv[None, :], gids[None, :], k, axis)
+    return jnp.where(gi >= 0, -d, jnp.float32(0.0)), gi
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "k1", "b", "mesh", "axis"))
+def _sharded_sparse_topk_jit(rows, tf, dl, w, avgdl, allow, k: int,
+                             k1: float, b: float,
+                             mesh: Optional[Mesh] = None,
+                             axis: str = SHARD_AXIS):
+    fn = _shard_map(
+        functools.partial(_local_sparse_topk, k=k, k1=k1, b=b, axis=axis),
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis, None),
+                  P(axis, None), P(axis, None), P(axis)),
+        out_specs=(P(None, None), P(None, None)),
+    )
+    return fn(rows, tf, dl, w, avgdl, allow)
+
+
+def sharded_sparse_topk(rows, tf, dl, w, avgdl, allow, k: int,
+                        k1: float, b: float, mesh: Mesh,
+                        axis: str = SHARD_AXIS):
+    """Mesh entry for the segmented sparse BM25 path (ops/sparse.py):
+    entries pre-partitioned by doc row-block along the shard axis
+    ([n_shards, P] leading dim), allow mask [S] row-sharded like the
+    dense planes. Replicated ([1, k] scores desc, [1, k] global ids,
+    -1 where exhausted). The all_gather merge makes this a collective
+    program, so the dispatch takes the order lock."""
+    from weaviate_tpu.ops import sparse as sops
+
+    with _DISPATCH_LOCK:
+        out = _sharded_sparse_topk_jit(rows, tf, dl, w, avgdl, allow, k,
+                                       k1, b, mesh=mesh, axis=axis)
+    sops.count_dispatch()
+    return out
+
+
 def _local_step(c_local, v_local, ids, vecs, queries, k, metric, axis, precision):
     """Ingest-then-search on one device: the vector-DB 'training step'.
 

@@ -239,6 +239,13 @@ class Explorer:
                 col, self._query_vector(col, params.near_text),
                 params.near_text_move_to, params.near_text_move_away,
                 params.tenant)
+        if params.hybrid is not None:
+            # reject unknown fusion names BEFORE any leg work (or query
+            # vectorization) — every surface maps this ValueError to
+            # 400 / INVALID_ARGUMENT, never a 500
+            from weaviate_tpu.query.fusion import validate_fusion
+
+            validate_fusion(params.hybrid.fusion)
         if params.hybrid is not None and params.hybrid.vector is None \
                 and params.hybrid.query and col.config.vectorizer != "none" \
                 and col.modules is not None:

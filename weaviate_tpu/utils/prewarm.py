@@ -88,6 +88,18 @@ MANIFEST: dict[str, str] = {
         "sharded candidate gather-scorer (mesh rescore tier)",
     "parallel.sharded_search._sharded_take_jit":
         "sharded row gather (mesh rescore operand fetch)",
+    "ops.fusion.ranked_fusion_topk":
+        "hybrid reciprocal-rank fusion: per-leg scatter + fused top-k "
+        "in one dispatch (docs/hybrid.md)",
+    "ops.fusion.relative_score_fusion_topk":
+        "hybrid min-max-normalized score fusion, one dispatch",
+    "ops.sparse.sparse_score_topk":
+        "segmented sparse BM25 scoring for filtered hybrid legs",
+    "ops.sparse.sparse_score_topk_min_match":
+        "segmented sparse BM25 with the distinct-token min-match rule",
+    "parallel.sharded_search._sharded_sparse_topk_jit":
+        "mesh-sharded sparse BM25: per-shard scatter-score + cross-shard "
+        "top-k merge along the same axis as the dense planes",
 }
 
 _tls = threading.local()
@@ -148,6 +160,13 @@ class _Spec:
     dims: int
     bucket: int
     k: int
+    kind: str = "index"  # "index" = shard lattice; "fusion" = hybrid
+
+
+# hybrid fusion programs already compiled this process, keyed on
+# (algorithm, k): the kernels' identity is collection-independent
+# (ops/fusion.py buckets), so one warm covers every collection
+_fusion_warmed: set[tuple] = set()
 
 
 @dataclass
@@ -244,7 +263,48 @@ def plan_for_collection(col, shards: Optional[list[str]] = None,
                 elif skipped is not None:
                     skipped.append(
                         f"{col.config.name}/{sname}/{target}@{b}")
+    # hybrid fusion lattice (ops/fusion.py): the fused-page program's
+    # identity is (algorithm, leg bucket, union bucket, k) — derived
+    # from the overfetch knob, independent of any index — so a text-
+    # bearing collection warms it once per process and every hybrid
+    # request (any collection) reuses the compile
+    from weaviate_tpu.schema.config import DataType
+
+    has_text = any(
+        p.data_type in (DataType.TEXT, DataType.TEXT_ARRAY)
+        for p in col.config.properties)
+    if open_shards and has_text:
+        for algo in ("rankedFusion", "relativeScoreFusion"):
+            if (algo, k) not in _fusion_warmed:
+                specs.append(_Spec(col.config.name, "-", algo, None, 0,
+                                   0, k, kind="fusion"))
+            elif skipped is not None:
+                skipped.append(f"{col.config.name}/-/{algo}@0")
     return specs
+
+
+def _warm_fusion(spec: _Spec) -> None:
+    """Compile one hybrid-fusion program with bucket-exact synthetic
+    legs: the shapes mirror exactly what a hybrid request of page size
+    ``spec.k`` dispatches (two legs of ceil(overfetch·k) candidates,
+    their union) — deterministic, no RNG, no index touched."""
+    from weaviate_tpu.ops.fusion import bucket, fuse_topk
+    from weaviate_tpu.query.fusion import hybrid_fetch
+
+    k = spec.k
+    fetch = hybrid_fetch(k)  # the SAME derivation the serving path uses
+    # real legs range from fully-overlapping (union = fetch) to disjoint
+    # (union = 2·fetch) — warm every distinct union bucket in that range
+    # so the first hybrid request compiles nothing regardless of overlap
+    for union in sorted({bucket(max(fetch, k)),
+                         bucket(fetch + fetch // 2),
+                         bucket(2 * fetch)}):
+        legs = [list(range(fetch)),
+                list(range(union - fetch, union))]
+        scores = [[float(fetch - i) for i in range(fetch)] for _ in legs]
+        fuse_topk(legs, scores, [0.5, 0.5], k, spec.target,
+                  union_size=union)
+    _fusion_warmed.add((spec.target, k))
 
 
 def _warm_one(spec: _Spec, reason: str) -> None:
@@ -257,6 +317,10 @@ def _warm_one(spec: _Spec, reason: str) -> None:
                      target=spec.target, bucket=spec.bucket,
                      reason=reason) as sp:
         t0 = time.perf_counter()
+        if spec.kind == "fusion":
+            _warm_fusion(spec)
+            sp.set(warm_ms=round((time.perf_counter() - t0) * 1000, 3))
+            return
         # bucket-exact synthetic batch: the search path pads rows to the
         # same pow2 bucket a real batch of this size would land in, so
         # the program identity compiled here IS the one traffic will ask
@@ -320,10 +384,11 @@ def _run(specs: list[_Spec], reason: str,
                 continue
             PREWARM_PROGRAMS.inc(outcome="warmed")
             report.warmed.append(label)
-            memo = getattr(s.index, "_prewarmed_buckets", None)
-            if memo is None:
-                memo = s.index._prewarmed_buckets = set()
-            memo.add(s.bucket)
+            if s.kind == "index":
+                memo = getattr(s.index, "_prewarmed_buckets", None)
+                if memo is None:
+                    memo = s.index._prewarmed_buckets = set()
+                memo.add(s.bucket)
             with _lock:
                 _warmed.add(key)
 
@@ -428,6 +493,7 @@ def reset_for_tests() -> None:
     global _in_flight, _pending, _last_report
     with _lock:
         _warmed.clear()
+        _fusion_warmed.clear()
         _last_report = None
         _in_flight = 0
         _pending = 0

@@ -200,3 +200,20 @@ PREWARM_CONCURRENCY = RUNTIME.register("prewarm_concurrency", 2, cast=int)
 # workaround is a knob now, not a constant
 CLUSTER_FINISH_BUDGET_S = RUNTIME.register(
     "cluster_finish_budget_s", 10.0, cast=float)
+# hybrid search (core/collection.py hybrid_search, docs/hybrid.md): each
+# leg over-fetches ceil(factor * k) candidates so fusion has room beyond
+# the final page — the reference fetches ~2x k per leg; the old
+# hardcoded max(k, 20) silently degraded fusion quality past k≈20.
+HYBRID_OVERFETCH_FACTOR = RUNTIME.register(
+    "hybrid_overfetch_factor", 2.0, cast=float)
+# device fusion tier (ops/fusion.py): "off" pins fusion to the host
+# python twin (query/fusion.py) — the A/B lever for bench + incident
+# bypass; fallbacks latch in weaviate_tpu_hybrid_fallback_total either way
+HYBRID_DEVICE_FUSION = RUNTIME.register(
+    "hybrid_device_fusion", "on", cast=str)
+# segmented sparse scoring (ops/sparse.py): "auto" scores FILTERED hybrid
+# keyword legs on device (where WAND's skipping advantage collapses),
+# "on" forces every hybrid keyword leg through it, "off" keeps all
+# keyword scoring on the WAND/host tier
+HYBRID_SPARSE_DEVICE = RUNTIME.register(
+    "hybrid_sparse_device", "auto", cast=str)
