@@ -241,7 +241,9 @@ CONFIG_METRICS = {
     "meshbeam": (lambda m: m.startswith("mesh_"),
                  lambda m: m.startswith("mesh_qps_scaling")),
     "pallasab": (_m_pallas, _m_pallas),
+    "ingestserve": (lambda m: m.startswith("ingest_docs_s_serving"),) * 2,
     "ingest": (lambda m: m.startswith("ingest_docs_s")
+        and not m.startswith("ingest_docs_s_serving")
         and not m.rstrip("0123456789").endswith("w"),) * 2,
     "ingestmp": (lambda m: m.startswith("ingest_docs_s")
         and m.rstrip("0123456789").endswith("w"),) * 2,
@@ -1408,6 +1410,127 @@ def _bench_ingest_impl(n, d):
             "build_s": round(dt, 1),
             "dims": d,
             "device": "cpu (objectsBatcher analogue, single core)",
+        })
+        db.close()
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def bench_ingest_serving(n=200_000, d=128, batch=2000, k=10, iters=0,
+                         warmup=0, soak=False):
+    """Ingest WHILE SERVING (docs/ingest.md, ROADMAP item 4): preload
+    half the corpus, measure an IDLE search p99 control window, then run
+    sustained put_batch load with a concurrent searcher and journal
+    ``ingest_docs_s_serving`` — the ROADMAP-named metric — next to the
+    search p99 DURING ingest and the idle control. The acceptance gate
+    this bench exists for: ingest-window p99 within a small multiple of
+    the idle p99, because the staged pipeline keeps device builds out of
+    the shard lock. ``--soak`` raises n to 10M docs (hour-scale; the
+    slow soak the satellite task names). ``iters``/``warmup`` accepted
+    for override compatibility and ignored."""
+    import shutil
+    import tempfile
+    import threading
+
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        DataType,
+        FlatIndexConfig,
+        Property,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    if soak:
+        n = 10_000_000
+        # fail fast: the soak corpus is ~50x the standard footprint
+        if not preflight("ingestserve", soak=True):
+            raise RuntimeError(
+                "ingestserve --soak footprint exceeds this host's budget")
+    rng = np.random.default_rng(23)
+    tmpdir = tempfile.mkdtemp(prefix="bench_ingestserve_", dir=".")
+    try:
+        db = DB(tmpdir)
+        db.create_collection(CollectionConfig(
+            name="Doc",
+            vector_config=FlatIndexConfig(distance="l2-squared"),
+            properties=[Property(name="n", data_type=DataType.INT)]))
+        col = db.get_collection("Doc")
+        preload = n // 2
+        vecs = rng.standard_normal((max(4096, min(n, 1_000_000)), d)) \
+            .astype(np.float32)
+
+        def obj(i):
+            return StorageObject(
+                uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Doc",
+                properties={"n": int(i)}, vector=vecs[i % len(vecs)])
+
+        for s in range(0, preload, batch):
+            col.put_batch([obj(i) for i in range(s, min(s + batch,
+                                                        preload))])
+        queries = vecs[:8]
+
+        def one_search():
+            t0 = time.perf_counter()
+            col.vector_search(queries, k=k)
+            return (time.perf_counter() - t0) * 1e3
+
+        one_search()  # compile/warm outside both windows
+        # ---- idle control window ----------------------------------------
+        idle_ms = [one_search() for _ in range(200)]
+
+        # ---- sustained ingest with a concurrent searcher ----------------
+        during_ms: list = []
+        search_errs: list = []
+        stop = threading.Event()
+
+        def searcher():
+            # one transient failure must not silently kill the searcher:
+            # a dead thread truncates the during-window and the emitted
+            # interference ratio would false-pass the <=3x gate
+            while not stop.is_set():
+                try:
+                    during_ms.append(one_search())
+                except Exception as e:  # noqa: BLE001 — keep sampling
+                    search_errs.append(repr(e))
+                time.sleep(0.001)
+
+        st = threading.Thread(target=searcher, daemon=True)
+        st.start()
+        t0 = time.perf_counter()
+        for s in range(preload, n, batch):
+            col.put_batch([obj(i) for i in range(s, min(s + batch, n))])
+        ingest_wall = time.perf_counter() - t0
+        stop.set()
+        st.join(timeout=5)
+        if not during_ms:
+            raise RuntimeError(
+                "ingestserve: zero searches completed during the ingest "
+                f"window ({len(search_errs)} errors, first: "
+                f"{search_errs[0] if search_errs else 'none'}) — the "
+                "interference ratio would be meaningless")
+
+        def p(q_, xs):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(q_ * len(xs)))] if xs else 0.0
+
+        docs_s = (n - preload) / ingest_wall
+        p99_idle, p99_during = p(0.99, idle_ms), p(0.99, during_ms)
+        _emit({
+            "metric": "ingest_docs_s_serving",
+            "value": round(docs_s, 1),
+            "unit": "docs/s",
+            # the p99 interference ratio IS the story: <= 3x is the
+            # pinned acceptance bound (tests/test_ingest_pipeline.py)
+            "vs_baseline": round(p99_during / max(p99_idle, 1e-6), 2),
+            "n": n, "dims": d, "batch": batch, "preloaded": preload,
+            "search_p99_idle_ms": round(p99_idle, 2),
+            "search_p99_during_ms": round(p99_during, 2),
+            "search_p50_during_ms": round(p(0.5, during_ms), 2),
+            "searches_during": len(during_ms),
+            "search_errors": len(search_errs),
+            "ingest_wall_s": round(ingest_wall, 1),
+            "soak": bool(soak),
         })
         db.close()
     finally:
@@ -2669,6 +2792,7 @@ CONFIGS = {
     "bm25seg": bench_bm25seg,
     "ingest": bench_ingest,
     "ingestmp": bench_ingest_parallel,
+    "ingestserve": bench_ingest_serving,
     "rebalance": bench_rebalance,
     "coldstart": bench_coldstart,
     "rerank": bench_rerank,
@@ -2691,7 +2815,7 @@ _GB = 1e9
 _HBM_BUDGET_GB = 16.0  # v5e
 
 
-def _full_footprint(name: str) -> dict:
+def _full_footprint(name: str, soak: bool = False) -> dict:
     """Projected FULL-scale footprint (GB) per tier: device HBM, host RAM,
     disk. Mirrors each bench function's true allocations, including the
     bench-only ground-truth corpus where it dominates the peak."""
@@ -2764,6 +2888,14 @@ def _full_footprint(name: str) -> dict:
         n = 120_000
         return {"hbm_gb": 0.0, "host_gb": n * 128 * 4 * 3 / _GB,
                 "disk_gb": n * 800 / _GB}
+    if name == "ingestserve":
+        # fp32 corpus slab (capped at 1M rows) + bf16 device copy of the
+        # served half; object store + WAL on disk. --soak raises n to the
+        # 10M-doc soak corpus, so the gate must scale with it.
+        n, di = (10_000_000 if soak else 200_000), 128
+        return {"hbm_gb": n * di * (2 + 4) / _GB,
+                "host_gb": min(n, 1_000_000) * di * 4 * 2 / _GB,
+                "disk_gb": n * 700 / _GB}
     if name == "coldstart":
         # per-subprocess: fp32 corpus + bf16 device copy + graph mirror
         n, dc = 20_000, 256
@@ -2816,6 +2948,9 @@ SMOKE = {
     "bm25seg": dict(n=20_000, vocab=8_000),
     "ingest": dict(n=8_000),
     "ingestmp": dict(n=8_000),
+    # interference semantics check (searcher overlaps the writer), not a
+    # throughput claim
+    "ingestserve": dict(n=6_000, d=32, batch=500),
     # semantics check (moves happen, nothing lost), not a latency claim
     "rebalance": dict(n=2_000, shards=4, load_seconds=1.5),
     # three subprocess builds: keep each tiny (restart semantics check)
@@ -2839,11 +2974,11 @@ def _disk_free_gb(path: str = ".") -> float:
     return shutil.disk_usage(path).free / _GB
 
 
-def preflight(name: str, emit: bool = True) -> bool:
+def preflight(name: str, emit: bool = True, soak: bool = False) -> bool:
     """Assert the FULL-scale run of ``name`` fits this host's HBM / RAM /
     disk. Called by smoke mode for every config, and by the disk-backed
     configs themselves before they allocate (fail fast, not at row 40M)."""
-    fp = _full_footprint(name)
+    fp = _full_footprint(name, soak=soak)
     host_gb = _host_budget_gb()
     disk_gb = _disk_free_gb()
     ok = (fp["hbm_gb"] <= _HBM_BUDGET_GB
@@ -2943,6 +3078,8 @@ def _run_isolated(names, args, overrides) -> int:
         for key_ in ("n", "batch", "iters"):
             if overrides.get(key_):
                 cmd += [f"--{key_}", str(overrides[key_])]
+        if name == "ingestserve" and getattr(args, "soak", False):
+            cmd.append("--soak")
         t_cfg = time.monotonic()
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
                                 start_new_session=True)
@@ -3078,6 +3215,9 @@ def main():
     ap.add_argument("--n", type=int, default=0, help="override corpus size")
     ap.add_argument("--batch", type=int, default=0, help="override query batch")
     ap.add_argument("--iters", type=int, default=0, help="override timed iters")
+    ap.add_argument("--soak", action="store_true",
+                    help="ingestserve only: the slow 10M-doc soak "
+                         "(hour-scale; docs/ingest.md)")
     args = ap.parse_args()
     overrides = {}
     if args.n:
@@ -3162,7 +3302,10 @@ def main():
             failed.append(name)
             continue
         try:
-            fn(**overrides)
+            kw = dict(overrides)
+            if name == "ingestserve" and getattr(args, "soak", False):
+                kw["soak"] = True  # the slow 10M-doc soak
+            fn(**kw)
         except Exception as e:  # keep remaining configs alive
             print(f"# config {name} failed: {e!r}", file=sys.stderr)
             failed.append(name)

@@ -887,6 +887,83 @@ class TestLockAcrossDeviceCall:
 
 
 # ---------------------------------------------------------------------------
+# device-feed-under-lock
+
+CORE = "weaviate_tpu/core/fake_shard.py"
+
+
+class TestDeviceFeedUnderLock:
+    """Seed tests pinning the PR-15 ingest contract: the write path's
+    lock-held critical section is durability only — a reintroduced
+    in-lock ``_feed_index``/``add_batch`` call in core/ must be flagged
+    (the exact convoy the staged pipeline removed from put_batch)."""
+
+    def test_feed_index_under_shard_lock_flagged(self):
+        # the pre-PR-15 put_batch shape: _feed_index inside `with self._lock`
+        res = run("""
+            class Shard:
+                def put_batch(self, objs):
+                    with self._lock:
+                        for nm, (ids, vecs) in self._collect(objs).items():
+                            _feed_index(self._index_for(nm), ids, vecs)
+        """, rel=CORE, rules=["device-feed-under-lock"])
+        assert rule_ids(res) == ["device-feed-under-lock"]
+
+    def test_add_batch_under_lock_flagged(self):
+        res = run("""
+            class Shard:
+                def put(self, ids, vecs):
+                    with self._lock:
+                        self._vector_indexes[""].add_batch(ids, vecs)
+        """, rel=CORE, rules=["device-feed-under-lock"])
+        assert rule_ids(res) == ["device-feed-under-lock"]
+
+    def test_locked_suffix_convention_flagged(self):
+        # by-convention lock-held: a *_locked helper feeds the index
+        res = run("""
+            class Q:
+                def _apply_locked(self, idx, ids, vecs):
+                    idx.add_batch(ids, vecs)
+        """, rel=CORE, rules=["device-feed-under-lock"])
+        assert rule_ids(res) == ["device-feed-under-lock"]
+
+    def test_feed_after_lock_release_ok(self):
+        # the PR-15 shape: durability in-lock, feed after release
+        res = run("""
+            class Shard:
+                def put_batch(self, objs):
+                    with self._lock:
+                        pushed = self._durable_writes(objs)
+                    self.async_queue.ensure_drained(pushed)
+
+                def _replay(self, idx, ids, vecs):
+                    _feed_index(idx, ids, vecs)
+        """, rel=CORE, rules=["device-feed-under-lock"])
+        assert rule_ids(res) == []
+
+    def test_outside_core_not_flagged(self):
+        # index-internal code feeds under its own locks by design
+        res = run("""
+            class Wrapper:
+                def add(self, ids, vecs):
+                    with self._swap_lock:
+                        self._inner.add_batch(ids, vecs)
+        """, rel="weaviate_tpu/index/fake_dynamic.py",
+            rules=["device-feed-under-lock"])
+        assert rule_ids(res) == []
+
+    def test_suppressed_with_reason(self):
+        res = run("""
+            class Q:
+                def _drain_locked(self, idx, ids, vecs):
+                    # graftlint: allow[device-feed-under-lock] reason=drain lock, not shard lock
+                    idx.add_batch(ids, vecs)
+        """, rel=CORE, rules=["device-feed-under-lock"])
+        assert rule_ids(res) == []
+        assert [v.rule for v in res.suppressed] == ["device-feed-under-lock"]
+
+
+# ---------------------------------------------------------------------------
 # float64-literal-drift
 
 

@@ -175,6 +175,9 @@ def test_dynamic_upgrade(corpus):
     assert not idx.upgraded
     assert idx.stats()["type"] == "dynamic[flat]"
     idx.add_batch(np.arange(300, 1000), vecs[300:1000])
+    # the cutover builds in the BACKGROUND by default (docs/ingest.md):
+    # the threshold-crossing write returned without paying the build tax
+    assert idx.wait_cutover(timeout=120.0)
     assert idx.upgraded
     assert idx.stats()["type"] == "dynamic[hnsw]"
     assert idx.count() == 1000

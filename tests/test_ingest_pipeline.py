@@ -1,0 +1,844 @@
+"""Streaming ingest pipeline: WAL → async device build → debt-driven
+compaction, while serving (docs/ingest.md, ROADMAP item 4).
+
+The acceptance scenario pinned here:
+
+1. search latency during sustained ingest stays within 3× the idle p99
+   (and, structurally, readers/writers are never parked behind one
+   writer's device feed — the convoy put_batch used to be);
+2. the flat→HNSW dynamic cutover completes in the BACKGROUND with zero
+   failed writes and search parity across the swap;
+3. SIGKILL mid-compaction and mid-cutover both replay to the exact
+   pre-kill live set;
+4. the drained device feed is one dispatch per pow2 bucket (the
+   ``feed_dispatch_count`` hook) under the ``("ingest",)`` batch-group
+   token, so it can never coalesce with a live search batch.
+
+Plus the satellite crash contracts: WAL torn-tail replay racing a
+``flush_soft`` writer, async-queue chunk-file replay after SIGKILL
+mid-drain, group-commit fsync batching, the duplicate-uuid doc_id
+regression, debt-driven compaction scheduling, and the QoS ingest
+backpressure shed.
+"""
+
+import os
+import shutil
+import signal
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.core.async_queue import MAX_FEED_BUCKET, pow2_buckets
+from weaviate_tpu.core.shard import Shard
+from weaviate_tpu.index.dispatch import current_dispatch_group
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    DataType,
+    DynamicIndexConfig,
+    FlatIndexConfig,
+    Property,
+)
+from weaviate_tpu.storage.objects import StorageObject
+from weaviate_tpu.storage.wal import WAL
+
+
+def _cfg(index_cfg=None, name="Ingest"):
+    return CollectionConfig(
+        name=name,
+        properties=[Property(name="n", data_type=DataType.INT)],
+        vector_config=index_cfg or FlatIndexConfig(
+            distance="l2-squared", precision="fp32"),
+    )
+
+
+def _obj(i, dims=16, collection="Ingest"):
+    # vector deterministic per id (and distinct): exact-match probes
+    # resolve to exactly one doc at distance ~0
+    rng = np.random.default_rng(i)
+    return StorageObject(
+        uuid=f"00000000-0000-0000-0000-{i:012d}", collection=collection,
+        properties={"n": int(i)},
+        vector=rng.standard_normal(dims).astype(np.float32),
+    )
+
+
+@pytest.fixture
+def tmpdir():
+    d = tempfile.mkdtemp()
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# pow2 bucketing + the one-dispatch-per-bucket feed contract
+
+
+def test_pow2_buckets_binary_decomposition():
+    assert pow2_buckets(300) == [(0, 256), (256, 32), (288, 8), (296, 4)]
+    assert pow2_buckets(1) == [(0, 1)]
+    assert pow2_buckets(2048) == [(0, 2048)]
+    # over the cap: repeated max-size buckets, remainder decomposed
+    bks = pow2_buckets(5000)
+    assert sum(sz for _, sz in bks) == 5000
+    assert all(sz <= MAX_FEED_BUCKET and sz & (sz - 1) == 0
+               for _, sz in bks)
+    # contiguous, in order
+    off = 0
+    for o, sz in bks:
+        assert o == off
+        off += sz
+
+
+def test_drain_is_one_dispatch_per_pow2_bucket(tmpdir):
+    """Acceptance pin (4): a drained 300-row feed issues exactly
+    len(pow2_buckets(300)) add_batch dispatches, every one under the
+    ``("ingest",)`` batch-group token — the dispatcher folds group_key
+    into batch identity, so an ingest feed can never share a device
+    batch with a live search (which carries no token)."""
+    s = Shard(tmpdir, _cfg())
+    s.put_batch([_obj(i) for i in range(16)])  # build the index
+    idx = s.vector_index()
+    calls: list[tuple] = []
+    orig = idx.add_batch
+
+    def spy(ids, vecs):
+        calls.append((current_dispatch_group(), len(ids)))
+        return orig(ids, vecs)
+
+    idx.add_batch = spy
+    try:
+        base = s.async_queue.feed_dispatch_count()
+        s.put_batch([_obj(i) for i in range(100, 400)])  # 300 rows
+        assert s.async_queue.feed_dispatch_count() - base == 4
+        assert [n for _, n in calls] == [256, 32, 8, 4]
+        assert all(g == ("ingest",) for g, _ in calls)
+    finally:
+        del idx.add_batch
+    # the token is drain-scoped: it never leaks onto the caller's thread
+    assert current_dispatch_group() is None
+    # instruments saw the window
+    from weaviate_tpu.monitoring.metrics import REGISTRY
+    text = REGISTRY.render_text()
+    assert "weaviate_tpu_ingest_drain_seconds" in text
+    assert "weaviate_tpu_ingest_queue_depth" in text
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# the convoy is gone: durability and reads proceed while a device feed runs
+
+
+def test_readers_and_writers_not_parked_behind_device_feed(tmpdir):
+    """Structural half of acceptance pin (1). Park writer A inside its
+    drain's device feed and prove the shard stays fully available:
+    reads, searches, count — and a SECOND writer's durability section —
+    all complete while A is still feeding. Pre-PR-15, A held the shard
+    lock across the feed and every one of these queued behind it."""
+    s = Shard(tmpdir, _cfg())
+    s.put_batch([_obj(i) for i in range(32)])
+    idx = s.vector_index()
+    in_feed, release = threading.Event(), threading.Event()
+    orig = idx.add_batch
+
+    def parked(ids, vecs):
+        in_feed.set()
+        assert release.wait(timeout=30)
+        return orig(ids, vecs)
+
+    idx.add_batch = parked
+    writers = []
+    try:
+        a = threading.Thread(
+            target=lambda: s.put_batch([_obj(i) for i in range(100, 164)]))
+        a.start()
+        writers.append(a)
+        assert in_feed.wait(timeout=30)
+        # writer B: durability lands and is VISIBLE while A still feeds
+        # (B then parks waiting for its own chunk to drain — the device
+        # feed serializes, the lock-held durability section does not)
+        b = threading.Thread(
+            target=lambda: s.put_batch([_obj(i) for i in range(200, 232)]))
+        b.start()
+        writers.append(b)
+        deadline = time.monotonic() + 30
+        while s.get_by_uuid(_obj(200).uuid) is None:
+            assert time.monotonic() < deadline, \
+                "writer B's durability section queued behind A's device feed"
+            time.sleep(0.005)
+        assert not release.is_set() and a.is_alive()
+        # reads and searches during the parked feed
+        assert s.get_by_uuid(_obj(5).uuid) is not None
+        assert s.count() == 32 + 64 + 32  # durable rows all counted
+        res = s.vector_search(_obj(7).vector[None, :], k=1)
+        assert res.ids[0][0] == 7
+    finally:
+        release.set()
+        for t in writers:
+            t.join(timeout=60)
+        del idx.add_batch
+    # after the drain completes, everything is searchable
+    for probe in (150, 210):
+        want = s.get_by_uuid(_obj(probe).uuid).doc_id
+        res = s.vector_search(_obj(probe).vector[None, :], k=1)
+        assert res.ids[0][0] == want
+    s.close()
+
+
+@pytest.mark.timeout(240)
+def test_search_p99_during_ingest_within_3x_idle(tmpdir):
+    """Timing half of acceptance pin (1): sustained put_batch load with a
+    concurrent searcher — the during-ingest p99 stays within 3× the idle
+    p99. The floor on the denominator keeps the ratio about convoy
+    behavior (seconds-long stalls pre-PR-15) rather than sub-millisecond
+    scheduler noise."""
+    dims, batch = 64, 512
+    s = Shard(tmpdir, _cfg())
+    rng = np.random.default_rng(7)
+    vecs = rng.standard_normal((8192, dims)).astype(np.float32)
+
+    def batch_objs(start, n):
+        return [
+            StorageObject(
+                uuid=f"00000000-0000-0000-0000-{i:012d}",
+                collection="Ingest", properties={"n": int(i)},
+                vector=vecs[i % len(vecs)])
+            for i in range(start, start + n)
+        ]
+
+    # preload with the SAME batch size the load phase uses, so every
+    # pow2 feed bucket (and the search program) is compiled before the
+    # idle control window — first-touch compiles are ROADMAP item 3's
+    # problem, not this test's
+    preload = 4096
+    for st in range(0, preload, batch):
+        s.put_batch(batch_objs(st, batch))
+    queries = vecs[:4]
+
+    def one_search():
+        t0 = time.perf_counter()
+        s.vector_search(queries, k=10)
+        return time.perf_counter() - t0
+
+    for _ in range(5):
+        one_search()  # warm
+    idle = sorted(one_search() for _ in range(200))
+
+    during: list[float] = []
+    done = threading.Event()
+
+    def writer():
+        try:
+            for st in range(preload, preload + 6 * batch, batch):
+                s.put_batch(batch_objs(st, batch))
+        finally:
+            done.set()
+
+    w = threading.Thread(target=writer)
+    w.start()
+    while not done.is_set() or len(during) < 100:
+        during.append(one_search())
+        if len(during) > 3000:  # safety valve, never expected
+            break
+    w.join(timeout=60)
+    during.sort()
+
+    def p99(xs):
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+    idle_p99, during_p99 = p99(idle), p99(during)
+    assert during_p99 <= 3.0 * max(idle_p99, 0.005), (
+        f"search p99 during ingest {during_p99 * 1e3:.2f}ms vs idle "
+        f"{idle_p99 * 1e3:.2f}ms — the ingest pipeline is convoying "
+        "searches again")
+    assert s.count() == preload + 6 * batch
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# background flat→HNSW cutover (acceptance pin 2)
+
+
+def test_background_cutover_zero_failed_writes_and_parity(tmpdir, monkeypatch):
+    """Writes keep landing (and returning promptly) while the graph
+    builds off-thread; the swap loses nothing: every doc written before,
+    during, and after the build resolves identically post-swap."""
+    import weaviate_tpu.index.dynamic as dyn_mod
+
+    real = dyn_mod.HNSWIndex
+    bulk_gate = threading.Event()
+    bulk_calls: list[int] = []
+
+    class GatedHNSW(real):
+        def index_existing(self):
+            if not bulk_calls:  # phase-1 bulk build only; catch-up runs free
+                bulk_calls.append(1)
+                assert bulk_gate.wait(timeout=60)
+            return super().index_existing()
+
+    monkeypatch.setattr(dyn_mod, "HNSWIndex", GatedHNSW)
+    cfg = _cfg(DynamicIndexConfig(
+        distance="l2-squared", precision="fp32", threshold=600,
+        hnsw={"max_connections": 8, "ef_construction": 48, "ef": 48}))
+    s = Shard(tmpdir, cfg)
+    for st in range(0, 500, 100):
+        s.put_batch([_obj(i) for i in range(st, st + 100)])
+    dyn = s.vector_index()
+    assert dyn.cutover_state == "idle" and not dyn.upgraded
+    flat_top1 = {i: int(s.vector_search(_obj(i).vector[None, :], k=1)
+                        .ids[0][0]) for i in (3, 250, 499)}
+
+    # cross the threshold: the write returns while the build is parked
+    s.put_batch([_obj(i) for i in range(500, 650)])
+    assert dyn.cutover_state == "building"
+    assert not dyn.upgraded  # still serving from flat
+
+    # zero failed writes: every batch during the build succeeds and is
+    # immediately visible (read-your-writes through the inline drain)
+    for st in range(650, 850, 100):
+        s.put_batch([_obj(i) for i in range(st, st + 100)])
+        res = s.vector_search(_obj(st).vector[None, :], k=1)
+        assert res.ids[0][0] == st
+    assert dyn.cutover_state == "building"
+
+    bulk_gate.set()
+    assert dyn.wait_cutover(timeout=120.0)
+    assert dyn.upgraded and dyn.cutover_state == "done"
+    assert dyn.stats()["type"] == "dynamic[hnsw]"
+
+    # parity across the swap: pre-threshold probes resolve identically,
+    # and the delta replay picked up every id added DURING the build
+    for i, want in flat_top1.items():
+        assert int(s.vector_search(_obj(i).vector[None, :], k=1)
+                   .ids[0][0]) == want
+    for i in (520, 700, 849):
+        assert int(s.vector_search(_obj(i).vector[None, :], k=1)
+                   .ids[0][0]) == i
+    assert s.count() == dyn.count() == 850
+    s.close()
+
+
+def test_cutover_failure_keeps_flat_serving_then_retries(tmpdir,
+                                                         monkeypatch):
+    """The failed arm of the state machine: a build that dies leaves the
+    flat index serving — correctness is never at stake — and the first
+    threshold crossing after the backoff window retries the build, so a
+    transient failure never latches linear-scan serving until restart."""
+    import weaviate_tpu.index.dynamic as dyn_mod
+
+    real = dyn_mod.HNSWIndex
+    broken = [True]
+
+    class FlakyHNSW(real):
+        def index_existing(self):
+            if broken[0]:
+                raise RuntimeError("injected build failure")
+            return super().index_existing()
+
+    monkeypatch.setattr(dyn_mod, "HNSWIndex", FlakyHNSW)
+    cfg = _cfg(DynamicIndexConfig(
+        distance="l2-squared", precision="fp32", threshold=50,
+        hnsw={"max_connections": 8, "ef_construction": 32, "ef": 32}))
+    s = Shard(tmpdir, cfg)
+    s.put_batch([_obj(i) for i in range(80)])
+    dyn = s.vector_index()
+    assert not dyn.wait_cutover(timeout=60.0)
+    assert dyn.cutover_state == "failed" and not dyn.upgraded
+    # flat keeps serving, and keeps accepting writes; inside the backoff
+    # window the failure does NOT hot-loop new build attempts
+    s.put_batch([_obj(i) for i in range(80, 120)])
+    assert dyn.cutover_state == "failed"
+    assert int(s.vector_search(_obj(100).vector[None, :], k=1)
+               .ids[0][0]) == 100
+    assert s.count() == 120
+    # past the backoff (and with the transient cause cleared), the next
+    # threshold crossing restarts — and completes — the build
+    broken[0] = False
+    dyn._cutover_failed_at = (
+        time.monotonic() - dyn_mod.CUTOVER_RETRY_BACKOFF_S - 1.0)
+    s.put_batch([_obj(i) for i in range(120, 140)])
+    assert dyn.cutover_state == "building" or dyn.upgraded
+    assert dyn.wait_cutover(timeout=120.0)
+    assert dyn.upgraded and dyn.cutover_state == "done"
+    assert int(s.vector_search(_obj(130).vector[None, :], k=1)
+               .ids[0][0]) == 130
+    assert s.count() == 140
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# duplicate-uuid doc_id regression (satellite fix)
+
+
+def test_duplicate_uuid_in_batch_does_not_burn_doc_ids(tmpdir):
+    """Pre-fix, put_batch assigned a doc_id to every raw element but only
+    wrote the deduped winners — duplicate uuids burned ids and desynced
+    ``_next_doc_id`` from the live set."""
+    s = Shard(tmpdir, _cfg())
+    u = _obj(1).uuid
+    first = StorageObject(uuid=u, collection="Ingest",
+                          properties={"n": 1},
+                          vector=_obj(1).vector)
+    second = StorageObject(uuid=u, collection="Ingest",
+                           properties={"n": 111},
+                           vector=_obj(901).vector)
+    other = _obj(2)
+    before = s._next_doc_id
+    ids = s.put_batch([first, second, other])
+    # one id per DISTINCT uuid; both duplicate slots report the winner's
+    assert s._next_doc_id == before + 2
+    assert ids[0] == ids[1] == second.doc_id
+    assert ids[2] == other.doc_id != ids[0]
+    assert s.count() == 2
+    # the later occurrence won, object AND vector
+    assert s.get_by_uuid(u).properties["n"] == 111
+    res = s.vector_search(_obj(901).vector[None, :], k=1)
+    assert int(res.ids[0][0]) == second.doc_id
+    # id space and live set stay in sync across restart
+    s.close()
+    s2 = Shard(tmpdir, _cfg())
+    assert s2.count() == 2
+    assert s2.get_by_uuid(u).properties["n"] == 111
+    s2.close()
+
+
+# ---------------------------------------------------------------------------
+# WAL group commit
+
+
+def _count_fsyncs(monkeypatch):
+    real, calls = os.fsync, []
+    monkeypatch.setattr(os, "fsync", lambda fd: (calls.append(fd),
+                                                 real(fd))[1])
+    return calls
+
+
+def test_group_commit_one_fsync_per_window(tmp_path, monkeypatch):
+    calls = _count_fsyncs(monkeypatch)
+    p = str(tmp_path / "g.wal")
+    w = WAL(p, sync=True, group=True)
+    for i in range(50):
+        w.append(f"rec-{i}".encode())
+    assert len(calls) == 0  # appends buffer; durability is claimed below
+    w.sync_window()
+    assert len(calls) == 1  # ONE fsync covers the whole window
+    w.sync_window()
+    assert len(calls) == 1  # nothing new appended: barrier is a no-op
+    w.close()
+    assert [r.decode() for r in WAL.replay(p)] == \
+        [f"rec-{i}" for i in range(50)]
+    # per-record mode for contrast: one fsync per append
+    calls.clear()
+    w2 = WAL(str(tmp_path / "s.wal"), sync=True)
+    for i in range(10):
+        w2.append(b"x")
+    assert len(calls) == 10
+    w2.close()
+
+
+def test_group_commit_concurrent_committers_share_fsyncs(tmp_path,
+                                                         monkeypatch):
+    """Leader/follower: N threads each append-then-barrier; every record
+    is durable at its barrier return, with at most one fsync per
+    sync_window call (and typically far fewer — followers ride the
+    leader's flush)."""
+    calls = _count_fsyncs(monkeypatch)
+    p = str(tmp_path / "cc.wal")
+    w = WAL(p, sync=True, group=True)
+    n_threads, per = 8, 20
+    errs: list[Exception] = []
+
+    def committer(t):
+        try:
+            for i in range(per):
+                w.append(f"t{t}-{i}".encode())
+            w.sync_window()
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=committer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    w.close()
+    assert len(calls) <= n_threads
+    assert len(list(WAL.replay(p))) == n_threads * per
+
+
+# ---------------------------------------------------------------------------
+# WAL torn-tail replay racing a flush_soft writer (satellite coverage)
+
+_HDR = struct.Struct("<II")
+
+
+def _rec(payload: bytes) -> bytes:
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def test_torn_tail_replay_racing_flush_soft_writer(tmp_path):
+    """The race the size guard exists for: replay snapshots the log while
+    a record is only half-flushed (an in-flight flush_soft), the writer
+    completes it before the replay's truncation point — the truncate
+    must NOT fire, or the completed record is chopped off a live log."""
+    p = str(tmp_path / "race.wal")
+    w = WAL(p)
+    w.append(b"one")
+    w.append(b"two")
+    w.close()
+    full = _rec(b"three")
+    with open(p, "ab") as f:  # half the record: a flush_soft in flight
+        f.write(full[: len(full) // 2])
+
+    it = WAL.replay(p)  # generator: snapshots the file at first next()
+    assert next(it) == b"one"
+    assert next(it) == b"two"
+    # the writer's next flush_soft completes the in-flight record
+    with open(p, "ab") as f:
+        f.write(full[len(full) // 2:])
+    assert list(it) == []  # the snapshot still ends at the torn tail
+    # NOT truncated: the completed record survives and a fresh replay
+    # (now quiescent) yields it
+    assert [r for r in WAL.replay(p)] == [b"one", b"two", b"three"]
+
+
+def test_torn_tail_still_truncates_when_quiescent(tmp_path):
+    p = str(tmp_path / "quiet.wal")
+    w = WAL(p)
+    w.append(b"one")
+    w.close()
+    with open(p, "ab") as f:
+        f.write(_rec(b"garbage")[:6])  # torn, and no writer returns
+    assert list(WAL.replay(p)) == [b"one"]
+    # recovery truncation applied: the torn bytes are gone
+    assert os.path.getsize(p) == len(_rec(b"one"))
+    assert list(WAL.replay(p)) == [b"one"]
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL crash contracts (acceptance pin 3 + queue satellite)
+
+_CHILD_PRELUDE = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("WEAVIATE_TPU_MESH", "off")
+import numpy as np
+from weaviate_tpu.core.shard import Shard
+from weaviate_tpu.schema.config import (
+    CollectionConfig, DataType, DynamicIndexConfig, FlatIndexConfig,
+    Property)
+from weaviate_tpu.storage.objects import StorageObject
+
+def _obj(i, dims=16):
+    rng = np.random.default_rng(i)
+    return StorageObject(
+        uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Ingest",
+        properties={"n": int(i)},
+        vector=rng.standard_normal(dims).astype(np.float32))
+
+def _flat_cfg():
+    return CollectionConfig(
+        name="Ingest",
+        properties=[Property(name="n", data_type=DataType.INT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"))
+d = sys.argv[1]
+"""
+
+_CHILD_MID_DRAIN = _CHILD_PRELUDE + r"""
+s = Shard(d, _flat_cfg(), sync_writes=True)
+s.put_batch([_obj(i) for i in range(64)])      # baseline, fully drained
+idx = s.vector_index()
+orig = idx.add_batch
+def parked(ids, vecs):
+    print("MID_DRAIN", flush=True)
+    time.sleep(120)                            # parent SIGKILLs here
+    return orig(ids, vecs)
+idx.add_batch = parked
+# durability (group-commit fsync) completes BEFORE the drain parks
+s.put_batch([_obj(i) for i in range(64, 128)])
+"""
+
+_CHILD_MID_COMPACTION = _CHILD_PRELUDE + r"""
+s = Shard(d, _flat_cfg(), sync_writes=True)
+for b in range(6):
+    s.put_batch([_obj(i) for i in range(b * 40, (b + 1) * 40)])
+    for bk in list(s.store._buckets.values()):
+        bk.flush_memtable()                    # a segment per batch: debt
+s.delete([_obj(i).uuid for i in range(0, 120, 5)])
+import weaviate_tpu.storage.store as store_mod
+orig_merge = store_mod.native_merge
+def slow_merge(paths, out, strategy, *a, **k):
+    r = orig_merge(paths, out, strategy, *a, **k)
+    print("MERGE_MID", flush=True)             # merged file written,
+    time.sleep(120)                            # bookkeeping NOT done:
+    return r                                   # parent SIGKILLs here
+store_mod.native_merge = slow_merge
+print("READY", flush=True)
+while True:
+    for bk in list(s.store._buckets.values()):
+        bk.compact_once()
+    time.sleep(0.01)
+"""
+
+_CHILD_MID_CUTOVER = _CHILD_PRELUDE + r"""
+import weaviate_tpu.index.dynamic as dyn_mod
+real = dyn_mod.HNSWIndex
+class SlowHNSW(real):
+    def index_existing(self):
+        print("CUTOVER", flush=True)
+        time.sleep(120)                        # parent SIGKILLs mid-build
+        return super().index_existing()
+dyn_mod.HNSWIndex = SlowHNSW
+cfg = CollectionConfig(
+    name="Ingest",
+    properties=[Property(name="n", data_type=DataType.INT)],
+    vector_config=DynamicIndexConfig(
+        distance="l2-squared", precision="fp32", threshold=300,
+        hnsw={"max_connections": 8, "ef_construction": 32, "ef": 32}))
+s = Shard(d, cfg, sync_writes=True)
+for b in range(4):                             # crosses threshold at 300
+    s.put_batch([_obj(i) for i in range(b * 100, (b + 1) * 100)])
+# one more durable batch DURING the parked build
+s.put_batch([_obj(i) for i in range(400, 500)])
+print("FINAL", flush=True)
+time.sleep(300)
+"""
+
+
+def _spawn_and_kill_on(script: str, workdir: str, marker: str,
+                       timeout: float = 90.0) -> None:
+    """Run ``script`` as a child python process, SIGKILL it the moment it
+    prints ``marker``."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "WEAVIATE_TPU_MESH": "off"}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, workdir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, start_new_session=True)
+    try:
+        deadline = time.monotonic() + timeout
+        for line in proc.stdout:
+            if marker in line:
+                break
+            assert time.monotonic() < deadline, \
+                f"child never reached {marker!r}"
+        else:
+            out = proc.stdout.read()
+            raise AssertionError(
+                f"child exited (rc={proc.wait()}) before {marker!r}:\n"
+                f"{out}")
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait(timeout=30)
+        proc.stdout.close()
+
+
+@pytest.mark.timeout(240)
+def test_sigkill_mid_drain_replays_exact_live_set(tmpdir):
+    """Queue crash contract: kill -9 while the device feed is mid-drain.
+    The durability section already acked both batches, so recovery must
+    surface all 128 docs; the leftover chunk files are discarded (the
+    store rebuild re-feeds the index)."""
+    _spawn_and_kill_on(_CHILD_MID_DRAIN, tmpdir, "MID_DRAIN")
+    qdir = os.path.join(tmpdir, "index_queue")
+    leftover = [f for f in os.listdir(qdir) if f.startswith("q-")]
+    assert leftover, "kill was not mid-drain: no chunk file pending"
+
+    s = Shard(tmpdir, _cfg())
+    assert s.count() == 128
+    # the batch whose feed was killed is fully searchable after replay
+    for probe in (3, 70, 127):
+        res = s.vector_search(_obj(probe).vector[None, :], k=1)
+        assert int(res.ids[0][0]) == probe
+    # leftover chunks were discarded, not replayed twice
+    assert not s.async_queue.has_pending_files()
+    assert s.vector_index().count() == 128
+    s.close()
+
+
+@pytest.mark.timeout(240)
+def test_sigkill_mid_compaction_replays_exact_live_set(tmpdir):
+    """Acceptance pin (3a): kill -9 after a native merge wrote its output
+    but before the segment bookkeeping — replay converges to the exact
+    pre-kill live set (240 written, 24 deleted)."""
+    _spawn_and_kill_on(_CHILD_MID_COMPACTION, tmpdir, "MERGE_MID")
+
+    s = Shard(tmpdir, _cfg())
+    dead = set(range(0, 120, 5))
+    assert s.count() == 240 - len(dead)
+    for i in sorted(dead)[:5]:
+        assert s.get_by_uuid(_obj(i).uuid) is None
+    for i in (1, 7, 121, 239):
+        assert s.get_by_uuid(_obj(i).uuid) is not None
+        res = s.vector_search(_obj(i).vector[None, :], k=1)
+        assert int(res.ids[0][0]) == i
+    # deleted docs resurrect nowhere
+    res = s.vector_search(_obj(5).vector[None, :], k=5)
+    assert 5 not in set(res.ids.flatten().tolist())
+    s.close()
+
+
+@pytest.mark.timeout(240)
+def test_sigkill_mid_cutover_replays_exact_live_set(tmpdir):
+    """Acceptance pin (3b): kill -9 while the background flat→HNSW build
+    is in flight. The crash costs only the partial graph: recovery
+    rebuilds from the durable store (all 500 docs), serves from flat,
+    and the next threshold crossing restarts — and completes — the
+    cutover."""
+    _spawn_and_kill_on(_CHILD_MID_CUTOVER, tmpdir, "FINAL")
+
+    cfg = _cfg(DynamicIndexConfig(
+        distance="l2-squared", precision="fp32", threshold=300,
+        hnsw={"max_connections": 8, "ef_construction": 32, "ef": 32}))
+    s = Shard(tmpdir, cfg)
+    assert s.count() == 500
+    for i in (0, 250, 499):  # served (from flat) right now
+        res = s.vector_search(_obj(i).vector[None, :], k=1)
+        assert int(res.ids[0][0]) == i
+    # the rebuild re-crossed the threshold: the cutover restarts and
+    # completes, with identical results across the swap
+    dyn = s.vector_index()
+    assert dyn.wait_cutover(timeout=120.0)
+    assert dyn.upgraded
+    for i in (0, 250, 499):
+        res = s.vector_search(_obj(i).vector[None, :], k=1)
+        assert int(res.ids[0][0]) == i
+    assert s.count() == 500
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# debt-driven compaction
+
+
+def test_bucket_compaction_debt_score(tmp_path):
+    from weaviate_tpu.storage.store import Bucket
+
+    b = Bucket(str(tmp_path / "b"), strategy="replace")
+    assert b.compaction_debt() == 0  # empty
+    for i in range(30):
+        b.put(f"k{i:04d}".encode(), b"x" * 50)
+    b.flush_memtable()
+    assert b.compaction_debt() == 0  # one segment owes nothing
+    for i in range(30):
+        b.put(f"k{i:04d}".encode(), b"y" * 50)
+    b.flush_memtable()
+    sizes = [os.path.getsize(s.path) for s in b._segments]
+    assert len(sizes) == 2
+    want = (len(sizes) - 1) * (sum(sizes) - max(sizes))
+    assert b.compaction_debt() == want > 0
+    # debt clears when the stack collapses
+    while b.compact_once():
+        pass
+    assert b.compaction_debt() == 0
+    b.close()
+
+
+def test_debt_driven_cycle_merges_past_target_and_respects_backstop(
+        tmp_path):
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.utils.runtime_config import (
+        COMPACTION_DEBT_TARGET_BYTES,
+        COMPACTION_MAX_MERGES,
+    )
+
+    db = DB(str(tmp_path))
+    db.cycles.stop()  # drive the compaction cycle by hand, deterministically
+    db.create_collection(_cfg(name="Debt"))
+    col = db.get_collection("Debt")
+    shard = next(iter(col._shards.values()))
+    for b in range(4):
+        col.put_batch([_obj(i, collection="Debt")
+                       for i in range(b * 30, (b + 1) * 30)])
+        for bk in list(shard.store._buckets.values()):
+            bk.flush_memtable()
+    objects = shard.store.bucket("objects")
+    segs_before = len(objects._segments)
+    assert segs_before >= 4
+    assert shard.store.compaction_debt() > 0
+
+    try:
+        # below target, backstop window not due: the cycle only scores
+        db._last_compaction_sweep = time.monotonic()
+        COMPACTION_DEBT_TARGET_BYTES.set_override(1 << 40)
+        db._compaction_cycle()
+        assert len(objects._segments) == segs_before
+        assert db.compaction_debt() > 0  # scored and cached for QoS
+        # over target: top-debt buckets merge, capped per pass
+        COMPACTION_DEBT_TARGET_BYTES.set_override(1)
+        COMPACTION_MAX_MERGES.set_override(8)
+        db._compaction_cycle()
+        assert len(objects._segments) < segs_before
+        # the cached signal refreshed after the merges, not a tick later
+        assert db.compaction_debt() == sum(
+            st.compaction_debt()
+            for st in [s.store for s in col._shards.values()])
+    finally:
+        COMPACTION_DEBT_TARGET_BYTES.clear_override()
+        COMPACTION_MAX_MERGES.clear_override()
+    # merged data intact
+    assert shard.get_by_uuid(_obj(7).uuid) is not None
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# QoS ingest backpressure (the pipeline's admission-side shed)
+
+
+def test_qos_batch_lane_sheds_on_ingest_pressure():
+    from weaviate_tpu.serving.qos import (
+        BATCH,
+        INTERACTIVE,
+        AdmissionController,
+        QosRejected,
+    )
+    from weaviate_tpu.utils.runtime_config import (
+        INGEST_SHED_DEBT_BYTES,
+        INGEST_SHED_QUEUE_DEPTH,
+    )
+
+    pressure = {"depth": 0, "debt": 0}
+    qos = AdmissionController()
+    qos.ingest_pressure = lambda: (pressure["depth"], pressure["debt"])
+    try:
+        INGEST_SHED_QUEUE_DEPTH.set_override(100)
+        INGEST_SHED_DEBT_BYTES.set_override(1000)
+        # under both thresholds: admitted
+        with qos.acquire(BATCH):
+            pass
+        # queue depth over: the BATCH lane sheds, Retry-After scales
+        # with how far past the line the signal is
+        pressure["depth"] = 300
+        with pytest.raises(QosRejected) as ei:
+            qos.acquire(BATCH)
+        assert ei.value.reason == "ingest_queue"
+        assert ei.value.retry_after == 3.0  # ceil(300/100)
+        # searches are NOT the lane being shed
+        with qos.acquire(INTERACTIVE):
+            pass
+        # debt signal, same contract
+        pressure["depth"] = 0
+        pressure["debt"] = 50_000
+        with pytest.raises(QosRejected) as ei:
+            qos.acquire(BATCH)
+        assert ei.value.reason == "compaction_debt"
+        assert ei.value.retry_after == 30.0  # capped
+        # a zeroed knob disables that signal
+        INGEST_SHED_DEBT_BYTES.set_override(0)
+        with qos.acquire(BATCH):
+            pass
+    finally:
+        INGEST_SHED_QUEUE_DEPTH.clear_override()
+        INGEST_SHED_DEBT_BYTES.clear_override()

@@ -855,6 +855,74 @@ class LockAcrossDeviceCall(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 5b. device-feed-under-lock
+
+
+class DeviceFeedUnderLock(Rule):
+    id = "device-feed-under-lock"
+    description = (
+        "vector-index feed (_feed_index / add_batch) issued while a lock "
+        "is held in core/ write-path code"
+    )
+    rationale = (
+        "The ingest pipeline's contract (docs/ingest.md): the lock-held "
+        "critical section of the write path is DURABILITY ONLY — WAL/"
+        "delta append, object + inverted + id-map writes, and the queue "
+        "chunk push. Feeding the vector index is device work (graph "
+        "construction included); doing it in-lock reintroduces the "
+        "write-path convoy PR 15 removed, where one writer's device "
+        "build queues every other writer and reader on the shard. Feed "
+        "in a queue drain window after releasing the lock instead."
+    )
+
+    _FEEDS = ("add_batch", "add_batch_multi")
+
+    def _is_feed(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id == "_feed_index":
+            return True
+        return isinstance(f, ast.Attribute) and f.attr in self._FEEDS
+
+    def _held_context(self, ctx, call: ast.Call) -> Optional[str]:
+        """The lock context a feed call executes under: a lexical ``with
+        <something named *lock*>:`` ancestor, or an enclosing function
+        named ``*_locked`` (the repo convention for 'caller holds the
+        lock' — the convoy is the same whether the acquisition is
+        visible in this function or in its caller)."""
+        for parent, field in ctx.ancestry(call):
+            if isinstance(parent, (ast.With, ast.AsyncWith)) \
+                    and field == "body":
+                for item in parent.items:
+                    dn = dotted_name(item.context_expr)
+                    if dn and "lock" in dn.lower():
+                        return dn
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and field == "body" and parent.name.endswith("_locked"):
+                return f"{parent.name}() [lock held by caller, by the " \
+                       "*_locked naming convention]"
+        return None
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not ctx.rel_path.startswith("weaviate_tpu/core/"):
+            return
+        for call in ctx.walk(ast.Call):
+            if not self._is_feed(call):
+                continue
+            held = self._held_context(ctx, call)
+            if held is None:
+                continue
+            fn = call.func
+            name = fn.id if isinstance(fn, ast.Name) else fn.attr
+            yield self.violation(
+                ctx, call,
+                f"{name}(...) feeds a vector index while {held} is held "
+                "— the write path's critical section is durability only; "
+                "push a queue chunk and feed in a drain window after "
+                "releasing the lock (docs/ingest.md)",
+            )
+
+
+# ---------------------------------------------------------------------------
 # 6. float64-literal-drift
 
 
@@ -1322,6 +1390,7 @@ ALL_RULES: tuple = (
     DeviceArrayLeak(),
     HostLoopOverMesh(),
     LockAcrossDeviceCall(),
+    DeviceFeedUnderLock(),
     Float64LiteralDrift(),
     LockwitnessInKernel(),
     TracerInKernel(),

@@ -64,7 +64,12 @@ class DB:
         self.cycles = CycleManager()
         self.cycles.register("object_ttl", self._ttl_cycle, 60.0)
         self.cycles.register("metrics_refresh", self._metrics_cycle, 30.0)
-        self.cycles.register("compaction", self._compaction_cycle, 60.0)
+        # debt-driven compaction (docs/ingest.md): the cycle ticks fast
+        # but merges only when outstanding debt crosses the target knob —
+        # a 60s full sweep survives as the backstop for cold buckets
+        self._compaction_debt = 0  # cached total; the QoS shed signal
+        self._last_compaction_sweep = 0.0
+        self.cycles.register("compaction", self._compaction_cycle, 5.0)
         self.cycles.register("checkpoint", self._checkpoint_cycle, 120.0)
         if self.tiering is not None:
             self.cycles.register("tiering", self.tiering.tick, 5.0)
@@ -82,9 +87,73 @@ class DB:
         for c in list(self._collections.values()):
             c.expire_ttl_once()
 
-    def _compaction_cycle(self) -> None:
+    def _open_stores(self):
+        """(collection, shard) stores eligible for maintenance (open
+        shards of unpaused collections only — waking lazy tenants to
+        score their debt would defeat lazy loading)."""
+        out = []
         for c in list(self._collections.values()):
-            c.compact_once()
+            with c._lock:
+                if c._maintenance_pause:
+                    continue
+                shards = list(c._shards.values())
+            out.extend(s.store for s in shards)
+        return out
+
+    def compaction_debt(self) -> int:
+        """Cached total merge debt across open shards (bytes) — refreshed
+        every compaction cycle; the QoS ingest lane sheds against it."""
+        return self._compaction_debt
+
+    def _compaction_cycle(self) -> None:
+        """Debt-driven compaction (docs/ingest.md; reference leveled
+        ``segment_group_compaction.go`` policy on the cyclemanager):
+        rank every open bucket by its outstanding merge debt
+        (``(segments-1) x overlap bytes``) and run the top-ranked native
+        merges — capped at ``compaction_max_merges`` per pass so merges
+        never starve the serving threads — whenever total debt crosses
+        ``compaction_debt_target_bytes``. A fixed-interval full sweep
+        survives as a 60s backstop (small buckets below the target still
+        deserve collapse eventually)."""
+        import time as _time
+
+        from weaviate_tpu.monitoring import tracing
+        from weaviate_tpu.monitoring.metrics import COMPACTION_DEBT_BYTES
+        from weaviate_tpu.utils.runtime_config import (
+            COMPACTION_DEBT_TARGET_BYTES,
+            COMPACTION_MAX_MERGES,
+        )
+
+        stores = self._open_stores()
+        ranked: list = []
+        for st in stores:
+            ranked.extend(st.debt_ranked_buckets())
+        total = sum(d for d, _ in ranked)
+        self._compaction_debt = total
+        COMPACTION_DEBT_BYTES.set(total)
+        target = int(COMPACTION_DEBT_TARGET_BYTES.get())
+        if target > 0 and total >= target:
+            ranked.sort(key=lambda t: -t[0])
+            cap = max(1, int(COMPACTION_MAX_MERGES.get()))
+            merged = 0
+            for debt, bucket in ranked[:cap]:
+                with tracing.TRACER.span(
+                        "compaction.merge", bucket=bucket.dir,
+                        debt_bytes=debt) as span:
+                    did = bucket.compact_once()
+                    span.set(merged=bool(did))
+                merged += bool(did)
+            # refresh the cached signal so backpressure releases as soon
+            # as the merges land, not one tick later
+            self._compaction_debt = sum(
+                d for st in stores for d, _ in st.debt_ranked_buckets())
+            COMPACTION_DEBT_BYTES.set(self._compaction_debt)
+            return
+        now = _time.monotonic()
+        if now - self._last_compaction_sweep >= 60.0:
+            self._last_compaction_sweep = now
+            for c in list(self._collections.values()):
+                c.compact_once()
 
     def _checkpoint_cycle(self) -> None:
         """Bound crash-recovery replay: shards with a fat delta log
@@ -181,6 +250,11 @@ class DB:
                 from weaviate_tpu.serving.qos import AdmissionController
 
                 self._qos = AdmissionController()
+                # ingest backpressure (docs/ingest.md): the batch lane
+                # sheds with Retry-After when the WAL->device window or
+                # the compaction debt outgrows its knob — bounded queues
+                # all the way down, the WAL never grows unbounded
+                self._qos.ingest_pressure = self._ingest_pressure
                 if self.tiering is not None:
                     # front-door activity signal: every admitted tenant
                     # request bumps the tiering EWMA before the query
@@ -188,6 +262,21 @@ class DB:
                     self._qos.throttle.on_activity = \
                         self.tiering.on_tenant_signal
             return self._qos
+
+    def _ingest_pressure(self) -> tuple[int, int]:
+        """(pending vectors in the WAL->device window across open shards,
+        cached compaction debt) — the QoS batch lane's shed signal.
+        Queue depth is a sum of ints (cheap, read live); debt is the
+        compaction cycle's cached score (segment stats cost a stat walk)."""
+        depth = 0
+        for c in list(self._collections.values()):
+            with c._lock:
+                shards = list(c._shards.values())
+            for s in shards:
+                q = getattr(s, "async_queue", None)
+                if q is not None:
+                    depth += q.size()
+        return depth, self._compaction_debt
 
     def get_collection(self, name: str) -> Collection:
         c = self._collections.get(name)

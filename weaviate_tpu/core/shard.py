@@ -97,7 +97,11 @@ class Shard:
         self.name = name
         self.config = config
         os.makedirs(dirpath, exist_ok=True)
-        self.store = Store(os.path.join(dirpath, "lsm"), sync=sync_writes)
+        # group=sync_writes: bucket WALs defer their fsync to the ONE
+        # store.sync_all() barrier put_batch/delete run per batch (group
+        # commit, docs/ingest.md) instead of fsyncing per record
+        self.store = Store(os.path.join(dirpath, "lsm"), sync=sync_writes,
+                           group=sync_writes)
         self.objects = self.store.bucket("objects")  # docid(8B BE) -> storobj
         self.ids = self.store.bucket("ids")  # uuid bytes -> docid(8B)
         self._inv_snap_path = os.path.join(dirpath, "inverted.snap")
@@ -112,6 +116,16 @@ class Shard:
         # closed store
         self._tier_released = False
         self._lock = threading.RLock()
+        # first-touch index builds serialize here, NOT on the shard lock:
+        # the ingest drain (no shard lock held) is the usual builder, and
+        # a build under the shard lock was the old convoy (docs/ingest.md).
+        # _vector_indexes/_dims publish copy-on-write under this lock so
+        # every reader iterates a stable snapshot lock-free.
+        self._build_lock = threading.Lock()
+        # checkpoint gate: deferred post-lock index work (ragged feeds,
+        # index deletes) in flight — a checkpoint taken mid-window would
+        # record a seq whose index effects haven't landed yet
+        self._defer_ops = 0
         self._vector_indexes: dict[str, VectorIndex] = {}
         self._counter_path = os.path.join(dirpath, "counter.bin")
         self._meta_path = os.path.join(dirpath, "meta.bin")
@@ -123,19 +137,29 @@ class Shard:
         self._recover()
         from weaviate_tpu.storage.wal import WAL
 
-        self._delta = WAL(self._delta_path, sync=sync_writes)
-        # async indexing (ASYNC_INDEXING env or per-class config)
-        self.async_queue = None
-        if config.async_indexing or os.environ.get("ASYNC_INDEXING") == "true":
-            from weaviate_tpu.core.async_queue import AsyncVectorQueue
+        self._delta = WAL(self._delta_path, sync=sync_writes,
+                          group=sync_writes)
+        # ingest pipeline stage (docs/ingest.md): EVERY fixed-shape vector
+        # write enqueues a durable chunk inside the durability section and
+        # the device feed happens in drain windows outside the shard lock.
+        # Default = inline drain (put_batch drains its own chunks before
+        # returning: read-your-writes preserved, but readers and other
+        # writers never queue behind one writer's device build).
+        # ASYNC_INDEXING env / per-class config = the legacy fully-async
+        # mode: a background drainer, writes return before indexing.
+        from weaviate_tpu.core.async_queue import AsyncVectorQueue
 
-            self.async_queue = AsyncVectorQueue(
-                os.path.join(dirpath, "index_queue"),
-                index_for=self._index_for,
-                is_live=lambda d: bool(
-                    d < self._live.shape[0] and self._live[d]),
-                shard_label=name,
-            )
+        self._fully_async = bool(
+            config.async_indexing
+            or os.environ.get("ASYNC_INDEXING") == "true")
+        self.async_queue = AsyncVectorQueue(
+            os.path.join(dirpath, "index_queue"),
+            index_for=self._index_for,
+            is_live=lambda d: bool(
+                d < self._live.shape[0] and self._live[d]),
+            shard_label=name,
+        )
+        if self._fully_async:
             self.async_queue.start()
 
     # -- recovery ---------------------------------------------------------
@@ -313,6 +337,11 @@ class Shard:
         from weaviate_tpu.inverted.snapshot import save_snapshot
         from weaviate_tpu.storage.wal import WAL
 
+        # drain the ingest window OUTSIDE the lock first: the vector
+        # checkpoints below must contain every add <= seq, and draining
+        # in-lock would put device work back under the shard lock — the
+        # exact convoy the pipeline removed
+        self.async_queue.flush()
         with self._lock:
             if self._migrating:
                 # the tier migration's catch-up replay depends on the delta
@@ -322,6 +351,24 @@ class Shard:
                 # completed before the migration snapshotted its seq (all
                 # later records survive) or it sees the flag and skips.
                 return
+            if self._defer_ops:
+                # a racing writer's post-lock index work (ragged feed /
+                # deferred delete) is in flight: the index lags the delta
+                # seq. Skip — a skipped checkpoint never loses data (the
+                # delta log still covers everything), and this window is
+                # the brief post-lock tail of one batch, so the next
+                # cycle lands.
+                return
+            # residual chunks pushed between the flush above and this
+            # lock: drain them HERE so the vector snapshots provably
+            # cover every add <= seq. Bounded device work (the out-of-
+            # lock flush consumed the backlog, and pushes need the shard
+            # lock we hold, so nothing new can arrive) — a skip instead
+            # would starve under sustained ingest, where some writer's
+            # chunk is pending at almost every cycle, and the delta log
+            # would never truncate during exactly the ingest-while-
+            # serving workload that grows it fastest.
+            self.async_queue.drain_until_empty()
             seq = self._seq
             # objects the snapshot indexes must be durable BEFORE the delta
             # log is truncated — else a crash leaves doc ids the store can't
@@ -332,10 +379,10 @@ class Shard:
                 idx.flush()  # HNSW graph snapshot rides along
                 idx.save_vectors(self._vec_ckpt_path(nm), {"seq": seq})
             # all records are <= seq under the lock: drop the whole log
-            sync = self._delta.sync
+            sync, group = self._delta.sync, self._delta.group
             self._delta.close()
             WAL.delete(self._delta_path)
-            self._delta = WAL(self._delta_path, sync=sync)
+            self._delta = WAL(self._delta_path, sync=sync, group=group)
 
     @staticmethod
     def _atomic_write(path: str, blob: bytes) -> None:
@@ -396,15 +443,25 @@ class Shard:
 
     def _index_for(self, target: str, dims: int) -> VectorIndex:
         idx = self._vector_indexes.get(target)
-        if idx is None:
+        if idx is not None:
+            return idx
+        # first touch: build under the BUILD lock, never the shard lock —
+        # the ingest drain is the usual builder and a build in the shard
+        # lock was the old write-path convoy. Publish copy-on-write so
+        # concurrent readers iterate a stable dict snapshot lock-free.
+        with self._build_lock:
+            idx = self._vector_indexes.get(target)
+            if idx is not None:
+                return idx
             # 'vector__' + target: the double underscore keeps the unnamed
             # default ('vector__') from colliding with a vector named 'default'
             path = os.path.join(self.dir, f"vector__{target}")
+            # graftlint: allow[blocking-under-lock] reason=first-touch construction happens once per target on the build lock, which only other first-touch builders contend on; the shard lock (the write/read serving path) is never held here
             idx = build_vector_index(dims, self._config_for(target), path=path)
-            self._vector_indexes[target] = idx
-            self._dims[target] = dims
+            self._dims = {**self._dims, target: dims}
+            self._vector_indexes = {**self._vector_indexes, target: idx}
             self._persist_meta()
-        return idx
+            return idx
 
     def vector_index(self, target: str = DEFAULT_VECTOR) -> Optional[VectorIndex]:
         return self._vector_indexes.get(target)
@@ -413,9 +470,13 @@ class Shard:
     def put_batch(self, objs: list[StorageObject]) -> list[int]:
         """Batch insert/update. Returns assigned doc ids.
 
-        Mirrors objectsBatcher (``shard_write_batch_objects.go:84-140``):
-        resolve doc ids (new vs update), store objects, update inverted,
-        feed vector indexes in one device batch per target vector.
+        Mirrors objectsBatcher (``shard_write_batch_objects.go:84-140``),
+        restructured as the ingest pipeline's front stage (docs/ingest.md):
+        the lock-held critical section is DURABILITY ONLY — delta-log
+        append, object + inverted + id-map writes, and the vector chunk
+        push. The device feed (index build included) happens in queue
+        drain windows after the lock is released, so one writer's device
+        build never convoys every other writer and reader on the shard.
         """
         # memwatch gate (reference memwatch.CheckAlloc on the write path):
         # refuse the batch under memory pressure instead of OOMing mid-write
@@ -429,6 +490,9 @@ class Shard:
                   for v in o.named_vectors.values())
             for o in objs)
         MONITOR.check_alloc(est, "batch import")
+        deferred_deletes: Optional[np.ndarray] = None
+        ragged: list[tuple[str, np.ndarray, list]] = []
+        pushed: list[str] = []
         with self._lock:
             self._require_open()
             # validate up-front so a bad object can't leave a partial batch:
@@ -448,15 +512,32 @@ class Shard:
                             f"object {obj.uuid}: vector {nm or 'default'!r} dims "
                             f"{d} != index dims {want}"
                         )
+            new_dims = {nm: d for nm, d in batch_dims.items()
+                        if nm not in self._dims}
+            if new_dims:
+                # pin brand-new targets' dims NOW (the index itself builds
+                # lazily at drain time): a later batch with different dims
+                # must fail validation, not poison the drain
+                with self._build_lock:
+                    self._dims = {**self._dims, **new_dims}
+                    self._persist_meta()
             # same uuid twice in one batch: the later occurrence wins; the
             # earlier one is never written (it was never visible)
             final: dict[str, StorageObject] = {o.uuid: o for o in objs}
-            doc_ids: list[int] = []
             old_docids: list[int] = []
-            for obj in objs:
+            # doc ids are assigned over the DEDUPED set only: burning one
+            # per raw element desynced _next_doc_id from the live set when
+            # a batch repeated a uuid (dropped earlier duplicates report
+            # the winner's id — same uuid, same visible object)
+            for obj in final.values():
                 obj.doc_id = self._next_doc_id
                 self._next_doc_id += 1
-                doc_ids.append(obj.doc_id)
+            doc_ids: list[int] = []
+            for obj in objs:
+                winner = final[obj.uuid]
+                if obj is not winner:
+                    obj.doc_id = winner.doc_id
+                doc_ids.append(winner.doc_id)
             for uuid, obj in final.items():
                 prev = self.ids.get(uuid.encode())
                 if prev is not None:
@@ -496,25 +577,61 @@ class Shard:
                         b[1].append(np.asarray(v, np.float32))
 
             if old_docids:
-                self._delete_docids(old_docids)
+                deferred_deletes = self._delete_docids_durable(old_docids)
 
             for nm, (ids, vecs) in batches.items():
                 id_arr = np.asarray(ids, np.int64)
-                dims = int(np.asarray(vecs[0]).shape[-1])
-                # graftlint: allow[blocking-under-lock] reason=lazy index build on first write is the shard-open contract; the write already owns the shard
-                idx = self._index_for(nm, dims)
-                if (self.async_queue is not None
-                        and not idx.multi_vector):
-                    # fixed-shape targets enqueue; ragged multivector sets
-                    # index synchronously (the disk queue stores [n, D])
-                    self.async_queue.push(nm, id_arr, np.stack(vecs))
+                if self._config_for(nm).index_type == "multivector":
+                    # ragged token sets can't ride the disk queue (it
+                    # stores [n, D]); they feed synchronously AFTER the
+                    # lock instead
+                    ragged.append((nm, id_arr, vecs))
                 else:
-                    _feed_index(idx, id_arr, vecs)
+                    # durable chunk push — a disk write, part of the
+                    # durability section; the device feed happens in the
+                    # drain below, outside the lock
+                    pushed.append(self.async_queue.push(
+                        nm, id_arr, np.stack(vecs)))
             self._live_count += len(final)
+            self._defer_ops += 1
+        try:
+            # durability ack barrier (group commit): ONE fsync per WAL
+            # covering the whole batch, not one per record — a no-op in
+            # non-sync mode
+            if self._delta.group:
+                self._delta.sync_window()
+                self.store.sync_all()
+            if ragged:
+                # ragged sets bypass the queue but are still ingest work:
+                # same batch-group token as the drain (never coalesces
+                # with a live search batch) and same apply barrier, so
+                # demote/promote_device's "no feed interleaves with the
+                # array move" guarantee covers this path too
+                from weaviate_tpu.index.dispatch import dispatch_group
+
+                with dispatch_group(("ingest",)), \
+                        self.async_queue.apply_barrier():
+                    for nm, id_arr, vecs in ragged:
+                        idx = self._index_for(
+                            nm, int(np.asarray(vecs[0]).shape[-1]))
+                        _feed_index(idx, id_arr, vecs)
+            if deferred_deletes is not None:
+                self._apply_index_deletes(deferred_deletes)
+        finally:
+            with self._lock:
+                self._defer_ops -= 1
+        if pushed and not self._fully_async:
+            # inline mode: drain our own chunks (read-your-writes) — other
+            # writers' chunks coalesce into the same drain windows
+            self.async_queue.ensure_drained(pushed)
         self._maybe_upgrade_inverted()
         return doc_ids
 
-    def _delete_docids(self, doc_ids: list[int]) -> None:
+    def _delete_docids_durable(self, doc_ids: list[int]) -> np.ndarray:
+        """Durable half of a delete (caller holds the shard lock):
+        delta-log, inverted + object-store removal, liveness flip. The
+        device-index removal is deferred to :meth:`_apply_index_deletes`
+        OUTSIDE the lock."""
         self._seq += 1
         self._delta.append(msgpack.packb(
             {"s": self._seq, "o": "d", "d": [int(d) for d in doc_ids]},
@@ -528,9 +645,18 @@ class Shard:
                 self.objects.delete(_DOCID.pack(d))
                 self._mark_live(d, False)
                 self._live_count -= 1
-        arr = np.asarray(doc_ids, np.int64)
-        for idx in self._vector_indexes.values():
-            idx.delete(arr)
+        return np.asarray(doc_ids, np.int64)
+
+    def _apply_index_deletes(self, arr: np.ndarray) -> None:
+        """Device-index half of a delete, outside the shard lock, ordered
+        against the ingest drain via the queue's apply barrier: liveness
+        flipped false (under the shard lock) BEFORE this runs, so any
+        drain that liveness-checked the doc alive finishes first and the
+        delete lands after its add; later drains see it dead and skip —
+        either interleaving converges, resurrection is impossible."""
+        with self.async_queue.apply_barrier():
+            for idx in self._vector_indexes.values():
+                idx.delete(arr)
 
     def _require_open(self) -> None:
         """Caller holds ``self._lock``. A shard the tiering controller
@@ -545,7 +671,9 @@ class Shard:
                 "re-route to the re-opened shard")
 
     def delete(self, uuids: list[str]) -> int:
-        """Delete by uuid; returns number actually removed."""
+        """Delete by uuid; returns number actually removed. Same staging
+        as put_batch: durability under the lock, index removal after."""
+        arr: Optional[np.ndarray] = None
         with self._lock:
             self._require_open()
             doc_ids = []
@@ -557,8 +685,18 @@ class Shard:
                 doc_ids.append(_DOCID.unpack(prev)[0])
                 self.ids.delete(key)
             if doc_ids:
-                self._delete_docids(doc_ids)
-            return len(doc_ids)
+                arr = self._delete_docids_durable(doc_ids)
+                self._defer_ops += 1
+        if arr is not None:
+            try:
+                if self._delta.group:
+                    self._delta.sync_window()
+                    self.store.sync_all()
+                self._apply_index_deletes(arr)
+            finally:
+                with self._lock:
+                    self._defer_ops -= 1
+        return len(doc_ids)
 
     # -- read path --------------------------------------------------------
     def get_by_uuid(self, uuid: str) -> Optional[StorageObject]:
@@ -675,16 +813,19 @@ class Shard:
     def demote_device(self) -> int:
         """Warm demotion of every vector index; returns total HBM bytes
         released (the caller feeds this to the tiering accountant). Held
-        under the shard lock so a concurrent put cannot interleave with
-        the array move."""
+        under the shard lock AND the drain apply barrier so neither a
+        concurrent put's durability section nor an in-flight ingest drain
+        can interleave with the array move."""
         with self._lock:
-            return sum(idx.demote_device()
-                       for idx in self._vector_indexes.values())
+            with self.async_queue.apply_barrier():
+                return sum(idx.demote_device()
+                           for idx in self._vector_indexes.values())
 
     def promote_device(self) -> int:
         with self._lock:
-            return sum(idx.promote_device()
-                       for idx in self._vector_indexes.values())
+            with self.async_queue.apply_barrier():
+                return sum(idx.promote_device()
+                           for idx in self._vector_indexes.values())
 
     # -- lifecycle --------------------------------------------------------
     def flush(self) -> None:

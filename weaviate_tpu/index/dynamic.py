@@ -5,10 +5,30 @@ upgrade). On TPU the flat index stays competitive far longer than on CPU
 (the scan is one matmul), so the default threshold is higher than the
 reference's 10k; the upgrade rebuilds the graph from the flat store's
 device-resident vectors without leaving HBM.
+
+Background cutover (docs/ingest.md): by default the flat→HNSW upgrade is
+a BACKGROUND build — the write that crosses the threshold returns
+immediately and searches keep serving from flat while ``index_existing``
+builds the graph off-thread over a snapshot of the shared device store.
+The cutover then catches up (a second ``index_existing`` pass picks up
+exactly the ids added during the build — vectors at a doc id are
+immutable, updates mint new ids) and swaps the inner index atomically
+under a brief writer quiesce. No write ever pays the graph-build tax.
+
+State machine: ``idle → building → done`` (or ``→ failed``, which keeps
+serving from flat — correctness is never at stake, only the crossover
+to sub-linear search — and retries at the first threshold crossing
+after a backoff window). A crash mid-build costs only the partial graph:
+the store is rebuilt from the durable object log on boot and the next
+threshold crossing restarts the build (HNSW construction is idempotent —
+``add_batch``/``index_existing`` skip ids already in the graph).
 """
 
 from __future__ import annotations
 
+import logging
+import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -22,6 +42,15 @@ from weaviate_tpu.schema.config import (
     HNSWIndexConfig,
 )
 
+logger = logging.getLogger("weaviate_tpu.dynamic")
+
+# seconds a FAILED background cutover waits before the next threshold
+# crossing may retry the build: long enough that a persistent cause
+# (bad config, corrupted store) doesn't hot-loop seconds-long builds,
+# short enough that a transient one (tier demotion mid-build, memory
+# pressure) doesn't latch linear-scan serving until process restart
+CUTOVER_RETRY_BACKOFF_S = 60.0
+
 
 class DynamicIndex(VectorIndex):
     def __init__(
@@ -34,7 +63,8 @@ class DynamicIndex(VectorIndex):
         self.dims = dims
         self.path = path
         base = self.config.to_dict()
-        for key in ("index_type", "threshold", "hnsw", "flat"):
+        for key in ("index_type", "threshold", "hnsw", "flat",
+                    "cutover_background"):
             base.pop(key, None)
         base.pop("quantizer", None)
         flat_overrides = self.config.flat or {}
@@ -43,6 +73,18 @@ class DynamicIndex(VectorIndex):
         self._hnsw_cfg = HNSWIndexConfig(**{**base, **hnsw_overrides})
         self._inner: VectorIndex = FlatIndex(dims, self._flat_cfg)
         self._upgraded = False
+        # background cutover machinery. _swap_lock brackets every inner
+        # MUTATION (one store put / delete — fast) so the builder's
+        # catch-up + swap phase can quiesce writers briefly; searches
+        # read self._inner without it (attribute swap is atomic).
+        self._swap_lock = threading.Lock()
+        self._cutover_state = "idle"  # idle|building|done|failed
+        self._cutover_failed_at = 0.0  # monotonic; gates the retry backoff
+        self._cutover_thread: Optional[threading.Thread] = None
+        # ids deleted while the build is in flight: the builder may have
+        # already graph-inserted them, so the swap re-applies the delete
+        # to the new graph (the store itself saw it immediately)
+        self._pending_deletes: list[int] = []
 
     @property
     def inner(self) -> VectorIndex:
@@ -52,24 +94,134 @@ class DynamicIndex(VectorIndex):
     def upgraded(self) -> bool:
         return self._upgraded
 
+    @property
+    def cutover_state(self) -> str:
+        return self._cutover_state
+
     def _maybe_upgrade(self) -> None:
         if self._upgraded or self._inner.count() < self.config.threshold:
             return
-        flat: FlatIndex = self._inner  # type: ignore[assignment]
-        # hand over the device store wholesale; rebuild only the graph —
-        # vectors never leave HBM
-        hnsw = HNSWIndex(self.dims, self._hnsw_cfg, path=self.path, store=flat.store)
-        hnsw.index_existing()
-        self._inner = hnsw
-        self._upgraded = True
+        if not getattr(self.config, "cutover_background", True):
+            self._upgrade_sync()
+            return
+        self._start_cutover()
+
+    def _upgrade_sync(self) -> None:
+        """Legacy synchronous upgrade (cutover_background=False): the
+        write that crosses the threshold blocks until the graph exists."""
+        from weaviate_tpu.index.dispatch import dispatch_group
+
+        with dispatch_group(("ingest",)), self._swap_lock:
+            if self._upgraded:
+                return
+            flat: FlatIndex = self._inner  # type: ignore[assignment]
+            # hand over the device store wholesale; rebuild only the
+            # graph — vectors never leave HBM
+            hnsw = HNSWIndex(self.dims, self._hnsw_cfg, path=self.path,
+                             store=flat.store)
+            # graftlint: allow[blocking-under-lock] reason=cutover_background=False is the explicit opt-IN to the blocking legacy upgrade; the default path builds off-thread
+            hnsw.index_existing()
+            self._inner = hnsw
+            self._upgraded = True
+            self._cutover_state = "done"
+
+    def _start_cutover(self) -> None:
+        with self._swap_lock:
+            if self._upgraded:
+                return
+            if self._cutover_state == "failed":
+                # a failed build must not latch linear-scan serving
+                # forever: transient causes (tier demotion mid-build,
+                # OOM pressure) clear. Back off, then let the next
+                # threshold crossing retry; a persistent cause fails
+                # again at most once per backoff window.
+                if (time.monotonic() - self._cutover_failed_at
+                        < CUTOVER_RETRY_BACKOFF_S):
+                    return
+            elif self._cutover_state != "idle":
+                return
+            self._cutover_state = "building"
+            self._pending_deletes = []
+        t = threading.Thread(target=self._build_cutover, daemon=True,
+                             name="dynamic-cutover")
+        self._cutover_thread = t
+        t.start()
+
+    def _build_cutover(self) -> None:
+        from weaviate_tpu.monitoring import tracing
+        from weaviate_tpu.monitoring.metrics import INDEX_CUTOVER_SECONDS
+
+        from weaviate_tpu.index.dispatch import dispatch_group
+
+        t0 = time.perf_counter()
+        outcome = "failed"
+        try:
+            # the construction beam is ingest work: under the ingest
+            # batch-group token its dispatcher-mediated searches coalesce
+            # with other builds, never with a live serving batch
+            with dispatch_group(("ingest",)), tracing.TRACER.span(
+                    "index.cutover", threshold=self.config.threshold,
+                    count=self._inner.count()) as span:
+                flat: FlatIndex = self._inner  # type: ignore[assignment]
+                hnsw = HNSWIndex(self.dims, self._hnsw_cfg, path=self.path,
+                                 store=flat.store)
+                # phase 1: bulk build, NO lock — writers keep feeding
+                # flat (shared store), searches keep serving from flat.
+                # Rows frozen at snapshot time are immutable (doc ids
+                # are never rewritten in place), so the lock-free walk
+                # reads stable vectors.
+                hnsw.index_existing()
+                # phase 2: brief writer quiesce — replay the delta (ids
+                # that landed during phase 1; index_existing inserts
+                # exactly the live store ids the graph lacks), re-apply
+                # in-flight deletes, then swap atomically.
+                with self._swap_lock:
+                    # graftlint: allow[blocking-under-lock] reason=this IS the atomic swap's writer quiesce — the catch-up pass is bounded by the adds that landed during the bulk build, and searches never take this lock
+                    hnsw.index_existing()
+                    if self._pending_deletes:
+                        hnsw.delete(np.asarray(
+                            sorted(set(self._pending_deletes)), np.int64))
+                        self._pending_deletes = []
+                    self._inner = hnsw
+                    self._upgraded = True
+                    self._cutover_state = "done"
+                outcome = "completed"
+                span.set(nodes=hnsw.count(), outcome=outcome)
+        except Exception:
+            # flat keeps serving (correctness is never at stake — only
+            # the crossover to sub-linear search); the operator sees the
+            # outcome label + this log line, and the next threshold
+            # crossing after the backoff retries the build
+            with self._swap_lock:
+                self._cutover_state = "failed"
+                self._cutover_failed_at = time.monotonic()
+            logger.exception("background flat->HNSW cutover failed; "
+                             "flat index keeps serving until the next "
+                             "post-backoff threshold crossing retries")
+        finally:
+            INDEX_CUTOVER_SECONDS.observe(
+                time.perf_counter() - t0, outcome=outcome)
+
+    def wait_cutover(self, timeout: Optional[float] = None) -> bool:
+        """Block until an in-flight background cutover finishes (tests +
+        explicit maintenance); returns whether the index is upgraded."""
+        t = self._cutover_thread
+        if t is not None:
+            t.join(timeout)
+        return self._upgraded
 
     # -- VectorIndex ------------------------------------------------------
     def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
-        self._inner.add_batch(doc_ids, vectors)
+        with self._swap_lock:
+            self._inner.add_batch(doc_ids, vectors)
         self._maybe_upgrade()
 
     def delete(self, doc_ids: np.ndarray) -> None:
-        self._inner.delete(doc_ids)
+        with self._swap_lock:
+            self._inner.delete(doc_ids)
+            if self._cutover_state == "building":
+                self._pending_deletes.extend(
+                    int(d) for d in np.asarray(doc_ids).ravel())
 
     def search(self, queries, k, allow_list=None) -> SearchResult:
         return self._inner.search(queries, k, allow_list)
@@ -91,6 +243,13 @@ class DynamicIndex(VectorIndex):
         self._inner.flush()
 
     def close(self) -> None:
+        # a close racing an in-flight build: let the builder finish its
+        # swap (bounded by the catch-up pass) rather than tear the store
+        # out from under it; the thread is daemonic, so a wedged build
+        # never blocks interpreter exit past the timeout
+        t = self._cutover_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=30.0)
         if hasattr(self._inner, "close"):
             self._inner.close()
 
@@ -127,4 +286,5 @@ class DynamicIndex(VectorIndex):
         s = self._inner.stats()
         s["type"] = f"dynamic[{s['type']}]"
         s["upgraded"] = self._upgraded
+        s["cutover_state"] = self._cutover_state
         return s

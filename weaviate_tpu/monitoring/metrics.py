@@ -460,6 +460,36 @@ NODE_HBM_USED = REGISTRY.gauge(
     "per-node HBM bytes in use as advertised via gossip (the tiering "
     "accountant ledger total), by node")
 
+# streaming ingest pipeline instruments (core/async_queue.py drain stage +
+# storage debt-driven compaction + index/dynamic.py background cutover,
+# docs/ingest.md): the WAL→device window depth, how long each drain window
+# takes, the merge debt the compactor is scheduled against (also the
+# backpressure signal the QoS ingest lane sheds on), and the wall time of
+# a background flat→HNSW cutover
+INGEST_QUEUE_DEPTH = REGISTRY.gauge(
+    "weaviate_tpu_ingest_queue_depth",
+    "vectors waiting in the WAL->device ingest window, by shard "
+    "(delta-logged and acked; the device feed still owes them) — the "
+    "same unit the ingest_shed_queue_depth backpressure knob sheds "
+    "against, so the gauge IS the signal to tune that knob by")
+INGEST_DRAIN_SECONDS = REGISTRY.histogram(
+    "weaviate_tpu_ingest_drain_seconds",
+    "wall time of one ingest drain window (chunk-file read through the "
+    "last pow2-bucketed device feed of the window)")
+COMPACTION_DEBT_BYTES = REGISTRY.gauge(
+    "weaviate_tpu_compaction_debt_bytes",
+    "outstanding segment-merge debt across all open shards (sum over "
+    "buckets of (segment_count - 1) x overlap bytes) — the score the "
+    "debt-driven compaction scheduler ranks by and the QoS ingest lane "
+    "sheds against")
+INDEX_CUTOVER_SECONDS = REGISTRY.histogram(
+    "weaviate_tpu_index_cutover_seconds",
+    "wall time of one background flat->HNSW dynamic-index cutover "
+    "(snapshot build + delta replay + atomic swap), by outcome "
+    "(completed/cancelled/failed)",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+             300.0))
+
 # persistent compilation cache + shape-bucket prewarming instruments
 # (utils/compile_cache.py + utils/prewarm.py): whether a restarted node
 # deserialized its programs off disk instead of recompiling, and how much

@@ -452,6 +452,12 @@ class DynamicIndexConfig(VectorIndexConfig):
     threshold: int = 10_000
     hnsw: Optional[dict] = None  # HNSWIndexConfig dict used after upgrade
     flat: Optional[dict] = None
+    # background cutover (docs/ingest.md): past the threshold the HNSW
+    # graph builds OFF-THREAD on a snapshot while searches keep serving
+    # from flat, then swaps in atomically after a writer-quiesced delta
+    # replay — no write ever pays the graph-build tax. False restores the
+    # legacy synchronous upgrade (the unlucky write blocks until built).
+    cutover_background: bool = True
 
 
 # ---------------------------------------------------------------------------

@@ -126,6 +126,12 @@ class AdmissionController:
             name: [] for name in self.lanes}
         self._credits: dict[str, float] = {name: 0.0 for name in self.lanes}
         self._svc_ewma = 0.05  # smoothed queue+execute seconds, Retry-After
+        # ingest backpressure supplier (docs/ingest.md), wired by the DB:
+        # () -> (pending vectors in the WAL->device window, compaction
+        # debt bytes). When either crosses its runtime knob the BATCH
+        # lane sheds with Retry-After — admission is where the pipeline
+        # says "stop feeding me", before the WAL grows unbounded.
+        self.ingest_pressure: Optional[Callable[[], tuple]] = None
 
     # -- admission ---------------------------------------------------------
     @staticmethod
@@ -147,6 +153,16 @@ class AdmissionController:
         if deadline is not None and deadline.expired:
             QOS_EXPIRED.inc(lane=lane)
             deadline.require()  # raises DeadlineExceeded
+        if lane == BATCH:
+            shed = self._check_ingest_pressure()
+            if shed is not None:
+                reason, retry_after = shed
+                QOS_SHED.inc(lane=lane, reason=reason)
+                raise QosRejected(
+                    f"ingest backpressure: {reason.replace('_', ' ')} over "
+                    "its shed threshold (the WAL->device window or merge "
+                    "debt must drain first)",
+                    retry_after=retry_after, reason=reason)
         throttle_wait = self.throttle.check(tenant)
         if throttle_wait is not None:
             # label cardinality must stay bounded: only operator-pinned
@@ -198,6 +214,30 @@ class AdmissionController:
                                exemplar=current_trace_id())
         QOS_ADMITTED.inc(lane=lane)
         return _Ticket(self, lane, t0, queue_wait=queue_wait)
+
+    def _check_ingest_pressure(self) -> Optional[tuple[str, float]]:
+        """(reason, retry_after) when the ingest pipeline is over a shed
+        threshold, else None. A knob set to 0 disables that signal. The
+        Retry-After hint scales with how far past the threshold the
+        signal is — at 3x the threshold a client backs off 3x longer
+        (capped) than one arriving right at the line."""
+        if self.ingest_pressure is None:
+            return None
+        from weaviate_tpu.utils.runtime_config import (
+            INGEST_SHED_DEBT_BYTES,
+            INGEST_SHED_QUEUE_DEPTH,
+        )
+
+        depth, debt = self.ingest_pressure()
+        max_depth = int(INGEST_SHED_QUEUE_DEPTH.get())
+        if max_depth > 0 and depth >= max_depth:
+            return "ingest_queue", float(
+                min(30.0, max(1.0, math.ceil(depth / max_depth))))
+        max_debt = int(INGEST_SHED_DEBT_BYTES.get())
+        if max_debt > 0 and debt >= max_debt:
+            return "compaction_debt", float(
+                min(30.0, max(1.0, math.ceil(debt / max_debt))))
+        return None
 
     def _wait(self, waiter: _Waiter, deadline) -> None:
         while True:

@@ -47,12 +47,13 @@ class ShardClosed(RuntimeError):
 
 class Bucket:
     def __init__(self, dirpath: str, strategy: str = "replace", sync: bool = False,
-                 memtable_max_entries: int = 100_000):
+                 memtable_max_entries: int = 100_000, group: bool = False):
         if strategy not in STRATEGIES:
             raise ValueError(f"unknown strategy {strategy!r}")
         self.dir = dirpath
         self.strategy = strategy
         self.memtable_max_entries = memtable_max_entries
+        self.group = group  # group-commit WAL (one fsync per sync_window)
         os.makedirs(dirpath, exist_ok=True)
         self._lock = threading.RLock()
         self._mem: dict[bytes, Any] = {}
@@ -83,7 +84,7 @@ class Bucket:
         for rec in WAL.replay(wal_path):
             op = msgpack.unpackb(rec, raw=True)
             self._apply_mem(op[b"k"], op[b"v"])
-        self._wal = WAL(wal_path, sync=sync)
+        self._wal = WAL(wal_path, sync=sync, group=self.group)
 
     # -- strategy-aware memtable application ------------------------------
     def _apply_mem(self, key: bytes, val) -> None:
@@ -344,7 +345,8 @@ class Bucket:
             self._mem = {}
             self._wal.close()
             WAL.delete(self._wal.path)
-            self._wal = WAL(self._wal.path, sync=self._wal.sync)
+            self._wal = WAL(self._wal.path, sync=self._wal.sync,
+                            group=self._wal.group)
 
     def _merge_to(self, path: str, old: list, drop_tombstones: bool):
         """Merge ``old`` (oldest first) into a new segment at ``path``.
@@ -429,6 +431,40 @@ class Bucket:
             if not self.compact_once():
                 return
 
+    def compaction_debt(self) -> int:
+        """Outstanding merge work this bucket owes, in bytes — the
+        leveled-policy debt score (docs/ingest.md): ``(segment_count - 1)
+        × overlap bytes``, where overlap is the bytes that must be
+        rewritten to collapse the stack to one segment (total minus the
+        largest segment — LSM segments overlap the full key range). A
+        single-segment or paused bucket owes nothing. The debt-driven
+        scheduler ranks buckets by this score instead of sweeping every
+        bucket on a fixed clock."""
+        with self._lock:
+            if self._paused or len(self._segments) <= 1:
+                return 0
+            try:
+                sizes = [os.path.getsize(s.path) for s in self._segments]
+            except OSError:
+                return 0  # a racing compaction swapped files; next pass
+            overlap = sum(sizes) - max(sizes)
+            return max(0, (len(sizes) - 1) * overlap)
+
+    def sync_window(self) -> None:
+        """Group-commit barrier for this bucket's WAL, safe against a
+        concurrent memtable-flush rotation: the WAL reference is captured
+        under the bucket lock, and a barrier that loses the race to the
+        rotation (closed file) is satisfied vacuously — flush_memtable
+        wrote every one of that WAL's records into a segment before
+        closing it."""
+        with self._lock:
+            wal = self._wal
+        try:
+            wal.sync_window()
+        except ValueError:
+            if not wal.closed:
+                raise
+
     def flush(self) -> None:
         self._wal.flush()
 
@@ -476,9 +512,10 @@ def _as_layer(v):
 class Store:
     """Named buckets rooted at a shard directory (reference ``store.go:41``)."""
 
-    def __init__(self, dirpath: str, sync: bool = False):
+    def __init__(self, dirpath: str, sync: bool = False, group: bool = False):
         self.dir = dirpath
         self.sync = sync
+        self.group = group  # bucket WALs group-commit; ack via sync_all()
         os.makedirs(dirpath, exist_ok=True)
         self._buckets: dict[str, Bucket] = {}
         self._lock = threading.Lock()
@@ -487,7 +524,8 @@ class Store:
         with self._lock:
             b = self._buckets.get(name)
             if b is None:
-                b = Bucket(os.path.join(self.dir, name), strategy, sync=self.sync, **kw)
+                b = Bucket(os.path.join(self.dir, name), strategy,
+                           sync=self.sync, group=self.group, **kw)
                 self._buckets[name] = b
             elif b.strategy != strategy:
                 raise ValueError(
@@ -531,6 +569,31 @@ class Store:
         with self._lock:
             for b in self._buckets.values():
                 b.resume_maintenance()
+
+    def sync_all(self) -> None:
+        """Group-commit barrier across every bucket: one fsync per bucket
+        WAL covering all records appended before the call (the per-batch
+        durability ack of the ingest pipeline, docs/ingest.md). A no-op
+        for non-group stores (every append already synced or soft)."""
+        with self._lock:
+            buckets = list(self._buckets.values())
+        for b in buckets:
+            b.sync_window()
+
+    def compaction_debt(self) -> int:
+        """Total merge debt across buckets (see Bucket.compaction_debt)."""
+        with self._lock:
+            buckets = list(self._buckets.values())
+        return sum(b.compaction_debt() for b in buckets)
+
+    def debt_ranked_buckets(self) -> list[tuple[int, "Bucket"]]:
+        """(debt, bucket) pairs with positive debt, highest first — the
+        debt-driven compaction scheduler's work queue."""
+        with self._lock:
+            buckets = list(self._buckets.values())
+        ranked = [(b.compaction_debt(), b) for b in buckets]
+        return sorted(((d, b) for d, b in ranked if d > 0),
+                      key=lambda t: -t[0])
 
     def compact_all(self, min_segments: int = 4) -> None:
         """Background compaction entry (reference cyclemanager-driven

@@ -200,6 +200,23 @@ PREWARM_CONCURRENCY = RUNTIME.register("prewarm_concurrency", 2, cast=int)
 # workaround is a knob now, not a constant
 CLUSTER_FINISH_BUDGET_S = RUNTIME.register(
     "cluster_finish_budget_s", 10.0, cast=float)
+# streaming ingest pipeline (docs/ingest.md): backpressure thresholds the
+# QoS ingest (batch) lane sheds against — pending vectors in the
+# WAL->device window across open shards, and outstanding compaction debt.
+# 0 disables that signal. Hot-reloadable: an operator can tighten them on
+# a node whose WAL is outgrowing its drain rate.
+INGEST_SHED_QUEUE_DEPTH = RUNTIME.register(
+    "ingest_shed_queue_depth", 500_000, cast=int)
+INGEST_SHED_DEBT_BYTES = RUNTIME.register(
+    "ingest_shed_debt_bytes", 4 << 30, cast=int)
+# debt-driven compaction scheduler (core/db.py): merge debt (bytes) past
+# which the compaction cycle runs ahead of its interval backstop, and how
+# many bucket merges may run concurrently per pass (native merges are
+# CPU+IO bound; the cap keeps them from starving the serving threads)
+COMPACTION_DEBT_TARGET_BYTES = RUNTIME.register(
+    "compaction_debt_target_bytes", 64 << 20, cast=int)
+COMPACTION_MAX_MERGES = RUNTIME.register(
+    "compaction_max_merges", 2, cast=int)
 # hybrid search (core/collection.py hybrid_search, docs/hybrid.md): each
 # leg over-fetches ceil(factor * k) candidates so fusion has room beyond
 # the final page — the reference fetches ~2x k per leg; the old
