@@ -30,12 +30,16 @@ test:
 		-p no:cacheprovider
 
 # The chaos suite, slow soaks included: replica coordination under
-# seeded drop/latency/partition faults, and the elastic scale-out
+# seeded drop/latency/partition faults, the elastic scale-out
 # scenario (3->5 nodes under live ingest+search, donor killed
-# mid-migration, crash-resume via the rebalance ledger).
+# mid-migration, crash-resume via the rebalance ledger), and the cold
+# tier / cluster backup scenarios (kill mid-offload and mid-backup,
+# bucket outages, 3-node backup restored into 5 nodes with zero lost
+# acked writes).
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_replication.py \
-		tests/test_rebalance.py -q -p no:cacheprovider
+		tests/test_rebalance.py tests/test_coldtier_chaos.py \
+		-q -p no:cacheprovider
 
 # Boot a node on a loopback port, run a mixed search/ingest burst, and
 # pretty-print the assembled trace tree from /v1/debug/traces — the

@@ -2282,6 +2282,198 @@ def bench_rebalance(n=20_000, d=64, shards=8, batch=8, k=10, iters=0,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_coldtier(n=64_000, d=256, tenants=8, k=10, cluster_objs=400,
+                   shards=6):
+    """Bottomless cold tier + cluster backup (docs/backup.md): three
+    journal lines. (1) ``coldtier_offload_mb_s`` — wholesale tenant
+    offload throughput into the blob tier (manifest-first,
+    verify-then-delete-local) driven through the real tiering
+    controller; (2) ``coldtier_hydrate_first_query_ms`` — first search
+    against an offloaded tenant, paying download + digest verify +
+    install through the single-flight promotion path; (3)
+    ``backup_restore_zero_loss`` — a snapshot-consistent 3-node cluster
+    backup taken under live writes, restored into a 5-node cluster, with
+    every acked write audited readable (1 = zero lost, the number this
+    subsystem exists to pin)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from weaviate_tpu.backup.blobstore import LocalDirBlobStore
+    from weaviate_tpu.backup.cluster_backup import ClusterBackupCoordinator
+    from weaviate_tpu.cluster import ClusterNode, InProcTransport
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        FlatIndexConfig,
+        MultiTenancyConfig,
+        Property,
+        ReplicationConfig,
+        ShardingConfig,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+    from weaviate_tpu.tiering.coldstore import TenantColdStore
+
+    per = max(256, n // tenants)
+    n = per * tenants
+    rng = np.random.default_rng(13)
+    root = tempfile.mkdtemp(prefix="bench_coldtier_")
+    store = LocalDirBlobStore(f"{root}/bucket")
+    db = DB(f"{root}/db", tiering_budget_bytes=1 << 62)
+    db.tiering.coldstore = TenantColdStore(store)
+    try:
+        col = db.create_collection(CollectionConfig(
+            name="Cold", multi_tenancy=MultiTenancyConfig(enabled=True)))
+        names = [f"t{t:03d}" for t in range(tenants)]
+        for name in names:
+            col.add_tenant(name)
+            vecs = rng.standard_normal((per, d)).astype(np.float32)
+            for lo in range(0, per, 2048):
+                col.put_batch(
+                    [StorageObject(uuid=f"{name}-{i:08d}",
+                                   collection="Cold", properties={},
+                                   vector=vecs[i], tenant=name)
+                     for i in range(lo, min(lo + 2048, per))],
+                    tenant=name)
+
+        # ---- offload: every tenant wholesale into the blob tier ----------
+        local_bytes = sum(
+            os.path.getsize(os.path.join(dp, f))
+            for dp, _, fs in os.walk(col.dir) for f in fs)
+        db.tiering.cold_after_s = 0.0
+        time.sleep(0.01)
+        t0 = time.perf_counter()
+        db.tiering.tick()  # hot -> warm
+        db.tiering.tick()  # warm -> cold + offload
+        offload_s = time.perf_counter() - t0
+        offloaded = sum(
+            1 for e in db.tiering.stats()["tenants"].values()
+            if e["state"] == "cold")
+        _emit({
+            "metric": "coldtier_offload_mb_s",
+            "value": round(local_bytes / 1e6 / offload_s, 1),
+            "unit": "MB/s", "vs_baseline": 0, "n": n, "d": d,
+            "tenants": tenants, "offloaded": offloaded,
+            "bytes": local_bytes, "offload_s": round(offload_s, 2),
+        })
+
+        # ---- hydrate: first query pays download + verify + install -------
+        db.tiering.cold_after_s = 3600.0  # hydrated tenants stay hot
+        q = rng.standard_normal(d).astype(np.float32)
+        lat_ms = []
+        for name in names[:5]:
+            t0 = time.perf_counter()
+            hits = col.vector_search(q, k, tenant=name)
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+            assert len(hits) == k
+        lat_ms.sort()
+        _emit({
+            "metric": "coldtier_hydrate_first_query_ms",
+            "value": round(lat_ms[len(lat_ms) // 2], 2), "unit": "ms",
+            "vs_baseline": 0, "p_max": round(lat_ms[-1], 2),
+            "sampled": len(lat_ms), "per_tenant_rows": per,
+            "per_tenant_mb": round(local_bytes / tenants / 1e6, 1),
+        })
+    finally:
+        db.close()
+
+    # ---- cluster backup under live writes -> restore into 5 nodes --------
+    registry = {}
+    ids = [f"n{i}" for i in range(3)]
+    nodes = [ClusterNode(nid, ids, InProcTransport(registry, nid),
+                         f"{root}/{nid}") for nid in ids]
+    for nd in nodes:
+        nd.blobstore = store
+    restored = []
+    try:
+        t_deadline = time.monotonic() + 30
+        while not any(nd.raft.is_leader() for nd in nodes):
+            if time.monotonic() > t_deadline:
+                raise RuntimeError("no raft leader")
+            time.sleep(0.05)
+        leader = next(nd for nd in nodes if nd.raft.is_leader())
+        leader.create_collection(CollectionConfig(
+            name="Bench", properties=[Property(name="body")],
+            vector_config=FlatIndexConfig(distance="l2-squared",
+                                          precision="fp32"),
+            sharding=ShardingConfig(desired_count=shards),
+            replication=ReplicationConfig(factor=1)))
+        while not all(nd.db.has_collection("Bench") for nd in nodes):
+            time.sleep(0.05)
+
+        bvecs = rng.standard_normal((cluster_objs, d)).astype(np.float32)
+
+        def obj(i):
+            return StorageObject(uuid=f"{i:032x}", collection="Bench",
+                                 properties={"body": f"doc {i}"},
+                                 vector=bvecs[i % cluster_objs])
+
+        nodes[0].put_batch("Bench", [obj(i) for i in range(cluster_objs)],
+                           consistency="ONE")
+        acked, stop = [f"{i:032x}" for i in range(cluster_objs)], \
+            threading.Event()
+
+        def writer():
+            i = cluster_objs
+            while not stop.is_set():
+                nodes[0].put_batch("Bench", [obj(i)], consistency="ONE")
+                acked.append(f"{i:032x}")
+                i += 1
+                time.sleep(0.002)
+
+        th = threading.Thread(target=writer, daemon=True)
+        th.start()
+        time.sleep(0.05)
+        acked_before_fence = list(acked)
+        t0 = time.perf_counter()
+        out = ClusterBackupCoordinator(leader, store).backup("bench-bk")
+        backup_s = time.perf_counter() - t0
+        stop.set()
+        th.join(timeout=5)
+
+        m_ids = [f"m{i}" for i in range(5)]
+        restored = [ClusterNode(mid, m_ids, InProcTransport(registry, mid),
+                                f"{root}/new/{mid}") for mid in m_ids]
+        for nd in restored:
+            nd.blobstore = store
+        while not any(nd.raft.is_leader() for nd in restored):
+            time.sleep(0.05)
+        rleader = next(nd for nd in restored if nd.raft.is_leader())
+        t0 = time.perf_counter()
+        ClusterBackupCoordinator(rleader, store).restore("bench-bk")
+        restore_s = time.perf_counter() - t0
+        while not all(nd.db.has_collection("Bench") for nd in restored):
+            time.sleep(0.05)
+
+        def placement(nd):
+            st = nd._state_for("Bench")
+            return [tuple(st.replicas(s)) for s in range(st.n_shards)]
+
+        t_deadline = time.monotonic() + 30
+        while not all(placement(nd) == placement(restored[0])
+                      for nd in restored):
+            if time.monotonic() > t_deadline:
+                raise RuntimeError("placement never converged")
+            time.sleep(0.05)
+        lost = sum(1 for uid in acked_before_fence
+                   if restored[1].get("Bench", uid,
+                                      consistency="ONE") is None)
+        _emit({
+            "metric": "backup_restore_zero_loss",
+            "value": int(lost == 0), "unit": "bool", "vs_baseline": 0,
+            "acked_before_fence": len(acked_before_fence), "lost": lost,
+            "backup_bytes": out.get("bytes", 0), "source_nodes": 3,
+            "restored_nodes": 5, "backup_s": round(backup_s, 2),
+            "restore_s": round(restore_s, 2),
+        })
+    finally:
+        for nd in nodes + restored:
+            nd.quiesce()
+        for nd in nodes + restored:
+            nd.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_pallas_ab(**kw):
     """The one Pallas compile in the matrix, as its own config ordered
     after every XLA-only serving config: a wedged compile helper
@@ -2794,6 +2986,7 @@ CONFIGS = {
     "ingestmp": bench_ingest_parallel,
     "ingestserve": bench_ingest_serving,
     "rebalance": bench_rebalance,
+    "coldtier": bench_coldtier,
     "coldstart": bench_coldstart,
     "rerank": bench_rerank,
     "pallasab": bench_pallas_ab,
@@ -2802,7 +2995,8 @@ CONFIGS = {
 }
 
 # configs that touch no device: they run even when the TPU probe fails
-CPU_ONLY = ("bm25", "bm25seg", "ingest", "ingestmp", "rebalance")
+CPU_ONLY = ("bm25", "bm25seg", "ingest", "ingestmp", "rebalance",
+            "coldtier")
 
 # ---------------------------------------------------------------------------
 # smoke mode: every config end-to-end at ~1/50 scale on CPU (<10 min total),
@@ -2953,6 +3147,8 @@ SMOKE = {
     "ingestserve": dict(n=6_000, d=32, batch=500),
     # semantics check (moves happen, nothing lost), not a latency claim
     "rebalance": dict(n=2_000, shards=4, load_seconds=1.5),
+    # offload/hydrate/backup semantics check, not a throughput claim
+    "coldtier": dict(n=2_048, d=32, tenants=4, cluster_objs=60, shards=4),
     # three subprocess builds: keep each tiny (restart semantics check)
     "coldstart": dict(n=1_500, d=32),
     # quality-delta semantics check (fused vs host MaxSim), not a

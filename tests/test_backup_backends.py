@@ -1,0 +1,221 @@
+"""Backup backend + blob store unit tests: path confinement, atomic
+meta replace, object-store key layout, and the fault-injecting blob
+wrapper the chaos suites drive offload/backup through."""
+
+import os
+
+import pytest
+
+from weaviate_tpu.backup.backends import (
+    FilesystemBackend,
+    ObjectStoreBackend,
+    confine,
+    validate_backup_id,
+)
+from weaviate_tpu.backup.blobstore import (
+    BlobStoreError,
+    FaultInjectingBlobStore,
+    LocalDirBlobStore,
+    validate_key,
+)
+
+
+# ------------------------------------------------------------ confinement
+class TestConfine:
+    def test_inside_passes(self, tmp_path):
+        base = str(tmp_path / "b")
+        os.makedirs(base)
+        assert confine(base, os.path.join(base, "x", "y")) \
+            == os.path.join(base, "x", "y")
+        assert confine(base, base) == base
+
+    def test_dotdot_traversal_refused(self, tmp_path):
+        base = str(tmp_path / "b")
+        os.makedirs(base)
+        with pytest.raises(ValueError):
+            confine(base, os.path.join(base, "..", "outside"))
+
+    def test_sibling_prefix_refused(self, tmp_path):
+        # "/root/b-evil" must not pass as inside "/root/b" (sep-aware
+        # prefix check, not a raw startswith)
+        base = str(tmp_path / "b")
+        os.makedirs(base)
+        os.makedirs(str(tmp_path / "b-evil"))
+        with pytest.raises(ValueError):
+            confine(base, str(tmp_path / "b-evil"))
+
+    def test_symlink_escape_refused(self, tmp_path):
+        base = str(tmp_path / "b")
+        os.makedirs(base)
+        outside = tmp_path / "outside"
+        outside.mkdir()
+        link = os.path.join(base, "link")
+        os.symlink(str(outside), link)
+        with pytest.raises(ValueError):
+            confine(base, os.path.join(link, "f"))
+
+    def test_backup_id_validation(self):
+        assert validate_backup_id("bk-1.x_2") == "bk-1.x_2"
+        for bad in ("", ".hidden", "a/b", "..", "a b", "/abs"):
+            with pytest.raises(ValueError):
+                validate_backup_id(bad)
+
+
+# ------------------------------------------------------ filesystem backend
+class TestFilesystemBackend:
+    def test_put_meta_atomic_replace(self, tmp_path):
+        be = FilesystemBackend(str(tmp_path))
+        be.put_meta("bk1", b"v1")
+        assert be.get_meta("bk1") == b"v1"
+        be.put_meta("bk1", b"v2-longer")
+        assert be.get_meta("bk1") == b"v2-longer"
+        # the tmp staging file never survives a completed put
+        leftovers = [f for f in os.listdir(tmp_path / "bk1")
+                     if f.endswith(".tmp")]
+        assert leftovers == []
+
+    def test_traversal_rel_path_refused(self, tmp_path):
+        be = FilesystemBackend(str(tmp_path))
+        src = tmp_path / "payload"
+        src.write_bytes(b"x")
+        with pytest.raises(ValueError):
+            be.put_file("bk1", os.path.join("..", "escape"), str(src))
+        with pytest.raises(ValueError):
+            be.get_file("bk1", os.path.join("..", "..", "etc"), str(src))
+
+    def test_meta_absent_is_none_and_exists_false(self, tmp_path):
+        be = FilesystemBackend(str(tmp_path))
+        assert be.get_meta("nope") is None
+        assert not be.exists("nope")
+
+    def test_list_files_excludes_meta(self, tmp_path):
+        be = FilesystemBackend(str(tmp_path))
+        src = tmp_path / "payload"
+        src.write_bytes(b"x")
+        be.put_file("bk1", os.path.join("Doc", "seg0"), str(src))
+        be.put_meta("bk1", b"{}")
+        assert be.list_files("bk1") == [os.path.join("Doc", "seg0")]
+
+
+# ----------------------------------------------------- object-store backend
+class _FakeClient:
+    """Minimal object-store client recording the exact keys used."""
+
+    def __init__(self):
+        self.blobs: dict[str, bytes] = {}
+
+    def put(self, key, data):
+        self.blobs[key] = data
+
+    def get(self, key):
+        return self.blobs.get(key)
+
+    def put_file(self, key, src):
+        with open(src, "rb") as f:
+            self.blobs[key] = f.read()
+
+    def get_to_file(self, key, dst):
+        if key not in self.blobs:
+            return False
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        with open(dst, "wb") as f:
+            f.write(self.blobs[key])
+        return True
+
+    def list(self, prefix):
+        return sorted(k for k in self.blobs if k.startswith(prefix))
+
+
+class TestObjectStoreBackend:
+    def test_key_layout_is_id_slash_rel(self, tmp_path):
+        c = _FakeClient()
+        be = ObjectStoreBackend("s3", c)
+        src = tmp_path / "seg"
+        src.write_bytes(b"data")
+        be.put_file("bk1", os.path.join("Doc", "shard0", "seg"), str(src))
+        be.put_meta("bk1", b"{}")
+        assert set(c.blobs) == {"bk1/Doc/shard0/seg", "bk1/backup.json"}
+
+    def test_traversal_and_absolute_rel_refused(self, tmp_path):
+        be = ObjectStoreBackend("s3", _FakeClient())
+        with pytest.raises(ValueError):
+            be._key("bk1", "../escape")
+        with pytest.raises(ValueError):
+            be._key("bk1", "/abs")
+        with pytest.raises(ValueError):
+            be._key("bad/id", "x")
+
+    def test_list_files_keeps_data_named_like_meta(self, tmp_path):
+        c = _FakeClient()
+        be = ObjectStoreBackend("s3", c)
+        src = tmp_path / "seg"
+        src.write_bytes(b"data")
+        be.put_file("bk1", os.path.join("Doc", "backup.json"), str(src))
+        be.put_meta("bk1", b"{}")
+        # only the EXACT meta key is filtered from the listing
+        assert be.list_files("bk1") == ["Doc/backup.json"]
+
+    def test_get_file_missing_raises(self, tmp_path):
+        be = ObjectStoreBackend("s3", _FakeClient())
+        with pytest.raises(FileNotFoundError):
+            be.get_file("bk1", "Doc/seg", str(tmp_path / "out"))
+
+
+# ----------------------------------------------------------- blob store
+class TestBlobStore:
+    def test_validate_key(self):
+        assert validate_key("a/b/c.bin") == "a/b/c.bin"
+        for bad in ("", "/abs", "a//b", "a/../b", "a/./b", "trail/"):
+            with pytest.raises(BlobStoreError):
+                validate_key(bad)
+
+    def test_localdir_roundtrip(self, tmp_path):
+        s = LocalDirBlobStore(str(tmp_path))
+        s.put("cold/Doc/t1/gen-00000001/seg", b"hello")
+        assert s.get("cold/Doc/t1/gen-00000001/seg") == b"hello"
+        assert s.list("cold/Doc/") == ["cold/Doc/t1/gen-00000001/seg"]
+        assert s.exists("cold/Doc/t1/gen-00000001/seg")
+        s.delete("cold/Doc/t1/gen-00000001/seg")
+        s.delete("cold/Doc/t1/gen-00000001/seg")  # idempotent
+        with pytest.raises(KeyError):
+            s.get("cold/Doc/t1/gen-00000001/seg")
+
+    def test_fault_injection_deterministic(self, tmp_path):
+        def run(seed):
+            s = FaultInjectingBlobStore(
+                LocalDirBlobStore(str(tmp_path / f"s{seed}")), seed=seed)
+            s.program("put", drop=0.5)
+            outcomes = []
+            for i in range(20):
+                try:
+                    s.put(f"k/{i}", b"x")
+                    outcomes.append("ok")
+                except BlobStoreError:
+                    outcomes.append("drop")
+            return outcomes
+
+        assert run(7) == run(7)  # same seed, same schedule
+        assert "drop" in run(7) and "ok" in run(7)
+
+    def test_torn_write_leaves_truncated_blob(self, tmp_path):
+        s = FaultInjectingBlobStore(LocalDirBlobStore(str(tmp_path)),
+                                    seed=1)
+        s.program("put", torn_write=1.0)
+        with pytest.raises(BlobStoreError):
+            s.put("k", b"0123456789")
+        # the blob EXISTS but is a truncated prefix — only a digest
+        # check can tell it from a good write
+        assert s.inner.get("k") == b"01234"
+        s.clear()
+        s.put("k", b"0123456789")
+        assert s.get("k") == b"0123456789"
+
+    def test_program_extends_per_op(self, tmp_path):
+        s = FaultInjectingBlobStore(LocalDirBlobStore(str(tmp_path)),
+                                    seed=2)
+        s.program("get", drop=1.0)
+        s.put("k", b"x")  # puts unaffected
+        with pytest.raises(BlobStoreError):
+            s.get("k")
+        with pytest.raises(ValueError):
+            s.program("rename", drop=1.0)

@@ -46,9 +46,11 @@ def test_backup_roundtrip(tmp_path):
     status = handler.create(backend, "bk1")
     assert status["status"] == "SUCCESS"
     assert handler.status(backend, "bk1")["status"] == "SUCCESS"
-    # duplicate id refused
-    with pytest.raises(BackupError):
-        handler.create(backend, "bk1")
+    # re-submit of the same backup_id is idempotent: it answers with the
+    # stored status instead of forking a second copy
+    again = handler.create(backend, "bk1")
+    assert again["status"] == "SUCCESS"
+    assert again["id"] == "bk1"
 
     # restore into a FRESH db dir (disaster recovery)
     db2 = DB(str(tmp_path / "db2"))

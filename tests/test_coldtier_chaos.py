@@ -1,0 +1,337 @@
+"""Chaos acceptance for the cold tier + snapshot-consistent cluster
+backup (ISSUE 16):
+
+* a node "killed" mid-offload (upload faults = the process never reached
+  the commit step) leaves the local copy intact and the abandoned
+  partial generation GC-able once superseded;
+* a coordinator SIGKILLed mid-backup (``CrashInjected`` at seeded crash
+  points, no cleanup runs) leaves a partial that can NEVER restore, is
+  visible in the raft backup ledger, is GC-able, and a same-coordinator
+  re-run completes the backup under the same id;
+* a 3-node backup restores into a 5-node cluster through the rebalance
+  planner with ZERO lost acked writes;
+* live writes continue during the backup (the fence rides the WAL
+  group-commit barrier, it does not stop the write path);
+* the backup retention sweep deletes only blobs no committed manifest
+  references.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.backup.blobstore import (
+    FaultInjectingBlobStore,
+    LocalDirBlobStore,
+)
+from weaviate_tpu.backup.cluster_backup import (
+    ClusterBackupCoordinator,
+    cluster_manifest_key,
+    read_cluster_manifest,
+    sweep_backups,
+)
+from weaviate_tpu.backup.handler import BackupError
+from weaviate_tpu.cluster import ClusterNode, InProcTransport
+from weaviate_tpu.cluster.rebalance import CrashInjected
+from weaviate_tpu.monitoring.metrics import RETENTION_DELETED
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    FlatIndexConfig,
+    Property,
+    ReplicationConfig,
+    ShardingConfig,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+
+def wait_for(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+def _leader(nodes):
+    for n in nodes:
+        if n.raft.is_leader():
+            return n
+    return None
+
+
+def _cfg(factor=1, shards=6, name="Doc"):
+    return CollectionConfig(
+        name=name,
+        properties=[Property(name="body")],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        sharding=ShardingConfig(desired_count=shards),
+        replication=ReplicationConfig(factor=factor),
+    )
+
+
+def _objs(n, dims=8, start=0, name="Doc"):
+    out = []
+    for i in range(start, start + n):
+        v = np.zeros(dims, np.float32)
+        v[i % dims] = 1.0
+        out.append(StorageObject(
+            uuid=f"00000000-0000-0000-0000-{i:012d}",
+            collection=name,
+            properties={"body": f"doc {i}"},
+            vector=v,
+        ))
+    return out
+
+
+def _make_cluster(tmp_path, ids, store):
+    registry = {}
+    nodes = []
+    for nid in ids:
+        t = InProcTransport(registry, nid)
+        n = ClusterNode(nid, ids, t, str(tmp_path / nid))
+        n.blobstore = store  # shared bucket, injected (no env)
+        nodes.append(n)
+    wait_for(lambda: any(n.raft.is_leader() for n in nodes),
+             msg="leader election")
+    return nodes, registry
+
+
+def _teardown(nodes):
+    for n in nodes:
+        n.quiesce()
+    for n in nodes:
+        n.close()
+
+
+def _seeded_cluster(tmp_path, store, n_objs=40):
+    ids = ["n0", "n1", "n2"]
+    nodes, registry = _make_cluster(tmp_path, ids, store)
+    leader = _leader(nodes)
+    leader.create_collection(_cfg(factor=1, shards=6))
+    wait_for(lambda: all(n.db.has_collection("Doc") for n in nodes),
+             msg="schema replication")
+    nodes[0].put_batch("Doc", _objs(n_objs), consistency="ONE")
+    return nodes, registry
+
+
+# ---------------------------------------------------------------------------
+# backup -> restore into a LARGER topology
+
+
+def test_backup_3_nodes_restore_into_5_zero_lost_writes(tmp_path):
+    store = LocalDirBlobStore(str(tmp_path / "bucket"))
+    nodes, _ = _seeded_cluster(tmp_path, store)
+    restored_nodes = []
+    try:
+        # live writes DURING the backup: the fence is a durability
+        # barrier, not write downtime
+        acked, stop = [], threading.Event()
+
+        def writer():
+            i = 1000
+            while not stop.is_set():
+                batch = _objs(1, start=i)
+                nodes[0].put_batch("Doc", batch, consistency="ONE")
+                acked.extend(o.uuid for o in batch)
+                i += 1
+                time.sleep(0.003)
+
+        th = threading.Thread(target=writer, daemon=True)
+        th.start()
+        time.sleep(0.05)
+        acked_before_fence = list(acked)
+
+        coord = ClusterBackupCoordinator(_leader(nodes), store)
+        out = coord.backup("bk1")
+        stop.set()
+        th.join(timeout=5)
+        assert out["status"] == "SUCCESS"
+        assert out["nodes"] == ["n0", "n1", "n2"]
+        wait_for(lambda: nodes[0].fsm.backup_ledger["bk1"]["state"]
+                 == "committed", msg="committed state replicated")
+        assert read_cluster_manifest(store, "bk1") is not None
+
+        # idempotent re-submit: answered from the ledger, not re-run
+        again = ClusterBackupCoordinator(nodes[1], store).backup("bk1")
+        assert again["status"] == "SUCCESS"
+        assert again.get("resubmitted") is True
+
+        # ---- restore into a DIFFERENT, LARGER topology -------------------
+        m_ids = ["m0", "m1", "m2", "m3", "m4"]
+        restored_nodes, _ = _make_cluster(tmp_path / "new", m_ids, store)
+        rcoord = ClusterBackupCoordinator(_leader(restored_nodes), store)
+        res = rcoord.restore("bk1")
+        assert res["status"] == "SUCCESS" and res["classes"] == ["Doc"]
+        wait_for(lambda: all(n.db.has_collection("Doc")
+                             for n in restored_nodes),
+                 msg="restored schema replication")
+
+        # placement overrides ride raft: wait for every node to agree
+        # before routing reads through them
+        def _placement(n):
+            st = n._state_for("Doc")
+            return [tuple(st.replicas(s)) for s in range(st.n_shards)]
+
+        wait_for(lambda: all(_placement(n) == _placement(restored_nodes[0])
+                             for n in restored_nodes),
+                 msg="placement convergence")
+
+        # zero lost acked writes: everything acked before the fence
+        # answers through the NEW cluster's routing
+        want = [o.uuid for o in _objs(40)] + acked_before_fence
+        for uid in want:
+            got = restored_nodes[1].get("Doc", uid, consistency="ONE")
+            assert got is not None, f"lost acked write {uid}"
+
+        # the planner actually spread the data: every shard routed, and
+        # holders go beyond the first three ring slots
+        st = restored_nodes[0]._state_for("Doc")
+        holders = {rep for s in range(st.n_shards)
+                   for rep in st.replicas(s)}
+        assert holders <= set(m_ids)
+        assert len(holders) >= 4, holders
+        q = np.zeros(8, np.float32)
+        q[2] = 1.0
+        hits = restored_nodes[2].vector_search("Doc", q, k=3)
+        assert len(hits) == 3
+    finally:
+        _teardown(nodes + restored_nodes)
+
+
+# ---------------------------------------------------------------------------
+# coordinator SIGKILLed mid-backup
+
+
+def test_coordinator_killed_mid_backup_partial_never_restores(tmp_path):
+    store = LocalDirBlobStore(str(tmp_path / "bucket"))
+    nodes, _ = _seeded_cluster(tmp_path, store)
+    try:
+        leader = _leader(nodes)
+        coord = ClusterBackupCoordinator(
+            leader, store, crash_points={"mid_upload"})
+        with pytest.raises(CrashInjected):
+            coord.backup("bk1")
+
+        # the partial is visible: ledger journaled non-terminal, blobs
+        # exist, but the terminal manifest does NOT
+        wait_for(lambda: nodes[0].fsm.backup_ledger["bk1"]["state"]
+                 == "uploading", msg="uploading state replicated")
+        assert store.list("backups/bk1/")
+        assert read_cluster_manifest(store, "bk1") is None
+
+        # a partial can NEVER restore
+        with pytest.raises(BackupError, match="refusing to restore"):
+            ClusterBackupCoordinator(nodes[1], store).restore("bk1")
+
+        # the retention sweep leaves an unnamed partial alone (it may be
+        # in flight) ...
+        assert sweep_backups(store) == 0
+        assert store.list("backups/bk1/")
+
+        # ... and a same-coordinator re-run under the same id resumes
+        # and completes (crash-resume via the ledger's coordinator stamp)
+        out = ClusterBackupCoordinator(leader, store).backup("bk1")
+        assert out["status"] == "SUCCESS"
+        wait_for(lambda: nodes[0].fsm.backup_ledger["bk1"]["state"]
+                 == "committed", msg="committed state replicated")
+        res = read_cluster_manifest(store, "bk1")
+        assert res is not None and set(res["nodes"]) == {"n0", "n1", "n2"}
+    finally:
+        _teardown(nodes)
+
+
+def test_dead_partial_gc_and_foreign_coordinator_fenced(tmp_path):
+    store = LocalDirBlobStore(str(tmp_path / "bucket"))
+    nodes, _ = _seeded_cluster(tmp_path, store, n_objs=10)
+    try:
+        leader = _leader(nodes)
+        with pytest.raises(CrashInjected):
+            ClusterBackupCoordinator(
+                leader, store,
+                crash_points={"after_fence"}).backup("bk-dead")
+        wait_for(lambda: nodes[0].fsm.backup_ledger["bk-dead"]["state"]
+                 == "uploading", msg="uploading state replicated")
+
+        # a DIFFERENT coordinator cannot hijack the live entry
+        other = next(n for n in nodes if n.id != leader.id)
+        with pytest.raises(BackupError, match="in progress"):
+            ClusterBackupCoordinator(other, store).backup("bk-dead")
+
+        # the operator declares it dead: named partials are collected,
+        # counted under partial_backup
+        p0 = RETENTION_DELETED.value(reason="partial_backup")
+        sweep_backups(store, delete_ids=("bk-dead",))
+        assert store.list("backups/bk-dead/") == []
+        assert RETENTION_DELETED.value(reason="partial_backup") >= p0
+
+        # a COMMITTED backup named in delete_ids is refused, and only
+        # unreferenced strays under it are collected
+        out = ClusterBackupCoordinator(leader, store).backup("bk-live")
+        assert out["status"] == "SUCCESS"
+        store.put("backups/bk-live/nodes/n0/stray.bin", b"leftover")
+        u0 = RETENTION_DELETED.value(reason="unreferenced")
+        sweep_backups(store, delete_ids=("bk-live",))
+        assert RETENTION_DELETED.value(reason="unreferenced") == u0 + 1
+        assert read_cluster_manifest(store, "bk-live") is not None
+        restored, _ = _make_cluster(tmp_path / "new", ["m0", "m1"], store)
+        try:
+            res = ClusterBackupCoordinator(
+                _leader(restored), store).restore("bk-live")
+            assert res["status"] == "SUCCESS"
+        finally:
+            _teardown(restored)
+    finally:
+        _teardown(nodes)
+
+
+# ---------------------------------------------------------------------------
+# upload faults: a failed backup is journaled FAILED and retryable
+
+
+def test_backup_with_bucket_down_fails_loudly_then_retries(tmp_path):
+    inner = LocalDirBlobStore(str(tmp_path / "bucket"))
+    store = FaultInjectingBlobStore(inner, seed=77)
+    nodes, _ = _seeded_cluster(tmp_path, store, n_objs=10)
+    try:
+        leader = _leader(nodes)
+        store.program("put", drop=1.0)
+        with pytest.raises(BackupError):
+            ClusterBackupCoordinator(leader, store).backup("bk1")
+        wait_for(lambda: nodes[0].fsm.backup_ledger["bk1"]["state"]
+                 == "failed", msg="failed state replicated")
+        assert read_cluster_manifest(store, "bk1") is None
+
+        # bucket heals -> the same id retries to completion
+        store.clear()
+        out = ClusterBackupCoordinator(leader, store).backup("bk1")
+        assert out["status"] == "SUCCESS"
+        assert store.exists(cluster_manifest_key("bk1"))
+    finally:
+        _teardown(nodes)
+
+
+# ---------------------------------------------------------------------------
+# torn node manifest: verification refuses commit AND restore
+
+
+def test_torn_upload_detected_before_commit(tmp_path):
+    inner = LocalDirBlobStore(str(tmp_path / "bucket"))
+    store = FaultInjectingBlobStore(inner, seed=5)
+    nodes, _ = _seeded_cluster(tmp_path, store, n_objs=10)
+    try:
+        leader = _leader(nodes)
+        # tear SOME uploads: blobs exist with truncated bytes. The
+        # upload RPC fails on the first torn put, so the backup fails
+        # before the terminal manifest — never a restorable half-backup.
+        store.program("put", torn_write=0.3)
+        with pytest.raises(BackupError):
+            ClusterBackupCoordinator(leader, store).backup("bk1")
+        assert read_cluster_manifest(store, "bk1") is None
+        with pytest.raises(BackupError):
+            ClusterBackupCoordinator(nodes[1], store).restore("bk1")
+    finally:
+        _teardown(nodes)

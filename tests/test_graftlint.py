@@ -1943,6 +1943,93 @@ class TestModuleHookHostSync:
 # unwarmed-jit-program
 
 
+class TestUnverifiedRemoteDelete:
+    BACKUP = "weaviate_tpu/backup/fake.py"
+    TIERING = "weaviate_tpu/tiering/fake.py"
+
+    def test_remote_delete_without_verify_flagged(self):
+        res = run("""
+            def sweep(store, keys):
+                for key in keys:
+                    store.delete(key)
+        """, rel=self.BACKUP)
+        vs = [v for v in res.violations
+              if v.rule == "unverified-remote-delete"]
+        assert len(vs) == 1
+        assert vs[0].severity == "error"
+        assert "remote blob" in vs[0].message
+
+    def test_local_rmtree_without_verify_flagged(self):
+        res = run("""
+            import shutil
+
+            def offload(src, client):
+                client.put(src)
+                shutil.rmtree(src)
+        """, rel=self.TIERING)
+        assert rule_ids(res).count("unverified-remote-delete") == 1
+
+    def test_verify_then_delete_passes(self):
+        res = run("""
+            import shutil
+
+            def offload(self, src, manifest):
+                self.verify_uploaded(manifest)
+                shutil.rmtree(src)
+                self.store.delete("stale-key")
+        """, rel=self.TIERING)
+        assert "unverified-remote-delete" not in rule_ids(res)
+
+    def test_digest_check_counts_as_verification(self):
+        res = run("""
+            import hashlib
+            import os
+
+            def install(store, ent, path):
+                data = store.get(ent["key"])
+                assert hashlib.sha256(data).hexdigest() == ent["sha256"]
+                os.remove(path)
+        """, rel=self.BACKUP)
+        assert "unverified-remote-delete" not in rule_ids(res)
+
+    def test_scratch_targets_exempt(self):
+        res = run("""
+            import os
+            import shutil
+
+            def cleanup(tmp_dir, staging):
+                shutil.rmtree(tmp_dir)
+                shutil.rmtree(staging)
+                os.remove(tmp_dir + "/x")
+        """, rel=self.BACKUP)
+        assert "unverified-remote-delete" not in rule_ids(res)
+
+    def test_deletion_primitive_exempt(self):
+        res = run("""
+            def delete_partial(store, keys):
+                for key in keys:
+                    store.delete(key)
+        """, rel=self.BACKUP)
+        assert "unverified-remote-delete" not in rule_ids(res)
+
+    def test_out_of_scope_dir_ignored(self):
+        res = run("""
+            def sweep(store, keys):
+                for key in keys:
+                    store.delete(key)
+        """, rel=COLD)
+        assert "unverified-remote-delete" not in rule_ids(res)
+
+    def test_suppressible_with_reason(self):
+        res = run("""
+            def sweep(store, keys):
+                for key in keys:
+                    # graftlint: allow[unverified-remote-delete] reason=caller verified
+                    store.delete(key)
+        """, rel=self.BACKUP)
+        assert "unverified-remote-delete" not in rule_ids(res)
+
+
 class TestUnwarmedJitProgram:
     @pytest.fixture(autouse=True)
     def _manifest(self):

@@ -1177,6 +1177,105 @@ class ModuleHookHostSync(Rule):
 # 7. suppression-missing-reason (meta-rule, emitted by the engine)
 
 
+class UnverifiedRemoteDelete(Rule):
+    id = "unverified-remote-delete"
+    description = (
+        "delete of a local segment set or remote blob in backup/ or "
+        "tiering/ with no manifest/digest verification earlier in the "
+        "same function"
+    )
+    rationale = (
+        "The cold tier and the backup store are the LAST copy of data "
+        "once the local files go: the offload contract is verify-then-"
+        "delete-local, and retention sweeps must re-verify a manifest "
+        "before garbage-collecting anything it might reference. A "
+        "delete (remote `.delete(...)` on a store/client handle, or a "
+        "local os.remove/os.unlink/shutil.rmtree) that no verification "
+        "call precedes is exactly the shape of a data-loss bug chaos "
+        "testing keeps finding. Call something whose name carries "
+        "verify/digest/sha256/checksum first (verify_uploaded, "
+        "verify_backup, hexdigest, ...), or route the deletion through "
+        "a dedicated ``*delete*`` helper that owns its safety contract. "
+        "Scratch targets (tmp/staging/partial/orphan names) are exempt."
+    )
+
+    _DIRS = ("weaviate_tpu/backup/", "weaviate_tpu/tiering/")
+    # receiver tails that look like a blob-store / object-store handle
+    _REMOTE_RECV = ("client", "store", "blob", "backend", "bucket", "s3",
+                    "inner")
+    _LOCAL_FNS = frozenset({"os.remove", "os.unlink", "shutil.rmtree",
+                            "_os.remove", "_os.unlink", "_shutil.rmtree"})
+    _VERIFY_MARKS = ("verify", "digest", "sha256", "checksum")
+    _SCRATCH_MARKS = ("tmp", "temp", "stag", "partial", "orphan")
+
+    def _is_remote_delete(self, call: ast.Call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "delete"):
+            return False
+        recv = dotted_name(f.value) or ""
+        tail = recv.rsplit(".", 1)[-1].lower()
+        return any(m in tail for m in self._REMOTE_RECV)
+
+    def _is_local_delete(self, call: ast.Call) -> bool:
+        return dotted_name(call.func) in self._LOCAL_FNS
+
+    def _is_scratch(self, call: ast.Call) -> bool:
+        """Deleting a tmp/staging/partial/orphan target is cleanup, not
+        data destruction — judged by the names in the argument subtree."""
+        words = []
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            for n in ast.walk(a):
+                if isinstance(n, ast.Name):
+                    words.append(n.id.lower())
+                elif isinstance(n, ast.Attribute):
+                    words.append(n.attr.lower())
+                elif isinstance(n, ast.Constant) and isinstance(
+                        n.value, str):
+                    words.append(n.value.lower())
+        return any(m in w for w in words for m in self._SCRATCH_MARKS)
+
+    def _has_verify_mark(self, node: ast.AST) -> bool:
+        name = None
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else dotted_name(f) or "")
+        return bool(name) and any(m in name.lower()
+                                  for m in self._VERIFY_MARKS)
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, self._DIRS):
+            return
+        for call in ctx.walk(ast.Call):
+            remote = self._is_remote_delete(call)
+            if not remote and not self._is_local_delete(call):
+                continue
+            fns = ctx.enclosing_functions(call)
+            if not fns:
+                continue  # module level: import-time deletes don't occur
+            fn = fns[0]
+            # a function that IS the deletion primitive (``delete``,
+            # ``delete_partial_backup``…) owns its own contract; the rule
+            # polices call sites
+            if "delete" in fn.name.lower():
+                continue
+            if not remote and self._is_scratch(call):
+                continue
+            verified = any(
+                self._has_verify_mark(n) and n is not call
+                and getattr(n, "lineno", 1 << 30) <= call.lineno
+                for n in ast.walk(fn))
+            if verified:
+                continue
+            kind = "remote blob" if remote else "local segment"
+            yield self.violation(
+                ctx, call,
+                f"{kind} delete in {fn.name}() with no preceding "
+                "manifest/digest verification — verify-then-delete, or "
+                "move it into a dedicated *delete* helper",
+                severity=SEV_ERROR)
+
+
 class SuppressionMissingReason(Rule):
     id = "suppression-missing-reason"
     description = (
@@ -1399,6 +1498,7 @@ ALL_RULES: tuple = (
     BlockingUnderLock(),
     UnlockedCollectiveDispatch(),
     UnwarmedJitProgram(),
+    UnverifiedRemoteDelete(),
     SuppressionMissingReason(),
 )
 

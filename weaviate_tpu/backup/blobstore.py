@@ -1,0 +1,322 @@
+"""S3-shaped blob tier: the durability layer below the disk tier.
+
+Reference: the ``modules/offload-s3`` bucket the reference parks FROZEN
+tenants in, generalized into the flat put/get/list/delete surface every
+cold-tier consumer here shares (``tiering/coldstore.py`` wholesale tenant
+offload, ``backup/cluster_backup.py`` snapshot backups, the retention
+sweep). Two implementations ship: a local-directory fake that is fully
+functional (and what the zero-egress test image runs), and an adapter
+over ``backup/object_store.py``'s real S3/GCS/Azure clients.
+
+:class:`FaultInjectingBlobStore` wraps any store with seeded,
+programmable per-op faults — drop, latency, torn writes — in the style
+of ``cluster/chaos.py:ChaosTransport``. The chaos suites drive offload
+and backup through it to prove the manifest-first / verify-then-delete
+protocols hold when the bucket misbehaves.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Optional
+
+from weaviate_tpu.monitoring.metrics import CHAOS_FAULTS
+
+
+class BlobStoreError(RuntimeError):
+    """A blob operation failed (injected fault, backend error, torn
+    write). Retryable at the caller's discretion — the offload/backup
+    protocols wrap ops in ``cluster/resilience.retrying_call``."""
+
+
+def validate_key(key: str) -> str:
+    """Blob keys are ``/``-joined posix-ish components: no traversal, no
+    absolute paths, no empty segments. Keys cross trust boundaries (a
+    restore reads them out of a manifest an attacker may have written),
+    so every store validates on BOTH read and write."""
+    if not key or key.startswith("/") or key.endswith("/"):
+        raise BlobStoreError(f"invalid blob key {key!r}")
+    parts = key.split("/")
+    if any(p in ("", ".", "..") for p in parts):
+        raise BlobStoreError(f"invalid blob key {key!r}")
+    return key
+
+
+class BlobStore:
+    """SPI: a flat keyspace of immutable-ish blobs."""
+
+    name = "blob"
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        """Return the blob or raise :class:`KeyError` when absent."""
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> list[str]:
+        """All keys under ``prefix``, sorted."""
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        """Idempotent: deleting a missing key is a no-op."""
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        try:
+            self.get(key)
+            return True
+        except KeyError:
+            return False
+
+    # -- file-shaped convenience (segments are files on both ends) -------
+    def put_file(self, key: str, src_path: str) -> None:
+        with open(src_path, "rb") as f:
+            self.put(key, f.read())
+
+    def get_to_file(self, key: str, dst_path: str) -> None:
+        data = self.get(key)
+        os.makedirs(os.path.dirname(dst_path) or ".", exist_ok=True)
+        tmp = dst_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, dst_path)
+
+
+class LocalDirBlobStore(BlobStore):
+    """The local-dir fake: one file per key under ``root``. Writes are
+    atomic (tmp + ``os.replace``) so a crashed writer never leaves a
+    half-blob a reader could mistake for the real thing — torn blobs
+    exist in this tree only when the fault injector tears them on
+    purpose."""
+
+    name = "localdir"
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, *validate_key(key).split("/"))
+
+    def put(self, key: str, data: bytes) -> None:
+        p = self._path(key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = f"{p}.tmp.{threading.get_ident()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, p)
+        except OSError as e:
+            raise BlobStoreError(f"put {key!r}: {e}") from e
+
+    def get(self, key: str) -> bytes:
+        p = self._path(key)
+        try:
+            with open(p, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise KeyError(key) from None
+        except OSError as e:
+            raise BlobStoreError(f"get {key!r}: {e}") from e
+
+    def list(self, prefix: str = "") -> list[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                full = os.path.join(dirpath, fn)
+                key = os.path.relpath(full, self.root).replace(os.sep, "/")
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            raise BlobStoreError(f"delete {key!r}: {e}") from e
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+
+class ObjectStoreBlobStore(BlobStore):
+    """Adapter over ``backup/object_store.py`` clients (S3 SigV4 / GCS /
+    Azure): the same wire clients the backup backends use, re-shaped to
+    the flat BlobStore SPI. Client errors surface as
+    :class:`BlobStoreError` so callers retry uniformly."""
+
+    name = "objectstore"
+
+    def __init__(self, client):
+        self.client = client
+
+    def put(self, key: str, data: bytes) -> None:
+        from weaviate_tpu.backup.object_store import ObjectStoreError
+
+        try:
+            self.client.put(validate_key(key), data)
+        except ObjectStoreError as e:
+            raise BlobStoreError(str(e)) from e
+
+    def get(self, key: str) -> bytes:
+        from weaviate_tpu.backup.object_store import ObjectStoreError
+
+        try:
+            data = self.client.get(validate_key(key))
+        except ObjectStoreError as e:
+            raise BlobStoreError(str(e)) from e
+        if data is None:
+            raise KeyError(key)
+        return data
+
+    def list(self, prefix: str = "") -> list[str]:
+        from weaviate_tpu.backup.object_store import ObjectStoreError
+
+        try:
+            return sorted(self.client.list(prefix))
+        except ObjectStoreError as e:
+            raise BlobStoreError(str(e)) from e
+
+    def delete(self, key: str) -> None:
+        from weaviate_tpu.backup.object_store import ObjectStoreError
+
+        try:
+            self.client.delete(validate_key(key))
+        except ObjectStoreError as e:
+            raise BlobStoreError(str(e)) from e
+
+
+@dataclass
+class BlobFaults:
+    """One op-class's fault program (``ChaosTransport.LinkFaults`` for
+    the bucket): probabilities are per OPERATION, decided by one rng draw
+    each under the lock so a seeded schedule is deterministic."""
+
+    drop: float = 0.0        # raise BlobStoreError, op not performed
+    torn_write: float = 0.0  # put writes a truncated prefix, then raises
+    latency: float = 0.0     # fixed pre-op delay (seconds)
+    jitter: float = 0.0      # + uniform(0, jitter)
+
+
+class FaultInjectingBlobStore(BlobStore):
+    """Seeded fault wrapper for any :class:`BlobStore`.
+
+    ``program(op, **faults)`` installs a fault program for one op
+    (``put``/``get``/``list``/``delete``) or, with ``op=None``, for all
+    of them; ``clear()`` resets. A torn write is the nasty case: the
+    inner store receives a truncated prefix of the data and the caller
+    sees a failure — the blob EXISTS but is corrupt, which is exactly
+    what digest verification (and nothing else) catches.
+    """
+
+    name = "chaosblob"
+
+    _OPS = ("put", "get", "list", "delete")
+
+    def __init__(self, inner: BlobStore, seed: int = 0):
+        self.inner = inner
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._programs: dict[str, BlobFaults] = {}
+        self.faults_fired = 0
+
+    def program(self, op: Optional[str] = None, **kw) -> None:
+        """Install/extend the fault program for ``op`` (None = all)."""
+        ops = self._OPS if op is None else (op,)
+        with self._lock:
+            for o in ops:
+                if o not in self._OPS:
+                    raise ValueError(f"unknown blob op {o!r}")
+                cur = self._programs.get(o, BlobFaults())
+                self._programs[o] = replace(cur, **kw)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._programs.clear()
+
+    def _decide(self, op: str, key: str) -> tuple[bool, bool, float]:
+        """(drop?, torn?, delay) — one rng draw per probability, under
+        the lock, so concurrent ops cannot reorder a seeded schedule."""
+        with self._lock:
+            f = self._programs.get(op)
+            if f is None:
+                return False, False, 0.0
+            drop = f.drop > 0 and self._rng.random() < f.drop
+            torn = (op == "put" and not drop and f.torn_write > 0
+                    and self._rng.random() < f.torn_write)
+            delay = f.latency + (
+                self._rng.random() * f.jitter if f.jitter > 0 else 0.0)
+        if drop:
+            self.faults_fired += 1
+            CHAOS_FAULTS.inc(kind="blob_drop", link=f"{op}:{key}")
+        if torn:
+            self.faults_fired += 1
+            CHAOS_FAULTS.inc(kind="blob_torn_write", link=f"{op}:{key}")
+        return drop, torn, delay
+
+    def put(self, key: str, data: bytes) -> None:
+        drop, torn, delay = self._decide("put", key)
+        if delay > 0:
+            time.sleep(delay)
+        if drop:
+            raise BlobStoreError(f"injected drop: put {key!r}")
+        if torn:
+            # the inner store sees a PREFIX commit: the key exists with
+            # truncated bytes, the caller sees a failure — only a digest
+            # check can tell this apart from a good blob
+            self.inner.put(key, data[: max(0, len(data) // 2)])
+            raise BlobStoreError(f"injected torn write: put {key!r}")
+        self.inner.put(key, data)
+
+    def get(self, key: str) -> bytes:
+        drop, _torn, delay = self._decide("get", key)
+        if delay > 0:
+            time.sleep(delay)
+        if drop:
+            raise BlobStoreError(f"injected drop: get {key!r}")
+        return self.inner.get(key)
+
+    def list(self, prefix: str = "") -> list[str]:
+        drop, _torn, delay = self._decide("list", prefix)
+        if delay > 0:
+            time.sleep(delay)
+        if drop:
+            raise BlobStoreError(f"injected drop: list {prefix!r}")
+        return self.inner.list(prefix)
+
+    def delete(self, key: str) -> None:
+        drop, _torn, delay = self._decide("delete", key)
+        if delay > 0:
+            time.sleep(delay)
+        if drop:
+            raise BlobStoreError(f"injected drop: delete {key!r}")
+        self.inner.delete(key)
+
+    def exists(self, key: str) -> bool:
+        return self.inner.exists(key)
+
+
+def make_blobstore() -> Optional[BlobStore]:
+    """Environment-gated factory for the cold/backup blob tier.
+
+    ``COLD_TIER_BLOB_PATH`` selects the local-dir store (tests, single
+    boxes, NFS); ``COLD_TIER_S3_BUCKET`` the S3 client (same env surface
+    as ``backup/object_store.py``). Absent both, there is no blob tier
+    and offload/cluster-backup features stay dormant.
+    """
+    path = os.environ.get("COLD_TIER_BLOB_PATH")
+    if path:
+        return LocalDirBlobStore(path)
+    if os.environ.get("COLD_TIER_S3_BUCKET"):
+        from weaviate_tpu.backup.object_store import S3Client
+
+        return ObjectStoreBlobStore(
+            S3Client(bucket=os.environ["COLD_TIER_S3_BUCKET"]))
+    return None

@@ -1,0 +1,450 @@
+"""Snapshot-consistent cluster backup + point-in-time restore.
+
+Reference: ``usecases/backup/coordinator.go`` — the coordinator drives
+every participating node through a phase machine and only a terminal
+global manifest makes the backup real. Mapped here onto the repo's own
+primitives:
+
+* the **fence** rides the WAL group-commit barrier
+  (``storage/wal.py:sync_window``) and the shard checkpoint: a
+  ``backup_fence`` RPC makes every write acked before the fence
+  fsync-durable and checkpointed on every shard/replica;
+* each node then uploads its fenced segment set + a per-node manifest
+  (``backups/<id>/nodes/<node>/...``) to the shared blob store
+  (``backup/blobstore.py``);
+* the coordinator digest-verifies the uploads and writes the terminal
+  cluster manifest ``backups/<id>/MANIFEST.json`` — the ATOMICITY
+  point. A crash anywhere before it leaves a partial that can never
+  restore (restore refuses without the terminal manifest) and that the
+  retention sweep can GC; a crash after it leaves a complete backup.
+* progress is journaled in the raft-replicated backup ledger
+  (``cluster/fsm.py``), so a dead coordinator's partial is visible to
+  every surviving node.
+
+Restore replays the manifest into a DIFFERENT topology: collections are
+re-created through raft, placement is computed by the rebalancer's pure
+planner (``cluster/rebalance.py:plan_moves``) over the NEW cluster's
+membership with per-shard byte weights from the manifest, and each
+target node downloads, digest-verifies, and atomically installs its
+assigned shards.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from typing import Optional
+
+from weaviate_tpu.backup.blobstore import BlobStore, BlobStoreError
+from weaviate_tpu.backup.handler import BackupError
+from weaviate_tpu.cluster.rebalance import CrashInjected, plan_moves
+from weaviate_tpu.cluster.resilience import Deadline
+from weaviate_tpu.monitoring.metrics import (
+    BACKUP_BYTES,
+    BACKUP_RUNS,
+    RESTORE_RUNS,
+    RETENTION_DELETED,
+)
+
+logger = logging.getLogger("weaviate_tpu.backup.cluster")
+
+BACKUP_PREFIX = "backups"
+CLUSTER_MANIFEST = "MANIFEST.json"
+NODE_MANIFEST = "manifest.json"
+
+
+def cluster_manifest_key(backup_id: str) -> str:
+    return f"{BACKUP_PREFIX}/{backup_id}/{CLUSTER_MANIFEST}"
+
+
+def node_manifest_key(backup_id: str, node_id: str) -> str:
+    return f"{BACKUP_PREFIX}/{backup_id}/nodes/{node_id}/{NODE_MANIFEST}"
+
+
+def read_cluster_manifest(store: BlobStore, backup_id: str
+                          ) -> Optional[dict]:
+    """The terminal manifest, or None when the backup never committed
+    (unknown id or a crashed coordinator's partial)."""
+    try:
+        return json.loads(store.get(cluster_manifest_key(backup_id)))
+    except KeyError:
+        return None
+    except ValueError as e:
+        raise BackupError(
+            f"cluster manifest for {backup_id!r} is torn: {e}") from e
+
+
+def verify_backup(store: BlobStore, manifest: dict) -> dict:
+    """Digest-verify every blob the cluster manifest references, via the
+    per-node manifests. Returns {node: parsed node manifest}. Raises
+    :class:`BackupError` on any missing or corrupt blob — the gate both
+    restore and the retention sweep run before acting."""
+    nodes = {}
+    for nid, info in manifest.get("nodes", {}).items():
+        try:
+            nm = json.loads(store.get(info["manifest_key"]))
+        except KeyError:
+            raise BackupError(
+                f"backup {manifest['id']!r}: node manifest missing for "
+                f"{nid}") from None
+        except ValueError as e:
+            raise BackupError(
+                f"backup {manifest['id']!r}: node manifest for {nid} "
+                f"is torn: {e}") from e
+        for ent in nm.get("files", ()):
+            try:
+                data = store.get(ent["key"])
+            except KeyError:
+                raise BackupError(
+                    f"backup {manifest['id']!r}: blob missing: "
+                    f"{ent['key']}") from None
+            if hashlib.sha256(data).hexdigest() != ent["sha256"]:
+                raise BackupError(
+                    f"backup {manifest['id']!r}: blob digest mismatch: "
+                    f"{ent['key']}")
+        nodes[nid] = nm
+    return nodes
+
+
+class ClusterBackupCoordinator:
+    """Drives one cluster backup or restore from any node (the RPCs and
+    ledger writes forward through raft/transport as usual).
+
+    ``crash_points`` mirrors ``Rebalancer.crash_points``: the chaos
+    suite plants a point name and the coordinator dies there with
+    :class:`CrashInjected` — no cleanup, exactly a SIGKILL."""
+
+    def __init__(self, node, store: BlobStore, *,
+                 op_budget_s: float = 30.0,
+                 crash_points: Optional[set] = None):
+        self.node = node
+        self.store = store
+        self.op_budget_s = float(op_budget_s)
+        self.crash_points = crash_points if crash_points is not None \
+            else set()
+
+    def _crash(self, point: str) -> None:
+        if point in self.crash_points:
+            raise CrashInjected(f"backup coordinator crash at {point!r}")
+
+    def _advance(self, backup_id: str, state: str, **extra) -> None:
+        res = self.node.apply({"op": "backup_advance", "id": backup_id,
+                               "state": state, "ts": time.time(), **extra})
+        if not res.get("ok"):
+            raise BackupError(
+                f"backup ledger advance to {state!r} failed: "
+                f"{res.get('error')}")
+
+    # -- backup ------------------------------------------------------------
+    def backup(self, backup_id: str,
+               include: Optional[list[str]] = None) -> dict:
+        from weaviate_tpu.backup.backends import validate_backup_id
+
+        try:
+            validate_backup_id(backup_id)
+        except ValueError as e:
+            raise BackupError(str(e)) from e
+        node = self.node
+        classes = include or node.db.collections()
+        for c in classes:
+            if not node.db.has_collection(c):
+                raise BackupError(f"class {c!r} not found")
+        res = node.apply({"op": "backup_begin", "entry": {
+            "id": backup_id, "classes": list(classes),
+            "coordinator": node.id, "created_ts": time.time(),
+        }})
+        if not res.get("ok"):
+            raise BackupError(res.get("error", "backup refused"))
+        if "existing" in res:
+            # idempotent re-submit of a committed backup
+            return {"id": backup_id, "status": "SUCCESS",
+                    "classes": res["existing"].get("classes", []),
+                    "resubmitted": True}
+        members = list(node.all_nodes)
+        try:
+            # phase 1 — the cluster-wide checkpoint fence: after this
+            # fan-out, every write acked before backup() was called is
+            # fsync-durable (WAL group-commit barrier) and checkpointed
+            # on EVERY replica
+            for peer in members:
+                reply = node._call(peer, {
+                    "type": "backup_fence", "backup_id": backup_id,
+                    "classes": list(classes),
+                }, deadline=Deadline(self.op_budget_s, op="backup_fence"),
+                    timeout=self.op_budget_s)
+                if not reply.get("ok"):
+                    raise BackupError(
+                        f"fence failed on {peer}: {reply.get('error')}")
+            self._advance(backup_id, "uploading")
+            self._crash("after_fence")
+            # phase 2 — every node uploads its fenced segment set + a
+            # per-node manifest
+            total_bytes = 0
+            node_infos = {}
+            for i, peer in enumerate(members):
+                reply = node._call(peer, {
+                    "type": "backup_upload", "backup_id": backup_id,
+                    "classes": list(classes),
+                }, deadline=Deadline(self.op_budget_s * 4,
+                                     op="backup_upload"),
+                    timeout=self.op_budget_s * 4)
+                if not reply.get("ok"):
+                    raise BackupError(
+                        f"upload failed on {peer}: {reply.get('error')}")
+                info = {"manifest_key": reply["manifest_key"],
+                        "files": reply["files"], "bytes": reply["bytes"]}
+                node_infos[peer] = info
+                total_bytes += reply["bytes"]
+                self._advance(backup_id, "uploading", node=peer,
+                              node_info=info)
+                if i == 0:
+                    self._crash("mid_upload")
+            self._crash("before_commit")
+            # the uploads are only trusted once every byte re-reads
+            # correctly against its manifest digest
+            manifest = {
+                "id": backup_id, "version": 1,
+                "created_at": time.time(),
+                "coordinator": node.id,
+                "members": members,
+                "classes": {
+                    cls: {
+                        "config":
+                            node.db.get_collection(cls).config.to_dict(),
+                        "tenants":
+                            node.db.get_collection(cls).tenants()
+                            if node.db.get_collection(cls)
+                            .config.multi_tenancy.enabled else {},
+                    } for cls in classes
+                },
+                "nodes": node_infos,
+            }
+            verify_backup(self.store, manifest)
+            # phase 3 — the terminal manifest IS the commit: atomic on
+            # the blob store's single-key put
+            self.store.put(cluster_manifest_key(backup_id),
+                           json.dumps(manifest, sort_keys=True).encode())
+            self._advance(backup_id, "committed",
+                          manifest_key=cluster_manifest_key(backup_id))
+        except CrashInjected:
+            # a SIGKILLed coordinator runs NO cleanup: the ledger keeps
+            # the non-terminal entry, the store keeps the partial
+            raise
+        except (BackupError, BlobStoreError, TimeoutError) as e:
+            BACKUP_RUNS.inc(status="failed")
+            try:
+                self._advance(backup_id, "failed", error=str(e))
+            except BackupError:
+                logger.warning("backup %s: failed-state ledger advance "
+                               "also failed", backup_id)
+            raise BackupError(f"cluster backup {backup_id!r} failed: {e}") \
+                from e
+        BACKUP_RUNS.inc(status="success")
+        BACKUP_BYTES.inc(total_bytes)
+        logger.info("cluster backup %s committed (%d nodes, %d bytes)",
+                    backup_id, len(members), total_bytes)
+        return {"id": backup_id, "status": "SUCCESS",
+                "classes": list(classes), "bytes": total_bytes,
+                "nodes": sorted(node_infos)}
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, backup_id: str,
+                include: Optional[list[str]] = None) -> dict:
+        node = self.node
+        manifest = read_cluster_manifest(self.store, backup_id)
+        if manifest is None:
+            raise BackupError(
+                f"backup {backup_id!r} has no committed cluster manifest "
+                "(unknown id or a crashed coordinator's partial) — "
+                "refusing to restore")
+        node_manifests = verify_backup(self.store, manifest)
+        classes = include or list(manifest["classes"].keys())
+        from weaviate_tpu.backup.backends import validate_backup_id
+        from weaviate_tpu.schema.config import CollectionConfig
+
+        for cls in classes:
+            try:
+                validate_backup_id(cls)
+            except ValueError:
+                raise BackupError(
+                    f"invalid class name in manifest: {cls!r}") from None
+            if cls not in manifest["classes"]:
+                raise BackupError(f"class {cls!r} not in backup")
+            if node.db.has_collection(cls):
+                raise BackupError(
+                    f"class {cls!r} already exists; delete it before "
+                    "restore")
+        try:
+            restored = []
+            for cls in classes:
+                entry = manifest["classes"][cls]
+                cfg = CollectionConfig.from_dict(entry["config"])
+                node.create_collection(cfg)
+                # raft-submitted; a forwarding follower's local apply may
+                # lag the leader's commit — bounded wait before placement
+                wait_until = time.monotonic() + 10.0
+                while not node.db.has_collection(cls) \
+                        and time.monotonic() < wait_until:
+                    time.sleep(0.02)
+                placement = self._place(cls, cfg, node_manifests)
+                for shard, (replicas, files) in placement.items():
+                    for dst in replicas:
+                        reply = node._call(dst, {
+                            "type": "backup_install_shard",
+                            "backup_id": backup_id, "class": cls,
+                            "shard": shard, "files": files,
+                        }, deadline=Deadline(self.op_budget_s * 4,
+                                             op="backup_install"),
+                            timeout=self.op_budget_s * 4)
+                        if not reply.get("ok"):
+                            raise BackupError(
+                                f"install shard {cls}/{shard} on {dst} "
+                                f"failed: {reply.get('error')}")
+                if entry.get("tenants"):
+                    node.add_tenants(cls, [
+                        {"name": t, "status": s}
+                        for t, s in entry["tenants"].items()])
+                restored.append(cls)
+        except (BackupError, BlobStoreError, TimeoutError) as e:
+            RESTORE_RUNS.inc(status="failed")
+            raise BackupError(
+                f"cluster restore {backup_id!r} failed: {e}") from e
+        RESTORE_RUNS.inc(status="success")
+        logger.info("cluster restore %s complete (%s) into %d nodes",
+                    backup_id, ",".join(restored), len(node.all_nodes))
+        return {"id": backup_id, "status": "SUCCESS",
+                "classes": restored}
+
+    def _place(self, cls: str, cfg, node_manifests: dict
+               ) -> dict[int, tuple[list[str], list[dict]]]:
+        """shard -> (replica set on the NEW topology, source file list).
+
+        Base placement comes from the new cluster's own sharding state;
+        the rebalancer's pure planner then balances it with per-shard
+        byte weights from the manifest (a 3-node backup restored into 5
+        nodes spreads instead of landing on the first 3 ring slots).
+        Planner moves are committed as raft routing overrides BEFORE any
+        file lands, so routing and data always agree."""
+        node = self.node
+        state = node._state_for(cls)
+        # per-shard source files: the node manifest with the most bytes
+        # for a shard wins (the most complete fenced replica)
+        sources: dict[int, tuple[int, list[dict]]] = {}
+        for _nid, nm in sorted(node_manifests.items()):
+            per_shard: dict[int, list[dict]] = {}
+            for ent in nm.get("files", ()):
+                if ent.get("class") != cls:
+                    continue
+                per_shard.setdefault(int(ent.get("shard", 0)),
+                                     []).append(ent)
+            for shard, files in per_shard.items():
+                size = sum(int(f.get("size", 0)) for f in files)
+                if shard not in sources or size > sources[shard][0]:
+                    sources[shard] = (size, files)
+        placement = {s: state.replicas(s) for s in sources}
+        snapshot = {
+            "nodes": list(node.all_nodes),
+            "draining": list(node.fsm.draining_nodes),
+            "meta": {},
+            "shards": [
+                {"class": cls, "shard": s, "replicas": placement[s],
+                 "weight": max(1.0, float(sources[s][0]))}
+                for s in sorted(sources)
+            ],
+        }
+        for mv in plan_moves(snapshot, max_moves=4 * len(sources)):
+            reps = [mv.dst if r == mv.src else r
+                    for r in placement[mv.shard]]
+            res = node.apply({"op": "set_shard_replicas", "class": cls,
+                              "shard": mv.shard, "nodes": reps})
+            if not res.get("ok"):
+                raise BackupError(
+                    f"routing override for {cls}/{mv.shard} failed: "
+                    f"{res.get('error')}")
+            placement[mv.shard] = reps
+        return {s: (placement[s], sources[s][1]) for s in sources}
+
+
+# -- retention / orphan sweep ----------------------------------------------
+def referenced_backup_keys(store: BlobStore) -> set:
+    """Every key a COMMITTED cluster manifest still references (manifests
+    included): the never-delete allow-list."""
+    out: set = set()
+    for key in store.list(f"{BACKUP_PREFIX}/"):
+        parts = key.split("/")
+        if len(parts) != 3 or parts[2] != CLUSTER_MANIFEST:
+            continue
+        try:
+            man = json.loads(store.get(key))
+        except (KeyError, ValueError, BlobStoreError):
+            continue
+        out.add(key)
+        for info in man.get("nodes", {}).values():
+            mkey = info.get("manifest_key", "")
+            out.add(mkey)
+            try:
+                nm = json.loads(store.get(mkey))
+            except (KeyError, ValueError, BlobStoreError):
+                continue
+            for ent in nm.get("files", ()):
+                out.add(ent.get("key"))
+    return out
+
+
+def _delete_partial_backup(store: BlobStore, keys: list) -> int:
+    """Deletion primitive for a crashed coordinator's partial: there is
+    no manifest to verify by construction (the terminal manifest's
+    absence is WHY it may die), and the caller only reaches here for ids
+    the operator/ledger explicitly named dead."""
+    n = 0
+    for key in keys:
+        store.delete(key)
+        RETENTION_DELETED.inc(reason="partial_backup")
+        n += 1
+    return n
+
+
+def sweep_backups(store: BlobStore, delete_ids: tuple = ()) -> int:
+    """GC the backup prefix. Two classes of garbage:
+
+    * keys under a COMMITTED backup that its manifests do not reference
+      (leftovers of retried uploads) — deleted only after the backup
+      re-verifies intact;
+    * entire partials named in ``delete_ids`` (a crashed coordinator's
+      backup the operator or ledger declared dead) — refused if the id
+      actually committed.
+
+    Keys a committed manifest references are NEVER deleted."""
+    deleted = 0
+    referenced = referenced_backup_keys(store)
+    by_id: dict[str, list[str]] = {}
+    for key in store.list(f"{BACKUP_PREFIX}/"):
+        parts = key.split("/")
+        if len(parts) >= 3:
+            by_id.setdefault(parts[1], []).append(key)
+    for bid, keys in sorted(by_id.items()):
+        manifest = read_cluster_manifest(store, bid)
+        if manifest is None:
+            if bid not in delete_ids:
+                continue  # possibly in flight: only named partials die
+            deleted += _delete_partial_backup(store, keys)
+            continue
+        if bid in delete_ids:
+            logger.warning("sweep: refusing to delete committed backup "
+                           "%s", bid)
+        # committed: verify FIRST, then drop only unreferenced strays
+        try:
+            verify_backup(store, manifest)
+        except BackupError as e:
+            logger.warning("sweep: backup %s fails verification (%s); "
+                           "leaving its keys untouched", bid, e)
+            continue
+        for key in keys:
+            if key in referenced:
+                continue
+            store.delete(key)
+            RETENTION_DELETED.inc(reason="unreferenced")
+            deleted += 1
+    return deleted

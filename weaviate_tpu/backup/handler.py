@@ -51,17 +51,25 @@ class BackupHandler:
             "id": backup_id, "backend": backend.name,
             "status": STATUS_STARTED, "classes": classes,
             "version": __version__, "started_at": time.time(),
-            "error": None,
+            "error": None, "class_errors": {},
         }
+        # idempotent re-submit (reference: repeated POST of the same
+        # backup id must not fork a second copy): an in-flight or
+        # already-stored backup answers with ITS status instead of
+        # starting over. The backend probe is blocking I/O, so it runs
+        # BEFORE the lock; the _active check under the lock stays the
+        # same-process arbiter (a FAILED entry may be retried).
+        prior: Optional[dict] = None
+        if backend.exists(backup_id):
+            meta = backend.get_meta(backup_id)
+            prior = (json.loads(meta).get("status") if meta else None) \
+                or {"id": backup_id, "status": STATUS_SUCCESS}
         with self._lock:
-            # duplicate check under the lock covers both finished backups
-            # (backend meta) and in-flight ones (_active)
-            if backup_id in self._active and \
-                    self._active[backup_id]["status"] in (
-                        STATUS_STARTED, STATUS_TRANSFERRING):
-                raise BackupError(f"backup {backup_id!r} is in progress")
-            if backend.exists(backup_id):
-                raise BackupError(f"backup {backup_id!r} already exists")
+            live = self._active.get(backup_id)
+            if live is not None and live["status"] != STATUS_FAILED:
+                return dict(live)
+            if prior is not None:
+                return dict(prior)
             self._active[backup_id] = status
 
         def run():
@@ -69,58 +77,18 @@ class BackupHandler:
                 status["status"] = STATUS_TRANSFERRING
                 manifest: dict = {"classes": {}, "version": __version__}
                 for cls in classes:
-                    col = self.db.get_collection(cls)
-                    col.flush()
-                    # freeze the segment set while walking+copying: a
-                    # concurrent compaction would delete listed files
-                    # mid-copy (reference bucket_pauses.go)
-                    with col.maintenance_paused():
-                        files = []
-                        base = col.dir
-                        for dirpath, _dirs, fnames in os.walk(base):
-                            for fn in fnames:
-                                full = os.path.join(dirpath, fn)
-                                rel = os.path.join(
-                                    cls, os.path.relpath(full, base))
-                                backend.put_file(backup_id, rel, full)
-                                files.append(rel)
-                        # FROZEN tenants live in the local offload tier,
-                        # outside col.dir — without these files a restore
-                        # would recreate the tenant FROZEN but empty.
-                        # (Bucket-offloaded tenants already sit in durable
-                        # object storage; the manifest records that.)
-                        frozen_root = col._offload_root()
-                        offloaded = []
-                        from weaviate_tpu.backup.offload import (
-                            get_offloader,
-                        )
-
-                        bucket_off = get_offloader()
-                        for tname, tstatus in col.tenants().items():
-                            if tstatus != "FROZEN":
-                                continue
-                            fdir = os.path.join(frozen_root, tname)
-                            if os.path.isdir(fdir):
-                                for dirpath, _dirs, fnames in os.walk(fdir):
-                                    for fn in fnames:
-                                        full = os.path.join(dirpath, fn)
-                                        rel = os.path.join(
-                                            cls, "__frozen__", tname,
-                                            os.path.relpath(full, fdir))
-                                        backend.put_file(
-                                            backup_id, rel, full)
-                                        files.append(rel)
-                            elif bucket_off is not None and \
-                                    bucket_off.exists(cls, tname):
-                                offloaded.append(tname)
-                    manifest["classes"][cls] = {
-                        "config": col.config.to_dict(),
-                        "files": files,
-                        "tenants": col.tenants(),
-                        # frozen tenants whose data stays in the offload
-                        # bucket (not copied into the backup)
-                        "bucket_offloaded_tenants": offloaded,
-                    }
+                    try:
+                        self._copy_class(backend, backup_id, cls, manifest)
+                    except Exception as e:  # noqa: BLE001 — per-class
+                        # one broken class must not mask the rest: record
+                        # WHICH copy failed and keep going, so status()
+                        # surfaces per-class error detail
+                        status["class_errors"][cls] = str(e)
+                if status["class_errors"]:
+                    raise BackupError(
+                        "class copies failed: " + "; ".join(
+                            f"{c}: {m}" for c, m in
+                            sorted(status["class_errors"].items())))
                 status["status"] = STATUS_SUCCESS
                 status["completed_at"] = time.time()
                 manifest["status"] = status
@@ -129,12 +97,64 @@ class BackupHandler:
             except Exception as e:  # backup must never crash the server
                 status["status"] = STATUS_FAILED
                 status["error"] = str(e)
+                status["completed_at"] = time.time()
 
         if wait:
             run()
         else:
             threading.Thread(target=run, daemon=True).start()
         return dict(status)
+
+    def _copy_class(self, backend: BackupBackend, backup_id: str,
+                    cls: str, manifest: dict) -> None:
+        col = self.db.get_collection(cls)
+        col.flush()
+        # freeze the segment set while walking+copying: a concurrent
+        # compaction would delete listed files mid-copy (reference
+        # bucket_pauses.go)
+        with col.maintenance_paused():
+            files = []
+            base = col.dir
+            for dirpath, _dirs, fnames in os.walk(base):
+                for fn in fnames:
+                    full = os.path.join(dirpath, fn)
+                    rel = os.path.join(
+                        cls, os.path.relpath(full, base))
+                    backend.put_file(backup_id, rel, full)
+                    files.append(rel)
+            # FROZEN tenants live in the local offload tier, outside
+            # col.dir — without these files a restore would recreate the
+            # tenant FROZEN but empty. (Bucket-offloaded tenants already
+            # sit in durable object storage; the manifest records that.)
+            frozen_root = col._offload_root()
+            offloaded = []
+            from weaviate_tpu.backup.offload import get_offloader
+
+            bucket_off = get_offloader()
+            for tname, tstatus in col.tenants().items():
+                if tstatus != "FROZEN":
+                    continue
+                fdir = os.path.join(frozen_root, tname)
+                if os.path.isdir(fdir):
+                    for dirpath, _dirs, fnames in os.walk(fdir):
+                        for fn in fnames:
+                            full = os.path.join(dirpath, fn)
+                            rel = os.path.join(
+                                cls, "__frozen__", tname,
+                                os.path.relpath(full, fdir))
+                            backend.put_file(backup_id, rel, full)
+                            files.append(rel)
+                elif bucket_off is not None and \
+                        bucket_off.exists(cls, tname):
+                    offloaded.append(tname)
+        manifest["classes"][cls] = {
+            "config": col.config.to_dict(),
+            "files": files,
+            "tenants": col.tenants(),
+            # frozen tenants whose data stays in the offload bucket (not
+            # copied into the backup)
+            "bucket_offloaded_tenants": offloaded,
+        }
 
     def status(self, backend: BackupBackend, backup_id: str) -> dict:
         with self._lock:
@@ -215,6 +235,7 @@ class BackupHandler:
                     os.makedirs(dst_root, exist_ok=True)
                     for tname in os.listdir(tmp_frozen):
                         tdst = os.path.join(dst_root, tname)
+                        # graftlint: allow[unverified-remote-delete] reason=replacing a stale frozen copy with the just-downloaded backup payload; every file was fetched successfully above and the replacement is staged in tmp_frozen before this clear
                         shutil.rmtree(tdst, ignore_errors=True)
                         if os.path.exists(tdst):
                             # a surviving stale dir would make move() NEST

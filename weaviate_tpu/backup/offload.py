@@ -43,6 +43,7 @@ class ObjectStoreOffloader:
         # the bucket would resurrect deleted data on the next download
         # (the filesystem tier's rmtree-before-move invariant)
         for stale in self.client.list(pre):
+            # graftlint: allow[unverified-remote-delete] reason=clearing the PREVIOUS frozen generation before re-upload; the local shard_dir being uploaded is the authoritative copy and still on disk, so nothing unrecoverable is deleted
             self.client.delete(stale)
         n = 0
         for dirpath, _dirs, files in os.walk(shard_dir):

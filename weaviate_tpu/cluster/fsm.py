@@ -32,6 +32,21 @@ _LEDGER_NEXT = {
     "aborted": set(),
 }
 
+# cluster-backup ledger lifecycle (backup/cluster_backup.py): fencing
+# (checkpoint fence riding the WAL group-commit barrier) -> uploading
+# (nodes pushing fenced segment sets) -> committed (terminal cluster
+# manifest written — the atomicity point) or failed. A crashed
+# coordinator leaves a non-terminal entry any node can see, GC, or
+# resume; only "committed" backups are restorable.
+BACKUP_STATES = ("fencing", "uploading", "committed", "failed")
+BACKUP_TERMINAL = ("committed", "failed")
+_BACKUP_NEXT = {
+    "fencing": {"uploading", "failed"},
+    "uploading": {"committed", "failed"},
+    "committed": set(),
+    "failed": set(),
+}
+
 
 class SchemaFSM:
     def __init__(self, db: DB):
@@ -51,6 +66,11 @@ class SchemaFSM:
         # of un-overridden shards and from rebalance targets; writes to
         # shards they still hold keep flowing until the moves flip
         self.draining_nodes: list[str] = []
+        # raft-replicated cluster-backup journal (backup/cluster_backup
+        # .py): backup_id -> {state, classes, coordinator, nodes, ...};
+        # a coordinator crash leaves a durable non-terminal record any
+        # surviving node can GC or resume
+        self.backup_ledger: dict[str, dict] = {}
         # distributed-task table (reference cluster/distributedtask FSM)
         self.tasks = TaskFSM()
 
@@ -145,6 +165,19 @@ class SchemaFSM:
                 for mid in drop:
                     del self.rebalance_ledger[mid]
                 return {"ok": True, "removed": len(drop)}
+            if op == "backup_begin":
+                return self._apply_backup_begin(cmd)
+            if op == "backup_advance":
+                return self._apply_backup_advance(cmd)
+            if op == "backup_forget":
+                drop = [
+                    bid for bid, e in self.backup_ledger.items()
+                    if e["state"] in BACKUP_TERMINAL
+                    and (not cmd.get("ids") or bid in cmd["ids"])
+                ]
+                for bid in drop:
+                    del self.backup_ledger[bid]
+                return {"ok": True, "removed": len(drop)}
             if op == "set_node_draining":
                 if cmd["node"] not in self.draining_nodes:
                     self.draining_nodes.append(cmd["node"])
@@ -201,6 +234,52 @@ class SchemaFSM:
             e["updated_ts"] = cmd["ts"]
         return {"ok": True}
 
+    # -- backup ledger -----------------------------------------------------
+    def _apply_backup_begin(self, cmd: dict) -> dict:
+        e = dict(cmd["entry"])
+        for f in ("id", "classes", "coordinator"):
+            if f not in e:
+                return {"ok": False,
+                        "error": f"backup entry missing {f!r}"}
+        prev = self.backup_ledger.get(e["id"])
+        if prev is not None and prev["state"] not in BACKUP_TERMINAL:
+            # same-coordinator re-begin is the crash-resume path; a
+            # DIFFERENT coordinator must not hijack a live backup
+            if prev.get("coordinator") != e["coordinator"]:
+                return {"ok": False,
+                        "error": f"backup {e['id']!r} in progress"}
+        if prev is not None and prev["state"] == "committed":
+            # idempotent re-submit of a finished backup: report it,
+            # don't redo it (the REST handler surfaces the dict)
+            return {"ok": True, "id": e["id"], "existing": dict(prev)}
+        e["state"] = "fencing"
+        e.setdefault("nodes", {})
+        e.setdefault("error", "")
+        self.backup_ledger[e["id"]] = e
+        return {"ok": True, "id": e["id"]}
+
+    def _apply_backup_advance(self, cmd: dict) -> dict:
+        e = self.backup_ledger.get(cmd.get("id", ""))
+        if e is None:
+            return {"ok": False, "error": "unknown backup id"}
+        state = cmd["state"]
+        if state not in BACKUP_STATES:
+            return {"ok": False, "error": f"unknown state {state!r}"}
+        if state != e["state"] and state not in _BACKUP_NEXT[e["state"]]:
+            return {"ok": False,
+                    "error": f"illegal transition {e['state']} -> {state}"}
+        e["state"] = state
+        if "node" in cmd:
+            e.setdefault("nodes", {})[cmd["node"]] = dict(
+                cmd.get("node_info", {}))
+        if "manifest_key" in cmd:
+            e["manifest_key"] = cmd["manifest_key"]
+        if "error" in cmd:
+            e["error"] = str(cmd["error"])[:500]
+        if "ts" in cmd:
+            e["updated_ts"] = cmd["ts"]
+        return {"ok": True}
+
     # -- snapshot / restore ------------------------------------------------
     def snapshot(self) -> bytes:
         state = {
@@ -216,6 +295,7 @@ class SchemaFSM:
             "shard_overrides": self.shard_overrides,
             "shard_warming": self.shard_warming,
             "rebalance_ledger": self.rebalance_ledger,
+            "backup_ledger": self.backup_ledger,
             "draining_nodes": self.draining_nodes,
             "tasks": self.tasks.state(),
             "aliases": self.db.aliases(),
@@ -246,5 +326,6 @@ class SchemaFSM:
         self.shard_overrides = dict(state.get("shard_overrides", {}))
         self.shard_warming = dict(state.get("shard_warming", {}))
         self.rebalance_ledger = dict(state.get("rebalance_ledger", {}))
+        self.backup_ledger = dict(state.get("backup_ledger", {}))
         self.draining_nodes = list(state.get("draining_nodes", []))
         self.tasks.load(state.get("tasks", {}))

@@ -204,6 +204,9 @@ class ClusterNode:
         # async replica-op registry (reference /v1/replication/replicate)
         self._rep_ops: dict[str, dict] = {}
         self._rep_ops_lock = threading.Lock()
+        # shared blob store (cold tier + cluster backups); resolved
+        # lazily from env by _get_blobstore, injectable by tests
+        self.blobstore: Optional[Any] = None
         transport.start(self._dispatch)
         if heartbeat:
             self.raft.start()
@@ -422,6 +425,25 @@ class ClusterNode:
     # coordinator's local schema
     def has_collection(self, name: str) -> bool:
         return self.db.has_collection(name)
+
+    # -- cluster backup / restore (backup/cluster_backup.py) ---------------
+    def cluster_backup(self, backup_id: str,
+                       include: Optional[list] = None) -> dict:
+        from weaviate_tpu.backup.cluster_backup import (
+            ClusterBackupCoordinator,
+        )
+
+        return ClusterBackupCoordinator(
+            self, self._get_blobstore()).backup(backup_id, include)
+
+    def cluster_restore(self, backup_id: str,
+                        include: Optional[list] = None) -> dict:
+        from weaviate_tpu.backup.cluster_backup import (
+            ClusterBackupCoordinator,
+        )
+
+        return ClusterBackupCoordinator(
+            self, self._get_blobstore()).restore(backup_id, include)
 
     def get_collection(self, name: str):
         return self.db.get_collection(name)
@@ -1962,6 +1984,145 @@ class ClusterNode:
         self._frozen.discard(
             (msg["class"], msg["shard"], msg.get("tenant", "")))
         return {"ok": True}
+
+    # -- cluster backup (backup/cluster_backup.py) -------------------------
+    def _get_blobstore(self):
+        """Shared blob store for cold-tier offload and cluster backups.
+        Tests inject by assigning ``node.blobstore`` directly."""
+        if self.blobstore is None:
+            from weaviate_tpu.backup.blobstore import make_blobstore
+
+            self.blobstore = make_blobstore()
+        if self.blobstore is None:
+            raise RuntimeError(
+                "no blob store configured (set COLD_TIER_BLOB_PATH or "
+                "COLD_TIER_S3_BUCKET)")
+        return self.blobstore
+
+    def _on_backup_fence(self, msg: dict) -> dict:
+        """Checkpoint fence: when this returns, every write this node
+        acked before the fence is fsync-durable (shard flush rides the
+        WAL group-commit ``sync_window`` barrier) and captured in the
+        on-disk checkpoint — the segment set the upload phase walks."""
+        fenced = 0
+        for cls in msg["classes"]:
+            col = self.db.get_collection(cls)
+            with col._lock:
+                shards = list(col._shards.values())
+            for s in shards:
+                s.flush()
+                s.checkpoint()
+            fenced += len(shards)
+        return {"ok": True, "shards": fenced}
+
+    def _on_backup_upload(self, msg: dict) -> dict:
+        """Upload this node's fenced segment set + a per-node manifest.
+
+        Runs under ``maintenance_paused`` so compaction cannot rewrite
+        the fenced files mid-copy (writes continue into WAL+memtable —
+        they belong to the NEXT backup). Shard dirs named ``shard<n>``
+        carry their shard number; ``tenant-*`` dirs group under shard 0
+        for restore placement (a tenant's objects route by uuid-shard,
+        so a tenant dir spread over many shards restores partially —
+        documented in docs/backup.md)."""
+        import hashlib as _hashlib
+        import json as _json
+        import os as _os
+
+        from weaviate_tpu.backup.cluster_backup import node_manifest_key
+
+        store = self._get_blobstore()
+        bid = msg["backup_id"]
+        files: list[dict] = []
+        total = 0
+        for cls in msg["classes"]:
+            col = self.db.get_collection(cls)
+            with col.maintenance_paused():
+                for entry in sorted(_os.listdir(col.dir)):
+                    shard_dir = _os.path.join(col.dir, entry)
+                    if not _os.path.isdir(shard_dir):
+                        continue
+                    if entry.startswith("shard"):
+                        shard_no = int(entry[len("shard"):])
+                    elif entry.startswith("tenant-"):
+                        shard_no = 0
+                    else:
+                        continue
+                    for root, _dirs, names in _os.walk(shard_dir):
+                        for name in sorted(names):
+                            if ".tmp." in name:
+                                continue  # _sweep_tmp litter
+                            path = _os.path.join(root, name)
+                            rel = _os.path.relpath(path, shard_dir)
+                            key = (f"backups/{bid}/nodes/{self.id}/"
+                                   f"{cls}/{entry}/{rel}")
+                            with open(path, "rb") as f:
+                                data = f.read()
+                            store.put(key, data)
+                            files.append({
+                                "key": key, "class": cls,
+                                "shard": shard_no, "dir": entry,
+                                "rel": rel, "size": len(data),
+                                "sha256":
+                                    _hashlib.sha256(data).hexdigest(),
+                            })
+                            total += len(data)
+        mkey = node_manifest_key(bid, self.id)
+        store.put(mkey, _json.dumps(
+            {"node": self.id, "backup_id": bid, "files": files},
+            sort_keys=True).encode())
+        return {"ok": True, "manifest_key": mkey,
+                "files": len(files), "bytes": total}
+
+    def _on_backup_install_shard(self, msg: dict) -> dict:
+        """Download one shard's backed-up files, digest-verify every
+        byte, then atomically install (staging dir + ``os.replace``) —
+        a torn download can never masquerade as a restored shard."""
+        import hashlib as _hashlib
+        import os as _os
+        import shutil as _shutil
+
+        store = self._get_blobstore()
+        # the restore coordinator creates the class through raft just
+        # before this RPC; tolerate this node's apply lag (bounded)
+        wait_until = time.monotonic() + 10.0
+        while not self.db.has_collection(msg["class"]) \
+                and time.monotonic() < wait_until:
+            time.sleep(0.02)
+        col = self.db.get_collection(msg["class"])
+        by_dir: dict[str, list[dict]] = {}
+        for ent in msg["files"]:
+            by_dir.setdefault(ent["dir"], []).append(ent)
+        for dirname, ents in sorted(by_dir.items()):
+            dst = _os.path.join(col.dir, dirname)
+            staging = dst + ".restore"
+            _shutil.rmtree(staging, ignore_errors=True)
+            try:
+                for ent in ents:
+                    rel = _os.path.normpath(ent["rel"])
+                    if rel.startswith("..") or _os.path.isabs(rel):
+                        raise ValueError(
+                            f"manifest path escapes shard dir: "
+                            f"{ent['rel']!r}")
+                    data = store.get(ent["key"])
+                    if (_hashlib.sha256(data).hexdigest()
+                            != ent["sha256"]):
+                        raise ValueError(
+                            f"digest mismatch for {ent['key']}")
+                    path = _os.path.join(staging, rel)
+                    _os.makedirs(_os.path.dirname(path), exist_ok=True)
+                    with open(path, "wb") as f:
+                        f.write(data)
+            except (KeyError, ValueError, OSError) as e:
+                _shutil.rmtree(staging, ignore_errors=True)
+                raise RuntimeError(
+                    f"install {msg['class']}/{dirname} failed: {e}") \
+                    from e
+            with col._lock:
+                col._shards.pop(dirname, None)
+            _shutil.rmtree(dst, ignore_errors=True)
+            _os.replace(staging, dst)
+        return {"ok": True, "dirs": sorted(by_dir)}
 
     # -- orphan-copy GC ----------------------------------------------------
     def _shard_move_active(self, cls: str, shard: int) -> bool:

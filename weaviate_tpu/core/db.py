@@ -47,7 +47,19 @@ class DB:
         if tiering_budget_bytes > 0:
             from weaviate_tpu.tiering import TieringController
 
-            self.tiering = TieringController(self, tiering_budget_bytes)
+            # bottomless cold tier: with a blob store configured
+            # (COLD_TIER_BLOB_PATH / COLD_TIER_S3_BUCKET) cold releases
+            # offload wholesale and first touch hydrates back
+            from weaviate_tpu.backup.blobstore import make_blobstore
+
+            coldstore = None
+            blob = make_blobstore()
+            if blob is not None:
+                from weaviate_tpu.tiering.coldstore import TenantColdStore
+
+                coldstore = TenantColdStore(blob)
+            self.tiering = TieringController(self, tiering_budget_bytes,
+                                             coldstore=coldstore)
         # serving QoS controller, shared by every API plane mounted on
         # this DB (REST + both gRPC services) so one AIMD ceiling governs
         # total in-flight work; built lazily — most tests never serve

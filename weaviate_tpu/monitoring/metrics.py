@@ -408,6 +408,42 @@ TIER_SEARCHES = REGISTRY.counter(
     "vector searches served by residency tier (device = HBM-resident "
     "arrays, host = the instrumented warm-tier exact fallback)")
 
+# bottomless cold tier + cluster backup instruments (tiering/coldstore.py,
+# backup/cluster_backup.py): every offload/hydrate/backup/restore leg and
+# the retention sweep observable — the DR story's dashboards
+OFFLOAD_TENANTS = REGISTRY.counter(
+    "weaviate_tpu_offload_tenants_total",
+    "wholesale tenant offloads to the blob tier, by outcome "
+    "(ok/failed; failed leaves the local copy intact)")
+OFFLOAD_BYTES = REGISTRY.counter(
+    "weaviate_tpu_offload_bytes_total",
+    "bytes uploaded to the blob tier by tenant offload (segments + WAL "
+    "checkpoint + manifest)")
+OFFLOAD_SECONDS = REGISTRY.histogram(
+    "weaviate_tpu_offload_seconds",
+    "wall time of one tenant offload (upload + verify + local delete)")
+HYDRATE_TENANTS = REGISTRY.counter(
+    "weaviate_tpu_hydrate_tenants_total",
+    "first-touch tenant hydrations from the blob tier, by outcome "
+    "(ok/failed/corrupt; corrupt = digest mismatch, nothing installed)")
+HYDRATE_SECONDS = REGISTRY.histogram(
+    "weaviate_tpu_hydrate_seconds",
+    "wall time of one tenant hydration (download + verify + install), "
+    "the cold-start tax the promotion deadline sheds against")
+BACKUP_RUNS = REGISTRY.counter(
+    "weaviate_tpu_backup_runs_total",
+    "cluster backup runs, by terminal status (success/failed)")
+BACKUP_BYTES = REGISTRY.counter(
+    "weaviate_tpu_backup_bytes_total",
+    "bytes uploaded by cluster backups (fenced segment sets + manifests)")
+RESTORE_RUNS = REGISTRY.counter(
+    "weaviate_tpu_restore_runs_total",
+    "cluster restore runs, by terminal status (success/failed)")
+RETENTION_DELETED = REGISTRY.counter(
+    "weaviate_tpu_retention_deleted_total",
+    "blobs deleted by the retention sweep, by reason (stale_generation/"
+    "partial_offload/partial_backup/unreferenced)")
+
 # end-to-end tracing instruments (monitoring/tracing.py + the coalescing
 # dispatcher's batch spans): the dispatcher's queue-wait/service split is
 # measurable even when sampling is off, and both histograms carry the

@@ -94,9 +94,14 @@ class TieringController:
                  promote_min_score: float = 1.0,
                  swap_margin: float = 1.5,
                  max_cold_wait_s: float = 60.0,
+                 coldstore=None,
                  clock: Callable[[], float] = time.monotonic):
         self.db = db
         self.accountant = HbmAccountant(budget_bytes)
+        # bottomless cold tier (tiering/coldstore.py): when a blob store
+        # is configured, a cold release offloads the tenant wholesale and
+        # first touch hydrates through the promotion path below
+        self.coldstore = coldstore
         self.half_life_s = float(half_life_s)
         self.cold_after_s = float(cold_after_s)
         self.promote_min_score = float(promote_min_score)
@@ -310,7 +315,15 @@ class TieringController:
                 self._make_room(est, exclude=key)
         # the cold open (checkpoint replay, possibly seconds) runs
         # OUTSIDE the attach lock: another tenant's warm attach or write
-        # promotion must not queue behind this tenant's disk replay
+        # promotion must not queue behind this tenant's disk replay.
+        # An OFFLOADED tenant hydrates from the blob tier first — inside
+        # this single-flight future, so concurrent cold queries share one
+        # download and the deadline shed (ColdStartPending) applies
+        # unchanged. Hydration failure propagates: a torn manifest/blob
+        # must fail the waiting queries loudly, never open an empty shard
+        # in place of the tenant's data.
+        if self.coldstore is not None:
+            self.coldstore.hydrate(col, tenant)
         shard = col._get_shard(f"tenant-{tenant}")
         per_tenant = self._tenant_budget(col)
         with self._attach_lock:
@@ -532,6 +545,12 @@ class TieringController:
         TIER_DEMOTIONS.inc(to_tier=COLD)
         logger.info("released tenant %s/%s to the cold tier (%d bytes "
                     "on disk)", ent.key[0], ent.key[1], ent.disk_bytes)
+        if self.coldstore is not None:
+            # wholesale offload of the closed shard dir: manifest-first,
+            # verify-then-delete-local (coldstore.py). A failed offload
+            # keeps the local copy — the tenant stays plain-cold and the
+            # next release retries with a fresh generation.
+            self.coldstore.offload(col, ent.key[1])
 
     def _coldest(self, entries: list, state: str) -> Optional[_Tenant]:
         cands = [e for e in entries if e.state == state]
