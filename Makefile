@@ -1,14 +1,18 @@
 # Developer/CI entry points. Tier-1 itself is driven by ROADMAP.md's
 # pytest line; these targets cover the static-analysis side.
 
-.PHONY: lint lint-sarif lint-dot lint-fix-baseline test trace-demo chaos
+.PHONY: lint lint-sarif lint-dot lint-errorflow-dot lint-fix-baseline \
+	test trace-demo chaos
 
-# Full graftlint: every per-file rule plus the interprocedural
-# concurrency pass (lock-order cycles, blocking-under-lock, unlocked
-# collective dispatch). The concurrency model is cached on source
-# mtimes (tools/graftlint/.concurrency_cache.json); per-phase wall time
-# is recorded in summary.timings of the JSON so tier-1 budget creep is
-# visible in CI artifacts.
+# Full graftlint: every per-file rule plus BOTH interprocedural
+# passes — concurrency (lock-order cycles, blocking-under-lock,
+# unlocked collective dispatch) and errorflow (unchecked RPC replies,
+# budgets minted in flight, unbounded blocking on ingress paths). Both
+# models are cached on source mtimes
+# (tools/graftlint/.{concurrency,errorflow}_cache.json, one shared
+# invalidation path); per-phase wall time is recorded in
+# summary.timings of the JSON so tier-1 budget creep is visible in CI
+# artifacts (tests/test_lint_clean.py pins the warm run under 15s).
 lint:
 	@python -m tools.graftlint weaviate_tpu/ --format json
 
@@ -21,6 +25,13 @@ lint-sarif:
 #   make lint-dot | dot -Tsvg > lock-order.svg
 lint-dot:
 	@python -m tools.graftlint weaviate_tpu/ --format dot
+
+# The whole-program reply-taint graph (graphviz): RPC/blob/queue taint
+# sources, the functions whose returns launder them, and the
+# sanitizers that clear them (docs/lint.md "Error-path contracts"):
+#   make lint-errorflow-dot | dot -Tsvg > reply-taint.svg
+lint-errorflow-dot:
+	@python -m tools.graftlint weaviate_tpu/ --format errorflow-dot
 
 lint-fix-baseline:
 	python -m tools.graftlint weaviate_tpu/ --fix-baseline
@@ -35,7 +46,10 @@ test:
 # mid-migration, crash-resume via the rebalance ledger), and the cold
 # tier / cluster backup scenarios (kill mid-offload and mid-backup,
 # bucket outages, 3-node backup restored into 5 nodes with zero lost
-# acked writes).
+# acked writes). Runs under both runtime witnesses (conftest default):
+# the session FAILS if any lock-order inversion or any serving-scope
+# RPC with no live deadline is observed — zero violations is an
+# asserted invariant of the chaos suite, not a hope.
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_replication.py \
 		tests/test_rebalance.py tests/test_coldtier_chaos.py \

@@ -54,20 +54,46 @@ if _WITNESS_MODE not in ("off", "0", ""):
     sys.modules["weaviate_tpu.utils.lockwitness"] = lockwitness
     lockwitness.install(strict=(_WITNESS_MODE == "strict"))
 
+# Deadline witness (docs/lint.md "Error-path contracts"): the runtime
+# counterpart of the errorflow budget pass. Boot-loaded by file path the
+# same way so the conftest-installed instance is THE one the inline
+# transport/resilience hooks see. Knob:
+# WEAVIATE_TPU_DEADLINE_WITNESS=off|record|strict (default record —
+# a serving-scope RPC with no live deadline fails the session at exit).
+_DW_MODE = os.environ.get("WEAVIATE_TPU_DEADLINE_WITNESS", "record")
+if _DW_MODE not in ("off", "0", ""):
+    import importlib.util
+
+    _dw_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..", "weaviate_tpu", "utils", "deadlinewitness.py")
+    _dw_spec = importlib.util.spec_from_file_location(
+        "weaviate_tpu.utils.deadlinewitness", os.path.abspath(_dw_path))
+    deadlinewitness = importlib.util.module_from_spec(_dw_spec)
+    _dw_spec.loader.exec_module(deadlinewitness)
+    sys.modules["weaviate_tpu.utils.deadlinewitness"] = deadlinewitness
+    deadlinewitness.install(strict=(_DW_MODE == "strict"))
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """Zero observed lock-order inversions is a tier-1 invariant: the
-    chaos, tiering, and mesh suites all ran with the witness on."""
+    """Zero observed lock-order inversions AND zero unbudgeted
+    serving-scope RPCs are tier-1 invariants: the chaos, tiering, and
+    mesh suites all ran with both witnesses on."""
     lw = sys.modules.get("weaviate_tpu.utils.lockwitness")
-    if lw is None or not lw.installed():
-        return
-    w = lw.current()
-    print("\n" + w.report())
-    if w.inversions and exitstatus == 0:
-        session.exitstatus = 1
+    if lw is not None and lw.installed():
+        w = lw.current()
+        print("\n" + w.report())
+        if w.inversions and exitstatus == 0:
+            session.exitstatus = 1
+    dw = sys.modules.get("weaviate_tpu.utils.deadlinewitness")
+    if dw is not None and dw.installed():
+        w = dw.current()
+        print(w.report())
+        if w.violations and exitstatus == 0:
+            session.exitstatus = 1
 
 
 @pytest.fixture

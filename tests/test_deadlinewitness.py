@@ -1,0 +1,211 @@
+"""Deadline witness: the runtime half of the errorflow budget contract.
+
+The static pass (tools/graftlint/errorflow.py, budget-minted-in-flight /
+blocking-call-without-deadline) proves by construction; these tests prove
+the dynamic complement catches what actually executes — a serving-scope
+RPC escaping the request budget is recorded (record mode) or raised
+(strict mode) AT THE SEND, with real transports and the real resilience
+stack in the loop. Every provoked violation runs inside
+``deadlinewitness.isolated()`` so the session-wide zero-violation
+assertion in conftest's ``pytest_sessionfinish`` stays meaningful.
+"""
+
+import random
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from weaviate_tpu.cluster.resilience import Deadline, RetryPolicy, \
+    retrying_call
+from weaviate_tpu.cluster.transport import InProcTransport, TransportError
+from weaviate_tpu.serving.context import RequestContext, request_scope
+from weaviate_tpu.utils import deadlinewitness as dw
+
+
+def _pair(registry=None):
+    """Two wired in-proc nodes; b echoes the message type back."""
+    registry = {} if registry is None else registry
+    a = InProcTransport(registry, "a")
+    b = InProcTransport(registry, "b")
+    a.start(lambda msg: {"ok": True})
+    b.start(lambda msg: {"echo": msg.get("type", "")})
+    return a, b
+
+
+class TestRecordMode:
+    def test_no_deadline_rpc_recorded(self):
+        a, _ = _pair()
+        with dw.isolated() as w:
+            with request_scope(RequestContext(deadline=None, lane="query")):
+                r = a.send("b", {"type": "probe"})
+        assert r == {"echo": "probe"}
+        assert w.stats()["violations"] == 1
+        rec = w.violations[0]
+        assert rec["peer"] == "b"
+        assert rec["msg_type"] == "probe"
+        assert "test_deadlinewitness" in rec["here"]
+
+    def test_ctx_deadline_satisfies(self):
+        a, _ = _pair()
+        with dw.isolated() as w:
+            ctx = RequestContext(deadline=Deadline(5.0, op="q"))
+            with request_scope(ctx):
+                a.send("b", {"type": "probe"})
+        assert w.stats()["violations"] == 0
+        assert w.stats()["rpcs"] == 1
+
+    def test_no_ctx_is_not_serving_scope(self):
+        # maintenance / control-plane sends carry no budget contract
+        a, _ = _pair()
+        with dw.isolated() as w:
+            a.send("b", {"type": "gossip"})
+        assert w.stats() == {"rpcs": 0, "violations": 0, "late_rpcs": 0,
+                             "minted_in_flight": 0, "error_replies": 0}
+
+    def test_retrying_call_push_satisfies(self):
+        # explicit caller deadline > ctx deadline: retrying_call marks its
+        # deadline live on the thread, so a ctx WITHOUT one is still fine
+        a, _ = _pair()
+        with dw.isolated() as w:
+            with request_scope(RequestContext(deadline=None)):
+                r = retrying_call(
+                    lambda t: a.send("b", {"type": "x"}, timeout=t),
+                    peer="b", policy=RetryPolicy(attempts=2),
+                    deadline=Deadline(5.0, op="x"), timeout=1.0,
+                    rng=random.Random(0), retry_on=(TransportError,))
+        assert r == {"echo": "x"}
+        assert w.stats()["violations"] == 0
+        assert w.stats()["rpcs"] == 1
+
+    def test_deadline_popped_after_retrying_call(self):
+        # the TLS push must not leak: a later bare send is a violation
+        a, _ = _pair()
+        with dw.isolated() as w:
+            with request_scope(RequestContext(deadline=None)):
+                retrying_call(
+                    lambda t: a.send("b", {"type": "x"}, timeout=t),
+                    peer="b", policy=RetryPolicy(attempts=1),
+                    deadline=Deadline(5.0, op="x"), timeout=1.0,
+                    rng=random.Random(0))
+                a.send("b", {"type": "bare"})
+        assert w.stats()["violations"] == 1
+        assert w.violations[0]["msg_type"] == "bare"
+
+    def test_expired_deadline_counts_late(self):
+        a, _ = _pair()
+        with dw.isolated() as w:
+            spent = Deadline(0.0, op="q", clock=lambda: 100.0)
+            with request_scope(RequestContext(deadline=spent)):
+                a.send("b", {"type": "probe"})
+        assert w.stats()["violations"] == 0
+        assert w.stats()["late_rpcs"] == 1
+
+    def test_mint_inside_live_scope_counted(self):
+        # the dynamic shape of the PR 16 bug: a fresh budget born while
+        # the request already holds one (stat, not violation — the static
+        # pass owns the verdict, with suppressions for the 2PC finish leg)
+        with dw.isolated() as w:
+            ctx = RequestContext(deadline=Deadline(5.0, op="req"))
+            with request_scope(ctx):
+                Deadline(30.0, op="rogue_leg")
+        assert w.stats()["minted_in_flight"] == 1
+        assert w.stats()["violations"] == 0
+
+    def test_error_reply_counted(self):
+        # the raw material of the PR 10 class: replies the taint pass
+        # proves each caller checks
+        registry = {}
+        a = InProcTransport(registry, "a")
+        b = InProcTransport(registry, "b")
+        a.start(lambda msg: {})
+        b.start(lambda msg: {"error": "shard unknown"})
+        with dw.isolated() as w:
+            a.send("b", {"type": "shard_digest"})
+        assert w.stats()["error_replies"] == 1
+
+
+class TestModes:
+    def test_off_is_inert(self):
+        # every hook early-returns on the module-global None check; the
+        # off path must not touch thread-locals or record anything
+        a, _ = _pair()
+        with dw.isolated():
+            dw.uninstall()
+            assert not dw.installed()
+            assert dw.current() is None
+            assert dw.push_deadline(Deadline(1.0)) is False
+            dw.pop_deadline(False)
+            with request_scope(RequestContext(deadline=None)):
+                a.send("b", {"type": "probe"})  # no witness, no record
+            dw.observe_reply({"error": "x"})
+            dw.observe_mint(object())
+        # exiting isolated() restored the session witness
+        assert dw.installed()
+
+    def test_strict_raises_at_the_send(self):
+        a, _ = _pair()
+        with dw.isolated(strict=True) as w:
+            with request_scope(RequestContext(deadline=None)):
+                with pytest.raises(dw.DeadlineViolation, match="no\\s+live"):
+                    a.send("b", {"type": "probe"})
+        assert w.stats()["violations"] == 1
+
+    def test_install_is_idempotent_and_updates_strictness(self):
+        with dw.isolated():
+            w1 = dw.install(strict=False)
+            w2 = dw.install(strict=True)
+            assert w2 is w1  # same recorder, not a reset
+            assert w1.strict is True  # re-install flipped strictness
+
+    def test_strict_mode_subprocess(self):
+        # end to end in a clean interpreter: no conftest, plain package
+        # imports, strict witness installed by hand — the unbudgeted send
+        # must surface as DeadlineViolation, not a silent success
+        code = textwrap.dedent("""
+            import sys
+            from weaviate_tpu.utils import deadlinewitness as dw
+            from weaviate_tpu.cluster.transport import InProcTransport
+            from weaviate_tpu.serving.context import (
+                RequestContext, request_scope)
+
+            dw.install(strict=True)
+            reg = {}
+            a = InProcTransport(reg, "a")
+            b = InProcTransport(reg, "b")
+            a.start(lambda m: {})
+            b.start(lambda m: {"ok": True})
+            with request_scope(RequestContext(deadline=None)):
+                try:
+                    a.send("b", {"type": "probe"})
+                except dw.DeadlineViolation:
+                    sys.exit(7)
+            sys.exit(1)
+        """)
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=120, env=env)
+        assert proc.returncode == 7, proc.stderr
+
+
+class TestReport:
+    def test_report_names_the_offender(self):
+        a, _ = _pair()
+        with dw.isolated() as w:
+            with request_scope(RequestContext(deadline=None)):
+                a.send("b", {"type": "object_push"})
+        rep = w.report()
+        assert "1 violation(s)" in rep
+        assert "VIOLATION" in rep
+        assert "'object_push' -> b" in rep
+
+    def test_clean_report_is_one_line(self):
+        with dw.isolated() as w:
+            pass
+        assert w.report() == (
+            "deadlinewitness: 0 serving-scope rpcs, 0 violation(s), "
+            "0 late, 0 minted-in-flight, 0 error replies")

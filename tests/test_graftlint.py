@@ -2263,3 +2263,489 @@ class TestConcurrencyEngineIntegration:
         assert "concurrency_s" in doc["summary"]["timings"]
         assert "total_s" in doc["summary"]["timings"]
         assert doc["summary"]["concurrency_cache"] == "off"
+
+
+# ---------------------------------------------------------------------------
+# errorflow: reply taint (unchecked-rpc-reply)
+
+
+from tools.graftlint import errorflow as ef  # noqa: E402
+
+EF_RULES = list(ef.ERRORFLOW_RULE_IDS)
+API = "weaviate_tpu/api/fake_rest.py"
+TIER = "weaviate_tpu/tiering/fake.py"
+
+
+def run_ef(src, rel=CLUSTER):
+    return run(src, rel=rel, rules=EF_RULES)
+
+
+class TestReplyTaint:
+    def test_pr10_error_reply_as_verified_zero_flagged(self):
+        # the PR 10 bug shape: an {'error': ...} reply has no data keys,
+        # so .get() reads it as verified-zero and repair is skipped
+        res = run_ef("""
+            class Node:
+                def digests(self, rep):
+                    r = self._send(rep, {"type": "shard_digest"})
+                    return r.get("digests")
+        """)
+        assert rule_ids(res) == ["unchecked-rpc-reply"]
+        assert res.violations[0].severity == "error"
+
+    def test_error_key_check_sanitizes(self):
+        res = run_ef("""
+            class Node:
+                def digests(self, rep):
+                    r = self._send(rep, {"type": "shard_digest"})
+                    if "error" in r:
+                        return None
+                    return r["digests"]
+        """)
+        assert rule_ids(res) == []
+
+    def test_ok_key_get_sanitizes(self):
+        res = run_ef("""
+            class Node:
+                def push(self, rep):
+                    r = self._send(rep, {"type": "object_push"})
+                    if not r.get("ok"):
+                        raise RuntimeError("push rejected")
+                    return r["applied"]
+        """)
+        assert rule_ids(res) == []
+
+    def test_expect_validator_sanitizes(self):
+        res = run_ef("""
+            class Node:
+                def pull(self, rep):
+                    r = self._send(rep, {"type": "object_fetch"})
+                    blobs = self._expect(r, "objects", rep)
+                    return [b for b in r["objects"] if b]
+        """)
+        assert rule_ids(res) == []
+
+    def test_taint_through_assignment_chain(self):
+        res = run_ef("""
+            class Node:
+                def hop(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    s = r
+                    return s["items"]
+        """)
+        assert rule_ids(res) == ["unchecked-rpc-reply"]
+
+    def test_taint_through_tuple_unpack(self):
+        res = run_ef("""
+            class Node:
+                def pair(self, rep):
+                    r, n = self._send(rep, {"type": "x"}), 0
+                    return r["items"], n
+        """)
+        assert rule_ids(res) == ["unchecked-rpc-reply"]
+
+    def test_tuple_unpack_clean_slot_not_tainted(self):
+        res = run_ef("""
+            class Node:
+                def pair(self, rep):
+                    r, n = self._send(rep, {"type": "x"}), {"k": 1}
+                    if "error" in r:
+                        return None
+                    return n["k"]
+        """)
+        assert rule_ids(res) == []
+
+    def test_taint_through_helper_return(self):
+        # returns-tainted fixpoint: the helper launders the reply
+        # through its return value; the caller's read is the finding
+        res = run_ef("""
+            class Node:
+                def _grab(self, rep):
+                    return self._send(rep, {"type": "x"})
+
+                def use(self, rep):
+                    r = self._grab(rep)
+                    return r["items"]
+        """)
+        assert rule_ids(res) == ["unchecked-rpc-reply"]
+
+    def test_truthiness_as_success_flagged(self):
+        res = run_ef("""
+            class Node:
+                def ok(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    if r:
+                        return True
+                    return False
+        """)
+        assert rule_ids(res) == ["unchecked-rpc-reply"]
+
+    def test_iteration_over_reply_flagged(self):
+        res = run_ef("""
+            class Node:
+                def items(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    out = []
+                    for it in r:
+                        out.append(it)
+                    return out
+        """)
+        assert rule_ids(res) == ["unchecked-rpc-reply"]
+
+    def test_registered_validator_sanitizes(self):
+        ef.register_validator("check_reply")
+        try:
+            res = run_ef("""
+                class Node:
+                    def use(self, rep):
+                        r = self._send(rep, {"type": "x"})
+                        check_reply(r)
+                        return r["items"]
+            """)
+            assert rule_ids(res) == []
+        finally:
+            ef.clear_registered_validators()
+        assert "check_reply" not in ef.validator_names()
+
+    def test_reply_validator_marker(self):
+        res = run_ef("""
+            class Node:
+                def _check(self, r):  # graftlint: reply-validator
+                    if "error" in r:
+                        raise RuntimeError(r["error"])
+
+                def use(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    self._check(r)
+                    return r["items"]
+        """)
+        assert rule_ids(res) == []
+
+    def test_reply_raises_marker_kills_source(self):
+        # a source whose error channel is an exception (api_provider's
+        # transport) never returns error dicts — replies are clean
+        res = run_ef("""
+            class Client:
+                def _call(self, payload):  # graftlint: reply-raises
+                    return transport(payload)
+
+                def embed(self, text):
+                    r = self._call({"input": text})
+                    return r["data"]
+        """)
+        assert rule_ids(res) == []
+
+    def test_severity_warning_outside_critical_dirs(self):
+        src = """
+            class Node:
+                def digests(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    return r.get("digests")
+        """
+        res = run_ef(src, rel=COLD)
+        assert rule_ids(res) == ["unchecked-rpc-reply"]
+        assert res.violations[0].severity == "warning"
+
+    def test_suppression_consumed_by_errorflow(self):
+        res = run("""
+            class Node:
+                def digests(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    # graftlint: allow[unchecked-rpc-reply] reason=probe endpoint, error reply intentionally reads as empty
+                    return r.get("digests")
+        """, rel=CLUSTER)
+        assert rule_ids(res) == []
+
+    def test_blob_get_unguarded_flagged(self):
+        res = run_ef("""
+            class Cold:
+                def read(self, store, key):
+                    return store.get(key)
+        """, rel=TIER)
+        assert rule_ids(res) == ["unchecked-rpc-reply"]
+
+    def test_blob_get_keyerror_guard_clean(self):
+        res = run_ef("""
+            class Cold:
+                def read(self, store, key):
+                    try:
+                        return store.get(key)
+                    except KeyError:
+                        return None
+        """, rel=TIER)
+        assert rule_ids(res) == []
+
+    def test_zero_arg_get_is_not_blob_io(self):
+        # DynamicValue/config reads: .get() without a key operand
+        res = run_ef("""
+            class Cold:
+                def budget(self):
+                    return float(BUDGET_STORE.get())
+        """, rel=TIER)
+        assert rule_ids(res) == []
+
+
+# ---------------------------------------------------------------------------
+# errorflow: budget propagation
+
+
+class TestBudgetPropagation:
+    def test_pr16_fresh_budget_in_leg_flagged(self):
+        # the PR 16 bug shape: a leg reachable from ingress mints its own
+        # budget instead of threading the request's deadline
+        res = run_ef("""
+            from weaviate_tpu.cluster.resilience import Deadline
+            from weaviate_tpu.serving.context import RequestContext
+            from weaviate_tpu.serving.context import request_scope
+
+            def handle_backup(req):
+                ctx = RequestContext(deadline=req.deadline)
+                with request_scope(ctx):
+                    return _backup_leg(req)
+
+            def _backup_leg(req):
+                deadline = Deadline(30.0, op="backup")
+                return req.run(deadline)
+        """, rel=API)
+        assert rule_ids(res) == ["budget-minted-in-flight"]
+        assert res.violations[0].symbol.endswith("_backup_leg")
+
+    def test_ctx_installer_mint_exempt(self):
+        # the ingress mint IS where the budget is born: exempt
+        res = run_ef("""
+            from weaviate_tpu.cluster.resilience import Deadline
+            from weaviate_tpu.serving.context import RequestContext
+            from weaviate_tpu.serving.context import request_scope
+
+            def handle(req):
+                ctx = RequestContext(deadline=Deadline(30.0, op="rest"))
+                with request_scope(ctx):
+                    return req.run()
+        """, rel=API)
+        assert rule_ids(res) == []
+
+    def test_mint_outside_ingress_reach_not_flagged(self):
+        res = run_ef("""
+            from weaviate_tpu.cluster.resilience import Deadline
+
+            def maintenance_sweep(store):
+                deadline = Deadline(60.0, op="sweep")
+                return store.sweep(deadline)
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+    def test_op_deadline_helper_exempt(self):
+        res = run_ef("""
+            from weaviate_tpu.cluster.resilience import Deadline
+
+            def handle(req):
+                return _op_deadline("q")
+
+            def _op_deadline(op):
+                return Deadline(5.0, op=op)
+        """, rel=API)
+        assert rule_ids(res) == []
+
+    def test_ingress_marker_makes_root(self):
+        res = run_ef("""
+            from weaviate_tpu.cluster.resilience import Deadline
+
+            def pump(batch):  # graftlint: ingress
+                return _leg(batch)
+
+            def _leg(batch):
+                deadline = Deadline(10.0, op="pump")
+                return batch.run(deadline)
+        """, rel=COLD)
+        assert rule_ids(res) == ["budget-minted-in-flight"]
+
+    def test_cycle_registration_roots_ingress(self):
+        res = run_ef("""
+            from weaviate_tpu.cluster.resilience import Deadline
+
+            class Controller:
+                def start(self, cycles):
+                    cycles.register("demote", self._demote)
+
+                def _demote(self):
+                    deadline = Deadline(60.0, op="demote")
+                    return deadline
+        """, rel=TIER)
+        assert rule_ids(res) == ["budget-minted-in-flight"]
+
+
+class TestBlockingWithoutDeadline:
+    def test_future_result_unbounded_flagged(self):
+        res = run_ef("""
+            def handle(pool, job):
+                f = pool.submit(job)
+                return f.result()
+        """, rel=API)
+        assert rule_ids(res) == ["blocking-call-without-deadline"]
+
+    def test_future_result_with_timeout_clean(self):
+        res = run_ef("""
+            def handle(pool, job, timeout):
+                f = pool.submit(job)
+                return f.result(timeout)
+        """, rel=API)
+        assert rule_ids(res) == []
+
+    def test_queue_get_unbounded_flagged_bounded_clean(self):
+        res = run_ef("""
+            import queue
+
+            def handle(items):
+                q = queue.Queue()
+                for it in items:
+                    q.put(it)
+                return q.get()
+        """, rel=API)
+        assert rule_ids(res) == ["blocking-call-without-deadline"]
+        res = run_ef("""
+            import queue
+
+            def handle(items):
+                q = queue.Queue()
+                for it in items:
+                    q.put(it)
+                return q.get(timeout=1.0)
+        """, rel=API)
+        assert rule_ids(res) == []
+
+    def test_socket_send_flagged(self):
+        res = run_ef("""
+            def handle(sock, payload):
+                sock.sendall(payload)
+        """, rel=API)
+        assert rule_ids(res) == ["blocking-call-without-deadline"]
+
+    def test_deadline_param_exempts_blocking(self):
+        # a fn that takes (and so presumably threads) a deadline is
+        # trusted: per-path clamp proof is beyond the static model
+        res = run_ef("""
+            def handle(pool, job, deadline):
+                f = pool.submit(job)
+                return f.result()
+        """, rel=API)
+        assert rule_ids(res) == []
+
+    def test_blocking_outside_ingress_reach_not_flagged(self):
+        res = run_ef("""
+            def background_join(pool, job):
+                f = pool.submit(job)
+                return f.result()
+        """, rel=COLD)
+        assert rule_ids(res) == []
+
+
+# ---------------------------------------------------------------------------
+# errorflow: engine / cache / reporting integration
+
+
+class TestErrorFlowEngineIntegration:
+    def test_ingress_set_computation(self):
+        model = ef.analyze_sources({
+            API: "def handle(req):\n    return req\n",
+            CLUSTER: (
+                "class QueryDispatcher:\n"
+                "    def drain(self):\n"
+                "        return 1\n"
+                "\n"
+                "class Plain:\n"
+                "    def other(self):\n"
+                "        return 2\n"),
+        })
+        assert "weaviate_tpu.api.fake_rest::handle" in model.ingress
+        assert ("weaviate_tpu.cluster.fake::QueryDispatcher.drain"
+                in model.ingress)
+        assert "weaviate_tpu.cluster.fake::Plain.other" not in model.ingress
+
+    def test_select_excludes_errorflow(self):
+        res = run("""
+            class Node:
+                def digests(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    return r.get("digests")
+        """, rel=CLUSTER, rules=["swallowed-exception"])
+        assert rule_ids(res) == []
+
+    def test_errorflow_cache_cold_then_warm(self, tmp_path):
+        src = textwrap.dedent("""
+            class Node:
+                def digests(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    return r.get("digests")
+        """)
+        f = tmp_path / "mod.py"
+        f.write_text(src)
+        from tools.graftlint.engine import FileContext
+        cache = tmp_path / "ef_cache.json"
+
+        def once():
+            st = f.stat()
+            return ef.check_contexts(
+                {CLUSTER: FileContext(src, CLUSTER)},
+                {CLUSTER: (st.st_mtime_ns, st.st_size)},
+                cache_path=cache)
+
+        m1 = once()
+        assert m1.cache_state == "cold"
+        assert [v.rule for v in m1.violations] == ["unchecked-rpc-reply"]
+        m2 = once()
+        assert m2.cache_state == "warm"
+        assert [v.to_dict() for v in m2.violations] == \
+            [v.to_dict() for v in m1.violations]
+        assert set(m2.edges) == set(m1.edges)
+        assert m2.ingress == m1.ingress
+        import os as _os
+        _os.utime(f, ns=(f.stat().st_atime_ns, f.stat().st_mtime_ns + 7))
+        m3 = once()
+        assert m3.cache_state == "cold"
+
+    def test_errorflow_dot_output(self, tmp_path, capsys):
+        (tmp_path / "replies.py").write_text(textwrap.dedent("""
+            class Node:
+                def digests(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    return r.get("digests")
+        """))
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "b.json"),
+                       "--format", "errorflow-dot"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "digraph reply_taint" in out
+        assert "rpc:_send" in out
+
+    def test_sarif_covers_errorflow_rules(self, tmp_path, capsys):
+        (tmp_path / "weaviate_tpu").mkdir()
+        sub = tmp_path / "weaviate_tpu" / "cluster"
+        sub.mkdir()
+        (sub / "fake.py").write_text(textwrap.dedent("""
+            class Node:
+                def digests(self, rep):
+                    r = self._send(rep, {"type": "x"})
+                    return r.get("digests")
+        """))
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "b.json"),
+                       "--format", "sarif"])
+        assert rc == 1
+        doc = json.loads(capsys.readouterr().out)
+        results = doc["runs"][0]["results"]
+        assert any(r["ruleId"] == "unchecked-rpc-reply" for r in results)
+        rules = doc["runs"][0]["tool"]["driver"]["rules"]
+        meta = [r for r in rules if r["id"] == "unchecked-rpc-reply"]
+        assert meta and "reply" in meta[0]["shortDescription"]["text"]
+
+    def test_json_records_errorflow_walltime_and_cache(
+            self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("x = 1\n")
+        rc = cli_main([str(tmp_path), "--root", str(tmp_path),
+                       "--baseline", str(tmp_path / "b.json"),
+                       "--format", "json", "--no-concurrency-cache"])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert "errorflow_s" in doc["summary"]["timings"]
+        assert doc["summary"]["errorflow_cache"] == "off"

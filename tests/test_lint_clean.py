@@ -56,6 +56,22 @@ def test_baseline_is_empty():
         "regrow")
 
 
+def test_warm_lint_under_budget():
+    """Both whole-program passes ran (their wall-times are in the JSON
+    timings) and the full warm-cache tree lint stays inside the 15s
+    budget that keeps `make lint` a pre-commit habit rather than a CI
+    chore. The _lint() walk above ran with warm caches (they are
+    rebuilt by `make lint` and committed-adjacent), so total_s here is
+    the warm number."""
+    result, _, _ = _lint()
+    assert "concurrency_s" in result.timings
+    assert "errorflow_s" in result.timings
+    assert result.timings["total_s"] < 15.0, (
+        f"warm tree lint took {result.timings['total_s']:.1f}s — over the "
+        "15s budget; check the pass caches are keyed correctly "
+        "(.concurrency_cache.json / .errorflow_cache.json)")
+
+
 def test_suppressions_carry_reasons():
     # engine-level invariant: reasonless allows surface as violations of
     # suppression-missing-reason, which test_no_new_violations catches;

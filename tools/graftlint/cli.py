@@ -30,15 +30,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("paths", nargs="*", default=None,
                    help="files or directories to lint (default: the "
                         "repo's weaviate_tpu/, from any cwd)")
-    p.add_argument("--format", choices=("text", "json", "sarif", "dot"),
+    p.add_argument("--format",
+                   choices=("text", "json", "sarif", "dot",
+                            "errorflow-dot"),
                    default="text",
                    help="text/json: ratcheted report; sarif: SARIF 2.1.0 "
                         "of the NEW violations (CI code annotations); "
                         "dot: the interprocedural lock-order graph "
-                        "(graphviz)")
+                        "(graphviz); errorflow-dot: the reply-taint "
+                        "flow graph (same shape)")
     p.add_argument("--no-concurrency-cache", action="store_true",
-                   help="recompute the interprocedural concurrency model "
-                        "even when source mtimes match the cache")
+                   help="recompute the whole-program models (concurrency "
+                        "AND errorflow) even when source mtimes match "
+                        "their caches")
     p.add_argument("--baseline", type=Path,
                    default=baseline_mod.DEFAULT_BASELINE,
                    help="baseline file (default: tools/graftlint/"
@@ -115,6 +119,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(result.concurrency.to_dot())
         return 0
 
+    if args.format == "errorflow-dot":
+        if result.errorflow is None:
+            print("graftlint: --format errorflow-dot needs the errorflow "
+                  "pass (do not --select it away)", file=sys.stderr)
+            return 2
+        print(result.errorflow.to_dot())
+        return 0
+
     if args.fix_baseline:
         n = baseline_mod.write(args.baseline, result.violations)
         print(f"graftlint: wrote {n} baseline entries "
@@ -135,9 +147,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.format == "json":
         cache_state = (result.concurrency.cache_state
                        if result.concurrency is not None else None)
+        ef_cache = (result.errorflow.cache_state
+                    if result.errorflow is not None else None)
         print(render_json(new, baselined, stale, len(result.suppressed),
                           result.files_checked, timings=result.timings,
-                          concurrency_cache=cache_state))
+                          concurrency_cache=cache_state,
+                          errorflow_cache=ef_cache))
     elif args.format == "sarif":
         print(render_sarif(new, result.files_checked,
                            rules_meta=ALL_RULES))

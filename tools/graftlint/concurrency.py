@@ -59,7 +59,6 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-import json
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
@@ -1212,53 +1211,43 @@ def analyze_sources(sources: Dict[str, str]) -> ConcurrencyModel:
         {rel: FileContext(src, rel) for rel, src in sources.items()})
 
 
-def _cache_key(meta: Dict[str, Tuple[int, int]]) -> dict:
-    return {rel: list(mt) for rel, mt in sorted(meta.items())}
-
-
 def check_contexts(contexts: Dict[str, "FileContext"],
                    meta: Optional[Dict[str, Tuple[int, int]]] = None,
                    cache_path: Optional[Path] = None) -> ConcurrencyModel:
-    """Analysis with the mtime cache: ``meta`` maps rel path ->
-    (mtime_ns, size). A warm cache (identical version + file set +
-    stamps) replays the stored findings and edges without re-running
-    the pass; anything else recomputes and rewrites the cache."""
+    """Analysis with the shared mtime cache (``passcache``): ``meta``
+    maps rel path -> (mtime_ns, size). A warm cache (identical version
+    + file set + stamps) replays the stored findings and edges without
+    re-running the pass; anything else recomputes and rewrites."""
     import time as _time
+
+    from tools.graftlint import passcache
+
     t0 = _time.perf_counter()
-    if cache_path is not None and meta is not None and cache_path.exists():
+    data = passcache.load(cache_path, CONCURRENCY_VERSION, meta)
+    if data is not None:
         try:
-            data = json.loads(cache_path.read_text(encoding="utf-8"))
-            if (data.get("version") == CONCURRENCY_VERSION
-                    and data.get("files") == _cache_key(meta)):
-                model = ConcurrencyModel()
-                model.cache_state = "warm"
-                for d in data["violations"]:
-                    model.violations.append(Violation(**d))
-                for d in data["edges"]:
-                    e = Edge(**d)
-                    model.edges[(e.src, e.dst)] = e
-                for d in data["locks"]:
-                    ld = LockDef(**d)
-                    model.locks[ld.id] = ld
-                model.wall_s = _time.perf_counter() - t0
-                return model
+            model = ConcurrencyModel()
+            model.cache_state = "warm"
+            for d in data["violations"]:
+                model.violations.append(Violation(**d))
+            for d in data["edges"]:
+                e = Edge(**d)
+                model.edges[(e.src, e.dst)] = e
+            for d in data["locks"]:
+                ld = LockDef(**d)
+                model.locks[ld.id] = ld
+            model.wall_s = _time.perf_counter() - t0
+            return model
         except (ValueError, KeyError, TypeError):
-            pass  # malformed cache: recompute and overwrite
+            pass  # malformed payload: recompute and overwrite
     model = analyze_contexts(contexts)
     model.cache_state = "cold" if cache_path is not None else "off"
     model.wall_s = _time.perf_counter() - t0
-    if cache_path is not None and meta is not None:
-        payload = {
-            "version": CONCURRENCY_VERSION,
-            "files": _cache_key(meta),
-            "violations": [v.to_dict() for v in model.violations],
-            "edges": [dataclasses.asdict(e)
-                      for _, e in sorted(model.edges.items())],
-            "locks": [dataclasses.asdict(ld)
-                      for _, ld in sorted(model.locks.items())],
-        }
-        try:
-            cache_path.write_text(json.dumps(payload), encoding="utf-8")
-        except OSError:
-            pass  # read-only checkout: run uncached
+    passcache.store(cache_path, CONCURRENCY_VERSION, meta, {
+        "violations": [v.to_dict() for v in model.violations],
+        "edges": [dataclasses.asdict(e)
+                  for _, e in sorted(model.edges.items())],
+        "locks": [dataclasses.asdict(ld)
+                  for _, ld in sorted(model.locks.items())],
+    })
     return model

@@ -200,10 +200,13 @@ class LintResult:
     suppressed: List[Violation]
     files_checked: int = 1
     parse_errors: List[Violation] = dataclasses.field(default_factory=list)
-    # whole-program concurrency pass artifacts (None when not run):
-    # the ConcurrencyModel carries the lock-order graph (for --format
-    # dot) plus its wall time and cache state (for the JSON report)
+    # whole-program pass artifacts (None when not run): the
+    # ConcurrencyModel carries the lock-order graph (for --format dot)
+    # and the ErrorFlowModel the reply-taint graph (--format
+    # errorflow-dot); both carry wall time + cache state for the JSON
+    # report
     concurrency: Optional[object] = None
+    errorflow: Optional[object] = None
     timings: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
@@ -259,11 +262,15 @@ def _concurrency_selected(rules: Optional[Sequence[str]]) -> bool:
     return rules is None or bool(set(rules) & set(CONCURRENCY_RULE_IDS))
 
 
-def _run_concurrency(contexts, meta, cache_path, rules,
-                     kept: List[Violation],
-                     suppressed: List[Violation]):
-    from tools.graftlint import concurrency as conc
-    model = conc.check_contexts(contexts, meta, cache_path)
+def _errorflow_selected(rules: Optional[Sequence[str]]) -> bool:
+    from tools.graftlint.errorflow import ERRORFLOW_RULE_IDS
+    return rules is None or bool(set(rules) & set(ERRORFLOW_RULE_IDS))
+
+
+def _route_model(model, contexts, rules, kept: List[Violation],
+                 suppressed: List[Violation]):
+    """Route a whole-program model's findings through the same
+    suppression pipeline the per-file rules use."""
     selected = set(rules) if rules is not None else None
     for v in model.violations:
         if selected is not None and v.rule not in selected:
@@ -274,6 +281,22 @@ def _run_concurrency(contexts, meta, cache_path, rules,
         else:
             kept.append(v)
     return model
+
+
+def _run_concurrency(contexts, meta, cache_path, rules,
+                     kept: List[Violation],
+                     suppressed: List[Violation]):
+    from tools.graftlint import concurrency as conc
+    model = conc.check_contexts(contexts, meta, cache_path)
+    return _route_model(model, contexts, rules, kept, suppressed)
+
+
+def _run_errorflow(contexts, meta, cache_path, rules,
+                   kept: List[Violation],
+                   suppressed: List[Violation]):
+    from tools.graftlint import errorflow as ef
+    model = ef.check_contexts(contexts, meta, cache_path)
+    return _route_model(model, contexts, rules, kept, suppressed)
 
 
 def lint_source(source: str, rel_path: str,
@@ -300,10 +323,14 @@ def lint_source(source: str, rel_path: str,
     if _concurrency_selected(rules):
         concurrency = _run_concurrency(
             {ctx.rel_path: ctx}, None, None, rules, kept, suppressed)
+    errorflow = None
+    if _errorflow_selected(rules):
+        errorflow = _run_errorflow(
+            {ctx.rel_path: ctx}, None, None, rules, kept, suppressed)
     _flush_unused_suppressions(ctx, rules, kept)
     kept.sort(key=lambda v: (v.line, v.col, v.rule))
     return LintResult(violations=kept, suppressed=suppressed,
-                      concurrency=concurrency)
+                      concurrency=concurrency, errorflow=errorflow)
 
 
 def iter_python_files(paths: Sequence[str]) -> Iterable[Path]:
@@ -376,23 +403,33 @@ def lint_paths(paths: Sequence[str], root: Optional[Path] = None,
         _per_file_rules(ctx, rules, all_v, all_s)
 
     concurrency = None
+    errorflow = None
     timings: Dict[str, float] = {}
+    # the committed caches are only meaningful for the canonical full
+    # tree; fixture/tmp-path runs must not overwrite them
+    want = (repo_root() / "weaviate_tpu").resolve()
+    canonical = {Path(p).resolve() for p in paths} == {want}
     if _concurrency_selected(rules) and contexts:
         from tools.graftlint.concurrency import DEFAULT_CACHE
 
-        # the committed cache is only meaningful for the canonical full
-        # tree; fixture/tmp-path runs must not overwrite it
-        want = (repo_root() / "weaviate_tpu").resolve()
-        canonical = {Path(p).resolve() for p in paths} == {want}
         cache_path = (DEFAULT_CACHE
                       if concurrency_cache and canonical else None)
         concurrency = _run_concurrency(
             contexts, meta, cache_path, rules, all_v, all_s)
         timings["concurrency_s"] = round(concurrency.wall_s, 3)
+    if _errorflow_selected(rules) and contexts:
+        from tools.graftlint.errorflow import DEFAULT_CACHE as EF_CACHE
+
+        cache_path = (EF_CACHE
+                      if concurrency_cache and canonical else None)
+        errorflow = _run_errorflow(
+            contexts, meta, cache_path, rules, all_v, all_s)
+        timings["errorflow_s"] = round(errorflow.wall_s, 3)
     for ctx in contexts.values():
         _flush_unused_suppressions(ctx, rules, all_v)
     all_v.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     timings["total_s"] = round(_time.perf_counter() - t_start, 3)
     return LintResult(violations=all_v, suppressed=all_s,
                       files_checked=n, parse_errors=parse_errors,
-                      concurrency=concurrency, timings=timings)
+                      concurrency=concurrency, errorflow=errorflow,
+                      timings=timings)

@@ -1,0 +1,1246 @@
+"""Whole-program error-path + deadline-contract analysis.
+
+The second interprocedural pass (the first is ``concurrency.py``, whose
+call-graph machinery this reuses). It enforces the two contracts three
+PRs in a row had to re-fix by hand:
+
+**Reply taint** (`unchecked-rpc-reply`). Every value returned from
+``ClusterNode._call`` / ``_send`` / ``retrying_call``, from a fan-out
+result queue, or from a blob-store ``get`` is *tainted*: it may be the
+error shape (``{"error": ...}`` / a raised ``KeyError`` for blobs)
+rather than data. Taint follows assignment, tuple unpack, queue
+put/get (element-wise for tuple payloads), and helper returns. It is
+cleared only by a **sanitizer**:
+
+- flowing through ``_expect(reply, key, peer)`` or any registered
+  validator (``# graftlint: reply-validator`` on the def line, or
+  :func:`register_validator`),
+- an explicit error-key read — ``r.get("ok")`` / ``r["error"]`` /
+  ``"ok" in r`` style membership tests,
+- for blob gets: a lexically enclosing ``try`` whose handlers catch
+  the absence (``KeyError`` / ``BlobStoreError`` / broader).
+
+Field access or truthiness-as-success on a tainted reply is the PR 10
+bug shape (an error reply read as a verified zero) and is flagged —
+SEV_ERROR under ``cluster/``, ``backup/``, ``tiering/``, SEV_WARNING
+elsewhere. A *discarded* reply is deliberately not flagged (fire-and-
+forget best-effort sends are legitimate; acting on the value without
+checking it is not).
+
+**Budget propagation**. The serving ingress set — REST/gRPC handler
+methods (classes named ``*API`` under ``weaviate_tpu/api/``),
+dispatcher drain (``*Dispatcher`` methods), cycle-runner tasks
+(functions registered via ``<cycles>.register("name", fn)``), plus any
+def marked ``# graftlint: ingress`` — is computed, then closed over
+the call graph. Inside that closure:
+
+- `budget-minted-in-flight` (SEV_WARNING): constructing a fresh
+  ``Deadline(...)`` instead of threading ``_op_deadline`` /
+  ``RequestContext``. Exempt: the function that *installs* the
+  ``RequestContext`` (that IS the ingress mint) and ``_op_deadline``
+  itself (the sanctioned fallback mint for non-serving callers).
+- `blocking-call-without-deadline` (SEV_ERROR): a blocking primitive
+  (``queue.get``, ``Future.result``, bare ``.wait()``, socket
+  recv/accept/sendall/connect, blob I/O) with no timeout argument, in
+  a function that neither receives a ``deadline``/``timeout`` nor
+  touches the deadline machinery — i.e. no clamp exists on any path.
+
+Results are cached through the same ``passcache`` sidecar mechanism as
+the concurrency pass (``.errorflow_cache.json``, keyed on
+``ERRORFLOW_VERSION`` + source mtimes) and rendered as a reply-taint
+flow graph by ``to_dot()`` (same dot shape as the lock-order graph).
+See docs/lint.md "Error-path contracts" for the full model and the
+triage record of the first tree-wide run.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.graftlint import concurrency as conc
+from tools.graftlint.rules import (
+    SEV_ERROR,
+    SEV_WARNING,
+    Violation,
+    dotted_name,
+)
+
+# bump to invalidate caches when the analysis itself changes
+ERRORFLOW_VERSION = 1
+
+UNCHECKED_RPC_REPLY = "unchecked-rpc-reply"
+BUDGET_MINTED_IN_FLIGHT = "budget-minted-in-flight"
+BLOCKING_CALL_WITHOUT_DEADLINE = "blocking-call-without-deadline"
+ERRORFLOW_RULE_IDS = (
+    UNCHECKED_RPC_REPLY, BUDGET_MINTED_IN_FLIGHT,
+    BLOCKING_CALL_WITHOUT_DEADLINE)
+
+DEFAULT_CACHE = Path(__file__).with_name(".errorflow_cache.json")
+
+# calls whose return value is an RPC reply dict (the taint sources);
+# matched by simple name for both attribute (`self.node._call(...)`)
+# and bare (`retrying_call(...)`) call forms
+REPLY_SOURCE_NAMES = frozenset({"_call", "_send", "retrying_call"})
+
+# reading one of these keys IS the error check — it clears the taint
+SANITIZER_KEYS = frozenset({"ok", "error", "status"})
+
+# blob-store access: `<recv>.get(...)` where the receiver name hints at
+# a blob store; absence surfaces as an exception, so the sanitizer is a
+# lexically enclosing try whose handlers catch it. The heuristic is
+# scoped to the modules that actually speak the BlobStore contract —
+# "store"-named receivers elsewhere (hfresh's vector store, dict
+# registries) have no absence-as-exception semantics to check
+BLOB_GET_ATTRS = frozenset({"get", "get_to_file"})
+BLOB_IO_ATTRS = frozenset({
+    "get", "get_to_file", "put", "put_file", "list", "delete"})
+_BLOB_RECV_HINTS = ("store", "blob")
+_BLOB_DIRS = (
+    "weaviate_tpu/tiering/", "weaviate_tpu/backup/", "weaviate_tpu/storage/")
+_BLOB_EXC_NAMES = frozenset({
+    "KeyError", "LookupError", "BlobStoreError", "OSError", "Exception",
+    "BaseException"})
+
+# per-directory severity escalation: an unverified reply in the
+# replication/backup/tiering planes can flip data or drop objects
+CRITICAL_REPLY_DIRS = (
+    "weaviate_tpu/cluster/", "weaviate_tpu/backup/", "weaviate_tpu/tiering/")
+
+# name-based validators always on: `_expect` raises on error replies,
+# `_fan_out` returns only ok()-checked replies
+DEFAULT_VALIDATORS = frozenset({"_expect", "_fan_out"})
+
+_VALIDATOR_MARK_RE = re.compile(r"#\s*graftlint:\s*reply-validator\b")
+_INGRESS_MARK_RE = re.compile(r"#\s*graftlint:\s*ingress\b")
+# on a def whose NAME matches a reply source but whose error channel is
+# an exception (it never returns an error-shaped dict) — e.g. the
+# external-API `_APIBase._call`, which raises ModuleNotAvailable
+_RAISES_MARK_RE = re.compile(r"#\s*graftlint:\s*reply-raises\b")
+
+_registered_validators: Set[str] = set()
+
+
+def register_validator(name: str) -> None:
+    """Register a reply-validator by simple function name (conftest /
+    plugin hook). Prefer the in-source ``# graftlint: reply-validator``
+    marker for project code — it keeps the contract next to the def."""
+    _registered_validators.add(name)
+
+
+def clear_registered_validators() -> None:
+    _registered_validators.clear()
+
+
+def validator_names() -> frozenset:
+    return DEFAULT_VALIDATORS | frozenset(_registered_validators)
+
+
+# ---------------------------------------------------------------------------
+# model
+
+
+@dataclasses.dataclass
+class TaintEdge:
+    src: str             # function key or pseudo source node ("rpc:_send")
+    dst: str
+    path: str
+    line: int
+    kind: str = "return"  # source | return | queue
+
+
+class ErrorFlowModel:
+    """The computed model: taint flow edges, the ingress closure, and
+    the derived findings."""
+
+    def __init__(self):
+        self.violations: List[Violation] = []
+        self.edges: Dict[Tuple[str, str], TaintEdge] = {}
+        self.ingress: Dict[str, str] = {}       # fn key -> root kind
+        self.reachable: Set[str] = set()        # ingress closure
+        self.tainted_fns: Set[str] = set()      # keys whose return is tainted
+        self.cache_state: str = "off"           # off | cold | warm
+        self.wall_s: float = 0.0
+
+    def to_dot(self) -> str:
+        """The reply-taint flow graph in graphviz dot form — same shape
+        as the lock-order graph so the two can sit side by side; nodes
+        with unverified-reply findings are red."""
+        bad = {f"{v.path}::{v.symbol}" for v in self.violations
+               if v.rule == UNCHECKED_RPC_REPLY}
+        bad_keys = set()
+        nodes: Set[str] = set()
+        for (s, d) in self.edges:
+            nodes.add(s)
+            nodes.add(d)
+        out = ["digraph reply_taint {", "  rankdir=LR;",
+               '  node [shape=box, fontsize=10];']
+        for n in sorted(nodes):
+            shape = "ellipse" if ":" in n.split("::")[0] else "box"
+            e = self._node_edge(n)
+            is_bad = e is not None and f"{e.path}::{_symbol_of(n)}" in bad
+            if is_bad:
+                bad_keys.add(n)
+            color = ' color=red penwidth=2' if is_bad else ""
+            out.append(f'  "{n}" [shape={shape}{color}];')
+        for (s, d) in sorted(self.edges):
+            e = self.edges[(s, d)]
+            color = (' color=red penwidth=2'
+                     if s in bad_keys or d in bad_keys else "")
+            out.append(
+                f'  "{s}" -> "{d}" '
+                f'[label="{e.path}:{e.line}", fontsize=8{color}];')
+        out.append("}")
+        return "\n".join(out)
+
+    def _node_edge(self, node: str) -> Optional[TaintEdge]:
+        for (s, d), e in self.edges.items():
+            if d == node or s == node:
+                return e
+        return None
+
+
+def _symbol_of(key: str) -> str:
+    return key.split("::", 1)[-1]
+
+
+# ---------------------------------------------------------------------------
+# per-function extraction
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    key: str
+    module: str
+    qual: str
+    name: str
+    path: str
+    line: int
+    cls: Optional[str]
+    events: List[tuple] = dataclasses.field(default_factory=list)
+    calls: List[tuple] = dataclasses.field(default_factory=list)
+    cycle_regs: List[tuple] = dataclasses.field(default_factory=list)
+    mints: List[Tuple[int, str]] = dataclasses.field(default_factory=list)
+    blocking: List[Tuple[int, str, bool, str]] = \
+        dataclasses.field(default_factory=list)
+    installs_ctx: bool = False
+    mentions_deadline: bool = False
+    is_validator: bool = False
+    ingress_marked: bool = False
+    raises_marked: bool = False
+
+
+class _TaintScanner:
+    """One top-level function (nested defs and lambdas scanned inline —
+    closures run later but share the enclosing taint facts, which is
+    exactly what the fan-out worker/drain split needs)."""
+
+    def __init__(self, fm: "conc._FileModel", conc_f, fn: _FnInfo,
+                 node: ast.AST):
+        self.fm = fm
+        self.ctx = fm.ctx
+        self.conc_f = conc_f
+        self.fn = fn
+        self.node = node
+        self.param_types: Dict[str, str] = {}
+        self._scan_params(node)
+        self.scan_body(node.body)
+
+    # -- setup -----------------------------------------------------------
+
+    def _scan_params(self, node) -> None:
+        args = (node.args.args + node.args.kwonlyargs
+                + node.args.posonlyargs)
+        if any(a.arg in ("deadline", "timeout") for a in args):
+            self.fn.mentions_deadline = True
+        for a in args:
+            ann = a.annotation
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                self.param_types[a.arg] = ann.value.rsplit(".", 1)[-1]
+            else:
+                dn = dotted_name(ann) if ann is not None else None
+                if dn:
+                    self.param_types[a.arg] = dn.rsplit(".", 1)[-1]
+
+    # -- classification helpers -----------------------------------------
+
+    @staticmethod
+    def _call_name(call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        if isinstance(call.func, ast.Name):
+            return call.func.id
+        return None
+
+    def _source_info(self, call: ast.Call) -> Optional[tuple]:
+        """(detail, simple-name, receiver-hint) when the call is named
+        like a reply source, else None. The hint lets the analyzer
+        resolve the actual target and honor ``reply-raises`` markers —
+        a receiver it cannot type stays a source (conservative)."""
+        name = self._call_name(call)
+        if name not in REPLY_SOURCE_NAMES:
+            return None
+        hint = None
+        if isinstance(call.func, ast.Attribute):
+            recv = call.func.value
+            if isinstance(recv, ast.Name):
+                if recv.id == "self" and self.fn.cls:
+                    hint = ("self", self.fn.cls)
+                elif recv.id in self.param_types:
+                    hint = ("cls", self.param_types[recv.id])
+        return (self._detail(call), name, hint)
+
+    def _is_blob_recv(self, recv: ast.AST) -> bool:
+        if not self.fn.path.startswith(_BLOB_DIRS):
+            return False
+        dn = dotted_name(recv)
+        if dn is None:
+            return False
+        leaf = dn.rsplit(".", 1)[-1].lower()
+        return any(h in leaf for h in _BLOB_RECV_HINTS)
+
+    def _is_blob_call(self, call: ast.Call, attrs: frozenset) -> bool:
+        # every BlobStore verb takes the key (or prefix/path) positionally;
+        # a zero-arg .get() is a DynamicValue/config read, not blob I/O
+        return (isinstance(call.func, ast.Attribute)
+                and call.func.attr in attrs
+                and bool(call.args)
+                and self._is_blob_recv(call.func.value))
+
+    def _is_queue_recv(self, recv: ast.AST) -> bool:
+        f = self.conc_f
+        if f is None:
+            return False
+        if isinstance(recv, ast.Name):
+            return recv.id in f.local_queues
+        if isinstance(recv, ast.Attribute) and \
+                isinstance(recv.value, ast.Name) and \
+                recv.value.id == "self" and f.cls:
+            return recv.attr in self.fm.queue_attrs.get(f.cls, set())
+        return False
+
+    def _is_deadline_mint(self, call: ast.Call) -> bool:
+        dn = dotted_name(call.func)
+        if dn is None:
+            return False
+        if dn.endswith(".after"):
+            dn = dn[:-len(".after")]
+        canon = self.fm._canonical(dn) or dn
+        return (canon == "Deadline"
+                or canon.endswith("resilience.Deadline"))
+
+    def _installs_ctx(self, call: ast.Call) -> bool:
+        dn = dotted_name(call.func)
+        return dn is not None and dn.rsplit(".", 1)[-1] == "RequestContext"
+
+    def _in_blob_guard(self, call: ast.Call) -> bool:
+        """Whether an enclosing try's handlers catch blob absence."""
+        for parent, field in self.ctx.ancestry(call):
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)) and parent is not self.node:
+                # deferred body: runs under whoever invokes the closure
+                # (commonly retrying_call with a deadline + retry_on)
+                return True
+            if isinstance(parent, ast.Try) and field == "body":
+                for h in parent.handlers:
+                    names = []
+                    t = h.type
+                    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                    for e in elts:
+                        d = dotted_name(e) if e is not None else None
+                        if d:
+                            names.append(d.rsplit(".", 1)[-1])
+                    if t is None or any(n in _BLOB_EXC_NAMES
+                                        for n in names):
+                        return True
+        return False
+
+    def _detail(self, node: ast.AST) -> str:
+        dn = dotted_name(getattr(node, "func", node))
+        return f"{dn or '<expr>'}(...)" if isinstance(node, ast.Call) \
+            else (dn or "<expr>")
+
+    # -- statement walk --------------------------------------------------
+
+    def scan_body(self, stmts: Sequence[ast.stmt]) -> None:
+        for st in stmts:
+            self.stmt(st)
+
+    def stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: scanned inline (shared event stream, see class
+            # docstring); its params may carry the deadline too
+            self._scan_params(st)
+            self.scan_body(st.body)
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        if isinstance(st, ast.Assign):
+            self._assign(st.targets, st.value, st.lineno)
+            return
+        if isinstance(st, ast.AnnAssign) and st.value is not None:
+            self._assign([st.target], st.value, st.lineno)
+            return
+        if isinstance(st, ast.AugAssign):
+            self.scan_uses(st.value)
+            return
+        if isinstance(st, ast.Expr):
+            if isinstance(st.value, ast.Call):
+                self._bare_call(st.value)
+            else:
+                self.scan_uses(st.value)
+            return
+        if isinstance(st, ast.Return):
+            if st.value is not None:
+                spec = self.value_spec(st.value)
+                self.fn.events.append(("ret", spec, st.lineno))
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self.test_uses(st.test)
+            self.scan_body(st.body)
+            self.scan_body(st.orelse)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            spec = self.value_spec(st.iter)
+            if spec[0] == "name":
+                self.fn.events.append(
+                    ("use", spec[1], "iter", st.lineno,
+                     f"for ... in {spec[1]}"))
+            elif spec[0] == "source":
+                self.fn.events.append(
+                    ("usedirect", "iter", st.lineno, spec[1],
+                     tuple(spec[1:])))
+            self.scan_body(st.body)
+            self.scan_body(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.scan_uses(item.context_expr)
+            self.scan_body(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self.scan_body(st.body)
+            for h in st.handlers:
+                self.scan_body(h.body)
+            self.scan_body(st.orelse)
+            self.scan_body(st.finalbody)
+            return
+        if isinstance(st, ast.Assert):
+            self.test_uses(st.test)
+            return
+        if isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self.scan_uses(st.exc)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    self.fn.events.append(("san", t.id, st.lineno))
+            return
+        # anything else: scan contained expressions generically
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self.scan_uses(child)
+
+    # -- assignment ------------------------------------------------------
+
+    def _assign(self, targets: Sequence[ast.AST], value: ast.AST,
+                line: int) -> None:
+        spec = self.value_spec(value)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.fn.events.append(
+                    ("assign", ("name", t.id), spec, line))
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                names = [e.id if isinstance(e, ast.Name) else None
+                         for e in t.elts]
+                self.fn.events.append(
+                    ("assign", ("names", names), spec, line))
+            else:
+                # attribute/subscript target: evaluate for side effects
+                self.scan_uses(t)
+
+    # -- call handling ---------------------------------------------------
+
+    def _record_call(self, call: ast.Call) -> Optional[tuple]:
+        """Shared bookkeeping for every call node: call-graph edge,
+        deadline mint, ctx install, blocking site, cycle registration,
+        validator-args event. Returns the call descriptor (or None)."""
+        func = call.func
+        if self._is_deadline_mint(call):
+            self.fn.mints.append((call.lineno, self._detail(call)))
+            self.fn.mentions_deadline = True
+        if self._installs_ctx(call):
+            self.fn.installs_ctx = True
+        name = self._call_name(call)
+        if name in ("_op_deadline", "current_deadline", "retrying_call"):
+            self.fn.mentions_deadline = True
+        self._record_blocking(call)
+        self._record_cycle_reg(call)
+        self._record_qput(call)
+        desc = self._descriptor(call)
+        if desc is not None:
+            self.fn.calls.append(desc)
+            argnames = [a.id for a in call.args
+                        if isinstance(a, ast.Name)]
+            if argnames:
+                self.fn.events.append(
+                    ("args", desc, argnames, call.lineno))
+        return desc
+
+    @staticmethod
+    def _descriptor(call: ast.Call) -> Optional[tuple]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return ("name", func.id)
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self":
+                return ("self", func.attr)
+            dn = dotted_name(func)
+            if dn is not None:
+                return ("dotted", dn)
+            return ("attr", func.attr)
+        return None
+
+    def _has_timeout_kw(self, call: ast.Call) -> bool:
+        return any(kw.arg == "timeout" for kw in call.keywords)
+
+    def _record_blocking(self, call: ast.Call) -> None:
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return
+        attr, recv = func.attr, func.value
+        line = call.lineno
+        detail = f"{dotted_name(recv) or '<expr>'}.{attr}()"
+        if attr == "result":
+            bounded = bool(call.args) or self._has_timeout_kw(call)
+            self.fn.blocking.append((line, "future-result", bounded, detail))
+        elif attr == "get" and self._is_queue_recv(recv):
+            bounded = bool(call.args) or self._has_timeout_kw(call)
+            self.fn.blocking.append((line, "queue-get", bounded, detail))
+        elif attr == "wait":
+            bounded = bool(call.args) or self._has_timeout_kw(call)
+            self.fn.blocking.append((line, "wait", bounded, detail))
+        elif attr in ("recv", "accept", "sendall", "connect",
+                      "create_connection"):
+            bounded = self._has_timeout_kw(call)
+            self.fn.blocking.append((line, "socket", bounded, detail))
+        elif self._is_blob_call(call, BLOB_IO_ATTRS):
+            # blob I/O has no timeout parameter at all; the only clamp
+            # is a deadline threaded into the enclosing function
+            self.fn.blocking.append((line, "blob-io", False, detail))
+
+    def _pure_spec(self, expr: ast.AST) -> tuple:
+        """Side-effect-free value spec (no event emission) for put
+        payloads — the generic use-scan records the contained calls."""
+        if isinstance(expr, ast.Name):
+            return ("name", expr.id)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return ("tuple", [self._pure_spec(e) for e in expr.elts])
+        if isinstance(expr, ast.Call):
+            si = self._source_info(expr)
+            if si is not None:
+                return ("source",) + si
+        return ("clean",)
+
+    def _record_qput(self, call: ast.Call) -> None:
+        func = call.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in ("put", "put_nowait")
+                and self._is_queue_recv(func.value)):
+            return
+        if not call.args:
+            return
+        qn = dotted_name(func.value) or "<queue>"
+        payload = call.args[0]
+        specs = ([self._pure_spec(e) for e in payload.elts]
+                 if isinstance(payload, (ast.Tuple, ast.List))
+                 else [self._pure_spec(payload)])
+        self.fn.events.append(("qput", qn, specs, call.lineno))
+
+    def _record_cycle_reg(self, call: ast.Call) -> None:
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "register"):
+            return
+        if len(call.args) < 2:
+            return
+        if not (isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            return
+        target = call.args[1]
+        if isinstance(target, ast.Name):
+            self.fn.cycle_regs.append(("name", target.id))
+        elif isinstance(target, ast.Attribute):
+            desc = self._descriptor(ast.Call(func=target, args=[],
+                                             keywords=[]))
+            if desc is not None:
+                self.fn.cycle_regs.append(desc)
+
+    def _bare_call(self, call: ast.Call) -> None:
+        """Statement-level call: replies may be discarded, blob gets
+        must still be guarded, validator args still sanitize."""
+        if self._is_blob_call(call, BLOB_GET_ATTRS) \
+                and not self._in_blob_guard(call):
+            self.fn.events.append(
+                ("usedirect", "blob-get", call.lineno, self._detail(call),
+                 None))
+        self._record_call(call)
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            self.scan_uses(a)
+
+    # -- value specs (assign/return RHS) ---------------------------------
+
+    def value_spec(self, expr: ast.AST) -> tuple:
+        if isinstance(expr, ast.Await):
+            return self.value_spec(expr.value)
+        if isinstance(expr, ast.Name):
+            return ("name", expr.id)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return ("tuple", [self.value_spec(e) for e in expr.elts])
+        if isinstance(expr, ast.IfExp):
+            self.test_uses(expr.test)
+            return ("either", self.value_spec(expr.body),
+                    self.value_spec(expr.orelse))
+        if isinstance(expr, ast.Call):
+            return self._call_spec(expr)
+        self.scan_uses(expr)
+        return ("clean",)
+
+    def _maybe_field_get(self, call: ast.Call) -> bool:
+        """Handle the ``<reply>.get("key")`` read pattern (san if the
+        key is an error key, use otherwise). True when handled."""
+        func = call.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "get"
+                and call.args
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)
+                and not self._is_queue_recv(func.value)
+                and not self._is_blob_recv(func.value)):
+            return False
+        keyname = call.args[0].value
+        if isinstance(func.value, ast.Name):
+            if keyname in SANITIZER_KEYS:
+                self.fn.events.append(
+                    ("san", func.value.id, call.lineno))
+            else:
+                self.fn.events.append(
+                    ("use", func.value.id, "field", call.lineno,
+                     f"{func.value.id}.get({keyname!r})"))
+            self._record_call(call)
+            return True
+        if isinstance(func.value, ast.Call):
+            si = self._source_info(func.value)
+            if si is not None:
+                self._record_call(func.value)
+                if keyname not in SANITIZER_KEYS:
+                    self.fn.events.append(
+                        ("usedirect", "field", call.lineno, si[0], si))
+                return True
+        return False
+
+    def _call_spec(self, call: ast.Call) -> tuple:
+        if self._maybe_field_get(call):
+            for a in list(call.args)[1:] + [kw.value for kw in
+                                            call.keywords]:
+                self.scan_uses(a)
+            return ("clean",)
+        si = self._source_info(call)
+        if si is not None:
+            self._record_call(call)
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                self.scan_uses(a)
+            return ("source",) + si
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "get" \
+                and self._is_queue_recv(call.func.value):
+            self._record_blocking(call)
+            qn = dotted_name(call.func.value) or "<queue>"
+            return ("qget", qn)
+        if self._is_blob_call(call, BLOB_GET_ATTRS):
+            if not self._in_blob_guard(call):
+                self.fn.events.append(
+                    ("usedirect", "blob-get", call.lineno,
+                     self._detail(call), None))
+            self._record_call(call)
+            for a in list(call.args) + [kw.value for kw in call.keywords]:
+                self.scan_uses(a)
+            return ("clean",)
+        desc = self._record_call(call)
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            self.scan_uses(a)
+        if desc is not None:
+            return ("call", desc)
+        return ("clean",)
+
+    # -- generic expression scanning -------------------------------------
+
+    def test_uses(self, test: ast.AST) -> None:
+        """If/while/assert condition: bare tainted names and non-
+        sanitizer ``.get`` reads here are truthiness-as-success."""
+        if isinstance(test, ast.Name):
+            self.fn.events.append(
+                ("use", test.id, "truthy", test.lineno, f"if {test.id}:"))
+            return
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            self.test_uses(test.operand)
+            return
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                self.test_uses(v)
+            return
+        if isinstance(test, ast.Call):
+            si = self._source_info(test)
+            if si is not None:
+                self._record_call(test)
+                self.fn.events.append(
+                    ("usedirect", "truthy", test.lineno, si[0], si))
+                return
+        self.scan_uses(test)
+
+    def scan_uses(self, expr: ast.AST) -> None:
+        """Walk an expression emitting san/use events in source order.
+        Nested lambdas are scanned inline (same rationale as nested
+        defs)."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Subscript):
+                self._subscript(node)
+            elif isinstance(node, ast.Call):
+                self._use_call(node)
+            elif isinstance(node, ast.Compare):
+                self._compare(node)
+
+    def _subscript(self, node: ast.Subscript) -> None:
+        key = node.slice
+        keyname = (key.value
+                   if isinstance(key, ast.Constant)
+                   and isinstance(key.value, str) else None)
+        if isinstance(node.value, ast.Name):
+            if keyname in SANITIZER_KEYS:
+                self.fn.events.append(
+                    ("san", node.value.id, node.lineno))
+            else:
+                self.fn.events.append(
+                    ("use", node.value.id, "field", node.lineno,
+                     f"{node.value.id}[{keyname!r}]" if keyname
+                     else f"{node.value.id}[...]"))
+        elif isinstance(node.value, ast.Call):
+            si = self._source_info(node.value)
+            if si is not None and keyname not in SANITIZER_KEYS:
+                self.fn.events.append(
+                    ("usedirect", "field", node.lineno, si[0], si))
+
+    def _use_call(self, call: ast.Call) -> None:
+        if self._maybe_field_get(call):
+            return
+        if self._is_blob_call(call, BLOB_GET_ATTRS) \
+                and not self._in_blob_guard(call):
+            self.fn.events.append(
+                ("usedirect", "blob-get", call.lineno, self._detail(call),
+                 None))
+        self._record_call(call)
+
+    def _compare(self, node: ast.Compare) -> None:
+        # `"digests" in r` / `"x" not in r`: an explicit presence check —
+        # the code has a branch for the missing-key case
+        if len(node.ops) == 1 and isinstance(node.ops[0],
+                                             (ast.In, ast.NotIn)):
+            right = node.comparators[0]
+            if isinstance(right, ast.Name):
+                self.fn.events.append(("san", right.id, node.lineno))
+
+
+# ---------------------------------------------------------------------------
+# global analysis
+
+
+class Analyzer:
+    """Builds per-function taint summaries on top of the concurrency
+    pass's file models + call resolution, then runs the fixpoint and
+    derives findings."""
+
+    def __init__(self, contexts: Dict[str, "FileContext"]):
+        self.conc = conc.Analyzer(contexts)
+        self.fns: Dict[str, _FnInfo] = {}
+        self._fm_of: Dict[str, conc._FileModel] = {}
+        self._cf_of: Dict[str, object] = {}
+        # simple single-inheritance view for method lookup through
+        # project base classes (the reply-raises marker on a base's
+        # `_call` must cover every subclass receiver)
+        self.class_bases: Dict[Tuple[str, str], List[str]] = {}
+        self.class_sites: Dict[str, Set[str]] = {}
+        for rel, fm in self.conc.files.items():
+            self._extract_file(fm)
+
+    # -- extraction ------------------------------------------------------
+
+    def _extract_file(self, fm: "conc._FileModel") -> None:
+        ctx = fm.ctx
+        vnames = validator_names()
+        for st in ctx.tree.body:
+            if isinstance(st, ast.ClassDef):
+                bases = []
+                for b in st.bases:
+                    dn = dotted_name(b)
+                    if dn:
+                        bases.append(dn.rsplit(".", 1)[-1])
+                self.class_bases[(fm.module, st.name)] = bases
+                self.class_sites.setdefault(st.name, set()).add(fm.module)
+        for node, qual, cls in self._iter_defs(ctx):
+            key = f"{fm.module}::{qual}"
+            defline = ctx.lines[node.lineno - 1] \
+                if node.lineno <= len(ctx.lines) else ""
+            fn = _FnInfo(
+                key=key, module=fm.module, qual=qual, name=node.name,
+                path=fm.rel_path, line=node.lineno, cls=cls,
+                is_validator=(node.name in vnames
+                              or bool(_VALIDATOR_MARK_RE.search(defline))),
+                ingress_marked=bool(_INGRESS_MARK_RE.search(defline)),
+                raises_marked=bool(_RAISES_MARK_RE.search(defline)))
+            conc_f = fm.funcs.get(qual)
+            _TaintScanner(fm, conc_f, fn, node)
+            self.fns[key] = fn
+            self._fm_of[key] = fm
+            self._cf_of[key] = conc_f
+
+    @staticmethod
+    def _iter_defs(ctx):
+        """Top-level defs + methods (nested defs are scanned inline by
+        the owner's scanner, matching closure semantics)."""
+        for st in ctx.tree.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield st, st.name, None
+            elif isinstance(st, ast.ClassDef):
+                for sub in st.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        yield sub, f"{st.name}.{sub.name}", st.name
+
+    # -- resolution ------------------------------------------------------
+
+    def resolve(self, key: str, desc: tuple) -> List[str]:
+        fm = self._fm_of.get(key)
+        if fm is None:
+            return []
+        keys = self.conc.resolve_call(fm, self._cf_of.get(key), desc)
+        return [k for k in keys if k in self.fns]
+
+    def _is_validator_call(self, key: str, desc: tuple) -> bool:
+        simple = str(desc[-1]).rsplit(".", 1)[-1]
+        if simple in validator_names():
+            return True
+        return any(self.fns[k].is_validator
+                   for k in self.resolve(key, desc))
+
+    def _lookup_method(self, module: str, cls: str, name: str,
+                       depth: int = 0) -> Optional[str]:
+        """Resolve ``cls.name`` through the project class hierarchy
+        (same module first, then uniquely-named classes elsewhere)."""
+        if depth > 8:
+            return None
+        k = f"{module}::{cls}.{name}"
+        if k in self.fns:
+            return k
+        for base in self.class_bases.get((module, cls), ()):
+            r = self._lookup_method(module, base, name, depth + 1)
+            if r is not None:
+                return r
+            for m in self.class_sites.get(base, ()):
+                if m != module:
+                    r = self._lookup_method(m, base, name, depth + 1)
+                    if r is not None:
+                        return r
+        return None
+
+    def _source_is_reply(self, key: str, name: str,
+                         hint: Optional[tuple]) -> bool:
+        """Whether a source-named call actually yields a reply-shaped
+        value. False only when the receiver resolves to a function
+        marked ``# graftlint: reply-raises`` (error channel is an
+        exception); unresolvable receivers stay sources."""
+        if hint is None:
+            return True
+        fn = self.fns.get(key)
+        if fn is None:
+            return True
+        target = self._lookup_method(fn.module, hint[1], name)
+        if target is None and hint[0] == "cls":
+            mods = self.class_sites.get(hint[1], set())
+            if len(mods) == 1:
+                target = self._lookup_method(
+                    next(iter(mods)), hint[1], name)
+        if target is not None and self.fns[target].raises_marked:
+            return False
+        return True
+
+    # -- taint replay ----------------------------------------------------
+
+    def _spec_taint(self, key: str, spec: tuple, tainted: Dict[str, str],
+                    qtaint: Dict[str, Set[int]],
+                    returns_tainted: Set[str]) -> Optional[str]:
+        kind = spec[0]
+        if kind == "source":
+            if self._source_is_reply(key, spec[2], spec[3]):
+                return spec[1]
+            return None
+        if kind == "name":
+            return tainted.get(spec[1])
+        if kind == "qget":
+            return f"reply from queue {spec[1]}" \
+                if qtaint.get(spec[1]) else None
+        if kind == "call":
+            desc = spec[1]
+            if self._is_validator_call(key, desc):
+                return None
+            for k in self.resolve(key, desc):
+                if k in returns_tainted:
+                    return f"return of {_symbol_of(k)}"
+            return None
+        if kind == "either":
+            return (self._spec_taint(key, spec[1], tainted, qtaint,
+                                     returns_tainted)
+                    or self._spec_taint(key, spec[2], tainted, qtaint,
+                                        returns_tainted))
+        if kind == "tuple":
+            for s in spec[1]:
+                origin = self._spec_taint(key, s, tainted, qtaint,
+                                          returns_tainted)
+                if origin:
+                    return origin
+            return None
+        return None
+
+    def _replay(self, fn: _FnInfo, returns_tainted: Set[str],
+                emit: Optional[list]) -> bool:
+        """Interpret the event stream; returns whether the function's
+        return value is tainted. ``emit`` collects (event, origin)
+        violations on the final pass."""
+        key = fn.key
+        tainted: Dict[str, str] = {}
+        qtaint: Dict[str, Set[int]] = {}
+        rt = False
+        for ev in fn.events:
+            k = ev[0]
+            if k == "san":
+                tainted.pop(ev[1], None)
+            elif k == "args":
+                _, desc, names, _line = ev
+                if self._is_validator_call(key, desc):
+                    for n in names:
+                        tainted.pop(n, None)
+            elif k == "use":
+                _, name, ukind, line, detail = ev
+                origin = tainted.get(name)
+                if origin and emit is not None:
+                    emit.append((fn, ukind, line, detail, origin))
+            elif k == "usedirect":
+                _, ukind, line, detail, srcinfo = ev
+                if srcinfo is not None and not self._source_is_reply(
+                        key, srcinfo[1], srcinfo[2]):
+                    continue
+                if emit is not None:
+                    emit.append((fn, ukind, line, detail, detail))
+            elif k == "assign":
+                _, tgt, spec, line = ev
+                self._do_assign(fn, tgt, spec, line, tainted, qtaint,
+                                returns_tainted)
+            elif k == "qput":
+                _, qn, specs, _line = ev
+                pos = qtaint.setdefault(qn, set())
+                for i, s in enumerate(specs):
+                    if self._spec_taint(key, s, tainted, qtaint,
+                                        returns_tainted):
+                        pos.add(i)
+            elif k == "ret":
+                _, spec, _line = ev
+                if self._spec_taint(key, spec, tainted, qtaint,
+                                    returns_tainted):
+                    rt = True
+        return rt
+
+    def _do_assign(self, fn: _FnInfo, tgt: tuple, spec: tuple, line: int,
+                   tainted: Dict[str, str], qtaint: Dict[str, Set[int]],
+                   returns_tainted: Set[str]) -> None:
+        key = fn.key
+        origin = self._spec_taint(key, spec, tainted, qtaint,
+                                  returns_tainted)
+        if tgt[0] == "name":
+            if origin:
+                tainted[tgt[1]] = origin
+            else:
+                tainted.pop(tgt[1], None)
+            return
+        names = tgt[1]
+        if spec[0] == "qget" and qtaint.get(spec[1]):
+            # element-wise: only the positions that received a tainted
+            # payload element at put-time are tainted at get-time
+            pos = qtaint[spec[1]]
+            for i, n in enumerate(names):
+                if n is None:
+                    continue
+                if i in pos:
+                    tainted[n] = f"reply from queue {spec[1]}"
+                else:
+                    tainted.pop(n, None)
+            return
+        if spec[0] == "tuple":
+            for i, n in enumerate(names):
+                if n is None:
+                    continue
+                s = spec[1][i] if i < len(spec[1]) else ("clean",)
+                o = self._spec_taint(key, s, tainted, qtaint,
+                                     returns_tainted)
+                if o:
+                    tainted[n] = o
+                else:
+                    tainted.pop(n, None)
+            return
+        for n in names:
+            if n is None:
+                continue
+            if origin:
+                tainted[n] = origin
+            else:
+                tainted.pop(n, None)
+
+    # -- ingress + reachability ------------------------------------------
+
+    def _ingress_roots(self) -> Dict[str, str]:
+        roots: Dict[str, str] = {}
+        for key, fn in self.fns.items():
+            if fn.ingress_marked:
+                roots[key] = "marked"
+                continue
+            if (fn.module.startswith("weaviate_tpu.api.")
+                    and (fn.cls is None or fn.cls.endswith("API"))):
+                roots[key] = "api"
+            elif fn.cls is not None and fn.cls.endswith("Dispatcher"):
+                roots[key] = "dispatcher"
+        for key, fn in self.fns.items():
+            for desc in fn.cycle_regs:
+                for k in self.resolve(key, desc):
+                    roots.setdefault(k, "cycle")
+        return roots
+
+    def _reachable(self, roots: Dict[str, str]) -> Set[str]:
+        seen = set(roots)
+        work = list(roots)
+        while work:
+            cur = work.pop()
+            fn = self.fns.get(cur)
+            if fn is None:
+                continue
+            for desc in fn.calls:
+                for k in self.resolve(cur, desc):
+                    if k not in seen:
+                        seen.add(k)
+                        work.append(k)
+        return seen
+
+    # -- findings --------------------------------------------------------
+
+    def run(self) -> ErrorFlowModel:
+        model = ErrorFlowModel()
+
+        # returns-tainted fixpoint over helper returns
+        returns_tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for key, fn in self.fns.items():
+                if key in returns_tainted:
+                    continue
+                if self._replay(fn, returns_tainted, emit=None):
+                    returns_tainted.add(key)
+                    changed = True
+        model.tainted_fns = set(returns_tainted)
+
+        # final replay, collecting reply-taint findings + flow edges
+        for key, fn in self.fns.items():
+            found: list = []
+            self._replay(fn, returns_tainted, emit=found)
+            for (f, ukind, line, detail, origin) in found:
+                model.violations.append(self._reply_violation(
+                    f, ukind, line, detail, origin))
+            self._flow_edges(model, fn, returns_tainted)
+
+        roots = self._ingress_roots()
+        model.ingress = roots
+        reach = self._reachable(roots)
+        model.reachable = reach
+
+        for key in sorted(reach):
+            fn = self.fns.get(key)
+            if fn is None:
+                continue
+            self._budget_findings(model, fn)
+
+        model.violations.sort(
+            key=lambda v: (v.path, v.line, v.col, v.rule))
+        return model
+
+    def _reply_violation(self, fn: _FnInfo, ukind: str, line: int,
+                         detail: str, origin: str) -> Violation:
+        sev = SEV_ERROR if any(fn.path.startswith(d)
+                               for d in CRITICAL_REPLY_DIRS) \
+            else SEV_WARNING
+        if ukind == "blob-get":
+            msg = (f"blob-store read {detail} outside a KeyError/"
+                   "BlobStoreError handler — absence surfaces as a raw "
+                   "exception far from the call; wrap in try/except or "
+                   "route through a registered validator")
+        elif ukind == "truthy":
+            msg = (f"truthiness of an unverified RPC reply ({origin}) "
+                   "used as a success signal — an error reply "
+                   "{'error': ...} is truthy (and a missing-key .get() "
+                   "on it reads as empty); check _expect()/an error key "
+                   "first (the PR 10 verified-zero bug shape)")
+        elif ukind == "iter":
+            msg = (f"iterating an unverified RPC reply ({origin}) — an "
+                   "error reply iterates as its keys; check _expect()/"
+                   "an error key first")
+        else:
+            msg = (f"field {detail} read from an unverified RPC reply "
+                   f"({origin}) — an error reply {{'error': ...}} has "
+                   "no data keys, so this reads as missing/zero; route "
+                   "through _expect() or an explicit error-key check")
+        fm = self._fm_of[fn.key]
+        return Violation(
+            rule=UNCHECKED_RPC_REPLY, path=fn.path, line=line, col=0,
+            severity=sev, message=msg, symbol=fn.qual,
+            snippet=fm.ctx.line_snippet(line))
+
+    def _budget_findings(self, model: ErrorFlowModel, fn: _FnInfo) -> None:
+        fm = self._fm_of[fn.key]
+        if fn.mints and not fn.installs_ctx \
+                and fn.name != "_op_deadline":
+            for (line, detail) in fn.mints:
+                model.violations.append(Violation(
+                    rule=BUDGET_MINTED_IN_FLIGHT, path=fn.path,
+                    line=line, col=0, severity=SEV_WARNING,
+                    message=(
+                        f"fresh {detail} minted on a serving path "
+                        "(reachable from the ingress set) — thread the "
+                        "ingress budget via RequestContext/"
+                        "_op_deadline() instead; a leg that mints its "
+                        "own budget outlives the request that paid for "
+                        "it (the PR 16 backup-leg bug shape)"),
+                    symbol=fn.qual,
+                    snippet=fm.ctx.line_snippet(line)))
+        if fn.mentions_deadline:
+            return
+        for (line, cat, bounded, detail) in fn.blocking:
+            if bounded:
+                continue
+            model.violations.append(Violation(
+                rule=BLOCKING_CALL_WITHOUT_DEADLINE, path=fn.path,
+                line=line, col=0, severity=SEV_ERROR,
+                message=(
+                    f"unbounded {cat} {detail} reachable from the "
+                    "serving ingress set with no deadline clamp on any "
+                    "path — pass timeout=deadline.per_attempt(...) or "
+                    "thread a deadline/timeout parameter into "
+                    f"{fn.name}()"),
+                symbol=fn.qual,
+                snippet=fm.ctx.line_snippet(line)))
+
+    def _flow_edges(self, model: ErrorFlowModel, fn: _FnInfo,
+                    returns_tainted: Set[str]) -> None:
+        """Taint flow graph: pseudo source nodes -> consuming functions,
+        plus callee -> caller edges where taint crosses a return."""
+        key = fn.key
+        for ev in fn.events:
+            if ev[0] == "assign":
+                self._edge_from_spec(model, fn, ev[2], ev[3],
+                                     returns_tainted)
+            elif ev[0] == "ret":
+                self._edge_from_spec(model, fn, ev[1], ev[2],
+                                     returns_tainted)
+
+    def _edge_from_spec(self, model: ErrorFlowModel, fn: _FnInfo,
+                        spec: tuple, line: int,
+                        returns_tainted: Set[str]) -> None:
+        kind = spec[0]
+        if kind == "source":
+            if not self._source_is_reply(fn.key, spec[2], spec[3]):
+                return
+            name = spec[1].split("(", 1)[0].rsplit(".", 1)[-1]
+            src = f"rpc:{name}"
+            model.edges.setdefault((src, fn.key), TaintEdge(
+                src=src, dst=fn.key, path=fn.path, line=line,
+                kind="source"))
+        elif kind == "qget":
+            src = f"queue:{spec[1]}"
+            model.edges.setdefault((src, fn.key), TaintEdge(
+                src=src, dst=fn.key, path=fn.path, line=line,
+                kind="queue"))
+        elif kind == "call":
+            for k in self.resolve(fn.key, spec[1]):
+                if k in returns_tainted:
+                    model.edges.setdefault((k, fn.key), TaintEdge(
+                        src=k, dst=fn.key, path=fn.path, line=line,
+                        kind="return"))
+        elif kind in ("tuple", "either"):
+            for s in spec[1:] if kind == "either" else spec[1]:
+                self._edge_from_spec(model, fn, s, line, returns_tainted)
+
+
+# ---------------------------------------------------------------------------
+# entry points + cache
+
+
+def analyze_contexts(contexts: Dict[str, "FileContext"]) -> ErrorFlowModel:
+    """Run the whole-program error-flow analysis over pre-built
+    FileContexts."""
+    return Analyzer(contexts).run()
+
+
+def analyze_sources(sources: Dict[str, str]) -> ErrorFlowModel:
+    """Test/utility entry: analyze raw sources keyed by rel path."""
+    from tools.graftlint.engine import FileContext
+    return analyze_contexts(
+        {rel: FileContext(src, rel) for rel, src in sources.items()})
+
+
+def check_contexts(contexts: Dict[str, "FileContext"],
+                   meta: Optional[Dict[str, Tuple[int, int]]] = None,
+                   cache_path: Optional[Path] = None) -> ErrorFlowModel:
+    """Analysis behind the shared ``passcache`` sidecar — one cache
+    invalidation path for both whole-program passes."""
+    import time as _time
+
+    from tools.graftlint import passcache
+
+    t0 = _time.perf_counter()
+    data = passcache.load(cache_path, ERRORFLOW_VERSION, meta)
+    if data is not None:
+        try:
+            model = ErrorFlowModel()
+            model.cache_state = "warm"
+            for d in data["violations"]:
+                model.violations.append(Violation(**d))
+            for d in data["edges"]:
+                e = TaintEdge(**d)
+                model.edges[(e.src, e.dst)] = e
+            model.ingress = dict(data["ingress"])
+            model.reachable = set(data["reachable"])
+            model.tainted_fns = set(data["tainted_fns"])
+            model.wall_s = _time.perf_counter() - t0
+            return model
+        except (ValueError, KeyError, TypeError):
+            pass  # malformed payload: recompute and overwrite
+    model = analyze_contexts(contexts)
+    model.cache_state = "cold" if cache_path is not None else "off"
+    model.wall_s = _time.perf_counter() - t0
+    from tools.graftlint import passcache as _pc
+    _pc.store(cache_path, ERRORFLOW_VERSION, meta, {
+        "violations": [v.to_dict() for v in model.violations],
+        "edges": [dataclasses.asdict(e)
+                  for _, e in sorted(model.edges.items())],
+        "ingress": dict(sorted(model.ingress.items())),
+        "reachable": sorted(model.reachable),
+        "tainted_fns": sorted(model.tainted_fns),
+    })
+    return model

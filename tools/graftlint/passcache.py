@@ -1,0 +1,55 @@
+"""Shared mtime cache for the whole-program passes.
+
+Both interprocedural passes (``concurrency.py``, ``errorflow.py``) are
+pure functions of the analyzed source set, so their results are cached
+identically: a JSON sidecar keyed on the pass version plus every file's
+``(mtime_ns, size)`` stamp. One invalidation path means the two passes
+can never drift — a source edit that re-runs one re-runs the other, and
+a pass-version bump invalidates exactly its own sidecar.
+
+The cache is best-effort: a malformed or unwritable sidecar degrades to
+a recompute, never an error (read-only checkouts lint fine, just
+uncached).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+
+def cache_key(meta: Dict[str, Tuple[int, int]]) -> dict:
+    """Canonical file-set stamp: rel path -> [mtime_ns, size], sorted."""
+    return {rel: list(mt) for rel, mt in sorted(meta.items())}
+
+
+def load(cache_path: Optional[Path], version: int,
+         meta: Optional[Dict[str, Tuple[int, int]]]) -> Optional[dict]:
+    """The cached payload when warm (same version + identical file
+    stamps), else None. Malformed caches read as cold."""
+    if cache_path is None or meta is None or not cache_path.exists():
+        return None
+    try:
+        data = json.loads(cache_path.read_text(encoding="utf-8"))
+        if (data.get("version") == version
+                and data.get("files") == cache_key(meta)):
+            return data
+    except (ValueError, KeyError, TypeError, OSError):
+        pass
+    return None
+
+
+def store(cache_path: Optional[Path], version: int,
+          meta: Optional[Dict[str, Tuple[int, int]]],
+          payload: dict) -> None:
+    """Write the sidecar (version + file stamps + pass payload).
+    Silently skipped when uncacheable or unwritable."""
+    if cache_path is None or meta is None:
+        return
+    doc = {"version": version, "files": cache_key(meta)}
+    doc.update(payload)
+    try:
+        cache_path.write_text(json.dumps(doc), encoding="utf-8")
+    except OSError:
+        pass  # read-only checkout: run uncached

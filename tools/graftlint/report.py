@@ -52,7 +52,8 @@ def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
                 stale: Counter, suppressed_count: int,
                 files_checked: int,
                 timings: Optional[dict] = None,
-                concurrency_cache: Optional[str] = None) -> str:
+                concurrency_cache: Optional[str] = None,
+                errorflow_cache: Optional[str] = None) -> str:
     doc = {
         "summary": {
             "status": "fail" if (new or stale) else "ok",
@@ -76,6 +77,8 @@ def render_json(new: Sequence[Violation], baselined: Sequence[Violation],
         doc["summary"]["timings"] = dict(timings)
     if concurrency_cache is not None:
         doc["summary"]["concurrency_cache"] = concurrency_cache
+    if errorflow_cache is not None:
+        doc["summary"]["errorflow_cache"] = errorflow_cache
     return json.dumps(doc, indent=2)
 
 

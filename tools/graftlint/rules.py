@@ -1478,6 +1478,66 @@ class UnlockedCollectiveDispatch(WholeProgramRule):
     )
 
 
+# ---------------------------------------------------------------------------
+# 9. whole-program error-path / deadline rules (driven by tools/
+#    graftlint/errorflow.py — same dispatch shape as the concurrency
+#    rules above)
+
+
+class UncheckedRpcReply(WholeProgramRule):
+    id = "unchecked-rpc-reply"
+    description = (
+        "field access or truthiness-as-success on an RPC reply / fan-out "
+        "queue payload / blob get that never flowed through _expect, an "
+        "error-key check, or a registered validator"
+    )
+    rationale = (
+        "An error reply is {'error': ...} — truthy, and .get() of any "
+        "data key reads as missing/zero. PR 10's digest round treated "
+        "exactly that as a verified-zero and could flip+drop objects on "
+        "nothing; PR 16 swept the backup plane for the same shape. "
+        "Taint is tracked whole-program (assignment, tuple unpack, "
+        "queue put/get, helper returns) so a reply laundered through "
+        "two helpers is as visible as a direct read. SEV_ERROR in "
+        "cluster/, backup/, tiering/ — the planes where the bug class "
+        "destroys data."
+    )
+
+
+class BudgetMintedInFlight(WholeProgramRule):
+    id = "budget-minted-in-flight"
+    description = (
+        "fresh Deadline(...) constructed on a path reachable from the "
+        "serving ingress set instead of threading _op_deadline/"
+        "RequestContext"
+    )
+    rationale = (
+        "A leg that mints its own budget outlives the request that "
+        "paid for it: the client has timed out and retried while the "
+        "orphan leg still holds locks and sockets — PR 16's backup-leg "
+        "bug. The only sanctioned mints are the ingress itself (the "
+        "function installing the RequestContext) and the _op_deadline "
+        "fallback for non-serving callers."
+    )
+
+
+class BlockingCallWithoutDeadline(WholeProgramRule):
+    id = "blocking-call-without-deadline"
+    description = (
+        "blocking call (queue.get, Future.result, event wait, socket "
+        "send/recv, blob I/O) reachable from the serving ingress set "
+        "with no deadline clamp on any path"
+    )
+    rationale = (
+        "Unbounded blocking on a serving path turns one slow peer into "
+        "a stuck worker thread; enough of them and the pool is gone — "
+        "the class PR 3/PR 9/PR 11 fixed by hand three times. A call "
+        "is clamped if it passes a timeout or the enclosing function "
+        "threads deadline machinery (deadline/timeout parameter, "
+        "_op_deadline, retrying_call, Deadline methods)."
+    )
+
+
 ALL_RULES: tuple = (
     HostSyncInHotPath(),
     JitInLoop(),
@@ -1497,6 +1557,9 @@ ALL_RULES: tuple = (
     LockOrderCycle(),
     BlockingUnderLock(),
     UnlockedCollectiveDispatch(),
+    UncheckedRpcReply(),
+    BudgetMintedInFlight(),
+    BlockingCallWithoutDeadline(),
     UnwarmedJitProgram(),
     UnverifiedRemoteDelete(),
     SuppressionMissingReason(),

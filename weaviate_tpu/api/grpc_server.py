@@ -451,6 +451,7 @@ class GrpcAPI:
 
     def shutdown(self, grace: float = 1.0):
         if self._server is not None:
+            # graftlint: allow[blocking-call-without-deadline] reason=shutdown verb, not a request leg; stop(grace) already bounds in-flight handlers before the event fires
             self._server.stop(grace).wait()
 
 

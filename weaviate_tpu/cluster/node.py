@@ -741,6 +741,7 @@ class ClusterNode:
         first_err: Optional[BaseException] = None
         for f in futures:
             try:
+                # graftlint: allow[blocking-call-without-deadline] reason=every scatter leg is a deadline-clamped RPC; result() returns when the leg's own deadline expires
                 out.append(f.result())
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 out.append(None)
@@ -796,6 +797,7 @@ class ClusterNode:
                     # full budget per attempt: timing out a commit that is
                     # mid-apply just to retry it buys nothing
                     r = self._call(rep, msg,
+                                   # graftlint: allow[budget-minted-in-flight] reason=deliberate decoupling from the ingress budget — the decision is durable, so a commit mid-apply must not be timed out by the request that paid for it (PR 3 design)
                                    deadline=Deadline(budget,
                                                      op="2pc_finish"),
                                    timeout=budget)
@@ -1240,10 +1242,16 @@ class ClusterNode:
         last = "no replicas"
         for rep in self._ordered(state.read_replicas(shard)):
             try:
-                return self._call(rep, msg, deadline=deadline)
+                r = self._call(rep, msg, deadline=deadline)
             except _REPLICA_ERRORS as e:
                 last = str(e)
                 continue
+            if "error" in r:
+                # an application-level error reply is a failed leg too:
+                # fail over instead of handing the caller a data-free dict
+                last = str(r["error"])
+                continue
+            return r
         raise ReplicationError(
             f"shard {shard}: no replica reachable ({last})")
 
@@ -1495,11 +1503,12 @@ class ClusterNode:
                 "type": "hashtree_leaves", "class": cls,
                 "tenant": tenant, "shard": shard,
             }, deadline=deadline)
-        except _REPLICA_ERRORS:
+            leaves = self._expect(r, "leaves", rep)
+        except (ReplicationError, *_REPLICA_ERRORS):
             logger.info("hashBeat: %s unreachable for %s/shard%s leaves",
                         rep, cls, shard)
             return 0
-        diff = local_tree.diff_leaves(r["leaves"])
+        diff = local_tree.diff_leaves(leaves)
         if not diff:
             return 0
         try:
@@ -1508,11 +1517,11 @@ class ClusterNode:
                 "tenant": tenant, "shard": shard,
                 "buckets": diff, "n_leaves": local_tree.n_leaves,
             }, deadline=deadline)
-        except _REPLICA_ERRORS:
+            theirs = dict(self._expect(r, "items", rep))
+        except (ReplicationError, *_REPLICA_ERRORS):
             logger.info("hashBeat: %s unreachable for %s/shard%s items",
                         rep, cls, shard)
             return 0
-        theirs = dict(r["items"])
         mine = {
             u: v for u, v in self._shard_items(cls, shard, tenant)
             if bucket_of(u, local_tree.n_leaves) in set(diff)
@@ -1529,11 +1538,11 @@ class ClusterNode:
                     "type": "tombstone_push", "class": cls,
                     "tenant": tenant, "shard": shard, "tombs": tombs,
                 }, deadline=deadline)
-                removed = rr.get("removed", 0)
+                removed = self._expect(rr, "removed", rep)
                 moved += removed
                 if removed:
                     REPLICA_REPAIRS.inc(removed, path="anti_entropy")
-            except _REPLICA_ERRORS:
+            except (ReplicationError, *_REPLICA_ERRORS):
                 logger.warning("hashBeat tombstone push to %s failed "
                                "(%s/shard%s, %d tombstones)", rep, cls,
                                shard, len(tombs))
@@ -1553,11 +1562,11 @@ class ClusterNode:
                         "tenant": tenant, "shard": shard,
                         "objects": blobs,
                     }, deadline=deadline)
-                    applied = rr.get("applied", 0)
+                    applied = self._expect(rr, "applied", rep)
                     moved += applied
                     if applied:
                         REPLICA_REPAIRS.inc(applied, path="anti_entropy")
-                except _REPLICA_ERRORS:
+                except (ReplicationError, *_REPLICA_ERRORS):
                     logger.warning("hashBeat push to %s failed "
                                    "(%s/shard%s, %d objects)", rep, cls,
                                    shard, len(blobs))
@@ -1570,7 +1579,8 @@ class ClusterNode:
                     "type": "object_fetch", "class": cls,
                     "tenant": tenant, "shard": shard, "uuids": pull,
                 }, deadline=deadline)
-                blobs = [b for b in rr["objects"] if b is not None]
+                blobs = [b for b in self._expect(rr, "objects", rep)
+                         if b is not None]
                 if blobs:
                     r2 = self._on_object_push({
                         "class": cls, "tenant": tenant,
@@ -1580,7 +1590,7 @@ class ClusterNode:
                     moved += applied
                     if applied:
                         REPLICA_REPAIRS.inc(applied, path="anti_entropy")
-            except _REPLICA_ERRORS:
+            except (ReplicationError, *_REPLICA_ERRORS):
                 logger.warning("hashBeat pull from %s failed "
                                "(%s/shard%s, %d uuids)", rep, cls, shard,
                                len(pull))
@@ -1604,7 +1614,7 @@ class ClusterNode:
                     "type": "object_push", "class": cls, "tenant": tenant,
                     "shard": shard, "objects": blobs,
                 }, timeout=10.0)
-                moved += rr.get("applied", 0)
+                moved += self._expect(rr, "applied", dst)
             after = r.get("next", None)
             if after is None:
                 return moved

@@ -729,6 +729,11 @@ class Rebalancer:
                 except TransportError:
                     out.append((cls, s, "unreachable"))
                     continue
+                if "error" in r:
+                    # an error reply is NOT proof the shard is empty: treat
+                    # it like unreachable and keep blocking the removal
+                    out.append((cls, s, f"error: {r['error']}"))
+                    continue
                 if r.get("objects"):
                     out.append((cls, s, "unrouted data"))
         return out

@@ -39,6 +39,7 @@ from weaviate_tpu.monitoring.metrics import (
     DEADLINE_EXPIRED,
     RPC_RETRIES,
 )
+from weaviate_tpu.utils import deadlinewitness
 
 CLOSED = "closed"
 OPEN = "open"
@@ -90,6 +91,7 @@ class Deadline:
         self._expires = clock() + budget
         self._recorded = False
         self._lock = threading.Lock()
+        deadlinewitness.observe_mint(self)
 
     @classmethod
     def after(cls, budget: float, op: str = "rpc") -> "Deadline":
@@ -262,24 +264,29 @@ def retrying_call(fn: Callable[[float], dict], *, peer: str,
     retries only on ``retry_on`` exception types. The caller wraps breaker
     bookkeeping (it decides which peers a retry may target)."""
     last: Optional[BaseException] = None
-    for attempt in range(1, policy.attempts + 1):
-        deadline.require()
-        try:
-            return fn(deadline.per_attempt(timeout))
-        except retry_on as e:  # type: ignore[misc]
-            last = e
-            if attempt == policy.attempts:
-                break
-            RPC_RETRIES.inc(peer=peer, msg_type=msg_type)
-            # span event on the caller's rpc span (no-op unsampled): the
-            # trace shows each retry with its cause, not just a slow leg
-            from weaviate_tpu.monitoring.tracing import add_event
+    pushed = deadlinewitness.push_deadline(deadline)
+    try:
+        for attempt in range(1, policy.attempts + 1):
+            deadline.require()
+            try:
+                return fn(deadline.per_attempt(timeout))
+            except retry_on as e:  # type: ignore[misc]
+                last = e
+                if attempt == policy.attempts:
+                    break
+                RPC_RETRIES.inc(peer=peer, msg_type=msg_type)
+                # span event on the caller's rpc span (no-op unsampled):
+                # the trace shows each retry with its cause, not just a
+                # slow leg
+                from weaviate_tpu.monitoring.tracing import add_event
 
-            add_event("rpc.retry", attempt=attempt, peer=peer,
-                      error=str(e))
-            pause = min(policy.backoff(attempt, rng),
-                        max(0.0, deadline.remaining()))
-            if pause > 0:
-                sleep(pause)
+                add_event("rpc.retry", attempt=attempt, peer=peer,
+                          error=str(e))
+                pause = min(policy.backoff(attempt, rng),
+                            max(0.0, deadline.remaining()))
+                if pause > 0:
+                    sleep(pause)
+    finally:
+        deadlinewitness.pop_deadline(pushed)
     assert last is not None
     raise last

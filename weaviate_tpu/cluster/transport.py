@@ -21,6 +21,8 @@ from typing import Callable, Optional
 
 import msgpack
 
+from weaviate_tpu.utils import deadlinewitness
+
 Handler = Callable[[dict], dict]
 
 
@@ -42,6 +44,7 @@ class InProcTransport:
         self.handler = handler
 
     def send(self, peer: str, msg: dict, timeout: float = 1.0) -> dict:
+        deadlinewitness.observe_rpc(peer, str(msg.get("type", "")))
         if peer in self.partitioned:
             raise TransportError(f"{self.node_id} -> {peer}: partitioned")
         target = self.registry.get(peer)
@@ -49,7 +52,9 @@ class InProcTransport:
             raise TransportError(f"{self.node_id} -> {peer}: unreachable")
         if self.node_id in target.partitioned:
             raise TransportError(f"{self.node_id} -> {peer}: partitioned")
-        return target.handler(msg)
+        reply = target.handler(msg)
+        deadlinewitness.observe_reply(reply)
+        return reply
 
     def stop(self) -> None:
         self.registry.pop(self.node_id, None)
@@ -123,6 +128,7 @@ class TcpTransport:
         self._thread.start()
 
     def send(self, peer: str, msg: dict, timeout: float = 1.0) -> dict:
+        deadlinewitness.observe_rpc(peer, str(msg.get("type", "")))
         payload = msgpack.packb(msg, use_bin_type=True)
         with self._conn_lock:
             pool = self._idle.get(peer)
@@ -157,6 +163,7 @@ class TcpTransport:
                 reply = msgpack.unpackb(_recv_exact(sock, n), raw=False)
                 with self._conn_lock:
                     self._idle.setdefault(peer, []).append(sock)
+                deadlinewitness.observe_reply(reply)
                 return reply
             except (OSError, struct.error, TransportError) as e:
                 try:

@@ -114,7 +114,7 @@ class _APIBase:
             return {self.spec.auth.split(":", 1)[1]: key}
         return {}
 
-    def _call(self, payload: dict) -> dict:
+    def _call(self, payload: dict) -> dict:  # graftlint: reply-raises
         return self.transport(self._endpoint(), self._headers(), payload)
 
 

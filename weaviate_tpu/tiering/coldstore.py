@@ -97,11 +97,19 @@ class TenantColdStore:
                  rng: Optional[random.Random] = None):
         self.store = store
         self.retry = retry or RetryPolicy(attempts=4, base=0.02, cap=0.25)
-        self.op_budget_s = float(op_budget_s)
+        self._op_budget_s = float(op_budget_s)
         self._rng = rng or random.Random("coldstore")
 
+    @property
+    def op_budget_s(self) -> float:
+        from weaviate_tpu.utils.runtime_config import COLDSTORE_OP_BUDGET_S
+
+        v = float(COLDSTORE_OP_BUDGET_S.get())
+        return v if v > 0 else self._op_budget_s
+
     # -- retried blob ops --------------------------------------------------
-    def _call(self, what: str, fn, deadline: Deadline):
+    def _call(self, what: str, fn,
+              deadline: Deadline):  # graftlint: reply-raises
         return retrying_call(
             lambda _t: fn(), peer="blobstore", policy=self.retry,
             deadline=deadline, timeout=self.op_budget_s, rng=self._rng,
@@ -119,13 +127,21 @@ class TenantColdStore:
         except (OSError, ValueError):
             return None
 
-    def latest_generation(self, collection: str, tenant: str
+    def latest_generation(self, collection: str, tenant: str,
+                          deadline: Optional[Deadline] = None
                           ) -> Optional[int]:
         """Highest generation with a committed manifest (remote truth —
-        used when the local marker is missing, e.g. a rebuilt node)."""
+        used when the local marker is missing, e.g. a rebuilt node).
+        Callers on a budgeted path pass their ``deadline`` so the listing
+        rides the retry/deadline clamp instead of blocking unboundedly."""
         pre = tenant_prefix(collection, tenant)
+        if deadline is not None:
+            keys = self._call("blob_list",
+                              lambda: list(self.store.list(pre)), deadline)
+        else:
+            keys = list(self.store.list(pre))
         gens = []
-        for key in self.store.list(pre):
+        for key in keys:
             rest = key[len(pre):]
             parts = rest.split("/", 1)
             m = _GEN_RE.match(parts[0]) if parts else None
@@ -144,9 +160,10 @@ class TenantColdStore:
             return None
         cls = col.config.name
         t0 = time.monotonic()
+        # graftlint: allow[budget-minted-in-flight] reason=offload is a maintenance root (tiering demotion cycle), not a request leg — the cycle owns this budget; coldstore_op_budget_s makes it hot-reloadable
         deadline = Deadline(self.op_budget_s, op="cold_offload")
         try:
-            gen = (self.latest_generation(cls, tenant) or 0) + 1
+            gen = (self.latest_generation(cls, tenant, deadline) or 0) + 1
             gen_pre = f"{tenant_prefix(cls, tenant)}gen-{gen:08d}/"
             files = []
             total = 0
@@ -175,7 +192,7 @@ class TenantColdStore:
                        lambda: self.store.put(mkey, blob), deadline)
             # the remote copy is only trusted once every byte re-reads
             # correctly — THE gate before any local delete
-            self.verify_uploaded(manifest)
+            self.verify_uploaded(manifest, deadline)
         except (BlobStoreError, ColdTierError, OSError, TimeoutError) as e:
             OFFLOAD_TENANTS.inc(outcome="failed")
             logger.warning("offload %s/%s failed (local copy kept): %s",
@@ -210,13 +227,19 @@ class TenantColdStore:
                     "%.2fs)", cls, tenant, gen, len(files), total, dt)
         return manifest
 
-    def verify_uploaded(self, manifest: dict) -> None:
+    def verify_uploaded(self, manifest: dict,
+                        deadline: Optional[Deadline] = None) -> None:
         """Digest-check every blob the manifest lists against the store.
         Raises :class:`ColdTierCorruption` on any mismatch — the caller
         must not delete local state past a failure here."""
         for ent in manifest["files"]:
             try:
-                data = self.store.get(ent["key"])
+                if deadline is not None:
+                    data = self._call(
+                        "blob_get",
+                        lambda k=ent["key"]: self.store.get(k), deadline)
+                else:
+                    data = self.store.get(ent["key"])
             except KeyError:
                 raise ColdTierCorruption(
                     f"uploaded blob missing: {ent['key']}") from None
@@ -263,18 +286,18 @@ class TenantColdStore:
         if os.path.isdir(dst):
             return False  # local copy exists: nothing to hydrate
         cls = col.config.name
+        deadline = Deadline(self.op_budget_s, op="cold_hydrate")
         marker = self.read_marker(col.dir, tenant)
         if marker is not None:
             gen = int(marker["generation"])
         else:
-            latest = self.latest_generation(cls, tenant)
+            latest = self.latest_generation(cls, tenant, deadline)
             if latest is None:
                 return False
             gen = latest
         t0 = time.monotonic()
         staging = dst + ".hydrate"
         shutil.rmtree(staging, ignore_errors=True)
-        deadline = Deadline(self.op_budget_s, op="cold_hydrate")
         try:
             manifest = self.fetch_manifest(cls, tenant, gen)
             total = 0

@@ -1,0 +1,262 @@
+"""Runtime deadline witness: dynamic validation of the errorflow budget
+model.
+
+``tools/graftlint/errorflow.py`` computes the *static* budget-propagation
+pass (``budget-minted-in-flight`` / ``blocking-call-without-deadline``).
+This module is its runtime counterpart, the deadline analogue of
+:mod:`~weaviate_tpu.utils.lockwitness`: opt-in instrumentation on the
+transport send path and the resilience policy stack that records every
+serving-scope RPC issued with **no live deadline** — the dynamic shape of
+the PR 16 fresh-budget-in-backup-leg bug (a leg that escapes the request
+budget can outlive the request that paid for it).
+
+Contract checked per RPC (the same resolution order ``_op_deadline``
+implements: explicit caller deadline > ingress RequestContext deadline):
+
+- a **violation** is a transport send issued while a
+  :class:`~weaviate_tpu.serving.context.RequestContext` is installed on
+  the thread but NEITHER the context nor the resilience layer
+  (``retrying_call``'s in-flight deadline, pushed here per attempt run)
+  carries a live :class:`~weaviate_tpu.cluster.resilience.Deadline`;
+- a send whose effective deadline is already **expired** is counted in
+  ``late_rpcs`` (stat only: ``Deadline.require()`` owns enforcement);
+- a ``Deadline(...)`` minted while the installed context already holds a
+  live deadline is counted in ``minted_in_flight`` (stat only: the
+  static pass owns the verdict, with reasoned suppressions for the
+  legitimate decoupling points like the 2PC finish leg);
+- replies carrying an ``"error"`` key are counted in ``error_replies``
+  (the raw material of the PR 10 error-reply-as-verified-zero class; the
+  reply-taint pass proves each one is checked).
+
+Hooks are inline (``transport.py`` both sends, ``resilience.py``
+``Deadline.__init__``/``retrying_call``) and early-return on a single
+module-global ``None`` check when the witness is off — the production
+import costs one predicted branch per call, nothing else.
+
+Activation (tests): ``tests/conftest.py`` installs the witness when
+``WEAVIATE_TPU_DEADLINE_WITNESS`` is not ``off`` (default ``record``:
+violations are collected and the session fails at exit; ``strict``
+raises :class:`DeadlineViolation` at the offending send).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+__all__ = [
+    "DeadlineViolation", "DeadlineWitness", "install", "uninstall",
+    "installed", "current", "isolated", "observe_rpc", "observe_reply",
+    "observe_mint", "push_deadline", "pop_deadline",
+]
+
+
+class DeadlineViolation(RuntimeError):
+    """A serving-scope RPC was issued with no live deadline anywhere on
+    its path — the budget the ingress admitted the request under does
+    not govern this leg."""
+
+
+def _request_ctx():
+    """The thread's RequestContext, or None. Looked up through
+    sys.modules so this module stays stdlib-only at import time (it is
+    boot-loaded by conftest the same way lockwitness is); a process that
+    never imported the serving layer has no serving scope by
+    definition."""
+    ctx_mod = sys.modules.get("weaviate_tpu.serving.context")
+    if ctx_mod is None:
+        return None
+    return ctx_mod.current()
+
+
+def _stack_note(limit: int = 5) -> str:
+    frames = traceback.extract_stack()
+    keep = [fr for fr in frames
+            if os.path.basename(fr.filename) != "deadlinewitness.py"
+            ][-limit:]
+    return " <- ".join(f"{os.path.basename(fr.filename)}:{fr.lineno}"
+                       f"({fr.name})" for fr in reversed(keep))
+
+
+# The in-flight deadline stack is a property of the THREAD (a fan-out
+# worker's retrying_call must not satisfy the coordinator thread's
+# sends), and survives `isolated()` swapping the recorder mid-flight.
+_tls = threading.local()
+
+
+def _stack() -> List[object]:
+    try:
+        return _tls.deadlines
+    except AttributeError:
+        _tls.deadlines = []
+        return _tls.deadlines
+
+
+class DeadlineWitness:
+    """The per-session recorder: violations + budget-path stats."""
+
+    def __init__(self, strict: bool = False):
+        self.strict = strict
+        self._mu = threading.Lock()
+        self.violations: List[dict] = []
+        self.rpcs = 0              # serving-scope sends witnessed
+        self.late_rpcs = 0         # sends whose deadline was already spent
+        self.minted_in_flight = 0  # Deadline() births inside a live scope
+        self.error_replies = 0     # {"error": ...} replies observed
+
+    # -- the check ------------------------------------------------------
+
+    def observe_rpc(self, peer: str, msg_type: str = "") -> None:
+        ctx = _request_ctx()
+        if ctx is None:
+            return  # maintenance / control plane: no budget contract
+        stack = _stack()
+        deadline = stack[-1] if stack else getattr(ctx, "deadline", None)
+        with self._mu:
+            self.rpcs += 1
+        if deadline is None:
+            rec = {
+                "peer": peer,
+                "msg_type": msg_type,
+                "thread": threading.current_thread().name,
+                "here": _stack_note(),
+            }
+            with self._mu:
+                self.violations.append(rec)
+            if self.strict:
+                raise DeadlineViolation(
+                    f"serving-scope RPC {msg_type!r} -> {peer} with no "
+                    f"live deadline (RequestContext has none and no "
+                    f"retrying_call is in flight); here: {rec['here']}")
+            return
+        if getattr(deadline, "expired", False):
+            with self._mu:
+                self.late_rpcs += 1
+
+    def observe_reply(self, reply: object) -> None:
+        if isinstance(reply, dict) and "error" in reply:
+            with self._mu:
+                self.error_replies += 1
+
+    def observe_mint(self, deadline: object) -> None:
+        ctx = _request_ctx()
+        if ctx is None:
+            return
+        held = getattr(ctx, "deadline", None)
+        if held is not None and held is not deadline:
+            with self._mu:
+                self.minted_in_flight += 1
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._mu:
+            return {
+                "rpcs": self.rpcs,
+                "violations": len(self.violations),
+                "late_rpcs": self.late_rpcs,
+                "minted_in_flight": self.minted_in_flight,
+                "error_replies": self.error_replies,
+            }
+
+    def report(self) -> str:
+        s = self.stats()
+        lines = [
+            f"deadlinewitness: {s['rpcs']} serving-scope rpcs, "
+            f"{s['violations']} violation(s), {s['late_rpcs']} late, "
+            f"{s['minted_in_flight']} minted-in-flight, "
+            f"{s['error_replies']} error replies"]
+        for rec in self.violations:
+            lines.append(
+                f"  VIOLATION [{rec['thread']}]: {rec['msg_type']!r} -> "
+                f"{rec['peer']} with no live deadline; here: {rec['here']}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# module state + inline-hook entry points (all early-return when off)
+
+
+_active: Optional[DeadlineWitness] = None
+
+
+def current() -> Optional[DeadlineWitness]:
+    return _active
+
+
+def installed() -> bool:
+    return _active is not None
+
+
+def install(strict: bool = False) -> DeadlineWitness:
+    """Activate recording. Idempotent; re-install updates strictness."""
+    global _active
+    if _active is None:
+        _active = DeadlineWitness(strict=strict)
+    else:
+        _active.strict = strict
+    return _active
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def observe_rpc(peer: str, msg_type: str = "") -> None:
+    w = _active
+    if w is not None:
+        w.observe_rpc(peer, msg_type)
+
+
+def observe_reply(reply: object) -> None:
+    w = _active
+    if w is not None:
+        w.observe_reply(reply)
+
+
+def observe_mint(deadline: object) -> None:
+    w = _active
+    if w is not None:
+        w.observe_mint(deadline)
+
+
+def push_deadline(deadline: object) -> bool:
+    """retrying_call's hook: mark ``deadline`` live on this thread for
+    the duration of the policy-wrapped call. Returns whether a pop is
+    owed (False when the witness is off: the off path must not touch
+    thread-locals)."""
+    if _active is None:
+        return False
+    _stack().append(deadline)
+    return True
+
+
+def pop_deadline(pushed: bool) -> None:
+    if pushed:
+        stack = _stack()
+        if stack:
+            stack.pop()
+
+
+class isolated:
+    """Context manager swapping in a fresh witness — tests that
+    deliberately provoke violations must not pollute the session-wide
+    zero-violation assertion."""
+
+    def __init__(self, strict: bool = False):
+        self._fresh = DeadlineWitness(strict=strict)
+        self._prev: Optional[DeadlineWitness] = None
+
+    def __enter__(self) -> DeadlineWitness:
+        global _active
+        self._prev = _active
+        _active = self._fresh
+        return self._fresh
+
+    def __exit__(self, *exc) -> None:
+        global _active
+        _active = self._prev

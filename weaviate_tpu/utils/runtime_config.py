@@ -234,3 +234,10 @@ HYBRID_DEVICE_FUSION = RUNTIME.register(
 # keyword scoring on the WAND/host tier
 HYBRID_SPARSE_DEVICE = RUNTIME.register(
     "hybrid_sparse_device", "auto", cast=str)
+# cold-tier blob op budget (tiering/coldstore.py): per-op deadline for
+# offload/hydrate/sweep blob traffic, surfaced by the errorflow lint's
+# budget pass. 0 = unset (follow the TenantColdStore constructor arg) —
+# hot-reloadable so an operator can stretch it while a slow object store
+# recovers instead of letting hydrations die mid-download.
+COLDSTORE_OP_BUDGET_S = RUNTIME.register(
+    "coldstore_op_budget_s", 0.0, cast=float)
