@@ -46,13 +46,16 @@ test:
 # mid-migration, crash-resume via the rebalance ledger), and the cold
 # tier / cluster backup scenarios (kill mid-offload and mid-backup,
 # bucket outages, 3-node backup restored into 5 nodes with zero lost
-# acked writes). Runs under both runtime witnesses (conftest default):
+# acked writes), and the closed-loop autoscaling diurnal ramp (3->6->3
+# under seeded faults with a leader killed between decision-journal
+# and actuation). Runs under both runtime witnesses (conftest default):
 # the session FAILS if any lock-order inversion or any serving-scope
 # RPC with no live deadline is observed — zero violations is an
 # asserted invariant of the chaos suite, not a hope.
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos_replication.py \
 		tests/test_rebalance.py tests/test_coldtier_chaos.py \
+		tests/test_autoscale.py \
 		-q -p no:cacheprovider
 
 # Boot a node on a loopback port, run a mixed search/ingest burst, and

@@ -255,6 +255,10 @@ CONFIG_METRICS = {
     # rides along (and must stay zero)
     "rebalance": (lambda m: m.startswith("rebalance_"),
                   lambda m: m.startswith("rebalance_p99_during_move_ms")),
+    # headline: advertised-p99-in-SLO fraction across the diurnal ramp;
+    # the lost-write count rides along (and must stay zero)
+    "autoscale": (lambda m: m.startswith("autoscale_"),
+                  lambda m: m.startswith("autoscale_p99_in_slo_pct")),
     # headline: reranked serving QPS; the quality-delta line rides along
     # (and is what the perf-flag verdict stands on)
     "rerank": (lambda m: m.startswith("rerank_"),
@@ -2282,6 +2286,233 @@ def bench_rebalance(n=20_000, d=64, shards=8, batch=8, k=10, iters=0,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_autoscale(n=12_000, d=64, shards=8, k=10, ramp_seconds=45.0):
+    """Closed-loop autoscaling under a diurnal ramp (docs/autoscale.md):
+    an in-proc 3-node cluster with the autoscaler armed serves sustained
+    ingest+search while the offered load (modeled p99, fed into each
+    node's AIMD limiter — the same signal path production reads) ramps
+    ~7x and back down. The loop must grow the cluster to the max-nodes
+    ceiling and shrink it back through the raft decision ledger. Journals
+    the fraction of evaluation samples whose advertised worst p99 sat
+    inside the SLO target (loop responsiveness — the breach windows ARE
+    the detection+actuation latency) and the lost-write count (acked
+    writes unreadable after convergence — must be zero)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from weaviate_tpu.cluster import ClusterNode, InProcTransport
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        FlatIndexConfig,
+        Property,
+        ReplicationConfig,
+        ShardingConfig,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+    from weaviate_tpu.utils.runtime_config import (
+        AUTOSCALE_COOLDOWN_S,
+        AUTOSCALE_ENABLED,
+        AUTOSCALE_MAX_NODES,
+        AUTOSCALE_MIN_NODES,
+        AUTOSCALE_P99_TARGET_MS,
+    )
+
+    rng = np.random.default_rng(13)
+    root = tempfile.mkdtemp(prefix="bench_autoscale_")
+    registry = {}
+    ids = [f"n{i}" for i in range(3)]
+    nodes = [ClusterNode(nid, ids, InProcTransport(registry, nid),
+                         f"{root}/{nid}") for nid in ids]
+    cluster = {nd.id: nd for nd in nodes}
+    retired = []
+    target_ms = 200.0
+    try:
+        AUTOSCALE_ENABLED.set_override(True)
+        AUTOSCALE_P99_TARGET_MS.set_override(target_ms)
+        AUTOSCALE_COOLDOWN_S.set_override(0.5)
+        AUTOSCALE_MIN_NODES.set_override(3)
+        AUTOSCALE_MAX_NODES.set_override(5)
+
+        t_deadline = time.monotonic() + 30
+        while not any(nd.raft.is_leader() for nd in nodes):
+            if time.monotonic() > t_deadline:
+                raise RuntimeError("no raft leader")
+            time.sleep(0.05)
+        leader = next(nd for nd in nodes if nd.raft.is_leader())
+        leader.create_collection(CollectionConfig(
+            name="Bench", properties=[Property(name="body")],
+            vector_config=FlatIndexConfig(distance="l2-squared",
+                                          precision="fp32"),
+            sharding=ShardingConfig(desired_count=shards),
+            replication=ReplicationConfig(factor=1)))
+        while not all(nd.db.has_collection("Bench") for nd in nodes):
+            time.sleep(0.05)
+
+        vecs = rng.standard_normal((n, d)).astype(np.float32)
+
+        def obj(i):
+            return StorageObject(uuid=f"{i:032x}", collection="Bench",
+                                 properties={"body": f"doc {i}"},
+                                 vector=vecs[i % n])
+
+        for lo in range(0, n, 1024):
+            nodes[0].put_batch(
+                "Bench", [obj(i) for i in range(lo, min(lo + 1024, n))],
+                consistency="ONE")
+
+        def live():
+            return list(cluster.values())
+
+        def any_live():
+            for nd in live():
+                if nd.raft.is_leader():
+                    return nd
+            return live()[0]
+
+        prov_state = {"next": 3}
+
+        def provision():
+            nid = f"n{prov_state['next']}"
+            prov_state["next"] += 1
+            joiner = ClusterNode(
+                nid, sorted(set(any_live().all_nodes) | {nid}),
+                InProcTransport(registry, nid), f"{root}/{nid}")
+            tune(joiner)
+            cluster[nid] = joiner
+            return nid
+
+        def tune(nd):
+            nd.db.qos.limiter.window = 4
+            a = nd.autoscaler
+            a.provision_fn = provision
+            a.decommission_fn = retired.append
+
+        for nd in nodes:
+            tune(nd)
+
+        # modeled offered load: p99 = load seconds over live capacity,
+        # so joins genuinely lower the advertised signal (closed loop)
+        phase = {"load": 0.9}  # 3 nodes -> 300ms: over the 200ms target
+
+        def feed():
+            members = live()
+            lat = phase["load"] / max(1, len(members))
+            for nd in members:
+                lim = nd.db.qos.limiter
+                for _ in range(lim.window):
+                    lim.record(lat)
+
+        acked, write_errs = [], []
+        stop = threading.Event()
+
+        def writer():
+            i = n
+            while not stop.is_set():
+                try:
+                    any_live().put_batch("Bench", [obj(i)],
+                                         consistency="ONE")
+                    acked.append(f"{i:032x}")
+                except Exception as e:  # noqa: BLE001 — counted, reported
+                    write_errs.append(str(e))
+                i += 1
+                time.sleep(0.005)
+
+        def searcher():
+            q = vecs[:1]
+            while not stop.is_set():
+                try:
+                    any_live().vector_search("Bench", q, k=k)
+                except Exception:  # noqa: BLE001 — availability noise
+                    pass
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=writer, daemon=True),
+                   threading.Thread(target=searcher, daemon=True)]
+        for t in threads:
+            t.start()
+
+        slo_samples = []  # one advertised-p99-vs-target sample per tick
+
+        def drive(load, want_members, deadline_s):
+            phase["load"] = load
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                feed()
+                for nd in live():
+                    try:
+                        st = nd.autoscaler.tick()
+                    except Exception:  # noqa: BLE001 — deposed leader race
+                        continue
+                    if st.get("leader"):
+                        sig = st.get("last_signals") or {}
+                        if "p99_worst_ms" in sig:
+                            slo_samples.append(
+                                sig["p99_worst_ms"] <= target_ms)
+                while retired:
+                    gone = cluster.pop(retired.pop(), None)
+                    if gone is not None:
+                        gone.quiesce()
+                        gone.close()
+                ledger = any_live().fsm.autoscale_ledger
+                settled = all(e["state"] in ("done", "aborted")
+                              for e in ledger.values())
+                if len(any_live().all_nodes) == want_members and settled:
+                    return True
+                time.sleep(0.1)
+            return False
+
+        t0 = time.perf_counter()
+        grew = drive(0.9, 5, ramp_seconds)  # daytime: 3 -> 5
+        t_grow = time.perf_counter() - t0
+        shrank = drive(0.15, 3, ramp_seconds)  # night: 5 -> 3
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+        ledger = any_live().fsm.autoscale_ledger
+        done = [e for e in ledger.values() if e["state"] == "done"]
+        # convergence, then the zero-lost-writes audit
+        survivors = list(cluster.values())
+        for _ in range(30):
+            if sum(nd.anti_entropy_once("Bench")
+                   for nd in survivors) == 0:
+                break
+        reader = survivors[0]
+        lost = 0
+        for uid in acked:
+            if reader.get("Bench", uid, consistency="ONE") is None:
+                lost += 1
+
+        in_slo = (100.0 * sum(slo_samples) / len(slo_samples)
+                  if slo_samples else 0.0)
+        _emit({
+            "metric": "autoscale_p99_in_slo_pct",
+            "value": round(in_slo, 1), "unit": "%",
+            "vs_baseline": 0, "n": n, "d": d, "shards": shards,
+            "target_ms": target_ms, "ticks": len(slo_samples),
+            "grew_to_5": grew, "shrank_to_3": shrank,
+            "grow_seconds": round(t_grow, 2),
+            "decisions_out": sum(e["direction"] == "out" for e in done),
+            "decisions_in": sum(e["direction"] == "in" for e in done),
+        })
+        _emit({
+            "metric": "autoscale_lost_writes", "value": lost,
+            "unit": "count", "vs_baseline": 0,
+            "acked_writes": len(acked), "write_errors": len(write_errs),
+        })
+    finally:
+        for dv in (AUTOSCALE_ENABLED, AUTOSCALE_P99_TARGET_MS,
+                   AUTOSCALE_COOLDOWN_S, AUTOSCALE_MIN_NODES,
+                   AUTOSCALE_MAX_NODES):
+            dv.clear_override()
+        for nd in cluster.values():
+            nd.quiesce()
+        for nd in cluster.values():
+            nd.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_coldtier(n=64_000, d=256, tenants=8, k=10, cluster_objs=400,
                    shards=6):
     """Bottomless cold tier + cluster backup (docs/backup.md): three
@@ -2986,6 +3217,7 @@ CONFIGS = {
     "ingestmp": bench_ingest_parallel,
     "ingestserve": bench_ingest_serving,
     "rebalance": bench_rebalance,
+    "autoscale": bench_autoscale,
     "coldtier": bench_coldtier,
     "coldstart": bench_coldstart,
     "rerank": bench_rerank,
@@ -2996,7 +3228,7 @@ CONFIGS = {
 
 # configs that touch no device: they run even when the TPU probe fails
 CPU_ONLY = ("bm25", "bm25seg", "ingest", "ingestmp", "rebalance",
-            "coldtier")
+            "autoscale", "coldtier")
 
 # ---------------------------------------------------------------------------
 # smoke mode: every config end-to-end at ~1/50 scale on CPU (<10 min total),
@@ -3147,6 +3379,9 @@ SMOKE = {
     "ingestserve": dict(n=6_000, d=32, batch=500),
     # semantics check (moves happen, nothing lost), not a latency claim
     "rebalance": dict(n=2_000, shards=4, load_seconds=1.5),
+    # loop semantics check (grows, shrinks, nothing lost), not a
+    # responsiveness claim
+    "autoscale": dict(n=1_500, shards=4, ramp_seconds=30.0),
     # offload/hydrate/backup semantics check, not a throughput claim
     "coldtier": dict(n=2_048, d=32, tenants=4, cluster_objs=60, shards=4),
     # three subprocess builds: keep each tiny (restart semantics check)

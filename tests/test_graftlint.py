@@ -2030,6 +2030,116 @@ class TestUnverifiedRemoteDelete:
         assert "unverified-remote-delete" not in rule_ids(res)
 
 
+class TestSingletonCycleWithoutLeaderCheck:
+    RULE = "singleton-cycle-without-leader-check"
+
+    def test_registered_fn_submitting_raft_flagged(self):
+        res = run("""
+            def scale_cycle(node):
+                node.raft.submit({"op": "autoscale_decision"})
+
+            node.db.cycles.register("scale", scale_cycle, 5.0)
+        """, rel=CLUSTER)
+        vs = [v for v in res.violations if v.rule == self.RULE]
+        assert len(vs) == 1
+        assert vs[0].severity == "error"
+
+    def test_tick_calling_join_flagged(self):
+        res = run("""
+            class Loop:
+                def tick(self):
+                    self.node.rebalancer.join("n4")
+        """, rel=CLUSTER)
+        assert rule_ids(res).count(self.RULE) == 1
+
+    def test_leader_gate_before_actuation_passes(self):
+        res = run("""
+            class Loop:
+                def tick(self):
+                    if not self.node.raft.is_leader():
+                        return
+                    self.node.raft.submit({"op": "autoscale_decision"})
+                    self.node.rebalancer.drain("n4")
+        """, rel=CLUSTER)
+        assert self.RULE not in rule_ids(res)
+
+    def test_actuation_laundered_through_helper_flagged(self):
+        res = run("""
+            class Loop:
+                def tick(self):
+                    self._act()
+
+                def _act(self):
+                    self.node.rebalancer.drain("n4")
+        """, rel=CLUSTER)
+        assert rule_ids(res).count(self.RULE) == 1
+
+    def test_consult_inside_helper_on_path_passes(self):
+        res = run("""
+            class Loop:
+                def tick(self):
+                    self._act()
+
+                def _act(self):
+                    if not self.node.raft.is_leader():
+                        return
+                    self.node.rebalancer.drain("n4")
+        """, rel=CLUSTER)
+        assert self.RULE not in rule_ids(res)
+
+    def test_consult_after_direct_actuation_flagged(self):
+        res = run("""
+            class Loop:
+                def tick(self):
+                    self.node.raft.submit({"op": "autoscale_decision"})
+                    if not self.node.raft.is_leader():
+                        return
+        """, rel=CLUSTER)
+        assert rule_ids(res).count(self.RULE) == 1
+
+    def test_registered_lambda_flagged(self):
+        res = run("""
+            db.cycles.register("drain", lambda: node.rebalancer.drain("n2"),
+                               5.0)
+        """, rel=CLUSTER)
+        assert rule_ids(res).count(self.RULE) == 1
+
+    def test_non_actuating_cycle_passes(self):
+        res = run("""
+            class Loop:
+                def gc_cycle(self):
+                    self.sweep_staging()
+
+                def sweep_staging(self):
+                    return 0
+        """, rel=CLUSTER)
+        assert self.RULE not in rule_ids(res)
+
+    def test_thread_join_not_actuation(self):
+        res = run("""
+            class Loop:
+                def tick(self):
+                    self.worker.join(timeout=1.0)
+        """, rel=CLUSTER)
+        assert self.RULE not in rule_ids(res)
+
+    def test_out_of_scope_dir_ignored(self):
+        res = run("""
+            class Loop:
+                def tick(self):
+                    self.node.raft.submit({"op": "x"})
+        """, rel=COLD)
+        assert self.RULE not in rule_ids(res)
+
+    def test_suppressible_with_reason(self):
+        res = run("""
+            class Loop:
+                def tick(self):  # graftlint: allow[singleton-cycle-without-leader-check] reason=single-node deployment, no peers to split-brain with
+                    self.node.raft.submit({"op": "x"})
+        """, rel=CLUSTER)
+        assert self.RULE not in rule_ids(res)
+
+
 class TestUnwarmedJitProgram:
     @pytest.fixture(autouse=True)
     def _manifest(self):

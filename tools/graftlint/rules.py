@@ -1276,6 +1276,148 @@ class UnverifiedRemoteDelete(Rule):
                 severity=SEV_ERROR)
 
 
+class SingletonCycleWithoutLeaderCheck(Rule):
+    id = "singleton-cycle-without-leader-check"
+    description = (
+        "cycle-runner-registered function (or conventional tick/*_cycle "
+        "entrypoint) in cluster/ that submits raft commands or calls "
+        "rebalancer join/drain without consulting raft leadership"
+    )
+    rationale = (
+        "Background cycles run on EVERY node, but a policy loop that "
+        "journals decisions or mutates membership must be a raft-leader "
+        "singleton: two nodes acting on the same stale pressure view "
+        "provision twice, drain the wrong node, or double-journal one "
+        "decision — split-brain actuation, the exact bug class the "
+        "autoscaler introduces (cluster/autoscale.py gates its tick on "
+        "``raft.is_leader()`` before reading a single signal). The rule "
+        "covers functions registered on a ``*.cycles.register(...)`` "
+        "runner in the same file plus the conventional entrypoint names "
+        "(``tick``, ``*_cycle``), and follows same-file helper calls — "
+        "an actuation laundered through one private helper is as "
+        "dangerous as a direct one. Consult ``is_leader`` (or "
+        "``.leader()``) in the entrypoint before the actuation, or in a "
+        "helper on the path to it."
+    )
+
+    _DIRS = ("weaviate_tpu/cluster/",)
+    _MAX_DEPTH = 5
+
+    @staticmethod
+    def _is_actuation(call: ast.Call) -> bool:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return False
+        recv = (dotted_name(f.value) or "").lower()
+        if f.attr == "submit" and "raft" in recv:
+            return True
+        return f.attr in ("join", "drain") and "rebalancer" in recv
+
+    @staticmethod
+    def _consults_leadership(node: ast.AST) -> list[int]:
+        """Line numbers of leadership consults in the subtree."""
+        out = []
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and n.attr == "is_leader":
+                out.append(n.lineno)
+            elif isinstance(n, ast.Name) and n.id == "is_leader":
+                out.append(n.lineno)
+            elif (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "leader"):
+                out.append(n.lineno)
+        return out
+
+    @staticmethod
+    def _callee_names(fn: ast.AST) -> list[str]:
+        """Bare names of same-file-resolvable callees: plain calls and
+        ``self.<helper>(...)`` method calls."""
+        names = []
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if isinstance(f, ast.Name):
+                names.append(f.id)
+            elif isinstance(f, ast.Attribute):
+                recv = dotted_name(f.value) or ""
+                if recv == "self" or recv.startswith("self."):
+                    names.append(f.attr)
+        return names
+
+    def _registered_fns(self, ctx, fn_map: dict) -> dict:
+        """Candidate entrypoints: {ast node -> report node}. Collects
+        functions handed to a ``*.cycles.register(...)`` call (by name
+        for defs, directly for lambdas) plus the conventional names."""
+        out: dict = {}
+        for call in ctx.walk(ast.Call):
+            f = call.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "register"):
+                continue
+            recv = (dotted_name(f.value) or "").lower()
+            if "cycles" not in recv:
+                continue
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            for a in args:
+                if isinstance(a, ast.Lambda):
+                    out[a] = call
+                elif isinstance(a, ast.Attribute) and a.attr in fn_map:
+                    out[fn_map[a.attr]] = fn_map[a.attr]
+                elif isinstance(a, ast.Name) and a.id in fn_map:
+                    out[fn_map[a.id]] = fn_map[a.id]
+        for name, fn in fn_map.items():
+            if name == "tick" or name.endswith("_cycle"):
+                out.setdefault(fn, fn)
+        return out
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, self._DIRS):
+            return
+        fn_map = {fn.name: fn for fn in ctx.walk(ast.FunctionDef)}
+
+        def reach(fn: ast.AST, depth: int, seen: set) -> tuple:
+            """(direct actuation linenos, any reachable actuation,
+            any reachable-helper leadership consult)."""
+            direct = [c.lineno for c in ast.walk(fn)
+                      if isinstance(c, ast.Call) and self._is_actuation(c)]
+            any_act = bool(direct)
+            helper_consult = False
+            if depth < self._MAX_DEPTH:
+                for name in self._callee_names(fn):
+                    callee = fn_map.get(name)
+                    if callee is None or callee in seen:
+                        continue
+                    seen.add(callee)
+                    _, act, consult = reach(callee, depth + 1, seen)
+                    any_act = any_act or act
+                    helper_consult = (helper_consult or consult
+                                      or bool(self._consults_leadership(
+                                          callee)))
+            return direct, any_act, helper_consult
+
+        for fn, report_at in self._registered_fns(ctx, fn_map).items():
+            direct, any_act, helper_consult = reach(fn, 0, {fn})
+            if not any_act:
+                continue
+            own = self._consults_leadership(fn)
+            first_act = min(direct) if direct else (1 << 30)
+            # a direct actuation needs a consult BEFORE it; actuation
+            # buried in helpers is covered by any consult on the path
+            consulted = (any(ln <= first_act for ln in own)
+                         or (not direct and bool(own))
+                         or helper_consult)
+            if consulted:
+                continue
+            name = getattr(fn, "name", "<lambda>")
+            yield self.violation(
+                ctx, report_at,
+                f"cycle entrypoint {name}() submits raft commands or "
+                "calls join/drain without consulting raft leadership "
+                "first — background cycles run on every node; gate the "
+                "actuation on is_leader() or it runs split-brain",
+                severity=SEV_ERROR)
+
+
 class SuppressionMissingReason(Rule):
     id = "suppression-missing-reason"
     description = (
@@ -1562,6 +1704,7 @@ ALL_RULES: tuple = (
     BlockingCallWithoutDeadline(),
     UnwarmedJitProgram(),
     UnverifiedRemoteDelete(),
+    SingletonCycleWithoutLeaderCheck(),
     SuppressionMissingReason(),
 )
 

@@ -163,7 +163,7 @@ class RestAPI:
         "root", "meta", "ready", "live", "metrics", "openapi",
         "oidc_discovery", "pprof_profile", "pprof_heap", "debug_traces",
         "debug_config", "debug_telemetry", "debug_cluster",
-        "debug_compile",
+        "debug_compile", "cluster_autoscale",
     })
     # endpoint -> admission lane; anything unlisted is background
     # (schema/authz/backup/replication mutations: important, not latency-
@@ -258,6 +258,8 @@ class RestAPI:
                  methods=["GET", "POST"]),
             Rule("/v1/cluster/drain/<node>", endpoint="cluster_drain",
                  methods=["POST"]),
+            Rule("/v1/cluster/autoscale", endpoint="cluster_autoscale",
+                 methods=["GET", "POST"]),
             Rule("/v1/replication/replicate", endpoint="replicate",
                  methods=["POST"]),
             Rule("/v1/replication/replicate/list",
@@ -1278,6 +1280,33 @@ class RestAPI:
                           name=f"drain-{node}").start()
         return _json_response({"draining": node, "remove": remove},
                               status=202)
+
+    def on_cluster_autoscale(self, request):
+        """Closed-loop autoscaler control (docs/autoscale.md). GET: the
+        loop's status (knob state, breach counters, cooldown, decision
+        ledger). POST {"action": enable|disable|evaluate}: flip the
+        hot-reloadable autoscale_enabled knob or force one leader-side
+        evaluation. QoS-exempt: disarming the loop mid-incident must
+        work exactly when the cluster is overloaded."""
+        c = self._cluster_or_422()
+        if request.method == "GET":
+            self._authz(request, "read_cluster")
+            return _json_response({"autoscale": c.autoscaler.status()})
+        self._authz(request, "manage_cluster")
+        from weaviate_tpu.utils.runtime_config import AUTOSCALE_ENABLED
+
+        action = (self._body(request) or {}).get("action", "")
+        if action == "enable":
+            AUTOSCALE_ENABLED.set_override(True)
+        elif action == "disable":
+            AUTOSCALE_ENABLED.set_override(False)
+        elif action == "evaluate":
+            return _json_response(
+                {"autoscale": c.autoscaler.tick(force=True)})
+        else:
+            _abort(422, f"unknown action {action!r}; expected "
+                        "enable | disable | evaluate")
+        return _json_response({"autoscale": c.autoscaler.status()})
 
     def on_debug_cluster(self, request):
         """Operator cluster view: membership + gossip liveness, per-node

@@ -4,6 +4,7 @@ Reference: ``cluster/`` (raft store, router, replication engine) +
 ``usecases/replica`` (coordinator/finder/repairer) + ``usecases/sharding``.
 """
 
+from weaviate_tpu.cluster.autoscale import Autoscaler
 from weaviate_tpu.cluster.chaos import ChaosTransport, LinkFaults
 from weaviate_tpu.cluster.fsm import SchemaFSM
 from weaviate_tpu.cluster.hashtree import HashTree
@@ -39,5 +40,5 @@ __all__ = [
     "InProcTransport", "TcpTransport", "TransportError",
     "ChaosTransport", "LinkFaults", "RetryPolicy", "Deadline",
     "DeadlineExceeded", "CircuitBreaker", "BreakerBoard",
-    "Rebalancer", "Move", "plan_moves", "CrashInjected",
+    "Rebalancer", "Move", "plan_moves", "CrashInjected", "Autoscaler",
 ]

@@ -1,0 +1,494 @@
+"""Closed-loop autoscaler: QoS pressure -> rebalancer -> node join/drain.
+
+Every elasticity primitive already exists one layer down — lane shed
+rates and the AIMD p99 (serving/qos.py), ingest queue depth + compaction
+debt (core/db.py), per-node HBM adverts riding gossip, and a
+crash-resumable join/drain (cluster/rebalance.py). This module closes
+the loop: a **raft-leader singleton** policy on the DB cycle runner that
+turns those signals into membership changes, safely.
+
+The control-loop literature is unambiguous that naive feedback on noisy
+tail-latency signals flaps, so the policy is hysteretic end to end:
+
+- SIGNALS: the leader aggregates the gossiped node-meta of every live
+  member — worst p99 EWMA vs the ``autoscale_p99_target_ms`` SLO, worst
+  per-lane shed fraction, aggregate HBM used/budget, total ingest queue
+  depth + compaction debt. One node in pain is enough to scale out
+  (max, not mean: averages hide the hot shard).
+- HYSTERESIS: pressure must breach for ``breach_ticks`` CONSECUTIVE
+  evaluations before anything actuates; the scale-in band sits far
+  below the scale-out band; any actuation arms an
+  ``autoscale_cooldown_s`` quiet window; and the loop never decides
+  while a rebalance-ledger entry is live (the cluster is mid-reshape —
+  deciding against that view double-counts the fix in flight).
+- DURABILITY: a decision is raft-journaled (``autoscale_decision``)
+  BEFORE actuation. A leader crash mid-scale leaves a ledger entry the
+  next leader adopts (actuating entries resume — join and drain are
+  idempotent by construction) or aborts (decided-but-unactuated entries
+  re-evaluate fresh), exactly the rebalance-move contract one level up.
+- ACTUATION reuses the proven machinery: scale-out = ``provision_fn``
+  -> ``Rebalancer.join`` (prewarm-before-traffic, so the joiner serves
+  its first query compile-free); scale-in = coldest node by tiering
+  heat -> ``Rebalancer.drain`` (writes are never rejected mid-drain),
+  then ``decommission_fn``. Every decision is one ``autoscale.decide``
+  trace with provision/join/drain legs as children.
+
+The loop ships DISABLED (``autoscale_enabled`` knob) and can be
+disarmed mid-incident via the overrides file or
+``POST /v1/cluster/autoscale`` — see docs/autoscale.md for the runbook.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+from weaviate_tpu.cluster.fsm import AUTOSCALE_TERMINAL, LEDGER_TERMINAL
+from weaviate_tpu.cluster.rebalance import CrashInjected, ReplicationError
+from weaviate_tpu.monitoring.metrics import (
+    AUTOSCALE_BREACH_TICKS,
+    AUTOSCALE_COOLDOWN_REMAINING,
+    AUTOSCALE_DECISIONS,
+)
+from weaviate_tpu.monitoring.tracing import TRACER
+
+logger = logging.getLogger("weaviate_tpu.autoscale")
+
+# cycle-runner interval of the evaluation tick; with the default
+# breach_ticks=3 the loop needs ~15s of sustained pressure to act
+INTERVAL_S = 5.0
+
+
+class Autoscaler:
+    """Leader-singleton scale policy. One instance per node (lazy on
+    :class:`~weaviate_tpu.cluster.node.ClusterNode`); only the raft
+    leader's ticks ever evaluate or actuate."""
+
+    def __init__(self, node,
+                 provision_fn: Optional[Callable[[], str]] = None,
+                 decommission_fn: Optional[Callable[[str], None]] = None,
+                 breach_ticks: int = 3,
+                 shed_high: float = 0.05,
+                 hbm_high: float = 0.90,
+                 hbm_low: float = 0.50,
+                 p99_low_frac: float = 0.30,
+                 signals_fn: Optional[Callable[[], dict]] = None):
+        self.node = node
+        # environment hooks: provision_fn boots a fresh node and returns
+        # its id (cloud: instance template; tests/bench: in-proc node
+        # factory); decommission_fn releases a drained one. Without a
+        # provision hook the loop observes but never scales out.
+        self.provision_fn = provision_fn
+        self.decommission_fn = decommission_fn
+        self.breach_ticks = max(1, int(breach_ticks))
+        self.shed_high = float(shed_high)
+        self.hbm_high = float(hbm_high)
+        self.hbm_low = float(hbm_low)
+        self.p99_low_frac = float(p99_low_frac)
+        self.signals_fn = signals_fn  # test override: injected pressure
+        self._breach_out = 0
+        self._breach_in = 0
+        self._cooldown_until = 0.0
+        self._lock = threading.Lock()
+        self._actuating = False
+        self._last_signals: dict = {}
+        self._last_refusal = ""
+        # chaos hook (same contract as Rebalancer.crash_points): the
+        # worker dies WITHOUT cleanup at these points, leaving the
+        # journaled entry for the next leader to adopt or abort
+        self.crash_points: set[str] = set()
+
+    # -- knobs -------------------------------------------------------------
+    @staticmethod
+    def _knobs() -> dict:
+        from weaviate_tpu.utils.runtime_config import (
+            AUTOSCALE_COOLDOWN_S,
+            AUTOSCALE_ENABLED,
+            AUTOSCALE_MAX_NODES,
+            AUTOSCALE_MIN_NODES,
+            AUTOSCALE_P99_TARGET_MS,
+        )
+
+        return {
+            "enabled": bool(AUTOSCALE_ENABLED.get()),
+            "p99_target_ms": float(AUTOSCALE_P99_TARGET_MS.get()),
+            "cooldown_s": float(AUTOSCALE_COOLDOWN_S.get()),
+            "min_nodes": max(1, int(AUTOSCALE_MIN_NODES.get())),
+            "max_nodes": max(1, int(AUTOSCALE_MAX_NODES.get())),
+        }
+
+    # -- signal aggregation ------------------------------------------------
+    def signals(self) -> dict:
+        """Cluster-wide pressure view, assembled from the freshest gossip
+        node-meta (this node's own advert is read directly — a singleton
+        that never completed a gossip round still sees itself)."""
+        if self.signals_fn is not None:
+            return dict(self.signals_fn())
+        n = self.node
+        meta = n.gossip.node_meta()
+        meta[n.id] = n._capacity_meta()
+        live = [nid for nid in n.all_nodes
+                if nid == n.id or n.gossip.alive(nid)]
+        p99s, sheds = [0.0], [0.0]
+        budget = used = 0.0
+        depth = debt = 0
+        for nid in live:
+            m = meta.get(nid) or {}
+            srv = m.get("serving") or {}
+            p99s.append(float(srv.get("p99_ewma_ms", 0.0) or 0.0))
+            rates = srv.get("shed_rate") or {}
+            sheds.append(max((float(v) for v in rates.values()),
+                             default=0.0))
+            depth += int(srv.get("ingest_queue_depth", 0) or 0)
+            debt += int(srv.get("compaction_debt_bytes", 0) or 0)
+            budget += float(m.get("hbm_budget", 0) or 0)
+            used += float(m.get("hbm_used", 0) or 0)
+        return {
+            "nodes": len(live),
+            "p99_worst_ms": max(p99s),
+            "shed_rate_max": max(sheds),
+            "hbm_pressure": (used / budget) if budget > 0 else 0.0,
+            "ingest_queue_depth": depth,
+            "compaction_debt_bytes": debt,
+        }
+
+    def _classify(self, sig: dict, knobs: dict) -> str:
+        """'high' / 'low' / 'ok' — the two actionable bands are separated
+        by a wide dead zone, so a signal hovering at the scale-out
+        threshold can never alternate between opposite decisions."""
+        from weaviate_tpu.utils.runtime_config import (
+            INGEST_SHED_QUEUE_DEPTH,
+        )
+
+        target = knobs["p99_target_ms"]
+        ingest_cap = int(INGEST_SHED_QUEUE_DEPTH.get())
+        if ((target > 0 and sig["p99_worst_ms"] > target)
+                or sig["shed_rate_max"] > self.shed_high
+                or sig["hbm_pressure"] > self.hbm_high
+                or (ingest_cap > 0
+                    and sig["ingest_queue_depth"] >= ingest_cap)):
+            return "high"
+        if (sig["p99_worst_ms"] < self.p99_low_frac * target
+                and sig["shed_rate_max"] < 0.001
+                and sig["hbm_pressure"] < self.hbm_low):
+            return "low"
+        return "ok"
+
+    # -- ledger helpers ----------------------------------------------------
+    def _live_decision(self) -> Optional[dict]:
+        for e in self.node.fsm.autoscale_ledger.values():
+            if e["state"] not in AUTOSCALE_TERMINAL:
+                return dict(e)
+        return None
+
+    def _rebalance_busy(self) -> bool:
+        return any(e["state"] not in LEDGER_TERMINAL
+                   for e in self.node.fsm.rebalance_ledger.values())
+
+    def _advance(self, e: dict, state: str, node: str = "",
+                 error: str = "") -> None:
+        cmd = {"op": "autoscale_advance", "id": e["id"], "state": state,
+               "coordinator": self.node.id, "ts": time.time()}
+        if node:
+            cmd["node"] = node
+        if error:
+            cmd["error"] = error
+        r = self.node.raft.submit(cmd)
+        if not r.get("ok"):
+            raise ReplicationError(
+                f"autoscale advance to {state!r} failed: {r.get('error')}")
+        e["state"] = state
+        if node:
+            e["node"] = node
+
+    def _maybe_crash(self, point: str) -> None:
+        if point in self.crash_points:
+            raise CrashInjected(point)
+
+    # -- the evaluation tick (cycle runner entrypoint) ---------------------
+    def tick(self, force: bool = False) -> dict:
+        """One closed-loop evaluation. Called by the DB cycle runner
+        every ``INTERVAL_S`` on every node; everything after the
+        leadership gate runs ONLY on the raft leader — followers reset
+        their counters so a newly elected leader starts with a clean
+        fuse instead of a predecessor's half-burnt one. ``force`` (the
+        operator's force-evaluate) skips the enabled/cooldown gates and
+        acts on a single breach, but never skips the safety guards."""
+        knobs = self._knobs()
+        n = self.node
+        # leadership FIRST: only the leader may journal or actuate — a
+        # follower acting on its stale view is the split-brain-actuation
+        # bug class graftlint's singleton-cycle-without-leader-check
+        # exists to catch
+        if not n.raft.is_leader() or not (knobs["enabled"] or force):
+            self._reset_counters()
+            return self.status()
+        self.adopt_pending()
+        with self._lock:
+            busy = self._actuating
+        if busy or self._live_decision() is not None:
+            return self.status()
+        remaining = max(0.0, self._cooldown_until - time.monotonic())
+        AUTOSCALE_COOLDOWN_REMAINING.set(round(remaining, 2))
+        if remaining > 0 and not force:
+            return self.status()
+        if self._rebalance_busy():
+            # an operator-driven (or adopted) reshape is in flight; its
+            # routing flips will move the very signals this tick reads
+            self._last_refusal = "rebalance ledger live"
+            return self.status()
+        sig = self.signals()
+        self._last_signals = sig
+        band = self._classify(sig, knobs)
+        if band == "high":
+            self._breach_out += 1
+            self._breach_in = 0
+        elif band == "low":
+            self._breach_in += 1
+            self._breach_out = 0
+        else:
+            self._reset_counters()
+        AUTOSCALE_BREACH_TICKS.set(max(self._breach_out, self._breach_in))
+        need = 1 if force else self.breach_ticks
+        if self._breach_out >= need:
+            self._act("out", sig, knobs)
+        elif self._breach_in >= need:
+            self._act("in", sig, knobs)
+        return self.status()
+
+    def _reset_counters(self) -> None:
+        self._breach_out = 0
+        self._breach_in = 0
+        AUTOSCALE_BREACH_TICKS.set(0)
+
+    # -- decide + journal --------------------------------------------------
+    def _act(self, direction: str, sig: dict, knobs: dict) -> None:
+        n = self.node
+        if direction == "out":
+            if self.provision_fn is None:
+                self._last_refusal = "no provision hook"
+                self._breach_out = 0
+                return
+            if sig["nodes"] >= knobs["max_nodes"]:
+                self._last_refusal = (
+                    f"at max_nodes ({sig['nodes']}/{knobs['max_nodes']})")
+                self._breach_out = 0
+                return
+            victim = ""
+            reason = (f"p99 {sig['p99_worst_ms']:.0f}ms / shed "
+                      f"{sig['shed_rate_max']:.3f} / hbm "
+                      f"{sig['hbm_pressure']:.2f} over band for "
+                      f"{self._breach_out} ticks")
+        else:
+            floor = max(knobs["min_nodes"], self._factor_floor())
+            if sig["nodes"] - 1 < floor:
+                self._last_refusal = (
+                    f"scale-in would breach floor {floor} "
+                    f"(min_nodes/replication factor)")
+                self._breach_in = 0
+                return
+            victim = self._coldest_node()
+            if not victim:
+                self._last_refusal = "no drainable node (leader excluded)"
+                self._breach_in = 0
+                return
+            reason = (f"p99 {sig['p99_worst_ms']:.0f}ms / shed "
+                      f"{sig['shed_rate_max']:.3f} / hbm "
+                      f"{sig['hbm_pressure']:.2f} under band for "
+                      f"{self._breach_in} ticks")
+        entry = {
+            "id": uuid.uuid4().hex[:12],
+            "direction": direction,
+            "node": victim,
+            "coordinator": n.id,
+            "created_ts": time.time(),
+            "reason": reason,
+        }
+        r = n.raft.submit({"op": "autoscale_decision", "entry": entry})
+        if not r.get("ok"):
+            # a racing decision (another leader's, adopted late) holds
+            # the singleton slot; keep the fuse burnt and retry next tick
+            self._last_refusal = f"journal refused: {r.get('error')}"
+            return
+        AUTOSCALE_DECISIONS.inc(direction=direction)
+        self._last_refusal = ""
+        self._reset_counters()
+        entry["state"] = "decided"
+        logger.info("autoscale decision %s: scale %s (%s)%s", entry["id"],
+                    direction, reason,
+                    f" victim={victim}" if victim else "")
+        self._spawn(entry)
+
+    def _spawn(self, entry: dict) -> None:
+        with self._lock:
+            self._actuating = True
+        threading.Thread(target=self._worker, args=(entry,), daemon=True,
+                         name=f"autoscale-{entry['id']}").start()
+
+    def _worker(self, entry: dict) -> None:
+        try:
+            self._run_decision(entry)
+        except CrashInjected:
+            # simulated leader death mid-scale: no abort, no cleanup —
+            # the journaled entry is the next leader's to adopt
+            logger.warning("autoscale worker crash injected at decision "
+                           "%s", entry["id"])
+        except Exception as e:
+            logger.warning("autoscale decision %s (%s) failed in state "
+                           "%s: %s — aborting via ledger", entry["id"],
+                           entry["direction"], entry["state"], e)
+            try:
+                self._advance(entry, "aborted", error=str(e))
+            except Exception:
+                logger.exception("abort of decision %s failed; entry "
+                                 "left for adoption", entry["id"])
+        finally:
+            with self._lock:
+                self._actuating = False
+            # cooldown arms on EVERY outcome: a failed actuation must
+            # not be retried at tick frequency
+            self._cooldown_until = (time.monotonic()
+                                    + self._knobs()["cooldown_s"])
+
+    # -- actuation (the phase machine) -------------------------------------
+    def _run_decision(self, e: dict) -> None:
+        """Drive one journaled decision from its current state to
+        terminal. Entered fresh after the journal OR mid-state on
+        leader takeover — join and drain are idempotent/re-runnable, so
+        re-execution from the journaled phase is safe."""
+        n = self.node
+        root = TRACER.span(
+            "autoscale.decide", parent=None, decision_id=e["id"],
+            direction=e["direction"], reason=e.get("reason", ""),
+            start_state=e["state"], node=n.id)
+        with root:
+            if e["state"] == "decided":
+                self._maybe_crash("actuate")
+                if e["direction"] == "out" and not e.get("node"):
+                    with TRACER.span("autoscale.provision"):
+                        nid = self.provision_fn()
+                    self._advance(e, "actuating", node=nid)
+                else:
+                    self._advance(e, "actuating")
+            if e["state"] == "actuating":
+                if e["direction"] == "out":
+                    self._maybe_crash("join")
+                    with TRACER.span("autoscale.join", joiner=e["node"]):
+                        n.rebalancer.join(e["node"])
+                else:
+                    self._maybe_crash("drain")
+                    with TRACER.span("autoscale.drain", victim=e["node"]):
+                        n.rebalancer.drain(e["node"])
+                    if self.decommission_fn is not None:
+                        self.decommission_fn(e["node"])
+                self._advance(e, "done")
+        logger.info("autoscale decision %s done (scale %s, node %s)",
+                    e["id"], e["direction"], e.get("node", ""))
+
+    # -- takeover (next-leader adoption) -----------------------------------
+    def adopt_pending(self) -> dict[str, str]:
+        """Leader-crash recovery: every non-terminal decision whose
+        coordinator is this node (a previous incarnation) or dead per
+        gossip is adopted. Entries still ``decided`` are ABORTED — the
+        dead leader's pressure read is stale, and re-evaluating fresh is
+        strictly safer than provisioning against it; ``actuating``
+        entries have a journaled target node, so the actuation resumes
+        to completion. Returns id -> action."""
+        n = self.node
+        out: dict[str, str] = {}
+        for e in sorted(n.fsm.autoscale_ledger.values(),
+                        key=lambda x: x.get("created_ts", 0.0)):
+            if e["state"] in AUTOSCALE_TERMINAL:
+                continue
+            with self._lock:
+                if self._actuating:
+                    return out  # our own live worker owns the singleton
+            coord = e.get("coordinator", "")
+            if coord != n.id and n.gossip.alive(coord):
+                continue  # its coordinator is alive and responsible
+            e = dict(e)
+            try:
+                if e["state"] == "decided":
+                    self._advance(e, "aborted",
+                                  error="aborted on adopt: coordinator "
+                                        "lost before actuation")
+                    out[e["id"]] = "aborted"
+                elif e["direction"] == "out" \
+                        and e.get("node") not in n.all_nodes:
+                    # provisioned node never made membership and its
+                    # coordinator is gone — nothing to finish joining
+                    self._advance(e, "aborted",
+                                  error="aborted on adopt: joiner never "
+                                        "reached membership")
+                    out[e["id"]] = "aborted"
+                else:
+                    # same-state re-commit stamps this leader as the
+                    # coordinator before any actuation resumes
+                    self._advance(e, e["state"])
+                    self._spawn(e)
+                    out[e["id"]] = "resumed"
+            except CrashInjected:
+                raise
+            except Exception as ex:
+                logger.warning("adoption of decision %s left pending: %s",
+                               e["id"], ex)
+                out[e["id"]] = "pending"
+        if out:
+            logger.info("autoscale adopted decisions: %s", out)
+        return out
+
+    # -- scale-in victim selection -----------------------------------------
+    def _factor_floor(self) -> int:
+        """Members the cluster can never shrink below without breaking a
+        collection's replication contract."""
+        floor = 1
+        for cls in self.node.db.collections():
+            cfg = self.node.db.get_collection(cls).config
+            floor = max(floor, int(cfg.replication.factor))
+        return floor
+
+    def _coldest_node(self) -> str:
+        """The drain victim: lowest sum of held shard heat-weights (the
+        same tiering-activity axis the rebalance planner packs by), the
+        leader itself excluded — draining the node that runs this very
+        loop would orphan the decision mid-flight."""
+        n = self.node
+        snap = n.rebalancer.snapshot()
+        load: dict[str, float] = {
+            nid: 0.0 for nid in snap["nodes"]}
+        for sh in snap["shards"]:
+            for rep in sh["replicas"]:
+                if rep in load:
+                    load[rep] += float(sh["weight"])
+        candidates = [nid for nid in snap["nodes"]
+                      if nid != n.id and nid not in snap["draining"]]
+        if not candidates:
+            return ""
+        return min(candidates, key=lambda nid: (load.get(nid, 0.0), nid))
+
+    # -- introspection -----------------------------------------------------
+    def status(self) -> dict:
+        knobs = self._knobs()
+        remaining = max(0.0, self._cooldown_until - time.monotonic())
+        with self._lock:
+            actuating = self._actuating
+        return {
+            "enabled": knobs["enabled"],
+            "leader": self.node.raft.is_leader(),
+            "breach_out": self._breach_out,
+            "breach_in": self._breach_in,
+            "breach_ticks_to_act": self.breach_ticks,
+            "cooldown_remaining_s": round(remaining, 2),
+            "actuating": actuating,
+            "last_signals": dict(self._last_signals),
+            "last_refusal": self._last_refusal,
+            # copy the entries: the raft apply thread mutates the live
+            # dicts while this serializes
+            "ledger": sorted(
+                (dict(e) for e in
+                 list(self.node.fsm.autoscale_ledger.values())),
+                key=lambda e: e.get("created_ts", 0.0)),
+        }

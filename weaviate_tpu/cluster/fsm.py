@@ -32,6 +32,22 @@ _LEDGER_NEXT = {
     "aborted": set(),
 }
 
+# autoscale-decision ledger lifecycle (cluster/autoscale.py): decided
+# (the leader journaled WHAT it will do before touching membership) ->
+# actuating (provision/join or drain in flight, target node stamped) ->
+# done or aborted. A leader crash between any two states leaves a
+# durable entry the next leader adopts or aborts — exactly the
+# rebalance-move contract, one level up. Same-state re-commit is the
+# coordinator-takeover path here too.
+AUTOSCALE_STATES = ("decided", "actuating", "done", "aborted")
+AUTOSCALE_TERMINAL = ("done", "aborted")
+_AUTOSCALE_NEXT = {
+    "decided": {"actuating", "aborted"},
+    "actuating": {"done", "aborted"},
+    "done": set(),
+    "aborted": set(),
+}
+
 # cluster-backup ledger lifecycle (backup/cluster_backup.py): fencing
 # (checkpoint fence riding the WAL group-commit barrier) -> uploading
 # (nodes pushing fenced segment sets) -> committed (terminal cluster
@@ -71,6 +87,12 @@ class SchemaFSM:
         # a coordinator crash leaves a durable non-terminal record any
         # surviving node can GC or resume
         self.backup_ledger: dict[str, dict] = {}
+        # raft-replicated autoscale journal (cluster/autoscale.py):
+        # decision_id -> {state, direction, node, coordinator, ...}; the
+        # leader journals BEFORE actuating, so a crash mid-scale is a
+        # ledger entry the next leader adopts or aborts, never a
+        # half-provisioned node nobody owns
+        self.autoscale_ledger: dict[str, dict] = {}
         # distributed-task table (reference cluster/distributedtask FSM)
         self.tasks = TaskFSM()
 
@@ -165,6 +187,23 @@ class SchemaFSM:
                 for mid in drop:
                     del self.rebalance_ledger[mid]
                 return {"ok": True, "removed": len(drop)}
+            if op == "autoscale_decision":
+                return self._apply_autoscale_decision(cmd)
+            if op == "autoscale_advance":
+                return self._apply_autoscale_advance(cmd)
+            if op == "autoscale_forget":
+                before = float(cmd.get("before", 0.0))
+                drop = [
+                    did for did, e in self.autoscale_ledger.items()
+                    if e["state"] in AUTOSCALE_TERMINAL
+                    and (not cmd.get("ids") or did in cmd["ids"])
+                    and (not before
+                         or e.get("updated_ts",
+                                  e.get("created_ts", 0.0)) < before)
+                ]
+                for did in drop:
+                    del self.autoscale_ledger[did]
+                return {"ok": True, "removed": len(drop)}
             if op == "backup_begin":
                 return self._apply_backup_begin(cmd)
             if op == "backup_advance":
@@ -234,6 +273,56 @@ class SchemaFSM:
             e["updated_ts"] = cmd["ts"]
         return {"ok": True}
 
+    # -- autoscale ledger --------------------------------------------------
+    def _apply_autoscale_decision(self, cmd: dict) -> dict:
+        e = dict(cmd["entry"])
+        for f in ("id", "direction", "coordinator"):
+            if f not in e:
+                return {"ok": False,
+                        "error": f"autoscale entry missing {f!r}"}
+        if e["direction"] not in ("out", "in"):
+            return {"ok": False,
+                    "error": f"unknown direction {e['direction']!r}"}
+        if e["id"] in self.autoscale_ledger:
+            return {"ok": False, "error": f"decision {e['id']!r} exists"}
+        # ONE live decision at a time: the loop is a singleton and its
+        # actuation mutates membership — a second concurrent decision
+        # would plan against a cluster the first is still reshaping
+        for o in self.autoscale_ledger.values():
+            if o["state"] not in AUTOSCALE_TERMINAL:
+                return {"ok": False,
+                        "error": f"decision {o['id']} still "
+                                 f"{o['state']}"}
+        e["state"] = "decided"
+        e.setdefault("node", "")
+        e.setdefault("reason", "")
+        e.setdefault("error", "")
+        self.autoscale_ledger[e["id"]] = e
+        return {"ok": True, "id": e["id"]}
+
+    def _apply_autoscale_advance(self, cmd: dict) -> dict:
+        e = self.autoscale_ledger.get(cmd.get("id", ""))
+        if e is None:
+            return {"ok": False, "error": "unknown decision id"}
+        state = cmd["state"]
+        if state not in AUTOSCALE_STATES:
+            return {"ok": False, "error": f"unknown state {state!r}"}
+        # same-state re-commit is the leader-takeover path (the adopting
+        # leader stamps itself without changing the phase)
+        if state != e["state"] and state not in _AUTOSCALE_NEXT[e["state"]]:
+            return {"ok": False,
+                    "error": f"illegal transition {e['state']} -> {state}"}
+        e["state"] = state
+        if "coordinator" in cmd:
+            e["coordinator"] = cmd["coordinator"]
+        if "node" in cmd:
+            e["node"] = cmd["node"]
+        if "error" in cmd:
+            e["error"] = str(cmd["error"])[:500]
+        if "ts" in cmd:
+            e["updated_ts"] = cmd["ts"]
+        return {"ok": True}
+
     # -- backup ledger -----------------------------------------------------
     def _apply_backup_begin(self, cmd: dict) -> dict:
         e = dict(cmd["entry"])
@@ -296,6 +385,7 @@ class SchemaFSM:
             "shard_warming": self.shard_warming,
             "rebalance_ledger": self.rebalance_ledger,
             "backup_ledger": self.backup_ledger,
+            "autoscale_ledger": self.autoscale_ledger,
             "draining_nodes": self.draining_nodes,
             "tasks": self.tasks.state(),
             "aliases": self.db.aliases(),
@@ -327,5 +417,6 @@ class SchemaFSM:
         self.shard_warming = dict(state.get("shard_warming", {}))
         self.rebalance_ledger = dict(state.get("rebalance_ledger", {}))
         self.backup_ledger = dict(state.get("backup_ledger", {}))
+        self.autoscale_ledger = dict(state.get("autoscale_ledger", {}))
         self.draining_nodes = list(state.get("draining_nodes", []))
         self.tasks.load(state.get("tasks", {}))

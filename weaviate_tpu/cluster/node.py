@@ -201,6 +201,14 @@ class ClusterNode:
         from weaviate_tpu.cluster.tasks import DistributedTaskExecutor
 
         self.tasks = DistributedTaskExecutor(self)
+        # closed-loop autoscaler tick rides the DB's cycle runner (it is
+        # already the maintenance heartbeat, and MAINTENANCE_PAUSED must
+        # freeze scaling along with compaction); the tick no-ops on
+        # followers and while the autoscale_enabled knob is off
+        from weaviate_tpu.cluster.autoscale import INTERVAL_S
+
+        self.db.cycles.register("autoscale", self._autoscale_cycle,
+                                INTERVAL_S)
         # async replica-op registry (reference /v1/replication/replicate)
         self._rep_ops: dict[str, dict] = {}
         self._rep_ops_lock = threading.Lock()
@@ -310,14 +318,24 @@ class ClusterNode:
     # -- capacity advertisement (gossip node meta) -------------------------
     def _capacity_meta(self) -> dict:
         """This node's capacity payload for gossip: HBM budget/usage from
-        the tiering accountant (or the injected ``capacity_fn``)."""
+        the tiering accountant (or the injected ``capacity_fn``), plus
+        the serving-pressure stats (QoS shed rates, p99 EWMA, ingest
+        queue depth) the autoscale leader aggregates cluster-wide. The
+        serving block composes WITH capacity_fn rather than being
+        replaced by it — an injected capacity view should not blind the
+        autoscaler to real admission pressure."""
         if self.capacity_fn is not None:
-            return dict(self.capacity_fn() or {})
-        tiering = getattr(self.db, "tiering", None)
-        if tiering is not None:
-            acc = tiering.accountant
-            return {"hbm_budget": acc.budget_bytes, "hbm_used": acc.total()}
-        return {"hbm_budget": 0, "hbm_used": 0}
+            base = dict(self.capacity_fn() or {})
+        else:
+            tiering = getattr(self.db, "tiering", None)
+            if tiering is not None:
+                acc = tiering.accountant
+                base = {"hbm_budget": acc.budget_bytes,
+                        "hbm_used": acc.total()}
+            else:
+                base = {"hbm_budget": 0, "hbm_used": 0}
+        base.setdefault("serving", self.db.serving_signals())
+        return base
 
     def _on_capacity_meta(self, node: str, meta: dict) -> None:
         NODE_HBM_BUDGET.set(float(meta.get("hbm_budget", 0) or 0),
@@ -353,6 +371,7 @@ class ClusterNode:
                  list(self.fsm.rebalance_ledger.values())),
                 key=lambda e: e.get("created_ts", 0.0)),
             "replication_ops": self.replication_ops(),
+            "autoscale": self.autoscaler.status(),
         }
 
     # -- membership API ----------------------------------------------------
@@ -507,6 +526,26 @@ class ClusterNode:
             rb = Rebalancer(self)
             self._rebalancer = rb
         return rb
+
+    @property
+    def autoscaler(self):
+        """Closed-loop scale policy (cluster/autoscale.py): leader-
+        singleton evaluation over gossiped serving stats, raft-journaled
+        decisions, actuation through the rebalancer. Lazy like the
+        rebalancer — only the leader's ticks ever do work."""
+        a = getattr(self, "_autoscaler", None)
+        if a is None:
+            from weaviate_tpu.cluster.autoscale import Autoscaler
+
+            a = Autoscaler(self)
+            self._autoscaler = a
+        return a
+
+    def _autoscale_cycle(self) -> None:
+        """DB cycle-runner entrypoint for the autoscale evaluation tick
+        (tick() gates on raft leadership + the autoscale_enabled knob
+        before it reads a single signal)."""
+        self.autoscaler.tick()
 
     def _ordered(self, replicas: list[str]) -> list[str]:
         """Live replicas first so reads don't burn timeouts on dead peers;

@@ -167,6 +167,24 @@ class WorkerControl:
         return {"resumed": self.node.rebalancer.resume_pending(
             force=bool(msg.get("force", False)))}
 
+    def ctl_autoscale(self, msg):
+        """Closed-loop autoscaler control (cluster/autoscale.py):
+        enable/disable flip the hot-reloadable knob, evaluate forces
+        one leader-side evaluation tick, status just reports."""
+        from weaviate_tpu.utils.runtime_config import AUTOSCALE_ENABLED
+
+        action = msg.get("action", "status")
+        a = self.node.autoscaler
+        if action == "enable":
+            AUTOSCALE_ENABLED.set_override(True)
+        elif action == "disable":
+            AUTOSCALE_ENABLED.set_override(False)
+        elif action == "evaluate":
+            return {"autoscale": a.tick(force=True)}
+        elif action != "status":
+            raise ValueError(f"unknown autoscale action {action!r}")
+        return {"autoscale": a.status()}
+
     def ctl_cluster_view(self, msg):
         return {"view": self.node.cluster_view()}
 

@@ -290,6 +290,22 @@ class DB:
                     depth += q.size()
         return depth, self._compaction_debt
 
+    def serving_signals(self) -> dict:
+        """This node's serving-pressure summary for the gossip capacity
+        advert (cluster/autoscale.py reads the merged cluster view):
+        QoS shed rates + p99 EWMA when the admission controller exists,
+        ingest queue depth + compaction debt always. Reads ``_qos``
+        directly — a node that never served an API request must not
+        grow an admission controller just to advertise zeros."""
+        qos = self._qos
+        out = (qos.serving_stats() if qos is not None
+               else {"shed_rate": {}, "p99_ewma_ms": 0.0,
+                     "p99_target_ms": 0.0})
+        depth, debt = self._ingest_pressure()
+        out["ingest_queue_depth"] = int(depth)
+        out["compaction_debt_bytes"] = int(debt)
+        return out
+
     def get_collection(self, name: str) -> Collection:
         c = self._collections.get(name)
         if c is None and name in self._aliases:

@@ -496,6 +496,24 @@ NODE_HBM_USED = REGISTRY.gauge(
     "per-node HBM bytes in use as advertised via gossip (the tiering "
     "accountant ledger total), by node")
 
+# closed-loop autoscaler instruments (cluster/autoscale.py): every
+# journaled decision by direction, how close the hysteresis is to
+# firing, and how long until the post-actuation cooldown releases —
+# together they answer "why did/didn't the cluster just scale"
+AUTOSCALE_DECISIONS = REGISTRY.counter(
+    "weaviate_tpu_autoscale_decisions_total",
+    "raft-journaled autoscale decisions by direction (out/in) — counted "
+    "at journal time, before actuation, so an aborted scale still shows")
+AUTOSCALE_BREACH_TICKS = REGISTRY.gauge(
+    "weaviate_tpu_autoscale_breach_ticks",
+    "consecutive evaluation ticks the pressure signal has breached in "
+    "the current direction; the loop acts only at the hysteresis "
+    "threshold, so this is the fuse burning down")
+AUTOSCALE_COOLDOWN_REMAINING = REGISTRY.gauge(
+    "weaviate_tpu_autoscale_cooldown_remaining_s",
+    "seconds until the post-actuation cooldown window releases and the "
+    "loop may decide again (0 = armed)")
+
 # streaming ingest pipeline instruments (core/async_queue.py drain stage +
 # storage debt-driven compaction + index/dynamic.py background cutover,
 # docs/ingest.md): the WAL→device window depth, how long each drain window

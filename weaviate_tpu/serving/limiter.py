@@ -43,6 +43,10 @@ class AIMDLimiter:
         self._limit = float(initial)
         self._samples: list[float] = []  # bounded: reset every `window`
         self._lock = threading.Lock()
+        # smoothed per-window p99 (seconds): the latency term the cluster
+        # autoscaler compares against its own target — smoother than one
+        # window's p99, fresher than the ceiling it already moved
+        self.p99_ewma = 0.0
         QOS_LIMIT.set(self.ceiling)
 
     @property
@@ -61,6 +65,8 @@ class AIMDLimiter:
             self._samples = []
             p99 = samples[min(len(samples) - 1,
                               int(0.99 * (len(samples) - 1)))]
+            self.p99_ewma = (p99 if self.p99_ewma == 0.0
+                             else 0.7 * self.p99_ewma + 0.3 * p99)
             if p99 > self.target_p99_s:
                 self._limit = max(float(self.min_limit),
                                   self._limit * self.decrease)

@@ -132,6 +132,16 @@ class AdmissionController:
         # lane sheds with Retry-After — admission is where the pipeline
         # says "stop feeding me", before the WAL grows unbounded.
         self.ingest_pressure: Optional[Callable[[], tuple]] = None
+        # per-lane admit/shed tallies feeding serving_stats(): raw
+        # monotonic counts under their own lock (the shed paths raise
+        # before _lock is ever taken), smoothed into a shed-fraction
+        # EWMA per read so gossip ships a stable signal, not one
+        # polling interval's noise
+        self._sig_lock = threading.Lock()
+        self._sig_counts: dict[str, list[int]] = {}  # lane -> [ok, shed]
+        self._sig_prev: dict[str, tuple[int, int]] = {}
+        self._shed_ewma: dict[str, float] = {}
+        self._sig_ts: Optional[float] = None
 
     # -- admission ---------------------------------------------------------
     @staticmethod
@@ -158,6 +168,7 @@ class AdmissionController:
             if shed is not None:
                 reason, retry_after = shed
                 QOS_SHED.inc(lane=lane, reason=reason)
+                self._note(lane, shed=True)
                 raise QosRejected(
                     f"ingest backpressure: {reason.replace('_', ' ')} over "
                     "its shed threshold (the WAL->device window or merge "
@@ -172,6 +183,7 @@ class AdmissionController:
                 tenant=tenant if self.throttle.has_override(tenant)
                 else "default")
             QOS_SHED.inc(lane=lane, reason="tenant_rate")
+            self._note(lane, shed=True)
             raise QosRejected(
                 f"tenant {tenant or 'default'!r} over its rate limit",
                 retry_after=max(1.0, math.ceil(throttle_wait)),
@@ -183,9 +195,11 @@ class AdmissionController:
                 self._inflight += 1
                 QOS_INFLIGHT.set(self._inflight)
                 QOS_ADMITTED.inc(lane=lane)
+                self._note(lane, shed=False)
                 return _Ticket(self, lane, t0)
             if self._lane_depth(lane) >= cfg.max_queue_depth:
                 QOS_SHED.inc(lane=lane, reason="queue_full")
+                self._note(lane, shed=True)
                 raise QosRejected(
                     f"overloaded: {lane} admission queue full "
                     f"(depth {cfg.max_queue_depth})",
@@ -213,6 +227,7 @@ class AdmissionController:
         QOS_QUEUE_WAIT.observe(queue_wait, lane=lane,
                                exemplar=current_trace_id())
         QOS_ADMITTED.inc(lane=lane)
+        self._note(lane, shed=False)
         return _Ticket(self, lane, t0, queue_wait=queue_wait)
 
     def _check_ingest_pressure(self) -> Optional[tuple[str, float]]:
@@ -333,6 +348,43 @@ class AdmissionController:
         backlog = self._queued_total() + self._inflight
         est = backlog * self._svc_ewma / max(1, self.limiter.ceiling)
         return float(min(60.0, max(1.0, math.ceil(est))))
+
+    # -- serving signals (gossiped to the autoscaler) ----------------------
+    def _note(self, lane: str, shed: bool) -> None:
+        with self._sig_lock:
+            c = self._sig_counts.setdefault(lane, [0, 0])
+            c[1 if shed else 0] += 1
+
+    def serving_stats(self) -> dict:
+        """This node's serving-pressure advert: per-lane shed-fraction
+        EWMAs plus the limiter's smoothed p99 vs its target. Rides the
+        gossip node-meta payload (cluster/node.py ``_capacity_meta``) so
+        the autoscale leader sees every node's pressure, not its own.
+        Each call folds the admit/shed deltas since the previous call
+        into a time-aware EWMA (tau ~5s) — a quiet window decays the
+        fraction toward zero instead of freezing the last burst."""
+        with self._sig_lock:
+            now = self._clock()
+            dt = (now - self._sig_ts) if self._sig_ts is not None else 1.0
+            self._sig_ts = now
+            alpha = 1.0 - math.exp(-max(dt, 1e-3) / 5.0)
+            shed_rate: dict[str, float] = {}
+            for lane in self.lanes:
+                ok, shed = self._sig_counts.get(lane, [0, 0])
+                pok, pshed = self._sig_prev.get(lane, (0, 0))
+                self._sig_prev[lane] = (ok, shed)
+                d_ok, d_shed = ok - pok, shed - pshed
+                total = d_ok + d_shed
+                frac = (d_shed / total) if total else 0.0
+                prev = self._shed_ewma.get(lane, 0.0)
+                cur = (1.0 - alpha) * prev + alpha * frac
+                self._shed_ewma[lane] = cur
+                shed_rate[lane] = round(cur, 4)
+        return {
+            "shed_rate": shed_rate,
+            "p99_ewma_ms": round(self.limiter.p99_ewma * 1e3, 3),
+            "p99_target_ms": round(self.limiter.target_p99_s * 1e3, 3),
+        }
 
     # -- introspection -----------------------------------------------------
     def snapshot(self) -> dict:

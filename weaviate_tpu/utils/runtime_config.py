@@ -234,6 +234,24 @@ HYBRID_DEVICE_FUSION = RUNTIME.register(
 # keyword scoring on the WAND/host tier
 HYBRID_SPARSE_DEVICE = RUNTIME.register(
     "hybrid_sparse_device", "auto", cast=str)
+# closed-loop autoscaler (cluster/autoscale.py): the loop ships DISABLED
+# — an operator (or the acceptance harness) arms it explicitly, and can
+# disarm it mid-incident with one overrides-file edit while join/drain
+# stay available by hand. Target p99 is the cluster-wide SLO the leader
+# compares the worst advertised p99 EWMA against; cooldown is the
+# mandatory quiet window after any actuation; min/max bound membership
+# (scale-in additionally refuses to drop below any collection's
+# replication factor).
+AUTOSCALE_ENABLED = RUNTIME.register("autoscale_enabled", False,
+                                     cast=bool)
+AUTOSCALE_P99_TARGET_MS = RUNTIME.register(
+    "autoscale_p99_target_ms", 750.0, cast=float)
+AUTOSCALE_COOLDOWN_S = RUNTIME.register(
+    "autoscale_cooldown_s", 60.0, cast=float)
+AUTOSCALE_MIN_NODES = RUNTIME.register("autoscale_min_nodes", 1,
+                                       cast=int)
+AUTOSCALE_MAX_NODES = RUNTIME.register("autoscale_max_nodes", 64,
+                                       cast=int)
 # cold-tier blob op budget (tiering/coldstore.py): per-op deadline for
 # offload/hydrate/sweep blob traffic, surfaced by the errorflow lint's
 # budget pass. 0 = unset (follow the TenantColdStore constructor arg) —
