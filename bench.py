@@ -3200,6 +3200,179 @@ def bench_hybrid(n=200_000, d=256, batch=0, k=10, iters=0, warmup=0,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_filtered(n=200_000, d=128, batch=0, k=10, iters=0, warmup=0,
+                   nq=48, reps=3):
+    """Filter-native device search (docs/planner.md): `filtered_qps`
+    across the selectivity sweep (0.1% -> 50%) through the REAL
+    Collection path, recall@10 pinned per selectivity against exact
+    pre-filtered host ground truth, the plan-choice distribution
+    journaled from the planner counter (the sweep must light up all
+    three plan types), and a `device_filter_planes` perf-flag verdict:
+    the resident-plane leg must hold recall parity with the ad-hoc
+    digest-mask leg while actually riding plane-keyed dispatch."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.inverted.filters import Where
+    from weaviate_tpu.monitoring.metrics import (
+        DISPATCH_FILTERED_PLANE,
+        PLANNER_PLANS,
+    )
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        DataType,
+        HNSWIndexConfig,
+        Property,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+    from weaviate_tpu.utils.runtime_config import FILTER_PLANE_PROMOTE_HITS
+
+    rng = np.random.default_rng(23)
+    print(f"# filtered: n={n} d={d} nq={nq}", file=sys.stderr)
+    # grp = i % 1000 makes the sweep selectivities EXACT, not sampled:
+    # grp==0 -> 0.1%, grp<10 -> 1%, grp<100 -> 10%, grp<500 -> 50%
+    sweep = [("0.1pct", Where.eq("grp", 0), 0.001),
+             ("1pct", Where.lt("grp", 10), 0.01),
+             ("10pct", Where.lt("grp", 100), 0.10),
+             ("50pct", Where.lt("grp", 500), 0.50)]
+    # cutoff sized so 0.1% brute-forces (exact_scan) while 1% walks the
+    # graph; filter_flat_selectivity lowered below 1% for the same reason
+    flat_cutoff = max(25, n // 500)
+    root = tempfile.mkdtemp(prefix="bench_filtered_")
+    db = DB(root)
+    try:
+        col = db.create_collection(CollectionConfig(
+            name="Filtered",
+            properties=[Property(name="grp", data_type=DataType.INT)],
+            vector_config=HNSWIndexConfig(
+                distance="l2-squared", ef=64, ef_construction=64,
+                flat_search_cutoff=flat_cutoff,
+                filter_flat_selectivity=0.002),
+            resident_filters=[f.to_dict() for _, f, _ in sweep],
+        ))
+        t0 = time.perf_counter()
+        vecs = rng.standard_normal((n, d)).astype(np.float32)
+        for lo in range(0, n, 4096):
+            hi = min(lo + 4096, n)
+            col.put_batch([StorageObject(
+                uuid=f"{i:08x}-0000-0000-0000-000000000000",
+                collection="Filtered",
+                properties={"grp": i % 1000},
+                vector=vecs[i]) for i in range(lo, hi)])
+        build_s = time.perf_counter() - t0
+        print(f"# built in {build_s:.1f}s", file=sys.stderr)
+
+        q_vecs = vecs[rng.choice(n, nq, replace=False)] \
+            + 0.05 * rng.standard_normal((nq, d)).astype(np.float32)
+        grp = np.arange(n) % 1000
+
+        def gt_topk(qi, allowed_rows):
+            dists = np.sum(
+                (vecs[allowed_rows] - q_vecs[qi]) ** 2, axis=1)
+            top = allowed_rows[np.argsort(dists, kind="stable")[:k]]
+            return {f"{i:08x}-0000-0000-0000-000000000000" for i in top}
+
+        def sweep_leg(flt):
+            res = col.vector_search_batch(q_vecs, k=k, flt=flt)
+            return [{o.uuid for o, _ in row[:k]} for row in res]
+
+        plan_labels = ("unfiltered", "exact_scan", "filtered_beam",
+                       "overfetch_postfilter")
+        plans_before = {p: PLANNER_PLANS.value(plan=p)
+                        for p in plan_labels}
+        planes_before = DISPATCH_FILTERED_PLANE.value()
+        recalls = {}
+        plan_mix = {}
+        for tag, flt, sel in sweep:
+            allowed_rows = np.nonzero(
+                grp == 0 if sel == 0.001
+                else grp < int(sel * 1000))[0]
+            snap = {p: PLANNER_PLANS.value(plan=p) for p in plan_labels}
+            live = sweep_leg(flt)  # warmup + recall, resident-plane leg
+            plan_mix[tag] = {
+                p: int(PLANNER_PLANS.value(plan=p) - snap[p])
+                for p in plan_labels
+                if PLANNER_PLANS.value(plan=p) > snap[p]}
+            recalls[tag] = float(np.mean([
+                len(live[i] & gt_topk(i, allowed_rows))
+                / max(1, min(k, len(allowed_rows)))
+                for i in range(nq)]))
+            best = 0.0
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                sweep_leg(flt)
+                best = max(best, nq / (time.perf_counter() - t0))
+            _emit({
+                "metric": f"filtered_qps_{tag}_{n // 1000}k_{d}d",
+                "value": round(best, 1), "unit": "qps",
+                "selectivity": sel, "k": k,
+                "recall10_vs_exact": round(recalls[tag], 4),
+                "recall_ok": bool(recalls[tag] >= 0.95),
+                "plans": plan_mix[tag],
+                "note": "resident-plane leg, recall vs exact "
+                        "pre-filtered host ground truth",
+            })
+        plane_dispatches = DISPATCH_FILTERED_PLANE.value() - planes_before
+
+        # ad-hoc leg: a permissive filter NOT in resident_filters, with
+        # promotion pinned off — it must fall back to digest-keyed masks
+        # and flip the plan choice to over-fetch + post-filter (paying
+        # per-query mask rent to walk a barely-filtered graph loses to
+        # over-fetching the unfiltered walk)
+        FILTER_PLANE_PROMOTE_HITS.set_override(10 ** 9)
+        try:
+            adhoc = Where.lt("grp", 900)  # 90%, not in resident_filters
+            snap = {p: PLANNER_PLANS.value(plan=p) for p in plan_labels}
+            live = sweep_leg(adhoc)
+            adhoc_mix = {
+                p: int(PLANNER_PLANS.value(plan=p) - snap[p])
+                for p in plan_labels
+                if PLANNER_PLANS.value(plan=p) > snap[p]}
+            allowed_rows = np.nonzero(grp < 900)[0]
+            adhoc_recall = float(np.mean([
+                len(live[i] & gt_topk(i, allowed_rows)) / k
+                for i in range(nq)]))
+        finally:
+            FILTER_PLANE_PROMOTE_HITS.clear_override()
+
+        plans_seen = {p for mix in plan_mix.values() for p in mix} \
+            | set(adhoc_mix)
+        total_mix = {p: sum(m.get(p, 0) for m in plan_mix.values())
+                     + adhoc_mix.get(p, 0) for p in plans_seen}
+        _emit({
+            "metric": f"filtered_plan_mix_{n // 1000}k",
+            "value": len(plans_seen), "unit": "plan_types",
+            "mix": total_mix, "adhoc_mix": adhoc_mix,
+            "adhoc_recall10": round(adhoc_recall, 4),
+            "plane_dispatches": int(plane_dispatches),
+            "note": "planner must switch plans across the sweep; the "
+                    "ad-hoc leg shows the no-plane choice",
+        })
+        from weaviate_tpu.utils import perf_flags
+
+        recall_ok = all(r >= 0.95 for r in recalls.values()) \
+            and adhoc_recall >= 0.95
+        perf_flags.record(
+            "device_filter_planes",
+            enabled=bool(recall_ok
+                         and plane_dispatches > 0
+                         and {"exact_scan", "filtered_beam",
+                              "overfetch_postfilter"} <= plans_seen),
+            evidence={"recalls": {t: round(r, 4)
+                                  for t, r in recalls.items()},
+                      "adhoc_recall10": round(adhoc_recall, 4),
+                      "plan_mix": total_mix,
+                      "plane_dispatches": int(plane_dispatches),
+                      "config": f"{n}x{d} k{k} ef64"},
+            platform=jax.default_backend())
+    finally:
+        db.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 CONFIGS = {
     "flat1m": bench_flat1m,
     "sift1m": bench_sift1m,
@@ -3209,6 +3382,7 @@ CONFIGS = {
     "bq": bench_bq,
     "msmarco": bench_msmarco,
     "hybrid": bench_hybrid,
+    "filtered": bench_filtered,
     "tiering": bench_tiering,
     "meshbeam": bench_meshbeam,
     "bm25": bench_bm25,
@@ -3343,6 +3517,13 @@ def _full_footprint(name: str, soak: bool = False) -> dict:
                            + n * t * dr * 4 + n * t) / _GB,
                 "host_gb": (n * dr * 4 * (1 + t) + n * 200) / _GB,
                 "disk_gb": 0.0}
+    if name == "filtered":
+        # fp32 corpus + adjacency mirror + four bool filter planes in
+        # HBM; host holds the fp32 originals, graph and int postings
+        n, df = 200_000, 128
+        return {"hbm_gb": (n * (df * 4 + 33 * 4) + 4 * n) / _GB,
+                "host_gb": (n * (df * 4 * 2 + 200) + n * 24) / _GB,
+                "disk_gb": 0.0}
     return {"hbm_gb": 0.0, "host_gb": 0.0, "disk_gb": 0.0}
 
 
@@ -3367,6 +3548,9 @@ SMOKE = {
     # semantics check (overlap + one-dispatch fusion + recall parity),
     # not a throughput claim
     "hybrid": dict(n=3_000, vocab=1_500, nq=12, threads=4, reps=2),
+    # plan-switch semantics check (all three plan types + recall
+    # parity), not a throughput claim
+    "filtered": dict(n=4_000, nq=8, reps=1),
     "tiering": dict(n=8_000, tenants=8, batch=16, iters=2, warmup=1),
     # mesh A/B needs real builds on both legs: keep the smoke shape tiny
     "meshbeam": dict(n=3_000, batch=32, ef=48, iters=2, warmup=1),

@@ -126,8 +126,12 @@ def test_quantized_filtered_keep_k_matches_host(rng):
     n = len(corpus)
     allow = np.zeros(idx.graph.capacity, bool)
     allow[rng.choice(n, int(0.6 * n), replace=False)] = True
-    # keep the flat tier from absorbing the 60% filter
+    # keep the planner from absorbing the 60% filter into the exact
+    # masked scan: drop the flat cutoff AND pin ef where the beam wins
+    # the cost race (default ef=100 · deg=16 outprices a 1500-row scan)
+    # — the masked-beam-over-code-planes path is the coverage here
     idx.config.flat_search_cutoff = 10
+    idx.config.ef = 48
 
     q = _queries(rng, corpus)
     k = 10

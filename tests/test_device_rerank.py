@@ -160,6 +160,10 @@ def test_fused_rerank_one_dispatch_matches_reference(rng, quant):
 
 def test_fused_rerank_filtered_allowed_only(rng):
     idx, corpus = _build(rng)
+    # the planner must pick the filtered beam (this test pins the FUSED
+    # rerank+mask path): at 600 docs the default ef=100 walk costs more
+    # than the masked exact scan, so pin ef where the beam wins the race
+    idx.config.ef = 32
     q = corpus[:4]
     allow = np.zeros(len(corpus), bool)
     allow[::2] = True
@@ -534,6 +538,9 @@ class TestMeshRerank:
 
     def test_mesh_fused_rerank_filtered(self, rng):
         idx, corpus = _build(rng, n=640, d=16)
+        # keep the cost race on the beam plan — the fused mesh rerank
+        # path is what this test covers, not the exact-scan triage
+        idx.config.ef = 32
         q = corpus[:2]
         allow = np.zeros(len(corpus), bool)
         allow[::2] = True

@@ -1,0 +1,641 @@
+"""Filter-native device search: resident planes + the cost-based planner.
+
+ISSUE 19 acceptance pins:
+
+1. ``plan()`` is pure — plan choices unit-tested against seeded stats
+   (guards keep the pre-planner triage semantics; the cost race picks
+   exact-scan / filtered-beam / over-fetch-post-filter past them);
+2. recall@10 parity within 0.005 of the exact pre-filtered host scan
+   per plan type across the 0.1% -> 50% selectivity sweep, on and off
+   mesh, including a fully-filtered mesh shard — with the filtered beam
+   at 1% selectivity exactly ONE device dispatch per batch
+   (``ops.device_beam.dispatch_count``);
+3. resident planes are maintained incrementally through the ingest
+   drain (put/delete flip bits WITHOUT a version bump, so dispatcher
+   coalescing by ``(plane_id, version)`` survives live writes) and
+   converge to the inverted-index oracle after SIGKILL replay;
+4. plane HBM bytes ride the tiering ledger: ``Shard.hbm_bytes`` counts
+   them and ``demote_device`` / first reuse detach and re-attach them
+   symmetrically.
+
+Fixture geometry: blob corpora with query-correlated filters (queries
+land near their allowed blobs — the tenant-search shape). That is the
+regime where a graph walk can legitimately match the exact pre-filtered
+scan at low selectivity; scattered allowed sets at 1% are exactly what
+the cost guards route to the exact plan instead.
+
+Mesh opt-in mirrors test_mesh_beam: conftest defaults
+``WEAVIATE_TPU_MESH=off``; the mesh class sets the runtime mesh
+explicitly and resets it on teardown.
+"""
+
+import math
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.core.shard import Shard
+from weaviate_tpu.index.hnsw import HNSWIndex
+from weaviate_tpu.inverted.filters import Filter, Where
+from weaviate_tpu.monitoring.metrics import (
+    FILTER_PLANE_HBM_BYTES,
+    PLANNER_PLANS,
+)
+from weaviate_tpu.ops import device_beam as device_beam_mod
+from weaviate_tpu.query.planner import (
+    PLAN_BEAM,
+    PLAN_EXACT,
+    PLAN_OVERFETCH,
+    PLAN_UNFILTERED,
+    FilterPlane,
+    FilterPlaneStore,
+    PlanStats,
+    expansion_budget,
+    plan,
+)
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    DataType,
+    FlatIndexConfig,
+    HNSWIndexConfig,
+    Property,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+K = 10
+_PLANS = (PLAN_UNFILTERED, PLAN_EXACT, PLAN_BEAM, PLAN_OVERFETCH)
+
+
+def _plan_snap():
+    return {p: PLANNER_PLANS.value(plan=p) for p in _PLANS}
+
+
+def _plan_delta(snap):
+    return {p: int(PLANNER_PLANS.value(plan=p) - snap[p]) for p in _PLANS
+            if PLANNER_PLANS.value(plan=p) > snap[p]}
+
+
+# ---------------------------------------------------------------------------
+# plan(): pure + explainable, pinned against seeded stats
+# ---------------------------------------------------------------------------
+
+def _stats(sel, **kw):
+    base = dict(live=20_000, k=10, ef=64, selectivity=sel,
+                exact_count=True, plane_resident=False, flat_cutoff=50,
+                flat_selectivity=0.002, graph_degree=32)
+    base.update(kw)
+    return PlanStats(**base)
+
+
+def test_plan_unfiltered_passthrough():
+    p = plan(_stats(1.0))
+    assert p.plan_type == PLAN_UNFILTERED
+    assert p.reason == "filter passes everything"
+
+
+def test_plan_allowed_below_k_is_exact():
+    p = plan(_stats(0.0004))  # 8 allowed <= k=10
+    assert p.plan_type == PLAN_EXACT
+    assert "<= k=" in p.reason
+
+
+def test_plan_flat_cutoff_guard_is_exact():
+    p = plan(_stats(0.002))  # 40 allowed <= flat_search_cutoff=50
+    assert p.plan_type == PLAN_EXACT
+    assert "flat_search_cutoff" in p.reason
+
+
+def test_plan_flat_selectivity_guard_is_exact():
+    # pre-planner triage semantics: permissive flat_selectivity still
+    # routes mid-selectivity filters to the masked flat scan
+    p = plan(_stats(0.04, flat_selectivity=0.05))
+    assert p.plan_type == PLAN_EXACT
+    assert "filter_flat_selectivity" in p.reason
+
+
+def test_plan_beam_at_low_selectivity():
+    p = plan(_stats(0.01))  # 200 allowed, past both guards
+    assert p.plan_type == PLAN_BEAM
+    assert p.expansion == 2  # two decades below 100%
+    assert p.cost_beam < p.cost_exact
+    assert p.cost_beam < p.cost_overfetch
+
+
+def test_plan_overfetch_at_high_selectivity_without_plane():
+    p = plan(_stats(0.5))
+    assert p.plan_type == PLAN_OVERFETCH
+    # fetch = max(k, min(ef, 2k)) = 20, over-fetched by 1/sel
+    assert p.fetch_k == 40
+    assert p.expansion == 0
+
+
+def test_plan_plane_residency_flips_high_selectivity_to_beam():
+    # same stats, but the mask is already HBM-resident: no mask rent,
+    # the beam wins the race it just lost
+    p = plan(_stats(0.5, plane_resident=True))
+    assert p.plan_type == PLAN_BEAM
+    assert "plane resident" in p.reason
+
+
+def test_plan_overfetch_infeasible_past_kernel_cap():
+    # fetch/sel blows past the widest device bucket -> cost is inf and
+    # over-fetch can never win
+    p = plan(_stats(0.005))
+    assert math.isinf(p.cost_overfetch)
+    assert p.plan_type == PLAN_BEAM
+
+
+def test_expansion_budget_scales_by_decade():
+    assert expansion_budget(1.0) == 0
+    assert expansion_budget(0.5) == 0
+    assert expansion_budget(0.1) == 1
+    assert expansion_budget(0.01) == 2
+    assert expansion_budget(0.001) == 3
+    assert expansion_budget(1e-9) == 4  # capped
+
+
+def test_plan_is_pure_and_explainable():
+    a, b = plan(_stats(0.07)), plan(_stats(0.07))
+    assert a == b  # frozen dataclass, deterministic in stats
+    attrs = a.trace_attrs()
+    for key in ("planner.plan", "planner.reason", "planner.selectivity",
+                "planner.allowed", "planner.expansion", "planner.fetch_k",
+                "planner.cost_exact", "planner.cost_beam",
+                "planner.cost_overfetch"):
+        assert key in attrs
+
+
+# ---------------------------------------------------------------------------
+# FilterPlane / FilterPlaneStore unit semantics
+# ---------------------------------------------------------------------------
+
+def test_plane_incremental_set_preserves_version():
+    pl = FilterPlane(Where.lt("n", 50))
+    mask = np.zeros(100, bool)
+    mask[:50] = True
+    pl.rebuild(mask)
+    v = pl.version
+    pl.set(80, True)   # put of a matching doc
+    pl.set(3, False)   # delete
+    assert pl.version == v, \
+        "incremental maintenance must not break (plane_id, version) " \
+        "dispatcher coalescing"
+    got = pl.mask(100)
+    assert got[80] and not got[3] and got[49]
+    assert pl.count() == 50  # 50 - 1 + 1
+
+
+def test_plane_rebuild_bumps_version():
+    pl = FilterPlane(Where.eq("n", 1))
+    pl.rebuild(np.ones(10, bool))
+    v = pl.version
+    pl.rebuild(np.zeros(10, bool))
+    assert pl.version == v + 1
+    assert not pl.stale
+
+
+def test_plane_device_mask_cached_and_detachable():
+    pl = FilterPlane(Where.lt("n", 8))
+    pl.rebuild(np.arange(64) < 8)
+    a = pl.device_mask(64)
+    assert pl.hbm_bytes() > 0
+    assert pl.device_mask(64) is a  # cached by (version, mut, cap)
+    freed = pl.drop_device()
+    assert freed > 0 and pl.hbm_bytes() == 0
+    b = pl.device_mask(64)  # re-attach
+    assert pl.hbm_bytes() == freed
+    assert np.asarray(b).sum() == 8
+
+
+def test_plane_store_declares_and_auto_promotes():
+    space = np.zeros(40, bool)
+    space[:10] = True
+    calls = []
+
+    def recompute(flt):
+        calls.append(flt.operator)
+        return space.copy()
+
+    store = FilterPlaneStore(recompute=recompute)
+    declared = store.declare(Where.lt("n", 10))
+    assert store.lookup(Where.lt("n", 10)) is declared
+    assert calls, "declared plane must rebuild from the oracle"
+
+    hot = Where.eq("n", 3)
+    hits = 0
+    while store.lookup(hot) is None:
+        hits += 1
+        assert hits < 50, "hot filter never auto-promoted"
+    assert store.lookup(hot) is not None  # promoted + resident now
+
+
+def test_plane_store_maintains_on_put_and_delete():
+    def recompute(flt):
+        return np.zeros(8, bool)
+
+    store = FilterPlaneStore(recompute=recompute)
+    pl = store.declare(Where.lt("n", 50))
+    store.lookup(Where.lt("n", 50))  # build
+    v = pl.version
+    store.on_put(5, {"n": 7})    # matches
+    store.on_put(6, {"n": 99})   # does not
+    mask = pl.mask(8)
+    assert mask[5] and not mask[6]
+    store.on_delete(5)
+    assert not pl.mask(8)[5]
+    assert pl.version == v
+
+
+# ---------------------------------------------------------------------------
+# off-mesh end-to-end: recall parity per plan type + one-dispatch pins
+# ---------------------------------------------------------------------------
+
+N_OFF, D_OFF, BLOB = 6_000, 16, 60  # 100 blobs x 60 docs
+
+
+def _blob_corpus(rng, n, blob, d):
+    centers = rng.standard_normal((n // blob, d)).astype(np.float32)
+    grp = np.arange(n) % (n // blob)
+    vecs = (centers[grp]
+            + 0.15 * rng.standard_normal((n, d))).astype(np.float32)
+    return vecs, grp
+
+
+@pytest.fixture(scope="module")
+def off_mesh():
+    rng = np.random.default_rng(7)
+    vecs, grp = _blob_corpus(rng, N_OFF, BLOB, D_OFF)
+    cfg = HNSWIndexConfig(
+        distance="l2-squared", precision="fp32", max_connections=12,
+        ef_construction=96, ef=96, flat_search_cutoff=40,
+        filter_flat_selectivity=0.002, device_beam=True)
+    idx = HNSWIndex(D_OFF, cfg)
+    idx.add_batch(np.arange(N_OFF), vecs)
+    return idx, vecs, grp, rng
+
+
+def _queries_near(rng, vecs, rows, nq=16):
+    pick = rng.choice(rows, nq, replace=False)
+    return (vecs[pick] + 0.05 * rng.standard_normal(
+        (nq, vecs.shape[1]))).astype(np.float32)
+
+
+def _gt(vecs, queries, allow_rows, k=K):
+    d2 = ((queries[:, None, :] - vecs[allow_rows][None]) ** 2).sum(-1)
+    return allow_rows[np.argsort(d2, axis=1, kind="stable")[:, :k]]
+
+
+def _recall(ids, want, allowed, k=K):
+    hit = sum(len(set(g[g >= 0].tolist()) & set(w.tolist()))
+              for g, w in zip(ids, want))
+    return hit / (len(want) * min(k, allowed))
+
+
+def _as_plane(mask, tag):
+    pl = FilterPlane(Where.eq("fixture", tag))
+    pl.rebuild(mask)
+    return pl
+
+
+def _run_case(idx, vecs, grp, rng, mask, blobs, want_plan,
+              use_plane, tag, expect_dispatch=None):
+    allow_rows = np.nonzero(mask)[0]
+    q = _queries_near(rng, vecs, np.concatenate(
+        [np.nonzero(grp == b)[0] for b in blobs]))
+    allow = _as_plane(mask, tag) if use_plane else mask
+    snap = _plan_snap()
+    d0 = device_beam_mod.dispatch_count()
+    res = idx.search(q, K, allow_list=allow)
+    delta = _plan_delta(snap)
+    assert delta == {want_plan: 1}, (tag, delta)
+    if expect_dispatch is not None:
+        assert device_beam_mod.dispatch_count() - d0 == expect_dispatch, \
+            (tag, "dispatch count")
+    live = res.ids[res.ids >= 0]
+    assert len(live) and mask[live].all(), (tag, "disallowed id leaked")
+    r = _recall(res.ids, _gt(vecs, q, allow_rows), len(allow_rows))
+    assert r >= 1.0 - 0.005, (tag, r)
+    return r
+
+
+def test_parity_sweep_off_mesh(off_mesh):
+    """Acceptance sweep 0.1% -> 50%: each selectivity's chosen plan hits
+    recall@10 within 0.005 of the exact pre-filtered scan — plane and
+    ad-hoc mask, per plan type."""
+    idx, vecs, grp, rng = off_mesh
+    # 0.1%: 6 allowed docs <= k -> exact guard
+    tiny = np.zeros(N_OFF, bool)
+    tiny[np.nonzero(grp == 7)[0][:6]] = True
+    _run_case(idx, vecs, grp, rng, tiny, [7], PLAN_EXACT, False,
+              "sel=0.001", expect_dispatch=0)
+    # 1%: one blob; cost race picks the filtered beam (expansion=2)
+    _run_case(idx, vecs, grp, rng, grp == 7, [7], PLAN_BEAM, False,
+              "sel=0.01/mask")
+    _run_case(idx, vecs, grp, rng, grp == 7, [7], PLAN_BEAM, True,
+              "sel=0.01/plane")
+    # 10% and 50%: beam both with and without residency (mask rent at
+    # live=6000 never overturns the beam here)
+    _run_case(idx, vecs, grp, rng, grp < 10, range(10), PLAN_BEAM, True,
+              "sel=0.10/plane")
+    _run_case(idx, vecs, grp, rng, grp < 50, range(50), PLAN_BEAM, True,
+              "sel=0.50/plane")
+    _run_case(idx, vecs, grp, rng, grp < 50, range(50), PLAN_BEAM, False,
+              "sel=0.50/mask")
+
+
+def test_overfetch_parity_off_mesh(off_mesh):
+    """A permissive ad-hoc filter (90%) flips to over-fetch+post-filter
+    — and still matches the exact pre-filtered scan."""
+    idx, vecs, grp, rng = off_mesh
+    _run_case(idx, vecs, grp, rng, grp < 90, range(90), PLAN_OVERFETCH,
+              False, "sel=0.90/mask", expect_dispatch=1)
+
+
+def test_one_dispatch_at_one_percent_off_mesh(off_mesh):
+    """Acceptance pin: 1% selectivity, filter-aware beam, exactly ONE
+    device dispatch for the whole batch, parity within 0.005."""
+    idx, vecs, grp, rng = off_mesh
+    _run_case(idx, vecs, grp, rng, grp == 13, [13], PLAN_BEAM, True,
+              "one-dispatch", expect_dispatch=1)
+
+
+def test_est_selectivity_rides_through_search(off_mesh):
+    # the sketch estimate is explainability payload, never routing: the
+    # search result is identical with and without it
+    idx, vecs, grp, rng = off_mesh
+    q = _queries_near(rng, vecs, np.nonzero(grp == 3)[0], nq=4)
+    a = idx.search(q, K, allow_list=grp == 3)
+    b = idx.search(q, K, allow_list=grp == 3, est_selectivity=0.01)
+    assert np.array_equal(a.ids, b.ids)
+
+
+def test_padding_tail_does_not_inflate_selectivity(off_mesh):
+    """A capacity-sized mask whose padding tail is all-True must not
+    read as a no-op filter (popcount counts PRESENT rows only)."""
+    idx, vecs, grp, rng = off_mesh
+    cap = idx.graph.capacity
+    mask = np.ones(cap, bool)
+    mask[:N_OFF] = grp == 7  # 1% of live docs, every padding row "set"
+    snap = _plan_snap()
+    res = idx.search(_queries_near(rng, vecs, np.nonzero(grp == 7)[0],
+                                   nq=4), K, allow_list=mask)
+    assert _plan_delta(snap) == {PLAN_BEAM: 1}
+    live = res.ids[res.ids >= 0]
+    assert len(live) and (grp[live] == 7).all()
+
+
+# ---------------------------------------------------------------------------
+# mesh: same contract spanning shards, including a fully-filtered shard
+# ---------------------------------------------------------------------------
+
+N_MESH, BLOB_MESH = 4_800, 48
+
+
+class TestMeshFilterParity:
+    @pytest.fixture(scope="class")
+    def mesh_idx(self):
+        from weaviate_tpu.parallel import runtime
+        from weaviate_tpu.parallel.mesh import make_mesh
+
+        runtime.set_mesh(make_mesh(8))
+        try:
+            rng = np.random.default_rng(7)
+            vecs, grp = _blob_corpus(rng, N_MESH, BLOB_MESH, D_OFF)
+            cfg = HNSWIndexConfig(
+                distance="l2-squared", precision="fp32",
+                max_connections=12, ef_construction=96, ef=96,
+                flat_search_cutoff=40, filter_flat_selectivity=0.002,
+                device_beam=True)
+            idx = HNSWIndex(D_OFF, cfg)
+            idx.add_batch(np.arange(N_MESH), vecs)
+            from weaviate_tpu.ops.device_beam import MeshDeviceAdjacency
+
+            assert isinstance(idx._device_beam, MeshDeviceAdjacency)
+            assert idx._mesh_partitioned
+            yield idx, vecs, grp, rng
+        finally:
+            runtime.reset()
+
+    def test_mesh_parity_sweep(self, mesh_idx):
+        idx, vecs, grp, rng = mesh_idx
+        tiny = np.zeros(N_MESH, bool)
+        tiny[np.nonzero(grp == 7)[0][:6]] = True
+        _run_case(idx, vecs, grp, rng, tiny, [7], PLAN_EXACT, False,
+                  "mesh/sel=0.001", expect_dispatch=0)
+        _run_case(idx, vecs, grp, rng, grp == 7, [7], PLAN_BEAM, True,
+                  "mesh/sel=0.01/plane", expect_dispatch=1)
+        _run_case(idx, vecs, grp, rng, grp < 50, range(50), PLAN_BEAM,
+                  True, "mesh/sel=0.50/plane", expect_dispatch=1)
+        _run_case(idx, vecs, grp, rng, grp < 90, range(90),
+                  PLAN_OVERFETCH, False, "mesh/sel=0.90/mask",
+                  expect_dispatch=1)
+
+    def test_mesh_fully_filtered_shard(self, mesh_idx):
+        """Ban one ENTIRE shard's rows plus a scattered 30%: one
+        dispatch, nothing from the banned shard, parity holds."""
+        idx, vecs, grp, rng = mesh_idx
+        rows = idx._device_beam.rows_per_shard()
+        allow = np.ones(idx.graph.capacity, bool)
+        allow[rows:2 * rows] = False
+        allow[rng.choice(N_MESH, int(0.3 * N_MESH), replace=False)] = False
+        q = _queries_near(rng, vecs, np.arange(N_MESH))
+        allow_rows = np.nonzero(allow[:N_MESH])[0]
+        snap = _plan_snap()
+        d0 = device_beam_mod.dispatch_count()
+        res = idx.search(q, K, allow_list=allow)
+        assert _plan_delta(snap) == {PLAN_BEAM: 1}
+        assert device_beam_mod.dispatch_count() - d0 == 1
+        live = res.ids[res.ids >= 0]
+        assert len(live) and allow[live].all()
+        assert not ((live >= rows) & (live < 2 * rows)).any(), \
+            "fully-filtered shard leaked results"
+        r = _recall(res.ids, _gt(vecs, q, allow_rows), len(allow_rows))
+        assert r >= 1.0 - 0.005, r
+
+
+# ---------------------------------------------------------------------------
+# shard integration: resident planes through ingest, tiering, SIGKILL
+# ---------------------------------------------------------------------------
+
+_RES_FILTER = Where.lt("n", 50)  # docs with n = i % 100 -> 50%
+
+
+def _shard_cfg(resident=True):
+    return CollectionConfig(
+        name="Planes",
+        properties=[Property(name="n", data_type=DataType.INT)],
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        resident_filters=[_RES_FILTER.to_dict()] if resident else [],
+    )
+
+
+def _pobj(i, dims=8):
+    rng = np.random.default_rng(i)
+    return StorageObject(
+        uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Planes",
+        properties={"n": int(i % 100)},
+        vector=rng.standard_normal(dims).astype(np.float32))
+
+
+@pytest.fixture
+def tmpdir():
+    d = tempfile.mkdtemp()
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def test_resident_plane_maintained_under_live_ingest(tmpdir):
+    s = Shard(tmpdir, _shard_cfg())
+    try:
+        s.put_batch([_pobj(i) for i in range(200)])
+        pl = s.filter_planes.lookup(_RES_FILTER)
+        assert pl is not None
+        oracle = s.allow_list(_RES_FILTER)
+        assert np.array_equal(pl.mask(len(oracle)), oracle)
+        v = pl.version
+
+        # live ingest: bits flip incrementally, version does NOT
+        s.put_batch([_pobj(i) for i in range(200, 320)])
+        oracle = s.allow_list(_RES_FILTER)
+        assert np.array_equal(pl.mask(len(oracle)), oracle)
+        assert pl.version == v, \
+            "on_put must not bump the version (coalescing identity)"
+
+        s.delete([_pobj(i).uuid for i in range(0, 100, 7)])
+        oracle = s.allow_list(_RES_FILTER)
+        assert np.array_equal(pl.mask(len(oracle)), oracle)
+        assert pl.version == v
+    finally:
+        s.close()
+
+
+def test_plane_auto_promotion_through_shard_lookup(tmpdir):
+    s = Shard(tmpdir, _shard_cfg(resident=False))
+    try:
+        s.put_batch([_pobj(i) for i in range(64)])
+        hot = Where.eq("n", 3)
+        seen = None
+        for _ in range(32):
+            seen = s.filter_planes.lookup(hot)
+            if seen is not None:
+                break
+        assert seen is not None, "hot filter never promoted to a plane"
+        oracle = s.allow_list(hot)
+        assert np.array_equal(seen.mask(len(oracle)), oracle)
+    finally:
+        s.close()
+
+
+def test_tiering_detach_attach_symmetry(tmpdir):
+    s = Shard(tmpdir, _shard_cfg())
+    try:
+        s.put_batch([_pobj(i) for i in range(128)])
+        pl = s.filter_planes.lookup(_RES_FILTER)
+        pl.device_mask(256)  # materialize the HBM mirror
+        plane_bytes = pl.hbm_bytes()
+        assert plane_bytes > 0
+        total = s.hbm_bytes()
+        assert total >= plane_bytes, \
+            "plane HBM bytes missing from the tiering ledger"
+        assert FILTER_PLANE_HBM_BYTES.value(
+            shard=s.name) == plane_bytes
+
+        freed = s.demote_device()
+        assert freed >= plane_bytes
+        assert pl.hbm_bytes() == 0
+        assert FILTER_PLANE_HBM_BYTES.value(shard=s.name) == 0
+
+        pl.device_mask(256)  # re-attach
+        assert pl.hbm_bytes() == plane_bytes  # symmetric
+        assert s.hbm_bytes() >= plane_bytes
+    finally:
+        s.close()
+
+
+_CHILD_PLANES = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("WEAVIATE_TPU_MESH", "off")
+import numpy as np
+from weaviate_tpu.core.shard import Shard
+from weaviate_tpu.inverted.filters import Where
+from weaviate_tpu.schema.config import (
+    CollectionConfig, DataType, FlatIndexConfig, Property)
+from weaviate_tpu.storage.objects import StorageObject
+
+def _pobj(i, dims=8):
+    rng = np.random.default_rng(i)
+    return StorageObject(
+        uuid=f"00000000-0000-0000-0000-{i:012d}", collection="Planes",
+        properties={"n": int(i % 100)},
+        vector=rng.standard_normal(dims).astype(np.float32))
+
+cfg = CollectionConfig(
+    name="Planes",
+    properties=[Property(name="n", data_type=DataType.INT)],
+    vector_config=FlatIndexConfig(distance="l2-squared",
+                                  precision="fp32"),
+    resident_filters=[Where.lt("n", 50).to_dict()])
+s = Shard(sys.argv[1], cfg, sync_writes=True)
+s.put_batch([_pobj(i) for i in range(64)])
+# build the plane, then keep ingesting THROUGH it so on_put bits are
+# in flight when the kill lands
+s.filter_planes.lookup(Where.lt("n", 50))
+s.put_batch([_pobj(i) for i in range(64, 128)])
+print("PLANES_LIVE", flush=True)
+s.put_batch([_pobj(i) for i in range(128, 192)])
+time.sleep(120)
+"""
+
+
+@pytest.mark.timeout(240)
+def test_sigkill_replay_plane_matches_inverted_oracle(tmpdir):
+    """kill -9 with plane maintenance in flight: after replay the
+    re-declared plane rebuilds lazily and matches the inverted-index
+    oracle exactly — whatever subset of writes survived."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "WEAVIATE_TPU_MESH": "off"}
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_PLANES, tmpdir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, start_new_session=True)
+    try:
+        deadline = time.monotonic() + 90
+        for line in proc.stdout:
+            if "PLANES_LIVE" in line:
+                break
+            assert time.monotonic() < deadline
+        else:
+            raise AssertionError(
+                f"child exited rc={proc.wait()} before PLANES_LIVE")
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait(timeout=30)
+        proc.stdout.close()
+
+    s = Shard(tmpdir, _shard_cfg())
+    try:
+        assert s.count() >= 128  # both acked batches replayed
+        pl = s.filter_planes.lookup(_RES_FILTER)
+        assert pl is not None and not pl.stale
+        oracle = s.allow_list(_RES_FILTER)
+        assert np.array_equal(pl.mask(len(oracle)), oracle), \
+            "replayed plane diverged from the inverted-index oracle"
+        # and it serves filtered search correctly
+        probe = _pobj(7)  # n=7 < 50: allowed
+        res = s.vector_search(probe.vector[None, :], k=1,
+                              allow_list=pl.mask(len(oracle)))
+        assert int(res.ids[0][0]) == 7
+    finally:
+        s.close()

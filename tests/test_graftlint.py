@@ -2771,6 +2771,78 @@ class TestErrorFlowEngineIntegration:
                 in model.ingress)
         assert "weaviate_tpu.cluster.fake::Plain.other" not in model.ingress
 
+    def test_unplanned_dispatch_flagged(self):
+        res = run("""
+            class Index:
+                def search(self, queries, k, allow_list=None):
+                    return self._dispatch.search(queries, k, allow_list)
+        """, rel="weaviate_tpu/index/fake.py",
+            rules=["unplanned-filtered-search"])
+        assert rule_ids(res) == ["unplanned-filtered-search"]
+        assert res.violations[0].severity == "warning"
+
+    def test_planned_dispatch_clean(self):
+        res = run("""
+            from weaviate_tpu.query.planner import PlanStats, plan
+
+            class Index:
+                def search(self, queries, k, allow_list=None):
+                    chosen = plan(PlanStats(live=10, k=k, ef=64,
+                                            selectivity=0.5))
+                    return self._dispatch.search(queries, k, allow_list)
+        """, rel="weaviate_tpu/index/fake.py",
+            rules=["unplanned-filtered-search"])
+        assert rule_ids(res) == []
+
+    def test_unfiltered_dispatch_clean(self):
+        # no allow arg in scope: plain traffic needs no plan
+        res = run("""
+            class Index:
+                def search(self, queries, k):
+                    return self._dispatch.search(queries, k, None)
+        """, rel="weaviate_tpu/index/fake.py",
+            rules=["unplanned-filtered-search"])
+        assert rule_ids(res) == []
+
+    def test_mask_materialize_without_planes_flagged(self):
+        res = run("""
+            class Explorer:
+                def run(self, shard, flt, q, k):
+                    mask = shard.allow_list(flt)
+                    return shard.vector_search(q, k, allow_list=mask)
+        """, rel="weaviate_tpu/query/fake.py",
+            rules=["unplanned-filtered-search"])
+        assert rule_ids(res) == ["unplanned-filtered-search"]
+
+    def test_mask_materialize_with_planes_clean(self):
+        res = run("""
+            class Explorer:
+                def run(self, shard, flt, q, k):
+                    plane = shard.filter_planes.lookup(flt)
+                    mask = plane if plane is not None \\
+                        else shard.allow_list(flt)
+                    return shard.vector_search(q, k, allow_list=mask)
+        """, rel="weaviate_tpu/query/fake.py",
+            rules=["unplanned-filtered-search"])
+        assert rule_ids(res) == []
+
+    def test_unplanned_search_cold_dir_not_flagged(self):
+        res = run("""
+            class Index:
+                def search(self, queries, k, allow_list=None):
+                    return self._dispatch.search(queries, k, allow_list)
+        """, rel=COLD, rules=["unplanned-filtered-search"])
+        assert rule_ids(res) == []
+
+    def test_unplanned_search_suppressible(self):
+        res = run("""
+            class Index:
+                def search(self, queries, k, allow_list=None):
+                    return self._dispatch.search(queries, k, allow_list)  # graftlint: allow[unplanned-filtered-search] reason=exact host tier, planner upstream
+        """, rel="weaviate_tpu/index/fake.py",
+            rules=["unplanned-filtered-search"])
+        assert rule_ids(res) == []
+
     def test_select_excludes_errorflow(self):
         res = run("""
             class Node:

@@ -141,10 +141,13 @@ def test_mesh_filter_and_tombstones_span_shards(rng):
     idx, corpus = _build(rng, QCFGS["sq"], n=1200)
     n = len(corpus)
     rows = idx._device_beam.rows_per_shard()
-    # ban one ENTIRE shard's rows plus a scattered 30% everywhere else
+    # ban one ENTIRE shard's rows plus a scattered 20% everywhere else
+    # (20%, not more: below 50% selectivity the planner's two-hop
+    # expansion doubles the beam cost and the exact masked scan wins the
+    # race on a corpus this small — this test pins the FUSED masked path)
     allow = np.ones(idx.graph.capacity, bool)
     allow[rows:2 * rows] = False
-    allow[rng.choice(n, int(0.3 * n), replace=False)] = False
+    allow[rng.choice(n, int(0.2 * n), replace=False)] = False
     dead = np.arange(0, n, 7, dtype=np.int64)  # every shard gets deletes
     idx.delete(dead)
 

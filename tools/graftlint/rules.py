@@ -1680,6 +1680,91 @@ class BlockingCallWithoutDeadline(WholeProgramRule):
     )
 
 
+class UnplannedFilteredSearch(Rule):
+    id = "unplanned-filtered-search"
+    description = (
+        "filtered search entry point that bypasses the cost-based "
+        "planner, or materializes a full-corpus host mask without "
+        "consulting the resident filter-plane store"
+    )
+    rationale = (
+        "Filtered device search is routed by query/planner: plan() "
+        "races exact-scan / filtered-beam / over-fetch from selectivity "
+        "stats, and hot predicates serve from device-resident bitmap "
+        "planes the dispatcher coalesces by (plane_id, version). A "
+        "search path that takes an allow mask straight into the "
+        "dispatcher re-introduces the unplanned walk the planner "
+        "replaced (wrong plan at the selectivity extremes), and an "
+        "inverted-index allow_list() materialization that never asks "
+        "the plane store first pays a full-corpus mask build + device "
+        "upload per query for predicates that already have a resident "
+        "plane. Consult plan()/filter_planes, or suppress with the "
+        "invariant that makes the bypass safe."
+    )
+
+    _DIRS = ("weaviate_tpu/index/", "weaviate_tpu/query/")
+    _ALLOW_ARGS = frozenset({"allow", "allow_list"})
+    _PLANNER_TOKENS = frozenset({
+        "plan", "planner", "PlanStats", "expansion_budget",
+    })
+
+    @staticmethod
+    def _tokens(fn: ast.AST) -> set:
+        toks = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name):
+                toks.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                toks.add(n.attr)
+            elif isinstance(n, ast.ImportFrom) and n.module:
+                toks.update(n.module.split("."))
+        return toks
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, self._DIRS):
+            return
+        for fn in ctx.walk(ast.FunctionDef):
+            args = fn.args
+            names = {a.arg for a in (args.args + args.kwonlyargs
+                                     + args.posonlyargs)}
+            toks = None
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                if (f.attr == "search"
+                        and isinstance(f.value, ast.Attribute)
+                        and f.value.attr == "_dispatch"
+                        and names & self._ALLOW_ARGS):
+                    if toks is None:
+                        toks = self._tokens(fn)
+                    if not (toks & self._PLANNER_TOKENS):
+                        yield self.violation(
+                            ctx, node,
+                            "filtered dispatcher search without a "
+                            "planner decision — route the allow mask "
+                            "through query.planner.plan() so the "
+                            "exact/beam/over-fetch choice is costed "
+                            "and traced",
+                            severity=SEV_WARNING,
+                        )
+                elif f.attr == "allow_list":
+                    if toks is None:
+                        toks = self._tokens(fn)
+                    if "filter_planes" not in toks:
+                        yield self.violation(
+                            ctx, node,
+                            "full-corpus host mask materialized without "
+                            "consulting the resident plane store — "
+                            "lookup filter_planes first so hot "
+                            "predicates serve from their device bitmap "
+                            "instead of rebuilding the mask per query",
+                            severity=SEV_WARNING,
+                        )
+
+
 ALL_RULES: tuple = (
     HostSyncInHotPath(),
     JitInLoop(),
@@ -1705,6 +1790,7 @@ ALL_RULES: tuple = (
     UnwarmedJitProgram(),
     UnverifiedRemoteDelete(),
     SingletonCycleWithoutLeaderCheck(),
+    UnplannedFilteredSearch(),
     SuppressionMissingReason(),
 )
 

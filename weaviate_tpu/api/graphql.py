@@ -654,15 +654,17 @@ class GraphQLExecutor:
         return p
 
     def _needs_cluster_scatter(self, p) -> bool:
-        """A PLAIN nearVector Get against a collection whose shard set
-        extends beyond this node must scatter through the cluster — the
-        local replica view would silently drop the remote shards' hits.
-        Any feature the cluster search API doesn't carry (filters,
-        hybrid, offsets, ...) keeps the local path with its documented
-        local-replica semantics."""
+        """A nearVector Get (plain or where-filtered — the cluster
+        search API ships the filter AST and each replica re-plans
+        locally) against a collection whose shard set extends beyond
+        this node must scatter through the cluster — the local replica
+        view would silently drop the remote shards' hits. Any feature
+        the cluster search API doesn't carry (hybrid, offsets, ...)
+        keeps the local path with its documented local-replica
+        semantics."""
         if self.cluster is None or p.near_vector is None:
             return False
-        featured = (p.filters is not None or p.hybrid is not None
+        featured = (p.hybrid is not None
                     or p.bm25_query is not None or p.near_text is not None
                     or getattr(p, "ask", None) is not None
                     or p.group_by is not None
@@ -759,7 +761,8 @@ class GraphQLExecutor:
         if self._needs_cluster_scatter(params):
             rows = self.cluster.vector_search(
                 params.collection, params.near_vector, k=params.limit,
-                tenant=params.tenant, target=params.target_vector)
+                tenant=params.tenant, target=params.target_vector,
+                flt=params.filters)
             return [self._render_object(cls.selections, obj, None, d)
                     for obj, d in rows]
 

@@ -163,7 +163,7 @@ class RestAPI:
         "root", "meta", "ready", "live", "metrics", "openapi",
         "oidc_discovery", "pprof_profile", "pprof_heap", "debug_traces",
         "debug_config", "debug_telemetry", "debug_cluster",
-        "debug_compile", "cluster_autoscale",
+        "debug_compile", "debug_planner", "cluster_autoscale",
     })
     # endpoint -> admission lane; anything unlisted is background
     # (schema/authz/backup/replication mutations: important, not latency-
@@ -352,6 +352,8 @@ class RestAPI:
             Rule("/v1/debug/telemetry", endpoint="debug_telemetry",
                  methods=["GET"]),
             Rule("/v1/debug/compile", endpoint="debug_compile",
+                 methods=["GET"]),
+            Rule("/v1/debug/planner", endpoint="debug_planner",
                  methods=["GET"]),
             Rule("/v1/debug/reindex/<cls>", endpoint="debug_reindex",
                  methods=["POST"]),
@@ -1499,6 +1501,64 @@ class RestAPI:
                 "phases": devtime.phase_counts(),
             },
         })
+
+    def on_debug_planner(self, request):
+        """Query-planner inspection surface (docs/planner.md): per
+        collection/shard, the resident filter planes (id, version, hit
+        count, HBM bytes) and the inverted index's selectivity sketches
+        (per-property row count / NDV / min-max) the cost model plans
+        from. An operator can answer "why did this filter take a beam"
+        from this GET plus the plan's trace-span attributes.
+
+        ``?estimate=<filter-json>&collection=<name>`` additionally runs
+        the estimator against live sketches and returns per-shard
+        selectivity — the same numbers plan() would consume."""
+        self._authz(request, "read_cluster", "debug/planner")
+        from weaviate_tpu.utils.runtime_config import (
+            FILTER_PLANE_MAX,
+            FILTER_PLANE_PROMOTE_HITS,
+        )
+
+        out: dict = {
+            "knobs": {
+                "filter_plane_promote_hits":
+                    int(FILTER_PLANE_PROMOTE_HITS.get()),
+                "filter_plane_max": int(FILTER_PLANE_MAX.get()),
+            },
+            "collections": {},
+        }
+        want = request.args.get("collection")
+        for name, col in list(self.db._collections.items()):
+            if want and name != want:
+                continue
+            shards = {}
+            for sname, shard in list(col._shards.items()):
+                inv_stats = shard.inverted.stats()
+                shards[sname] = {
+                    "filter_planes": shard.filter_planes.stats(),
+                    "selectivity_sketches":
+                        inv_stats.get("selectivity_sketches", {}),
+                }
+            out["collections"][name] = {"shards": shards}
+        est = request.args.get("estimate")
+        if est:
+            import json as _json
+
+            from weaviate_tpu.inverted.filters import Filter
+
+            flt = Filter.from_dict(_json.loads(est))
+            estimates: dict = {}
+            for name, col in list(self.db._collections.items()):
+                if want and name != want:
+                    continue
+                for sname, shard in list(col._shards.items()):
+                    try:
+                        estimates[f"{name}/{sname}"] = \
+                            shard.inverted.estimate_selectivity(flt)
+                    except Exception as e:
+                        estimates[f"{name}/{sname}"] = f"error: {e}"
+            out["estimates"] = estimates
+        return _json_response(out)
 
     def on_debug_reindex(self, request, cls):
         self._authz(request, "update_schema", f"collections/{cls}")

@@ -1251,18 +1251,24 @@ class ClusterNode:
     # -- search: scatter-gather --------------------------------------------
     def vector_search(self, cls: str, query: np.ndarray, k: int = 10,
                       tenant: str = "", target: str = "",
+                      flt=None,
                       deadline: Optional[Deadline] = None) \
             -> list[tuple[StorageObject, float]]:
+        """Scatter a (optionally filtered) vector search across shards.
+        The filter ships as its AST dict; each serving replica re-plans
+        LOCALLY (plane lookup + sketch estimate are per-shard state, so
+        the same query may take different plans on different shards)."""
         state = self._state_for(cls)
         q = np.asarray(query, np.float32)
         deadline = self._op_deadline("vector_search", deadline)
+        filter_dict = flt.to_dict() if flt is not None else None
 
         def one_shard(shard: int) -> list[tuple[float, bytes]]:
             r = self._first_replica(state, shard, {
                 "type": "shard_search", "class": cls,
                 "tenant": tenant, "shard": shard,
                 "query": q.tobytes(), "dims": q.shape[-1],
-                "k": k, "target": target,
+                "k": k, "target": target, "filter": filter_dict,
             }, deadline)
             return [(dist, blob) for dist, blob in r["hits"]]
 
@@ -1298,7 +1304,25 @@ class ClusterNode:
         shard = self._local_shard(msg["class"], msg["shard"],
                                   msg.get("tenant", ""))
         q = np.frombuffer(msg["query"], np.float32).reshape(1, msg["dims"])
-        res = shard.vector_search(q, msg["k"], target=msg.get("target", ""))
+        allow = None
+        est_sel = None
+        if msg.get("filter"):
+            from weaviate_tpu.inverted.filters import Filter
+
+            flt = Filter.from_dict(msg["filter"])
+            # plane-first, exactly like the single-node path: the plan
+            # is made per shard from per-shard stats
+            plane = shard.filter_planes.lookup(flt)
+            allow = plane if plane is not None else shard.allow_list(flt)
+            try:
+                est_sel = shard.inverted.estimate_selectivity(flt)
+            except Exception:
+                logging.getLogger("weaviate_tpu.cluster").debug(
+                    "selectivity estimate failed", exc_info=True)
+                est_sel = None
+        res = shard.vector_search(q, msg["k"], target=msg.get("target", ""),
+                                  allow_list=allow,
+                                  est_selectivity=est_sel)
         hits = []
         for d, i in zip(res.dists[0], res.ids[0]):
             if i < 0:

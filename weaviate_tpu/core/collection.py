@@ -1144,14 +1144,33 @@ class Collection:
                     REPORTER.track("vector", collection=self.config.name,
                                    shard=shard.name) as tr:
                 allow = None
+                est_sel = None
                 if flt is not None:
-                    allow = shard.allow_list(flt)
+                    # resident plane first: a hot predicate serves from
+                    # its bitmap (and coalesces in the dispatcher by
+                    # (plane_id, version)) instead of materializing a
+                    # fresh full-corpus mask per query; the sketch
+                    # estimate rides along for the planner's trace span
+                    plane = shard.filter_planes.lookup(flt)
+                    allow = (plane if plane is not None
+                             else shard.allow_list(flt))
+                    try:
+                        est_sel = shard.inverted.estimate_selectivity(flt)
+                    except Exception:
+                        # estimator gaps never fail a query
+                        import logging
+
+                        logging.getLogger(
+                            "weaviate_tpu.core.collection").debug(
+                            "selectivity estimate failed", exc_info=True)
+                        est_sel = None
                 tr.stage("filter")
                 if deadline is not None:
                     deadline.require()  # filter work may have spent it
                 res = shard.vector_search(
                     queries, k, target=target, allow_list=allow,
-                    max_distance=max_distance, rerank=rerank)
+                    max_distance=max_distance, rerank=rerank,
+                    est_selectivity=est_sel)
                 tr.stage("search")
             return shard, res
 
@@ -1467,11 +1486,23 @@ class Collection:
             dists: dict[tuple[str, int], float] = {}
             for shard in shards:
                 allow = None
+                est_sel = None
                 if flt is not None:
-                    allow = shard.allow_list(flt)
+                    plane = shard.filter_planes.lookup(flt)
+                    allow = (plane if plane is not None
+                             else shard.allow_list(flt))
+                    try:
+                        est_sel = shard.inverted.estimate_selectivity(flt)
+                    except Exception:
+                        import logging
+
+                        logging.getLogger(
+                            "weaviate_tpu.core.collection").debug(
+                            "selectivity estimate failed", exc_info=True)
+                        est_sel = None
                 res = shard.vector_search(
                     np.atleast_2d(np.asarray(q, np.float32)), k, target=tgt,
-                    allow_list=allow,
+                    allow_list=allow, est_selectivity=est_sel,
                 )
                 for d, i in zip(res.dists[0], res.ids[0]):
                     if i >= 0:

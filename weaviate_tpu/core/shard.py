@@ -107,6 +107,18 @@ class Shard:
         self._inv_snap_path = os.path.join(dirpath, "inverted.snap")
         self.inverted = make_inverted_index(
             config, self.store, snapshot_path=self._inv_snap_path)
+        # resident filter planes (query/planner/planes.py): declared hot
+        # predicates compile to bitmap planes maintained on the durable
+        # write path; undeclared predicates auto-promote by hit rate.
+        # recompute = the exact evaluator (inverted ∧ live), used at
+        # promotion and stale recovery — NOT per query.
+        from weaviate_tpu.inverted.filters import Filter as _Filter
+        from weaviate_tpu.query.planner import FilterPlaneStore
+
+        self.filter_planes = FilterPlaneStore(recompute=self.allow_list)
+        for f in (config.resident_filters or []):
+            self.filter_planes.declare(
+                _Filter.from_dict(f) if isinstance(f, dict) else f)
         self._migrating = False  # auto tier upgrade in flight
         self._migrate_cancel = False
         self._migrate_thread = None
@@ -567,6 +579,7 @@ class Shard:
                     self.objects.put(_DOCID.pack(obj.doc_id),
                                      obj.to_bytes())
                     self.inverted.add_object(obj)
+                    self.filter_planes.on_put(obj.doc_id, obj.properties)
                     if obj.vector is not None:
                         b = batches.setdefault(DEFAULT_VECTOR, ([], []))
                         b[0].append(obj.doc_id)
@@ -642,6 +655,7 @@ class Shard:
             if raw is not None:
                 old = StorageObject.from_bytes(raw)
                 self.inverted.delete_object(old)
+                self.filter_planes.on_delete(d)
                 self.objects.delete(_DOCID.pack(d))
                 self._mark_live(d, False)
                 self._live_count -= 1
@@ -748,7 +762,10 @@ class Shard:
         allow_list: Optional[np.ndarray] = None,
         max_distance: Optional[float] = None,
         rerank=None,
+        est_selectivity: Optional[float] = None,
     ) -> SearchResult:
+        """``allow_list`` is an ndarray mask or a resident FilterPlane;
+        routes that can't consume a plane resolve its host bitmap here."""
         idx = self._vector_indexes.get(target)
         if idx is None:
             b = np.atleast_2d(queries).shape[0]
@@ -762,6 +779,13 @@ class Shard:
         # arrays, host = the warm tier's exact fallback executor
         TIER_SEARCHES.inc(
             tier="device" if idx.device_resident else "host")
+        if allow_list is not None \
+                and getattr(allow_list, "plane_id", None) is not None \
+                and (idx.multi_vector or max_distance is not None
+                     or not getattr(idx, "supports_filter_planes", False)):
+            # only the plain graph search consumes planes natively; every
+            # other route gets the plane's host bitmap
+            allow_list = allow_list.mask(max(self._next_doc_id, 1))
         if idx.multi_vector:
             # a [Tq, D] matrix is ONE late-interaction query (token set),
             # not a Tq-query batch; max_distance bounds the negated
@@ -782,20 +806,28 @@ class Shard:
                     "rerank and max_distance cannot combine: reranked "
                     "distances are negated module scores, not metric "
                     "distances a bound could apply to")
-            return idx.search(queries, k, allow_list, rerank=rerank)
+            return idx.search(queries, k, allow_list, rerank=rerank,
+                              est_selectivity=est_selectivity)
         if max_distance is not None:
             return idx.search_by_distance(queries, max_distance, allow_list, limit=k)
-        return idx.search(queries, k, allow_list)
+        return idx.search(queries, k, allow_list,
+                          est_selectivity=est_selectivity)
 
     def objects_by_docids(self, doc_ids: np.ndarray) -> list[Optional[StorageObject]]:
         return [self.get_by_docid(int(d)) if d >= 0 else None for d in doc_ids]
 
     # -- tiered residency (docs/tiering.md) --------------------------------
     def hbm_bytes(self) -> int:
-        """Current HBM rent of every vector index this shard owns."""
+        """Current HBM rent of every vector index this shard owns, plus
+        the resident filter planes' device mirrors — planes are charged
+        to the same tiering ledger as the arrays they filter."""
+        from weaviate_tpu.monitoring.metrics import FILTER_PLANE_HBM_BYTES
+
+        plane_bytes = self.filter_planes.hbm_bytes()
+        FILTER_PLANE_HBM_BYTES.set(plane_bytes, shard=self.name)
         with self._lock:
-            return sum(idx.hbm_bytes()
-                       for idx in self._vector_indexes.values())
+            return plane_bytes + sum(idx.hbm_bytes()
+                                     for idx in self._vector_indexes.values())
 
     def host_tier_bytes(self) -> int:
         with self._lock:
@@ -818,8 +850,17 @@ class Shard:
         can interleave with the array move."""
         with self._lock:
             with self.async_queue.apply_barrier():
-                return sum(idx.demote_device()
-                           for idx in self._vector_indexes.values())
+                # plane mirrors detach with the arrays they filter (the
+                # host bitmap stays — re-promotion re-uploads lazily at
+                # the next filtered query, symmetric by construction)
+                freed = self.filter_planes.drop_device()
+                from weaviate_tpu.monitoring.metrics import (
+                    FILTER_PLANE_HBM_BYTES,
+                )
+
+                FILTER_PLANE_HBM_BYTES.set(0, shard=self.name)
+                return freed + sum(idx.demote_device()
+                                   for idx in self._vector_indexes.values())
 
     def promote_device(self) -> int:
         with self._lock:
@@ -1022,4 +1063,5 @@ class Shard:
             "vector_indexes": {
                 nm: idx.stats() for nm, idx in self._vector_indexes.items()
             },
+            "filter_planes": self.filter_planes.stats(),
         }

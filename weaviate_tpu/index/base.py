@@ -29,6 +29,10 @@ class VectorIndex(abc.ABC):
     """Batched ANN index over internal doc ids (uint64 monotonic per shard)."""
 
     multi_vector: bool = False
+    # whether search() accepts a resident FilterPlane as ``allow_list``
+    # (query/planner/planes.py); callers resolve the plane's host bitmap
+    # for indexes that don't
+    supports_filter_planes: bool = False
 
     @abc.abstractmethod
     def add_batch(self, doc_ids: np.ndarray, vectors: np.ndarray) -> None:
@@ -44,8 +48,13 @@ class VectorIndex(abc.ABC):
         queries: np.ndarray,
         k: int,
         allow_list: Optional[np.ndarray] = None,
+        est_selectivity: Optional[float] = None,
     ) -> SearchResult:
-        """Batched top-k by vector. ``allow_list``: bool mask over doc ids."""
+        """Batched top-k by vector. ``allow_list``: bool mask over doc ids
+        (or a resident FilterPlane where the index supports them).
+        ``est_selectivity``: the inverted index's sketch estimate for the
+        filter — explainability payload for planner-routed indexes, ignored
+        by the rest."""
 
     @abc.abstractmethod
     def search_by_distance(

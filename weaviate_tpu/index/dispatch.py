@@ -47,6 +47,8 @@ from weaviate_tpu.monitoring.metrics import (
     DISPATCH_BATCH_SECONDS,
     DISPATCH_DEVICE_ROWS,
     DISPATCH_EXPIRED,
+    DISPATCH_FILTERED_DIGEST,
+    DISPATCH_FILTERED_PLANE,
     DISPATCH_QUEUE_WAIT,
 )
 
@@ -103,12 +105,18 @@ class _Req:
         # otherwise coalesce into a batch whose arrays belong to the
         # other generation
         self.tier_key = tier_key
-        # content digest of the allow mask, computed ONCE at enqueue so
-        # the leader's grouping scan never re-reads mask bytes under the
-        # lock; collisions are disambiguated by array_equal in
-        # _masks_equal before two requests may share a batch
+        # mask identity, computed ONCE at enqueue so the leader's
+        # grouping scan never re-reads mask bytes under the lock. A
+        # resident filter plane (query/planner/planes.py) is addressed
+        # STRUCTURALLY by (plane_id, version) — no digesting; the
+        # version only bumps on rebuilds, so requests racing live
+        # ingest still coalesce (torn-read stance of the live mask).
+        # Ad-hoc masks keep the content-digest path, disambiguated by
+        # array_equal in _masks_equal before sharing a batch.
         if allow is None:
             self.mask_key = None
+        elif getattr(allow, "plane_id", None) is not None:
+            self.mask_key = ("plane", allow.plane_id, allow.version)
         else:
             a = np.asarray(allow)
             self.mask_key = (a.shape, a.dtype.str, hash(a.tobytes()))
@@ -137,6 +145,12 @@ def _masks_equal(a: _Req, b: _Req) -> bool:
         return a.allow is None and b.allow is None
     if a.allow is b.allow:
         return True
+    a_plane = isinstance(a.mask_key, tuple) and a.mask_key[0] == "plane"
+    b_plane = isinstance(b.mask_key, tuple) and b.mask_key[0] == "plane"
+    if a_plane or b_plane:
+        # (plane_id, version) IS the identity — no byte compare needed,
+        # and a plane never coalesces with an ad-hoc mask
+        return a.mask_key == b.mask_key
     return a.mask_key == b.mask_key and np.array_equal(a.allow, b.allow)
 
 
@@ -286,6 +300,10 @@ class CoalescingDispatcher:
             queue_ms=round(queue_s * 1000, 3),
             **attrs,
         )
+        if group[0].allow is not None \
+                and getattr(group[0].allow, "plane_id", None) is not None:
+            span.set(plane=group[0].allow.plane_id,
+                     plane_version=group[0].allow.version)
         return span
 
     def _drain(self, until_done: Optional[_Req] = None) -> None:
@@ -319,6 +337,13 @@ class CoalescingDispatcher:
                 q = (group[0].queries if len(group) == 1
                      else np.concatenate([r.queries for r in group], axis=0))
                 DISPATCH_DEVICE_ROWS.inc(q.shape[0])
+                if group[0].allow is not None:
+                    # plane-vs-digest split: how often filtered batches
+                    # ride a resident plane instead of digesting masks
+                    if getattr(group[0].allow, "plane_id", None) is not None:
+                        DISPATCH_FILTERED_PLANE.inc()
+                    else:
+                        DISPATCH_FILTERED_DIGEST.inc()
                 if group[0].rerank is not None:
                     # per-request query token sets concatenate along the
                     # batch rows exactly like the queries themselves

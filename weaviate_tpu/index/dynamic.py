@@ -223,8 +223,14 @@ class DynamicIndex(VectorIndex):
                 self._pending_deletes.extend(
                     int(d) for d in np.asarray(doc_ids).ravel())
 
-    def search(self, queries, k, allow_list=None) -> SearchResult:
-        return self._inner.search(queries, k, allow_list)
+    @property
+    def supports_filter_planes(self) -> bool:
+        return getattr(self._inner, "supports_filter_planes", False)
+
+    def search(self, queries, k, allow_list=None,
+               est_selectivity=None) -> SearchResult:
+        return self._inner.search(queries, k, allow_list,
+                                  est_selectivity=est_selectivity)
 
     def search_by_distance(self, queries, max_distance, allow_list=None, limit=1024):
         return self._inner.search_by_distance(queries, max_distance, allow_list, limit)

@@ -68,10 +68,14 @@ class FlatIndex(VectorIndex):
         k: int,
         allow_list: Optional[np.ndarray] = None,
         approx_recall: Optional[float] = None,
+        est_selectivity: Optional[float] = None,
     ) -> SearchResult:
         """Top-k scan. ``approx_recall`` overrides the config knob (range
         queries force 0.0: approx selection may drop in-range rows, which
-        breaks the search_by_distance contract rather than trading recall)."""
+        breaks the search_by_distance contract rather than trading recall).
+        ``est_selectivity`` is accepted for signature parity with the
+        planner-aware HNSW path and ignored — a flat scan IS the exact
+        plan."""
         # a tiering demote/promote between the residency check below and
         # the array access re-routes the query, never fails it
         return run_tier_stable(
@@ -334,6 +338,7 @@ class QuantizedFlatIndex(VectorIndex):
         queries: np.ndarray,
         k: int,
         allow_list: Optional[np.ndarray] = None,
+        est_selectivity: Optional[float] = None,
     ) -> SearchResult:
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         if queries.shape[-1] != self.dims:

@@ -260,7 +260,10 @@ class HFreshIndex(VectorIndex):
 
     # -- search -------------------------------------------------------------
     def search(self, queries: np.ndarray, k: int,
-               allow_list: Optional[np.ndarray] = None) -> SearchResult:
+               allow_list: Optional[np.ndarray] = None,
+               est_selectivity: Optional[float] = None) -> SearchResult:
+        # est_selectivity: planner explainability payload — IVF probing has
+        # no plan race, so it is accepted for interface parity and unused
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         if queries.shape[-1] != self.dims:
             raise ValueError(

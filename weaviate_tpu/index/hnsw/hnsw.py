@@ -45,6 +45,8 @@ def _pow2_pad(n: int) -> int:
 
 
 class HNSWIndex(VectorIndex):
+    supports_filter_planes = True
+
     def __init__(
         self,
         dims: int,
@@ -348,6 +350,7 @@ class HNSWIndex(VectorIndex):
         level: int,
         keep_mask: Optional[np.ndarray] = None,
         keep_k: int = 0,
+        expand: int = 0,
     ):
         """Returns (res_ids [B, ef], res_d [B, ef]) ascending, and — when
         ``keep_mask`` is given (sweeping filter strategy, search.go:36-41) —
@@ -363,10 +366,10 @@ class HNSWIndex(VectorIndex):
         with self._scratch_lock:
             # graftlint: allow[blocking-under-lock] reason=scratch buffers are the shared state the walk mutates per hop; serving uses the device beam, this host walk is the annotated fallback tier
             return self._search_level_impl(qdev, eps, ef, level, keep_mask,
-                                           keep_k)
+                                           keep_k, expand)
 
     def _search_level_impl(self, qdev, eps, ef, level, keep_mask=None,
-                           keep_k=0):
+                           keep_k=0, expand=0):
         b = qdev.shape[0]
         rows = np.arange(b)
         # reusable visited scratch, cleared lazily via the touched log so a
@@ -419,6 +422,40 @@ class HNSWIndex(VectorIndex):
                 visited[rr[sel], nbrs[sel]] = True
                 touched.append((rr[sel], nbrs[sel]))
             nd = self._frontier_dists(qdev, nbrs)
+
+            if track_kept and expand > 0:
+                # ACORN two-hop widening — the parity oracle of the device
+                # kernel's _two_hop_widen: the `expand` closest BLOCKED
+                # neighbors expand through to their own adjacency rows in
+                # the same step, with in-row first-occurrence dedup
+                blocked_d = np.where(
+                    (nbrs >= 0) & ~keep_mask[np.maximum(nbrs, 0)],
+                    nd, _INF)
+                psel = np.argsort(blocked_d, axis=1,
+                                  kind="stable")[:, :expand]
+                parents = np.take_along_axis(nbrs, psel, 1)
+                pvalid = np.take_along_axis(blocked_d, psel, 1) < _INF
+                hop2 = self.graph.neighbors_batch(
+                    level, np.maximum(parents, 0).reshape(-1)
+                ).astype(np.int64).reshape(b, parents.shape[1], -1)
+                hop2[~pvalid] = NO_NODE
+                hop2 = hop2.reshape(b, -1)
+                eq = hop2[:, :, None] == hop2[:, None, :]
+                first = (np.argmax(eq, axis=2)
+                         == np.arange(hop2.shape[1])[None, :])
+                hop2[~first] = NO_NODE
+                hop2[hop2 >= visited.shape[1]] = NO_NODE
+                rr2 = np.repeat(rows, hop2.shape[1]).reshape(hop2.shape)
+                fresh2 = hop2 >= 0
+                fresh2[fresh2] = ~visited[rr2[fresh2], hop2[fresh2]]
+                hop2 = np.where(fresh2, hop2, NO_NODE)
+                sel2 = hop2 >= 0
+                if sel2.any():
+                    visited[rr2[sel2], hop2[sel2]] = True
+                    touched.append((rr2[sel2], hop2[sel2]))
+                nd2 = self._frontier_dists(qdev, hop2)
+                nbrs = np.concatenate([nbrs, hop2], axis=1)
+                nd = np.concatenate([nd, nd2], axis=1)
 
             all_ids = np.concatenate([res_ids, nbrs], axis=1)
             all_d = np.concatenate([res_d, nd], axis=1)
@@ -930,11 +967,15 @@ class HNSWIndex(VectorIndex):
         k: int,
         allow_list: Optional[np.ndarray] = None,
         rerank=None,
+        est_selectivity: Optional[float] = None,
     ) -> SearchResult:
         # a tiering demote/promote between the residency check and the
         # array access (here, in the dispatcher's leader, or in the host
         # tier) surfaces as ResidencyMoved: re-route, never fail — the
-        # retry re-enqueues under the NEW residency epoch's tier_key
+        # retry re-enqueues under the NEW residency epoch's tier_key.
+        # ``allow_list`` is an ndarray mask OR a resident FilterPlane
+        # (query/planner/planes.py); ``est_selectivity`` is the inverted
+        # index's sketch estimate, surfaced on the plan's trace span.
         from weaviate_tpu.index.base import run_tier_stable
 
         if rerank is not None and self._token_store is None:
@@ -942,7 +983,26 @@ class HNSWIndex(VectorIndex):
                 "rerank requested but no rerank module is configured on "
                 "this index (HNSWIndexConfig.rerank)")
         return run_tier_stable(
-            lambda: self._search_tiered(queries, k, allow_list, rerank))
+            lambda: self._search_tiered(queries, k, allow_list, rerank,
+                                        est_selectivity))
+
+    def _allow_host(self, allow_list):
+        """Resolve a resident FilterPlane to its host bitmap; ad-hoc
+        ndarray masks (and None) pass through untouched."""
+        if allow_list is not None \
+                and getattr(allow_list, "plane_id", None) is not None:
+            return allow_list.mask(self.graph.capacity)
+        return allow_list
+
+    def _allow_popcount(self, allow_list) -> int:
+        """Allowed count over PRESENT rows only: a capacity-sized mask's
+        padding tail must not count, or selectivity inflates past 1.0
+        and the planner mistakes a real filter for a no-op."""
+        if getattr(allow_list, "plane_id", None) is not None:
+            return allow_list.count()
+        a = np.asarray(allow_list, bool)
+        m = min(len(a), len(self.graph.levels))
+        return int(np.count_nonzero(a[:m] & (self.graph.levels[:m] >= 0)))
 
     def _fetch_width(self, k: int, ef: int) -> int:
         """THE over-fetch policy (reference hnsw/search.go:184
@@ -999,6 +1059,7 @@ class HNSWIndex(VectorIndex):
         k: int,
         allow_list: Optional[np.ndarray] = None,
         rerank=None,
+        est_selectivity: Optional[float] = None,
     ) -> SearchResult:
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         if queries.shape[-1] != self.backend.dims:
@@ -1022,39 +1083,16 @@ class HNSWIndex(VectorIndex):
             from weaviate_tpu.monitoring.tracing import TRACER
 
             with TRACER.span("tiering.host_search", rows=b, k=k):
+                allow_host = self._allow_host(allow_list)
                 if rerank is not None:
                     fetch = self._fetch_width(k, self._dynamic_ef(k))
                     _, ids = self.backend.host_topk(
-                        queries, fetch, allow_list)
+                        queries, fetch, allow_host)
                     ids, d = self._host_rerank_topk(
                         rerank.batch_for(queries), ids, k, "warm_tier")
                 else:
-                    d, ids = self.backend.host_topk(queries, k, allow_list)
+                    d, ids = self.backend.host_topk(queries, k, allow_host)
             return SearchResult(ids=ids, dists=d)
-
-        # Filtered-search triage (reference SWEEPING/ACORN/RRE pick,
-        # search.go:36-41 + the flat cutoff, flat_search.go:28). TPU-first
-        # the tiers are: (1) small OR mid-selectivity filters take the
-        # masked flat scan — one fused masked-matmul dispatch, exact, and
-        # on the MXU faster than a graph walk that would mostly expand
-        # disallowed nodes; (2) permissive filters sweep the graph (host
-        # lockstep beam, or the masked device beam which tracks
-        # best-allowed-seen on device in the same single dispatch).
-        if allow_list is not None:
-            n_allowed = int(np.asarray(allow_list, bool).sum())
-            live = max(1, self.count())
-            if (n_allowed <= self.config.flat_search_cutoff
-                    or n_allowed <= k
-                    or n_allowed <= self.config.filter_flat_selectivity
-                    * live):
-                if rerank is not None:
-                    fetch = self._fetch_width(k, self._dynamic_ef(k))
-                    _, ids = self.backend.flat_topk(
-                        queries, fetch, allow_list)
-                    ids, d = self._host_rerank_topk(
-                        rerank.batch_for(queries), ids, k, "flat_triage")
-                    return SearchResult(ids=ids, dists=d)
-                return self._flat_filtered(queries, k, allow_list)
 
         # batch-group key: residency epoch PLUS the mesh mirror's
         # membership epoch — a request enqueued before an integer-factor
@@ -1066,12 +1104,86 @@ class HNSWIndex(VectorIndex):
         # latency through it (utils/prewarm.py)
         from weaviate_tpu.utils.prewarm import isolation_key
 
+        tier_key = (self._residency_epoch,
+                    getattr(self._device_beam, "epoch", 0),
+                    isolation_key())
+
+        # Filtered-search triage is the COST-BASED PLANNER's call
+        # (query/planner/cost.py): pure ``plan()`` races the exact
+        # masked flat scan (reference SWEEPING + flat cutoff,
+        # flat_search.go:28) against the filter-aware beam (ACORN-style
+        # two-hop expansion through blocked neighbors) and the
+        # over-fetch-post-filter route, from the allowlist popcount —
+        # exact here; the inverted index's sketch estimate rides along
+        # as a trace attribute. The legacy cutoff knobs remain hard
+        # guards INSIDE the planner, so sub-cutoff filters take the
+        # one-dispatch masked-matmul exactly as before.
+        if allow_list is not None:
+            from weaviate_tpu.monitoring import tracing
+            from weaviate_tpu.monitoring.metrics import PLANNER_PLANS
+            from weaviate_tpu.query.planner import (
+                PLAN_EXACT,
+                PLAN_OVERFETCH,
+                PlanStats,
+                plan,
+            )
+
+            plane = (allow_list if getattr(allow_list, "plane_id", None)
+                     is not None else None)
+            n_allowed = self._allow_popcount(allow_list)
+            live = max(1, self.count())
+            stats = PlanStats(
+                live=live, k=k, ef=self._dynamic_ef(k),
+                selectivity=n_allowed / live, exact_count=True,
+                plane_resident=plane is not None,
+                flat_cutoff=self.config.flat_search_cutoff,
+                flat_selectivity=self.config.filter_flat_selectivity,
+                graph_degree=self.config.max_connections,
+                mesh=self._mesh_partitioned)
+            chosen = plan(stats)
+            PLANNER_PLANS.inc(plan=chosen.plan_type)
+            attrs = chosen.trace_attrs()
+            if est_selectivity is not None:
+                attrs["planner.sketch_selectivity"] = round(
+                    float(est_selectivity), 6)
+            if plane is not None:
+                attrs["planner.plane"] = plane.plane_id
+            tracing.annotate(**attrs)
+            if chosen.plan_type == PLAN_EXACT:
+                allow_host = self._allow_host(allow_list)
+                if rerank is not None:
+                    fetch = self._fetch_width(k, self._dynamic_ef(k))
+                    _, ids = self.backend.flat_topk(
+                        queries, fetch, allow_host)
+                    ids, d = self._host_rerank_topk(
+                        rerank.batch_for(queries), ids, k, "flat_triage")
+                    return SearchResult(ids=ids, dists=d)
+                return self._flat_filtered(queries, k, allow_host)
+            if chosen.plan_type == PLAN_OVERFETCH and rerank is None:
+                # over-fetch the UNFILTERED walk — it coalesces with
+                # plain traffic at fetch_k — then post-filter on host;
+                # the planner only picks this when selectivity is mild
+                # enough that fetch_k stays bounded
+                ids, d = self._dispatch.search(
+                    queries, chosen.fetch_k, None, tier_key=tier_key)
+                al = np.asarray(self._allow_host(allow_list), bool)
+                ok = ((ids >= 0) & (ids < len(al))
+                      & al[np.clip(ids, 0, len(al) - 1)])
+                d = np.where(ok, d, _INF)
+                ids = np.where(ok, ids, -1)
+                order = np.argsort(d, axis=1, kind="stable")[:, :k]
+                return SearchResult(
+                    ids=np.take_along_axis(ids, order, axis=1),
+                    dists=np.take_along_axis(d, order, axis=1))
+            # PLAN_BEAM (and over-fetch under rerank, which degenerates
+            # to the filtered beam — the fused rerank stage needs the
+            # mask on device): the plane/mask rides the dispatch below;
+            # the batch leader re-derives the expansion budget from the
+            # same popcount, so every coalesced member agrees with the
+            # plan made here
+
         ids, d = self._dispatch.search(
-            queries, k, allow_list,
-            tier_key=(self._residency_epoch,
-                      getattr(self._device_beam, "epoch", 0),
-                      isolation_key()),
-            rerank=rerank)
+            queries, k, allow_list, tier_key=tier_key, rerank=rerank)
         return SearchResult(ids=ids, dists=d)
 
     def _run_search_batch(self, queries: np.ndarray, k: int, allow_list,
@@ -1083,11 +1195,12 @@ class HNSWIndex(VectorIndex):
             # a demotion landed while this group was queued: the leader
             # re-routes the whole batch to the warm host tier instead of
             # touching (now-detached) device arrays
+            allow_host = self._allow_host(allow_list)
             if rerank is not None:
                 fetch = self._fetch_width(k, self._dynamic_ef(k))
-                _, ids = self.backend.host_topk(queries, fetch, allow_list)
+                _, ids = self.backend.host_topk(queries, fetch, allow_host)
                 return self._host_rerank_topk(rerank, ids, k, "warm_tier")
-            d, ids = self.backend.host_topk(queries, k, allow_list)
+            d, ids = self.backend.host_topk(queries, k, allow_host)
             return ids, d
         b = queries.shape[0]
         # visited scratch is [B, capacity]; bound its footprint
@@ -1110,6 +1223,7 @@ class HNSWIndex(VectorIndex):
         if len(valid) < cap:
             valid = np.pad(valid, (0, cap - len(valid)))
         keep = valid[:cap] & (self.graph.levels >= 0)
+        allow_list = self._allow_host(allow_list)
         if allow_list is not None:
             al = np.asarray(allow_list, bool)
             if len(al) < cap:
@@ -1121,11 +1235,22 @@ class HNSWIndex(VectorIndex):
         b = queries.shape[0]
         qdev = self._qdev(queries)
         ef = self._dynamic_ef(k)
+        # the leader re-derives the filtered beam's two-hop expansion
+        # budget from the group's mask (deterministic in the popcount,
+        # so it matches the plan each member was routed under — a plane
+        # coalesces only with itself, an ad-hoc mask only with byte-
+        # equal masks, hence ONE budget per batch)
+        expand = 0
+        if allow_list is not None:
+            from weaviate_tpu.query.planner import expansion_budget
+
+            n_allowed = self._allow_popcount(allow_list)
+            expand = expansion_budget(n_allowed / max(1, self.count()))
         if self._device_beam is not None:
             # fused walk: greedy descent + layer-0 beam in ONE dispatch
             # (the host per-level loop below is the fallback tier)
             out = self._device_beam_search(queries, qdev, ef, k, allow_list,
-                                           rerank=rerank)
+                                           rerank=rerank, expand=expand)
             if out is not None:
                 return out
         if self._mesh_partitioned:
@@ -1136,9 +1261,11 @@ class HNSWIndex(VectorIndex):
             # is the exact sharded flat scan — still one dispatch.
             if rerank is not None:
                 fetch = self._fetch_width(k, ef)
-                _, ids = self.backend.flat_topk(queries, fetch, allow_list)
+                _, ids = self.backend.flat_topk(
+                    queries, fetch, self._allow_host(allow_list))
                 return self._host_rerank_topk(rerank, ids, k, "host_walk")
-            d, ids = self.backend.flat_topk(queries, k, allow_list)
+            d, ids = self.backend.flat_topk(
+                queries, k, self._allow_host(allow_list))
             return ids, d
         eps = np.full(b, self.graph.entrypoint, np.int64)
         all_active = np.ones(b, bool)
@@ -1150,7 +1277,7 @@ class HNSWIndex(VectorIndex):
         # policy — the device walk and rerank pool use the same width
         keep_k = self._fetch_width(k, ef)
         _, _, kept_ids, kept_d = self._search_level(
-            qdev, eps, ef, 0, keep_mask=keep, keep_k=keep_k
+            qdev, eps, ef, 0, keep_mask=keep, keep_k=keep_k, expand=expand
         )
         if rerank is not None:
             # host-walk fallback: the kept candidates feed the module's
@@ -1159,7 +1286,7 @@ class HNSWIndex(VectorIndex):
         return self.backend.rescore_topk(queries, kept_ids, kept_d, k)
 
     def _device_beam_search(self, queries, qdev, ef, k, allow_list=None,
-                            rerank=None):
+                            rerank=None, expand: int = 0):
         """Full entrypoint→layer-0 walk in ONE device dispatch: the fused
         kernel runs the upper-layer greedy descent AND the layer-0 beam
         (``ops/device_beam.py``), gather-scoring the backend's HBM arrays
@@ -1205,8 +1332,11 @@ class HNSWIndex(VectorIndex):
                     [q, jnp.repeat(q[:1], b_pad - b, axis=0)], axis=0)
             cap = int(adj.shape[0])
             al_pad = None
+            plane = (allow_list if getattr(allow_list, "plane_id", None)
+                     is not None else None)
             if allow_list is not None:
-                al = np.asarray(allow_list, bool)
+                al = (plane.mask(cap) if plane is not None
+                      else np.asarray(allow_list, bool))
                 if len(al) < cap:
                     al = np.pad(al, (0, cap - len(al)))
                 al_pad = al[:cap]
@@ -1244,16 +1374,24 @@ class HNSWIndex(VectorIndex):
 
                 seeds = mesh_mirror.sync_seeds()
                 if al_pad is not None:
-                    allow_j = jax.device_put(
-                        al_pad, NamedSharding(
-                            mesh_mirror.mesh, P(SHARD_AXIS)))
+                    # a resident plane's device mirror is cached inside
+                    # the plane (keyed by version + mutation counter +
+                    # sharding), so repeat queries through a hot
+                    # predicate re-upload NOTHING; ad-hoc masks pay the
+                    # device_put per miss as before
+                    shard_spec = NamedSharding(mesh_mirror.mesh,
+                                               P(SHARD_AXIS))
+                    if plane is not None:
+                        allow_j = plane.device_mask(cap, shard_spec)
+                    else:
+                        allow_j = jax.device_put(al_pad, shard_spec)
                     out = device_search_mesh(
                         scorer, q, operands, adj, present,
                         mesh_mirror.mesh, ef=ef_pad,
                         max_steps=int(4 * ef_pad + 64), fetch=fetch_pad,
                         seeds=seeds, upper_adj=upper_adj,
                         upper_slots=upper_slots, allow=allow_j,
-                        keep_k=fetch_pad, **rr_args)
+                        keep_k=fetch_pad, expand=expand, **rr_args)
                     # with rerank the mesh merge ranks by module score
                     # and returns just (ids, neg_scores); unfused
                     # filtered walks return the 4-tuple kept track
@@ -1267,11 +1405,13 @@ class HNSWIndex(VectorIndex):
                         upper_slots=upper_slots, **rr_args)
             elif al_pad is not None:
                 eps = np.full(b_pad, self.graph.entrypoint, np.int32)
+                allow_j = (plane.device_mask(cap) if plane is not None
+                           else jnp.asarray(al_pad))
                 out = device_search(
                     scorer, q, operands, adj, present, eps,
                     ef=ef_pad, max_steps=int(4 * ef_pad + 64),
                     upper_adj=upper_adj, upper_slots=upper_slots,
-                    allow=jnp.asarray(al_pad), keep_k=fetch_pad,
+                    allow=allow_j, keep_k=fetch_pad, expand=expand,
                     **rr_args,
                 )
                 ids, d = out[2:]
@@ -1307,7 +1447,8 @@ class HNSWIndex(VectorIndex):
                 # a DISTINCT program identity whose first dispatch pays
                 # its own compile — it must not masquerade as a warm
                 # execute of the plain walk
-                shape_key=(b_pad, ef_pad, al_pad is not None, rr_name),
+                shape_key=(b_pad, ef_pad, al_pad is not None, expand,
+                           rr_name),
                 seconds=dt_dev)
             tracing.annotate(
                 device_execute_ms=round(dt_dev * 1000, 3),

@@ -375,9 +375,11 @@ class MultiVectorIndex(VectorIndex):
         return SearchResult(ids=out_ids, dists=out_d)
 
     def search(self, queries: np.ndarray, k: int,
-               allow_list: Optional[np.ndarray] = None) -> SearchResult:
+               allow_list: Optional[np.ndarray] = None,
+               est_selectivity: Optional[float] = None) -> SearchResult:
         """[B, D] single-vector queries (each = a 1-token set) or a single
-        [Tq, D] token matrix via search_multi."""
+        [Tq, D] token matrix via search_multi. ``est_selectivity`` is
+        accepted for interface parity (planes resolve to host masks here)."""
         queries = np.atleast_2d(np.asarray(queries, np.float32))
         outs = [self.search_multi(q[None, :], k, allow_list) for q in queries]
         return SearchResult(

@@ -58,6 +58,13 @@ class InvertedIndex:
         from weaviate_tpu.inverted.columnar import ColumnarProps
 
         self.columnar = ColumnarProps()
+        # per-property selectivity sketches (rows / NDV / min-max) feeding
+        # the cost-based query planner; maintained inline with the write
+        # path, persisted with the shard snapshot (+ segment flush in
+        # segmented mode)
+        from weaviate_tpu.inverted.sketches import SketchRegistry
+
+        self.sketches = SketchRegistry()
         self.doc_count = 0
         # cross-collection ref-filter hook, set by the owning Collection
         # (fn(inv, flt, space) -> mask); None = ref filters unsupported
@@ -195,6 +202,7 @@ class InvertedIndex:
                 continue
             if self._filterable(prop):
                 self.values[prop][doc_id] = val
+                self.sketches.add(prop, val)
             if self._range_indexed(prop) and self._range_eligible(val):
                 if prop in self._range_counts and \
                         self._range_counts[prop] is not None:
@@ -241,6 +249,8 @@ class InvertedIndex:
             self.native.remove_doc(doc_id)
         for prop, val in obj.properties.items():
             popped = self.values.get(prop, {}).pop(doc_id, None)
+            if popped is not None:
+                self.sketches.remove(prop)
             if self._range_eligible(popped) and \
                     self._range_counts.get(prop) is not None:
                 self._range_counts[prop] -= 1
@@ -274,6 +284,8 @@ class InvertedIndex:
             self.native.remove_doc(doc_id)
         for prop, vals in self.values.items():
             popped = vals.pop(doc_id, None)
+            if popped is not None:
+                self.sketches.remove(prop)
             if self._range_eligible(popped) and \
                     self._range_counts.get(prop) is not None:
                 self._range_counts[prop] -= 1
@@ -693,11 +705,22 @@ class InvertedIndex:
             raise ValueError(f"unhandled operator {op!r}")
         return mask
 
+    def estimate_selectivity(self, flt: Filter) -> float:
+        """Sketch-based estimate of the fraction of live docs passing
+        ``flt`` — O(filter tree), never touches postings or columns. The
+        planner's only statistics input (docs/planner.md)."""
+        from weaviate_tpu.inverted.sketches import estimate_selectivity
+
+        flt.validate()
+        return estimate_selectivity(flt, self.sketches.props,
+                                    self.doc_count)
+
     def stats(self) -> dict:
         return {
             "doc_count": self.doc_count,
             "searchable_props": sorted(self.postings.keys()),
             "filterable_props": sorted(self.values.keys()),
+            "selectivity_sketches": self.sketches.summary(),
         }
 
 

@@ -52,6 +52,7 @@ _DOCID = struct.Struct(">q")
 # text/bool tokens
 _K_PRESENT = b"\x00p"
 _K_MULTI = b"\x00m"
+_K_SKETCHES = b"sketches"  # sole row of the sketch_meta bucket
 _NUM_PREFIX = b"n"
 _TOK_PREFIX = b"t"
 
@@ -189,6 +190,26 @@ class SegmentedInvertedIndex(InvertedIndex):
         self._wand_lock = _threading.RLock()
         self.values = _ValuesFacade(self)
         self.propvals = store.bucket("propvals", "replace")
+        # selectivity sketches persist as segment metadata: one row,
+        # rewritten at every batched-writes flush (the segment-flush
+        # moment for every other bucket family). The shard snapshot also
+        # carries them; this row covers boots that rebuild from buckets
+        # without a snapshot.
+        self._sketch_bk = store.bucket("sketch_meta", "replace")
+        raw = self._sketch_bk.get(_K_SKETCHES)
+        if raw is not None:
+            try:
+                from weaviate_tpu.inverted.sketches import SketchRegistry
+
+                self.sketches = SketchRegistry.from_dict(
+                    msgpack.unpackb(raw, raw=False, strict_map_key=False))
+            except Exception:
+                # estimates only: a torn row degrades, never fails
+                import logging
+
+                logging.getLogger("weaviate_tpu.inverted").warning(
+                    "discarding unreadable selectivity sketches "
+                    "(rebuilt from future flushes)", exc_info=True)
         self._term_bk: dict[str, Any] = {}
         self._post_bk: dict[str, Any] = {}
         # avgdl state: totals + doc counts per searchable prop (persisted in
@@ -382,6 +403,13 @@ class SegmentedInvertedIndex(InvertedIndex):
                 self.len_totals[prop] += t
             for prop, c in pending["lens_counts"].items():
                 self.lens_counts[prop] += c
+            if pending["docs"]:
+                # segment metadata: sketches ride every flush so a boot
+                # without a snapshot still has planner statistics
+                self._sketch_bk.put(
+                    _K_SKETCHES,
+                    msgpack.packb(self.sketches.to_dict(),
+                                  use_bin_type=True))
 
     # keep the base-class name working for callers that only batch ranges
     batched_range_writes = batched_writes
@@ -450,6 +478,8 @@ class SegmentedInvertedIndex(InvertedIndex):
         # -- the object completed: merge its staging into the batch -------
         pend = self._pending
         pend["doc_count"] += 1
+        for prop, v in pv_vals.items():
+            self.sketches.add(prop, v)
         for prop in present:
             pend["present"][prop].append(doc_id)
         for prop in multi:
@@ -512,6 +542,7 @@ class SegmentedInvertedIndex(InvertedIndex):
                 continue
             vals = val if isinstance(val, list) else [val]
             if self._filterable(prop):
+                self.sketches.remove(prop)
                 bk = self._terms(prop)
                 bk.roaring_remove(_K_PRESENT, ids)
                 if len(vals) > 1:
@@ -977,6 +1008,7 @@ class SegmentedInvertedIndex(InvertedIndex):
             "filterable_props": sorted(
                 p.name for p in self.config.properties
                 if self._filterable(p.name)),
+            "selectivity_sketches": self.sketches.summary(),
         }
 
 

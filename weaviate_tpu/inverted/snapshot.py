@@ -135,6 +135,7 @@ def save_snapshot(inv, path: str, seq: int) -> None:
             "live": np.packbits(inv.columnar._live._arr).tobytes(),
             "live_n": len(inv.columnar._live._arr),
             "watermark": inv.columnar._watermark,
+            "sketches": inv.sketches.to_dict(),
         }
         if segmented:
             hdr["mode"] = "segmented"
@@ -254,6 +255,10 @@ def load_snapshot(inv, path: str) -> Optional[int]:
 
     inv.doc_count = doc_count
     inv.len_totals.update(len_totals)
+    if hdr.get("sketches"):
+        from weaviate_tpu.inverted.sketches import SketchRegistry
+
+        inv.sketches = SketchRegistry.from_dict(hdr["sketches"])
     inv.columnar._live = live
     inv.columnar._watermark = hdr["watermark"]
     inv.columnar.props = cols

@@ -283,6 +283,23 @@ DISPATCH_DEVICE_ROWS = REGISTRY.counter(
     "weaviate_tpu_dispatch_device_rows_total",
     "query rows the coalescing dispatcher actually sent to device "
     "batches (expired rows never count here)")
+DISPATCH_FILTERED_PLANE = REGISTRY.counter(
+    "weaviate_tpu_dispatch_filtered_plane_total",
+    "filtered device batches whose allow mask was a resident filter "
+    "plane — coalesced by (plane_id, version), no mask digesting")
+DISPATCH_FILTERED_DIGEST = REGISTRY.counter(
+    "weaviate_tpu_dispatch_filtered_digest_total",
+    "filtered device batches carrying an ad-hoc allow mask, coalesced "
+    "by content digest + exact compare (the fallback when no resident "
+    "plane serves the filter)")
+PLANNER_PLANS = REGISTRY.counter(
+    "weaviate_tpu_planner_plans_total",
+    "filtered-search plans chosen by the cost-based query planner, by "
+    "plan type (exact_scan / filtered_beam / overfetch_postfilter)")
+FILTER_PLANE_HBM_BYTES = REGISTRY.gauge(
+    "weaviate_tpu_filter_plane_hbm_bytes",
+    "HBM bytes held by resident filter-plane device mirrors, by shard "
+    "(charged inside the shard's tiering-ledger footprint)")
 DEVICE_BEAM_FALLBACK = REGISTRY.counter(
     "weaviate_tpu_device_beam_fallback_total",
     "fused device-beam walks that fell back to the host per-hop path, "

@@ -177,10 +177,48 @@ def _rerank_stage(rerank, out_k, cand, tokens, tmask, rq, rqmask):
 # ---------------------------------------------------------------------------
 
 
+def _two_hop_widen(adjacency, present, allow, queries, operands, scorer,
+                   nbrs, nd, visited, rows, expand: int):
+    """ACORN-style two-hop widening: instead of letting blocked neighbors
+    dead-end the kept track, the ``expand`` CLOSEST blocked one-hop
+    neighbors expand through to their own adjacency rows in the same
+    step. Returns (nbrs, nd, visited) with the second-hop frontier
+    concatenated — both the beam merge and the kept-track merge then
+    consume the widened frontier, so traversal reach grows under
+    selective filters without extra dispatches.
+
+    Second-hop rows from different parents can collide; an in-row
+    first-occurrence dedup keeps one copy (duplicate ids would otherwise
+    occupy two beam/kept slots and surface duplicate results). Collisions
+    with this step's one-hop frontier are screened by ``visited``, which
+    the caller already updated for the one-hop row."""
+    b, m0 = nbrs.shape[0], adjacency.shape[1]
+    # closest blocked one-hop neighbors become expansion parents
+    blocked_d = jnp.where(
+        (nbrs >= 0) & ~jnp.take(allow, jnp.maximum(nbrs, 0)), nd, _INF)
+    _, psel = jax.lax.top_k(-blocked_d, expand)            # [B, expand]
+    parents = jnp.take_along_axis(nbrs, psel, axis=1)
+    pvalid = jnp.take_along_axis(blocked_d, psel, axis=1) < _INF
+    parents = jnp.where(pvalid, parents, -1)
+    hop2 = jnp.take(adjacency, jnp.maximum(parents, 0), axis=0)
+    hop2 = jnp.where(pvalid[:, :, None], hop2, -1).reshape(b, expand * m0)
+    # in-row first-occurrence dedup across parent rows
+    eq = hop2[:, :, None] == hop2[:, None, :]
+    first = jnp.argmax(eq, axis=2) == jnp.arange(expand * m0)[None, :]
+    safe2 = jnp.maximum(hop2, 0)
+    seen2 = jnp.take_along_axis(visited, safe2, axis=1) > 0
+    ok2 = (hop2 >= 0) & first & ~seen2 & jnp.take(present, safe2)
+    hop2 = jnp.where(ok2, hop2, -1)
+    visited = visited.at[rows[:, None], safe2].max(ok2.astype(jnp.uint8))
+    nd2 = _masked_scores(scorer, queries, hop2, operands)
+    return (jnp.concatenate([nbrs, hop2], axis=1),
+            jnp.concatenate([nd, nd2], axis=1), visited)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("scorer", "ef", "max_steps", "keep_k", "rerank",
-                     "rerank_k"))
+                     "rerank_k", "expand"))
 def _fused_search(
     scorer,                      # static Scorer (hashable dataclass)
     queries: jnp.ndarray,        # [B, ...] backend query rep
@@ -194,6 +232,7 @@ def _fused_search(
     max_steps: int,
     allow: Optional[jnp.ndarray] = None,  # [N] bool filter allowlist
     keep_k: int = 0,
+    expand: int = 0,             # static two-hop widening budget (ACORN)
     rerank=None,                 # static DeviceRerankModule (hashable)
     rerank_k: int = 0,
     rerank_q: Optional[jnp.ndarray] = None,       # [B, Tq, D]
@@ -297,6 +336,10 @@ def _fused_search(
         visited = visited.at[rows[:, None], safe].max(
             ok.astype(jnp.uint8))
         nd = _masked_scores(scorer, queries, nbrs, operands)
+        if track and expand > 0:
+            nbrs, nd, visited = _two_hop_widen(
+                adjacency, present, allow, queries, operands, scorer,
+                nbrs, nd, visited, rows, expand)
         all_ids = jnp.concatenate([beam_ids, nbrs], axis=1)
         all_d = jnp.concatenate([beam_d, nd], axis=1)
         all_exp = jnp.concatenate(
@@ -372,7 +415,8 @@ def _op_partition_spec(arr, cap: int, axis: str):
 @functools.partial(
     jax.jit,
     static_argnames=("scorer", "ef", "max_steps", "fetch", "keep_k",
-                     "mesh", "axis", "merge", "rerank", "rerank_k"))
+                     "mesh", "axis", "merge", "rerank", "rerank_k",
+                     "expand"))
 def _fused_mesh_search(
     scorer,
     queries,
@@ -391,6 +435,7 @@ def _fused_mesh_search(
     qeps=None,           # [B] int32 replicated GLOBAL ids (construction)
     allow=None,          # [cap] bool row-sharded
     keep_k: int = 0,
+    expand: int = 0,     # static two-hop widening budget (ACORN)
     rerank=None,         # static DeviceRerankModule (hashable)
     rerank_k: int = 0,
     rerank_q=None,       # [B, Tq, D] replicated
@@ -536,6 +581,12 @@ def _fused_mesh_search(
             visited = visited.at[rows[:, None], safe].max(
                 ok.astype(jnp.uint8))
             nd = _masked_scores(scorer, q, nbrs, ops_l)
+            if track and expand > 0:
+                # same ACORN widening as the single-chip kernel, over the
+                # shard-LOCAL subgraph (local adjacency + local allow)
+                nbrs, nd, visited = _two_hop_widen(
+                    adj_l, pres_l, allow_l, q, ops_l, scorer,
+                    nbrs, nd, visited, rows, expand)
             all_ids = jnp.concatenate([beam_ids, nbrs], axis=1)
             all_d = jnp.concatenate([beam_d, nd], axis=1)
             all_exp = jnp.concatenate(
@@ -673,6 +724,7 @@ def device_search_mesh(
     upper_slots=None,
     allow=None,
     keep_k: int = 0,
+    expand: int = 0,
     merge: bool = True,
     axis: str = "shard",
     rerank=None,
@@ -713,7 +765,7 @@ def device_search_mesh(
                 scorer, queries, operands, adjacency, present, upper_adj,
                 upper_slots, ef=ef, max_steps=max_steps, fetch=fetch,
                 mesh=mesh, axis=axis, merge=merge, seeds=seeds, qeps=qeps,
-                allow=allow, keep_k=keep_k, rerank=rerank,
+                allow=allow, keep_k=keep_k, expand=expand, rerank=rerank,
                 rerank_k=rerank_k, rerank_q=rerank_q,
                 rerank_qmask=rerank_qmask, rerank_tokens=rerank_tokens,
                 rerank_tmask=rerank_tmask)
@@ -724,7 +776,7 @@ def device_search_mesh(
         scorer, queries, operands, adjacency, present, upper_adj,
         upper_slots, ef=ef, max_steps=max_steps, fetch=fetch, mesh=mesh,
         axis=axis, merge=merge, seeds=seeds, qeps=qeps, allow=allow,
-        keep_k=keep_k)
+        keep_k=keep_k, expand=expand)
 
 
 # jit-cache-stable empty upper tables for layer-0-only walks (the shapes
@@ -754,6 +806,7 @@ def device_search(
     upper_slots=None,
     allow=None,
     keep_k: int = 0,
+    expand: int = 0,
     rerank=None,
     rerank_k: int = 0,
     rerank_q=None,
@@ -780,7 +833,7 @@ def device_search(
         scorer, queries, operands, adjacency, present,
         jnp.asarray(eps, jnp.int32), upper_adj, upper_slots,
         ef=ef, max_steps=max_steps, allow=allow, keep_k=keep_k,
-        rerank=rerank, rerank_k=rerank_k, rerank_q=rerank_q,
+        expand=expand, rerank=rerank, rerank_k=rerank_k, rerank_q=rerank_q,
         rerank_qmask=rerank_qmask, rerank_tokens=rerank_tokens,
         rerank_tmask=rerank_tmask)
 

@@ -1,0 +1,24 @@
+"""Cost-based query planner + resident filter planes (docs/planner.md).
+
+``plan(stats)`` picks exact-scan / filtered-beam / over-fetch-post-filter
+per query from inverted-index selectivity estimates; ``FilterPlaneStore``
+keeps hot predicates as device-resident bitmaps the dispatcher coalesces
+by ``(plane_id, version)``.
+"""
+
+from weaviate_tpu.query.planner.cost import (  # noqa: F401
+    PLAN_BEAM,
+    PLAN_EXACT,
+    PLAN_OVERFETCH,
+    PLAN_UNFILTERED,
+    Plan,
+    PlanStats,
+    expansion_budget,
+    plan,
+)
+from weaviate_tpu.query.planner.planes import (  # noqa: F401
+    FilterPlane,
+    FilterPlaneStore,
+    canonical_key,
+    matches,
+)

@@ -1,0 +1,161 @@
+"""Cost-based plan choice for filtered vector search — pure + explainable.
+
+Three executable plans (docs/planner.md has the full taxonomy):
+
+- ``exact_scan``           — masked flat top-k over allowed rows only
+  (pre-filter, exact; the MXU eats small allowed sets for breakfast).
+- ``filtered_beam``        — device graph walk with the allow mask on
+  device and a two-hop ACORN expansion budget that widens *through*
+  blocked neighbors (ops/device_beam.py).
+- ``overfetch_postfilter`` — unfiltered device walk over-fetched by
+  ~1/selectivity, filtered on host (Weaviate's classic post-filter
+  switch); only viable at high selectivity where the over-fetch stays
+  inside the kernel's widest bucket.
+
+``plan()`` is a pure function of :class:`PlanStats` — no clocks, no
+globals, no I/O — so plan choices are unit-testable against seeded stats
+and reproducible from the trace attributes they emit
+(``planner.plan`` / ``planner.reason`` / ``planner.cost_*``).
+
+Cost unit: estimated vector-distance evaluations on device. The config
+knobs ``flat_search_cutoff`` / ``filter_flat_selectivity`` act as hard
+pre-filter guards first (identical semantics to the pre-planner triage,
+so existing deployments keep their behavior); the cost race only decides
+among plans that are recall-viable past the guards.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+PLAN_UNFILTERED = "unfiltered"
+PLAN_EXACT = "exact_scan"
+PLAN_BEAM = "filtered_beam"
+PLAN_OVERFETCH = "overfetch_postfilter"
+
+# widest walk the device kernel will bucket to before over-fetch stops
+# being viable (pow2 bucketing in hnsw._device_beam_search)
+_EF_CAP = 2048
+# two-hop expansion budget ceiling: each unit gathers one extra adjacency
+# row per beam step, so the budget is decades-of-selectivity, not 1/sel
+_MAX_EXPANSION = 4
+
+
+@dataclass(frozen=True)
+class PlanStats:
+    """Everything ``plan()`` is allowed to know. ``selectivity`` is the
+    allowed fraction of live docs — exact when the caller already holds a
+    mask or plane popcount (``exact_count=True``), otherwise the inverted
+    index's sketch estimate (``estimate_selectivity``)."""
+
+    live: int
+    k: int
+    ef: int
+    selectivity: float
+    exact_count: bool = False
+    plane_resident: bool = False
+    flat_cutoff: int = 40000
+    flat_selectivity: float = 0.35
+    graph_degree: int = 32
+    mesh: bool = False
+
+
+@dataclass(frozen=True)
+class Plan:
+    """The chosen plan + enough context to explain it in a trace span."""
+
+    plan_type: str
+    expansion: int        # two-hop budget per beam step (filtered_beam)
+    fetch_k: int          # device fetch width (overfetch_postfilter)
+    est_selectivity: float
+    est_allowed: int
+    cost_exact: float
+    cost_beam: float
+    cost_overfetch: float
+    reason: str
+
+    def trace_attrs(self) -> dict:
+        """Span attributes — the explainability contract of docs/planner.md."""
+        return {
+            "planner.plan": self.plan_type,
+            "planner.reason": self.reason,
+            "planner.selectivity": round(self.est_selectivity, 6),
+            "planner.allowed": self.est_allowed,
+            "planner.expansion": self.expansion,
+            "planner.fetch_k": self.fetch_k,
+            "planner.cost_exact": round(self.cost_exact, 1),
+            "planner.cost_beam": round(self.cost_beam, 1),
+            "planner.cost_overfetch": round(self.cost_overfetch, 1),
+        }
+
+
+def expansion_budget(selectivity: float) -> int:
+    """Selectivity-scaled two-hop budget: one extra adjacency row per
+    decade of selectivity below 100% (1% -> 2, 0.1% -> 3), capped."""
+    if selectivity >= 0.5:
+        return 0
+    decades = math.ceil(math.log10(1.0 / max(selectivity, 1e-9)))
+    return max(1, min(_MAX_EXPANSION, decades))
+
+
+def plan(stats: PlanStats) -> "Plan":
+    """Pick the cheapest recall-viable plan for one filtered query."""
+    live = max(1, stats.live)
+    sel = min(1.0, max(0.0, stats.selectivity))
+    allowed = int(round(sel * live))
+    fetch = max(stats.k, min(stats.ef, 2 * stats.k))
+
+    def mk(plan_type, expansion, fetch_k, ce, cb, co, reason):
+        return Plan(plan_type, expansion, fetch_k, sel, allowed,
+                    ce, cb, co, reason)
+
+    if sel >= 1.0:
+        return mk(PLAN_UNFILTERED, 0, fetch, 0.0, 0.0, 0.0,
+                  "filter passes everything")
+
+    expansion = expansion_budget(sel)
+    # cost race (unit: device distance evals)
+    cost_exact = float(live)
+    # beam converges in O(ef) expansions of graph_degree neighbors; the
+    # two-hop budget multiplies the per-step gather. An ad-hoc filter
+    # additionally pays a host mask AND + upload, amortized here as
+    # live/8 (byte traffic, not distance math — a deliberate thumb on
+    # the scale toward plans that reuse a resident plane).
+    mask_rent = 0.0 if stats.plane_resident else live / 8.0
+    cost_beam = stats.ef * stats.graph_degree * (1 + expansion) + mask_rent
+    # over-fetch must surface k allowed among ~fetch/sel candidates
+    fetch_over = int(math.ceil(fetch / max(sel, 1.0 / live)))
+    if fetch_over <= _EF_CAP:
+        cost_overfetch = (stats.ef * stats.graph_degree) / max(sel, 1e-9)
+    else:
+        cost_overfetch = math.inf
+
+    # hard pre-filter guards — same routing the pre-planner triage used
+    if allowed <= stats.k:
+        return mk(PLAN_EXACT, 0, fetch, cost_exact, cost_beam,
+                  cost_overfetch, f"allowed={allowed} <= k={stats.k}")
+    if allowed <= stats.flat_cutoff:
+        return mk(PLAN_EXACT, 0, fetch, cost_exact, cost_beam,
+                  cost_overfetch,
+                  f"allowed={allowed} <= flat_search_cutoff="
+                  f"{stats.flat_cutoff}")
+    if sel <= stats.flat_selectivity:
+        return mk(PLAN_EXACT, 0, fetch, cost_exact, cost_beam,
+                  cost_overfetch,
+                  f"selectivity={sel:.4f} <= filter_flat_selectivity="
+                  f"{stats.flat_selectivity}")
+
+    best = min(cost_exact, cost_beam, cost_overfetch)
+    if best == cost_beam:
+        return mk(PLAN_BEAM, expansion, fetch, cost_exact, cost_beam,
+                  cost_overfetch,
+                  "beam cheapest"
+                  + (" (plane resident)" if stats.plane_resident else ""))
+    if best == cost_overfetch:
+        return mk(PLAN_OVERFETCH, 0, min(_EF_CAP, fetch_over), cost_exact,
+                  cost_beam, cost_overfetch,
+                  f"over-fetch x{fetch_over // max(1, fetch)} cheapest at "
+                  f"selectivity {sel:.3f}")
+    return mk(PLAN_EXACT, 0, fetch, cost_exact, cost_beam, cost_overfetch,
+              "exact scan cheapest")

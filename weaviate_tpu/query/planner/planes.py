@@ -1,0 +1,346 @@
+"""Resident filter planes: hot predicates as device-resident bitmaps.
+
+A *filter plane* is one predicate compiled to a dense bool bitmap over a
+shard's doc-id space, kept hot:
+
+- **host side** it is maintained incrementally on every put/delete (the
+  per-doc :func:`matches` evaluator for the supported operator subset;
+  unsupported operators mark the plane stale and it rebuilds lazily from
+  the inverted index — exact either way),
+- **device side** it is uploaded once per (version, mutation) state and
+  reused across queries — row-sharded along the mesh ``shard`` axis like
+  every other plane when a mesh is up — and the dispatcher coalesces
+  filtered requests by ``(plane_id, version)`` instead of digesting full
+  masks (index/dispatch.py).
+
+Planes come from two sources: collection config (``resident_filters`` —
+declared hot predicates) and auto-promotion (an ad-hoc filter seen
+``filter_plane_promote_hits`` times). Their HBM bytes are charged to the
+tiering ledger through ``Shard.hbm_bytes`` and detach/attach with the
+shard's residency moves (demote drops the device mirror; the next search
+after promote re-uploads).
+
+Torn reads are acceptable by design: a search racing an insert may see
+the bit either way — the same consistency stance as the live mask.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from weaviate_tpu.inverted.filters import Filter, like_to_regex
+
+# operators the per-doc evaluator maintains incrementally; anything else
+# (geo, reference joins) flips the plane to stale-on-write + lazy rebuild
+_INCREMENTAL_OPS = frozenset((
+    "And", "Or", "Not", "Equal", "NotEqual", "GreaterThan",
+    "GreaterThanEqual", "LessThan", "LessThanEqual", "Like",
+    "ContainsAny", "ContainsAll", "IsNull",
+))
+
+
+def canonical_key(flt: Filter) -> str:
+    """Stable identity of a predicate: sorted-key JSON of its AST."""
+    return json.dumps(flt.to_dict(), sort_keys=True, separators=(",", ":"))
+
+
+def _plane_id(key: str) -> str:
+    return hashlib.blake2b(key.encode("utf-8"), digest_size=6).hexdigest()
+
+
+def _supported(flt: Filter) -> bool:
+    if flt.operator not in _INCREMENTAL_OPS:
+        return False
+    # reference joins traverse other collections — per-doc eval can't
+    if flt.path is not None and len(flt.path) >= 3:
+        return False
+    return all(_supported(o) for o in flt.operands)
+
+
+def _eq_scalar(v: Any, target: Any) -> bool:
+    if isinstance(v, bool) != isinstance(target, bool):
+        return False
+    if isinstance(v, (int, float)) and isinstance(target, (int, float)):
+        return float(v) == float(target)
+    return v == target
+
+
+def matches(flt: Filter, properties: dict) -> bool:
+    """Per-doc predicate eval mirroring ``columnar.eval_leaf`` semantics
+    (NotEqual only matches docs that HAVE the property; list values match
+    if any element matches). Only call for :func:`_supported` trees."""
+    op = flt.operator
+    if op == "And":
+        return all(matches(o, properties) for o in flt.operands)
+    if op == "Or":
+        return any(matches(o, properties) for o in flt.operands)
+    if op == "Not":
+        return not matches(flt.operands[0], properties)
+
+    prop = flt.path[-1]
+    val = properties.get(prop)
+    if op == "IsNull":
+        has = val is not None
+        want_null = flt.value in (True, None)
+        return (not has) if want_null else has
+    if val is None:
+        return False
+    vals = val if isinstance(val, list) else [val]
+    if op == "Equal":
+        return any(_eq_scalar(v, flt.value) for v in vals)
+    if op == "NotEqual":
+        # multi-valued docs always carry some value != fv (columnar.py)
+        if len(vals) > 1:
+            return True
+        return not _eq_scalar(vals[0], flt.value)
+    if op in ("GreaterThan", "GreaterThanEqual", "LessThan",
+              "LessThanEqual"):
+        t = flt.value
+        out = False
+        for v in vals:
+            if isinstance(v, bool) or not isinstance(v, (int, float, str)):
+                continue
+            if isinstance(v, str) != isinstance(t, str):
+                continue
+            if op == "GreaterThan":
+                out = out or v > t
+            elif op == "GreaterThanEqual":
+                out = out or v >= t
+            elif op == "LessThan":
+                out = out or v < t
+            else:
+                out = out or v <= t
+        return out
+    if op == "Like":
+        rx = like_to_regex(str(flt.value))
+        return any(isinstance(v, str) and rx.match(v) is not None
+                   for v in vals)
+    if op == "ContainsAny":
+        wanted = flt.value if isinstance(flt.value, list) else [flt.value]
+        return any(any(_eq_scalar(v, w) for v in vals) for w in wanted)
+    if op == "ContainsAll":
+        wanted = flt.value if isinstance(flt.value, list) else [flt.value]
+        if not wanted:
+            return False
+        return all(any(_eq_scalar(v, w) for v in vals) for w in wanted)
+    raise ValueError(f"matches() on unsupported operator {op!r}")
+
+
+class FilterPlane:
+    """One resident predicate bitmap (see module doc)."""
+
+    def __init__(self, flt: Filter, key: Optional[str] = None):
+        self.flt = flt
+        self.key = key if key is not None else canonical_key(flt)
+        self.plane_id = _plane_id(self.key)
+        self.incremental = _supported(flt)
+        # version: structural identity of the bitmap — bumps on rebuild,
+        # NOT on incremental bit flips, so the dispatcher's
+        # (plane_id, version) group key coalesces across live ingest
+        self.version = 0
+        self.hits = 0
+        self.stale = True  # built on first lookup
+        self._bits = np.zeros(0, bool)
+        self._mut = 0          # host mutation counter (device dirtiness)
+        self._count: Optional[tuple[int, int]] = None  # (_mut, popcount)
+        self._dev = None       # jnp mirror
+        self._dev_state = None  # (version, _mut, cap, sharding key)
+        self._grow_lock = threading.Lock()
+
+    # -- host bitmap -------------------------------------------------------
+    def _ensure(self, n: int) -> None:
+        if n <= len(self._bits):
+            return
+        with self._grow_lock:
+            if n > len(self._bits):
+                grown = np.zeros(max(n, 2 * len(self._bits), 1024), bool)
+                grown[: len(self._bits)] = self._bits
+                self._bits = grown
+
+    def set(self, doc_id: int, value: bool) -> None:
+        self._ensure(doc_id + 1)
+        if bool(self._bits[doc_id]) != value:
+            self._bits[doc_id] = value
+            self._mut += 1
+            self._count = None
+
+    def rebuild(self, mask: np.ndarray) -> None:
+        """Replace the bitmap wholesale (promotion / stale recovery)."""
+        self._bits = np.asarray(mask, bool).copy()
+        self.version += 1
+        self._mut += 1
+        self._count = None
+        self.stale = False
+
+    def mask(self, space: int) -> np.ndarray:
+        """Dense bool mask over ``space`` doc ids (zero-padded view)."""
+        b = self._bits
+        if len(b) == space:
+            return b
+        if len(b) > space:
+            return b[:space]
+        out = np.zeros(space, bool)
+        out[: len(b)] = b
+        return out
+
+    def count(self) -> int:
+        c = self._count
+        if c is not None and c[0] == self._mut:
+            return c[1]
+        n = int(np.count_nonzero(self._bits))
+        self._count = (self._mut, n)
+        return n
+
+    # -- device mirror -----------------------------------------------------
+    def device_mask(self, cap: int, sharding=None):
+        """The plane's device-resident mirror, padded to ``cap`` and placed
+        with ``sharding`` (row-sharded along the mesh shard axis when one
+        is up). Cached until a host bit flips or the plane rebuilds —
+        repeat filtered queries pay zero upload."""
+        state = (self.version, self._mut, cap,
+                 None if sharding is None else id(sharding))
+        if self._dev is not None and self._dev_state == state:
+            return self._dev
+        import jax
+
+        host = self.mask(cap)
+        if sharding is not None:
+            dev = jax.device_put(host, sharding)
+        else:
+            dev = jax.device_put(host)
+        self._dev = dev
+        self._dev_state = state
+        return dev
+
+    def hbm_bytes(self) -> int:
+        return int(self._dev.nbytes) if self._dev is not None else 0
+
+    def drop_device(self) -> int:
+        """Detach the device mirror (tiering demote); returns bytes freed
+        so callers keep the ledger honest (device-array-leak contract)."""
+        freed = self.hbm_bytes()
+        self._dev = None
+        self._dev_state = None
+        return freed
+
+    def nbytes_host(self) -> int:
+        return int(self._bits.nbytes)
+
+    def summary(self) -> dict:
+        return {
+            "plane_id": self.plane_id,
+            "version": self.version,
+            "hits": self.hits,
+            "incremental": self.incremental,
+            "stale": self.stale,
+            "count": self.count(),
+            "hbm_bytes": self.hbm_bytes(),
+            "filter": self.flt.to_dict(),
+        }
+
+
+class FilterPlaneStore:
+    """All resident planes of one shard.
+
+    ``recompute(flt) -> mask`` is the exact evaluator (inverted index ∧
+    live mask), used at promotion and stale recovery. ``on_put`` /
+    ``on_delete`` ride the shard's durable write path; searches call
+    ``lookup`` which also drives hit-counting auto-promotion."""
+
+    def __init__(self, recompute: Callable[[Filter], np.ndarray]):
+        self._recompute = recompute
+        self._lock = threading.Lock()
+        self._planes: dict[str, FilterPlane] = {}
+        self._hits: dict[str, tuple[int, Filter]] = {}
+
+    def _knobs(self) -> tuple[int, int]:
+        from weaviate_tpu.utils.runtime_config import (
+            FILTER_PLANE_MAX, FILTER_PLANE_PROMOTE_HITS,
+        )
+
+        return int(FILTER_PLANE_PROMOTE_HITS.get()), int(
+            FILTER_PLANE_MAX.get())
+
+    def declare(self, flt: Filter) -> FilterPlane:
+        """Register a config-declared plane (built on first lookup)."""
+        key = canonical_key(flt)
+        with self._lock:
+            plane = self._planes.get(key)
+            if plane is None:
+                plane = self._planes[key] = FilterPlane(flt, key)
+            return plane
+
+    def lookup(self, flt: Filter) -> Optional[FilterPlane]:
+        """The search-path entry: returns a ready plane for ``flt`` or
+        None (counting the miss toward auto-promotion)."""
+        key = canonical_key(flt)
+        plane = self._planes.get(key)
+        if plane is None:
+            promote_hits, max_planes = self._knobs()
+            if promote_hits <= 0:
+                return None
+            with self._lock:
+                plane = self._planes.get(key)
+                if plane is None:
+                    hits, _ = self._hits.get(key, (0, flt))
+                    hits += 1
+                    if hits >= promote_hits \
+                            and len(self._planes) < max_planes:
+                        plane = self._planes[key] = FilterPlane(flt, key)
+                        self._hits.pop(key, None)
+                    else:
+                        self._hits[key] = (hits, flt)
+                        if len(self._hits) > 256:  # bound the miss table
+                            self._hits.pop(next(iter(self._hits)))
+                        return None
+        plane.hits += 1
+        if plane.stale:
+            with self._lock:
+                if plane.stale:
+                    plane.rebuild(self._recompute(plane.flt))
+        return plane
+
+    # -- write-path maintenance -------------------------------------------
+    def on_put(self, doc_id: int, properties: dict) -> None:
+        for plane in self._planes.values():
+            if plane.stale:
+                continue
+            if plane.incremental:
+                plane.set(doc_id, matches(plane.flt, properties))
+            else:
+                plane.stale = True  # lazy rebuild at next lookup
+
+    def on_delete(self, doc_id: int) -> None:
+        for plane in self._planes.values():
+            if not plane.stale:
+                plane.set(doc_id, False)
+
+    # -- residency ---------------------------------------------------------
+    def hbm_bytes(self) -> int:
+        return sum(p.hbm_bytes() for p in self._planes.values())
+
+    def host_bytes(self) -> int:
+        return sum(p.nbytes_host() for p in self._planes.values())
+
+    def drop_device(self) -> int:
+        """Detach every device mirror; returns total bytes freed."""
+        return sum(p.drop_device() for p in self._planes.values())
+
+    def __len__(self) -> int:
+        return len(self._planes)
+
+    def planes(self) -> list[FilterPlane]:
+        return list(self._planes.values())
+
+    def stats(self) -> dict:
+        return {
+            "planes": [p.summary() for p in self._planes.values()],
+            "hbm_bytes": self.hbm_bytes(),
+            "host_bytes": self.host_bytes(),
+            "pending": {k: h for k, (h, _) in self._hits.items()},
+        }

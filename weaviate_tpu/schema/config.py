@@ -533,6 +533,10 @@ class CollectionConfig:
     # object TTL: objects expire this many seconds after creation
     # (reference usecases/object_ttl; 0 = disabled)
     object_ttl_seconds: int = 0
+    # declared hot predicates: each entry is a Filter dict compiled to a
+    # device-resident bitmap plane per shard (query/planner/planes.py);
+    # predicates not listed here can still auto-promote by hit rate
+    resident_filters: list = field(default_factory=list)
 
     def validate(self) -> None:
         if not self.name or not self.name[0].isupper():
@@ -569,6 +573,7 @@ class CollectionConfig:
             "description": self.description,
             "async_indexing": self.async_indexing,
             "object_ttl_seconds": self.object_ttl_seconds,
+            "resident_filters": list(self.resident_filters),
         }
 
     @staticmethod
@@ -589,4 +594,5 @@ class CollectionConfig:
             description=d.get("description", ""),
             async_indexing=d.get("async_indexing", False),
             object_ttl_seconds=d.get("object_ttl_seconds", 0),
+            resident_filters=d.get("resident_filters", []),
         )

@@ -259,3 +259,11 @@ AUTOSCALE_MAX_NODES = RUNTIME.register("autoscale_max_nodes", 64,
 # recovers instead of letting hydrations die mid-download.
 COLDSTORE_OP_BUDGET_S = RUNTIME.register(
     "coldstore_op_budget_s", 0.0, cast=float)
+
+# resident filter planes (query/planner/planes.py): an ad-hoc filter seen
+# this many times auto-promotes to a device-resident bitmap plane; 0
+# disables auto-promotion (declared planes still build). Max bounds the
+# per-shard plane count — planes pay HBM rent through the tiering ledger.
+FILTER_PLANE_PROMOTE_HITS = RUNTIME.register(
+    "filter_plane_promote_hits", 3, cast=int)
+FILTER_PLANE_MAX = RUNTIME.register("filter_plane_max", 8, cast=int)
