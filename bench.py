@@ -263,6 +263,11 @@ CONFIG_METRICS = {
     # (and is what the perf-flag verdict stands on)
     "rerank": (lambda m: m.startswith("rerank_"),
                lambda m: m.startswith("rerank_qps_")),
+    # headline: the fused serving QPS; per-join recall lines and the
+    # fused-vs-N-dispatch A/B ride along (the perf-flag verdict stands
+    # on all three)
+    "multitarget": (lambda m: m.startswith("multitarget_"),
+                    lambda m: m.startswith("multitarget_qps_")),
     # headline: warm-restart first-query latency; steady-state compile
     # seconds ride along (zero on the warm leg = the restart proof)
     "coldstart": (lambda m: m.startswith(("cold_start_ms",
@@ -3373,6 +3378,171 @@ def bench_filtered(n=200_000, d=128, batch=0, k=10, iters=0, warmup=0,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_multitarget(n=120_000, k=10, nq=32, reps=3):
+    """One-dispatch multi-target search (docs/multitarget.md):
+    `multitarget_qps` through the REAL Collection path on 2- and
+    3-target corpora (768d+256d mixes), recall@10 pinned per join mode
+    against the per-target host walk+join ground truth (the exact
+    parity oracle, pool-widened so join order is settled), the
+    fused-vs-N-dispatch A/B, and a `device_multi_target` perf-flag
+    verdict: the fused leg must hold recall parity while issuing
+    exactly ONE device dispatch per query."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from weaviate_tpu.core.db import DB
+    from weaviate_tpu.ops import device_beam as db_ops
+    from weaviate_tpu.schema.config import (
+        CollectionConfig,
+        HNSWIndexConfig,
+    )
+    from weaviate_tpu.storage.objects import StorageObject
+
+    rng = np.random.default_rng(29)
+    corpora = [("2t", {"a": 768, "b": 256}),
+               ("3t", {"a": 768, "b": 256, "c": 256})]
+    combos = [("sum", None), ("average", None), ("minimum", None),
+              ("manualWeights", "w"), ("relativeScore", "w")]
+    root = tempfile.mkdtemp(prefix="bench_multitarget_")
+    db = DB(root)
+    results = {}
+    try:
+        for tag, dims in corpora:
+            targets = list(dims)
+            print(f"# multitarget {tag}: n={n} dims={dims}",
+                  file=sys.stderr)
+            col = db.create_collection(CollectionConfig(
+                name=f"Multi{tag}",
+                vector_config=HNSWIndexConfig(
+                    distance="l2-squared", ef=64, ef_construction=64),
+                named_vectors={
+                    t: HNSWIndexConfig(
+                        distance="l2-squared", ef=64,
+                        ef_construction=64, device_beam=True)
+                    for t in targets},
+            ))
+            t0 = time.perf_counter()
+            vecs = {t: rng.standard_normal((n, d)).astype(np.float32)
+                    for t, d in dims.items()}
+            for lo in range(0, n, 4096):
+                hi = min(lo + 4096, n)
+                col.put_batch([StorageObject(
+                    uuid=f"{i:08x}-0000-0000-0000-000000000000",
+                    collection=f"Multi{tag}",
+                    named_vectors={t: vecs[t][i] for t in targets},
+                ) for i in range(lo, hi)])
+            print(f"# built in {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr)
+            rows = rng.choice(n, nq, replace=False)
+            qs = [{t: vecs[t][r] + 0.05 * rng.standard_normal(
+                dims[t]).astype(np.float32) for t in targets}
+                for r in rows]
+            manual = {t: w for t, w in zip(
+                targets, (0.7, 0.3, 1.5))}
+
+            recalls = {}
+            dispatch_ratio = {}
+            for combination, wtag in combos:
+                weights = manual if wtag else None
+                # per-target host walk+join ground truth, pool-widened
+                # past k so the joined order is settled (a k-wide pool
+                # misses docs whose JOINED score is good but that sit
+                # in no single target's top-k)
+                gt = [
+                    {o.uuid for o, _ in col._multi_target_search_host(
+                        q, k=max(4 * k, 64), combination=combination,
+                        weights=weights)[:k]}
+                    for q in qs]
+                before = db_ops.dispatch_count()
+                live = [
+                    {o.uuid for o, _ in col.multi_target_search(
+                        q, k=k, combination=combination,
+                        weights=weights)}
+                    for q in qs]
+                dispatch_ratio[combination] = \
+                    (db_ops.dispatch_count() - before) / nq
+                recalls[combination] = float(np.mean(
+                    [len(live[i] & gt[i]) / k for i in range(nq)]))
+                _emit({
+                    "metric": f"multitarget_recall10_{tag}_{combination}",
+                    "value": round(recalls[combination], 4),
+                    "unit": "recall", "k": k,
+                    "dispatches_per_query": dispatch_ratio[combination],
+                    "recall_ok": bool(recalls[combination] >= 0.995),
+                    "note": "fused vs per-target host walk+join "
+                            "ground truth",
+                })
+
+            # fused-vs-N-dispatch A/B on the shared sum join: the
+            # baseline issues one device walk PER TARGET then joins on
+            # host — exactly the loop the fused program replaces
+            fused_qps = host_qps = 0.0
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for q in qs:
+                    col.multi_target_search(q, k=k, combination="sum")
+                fused_qps = max(fused_qps,
+                                nq / (time.perf_counter() - t0))
+                t0 = time.perf_counter()
+                for q in qs:
+                    col._multi_target_search_host(
+                        q, k=k, combination="sum")
+                host_qps = max(host_qps,
+                               nq / (time.perf_counter() - t0))
+            results[tag] = dict(recalls=recalls, fused_qps=fused_qps,
+                                host_qps=host_qps,
+                                dispatch_ratio=dispatch_ratio)
+            _emit({
+                "metric": f"multitarget_ab_{tag}_{n // 1000}k",
+                "value": round(fused_qps / max(host_qps, 1e-9), 2),
+                "unit": "x_vs_ndispatch",
+                "fused_qps": round(fused_qps, 1),
+                "ndispatch_qps": round(host_qps, 1),
+                "targets": len(targets),
+                "note": "fused one-dispatch vs per-target "
+                        "walk + host join",
+            })
+
+        from weaviate_tpu.utils import perf_flags
+
+        recall_ok = all(r >= 0.995
+                        for res in results.values()
+                        for r in res["recalls"].values())
+        one_dispatch = all(ratio <= 1.0
+                           for res in results.values()
+                           for ratio in res["dispatch_ratio"].values())
+        fused_ahead = all(res["fused_qps"] > res["host_qps"]
+                          for res in results.values())
+        perf_flags.record(
+            "device_multi_target",
+            enabled=bool(recall_ok and one_dispatch and fused_ahead),
+            evidence={
+                tag: {"recalls": {c: round(r, 4)
+                                  for c, r in res["recalls"].items()},
+                      "fused_qps": round(res["fused_qps"], 1),
+                      "ndispatch_qps": round(res["host_qps"], 1),
+                      "dispatches_per_query": res["dispatch_ratio"]}
+                for tag, res in results.items()},
+            platform=jax.default_backend())
+        # headline LAST: the 2-target fused QPS line
+        _emit({
+            "metric": f"multitarget_qps_{n // 1000}k",
+            "value": round(results["2t"]["fused_qps"], 1),
+            "unit": "qps", "k": k,
+            "recall10_vs_host_join": round(
+                min(results["2t"]["recalls"].values()), 4),
+            "x_vs_ndispatch": round(
+                results["2t"]["fused_qps"]
+                / max(results["2t"]["host_qps"], 1e-9), 2),
+            "note": "2-target 768d+256d fused one-dispatch serving",
+        })
+    finally:
+        db.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 CONFIGS = {
     "flat1m": bench_flat1m,
     "sift1m": bench_sift1m,
@@ -3395,6 +3565,7 @@ CONFIGS = {
     "coldtier": bench_coldtier,
     "coldstart": bench_coldstart,
     "rerank": bench_rerank,
+    "multitarget": bench_multitarget,
     "pallasab": bench_pallas_ab,
     "bq50m": bench_bq50m,
     "bq100m": bench_bq100m,
@@ -3524,6 +3695,13 @@ def _full_footprint(name: str, soak: bool = False) -> dict:
         return {"hbm_gb": (n * (df * 4 + 33 * 4) + 4 * n) / _GB,
                 "host_gb": (n * (df * 4 * 2 + 200) + n * 24) / _GB,
                 "disk_gb": 0.0}
+    if name == "multitarget":
+        # worst corpus (3t): per-target fp32 planes + adjacency mirrors
+        # in HBM; host holds the originals + three graphs
+        n, dsum, t = 120_000, 768 + 256 + 256, 3
+        return {"hbm_gb": n * (dsum * 4 + t * 33 * 4) / _GB,
+                "host_gb": n * (dsum * 4 * 2 + t * 200) / _GB,
+                "disk_gb": 0.0}
     return {"hbm_gb": 0.0, "host_gb": 0.0, "disk_gb": 0.0}
 
 
@@ -3573,6 +3751,9 @@ SMOKE = {
     # quality-delta semantics check (fused vs host MaxSim), not a
     # throughput claim
     "rerank": dict(n=6_000, d=32, batch=16, nq=16),
+    # one-dispatch + join-parity semantics check (fused vs per-target
+    # host walk+join), not a throughput claim
+    "multitarget": dict(n=2_000, nq=6, reps=1),
 }
 
 

@@ -81,6 +81,63 @@ def test_near_vector_and_filters(client):
     assert {h.properties["wordCount"] for h in hits} == {40, 50, 60, 70, 80}
 
 
+def test_near_vector_multi_target(client):
+    col = client.collections.create(
+        "Multi", vector_index_type="flat", distance="l2-squared",
+        vectorConfig={
+            "a": {"vectorIndexType": "flat",
+                  "vectorIndexConfig": {"distance": "l2-squared"}},
+            "b": {"vectorIndexType": "flat",
+                  "vectorIndexConfig": {"distance": "l2-squared"}},
+        })
+    objs = []
+    for i in range(24):
+        va = np.zeros(8, np.float32)
+        vb = np.zeros(8, np.float32)
+        va[i % 8] = 1.0
+        vb[(i + 4) % 8] = 1.0
+        objs.append({
+            "id": f"00000000-0000-0000-0002-{i:012d}",
+            "properties": {},
+            "vectors": {"a": va.tolist(), "b": vb.tolist()},
+        })
+    res = col.data.insert_many(objs)
+    assert all(r["result"]["status"] == "SUCCESS" for r in res)
+
+    qa = np.zeros(8, np.float32)
+    qa[0] = 1.0
+    qb = np.zeros(8, np.float32)
+    qb[4] = 1.0  # both point at docids with i % 8 == 0
+    hits = col.query.near_vector(
+        vector_per_target={"a": qa.tolist(), "b": qb.tolist()},
+        combination="sum", limit=3)
+    assert len(hits) == 3
+    assert all(int(h.uuid[-12:]) % 8 == 0 for h in hits)
+    assert hits[0].distance == pytest.approx(0.0)
+
+    # one shared query vector scored against both targets; minimum
+    # join zeroes on a-matches (i % 8 == 0) AND b-matches (i % 8 == 4)
+    hits = col.query.near_vector(
+        qa.tolist(), target_vectors=["a", "b"],
+        combination="minimum", limit=3)
+    assert hits and int(hits[0].uuid[-12:]) % 4 == 0
+    assert hits[0].distance == pytest.approx(0.0)
+
+    # manual weights ride the targets object
+    hits = col.query.near_vector(
+        vector_per_target={"a": qa.tolist(), "b": qb.tolist()},
+        combination="manualWeights",
+        target_weights={"a": 1.0, "b": 0.25}, limit=3)
+    assert hits and int(hits[0].uuid[-12:]) % 8 == 0
+
+    # weight/target mismatch surfaces as the API error shape
+    with pytest.raises(wvt.ApiError):
+        col.query.near_vector(
+            vector_per_target={"a": qa.tolist(), "b": qb.tolist()},
+            combination="manualWeights",
+            target_weights={"a": 1.0}, limit=3)
+
+
 def test_bm25_search_operator(client):
     col = _seed(client)
     # every doc contains "article"; only doc 7 contains "7"

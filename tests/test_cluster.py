@@ -249,6 +249,54 @@ def test_distributed_vector_and_bm25_search(cluster3):
     assert res and res[0][0].properties["body"] == "doc 5"
 
 
+def test_distributed_multi_target_search(cluster3):
+    nodes, _ = cluster3
+    cfg = CollectionConfig(
+        name="MT",
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        named_vectors={
+            "a": FlatIndexConfig(distance="l2-squared", precision="fp32"),
+            "b": FlatIndexConfig(distance="l2-squared", precision="fp32"),
+        },
+        sharding=ShardingConfig(desired_count=3),
+        replication=ReplicationConfig(factor=1),
+    )
+    _leader(nodes).create_collection(cfg)
+    wait_for(lambda: all(n.db.has_collection("MT") for n in nodes))
+    objs = []
+    for i in range(24):
+        va = np.zeros(8, np.float32)
+        vb = np.zeros(8, np.float32)
+        va[i % 8] = 1.0
+        vb[(i + 4) % 8] = 1.0
+        objs.append(StorageObject(
+            uuid=f"00000000-0000-0000-0001-{i:012d}",
+            collection="MT",
+            named_vectors={"a": va, "b": vb}))
+    nodes[0].put_batch("MT", objs, consistency="ONE")
+    qa = np.zeros(8, np.float32)
+    qa[0] = 1.0
+    qb = np.zeros(8, np.float32)
+    qb[4] = 1.0  # both point at docids with i % 8 == 0
+    # true scatter: every node coordinates the same joined ranking,
+    # with the per-target queries + weights shipped in the envelope
+    for n in nodes:
+        res = n.multi_target_search(
+            "MT", {"a": qa, "b": qb}, k=3, combination="sum")
+        assert len(res) == 3
+        assert all(int(o.uuid[-12:]) % 8 == 0 for o, _ in res)
+        assert res[0][1] == pytest.approx(0.0)
+    res = nodes[1].multi_target_search(
+        "MT", {"a": qa, "b": qb}, k=3, combination="manualWeights",
+        weights={"a": 1.0, "b": 0.25})
+    assert res and int(res[0][0].uuid[-12:]) % 8 == 0
+    # validation happens at the coordinator, before any scatter
+    with pytest.raises(ValueError):
+        nodes[0].multi_target_search(
+            "MT", {"a": qa, "nope": qb}, k=3, combination="sum")
+
+
 # -- tcp transport -----------------------------------------------------------
 def test_tcp_transport_roundtrip():
     t1 = TcpTransport("127.0.0.1:0")

@@ -828,6 +828,94 @@ class TestHostLoopOverMesh:
 
 
 # ---------------------------------------------------------------------------
+# host-loop-over-targets
+
+
+class TestHostLoopOverTargets:
+    RULES = ["host-loop-over-targets"]
+    IDX = "weaviate_tpu/index/fake.py"
+    QRY = "weaviate_tpu/query/fake.py"
+
+    def test_loop_over_targets_dispatching_flagged(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def search_all(targets, planes, q):
+                outs = []
+                for t in targets:
+                    outs.append(jnp.dot(q, planes[t]))
+                return outs
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == ["host-loop-over-targets"]
+        assert res.violations[0].severity == "error"
+
+    def test_loop_over_vector_indexes_searching_flagged(self):
+        res = run("""
+            def scatter(shard, q, k):
+                hits = []
+                for name, idx in shard._vector_indexes.items():
+                    hits.append(idx.vector_search(q, k))
+                return hits
+        """, rel=self.QRY, rules=self.RULES)
+        assert rule_ids(res) == ["host-loop-over-targets"]
+
+    def test_host_merge_per_target_flagged(self):
+        res = run("""
+            def join(per_target, named_vectors, combination):
+                out = []
+                for t in named_vectors:
+                    out.append(combine_multi_target(
+                        per_target[t], combination))
+                return out
+        """, rel=self.QRY, rules=self.RULES)
+        assert rule_ids(res) == ["host-loop-over-targets"]
+
+    def test_metadata_loop_not_flagged(self):
+        # enumerating targets for plane accounting / config plumbing is
+        # fine — only loops that DISPATCH or search per target scatter
+        res = run("""
+            def plane_bytes(named_vectors):
+                total = 0
+                for t, cfg in named_vectors.items():
+                    total += cfg.dims * 4
+                return total
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_non_target_loop_not_flagged(self):
+        res = run("""
+            import jax.numpy as jnp
+
+            def f(chunks, q):
+                outs = []
+                for c in chunks:
+                    outs.append(jnp.dot(q, c))
+                return outs
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_outside_scoped_dirs_ignored(self):
+        # the host parity oracle (core/collection.py) loops per target
+        # BY DESIGN — core/ is outside the rule's scope
+        res = run("""
+            def oracle(targets, idx, q, k):
+                for t in targets:
+                    idx.vector_search(q, k)
+        """, rel="weaviate_tpu/core/fake.py", rules=self.RULES)
+        assert rule_ids(res) == []
+
+    def test_suppressible_with_reason(self):
+        res = run("""
+            def drain(named_vectors, planes):
+                for t in named_vectors:  # graftlint: allow[host-loop-over-targets] reason=build-time plane hydration, not the serving path
+                    planes[t].vector_search(None, 1)
+        """, rel=self.IDX, rules=self.RULES)
+        assert rule_ids(res) == []
+        assert [v.rule for v in res.suppressed] == \
+            ["host-loop-over-targets"]
+
+
+# ---------------------------------------------------------------------------
 # lock-across-device-call
 
 

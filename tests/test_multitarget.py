@@ -1,0 +1,432 @@
+"""One-dispatch multi-target search (docs/multitarget.md).
+
+Named vectors served as first-class device planes: a multi-target query
+is ONE fused device dispatch (per-target beam walks + cross-scoring +
+weighted join + top-k inside one jitted program, ops/device_beam.py
+``device_multi_search``), with the per-target host walk+join
+(``Collection._multi_target_search_host``) as the exact parity oracle.
+
+Parity is measured against a POOL-WIDENED oracle (k=64 truncated to
+k=10): the oracle's candidate pool is per-target top-k, so at pool
+width k it misses docs whose JOINED score is good but that sit in no
+single target's top-k — a pool artifact, not a kernel disagreement.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from weaviate_tpu.core.db import DB
+from weaviate_tpu.ops import device_beam as db_ops
+from weaviate_tpu.schema.config import (
+    CollectionConfig,
+    FlatIndexConfig,
+    HNSWIndexConfig,
+)
+from weaviate_tpu.storage.objects import StorageObject
+
+DIMS = {"a": 24, "b": 16}
+N = 160
+K = 10
+COMBOS = [("sum", None), ("average", None), ("minimum", None),
+          ("manualWeights", {"a": 0.7, "b": 0.3}),
+          ("relativeScore", {"a": 2.0, "b": 1.0})]
+
+
+def _hnsw(device_beam=True):
+    return HNSWIndexConfig(distance="l2-squared", ef=48,
+                           ef_construction=32, device_beam=device_beam)
+
+
+def _build(tmp_dbdir, rng, name="Multi", n=N, dims=DIMS, missing=()):
+    """A named-vector collection with per-target HNSW device planes;
+    docids in ``missing`` get no 'b' vector (partial-coverage corpus)."""
+    db = DB(tmp_dbdir)
+    col = db.create_collection(CollectionConfig(
+        name=name,
+        vector_config=FlatIndexConfig(distance="l2-squared",
+                                      precision="fp32"),
+        named_vectors={t: _hnsw() for t in dims},
+    ))
+    vecs = {t: rng.standard_normal((n, d)).astype(np.float32)
+            for t, d in dims.items()}
+    objs = []
+    for i in range(n):
+        nv = {t: vecs[t][i] for t in dims
+              if not (t == "b" and i in missing)}
+        objs.append(StorageObject(
+            uuid=f"{i:08x}-0000-0000-0000-000000000000",
+            collection=name, named_vectors=nv))
+    col.put_batch(objs)
+    return db, col, vecs
+
+
+def _queries(rng, vecs, nq=8):
+    rows = rng.choice(len(next(iter(vecs.values()))), nq, replace=False)
+    return [{t: vecs[t][r] + 0.05 * rng.standard_normal(
+        vecs[t].shape[1]).astype(np.float32) for t in vecs}
+        for r in rows]
+
+
+def _oracle_topk(col, q, combination, weights, k=K):
+    """Pool-widened host oracle: per-target walks fetch 64 deep so the
+    joined order is settled, then truncate to the serving k."""
+    wide = col._multi_target_search_host(
+        q, k=max(4 * k, 64), combination=combination, weights=weights)
+    return [o.uuid for o, _ in wide[:k]]
+
+
+def _parity(col, queries, max_delta=0.005, combos=COMBOS):
+    """Recall@10 fused-vs-oracle per join mode + the one-dispatch pin."""
+    for combination, weights in combos:
+        gt = [_oracle_topk(col, q, combination, weights)
+              for q in queries]
+        before = db_ops.dispatch_count()
+        live = [[o.uuid for o, _ in col.multi_target_search(
+            q, k=K, combination=combination, weights=weights)]
+            for q in queries]
+        dispatches = db_ops.dispatch_count() - before
+        assert dispatches == len(queries), \
+            f"{combination}: {dispatches} dispatches for " \
+            f"{len(queries)} multi-target queries — the fused path " \
+            "fell back or scattered"
+        recall = float(np.mean([
+            len(set(live[i]) & set(gt[i])) / K
+            for i in range(len(queries))]))
+        assert recall >= 1.0 - max_delta, \
+            f"{combination}: recall@10 {recall} vs host oracle"
+
+
+def test_fused_recall_parity_all_joins(tmp_dbdir, rng):
+    db, col, vecs = _build(tmp_dbdir, rng)
+    try:
+        queries = _queries(rng, vecs)
+        # warm the compile outside the measured window
+        col.multi_target_search(queries[0], k=K, combination="sum")
+        _parity(col, queries)
+    finally:
+        db.close()
+
+
+def test_fused_recall_parity_on_mesh(tmp_dbdir, rng):
+    from weaviate_tpu.parallel import runtime
+    from weaviate_tpu.parallel.mesh import make_mesh
+
+    runtime.set_mesh(make_mesh(8))
+    try:
+        db, col, vecs = _build(tmp_dbdir, rng, name="MultiMesh", n=256)
+        try:
+            queries = _queries(rng, vecs)
+            col.multi_target_search(queries[0], k=K, combination="sum")
+            _parity(col, queries)
+        finally:
+            db.close()
+    finally:
+        runtime.reset()
+
+
+def test_one_dispatch_per_coalesced_batch(tmp_dbdir, rng):
+    """Concurrent same-target-set requests coalesce into ONE device
+    dispatch (the batch-group key carries the target-set identity)."""
+    db, col, vecs = _build(tmp_dbdir, rng)
+    try:
+        queries = _queries(rng, vecs, nq=6)
+        col.multi_target_search(queries[0], k=K, combination="sum")
+        shard = col._get_shard("shard0")
+        disp = shard._mt_dispatcher(("a", "b"), "weighted")
+        w = np.ones((1, 2), np.float32)
+        before = db_ops.dispatch_count()
+        results = [None] * len(queries)
+
+        def one(i):
+            q = queries[i]
+            results[i] = disp.search(
+                (w, np.atleast_2d(q["a"]), np.atleast_2d(q["b"])), K)
+
+        # stage every request behind the dispatcher's own lock so the
+        # drain thread sees them as one group
+        with disp._lock:
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(len(queries))]
+            for t in threads:
+                t.start()
+            import time
+
+            time.sleep(0.2)
+        for t in threads:
+            t.join()
+        dispatches = db_ops.dispatch_count() - before
+        assert dispatches < len(queries), \
+            f"{len(queries)} concurrent same-target requests took " \
+            f"{dispatches} dispatches — no coalescing happened"
+        for r in results:
+            ids, d = r
+            assert ids.shape[-1] >= K
+    finally:
+        db.close()
+
+
+def test_mixed_dims_targets(tmp_dbdir, rng):
+    """24d + 16d planes in one fused program; a doc that dominates both
+    targets must rank first under every join."""
+    db, col, _ = _build(tmp_dbdir, rng, name="Mixed")
+    try:
+        # craft a query pair that is exactly doc 7's vectors
+        obj = col.get(f"{7:08x}-0000-0000-0000-000000000000")
+        q = {t: np.asarray(v, np.float32)
+             for t, v in obj.named_vectors.items()}
+        for combination, weights in COMBOS:
+            res = col.multi_target_search(
+                q, k=5, combination=combination, weights=weights)
+            assert res and res[0][0].uuid == obj.uuid, combination
+    finally:
+        db.close()
+
+
+def test_missing_target_vectors_masked_not_crashed(tmp_dbdir, rng):
+    """Objects lacking one target's vector are DROPPED from the joined
+    ranking (host oracle semantics: drop-if-missing), never crash the
+    fused program, and never surface with a bogus joined score."""
+    missing = set(range(0, N, 3))  # a third of the corpus lacks 'b'
+    db, col, vecs = _build(tmp_dbdir, rng, name="Sparse",
+                           missing=missing)
+    try:
+        queries = _queries(rng, vecs, nq=6)
+        col.multi_target_search(queries[0], k=K, combination="sum")
+        for q in queries:
+            res = col.multi_target_search(q, k=K, combination="sum")
+            assert res
+            for o, d in res:
+                assert int(o.uuid[:8], 16) not in missing
+                assert np.isfinite(d)
+        # masking happens BEFORE the join, so one join mode pins it
+        _parity(col, queries, combos=COMBOS[:1])
+    finally:
+        db.close()
+
+
+def test_tiering_ledger_symmetry_per_target_plane(tmp_dbdir, rng):
+    """Demote/attach cycles keep the per-target plane ledger symmetric:
+    every named plane charges HBM rent independently, demotion frees
+    exactly what was charged, and re-promotion (plus the lazy topology
+    re-sync at the next search) restores the identical footprint."""
+    from weaviate_tpu.monitoring.metrics import TARGET_PLANE_HBM_BYTES
+
+    db, col, vecs = _build(tmp_dbdir, rng, name="Tiered")
+    try:
+        queries = _queries(rng, vecs, nq=4)
+        col.multi_target_search(queries[0], k=K, combination="sum")
+        shard = col._get_shard("shard0")
+        pre = shard.hbm_bytes()
+        assert pre > 0
+        per_target_pre = {
+            t: TARGET_PLANE_HBM_BYTES.value(shard=shard.name, target=t)
+            for t in DIMS}
+        assert all(v > 0 for v in per_target_pre.values())
+
+        freed = shard.demote_device()
+        assert freed > 0
+        mid = shard.hbm_bytes()
+        assert mid < pre
+        for t in DIMS:
+            assert TARGET_PLANE_HBM_BYTES.value(
+                shard=shard.name, target=t) < per_target_pre[t]
+
+        shard.promote_device()
+        # lazy mirrors re-sync at the next fused search
+        col.multi_target_search(queries[0], k=K, combination="sum")
+        post = shard.hbm_bytes()
+        assert post == pre, f"ledger asymmetry: {pre} -> {post}"
+        for t in DIMS:
+            assert TARGET_PLANE_HBM_BYTES.value(
+                shard=shard.name, target=t) == per_target_pre[t]
+        _parity(col, queries, combos=COMBOS[:1])
+    finally:
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# request validation at the API surfaces
+
+
+@pytest.fixture
+def rest_server(tmp_dbdir, rng):
+    from weaviate_tpu.api.rest import RestAPI
+
+    db, col, vecs = _build(tmp_dbdir, rng)
+    api = RestAPI(db)
+    srv = api.serve(host="127.0.0.1", port=0, background=True)
+    yield f"http://127.0.0.1:{srv.server_port}", vecs
+    api.shutdown()
+    db.close()
+
+
+def _graphql(base, query):
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        base + "/v1/graphql",
+        data=json.dumps({"query": query}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_rest_multi_target_roundtrip_and_validation(rest_server):
+    base, vecs = rest_server
+    qa = ", ".join(f"{x:.4f}" for x in vecs["a"][3])
+    qb = ", ".join(f"{x:.4f}" for x in vecs["b"][3])
+    ok = _graphql(base, f"""
+    {{ Get {{ Multi(limit: 3, nearVector: {{
+        vectorPerTarget: {{a: [{qa}], b: [{qb}]}},
+        targets: {{targetVectors: ["a", "b"],
+                   combinationMethod: sum}}}})
+        {{ _additional {{ id distance }} }} }} }}
+    """)
+    assert not ok.get("errors"), ok
+    hits = ok["data"]["Get"]["Multi"]
+    assert hits and hits[0]["_additional"]["id"].startswith("00000003")
+
+    # unknown target -> GraphQL errors array (the 400 surface)
+    bad = _graphql(base, f"""
+    {{ Get {{ Multi(limit: 3, nearVector: {{
+        vectorPerTarget: {{a: [{qa}], b: [{qb}]}},
+        targets: {{targetVectors: ["a", "nope"]}}}})
+        {{ _additional {{ id }} }} }} }}
+    """)
+    assert bad.get("errors")
+    assert "nope" in bad["errors"][0]["message"]
+
+    # manualWeights with incomplete weight coverage -> errors array
+    bad = _graphql(base, f"""
+    {{ Get {{ Multi(limit: 3, nearVector: {{
+        vectorPerTarget: {{a: [{qa}], b: [{qb}]}},
+        targets: {{targetVectors: ["a", "b"],
+                   combinationMethod: manualWeights,
+                   weights: {{a: 0.5}}}}}})
+        {{ _additional {{ id }} }} }} }}
+    """)
+    assert bad.get("errors")
+    assert "weight" in bad["errors"][0]["message"].lower()
+
+
+def test_grpc_multi_target_roundtrip_and_invalid_argument(tmp_dbdir, rng):
+    import grpc
+
+    from weaviate_tpu.api.grpc_server import GrpcAPI
+    from weaviate_tpu.api.proto import weaviate_v1_compat_pb2 as wv
+
+    db, col, vecs = _build(tmp_dbdir, rng)
+    api = GrpcAPI(db)
+    port = api.serve(port=0)
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+
+    def search(req):
+        m = chan.unary_unary(
+            "/weaviate.v1.Weaviate/Search",
+            request_serializer=lambda x: x.SerializeToString(),
+            response_deserializer=wv.SearchReply.FromString)
+        return m(req)
+
+    try:
+        req = wv.SearchRequest(collection="Multi", limit=3)
+        for t in ("a", "b"):
+            vt = req.near_vector.vector_for_targets.add()
+            vt.name = t
+            vt.vector_bytes = np.asarray(
+                vecs[t][5], "<f4").tobytes()
+        req.near_vector.targets.target_vectors.extend(["a", "b"])
+        req.near_vector.targets.combination = 1  # SUM
+        req.metadata.uuid = True
+        reply = search(req)
+        assert reply.results
+        assert reply.results[0].metadata.id.startswith("00000005")
+
+        # manualWeights naming only one of two targets
+        req.near_vector.targets.combination = 5  # MANUAL
+        w = req.near_vector.targets.weights_for_targets.add()
+        w.target = "a"
+        w.weight = 0.5
+        with pytest.raises(grpc.RpcError) as ei:
+            search(req)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+        # unknown target vector
+        req2 = wv.SearchRequest(collection="Multi", limit=3)
+        vt = req2.near_vector.vector_for_targets.add()
+        vt.name = "nope"
+        vt.vector_bytes = np.asarray(vecs["a"][0], "<f4").tobytes()
+        with pytest.raises(grpc.RpcError) as ei:
+            search(req2)
+        assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    finally:
+        api.shutdown()
+        db.close()
+
+
+# ---------------------------------------------------------------------------
+# single-target collections: batch-group keys and dispatch identities stay
+# byte-identical (the multi-target plumbing widened _Req.queries to tuples
+# WITHOUT touching the grouping predicate)
+
+
+def test_single_target_dispatch_identity_unchanged(tmp_dbdir, rng):
+    from weaviate_tpu.index.dispatch import (
+        _Req,
+        _concat_queries,
+        _rows,
+        current_dispatch_group,
+        dispatch_group,
+    )
+
+    q1 = rng.standard_normal((3, 8)).astype(np.float32)
+    q2 = rng.standard_normal((2, 8)).astype(np.float32)
+
+    # legacy single-target requests: the ndarray rides UNWRAPPED (no
+    # tuple envelope), the group key stays None outside any dispatch
+    # group, and concatenation is byte-identical to np.concatenate
+    r1 = _Req(q1, 10, None, tier_key=(0, 0))
+    r2 = _Req(q2, 10, None, tier_key=(0, 0))
+    assert r1.queries is q1
+    assert r1.group_key is None
+    assert _rows(r1.queries) == 3
+    cat = _concat_queries([r1, r2])
+    assert cat.tobytes() == np.concatenate([q1, q2]).tobytes()
+
+    # the grouping predicate (_take_group_locked) joins on
+    # (k, tier_key, group_key, rerank, mask): identical for two legacy
+    # requests, so they coalesce exactly as before
+    assert (r1.k, r1.tier_key, r1.group_key) \
+        == (r2.k, r2.tier_key, r2.group_key)
+
+    # multi-target requests carry their target-set identity in the
+    # group token: same target set + join share a key (DO coalesce),
+    # different target sets never do, and neither matches legacy None
+    with dispatch_group(("multitarget", ("a", "b"), "weighted")):
+        g_ab = current_dispatch_group()
+    with dispatch_group(("multitarget", ("a", "b"), "weighted")):
+        g_ab2 = current_dispatch_group()
+    with dispatch_group(("multitarget", ("a", "c"), "weighted")):
+        g_ac = current_dispatch_group()
+    assert g_ab == g_ab2
+    assert g_ab != g_ac
+    assert g_ab is not None and r1.group_key is None
+
+    # end-to-end: a legacy single-target collection serves through the
+    # unchanged identity (one device dispatch per search call, queries
+    # as a bare ndarray all the way down)
+    db = DB(tmp_dbdir)
+    col = db.create_collection(CollectionConfig(
+        name="Legacy", vector_config=_hnsw()))
+    try:
+        vecs = rng.standard_normal((200, 16)).astype(np.float32)
+        col.put_batch([StorageObject(
+            uuid=f"{i:08x}-0000-0000-0000-000000000000",
+            collection="Legacy", vector=vecs[i]) for i in range(200)])
+        res = col.vector_search_batch(vecs[:4], k=5)
+        assert len(res) == 4
+        assert res[0][0][0].uuid.startswith("00000000")
+    finally:
+        db.close()

@@ -814,6 +814,87 @@ class HostLoopOverMesh(Rule):
 
 
 # ---------------------------------------------------------------------------
+# 4g. host-loop-over-targets
+
+
+class HostLoopOverTargets(Rule):
+    id = "host-loop-over-targets"
+    description = (
+        "Python for-loop over named-vector targets whose body issues "
+        "per-target device dispatches or host merges"
+    )
+    rationale = (
+        "The multi-target serving contract is ONE fused device dispatch "
+        "per batch (ops/device_beam.py device_multi_search: per-target "
+        "walks + cross-scoring + join + top-k inside one jitted "
+        "program, docs/multitarget.md): a host loop that walks or "
+        "merges per target pays T dispatch round trips and a host-side "
+        "join, exactly the scatter the fused program deletes. "
+        "Enumerating targets for metadata (counts, plane accounting, "
+        "config plumbing) is fine — only loops that DISPATCH or run a "
+        "per-target search/merge are flagged. Route through "
+        "Shard.multi_target_search, or suppress with the invariant "
+        "that makes the loop cold (the host parity oracle lives in "
+        "core/, outside this rule's scope, on purpose)."
+    )
+
+    _DIRS = ("weaviate_tpu/index/", "weaviate_tpu/query/",
+             "weaviate_tpu/ops/")
+    _TARGET_NAMES = frozenset({"targets", "target_vectors",
+                               "named_vectors", "_vector_indexes"})
+    _MERGE_CALLS = frozenset({"vector_search", "vector_search_batch",
+                              "device_beam_search",
+                              "combine_multi_target"})
+
+    def _iterates_targets(self, it: ast.AST) -> bool:
+        """Whether the loop's iterable mentions a target enumeration:
+        ``targets`` / ``target_vectors`` / ``named_vectors`` /
+        ``_vector_indexes`` as a name or attribute (including .items()/
+        .values() views and enumerate(...) of any of those)."""
+        for n in ast.walk(it):
+            if isinstance(n, ast.Name) and n.id in self._TARGET_NAMES:
+                return True
+            if isinstance(n, ast.Attribute) \
+                    and n.attr in self._TARGET_NAMES:
+                return True
+        return False
+
+    def _per_target_work(self, node, ctx) -> Optional[ast.Call]:
+        for call in ast.walk(ast.Module(body=node.body, type_ignores=[])):
+            if not isinstance(call, ast.Call):
+                continue
+            if is_dispatch_call(call, ctx):
+                return call
+            if isinstance(call.func, ast.Attribute) \
+                    and call.func.attr in self._MERGE_CALLS:
+                return call
+            if isinstance(call.func, ast.Name) \
+                    and call.func.id in self._MERGE_CALLS:
+                return call
+        return None
+
+    def check(self, ctx) -> Iterator[Violation]:
+        if not _path_in(ctx.rel_path, self._DIRS):
+            return
+        for node in ctx.walk(ast.For, ast.AsyncFor):
+            if not self._iterates_targets(node.iter):
+                continue
+            call = self._per_target_work(node, ctx)
+            if call is None:
+                continue
+            dn = dotted_name(call.func)
+            yield self.violation(
+                ctx, node,
+                f"for-loop over named-vector targets runs {dn}(...) per "
+                "target — T serialized walks + a host join instead of "
+                "the one fused multi-target dispatch; route through "
+                "Shard.multi_target_search (ops/device_beam."
+                "device_multi_search)",
+                severity=SEV_ERROR,
+            )
+
+
+# ---------------------------------------------------------------------------
 # 5. lock-across-device-call
 
 
@@ -1775,6 +1856,7 @@ ALL_RULES: tuple = (
     HostBeamFallbackUnproven(),
     DeviceArrayLeak(),
     HostLoopOverMesh(),
+    HostLoopOverTargets(),
     LockAcrossDeviceCall(),
     DeviceFeedUnderLock(),
     Float64LiteralDrift(),

@@ -551,13 +551,16 @@ class GraphQLExecutor:
             p.filters = where_to_filter(args["where"])
         if "nearVector" in args:
             nv = args["nearVector"]
-            p.near_vector = np.asarray(nv["vector"], np.float32)
+            if "vector" in nv:
+                p.near_vector = np.asarray(nv["vector"], np.float32)
             if "distance" in nv:
                 p.max_distance = float(nv["distance"])
             elif "certainty" in nv:
                 p.max_distance = 2.0 * (1.0 - float(nv["certainty"]))
-            if "targetVectors" in nv and nv["targetVectors"]:
-                p.target_vector = nv["targetVectors"][0]
+            self._parse_targets(p, nv)
+            if p.targets is None and p.near_vector is None:
+                raise GraphQLError(
+                    "nearVector requires vector or vectorPerTarget")
         if "nearText" in args:
             nt = args["nearText"]
             concepts = nt.get("concepts", [])
@@ -653,6 +656,87 @@ class GraphQLExecutor:
             )
         return p
 
+    def _parse_targets(self, p, nv: dict) -> None:
+        """Multi-target argument plumbing shared with the reference's
+        shapes: ``targetVectors: [a, b]`` (one query vector scored
+        against every target), ``vectorPerTarget: {a: [...], b: [...]}``
+        (mixed-dims targets), and the ``targets: {targetVectors,
+        combinationMethod, weights}`` object. A single targetVector
+        keeps the legacy single-target fields — batch-group keys and
+        dispatch identities for single-target collections stay
+        byte-identical."""
+        tv = list(nv.get("targetVectors") or [])
+        tobj = nv.get("targets")
+        weights = None
+        if isinstance(tobj, dict):
+            tv = list(tobj.get("targetVectors") or tv)
+            method = tobj.get("combinationMethod")
+            if method:
+                p.target_combination = str(method)
+            w = tobj.get("weights")
+            if isinstance(w, dict) and w:
+                weights = {str(k): float(v) for k, v in w.items()}
+        vpt = nv.get("vectorPerTarget")
+        per_target = None
+        if isinstance(vpt, dict) and vpt:
+            per_target = {str(t): np.asarray(v, np.float32)
+                          for t, v in vpt.items()}
+            if not tv:
+                tv = list(per_target.keys())
+        if len(tv) <= 1 and per_target is None:
+            if tv:
+                p.target_vector = tv[0]
+            return
+        if per_target is not None:
+            missing = [t for t in tv if t not in per_target]
+            if missing:
+                raise GraphQLError(
+                    f"vectorPerTarget missing targets: {missing}")
+            p.targets = {t: per_target[t] for t in tv}
+        else:
+            if p.near_vector is None:
+                raise GraphQLError(
+                    "multi-target nearVector requires vector or "
+                    "vectorPerTarget")
+            p.targets = {t: p.near_vector for t in tv}
+        if weights is not None:
+            p.target_weights = weights
+            if not isinstance(tobj, dict) or \
+                    not tobj.get("combinationMethod"):
+                p.target_combination = "manualWeights"
+
+    def _needs_cluster_multi(self, p) -> bool:
+        """A plain multi-target Get against a collection with non-local
+        shards scatters through the coordinator
+        (``cluster/node.py:multi_target_search``) — each serving
+        replica re-plans locally and runs its shard's fused program;
+        the coordinator merges by joined distance. Features the cluster
+        multi-target API doesn't carry keep the local path."""
+        if self.cluster is None or not p.targets:
+            return False
+        featured = (p.hybrid is not None
+                    or p.bm25_query is not None or p.near_text is not None
+                    or getattr(p, "ask", None) is not None
+                    or p.group_by is not None
+                    or getattr(p, "legacy_group", None) is not None
+                    or getattr(p, "sort", None)
+                    or getattr(p, "generate", None) is not None
+                    or getattr(p, "rerank", None) is not None
+                    or getattr(p, "summary", None) is not None
+                    or getattr(p, "tokens", None) is not None
+                    or p.offset or p.autocut
+                    or getattr(p, "autocorrect", False)
+                    or p.max_distance is not None
+                    or p.after is not None)
+        if featured:
+            return False
+        try:
+            st = self.cluster._state_for(p.collection)
+        except (KeyError, ValueError):
+            return False
+        return any(self.cluster.id not in st.replicas(s)
+                   for s in range(st.n_shards))
+
     def _needs_cluster_scatter(self, p) -> bool:
         """A nearVector Get (plain or where-filtered — the cluster
         search API ships the filter AST and each replica re-plans
@@ -662,7 +746,7 @@ class GraphQLExecutor:
         the cluster search API doesn't carry (hybrid, offsets, ...)
         keeps the local path with its documented local-replica
         semantics."""
-        if self.cluster is None or p.near_vector is None:
+        if self.cluster is None or p.near_vector is None or p.targets:
             return False
         featured = (p.hybrid is not None
                     or p.bm25_query is not None or p.near_text is not None
@@ -757,6 +841,15 @@ class GraphQLExecutor:
                             else [props],
                             certainty=float(sub.args.get("certainty", 0.0)),
                         )
+
+        if self._needs_cluster_multi(params):
+            rows = self.cluster.multi_target_search(
+                params.collection, params.targets, k=params.limit,
+                combination=params.target_combination,
+                weights=params.target_weights,
+                tenant=params.tenant, flt=params.filters)
+            return [self._render_object(cls.selections, obj, None, d)
+                    for obj, d in rows]
 
         if self._needs_cluster_scatter(params):
             rows = self.cluster.vector_search(

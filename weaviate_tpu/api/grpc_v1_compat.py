@@ -154,7 +154,63 @@ def apply_hybrid(params: QueryParams, h) -> None:
     )
 
 
+# reference CombinationMethod enum (base_search.proto); UNSPECIFIED
+# keeps the reference's minimum default
+_COMBINATION = {0: "minimum", 1: "sum", 2: "minimum", 3: "average",
+                4: "relativeScore", 5: "manualWeights"}
+
+
+def _apply_targets(params: QueryParams, targets, shared, per_target) -> bool:
+    """Translate a pb ``Targets`` block (+ optional per-target vectors)
+    into the QueryParams multi-target fields. Returns False when the
+    request is single-target so callers keep the legacy field mapping.
+    ValueError surfaces as INVALID_ARGUMENT at the servicer boundary."""
+    tv = list(targets.target_vectors)
+    if per_target is None and len(tv) <= 1:
+        return False
+    vecs: dict[str, np.ndarray] = dict(per_target or {})
+    for t in tv:
+        if t not in vecs:
+            if shared is None:
+                raise ValueError(
+                    f"no query vector provided for target {t!r}")
+            vecs[t] = shared
+    if not vecs:
+        return False
+    combination = _COMBINATION.get(int(targets.combination))
+    if combination is None:
+        raise ValueError(
+            f"unknown combination method {int(targets.combination)}")
+    weights = {w.target: float(w.weight)
+               for w in targets.weights_for_targets}
+    if weights and int(targets.combination) == 0:
+        combination = "manualWeights"
+    params.targets = vecs
+    params.target_combination = combination
+    params.target_weights = weights or None
+    return True
+
+
 def apply_near_vector(params: QueryParams, nv) -> None:
+    per_target: Optional[dict[str, np.ndarray]] = None
+    if nv.vector_for_targets:
+        per_target = {}
+        for vt in nv.vector_for_targets:
+            if vt.vectors:
+                per_target[vt.name] = _decode_vectors_entry(vt.vectors[0])
+            elif vt.vector_bytes:
+                per_target[vt.name] = _vec_from_bytes(vt.vector_bytes)
+            else:
+                raise ValueError(
+                    f"vector_for_targets entry {vt.name!r} carries no "
+                    "vector")
+    shared = None
+    if nv.vectors or nv.vector_bytes or nv.vector:
+        shared = vector_from_near(nv)
+    if _apply_targets(params, nv.targets, shared, per_target):
+        if nv.HasField("distance"):
+            params.max_distance = float(nv.distance)
+        return
     params.near_vector = vector_from_near(nv)
     if nv.targets.target_vectors:
         params.target_vector = nv.targets.target_vectors[0]

@@ -267,20 +267,44 @@ class _Query:
                             for s in sorts]
         return args
 
-    def near_vector(self, vector, *, limit: int = 10, certainty=None,
+    def near_vector(self, vector=None, *, limit: int = 10, certainty=None,
                     distance=None, filters=None, offset: int = 0,
                     autocut=None, sort=None, target_vector: str = "",
+                    target_vectors: Optional[Sequence[str]] = None,
+                    vector_per_target: Optional[dict] = None,
+                    combination: Optional[str] = None,
+                    target_weights: Optional[dict] = None,
                     return_properties: Optional[Sequence[str]] = None,
                     include: Sequence[str] = ("distance",)):
-        nv: dict = {"vector": vector}
+        """Multi-target: pass ``target_vectors=[a, b]`` (one query vector
+        scored against every named plane) or ``vector_per_target={a:
+        [...], b: [...]}`` for mixed-dims targets, plus optional
+        ``combination`` (sum/average/minimum/manualWeights/relativeScore)
+        and ``target_weights``."""
+        nv: dict = {}
+        if vector is not None:
+            nv["vector"] = vector
         if certainty is not None:
             nv["certainty"] = certainty
         if distance is not None:
             nv["distance"] = distance
-        if target_vector:
+        if vector_per_target:
+            nv["vectorPerTarget"] = {str(t): list(v)
+                                     for t, v in vector_per_target.items()}
+        tv = list(target_vectors or ([target_vector] if target_vector
+                                     else []))
+        if combination or target_weights:
+            tobj: dict = {"targetVectors": tv}
+            if combination:
+                tobj["combinationMethod"] = combination
+            if target_weights:
+                tobj["weights"] = {str(t): float(w)
+                                   for t, w in target_weights.items()}
+            nv["targets"] = tobj
+        elif tv:
             # the server reads targetVectors nested in the operator
             # (graphql.py _params_from_args), matching the reference
-            nv["targetVectors"] = [target_vector]
+            nv["targetVectors"] = tv
         args = self._common({"nearVector": nv}, filters, limit, offset,
                             autocut, sort)
         return self._run(args, return_properties, include)

@@ -1332,6 +1332,157 @@ class ClusterNode:
                 hits.append((float(d), o.to_bytes()))
         return {"hits": hits}
 
+    def multi_target_search(self, cls: str, vectors: dict, k: int = 10,
+                            combination: str = "minimum",
+                            weights: Optional[dict] = None,
+                            tenant: str = "", flt=None,
+                            deadline: Optional[Deadline] = None) \
+            -> list[tuple[StorageObject, float]]:
+        """Scatter a multi-target (named-vector) search across shards.
+        The per-target query vectors AND the target weights ship in the
+        envelope so each serving replica re-plans locally — filter
+        plane lookup, walk-leg eligibility, and the fused one-dispatch
+        program are all per-shard state; the coordinator merges by
+        joined distance (per-shard relativeScore normalization, same
+        stance as the reference's shard combine)."""
+        from weaviate_tpu.query.multi_target import validate_multi_target
+
+        state = self._state_for(cls)
+        cfg = self._collection_config(cls)
+        known = (set(cfg.named_vectors or ()) | {""}) if cfg is not None \
+            else set(vectors)
+        validate_multi_target(list(vectors), combination, weights, known)
+        deadline = self._op_deadline("vector_search", deadline)
+        filter_dict = flt.to_dict() if flt is not None else None
+        targets = list(vectors)
+        qs = {t: np.asarray(vectors[t], np.float32) for t in targets}
+
+        def one_shard(shard: int) -> list[tuple[float, bytes]]:
+            r = self._first_replica(state, shard, {
+                "type": "shard_multi_target", "class": cls,
+                "tenant": tenant, "shard": shard,
+                "targets": targets,
+                "queries": {t: qs[t].tobytes() for t in targets},
+                "dims": {t: int(qs[t].shape[-1]) for t in targets},
+                "k": k, "combination": combination,
+                "weights": weights, "filter": filter_dict,
+            }, deadline)
+            return [(dist, blob) for dist, blob in r["hits"]]
+
+        results: list[tuple[float, bytes]] = []
+        for hits in self._parallel_map(one_shard,
+                                       list(range(state.n_shards))):
+            results.extend(hits)
+        results.sort(key=lambda t: t[0])
+        return [(StorageObject.from_bytes(blob), d)
+                for d, blob in results[:k]]
+
+    def _collection_config(self, cls: str):
+        try:
+            return self.db.get_collection(cls).config
+        except KeyError:
+            # schema not applied locally yet: validation then trusts
+            # the caller's target set and the serving replica re-checks
+            logging.getLogger("weaviate_tpu.cluster").debug(
+                "no local schema for %s; skipping target validation",
+                cls)
+            return None
+
+    def _on_shard_multi_target(self, msg: dict) -> dict:
+        """Serving-replica leg: re-plan the filter locally, run the
+        shard's fused multi-target program when every target plane is
+        eligible, else the per-shard host walk+join oracle."""
+        shard = self._local_shard(msg["class"], msg["shard"],
+                                  msg.get("tenant", ""))
+        targets = list(msg["targets"])
+        vectors = {
+            t: np.frombuffer(msg["queries"][t], np.float32).reshape(
+                msg["dims"][t])
+            for t in targets}
+        combination = msg.get("combination", "minimum")
+        weights = msg.get("weights")
+        k = msg["k"]
+        allow = None
+        est_sel = None
+        if msg.get("filter"):
+            from weaviate_tpu.inverted.filters import Filter
+
+            flt = Filter.from_dict(msg["filter"])
+            plane = shard.filter_planes.lookup(flt)
+            allow = plane if plane is not None else shard.allow_list(flt)
+            try:
+                est_sel = shard.inverted.estimate_selectivity(flt)
+            except Exception:
+                logging.getLogger("weaviate_tpu.cluster").debug(
+                    "selectivity estimate failed", exc_info=True)
+                est_sel = None
+        if shard.multi_target_device_eligible(tuple(targets)):
+            try:
+                res = shard.multi_target_search(
+                    vectors, k, combination, weights, allow_list=allow)
+                hits = []
+                for d, i in zip(res.dists[0], res.ids[0]):
+                    if i < 0 or not np.isfinite(d):
+                        continue
+                    o = shard.get_by_docid(int(i))
+                    if o is not None:
+                        hits.append((float(d), o.to_bytes()))
+                return {"hits": hits}
+            except Exception:
+                logging.getLogger("weaviate_tpu.cluster").warning(
+                    "fused multi-target leg failed; serving host "
+                    "oracle", exc_info=True)
+        return {"hits": self._shard_multi_target_host(
+            shard, vectors, k, combination, weights, allow, est_sel)}
+
+    @staticmethod
+    def _shard_multi_target_host(shard, vectors: dict, k: int,
+                                 combination: str, weights, allow,
+                                 est_sel) -> list[tuple[float, bytes]]:
+        """Per-shard host oracle: per-target walks, exact gap-fill from
+        stored vectors, drop-if-missing, combine — the single-shard
+        slice of ``Collection._multi_target_search_host``."""
+        from weaviate_tpu.query.multi_target import (
+            combine_multi_target,
+            np_distance,
+        )
+
+        per_target: dict[str, dict] = {}
+        for tgt, q in vectors.items():
+            res = shard.vector_search(
+                np.atleast_2d(np.asarray(q, np.float32)), k, target=tgt,
+                allow_list=allow, est_selectivity=est_sel)
+            per_target[tgt] = {
+                int(i): float(d)
+                for d, i in zip(res.dists[0], res.ids[0]) if i >= 0}
+        union: set[int] = set()
+        for dists in per_target.values():
+            union.update(dists)
+        objs: dict[int, StorageObject] = {}
+        for docid in union:
+            obj = shard.get_by_docid(docid)
+            if obj is None:
+                continue
+            objs[docid] = obj
+            for tgt in vectors:
+                if docid not in per_target[tgt]:
+                    v = obj.named_vectors.get(tgt)
+                    if v is None and tgt == "":
+                        v = obj.vector
+                    if v is None:
+                        continue
+                    cfg = (shard.config.named_vectors.get(tgt)
+                           or shard.config.vector_config)
+                    per_target[tgt][docid] = np_distance(
+                        vectors[tgt], v, cfg.distance)
+        full = [key for key in union
+                if all(key in per_target[t] for t in vectors)]
+        per_target = {t: {k2: d[k2] for k2 in full}
+                      for t, d in per_target.items()}
+        combined = combine_multi_target(per_target, combination, weights)
+        return [(score, objs[docid].to_bytes())
+                for docid, score in combined[:k] if docid in objs]
+
     def bm25_search(self, cls: str, query: str, k: int = 10,
                     tenant: str = "",
                     deadline: Optional[Deadline] = None) \

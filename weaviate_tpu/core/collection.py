@@ -1470,11 +1470,103 @@ class Collection:
         flt: Optional[Filter] = None,
         tenant: str = "",
     ) -> list[tuple[StorageObject, float]]:
-        """Search several named target vectors and join scores.
+        """Search several named target vectors and join scores — as ONE
+        fused device dispatch per shard when every target serves a
+        device plane (docs/multitarget.md), with the host per-target
+        walk+join (``_multi_target_search_host``) as the exact parity
+        oracle and fallback tier. Request-shape errors (unknown target,
+        weight mismatch) raise ``ValueError`` before any search runs."""
+        from weaviate_tpu.monitoring.metrics import (
+            MULTITARGET_FALLBACK,
+            MULTITARGET_REQUESTS,
+        )
+        from weaviate_tpu.query.multi_target import (
+            join_mode,
+            validate_multi_target,
+        )
+
+        known = set(self.config.named_vectors or ()) | {DEFAULT_VECTOR}
+        validate_multi_target(list(vectors.keys()), combination, weights,
+                              known)
+        join = join_mode(combination)
+        MULTITARGET_REQUESTS.inc(join=join)
+        targets = tuple(vectors.keys())
+        shards = self._search_shards(tenant)
+        # dim mismatches must fail as request-shape errors HERE — inside
+        # the fused program they would abort the jit and read as a
+        # device failure (latching a fresh target set onto the oracle)
+        for t in targets:
+            q = np.asarray(vectors[t])
+            for s in shards:
+                idx = s.vector_index(t)
+                dims = getattr(idx, "dims", None)
+                if dims and q.shape[-1] != dims:
+                    raise ValueError(
+                        f"query vector for target {t!r} has dim "
+                        f"{q.shape[-1]}, index expects {dims}")
+                break
+        if len(targets) >= 2 and shards and all(
+                s.multi_target_device_eligible(targets) for s in shards):
+            try:
+                return self._multi_target_search_fused(
+                    vectors, k, combination, weights, flt, shards)
+            except Exception:
+                import logging
+
+                # the shard runner already classified (and latched) the
+                # failure on its ledger; this request serves exactly
+                # from the oracle
+                logging.getLogger("weaviate_tpu.core.collection").warning(
+                    "fused multi-target search failed; serving host "
+                    "oracle", exc_info=True)
+        elif len(targets) >= 2:
+            MULTITARGET_FALLBACK.inc(mode="ineligible")
+        return self._multi_target_search_host(
+            vectors, k, combination, weights, flt, tenant)
+
+    def _multi_target_search_fused(
+        self, vectors, k, combination, weights, flt, shards,
+    ) -> list[tuple[StorageObject, float]]:
+        """Fused tier: one device dispatch PER SHARD (each over all
+        targets), merged by joined distance on the coordinator — the
+        multi-target analogue of ``vector_search``'s shard merge."""
+        per_shard = []
+        for shard in shards:
+            allow = None
+            if flt is not None:
+                plane = shard.filter_planes.lookup(flt)
+                allow = (plane if plane is not None
+                         else shard.allow_list(flt))
+            res = shard.multi_target_search(
+                vectors, k, combination, weights, allow_list=allow)
+            per_shard.append((shard, res))
+        merged = []
+        for shard, res in per_shard:
+            for d, i in zip(res.dists[0], res.ids[0]):
+                if i >= 0 and np.isfinite(d):
+                    merged.append((float(d), shard, int(i)))
+        merged.sort(key=lambda x: x[0])
+        out = []
+        for d, shard, docid in merged[:k]:
+            obj = shard.get_by_docid(docid)
+            if obj is not None:
+                out.append((obj, d))
+        return out
+
+    def _multi_target_search_host(
+        self,
+        vectors: dict[str, np.ndarray],
+        k: int = 10,
+        combination: str = "minimum",
+        weights: Optional[dict[str, float]] = None,
+        flt: Optional[Filter] = None,
+        tenant: str = "",
+    ) -> list[tuple[StorageObject, float]]:
+        """The exact parity oracle: per-target searches, missing
+        distances recomputed exactly from stored vectors, then combined.
 
         Reference ``explorer.go:241`` (searchForTargets) +
-        ``shard_combine_multi_target.go``: per-target searches, missing
-        distances recomputed exactly from stored vectors, then combined.
+        ``shard_combine_multi_target.go``.
         """
         from weaviate_tpu.query.multi_target import combine_multi_target, np_distance
 

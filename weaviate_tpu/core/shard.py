@@ -134,6 +134,14 @@ class Shard:
         # _vector_indexes/_dims publish copy-on-write under this lock so
         # every reader iterates a stable snapshot lock-free.
         self._build_lock = threading.Lock()
+        # fused multi-target serving state (docs/multitarget.md): one
+        # coalescing dispatcher per (target-set, join) identity — batch
+        # grouping must never mix target sets, and the per-target query
+        # tuples concatenate component-wise — plus the proven/latched
+        # ledger driving the host-oracle fallback semantics.
+        self._mt_dispatchers: dict[tuple, Any] = {}
+        self._mt_proven: set[tuple] = set()
+        self._mt_latched: set[tuple] = set()
         # checkpoint gate: deferred post-lock index work (ragged feeds,
         # index deletes) in flight — a checkpoint taken mid-window would
         # record a seq whose index effects haven't landed yet
@@ -816,6 +824,213 @@ class Shard:
     def objects_by_docids(self, doc_ids: np.ndarray) -> list[Optional[StorageObject]]:
         return [self.get_by_docid(int(d)) if d >= 0 else None for d in doc_ids]
 
+    # -- fused multi-target serving (docs/multitarget.md) ------------------
+    def multi_target_device_eligible(self, targets: tuple[str, ...]) -> bool:
+        """Cheap pre-check: every target has a device-beam-capable index
+        in a CONSISTENT mesh mode and the target set hasn't latched.
+        Runtime state may still change between this check and the
+        drain — the batch runner re-validates and raises."""
+        if len(targets) < 2 or targets in self._mt_latched:
+            return False
+        modes = []
+        for t in targets:
+            idx = self._vector_indexes.get(t)
+            if idx is None or getattr(idx, "multi_walk_inputs", None) is None:
+                return False
+            if getattr(idx, "_device_beam", None) is None \
+                    or not idx.device_resident:
+                return False
+            modes.append(idx._mesh_mirror() is not None)
+        return all(modes) or not any(modes)
+
+    def _mt_dispatcher(self, targets: tuple[str, ...], join: str):
+        key = (targets, join)
+        disp = self._mt_dispatchers.get(key)
+        if disp is None:
+            with self._build_lock:
+                disp = self._mt_dispatchers.get(key)
+                if disp is None:
+                    from weaviate_tpu.index.dispatch import (
+                        CoalescingDispatcher,
+                    )
+
+                    def run(q, k, allow, _t=targets, _j=join):
+                        return self._run_multi_batch(_t, _j, q, k, allow)
+
+                    disp = CoalescingDispatcher(run)
+                    self._mt_dispatchers = {**self._mt_dispatchers,
+                                            key: disp}
+        return disp
+
+    def multi_target_search(
+        self,
+        vectors: dict[str, np.ndarray],
+        k: int,
+        combination: str,
+        weights: Optional[dict[str, float]] = None,
+        allow_list=None,
+    ) -> SearchResult:
+        """ONE-dispatch multi-target search: enqueue the per-target query
+        tuple (weight rows first — they share the batch dimension) into
+        the target set's coalescing dispatcher; the drain leader runs
+        every coalesced request as a single fused multi-target program.
+        Raises on ineligibility/kernel failure — the Collection catches
+        and serves the host per-target-walk+join oracle."""
+        from weaviate_tpu.index.dispatch import dispatch_group
+        from weaviate_tpu.query.multi_target import join_mode, weight_row
+
+        targets = tuple(vectors.keys())
+        join = join_mode(combination)
+        w = weight_row(list(targets), combination, weights)[None, :]
+        qs = tuple(np.atleast_2d(np.asarray(vectors[t], np.float32))
+                   for t in targets)
+        tier_key = tuple(
+            (getattr(self._vector_indexes.get(t), "_residency_epoch", 0),
+             getattr(getattr(self._vector_indexes.get(t), "_device_beam",
+                             None), "epoch", 0))
+            for t in targets)
+        disp = self._mt_dispatcher(targets, join)
+        with dispatch_group(("multitarget", targets, join)):
+            ids, dists = disp.search(
+                (w.astype(np.float32),) + qs, k, allow=allow_list,
+                tier_key=tier_key)
+        return SearchResult(ids=ids, dists=dists)
+
+    def _run_multi_batch(self, targets: tuple[str, ...], join: str,
+                         q_tuple: tuple, k: int, allow_list):
+        """Drain leader body: assemble one walk leg per target and run
+        them as ONE fused device dispatch (``device_multi_search`` /
+        ``_mesh``), then host-sweep deleted docids and truncate. Any
+        failure classifies transient/latched on the target-set ledger
+        and propagates — the fallback tier is the Collection's host
+        oracle, never a partial answer."""
+        from weaviate_tpu.monitoring.metrics import MULTITARGET_FALLBACK
+
+        weights = q_tuple[0]
+        qs = q_tuple[1:]
+        b = weights.shape[0]
+        b_pad = 1 << max(3, (b - 1).bit_length())
+        # the leader re-derives ONE joint expansion budget from the
+        # group's shared mask (same derivation as the single-target
+        # leader — deterministic in the popcount)
+        expand = 0
+        idx0 = self._vector_indexes.get(targets[0])
+        if allow_list is not None and idx0 is not None:
+            from weaviate_tpu.query.planner import expansion_budget
+
+            n_allowed = idx0._allow_popcount(allow_list)
+            expand = expansion_budget(n_allowed / max(1, idx0.count()))
+        try:
+            legs = []
+            for t, q in zip(targets, qs):
+                idx = self._vector_indexes.get(t)
+                leg = None
+                if idx is not None \
+                        and getattr(idx, "multi_walk_inputs", None):
+                    leg = idx.multi_walk_inputs(
+                        q, k, b_pad, allow_list=allow_list, expand=expand)
+                if leg is None:
+                    MULTITARGET_FALLBACK.inc(mode="ineligible")
+                    raise RuntimeError(
+                        f"target {t!r} cannot serve a device walk")
+                legs.append(leg)
+            mesh_modes = [leg["mesh_mirror"] is not None for leg in legs]
+            if any(mesh_modes) and not all(mesh_modes):
+                MULTITARGET_FALLBACK.inc(mode="ineligible")
+                raise RuntimeError("mixed mesh/single-chip target planes")
+            ids, d = self._dispatch_multi_legs(
+                legs, weights, b, b_pad, k, join)
+        except Exception:
+            if targets in self._mt_proven:
+                MULTITARGET_FALLBACK.inc(mode="transient")
+            else:
+                MULTITARGET_FALLBACK.inc(mode="latched")
+                self._mt_latched.add(targets)
+            raise
+        self._mt_proven.add(targets)
+        for t in targets:
+            idx = self._vector_indexes.get(t)
+            if idx is not None and hasattr(idx, "beam_proven"):
+                idx.beam_proven()
+        # host sweep: deleted/tombstoned docids stay traversable on
+        # device; a doc must be live in EVERY target's graph (and
+        # allowed) to surface — the oracle's drop semantics
+        keep_masks = []
+        for t in targets:
+            idx = self._vector_indexes.get(t)
+            keep_masks.append(idx._keep_mask(allow_list))
+        ok = ids >= 0
+        for km in keep_masks:
+            ok &= np.where(
+                ids < len(km), km[np.clip(ids, 0, len(km) - 1)], False)
+        d = np.where(ok, d, np.float32(np.inf))
+        ids = np.where(ok, ids, -1)
+        order = np.argsort(d, axis=1, kind="stable")[:, :k]
+        d = np.take_along_axis(d, order, axis=1)
+        ids = np.take_along_axis(ids, order, axis=1)
+        if d.shape[1] < k:
+            pad = k - d.shape[1]
+            d = np.pad(d, ((0, 0), (0, pad)), constant_values=np.inf)
+            ids = np.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
+        return ids.astype(np.int64), d.astype(np.float32)
+
+    def _dispatch_multi_legs(self, legs, weights, b: int, b_pad: int,
+                             k: int, join: str):
+        """The single fused dispatch for an assembled leg set."""
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from weaviate_tpu.ops import device_beam as db
+
+        w = np.asarray(weights, np.float32)
+        if b_pad != b:
+            w = np.concatenate([w, np.repeat(w[:1], b_pad - b, axis=0)])
+        filtered = legs[0]["allow"] is not None
+        fetch = min((leg["keep_k"] if leg["keep_k"] > 0 else leg["ef_pad"])
+                    for leg in legs)
+        max_steps = max(int(4 * leg["ef_pad"] + 64) for leg in legs)
+        common = dict(
+            scorers=tuple(leg["scorer"] for leg in legs),
+            weights=jnp.asarray(w),
+            queries=tuple(leg["q"] for leg in legs),
+            operands=tuple(leg["operands"] for leg in legs),
+            adjacency=tuple(leg["adj"] for leg in legs),
+            present=tuple(leg["present"] for leg in legs),
+            upper_adjs=tuple(leg["upper_adj"] for leg in legs),
+            upper_slots=tuple(leg["upper_slots"] for leg in legs),
+            efs=tuple(leg["ef_pad"] for leg in legs),
+            max_steps=max_steps,
+            fetch=fetch,
+            join=join,
+            allows=tuple(leg["allow"] for leg in legs),
+            keep_ks=tuple(leg["keep_k"] for leg in legs),
+            expands=tuple(leg["expand"] for leg in legs),
+        )
+        t_dev = _time.perf_counter()
+        if legs[0]["mesh_mirror"] is not None:
+            ids, d = db.device_multi_search_mesh(
+                seeds=tuple(leg["seeds"] for leg in legs),
+                mesh=legs[0]["mesh_mirror"].mesh, **common)
+        else:
+            ids, d = db.device_multi_search(
+                eps=tuple(leg["eps"] for leg in legs), **common)
+        ids = np.asarray(ids)[:b].astype(np.int64)
+        d = np.asarray(d)[:b]
+        from weaviate_tpu.monitoring import devtime, tracing
+
+        dt_dev = _time.perf_counter() - t_dev
+        mesh_mode = ("mesh" if legs[0]["mesh_mirror"] is not None
+                     else "single")
+        phase = devtime.record(
+            backend="MultiTarget", scorer=join, mesh=mesh_mode,
+            shape_key=(b_pad, fetch, len(legs), filtered), seconds=dt_dev)
+        tracing.annotate(
+            device_execute_ms=round(dt_dev * 1000, 3),
+            device_phase=phase, scorer=f"multi:{join}",
+            mesh_mode=mesh_mode)
+        return ids, d
+
     # -- tiered residency (docs/tiering.md) --------------------------------
     def hbm_bytes(self) -> int:
         """Current HBM rent of every vector index this shard owns, plus
@@ -825,9 +1040,18 @@ class Shard:
 
         plane_bytes = self.filter_planes.hbm_bytes()
         FILTER_PLANE_HBM_BYTES.set(plane_bytes, shard=self.name)
+        from weaviate_tpu.monitoring.metrics import TARGET_PLANE_HBM_BYTES
+
         with self._lock:
-            return plane_bytes + sum(idx.hbm_bytes()
-                                     for idx in self._vector_indexes.values())
+            total = plane_bytes
+            for tgt, idx in self._vector_indexes.items():
+                n = idx.hbm_bytes()
+                # per-target plane rent: each named vector's arrays +
+                # topology mirror charge the ledger independently
+                TARGET_PLANE_HBM_BYTES.set(
+                    n, shard=self.name, target=tgt or "default")
+                total += n
+            return total
 
     def host_tier_bytes(self) -> int:
         with self._lock:

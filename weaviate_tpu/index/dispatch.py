@@ -135,6 +135,30 @@ class _Req:
         return self.deadline is not None and self.deadline.expired
 
 
+def _rows(queries) -> int:
+    """Batch-row count of a request's query payload. Multi-target
+    requests carry a TUPLE of per-target query arrays (plus the [B, T]
+    weight rows) sharing one batch dimension; everything else is a
+    single [B, ...] array."""
+    if isinstance(queries, tuple):
+        return queries[0].shape[0]
+    return queries.shape[0]
+
+
+def _concat_queries(group: list[_Req]):
+    """Row-concatenate a drained group's query payloads. Tuple payloads
+    (multi-target) concatenate COMPONENT-WISE — grouping guarantees
+    every member carries the same target-set structure (the tuple arity
+    and per-component dims ride the dispatch-group token)."""
+    if len(group) == 1:
+        return group[0].queries
+    if isinstance(group[0].queries, tuple):
+        return tuple(
+            np.concatenate(parts, axis=0)
+            for parts in zip(*(r.queries for r in group)))
+    return np.concatenate([r.queries for r in group], axis=0)
+
+
 def _rerank_key(r: _Req):
     return None if r.rerank is None else r.rerank.group_key
 
@@ -261,7 +285,7 @@ class CoalescingDispatcher:
                         and _rerank_key(r) == head_rr \
                         and _masks_equal(head, r):
                     group.append(self._pending.pop(i))
-                    rows += r.queries.shape[0]
+                    rows += _rows(r.queries)
                 else:
                     i += 1
             return group
@@ -317,7 +341,7 @@ class CoalescingDispatcher:
             # the group's WORST wait: the batch drained now, so every
             # member's wait ends here
             queue_s = max(t0 - r.enq_t for r in group)
-            rows = sum(r.queries.shape[0] for r in group)
+            rows = sum(_rows(r.queries) for r in group)
             span = self._batch_span(group, rows, queue_s)
             detach_token = None
             if span is not None:
@@ -334,9 +358,8 @@ class CoalescingDispatcher:
                     detach_token = tracing.detach()
             batch_exc: Optional[BaseException] = None
             try:
-                q = (group[0].queries if len(group) == 1
-                     else np.concatenate([r.queries for r in group], axis=0))
-                DISPATCH_DEVICE_ROWS.inc(q.shape[0])
+                q = _concat_queries(group)
+                DISPATCH_DEVICE_ROWS.inc(_rows(q))
                 if group[0].allow is not None:
                     # plane-vs-digest split: how often filtered batches
                     # ride a resident plane instead of digesting masks
@@ -361,7 +384,7 @@ class CoalescingDispatcher:
                                                 group[0].allow)
                 at = 0
                 for r in group:
-                    n = r.queries.shape[0]
+                    n = _rows(r.queries)
                     r.ids = ids[at:at + n]
                     r.dists = dists[at:at + n]
                     at += n

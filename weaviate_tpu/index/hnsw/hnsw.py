@@ -1524,6 +1524,84 @@ class HNSWIndex(VectorIndex):
             ids = np.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
         return ids, d
 
+    def multi_walk_inputs(self, queries, k: int, b_pad: int,
+                          allow_list=None, expand: int = 0):
+        """One WALK LEG of the fused multi-target program: everything
+        ``_device_beam_search`` would hand the single-target kernel —
+        scorer + HBM operands, padded device queries, synced adjacency
+        mirror, entrypoints/seed table, pow2-bucketed widths, device
+        allow mask — extracted so the shard's multi-target dispatcher
+        (``core/shard.py``) can assemble N legs into ONE
+        ``device_multi_search[_mesh]`` dispatch. Returns None when this
+        index cannot serve a device walk right now (mirror dropped /
+        demoted / unfitted quantizer); the caller then falls back to
+        the host per-target-walk+join oracle for the whole request."""
+        if self._device_beam is None or not self.device_resident:
+            return None
+        scorer_pack = self.backend.device_scorer()
+        if scorer_pack is None:
+            return None  # quantizer unfitted: lifecycle, not a failure
+        scorer, operands = scorer_pack
+        qdev = self._qdev(queries)
+        q = self.backend.beam_queries(qdev)
+        if q is None:
+            return None
+        import jax.numpy as jnp
+
+        ef = self._dynamic_ef(k)
+        fetch = self._fetch_width(k, ef)
+        ef_pad = 1 << max(4, (int(ef) - 1).bit_length())
+        fetch_pad = min(ef_pad, 1 << max(3, (int(fetch) - 1).bit_length()))
+        b = q.shape[0]
+        if b_pad != b:
+            q = jnp.concatenate(
+                [q, jnp.repeat(q[:1], b_pad - b, axis=0)], axis=0)
+        adj, present = self._device_beam.sync()
+        upper_adj, upper_slots = self._device_beam.sync_upper()
+        cap = int(adj.shape[0])
+        mesh_mirror = self._mesh_mirror()
+        leg = dict(
+            scorer=scorer, operands=operands, q=q, adj=adj,
+            present=present, upper_adj=upper_adj,
+            upper_slots=upper_slots, ef_pad=ef_pad, fetch_pad=fetch_pad,
+            cap=cap, allow=None, keep_k=0, expand=0,
+            mesh_mirror=mesh_mirror,
+        )
+        if mesh_mirror is not None:
+            leg["seeds"] = mesh_mirror.sync_seeds()
+        else:
+            leg["eps"] = np.full(b_pad, self.graph.entrypoint, np.int32)
+        if allow_list is not None:
+            plane = (allow_list if getattr(allow_list, "plane_id", None)
+                     is not None else None)
+            al = (plane.mask(cap) if plane is not None
+                  else np.asarray(allow_list, bool))
+            if len(al) < cap:
+                al = np.pad(al, (0, cap - len(al)))
+            al_pad = al[:cap]
+            if mesh_mirror is not None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from weaviate_tpu.parallel.mesh import SHARD_AXIS
+
+                shard_spec = NamedSharding(mesh_mirror.mesh, P(SHARD_AXIS))
+                leg["allow"] = (plane.device_mask(cap, shard_spec)
+                                if plane is not None
+                                else jax.device_put(al_pad, shard_spec))
+            else:
+                leg["allow"] = (plane.device_mask(cap) if plane is not None
+                                else jnp.asarray(al_pad))
+            leg["keep_k"] = fetch_pad
+            leg["expand"] = expand
+        return leg
+
+    def beam_proven(self) -> None:
+        """Mark the fused walk proven on this backend — called by the
+        multi-target dispatcher after a leg of its joint program ran,
+        so a later single-target failure is classified transient."""
+        self._beam_proven = True
+
     def _flat_filtered(self, queries, k, allow_list):
         d, ids = self.backend.flat_topk(queries, k, allow_list)
         return SearchResult(ids=ids, dists=d)

@@ -300,6 +300,22 @@ FILTER_PLANE_HBM_BYTES = REGISTRY.gauge(
     "weaviate_tpu_filter_plane_hbm_bytes",
     "HBM bytes held by resident filter-plane device mirrors, by shard "
     "(charged inside the shard's tiering-ledger footprint)")
+MULTITARGET_REQUESTS = REGISTRY.counter(
+    "weaviate_tpu_multitarget_requests_total",
+    "multi-target (named-vector) searches served, by join mode "
+    "(weighted/minimum/relative); the fused path serves a whole "
+    "request as ONE device dispatch (docs/multitarget.md)")
+MULTITARGET_FALLBACK = REGISTRY.counter(
+    "weaviate_tpu_multitarget_fallback_total",
+    "multi-target searches that fell back to the host per-target "
+    "walk+join oracle, by mode (transient/latched/ineligible); latched "
+    "means the fused multi-target program is disabled for that "
+    "target set until restart")
+TARGET_PLANE_HBM_BYTES = REGISTRY.gauge(
+    "weaviate_tpu_target_plane_hbm_bytes",
+    "HBM bytes held per named-vector target plane, by shard and "
+    "target (each target's corpus/code plane + topology mirror pays "
+    "tiering-ledger rent independently)")
 DEVICE_BEAM_FALLBACK = REGISTRY.counter(
     "weaviate_tpu_device_beam_fallback_total",
     "fused device-beam walks that fell back to the host per-hop path, "

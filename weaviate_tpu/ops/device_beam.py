@@ -911,6 +911,330 @@ def fused_flat_rerank(module, queries, corpus, valid, q_tokens, q_mask,
         precision=precision)
 
 
+# ---------------------------------------------------------------------------
+# multi-target fused search: N named-vector walks + weighted join, ONE jit
+# ---------------------------------------------------------------------------
+#
+# The reference fans out one goroutine per target vector and joins the
+# candidate lists on the host (traverser multi-target path; PAPER.md
+# §2.9 intra-query parallelism). The jax-native analogue inlines each
+# target's ALREADY-JITTED fused walk (`_fused_search` /
+# `_fused_mesh_search`) into one outer program — per-target descent +
+# beam over that target's own HBM planes, then a generalized fusion
+# stage (the hybrid-search join with target weights as a TRACED input,
+# so sum / average / manualWeights requests share one compiled program)
+# and one on-device top-k. N targets still cost exactly one dispatch.
+#
+# Join semantics (host oracle: query/multi_target.combine_multi_target):
+#   "weighted"  — Σ_t w_t · d_t   (sum: w=1; average: w=1/T;
+#                 manualWeights: caller weights)
+#   "minimum"   — min_t d_t
+#   "relative"  — per-target min-max normalize over the candidate pool,
+#                 then Σ_t w_t · norm_t (relativeScore)
+# A candidate missing ANY target's vector is masked to _INF — exactly
+# the host oracle's drop-if-missing semantics.
+
+_MT_JOINS = ("weighted", "minimum", "relative")
+
+
+def _mt_dedup(cand):
+    """In-row dedup of the cross-target candidate union: ascending sort
+    clusters duplicates (and -1 pads, which sort first), adjacent equals
+    collapse to -1. Order is irrelevant — the join re-ranks the pool."""
+    cand = jnp.sort(cand, axis=1)
+    dup = (cand[:, 1:] == cand[:, :-1]) & (cand[:, 1:] >= 0)
+    return jnp.concatenate(
+        [cand[:, :1], jnp.where(dup, -1, cand[:, 1:])], axis=1)
+
+
+def _mt_join(join, weights, stack, valid_all):
+    """[B, C, T] per-target distances + [B, C] validity → [B, C]
+    combined distance (invalid slots at _INF). ``weights`` [B, T] is
+    traced — per-REQUEST weights ride the batch, so differently-weighted
+    requests over the same target set share one compiled program."""
+    if join == "minimum":
+        combined = jnp.min(stack, axis=-1)
+    elif join == "relative":
+        # min-max normalize each target over the VALID candidate pool
+        # (the host oracle normalizes over its own top-k pool; the pools
+        # coincide up to walk recall)
+        vmask = valid_all[:, :, None]
+        lo = jnp.min(jnp.where(vmask, stack, _INF), axis=1, keepdims=True)
+        hi = jnp.max(jnp.where(vmask, stack, _NEG_INF), axis=1,
+                     keepdims=True)
+        span = hi - lo
+        span = jnp.where(span > 0, span, jnp.float32(1.0))
+        combined = jnp.sum(((stack - lo) / span) * weights[:, None, :],
+                           axis=-1)
+    else:
+        combined = jnp.sum(stack * weights[:, None, :], axis=-1)
+    return jnp.where(valid_all, combined, _INF)
+
+
+def _mt_topk(cand, combined, fetch):
+    neg, sel = jax.lax.top_k(-combined, fetch)
+    ids = jnp.take_along_axis(cand, sel, axis=1)
+    d_out = -neg
+    ok = d_out < _INF
+    return jnp.where(ok, ids, -1), jnp.where(ok, d_out, _INF)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scorers", "efs", "max_steps", "fetch", "join",
+                     "keep_ks", "expands"))
+def _fused_multi_search(
+    scorers,        # static tuple of per-target Scorers
+    weights,        # [B, T] traced join weights (rows = requests)
+    queries,        # tuple of per-target query reps [B, ...]
+    operands,       # tuple of per-target HBM operand tuples
+    adjacency,      # tuple of [N_t, M0_t] int32 layer-0 adjacencies
+    present,        # tuple of [N_t] bool node-exists masks
+    eps,            # tuple of [B] int32 per-target entrypoints
+    upper_adj,      # tuple of [L_t, S_t, M_t] slot-compacted tables
+    upper_slots,    # tuple of [L_t, N_t] node -> slot maps
+    efs,            # static tuple: per-target beam width
+    max_steps: int,
+    fetch: int,     # static: per-target pool width AND output width
+    join: str,      # static: "weighted" | "minimum" | "relative"
+    allows=None,    # tuple of Optional [N_t] bool (shared docid space)
+    keep_ks=None,   # static tuple: per-target kept-track width
+    expands=None,   # static tuple: per-target two-hop widening budget
+):
+    """→ (ids [B, fetch], combined [B, fetch]) ascending by joined
+    distance; -1/_INF padded. One program: T inlined fused walks (each
+    over its own planes/graph/scorer), candidate-union dedup, per-target
+    cross-scoring of the union (a candidate surfaced by target A's walk
+    gets its exact target-B distance from B's scorer — the device
+    analogue of the host oracle's gap-fill recompute), weighted join,
+    one top-k. Node ids are shard docids, shared across every target's
+    graph, which is what makes cross-target scoring well-defined."""
+    t_count = len(scorers)
+    cands = []
+    for t in range(t_count):
+        out = _fused_search(
+            scorers[t], queries[t], operands[t], adjacency[t], present[t],
+            eps[t], upper_adj[t], upper_slots[t], ef=efs[t],
+            max_steps=max_steps, allow=allows[t], keep_k=keep_ks[t],
+            expand=expands[t])
+        pool = out[2] if (allows[t] is not None and keep_ks[t] > 0) \
+            else out[0]
+        cands.append(pool[:, :fetch])
+    cand = _mt_dedup(jnp.concatenate(cands, axis=1))
+
+    per_d = []
+    valid_all = cand >= 0
+    for t in range(t_count):
+        cap_t = present[t].shape[0]
+        safe = jnp.clip(cand, 0, cap_t - 1)
+        # a docid can exceed target t's capacity (planes grow
+        # independently) or lack a t-vector (present False) — both mean
+        # "missing this target", which invalidates the candidate
+        ok_t = (cand >= 0) & (cand < cap_t) & jnp.take(present[t], safe)
+        d_t = _masked_scores(scorers[t], queries[t],
+                             jnp.where(ok_t, cand, -1), operands[t])
+        per_d.append(d_t)
+        valid_all &= ok_t
+    combined = _mt_join(join, weights, jnp.stack(per_d, axis=-1),
+                        valid_all)
+    return _mt_topk(cand, combined, fetch)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scorers", "efs", "max_steps", "fetch", "join",
+                     "keep_ks", "expands", "mesh", "axis"))
+def _fused_multi_mesh_search(
+    scorers,
+    weights,        # [B, T] replicated
+    queries,        # tuple of per-target [B, ...] replicated
+    operands,       # tuple of per-target operand tuples (row-sharded)
+    adjacency,      # tuple of [cap_t, M0] row-sharded, LOCAL ids
+    present,        # tuple of [cap_t] bool row-sharded
+    seeds,          # tuple of [n, E] int32 sharded on 0, LOCAL ids
+    upper_adj,      # tuple of [n, Lv, S, M] sharded on 0
+    upper_slots,    # tuple of [Lv, cap_t] sharded on dim 1
+    efs,
+    max_steps: int,
+    fetch: int,
+    join: str,
+    mesh=None,
+    axis: str = "shard",
+    allows=None,
+    keep_ks=None,
+    expands=None,
+):
+    """Mesh twin: T inlined SPMD walks (each already merging across
+    shards on device) feed one replicated candidate union; a second
+    shard_map cross-scores the union against every target's row-sharded
+    planes — each shard scores the docids IT owns (per-target
+    capacities, hence shard boundaries, may differ; global docid = shard
+    base + local row reconstructs identically for every target) and
+    ``pmin``/``pmax`` resolve ownership — then the join + top-k run
+    replicated. Still exactly ONE dispatch for the whole mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    from weaviate_tpu.parallel.sharded_search import _shard_map
+
+    t_count = len(scorers)
+    cands = []
+    for t in range(t_count):
+        out = _fused_mesh_search(
+            scorers[t], queries[t], operands[t], adjacency[t], present[t],
+            upper_adj[t], upper_slots[t], ef=efs[t], max_steps=max_steps,
+            fetch=fetch, mesh=mesh, axis=axis, merge=True, seeds=seeds[t],
+            allow=allows[t], keep_k=keep_ks[t], expand=expands[t])
+        pool = out[2] if (allows[t] is not None and keep_ks[t] > 0) \
+            else out[0]
+        cands.append(pool[:, :fetch])
+    cand = _mt_dedup(jnp.concatenate(cands, axis=1))
+
+    def xscore(cand_r, *rest):
+        rest = list(rest)
+        per_d = []
+        ok_all = cand_r >= 0
+        for t in range(t_count):
+            q_t = rest.pop(0)
+            ops_t = rest.pop(0)
+            pres_t = rest.pop(0)
+            n_local = pres_t.shape[0]
+            base = jax.lax.axis_index(axis) * n_local
+            loc = cand_r - base
+            inr = (cand_r >= 0) & (loc >= 0) & (loc < n_local)
+            safe = jnp.clip(loc, 0, n_local - 1)
+            ok = inr & jnp.take(pres_t, safe)
+            d = _masked_scores(scorers[t], q_t,
+                               jnp.where(ok, loc, -1), ops_t)
+            # exactly one shard owns each docid for target t; the
+            # non-owners hold _INF / False, so pmin/pmax ARE the
+            # ownership resolution (and leave the result replicated)
+            d = jax.lax.pmin(d, axis)
+            okg = jax.lax.pmax(ok.astype(jnp.int32), axis) > 0
+            per_d.append(jnp.where(okg, d, _INF))
+            ok_all &= okg
+        return jnp.stack(per_d, axis=-1), ok_all
+
+    in_specs = [P(None, None)]
+    args = [cand]
+    for t in range(t_count):
+        cap_t = present[t].shape[0]
+        in_specs += [
+            P(*([None] * np.ndim(queries[t]))),
+            tuple(_op_partition_spec(a, cap_t, axis)
+                  for a in operands[t]),
+            P(axis),
+        ]
+        args += [queries[t], operands[t], present[t]]
+    fn = _shard_map(xscore, mesh=mesh, in_specs=tuple(in_specs),
+                    out_specs=(P(None, None, None), P(None, None)))
+    stack, valid_all = fn(*args)
+    combined = _mt_join(join, weights, stack, valid_all)
+    return _mt_topk(cand, combined, fetch)
+
+
+def _mt_norm_static(t_count, allows, keep_ks, expands):
+    allows = tuple(allows) if allows is not None else (None,) * t_count
+    keep_ks = tuple(keep_ks) if keep_ks is not None else (0,) * t_count
+    expands = tuple(expands) if expands is not None else (0,) * t_count
+    return allows, keep_ks, expands
+
+
+def device_multi_search(
+    scorers,
+    weights,
+    queries,
+    operands,
+    adjacency,
+    present,
+    eps,
+    upper_adjs,
+    upper_slots,
+    efs,
+    max_steps: int,
+    fetch: int,
+    join: str,
+    allows=None,
+    keep_ks=None,
+    expands=None,
+):
+    """Dispatch ONE fused multi-target program: per-target walks +
+    cross-scored weighted join + top-k. Increments the module dispatch
+    counter once — the test hook behind 'N targets, one dispatch'."""
+    global _dispatch_count
+    t_count = len(scorers)
+    if join not in _MT_JOINS:
+        raise ValueError(f"unknown multi-target join {join!r}")
+    allows, keep_ks, expands = _mt_norm_static(
+        t_count, allows, keep_ks, expands)
+    ua, us = [], []
+    for t in range(t_count):
+        a, s = upper_adjs[t], upper_slots[t]
+        if a is None or a.shape[0] == 0:
+            a, s = _empty_upper()
+        ua.append(a)
+        us.append(s)
+    _dispatch_count += 1
+    return _fused_multi_search(
+        tuple(scorers), weights, tuple(queries), tuple(operands),
+        tuple(adjacency), tuple(present),
+        tuple(jnp.asarray(e, jnp.int32) for e in eps),
+        tuple(ua), tuple(us), efs=tuple(efs), max_steps=max_steps,
+        fetch=fetch, join=join, allows=allows, keep_ks=keep_ks,
+        expands=expands)
+
+
+def device_multi_search_mesh(
+    scorers,
+    weights,
+    queries,
+    operands,
+    adjacency,
+    present,
+    seeds,
+    mesh,
+    efs,
+    max_steps: int,
+    fetch: int,
+    join: str,
+    upper_adjs=None,
+    upper_slots=None,
+    allows=None,
+    keep_ks=None,
+    expands=None,
+    axis: str = "shard",
+):
+    """Mesh twin of :func:`device_multi_search`: one SPMD program spans
+    every chip AND every target. Serialized on the collective-dispatch
+    lock like every merged mesh walk."""
+    global _dispatch_count
+    t_count = len(scorers)
+    if join not in _MT_JOINS:
+        raise ValueError(f"unknown multi-target join {join!r}")
+    allows, keep_ks, expands = _mt_norm_static(
+        t_count, allows, keep_ks, expands)
+    ua, us = [], []
+    for t in range(t_count):
+        a = None if upper_adjs is None else upper_adjs[t]
+        s = None if upper_slots is None else upper_slots[t]
+        if a is None or a.shape[1] == 0:
+            a, s = _mesh_empty_upper(mesh, adjacency[t].shape[0], axis)
+        ua.append(a)
+        us.append(s)
+    _dispatch_count += 1
+    from weaviate_tpu.monitoring.metrics import MESH_BEAM_DISPATCH
+
+    MESH_BEAM_DISPATCH.inc(mode="search")
+    from weaviate_tpu.parallel.sharded_search import mesh_dispatch_lock
+
+    with mesh_dispatch_lock():
+        return _fused_multi_mesh_search(
+            tuple(scorers), weights, tuple(queries), tuple(operands),
+            tuple(adjacency), tuple(present), tuple(seeds),
+            tuple(ua), tuple(us), efs=tuple(efs), max_steps=max_steps,
+            fetch=fetch, join=join, mesh=mesh, axis=axis, allows=allows,
+            keep_ks=keep_ks, expands=expands)
+
+
 class DeviceAdjacency:
     """Incrementally synced device mirror of the host graph topology.
 

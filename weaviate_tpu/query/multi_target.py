@@ -34,6 +34,67 @@ def np_distance(q: np.ndarray, v: np.ndarray, metric: str) -> float:
     raise ValueError(f"unknown metric {metric!r}")
 
 
+def join_mode(combination: str) -> str:
+    """Map an API combination to the fused kernel's static join variant.
+    sum / average / manualWeights all lower to ONE "weighted" program —
+    only the traced weight rows differ — so they share a compile."""
+    if combination == "minimum":
+        return "minimum"
+    if combination == "relativeScore":
+        return "relative"
+    return "weighted"
+
+
+def weight_row(targets: list[str], combination: str,
+               weights: dict[str, float] | None) -> np.ndarray:
+    """Per-target weight row [T] feeding the kernel's traced ``weights``
+    input, reproducing the host oracle's arithmetic exactly: sum → 1,
+    average → 1/T, manualWeights/relativeScore → caller weights
+    (default 1), minimum → ones (the join ignores them)."""
+    t = len(targets)
+    if combination == "average":
+        return np.full(t, 1.0 / t, np.float32)
+    if combination in ("manualWeights", "relativeScore"):
+        return np.asarray([(weights or {}).get(tg, 1.0) for tg in targets],
+                          np.float32)
+    return np.ones(t, np.float32)
+
+
+def validate_multi_target(
+    targets: list[str], combination: str,
+    weights: dict[str, float] | None, known_targets,
+) -> None:
+    """Request-shape validation shared by every API surface: raises
+    ``ValueError`` (GraphQL errors / 400 at REST, INVALID_ARGUMENT at
+    gRPC) on unknown targets, duplicate targets, unknown combination,
+    or weight/target-set mismatch."""
+    if not targets:
+        raise ValueError("multi-target search requires at least one "
+                         "target vector")
+    if len(set(targets)) != len(targets):
+        raise ValueError("duplicate target vectors in targetVectors")
+    known = set(known_targets)
+    for t in targets:
+        if t not in known:
+            raise ValueError(f"unknown target vector {t!r}")
+    if combination not in COMBINATIONS:
+        raise ValueError(f"unknown combination {combination!r}")
+    if weights:
+        if combination not in ("manualWeights", "relativeScore"):
+            raise ValueError(
+                "targetVectors weights require the manualWeights or "
+                f"relativeScore combination, not {combination!r}")
+        extra = set(weights) - set(targets)
+        if extra:
+            raise ValueError(
+                f"weights name unknown targets: {sorted(extra)}")
+        if combination == "manualWeights" and set(weights) != set(targets):
+            missing = set(targets) - set(weights)
+            raise ValueError(
+                "manualWeights requires one weight per target; missing: "
+                f"{sorted(missing)}")
+
+
 def combine_multi_target(
     per_target: dict[str, dict], combination: str,
     weights: dict[str, float] | None = None,

@@ -57,6 +57,11 @@ MANIFEST: dict[str, str] = {
         "fused greedy-descent + layer-0 beam walk, single device",
     "ops.device_beam._fused_mesh_search":
         "fused beam walk as ONE SPMD program across the shard mesh",
+    "ops.device_beam._fused_multi_search":
+        "fused multi-target walk + cross-scored weighted join, single "
+        "device (docs/multitarget.md)",
+    "ops.device_beam._fused_multi_mesh_search":
+        "fused multi-target walk + join as ONE SPMD program on the mesh",
     "ops.device_beam._fused_flat_rerank":
         "fused coarse flat scan + device-module rerank (multivector "
         "MUVERA serving path, docs/modules.md)",
